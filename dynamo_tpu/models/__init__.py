@@ -1,0 +1,7 @@
+from dynamo_tpu.models.llama import (
+    LlamaConfig,
+    init_params,
+    forward,
+)
+
+__all__ = ["LlamaConfig", "init_params", "forward"]

@@ -1,0 +1,377 @@
+"""Llama-family decoder in pure functional JAX with a paged KV cache.
+
+This is the flagship engine model (the reference serves Llama via external
+GPU engines — vLLM/TRT-LLM; here the engine is first-class, SURVEY.md §2.9).
+Design choices are TPU-first:
+
+- One `forward` covers prefill AND decode: T is just the chunk length (1 for
+  decode). Attention always runs against the paged KV cache gathered through
+  the page table, so chunked prefill, prefix-cache continuation, and decode
+  are the same compiled program shape-family.
+- Layers are scanned (`lax.scan` over stacked layer params), so compile time
+  is O(1) in depth and XLA sees one fused layer body.
+- Weights live in bf16; softmax/norm accumulate in f32 (MXU-friendly).
+- All shapes are static: (B, T, MAX_PAGES) come from the scheduler's bucket,
+  padding is masked. No data-dependent control flow under jit.
+
+Parity notes: replaces the model execution the reference delegates to
+vLLM/SGLang/TRT-LLM subprocesses (/root/reference launch/dynamo-run/src/
+subprocess/vllm_inc.py etc.); paged-KV semantics match the vLLM-style paged
+attention contract (page table per sequence, block == token-block of the
+router, so KV routing hashes align with engine pages).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, replace
+from functools import partial
+from typing import Any, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 128256
+    hidden_size: int = 4096
+    intermediate_size: int = 14336
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 8
+    head_dim: int = 128
+    rope_theta: float = 500000.0
+    rms_norm_eps: float = 1e-5
+    tie_word_embeddings: bool = False
+    # Llama-3.1-style NTK rope scaling (None disables).
+    rope_scaling_factor: Optional[float] = None
+    rope_low_freq_factor: float = 1.0
+    rope_high_freq_factor: float = 4.0
+    rope_original_max_position: int = 8192
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def q_per_kv(self) -> int:
+        return self.num_heads // self.num_kv_heads
+
+    # -- canned configs ----------------------------------------------------
+
+    @staticmethod
+    def llama3_8b() -> "LlamaConfig":
+        return LlamaConfig()  # defaults above are Llama-3-8B
+
+    @staticmethod
+    def llama3_70b() -> "LlamaConfig":
+        return LlamaConfig(
+            hidden_size=8192, intermediate_size=28672, num_layers=80,
+            num_heads=64, num_kv_heads=8,
+        )
+
+    @staticmethod
+    def llama3_1b() -> "LlamaConfig":
+        """Llama-3.2-1B-shaped config — fits a single v5e chip with headroom."""
+        return LlamaConfig(
+            hidden_size=2048, intermediate_size=8192, num_layers=16,
+            num_heads=32, num_kv_heads=8, head_dim=64,
+            tie_word_embeddings=True,
+            rope_scaling_factor=32.0,
+        )
+
+    @staticmethod
+    def tiny(vocab_size: int = 256) -> "LlamaConfig":
+        """For unit tests (CPU) — small enough to compare against torch."""
+        return LlamaConfig(
+            vocab_size=vocab_size, hidden_size=64, intermediate_size=128,
+            num_layers=2, num_heads=4, num_kv_heads=2, head_dim=16,
+            rope_theta=10000.0, dtype=jnp.float32,
+        )
+
+    @staticmethod
+    def from_hf_config(hf: dict) -> "LlamaConfig":
+        """Map a HuggingFace `config.json` dict onto LlamaConfig."""
+        rope_scaling = hf.get("rope_scaling") or {}
+        factor = None
+        if rope_scaling.get("rope_type", rope_scaling.get("type")) == "llama3":
+            factor = float(rope_scaling["factor"])
+        head_dim = hf.get("head_dim") or hf["hidden_size"] // hf["num_attention_heads"]
+        return LlamaConfig(
+            vocab_size=hf["vocab_size"],
+            hidden_size=hf["hidden_size"],
+            intermediate_size=hf["intermediate_size"],
+            num_layers=hf["num_hidden_layers"],
+            num_heads=hf["num_attention_heads"],
+            num_kv_heads=hf.get("num_key_value_heads", hf["num_attention_heads"]),
+            head_dim=head_dim,
+            rope_theta=float(hf.get("rope_theta", 10000.0)),
+            rms_norm_eps=float(hf.get("rms_norm_eps", 1e-5)),
+            tie_word_embeddings=bool(hf.get("tie_word_embeddings", False)),
+            rope_scaling_factor=factor,
+            rope_low_freq_factor=float(rope_scaling.get("low_freq_factor", 1.0)),
+            rope_high_freq_factor=float(rope_scaling.get("high_freq_factor", 4.0)),
+            rope_original_max_position=int(
+                rope_scaling.get("original_max_position_embeddings", 8192)
+            ),
+        )
+
+
+class KVPages(NamedTuple):
+    """Paged KV cache: one page pool shared by all sequences of a worker.
+
+    k, v: [num_layers, num_pages, page_size, num_kv_heads, head_dim]
+    Page 0 is the null page: padding writes land there and no real page
+    table ever references it.
+    """
+
+    k: jax.Array
+    v: jax.Array
+
+    @property
+    def num_pages(self) -> int:
+        return self.k.shape[1]
+
+    @property
+    def page_size(self) -> int:
+        return self.k.shape[2]
+
+
+def init_kv_pages(
+    cfg: LlamaConfig, num_pages: int, page_size: int, dtype=None
+) -> KVPages:
+    shape = (cfg.num_layers, num_pages, page_size, cfg.num_kv_heads, cfg.head_dim)
+    dtype = dtype or cfg.dtype
+    return KVPages(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
+
+
+# ---------------------------------------------------------------------------
+# Parameters
+# ---------------------------------------------------------------------------
+
+
+def init_params(key: jax.Array, cfg: LlamaConfig) -> dict:
+    """Random-init params, layer-stacked for lax.scan."""
+    h, i, v = cfg.hidden_size, cfg.intermediate_size, cfg.vocab_size
+    qd, kvd = cfg.num_heads * cfg.head_dim, cfg.num_kv_heads * cfg.head_dim
+    L = cfg.num_layers
+    keys = jax.random.split(key, 10)
+
+    def norm_init(shape):
+        return jnp.ones(shape, cfg.dtype)
+
+    def dense(key, shape, fan_in):
+        scale = 1.0 / math.sqrt(fan_in)
+        return (jax.random.normal(key, shape, jnp.float32) * scale).astype(cfg.dtype)
+
+    params = {
+        "embed": dense(keys[0], (v, h), h),
+        "layers": {
+            "attn_norm": norm_init((L, h)),
+            "wq": dense(keys[1], (L, h, qd), h),
+            "wk": dense(keys[2], (L, h, kvd), h),
+            "wv": dense(keys[3], (L, h, kvd), h),
+            "wo": dense(keys[4], (L, qd, h), qd),
+            "mlp_norm": norm_init((L, h)),
+            "w_gate": dense(keys[5], (L, h, i), h),
+            "w_up": dense(keys[6], (L, h, i), h),
+            "w_down": dense(keys[7], (L, i, h), i),
+        },
+        "final_norm": norm_init((h,)),
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = dense(keys[8], (h, v), h)
+    return params
+
+
+def params_from_torch_state_dict(state_dict, cfg: LlamaConfig) -> dict:
+    """Convert a HuggingFace Llama state_dict (torch tensors) to our pytree.
+
+    HF stores projections as [out, in]; we use [in, out] so matmuls read
+    x @ W. Layer tensors are stacked along a leading L axis for lax.scan.
+    """
+    import numpy as np
+
+    def t(name):
+        w = state_dict[name]
+        return np.asarray(w.to("cpu").float().numpy())
+
+    L = cfg.num_layers
+
+    def stack(fmt, transpose=True):
+        ws = [t(fmt.format(l)) for l in range(L)]
+        ws = [w.T if transpose else w for w in ws]
+        return jnp.asarray(np.stack(ws), cfg.dtype)
+
+    params = {
+        "embed": jnp.asarray(t("model.embed_tokens.weight"), cfg.dtype),
+        "layers": {
+            "attn_norm": stack("model.layers.{}.input_layernorm.weight", transpose=False),
+            "wq": stack("model.layers.{}.self_attn.q_proj.weight"),
+            "wk": stack("model.layers.{}.self_attn.k_proj.weight"),
+            "wv": stack("model.layers.{}.self_attn.v_proj.weight"),
+            "wo": stack("model.layers.{}.self_attn.o_proj.weight"),
+            "mlp_norm": stack("model.layers.{}.post_attention_layernorm.weight", transpose=False),
+            "w_gate": stack("model.layers.{}.mlp.gate_proj.weight"),
+            "w_up": stack("model.layers.{}.mlp.up_proj.weight"),
+            "w_down": stack("model.layers.{}.mlp.down_proj.weight"),
+        },
+        "final_norm": jnp.asarray(t("model.norm.weight"), cfg.dtype),
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = jnp.asarray(t("lm_head.weight").T, cfg.dtype)
+    return params
+
+
+# ---------------------------------------------------------------------------
+# Building blocks
+# ---------------------------------------------------------------------------
+
+
+def rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    out = xf * lax.rsqrt(var + eps)
+    return (out * weight.astype(jnp.float32)).astype(x.dtype)
+
+
+def _rope_inv_freq(cfg: LlamaConfig) -> jax.Array:
+    d = cfg.head_dim
+    inv_freq = 1.0 / (
+        cfg.rope_theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d)
+    )
+    if cfg.rope_scaling_factor is not None:
+        # Llama-3.1 NTK-by-parts scaling.
+        low = cfg.rope_original_max_position / cfg.rope_low_freq_factor
+        high = cfg.rope_original_max_position / cfg.rope_high_freq_factor
+        wavelen = 2.0 * jnp.pi / inv_freq
+        smooth = (cfg.rope_original_max_position / wavelen - cfg.rope_low_freq_factor) / (
+            cfg.rope_high_freq_factor - cfg.rope_low_freq_factor
+        )
+        smooth = jnp.clip(smooth, 0.0, 1.0)
+        scaled = inv_freq / cfg.rope_scaling_factor
+        blended = (1.0 - smooth) * scaled + smooth * inv_freq
+        inv_freq = jnp.where(wavelen > low, scaled, jnp.where(wavelen < high, inv_freq, blended))
+    return inv_freq
+
+
+def apply_rope(x: jax.Array, positions: jax.Array, cfg: LlamaConfig) -> jax.Array:
+    """x: [B, T, H, D]; positions: [B, T] absolute positions."""
+    inv_freq = _rope_inv_freq(cfg)
+    angles = positions[..., None].astype(jnp.float32) * inv_freq  # [B,T,D/2]
+    cos = jnp.cos(angles)[:, :, None, :]  # [B,T,1,D/2]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+def paged_scatter(
+    cache: jax.Array,  # [P, S, Hkv, D]
+    new: jax.Array,  # [B, T, Hkv, D]
+    page_tables: jax.Array,  # [B, MP] int32
+    positions: jax.Array,  # [B, T] int32
+    valid: jax.Array,  # [B, T] bool
+) -> jax.Array:
+    """Write new KV for absolute `positions` into their pages.
+
+    Invalid (padding) slots are redirected to the null page 0 slot 0.
+    """
+    page_size = cache.shape[1]
+    page_of = positions // page_size  # [B,T] index into page table
+    slot_of = positions % page_size
+    page_ids = jnp.take_along_axis(page_tables, page_of, axis=1)  # [B,T]
+    page_ids = jnp.where(valid, page_ids, 0)
+    slot_of = jnp.where(valid, slot_of, 0)
+    flat_pages = page_ids.reshape(-1)
+    flat_slots = slot_of.reshape(-1)
+    flat_new = new.reshape((-1,) + new.shape[2:])
+    return cache.at[flat_pages, flat_slots].set(flat_new, mode="drop")
+
+
+def paged_gather(cache: jax.Array, page_tables: jax.Array) -> jax.Array:
+    """[P, S, Hkv, D] × [B, MP] -> [B, MP*S, Hkv, D], position-ordered."""
+    g = cache[page_tables]  # [B, MP, S, Hkv, D]
+    b, mp, s = g.shape[0], g.shape[1], g.shape[2]
+    return g.reshape(b, mp * s, *g.shape[3:])
+
+
+def paged_attention(
+    q: jax.Array,  # [B, T, Hq, D] (post-rope)
+    k_pages: jax.Array,  # [B, K, Hkv, D] gathered, position-ordered
+    v_pages: jax.Array,  # [B, K, Hkv, D]
+    q_positions: jax.Array,  # [B, T]
+    cfg: LlamaConfig,
+) -> jax.Array:
+    """Reference paged attention (XLA path; Pallas kernel in dynamo_tpu.ops
+    replaces this on TPU for long contexts).
+
+    Causality over the whole paged history: key at gathered index i has
+    absolute position i, so the mask is simply key_pos <= q_pos. Unallocated
+    page-table slots sit at positions >= seq_len and are masked by the same
+    comparison.
+    """
+    b, t, hq, d = q.shape
+    kk = k_pages.shape[1]
+    g = cfg.q_per_kv
+    qg = q.reshape(b, t, cfg.num_kv_heads, g, d)
+    scale = 1.0 / math.sqrt(d)
+    scores = jnp.einsum(
+        "btkgd,bskd->bkgts", qg.astype(jnp.float32), k_pages.astype(jnp.float32)
+    ) * scale
+    key_pos = jnp.arange(kk)[None, None, None, None, :]
+    mask = key_pos <= q_positions[:, None, None, :, None]
+    scores = jnp.where(mask, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bkgts,bskd->btkgd", probs, v_pages.astype(jnp.float32))
+    return out.reshape(b, t, hq * d).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+
+def forward(
+    params: dict,
+    cfg: LlamaConfig,
+    tokens: jax.Array,  # [B, T] int32
+    positions: jax.Array,  # [B, T] int32 absolute positions (padding: any)
+    valid: jax.Array,  # [B, T] bool — which (b,t) are real tokens
+    kv: KVPages,
+    page_tables: jax.Array,  # [B, MP] int32
+) -> tuple[jax.Array, KVPages]:
+    """One model step over a token chunk; returns (logits [B,T,V], new kv).
+
+    Covers prefill (T = chunk), decode (T = 1), and prefix-cache continuation
+    (positions start past 0) uniformly.
+    """
+    h = params["embed"][tokens].astype(cfg.dtype)  # [B,T,H]
+
+    def layer(h, xs):
+        lp, k_cache, v_cache = xs
+        x = rms_norm(h, lp["attn_norm"], cfg.rms_norm_eps)
+        b, t, _ = x.shape
+        q = (x @ lp["wq"]).reshape(b, t, cfg.num_heads, cfg.head_dim)
+        k = (x @ lp["wk"]).reshape(b, t, cfg.num_kv_heads, cfg.head_dim)
+        v = (x @ lp["wv"]).reshape(b, t, cfg.num_kv_heads, cfg.head_dim)
+        q = apply_rope(q, positions, cfg)
+        k = apply_rope(k, positions, cfg)
+        k_cache = paged_scatter(k_cache, k, page_tables, positions, valid)
+        v_cache = paged_scatter(v_cache, v, page_tables, positions, valid)
+        k_all = paged_gather(k_cache, page_tables)
+        v_all = paged_gather(v_cache, page_tables)
+        attn = paged_attention(q, k_all, v_all, positions, cfg)
+        h = h + attn @ lp["wo"]
+        x = rms_norm(h, lp["mlp_norm"], cfg.rms_norm_eps)
+        gate = jax.nn.silu((x @ lp["w_gate"]).astype(jnp.float32))
+        up = (x @ lp["w_up"]).astype(jnp.float32)
+        h = h + ((gate * up).astype(cfg.dtype) @ lp["w_down"])
+        return h, (k_cache, v_cache)
+
+    h, (k_new, v_new) = lax.scan(layer, h, (params["layers"], kv.k, kv.v))
+    h = rms_norm(h, params["final_norm"], cfg.rms_norm_eps)
+    lm_head = params.get("lm_head")
+    if lm_head is None:
+        lm_head = params["embed"].T
+    logits = (h @ lm_head).astype(jnp.float32)
+    return logits, KVPages(k=k_new, v=v_new)

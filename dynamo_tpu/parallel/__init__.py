@@ -1,0 +1,16 @@
+from dynamo_tpu.parallel.mesh import MeshConfig, make_mesh
+from dynamo_tpu.parallel.shardings import (
+    batch_spec,
+    kv_cache_spec,
+    llama_param_specs,
+    shardings_for,
+)
+
+__all__ = [
+    "MeshConfig",
+    "make_mesh",
+    "batch_spec",
+    "kv_cache_spec",
+    "llama_param_specs",
+    "shardings_for",
+]

@@ -1,0 +1,67 @@
+"""Device mesh construction.
+
+TPU-first parallelism lives here: all intra-engine parallelism (tensor /
+data / expert / sequence) is expressed as shardings over a single
+`jax.sharding.Mesh`, with XLA inserting the ICI collectives. This replaces
+what the reference delegates to its GPU engines via NCCL (SURVEY.md §2.9:
+TP/PP/DP/EP are engine-delegated flags like `tensor-parallel-size`; here the
+engine is ours, so the mesh IS the parallelism implementation).
+
+Axis conventions (scaling-book style):
+- "dp"  — data parallel over the request batch
+- "tp"  — tensor parallel over heads / hidden / vocab
+- "ep"  — expert parallel for MoE (maps onto "tp" devices for dense models)
+- "sp"  — sequence/context parallel (ring attention), optional
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    """Parallel layout of one engine worker."""
+
+    dp: int = 1
+    tp: int = 1
+    sp: int = 1
+    axis_names: tuple[str, ...] = ("dp", "sp", "tp")
+
+    @property
+    def shape(self) -> tuple[int, ...]:
+        return (self.dp, self.sp, self.tp)
+
+    @property
+    def num_devices(self) -> int:
+        return self.dp * self.sp * self.tp
+
+    @staticmethod
+    def single_device() -> "MeshConfig":
+        return MeshConfig(dp=1, tp=1, sp=1)
+
+
+def make_mesh(
+    config: Optional[MeshConfig] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a Mesh laid out so "tp" is the innermost (fastest-ICI) axis.
+
+    TP collectives (per-layer all-reduce) are latency-critical, so they ride
+    the innermost device ring; DP gradients-of-nothing (inference) only
+    all-gathers tokens rarely.
+    """
+    config = config or MeshConfig.single_device()
+    devices = list(devices if devices is not None else jax.devices())
+    n = config.num_devices
+    if len(devices) < n:
+        raise ValueError(
+            f"mesh {config.shape} needs {n} devices, have {len(devices)}"
+        )
+    arr = np.asarray(devices[:n]).reshape(config.shape)
+    return Mesh(arr, axis_names=config.axis_names)

@@ -1,0 +1,65 @@
+"""Sharding rules: logical tensor dims -> mesh axes.
+
+The recipe (scaling-book style): pick a mesh, annotate param/activation
+shardings with PartitionSpecs, jit, and let XLA insert the ICI collectives.
+
+Megatron-style TP layout for Llama:
+- wq/wk/wv: shard the head (output) dim on "tp" — each device owns a head
+  subset, attention is embarrassingly parallel across heads.
+- wo / w_down: shard the *input* dim on "tp" — the following matmul produces
+  partial sums; XLA inserts one psum (all-reduce) per layer, the minimal TP
+  collective count.
+- embed/lm_head: shard the vocab/hidden dim on "tp".
+- KV pages: shard kv-heads on "tp" — KV stays resident beside its heads,
+  no KV collectives during decode.
+- Request batch dims shard on "dp".
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dynamo_tpu.models.llama import LlamaConfig
+
+
+def llama_param_specs(cfg: LlamaConfig) -> dict:
+    specs = {
+        "embed": P(None, "tp"),
+        "layers": {
+            "attn_norm": P(None, None),
+            "wq": P(None, None, "tp"),
+            "wk": P(None, None, "tp"),
+            "wv": P(None, None, "tp"),
+            "wo": P(None, "tp", None),
+            "mlp_norm": P(None, None),
+            "w_gate": P(None, None, "tp"),
+            "w_up": P(None, None, "tp"),
+            "w_down": P(None, "tp", None),
+        },
+        "final_norm": P(None),
+    }
+    if not cfg.tie_word_embeddings:
+        specs["lm_head"] = P(None, "tp")
+    return specs
+
+
+def kv_cache_spec() -> P:
+    # [L, P, S, Hkv, D] — kv heads ride with their tp shard.
+    return P(None, None, None, "tp", None)
+
+
+def batch_spec(ndim: int) -> P:
+    # [B, ...] request-batch tensors shard over dp.
+    return P(*(("dp",) + (None,) * (ndim - 1)))
+
+
+def shardings_for(mesh: Mesh, specs: Any):
+    """Map a pytree of PartitionSpecs to NamedShardings on `mesh`."""
+    return jax.tree.map(
+        lambda s: NamedSharding(mesh, s),
+        specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )

@@ -1,0 +1,25 @@
+from dynamo_tpu.tokens.blocks import (
+    BLOCK_HASH_SEED,
+    DEFAULT_BLOCK_SIZE,
+    PartialTokenBlock,
+    SaltHash,
+    SequenceHash,
+    TokenBlock,
+    TokenBlockSequence,
+    compute_block_hash,
+    compute_seq_hash,
+    hash_token_blocks,
+)
+
+__all__ = [
+    "BLOCK_HASH_SEED",
+    "DEFAULT_BLOCK_SIZE",
+    "PartialTokenBlock",
+    "SaltHash",
+    "SequenceHash",
+    "TokenBlock",
+    "TokenBlockSequence",
+    "compute_block_hash",
+    "compute_seq_hash",
+    "hash_token_blocks",
+]

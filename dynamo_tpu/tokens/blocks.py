@@ -1,0 +1,214 @@
+"""Content-addressed token blocks.
+
+The canonical contract that makes KV-cache-aware routing work across engines:
+a token stream is chunked into fixed-size blocks; each full block gets a
+*sequence hash* chained from its parent so that an identical prefix always
+produces an identical chain of hashes, regardless of which worker produced it.
+The engine's paged KV cache, the KV router's radix indexer, the block manager,
+and the mock engine all speak in these hashes.
+
+Capability parity with the reference's token primitives crate
+(/root/reference lib/tokens/src/lib.rs: `TokenBlock` :221, chained hash :231,
+`PartialTokenBlock::push_token` :152, xxh3 with salt :44), re-implemented
+independently: we chain xxh3_64 over little-endian u32 tokens with the parent
+sequence hash folded in as the seed.
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass, field
+from typing import Iterable, Optional, Sequence
+
+import xxhash
+
+Token = int
+SequenceHash = int  # u64
+SaltHash = int  # u64
+
+#: Seed used when hashing the salt string and the root block.
+BLOCK_HASH_SEED = 1337
+
+#: Default block size. The reference deploys 64/128-token blocks; 64 hits a
+#: good balance between routing granularity and page-table overhead on TPU
+#: (one block == one KV page in the engine).
+DEFAULT_BLOCK_SIZE = 64
+
+_U64_MASK = (1 << 64) - 1
+
+
+def compute_salt_hash(salt: str = "") -> SaltHash:
+    """Hash a namespace salt (e.g. model id) so hash chains from different
+    models never collide in a shared index."""
+    return xxhash.xxh3_64_intdigest(salt.encode("utf-8"), seed=BLOCK_HASH_SEED)
+
+
+def _pack_tokens(tokens: Sequence[Token]) -> bytes:
+    return struct.pack(f"<{len(tokens)}I", *[t & 0xFFFFFFFF for t in tokens])
+
+
+def compute_block_hash(tokens: Sequence[Token], seed: int) -> int:
+    """Hash one block's tokens under a chaining seed (parent hash or salt)."""
+    return xxhash.xxh3_64_intdigest(_pack_tokens(tokens), seed=seed & _U64_MASK)
+
+
+def compute_seq_hash(parent: Optional[SequenceHash], block_hash: int) -> SequenceHash:
+    """Chain a block hash onto its parent to get the block's sequence hash."""
+    if parent is None:
+        return block_hash & _U64_MASK
+    return xxhash.xxh3_64_intdigest(
+        struct.pack("<QQ", parent & _U64_MASK, block_hash & _U64_MASK),
+        seed=BLOCK_HASH_SEED,
+    )
+
+
+@dataclass(frozen=True)
+class TokenBlock:
+    """An immutable, full block of tokens with its chained identity."""
+
+    tokens: tuple[Token, ...]
+    block_hash: int
+    sequence_hash: SequenceHash
+    parent_sequence_hash: Optional[SequenceHash]
+    block_index: int
+
+    def __len__(self) -> int:
+        return len(self.tokens)
+
+
+@dataclass
+class PartialTokenBlock:
+    """The mutable tail of a sequence: accumulates tokens until full."""
+
+    block_size: int
+    salt_hash: SaltHash
+    parent_sequence_hash: Optional[SequenceHash]
+    block_index: int
+    tokens: list[Token] = field(default_factory=list)
+
+    @property
+    def remaining(self) -> int:
+        return self.block_size - len(self.tokens)
+
+    def push_token(self, token: Token) -> Optional[TokenBlock]:
+        """Append one token; returns the committed TokenBlock when it fills."""
+        self.tokens.append(token)
+        if len(self.tokens) == self.block_size:
+            return self._commit()
+        return None
+
+    def _commit(self) -> TokenBlock:
+        seed = (
+            self.parent_sequence_hash
+            if self.parent_sequence_hash is not None
+            else self.salt_hash
+        )
+        block_hash = compute_block_hash(self.tokens, seed)
+        seq_hash = compute_seq_hash(self.parent_sequence_hash, block_hash)
+        return TokenBlock(
+            tokens=tuple(self.tokens),
+            block_hash=block_hash,
+            sequence_hash=seq_hash,
+            parent_sequence_hash=self.parent_sequence_hash,
+            block_index=self.block_index,
+        )
+
+
+class TokenBlockSequence:
+    """A token stream chunked into content-addressed blocks.
+
+    Appending tokens commits full blocks eagerly; `blocks` holds the immutable
+    prefix and `partial` the in-progress tail. Truncation (for stop-sequence
+    rollback) is supported via `truncate`.
+    """
+
+    def __init__(
+        self,
+        tokens: Iterable[Token] = (),
+        block_size: int = DEFAULT_BLOCK_SIZE,
+        salt: str = "",
+    ):
+        if block_size <= 0:
+            raise ValueError(f"block_size must be positive, got {block_size}")
+        self.block_size = block_size
+        self.salt_hash = compute_salt_hash(salt)
+        self.blocks: list[TokenBlock] = []
+        self.partial = PartialTokenBlock(
+            block_size=block_size,
+            salt_hash=self.salt_hash,
+            parent_sequence_hash=None,
+            block_index=0,
+        )
+        self.extend(tokens)
+
+    # -- mutation ----------------------------------------------------------
+
+    def append(self, token: Token) -> Optional[TokenBlock]:
+        committed = self.partial.push_token(token)
+        if committed is not None:
+            self.blocks.append(committed)
+            self.partial = PartialTokenBlock(
+                block_size=self.block_size,
+                salt_hash=self.salt_hash,
+                parent_sequence_hash=committed.sequence_hash,
+                block_index=committed.block_index + 1,
+            )
+        return committed
+
+    def extend(self, tokens: Iterable[Token]) -> list[TokenBlock]:
+        out = []
+        for t in tokens:
+            b = self.append(t)
+            if b is not None:
+                out.append(b)
+        return out
+
+    def truncate(self, num_tokens: int) -> None:
+        """Keep only the first `num_tokens` tokens.
+
+        Full blocks before the cut are immutable and keep their hashes; only
+        the new partial tail is rebuilt — O(block_size), not O(n).
+        """
+        if num_tokens > len(self):
+            raise ValueError(f"cannot truncate to {num_tokens}, have {len(self)}")
+        keep_blocks = num_tokens // self.block_size
+        tail = self.tokens[keep_blocks * self.block_size : num_tokens]
+        self.blocks = self.blocks[:keep_blocks]
+        parent = self.blocks[-1].sequence_hash if self.blocks else None
+        self.partial = PartialTokenBlock(
+            block_size=self.block_size,
+            salt_hash=self.salt_hash,
+            parent_sequence_hash=parent,
+            block_index=keep_blocks,
+            tokens=list(tail),
+        )
+
+    # -- views -------------------------------------------------------------
+
+    @property
+    def tokens(self) -> list[Token]:
+        out: list[Token] = []
+        for b in self.blocks:
+            out.extend(b.tokens)
+        out.extend(self.partial.tokens)
+        return out
+
+    def __len__(self) -> int:
+        return len(self.blocks) * self.block_size + len(self.partial.tokens)
+
+    def sequence_hashes(self) -> list[SequenceHash]:
+        """The chained hash per full block — the routing/caching identity."""
+        return [b.sequence_hash for b in self.blocks]
+
+    def block_hashes(self) -> list[int]:
+        return [b.block_hash for b in self.blocks]
+
+
+def hash_token_blocks(
+    tokens: Sequence[Token],
+    block_size: int = DEFAULT_BLOCK_SIZE,
+    salt: str = "",
+) -> list[SequenceHash]:
+    """One-shot helper: sequence hashes of all *full* blocks of `tokens`."""
+    seq = TokenBlockSequence(tokens, block_size=block_size, salt=salt)
+    return seq.sequence_hashes()

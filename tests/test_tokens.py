@@ -1,0 +1,87 @@
+"""Token block primitives: determinism, chaining, divergence, truncation."""
+
+from dynamo_tpu.tokens import (
+    DEFAULT_BLOCK_SIZE,
+    TokenBlockSequence,
+    hash_token_blocks,
+)
+
+
+def test_empty_sequence():
+    s = TokenBlockSequence(block_size=4)
+    assert len(s) == 0
+    assert s.sequence_hashes() == []
+    assert s.tokens == []
+
+
+def test_block_commit_boundaries():
+    s = TokenBlockSequence(block_size=4)
+    for t in range(3):
+        assert s.append(t) is None
+    b = s.append(3)
+    assert b is not None
+    assert b.tokens == (0, 1, 2, 3)
+    assert b.block_index == 0
+    assert len(s.blocks) == 1
+    assert s.partial.tokens == []
+    assert len(s) == 4
+
+
+def test_determinism_and_prefix_property():
+    a = hash_token_blocks(list(range(100)), block_size=8)
+    b = hash_token_blocks(list(range(100)), block_size=8)
+    assert a == b
+    assert len(a) == 100 // 8
+    # shared prefix -> shared hash chain prefix
+    c = hash_token_blocks(list(range(64)) + [999] * 36, block_size=8)
+    assert c[: 64 // 8] == a[: 64 // 8]
+    assert c[64 // 8] != a[64 // 8]
+
+
+def test_chain_divergence_propagates():
+    # Differ in the FIRST block: every subsequent hash must differ even though
+    # later blocks contain identical tokens.
+    a = hash_token_blocks([1, 2, 3, 4, 5, 6, 7, 8], block_size=4)
+    b = hash_token_blocks([9, 2, 3, 4, 5, 6, 7, 8], block_size=4)
+    assert a[0] != b[0]
+    assert a[1] != b[1]
+
+
+def test_salt_separates_models():
+    a = hash_token_blocks(list(range(8)), block_size=4, salt="llama-3-8b")
+    b = hash_token_blocks(list(range(8)), block_size=4, salt="qwen2-7b")
+    assert a != b
+
+
+def test_same_tokens_different_position_differ():
+    # Block content [5,6,7,8] appears at index 0 in one seq and index 1 in
+    # another; chained hashing must distinguish them.
+    a = hash_token_blocks([5, 6, 7, 8], block_size=4)
+    b = hash_token_blocks([1, 2, 3, 4, 5, 6, 7, 8], block_size=4)
+    assert a[0] != b[1]
+
+
+def test_truncate_rollback():
+    s = TokenBlockSequence(list(range(20)), block_size=4)
+    hashes_full = s.sequence_hashes()
+    s.truncate(10)
+    assert len(s) == 10
+    assert s.tokens == list(range(10))
+    assert s.sequence_hashes() == hashes_full[:2]
+    # re-extending reproduces the original chain
+    s.extend(range(10, 20))
+    assert s.sequence_hashes() == hashes_full
+
+
+def test_incremental_matches_oneshot():
+    s = TokenBlockSequence(block_size=4)
+    for t in [7, 1, 3, 9, 2, 8, 4, 4, 0]:
+        s.append(t)
+    assert s.sequence_hashes() == hash_token_blocks(
+        [7, 1, 3, 9, 2, 8, 4, 4, 0], block_size=4
+    )
+    assert s.partial.tokens == [0]
+
+
+def test_default_block_size():
+    assert DEFAULT_BLOCK_SIZE == 64
