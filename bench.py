@@ -1,0 +1,109 @@
+"""Benchmark: serving throughput of the JaxEngine on one TPU chip.
+
+Workload (genai-perf-inspired, scaled to one chip — BASELINE.md): N
+concurrent requests, random prompts, fixed output length, continuous
+batching with paged KV + prefix caching off (worst case). Reports output
+tokens/sec/chip and p50 TTFT.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ...,
+   "extras": {...}}
+
+vs_baseline compares against `published.output_tok_s_per_chip` in
+BASELINE.json when present (rounds record their numbers there); 1.0 until a
+prior round has published.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+
+def main() -> None:
+    model = os.environ.get("BENCH_MODEL", "llama3-1b")
+    num_requests = int(os.environ.get("BENCH_REQUESTS", "16"))
+    isl = int(os.environ.get("BENCH_ISL", "128"))
+    osl = int(os.environ.get("BENCH_OSL", "64"))
+
+    import numpy as np
+
+    from dynamo_tpu.engine import EngineConfig
+    from dynamo_tpu.engine.engine import JaxEngine
+    from dynamo_tpu.engine.request import SamplingParams
+
+    cfg = EngineConfig(
+        model=model,
+        num_pages=512,
+        page_size=64,
+        max_pages_per_seq=16,
+        decode_buckets=(1, 2, 4, 8, 16, 32),
+        prefill_chunk=max(128, isl),
+        max_seqs=32,
+        dtype="bfloat16",
+        enable_prefix_caching=False,
+    )
+    eng = JaxEngine(cfg)
+
+    rng = np.random.default_rng(0)
+    prompts = [
+        [int(x) for x in rng.integers(1, 32000, isl)] for _ in range(num_requests)
+    ]
+
+    # Warmup: compile the prefill + decode programs used by the run.
+    eng.add_request("warm", prompts[0], SamplingParams(max_tokens=4))
+    eng.run_to_completion()
+
+    t0 = time.time()
+    submit = {}
+    first_token = {}
+    for i, p in enumerate(prompts):
+        rid = f"r{i}"
+        submit[rid] = time.time()
+        eng.add_request(rid, p, SamplingParams(temperature=0.0, max_tokens=osl))
+    generated = 0
+    while eng.has_work:
+        for out in eng.step():
+            generated += len(out.new_token_ids)
+            if out.is_first and out.request_id not in first_token:
+                first_token[out.request_id] = time.time()
+    elapsed = time.time() - t0
+
+    ttfts = sorted(first_token[r] - submit[r] for r in first_token)
+    p50_ttft = ttfts[len(ttfts) // 2] if ttfts else float("nan")
+    tok_s = generated / elapsed
+
+    baseline = 0.0
+    try:
+        with open(os.path.join(os.path.dirname(__file__), "BASELINE.json")) as f:
+            baseline = float(
+                json.load(f).get("published", {}).get("output_tok_s_per_chip", 0.0)
+            )
+    except Exception:
+        pass
+    vs = tok_s / baseline if baseline > 0 else 1.0
+
+    print(
+        json.dumps(
+            {
+                "metric": "output_tok_s_per_chip",
+                "value": round(tok_s, 2),
+                "unit": "tok/s",
+                "vs_baseline": round(vs, 3),
+                "extras": {
+                    "model": model,
+                    "num_requests": num_requests,
+                    "isl": isl,
+                    "osl": osl,
+                    "p50_ttft_s": round(p50_ttft, 4),
+                    "elapsed_s": round(elapsed, 2),
+                    "generated_tokens": generated,
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,22 @@
+from dynamo_tpu.engine.config import EngineConfig
+from dynamo_tpu.engine.page_table import KvEvent, PageAllocator, PrefixCacheStats
+from dynamo_tpu.engine.request import (
+    FinishReason,
+    Request,
+    SamplingParams,
+    StepOutput,
+)
+from dynamo_tpu.engine.scheduler import Scheduler, ScheduledBatch
+
+__all__ = [
+    "EngineConfig",
+    "KvEvent",
+    "PageAllocator",
+    "PrefixCacheStats",
+    "FinishReason",
+    "Request",
+    "SamplingParams",
+    "StepOutput",
+    "Scheduler",
+    "ScheduledBatch",
+]

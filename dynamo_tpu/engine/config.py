@@ -1,0 +1,66 @@
+"""Engine configuration."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+
+@dataclass(frozen=True)
+class EngineConfig:
+    """Static configuration of one engine worker.
+
+    Shape-affecting knobs (page_size, buckets, max_pages_per_seq) define the
+    finite program family XLA compiles; everything dynamic is masked inside
+    those shapes (no data-dependent shapes under jit).
+    """
+
+    model: str = "llama3-8b"
+    #: KV pages on device (page 0 reserved as the null page)
+    num_pages: int = 2048
+    #: tokens per page == router token-block size (hashes align 1:1)
+    page_size: int = 64
+    #: max pages a single sequence may hold (=> max context length)
+    max_pages_per_seq: int = 64
+    #: decode batch buckets (padded up to the next bucket)
+    decode_buckets: tuple[int, ...] = (1, 2, 4, 8, 16, 32, 64)
+    #: prefill token budget per step (one chunk, padded to this length)
+    prefill_chunk: int = 512
+    #: max sequences resident (decode slots)
+    max_seqs: int = 64
+    #: admission watermark: keep this fraction of pages free when admitting
+    admission_watermark: float = 0.02
+    #: eos token ids (from the model card/tokenizer)
+    eos_token_ids: tuple[int, ...] = ()
+    #: dtype name for params/KV ("bfloat16" | "float32")
+    dtype: str = "bfloat16"
+    #: mesh layout
+    dp: int = 1
+    tp: int = 1
+    #: random seed for sampling
+    seed: int = 0
+    #: enable content-addressed prefix caching
+    enable_prefix_caching: bool = True
+
+    @property
+    def max_context(self) -> int:
+        return self.max_pages_per_seq * self.page_size
+
+    def decode_bucket_for(self, n: int) -> int:
+        for b in self.decode_buckets:
+            if n <= b:
+                return b
+        return self.decode_buckets[-1]
+
+    @staticmethod
+    def for_tests() -> "EngineConfig":
+        return EngineConfig(
+            model="tiny",
+            num_pages=64,
+            page_size=4,
+            max_pages_per_seq=8,
+            decode_buckets=(1, 2, 4, 8),
+            prefill_chunk=16,
+            max_seqs=8,
+            dtype="float32",
+        )

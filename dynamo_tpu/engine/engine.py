@@ -1,0 +1,364 @@
+"""JaxEngine: the TPU-native inference engine.
+
+Owns the model params, the device page pool, the host-side allocator and
+continuous-batching scheduler, and a small cache of jitted step programs
+(one per (kind, bucket) shape). This is the first-class engine the reference
+lacks natively (it shells out to vLLM/SGLang/TRT-LLM — SURVEY.md L4);
+tokens-in/tokens-out, KV events and worker metrics out.
+
+Execution model per `step()`:
+  scheduler -> ScheduledBatch -> pad to bucket -> jitted forward+sample ->
+  host sync of sampled ids -> append/finish bookkeeping + page registration.
+
+Multi-chip: pass a MeshConfig; params/KV are device_put with tp/dp
+PartitionSpecs and the same jitted programs run SPMD over the mesh.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass
+from functools import partial
+from typing import Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dynamo_tpu.engine.config import EngineConfig
+from dynamo_tpu.engine.page_table import KvEvent, PageAllocator
+from dynamo_tpu.engine.request import (
+    FinishReason,
+    Request,
+    RequestState,
+    SamplingParams,
+    StepOutput,
+)
+from dynamo_tpu.engine.sampling import sample
+from dynamo_tpu.engine.scheduler import ScheduledBatch, Scheduler
+from dynamo_tpu.models.registry import ModelAdapter, get_model
+from dynamo_tpu.parallel.mesh import MeshConfig, make_mesh
+from dynamo_tpu.parallel.shardings import batch_spec, shardings_for
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class EngineMetrics:
+    """Worker load snapshot published to routers/planner (parity with the
+    reference's ForwardPassMetrics — kv_router/protocols.rs:43-69)."""
+
+    num_waiting: int = 0
+    num_running: int = 0
+    kv_active_pages: int = 0
+    kv_total_pages: int = 0
+    kv_usage: float = 0.0
+    prefix_hit_rate: float = 0.0
+    steps: int = 0
+    generated_tokens: int = 0
+
+    def to_dict(self) -> dict:
+        return dict(self.__dict__)
+
+
+class JaxEngine:
+    def __init__(
+        self,
+        config: EngineConfig,
+        params=None,
+        mesh_config: Optional[MeshConfig] = None,
+        on_kv_event: Optional[Callable[[KvEvent], None]] = None,
+        checkpoint_path: Optional[str] = None,
+    ):
+        self.config = config
+        self.adapter: ModelAdapter = get_model(config.model, dtype=config.dtype)
+        self.allocator = PageAllocator(
+            config.num_pages, config.page_size, on_event=on_kv_event
+        )
+        self.scheduler = Scheduler(config, self.allocator)
+        self.metrics = EngineMetrics(kv_total_pages=config.num_pages - 1)
+        self._outputs_emitted: set[str] = set()
+        self._jit_cache: dict[tuple, Callable] = {}
+
+        mc = mesh_config or MeshConfig(dp=config.dp, tp=config.tp)
+        self.mesh = make_mesh(mc) if mc.num_devices > 1 else None
+
+        if params is None:
+            if checkpoint_path is not None and self.adapter.load_params:
+                params = self.adapter.load_params(checkpoint_path)
+            else:
+                logger.info("initializing random params for %s", config.model)
+                params = self.adapter.init_params(jax.random.key(0))
+        kv = self.adapter.init_kv(config.num_pages, config.page_size)
+        if self.mesh is not None:
+            params = jax.device_put(
+                params, shardings_for(self.mesh, self.adapter.param_specs())
+            )
+            kv = jax.device_put(kv, shardings_for(self.mesh, self.adapter.kv_spec()))
+        self.params = params
+        self.kv = kv
+
+    # -- public API --------------------------------------------------------
+
+    def add_request(
+        self,
+        request_id: str,
+        prompt_tokens: Sequence[int],
+        sampling: Optional[SamplingParams] = None,
+    ) -> Request:
+        req = Request(
+            request_id=request_id,
+            prompt_tokens=list(prompt_tokens),
+            sampling=sampling or SamplingParams(),
+            arrival_time=time.time(),
+        )
+        self.scheduler.add_request(req)
+        return req
+
+    def abort_request(self, request_id: str) -> bool:
+        return self.scheduler.abort_request(request_id) is not None
+
+    @property
+    def has_work(self) -> bool:
+        return self.scheduler.has_work
+
+    def step(self) -> list[StepOutput]:
+        batch = self.scheduler.schedule()
+        outputs = self._drain_doomed()
+        if batch is not None:
+            if batch.kind == "prefill":
+                outputs += self._run_prefill(batch)
+            else:
+                outputs += self._run_decode(batch)
+            self.metrics.steps += 1
+        self._refresh_metrics()
+        return outputs
+
+    def _drain_doomed(self) -> list[StepOutput]:
+        """Finish requests the scheduler proved can never progress."""
+        outputs = []
+        for req, why in self.scheduler.doomed:
+            logger.error("request %s cannot progress: %s", req.request_id, why)
+            req.state = RequestState.FINISHED
+            req.finish_reason = FinishReason.LENGTH
+            outputs.append(
+                StepOutput(
+                    request_id=req.request_id,
+                    new_token_ids=(),
+                    finish_reason=FinishReason.LENGTH,
+                )
+            )
+        self.scheduler.doomed.clear()
+        return outputs
+
+    def run_to_completion(self) -> dict[str, list[int]]:
+        """Drain all queued work; returns request_id -> generated tokens."""
+        done: dict[str, list[int]] = {}
+        while self.has_work:
+            for out in self.step():
+                done.setdefault(out.request_id, []).extend(out.new_token_ids)
+        return done
+
+    # -- prefill -----------------------------------------------------------
+
+    def _bucket_t(self, n: int) -> int:
+        t = 32
+        while t < n:
+            t *= 2
+        return min(t, max(self.config.prefill_chunk, 32))
+
+    def _run_prefill(self, batch: ScheduledBatch) -> list[StepOutput]:
+        outputs: list[StepOutput] = []
+        for piece in batch.prefill:
+            req = piece.request
+            is_last_chunk = (
+                piece.start + piece.length >= len(req.prompt_tokens)
+            )
+            t_bucket = self._bucket_t(piece.length)
+            mp = self.config.max_pages_per_seq
+            tokens = np.zeros((1, t_bucket), np.int32)
+            chunk = req.all_tokens[piece.start : piece.start + piece.length]
+            tokens[0, : piece.length] = chunk
+            positions = np.arange(t_bucket, dtype=np.int32)[None] + piece.start
+            valid = np.zeros((1, t_bucket), bool)
+            valid[0, : piece.length] = True
+            pt = np.zeros((1, mp), np.int32)
+            pt[0, : len(req.pages)] = req.pages
+
+            args = (
+                self.params, jnp.asarray(tokens), jnp.asarray(positions),
+                jnp.asarray(valid), self.kv, jnp.asarray(pt),
+            )
+            if is_last_chunk:
+                fn = self._get_step_fn("prefill", 1, t_bucket)
+                samp = self._sampling_arrays([req])
+                last_idx = np.array([piece.length - 1], np.int32)
+                token_ids, self.kv = fn(*args, jnp.asarray(last_idx), *samp)
+            else:
+                # Mid-prompt chunk: KV writes only — skip the vocab-sized
+                # logits + sort entirely.
+                fn = self._get_step_fn("prefill_nosample", 1, t_bucket)
+                self.kv = fn(*args)
+            req.num_computed_tokens += piece.length
+            self._register_pages(req)
+            if req.prefill_done:
+                req.state = RequestState.DECODE
+                tok = int(np.asarray(token_ids)[0])
+                outputs.extend(self._accept_token(req, tok, first=True))
+        return outputs
+
+    # -- decode ------------------------------------------------------------
+
+    def _run_decode(self, batch: ScheduledBatch) -> list[StepOutput]:
+        reqs = list(batch.decode)
+        b_bucket = self.config.decode_bucket_for(len(reqs))
+        mp = self.config.max_pages_per_seq
+        b = len(reqs)
+        tokens = np.zeros((b_bucket, 1), np.int32)
+        positions = np.zeros((b_bucket, 1), np.int32)
+        valid = np.zeros((b_bucket, 1), bool)
+        pt = np.zeros((b_bucket, mp), np.int32)
+        for i, req in enumerate(reqs):
+            tokens[i, 0] = req.all_tokens[-1]
+            positions[i, 0] = req.num_tokens - 1
+            valid[i, 0] = True
+            pt[i, : len(req.pages)] = req.pages
+
+        fn = self._get_step_fn("decode", b_bucket, 1)
+        samp = self._sampling_arrays(reqs, pad_to=b_bucket)
+        last_idx = np.zeros(b_bucket, np.int32)
+        token_ids, self.kv = fn(
+            self.params, jnp.asarray(tokens), jnp.asarray(positions),
+            jnp.asarray(valid), self.kv, jnp.asarray(pt),
+            jnp.asarray(last_idx), *samp,
+        )
+        ids = np.asarray(token_ids)
+        outputs: list[StepOutput] = []
+        for i, req in enumerate(reqs):
+            req.num_computed_tokens += 1
+            outputs.extend(self._accept_token(req, int(ids[i])))
+            self._register_pages(req)
+        return outputs
+
+    # -- shared ------------------------------------------------------------
+
+    def _sampling_arrays(self, reqs: list[Request], pad_to: Optional[int] = None):
+        n = pad_to or len(reqs)
+        temps = np.zeros(n, np.float32)
+        top_ps = np.ones(n, np.float32)
+        top_ks = np.zeros(n, np.int32)
+        seeds = np.zeros(n, np.uint32)
+        counters = np.zeros(n, np.int32)
+        for i, r in enumerate(reqs):
+            temps[i] = r.sampling.temperature
+            top_ps[i] = r.sampling.top_p
+            top_ks[i] = r.sampling.top_k
+            seeds[i] = self._request_seed(r)
+            # num_emitted keeps the draw counter monotonic across preemption
+            counters[i] = r.num_emitted + len(r.output_tokens)
+        return (
+            jnp.asarray(temps), jnp.asarray(top_ps), jnp.asarray(top_ks),
+            jnp.asarray(seeds), jnp.asarray(counters),
+        )
+
+    def _request_seed(self, req: Request) -> int:
+        if req.sampling.seed is not None:
+            return req.sampling.seed & 0xFFFFFFFF
+        import xxhash
+
+        return (
+            xxhash.xxh32_intdigest(req.request_id.encode(), seed=self.config.seed)
+            & 0xFFFFFFFF
+        )
+
+    def _get_step_fn(self, kind: str, b: int, t: int) -> Callable:
+        cache_key = (kind, b, t)
+        fn = self._jit_cache.get(cache_key)
+        if fn is not None:
+            return fn
+        adapter = self.adapter
+
+        if kind == "prefill_nosample":
+
+            def nosample_fn(params, tokens, positions, valid, kv, pt):
+                _, kv = adapter.forward_hidden(
+                    params, tokens, positions, valid, kv, pt
+                )
+                return kv
+
+            jitted = jax.jit(nosample_fn, donate_argnums=(4,))
+            self._jit_cache[cache_key] = jitted
+            logger.info("compiled %s program B=%d T=%d", kind, b, t)
+            return jitted
+
+        def step_fn(params, tokens, positions, valid, kv, pt, last_idx,
+                    temps, top_ps, top_ks, seeds, counters):
+            hidden, kv = adapter.forward_hidden(params, tokens, positions, valid, kv, pt)
+            rows = jnp.arange(hidden.shape[0])
+            last_hidden = hidden[rows, last_idx]  # [B, H] — lm_head only here
+            logits = adapter.compute_logits(params, last_hidden)
+            ids = sample(logits, temps, top_ps, top_ks, seeds, counters)
+            return ids, kv
+
+        jitted = jax.jit(step_fn, donate_argnums=(4,))
+        self._jit_cache[cache_key] = jitted
+        logger.info("compiled %s program B=%d T=%d", kind, b, t)
+        return jitted
+
+    def _accept_token(self, req: Request, token: int, first: bool = False) -> list[StepOutput]:
+        req.output_tokens.append(token)
+        chain = self.scheduler.chains.get(req.request_id)
+        if chain is not None:
+            chain.append(token)
+        self.metrics.generated_tokens += 1
+        finish: Optional[FinishReason] = None
+        s = req.sampling
+        if not s.ignore_eos and (
+            token in self.config.eos_token_ids or token in s.stop_token_ids
+        ):
+            finish = FinishReason.STOP
+        elif len(req.output_tokens) + req.num_emitted >= s.max_tokens:
+            finish = FinishReason.LENGTH
+        elif req.num_tokens >= self.config.max_context:
+            finish = FinishReason.LENGTH
+        if finish is not None:
+            self.scheduler.finish(req)
+            req.finish_reason = finish
+        return [
+            StepOutput(
+                request_id=req.request_id,
+                new_token_ids=(token,),
+                finish_reason=finish,
+                is_first=first,
+            )
+        ]
+
+    def _register_pages(self, req: Request) -> None:
+        """Content-address any newly *filled* pages (enables prefix sharing
+        and emits 'stored' KV events for routers)."""
+        if not self.config.enable_prefix_caching:
+            return
+        chain = self.scheduler.chains.get(req.request_id)
+        if chain is None:
+            return
+        ps = self.config.page_size
+        full_computed = min(req.num_computed_tokens, len(chain) ) // ps
+        for bi in range(full_computed):
+            if bi >= len(req.pages):
+                break
+            block = chain.blocks[bi]
+            self.allocator.register(
+                req.pages[bi],
+                block.sequence_hash,
+                block.parent_sequence_hash,
+                block.tokens,
+            )
+
+    def _refresh_metrics(self) -> None:
+        m = self.metrics
+        m.num_waiting = self.scheduler.num_waiting()
+        m.num_running = self.scheduler.num_running()
+        m.kv_active_pages = self.allocator.num_active
+        m.kv_usage = self.allocator.usage()
+        m.prefix_hit_rate = self.allocator.stats.hit_rate

@@ -1,0 +1,205 @@
+"""Host-side paged-KV allocator with content-addressed prefix caching.
+
+The device holds one flat page pool (models/llama.py KVPages); this module
+owns which page belongs to whom. Three ideas:
+
+1. **Ref-counted pages**: a page can back multiple sequences when they share
+   a prefix (same chained block hash ⇒ byte-identical KV).
+2. **Prefix cache**: full pages are registered under their TokenBlock
+   sequence hash; new requests reuse any cached prefix chain. Freed pages
+   stay cached (refcount 0) in an LRU until reclaimed.
+3. **KV events**: every cache store/remove emits an event for the KV-aware
+   router's global index (parity with the reference's engine-emitted KV
+   events — /root/reference lib/llm/src/kv_router/publisher.rs; vLLM's ZMQ
+   event stream — and the mocker's KvManager, mocker/kv_manager.rs:121).
+
+Page 0 is the null page (padding writes), never allocated.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Callable, Literal, Optional, Sequence
+
+
+@dataclass(frozen=True)
+class KvEvent:
+    """Block stored/removed in this worker's KV cache."""
+
+    kind: Literal["stored", "removed"]
+    #: chained sequence hashes (tokens/blocks.py) — one per block
+    block_hashes: tuple[int, ...]
+    #: parent chain hash for "stored" (None at root)
+    parent_hash: Optional[int] = None
+    #: token payload for stored events (lets indexers rebuild chains)
+    token_blocks: tuple[tuple[int, ...], ...] = ()
+
+
+@dataclass
+class PrefixCacheStats:
+    queries: int = 0
+    hit_tokens: int = 0
+    query_tokens: int = 0
+    stored_blocks: int = 0
+    evicted_blocks: int = 0
+
+    @property
+    def hit_rate(self) -> float:
+        return self.hit_tokens / self.query_tokens if self.query_tokens else 0.0
+
+
+class PageAllocator:
+    """Free-list + refcount + prefix-cache LRU over a fixed page pool."""
+
+    def __init__(
+        self,
+        num_pages: int,
+        page_size: int,
+        on_event: Optional[Callable[[KvEvent], None]] = None,
+    ):
+        if num_pages < 2:
+            raise ValueError("need at least 2 pages (page 0 is the null page)")
+        self.num_pages = num_pages
+        self.page_size = page_size
+        self._free: list[int] = list(range(num_pages - 1, 0, -1))  # pop() -> 1 first
+        self._refcount: dict[int, int] = {}
+        #: full pages registered by content: seq_hash -> page id
+        self._by_hash: dict[int, int] = {}
+        #: page id -> (seq_hash, parent_hash, tokens) for registered pages
+        self._page_meta: dict[int, tuple[int, Optional[int], tuple[int, ...]]] = {}
+        #: refcount-0 registered pages, LRU order (oldest first)
+        self._reclaimable: OrderedDict[int, None] = OrderedDict()
+        self._on_event = on_event
+        self.stats = PrefixCacheStats()
+
+    # -- capacity ----------------------------------------------------------
+
+    @property
+    def num_free(self) -> int:
+        """Pages allocatable right now (free list + reclaimable cache)."""
+        return len(self._free) + len(self._reclaimable)
+
+    @property
+    def num_active(self) -> int:
+        return (self.num_pages - 1) - self.num_free
+
+    def usage(self) -> float:
+        return self.num_active / (self.num_pages - 1)
+
+    # -- allocation --------------------------------------------------------
+
+    def allocate(self, n: int) -> Optional[list[int]]:
+        """Get n fresh pages (evicting cached pages LRU-first), or None."""
+        if n > self.num_free:
+            return None
+        out = []
+        for _ in range(n):
+            if self._free:
+                page = self._free.pop()
+            else:
+                page, _ = self._reclaimable.popitem(last=False)
+                self._evict(page)
+            self._refcount[page] = 1
+            out.append(page)
+        return out
+
+    def free(self, pages: Sequence[int]) -> None:
+        """Drop one reference; registered pages become reclaimable (stay
+        cached), unregistered ones return to the free list."""
+        for page in pages:
+            rc = self._refcount.get(page)
+            if rc is None:
+                raise ValueError(f"double free of page {page}")
+            if rc > 1:
+                self._refcount[page] = rc - 1
+                continue
+            del self._refcount[page]
+            if page in self._page_meta:
+                self._reclaimable[page] = None
+                self._reclaimable.move_to_end(page)
+            else:
+                self._free.append(page)
+
+    # -- prefix cache ------------------------------------------------------
+
+    def register(
+        self,
+        page: int,
+        seq_hash: int,
+        parent_hash: Optional[int],
+        tokens: tuple[int, ...],
+    ) -> None:
+        """Content-address a *full* page so future requests can share it."""
+        if page in self._page_meta:
+            return
+        prev = self._by_hash.get(seq_hash)
+        if prev is not None and prev != page:
+            # Duplicate content under two pages (two seqs computed the same
+            # block concurrently). Keep the existing registration.
+            return
+        self._by_hash[seq_hash] = page
+        self._page_meta[page] = (seq_hash, parent_hash, tokens)
+        self.stats.stored_blocks += 1
+        self._emit(
+            KvEvent(
+                kind="stored",
+                block_hashes=(seq_hash,),
+                parent_hash=parent_hash,
+                token_blocks=(tokens,),
+            )
+        )
+
+    def lookup(self, seq_hashes: Sequence[int]) -> list[int]:
+        """Longest cached prefix: page ids for leading hashes present.
+
+        Acquires a reference on each returned page.
+        """
+        pages = []
+        for h in seq_hashes:
+            page = self._by_hash.get(h)
+            if page is None:
+                break
+            self._acquire(page)
+            pages.append(page)
+        self.stats.queries += 1
+        self.stats.query_tokens += len(seq_hashes) * self.page_size
+        self.stats.hit_tokens += len(pages) * self.page_size
+        return pages
+
+    def match_length(self, seq_hashes: Sequence[int]) -> int:
+        """Cached-prefix length in blocks, without acquiring references."""
+        n = 0
+        for h in seq_hashes:
+            if h not in self._by_hash:
+                break
+            n += 1
+        return n
+
+    # -- internals ---------------------------------------------------------
+
+    def _acquire(self, page: int) -> None:
+        rc = self._refcount.get(page, 0)
+        if rc == 0:
+            self._reclaimable.pop(page, None)
+        self._refcount[page] = rc + 1
+
+    def _evict(self, page: int) -> None:
+        seq_hash, _, _ = self._page_meta.pop(page)
+        del self._by_hash[seq_hash]
+        self.stats.evicted_blocks += 1
+        self._emit(KvEvent(kind="removed", block_hashes=(seq_hash,)))
+
+    def _emit(self, event: KvEvent) -> None:
+        if self._on_event is not None:
+            self._on_event(event)
+
+    def clear_cache(self) -> int:
+        """Drop all reclaimable cached pages (frontend /clear_kv_blocks)."""
+        n = 0
+        while self._reclaimable:
+            page, _ = self._reclaimable.popitem(last=False)
+            self._evict(page)
+            self._free.append(page)
+            n += 1
+        return n

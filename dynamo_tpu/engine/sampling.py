@@ -1,0 +1,51 @@
+"""On-device batched sampling: temperature / top-k / top-p / greedy.
+
+One jitted function handles a heterogeneous batch (per-row params) so decode
+stays a single XLA program: greedy rows take argmax, sampling rows take a
+Gumbel draw over the top-k/top-p-masked, temperature-scaled distribution.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -1e30
+
+
+def sample(
+    logits: jax.Array,  # [B, V] f32
+    temperature: jax.Array,  # [B] f32 (<=0 => greedy)
+    top_p: jax.Array,  # [B] f32 in (0, 1]
+    top_k: jax.Array,  # [B] i32 (0 => disabled)
+    seeds: jax.Array,  # [B] u32 per-request seed
+    counters: jax.Array,  # [B] i32 per-request draw counter (token position)
+) -> jax.Array:  # [B] i32 sampled token ids
+    """Per-row PRNG: each request draws from key(seed) folded with its own
+    token counter, so a (prompt, seed) pair reproduces exactly regardless of
+    what else shares the batch or how steps interleave."""
+    b, v = logits.shape
+    greedy = temperature <= 0.0
+    safe_t = jnp.where(greedy, 1.0, jnp.maximum(temperature, 1e-6))
+    scaled = logits / safe_t[:, None]
+
+    # Work in sorted space: one descending sort serves both k and p masks.
+    sort_idx = jnp.argsort(-scaled, axis=-1)  # [B, V]
+    sorted_logits = jnp.take_along_axis(scaled, sort_idx, axis=-1)
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    ranks = jnp.arange(v)[None, :]
+    # top-p: keep tokens whose preceding mass is < p (first always kept)
+    keep_p = (cum - probs) < top_p[:, None]
+    # top-k: keep the first k ranks (k == 0 disables)
+    keep_k = jnp.where(top_k[:, None] > 0, ranks < top_k[:, None], True)
+    masked = jnp.where(keep_p & keep_k, sorted_logits, _NEG_INF)
+
+    def row_gumbel(seed, counter):
+        key = jax.random.fold_in(jax.random.key(seed), counter)
+        return jax.random.gumbel(key, (v,), jnp.float32)
+
+    gumbel = jax.vmap(row_gumbel)(seeds, counters)  # [B, V]
+    sampled_rank = jnp.argmax(masked + gumbel, axis=-1)  # [B]
+    sampled = jnp.take_along_axis(sort_idx, sampled_rank[:, None], axis=-1)[:, 0]
+    return jnp.where(greedy, jnp.argmax(logits, axis=-1), sampled).astype(jnp.int32)

@@ -1,0 +1,266 @@
+"""Continuous-batching scheduler.
+
+Replaces what the reference gets for free from vLLM's scheduler (and mirrors
+its own mocker's simulation of it — /root/reference lib/llm/src/mocker/
+scheduler.rs:197): admission with KV watermark, chunked prefill, decode
+batching, and preemption-by-recompute under page pressure.
+
+TPU-first twist: the scheduler's output is always one of a *finite family of
+shapes* — a prefill chunk of exactly `prefill_chunk` tokens or a decode batch
+padded to a bucket — so the engine runs a handful of XLA programs total.
+
+Policy (one `schedule()` call = one engine step):
+1. Admit waiting requests while pages + decode slots allow (prefix-cache
+   lookups happen here, so admission cost reflects true page need).
+2. If any running request still needs prefill: schedule one prefill chunk
+   (packing multiple small prompts up to the token budget).
+3. Otherwise schedule a decode batch over all running sequences, growing
+   page tables by one page where the next token would overflow; preempt
+   the youngest sequences if pages run out.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+from typing import Literal, Optional
+
+from dynamo_tpu.engine.config import EngineConfig
+from dynamo_tpu.engine.page_table import PageAllocator
+from dynamo_tpu.engine.request import Request, RequestState
+from dynamo_tpu.tokens import TokenBlockSequence
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass(frozen=True)
+class PrefillPiece:
+    """One request's token span inside a prefill chunk."""
+
+    request: Request
+    start: int  # absolute token index where this piece begins
+    length: int
+
+
+@dataclass(frozen=True)
+class ScheduledBatch:
+    kind: Literal["prefill", "decode"]
+    prefill: tuple[PrefillPiece, ...] = ()
+    decode: tuple[Request, ...] = ()
+
+    @property
+    def num_tokens(self) -> int:
+        if self.kind == "prefill":
+            return sum(p.length for p in self.prefill)
+        return len(self.decode)
+
+
+class Scheduler:
+    def __init__(self, config: EngineConfig, allocator: PageAllocator):
+        self.config = config
+        self.allocator = allocator
+        self.waiting: list[Request] = []
+        self.running: list[Request] = []
+        #: content chains per live request (prefix registration + routing)
+        self.chains: dict[str, TokenBlockSequence] = {}
+        #: requests that can never make progress (engine finishes them) —
+        #: guarantees step() liveness instead of a silent busy-spin
+        self.doomed: list[tuple[Request, str]] = []
+
+    # -- queue interface ---------------------------------------------------
+
+    def add_request(self, request: Request) -> None:
+        # Need ceil((len+1)/ps) pages <= max_pages_per_seq, i.e. room for the
+        # prompt plus at least one generated token.
+        if len(request.prompt_tokens) >= self.config.max_context:
+            raise ValueError(
+                f"prompt of {len(request.prompt_tokens)} tokens exceeds max "
+                f"context {self.config.max_context} (one slot is reserved for "
+                "generation)"
+            )
+        request.state = RequestState.WAITING
+        self.waiting.append(request)
+
+    def abort_request(self, request_id: str) -> Optional[Request]:
+        for q in (self.waiting, self.running):
+            for r in q:
+                if r.request_id == request_id:
+                    q.remove(r)
+                    self._release(r)
+                    self.chains.pop(request_id, None)
+                    return r
+        return None
+
+    @property
+    def has_work(self) -> bool:
+        return bool(self.waiting or self.running)
+
+    def num_waiting(self) -> int:
+        return len(self.waiting)
+
+    def num_running(self) -> int:
+        return len(self.running)
+
+    # -- the step ----------------------------------------------------------
+
+    def schedule(self) -> Optional[ScheduledBatch]:
+        self._admit()
+        prefill = self._schedule_prefill()
+        if prefill is not None:
+            return prefill
+        return self._schedule_decode()
+
+    def _watermark_pages(self) -> int:
+        return int(self.allocator.num_pages * self.config.admission_watermark)
+
+    def _admit(self) -> None:
+        ps = self.config.page_size
+        while self.waiting and len(self.running) < self.config.max_seqs:
+            req = self.waiting[0]
+            # A prompt that can never fit the pool (even with everything else
+            # evicted) would block the queue head forever: doom it instead.
+            min_need = -(-(len(req.prompt_tokens) + 1) // ps)
+            if min_need > (self.allocator.num_pages - 1) - self._watermark_pages():
+                self.waiting.pop(0)
+                self.doomed.append(
+                    (req, f"prompt needs {min_need} pages; pool has "
+                          f"{self.allocator.num_pages - 1}")
+                )
+                continue
+            chain = self.chains.get(req.request_id)
+            if chain is None:
+                chain = TokenBlockSequence(
+                    req.prompt_tokens, block_size=ps, salt=self.config.model
+                )
+                self.chains[req.request_id] = chain
+            # Probe the prefix cache to size the true page need.
+            cached_blocks = (
+                self.allocator.match_length(chain.sequence_hashes())
+                if self.config.enable_prefix_caching
+                else 0
+            )
+            total_pages = -(-(len(req.prompt_tokens) + 1) // ps)
+            need = total_pages - cached_blocks
+            if self.allocator.num_free - need < self._watermark_pages():
+                break  # head-of-line blocking by design (FIFO fairness)
+            cached_pages = (
+                self.allocator.lookup(chain.sequence_hashes())
+                if self.config.enable_prefix_caching
+                else []
+            )
+            # A fully-cached prompt must still recompute its last token so
+            # there are logits to sample from: cap the reuse.
+            max_reuse = (len(req.prompt_tokens) - 1) // ps
+            while len(cached_pages) > max_reuse:
+                self.allocator.free([cached_pages.pop()])
+            fresh = self.allocator.allocate(total_pages - len(cached_pages))
+            if fresh is None:
+                self.allocator.free(cached_pages)
+                break
+            req.pages = cached_pages + fresh
+            req.num_cached_prompt_tokens = len(cached_pages) * ps
+            req.num_computed_tokens = req.num_cached_prompt_tokens
+            req.state = RequestState.PREFILL
+            self.waiting.pop(0)
+            self.running.append(req)
+
+    def _schedule_prefill(self) -> Optional[ScheduledBatch]:
+        budget = self.config.prefill_chunk
+        pieces: list[PrefillPiece] = []
+        for req in self.running:
+            if req.state != RequestState.PREFILL or budget <= 0:
+                continue
+            remaining = len(req.prompt_tokens) - req.num_computed_tokens
+            take = min(remaining, budget)
+            if take <= 0:
+                continue
+            pieces.append(
+                PrefillPiece(request=req, start=req.num_computed_tokens, length=take)
+            )
+            budget -= take
+        if not pieces:
+            return None
+        return ScheduledBatch(kind="prefill", prefill=tuple(pieces))
+
+    def _schedule_decode(self) -> Optional[ScheduledBatch]:
+        decodable = [r for r in self.running if r.state == RequestState.DECODE]
+        if not decodable:
+            return None
+        ps = self.config.page_size
+        scheduled: list[Request] = []
+        # Oldest first; preemption victims are taken from the youngest.
+        for req in decodable:
+            have = len(req.pages) * ps
+            if req.num_tokens >= have:
+                if len(req.pages) >= self.config.max_pages_per_seq:
+                    # Context limit: engine will finish it this step.
+                    scheduled.append(req)
+                    continue
+                got = self.allocator.allocate(1)
+                if got is None:
+                    if self._preempt_youngest(excluding=req, scheduled=scheduled):
+                        got = self.allocator.allocate(1)
+                    if got is None:
+                        if not scheduled and len(self.running) == 1:
+                            # Sole sequence and the pool is exhausted: no
+                            # future step can free pages — doom it rather
+                            # than busy-spin (engine finishes it as LENGTH).
+                            self.running.remove(req)
+                            self._release(req)
+                            self.chains.pop(req.request_id, None)
+                            self.doomed.append(
+                                (req, "kv pool exhausted with no preemption "
+                                      "victim")
+                            )
+                        continue  # stalled this step; others may progress
+                req.pages.extend(got)
+            scheduled.append(req)
+        if not scheduled:
+            return None
+        cap = self.config.decode_buckets[-1]
+        return ScheduledBatch(kind="decode", decode=tuple(scheduled[:cap]))
+
+    def _preempt_youngest(
+        self, excluding: Request, scheduled: Optional[list[Request]] = None
+    ) -> bool:
+        victims = [
+            r
+            for r in self.running
+            if r is not excluding and r.state == RequestState.DECODE
+        ]
+        if not victims:
+            return False
+        victim = victims[-1]
+        if scheduled is not None and victim in scheduled:
+            # Already picked for this step's batch — pull it back out, or it
+            # would decode against an empty page table (the null page).
+            scheduled.remove(victim)
+        logger.warning(
+            "preempting %s (recompute) under page pressure", victim.request_id
+        )
+        self._release(victim)
+        # Recompute-from-scratch: prompt grows to include generated tokens.
+        victim.state = RequestState.WAITING
+        victim.num_emitted += len(victim.output_tokens)
+        victim.prompt_tokens = victim.all_tokens
+        victim.output_tokens = []
+        victim.num_computed_tokens = 0
+        victim.num_cached_prompt_tokens = 0
+        self.running.remove(victim)
+        self.waiting.insert(0, victim)
+        self.chains.pop(victim.request_id, None)
+        return True
+
+    # -- completion --------------------------------------------------------
+
+    def finish(self, request: Request) -> None:
+        request.state = RequestState.FINISHED
+        if request in self.running:
+            self.running.remove(request)
+        self._release(request)
+        self.chains.pop(request.request_id, None)
+
+    def _release(self, request: Request) -> None:
+        if request.pages:
+            self.allocator.free(request.pages)
+            request.pages = []

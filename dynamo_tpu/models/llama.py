@@ -331,7 +331,7 @@ def paged_attention(
 # ---------------------------------------------------------------------------
 
 
-def forward(
+def forward_hidden(
     params: dict,
     cfg: LlamaConfig,
     tokens: jax.Array,  # [B, T] int32
@@ -340,7 +340,10 @@ def forward(
     kv: KVPages,
     page_tables: jax.Array,  # [B, MP] int32
 ) -> tuple[jax.Array, KVPages]:
-    """One model step over a token chunk; returns (logits [B,T,V], new kv).
+    """One model step over a token chunk; returns (hidden [B,T,H] post final
+    norm, new kv). The engine applies `compute_logits` only at the positions
+    it samples from — for a 512-token prefill chunk the full-chunk lm_head
+    matmul would otherwise dominate the step's FLOPs.
 
     Covers prefill (T = chunk), decode (T = 1), and prefix-cache continuation
     (positions start past 0) uniformly.
@@ -370,8 +373,27 @@ def forward(
 
     h, (k_new, v_new) = lax.scan(layer, h, (params["layers"], kv.k, kv.v))
     h = rms_norm(h, params["final_norm"], cfg.rms_norm_eps)
+    return h, KVPages(k=k_new, v=v_new)
+
+
+def compute_logits(params: dict, cfg: LlamaConfig, hidden: jax.Array) -> jax.Array:
+    """Project hidden states [..., H] to vocab logits [..., V] in f32."""
     lm_head = params.get("lm_head")
     if lm_head is None:
         lm_head = params["embed"].T
-    logits = (h @ lm_head).astype(jnp.float32)
-    return logits, KVPages(k=k_new, v=v_new)
+    return (hidden @ lm_head).astype(jnp.float32)
+
+
+def forward(
+    params: dict,
+    cfg: LlamaConfig,
+    tokens: jax.Array,
+    positions: jax.Array,
+    valid: jax.Array,
+    kv: KVPages,
+    page_tables: jax.Array,
+) -> tuple[jax.Array, KVPages]:
+    """forward_hidden + full-chunk logits (tests/tools; engine uses the
+    split form to avoid the all-positions lm_head matmul)."""
+    h, kv = forward_hidden(params, cfg, tokens, positions, valid, kv, page_tables)
+    return compute_logits(params, cfg, h), kv

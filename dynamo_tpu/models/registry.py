@@ -1,0 +1,107 @@
+"""Model registry: name -> ModelAdapter the engine can drive.
+
+The engine is model-family-agnostic (same role as the reference being
+engine-agnostic at a higher level): an adapter exposes init/forward/kv-init
+over the paged cache contract. New families (Qwen2, Mixtral/MoE) register
+here.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, replace
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from dynamo_tpu.models import llama as llama_mod
+from dynamo_tpu.models.llama import KVPages, LlamaConfig
+
+
+@dataclass(frozen=True)
+class ModelAdapter:
+    name: str
+    config: Any
+    vocab_size: int
+    init_params: Callable[[jax.Array], Any]
+    forward: Callable[..., tuple[jax.Array, KVPages]]  # (params, tokens, positions, valid, kv, pt) -> (logits, kv)
+    forward_hidden: Callable[..., tuple[jax.Array, KVPages]]  # same in, (hidden, kv) out
+    compute_logits: Callable[[Any, jax.Array], jax.Array]  # (params, hidden) -> logits
+    init_kv: Callable[[int, int], KVPages]
+    param_specs: Callable[[], Any]
+    kv_spec: Callable[[], Any]
+    load_params: Optional[Callable[[str], Any]] = None  # from a checkpoint dir
+
+
+_LLAMA_PRESETS: dict[str, Callable[[], LlamaConfig]] = {
+    "tiny": LlamaConfig.tiny,
+    "llama3-1b": LlamaConfig.llama3_1b,
+    "llama3-8b": LlamaConfig.llama3_8b,
+    "llama3-70b": LlamaConfig.llama3_70b,
+    # DeepSeek-R1-Distill-Llama-8B is architecturally Llama-3-8B.
+    "deepseek-r1-distill-llama-8b": LlamaConfig.llama3_8b,
+}
+
+
+def _llama_adapter(name: str, cfg: LlamaConfig) -> ModelAdapter:
+    from dynamo_tpu.parallel.shardings import kv_cache_spec, llama_param_specs
+
+    def forward(params, tokens, positions, valid, kv, page_tables):
+        return llama_mod.forward(params, cfg, tokens, positions, valid, kv, page_tables)
+
+    def forward_hidden(params, tokens, positions, valid, kv, page_tables):
+        return llama_mod.forward_hidden(
+            params, cfg, tokens, positions, valid, kv, page_tables
+        )
+
+    return ModelAdapter(
+        name=name,
+        config=cfg,
+        vocab_size=cfg.vocab_size,
+        init_params=lambda key: llama_mod.init_params(key, cfg),
+        forward=forward,
+        forward_hidden=forward_hidden,
+        compute_logits=lambda params, h: llama_mod.compute_logits(params, cfg, h),
+        init_kv=lambda num_pages, page_size: llama_mod.init_kv_pages(
+            cfg, num_pages, page_size
+        ),
+        param_specs=lambda: llama_param_specs(cfg),
+        kv_spec=lambda: KVPages(k=kv_cache_spec(), v=kv_cache_spec()),
+        load_params=lambda path: _load_llama_checkpoint(path, cfg),
+    )
+
+
+def _load_llama_checkpoint(path: str, cfg: LlamaConfig):
+    """Load HF-format weights (safetensors/bin) from a local dir."""
+    import torch
+    from transformers import AutoModelForCausalLM
+
+    model = AutoModelForCausalLM.from_pretrained(
+        path, torch_dtype=torch.float32, low_cpu_mem_usage=True
+    )
+    return llama_mod.params_from_torch_state_dict(model.state_dict(), cfg)
+
+
+def get_model(name: str, dtype: Optional[str] = None) -> ModelAdapter:
+    """Resolve a model name: preset id, or a local HF checkpoint dir."""
+    key = name.lower()
+    if key in _LLAMA_PRESETS:
+        cfg = _LLAMA_PRESETS[key]()
+    elif os.path.isdir(name) and os.path.exists(os.path.join(name, "config.json")):
+        with open(os.path.join(name, "config.json")) as f:
+            hf = json.load(f)
+        arch = (hf.get("architectures") or ["LlamaForCausalLM"])[0]
+        if "llama" not in arch.lower():
+            raise ValueError(f"unsupported architecture {arch} for {name}")
+        cfg = LlamaConfig.from_hf_config(hf)
+    else:
+        raise ValueError(
+            f"unknown model {name!r}; presets: {sorted(_LLAMA_PRESETS)} "
+            "or a local HF checkpoint directory"
+        )
+    if dtype is not None:
+        dt = {"bfloat16": jnp.bfloat16, "float32": jnp.float32}.get(dtype, dtype)
+        cfg = replace(cfg, dtype=dt)
+    return _llama_adapter(name, cfg)

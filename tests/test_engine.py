@@ -1,0 +1,233 @@
+"""JaxEngine end-to-end on CPU: continuous batching, prefix caching,
+chunked prefill, preemption, sampling, and consistency with the raw model."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dynamo_tpu.engine import EngineConfig
+from dynamo_tpu.engine.engine import JaxEngine
+from dynamo_tpu.engine.request import FinishReason, SamplingParams
+
+
+@pytest.fixture(scope="module")
+def engine_factory():
+    def make(**overrides):
+        base = EngineConfig.for_tests()
+        cfg = EngineConfig(**{**base.__dict__, **overrides})
+        return JaxEngine(cfg)
+
+    return make
+
+
+def _greedy(max_tokens=8):
+    return SamplingParams(temperature=0.0, max_tokens=max_tokens)
+
+
+def test_single_request_greedy(engine_factory):
+    eng = engine_factory()
+    eng.add_request("r1", [5, 17, 42, 99, 3], _greedy(6))
+    out = eng.run_to_completion()
+    assert len(out["r1"]) == 6
+
+    # Same prompt again must produce identical tokens (greedy determinism)
+    eng2 = engine_factory()
+    eng2.add_request("x", [5, 17, 42, 99, 3], _greedy(6))
+    assert eng2.run_to_completion()["x"] == out["r1"]
+
+
+def test_engine_matches_raw_model(engine_factory):
+    """Engine greedy output == hand-rolled forward loop on the same params."""
+    from dynamo_tpu.models.llama import forward, init_kv_pages
+
+    eng = engine_factory()
+    prompt = [7, 1, 3, 9, 2, 8, 4, 4, 0, 6, 11, 13]  # 12 tokens, 3 pages
+    eng.add_request("r", prompt, _greedy(5))
+    got = eng.run_to_completion()["r"]
+
+    cfg = eng.adapter.config
+    kv = init_kv_pages(cfg, 64, 4)
+    pt = jnp.asarray(np.arange(1, 9, dtype=np.int32)[None])
+    toks = list(prompt)
+    ref = []
+    for step in range(5):
+        arr = jnp.asarray([toks], jnp.int32)
+        pos = jnp.arange(len(toks), dtype=jnp.int32)[None]
+        kv0 = init_kv_pages(cfg, 64, 4)
+        logits, _ = forward(eng.params, cfg, arr, pos,
+                            jnp.ones((1, len(toks)), bool), kv0, pt)
+        tok = int(np.asarray(logits)[0, -1].argmax())
+        ref.append(tok)
+        toks.append(tok)
+    assert got == ref
+
+
+def test_concurrent_requests_isolated(engine_factory):
+    """Batched decode must equal each request run alone."""
+    eng = engine_factory()
+    prompts = {
+        "a": [1, 2, 3, 4, 5],
+        "b": [9, 8, 7],
+        "c": [11, 4, 11, 4, 11, 4, 2],
+    }
+    for rid, p in prompts.items():
+        eng.add_request(rid, p, _greedy(4))
+    batched = eng.run_to_completion()
+
+    for rid, p in prompts.items():
+        solo_eng = engine_factory()
+        solo_eng.add_request("solo", p, _greedy(4))
+        assert solo_eng.run_to_completion()["solo"] == batched[rid], rid
+
+
+def test_chunked_prefill_long_prompt(engine_factory):
+    """Prompt longer than prefill_chunk is prefilled over multiple steps."""
+    eng = engine_factory(prefill_chunk=8, max_pages_per_seq=16, num_pages=128)
+    prompt = list(np.random.default_rng(0).integers(1, 200, 25))
+    eng.add_request("long", [int(x) for x in prompt], _greedy(3))
+    out = eng.run_to_completion()
+    assert len(out["long"]) == 3
+
+    # consistency with single-chunk prefill
+    eng2 = engine_factory(prefill_chunk=32, max_pages_per_seq=16, num_pages=128)
+    eng2.add_request("one", [int(x) for x in prompt], _greedy(3))
+    assert eng2.run_to_completion()["one"] == out["long"]
+
+
+def test_prefix_cache_hit_same_output(engine_factory):
+    """Second request sharing a long prefix reuses pages AND matches the
+    no-cache output exactly."""
+    eng = engine_factory()
+    base = [3, 1, 4, 1, 5, 9, 2, 6]  # 2 full pages
+    eng.add_request("p1", base + [10, 11], _greedy(4))
+    first = eng.run_to_completion()["p1"]
+    hits_before = eng.allocator.stats.hit_tokens
+    eng.add_request("p2", base + [10, 11], _greedy(4))
+    second = eng.run_to_completion()["p2"]
+    assert second == first
+    assert eng.allocator.stats.hit_tokens > hits_before
+
+    cold = engine_factory(enable_prefix_caching=False)
+    cold.add_request("p3", base + [10, 11], _greedy(4))
+    assert cold.run_to_completion()["p3"] == first
+
+
+def test_eos_stops_generation(engine_factory):
+    eng = engine_factory()
+    eng.add_request("r", [5, 17, 42, 99, 3], _greedy(6))
+    ref = eng.run_to_completion()["r"]
+    eos = ref[2]
+
+    eng2 = engine_factory(eos_token_ids=(eos,))
+    eng2.add_request("r", [5, 17, 42, 99, 3], _greedy(6))
+    outs = []
+    finish = None
+    while eng2.has_work:
+        for o in eng2.step():
+            outs.extend(o.new_token_ids)
+            if o.finish_reason:
+                finish = o.finish_reason
+    assert outs == ref[:3]
+    assert finish == FinishReason.STOP
+
+
+def test_sampling_with_temperature_varies_and_respects_topk(engine_factory):
+    eng = engine_factory()
+    sp = SamplingParams(temperature=1.5, top_k=5, max_tokens=12, seed=1)
+    eng.add_request("s", [5, 17, 42], sp)
+    out = eng.run_to_completion()["s"]
+    assert len(out) == 12
+    # top-k=5 on a random tiny model: sampled ids must come from the top-5
+    # at each step — verify the first step's choice against raw logits.
+    from dynamo_tpu.models.llama import forward, init_kv_pages
+
+    cfg = eng.adapter.config
+    kv0 = init_kv_pages(cfg, 64, 4)
+    pt = jnp.asarray(np.arange(1, 9, dtype=np.int32)[None])
+    logits, _ = forward(eng.params, cfg, jnp.asarray([[5, 17, 42]], jnp.int32),
+                        jnp.arange(3, dtype=jnp.int32)[None],
+                        jnp.ones((1, 3), bool), kv0, pt)
+    top5 = set(np.asarray(logits)[0, -1].argsort()[-5:].tolist())
+    assert out[0] in top5
+
+
+def test_preemption_under_page_pressure(engine_factory):
+    """More decode growth than pages: youngest preempted, all finish."""
+    eng = engine_factory(num_pages=12, max_seqs=4, admission_watermark=0.0)
+    for i in range(3):
+        eng.add_request(f"r{i}", [10 + i, 20 + i, 30 + i, 40 + i], _greedy(10))
+    out = eng.run_to_completion()
+    assert all(len(out[f"r{i}"]) == 10 for i in range(3))
+    # Preempted-then-recomputed streams must equal unpressured solo runs.
+    for i in range(3):
+        solo = engine_factory(num_pages=64)
+        solo.add_request("s", [10 + i, 20 + i, 30 + i, 40 + i], _greedy(10))
+        assert solo.run_to_completion()["s"] == out[f"r{i}"], f"r{i}"
+
+
+def test_metrics_surface(engine_factory):
+    eng = engine_factory()
+    eng.add_request("m", [1, 2, 3, 4, 5, 6], _greedy(4))
+    eng.step()
+    m = eng.metrics
+    assert m.kv_total_pages == eng.config.num_pages - 1
+    assert m.kv_active_pages > 0
+    eng.run_to_completion()
+    assert eng.metrics.generated_tokens == 4
+
+
+def test_seeded_sampling_reproducible(engine_factory):
+    """(prompt, seed) reproduces exactly, regardless of batch composition."""
+    sp = SamplingParams(temperature=1.0, max_tokens=6, seed=123)
+    eng = engine_factory()
+    eng.add_request("solo", [5, 6, 7], sp)
+    solo = eng.run_to_completion()["solo"]
+
+    eng2 = engine_factory()
+    eng2.add_request("other", [9, 9, 9], SamplingParams(temperature=1.3, max_tokens=6, seed=7))
+    eng2.add_request("same", [5, 6, 7], sp)
+    batched = eng2.run_to_completion()
+    assert batched["same"] == solo
+
+    # different seed -> (almost surely) different stream
+    eng3 = engine_factory()
+    eng3.add_request("d", [5, 6, 7], SamplingParams(temperature=1.0, max_tokens=6, seed=124))
+    assert eng3.run_to_completion()["d"] != solo
+
+
+def test_impossible_requests_finish_instead_of_hanging(engine_factory):
+    """Liveness: requests that can never progress are finished, not spun on."""
+    # (a) prompt larger than the whole pool
+    eng = engine_factory(num_pages=4, max_pages_per_seq=8)
+    eng.add_request("big", list(range(14)), _greedy(4))  # needs 4 pages, pool has 3
+    outs = {}
+    for _ in range(50):
+        if not eng.has_work:
+            break
+        for o in eng.step():
+            outs[o.request_id] = o.finish_reason
+    assert not eng.has_work, "engine hung on impossible prompt"
+    assert outs["big"] == FinishReason.LENGTH
+
+    # (b) sole sequence exhausts the pool mid-decode
+    eng2 = engine_factory(num_pages=4, max_pages_per_seq=8, admission_watermark=0.0)
+    eng2.add_request("grow", [1, 2, 3], _greedy(40))
+    n = 0
+    for _ in range(100):
+        if not eng2.has_work:
+            break
+        for o in eng2.step():
+            n += len(o.new_token_ids)
+    assert not eng2.has_work, "engine hung on pool exhaustion"
+    assert 0 < n < 40  # stopped early at pool capacity
+
+
+def test_prompt_at_max_context_rejected(engine_factory):
+    eng = engine_factory()  # max_context = 32
+    with pytest.raises(ValueError):
+        eng.add_request("edge", list(range(32)), _greedy(2))
+    eng.add_request("ok", list(range(31)), _greedy(2))
+    out = eng.run_to_completion()
+    assert len(out["ok"]) >= 1

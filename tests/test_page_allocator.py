@@ -1,0 +1,84 @@
+"""PageAllocator: refcounts, prefix cache, LRU eviction, KV events."""
+
+import pytest
+
+from dynamo_tpu.engine.page_table import KvEvent, PageAllocator
+
+
+def test_basic_allocate_free():
+    a = PageAllocator(num_pages=8, page_size=4)
+    assert a.num_free == 7  # page 0 reserved
+    pages = a.allocate(3)
+    assert pages is not None and 0 not in pages
+    assert a.num_free == 4
+    a.free(pages)
+    assert a.num_free == 7
+
+
+def test_allocate_exhaustion_returns_none():
+    a = PageAllocator(num_pages=4, page_size=4)
+    assert a.allocate(3) is not None
+    assert a.allocate(1) is None
+
+
+def test_double_free_raises():
+    a = PageAllocator(num_pages=4, page_size=4)
+    (p,) = a.allocate(1)
+    a.free([p])
+    with pytest.raises(ValueError):
+        a.free([p])
+
+
+def test_prefix_cache_share_and_refcount():
+    a = PageAllocator(num_pages=8, page_size=4)
+    (p,) = a.allocate(1)
+    a.register(p, seq_hash=111, parent_hash=None, tokens=(1, 2, 3, 4))
+    # Second request hits the cache; page now has 2 refs.
+    hit = a.lookup([111, 222])
+    assert hit == [p]
+    a.free([p])  # first owner leaves — still referenced
+    assert a.lookup([111]) == [p]  # still cached + re-acquirable
+    a.free([p])
+    a.free([p])
+    # rc 0 -> reclaimable but still matchable
+    assert a.match_length([111]) == 1
+    assert a.num_free == 7
+
+
+def test_lru_eviction_emits_removed_event():
+    events: list[KvEvent] = []
+    a = PageAllocator(num_pages=4, page_size=4, on_event=events.append)
+    pages = a.allocate(3)
+    for i, p in enumerate(pages):
+        a.register(p, seq_hash=100 + i, parent_hash=None, tokens=(i,) * 4)
+    a.free(pages)  # all reclaimable, LRU order 100,101,102
+    got = a.allocate(2)  # must evict 100 then 101
+    assert got is not None
+    removed = [e for e in events if e.kind == "removed"]
+    assert [e.block_hashes[0] for e in removed] == [100, 101]
+    assert a.match_length([102]) == 1
+    assert a.match_length([100]) == 0
+
+
+def test_stored_events_carry_chain_info():
+    events: list[KvEvent] = []
+    a = PageAllocator(num_pages=4, page_size=2, on_event=events.append)
+    (p1,) = a.allocate(1)
+    a.register(p1, seq_hash=7, parent_hash=None, tokens=(1, 2))
+    (p2,) = a.allocate(1)
+    a.register(p2, seq_hash=8, parent_hash=7, tokens=(3, 4))
+    assert events[0].kind == "stored" and events[0].parent_hash is None
+    assert events[1].parent_hash == 7
+    assert events[1].token_blocks == ((3, 4),)
+
+
+def test_clear_cache():
+    a = PageAllocator(num_pages=6, page_size=4)
+    pages = a.allocate(2)
+    for i, p in enumerate(pages):
+        a.register(p, seq_hash=50 + i, parent_hash=None, tokens=(i,) * 4)
+    a.free(pages)
+    n = a.clear_cache()
+    assert n == 2
+    assert a.match_length([50]) == 0
+    assert a.num_free == 5
