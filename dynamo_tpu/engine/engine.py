@@ -98,6 +98,21 @@ class JaxEngine:
             kv = jax.device_put(kv, shardings_for(self.mesh, self.adapter.kv_spec()))
         self.params = params
         self.kv = kv
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding
+
+            self._batch_shardings = {
+                nd: NamedSharding(self.mesh, batch_spec(nd)) for nd in (1, 2)
+            }
+        else:
+            self._batch_shardings = None
+
+    def _dev(self, arr: np.ndarray):
+        """Host batch array -> device, dp-sharded along dim 0 on a mesh."""
+        x = jnp.asarray(arr)
+        if self._batch_shardings is not None:
+            x = jax.device_put(x, self._batch_shardings[arr.ndim])
+        return x
 
     # -- public API --------------------------------------------------------
 
@@ -187,14 +202,14 @@ class JaxEngine:
             pt[0, : len(req.pages)] = req.pages
 
             args = (
-                self.params, jnp.asarray(tokens), jnp.asarray(positions),
-                jnp.asarray(valid), self.kv, jnp.asarray(pt),
+                self.params, self._dev(tokens), self._dev(positions),
+                self._dev(valid), self.kv, self._dev(pt),
             )
             if is_last_chunk:
                 fn = self._get_step_fn("prefill", 1, t_bucket)
                 samp = self._sampling_arrays([req])
                 last_idx = np.array([piece.length - 1], np.int32)
-                token_ids, self.kv = fn(*args, jnp.asarray(last_idx), *samp)
+                token_ids, self.kv = fn(*args, self._dev(last_idx), *samp)
             else:
                 # Mid-prompt chunk: KV writes only — skip the vocab-sized
                 # logits + sort entirely.
@@ -229,9 +244,9 @@ class JaxEngine:
         samp = self._sampling_arrays(reqs, pad_to=b_bucket)
         last_idx = np.zeros(b_bucket, np.int32)
         token_ids, self.kv = fn(
-            self.params, jnp.asarray(tokens), jnp.asarray(positions),
-            jnp.asarray(valid), self.kv, jnp.asarray(pt),
-            jnp.asarray(last_idx), *samp,
+            self.params, self._dev(tokens), self._dev(positions),
+            self._dev(valid), self.kv, self._dev(pt),
+            self._dev(last_idx), *samp,
         )
         ids = np.asarray(token_ids)
         outputs: list[StepOutput] = []
@@ -258,8 +273,8 @@ class JaxEngine:
             # num_emitted keeps the draw counter monotonic across preemption
             counters[i] = r.num_emitted + len(r.output_tokens)
         return (
-            jnp.asarray(temps), jnp.asarray(top_ps), jnp.asarray(top_ks),
-            jnp.asarray(seeds), jnp.asarray(counters),
+            self._dev(temps), self._dev(top_ps), self._dev(top_ks),
+            self._dev(seeds), self._dev(counters),
         )
 
     def _request_seed(self, req: Request) -> int:

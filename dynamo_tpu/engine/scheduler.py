@@ -165,6 +165,11 @@ class Scheduler:
             self.running.append(req)
 
     def _schedule_prefill(self) -> Optional[ScheduledBatch]:
+        # NOTE: pieces are currently executed by the engine as separate
+        # B=1 programs; the packing budget bounds total work per step, not
+        # one fused launch. TODO(flat-batch): pack pieces into one
+        # flat-token program with segment ids (vLLM-style) so one dispatch
+        # covers the whole chunk.
         budget = self.config.prefill_chunk
         pieces: list[PrefillPiece] = []
         for req in self.running:
@@ -190,12 +195,14 @@ class Scheduler:
         scheduled: list[Request] = []
         # Oldest first; preemption victims are taken from the youngest.
         for req in decodable:
+            if req.state != RequestState.DECODE:
+                continue  # preempted by an earlier iteration of this loop
             have = len(req.pages) * ps
-            if req.num_tokens >= have:
-                if len(req.pages) >= self.config.max_pages_per_seq:
-                    # Context limit: engine will finish it this step.
-                    scheduled.append(req)
-                    continue
+            # Writing this step's KV at position num_tokens-1 needs
+            # have >= num_tokens; grow exactly when it would not fit.
+            # (num_tokens can never exceed max_context here: _accept_token
+            # finishes requests at the boundary, so growth is always legal.)
+            if req.num_tokens > have:
                 got = self.allocator.allocate(1)
                 if got is None:
                     if self._preempt_youngest(excluding=req, scheduled=scheduled):

@@ -102,6 +102,16 @@ def get_model(name: str, dtype: Optional[str] = None) -> ModelAdapter:
             "or a local HF checkpoint directory"
         )
     if dtype is not None:
-        dt = {"bfloat16": jnp.bfloat16, "float32": jnp.float32}.get(dtype, dtype)
-        cfg = replace(cfg, dtype=dt)
+        if isinstance(dtype, str):
+            table = {
+                "bfloat16": jnp.bfloat16,
+                "float32": jnp.float32,
+                "float64": jnp.float64,
+            }
+            if dtype not in table:
+                raise ValueError(
+                    f"unsupported dtype {dtype!r}; use one of {sorted(table)}"
+                )
+            dtype = table[dtype]
+        cfg = replace(cfg, dtype=dtype)
     return _llama_adapter(name, cfg)

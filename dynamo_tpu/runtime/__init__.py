@@ -1,0 +1,40 @@
+from dynamo_tpu.runtime.context import CancellationToken, Context
+from dynamo_tpu.runtime.runtime import (
+    Component,
+    DistributedRuntime,
+    Endpoint,
+    Namespace,
+)
+from dynamo_tpu.runtime.component import (
+    EndpointRegistration,
+    Instance,
+    InstanceSource,
+)
+from dynamo_tpu.runtime.ingress import IngressServer
+from dynamo_tpu.runtime.push_router import (
+    EngineStreamError,
+    NoInstancesError,
+    PushRouter,
+    RouterMode,
+)
+from dynamo_tpu.runtime.store import MemStore, Watch, WatchEvent
+
+__all__ = [
+    "CancellationToken",
+    "Context",
+    "Component",
+    "DistributedRuntime",
+    "Endpoint",
+    "Namespace",
+    "EndpointRegistration",
+    "Instance",
+    "InstanceSource",
+    "IngressServer",
+    "EngineStreamError",
+    "NoInstancesError",
+    "PushRouter",
+    "RouterMode",
+    "MemStore",
+    "Watch",
+    "WatchEvent",
+]

@@ -1,0 +1,74 @@
+"""Checksummed two-part wire framing.
+
+Every cross-process payload rides frames of (header: msgpack, payload: raw
+bytes), each length-prefixed and xxh3-checksummed — the reference's
+TwoPartCodec contract (/root/reference lib/runtime/src/pipeline/network/
+codec/two_part.rs) re-done for asyncio streams. Control messages leave the
+payload empty; bulk bytes (token streams, KV pages) ride the payload
+untouched by msgpack.
+
+Frame layout:
+  u32 header_len | u32 payload_len | u64 xxh3(header) | u64 xxh3(payload)
+  | header bytes | payload bytes
+"""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+from typing import Any, Optional
+
+import msgpack
+import xxhash
+
+_PREFIX = struct.Struct("<IIQQ")
+
+#: refuse absurd frames instead of allocating gigabytes on a corrupt length
+MAX_FRAME = 1 << 30
+
+
+class CodecError(Exception):
+    pass
+
+
+def encode_frame(header: Any, payload: bytes = b"") -> bytes:
+    h = msgpack.packb(header, use_bin_type=True)
+    return (
+        _PREFIX.pack(
+            len(h),
+            len(payload),
+            xxhash.xxh3_64_intdigest(h),
+            xxhash.xxh3_64_intdigest(payload),
+        )
+        + h
+        + payload
+    )
+
+
+async def read_frame(reader: asyncio.StreamReader) -> tuple[Any, bytes]:
+    prefix = await reader.readexactly(_PREFIX.size)
+    hlen, plen, hsum, psum = _PREFIX.unpack(prefix)
+    if hlen > MAX_FRAME or plen > MAX_FRAME:
+        raise CodecError(f"frame too large: header={hlen} payload={plen}")
+    h = await reader.readexactly(hlen)
+    p = await reader.readexactly(plen) if plen else b""
+    if xxhash.xxh3_64_intdigest(h) != hsum:
+        raise CodecError("header checksum mismatch")
+    if xxhash.xxh3_64_intdigest(p) != psum:
+        raise CodecError("payload checksum mismatch")
+    return msgpack.unpackb(h, raw=False), p
+
+
+def decode_frame(buf: bytes) -> tuple[Any, bytes, int]:
+    """Sync variant for tests/tools: returns (header, payload, consumed)."""
+    if len(buf) < _PREFIX.size:
+        raise CodecError("short buffer")
+    hlen, plen, hsum, psum = _PREFIX.unpack(buf[: _PREFIX.size])
+    end = _PREFIX.size + hlen + plen
+    if len(buf) < end:
+        raise CodecError("short buffer")
+    h = buf[_PREFIX.size : _PREFIX.size + hlen]
+    p = buf[_PREFIX.size + hlen : end]
+    if xxhash.xxh3_64_intdigest(h) != hsum or xxhash.xxh3_64_intdigest(p) != psum:
+        raise CodecError("checksum mismatch")
+    return msgpack.unpackb(h, raw=False), p, end

@@ -1,0 +1,172 @@
+"""Logical naming tree + instance discovery.
+
+Namespace → Component → Endpoint naming with lease-bound instance
+registration and prefix-watch discovery (reference: lib/runtime/src/
+component.rs — Namespace :408, Component :114, Endpoint :263, Instance :92;
+etcd path per instance :348). An Instance record points at the worker's
+ingress TCP address; clients keep a live instance set from a watch and
+route per RouterMode.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import msgpack
+
+from dynamo_tpu.runtime.store import Watch, WatchEvent
+
+logger = logging.getLogger(__name__)
+
+INSTANCE_ROOT = "v1/instances"
+MODEL_ROOT = "v1/models"
+DEFAULT_LEASE_TTL = 3.0
+
+
+@dataclass(frozen=True)
+class Instance:
+    namespace: str
+    component: str
+    endpoint: str
+    instance_id: str
+    host: str
+    port: int
+    metadata: dict[str, Any] = field(default_factory=dict)
+
+    @property
+    def path(self) -> str:
+        return instance_key(
+            self.namespace, self.component, self.endpoint, self.instance_id
+        )
+
+    @property
+    def address(self) -> tuple[str, int]:
+        return (self.host, self.port)
+
+    def pack(self) -> bytes:
+        return msgpack.packb(
+            {
+                "namespace": self.namespace,
+                "component": self.component,
+                "endpoint": self.endpoint,
+                "instance_id": self.instance_id,
+                "host": self.host,
+                "port": self.port,
+                "metadata": self.metadata,
+            },
+            use_bin_type=True,
+        )
+
+    @staticmethod
+    def unpack(data: bytes) -> "Instance":
+        d = msgpack.unpackb(data, raw=False)
+        return Instance(**d)
+
+
+def endpoint_prefix(namespace: str, component: str, endpoint: str) -> str:
+    return f"{INSTANCE_ROOT}/{namespace}/{component}/{endpoint}/"
+
+
+def instance_key(
+    namespace: str, component: str, endpoint: str, instance_id: str
+) -> str:
+    return endpoint_prefix(namespace, component, endpoint) + instance_id
+
+
+class EndpointRegistration:
+    """A live (endpoint × lease) registration; revoking the lease (or the
+    process dying and missing keepalives) erases it everywhere."""
+
+    def __init__(self, fabric, instance: Instance, lease_id: str):
+        self.fabric = fabric
+        self.instance = instance
+        self.lease_id = lease_id
+
+    @classmethod
+    async def register(
+        cls,
+        fabric,
+        namespace: str,
+        component: str,
+        endpoint: str,
+        host: str,
+        port: int,
+        metadata: Optional[dict] = None,
+        lease_ttl: float = DEFAULT_LEASE_TTL,
+        lease_id: Optional[str] = None,
+    ) -> "EndpointRegistration":
+        if lease_id is None:
+            lease_id = await fabric.grant_lease(lease_ttl)
+        inst = Instance(
+            namespace=namespace,
+            component=component,
+            endpoint=endpoint,
+            instance_id=uuid.uuid4().hex[:12],
+            host=host,
+            port=port,
+            metadata=metadata or {},
+        )
+        await fabric.put(inst.path, inst.pack(), lease_id=lease_id)
+        logger.info("registered %s at %s:%d", inst.path, host, port)
+        return cls(fabric, inst, lease_id)
+
+    async def deregister(self) -> None:
+        await self.fabric.revoke_lease(self.lease_id)
+
+
+class InstanceSource:
+    """Live set of instances for one endpoint, fed by a prefix watch."""
+
+    def __init__(self, fabric, namespace: str, component: str, endpoint: str):
+        self.fabric = fabric
+        self.prefix = endpoint_prefix(namespace, component, endpoint)
+        self.instances: dict[str, Instance] = {}
+        self._watch: Optional[Watch] = None
+        self._task: Optional[asyncio.Task] = None
+        self._changed = asyncio.Event()
+
+    async def start(self) -> None:
+        self._watch = await self.fabric.watch_prefix(self.prefix)
+        self._task = asyncio.get_running_loop().create_task(self._pump())
+
+    async def _pump(self) -> None:
+        async for ev in self._watch:
+            if ev.kind == "put":
+                inst = Instance.unpack(ev.value)
+                self.instances[inst.instance_id] = inst
+            else:
+                iid = ev.key.rsplit("/", 1)[-1]
+                self.instances.pop(iid, None)
+            self._changed.set()
+
+    def list(self) -> list[Instance]:
+        return sorted(self.instances.values(), key=lambda i: i.instance_id)
+
+    def mark_down(self, instance_id: str) -> None:
+        """Active fault detection: drop locally before the lease expires."""
+        if self.instances.pop(instance_id, None) is not None:
+            logger.warning("marked instance %s down", instance_id)
+            self._changed.set()
+
+    async def wait_for_instances(self, timeout: float = 5.0) -> list[Instance]:
+        deadline = asyncio.get_running_loop().time() + timeout
+        while not self.instances:
+            remaining = deadline - asyncio.get_running_loop().time()
+            if remaining <= 0:
+                raise TimeoutError(f"no instances under {self.prefix}")
+            self._changed.clear()
+            try:
+                await asyncio.wait_for(self._changed.wait(), remaining)
+            except asyncio.TimeoutError:
+                pass
+        return self.list()
+
+    async def stop(self) -> None:
+        if self._watch:
+            self._watch.close()
+        if self._task:
+            self._task.cancel()

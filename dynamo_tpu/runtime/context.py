@@ -1,0 +1,76 @@
+"""Cancellation tree + request context.
+
+The universal request envelope: every request flowing through pipelines and
+over the network carries a Context with a request id and a cancellation
+token; cancelling a parent cancels all children (parity with the reference's
+AsyncEngineContext / CancellationToken tree — /root/reference
+lib/runtime/src/engine.rs:124, lib.rs:69).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import uuid
+from typing import Any, Optional
+
+
+class CancellationToken:
+    """Hierarchical cancellation: child tokens are cancelled with the parent."""
+
+    def __init__(self, parent: Optional["CancellationToken"] = None):
+        self._event = asyncio.Event()
+        self._parent = parent
+        self._children: list[CancellationToken] = []
+        if parent is not None:
+            parent._children.append(self)
+            if parent.cancelled:
+                self._event.set()
+
+    @property
+    def cancelled(self) -> bool:
+        return self._event.is_set()
+
+    def cancel(self) -> None:
+        if self._event.is_set():
+            return
+        self._event.set()
+        for child in self._children:
+            child.cancel()
+
+    def child(self) -> "CancellationToken":
+        return CancellationToken(parent=self)
+
+    async def wait(self) -> None:
+        await self._event.wait()
+
+    def raise_if_cancelled(self) -> None:
+        if self.cancelled:
+            raise asyncio.CancelledError("context cancelled")
+
+
+class Context:
+    """Request context: id + cancellation + free-form metadata."""
+
+    def __init__(
+        self,
+        request_id: Optional[str] = None,
+        token: Optional[CancellationToken] = None,
+        metadata: Optional[dict[str, Any]] = None,
+    ):
+        self.request_id = request_id or uuid.uuid4().hex
+        self.token = token or CancellationToken()
+        self.metadata = metadata or {}
+
+    @property
+    def cancelled(self) -> bool:
+        return self.token.cancelled
+
+    def cancel(self) -> None:
+        self.token.cancel()
+
+    def child(self) -> "Context":
+        return Context(
+            request_id=self.request_id,
+            token=self.token.child(),
+            metadata=dict(self.metadata),
+        )

@@ -1,0 +1,12 @@
+from dynamo_tpu.runtime.fabric.base import AbstractFabric, Subscription
+from dynamo_tpu.runtime.fabric.local import LocalFabric
+from dynamo_tpu.runtime.fabric.server import FabricServer
+from dynamo_tpu.runtime.fabric.client import RemoteFabric
+
+__all__ = [
+    "AbstractFabric",
+    "Subscription",
+    "LocalFabric",
+    "FabricServer",
+    "RemoteFabric",
+]

@@ -1,0 +1,103 @@
+"""The fabric interface: control-plane KV + leases + watches, pub/sub
+events, durable work queues, and an object store.
+
+One abstraction covers what the reference splits across four transports
+(etcd for discovery/lease/watch, NATS core for events, JetStream for the
+prefill queue + object store — SURVEY.md L0). Implementations:
+LocalFabric (in-process, zero infra — the mem.rs pattern) and RemoteFabric
+(TCP client to a FabricServer).
+
+Design rule kept from the reference (§5.8): small control messages ride the
+fabric; bulk bytes (token streams, KV pages) ride dedicated direct TCP
+planes (runtime/ingress.py, disagg/transfer.py).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass
+from typing import Any, Optional, Protocol
+
+from dynamo_tpu.runtime.store import Watch
+
+
+@dataclass(frozen=True)
+class BusMessage:
+    subject: str
+    header: Any
+    payload: bytes
+
+
+class Subscription:
+    def __init__(self, subject: str):
+        self.subject = subject
+        self.queue: asyncio.Queue[Optional[BusMessage]] = asyncio.Queue()
+        self._closed = False
+
+    def _push(self, msg: Optional[BusMessage]) -> None:
+        if not self._closed:
+            self.queue.put_nowait(msg)
+
+    async def next(self, timeout: Optional[float] = None) -> Optional[BusMessage]:
+        try:
+            if timeout is None:
+                return await self.queue.get()
+            return await asyncio.wait_for(self.queue.get(), timeout)
+        except asyncio.TimeoutError:
+            return None
+
+    async def __aiter__(self):
+        while True:
+            m = await self.queue.get()
+            if m is None:
+                return
+            yield m
+
+    def close(self) -> None:
+        self._closed = True
+        self.queue.put_nowait(None)
+
+
+@dataclass(frozen=True)
+class QueueItem:
+    item_id: str
+    header: Any
+    payload: bytes
+
+
+def subject_matches(pattern: str, subject: str) -> bool:
+    """Exact match, or prefix wildcard: 'events.>' matches 'events.kv.x'."""
+    if pattern.endswith(">"):
+        return subject.startswith(pattern[:-1])
+    return pattern == subject
+
+
+class AbstractFabric(Protocol):
+    # kv + leases + watches (KeyValueStore surface)
+    async def put(self, key: str, value: bytes, lease_id: Optional[str] = None) -> None: ...
+    async def create(self, key: str, value: bytes, lease_id: Optional[str] = None) -> bool: ...
+    async def get(self, key: str) -> Optional[bytes]: ...
+    async def get_prefix(self, prefix: str) -> dict[str, bytes]: ...
+    async def delete(self, key: str) -> bool: ...
+    async def watch_prefix(self, prefix: str) -> Watch: ...
+    async def grant_lease(self, ttl: float) -> str: ...
+    async def keepalive(self, lease_id: str) -> bool: ...
+    async def revoke_lease(self, lease_id: str) -> None: ...
+
+    # pub/sub
+    async def publish(self, subject: str, header: Any, payload: bytes = b"") -> None: ...
+    async def subscribe(self, subject: str) -> Subscription: ...
+
+    # durable work queue (ack-based redelivery)
+    async def queue_push(self, queue: str, header: Any, payload: bytes = b"") -> None: ...
+    async def queue_pop(self, queue: str, timeout: Optional[float] = None) -> Optional[QueueItem]: ...
+    async def queue_ack(self, queue: str, item_id: str) -> None: ...
+    async def queue_nack(self, queue: str, item_id: str) -> None: ...
+    async def queue_len(self, queue: str) -> int: ...
+
+    # object store
+    async def obj_put(self, name: str, data: bytes) -> None: ...
+    async def obj_get(self, name: str) -> Optional[bytes]: ...
+    async def obj_delete(self, name: str) -> bool: ...
+
+    async def close(self) -> None: ...

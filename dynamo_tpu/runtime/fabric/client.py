@@ -1,0 +1,232 @@
+"""RemoteFabric: client to a FabricServer (AbstractFabric over TCP)."""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import logging
+from typing import Any, Optional
+
+from dynamo_tpu.runtime.codec import encode_frame, read_frame
+from dynamo_tpu.runtime.fabric.base import BusMessage, QueueItem, Subscription
+from dynamo_tpu.runtime.store import Watch, WatchEvent
+
+logger = logging.getLogger(__name__)
+
+
+class FabricConnectionError(ConnectionError):
+    pass
+
+
+class RemoteFabric:
+    def __init__(self, address: str):
+        self.address = address
+        self._reader: Optional[asyncio.StreamReader] = None
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._ids = itertools.count(1)
+        self._pending: dict[int, asyncio.Future] = {}
+        self._watches: dict[int, Watch] = {}
+        self._subs: dict[int, Subscription] = {}
+        self._reader_task: Optional[asyncio.Task] = None
+        self._keepalive_task: Optional[asyncio.Task] = None
+        self._leases: set[str] = set()
+        self._send_lock = asyncio.Lock()
+
+    @classmethod
+    async def connect(cls, address: str) -> "RemoteFabric":
+        self = cls(address)
+        host, port = address.rsplit(":", 1)
+        try:
+            self._reader, self._writer = await asyncio.open_connection(
+                host, int(port)
+            )
+        except OSError as e:
+            raise FabricConnectionError(f"cannot reach fabric at {address}: {e}")
+        self._reader_task = asyncio.get_running_loop().create_task(
+            self._read_loop()
+        )
+        return self
+
+    async def _read_loop(self) -> None:
+        try:
+            while True:
+                header, payload = await read_frame(self._reader)
+                if "push" in header:
+                    self._handle_push(header, payload)
+                    continue
+                fut = self._pending.pop(header.get("id"), None)
+                if fut is not None and not fut.done():
+                    fut.set_result((header, payload))
+        except (asyncio.IncompleteReadError, ConnectionError, asyncio.CancelledError):
+            pass
+        finally:
+            err = FabricConnectionError(f"fabric connection {self.address} lost")
+            for fut in self._pending.values():
+                if not fut.done():
+                    fut.set_exception(err)
+            self._pending.clear()
+            for w in self._watches.values():
+                w.close()
+            for s in self._subs.values():
+                s.close()
+
+    def _handle_push(self, h: Any, payload: bytes) -> None:
+        if h["push"] == "watch":
+            w = self._watches.get(h["watch_id"])
+            if w is not None:
+                w._push(
+                    WatchEvent(
+                        h["kind"], h["key"], payload if h["kind"] == "put" else None
+                    )
+                )
+        elif h["push"] == "msg":
+            s = self._subs.get(h["sub_id"])
+            if s is not None:
+                s._push(BusMessage(h["subject"], h.get("header"), payload))
+
+    async def _call(self, header: dict, payload: bytes = b"") -> tuple[Any, bytes]:
+        rid = next(self._ids)
+        header["id"] = rid
+        fut = asyncio.get_running_loop().create_future()
+        self._pending[rid] = fut
+        async with self._send_lock:
+            if self._writer is None:
+                raise FabricConnectionError("not connected")
+            self._writer.write(encode_frame(header, payload))
+            await self._writer.drain()
+        h, p = await fut
+        if not h.get("ok"):
+            raise RuntimeError(f"fabric {header.get('op')}: {h.get('error')}")
+        return h, p
+
+    # -- kv ----------------------------------------------------------------
+
+    async def put(self, key, value, lease_id=None):
+        await self._call({"op": "kv.put", "key": key, "lease": lease_id}, value)
+
+    async def create(self, key, value, lease_id=None):
+        h, _ = await self._call(
+            {"op": "kv.create", "key": key, "lease": lease_id}, value
+        )
+        return h["created"]
+
+    async def get(self, key):
+        h, p = await self._call({"op": "kv.get", "key": key})
+        return p if h["found"] else None
+
+    async def get_prefix(self, prefix):
+        h, _ = await self._call({"op": "kv.get_prefix", "prefix": prefix})
+        return h["items"]
+
+    async def delete(self, key):
+        h, _ = await self._call({"op": "kv.delete", "key": key})
+        return h["deleted"]
+
+    async def watch_prefix(self, prefix) -> Watch:
+        watch_id = next(self._ids)
+        w = Watch()
+        self._watches[watch_id] = w
+        await self._call(
+            {"op": "kv.watch", "prefix": prefix, "watch_id": watch_id}
+        )
+        return w
+
+    # -- leases ------------------------------------------------------------
+
+    async def grant_lease(self, ttl):
+        h, _ = await self._call({"op": "lease.grant", "ttl": ttl})
+        self._leases.add(h["lease"])
+        self._ensure_keepalive(ttl)
+        return h["lease"]
+
+    async def keepalive(self, lease_id):
+        h, _ = await self._call({"op": "lease.keepalive", "lease": lease_id})
+        return h["alive"]
+
+    async def revoke_lease(self, lease_id):
+        self._leases.discard(lease_id)
+        await self._call({"op": "lease.revoke", "lease": lease_id})
+
+    def _ensure_keepalive(self, ttl: float) -> None:
+        if self._keepalive_task is None or self._keepalive_task.done():
+            self._keepalive_task = asyncio.get_running_loop().create_task(
+                self._keepalive_loop(max(ttl / 3.0, 0.2))
+            )
+
+    async def _keepalive_loop(self, interval: float) -> None:
+        try:
+            while True:
+                await asyncio.sleep(interval)
+                for lease in list(self._leases):
+                    try:
+                        await self.keepalive(lease)
+                    except Exception:
+                        logger.warning("keepalive failed for %s", lease)
+        except asyncio.CancelledError:
+            pass
+
+    # -- pub/sub -----------------------------------------------------------
+
+    async def publish(self, subject, header, payload=b""):
+        await self._call(
+            {"op": "bus.pub", "subject": subject, "header": header}, payload
+        )
+
+    async def subscribe(self, subject) -> Subscription:
+        sub_id = next(self._ids)
+        s = Subscription(subject)
+        self._subs[sub_id] = s
+        await self._call({"op": "bus.sub", "subject": subject, "sub_id": sub_id})
+        return s
+
+    # -- queue -------------------------------------------------------------
+
+    async def queue_push(self, queue, header, payload=b""):
+        await self._call({"op": "queue.push", "queue": queue, "header": header}, payload)
+
+    async def queue_pop(self, queue, timeout=None):
+        h, p = await self._call(
+            {"op": "queue.pop", "queue": queue, "timeout": timeout}
+        )
+        if not h["found"]:
+            return None
+        return QueueItem(h["item_id"], h.get("header"), p)
+
+    async def queue_ack(self, queue, item_id):
+        await self._call({"op": "queue.ack", "queue": queue, "item_id": item_id})
+
+    async def queue_nack(self, queue, item_id):
+        await self._call({"op": "queue.nack", "queue": queue, "item_id": item_id})
+
+    async def queue_len(self, queue):
+        h, _ = await self._call({"op": "queue.len", "queue": queue})
+        return h["len"]
+
+    # -- objects -----------------------------------------------------------
+
+    async def obj_put(self, name, data):
+        await self._call({"op": "obj.put", "name": name}, data)
+
+    async def obj_get(self, name):
+        h, p = await self._call({"op": "obj.get", "name": name})
+        return p if h["found"] else None
+
+    async def obj_delete(self, name):
+        h, _ = await self._call({"op": "obj.delete", "name": name})
+        return h["deleted"]
+
+    async def ping(self) -> bool:
+        h, _ = await self._call({"op": "ping"})
+        return bool(h.get("ok"))
+
+    async def close(self):
+        if self._keepalive_task:
+            self._keepalive_task.cancel()
+        if self._reader_task:
+            self._reader_task.cancel()
+        if self._writer is not None:
+            self._writer.close()
+            try:
+                await self._writer.wait_closed()
+            except Exception:
+                pass

@@ -1,0 +1,137 @@
+"""In-process fabric: MemStore + local bus/queues/objects.
+
+Unit tests and single-process serving (`--static` mode) run on this with
+zero external infrastructure.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import uuid
+from collections import deque
+from typing import Any, Optional
+
+from dynamo_tpu.runtime.fabric.base import (
+    BusMessage,
+    QueueItem,
+    Subscription,
+    subject_matches,
+)
+from dynamo_tpu.runtime.store import MemStore, Watch
+
+
+class _LocalQueue:
+    def __init__(self):
+        self.items: deque[QueueItem] = deque()
+        self.inflight: dict[str, QueueItem] = {}
+        self.event = asyncio.Event()
+
+    def push(self, item: QueueItem) -> None:
+        self.items.append(item)
+        self.event.set()
+
+    def pop_nowait(self) -> Optional[QueueItem]:
+        if not self.items:
+            self.event.clear()
+            return None
+        item = self.items.popleft()
+        self.inflight[item.item_id] = item
+        return item
+
+
+class LocalFabric:
+    def __init__(self):
+        self.store = MemStore()
+        self._subs: list[Subscription] = []
+        self._queues: dict[str, _LocalQueue] = {}
+        self._objects: dict[str, bytes] = {}
+
+    # -- kv/lease/watch: delegate ------------------------------------------
+
+    async def put(self, key, value, lease_id=None):
+        await self.store.put(key, value, lease_id)
+
+    async def create(self, key, value, lease_id=None):
+        return await self.store.create(key, value, lease_id)
+
+    async def get(self, key):
+        return await self.store.get(key)
+
+    async def get_prefix(self, prefix):
+        return await self.store.get_prefix(prefix)
+
+    async def delete(self, key):
+        return await self.store.delete(key)
+
+    async def watch_prefix(self, prefix) -> Watch:
+        return await self.store.watch_prefix(prefix)
+
+    async def grant_lease(self, ttl):
+        return await self.store.grant_lease(ttl)
+
+    async def keepalive(self, lease_id):
+        return await self.store.keepalive(lease_id)
+
+    async def revoke_lease(self, lease_id):
+        await self.store.revoke_lease(lease_id)
+
+    # -- pub/sub -----------------------------------------------------------
+
+    async def publish(self, subject, header, payload=b""):
+        msg = BusMessage(subject, header, payload)
+        for sub in self._subs:
+            if subject_matches(sub.subject, subject):
+                sub._push(msg)
+
+    async def subscribe(self, subject) -> Subscription:
+        sub = Subscription(subject)
+        self._subs.append(sub)
+        return sub
+
+    # -- queues ------------------------------------------------------------
+
+    def _q(self, name: str) -> _LocalQueue:
+        return self._queues.setdefault(name, _LocalQueue())
+
+    async def queue_push(self, queue, header, payload=b""):
+        self._q(queue).push(QueueItem(uuid.uuid4().hex, header, payload))
+
+    async def queue_pop(self, queue, timeout=None):
+        q = self._q(queue)
+        while True:
+            item = q.pop_nowait()
+            if item is not None:
+                return item
+            try:
+                await asyncio.wait_for(q.event.wait(), timeout)
+            except asyncio.TimeoutError:
+                return None
+
+    async def queue_ack(self, queue, item_id):
+        self._q(queue).inflight.pop(item_id, None)
+
+    async def queue_nack(self, queue, item_id):
+        q = self._q(queue)
+        item = q.inflight.pop(item_id, None)
+        if item is not None:
+            q.items.appendleft(item)
+            q.event.set()
+
+    async def queue_len(self, queue):
+        return len(self._q(queue).items)
+
+    # -- objects -----------------------------------------------------------
+
+    async def obj_put(self, name, data):
+        self._objects[name] = bytes(data)
+
+    async def obj_get(self, name):
+        return self._objects.get(name)
+
+    async def obj_delete(self, name):
+        return self._objects.pop(name, None) is not None
+
+    async def close(self):
+        self.store.close()
+        for s in self._subs:
+            s.close()

@@ -1,0 +1,258 @@
+"""Fabric server: the control-plane process.
+
+One asyncio TCP server providing KV/lease/watch + pub/sub + queues + object
+store to every worker/frontend/router process (the role etcd + NATS +
+JetStream play for the reference — SURVEY.md L0). Wire protocol: codec.py
+frames; request/response correlated by `id`; server-initiated pushes carry
+`push` instead.
+
+Connection-scoped cleanup is the liveness model: leases granted on a
+connection are revoked when it drops (⇒ all its registrations vanish),
+subscriptions/watches die with it, and unacked queue items are redelivered.
+Start standalone:  python -m dynamo_tpu.runtime.fabric.server --port 4222
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+from typing import Any, Optional
+
+from dynamo_tpu.runtime.codec import encode_frame, read_frame
+from dynamo_tpu.runtime.fabric.local import LocalFabric
+
+logger = logging.getLogger(__name__)
+
+
+class _Conn:
+    def __init__(self, writer: asyncio.StreamWriter):
+        self.writer = writer
+        self.leases: set[str] = set()
+        self.watches: dict[int, Any] = {}  # watch_id -> (Watch, pump task)
+        self.subs: dict[int, Any] = {}  # sub_id -> (Subscription, pump task)
+        self.inflight: set[tuple[str, str]] = set()  # (queue, item_id)
+        self.lock = asyncio.Lock()
+
+    async def send(self, header: Any, payload: bytes = b"") -> None:
+        async with self.lock:
+            self.writer.write(encode_frame(header, payload))
+            await self.writer.drain()
+
+
+class FabricServer:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.host = host
+        self.port = port
+        self.fabric = LocalFabric()
+        self._server: Optional[asyncio.Server] = None
+        self._conns: set[_Conn] = set()
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._handle, self.host, self.port
+        )
+        self.port = self._server.sockets[0].getsockname()[1]
+        logger.info("fabric server on %s:%d", self.host, self.port)
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            # 3.12 wait_closed() also waits for handlers: drop live conns.
+            for conn in list(self._conns):
+                conn.writer.close()
+            await self._server.wait_closed()
+        await self.fabric.close()
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    # ------------------------------------------------------------------
+
+    async def _handle(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        conn = _Conn(writer)
+        self._conns.add(conn)
+        try:
+            while True:
+                header, payload = await read_frame(reader)
+                asyncio.get_running_loop().create_task(
+                    self._dispatch(conn, header, payload)
+                )
+        except (asyncio.IncompleteReadError, ConnectionError):
+            pass
+        except Exception:
+            logger.exception("fabric connection error")
+        finally:
+            self._conns.discard(conn)
+            await self._cleanup(conn)
+            writer.close()
+
+    async def _cleanup(self, conn: _Conn) -> None:
+        for _, (w, task) in conn.watches.items():
+            w.close()
+            task.cancel()
+        for _, (s, task) in conn.subs.items():
+            s.close()
+            task.cancel()
+        for queue, item_id in list(conn.inflight):
+            await self.fabric.queue_nack(queue, item_id)
+        for lease in list(conn.leases):
+            await self.fabric.revoke_lease(lease)
+
+    async def _dispatch(self, conn: _Conn, h: Any, payload: bytes) -> None:
+        op, rid = h.get("op"), h.get("id")
+        f = self.fabric
+        try:
+            if op == "kv.put":
+                await f.put(h["key"], payload, h.get("lease"))
+                await conn.send({"id": rid, "ok": True})
+            elif op == "kv.create":
+                created = await f.create(h["key"], payload, h.get("lease"))
+                await conn.send({"id": rid, "ok": True, "created": created})
+            elif op == "kv.get":
+                v = await f.get(h["key"])
+                await conn.send(
+                    {"id": rid, "ok": True, "found": v is not None}, v or b""
+                )
+            elif op == "kv.get_prefix":
+                items = await f.get_prefix(h["prefix"])
+                await conn.send({"id": rid, "ok": True, "items": items})
+            elif op == "kv.delete":
+                deleted = await f.delete(h["key"])
+                await conn.send({"id": rid, "ok": True, "deleted": deleted})
+            elif op == "kv.watch":
+                watch = await f.watch_prefix(h["prefix"])
+                watch_id = h["watch_id"]
+                task = asyncio.get_running_loop().create_task(
+                    self._pump_watch(conn, watch_id, watch)
+                )
+                conn.watches[watch_id] = (watch, task)
+                await conn.send({"id": rid, "ok": True})
+            elif op == "kv.unwatch":
+                entry = conn.watches.pop(h["watch_id"], None)
+                if entry:
+                    entry[0].close()
+                    entry[1].cancel()
+                await conn.send({"id": rid, "ok": True})
+            elif op == "lease.grant":
+                lease = await f.grant_lease(h["ttl"])
+                conn.leases.add(lease)
+                await conn.send({"id": rid, "ok": True, "lease": lease})
+            elif op == "lease.keepalive":
+                ok = await f.keepalive(h["lease"])
+                await conn.send({"id": rid, "ok": True, "alive": ok})
+            elif op == "lease.revoke":
+                conn.leases.discard(h["lease"])
+                await f.revoke_lease(h["lease"])
+                await conn.send({"id": rid, "ok": True})
+            elif op == "bus.pub":
+                await f.publish(h["subject"], h.get("header"), payload)
+                if rid is not None:
+                    await conn.send({"id": rid, "ok": True})
+            elif op == "bus.sub":
+                sub = await f.subscribe(h["subject"])
+                sub_id = h["sub_id"]
+                task = asyncio.get_running_loop().create_task(
+                    self._pump_sub(conn, sub_id, sub)
+                )
+                conn.subs[sub_id] = (sub, task)
+                await conn.send({"id": rid, "ok": True})
+            elif op == "bus.unsub":
+                entry = conn.subs.pop(h["sub_id"], None)
+                if entry:
+                    entry[0].close()
+                    entry[1].cancel()
+                await conn.send({"id": rid, "ok": True})
+            elif op == "queue.push":
+                await f.queue_push(h["queue"], h.get("header"), payload)
+                await conn.send({"id": rid, "ok": True})
+            elif op == "queue.pop":
+                item = await f.queue_pop(h["queue"], h.get("timeout"))
+                if item is None:
+                    await conn.send({"id": rid, "ok": True, "found": False})
+                else:
+                    conn.inflight.add((h["queue"], item.item_id))
+                    await conn.send(
+                        {
+                            "id": rid, "ok": True, "found": True,
+                            "item_id": item.item_id, "header": item.header,
+                        },
+                        item.payload,
+                    )
+            elif op == "queue.ack":
+                conn.inflight.discard((h["queue"], h["item_id"]))
+                await f.queue_ack(h["queue"], h["item_id"])
+                await conn.send({"id": rid, "ok": True})
+            elif op == "queue.nack":
+                conn.inflight.discard((h["queue"], h["item_id"]))
+                await f.queue_nack(h["queue"], h["item_id"])
+                await conn.send({"id": rid, "ok": True})
+            elif op == "queue.len":
+                n = await f.queue_len(h["queue"])
+                await conn.send({"id": rid, "ok": True, "len": n})
+            elif op == "obj.put":
+                await f.obj_put(h["name"], payload)
+                await conn.send({"id": rid, "ok": True})
+            elif op == "obj.get":
+                data = await f.obj_get(h["name"])
+                await conn.send(
+                    {"id": rid, "ok": True, "found": data is not None},
+                    data or b"",
+                )
+            elif op == "obj.delete":
+                deleted = await f.obj_delete(h["name"])
+                await conn.send({"id": rid, "ok": True, "deleted": deleted})
+            elif op == "ping":
+                await conn.send({"id": rid, "ok": True})
+            else:
+                await conn.send({"id": rid, "ok": False, "error": f"bad op {op}"})
+        except Exception as e:  # noqa: BLE001 — report op failures to caller
+            logger.exception("fabric op %s failed", op)
+            if rid is not None:
+                try:
+                    await conn.send({"id": rid, "ok": False, "error": str(e)})
+                except Exception:
+                    pass
+
+    async def _pump_watch(self, conn: _Conn, watch_id: int, watch) -> None:
+        async for ev in watch:
+            await conn.send(
+                {
+                    "push": "watch", "watch_id": watch_id, "kind": ev.kind,
+                    "key": ev.key,
+                },
+                ev.value or b"",
+            )
+
+    async def _pump_sub(self, conn: _Conn, sub_id: int, sub) -> None:
+        async for msg in sub:
+            await conn.send(
+                {
+                    "push": "msg", "sub_id": sub_id, "subject": msg.subject,
+                    "header": msg.header,
+                },
+                msg.payload,
+            )
+
+
+async def _amain(args) -> None:
+    server = FabricServer(args.host, args.port)
+    await server.start()
+    print(f"fabric listening on {server.address}", flush=True)
+    await asyncio.Event().wait()
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description="dynamo-tpu fabric server")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=4222)
+    args = p.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    asyncio.run(_amain(args))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,134 @@
+"""Worker ingress: the response-stream data plane.
+
+A TCP server on each worker that accepts pushed requests and streams
+responses back on the same connection, multiplexed by request id —
+collapsing the reference's NATS-push + separate-TCP-response pair
+(network.rs Ingress :279 + tcp/server.rs) into one direct, checksummed
+stream per client↔worker pair (fewer hops; the fabric stays control-only).
+
+Handler contract: `async def handler(context, request) -> AsyncIterator`
+yielding msgpack-able responses. Client-side cancel frames cancel the
+context mid-stream.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import AsyncIterator, Awaitable, Callable, Optional
+
+from dynamo_tpu.runtime.codec import encode_frame, read_frame
+from dynamo_tpu.runtime.context import Context
+
+logger = logging.getLogger(__name__)
+
+Handler = Callable[[Context, dict], AsyncIterator]
+
+
+class IngressServer:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.host = host
+        self.port = port
+        self._handlers: dict[str, Handler] = {}
+        self._server: Optional[asyncio.Server] = None
+        #: inflight request contexts by (connection id, request id)
+        self._inflight: dict[tuple[int, str], Context] = {}
+        self._conn_ids = iter(range(1, 1 << 62))
+        self._writers: set[asyncio.StreamWriter] = set()
+
+    def add_handler(self, endpoint: str, handler: Handler) -> None:
+        self._handlers[endpoint] = handler
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(self._handle, self.host, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        logger.info("ingress on %s:%d", self.host, self.port)
+
+    async def stop(self) -> None:
+        if self._server:
+            self._server.close()
+            # wait_closed() (3.12) waits for connection handlers too — kill
+            # live connections first or a stop with connected clients hangs.
+            for w in list(self._writers):
+                w.close()
+            await self._server.wait_closed()
+
+    async def _handle(self, reader, writer) -> None:
+        conn_id = next(self._conn_ids)
+        self._writers.add(writer)
+        write_lock = asyncio.Lock()
+        tasks: set[asyncio.Task] = set()
+        try:
+            while True:
+                header, payload = await read_frame(reader)
+                op = header.get("op")
+                if op == "call":
+                    t = asyncio.get_running_loop().create_task(
+                        self._serve_call(
+                            conn_id, header, payload, writer, write_lock
+                        )
+                    )
+                    tasks.add(t)
+                    t.add_done_callback(tasks.discard)
+                elif op == "cancel":
+                    ctx = self._inflight.get((conn_id, header["request_id"]))
+                    if ctx is not None:
+                        ctx.cancel()
+        except (asyncio.IncompleteReadError, ConnectionError):
+            pass
+        finally:
+            # connection gone: cancel everything it had in flight
+            for (cid, rid), ctx in list(self._inflight.items()):
+                if cid == conn_id:
+                    ctx.cancel()
+                    self._inflight.pop((cid, rid), None)
+            for t in tasks:
+                t.cancel()
+            self._writers.discard(writer)
+            writer.close()
+
+    async def _serve_call(
+        self, conn_id: int, header, payload: bytes, writer, write_lock
+    ) -> None:
+        import msgpack
+
+        rid = header["request_id"]
+        endpoint = header.get("endpoint", "")
+        ctx = Context(request_id=rid, metadata=header.get("metadata") or {})
+        self._inflight[(conn_id, rid)] = ctx
+
+        async def send(h, p=b""):
+            async with write_lock:
+                writer.write(encode_frame(h, p))
+                await writer.drain()
+
+        try:
+            handler = self._handlers.get(endpoint)
+            if handler is None:
+                await send(
+                    {"op": "error", "request_id": rid,
+                     "message": f"no handler for endpoint {endpoint!r}"}
+                )
+                return
+            request = msgpack.unpackb(payload, raw=False) if payload else None
+            async for item in handler(ctx, request):
+                if ctx.cancelled:
+                    break
+                await send(
+                    {"op": "data", "request_id": rid},
+                    msgpack.packb(item, use_bin_type=True),
+                )
+            await send({"op": "end", "request_id": rid, "cancelled": ctx.cancelled})
+        except asyncio.CancelledError:
+            try:
+                await send({"op": "end", "request_id": rid, "cancelled": True})
+            except Exception:
+                pass
+        except Exception as e:  # noqa: BLE001 — stream errors to the caller
+            logger.exception("handler error for %s", endpoint)
+            try:
+                await send({"op": "error", "request_id": rid, "message": str(e)})
+            except Exception:
+                pass
+        finally:
+            self._inflight.pop((conn_id, rid), None)

@@ -1,0 +1,209 @@
+"""PushRouter: client-side instance selection + streaming RPC with fault
+detection.
+
+RouterMode round_robin / random / direct / kv (kv delegates the choice to a
+KvRouter — dynamo_tpu.router) mirroring the reference's PushRouter
+(egress/push_router.rs:43, RouterMode :74). Fault detection: connection
+refused or a mid-stream drop marks the instance down locally (the lease
+mechanism cleans up globally) and retries on another instance
+(generate_with_fault_detection — push_router.rs:185-224).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import enum
+import itertools
+import logging
+import random
+import uuid
+from typing import Any, AsyncIterator, Optional
+
+import msgpack
+
+from dynamo_tpu.runtime.codec import encode_frame, read_frame
+from dynamo_tpu.runtime.component import Instance, InstanceSource
+from dynamo_tpu.runtime.context import Context
+
+logger = logging.getLogger(__name__)
+
+
+class RouterMode(str, enum.Enum):
+    ROUND_ROBIN = "round_robin"
+    RANDOM = "random"
+    DIRECT = "direct"
+    KV = "kv"
+
+
+class EngineStreamError(Exception):
+    pass
+
+
+class NoInstancesError(Exception):
+    pass
+
+
+class _WorkerConn:
+    """One multiplexed TCP connection to a worker's ingress."""
+
+    def __init__(self, reader, writer):
+        self.reader = reader
+        self.writer = writer
+        self.streams: dict[str, asyncio.Queue] = {}
+        self.lock = asyncio.Lock()
+        self.alive = True
+        self._task = asyncio.get_running_loop().create_task(self._read_loop())
+
+    async def _read_loop(self):
+        try:
+            while True:
+                header, payload = await read_frame(self.reader)
+                q = self.streams.get(header.get("request_id"))
+                if q is not None:
+                    q.put_nowait((header, payload))
+        except (asyncio.IncompleteReadError, ConnectionError, asyncio.CancelledError):
+            pass
+        finally:
+            self.alive = False
+            for q in self.streams.values():
+                q.put_nowait(None)
+
+    async def send(self, header, payload=b""):
+        async with self.lock:
+            self.writer.write(encode_frame(header, payload))
+            await self.writer.drain()
+
+    def close(self):
+        self.alive = False
+        self._task.cancel()
+        self.writer.close()
+
+
+class PushRouter:
+    def __init__(
+        self,
+        source: InstanceSource,
+        endpoint: str,
+        mode: RouterMode = RouterMode.ROUND_ROBIN,
+        kv_chooser=None,
+    ):
+        self.source = source
+        self.endpoint = endpoint
+        self.mode = mode
+        self.kv_chooser = kv_chooser  # async (request) -> instance_id
+        self._rr = itertools.count()
+        self._conns: dict[str, _WorkerConn] = {}
+
+    # -- selection ---------------------------------------------------------
+
+    async def _pick(
+        self, request: Any, instance_id: Optional[str]
+    ) -> Instance:
+        instances = self.source.list()
+        if not instances:
+            instances = await self.source.wait_for_instances(timeout=2.0)
+        if self.mode == RouterMode.DIRECT:
+            if instance_id is None:
+                raise ValueError("direct mode requires instance_id")
+            for inst in instances:
+                if inst.instance_id == instance_id:
+                    return inst
+            raise NoInstancesError(f"instance {instance_id} not found")
+        if self.mode == RouterMode.KV and self.kv_chooser is not None:
+            chosen = await self.kv_chooser(request)
+            for inst in instances:
+                if inst.instance_id == chosen:
+                    return inst
+            logger.warning("kv-chosen instance %s gone; falling back", chosen)
+        if self.mode == RouterMode.RANDOM:
+            return random.choice(instances)
+        return instances[next(self._rr) % len(instances)]
+
+    async def _conn_for(self, inst: Instance) -> _WorkerConn:
+        conn = self._conns.get(inst.instance_id)
+        if conn is not None and conn.alive:
+            return conn
+        reader, writer = await asyncio.open_connection(inst.host, inst.port)
+        conn = _WorkerConn(reader, writer)
+        self._conns[inst.instance_id] = conn
+        return conn
+
+    # -- the call ----------------------------------------------------------
+
+    async def generate(
+        self,
+        request: Any,
+        context: Optional[Context] = None,
+        instance_id: Optional[str] = None,
+        max_attempts: int = 3,
+    ) -> AsyncIterator[Any]:
+        """Push `request`; yields the response stream. Retries on instances
+        that fail before producing any output; mid-stream failure surfaces
+        as EngineStreamError after marking the instance down."""
+        ctx = context or Context()
+        attempts = 0
+        while True:
+            attempts += 1
+            inst = await self._pick(request, instance_id)
+            try:
+                conn = await self._conn_for(inst)
+            except OSError:
+                self.source.mark_down(inst.instance_id)
+                if attempts >= max_attempts:
+                    raise NoInstancesError(
+                        f"no reachable instance for {self.endpoint}"
+                    )
+                continue
+
+            rid = ctx.request_id + "-" + uuid.uuid4().hex[:6]
+            q: asyncio.Queue = asyncio.Queue()
+            conn.streams[rid] = q
+            try:
+                await conn.send(
+                    {
+                        "op": "call", "request_id": rid,
+                        "endpoint": self.endpoint, "metadata": ctx.metadata,
+                    },
+                    msgpack.packb(request, use_bin_type=True),
+                )
+            except (OSError, ConnectionError):
+                conn.streams.pop(rid, None)
+                self.source.mark_down(inst.instance_id)
+                if attempts >= max_attempts:
+                    raise NoInstancesError(
+                        f"no reachable instance for {self.endpoint}"
+                    )
+                continue
+
+            got_data = False
+            try:
+                while True:
+                    if ctx.cancelled:
+                        try:
+                            await conn.send({"op": "cancel", "request_id": rid})
+                        except Exception:
+                            pass
+                        return
+                    item = await q.get()
+                    if item is None:  # connection dropped mid-stream
+                        self.source.mark_down(inst.instance_id)
+                        if got_data or attempts >= max_attempts:
+                            raise EngineStreamError(
+                                f"stream from {inst.instance_id} dropped"
+                            )
+                        break  # retry another instance
+                    header, payload = item
+                    op = header["op"]
+                    if op == "data":
+                        got_data = True
+                        yield msgpack.unpackb(payload, raw=False)
+                    elif op == "end":
+                        return
+                    elif op == "error":
+                        raise EngineStreamError(header.get("message"))
+            finally:
+                conn.streams.pop(rid, None)
+
+    def close(self) -> None:
+        for conn in self._conns.values():
+            conn.close()

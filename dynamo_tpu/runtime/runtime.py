@@ -1,0 +1,109 @@
+"""DistributedRuntime: the wiring root every process starts from.
+
+Holds the fabric connection (or an in-process LocalFabric in static mode),
+grants the process's primary lease, and hands out namespaced helpers
+(reference: DistributedRuntime — lib/runtime/src/distributed.rs:34-85,
+is_static mode lib.rs:97).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+from dynamo_tpu.runtime.component import (
+    DEFAULT_LEASE_TTL,
+    EndpointRegistration,
+    InstanceSource,
+)
+from dynamo_tpu.runtime.fabric import LocalFabric, RemoteFabric
+from dynamo_tpu.runtime.push_router import PushRouter, RouterMode
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_FABRIC_ADDR = os.environ.get("DYNTPU_FABRIC", "127.0.0.1:4222")
+
+
+class DistributedRuntime:
+    def __init__(self, fabric, primary_lease: Optional[str] = None):
+        self.fabric = fabric
+        self.primary_lease = primary_lease
+
+    @classmethod
+    async def create(
+        cls,
+        fabric_address: Optional[str] = None,
+        static: bool = False,
+        lease_ttl: float = DEFAULT_LEASE_TTL,
+    ) -> "DistributedRuntime":
+        """static=True: no discovery plane, in-process fabric (single-node
+        pipelines, tests). Otherwise connect to the fabric server and take
+        the process's primary lease."""
+        if static:
+            fabric = LocalFabric()
+        else:
+            fabric = await RemoteFabric.connect(
+                fabric_address or DEFAULT_FABRIC_ADDR
+            )
+        lease = await fabric.grant_lease(lease_ttl)
+        return cls(fabric, primary_lease=lease)
+
+    def namespace(self, name: str) -> "Namespace":
+        return Namespace(self, name)
+
+    async def close(self) -> None:
+        await self.fabric.close()
+
+
+class Namespace:
+    def __init__(self, runtime: DistributedRuntime, name: str):
+        self.runtime = runtime
+        self.name = name
+
+    def component(self, name: str) -> "Component":
+        return Component(self, name)
+
+
+class Component:
+    def __init__(self, namespace: Namespace, name: str):
+        self.namespace = namespace
+        self.name = name
+
+    def endpoint(self, name: str) -> "Endpoint":
+        return Endpoint(self, name)
+
+
+class Endpoint:
+    def __init__(self, component: Component, name: str):
+        self.component = component
+        self.name = name
+
+    @property
+    def _rt(self) -> DistributedRuntime:
+        return self.component.namespace.runtime
+
+    @property
+    def path(self) -> tuple[str, str, str]:
+        return (self.component.namespace.name, self.component.name, self.name)
+
+    async def register(
+        self, host: str, port: int, metadata: Optional[dict] = None
+    ) -> EndpointRegistration:
+        ns, comp, ep = self.path
+        return await EndpointRegistration.register(
+            self._rt.fabric, ns, comp, ep, host, port,
+            metadata=metadata, lease_id=self._rt.primary_lease,
+        )
+
+    async def instance_source(self) -> InstanceSource:
+        ns, comp, ep = self.path
+        src = InstanceSource(self._rt.fabric, ns, comp, ep)
+        await src.start()
+        return src
+
+    async def router(
+        self, mode: RouterMode = RouterMode.ROUND_ROBIN, kv_chooser=None
+    ) -> PushRouter:
+        src = await self.instance_source()
+        return PushRouter(src, self.name, mode=mode, kv_chooser=kv_chooser)

@@ -1,0 +1,193 @@
+"""Key-value store abstraction with leases and prefix watches.
+
+The control-plane seam: everything above (component registration, model
+cards, discovery, barriers) talks to this interface; the backend is either
+the in-process MemStore (unit tests need no infra — the reference's
+storage/key_value_store/mem.rs lesson, SURVEY.md §4) or the fabric server's
+store (production). Liveness is lease-scoped: a key bound to a lease is
+deleted when the lease expires, which is the entire crash-detection story
+(reference: etcd primary lease — transports/etcd.rs:78).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import AsyncIterator, Literal, Optional, Protocol
+
+
+@dataclass(frozen=True)
+class WatchEvent:
+    kind: Literal["put", "delete"]
+    key: str
+    value: Optional[bytes] = None
+
+
+@dataclass
+class KvEntry:
+    key: str
+    value: bytes
+    lease_id: Optional[str] = None
+
+
+class KeyValueStore(Protocol):
+    async def put(
+        self, key: str, value: bytes, lease_id: Optional[str] = None
+    ) -> None: ...
+
+    async def create(
+        self, key: str, value: bytes, lease_id: Optional[str] = None
+    ) -> bool:
+        """Put only if absent; returns False if the key exists."""
+        ...
+
+    async def get(self, key: str) -> Optional[bytes]: ...
+
+    async def get_prefix(self, prefix: str) -> dict[str, bytes]: ...
+
+    async def delete(self, key: str) -> bool: ...
+
+    async def watch_prefix(self, prefix: str) -> "Watch": ...
+
+    async def grant_lease(self, ttl: float) -> str: ...
+
+    async def keepalive(self, lease_id: str) -> bool: ...
+
+    async def revoke_lease(self, lease_id: str) -> None: ...
+
+
+class Watch:
+    """A stream of WatchEvents for a key prefix. Initial state is replayed
+    as synthetic 'put' events so consumers need no separate list+watch."""
+
+    def __init__(self):
+        self.queue: asyncio.Queue[Optional[WatchEvent]] = asyncio.Queue()
+        self._closed = False
+
+    def _push(self, event: Optional[WatchEvent]) -> None:
+        if not self._closed:
+            self.queue.put_nowait(event)
+
+    async def __aiter__(self) -> AsyncIterator[WatchEvent]:
+        while True:
+            ev = await self.queue.get()
+            if ev is None:
+                return
+            yield ev
+
+    async def next(self, timeout: Optional[float] = None) -> Optional[WatchEvent]:
+        if timeout is None:
+            return await self.queue.get()
+        try:
+            return await asyncio.wait_for(self.queue.get(), timeout)
+        except asyncio.TimeoutError:
+            return None
+
+    def close(self) -> None:
+        self._closed = True
+        self.queue.put_nowait(None)
+
+
+class MemStore:
+    """In-process KeyValueStore with real lease expiry and watches."""
+
+    def __init__(self):
+        self._data: dict[str, KvEntry] = {}
+        self._leases: dict[str, float] = {}  # lease_id -> deadline
+        self._lease_ttl: dict[str, float] = {}
+        self._lease_keys: dict[str, set[str]] = {}
+        self._watches: list[tuple[str, Watch]] = []
+        self._reaper: Optional[asyncio.Task] = None
+
+    def _ensure_reaper(self) -> None:
+        if self._reaper is None or self._reaper.done():
+            self._reaper = asyncio.get_running_loop().create_task(
+                self._reap_loop()
+            )
+
+    async def _reap_loop(self) -> None:
+        while True:
+            await asyncio.sleep(0.05)
+            now = time.monotonic()
+            for lease_id in [
+                l for l, dl in self._leases.items() if dl < now
+            ]:
+                await self.revoke_lease(lease_id)
+
+    def _notify(self, event: WatchEvent) -> None:
+        for prefix, watch in self._watches:
+            if event.key.startswith(prefix):
+                watch._push(event)
+
+    # -- kv ----------------------------------------------------------------
+
+    async def put(self, key, value, lease_id=None) -> None:
+        if lease_id is not None:
+            if lease_id not in self._leases:
+                raise KeyError(f"unknown lease {lease_id}")
+            self._lease_keys.setdefault(lease_id, set()).add(key)
+        self._data[key] = KvEntry(key, value, lease_id)
+        self._notify(WatchEvent("put", key, value))
+
+    async def create(self, key, value, lease_id=None) -> bool:
+        if key in self._data:
+            return False
+        await self.put(key, value, lease_id)
+        return True
+
+    async def get(self, key) -> Optional[bytes]:
+        e = self._data.get(key)
+        return e.value if e else None
+
+    async def get_prefix(self, prefix) -> dict[str, bytes]:
+        return {
+            k: e.value for k, e in self._data.items() if k.startswith(prefix)
+        }
+
+    async def delete(self, key) -> bool:
+        e = self._data.pop(key, None)
+        if e is None:
+            return False
+        if e.lease_id and e.lease_id in self._lease_keys:
+            self._lease_keys[e.lease_id].discard(key)
+        self._notify(WatchEvent("delete", key))
+        return True
+
+    # -- watches -----------------------------------------------------------
+
+    async def watch_prefix(self, prefix) -> Watch:
+        w = Watch()
+        for k, e in self._data.items():
+            if k.startswith(prefix):
+                w._push(WatchEvent("put", k, e.value))
+        self._watches.append((prefix, w))
+        return w
+
+    # -- leases ------------------------------------------------------------
+
+    async def grant_lease(self, ttl: float) -> str:
+        self._ensure_reaper()
+        lease_id = uuid.uuid4().hex[:16]
+        self._leases[lease_id] = time.monotonic() + ttl
+        self._lease_ttl[lease_id] = ttl
+        self._lease_keys[lease_id] = set()
+        return lease_id
+
+    async def keepalive(self, lease_id: str) -> bool:
+        if lease_id not in self._leases:
+            return False
+        self._leases[lease_id] = time.monotonic() + self._lease_ttl[lease_id]
+        return True
+
+    async def revoke_lease(self, lease_id: str) -> None:
+        self._leases.pop(lease_id, None)
+        self._lease_ttl.pop(lease_id, None)
+        for key in list(self._lease_keys.pop(lease_id, ())):
+            await self.delete(key)
+
+    def close(self) -> None:
+        if self._reaper is not None:
+            self._reaper.cancel()
+            self._reaper = None

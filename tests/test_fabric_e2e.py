@@ -1,0 +1,138 @@
+"""FabricServer + RemoteFabric over real TCP: kv/lease/watch/pubsub/queue,
+connection-drop semantics (lease revocation, queue redelivery)."""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.runtime.fabric import FabricServer, RemoteFabric
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def _server():
+    s = FabricServer(port=0)
+    await s.start()
+    return s
+
+
+def test_kv_roundtrip_and_watch():
+    async def main():
+        server = await _server()
+        c1 = await RemoteFabric.connect(server.address)
+        c2 = await RemoteFabric.connect(server.address)
+        try:
+            await c1.put("k/a", b"v1")
+            assert await c2.get("k/a") == b"v1"
+            assert await c2.get("k/missing") is None
+            w = await c2.watch_prefix("k/")
+            ev = await w.next(timeout=1)
+            assert ev.key == "k/a" and ev.value == b"v1"
+            await c1.put("k/b", b"v2")
+            ev = await w.next(timeout=1)
+            assert ev.key == "k/b"
+            await c1.delete("k/a")
+            ev = await w.next(timeout=1)
+            assert ev.kind == "delete" and ev.key == "k/a"
+            assert await c1.create("k/b", b"x") is False
+            items = await c2.get_prefix("k/")
+            assert items == {"k/b": b"v2"}
+        finally:
+            await c1.close()
+            await c2.close()
+            await server.stop()
+
+    run(main())
+
+
+def test_connection_drop_revokes_leases():
+    async def main():
+        server = await _server()
+        c1 = await RemoteFabric.connect(server.address)
+        c2 = await RemoteFabric.connect(server.address)
+        try:
+            lease = await c1.grant_lease(ttl=30.0)  # long ttl: drop must win
+            await c1.put("inst/worker1", b"meta", lease_id=lease)
+            assert await c2.get("inst/worker1") == b"meta"
+            w = await c2.watch_prefix("inst/")
+            assert (await w.next(timeout=1)).kind == "put"
+            await c1.close()  # simulated crash
+            ev = await w.next(timeout=2)
+            assert ev is not None and ev.kind == "delete" and ev.key == "inst/worker1"
+            assert await c2.get("inst/worker1") is None
+        finally:
+            await c2.close()
+            await server.stop()
+
+    run(main())
+
+
+def test_pubsub_and_objects_over_tcp():
+    async def main():
+        server = await _server()
+        c1 = await RemoteFabric.connect(server.address)
+        c2 = await RemoteFabric.connect(server.address)
+        try:
+            sub = await c2.subscribe("kv_events.>")
+            await asyncio.sleep(0)  # let sub registration land
+            await c1.publish("kv_events.w1", {"stored": [1, 2]}, b"blob")
+            msg = await sub.next(timeout=2)
+            assert msg.subject == "kv_events.w1"
+            assert msg.header == {"stored": [1, 2]} and msg.payload == b"blob"
+
+            await c1.obj_put("cards/m1", b"model-card-bytes")
+            assert await c2.obj_get("cards/m1") == b"model-card-bytes"
+            assert await c2.obj_delete("cards/m1") is True
+            assert await c2.obj_get("cards/m1") is None
+        finally:
+            await c1.close()
+            await c2.close()
+            await server.stop()
+
+    run(main())
+
+
+def test_queue_redelivery_on_worker_crash():
+    """A popped-but-unacked item is redelivered when the consumer dies —
+    the prefill-queue durability contract."""
+
+    async def main():
+        server = await _server()
+        producer = await RemoteFabric.connect(server.address)
+        worker1 = await RemoteFabric.connect(server.address)
+        worker2 = await RemoteFabric.connect(server.address)
+        try:
+            await producer.queue_push("prefill", {"req": "A"}, b"tokens")
+            item = await worker1.queue_pop("prefill", timeout=1)
+            assert item.header == {"req": "A"}
+            await worker1.close()  # crash before ack
+            item2 = await worker2.queue_pop("prefill", timeout=2)
+            assert item2 is not None and item2.header == {"req": "A"}
+            await worker2.queue_ack("prefill", item2.item_id)
+            assert await worker2.queue_pop("prefill", timeout=0.05) is None
+        finally:
+            await producer.close()
+            await worker2.close()
+            await server.stop()
+
+    run(main())
+
+
+def test_bad_op_and_error_paths():
+    async def main():
+        server = await _server()
+        c = await RemoteFabric.connect(server.address)
+        try:
+            assert await c.ping() is True
+            with pytest.raises(RuntimeError):
+                await c._call({"op": "definitely.not.an.op"})
+            # lease put with unknown lease errors cleanly
+            with pytest.raises(RuntimeError):
+                await c.put("x", b"v", lease_id="nope")
+        finally:
+            await c.close()
+            await server.stop()
+
+    run(main())

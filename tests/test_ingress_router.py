@@ -1,0 +1,220 @@
+"""Ingress + PushRouter over real TCP with a FabricServer discovery plane:
+registration, round-robin/direct routing, streaming, cancellation, fault
+detection on worker death."""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.runtime import (
+    Context,
+    DistributedRuntime,
+    IngressServer,
+    NoInstancesError,
+    RouterMode,
+)
+from dynamo_tpu.runtime.fabric import FabricServer
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def echo_handler(ctx, request):
+    for i in range(request.get("n", 3)):
+        yield {"i": i, "echo": request["text"], "rid": ctx.request_id}
+
+
+async def slow_handler(ctx, request):
+    for i in range(100):
+        await asyncio.sleep(0.02)
+        yield {"i": i}
+
+
+async def _spawn_worker(rt, name, handler=echo_handler, endpoint="generate"):
+    ingress = IngressServer()
+    ingress.add_handler(endpoint, handler)
+    await ingress.start()
+    ep = rt.namespace("test").component("worker").endpoint(endpoint)
+    reg = await ep.register("127.0.0.1", ingress.port, metadata={"name": name})
+    return ingress, reg
+
+
+def test_register_discover_roundrobin():
+    async def main():
+        server = FabricServer(port=0)
+        await server.start()
+        rt_w1 = await DistributedRuntime.create(server.address)
+        rt_w2 = await DistributedRuntime.create(server.address)
+        rt_c = await DistributedRuntime.create(server.address)
+        try:
+            ing1, _ = await _spawn_worker(rt_w1, "w1")
+            ing2, _ = await _spawn_worker(rt_w2, "w2")
+            ep = rt_c.namespace("test").component("worker").endpoint("generate")
+            router = await ep.router(mode=RouterMode.ROUND_ROBIN)
+            await router.source.wait_for_instances()
+            assert len(router.source.list()) == 2
+
+            out = [x async for x in router.generate({"text": "hi", "n": 2})]
+            assert [o["echo"] for o in out] == ["hi", "hi"]
+
+            # round robin alternates instances: hit it 4 times, count conns
+            seen = set()
+            for _ in range(4):
+                async for _ in router.generate({"text": "x", "n": 1}):
+                    pass
+                seen = set(router._conns)
+            assert len(seen) == 2
+        finally:
+            await rt_c.close()
+            await rt_w1.close()
+            await rt_w2.close()
+            await server.stop()
+
+    run(main())
+
+
+def test_direct_mode_and_metadata():
+    async def main():
+        server = FabricServer(port=0)
+        await server.start()
+        rt_w = await DistributedRuntime.create(server.address)
+        rt_c = await DistributedRuntime.create(server.address)
+        try:
+            await _spawn_worker(rt_w, "w1")
+            ep = rt_c.namespace("test").component("worker").endpoint("generate")
+            router = await ep.router(mode=RouterMode.DIRECT)
+            insts = await router.source.wait_for_instances()
+            iid = insts[0].instance_id
+            assert insts[0].metadata == {"name": "w1"}
+            out = [
+                x async for x in router.generate({"text": "d", "n": 1}, instance_id=iid)
+            ]
+            assert out[0]["echo"] == "d"
+            with pytest.raises(NoInstancesError):
+                async for _ in router.generate({"text": "d"}, instance_id="missing"):
+                    pass
+        finally:
+            await rt_c.close()
+            await rt_w.close()
+            await server.stop()
+
+    run(main())
+
+
+def test_cancellation_stops_stream():
+    async def main():
+        server = FabricServer(port=0)
+        await server.start()
+        rt_w = await DistributedRuntime.create(server.address)
+        rt_c = await DistributedRuntime.create(server.address)
+        try:
+            ingress, _ = await _spawn_worker(rt_w, "w1", handler=slow_handler)
+            ep = rt_c.namespace("test").component("worker").endpoint("generate")
+            router = await ep.router()
+            ctx = Context()
+            got = 0
+            async for item in router.generate({"n": 100}, context=ctx):
+                got += 1
+                if got == 3:
+                    ctx.cancel()
+            assert got <= 4
+            # worker side must drop the inflight context soon after
+            await asyncio.sleep(0.3)
+            assert not ingress._inflight
+        finally:
+            await rt_c.close()
+            await rt_w.close()
+            await server.stop()
+
+    run(main())
+
+
+def test_fault_detection_worker_death():
+    """Kill one of two workers; router marks it down and the request is
+    served by the survivor."""
+
+    async def main():
+        server = FabricServer(port=0)
+        await server.start()
+        rt_w1 = await DistributedRuntime.create(server.address)
+        rt_w2 = await DistributedRuntime.create(server.address)
+        rt_c = await DistributedRuntime.create(server.address)
+        try:
+            ing1, _ = await _spawn_worker(rt_w1, "w1")
+            ing2, _ = await _spawn_worker(rt_w2, "w2")
+            ep = rt_c.namespace("test").component("worker").endpoint("generate")
+            router = await ep.router(mode=RouterMode.ROUND_ROBIN)
+            await router.source.wait_for_instances()
+
+            # cache conns to both
+            for _ in range(2):
+                async for _ in router.generate({"text": "warm", "n": 1}):
+                    pass
+            # kill w1 abruptly (ingress down; lease will also lapse)
+            await ing1.stop()
+            for conn in router._conns.values():
+                pass
+            ok = 0
+            for _ in range(4):
+                async for item in router.generate({"text": "after", "n": 1}):
+                    ok += 1
+            assert ok == 4  # all served despite the dead instance
+        finally:
+            await rt_c.close()
+            await rt_w1.close()
+            await rt_w2.close()
+            await server.stop()
+
+    run(main())
+
+
+def test_handler_error_propagates():
+    async def main():
+        server = FabricServer(port=0)
+        await server.start()
+        rt_w = await DistributedRuntime.create(server.address)
+        rt_c = await DistributedRuntime.create(server.address)
+        try:
+
+            async def bad_handler(ctx, request):
+                yield {"ok": 1}
+                raise RuntimeError("engine exploded")
+
+            await _spawn_worker(rt_w, "w1", handler=bad_handler)
+            ep = rt_c.namespace("test").component("worker").endpoint("generate")
+            router = await ep.router()
+            from dynamo_tpu.runtime import EngineStreamError
+
+            items = []
+            with pytest.raises(EngineStreamError, match="engine exploded"):
+                async for x in router.generate({"text": "x"}):
+                    items.append(x)
+            assert items == [{"ok": 1}]
+        finally:
+            await rt_c.close()
+            await rt_w.close()
+            await server.stop()
+
+    run(main())
+
+
+def test_static_mode_no_fabric_server():
+    """LocalFabric static mode: registration+discovery inside one process."""
+
+    async def main():
+        rt = await DistributedRuntime.create(static=True)
+        try:
+            ingress = IngressServer()
+            ingress.add_handler("generate", echo_handler)
+            await ingress.start()
+            ep = rt.namespace("n").component("c").endpoint("generate")
+            await ep.register("127.0.0.1", ingress.port)
+            router = await ep.router()
+            out = [x async for x in router.generate({"text": "local", "n": 1})]
+            assert out[0]["echo"] == "local"
+            await ingress.stop()
+        finally:
+            await rt.close()
+
+    run(main())

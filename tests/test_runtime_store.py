@@ -1,0 +1,111 @@
+"""MemStore + LocalFabric: leases, watches, queues, pub/sub."""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.runtime.fabric import LocalFabric
+from dynamo_tpu.runtime.store import MemStore
+
+
+@pytest.fixture
+def run():
+    def _run(coro):
+        return asyncio.run(coro)
+
+    return _run
+
+
+def test_kv_basics(run):
+    async def main():
+        s = MemStore()
+        await s.put("a/b", b"1")
+        assert await s.get("a/b") == b"1"
+        assert await s.create("a/b", b"2") is False
+        assert await s.create("a/c", b"2") is True
+        assert await s.get_prefix("a/") == {"a/b": b"1", "a/c": b"2"}
+        assert await s.delete("a/b") is True
+        assert await s.delete("a/b") is False
+        s.close()
+
+    run(main())
+
+
+def test_lease_expiry_deletes_keys(run):
+    async def main():
+        s = MemStore()
+        lease = await s.grant_lease(ttl=0.15)
+        await s.put("live/x", b"v", lease_id=lease)
+        assert await s.get("live/x") == b"v"
+        # keepalive extends life
+        await asyncio.sleep(0.1)
+        await s.keepalive(lease)
+        await asyncio.sleep(0.1)
+        assert await s.get("live/x") == b"v"
+        # stop keepalives -> expiry deletes the key
+        await asyncio.sleep(0.4)
+        assert await s.get("live/x") is None
+        s.close()
+
+    run(main())
+
+
+def test_watch_sees_initial_and_updates(run):
+    async def main():
+        s = MemStore()
+        await s.put("w/1", b"a")
+        w = await s.watch_prefix("w/")
+        ev = await w.next(timeout=1)
+        assert (ev.kind, ev.key, ev.value) == ("put", "w/1", b"a")
+        await s.put("w/2", b"b")
+        ev = await w.next(timeout=1)
+        assert (ev.kind, ev.key) == ("put", "w/2")
+        await s.delete("w/1")
+        ev = await w.next(timeout=1)
+        assert (ev.kind, ev.key) == ("delete", "w/1")
+        # unrelated key: no event
+        await s.put("other", b"z")
+        assert await w.next(timeout=0.1) is None
+        w.close()
+        s.close()
+
+    run(main())
+
+
+def test_local_fabric_pubsub_wildcards(run):
+    async def main():
+        f = LocalFabric()
+        exact = await f.subscribe("events.kv")
+        wild = await f.subscribe("events.>")
+        await f.publish("events.kv", {"n": 1}, b"x")
+        await f.publish("events.metrics", {"n": 2})
+        m1 = await exact.next(timeout=1)
+        assert m1.header == {"n": 1} and m1.payload == b"x"
+        assert (await wild.next(timeout=1)).subject == "events.kv"
+        assert (await wild.next(timeout=1)).subject == "events.metrics"
+        assert await exact.next(timeout=0.05) is None
+        await f.close()
+
+    run(main())
+
+
+def test_local_queue_ack_nack(run):
+    async def main():
+        f = LocalFabric()
+        await f.queue_push("q", {"job": 1})
+        await f.queue_push("q", {"job": 2})
+        assert await f.queue_len("q") == 2
+        item = await f.queue_pop("q", timeout=1)
+        assert item.header == {"job": 1}
+        # nack -> redelivered at the front
+        await f.queue_nack("q", item.item_id)
+        item2 = await f.queue_pop("q", timeout=1)
+        assert item2.header == {"job": 1}
+        await f.queue_ack("q", item2.item_id)
+        item3 = await f.queue_pop("q", timeout=1)
+        assert item3.header == {"job": 2}
+        # empty: timeout returns None
+        assert await f.queue_pop("q", timeout=0.05) is None
+        await f.close()
+
+    run(main())

@@ -1,0 +1,98 @@
+"""Scheduler unit tests against a bare allocator (no model, no device):
+preemption victim selection, snapshot consistency, growth timing."""
+
+from dynamo_tpu.engine.config import EngineConfig
+from dynamo_tpu.engine.page_table import PageAllocator
+from dynamo_tpu.engine.request import Request, RequestState, SamplingParams
+from dynamo_tpu.engine.scheduler import Scheduler
+
+
+def _cfg(**over):
+    base = dict(
+        model="tiny", num_pages=8, page_size=4, max_pages_per_seq=8,
+        decode_buckets=(1, 2, 4, 8), prefill_chunk=16, max_seqs=8,
+        admission_watermark=0.0, dtype="float32",
+    )
+    base.update(over)
+    return EngineConfig(**base)
+
+
+def _mk(scheduler, rid, prompt_len, outputs=0):
+    req = Request(
+        request_id=rid,
+        prompt_tokens=list(range(1, prompt_len + 1)),
+        sampling=SamplingParams(max_tokens=64),
+    )
+    scheduler.add_request(req)
+    return req
+
+
+def _drain_prefill(s: Scheduler):
+    """Admit + mark all prefill work computed (simulating the engine)."""
+    for _ in range(10):
+        batch = s.schedule()
+        if batch is None or batch.kind != "prefill":
+            return batch
+        for piece in batch.prefill:
+            piece.request.num_computed_tokens += piece.length
+            if piece.request.prefill_done:
+                piece.request.state = RequestState.DECODE
+                piece.request.output_tokens.append(0)
+    return None
+
+
+def test_victim_later_in_snapshot_is_not_scheduled():
+    """A victim preempted by an EARLIER request's page growth must not
+    appear in the same decode batch (it would decode on a released page
+    table)."""
+    cfg = _cfg(num_pages=8)  # 7 usable pages
+    alloc = PageAllocator(cfg.num_pages, cfg.page_size)
+    s = Scheduler(cfg, alloc)
+    # Three requests, 7-token prompts: 2 pages each -> 6 pages used, 1 free.
+    r0 = _mk(s, "r0", 7)
+    r1 = _mk(s, "r1", 7)
+    r2 = _mk(s, "r2", 7)
+    _drain_prefill(s)
+    assert all(r.state == RequestState.DECODE for r in (r0, r1, r2))
+    # Simulate decode progress to the growth edge for r0 ONLY: give it 9
+    # total tokens (needs 3rd page next step); r1/r2 stay within 2 pages.
+    r0.output_tokens.extend([0] * (9 - r0.num_tokens))
+    alloc.allocate(1)  # burn the last free page -> pool empty
+    batch = s.schedule()
+    assert batch is not None and batch.kind == "decode"
+    ids = [r.request_id for r in batch.decode]
+    # r2 (youngest) must be the victim and must NOT be in the batch
+    assert r2.state == RequestState.WAITING
+    assert "r2" not in ids
+    assert set(ids) == {"r0", "r1"}
+    # and no request in the batch is page-less
+    assert all(r.pages for r in batch.decode)
+
+
+def test_growth_only_when_needed():
+    """No page allocation while the next write still fits."""
+    cfg = _cfg(num_pages=16)
+    alloc = PageAllocator(cfg.num_pages, cfg.page_size)
+    s = Scheduler(cfg, alloc)
+    r = _mk(s, "r", 6)  # 2 pages hold 8 slots
+    _drain_prefill(s)
+    assert len(r.pages) == 2
+    # num_tokens == 7 -> writes position 6, fits page 2; no growth
+    batch = s.schedule()
+    assert batch.kind == "decode" and len(r.pages) == 2
+    r.output_tokens.append(0)  # now 8 tokens; position 7 still fits
+    batch = s.schedule()
+    assert len(r.pages) == 2
+    r.output_tokens.append(0)  # 9 tokens; position 8 needs page 3
+    batch = s.schedule()
+    assert len(r.pages) == 3
+
+
+def test_doomed_oversized_prompt():
+    cfg = _cfg(num_pages=4, max_pages_per_seq=8)  # pool: 3 pages
+    alloc = PageAllocator(cfg.num_pages, cfg.page_size)
+    s = Scheduler(cfg, alloc)
+    _mk(s, "big", 14)  # needs 4 pages
+    assert s.schedule() is None
+    assert len(s.doomed) == 1 and s.doomed[0][0].request_id == "big"
+    assert not s.waiting
