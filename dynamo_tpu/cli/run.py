@@ -1,0 +1,241 @@
+"""`dynamo-tpu run` — the one-command launcher.
+
+  dynamo-tpu run in=http out=jax model=llama3-1b            # single process
+  dynamo-tpu run in=text out=echo                           # REPL chat
+  dynamo-tpu run in=batch:prompts.jsonl out=jax model=tiny  # batch file
+  dynamo-tpu run in=dyn out=jax model=llama3-8b --fabric host:port
+                                                            # join as worker
+  dynamo-tpu run in=http out=dyn --fabric host:port         # frontend only
+
+(reference: `dynamo run in=<http|text|stdin|batch:f|dyn://...>
+out=<engine>` — launch/dynamo-run/src/lib.rs:44, opt.rs:7.)
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import logging
+import sys
+from typing import Optional
+
+from dynamo_tpu.engine import EngineConfig
+from dynamo_tpu.logging_config import configure_logging
+
+logger = logging.getLogger(__name__)
+
+
+def _engine_config(args) -> EngineConfig:
+    return EngineConfig(
+        model=args.model,
+        num_pages=args.num_pages,
+        page_size=args.page_size,
+        max_pages_per_seq=args.max_context // args.page_size,
+        prefill_chunk=args.prefill_chunk,
+        max_seqs=args.max_seqs,
+        dtype=args.dtype,
+        dp=args.dp,
+        tp=args.tp,
+        eos_token_ids=(0,),
+    )
+
+
+def _card(args):
+    from dynamo_tpu.model_card import ModelDeploymentCard
+
+    tokenizer = {"kind": "byte"}
+    if args.tokenizer:
+        tokenizer = {"kind": "hf", "path": args.tokenizer}
+    return ModelDeploymentCard(
+        name=args.model,
+        tokenizer=tokenizer,
+        context_length=args.max_context,
+        kv_page_size=args.page_size,
+    )
+
+
+async def _make_local_pipeline(args):
+    from dynamo_tpu.engine.async_engine import AsyncEngineRunner, EchoEngine
+    from dynamo_tpu.engine.engine import JaxEngine
+    from dynamo_tpu.frontend.service import local_pipeline
+
+    card = _card(args)
+    if args.out == "echo":
+        return local_pipeline(card, EchoEngine()), None
+    if args.out == "mock":
+        from dynamo_tpu.mocker import MockEngine
+
+        return local_pipeline(card, MockEngine()), None
+    engine = JaxEngine(_engine_config(args), checkpoint_path=args.checkpoint)
+    runner = AsyncEngineRunner(engine)
+    runner.start()
+    return local_pipeline(card, runner), runner
+
+
+async def _run_http(args) -> None:
+    from dynamo_tpu.frontend import HttpService, ModelManager
+    from dynamo_tpu.frontend.service import ModelWatcher
+
+    manager = ModelManager()
+    runner = None
+    watcher = None
+    if args.out == "dyn":
+        from dynamo_tpu.runtime import DistributedRuntime
+
+        rt = await DistributedRuntime.create(args.fabric)
+        watcher = ModelWatcher(rt, manager)
+        await watcher.start()
+    else:
+        pipeline, runner = await _make_local_pipeline(args)
+        manager.add(args.model, pipeline)
+    svc = HttpService(manager, host=args.host, port=args.port)
+    await svc.start()
+    print(f"listening on http://{args.host}:{svc.port}/v1", flush=True)
+    try:
+        await asyncio.Event().wait()
+    finally:
+        await svc.stop()
+        if runner:
+            runner.stop()
+
+
+async def _run_text(args) -> None:
+    from dynamo_tpu.protocols.openai import ChatCompletionRequest, ChatMessage
+
+    pipeline, runner = await _make_local_pipeline(args)
+    print(f"chat with {args.model} (out={args.out}); /quit to exit", flush=True)
+    history: list[ChatMessage] = []
+    try:
+        while True:
+            try:
+                line = await asyncio.get_running_loop().run_in_executor(
+                    None, lambda: input("> ")
+                )
+            except EOFError:
+                break
+            if line.strip() in ("/quit", "/exit"):
+                break
+            history.append(ChatMessage(role="user", content=line))
+            req = ChatCompletionRequest(
+                model=args.model, messages=history, stream=True,
+                max_tokens=args.max_tokens,
+            )
+            text = []
+            async for chunk in pipeline.chat_stream(req):
+                for c in chunk.choices:
+                    if c.delta.content:
+                        text.append(c.delta.content)
+                        print(c.delta.content, end="", flush=True)
+            print()
+            history.append(ChatMessage(role="assistant", content="".join(text)))
+    finally:
+        if runner:
+            runner.stop()
+
+
+async def _run_batch(args, path: str) -> None:
+    from dynamo_tpu.protocols.openai import ChatCompletionRequest, ChatMessage
+
+    pipeline, runner = await _make_local_pipeline(args)
+    try:
+        with open(path) as f:
+            lines = [json.loads(l) for l in f if l.strip()]
+        for i, item in enumerate(lines):
+            prompt = item.get("prompt") or item.get("text") or ""
+            req = ChatCompletionRequest(
+                model=args.model,
+                messages=[ChatMessage(role="user", content=prompt)],
+                stream=True,
+                max_tokens=item.get("max_tokens", args.max_tokens),
+            )
+            text = []
+            async for chunk in pipeline.chat_stream(req):
+                for c in chunk.choices:
+                    if c.delta.content:
+                        text.append(c.delta.content)
+            print(json.dumps({"index": i, "prompt": prompt, "output": "".join(text)}), flush=True)
+    finally:
+        if runner:
+            runner.stop()
+
+
+async def _run_worker(args) -> None:
+    from dynamo_tpu.runtime import DistributedRuntime
+    from dynamo_tpu.worker import Worker
+
+    rt = await DistributedRuntime.create(args.fabric)
+    worker = Worker(
+        rt,
+        _card(args),
+        engine_config=_engine_config(args) if args.out == "jax" else None,
+        engine_kind=args.out,
+        namespace=args.namespace,
+        component=args.component,
+        endpoint=args.endpoint,
+        checkpoint_path=args.checkpoint,
+    )
+    await worker.start()
+    print(f"worker {worker.instance_id} up (model={args.model})", flush=True)
+    try:
+        await asyncio.Event().wait()
+    finally:
+        await worker.stop()
+
+
+def main(argv: Optional[list[str]] = None) -> None:
+    p = argparse.ArgumentParser(prog="dynamo-tpu")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    runp = sub.add_parser("run", help="serve / chat / batch / worker")
+    runp.add_argument("io", nargs="*", help="in=<http|text|batch:file|dyn> out=<jax|echo|mock|dyn>")
+    runp.add_argument("--model", default="tiny")
+    runp.add_argument("--checkpoint", default=None, help="local HF checkpoint dir")
+    runp.add_argument("--tokenizer", default=None, help="local tokenizer dir")
+    runp.add_argument("--fabric", default=None, help="fabric server host:port")
+    runp.add_argument("--host", default="127.0.0.1")
+    runp.add_argument("--port", type=int, default=8080)
+    runp.add_argument("--namespace", default="dynamo")
+    runp.add_argument("--component", default="backend")
+    runp.add_argument("--endpoint", default="generate")
+    runp.add_argument("--num-pages", type=int, default=512, dest="num_pages")
+    runp.add_argument("--page-size", type=int, default=64, dest="page_size")
+    runp.add_argument("--max-context", type=int, default=4096, dest="max_context")
+    runp.add_argument("--prefill-chunk", type=int, default=512, dest="prefill_chunk")
+    runp.add_argument("--max-seqs", type=int, default=32, dest="max_seqs")
+    runp.add_argument("--max-tokens", type=int, default=256, dest="max_tokens")
+    runp.add_argument("--dtype", default="bfloat16")
+    runp.add_argument("--dp", type=int, default=1)
+    runp.add_argument("--tp", type=int, default=1)
+
+    fabricp = sub.add_parser("fabric", help="start the fabric server")
+    fabricp.add_argument("--host", default="127.0.0.1")
+    fabricp.add_argument("--port", type=int, default=4222)
+
+    args = p.parse_args(argv)
+    configure_logging()
+
+    if args.cmd == "fabric":
+        from dynamo_tpu.runtime.fabric.server import _amain
+
+        asyncio.run(_amain(args))
+        return
+
+    io = dict(kv.split("=", 1) for kv in args.io if "=" in kv)
+    inp = io.get("in", "text")
+    args.out = io.get("out", "jax")
+
+    if inp == "dyn":
+        asyncio.run(_run_worker(args))
+    elif inp == "http":
+        asyncio.run(_run_http(args))
+    elif inp.startswith("batch:"):
+        asyncio.run(_run_batch(args, inp.split(":", 1)[1]))
+    elif inp in ("text", "stdin"):
+        asyncio.run(_run_text(args))
+    else:
+        print(f"unknown in={inp}", file=sys.stderr)
+        sys.exit(2)
+
+
+if __name__ == "__main__":
+    main()

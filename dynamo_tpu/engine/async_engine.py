@@ -1,0 +1,159 @@
+"""Async bridge over JaxEngine + simple test engines.
+
+The engine's step loop is synchronous (device dispatch); AsyncEngineRunner
+runs it on a dedicated thread and exposes the universal AsyncEngine
+interface: `generate(context, preprocessed) -> async iterator of
+{token_ids, finish_reason}` (the reference's AsyncEngine::generate —
+engine.rs:207). Echo engines mirror engines.rs EchoFull/EchoCore for
+tests/CLI.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import threading
+from typing import Any, AsyncIterator, Optional, Protocol
+
+from dynamo_tpu.engine.engine import JaxEngine
+from dynamo_tpu.engine.request import SamplingParams, StepOutput
+from dynamo_tpu.preprocessor.preprocessor import PreprocessedRequest
+from dynamo_tpu.runtime.context import Context
+
+logger = logging.getLogger(__name__)
+
+
+class AsyncEngine(Protocol):
+    async def generate(
+        self, context: Context, request: PreprocessedRequest
+    ) -> AsyncIterator[dict]: ...
+
+
+def _sampling_from(req: PreprocessedRequest) -> SamplingParams:
+    return SamplingParams(
+        temperature=req.temperature,
+        top_p=req.top_p,
+        top_k=req.top_k,
+        max_tokens=req.max_tokens,
+        stop_token_ids=tuple(req.stop_token_ids),
+        ignore_eos=req.ignore_eos,
+        seed=req.seed,
+    )
+
+
+class AsyncEngineRunner:
+    """Thread-backed continuous-batching loop around a JaxEngine."""
+
+    def __init__(self, engine: JaxEngine):
+        self.engine = engine
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._queues: dict[str, asyncio.Queue] = {}
+        self._pending: list[tuple[PreprocessedRequest, SamplingParams]] = []
+        self._aborts: list[str] = []
+        self._lock = threading.Lock()
+        self._wake = threading.Event()
+        self._stop = False
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._loop = asyncio.get_running_loop()
+        self._thread = threading.Thread(target=self._run, daemon=True, name="engine")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop = True
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+
+    # -- engine thread -----------------------------------------------------
+
+    def _run(self) -> None:
+        eng = self.engine
+        while not self._stop:
+            with self._lock:
+                pending, self._pending = self._pending, []
+                aborts, self._aborts = self._aborts, []
+            for req, sampling in pending:
+                try:
+                    eng.add_request(req.request_id, req.token_ids, sampling)
+                except Exception as e:
+                    self._post(req.request_id, {"error": str(e)})
+                    self._post(req.request_id, None)
+            for rid in aborts:
+                eng.abort_request(rid)
+            if not eng.has_work:
+                self._wake.wait(timeout=0.05)
+                self._wake.clear()
+                continue
+            try:
+                outputs = eng.step()
+            except Exception:
+                logger.exception("engine step failed")
+                continue
+            for out in outputs:
+                self._post(
+                    out.request_id,
+                    {
+                        "token_ids": list(out.new_token_ids),
+                        "finish_reason": out.finish_reason.value
+                        if out.finish_reason
+                        else None,
+                    },
+                )
+                if out.finish_reason is not None:
+                    self._post(out.request_id, None)
+
+    def _post(self, request_id: str, item) -> None:
+        q = self._queues.get(request_id)
+        if q is not None and self._loop is not None:
+            self._loop.call_soon_threadsafe(q.put_nowait, item)
+
+    # -- async side --------------------------------------------------------
+
+    async def generate(
+        self, context: Context, request: PreprocessedRequest
+    ) -> AsyncIterator[dict]:
+        q: asyncio.Queue = asyncio.Queue()
+        self._queues[request.request_id] = q
+        with self._lock:
+            self._pending.append((request, _sampling_from(request)))
+        self._wake.set()
+        try:
+            while True:
+                if context.cancelled:
+                    with self._lock:
+                        self._aborts.append(request.request_id)
+                    self._wake.set()
+                    return
+                item = await q.get()
+                if item is None:
+                    return
+                if "error" in item:
+                    raise RuntimeError(item["error"])
+                yield item
+        finally:
+            self._queues.pop(request.request_id, None)
+
+    @property
+    def metrics(self):
+        return self.engine.metrics
+
+
+class EchoEngine:
+    """Echoes the prompt tokens back, one per step (engines.rs EchoCore)."""
+
+    def __init__(self, delay: float = 0.0):
+        self.delay = delay
+
+    async def generate(self, context, request: PreprocessedRequest):
+        n = min(len(request.token_ids), request.max_tokens)
+        for i, tok in enumerate(request.token_ids[:n]):
+            if context.cancelled:
+                return
+            if self.delay:
+                await asyncio.sleep(self.delay)
+            yield {
+                "token_ids": [tok],
+                "finish_reason": "stop" if i == n - 1 else None,
+            }

@@ -108,10 +108,15 @@ class JaxEngine:
             self._batch_shardings = None
 
     def _dev(self, arr: np.ndarray):
-        """Host batch array -> device, dp-sharded along dim 0 on a mesh."""
+        """Host batch array -> device, dp-sharded along dim 0 on a mesh.
+
+        Batches not divisible by dp (B=1 prefill, small decode buckets) are
+        left for jit to reshard — an explicit device_put would raise."""
         x = jnp.asarray(arr)
         if self._batch_shardings is not None:
-            x = jax.device_put(x, self._batch_shardings[arr.ndim])
+            dp = self.mesh.shape.get("dp", 1)
+            if dp > 1 and arr.shape[0] % dp == 0:
+                x = jax.device_put(x, self._batch_shardings[arr.ndim])
         return x
 
     # -- public API --------------------------------------------------------

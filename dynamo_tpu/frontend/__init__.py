@@ -1,0 +1,4 @@
+from dynamo_tpu.frontend.service import ModelManager, ModelPipeline, ModelWatcher
+from dynamo_tpu.frontend.http import HttpService
+
+__all__ = ["ModelManager", "ModelPipeline", "ModelWatcher", "HttpService"]

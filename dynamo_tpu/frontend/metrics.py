@@ -1,0 +1,123 @@
+"""Prometheus text-format metrics for the HTTP service (hand-rolled
+exposition; no client library in the image).
+
+Metric names mirror the reference's HTTP service plane
+(http/service/metrics.rs:104-111): requests_total, inflight_requests,
+request_duration, input/output_sequence_tokens, time_to_first_token,
+inter_token_latency.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict
+from typing import Optional
+
+PREFIX = "dynamo_tpu_http_service"
+
+_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0)
+
+
+class Histogram:
+    def __init__(self):
+        self.counts = [0] * (len(_BUCKETS) + 1)
+        self.total = 0.0
+        self.n = 0
+
+    def observe(self, v: float) -> None:
+        self.total += v
+        self.n += 1
+        for i, b in enumerate(_BUCKETS):
+            if v <= b:
+                self.counts[i] += 1
+                return
+        self.counts[-1] += 1
+
+    def expose(self, name: str, labels: str) -> list[str]:
+        out = []
+        cum = 0
+        for i, b in enumerate(_BUCKETS):
+            cum += self.counts[i]
+            out.append(f'{name}_bucket{{{labels},le="{b}"}} {cum}')
+        cum += self.counts[-1]
+        out.append(f'{name}_bucket{{{labels},le="+Inf"}} {cum}')
+        out.append(f"{name}_sum{{{labels}}} {self.total}")
+        out.append(f"{name}_count{{{labels}}} {self.n}")
+        return out
+
+
+class FrontendMetrics:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.requests_total = defaultdict(int)  # (model, endpoint, status)
+        self.inflight = defaultdict(int)  # model
+        self.input_tokens = defaultdict(int)
+        self.output_tokens = defaultdict(int)
+        self.duration = defaultdict(Histogram)  # model
+        self.ttft = defaultdict(Histogram)
+        self.itl = defaultdict(Histogram)
+
+    def request_done(
+        self, model: str, endpoint: str, status: str, duration_s: float,
+        input_tokens: int = 0, output_tokens: int = 0,
+        ttft_s: Optional[float] = None, itl_s: Optional[list[float]] = None,
+    ) -> None:
+        with self._lock:
+            self.requests_total[(model, endpoint, status)] += 1
+            self.input_tokens[model] += input_tokens
+            self.output_tokens[model] += output_tokens
+            self.duration[model].observe(duration_s)
+            if ttft_s is not None:
+                self.ttft[model].observe(ttft_s)
+            for v in itl_s or ():
+                self.itl[model].observe(v)
+
+    def inflight_guard(self, model: str) -> "InflightGuard":
+        return InflightGuard(self, model)
+
+    def expose(self) -> str:
+        lines = []
+        with self._lock:
+            lines.append(f"# TYPE {PREFIX}_requests_total counter")
+            for (model, ep, status), n in sorted(self.requests_total.items()):
+                lines.append(
+                    f'{PREFIX}_requests_total{{model="{model}",endpoint="{ep}",status="{status}"}} {n}'
+                )
+            lines.append(f"# TYPE {PREFIX}_inflight_requests gauge")
+            for model, n in sorted(self.inflight.items()):
+                lines.append(f'{PREFIX}_inflight_requests{{model="{model}"}} {n}')
+            for name, table in (
+                ("input_sequence_tokens", self.input_tokens),
+                ("output_sequence_tokens", self.output_tokens),
+            ):
+                lines.append(f"# TYPE {PREFIX}_{name} counter")
+                for model, n in sorted(table.items()):
+                    lines.append(f'{PREFIX}_{name}{{model="{model}"}} {n}')
+            for name, table in (
+                ("request_duration_seconds", self.duration),
+                ("time_to_first_token_seconds", self.ttft),
+                ("inter_token_latency_seconds", self.itl),
+            ):
+                lines.append(f"# TYPE {PREFIX}_{name} histogram")
+                for model, h in sorted(table.items()):
+                    lines.extend(h.expose(f"{PREFIX}_{name}", f'model="{model}"'))
+        return "\n".join(lines) + "\n"
+
+
+class InflightGuard:
+    """RAII inflight counter (reference: metrics.rs InflightGuard :41)."""
+
+    def __init__(self, metrics: FrontendMetrics, model: str):
+        self.metrics = metrics
+        self.model = model
+
+    def __enter__(self):
+        with self.metrics._lock:
+            self.metrics.inflight[self.model] += 1
+        return self
+
+    def __exit__(self, *exc):
+        with self.metrics._lock:
+            self.metrics.inflight[self.model] -= 1
+        return False

@@ -1,0 +1,187 @@
+"""ModelManager + ModelPipeline + ModelWatcher.
+
+A ModelPipeline is the canonical serving chain for one model:
+  OpenAI request -> preprocess (template+tokenize) -> engine source
+  (local AsyncEngine, or PushRouter to remote workers) -> postprocess
+  (detokenize + stop + chunks)
+(reference: build_pipeline — entrypoint/input/common.rs:121-150).
+
+The ModelManager maps model name -> pipeline; the ModelWatcher feeds it
+from a MODEL_ROOT prefix watch so frontends attach/detach models at
+runtime (discovery/watcher.rs:69, model_manager.rs:33).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import AsyncIterator, Callable, Optional
+
+from dynamo_tpu.model_card import ModelDeploymentCard, ModelEntry, load_card
+from dynamo_tpu.preprocessor import OpenAIPreprocessor, load_tokenizer
+from dynamo_tpu.preprocessor.preprocessor import PreprocessedRequest
+from dynamo_tpu.protocols.openai import (
+    ChatCompletionChunk,
+    ChatCompletionRequest,
+    CompletionRequest,
+)
+from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.runtime.push_router import PushRouter, RouterMode
+
+logger = logging.getLogger(__name__)
+
+
+class ModelPipeline:
+    def __init__(
+        self,
+        card: ModelDeploymentCard,
+        engine_fn: Callable[[Context, PreprocessedRequest], AsyncIterator[dict]],
+        close_fn: Optional[Callable] = None,
+    ):
+        self.card = card
+        self.preprocessor = OpenAIPreprocessor(
+            load_tokenizer(card.tokenizer), model_name=card.name
+        )
+        self.engine_fn = engine_fn
+        self.close_fn = close_fn
+
+    async def chat_stream(
+        self, request: ChatCompletionRequest, context: Optional[Context] = None
+    ) -> AsyncIterator[ChatCompletionChunk]:
+        ctx = context or Context()
+        pre = self.preprocessor.preprocess_chat(request)
+        self._clamp(pre)
+        include_usage = bool(
+            request.stream_options and request.stream_options.include_usage
+        ) or not request.stream
+        stream = self.engine_fn(ctx, pre)
+        async for chunk in self.preprocessor.postprocess_chat_stream(
+            stream, pre.request_id, pre, include_usage=include_usage
+        ):
+            yield chunk
+
+    async def completion_stream(
+        self, request: CompletionRequest, context: Optional[Context] = None
+    ) -> AsyncIterator[ChatCompletionChunk]:
+        ctx = context or Context()
+        pre = self.preprocessor.preprocess_completion(request)
+        self._clamp(pre)
+        include_usage = bool(
+            request.stream_options and request.stream_options.include_usage
+        ) or not request.stream
+        stream = self.engine_fn(ctx, pre)
+        async for chunk in self.preprocessor.postprocess_chat_stream(
+            stream, pre.request_id, pre, include_usage=include_usage
+        ):
+            yield chunk
+
+    def _clamp(self, pre: PreprocessedRequest) -> None:
+        room = self.card.context_length - len(pre.token_ids) - 1
+        if room < 0:
+            raise ValueError(
+                f"prompt of {len(pre.token_ids)} tokens exceeds context "
+                f"window {self.card.context_length}"
+            )
+        pre.max_tokens = max(1, min(pre.max_tokens, room)) if room else 1
+
+    async def close(self) -> None:
+        if self.close_fn:
+            res = self.close_fn()
+            if asyncio.iscoroutine(res):
+                await res
+
+
+def local_pipeline(card: ModelDeploymentCard, async_engine) -> ModelPipeline:
+    """Single-process pipeline over an in-process AsyncEngine."""
+    return ModelPipeline(card, engine_fn=async_engine.generate)
+
+
+def router_pipeline(
+    card: ModelDeploymentCard, router: PushRouter
+) -> ModelPipeline:
+    """Distributed pipeline: push preprocessed requests to workers."""
+
+    async def engine_fn(ctx: Context, pre: PreprocessedRequest):
+        instance_id = pre.annotations.get("instance_id")
+        async for item in router.generate(
+            pre.to_dict(), context=ctx, instance_id=instance_id
+        ):
+            yield item
+
+    return ModelPipeline(card, engine_fn=engine_fn, close_fn=router.close)
+
+
+class ModelManager:
+    def __init__(self):
+        self.pipelines: dict[str, ModelPipeline] = {}
+
+    def add(self, name: str, pipeline: ModelPipeline) -> None:
+        self.pipelines[name] = pipeline
+        logger.info("model attached: %s", name)
+
+    async def remove(self, name: str) -> None:
+        p = self.pipelines.pop(name, None)
+        if p is not None:
+            await p.close()
+            logger.info("model detached: %s", name)
+
+    def get(self, name: str) -> Optional[ModelPipeline]:
+        return self.pipelines.get(name)
+
+    def list_models(self) -> list[str]:
+        return sorted(self.pipelines)
+
+
+class ModelWatcher:
+    """Attach/detach models from MODEL_ROOT watch events."""
+
+    def __init__(self, runtime, manager: ModelManager):
+        self.runtime = runtime
+        self.manager = manager
+        self._task: Optional[asyncio.Task] = None
+        #: model -> set of entry keys currently backing it
+        self._entries: dict[str, set[str]] = {}
+
+    async def start(self) -> None:
+        from dynamo_tpu.runtime.component import MODEL_ROOT
+
+        watch = await self.runtime.fabric.watch_prefix(MODEL_ROOT + "/")
+        self._task = asyncio.get_running_loop().create_task(self._pump(watch))
+
+    async def _pump(self, watch) -> None:
+        async for ev in watch:
+            try:
+                if ev.kind == "put":
+                    await self._on_put(ev.key, ev.value)
+                else:
+                    await self._on_delete(ev.key)
+            except Exception:
+                logger.exception("model watcher event failed for %s", ev.key)
+
+    async def _on_put(self, key: str, value: bytes) -> None:
+        entry = ModelEntry.unpack(value)
+        keys = self._entries.setdefault(entry.model, set())
+        keys.add(key)
+        if self.manager.get(entry.model) is not None:
+            return  # already attached; this is another worker for it
+        card = await load_card(self.runtime.fabric, entry)
+        ep = (
+            self.runtime.namespace(entry.namespace)
+            .component(entry.component)
+            .endpoint(entry.endpoint)
+        )
+        router = await ep.router(mode=RouterMode(entry.router_mode))
+        self.manager.add(entry.model, router_pipeline(card, router))
+
+    async def _on_delete(self, key: str) -> None:
+        for model, keys in list(self._entries.items()):
+            if key in keys:
+                keys.discard(key)
+                if not keys:
+                    del self._entries[model]
+                    await self.manager.remove(model)
+                return
+
+    async def stop(self) -> None:
+        if self._task:
+            self._task.cancel()

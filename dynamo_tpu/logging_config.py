@@ -1,0 +1,58 @@
+"""Structured logging: pretty console or JSONL (env DYNTPU_LOGGING_JSONL).
+
+Parity with the reference's logging layer (lib/runtime/src/logging.rs:100:
+pretty vs JSONL selected by env, flattened span fields) — here a JSON
+formatter that merges `extra` fields into each record.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import sys
+import time
+
+_STD_ATTRS = set(
+    logging.LogRecord("", 0, "", 0, "", (), None).__dict__
+) | {"message", "asctime"}
+
+
+class JsonlFormatter(logging.Formatter):
+    def format(self, record: logging.LogRecord) -> str:
+        out = {
+            "ts": round(time.time(), 6),
+            "level": record.levelname,
+            "logger": record.name,
+            "message": record.getMessage(),
+        }
+        if record.exc_info:
+            out["exc"] = self.formatException(record.exc_info)
+        for k, v in record.__dict__.items():
+            if k not in _STD_ATTRS and not k.startswith("_"):
+                try:
+                    json.dumps(v)
+                    out[k] = v
+                except TypeError:
+                    out[k] = repr(v)
+        return json.dumps(out)
+
+
+def env_is_truthy(name: str) -> bool:
+    return os.environ.get(name, "").lower() in ("1", "true", "yes", "on")
+
+
+def configure_logging(level: int | None = None) -> None:
+    level = level if level is not None else (
+        logging.DEBUG if env_is_truthy("DYNTPU_DEBUG") else logging.INFO
+    )
+    handler = logging.StreamHandler(sys.stderr)
+    if env_is_truthy("DYNTPU_LOGGING_JSONL"):
+        handler.setFormatter(JsonlFormatter())
+    else:
+        handler.setFormatter(
+            logging.Formatter("%(asctime)s %(levelname)s %(name)s: %(message)s")
+        )
+    root = logging.getLogger()
+    root.handlers[:] = [handler]
+    root.setLevel(level)

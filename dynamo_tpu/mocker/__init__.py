@@ -1,0 +1,3 @@
+from dynamo_tpu.mocker.engine import MockEngine, MockEngineArgs
+
+__all__ = ["MockEngine", "MockEngineArgs"]

@@ -1,0 +1,100 @@
+"""Mock engine: a zero-hardware stand-in worker.
+
+Simulates a paged-KV continuous-batching engine faithfully enough to test
+routing and observability with no TPU: it runs a real PageAllocator (so
+prefix caching, eviction, and KV events are REAL — same code as JaxEngine),
+simulated prefill/decode timing, and deterministic token output (reference:
+the mocker component — lib/llm/src/mocker/engine.rs:60, kv_manager.rs:121,
+protocols.rs MockEngineArgs :72).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from dynamo_tpu.engine.page_table import KvEvent, PageAllocator
+from dynamo_tpu.preprocessor.preprocessor import PreprocessedRequest
+from dynamo_tpu.tokens import TokenBlockSequence
+
+
+@dataclass(frozen=True)
+class MockEngineArgs:
+    num_pages: int = 256
+    page_size: int = 16
+    #: simulated seconds per prefill token / per decode step
+    prefill_s_per_token: float = 0.0001
+    decode_s_per_step: float = 0.002
+    vocab_size: int = 256
+    salt: str = "mock"
+
+
+class MockEngine:
+    def __init__(
+        self,
+        args: MockEngineArgs = MockEngineArgs(),
+        on_kv_event: Optional[Callable[[KvEvent], None]] = None,
+    ):
+        self.args = args
+        self.allocator = PageAllocator(
+            args.num_pages, args.page_size, on_event=on_kv_event
+        )
+        self.active_requests = 0
+
+    def _next_token(self, history: list[int]) -> int:
+        h = hashlib.blake2b(bytes(str(history[-8:]), "utf-8"), digest_size=4)
+        return int.from_bytes(h.digest(), "little") % self.args.vocab_size
+
+    async def generate(self, context, request: PreprocessedRequest):
+        a = self.args
+        self.active_requests += 1
+        chain = TokenBlockSequence(
+            request.token_ids, block_size=a.page_size, salt=a.salt
+        )
+        hashes = chain.sequence_hashes()
+        cached = self.allocator.lookup(hashes)
+        need = -(-(len(request.token_ids) + 1) // a.page_size) - len(cached)
+        pages = self.allocator.allocate(max(need, 0)) or []
+        all_pages = cached + pages
+        try:
+            # simulated prefill (cached prefix is free)
+            uncached = len(request.token_ids) - len(cached) * a.page_size
+            await asyncio.sleep(max(uncached, 0) * a.prefill_s_per_token)
+            history = list(request.token_ids)
+            produced = 0
+            while produced < request.max_tokens:
+                if context.cancelled:
+                    return
+                await asyncio.sleep(a.decode_s_per_step)
+                tok = self._next_token(history)
+                history.append(tok)
+                committed = chain.append(tok)
+                if committed is not None:
+                    # register the newly-filled page for prefix reuse
+                    page_idx = committed.block_index
+                    if page_idx < len(all_pages):
+                        self.allocator.register(
+                            all_pages[page_idx],
+                            committed.sequence_hash,
+                            committed.parent_sequence_hash,
+                            committed.tokens,
+                        )
+                    grown = self.allocator.allocate(1)
+                    if grown:
+                        all_pages.extend(grown)
+                produced += 1
+                stop = (
+                    not request.ignore_eos and tok in request.stop_token_ids
+                ) or produced >= request.max_tokens
+                yield {
+                    "token_ids": [tok],
+                    "finish_reason": ("stop" if tok in request.stop_token_ids else "length") if stop else None,
+                }
+                if stop:
+                    return
+        finally:
+            self.active_requests -= 1
+            if all_pages:
+                self.allocator.free(all_pages)

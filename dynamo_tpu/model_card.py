@@ -1,0 +1,98 @@
+"""Model deployment cards + model registry.
+
+A ModelDeploymentCard is the canonical metadata for a served model
+(tokenizer spec, context window, eos ids); workers publish it once
+(card body in the fabric object store, entry key under MODEL_ROOT bound to
+the worker's lease) and frontends attach models dynamically from a prefix
+watch. Parity: reference ModelDeploymentCard (lib/llm/src/model_card/
+model.rs:86, move_to_nats :230) + ModelWatcher/MODEL_ROOT_PATH
+(discovery/watcher.rs:69).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import msgpack
+
+from dynamo_tpu.runtime.component import MODEL_ROOT
+
+CARD_OBJ_PREFIX = "cards/"
+
+
+@dataclass
+class ModelDeploymentCard:
+    name: str
+    tokenizer: dict = field(default_factory=lambda: {"kind": "byte"})
+    context_length: int = 4096
+    eos_token_ids: tuple[int, ...] = (0,)
+    kv_page_size: int = 64
+    chat_capable: bool = True
+    extra: dict[str, Any] = field(default_factory=dict)
+
+    def pack(self) -> bytes:
+        d = dict(self.__dict__)
+        d["eos_token_ids"] = list(self.eos_token_ids)
+        return msgpack.packb(d, use_bin_type=True)
+
+    @staticmethod
+    def unpack(data: bytes) -> "ModelDeploymentCard":
+        d = msgpack.unpackb(data, raw=False)
+        d["eos_token_ids"] = tuple(d.get("eos_token_ids", ()))
+        return ModelDeploymentCard(**d)
+
+
+@dataclass
+class ModelEntry:
+    """MODEL_ROOT entry: which component serves this model."""
+
+    model: str
+    namespace: str
+    component: str
+    endpoint: str
+    card_object: str
+    router_mode: str = "round_robin"
+
+    def pack(self) -> bytes:
+        return msgpack.packb(dict(self.__dict__), use_bin_type=True)
+
+    @staticmethod
+    def unpack(data: bytes) -> "ModelEntry":
+        return ModelEntry(**msgpack.unpackb(data, raw=False))
+
+
+def model_key(model: str, instance_suffix: str = "") -> str:
+    return f"{MODEL_ROOT}/{model}" + (f"/{instance_suffix}" if instance_suffix else "")
+
+
+async def register_llm(
+    fabric,
+    card: ModelDeploymentCard,
+    namespace: str,
+    component: str,
+    endpoint: str,
+    lease_id: Optional[str] = None,
+    router_mode: str = "round_robin",
+) -> ModelEntry:
+    """Publish card + model entry (reference: register_llm — _core.pyi:838)."""
+    obj = CARD_OBJ_PREFIX + card.name
+    await fabric.obj_put(obj, card.pack())
+    entry = ModelEntry(
+        model=card.name,
+        namespace=namespace,
+        component=component,
+        endpoint=endpoint,
+        card_object=obj,
+        router_mode=router_mode,
+    )
+    suffix = lease_id or ""
+    await fabric.put(model_key(card.name, suffix), entry.pack(), lease_id=lease_id)
+    return entry
+
+
+async def load_card(fabric, entry: ModelEntry) -> ModelDeploymentCard:
+    data = await fabric.obj_get(entry.card_object)
+    if data is None:
+        raise KeyError(f"card object {entry.card_object} missing")
+    return ModelDeploymentCard.unpack(data)

@@ -1,0 +1,23 @@
+from dynamo_tpu.preprocessor.tokenizer import (
+    ByteTokenizer,
+    HfTokenizer,
+    Tokenizer,
+    load_tokenizer,
+)
+from dynamo_tpu.preprocessor.detokenize import DecodeStream
+from dynamo_tpu.preprocessor.stop import StopChecker
+from dynamo_tpu.preprocessor.preprocessor import (
+    OpenAIPreprocessor,
+    PreprocessedRequest,
+)
+
+__all__ = [
+    "ByteTokenizer",
+    "HfTokenizer",
+    "Tokenizer",
+    "load_tokenizer",
+    "DecodeStream",
+    "StopChecker",
+    "OpenAIPreprocessor",
+    "PreprocessedRequest",
+]

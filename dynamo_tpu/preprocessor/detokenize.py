@@ -1,0 +1,44 @@
+"""Incremental detokenization.
+
+Token-at-a-time decode with a sliding window so multi-token glyphs (BPE
+continuation bytes, SentencePiece pieces) render correctly: we keep the
+last `read_offset` decoded text and emit only the stable suffix delta
+(vLLM-style prefix-offset detokenization; reference contract:
+DecodeStream::step — tokenizers.rs:212).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from dynamo_tpu.preprocessor.tokenizer import Tokenizer
+
+
+class DecodeStream:
+    def __init__(self, tokenizer: Tokenizer, window: int = 8):
+        self.tokenizer = tokenizer
+        self.window = window
+        self.ids: list[int] = []
+        self._emitted = ""
+
+    def step(self, token_id: int) -> str:
+        """Feed one token id; returns the newly-stable text delta ('' if the
+        glyph is still incomplete)."""
+        self.ids.append(token_id)
+        tail = self.ids[-self.window :]
+        prev_tail_text = self.tokenizer.decode(tail[:-1])
+        tail_text = self.tokenizer.decode(tail)
+        if tail_text.endswith("�"):
+            return ""  # incomplete multi-byte glyph; hold
+        if prev_tail_text.endswith("�"):
+            # previous call held text back; recompute delta from full decode
+            full = self.tokenizer.decode(self.ids)
+            delta = full[len(self._emitted) :]
+        else:
+            delta = tail_text[len(prev_tail_text) :]
+        self._emitted += delta
+        return delta
+
+    @property
+    def text(self) -> str:
+        return self._emitted

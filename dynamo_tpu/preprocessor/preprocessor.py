@@ -1,0 +1,197 @@
+"""OpenAI request ⇄ engine tokens: the preprocessor + stream postprocessor.
+
+Forward: template render → tokenize → sampling/stop defaults →
+PreprocessedRequest (the engine-facing contract; reference:
+OpenAIPreprocessor::preprocess_request — preprocessor.rs:156).
+Backward: token stream → incremental detokenize → stop strings → OpenAI
+chunks (transform_postprocessor_stream :335 + backend.rs Decoder).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, AsyncIterator, Optional
+
+from dynamo_tpu.preprocessor.detokenize import DecodeStream
+from dynamo_tpu.preprocessor.stop import StopChecker
+from dynamo_tpu.preprocessor.tokenizer import Tokenizer
+from dynamo_tpu.protocols.openai import (
+    ChatCompletionChunk,
+    ChatCompletionRequest,
+    ChatStreamChoice,
+    ChatChoiceDelta,
+    CompletionRequest,
+    Usage,
+    new_request_id,
+    now,
+)
+
+DEFAULT_MAX_TOKENS = 512
+
+
+@dataclass
+class PreprocessedRequest:
+    """Engine-facing request (msgpack-able via to_dict)."""
+
+    request_id: str
+    token_ids: list[int]
+    max_tokens: int = DEFAULT_MAX_TOKENS
+    temperature: float = 0.0
+    top_p: float = 1.0
+    top_k: int = 0
+    seed: Optional[int] = None
+    stop_token_ids: list[int] = field(default_factory=list)
+    stop_strings: list[str] = field(default_factory=list)
+    ignore_eos: bool = False
+    annotations: dict[str, Any] = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        return {
+            "request_id": self.request_id,
+            "token_ids": self.token_ids,
+            "max_tokens": self.max_tokens,
+            "temperature": self.temperature,
+            "top_p": self.top_p,
+            "top_k": self.top_k,
+            "seed": self.seed,
+            "stop_token_ids": self.stop_token_ids,
+            "stop_strings": self.stop_strings,
+            "ignore_eos": self.ignore_eos,
+            "annotations": self.annotations,
+        }
+
+    @staticmethod
+    def from_dict(d: dict) -> "PreprocessedRequest":
+        return PreprocessedRequest(**d)
+
+
+def _stop_list(stop) -> list[str]:
+    if stop is None:
+        return []
+    if isinstance(stop, str):
+        return [stop]
+    return list(stop)
+
+
+class OpenAIPreprocessor:
+    def __init__(self, tokenizer: Tokenizer, model_name: str = ""):
+        self.tokenizer = tokenizer
+        self.model_name = model_name
+
+    # -- forward -----------------------------------------------------------
+
+    def preprocess_chat(self, request: ChatCompletionRequest) -> PreprocessedRequest:
+        messages = [m.model_dump(exclude_none=True) for m in request.messages]
+        prompt = self.tokenizer.apply_chat_template(messages)
+        return self._common(
+            prompt_ids=self.tokenizer.encode(prompt),
+            max_tokens=request.effective_max_tokens,
+            temperature=request.temperature,
+            top_p=request.top_p,
+            top_k=request.top_k,
+            seed=request.seed,
+            stop=request.stop,
+            ext=request.extension,
+        )
+
+    def preprocess_completion(self, request: CompletionRequest) -> PreprocessedRequest:
+        prompt = request.prompt
+        if isinstance(prompt, list) and prompt and isinstance(prompt[0], int):
+            ids = list(prompt)
+        elif isinstance(prompt, list):
+            ids = self.tokenizer.encode("".join(prompt))
+        else:
+            ids = self.tokenizer.encode(prompt)
+        return self._common(
+            prompt_ids=ids,
+            max_tokens=request.max_tokens,
+            temperature=request.temperature,
+            top_p=request.top_p,
+            top_k=request.top_k,
+            seed=request.seed,
+            stop=request.stop,
+            ext=request.extension,
+        )
+
+    def _common(
+        self, prompt_ids, max_tokens, temperature, top_p, top_k, seed, stop, ext
+    ) -> PreprocessedRequest:
+        return PreprocessedRequest(
+            request_id=new_request_id(),
+            token_ids=prompt_ids,
+            max_tokens=max_tokens or DEFAULT_MAX_TOKENS,
+            temperature=temperature if temperature is not None else 0.0,
+            top_p=top_p if top_p is not None else 1.0,
+            top_k=top_k if top_k is not None else 0,
+            seed=seed,
+            stop_token_ids=list(self.tokenizer.eos_token_ids),
+            stop_strings=_stop_list(stop),
+            ignore_eos=bool(ext.ignore_eos) if ext else False,
+            annotations=(ext.annotations or {}) if ext else {},
+        )
+
+    # -- backward ----------------------------------------------------------
+
+    async def postprocess_chat_stream(
+        self,
+        engine_stream: AsyncIterator[dict],
+        request_id: str,
+        preprocessed: PreprocessedRequest,
+        include_usage: bool = False,
+    ) -> AsyncIterator[ChatCompletionChunk]:
+        """Engine events {token_ids, finish_reason} → OpenAI chunks."""
+        decode = DecodeStream(self.tokenizer)
+        stop = StopChecker(preprocessed.stop_strings)
+        created = now()
+        completion_tokens = 0
+        first = True
+        finish: Optional[str] = None
+
+        def chunk(content=None, role=None, finish_reason=None):
+            return ChatCompletionChunk(
+                id=request_id,
+                created=created,
+                model=self.model_name,
+                choices=[
+                    ChatStreamChoice(
+                        delta=ChatChoiceDelta(role=role, content=content),
+                        finish_reason=finish_reason,
+                    )
+                ],
+            )
+
+        stop_ids = set(preprocessed.stop_token_ids)
+        async for event in engine_stream:
+            for tok in event.get("token_ids", ()):
+                completion_tokens += 1
+                if tok in stop_ids and not preprocessed.ignore_eos:
+                    finish = "stop"
+                    break  # never render the stop/eos token itself
+                delta = decode.step(tok)
+                text = stop.feed(delta)
+                if text:
+                    if first:
+                        yield chunk(role="assistant", content=text)
+                        first = False
+                    else:
+                        yield chunk(content=text)
+                if stop.stopped:
+                    finish = "stop"
+                    break
+            if stop.stopped or finish == "stop":
+                break
+            if event.get("finish_reason"):
+                finish = event["finish_reason"]
+        if not stop.stopped:
+            tail = stop.flush()
+            if tail:
+                yield chunk(content=tail, role="assistant" if first else None)
+                first = False
+        final = chunk(finish_reason=finish or "stop")
+        if include_usage:
+            final.usage = Usage(
+                prompt_tokens=len(preprocessed.token_ids),
+                completion_tokens=completion_tokens,
+                total_tokens=len(preprocessed.token_ids) + completion_tokens,
+            )
+        yield final

@@ -1,0 +1,99 @@
+"""Tokenizer abstraction: HF tokenizers for real models, a reversible
+byte-level tokenizer for tests/echo (no downloads, vocab 256).
+
+Parity with the reference's tokenizer layer (/root/reference lib/llm/src/
+tokenizers.rs — Tokenizer :84, DecodeStream :212) with chat-template
+rendering folded in (the reference renders via minijinja in its
+preprocessor; HF tokenizers carry their template, and the byte tokenizer
+uses a simple role-prefix format).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Protocol, Sequence
+
+
+class Tokenizer(Protocol):
+    name: str
+    vocab_size: int
+    eos_token_ids: tuple[int, ...]
+
+    def encode(self, text: str) -> list[int]: ...
+
+    def decode(self, ids: Sequence[int]) -> str: ...
+
+    def apply_chat_template(self, messages: list[dict]) -> str: ...
+
+
+_FALLBACK_TEMPLATE_SUFFIX = "assistant:"
+
+
+def render_fallback_template(messages: list[dict]) -> str:
+    parts = []
+    for m in messages:
+        content = m.get("content") or ""
+        if isinstance(content, list):  # multimodal-style content parts
+            content = " ".join(
+                p.get("text", "") for p in content if isinstance(p, dict)
+            )
+        parts.append(f"{m.get('role', 'user')}: {content}")
+    parts.append(_FALLBACK_TEMPLATE_SUFFIX)
+    return "\n".join(parts)
+
+
+class ByteTokenizer:
+    """UTF-8 bytes as token ids (0..255). Reversible, dependency-free."""
+
+    def __init__(self, eos_token_ids: tuple[int, ...] = (0,)):
+        self.name = "byte"
+        self.vocab_size = 256
+        self.eos_token_ids = eos_token_ids
+
+    def encode(self, text: str) -> list[int]:
+        return list(text.encode("utf-8"))
+
+    def decode(self, ids: Sequence[int]) -> str:
+        return bytes(i for i in ids if 0 <= i < 256).decode("utf-8", errors="replace")
+
+    def apply_chat_template(self, messages: list[dict]) -> str:
+        return render_fallback_template(messages)
+
+
+class HfTokenizer:
+    """transformers AutoTokenizer wrapper (local files; zero-egress env)."""
+
+    def __init__(self, path: str):
+        from transformers import AutoTokenizer
+
+        self._tok = AutoTokenizer.from_pretrained(path, local_files_only=True)
+        self.name = os.path.basename(path.rstrip("/"))
+        self.vocab_size = len(self._tok)
+        eos = self._tok.eos_token_id
+        self.eos_token_ids = tuple(eos if isinstance(eos, list) else [eos]) if eos is not None else ()
+
+    def encode(self, text: str) -> list[int]:
+        return self._tok.encode(text, add_special_tokens=False)
+
+    def decode(self, ids: Sequence[int]) -> str:
+        return self._tok.decode(list(ids), skip_special_tokens=True)
+
+    def apply_chat_template(self, messages: list[dict]) -> str:
+        try:
+            return self._tok.apply_chat_template(
+                messages, tokenize=False, add_generation_prompt=True
+            )
+        except Exception:
+            return render_fallback_template(messages)
+
+
+def load_tokenizer(spec: dict | str) -> Tokenizer:
+    """spec: "byte" | {"kind": "byte"} | {"kind": "hf", "path": dir}"""
+    if isinstance(spec, str):
+        spec = {"kind": spec}
+    kind = spec.get("kind", "byte")
+    if kind == "byte":
+        return ByteTokenizer(tuple(spec.get("eos_token_ids", (0,))))
+    if kind == "hf":
+        return HfTokenizer(spec["path"])
+    raise ValueError(f"unknown tokenizer kind {kind!r}")

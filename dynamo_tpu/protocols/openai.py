@@ -1,0 +1,210 @@
+"""OpenAI-compatible protocol types (chat/completions/embeddings) + SSE.
+
+Pydantic models for the public HTTP surface, with a Dynamo-style extension
+block (`ext` here, `nvext` in the reference — /root/reference lib/llm/src/
+protocols/openai/nvext.rs) for framework-specific options (ignore_eos,
+annotations). Delta aggregation for non-streaming responses mirrors the
+reference's aggregator (protocols/openai/aggregator.rs).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import uuid
+from typing import Any, Literal, Optional, Union
+
+from pydantic import BaseModel, Field
+
+
+class Ext(BaseModel):
+    """Framework extensions (the reference's nvext)."""
+
+    ignore_eos: Optional[bool] = None
+    annotations: Optional[dict[str, Any]] = None
+    #: greedy-route this request to a specific worker instance
+    instance_id: Optional[str] = None
+
+
+class ChatMessage(BaseModel):
+    role: Literal["system", "user", "assistant", "tool"] = "user"
+    content: Union[str, list[dict[str, Any]], None] = None
+    name: Optional[str] = None
+
+
+class StreamOptions(BaseModel):
+    include_usage: Optional[bool] = None
+
+
+class ChatCompletionRequest(BaseModel):
+    model: str
+    messages: list[ChatMessage]
+    max_tokens: Optional[int] = None
+    max_completion_tokens: Optional[int] = None
+    temperature: Optional[float] = None
+    top_p: Optional[float] = None
+    top_k: Optional[int] = None  # extension accepted at top level too
+    n: Optional[int] = 1
+    stream: bool = False
+    stream_options: Optional[StreamOptions] = None
+    stop: Union[str, list[str], None] = None
+    seed: Optional[int] = None
+    frequency_penalty: Optional[float] = None
+    presence_penalty: Optional[float] = None
+    logprobs: Optional[bool] = None
+    ext: Optional[Ext] = None
+    nvext: Optional[Ext] = None  # accepted alias for drop-in compatibility
+
+    @property
+    def extension(self) -> Ext:
+        return self.ext or self.nvext or Ext()
+
+    @property
+    def effective_max_tokens(self) -> Optional[int]:
+        return self.max_completion_tokens or self.max_tokens
+
+
+class CompletionRequest(BaseModel):
+    model: str
+    prompt: Union[str, list[str], list[int]]
+    max_tokens: Optional[int] = 16
+    temperature: Optional[float] = None
+    top_p: Optional[float] = None
+    top_k: Optional[int] = None
+    n: Optional[int] = 1
+    stream: bool = False
+    stream_options: Optional[StreamOptions] = None
+    stop: Union[str, list[str], None] = None
+    seed: Optional[int] = None
+    echo: Optional[bool] = False
+    ext: Optional[Ext] = None
+    nvext: Optional[Ext] = None
+
+    @property
+    def extension(self) -> Ext:
+        return self.ext or self.nvext or Ext()
+
+
+class EmbeddingRequest(BaseModel):
+    model: str
+    input: Union[str, list[str], list[int], list[list[int]]]
+    encoding_format: Optional[str] = "float"
+
+
+class Usage(BaseModel):
+    prompt_tokens: int = 0
+    completion_tokens: int = 0
+    total_tokens: int = 0
+
+
+class ChatChoiceDelta(BaseModel):
+    role: Optional[str] = None
+    content: Optional[str] = None
+
+
+class ChatStreamChoice(BaseModel):
+    index: int = 0
+    delta: ChatChoiceDelta = Field(default_factory=ChatChoiceDelta)
+    finish_reason: Optional[str] = None
+
+
+class ChatCompletionChunk(BaseModel):
+    id: str
+    object: str = "chat.completion.chunk"
+    created: int = 0
+    model: str = ""
+    choices: list[ChatStreamChoice] = Field(default_factory=list)
+    usage: Optional[Usage] = None
+
+
+class ChatChoice(BaseModel):
+    index: int = 0
+    message: ChatMessage = Field(default_factory=lambda: ChatMessage(role="assistant", content=""))
+    finish_reason: Optional[str] = None
+
+
+class ChatCompletionResponse(BaseModel):
+    id: str
+    object: str = "chat.completion"
+    created: int = 0
+    model: str = ""
+    choices: list[ChatChoice] = Field(default_factory=list)
+    usage: Optional[Usage] = None
+
+
+class CompletionChoice(BaseModel):
+    index: int = 0
+    text: str = ""
+    finish_reason: Optional[str] = None
+
+
+class CompletionResponse(BaseModel):
+    id: str
+    object: str = "text_completion"
+    created: int = 0
+    model: str = ""
+    choices: list[CompletionChoice] = Field(default_factory=list)
+    usage: Optional[Usage] = None
+
+
+class ModelInfo(BaseModel):
+    id: str
+    object: str = "model"
+    created: int = 0
+    owned_by: str = "dynamo-tpu"
+
+
+class ModelList(BaseModel):
+    object: str = "list"
+    data: list[ModelInfo] = Field(default_factory=list)
+
+
+def new_request_id(prefix: str = "cmpl") -> str:
+    return f"{prefix}-{uuid.uuid4().hex}"
+
+
+def now() -> int:
+    return int(time.time())
+
+
+# -- SSE ---------------------------------------------------------------------
+
+
+def sse_event(data: BaseModel | dict) -> bytes:
+    if isinstance(data, BaseModel):
+        body = data.model_dump_json(exclude_none=True)
+    else:
+        body = json.dumps(data)
+    return f"data: {body}\n\n".encode()
+
+
+SSE_DONE = b"data: [DONE]\n\n"
+
+
+def aggregate_chat_stream(
+    chunks: list[ChatCompletionChunk], model: str, request_id: str
+) -> ChatCompletionResponse:
+    """Fold a chunk stream into a non-streaming response."""
+    text = []
+    finish = None
+    usage = None
+    for ch in chunks:
+        for choice in ch.choices:
+            if choice.delta.content:
+                text.append(choice.delta.content)
+            if choice.finish_reason:
+                finish = choice.finish_reason
+        if ch.usage is not None:
+            usage = ch.usage
+    return ChatCompletionResponse(
+        id=request_id,
+        created=now(),
+        model=model,
+        choices=[
+            ChatChoice(
+                message=ChatMessage(role="assistant", content="".join(text)),
+                finish_reason=finish,
+            )
+        ],
+        usage=usage,
+    )

@@ -81,10 +81,16 @@ class EndpointRegistration:
     """A live (endpoint × lease) registration; revoking the lease (or the
     process dying and missing keepalives) erases it everywhere."""
 
-    def __init__(self, fabric, instance: Instance, lease_id: str):
+    def __init__(
+        self, fabric, instance: Instance, lease_id: str, owns_lease: bool
+    ):
         self.fabric = fabric
         self.instance = instance
         self.lease_id = lease_id
+        #: False when riding the process's shared primary lease — then
+        #: deregister only deletes this key (revoking would erase every
+        #: registration of the process).
+        self.owns_lease = owns_lease
 
     @classmethod
     async def register(
@@ -99,6 +105,7 @@ class EndpointRegistration:
         lease_ttl: float = DEFAULT_LEASE_TTL,
         lease_id: Optional[str] = None,
     ) -> "EndpointRegistration":
+        owns_lease = lease_id is None
         if lease_id is None:
             lease_id = await fabric.grant_lease(lease_ttl)
         inst = Instance(
@@ -112,10 +119,12 @@ class EndpointRegistration:
         )
         await fabric.put(inst.path, inst.pack(), lease_id=lease_id)
         logger.info("registered %s at %s:%d", inst.path, host, port)
-        return cls(fabric, inst, lease_id)
+        return cls(fabric, inst, lease_id, owns_lease)
 
     async def deregister(self) -> None:
-        await self.fabric.revoke_lease(self.lease_id)
+        await self.fabric.delete(self.instance.path)
+        if self.owns_lease:
+            await self.fabric.revoke_lease(self.lease_id)
 
 
 class InstanceSource:

@@ -129,7 +129,24 @@ class RemoteFabric:
         await self._call(
             {"op": "kv.watch", "prefix": prefix, "watch_id": watch_id}
         )
+
+        # closing the local Watch tears down the server-side pump too
+        orig_close = w.close
+
+        def close_with_unwatch():
+            orig_close()
+            self._watches.pop(watch_id, None)
+            if self._writer is not None and not self._writer.is_closing():
+                asyncio.get_running_loop().create_task(self._unwatch(watch_id))
+
+        w.close = close_with_unwatch  # type: ignore[method-assign]
         return w
+
+    async def _unwatch(self, watch_id: int) -> None:
+        try:
+            await self._call({"op": "kv.unwatch", "watch_id": watch_id})
+        except Exception:
+            pass
 
     # -- leases ------------------------------------------------------------
 
@@ -177,7 +194,23 @@ class RemoteFabric:
         s = Subscription(subject)
         self._subs[sub_id] = s
         await self._call({"op": "bus.sub", "subject": subject, "sub_id": sub_id})
+
+        orig_close = s.close
+
+        def close_with_unsub():
+            orig_close()
+            self._subs.pop(sub_id, None)
+            if self._writer is not None and not self._writer.is_closing():
+                asyncio.get_running_loop().create_task(self._unsub(sub_id))
+
+        s.close = close_with_unsub  # type: ignore[method-assign]
         return s
+
+    async def _unsub(self, sub_id: int) -> None:
+        try:
+            await self._call({"op": "bus.unsub", "sub_id": sub_id})
+        except Exception:
+            pass
 
     # -- queue -------------------------------------------------------------
 

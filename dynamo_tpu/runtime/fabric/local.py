@@ -98,12 +98,22 @@ class LocalFabric:
 
     async def queue_pop(self, queue, timeout=None):
         q = self._q(queue)
+        deadline = (
+            asyncio.get_running_loop().time() + timeout
+            if timeout is not None
+            else None
+        )
         while True:
             item = q.pop_nowait()
             if item is not None:
                 return item
+            remaining = None
+            if deadline is not None:
+                remaining = deadline - asyncio.get_running_loop().time()
+                if remaining <= 0:
+                    return None
             try:
-                await asyncio.wait_for(q.event.wait(), timeout)
+                await asyncio.wait_for(q.event.wait(), remaining)
             except asyncio.TimeoutError:
                 return None
 

@@ -32,6 +32,7 @@ class _Conn:
         self.watches: dict[int, Any] = {}  # watch_id -> (Watch, pump task)
         self.subs: dict[int, Any] = {}  # sub_id -> (Subscription, pump task)
         self.inflight: set[tuple[str, str]] = set()  # (queue, item_id)
+        self.tasks: set[asyncio.Task] = set()  # pending op dispatches
         self.lock = asyncio.Lock()
 
     async def send(self, header: Any, payload: bytes = b"") -> None:
@@ -78,9 +79,11 @@ class FabricServer:
         try:
             while True:
                 header, payload = await read_frame(reader)
-                asyncio.get_running_loop().create_task(
+                t = asyncio.get_running_loop().create_task(
                     self._dispatch(conn, header, payload)
                 )
+                conn.tasks.add(t)
+                t.add_done_callback(conn.tasks.discard)
         except (asyncio.IncompleteReadError, ConnectionError):
             pass
         except Exception:
@@ -91,6 +94,10 @@ class FabricServer:
             writer.close()
 
     async def _cleanup(self, conn: _Conn) -> None:
+        # kill pending dispatches first (e.g. a blocked queue.pop would
+        # otherwise pop an item for this dead connection and strand it)
+        for t in list(conn.tasks):
+            t.cancel()
         for _, (w, task) in conn.watches.items():
             w.close()
             task.cancel()
@@ -175,13 +182,19 @@ class FabricServer:
                     await conn.send({"id": rid, "ok": True, "found": False})
                 else:
                     conn.inflight.add((h["queue"], item.item_id))
-                    await conn.send(
-                        {
-                            "id": rid, "ok": True, "found": True,
-                            "item_id": item.item_id, "header": item.header,
-                        },
-                        item.payload,
-                    )
+                    try:
+                        await conn.send(
+                            {
+                                "id": rid, "ok": True, "found": True,
+                                "item_id": item.item_id, "header": item.header,
+                            },
+                            item.payload,
+                        )
+                    except Exception:
+                        # consumer died between pop and send: put it back
+                        conn.inflight.discard((h["queue"], item.item_id))
+                        await f.queue_nack(h["queue"], item.item_id)
+                        raise
             elif op == "queue.ack":
                 conn.inflight.discard((h["queue"], h["item_id"]))
                 await f.queue_ack(h["queue"], h["item_id"])

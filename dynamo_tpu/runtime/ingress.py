@@ -63,9 +63,16 @@ class IngressServer:
                 header, payload = await read_frame(reader)
                 op = header.get("op")
                 if op == "call":
+                    # Register the context BEFORE yielding to the loop, so a
+                    # cancel frame buffered in the same read batch finds it.
+                    rid = header["request_id"]
+                    ctx = Context(
+                        request_id=rid, metadata=header.get("metadata") or {}
+                    )
+                    self._inflight[(conn_id, rid)] = ctx
                     t = asyncio.get_running_loop().create_task(
                         self._serve_call(
-                            conn_id, header, payload, writer, write_lock
+                            conn_id, ctx, header, payload, writer, write_lock
                         )
                     )
                     tasks.add(t)
@@ -88,14 +95,13 @@ class IngressServer:
             writer.close()
 
     async def _serve_call(
-        self, conn_id: int, header, payload: bytes, writer, write_lock
+        self, conn_id: int, ctx: Context, header, payload: bytes, writer,
+        write_lock,
     ) -> None:
         import msgpack
 
         rid = header["request_id"]
         endpoint = header.get("endpoint", "")
-        ctx = Context(request_id=rid, metadata=header.get("metadata") or {})
-        self._inflight[(conn_id, rid)] = ctx
 
         async def send(h, p=b""):
             async with write_lock:

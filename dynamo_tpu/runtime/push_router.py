@@ -184,7 +184,19 @@ class PushRouter:
                         except Exception:
                             pass
                         return
-                    item = await q.get()
+                    # race q.get() against cancellation so a cancel issued
+                    # while idle reaches the worker immediately
+                    get_task = asyncio.ensure_future(q.get())
+                    cancel_task = asyncio.ensure_future(ctx.token.wait())
+                    done, _ = await asyncio.wait(
+                        {get_task, cancel_task},
+                        return_when=asyncio.FIRST_COMPLETED,
+                    )
+                    cancel_task.cancel()
+                    if get_task not in done:
+                        get_task.cancel()
+                        continue  # loop re-checks ctx.cancelled and notifies
+                    item = get_task.result()
                     if item is None:  # connection dropped mid-stream
                         self.source.mark_down(inst.instance_id)
                         if got_data or attempts >= max_attempts:

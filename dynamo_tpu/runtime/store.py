@@ -117,6 +117,9 @@ class MemStore:
                 await self.revoke_lease(lease_id)
 
     def _notify(self, event: WatchEvent) -> None:
+        dead = [w for w in self._watches if w[1]._closed]
+        for entry in dead:
+            self._watches.remove(entry)
         for prefix, watch in self._watches:
             if event.key.startswith(prefix):
                 watch._push(event)
@@ -127,6 +130,11 @@ class MemStore:
         if lease_id is not None:
             if lease_id not in self._leases:
                 raise KeyError(f"unknown lease {lease_id}")
+        prev = self._data.get(key)
+        if prev is not None and prev.lease_id and prev.lease_id != lease_id:
+            # rebinding: the old lease's expiry must not delete the new entry
+            self._lease_keys.get(prev.lease_id, set()).discard(key)
+        if lease_id is not None:
             self._lease_keys.setdefault(lease_id, set()).add(key)
         self._data[key] = KvEntry(key, value, lease_id)
         self._notify(WatchEvent("put", key, value))

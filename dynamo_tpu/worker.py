@@ -1,0 +1,161 @@
+"""Engine worker process: engine + ingress + registration + publishers.
+
+One worker = one JaxEngine serving one model over the fabric. It:
+1. starts the engine thread (AsyncEngineRunner),
+2. serves `generate` (and `flush`) on its ingress,
+3. registers its endpoint instance under the process lease,
+4. publishes the model card + entry (register_llm),
+5. publishes KV events (subject kv_events.{instance_id}) and worker load
+   metrics (subject metrics.{component}) for routers/planner.
+
+Equivalent of the reference's engine-subprocess workers joining the
+runtime (launch/dynamo-run/src/subprocess/vllm_inc.py + endpoint.rs).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Optional
+
+import msgpack
+
+from dynamo_tpu.engine import EngineConfig
+from dynamo_tpu.engine.async_engine import AsyncEngineRunner, EchoEngine
+from dynamo_tpu.engine.engine import JaxEngine
+from dynamo_tpu.engine.page_table import KvEvent
+from dynamo_tpu.model_card import ModelDeploymentCard, register_llm
+from dynamo_tpu.preprocessor.preprocessor import PreprocessedRequest
+from dynamo_tpu.runtime import DistributedRuntime, IngressServer
+
+logger = logging.getLogger(__name__)
+
+KV_EVENT_SUBJECT = "kv_events"
+METRICS_SUBJECT = "metrics"
+
+
+class Worker:
+    def __init__(
+        self,
+        runtime: DistributedRuntime,
+        card: ModelDeploymentCard,
+        engine_config: Optional[EngineConfig] = None,
+        engine_kind: str = "jax",
+        namespace: str = "dynamo",
+        component: str = "backend",
+        endpoint: str = "generate",
+        checkpoint_path: Optional[str] = None,
+        metrics_interval: float = 1.0,
+    ):
+        self.runtime = runtime
+        self.card = card
+        self.engine_config = engine_config
+        self.engine_kind = engine_kind
+        self.namespace = namespace
+        self.component = component
+        self.endpoint_name = endpoint
+        self.checkpoint_path = checkpoint_path
+        self.metrics_interval = metrics_interval
+        self.ingress = IngressServer()
+        self.runner: Optional[AsyncEngineRunner] = None
+        self.echo: Optional[EchoEngine] = None
+        self.registration = None
+        self.instance_id: str = ""
+        self._kv_event_buffer: list[KvEvent] = []
+        self._tasks: list[asyncio.Task] = []
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def start(self) -> None:
+        if self.engine_kind == "echo":
+            self.echo = EchoEngine()
+        else:
+            engine = JaxEngine(
+                self.engine_config,
+                on_kv_event=self._kv_event_buffer.append,
+                checkpoint_path=self.checkpoint_path,
+            )
+            self.runner = AsyncEngineRunner(engine)
+            self.runner.start()
+
+        self.ingress.add_handler("generate", self._generate)
+        self.ingress.add_handler("flush", self._flush)
+        await self.ingress.start()
+
+        ep = (
+            self.runtime.namespace(self.namespace)
+            .component(self.component)
+            .endpoint(self.endpoint_name)
+        )
+        self.registration = await ep.register(
+            "127.0.0.1", self.ingress.port, metadata={"model": self.card.name}
+        )
+        self.instance_id = self.registration.instance.instance_id
+        await register_llm(
+            self.runtime.fabric, self.card, self.namespace, self.component,
+            self.endpoint_name, lease_id=self.runtime.primary_lease,
+        )
+        loop = asyncio.get_running_loop()
+        self._tasks.append(loop.create_task(self._publish_loop()))
+        logger.info(
+            "worker %s serving %s on :%d", self.instance_id, self.card.name,
+            self.ingress.port,
+        )
+
+    async def stop(self) -> None:
+        for t in self._tasks:
+            t.cancel()
+        await self.ingress.stop()
+        if self.runner:
+            self.runner.stop()
+
+    # -- handlers ----------------------------------------------------------
+
+    async def _generate(self, ctx, request: dict):
+        pre = PreprocessedRequest.from_dict(request)
+        gen = (self.echo or self.runner).generate(ctx, pre)
+        async for event in gen:
+            yield event
+
+    async def _flush(self, ctx, request):
+        n = 0
+        if self.runner is not None:
+            n = self.runner.engine.allocator.clear_cache()
+        yield {"cleared_pages": n}
+
+    # -- publishers --------------------------------------------------------
+
+    async def _publish_loop(self) -> None:
+        """Ship buffered KV events + a load-metrics snapshot periodically
+        (reference: KvEventPublisher publisher.rs:99 + WorkerMetricsPublisher
+        :463; events ride the bus, scrape-free)."""
+        fabric = self.runtime.fabric
+        while True:
+            await asyncio.sleep(self.metrics_interval)
+            events, self._kv_event_buffer = self._kv_event_buffer, []
+            if events:
+                payload = msgpack.packb(
+                    [
+                        {
+                            "kind": e.kind,
+                            "block_hashes": list(e.block_hashes),
+                            "parent_hash": e.parent_hash,
+                            "token_blocks": [list(t) for t in e.token_blocks],
+                        }
+                        for e in events
+                    ],
+                    use_bin_type=True,
+                )
+                await fabric.publish(
+                    f"{KV_EVENT_SUBJECT}.{self.instance_id}",
+                    {"instance_id": self.instance_id, "count": len(events)},
+                    payload,
+                )
+            if self.runner is not None:
+                m = self.runner.metrics.to_dict()
+                m["instance_id"] = self.instance_id
+                m["model"] = self.card.name
+                await fabric.publish(
+                    f"{METRICS_SUBJECT}.{self.component}.{self.instance_id}",
+                    m,
+                )

@@ -1,0 +1,259 @@
+"""HTTP frontend e2e: real aiohttp server + client.
+
+Single-process (echo + mock engine) and fully distributed (fabric server +
+worker process registration + ModelWatcher attach) paths, streaming and
+unary, metrics exposition (reference test model: lib/llm/tests/
+http-service.rs — real server + counting engine + Prometheus asserts).
+"""
+
+import asyncio
+import json
+
+import aiohttp
+import pytest
+
+from dynamo_tpu.engine.async_engine import EchoEngine
+from dynamo_tpu.frontend import HttpService, ModelManager
+from dynamo_tpu.frontend.service import ModelWatcher, local_pipeline
+from dynamo_tpu.model_card import ModelDeploymentCard
+from dynamo_tpu.mocker import MockEngine
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def _card(name="echo-model"):
+    return ModelDeploymentCard(name=name, tokenizer={"kind": "byte"}, context_length=512)
+
+
+async def _start_local(engine, name="echo-model"):
+    manager = ModelManager()
+    manager.add(name, local_pipeline(_card(name), engine))
+    svc = HttpService(manager, host="127.0.0.1", port=0)
+    await svc.start()
+    return svc
+
+
+def test_models_health_metrics_endpoints():
+    async def main():
+        svc = await _start_local(EchoEngine())
+        base = f"http://127.0.0.1:{svc.port}"
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"{base}/health") as r:
+                assert r.status == 200
+                assert (await r.json())["models"] == ["echo-model"]
+            async with s.get(f"{base}/v1/models") as r:
+                data = await r.json()
+                assert data["data"][0]["id"] == "echo-model"
+            async with s.get(f"{base}/metrics") as r:
+                assert r.status == 200
+        await svc.stop()
+
+    run(main())
+
+
+def test_chat_unary_echo():
+    async def main():
+        svc = await _start_local(EchoEngine())
+        base = f"http://127.0.0.1:{svc.port}"
+        async with aiohttp.ClientSession() as s:
+            body = {
+                "model": "echo-model",
+                "messages": [{"role": "user", "content": "hello"}],
+                "max_tokens": 500,
+            }
+            async with s.post(f"{base}/v1/chat/completions", json=body) as r:
+                assert r.status == 200
+                data = await r.json()
+                content = data["choices"][0]["message"]["content"]
+                # echo engine returns the templated prompt text
+                assert "user: hello" in content
+                assert data["usage"]["completion_tokens"] > 0
+        await svc.stop()
+
+    run(main())
+
+
+def test_chat_streaming_sse():
+    async def main():
+        svc = await _start_local(EchoEngine())
+        base = f"http://127.0.0.1:{svc.port}"
+        async with aiohttp.ClientSession() as s:
+            body = {
+                "model": "echo-model",
+                "messages": [{"role": "user", "content": "abc"}],
+                "stream": True,
+                "stream_options": {"include_usage": True},
+            }
+            events = []
+            async with s.post(f"{base}/v1/chat/completions", json=body) as r:
+                assert r.status == 200
+                assert r.headers["Content-Type"].startswith("text/event-stream")
+                async for line in r.content:
+                    line = line.decode().strip()
+                    if line.startswith("data: "):
+                        events.append(line[6:])
+            assert events[-1] == "[DONE]"
+            parsed = [json.loads(e) for e in events[:-1]]
+            text = "".join(
+                c.get("delta", {}).get("content") or ""
+                for p in parsed
+                for c in p["choices"]
+            )
+            assert "user: abc" in text
+            usage = [p["usage"] for p in parsed if p.get("usage")]
+            assert usage and usage[-1]["completion_tokens"] > 0
+
+        # metrics recorded
+        async with aiohttp.ClientSession() as s:
+            async with s.get(f"{base}/metrics") as r:
+                body = await r.text()
+                assert 'requests_total{model="echo-model"' in body
+                assert "time_to_first_token_seconds" in body
+        await svc.stop()
+
+    run(main())
+
+
+def test_unknown_model_404_and_bad_json_400():
+    async def main():
+        svc = await _start_local(EchoEngine())
+        base = f"http://127.0.0.1:{svc.port}"
+        async with aiohttp.ClientSession() as s:
+            async with s.post(
+                f"{base}/v1/chat/completions",
+                json={"model": "nope", "messages": [{"role": "user", "content": "x"}]},
+            ) as r:
+                assert r.status == 404
+            async with s.post(
+                f"{base}/v1/chat/completions", data=b"{not json"
+            ) as r:
+                assert r.status == 400
+            async with s.post(
+                f"{base}/v1/chat/completions", json={"model": "echo-model"}
+            ) as r:
+                assert r.status == 400  # missing messages
+        await svc.stop()
+
+    run(main())
+
+
+def test_completions_endpoint_with_mock_engine():
+    async def main():
+        svc = await _start_local(MockEngine(), name="mock-model")
+        base = f"http://127.0.0.1:{svc.port}"
+        async with aiohttp.ClientSession() as s:
+            body = {"model": "mock-model", "prompt": "once upon", "max_tokens": 8}
+            async with s.post(f"{base}/v1/completions", json=body) as r:
+                assert r.status == 200
+                data = await r.json()
+                assert data["object"] == "text_completion"
+                assert isinstance(data["choices"][0]["text"], str)
+        await svc.stop()
+
+    run(main())
+
+
+def test_distributed_frontend_worker_via_fabric():
+    """Full distributed slice in-process: fabric server, echo worker that
+    registers a model card, frontend attaching it via ModelWatcher."""
+
+    async def main():
+        from dynamo_tpu.runtime import DistributedRuntime
+        from dynamo_tpu.runtime.fabric import FabricServer
+        from dynamo_tpu.worker import Worker
+
+        fabric_server = FabricServer(port=0)
+        await fabric_server.start()
+
+        rt_worker = await DistributedRuntime.create(fabric_server.address)
+        worker = Worker(
+            rt_worker, _card("dist-model"), engine_kind="echo",
+            namespace="ns", component="backend", endpoint="generate",
+        )
+        await worker.start()
+
+        rt_front = await DistributedRuntime.create(fabric_server.address)
+        manager = ModelManager()
+        watcher = ModelWatcher(rt_front, manager)
+        await watcher.start()
+        for _ in range(50):
+            if manager.get("dist-model"):
+                break
+            await asyncio.sleep(0.05)
+        assert manager.get("dist-model") is not None
+
+        svc = HttpService(manager, host="127.0.0.1", port=0)
+        await svc.start()
+        base = f"http://127.0.0.1:{svc.port}"
+        async with aiohttp.ClientSession() as s:
+            body = {
+                "model": "dist-model",
+                "messages": [{"role": "user", "content": "over the wire"}],
+                "max_tokens": 400,
+            }
+            async with s.post(f"{base}/v1/chat/completions", json=body) as r:
+                assert r.status == 200
+                data = await r.json()
+                assert "over the wire" in data["choices"][0]["message"]["content"]
+
+        # worker death detaches the model (lease-driven)
+        await worker.stop()
+        await rt_worker.close()
+        for _ in range(80):
+            if manager.get("dist-model") is None:
+                break
+            await asyncio.sleep(0.05)
+        assert manager.get("dist-model") is None
+
+        await svc.stop()
+        await watcher.stop()
+        await rt_front.close()
+        await fabric_server.stop()
+
+    run(main())
+
+
+def test_http_with_real_jax_engine():
+    """Whole single-process slice: HTTP -> preprocess -> JaxEngine(tiny)
+    -> detokenize -> SSE, on the CPU platform."""
+
+    async def main():
+        from dynamo_tpu.engine import EngineConfig
+        from dynamo_tpu.engine.async_engine import AsyncEngineRunner
+        from dynamo_tpu.engine.engine import JaxEngine
+
+        engine = JaxEngine(EngineConfig.for_tests())
+        runner = AsyncEngineRunner(engine)
+        runner.start()
+        manager = ModelManager()
+        card = ModelDeploymentCard(
+            name="tiny", tokenizer={"kind": "byte"}, context_length=32
+        )
+        manager.add("tiny", local_pipeline(card, runner))
+        svc = HttpService(manager, host="127.0.0.1", port=0)
+        await svc.start()
+        base = f"http://127.0.0.1:{svc.port}"
+        async with aiohttp.ClientSession() as s:
+            body = {
+                "model": "tiny",
+                "prompt": "ab",
+                "max_tokens": 5,
+                "ext": {"ignore_eos": True},
+            }
+            async with s.post(f"{base}/v1/completions", json=body) as r:
+                assert r.status == 200
+                data = await r.json()
+                assert data["usage"]["completion_tokens"] == 5
+            # over-long prompt -> 400 with clear error
+            async with s.post(
+                f"{base}/v1/completions",
+                json={"model": "tiny", "prompt": "x" * 40, "max_tokens": 2},
+            ) as r:
+                assert r.status == 400
+                assert "context window" in (await r.json())["error"]
+        await svc.stop()
+        runner.stop()
+
+    run(main())

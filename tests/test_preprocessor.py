@@ -1,0 +1,117 @@
+"""Preprocessor: tokenizers, incremental detokenize, stop strings,
+request mapping."""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.preprocessor import (
+    ByteTokenizer,
+    DecodeStream,
+    OpenAIPreprocessor,
+    StopChecker,
+)
+from dynamo_tpu.protocols.openai import (
+    ChatCompletionRequest,
+    ChatMessage,
+    CompletionRequest,
+    Ext,
+)
+
+
+def test_byte_tokenizer_roundtrip():
+    t = ByteTokenizer()
+    s = "hello, wörld! 你好"
+    assert t.decode(t.encode(s)) == s
+
+
+def test_decode_stream_multibyte_held_back():
+    t = ByteTokenizer()
+    ds = DecodeStream(t)
+    # '你' is 3 bytes in utf-8: first two steps emit nothing, third emits it
+    ids = t.encode("你")
+    assert ds.step(ids[0]) == ""
+    assert ds.step(ids[1]) == ""
+    assert ds.step(ids[2]) == "你"
+    assert ds.text == "你"
+    # ascii after flows immediately
+    assert ds.step(ord("!")) == "!"
+
+
+def test_stop_checker_straddles_chunks():
+    c = StopChecker(["END"])
+    assert c.feed("hello E") == "hello "
+    assert c.feed("N") == ""  # still could be END
+    assert c.feed("D trailing") == ""
+    assert c.stopped
+    # no double emission after stop
+    assert c.feed("more") == ""
+
+
+def test_stop_checker_false_prefix_released():
+    c = StopChecker(["END"])
+    assert c.feed("foo E") == "foo "
+    out = c.feed("Nx bar")  # ENx — not END: held text must be released
+    assert out == "ENx bar"
+    assert not c.stopped
+    assert c.flush() == ""
+
+
+def test_stop_checker_flush_releases_tail():
+    c = StopChecker(["STOP"])
+    assert c.feed("abc ST") == "abc "
+    assert c.flush() == "ST"
+
+
+def test_preprocess_chat_and_completion():
+    t = ByteTokenizer()
+    p = OpenAIPreprocessor(t, model_name="m")
+    req = ChatCompletionRequest(
+        model="m",
+        messages=[ChatMessage(role="user", content="hi")],
+        max_tokens=7,
+        temperature=0.5,
+        seed=3,
+        stop=["X"],
+        ext=Ext(ignore_eos=True),
+    )
+    pre = p.preprocess_chat(req)
+    assert t.decode(pre.token_ids).endswith("assistant:")
+    assert "user: hi" in t.decode(pre.token_ids)
+    assert pre.max_tokens == 7 and pre.temperature == 0.5 and pre.seed == 3
+    assert pre.stop_strings == ["X"] and pre.ignore_eos
+
+    comp = CompletionRequest(model="m", prompt="abc", max_tokens=3)
+    pre2 = p.preprocess_completion(comp)
+    assert pre2.token_ids == t.encode("abc")
+    # token-id prompt passthrough
+    comp3 = CompletionRequest(model="m", prompt=[1, 2, 3])
+    assert p.preprocess_completion(comp3).token_ids == [1, 2, 3]
+
+
+def test_postprocess_stream_stop_string():
+    t = ByteTokenizer()
+    p = OpenAIPreprocessor(t, model_name="m")
+
+    async def engine_stream():
+        for ch in "abSTOPcd":
+            yield {"token_ids": [ord(ch)], "finish_reason": None}
+        yield {"token_ids": [], "finish_reason": "length"}
+
+    async def main():
+        pre = p.preprocess_completion(
+            CompletionRequest(model="m", prompt="x", stop=["STOP"])
+        )
+        chunks = [
+            c
+            async for c in p.postprocess_chat_stream(
+                engine_stream(), "rid", pre
+            )
+        ]
+        text = "".join(c.choices[0].delta.content or "" for c in chunks)
+        finish = [c.choices[0].finish_reason for c in chunks if c.choices[0].finish_reason]
+        return text, finish
+
+    text, finish = asyncio.run(main())
+    assert text == "ab"
+    assert finish == ["stop"]
