@@ -41,6 +41,14 @@ def _engine_config(args) -> EngineConfig:
     )
 
 
+def _disagg_config(args):
+    if not args.disagg:
+        return None
+    from dynamo_tpu.disagg import DisaggConfig
+
+    return DisaggConfig(max_local_prefill_length=args.max_local_prefill)
+
+
 def _card(args):
     from dynamo_tpu.model_card import ModelDeploymentCard
 
@@ -165,6 +173,20 @@ async def _run_worker(args) -> None:
     from dynamo_tpu.worker import Worker
 
     rt = await DistributedRuntime.create(args.fabric)
+    if args.role == "prefill":
+        from dynamo_tpu.disagg.prefill_worker import PrefillWorker
+
+        pw = PrefillWorker(
+            rt, _engine_config(args), namespace=args.namespace,
+            checkpoint_path=args.checkpoint,
+        )
+        await pw.start()
+        print(f"prefill worker {pw.instance_id} up (model={args.model})", flush=True)
+        try:
+            await asyncio.Event().wait()
+        finally:
+            await pw.stop()
+        return
     worker = Worker(
         rt,
         _card(args),
@@ -174,6 +196,9 @@ async def _run_worker(args) -> None:
         component=args.component,
         endpoint=args.endpoint,
         checkpoint_path=args.checkpoint,
+        router_mode=args.router_mode,
+        enable_disagg=args.disagg,
+        disagg_config=_disagg_config(args),
     )
     await worker.start()
     print(f"worker {worker.instance_id} up (model={args.model})", flush=True)
@@ -194,6 +219,23 @@ def main(argv: Optional[list[str]] = None) -> None:
     runp.add_argument("--fabric", default=None, help="fabric server host:port")
     runp.add_argument("--host", default="127.0.0.1")
     runp.add_argument("--port", type=int, default=8080)
+    runp.add_argument(
+        "--router-mode", default="round_robin", dest="router_mode",
+        choices=["round_robin", "random", "kv"],
+        help="how frontends route to this worker's endpoint",
+    )
+    runp.add_argument(
+        "--role", default="decode", choices=["decode", "prefill"],
+        help="worker role when in=dyn (prefill = queue consumer)",
+    )
+    runp.add_argument(
+        "--disagg", action="store_true",
+        help="decode worker: send long prefills to the prefill fleet",
+    )
+    runp.add_argument(
+        "--max-local-prefill", type=int, default=512, dest="max_local_prefill",
+        help="uncached prefill tokens above which prefill goes remote",
+    )
     runp.add_argument("--namespace", default="dynamo")
     runp.add_argument("--component", default="backend")
     runp.add_argument("--endpoint", default="generate")

@@ -1,0 +1,28 @@
+"""Disaggregated prefill/decode serving.
+
+Long prompts stall decode batches: one chunked prefill shares engine steps
+with every decoding sequence. Disaggregation moves qualifying prefills to
+dedicated prefill workers: the decode worker reserves KV pages, enqueues a
+RemotePrefillRequest on a shared durable queue, any prefill worker computes
+the prompt KV and writes it straight into the reserved pages through the
+KV transfer plane, and decode continues from the first sampled token
+(capability parity with the reference's disagg serving —
+/root/reference lib/llm/src/disagg_router.rs, examples/llm prefill_queue.py
++ prefill_worker.py, docs dynamo_flow.md:12-44 — with the NIXL RDMA write
+replaced by an explicit page-transfer service; on TPU the same interface
+can ride ICI collectives intra-slice or DCN streams across slices).
+"""
+
+from dynamo_tpu.disagg.prefill_queue import PrefillQueue
+from dynamo_tpu.disagg.protocol import RemotePrefillRequest
+from dynamo_tpu.disagg.router import DisaggConfig, DisaggregatedRouter
+from dynamo_tpu.disagg.transfer import KvTransferClient, KvTransferServer
+
+__all__ = [
+    "DisaggConfig",
+    "DisaggregatedRouter",
+    "KvTransferClient",
+    "KvTransferServer",
+    "PrefillQueue",
+    "RemotePrefillRequest",
+]

@@ -1,0 +1,45 @@
+"""Shared durable prefill queue over the fabric work queue.
+
+Prefill workers are stateless competing consumers: any of them can pop any
+item, and un-acked items are redelivered if a worker dies mid-prefill
+(reference: PrefillQueue over NATS JetStream —
+examples/llm/utils/prefill_queue.py:24, transports/nats.rs NatsQueue :345).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from dynamo_tpu.disagg.protocol import RemotePrefillRequest
+
+DEFAULT_QUEUE = "prefill_queue"
+
+
+class PrefillQueue:
+    def __init__(self, fabric, name: str = DEFAULT_QUEUE):
+        self.fabric = fabric
+        self.name = name
+
+    async def push(self, req: RemotePrefillRequest) -> None:
+        await self.fabric.queue_push(
+            self.name, {"request_id": req.request_id}, req.pack()
+        )
+
+    async def pop(
+        self, timeout: Optional[float] = None
+    ) -> Optional[tuple[str, RemotePrefillRequest]]:
+        """Returns (item_id, request); ack(item_id) when the transfer lands,
+        nack(item_id) to redeliver."""
+        item = await self.fabric.queue_pop(self.name, timeout=timeout)
+        if item is None:
+            return None
+        return item.item_id, RemotePrefillRequest.unpack(item.payload)
+
+    async def ack(self, item_id: str) -> None:
+        await self.fabric.queue_ack(self.name, item_id)
+
+    async def nack(self, item_id: str) -> None:
+        await self.fabric.queue_nack(self.name, item_id)
+
+    async def depth(self) -> int:
+        return await self.fabric.queue_len(self.name)

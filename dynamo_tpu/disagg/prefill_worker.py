@@ -1,0 +1,197 @@
+"""Prefill worker: stateless competing consumer of the shared prefill queue.
+
+Pops a RemotePrefillRequest, runs the prompt through its local engine (one
+sampled token, pages held), ships the KV pages to the decode worker's
+transfer server, releases, acks. Any number of these can run; un-acked
+items redeliver if one dies mid-prefill (reference:
+examples/llm/components/prefill_worker.py:139 prefill_queue_handler).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Optional
+
+from dynamo_tpu.disagg.prefill_queue import PrefillQueue
+from dynamo_tpu.disagg.protocol import RemotePrefillRequest
+from dynamo_tpu.disagg.transfer import KvTransferClient
+from dynamo_tpu.engine import EngineConfig
+from dynamo_tpu.engine.async_engine import AsyncEngineRunner
+from dynamo_tpu.engine.engine import JaxEngine
+from dynamo_tpu.engine.request import SamplingParams
+from dynamo_tpu.runtime import DistributedRuntime
+
+logger = logging.getLogger(__name__)
+
+
+class PrefillWorker:
+    def __init__(
+        self,
+        runtime: DistributedRuntime,
+        engine_config: EngineConfig,
+        namespace: str = "dynamo",
+        component: str = "prefill",
+        queue_name: str = "prefill_queue",
+        max_concurrent: int = 4,
+        checkpoint_path: Optional[str] = None,
+        runner: Optional[AsyncEngineRunner] = None,
+    ):
+        self.runtime = runtime
+        self.engine_config = engine_config
+        self.namespace = namespace
+        self.component = component
+        self.queue = PrefillQueue(runtime.fabric, queue_name)
+        self.transfer = KvTransferClient()
+        self.max_concurrent = max_concurrent
+        self.checkpoint_path = checkpoint_path
+        self.runner = runner
+        self.registration = None
+        self.instance_id = ""
+        self.prefills_done = 0
+        self._task: Optional[asyncio.Task] = None
+        self._sem = asyncio.Semaphore(max_concurrent)
+
+    async def start(self) -> None:
+        if self.runner is None:
+            # off-loop: engine init blocks for seconds and would starve the
+            # fabric lease keepalives (see Worker.start)
+            engine = await asyncio.get_running_loop().run_in_executor(
+                None,
+                lambda: JaxEngine(
+                    self.engine_config, checkpoint_path=self.checkpoint_path
+                ),
+            )
+            self.runner = AsyncEngineRunner(engine)
+            self.runner.start()
+        # Register for liveness/planner visibility (no ingress: work arrives
+        # via the queue, not pushed RPC).
+        ep = (
+            self.runtime.namespace(self.namespace)
+            .component(self.component)
+            .endpoint("prefill")
+        )
+        self.registration = await ep.register(
+            "127.0.0.1", 0, metadata={"model": self.engine_config.model}
+        )
+        self.instance_id = self.registration.instance.instance_id
+        self._task = asyncio.get_running_loop().create_task(self._consume_loop())
+        logger.info("prefill worker %s consuming %s", self.instance_id, self.queue.name)
+
+    MAX_ATTEMPTS = 3
+
+    async def _consume_loop(self) -> None:
+        while True:
+            try:
+                popped = await self.queue.pop(timeout=1.0)
+            except asyncio.CancelledError:
+                return
+            except Exception:
+                logger.exception("prefill queue pop failed; retrying")
+                await asyncio.sleep(0.5)
+                continue
+            if popped is None:
+                continue
+            await self._sem.acquire()
+            task = asyncio.get_running_loop().create_task(self._handle(*popped))
+            task.add_done_callback(
+                lambda t: t.cancelled() or t.exception()  # observe, never raise
+            )
+
+    async def _handle(self, item_id: str, req: RemotePrefillRequest) -> None:
+        try:
+            await self._prefill_and_transfer(req)
+            await self.queue.ack(item_id)
+            self.prefills_done += 1
+        except Exception:
+            logger.exception("remote prefill %s failed", req.request_id)
+            # Bounded retry: requeue a fresh copy with attempts+1 and ack the
+            # original, so a permanently-failing item (dead decode worker,
+            # config skew) can't cycle through the fleet forever.
+            try:
+                if req.attempts + 1 < self.MAX_ATTEMPTS:
+                    req.attempts += 1
+                    await self.queue.push(req)
+                else:
+                    logger.error(
+                        "dropping prefill %s after %d attempts",
+                        req.request_id, req.attempts + 1,
+                    )
+                await self.queue.ack(item_id)
+            except Exception:
+                logger.exception("requeue of %s failed", req.request_id)
+        finally:
+            self._sem.release()
+
+    async def _prefill_and_transfer(self, req: RemotePrefillRequest) -> None:
+        rid = req.request_id
+        runner = self.runner
+        if req.model and req.model != self.engine_config.model:
+            raise RuntimeError(
+                f"model mismatch: request for {req.model!r}, this prefill "
+                f"worker serves {self.engine_config.model!r}"
+            )
+        s = req.sampling
+        sampling = SamplingParams(
+            temperature=float(s.get("temperature", 0.0)),
+            top_p=float(s.get("top_p", 1.0)),
+            top_k=int(s.get("top_k", 0)),
+            seed=s.get("seed"),
+            max_tokens=1,
+            ignore_eos=True,  # always produce the one token; decode applies stops
+        )
+        out_q = runner.watch_request(rid)
+
+        def _add(eng):
+            r = eng.add_request(rid, req.token_ids, sampling)
+            r.hold_pages = True
+            return r
+
+        await runner.submit(_add)
+        first_token: Optional[int] = None
+        try:
+            while True:
+                item = await out_q.get()
+                if item is None:
+                    break
+                if "error" in item:
+                    raise RuntimeError(item["error"])
+                if item.get("token_ids"):
+                    first_token = item["token_ids"][0]
+        finally:
+            runner.unwatch_request(rid)
+        if first_token is None:
+            raise RuntimeError(f"prefill of {rid} produced no token")
+
+        def _extract(eng):
+            pages = eng.scheduler.held.get(rid)
+            if pages is None:
+                raise RuntimeError(f"held pages for {rid} missing")
+            # decode reserved ceil((len+1)/ps) pages; we transfer the prompt
+            # KV — the first-token page slot is recomputed decode-side
+            return pages, eng.extract_pages(pages)
+
+        pages, (k, v) = await runner.submit(_extract)
+        try:
+            if len(pages) != len(req.page_ids):
+                raise RuntimeError(
+                    f"page count mismatch: prefill {len(pages)} vs decode "
+                    f"{len(req.page_ids)} (page_size/config skew?)"
+                )
+            ok = await self.transfer.write(
+                req.transfer_host, req.transfer_port, rid, req.page_ids,
+                k, v, first_token,
+            )
+            if not ok:
+                raise RuntimeError("decode side nacked the KV write")
+        finally:
+            await runner.submit(lambda eng: eng.scheduler.release_held(rid))
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+        self.transfer.close()
+        if self.registration is not None:
+            await self.registration.deregister()
+        if self.runner is not None:
+            self.runner.stop()

@@ -1,0 +1,35 @@
+"""Wire types for the disaggregation planes."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+import msgpack
+
+
+@dataclass
+class RemotePrefillRequest:
+    """One unit of work on the shared prefill queue (reference:
+    RemotePrefillRequest — examples/llm/utils/protocol.py:30-105).
+
+    page_ids are the *decode* worker's reserved pages; the prefill worker
+    maps its computed pages onto them 1:1 in the transfer write."""
+
+    request_id: str
+    token_ids: list[int]
+    page_ids: list[int]
+    transfer_host: str
+    transfer_port: int
+    #: sampling for the first token (the prefill worker samples it)
+    sampling: dict[str, Any] = field(default_factory=dict)
+    model: str = ""
+    #: delivery attempts so far; requeued with +1 on failure, dropped at cap
+    attempts: int = 0
+
+    def pack(self) -> bytes:
+        return msgpack.packb(dict(self.__dict__), use_bin_type=True)
+
+    @staticmethod
+    def unpack(data: bytes) -> "RemotePrefillRequest":
+        return RemotePrefillRequest(**msgpack.unpackb(data, raw=False))

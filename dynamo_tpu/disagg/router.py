@@ -1,0 +1,87 @@
+"""Conditional-disaggregation policy.
+
+Remote prefill pays a queue hop plus a page transfer, so it only wins when
+the prefill is long (after prefix-cache credit) and the prefill fleet has
+headroom. The policy is a live config watched from the fabric, so operators
+can retune thresholds on a running system without restarts (reference:
+DisaggregatedRouter — /root/reference lib/llm/src/disagg_router.rs:242,
+etcd-watched config :38).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from dataclasses import dataclass
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+DISAGG_CONFIG_KEY = "v1/config/disagg"
+
+
+@dataclass
+class DisaggConfig:
+    #: prefills at or below this many uncached tokens stay local
+    max_local_prefill_length: int = 512
+    #: skip remote when the shared queue is already this deep
+    max_prefill_queue_size: int = 8
+    #: give up on a transfer and prefill locally after this long
+    transfer_timeout_s: float = 30.0
+
+    def to_json(self) -> bytes:
+        return json.dumps(self.__dict__).encode()
+
+    @staticmethod
+    def from_json(data: bytes) -> "DisaggConfig":
+        d = json.loads(data)
+        return DisaggConfig(
+            **{k: v for k, v in d.items() if k in DisaggConfig.__dataclass_fields__}
+        )
+
+
+class DisaggregatedRouter:
+    def __init__(self, fabric, config: Optional[DisaggConfig] = None):
+        self.fabric = fabric
+        self.config = config or DisaggConfig()
+        self._task: Optional[asyncio.Task] = None
+        self._watch = None
+
+    async def start(self) -> None:
+        """Load the fabric-stored config (if any) and follow updates."""
+        data = await self.fabric.get(DISAGG_CONFIG_KEY)
+        if data:
+            self.config = DisaggConfig.from_json(data)
+        self._watch = await self.fabric.watch_prefix(DISAGG_CONFIG_KEY)
+        self._task = asyncio.get_running_loop().create_task(self._pump())
+
+    async def _pump(self) -> None:
+        async for ev in self._watch:
+            if ev.kind == "put":
+                try:
+                    self.config = DisaggConfig.from_json(ev.value)
+                    logger.info("disagg config updated: %s", self.config)
+                except Exception:
+                    logger.exception("bad disagg config update")
+
+    def prefill_remote(
+        self, prefill_length: int, prefix_hit_length: int, queue_depth: int
+    ) -> bool:
+        """Remote iff the *uncached* prefill exceeds the local threshold and
+        the queue is not overloaded."""
+        uncached = prefill_length - prefix_hit_length
+        return (
+            uncached > self.config.max_local_prefill_length
+            and queue_depth < self.config.max_prefill_queue_size
+        )
+
+    async def stop(self) -> None:
+        if self._watch is not None:
+            self._watch.close()
+        if self._task is not None:
+            self._task.cancel()
+
+
+async def publish_disagg_config(fabric, config: DisaggConfig) -> None:
+    await fabric.put(DISAGG_CONFIG_KEY, config.to_json())

@@ -1,0 +1,208 @@
+"""KV page transfer plane: prefill → decode bulk KV movement.
+
+The decode worker runs a KvTransferServer next to its engine; a prefill
+worker connects and streams the prompt's KV pages, addressed by the decode
+worker's reserved page ids. Pages ride the checksummed two-part framing
+(header: page ids + dtype/shape; payload: raw k‖v bytes), then an async
+write callback scatters them into the decode engine's device pool and the
+request's waiter fires with the first sampled token.
+
+This is the reference's NIXL RDMA KV write (dynamo_flow.md:36-38,
+block_manager/storage/nixl.rs) re-designed for TPU: no verbs — pages move
+device→host→TCP→host→device today, with the same interface ready to back
+onto ICI remote DMA (Pallas) intra-slice or DCN streams across slices.
+Metadata rendezvous (who listens where) rides the lease store exactly like
+the reference's nixl.py:58-86 etcd pattern: the transfer address is
+published in the worker's instance metadata.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from dataclasses import dataclass
+from typing import Awaitable, Callable, Optional, Sequence
+
+import numpy as np
+
+from dynamo_tpu.runtime.codec import encode_frame, read_frame
+
+logger = logging.getLogger(__name__)
+
+#: write callback: (page_ids, k, v) -> awaitable; arrays [L, n, ps, Hkv, D]
+WriteFn = Callable[[Sequence[int], np.ndarray, np.ndarray], Awaitable[None]]
+
+
+@dataclass
+class TransferResult:
+    request_id: str
+    first_token: int
+    num_pages: int
+
+
+class KvTransferServer:
+    """Decode-side receiver: accepts page writes, lands them via write_fn,
+    resolves per-request waiters."""
+
+    def __init__(self, write_fn: WriteFn, host: str = "127.0.0.1", port: int = 0):
+        self.write_fn = write_fn
+        self.host = host
+        self.port = port
+        self._server: Optional[asyncio.base_events.Server] = None
+        self._waiters: dict[str, asyncio.Future] = {}
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._handle, self.host, self.port
+        )
+        self.port = self._server.sockets[0].getsockname()[1]
+
+    @property
+    def address(self) -> tuple[str, int]:
+        return (self.host, self.port)
+
+    def expect(self, request_id: str) -> asyncio.Future:
+        """Register a waiter before enqueueing the remote prefill; await it
+        for the TransferResult (or cancel on timeout/fallback)."""
+        fut = asyncio.get_running_loop().create_future()
+        self._waiters[request_id] = fut
+        return fut
+
+    def forget(self, request_id: str) -> None:
+        self._waiters.pop(request_id, None)
+
+    async def _handle(self, reader, writer) -> None:
+        try:
+            while True:
+                header, payload = await read_frame(reader)
+                op = header.get("op")
+                try:
+                    if op == "write":
+                        await self._on_write(header, payload, writer)
+                    elif op == "close":
+                        return
+                    else:
+                        logger.warning("transfer server: unknown op %r", op)
+                except Exception:
+                    # Malformed frame (missing key, shape/payload mismatch):
+                    # nack fast so the sender fails instead of the decode
+                    # side waiting out its transfer timeout.
+                    logger.exception("transfer frame failed")
+                    rid = header.get("request_id") if isinstance(header, dict) else None
+                    writer.write(encode_frame({"op": "nack", "request_id": rid}))
+                    await writer.drain()
+        except (asyncio.IncompleteReadError, ConnectionError):
+            pass
+        finally:
+            writer.close()
+
+    async def _on_write(self, header, payload: bytes, writer) -> None:
+        rid = header["request_id"]
+        if rid not in self._waiters:
+            # Decode side gave up (timeout → pages freed and possibly
+            # reallocated): landing this write would corrupt a live
+            # request's KV. Refuse it.
+            logger.warning("dropping KV write for %s: no waiter", rid)
+            writer.write(encode_frame({"op": "nack", "request_id": rid}))
+            await writer.drain()
+            return
+        page_ids = header["page_ids"]
+        shape = tuple(header["shape"])  # [L, n, ps, Hkv, D]
+        dtype = np.dtype(header["dtype"])
+        nbytes = int(np.prod(shape)) * dtype.itemsize
+        k = np.frombuffer(payload[:nbytes], dtype=dtype).reshape(shape)
+        v = np.frombuffer(payload[nbytes : 2 * nbytes], dtype=dtype).reshape(shape)
+        try:
+            await self.write_fn(page_ids, k, v)
+        except Exception as e:
+            logger.exception("KV page write failed for %s", rid)
+            fut = self._waiters.pop(rid, None)
+            if fut is not None and not fut.done():
+                fut.set_exception(e)
+            writer.write(encode_frame({"op": "nack", "request_id": rid}))
+            await writer.drain()
+            return
+        fut = self._waiters.pop(rid, None)
+        if fut is not None and not fut.done():
+            fut.set_result(
+                TransferResult(
+                    request_id=rid,
+                    first_token=header["first_token"],
+                    num_pages=len(page_ids),
+                )
+            )
+        writer.write(encode_frame({"op": "ack", "request_id": rid}))
+        await writer.drain()
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+        for fut in self._waiters.values():
+            if not fut.done():
+                fut.cancel()
+        self._waiters.clear()
+
+
+class KvTransferClient:
+    """Prefill-side sender; one connection per decode target, reused."""
+
+    def __init__(self):
+        self._conns: dict[tuple[str, int], tuple] = {}
+        self._locks: dict[tuple[str, int], asyncio.Lock] = {}
+
+    def _lock(self, key: tuple[str, int]) -> asyncio.Lock:
+        # created synchronously, so concurrent writers share one lock
+        lock = self._locks.get(key)
+        if lock is None:
+            lock = self._locks[key] = asyncio.Lock()
+        return lock
+
+    async def _conn(self, key: tuple[str, int]):
+        """Must be called holding the key's lock."""
+        conn = self._conns.get(key)
+        if conn is not None and not conn[1].is_closing():
+            return conn
+        reader, writer = await asyncio.open_connection(*key)
+        self._conns[key] = (reader, writer)
+        return reader, writer
+
+    async def write(
+        self,
+        host: str,
+        port: int,
+        request_id: str,
+        page_ids: Sequence[int],
+        k: np.ndarray,
+        v: np.ndarray,
+        first_token: int,
+    ) -> bool:
+        """Ship pages; True on decode-side ack. k/v: [L, n, ps, Hkv, D]
+        with n == len(page_ids)."""
+        assert k.shape == v.shape and k.shape[1] == len(page_ids), (
+            k.shape, len(page_ids),
+        )
+        key = (host, port)
+        async with self._lock(key):
+            reader, writer = await self._conn(key)
+            writer.write(
+                encode_frame(
+                    {
+                        "op": "write",
+                        "request_id": request_id,
+                        "page_ids": list(page_ids),
+                        "shape": list(k.shape),
+                        "dtype": k.dtype.str,
+                        "first_token": int(first_token),
+                    },
+                    k.tobytes() + v.tobytes(),
+                )
+            )
+            await writer.drain()
+            header, _ = await read_frame(reader)
+        return header.get("op") == "ack"
+
+    def close(self) -> None:
+        for _, writer in self._conns.values():
+            writer.close()
+        self._conns.clear()

@@ -29,6 +29,14 @@ class AsyncEngine(Protocol):
     ) -> AsyncIterator[dict]: ...
 
 
+def output_to_dict(out: StepOutput) -> dict:
+    """The one wire shape for engine stream items."""
+    return {
+        "token_ids": list(out.new_token_ids),
+        "finish_reason": out.finish_reason.value if out.finish_reason else None,
+    }
+
+
 def _sampling_from(req: PreprocessedRequest) -> SamplingParams:
     return SamplingParams(
         temperature=req.temperature,
@@ -50,6 +58,7 @@ class AsyncEngineRunner:
         self._queues: dict[str, asyncio.Queue] = {}
         self._pending: list[tuple[PreprocessedRequest, SamplingParams]] = []
         self._aborts: list[str] = []
+        self._ops: list[tuple] = []
         self._lock = threading.Lock()
         self._wake = threading.Event()
         self._stop = False
@@ -74,6 +83,17 @@ class AsyncEngineRunner:
             with self._lock:
                 pending, self._pending = self._pending, []
                 aborts, self._aborts = self._aborts, []
+                ops, self._ops = self._ops, []
+            for fn, fut in ops:
+                try:
+                    res = fn(eng)
+                    self._loop.call_soon_threadsafe(
+                        lambda f=fut, r=res: f.done() or f.set_result(r)
+                    )
+                except Exception as e:
+                    self._loop.call_soon_threadsafe(
+                        lambda f=fut, err=e: f.done() or f.set_exception(err)
+                    )
             for req, sampling in pending:
                 try:
                     eng.add_request(req.request_id, req.token_ids, sampling)
@@ -92,15 +112,7 @@ class AsyncEngineRunner:
                 logger.exception("engine step failed")
                 continue
             for out in outputs:
-                self._post(
-                    out.request_id,
-                    {
-                        "token_ids": list(out.new_token_ids),
-                        "finish_reason": out.finish_reason.value
-                        if out.finish_reason
-                        else None,
-                    },
-                )
+                self._post(out.request_id, output_to_dict(out))
                 if out.finish_reason is not None:
                     self._post(out.request_id, None)
 
@@ -111,19 +123,48 @@ class AsyncEngineRunner:
 
     # -- async side --------------------------------------------------------
 
+    async def submit(self, fn):
+        """Run fn(engine) on the engine thread (the only thread allowed to
+        touch the allocator/scheduler/KV); awaitable result. Used by the
+        disaggregation path for page reservation, KV injection, and
+        prefilled-request admission."""
+        fut = asyncio.get_running_loop().create_future()
+        with self._lock:
+            self._ops.append((fn, fut))
+        self._wake.set()
+        return await fut
+
+    def watch_request(self, request_id: str) -> asyncio.Queue:
+        """Open the output queue for a request admitted out of band (e.g.
+        via add_prefilled on the engine thread)."""
+        q: asyncio.Queue = asyncio.Queue()
+        self._queues[request_id] = q
+        return q
+
+    def unwatch_request(self, request_id: str) -> None:
+        self._queues.pop(request_id, None)
+
     async def generate(
         self, context: Context, request: PreprocessedRequest
     ) -> AsyncIterator[dict]:
-        q: asyncio.Queue = asyncio.Queue()
-        self._queues[request.request_id] = q
+        q = self.watch_request(request.request_id)
         with self._lock:
             self._pending.append((request, _sampling_from(request)))
         self._wake.set()
+        async for item in self.drain(context, request.request_id, q):
+            yield item
+
+    async def drain(
+        self, context: Context, request_id: str, q: asyncio.Queue
+    ) -> AsyncIterator[dict]:
+        """Stream a watched request's output queue: the single place that
+        knows the cancel/sentinel/error protocol (used by generate and the
+        disaggregated decode path)."""
         try:
             while True:
                 if context.cancelled:
                     with self._lock:
-                        self._aborts.append(request.request_id)
+                        self._aborts.append(request_id)
                     self._wake.set()
                     return
                 item = await q.get()
@@ -133,7 +174,7 @@ class AsyncEngineRunner:
                     raise RuntimeError(item["error"])
                 yield item
         finally:
-            self._queues.pop(request.request_id, None)
+            self._queues.pop(request_id, None)
 
     @property
     def metrics(self):

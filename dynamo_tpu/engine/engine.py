@@ -40,6 +40,7 @@ from dynamo_tpu.engine.scheduler import ScheduledBatch, Scheduler
 from dynamo_tpu.models.registry import ModelAdapter, get_model
 from dynamo_tpu.parallel.mesh import MeshConfig, make_mesh
 from dynamo_tpu.parallel.shardings import batch_spec, shardings_for
+from dynamo_tpu.tokens import TokenBlockSequence
 
 logger = logging.getLogger(__name__)
 
@@ -353,6 +354,79 @@ class JaxEngine:
                 is_first=first,
             )
         ]
+
+    # -- disaggregated prefill/decode hooks -------------------------------
+    # (decode side pre-allocates pages; a prefill worker computes the KV,
+    #  extracts it from its own pool, and the transfer service injects it
+    #  here — the reference's NIXL RDMA write path, dynamo_flow.md:36-38,
+    #  re-done as explicit page movement through host/DCN for TPU.)
+
+    def extract_pages(self, page_ids: Sequence[int]):
+        """Pull KV pages to host: (k, v) as [L, n, page_size, Hkv, D]."""
+        ids = jnp.asarray(np.asarray(page_ids, np.int32))
+        k = np.asarray(jax.device_get(jnp.take(self.kv.k, ids, axis=1)))
+        v = np.asarray(jax.device_get(jnp.take(self.kv.v, ids, axis=1)))
+        return k, v
+
+    def inject_pages(self, page_ids: Sequence[int], k: np.ndarray, v: np.ndarray) -> None:
+        """Write transferred KV pages into this engine's pool in place."""
+        n = len(page_ids)
+        fn = self._jit_cache.get(("inject", n))
+        if fn is None:
+            def inject_fn(kv, ids, kk, vv):
+                return type(kv)(
+                    k=kv.k.at[:, ids].set(kk.astype(kv.k.dtype)),
+                    v=kv.v.at[:, ids].set(vv.astype(kv.v.dtype)),
+                )
+            fn = jax.jit(inject_fn, donate_argnums=(0,))
+            self._jit_cache[("inject", n)] = fn
+        self.kv = fn(
+            self.kv, jnp.asarray(np.asarray(page_ids, np.int32)),
+            jnp.asarray(k), jnp.asarray(v),
+        )
+
+    def allocate_for_remote_prefill(
+        self,
+        request_id: str,
+        prompt_tokens: Sequence[int],
+        sampling: Optional[SamplingParams] = None,
+    ) -> Optional[Request]:
+        """Decode-side page reservation: allocate the prompt's pages (plus
+        one-token headroom) now so a prefill worker can write into them.
+        Returns None when the pool can't take it (caller falls back local)."""
+        ps = self.config.page_size
+        need = -(-(len(prompt_tokens) + 1) // ps)
+        pages = self.allocator.allocate(need)
+        if pages is None:
+            return None
+        req = Request(
+            request_id=request_id,
+            prompt_tokens=list(prompt_tokens),
+            sampling=sampling or SamplingParams(),
+            arrival_time=time.time(),
+        )
+        req.pages = pages
+        return req
+
+    def add_prefilled(self, req: Request, first_token: int) -> list[StepOutput]:
+        """Admit a remote-prefilled request into decode: its pages hold the
+        prompt KV; accept the prefill worker's first sampled token and let
+        the normal decode loop continue."""
+        chain = TokenBlockSequence(
+            req.prompt_tokens, block_size=self.config.page_size,
+            salt=self.config.model,
+        )
+        self.scheduler.add_prefilled(req, chain)
+        outputs = self._accept_token(req, first_token, first=True)
+        self._register_pages(req)
+        self._refresh_metrics()
+        return outputs
+
+    def cancel_remote_prefill(self, req: Request) -> None:
+        """Transfer failed or timed out: give the reservation back."""
+        if req.pages:
+            self.allocator.free(req.pages)
+            req.pages = []
 
     def _register_pages(self, req: Request) -> None:
         """Content-address any newly *filled* pages (enables prefix sharing

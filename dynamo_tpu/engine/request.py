@@ -60,6 +60,9 @@ class Request:
     #: (keeps the max_tokens budget correct across recompute)
     num_emitted: int = 0
     finish_reason: Optional[FinishReason] = None
+    #: disaggregated serving: keep pages allocated after finish so a prefill
+    #: worker can extract their KV for transfer (released via release_held)
+    hold_pages: bool = False
 
     @property
     def num_tokens(self) -> int:

@@ -66,6 +66,8 @@ class Scheduler:
         #: requests that can never make progress (engine finishes them) —
         #: guarantees step() liveness instead of a silent busy-spin
         self.doomed: list[tuple[Request, str]] = []
+        #: pages of finished hold_pages requests, awaiting extraction
+        self.held: dict[str, list[int]] = {}
 
     # -- queue interface ---------------------------------------------------
 
@@ -264,8 +266,25 @@ class Scheduler:
         request.state = RequestState.FINISHED
         if request in self.running:
             self.running.remove(request)
-        self._release(request)
+        if request.hold_pages and request.pages:
+            self.held[request.request_id] = request.pages
+            request.pages = []
+        else:
+            self._release(request)
         self.chains.pop(request.request_id, None)
+
+    def release_held(self, request_id: str) -> None:
+        pages = self.held.pop(request_id, None)
+        if pages:
+            self.allocator.free(pages)
+
+    def add_prefilled(self, request: Request, chain: TokenBlockSequence) -> None:
+        """Admit a request whose prompt KV is already resident (written into
+        request.pages by a remote prefill transfer) straight into decode."""
+        request.state = RequestState.DECODE
+        request.num_computed_tokens = len(request.prompt_tokens)
+        self.chains[request.request_id] = chain
+        self.running.append(request)
 
     def _release(self, request: Request) -> None:
         if request.pages:

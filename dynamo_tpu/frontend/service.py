@@ -97,18 +97,33 @@ def local_pipeline(card: ModelDeploymentCard, async_engine) -> ModelPipeline:
 
 
 def router_pipeline(
-    card: ModelDeploymentCard, router: PushRouter
+    card: ModelDeploymentCard, router: PushRouter, kv_router=None
 ) -> ModelPipeline:
-    """Distributed pipeline: push preprocessed requests to workers."""
+    """Distributed pipeline: push preprocessed requests to workers. With a
+    KvRouter attached, per-token and completion feedback keep its local
+    in-flight bookkeeping current (reference: kv_router.rs:204-210)."""
 
     async def engine_fn(ctx: Context, pre: PreprocessedRequest):
         instance_id = pre.annotations.get("instance_id")
-        async for item in router.generate(
-            pre.to_dict(), context=ctx, instance_id=instance_id
-        ):
-            yield item
+        try:
+            async for item in router.generate(
+                pre.to_dict(), context=ctx, instance_id=instance_id
+            ):
+                if kv_router is not None and isinstance(item, dict):
+                    kv_router.on_tokens(
+                        pre.request_id, len(item.get("token_ids", ()))
+                    )
+                yield item
+        finally:
+            if kv_router is not None:
+                kv_router.on_complete(pre.request_id)
 
-    return ModelPipeline(card, engine_fn=engine_fn, close_fn=router.close)
+    async def close_fn():
+        router.close()
+        if kv_router is not None:
+            await kv_router.stop()
+
+    return ModelPipeline(card, engine_fn=engine_fn, close_fn=close_fn)
 
 
 class ModelManager:
@@ -170,7 +185,27 @@ class ModelWatcher:
             .component(entry.component)
             .endpoint(entry.endpoint)
         )
-        router = await ep.router(mode=RouterMode(entry.router_mode))
+        mode = RouterMode(entry.router_mode)
+        if mode == RouterMode.KV:
+            from dynamo_tpu.kv_router import KvRouter
+
+            src = await ep.instance_source()
+            kv_router = KvRouter(
+                self.runtime.fabric,
+                entry.component,
+                src,
+                block_size=card.kv_page_size,
+                salt=card.name,
+            )
+            await kv_router.start()
+            router = PushRouter(
+                src, ep.name, mode=mode, kv_chooser=kv_router.choose
+            )
+            self.manager.add(
+                entry.model, router_pipeline(card, router, kv_router=kv_router)
+            )
+            return
+        router = await ep.router(mode=mode)
         self.manager.add(entry.model, router_pipeline(card, router))
 
     async def _on_delete(self, key: str) -> None:

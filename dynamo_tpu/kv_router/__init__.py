@@ -1,0 +1,30 @@
+"""KV-cache-aware routing.
+
+Global view of which worker holds which content-addressed KV blocks, kept
+fresh by worker-emitted KV events, plus a cost-based scheduler that sends
+each request to the worker where the most prefix KV is already resident
+(capability parity with the reference's kv_router family —
+/root/reference lib/llm/src/kv_router/: KvRouter kv_router.rs:163,
+RadixTree indexer.rs:239, KvScheduler scheduler.rs:204, ActiveSequences
+sequence.rs:74, metrics_aggregator.rs, approx.rs, recorder.rs).
+"""
+
+from dynamo_tpu.kv_router.indexer import KvIndexer, OverlapScores, RadixTree
+from dynamo_tpu.kv_router.kv_router import KvRouter
+from dynamo_tpu.kv_router.scheduler import (
+    DefaultWorkerSelector,
+    KvRouterConfig,
+    WorkerSnapshot,
+)
+from dynamo_tpu.kv_router.sequence import ActiveSequences
+
+__all__ = [
+    "ActiveSequences",
+    "DefaultWorkerSelector",
+    "KvIndexer",
+    "KvRouter",
+    "KvRouterConfig",
+    "OverlapScores",
+    "RadixTree",
+    "WorkerSnapshot",
+]

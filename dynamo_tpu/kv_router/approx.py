@@ -1,0 +1,63 @@
+"""Approximate KV index for event-less engines.
+
+When a worker can't emit KV events, the router can still estimate locality:
+every routing decision implies the chosen worker will shortly hold the
+request's blocks, so record them locally with a TTL matched to the worker's
+expected cache residency. Strictly an estimate — eviction on the worker is
+invisible — but it captures the dominant effect (recent prompts are hot).
+
+Capability parity with the reference's ApproxKvIndexer
+(/root/reference lib/llm/src/kv_router/approx.rs:157).
+"""
+
+from __future__ import annotations
+
+import heapq
+import time
+from typing import Optional, Sequence
+
+from dynamo_tpu.kv_router.indexer import OverlapScores, RadixTree
+
+
+class ApproxKvIndexer:
+    def __init__(self, ttl_s: float = 120.0, clock=time.monotonic):
+        self.ttl_s = ttl_s
+        self.tree = RadixTree()
+        self._clock = clock
+        #: (expiry, worker_id, hash) min-heap; stale entries are skipped on
+        #: pop when _latest shows a refresh
+        self._expiries: list[tuple[float, str, int]] = []
+        #: (worker_id, hash) -> newest expiry (routing decisions refresh TTL)
+        self._latest: dict[tuple[str, int], float] = {}
+
+    def process_routing_decision(
+        self, worker_id: str, seq_hashes: Sequence[int]
+    ) -> None:
+        now = self._clock()
+        self.tree.apply_event(
+            worker_id, {"kind": "stored", "block_hashes": list(seq_hashes)}
+        )
+        expiry = now + self.ttl_s
+        for h in seq_hashes:
+            heapq.heappush(self._expiries, (expiry, worker_id, h))
+            self._latest[(worker_id, h)] = expiry
+
+    def _expire(self) -> None:
+        now = self._clock()
+        while self._expiries and self._expiries[0][0] <= now:
+            expiry, worker_id, h = heapq.heappop(self._expiries)
+            if self._latest.get((worker_id, h), expiry) > expiry:
+                continue  # refreshed since this entry was pushed
+            self._latest.pop((worker_id, h), None)
+            self.tree.apply_event(
+                worker_id, {"kind": "removed", "block_hashes": [h]}
+            )
+
+    def find_matches(self, seq_hashes: Sequence[int]) -> OverlapScores:
+        self._expire()
+        return self.tree.find_matches(seq_hashes)
+
+    def remove_worker(self, worker_id: str) -> int:
+        for key in [k for k in self._latest if k[0] == worker_id]:
+            del self._latest[key]
+        return self.tree.remove_worker(worker_id)

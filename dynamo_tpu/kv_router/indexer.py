@@ -1,0 +1,182 @@
+"""Global KV-block index: (worker × chained block hash) → overlap scores.
+
+Because block identity is a *chained* sequence hash (tokens/blocks.py), the
+prefix tree over blocks collapses to a flat map: a sequence hash uniquely
+names its entire ancestry, so membership of hash h implies the exact prefix
+chain. `find_matches` therefore walks the request's hash chain in order and
+scores each worker by its **contiguous** prefix length — only contiguous
+blocks are reusable by an engine's prefix cache, so that is the true number
+of prefill blocks saved.
+
+Capability parity with the reference's RadixTree indexer
+(/root/reference lib/llm/src/kv_router/indexer.rs — RadixTree :239,
+apply_event :283, KvIndexer :518, OverlapScores :410), re-designed around
+the flat chained-hash map instead of a pointer tree.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+import msgpack
+
+from dynamo_tpu.subjects import KV_EVENT_SUBJECT
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class OverlapScores:
+    """Per-worker contiguous-prefix overlap, in blocks."""
+
+    scores: dict[str, int] = field(default_factory=dict)
+    #: how many leading blocks of the query hit *any* worker
+    matched_blocks: int = 0
+
+    def best(self) -> tuple[Optional[str], int]:
+        if not self.scores:
+            return None, 0
+        worker = max(self.scores, key=lambda w: (self.scores[w], w))
+        return worker, self.scores[worker]
+
+
+class RadixTree:
+    """Worker-set per chained block hash, with per-worker reverse index for
+    O(worker's blocks) removal when a lease expires."""
+
+    def __init__(self):
+        self._workers_by_hash: dict[int, set[str]] = {}
+        self._hashes_by_worker: dict[str, set[int]] = {}
+        self.events_applied = 0
+
+    # -- mutation ----------------------------------------------------------
+
+    def apply_event(self, worker_id: str, event: dict) -> None:
+        """Apply one stored/removed event (the wire dict form emitted by
+        workers — worker.py _publish_loop)."""
+        kind = event["kind"]
+        hashes = event["block_hashes"]
+        if kind == "stored":
+            self._store(worker_id, hashes)
+        elif kind == "removed":
+            self._remove(worker_id, hashes)
+        else:
+            logger.warning("unknown kv event kind %r", kind)
+        self.events_applied += 1
+
+    def _store(self, worker_id: str, hashes: Sequence[int]) -> None:
+        mine = self._hashes_by_worker.setdefault(worker_id, set())
+        for h in hashes:
+            self._workers_by_hash.setdefault(h, set()).add(worker_id)
+            mine.add(h)
+
+    def _remove(self, worker_id: str, hashes: Sequence[int]) -> None:
+        mine = self._hashes_by_worker.get(worker_id)
+        for h in hashes:
+            workers = self._workers_by_hash.get(h)
+            if workers is not None:
+                workers.discard(worker_id)
+                if not workers:
+                    del self._workers_by_hash[h]
+            if mine is not None:
+                mine.discard(h)
+
+    def remove_worker(self, worker_id: str) -> int:
+        """Drop every block owned by a departed worker."""
+        hashes = self._hashes_by_worker.pop(worker_id, set())
+        for h in hashes:
+            workers = self._workers_by_hash.get(h)
+            if workers is not None:
+                workers.discard(worker_id)
+                if not workers:
+                    del self._workers_by_hash[h]
+        return len(hashes)
+
+    def clear(self) -> None:
+        self._workers_by_hash.clear()
+        self._hashes_by_worker.clear()
+
+    # -- query -------------------------------------------------------------
+
+    def find_matches(self, seq_hashes: Sequence[int]) -> OverlapScores:
+        out = OverlapScores()
+        active: Optional[set[str]] = None
+        for depth, h in enumerate(seq_hashes):
+            holders = self._workers_by_hash.get(h)
+            if not holders:
+                break
+            active = set(holders) if active is None else active & holders
+            if not active:
+                break
+            out.matched_blocks = depth + 1
+            for w in active:
+                out.scores[w] = depth + 1
+        return out
+
+    # -- introspection -----------------------------------------------------
+
+    @property
+    def num_blocks(self) -> int:
+        return len(self._workers_by_hash)
+
+    def num_workers(self) -> int:
+        return len(self._hashes_by_worker)
+
+    def workers(self) -> set[str]:
+        return set(self._hashes_by_worker)
+
+    def blocks_for(self, worker_id: str) -> int:
+        return len(self._hashes_by_worker.get(worker_id, ()))
+
+
+class KvIndexer:
+    """Event-driven index: subscribes `kv_events.>` on the fabric and keeps
+    a RadixTree current (reference: KvIndexer — indexer.rs:518, fed from the
+    NATS kv_events subject, kv_router.rs:131-152)."""
+
+    def __init__(self, fabric, subject: str = KV_EVENT_SUBJECT):
+        self.fabric = fabric
+        self.subject = subject
+        self.tree = RadixTree()
+        self._sub = None
+        self._task: Optional[asyncio.Task] = None
+        self._on_event_hooks = []
+
+    async def start(self) -> None:
+        self._sub = await self.fabric.subscribe(self.subject + ".>")
+        self._task = asyncio.get_running_loop().create_task(self._pump())
+
+    async def _pump(self) -> None:
+        while True:
+            msg = await self._sub.next()
+            if msg is None:
+                return
+            try:
+                worker_id = msg.header["instance_id"]
+                events = msgpack.unpackb(msg.payload, raw=False)
+                for ev in events:
+                    self.tree.apply_event(worker_id, ev)
+                    for hook in self._on_event_hooks:
+                        hook(worker_id, ev, time.monotonic())
+            except Exception:
+                logger.exception("bad kv event message on %s", msg.subject)
+
+    def add_event_hook(self, hook) -> None:
+        """hook(worker_id, event_dict, monotonic_ts) — recorder/metrics tap."""
+        self._on_event_hooks.append(hook)
+
+    def find_matches(self, seq_hashes: Sequence[int]) -> OverlapScores:
+        return self.tree.find_matches(seq_hashes)
+
+    def remove_worker(self, worker_id: str) -> int:
+        return self.tree.remove_worker(worker_id)
+
+    async def stop(self) -> None:
+        if self._sub is not None:
+            self._sub.close()
+        if self._task is not None:
+            self._task.cancel()

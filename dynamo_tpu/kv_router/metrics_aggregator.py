@@ -1,0 +1,79 @@
+"""Worker load-metrics aggregation.
+
+Workers publish EngineMetrics snapshots on `metrics.{component}.{instance}`
+every interval (worker.py _publish_loop); this aggregator subscribes the
+component's whole subject space and serves the latest snapshot per live
+worker, pruning entries that stop refreshing.
+
+Capability parity with the reference's EndpointCollector /
+collect_endpoints_task (/root/reference lib/llm/src/kv_router/
+metrics_aggregator.rs:31,124 — there a NATS service-stats scrape; here the
+workers push, which removes the scrape round-trip).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Optional
+
+from dynamo_tpu.subjects import METRICS_SUBJECT
+
+logger = logging.getLogger(__name__)
+
+
+class MetricsAggregator:
+    def __init__(
+        self,
+        fabric,
+        component: str,
+        stale_after: float = 10.0,
+        subject: str = METRICS_SUBJECT,
+    ):
+        self.fabric = fabric
+        self.component = component
+        self.stale_after = stale_after
+        self.subject = f"{subject}.{component}.>"
+        self._latest: dict[str, tuple[dict, float]] = {}
+        self._sub = None
+        self._task: Optional[asyncio.Task] = None
+
+    async def start(self) -> None:
+        self._sub = await self.fabric.subscribe(self.subject)
+        self._task = asyncio.get_running_loop().create_task(self._pump())
+
+    async def _pump(self) -> None:
+        while True:
+            msg = await self._sub.next()
+            if msg is None:
+                return
+            m = msg.header
+            iid = m.get("instance_id")
+            if iid:
+                self._latest[iid] = (m, time.monotonic())
+
+    def snapshot(self) -> dict[str, dict]:
+        """instance_id → latest metrics dict, stale entries pruned."""
+        now = time.monotonic()
+        dead = [
+            iid
+            for iid, (_, ts) in self._latest.items()
+            if now - ts > self.stale_after
+        ]
+        for iid in dead:
+            del self._latest[iid]
+        return {iid: m for iid, (m, _) in self._latest.items()}
+
+    def for_instance(self, instance_id: str) -> Optional[dict]:
+        entry = self._latest.get(instance_id)
+        return entry[0] if entry else None
+
+    def remove(self, instance_id: str) -> None:
+        self._latest.pop(instance_id, None)
+
+    async def stop(self) -> None:
+        if self._sub is not None:
+            self._sub.close()
+        if self._task is not None:
+            self._task.cancel()

@@ -1,0 +1,95 @@
+"""KV event stream recorder / replayer.
+
+Records the live `kv_events.>` stream to JSONL for offline debugging, and
+replays a recording back onto a fabric (optionally time-scaled) so routing
+behavior can be reproduced without the workers that generated it.
+
+Capability parity with the reference's KvRecorder
+(/root/reference lib/llm/src/kv_router/recorder.rs; python surface
+_core.pyi:637-704).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import time
+from pathlib import Path
+from typing import Optional
+
+import msgpack
+
+from dynamo_tpu.subjects import KV_EVENT_SUBJECT
+
+logger = logging.getLogger(__name__)
+
+
+class KvRecorder:
+    def __init__(self, fabric, path: str, subject: str = KV_EVENT_SUBJECT):
+        self.fabric = fabric
+        self.path = Path(path)
+        self.subject = subject
+        self.event_count = 0
+        self._sub = None
+        self._task: Optional[asyncio.Task] = None
+        self._t0: Optional[float] = None
+
+    async def start(self) -> None:
+        self._sub = await self.fabric.subscribe(self.subject + ".>")
+        self._task = asyncio.get_running_loop().create_task(self._pump())
+
+    async def _pump(self) -> None:
+        with self.path.open("a") as f:
+            while True:
+                msg = await self._sub.next()
+                if msg is None:
+                    return
+                now = time.monotonic()
+                if self._t0 is None:
+                    self._t0 = now
+                events = msgpack.unpackb(msg.payload, raw=False)
+                for ev in events:
+                    f.write(
+                        json.dumps(
+                            {
+                                "t": now - self._t0,
+                                "worker": msg.header.get("instance_id"),
+                                "event": ev,
+                            }
+                        )
+                        + "\n"
+                    )
+                    self.event_count += 1
+                f.flush()
+
+    async def stop(self) -> None:
+        if self._sub is not None:
+            self._sub.close()
+        if self._task is not None:
+            self._task.cancel()
+
+
+async def replay(
+    fabric,
+    path: str,
+    subject: str = KV_EVENT_SUBJECT,
+    timed: bool = False,
+    speed: float = 1.0,
+) -> int:
+    """Publish a recording back onto the fabric. timed=False replays as fast
+    as possible; otherwise sleeps to reproduce original spacing / speed."""
+    n = 0
+    last_t = 0.0
+    for line in Path(path).read_text().splitlines():
+        rec = json.loads(line)
+        if timed and rec["t"] > last_t:
+            await asyncio.sleep((rec["t"] - last_t) / speed)
+        last_t = rec["t"]
+        await fabric.publish(
+            f"{subject}.{rec['worker']}",
+            {"instance_id": rec["worker"], "count": 1},
+            msgpack.packb([rec["event"]], use_bin_type=True),
+        )
+        n += 1
+    return n

@@ -1,0 +1,112 @@
+"""Cost-based worker selection with softmax-temperature sampling.
+
+For each candidate worker the selector computes the work the request would
+cost there — blocks still to prefill plus the load the worker would carry —
+and samples from a softmax over the negated costs. Temperature 0 is argmin
+(deterministic best); higher temperatures spread load across near-ties so a
+single hot prefix doesn't concentrate every request on one worker.
+
+Capability parity with the reference's KvScheduler / DefaultWorkerSelector
+(/root/reference lib/llm/src/kv_router/scheduler.rs — schedule :204,
+select_worker :360, logit = overlap_weight·prefill_blocks + potential_blocks
+:391, softmax_sample :276; KvRouterConfig — kv_router.rs:55).
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import random
+from dataclasses import dataclass, field
+from typing import Optional, Protocol, Sequence
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass(frozen=True)
+class KvRouterConfig:
+    #: weight on blocks-to-prefill relative to total resulting load
+    overlap_score_weight: float = 1.0
+    #: softmax temperature; 0 ⇒ deterministic argmin cost
+    temperature: float = 0.0
+    #: ignore workers whose KV pool is fuller than this fraction
+    max_kv_usage: float = 0.98
+    #: rng seed for reproducible sampling in tests (None ⇒ nondeterministic)
+    seed: Optional[int] = None
+
+
+@dataclass
+class WorkerSnapshot:
+    """One worker's load as seen by the router: the published metrics merged
+    with router-local in-flight bookkeeping (ActiveSequences)."""
+
+    instance_id: str
+    kv_active_blocks: float = 0.0
+    kv_total_blocks: float = 0.0
+    num_waiting: int = 0
+    num_running: int = 0
+
+    @property
+    def kv_usage(self) -> float:
+        if self.kv_total_blocks <= 0:
+            return 0.0
+        return self.kv_active_blocks / self.kv_total_blocks
+
+
+class WorkerSelector(Protocol):
+    def select(
+        self,
+        workers: Sequence[WorkerSnapshot],
+        overlaps: dict[str, int],
+        total_blocks: int,
+    ) -> Optional[str]: ...
+
+
+def softmax_sample(
+    neg_costs: Sequence[float], temperature: float, rng: random.Random
+) -> int:
+    """Sample an index ∝ softmax(neg_costs / temperature); argmax at T=0."""
+    if temperature <= 0:
+        return max(range(len(neg_costs)), key=lambda i: neg_costs[i])
+    m = max(neg_costs)
+    weights = [math.exp((c - m) / temperature) for c in neg_costs]
+    return rng.choices(range(len(neg_costs)), weights=weights, k=1)[0]
+
+
+@dataclass
+class DefaultWorkerSelector:
+    config: KvRouterConfig = field(default_factory=KvRouterConfig)
+
+    def __post_init__(self):
+        self._rng = random.Random(self.config.seed)
+
+    def select(
+        self,
+        workers: Sequence[WorkerSnapshot],
+        overlaps: dict[str, int],
+        total_blocks: int,
+    ) -> Optional[str]:
+        if not workers:
+            return None
+        eligible = [
+            w for w in workers if w.kv_usage < self.config.max_kv_usage
+        ] or list(workers)
+        neg_costs = []
+        for w in eligible:
+            prefill_blocks = total_blocks - overlaps.get(w.instance_id, 0)
+            potential_blocks = w.kv_active_blocks + prefill_blocks
+            cost = (
+                self.config.overlap_score_weight * prefill_blocks
+                + potential_blocks
+            )
+            neg_costs.append(-cost)
+        idx = softmax_sample(neg_costs, self.config.temperature, self._rng)
+        chosen = eligible[idx]
+        logger.debug(
+            "kv select %s: overlap=%d/%d cost=%.1f",
+            chosen.instance_id,
+            overlaps.get(chosen.instance_id, 0),
+            total_blocks,
+            -neg_costs[idx],
+        )
+        return chosen.instance_id

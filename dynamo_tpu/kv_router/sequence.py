@@ -1,0 +1,85 @@
+"""Router-local in-flight bookkeeping.
+
+Published worker metrics lag (they arrive on the publish interval), so the
+router tracks what *it* has sent each worker: per-request block footprints
+that grow as tokens stream back and are released on completion. Merging
+this with the scraped metrics closes the feedback gap that would otherwise
+let a burst of requests all land on the momentarily-idle-looking worker.
+
+Capability parity with the reference's ActiveSequences /
+ActiveSequencesMultiWorker (/root/reference lib/llm/src/kv_router/
+sequence.rs:74,247; fed per token from kv_router.rs:204-210).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass
+class _Active:
+    worker_id: str
+    blocks: int
+    tokens_seen: int = 0
+
+
+class ActiveSequences:
+    def __init__(self, block_size: int):
+        self.block_size = block_size
+        self._by_request: dict[str, _Active] = {}
+        self._blocks_by_worker: dict[str, int] = {}
+
+    def add(self, worker_id: str, request_id: str, prompt_blocks: int) -> None:
+        if request_id in self._by_request:
+            self.free(request_id)
+        self._by_request[request_id] = _Active(worker_id, prompt_blocks)
+        self._blocks_by_worker[worker_id] = (
+            self._blocks_by_worker.get(worker_id, 0) + prompt_blocks
+        )
+
+    def on_tokens(self, request_id: str, n: int) -> None:
+        """Account n generated tokens; every block_size tokens grows the
+        footprint by one block."""
+        a = self._by_request.get(request_id)
+        if a is None:
+            return
+        before = a.tokens_seen // self.block_size
+        a.tokens_seen += n
+        grown = a.tokens_seen // self.block_size - before
+        if grown:
+            a.blocks += grown
+            self._blocks_by_worker[a.worker_id] += grown
+
+    def free(self, request_id: str) -> str | None:
+        a = self._by_request.pop(request_id, None)
+        if a is None:
+            return None
+        left = self._blocks_by_worker.get(a.worker_id, 0) - a.blocks
+        if left > 0:
+            self._blocks_by_worker[a.worker_id] = left
+        else:
+            self._blocks_by_worker.pop(a.worker_id, None)
+        return a.worker_id
+
+    def remove_worker(self, worker_id: str) -> int:
+        gone = [
+            rid for rid, a in self._by_request.items() if a.worker_id == worker_id
+        ]
+        for rid in gone:
+            del self._by_request[rid]
+        self._blocks_by_worker.pop(worker_id, None)
+        return len(gone)
+
+    def workers(self) -> set[str]:
+        return {a.worker_id for a in self._by_request.values()}
+
+    def active_blocks(self, worker_id: str) -> int:
+        return self._blocks_by_worker.get(worker_id, 0)
+
+    def active_seqs(self, worker_id: str) -> int:
+        return sum(
+            1 for a in self._by_request.values() if a.worker_id == worker_id
+        )
+
+    def __len__(self) -> int:
+        return len(self._by_request)

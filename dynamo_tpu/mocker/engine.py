@@ -62,6 +62,17 @@ class MockEngine:
             # simulated prefill (cached prefix is free)
             uncached = len(request.token_ids) - len(cached) * a.page_size
             await asyncio.sleep(max(uncached, 0) * a.prefill_s_per_token)
+            # register the prompt's full blocks for prefix reuse (and so KV
+            # events cover the prompt, which is what routing matches on)
+            for bi in range(len(cached), len(chain.blocks)):
+                if bi < len(all_pages):
+                    blk = chain.blocks[bi]
+                    self.allocator.register(
+                        all_pages[bi],
+                        blk.sequence_hash,
+                        blk.parent_sequence_hash,
+                        blk.tokens,
+                    )
             history = list(request.token_ids)
             produced = 0
             while produced < request.max_tokens:

@@ -65,9 +65,9 @@ class RemoteFabric:
                 if not fut.done():
                     fut.set_exception(err)
             self._pending.clear()
-            for w in self._watches.values():
+            for w in list(self._watches.values()):
                 w.close()
-            for s in self._subs.values():
+            for s in list(self._subs.values()):
                 s.close()
 
     def _handle_push(self, h: Any, payload: bytes) -> None:

@@ -1,0 +1,16 @@
+"""Shared fabric subject names.
+
+Publishers (worker processes) and subscribers (routers, aggregators,
+planner) must agree on these; defining them once keeps a rename from
+silently severing a plane (reference: subject constants in
+lib/llm/src/kv_router.rs:48-49).
+"""
+
+#: per-worker KV cache events: kv_events.{instance_id}
+KV_EVENT_SUBJECT = "kv_events"
+
+#: per-worker load metrics: metrics.{component}.{instance_id}
+METRICS_SUBJECT = "metrics"
+
+#: router-emitted per-decision prefix-cache hit rates
+KV_HIT_RATE_SUBJECT = "kv-hit-rate"

@@ -27,11 +27,9 @@ from dynamo_tpu.engine.page_table import KvEvent
 from dynamo_tpu.model_card import ModelDeploymentCard, register_llm
 from dynamo_tpu.preprocessor.preprocessor import PreprocessedRequest
 from dynamo_tpu.runtime import DistributedRuntime, IngressServer
+from dynamo_tpu.subjects import KV_EVENT_SUBJECT, METRICS_SUBJECT
 
 logger = logging.getLogger(__name__)
-
-KV_EVENT_SUBJECT = "kv_events"
-METRICS_SUBJECT = "metrics"
 
 
 class Worker:
@@ -46,6 +44,11 @@ class Worker:
         endpoint: str = "generate",
         checkpoint_path: Optional[str] = None,
         metrics_interval: float = 1.0,
+        router_mode: str = "round_robin",
+        enable_disagg: bool = False,
+        disagg_config=None,
+        prefill_queue_name: str = "prefill_queue",
+        advertise_host: str = "127.0.0.1",
     ):
         self.runtime = runtime
         self.card = card
@@ -56,6 +59,18 @@ class Worker:
         self.endpoint_name = endpoint
         self.checkpoint_path = checkpoint_path
         self.metrics_interval = metrics_interval
+        self.router_mode = router_mode
+        self.mock = None
+        self.enable_disagg = enable_disagg
+        self.disagg_config = disagg_config
+        self.prefill_queue_name = prefill_queue_name
+        #: host other processes (frontends, prefill workers) reach us at —
+        #: must be a routable address in multi-host deployments
+        self.advertise_host = advertise_host
+        self.transfer_server = None
+        self.disagg_router = None
+        self.prefill_queue = None
+        self.remote_prefills = 0
         self.ingress = IngressServer()
         self.runner: Optional[AsyncEngineRunner] = None
         self.echo: Optional[EchoEngine] = None
@@ -69,11 +84,26 @@ class Worker:
     async def start(self) -> None:
         if self.engine_kind == "echo":
             self.echo = EchoEngine()
-        else:
-            engine = JaxEngine(
-                self.engine_config,
+        elif self.engine_kind == "mock":
+            from dynamo_tpu.mocker import MockEngine, MockEngineArgs
+
+            self.mock = MockEngine(
+                MockEngineArgs(
+                    page_size=self.card.kv_page_size, salt=self.card.name
+                ),
                 on_kv_event=self._kv_event_buffer.append,
-                checkpoint_path=self.checkpoint_path,
+            )
+        else:
+            # Engine construction (param init, first compiles) blocks for
+            # seconds — run it off-loop or the fabric lease keepalives
+            # starve and the registration lease expires before it exists.
+            engine = await asyncio.get_running_loop().run_in_executor(
+                None,
+                lambda: JaxEngine(
+                    self.engine_config,
+                    on_kv_event=self._kv_event_buffer.append,
+                    checkpoint_path=self.checkpoint_path,
+                ),
             )
             self.runner = AsyncEngineRunner(engine)
             self.runner.start()
@@ -82,18 +112,45 @@ class Worker:
         self.ingress.add_handler("flush", self._flush)
         await self.ingress.start()
 
+        metadata = {"model": self.card.name}
+        if self.enable_disagg and self.runner is not None:
+            from dynamo_tpu.disagg import (
+                DisaggregatedRouter,
+                KvTransferServer,
+                PrefillQueue,
+            )
+
+            runner = self.runner
+
+            async def write_fn(page_ids, k, v):
+                await runner.submit(
+                    lambda eng: eng.inject_pages(page_ids, k, v)
+                )
+
+            self.transfer_server = KvTransferServer(write_fn)
+            await self.transfer_server.start()
+            self.disagg_router = DisaggregatedRouter(
+                self.runtime.fabric, self.disagg_config
+            )
+            await self.disagg_router.start()
+            self.prefill_queue = PrefillQueue(
+                self.runtime.fabric, self.prefill_queue_name
+            )
+            metadata["kv_transfer_port"] = self.transfer_server.port
+
         ep = (
             self.runtime.namespace(self.namespace)
             .component(self.component)
             .endpoint(self.endpoint_name)
         )
         self.registration = await ep.register(
-            "127.0.0.1", self.ingress.port, metadata={"model": self.card.name}
+            self.advertise_host, self.ingress.port, metadata=metadata
         )
         self.instance_id = self.registration.instance.instance_id
         await register_llm(
             self.runtime.fabric, self.card, self.namespace, self.component,
             self.endpoint_name, lease_id=self.runtime.primary_lease,
+            router_mode=self.router_mode,
         )
         loop = asyncio.get_running_loop()
         self._tasks.append(loop.create_task(self._publish_loop()))
@@ -106,6 +163,10 @@ class Worker:
         for t in self._tasks:
             t.cancel()
         await self.ingress.stop()
+        if self.transfer_server is not None:
+            await self.transfer_server.stop()
+        if self.disagg_router is not None:
+            await self.disagg_router.stop()
         if self.runner:
             self.runner.stop()
 
@@ -113,14 +174,119 @@ class Worker:
 
     async def _generate(self, ctx, request: dict):
         pre = PreprocessedRequest.from_dict(request)
-        gen = (self.echo or self.runner).generate(ctx, pre)
+        if self.prefill_queue is not None and await self._want_remote(pre):
+            handled = False
+            async for event in self._generate_disagg(ctx, pre):
+                handled = True
+                yield event
+            if handled:
+                return
+            # transfer fell through — run the normal local path below
+        gen = (self.echo or self.mock or self.runner).generate(ctx, pre)
         async for event in gen:
             yield event
+
+    # -- disaggregated path ------------------------------------------------
+
+    async def _want_remote(self, pre: PreprocessedRequest) -> bool:
+        # Cheap local short-circuit: uncached length can't exceed prompt
+        # length, so short prompts never qualify — skip the engine-thread
+        # and fabric round-trips entirely.
+        if (
+            len(pre.token_ids)
+            <= self.disagg_router.config.max_local_prefill_length
+        ):
+            return False
+        runner = self.runner
+
+        def _hit(eng):
+            from dynamo_tpu.tokens import hash_token_blocks
+
+            hashes = hash_token_blocks(
+                pre.token_ids, block_size=eng.config.page_size,
+                salt=eng.config.model,
+            )
+            return eng.allocator.match_length(hashes) * eng.config.page_size
+
+        prefix_hit = await runner.submit(_hit)
+        depth = await self.prefill_queue.depth()
+        return self.disagg_router.prefill_remote(
+            len(pre.token_ids), prefix_hit, depth
+        )
+
+    async def _generate_disagg(self, ctx, pre: PreprocessedRequest):
+        """Remote prefill: reserve pages, enqueue, wait for the KV landing,
+        then decode locally. Yields nothing (falls back) on reservation
+        failure or transfer timeout."""
+        from dynamo_tpu.disagg.protocol import RemotePrefillRequest
+        from dynamo_tpu.engine.async_engine import _sampling_from
+
+        runner = self.runner
+        rid = pre.request_id
+        sampling = _sampling_from(pre)
+        req = await runner.submit(
+            lambda eng: eng.allocate_for_remote_prefill(
+                rid, pre.token_ids, sampling
+            )
+        )
+        if req is None:
+            logger.info("disagg: no pages free for %s; local fallback", rid)
+            return
+        # From here until add_prefilled succeeds, any failure must give the
+        # page reservation and the transfer waiter back.
+        waiter = self.transfer_server.expect(rid)
+        try:
+            await self.prefill_queue.push(
+                RemotePrefillRequest(
+                    request_id=rid,
+                    token_ids=list(pre.token_ids),
+                    page_ids=list(req.pages),
+                    transfer_host=self.advertise_host,
+                    transfer_port=self.transfer_server.port,
+                    sampling={
+                        "temperature": pre.temperature, "top_p": pre.top_p,
+                        "top_k": pre.top_k, "seed": pre.seed,
+                    },
+                    model=self.card.name,
+                )
+            )
+            timeout = self.disagg_router.config.transfer_timeout_s
+            result = await asyncio.wait_for(waiter, timeout)
+        except Exception:
+            self.transfer_server.forget(rid)
+            await runner.submit(lambda eng: eng.cancel_remote_prefill(req))
+            logger.warning(
+                "disagg: remote prefill for %s failed/timed out; local fallback",
+                rid,
+            )
+            return
+        self.remote_prefills += 1
+        from dynamo_tpu.engine.async_engine import output_to_dict
+
+        out_q = runner.watch_request(rid)
+        try:
+            try:
+                outputs = await runner.submit(
+                    lambda eng: eng.add_prefilled(req, result.first_token)
+                )
+            except Exception:
+                await runner.submit(lambda eng: eng.cancel_remote_prefill(req))
+                raise
+            for out in outputs:
+                yield output_to_dict(out)
+                if out.finish_reason is not None:
+                    return
+            async for item in runner.drain(ctx, rid, out_q):
+                yield item
+        finally:
+            runner.unwatch_request(rid)
 
     async def _flush(self, ctx, request):
         n = 0
         if self.runner is not None:
             n = self.runner.engine.allocator.clear_cache()
+        elif self.mock is not None:
+            n = self.mock.allocator.clear_cache()
         yield {"cleared_pages": n}
 
     # -- publishers --------------------------------------------------------
@@ -151,8 +317,20 @@ class Worker:
                     {"instance_id": self.instance_id, "count": len(events)},
                     payload,
                 )
+            m = None
             if self.runner is not None:
                 m = self.runner.metrics.to_dict()
+            elif self.mock is not None:
+                alloc = self.mock.allocator
+                m = {
+                    "num_waiting": 0,
+                    "num_running": self.mock.active_requests,
+                    "kv_active_pages": alloc.num_active,
+                    "kv_total_pages": alloc.num_pages - 1,
+                    "kv_usage": alloc.usage(),
+                    "prefix_hit_rate": alloc.stats.hit_rate,
+                }
+            if m is not None:
                 m["instance_id"] = self.instance_id
                 m["model"] = self.card.name
                 await fabric.publish(

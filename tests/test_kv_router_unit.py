@@ -1,0 +1,154 @@
+"""Unit tests for the KV-aware routing primitives (no fabric, no hardware)."""
+
+import random
+
+from dynamo_tpu.kv_router.approx import ApproxKvIndexer
+from dynamo_tpu.kv_router.indexer import RadixTree
+from dynamo_tpu.kv_router.scheduler import (
+    DefaultWorkerSelector,
+    KvRouterConfig,
+    WorkerSnapshot,
+    softmax_sample,
+)
+from dynamo_tpu.kv_router.sequence import ActiveSequences
+from dynamo_tpu.tokens import hash_token_blocks
+
+
+def _store(tree, worker, hashes):
+    tree.apply_event(worker, {"kind": "stored", "block_hashes": list(hashes)})
+
+
+class TestRadixTree:
+    def test_contiguous_prefix_scoring(self):
+        t = RadixTree()
+        h = hash_token_blocks(list(range(64 * 4)), block_size=64)
+        _store(t, "w1", h[:3])
+        _store(t, "w2", h[:1])
+        m = t.find_matches(h)
+        assert m.scores == {"w1": 3, "w2": 1}
+        assert m.matched_blocks == 3
+
+    def test_gap_breaks_contiguity(self):
+        t = RadixTree()
+        h = hash_token_blocks(list(range(64 * 4)), block_size=64)
+        # w1 lost block 1 to eviction but still holds 2: only block 0 counts.
+        _store(t, "w1", [h[0], h[2]])
+        m = t.find_matches(h)
+        assert m.scores == {"w1": 1}
+
+    def test_removed_event(self):
+        t = RadixTree()
+        h = hash_token_blocks(list(range(64 * 2)), block_size=64)
+        _store(t, "w1", h)
+        t.apply_event("w1", {"kind": "removed", "block_hashes": [h[1]]})
+        assert t.find_matches(h).scores == {"w1": 1}
+        assert t.blocks_for("w1") == 1
+
+    def test_remove_worker(self):
+        t = RadixTree()
+        h = hash_token_blocks(list(range(64 * 3)), block_size=64)
+        _store(t, "w1", h)
+        _store(t, "w2", h[:2])
+        assert t.remove_worker("w1") == 3
+        assert t.find_matches(h).scores == {"w2": 2}
+        assert t.num_workers() == 1
+
+    def test_no_match(self):
+        t = RadixTree()
+        h = hash_token_blocks(list(range(128)), block_size=64)
+        assert t.find_matches(h).scores == {}
+
+    def test_salt_isolation(self):
+        t = RadixTree()
+        tokens = list(range(64))
+        _store(t, "w1", hash_token_blocks(tokens, block_size=64, salt="a"))
+        m = t.find_matches(hash_token_blocks(tokens, block_size=64, salt="b"))
+        assert m.scores == {}
+
+
+class TestSelector:
+    def _w(self, iid, active=0, total=1000):
+        return WorkerSnapshot(
+            instance_id=iid, kv_active_blocks=active, kv_total_blocks=total
+        )
+
+    def test_prefers_overlap(self):
+        sel = DefaultWorkerSelector(KvRouterConfig(temperature=0.0))
+        workers = [self._w("a"), self._w("b")]
+        assert sel.select(workers, {"b": 8}, 10) == "b"
+
+    def test_load_beats_small_overlap(self):
+        sel = DefaultWorkerSelector(KvRouterConfig(temperature=0.0))
+        # b has 1 block of overlap but is heavily loaded; a is idle.
+        workers = [self._w("a", active=0), self._w("b", active=500)]
+        assert sel.select(workers, {"b": 1}, 10) == "a"
+
+    def test_full_worker_excluded(self):
+        sel = DefaultWorkerSelector(KvRouterConfig(temperature=0.0))
+        workers = [self._w("a", active=999, total=1000), self._w("b")]
+        assert sel.select(workers, {"a": 10}, 10) == "b"
+
+    def test_temperature_spreads(self):
+        sel = DefaultWorkerSelector(KvRouterConfig(temperature=10.0, seed=7))
+        workers = [self._w("a"), self._w("b")]
+        picks = {sel.select(workers, {}, 4) for _ in range(50)}
+        assert picks == {"a", "b"}
+
+    def test_softmax_sample_argmax_at_zero(self):
+        assert softmax_sample([-5.0, -1.0, -9.0], 0.0, random.Random(0)) == 1
+
+    def test_empty(self):
+        sel = DefaultWorkerSelector()
+        assert sel.select([], {}, 4) is None
+
+
+class TestActiveSequences:
+    def test_add_grow_free(self):
+        a = ActiveSequences(block_size=4)
+        a.add("w1", "r1", 3)
+        assert a.active_blocks("w1") == 3
+        a.on_tokens("r1", 4)  # one full block generated
+        assert a.active_blocks("w1") == 4
+        a.on_tokens("r1", 3)  # partial — no growth yet
+        assert a.active_blocks("w1") == 4
+        assert a.free("r1") == "w1"
+        assert a.active_blocks("w1") == 0
+
+    def test_remove_worker(self):
+        a = ActiveSequences(block_size=4)
+        a.add("w1", "r1", 2)
+        a.add("w1", "r2", 2)
+        a.add("w2", "r3", 1)
+        assert a.remove_worker("w1") == 2
+        assert a.active_blocks("w1") == 0
+        assert a.active_blocks("w2") == 1
+
+    def test_double_add_replaces(self):
+        a = ActiveSequences(block_size=4)
+        a.add("w1", "r1", 2)
+        a.add("w2", "r1", 3)
+        assert a.active_blocks("w1") == 0
+        assert a.active_blocks("w2") == 3
+
+
+class TestApproxIndexer:
+    def test_ttl_expiry(self):
+        now = [0.0]
+        idx = ApproxKvIndexer(ttl_s=10.0, clock=lambda: now[0])
+        h = hash_token_blocks(list(range(128)), block_size=64)
+        idx.process_routing_decision("w1", h)
+        assert idx.find_matches(h).scores == {"w1": 2}
+        now[0] = 11.0
+        assert idx.find_matches(h).scores == {}
+
+    def test_ttl_refresh_extends(self):
+        now = [0.0]
+        idx = ApproxKvIndexer(ttl_s=10.0, clock=lambda: now[0])
+        h = hash_token_blocks(list(range(128)), block_size=64)
+        idx.process_routing_decision("w1", h)
+        now[0] = 5.0
+        idx.process_routing_decision("w1", h)  # refresh
+        now[0] = 11.0  # past the first deadline, inside the second
+        assert idx.find_matches(h).scores == {"w1": 2}
+        now[0] = 16.0
+        assert idx.find_matches(h).scores == {}
