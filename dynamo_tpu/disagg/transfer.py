@@ -29,7 +29,7 @@ from dynamo_tpu.runtime.codec import encode_frame, read_frame
 
 logger = logging.getLogger(__name__)
 
-#: write callback: (page_ids, k, v) -> awaitable; arrays [L, n, ps, Hkv, D]
+#: write callback: (page_ids, k, v) -> awaitable; arrays [L, Hkv, n, ps, D]
 WriteFn = Callable[[Sequence[int], np.ndarray, np.ndarray], Awaitable[None]]
 
 
@@ -107,7 +107,7 @@ class KvTransferServer:
             await writer.drain()
             return
         page_ids = header["page_ids"]
-        shape = tuple(header["shape"])  # [L, n, ps, Hkv, D]
+        shape = tuple(header["shape"])  # [L, Hkv, n, ps, D]
         dtype = np.dtype(header["dtype"])
         nbytes = int(np.prod(shape)) * dtype.itemsize
         k = np.frombuffer(payload[:nbytes], dtype=dtype).reshape(shape)
@@ -177,9 +177,9 @@ class KvTransferClient:
         v: np.ndarray,
         first_token: int,
     ) -> bool:
-        """Ship pages; True on decode-side ack. k/v: [L, n, ps, Hkv, D]
+        """Ship pages; True on decode-side ack. k/v: [L, Hkv, n, ps, D]
         with n == len(page_ids)."""
-        assert k.shape == v.shape and k.shape[1] == len(page_ids), (
+        assert k.shape == v.shape and k.shape[2] == len(page_ids), (
             k.shape, len(page_ids),
         )
         key = (host, port)

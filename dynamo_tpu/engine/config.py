@@ -34,6 +34,9 @@ class EngineConfig:
     eos_token_ids: tuple[int, ...] = ()
     #: dtype name for params/KV ("bfloat16" | "float32")
     dtype: str = "bfloat16"
+    #: decode attention: "auto" (pallas on TPU single-chip, else xla),
+    #: "xla", or "pallas"
+    attention_impl: str = "auto"
     #: mesh layout
     dp: int = 1
     tp: int = 1

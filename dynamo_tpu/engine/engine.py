@@ -73,7 +73,28 @@ class JaxEngine:
         checkpoint_path: Optional[str] = None,
     ):
         self.config = config
-        self.adapter: ModelAdapter = get_model(config.model, dtype=config.dtype)
+        mc = mesh_config or MeshConfig(dp=config.dp, tp=config.tp)
+        impl = config.attention_impl
+        if impl not in ("auto", "xla", "pallas"):
+            raise ValueError(
+                f"unknown attention_impl {impl!r}; use auto|xla|pallas"
+            )
+        if impl == "pallas" and mc.num_devices > 1:
+            raise ValueError(
+                "attention_impl='pallas' is single-chip only for now (the "
+                "kernel is not shard_map-wrapped for GSPMD); use 'auto'"
+            )
+        if impl == "auto":
+            # The pallas decode kernel is not yet shard_map-wrapped for
+            # GSPMD partitioning, so multi-chip meshes stay on the XLA path.
+            impl = (
+                "pallas"
+                if jax.default_backend() == "tpu" and mc.num_devices == 1
+                else "xla"
+            )
+        self.adapter: ModelAdapter = get_model(
+            config.model, dtype=config.dtype, attention_impl=impl
+        )
         self.allocator = PageAllocator(
             config.num_pages, config.page_size, on_event=on_kv_event
         )
@@ -82,7 +103,6 @@ class JaxEngine:
         self._outputs_emitted: set[str] = set()
         self._jit_cache: dict[tuple, Callable] = {}
 
-        mc = mesh_config or MeshConfig(dp=config.dp, tp=config.tp)
         self.mesh = make_mesh(mc) if mc.num_devices > 1 else None
 
         if params is None:
@@ -362,10 +382,10 @@ class JaxEngine:
     #  re-done as explicit page movement through host/DCN for TPU.)
 
     def extract_pages(self, page_ids: Sequence[int]):
-        """Pull KV pages to host: (k, v) as [L, n, page_size, Hkv, D]."""
+        """Pull KV pages to host: (k, v) as [L, Hkv, n, page_size, D]."""
         ids = jnp.asarray(np.asarray(page_ids, np.int32))
-        k = np.asarray(jax.device_get(jnp.take(self.kv.k, ids, axis=1)))
-        v = np.asarray(jax.device_get(jnp.take(self.kv.v, ids, axis=1)))
+        k = np.asarray(jax.device_get(jnp.take(self.kv.k, ids, axis=2)))
+        v = np.asarray(jax.device_get(jnp.take(self.kv.v, ids, axis=2)))
         return k, v
 
     def inject_pages(self, page_ids: Sequence[int], k: np.ndarray, v: np.ndarray) -> None:
@@ -375,8 +395,8 @@ class JaxEngine:
         if fn is None:
             def inject_fn(kv, ids, kk, vv):
                 return type(kv)(
-                    k=kv.k.at[:, ids].set(kk.astype(kv.k.dtype)),
-                    v=kv.v.at[:, ids].set(vv.astype(kv.v.dtype)),
+                    k=kv.k.at[:, :, ids].set(kk.astype(kv.k.dtype)),
+                    v=kv.v.at[:, :, ids].set(vv.astype(kv.v.dtype)),
                 )
             fn = jax.jit(inject_fn, donate_argnums=(0,))
             self._jit_cache[("inject", n)] = fn

@@ -51,6 +51,9 @@ class LlamaConfig:
     rope_high_freq_factor: float = 4.0
     rope_original_max_position: int = 8192
     dtype: Any = jnp.bfloat16
+    #: "xla" (gather path, any T) | "pallas" (DMA kernel for decode T=1;
+    #: prefill chunks still take the XLA path)
+    attention_impl: str = "xla"
 
     @property
     def q_per_kv(self) -> int:
@@ -119,9 +122,11 @@ class LlamaConfig:
 class KVPages(NamedTuple):
     """Paged KV cache: one page pool shared by all sequences of a worker.
 
-    k, v: [num_layers, num_pages, page_size, num_kv_heads, head_dim]
-    Page 0 is the null page: padding writes land there and no real page
-    table ever references it.
+    k, v: [num_layers, num_kv_heads, num_pages, page_size, head_dim]
+    Head-major so one (head, page) slice is a contiguous [S, D] block — a
+    single dense DMA descriptor for the Pallas decode kernel and the natural
+    unit for tp sharding (heads ride with their shard). Page 0 is the null
+    page: padding writes land there and no real page table ever references it.
     """
 
     k: jax.Array
@@ -129,17 +134,17 @@ class KVPages(NamedTuple):
 
     @property
     def num_pages(self) -> int:
-        return self.k.shape[1]
+        return self.k.shape[2]
 
     @property
     def page_size(self) -> int:
-        return self.k.shape[2]
+        return self.k.shape[3]
 
 
 def init_kv_pages(
     cfg: LlamaConfig, num_pages: int, page_size: int, dtype=None
 ) -> KVPages:
-    shape = (cfg.num_layers, num_pages, page_size, cfg.num_kv_heads, cfg.head_dim)
+    shape = (cfg.num_layers, cfg.num_kv_heads, num_pages, page_size, cfg.head_dim)
     dtype = dtype or cfg.dtype
     return KVPages(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
 
@@ -266,7 +271,7 @@ def apply_rope(x: jax.Array, positions: jax.Array, cfg: LlamaConfig) -> jax.Arra
 
 
 def paged_scatter(
-    cache: jax.Array,  # [P, S, Hkv, D]
+    cache: jax.Array,  # [Hkv, P, S, D]
     new: jax.Array,  # [B, T, Hkv, D]
     page_tables: jax.Array,  # [B, MP] int32
     positions: jax.Array,  # [B, T] int32
@@ -276,7 +281,7 @@ def paged_scatter(
 
     Invalid (padding) slots are redirected to the null page 0 slot 0.
     """
-    page_size = cache.shape[1]
+    page_size = cache.shape[2]
     page_of = positions // page_size  # [B,T] index into page table
     slot_of = positions % page_size
     page_ids = jnp.take_along_axis(page_tables, page_of, axis=1)  # [B,T]
@@ -284,26 +289,26 @@ def paged_scatter(
     slot_of = jnp.where(valid, slot_of, 0)
     flat_pages = page_ids.reshape(-1)
     flat_slots = slot_of.reshape(-1)
-    flat_new = new.reshape((-1,) + new.shape[2:])
-    return cache.at[flat_pages, flat_slots].set(flat_new, mode="drop")
+    flat_new = new.reshape(-1, new.shape[2], new.shape[3]).swapaxes(0, 1)  # [Hkv,N,D]
+    return cache.at[:, flat_pages, flat_slots].set(flat_new, mode="drop")
 
 
 def paged_gather(cache: jax.Array, page_tables: jax.Array) -> jax.Array:
-    """[P, S, Hkv, D] × [B, MP] -> [B, MP*S, Hkv, D], position-ordered."""
-    g = cache[page_tables]  # [B, MP, S, Hkv, D]
-    b, mp, s = g.shape[0], g.shape[1], g.shape[2]
-    return g.reshape(b, mp * s, *g.shape[3:])
+    """[Hkv, P, S, D] × [B, MP] -> [Hkv, B, MP*S, D], position-ordered."""
+    g = cache[:, page_tables]  # [Hkv, B, MP, S, D]
+    hkv, b, mp, s, d = g.shape
+    return g.reshape(hkv, b, mp * s, d)
 
 
 def paged_attention(
     q: jax.Array,  # [B, T, Hq, D] (post-rope)
-    k_pages: jax.Array,  # [B, K, Hkv, D] gathered, position-ordered
-    v_pages: jax.Array,  # [B, K, Hkv, D]
+    k_pages: jax.Array,  # [Hkv, B, K, D] gathered, position-ordered
+    v_pages: jax.Array,  # [Hkv, B, K, D]
     q_positions: jax.Array,  # [B, T]
     cfg: LlamaConfig,
 ) -> jax.Array:
-    """Reference paged attention (XLA path; Pallas kernel in dynamo_tpu.ops
-    replaces this on TPU for long contexts).
+    """Reference paged attention (XLA path; the Pallas decode kernel in
+    dynamo_tpu.ops replaces this for T=1 when cfg.attention_impl="pallas").
 
     Causality over the whole paged history: key at gathered index i has
     absolute position i, so the mask is simply key_pos <= q_pos. Unallocated
@@ -311,18 +316,18 @@ def paged_attention(
     comparison.
     """
     b, t, hq, d = q.shape
-    kk = k_pages.shape[1]
+    kk = k_pages.shape[2]
     g = cfg.q_per_kv
     qg = q.reshape(b, t, cfg.num_kv_heads, g, d)
     scale = 1.0 / math.sqrt(d)
     scores = jnp.einsum(
-        "btkgd,bskd->bkgts", qg.astype(jnp.float32), k_pages.astype(jnp.float32)
+        "btkgd,kbsd->bkgts", qg.astype(jnp.float32), k_pages.astype(jnp.float32)
     ) * scale
     key_pos = jnp.arange(kk)[None, None, None, None, :]
     mask = key_pos <= q_positions[:, None, None, :, None]
     scores = jnp.where(mask, scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("bkgts,bskd->btkgd", probs, v_pages.astype(jnp.float32))
+    out = jnp.einsum("bkgts,kbsd->btkgd", probs, v_pages.astype(jnp.float32))
     return out.reshape(b, t, hq * d).astype(q.dtype)
 
 
@@ -361,9 +366,17 @@ def forward_hidden(
         k = apply_rope(k, positions, cfg)
         k_cache = paged_scatter(k_cache, k, page_tables, positions, valid)
         v_cache = paged_scatter(v_cache, v, page_tables, positions, valid)
-        k_all = paged_gather(k_cache, page_tables)
-        v_all = paged_gather(v_cache, page_tables)
-        attn = paged_attention(q, k_all, v_all, positions, cfg)
+        if cfg.attention_impl == "pallas" and t == 1:
+            from dynamo_tpu.ops.paged_attention import paged_decode_attention
+
+            seq_lens = positions[:, 0] + 1
+            attn = paged_decode_attention(
+                q[:, 0], k_cache, v_cache, page_tables, seq_lens
+            )[:, None, :]
+        else:
+            k_all = paged_gather(k_cache, page_tables)
+            v_all = paged_gather(v_cache, page_tables)
+            attn = paged_attention(q, k_all, v_all, positions, cfg)
         h = h + attn @ lp["wo"]
         x = rms_norm(h, lp["mlp_norm"], cfg.rms_norm_eps)
         gate = jax.nn.silu((x @ lp["w_gate"]).astype(jnp.float32))

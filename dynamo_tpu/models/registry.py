@@ -84,7 +84,11 @@ def _load_llama_checkpoint(path: str, cfg: LlamaConfig):
     return llama_mod.params_from_torch_state_dict(model.state_dict(), cfg)
 
 
-def get_model(name: str, dtype: Optional[str] = None) -> ModelAdapter:
+def get_model(
+    name: str,
+    dtype: Optional[str] = None,
+    attention_impl: Optional[str] = None,
+) -> ModelAdapter:
     """Resolve a model name: preset id, or a local HF checkpoint dir."""
     key = name.lower()
     if key in _LLAMA_PRESETS:
@@ -114,4 +118,6 @@ def get_model(name: str, dtype: Optional[str] = None) -> ModelAdapter:
                 )
             dtype = table[dtype]
         cfg = replace(cfg, dtype=dtype)
+    if attention_impl is not None:
+        cfg = replace(cfg, attention_impl=attention_impl)
     return _llama_adapter(name, cfg)

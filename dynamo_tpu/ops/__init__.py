@@ -1,0 +1,12 @@
+"""Pallas TPU kernels for the hot ops.
+
+The engine's default compute path is plain XLA (models/llama.py) — fully
+fused and fine for short contexts. These kernels replace the pieces where
+hand-control over HBM traffic wins: paged-attention decode streams KV pages
+HBM→VMEM once with double-buffered DMA instead of materializing the whole
+gathered history (paged_gather) in HBM.
+"""
+
+from dynamo_tpu.ops.paged_attention import paged_decode_attention
+
+__all__ = ["paged_decode_attention"]

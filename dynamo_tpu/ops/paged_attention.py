@@ -1,0 +1,153 @@
+"""Pallas TPU paged-attention decode kernel.
+
+Decode (T=1) attention against the paged KV cache. The XLA fallback path
+(models/llama.py:paged_attention) gathers the full per-sequence KV history
+into a dense [B, K, Hkv, D] array in HBM before the matmuls — 2× the HBM
+traffic (read pages, write gather, read gather) plus O(B·MP·S) memory. This
+kernel instead walks each sequence's page table and streams pages HBM→VMEM
+with double-buffered async DMA, accumulating a flash-style online softmax.
+KV bytes are read exactly once, nothing is materialized.
+
+Cache layout is [Hkv, P, S, D] per layer (models/llama.py KVPages), so one
+(head, page) slice is a contiguous [S, D] block — a single dense DMA
+descriptor per page.
+
+Grid: (B, Hkv) — one cell per (sequence, kv-head); the q-head group G=Hq/Hkv
+rides the sublane dim. Decode attention is HBM-bandwidth-bound, so the tiny
+per-cell matmuls ([G,S]·[S,D]) are irrelevant; the DMA pipeline is the point.
+
+Parity: replaces the paged-attention kernels the reference gets from vLLM /
+TRT-LLM (engine-delegated, SURVEY.md §2.9); on TPU the engine is first-class
+so the kernel lives here.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _decode_kernel(
+    # scalar prefetch
+    pt_ref,  # [B, MP] int32 page tables (SMEM)
+    len_ref,  # [B] int32 kv lengths, incl. the token being decoded (SMEM)
+    # inputs
+    q_ref,  # [1, 1, G, D] VMEM block (this cell's q-head group, pre-scaled)
+    k_ref,  # [Hkv, P, S, D] in HBM/ANY
+    v_ref,  # [Hkv, P, S, D] in HBM/ANY
+    # output
+    o_ref,  # [1, 1, G, D] VMEM block
+    # scratch
+    k_scr,  # [2, S, D] VMEM
+    v_scr,  # [2, S, D] VMEM
+    sem,  # [2, 2] DMA semaphores: [k|v, slot]
+    *,
+    page_size: int,
+):
+    b = pl.program_id(0)
+    h = pl.program_id(1)
+    g, d = q_ref.shape[2], q_ref.shape[3]
+    s = page_size
+    seq_len = len_ref[b]
+    used = pl.cdiv(seq_len, s)  # pages this sequence actually occupies
+
+    def k_copy(slot, i):
+        return pltpu.make_async_copy(
+            k_ref.at[h, pt_ref[b, i]], k_scr.at[slot], sem.at[0, slot]
+        )
+
+    def v_copy(slot, i):
+        return pltpu.make_async_copy(
+            v_ref.at[h, pt_ref[b, i]], v_scr.at[slot], sem.at[1, slot]
+        )
+
+    # Warm up the pipeline (seq_len >= 1 always: the decoded token itself).
+    k_copy(0, 0).start()
+    v_copy(0, 0).start()
+
+    # Scale after the f32 cast so bf16 q matches the XLA path bit-for-bit.
+    q = q_ref[0, 0].astype(jnp.float32) * (1.0 / math.sqrt(d))  # [G, D]
+
+    def body(i, carry):
+        m, l, acc = carry
+        slot = jax.lax.rem(i, 2)
+
+        @pl.when(i + 1 < used)
+        def _():
+            k_copy(1 - slot, i + 1).start()
+            v_copy(1 - slot, i + 1).start()
+
+        k_copy(slot, i).wait()
+        v_copy(slot, i).wait()
+
+        k = k_scr[slot].astype(jnp.float32)  # [S, D]
+        scores = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )  # [G, S]
+        key_pos = i * s + jax.lax.broadcasted_iota(jnp.int32, (g, s), 1)
+        scores = jnp.where(key_pos < seq_len, scores, -1e30)
+
+        m_new = jnp.maximum(m, jnp.max(scores, axis=1, keepdims=True))
+        p = jnp.exp(scores - m_new)  # [G, S]
+        corr = jnp.exp(m - m_new)  # [G, 1]
+        l_new = l * corr + jnp.sum(p, axis=1, keepdims=True)
+        v = v_scr[slot].astype(jnp.float32)  # [S, D]
+        acc_new = acc * corr + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((g, 1), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((g, 1), jnp.float32)
+    a0 = jnp.zeros((g, d), jnp.float32)
+    _, l, acc = jax.lax.fori_loop(0, used, body, (m0, l0, a0))
+    o_ref[0, 0] = (acc / l).astype(o_ref.dtype)
+
+
+def paged_decode_attention(
+    q: jax.Array,  # [B, Hq, D] post-rope decode queries
+    k_cache: jax.Array,  # [Hkv, P, S, D]
+    v_cache: jax.Array,  # [Hkv, P, S, D]
+    page_tables: jax.Array,  # [B, MP] int32
+    seq_lens: jax.Array,  # [B] int32 — kv length incl. the decoded token
+    *,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Returns [B, Hq*D] attention output, matching the XLA paged path.
+
+    `interpret` defaults to True off-TPU so tests run the same kernel on CPU.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    b, hq, d = q.shape
+    hkv, _, s, _ = k_cache.shape
+    g = hq // hkv
+    qr = q.reshape(b, hkv, g, d)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, hkv),
+        in_specs=[
+            pl.BlockSpec((1, 1, g, d), lambda bi, hi, pt, ln: (bi, hi, 0, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec((1, 1, g, d), lambda bi, hi, pt, ln: (bi, hi, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((2, s, d), k_cache.dtype),
+            pltpu.VMEM((2, s, d), v_cache.dtype),
+            pltpu.SemaphoreType.DMA((2, 2)),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_decode_kernel, page_size=s),
+        out_shape=jax.ShapeDtypeStruct((b, hkv, g, d), q.dtype),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(page_tables.astype(jnp.int32), seq_lens.astype(jnp.int32), qr, k_cache, v_cache)
+    return out.reshape(b, hq * d)

@@ -101,7 +101,7 @@ def test_kv_transfer_numerical_equivalence(tiny_cfg):
     assert first == ref_tokens[:1]
     held = pre.scheduler.held["d1"]
     k, v = pre.extract_pages(held)
-    assert k.shape[1] == len(held)
+    assert k.shape[2] == len(held)  # [L, Hkv, n, ps, D]
 
     # decode engine: reserve, inject, admit, continue
     dec = JaxEngine(tiny_cfg)
