@@ -44,6 +44,12 @@ class EngineConfig:
     seed: int = 0
     #: enable content-addressed prefix caching
     enable_prefix_caching: bool = True
+    #: KVBM tiering (dynamo_tpu/kvbm): host-DRAM tier byte budget (0 = off)
+    host_kv_cache_bytes: int = 0
+    #: disk tier byte budget (0 = off; needs disk_kv_cache_dir)
+    disk_kv_cache_bytes: int = 0
+    #: directory for the disk tier's block files
+    disk_kv_cache_dir: Optional[str] = None
 
     @property
     def max_context(self) -> int:

@@ -95,9 +95,23 @@ class JaxEngine:
         self.adapter: ModelAdapter = get_model(
             config.model, dtype=config.dtype, attention_impl=impl
         )
-        self.allocator = PageAllocator(
-            config.num_pages, config.page_size, on_event=on_kv_event
-        )
+        if config.host_kv_cache_bytes > 0 or config.disk_kv_cache_bytes > 0:
+            from dynamo_tpu.kvbm import TieredPageAllocator
+
+            self.allocator: PageAllocator = TieredPageAllocator(
+                config.num_pages,
+                config.page_size,
+                extract_fn=self.extract_pages,
+                inject_fn=self.inject_pages,
+                host_bytes=config.host_kv_cache_bytes,
+                disk_bytes=config.disk_kv_cache_bytes,
+                disk_dir=config.disk_kv_cache_dir,
+                on_event=on_kv_event,
+            )
+        else:
+            self.allocator = PageAllocator(
+                config.num_pages, config.page_size, on_event=on_kv_event
+            )
         self.scheduler = Scheduler(config, self.allocator)
         self.metrics = EngineMetrics(kv_total_pages=config.num_pages - 1)
         self._outputs_emitted: set[str] = set()

@@ -43,6 +43,9 @@ class PrefixCacheStats:
     query_tokens: int = 0
     stored_blocks: int = 0
     evicted_blocks: int = 0
+    # KVBM tier movement (dynamo_tpu/kvbm) — zero when tiering is off
+    offloaded_blocks: int = 0
+    onboarded_blocks: int = 0
 
     @property
     def hit_rate(self) -> float:

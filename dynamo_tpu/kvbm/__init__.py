@@ -1,0 +1,25 @@
+"""KVBM — multi-tier KV block manager.
+
+TPU-native analogue of the reference's KV Block Manager (/root/reference
+lib/llm/src/block_manager.rs:69-78): a tier hierarchy
+
+    G1 device HBM  (the engine's page pool, models/llama.py KVPages)
+    G2 host DRAM   (HostTier — bounded bytes, LRU)
+    G3 local disk  (DiskTier — bounded bytes, LRU, one file per block)
+
+Content-addressed blocks evicted from the device prefix cache are *offloaded*
+down the hierarchy instead of dropped; a later prefix hit *onboards* them
+back into fresh device pages (block_manager.rs:169 onboard_blocks). Effective
+KV capacity becomes host-DRAM/disk-sized rather than HBM-sized — the
+reference reports +40% TTFT from exactly this (SURVEY.md §6).
+
+Where the reference moves blocks with CUDA memcpy/NIXL RDMA agents
+(block/transfer.rs:83-111), the TPU build moves them through JAX device
+transfers: extract = gather pages → host numpy; inject = scatter into the
+device pool (engine.extract_pages / inject_pages).
+"""
+
+from dynamo_tpu.kvbm.manager import TieredPageAllocator
+from dynamo_tpu.kvbm.tiers import BlockEntry, DiskTier, HostTier
+
+__all__ = ["TieredPageAllocator", "HostTier", "DiskTier", "BlockEntry"]

@@ -1,0 +1,173 @@
+"""TieredPageAllocator: the engine's PageAllocator with G2/G3 offload.
+
+Drop-in subclass of engine.page_table.PageAllocator (the scheduler is
+unaware of tiering):
+
+- **Offload on eviction**: when a content-addressed page is about to be
+  evicted from the device pool (its KV bytes would be lost), the block is
+  extracted to the host tier first; host-tier overflow demotes to disk
+  (reference: OffloadManager priority queues, block_manager/offload.rs).
+- **Onboard on prefix hit**: `lookup` first matches device-resident pages
+  (free reuse), then continues the chain through host/disk; found blocks are
+  injected into freshly allocated device pages and registered, extending the
+  effective prefix cache past HBM (block_manager.rs:169 onboard_blocks).
+
+Accounting: `match_length` stays device-only on purpose — onboarded blocks
+consume fresh device pages, so the scheduler's admission math (pages needed
+= total - device-cached) remains exact whether or not onboarding succeeds.
+
+Offload/onboard transfers are synchronous device<->host copies for now
+(device_get/device_put on the page axis); async double-buffered offload
+streams are a planned optimization.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+
+from dynamo_tpu.engine.page_table import KvEvent, PageAllocator
+from dynamo_tpu.kvbm.tiers import BlockEntry, DiskTier, HostTier
+
+logger = logging.getLogger(__name__)
+
+#: (page_ids) -> (k, v) as [L, Hkv, n, S, D] host arrays
+ExtractFn = Callable[[Sequence[int]], tuple[np.ndarray, np.ndarray]]
+#: (page_ids, k, v) -> None, same shapes
+InjectFn = Callable[[Sequence[int], np.ndarray, np.ndarray], None]
+
+
+class TieredPageAllocator(PageAllocator):
+    def __init__(
+        self,
+        num_pages: int,
+        page_size: int,
+        extract_fn: ExtractFn,
+        inject_fn: InjectFn,
+        host_bytes: int = 0,
+        disk_bytes: int = 0,
+        disk_dir: Optional[str] = None,
+        on_event=None,
+    ):
+        super().__init__(num_pages, page_size, on_event=on_event)
+        self._extract_fn = extract_fn
+        self._inject_fn = inject_fn
+        if disk_bytes > 0 and not disk_dir:
+            raise ValueError(
+                "disk KV tier enabled (disk_bytes > 0) but no disk_dir given"
+            )
+        self.disk: Optional[DiskTier] = (
+            DiskTier(disk_dir, disk_bytes) if disk_bytes > 0 else None
+        )
+        demote = self.disk.put if self.disk is not None else None
+        self.host: Optional[HostTier] = (
+            HostTier(host_bytes, demote=demote) if host_bytes > 0 else None
+        )
+        self._offload_enabled = self.host is not None or self.disk is not None
+
+    # -- offload (device eviction hook) ------------------------------------
+
+    def _offload_pages(self, pages: Sequence[int]) -> None:
+        """Extract `pages` in one batched device read and store them down
+        the tier hierarchy. Pages must still be registered."""
+        todo = []
+        for page in pages:
+            seq_hash, parent_hash, tokens = self._page_meta[page]
+            in_lower = (self.host is not None and seq_hash in self.host) or (
+                self.disk is not None and seq_hash in self.disk
+            )
+            if not in_lower:
+                todo.append((page, seq_hash, parent_hash, tokens))
+        if not todo:
+            return
+        k, v = self._extract_fn([p for p, _, _, _ in todo])
+        for i, (_, seq_hash, parent_hash, tokens) in enumerate(todo):
+            entry = BlockEntry(
+                seq_hash=seq_hash, parent_hash=parent_hash, tokens=tokens,
+                k=np.ascontiguousarray(k[:, :, i]),
+                v=np.ascontiguousarray(v[:, :, i]),
+            )
+            if self.host is not None:
+                ok = self.host.put(entry)
+            else:
+                ok = self.disk.put(entry)
+            if ok:
+                self.stats.offloaded_blocks += 1
+
+    def allocate(self, n: int) -> Optional[list[int]]:
+        """Pre-offload the eviction victims in ONE batched device read
+        (instead of one sync per page inside the eviction loop); the
+        per-page _evict hook then sees them already in a lower tier."""
+        if self._offload_enabled and n <= self.num_free:
+            n_evict = n - min(len(self._free), n)
+            if n_evict > 0:
+                victims = list(self._reclaimable)[:n_evict]  # LRU-first
+                self._offload_pages(victims)
+        return super().allocate(n)
+
+    def _evict(self, page: int) -> None:
+        if self._offload_enabled:
+            self._offload_pages([page])
+        super()._evict(page)
+
+    # -- onboard (prefix-hit continuation) ---------------------------------
+
+    def _tier_get(self, seq_hash: int) -> Optional[BlockEntry]:
+        if self.host is not None:
+            e = self.host.get(seq_hash)
+            if e is not None:
+                return e
+        if self.disk is not None:
+            return self.disk.get(seq_hash)
+        return None
+
+    def lookup(self, seq_hashes: Sequence[int]) -> list[int]:
+        pages = super().lookup(seq_hashes)
+        if not self._offload_enabled or len(pages) >= len(seq_hashes):
+            return pages
+        # Continue the chain through the lower tiers.
+        found: list[BlockEntry] = []
+        for h in seq_hashes[len(pages):]:
+            e = self._tier_get(h)
+            if e is None:
+                break
+            found.append(e)
+        if not found:
+            return pages
+        fresh = self.allocate(len(found))  # may itself evict+offload: fine,
+        if fresh is None:  # entries already hold their arrays
+            return pages  # pool pressure — skip onboarding this time
+        k = np.stack([e.k for e in found], axis=2)  # [L, Hkv, n, S, D]
+        v = np.stack([e.v for e in found], axis=2)
+        self._inject_fn(fresh, k, v)
+        for page, e in zip(fresh, found):
+            self.register(page, e.seq_hash, e.parent_hash, e.tokens)
+            # Promote: the block lives on device again; drop lower copies so
+            # tier bytes track unique content.
+            if self.host is not None:
+                self.host.pop(e.seq_hash)
+            if self.disk is not None:
+                self.disk.pop(e.seq_hash)
+        self.stats.onboarded_blocks += len(found)
+        self.stats.hit_tokens += len(found) * self.page_size
+        pages.extend(fresh)
+        return pages
+
+    # -- cache clearing ----------------------------------------------------
+
+    def clear_cache(self) -> int:
+        """/clear_kv_blocks semantics: drop cached content in ALL tiers."""
+        prev, self._offload_enabled = self._offload_enabled, False
+        try:
+            n = super().clear_cache()
+        finally:
+            self._offload_enabled = prev
+        if self.host is not None:
+            n += len(self.host)
+            self.host.clear()
+        if self.disk is not None:
+            n += len(self.disk)
+            self.disk.clear()
+        return n

@@ -1,0 +1,187 @@
+"""Host-DRAM and disk KV block tiers (G2/G3).
+
+Both tiers store whole content-addressed blocks — (seq_hash, parent_hash,
+tokens, k, v) with k/v of shape [L, Hkv, S, D] — under a byte budget with
+LRU eviction. A tier may be given a `demote` callback that receives entries
+it evicts, chaining G2 → G3 (the reference's offload pipeline,
+block_manager/offload.rs:17-45).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from collections import OrderedDict
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class BlockEntry:
+    seq_hash: int
+    parent_hash: Optional[int]
+    tokens: tuple[int, ...]
+    k: np.ndarray  # [L, Hkv, S, D]
+    v: np.ndarray
+
+    @property
+    def nbytes(self) -> int:
+        return self.k.nbytes + self.v.nbytes
+
+
+class HostTier:
+    """Bounded in-memory block store, LRU order (oldest first)."""
+
+    def __init__(
+        self,
+        capacity_bytes: int,
+        demote: Optional[Callable[[BlockEntry], None]] = None,
+    ):
+        self.capacity_bytes = capacity_bytes
+        self._demote = demote
+        self._entries: OrderedDict[int, BlockEntry] = OrderedDict()
+        self._bytes = 0
+
+    def __contains__(self, seq_hash: int) -> bool:
+        return seq_hash in self._entries
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    @property
+    def used_bytes(self) -> int:
+        return self._bytes
+
+    def put(self, entry: BlockEntry) -> bool:
+        """True iff the block is preserved (here or via the demote chain)."""
+        if entry.seq_hash in self._entries:
+            return True
+        if entry.nbytes > self.capacity_bytes:
+            # Can never fit this tier — pass straight down the hierarchy.
+            return bool(self._demote is not None and self._demote(entry))
+        self._entries[entry.seq_hash] = entry
+        self._bytes += entry.nbytes
+        while self._bytes > self.capacity_bytes:
+            _, victim = self._entries.popitem(last=False)
+            self._bytes -= victim.nbytes
+            if self._demote is not None:
+                self._demote(victim)
+        return True
+
+    def get(self, seq_hash: int) -> Optional[BlockEntry]:
+        """Read without removing; refreshes LRU recency."""
+        e = self._entries.get(seq_hash)
+        if e is not None:
+            self._entries.move_to_end(seq_hash)
+        return e
+
+    def pop(self, seq_hash: int) -> Optional[BlockEntry]:
+        e = self._entries.pop(seq_hash, None)
+        if e is not None:
+            self._bytes -= e.nbytes
+        return e
+
+    def clear(self) -> None:
+        self._entries.clear()
+        self._bytes = 0
+
+
+def _dtype_from_name(name: str) -> np.dtype:
+    """Resolve numpy AND ml_dtypes names (bfloat16 is not a numpy builtin)."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+class DiskTier:
+    """Bounded on-disk block store: one .npy file per block ([2,L,Hkv,S,D],
+    k stacked over v, stored as raw uint8 bytes because np.save round-trips
+    ml_dtypes.bfloat16 as an unusable void dtype), in-memory LRU index.
+    Process-scoped (the index is not persisted), like the reference's G3
+    pool."""
+
+    def __init__(self, directory: str, capacity_bytes: int):
+        self.directory = directory
+        self.capacity_bytes = capacity_bytes
+        os.makedirs(directory, exist_ok=True)
+        #: seq_hash -> (parent_hash, tokens, nbytes, dtype_name, block_shape)
+        self._index: OrderedDict[
+            int, tuple[Optional[int], tuple[int, ...], int, str, tuple[int, ...]]
+        ] = OrderedDict()
+        self._bytes = 0
+
+    def _path(self, seq_hash: int) -> str:
+        return os.path.join(self.directory, f"{seq_hash & 0xFFFFFFFFFFFFFFFF:016x}.npy")
+
+    def __contains__(self, seq_hash: int) -> bool:
+        return seq_hash in self._index
+
+    def __len__(self) -> int:
+        return len(self._index)
+
+    @property
+    def used_bytes(self) -> int:
+        return self._bytes
+
+    def put(self, entry: BlockEntry) -> bool:
+        if entry.seq_hash in self._index:
+            return True
+        if entry.nbytes > self.capacity_bytes:
+            return False
+        stacked = np.stack([entry.k, entry.v])
+        try:
+            np.save(self._path(entry.seq_hash), stacked.view(np.uint8))
+        except OSError:
+            logger.exception("disk tier write failed for %x", entry.seq_hash)
+            return False
+        self._index[entry.seq_hash] = (
+            entry.parent_hash, entry.tokens, entry.nbytes,
+            entry.k.dtype.name, entry.k.shape,
+        )
+        self._bytes += entry.nbytes
+        while self._bytes > self.capacity_bytes:
+            victim_hash, meta = self._index.popitem(last=False)
+            self._bytes -= meta[2]
+            self._unlink(victim_hash)
+        return True
+
+    def get(self, seq_hash: int) -> Optional[BlockEntry]:
+        meta = self._index.get(seq_hash)
+        if meta is None:
+            return None
+        parent_hash, tokens, _, dtype_name, shape = meta
+        try:
+            raw = np.load(self._path(seq_hash))
+        except OSError:
+            logger.exception("disk tier read failed for %x", seq_hash)
+            self.pop(seq_hash)
+            return None
+        kv = raw.view(_dtype_from_name(dtype_name)).reshape((2, *shape))
+        self._index.move_to_end(seq_hash)
+        return BlockEntry(
+            seq_hash=seq_hash, parent_hash=parent_hash, tokens=tokens,
+            k=kv[0], v=kv[1],
+        )
+
+    def pop(self, seq_hash: int) -> None:
+        meta = self._index.pop(seq_hash, None)
+        if meta is not None:
+            self._bytes -= meta[2]
+            self._unlink(seq_hash)
+
+    def _unlink(self, seq_hash: int) -> None:
+        try:
+            os.unlink(self._path(seq_hash))
+        except OSError:
+            pass
+
+    def clear(self) -> None:
+        for h in list(self._index):
+            self.pop(h)
