@@ -256,6 +256,13 @@ def main(argv: Optional[list[str]] = None) -> None:
     args = p.parse_args(argv)
     configure_logging()
 
+    # Compile the native hot-path core before serving so no request admission
+    # or router construction ever waits on g++ (falls back to Python if the
+    # toolchain is missing).
+    from dynamo_tpu.native import ensure_built
+
+    ensure_built()
+
     if args.cmd == "fabric":
         from dynamo_tpu.runtime.fabric.server import _amain
 

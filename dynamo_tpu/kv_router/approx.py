@@ -16,13 +16,13 @@ import heapq
 import time
 from typing import Optional, Sequence
 
-from dynamo_tpu.kv_router.indexer import OverlapScores, RadixTree
+from dynamo_tpu.kv_router.indexer import OverlapScores, make_radix_tree
 
 
 class ApproxKvIndexer:
     def __init__(self, ttl_s: float = 120.0, clock=time.monotonic):
         self.ttl_s = ttl_s
-        self.tree = RadixTree()
+        self.tree = make_radix_tree()
         self._clock = clock
         #: (expiry, worker_id, hash) min-heap; stale entries are skipped on
         #: pop when _latest shows a refresh

@@ -133,6 +133,126 @@ class RadixTree:
         return len(self._hashes_by_worker.get(worker_id, ()))
 
 
+class NativeRadixTree:
+    """Same interface as RadixTree, backed by the C++ index
+    (native/dynamo_native.cpp RadixIndex) via ctypes. Worker names are
+    interned to u32 ids on the native side; this wrapper mirrors the
+    id<->name mapping and the live-worker set."""
+
+    def __init__(self):
+        from dynamo_tpu import native
+
+        self._lib = native.lib()
+        if self._lib is None:
+            raise RuntimeError("native library unavailable")
+        self._ptr = self._lib.dyn_radix_new()
+        self._ids: dict[str, int] = {}
+        self._names: list[str] = []
+        self._live: set[str] = set()
+
+    def __del__(self):
+        lib, ptr = getattr(self, "_lib", None), getattr(self, "_ptr", None)
+        if lib is not None and ptr:
+            lib.dyn_radix_free(ptr)
+            self._ptr = None
+
+    def _intern(self, worker_id: str) -> int:
+        wid = self._ids.get(worker_id)
+        if wid is None:
+            wid = self._lib.dyn_radix_intern(self._ptr, worker_id.encode())
+            self._ids[worker_id] = wid
+            assert wid == len(self._names)
+            self._names.append(worker_id)
+        return wid
+
+    @staticmethod
+    def _hash_buf(hashes: Sequence[int]):
+        import numpy as np
+
+        try:
+            arr = np.ascontiguousarray(np.asarray(hashes, dtype=np.uint64))
+        except (OverflowError, ValueError, TypeError):
+            arr = np.asarray([h & (1 << 64) - 1 for h in hashes], np.uint64)
+        return arr, arr.ctypes.data, len(arr)
+
+    def apply_event(self, worker_id: str, event: dict) -> None:
+        kind = event["kind"]
+        if kind not in ("stored", "removed"):
+            logger.warning("unknown kv event kind %r", kind)
+            return
+        arr, buf, n = self._hash_buf(event["block_hashes"])
+        self._lib.dyn_radix_apply(
+            self._ptr, self._intern(worker_id), 0 if kind == "stored" else 1,
+            buf, n,
+        )
+        if kind == "stored":
+            self._live.add(worker_id)
+
+    def remove_worker(self, worker_id: str) -> int:
+        self._live.discard(worker_id)
+        wid = self._ids.get(worker_id)
+        if wid is None:
+            return 0
+        return self._lib.dyn_radix_remove_worker(self._ptr, wid)
+
+    def clear(self) -> None:
+        self._lib.dyn_radix_clear(self._ptr)
+        self._live.clear()
+
+    def find_matches(self, seq_hashes: Sequence[int]) -> OverlapScores:
+        import ctypes
+
+        import numpy as np
+
+        out = OverlapScores()
+        if not seq_hashes:
+            return out
+        arr, buf, n = self._hash_buf(seq_hashes)
+        cap = max(1, len(self._names))
+        ids = np.empty(cap, np.uint32)
+        scores = np.empty(cap, np.uint32)
+        matched = ctypes.c_size_t(0)
+        k = self._lib.dyn_radix_find(
+            self._ptr, buf, n, ids.ctypes.data, scores.ctypes.data, cap,
+            ctypes.byref(matched),
+        )
+        out.matched_blocks = int(matched.value)
+        for i in range(k):
+            out.scores[self._names[ids[i]]] = int(scores[i])
+        return out
+
+    # -- introspection (parity with RadixTree) ------------------------------
+
+    @property
+    def events_applied(self) -> int:
+        return self._lib.dyn_radix_events_applied(self._ptr)
+
+    @property
+    def num_blocks(self) -> int:
+        return self._lib.dyn_radix_num_blocks(self._ptr)
+
+    def num_workers(self) -> int:
+        return len(self._live)
+
+    def workers(self) -> set[str]:
+        return set(self._live)
+
+    def blocks_for(self, worker_id: str) -> int:
+        wid = self._ids.get(worker_id)
+        if wid is None:
+            return 0
+        return self._lib.dyn_radix_blocks_for(self._ptr, wid)
+
+
+def make_radix_tree():
+    """Native-backed tree when libdynamo_native is available, else Python."""
+    from dynamo_tpu import native
+
+    if native.lib() is not None:
+        return NativeRadixTree()
+    return RadixTree()
+
+
 class KvIndexer:
     """Event-driven index: subscribes `kv_events.>` on the fabric and keeps
     a RadixTree current (reference: KvIndexer — indexer.rs:518, fed from the
@@ -141,7 +261,7 @@ class KvIndexer:
     def __init__(self, fabric, subject: str = KV_EVENT_SUBJECT):
         self.fabric = fabric
         self.subject = subject
-        self.tree = RadixTree()
+        self.tree = make_radix_tree()
         self._sub = None
         self._task: Optional[asyncio.Task] = None
         self._on_event_hooks = []

@@ -1,0 +1,166 @@
+"""ctypes loader for libdynamo_native (native/ — the C++ hot-path core).
+
+Follows the environment's binding constraints (no pybind11): a plain C ABI
+loaded with ctypes. Set DYNTPU_NO_NATIVE=1 to force the pure-Python
+fallbacks everywhere.
+
+Build discipline:
+- `ensure_built()` — blocking compile+load; call it once from process entry
+  points (CLI/worker startup) before serving.
+- `lib()` — never blocks the caller on a compile: returns the loaded CDLL,
+  or None while a background build (started on first miss) is running.
+  Callers must keep a Python fallback path (tokens/blocks.py,
+  kv_router/indexer.py do).
+- Builds are cross-process safe: compiled under an flock to a temp name in
+  native/build/, then os.replace'd into place so a concurrent loader never
+  dlopens a half-written ELF.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from pathlib import Path
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+_NATIVE_DIR = Path(__file__).resolve().parent.parent / "native"
+_LIB_PATH = _NATIVE_DIR / "build" / "libdynamo_native.so"
+_SOURCES = [_NATIVE_DIR / "dynamo_native.cpp", _NATIVE_DIR / "xxh3.h"]
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_thread: Optional[threading.Thread] = None
+_build_failed = False
+
+
+def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
+    u64, u32, sz = ctypes.c_uint64, ctypes.c_uint32, ctypes.c_size_t
+    p = ctypes.c_void_p
+    lib.dyn_xxh3_64.restype = u64
+    lib.dyn_xxh3_64.argtypes = [ctypes.c_char_p, sz, u64]
+    lib.dyn_hash_token_blocks.restype = sz
+    lib.dyn_hash_token_blocks.argtypes = [p, sz, sz, u64, u64, p, p]
+    lib.dyn_radix_new.restype = p
+    lib.dyn_radix_free.argtypes = [p]
+    lib.dyn_radix_intern.restype = u32
+    lib.dyn_radix_intern.argtypes = [p, ctypes.c_char_p]
+    lib.dyn_radix_apply.argtypes = [p, u32, ctypes.c_int, p, sz]
+    lib.dyn_radix_remove_worker.restype = sz
+    lib.dyn_radix_remove_worker.argtypes = [p, u32]
+    lib.dyn_radix_clear.argtypes = [p]
+    lib.dyn_radix_find.restype = sz
+    lib.dyn_radix_find.argtypes = [p, p, sz, p, p, sz, p]
+    lib.dyn_radix_num_blocks.restype = sz
+    lib.dyn_radix_num_blocks.argtypes = [p]
+    lib.dyn_radix_blocks_for.restype = sz
+    lib.dyn_radix_blocks_for.argtypes = [p, u32]
+    lib.dyn_radix_events_applied.restype = u64
+    lib.dyn_radix_events_applied.argtypes = [p]
+    return lib
+
+
+def _stale() -> bool:
+    if not _LIB_PATH.exists():
+        return True
+    mtime = _LIB_PATH.stat().st_mtime
+    return any(s.exists() and s.stat().st_mtime > mtime for s in _SOURCES)
+
+
+def _build() -> bool:
+    """Compile under an inter-process lock; atomic rename into place."""
+    build_dir = _NATIVE_DIR / "build"
+    try:
+        build_dir.mkdir(parents=True, exist_ok=True)
+        lock_path = build_dir / ".build.lock"
+        with open(lock_path, "w") as lock_f:
+            import fcntl
+
+            fcntl.flock(lock_f, fcntl.LOCK_EX)
+            try:
+                if not _stale():  # another process built it while we waited
+                    return True
+                tmp = build_dir / f".tmp.{os.getpid()}.so"
+                proc = subprocess.run(
+                    ["make", "-s", "-C", str(_NATIVE_DIR),
+                     f"LIB=build/{tmp.name}"],
+                    capture_output=True, text=True, timeout=180,
+                )
+                if proc.returncode != 0:
+                    logger.warning("native build failed:\n%s", proc.stderr[-2000:])
+                    return False
+                os.replace(tmp, _LIB_PATH)
+                return True
+            finally:
+                fcntl.flock(lock_f, fcntl.LOCK_UN)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        logger.warning("native build unavailable: %s", e)
+        return False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib
+    try:
+        _lib = _configure(ctypes.CDLL(str(_LIB_PATH)))
+    except OSError as e:
+        logger.warning("could not load %s: %s", _LIB_PATH, e)
+        _lib = None
+    return _lib
+
+
+def ensure_built(timeout_s: float = 180.0) -> Optional[ctypes.CDLL]:
+    """Blocking build+load. Call from process entry points before serving."""
+    global _build_failed
+    if os.environ.get("DYNTPU_NO_NATIVE"):
+        return None
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _build_failed:
+            return None
+        t = _build_thread
+    if t is not None:
+        t.join(timeout=timeout_s)
+    with _lock:
+        if _lib is not None or _build_failed:
+            return _lib
+        if _stale() and not _build():
+            _build_failed = True
+            return None
+        return _load()
+
+
+def lib() -> Optional[ctypes.CDLL]:
+    """The loaded native library, or None. Never compiles on the caller's
+    thread: a stale/missing .so kicks off one background build and this
+    returns None until it lands (pure-Python fallbacks cover the gap)."""
+    global _build_thread, _build_failed
+    if os.environ.get("DYNTPU_NO_NATIVE"):
+        return None
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _build_failed:
+            return None
+        if not _stale():
+            return _load()
+        if _build_thread is None or not _build_thread.is_alive():
+
+            def _bg():
+                global _build_failed
+                ok = _build()
+                with _lock:
+                    if ok:
+                        _load()
+                    else:
+                        _build_failed = True
+
+            _build_thread = threading.Thread(
+                target=_bg, name="dynamo-native-build", daemon=True
+            )
+            _build_thread.start()
+        return None

@@ -37,6 +37,40 @@ DEFAULT_BLOCK_SIZE = 64
 _U64_MASK = (1 << 64) - 1
 
 
+def _native_bulk_hashes(tokens: Sequence[Token], block_size: int, salt_hash: int):
+    """All full-block (block_hash, seq_hash) pairs in one C call, or None.
+
+    The C++ path (native/dynamo_native.cpp dyn_hash_token_blocks) is
+    byte-identical to the Python chain below — asserted by
+    tests/test_native.py on random streams.
+    """
+    n_full = len(tokens) - len(tokens) % block_size
+    if n_full == 0:
+        return None
+    from dynamo_tpu.native import lib
+
+    l = lib()
+    if l is None:
+        return None
+    import numpy as np
+
+    try:
+        arr = np.ascontiguousarray(
+            (np.asarray(tokens, dtype=np.int64) & 0xFFFFFFFF).astype(np.uint32)
+        )
+    except (OverflowError, ValueError, TypeError):
+        # Token outside int64 range — mask in Python like the scalar path.
+        arr = np.asarray([t & 0xFFFFFFFF for t in tokens], np.uint32)
+    nb = n_full // block_size
+    bh = np.empty(nb, np.uint64)
+    sh = np.empty(nb, np.uint64)
+    l.dyn_hash_token_blocks(
+        arr.ctypes.data, len(arr), block_size, salt_hash & _U64_MASK,
+        BLOCK_HASH_SEED, bh.ctypes.data, sh.ctypes.data,
+    )
+    return bh.tolist(), sh.tolist()
+
+
 def compute_salt_hash(salt: str = "") -> SaltHash:
     """Hash a namespace salt (e.g. model id) so hash chains from different
     models never collide in a shared index."""
@@ -139,7 +173,34 @@ class TokenBlockSequence:
             parent_sequence_hash=None,
             block_index=0,
         )
-        self.extend(tokens)
+        toks = list(tokens)
+        bulk = _native_bulk_hashes(toks, block_size, self.salt_hash)
+        if bulk is None:
+            self.extend(toks)
+            return
+        # Bulk ingest (prompt admission hot path): hashes computed in one
+        # native call; Python only materializes the block objects.
+        block_hashes, seq_hashes = bulk
+        parent: Optional[SequenceHash] = None
+        for i, (bh, sh) in enumerate(zip(block_hashes, seq_hashes)):
+            self.blocks.append(
+                TokenBlock(
+                    tokens=tuple(toks[i * block_size : (i + 1) * block_size]),
+                    block_hash=bh,
+                    sequence_hash=sh,
+                    parent_sequence_hash=parent,
+                    block_index=i,
+                )
+            )
+            parent = sh
+        nb = len(block_hashes)
+        self.partial = PartialTokenBlock(
+            block_size=block_size,
+            salt_hash=self.salt_hash,
+            parent_sequence_hash=parent,
+            block_index=nb,
+            tokens=list(toks[nb * block_size :]),
+        )
 
     # -- mutation ----------------------------------------------------------
 

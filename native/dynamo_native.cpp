@@ -1,0 +1,192 @@
+// libdynamo_native — the framework's C++ hot-path core, exposed over a C ABI
+// consumed via ctypes (dynamo_tpu/native.py).
+//
+// What lives here and why (reference parity: the reference keeps these in
+// native Rust crates — lib/tokens/src/lib.rs for block hashing and
+// lib/llm/src/kv_router/indexer.rs for the KV radix index — because they sit
+// on the per-request routing hot path):
+//   1. xxh3_64 (native/xxh3.h) — the canonical content-address hash.
+//   2. One-shot token-block chain hashing: a whole prompt's chained block
+//      hashes in a single call over a u32 buffer (no per-block Python work).
+//   3. The KV radix index: worker-set per chained block hash with interned
+//      worker ids, contiguous-prefix match scoring, O(worker blocks) removal.
+//
+// Python keeps byte-identical fallbacks (tokens/blocks.py, kv_router/
+// indexer.py); tests assert both paths agree on random streams.
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <unordered_map>
+#include <unordered_set>
+#include <vector>
+
+#include "xxh3.h"
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// Hashing
+// ---------------------------------------------------------------------------
+
+uint64_t dyn_xxh3_64(const uint8_t* data, size_t len, uint64_t seed) {
+    return dynxxh3::xxh3_64(data, len, seed);
+}
+
+// Chained block hashing over little-endian u32 tokens (the contract of
+// dynamo_tpu/tokens/blocks.py): block i of size B is hashed with seed =
+// parent sequence hash (salt_hash for block 0); its sequence hash chains
+// parent||block_hash under BLOCK_HASH_SEED. Returns the number of full
+// blocks written to out_block_hashes / out_seq_hashes (each sized n/B).
+size_t dyn_hash_token_blocks(const uint32_t* tokens, size_t n, size_t block_size,
+                             uint64_t salt_hash, uint64_t chain_seed,
+                             uint64_t* out_block_hashes, uint64_t* out_seq_hashes) {
+    if (block_size == 0) return 0;
+    size_t nb = n / block_size;
+    uint64_t parent = 0;
+    bool has_parent = false;
+    for (size_t i = 0; i < nb; i++) {
+        uint64_t seed = has_parent ? parent : salt_hash;
+        uint64_t bh = dynxxh3::xxh3_64(tokens + i * block_size,
+                                       block_size * sizeof(uint32_t), seed);
+        uint64_t sh;
+        if (!has_parent) {
+            sh = bh;
+        } else {
+            uint64_t buf[2] = {parent, bh};
+            sh = dynxxh3::xxh3_64(buf, 16, chain_seed);
+        }
+        out_block_hashes[i] = bh;
+        out_seq_hashes[i] = sh;
+        parent = sh;
+        has_parent = true;
+    }
+    return nb;
+}
+
+// ---------------------------------------------------------------------------
+// KV radix index
+// ---------------------------------------------------------------------------
+
+struct RadixIndex {
+    // worker interning
+    std::unordered_map<std::string, uint32_t> worker_ids;
+    std::vector<std::string> worker_names;
+    // hash -> worker-id set; worker-id -> hash set (for lease-expiry removal)
+    std::unordered_map<uint64_t, std::unordered_set<uint32_t>> workers_by_hash;
+    std::unordered_map<uint32_t, std::unordered_set<uint64_t>> hashes_by_worker;
+    uint64_t events_applied = 0;
+};
+
+void* dyn_radix_new() { return new RadixIndex(); }
+
+void dyn_radix_free(void* p) { delete (RadixIndex*)p; }
+
+uint32_t dyn_radix_intern(void* p, const char* worker) {
+    RadixIndex* r = (RadixIndex*)p;
+    auto it = r->worker_ids.find(worker);
+    if (it != r->worker_ids.end()) return it->second;
+    uint32_t id = (uint32_t)r->worker_names.size();
+    r->worker_ids.emplace(worker, id);
+    r->worker_names.push_back(worker);
+    return id;
+}
+
+// kind: 0 = stored, 1 = removed
+void dyn_radix_apply(void* p, uint32_t worker_id, int kind, const uint64_t* hashes,
+                     size_t n) {
+    RadixIndex* r = (RadixIndex*)p;
+    if (kind == 0) {
+        auto& mine = r->hashes_by_worker[worker_id];
+        for (size_t i = 0; i < n; i++) {
+            r->workers_by_hash[hashes[i]].insert(worker_id);
+            mine.insert(hashes[i]);
+        }
+    } else {
+        auto mit = r->hashes_by_worker.find(worker_id);
+        for (size_t i = 0; i < n; i++) {
+            auto it = r->workers_by_hash.find(hashes[i]);
+            if (it != r->workers_by_hash.end()) {
+                it->second.erase(worker_id);
+                if (it->second.empty()) r->workers_by_hash.erase(it);
+            }
+            if (mit != r->hashes_by_worker.end()) mit->second.erase(hashes[i]);
+        }
+    }
+    r->events_applied++;
+}
+
+size_t dyn_radix_remove_worker(void* p, uint32_t worker_id) {
+    RadixIndex* r = (RadixIndex*)p;
+    auto mit = r->hashes_by_worker.find(worker_id);
+    if (mit == r->hashes_by_worker.end()) return 0;
+    size_t n = mit->second.size();
+    for (uint64_t h : mit->second) {
+        auto it = r->workers_by_hash.find(h);
+        if (it != r->workers_by_hash.end()) {
+            it->second.erase(worker_id);
+            if (it->second.empty()) r->workers_by_hash.erase(it);
+        }
+    }
+    r->hashes_by_worker.erase(mit);
+    return n;
+}
+
+void dyn_radix_clear(void* p) {
+    RadixIndex* r = (RadixIndex*)p;
+    r->workers_by_hash.clear();
+    r->hashes_by_worker.clear();
+}
+
+// Contiguous-prefix match (indexer.py RadixTree.find_matches): walk the hash
+// chain; at each depth intersect the holder set; a worker's score is the
+// depth of the deepest block it holds contiguously. Writes up to `cap`
+// (worker_id, score) pairs; returns the pair count; *out_matched = number of
+// leading query blocks held by any worker (before intersection emptied).
+size_t dyn_radix_find(void* p, const uint64_t* hashes, size_t n, uint32_t* out_ids,
+                      uint32_t* out_scores, size_t cap, size_t* out_matched) {
+    RadixIndex* r = (RadixIndex*)p;
+    std::unordered_map<uint32_t, uint32_t> scores;
+    std::unordered_set<uint32_t> active;
+    bool first = true;
+    size_t matched = 0;
+    for (size_t depth = 0; depth < n; depth++) {
+        auto it = r->workers_by_hash.find(hashes[depth]);
+        if (it == r->workers_by_hash.end() || it->second.empty()) break;
+        if (first) {
+            active = it->second;
+            first = false;
+        } else {
+            for (auto a = active.begin(); a != active.end();)
+                a = it->second.count(*a) ? std::next(a) : active.erase(a);
+        }
+        if (active.empty()) break;
+        matched = depth + 1;
+        for (uint32_t w : active) scores[w] = (uint32_t)(depth + 1);
+    }
+    *out_matched = matched;
+    size_t k = 0;
+    for (auto& [w, s] : scores) {
+        if (k >= cap) break;
+        out_ids[k] = w;
+        out_scores[k] = s;
+        k++;
+    }
+    return k;
+}
+
+size_t dyn_radix_num_blocks(void* p) {
+    return ((RadixIndex*)p)->workers_by_hash.size();
+}
+
+size_t dyn_radix_blocks_for(void* p, uint32_t worker_id) {
+    RadixIndex* r = (RadixIndex*)p;
+    auto it = r->hashes_by_worker.find(worker_id);
+    return it == r->hashes_by_worker.end() ? 0 : it->second.size();
+}
+
+uint64_t dyn_radix_events_applied(void* p) {
+    return ((RadixIndex*)p)->events_applied;
+}
+
+}  // extern "C"

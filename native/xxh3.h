@@ -1,0 +1,248 @@
+// XXH3-64 (seeded) — independent C++ implementation of the public XXH3
+// specification (https://github.com/Cyan4973/xxHash/blob/dev/doc/xxhash_spec.md).
+// Byte-for-byte compatible with python-xxhash's xxh3_64_intdigest (golden
+// tests in tests/test_native.py assert equality across all length classes).
+//
+// This is the canonical content-address hash of the framework: token-block
+// chain hashing (native/dynamo_native.cpp) must agree exactly with the
+// Python path (dynamo_tpu/tokens/blocks.py).
+#pragma once
+
+#include <cstdint>
+#include <cstring>
+#include <cstddef>
+
+namespace dynxxh3 {
+
+static const uint64_t PRIME32_1 = 0x9E3779B1ULL;
+static const uint64_t PRIME32_2 = 0x85EBCA77ULL;
+static const uint64_t PRIME32_3 = 0xC2B2AE3DULL;
+static const uint64_t PRIME64_1 = 0x9E3779B185EBCA87ULL;
+static const uint64_t PRIME64_2 = 0xC2B2AE3D27D4EB4FULL;
+static const uint64_t PRIME64_3 = 0x165667B19E3779F9ULL;
+static const uint64_t PRIME64_4 = 0x85EBCA77C2B2AE63ULL;
+static const uint64_t PRIME64_5 = 0x27D4EB2F165667C5ULL;
+static const uint64_t PRIME_MX1 = 0x165667919E3779F9ULL;
+static const uint64_t PRIME_MX2 = 0x9FB21C651E98DF25ULL;
+
+// The spec's default 192-byte secret.
+static const uint8_t kSecret[192] = {
+    0xb8, 0xfe, 0x6c, 0x39, 0x23, 0xa4, 0x4b, 0xbe,
+    0x7c, 0x01, 0x81, 0x2c, 0xf7, 0x21, 0xad, 0x1c,
+    0xde, 0xd4, 0x6d, 0xe9, 0x83, 0x90, 0x97, 0xdb,
+    0x72, 0x40, 0xa4, 0xa4, 0xb7, 0xb3, 0x67, 0x1f,
+    0xcb, 0x79, 0xe6, 0x4e, 0xcc, 0xc0, 0xe5, 0x78,
+    0x82, 0x5a, 0xd0, 0x7d, 0xcc, 0xff, 0x72, 0x21,
+    0xb8, 0x08, 0x46, 0x74, 0xf7, 0x43, 0x24, 0x8e,
+    0xe0, 0x35, 0x90, 0xe6, 0x81, 0x3a, 0x26, 0x4c,
+    0x3c, 0x28, 0x52, 0xbb, 0x91, 0xc3, 0x00, 0xcb,
+    0x88, 0xd0, 0x65, 0x8b, 0x1b, 0x53, 0x2e, 0xa3,
+    0x71, 0x64, 0x48, 0x97, 0xa2, 0x0d, 0xf9, 0x4e,
+    0x38, 0x19, 0xef, 0x46, 0xa9, 0xde, 0xac, 0xd8,
+    0xa8, 0xfa, 0x76, 0x3f, 0xe3, 0x9c, 0x34, 0x3f,
+    0xf9, 0xdc, 0xbb, 0xc7, 0xc7, 0x0b, 0x4f, 0x1d,
+    0x8a, 0x51, 0xe0, 0x4b, 0xcd, 0xb4, 0x59, 0x31,
+    0xc8, 0x9f, 0x7e, 0xc9, 0xd9, 0x78, 0x73, 0x64,
+    0xea, 0xc5, 0xac, 0x83, 0x34, 0xd3, 0xeb, 0xc3,
+    0xc5, 0x81, 0xa0, 0xff, 0xfa, 0x13, 0x63, 0xeb,
+    0x17, 0x0d, 0xdd, 0x51, 0xb7, 0xf0, 0xda, 0x49,
+    0xd3, 0x16, 0x55, 0x26, 0x29, 0xd4, 0x68, 0x9e,
+    0x2b, 0x16, 0xbe, 0x58, 0x7d, 0x47, 0xa1, 0xfc,
+    0x8f, 0xf8, 0xb8, 0xd1, 0x7a, 0xd0, 0x31, 0xce,
+    0x45, 0xcb, 0x3a, 0x8f, 0x95, 0x16, 0x04, 0x28,
+    0xaf, 0xd7, 0xfb, 0xca, 0xbb, 0x4b, 0x40, 0x7e,
+};
+
+static inline uint32_t read32(const uint8_t* p) {
+    uint32_t v;
+    std::memcpy(&v, p, 4);
+    return v;  // little-endian hosts only (x86-64 / aarch64)
+}
+
+static inline uint64_t read64(const uint8_t* p) {
+    uint64_t v;
+    std::memcpy(&v, p, 8);
+    return v;
+}
+
+static inline uint64_t rotl64(uint64_t x, int r) {
+    return (x << r) | (x >> (64 - r));
+}
+
+static inline uint32_t swap32(uint32_t x) { return __builtin_bswap32(x); }
+static inline uint64_t swap64(uint64_t x) { return __builtin_bswap64(x); }
+
+static inline uint64_t mul128_fold64(uint64_t a, uint64_t b) {
+    __uint128_t m = (__uint128_t)a * (__uint128_t)b;
+    return (uint64_t)m ^ (uint64_t)(m >> 64);
+}
+
+static inline uint64_t xxh64_avalanche(uint64_t h) {
+    h ^= h >> 33;
+    h *= PRIME64_2;
+    h ^= h >> 29;
+    h *= PRIME64_3;
+    h ^= h >> 32;
+    return h;
+}
+
+static inline uint64_t xxh3_avalanche(uint64_t h) {
+    h ^= h >> 37;
+    h *= PRIME_MX1;
+    h ^= h >> 32;
+    return h;
+}
+
+static inline uint64_t rrmxmx(uint64_t h, uint64_t len) {
+    h ^= rotl64(h, 49) ^ rotl64(h, 24);
+    h *= PRIME_MX2;
+    h ^= (h >> 35) + len;
+    h *= PRIME_MX2;
+    h ^= h >> 28;
+    return h;
+}
+
+static inline uint64_t mix16b(const uint8_t* in, const uint8_t* sec, uint64_t seed) {
+    uint64_t lo = read64(in) ^ (read64(sec) + seed);
+    uint64_t hi = read64(in + 8) ^ (read64(sec + 8) - seed);
+    return mul128_fold64(lo, hi);
+}
+
+static inline uint64_t len_0(const uint8_t* sec, uint64_t seed) {
+    return xxh64_avalanche(seed ^ (read64(sec + 56) ^ read64(sec + 64)));
+}
+
+static inline uint64_t len_1to3(const uint8_t* in, size_t len, const uint8_t* sec,
+                                uint64_t seed) {
+    uint8_t c1 = in[0], c2 = in[len >> 1], c3 = in[len - 1];
+    uint32_t combined = ((uint32_t)c1 << 16) | ((uint32_t)c2 << 24) |
+                        ((uint32_t)c3) | ((uint32_t)len << 8);
+    uint64_t bitflip = (uint64_t)(read32(sec) ^ read32(sec + 4)) + seed;
+    return xxh64_avalanche((uint64_t)combined ^ bitflip);
+}
+
+static inline uint64_t len_4to8(const uint8_t* in, size_t len, const uint8_t* sec,
+                                uint64_t seed) {
+    seed ^= (uint64_t)swap32((uint32_t)seed) << 32;
+    uint32_t in1 = read32(in);
+    uint32_t in2 = read32(in + len - 4);
+    uint64_t bitflip = (read64(sec + 8) ^ read64(sec + 16)) - seed;
+    uint64_t input64 = (uint64_t)in2 + ((uint64_t)in1 << 32);
+    return rrmxmx(input64 ^ bitflip, (uint64_t)len);
+}
+
+static inline uint64_t len_9to16(const uint8_t* in, size_t len, const uint8_t* sec,
+                                 uint64_t seed) {
+    uint64_t bf1 = (read64(sec + 24) ^ read64(sec + 32)) + seed;
+    uint64_t bf2 = (read64(sec + 40) ^ read64(sec + 48)) - seed;
+    uint64_t lo = read64(in) ^ bf1;
+    uint64_t hi = read64(in + len - 8) ^ bf2;
+    uint64_t acc = (uint64_t)len + swap64(lo) + hi + mul128_fold64(lo, hi);
+    return xxh3_avalanche(acc);
+}
+
+static inline uint64_t len_17to128(const uint8_t* in, size_t len, const uint8_t* sec,
+                                   uint64_t seed) {
+    uint64_t acc = (uint64_t)len * PRIME64_1;
+    if (len > 32) {
+        if (len > 64) {
+            if (len > 96) {
+                acc += mix16b(in + 48, sec + 96, seed);
+                acc += mix16b(in + len - 64, sec + 112, seed);
+            }
+            acc += mix16b(in + 32, sec + 64, seed);
+            acc += mix16b(in + len - 48, sec + 80, seed);
+        }
+        acc += mix16b(in + 16, sec + 32, seed);
+        acc += mix16b(in + len - 32, sec + 48, seed);
+    }
+    acc += mix16b(in, sec, seed);
+    acc += mix16b(in + len - 16, sec + 16, seed);
+    return xxh3_avalanche(acc);
+}
+
+static inline uint64_t len_129to240(const uint8_t* in, size_t len, const uint8_t* sec,
+                                    uint64_t seed) {
+    const int kStartOffset = 3, kLastOffset = 17;
+    uint64_t acc = (uint64_t)len * PRIME64_1;
+    size_t nb = len / 16;
+    for (size_t i = 0; i < 8; i++) acc += mix16b(in + 16 * i, sec + 16 * i, seed);
+    acc = xxh3_avalanche(acc);
+    for (size_t i = 8; i < nb; i++)
+        acc += mix16b(in + 16 * i, sec + 16 * (i - 8) + kStartOffset, seed);
+    acc += mix16b(in + len - 16, sec + 136 - kLastOffset, seed);
+    return xxh3_avalanche(acc);
+}
+
+static inline void accumulate_stripe(uint64_t acc[8], const uint8_t* in,
+                                     const uint8_t* sec) {
+    for (int i = 0; i < 8; i++) {
+        uint64_t data_val = read64(in + 8 * i);
+        uint64_t data_key = data_val ^ read64(sec + 8 * i);
+        acc[i ^ 1] += data_val;
+        acc[i] += (data_key & 0xFFFFFFFFULL) * (data_key >> 32);
+    }
+}
+
+static inline void scramble_acc(uint64_t acc[8], const uint8_t* sec) {
+    for (int i = 0; i < 8; i++) {
+        acc[i] ^= acc[i] >> 47;
+        acc[i] ^= read64(sec + 8 * i);
+        acc[i] *= PRIME32_1;
+    }
+}
+
+static inline uint64_t merge_accs(const uint64_t acc[8], const uint8_t* sec,
+                                  uint64_t start) {
+    uint64_t result = start;
+    for (int i = 0; i < 4; i++)
+        result += mul128_fold64(acc[2 * i] ^ read64(sec + 16 * i),
+                                acc[2 * i + 1] ^ read64(sec + 16 * i + 8));
+    return xxh3_avalanche(result);
+}
+
+static inline uint64_t hash_long(const uint8_t* in, size_t len, uint64_t seed) {
+    const size_t secret_size = 192;
+    uint8_t sec[192];
+    if (seed == 0) {
+        std::memcpy(sec, kSecret, secret_size);
+    } else {
+        for (size_t i = 0; i < secret_size; i += 16) {
+            uint64_t lo = read64(kSecret + i) + seed;
+            uint64_t hi = read64(kSecret + i + 8) - seed;
+            std::memcpy(sec + i, &lo, 8);
+            std::memcpy(sec + i + 8, &hi, 8);
+        }
+    }
+    uint64_t acc[8] = {PRIME32_3, PRIME64_1, PRIME64_2, PRIME64_3,
+                       PRIME64_4, PRIME32_2, PRIME64_5, PRIME32_1};
+    const size_t stripes_per_block = (secret_size - 64) / 8;  // 16
+    const size_t block_len = 64 * stripes_per_block;          // 1024
+    const size_t nb_blocks = (len - 1) / block_len;
+    for (size_t b = 0; b < nb_blocks; b++) {
+        for (size_t s = 0; s < stripes_per_block; s++)
+            accumulate_stripe(acc, in + b * block_len + s * 64, sec + s * 8);
+        scramble_acc(acc, sec + secret_size - 64);
+    }
+    const size_t nb_stripes = ((len - 1) - block_len * nb_blocks) / 64;
+    for (size_t s = 0; s < nb_stripes; s++)
+        accumulate_stripe(acc, in + nb_blocks * block_len + s * 64, sec + s * 8);
+    // last stripe: final 64 bytes, SECRET_LASTACC_START = 7
+    accumulate_stripe(acc, in + len - 64, sec + secret_size - 64 - 7);
+    // SECRET_MERGEACCS_START = 11
+    return merge_accs(acc, sec + 11, (uint64_t)len * PRIME64_1);
+}
+
+inline uint64_t xxh3_64(const void* data, size_t len, uint64_t seed) {
+    const uint8_t* in = (const uint8_t*)data;
+    const uint8_t* sec = kSecret;
+    if (len == 0) return len_0(sec, seed);
+    if (len <= 3) return len_1to3(in, len, sec, seed);
+    if (len <= 8) return len_4to8(in, len, sec, seed);
+    if (len <= 16) return len_9to16(in, len, sec, seed);
+    if (len <= 128) return len_17to128(in, len, sec, seed);
+    if (len <= 240) return len_129to240(in, len, sec, seed);
+    return hash_long(in, len, seed);
+}
+
+}  // namespace dynxxh3

@@ -1,0 +1,161 @@
+"""Native C++ core (native/) parity with the pure-Python paths.
+
+The native library is the production hot path (token-block chain hashing,
+KV radix index); these tests pin it byte-for-byte / decision-for-decision
+against the Python implementations, plus golden xxh3 values against the
+python-xxhash C extension (the canonical reference for the hash).
+"""
+
+import os
+import random
+
+import numpy as np
+import pytest
+import xxhash
+
+from dynamo_tpu.native import ensure_built, lib
+
+pytestmark = pytest.mark.skipif(
+    ensure_built() is None, reason="native library unavailable (no g++?)"
+)
+
+
+# -- xxh3 -------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "n", [0, 1, 2, 3, 4, 7, 8, 9, 15, 16, 17, 64, 128, 129, 200, 240, 241,
+          256, 512, 1023, 1024, 1025, 4096, 10000]
+)
+def test_xxh3_matches_python_xxhash(n):
+    rng = random.Random(n)
+    data = bytes(rng.getrandbits(8) for _ in range(n))
+    for seed in (0, 1, 1337, rng.getrandbits(64)):
+        got = lib().dyn_xxh3_64(data, n, seed)
+        assert got == xxhash.xxh3_64_intdigest(data, seed=seed)
+
+
+def test_xxh3_fuzz():
+    rng = random.Random(42)
+    for _ in range(500):
+        n = rng.randrange(0, 3000)
+        data = os.urandom(n)
+        seed = rng.getrandbits(64)
+        assert lib().dyn_xxh3_64(data, n, seed) == xxhash.xxh3_64_intdigest(
+            data, seed=seed
+        )
+
+
+# -- token-block chain hashing ---------------------------------------------
+
+
+def _python_chain(tokens, block_size, salt):
+    """Ground-truth chain via the scalar Python primitives."""
+    from dynamo_tpu.tokens.blocks import (
+        compute_block_hash,
+        compute_salt_hash,
+        compute_seq_hash,
+    )
+
+    salt_hash = compute_salt_hash(salt)
+    parent = None
+    bhs, shs = [], []
+    for i in range(len(tokens) // block_size):
+        block = tokens[i * block_size : (i + 1) * block_size]
+        bh = compute_block_hash(block, parent if parent is not None else salt_hash)
+        sh = compute_seq_hash(parent, bh)
+        bhs.append(bh)
+        shs.append(sh)
+        parent = sh
+    return bhs, shs
+
+
+@pytest.mark.parametrize("block_size,n", [(4, 0), (4, 3), (4, 4), (4, 17),
+                                          (64, 64), (64, 257), (16, 1000)])
+def test_token_block_sequence_native_bulk_parity(block_size, n):
+    from dynamo_tpu.tokens import TokenBlockSequence
+
+    rng = random.Random(n)
+    tokens = [rng.randrange(0, 1 << 32) for _ in range(n)]
+    seq = TokenBlockSequence(tokens, block_size=block_size, salt="model-x")
+    bhs, shs = _python_chain(tokens, block_size, "model-x")
+    assert seq.block_hashes() == bhs
+    assert seq.sequence_hashes() == shs
+    assert seq.tokens == tokens
+    # Appending after a bulk init must continue the same chain.
+    extra = [rng.randrange(0, 1 << 32) for _ in range(2 * block_size)]
+    seq.extend(extra)
+    bhs2, shs2 = _python_chain(tokens + extra, block_size, "model-x")
+    assert seq.sequence_hashes() == shs2
+
+
+def test_token_values_beyond_int64_mask_like_python(monkeypatch):
+    from dynamo_tpu import native
+    from dynamo_tpu.tokens import TokenBlockSequence
+
+    toks = [2**63, 2**64 - 1, 5, 6]
+    with_native = TokenBlockSequence(toks, block_size=4).sequence_hashes()
+    monkeypatch.setattr(native, "_lib", None)
+    monkeypatch.setattr(native, "_build_failed", True)
+    without = TokenBlockSequence(toks, block_size=4).sequence_hashes()
+    assert with_native == without
+
+
+def test_hash_token_blocks_native_vs_forced_python(monkeypatch):
+    from dynamo_tpu import native
+    from dynamo_tpu.tokens import hash_token_blocks
+
+    tokens = [random.Random(9).randrange(0, 1 << 32) for _ in range(300)]
+    with_native = hash_token_blocks(tokens, block_size=32, salt="s")
+    monkeypatch.setattr(native, "_lib", None)
+    monkeypatch.setattr(native, "_build_failed", True)
+    without = hash_token_blocks(tokens, block_size=32, salt="s")
+    assert with_native == without
+
+
+# -- radix index ------------------------------------------------------------
+
+
+def test_native_radix_tree_matches_python():
+    from dynamo_tpu.kv_router.indexer import NativeRadixTree, RadixTree
+
+    rng = random.Random(7)
+    native_tree, py_tree = NativeRadixTree(), RadixTree()
+    workers = [f"w{i}" for i in range(6)]
+    # Build some shared-prefix hash chains.
+    chains = [[rng.getrandbits(64) for _ in range(10)] for _ in range(4)]
+    chains.append(chains[0][:5] + [rng.getrandbits(64) for _ in range(5)])
+
+    events = []
+    for _ in range(400):
+        w = rng.choice(workers)
+        chain = rng.choice(chains)
+        k = rng.randrange(1, len(chain) + 1)
+        kind = "stored" if rng.random() < 0.7 else "removed"
+        events.append((w, {"kind": kind, "block_hashes": chain[:k]}))
+    for w, ev in events:
+        native_tree.apply_event(w, ev)
+        py_tree.apply_event(w, ev)
+
+    assert native_tree.num_blocks == py_tree.num_blocks
+    assert native_tree.events_applied == py_tree.events_applied
+    for chain in chains:
+        for k in (0, 1, 5, 10):
+            a = native_tree.find_matches(chain[:k])
+            b = py_tree.find_matches(chain[:k])
+            assert a.scores == b.scores, (chain[:k], a.scores, b.scores)
+            assert a.matched_blocks == b.matched_blocks
+
+    gone = workers[0]
+    assert native_tree.remove_worker(gone) == py_tree.remove_worker(gone)
+    for chain in chains:
+        a = native_tree.find_matches(chain)
+        b = py_tree.find_matches(chain)
+        assert a.scores == b.scores
+    for w in workers:
+        assert native_tree.blocks_for(w) == py_tree.blocks_for(w)
+
+    native_tree.clear()
+    py_tree.clear()
+    assert native_tree.num_blocks == py_tree.num_blocks == 0
+    assert native_tree.find_matches(chains[0]).scores == {}
