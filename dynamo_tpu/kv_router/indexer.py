@@ -149,6 +149,9 @@ class NativeRadixTree:
         self._ids: dict[str, int] = {}
         self._names: list[str] = []
         self._live: set[str] = set()
+        #: unknown-kind events counted here so events_applied matches the
+        #: Python tree (which counts every event, known or not)
+        self._unknown_events = 0
 
     def __del__(self):
         lib, ptr = getattr(self, "_lib", None), getattr(self, "_ptr", None)
@@ -177,10 +180,12 @@ class NativeRadixTree:
 
     def apply_event(self, worker_id: str, event: dict) -> None:
         kind = event["kind"]
+        hashes = event["block_hashes"]  # KeyError parity with RadixTree
         if kind not in ("stored", "removed"):
             logger.warning("unknown kv event kind %r", kind)
+            self._unknown_events += 1
             return
-        arr, buf, n = self._hash_buf(event["block_hashes"])
+        arr, buf, n = self._hash_buf(hashes)
         self._lib.dyn_radix_apply(
             self._ptr, self._intern(worker_id), 0 if kind == "stored" else 1,
             buf, n,
@@ -225,7 +230,7 @@ class NativeRadixTree:
 
     @property
     def events_applied(self) -> int:
-        return self._lib.dyn_radix_events_applied(self._ptr)
+        return self._lib.dyn_radix_events_applied(self._ptr) + self._unknown_events
 
     @property
     def num_blocks(self) -> int:

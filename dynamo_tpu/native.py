@@ -74,6 +74,7 @@ def _stale() -> bool:
 def _build() -> bool:
     """Compile under an inter-process lock; atomic rename into place."""
     build_dir = _NATIVE_DIR / "build"
+    tmp = build_dir / f".tmp.{os.getpid()}.so"
     try:
         build_dir.mkdir(parents=True, exist_ok=True)
         lock_path = build_dir / ".build.lock"
@@ -84,7 +85,6 @@ def _build() -> bool:
             try:
                 if not _stale():  # another process built it while we waited
                     return True
-                tmp = build_dir / f".tmp.{os.getpid()}.so"
                 proc = subprocess.run(
                     ["make", "-s", "-C", str(_NATIVE_DIR),
                      f"LIB=build/{tmp.name}"],
@@ -96,19 +96,24 @@ def _build() -> bool:
                 os.replace(tmp, _LIB_PATH)
                 return True
             finally:
+                tmp.unlink(missing_ok=True)
                 fcntl.flock(lock_f, fcntl.LOCK_UN)
     except (OSError, subprocess.TimeoutExpired) as e:
         logger.warning("native build unavailable: %s", e)
+        tmp.unlink(missing_ok=True)
         return False
 
 
 def _load() -> Optional[ctypes.CDLL]:
-    global _lib
+    """Must be called with _lock held. Latches failure: a present-but-
+    unloadable .so (corrupt/ABI mismatch) must not be retried per request."""
+    global _lib, _build_failed
     try:
         _lib = _configure(ctypes.CDLL(str(_LIB_PATH)))
     except OSError as e:
         logger.warning("could not load %s: %s", _LIB_PATH, e)
         _lib = None
+        _build_failed = True
     return _lib
 
 
@@ -125,10 +130,14 @@ def ensure_built(timeout_s: float = 180.0) -> Optional[ctypes.CDLL]:
         t = _build_thread
     if t is not None:
         t.join(timeout=timeout_s)
+    # Compile OUTSIDE _lock: concurrent lib() callers must stay non-blocking
+    # (they fall back to Python while this thread builds). _build() itself is
+    # flock-serialized, so parallel ensure_built calls don't race the .so.
+    built = (not _stale()) or _build()
     with _lock:
         if _lib is not None or _build_failed:
             return _lib
-        if _stale() and not _build():
+        if not built:
             _build_failed = True
             return None
         return _load()
@@ -155,7 +164,7 @@ def lib() -> Optional[ctypes.CDLL]:
                 ok = _build()
                 with _lock:
                     if ok:
-                        _load()
+                        _load()  # latches _build_failed itself on error
                     else:
                         _build_failed = True
 

@@ -1,3 +1,8 @@
+from dynamo_tpu.parallel.context import (
+    dense_gqa_attention,
+    ring_attention,
+    ulysses_attention,
+)
 from dynamo_tpu.parallel.mesh import MeshConfig, make_mesh
 from dynamo_tpu.parallel.shardings import (
     batch_spec,
@@ -7,6 +12,9 @@ from dynamo_tpu.parallel.shardings import (
 )
 
 __all__ = [
+    "dense_gqa_attention",
+    "ring_attention",
+    "ulysses_attention",
     "MeshConfig",
     "make_mesh",
     "batch_spec",

@@ -1,0 +1,99 @@
+"""Ring attention + Ulysses all-to-all vs dense attention on a CPU mesh.
+
+Sequence parallelism is TPU-first-class here (the reference has none —
+SURVEY.md §5.7); these tests run the real shard_map programs (ppermute /
+all_to_all collectives) on the 8-virtual-device CPU platform.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dynamo_tpu.parallel import MeshConfig, make_mesh
+from dynamo_tpu.parallel.context import (
+    dense_gqa_attention,
+    ring_attention,
+    ulysses_attention,
+)
+
+
+def _qkv(rng, b, t, hq, hkv, d):
+    q = jnp.asarray(rng.normal(size=(b, t, hq, d)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(b, t, hkv, d)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(b, t, hkv, d)), jnp.float32)
+    return q, k, v
+
+
+def test_dense_gqa_matches_naive():
+    """Pin the test oracle itself against a naive per-head softmax."""
+    rng = np.random.default_rng(0)
+    b, t, hq, hkv, d = 1, 8, 4, 2, 16
+    q, k, v = _qkv(rng, b, t, hq, hkv, d)
+    out = dense_gqa_attention(q, k, v, causal=True)
+
+    g = hq // hkv
+    expected = np.zeros((b, t, hq, d), np.float32)
+    for h in range(hq):
+        kk = np.asarray(k[:, :, h // g])
+        vv = np.asarray(v[:, :, h // g])
+        s = np.asarray(q)[:, :, h] @ kk.transpose(0, 2, 1) / np.sqrt(d)
+        mask = np.tril(np.ones((t, t), bool))
+        s = np.where(mask[None], s, -1e30)
+        p = np.exp(s - s.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        expected[:, :, h] = p @ vv
+    np.testing.assert_allclose(np.asarray(out), expected, rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("sp", [2, 4, 8])
+def test_ring_attention_matches_dense(cpu_mesh_devices, sp, causal):
+    mesh = make_mesh(
+        MeshConfig(dp=1, sp=sp, tp=1), devices=cpu_mesh_devices[:sp]
+    )
+    rng = np.random.default_rng(sp)
+    b, t, hq, hkv, d = 2, 8 * sp, 4, 2, 16
+    q, k, v = _qkv(rng, b, t, hq, hkv, d)
+    ref = dense_gqa_attention(q, k, v, causal=causal)
+    out = ring_attention(q, k, v, mesh, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ulysses_attention_matches_dense(cpu_mesh_devices, causal):
+    sp = 4
+    mesh = make_mesh(
+        MeshConfig(dp=1, sp=sp, tp=1), devices=cpu_mesh_devices[:sp]
+    )
+    rng = np.random.default_rng(9)
+    b, t, hq, hkv, d = 2, 32, 8, 4, 16
+    q, k, v = _qkv(rng, b, t, hq, hkv, d)
+    ref = dense_gqa_attention(q, k, v, causal=causal)
+    out = ulysses_attention(q, k, v, mesh, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+def test_ring_under_jit_with_dp(cpu_mesh_devices):
+    """ring attention composes with a dp axis under jit (the serving shape)."""
+    mesh = make_mesh(
+        MeshConfig(dp=2, sp=4, tp=1), devices=cpu_mesh_devices[:8]
+    )
+    rng = np.random.default_rng(3)
+    b, t, hq, hkv, d = 4, 32, 4, 2, 16
+    q, k, v = _qkv(rng, b, t, hq, hkv, d)
+    ref = dense_gqa_attention(q, k, v, causal=True)
+    out = jax.jit(lambda *a: ring_attention(*a, mesh=mesh, causal=True))(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+def test_shape_validation(cpu_mesh_devices):
+    mesh = make_mesh(MeshConfig(dp=1, sp=4, tp=1), devices=cpu_mesh_devices[:4])
+    rng = np.random.default_rng(1)
+    q, k, v = _qkv(rng, 1, 30, 4, 2, 16)  # 30 % 4 != 0
+    with pytest.raises(ValueError, match="not divisible"):
+        ring_attention(q, k, v, mesh)
+    q, k, v = _qkv(rng, 1, 32, 4, 2, 16)  # Hkv=2 % 4 != 0
+    with pytest.raises(ValueError, match="heads"):
+        ulysses_attention(q, k, v, mesh)
