@@ -208,6 +208,74 @@ async def _run_worker(args) -> None:
         await worker.stop()
 
 
+async def _run_planner(args) -> None:
+    import shlex
+
+    from dynamo_tpu.planner import (
+        LoadPlanner,
+        LocalConnector,
+        PerfInterpolator,
+        PlannerConfig,
+        SlaPlanner,
+    )
+    from dynamo_tpu.planner.planner import PlannerRunner, SlaTargets
+    from dynamo_tpu.planner.service import FleetObserver
+    from dynamo_tpu.runtime import DistributedRuntime
+
+    cfg = PlannerConfig(
+        interval_s=args.interval,
+        min_decode=args.min_decode,
+        max_decode=args.max_decode,
+        min_prefill=args.min_prefill,
+        max_prefill=args.max_prefill,
+    )
+    if args.mode == "sla":
+        if not args.perf_table:
+            print("--perf-table is required in SLA mode", file=sys.stderr)
+            sys.exit(2)
+        with open(args.perf_table) as f:
+            table = json.load(f)
+        planner = SlaPlanner(
+            cfg,
+            SlaTargets(ttft_ms=args.ttft_ms, itl_ms=args.itl_ms),
+            ttft_vs_rate=PerfInterpolator(*zip(*table["ttft_vs_rate"])),
+            itl_vs_rate=PerfInterpolator(*zip(*table["itl_vs_rate"])),
+        )
+    else:
+        planner = LoadPlanner(cfg)
+
+    extra = shlex.split(args.worker_args)
+
+    def spawn_cmd(role: str) -> list[str]:
+        cmd = [
+            sys.executable, "-m", "dynamo_tpu.cli.run", "run",
+            "in=dyn", "out=jax",
+            "--fabric", args.fabric,
+            "--role", role,
+            "--namespace", args.namespace,
+            "--component", args.component if role == "decode" else "prefill",
+            "--model", args.model,
+        ]
+        if args.checkpoint:
+            cmd += ["--checkpoint", args.checkpoint]
+        return cmd + extra
+
+    rt = await DistributedRuntime.create(args.fabric)
+    observer = FleetObserver(
+        rt, namespace=args.namespace, decode_component=args.component
+    )
+    await observer.start()
+    connector = LocalConnector(spawn_cmd)
+    runner = PlannerRunner(planner, connector, observer.observe)
+    print(f"planner up (mode={args.mode}, interval={args.interval}s)", flush=True)
+    try:
+        await runner.run()
+    finally:
+        connector.stop_all()
+        await observer.stop()
+        await rt.close()
+
+
 def main(argv: Optional[list[str]] = None) -> None:
     p = argparse.ArgumentParser(prog="dynamo-tpu")
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -253,6 +321,38 @@ def main(argv: Optional[list[str]] = None) -> None:
     fabricp.add_argument("--host", default="127.0.0.1")
     fabricp.add_argument("--port", type=int, default=4222)
 
+    planp = sub.add_parser("planner", help="autoscale the worker fleet")
+    planp.add_argument("--fabric", required=True, help="fabric host:port")
+    planp.add_argument("--mode", default="load", choices=["load", "sla"])
+    planp.add_argument("--namespace", default="dynamo")
+    planp.add_argument("--component", default="backend")
+    planp.add_argument("--interval", type=float, default=10.0)
+    planp.add_argument("--min-decode", type=int, default=1, dest="min_decode")
+    planp.add_argument("--max-decode", type=int, default=8, dest="max_decode")
+    planp.add_argument("--min-prefill", type=int, default=0, dest="min_prefill")
+    planp.add_argument("--max-prefill", type=int, default=4, dest="max_prefill")
+    planp.add_argument(
+        "--ttft-ms", type=float, default=200.0, dest="ttft_ms",
+        help="SLA mode: time-to-first-token target",
+    )
+    planp.add_argument(
+        "--itl-ms", type=float, default=20.0, dest="itl_ms",
+        help="SLA mode: inter-token-latency target",
+    )
+    planp.add_argument(
+        "--perf-table", default=None, dest="perf_table",
+        help="SLA mode: JSON from benchmarks/profile_sla.py "
+             '({"ttft_vs_rate": [[rate, ms], ...], "itl_vs_rate": [...]})',
+    )
+    planp.add_argument("--model", default="tiny", help="model spawned workers serve")
+    planp.add_argument(
+        "--checkpoint", default=None, help="checkpoint dir for spawned workers"
+    )
+    planp.add_argument(
+        "--worker-args", default="", dest="worker_args",
+        help="extra flags appended to spawned worker commands",
+    )
+
     args = p.parse_args(argv)
     configure_logging()
 
@@ -267,6 +367,10 @@ def main(argv: Optional[list[str]] = None) -> None:
         from dynamo_tpu.runtime.fabric.server import _amain
 
         asyncio.run(_amain(args))
+        return
+
+    if args.cmd == "planner":
+        asyncio.run(_run_planner(args))
         return
 
     io = dict(kv.split("=", 1) for kv in args.io if "=" in kv)

@@ -58,6 +58,8 @@ class EngineMetrics:
     prefix_hit_rate: float = 0.0
     steps: int = 0
     generated_tokens: int = 0
+    #: monotonically increasing arrivals (planner derives request_rate)
+    requests_received: int = 0
 
     def to_dict(self) -> dict:
         return dict(self.__dict__)
@@ -169,6 +171,7 @@ class JaxEngine:
             arrival_time=time.time(),
         )
         self.scheduler.add_request(req)
+        self.metrics.requests_received += 1
         return req
 
     def abort_request(self, request_id: str) -> bool:

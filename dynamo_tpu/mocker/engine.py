@@ -42,6 +42,7 @@ class MockEngine:
             args.num_pages, args.page_size, on_event=on_kv_event
         )
         self.active_requests = 0
+        self.requests_received = 0
 
     def _next_token(self, history: list[int]) -> int:
         h = hashlib.blake2b(bytes(str(history[-8:]), "utf-8"), digest_size=4)
@@ -50,6 +51,7 @@ class MockEngine:
     async def generate(self, context, request: PreprocessedRequest):
         a = self.args
         self.active_requests += 1
+        self.requests_received += 1
         chain = TokenBlockSequence(
             request.token_ids, block_size=a.page_size, salt=a.salt
         )

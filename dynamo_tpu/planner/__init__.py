@@ -1,0 +1,47 @@
+"""Planner — the autoscaler (reference: components/planner, SURVEY.md #40).
+
+Watches the worker load plane (MetricsAggregator snapshots + the disagg
+prefill-queue depth) and adjusts the decode/prefill fleet:
+
+- `LoadPlanner` — threshold + hysteresis on KV usage and queue pressure
+  (reference utils/planner_core.py:31-120).
+- `SlaPlanner` — predicts the request rate (load predictors) and sizes the
+  fleet from offline perf-interpolation tables so predicted TTFT/ITL stay
+  inside targets (reference planner_sla.py + utils/perf_interpolation.py).
+
+Actuation goes through a `Connector`: `LocalConnector` spawns/stops worker
+processes on this host (reference's circus LocalConnector,
+local_connector.py:105); `RecordingConnector` is the test double. A k8s
+connector maps to editing DynamoGraphDeployment replicas (deploy/ manifests)
+and is intentionally out of process scope here.
+"""
+
+from dynamo_tpu.planner.load_predictor import (
+    ConstantPredictor,
+    MovingAveragePredictor,
+    TrendPredictor,
+    make_predictor,
+)
+from dynamo_tpu.planner.perf_model import PerfInterpolator
+from dynamo_tpu.planner.planner import (
+    Connector,
+    LoadPlanner,
+    LocalConnector,
+    PlannerConfig,
+    RecordingConnector,
+    SlaPlanner,
+)
+
+__all__ = [
+    "ConstantPredictor",
+    "MovingAveragePredictor",
+    "TrendPredictor",
+    "make_predictor",
+    "PerfInterpolator",
+    "PlannerConfig",
+    "LoadPlanner",
+    "SlaPlanner",
+    "Connector",
+    "LocalConnector",
+    "RecordingConnector",
+]

@@ -1,0 +1,79 @@
+"""Load predictors: observe a scalar series, predict the next interval.
+
+The reference ships constant / ARIMA / Prophet predictors
+(components/planner/utils/load_predictor.py:62-132). Heavy statistical
+deps aren't available here (and are overkill at serving timescales), so the
+trend predictor is a windowed least-squares slope — the piece of ARIMA that
+actually matters for scale-ahead decisions.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Deque, Optional
+
+
+class ConstantPredictor:
+    """Next value == last observed (the reference's default)."""
+
+    def __init__(self):
+        self._last: float = 0.0
+
+    def observe(self, value: float) -> None:
+        self._last = float(value)
+
+    def predict(self) -> float:
+        return self._last
+
+
+class MovingAveragePredictor:
+    def __init__(self, window: int = 6):
+        if window < 1:
+            raise ValueError("window must be >= 1")
+        self._buf: Deque[float] = deque(maxlen=window)
+
+    def observe(self, value: float) -> None:
+        self._buf.append(float(value))
+
+    def predict(self) -> float:
+        return sum(self._buf) / len(self._buf) if self._buf else 0.0
+
+
+class TrendPredictor:
+    """Least-squares extrapolation one step ahead over a recent window.
+
+    Scale-ahead: a rising ramp is forecast above its last sample, so capacity
+    arrives before the load does. Never predicts below zero.
+    """
+
+    def __init__(self, window: int = 8):
+        if window < 2:
+            raise ValueError("window must be >= 2")
+        self._buf: Deque[float] = deque(maxlen=window)
+
+    def observe(self, value: float) -> None:
+        self._buf.append(float(value))
+
+    def predict(self) -> float:
+        n = len(self._buf)
+        if n == 0:
+            return 0.0
+        if n == 1:
+            return self._buf[0]
+        xs = range(n)
+        mean_x = (n - 1) / 2
+        mean_y = sum(self._buf) / n
+        cov = sum((x - mean_x) * (y - mean_y) for x, y in zip(xs, self._buf))
+        var = sum((x - mean_x) ** 2 for x in xs)
+        slope = cov / var if var else 0.0
+        return max(0.0, mean_y + slope * (n - mean_x))  # x = n is "next"
+
+
+def make_predictor(kind: str, window: int = 8):
+    if kind == "constant":
+        return ConstantPredictor()
+    if kind == "moving_average":
+        return MovingAveragePredictor(window)
+    if kind == "trend":
+        return TrendPredictor(window)
+    raise ValueError(f"unknown predictor {kind!r}")

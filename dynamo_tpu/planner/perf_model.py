@@ -1,0 +1,55 @@
+"""Offline perf interpolation tables for SLA planning.
+
+The reference profiles each parallel config offline and interpolates
+TTFT/ITL against load (benchmarks/profiler/profile_sla.py + utils/
+perf_interpolation.py:20-116). Same idea: feed (load, metric) samples from
+the benchmark harness (benchmarks/profile_sla.py here), then ask either
+"metric at load" or "max load that keeps metric under target".
+"""
+
+from __future__ import annotations
+
+from bisect import bisect_left
+from typing import Sequence
+
+
+class PerfInterpolator:
+    """Piecewise-linear y(x) over sorted sample points, clamped at the ends
+    (monotone x; y need not be monotone, but SLA metrics in practice are)."""
+
+    def __init__(self, xs: Sequence[float], ys: Sequence[float]):
+        if len(xs) != len(ys) or len(xs) == 0:
+            raise ValueError("need equal, non-empty xs/ys")
+        pairs = sorted(zip(map(float, xs), map(float, ys)))
+        self.xs = [p[0] for p in pairs]
+        self.ys = [p[1] for p in pairs]
+
+    def at(self, x: float) -> float:
+        xs, ys = self.xs, self.ys
+        if x <= xs[0]:
+            return ys[0]
+        if x >= xs[-1]:
+            return ys[-1]
+        i = bisect_left(xs, x)
+        x0, x1, y0, y1 = xs[i - 1], xs[i], ys[i - 1], ys[i]
+        t = (x - x0) / (x1 - x0)
+        return y0 + t * (y1 - y0)
+
+    def max_load_within(self, target_y: float) -> float:
+        """Largest x with y(x) <= target (y non-decreasing in x assumed).
+        Returns 0.0 if even the lightest load misses the target."""
+        if self.ys[0] > target_y:
+            return 0.0
+        best = self.xs[0]
+        # walk segments; within a segment solve the linear crossing
+        for (x0, y0), (x1, y1) in zip(
+            zip(self.xs, self.ys), zip(self.xs[1:], self.ys[1:])
+        ):
+            if y1 <= target_y:
+                best = x1
+                continue
+            if y0 <= target_y < y1:
+                t = (target_y - y0) / (y1 - y0)
+                best = x0 + t * (x1 - x0)
+            break
+        return best

@@ -1,0 +1,331 @@
+"""Planner cores + actuation connectors.
+
+`LoadPlanner.tick()` / `SlaPlanner.tick()` are pure decision functions over
+an observed state snapshot — the async runner (`run()`) just samples state on
+an interval and applies decisions through the connector. Pure cores keep the
+whole policy unit-testable with no processes or clocks (the reference tests
+its planner the same way, components/planner/test/).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import subprocess
+import sys
+from dataclasses import dataclass, field
+from typing import Callable, Optional, Protocol
+
+from dynamo_tpu.planner.load_predictor import make_predictor
+from dynamo_tpu.planner.perf_model import PerfInterpolator
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass(frozen=True)
+class PlannerConfig:
+    interval_s: float = 10.0
+    min_decode: int = 1
+    max_decode: int = 8
+    min_prefill: int = 0
+    max_prefill: int = 4
+    #: scale decode UP when mean kv usage crosses this...
+    kv_usage_high: float = 0.85
+    #: ...and DOWN when it stays under this for `down_stable_ticks`
+    kv_usage_low: float = 0.4
+    #: scale decode UP when total queued requests per worker crosses this
+    waiting_per_worker_high: float = 4.0
+    #: scale prefill UP when queue depth per prefill worker crosses this
+    prefill_queue_per_worker_high: float = 2.0
+    #: consecutive calm ticks required before any scale-down (hysteresis)
+    down_stable_ticks: int = 3
+    #: at most this many replicas added/removed per tick
+    max_step: int = 1
+
+
+@dataclass(frozen=True)
+class FleetState:
+    """One observation of the world, assembled by the runner."""
+
+    num_decode: int
+    num_prefill: int
+    #: mean KV pool usage over live decode workers (0..1)
+    kv_usage: float
+    #: total requests waiting in decode schedulers
+    num_waiting: int
+    #: disagg prefill queue depth (0 when disagg is off)
+    prefill_queue_depth: int
+    #: request arrivals observed this interval (SLA planner)
+    request_rate: float = 0.0
+
+
+@dataclass(frozen=True)
+class Decision:
+    target_decode: int
+    target_prefill: int
+
+    def delta(self, state: FleetState) -> tuple[int, int]:
+        return (
+            self.target_decode - state.num_decode,
+            self.target_prefill - state.num_prefill,
+        )
+
+
+class Connector(Protocol):
+    async def scale(self, role: str, target: int, observed: int) -> None:
+        """Move the fleet toward `target` given `observed` live (registered)
+        workers — the connector may own only part of the fleet."""
+        ...
+
+
+class RecordingConnector:
+    """Test double: records every scale call."""
+
+    def __init__(self):
+        self.calls: list[tuple[str, int, int]] = []
+
+    async def scale(self, role: str, target: int, observed: int) -> None:
+        self.calls.append((role, target, observed))
+
+
+class LocalConnector:
+    """Spawn/stop worker processes on this host (reference: circus
+    local_connector.py:105 add_component / :197 remove_component).
+
+    `spawn_cmd(role) -> argv` builds the worker command line (typically
+    `python -m dynamo_tpu.cli.run run in=dyn out=jax --role <role> ...`).
+    Deltas are computed against the OBSERVED registered count, not this
+    connector's children, so externally started workers are part of the
+    arithmetic; children that are alive but not yet registered (engines take
+    seconds to init) count as pending capacity so ticks during startup don't
+    over-spawn. Scale-down stops the youngest owned processes (graceful
+    TERM; leases expire and routers prune them — SURVEY.md §5.3); workers
+    this connector doesn't own can't be stopped and are logged instead."""
+
+    def __init__(
+        self,
+        spawn_cmd: Callable[[str], list[str]],
+        startup_grace_s: float = 30.0,
+    ):
+        self.spawn_cmd = spawn_cmd
+        #: children spawned within this window count as pending capacity
+        #: (engine init takes seconds before the lease registers)
+        self.startup_grace_s = startup_grace_s
+        self._procs: dict[str, list[tuple[subprocess.Popen, float]]] = {}
+
+    def alive(self, role: str) -> int:
+        procs = self._procs.setdefault(role, [])
+        procs[:] = [(p, t) for p, t in procs if p.poll() is None]
+        return len(procs)
+
+    def _pending(self, role: str) -> int:
+        import time as _time
+
+        now = _time.monotonic()
+        return sum(
+            1
+            for _, t in self._procs.get(role, ())
+            if now - t < self.startup_grace_s
+        )
+
+    async def scale(self, role: str, target: int, observed: int) -> None:
+        self.alive(role)  # reap
+        procs = self._procs[role]
+        delta = target - observed
+        if delta > 0:
+            # Children still inside their startup grace are capacity the
+            # observation hasn't seen yet — don't spawn duplicates for them.
+            for _ in range(max(0, delta - self._pending(role))):
+                argv = self.spawn_cmd(role)
+                logger.info("planner: spawning %s worker: %s", role, argv)
+                import time as _time
+
+                procs.append((subprocess.Popen(argv), _time.monotonic()))
+        elif delta < 0:
+            to_stop = min(-delta, len(procs))
+            for _ in range(to_stop):
+                victim, _ = procs.pop()
+                logger.info(
+                    "planner: stopping %s worker pid=%s", role, victim.pid
+                )
+                victim.terminate()
+            if to_stop < -delta:
+                logger.warning(
+                    "planner: want %d fewer %s workers but own only %d — "
+                    "externally started workers must be stopped externally",
+                    -delta, role, to_stop,
+                )
+
+    def stop_all(self) -> None:
+        for procs in self._procs.values():
+            for p, _ in procs:
+                if p.poll() is None:
+                    p.terminate()
+
+
+def _clamp(v: int, lo: int, hi: int) -> int:
+    return max(lo, min(hi, v))
+
+
+class LoadPlanner:
+    """Threshold + hysteresis scaling on KV usage / queue pressure."""
+
+    def __init__(self, config: PlannerConfig):
+        self.config = config
+        self._calm_ticks = 0
+        self._prefill_calm_ticks = 0
+
+    def tick(self, state: FleetState) -> Decision:
+        c = self.config
+        decode, prefill = state.num_decode, state.num_prefill
+
+        waiting_pw = state.num_waiting / max(1, decode)
+        pressure = (
+            state.kv_usage >= c.kv_usage_high
+            or waiting_pw >= c.waiting_per_worker_high
+        )
+        calm = state.kv_usage <= c.kv_usage_low and state.num_waiting == 0
+
+        if pressure:
+            self._calm_ticks = 0
+            decode += c.max_step
+        elif calm:
+            self._calm_ticks += 1
+            if self._calm_ticks >= c.down_stable_ticks:
+                decode -= c.max_step
+                self._calm_ticks = 0
+        else:
+            self._calm_ticks = 0
+
+        queue_pw = state.prefill_queue_depth / max(1, state.num_prefill)
+        if queue_pw >= c.prefill_queue_per_worker_high:
+            self._prefill_calm_ticks = 0
+            prefill += c.max_step
+        elif state.prefill_queue_depth == 0 and state.num_prefill > c.min_prefill:
+            # Same hysteresis as decode: prefill engines also take seconds to
+            # come back, and the queue legitimately drains between ticks.
+            self._prefill_calm_ticks += 1
+            if self._prefill_calm_ticks >= c.down_stable_ticks:
+                prefill -= c.max_step
+                self._prefill_calm_ticks = 0
+        else:
+            self._prefill_calm_ticks = 0
+
+        return Decision(
+            target_decode=_clamp(decode, c.min_decode, c.max_decode),
+            target_prefill=_clamp(prefill, c.min_prefill, c.max_prefill),
+        )
+
+
+@dataclass(frozen=True)
+class SlaTargets:
+    ttft_ms: float = 200.0
+    itl_ms: float = 20.0
+
+
+class SlaPlanner:
+    """Predict next-interval request rate, size the decode fleet so each
+    worker's share of the load keeps interpolated TTFT/ITL within targets.
+
+    `ttft_vs_rate` / `itl_vs_rate` are per-worker tables: metric as a
+    function of requests/s handled by ONE worker (from the offline profiler,
+    benchmarks/profile_sla.py)."""
+
+    def __init__(
+        self,
+        config: PlannerConfig,
+        targets: SlaTargets,
+        ttft_vs_rate: PerfInterpolator,
+        itl_vs_rate: PerfInterpolator,
+        predictor: str = "trend",
+        predictor_window: int = 8,
+    ):
+        self.config = config
+        self.targets = targets
+        self.ttft_vs_rate = ttft_vs_rate
+        self.itl_vs_rate = itl_vs_rate
+        self.predictor = make_predictor(predictor, predictor_window)
+        #: prefill scaling rides the same queue policy as LoadPlanner
+        self._load = LoadPlanner(config)
+
+    def tick(self, state: FleetState) -> Decision:
+        c = self.config
+        self.predictor.observe(state.request_rate)
+        predicted = self.predictor.predict()
+
+        per_worker_cap = min(
+            self.ttft_vs_rate.max_load_within(self.targets.ttft_ms),
+            self.itl_vs_rate.max_load_within(self.targets.itl_ms),
+        )
+        if per_worker_cap <= 0:
+            # No load level meets the SLA — pin the fleet at max and complain.
+            logger.warning(
+                "SLA targets unreachable at any load; scaling decode to max"
+            )
+            needed = c.max_decode
+        else:
+            needed = -(-predicted // per_worker_cap) if predicted > 0 else c.min_decode
+        prefill = self._load.tick(state).target_prefill
+        return Decision(
+            target_decode=_clamp(int(needed), c.min_decode, c.max_decode),
+            target_prefill=prefill,
+        )
+
+
+class PlannerRunner:
+    """Samples FleetState on an interval and actuates decisions.
+
+    `observe()` is injected (async () -> FleetState) so the runner is
+    agnostic to where state comes from — MetricsAggregator + PrefillQueue in
+    production, a stub in tests."""
+
+    def __init__(
+        self,
+        planner,
+        connector: Connector,
+        observe,
+        interval_s: Optional[float] = None,
+    ):
+        self.planner = planner
+        self.connector = connector
+        self.observe = observe
+        self.interval_s = interval_s or planner.config.interval_s
+        self._task: Optional[asyncio.Task] = None
+
+    async def step(self) -> Decision:
+        state = await self.observe()
+        decision = self.planner.tick(state)
+        d_decode, d_prefill = decision.delta(state)
+        if d_decode:
+            logger.info(
+                "planner: decode %d -> %d", state.num_decode, decision.target_decode
+            )
+            await self.connector.scale(
+                "decode", decision.target_decode, state.num_decode
+            )
+        if d_prefill:
+            logger.info(
+                "planner: prefill %d -> %d", state.num_prefill, decision.target_prefill
+            )
+            await self.connector.scale(
+                "prefill", decision.target_prefill, state.num_prefill
+            )
+        return decision
+
+    async def run(self) -> None:
+        while True:
+            try:
+                await self.step()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                logger.exception("planner tick failed")
+            await asyncio.sleep(self.interval_s)
+
+    def start(self) -> None:
+        self._task = asyncio.get_running_loop().create_task(self.run())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None

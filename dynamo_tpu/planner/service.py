@@ -1,0 +1,98 @@
+"""Planner service wiring: observe the live fleet through the fabric.
+
+FleetObserver assembles a FleetState from three sources:
+- lease discovery (InstanceSource) — who is alive, decode vs prefill
+- the worker metrics plane (MetricsAggregator) — KV usage, queue depth
+- the disagg prefill queue — backlog depth
+and derives request_rate from the fleet-wide requests_received counter
+(reference: the planner scrapes Prometheus frontend counters,
+utils/prometheus.py; here the worker metrics plane carries it directly).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Optional
+
+from dynamo_tpu.disagg.prefill_queue import PrefillQueue
+from dynamo_tpu.kv_router.metrics_aggregator import MetricsAggregator
+from dynamo_tpu.planner.planner import FleetState
+from dynamo_tpu.runtime.component import InstanceSource
+
+logger = logging.getLogger(__name__)
+
+
+class FleetObserver:
+    def __init__(
+        self,
+        runtime,
+        namespace: str = "dynamo",
+        decode_component: str = "backend",
+        decode_endpoint: str = "generate",
+        prefill_component: str = "prefill",
+        prefill_endpoint: str = "prefill",
+        queue_name: str = "prefill_queue",
+    ):
+        fabric = runtime.fabric
+        self._decode_src = InstanceSource(
+            fabric, namespace, decode_component, decode_endpoint
+        )
+        self._prefill_src = InstanceSource(
+            fabric, namespace, prefill_component, prefill_endpoint
+        )
+        self.metrics = MetricsAggregator(fabric, decode_component)
+        self.queue = PrefillQueue(fabric, queue_name)
+        #: per-instance last requests_received — rate sums per-instance
+        #: deltas, so a worker leaving the fleet doesn't read as negative
+        #: load (its counter simply stops contributing)
+        self._last_received: dict[str, int] = {}
+        self._last_ts: float = 0.0
+        self._have_baseline = False
+
+    async def start(self) -> None:
+        await self._decode_src.start()
+        await self._prefill_src.start()
+        await self.metrics.start()
+
+    async def stop(self) -> None:
+        await self._decode_src.stop()
+        await self._prefill_src.stop()
+        await self.metrics.stop()
+
+    async def observe(self) -> FleetState:
+        decode = self._decode_src.list()
+        prefill = self._prefill_src.list()
+        snap = self.metrics.snapshot()
+        usages = [m.get("kv_usage", 0.0) for m in snap.values()]
+        waiting = sum(int(m.get("num_waiting", 0)) for m in snap.values())
+        now = time.monotonic()
+        delta = 0
+        current: dict[str, int] = {}
+        for iid, m in snap.items():
+            count = int(m.get("requests_received", 0))
+            current[iid] = count
+            prev = self._last_received.get(iid)
+            if prev is not None:
+                # Per-instance: restarts (count < prev) floor at 0; a fresh
+                # instance contributes from its next sample.
+                delta += max(0, count - prev)
+        rate = 0.0
+        if self._have_baseline and now > self._last_ts:
+            rate = delta / (now - self._last_ts)
+        self._last_received = current
+        self._last_ts = now
+        self._have_baseline = True
+        try:
+            depth = await self.queue.depth()
+        except Exception:
+            logger.debug("prefill queue depth unavailable", exc_info=True)
+            depth = 0
+        return FleetState(
+            num_decode=len(decode),
+            num_prefill=len(prefill),
+            kv_usage=sum(usages) / len(usages) if usages else 0.0,
+            num_waiting=waiting,
+            prefill_queue_depth=depth,
+            request_rate=rate,
+        )

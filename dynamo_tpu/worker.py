@@ -329,6 +329,7 @@ class Worker:
                     "kv_total_pages": alloc.num_pages - 1,
                     "kv_usage": alloc.usage(),
                     "prefix_hit_rate": alloc.stats.hit_rate,
+                    "requests_received": self.mock.requests_received,
                 }
             if m is not None:
                 m["instance_id"] = self.instance_id

@@ -1,0 +1,264 @@
+"""Planner: predictors, perf interpolation, and scaling policy cores."""
+
+import asyncio
+import sys
+
+import pytest
+
+from dynamo_tpu.planner import (
+    ConstantPredictor,
+    LoadPlanner,
+    LocalConnector,
+    MovingAveragePredictor,
+    PerfInterpolator,
+    PlannerConfig,
+    RecordingConnector,
+    SlaPlanner,
+    TrendPredictor,
+    make_predictor,
+)
+from dynamo_tpu.planner.planner import Decision, FleetState, PlannerRunner, SlaTargets
+
+
+def _state(**kw):
+    base = dict(
+        num_decode=2, num_prefill=1, kv_usage=0.5, num_waiting=0,
+        prefill_queue_depth=0, request_rate=0.0,
+    )
+    base.update(kw)
+    return FleetState(**base)
+
+
+# -- predictors -------------------------------------------------------------
+
+
+def test_constant_predictor():
+    p = ConstantPredictor()
+    assert p.predict() == 0.0
+    p.observe(5)
+    p.observe(9)
+    assert p.predict() == 9.0
+
+
+def test_moving_average_window():
+    p = MovingAveragePredictor(window=3)
+    for v in (3, 6, 9, 12):
+        p.observe(v)
+    assert p.predict() == pytest.approx((6 + 9 + 12) / 3)
+
+
+def test_trend_predictor_extrapolates_ramp():
+    p = TrendPredictor(window=4)
+    for v in (10, 20, 30, 40):
+        p.observe(v)
+    assert p.predict() == pytest.approx(50.0)  # linear ramp continues
+
+
+def test_trend_predictor_never_negative():
+    p = TrendPredictor(window=4)
+    for v in (40, 20, 5, 0):
+        p.observe(v)
+    assert p.predict() >= 0.0
+
+
+def test_make_predictor_rejects_unknown():
+    with pytest.raises(ValueError):
+        make_predictor("prophet")
+
+
+# -- perf interpolation -----------------------------------------------------
+
+
+def test_interpolator_midpoints_and_clamps():
+    t = PerfInterpolator([1, 2, 4], [100, 200, 400])
+    assert t.at(1.5) == pytest.approx(150)
+    assert t.at(3) == pytest.approx(300)
+    assert t.at(0) == 100  # clamped
+    assert t.at(10) == 400
+
+
+def test_max_load_within_target():
+    t = PerfInterpolator([1, 2, 4], [100, 200, 400])
+    assert t.max_load_within(300) == pytest.approx(3.0)
+    assert t.max_load_within(50) == 0.0  # unreachable
+    assert t.max_load_within(1000) == 4.0  # everything qualifies
+
+
+# -- load planner policy ----------------------------------------------------
+
+
+def test_scale_up_on_kv_pressure():
+    p = LoadPlanner(PlannerConfig(max_decode=4))
+    d = p.tick(_state(kv_usage=0.9))
+    assert d.target_decode == 3
+
+
+def test_scale_up_on_queue_pressure():
+    p = LoadPlanner(PlannerConfig(waiting_per_worker_high=4.0))
+    d = p.tick(_state(num_waiting=8))  # 4 per worker
+    assert d.target_decode == 3
+
+
+def test_scale_down_requires_stable_calm():
+    p = LoadPlanner(PlannerConfig(down_stable_ticks=3, min_decode=1))
+    for _ in range(2):
+        assert p.tick(_state(kv_usage=0.1)).target_decode == 2
+    assert p.tick(_state(kv_usage=0.1)).target_decode == 1
+    # a pressure blip resets the calm streak
+    p2 = LoadPlanner(PlannerConfig(down_stable_ticks=3))
+    p2.tick(_state(kv_usage=0.1))
+    p2.tick(_state(kv_usage=0.9))  # blip
+    assert p2.tick(_state(kv_usage=0.1)).target_decode == 2
+    assert p2.tick(_state(kv_usage=0.1)).target_decode == 2
+
+
+def test_bounds_respected():
+    p = LoadPlanner(PlannerConfig(min_decode=2, max_decode=3))
+    assert p.tick(_state(num_decode=3, kv_usage=0.99)).target_decode == 3
+    p2 = LoadPlanner(PlannerConfig(min_decode=2, max_decode=3, down_stable_ticks=1))
+    assert p2.tick(_state(num_decode=2, kv_usage=0.0)).target_decode == 2
+
+
+def test_prefill_scales_with_queue_depth():
+    p = LoadPlanner(
+        PlannerConfig(
+            prefill_queue_per_worker_high=2.0, max_prefill=4, down_stable_ticks=2
+        )
+    )
+    d = p.tick(_state(num_prefill=1, prefill_queue_depth=3))
+    assert d.target_prefill == 2
+    # scale-down needs sustained emptiness (same hysteresis as decode)
+    d = p.tick(_state(num_prefill=2, prefill_queue_depth=0))
+    assert d.target_prefill == 2
+    d = p.tick(_state(num_prefill=2, prefill_queue_depth=0))
+    assert d.target_prefill == 1
+
+
+def test_prefill_down_hysteresis_resets_on_backlog():
+    p = LoadPlanner(PlannerConfig(down_stable_ticks=2, max_prefill=4))
+    p.tick(_state(num_prefill=2, prefill_queue_depth=0))
+    p.tick(_state(num_prefill=2, prefill_queue_depth=1))  # backlog blip
+    d = p.tick(_state(num_prefill=2, prefill_queue_depth=0))
+    assert d.target_prefill == 2  # streak restarted
+
+
+# -- SLA planner ------------------------------------------------------------
+
+
+def _sla(cfg=None, **kw):
+    # one worker keeps TTFT<=200ms up to 2 req/s and ITL<=20ms up to 3 req/s
+    return SlaPlanner(
+        cfg or PlannerConfig(min_decode=1, max_decode=8),
+        SlaTargets(ttft_ms=200, itl_ms=20),
+        ttft_vs_rate=PerfInterpolator([0.5, 2, 4], [50, 200, 500]),
+        itl_vs_rate=PerfInterpolator([0.5, 3, 6], [5, 20, 80]),
+        **kw,
+    )
+
+
+def test_sla_sizes_fleet_from_predicted_rate():
+    p = _sla(predictor="constant")
+    # capacity = min(2, 3) = 2 req/s per worker; 5 req/s -> 3 workers
+    d = p.tick(_state(request_rate=5.0))
+    assert d.target_decode == 3
+
+
+def test_sla_scales_ahead_of_ramp():
+    p = _sla(predictor="trend", predictor_window=4)
+    for rate in (1.0, 2.0, 3.0, 4.0):
+        d = p.tick(_state(request_rate=rate))
+    # trend predicts ~5 req/s next -> 3 workers, before the load arrives
+    assert d.target_decode == 3
+
+
+def test_sla_unreachable_pins_max():
+    p = SlaPlanner(
+        PlannerConfig(min_decode=1, max_decode=4),
+        SlaTargets(ttft_ms=10, itl_ms=1),  # unreachable
+        ttft_vs_rate=PerfInterpolator([1, 2], [100, 200]),
+        itl_vs_rate=PerfInterpolator([1, 2], [10, 20]),
+    )
+    assert p.tick(_state(request_rate=0.5)).target_decode == 4
+
+
+# -- runner + connectors ----------------------------------------------------
+
+
+def test_runner_actuates_only_deltas():
+    async def main():
+        conn = RecordingConnector()
+        states = iter(
+            [
+                _state(kv_usage=0.9, num_prefill=0),  # pressure -> decode 3
+                _state(num_decode=3, kv_usage=0.5, num_prefill=0),  # steady
+            ]
+        )
+
+        async def observe():
+            return next(states)
+
+        runner = PlannerRunner(
+            LoadPlanner(PlannerConfig()), conn, observe, interval_s=0.01
+        )
+        await runner.step()
+        await runner.step()
+        return conn.calls
+
+    calls = asyncio.run(main())
+    assert calls == [("decode", 3, 2)]
+
+
+def _sleeper(role):
+    return [sys.executable, "-c", "import time; time.sleep(60)"]
+
+
+def test_local_connector_spawns_and_reaps():
+    async def main():
+        conn = LocalConnector(_sleeper)
+        try:
+            await conn.scale("decode", 2, observed=0)
+            assert conn.alive("decode") == 2
+            # a repeat tick before registration must not double-spawn
+            await conn.scale("decode", 2, observed=0)
+            assert conn.alive("decode") == 2
+            # children registered; scale down by one, then to zero
+            await conn.scale("decode", 1, observed=2)
+            assert conn.alive("decode") == 1
+            await conn.scale("decode", 0, observed=1)
+            assert conn.alive("decode") == 0
+        finally:
+            conn.stop_all()
+
+    asyncio.run(main())
+
+
+def test_local_connector_counts_external_workers():
+    async def main():
+        conn = LocalConnector(_sleeper)
+        try:
+            # 2 externally started workers observed; target 3 -> spawn ONE
+            await conn.scale("decode", 3, observed=2)
+            assert conn.alive("decode") == 1
+            # already-spawned-but-unregistered child is pending capacity:
+            # the next tick still observes 2 and must not double-spawn
+            await conn.scale("decode", 3, observed=2)
+            assert conn.alive("decode") == 1
+            # once the grace window lapses without registration, the child is
+            # presumed wedged and capacity is re-spawned
+            conn.startup_grace_s = 0.0
+            await conn.scale("decode", 3, observed=2)
+            assert conn.alive("decode") == 2
+        finally:
+            conn.stop_all()
+
+    asyncio.run(main())
+
+
+def test_local_connector_cannot_stop_external_workers():
+    async def main():
+        conn = LocalConnector(_sleeper)
+        # observed 3 external workers, own none; scale down is a no-op
+        await conn.scale("decode", 2, observed=3)
+        assert conn.alive("decode") == 0
+
+    asyncio.run(main())

@@ -1,0 +1,92 @@
+"""FleetObserver over a real fabric: discovery + metrics + queue -> FleetState."""
+
+import asyncio
+
+from dynamo_tpu.disagg.prefill_queue import PrefillQueue
+from dynamo_tpu.disagg.protocol import RemotePrefillRequest
+from dynamo_tpu.planner.service import FleetObserver
+from dynamo_tpu.runtime import DistributedRuntime
+from dynamo_tpu.runtime.fabric import FabricServer
+from dynamo_tpu.subjects import METRICS_SUBJECT
+
+
+def test_fleet_observer_assembles_state():
+    async def main():
+        server = FabricServer(port=0)
+        await server.start()
+        try:
+            rt_obs = await DistributedRuntime.create(server.address)
+            rt_w = await DistributedRuntime.create(server.address)
+
+            # two decode workers + one prefill worker register
+            regs = []
+            for i in range(2):
+                ep = rt_w.namespace("dynamo").component("backend").endpoint("generate")
+                regs.append(await ep.register("127.0.0.1", 9000 + i, metadata={}))
+            epp = rt_w.namespace("dynamo").component("prefill").endpoint("prefill")
+            regs.append(await epp.register("127.0.0.1", 0, metadata={}))
+
+            observer = FleetObserver(rt_obs)
+            await observer.start()
+            await asyncio.sleep(0.2)  # watch deliveries
+
+            # metrics from both decode workers
+            for i, reg in enumerate(regs[:2]):
+                iid = reg.instance.instance_id
+                await rt_w.fabric.publish(
+                    f"{METRICS_SUBJECT}.backend.{iid}",
+                    {
+                        "instance_id": iid,
+                        "kv_usage": 0.4 + 0.2 * i,  # mean 0.5
+                        "num_waiting": 2,
+                        "requests_received": 10,
+                    },
+                )
+            # one queued remote prefill
+            q = PrefillQueue(rt_w.fabric)
+            await q.push(
+                RemotePrefillRequest(
+                    request_id="r1", token_ids=[1, 2, 3], page_ids=[1],
+                    transfer_host="h", transfer_port=1, sampling={},
+                )
+            )
+            await asyncio.sleep(0.2)
+
+            s1 = await observer.observe()
+            assert s1.num_decode == 2
+            assert s1.num_prefill == 1
+            assert abs(s1.kv_usage - 0.5) < 1e-6
+            assert s1.num_waiting == 4
+            assert s1.prefill_queue_depth == 1
+            assert s1.request_rate == 0.0  # first sample: no baseline yet
+
+            # counters advance -> positive request rate
+            await asyncio.sleep(0.05)
+            for reg in regs[:2]:
+                iid = reg.instance.instance_id
+                await rt_w.fabric.publish(
+                    f"{METRICS_SUBJECT}.backend.{iid}",
+                    {
+                        "instance_id": iid,
+                        "kv_usage": 0.5,
+                        "num_waiting": 0,
+                        "requests_received": 15,
+                    },
+                )
+            await asyncio.sleep(0.2)
+            s2 = await observer.observe()
+            assert s2.request_rate > 0.0
+
+            # a dead worker disappears from the fleet
+            await regs[0].deregister()
+            await asyncio.sleep(0.2)
+            s3 = await observer.observe()
+            assert s3.num_decode == 1
+
+            await observer.stop()
+            await rt_obs.close()
+            await rt_w.close()
+        finally:
+            await server.stop()
+
+    asyncio.run(main())
