@@ -208,6 +208,23 @@ async def _run_worker(args) -> None:
         await worker.stop()
 
 
+async def _run_metrics(args) -> None:
+    from dynamo_tpu.metrics_service import MetricsService
+    from dynamo_tpu.runtime import DistributedRuntime
+
+    rt = await DistributedRuntime.create(args.fabric)
+    svc = MetricsService(
+        rt.fabric, component=args.component, host=args.host, port=args.port
+    )
+    await svc.start()
+    print(f"metrics service on {args.host}:{svc.port}", flush=True)
+    try:
+        await asyncio.Event().wait()
+    finally:
+        await svc.stop()
+        await rt.close()
+
+
 async def _run_planner(args) -> None:
     import shlex
 
@@ -321,6 +338,12 @@ def main(argv: Optional[list[str]] = None) -> None:
     fabricp.add_argument("--host", default="127.0.0.1")
     fabricp.add_argument("--port", type=int, default=4222)
 
+    metricsp = sub.add_parser("metrics", help="Prometheus metrics service")
+    metricsp.add_argument("--fabric", required=True, help="fabric host:port")
+    metricsp.add_argument("--component", default="backend")
+    metricsp.add_argument("--host", default="127.0.0.1")
+    metricsp.add_argument("--port", type=int, default=9091)
+
     planp = sub.add_parser("planner", help="autoscale the worker fleet")
     planp.add_argument("--fabric", required=True, help="fabric host:port")
     planp.add_argument("--mode", default="load", choices=["load", "sla"])
@@ -371,6 +394,10 @@ def main(argv: Optional[list[str]] = None) -> None:
 
     if args.cmd == "planner":
         asyncio.run(_run_planner(args))
+        return
+
+    if args.cmd == "metrics":
+        asyncio.run(_run_metrics(args))
         return
 
     io = dict(kv.split("=", 1) for kv in args.io if "=" in kv)

@@ -1,0 +1,138 @@
+"""Standalone metrics service: fleet observability -> Prometheus.
+
+Capability parity with the reference's metrics component
+(/root/reference components/metrics/src/main.rs: scrape endpoint stats,
+aggregate LLMWorkerLoadCapacityConfig, serve Prometheus, subscribe
+KVHitRateEvent on `kv-hit-rate`). Here the worker metrics plane is
+push-based (worker.py _publish_loop), so the service subscribes instead of
+scraping, converts the latest per-worker snapshots plus cumulative
+router hit-rate counters into Prometheus text format, and serves
+/metrics + /health over HTTP.
+
+Run: `dynamo-tpu metrics --fabric host:port --port 9091`.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Optional
+
+from aiohttp import web
+
+from dynamo_tpu.kv_router.metrics_aggregator import MetricsAggregator
+from dynamo_tpu.subjects import KV_HIT_RATE_SUBJECT
+
+logger = logging.getLogger(__name__)
+
+PREFIX = "dynamo_tpu"
+
+#: worker snapshot fields -> (prometheus suffix, type)
+_WORKER_FIELDS = (
+    ("kv_usage", "gauge"),
+    ("kv_active_pages", "gauge"),
+    ("kv_total_pages", "gauge"),
+    ("num_waiting", "gauge"),
+    ("num_running", "gauge"),
+    ("prefix_hit_rate", "gauge"),
+    ("steps", "counter"),
+    ("generated_tokens", "counter"),
+    ("requests_received", "counter"),
+)
+
+
+class MetricsService:
+    def __init__(
+        self,
+        fabric,
+        component: str = "backend",
+        host: str = "127.0.0.1",
+        port: int = 9091,
+    ):
+        self.fabric = fabric
+        self.component = component
+        self.host = host
+        self.port = port
+        self.aggregator = MetricsAggregator(fabric, component)
+        # cumulative router-decision counters (KVHitRateEvent stream)
+        self.hit_events = 0
+        self.isl_tokens_total = 0
+        self.overlap_tokens_total = 0
+        self._sub = None
+        self._task: Optional[asyncio.Task] = None
+        self._runner: Optional[web.AppRunner] = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def start(self) -> None:
+        await self.aggregator.start()
+        self._sub = await self.fabric.subscribe(KV_HIT_RATE_SUBJECT)
+        self._task = asyncio.get_running_loop().create_task(self._pump())
+        app = web.Application()
+        app.router.add_get("/metrics", self._metrics)
+        app.router.add_get("/health", self._health)
+        self._runner = web.AppRunner(app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        self.port = self._runner.addresses[0][1]
+        logger.info("metrics service on %s:%d", self.host, self.port)
+
+    async def stop(self) -> None:
+        if self._sub is not None:
+            self._sub.close()
+        if self._task is not None:
+            self._task.cancel()
+        await self.aggregator.stop()
+        if self._runner is not None:
+            await self._runner.cleanup()
+
+    async def _pump(self) -> None:
+        while True:
+            msg = await self._sub.next()
+            if msg is None:
+                return
+            h = msg.header
+            self.hit_events += 1
+            self.isl_tokens_total += int(h.get("isl_tokens", 0))
+            self.overlap_tokens_total += int(h.get("overlap_tokens", 0))
+
+    # -- exposition --------------------------------------------------------
+
+    def expose(self) -> str:
+        snap = self.aggregator.snapshot()
+        lines = [
+            f"# TYPE {PREFIX}_live_workers gauge",
+            f'{PREFIX}_live_workers{{component="{self.component}"}} {len(snap)}',
+        ]
+        for field, ptype in _WORKER_FIELDS:
+            name = f"{PREFIX}_worker_{field}"
+            lines.append(f"# TYPE {name} {ptype}")
+            for iid, m in sorted(snap.items()):
+                if field in m:
+                    lines.append(
+                        f'{name}{{component="{self.component}",'
+                        f'instance="{iid}"}} {m[field]}'
+                    )
+        lines += [
+            f"# TYPE {PREFIX}_kv_hit_rate_events_total counter",
+            f"{PREFIX}_kv_hit_rate_events_total {self.hit_events}",
+            f"# TYPE {PREFIX}_kv_hit_rate_isl_tokens_total counter",
+            f"{PREFIX}_kv_hit_rate_isl_tokens_total {self.isl_tokens_total}",
+            f"# TYPE {PREFIX}_kv_hit_rate_overlap_tokens_total counter",
+            f"{PREFIX}_kv_hit_rate_overlap_tokens_total {self.overlap_tokens_total}",
+            f"# TYPE {PREFIX}_kv_hit_rate gauge",
+            f"{PREFIX}_kv_hit_rate "
+            f"{self.overlap_tokens_total / self.isl_tokens_total if self.isl_tokens_total else 0.0}",
+        ]
+        return "\n".join(lines) + "\n"
+
+    async def _metrics(self, request: web.Request) -> web.Response:
+        return web.Response(
+            text=self.expose(), content_type="text/plain", charset="utf-8"
+        )
+
+    async def _health(self, request: web.Request) -> web.Response:
+        return web.json_response(
+            {"status": "ok", "workers": len(self.aggregator.snapshot())}
+        )

@@ -12,8 +12,8 @@ from __future__ import annotations
 import asyncio
 import logging
 import subprocess
-import sys
-from dataclasses import dataclass, field
+import time
+from dataclasses import dataclass
 from typing import Callable, Optional, Protocol
 
 from dynamo_tpu.planner.load_predictor import make_predictor
@@ -111,40 +111,51 @@ class LocalConnector:
         #: children spawned within this window count as pending capacity
         #: (engine init takes seconds before the lease registers)
         self.startup_grace_s = startup_grace_s
-        self._procs: dict[str, list[tuple[subprocess.Popen, float]]] = {}
+        #: per role: [proc, spawn_time, seen] — `seen` flips once the
+        #: observed count rises, crediting the registration to the oldest
+        #: unseen child so it stops counting as pending
+        self._procs: dict[str, list[list]] = {}
+        self._last_observed: dict[str, int] = {}
 
     def alive(self, role: str) -> int:
         procs = self._procs.setdefault(role, [])
-        procs[:] = [(p, t) for p, t in procs if p.poll() is None]
+        procs[:] = [e for e in procs if e[0].poll() is None]
         return len(procs)
 
     def _pending(self, role: str) -> int:
-        import time as _time
-
-        now = _time.monotonic()
+        now = time.monotonic()
         return sum(
             1
-            for _, t in self._procs.get(role, ())
-            if now - t < self.startup_grace_s
+            for _, t, seen in self._procs.get(role, ())
+            if not seen and now - t < self.startup_grace_s
         )
 
     async def scale(self, role: str, target: int, observed: int) -> None:
         self.alive(role)  # reap
         procs = self._procs[role]
+        # Registrations since last tick retire pending credits, oldest first
+        # (a child that both spawned AND registered must not count twice —
+        # once in `observed` and once in pending).
+        newly_seen = max(0, observed - self._last_observed.get(role, observed))
+        self._last_observed[role] = observed
+        for entry in sorted(procs, key=lambda e: e[1]):
+            if newly_seen <= 0:
+                break
+            if not entry[2]:
+                entry[2] = True
+                newly_seen -= 1
         delta = target - observed
         if delta > 0:
-            # Children still inside their startup grace are capacity the
-            # observation hasn't seen yet — don't spawn duplicates for them.
+            # Unseen children inside their startup grace are capacity the
+            # observation hasn't caught up with — don't duplicate them.
             for _ in range(max(0, delta - self._pending(role))):
                 argv = self.spawn_cmd(role)
                 logger.info("planner: spawning %s worker: %s", role, argv)
-                import time as _time
-
-                procs.append((subprocess.Popen(argv), _time.monotonic()))
+                procs.append([subprocess.Popen(argv), time.monotonic(), False])
         elif delta < 0:
             to_stop = min(-delta, len(procs))
             for _ in range(to_stop):
-                victim, _ = procs.pop()
+                victim = procs.pop()[0]
                 logger.info(
                     "planner: stopping %s worker pid=%s", role, victim.pid
                 )
@@ -158,9 +169,9 @@ class LocalConnector:
 
     def stop_all(self) -> None:
         for procs in self._procs.values():
-            for p, _ in procs:
-                if p.poll() is None:
-                    p.terminate()
+            for entry in procs:
+                if entry[0].poll() is None:
+                    entry[0].terminate()
 
 
 def _clamp(v: int, lo: int, hi: int) -> int:

@@ -1,0 +1,73 @@
+"""Standalone metrics service: worker plane + hit-rate stream -> Prometheus."""
+
+import asyncio
+
+import aiohttp
+
+from dynamo_tpu.metrics_service import MetricsService
+from dynamo_tpu.runtime import DistributedRuntime
+from dynamo_tpu.runtime.fabric import FabricServer
+from dynamo_tpu.subjects import KV_HIT_RATE_SUBJECT, METRICS_SUBJECT
+
+
+def test_metrics_service_exposition():
+    async def main():
+        server = FabricServer(port=0)
+        await server.start()
+        try:
+            rt_m = await DistributedRuntime.create(server.address)
+            rt_w = await DistributedRuntime.create(server.address)
+            svc = MetricsService(rt_m.fabric, component="backend", port=0)
+            await svc.start()
+            await asyncio.sleep(0.1)
+
+            await rt_w.fabric.publish(
+                f"{METRICS_SUBJECT}.backend.worker-1",
+                {
+                    "instance_id": "worker-1",
+                    "kv_usage": 0.25,
+                    "num_waiting": 3,
+                    "generated_tokens": 100,
+                    "requests_received": 7,
+                },
+            )
+            for _ in range(2):
+                await rt_w.fabric.publish(
+                    KV_HIT_RATE_SUBJECT,
+                    {"isl_tokens": 100, "overlap_blocks": 1, "overlap_tokens": 64},
+                )
+            await asyncio.sleep(0.2)
+
+            async with aiohttp.ClientSession() as sess:
+                async with sess.get(
+                    f"http://127.0.0.1:{svc.port}/metrics"
+                ) as resp:
+                    assert resp.status == 200
+                    text = await resp.text()
+                async with sess.get(
+                    f"http://127.0.0.1:{svc.port}/health"
+                ) as resp:
+                    health = await resp.json()
+
+            assert 'dynamo_tpu_live_workers{component="backend"} 1' in text
+            assert (
+                'dynamo_tpu_worker_kv_usage{component="backend",instance="worker-1"} 0.25'
+                in text
+            )
+            assert (
+                'dynamo_tpu_worker_requests_received{component="backend",instance="worker-1"} 7'
+                in text
+            )
+            assert "dynamo_tpu_kv_hit_rate_events_total 2" in text
+            assert "dynamo_tpu_kv_hit_rate_isl_tokens_total 200" in text
+            assert "dynamo_tpu_kv_hit_rate_overlap_tokens_total 128" in text
+            assert "dynamo_tpu_kv_hit_rate 0.64" in text
+            assert health["workers"] == 1
+
+            await svc.stop()
+            await rt_m.close()
+            await rt_w.close()
+        finally:
+            await server.stop()
+
+    asyncio.run(main())

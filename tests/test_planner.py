@@ -221,8 +221,12 @@ def test_local_connector_spawns_and_reaps():
             # a repeat tick before registration must not double-spawn
             await conn.scale("decode", 2, observed=0)
             assert conn.alive("decode") == 2
-            # children registered; scale down by one, then to zero
-            await conn.scale("decode", 1, observed=2)
+            # both register, then load spikes: the registered children no
+            # longer count as pending, so a real spawn happens immediately
+            await conn.scale("decode", 3, observed=2)
+            assert conn.alive("decode") == 3
+            # scale back down to zero
+            await conn.scale("decode", 1, observed=3)
             assert conn.alive("decode") == 1
             await conn.scale("decode", 0, observed=1)
             assert conn.alive("decode") == 0
