@@ -208,6 +208,86 @@ async def _run_worker(args) -> None:
         await worker.stop()
 
 
+async def _run_serve(args) -> None:
+    """Orchestrate a service graph: one OS process per replica (the
+    reference's circus-arbiter local serving, sdk cli/serving.py:152)."""
+    import subprocess
+
+    from dynamo_tpu.sdk.config import load_config
+    from dynamo_tpu.sdk.decorators import service_meta
+    from dynamo_tpu.sdk.graph import discover_graph
+    from dynamo_tpu.sdk.serving import resolve_service
+
+    root = resolve_service(args.graph)
+    config = load_config(args.config) if args.config else {}
+
+    fabric_server = None
+    fabric_addr = args.fabric
+    if fabric_addr is None:
+        from dynamo_tpu.runtime.fabric import FabricServer
+
+        fabric_server = FabricServer(port=args.fabric_port)
+        await fabric_server.start()
+        fabric_addr = fabric_server.address
+        print(f"fabric on {fabric_addr}", flush=True)
+
+    # SIGTERM/SIGINT must run the cleanup below, or every replica (and the
+    # locally spawned fabric) outlives the orchestrator.
+    import signal as _signal
+
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (_signal.SIGTERM, _signal.SIGINT):
+        loop.add_signal_handler(sig, stop.set)
+
+    procs: list[tuple[str, "subprocess.Popen"]] = []
+    try:
+        for cls in discover_graph(root):
+            meta = service_meta(cls)
+            svc_cfg = config.get(meta.name, {})
+            replicas = int(
+                (svc_cfg.get("ServiceArgs") or {}).get("workers", meta.workers)
+            )
+            spec = f"{cls.__module__}:{cls.__name__}"
+            for _ in range(replicas):
+                cmd = [
+                    sys.executable, "-m", "dynamo_tpu.sdk.serving", spec,
+                    "--fabric", fabric_addr,
+                ]
+                if args.config:
+                    cmd += ["-f", args.config]
+                print(f"spawning {meta.name}: {' '.join(cmd)}", flush=True)
+                procs.append((meta.name, subprocess.Popen(cmd)))
+        print(f"graph up: {len(procs)} service processes", flush=True)
+        # Supervise: a dead child means a degraded graph — tear down and
+        # exit nonzero so the outer supervisor (systemd/k8s) restarts us.
+        while not stop.is_set():
+            for name, p in procs:
+                code = p.poll()
+                if code is not None:
+                    print(
+                        f"service {name} (pid {p.pid}) exited with {code}; "
+                        "stopping graph", file=sys.stderr, flush=True,
+                    )
+                    stop.set()
+                    break
+            try:
+                await asyncio.wait_for(stop.wait(), timeout=1.0)
+            except asyncio.TimeoutError:
+                pass
+    finally:
+        for _, p in procs:
+            if p.poll() is None:
+                p.terminate()
+        for _, p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        if fabric_server is not None:
+            await fabric_server.stop()
+
+
 async def _run_metrics(args) -> None:
     from dynamo_tpu.metrics_service import MetricsService
     from dynamo_tpu.runtime import DistributedRuntime
@@ -338,6 +418,18 @@ def main(argv: Optional[list[str]] = None) -> None:
     fabricp.add_argument("--host", default="127.0.0.1")
     fabricp.add_argument("--port", type=int, default=4222)
 
+    servep = sub.add_parser("serve", help="serve a service graph (SDK DSL)")
+    servep.add_argument("graph", help="pkg.module:RootService")
+    servep.add_argument("-f", "--config", default=None, help="YAML config")
+    servep.add_argument(
+        "--fabric", default=None,
+        help="existing fabric host:port (default: spawn one locally)",
+    )
+    servep.add_argument(
+        "--fabric-port", type=int, default=4222, dest="fabric_port",
+        help="port for the locally spawned fabric",
+    )
+
     metricsp = sub.add_parser("metrics", help="Prometheus metrics service")
     metricsp.add_argument("--fabric", required=True, help="fabric host:port")
     metricsp.add_argument("--component", default="backend")
@@ -398,6 +490,10 @@ def main(argv: Optional[list[str]] = None) -> None:
 
     if args.cmd == "metrics":
         asyncio.run(_run_metrics(args))
+        return
+
+    if args.cmd == "serve":
+        asyncio.run(_run_serve(args))
         return
 
     io = dict(kv.split("=", 1) for kv in args.io if "=" in kv)

@@ -92,10 +92,18 @@ class MetricsService:
             msg = await self._sub.next()
             if msg is None:
                 return
-            h = msg.header
+            try:
+                h = msg.header or {}
+                isl = int(h.get("isl_tokens", 0))
+                overlap = int(h.get("overlap_tokens", 0))
+            except (TypeError, ValueError, AttributeError):
+                # One malformed publish must not kill the consumer task and
+                # freeze the counters for every later legitimate event.
+                logger.warning("malformed kv-hit-rate event: %r", msg.header)
+                continue
             self.hit_events += 1
-            self.isl_tokens_total += int(h.get("isl_tokens", 0))
-            self.overlap_tokens_total += int(h.get("overlap_tokens", 0))
+            self.isl_tokens_total += isl
+            self.overlap_tokens_total += overlap
 
     # -- exposition --------------------------------------------------------
 

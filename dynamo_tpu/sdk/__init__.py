@@ -1,0 +1,29 @@
+"""SDK: the service-graph DSL and local serving orchestrator.
+
+Capability parity with the reference's deploy/sdk (SURVEY.md #39): declare
+services with `@service`, expose streaming handlers with `@endpoint`, wire
+dependencies with `depends(Other)`, and run the whole graph with
+`serve_graph` (in-process) or the `dynamo-tpu serve` CLI (one OS process
+per service replica, the reference's circus-arbiter shape —
+deploy/sdk/src/dynamo/sdk/cli/serving.py:152).
+
+Every service process joins the distributed runtime: endpoints register
+under namespace/<service>/<endpoint> with the process lease, dependencies
+resolve to PushRouter-backed clients, so SDK graphs interoperate with
+plain workers/frontends on the same fabric.
+"""
+
+from dynamo_tpu.sdk.config import load_config
+from dynamo_tpu.sdk.decorators import depends, endpoint, service
+from dynamo_tpu.sdk.graph import discover_graph
+from dynamo_tpu.sdk.serving import ServiceHandle, serve_graph
+
+__all__ = [
+    "service",
+    "endpoint",
+    "depends",
+    "discover_graph",
+    "load_config",
+    "serve_graph",
+    "ServiceHandle",
+]

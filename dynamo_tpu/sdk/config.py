@@ -1,0 +1,62 @@
+"""Per-service YAML config with common-configs inheritance + env interpolation.
+
+Reference parity: the SDK's YAML service configs
+(examples/llm/configs/disagg_router.yaml:15-60 `common-configs`, env
+interpolation in deploy/sdk lib/config.py). Shape:
+
+    common-configs:
+      fabric: 127.0.0.1:4222
+    Frontend:
+      port: ${FRONTEND_PORT}
+    Worker:
+      model: llama3-8b
+      ServiceArgs:
+        workers: 2
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Any
+
+import yaml
+
+_ENV_RE = re.compile(r"\$\{([A-Za-z_][A-Za-z0-9_]*)(?::-([^}]*))?\}")
+
+
+def _interpolate(value: Any) -> Any:
+    if isinstance(value, str):
+
+        def sub(m: re.Match) -> str:
+            var, default = m.group(1), m.group(2)
+            got = os.environ.get(var)
+            if got is None:
+                if default is not None:
+                    return default
+                raise KeyError(
+                    f"config references undefined environment variable {var}"
+                )
+            return got
+
+        return _ENV_RE.sub(sub, value)
+    if isinstance(value, dict):
+        return {k: _interpolate(v) for k, v in value.items()}
+    if isinstance(value, list):
+        return [_interpolate(v) for v in value]
+    return value
+
+
+def load_config(path: str) -> dict[str, dict]:
+    """service name -> merged config dict (common-configs under, service
+    overrides on top), ${VAR} / ${VAR:-default} interpolated."""
+    with open(path) as f:
+        data = yaml.safe_load(f) or {}
+    if not isinstance(data, dict):
+        raise ValueError(f"{path}: top level must be a mapping")
+    common = data.pop("common-configs", {}) or {}
+    out = {}
+    for svc, cfg in data.items():
+        merged = {**common, **(cfg or {})}
+        out[svc] = _interpolate(merged)
+    return out

@@ -1,0 +1,97 @@
+"""@service / @endpoint / depends — the graph DSL primitives.
+
+Reference parity: deploy/sdk core/lib.py:88-121 (@service), core/decorators/
+endpoint.py:99 (@endpoint), depends() in core/lib.py — reimagined thin:
+metadata lives on the class, all runtime wiring happens in sdk/serving.py.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Optional, Union
+
+
+@dataclass(frozen=True)
+class ServiceMeta:
+    name: str
+    namespace: str = "dynamo"
+    #: default replica count (config ServiceArgs.workers overrides)
+    workers: int = 1
+
+
+def service(cls=None, *, name: Optional[str] = None, namespace: str = "dynamo",
+            workers: int = 1):
+    """Class decorator marking a service. Usable bare (@service) or with
+    arguments (@service(name=..., workers=2))."""
+
+    def wrap(c):
+        c._svc_meta = ServiceMeta(
+            name=name or c.__name__, namespace=namespace, workers=workers
+        )
+        return c
+
+    return wrap(cls) if cls is not None else wrap
+
+
+def endpoint(fn: Optional[Callable] = None, *, name: Optional[str] = None):
+    """Method decorator: `async def handler(self, ctx, request)` yielding
+    response chunks (the runtime's streaming handler contract,
+    runtime/ingress.py)."""
+
+    def wrap(f):
+        f._endpoint_name = name or f.__name__
+        return f
+
+    return wrap(fn) if fn is not None else wrap
+
+
+class depends:
+    """Class attribute declaring a dependency on another service. At serve
+    time the attribute becomes a ServiceClient whose endpoint methods stream
+    responses:
+
+        backend = depends(Backend)
+        ...
+        async for chunk in self.backend.generate({...}): ...
+    """
+
+    def __init__(self, target: Union[type, str]):
+        self.target = target
+
+    def target_meta(self) -> ServiceMeta:
+        if isinstance(self.target, str):
+            return ServiceMeta(name=self.target)
+        meta = getattr(self.target, "_svc_meta", None)
+        if meta is None:
+            raise TypeError(
+                f"depends() target {self.target!r} is not a @service class"
+            )
+        return meta
+
+
+def service_meta(cls) -> ServiceMeta:
+    meta = getattr(cls, "_svc_meta", None)
+    if meta is None:
+        raise TypeError(f"{cls!r} is not a @service class")
+    return meta
+
+
+def service_endpoints(cls) -> dict[str, str]:
+    """endpoint name -> method attribute name."""
+    out = {}
+    for attr in dir(cls):
+        fn = getattr(cls, attr, None)
+        ep = getattr(fn, "_endpoint_name", None)
+        if ep is not None:
+            out[ep] = attr
+    return out
+
+
+def service_dependencies(cls) -> dict[str, depends]:
+    """attribute name -> depends declaration."""
+    out = {}
+    for klass in reversed(cls.__mro__):
+        for attr, val in vars(klass).items():
+            if isinstance(val, depends):
+                out[attr] = val
+    return out

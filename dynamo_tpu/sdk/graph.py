@@ -1,0 +1,32 @@
+"""Graph discovery: root service class -> dependency-ordered class list."""
+
+from __future__ import annotations
+
+from dynamo_tpu.sdk.decorators import service_dependencies, service_meta
+
+
+def discover_graph(root) -> list[type]:
+    """All services reachable from `root` via depends(), dependencies first
+    (so serving brings providers up before consumers). String-named
+    dependencies are external (already running on the fabric) and are not
+    part of the returned graph."""
+    order: list[type] = []
+    visiting: set[type] = set()
+
+    def visit(cls) -> None:
+        service_meta(cls)  # raises for non-services
+        if cls in order:
+            return
+        if cls in visiting:
+            raise ValueError(
+                f"dependency cycle through {cls.__name__}"
+            )
+        visiting.add(cls)
+        for dep in service_dependencies(cls).values():
+            if not isinstance(dep.target, str):
+                visit(dep.target)
+        visiting.discard(cls)
+        order.append(cls)
+
+    visit(root)
+    return order

@@ -1,0 +1,68 @@
+"""Hello-world service graph: Frontend (HTTP) -> Middle -> Backend.
+
+The SDK's canonical smoke graph (reference: examples/hello_world) — three
+services chained with depends(); run it with:
+
+    PYTHONPATH=. python -m dynamo_tpu.cli.run serve examples.hello_world.graph:Frontend
+
+then: curl 'http://127.0.0.1:8017/generate?text=hello world'
+"""
+
+from __future__ import annotations
+
+from aiohttp import web
+
+from dynamo_tpu.sdk import depends, endpoint, service
+
+
+@service
+class Backend:
+    @endpoint
+    async def generate(self, ctx, request):
+        for word in request["text"].split():
+            yield {"word": word.upper()}
+
+
+@service
+class Middle:
+    backend = depends(Backend)
+
+    @endpoint
+    async def generate(self, ctx, request):
+        async for item in self.backend.generate(
+            {"text": request["text"]}
+        ):
+            yield {"word": f"mid-{item['word']}"}
+
+
+@service
+class Frontend:
+    middle = depends(Middle)
+
+    def __init__(self):
+        self._runner = None
+        self.port = None
+
+    async def setup(self):
+        app = web.Application()
+        app.router.add_get("/generate", self._generate)
+        self._runner = web.AppRunner(app)
+        await self._runner.setup()
+        site = web.TCPSite(
+            self._runner, "127.0.0.1", int(self.config.get("port", 8017))
+        )
+        await site.start()
+        self.port = self._runner.addresses[0][1]
+        print(f"hello-world frontend on 127.0.0.1:{self.port}", flush=True)
+
+    async def teardown(self):
+        if self._runner is not None:
+            await self._runner.cleanup()
+
+    async def _generate(self, request: web.Request) -> web.Response:
+        text = request.query.get("text", "hello world")
+        words = [
+            item["word"]
+            async for item in self.middle.generate({"text": text})
+        ]
+        return web.json_response({"words": words})

@@ -1,0 +1,222 @@
+"""SDK DSL: decorators, graph discovery, config, in-process + CLI serving."""
+
+import asyncio
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from dynamo_tpu.sdk import (
+    depends,
+    discover_graph,
+    endpoint,
+    load_config,
+    serve_graph,
+    service,
+)
+from dynamo_tpu.sdk.decorators import (
+    service_dependencies,
+    service_endpoints,
+    service_meta,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -- decorators / graph -----------------------------------------------------
+
+
+@service
+class A:
+    @endpoint
+    async def gen(self, ctx, request):
+        yield {"from": "a", "x": request["x"]}
+
+
+@service(name="bee", workers=2)
+class B:
+    a = depends(A)
+
+    @endpoint(name="run")
+    async def handler(self, ctx, request):
+        async for item in self.a.gen(request):
+            yield {"via": "b", **item}
+
+
+@service
+class C:
+    b = depends(B)
+    a = depends(A)  # diamond
+
+
+def test_metadata_and_discovery():
+    assert service_meta(B).name == "bee" and service_meta(B).workers == 2
+    assert service_endpoints(B) == {"run": "handler"}
+    assert set(service_dependencies(C)) == {"a", "b"}
+    order = discover_graph(C)
+    assert order.index(A) < order.index(B) < order.index(C)
+    assert order.count(A) == 1  # diamond visited once
+
+
+def test_cycle_detection():
+    @service
+    class X:
+        pass
+
+    @service
+    class Y:
+        x = depends(X)
+
+    X.y = depends(Y)
+    with pytest.raises(ValueError, match="cycle"):
+        discover_graph(X)
+
+
+def test_depends_rejects_plain_class():
+    class NotAService:
+        pass
+
+    with pytest.raises(TypeError, match="not a @service"):
+        depends(NotAService).target_meta()
+
+
+# -- config -----------------------------------------------------------------
+
+
+def test_load_config(tmp_path, monkeypatch):
+    monkeypatch.setenv("HW_PORT", "9999")
+    p = tmp_path / "conf.yaml"
+    p.write_text(
+        """
+common-configs:
+  fabric: 127.0.0.1:4222
+Frontend:
+  port: ${HW_PORT}
+  retries: ${MISSING:-3}
+Worker:
+  model: tiny
+"""
+    )
+    cfg = load_config(str(p))
+    assert cfg["Frontend"]["fabric"] == "127.0.0.1:4222"
+    assert cfg["Frontend"]["port"] == "9999"
+    assert cfg["Frontend"]["retries"] == "3"
+    assert cfg["Worker"]["model"] == "tiny"
+    monkeypatch.delenv("HW_PORT")
+    with pytest.raises(KeyError, match="HW_PORT"):
+        load_config(str(p))
+
+
+# -- in-process serving -----------------------------------------------------
+
+
+def test_serve_graph_in_process():
+    from dynamo_tpu.runtime.fabric import FabricServer
+
+    async def main():
+        server = FabricServer(port=0)
+        await server.start()
+        try:
+            graph = await serve_graph(B, fabric_addr=server.address)
+            await asyncio.sleep(0.2)
+            from dynamo_tpu.sdk.serving import ServiceClient
+
+            from dynamo_tpu.runtime import DistributedRuntime
+
+            rt = await DistributedRuntime.create(server.address)
+            client = ServiceClient(rt, service_meta(B))
+            got = [item async for item in client.run({"x": 41})]
+            assert got == [{"via": "b", "from": "a", "x": 41}]
+            client.close()
+            await rt.close()
+            await graph.stop()
+        finally:
+            await server.stop()
+
+    asyncio.run(main())
+
+
+def test_hello_world_graph_in_process():
+    from examples.hello_world.graph import Frontend
+
+    async def run():
+        from dynamo_tpu.runtime.fabric import FabricServer
+
+        server = FabricServer(port=0)
+        await server.start()
+        try:
+            graph = await serve_graph(
+                Frontend,
+                config={"Frontend": {"port": 0}},
+                fabric_addr=server.address,
+            )
+            port = graph.instance_of(Frontend).port
+            import aiohttp
+
+            async with aiohttp.ClientSession() as sess:
+                async with sess.get(
+                    f"http://127.0.0.1:{port}/generate",
+                    params={"text": "tpu go brr"},
+                ) as resp:
+                    data = await resp.json()
+            assert data["words"] == ["mid-TPU", "mid-GO", "mid-BRR"]
+            await graph.stop()
+        finally:
+            await server.stop()
+
+    asyncio.run(run())
+
+
+# -- CLI serving (one process per service) ----------------------------------
+
+
+@pytest.mark.slow
+def test_serve_cli_spawns_graph():
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "dynamo_tpu.cli.run", "serve",
+            "examples.hello_world.graph:Frontend", "--fabric-port", "0",
+        ],
+        cwd=REPO, env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    try:
+        deadline = time.time() + 60
+        data = None
+        while time.time() < deadline:
+            try:
+                with urllib.request.urlopen(
+                    "http://127.0.0.1:8017/generate?text=all%20systems%20go",
+                    timeout=1,
+                ) as resp:
+                    import json
+
+                    data = json.loads(resp.read())
+                    break
+            except OSError:
+                if proc.poll() is not None:
+                    out = proc.stdout.read()
+                    raise AssertionError(f"serve died:\n{out}")
+                time.sleep(0.5)
+        assert data == {"words": ["mid-ALL", "mid-SYSTEMS", "mid-GO"]}
+        # SIGTERM must reap the whole graph (children + fabric), not just
+        # the orchestrator.
+        proc.send_signal(signal.SIGTERM)
+        proc.wait(timeout=15)
+        time.sleep(0.5)
+        leftover = subprocess.run(
+            ["pgrep", "-f", "dynamo_tpu.sdk.serving"],
+            capture_output=True, text=True,
+        )
+        assert leftover.stdout.strip() == "", (
+            f"orphaned service processes: {leftover.stdout}"
+        )
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+        subprocess.run(["pkill", "-f", "dynamo_tpu.sdk.serving"], check=False)
