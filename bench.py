@@ -22,6 +22,12 @@ import time
 
 
 def main() -> None:
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from dynamo_tpu.platform import honor_jax_platforms_env
+
+    honor_jax_platforms_env()
     model = os.environ.get("BENCH_MODEL", "llama3-1b")
     num_requests = int(os.environ.get("BENCH_REQUESTS", "16"))
     isl = int(os.environ.get("BENCH_ISL", "128"))

@@ -1,0 +1,6 @@
+"""Benchmark harness (reference: benchmarks/ — SURVEY.md #43).
+
+- synthesizer: prefix-tree structured synthetic workloads
+- perf: concurrency-sweep serving benchmark (tok/s, TTFT, ITL)
+- profile_sla: per-worker perf tables for the SLA planner
+"""

@@ -1,0 +1,276 @@
+"""Concurrency-sweep serving benchmark.
+
+The reference's genai-perf harness shape (benchmarks/llm/perf.sh: ISL 3000 /
+OSL 150, concurrency sweep 1-256, streaming) pointed at either:
+- the in-process engine (`--mode engine`, default — what the driver's
+  bench.py wraps), or
+- a live OpenAI frontend (`--mode http --url http://host:port`), measuring
+  the full network path.
+
+Per concurrency level: output tok/s, request/s, TTFT p50/p95, ITL p50/p95.
+Prints one JSON document; `--csv` emits a sweep table.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import statistics
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+
+@dataclass
+class RequestResult:
+    ttft_s: Optional[float]
+    latency_s: float
+    output_tokens: int
+    itls_s: list[float]
+
+
+def _percentiles(values, ps=(50, 95)):
+    if not values:
+        return {f"p{p}": None for p in ps}
+    values = sorted(values)
+    out = {}
+    for p in ps:
+        k = min(len(values) - 1, int(round((p / 100) * (len(values) - 1))))
+        out[f"p{p}"] = values[k]
+    return out
+
+
+def summarize(results: list[RequestResult], wall_s: float) -> dict:
+    ttfts = [r.ttft_s for r in results if r.ttft_s is not None]
+    itls = [v for r in results for v in r.itls_s]
+    out_tokens = sum(r.output_tokens for r in results)
+    return {
+        "requests": len(results),
+        "wall_s": round(wall_s, 3),
+        "output_tok_s": round(out_tokens / wall_s, 2) if wall_s else 0.0,
+        "req_s": round(len(results) / wall_s, 3) if wall_s else 0.0,
+        "ttft_ms": {
+            k: round(v * 1e3, 2) if v is not None else None
+            for k, v in _percentiles(ttfts).items()
+        },
+        "itl_ms": {
+            k: round(v * 1e3, 3) if v is not None else None
+            for k, v in _percentiles(itls).items()
+        },
+    }
+
+
+# -- engine mode ------------------------------------------------------------
+
+
+def bench_engine(
+    engine, prompts: list[tuple[list[int], int]], concurrency: int
+) -> dict:
+    """Closed-loop: keep `concurrency` requests in flight inside the
+    engine's step loop; measure per-request TTFT/ITL from step timestamps."""
+    from dynamo_tpu.engine.request import SamplingParams
+
+    pending = list(enumerate(prompts))
+    starts: dict[str, float] = {}
+    first: dict[str, float] = {}
+    last: dict[str, float] = {}
+    itls: dict[str, list[float]] = {}
+    counts: dict[str, int] = {}
+    done: list[str] = []
+
+    def submit_next() -> bool:
+        if not pending:
+            return False
+        i, (toks, osl) = pending.pop(0)
+        rid = f"r{i}"
+        engine.add_request(
+            rid, toks, SamplingParams(max_tokens=osl, ignore_eos=True)
+        )
+        starts[rid] = time.perf_counter()
+        itls[rid] = []
+        counts[rid] = 0
+        return True
+
+    for _ in range(concurrency):
+        submit_next()
+    t0 = time.perf_counter()
+    while engine.has_work:
+        outs = engine.step()
+        now = time.perf_counter()
+        for o in outs:
+            rid = o.request_id
+            if o.new_token_ids:
+                counts[rid] += len(o.new_token_ids)
+                if rid not in first:
+                    first[rid] = now
+                else:
+                    itls[rid].append(now - last[rid])
+                last[rid] = now
+            if o.finish_reason is not None:
+                done.append(rid)
+                submit_next()
+    wall = time.perf_counter() - t0
+    results = [
+        RequestResult(
+            ttft_s=(first[rid] - starts[rid]) if rid in first else None,
+            latency_s=(last.get(rid, starts[rid]) - starts[rid]),
+            output_tokens=counts[rid],
+            itls_s=itls[rid],
+        )
+        for rid in done
+    ]
+    return summarize(results, wall)
+
+
+# -- http mode --------------------------------------------------------------
+
+
+async def _one_http(session, url: str, model: str, prompt_text: str, osl: int):
+    payload = {
+        "model": model,
+        "messages": [{"role": "user", "content": prompt_text}],
+        "stream": True,
+        "max_tokens": osl,
+    }
+    t0 = time.perf_counter()
+    ttft = None
+    prev = None
+    itls: list[float] = []
+    n = 0
+    async with session.post(url + "/v1/chat/completions", json=payload) as resp:
+        resp.raise_for_status()
+        async for raw in resp.content:
+            line = raw.decode().strip()
+            if not line.startswith("data:") or line == "data: [DONE]":
+                continue
+            now = time.perf_counter()
+            n += 1
+            if ttft is None:
+                ttft = now - t0
+            else:
+                itls.append(now - prev)
+            prev = now
+    return RequestResult(
+        ttft_s=ttft, latency_s=time.perf_counter() - t0, output_tokens=n,
+        itls_s=itls,
+    )
+
+
+async def bench_http(
+    url: str, model: str, prompts: list[tuple[str, int]], concurrency: int
+) -> dict:
+    import aiohttp
+
+    queue: asyncio.Queue = asyncio.Queue()
+    for p in prompts:
+        queue.put_nowait(p)
+    results: list[RequestResult] = []
+
+    async with aiohttp.ClientSession() as session:
+
+        async def worker():
+            while True:
+                try:
+                    text, osl = queue.get_nowait()
+                except asyncio.QueueEmpty:
+                    return
+                results.append(await _one_http(session, url, model, text, osl))
+
+        t0 = time.perf_counter()
+        await asyncio.gather(*(worker() for _ in range(concurrency)))
+        wall = time.perf_counter() - t0
+    return summarize(results, wall)
+
+
+# -- CLI --------------------------------------------------------------------
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description="concurrency-sweep benchmark")
+    p.add_argument("--mode", choices=["engine", "http"], default="engine")
+    p.add_argument("--url", default="http://127.0.0.1:8080")
+    p.add_argument("--model", default="llama3-1b")
+    p.add_argument("--num-requests", type=int, default=32, dest="num_requests")
+    p.add_argument("--isl", type=int, default=128)
+    p.add_argument("--osl", type=int, default=64)
+    p.add_argument(
+        "--concurrency", default="1,4,16",
+        help="comma-separated sweep levels",
+    )
+    p.add_argument("--num-pages", type=int, default=2048, dest="num_pages")
+    p.add_argument("--page-size", type=int, default=64, dest="page_size")
+    p.add_argument("--dtype", default="bfloat16")
+    p.add_argument("--csv", action="store_true")
+    args = p.parse_args(argv)
+
+    from dynamo_tpu.platform import honor_jax_platforms_env
+
+    honor_jax_platforms_env()
+
+    from benchmarks.synthesizer import SynthConfig, synthesize
+
+    reqs = synthesize(
+        SynthConfig(
+            num_requests=args.num_requests,
+            depth=0,
+            mean_suffix_len=args.isl,
+            mean_output_len=args.osl,
+        )
+    )
+    levels = [int(x) for x in args.concurrency.split(",")]
+    sweep = []
+    if args.mode == "engine":
+        from dynamo_tpu.engine import EngineConfig
+        from dynamo_tpu.engine.engine import JaxEngine
+
+        engine = JaxEngine(
+            EngineConfig(
+                model=args.model,
+                num_pages=args.num_pages,
+                page_size=args.page_size,
+                max_pages_per_seq=max(
+                    8, -(-(args.isl + args.osl + 64) // args.page_size)
+                ),
+                dtype=args.dtype,
+                enable_prefix_caching=False,
+            )
+        )
+        prompts = [(list(r.prompt_tokens), r.output_len) for r in reqs]
+        # warmup compiles every program shape the sweep will touch
+        bench_engine(engine, prompts[: max(levels)], max(levels))
+        for c in levels:
+            sweep.append({"concurrency": c, **bench_engine(engine, prompts, c)})
+    else:
+        texts = [
+            (" ".join(str(t) for t in r.prompt_tokens[: args.isl // 4]),
+             r.output_len)
+            for r in reqs
+        ]
+        for c in levels:
+            sweep.append(
+                {
+                    "concurrency": c,
+                    **asyncio.run(bench_http(args.url, args.model, texts, c)),
+                }
+            )
+
+    if args.csv:
+        cols = ["concurrency", "output_tok_s", "req_s"]
+        print(",".join(cols + ["ttft_p50_ms", "itl_p50_ms"]))
+        for row in sweep:
+            print(
+                ",".join(
+                    str(x)
+                    for x in (
+                        row["concurrency"], row["output_tok_s"], row["req_s"],
+                        row["ttft_ms"]["p50"], row["itl_ms"]["p50"],
+                    )
+                )
+            )
+    else:
+        print(json.dumps({"mode": args.mode, "sweep": sweep}, indent=2))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,103 @@
+"""SLA profiler: build the per-worker perf tables the SLA planner consumes.
+
+Reference parity: benchmarks/profiler/profile_sla.py sweeps parallel
+configs and interpolates TTFT/ITL against load to pre-compute planner
+tables (docs sla_planner.md). Here: sweep closed-loop concurrency against
+ONE engine worker, record (achieved req/s -> TTFT ms, ITL ms), and emit
+exactly the JSON `dynamo-tpu planner --mode sla --perf-table` loads:
+
+    {"ttft_vs_rate": [[req_s, ttft_p50_ms], ...],
+     "itl_vs_rate":  [[req_s, itl_p50_ms], ...],
+     "meta": {...}}
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+
+def profile(
+    model: str = "tiny",
+    num_requests: int = 32,
+    isl: int = 64,
+    osl: int = 32,
+    concurrency_levels=(1, 2, 4, 8),
+    engine_config=None,
+) -> dict:
+    from benchmarks.perf import bench_engine
+    from benchmarks.synthesizer import SynthConfig, synthesize
+    from dynamo_tpu.engine import EngineConfig
+    from dynamo_tpu.engine.engine import JaxEngine
+
+    cfg = engine_config or EngineConfig(
+        model=model,
+        num_pages=2048,
+        page_size=64,
+        max_pages_per_seq=max(8, -(-(isl + osl + 64) // 64)),
+        dtype="bfloat16",
+        enable_prefix_caching=False,
+    )
+    engine = JaxEngine(cfg)
+    reqs = synthesize(
+        SynthConfig(
+            num_requests=num_requests, depth=0,
+            mean_suffix_len=isl, mean_output_len=osl,
+        )
+    )
+    prompts = [(list(r.prompt_tokens), r.output_len) for r in reqs]
+    # compile every shape before the timed sweeps
+    bench_engine(engine, prompts[: max(concurrency_levels)],
+                 max(concurrency_levels))
+
+    ttft_rows, itl_rows, sweep = [], [], []
+    for c in concurrency_levels:
+        s = bench_engine(engine, prompts, c)
+        sweep.append({"concurrency": c, **s})
+        if s["req_s"] and s["ttft_ms"]["p50"] is not None:
+            ttft_rows.append([s["req_s"], s["ttft_ms"]["p50"]])
+        if s["req_s"] and s["itl_ms"]["p50"] is not None:
+            itl_rows.append([s["req_s"], s["itl_ms"]["p50"]])
+    return {
+        "ttft_vs_rate": sorted(ttft_rows),
+        "itl_vs_rate": sorted(itl_rows),
+        "meta": {
+            "model": model, "isl": isl, "osl": osl,
+            "concurrency_levels": list(concurrency_levels),
+            "sweep": sweep,
+        },
+    }
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description="profile one worker for the SLA planner")
+    p.add_argument("--model", default="llama3-1b")
+    p.add_argument("--num-requests", type=int, default=32, dest="num_requests")
+    p.add_argument("--isl", type=int, default=128)
+    p.add_argument("--osl", type=int, default=64)
+    p.add_argument("--concurrency", default="1,2,4,8,16")
+    p.add_argument("-o", "--output", default=None, help="write JSON here")
+    args = p.parse_args(argv)
+
+    from dynamo_tpu.platform import honor_jax_platforms_env
+
+    honor_jax_platforms_env()
+
+    table = profile(
+        model=args.model,
+        num_requests=args.num_requests,
+        isl=args.isl,
+        osl=args.osl,
+        concurrency_levels=[int(x) for x in args.concurrency.split(",")],
+    )
+    text = json.dumps(table, indent=2)
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(text)
+        print(f"wrote {args.output}")
+    else:
+        print(text)
+
+
+if __name__ == "__main__":
+    main()

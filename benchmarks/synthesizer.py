@@ -1,0 +1,127 @@
+"""Synthetic workload generator: prefix-tree structured request streams.
+
+Real serving traffic shares prompt prefixes (system prompts, multi-turn
+context, templated tasks). The reference synthesizes this with a prefix
+tree (benchmarks/data_generator/synthesizer.py:34): requests are paths
+root→leaf through a shared token tree plus a unique suffix. KV-routing and
+prefix-cache behavior under such workloads is what the KV-aware router's
+3× TTFT claim is measured on (SURVEY.md §6).
+
+Everything is deterministic under `seed`.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+@dataclass(frozen=True)
+class SynthConfig:
+    num_requests: int = 100
+    #: tokens per shared-tree node (one router block per node is natural)
+    node_len: int = 64
+    #: children per tree node
+    branching: int = 3
+    #: tree depth (max shared-prefix length = depth * node_len)
+    depth: int = 3
+    #: unique per-request suffix token count (mean of a geometric)
+    mean_suffix_len: int = 128
+    #: output tokens per request (mean of a geometric)
+    mean_output_len: int = 64
+    #: mean request inter-arrival seconds (poisson process); 0 = all at t=0
+    mean_interarrival_s: float = 0.0
+    vocab_size: int = 32000
+    seed: int = 0
+
+
+@dataclass(frozen=True)
+class SynthRequest:
+    prompt_tokens: tuple[int, ...]
+    output_len: int
+    arrival_s: float
+    #: depth of the shared-tree path this prompt rides (0 = no shared prefix)
+    shared_depth: int
+
+
+class PrefixTree:
+    """Lazy random token tree: node (path) -> its node_len tokens."""
+
+    def __init__(self, cfg: SynthConfig, rng: random.Random):
+        self.cfg = cfg
+        self.rng = rng
+        self._nodes: dict[tuple[int, ...], tuple[int, ...]] = {}
+
+    def tokens_for_path(self, path: tuple[int, ...]) -> list[int]:
+        out: list[int] = []
+        for i in range(len(path)):
+            key = path[: i + 1]
+            node = self._nodes.get(key)
+            if node is None:
+                node = tuple(
+                    self.rng.randrange(1, self.cfg.vocab_size)
+                    for _ in range(self.cfg.node_len)
+                )
+                self._nodes[key] = node
+            out.extend(node)
+        return out
+
+
+def _geometric(rng: random.Random, mean: float) -> int:
+    """>=1 geometric sample with the given mean."""
+    if mean <= 1:
+        return 1
+    p = 1.0 / mean
+    u = rng.random()
+    return max(1, int(math.log(u) / math.log(1.0 - p)) + 1)
+
+
+def synthesize(cfg: SynthConfig) -> list[SynthRequest]:
+    rng = random.Random(cfg.seed)
+    tree = PrefixTree(cfg, rng)
+    out: list[SynthRequest] = []
+    t = 0.0
+    for _ in range(cfg.num_requests):
+        depth = rng.randint(0, cfg.depth)
+        path = tuple(rng.randrange(cfg.branching) for _ in range(depth))
+        prompt = tree.tokens_for_path(path)
+        suffix_len = _geometric(rng, cfg.mean_suffix_len)
+        prompt.extend(
+            rng.randrange(1, cfg.vocab_size) for _ in range(suffix_len)
+        )
+        if cfg.mean_interarrival_s > 0:
+            t += rng.expovariate(1.0 / cfg.mean_interarrival_s)
+        out.append(
+            SynthRequest(
+                prompt_tokens=tuple(prompt),
+                output_len=_geometric(rng, cfg.mean_output_len),
+                arrival_s=t,
+                shared_depth=depth,
+            )
+        )
+    return out
+
+
+def sharing_stats(requests: list[SynthRequest], block_size: int = 64) -> dict:
+    """How much block-level prefix sharing the workload actually contains
+    (sanity signal when calibrating cache-hit benchmarks)."""
+    from dynamo_tpu.tokens import hash_token_blocks
+
+    seen: set[int] = set()
+    total_blocks = 0
+    shared_blocks = 0
+    for r in requests:
+        hashes = hash_token_blocks(list(r.prompt_tokens), block_size=block_size)
+        total_blocks += len(hashes)
+        for h in hashes:
+            if h in seen:
+                shared_blocks += 1
+            else:
+                seen.add(h)
+    return {
+        "total_blocks": total_blocks,
+        "reused_blocks": shared_blocks,
+        "reuse_fraction": shared_blocks / total_blocks if total_blocks else 0.0,
+    }

@@ -241,6 +241,7 @@ async def _run_serve(args) -> None:
         loop.add_signal_handler(sig, stop.set)
 
     procs: list[tuple[str, "subprocess.Popen"]] = []
+    child_died = False
     try:
         for cls in discover_graph(root):
             meta = service_meta(cls)
@@ -269,6 +270,7 @@ async def _run_serve(args) -> None:
                         f"service {name} (pid {p.pid}) exited with {code}; "
                         "stopping graph", file=sys.stderr, flush=True,
                     )
+                    child_died = True
                     stop.set()
                     break
             try:
@@ -286,6 +288,8 @@ async def _run_serve(args) -> None:
                 p.kill()
         if fabric_server is not None:
             await fabric_server.stop()
+        if child_died:
+            sys.exit(1)
 
 
 async def _run_metrics(args) -> None:
@@ -470,6 +474,10 @@ def main(argv: Optional[list[str]] = None) -> None:
 
     args = p.parse_args(argv)
     configure_logging()
+
+    from dynamo_tpu.platform import honor_jax_platforms_env
+
+    honor_jax_platforms_env()
 
     # Compile the native hot-path core before serving so no request admission
     # or router construction ever waits on g++ (falls back to Python if the

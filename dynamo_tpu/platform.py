@@ -1,0 +1,27 @@
+"""Backend-selection hygiene for process entry points.
+
+The TPU image's sitecustomize rewrites jax's platform list to "axon,cpu"
+at interpreter start, overriding a JAX_PLATFORMS environment variable the
+operator set. Normally the axon (TPU tunnel) backend fails fast when
+unavailable and jax falls back to cpu — but a wedged tunnel HANGS backend
+init instead, freezing any process that merely touches jax.devices().
+
+Entry points call honor_jax_platforms_env() first: if the operator
+explicitly set JAX_PLATFORMS, that choice is restored via jax.config
+(which sitecustomize cannot override post-hoc — backends initialize
+lazily, so this works as long as it runs before first device use).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def honor_jax_platforms_env() -> None:
+    requested = os.environ.get("JAX_PLATFORMS")
+    if not requested:
+        return
+    import jax
+
+    if jax.config.jax_platforms != requested:
+        jax.config.update("jax_platforms", requested)

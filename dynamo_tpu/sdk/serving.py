@@ -85,12 +85,16 @@ class ServiceClient:
 class ServiceHandle:
     """One running service instance (in this process)."""
 
-    def __init__(self, runtime, instance, ingress, registrations, clients):
+    def __init__(
+        self, runtime, instance, ingress, registrations, clients,
+        owns_runtime: bool = True,
+    ):
         self.runtime = runtime
         self.instance = instance
         self.ingress = ingress
         self.registrations = registrations
         self.clients = clients
+        self.owns_runtime = owns_runtime
 
     async def stop(self) -> None:
         for reg in self.registrations:
@@ -105,19 +109,25 @@ class ServiceHandle:
             await teardown()
         for c in self.clients:
             c.close()
-        await self.runtime.close()
+        if self.owns_runtime:
+            await self.runtime.close()
 
 
 async def start_service(
     cls,
     config: Optional[dict] = None,
     fabric_addr: Optional[str] = None,
-    static: bool = False,
+    runtime: Optional[DistributedRuntime] = None,
 ) -> ServiceHandle:
     """Bring up ONE instance of `cls`: join the fabric, inject config and
-    dependency clients, register endpoints, run optional `async setup()`."""
+    dependency clients, run optional `async setup()`, then register
+    endpoints (ready-then-advertise: no consumer is routed here before
+    setup finished). Pass `runtime` to share a caller-owned runtime (the
+    handle then doesn't close it)."""
     meta = service_meta(cls)
-    runtime = await DistributedRuntime.create(fabric_addr, static=static)
+    owns_runtime = runtime is None
+    if runtime is None:
+        runtime = await DistributedRuntime.create(fabric_addr)
     instance = cls()
     instance.config = dict(config or {})
 
@@ -130,11 +140,18 @@ async def start_service(
     eps = service_endpoints(cls)
     ingress = None
     registrations = []
-    if eps:
-        ingress = IngressServer()
-        for ep_name, attr in eps.items():
-            ingress.add_handler(ep_name, getattr(instance, attr))
-        await ingress.start()
+    try:
+        if eps:
+            ingress = IngressServer()
+            for ep_name, attr in eps.items():
+                ingress.add_handler(ep_name, getattr(instance, attr))
+            await ingress.start()
+
+        setup = getattr(instance, "setup", None)
+        if setup is not None:
+            await setup()
+
+        advertise_host = instance.config.get("advertise_host", "127.0.0.1")
         for ep_name in eps:
             ep = (
                 runtime.namespace(meta.namespace)
@@ -142,21 +159,29 @@ async def start_service(
                 .endpoint(ep_name)
             )
             registrations.append(
-                await ep.register("127.0.0.1", ingress.port, metadata={})
+                await ep.register(advertise_host, ingress.port, metadata={})
             )
-
-    setup = getattr(instance, "setup", None)
-    if setup is not None:
-        await setup()
+    except Exception:
+        if ingress is not None:
+            await ingress.stop()
+        for c in clients:
+            c.close()
+        if owns_runtime:
+            await runtime.close()
+        raise
     logger.info(
         "service %s up (%d endpoints)", meta.name, len(eps)
     )
-    return ServiceHandle(runtime, instance, ingress, registrations, clients)
+    return ServiceHandle(
+        runtime, instance, ingress, registrations, clients,
+        owns_runtime=owns_runtime,
+    )
 
 
 class GraphHandle:
-    def __init__(self, handles: list[ServiceHandle]):
+    def __init__(self, handles: list[ServiceHandle], shared_fabric=None):
         self.handles = handles
+        self.shared_fabric = shared_fabric
 
     def instance_of(self, cls) -> Any:
         for h in self.handles:
@@ -167,6 +192,8 @@ class GraphHandle:
     async def stop(self) -> None:
         for h in reversed(self.handles):  # consumers before providers
             await h.stop()
+        if self.shared_fabric is not None:
+            await self.shared_fabric.close()
 
 
 async def serve_graph(
@@ -176,17 +203,43 @@ async def serve_graph(
     static: bool = False,
 ) -> GraphHandle:
     """In-process serving: every service of the graph on this event loop,
-    dependencies first."""
+    dependencies first. `static=True` runs without any fabric server — all
+    services share ONE in-memory fabric (discovery stays coherent). On any
+    start failure, already-started services are stopped before the error
+    propagates."""
     config = config or {}
-    handles = []
-    for cls in discover_graph(root):
-        meta = service_meta(cls)
-        handles.append(
-            await start_service(
-                cls, config.get(meta.name), fabric_addr, static=static
+    shared_fabric = None
+    runtimes: list[Optional[DistributedRuntime]] = []
+    classes = discover_graph(root)
+    if static:
+        from dynamo_tpu.runtime.fabric.local import LocalFabric
+
+        shared_fabric = LocalFabric()
+        for _ in classes:
+            lease = await shared_fabric.grant_lease(30.0)
+            runtimes.append(DistributedRuntime(shared_fabric, primary_lease=lease))
+    else:
+        runtimes = [None] * len(classes)
+
+    handles: list[ServiceHandle] = []
+    try:
+        for cls, rt in zip(classes, runtimes):
+            meta = service_meta(cls)
+            handles.append(
+                await start_service(
+                    cls, config.get(meta.name), fabric_addr, runtime=rt
+                )
             )
-        )
-    return GraphHandle(handles)
+    except Exception:
+        for h in reversed(handles):
+            try:
+                await h.stop()
+            except Exception:
+                logger.debug("rollback stop failed", exc_info=True)
+        if shared_fabric is not None:
+            await shared_fabric.close()
+        raise
+    return GraphHandle(handles, shared_fabric=shared_fabric)
 
 
 def resolve_service(spec: str):
@@ -220,8 +273,10 @@ def main(argv: Optional[list[str]] = None) -> None:
     p.add_argument("-f", "--config", default=None)
     args = p.parse_args(argv)
     from dynamo_tpu.logging_config import configure_logging
+    from dynamo_tpu.platform import honor_jax_platforms_env
 
     configure_logging()
+    honor_jax_platforms_env()
     asyncio.run(_amain(args))
 
 

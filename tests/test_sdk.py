@@ -171,6 +171,104 @@ def test_hello_world_graph_in_process():
     asyncio.run(run())
 
 
+def test_serve_graph_static_shared_fabric():
+    """static=True: no fabric server, all services on ONE in-memory fabric
+    so depends() discovery still works."""
+
+    async def main():
+        graph = await serve_graph(B, static=True)
+        try:
+            await asyncio.sleep(0.1)
+            from dynamo_tpu.sdk.serving import ServiceClient
+
+            # ride one of the graph's own runtimes (same shared fabric)
+            rt = graph.handles[0].runtime
+            client = ServiceClient(rt, service_meta(B))
+            got = [item async for item in client.run({"x": 1})]
+            assert got == [{"via": "b", "from": "a", "x": 1}]
+            client.close()
+        finally:
+            await graph.stop()
+
+    asyncio.run(main())
+
+
+def test_setup_runs_before_registration():
+    """Ready-then-advertise: a service must not be discoverable until its
+    setup() finished (consumers would hit uninitialized state)."""
+    from dynamo_tpu.runtime.component import InstanceSource
+    from dynamo_tpu.runtime.fabric import FabricServer
+
+    seen_during_setup = {}
+
+    @service
+    class Slow:
+        async def setup(self):
+            src = InstanceSource(
+                self._probe_fabric, "dynamo", "Slow", "gen"
+            )
+            await src.start()
+            await asyncio.sleep(0.1)
+            seen_during_setup["instances"] = len(src.list())
+            await src.stop()
+            self.ready = True
+
+        @endpoint
+        async def gen(self, ctx, request):
+            yield {"ready": self.ready}
+
+    async def main():
+        server = FabricServer(port=0)
+        await server.start()
+        try:
+            from dynamo_tpu.runtime import DistributedRuntime
+            from dynamo_tpu.sdk.serving import start_service
+
+            probe_rt = await DistributedRuntime.create(server.address)
+            Slow._probe_fabric = probe_rt.fabric
+            handle = await start_service(Slow, fabric_addr=server.address)
+            assert seen_during_setup["instances"] == 0
+            assert handle.instance.ready
+            await handle.stop()
+            await probe_rt.close()
+        finally:
+            await server.stop()
+
+    asyncio.run(main())
+
+
+def test_serve_graph_rolls_back_on_failure():
+    from dynamo_tpu.runtime import DistributedRuntime
+    from dynamo_tpu.runtime.component import InstanceSource
+    from dynamo_tpu.runtime.fabric import FabricServer
+
+    @service
+    class Boom:
+        a = depends(A)
+
+        async def setup(self):
+            raise RuntimeError("boom")
+
+    async def main():
+        server = FabricServer(port=0)
+        await server.start()
+        try:
+            with pytest.raises(RuntimeError, match="boom"):
+                await serve_graph(Boom, fabric_addr=server.address)
+            # A (started first) must have been rolled back: deregistered.
+            rt = await DistributedRuntime.create(server.address)
+            src = InstanceSource(rt.fabric, "dynamo", "A", "gen")
+            await src.start()
+            await asyncio.sleep(0.2)
+            assert src.list() == []
+            await src.stop()
+            await rt.close()
+        finally:
+            await server.stop()
+
+    asyncio.run(main())
+
+
 # -- CLI serving (one process per service) ----------------------------------
 
 
