@@ -224,19 +224,21 @@ def main(argv=None) -> None:
         from dynamo_tpu.engine import EngineConfig
         from dynamo_tpu.engine.engine import JaxEngine
 
+        prompts = [(list(r.prompt_tokens), r.output_len) for r in reqs]
+        # Budget pages for the ACTUAL longest sequence — the geometric
+        # suffix has a heavy tail and a mean-sized budget trips the
+        # scheduler's max-context guard mid-run.
+        longest = max(len(p) + osl for p, osl in prompts)
         engine = JaxEngine(
             EngineConfig(
                 model=args.model,
                 num_pages=args.num_pages,
                 page_size=args.page_size,
-                max_pages_per_seq=max(
-                    8, -(-(args.isl + args.osl + 64) // args.page_size)
-                ),
+                max_pages_per_seq=max(8, -(-(longest + 1) // args.page_size)),
                 dtype=args.dtype,
                 enable_prefix_caching=False,
             )
         )
-        prompts = [(list(r.prompt_tokens), r.output_len) for r in reqs]
         # warmup compiles every program shape the sweep will touch
         bench_engine(engine, prompts[: max(levels)], max(levels))
         for c in levels:

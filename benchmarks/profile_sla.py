@@ -30,15 +30,6 @@ def profile(
     from dynamo_tpu.engine import EngineConfig
     from dynamo_tpu.engine.engine import JaxEngine
 
-    cfg = engine_config or EngineConfig(
-        model=model,
-        num_pages=2048,
-        page_size=64,
-        max_pages_per_seq=max(8, -(-(isl + osl + 64) // 64)),
-        dtype="bfloat16",
-        enable_prefix_caching=False,
-    )
-    engine = JaxEngine(cfg)
     reqs = synthesize(
         SynthConfig(
             num_requests=num_requests, depth=0,
@@ -46,6 +37,22 @@ def profile(
         )
     )
     prompts = [(list(r.prompt_tokens), r.output_len) for r in reqs]
+    # Budget pages for the actual longest sequence (geometric tail).
+    longest = max(len(p) + o for p, o in prompts)
+    cfg = engine_config or EngineConfig(
+        model=model,
+        num_pages=2048,
+        page_size=64,
+        max_pages_per_seq=max(8, -(-(longest + 1) // 64)),
+        dtype="bfloat16",
+        enable_prefix_caching=False,
+    )
+    # A caller-supplied config has a fixed context budget: clamp prompts to
+    # it (the synthesizer's geometric tail would trip the admission guard).
+    prompts = [
+        (p[: max(1, cfg.max_context - o - 1)], o) for p, o in prompts
+    ]
+    engine = JaxEngine(cfg)
     # compile every shape before the timed sweeps
     bench_engine(engine, prompts[: max(concurrency_levels)],
                  max(concurrency_levels))

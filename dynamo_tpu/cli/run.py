@@ -208,6 +208,75 @@ async def _run_worker(args) -> None:
         await worker.stop()
 
 
+async def _run_ctl(args) -> None:
+    """llmctl parity (reference launch/llmctl/src/main.rs:114-139): list,
+    add, remove model registrations against the fabric store."""
+    from dynamo_tpu.model_card import (
+        ModelDeploymentCard,
+        ModelEntry,
+        model_key,
+        register_llm,
+    )
+    from dynamo_tpu.runtime import DistributedRuntime
+    from dynamo_tpu.runtime.component import INSTANCE_ROOT, MODEL_ROOT, Instance
+
+    rt = await DistributedRuntime.create(args.fabric)
+    try:
+        fabric = rt.fabric
+        if args.ctl_cmd == "list":
+            models = await fabric.get_prefix(MODEL_ROOT + "/")
+            print(f"models ({len(models)}):")
+            for key, raw in sorted(models.items()):
+                try:
+                    e = ModelEntry.unpack(raw)
+                    print(
+                        f"  {e.model}  ->  {e.namespace}/{e.component}/"
+                        f"{e.endpoint}  (router={e.router_mode})  [{key}]"
+                    )
+                except Exception:
+                    print(f"  {key}  (unreadable)")
+            instances = await fabric.get_prefix(INSTANCE_ROOT + "/")
+            print(f"instances ({len(instances)}):")
+            for key, raw in sorted(instances.items()):
+                try:
+                    inst = Instance.unpack(raw)
+                    print(
+                        f"  {inst.instance_id}  {inst.namespace}/"
+                        f"{inst.component}/{inst.endpoint}  at "
+                        f"{inst.host}:{inst.port}"
+                    )
+                except Exception:
+                    print(f"  {key}  (unreadable)")
+        elif args.ctl_cmd == "add":
+            from dynamo_tpu.model_card import CARD_OBJ_PREFIX
+
+            card = ModelDeploymentCard(
+                name=args.model, tokenizer={"kind": "byte"}, context_length=4096
+            )
+            # Never clobber a live model's real card with this placeholder.
+            existing = await fabric.obj_get(CARD_OBJ_PREFIX + args.model)
+            await register_llm(
+                fabric, card, args.namespace, args.component, args.endpoint,
+                router_mode=args.router_mode,
+                publish_card=existing is None,
+            )
+            print(f"registered {args.model} -> "
+                  f"{args.namespace}/{args.component}/{args.endpoint}"
+                  + (" (kept existing card)" if existing is not None else ""))
+        elif args.ctl_cmd == "remove":
+            base = model_key(args.model)
+            keys = await fabric.get_prefix(base)
+            n = 0
+            for key in keys:
+                # Exact model only: 'llama3' must not remove 'llama3-70b'.
+                if key == base or key.startswith(base + "/"):
+                    if await fabric.delete(key):
+                        n += 1
+            print(f"removed {n} registration(s) for {args.model}")
+    finally:
+        await rt.close()
+
+
 async def _run_serve(args) -> None:
     """Orchestrate a service graph: one OS process per replica (the
     reference's circus-arbiter local serving, sdk cli/serving.py:152)."""
@@ -422,6 +491,24 @@ def main(argv: Optional[list[str]] = None) -> None:
     fabricp.add_argument("--host", default="127.0.0.1")
     fabricp.add_argument("--port", type=int, default=4222)
 
+    ctlp = sub.add_parser(
+        "ctl", help="inspect/edit model + instance registrations (llmctl)"
+    )
+    ctlp.add_argument("--fabric", required=True, help="fabric host:port")
+    ctl_sub = ctlp.add_subparsers(dest="ctl_cmd", required=True)
+    ctl_sub.add_parser("list", help="list models and live instances")
+    addp = ctl_sub.add_parser("add", help="register a model entry")
+    addp.add_argument("model")
+    addp.add_argument("--namespace", default="dynamo")
+    addp.add_argument("--component", default="backend")
+    addp.add_argument("--endpoint", default="generate")
+    addp.add_argument(
+        "--router-mode", default="round_robin", dest="router_mode",
+        choices=["round_robin", "random", "kv"],
+    )
+    rmp = ctl_sub.add_parser("remove", help="remove a model's registrations")
+    rmp.add_argument("model")
+
     servep = sub.add_parser("serve", help="serve a service graph (SDK DSL)")
     servep.add_argument("graph", help="pkg.module:RootService")
     servep.add_argument("-f", "--config", default=None, help="YAML config")
@@ -502,6 +589,10 @@ def main(argv: Optional[list[str]] = None) -> None:
 
     if args.cmd == "serve":
         asyncio.run(_run_serve(args))
+        return
+
+    if args.cmd == "ctl":
+        asyncio.run(_run_ctl(args))
         return
 
     io = dict(kv.split("=", 1) for kv in args.io if "=" in kv)

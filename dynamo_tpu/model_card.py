@@ -74,10 +74,15 @@ async def register_llm(
     endpoint: str,
     lease_id: Optional[str] = None,
     router_mode: str = "round_robin",
+    publish_card: bool = True,
 ) -> ModelEntry:
-    """Publish card + model entry (reference: register_llm — _core.pyi:838)."""
+    """Publish card + model entry (reference: register_llm — _core.pyi:838).
+
+    publish_card=False registers the entry against the EXISTING card object
+    (ctl add on a live model must not clobber the workers' real card)."""
     obj = CARD_OBJ_PREFIX + card.name
-    await fabric.obj_put(obj, card.pack())
+    if publish_card:
+        await fabric.obj_put(obj, card.pack())
     entry = ModelEntry(
         model=card.name,
         namespace=namespace,

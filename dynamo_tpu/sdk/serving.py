@@ -216,7 +216,10 @@ async def serve_graph(
 
         shared_fabric = LocalFabric()
         for _ in classes:
-            lease = await shared_fabric.grant_lease(30.0)
+            # LocalFabric has a real expiry reaper but no keepalive loop
+            # (that lives in RemoteFabric) — an effectively-infinite TTL
+            # keeps static in-process graphs registered for their lifetime.
+            lease = await shared_fabric.grant_lease(1e12)
             runtimes.append(DistributedRuntime(shared_fabric, primary_lease=lease))
     else:
         runtimes = [None] * len(classes)

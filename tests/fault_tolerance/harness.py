@@ -1,0 +1,170 @@
+"""Fault-tolerance harness: real process clusters + kill injection.
+
+The reference runs its FT suite by launching the full serve stack and
+killing named processes on a schedule (tests/fault_tolerance/scenarios.py,
+test_runner.py, utils/managed_process.py). Same shape here: ManagedProc
+wraps a CLI process with log capture + pattern readiness; Cluster stands up
+fabric + frontend + echo workers and exposes kill/spawn/request.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.error
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+ENV = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+
+
+class ManagedProc:
+    """Subprocess with a log file and wait-for-pattern readiness."""
+
+    def __init__(self, name: str, argv: list[str]):
+        self.name = name
+        self.log_path = tempfile.NamedTemporaryFile(
+            mode="w", suffix=f"-{name}.log", delete=False
+        ).name
+        self._log = open(self.log_path, "w")
+        self.proc = subprocess.Popen(
+            argv, cwd=REPO, env=ENV, stdout=self._log, stderr=subprocess.STDOUT
+        )
+
+    def wait_for(self, pattern: str, timeout: float = 30.0) -> None:
+        rx = re.compile(pattern)
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            with open(self.log_path) as f:
+                if rx.search(f.read()):
+                    return
+            if self.proc.poll() is not None:
+                raise AssertionError(
+                    f"{self.name} exited {self.proc.returncode} before "
+                    f"matching {pattern!r}:\n{open(self.log_path).read()}"
+                )
+            time.sleep(0.2)
+        raise AssertionError(
+            f"{self.name}: {pattern!r} not seen in {timeout}s:\n"
+            + open(self.log_path).read()
+        )
+
+    def kill(self, sig=signal.SIGKILL) -> None:
+        if self.proc.poll() is None:
+            self.proc.send_signal(sig)
+            self.proc.wait(timeout=10)
+
+    def stop(self) -> None:
+        self.kill(signal.SIGTERM)
+        self._log.close()
+
+
+def _cli(*args: str) -> list[str]:
+    return [sys.executable, "-m", "dynamo_tpu.cli.run", *args]
+
+
+class Cluster:
+    """fabric + OpenAI frontend + N echo workers on one model."""
+
+    def __init__(self, num_workers: int = 2, model: str = "tiny"):
+        self.model = model
+        self.fabric_port = _free_port()
+        self.http_port = _free_port()
+        self.fabric = None
+        self.frontend = None
+        self.workers: list[ManagedProc] = []
+        try:
+            self.fabric = ManagedProc(
+                "fabric", _cli("fabric", "--port", str(self.fabric_port))
+            )
+            self.fabric.wait_for("fabric server on|listening", timeout=20)
+            for _ in range(num_workers):
+                self.add_worker()
+            self.frontend = ManagedProc(
+                "frontend",
+                _cli(
+                    "run", "in=http", "out=dyn",
+                    "--fabric", f"127.0.0.1:{self.fabric_port}",
+                    "--port", str(self.http_port),
+                ),
+            )
+            self.frontend.wait_for("listening on", timeout=30)
+            self.wait_until_ready()
+        except BaseException:
+            # A failed bring-up must not leak the processes already started
+            # (the fixture never gets a Cluster object to stop()).
+            self.stop()
+            raise
+
+    def add_worker(self) -> ManagedProc:
+        w = ManagedProc(
+            f"worker{len(self.workers)}",
+            _cli(
+                "run", "in=dyn", "out=echo", "--model", self.model,
+                "--fabric", f"127.0.0.1:{self.fabric_port}",
+            ),
+        )
+        w.wait_for(r"worker \w+ up", timeout=40)
+        self.workers.append(w)
+        return w
+
+    def request(self, text: str, timeout: float = 10.0) -> tuple[int, dict]:
+        body = json.dumps(
+            {
+                "model": self.model,
+                "messages": [{"role": "user", "content": text}],
+                "max_tokens": 32,
+            }
+        ).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{self.http_port}/v1/chat/completions",
+            data=body,
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                return resp.status, json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            try:
+                payload = json.loads(e.read())
+            except Exception:
+                payload = {}
+            return e.code, payload
+
+    def wait_until_ready(self, timeout: float = 30.0) -> None:
+        """Model attached + at least one worker reachable end-to-end."""
+        deadline = time.time() + timeout
+        last = None
+        while time.time() < deadline:
+            try:
+                status, data = self.request("ping", timeout=5)
+                if status == 200:
+                    return
+                last = (status, data)
+            except Exception as e:  # conn refused while booting
+                last = e
+            time.sleep(0.5)
+        raise AssertionError(f"cluster never became ready: {last}")
+
+    def stop(self) -> None:
+        for p in [self.frontend, *self.workers, self.fabric]:
+            if p is None:
+                continue
+            try:
+                p.stop()
+            except Exception:
+                pass
+
+
+def _free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]

@@ -1,0 +1,93 @@
+"""Fault-tolerance scenarios: process-kill injection under live traffic.
+
+Reference model (tests/fault_tolerance/scenarios.py:199-206): scenario
+tables mapping names to timed process kills, asserting the serving plane
+degrades gracefully and recovers. Covered here:
+
+- decode_worker_kill: SIGKILL one of two workers mid-traffic; every
+  subsequent request still succeeds (PushRouter fault detection retries +
+  marks the instance down, SURVEY.md §5.3).
+- all_workers_down_then_recover: kill the whole fleet -> requests fail
+  fast (5xx, no hang); spawn a replacement -> traffic succeeds again
+  (lease-based discovery attaches it automatically).
+- frontend_restart: kill and restart the frontend; the model re-attaches
+  from the fabric card registry with workers untouched.
+"""
+
+import signal
+import time
+
+import pytest
+
+from tests.fault_tolerance.harness import Cluster, ManagedProc
+
+pytestmark = pytest.mark.slow
+
+
+@pytest.fixture()
+def cluster():
+    c = Cluster(num_workers=2)
+    yield c
+    c.stop()
+
+
+def _drive(cluster, n, expect_ok=True):
+    ok = 0
+    for i in range(n):
+        status, data = cluster.request(f"msg {i}")
+        if status == 200:
+            ok += 1
+    if expect_ok:
+        assert ok == n, f"only {ok}/{n} requests succeeded"
+    return ok
+
+
+def test_decode_worker_kill(cluster):
+    _drive(cluster, 5)
+    cluster.workers[0].kill(signal.SIGKILL)
+    # No settling time on purpose: the router must handle the dead
+    # instance inline (retry + mark-down), not rely on lease expiry.
+    _drive(cluster, 10)
+
+
+def test_all_workers_down_then_recover(cluster):
+    _drive(cluster, 3)
+    for w in cluster.workers:
+        w.kill(signal.SIGKILL)
+    deadline = time.time() + 30
+    saw_failure = False
+    while time.time() < deadline:
+        status, _ = cluster.request("into the void", timeout=15)
+        if status != 200:
+            saw_failure = True
+            break
+        time.sleep(0.5)
+    assert saw_failure, "requests kept succeeding with zero workers"
+
+    cluster.add_worker()
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        status, _ = cluster.request("back online")
+        if status == 200:
+            return
+        time.sleep(0.5)
+    raise AssertionError("replacement worker never took traffic")
+
+
+def test_frontend_restart(cluster):
+    _drive(cluster, 3)
+    http_port = cluster.http_port
+    cluster.frontend.kill(signal.SIGKILL)
+    from tests.fault_tolerance.harness import _cli
+
+    cluster.frontend = ManagedProc(
+        "frontend2",
+        _cli(
+            "run", "in=http", "out=dyn",
+            "--fabric", f"127.0.0.1:{cluster.fabric_port}",
+            "--port", str(http_port),
+        ),
+    )
+    cluster.frontend.wait_for("listening on", timeout=30)
+    cluster.wait_until_ready()
+    _drive(cluster, 5)
