@@ -54,6 +54,8 @@ class LlamaConfig:
     #: "xla" (gather path, any T) | "pallas" (DMA kernel for decode T=1;
     #: prefill chunks still take the XLA path)
     attention_impl: str = "xla"
+    #: q/k/v projection bias — the Qwen2 family's one architectural delta
+    attention_bias: bool = False
 
     @property
     def q_per_kv(self) -> int:
@@ -92,14 +94,38 @@ class LlamaConfig:
         )
 
     @staticmethod
+    def qwen2_7b() -> "LlamaConfig":
+        """Qwen2/2.5-7B: Llama architecture + qkv bias."""
+        return LlamaConfig(
+            vocab_size=152064, hidden_size=3584, intermediate_size=18944,
+            num_layers=28, num_heads=28, num_kv_heads=4, head_dim=128,
+            rope_theta=1000000.0, rms_norm_eps=1e-6, attention_bias=True,
+        )
+
+    @staticmethod
+    def qwen2_05b() -> "LlamaConfig":
+        """Qwen2.5-0.5B — single-chip smoke size for the family."""
+        return LlamaConfig(
+            vocab_size=151936, hidden_size=896, intermediate_size=4864,
+            num_layers=24, num_heads=14, num_kv_heads=2, head_dim=64,
+            rope_theta=1000000.0, rms_norm_eps=1e-6, attention_bias=True,
+            tie_word_embeddings=True,
+        )
+
+    @staticmethod
     def from_hf_config(hf: dict) -> "LlamaConfig":
-        """Map a HuggingFace `config.json` dict onto LlamaConfig."""
+        """Map a HuggingFace `config.json` dict onto LlamaConfig (covers the
+        Llama and Qwen2 families — Qwen2 is Llama + qkv bias)."""
+        arch = (hf.get("architectures") or ["LlamaForCausalLM"])[0]
         rope_scaling = hf.get("rope_scaling") or {}
         factor = None
         if rope_scaling.get("rope_type", rope_scaling.get("type")) == "llama3":
             factor = float(rope_scaling["factor"])
         head_dim = hf.get("head_dim") or hf["hidden_size"] // hf["num_attention_heads"]
         return LlamaConfig(
+            attention_bias=bool(
+                hf.get("attention_bias", arch == "Qwen2ForCausalLM")
+            ),
             vocab_size=hf["vocab_size"],
             hidden_size=hf["hidden_size"],
             intermediate_size=hf["intermediate_size"],
@@ -183,6 +209,10 @@ def init_params(key: jax.Array, cfg: LlamaConfig) -> dict:
         },
         "final_norm": norm_init((h,)),
     }
+    if cfg.attention_bias:
+        params["layers"]["bq"] = jnp.zeros((L, qd), cfg.dtype)
+        params["layers"]["bk"] = jnp.zeros((L, kvd), cfg.dtype)
+        params["layers"]["bv"] = jnp.zeros((L, kvd), cfg.dtype)
     if not cfg.tie_word_embeddings:
         params["lm_head"] = dense(keys[8], (h, v), h)
     return params
@@ -222,6 +252,16 @@ def params_from_torch_state_dict(state_dict, cfg: LlamaConfig) -> dict:
         },
         "final_norm": jnp.asarray(t("model.norm.weight"), cfg.dtype),
     }
+    if cfg.attention_bias:
+        params["layers"]["bq"] = stack(
+            "model.layers.{}.self_attn.q_proj.bias", transpose=False
+        )
+        params["layers"]["bk"] = stack(
+            "model.layers.{}.self_attn.k_proj.bias", transpose=False
+        )
+        params["layers"]["bv"] = stack(
+            "model.layers.{}.self_attn.v_proj.bias", transpose=False
+        )
     if not cfg.tie_word_embeddings:
         params["lm_head"] = jnp.asarray(t("lm_head.weight").T, cfg.dtype)
     return params
@@ -359,9 +399,12 @@ def forward_hidden(
         lp, k_cache, v_cache = xs
         x = rms_norm(h, lp["attn_norm"], cfg.rms_norm_eps)
         b, t, _ = x.shape
-        q = (x @ lp["wq"]).reshape(b, t, cfg.num_heads, cfg.head_dim)
-        k = (x @ lp["wk"]).reshape(b, t, cfg.num_kv_heads, cfg.head_dim)
-        v = (x @ lp["wv"]).reshape(b, t, cfg.num_kv_heads, cfg.head_dim)
+        q, k, v = x @ lp["wq"], x @ lp["wk"], x @ lp["wv"]
+        if cfg.attention_bias:
+            q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
+        q = q.reshape(b, t, cfg.num_heads, cfg.head_dim)
+        k = k.reshape(b, t, cfg.num_kv_heads, cfg.head_dim)
+        v = v.reshape(b, t, cfg.num_kv_heads, cfg.head_dim)
         q = apply_rope(q, positions, cfg)
         k = apply_rope(k, positions, cfg)
         k_cache = paged_scatter(k_cache, k, page_tables, positions, valid)

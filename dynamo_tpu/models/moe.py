@@ -1,0 +1,286 @@
+"""Mixtral-style MoE decoder with GShard dispatch, ep-sharded experts.
+
+The reference delegates expert parallelism to its engines (SGLang DeepEP
+flags — SURVEY.md §2.9 "EP — engine-delegated"); here MoE is first-class
+TPU: expert weights are stacked on a leading E axis sharded over the mesh's
+"ep" axis, and routing is the capacity-based one-hot dispatch/combine
+einsum formulation (GShard / Switch) — static shapes, MXU-shaped batched
+matmuls, with XLA inserting the ep all-to-alls from the shardings alone.
+
+Attention / norms / rope / the paged KV cache are shared with the Llama
+module (models/llama.py); only the FFN differs:
+
+    router: logits = x @ w_router            [N, E]
+    gates:  softmax, top-k, renormalize      (Mixtral semantics)
+    dispatch/combine: one-hot [N, E, C] einsums with per-expert capacity C
+    experts: SwiGLU with weights [E, H, I] / [E, I, H]
+
+Tokens over capacity are dropped (their expert contribution is zero and
+the residual stream carries them) — the standard static-shape trade; set
+capacity_factor high enough (tests use >= E/top_k) for exactness.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from dynamo_tpu.models import llama as llama_mod
+from dynamo_tpu.models.llama import (
+    KVPages,
+    LlamaConfig,
+    apply_rope,
+    paged_attention,
+    paged_gather,
+    paged_scatter,
+    rms_norm,
+)
+
+
+@dataclass(frozen=True)
+class MoeConfig:
+    """Mixtral shape: Llama attention + MoE FFN."""
+
+    base: LlamaConfig = field(default_factory=LlamaConfig)
+    num_experts: int = 8
+    top_k: int = 2
+    #: per-expert capacity = ceil(top_k * tokens / num_experts) * factor
+    capacity_factor: float = 2.0
+
+    @staticmethod
+    def mixtral_8x7b() -> "MoeConfig":
+        return MoeConfig(
+            base=LlamaConfig(
+                vocab_size=32000, hidden_size=4096, intermediate_size=14336,
+                num_layers=32, num_heads=32, num_kv_heads=8, head_dim=128,
+                rope_theta=1000000.0,
+            ),
+            num_experts=8, top_k=2,
+        )
+
+    @staticmethod
+    def tiny(vocab_size: int = 256) -> "MoeConfig":
+        return MoeConfig(
+            base=replace(LlamaConfig.tiny(vocab_size), intermediate_size=32),
+            num_experts=4, top_k=2,
+        )
+
+    @staticmethod
+    def from_hf_config(hf: dict) -> "MoeConfig":
+        base = LlamaConfig.from_hf_config(hf)
+        return MoeConfig(
+            base=base,
+            num_experts=int(hf.get("num_local_experts", 8)),
+            top_k=int(hf.get("num_experts_per_tok", 2)),
+        )
+
+
+def _capacity(cfg: MoeConfig, num_tokens: int) -> int:
+    per = -(-cfg.top_k * num_tokens // cfg.num_experts)
+    return max(1, int(per * cfg.capacity_factor))
+
+
+def init_params(key: jax.Array, cfg: MoeConfig) -> dict:
+    """Llama params with the dense FFN replaced by router + stacked experts."""
+    base = llama_mod.init_params(key, cfg.base)
+    h, i = cfg.base.hidden_size, cfg.base.intermediate_size
+    L, E = cfg.base.num_layers, cfg.num_experts
+    keys = jax.random.split(jax.random.fold_in(key, 1), 4)
+
+    def dense(k, shape, fan_in):
+        import math
+
+        scale = 1.0 / math.sqrt(fan_in)
+        return (jax.random.normal(k, shape, jnp.float32) * scale).astype(
+            cfg.base.dtype
+        )
+
+    layers = base["layers"]
+    for name in ("w_gate", "w_up", "w_down"):
+        del layers[name]
+    layers["w_router"] = dense(keys[0], (L, h, E), h)
+    layers["we_gate"] = dense(keys[1], (L, E, h, i), h)
+    layers["we_up"] = dense(keys[2], (L, E, h, i), h)
+    layers["we_down"] = dense(keys[3], (L, E, i, h), i)
+    return base
+
+
+def params_from_torch_state_dict(state_dict, cfg: MoeConfig) -> dict:
+    """HF Mixtral state_dict -> our pytree (experts stacked on E)."""
+    import numpy as np
+
+    def t(name):
+        return np.asarray(state_dict[name].to("cpu").float().numpy())
+
+    L, E = cfg.base.num_layers, cfg.num_experts
+    dt = cfg.base.dtype
+
+    def stack(fmt, transpose=True):
+        ws = [t(fmt.format(l)) for l in range(L)]
+        ws = [w.T if transpose else w for w in ws]
+        return jnp.asarray(np.stack(ws), dt)
+
+    def stack_experts(fmt):
+        # [L, E, in, out]: HF stores [out, in] per expert
+        return jnp.asarray(
+            np.stack(
+                [
+                    np.stack([t(fmt.format(l, e)).T for e in range(E)])
+                    for l in range(L)
+                ]
+            ),
+            dt,
+        )
+
+    params = {
+        "embed": jnp.asarray(t("model.embed_tokens.weight"), dt),
+        "layers": {
+            "attn_norm": stack("model.layers.{}.input_layernorm.weight", False),
+            "wq": stack("model.layers.{}.self_attn.q_proj.weight"),
+            "wk": stack("model.layers.{}.self_attn.k_proj.weight"),
+            "wv": stack("model.layers.{}.self_attn.v_proj.weight"),
+            "wo": stack("model.layers.{}.self_attn.o_proj.weight"),
+            "mlp_norm": stack(
+                "model.layers.{}.post_attention_layernorm.weight", False
+            ),
+            "w_router": stack("model.layers.{}.block_sparse_moe.gate.weight"),
+            "we_gate": stack_experts(
+                "model.layers.{}.block_sparse_moe.experts.{}.w1.weight"
+            ),
+            "we_down": stack_experts(
+                "model.layers.{}.block_sparse_moe.experts.{}.w2.weight"
+            ),
+            "we_up": stack_experts(
+                "model.layers.{}.block_sparse_moe.experts.{}.w3.weight"
+            ),
+        },
+        "final_norm": jnp.asarray(t("model.norm.weight"), dt),
+        "lm_head": jnp.asarray(t("lm_head.weight").T, dt),
+    }
+    return params
+
+
+def top_k_gating(
+    logits: jax.Array,  # [N, E] f32
+    top_k: int,
+    capacity: int,
+) -> tuple[jax.Array, jax.Array]:
+    """GShard dispatch/combine tensors, Mixtral gate semantics.
+
+    Returns (dispatch [N, E, C] in {0,1}, combine [N, E, C] f32). Slot-major
+    position assignment: every token's 1st choice is placed before any 2nd
+    choice, so capacity pressure drops the weakest assignments first.
+    """
+    n, e = logits.shape
+    probs = jax.nn.softmax(logits, axis=-1)
+    vals, idx = lax.top_k(probs, top_k)  # [N, k]
+    vals = vals / jnp.sum(vals, axis=-1, keepdims=True)
+
+    onehot = jax.nn.one_hot(idx, e, dtype=jnp.int32)  # [N, k, E]
+    flat = onehot.transpose(1, 0, 2).reshape(top_k * n, e)  # slot-major
+    pos_flat = jnp.cumsum(flat, axis=0) - flat
+    pos = (pos_flat * flat).sum(-1).reshape(top_k, n).T  # [N, k]
+
+    keep = pos < capacity
+    weight = vals * keep
+    disp = (
+        onehot[..., None]
+        * jax.nn.one_hot(pos, capacity, dtype=jnp.int32)[..., None, :]
+    )  # [N, k, E, C]
+    disp = disp * keep[..., None, None].astype(jnp.int32)
+    dispatch = disp.sum(axis=1)
+    combine = (disp * weight[..., None, None]).sum(axis=1)
+    return dispatch, combine.astype(jnp.float32)
+
+
+def moe_ffn(x: jax.Array, lp: dict, cfg: MoeConfig) -> jax.Array:
+    """x: [B, T, H] post-norm -> MoE output [B, T, H]."""
+    b, t, h = x.shape
+    n = b * t
+    xf = x.reshape(n, h)
+    logits = (xf @ lp["w_router"]).astype(jnp.float32)  # [N, E]
+    dispatch, combine = top_k_gating(logits, cfg.top_k, _capacity(cfg, n))
+    d = dispatch.astype(x.dtype)
+    expert_in = jnp.einsum("nh,nec->ech", xf, d)  # [E, C, H]
+    gate = jax.nn.silu(
+        jnp.einsum("ech,ehi->eci", expert_in, lp["we_gate"]).astype(jnp.float32)
+    )
+    up = jnp.einsum("ech,ehi->eci", expert_in, lp["we_up"]).astype(jnp.float32)
+    expert_out = jnp.einsum(
+        "eci,eih->ech", (gate * up).astype(x.dtype), lp["we_down"]
+    )  # [E, C, H]
+    out = jnp.einsum(
+        "ech,nec->nh", expert_out.astype(jnp.float32), combine
+    )
+    return out.reshape(b, t, h).astype(x.dtype)
+
+
+def forward_hidden(
+    params: dict,
+    cfg: MoeConfig,
+    tokens: jax.Array,
+    positions: jax.Array,
+    valid: jax.Array,
+    kv: KVPages,
+    page_tables: jax.Array,
+) -> tuple[jax.Array, KVPages]:
+    """Same contract as llama.forward_hidden (engine-compatible)."""
+    bc = cfg.base
+    h = params["embed"][tokens].astype(bc.dtype)
+
+    def layer(h, xs):
+        lp, k_cache, v_cache = xs
+        x = rms_norm(h, lp["attn_norm"], bc.rms_norm_eps)
+        b, t, _ = x.shape
+        q = (x @ lp["wq"]).reshape(b, t, bc.num_heads, bc.head_dim)
+        k = (x @ lp["wk"]).reshape(b, t, bc.num_kv_heads, bc.head_dim)
+        v = (x @ lp["wv"]).reshape(b, t, bc.num_kv_heads, bc.head_dim)
+        q = apply_rope(q, positions, bc)
+        k = apply_rope(k, positions, bc)
+        k_cache = paged_scatter(k_cache, k, page_tables, positions, valid)
+        v_cache = paged_scatter(v_cache, v, page_tables, positions, valid)
+        if bc.attention_impl == "pallas" and t == 1:
+            from dynamo_tpu.ops.paged_attention import paged_decode_attention
+
+            attn = paged_decode_attention(
+                q[:, 0], k_cache, v_cache, page_tables, positions[:, 0] + 1
+            )[:, None, :]
+        else:
+            k_all = paged_gather(k_cache, page_tables)
+            v_all = paged_gather(v_cache, page_tables)
+            attn = paged_attention(q, k_all, v_all, positions, bc)
+        h = h + attn @ lp["wo"]
+        x = rms_norm(h, lp["mlp_norm"], bc.rms_norm_eps)
+        h = h + moe_ffn(x, lp, cfg)
+        return h, (k_cache, v_cache)
+
+    h, (k_new, v_new) = lax.scan(layer, h, (params["layers"], kv.k, kv.v))
+    h = rms_norm(h, params["final_norm"], bc.rms_norm_eps)
+    return h, KVPages(k=k_new, v=v_new)
+
+
+def forward(params, cfg: MoeConfig, tokens, positions, valid, kv, page_tables):
+    h, kv = forward_hidden(params, cfg, tokens, positions, valid, kv, page_tables)
+    return llama_mod.compute_logits(params, cfg.base, h), kv
+
+
+def moe_param_specs(cfg: MoeConfig):
+    """Llama specs + expert weights sharded on the ep axis; expert
+    intermediate dims additionally on tp."""
+    from jax.sharding import PartitionSpec as P
+
+    from dynamo_tpu.parallel.shardings import llama_param_specs
+
+    specs = llama_param_specs(cfg.base)
+    layers = specs["layers"]
+    for name in ("w_gate", "w_up", "w_down"):
+        del layers[name]
+    layers["w_router"] = P(None, None, None)
+    layers["we_gate"] = P(None, "ep", None, "tp")
+    layers["we_up"] = P(None, "ep", None, "tp")
+    layers["we_down"] = P(None, "ep", "tp", None)
+    return specs

@@ -42,6 +42,9 @@ _LLAMA_PRESETS: dict[str, Callable[[], LlamaConfig]] = {
     "llama3-70b": LlamaConfig.llama3_70b,
     # DeepSeek-R1-Distill-Llama-8B is architecturally Llama-3-8B.
     "deepseek-r1-distill-llama-8b": LlamaConfig.llama3_8b,
+    # Qwen2 family = Llama + qkv bias (models/llama.py attention_bias).
+    "qwen2-7b": LlamaConfig.qwen2_7b,
+    "qwen2-0.5b": LlamaConfig.qwen2_05b,
 }
 
 
@@ -84,40 +87,108 @@ def _load_llama_checkpoint(path: str, cfg: LlamaConfig):
     return llama_mod.params_from_torch_state_dict(model.state_dict(), cfg)
 
 
+def _moe_adapter(name: str, moe_cfg) -> ModelAdapter:
+    from dynamo_tpu.models import moe as moe_mod
+    from dynamo_tpu.parallel.shardings import kv_cache_spec
+
+    cfg = moe_cfg
+
+    def fwd(params, tokens, positions, valid, kv, pt):
+        return moe_mod.forward(params, cfg, tokens, positions, valid, kv, pt)
+
+    def fwd_hidden(params, tokens, positions, valid, kv, pt):
+        return moe_mod.forward_hidden(
+            params, cfg, tokens, positions, valid, kv, pt
+        )
+
+    def load(path):
+        import torch
+        from transformers import AutoModelForCausalLM
+
+        model = AutoModelForCausalLM.from_pretrained(
+            path, torch_dtype=torch.float32, low_cpu_mem_usage=True
+        )
+        return moe_mod.params_from_torch_state_dict(model.state_dict(), cfg)
+
+    return ModelAdapter(
+        name=name,
+        config=cfg,
+        vocab_size=cfg.base.vocab_size,
+        init_params=lambda key: moe_mod.init_params(key, cfg),
+        forward=fwd,
+        forward_hidden=fwd_hidden,
+        compute_logits=lambda params, h: llama_mod.compute_logits(
+            params, cfg.base, h
+        ),
+        init_kv=lambda num_pages, page_size: llama_mod.init_kv_pages(
+            cfg.base, num_pages, page_size
+        ),
+        param_specs=lambda: moe_mod.moe_param_specs(cfg),
+        kv_spec=lambda: KVPages(k=kv_cache_spec(), v=kv_cache_spec()),
+        load_params=load,
+    )
+
+
 def get_model(
     name: str,
     dtype: Optional[str] = None,
     attention_impl: Optional[str] = None,
 ) -> ModelAdapter:
     """Resolve a model name: preset id, or a local HF checkpoint dir."""
+    from dynamo_tpu.models.moe import MoeConfig
+
     key = name.lower()
+    moe_presets = {
+        "mixtral-8x7b": MoeConfig.mixtral_8x7b,
+        "moe-tiny": MoeConfig.tiny,
+    }
+    moe_cfg = None
     if key in _LLAMA_PRESETS:
         cfg = _LLAMA_PRESETS[key]()
+    elif key in moe_presets:
+        moe_cfg = moe_presets[key]()
     elif os.path.isdir(name) and os.path.exists(os.path.join(name, "config.json")):
         with open(os.path.join(name, "config.json")) as f:
             hf = json.load(f)
         arch = (hf.get("architectures") or ["LlamaForCausalLM"])[0]
-        if "llama" not in arch.lower():
+        if "mixtral" in arch.lower():
+            moe_cfg = MoeConfig.from_hf_config(hf)
+        elif "llama" in arch.lower() or "qwen2" in arch.lower():
+            cfg = LlamaConfig.from_hf_config(hf)
+        else:
             raise ValueError(f"unsupported architecture {arch} for {name}")
-        cfg = LlamaConfig.from_hf_config(hf)
     else:
         raise ValueError(
-            f"unknown model {name!r}; presets: {sorted(_LLAMA_PRESETS)} "
+            f"unknown model {name!r}; presets: "
+            f"{sorted(_LLAMA_PRESETS) + sorted(moe_presets)} "
             "or a local HF checkpoint directory"
         )
+    if moe_cfg is not None:
+        if dtype is not None:
+            moe_cfg = replace(moe_cfg, base=_with_dtype(moe_cfg.base, dtype))
+        if attention_impl is not None:
+            moe_cfg = replace(
+                moe_cfg,
+                base=replace(moe_cfg.base, attention_impl=attention_impl),
+            )
+        return _moe_adapter(name, moe_cfg)
     if dtype is not None:
-        if isinstance(dtype, str):
-            table = {
-                "bfloat16": jnp.bfloat16,
-                "float32": jnp.float32,
-                "float64": jnp.float64,
-            }
-            if dtype not in table:
-                raise ValueError(
-                    f"unsupported dtype {dtype!r}; use one of {sorted(table)}"
-                )
-            dtype = table[dtype]
-        cfg = replace(cfg, dtype=dtype)
+        cfg = _with_dtype(cfg, dtype)
     if attention_impl is not None:
         cfg = replace(cfg, attention_impl=attention_impl)
     return _llama_adapter(name, cfg)
+
+
+def _with_dtype(cfg: LlamaConfig, dtype) -> LlamaConfig:
+    if isinstance(dtype, str):
+        table = {
+            "bfloat16": jnp.bfloat16,
+            "float32": jnp.float32,
+            "float64": jnp.float64,
+        }
+        if dtype not in table:
+            raise ValueError(
+                f"unsupported dtype {dtype!r}; use one of {sorted(table)}"
+            )
+        dtype = table[dtype]
+    return replace(cfg, dtype=dtype)

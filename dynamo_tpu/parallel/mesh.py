@@ -31,15 +31,17 @@ class MeshConfig:
     dp: int = 1
     tp: int = 1
     sp: int = 1
-    axis_names: tuple[str, ...] = ("dp", "sp", "tp")
+    #: expert parallel (MoE expert dim; 1 for dense models)
+    ep: int = 1
+    axis_names: tuple[str, ...] = ("dp", "sp", "ep", "tp")
 
     @property
     def shape(self) -> tuple[int, ...]:
-        return (self.dp, self.sp, self.tp)
+        return (self.dp, self.sp, self.ep, self.tp)
 
     @property
     def num_devices(self) -> int:
-        return self.dp * self.sp * self.tp
+        return self.dp * self.sp * self.ep * self.tp
 
     @staticmethod
     def single_device() -> "MeshConfig":

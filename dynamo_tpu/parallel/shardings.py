@@ -41,6 +41,11 @@ def llama_param_specs(cfg: LlamaConfig) -> dict:
         },
         "final_norm": P(None),
     }
+    if cfg.attention_bias:
+        # biases shard with their projection's output dim
+        specs["layers"]["bq"] = P(None, "tp")
+        specs["layers"]["bk"] = P(None, "tp")
+        specs["layers"]["bv"] = P(None, "tp")
     if not cfg.tie_word_embeddings:
         specs["lm_head"] = P(None, "tp")
     return specs

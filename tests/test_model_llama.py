@@ -154,7 +154,7 @@ def test_sharded_forward_on_mesh(tiny_setup, cpu_mesh_devices):
     toks = rng.integers(0, cfg.vocab_size, size=(4, 8)).astype(np.int32)
     ref = _full_forward(cfg, params, toks)
 
-    mesh = make_mesh(MeshConfig(dp=2, tp=2, sp=1, axis_names=("dp", "sp", "tp")))
+    mesh = make_mesh(MeshConfig(dp=2, tp=2, sp=1))
     p_sh = shardings_for(mesh, llama_param_specs(cfg))
     params_s = jax.device_put(params, p_sh)
     kv = init_kv_pages(cfg, NUM_PAGES, PAGE_SIZE)
