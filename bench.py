@@ -46,6 +46,11 @@ def main() -> None:
         max_pages_per_seq=16,
         decode_buckets=(1, 2, 4, 8, 16, 32),
         prefill_chunk=max(128, isl),
+        # Whole-workload dispatches: all prompts prefill in one batched
+        # program; decode fuses K steps per host sync (the TPU sits behind
+        # a ~65ms tunnel round-trip, so syncs dominate unamortized).
+        prefill_token_budget=num_requests * max(128, isl),
+        decode_steps=int(os.environ.get("BENCH_DECODE_STEPS", "32")),
         max_seqs=32,
         dtype="bfloat16",
         enable_prefix_caching=False,

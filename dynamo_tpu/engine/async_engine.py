@@ -176,9 +176,29 @@ class AsyncEngineRunner:
         finally:
             self._queues.pop(request_id, None)
 
+    async def embed(self, prompts, normalize: bool = True):
+        """Embedding vectors via the engine thread (shares the page pool
+        and jit cache with the serving loop)."""
+        return await self.submit(lambda eng: eng.embed(prompts, normalize))
+
     @property
     def metrics(self):
         return self.engine.metrics
+
+
+def fake_embedding(tokens, dim: int = 32):
+    """Deterministic stand-in embedding for echo/mock engines: a hashed
+    bag-of-tokens projection, L2-normalized. Lets the /v1/embeddings path
+    be exercised end-to-end with no model."""
+    import numpy as np
+    import xxhash
+
+    vec = np.zeros(dim, np.float32)
+    for pos, tok in enumerate(tokens):
+        h = xxhash.xxh64_intdigest(f"{tok}".encode(), seed=7)
+        vec[h % dim] += 1.0 + 0.01 * (pos % 7)
+    norm = float(np.linalg.norm(vec))
+    return vec / norm if norm > 0 else vec
 
 
 class EchoEngine:
@@ -198,3 +218,8 @@ class EchoEngine:
                 "token_ids": [tok],
                 "finish_reason": "stop" if i == n - 1 else None,
             }
+
+    async def embed(self, prompts, normalize: bool = True):
+        import numpy as np
+
+        return np.stack([fake_embedding(p) for p in prompts])

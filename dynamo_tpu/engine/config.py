@@ -24,10 +24,22 @@ class EngineConfig:
     max_pages_per_seq: int = 64
     #: decode batch buckets (padded up to the next bucket)
     decode_buckets: tuple[int, ...] = (1, 2, 4, 8, 16, 32, 64)
-    #: prefill token budget per step (one chunk, padded to this length)
+    #: per-sequence prefill chunk length (a prompt is processed in chunks of
+    #: at most this many tokens; also the max prefill T bucket)
     prefill_chunk: int = 512
+    #: total prefill tokens per step across sequences (None => 4×chunk).
+    #: Pieces of the same length bucket run as ONE batched [B, T] program —
+    #: this is what lets many short/medium prompts prefill in one dispatch.
+    prefill_token_budget: Optional[int] = None
     #: max sequences resident (decode slots)
     max_seqs: int = 64
+    #: decode steps fused per dispatch (lax.scan with on-device token
+    #: feedback): one host⇄device sync per `decode_steps` tokens/seq. With
+    #: a remote/tunneled TPU the sync round-trip dominates a decode step,
+    #: so K steps per sync multiplies decode throughput by ~K. Finish
+    #: conditions are applied on the host afterwards — up to K-1 speculative
+    #: tokens past a stop are computed and dropped. 1 = classic stepping.
+    decode_steps: int = 8
     #: admission watermark: keep this fraction of pages free when admitting
     admission_watermark: float = 0.02
     #: eos token ids (from the model card/tokenizer)
@@ -54,6 +66,10 @@ class EngineConfig:
     @property
     def max_context(self) -> int:
         return self.max_pages_per_seq * self.page_size
+
+    @property
+    def effective_prefill_budget(self) -> int:
+        return self.prefill_token_budget or 4 * self.prefill_chunk
 
     def decode_bucket_for(self, n: int) -> int:
         for b in self.decode_buckets:

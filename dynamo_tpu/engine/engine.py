@@ -35,7 +35,7 @@ from dynamo_tpu.engine.request import (
     SamplingParams,
     StepOutput,
 )
-from dynamo_tpu.engine.sampling import sample
+from dynamo_tpu.engine.sampling import sample, sample_greedy
 from dynamo_tpu.engine.scheduler import ScheduledBatch, Scheduler
 from dynamo_tpu.models.registry import ModelAdapter, get_model
 from dynamo_tpu.parallel.mesh import MeshConfig, make_mesh
@@ -226,53 +226,125 @@ class JaxEngine:
             t *= 2
         return min(t, max(self.config.prefill_chunk, 32))
 
+    @staticmethod
+    def _bucket_b(n: int) -> int:
+        b = 1
+        while b < n:
+            b *= 2
+        return b
+
     def _run_prefill(self, batch: ScheduledBatch) -> list[StepOutput]:
+        """Pieces grouped by T bucket run as one batched [B, T] program —
+        many prompts prefill per dispatch instead of serial B=1 launches."""
         outputs: list[StepOutput] = []
+        groups: dict[int, list] = {}
         for piece in batch.prefill:
-            req = piece.request
-            is_last_chunk = (
-                piece.start + piece.length >= len(req.prompt_tokens)
-            )
-            t_bucket = self._bucket_t(piece.length)
-            mp = self.config.max_pages_per_seq
-            tokens = np.zeros((1, t_bucket), np.int32)
-            chunk = req.all_tokens[piece.start : piece.start + piece.length]
-            tokens[0, : piece.length] = chunk
-            positions = np.arange(t_bucket, dtype=np.int32)[None] + piece.start
-            valid = np.zeros((1, t_bucket), bool)
-            valid[0, : piece.length] = True
-            pt = np.zeros((1, mp), np.int32)
-            pt[0, : len(req.pages)] = req.pages
+            groups.setdefault(self._bucket_t(piece.length), []).append(piece)
+        mp = self.config.max_pages_per_seq
+        for t_bucket, pieces in sorted(groups.items()):
+            b = len(pieces)
+            b_bucket = self._bucket_b(b)
+            tokens = np.zeros((b_bucket, t_bucket), np.int32)
+            positions = np.zeros((b_bucket, t_bucket), np.int32)
+            valid = np.zeros((b_bucket, t_bucket), bool)
+            pt = np.zeros((b_bucket, mp), np.int32)
+            last_idx = np.zeros(b_bucket, np.int32)
+            any_last = False
+            for i, piece in enumerate(pieces):
+                req = piece.request
+                chunk = req.all_tokens[piece.start : piece.start + piece.length]
+                tokens[i, : piece.length] = chunk
+                positions[i] = np.arange(t_bucket, dtype=np.int32) + piece.start
+                valid[i, : piece.length] = True
+                pt[i, : len(req.pages)] = req.pages
+                last_idx[i] = piece.length - 1
+                if piece.start + piece.length >= len(req.prompt_tokens):
+                    any_last = True
 
             args = (
                 self.params, self._dev(tokens), self._dev(positions),
                 self._dev(valid), self.kv, self._dev(pt),
             )
-            if is_last_chunk:
-                fn = self._get_step_fn("prefill", 1, t_bucket)
-                samp = self._sampling_arrays([req])
-                last_idx = np.array([piece.length - 1], np.int32)
+            if any_last:
+                reqs = [p.request for p in pieces]
+                samp, all_greedy = self._sampling_arrays(reqs, pad_to=b_bucket)
+                fn = self._get_step_fn(
+                    "prefill", b_bucket, t_bucket, greedy=all_greedy
+                )
                 token_ids, self.kv = fn(*args, self._dev(last_idx), *samp)
+                ids = np.asarray(token_ids)
             else:
-                # Mid-prompt chunk: KV writes only — skip the vocab-sized
-                # logits + sort entirely.
-                fn = self._get_step_fn("prefill_nosample", 1, t_bucket)
+                # No piece finishes its prompt: KV writes only — skip the
+                # vocab-sized logits + sampling entirely.
+                fn = self._get_step_fn("prefill_nosample", b_bucket, t_bucket)
                 self.kv = fn(*args)
-            req.num_computed_tokens += piece.length
-            self._register_pages(req)
-            if req.prefill_done:
-                req.state = RequestState.DECODE
-                tok = int(np.asarray(token_ids)[0])
-                outputs.extend(self._accept_token(req, tok, first=True))
+                ids = None
+            for i, piece in enumerate(pieces):
+                req = piece.request
+                req.num_computed_tokens += piece.length
+                self._register_pages(req)
+                if req.prefill_done:
+                    req.state = RequestState.DECODE
+                    outputs.extend(
+                        self._accept_token(req, int(ids[i]), first=True)
+                    )
         return outputs
 
     # -- decode ------------------------------------------------------------
+
+    def _pick_decode_steps(self, reqs: list[Request]) -> int:
+        """Fused steps for this dispatch: capped by config, by remaining
+        context room, and dropped to 1 when admission is pending (so new
+        arrivals don't wait K steps) or when the pool can't pre-grow every
+        sequence's page table K tokens ahead."""
+        k = self.config.decode_steps
+        if k <= 1:
+            return 1
+        # Admission pending AND actually possible this step: stay responsive.
+        # (A backlog that can't admit anyway must not forfeit fusion.)
+        if self.scheduler.num_waiting() > 0 and self.scheduler.can_admit_head():
+            return 1
+        for req in reqs:
+            k = min(k, self.config.max_context - req.num_tokens + 1)
+        # Don't speculate past the longest remaining completion in the batch.
+        rem_max = 0
+        for req in reqs:
+            s = req.sampling
+            rem_max = max(
+                rem_max,
+                s.max_tokens - len(req.output_tokens) - req.num_emitted,
+            )
+        k = min(k, max(1, rem_max))
+        # Snap to a power of two so the decode_multi program family stays
+        # small (every distinct k is a full-model compile).
+        p = 1
+        while p * 2 <= k:
+            p *= 2
+        k = p
+        if k <= 1:
+            return 1
+        ps = self.config.page_size
+        need = 0
+        per_req = []
+        for req in reqs:
+            extra = -(-(req.num_tokens + k - 1) // ps) - len(req.pages)
+            per_req.append(max(0, extra))
+            need += max(0, extra)
+        if need > self.allocator.num_free:
+            return 1  # single-step path handles pressure via preemption
+        for req, extra in zip(reqs, per_req):
+            if extra:
+                got = self.allocator.allocate(extra)
+                if got is None:
+                    return 1
+                req.pages.extend(got)
+        return k
 
     def _run_decode(self, batch: ScheduledBatch) -> list[StepOutput]:
         reqs = list(batch.decode)
         b_bucket = self.config.decode_bucket_for(len(reqs))
         mp = self.config.max_pages_per_seq
-        b = len(reqs)
+        k_steps = self._pick_decode_steps(reqs)
         tokens = np.zeros((b_bucket, 1), np.int32)
         positions = np.zeros((b_bucket, 1), np.int32)
         valid = np.zeros((b_bucket, 1), bool)
@@ -283,31 +355,49 @@ class JaxEngine:
             valid[i, 0] = True
             pt[i, : len(req.pages)] = req.pages
 
-        fn = self._get_step_fn("decode", b_bucket, 1)
-        samp = self._sampling_arrays(reqs, pad_to=b_bucket)
-        last_idx = np.zeros(b_bucket, np.int32)
-        token_ids, self.kv = fn(
+        samp, all_greedy = self._sampling_arrays(reqs, pad_to=b_bucket)
+        args = (
             self.params, self._dev(tokens), self._dev(positions),
             self._dev(valid), self.kv, self._dev(pt),
-            self._dev(last_idx), *samp,
         )
-        ids = np.asarray(token_ids)
+        if k_steps == 1:
+            fn = self._get_step_fn("decode", b_bucket, 1, greedy=all_greedy)
+            last_idx = np.zeros(b_bucket, np.int32)
+            token_ids, self.kv = fn(*args, self._dev(last_idx), *samp)
+        else:
+            fn = self._get_step_fn(
+                "decode_multi", b_bucket, k_steps, greedy=all_greedy
+            )
+            token_ids, self.kv = fn(*args, *samp)  # [K, B]
+        ids = np.asarray(token_ids).reshape(k_steps, b_bucket)
         outputs: list[StepOutput] = []
         for i, req in enumerate(reqs):
-            req.num_computed_tokens += 1
-            outputs.extend(self._accept_token(req, int(ids[i])))
+            accepted: list[int] = []
+            finish: Optional[FinishReason] = None
+            for kk in range(k_steps):
+                accepted.append(int(ids[kk, i]))
+                finish = self._finish_reason_for(req, int(ids[kk, i]),
+                                                 len(accepted))
+                if finish is not None:
+                    break
+            req.num_computed_tokens += len(accepted)
+            outputs.extend(self._accept_tokens(req, accepted, finish))
             self._register_pages(req)
         return outputs
 
     # -- shared ------------------------------------------------------------
 
     def _sampling_arrays(self, reqs: list[Request], pad_to: Optional[int] = None):
+        """Returns ((temps, top_ps, top_ks, seeds, counters), all_greedy).
+        all_greedy selects the argmax-only program variant — temperature-0
+        batches never pay for top-k/gumbel."""
         n = pad_to or len(reqs)
         temps = np.zeros(n, np.float32)
         top_ps = np.ones(n, np.float32)
         top_ks = np.zeros(n, np.int32)
         seeds = np.zeros(n, np.uint32)
         counters = np.zeros(n, np.int32)
+        all_greedy = True
         for i, r in enumerate(reqs):
             temps[i] = r.sampling.temperature
             top_ps[i] = r.sampling.top_p
@@ -315,9 +405,14 @@ class JaxEngine:
             seeds[i] = self._request_seed(r)
             # num_emitted keeps the draw counter monotonic across preemption
             counters[i] = r.num_emitted + len(r.output_tokens)
+            if r.sampling.temperature > 0.0:
+                all_greedy = False
         return (
-            self._dev(temps), self._dev(top_ps), self._dev(top_ks),
-            self._dev(seeds), self._dev(counters),
+            (
+                self._dev(temps), self._dev(top_ps), self._dev(top_ks),
+                self._dev(seeds), self._dev(counters),
+            ),
+            all_greedy,
         )
 
     def _request_seed(self, req: Request) -> int:
@@ -330,12 +425,65 @@ class JaxEngine:
             & 0xFFFFFFFF
         )
 
-    def _get_step_fn(self, kind: str, b: int, t: int) -> Callable:
-        cache_key = (kind, b, t)
+    def _get_step_fn(
+        self, kind: str, b: int, t: int, greedy: bool = False
+    ) -> Callable:
+        cache_key = (kind, b, t, greedy)
         fn = self._jit_cache.get(cache_key)
         if fn is not None:
             return fn
         adapter = self.adapter
+
+        if kind == "embed":
+
+            def embed_fn(params, tokens, positions, valid, kv, pt):
+                hidden, kv = adapter.forward_hidden(
+                    params, tokens, positions, valid, kv, pt
+                )
+                # masked sum over the chunk; the host accumulates across
+                # chunks and divides by the true token count
+                pooled = jnp.sum(
+                    hidden.astype(jnp.float32) * valid[..., None], axis=1
+                )
+                return pooled, kv
+
+            jitted = jax.jit(embed_fn, donate_argnums=(4,))
+            self._jit_cache[cache_key] = jitted
+            logger.info("compiled %s program B=%d T=%d", kind, b, t)
+            return jitted
+
+        if kind == "decode_multi":
+            k_steps = t  # the (b, t) slot carries (bucket, fused steps)
+
+            def multi_fn(params, tokens, positions, valid, kv, pt,
+                         temps, top_ps, top_ks, seeds, counters):
+                def body(carry, _):
+                    tokens, positions, kv, counters = carry
+                    hidden, kv = adapter.forward_hidden(
+                        params, tokens, positions, valid, kv, pt
+                    )
+                    logits = adapter.compute_logits(params, hidden[:, -1])
+                    if greedy:
+                        ids = sample_greedy(logits)
+                    else:
+                        ids = sample(
+                            logits, temps, top_ps, top_ks, seeds, counters
+                        )
+                    return (ids[:, None], positions + 1, kv, counters + 1), ids
+
+                (_, _, kv, _), all_ids = jax.lax.scan(
+                    body, (tokens, positions, kv, counters), None,
+                    length=k_steps,
+                )
+                return all_ids, kv  # [K, B]
+
+            jitted = jax.jit(multi_fn, donate_argnums=(4,))
+            self._jit_cache[cache_key] = jitted
+            logger.info(
+                "compiled decode_multi program B=%d K=%d greedy=%s",
+                b, k_steps, greedy,
+            )
+            return jitted
 
         if kind == "prefill_nosample":
 
@@ -356,7 +504,10 @@ class JaxEngine:
             rows = jnp.arange(hidden.shape[0])
             last_hidden = hidden[rows, last_idx]  # [B, H] — lm_head only here
             logits = adapter.compute_logits(params, last_hidden)
-            ids = sample(logits, temps, top_ps, top_ks, seeds, counters)
+            if greedy:
+                ids = sample_greedy(logits)  # unused samp args are DCE'd
+            else:
+                ids = sample(logits, temps, top_ps, top_ks, seeds, counters)
             return ids, kv
 
         jitted = jax.jit(step_fn, donate_argnums=(4,))
@@ -364,33 +515,107 @@ class JaxEngine:
         logger.info("compiled %s program B=%d T=%d", kind, b, t)
         return jitted
 
-    def _accept_token(self, req: Request, token: int, first: bool = False) -> list[StepOutput]:
-        req.output_tokens.append(token)
-        chain = self.scheduler.chains.get(req.request_id)
-        if chain is not None:
-            chain.append(token)
-        self.metrics.generated_tokens += 1
-        finish: Optional[FinishReason] = None
+    def _finish_reason_for(
+        self, req: Request, token: int, n_new: int
+    ) -> Optional[FinishReason]:
+        """Finish check for the n_new'th newly-sampled token of this
+        dispatch (token not yet appended to the request)."""
         s = req.sampling
         if not s.ignore_eos and (
             token in self.config.eos_token_ids or token in s.stop_token_ids
         ):
-            finish = FinishReason.STOP
-        elif len(req.output_tokens) + req.num_emitted >= s.max_tokens:
-            finish = FinishReason.LENGTH
-        elif req.num_tokens >= self.config.max_context:
-            finish = FinishReason.LENGTH
+            return FinishReason.STOP
+        if len(req.output_tokens) + n_new + req.num_emitted >= s.max_tokens:
+            return FinishReason.LENGTH
+        if req.num_tokens + n_new >= self.config.max_context:
+            return FinishReason.LENGTH
+        return None
+
+    def _accept_tokens(
+        self,
+        req: Request,
+        tokens: Sequence[int],
+        finish: Optional[FinishReason],
+        first: bool = False,
+    ) -> list[StepOutput]:
+        chain = self.scheduler.chains.get(req.request_id)
+        for tok in tokens:
+            req.output_tokens.append(tok)
+            if chain is not None:
+                chain.append(tok)
+        self.metrics.generated_tokens += len(tokens)
         if finish is not None:
             self.scheduler.finish(req)
             req.finish_reason = finish
         return [
             StepOutput(
                 request_id=req.request_id,
-                new_token_ids=(token,),
+                new_token_ids=tuple(tokens),
                 finish_reason=finish,
                 is_first=first,
             )
         ]
+
+    def _accept_token(self, req: Request, token: int, first: bool = False) -> list[StepOutput]:
+        finish = self._finish_reason_for(req, token, 1)
+        return self._accept_tokens(req, [token], finish, first=first)
+
+    # -- embeddings --------------------------------------------------------
+
+    def embed(
+        self, prompts: Sequence[Sequence[int]], normalize: bool = True
+    ) -> np.ndarray:
+        """Mean-pooled (optionally L2-normalized) last-layer hidden states,
+        one vector per prompt (the /v1/embeddings engine path — the
+        reference delegates this to its engines; here it shares the prefill
+        programs' chunked execution and page pool). Pages are scratch:
+        allocated for attention across chunks, freed before returning."""
+        out: list[np.ndarray] = []
+        ps = self.config.page_size
+        mp = self.config.max_pages_per_seq
+        for toks in prompts:
+            toks = list(toks)
+            if not toks:
+                raise ValueError("cannot embed an empty token sequence")
+            need = -(-len(toks) // ps)
+            if need > mp:
+                raise ValueError(
+                    f"prompt of {len(toks)} tokens needs {need} KV pages; "
+                    f"max_pages_per_seq is {mp}"
+                )
+            pages = self.allocator.allocate(need)
+            if pages is None:
+                raise RuntimeError("no KV pages free for embedding")
+            try:
+                acc: Optional[np.ndarray] = None
+                for start in range(0, len(toks), self.config.prefill_chunk):
+                    chunk = toks[start : start + self.config.prefill_chunk]
+                    t_bucket = self._bucket_t(len(chunk))
+                    tokens = np.zeros((1, t_bucket), np.int32)
+                    tokens[0, : len(chunk)] = chunk
+                    positions = (
+                        np.arange(t_bucket, dtype=np.int32)[None] + start
+                    )
+                    valid = np.zeros((1, t_bucket), bool)
+                    valid[0, : len(chunk)] = True
+                    pt = np.zeros((1, mp), np.int32)
+                    pt[0, : len(pages)] = pages
+                    fn = self._get_step_fn("embed", 1, t_bucket)
+                    pooled, self.kv = fn(
+                        self.params, self._dev(tokens), self._dev(positions),
+                        self._dev(valid), self.kv, self._dev(pt),
+                    )
+                    vec = np.asarray(pooled, np.float32)[0]
+                    acc = vec if acc is None else acc + vec
+                mean = acc / len(toks)
+            finally:
+                self.allocator.free(pages)
+            if normalize:
+                norm = float(np.linalg.norm(mean))
+                if norm > 0:
+                    mean = mean / norm
+            out.append(mean)
+        return np.stack(out)
 
     # -- disaggregated prefill/decode hooks -------------------------------
     # (decode side pre-allocates pages; a prefill worker computes the KV,
@@ -398,15 +623,32 @@ class JaxEngine:
     #  here — the reference's NIXL RDMA write path, dynamo_flow.md:36-38,
     #  re-done as explicit page movement through host/DCN for TPU.)
 
+    @property
+    def _canonical_head_dim(self) -> int:
+        """The model's true head_dim — the wire/host format for extracted
+        pages. The device cache may be lane-padded (cfg.kv_head_dim) when
+        the Pallas kernel is active; extract strips the padding and inject
+        restores it, so disagg peers and KVBM tiers with different
+        attention impls interoperate (and host/disk tiers don't store
+        zero lanes)."""
+        cfg = self.adapter.config
+        return cfg.head_dim if hasattr(cfg, "head_dim") else cfg.base.head_dim
+
     def extract_pages(self, page_ids: Sequence[int]):
         """Pull KV pages to host: (k, v) as [L, Hkv, n, page_size, D]."""
         ids = jnp.asarray(np.asarray(page_ids, np.int32))
-        k = np.asarray(jax.device_get(jnp.take(self.kv.k, ids, axis=2)))
-        v = np.asarray(jax.device_get(jnp.take(self.kv.v, ids, axis=2)))
+        d = self._canonical_head_dim
+        k = np.asarray(jax.device_get(jnp.take(self.kv.k, ids, axis=2)))[..., :d]
+        v = np.asarray(jax.device_get(jnp.take(self.kv.v, ids, axis=2)))[..., :d]
         return k, v
 
     def inject_pages(self, page_ids: Sequence[int], k: np.ndarray, v: np.ndarray) -> None:
         """Write transferred KV pages into this engine's pool in place."""
+        dpad = self.kv.k.shape[-1] - k.shape[-1]
+        if dpad:
+            widths = [(0, 0)] * (k.ndim - 1) + [(0, dpad)]
+            k = np.pad(k, widths)
+            v = np.pad(v, widths)
         n = len(page_ids)
         fn = self._jit_cache.get(("inject", n))
         if fn is None:

@@ -3,6 +3,14 @@
 One jitted function handles a heterogeneous batch (per-row params) so decode
 stays a single XLA program: greedy rows take argmax, sampling rows take a
 Gumbel draw over the top-k/top-p-masked, temperature-scaled distribution.
+
+TPU note: a full-vocab argsort is a bitonic network over 128k lanes and
+costs tens of milliseconds — it would dominate the whole decode step. The
+sampler instead takes the top `k_cap` candidates with lax.top_k (already
+sorted) and computes their *true* probabilities under the full distribution
+via one logsumexp over the vocab. Sampling is thus truncated to the k_cap
+most likely tokens (requested top_k values above k_cap are clamped); top-p
+mass is exact w.r.t. the full softmax.
 """
 
 from __future__ import annotations
@@ -12,6 +20,9 @@ import jax.numpy as jnp
 
 _NEG_INF = -1e30
 
+#: static candidate-set bound; per-request top_k is clamped to this
+DEFAULT_K_CAP = 64
+
 
 def sample(
     logits: jax.Array,  # [B, V] f32
@@ -20,32 +31,42 @@ def sample(
     top_k: jax.Array,  # [B] i32 (0 => disabled)
     seeds: jax.Array,  # [B] u32 per-request seed
     counters: jax.Array,  # [B] i32 per-request draw counter (token position)
+    k_cap: int = DEFAULT_K_CAP,
 ) -> jax.Array:  # [B] i32 sampled token ids
     """Per-row PRNG: each request draws from key(seed) folded with its own
     token counter, so a (prompt, seed) pair reproduces exactly regardless of
     what else shares the batch or how steps interleave."""
     b, v = logits.shape
+    k_cap = min(k_cap, v)
     greedy = temperature <= 0.0
     safe_t = jnp.where(greedy, 1.0, jnp.maximum(temperature, 1e-6))
     scaled = logits / safe_t[:, None]
 
-    # Work in sorted space: one descending sort serves both k and p masks.
-    sort_idx = jnp.argsort(-scaled, axis=-1)  # [B, V]
-    sorted_logits = jnp.take_along_axis(scaled, sort_idx, axis=-1)
-    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    # Top-k_cap candidates, descending — the only vocab-wide work besides
+    # one reduction for the softmax denominator.
+    cand_logits, cand_idx = jax.lax.top_k(scaled, k_cap)  # [B, K]
+    lse = jax.scipy.special.logsumexp(scaled, axis=-1, keepdims=True)
+    probs = jnp.exp(cand_logits - lse)  # true full-softmax mass of candidates
     cum = jnp.cumsum(probs, axis=-1)
-    ranks = jnp.arange(v)[None, :]
+    ranks = jnp.arange(k_cap)[None, :]
     # top-p: keep tokens whose preceding mass is < p (first always kept)
     keep_p = (cum - probs) < top_p[:, None]
-    # top-k: keep the first k ranks (k == 0 disables)
-    keep_k = jnp.where(top_k[:, None] > 0, ranks < top_k[:, None], True)
-    masked = jnp.where(keep_p & keep_k, sorted_logits, _NEG_INF)
+    # top-k: keep the first k ranks (k == 0 disables => k_cap)
+    eff_k = jnp.where(top_k > 0, jnp.minimum(top_k, k_cap), k_cap)
+    keep = keep_p & (ranks < eff_k[:, None])
+    masked = jnp.where(keep, cand_logits, _NEG_INF)
 
     def row_gumbel(seed, counter):
         key = jax.random.fold_in(jax.random.key(seed), counter)
-        return jax.random.gumbel(key, (v,), jnp.float32)
+        return jax.random.gumbel(key, (k_cap,), jnp.float32)
 
-    gumbel = jax.vmap(row_gumbel)(seeds, counters)  # [B, V]
+    gumbel = jax.vmap(row_gumbel)(seeds, counters)  # [B, K]
     sampled_rank = jnp.argmax(masked + gumbel, axis=-1)  # [B]
-    sampled = jnp.take_along_axis(sort_idx, sampled_rank[:, None], axis=-1)[:, 0]
+    sampled = jnp.take_along_axis(cand_idx, sampled_rank[:, None], axis=-1)[:, 0]
     return jnp.where(greedy, jnp.argmax(logits, axis=-1), sampled).astype(jnp.int32)
+
+
+def sample_greedy(logits: jax.Array) -> jax.Array:
+    """Argmax-only fast path: when every request in the batch is greedy the
+    engine compiles this instead of the sampling pipeline."""
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32)

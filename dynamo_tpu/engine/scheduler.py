@@ -100,6 +100,16 @@ class Scheduler:
     def num_waiting(self) -> int:
         return len(self.waiting)
 
+    def can_admit_head(self) -> bool:
+        """Whether the waiting-queue head could be admitted right now
+        (cheap page-count check; used by the engine to decide if fused
+        decode should yield to admission latency)."""
+        if not self.waiting or len(self.running) >= self.config.max_seqs:
+            return False
+        req = self.waiting[0]
+        need = -(-(len(req.prompt_tokens) + 1) // self.config.page_size)
+        return self.allocator.num_free - need >= self._watermark_pages()
+
     def num_running(self) -> int:
         return len(self.running)
 
@@ -167,18 +177,17 @@ class Scheduler:
             self.running.append(req)
 
     def _schedule_prefill(self) -> Optional[ScheduledBatch]:
-        # NOTE: pieces are currently executed by the engine as separate
-        # B=1 programs; the packing budget bounds total work per step, not
-        # one fused launch. TODO(flat-batch): pack pieces into one
-        # flat-token program with segment ids (vLLM-style) so one dispatch
-        # covers the whole chunk.
-        budget = self.config.prefill_chunk
+        # Each piece is capped at prefill_chunk tokens; the step budget
+        # spans sequences. The engine groups same-bucket pieces into one
+        # batched [B, T] program, so packing many prompts here turns into
+        # fewer, larger dispatches rather than serial B=1 launches.
+        budget = self.config.effective_prefill_budget
         pieces: list[PrefillPiece] = []
         for req in self.running:
             if req.state != RequestState.PREFILL or budget <= 0:
                 continue
             remaining = len(req.prompt_tokens) - req.num_computed_tokens
-            take = min(remaining, budget)
+            take = min(remaining, self.config.prefill_chunk, budget)
             if take <= 0:
                 continue
             pieces.append(

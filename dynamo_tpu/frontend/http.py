@@ -29,6 +29,7 @@ from dynamo_tpu.protocols.openai import (
     CompletionRequest,
     CompletionChoice,
     CompletionResponse,
+    EmbeddingRequest,
     ModelInfo,
     ModelList,
     SSE_DONE,
@@ -58,6 +59,7 @@ class HttpService:
             [
                 web.post("/v1/chat/completions", self.chat_completions),
                 web.post("/v1/completions", self.completions),
+                web.post("/v1/embeddings", self.embeddings),
                 web.get("/v1/models", self.models),
                 web.get("/health", self.health),
                 web.get("/live", self.health),
@@ -106,6 +108,43 @@ class HttpService:
         # Engine workers expose cache flush via their admin endpoint; the
         # frontend acknowledges and the flush fans out through the fabric.
         return web.json_response({"status": "accepted"})
+
+    async def embeddings(self, request: web.Request) -> web.Response:
+        t0 = time.time()
+        try:
+            body = await request.json()
+            req = EmbeddingRequest.model_validate(body)
+        except Exception as e:
+            return web.json_response(
+                {"error": f"invalid request: {e}"}, status=400
+            )
+        pipeline = self.manager.get(req.model)
+        if pipeline is None:
+            self.metrics.request_done(
+                req.model, "embedding", "404", time.time() - t0
+            )
+            return web.json_response(
+                {"error": f"model {req.model!r} not found"}, status=404
+            )
+        with self.metrics.inflight_guard(req.model):
+            try:
+                resp = await pipeline.embed(req)
+            except ValueError as e:
+                self.metrics.request_done(
+                    req.model, "embedding", "400", time.time() - t0
+                )
+                return web.json_response({"error": str(e)}, status=400)
+            except Exception as e:
+                logger.exception("embedding request failed")
+                self.metrics.request_done(
+                    req.model, "embedding", "500", time.time() - t0
+                )
+                return web.json_response({"error": str(e)}, status=500)
+        self.metrics.request_done(
+            req.model, "embedding", "200", time.time() - t0,
+            input_tokens=resp.usage.prompt_tokens,
+        )
+        return web.json_response(resp.model_dump())
 
     async def chat_completions(self, request: web.Request) -> web.StreamResponse:
         return await self._serve(request, kind="chat")

@@ -24,6 +24,10 @@ from dynamo_tpu.protocols.openai import (
     ChatCompletionChunk,
     ChatCompletionRequest,
     CompletionRequest,
+    EmbeddingData,
+    EmbeddingRequest,
+    EmbeddingResponse,
+    Usage,
 )
 from dynamo_tpu.runtime.context import Context
 from dynamo_tpu.runtime.push_router import PushRouter, RouterMode
@@ -37,6 +41,7 @@ class ModelPipeline:
         card: ModelDeploymentCard,
         engine_fn: Callable[[Context, PreprocessedRequest], AsyncIterator[dict]],
         close_fn: Optional[Callable] = None,
+        embed_fn: Optional[Callable] = None,
     ):
         self.card = card
         self.preprocessor = OpenAIPreprocessor(
@@ -44,6 +49,8 @@ class ModelPipeline:
         )
         self.engine_fn = engine_fn
         self.close_fn = close_fn
+        #: async (prompts: list[list[int]]) -> list of vectors
+        self.embed_fn = embed_fn
 
     async def chat_stream(
         self, request: ChatCompletionRequest, context: Optional[Context] = None
@@ -75,6 +82,61 @@ class ModelPipeline:
         ):
             yield chunk
 
+    async def embed(self, request: EmbeddingRequest) -> EmbeddingResponse:
+        """OpenAI embeddings over this model (reference: embeddings route,
+        http/service/openai.rs). Accepts a string, list of strings, token
+        list, or list of token lists."""
+        if self.embed_fn is None:
+            raise ValueError(
+                f"model {self.card.name!r} does not serve embeddings"
+            )
+        if request.encoding_format not in (None, "float", "base64"):
+            raise ValueError(
+                f"unsupported encoding_format {request.encoding_format!r}; "
+                "use 'float' or 'base64'"
+            )
+        raw = request.input
+        if isinstance(raw, str):
+            batch = [raw]
+        elif isinstance(raw, list) and raw and isinstance(raw[0], int):
+            batch = [raw]
+        elif not raw:
+            raise ValueError("input must be non-empty")
+        else:
+            batch = raw
+        tok = self.preprocessor.tokenizer
+        prompts = [
+            p if isinstance(p, list) else tok.encode(p) for p in batch
+        ]
+        for p in prompts:
+            if not p:
+                raise ValueError("input item tokenized to zero tokens")
+            if len(p) > self.card.context_length:
+                raise ValueError(
+                    f"input of {len(p)} tokens exceeds context window "
+                    f"{self.card.context_length}"
+                )
+        vectors = await self.embed_fn(prompts)
+        ntok = sum(len(p) for p in prompts)
+        data = []
+        for i, vec in enumerate(vectors):
+            if request.encoding_format == "base64":
+                import base64
+
+                import numpy as np
+
+                emb = base64.b64encode(
+                    np.asarray(vec, np.float32).tobytes()
+                ).decode()
+            else:
+                emb = [float(x) for x in vec]
+            data.append(EmbeddingData(index=i, embedding=emb))
+        return EmbeddingResponse(
+            model=self.card.name,
+            data=data,
+            usage=Usage(prompt_tokens=ntok, total_tokens=ntok),
+        )
+
     def _clamp(self, pre: PreprocessedRequest) -> None:
         room = self.card.context_length - len(pre.token_ids) - 1
         if room < 0:
@@ -93,7 +155,11 @@ class ModelPipeline:
 
 def local_pipeline(card: ModelDeploymentCard, async_engine) -> ModelPipeline:
     """Single-process pipeline over an in-process AsyncEngine."""
-    return ModelPipeline(card, engine_fn=async_engine.generate)
+    return ModelPipeline(
+        card,
+        engine_fn=async_engine.generate,
+        embed_fn=getattr(async_engine, "embed", None),
+    )
 
 
 def router_pipeline(
@@ -120,10 +186,25 @@ def router_pipeline(
 
     async def close_fn():
         router.close()
+        embed_router.close()
         if kv_router is not None:
             await kv_router.stop()
 
-    return ModelPipeline(card, engine_fn=engine_fn, close_fn=close_fn)
+    # Embedding calls ride the same worker instances on their "embed"
+    # ingress handler; KV-affinity is meaningless for them (no decode), so
+    # the side router always balances round-robin.
+    embed_router = PushRouter(
+        router.source, "embed", mode=RouterMode.ROUND_ROBIN
+    )
+
+    async def embed_fn(prompts):
+        async for reply in embed_router.generate({"prompts": prompts}):
+            return reply["embeddings"]
+        raise RuntimeError("embed worker returned no reply")
+
+    return ModelPipeline(
+        card, engine_fn=engine_fn, close_fn=close_fn, embed_fn=embed_fn
+    )
 
 
 class ModelManager:

@@ -33,10 +33,7 @@ from dynamo_tpu.models import llama as llama_mod
 from dynamo_tpu.models.llama import (
     KVPages,
     LlamaConfig,
-    apply_rope,
-    paged_attention,
-    paged_gather,
-    paged_scatter,
+    attention_block,
     rms_norm,
 )
 
@@ -232,33 +229,27 @@ def forward_hidden(
     bc = cfg.base
     h = params["embed"][tokens].astype(bc.dtype)
 
-    def layer(h, xs):
-        lp, k_cache, v_cache = xs
+    def layer(carry, xs):
+        h, k_full, v_full = carry
+        lp, li = xs
         x = rms_norm(h, lp["attn_norm"], bc.rms_norm_eps)
         b, t, _ = x.shape
         q = (x @ lp["wq"]).reshape(b, t, bc.num_heads, bc.head_dim)
         k = (x @ lp["wk"]).reshape(b, t, bc.num_kv_heads, bc.head_dim)
         v = (x @ lp["wv"]).reshape(b, t, bc.num_kv_heads, bc.head_dim)
-        q = apply_rope(q, positions, bc)
-        k = apply_rope(k, positions, bc)
-        k_cache = paged_scatter(k_cache, k, page_tables, positions, valid)
-        v_cache = paged_scatter(v_cache, v, page_tables, positions, valid)
-        if bc.attention_impl == "pallas" and t == 1:
-            from dynamo_tpu.ops.paged_attention import paged_decode_attention
-
-            attn = paged_decode_attention(
-                q[:, 0], k_cache, v_cache, page_tables, positions[:, 0] + 1
-            )[:, None, :]
-        else:
-            k_all = paged_gather(k_cache, page_tables)
-            v_all = paged_gather(v_cache, page_tables)
-            attn = paged_attention(q, k_all, v_all, positions, bc)
+        attn, k_full, v_full = attention_block(
+            q, k, v, k_full, v_full, li, page_tables, positions, valid, bc
+        )
         h = h + attn @ lp["wo"]
         x = rms_norm(h, lp["mlp_norm"], bc.rms_norm_eps)
         h = h + moe_ffn(x, lp, cfg)
-        return h, (k_cache, v_cache)
+        return (h, k_full, v_full), None
 
-    h, (k_new, v_new) = lax.scan(layer, h, (params["layers"], kv.k, kv.v))
+    (h, k_new, v_new), _ = lax.scan(
+        layer,
+        (h, kv.k, kv.v),
+        (params["layers"], jnp.arange(bc.num_layers, dtype=jnp.int32)),
+    )
     h = rms_norm(h, params["final_norm"], bc.rms_norm_eps)
     return h, KVPages(k=k_new, v=v_new)
 

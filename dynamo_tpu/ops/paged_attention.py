@@ -34,12 +34,13 @@ from jax.experimental.pallas import tpu as pltpu
 
 def _decode_kernel(
     # scalar prefetch
+    layer_ref,  # [1] int32 — which layer of the stacked cache to read
     pt_ref,  # [B, MP] int32 page tables (SMEM)
     len_ref,  # [B] int32 kv lengths, incl. the token being decoded (SMEM)
     # inputs
     q_ref,  # [1, 1, G, D] VMEM block (this cell's q-head group, pre-scaled)
-    k_ref,  # [Hkv, P, S, D] in HBM/ANY
-    v_ref,  # [Hkv, P, S, D] in HBM/ANY
+    k_ref,  # [L, Hkv, P, S, D] in HBM/ANY — the full stacked cache
+    v_ref,  # [L, Hkv, P, S, D] in HBM/ANY
     # output
     o_ref,  # [1, 1, G, D] VMEM block
     # scratch
@@ -48,9 +49,11 @@ def _decode_kernel(
     sem,  # [2, 2] DMA semaphores: [k|v, slot]
     *,
     page_size: int,
+    scale_dim: int,
 ):
     b = pl.program_id(0)
     h = pl.program_id(1)
+    li = layer_ref[0]
     g, d = q_ref.shape[2], q_ref.shape[3]
     s = page_size
     seq_len = len_ref[b]
@@ -58,12 +61,12 @@ def _decode_kernel(
 
     def k_copy(slot, i):
         return pltpu.make_async_copy(
-            k_ref.at[h, pt_ref[b, i]], k_scr.at[slot], sem.at[0, slot]
+            k_ref.at[li, h, pt_ref[b, i]], k_scr.at[slot], sem.at[0, slot]
         )
 
     def v_copy(slot, i):
         return pltpu.make_async_copy(
-            v_ref.at[h, pt_ref[b, i]], v_scr.at[slot], sem.at[1, slot]
+            v_ref.at[li, h, pt_ref[b, i]], v_scr.at[slot], sem.at[1, slot]
         )
 
     # Warm up the pipeline (seq_len >= 1 always: the decoded token itself).
@@ -71,7 +74,8 @@ def _decode_kernel(
     v_copy(0, 0).start()
 
     # Scale after the f32 cast so bf16 q matches the XLA path bit-for-bit.
-    q = q_ref[0, 0].astype(jnp.float32) * (1.0 / math.sqrt(d))  # [G, D]
+    # scale_dim is the model's true head_dim — d may be lane-padded.
+    q = q_ref[0, 0].astype(jnp.float32) * (1.0 / math.sqrt(scale_dim))  # [G, D]
 
     def body(i, carry):
         m, l, acc = carry
@@ -111,33 +115,46 @@ def _decode_kernel(
 
 def paged_decode_attention(
     q: jax.Array,  # [B, Hq, D] post-rope decode queries
-    k_cache: jax.Array,  # [Hkv, P, S, D]
-    v_cache: jax.Array,  # [Hkv, P, S, D]
+    k_cache: jax.Array,  # [L, Hkv, P, S, D] — full stacked cache
+    v_cache: jax.Array,  # [L, Hkv, P, S, D]
+    layer: jax.Array,  # scalar int32 layer index
     page_tables: jax.Array,  # [B, MP] int32
     seq_lens: jax.Array,  # [B] int32 — kv length incl. the decoded token
     *,
+    scale_dim: int | None = None,
     interpret: bool | None = None,
 ) -> jax.Array:
     """Returns [B, Hq*D] attention output, matching the XLA paged path.
 
+    Takes the full layer-stacked cache plus a (traced) layer index so the
+    layer scan can carry the cache without slicing it — a dynamic slice of
+    one layer would materialize a copy per layer per step; the kernel
+    instead offsets its page DMAs by the prefetched index.
+
+    `scale_dim` is the softmax scale's head_dim — pass the model's true
+    head_dim when q/k/v are lane-padded to a 128 multiple (cfg.kv_head_dim).
     `interpret` defaults to True off-TPU so tests run the same kernel on CPU.
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     b, hq, d = q.shape
-    hkv, _, s, _ = k_cache.shape
+    hkv, s = k_cache.shape[1], k_cache.shape[3]
     g = hq // hkv
     qr = q.reshape(b, hkv, g, d)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
+        num_scalar_prefetch=3,
         grid=(b, hkv),
         in_specs=[
-            pl.BlockSpec((1, 1, g, d), lambda bi, hi, pt, ln: (bi, hi, 0, 0)),
+            pl.BlockSpec(
+                (1, 1, g, d), lambda bi, hi, li, pt, ln: (bi, hi, 0, 0)
+            ),
             pl.BlockSpec(memory_space=pl.ANY),
             pl.BlockSpec(memory_space=pl.ANY),
         ],
-        out_specs=pl.BlockSpec((1, 1, g, d), lambda bi, hi, pt, ln: (bi, hi, 0, 0)),
+        out_specs=pl.BlockSpec(
+            (1, 1, g, d), lambda bi, hi, li, pt, ln: (bi, hi, 0, 0)
+        ),
         scratch_shapes=[
             pltpu.VMEM((2, s, d), k_cache.dtype),
             pltpu.VMEM((2, s, d), v_cache.dtype),
@@ -145,9 +162,16 @@ def paged_decode_attention(
         ],
     )
     out = pl.pallas_call(
-        functools.partial(_decode_kernel, page_size=s),
+        functools.partial(
+            _decode_kernel, page_size=s, scale_dim=scale_dim or d
+        ),
         out_shape=jax.ShapeDtypeStruct((b, hkv, g, d), q.dtype),
         grid_spec=grid_spec,
         interpret=interpret,
-    )(page_tables.astype(jnp.int32), seq_lens.astype(jnp.int32), qr, k_cache, v_cache)
+    )(
+        jnp.asarray(layer, jnp.int32).reshape(1),
+        page_tables.astype(jnp.int32),
+        seq_lens.astype(jnp.int32),
+        qr, k_cache, v_cache,
+    )
     return out.reshape(b, hq * d)

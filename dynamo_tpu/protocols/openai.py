@@ -97,6 +97,20 @@ class Usage(BaseModel):
     total_tokens: int = 0
 
 
+class EmbeddingData(BaseModel):
+    object: str = "embedding"
+    index: int = 0
+    #: list of floats, or a base64 string when encoding_format="base64"
+    embedding: Union[list[float], str] = Field(default_factory=list)
+
+
+class EmbeddingResponse(BaseModel):
+    object: str = "list"
+    model: str = ""
+    data: list[EmbeddingData] = Field(default_factory=list)
+    usage: Usage = Field(default_factory=Usage)
+
+
 class ChatChoiceDelta(BaseModel):
     role: Optional[str] = None
     content: Optional[str] = None

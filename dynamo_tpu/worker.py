@@ -109,6 +109,7 @@ class Worker:
             self.runner.start()
 
         self.ingress.add_handler("generate", self._generate)
+        self.ingress.add_handler("embed", self._embed)
         self.ingress.add_handler("flush", self._flush)
         await self.ingress.start()
 
@@ -185,6 +186,23 @@ class Worker:
         gen = (self.echo or self.mock or self.runner).generate(ctx, pre)
         async for event in gen:
             yield event
+
+    async def _embed(self, ctx, request: dict):
+        """Embedding RPC: {"prompts": [[token ids], ...]} -> one reply with
+        the vectors (float lists; the frontend handles encoding_format)."""
+        prompts = request["prompts"]
+        if self.runner is not None:
+            vecs = await self.runner.embed(prompts)
+        else:
+            from dynamo_tpu.engine.async_engine import fake_embedding
+
+            import numpy as np
+
+            vecs = np.stack([fake_embedding(p) for p in prompts])
+        yield {
+            "embeddings": [[float(x) for x in v] for v in vecs],
+            "prompt_tokens": sum(len(p) for p in prompts),
+        }
 
     # -- disaggregated path ------------------------------------------------
 

@@ -1,0 +1,135 @@
+"""/v1/embeddings: engine pooling, pipeline, HTTP route.
+
+Reference surface: the embeddings route of the OpenAI-compatible HTTP
+service (lib/llm/src/http/service/openai.rs; protocol types
+protocols/openai/). Engine-side the reference delegates to its engines —
+here the JaxEngine pools last-layer hidden states over the prompt.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.engine import EngineConfig
+from dynamo_tpu.engine.engine import JaxEngine
+
+
+@pytest.fixture(scope="module")
+def engine():
+    return JaxEngine(
+        EngineConfig(
+            model="tiny",
+            num_pages=64,
+            page_size=4,
+            max_pages_per_seq=16,
+            prefill_chunk=8,
+            max_seqs=4,
+            dtype="float32",
+        )
+    )
+
+
+def test_embed_shapes_and_norm(engine):
+    vecs = engine.embed([[1, 2, 3], [4, 5, 6, 7, 8]])
+    assert vecs.shape == (2, 64)  # tiny hidden_size
+    np.testing.assert_allclose(np.linalg.norm(vecs, axis=1), 1.0, rtol=1e-5)
+
+
+def test_embed_deterministic(engine):
+    a = engine.embed([[9, 10, 11, 12]])
+    b = engine.embed([[9, 10, 11, 12]])
+    np.testing.assert_allclose(a, b, rtol=0, atol=0)
+
+
+def test_embed_chunked_matches_single_chunk(engine):
+    """A prompt spanning several prefill chunks pools identically to the
+    same prompt in one chunk (prefill_chunk=8 vs prompt of 19 tokens)."""
+    prompt = list(range(1, 20))
+    chunked = engine.embed([prompt])
+
+    big = JaxEngine(
+        EngineConfig(
+            model="tiny",
+            num_pages=64,
+            page_size=4,
+            max_pages_per_seq=16,
+            prefill_chunk=32,
+            max_seqs=4,
+            dtype="float32",
+        )
+    )
+    single = big.embed([prompt])
+    np.testing.assert_allclose(chunked, single, rtol=1e-5, atol=1e-6)
+
+
+def test_embed_pages_returned(engine):
+    free_before = engine.allocator.num_free
+    engine.embed([[1, 2, 3, 4, 5, 6, 7, 8, 9]])
+    assert engine.allocator.num_free == free_before
+
+
+def test_embed_rejects_empty_and_too_long(engine):
+    with pytest.raises(ValueError):
+        engine.embed([[]])
+    with pytest.raises(ValueError):
+        engine.embed([list(range(200))])  # > max_pages_per_seq * page_size
+
+
+def test_embeddings_http_route():
+    """Full route over a local echo pipeline (fake embeddings)."""
+    import aiohttp
+
+    from dynamo_tpu.engine.async_engine import EchoEngine
+    from dynamo_tpu.frontend.http import HttpService
+    from dynamo_tpu.frontend.service import ModelManager, local_pipeline
+    from dynamo_tpu.model_card import ModelDeploymentCard
+
+    async def run():
+        card = ModelDeploymentCard(
+            name="tiny", context_length=64, kv_page_size=4
+        )
+        manager = ModelManager()
+        manager.add("tiny", local_pipeline(card, EchoEngine()))
+        svc = HttpService(manager, host="127.0.0.1", port=0)
+        await svc.start()
+        try:
+            async with aiohttp.ClientSession() as sess:
+                url = f"http://127.0.0.1:{svc.port}/v1/embeddings"
+                r = await sess.post(
+                    url, json={"model": "tiny", "input": ["hi", "there"]}
+                )
+                assert r.status == 200, await r.text()
+                body = await r.json()
+                assert body["object"] == "list"
+                assert len(body["data"]) == 2
+                assert body["usage"]["prompt_tokens"] > 0
+                vec = body["data"][0]["embedding"]
+                assert isinstance(vec, list) and len(vec) == 32
+
+                # base64 encoding round-trips to the same floats
+                r2 = await sess.post(
+                    url,
+                    json={
+                        "model": "tiny",
+                        "input": "hi",
+                        "encoding_format": "base64",
+                    },
+                )
+                assert r2.status == 200
+                b64 = (await r2.json())["data"][0]["embedding"]
+                decoded = np.frombuffer(
+                    base64.b64decode(b64), dtype=np.float32
+                )
+                np.testing.assert_allclose(decoded, vec, rtol=1e-6)
+
+                # unknown model -> 404
+                r3 = await sess.post(url, json={"model": "nope", "input": "x"})
+                assert r3.status == 404
+        finally:
+            await svc.stop()
+
+    asyncio.run(run())

@@ -231,3 +231,38 @@ def test_prompt_at_max_context_rejected(engine_factory):
     eng.add_request("ok", list(range(31)), _greedy(2))
     out = eng.run_to_completion()
     assert len(out["ok"]) >= 1
+
+
+def test_multi_step_decode_matches_single_step(engine_factory):
+    """decode_steps=K fuses K decode iterations into one dispatch with
+    on-device token feedback; outputs must be identical to K=1 stepping,
+    including mixed finish points (eos overshoot dropped on host)."""
+    prompts = {
+        "a": [5, 17, 42, 99, 3],
+        "b": [1, 2, 3],
+        "c": [9, 9, 1, 4, 6, 2, 7],
+    }
+
+    def run(k):
+        eng = engine_factory(decode_steps=k)
+        for rid, p in prompts.items():
+            mt = {"a": 11, "b": 3, "c": 7}[rid]
+            eng.add_request(rid, p, _greedy(mt))
+        return eng.run_to_completion()
+
+    single, fused = run(1), run(8)
+    assert single == fused
+
+
+def test_multi_step_decode_sampled_matches(engine_factory):
+    """Seeded sampling is step-indexed (counters ride the scan), so fused
+    and single stepping draw identical tokens."""
+    sp = SamplingParams(temperature=0.8, top_p=0.9, top_k=12, seed=7,
+                       max_tokens=9)
+
+    def run(k):
+        eng = engine_factory(decode_steps=k)
+        eng.add_request("s", [3, 1, 4, 1, 5], sp)
+        return eng.run_to_completion()["s"]
+
+    assert run(1) == run(6)

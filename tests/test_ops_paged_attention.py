@@ -22,12 +22,14 @@ from dynamo_tpu.models.llama import (
 from dynamo_tpu.ops.paged_attention import paged_decode_attention
 
 
-def _rand_case(rng, b, hq, hkv, d, num_pages, page_size, mp):
+def _rand_case(rng, b, hq, hkv, d, num_pages, page_size, mp, num_layers=2):
     k_cache = jnp.asarray(
-        rng.normal(size=(hkv, num_pages, page_size, d)), jnp.float32
+        rng.normal(size=(num_layers, hkv, num_pages, page_size, d)),
+        jnp.float32,
     )
     v_cache = jnp.asarray(
-        rng.normal(size=(hkv, num_pages, page_size, d)), jnp.float32
+        rng.normal(size=(num_layers, hkv, num_pages, page_size, d)),
+        jnp.float32,
     )
     q = jnp.asarray(rng.normal(size=(b, hq, d)), jnp.float32)
     # Distinct non-null pages per row so sequences don't alias.
@@ -52,21 +54,24 @@ def test_kernel_matches_xla_path(seq_lens):
     q, k_cache, v_cache, pt = _rand_case(rng, b, hq, hkv, d, num_pages, page_size, mp)
     lens = jnp.asarray(seq_lens, jnp.int32)
 
-    out = paged_decode_attention(
-        q, k_cache, v_cache, pt, lens, interpret=True
-    )
+    # Exercise the layer-index prefetch: compare each stacked layer.
+    for layer in (0, 1):
+        li = jnp.asarray(layer, jnp.int32)
+        out = paged_decode_attention(
+            q, k_cache, v_cache, li, pt, lens, interpret=True
+        )
 
-    cfg = LlamaConfig(
-        num_heads=hq, num_kv_heads=hkv, head_dim=d, dtype=jnp.float32
-    )
-    k_all = paged_gather(k_cache, pt)
-    v_all = paged_gather(v_cache, pt)
-    ref = paged_attention(
-        q[:, None], k_all, v_all, (lens - 1)[:, None], cfg
-    )  # [B, 1, Hq*D]
-    np.testing.assert_allclose(
-        np.asarray(out), np.asarray(ref)[:, 0], rtol=2e-5, atol=2e-5
-    )
+        cfg = LlamaConfig(
+            num_heads=hq, num_kv_heads=hkv, head_dim=d, dtype=jnp.float32
+        )
+        k_all = paged_gather(k_cache, li, pt)
+        v_all = paged_gather(v_cache, li, pt)
+        ref = paged_attention(
+            q[:, None], k_all, v_all, (lens - 1)[:, None], cfg
+        )  # [B, 1, Hq*D]
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref)[:, 0], rtol=2e-5, atol=2e-5
+        )
 
 
 def test_full_model_decode_pallas_vs_xla():
@@ -78,22 +83,26 @@ def test_full_model_decode_pallas_vs_xla():
     rng = np.random.default_rng(1)
     page_size, num_pages, mp = 4, 32, 6
 
-    kv = init_kv_pages(cfg, num_pages, page_size)
     pt = jnp.asarray(np.array([[1, 2, 3, 0, 0, 0], [4, 5, 6, 0, 0, 0]], np.int32))
     # Prefill 9 tokens into the cache (positions 0..8), then decode pos 9.
     toks = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 9)), jnp.int32)
     positions = jnp.tile(jnp.arange(9, dtype=jnp.int32)[None], (2, 1))
-    _, kv = forward_hidden(
-        params, cfg, toks, positions, jnp.ones((2, 9), bool), kv, pt
-    )
-
     dec_tok = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 1)), jnp.int32)
     dec_pos = jnp.full((2, 1), 9, jnp.int32)
     dec_valid = jnp.ones((2, 1), bool)
 
-    h_xla, _ = forward_hidden(params, cfg, dec_tok, dec_pos, dec_valid, kv, pt)
+    # Each impl builds its own cache: the pallas cache is lane-padded
+    # (cfg.kv_head_dim 128 vs head_dim 16), exercising the padded path.
     cfg_p = replace(cfg, attention_impl="pallas")
-    h_pal, _ = forward_hidden(params, cfg_p, dec_tok, dec_pos, dec_valid, kv, pt)
+    assert cfg_p.kv_head_dim == 128 and cfg.kv_head_dim == cfg.head_dim
+    results = {}
+    for c in (cfg, cfg_p):
+        kv = init_kv_pages(c, num_pages, page_size)
+        _, kv = forward_hidden(
+            params, c, toks, positions, jnp.ones((2, 9), bool), kv, pt
+        )
+        h, _ = forward_hidden(params, c, dec_tok, dec_pos, dec_valid, kv, pt)
+        results[c.attention_impl] = np.asarray(h)
     np.testing.assert_allclose(
-        np.asarray(h_pal), np.asarray(h_xla), rtol=1e-5, atol=1e-5
+        results["pallas"], results["xla"], rtol=1e-5, atol=1e-5
     )
