@@ -39,17 +39,18 @@ def main() -> None:
     from dynamo_tpu.engine.engine import JaxEngine
     from dynamo_tpu.engine.request import SamplingParams
 
+    chunk = -(-max(128, isl) // 64) * 64  # page-aligned prefill chunk
     cfg = EngineConfig(
         model=model,
         num_pages=512,
         page_size=64,
         max_pages_per_seq=16,
         decode_buckets=(1, 2, 4, 8, 16, 32),
-        prefill_chunk=max(128, isl),
+        prefill_chunk=chunk,
         # Whole-workload dispatches: all prompts prefill in one batched
         # program; decode fuses K steps per host sync (the TPU sits behind
         # a ~65ms tunnel round-trip, so syncs dominate unamortized).
-        prefill_token_budget=num_requests * max(128, isl),
+        prefill_token_budget=num_requests * chunk,
         decode_steps=int(os.environ.get("BENCH_DECODE_STEPS", "32")),
         max_seqs=32,
         dtype="bfloat16",
@@ -62,11 +63,13 @@ def main() -> None:
         [int(x) for x in rng.integers(1, 32000, isl)] for _ in range(num_requests)
     ]
 
-    # Warmup with the SAME workload shape (all requests, short osl) so every
-    # decode bucket and prefill program the timed run uses is compiled
-    # before the timer starts — otherwise tok/s and TTFT measure XLA.
+    # Warmup with the SAME workload (all requests, same osl) so every
+    # decode bucket, fused-step count, and prefill program the timed run
+    # uses is compiled before the timer starts — otherwise tok/s and TTFT
+    # measure XLA (the fused decode K adapts to remaining max_tokens, so a
+    # short warmup osl would compile the wrong K).
     for i, p in enumerate(prompts):
-        eng.add_request(f"warm{i}", p, SamplingParams(max_tokens=2))
+        eng.add_request(f"warm{i}", p, SamplingParams(temperature=0.0, max_tokens=osl))
     eng.run_to_completion()
     eng.allocator.clear_cache()
 

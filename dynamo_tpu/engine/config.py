@@ -63,6 +63,24 @@ class EngineConfig:
     #: directory for the disk tier's block files
     disk_kv_cache_dir: Optional[str] = None
 
+    def __post_init__(self):
+        if self.prefill_chunk % self.page_size != 0:
+            raise ValueError(
+                f"prefill_chunk ({self.prefill_chunk}) must be a multiple of "
+                f"page_size ({self.page_size}) — chunks start page-aligned "
+                "so the KV write path can land whole-page DMA runs"
+            )
+        if (
+            self.prefill_token_budget is not None
+            and self.prefill_token_budget < self.page_size
+        ):
+            raise ValueError(
+                f"prefill_token_budget ({self.prefill_token_budget}) must be "
+                f">= page_size ({self.page_size}): mid-prompt chunks round "
+                "down to page boundaries, so a smaller budget could never "
+                "schedule any prefill work"
+            )
+
     @property
     def max_context(self) -> int:
         return self.max_pages_per_seq * self.page_size

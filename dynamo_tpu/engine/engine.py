@@ -635,15 +635,25 @@ class JaxEngine:
         return cfg.head_dim if hasattr(cfg, "head_dim") else cfg.base.head_dim
 
     def extract_pages(self, page_ids: Sequence[int]):
-        """Pull KV pages to host: (k, v) as [L, Hkv, n, page_size, D]."""
+        """Pull KV pages to host in the canonical wire format:
+        (k, v) as [L, Hkv, n, page_size, D] — layout- and padding-agnostic
+        so disagg peers and KVBM tiers interoperate across engine configs.
+        (Device cache is [L, P, S, Hkv, Dpad].)"""
         ids = jnp.asarray(np.asarray(page_ids, np.int32))
         d = self._canonical_head_dim
-        k = np.asarray(jax.device_get(jnp.take(self.kv.k, ids, axis=2)))[..., :d]
-        v = np.asarray(jax.device_get(jnp.take(self.kv.v, ids, axis=2)))[..., :d]
+        k = np.asarray(jax.device_get(jnp.take(self.kv.k, ids, axis=1)))
+        v = np.asarray(jax.device_get(jnp.take(self.kv.v, ids, axis=1)))
+        # [L, n, S, Hkv, Dp] -> [L, Hkv, n, S, D]
+        k = k.transpose(0, 3, 1, 2, 4)[..., :d]
+        v = v.transpose(0, 3, 1, 2, 4)[..., :d]
         return k, v
 
     def inject_pages(self, page_ids: Sequence[int], k: np.ndarray, v: np.ndarray) -> None:
-        """Write transferred KV pages into this engine's pool in place."""
+        """Write transferred KV pages (canonical [L, Hkv, n, S, D]) into
+        this engine's pool in place."""
+        # -> device layout [L, n, S, Hkv, Dp]
+        k = np.ascontiguousarray(k.transpose(0, 2, 3, 1, 4))
+        v = np.ascontiguousarray(v.transpose(0, 2, 3, 1, 4))
         dpad = self.kv.k.shape[-1] - k.shape[-1]
         if dpad:
             widths = [(0, 0)] * (k.ndim - 1) + [(0, dpad)]
@@ -654,8 +664,8 @@ class JaxEngine:
         if fn is None:
             def inject_fn(kv, ids, kk, vv):
                 return type(kv)(
-                    k=kv.k.at[:, :, ids].set(kk.astype(kv.k.dtype)),
-                    v=kv.v.at[:, :, ids].set(vv.astype(kv.v.dtype)),
+                    k=kv.k.at[:, ids].set(kk.astype(kv.k.dtype)),
+                    v=kv.v.at[:, ids].set(vv.astype(kv.v.dtype)),
                 )
             fn = jax.jit(inject_fn, donate_argnums=(0,))
             self._jit_cache[("inject", n)] = fn

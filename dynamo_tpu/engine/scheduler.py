@@ -182,12 +182,18 @@ class Scheduler:
         # batched [B, T] program, so packing many prompts here turns into
         # fewer, larger dispatches rather than serial B=1 launches.
         budget = self.config.effective_prefill_budget
+        ps = self.config.page_size
         pieces: list[PrefillPiece] = []
         for req in self.running:
             if req.state != RequestState.PREFILL or budget <= 0:
                 continue
             remaining = len(req.prompt_tokens) - req.num_computed_tokens
             take = min(remaining, self.config.prefill_chunk, budget)
+            if take < remaining:
+                # Mid-prompt chunks end on page boundaries so every chunk
+                # STARTS page-aligned — the Pallas write path lands chunk
+                # KV as whole-page DMA runs (ops/kv_update.py invariant).
+                take = (take // ps) * ps
             if take <= 0:
                 continue
             pieces.append(

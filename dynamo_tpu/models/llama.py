@@ -161,11 +161,15 @@ class LlamaConfig:
 class KVPages(NamedTuple):
     """Paged KV cache: one page pool shared by all sequences of a worker.
 
-    k, v: [num_layers, num_kv_heads, num_pages, page_size, head_dim]
-    Head-major so one (head, page) slice is a contiguous [S, D] block — a
-    single dense DMA descriptor for the Pallas decode kernel and the natural
-    unit for tp sharding (heads ride with their shard). Page 0 is the null
-    page: padding writes land there and no real page table ever references it.
+    k, v: [num_layers, num_pages, page_size, num_kv_heads, head_dim]
+    Page-major: one (layer, page) slice is a contiguous [S, Hkv, D] block —
+    a single dense DMA descriptor covering every kv head (the Pallas decode
+    kernel reads one page per DMA and computes all heads from it), and a
+    token's row [Hkv, D] is contiguous so the Pallas write kernel can land
+    it with one descriptor; writes for one sequence across ALL layers are a
+    single strided DMA (stride = the page axis). tp shards the kv-heads
+    axis. Page 0 is the null page: padding writes land there and no real
+    page table ever references it.
     """
 
     k: jax.Array
@@ -173,18 +177,18 @@ class KVPages(NamedTuple):
 
     @property
     def num_pages(self) -> int:
-        return self.k.shape[2]
+        return self.k.shape[1]
 
     @property
     def page_size(self) -> int:
-        return self.k.shape[3]
+        return self.k.shape[2]
 
 
 def init_kv_pages(
     cfg: LlamaConfig, num_pages: int, page_size: int, dtype=None
 ) -> KVPages:
     shape = (
-        cfg.num_layers, cfg.num_kv_heads, num_pages, page_size, cfg.kv_head_dim
+        cfg.num_layers, num_pages, page_size, cfg.num_kv_heads, cfg.kv_head_dim
     )
     dtype = dtype or cfg.dtype
     return KVPages(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
@@ -326,14 +330,16 @@ def apply_rope(x: jax.Array, positions: jax.Array, cfg: LlamaConfig) -> jax.Arra
 
 
 def paged_scatter(
-    cache: jax.Array,  # [L, Hkv, P, S, D] — the FULL stacked cache
+    cache: jax.Array,  # [L, P, S, Hkv, D] — the FULL stacked cache
     layer: jax.Array,  # scalar int32 layer index
     new: jax.Array,  # [B, T, Hkv, D]
     page_tables: jax.Array,  # [B, MP] int32
     positions: jax.Array,  # [B, T] int32
     valid: jax.Array,  # [B, T] bool
 ) -> jax.Array:
-    """Write new KV for absolute `positions` into cache[layer]'s pages.
+    """Write new KV for absolute `positions` into cache[layer]'s pages
+    (the XLA fallback path; the Pallas impl stages writes and lands them
+    with one DMA kernel per step instead — ops/kv_update.py).
 
     Invalid (padding) slots are redirected to the null page 0 slot 0.
 
@@ -342,9 +348,12 @@ def paged_scatter(
     while loop, so per-step HBM traffic is proportional to the tokens
     written — NOT to the cache size. (Emitting per-layer caches as scan
     outputs instead forces XLA to rewrite the entire pool every step —
-    measured 2.6× slower at 512 pages and linear in num_pages.)
+    measured 2.6× slower at 512 pages and linear in num_pages. The
+    slice-layer → 4D scatter → dynamic_update structure below keeps the
+    carry aliasable; a direct 5D advanced-index scatter with the scalar
+    layer index broke XLA's in-place update.)
     """
-    page_size = cache.shape[3]
+    page_size = cache.shape[2]
     page_of = positions // page_size  # [B,T] index into page table
     slot_of = positions % page_size
     page_ids = jnp.take_along_axis(page_tables, page_of, axis=1)  # [B,T]
@@ -352,12 +361,9 @@ def paged_scatter(
     slot_of = jnp.where(valid, slot_of, 0)
     flat_pages = page_ids.reshape(-1)
     flat_slots = slot_of.reshape(-1)
-    flat_new = new.reshape(-1, new.shape[2], new.shape[3]).swapaxes(0, 1)  # [Hkv,N,D]
-    # slice-layer → 4D scatter → dynamic_update keeps the whole-cache carry
-    # aliasable (a direct 5D advanced-index scatter with the layer as a
-    # scalar index broke XLA's in-place update — measured 5× slower).
+    flat_new = new.reshape(-1, new.shape[2], new.shape[3])  # [N,Hkv,D]
     layer_cache = lax.dynamic_index_in_dim(cache, layer, 0, keepdims=False)
-    layer_cache = layer_cache.at[:, flat_pages, flat_slots].set(
+    layer_cache = layer_cache.at[flat_pages, flat_slots].set(
         flat_new, mode="drop"
     )
     return lax.dynamic_update_index_in_dim(cache, layer_cache, layer, 0)
@@ -366,42 +372,46 @@ def paged_scatter(
 def paged_gather(
     cache: jax.Array, layer: jax.Array, page_tables: jax.Array
 ) -> jax.Array:
-    """[L, Hkv, P, S, D] × [B, MP] -> [Hkv, B, MP*S, D], position-ordered."""
+    """[L, P, S, Hkv, D] × [B, MP] -> [B, MP*S, Hkv, D], position-ordered."""
     g = jax.lax.dynamic_index_in_dim(
         cache, layer, axis=0, keepdims=False
-    )[:, page_tables]  # [Hkv, B, MP, S, D]
-    hkv, b, mp, s, d = g.shape
-    return g.reshape(hkv, b, mp * s, d)
+    )[page_tables]  # [B, MP, S, Hkv, D]
+    b, mp, s, hkv, d = g.shape
+    return g.reshape(b, mp * s, hkv, d)
 
 
 def paged_attention(
     q: jax.Array,  # [B, T, Hq, D] (post-rope)
-    k_pages: jax.Array,  # [Hkv, B, K, D] gathered, position-ordered
-    v_pages: jax.Array,  # [Hkv, B, K, D]
+    k_pages: jax.Array,  # [B, K, Hkv, D] gathered, position-ordered
+    v_pages: jax.Array,  # [B, K, Hkv, D]
     q_positions: jax.Array,  # [B, T]
     cfg: LlamaConfig,
+    key_positions: Optional[jax.Array] = None,  # [B, K]; default arange(K)
 ) -> jax.Array:
     """Reference paged attention (XLA path; the Pallas decode kernel in
     dynamo_tpu.ops replaces this for T=1 when cfg.attention_impl="pallas").
 
     Causality over the whole paged history: key at gathered index i has
-    absolute position i, so the mask is simply key_pos <= q_pos. Unallocated
-    page-table slots sit at positions >= seq_len and are masked by the same
-    comparison.
+    absolute position i (or key_positions when given), so the mask is
+    simply key_pos <= q_pos. Unallocated page-table slots sit at positions
+    >= seq_len and are masked by the same comparison.
     """
     b, t, hq, d = q.shape
-    kk = k_pages.shape[2]
+    kk = k_pages.shape[1]
     g = cfg.q_per_kv
     qg = q.reshape(b, t, cfg.num_kv_heads, g, d)
     scale = 1.0 / math.sqrt(d)
     scores = jnp.einsum(
-        "btkgd,kbsd->bkgts", qg.astype(jnp.float32), k_pages.astype(jnp.float32)
+        "btkgd,bskd->bkgts", qg.astype(jnp.float32), k_pages.astype(jnp.float32)
     ) * scale
-    key_pos = jnp.arange(kk)[None, None, None, None, :]
+    if key_positions is None:
+        key_pos = jnp.arange(kk)[None, None, None, None, :]
+    else:
+        key_pos = key_positions[:, None, None, None, :]
     mask = key_pos <= q_positions[:, None, None, :, None]
     scores = jnp.where(mask, scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("bkgts,kbsd->btkgd", probs, v_pages.astype(jnp.float32))
+    out = jnp.einsum("bkgts,bskd->btkgd", probs, v_pages.astype(jnp.float32))
     return out.reshape(b, t, hq * d).astype(q.dtype)
 
 
@@ -409,19 +419,28 @@ def attention_block(
     q: jax.Array,  # [B, T, Hq, D] pre-rope
     k: jax.Array,  # [B, T, Hkv, D] pre-rope
     v: jax.Array,  # [B, T, Hkv, D]
-    k_cache: jax.Array,  # [L, Hkv, P, S, kv_head_dim] full stacked cache
+    k_cache: jax.Array,  # [L, P, S, kv_head_dim] full stacked cache
     v_cache: jax.Array,
     layer: jax.Array,  # scalar int32
     page_tables: jax.Array,  # [B, MP] int32
     positions: jax.Array,  # [B, T] int32
     valid: jax.Array,  # [B, T] bool
     cfg: LlamaConfig,
-) -> tuple[jax.Array, jax.Array, jax.Array]:
-    """rope → KV scatter → paged attention (Pallas decode kernel when
-    enabled and T==1, XLA gather path otherwise). Returns
-    (attn [B,T,Hq*head_dim], k_cache, v_cache). Operates on the full
-    layer-stacked cache (see paged_scatter on why) and handles the cache's
-    lane padding (cfg.kv_head_dim) transparently."""
+):
+    """rope → paged attention, in one of two write disciplines:
+
+    - "xla": scatter this layer's KV into the cache, then gather + dense
+      attention. Works on any backend and under any mesh.
+    - "pallas": the cache is READ-ONLY here (history); this layer's KV is
+      returned as `staged` for the layer scan to stack, and the engine step
+      lands all layers with one DMA kernel (ops/kv_update.paged_write).
+      Decode (T==1) runs the flash kernel + exact current-token merge;
+      prefill attends to history pages + the in-register current chunk.
+
+    Returns (attn [B,T,Hq*head_dim], k_cache, v_cache, staged) where
+    staged is None (xla) or ([B,T,Hkv,Dpad], [B,T,Hkv,Dpad]).
+    Handles the cache's lane padding (cfg.kv_head_dim) transparently.
+    """
     b, t = q.shape[0], q.shape[1]
     q = apply_rope(q, positions, cfg)
     k = apply_rope(k, positions, cfg)
@@ -429,32 +448,76 @@ def attention_block(
     if dpad:
         k = jnp.pad(k, ((0, 0), (0, 0), (0, 0), (0, dpad)))
         v = jnp.pad(v, ((0, 0), (0, 0), (0, 0), (0, dpad)))
-    k_cache = paged_scatter(k_cache, layer, k, page_tables, positions, valid)
-    v_cache = paged_scatter(v_cache, layer, v, page_tables, positions, valid)
-    if cfg.attention_impl == "pallas" and t == 1:
-        from dynamo_tpu.ops.paged_attention import paged_decode_attention
 
-        seq_lens = positions[:, 0] + 1
-        qd = q[:, 0]
-        if dpad:
-            qd = jnp.pad(qd, ((0, 0), (0, 0), (0, dpad)))
-        attn = paged_decode_attention(
-            qd, k_cache, v_cache, layer, page_tables, seq_lens,
-            scale_dim=cfg.head_dim,
+    if cfg.attention_impl != "pallas":
+        k_cache = paged_scatter(
+            k_cache, layer, k, page_tables, positions, valid
         )
-        if dpad:
-            attn = attn.reshape(b, cfg.num_heads, cfg.kv_head_dim)[
-                :, :, : cfg.head_dim
-            ].reshape(b, cfg.num_heads * cfg.head_dim)
-        attn = attn[:, None, :]
-    else:
+        v_cache = paged_scatter(
+            v_cache, layer, v, page_tables, positions, valid
+        )
         k_all = paged_gather(k_cache, layer, page_tables)
         v_all = paged_gather(v_cache, layer, page_tables)
         if dpad:
             k_all = k_all[..., : cfg.head_dim]
             v_all = v_all[..., : cfg.head_dim]
         attn = paged_attention(q, k_all, v_all, positions, cfg)
-    return attn, k_cache, v_cache
+        return attn, k_cache, v_cache, None
+
+    from dynamo_tpu.ops.paged_attention import paged_decode_attention
+
+    if t == 1:
+        hist = positions[:, 0]  # tokens already in the cache
+        qd = q[:, 0]
+        if dpad:
+            qd = jnp.pad(qd, ((0, 0), (0, 0), (0, dpad)))
+        acc, m, l = paged_decode_attention(
+            qd, k_cache, v_cache, layer, page_tables, hist,
+            scale_dim=cfg.head_dim,
+        )  # acc [B,Hq,Dpad] unnormalized, m/l [B,Hq]
+        # Exact merge of the current (unwritten) token: self-attention
+        # score s = q·k_cur/√d folded into the flash running state.
+        g = cfg.q_per_kv
+        kv_of = jnp.arange(cfg.num_heads) // g  # [Hq]
+        k_sel = k[:, 0, kv_of]  # [B, Hq, Dpad]
+        v_sel = v[:, 0, kv_of].astype(jnp.float32)
+        scale = 1.0 / math.sqrt(cfg.head_dim)
+        s_self = jnp.sum(
+            qd.astype(jnp.float32) * k_sel.astype(jnp.float32), axis=-1
+        ) * scale  # [B, Hq]
+        m_star = jnp.maximum(m, s_self)
+        alpha = jnp.exp(m - m_star)
+        beta = jnp.exp(s_self - m_star)
+        out = (alpha[..., None] * acc + beta[..., None] * v_sel) / (
+            alpha * l + beta
+        )[..., None]
+        out = out.astype(cfg.dtype)
+        if dpad:
+            out = out[..., : cfg.head_dim]
+        attn = out.reshape(b, cfg.num_heads * cfg.head_dim)[:, None, :]
+    else:
+        # Prefill chunk: history pages (positions < chunk start) + the
+        # current chunk in registers, one causal mask over both.
+        k_hist = paged_gather(k_cache, layer, page_tables)  # [B,K,Hkv,Dp]
+        v_hist = paged_gather(v_cache, layer, page_tables)
+        kk = k_hist.shape[1]
+        start = positions[:, 0]
+        hist_pos = jnp.arange(kk, dtype=jnp.int32)[None, :]
+        # Mask unwritten (>= chunk start) gathered slots outright.
+        hist_pos = jnp.where(
+            hist_pos < start[:, None], hist_pos, jnp.int32(1 << 30)
+        )
+        cur_pos = jnp.where(valid, positions, jnp.int32(1 << 30))
+        keys = jnp.concatenate([k_hist, k], axis=1)
+        vals = jnp.concatenate([v_hist, v], axis=1)
+        key_positions = jnp.concatenate([hist_pos, cur_pos], axis=1)
+        if dpad:
+            keys = keys[..., : cfg.head_dim]
+            vals = vals[..., : cfg.head_dim]
+        attn = paged_attention(
+            q, keys, vals, positions, cfg, key_positions=key_positions
+        )
+    return attn, k_cache, v_cache, (k, v)
 
 
 # ---------------------------------------------------------------------------
@@ -492,7 +555,7 @@ def forward_hidden(
         q = q.reshape(b, t, cfg.num_heads, cfg.head_dim)
         k = k.reshape(b, t, cfg.num_kv_heads, cfg.head_dim)
         v = v.reshape(b, t, cfg.num_kv_heads, cfg.head_dim)
-        attn, k_full, v_full = attention_block(
+        attn, k_full, v_full, staged = attention_block(
             q, k, v, k_full, v_full, li, page_tables, positions, valid, cfg
         )
         h = h + attn @ lp["wo"]
@@ -500,15 +563,31 @@ def forward_hidden(
         gate = jax.nn.silu((x @ lp["w_gate"]).astype(jnp.float32))
         up = (x @ lp["w_up"]).astype(jnp.float32)
         h = h + ((gate * up).astype(cfg.dtype) @ lp["w_down"])
-        return (h, k_full, v_full), None
+        return (h, k_full, v_full), staged
 
-    (h, k_new, v_new), _ = lax.scan(
+    (h, k_new, v_new), staged = lax.scan(
         layer,
         (h, kv.k, kv.v),
         (params["layers"], jnp.arange(cfg.num_layers, dtype=jnp.int32)),
     )
+    k_new, v_new = land_staged_kv(
+        k_new, v_new, staged, page_tables, positions, valid
+    )
     h = rms_norm(h, params["final_norm"], cfg.rms_norm_eps)
     return h, KVPages(k=k_new, v=v_new)
+
+
+def land_staged_kv(k_cache, v_cache, staged, page_tables, positions, valid):
+    """Land a layer scan's staged KV (pallas write discipline) in one DMA
+    kernel call; no-op under the xla scatter discipline (staged is None).
+    Shared by the Llama and MoE forward passes."""
+    if staged is None:
+        return k_cache, v_cache
+    from dynamo_tpu.ops.kv_update import paged_write
+
+    return paged_write(
+        k_cache, v_cache, staged[0], staged[1], page_tables, positions, valid
+    )
 
 
 def compute_logits(params: dict, cfg: LlamaConfig, hidden: jax.Array) -> jax.Array:

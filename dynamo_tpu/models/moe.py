@@ -34,6 +34,7 @@ from dynamo_tpu.models.llama import (
     KVPages,
     LlamaConfig,
     attention_block,
+    land_staged_kv,
     rms_norm,
 )
 
@@ -237,18 +238,21 @@ def forward_hidden(
         q = (x @ lp["wq"]).reshape(b, t, bc.num_heads, bc.head_dim)
         k = (x @ lp["wk"]).reshape(b, t, bc.num_kv_heads, bc.head_dim)
         v = (x @ lp["wv"]).reshape(b, t, bc.num_kv_heads, bc.head_dim)
-        attn, k_full, v_full = attention_block(
+        attn, k_full, v_full, staged = attention_block(
             q, k, v, k_full, v_full, li, page_tables, positions, valid, bc
         )
         h = h + attn @ lp["wo"]
         x = rms_norm(h, lp["mlp_norm"], bc.rms_norm_eps)
         h = h + moe_ffn(x, lp, cfg)
-        return (h, k_full, v_full), None
+        return (h, k_full, v_full), staged
 
-    (h, k_new, v_new), _ = lax.scan(
+    (h, k_new, v_new), staged = lax.scan(
         layer,
         (h, kv.k, kv.v),
         (params["layers"], jnp.arange(bc.num_layers, dtype=jnp.int32)),
+    )
+    k_new, v_new = land_staged_kv(
+        k_new, v_new, staged, page_tables, positions, valid
     )
     h = rms_norm(h, params["final_norm"], bc.rms_norm_eps)
     return h, KVPages(k=k_new, v=v_new)

@@ -1,0 +1,167 @@
+"""Pallas TPU in-place paged-KV writer.
+
+The paged cache is [L, P, S, Hkv, D] (models/llama.py KVPages). The model's
+layer scan STAGES each layer's newly-computed KV (a small [L, B, T, Hkv, D]
+scan output) instead of scattering into the cache per layer — XLA lowers
+those scatters at ~0.5 ms each on TPU, and 2×L of them dominated the decode
+step. This kernel lands the whole step's writes afterwards in ONE launch:
+for every (sequence, page-run) it issues a single strided DMA covering ALL
+layers at once (the layer axis is the cache's major axis, so
+cache[:, page, slot0:slot0+run] is one descriptor).
+
+Run shape: decode writes runs of 1 slot; prefill chunks are page-aligned
+(scheduler invariant) so runs are min(T, S) slots. A prompt-tail run may
+carry garbage staging rows past the valid tokens — harmless, those slots
+are beyond every sequence's readable history and are overwritten by decode
+before they become readable. Invalid (padding) runs are redirected to the
+null page 0.
+
+input_output_aliasing keeps both caches in place. D must be a 128 multiple
+on TPU (LlamaConfig.kv_head_dim) — Mosaic DMA minor-dim alignment.
+
+Parity: the engine-side KV write the reference delegates to vLLM's
+reshape_and_cache CUDA kernel (SURVEY.md §2.9); TPU-native equivalent as a
+Pallas DMA kernel.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _write_kernel(
+    pages_ref,  # [NR] int32 target page per run (scalar prefetch)
+    slots_ref,  # [NR] int32 first slot per run (scalar prefetch)
+    k_src_ref,  # [L, NR, R, Hkv, D] ANY — staged K rows, run-major
+    v_src_ref,  # [L, NR, R, Hkv, D] ANY
+    k_in_ref,  # [L, P, S, Hkv, D] ANY (aliased with k_out)
+    v_in_ref,
+    k_out_ref,  # [L, P, S, Hkv, D] ANY
+    v_out_ref,
+    sem,  # DMA semaphore
+    *,
+    num_runs: int,
+    run: int,
+):
+    del k_in_ref, v_in_ref  # aliased: writes land in place
+
+    def copies(i):
+        dst_k = k_out_ref.at[:, pages_ref[i], pl.ds(slots_ref[i], run)]
+        dst_v = v_out_ref.at[:, pages_ref[i], pl.ds(slots_ref[i], run)]
+        return (
+            pltpu.make_async_copy(k_src_ref.at[:, i], dst_k, sem),
+            pltpu.make_async_copy(v_src_ref.at[:, i], dst_v, sem),
+        )
+
+    def start(i, _):
+        ck, cv = copies(i)
+        ck.start()
+        cv.start()
+        return 0
+
+    def drain(i, _):
+        ck, cv = copies(i)
+        ck.wait()
+        cv.wait()
+        return 0
+
+    # All runs' DMAs go out before any wait: targets are disjoint (padding
+    # runs all alias the null page, where content is irrelevant), so total
+    # latency is one round, not NR of them.
+    jax.lax.fori_loop(0, num_runs, start, 0)
+    jax.lax.fori_loop(0, num_runs, drain, 0)
+
+
+def paged_write(
+    k_cache: jax.Array,  # [L, P, S, Hkv, D]
+    v_cache: jax.Array,
+    k_stage: jax.Array,  # [L, B, T, Hkv, D] — per-layer staged new KV
+    v_stage: jax.Array,
+    page_tables: jax.Array,  # [B, MP] int32
+    positions: jax.Array,  # [B, T] int32 absolute positions
+    valid: jax.Array,  # [B, T] bool
+    *,
+    use_kernel: bool | None = None,
+) -> tuple[jax.Array, jax.Array]:
+    """Write one step's staged KV for all layers into the caches in place.
+
+    Requires T == 1 (decode) or page-aligned chunk starts with T a multiple
+    of min(T, S) (prefill — guaranteed by the scheduler's page-aligned
+    chunking). `use_kernel` defaults to True on TPU.
+    """
+    if use_kernel is None:
+        use_kernel = jax.default_backend() == "tpu"
+    L, b, t = k_stage.shape[0], k_stage.shape[1], k_stage.shape[2]
+    s = k_cache.shape[2]
+
+    if not use_kernel:
+        # XLA scatter fallback (CPU, meshes): token-granular, one 5D
+        # advanced-index scatter per cache.
+        page_of = positions // s
+        slot_of = positions % s
+        page_ids = jnp.take_along_axis(page_tables, page_of, axis=1)
+        page_ids = jnp.where(valid, page_ids, 0).reshape(-1)
+        slot_of = jnp.where(valid, slot_of, 0).reshape(-1)
+        ks = k_stage.reshape(L, b * t, *k_stage.shape[3:])
+        vs = v_stage.reshape(L, b * t, *v_stage.shape[3:])
+        k_cache = k_cache.at[:, page_ids, slot_of].set(
+            ks.astype(k_cache.dtype), mode="drop"
+        )
+        v_cache = v_cache.at[:, page_ids, slot_of].set(
+            vs.astype(v_cache.dtype), mode="drop"
+        )
+        return k_cache, v_cache
+
+    run = min(t, s)
+    assert t % run == 0, f"chunk T={t} must be a multiple of run={run}"
+    runs_per_seq = t // run
+    nr = b * runs_per_seq
+    # First token of each run determines its page/slot; invalid -> null.
+    first_pos = positions[:, ::run]  # [B, T//R]
+    first_valid = valid[:, ::run]
+    page_ids = jnp.take_along_axis(page_tables, first_pos // s, axis=1)
+    page_ids = jnp.where(first_valid, page_ids, 0).reshape(-1)
+    slots = jnp.where(first_valid, first_pos % s, 0).reshape(-1)
+
+    shape_tail = k_stage.shape[3:]
+    k_src = k_stage.reshape(L, nr, run, *shape_tail).astype(k_cache.dtype)
+    v_src = v_stage.reshape(L, nr, run, *shape_tail).astype(v_cache.dtype)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(1,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        scratch_shapes=[pltpu.SemaphoreType.DMA],
+    )
+    return pl.pallas_call(
+        functools.partial(_write_kernel, num_runs=nr, run=run),
+        out_shape=[
+            jax.ShapeDtypeStruct(k_cache.shape, k_cache.dtype),
+            jax.ShapeDtypeStruct(v_cache.shape, v_cache.dtype),
+        ],
+        grid_spec=grid_spec,
+        # operands: pages, slots, k_src, v_src, k_cache, v_cache
+        input_output_aliases={4: 0, 5: 1},
+        interpret=jax.default_backend() != "tpu",
+    )(
+        page_ids.astype(jnp.int32),
+        slots.astype(jnp.int32),
+        k_src,
+        v_src,
+        k_cache,
+        v_cache,
+    )

@@ -1,6 +1,6 @@
 """Pallas TPU paged-attention decode kernel.
 
-Decode (T=1) attention against the paged KV cache. The XLA fallback path
+Decode (T=1) attention over the paged KV history. The XLA fallback path
 (models/llama.py:paged_attention) gathers the full per-sequence KV history
 into a dense [B, K, Hkv, D] array in HBM before the matmuls — 2× the HBM
 traffic (read pages, write gather, read gather) plus O(B·MP·S) memory. This
@@ -8,13 +8,19 @@ kernel instead walks each sequence's page table and streams pages HBM→VMEM
 with double-buffered async DMA, accumulating a flash-style online softmax.
 KV bytes are read exactly once, nothing is materialized.
 
-Cache layout is [Hkv, P, S, D] per layer (models/llama.py KVPages), so one
-(head, page) slice is a contiguous [S, D] block — a single dense DMA
-descriptor per page.
+Cache layout is [L, P, S, Hkv, D] (models/llama.py KVPages): one (layer,
+page) slice is a contiguous [S, Hkv, D] block, so a single DMA per page
+feeds the compute for EVERY kv head — the grid is (B,), one cell per
+sequence, with the (small) per-head dots unrolled inside the cell. D is
+lane-padded to a 128 multiple (LlamaConfig.kv_head_dim): Mosaic DMA slices
+must be 128-aligned in the minor dimension.
 
-Grid: (B, Hkv) — one cell per (sequence, kv-head); the q-head group G=Hq/Hkv
-rides the sublane dim. Decode attention is HBM-bandwidth-bound, so the tiny
-per-cell matmuls ([G,S]·[S,D]) are irrelevant; the DMA pipeline is the point.
+The kernel reads HISTORY ONLY (tokens already written to pages — the
+current token's KV is staged and written once per step by ops/kv_update).
+It returns the UNNORMALIZED accumulator plus the softmax running max and
+denominator (m, l), and the caller folds the current token in exactly:
+
+    out = (e^{m-m*}·acc + e^{s_self-m*}·v_cur) / (e^{m-m*}·l + e^{s_self-m*})
 
 Parity: replaces the paged-attention kernels the reference gets from vLLM /
 TRT-LLM (engine-delegated, SURVEY.md §2.9); on TPU the engine is first-class
@@ -34,51 +40,55 @@ from jax.experimental.pallas import tpu as pltpu
 
 def _decode_kernel(
     # scalar prefetch
-    layer_ref,  # [1] int32 — which layer of the stacked cache to read
+    layer_ref,  # [1] int32 — layer of the stacked cache to read
     pt_ref,  # [B, MP] int32 page tables (SMEM)
-    len_ref,  # [B] int32 kv lengths, incl. the token being decoded (SMEM)
+    len_ref,  # [B] int32 HISTORY lengths (tokens already in the cache)
     # inputs
-    q_ref,  # [1, 1, G, D] VMEM block (this cell's q-head group, pre-scaled)
-    k_ref,  # [L, Hkv, P, S, D] in HBM/ANY — the full stacked cache
-    v_ref,  # [L, Hkv, P, S, D] in HBM/ANY
-    # output
-    o_ref,  # [1, 1, G, D] VMEM block
+    q_ref,  # [1, HQ, D] VMEM block (this sequence's queries, unscaled)
+    k_ref,  # [L, P, S, Hkv, D] in HBM/ANY
+    v_ref,  # [L, P, S, Hkv, D] in HBM/ANY
+    # outputs
+    acc_ref,  # [1, HQ, D] f32 — UNNORMALIZED flash accumulator
+    m_ref,  # [1, HQ, 128] f32 — running max (lane-broadcast)
+    l_ref,  # [1, HQ, 128] f32 — running denominator (lane-broadcast)
     # scratch
-    k_scr,  # [2, S, D] VMEM
-    v_scr,  # [2, S, D] VMEM
+    k_scr,  # [2, S, Hkv, D] VMEM
+    v_scr,  # [2, S, Hkv, D] VMEM
     sem,  # [2, 2] DMA semaphores: [k|v, slot]
     *,
     page_size: int,
     scale_dim: int,
+    num_kv_heads: int,
 ):
     b = pl.program_id(0)
-    h = pl.program_id(1)
     li = layer_ref[0]
-    g, d = q_ref.shape[2], q_ref.shape[3]
+    hq, d = q_ref.shape[1], q_ref.shape[2]
+    g = hq // num_kv_heads
     s = page_size
-    seq_len = len_ref[b]
-    used = pl.cdiv(seq_len, s)  # pages this sequence actually occupies
+    hist = len_ref[b]
+    used = pl.cdiv(hist, s)  # pages the history actually occupies
 
     def k_copy(slot, i):
         return pltpu.make_async_copy(
-            k_ref.at[li, h, pt_ref[b, i]], k_scr.at[slot], sem.at[0, slot]
+            k_ref.at[li, pt_ref[b, i]], k_scr.at[slot], sem.at[0, slot]
         )
 
     def v_copy(slot, i):
         return pltpu.make_async_copy(
-            v_ref.at[li, h, pt_ref[b, i]], v_scr.at[slot], sem.at[1, slot]
+            v_ref.at[li, pt_ref[b, i]], v_scr.at[slot], sem.at[1, slot]
         )
 
-    # Warm up the pipeline (seq_len >= 1 always: the decoded token itself).
-    k_copy(0, 0).start()
-    v_copy(0, 0).start()
+    @pl.when(used > 0)
+    def _():
+        k_copy(0, 0).start()
+        v_copy(0, 0).start()
 
     # Scale after the f32 cast so bf16 q matches the XLA path bit-for-bit.
     # scale_dim is the model's true head_dim — d may be lane-padded.
-    q = q_ref[0, 0].astype(jnp.float32) * (1.0 / math.sqrt(scale_dim))  # [G, D]
+    q = q_ref[0].astype(jnp.float32) * (1.0 / math.sqrt(scale_dim))  # [HQ, D]
 
     def body(i, carry):
-        m, l, acc = carry
+        ms, ls, accs = carry  # per-head tuples: [G,1], [G,1], [G,D]
         slot = jax.lax.rem(i, 2)
 
         @pl.when(i + 1 < used)
@@ -89,89 +99,112 @@ def _decode_kernel(
         k_copy(slot, i).wait()
         v_copy(slot, i).wait()
 
-        k = k_scr[slot].astype(jnp.float32)  # [S, D]
-        scores = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        )  # [G, S]
+        kp = k_scr[slot].astype(jnp.float32)  # [S, Hkv, D]
+        vp = v_scr[slot].astype(jnp.float32)
         key_pos = i * s + jax.lax.broadcasted_iota(jnp.int32, (g, s), 1)
-        scores = jnp.where(key_pos < seq_len, scores, -1e30)
+        key_mask = key_pos < hist  # [G, S]
 
-        m_new = jnp.maximum(m, jnp.max(scores, axis=1, keepdims=True))
-        p = jnp.exp(scores - m_new)  # [G, S]
-        corr = jnp.exp(m - m_new)  # [G, 1]
-        l_new = l * corr + jnp.sum(p, axis=1, keepdims=True)
-        v = v_scr[slot].astype(jnp.float32)  # [S, D]
-        acc_new = acc * corr + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
-        )
-        return m_new, l_new, acc_new
+        # One DMA fed all heads; the per-head dots are small but the page
+        # walk is DMA-bound, so their latency hides under the next copy.
+        m_out, l_out, a_out = [], [], []
+        for h in range(num_kv_heads):  # static unroll
+            qh = q[h * g : (h + 1) * g]  # [G, D]
+            scores = jax.lax.dot_general(
+                qh, kp[:, h], (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # [G, S]
+            scores = jnp.where(key_mask, scores, -1e30)
+            m_new = jnp.maximum(ms[h], jnp.max(scores, axis=1, keepdims=True))
+            p = jnp.exp(scores - m_new)
+            corr = jnp.exp(ms[h] - m_new)
+            l_new = ls[h] * corr + jnp.sum(p, axis=1, keepdims=True)
+            a_new = accs[h] * corr + jax.lax.dot_general(
+                p, vp[:, h], (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            m_out.append(m_new)
+            l_out.append(l_new)
+            a_out.append(a_new)
+        return tuple(m_out), tuple(l_out), tuple(a_out)
 
-    m0 = jnp.full((g, 1), -jnp.inf, jnp.float32)
-    l0 = jnp.zeros((g, 1), jnp.float32)
-    a0 = jnp.zeros((g, d), jnp.float32)
-    _, l, acc = jax.lax.fori_loop(0, used, body, (m0, l0, a0))
-    o_ref[0, 0] = (acc / l).astype(o_ref.dtype)
+    init = (
+        tuple(
+            jnp.full((g, 1), -jnp.inf, jnp.float32)
+            for _ in range(num_kv_heads)
+        ),
+        tuple(jnp.zeros((g, 1), jnp.float32) for _ in range(num_kv_heads)),
+        tuple(jnp.zeros((g, d), jnp.float32) for _ in range(num_kv_heads)),
+    )
+    ms, ls, accs = jax.lax.fori_loop(0, used, body, init)
+    acc_ref[0] = jnp.concatenate(accs, axis=0)
+    m_ref[0] = jnp.broadcast_to(jnp.concatenate(ms, axis=0), (hq, 128))
+    l_ref[0] = jnp.broadcast_to(jnp.concatenate(ls, axis=0), (hq, 128))
 
 
 def paged_decode_attention(
-    q: jax.Array,  # [B, Hq, D] post-rope decode queries
-    k_cache: jax.Array,  # [L, Hkv, P, S, D] — full stacked cache
-    v_cache: jax.Array,  # [L, Hkv, P, S, D]
+    q: jax.Array,  # [B, Hq, D] post-rope decode queries (D may be padded)
+    k_cache: jax.Array,  # [L, P, S, Hkv, D] — full stacked cache
+    v_cache: jax.Array,  # [L, P, S, Hkv, D]
     layer: jax.Array,  # scalar int32 layer index
     page_tables: jax.Array,  # [B, MP] int32
-    seq_lens: jax.Array,  # [B] int32 — kv length incl. the decoded token
+    history_lens: jax.Array,  # [B] int32 — tokens already written to pages
     *,
     scale_dim: int | None = None,
     interpret: bool | None = None,
-) -> jax.Array:
-    """Returns [B, Hq*D] attention output, matching the XLA paged path.
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """History-only flash attention over the paged cache.
 
-    Takes the full layer-stacked cache plus a (traced) layer index so the
-    layer scan can carry the cache without slicing it — a dynamic slice of
-    one layer would materialize a copy per layer per step; the kernel
-    instead offsets its page DMAs by the prefetched index.
+    Returns (acc [B, Hq, D] f32 unnormalized, m [B, Hq] f32, l [B, Hq] f32)
+    for the caller to merge the current token (see module docstring).
+    A sequence with history_lens == 0 yields acc=0, l=0, m=-inf — the merge
+    then reduces to pure self-attention.
 
-    `scale_dim` is the softmax scale's head_dim — pass the model's true
-    head_dim when q/k/v are lane-padded to a 128 multiple (cfg.kv_head_dim).
     `interpret` defaults to True off-TPU so tests run the same kernel on CPU.
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     b, hq, d = q.shape
-    hkv, s = k_cache.shape[1], k_cache.shape[3]
-    g = hq // hkv
-    qr = q.reshape(b, hkv, g, d)
+    hkv, s = k_cache.shape[3], k_cache.shape[2]
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
-        grid=(b, hkv),
+        grid=(b,),
         in_specs=[
-            pl.BlockSpec(
-                (1, 1, g, d), lambda bi, hi, li, pt, ln: (bi, hi, 0, 0)
-            ),
+            pl.BlockSpec((1, hq, d), lambda bi, li, pt, ln: (bi, 0, 0)),
             pl.BlockSpec(memory_space=pl.ANY),
             pl.BlockSpec(memory_space=pl.ANY),
         ],
-        out_specs=pl.BlockSpec(
-            (1, 1, g, d), lambda bi, hi, li, pt, ln: (bi, hi, 0, 0)
-        ),
+        out_specs=[
+            pl.BlockSpec((1, hq, d), lambda bi, li, pt, ln: (bi, 0, 0)),
+            pl.BlockSpec((1, hq, 128), lambda bi, li, pt, ln: (bi, 0, 0)),
+            pl.BlockSpec((1, hq, 128), lambda bi, li, pt, ln: (bi, 0, 0)),
+        ],
         scratch_shapes=[
-            pltpu.VMEM((2, s, d), k_cache.dtype),
-            pltpu.VMEM((2, s, d), v_cache.dtype),
+            pltpu.VMEM((2, s, hkv, d), k_cache.dtype),
+            pltpu.VMEM((2, s, hkv, d), v_cache.dtype),
             pltpu.SemaphoreType.DMA((2, 2)),
         ],
     )
-    out = pl.pallas_call(
+    acc, m, l = pl.pallas_call(
         functools.partial(
-            _decode_kernel, page_size=s, scale_dim=scale_dim or d
+            _decode_kernel,
+            page_size=s,
+            scale_dim=scale_dim or d,
+            num_kv_heads=hkv,
         ),
-        out_shape=jax.ShapeDtypeStruct((b, hkv, g, d), q.dtype),
+        out_shape=[
+            jax.ShapeDtypeStruct((b, hq, d), jnp.float32),
+            jax.ShapeDtypeStruct((b, hq, 128), jnp.float32),
+            jax.ShapeDtypeStruct((b, hq, 128), jnp.float32),
+        ],
         grid_spec=grid_spec,
         interpret=interpret,
     )(
         jnp.asarray(layer, jnp.int32).reshape(1),
         page_tables.astype(jnp.int32),
-        seq_lens.astype(jnp.int32),
-        qr, k_cache, v_cache,
+        history_lens.astype(jnp.int32),
+        q,
+        k_cache,
+        v_cache,
     )
-    return out.reshape(b, hq * d)
+    return acc, m[:, :, 0], l[:, :, 0]

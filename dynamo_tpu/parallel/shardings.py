@@ -52,8 +52,8 @@ def llama_param_specs(cfg: LlamaConfig) -> dict:
 
 
 def kv_cache_spec() -> P:
-    # [L, Hkv, P, S, D] — kv heads ride with their tp shard.
-    return P(None, "tp", None, None, None)
+    # [L, P, S, Hkv, D] — kv heads ride with their tp shard.
+    return P(None, None, None, "tp", None)
 
 
 def batch_spec(ndim: int) -> P:
