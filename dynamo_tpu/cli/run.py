@@ -26,7 +26,7 @@ from dynamo_tpu.logging_config import configure_logging
 logger = logging.getLogger(__name__)
 
 
-def _engine_config(args) -> EngineConfig:
+def _engine_config(args, eos_token_ids: tuple = ()) -> EngineConfig:
     return EngineConfig(
         model=args.model,
         num_pages=args.num_pages,
@@ -37,7 +37,7 @@ def _engine_config(args) -> EngineConfig:
         dtype=args.dtype,
         dp=args.dp,
         tp=args.tp,
-        eos_token_ids=(0,),
+        eos_token_ids=tuple(eos_token_ids) or (0,),
     )
 
 
@@ -50,16 +50,36 @@ def _disagg_config(args):
 
 
 def _card(args):
+    import os
+
     from dynamo_tpu.model_card import ModelDeploymentCard
 
     tokenizer = {"kind": "byte"}
+    context_length = args.max_context
+    eos: tuple[int, ...] = ()
     if args.tokenizer:
         tokenizer = {"kind": "hf", "path": args.tokenizer}
+    elif args.model.endswith(".gguf") and os.path.isfile(args.model):
+        # Serve the model's own embedded vocabulary + limits.
+        from dynamo_tpu.gguf import read_gguf
+
+        g = read_gguf(args.model)
+        if g.tokenizer_vocab() is not None:
+            tokenizer = {"kind": "gguf", "path": args.model}
+            eos_id = g.tokenizer_vocab().get("eos_token_id")
+            if eos_id is not None:
+                eos = (int(eos_id),)
+        context_length = min(context_length, g.context_length())
+    elif os.path.isdir(args.model) and os.path.exists(
+        os.path.join(args.model, "tokenizer_config.json")
+    ):
+        tokenizer = {"kind": "hf", "path": args.model}
     return ModelDeploymentCard(
         name=args.model,
         tokenizer=tokenizer,
-        context_length=args.max_context,
+        context_length=context_length,
         kv_page_size=args.page_size,
+        **({"eos_token_ids": eos} if eos else {}),
     )
 
 
@@ -75,7 +95,10 @@ async def _make_local_pipeline(args):
         from dynamo_tpu.mocker import MockEngine
 
         return local_pipeline(card, MockEngine()), None
-    engine = JaxEngine(_engine_config(args), checkpoint_path=args.checkpoint)
+    engine = JaxEngine(
+        _engine_config(args, card.eos_token_ids),
+        checkpoint_path=args.checkpoint,
+    )
     runner = AsyncEngineRunner(engine)
     runner.start()
     return local_pipeline(card, runner), runner
@@ -190,7 +213,11 @@ async def _run_worker(args) -> None:
     worker = Worker(
         rt,
         _card(args),
-        engine_config=_engine_config(args) if args.out == "jax" else None,
+        engine_config=(
+            _engine_config(args, _card(args).eos_token_ids)
+            if args.out == "jax"
+            else None
+        ),
         engine_kind=args.out,
         namespace=args.namespace,
         component=args.component,

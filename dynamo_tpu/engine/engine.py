@@ -122,6 +122,7 @@ class JaxEngine:
         self.mesh = make_mesh(mc) if mc.num_devices > 1 else None
 
         if params is None:
+            checkpoint_path = checkpoint_path or self.adapter.default_checkpoint
             if checkpoint_path is not None and self.adapter.load_params:
                 params = self.adapter.load_params(checkpoint_path)
             else:

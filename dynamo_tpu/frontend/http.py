@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import asyncio
 import contextlib
+import json
 import logging
 import time
 from typing import Optional
@@ -32,8 +33,14 @@ from dynamo_tpu.protocols.openai import (
     EmbeddingRequest,
     ModelInfo,
     ModelList,
+    ResponseOutputMessage,
+    ResponseOutputText,
+    ResponsesRequest,
+    ResponsesResponse,
+    ResponsesUsage,
     SSE_DONE,
     aggregate_chat_stream,
+    new_request_id,
     now,
     sse_event,
 )
@@ -60,6 +67,7 @@ class HttpService:
                 web.post("/v1/chat/completions", self.chat_completions),
                 web.post("/v1/completions", self.completions),
                 web.post("/v1/embeddings", self.embeddings),
+                web.post("/v1/responses", self.responses),
                 web.get("/v1/models", self.models),
                 web.get("/health", self.health),
                 web.get("/live", self.health),
@@ -145,6 +153,149 @@ class HttpService:
             input_tokens=resp.usage.prompt_tokens,
         )
         return web.json_response(resp.model_dump())
+
+    async def responses(self, request: web.Request) -> web.StreamResponse:
+        """OpenAI Responses API over the chat pipeline (reference serves
+        /v1/responses alongside chat — http/service/openai.rs)."""
+        t0 = time.time()
+        try:
+            body = await request.json()
+            req = ResponsesRequest.model_validate(body)
+        except Exception as e:
+            return web.json_response(
+                {"error": f"invalid request: {e}"}, status=400
+            )
+        pipeline = self.manager.get(req.model)
+        if pipeline is None:
+            self.metrics.request_done(
+                req.model, "responses", "404", time.time() - t0
+            )
+            return web.json_response(
+                {"error": f"model {req.model!r} not found"}, status=404
+            )
+        ctx = Context()
+        rid = new_request_id("resp")
+        with self.metrics.inflight_guard(req.model):
+            try:
+                chunk_stream = pipeline.responses_stream(req, ctx)
+                if req.stream:
+                    return await self._responses_stream(
+                        request, req, rid, chunk_stream, ctx, t0
+                    )
+                chunks = [c async for c in chunk_stream]
+            except ValueError as e:
+                self.metrics.request_done(
+                    req.model, "responses", "400", time.time() - t0
+                )
+                return web.json_response({"error": str(e)}, status=400)
+            except Exception as e:
+                logger.exception("responses request failed")
+                ctx.cancel()
+                self.metrics.request_done(
+                    req.model, "responses", "500", time.time() - t0
+                )
+                return web.json_response({"error": str(e)}, status=500)
+        agg = aggregate_chat_stream(chunks, req.model, rid)
+        usage = agg.usage
+        resp = self._make_responses_body(req, rid, agg)
+        self.metrics.request_done(
+            req.model, "responses", "200", time.time() - t0,
+            input_tokens=usage.prompt_tokens if usage else 0,
+            output_tokens=usage.completion_tokens if usage else 0,
+        )
+        return web.json_response(resp.model_dump())
+
+    @staticmethod
+    def _make_responses_body(req, rid: str, agg) -> ResponsesResponse:
+        usage = agg.usage
+        text = agg.choices[0].message.content or "" if agg.choices else ""
+        return ResponsesResponse(
+            id=rid,
+            created_at=now(),
+            model=req.model,
+            status="completed",
+            output=[
+                ResponseOutputMessage(
+                    id=rid + "-msg0",
+                    content=[ResponseOutputText(text=text)],
+                )
+            ],
+            usage=ResponsesUsage(
+                input_tokens=usage.prompt_tokens if usage else 0,
+                output_tokens=usage.completion_tokens if usage else 0,
+                total_tokens=usage.total_tokens if usage else 0,
+            ),
+        )
+
+    async def _responses_stream(
+        self, http_request, req, rid: str, chunk_stream, ctx: Context,
+        t0: float,
+    ) -> web.StreamResponse:
+        """Responses streaming: typed SSE events (response.created,
+        response.output_text.delta, response.completed)."""
+        resp = web.StreamResponse(
+            status=200,
+            headers={
+                "Content-Type": "text/event-stream",
+                "Cache-Control": "no-cache",
+            },
+        )
+        await resp.prepare(http_request)
+
+        async def emit(event: str, data: dict) -> None:
+            body = json.dumps({"type": event, **data})
+            await resp.write(
+                f"event: {event}\ndata: {body}\n\n".encode()
+            )
+
+        await emit(
+            "response.created",
+            {"response": {"id": rid, "object": "response",
+                          "status": "in_progress", "model": req.model}},
+        )
+        chunks = []
+        status = "200"
+        ntokens = 0
+        try:
+            async for chunk in chunk_stream:
+                chunks.append(chunk)
+                for c in chunk.choices:
+                    if c.delta.content:
+                        ntokens += 1
+                        await emit(
+                            "response.output_text.delta",
+                            {"item_id": rid + "-msg0", "output_index": 0,
+                             "delta": c.delta.content},
+                        )
+            agg = aggregate_chat_stream(chunks, req.model, rid)
+            await emit(
+                "response.completed",
+                {"response": self._make_responses_body(req, rid, agg).model_dump()},
+            )
+        except (ConnectionResetError, asyncio.CancelledError):
+            ctx.cancel()
+            status = "499"
+        except Exception as e:  # the stream is already prepared: emit a
+            # typed failure event instead of letting the error escape to a
+            # JSON handler (and double-count the request)
+            logger.exception("responses stream failed")
+            ctx.cancel()
+            status = "500"
+            with contextlib.suppress(Exception):
+                await emit(
+                    "response.failed",
+                    {"response": {"id": rid, "object": "response",
+                                  "status": "failed",
+                                  "error": {"message": str(e)}}},
+                )
+        finally:
+            self.metrics.request_done(
+                req.model, "responses", status, time.time() - t0,
+                output_tokens=ntokens,
+            )
+        with contextlib.suppress(Exception):
+            await resp.write_eof()
+        return resp
 
     async def chat_completions(self, request: web.Request) -> web.StreamResponse:
         return await self._serve(request, kind="chat")
@@ -252,6 +403,13 @@ class HttpService:
             # client went away: cancel into the engine (disconnect monitor)
             ctx.cancel()
             status = "499"
+        except Exception as e:  # prepared stream: error rides the SSE
+            logger.exception("chat stream failed")
+            ctx.cancel()
+            status = "500"
+            with contextlib.suppress(Exception):
+                await resp.write(sse_event({"error": {"message": str(e)}}))
+                await resp.write(SSE_DONE)
         finally:
             self.metrics.request_done(
                 req.model, kind, status, time.time() - t0,

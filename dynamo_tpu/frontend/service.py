@@ -27,6 +27,8 @@ from dynamo_tpu.protocols.openai import (
     EmbeddingData,
     EmbeddingRequest,
     EmbeddingResponse,
+    ResponsesRequest,
+    StreamOptions,
     Usage,
 )
 from dynamo_tpu.runtime.context import Context
@@ -81,6 +83,25 @@ class ModelPipeline:
             stream, pre.request_id, pre, include_usage=include_usage
         ):
             yield chunk
+
+    def responses_stream(
+        self, request: ResponsesRequest, context: Optional[Context] = None
+    ) -> AsyncIterator[ChatCompletionChunk]:
+        """Responses API rides the chat pipeline: input messages map onto a
+        chat request (instructions -> system) and the caller shapes the
+        chunk stream into Responses objects/events."""
+        chat = ChatCompletionRequest(
+            model=request.model,
+            messages=request.as_chat_messages(),
+            max_tokens=request.max_output_tokens,
+            temperature=request.temperature,
+            top_p=request.top_p,
+            stream=request.stream,
+            stream_options=StreamOptions(include_usage=True),
+            ext=request.ext,
+            nvext=request.nvext,
+        )
+        return self.chat_stream(chat, context)
 
     async def embed(self, request: EmbeddingRequest) -> EmbeddingResponse:
         """OpenAI embeddings over this model (reference: embeddings route,

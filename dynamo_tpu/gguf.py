@@ -1,0 +1,327 @@
+"""GGUF model-file reader.
+
+Parses GGUF v2/v3 containers: header, typed metadata KV pairs, the tensor
+index, and (for unquantized types) tensor data as numpy arrays. Extracts
+the embedded tokenizer vocabulary and maps `llama.*` metadata onto
+LlamaConfig so a .gguf file can be served directly.
+
+Parity: the reference's GGUF support (lib/llm/src/gguf/{content,
+gguf_metadata,gguf_tokenizer}.rs — metadata + tokenizer for model cards
+and the mistralrs engine). This implementation additionally loads
+unquantized tensor data for the JAX engine; k-quant blocks are indexed
+but not dequantized (ValueError on load).
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass, field
+from typing import Any, BinaryIO, Optional
+
+import numpy as np
+
+GGUF_MAGIC = 0x46554747  # "GGUF" little-endian
+
+# metadata value types
+_U8, _I8, _U16, _I16, _U32, _I32, _F32, _BOOL, _STR, _ARR, _U64, _I64, _F64 = range(13)
+
+_SCALAR_FMT = {
+    _U8: "<B", _I8: "<b", _U16: "<H", _I16: "<h", _U32: "<I", _I32: "<i",
+    _F32: "<f", _U64: "<Q", _I64: "<q", _F64: "<d",
+}
+
+#: ggml tensor types we can materialize (id -> (numpy dtype, bytes/elt))
+_TENSOR_DTYPES = {
+    0: ("float32", 4),  # F32
+    1: ("float16", 2),  # F16
+    30: ("bfloat16", 2),  # BF16
+}
+
+#: ggml type id -> name, for error messages / inventories
+GGML_TYPE_NAMES = {
+    0: "F32", 1: "F16", 2: "Q4_0", 3: "Q4_1", 6: "Q5_0", 7: "Q5_1",
+    8: "Q8_0", 9: "Q8_1", 10: "Q2_K", 11: "Q3_K", 12: "Q4_K", 13: "Q5_K",
+    14: "Q6_K", 15: "Q8_K", 16: "IQ2_XXS", 24: "I8", 25: "I16", 26: "I32",
+    27: "I64", 28: "F64", 30: "BF16",
+}
+
+
+@dataclass
+class GgufTensorInfo:
+    name: str
+    shape: tuple[int, ...]  # row-major (numpy) order
+    ggml_type: int
+    offset: int  # relative to the data section
+
+    @property
+    def type_name(self) -> str:
+        return GGML_TYPE_NAMES.get(self.ggml_type, f"type{self.ggml_type}")
+
+
+@dataclass
+class GgufFile:
+    path: str
+    version: int
+    metadata: dict[str, Any]
+    tensors: dict[str, GgufTensorInfo]
+    data_start: int = 0
+    alignment: int = 32
+
+    # -- tensor data -------------------------------------------------------
+
+    def load_tensor(self, name: str) -> np.ndarray:
+        info = self.tensors.get(name)
+        if info is None:
+            raise KeyError(f"no tensor {name!r} in {self.path}")
+        if info.ggml_type not in _TENSOR_DTYPES:
+            raise ValueError(
+                f"tensor {name!r} has quantized/unsupported ggml type "
+                f"{info.type_name}; only F32/F16/BF16 load as arrays"
+            )
+        dtype_name, elt = _TENSOR_DTYPES[info.ggml_type]
+        count = int(np.prod(info.shape)) if info.shape else 1
+        with open(self.path, "rb") as f:
+            f.seek(self.data_start + info.offset)
+            raw = f.read(count * elt)
+        if len(raw) != count * elt:
+            raise ValueError(f"tensor {name!r} data truncated")
+        if dtype_name == "bfloat16":
+            # numpy has no bf16: widen via the upper half of f32 bits
+            u16 = np.frombuffer(raw, np.uint16)
+            arr = (u16.astype(np.uint32) << 16).view(np.float32)
+        else:
+            arr = np.frombuffer(raw, dtype_name)
+        return arr.reshape(info.shape)
+
+    # -- tokenizer ---------------------------------------------------------
+
+    def tokenizer_vocab(self) -> Optional[dict]:
+        """Embedded tokenizer: model kind, token strings, scores, merge
+        rules, special ids (tokenizer.ggml.* keys — gguf_tokenizer.rs)."""
+        tokens = self.metadata.get("tokenizer.ggml.tokens")
+        if tokens is None:
+            return None
+        return {
+            "model": self.metadata.get("tokenizer.ggml.model", "llama"),
+            "tokens": tokens,
+            "scores": self.metadata.get("tokenizer.ggml.scores"),
+            "token_types": self.metadata.get("tokenizer.ggml.token_type"),
+            "merges": self.metadata.get("tokenizer.ggml.merges"),
+            "bos_token_id": self.metadata.get("tokenizer.ggml.bos_token_id"),
+            "eos_token_id": self.metadata.get("tokenizer.ggml.eos_token_id"),
+            "chat_template": self.metadata.get("tokenizer.chat_template"),
+        }
+
+    # -- model config ------------------------------------------------------
+
+    def architecture(self) -> str:
+        return self.metadata.get("general.architecture", "llama")
+
+    def to_llama_config(self):
+        """Map llama.* metadata onto LlamaConfig (serving config parity
+        with content.rs::to_llama_config)."""
+        from dynamo_tpu.models.llama import LlamaConfig
+
+        arch = self.architecture()
+        md = self.metadata
+
+        def key(suffix, default=None):
+            return md.get(f"{arch}.{suffix}", default)
+
+        n_heads = int(key("attention.head_count", 32))
+        embed = int(key("embedding_length", 4096))
+        head_dim = int(key("attention.key_length", embed // n_heads))
+        vocab = md.get("tokenizer.ggml.tokens")
+        vocab_size = int(
+            key("vocab_size", len(vocab) if vocab else 32000)
+        )
+        rope_scale = key("rope.scaling.factor")
+        return LlamaConfig(
+            vocab_size=vocab_size,
+            hidden_size=embed,
+            intermediate_size=int(key("feed_forward_length", 4 * embed)),
+            num_layers=int(key("block_count", 32)),
+            num_heads=n_heads,
+            num_kv_heads=int(key("attention.head_count_kv", n_heads)),
+            head_dim=head_dim,
+            rope_theta=float(key("rope.freq_base", 10000.0)),
+            rms_norm_eps=float(
+                key("attention.layer_norm_rms_epsilon", 1e-5)
+            ),
+            rope_scaling_factor=(
+                float(rope_scale) if rope_scale is not None else None
+            ),
+        )
+
+    def context_length(self) -> int:
+        return int(
+            self.metadata.get(f"{self.architecture()}.context_length", 4096)
+        )
+
+
+# -- parsing ----------------------------------------------------------------
+
+
+def _read(f: BinaryIO, fmt: str):
+    size = struct.calcsize(fmt)
+    data = f.read(size)
+    if len(data) != size:
+        raise ValueError("unexpected end of GGUF file")
+    return struct.unpack(fmt, data)[0]
+
+
+def _read_string(f: BinaryIO) -> str:
+    n = _read(f, "<Q")
+    return f.read(n).decode("utf-8", errors="replace")
+
+
+def _read_value(f: BinaryIO, vtype: int):
+    if vtype in _SCALAR_FMT:
+        return _read(f, _SCALAR_FMT[vtype])
+    if vtype == _BOOL:
+        return bool(_read(f, "<B"))
+    if vtype == _STR:
+        return _read_string(f)
+    if vtype == _ARR:
+        elem_type = _read(f, "<I")
+        count = _read(f, "<Q")
+        return [_read_value(f, elem_type) for _ in range(count)]
+    raise ValueError(f"unknown GGUF metadata value type {vtype}")
+
+
+#: (abspath, mtime_ns, size) -> parsed file; serving one model touches the
+#: metadata three times (config, tokenizer, weights) — parse once.
+_PARSE_CACHE: dict[tuple, "GgufFile"] = {}
+
+
+def read_gguf(path: str, use_cache: bool = True) -> GgufFile:
+    """Parse header, metadata, and the tensor index (no tensor data)."""
+    import os
+
+    if use_cache:
+        st = os.stat(path)
+        key = (os.path.abspath(path), st.st_mtime_ns, st.st_size)
+        hit = _PARSE_CACHE.get(key)
+        if hit is not None:
+            return hit
+    parsed = _read_gguf_impl(path)
+    if use_cache:
+        _PARSE_CACHE.clear()  # hold at most one file — they can be large
+        _PARSE_CACHE[key] = parsed
+    return parsed
+
+
+def _read_gguf_impl(path: str) -> GgufFile:
+    with open(path, "rb") as f:
+        magic = _read(f, "<I")
+        if magic != GGUF_MAGIC:
+            raise ValueError(f"{path}: not a GGUF file (magic {magic:#x})")
+        version = _read(f, "<I")
+        if version not in (2, 3):
+            raise ValueError(f"{path}: unsupported GGUF version {version}")
+        n_tensors = _read(f, "<Q")
+        n_kv = _read(f, "<Q")
+        metadata: dict[str, Any] = {}
+        for _ in range(n_kv):
+            key = _read_string(f)
+            vtype = _read(f, "<I")
+            metadata[key] = _read_value(f, vtype)
+        tensors: dict[str, GgufTensorInfo] = {}
+        for _ in range(n_tensors):
+            name = _read_string(f)
+            n_dims = _read(f, "<I")
+            # GGUF stores dims innermost-first; reverse for numpy order.
+            dims = tuple(_read(f, "<Q") for _ in range(n_dims))[::-1]
+            ggml_type = _read(f, "<I")
+            offset = _read(f, "<Q")
+            tensors[name] = GgufTensorInfo(
+                name=name, shape=dims, ggml_type=ggml_type, offset=offset
+            )
+        alignment = int(metadata.get("general.alignment", 32))
+        pos = f.tell()
+        data_start = (pos + alignment - 1) // alignment * alignment
+    return GgufFile(
+        path=path,
+        version=version,
+        metadata=metadata,
+        tensors=tensors,
+        data_start=data_start,
+        alignment=alignment,
+    )
+
+
+# -- writing (tests / tooling) ----------------------------------------------
+
+
+def write_gguf(
+    path: str,
+    metadata: dict[str, Any],
+    tensors: dict[str, np.ndarray],
+    alignment: int = 32,
+) -> None:
+    """Minimal GGUF v3 writer for fixtures and export tooling (F32/F16
+    tensors only)."""
+
+    def w_string(f, s: str):
+        b = s.encode()
+        f.write(struct.pack("<Q", len(b)))
+        f.write(b)
+
+    def value_type(v) -> int:
+        if isinstance(v, bool):
+            return _BOOL
+        if isinstance(v, int):
+            return _I64 if v < 0 else _U64
+        if isinstance(v, float):
+            return _F64
+        if isinstance(v, str):
+            return _STR
+        if isinstance(v, (list, tuple)):
+            return _ARR
+        raise TypeError(f"unsupported metadata value {type(v)}")
+
+    def w_value(f, v, vtype: int):
+        if vtype == _BOOL:
+            f.write(struct.pack("<B", int(v)))
+        elif vtype in _SCALAR_FMT:
+            f.write(struct.pack(_SCALAR_FMT[vtype], v))
+        elif vtype == _STR:
+            w_string(f, v)
+        elif vtype == _ARR:
+            et = value_type(v[0]) if v else _U64
+            f.write(struct.pack("<IQ", et, len(v)))
+            for item in v:
+                w_value(f, item, et)
+
+    with open(path, "wb") as f:
+        f.write(struct.pack("<IIQQ", GGUF_MAGIC, 3, len(tensors), len(metadata)))
+        for k, v in metadata.items():
+            w_string(f, k)
+            vt = value_type(v)
+            f.write(struct.pack("<I", vt))
+            w_value(f, v, vt)
+        offset = 0
+        blobs = []
+        for name, arr in tensors.items():
+            if arr.dtype == np.float32:
+                gt = 0
+            elif arr.dtype == np.float16:
+                gt = 1
+            else:
+                raise TypeError(f"write_gguf supports f32/f16, got {arr.dtype}")
+            w_string(f, name)
+            dims = arr.shape[::-1]  # innermost-first on disk
+            f.write(struct.pack("<I", len(dims)))
+            for d in dims:
+                f.write(struct.pack("<Q", d))
+            f.write(struct.pack("<IQ", gt, offset))
+            blob = np.ascontiguousarray(arr).tobytes()
+            blobs.append((offset, blob))
+            offset += (len(blob) + alignment - 1) // alignment * alignment
+        pos = f.tell()
+        pad = (pos + alignment - 1) // alignment * alignment - pos
+        f.write(b"\x00" * pad)
+        data_start = f.tell()
+        for off, blob in blobs:
+            f.seek(data_start + off)
+            f.write(blob)

@@ -286,6 +286,72 @@ def params_from_torch_state_dict(state_dict, cfg: LlamaConfig) -> dict:
     return params
 
 
+def params_from_gguf(gguf_file, cfg: LlamaConfig) -> dict:
+    """Load unquantized GGUF tensors into our layer-stacked pytree.
+
+    GGUF (llama.cpp) names: token_embd, blk.{l}.{attn_norm, attn_q, attn_k,
+    attn_v, attn_output, ffn_norm, ffn_gate, ffn_up, ffn_down},
+    output_norm, output. Projections stored [out, in] -> transposed to
+    [in, out] like params_from_torch_state_dict.
+
+    llama-arch GGUFs carry q/k projections in llama.cpp's interleaved rope
+    row order (the HF->GGUF converter permutes them); apply_rope here uses
+    the HF half-split pairing, so those rows are permuted back on load.
+    qwen2-arch GGUFs are not permuted by the converter.
+    """
+    import numpy as np
+
+    g = gguf_file
+    L = cfg.num_layers
+    permute_qk = g.architecture() == "llama"
+
+    def unpermute_rows(w: np.ndarray, n_head: int) -> np.ndarray:
+        # inverse of convert_hf_to_gguf's permute():
+        #   reshape(h, 2, d/2, in).swapaxes(1, 2)
+        out, inn = w.shape
+        d = out // n_head
+        return (
+            w.reshape(n_head, d // 2, 2, inn)
+            .swapaxes(1, 2)
+            .reshape(out, inn)
+        )
+
+    def t(name, transpose=True, rope_heads: Optional[int] = None):
+        w = np.asarray(g.load_tensor(name), np.float32)
+        if rope_heads is not None and permute_qk:
+            w = unpermute_rows(w, rope_heads)
+        return w.T if transpose else w
+
+    def stack(fmt, transpose=True, rope_heads: Optional[int] = None):
+        return jnp.asarray(
+            np.stack(
+                [t(fmt.format(l), transpose, rope_heads) for l in range(L)]
+            ),
+            cfg.dtype,
+        )
+
+    params = {
+        "embed": jnp.asarray(t("token_embd.weight", transpose=False), cfg.dtype),
+        "layers": {
+            "attn_norm": stack("blk.{}.attn_norm.weight", transpose=False),
+            "wq": stack("blk.{}.attn_q.weight", rope_heads=cfg.num_heads),
+            "wk": stack("blk.{}.attn_k.weight", rope_heads=cfg.num_kv_heads),
+            "wv": stack("blk.{}.attn_v.weight"),
+            "wo": stack("blk.{}.attn_output.weight"),
+            "mlp_norm": stack("blk.{}.ffn_norm.weight", transpose=False),
+            "w_gate": stack("blk.{}.ffn_gate.weight"),
+            "w_up": stack("blk.{}.ffn_up.weight"),
+            "w_down": stack("blk.{}.ffn_down.weight"),
+        },
+        "final_norm": jnp.asarray(
+            t("output_norm.weight", transpose=False), cfg.dtype
+        ),
+    }
+    if "output.weight" in g.tensors:
+        params["lm_head"] = jnp.asarray(t("output.weight"), cfg.dtype)
+    return params
+
+
 # ---------------------------------------------------------------------------
 # Building blocks
 # ---------------------------------------------------------------------------

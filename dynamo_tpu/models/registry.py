@@ -33,6 +33,10 @@ class ModelAdapter:
     param_specs: Callable[[], Any]
     kv_spec: Callable[[], Any]
     load_params: Optional[Callable[[str], Any]] = None  # from a checkpoint dir
+    #: where weights live when the model name itself identifies them
+    #: (an HF checkpoint dir or a .gguf file); engines load from here when
+    #: no explicit checkpoint_path is given
+    default_checkpoint: Optional[str] = None
 
 
 _LLAMA_PRESETS: dict[str, Callable[[], LlamaConfig]] = {
@@ -143,8 +147,20 @@ def get_model(
         "moe-tiny": MoeConfig.tiny,
     }
     moe_cfg = None
+    gguf_path = None
     if key in _LLAMA_PRESETS:
         cfg = _LLAMA_PRESETS[key]()
+    elif key.endswith(".gguf") and os.path.isfile(name):
+        from dynamo_tpu.gguf import read_gguf
+
+        g = read_gguf(name)
+        arch = g.architecture()
+        if arch not in ("llama", "qwen2"):
+            raise ValueError(
+                f"unsupported GGUF architecture {arch!r} for {name}"
+            )
+        cfg = g.to_llama_config()
+        gguf_path = name
     elif key in moe_presets:
         moe_cfg = moe_presets[key]()
     elif os.path.isdir(name) and os.path.exists(os.path.join(name, "config.json")):
@@ -171,12 +187,27 @@ def get_model(
                 moe_cfg,
                 base=replace(moe_cfg.base, attention_impl=attention_impl),
             )
-        return _moe_adapter(name, moe_cfg)
+        moe_adapter = _moe_adapter(name, moe_cfg)
+        if os.path.isdir(name):
+            moe_adapter = replace(moe_adapter, default_checkpoint=name)
+        return moe_adapter
     if dtype is not None:
         cfg = _with_dtype(cfg, dtype)
     if attention_impl is not None:
         cfg = replace(cfg, attention_impl=attention_impl)
-    return _llama_adapter(name, cfg)
+    adapter = _llama_adapter(name, cfg)
+    if gguf_path is not None:
+        from dynamo_tpu.gguf import read_gguf
+
+        def load_from_gguf(path=gguf_path, cfg=cfg):
+            return llama_mod.params_from_gguf(read_gguf(path), cfg)
+
+        adapter = replace(
+            adapter, load_params=load_from_gguf, default_checkpoint=gguf_path
+        )
+    elif os.path.isdir(name):
+        adapter = replace(adapter, default_checkpoint=name)
+    return adapter
 
 
 def _with_dtype(cfg: LlamaConfig, dtype) -> LlamaConfig:

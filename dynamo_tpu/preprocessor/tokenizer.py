@@ -87,8 +87,129 @@ class HfTokenizer:
             return render_fallback_template(messages)
 
 
+def _gpt2_byte_table() -> dict[int, str]:
+    """GPT-2's printable byte<->unicode map (byte-level BPE vocabs store
+    pieces in this alphabet)."""
+    bs = (
+        list(range(ord("!"), ord("~") + 1))
+        + list(range(ord("¡"), ord("¬") + 1))
+        + list(range(ord("®"), ord("ÿ") + 1))
+    )
+    cs = list(bs)
+    n = 0
+    for b in range(256):
+        if b not in bs:
+            bs.append(b)
+            cs.append(256 + n)
+            n += 1
+    return {b: chr(c) for b, c in zip(bs, cs)}
+
+
+class GgufTokenizer:
+    """Tokenizer from a GGUF file's embedded vocabulary.
+
+    Handles both vocab styles llama.cpp embeds: sentencepiece ("llama":
+    ▁-prefixed pieces + <0xNN> byte tokens) and byte-level BPE ("gpt2":
+    GPT-2 byte-alphabet pieces, e.g. Ġ for space). Decode joins pieces
+    exactly (one sentencepiece dummy-prefix space stripped). Encode is
+    greedy longest-match — a serviceable approximation of the true
+    unigram/BPE merge search; unmatched input falls back to byte tokens
+    or the unk id, never silently dropped. (The reference parses the same
+    vocab for its model cards / mistralrs — gguf_tokenizer.rs.)"""
+
+    def __init__(self, path: str):
+        from dynamo_tpu.gguf import read_gguf
+
+        vocab = read_gguf(path).tokenizer_vocab()
+        if vocab is None:
+            raise ValueError(f"{path}: GGUF file has no embedded tokenizer")
+        self.name = os.path.basename(path)
+        self.kind = vocab.get("model") or "llama"  # "llama" | "gpt2"
+        self._tokens: list[str] = list(vocab["tokens"])
+        self.vocab_size = len(self._tokens)
+        eos = vocab.get("eos_token_id")
+        self.eos_token_ids = (int(eos),) if eos is not None else ()
+        self._bos = vocab.get("bos_token_id")
+        self._chat_template = vocab.get("chat_template")
+        self._index = {t: i for i, t in enumerate(self._tokens)}
+        self._max_len = max((len(t) for t in self._tokens), default=1)
+        self._unk = self._index.get("<unk>", 0)
+        if self.kind == "gpt2":
+            self._b2u = _gpt2_byte_table()
+            self._u2b = {u: b for b, u in self._b2u.items()}
+        else:
+            self._byte_ids = {}
+            for i, t in enumerate(self._tokens):
+                if len(t) == 6 and t.startswith("<0x") and t.endswith(">"):
+                    self._byte_ids[i] = int(t[3:5], 16)
+
+    def _greedy(self, text: str, byte_fallback) -> list[int]:
+        out: list[int] = []
+        i = 0
+        while i < len(text):
+            for ln in range(min(self._max_len, len(text) - i), 0, -1):
+                tid = self._index.get(text[i : i + ln])
+                if tid is not None:
+                    out.append(tid)
+                    i += ln
+                    break
+            else:
+                out.extend(byte_fallback(text[i]))
+                i += 1
+        return out
+
+    def encode(self, text: str) -> list[int]:
+        if self.kind == "gpt2":
+            mapped = "".join(self._b2u[b] for b in text.encode("utf-8"))
+            return self._greedy(mapped, lambda ch: [self._unk])
+        spm = "▁" + text.replace(" ", "▁")
+
+        def bytes_or_unk(ch: str) -> list[int]:
+            ids = [
+                self._index[f"<0x{byte:02X}>"]
+                for byte in ch.encode("utf-8")
+                if f"<0x{byte:02X}>" in self._index
+            ]
+            return ids or [self._unk]
+
+        return self._greedy(spm, bytes_or_unk)
+
+    def decode(self, ids: Sequence[int]) -> str:
+        if self.kind == "gpt2":
+            chars = "".join(
+                self._tokens[i] for i in ids if 0 <= i < len(self._tokens)
+            )
+            data = bytes(self._u2b.get(c, ord(" ") & 0xFF) for c in chars)
+            return data.decode("utf-8", errors="replace")
+        parts: list[bytes] = []
+        for i in ids:
+            if i in self._byte_ids:
+                parts.append(bytes([self._byte_ids[i]]))
+            elif 0 <= i < len(self._tokens):
+                parts.append(self._tokens[i].replace("▁", " ").encode())
+        text = b"".join(parts).decode("utf-8", errors="replace")
+        # sentencepiece dummy prefix: strip exactly one leading space, and
+        # only when the first piece carries the ▁ marker (other leading
+        # whitespace the model generated must survive).
+        first = next(iter(ids), None)
+        if (
+            text.startswith(" ")
+            and first is not None
+            and 0 <= first < len(self._tokens)
+            and self._tokens[first].startswith("▁")
+        ):
+            text = text[1:]
+        return text
+
+    def apply_chat_template(self, messages: list[dict]) -> str:
+        # GGUF carries a jinja template string; rendering it would need a
+        # jinja engine — use the structured fallback format instead.
+        return render_fallback_template(messages)
+
+
 def load_tokenizer(spec: dict | str) -> Tokenizer:
-    """spec: "byte" | {"kind": "byte"} | {"kind": "hf", "path": dir}"""
+    """spec: "byte" | {"kind": "byte"} | {"kind": "hf", "path": dir}
+    | {"kind": "gguf", "path": file.gguf}"""
     if isinstance(spec, str):
         spec = {"kind": spec}
     kind = spec.get("kind", "byte")
@@ -96,4 +217,6 @@ def load_tokenizer(spec: dict | str) -> Tokenizer:
         return ByteTokenizer(tuple(spec.get("eos_token_ids", (0,))))
     if kind == "hf":
         return HfTokenizer(spec["path"])
+    if kind == "gguf":
+        return GgufTokenizer(spec["path"])
     raise ValueError(f"unknown tokenizer kind {kind!r}")

@@ -161,6 +161,74 @@ class CompletionResponse(BaseModel):
     usage: Optional[Usage] = None
 
 
+# -- Responses API (the reference serves /v1/responses alongside chat:
+# lib/llm/src/protocols/openai/responses.rs + http route openai.rs) -------
+
+
+class ResponsesRequest(BaseModel):
+    model: str
+    #: a plain string, or a list of {role, content} input messages
+    input: Union[str, list[dict[str, Any]]]
+    instructions: Optional[str] = None
+    max_output_tokens: Optional[int] = None
+    temperature: Optional[float] = None
+    top_p: Optional[float] = None
+    stream: bool = False
+    ext: Optional[Ext] = None
+    nvext: Optional[Ext] = None
+
+    @property
+    def extension(self) -> Ext:
+        return self.ext or self.nvext or Ext()
+
+    def as_chat_messages(self) -> list["ChatMessage"]:
+        msgs: list[ChatMessage] = []
+        if self.instructions:
+            msgs.append(ChatMessage(role="system", content=self.instructions))
+        if isinstance(self.input, str):
+            msgs.append(ChatMessage(role="user", content=self.input))
+        else:
+            for m in self.input:
+                msgs.append(ChatMessage.model_validate(m))
+        return msgs
+
+
+class ResponseOutputText(BaseModel):
+    type: str = "output_text"
+    text: str = ""
+    annotations: list = Field(default_factory=list)
+
+
+class ResponseOutputMessage(BaseModel):
+    type: str = "message"
+    id: str = ""
+    status: str = "completed"
+    role: str = "assistant"
+    content: list[ResponseOutputText] = Field(default_factory=list)
+
+
+class ResponsesUsage(BaseModel):
+    input_tokens: int = 0
+    output_tokens: int = 0
+    total_tokens: int = 0
+
+
+class ResponsesResponse(BaseModel):
+    id: str
+    object: str = "response"
+    created_at: int = 0
+    status: str = "completed"
+    model: str = ""
+    output: list[ResponseOutputMessage] = Field(default_factory=list)
+    usage: Optional[ResponsesUsage] = None
+
+    @property
+    def output_text(self) -> str:
+        return "".join(
+            part.text for msg in self.output for part in msg.content
+        )
+
+
 class ModelInfo(BaseModel):
     id: str
     object: str = "model"
