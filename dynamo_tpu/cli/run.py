@@ -342,9 +342,9 @@ async def _run_serve(args) -> None:
         for cls in discover_graph(root):
             meta = service_meta(cls)
             svc_cfg = config.get(meta.name, {})
-            replicas = int(
-                (svc_cfg.get("ServiceArgs") or {}).get("workers", meta.workers)
-            )
+            from dynamo_tpu.sdk.config import replica_count
+
+            replicas = replica_count(svc_cfg, meta.workers)
             spec = f"{cls.__module__}:{cls.__name__}"
             for _ in range(replicas):
                 cmd = [
@@ -548,6 +548,28 @@ def main(argv: Optional[list[str]] = None) -> None:
         help="port for the locally spawned fabric",
     )
 
+    buildp = sub.add_parser(
+        "build", help="freeze a service graph into a build manifest"
+    )
+    buildp.add_argument("graph", help="pkg.module:RootService")
+    buildp.add_argument("-f", "--config", default=None, help="YAML config")
+    buildp.add_argument("-o", "--output", default="dist", help="output dir")
+    buildp.add_argument("--image", default="dynamo-tpu:latest")
+
+    deployp = sub.add_parser(
+        "deploy", help="render Kubernetes manifests for a graph"
+    )
+    deployp.add_argument("graph", help="pkg.module:RootService")
+    deployp.add_argument("-f", "--config", default=None, help="YAML config")
+    deployp.add_argument("-o", "--output", default="dist", help="output dir")
+    deployp.add_argument("--image", default="dynamo-tpu:latest")
+    deployp.add_argument(
+        "--fabric-host", default="dynamo-fabric", dest="fabric_host",
+        help="k8s service name for the fabric control plane",
+    )
+
+    sub.add_parser("env", help="print the serving environment report")
+
     metricsp = sub.add_parser("metrics", help="Prometheus metrics service")
     metricsp.add_argument("--fabric", required=True, help="fabric host:port")
     metricsp.add_argument("--component", default="backend")
@@ -592,6 +614,35 @@ def main(argv: Optional[list[str]] = None) -> None:
     from dynamo_tpu.platform import honor_jax_platforms_env
 
     honor_jax_platforms_env()
+
+    # Manifest/introspection commands don't touch the native hot path —
+    # dispatch them before the (possibly minutes-long) native compile.
+    if args.cmd in ("build", "deploy"):
+        from dynamo_tpu.sdk.build import (
+            build_manifest,
+            render_k8s,
+            write_build,
+            write_k8s,
+        )
+        from dynamo_tpu.sdk.config import load_config
+
+        config = load_config(args.config) if args.config else {}
+        manifest = build_manifest(args.graph, config, image=args.image)
+        path = write_build(manifest, args.output)
+        print(f"wrote {path} ({len(manifest['services'])} services)")
+        if args.cmd == "deploy":
+            objs = render_k8s(manifest, fabric_host=args.fabric_host)
+            kpath = write_k8s(objs, args.output)
+            print(f"wrote {kpath} ({len(objs)} objects)")
+        return
+
+    if args.cmd == "env":
+        import json as _json
+
+        from dynamo_tpu.sdk.build import env_report
+
+        print(_json.dumps(env_report(), indent=2))
+        return
 
     # Compile the native hot-path core before serving so no request admission
     # or router construction ever waits on g++ (falls back to Python if the

@@ -1,0 +1,197 @@
+"""`build` / `deploy` for service graphs.
+
+Reference parity: the dynamo CLI's build/deploy commands (deploy/sdk
+cli/cli.py:71-81) — `build` freezes a graph into a deployable manifest
+(services, dependency edges, endpoints, replica counts, launch commands);
+`deploy` renders Kubernetes manifests from it (the YAML-first equivalent
+of the reference's DynamoGraphDeployment CRD + operator, SURVEY.md §2.9).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+from dynamo_tpu.sdk.decorators import (
+    service_dependencies,
+    service_endpoints,
+    service_meta,
+)
+from dynamo_tpu.sdk.graph import discover_graph
+
+
+def build_manifest(
+    root_spec: str, config: Optional[dict] = None, image: str = "dynamo-tpu:latest"
+) -> dict:
+    """Resolve `pkg.module:Class` and freeze the full graph."""
+    from dynamo_tpu.sdk.config import replica_count
+    from dynamo_tpu.sdk.serving import resolve_service
+
+    root = resolve_service(root_spec)
+    services = []
+    for cls in discover_graph(root):
+        meta = service_meta(cls)
+        svc_cfg = (config or {}).get(meta.name, {})
+        services.append(
+            {
+                "name": meta.name,
+                "namespace": meta.namespace,
+                "class": f"{cls.__module__}:{cls.__name__}",
+                "replicas": replica_count(svc_cfg, meta.workers),
+                "endpoints": sorted(service_endpoints(cls)),
+                "depends": sorted(
+                    service_meta(d.target).name
+                    if not isinstance(d.target, str)
+                    else d.target
+                    for d in service_dependencies(cls).values()
+                ),
+                "config": svc_cfg,
+            }
+        )
+    return {
+        "kind": "DynamoTpuGraph",
+        "version": 1,
+        "root": root_spec,
+        "image": image,
+        "services": services,
+    }
+
+
+def write_build(manifest: dict, out_dir: str) -> str:
+    os.makedirs(out_dir, exist_ok=True)
+    path = os.path.join(out_dir, "graph.json")
+    with open(path, "w") as f:
+        json.dump(manifest, f, indent=2)
+    return path
+
+
+# -- k8s rendering -----------------------------------------------------------
+
+
+def _k8s_name(s: str) -> str:
+    return s.lower().replace("_", "-")
+
+
+def render_k8s(manifest: dict, fabric_host: str = "dynamo-fabric") -> list[dict]:
+    """One Deployment per service (replicas from the graph), plus the
+    fabric control-plane Deployment + Service the workers rendezvous on."""
+    objs: list[dict] = [
+        {
+            "apiVersion": "apps/v1",
+            "kind": "Deployment",
+            "metadata": {"name": fabric_host, "labels": {"app": fabric_host}},
+            "spec": {
+                "replicas": 1,
+                "selector": {"matchLabels": {"app": fabric_host}},
+                "template": {
+                    "metadata": {"labels": {"app": fabric_host}},
+                    "spec": {
+                        "containers": [
+                            {
+                                "name": "fabric",
+                                "image": manifest["image"],
+                                "command": [
+                                    "python", "-m", "dynamo_tpu.cli.run",
+                                    "fabric", "--port", "4222",
+                                ],
+                                "ports": [{"containerPort": 4222}],
+                            }
+                        ]
+                    },
+                },
+            },
+        },
+        {
+            "apiVersion": "v1",
+            "kind": "Service",
+            "metadata": {"name": fabric_host},
+            "spec": {
+                "selector": {"app": fabric_host},
+                "ports": [{"port": 4222, "targetPort": 4222}],
+            },
+        },
+    ]
+    for svc in manifest["services"]:
+        name = _k8s_name(svc["name"])
+        container = {
+            "name": name,
+            "image": manifest["image"],
+            "command": [
+                "python", "-m", "dynamo_tpu.sdk.serving",
+                svc["class"], "--fabric", f"{fabric_host}:4222",
+            ],
+            "env": [
+                {"name": "DYNTPU_SERVICE_CONFIG",
+                 "value": json.dumps(svc["config"])}
+            ],
+        }
+        port = svc["config"].get("port")
+        if port:
+            container["ports"] = [{"containerPort": int(port)}]
+        objs.append(
+            {
+                "apiVersion": "apps/v1",
+                "kind": "Deployment",
+                "metadata": {"name": name, "labels": {"app": name}},
+                "spec": {
+                    "replicas": svc["replicas"],
+                    "selector": {"matchLabels": {"app": name}},
+                    "template": {
+                        "metadata": {"labels": {"app": name}},
+                        "spec": {"containers": [container]},
+                    },
+                },
+            }
+        )
+        if port:
+            objs.append(
+                {
+                    "apiVersion": "v1",
+                    "kind": "Service",
+                    "metadata": {"name": name},
+                    "spec": {
+                        "selector": {"app": name},
+                        "ports": [{"port": int(port), "targetPort": int(port)}],
+                    },
+                }
+            )
+    return objs
+
+
+def write_k8s(objs: list[dict], out_dir: str) -> str:
+    import yaml
+
+    os.makedirs(out_dir, exist_ok=True)
+    path = os.path.join(out_dir, "deploy.yaml")
+    with open(path, "w") as f:
+        yaml.safe_dump_all(objs, f, sort_keys=False)
+    return path
+
+
+def env_report() -> dict:
+    """`env` command: the serving environment at a glance."""
+    import platform as plat
+    import sys
+
+    report = {
+        "python": sys.version.split()[0],
+        "platform": plat.platform(),
+    }
+    try:
+        import jax
+
+        report["jax"] = jax.__version__
+        report["jax_backend"] = jax.default_backend()
+        report["devices"] = [str(d) for d in jax.devices()]
+    except Exception as e:  # jax init can fail off-accelerator
+        report["jax_error"] = str(e)
+    for mod in ("flax", "optax", "numpy", "aiohttp", "msgpack"):
+        try:
+            report[mod] = __import__(mod).__version__
+        except Exception:
+            report[mod] = None
+    from dynamo_tpu.runtime.runtime import DEFAULT_FABRIC_ADDR
+
+    report["fabric_default"] = DEFAULT_FABRIC_ADDR
+    return report

@@ -47,6 +47,18 @@ def _interpolate(value: Any) -> Any:
     return value
 
 
+def replica_count(svc_cfg: dict, default: int = 1) -> int:
+    """Replicas for a service: `ServiceArgs.workers` (the documented
+    shape) with a flat `workers` key accepted too — serve, build, and
+    deploy all resolve through here so one config drives every command."""
+    sa = svc_cfg.get("ServiceArgs") or {}
+    if "workers" in sa:
+        return int(sa["workers"])
+    if "workers" in svc_cfg:
+        return int(svc_cfg["workers"])
+    return int(default)
+
+
 def load_config(path: str) -> dict[str, dict]:
     """service name -> merged config dict (common-configs under, service
     overrides on top), ${VAR} / ${VAR:-default} interpolated."""

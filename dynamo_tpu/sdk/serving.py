@@ -130,6 +130,7 @@ async def start_service(
         runtime = await DistributedRuntime.create(fabric_addr)
     instance = cls()
     instance.config = dict(config or {})
+    instance.runtime = runtime  # services may register workers/watchers
 
     clients = []
     for attr, dep in service_dependencies(cls).items():
@@ -255,10 +256,19 @@ def resolve_service(spec: str):
 
 
 async def _amain(args) -> None:
+    import json
+    import os
+
     cls = resolve_service(args.service)
-    config = load_config(args.config) if args.config else {}
     meta = service_meta(cls)
-    handle = await start_service(cls, config.get(meta.name), args.fabric)
+    if args.config:
+        svc_config = load_config(args.config).get(meta.name)
+    else:
+        # k8s containers rendered by `deploy` carry the frozen per-service
+        # config in the environment (sdk/build.py render_k8s).
+        env_cfg = os.environ.get("DYNTPU_SERVICE_CONFIG")
+        svc_config = json.loads(env_cfg) if env_cfg else None
+    handle = await start_service(cls, svc_config, args.fabric)
     print(f"service {meta.name} up", flush=True)
     try:
         await asyncio.Event().wait()

@@ -1,0 +1,13 @@
+"""Aggregated serving: Frontend -> Worker (prefill+decode in one engine).
+
+Reference parity: examples/llm/graphs/agg.py (Frontend.link(Processor)
+.link(VllmWorker)) — the Processor's tokenize/detokenize role lives in
+this framework's frontend pipeline, so the graph is two services.
+
+    python -m dynamo_tpu.cli.run serve examples.llm.graphs.agg:Frontend \
+        -f examples/llm/configs/agg.yaml
+"""
+
+from examples.llm.components import Frontend, Worker
+
+__all__ = ["Frontend", "Worker"]

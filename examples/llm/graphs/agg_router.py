@@ -1,0 +1,11 @@
+"""Aggregated serving with KV-aware routing: workers publish KV events;
+frontends route each request to the worker with the deepest prefix
+overlap (reference: examples/llm/graphs/agg_router.py).
+
+    python -m dynamo_tpu.cli.run serve examples.llm.graphs.agg_router:Frontend \
+        -f examples/llm/configs/agg_router.yaml
+"""
+
+from examples.llm.components import Frontend, Worker
+
+__all__ = ["Frontend", "Worker"]

@@ -1,0 +1,11 @@
+"""Disaggregated prefill/decode: long prompts are prefilled by dedicated
+prefill workers and their KV pages pushed to the decode worker
+(reference: examples/llm/graphs/disagg.py).
+
+    python -m dynamo_tpu.cli.run serve examples.llm.graphs.disagg:DisaggFrontend \
+        -f examples/llm/configs/disagg.yaml
+"""
+
+from examples.llm.components import DisaggFrontend, PrefillWorkerService, Worker
+
+__all__ = ["DisaggFrontend", "Worker", "PrefillWorkerService"]

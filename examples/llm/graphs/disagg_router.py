@@ -1,0 +1,11 @@
+"""Disaggregated prefill/decode + KV-aware routing (reference:
+examples/llm/graphs/disagg_router.py).
+
+    python -m dynamo_tpu.cli.run serve \
+        examples.llm.graphs.disagg_router:DisaggFrontend \
+        -f examples/llm/configs/disagg_router.yaml
+"""
+
+from examples.llm.components import DisaggFrontend, PrefillWorkerService, Worker
+
+__all__ = ["DisaggFrontend", "Worker", "PrefillWorkerService"]

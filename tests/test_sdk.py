@@ -318,3 +318,46 @@ def test_serve_cli_spawns_graph():
         if proc.poll() is None:
             proc.kill()
         subprocess.run(["pkill", "-f", "dynamo_tpu.sdk.serving"], check=False)
+
+
+def test_build_manifest_and_k8s_render(tmp_path):
+    """`build` freezes the graph; `deploy` renders one k8s Deployment per
+    service plus the fabric control plane (reference: dynamo CLI
+    build/deploy, cli/cli.py:71-81)."""
+    from dynamo_tpu.sdk.build import (
+        build_manifest,
+        env_report,
+        render_k8s,
+        write_build,
+        write_k8s,
+    )
+
+    cfg = {"Worker": {"workers": 3, "model": "tiny"},
+           "Frontend": {"port": 8080}}
+    m = build_manifest("examples.llm.graphs.agg:Frontend", cfg)
+    names = {s["name"]: s for s in m["services"]}
+    assert set(names) == {"Frontend", "Worker"}
+    assert names["Worker"]["replicas"] == 3
+    assert "Worker" in names["Frontend"]["depends"]
+
+    path = write_build(m, str(tmp_path))
+    assert path.endswith("graph.json")
+
+    objs = render_k8s(m)
+    kinds = [(o["kind"], o["metadata"]["name"]) for o in objs]
+    assert ("Deployment", "dynamo-fabric") in kinds
+    assert ("Deployment", "worker") in kinds
+    assert ("Service", "frontend") in kinds  # has a port
+    worker_dep = next(
+        o for o in objs
+        if o["kind"] == "Deployment" and o["metadata"]["name"] == "worker"
+    )
+    assert worker_dep["spec"]["replicas"] == 3
+    kpath = write_k8s(objs, str(tmp_path))
+    import yaml
+
+    parsed = list(yaml.safe_load_all(open(kpath)))
+    assert len(parsed) == len(objs)
+
+    rep = env_report()
+    assert "python" in rep and "fabric_default" in rep
