@@ -309,7 +309,7 @@ async def _run_serve(args) -> None:
     reference's circus-arbiter local serving, sdk cli/serving.py:152)."""
     import subprocess
 
-    from dynamo_tpu.sdk.config import load_config
+    from dynamo_tpu.sdk.config import load_config, replica_count
     from dynamo_tpu.sdk.decorators import service_meta
     from dynamo_tpu.sdk.graph import discover_graph
     from dynamo_tpu.sdk.serving import resolve_service
@@ -342,8 +342,6 @@ async def _run_serve(args) -> None:
         for cls in discover_graph(root):
             meta = service_meta(cls)
             svc_cfg = config.get(meta.name, {})
-            from dynamo_tpu.sdk.config import replica_count
-
             replicas = replica_count(svc_cfg, meta.workers)
             spec = f"{cls.__module__}:{cls.__name__}"
             for _ in range(replicas):

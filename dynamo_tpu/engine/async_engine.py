@@ -96,7 +96,11 @@ class AsyncEngineRunner:
                     )
             for req, sampling in pending:
                 try:
-                    eng.add_request(req.request_id, req.token_ids, sampling)
+                    eng.add_request(
+                        req.request_id, req.token_ids, sampling,
+                        mm_embeds=req.mm_embeds,
+                        mm_positions=req.mm_positions,
+                    )
                 except Exception as e:
                     self._post(req.request_id, {"error": str(e)})
                     self._post(req.request_id, None)

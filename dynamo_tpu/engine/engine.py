@@ -164,12 +164,43 @@ class JaxEngine:
         request_id: str,
         prompt_tokens: Sequence[int],
         sampling: Optional[SamplingParams] = None,
+        mm_embeds: Optional[np.ndarray] = None,
+        mm_positions: Sequence[int] = (),
     ) -> Request:
+        if mm_embeds is not None:
+            mm_embeds = np.asarray(mm_embeds, np.float32)
+            if len(mm_positions) != len(mm_embeds):
+                raise ValueError(
+                    f"{len(mm_embeds)} multimodal embeddings but "
+                    f"{len(mm_positions)} placeholder positions"
+                )
+            cfg = self.adapter.config
+            hdim = (
+                cfg.hidden_size
+                if hasattr(cfg, "hidden_size")
+                else cfg.base.hidden_size
+            )
+            if mm_embeds.ndim != 2 or mm_embeds.shape[-1] != hdim:
+                # Reject here, where the runner returns the error to THIS
+                # client — a bad shape surfacing inside step() would wedge
+                # the whole batch loop instead.
+                raise ValueError(
+                    f"multimodal embeddings must be [n, {hdim}] for this "
+                    f"model; got {mm_embeds.shape}"
+                )
+            if any(
+                not 0 <= p < len(prompt_tokens) for p in mm_positions
+            ):
+                raise ValueError(
+                    "mm_positions out of range for the prompt"
+                )
         req = Request(
             request_id=request_id,
             prompt_tokens=list(prompt_tokens),
             sampling=sampling or SamplingParams(),
             arrival_time=time.time(),
+            mm_embeds=mm_embeds,
+            mm_positions=tuple(mm_positions),
         )
         self.scheduler.add_request(req)
         self.metrics.requests_received += 1
@@ -251,6 +282,17 @@ class JaxEngine:
             pt = np.zeros((b_bucket, mp), np.int32)
             last_idx = np.zeros(b_bucket, np.int32)
             any_last = False
+            any_mm = any(p.request.mm_embeds is not None for p in pieces)
+            mm_embeds = mm_mask = None
+            if any_mm:
+                hidden = self.adapter.config
+                hdim = (
+                    hidden.hidden_size
+                    if hasattr(hidden, "hidden_size")
+                    else hidden.base.hidden_size
+                )
+                mm_embeds = np.zeros((b_bucket, t_bucket, hdim), np.float32)
+                mm_mask = np.zeros((b_bucket, t_bucket), bool)
             for i, piece in enumerate(pieces):
                 req = piece.request
                 chunk = req.all_tokens[piece.start : piece.start + piece.length]
@@ -261,24 +303,38 @@ class JaxEngine:
                 last_idx[i] = piece.length - 1
                 if piece.start + piece.length >= len(req.prompt_tokens):
                     any_last = True
+                if req.mm_embeds is not None:
+                    for j, pos in enumerate(req.mm_positions):
+                        off = pos - piece.start
+                        if 0 <= off < piece.length:
+                            mm_embeds[i, off] = req.mm_embeds[j]
+                            mm_mask[i, off] = True
 
             args = (
                 self.params, self._dev(tokens), self._dev(positions),
                 self._dev(valid), self.kv, self._dev(pt),
             )
+            mm_args = (
+                (self._dev(mm_embeds), self._dev(mm_mask)) if any_mm else ()
+            )
             if any_last:
                 reqs = [p.request for p in pieces]
                 samp, all_greedy = self._sampling_arrays(reqs, pad_to=b_bucket)
                 fn = self._get_step_fn(
-                    "prefill", b_bucket, t_bucket, greedy=all_greedy
+                    "prefill", b_bucket, t_bucket, greedy=all_greedy,
+                    mm=any_mm,
                 )
-                token_ids, self.kv = fn(*args, self._dev(last_idx), *samp)
+                token_ids, self.kv = fn(
+                    *args, self._dev(last_idx), *samp, *mm_args
+                )
                 ids = np.asarray(token_ids)
             else:
                 # No piece finishes its prompt: KV writes only — skip the
                 # vocab-sized logits + sampling entirely.
-                fn = self._get_step_fn("prefill_nosample", b_bucket, t_bucket)
-                self.kv = fn(*args)
+                fn = self._get_step_fn(
+                    "prefill_nosample", b_bucket, t_bucket, mm=any_mm
+                )
+                self.kv = fn(*args, *mm_args)
                 ids = None
             for i, piece in enumerate(pieces):
                 req = piece.request
@@ -427,9 +483,10 @@ class JaxEngine:
         )
 
     def _get_step_fn(
-        self, kind: str, b: int, t: int, greedy: bool = False
+        self, kind: str, b: int, t: int, greedy: bool = False,
+        mm: bool = False,
     ) -> Callable:
-        cache_key = (kind, b, t, greedy)
+        cache_key = (kind, b, t, greedy, mm)
         fn = self._jit_cache.get(cache_key)
         if fn is not None:
             return fn
@@ -488,9 +545,11 @@ class JaxEngine:
 
         if kind == "prefill_nosample":
 
-            def nosample_fn(params, tokens, positions, valid, kv, pt):
+            def nosample_fn(params, tokens, positions, valid, kv, pt,
+                            mm_embeds=None, mm_mask=None):
                 _, kv = adapter.forward_hidden(
-                    params, tokens, positions, valid, kv, pt
+                    params, tokens, positions, valid, kv, pt,
+                    mm_embeds=mm_embeds, mm_mask=mm_mask,
                 )
                 return kv
 
@@ -500,8 +559,12 @@ class JaxEngine:
             return jitted
 
         def step_fn(params, tokens, positions, valid, kv, pt, last_idx,
-                    temps, top_ps, top_ks, seeds, counters):
-            hidden, kv = adapter.forward_hidden(params, tokens, positions, valid, kv, pt)
+                    temps, top_ps, top_ks, seeds, counters,
+                    mm_embeds=None, mm_mask=None):
+            hidden, kv = adapter.forward_hidden(
+                params, tokens, positions, valid, kv, pt,
+                mm_embeds=mm_embeds, mm_mask=mm_mask,
+            )
             rows = jnp.arange(hidden.shape[0])
             last_hidden = hidden[rows, last_idx]  # [B, H] — lm_head only here
             logits = adapter.compute_logits(params, last_hidden)
@@ -721,7 +784,7 @@ class JaxEngine:
     def _register_pages(self, req: Request) -> None:
         """Content-address any newly *filled* pages (enables prefix sharing
         and emits 'stored' KV events for routers)."""
-        if not self.config.enable_prefix_caching:
+        if not self.config.enable_prefix_caching or req.mm_embeds is not None:
             return
         chain = self.scheduler.chains.get(req.request_id)
         if chain is None:

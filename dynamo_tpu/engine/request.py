@@ -47,6 +47,10 @@ class Request:
     prompt_tokens: list[int]
     sampling: SamplingParams = field(default_factory=SamplingParams)
     arrival_time: float = 0.0
+    #: multimodal (llava-style): projected image embeddings [n, H] replacing
+    #: the placeholder prompt tokens at mm_positions (absolute indices)
+    mm_embeds: Optional["object"] = None  # np.ndarray
+    mm_positions: tuple[int, ...] = ()
 
     # -- engine-managed state ---------------------------------------------
     state: RequestState = RequestState.WAITING

@@ -145,10 +145,16 @@ class Scheduler:
                     req.prompt_tokens, block_size=ps, salt=self.config.model
                 )
                 self.chains[req.request_id] = chain
-            # Probe the prefix cache to size the true page need.
+            # Probe the prefix cache to size the true page need. Multimodal
+            # prompts bypass it: their placeholder token ids don't identify
+            # the image content, so content-addressing would alias
+            # different images onto the same hashes.
+            use_cache = (
+                self.config.enable_prefix_caching and req.mm_embeds is None
+            )
             cached_blocks = (
                 self.allocator.match_length(chain.sequence_hashes())
-                if self.config.enable_prefix_caching
+                if use_cache
                 else 0
             )
             total_pages = -(-(len(req.prompt_tokens) + 1) // ps)
@@ -157,7 +163,7 @@ class Scheduler:
                 break  # head-of-line blocking by design (FIFO fairness)
             cached_pages = (
                 self.allocator.lookup(chain.sequence_hashes())
-                if self.config.enable_prefix_caching
+                if use_cache
                 else []
             )
             # A fully-cached prompt must still recompute its last token so

@@ -53,12 +53,19 @@ class ModelPipeline:
         self.close_fn = close_fn
         #: async (prompts: list[list[int]]) -> list of vectors
         self.embed_fn = embed_fn
+        #: async (pixels: np [B,H,W,3]) -> np [B, n, H] projected image
+        #: embeddings — attached by multimodal deployments (the encode
+        #: worker); enables image_pixels content parts
+        self.image_encode_fn = None
 
     async def chat_stream(
         self, request: ChatCompletionRequest, context: Optional[Context] = None
     ) -> AsyncIterator[ChatCompletionChunk]:
         ctx = context or Context()
-        pre = self.preprocessor.preprocess_chat(request)
+        messages = [m.model_dump(exclude_none=True) for m in request.messages]
+        if any(isinstance(m.get("content"), list) for m in messages):
+            messages = await self._encode_image_parts(messages)
+        pre = self.preprocessor.preprocess_chat_messages(messages, request)
         self._clamp(pre)
         include_usage = bool(
             request.stream_options and request.stream_options.include_usage
@@ -83,6 +90,49 @@ class ModelPipeline:
             stream, pre.request_id, pre, include_usage=include_usage
         ):
             yield chunk
+
+    async def _encode_image_parts(self, messages: list[dict]) -> list[dict]:
+        """Turn image_pixels content parts into image_embed parts via the
+        attached encoder (reference: the multimodal encode worker +
+        `connect` tensor hand-off, examples/multimodal)."""
+        import base64
+
+        import numpy as np
+
+        out = []
+        for m in messages:
+            content = m.get("content")
+            if not isinstance(content, list):
+                out.append(m)
+                continue
+            parts = []
+            for part in content:
+                if (
+                    isinstance(part, dict)
+                    and part.get("type") == "image_pixels"
+                ):
+                    if self.image_encode_fn is None:
+                        raise ValueError(
+                            "image_pixels content requires an image "
+                            "encoder (multimodal deployment)"
+                        )
+                    raw = part["data"]
+                    if isinstance(raw, str):
+                        raw = base64.b64decode(raw)
+                    pixels = np.frombuffer(raw, np.float32).reshape(
+                        part["shape"]
+                    )
+                    embeds = await self.image_encode_fn(pixels[None])
+                    parts.append(
+                        {
+                            "type": "image_embed",
+                            "embedding": np.asarray(embeds[0], np.float32),
+                        }
+                    )
+                else:
+                    parts.append(part)
+            out.append({**m, "content": parts})
+        return out
 
     def responses_stream(
         self, request: ResponsesRequest, context: Optional[Context] = None

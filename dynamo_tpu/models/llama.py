@@ -599,6 +599,8 @@ def forward_hidden(
     valid: jax.Array,  # [B, T] bool — which (b,t) are real tokens
     kv: KVPages,
     page_tables: jax.Array,  # [B, MP] int32
+    mm_embeds: Optional[jax.Array] = None,  # [B, T, H] multimodal embeds
+    mm_mask: Optional[jax.Array] = None,  # [B, T] bool — use mm_embeds here
 ) -> tuple[jax.Array, KVPages]:
     """One model step over a token chunk; returns (hidden [B,T,H] post final
     norm, new kv). The engine applies `compute_logits` only at the positions
@@ -606,9 +608,14 @@ def forward_hidden(
     matmul would otherwise dominate the step's FLOPs.
 
     Covers prefill (T = chunk), decode (T = 1), and prefix-cache continuation
-    (positions start past 0) uniformly.
+    (positions start past 0) uniformly. Multimodal (llava-style) prompts
+    pass projected image embeddings in mm_embeds; where mm_mask is True
+    they replace the token-id embedding lookup (the placeholder ids under
+    the mask are ignored).
     """
     h = params["embed"][tokens].astype(cfg.dtype)  # [B,T,H]
+    if mm_embeds is not None:
+        h = jnp.where(mm_mask[..., None], mm_embeds.astype(cfg.dtype), h)
 
     def layer(carry, xs):
         h, k_full, v_full = carry

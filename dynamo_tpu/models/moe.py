@@ -225,10 +225,14 @@ def forward_hidden(
     valid: jax.Array,
     kv: KVPages,
     page_tables: jax.Array,
+    mm_embeds=None,
+    mm_mask=None,
 ) -> tuple[jax.Array, KVPages]:
     """Same contract as llama.forward_hidden (engine-compatible)."""
     bc = cfg.base
     h = params["embed"][tokens].astype(bc.dtype)
+    if mm_embeds is not None:
+        h = jnp.where(mm_mask[..., None], mm_embeds.astype(bc.dtype), h)
 
     def layer(carry, xs):
         h, k_full, v_full = carry

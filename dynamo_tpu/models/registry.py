@@ -58,9 +58,11 @@ def _llama_adapter(name: str, cfg: LlamaConfig) -> ModelAdapter:
     def forward(params, tokens, positions, valid, kv, page_tables):
         return llama_mod.forward(params, cfg, tokens, positions, valid, kv, page_tables)
 
-    def forward_hidden(params, tokens, positions, valid, kv, page_tables):
+    def forward_hidden(
+        params, tokens, positions, valid, kv, page_tables, **mm
+    ):
         return llama_mod.forward_hidden(
-            params, cfg, tokens, positions, valid, kv, page_tables
+            params, cfg, tokens, positions, valid, kv, page_tables, **mm
         )
 
     return ModelAdapter(
@@ -100,9 +102,9 @@ def _moe_adapter(name: str, moe_cfg) -> ModelAdapter:
     def fwd(params, tokens, positions, valid, kv, pt):
         return moe_mod.forward(params, cfg, tokens, positions, valid, kv, pt)
 
-    def fwd_hidden(params, tokens, positions, valid, kv, pt):
+    def fwd_hidden(params, tokens, positions, valid, kv, pt, **mm):
         return moe_mod.forward_hidden(
-            params, cfg, tokens, positions, valid, kv, pt
+            params, cfg, tokens, positions, valid, kv, pt, **mm
         )
 
     def load(path):

@@ -1,0 +1,138 @@
+"""ViT vision encoder for multimodal (llava-style) serving.
+
+A CLIP-ViT-shaped encoder in JAX: patch embedding (as one big matmul —
+MXU-friendly), pre-norm transformer blocks, and a two-layer projector to
+the language model's hidden size. The encode worker (examples/multimodal)
+runs this and ships the projected embeddings to the LLM worker over the
+fabric data plane — the reference's encode/prefill/decode split with its
+NIXL `connect` RDMA library (examples/multimodal/connect/__init__.py),
+re-done as host/ICI tensor hand-off.
+
+Dense [B, N, D] shapes throughout; no paging needed (images are encoded
+in one shot).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class VisionConfig:
+    image_size: int = 224
+    patch_size: int = 14
+    hidden_size: int = 1024
+    intermediate_size: int = 4096
+    num_layers: int = 24
+    num_heads: int = 16
+    #: language model hidden size the projector maps into
+    proj_dim: int = 4096
+    layer_norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @staticmethod
+    def clip_vit_l_14() -> "VisionConfig":
+        return VisionConfig()  # defaults are CLIP-ViT-L/14 @ 224
+
+    @staticmethod
+    def tiny(proj_dim: int = 64) -> "VisionConfig":
+        return VisionConfig(
+            image_size=16, patch_size=4, hidden_size=32,
+            intermediate_size=64, num_layers=2, num_heads=2,
+            proj_dim=proj_dim, dtype=jnp.float32,
+        )
+
+
+def init_params(key: jax.Array, cfg: VisionConfig) -> dict:
+    h, i = cfg.hidden_size, cfg.intermediate_size
+    patch_in = 3 * cfg.patch_size * cfg.patch_size
+    L = cfg.num_layers
+    keys = jax.random.split(key, 8)
+
+    def dense(key, shape, fan_in):
+        scale = 1.0 / math.sqrt(fan_in)
+        return (
+            jax.random.normal(key, shape, jnp.float32) * scale
+        ).astype(cfg.dtype)
+
+    return {
+        "patch_embed": dense(keys[0], (patch_in, h), patch_in),
+        "pos_embed": dense(keys[1], (cfg.num_patches, h), h),
+        "layers": {
+            "ln1": jnp.ones((L, h), cfg.dtype),
+            "ln1_b": jnp.zeros((L, h), cfg.dtype),
+            "wqkv": dense(keys[2], (L, h, 3 * h), h),
+            "wo": dense(keys[3], (L, h, h), h),
+            "ln2": jnp.ones((L, h), cfg.dtype),
+            "ln2_b": jnp.zeros((L, h), cfg.dtype),
+            "w1": dense(keys[4], (L, h, i), h),
+            "w2": dense(keys[5], (L, i, h), i),
+        },
+        "final_ln": jnp.ones((h,), cfg.dtype),
+        "final_ln_b": jnp.zeros((h,), cfg.dtype),
+        "proj1": dense(keys[6], (h, cfg.proj_dim), h),
+        "proj2": dense(keys[7], (cfg.proj_dim, cfg.proj_dim), cfg.proj_dim),
+    }
+
+
+def _layer_norm(x, w, b, eps):
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.var(xf, axis=-1, keepdims=True)
+    out = (xf - mu) * jax.lax.rsqrt(var + eps)
+    return (out * w.astype(jnp.float32) + b.astype(jnp.float32)).astype(x.dtype)
+
+
+def patchify(images: jax.Array, cfg: VisionConfig) -> jax.Array:
+    """[B, H, W, 3] -> [B, N, patch_in] row-major patches."""
+    b = images.shape[0]
+    p = cfg.patch_size
+    g = cfg.image_size // p
+    x = images.reshape(b, g, p, g, p, 3)
+    x = x.transpose(0, 1, 3, 2, 4, 5)  # [B, g, g, p, p, 3]
+    return x.reshape(b, g * g, p * p * 3)
+
+
+def forward(params: dict, cfg: VisionConfig, images: jax.Array) -> jax.Array:
+    """[B, image_size, image_size, 3] pixels -> [B, num_patches, proj_dim]
+    projected patch embeddings (the tokens spliced into the LLM prompt)."""
+    x = patchify(images.astype(cfg.dtype), cfg) @ params["patch_embed"]
+    x = x + params["pos_embed"][None]
+
+    def layer(x, lp):
+        y = _layer_norm(x, lp["ln1"], lp["ln1_b"], cfg.layer_norm_eps)
+        b, n, h = y.shape
+        qkv = (y @ lp["wqkv"]).reshape(
+            b, n, 3, cfg.num_heads, cfg.head_dim
+        )
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        scores = jnp.einsum(
+            "bnhd,bmhd->bhnm", q.astype(jnp.float32), k.astype(jnp.float32)
+        ) / math.sqrt(cfg.head_dim)
+        probs = jax.nn.softmax(scores, axis=-1)
+        attn = jnp.einsum(
+            "bhnm,bmhd->bnhd", probs, v.astype(jnp.float32)
+        ).reshape(b, n, h).astype(x.dtype)
+        x = x + attn @ lp["wo"]
+        y = _layer_norm(x, lp["ln2"], lp["ln2_b"], cfg.layer_norm_eps)
+        y = jax.nn.gelu((y @ lp["w1"]).astype(jnp.float32), approximate=True)
+        return x + (y.astype(cfg.dtype) @ lp["w2"]), None
+
+    x, _ = jax.lax.scan(layer, x, params["layers"])
+    x = _layer_norm(x, params["final_ln"], params["final_ln_b"], cfg.layer_norm_eps)
+    # llava-style 2-layer MLP projector into the LM embedding space
+    y = jax.nn.gelu((x @ params["proj1"]).astype(jnp.float32), approximate=True)
+    return (y.astype(cfg.dtype) @ params["proj2"]).astype(cfg.dtype)

@@ -44,9 +44,13 @@ class PreprocessedRequest:
     stop_strings: list[str] = field(default_factory=list)
     ignore_eos: bool = False
     annotations: dict[str, Any] = field(default_factory=dict)
+    #: multimodal: projected image embeddings [n, H] f32 (numpy) spliced at
+    #: mm_positions (absolute prompt indices of the placeholder tokens)
+    mm_embeds: Optional[Any] = None
+    mm_positions: list[int] = field(default_factory=list)
 
     def to_dict(self) -> dict:
-        return {
+        d = {
             "request_id": self.request_id,
             "token_ids": self.token_ids,
             "max_tokens": self.max_tokens,
@@ -59,10 +63,26 @@ class PreprocessedRequest:
             "ignore_eos": self.ignore_eos,
             "annotations": self.annotations,
         }
+        if self.mm_embeds is not None:
+            import numpy as np
+
+            arr = np.asarray(self.mm_embeds, np.float32)
+            d["mm_embeds"] = arr.tobytes()
+            d["mm_shape"] = list(arr.shape)
+            d["mm_positions"] = list(self.mm_positions)
+        return d
 
     @staticmethod
     def from_dict(d: dict) -> "PreprocessedRequest":
-        return PreprocessedRequest(**d)
+        d = dict(d)
+        raw = d.pop("mm_embeds", None)
+        shape = d.pop("mm_shape", None)
+        pre = PreprocessedRequest(**d)
+        if raw is not None:
+            import numpy as np
+
+            pre.mm_embeds = np.frombuffer(raw, np.float32).reshape(shape)
+        return pre
 
 
 def _stop_list(stop) -> list[str]:
@@ -82,9 +102,29 @@ class OpenAIPreprocessor:
 
     def preprocess_chat(self, request: ChatCompletionRequest) -> PreprocessedRequest:
         messages = [m.model_dump(exclude_none=True) for m in request.messages]
-        prompt = self.tokenizer.apply_chat_template(messages)
-        return self._common(
-            prompt_ids=self.tokenizer.encode(prompt),
+        return self.preprocess_chat_messages(messages, request)
+
+    def preprocess_chat_messages(
+        self, messages: list[dict], request: ChatCompletionRequest
+    ) -> PreprocessedRequest:
+        """Chat preprocessing over already-dumped message dicts (the
+        multimodal path transforms image parts into embeddings first)."""
+        if any(
+            isinstance(m.get("content"), list)
+            and any(
+                isinstance(p, dict) and p.get("type") == "image_embed"
+                for p in m["content"]
+            )
+            for m in messages
+        ):
+            ids, mm_embeds, mm_positions = self._multimodal_prompt(messages)
+        else:
+            prompt = self.tokenizer.apply_chat_template(messages)
+            ids, mm_embeds, mm_positions = (
+                self.tokenizer.encode(prompt), None, []
+            )
+        pre = self._common(
+            prompt_ids=ids,
             max_tokens=request.effective_max_tokens,
             temperature=request.temperature,
             top_p=request.top_p,
@@ -93,6 +133,66 @@ class OpenAIPreprocessor:
             stop=request.stop,
             ext=request.extension,
         )
+        pre.mm_embeds = mm_embeds
+        pre.mm_positions = mm_positions
+        return pre
+
+    def _multimodal_prompt(self, messages: list[dict]):
+        """llava-style prompt assembly: text parts tokenize normally; each
+        image_embed part contributes one placeholder token per embedding
+        row, recorded in (mm_embeds, mm_positions). Uses the structured
+        fallback chat format (templates are text-only)."""
+        import base64 as b64mod
+
+        import numpy as np
+
+        from dynamo_tpu.preprocessor.tokenizer import (
+            _FALLBACK_TEMPLATE_SUFFIX,
+            FALLBACK_MESSAGE_SEP,
+            fallback_role_prefix,
+        )
+
+        ids: list[int] = []
+        vecs: list[np.ndarray] = []
+        positions: list[int] = []
+        for m in messages:
+            ids += self.tokenizer.encode(fallback_role_prefix(m))
+            content = m.get("content") or ""
+            if isinstance(content, str):
+                ids += self.tokenizer.encode(content)
+            else:
+                for part in content:
+                    ptype = part.get("type")
+                    if ptype == "text":
+                        ids += self.tokenizer.encode(part.get("text", ""))
+                    elif ptype == "image_embed":
+                        emb = part.get("embedding")
+                        if isinstance(emb, (bytes, str)):
+                            raw = (
+                                b64mod.b64decode(emb)
+                                if isinstance(emb, str)
+                                else emb
+                            )
+                            arr = np.frombuffer(raw, np.float32).reshape(
+                                part["shape"]
+                            )
+                        else:
+                            arr = np.asarray(emb, np.float32)
+                        if arr.ndim == 1:
+                            arr = arr[None]
+                        for row in arr:
+                            positions.append(len(ids))
+                            ids.append(0)  # placeholder; masked by mm_mask
+                            vecs.append(row)
+                    else:
+                        raise ValueError(
+                            f"unsupported content part type {ptype!r} "
+                            "(no image encoder attached?)"
+                        )
+            ids += self.tokenizer.encode(FALLBACK_MESSAGE_SEP)
+        ids += self.tokenizer.encode(_FALLBACK_TEMPLATE_SUFFIX)
+        embeds = np.stack(vecs) if vecs else None
+        return ids, embeds, positions
 
     def preprocess_completion(self, request: CompletionRequest) -> PreprocessedRequest:
         prompt = request.prompt

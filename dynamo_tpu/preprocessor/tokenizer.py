@@ -29,6 +29,16 @@ class Tokenizer(Protocol):
 _FALLBACK_TEMPLATE_SUFFIX = "assistant:"
 
 
+def fallback_role_prefix(message: dict) -> str:
+    """One message's role prefix in the structured fallback format — the
+    multimodal prompt assembler builds the same format piecewise, so both
+    paths share these constants."""
+    return f"{message.get('role', 'user')}: "
+
+
+FALLBACK_MESSAGE_SEP = "\n"
+
+
 def render_fallback_template(messages: list[dict]) -> str:
     parts = []
     for m in messages:
@@ -37,9 +47,9 @@ def render_fallback_template(messages: list[dict]) -> str:
             content = " ".join(
                 p.get("text", "") for p in content if isinstance(p, dict)
             )
-        parts.append(f"{m.get('role', 'user')}: {content}")
+        parts.append(fallback_role_prefix(m) + content)
     parts.append(_FALLBACK_TEMPLATE_SUFFIX)
-    return "\n".join(parts)
+    return FALLBACK_MESSAGE_SEP.join(parts)
 
 
 class ByteTokenizer:

@@ -207,6 +207,11 @@ class Worker:
     # -- disaggregated path ------------------------------------------------
 
     async def _want_remote(self, pre: PreprocessedRequest) -> bool:
+        # Multimodal prompts prefill locally: the remote-prefill wire
+        # carries token ids only, and placeholder ids don't identify the
+        # image embeddings.
+        if pre.mm_embeds is not None:
+            return False
         # Cheap local short-circuit: uncached length can't exceed prompt
         # length, so short prompts never qualify — skip the engine-thread
         # and fabric round-trips entirely.

@@ -1,0 +1,125 @@
+"""Multimodal serving: encode / prefill / decode split.
+
+Reference parity: examples/multimodal — a vision encode worker produces
+image embeddings that are handed to the LLM worker and spliced into the
+prompt (llava-style). The reference ships embeddings over its NIXL RDMA
+`connect` library (examples/multimodal/connect/__init__.py); here they
+ride the fabric data plane as framed tensors: the EncodeWorker serves an
+`encode` endpoint, and the frontend attaches it to every model pipeline
+as the image encoder.
+
+Config keys:
+  EncodeWorker:       vision-model (tiny | clip-vit-l-14), proj-dim
+  Worker / Frontend:  as in examples/llm
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from dynamo_tpu.frontend.service import ModelManager
+from dynamo_tpu.sdk import depends, endpoint, service
+from examples.llm.components import Worker, _FrontendBase
+
+
+@service
+class EncodeWorker:
+    """Vision encoder: pixels in, projected patch embeddings out."""
+
+    def __init__(self):
+        self._forward = None
+        self._params = None
+        self._cfg = None
+
+    async def setup(self):
+        import asyncio
+
+        def build():
+            import jax
+
+            from dynamo_tpu.models import vision
+
+            name = self.config.get("vision-model", "clip-vit-l-14")
+            proj_dim = int(self.config.get("proj-dim", 4096))
+            if name == "tiny":
+                cfg = vision.VisionConfig.tiny(proj_dim=proj_dim)
+            else:
+                cfg = vision.VisionConfig.clip_vit_l_14()
+                if proj_dim != cfg.proj_dim:
+                    from dataclasses import replace
+
+                    cfg = replace(cfg, proj_dim=proj_dim)
+            params = vision.init_params(jax.random.key(0), cfg)
+            fwd = jax.jit(
+                lambda params, images: vision.forward(params, cfg, images)
+            )
+            return cfg, params, fwd
+
+        # Model init + first compiles block for seconds — off-loop, or the
+        # fabric lease keepalives starve and registration fails (same
+        # discipline as Worker's engine construction, worker.py).
+        self._cfg, self._params, self._forward = (
+            await asyncio.get_running_loop().run_in_executor(None, build)
+        )
+
+    @endpoint
+    async def encode(self, ctx, request):
+        """{"pixels": bytes f32, "shape": [B, H, W, 3]} ->
+        {"embeddings": bytes f32, "shape": [B, N, proj_dim]}"""
+        import asyncio
+
+        pixels = np.frombuffer(request["pixels"], np.float32).reshape(
+            request["shape"]
+        )
+        out = await asyncio.get_running_loop().run_in_executor(
+            None,
+            lambda: np.asarray(self._forward(self._params, pixels), np.float32),
+        )
+        yield {"embeddings": out.tobytes(), "shape": list(out.shape)}
+
+
+class _EncoderAttachingManager(ModelManager):
+    """Every attached model pipeline gets the encode worker as its image
+    encoder, enabling image_pixels content parts."""
+
+    def __init__(self, encode_fn):
+        super().__init__()
+        self._encode_fn = encode_fn
+
+    def add(self, name, pipeline):
+        pipeline.image_encode_fn = self._encode_fn
+        super().add(name, pipeline)
+
+
+@service
+class MultimodalFrontend(_FrontendBase):
+    worker = depends(Worker)
+    encoder = depends(EncodeWorker)
+
+    async def setup(self):
+        async def encode_fn(pixels: np.ndarray) -> np.ndarray:
+            reply = await self.encoder.encode.unary(
+                {
+                    "pixels": np.asarray(pixels, np.float32).tobytes(),
+                    "shape": list(pixels.shape),
+                }
+            )
+            return np.frombuffer(reply["embeddings"], np.float32).reshape(
+                reply["shape"]
+            )
+
+        self._encode_fn = encode_fn
+        # Same bring-up as the base frontend, but with the attaching manager.
+        from dynamo_tpu.frontend import HttpService
+        from dynamo_tpu.frontend.service import ModelWatcher
+
+        manager = _EncoderAttachingManager(encode_fn)
+        self.http = HttpService(
+            manager,
+            host=self.config.get("host", "0.0.0.0"),
+            port=int(self.config.get("port", 8080)),
+        )
+        await self.http.start()
+        self.port = self.http.port
+        self._watcher = ModelWatcher(self.runtime, manager)
+        await self._watcher.start()

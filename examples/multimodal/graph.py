@@ -1,0 +1,11 @@
+"""Multimodal graph: MultimodalFrontend -> (Worker, EncodeWorker).
+
+    python -m dynamo_tpu.cli.run serve \
+        examples.multimodal.graph:MultimodalFrontend \
+        -f examples/multimodal/config.yaml
+"""
+
+from examples.llm.components import Worker
+from examples.multimodal.components import EncodeWorker, MultimodalFrontend
+
+__all__ = ["MultimodalFrontend", "Worker", "EncodeWorker"]
