@@ -568,6 +568,28 @@ def main(argv: Optional[list[str]] = None) -> None:
 
     sub.add_parser("env", help="print the serving environment report")
 
+    routerp = sub.add_parser(
+        "router", help="standalone KV-router service (routing-as-a-service)"
+    )
+    routerp.add_argument("--fabric", required=True, help="fabric host:port")
+    routerp.add_argument("--namespace", default="dynamo")
+    routerp.add_argument("--component", default="backend")
+    routerp.add_argument("--endpoint", default="generate")
+    routerp.add_argument(
+        "--block-size", type=int, default=64, dest="block_size",
+        help="token-block size (must match the workers' page size)",
+    )
+    routerp.add_argument(
+        "--salt", default=None,
+        help="hash salt — REQUIRED, must be the served model name "
+             "(workers content-address KV blocks with salt=<model>)",
+    )
+    routerp.add_argument(
+        "--host", default="127.0.0.1",
+        help="address this router advertises to frontends (must be "
+             "routable from other machines in multi-host deployments)",
+    )
+
     metricsp = sub.add_parser("metrics", help="Prometheus metrics service")
     metricsp.add_argument("--fabric", required=True, help="fabric host:port")
     metricsp.add_argument("--component", default="backend")
@@ -661,6 +683,12 @@ def main(argv: Optional[list[str]] = None) -> None:
 
     if args.cmd == "metrics":
         asyncio.run(_run_metrics(args))
+        return
+
+    if args.cmd == "router":
+        from dynamo_tpu.kv_router.service import run_router
+
+        asyncio.run(run_router(args))
         return
 
     if args.cmd == "serve":

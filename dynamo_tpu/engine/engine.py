@@ -174,12 +174,7 @@ class JaxEngine:
                     f"{len(mm_embeds)} multimodal embeddings but "
                     f"{len(mm_positions)} placeholder positions"
                 )
-            cfg = self.adapter.config
-            hdim = (
-                cfg.hidden_size
-                if hasattr(cfg, "hidden_size")
-                else cfg.base.hidden_size
-            )
+            hdim = self._hidden_size
             if mm_embeds.ndim != 2 or mm_embeds.shape[-1] != hdim:
                 # Reject here, where the runner returns the error to THIS
                 # client — a bad shape surfacing inside step() would wedge
@@ -285,13 +280,9 @@ class JaxEngine:
             any_mm = any(p.request.mm_embeds is not None for p in pieces)
             mm_embeds = mm_mask = None
             if any_mm:
-                hidden = self.adapter.config
-                hdim = (
-                    hidden.hidden_size
-                    if hasattr(hidden, "hidden_size")
-                    else hidden.base.hidden_size
+                mm_embeds = np.zeros(
+                    (b_bucket, t_bucket, self._hidden_size), np.float32
                 )
-                mm_embeds = np.zeros((b_bucket, t_bucket, hdim), np.float32)
                 mm_mask = np.zeros((b_bucket, t_bucket), bool)
             for i, piece in enumerate(pieces):
                 req = piece.request
@@ -686,6 +677,15 @@ class JaxEngine:
     #  extracts it from its own pool, and the transfer service injects it
     #  here — the reference's NIXL RDMA write path, dynamo_flow.md:36-38,
     #  re-done as explicit page movement through host/DCN for TPU.)
+
+    @property
+    def _hidden_size(self) -> int:
+        cfg = self.adapter.config
+        return (
+            cfg.hidden_size
+            if hasattr(cfg, "hidden_size")
+            else cfg.base.hidden_size
+        )
 
     @property
     def _canonical_head_dim(self) -> int:

@@ -1,0 +1,151 @@
+"""Standalone KV-router service: routing-as-a-service over the fabric.
+
+Reference parity: components/router (main.rs:36-40 —
+`Ingress::for_engine(KvRouter)`): a dedicated process that maintains the
+global KV prefix index + worker load state and answers placement queries,
+so many thin frontends can share one router's view instead of each
+building its own.
+
+Endpoints served (namespace/router/...):
+  choose   {token_ids, request_id?} -> {instance_id, matched_blocks}
+  feedback {request_id, tokens?|complete} — in-flight bookkeeping
+  state    {} -> router state snapshot (workers, load, index size)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Optional
+
+from dynamo_tpu.kv_router.kv_router import KvRouter, KvRouterConfig
+from dynamo_tpu.runtime import DistributedRuntime, IngressServer
+
+logger = logging.getLogger(__name__)
+
+
+class RouterService:
+    def __init__(
+        self,
+        runtime: DistributedRuntime,
+        namespace: str = "dynamo",
+        component: str = "backend",
+        endpoint: str = "generate",
+        block_size: int = 64,
+        salt: str = "",
+        config: Optional[KvRouterConfig] = None,
+        advertise_host: str = "127.0.0.1",
+    ):
+        self.runtime = runtime
+        self.namespace = namespace
+        self.component = component
+        self.endpoint = endpoint
+        self.block_size = block_size
+        self.salt = salt
+        self.config = config
+        self.advertise_host = advertise_host
+        self.router: Optional[KvRouter] = None
+        bind = (
+            "127.0.0.1"
+            if advertise_host in ("127.0.0.1", "localhost")
+            else "0.0.0.0"
+        )
+        self.ingress = IngressServer(host=bind)
+        self.registration = None
+        self.instance_id = ""
+
+    async def start(self) -> None:
+        ep = (
+            self.runtime.namespace(self.namespace)
+            .component(self.component)
+            .endpoint(self.endpoint)
+        )
+        src = await ep.instance_source()
+        self.router = KvRouter(
+            self.runtime.fabric,
+            self.component,
+            src,
+            block_size=self.block_size,
+            salt=self.salt,
+            config=self.config,
+        )
+        await self.router.start()
+        self.ingress.add_handler("choose", self._choose)
+        self.ingress.add_handler("feedback", self._feedback)
+        self.ingress.add_handler("state", self._state)
+        await self.ingress.start()
+        reg_ep = (
+            self.runtime.namespace(self.namespace)
+            .component("router")
+            .endpoint("choose")
+        )
+        self.registration = await reg_ep.register(
+            self.advertise_host, self.ingress.port,
+            metadata={"routes": self.component},
+        )
+        self.instance_id = self.registration.instance.instance_id
+        logger.info(
+            "router service %s up for %s/%s on :%d",
+            self.instance_id, self.namespace, self.component,
+            self.ingress.port,
+        )
+
+    async def stop(self) -> None:
+        await self.ingress.stop()
+        if self.router is not None:
+            await self.router.stop()
+
+    # -- handlers ----------------------------------------------------------
+
+    async def _choose(self, ctx, request: dict):
+        choice, matched = await self.router.find_best_match(
+            request.get("token_ids", ()),
+            request_id=request.get("request_id"),
+        )
+        yield {"instance_id": choice, "matched_blocks": matched}
+
+    async def _feedback(self, ctx, request: dict):
+        rid = request.get("request_id", "")
+        if request.get("complete"):
+            self.router.on_complete(rid)
+        else:
+            self.router.on_tokens(rid, int(request.get("tokens", 0)))
+        yield {"ok": True}
+
+    async def _state(self, ctx, request):
+        active = self.router.active
+        yield {
+            "workers": [i.instance_id for i in self.router.source.list()],
+            "load": self.router.metrics.snapshot(),
+            "active_blocks": {
+                w: active.active_blocks(w) for w in active.workers()
+            },
+        }
+
+
+async def run_router(args) -> None:
+    if not args.salt:
+        # The salt MUST match the workers' content-addressing salt (the
+        # model name — engine hashes with salt=config.model). A mismatch
+        # doesn't error; it silently zeroes every prefix match.
+        raise SystemExit(
+            "router: --salt is required and must be the served model name "
+            "(workers hash KV blocks with salt=<model>)"
+        )
+    rt = await DistributedRuntime.create(args.fabric)
+    svc = RouterService(
+        rt,
+        namespace=args.namespace,
+        component=args.component,
+        endpoint=args.endpoint,
+        block_size=args.block_size,
+        salt=args.salt,
+        advertise_host=args.host,
+    )
+    await svc.start()
+    print(f"router {svc.instance_id} up", flush=True)
+    try:
+        await asyncio.Event().wait()
+    finally:
+        await svc.stop()
+        await rt.close()

@@ -123,11 +123,18 @@ class _FrontendBase:
         self._watcher = None
         self.port = None
 
+    def _make_manager(self):
+        """Hook for deployments that wrap the manager (e.g. the multimodal
+        frontend attaches an image encoder to every pipeline)."""
+        from dynamo_tpu.frontend import ModelManager
+
+        return ModelManager()
+
     async def setup(self):
-        from dynamo_tpu.frontend import HttpService, ModelManager
+        from dynamo_tpu.frontend import HttpService
         from dynamo_tpu.frontend.service import ModelWatcher
 
-        manager = ModelManager()
+        manager = self._make_manager()
         self.http = HttpService(
             manager,
             host=self.config.get("host", "0.0.0.0"),

@@ -96,7 +96,7 @@ class MultimodalFrontend(_FrontendBase):
     worker = depends(Worker)
     encoder = depends(EncodeWorker)
 
-    async def setup(self):
+    def _make_manager(self):
         async def encode_fn(pixels: np.ndarray) -> np.ndarray:
             reply = await self.encoder.encode.unary(
                 {
@@ -108,18 +108,4 @@ class MultimodalFrontend(_FrontendBase):
                 reply["shape"]
             )
 
-        self._encode_fn = encode_fn
-        # Same bring-up as the base frontend, but with the attaching manager.
-        from dynamo_tpu.frontend import HttpService
-        from dynamo_tpu.frontend.service import ModelWatcher
-
-        manager = _EncoderAttachingManager(encode_fn)
-        self.http = HttpService(
-            manager,
-            host=self.config.get("host", "0.0.0.0"),
-            port=int(self.config.get("port", 8080)),
-        )
-        await self.http.start()
-        self.port = self.http.port
-        self._watcher = ModelWatcher(self.runtime, manager)
-        await self._watcher.start()
+        return _EncoderAttachingManager(encode_fn)

@@ -190,3 +190,86 @@ def test_kv_recorder_and_replay(tmp_path):
             await server.stop()
 
     run(main())
+
+
+def test_standalone_router_service():
+    """Routing-as-a-service (reference: components/router): a dedicated
+    RouterService answers choose/feedback/state over its ingress, with
+    its placement following KV events from workers."""
+    import asyncio
+
+    import msgpack
+
+    from dynamo_tpu.kv_router.service import RouterService
+    from dynamo_tpu.runtime import DistributedRuntime
+    from dynamo_tpu.runtime.fabric.local import LocalFabric
+    from dynamo_tpu.runtime.push_router import PushRouter
+    from dynamo_tpu.subjects import KV_EVENT_SUBJECT
+    from dynamo_tpu.tokens import hash_token_blocks
+
+    async def run():
+        fabric = LocalFabric()
+
+        async def rt_for():
+            lease = await fabric.grant_lease(1e12)
+            return DistributedRuntime(fabric, primary_lease=lease)
+
+        rt = await rt_for()
+        # two fake workers registered on the routed component
+        regs = []
+        for host_port in ((("127.0.0.1", 9001)), ("127.0.0.1", 9002)):
+            wrt = await rt_for()
+            ep = wrt.namespace("dynamo").component("backend").endpoint("generate")
+            regs.append(await ep.register(host_port[0], host_port[1]))
+
+        svc = RouterService(rt, block_size=4, salt="m")
+        await svc.start()
+        try:
+            # worker A announces cached blocks for a prompt prefix
+            prompt = [1, 2, 3, 4, 5, 6, 7, 8]
+            hashes = hash_token_blocks(prompt, block_size=4, salt="m")
+            a_id = regs[0].instance.instance_id
+            await fabric.publish(
+                f"{KV_EVENT_SUBJECT}.{a_id}",
+                {"instance_id": a_id, "count": 1},
+                msgpack.packb(
+                    [{
+                        "kind": "stored",
+                        "block_hashes": list(hashes),
+                        "parent_hash": None,
+                        "token_blocks": [prompt[:4], prompt[4:]],
+                    }],
+                    use_bin_type=True,
+                ),
+            )
+            await asyncio.sleep(0.3)
+
+            # query the service through its OWN registered endpoint
+            router_ep = (
+                rt.namespace("dynamo").component("router").endpoint("choose")
+            )
+            src = await router_ep.instance_source()
+            client = PushRouter(src, "choose")
+            replies = [
+                r async for r in client.generate(
+                    {"token_ids": prompt, "request_id": "q1"}
+                )
+            ]
+            assert replies[0]["instance_id"] == a_id
+            assert replies[0]["matched_blocks"] == 2
+
+            state_client = PushRouter(src, "state")
+            state = [r async for r in state_client.generate({})][0]
+            assert a_id in state["workers"]
+
+            fb = PushRouter(src, "feedback")
+            assert [
+                r async for r in fb.generate(
+                    {"request_id": "q1", "complete": True}
+                )
+            ][0]["ok"]
+            client.close(); state_client.close(); fb.close()
+        finally:
+            await svc.stop()
+
+    asyncio.run(run())
