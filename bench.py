@@ -29,7 +29,7 @@ def main() -> None:
 
     honor_jax_platforms_env()
     model = os.environ.get("BENCH_MODEL", "llama3-1b")
-    num_requests = int(os.environ.get("BENCH_REQUESTS", "16"))
+    num_requests = int(os.environ.get("BENCH_REQUESTS", "128"))
     isl = int(os.environ.get("BENCH_ISL", "128"))
     osl = int(os.environ.get("BENCH_OSL", "64"))
 
@@ -40,19 +40,25 @@ def main() -> None:
     from dynamo_tpu.engine.request import SamplingParams
 
     chunk = -(-max(128, isl) // 64) * 64  # page-aligned prefill chunk
+    # One wave: every request resident at once (weights amortize across
+    # the whole batch), pages sized for prompt+output per sequence.
+    pages_per_seq = -(-(isl + osl + 1) // 64)
     cfg = EngineConfig(
         model=model,
-        num_pages=512,
+        num_pages=max(512, num_requests * (pages_per_seq + 1)),
         page_size=64,
-        max_pages_per_seq=16,
-        decode_buckets=(1, 2, 4, 8, 16, 32),
+        max_pages_per_seq=max(16, pages_per_seq + 1),
+        decode_buckets=tuple(
+            b for b in (1, 2, 4, 8, 16, 32, 64, 128, 256, 512)
+            if b <= max(32, num_requests)
+        ) or (num_requests,),
         prefill_chunk=chunk,
         # Whole-workload dispatches: all prompts prefill in one batched
         # program; decode fuses K steps per host sync (the TPU sits behind
         # a ~65ms tunnel round-trip, so syncs dominate unamortized).
         prefill_token_budget=num_requests * chunk,
-        decode_steps=int(os.environ.get("BENCH_DECODE_STEPS", "32")),
-        max_seqs=32,
+        decode_steps=int(os.environ.get("BENCH_DECODE_STEPS", "64")),
+        max_seqs=max(32, num_requests),
         dtype="bfloat16",
         enable_prefix_caching=False,
     )

@@ -308,12 +308,16 @@ class JaxEngine:
             mm_args = (
                 (self._dev(mm_embeds), self._dev(mm_mask)) if any_mm else ()
             )
+            # Every piece starting at 0 (un-chunked prompts, no prefix
+            # hits — the common case) compiles a history-free program:
+            # attention over the in-register chunk only, no page gather.
+            first_chunk = all(p.start == 0 for p in pieces)
             if any_last:
                 reqs = [p.request for p in pieces]
                 samp, all_greedy = self._sampling_arrays(reqs, pad_to=b_bucket)
                 fn = self._get_step_fn(
                     "prefill", b_bucket, t_bucket, greedy=all_greedy,
-                    mm=any_mm,
+                    mm=any_mm, first_chunk=first_chunk,
                 )
                 token_ids, self.kv = fn(
                     *args, self._dev(last_idx), *samp, *mm_args
@@ -323,7 +327,8 @@ class JaxEngine:
                 # No piece finishes its prompt: KV writes only — skip the
                 # vocab-sized logits + sampling entirely.
                 fn = self._get_step_fn(
-                    "prefill_nosample", b_bucket, t_bucket, mm=any_mm
+                    "prefill_nosample", b_bucket, t_bucket, mm=any_mm,
+                    first_chunk=first_chunk,
                 )
                 self.kv = fn(*args, *mm_args)
                 ids = None
@@ -475,9 +480,9 @@ class JaxEngine:
 
     def _get_step_fn(
         self, kind: str, b: int, t: int, greedy: bool = False,
-        mm: bool = False,
+        mm: bool = False, first_chunk: bool = False,
     ) -> Callable:
-        cache_key = (kind, b, t, greedy, mm)
+        cache_key = (kind, b, t, greedy, mm, first_chunk)
         fn = self._jit_cache.get(cache_key)
         if fn is not None:
             return fn
@@ -541,6 +546,7 @@ class JaxEngine:
                 _, kv = adapter.forward_hidden(
                     params, tokens, positions, valid, kv, pt,
                     mm_embeds=mm_embeds, mm_mask=mm_mask,
+                    first_chunk=first_chunk,
                 )
                 return kv
 
@@ -555,6 +561,7 @@ class JaxEngine:
             hidden, kv = adapter.forward_hidden(
                 params, tokens, positions, valid, kv, pt,
                 mm_embeds=mm_embeds, mm_mask=mm_mask,
+                first_chunk=first_chunk,
             )
             rows = jnp.arange(hidden.shape[0])
             last_hidden = hidden[rows, last_idx]  # [B, H] — lm_head only here

@@ -481,6 +481,18 @@ def paged_attention(
     return out.reshape(b, t, hq * d).astype(q.dtype)
 
 
+def _chunk_only_attention(q, k, v, positions, valid, cfg, dpad):
+    """First-chunk fast path: no history exists, so attend over the
+    in-register chunk only — skips the O(MP·S) page gather and the
+    attention over its padding. Invalid (padding) keys are pushed past
+    every query position."""
+    cur_pos = jnp.where(valid, positions, jnp.int32(1 << 30))
+    if dpad:
+        k = k[..., : cfg.head_dim]
+        v = v[..., : cfg.head_dim]
+    return paged_attention(q, k, v, positions, cfg, key_positions=cur_pos)
+
+
 def attention_block(
     q: jax.Array,  # [B, T, Hq, D] pre-rope
     k: jax.Array,  # [B, T, Hkv, D] pre-rope
@@ -492,6 +504,7 @@ def attention_block(
     positions: jax.Array,  # [B, T] int32
     valid: jax.Array,  # [B, T] bool
     cfg: LlamaConfig,
+    first_chunk: bool = False,
 ):
     """rope → paged attention, in one of two write disciplines:
 
@@ -522,6 +535,9 @@ def attention_block(
         v_cache = paged_scatter(
             v_cache, layer, v, page_tables, positions, valid
         )
+        if first_chunk and t > 1:
+            attn = _chunk_only_attention(q, k, v, positions, valid, cfg, dpad)
+            return attn, k_cache, v_cache, None
         k_all = paged_gather(k_cache, layer, page_tables)
         v_all = paged_gather(v_cache, layer, page_tables)
         if dpad:
@@ -561,6 +577,8 @@ def attention_block(
         if dpad:
             out = out[..., : cfg.head_dim]
         attn = out.reshape(b, cfg.num_heads * cfg.head_dim)[:, None, :]
+    elif first_chunk:
+        attn = _chunk_only_attention(q, k, v, positions, valid, cfg, dpad)
     else:
         # Prefill chunk: history pages (positions < chunk start) + the
         # current chunk in registers, one causal mask over both.
@@ -601,6 +619,7 @@ def forward_hidden(
     page_tables: jax.Array,  # [B, MP] int32
     mm_embeds: Optional[jax.Array] = None,  # [B, T, H] multimodal embeds
     mm_mask: Optional[jax.Array] = None,  # [B, T] bool — use mm_embeds here
+    first_chunk: bool = False,  # static: every row starts at position 0
 ) -> tuple[jax.Array, KVPages]:
     """One model step over a token chunk; returns (hidden [B,T,H] post final
     norm, new kv). The engine applies `compute_logits` only at the positions
@@ -629,7 +648,8 @@ def forward_hidden(
         k = k.reshape(b, t, cfg.num_kv_heads, cfg.head_dim)
         v = v.reshape(b, t, cfg.num_kv_heads, cfg.head_dim)
         attn, k_full, v_full, staged = attention_block(
-            q, k, v, k_full, v_full, li, page_tables, positions, valid, cfg
+            q, k, v, k_full, v_full, li, page_tables, positions, valid, cfg,
+            first_chunk=first_chunk,
         )
         h = h + attn @ lp["wo"]
         x = rms_norm(h, lp["mlp_norm"], cfg.rms_norm_eps)

@@ -227,6 +227,7 @@ def forward_hidden(
     page_tables: jax.Array,
     mm_embeds=None,
     mm_mask=None,
+    first_chunk: bool = False,
 ) -> tuple[jax.Array, KVPages]:
     """Same contract as llama.forward_hidden (engine-compatible)."""
     bc = cfg.base
@@ -243,7 +244,8 @@ def forward_hidden(
         k = (x @ lp["wk"]).reshape(b, t, bc.num_kv_heads, bc.head_dim)
         v = (x @ lp["wv"]).reshape(b, t, bc.num_kv_heads, bc.head_dim)
         attn, k_full, v_full, staged = attention_block(
-            q, k, v, k_full, v_full, li, page_tables, positions, valid, bc
+            q, k, v, k_full, v_full, li, page_tables, positions, valid, bc,
+            first_chunk=first_chunk,
         )
         h = h + attn @ lp["wo"]
         x = rms_norm(h, lp["mlp_norm"], bc.rms_norm_eps)
