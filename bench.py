@@ -48,10 +48,13 @@ def main() -> None:
         num_pages=max(512, num_requests * (pages_per_seq + 1)),
         page_size=64,
         max_pages_per_seq=max(16, pages_per_seq + 1),
+        # Buckets up to and INCLUDING one that fits the whole batch, so
+        # decode really runs as one wave (the scheduler caps batches at
+        # decode_buckets[-1]).
         decode_buckets=tuple(
             b for b in (1, 2, 4, 8, 16, 32, 64, 128, 256, 512)
-            if b <= max(32, num_requests)
-        ) or (num_requests,),
+            if b < num_requests
+        ) + (num_requests,),
         prefill_chunk=chunk,
         # Whole-workload dispatches: all prompts prefill in one batched
         # program; decode fuses K steps per host sync (the TPU sits behind

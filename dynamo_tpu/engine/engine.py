@@ -81,22 +81,25 @@ class JaxEngine:
             raise ValueError(
                 f"unknown attention_impl {impl!r}; use auto|xla|pallas"
             )
-        if impl == "pallas" and mc.num_devices > 1:
-            raise ValueError(
-                "attention_impl='pallas' is single-chip only for now (the "
-                "kernel is not shard_map-wrapped for GSPMD); use 'auto'"
-            )
         if impl == "auto":
-            # The pallas decode kernel is not yet shard_map-wrapped for
-            # GSPMD partitioning, so multi-chip meshes stay on the XLA path.
-            impl = (
-                "pallas"
-                if jax.default_backend() == "tpu" and mc.num_devices == 1
-                else "xla"
-            )
+            impl = "pallas" if jax.default_backend() == "tpu" else "xla"
+        self.mesh = make_mesh(mc) if mc.num_devices > 1 else None
+        # Under a mesh the Pallas kernels run shard_mapped over tp (heads
+        # are embarrassingly parallel); the model needs the mesh object.
         self.adapter: ModelAdapter = get_model(
-            config.model, dtype=config.dtype, attention_impl=impl
+            config.model, dtype=config.dtype, attention_impl=impl,
+            mesh=self.mesh,
         )
+        if mc.tp > 1:
+            acfg = self.adapter.config
+            if not hasattr(acfg, "num_heads"):
+                acfg = acfg.base
+            if acfg.num_heads % mc.tp or acfg.num_kv_heads % mc.tp:
+                raise ValueError(
+                    f"tp={mc.tp} must divide num_heads ({acfg.num_heads}) "
+                    f"and num_kv_heads ({acfg.num_kv_heads}) for "
+                    "head-sharded attention"
+                )
         if config.host_kv_cache_bytes > 0 or config.disk_kv_cache_bytes > 0:
             from dynamo_tpu.kvbm import TieredPageAllocator
 
@@ -118,8 +121,6 @@ class JaxEngine:
         self.metrics = EngineMetrics(kv_total_pages=config.num_pages - 1)
         self._outputs_emitted: set[str] = set()
         self._jit_cache: dict[tuple, Callable] = {}
-
-        self.mesh = make_mesh(mc) if mc.num_devices > 1 else None
 
         if params is None:
             checkpoint_path = checkpoint_path or self.adapter.default_checkpoint

@@ -505,6 +505,7 @@ def attention_block(
     valid: jax.Array,  # [B, T] bool
     cfg: LlamaConfig,
     first_chunk: bool = False,
+    mesh=None,
 ):
     """rope → paged attention, in one of two write disciplines:
 
@@ -555,7 +556,7 @@ def attention_block(
             qd = jnp.pad(qd, ((0, 0), (0, 0), (0, dpad)))
         acc, m, l = paged_decode_attention(
             qd, k_cache, v_cache, layer, page_tables, hist,
-            scale_dim=cfg.head_dim,
+            scale_dim=cfg.head_dim, mesh=mesh,
         )  # acc [B,Hq,Dpad] unnormalized, m/l [B,Hq]
         # Exact merge of the current (unwritten) token: self-attention
         # score s = q·k_cur/√d folded into the flash running state.
@@ -620,6 +621,7 @@ def forward_hidden(
     mm_embeds: Optional[jax.Array] = None,  # [B, T, H] multimodal embeds
     mm_mask: Optional[jax.Array] = None,  # [B, T] bool — use mm_embeds here
     first_chunk: bool = False,  # static: every row starts at position 0
+    mesh=None,  # tp mesh: the Pallas kernels shard_map over it
 ) -> tuple[jax.Array, KVPages]:
     """One model step over a token chunk; returns (hidden [B,T,H] post final
     norm, new kv). The engine applies `compute_logits` only at the positions
@@ -649,7 +651,7 @@ def forward_hidden(
         v = v.reshape(b, t, cfg.num_kv_heads, cfg.head_dim)
         attn, k_full, v_full, staged = attention_block(
             q, k, v, k_full, v_full, li, page_tables, positions, valid, cfg,
-            first_chunk=first_chunk,
+            first_chunk=first_chunk, mesh=mesh,
         )
         h = h + attn @ lp["wo"]
         x = rms_norm(h, lp["mlp_norm"], cfg.rms_norm_eps)
@@ -664,13 +666,15 @@ def forward_hidden(
         (params["layers"], jnp.arange(cfg.num_layers, dtype=jnp.int32)),
     )
     k_new, v_new = land_staged_kv(
-        k_new, v_new, staged, page_tables, positions, valid
+        k_new, v_new, staged, page_tables, positions, valid, mesh=mesh
     )
     h = rms_norm(h, params["final_norm"], cfg.rms_norm_eps)
     return h, KVPages(k=k_new, v=v_new)
 
 
-def land_staged_kv(k_cache, v_cache, staged, page_tables, positions, valid):
+def land_staged_kv(
+    k_cache, v_cache, staged, page_tables, positions, valid, mesh=None
+):
     """Land a layer scan's staged KV (pallas write discipline) in one DMA
     kernel call; no-op under the xla scatter discipline (staged is None).
     Shared by the Llama and MoE forward passes."""
@@ -679,7 +683,8 @@ def land_staged_kv(k_cache, v_cache, staged, page_tables, positions, valid):
     from dynamo_tpu.ops.kv_update import paged_write
 
     return paged_write(
-        k_cache, v_cache, staged[0], staged[1], page_tables, positions, valid
+        k_cache, v_cache, staged[0], staged[1], page_tables, positions,
+        valid, mesh=mesh,
     )
 
 

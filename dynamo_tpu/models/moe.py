@@ -228,6 +228,7 @@ def forward_hidden(
     mm_embeds=None,
     mm_mask=None,
     first_chunk: bool = False,
+    mesh=None,
 ) -> tuple[jax.Array, KVPages]:
     """Same contract as llama.forward_hidden (engine-compatible)."""
     bc = cfg.base
@@ -245,7 +246,7 @@ def forward_hidden(
         v = (x @ lp["wv"]).reshape(b, t, bc.num_kv_heads, bc.head_dim)
         attn, k_full, v_full, staged = attention_block(
             q, k, v, k_full, v_full, li, page_tables, positions, valid, bc,
-            first_chunk=first_chunk,
+            first_chunk=first_chunk, mesh=mesh,
         )
         h = h + attn @ lp["wo"]
         x = rms_norm(h, lp["mlp_norm"], bc.rms_norm_eps)
@@ -258,7 +259,7 @@ def forward_hidden(
         (params["layers"], jnp.arange(bc.num_layers, dtype=jnp.int32)),
     )
     k_new, v_new = land_staged_kv(
-        k_new, v_new, staged, page_tables, positions, valid
+        k_new, v_new, staged, page_tables, positions, valid, mesh=mesh
     )
     h = rms_norm(h, params["final_norm"], bc.rms_norm_eps)
     return h, KVPages(k=k_new, v=v_new)

@@ -52,7 +52,9 @@ _LLAMA_PRESETS: dict[str, Callable[[], LlamaConfig]] = {
 }
 
 
-def _llama_adapter(name: str, cfg: LlamaConfig) -> ModelAdapter:
+def _llama_adapter(
+    name: str, cfg: LlamaConfig, mesh=None
+) -> ModelAdapter:
     from dynamo_tpu.parallel.shardings import kv_cache_spec, llama_param_specs
 
     def forward(params, tokens, positions, valid, kv, page_tables):
@@ -62,7 +64,8 @@ def _llama_adapter(name: str, cfg: LlamaConfig) -> ModelAdapter:
         params, tokens, positions, valid, kv, page_tables, **mm
     ):
         return llama_mod.forward_hidden(
-            params, cfg, tokens, positions, valid, kv, page_tables, **mm
+            params, cfg, tokens, positions, valid, kv, page_tables,
+            mesh=mesh, **mm
         )
 
     return ModelAdapter(
@@ -93,7 +96,7 @@ def _load_llama_checkpoint(path: str, cfg: LlamaConfig):
     return llama_mod.params_from_torch_state_dict(model.state_dict(), cfg)
 
 
-def _moe_adapter(name: str, moe_cfg) -> ModelAdapter:
+def _moe_adapter(name: str, moe_cfg, mesh=None) -> ModelAdapter:
     from dynamo_tpu.models import moe as moe_mod
     from dynamo_tpu.parallel.shardings import kv_cache_spec
 
@@ -104,7 +107,7 @@ def _moe_adapter(name: str, moe_cfg) -> ModelAdapter:
 
     def fwd_hidden(params, tokens, positions, valid, kv, pt, **mm):
         return moe_mod.forward_hidden(
-            params, cfg, tokens, positions, valid, kv, pt, **mm
+            params, cfg, tokens, positions, valid, kv, pt, mesh=mesh, **mm
         )
 
     def load(path):
@@ -139,6 +142,7 @@ def get_model(
     name: str,
     dtype: Optional[str] = None,
     attention_impl: Optional[str] = None,
+    mesh=None,
 ) -> ModelAdapter:
     """Resolve a model name: preset id, or a local HF checkpoint dir."""
     from dynamo_tpu.models.moe import MoeConfig
@@ -189,7 +193,7 @@ def get_model(
                 moe_cfg,
                 base=replace(moe_cfg.base, attention_impl=attention_impl),
             )
-        moe_adapter = _moe_adapter(name, moe_cfg)
+        moe_adapter = _moe_adapter(name, moe_cfg, mesh=mesh)
         if os.path.isdir(name):
             moe_adapter = replace(moe_adapter, default_checkpoint=name)
         return moe_adapter
@@ -197,7 +201,7 @@ def get_model(
         cfg = _with_dtype(cfg, dtype)
     if attention_impl is not None:
         cfg = replace(cfg, attention_impl=attention_impl)
-    adapter = _llama_adapter(name, cfg)
+    adapter = _llama_adapter(name, cfg, mesh=mesh)
     if gguf_path is not None:
         from dynamo_tpu.gguf import read_gguf
 

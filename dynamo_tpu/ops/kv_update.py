@@ -87,15 +87,38 @@ def paged_write(
     valid: jax.Array,  # [B, T] bool
     *,
     use_kernel: bool | None = None,
+    mesh=None,
 ) -> tuple[jax.Array, jax.Array]:
     """Write one step's staged KV for all layers into the caches in place.
 
     Requires T == 1 (decode) or page-aligned chunk starts with T a multiple
     of min(T, S) (prefill — guaranteed by the scheduler's page-aligned
-    chunking). `use_kernel` defaults to True on TPU.
+    chunking). `use_kernel` defaults to True on TPU. Under a tp mesh the
+    kernel is shard_mapped: staging and cache both shard on the kv-head
+    axis, every shard writes its own lanes of the same rows.
     """
     if use_kernel is None:
         use_kernel = jax.default_backend() == "tpu"
+    if use_kernel and mesh is not None and mesh.shape.get("tp", 1) > 1:
+        from functools import partial
+
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        kv_spec = P(None, None, None, "tp", None)
+        fn = shard_map(
+            partial(paged_write, use_kernel=True, mesh=None),
+            mesh=mesh,
+            in_specs=(
+                kv_spec, kv_spec, kv_spec, kv_spec,
+                P(None, None), P(None, None), P(None, None),
+            ),
+            out_specs=(kv_spec, kv_spec),
+            check_vma=False,
+        )
+        return fn(
+            k_cache, v_cache, k_stage, v_stage, page_tables, positions, valid
+        )
     L, b, t = k_stage.shape[0], k_stage.shape[1], k_stage.shape[2]
     s = k_cache.shape[2]
 

@@ -151,6 +151,7 @@ def paged_decode_attention(
     *,
     scale_dim: int | None = None,
     interpret: bool | None = None,
+    mesh=None,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """History-only flash attention over the paged cache.
 
@@ -163,6 +164,35 @@ def paged_decode_attention(
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    if mesh is not None and mesh.shape.get("tp", 1) > 1:
+        # Heads are embarrassingly parallel: shard_map the kernel over tp
+        # (q/outputs on the head axis, caches on the kv-head axis) — each
+        # shard walks the same pages for its own heads, no collectives.
+        from functools import partial
+
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        fn = shard_map(
+            partial(
+                paged_decode_attention,
+                scale_dim=scale_dim,
+                interpret=interpret,
+                mesh=None,
+            ),
+            mesh=mesh,
+            in_specs=(
+                P(None, "tp", None),
+                P(None, None, None, "tp", None),
+                P(None, None, None, "tp", None),
+                P(),
+                P(),
+                P(),
+            ),
+            out_specs=(P(None, "tp", None), P(None, "tp"), P(None, "tp")),
+            check_vma=False,
+        )
+        return fn(q, k_cache, v_cache, layer, page_tables, history_lens)
     b, hq, d = q.shape
     hkv, s = k_cache.shape[3], k_cache.shape[2]
 
