@@ -1,0 +1,73 @@
+"""Soak: sustained concurrent load through the full distributed stack
+(reference: lib/runtime/tests/soak.rs). Kept short enough for CI; the
+shape — many overlapping streaming requests against real fabric + worker
+processes-in-tasks — is what matters."""
+
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.model_card import ModelDeploymentCard
+from dynamo_tpu.runtime import DistributedRuntime
+from dynamo_tpu.runtime.fabric.local import LocalFabric
+from dynamo_tpu.worker import Worker
+
+
+@pytest.mark.parametrize("num_clients,requests_each", [(8, 6)])
+def test_soak_concurrent_streams(num_clients, requests_each):
+    async def run():
+        fabric = LocalFabric()
+
+        async def rt():
+            lease = await fabric.grant_lease(1e12)
+            return DistributedRuntime(fabric, primary_lease=lease)
+
+        card = ModelDeploymentCard(name="tiny", context_length=128, kv_page_size=4)
+        workers = []
+        for _ in range(2):
+            w = Worker(await rt(), card, engine_kind="echo")
+            await w.start()
+            workers.append(w)
+
+        crt = await rt()
+        ep = crt.namespace("dynamo").component("backend").endpoint("generate")
+        router = await ep.router()
+
+        total = {"tokens": 0, "streams": 0}
+
+        async def client(cid: int):
+            for r in range(requests_each):
+                prompt = list(range(1, 12 + (cid + r) % 7))
+                got = []
+                async for item in router.generate(
+                    {
+                        "request_id": f"c{cid}r{r}",
+                        "token_ids": prompt,
+                        "max_tokens": 8,
+                        "temperature": 0.0,
+                        "top_p": 1.0,
+                        "top_k": 0,
+                        "seed": None,
+                        "stop_token_ids": [],
+                        "stop_strings": [],
+                        "ignore_eos": False,
+                        "annotations": {},
+                    }
+                ):
+                    got.extend(item.get("token_ids", ()))
+                # echo engine returns the prompt back (bounded by max_tokens)
+                assert got == prompt[: min(len(prompt), 8)]
+                total["tokens"] += len(got)
+                total["streams"] += 1
+
+        await asyncio.gather(*(client(i) for i in range(num_clients)))
+        assert total["streams"] == num_clients * requests_each
+        assert total["tokens"] > 0
+
+        router.close()
+        for w in workers:
+            await w.stop()
+
+    asyncio.run(run())
