@@ -113,9 +113,21 @@ class HttpService:
         )
 
     async def clear_kv_blocks(self, request: web.Request) -> web.Response:
-        # Engine workers expose cache flush via their admin endpoint; the
-        # frontend acknowledges and the flush fans out through the fabric.
-        return web.json_response({"status": "accepted"})
+        """Flush reusable (cached, unreferenced) KV pages on every worker
+        of every attached model (reference: /clear_kv_blocks fan-out)."""
+        results: dict[str, int] = {}
+        for name in self.manager.list_models():
+            pipeline = self.manager.get(name)
+            if pipeline is None or pipeline.flush_fn is None:
+                continue
+            try:
+                results[name] = await pipeline.flush_fn()
+            except Exception as e:
+                logger.warning("flush for %s failed: %s", name, e)
+                results[name] = -1
+        return web.json_response(
+            {"status": "ok", "cleared_pages": results}
+        )
 
     async def embeddings(self, request: web.Request) -> web.Response:
         t0 = time.time()

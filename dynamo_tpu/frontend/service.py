@@ -57,6 +57,8 @@ class ModelPipeline:
         #: embeddings — attached by multimodal deployments (the encode
         #: worker); enables image_pixels content parts
         self.image_encode_fn = None
+        #: async () -> cleared page count (the /clear_kv_blocks fan-out)
+        self.flush_fn = None
 
     async def chat_stream(
         self, request: ChatCompletionRequest, context: Optional[Context] = None
@@ -226,11 +228,27 @@ class ModelPipeline:
 
 def local_pipeline(card: ModelDeploymentCard, async_engine) -> ModelPipeline:
     """Single-process pipeline over an in-process AsyncEngine."""
-    return ModelPipeline(
+    pipeline = ModelPipeline(
         card,
         engine_fn=async_engine.generate,
         embed_fn=getattr(async_engine, "embed", None),
     )
+    if hasattr(async_engine, "submit"):
+        # AsyncEngineRunner: the engine thread is the only thread allowed
+        # to touch the allocator — route the flush through it.
+        async def flush_fn() -> int:
+            return await async_engine.submit(
+                lambda eng: eng.allocator.clear_cache()
+            )
+
+        pipeline.flush_fn = flush_fn
+    elif hasattr(async_engine, "allocator"):
+        # Loop-driven test engines (mock): no engine thread to race.
+        async def flush_fn() -> int:
+            return async_engine.allocator.clear_cache()
+
+        pipeline.flush_fn = flush_fn
+    return pipeline
 
 
 def router_pipeline(
@@ -258,6 +276,7 @@ def router_pipeline(
     async def close_fn():
         router.close()
         embed_router.close()
+        flush_router.close()
         if kv_router is not None:
             await kv_router.stop()
 
@@ -273,9 +292,32 @@ def router_pipeline(
             return reply["embeddings"]
         raise RuntimeError("embed worker returned no reply")
 
-    return ModelPipeline(
+    flush_router = PushRouter(
+        router.source, "flush", mode=RouterMode.DIRECT
+    )
+
+    async def flush_fn() -> int:
+        """Fan /clear_kv_blocks out to EVERY live worker instance. A dead
+        instance (lease not yet expired) must not abort the fan-out —
+        the rest still flush and partial counts survive."""
+        cleared = 0
+        for inst in router.source.list():
+            try:
+                async for reply in flush_router.generate(
+                    {}, instance_id=inst.instance_id
+                ):
+                    cleared += int(reply.get("cleared_pages", 0))
+            except Exception as e:
+                logger.warning(
+                    "flush on %s failed: %s", inst.instance_id, e
+                )
+        return cleared
+
+    pipeline = ModelPipeline(
         card, engine_fn=engine_fn, close_fn=close_fn, embed_fn=embed_fn
     )
+    pipeline.flush_fn = flush_fn
+    return pipeline
 
 
 class ModelManager:

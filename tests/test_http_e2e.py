@@ -257,3 +257,60 @@ def test_http_with_real_jax_engine():
         runner.stop()
 
     run(main())
+
+
+def test_clear_kv_blocks_fans_out_to_workers():
+    """/clear_kv_blocks flushes reusable cached pages on every worker of
+    every attached model (reference: the clear_kv_blocks admin route)."""
+    import asyncio
+
+    from dynamo_tpu.frontend.http import HttpService
+    from dynamo_tpu.frontend.service import ModelManager, ModelWatcher
+    from dynamo_tpu.model_card import ModelDeploymentCard
+    from dynamo_tpu.runtime import DistributedRuntime
+    from dynamo_tpu.runtime.fabric.local import LocalFabric
+    from dynamo_tpu.worker import Worker
+
+    async def run():
+        fabric = LocalFabric()
+
+        async def rt():
+            lease = await fabric.grant_lease(1e12)
+            return DistributedRuntime(fabric, primary_lease=lease)
+
+        card = ModelDeploymentCard(
+            name="tiny", context_length=64, kv_page_size=4
+        )
+        worker = Worker(await rt(), card, engine_kind="mock")
+        await worker.start()
+
+        frt = await rt()
+        manager = ModelManager()
+        watcher = ModelWatcher(frt, manager)
+        await watcher.start()
+        svc = HttpService(manager, host="127.0.0.1", port=0)
+        await svc.start()
+        try:
+            await asyncio.sleep(0.3)  # model attach
+            base = f"http://127.0.0.1:{svc.port}"
+            async with aiohttp.ClientSession() as sess:
+                # generate something so the mock engine caches pages
+                r = await sess.post(
+                    f"{base}/v1/completions",
+                    json={"model": "tiny", "prompt": "hello world prompt",
+                          "max_tokens": 4},
+                )
+                assert r.status == 200, await r.text()
+                r2 = await sess.post(f"{base}/clear_kv_blocks")
+                assert r2.status == 200
+                body = await r2.json()
+                assert body["status"] == "ok"
+                # the completion above cached reclaimable pages: a real
+                # flush must drop a nonzero count (0 = silent no-op bug)
+                assert body["cleared_pages"]["tiny"] > 0
+        finally:
+            await svc.stop()
+            await watcher.stop()
+            await worker.stop()
+
+    asyncio.run(run())
