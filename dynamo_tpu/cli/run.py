@@ -37,6 +37,7 @@ def _engine_config(args, eos_token_ids: tuple = ()) -> EngineConfig:
         dtype=args.dtype,
         dp=args.dp,
         tp=args.tp,
+        sp=getattr(args, "sp", 1),
         eos_token_ids=tuple(eos_token_ids) or (0,),
     )
 
@@ -511,6 +512,10 @@ def main(argv: Optional[list[str]] = None) -> None:
     runp.add_argument("--dtype", default="bfloat16")
     runp.add_argument("--dp", type=int, default=1)
     runp.add_argument("--tp", type=int, default=1)
+    runp.add_argument(
+        "--sp", type=int, default=1,
+        help="sequence-parallel devices: long prefills use ring attention",
+    )
 
     fabricp = sub.add_parser("fabric", help="start the fabric server")
     fabricp.add_argument("--host", default="127.0.0.1")

@@ -50,6 +50,8 @@ class PrefillWorker:
         self.instance_id = ""
         self.prefills_done = 0
         self._task: Optional[asyncio.Task] = None
+        self._flush_sub = None
+        self._flush_task: Optional[asyncio.Task] = None
         self._sem = asyncio.Semaphore(max_concurrent)
 
     async def start(self) -> None:
@@ -75,8 +77,24 @@ class PrefillWorker:
             "127.0.0.1", 0, metadata={"model": self.engine_config.model}
         )
         self.instance_id = self.registration.instance.instance_id
-        self._task = asyncio.get_running_loop().create_task(self._consume_loop())
+        loop = asyncio.get_running_loop()
+        self._task = loop.create_task(self._consume_loop())
+        # No ingress here — admin flush arrives as a fabric broadcast.
+        from dynamo_tpu.subjects import FLUSH_SUBJECT
+
+        self._flush_sub = await self.runtime.fabric.subscribe(FLUSH_SUBJECT)
+        self._flush_task = loop.create_task(self._flush_loop())
         logger.info("prefill worker %s consuming %s", self.instance_id, self.queue.name)
+
+    async def _flush_loop(self) -> None:
+        async for _ in self._flush_sub:
+            try:
+                n = await self.runner.submit(
+                    lambda eng: eng.allocator.clear_cache()
+                )
+                logger.info("admin flush: cleared %d cached pages", n)
+            except Exception:
+                logger.exception("admin flush failed")
 
     MAX_ATTEMPTS = 3
 
@@ -190,6 +208,9 @@ class PrefillWorker:
     async def stop(self) -> None:
         if self._task is not None:
             self._task.cancel()
+        if getattr(self, "_flush_task", None) is not None:
+            self._flush_sub.close()
+            self._flush_task.cancel()
         self.transfer.close()
         if self.registration is not None:
             await self.registration.deregister()

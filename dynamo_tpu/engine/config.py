@@ -52,6 +52,9 @@ class EngineConfig:
     #: mesh layout
     dp: int = 1
     tp: int = 1
+    #: sequence/context parallel: long first-chunk prefills run ring
+    #: attention over this many devices (parallel/context.py)
+    sp: int = 1
     #: random seed for sampling
     seed: int = 0
     #: enable content-addressed prefix caching
@@ -69,6 +72,15 @@ class EngineConfig:
                 f"prefill_chunk ({self.prefill_chunk}) must be a multiple of "
                 f"page_size ({self.page_size}) — chunks start page-aligned "
                 "so the KV write path can land whole-page DMA runs"
+            )
+        if self.sp > 1 and (32 % self.sp != 0 or self.prefill_chunk % self.sp):
+            # Prefill T buckets are powers of two from 32 up to
+            # prefill_chunk; sp must divide every one of them or the ring
+            # path silently never engages.
+            raise ValueError(
+                f"sp ({self.sp}) must be a power of two <= 32 that divides "
+                f"prefill_chunk ({self.prefill_chunk}) — prefill length "
+                "buckets must shard evenly over the sequence-parallel axis"
             )
         if (
             self.prefill_token_budget is not None

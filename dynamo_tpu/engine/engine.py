@@ -75,7 +75,7 @@ class JaxEngine:
         checkpoint_path: Optional[str] = None,
     ):
         self.config = config
-        mc = mesh_config or MeshConfig(dp=config.dp, tp=config.tp)
+        mc = mesh_config or MeshConfig(dp=config.dp, tp=config.tp, sp=config.sp)
         impl = config.attention_impl
         if impl not in ("auto", "xla", "pallas"):
             raise ValueError(

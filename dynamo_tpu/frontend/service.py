@@ -252,7 +252,7 @@ def local_pipeline(card: ModelDeploymentCard, async_engine) -> ModelPipeline:
 
 
 def router_pipeline(
-    card: ModelDeploymentCard, router: PushRouter, kv_router=None
+    card: ModelDeploymentCard, router: PushRouter, kv_router=None, fabric=None
 ) -> ModelPipeline:
     """Distributed pipeline: push preprocessed requests to workers. With a
     KvRouter attached, per-token and completion feedback keep its local
@@ -311,6 +311,12 @@ def router_pipeline(
                 logger.warning(
                     "flush on %s failed: %s", inst.instance_id, e
                 )
+        if fabric is not None:
+            # Broadcast for fleet members the frontend has no route to
+            # (disaggregated prefill workers consume queues, not RPC).
+            from dynamo_tpu.subjects import FLUSH_SUBJECT
+
+            await fabric.publish(FLUSH_SUBJECT, {"source": "frontend"})
         return cleared
 
     pipeline = ModelPipeline(
@@ -396,11 +402,18 @@ class ModelWatcher:
                 src, ep.name, mode=mode, kv_chooser=kv_router.choose
             )
             self.manager.add(
-                entry.model, router_pipeline(card, router, kv_router=kv_router)
+                entry.model,
+                router_pipeline(
+                    card, router, kv_router=kv_router,
+                    fabric=self.runtime.fabric,
+                ),
             )
             return
         router = await ep.router(mode=mode)
-        self.manager.add(entry.model, router_pipeline(card, router))
+        self.manager.add(
+            entry.model,
+            router_pipeline(card, router, fabric=self.runtime.fabric),
+        )
 
     async def _on_delete(self, key: str) -> None:
         for model, keys in list(self._entries.items()):

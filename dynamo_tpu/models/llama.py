@@ -481,15 +481,34 @@ def paged_attention(
     return out.reshape(b, t, hq * d).astype(q.dtype)
 
 
-def _chunk_only_attention(q, k, v, positions, valid, cfg, dpad):
+def _chunk_only_attention(q, k, v, positions, valid, cfg, dpad, mesh=None):
     """First-chunk fast path: no history exists, so attend over the
     in-register chunk only — skips the O(MP·S) page gather and the
     attention over its padding. Invalid (padding) keys are pushed past
-    every query position."""
-    cur_pos = jnp.where(valid, positions, jnp.int32(1 << 30))
+    every query position.
+
+    Long-context: under a mesh with an sp axis, the chunk's causal
+    attention runs as ring attention over the sequence shards (ICI
+    ppermute of K/V blocks — parallel/context.py), so a prompt too long
+    for one chip's attention memory prefills across the sp group. Valid
+    first-chunk positions are contiguous from 0, so index-causal masking
+    equals position masking; padding sits past every valid query."""
     if dpad:
         k = k[..., : cfg.head_dim]
         v = v[..., : cfg.head_dim]
+    sp = mesh.shape.get("sp", 1) if mesh is not None else 1
+    t = q.shape[1]
+    if sp > 1 and t % sp == 0 and t > 1:
+        from dynamo_tpu.parallel.context import ring_attention
+
+        out = ring_attention(
+            q, k, v, mesh=mesh, causal=True,
+            batch_axis="dp" if mesh.shape.get("dp", 1) > 1 else None,
+            head_axis="tp" if mesh.shape.get("tp", 1) > 1 else None,
+        )
+        b, _, hq, d = q.shape
+        return out.reshape(b, t, hq * d)
+    cur_pos = jnp.where(valid, positions, jnp.int32(1 << 30))
     return paged_attention(q, k, v, positions, cfg, key_positions=cur_pos)
 
 
@@ -537,7 +556,9 @@ def attention_block(
             v_cache, layer, v, page_tables, positions, valid
         )
         if first_chunk and t > 1:
-            attn = _chunk_only_attention(q, k, v, positions, valid, cfg, dpad)
+            attn = _chunk_only_attention(
+                q, k, v, positions, valid, cfg, dpad, mesh=mesh
+            )
             return attn, k_cache, v_cache, None
         k_all = paged_gather(k_cache, layer, page_tables)
         v_all = paged_gather(v_cache, layer, page_tables)
@@ -579,7 +600,9 @@ def attention_block(
             out = out[..., : cfg.head_dim]
         attn = out.reshape(b, cfg.num_heads * cfg.head_dim)[:, None, :]
     elif first_chunk:
-        attn = _chunk_only_attention(q, k, v, positions, valid, cfg, dpad)
+        attn = _chunk_only_attention(
+            q, k, v, positions, valid, cfg, dpad, mesh=mesh
+        )
     else:
         # Prefill chunk: history pages (positions < chunk start) + the
         # current chunk in registers, one causal mask over both.

@@ -139,13 +139,21 @@ def ring_attention(
     mesh: Mesh,
     axis_name: str = "sp",
     causal: bool = True,
+    batch_axis: str | None = None,  # shard B over this mesh axis (dp)
+    head_axis: str | None = None,  # shard heads over this mesh axis (tp)
 ) -> jax.Array:
     """Sequence-parallel causal attention over the sp ring. T must divide
-    evenly by the sp axis size."""
+    evenly by the sp axis size.
+
+    When the ambient mesh also carries dp/tp axes, pass them as
+    batch_axis/head_axis so the region stays batch- and head-sharded —
+    omitting them would all-gather every head and batch row onto every
+    device inside the shard_map (O(tp·dp) redundant attention work on the
+    long-prompt path whose point is reducing per-chip memory)."""
     sp = mesh.shape[axis_name]
     if q.shape[1] % sp:
         raise ValueError(f"T={q.shape[1]} not divisible by sp={sp}")
-    spec = P(None, axis_name, None, None)
+    spec = P(batch_axis, axis_name, head_axis, None)
     fn = shard_map(
         partial(_ring_shard, axis_name=axis_name, causal=causal),
         mesh=mesh,

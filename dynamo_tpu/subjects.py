@@ -14,3 +14,7 @@ METRICS_SUBJECT = "metrics"
 
 #: router-emitted per-decision prefix-cache hit rates
 KV_HIT_RATE_SUBJECT = "kv-hit-rate"
+
+#: admin broadcast: every worker (decode AND prefill) flushes reusable KV
+#: pages on receipt — reaches fleet members the frontend has no route to
+FLUSH_SUBJECT = "admin.flush"

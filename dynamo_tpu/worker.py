@@ -307,7 +307,11 @@ class Worker:
     async def _flush(self, ctx, request):
         n = 0
         if self.runner is not None:
-            n = self.runner.engine.allocator.clear_cache()
+            # The engine thread is the only thread allowed to touch the
+            # allocator — route through it.
+            n = await self.runner.submit(
+                lambda eng: eng.allocator.clear_cache()
+            )
         elif self.mock is not None:
             n = self.mock.allocator.clear_cache()
         yield {"cleared_pages": n}
