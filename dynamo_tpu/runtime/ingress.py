@@ -39,6 +39,11 @@ class IngressServer:
     def add_handler(self, endpoint: str, handler: Handler) -> None:
         self._handlers[endpoint] = handler
 
+    @property
+    def num_inflight(self) -> int:
+        """Live handler calls (used by graceful drain)."""
+        return len(self._inflight)
+
     async def start(self) -> None:
         self._server = await asyncio.start_server(self._handle, self.host, self.port)
         self.port = self._server.sockets[0].getsockname()[1]

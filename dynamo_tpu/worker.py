@@ -160,7 +160,42 @@ class Worker:
             self.ingress.port,
         )
 
-    async def stop(self) -> None:
+    async def stop(self, drain_timeout: float = 30.0) -> None:
+        """Graceful shutdown (reference: the vLLM drain handlers,
+        examples worker.py:156-170): deregister FIRST so routers stop
+        sending here, let in-flight requests finish up to drain_timeout,
+        then tear the planes down."""
+        if self.registration is not None:
+            try:
+                await self.registration.deregister()
+            except Exception:
+                # Routers will keep sending until the lease expires — make
+                # that window observable instead of silent.
+                logger.warning(
+                    "deregister failed; relying on lease expiry",
+                    exc_info=True,
+                )
+            self.registration = None
+        if drain_timeout > 0:
+
+            def busy() -> bool:
+                # ingress inflight covers the whole request lifecycle —
+                # runner._pending hand-off, disagg transfer waits, and the
+                # final response frames — not just scheduler occupancy.
+                if self.ingress.num_inflight > 0:
+                    return True
+                return (
+                    self.runner is not None and self.runner.engine.has_work
+                )
+
+            deadline = asyncio.get_running_loop().time() + drain_timeout
+            while busy() and asyncio.get_running_loop().time() < deadline:
+                await asyncio.sleep(0.05)
+            if busy():
+                logger.warning(
+                    "drain timeout: %d calls still in flight; closing",
+                    self.ingress.num_inflight,
+                )
         for t in self._tasks:
             t.cancel()
         await self.ingress.stop()

@@ -71,3 +71,48 @@ def test_soak_concurrent_streams(num_clients, requests_each):
             await w.stop()
 
     asyncio.run(run())
+
+
+def test_graceful_drain_completes_inflight_stream():
+    """Worker.stop() deregisters first, then lets in-flight streams finish
+    (reference: engine drain on shutdown) — a slow streaming request
+    started before stop() must complete, not reset."""
+    import asyncio
+
+    from dynamo_tpu.engine.async_engine import EchoEngine
+
+    async def run():
+        fabric = LocalFabric()
+
+        async def rt():
+            lease = await fabric.grant_lease(1e12)
+            return DistributedRuntime(fabric, primary_lease=lease)
+
+        card = ModelDeploymentCard(name="tiny", context_length=64, kv_page_size=4)
+        w = Worker(await rt(), card, engine_kind="echo")
+        await w.start()
+        w.echo = EchoEngine(delay=0.05)  # ~0.6s stream
+
+        crt = await rt()
+        ep = crt.namespace("dynamo").component("backend").endpoint("generate")
+        router = await ep.router()
+        prompt = list(range(1, 13))
+
+        async def consume():
+            got = []
+            async for item in router.generate(
+                {"request_id": "slow", "token_ids": prompt, "max_tokens": 12,
+                 "temperature": 0.0, "top_p": 1.0, "top_k": 0, "seed": None,
+                 "stop_token_ids": [], "stop_strings": [],
+                 "ignore_eos": False, "annotations": {}}
+            ):
+                got.extend(item.get("token_ids", ()))
+            return got
+
+        stream = asyncio.create_task(consume())
+        await asyncio.sleep(0.1)  # stream is mid-flight
+        await w.stop(drain_timeout=10.0)
+        assert await stream == prompt  # completed, not reset
+        router.close()
+
+    asyncio.run(run())
