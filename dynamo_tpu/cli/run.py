@@ -516,6 +516,18 @@ def main(argv: Optional[list[str]] = None) -> None:
         "--sp", type=int, default=1,
         help="sequence-parallel devices: long prefills use ring attention",
     )
+    runp.add_argument(
+        "--coordinator", default=None,
+        help="multi-host: coordinator host:port (same on every host)",
+    )
+    runp.add_argument(
+        "--num-hosts", type=int, default=1, dest="num_hosts",
+        help="multi-host: total participating host processes",
+    )
+    runp.add_argument(
+        "--host-id", type=int, default=0, dest="host_id",
+        help="multi-host: this process's rank (0..num-hosts-1)",
+    )
 
     fabricp = sub.add_parser("fabric", help="start the fabric server")
     fabricp.add_argument("--host", default="127.0.0.1")
@@ -707,6 +719,16 @@ def main(argv: Optional[list[str]] = None) -> None:
     io = dict(kv.split("=", 1) for kv in args.io if "=" in kv)
     inp = io.get("in", "text")
     args.out = io.get("out", "jax")
+
+    if getattr(args, "coordinator", None):
+        from dynamo_tpu.parallel.mesh import init_multihost
+
+        n = init_multihost(args.coordinator, args.num_hosts, args.host_id)
+        print(
+            f"multi-host up: host {args.host_id}/{args.num_hosts}, "
+            f"{n} global devices",
+            flush=True,
+        )
 
     if inp == "dyn":
         asyncio.run(_run_worker(args))

@@ -48,6 +48,42 @@ class MeshConfig:
         return MeshConfig(dp=1, tp=1, sp=1)
 
 
+def init_multihost(
+    coordinator: str, num_hosts: int, host_id: int
+) -> int:
+    """Join a multi-host JAX process group: every host calls this with the
+    same coordinator address BEFORE first device use; afterwards
+    jax.devices() is the GLOBAL device list (reference parity:
+    MultiNodeConfig + the leader/worker barrier, engines.rs:44, §2.9).
+
+    This wires the process-group bring-up (coordinator rendezvous, global
+    device visibility, collective transport). Cross-host SPMD *serving* —
+    every host running the engine step in lockstep with global batch
+    arrays — additionally needs multi-controller scheduling and is not
+    wired yet; make_mesh refuses a multi-process mesh rather than
+    building one that only addresses host 0's devices.
+
+    Returns the number of global devices. Idempotent for identical
+    arguments; raises on a conflicting re-init.
+    """
+    args = (coordinator, num_hosts, host_id)
+    prev = getattr(init_multihost, "_args", None)
+    if prev is not None:
+        if prev != args:
+            raise RuntimeError(
+                f"init_multihost already joined {prev}; cannot re-join as "
+                f"{args}"
+            )
+        return len(jax.devices())
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_hosts,
+        process_id=host_id,
+    )
+    init_multihost._args = args
+    return len(jax.devices())
+
+
 def make_mesh(
     config: Optional[MeshConfig] = None,
     devices: Optional[Sequence[jax.Device]] = None,
@@ -59,6 +95,13 @@ def make_mesh(
     all-gathers tokens rarely.
     """
     config = config or MeshConfig.single_device()
+    if devices is None and jax.process_count() > 1:
+        raise NotImplementedError(
+            "multi-process meshes are not wired into the engine yet: a "
+            "host-local scheduler cannot drive a cross-host SPMD step "
+            "(needs lockstep multi-controller scheduling + global batch "
+            "arrays). The process group itself is up — see init_multihost."
+        )
     devices = list(devices if devices is not None else jax.devices())
     n = config.num_devices
     if len(devices) < n:

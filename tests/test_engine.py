@@ -308,3 +308,36 @@ def test_sp_ring_prefill_matches_single_chip(engine_factory):
     assert eng.mesh is not None and eng.mesh.shape["sp"] == 2
     eng.add_request("s", prompt, _greedy(5))
     assert eng.run_to_completion()["s"] == expected
+
+
+def test_multihost_init_single_process():
+    """jax.distributed bring-up (num_hosts=1 smoke) — in a subprocess,
+    since initialize() must precede any XLA backend use and this suite
+    process has long since initialized it."""
+    import socket
+    import subprocess
+    import sys
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    code = f"""
+import jax
+jax.config.update("jax_platforms", "cpu")
+from dynamo_tpu.parallel.mesh import init_multihost
+n = init_multihost("127.0.0.1:{port}", num_hosts=1, host_id=0)
+assert n == len(jax.devices()) >= 1
+assert init_multihost("127.0.0.1:{port}", 1, 0) == n  # idempotent
+try:
+    init_multihost("127.0.0.1:9", 2, 1)
+except RuntimeError:
+    pass
+else:
+    raise AssertionError("conflicting re-init must raise")
+print("MULTIHOST_OK", n)
+"""
+    out = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        timeout=120, cwd="/root/repo",
+    )
+    assert "MULTIHOST_OK" in out.stdout, out.stderr
