@@ -17,6 +17,7 @@ import argparse
 import asyncio
 import json
 import logging
+import os
 import sys
 from typing import Optional
 
@@ -582,6 +583,22 @@ def main(argv: Optional[list[str]] = None) -> None:
         "--fabric-host", default="dynamo-fabric", dest="fabric_host",
         help="k8s service name for the fabric control plane",
     )
+    deployp.add_argument(
+        "--cr", action="store_true",
+        help="emit a DynamoGraphDeployment custom resource (for the "
+             "operator) instead of raw Deployments/Services",
+    )
+    deployp.add_argument(
+        "--name", default=None,
+        help="CR name with --cr (default: derived from the root service)",
+    )
+
+    operp = sub.add_parser(
+        "operator", help="run the Kubernetes operator (reconciles "
+                         "DynamoGraphDeployments; in-cluster credentials)"
+    )
+    operp.add_argument("--namespace", default="default")
+    operp.add_argument("--interval", type=float, default=5.0)
 
     sub.add_parser("env", help="print the serving environment report")
 
@@ -668,9 +685,39 @@ def main(argv: Optional[list[str]] = None) -> None:
         path = write_build(manifest, args.output)
         print(f"wrote {path} ({len(manifest['services'])} services)")
         if args.cmd == "deploy":
-            objs = render_k8s(manifest, fabric_host=args.fabric_host)
-            kpath = write_k8s(objs, args.output)
-            print(f"wrote {kpath} ({len(objs)} objects)")
+            if args.cr:
+                import yaml as _yaml
+
+                from dynamo_tpu.sdk.build import _k8s_name
+
+                name = args.name or _k8s_name(args.graph.split(":")[-1])
+                cr = {
+                    "apiVersion": "dynamo.tpu/v1alpha1",
+                    "kind": "DynamoGraphDeployment",
+                    "metadata": {"name": name},
+                    "spec": {
+                        "image": manifest["image"],
+                        "fabricHost": args.fabric_host,
+                        "services": manifest["services"],
+                    },
+                }
+                os.makedirs(args.output, exist_ok=True)
+                kpath = os.path.join(args.output, "graph-deployment.yaml")
+                with open(kpath, "w") as f:
+                    _yaml.safe_dump(cr, f, sort_keys=False)
+                print(f"wrote {kpath} (DynamoGraphDeployment/{name})")
+            else:
+                objs = render_k8s(manifest, fabric_host=args.fabric_host)
+                kpath = write_k8s(objs, args.output)
+                print(f"wrote {kpath} ({len(objs)} objects)")
+        return
+
+    if args.cmd == "operator":
+        from dynamo_tpu.operator.controller import main as operator_main
+
+        operator_main(
+            ["--namespace", args.namespace, "--interval", str(args.interval)]
+        )
         return
 
     if args.cmd == "env":

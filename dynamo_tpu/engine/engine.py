@@ -358,9 +358,16 @@ class JaxEngine:
         # (A backlog that can't admit anyway must not forfeit fusion.)
         if self.scheduler.num_waiting() > 0 and self.scheduler.can_admit_head():
             return 1
+        cap_tokens = self.config.max_pages_per_seq * self.config.page_size
         for req in reqs:
             k = min(k, self.config.max_context - req.num_tokens + 1)
-        # Don't speculate past the longest remaining completion in the batch.
+            k = min(k, cap_tokens - req.num_tokens + 1)
+        # Cover the longest remaining completion rounded UP to a power of
+        # two (the decode_multi program family stays small — every distinct
+        # k is a full-model compile). Requests finishing mid-scan discard
+        # their overshoot in the accept loop, so the tail of a wave runs as
+        # ONE dispatch instead of a halving ladder of dispatches, each a
+        # full host sync (the sync, not the compute, is what costs).
         rem_max = 0
         for req in reqs:
             s = req.sampling
@@ -368,9 +375,13 @@ class JaxEngine:
                 rem_max,
                 s.max_tokens - len(req.output_tokens) - req.num_emitted,
             )
-        k = min(k, max(1, rem_max))
-        # Snap to a power of two so the decode_multi program family stays
-        # small (every distinct k is a full-model compile).
+        p = 1
+        while p < max(1, rem_max):
+            p *= 2
+        k = min(k, p)
+        # The context/page caps above can leave an arbitrary k: snap DOWN to
+        # a power of two so cap-bound sequences don't each compile a fresh
+        # decode_multi program (k=37, 35, 33, ... would).
         p = 1
         while p * 2 <= k:
             p *= 2

@@ -14,13 +14,23 @@ owns which page belongs to whom. Three ideas:
    event stream — and the mocker's KvManager, mocker/kv_manager.rs:121).
 
 Page 0 is the null page (padding writes), never allocated.
+
+The bookkeeping core (free list, refcounts, hash maps, LRU reclaim) runs in
+C++ when libdynamo_native is available (native/pool.cpp — reference parity
+with the native Rust block pool, lib/llm/src/block_manager/pool.rs); the
+pure-Python path below is the fallback and the semantic spec. Page metadata
+(parent hashes, token payloads for KV events) and stats stay Python-side in
+both modes. Tests assert both paths agree on random workloads.
 """
 
 from __future__ import annotations
 
+import ctypes
 from collections import OrderedDict
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Callable, Literal, Optional, Sequence
+
+from dynamo_tpu import native
 
 
 @dataclass(frozen=True)
@@ -65,22 +75,35 @@ class PageAllocator:
             raise ValueError("need at least 2 pages (page 0 is the null page)")
         self.num_pages = num_pages
         self.page_size = page_size
-        self._free: list[int] = list(range(num_pages - 1, 0, -1))  # pop() -> 1 first
-        self._refcount: dict[int, int] = {}
-        #: full pages registered by content: seq_hash -> page id
-        self._by_hash: dict[int, int] = {}
         #: page id -> (seq_hash, parent_hash, tokens) for registered pages
         self._page_meta: dict[int, tuple[int, Optional[int], tuple[int, ...]]] = {}
-        #: refcount-0 registered pages, LRU order (oldest first)
-        self._reclaimable: OrderedDict[int, None] = OrderedDict()
         self._on_event = on_event
         self.stats = PrefixCacheStats()
+        self._nlib = native.lib()
+        if self._nlib is not None:
+            self._np = self._nlib.dyn_pool_new(num_pages)
+        else:
+            self._np = None
+        if self._np is None:
+            self._free: list[int] = list(range(num_pages - 1, 0, -1))
+            self._refcount: dict[int, int] = {}
+            #: full pages registered by content: seq_hash -> page id
+            self._by_hash: dict[int, int] = {}
+            #: refcount-0 registered pages, LRU order (oldest first)
+            self._reclaimable: OrderedDict[int, None] = OrderedDict()
+
+    def __del__(self):
+        np_, lib = getattr(self, "_np", None), getattr(self, "_nlib", None)
+        if np_ is not None and lib is not None:
+            lib.dyn_pool_delete(np_)
 
     # -- capacity ----------------------------------------------------------
 
     @property
     def num_free(self) -> int:
         """Pages allocatable right now (free list + reclaimable cache)."""
+        if self._np is not None:
+            return self._nlib.dyn_pool_num_free(self._np)
         return len(self._free) + len(self._reclaimable)
 
     @property
@@ -90,13 +113,37 @@ class PageAllocator:
     def usage(self) -> float:
         return self.num_active / (self.num_pages - 1)
 
+    def _free_slots(self) -> int:
+        """Free-list length — pages allocatable without evicting."""
+        if self._np is not None:
+            return self._nlib.dyn_pool_free_list_len(self._np)
+        return len(self._free)
+
+    def _peek_reclaimable(self, n: int) -> list[int]:
+        """The first n pages allocate() would evict (LRU-first)."""
+        if n <= 0:
+            return []
+        if self._np is not None:
+            out = (ctypes.c_uint32 * n)()
+            got = self._nlib.dyn_pool_peek_reclaimable(self._np, out, n)
+            return list(out[:got])
+        return list(self._reclaimable)[:n]
+
     # -- allocation --------------------------------------------------------
 
     def allocate(self, n: int) -> Optional[list[int]]:
         """Get n fresh pages (evicting cached pages LRU-first), or None."""
+        if self._np is not None:
+            if n > self.num_free:
+                return None
+            out = (ctypes.c_uint32 * max(1, n))()
+            if not self._nlib.dyn_pool_allocate(self._np, n, out):
+                return None
+            self._drain_evicted()
+            return list(out[:n])
         if n > self.num_free:
             return None
-        out = []
+        out_pages = []
         for _ in range(n):
             if self._free:
                 page = self._free.pop()
@@ -104,12 +151,21 @@ class PageAllocator:
                 page, _ = self._reclaimable.popitem(last=False)
                 self._evict(page)
             self._refcount[page] = 1
-            out.append(page)
-        return out
+            out_pages.append(page)
+        return out_pages
 
     def free(self, pages: Sequence[int]) -> None:
         """Drop one reference; registered pages become reclaimable (stay
         cached), unregistered ones return to the free list."""
+        if self._np is not None:
+            n = len(pages)
+            if n == 0:
+                return
+            arr = (ctypes.c_uint32 * n)(*pages)
+            bad = self._nlib.dyn_pool_release(self._np, arr, n)
+            if bad >= 0:
+                raise ValueError(f"double free of page {pages[bad]}")
+            return
         for page in pages:
             rc = self._refcount.get(page)
             if rc is None:
@@ -134,14 +190,20 @@ class PageAllocator:
         tokens: tuple[int, ...],
     ) -> None:
         """Content-address a *full* page so future requests can share it."""
-        if page in self._page_meta:
-            return
-        prev = self._by_hash.get(seq_hash)
-        if prev is not None and prev != page:
-            # Duplicate content under two pages (two seqs computed the same
-            # block concurrently). Keep the existing registration.
-            return
-        self._by_hash[seq_hash] = page
+        if self._np is not None:
+            if not self._nlib.dyn_pool_register(
+                self._np, page, seq_hash & 0xFFFFFFFFFFFFFFFF
+            ):
+                return
+        else:
+            if page in self._page_meta:
+                return
+            prev = self._by_hash.get(seq_hash)
+            if prev is not None and prev != page:
+                # Duplicate content under two pages (two seqs computed the
+                # same block concurrently). Keep the existing registration.
+                return
+            self._by_hash[seq_hash] = page
         self._page_meta[page] = (seq_hash, parent_hash, tokens)
         self.stats.stored_blocks += 1
         self._emit(
@@ -158,13 +220,24 @@ class PageAllocator:
 
         Acquires a reference on each returned page.
         """
-        pages = []
-        for h in seq_hashes:
-            page = self._by_hash.get(h)
-            if page is None:
-                break
-            self._acquire(page)
-            pages.append(page)
+        if self._np is not None:
+            n = len(seq_hashes)
+            pages: list[int] = []
+            if n:
+                harr = (ctypes.c_uint64 * n)(
+                    *(h & 0xFFFFFFFFFFFFFFFF for h in seq_hashes)
+                )
+                out = (ctypes.c_uint32 * n)()
+                k = self._nlib.dyn_pool_lookup(self._np, harr, n, out)
+                pages = list(out[:k])
+        else:
+            pages = []
+            for h in seq_hashes:
+                page = self._by_hash.get(h)
+                if page is None:
+                    break
+                self._acquire(page)
+                pages.append(page)
         self.stats.queries += 1
         self.stats.query_tokens += len(seq_hashes) * self.page_size
         self.stats.hit_tokens += len(pages) * self.page_size
@@ -172,6 +245,14 @@ class PageAllocator:
 
     def match_length(self, seq_hashes: Sequence[int]) -> int:
         """Cached-prefix length in blocks, without acquiring references."""
+        if self._np is not None:
+            n = len(seq_hashes)
+            if not n:
+                return 0
+            harr = (ctypes.c_uint64 * n)(
+                *(h & 0xFFFFFFFFFFFFFFFF for h in seq_hashes)
+            )
+            return self._nlib.dyn_pool_match_length(self._np, harr, n)
         n = 0
         for h in seq_hashes:
             if h not in self._by_hash:
@@ -187,11 +268,35 @@ class PageAllocator:
             self._reclaimable.pop(page, None)
         self._refcount[page] = rc + 1
 
+    def _pre_evict(self, page: int) -> None:
+        """Hook: called while the page's metadata (and device bytes) are
+        still intact, before the registration is dropped. KVBM offload
+        lives here (kvbm/manager.py)."""
+
     def _evict(self, page: int) -> None:
+        """Python-path eviction (native evictions arrive via _drain_evicted)."""
+        self._pre_evict(page)
         seq_hash, _, _ = self._page_meta.pop(page)
         del self._by_hash[seq_hash]
         self.stats.evicted_blocks += 1
         self._emit(KvEvent(kind="removed", block_hashes=(seq_hash,)))
+
+    def _drain_evicted(self) -> None:
+        """Process evictions queued inside the native pool: run the offload
+        hook (device bytes are untouched until the engine's next dispatch),
+        drop metadata, emit 'removed' events."""
+        pending = self._nlib.dyn_pool_evicted_pending(self._np)
+        if not pending:
+            return
+        pages = (ctypes.c_uint32 * pending)()
+        hashes = (ctypes.c_uint64 * pending)()
+        got = self._nlib.dyn_pool_drain_evicted(self._np, pages, hashes, pending)
+        for i in range(got):
+            page = pages[i]
+            self._pre_evict(page)
+            seq_hash, _, _ = self._page_meta.pop(page)
+            self.stats.evicted_blocks += 1
+            self._emit(KvEvent(kind="removed", block_hashes=(seq_hash,)))
 
     def _emit(self, event: KvEvent) -> None:
         if self._on_event is not None:
@@ -199,6 +304,10 @@ class PageAllocator:
 
     def clear_cache(self) -> int:
         """Drop all reclaimable cached pages (frontend /clear_kv_blocks)."""
+        if self._np is not None:
+            n = self._nlib.dyn_pool_clear_cache(self._np)
+            self._drain_evicted()
+            return n
         n = 0
         while self._reclaimable:
             page, _ = self._reclaimable.popitem(last=False)

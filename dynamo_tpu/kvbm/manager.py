@@ -99,18 +99,17 @@ class TieredPageAllocator(PageAllocator):
     def allocate(self, n: int) -> Optional[list[int]]:
         """Pre-offload the eviction victims in ONE batched device read
         (instead of one sync per page inside the eviction loop); the
-        per-page _evict hook then sees them already in a lower tier."""
+        per-page _pre_evict hook then sees them already in a lower tier."""
         if self._offload_enabled and n <= self.num_free:
-            n_evict = n - min(len(self._free), n)
+            n_evict = n - min(self._free_slots(), n)
             if n_evict > 0:
-                victims = list(self._reclaimable)[:n_evict]  # LRU-first
+                victims = self._peek_reclaimable(n_evict)  # LRU-first
                 self._offload_pages(victims)
         return super().allocate(n)
 
-    def _evict(self, page: int) -> None:
+    def _pre_evict(self, page: int) -> None:
         if self._offload_enabled:
             self._offload_pages([page])
-        super()._evict(page)
 
     # -- onboard (prefix-hit continuation) ---------------------------------
 
@@ -136,11 +135,15 @@ class TieredPageAllocator(PageAllocator):
             found.append(e)
         if not found:
             return pages
-        fresh = self.allocate(len(found))  # may itself evict+offload: fine,
-        if fresh is None:  # entries already hold their arrays
-            return pages  # pool pressure — skip onboarding this time
+        # Stack (= copy) the tier bytes BEFORE allocate(): allocate may
+        # evict+offload device pages into the host tier, and a full host
+        # tier then recycles LRU slabs — possibly the very slabs `found`
+        # native-backed entries view (tiers.py HostTier.get).
         k = np.stack([e.k for e in found], axis=2)  # [L, Hkv, n, S, D]
         v = np.stack([e.v for e in found], axis=2)
+        fresh = self.allocate(len(found))
+        if fresh is None:
+            return pages  # pool pressure — skip onboarding this time
         self._inject_fn(fresh, k, v)
         for page, e in zip(fresh, found):
             self.register(page, e.seq_hash, e.parent_hash, e.tokens)

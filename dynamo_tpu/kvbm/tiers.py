@@ -9,6 +9,7 @@ block_manager/offload.rs:17-45).
 
 from __future__ import annotations
 
+import ctypes
 import logging
 import os
 from collections import OrderedDict
@@ -16,6 +17,8 @@ from dataclasses import dataclass
 from typing import Callable, Optional
 
 import numpy as np
+
+from dynamo_tpu import native
 
 logger = logging.getLogger(__name__)
 
@@ -34,7 +37,18 @@ class BlockEntry:
 
 
 class HostTier:
-    """Bounded in-memory block store, LRU order (oldest first)."""
+    """Bounded in-memory block store, LRU order (oldest first).
+
+    Block bytes live in C++-owned, 64-byte-aligned, mlock'd (best-effort)
+    slabs when libdynamo_native is available (native/host_tier.cpp — the
+    reference keeps its G2 tier in native pinned memory for the same
+    reason: lib/llm/src/block_manager/storage/cuda.rs:174 PinnedStorage).
+    One engine config has one block shape, so the native store activates
+    lazily on the first put and serves every same-sized block from its
+    slab pool; odd-sized blocks (none in practice) ride a Python dict so
+    behavior stays exact. Entries returned by get() view the slab directly
+    — valid until the entry is popped or evicted; callers copy/consume
+    immediately (onboard does a device_put)."""
 
     def __init__(
         self,
@@ -45,27 +59,106 @@ class HostTier:
         self._demote = demote
         self._entries: OrderedDict[int, BlockEntry] = OrderedDict()
         self._bytes = 0
+        # native slab store (lazy): hash -> (parent, tokens, k_shape, dtype)
+        self._nlib = None
+        self._nh = None
+        self._block_bytes = 0
+        self._meta: dict[int, tuple[Optional[int], tuple[int, ...], tuple, np.dtype]] = {}
+
+    def _try_native_init(self, entry: BlockEntry) -> None:
+        if self._nh is not None or self._nlib is not None:
+            return
+        lib = native.lib()
+        if lib is None:
+            self._nlib = False  # latch: don't re-probe per put
+            return
+        nh = lib.dyn_host_new(self.capacity_bytes, entry.nbytes, 1)
+        if nh:
+            self._nlib, self._nh = lib, nh
+            self._block_bytes = entry.nbytes
+        else:
+            self._nlib = False
+
+    def __del__(self):
+        if self._nh is not None and self._nlib:
+            self._nlib.dyn_host_delete(self._nh)
 
     def __contains__(self, seq_hash: int) -> bool:
-        return seq_hash in self._entries
+        if seq_hash in self._entries:
+            return True
+        return bool(
+            self._nh is not None and self._nlib.dyn_host_contains(self._nh, seq_hash)
+        )
 
     def __len__(self) -> int:
-        return len(self._entries)
+        n = len(self._entries)
+        if self._nh is not None:
+            n += self._nlib.dyn_host_len(self._nh)
+        return n
 
     @property
     def used_bytes(self) -> int:
-        return self._bytes
+        b = self._bytes
+        if self._nh is not None:
+            b += self._nlib.dyn_host_used_bytes(self._nh)
+        return b
+
+    # -- native-slab entry views -------------------------------------------
+
+    def _slab_entry(self, seq_hash: int, ptr: int) -> BlockEntry:
+        parent, tokens, shape, dtype = self._meta[seq_hash]
+        half = self._block_bytes // 2
+        buf = (ctypes.c_uint8 * self._block_bytes).from_address(ptr)
+        k = np.frombuffer(buf, np.uint8, half).view(dtype).reshape(shape)
+        v = np.frombuffer(buf, np.uint8, half, offset=half).view(dtype).reshape(shape)
+        return BlockEntry(
+            seq_hash=seq_hash, parent_hash=parent, tokens=tokens, k=k, v=v
+        )
+
+    def _evict_native_lru(self) -> None:
+        ok = ctypes.c_int(0)
+        victim = self._nlib.dyn_host_peek_lru(self._nh, ctypes.byref(ok))
+        if not ok.value:
+            return
+        if self._demote is not None:
+            ptr = self._nlib.dyn_host_get(self._nh, victim)
+            # demote consumes the bytes synchronously (DiskTier.put copies)
+            self._demote(self._slab_entry(victim, ptr))
+        self._nlib.dyn_host_pop(self._nh, victim)
+        self._meta.pop(victim, None)
+
+    # -- store interface ---------------------------------------------------
 
     def put(self, entry: BlockEntry) -> bool:
         """True iff the block is preserved (here or via the demote chain)."""
-        if entry.seq_hash in self._entries:
+        if entry.seq_hash in self:
             return True
         if entry.nbytes > self.capacity_bytes:
             # Can never fit this tier — pass straight down the hierarchy.
             return bool(self._demote is not None and self._demote(entry))
+        self._try_native_init(entry)
+        if self._nh is not None and entry.nbytes == self._block_bytes:
+            ptr = self._nlib.dyn_host_reserve(self._nh, entry.seq_hash)
+            # At capacity: demote LRU victims until it fits. Bounded by the
+            # entry count — reserve can also fail on host OOM
+            # (aligned_alloc null), where spinning would hang the engine.
+            while not ptr and self._nlib.dyn_host_len(self._nh) > 0:
+                self._evict_native_lru()
+                ptr = self._nlib.dyn_host_reserve(self._nh, entry.seq_hash)
+            if not ptr:  # allocation failure — pass down the hierarchy
+                return bool(self._demote is not None and self._demote(entry))
+            half = self._block_bytes // 2
+            buf = (ctypes.c_uint8 * self._block_bytes).from_address(ptr)
+            dst = np.frombuffer(buf, np.uint8)
+            dst[:half] = np.ascontiguousarray(entry.k).view(np.uint8).reshape(-1)
+            dst[half:] = np.ascontiguousarray(entry.v).view(np.uint8).reshape(-1)
+            self._meta[entry.seq_hash] = (
+                entry.parent_hash, entry.tokens, entry.k.shape, entry.k.dtype,
+            )
+            return True
         self._entries[entry.seq_hash] = entry
         self._bytes += entry.nbytes
-        while self._bytes > self.capacity_bytes:
+        while self.used_bytes > self.capacity_bytes and self._entries:
             _, victim = self._entries.popitem(last=False)
             self._bytes -= victim.nbytes
             if self._demote is not None:
@@ -73,21 +166,43 @@ class HostTier:
         return True
 
     def get(self, seq_hash: int) -> Optional[BlockEntry]:
-        """Read without removing; refreshes LRU recency."""
+        """Read without removing; refreshes LRU recency. Native-slab entries
+        view C++ memory — valid until pop/eviction."""
         e = self._entries.get(seq_hash)
         if e is not None:
             self._entries.move_to_end(seq_hash)
-        return e
+            return e
+        if self._nh is not None:
+            ptr = self._nlib.dyn_host_get(self._nh, seq_hash)
+            if ptr:
+                return self._slab_entry(seq_hash, ptr)
+        return None
 
     def pop(self, seq_hash: int) -> Optional[BlockEntry]:
         e = self._entries.pop(seq_hash, None)
         if e is not None:
             self._bytes -= e.nbytes
-        return e
+            return e
+        if self._nh is not None:
+            ptr = self._nlib.dyn_host_get(self._nh, seq_hash)
+            if ptr:
+                # Materialize a copy: the slab is recycled on pop.
+                view = self._slab_entry(seq_hash, ptr)
+                out = BlockEntry(
+                    seq_hash=view.seq_hash, parent_hash=view.parent_hash,
+                    tokens=view.tokens, k=view.k.copy(), v=view.v.copy(),
+                )
+                self._nlib.dyn_host_pop(self._nh, seq_hash)
+                self._meta.pop(seq_hash, None)
+                return out
+        return None
 
     def clear(self) -> None:
         self._entries.clear()
         self._bytes = 0
+        if self._nh is not None:
+            self._nlib.dyn_host_clear(self._nh)
+            self._meta.clear()
 
 
 def _dtype_from_name(name: str) -> np.dtype:

@@ -30,7 +30,13 @@ logger = logging.getLogger(__name__)
 
 _NATIVE_DIR = Path(__file__).resolve().parent.parent / "native"
 _LIB_PATH = _NATIVE_DIR / "build" / "libdynamo_native.so"
-_SOURCES = [_NATIVE_DIR / "dynamo_native.cpp", _NATIVE_DIR / "xxh3.h"]
+_SOURCES = [
+    _NATIVE_DIR / "dynamo_native.cpp",
+    _NATIVE_DIR / "pool.cpp",
+    _NATIVE_DIR / "host_tier.cpp",
+    _NATIVE_DIR / "codec.cpp",
+    _NATIVE_DIR / "xxh3.h",
+]
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
@@ -61,6 +67,60 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.dyn_radix_blocks_for.argtypes = [p, u32]
     lib.dyn_radix_events_applied.restype = u64
     lib.dyn_radix_events_applied.argtypes = [p]
+    # pool.cpp — device page pool
+    i64 = ctypes.c_int64
+    lib.dyn_pool_new.restype = p
+    lib.dyn_pool_new.argtypes = [u32]
+    lib.dyn_pool_delete.argtypes = [p]
+    lib.dyn_pool_num_free.restype = sz
+    lib.dyn_pool_num_free.argtypes = [p]
+    lib.dyn_pool_free_list_len.restype = sz
+    lib.dyn_pool_free_list_len.argtypes = [p]
+    lib.dyn_pool_peek_reclaimable.restype = sz
+    lib.dyn_pool_peek_reclaimable.argtypes = [p, p, sz]
+    lib.dyn_pool_allocate.restype = ctypes.c_int
+    lib.dyn_pool_allocate.argtypes = [p, sz, p]
+    lib.dyn_pool_release.restype = i64
+    lib.dyn_pool_release.argtypes = [p, p, sz]
+    lib.dyn_pool_register.restype = ctypes.c_int
+    lib.dyn_pool_register.argtypes = [p, u32, u64]
+    lib.dyn_pool_lookup.restype = sz
+    lib.dyn_pool_lookup.argtypes = [p, p, sz, p]
+    lib.dyn_pool_match_length.restype = sz
+    lib.dyn_pool_match_length.argtypes = [p, p, sz]
+    lib.dyn_pool_clear_cache.restype = sz
+    lib.dyn_pool_clear_cache.argtypes = [p]
+    lib.dyn_pool_evicted_pending.restype = sz
+    lib.dyn_pool_evicted_pending.argtypes = [p]
+    lib.dyn_pool_drain_evicted.restype = sz
+    lib.dyn_pool_drain_evicted.argtypes = [p, p, p, sz]
+    # host_tier.cpp — KVBM G2 slab store
+    lib.dyn_host_new.restype = p
+    lib.dyn_host_new.argtypes = [u64, u64, ctypes.c_int]
+    lib.dyn_host_delete.argtypes = [p]
+    lib.dyn_host_len.restype = sz
+    lib.dyn_host_len.argtypes = [p]
+    lib.dyn_host_used_bytes.restype = u64
+    lib.dyn_host_used_bytes.argtypes = [p]
+    lib.dyn_host_capacity_slots.restype = u64
+    lib.dyn_host_capacity_slots.argtypes = [p]
+    lib.dyn_host_contains.restype = ctypes.c_int
+    lib.dyn_host_contains.argtypes = [p, u64]
+    lib.dyn_host_peek_lru.restype = u64
+    lib.dyn_host_peek_lru.argtypes = [p, p]
+    lib.dyn_host_reserve.restype = p
+    lib.dyn_host_reserve.argtypes = [p, u64]
+    lib.dyn_host_get.restype = p
+    lib.dyn_host_get.argtypes = [p, u64]
+    lib.dyn_host_pop.restype = ctypes.c_int
+    lib.dyn_host_pop.argtypes = [p, u64]
+    lib.dyn_host_clear.argtypes = [p]
+    # codec.cpp — two-part frame codec
+    lib.dyn_frame_prefix.argtypes = [p, sz, p, sz, p]
+    lib.dyn_frame_parse_prefix.restype = ctypes.c_int
+    lib.dyn_frame_parse_prefix.argtypes = [p, p, p]
+    lib.dyn_frame_check.restype = ctypes.c_int
+    lib.dyn_frame_check.argtypes = [p, p, sz, p, sz]
     return lib
 
 

@@ -1,0 +1,17 @@
+"""Kubernetes operator: reconciles DynamoGraphDeployment custom resources
+into Deployments/Services (reference parity: the Go operator at
+/root/reference deploy/cloud/operator — CRDs DynamoGraphDeployment /
+DynamoComponentDeployment, api/v1alpha1/dynamographdeployment_types.go:33-41,
+reconcilers in internal/controller/).
+
+Python-native here: the reconcile core is a pure diff over desired vs
+observed objects (testable with the in-memory kube backend — the envtest
+analog), the kube client speaks the REST API directly from in-cluster
+credentials, and the controller is a poll loop (no informer machinery
+needed at this scale)."""
+
+from dynamo_tpu.operator.controller import Controller
+from dynamo_tpu.operator.kube import InMemoryKube
+from dynamo_tpu.operator.reconciler import reconcile
+
+__all__ = ["Controller", "InMemoryKube", "reconcile"]

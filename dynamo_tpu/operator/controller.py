@@ -1,0 +1,86 @@
+"""Operator controller: a poll-reconcile loop over DynamoGraphDeployments.
+
+The reference operator is informer/watch-driven (controller-runtime); at
+this scale a bounded poll interval gives the same convergence with far
+less machinery, and the reconcile core stays a pure function. Each pass:
+
+1. list CRs in the watched namespace
+2. reconcile each (create/replace/delete children, patch status)
+3. garbage-collect children whose CR is gone
+
+Errors on one CR don't block the others; the loop continues."""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Any, Optional
+
+from dynamo_tpu.operator.reconciler import garbage_collect, reconcile
+
+logger = logging.getLogger(__name__)
+
+
+class Controller:
+    def __init__(self, kube: Any, namespace: str = "default", interval_s: float = 5.0):
+        self.kube = kube
+        self.namespace = namespace
+        self.interval_s = interval_s
+        self._stop = threading.Event()
+        self.passes = 0
+
+    def reconcile_once(self) -> dict[str, dict]:
+        """One full pass; returns status patches by CR name."""
+        statuses: dict[str, dict] = {}
+        crs = self.kube.list("DynamoGraphDeployment", self.namespace)
+        live = set()
+        for cr in crs:
+            name = cr["metadata"]["name"]
+            live.add(name)
+            try:
+                status = reconcile(self.kube, cr)
+                self.kube.patch_status(
+                    "DynamoGraphDeployment", self.namespace, name, status
+                )
+                statuses[name] = status
+            except Exception:
+                logger.exception("reconcile failed for %s", name)
+                statuses[name] = {
+                    "conditions": [
+                        {"type": "Ready", "status": "False", "reason": "Error"}
+                    ]
+                }
+        gc = garbage_collect(self.kube, self.namespace, live)
+        if gc:
+            logger.info("garbage-collected %d orphaned objects", gc)
+        self.passes += 1
+        return statuses
+
+    def run(self, max_passes: Optional[int] = None) -> None:
+        while not self._stop.is_set():
+            self.reconcile_once()
+            if max_passes is not None and self.passes >= max_passes:
+                return
+            self._stop.wait(self.interval_s)
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+def main(argv: Optional[list[str]] = None) -> None:
+    import argparse
+
+    p = argparse.ArgumentParser("dynamo-tpu-operator")
+    p.add_argument("--namespace", default="default")
+    p.add_argument("--interval", type=float, default=5.0)
+    args = p.parse_args(argv)
+
+    from dynamo_tpu.operator.kube import InClusterKube
+
+    kube = InClusterKube()
+    Controller(kube, namespace=args.namespace, interval_s=args.interval).run()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,165 @@
+"""Kubernetes API clients for the operator.
+
+Two backends behind one duck-typed interface (get/list/create/replace/
+delete/patch_status):
+
+- `InMemoryKube` — a faithful in-memory object store for tests (the
+  reference operator uses envtest, deploy/cloud/operator suite_test.go;
+  same idea without a control-plane binary).
+- `InClusterKube` — speaks the REST API over HTTPS using the pod's
+  service-account credentials (/var/run/secrets/kubernetes.io/...).
+  stdlib-only (urllib): the environment bakes no kubernetes client.
+
+Objects are plain dicts in k8s wire shape. List filtering supports the
+label selectors the reconciler uses (equality only)."""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import ssl
+import urllib.error
+import urllib.request
+from typing import Any, Optional
+
+logger = logging.getLogger(__name__)
+
+#: group/version/plural for each kind the operator touches
+_API = {
+    "Deployment": ("apis/apps/v1", "deployments"),
+    "Service": ("api/v1", "services"),
+    "DynamoGraphDeployment": ("apis/dynamo.tpu/v1alpha1", "dynamographdeployments"),
+}
+
+
+def _match_labels(obj: dict, selector: Optional[dict]) -> bool:
+    if not selector:
+        return True
+    labels = obj.get("metadata", {}).get("labels", {}) or {}
+    return all(labels.get(k) == v for k, v in selector.items())
+
+
+class InMemoryKube:
+    """Dict-backed kube API server double."""
+
+    def __init__(self):
+        #: (kind, namespace, name) -> object
+        self._objs: dict[tuple[str, str, str], dict] = {}
+        self.actions: list[tuple[str, str, str]] = []  # (verb, kind, name)
+
+    def get(self, kind: str, namespace: str, name: str) -> Optional[dict]:
+        return self._objs.get((kind, namespace, name))
+
+    def list(
+        self, kind: str, namespace: str, selector: Optional[dict] = None
+    ) -> list[dict]:
+        return [
+            o
+            for (k, ns, _), o in sorted(self._objs.items())
+            if k == kind and ns == namespace and _match_labels(o, selector)
+        ]
+
+    def create(self, kind: str, namespace: str, obj: dict) -> dict:
+        name = obj["metadata"]["name"]
+        key = (kind, namespace, name)
+        if key in self._objs:
+            raise RuntimeError(f"{kind} {namespace}/{name} already exists")
+        obj.setdefault("metadata", {}).setdefault("namespace", namespace)
+        self._objs[key] = json.loads(json.dumps(obj))
+        self.actions.append(("create", kind, name))
+        return self._objs[key]
+
+    def replace(self, kind: str, namespace: str, name: str, obj: dict) -> dict:
+        key = (kind, namespace, name)
+        if key not in self._objs:
+            raise RuntimeError(f"{kind} {namespace}/{name} not found")
+        self._objs[key] = json.loads(json.dumps(obj))
+        self.actions.append(("replace", kind, name))
+        return self._objs[key]
+
+    def delete(self, kind: str, namespace: str, name: str) -> bool:
+        existed = self._objs.pop((kind, namespace, name), None) is not None
+        if existed:
+            self.actions.append(("delete", kind, name))
+        return existed
+
+    def patch_status(self, kind: str, namespace: str, name: str, status: dict) -> None:
+        obj = self._objs.get((kind, namespace, name))
+        if obj is not None:
+            obj["status"] = json.loads(json.dumps(status))
+            self.actions.append(("status", kind, name))
+
+
+class InClusterKube:
+    """REST client using the pod's mounted service-account credentials."""
+
+    SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+    def __init__(self, base_url: Optional[str] = None):
+        host = os.environ.get("KUBERNETES_SERVICE_HOST", "kubernetes.default.svc")
+        port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+        self.base_url = base_url or f"https://{host}:{port}"
+        with open(os.path.join(self.SA_DIR, "token")) as f:
+            self._token = f.read().strip()
+        ca = os.path.join(self.SA_DIR, "ca.crt")
+        self._ctx = ssl.create_default_context(
+            cafile=ca if os.path.exists(ca) else None
+        )
+
+    def _url(self, kind: str, namespace: str, name: str = "", sub: str = "") -> str:
+        api, plural = _API[kind]
+        url = f"{self.base_url}/{api}/namespaces/{namespace}/{plural}"
+        if name:
+            url += f"/{name}"
+        if sub:
+            url += f"/{sub}"
+        return url
+
+    def _request(
+        self, method: str, url: str, body: Optional[dict] = None,
+        content_type: str = "application/json",
+    ) -> Optional[dict]:
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(url, data=data, method=method)
+        req.add_header("Authorization", f"Bearer {self._token}")
+        req.add_header("Accept", "application/json")
+        if data is not None:
+            req.add_header("Content-Type", content_type)
+        try:
+            with urllib.request.urlopen(req, context=self._ctx, timeout=30) as r:
+                return json.loads(r.read() or b"{}")
+        except urllib.error.HTTPError as e:
+            if e.code == 404:
+                return None
+            raise
+
+    def get(self, kind: str, namespace: str, name: str) -> Optional[dict]:
+        return self._request("GET", self._url(kind, namespace, name))
+
+    def list(
+        self, kind: str, namespace: str, selector: Optional[dict] = None
+    ) -> list[dict]:
+        url = self._url(kind, namespace)
+        if selector:
+            sel = ",".join(f"{k}={v}" for k, v in sorted(selector.items()))
+            url += f"?labelSelector={urllib.request.quote(sel)}"
+        out = self._request("GET", url)
+        return (out or {}).get("items", [])
+
+    def create(self, kind: str, namespace: str, obj: dict) -> Optional[dict]:
+        return self._request("POST", self._url(kind, namespace), obj)
+
+    def replace(self, kind: str, namespace: str, name: str, obj: dict) -> Optional[dict]:
+        return self._request("PUT", self._url(kind, namespace, name), obj)
+
+    def delete(self, kind: str, namespace: str, name: str) -> bool:
+        return self._request("DELETE", self._url(kind, namespace, name)) is not None
+
+    def patch_status(self, kind: str, namespace: str, name: str, status: dict) -> None:
+        self._request(
+            "PATCH",
+            self._url(kind, namespace, name, sub="status"),
+            {"status": status},
+            content_type="application/merge-patch+json",
+        )

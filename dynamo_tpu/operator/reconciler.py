@@ -1,0 +1,161 @@
+"""Reconcile one DynamoGraphDeployment into Deployments + Services.
+
+The CR's spec carries a frozen build manifest (`dynamo-tpu build` output —
+sdk/build.py): image + the service list with replicas/config. Desired
+child objects come from the same renderer the `deploy` command uses
+(sdk/build.render_k8s), stamped with ownership labels; reconciliation is
+a three-way sweep — create missing, replace drifted, delete orphaned —
+exactly the reference operator's loop (deploy/cloud/operator
+internal/controller/dynamographdeployment_controller.go) without the
+controller-runtime machinery.
+
+Drift detection compares the desired spec against the observed object's
+spec (fields we own); unknown server-set fields are ignored, so the loop
+is idempotent against defaulting."""
+
+from __future__ import annotations
+
+import logging
+from typing import Any
+
+from dynamo_tpu.sdk.build import render_k8s
+
+logger = logging.getLogger(__name__)
+
+MANAGED_BY = "dynamo-tpu-operator"
+LABEL_MANAGED = "app.kubernetes.io/managed-by"
+LABEL_OWNER = "dynamo.tpu/deployment"
+
+
+def desired_objects(cr: dict) -> list[dict]:
+    """Render the CR's child objects, labeled for ownership sweeps."""
+    spec = cr.get("spec", {})
+    # Hand-written CRs may omit fields the CRD marks optional; default them
+    # before rendering (render_k8s indexes replicas/config directly).
+    services = [
+        {
+            "name": s["name"],
+            "class": s["class"],
+            "replicas": s.get("replicas", 1),
+            "endpoints": s.get("endpoints", []),
+            "depends": s.get("depends", []),
+            "config": s.get("config", {}) or {},
+        }
+        for s in spec.get("services", [])
+    ]
+    manifest = {
+        "image": spec.get("image", "dynamo-tpu:latest"),
+        "services": services,
+    }
+    owner = cr["metadata"]["name"]
+    namespace = cr["metadata"].get("namespace", "default")
+    objs = render_k8s(manifest, fabric_host=spec.get("fabricHost", f"{owner}-fabric"))
+    for obj in objs:
+        meta = obj.setdefault("metadata", {})
+        meta["namespace"] = namespace
+        labels = meta.setdefault("labels", {})
+        labels[LABEL_MANAGED] = MANAGED_BY
+        labels[LABEL_OWNER] = owner
+        # Propagate ownership labels onto pod templates so `kubectl get
+        # pods -l dynamo.tpu/deployment=<name>` works.
+        if obj["kind"] == "Deployment":
+            tmeta = obj["spec"]["template"].setdefault("metadata", {})
+            tlabels = tmeta.setdefault("labels", {})
+            tlabels[LABEL_OWNER] = owner
+    return objs
+
+
+def _subset(want: Any, have: Any) -> bool:
+    """True when `want` is structurally contained in `have`: every field we
+    set must match, fields the API server defaulted (strategy,
+    imagePullPolicy, ports[].protocol, ...) are ignored. Lists compare
+    positionally with the same containment rule."""
+    if isinstance(want, dict):
+        if not isinstance(have, dict):
+            return False
+        return all(_subset(v, have.get(k)) for k, v in want.items())
+    if isinstance(want, list):
+        if not isinstance(have, list) or len(want) != len(have):
+            return False
+        return all(_subset(w, h) for w, h in zip(want, have))
+    return want == have
+
+
+def _spec_drifted(desired: dict, observed: dict) -> bool:
+    """Compare only fields we own (our spec subset + our labels)."""
+    if not _subset(desired.get("spec"), observed.get("spec")):
+        return True
+    want = desired["metadata"].get("labels", {})
+    have = observed.get("metadata", {}).get("labels", {}) or {}
+    return any(have.get(k) != v for k, v in want.items())
+
+
+def reconcile(kube: Any, cr: dict) -> dict:
+    """One reconcile pass. Returns a status patch for the CR."""
+    namespace = cr["metadata"].get("namespace", "default")
+    owner = cr["metadata"]["name"]
+    desired = desired_objects(cr)
+    created = replaced = deleted = 0
+
+    want_names: dict[str, set[str]] = {"Deployment": set(), "Service": set()}
+    for obj in desired:
+        kind, name = obj["kind"], obj["metadata"]["name"]
+        want_names[kind].add(name)
+        observed = kube.get(kind, namespace, name)
+        if observed is None:
+            kube.create(kind, namespace, obj)
+            created += 1
+        elif _spec_drifted(obj, observed):
+            merged = dict(observed)
+            merged["spec"] = obj["spec"]
+            labels = dict(observed.get("metadata", {}).get("labels", {}) or {})
+            labels.update(obj["metadata"]["labels"])
+            merged.setdefault("metadata", {})["labels"] = labels
+            kube.replace(kind, namespace, name, merged)
+            replaced += 1
+
+    # Ownership sweep: anything we manage for this CR that is no longer
+    # desired (service removed from the graph, port dropped) gets deleted.
+    selector = {LABEL_MANAGED: MANAGED_BY, LABEL_OWNER: owner}
+    for kind in ("Deployment", "Service"):
+        for obj in kube.list(kind, namespace, selector):
+            name = obj["metadata"]["name"]
+            if name not in want_names[kind]:
+                kube.delete(kind, namespace, name)
+                deleted += 1
+
+    if created or replaced or deleted:
+        logger.info(
+            "reconciled %s/%s: +%d ~%d -%d",
+            namespace, owner, created, replaced, deleted,
+        )
+    return {
+        "observedGeneration": cr["metadata"].get("generation", 0),
+        "conditions": [
+            {
+                "type": "Ready",
+                "status": "True",
+                "reason": "Reconciled",
+                "message": (
+                    f"{len(want_names['Deployment'])} deployments, "
+                    f"{len(want_names['Service'])} services"
+                ),
+            }
+        ],
+        "lastAction": {
+            "created": created, "replaced": replaced, "deleted": deleted,
+        },
+    }
+
+
+def garbage_collect(kube: Any, namespace: str, live_owners: set[str]) -> int:
+    """Delete objects owned by CRs that no longer exist (explicit-label GC —
+    the ownerReference cascade without relying on the API server)."""
+    n = 0
+    for kind in ("Deployment", "Service"):
+        for obj in kube.list(kind, namespace, {LABEL_MANAGED: MANAGED_BY}):
+            owner = (obj["metadata"].get("labels") or {}).get(LABEL_OWNER)
+            if owner and owner not in live_owners:
+                kube.delete(kind, namespace, obj["metadata"]["name"])
+                n += 1
+    return n

@@ -15,11 +15,14 @@ Frame layout:
 from __future__ import annotations
 
 import asyncio
+import ctypes
 import struct
 from typing import Any, Optional
 
 import msgpack
 import xxhash
+
+from dynamo_tpu import native
 
 _PREFIX = struct.Struct("<IIQQ")
 
@@ -33,6 +36,11 @@ class CodecError(Exception):
 
 def encode_frame(header: Any, payload: bytes = b"") -> bytes:
     h = msgpack.packb(header, use_bin_type=True)
+    lib = native.lib()
+    if lib is not None:
+        prefix = (ctypes.c_uint8 * _PREFIX.size)()
+        lib.dyn_frame_prefix(h, len(h), payload, len(payload), prefix)
+        return bytes(prefix) + h + payload
     return (
         _PREFIX.pack(
             len(h),
@@ -45,17 +53,30 @@ def encode_frame(header: Any, payload: bytes = b"") -> bytes:
     )
 
 
-async def read_frame(reader: asyncio.StreamReader) -> tuple[Any, bytes]:
-    prefix = await reader.readexactly(_PREFIX.size)
-    hlen, plen, hsum, psum = _PREFIX.unpack(prefix)
-    if hlen > MAX_FRAME or plen > MAX_FRAME:
-        raise CodecError(f"frame too large: header={hlen} payload={plen}")
-    h = await reader.readexactly(hlen)
-    p = await reader.readexactly(plen) if plen else b""
+def _check_frame(prefix: bytes, h: bytes, p: bytes) -> None:
+    lib = native.lib()
+    if lib is not None:
+        rc = lib.dyn_frame_check(prefix, h, len(h), p, len(p))
+        if rc == 1:
+            raise CodecError("header checksum mismatch")
+        if rc == 2:
+            raise CodecError("payload checksum mismatch")
+        return
+    _, _, hsum, psum = _PREFIX.unpack(prefix)
     if xxhash.xxh3_64_intdigest(h) != hsum:
         raise CodecError("header checksum mismatch")
     if xxhash.xxh3_64_intdigest(p) != psum:
         raise CodecError("payload checksum mismatch")
+
+
+async def read_frame(reader: asyncio.StreamReader) -> tuple[Any, bytes]:
+    prefix = await reader.readexactly(_PREFIX.size)
+    hlen, plen, _, _ = _PREFIX.unpack(prefix)
+    if hlen > MAX_FRAME or plen > MAX_FRAME:
+        raise CodecError(f"frame too large: header={hlen} payload={plen}")
+    h = await reader.readexactly(hlen)
+    p = await reader.readexactly(plen) if plen else b""
+    _check_frame(prefix, h, p)
     return msgpack.unpackb(h, raw=False), p
 
 
@@ -63,12 +84,16 @@ def decode_frame(buf: bytes) -> tuple[Any, bytes, int]:
     """Sync variant for tests/tools: returns (header, payload, consumed)."""
     if len(buf) < _PREFIX.size:
         raise CodecError("short buffer")
-    hlen, plen, hsum, psum = _PREFIX.unpack(buf[: _PREFIX.size])
+    hlen, plen, _, _ = _PREFIX.unpack(buf[: _PREFIX.size])
+    if hlen > MAX_FRAME or plen > MAX_FRAME:
+        raise CodecError(f"frame too large: header={hlen} payload={plen}")
     end = _PREFIX.size + hlen + plen
     if len(buf) < end:
         raise CodecError("short buffer")
     h = buf[_PREFIX.size : _PREFIX.size + hlen]
     p = buf[_PREFIX.size + hlen : end]
-    if xxhash.xxh3_64_intdigest(h) != hsum or xxhash.xxh3_64_intdigest(p) != psum:
-        raise CodecError("checksum mismatch")
+    try:
+        _check_frame(buf[: _PREFIX.size], h, p)
+    except CodecError:
+        raise CodecError("checksum mismatch") from None
     return msgpack.unpackb(h, raw=False), p, end

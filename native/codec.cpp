@@ -1,0 +1,60 @@
+// Two-part frame codec — the C++ hot path behind dynamo_tpu/runtime/codec.py.
+//
+// Reference parity: the reference frames every cross-process payload with a
+// checksummed two-part codec in native code (Rust lib/runtime/src/pipeline/
+// network/codec/two_part.rs — header+payload with xxh3 sums) because it runs
+// per response chunk on every token stream. Frame layout (little-endian):
+//   u32 header_len | u32 payload_len | u64 xxh3(header) | u64 xxh3(payload)
+//   | header bytes | payload bytes
+//
+// encode writes the 24-byte prefix for a (header, payload) pair in one call
+// (two hashes + pack); check validates a prefix against the two body spans.
+
+#include <cstddef>
+#include <cstdint>
+#include <cstring>
+
+#include "xxh3.h"
+
+extern "C" {
+
+static const uint64_t MAX_FRAME = 1ull << 30;
+
+// out_prefix must hold 24 bytes.
+void dyn_frame_prefix(const uint8_t* header, size_t hlen, const uint8_t* payload,
+                      size_t plen, uint8_t* out_prefix) {
+    uint32_t h32 = (uint32_t)hlen, p32 = (uint32_t)plen;
+    uint64_t hsum = dynxxh3::xxh3_64(header, hlen, 0);
+    uint64_t psum = dynxxh3::xxh3_64(payload, plen, 0);
+    std::memcpy(out_prefix, &h32, 4);
+    std::memcpy(out_prefix + 4, &p32, 4);
+    std::memcpy(out_prefix + 8, &hsum, 8);
+    std::memcpy(out_prefix + 16, &psum, 8);
+}
+
+// Parse a 24-byte prefix. Returns 0 and fills lengths, or -1 when a length
+// exceeds MAX_FRAME (corrupt stream — refuse before allocating).
+int dyn_frame_parse_prefix(const uint8_t* prefix, uint64_t* out_hlen,
+                           uint64_t* out_plen) {
+    uint32_t hlen, plen;
+    std::memcpy(&hlen, prefix, 4);
+    std::memcpy(&plen, prefix + 4, 4);
+    if (hlen > MAX_FRAME || plen > MAX_FRAME) return -1;
+    *out_hlen = hlen;
+    *out_plen = plen;
+    return 0;
+}
+
+// Validate body spans against the prefix checksums. Returns 0 ok, 1 header
+// mismatch, 2 payload mismatch.
+int dyn_frame_check(const uint8_t* prefix, const uint8_t* header, size_t hlen,
+                    const uint8_t* payload, size_t plen) {
+    uint64_t hsum, psum;
+    std::memcpy(&hsum, prefix + 8, 8);
+    std::memcpy(&psum, prefix + 16, 8);
+    if (dynxxh3::xxh3_64(header, hlen, 0) != hsum) return 1;
+    if (dynxxh3::xxh3_64(payload, plen, 0) != psum) return 2;
+    return 0;
+}
+
+}  // extern "C"

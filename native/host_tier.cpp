@@ -1,0 +1,141 @@
+// Host-DRAM KV block store — the C++ memory manager behind the KVBM G2 tier
+// (dynamo_tpu/kvbm/tiers.py HostTier).
+//
+// Reference parity: the reference's host tier is native pinned memory
+// (Rust lib/llm/src/block_manager/storage/cuda.rs:174 PinnedStorage,
+// cudaHostAlloc) so device<->host DMA never bounces through pageable pages.
+// TPU equivalent: C++-owned 64-byte-aligned slabs, mlock()ed best-effort
+// (TPU VM host DMA reads the same pages), with hash-keyed lookup and LRU
+// order maintained here instead of per-block Python objects.
+//
+// All blocks in a pool are the same size (one engine config => one
+// [2, L, Hkv, S, D] block shape), so the store is a uniform slab pool:
+// capacity_bytes / block_bytes slots, allocated lazily, recycled on a free
+// list — zero allocator traffic at steady state.
+//
+// Eviction is driven by the Python wrapper (peek_lru -> demote bytes to the
+// disk tier -> pop) so victim bytes are never recycled before the demote
+// copy completes. Block metadata (parent hash, tokens) stays Python-side.
+
+#include <cstddef>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <list>
+#include <unordered_map>
+#include <vector>
+
+#include <sys/mman.h>
+
+extern "C" {
+
+struct HostSlabs {
+    size_t block_bytes;
+    size_t capacity_slots;
+    bool try_mlock;
+    std::vector<void*> all_slabs;   // owned; freed in destructor
+    std::vector<void*> free_slabs;
+    struct Entry {
+        void* buf;
+        std::list<uint64_t>::iterator lru_it;
+    };
+    std::unordered_map<uint64_t, Entry> entries;
+    std::list<uint64_t> lru;        // front = oldest
+};
+
+void* dyn_host_new(uint64_t capacity_bytes, uint64_t block_bytes, int try_mlock) {
+    if (block_bytes == 0) return nullptr;
+    HostSlabs* h = new HostSlabs();
+    h->block_bytes = block_bytes;
+    h->capacity_slots = capacity_bytes / block_bytes;
+    h->try_mlock = try_mlock != 0;
+    return h;
+}
+
+void dyn_host_delete(void* hp) {
+    HostSlabs* h = (HostSlabs*)hp;
+    for (void* s : h->all_slabs) {
+        if (h->try_mlock) munlock(s, h->block_bytes);
+        std::free(s);
+    }
+    delete h;
+}
+
+size_t dyn_host_len(void* hp) { return ((HostSlabs*)hp)->entries.size(); }
+
+uint64_t dyn_host_used_bytes(void* hp) {
+    HostSlabs* h = (HostSlabs*)hp;
+    return (uint64_t)h->entries.size() * h->block_bytes;
+}
+
+uint64_t dyn_host_capacity_slots(void* hp) {
+    return ((HostSlabs*)hp)->capacity_slots;
+}
+
+int dyn_host_contains(void* hp, uint64_t seq_hash) {
+    return ((HostSlabs*)hp)->entries.count(seq_hash) ? 1 : 0;
+}
+
+// Oldest entry's hash, or 0 with *ok = 0 when empty.
+uint64_t dyn_host_peek_lru(void* hp, int* ok) {
+    HostSlabs* h = (HostSlabs*)hp;
+    if (h->lru.empty()) {
+        *ok = 0;
+        return 0;
+    }
+    *ok = 1;
+    return h->lru.front();
+}
+
+// Reserve a slot for seq_hash and return its writable buffer. Returns null
+// when the hash is already stored OR the pool is at capacity (the wrapper
+// demotes+pops the LRU victim first). The caller memcpys block_bytes in.
+void* dyn_host_reserve(void* hp, uint64_t seq_hash) {
+    HostSlabs* h = (HostSlabs*)hp;
+    if (h->capacity_slots == 0) return nullptr;
+    if (h->entries.count(seq_hash)) return nullptr;
+    if (h->entries.size() >= h->capacity_slots) return nullptr;
+    void* buf;
+    if (!h->free_slabs.empty()) {
+        buf = h->free_slabs.back();
+        h->free_slabs.pop_back();
+    } else {
+        buf = std::aligned_alloc(64, (h->block_bytes + 63) / 64 * 64);
+        if (buf == nullptr) return nullptr;
+        if (h->try_mlock) mlock(buf, h->block_bytes);  // best-effort pinning
+        h->all_slabs.push_back(buf);
+    }
+    h->lru.push_back(seq_hash);
+    h->entries[seq_hash] = {buf, std::prev(h->lru.end())};
+    return buf;
+}
+
+// Read pointer (valid until the entry is popped); refreshes LRU recency.
+const void* dyn_host_get(void* hp, uint64_t seq_hash) {
+    HostSlabs* h = (HostSlabs*)hp;
+    auto it = h->entries.find(seq_hash);
+    if (it == h->entries.end()) return nullptr;
+    h->lru.erase(it->second.lru_it);
+    h->lru.push_back(seq_hash);
+    it->second.lru_it = std::prev(h->lru.end());
+    return it->second.buf;
+}
+
+int dyn_host_pop(void* hp, uint64_t seq_hash) {
+    HostSlabs* h = (HostSlabs*)hp;
+    auto it = h->entries.find(seq_hash);
+    if (it == h->entries.end()) return 0;
+    h->free_slabs.push_back(it->second.buf);
+    h->lru.erase(it->second.lru_it);
+    h->entries.erase(it);
+    return 1;
+}
+
+void dyn_host_clear(void* hp) {
+    HostSlabs* h = (HostSlabs*)hp;
+    for (auto& [hash, e] : h->entries) h->free_slabs.push_back(e.buf);
+    h->entries.clear();
+    h->lru.clear();
+}
+
+}  // extern "C"

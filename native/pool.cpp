@@ -1,0 +1,215 @@
+// Device KV page pool — the C++ core behind dynamo_tpu/engine/page_table.py.
+//
+// Reference parity: the reference keeps its block pool native (Rust
+// lib/llm/src/block_manager/pool.rs — active/inactive sets with priority
+// eviction) because allocate/free/lookup sit on every request admission and
+// every decode-step page growth. Same here: free-list + refcount + content-
+// addressed prefix cache with LRU reclaim, one C call per operation.
+//
+// Semantics mirror page_table.py exactly (tests assert agreement on random
+// workloads):
+//   - page 0 is the null page, never allocated
+//   - allocate() serves from the free list first (pages 1, 2, ... first),
+//     then evicts reclaimable (refcount-0 registered) pages LRU-first
+//   - release() drops one reference; registered pages become reclaimable
+//     (stay content-addressed), unregistered ones return to the free list
+//   - register() content-addresses a full page; duplicate hashes keep the
+//     first registration
+//   - lookup() walks the hash chain acquiring refs; match_length() peeks
+//
+// Evicted (page, seq_hash) pairs queue internally; the Python wrapper drains
+// them after every call that can evict, runs the KVBM offload hook, and
+// emits "removed" KV events. Page metadata (parent hash, token payloads) and
+// all stats accounting stay Python-side — they never cross the ABI.
+
+#include <cstddef>
+#include <cstdint>
+#include <list>
+#include <unordered_map>
+#include <vector>
+
+extern "C" {
+
+struct PagePool {
+    uint32_t num_pages;
+    std::vector<uint32_t> free_list;              // pop_back() order: 1, 2, ...
+    std::unordered_map<uint32_t, uint32_t> refcount;
+    std::unordered_map<uint64_t, uint32_t> by_hash;        // seq_hash -> page
+    std::unordered_map<uint32_t, uint64_t> hash_of_page;   // registered pages
+    // refcount-0 registered pages, LRU order (front = oldest)
+    std::list<uint32_t> reclaim_order;
+    std::unordered_map<uint32_t, std::list<uint32_t>::iterator> reclaim_pos;
+    // (page, seq_hash) pairs evicted since the last drain
+    std::vector<uint64_t> evicted_hashes;
+    std::vector<uint32_t> evicted_pages;
+};
+
+void* dyn_pool_new(uint32_t num_pages) {
+    if (num_pages < 2) return nullptr;
+    PagePool* p = new PagePool();
+    p->num_pages = num_pages;
+    p->free_list.reserve(num_pages - 1);
+    for (uint32_t i = num_pages - 1; i >= 1; i--) p->free_list.push_back(i);
+    return p;
+}
+
+void dyn_pool_delete(void* h) { delete (PagePool*)h; }
+
+size_t dyn_pool_num_free(void* h) {
+    PagePool* p = (PagePool*)h;
+    return p->free_list.size() + p->reclaim_order.size();
+}
+
+size_t dyn_pool_free_list_len(void* h) {
+    return ((PagePool*)h)->free_list.size();
+}
+
+// Oldest-first peek of reclaimable pages (the pages allocate() would evict
+// next); returns the count written.
+size_t dyn_pool_peek_reclaimable(void* h, uint32_t* out, size_t cap) {
+    PagePool* p = (PagePool*)h;
+    size_t k = 0;
+    for (uint32_t page : p->reclaim_order) {
+        if (k >= cap) break;
+        out[k++] = page;
+    }
+    return k;
+}
+
+static void pool_evict(PagePool* p, uint32_t page) {
+    auto hit = p->hash_of_page.find(page);
+    uint64_t h = hit->second;
+    p->hash_of_page.erase(hit);
+    p->by_hash.erase(h);
+    p->evicted_pages.push_back(page);
+    p->evicted_hashes.push_back(h);
+}
+
+// Returns 1 and writes n page ids to out, or 0 (insufficient pages; no
+// partial allocation).
+int dyn_pool_allocate(void* h, size_t n, uint32_t* out) {
+    PagePool* p = (PagePool*)h;
+    if (n > dyn_pool_num_free(h)) return 0;
+    for (size_t i = 0; i < n; i++) {
+        uint32_t page;
+        if (!p->free_list.empty()) {
+            page = p->free_list.back();
+            p->free_list.pop_back();
+        } else {
+            page = p->reclaim_order.front();
+            p->reclaim_order.pop_front();
+            p->reclaim_pos.erase(page);
+            pool_evict(p, page);
+        }
+        p->refcount[page] = 1;
+        out[i] = page;
+    }
+    return 1;
+}
+
+// Returns -1 on success, else the index of the first double-freed page (the
+// wrapper raises; pages before it were processed, matching the Python
+// partial-raise behavior).
+int64_t dyn_pool_release(void* h, const uint32_t* pages, size_t n) {
+    PagePool* p = (PagePool*)h;
+    for (size_t i = 0; i < n; i++) {
+        uint32_t page = pages[i];
+        auto it = p->refcount.find(page);
+        if (it == p->refcount.end()) return (int64_t)i;
+        if (it->second > 1) {
+            it->second--;
+            continue;
+        }
+        p->refcount.erase(it);
+        if (p->hash_of_page.count(page)) {
+            p->reclaim_order.push_back(page);
+            p->reclaim_pos[page] = std::prev(p->reclaim_order.end());
+        } else {
+            p->free_list.push_back(page);
+        }
+    }
+    return -1;
+}
+
+// Returns 1 iff newly registered (wrapper records page meta and emits the
+// "stored" event), 0 if the page is already registered or the hash is
+// already bound to a different page.
+int dyn_pool_register(void* h, uint32_t page, uint64_t seq_hash) {
+    PagePool* p = (PagePool*)h;
+    if (p->hash_of_page.count(page)) return 0;
+    auto prev = p->by_hash.find(seq_hash);
+    if (prev != p->by_hash.end() && prev->second != page) return 0;
+    p->by_hash[seq_hash] = page;
+    p->hash_of_page[page] = seq_hash;
+    return 1;
+}
+
+// Longest cached prefix; acquires a reference on each returned page.
+size_t dyn_pool_lookup(void* h, const uint64_t* hashes, size_t n, uint32_t* out) {
+    PagePool* p = (PagePool*)h;
+    size_t k = 0;
+    for (; k < n; k++) {
+        auto it = p->by_hash.find(hashes[k]);
+        if (it == p->by_hash.end()) break;
+        uint32_t page = it->second;
+        auto rc = p->refcount.find(page);
+        if (rc == p->refcount.end()) {
+            auto pos = p->reclaim_pos.find(page);
+            if (pos != p->reclaim_pos.end()) {
+                p->reclaim_order.erase(pos->second);
+                p->reclaim_pos.erase(pos);
+            }
+            p->refcount[page] = 1;
+        } else {
+            rc->second++;
+        }
+        out[k] = page;
+    }
+    return k;
+}
+
+size_t dyn_pool_match_length(void* h, const uint64_t* hashes, size_t n) {
+    PagePool* p = (PagePool*)h;
+    size_t k = 0;
+    while (k < n && p->by_hash.count(hashes[k])) k++;
+    return k;
+}
+
+// Evict every reclaimable page back to the free list; evictions queue for
+// drain. Returns the number cleared.
+size_t dyn_pool_clear_cache(void* h) {
+    PagePool* p = (PagePool*)h;
+    size_t n = 0;
+    while (!p->reclaim_order.empty()) {
+        uint32_t page = p->reclaim_order.front();
+        p->reclaim_order.pop_front();
+        p->reclaim_pos.erase(page);
+        pool_evict(p, page);
+        p->free_list.push_back(page);
+        n++;
+    }
+    return n;
+}
+
+size_t dyn_pool_evicted_pending(void* h) {
+    return ((PagePool*)h)->evicted_hashes.size();
+}
+
+// Drain up to cap evicted (page, seq_hash) pairs, oldest first; returns the
+// count written.
+size_t dyn_pool_drain_evicted(void* h, uint32_t* out_pages, uint64_t* out_hashes,
+                              size_t cap) {
+    PagePool* p = (PagePool*)h;
+    size_t n = p->evicted_hashes.size();
+    if (n > cap) n = cap;
+    for (size_t i = 0; i < n; i++) {
+        out_pages[i] = p->evicted_pages[i];
+        out_hashes[i] = p->evicted_hashes[i];
+    }
+    p->evicted_pages.erase(p->evicted_pages.begin(), p->evicted_pages.begin() + n);
+    p->evicted_hashes.erase(p->evicted_hashes.begin(),
+                            p->evicted_hashes.begin() + n);
+    return n;
+}
+
+}  // extern "C"

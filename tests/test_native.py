@@ -159,3 +159,101 @@ def test_native_radix_tree_matches_python():
     py_tree.clear()
     assert native_tree.num_blocks == py_tree.num_blocks == 0
     assert native_tree.find_matches(chains[0]).scores == {}
+
+
+# -- host tier slabs ---------------------------------------------------------
+
+
+def test_host_tier_native_slab_roundtrip():
+    import ml_dtypes
+
+    from dynamo_tpu.kvbm.tiers import BlockEntry, HostTier
+
+    shape = (2, 4, 8, 16)  # [L, Hkv, S, D]
+    tier = HostTier(capacity_bytes=1 << 20)
+    rng = np.random.default_rng(0)
+
+    def mk(h, parent=None):
+        k = rng.standard_normal(shape).astype(ml_dtypes.bfloat16)
+        v = rng.standard_normal(shape).astype(ml_dtypes.bfloat16)
+        return BlockEntry(seq_hash=h, parent_hash=parent, tokens=(1, 2), k=k, v=v)
+
+    entries = {h: mk(h) for h in (10, 11, 12)}
+    for e in entries.values():
+        assert tier.put(e)
+    assert tier._nh is not None, "native slab store should have activated"
+    assert len(tier) == 3
+    assert tier.used_bytes == 3 * entries[10].nbytes
+    for h, e in entries.items():
+        got = tier.get(h)
+        assert got is not None and got.parent_hash == e.parent_hash
+        np.testing.assert_array_equal(np.asarray(got.k), np.asarray(e.k))
+        np.testing.assert_array_equal(np.asarray(got.v), np.asarray(e.v))
+    popped = tier.pop(11)
+    np.testing.assert_array_equal(np.asarray(popped.k), np.asarray(entries[11].k))
+    assert 11 not in tier and len(tier) == 2
+    tier.clear()
+    assert len(tier) == 0 and tier.used_bytes == 0
+
+
+def test_host_tier_native_lru_demote_chain():
+    from dynamo_tpu.kvbm.tiers import BlockEntry, HostTier
+
+    shape = (1, 1, 4, 8)
+    demoted = []
+    one = np.ones(shape, np.float32)
+    nbytes = 2 * one.nbytes
+    tier = HostTier(capacity_bytes=3 * nbytes, demote=lambda e: demoted.append(
+        BlockEntry(e.seq_hash, e.parent_hash, e.tokens, e.k.copy(), e.v.copy())
+    ))
+    for h in range(5):
+        tier.put(BlockEntry(h, None, (h,), one * h, one * (h + 10)))
+    # capacity 3 blocks: 0 then 1 demoted, LRU-first
+    assert [e.seq_hash for e in demoted] == [0, 1]
+    assert len(tier) == 3
+    # demoted copies carried the right bytes
+    np.testing.assert_array_equal(demoted[1].k, one * 1)
+    # get() refreshes recency: touch 2, then insert -> 3 is the next victim
+    assert tier.get(2) is not None
+    tier.put(BlockEntry(99, None, (99,), one, one))
+    assert [e.seq_hash for e in demoted] == [0, 1, 3]
+
+
+# -- frame codec -------------------------------------------------------------
+
+
+def test_native_codec_matches_python_framing():
+    import ctypes
+
+    from dynamo_tpu.runtime.codec import decode_frame, encode_frame
+
+    header = {"op": "generate", "id": "r1", "n": 7}
+    payload = os.urandom(333)
+    frame = encode_frame(header, payload)
+
+    import msgpack
+
+    hbytes = msgpack.packb(header, use_bin_type=True)
+    prefix = (ctypes.c_uint8 * 24)()
+    lib().dyn_frame_prefix(hbytes, len(hbytes), payload, len(payload), prefix)
+    native_frame = bytes(prefix) + hbytes + payload
+    assert native_frame == frame, "C++ and Python framing must be byte-identical"
+
+    hlen = ctypes.c_uint64()
+    plen = ctypes.c_uint64()
+    rc = lib().dyn_frame_parse_prefix(
+        bytes(frame[:24]), ctypes.byref(hlen), ctypes.byref(plen)
+    )
+    assert rc == 0 and hlen.value == len(hbytes) and plen.value == len(payload)
+    assert lib().dyn_frame_check(
+        bytes(frame[:24]), hbytes, len(hbytes), payload, len(payload)
+    ) == 0
+    # corruption detected
+    bad = bytearray(payload)
+    bad[0] ^= 0xFF
+    assert lib().dyn_frame_check(
+        bytes(frame[:24]), hbytes, len(hbytes), bytes(bad), len(payload)
+    ) == 2
+    # Python side decodes the native-framed bytes
+    h2, p2, consumed = decode_frame(native_frame)
+    assert h2 == header and p2 == payload and consumed == len(frame)

@@ -1,0 +1,157 @@
+"""Operator: reconcile DynamoGraphDeployments into Deployments/Services.
+
+Reference parity: deploy/cloud/operator's reconcilers tested via envtest
+(suite_test.go); here the in-memory kube double plays the API server."""
+
+import copy
+
+from dynamo_tpu.operator import Controller, InMemoryKube, reconcile
+from dynamo_tpu.operator.reconciler import (
+    LABEL_OWNER,
+    desired_objects,
+    garbage_collect,
+)
+
+
+def make_cr(name="demo", services=None, generation=1):
+    return {
+        "apiVersion": "dynamo.tpu/v1alpha1",
+        "kind": "DynamoGraphDeployment",
+        "metadata": {"name": name, "namespace": "default",
+                     "generation": generation},
+        "spec": {
+            "image": "dynamo-tpu:test",
+            "services": services
+            if services is not None
+            else [
+                {
+                    "name": "Frontend",
+                    "class": "examples.llm.graphs.agg:Frontend",
+                    "replicas": 1,
+                    "endpoints": [],
+                    "depends": ["Worker"],
+                    "config": {"port": 8000},
+                },
+                {
+                    "name": "Worker",
+                    "class": "examples.llm.graphs.agg:Worker",
+                    "replicas": 2,
+                    "endpoints": ["generate"],
+                    "depends": [],
+                    "config": {},
+                },
+            ],
+        },
+    }
+
+
+def test_desired_objects_labeled_and_namespaced():
+    objs = desired_objects(make_cr())
+    assert objs, "renderer produced nothing"
+    kinds = sorted(o["kind"] for o in objs)
+    # fabric Deployment+Service, frontend Deployment+Service (has port),
+    # worker Deployment
+    assert kinds.count("Deployment") == 3
+    assert kinds.count("Service") == 2
+    for o in objs:
+        assert o["metadata"]["namespace"] == "default"
+        assert o["metadata"]["labels"][LABEL_OWNER] == "demo"
+
+
+def test_reconcile_creates_then_idempotent():
+    kube = InMemoryKube()
+    cr = make_cr()
+    kube.create("DynamoGraphDeployment", "default", cr)
+    status = reconcile(kube, cr)
+    assert status["lastAction"] == {"created": 5, "replaced": 0, "deleted": 0}
+    assert status["conditions"][0]["status"] == "True"
+    # replicas made it through
+    worker = kube.get("Deployment", "default", "worker")
+    assert worker["spec"]["replicas"] == 2
+    # Second pass: no changes.
+    kube.actions.clear()
+    status = reconcile(kube, cr)
+    assert status["lastAction"] == {"created": 0, "replaced": 0, "deleted": 0}
+    assert kube.actions == []
+
+
+def test_reconcile_scales_on_spec_change():
+    kube = InMemoryKube()
+    cr = make_cr()
+    reconcile(kube, cr)
+    cr2 = copy.deepcopy(cr)
+    cr2["spec"]["services"][1]["replicas"] = 5
+    status = reconcile(kube, cr2)
+    assert status["lastAction"]["replaced"] == 1
+    assert kube.get("Deployment", "default", "worker")["spec"]["replicas"] == 5
+
+
+def test_reconcile_deletes_removed_service():
+    kube = InMemoryKube()
+    cr = make_cr()
+    reconcile(kube, cr)
+    assert kube.get("Deployment", "default", "worker") is not None
+    cr2 = copy.deepcopy(cr)
+    cr2["spec"]["services"] = cr2["spec"]["services"][:1]  # drop Worker
+    status = reconcile(kube, cr2)
+    assert status["lastAction"]["deleted"] == 1
+    assert kube.get("Deployment", "default", "worker") is None
+    # frontend + fabric untouched
+    assert kube.get("Deployment", "default", "frontend") is not None
+
+
+def test_reconcile_heals_manual_drift():
+    kube = InMemoryKube()
+    cr = make_cr()
+    reconcile(kube, cr)
+    # Someone kubectl-edits the replica count behind the operator's back.
+    obj = kube.get("Deployment", "default", "worker")
+    obj["spec"]["replicas"] = 0
+    kube.replace("Deployment", "default", "worker", obj)
+    reconcile(kube, cr)
+    assert kube.get("Deployment", "default", "worker")["spec"]["replicas"] == 2
+
+
+def test_garbage_collect_orphans():
+    kube = InMemoryKube()
+    cr = make_cr(name="gone")
+    reconcile(kube, cr)
+    n = garbage_collect(kube, "default", live_owners=set())
+    assert n == 5
+    assert kube.list("Deployment", "default") == []
+
+
+def test_controller_pass_updates_status_and_gc():
+    kube = InMemoryKube()
+    kube.create("DynamoGraphDeployment", "default", make_cr(name="a"))
+    ctl = Controller(kube, namespace="default")
+    statuses = ctl.reconcile_once()
+    assert statuses["a"]["conditions"][0]["status"] == "True"
+    cr = kube.get("DynamoGraphDeployment", "default", "a")
+    assert cr["status"]["observedGeneration"] == 1
+    # Delete the CR; next pass GCs its children.
+    kube.delete("DynamoGraphDeployment", "default", "a")
+    ctl.reconcile_once()
+    assert kube.list("Deployment", "default") == []
+
+
+def test_two_crs_do_not_interfere():
+    kube = InMemoryKube()
+    a = make_cr(name="a", services=[{
+        "name": "OnlyA", "class": "x:A", "replicas": 1,
+        "endpoints": [], "depends": [], "config": {},
+    }])
+    b = make_cr(name="b", services=[{
+        "name": "OnlyB", "class": "x:B", "replicas": 1,
+        "endpoints": [], "depends": [], "config": {},
+    }])
+    a["spec"]["fabricHost"] = "fabric-a"
+    b["spec"]["fabricHost"] = "fabric-b"
+    reconcile(kube, a)
+    reconcile(kube, b)
+    # Removing all of a's services must not touch b's objects.
+    a2 = copy.deepcopy(a)
+    a2["spec"]["services"] = []
+    reconcile(kube, a2)
+    assert kube.get("Deployment", "default", "onlyb") is not None
+    assert kube.get("Deployment", "default", "onlya") is None

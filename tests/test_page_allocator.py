@@ -82,3 +82,92 @@ def test_clear_cache():
     assert n == 2
     assert a.match_length([50]) == 0
     assert a.num_free == 5
+
+
+# -- native/python backend parity -------------------------------------------
+# The pool bookkeeping runs in C++ (native/pool.cpp) when libdynamo_native is
+# available; these drive the same random workload through both backends and
+# assert identical page ids, capacity accounting, and KV events.
+
+
+def _forced_python_allocator(monkeypatch, *args, **kwargs):
+    from dynamo_tpu import native
+
+    monkeypatch.setattr(native, "lib", lambda: None)
+    a = PageAllocator(*args, **kwargs)
+    assert a._np is None
+    return a
+
+
+def test_native_backend_active_when_lib_built():
+    from dynamo_tpu.native import ensure_built
+
+    if ensure_built() is None:
+        pytest.skip("native library unavailable")
+    a = PageAllocator(num_pages=8, page_size=4)
+    assert a._np is not None
+
+
+def test_native_python_parity_fuzz(monkeypatch):
+    import random
+
+    from dynamo_tpu.native import ensure_built
+
+    if ensure_built() is None:
+        pytest.skip("native library unavailable")
+
+    ev_a, ev_b = [], []
+    a = PageAllocator(num_pages=33, page_size=4, on_event=ev_a.append)
+    assert a._np is not None
+    b = _forced_python_allocator(
+        monkeypatch, num_pages=33, page_size=4, on_event=ev_b.append
+    )
+
+    rng = random.Random(123)
+    held_a, held_b = [], []  # parallel lists of page lists
+    hashes = [rng.getrandbits(64) for _ in range(40)]
+    next_hash = 0
+
+    for step in range(2000):
+        op = rng.random()
+        assert a.num_free == b.num_free, f"step {step}"
+        if op < 0.35:  # allocate
+            n = rng.randrange(1, 5)
+            ra, rb = a.allocate(n), b.allocate(n)
+            assert ra == rb, f"step {step}: {ra} != {rb}"
+            if ra is not None:
+                held_a.append(ra)
+                held_b.append(rb)
+        elif op < 0.55 and held_a:  # free
+            i = rng.randrange(len(held_a))
+            a.free(held_a.pop(i))
+            b.free(held_b.pop(i))
+        elif op < 0.75 and held_a:  # register a held page under a chain hash
+            i = rng.randrange(len(held_a))
+            j = rng.randrange(len(held_a[i]))
+            h = hashes[next_hash % len(hashes)] + next_hash
+            next_hash += 1
+            toks = tuple(rng.randrange(100) for _ in range(4))
+            a.register(held_a[i][j], h, None, toks)
+            b.register(held_b[i][j], h, None, toks)
+        elif op < 0.9:  # lookup a random chain
+            k = rng.randrange(1, 6)
+            chain = [hashes[rng.randrange(len(hashes))] for _ in range(k)]
+            ra, rb = a.lookup(chain), b.lookup(chain)
+            assert ra == rb, f"step {step}"
+            if ra:
+                held_a.append(ra)
+                held_b.append(rb)
+            assert a.match_length(chain) == b.match_length(chain)
+        else:  # clear cache sometimes
+            assert a.clear_cache() == b.clear_cache()
+
+    assert a.stats == b.stats
+    assert ev_a == ev_b
+    # Drain everything and confirm full recovery in both.
+    for pa, pb in zip(held_a, held_b):
+        a.free(pa)
+        b.free(pb)
+    assert a.num_free == b.num_free
+    assert a.clear_cache() == b.clear_cache()
+    assert a.num_free == 32
