@@ -31,10 +31,17 @@ class AsyncEngine(Protocol):
 
 def output_to_dict(out: StepOutput) -> dict:
     """The one wire shape for engine stream items."""
-    return {
+    d = {
         "token_ids": list(out.new_token_ids),
         "finish_reason": out.finish_reason.value if out.finish_reason else None,
     }
+    if out.logprobs is not None:
+        d["logprobs"] = list(out.logprobs)
+    if out.top_logprobs is not None:
+        d["top_logprobs"] = [
+            [[tid, lp] for tid, lp in alts] for alts in out.top_logprobs
+        ]
+    return d
 
 
 def _sampling_from(req: PreprocessedRequest) -> SamplingParams:
@@ -46,6 +53,9 @@ def _sampling_from(req: PreprocessedRequest) -> SamplingParams:
         stop_token_ids=tuple(req.stop_token_ids),
         ignore_eos=req.ignore_eos,
         seed=req.seed,
+        logprobs=getattr(req, "logprobs", -1),
+        frequency_penalty=getattr(req, "frequency_penalty", 0.0),
+        presence_penalty=getattr(req, "presence_penalty", 0.0),
     )
 
 

@@ -313,16 +313,31 @@ class JaxEngine:
             # hits — the common case) compiles a history-free program:
             # attention over the in-register chunk only, no page gather.
             first_chunk = all(p.start == 0 for p in pieces)
+            lp_data = None
             if any_last:
                 reqs = [p.request for p in pieces]
                 samp, all_greedy = self._sampling_arrays(reqs, pad_to=b_bucket)
+                lp = self._batch_logprobs(reqs)
                 fn = self._get_step_fn(
                     "prefill", b_bucket, t_bucket, greedy=all_greedy,
-                    mm=any_mm, first_chunk=first_chunk,
+                    mm=any_mm, first_chunk=first_chunk, lp=lp,
                 )
-                token_ids, self.kv = fn(
-                    *args, self._dev(last_idx), *samp, *mm_args
+                # mm ride as keywords: the positional tail of the shared
+                # step_fn signature belongs to the decode-only penalty args.
+                mm_kwargs = (
+                    {"mm_embeds": mm_args[0], "mm_mask": mm_args[1]}
+                    if any_mm
+                    else {}
                 )
+                if lp >= 0:
+                    token_ids, lp_raw, self.kv = fn(
+                        *args, self._dev(last_idx), *samp, **mm_kwargs
+                    )
+                    lp_data = tuple(np.asarray(x) for x in lp_raw)
+                else:
+                    token_ids, self.kv = fn(
+                        *args, self._dev(last_idx), *samp, **mm_kwargs
+                    )
                 ids = np.asarray(token_ids)
             else:
                 # No piece finishes its prompt: KV writes only — skip the
@@ -339,8 +354,21 @@ class JaxEngine:
                 self._register_pages(req)
                 if req.prefill_done:
                     req.state = RequestState.DECODE
+                    lps = tops = None
+                    if lp_data is not None and req.sampling.logprobs >= 0:
+                        lps = (float(lp_data[0][i]),)
+                        nk = req.sampling.logprobs
+                        if nk > 0:
+                            tops = (
+                                tuple(
+                                    (int(lp_data[1][i, j]), float(lp_data[2][i, j]))
+                                    for j in range(min(nk, lp_data[1].shape[-1]))
+                                ),
+                            )
                     outputs.extend(
-                        self._accept_token(req, int(ids[i]), first=True)
+                        self._accept_token(
+                            req, int(ids[i]), first=True, lps=lps, tops=tops
+                        )
                     )
         return outputs
 
@@ -421,20 +449,43 @@ class JaxEngine:
             pt[i, : len(req.pages)] = req.pages
 
         samp, all_greedy = self._sampling_arrays(reqs, pad_to=b_bucket)
+        lp = self._batch_logprobs(reqs)
+        pen = self._batch_penalty_bucket(reqs)
+        pen_args = (
+            self._penalty_arrays(reqs, b_bucket, pen) if pen else ()
+        )
         args = (
             self.params, self._dev(tokens), self._dev(positions),
             self._dev(valid), self.kv, self._dev(pt),
         )
+        lp_data = None
         if k_steps == 1:
-            fn = self._get_step_fn("decode", b_bucket, 1, greedy=all_greedy)
+            fn = self._get_step_fn(
+                "decode", b_bucket, 1, greedy=all_greedy, lp=lp, pen=pen
+            )
             last_idx = np.zeros(b_bucket, np.int32)
-            token_ids, self.kv = fn(*args, self._dev(last_idx), *samp)
+            if lp >= 0:
+                token_ids, lp_data, self.kv = fn(
+                    *args, self._dev(last_idx), *samp, *pen_args
+                )
+            else:
+                token_ids, self.kv = fn(
+                    *args, self._dev(last_idx), *samp, *pen_args
+                )
         else:
             fn = self._get_step_fn(
-                "decode_multi", b_bucket, k_steps, greedy=all_greedy
+                "decode_multi", b_bucket, k_steps, greedy=all_greedy, lp=lp,
+                pen=pen,
             )
-            token_ids, self.kv = fn(*args, *samp)  # [K, B]
+            if lp >= 0:
+                token_ids, lp_data, self.kv = fn(*args, *samp, *pen_args)
+            else:
+                token_ids, self.kv = fn(*args, *samp, *pen_args)  # [K, B]
         ids = np.asarray(token_ids).reshape(k_steps, b_bucket)
+        if lp_data is not None:
+            chosen_lp = np.asarray(lp_data[0]).reshape(k_steps, b_bucket)
+            top_ids = np.asarray(lp_data[1]).reshape(k_steps, b_bucket, -1)
+            top_lps = np.asarray(lp_data[2]).reshape(k_steps, b_bucket, -1)
         outputs: list[StepOutput] = []
         for i, req in enumerate(reqs):
             accepted: list[int] = []
@@ -446,11 +497,73 @@ class JaxEngine:
                 if finish is not None:
                     break
             req.num_computed_tokens += len(accepted)
-            outputs.extend(self._accept_tokens(req, accepted, finish))
+            lps = tops = None
+            if lp_data is not None and req.sampling.logprobs >= 0:
+                n = len(accepted)
+                lps = tuple(float(chosen_lp[kk, i]) for kk in range(n))
+                nk = req.sampling.logprobs
+                if nk > 0:
+                    tops = tuple(
+                        tuple(
+                            (int(top_ids[kk, i, j]), float(top_lps[kk, i, j]))
+                            for j in range(min(nk, top_ids.shape[-1]))
+                        )
+                        for kk in range(n)
+                    )
+            outputs.extend(
+                self._accept_tokens(req, accepted, finish, lps=lps, tops=tops)
+            )
             self._register_pages(req)
         return outputs
 
     # -- shared ------------------------------------------------------------
+
+    @staticmethod
+    def _batch_logprobs(reqs: list[Request]) -> int:
+        """Program-variant selector: -1 when no request wants logprobs,
+        else the largest top-N requested (the program computes one top-k;
+        per-request N slices it host-side). Snapped to the small OpenAI
+        range {0,1,..,20} so the compile family stays bounded."""
+        lp = -1
+        for r in reqs:
+            lp = max(lp, min(r.sampling.logprobs, 20))
+        return lp
+
+    @staticmethod
+    def _batch_penalty_bucket(reqs: list[Request]) -> int:
+        """0 when no request carries a frequency/presence penalty; else the
+        output-history bucket O (power of two) the penalty programs index.
+        The bucket, not the batch, keys the program variant — the family
+        grows log2(max_tokens) deep."""
+        if not any(
+            r.sampling.frequency_penalty or r.sampling.presence_penalty
+            for r in reqs
+        ):
+            return 0
+        longest = max(len(r.output_tokens) for r in reqs)
+        o = 1
+        while o < max(1, longest):
+            o *= 2
+        return o
+
+    def _penalty_arrays(self, reqs: list[Request], pad_to: int, o_bucket: int):
+        """(freq [B], pres [B], out_tokens [B, O], out_valid [B, O]) — the
+        output-token history the penalties are computed over."""
+        freq = np.zeros(pad_to, np.float32)
+        pres = np.zeros(pad_to, np.float32)
+        out_toks = np.zeros((pad_to, o_bucket), np.int32)
+        out_valid = np.zeros((pad_to, o_bucket), bool)
+        for i, r in enumerate(reqs):
+            freq[i] = r.sampling.frequency_penalty
+            pres[i] = r.sampling.presence_penalty
+            n = min(len(r.output_tokens), o_bucket)
+            if n:
+                out_toks[i, :n] = r.output_tokens[-n:]
+                out_valid[i, :n] = True
+        return (
+            self._dev(freq), self._dev(pres),
+            self._dev(out_toks), self._dev(out_valid),
+        )
 
     def _sampling_arrays(self, reqs: list[Request], pad_to: Optional[int] = None):
         """Returns ((temps, top_ps, top_ks, seeds, counters), all_greedy).
@@ -492,13 +605,38 @@ class JaxEngine:
 
     def _get_step_fn(
         self, kind: str, b: int, t: int, greedy: bool = False,
-        mm: bool = False, first_chunk: bool = False,
+        mm: bool = False, first_chunk: bool = False, lp: int = -1,
+        pen: int = 0,
     ) -> Callable:
-        cache_key = (kind, b, t, greedy, mm, first_chunk)
+        cache_key = (kind, b, t, greedy, mm, first_chunk, lp, pen)
         fn = self._jit_cache.get(cache_key)
         if fn is not None:
             return fn
         adapter = self.adapter
+
+        def maybe_logprobs(logits, ids):
+            """(chosen_lp, top_ids, top_lps) when this variant reports
+            logprobs, else None (OpenAI semantics — unscaled, unpenalized
+            model distribution)."""
+            if lp < 0:
+                return None
+            from dynamo_tpu.engine.sampling import token_logprobs
+
+            return token_logprobs(logits, ids, lp)
+
+        def pick(logits, samp_args, counts=None, freq=None, pres=None):
+            """Sample ids [B] from (possibly penalty-adjusted) logits;
+            logprob reporting reads the raw logits separately."""
+            eff = logits
+            if counts is not None:
+                from dynamo_tpu.engine.sampling import apply_penalties
+
+                eff = apply_penalties(logits, counts, freq, pres)
+            if greedy:
+                ids = sample_greedy(eff)
+            else:
+                ids = sample(eff, *samp_args)
+            return ids
 
         if kind == "embed":
 
@@ -522,25 +660,43 @@ class JaxEngine:
             k_steps = t  # the (b, t) slot carries (bucket, fused steps)
 
             def multi_fn(params, tokens, positions, valid, kv, pt,
-                         temps, top_ps, top_ks, seeds, counters):
+                         temps, top_ps, top_ks, seeds, counters,
+                         freq=None, pres=None, out_toks=None, out_valid=None):
+                if pen:
+                    from dynamo_tpu.engine.sampling import build_output_counts
+
+                    counts0 = build_output_counts(
+                        out_toks, out_valid, adapter.vocab_size
+                    )
+                else:
+                    counts0 = jnp.zeros((), jnp.float32)  # unused carry
+
                 def body(carry, _):
-                    tokens, positions, kv, counters = carry
+                    tokens, positions, kv, counters, counts = carry
                     hidden, kv = adapter.forward_hidden(
                         params, tokens, positions, valid, kv, pt
                     )
                     logits = adapter.compute_logits(params, hidden[:, -1])
-                    if greedy:
-                        ids = sample_greedy(logits)
-                    else:
-                        ids = sample(
-                            logits, temps, top_ps, top_ks, seeds, counters
-                        )
-                    return (ids[:, None], positions + 1, kv, counters + 1), ids
+                    ids = pick(
+                        logits, (temps, top_ps, top_ks, seeds, counters),
+                        counts=counts if pen else None, freq=freq, pres=pres,
+                    )
+                    if pen:
+                        # Each fused step extends the history it penalizes.
+                        rows = jnp.arange(ids.shape[0])
+                        counts = counts.at[rows, ids].add(1.0)
+                    out = (ids, maybe_logprobs(logits, ids))
+                    return (
+                        (ids[:, None], positions + 1, kv, counters + 1, counts),
+                        out,
+                    )
 
-                (_, _, kv, _), all_ids = jax.lax.scan(
-                    body, (tokens, positions, kv, counters), None,
+                (_, _, kv, _, _), (all_ids, all_lp) = jax.lax.scan(
+                    body, (tokens, positions, kv, counters, counts0), None,
                     length=k_steps,
                 )
+                if lp >= 0:
+                    return all_ids, all_lp, kv  # [K, B] (+ lp triple)
                 return all_ids, kv  # [K, B]
 
             jitted = jax.jit(multi_fn, donate_argnums=(4,))
@@ -569,6 +725,7 @@ class JaxEngine:
 
         def step_fn(params, tokens, positions, valid, kv, pt, last_idx,
                     temps, top_ps, top_ks, seeds, counters,
+                    freq=None, pres=None, out_toks=None, out_valid=None,
                     mm_embeds=None, mm_mask=None):
             hidden, kv = adapter.forward_hidden(
                 params, tokens, positions, valid, kv, pt,
@@ -578,10 +735,19 @@ class JaxEngine:
             rows = jnp.arange(hidden.shape[0])
             last_hidden = hidden[rows, last_idx]  # [B, H] — lm_head only here
             logits = adapter.compute_logits(params, last_hidden)
-            if greedy:
-                ids = sample_greedy(logits)  # unused samp args are DCE'd
-            else:
-                ids = sample(logits, temps, top_ps, top_ks, seeds, counters)
+            counts = None
+            if pen:
+                from dynamo_tpu.engine.sampling import build_output_counts
+
+                counts = build_output_counts(
+                    out_toks, out_valid, adapter.vocab_size
+                )
+            ids = pick(
+                logits, (temps, top_ps, top_ks, seeds, counters),
+                counts=counts, freq=freq, pres=pres,
+            )
+            if lp >= 0:
+                return ids, maybe_logprobs(logits, ids), kv
             return ids, kv
 
         jitted = jax.jit(step_fn, donate_argnums=(4,))
@@ -611,6 +777,8 @@ class JaxEngine:
         tokens: Sequence[int],
         finish: Optional[FinishReason],
         first: bool = False,
+        lps: Optional[tuple[float, ...]] = None,
+        tops: Optional[tuple] = None,
     ) -> list[StepOutput]:
         chain = self.scheduler.chains.get(req.request_id)
         for tok in tokens:
@@ -627,12 +795,19 @@ class JaxEngine:
                 new_token_ids=tuple(tokens),
                 finish_reason=finish,
                 is_first=first,
+                logprobs=lps,
+                top_logprobs=tops,
             )
         ]
 
-    def _accept_token(self, req: Request, token: int, first: bool = False) -> list[StepOutput]:
+    def _accept_token(
+        self, req: Request, token: int, first: bool = False,
+        lps: Optional[tuple[float, ...]] = None, tops: Optional[tuple] = None,
+    ) -> list[StepOutput]:
         finish = self._finish_reason_for(req, token, 1)
-        return self._accept_tokens(req, [token], finish, first=first)
+        return self._accept_tokens(
+            req, [token], finish, first=first, lps=lps, tops=tops
+        )
 
     # -- embeddings --------------------------------------------------------
 

@@ -22,6 +22,12 @@ class SamplingParams:
     stop_token_ids: tuple[int, ...] = ()
     ignore_eos: bool = False
     seed: Optional[int] = None
+    #: -1 = off; 0 = chosen-token logprob only; N>0 = chosen + top-N
+    #: alternatives per emitted token (OpenAI logprobs/top_logprobs)
+    logprobs: int = -1
+    #: OpenAI penalties over the output-token history (0 = off)
+    frequency_penalty: float = 0.0
+    presence_penalty: float = 0.0
 
 
 class FinishReason(str, enum.Enum):
@@ -94,3 +100,7 @@ class StepOutput:
     finish_reason: Optional[FinishReason] = None
     #: set on the first output of a request (TTFT accounting)
     is_first: bool = False
+    #: per-token logprob of each new token (when sampling.logprobs >= 0)
+    logprobs: Optional[tuple[float, ...]] = None
+    #: per-token top-N alternatives [(token_id, logprob), ...]
+    top_logprobs: Optional[tuple[tuple[tuple[int, float], ...], ...]] = None

@@ -24,6 +24,35 @@ _NEG_INF = -1e30
 DEFAULT_K_CAP = 64
 
 
+def build_output_counts(
+    out_tokens: jax.Array,  # [B, O] i32 output-token history (padded)
+    out_valid: jax.Array,  # [B, O] bool
+    vocab: int,
+) -> jax.Array:  # [B, V] f32 per-token output frequency
+    """Scatter the output-token history into a per-vocab count table (the
+    state the OpenAI frequency/presence penalties are defined over; output
+    tokens only, matching the common engine interpretation)."""
+    b = out_tokens.shape[0]
+    counts = jnp.zeros((b, vocab), jnp.float32)
+    rows = jnp.broadcast_to(jnp.arange(b)[:, None], out_tokens.shape)
+    return counts.at[rows, out_tokens].add(out_valid.astype(jnp.float32))
+
+
+def apply_penalties(
+    logits: jax.Array,  # [B, V] f32
+    counts: jax.Array,  # [B, V] f32 output-token frequency
+    freq_pen: jax.Array,  # [B] f32
+    pres_pen: jax.Array,  # [B] f32
+) -> jax.Array:
+    """OpenAI penalty rule: logit -= freq_pen * count + pres_pen * (count>0),
+    applied to the raw logits before temperature scaling."""
+    return (
+        logits
+        - freq_pen[:, None] * counts
+        - pres_pen[:, None] * (counts > 0).astype(logits.dtype)
+    )
+
+
 def sample(
     logits: jax.Array,  # [B, V] f32
     temperature: jax.Array,  # [B] f32 (<=0 => greedy)
@@ -70,3 +99,23 @@ def sample_greedy(logits: jax.Array) -> jax.Array:
     """Argmax-only fast path: when every request in the batch is greedy the
     engine compiles this instead of the sampling pipeline."""
     return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+
+def token_logprobs(
+    logits: jax.Array,  # [B, V] f32
+    ids: jax.Array,  # [B] i32 chosen token per row
+    k: int,  # top-k alternatives to report (0 => chosen only)
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Log-probabilities under the UNSCALED distribution (OpenAI semantics:
+    logprobs describe the model, not the sampling temperature).
+
+    Returns (chosen_lp [B], top_ids [B, max(k,1)], top_lps [B, max(k,1)]);
+    with k == 0 the top arrays are computed for 1 candidate and ignored by
+    the caller (keeps one jaxpr shape per k)."""
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)  # [B]
+    chosen = jnp.take_along_axis(logits, ids[:, None].astype(jnp.int32), axis=-1)[
+        :, 0
+    ]
+    kk = max(k, 1)
+    top_vals, top_idx = jax.lax.top_k(logits, kk)  # [B, kk]
+    return chosen - lse, top_idx.astype(jnp.int32), top_vals - lse[:, None]

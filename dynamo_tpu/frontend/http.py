@@ -368,14 +368,35 @@ class HttpService:
             output_tokens=usage.completion_tokens if usage else 0,
         )
         if kind == "completion":
+            from dynamo_tpu.protocols.openai import CompletionLogprobs
+
+            def to_completion_choice(choice) -> CompletionChoice:
+                lp = None
+                if choice.logprobs is not None:
+                    entries = choice.logprobs.content
+                    offsets, pos = [], 0
+                    for e in entries:
+                        offsets.append(pos)
+                        pos += len(e.token)
+                    lp = CompletionLogprobs(
+                        tokens=[e.token for e in entries],
+                        token_logprobs=[e.logprob for e in entries],
+                        top_logprobs=[
+                            {t.token: t.logprob for t in e.top_logprobs}
+                            for e in entries
+                        ],
+                        text_offset=offsets,
+                    )
+                return CompletionChoice(
+                    index=choice.index,
+                    text=choice.message.content or "",
+                    logprobs=lp,
+                    finish_reason=choice.finish_reason,
+                )
+
             comp = CompletionResponse(
                 id=resp.id, created=resp.created, model=req.model,
-                choices=[
-                    CompletionChoice(
-                        text=resp.choices[0].message.content or "",
-                        finish_reason=resp.choices[0].finish_reason,
-                    )
-                ],
+                choices=[to_completion_choice(c) for c in resp.choices],
                 usage=usage,
             )
             return web.json_response(comp.model_dump(exclude_none=True))

@@ -72,9 +72,8 @@ class ModelPipeline:
         include_usage = bool(
             request.stream_options and request.stream_options.include_usage
         ) or not request.stream
-        stream = self.engine_fn(ctx, pre)
-        async for chunk in self.preprocessor.postprocess_chat_stream(
-            stream, pre.request_id, pre, include_usage=include_usage
+        async for chunk in self._choices_stream(
+            pre, ctx, include_usage, n=request.n or 1
         ):
             yield chunk
 
@@ -87,11 +86,88 @@ class ModelPipeline:
         include_usage = bool(
             request.stream_options and request.stream_options.include_usage
         ) or not request.stream
-        stream = self.engine_fn(ctx, pre)
-        async for chunk in self.preprocessor.postprocess_chat_stream(
-            stream, pre.request_id, pre, include_usage=include_usage
+        async for chunk in self._choices_stream(
+            pre, ctx, include_usage, n=request.n or 1
         ):
             yield chunk
+
+    def _one_choice(self, pre: PreprocessedRequest, ctx: Context, include_usage):
+        stream = self.engine_fn(ctx, pre)
+        return self.preprocessor.postprocess_chat_stream(
+            stream, pre.request_id, pre, include_usage=include_usage
+        )
+
+    async def _choices_stream(
+        self, pre: PreprocessedRequest, ctx: Context, include_usage: bool,
+        n: int,
+    ) -> AsyncIterator[ChatCompletionChunk]:
+        """OpenAI `n`: one engine generation per choice, streamed
+        interleaved with choice indices rewritten (the engine batches the
+        sibling generations like any other concurrent requests — the
+        prefix cache makes their shared prompt prefill nearly free)."""
+        if n <= 1:
+            async for chunk in self._one_choice(pre, ctx, include_usage):
+                yield chunk
+            return
+        import dataclasses
+
+        done = object()
+        queue: asyncio.Queue = asyncio.Queue()
+        usages: list[Usage] = []
+
+        def sub_pre(i: int) -> PreprocessedRequest:
+            return dataclasses.replace(
+                pre,
+                request_id=f"{pre.request_id}-{i}",
+                seed=None if pre.seed is None else pre.seed + i,
+            )
+
+        async def pump(i: int):
+            try:
+                async for chunk in self._one_choice(sub_pre(i), ctx, include_usage):
+                    # All chunks of one completion share one id and one
+                    # usage block: restore the parent id and fold the
+                    # per-choice usage into a single trailing chunk.
+                    chunk.id = pre.request_id
+                    if chunk.usage is not None:
+                        usages.append(chunk.usage)
+                        chunk.usage = None
+                    for c in chunk.choices:
+                        c.index = i
+                    await queue.put(chunk)
+            except Exception as e:  # surfaced on the consumer side
+                await queue.put(e)
+            finally:
+                await queue.put(done)
+
+        tasks = [asyncio.create_task(pump(i)) for i in range(n)]
+        finished = 0
+        try:
+            while finished < n:
+                item = await queue.get()
+                if item is done:
+                    finished += 1
+                    continue
+                if isinstance(item, Exception):
+                    raise item
+                yield item
+            if usages:
+                combined = Usage(
+                    prompt_tokens=usages[0].prompt_tokens,
+                    completion_tokens=sum(u.completion_tokens for u in usages),
+                )
+                combined.total_tokens = (
+                    combined.prompt_tokens + combined.completion_tokens
+                )
+                yield ChatCompletionChunk(
+                    id=pre.request_id,
+                    model=self.card.name,
+                    choices=[],
+                    usage=combined,
+                )
+        finally:
+            for t in tasks:
+                t.cancel()
 
     async def _encode_image_parts(self, messages: list[dict]) -> list[dict]:
         """Turn image_pixels content parts into image_embed parts via the

@@ -43,6 +43,10 @@ class PreprocessedRequest:
     stop_token_ids: list[int] = field(default_factory=list)
     stop_strings: list[str] = field(default_factory=list)
     ignore_eos: bool = False
+    #: -1 off; 0 chosen-token logprob; N>0 chosen + top-N alternatives
+    logprobs: int = -1
+    frequency_penalty: float = 0.0
+    presence_penalty: float = 0.0
     annotations: dict[str, Any] = field(default_factory=dict)
     #: multimodal: projected image embeddings [n, H] f32 (numpy) spliced at
     #: mm_positions (absolute prompt indices of the placeholder tokens)
@@ -61,6 +65,9 @@ class PreprocessedRequest:
             "stop_token_ids": self.stop_token_ids,
             "stop_strings": self.stop_strings,
             "ignore_eos": self.ignore_eos,
+            "logprobs": self.logprobs,
+            "frequency_penalty": self.frequency_penalty,
+            "presence_penalty": self.presence_penalty,
             "annotations": self.annotations,
         }
         if self.mm_embeds is not None:
@@ -91,6 +98,33 @@ def _stop_list(stop) -> list[str]:
     if isinstance(stop, str):
         return [stop]
     return list(stop)
+
+
+def _chat_logprobs(request) -> int:
+    """Chat logprobs knobs → engine value, with OpenAI's validation
+    (rejected with 400, not silently clamped)."""
+    n = request.top_logprobs
+    if n is not None and not 0 <= int(n) <= 20:
+        raise ValueError(
+            f"top_logprobs must be between 0 and 20; got {n}"
+        )
+    if not request.logprobs:
+        if n:
+            raise ValueError(
+                "top_logprobs requires logprobs to be true"
+            )
+        return -1
+    return int(n or 0)
+
+
+def _completion_logprobs(request) -> int:
+    """Legacy completions logprobs=N → engine value, validated."""
+    n = request.logprobs
+    if n is None:
+        return -1
+    if not 0 <= int(n) <= 20:
+        raise ValueError(f"logprobs must be between 0 and 20; got {n}")
+    return int(n)
 
 
 class OpenAIPreprocessor:
@@ -132,6 +166,11 @@ class OpenAIPreprocessor:
             seed=request.seed,
             stop=request.stop,
             ext=request.extension,
+            # chat API: logprobs=true turns reporting on; top_logprobs asks
+            # for N alternatives per token (OpenAI caps at 20)
+            logprobs=_chat_logprobs(request),
+            frequency_penalty=request.frequency_penalty or 0.0,
+            presence_penalty=request.presence_penalty or 0.0,
         )
         pre.mm_embeds = mm_embeds
         pre.mm_positions = mm_positions
@@ -211,10 +250,16 @@ class OpenAIPreprocessor:
             seed=request.seed,
             stop=request.stop,
             ext=request.extension,
+            # completions API (legacy): logprobs=N means chosen + top-N
+            logprobs=_completion_logprobs(request),
+            frequency_penalty=request.frequency_penalty or 0.0,
+            presence_penalty=request.presence_penalty or 0.0,
         )
 
     def _common(
-        self, prompt_ids, max_tokens, temperature, top_p, top_k, seed, stop, ext
+        self, prompt_ids, max_tokens, temperature, top_p, top_k, seed, stop,
+        ext, logprobs: int = -1, frequency_penalty: float = 0.0,
+        presence_penalty: float = 0.0,
     ) -> PreprocessedRequest:
         return PreprocessedRequest(
             request_id=new_request_id(),
@@ -227,6 +272,9 @@ class OpenAIPreprocessor:
             stop_token_ids=list(self.tokenizer.eos_token_ids),
             stop_strings=_stop_list(stop),
             ignore_eos=bool(ext.ignore_eos) if ext else False,
+            logprobs=logprobs,
+            frequency_penalty=frequency_penalty or 0.0,
+            presence_penalty=presence_penalty or 0.0,
             annotations=(ext.annotations or {}) if ext else {},
         )
 
@@ -246,8 +294,12 @@ class OpenAIPreprocessor:
         completion_tokens = 0
         first = True
         finish: Optional[str] = None
+        #: logprob entries for tokens whose text is still buffered by the
+        #: stop-checker — attached to the next emitted chunk so the entry
+        #: sequence stays complete and ordered
+        pending_lp: list = []
 
-        def chunk(content=None, role=None, finish_reason=None):
+        def chunk(content=None, role=None, finish_reason=None, logprobs=None):
             return ChatCompletionChunk(
                 id=request_id,
                 created=created,
@@ -255,26 +307,75 @@ class OpenAIPreprocessor:
                 choices=[
                     ChatStreamChoice(
                         delta=ChatChoiceDelta(role=role, content=content),
+                        logprobs=logprobs,
                         finish_reason=finish_reason,
                     )
                 ],
             )
 
+        def tok_repr(t: int) -> tuple[str, list[int]]:
+            """(display text, exact bytes) for one token. token_bytes keeps
+            partial-UTF-8 tokens exact — the whole point of the OpenAI
+            `bytes` field; the display string may show replacement chars."""
+            if hasattr(self.tokenizer, "token_bytes"):
+                raw = self.tokenizer.token_bytes(t)
+            else:
+                raw = self.tokenizer.decode([t]).encode()
+            return raw.decode("utf-8", errors="replace"), list(raw)
+
+        def lp_entry(tok: int, i: int, event: dict):
+            from dynamo_tpu.protocols.openai import TokenLogprob, TopLogprob
+
+            lps = event.get("logprobs")
+            if lps is None or i >= len(lps):
+                return None
+            tok_text, tok_raw = tok_repr(tok)
+            alts = []
+            for pair in (event.get("top_logprobs") or [[]] * len(lps))[i]:
+                alt_text, alt_raw = tok_repr(int(pair[0]))
+                alts.append(
+                    TopLogprob(
+                        token=alt_text,
+                        logprob=float(pair[1]),
+                        bytes=alt_raw,
+                    )
+                )
+            return TokenLogprob(
+                token=tok_text,
+                logprob=float(lps[i]),
+                bytes=tok_raw,
+                top_logprobs=alts,
+            )
+
+        def take_lp():
+            if not pending_lp:
+                return None
+            from dynamo_tpu.protocols.openai import ChoiceLogprobs
+
+            out = ChoiceLogprobs(content=list(pending_lp))
+            pending_lp.clear()
+            return out
+
         stop_ids = set(preprocessed.stop_token_ids)
         async for event in engine_stream:
-            for tok in event.get("token_ids", ()):
+            for i, tok in enumerate(event.get("token_ids", ())):
                 completion_tokens += 1
                 if tok in stop_ids and not preprocessed.ignore_eos:
                     finish = "stop"
                     break  # never render the stop/eos token itself
+                e = lp_entry(tok, i, event)
+                if e is not None:
+                    pending_lp.append(e)
                 delta = decode.step(tok)
                 text = stop.feed(delta)
                 if text:
                     if first:
-                        yield chunk(role="assistant", content=text)
+                        yield chunk(
+                            role="assistant", content=text, logprobs=take_lp()
+                        )
                         first = False
                     else:
-                        yield chunk(content=text)
+                        yield chunk(content=text, logprobs=take_lp())
                 if stop.stopped:
                     finish = "stop"
                     break
@@ -285,7 +386,10 @@ class OpenAIPreprocessor:
         if not stop.stopped:
             tail = stop.flush()
             if tail:
-                yield chunk(content=tail, role="assistant" if first else None)
+                yield chunk(
+                    content=tail, role="assistant" if first else None,
+                    logprobs=take_lp(),
+                )
                 first = False
         final = chunk(finish_reason=finish or "stop")
         if include_usage:

@@ -25,6 +25,12 @@ class Tokenizer(Protocol):
 
     def apply_chat_template(self, messages: list[dict]) -> str: ...
 
+    def token_bytes(self, tok: int) -> bytes:
+        """The exact bytes one token contributes to the output stream —
+        the OpenAI logprobs `bytes` field. Unlike decode([tok]), partial
+        UTF-8 sequences come back verbatim, not as replacement chars."""
+        ...
+
 
 _FALLBACK_TEMPLATE_SUFFIX = "assistant:"
 
@@ -66,6 +72,9 @@ class ByteTokenizer:
     def decode(self, ids: Sequence[int]) -> str:
         return bytes(i for i in ids if 0 <= i < 256).decode("utf-8", errors="replace")
 
+    def token_bytes(self, tok: int) -> bytes:
+        return bytes([tok]) if 0 <= tok < 256 else b""
+
     def apply_chat_template(self, messages: list[dict]) -> str:
         return render_fallback_template(messages)
 
@@ -87,6 +96,26 @@ class HfTokenizer:
 
     def decode(self, ids: Sequence[int]) -> str:
         return self._tok.decode(list(ids), skip_special_tokens=True)
+
+    def token_bytes(self, tok: int) -> bytes:
+        piece = self._tok.convert_ids_to_tokens(int(tok))
+        if piece is None:
+            return b""
+        # sentencepiece byte token <0xNN>
+        if len(piece) == 6 and piece.startswith("<0x") and piece.endswith(">"):
+            try:
+                return bytes([int(piece[3:5], 16)])
+            except ValueError:
+                pass
+        # sentencepiece word-boundary marker
+        if "▁" in piece:
+            return piece.replace("▁", " ").encode()
+        # byte-level BPE alphabet (GPT-2/llama3 style)
+        u2b = _gpt2_unicode_to_byte()
+        try:
+            return bytes(u2b[c] for c in piece)
+        except KeyError:
+            return piece.encode()
 
     def apply_chat_template(self, messages: list[dict]) -> str:
         try:
@@ -113,6 +142,16 @@ def _gpt2_byte_table() -> dict[int, str]:
             cs.append(256 + n)
             n += 1
     return {b: chr(c) for b, c in zip(bs, cs)}
+
+
+_U2B_CACHE: Optional[dict[str, int]] = None
+
+
+def _gpt2_unicode_to_byte() -> dict[str, int]:
+    global _U2B_CACHE
+    if _U2B_CACHE is None:
+        _U2B_CACHE = {u: b for b, u in _gpt2_byte_table().items()}
+    return _U2B_CACHE
 
 
 class GgufTokenizer:
@@ -183,6 +222,17 @@ class GgufTokenizer:
             return ids or [self._unk]
 
         return self._greedy(spm, bytes_or_unk)
+
+    def token_bytes(self, tok: int) -> bytes:
+        if not 0 <= tok < len(self._tokens):
+            return b""
+        if self.kind == "gpt2":
+            return bytes(
+                self._u2b.get(c, ord(" ") & 0xFF) for c in self._tokens[tok]
+            )
+        if tok in self._byte_ids:
+            return bytes([self._byte_ids[tok]])
+        return self._tokens[tok].replace("▁", " ").encode()
 
     def decode(self, ids: Sequence[int]) -> str:
         if self.kind == "gpt2":

@@ -52,6 +52,7 @@ class ChatCompletionRequest(BaseModel):
     frequency_penalty: Optional[float] = None
     presence_penalty: Optional[float] = None
     logprobs: Optional[bool] = None
+    top_logprobs: Optional[int] = None  # 0-20 alternatives when logprobs=true
     ext: Optional[Ext] = None
     nvext: Optional[Ext] = None  # accepted alias for drop-in compatibility
 
@@ -77,6 +78,9 @@ class CompletionRequest(BaseModel):
     stop: Union[str, list[str], None] = None
     seed: Optional[int] = None
     echo: Optional[bool] = False
+    logprobs: Optional[int] = None  # legacy: N => chosen + top-N per token
+    frequency_penalty: Optional[float] = None
+    presence_penalty: Optional[float] = None
     ext: Optional[Ext] = None
     nvext: Optional[Ext] = None
 
@@ -111,6 +115,34 @@ class EmbeddingResponse(BaseModel):
     usage: Usage = Field(default_factory=Usage)
 
 
+class TopLogprob(BaseModel):
+    token: str = ""
+    logprob: float = 0.0
+    bytes: Optional[list[int]] = None
+
+
+class TokenLogprob(BaseModel):
+    token: str = ""
+    logprob: float = 0.0
+    bytes: Optional[list[int]] = None
+    top_logprobs: list[TopLogprob] = Field(default_factory=list)
+
+
+class ChoiceLogprobs(BaseModel):
+    """Chat-API logprobs block: one entry per emitted token."""
+
+    content: list[TokenLogprob] = Field(default_factory=list)
+
+
+class CompletionLogprobs(BaseModel):
+    """Legacy completions-API logprobs block (parallel arrays)."""
+
+    tokens: list[str] = Field(default_factory=list)
+    token_logprobs: list[float] = Field(default_factory=list)
+    top_logprobs: list[dict[str, float]] = Field(default_factory=list)
+    text_offset: list[int] = Field(default_factory=list)
+
+
 class ChatChoiceDelta(BaseModel):
     role: Optional[str] = None
     content: Optional[str] = None
@@ -119,6 +151,7 @@ class ChatChoiceDelta(BaseModel):
 class ChatStreamChoice(BaseModel):
     index: int = 0
     delta: ChatChoiceDelta = Field(default_factory=ChatChoiceDelta)
+    logprobs: Optional[ChoiceLogprobs] = None
     finish_reason: Optional[str] = None
 
 
@@ -134,6 +167,7 @@ class ChatCompletionChunk(BaseModel):
 class ChatChoice(BaseModel):
     index: int = 0
     message: ChatMessage = Field(default_factory=lambda: ChatMessage(role="assistant", content=""))
+    logprobs: Optional[ChoiceLogprobs] = None
     finish_reason: Optional[str] = None
 
 
@@ -149,6 +183,7 @@ class ChatCompletionResponse(BaseModel):
 class CompletionChoice(BaseModel):
     index: int = 0
     text: str = ""
+    logprobs: Optional[CompletionLogprobs] = None
     finish_reason: Optional[str] = None
 
 
@@ -266,27 +301,51 @@ SSE_DONE = b"data: [DONE]\n\n"
 def aggregate_chat_stream(
     chunks: list[ChatCompletionChunk], model: str, request_id: str
 ) -> ChatCompletionResponse:
-    """Fold a chunk stream into a non-streaming response."""
-    text = []
-    finish = None
-    usage = None
+    """Fold a chunk stream into a non-streaming response. Chunks may
+    interleave multiple choice indices (`n` > 1); each folds into its own
+    choice, and usage sums completion tokens across choices (prompt
+    counted once)."""
+    text: dict[int, list[str]] = {}
+    finish: dict[int, Optional[str]] = {}
+    lp_entries: dict[int, list[TokenLogprob]] = {}
+    usages: list[Usage] = []
     for ch in chunks:
         for choice in ch.choices:
+            i = choice.index
             if choice.delta.content:
-                text.append(choice.delta.content)
+                text.setdefault(i, []).append(choice.delta.content)
+            if choice.logprobs is not None:
+                lp_entries.setdefault(i, []).extend(choice.logprobs.content)
             if choice.finish_reason:
-                finish = choice.finish_reason
+                finish[i] = choice.finish_reason
         if ch.usage is not None:
-            usage = ch.usage
+            usages.append(ch.usage)
+    usage = None
+    if usages:
+        usage = Usage(
+            prompt_tokens=usages[0].prompt_tokens,
+            completion_tokens=sum(u.completion_tokens for u in usages),
+        )
+        usage.total_tokens = usage.prompt_tokens + usage.completion_tokens
+    indices = sorted(set(text) | set(finish) | set(lp_entries)) or [0]
     return ChatCompletionResponse(
         id=request_id,
         created=now(),
         model=model,
         choices=[
             ChatChoice(
-                message=ChatMessage(role="assistant", content="".join(text)),
-                finish_reason=finish,
+                index=i,
+                message=ChatMessage(
+                    role="assistant", content="".join(text.get(i, []))
+                ),
+                logprobs=(
+                    ChoiceLogprobs(content=lp_entries[i])
+                    if i in lp_entries
+                    else None
+                ),
+                finish_reason=finish.get(i),
             )
+            for i in indices
         ],
         usage=usage,
     )

@@ -247,6 +247,11 @@ class Worker:
         # image embeddings.
         if pre.mm_embeds is not None:
             return False
+        # Logprob requests prefill locally: the transfer result carries the
+        # first sampled token but not its logprob, and OpenAI logprob
+        # arrays must align with the emitted tokens from the first one.
+        if pre.logprobs >= 0:
+            return False
         # Cheap local short-circuit: uncached length can't exceed prompt
         # length, so short prompts never qualify — skip the engine-thread
         # and fabric round-trips entirely.
