@@ -40,6 +40,9 @@ def _engine_config(args, eos_token_ids: tuple = ()) -> EngineConfig:
         tp=args.tp,
         sp=getattr(args, "sp", 1),
         eos_token_ids=tuple(eos_token_ids) or (0,),
+        host_kv_cache_bytes=getattr(args, "host_kv_bytes", 0),
+        disk_kv_cache_bytes=getattr(args, "disk_kv_bytes", 0),
+        disk_kv_cache_dir=getattr(args, "disk_kv_dir", None),
     )
 
 
@@ -506,6 +509,19 @@ def main(argv: Optional[list[str]] = None) -> None:
     runp.add_argument("--endpoint", default="generate")
     runp.add_argument("--num-pages", type=int, default=512, dest="num_pages")
     runp.add_argument("--page-size", type=int, default=64, dest="page_size")
+    runp.add_argument(
+        "--host-kv-bytes", type=int, default=0, dest="host_kv_bytes",
+        help="KVBM G2: host-DRAM KV tier byte budget (0 = off); evicted "
+             "device pages offload here and onboard on prefix hit",
+    )
+    runp.add_argument(
+        "--disk-kv-bytes", type=int, default=0, dest="disk_kv_bytes",
+        help="KVBM G3: disk KV tier byte budget (0 = off)",
+    )
+    runp.add_argument(
+        "--disk-kv-dir", default=None, dest="disk_kv_dir",
+        help="directory for the disk KV tier (required with --disk-kv-bytes)",
+    )
     runp.add_argument("--max-context", type=int, default=4096, dest="max_context")
     runp.add_argument("--prefill-chunk", type=int, default=512, dest="prefill_chunk")
     runp.add_argument("--max-seqs", type=int, default=32, dest="max_seqs")

@@ -107,6 +107,7 @@ class JaxEngine:
                 config.num_pages,
                 config.page_size,
                 extract_fn=self.extract_pages,
+                extract_async_fn=self.extract_pages_async,
                 inject_fn=self.inject_pages,
                 host_bytes=config.host_kv_cache_bytes,
                 disk_bytes=config.disk_kv_cache_bytes,
@@ -318,12 +319,21 @@ class JaxEngine:
                 reqs = [p.request for p in pieces]
                 samp, all_greedy = self._sampling_arrays(reqs, pad_to=b_bucket)
                 lp = self._batch_logprobs(reqs)
+                # Penalties at prefill-sample time only matter when a
+                # penalized request already HAS generated history — i.e. a
+                # preempted request resuming via recompute.
+                pen = self._batch_penalty_bucket(reqs)
+                if pen and not any(self._penalty_history(r) for r in reqs):
+                    pen = 0
+                pen_args = (
+                    self._penalty_arrays(reqs, b_bucket, pen) if pen else ()
+                )
                 fn = self._get_step_fn(
                     "prefill", b_bucket, t_bucket, greedy=all_greedy,
-                    mm=any_mm, first_chunk=first_chunk, lp=lp,
+                    mm=any_mm, first_chunk=first_chunk, lp=lp, pen=pen,
                 )
                 # mm ride as keywords: the positional tail of the shared
-                # step_fn signature belongs to the decode-only penalty args.
+                # step_fn signature belongs to the penalty args.
                 mm_kwargs = (
                     {"mm_embeds": mm_args[0], "mm_mask": mm_args[1]}
                     if any_mm
@@ -331,12 +341,14 @@ class JaxEngine:
                 )
                 if lp >= 0:
                     token_ids, lp_raw, self.kv = fn(
-                        *args, self._dev(last_idx), *samp, **mm_kwargs
+                        *args, self._dev(last_idx), *samp, *pen_args,
+                        **mm_kwargs
                     )
                     lp_data = tuple(np.asarray(x) for x in lp_raw)
                 else:
                     token_ids, self.kv = fn(
-                        *args, self._dev(last_idx), *samp, **mm_kwargs
+                        *args, self._dev(last_idx), *samp, *pen_args,
+                        **mm_kwargs
                     )
                 ids = np.asarray(token_ids)
             else:
@@ -530,17 +542,27 @@ class JaxEngine:
         return lp
 
     @staticmethod
-    def _batch_penalty_bucket(reqs: list[Request]) -> int:
+    def _penalty_history(req: Request) -> list[int]:
+        """Every token this request has GENERATED — the history the OpenAI
+        penalties run over. Preemption-by-recompute folds generated tokens
+        into prompt_tokens (scheduler._preempt_youngest); num_emitted counts
+        them, so the folded tail stays part of the history."""
+        hist = req.output_tokens
+        if req.num_emitted:
+            hist = req.prompt_tokens[-req.num_emitted :] + hist
+        return hist
+
+    def _batch_penalty_bucket(self, reqs: list[Request]) -> int:
         """0 when no request carries a frequency/presence penalty; else the
-        output-history bucket O (power of two) the penalty programs index.
-        The bucket, not the batch, keys the program variant — the family
-        grows log2(max_tokens) deep."""
+        generated-history bucket O (power of two) the penalty programs
+        index. The bucket, not the batch, keys the program variant — the
+        family grows log2(max_tokens) deep."""
         if not any(
             r.sampling.frequency_penalty or r.sampling.presence_penalty
             for r in reqs
         ):
             return 0
-        longest = max(len(r.output_tokens) for r in reqs)
+        longest = max(len(self._penalty_history(r)) for r in reqs)
         o = 1
         while o < max(1, longest):
             o *= 2
@@ -548,7 +570,7 @@ class JaxEngine:
 
     def _penalty_arrays(self, reqs: list[Request], pad_to: int, o_bucket: int):
         """(freq [B], pres [B], out_tokens [B, O], out_valid [B, O]) — the
-        output-token history the penalties are computed over."""
+        generated-token history the penalties are computed over."""
         freq = np.zeros(pad_to, np.float32)
         pres = np.zeros(pad_to, np.float32)
         out_toks = np.zeros((pad_to, o_bucket), np.int32)
@@ -556,9 +578,10 @@ class JaxEngine:
         for i, r in enumerate(reqs):
             freq[i] = r.sampling.frequency_penalty
             pres[i] = r.sampling.presence_penalty
-            n = min(len(r.output_tokens), o_bucket)
+            hist = self._penalty_history(r)
+            n = min(len(hist), o_bucket)
             if n:
-                out_toks[i, :n] = r.output_tokens[-n:]
+                out_toks[i, :n] = hist[-n:]
                 out_valid[i, :n] = True
         return (
             self._dev(freq), self._dev(pres),
@@ -897,13 +920,28 @@ class JaxEngine:
         (k, v) as [L, Hkv, n, page_size, D] — layout- and padding-agnostic
         so disagg peers and KVBM tiers interoperate across engine configs.
         (Device cache is [L, P, S, Hkv, Dpad].)"""
+        k, v = self.extract_pages_async(page_ids)
+        return np.asarray(k), np.asarray(v)
+
+    def extract_pages_async(self, page_ids: Sequence[int]):
+        """Async variant: the page gather + canonical transpose run on
+        device and the device→host copy is started without blocking; the
+        returned jax arrays materialize on first np.asarray. The gather is
+        enqueued on the device stream BEFORE any later dispatch can
+        overwrite the pages, so content is captured even though the pool
+        may hand the page ids out immediately (KVBM's double-buffered
+        offload rides this — the reference overlaps offload DMA the same
+        way, block_manager/offload.rs)."""
         ids = jnp.asarray(np.asarray(page_ids, np.int32))
         d = self._canonical_head_dim
-        k = np.asarray(jax.device_get(jnp.take(self.kv.k, ids, axis=1)))
-        v = np.asarray(jax.device_get(jnp.take(self.kv.v, ids, axis=1)))
-        # [L, n, S, Hkv, Dp] -> [L, Hkv, n, S, D]
-        k = k.transpose(0, 3, 1, 2, 4)[..., :d]
-        v = v.transpose(0, 3, 1, 2, 4)[..., :d]
+        # [L, n, S, Hkv, Dp] -> [L, Hkv, n, S, D] on device
+        k = jnp.take(self.kv.k, ids, axis=1).transpose(0, 3, 1, 2, 4)[..., :d]
+        v = jnp.take(self.kv.v, ids, axis=1).transpose(0, 3, 1, 2, 4)[..., :d]
+        try:
+            k.copy_to_host_async()
+            v.copy_to_host_async()
+        except AttributeError:
+            pass  # older jax array types; np.asarray will sync-copy
         return k, v
 
     def inject_pages(self, page_ids: Sequence[int], k: np.ndarray, v: np.ndarray) -> None:
@@ -997,6 +1035,9 @@ class JaxEngine:
             )
 
     def _refresh_metrics(self) -> None:
+        # Complete async KVBM offloads started last step (double buffer:
+        # the device→host copies overlapped this step's compute).
+        self.allocator.flush_offloads()
         m = self.metrics
         m.num_waiting = self.scheduler.num_waiting()
         m.num_running = self.scheduler.num_running()

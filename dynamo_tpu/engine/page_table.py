@@ -302,6 +302,11 @@ class PageAllocator:
         if self._on_event is not None:
             self._on_event(event)
 
+    def flush_offloads(self) -> int:
+        """Tiered subclass hook: complete in-flight async offloads. The
+        base pool has none."""
+        return 0
+
     def clear_cache(self) -> int:
         """Drop all reclaimable cached pages (frontend /clear_kv_blocks)."""
         if self._np is not None:

@@ -49,6 +49,49 @@ from dynamo_tpu.runtime.context import Context
 logger = logging.getLogger(__name__)
 
 
+def _legacy_completion_chunk(chunk, text_offsets: dict[int, int]) -> dict:
+    """/v1/completions streams `text_completion` objects, not chat chunks:
+    choices carry `text` and the legacy parallel-array logprobs shape.
+    text_offsets accumulates emitted text length per choice so logprob
+    offsets stay absolute across the stream."""
+    choices = []
+    for c in chunk.choices:
+        text = c.delta.content or ""
+        choice: dict = {
+            "index": c.index,
+            "text": text,
+            "finish_reason": c.finish_reason,
+        }
+        if c.logprobs is not None:
+            entries = c.logprobs.content
+            offsets = []
+            pos = text_offsets.get(c.index, 0)
+            for e in entries:
+                offsets.append(pos)
+                pos += len(e.token)
+            choice["logprobs"] = {
+                "tokens": [e.token for e in entries],
+                "token_logprobs": [e.logprob for e in entries],
+                "top_logprobs": [
+                    {t.token: t.logprob for t in e.top_logprobs}
+                    for e in entries
+                ],
+                "text_offset": offsets,
+            }
+        text_offsets[c.index] = text_offsets.get(c.index, 0) + len(text)
+        choices.append(choice)
+    out = {
+        "id": chunk.id,
+        "object": "text_completion",
+        "created": chunk.created,
+        "model": chunk.model,
+        "choices": choices,
+    }
+    if chunk.usage is not None:
+        out["usage"] = chunk.usage.model_dump()
+    return out
+
+
 class HttpService:
     def __init__(
         self,
@@ -420,6 +463,7 @@ class HttpService:
         itl: list[float] = []
         ntokens = 0
         status = "200"
+        text_offsets: dict[int, int] = {}  # per-choice, for legacy logprobs
         try:
             async for chunk in chunk_stream:
                 t = time.time()
@@ -430,7 +474,12 @@ class HttpService:
                     elif last_t is not None:
                         itl.append(t - last_t)
                     last_t = t
-                await resp.write(sse_event(chunk))
+                payload = (
+                    chunk
+                    if kind == "chat"
+                    else _legacy_completion_chunk(chunk, text_offsets)
+                )
+                await resp.write(sse_event(payload))
             await resp.write(SSE_DONE)
         except (ConnectionResetError, asyncio.CancelledError):
             # client went away: cancel into the engine (disconnect monitor)

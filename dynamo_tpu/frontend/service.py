@@ -30,6 +30,7 @@ from dynamo_tpu.protocols.openai import (
     ResponsesRequest,
     StreamOptions,
     Usage,
+    combine_usages,
 )
 from dynamo_tpu.runtime.context import Context
 from dynamo_tpu.runtime.push_router import PushRouter, RouterMode
@@ -151,14 +152,8 @@ class ModelPipeline:
                 if isinstance(item, Exception):
                     raise item
                 yield item
-            if usages:
-                combined = Usage(
-                    prompt_tokens=usages[0].prompt_tokens,
-                    completion_tokens=sum(u.completion_tokens for u in usages),
-                )
-                combined.total_tokens = (
-                    combined.prompt_tokens + combined.completion_tokens
-                )
+            combined = combine_usages(usages)
+            if combined is not None:
                 yield ChatCompletionChunk(
                     id=pre.request_id,
                     model=self.card.name,

@@ -16,9 +16,14 @@ Accounting: `match_length` stays device-only on purpose — onboarded blocks
 consume fresh device pages, so the scheduler's admission math (pages needed
 = total - device-cached) remains exact whether or not onboarding succeeds.
 
-Offload/onboard transfers are synchronous device<->host copies for now
-(device_get/device_put on the page axis); async double-buffered offload
-streams are a planned optimization.
+Offload is **double-buffered**: eviction enqueues the page gather + the
+device→host copy on the device stream (extract_async_fn) and returns
+immediately; the bytes land in the host tier when the transfer is drained —
+at the next engine step (flush_offloads), when the staging buffer fills, or
+on demand when a prefix hit needs a still-in-flight block. Ordering makes
+this safe: the gather is enqueued before any subsequent dispatch can
+overwrite the evicted page (the reference overlaps its offload DMA the same
+way — block_manager/offload.rs).
 """
 
 from __future__ import annotations
@@ -38,6 +43,10 @@ ExtractFn = Callable[[Sequence[int]], tuple[np.ndarray, np.ndarray]]
 #: (page_ids, k, v) -> None, same shapes
 InjectFn = Callable[[Sequence[int], np.ndarray, np.ndarray], None]
 
+#: staged async-offload blocks before a forced drain (bounds the HBM the
+#: staging gathers hold)
+MAX_PENDING_OFFLOADS = 64
+
 
 class TieredPageAllocator(PageAllocator):
     def __init__(
@@ -50,9 +59,11 @@ class TieredPageAllocator(PageAllocator):
         disk_bytes: int = 0,
         disk_dir: Optional[str] = None,
         on_event=None,
+        extract_async_fn: Optional[ExtractFn] = None,
     ):
         super().__init__(num_pages, page_size, on_event=on_event)
         self._extract_fn = extract_fn
+        self._extract_async_fn = extract_async_fn
         self._inject_fn = inject_fn
         if disk_bytes > 0 and not disk_dir:
             raise ValueError(
@@ -66,35 +77,77 @@ class TieredPageAllocator(PageAllocator):
             HostTier(host_bytes, demote=demote) if host_bytes > 0 else None
         )
         self._offload_enabled = self.host is not None or self.disk is not None
+        #: seq_hash -> (parent_hash, tokens, k_dev, v_dev, column) — gathers
+        #: in flight to host; k_dev/v_dev are shared per extract batch
+        self._pending: dict[int, tuple] = {}
 
     # -- offload (device eviction hook) ------------------------------------
 
     def _offload_pages(self, pages: Sequence[int]) -> None:
-        """Extract `pages` in one batched device read and store them down
-        the tier hierarchy. Pages must still be registered."""
+        """Stage `pages` for offload in one batched device gather. With an
+        async extractor the call returns before the copy lands; otherwise
+        the bytes go straight down the tier hierarchy."""
         todo = []
         for page in pages:
             seq_hash, parent_hash, tokens = self._page_meta[page]
-            in_lower = (self.host is not None and seq_hash in self.host) or (
-                self.disk is not None and seq_hash in self.disk
+            in_lower = (
+                seq_hash in self._pending
+                or (self.host is not None and seq_hash in self.host)
+                or (self.disk is not None and seq_hash in self.disk)
             )
             if not in_lower:
                 todo.append((page, seq_hash, parent_hash, tokens))
         if not todo:
             return
-        k, v = self._extract_fn([p for p, _, _, _ in todo])
+        fn = self._extract_async_fn or self._extract_fn
+        k, v = fn([p for p, _, _, _ in todo])
         for i, (_, seq_hash, parent_hash, tokens) in enumerate(todo):
-            entry = BlockEntry(
-                seq_hash=seq_hash, parent_hash=parent_hash, tokens=tokens,
-                k=np.ascontiguousarray(k[:, :, i]),
-                v=np.ascontiguousarray(v[:, :, i]),
-            )
-            if self.host is not None:
-                ok = self.host.put(entry)
-            else:
-                ok = self.disk.put(entry)
-            if ok:
-                self.stats.offloaded_blocks += 1
+            self._pending[seq_hash] = (parent_hash, tokens, k, v, i)
+        if self._extract_async_fn is None or (
+            len(self._pending) >= MAX_PENDING_OFFLOADS
+        ):
+            self.flush_offloads()
+
+    def _store_entry(self, entry: BlockEntry) -> None:
+        if self.host is not None:
+            ok = self.host.put(entry)
+        else:
+            ok = self.disk.put(entry)
+        if ok:
+            self.stats.offloaded_blocks += 1
+
+    def _complete(self, seq_hash: int) -> Optional[BlockEntry]:
+        """Materialize one staged offload (np.asarray blocks only until the
+        already-started device→host copy finishes)."""
+        staged = self._pending.pop(seq_hash, None)
+        if staged is None:
+            return None
+        parent_hash, tokens, k, v, i = staged
+        k_host, v_host = np.asarray(k), np.asarray(v)
+        if k_host is not k:
+            # One extract batch backs many pending blocks: swap the
+            # materialized host copies into the siblings so the device
+            # transfer happens exactly once per batch.
+            for h, t in list(self._pending.items()):
+                if t[2] is k:
+                    self._pending[h] = (t[0], t[1], k_host, v_host, t[4])
+        return BlockEntry(
+            seq_hash=seq_hash, parent_hash=parent_hash, tokens=tokens,
+            k=np.ascontiguousarray(k_host[:, :, i]),
+            v=np.ascontiguousarray(v_host[:, :, i]),
+        )
+
+    def flush_offloads(self) -> int:
+        """Drain every staged offload into the tier hierarchy. The engine
+        calls this once per step — transfers started at step N complete
+        while step N+1 computes (the double buffer)."""
+        n = 0
+        for seq_hash in list(self._pending):
+            entry = self._complete(seq_hash)
+            if entry is not None:
+                self._store_entry(entry)
+                n += 1
+        return n
 
     def allocate(self, n: int) -> Optional[list[int]]:
         """Pre-offload the eviction victims in ONE batched device read
@@ -114,6 +167,12 @@ class TieredPageAllocator(PageAllocator):
     # -- onboard (prefix-hit continuation) ---------------------------------
 
     def _tier_get(self, seq_hash: int) -> Optional[BlockEntry]:
+        # A block may still be in flight to the host tier: complete it on
+        # demand (and keep it stored — the prefix may be hit again).
+        staged = self._complete(seq_hash)
+        if staged is not None:
+            self._store_entry(staged)
+            return staged
         if self.host is not None:
             e = self.host.get(seq_hash)
             if e is not None:
@@ -161,12 +220,15 @@ class TieredPageAllocator(PageAllocator):
     # -- cache clearing ----------------------------------------------------
 
     def clear_cache(self) -> int:
-        """/clear_kv_blocks semantics: drop cached content in ALL tiers."""
+        """/clear_kv_blocks semantics: drop cached content in ALL tiers,
+        including offloads still in flight."""
         prev, self._offload_enabled = self._offload_enabled, False
         try:
             n = super().clear_cache()
         finally:
             self._offload_enabled = prev
+        n += len(self._pending)
+        self._pending.clear()
         if self.host is not None:
             n += len(self.host)
             self.host.clear()

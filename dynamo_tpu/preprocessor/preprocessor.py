@@ -109,7 +109,7 @@ def _chat_logprobs(request) -> int:
             f"top_logprobs must be between 0 and 20; got {n}"
         )
     if not request.logprobs:
-        if n:
+        if n is not None:
             raise ValueError(
                 "top_logprobs requires logprobs to be true"
             )
@@ -391,7 +391,11 @@ class OpenAIPreprocessor:
                     logprobs=take_lp(),
                 )
                 first = False
-        final = chunk(finish_reason=finish or "stop")
+        # Any logprob entries still pending (tokens whose text never
+        # rendered — partial UTF-8 at stream end, or buffered by a stop
+        # string) ride the final chunk; dropping them would desync the
+        # entry list from the sampled tokens.
+        final = chunk(finish_reason=finish or "stop", logprobs=take_lp())
         if include_usage:
             final.usage = Usage(
                 prompt_tokens=len(preprocessed.token_ids),

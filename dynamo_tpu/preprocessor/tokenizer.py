@@ -227,9 +227,14 @@ class GgufTokenizer:
         if not 0 <= tok < len(self._tokens):
             return b""
         if self.kind == "gpt2":
-            return bytes(
-                self._u2b.get(c, ord(" ") & 0xFF) for c in self._tokens[tok]
-            )
+            piece = self._tokens[tok]
+            try:
+                return bytes(self._u2b[c] for c in piece)
+            except KeyError:
+                # special/added token outside the byte alphabet: its piece
+                # string IS its surface form — keep the bytes exact rather
+                # than substituting
+                return piece.encode()
         if tok in self._byte_ids:
             return bytes([self._byte_ids[tok]])
         return self._tokens[tok].replace("▁", " ").encode()

@@ -101,6 +101,19 @@ class Usage(BaseModel):
     total_tokens: int = 0
 
 
+def combine_usages(usages: list["Usage"]) -> Optional["Usage"]:
+    """Fold per-choice usage blocks (`n` > 1) into one: the shared prompt
+    counts once, completion tokens sum."""
+    if not usages:
+        return None
+    u = Usage(
+        prompt_tokens=usages[0].prompt_tokens,
+        completion_tokens=sum(x.completion_tokens for x in usages),
+    )
+    u.total_tokens = u.prompt_tokens + u.completion_tokens
+    return u
+
+
 class EmbeddingData(BaseModel):
     object: str = "embedding"
     index: int = 0
@@ -320,13 +333,7 @@ def aggregate_chat_stream(
                 finish[i] = choice.finish_reason
         if ch.usage is not None:
             usages.append(ch.usage)
-    usage = None
-    if usages:
-        usage = Usage(
-            prompt_tokens=usages[0].prompt_tokens,
-            completion_tokens=sum(u.completion_tokens for u in usages),
-        )
-        usage.total_tokens = usage.prompt_tokens + usage.completion_tokens
+    usage = combine_usages(usages)
     indices = sorted(set(text) | set(finish) | set(lp_entries)) or [0]
     return ChatCompletionResponse(
         id=request_id,

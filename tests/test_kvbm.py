@@ -186,3 +186,54 @@ def test_onboard_skipped_under_pool_pressure(tmp_path):
     alloc.free(pinned)
     # And once pressure is gone the same lookup onboards fine via a real run.
     assert _run(eng, "a2", prompt_a) == expected
+
+
+def test_async_offload_staging_and_inflight_lookup():
+    """Eviction stages the extract without landing it (double buffer);
+    a prefix hit on a still-in-flight block completes it on demand, and
+    flush_offloads drains the rest."""
+    import numpy as np
+
+    shape = (1, 1, 4, 8)  # [L, Hkv, S, D] per page
+    store: dict[int, np.ndarray] = {}
+
+    calls = {"extract": 0}
+
+    def extract_async(page_ids):
+        calls["extract"] += 1
+        k = np.stack([store[p] for p in page_ids], axis=2)  # [L,Hkv,n,S,D]
+        return k, k.copy()
+
+    injected = []
+
+    def inject(page_ids, k, v):
+        injected.append((list(page_ids), k.copy()))
+
+    alloc = TieredPageAllocator(
+        5, 4, extract_fn=extract_async, inject_fn=inject,
+        extract_async_fn=extract_async, host_bytes=1 << 20,
+    )
+    pages = alloc.allocate(4)
+    for j, p in enumerate(pages):
+        store[p] = np.full((1, 1, 4, 8), float(j), np.float32)
+        alloc.register(p, seq_hash=100 + j, parent_hash=None, tokens=(j,))
+    alloc.free(pages)
+
+    # Evict two pages (pool pressure): the offload is STAGED, not landed.
+    alloc.allocate(2)
+    assert sorted(alloc._pending) == [100, 101]
+    assert len(alloc.host) == 0
+
+    # Prefix-hit the in-flight blocks: completed on demand + onboarded
+    # into fresh pages (which themselves evict + stage 102/103).
+    got = alloc.lookup([100, 101])
+    assert len(got) == 2 and injected
+    assert alloc.stats.onboarded_blocks == 2
+    # the onboarded bytes are the evicted pages' content
+    np.testing.assert_array_equal(injected[0][1][:, :, 0], store[pages[0]])
+    assert sorted(alloc._pending) == [102, 103]
+
+    # flush completes the remaining transfers into the host tier.
+    n = alloc.flush_offloads()
+    assert n == 2 and 102 in alloc.host and 103 in alloc.host
+    assert alloc.stats.offloaded_blocks >= 2

@@ -443,3 +443,126 @@ def test_logprobs_validation_rejected():
             await svc.stop()
 
     asyncio.run(main())
+
+
+def test_logprob_entries_survive_unrendered_text():
+    """Tokens whose text never renders (partial UTF-8 at stream end) must
+    still deliver their logprob entries — on the final chunk."""
+    from dynamo_tpu.preprocessor import OpenAIPreprocessor, load_tokenizer
+    from dynamo_tpu.preprocessor.preprocessor import PreprocessedRequest
+
+    pre = PreprocessedRequest(
+        request_id="r", token_ids=[1, 2], max_tokens=2, logprobs=0
+    )
+
+    async def engine_stream():
+        # 0xF0: lone UTF-8 lead byte — DecodeStream buffers it forever
+        yield {"token_ids": [0xF0, 0xF0], "logprobs": [-1.0, -2.0],
+               "finish_reason": "length"}
+
+    async def main():
+        proc = OpenAIPreprocessor(load_tokenizer({"kind": "byte"}))
+        chunks = [
+            c
+            async for c in proc.postprocess_chat_stream(
+                engine_stream(), "r", pre
+            )
+        ]
+        entries = [
+            e
+            for c in chunks
+            if c.choices and c.choices[0].logprobs
+            for e in c.choices[0].logprobs.content
+        ]
+        assert [e.logprob for e in entries] == [-1.0, -2.0]
+        assert entries[0].bytes == [0xF0]
+
+    asyncio.run(main())
+
+
+def test_streaming_completions_legacy_shape():
+    """/v1/completions streaming must emit text_completion objects with
+    choices[].text and the legacy parallel-array logprobs shape."""
+    import aiohttp
+
+    from dynamo_tpu.engine.async_engine import AsyncEngineRunner
+    from dynamo_tpu.frontend import HttpService, ModelManager
+    from dynamo_tpu.frontend.service import local_pipeline
+    from dynamo_tpu.model_card import ModelDeploymentCard
+
+    async def main():
+        engine = JaxEngine(EngineConfig.for_tests())
+        runner = AsyncEngineRunner(engine)
+        runner.start()
+        card = ModelDeploymentCard(
+            name="tiny", tokenizer={"kind": "byte"}, context_length=32
+        )
+        manager = ModelManager()
+        manager.add("tiny", local_pipeline(card, runner))
+        svc = HttpService(manager, host="127.0.0.1", port=0)
+        await svc.start()
+        base = f"http://127.0.0.1:{svc.port}"
+        try:
+            async with aiohttp.ClientSession() as s:
+                async with s.post(
+                    f"{base}/v1/completions",
+                    json={
+                        "model": "tiny", "prompt": "abc", "max_tokens": 3,
+                        "stream": True, "logprobs": 1,
+                    },
+                ) as r:
+                    body = (await r.read()).decode()
+            objs = [
+                json.loads(line[6:])
+                for line in body.splitlines()
+                if line.startswith("data: {")
+            ]
+            assert objs, body
+            assert all(o["object"] == "text_completion" for o in objs)
+            lp_chunks = [
+                c["logprobs"]
+                for o in objs
+                for c in o["choices"]
+                if c.get("logprobs")
+            ]
+            assert lp_chunks, "no logprobs in stream"
+            total_tokens = sum(len(lp["tokens"]) for lp in lp_chunks)
+            assert total_tokens == 3
+            for lp in lp_chunks:
+                assert set(lp) == {"tokens", "token_logprobs",
+                                   "top_logprobs", "text_offset"}
+                assert all(len(d) == 1 for d in lp["top_logprobs"])
+            # no chat-shaped fields leak through
+            assert '"delta"' not in body
+        finally:
+            await svc.stop()
+            runner.stop()
+
+    asyncio.run(main())
+
+
+def test_penalty_history_survives_preemption():
+    """Preemption folds generated tokens into the prompt; the penalty
+    history must keep counting them after resume."""
+    base = EngineConfig.for_tests()
+    cfg = EngineConfig(**{**base.__dict__, "decode_steps": 1})
+    eng = JaxEngine(cfg)
+    eng.add_request(
+        "pp", [5, 6, 7],
+        SamplingParams(temperature=0.0, max_tokens=10,
+                       frequency_penalty=500.0),
+    )
+    # run a few steps, then preempt by hand (the scheduler's recompute path)
+    for _ in range(4):
+        eng.step()
+    req = next(r for r in eng.scheduler.running if r.request_id == "pp")
+    ngen = len(req.output_tokens)
+    assert ngen >= 1
+    eng.scheduler._preempt_youngest(excluding=None)
+    assert req.num_emitted == ngen and req.output_tokens == []
+    toks = eng.run_to_completion()["pp"]
+    # all tokens ever generated are distinct: the penalty saw the whole
+    # history across the preemption boundary
+    hist = req.prompt_tokens[3:] + toks if req.num_emitted else toks
+    all_gen = hist
+    assert len(set(all_gen)) == len(all_gen), all_gen
