@@ -43,6 +43,7 @@ def _engine_config(args, eos_token_ids: tuple = ()) -> EngineConfig:
         host_kv_cache_bytes=getattr(args, "host_kv_bytes", 0),
         disk_kv_cache_bytes=getattr(args, "disk_kv_bytes", 0),
         disk_kv_cache_dir=getattr(args, "disk_kv_dir", None),
+        spec_ngram=getattr(args, "spec_ngram", 0),
     )
 
 
@@ -521,6 +522,11 @@ def main(argv: Optional[list[str]] = None) -> None:
     runp.add_argument(
         "--disk-kv-dir", default=None, dest="disk_kv_dir",
         help="directory for the disk KV tier (required with --disk-kv-bytes)",
+    )
+    runp.add_argument(
+        "--spec-ngram", type=int, default=0, dest="spec_ngram",
+        help="speculative decoding: draft tokens per step proposed by "
+             "prompt lookup and verified in one forward pass (0 = off)",
     )
     runp.add_argument("--max-context", type=int, default=4096, dest="max_context")
     runp.add_argument("--prefill-chunk", type=int, default=512, dest="prefill_chunk")

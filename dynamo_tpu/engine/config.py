@@ -40,6 +40,22 @@ class EngineConfig:
     #: conditions are applied on the host afterwards — up to K-1 speculative
     #: tokens past a stop are computed and dropped. 1 = classic stepping.
     decode_steps: int = 8
+    #: speculative decoding by prompt lookup (draft-free n-gram
+    #: speculation): propose this many draft tokens per decode step from
+    #: the last occurrence of the sequence's trailing n-gram, verify all
+    #: of them in ONE forward pass, accept the longest matching prefix
+    #: plus the model's own token at the first mismatch. 0 = off. Greedy
+    #: requests only; mixed batches with sampling/logprob/penalty
+    #: requests fall back to the normal decode path for that step.
+    spec_ngram: int = 0
+    #: trailing n-gram length the lookup matches on
+    spec_ngram_match: int = 2
+    #: adaptive fallback: when a spec step's draft acceptance rate drops
+    #: below this, decode reverts to the fused multi-step path for
+    #: spec_cooldown_steps before probing speculation again (lookup-miss
+    #: workloads must not pay s+1-wide verifies per single token)
+    spec_min_accept_rate: float = 0.2
+    spec_cooldown_steps: int = 16
     #: admission watermark: keep this fraction of pages free when admitting
     admission_watermark: float = 0.02
     #: eos token ids (from the model card/tokenizer)

@@ -60,6 +60,10 @@ class EngineMetrics:
     generated_tokens: int = 0
     #: monotonically increasing arrivals (planner derives request_rate)
     requests_received: int = 0
+    #: speculative decoding (prompt lookup) — parity with the reference's
+    #: SpecDecodeStats (kv_router/protocols.rs:96)
+    spec_drafted: int = 0
+    spec_accepted: int = 0
 
     def to_dict(self) -> dict:
         return dict(self.__dict__)
@@ -122,6 +126,9 @@ class JaxEngine:
         self.metrics = EngineMetrics(kv_total_pages=config.num_pages - 1)
         self._outputs_emitted: set[str] = set()
         self._jit_cache: dict[tuple, Callable] = {}
+        #: adaptive speculation: steps left on the fused path after a
+        #: low-acceptance spec dispatch
+        self._spec_cooldown = 0
 
         if params is None:
             checkpoint_path = checkpoint_path or self.adapter.default_checkpoint
@@ -428,25 +435,160 @@ class JaxEngine:
         k = p
         if k <= 1:
             return 1
+        if not self._grow_pages_for(reqs, k - 1):
+            return 1  # single-step path handles pressure via preemption
+        return k
+
+    def _grow_pages_for(self, reqs: list[Request], ahead: int) -> bool:
+        """Grow every request's page table to cover num_tokens + ahead,
+        with an aggregate need-vs-free pre-check so pool pressure never
+        half-grows the batch. False => nothing was allocated."""
         ps = self.config.page_size
         need = 0
         per_req = []
         for req in reqs:
-            extra = -(-(req.num_tokens + k - 1) // ps) - len(req.pages)
+            extra = -(-(req.num_tokens + ahead) // ps) - len(req.pages)
             per_req.append(max(0, extra))
             need += max(0, extra)
         if need > self.allocator.num_free:
-            return 1  # single-step path handles pressure via preemption
+            return False
         for req, extra in zip(reqs, per_req):
             if extra:
                 got = self.allocator.allocate(extra)
                 if got is None:
-                    return 1
+                    return False  # unreachable given the pre-check
                 req.pages.extend(got)
-        return k
+        return True
+
+    # -- speculative decode (prompt lookup / n-gram) ------------------------
+
+    def _spec_eligible(self, reqs: list[Request]) -> bool:
+        """Draft-free speculation serves all-greedy batches with no
+        logprob/penalty reporting (those paths need per-position state the
+        verify program doesn't thread)."""
+        if self.config.spec_ngram <= 0:
+            return False
+        for r in reqs:
+            s = r.sampling
+            if (
+                s.temperature > 0.0
+                or s.logprobs >= 0
+                or s.frequency_penalty
+                or s.presence_penalty
+            ):
+                return False
+        return True
+
+    def _propose_drafts(self, req: Request, s: int) -> list[int]:
+        """Prompt-lookup proposal: the s tokens that followed the LAST
+        earlier occurrence of the sequence's trailing n-gram. No match =>
+        zero-pads (they simply fail verification; one token still lands).
+
+        The n-gram index is maintained incrementally on the request —
+        each position is indexed exactly once over the request's lifetime
+        (amortized O(1) per decode step instead of an O(L) rescan)."""
+        n = self.config.spec_ngram_match
+        if req.num_tokens <= n:
+            return [0] * s
+        if req.spec_index is None:
+            req.spec_index = {}
+            req.spec_ctx = req.all_tokens  # one full copy, then appended
+            req.spec_indexed_upto = 0
+        elif len(req.spec_ctx) < req.num_tokens:
+            delta = req.num_tokens - len(req.spec_ctx)
+            req.spec_ctx.extend(req.output_tokens[-delta:])
+        ctx = req.spec_ctx
+        # index every n-gram start except the trailing one (a tail must
+        # match an EARLIER occurrence)
+        for j in range(req.spec_indexed_upto, len(ctx) - n):
+            req.spec_index[tuple(ctx[j : j + n])] = j
+        req.spec_indexed_upto = max(req.spec_indexed_upto, len(ctx) - n)
+        j = req.spec_index.get(tuple(ctx[-n:]))
+        if j is None:
+            return [0] * s
+        cont = ctx[j + n : j + n + s]
+        return cont + [0] * (s - len(cont))
+
+    def _run_decode_spec(self, reqs: list[Request]) -> list[StepOutput]:
+        """One verify dispatch: [last_token, draft_0..draft_{S-1}] runs
+        through the model like a prefill chunk (causal over the window,
+        paged KV behind it); target tokens are the argmax at every
+        position. Accept matched drafts + the model's token at the first
+        mismatch — per request, 1..S+1 tokens per step. Stale KV written
+        for rejected positions is overwritten when the real tokens reach
+        those positions; attention never reads past a sequence's length."""
+        s = self.config.spec_ngram
+        b_bucket = self.config.decode_bucket_for(len(reqs))
+        mp = self.config.max_pages_per_seq
+        t = s + 1
+        cap_tokens = mp * self.config.page_size
+        # Pre-grow pages to cover the verify window; pressure => no spec
+        # (the aggregate pre-check in _grow_pages_for means a refusal
+        # claims nothing).
+        for req in reqs:
+            if req.num_tokens + s > min(cap_tokens, self.config.max_context):
+                return self._run_decode_plain(reqs)
+        if not self._grow_pages_for(reqs, s):
+            return self._run_decode_plain(reqs)
+
+        tokens = np.zeros((b_bucket, t), np.int32)
+        positions = np.zeros((b_bucket, t), np.int32)
+        valid = np.zeros((b_bucket, t), bool)
+        pt = np.zeros((b_bucket, mp), np.int32)
+        drafts = np.zeros((b_bucket, s), np.int32)
+        for i, req in enumerate(reqs):
+            d = self._propose_drafts(req, s)
+            drafts[i] = d
+            tokens[i, 0] = req.all_tokens[-1]
+            tokens[i, 1:] = d
+            positions[i] = np.arange(t, dtype=np.int32) + req.num_tokens - 1
+            valid[i] = True
+            pt[i, : len(req.pages)] = req.pages
+
+        fn = self._get_step_fn("spec_verify", b_bucket, t)
+        target_ids, self.kv = fn(
+            self.params, self._dev(tokens), self._dev(positions),
+            self._dev(valid), self.kv, self._dev(pt),
+        )
+        target = np.asarray(target_ids)  # [B, t]
+        outputs: list[StepOutput] = []
+        step_drafted = step_accepted = 0
+        for i, req in enumerate(reqs):
+            accepted: list[int] = []
+            finish: Optional[FinishReason] = None
+            for j in range(t):
+                tok = int(target[i, j])
+                accepted.append(tok)
+                finish = self._finish_reason_for(req, tok, len(accepted))
+                if finish is not None:
+                    break
+                if j < s and int(drafts[i, j]) != tok:
+                    break  # draft diverged: the model's token still lands
+            step_drafted += s
+            step_accepted += len(accepted) - 1
+            req.num_computed_tokens += len(accepted)
+            outputs.extend(self._accept_tokens(req, accepted, finish))
+            self._register_pages(req)
+        self.metrics.spec_drafted += step_drafted
+        self.metrics.spec_accepted += step_accepted
+        if (
+            step_drafted
+            and step_accepted / step_drafted < self.config.spec_min_accept_rate
+        ):
+            # Lookup is missing on this workload: revert to fused multi-
+            # step decode for a while, then probe speculation again.
+            self._spec_cooldown = self.config.spec_cooldown_steps
+        return outputs
 
     def _run_decode(self, batch: ScheduledBatch) -> list[StepOutput]:
         reqs = list(batch.decode)
+        if self._spec_eligible(reqs):
+            if self._spec_cooldown <= 0:
+                return self._run_decode_spec(reqs)
+            self._spec_cooldown -= 1
+        return self._run_decode_plain(reqs)
+
+    def _run_decode_plain(self, reqs: list[Request]) -> list[StepOutput]:
         b_bucket = self.config.decode_bucket_for(len(reqs))
         mp = self.config.max_pages_per_seq
         k_steps = self._pick_decode_steps(reqs)
@@ -728,6 +870,24 @@ class JaxEngine:
                 "compiled decode_multi program B=%d K=%d greedy=%s",
                 b, k_steps, greedy,
             )
+            return jitted
+
+        if kind == "spec_verify":
+
+            def verify_fn(params, tokens, positions, valid, kv, pt):
+                hidden, kv = adapter.forward_hidden(
+                    params, tokens, positions, valid, kv, pt
+                )
+                bsz, tlen, h = hidden.shape
+                logits = adapter.compute_logits(
+                    params, hidden.reshape(bsz * tlen, h)
+                )
+                ids = jnp.argmax(logits, axis=-1).reshape(bsz, tlen)
+                return ids.astype(jnp.int32), kv
+
+            jitted = jax.jit(verify_fn, donate_argnums=(4,))
+            self._jit_cache[cache_key] = jitted
+            logger.info("compiled %s program B=%d T=%d", kind, b, t)
             return jitted
 
         if kind == "prefill_nosample":

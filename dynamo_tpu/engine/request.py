@@ -73,6 +73,13 @@ class Request:
     #: disaggregated serving: keep pages allocated after finish so a prefill
     #: worker can extract their KV for transfer (released via release_held)
     hold_pages: bool = False
+    #: speculative decoding (engine-managed): incremental n-gram -> last
+    #: start position index over the token sequence, plus a persistent
+    #: copy of that sequence (all_tokens rebuilds a list per call) and the
+    #: next unindexed n-gram start
+    spec_index: Optional[dict] = None
+    spec_ctx: Optional[list] = None
+    spec_indexed_upto: int = 0
 
     @property
     def num_tokens(self) -> int:

@@ -17,7 +17,7 @@ from __future__ import annotations
 import asyncio
 import ctypes
 import struct
-from typing import Any, Optional
+from typing import Any
 
 import msgpack
 import xxhash

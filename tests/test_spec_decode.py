@@ -1,0 +1,131 @@
+"""Speculative decoding by prompt lookup (engine/engine.py
+_run_decode_spec): draft-free n-gram speculation verified in one forward
+pass. The invariant that matters: spec-on output is EXACTLY the greedy
+output — speculation changes the dispatch count, never the tokens.
+
+(The reference surfaces SpecDecodeStats from its engines —
+kv_router/protocols.rs:96; here the engine implements speculation itself.)
+"""
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.engine import EngineConfig
+from dynamo_tpu.engine.engine import JaxEngine
+from dynamo_tpu.engine.request import SamplingParams
+
+
+def _make(spec=0, **over):
+    base = EngineConfig.for_tests()
+    cfg = EngineConfig(**{**base.__dict__, "spec_ngram": spec, **over})
+    return JaxEngine(cfg)
+
+
+def _gen(eng, prompts, max_tokens=12):
+    for i, p in enumerate(prompts):
+        eng.add_request(f"r{i}", p, SamplingParams(temperature=0.0,
+                                                   max_tokens=max_tokens))
+    return eng.run_to_completion()
+
+
+PROMPTS = [
+    # strong repetition: lookup should hit
+    [1, 2, 3, 4, 1, 2, 3, 4, 1, 2],
+    # no repetition
+    [9, 8, 7, 6, 5],
+    # short
+    [3, 3],
+]
+
+
+def test_spec_matches_plain_greedy_exactly():
+    plain = _gen(_make(spec=0), PROMPTS)
+    spec = _gen(_make(spec=4), PROMPTS)
+    assert spec == plain, (spec, plain)
+
+
+def test_spec_reports_stats_and_accepts_on_repetition():
+    eng = _make(spec=4)
+    # a prompt whose continuation the tiny model repeats is not guaranteed;
+    # drive stats by checking the counters advance at all
+    _gen(eng, PROMPTS)
+    assert eng.metrics.spec_drafted > 0
+    assert 0 <= eng.metrics.spec_accepted <= eng.metrics.spec_drafted
+
+
+def test_spec_disabled_for_sampling_and_logprobs():
+    eng = _make(spec=4)
+    eng.add_request(
+        "s", [1, 2, 3], SamplingParams(temperature=0.7, max_tokens=4, seed=1)
+    )
+    assert not eng._spec_eligible(
+        [r for r in eng.scheduler.waiting]
+    )
+    eng.run_to_completion()
+    assert eng.metrics.spec_drafted == 0
+
+    eng2 = _make(spec=4)
+    eng2.add_request(
+        "l", [1, 2, 3],
+        SamplingParams(temperature=0.0, max_tokens=4, logprobs=0),
+    )
+    eng2.run_to_completion()
+    assert eng2.metrics.spec_drafted == 0
+
+
+def test_spec_with_prefix_cache_and_chunked_prefill():
+    base = EngineConfig.for_tests()
+    over = {
+        "spec_ngram": 3,
+        "enable_prefix_caching": True,
+        "prefill_chunk": 8,
+    }
+    cfg = EngineConfig(**{**base.__dict__, **over})
+    eng = JaxEngine(cfg)
+    long_prompt = list(range(1, 12)) + list(range(1, 12))
+    out1 = _gen(eng, [long_prompt], max_tokens=8)["r0"]
+    # same prompt again: prefix-cached admission, spec decode continues
+    eng.add_request("again", long_prompt,
+                    SamplingParams(temperature=0.0, max_tokens=8))
+    out2 = eng.run_to_completion()["again"]
+    assert out2 == out1
+
+
+def test_propose_drafts_lookup():
+    eng = _make(spec=3)
+    eng.add_request("x", [5, 6, 7, 8, 5, 6], SamplingParams(max_tokens=4))
+    req = eng.scheduler.waiting[0]
+    # trailing 2-gram (5, 6) occurred at position 0; continuation 7, 8, 5
+    assert eng._propose_drafts(req, 3) == [7, 8, 5]
+    # no match: zero-padded
+    eng.add_request("y", [1, 2, 3, 4], SamplingParams(max_tokens=4))
+    req2 = eng.scheduler.waiting[1]
+    assert eng._propose_drafts(req2, 3) == [0, 0, 0]
+
+
+def test_spec_stops_at_eos_and_max_tokens():
+    plain = _make(spec=0)
+    spec = _make(spec=4)
+    p = [2, 4, 6, 8, 2, 4, 6, 8]
+    plain.add_request("a", p, SamplingParams(temperature=0.0, max_tokens=3))
+    spec.add_request("a", p, SamplingParams(temperature=0.0, max_tokens=3))
+    o1 = plain.run_to_completion()["a"]
+    o2 = spec.run_to_completion()["a"]
+    assert o1 == o2 and len(o2) == 3
+
+
+def test_spec_cooldown_on_lookup_miss():
+    """Repeated lookup misses must push decode back to the fused path
+    (cooldown), then probe speculation again."""
+    eng = _make(spec=4, spec_cooldown_steps=3)
+    # non-repetitive prompt: proposals are zero-pads, acceptance ~0
+    eng.add_request("m", [11, 7, 23, 5, 17],
+                    SamplingParams(temperature=0.0, max_tokens=12))
+    eng.step()  # prefill
+    eng.step()  # spec attempt -> low acceptance -> cooldown set
+    assert eng._spec_cooldown == 3 or eng.metrics.spec_accepted > 0
+    drafted_after_first = eng.metrics.spec_drafted
+    if eng._spec_cooldown == 3:
+        # next cooldown steps run the fused path: drafted doesn't grow
+        eng.step()
+        assert eng.metrics.spec_drafted == drafted_after_first
