@@ -490,6 +490,13 @@ class JaxEngine:
         n = self.config.spec_ngram_match
         if req.num_tokens <= n:
             return [0] * s
+        if req.spec_index is not None and (
+            req.num_tokens - len(req.spec_ctx) > len(req.output_tokens)
+        ):
+            # Preemption folded outputs into the prompt while spec state
+            # was stale — the delta can no longer be read off
+            # output_tokens. Rebuild rather than desync the index.
+            req.spec_index = None
         if req.spec_index is None:
             req.spec_index = {}
             req.spec_ctx = req.all_tokens  # one full copy, then appended

@@ -56,6 +56,12 @@ class LlamaConfig:
     attention_impl: str = "xla"
     #: q/k/v projection bias — the Qwen2 family's one architectural delta
     attention_bias: bool = False
+    #: MLP activation: "silu" (Llama/Qwen GLU) or "gelu_tanh" (Gemma GeGLU)
+    hidden_act: str = "silu"
+    #: Gemma-style RMSNorm: scale by (1 + weight) instead of weight
+    rms_norm_unit_offset: bool = False
+    #: Gemma scales token embeddings by sqrt(hidden_size)
+    scale_embeddings: bool = False
 
     @property
     def q_per_kv(self) -> int:
@@ -126,6 +132,28 @@ class LlamaConfig:
         )
 
     @staticmethod
+    def gemma_2b() -> "LlamaConfig":
+        """Gemma-2B: GeGLU MLP, (1+w) RMSNorm, sqrt(H)-scaled embeddings,
+        tied lm_head, MQA (1 kv head), head_dim 256."""
+        return LlamaConfig(
+            vocab_size=256000, hidden_size=2048, intermediate_size=16384,
+            num_layers=18, num_heads=8, num_kv_heads=1, head_dim=256,
+            rope_theta=10000.0, rms_norm_eps=1e-6, tie_word_embeddings=True,
+            hidden_act="gelu_tanh", rms_norm_unit_offset=True,
+            scale_embeddings=True,
+        )
+
+    @staticmethod
+    def gemma_7b() -> "LlamaConfig":
+        return LlamaConfig(
+            vocab_size=256000, hidden_size=3072, intermediate_size=24576,
+            num_layers=28, num_heads=16, num_kv_heads=16, head_dim=256,
+            rope_theta=10000.0, rms_norm_eps=1e-6, tie_word_embeddings=True,
+            hidden_act="gelu_tanh", rms_norm_unit_offset=True,
+            scale_embeddings=True,
+        )
+
+    @staticmethod
     def from_hf_config(hf: dict) -> "LlamaConfig":
         """Map a HuggingFace `config.json` dict onto LlamaConfig (covers the
         Llama and Qwen2 families — Qwen2 is Llama + qkv bias)."""
@@ -135,10 +163,24 @@ class LlamaConfig:
         if rope_scaling.get("rope_type", rope_scaling.get("type")) == "llama3":
             factor = float(rope_scaling["factor"])
         head_dim = hf.get("head_dim") or hf["hidden_size"] // hf["num_attention_heads"]
+        gemma = hf.get("model_type") == "gemma" or arch == "GemmaForCausalLM"
+        hidden_act = hf.get("hidden_activation") or hf.get("hidden_act", "silu")
+        if hidden_act in ("gelu_pytorch_tanh", "gelu_tanh", "gelu"):
+            hidden_act = "gelu_tanh"
+        elif hidden_act == "silu":
+            hidden_act = "silu"
+        else:
+            # refuse rather than run a numerically wrong model
+            raise ValueError(
+                f"unsupported hidden_act {hidden_act!r} in HF config"
+            )
         return LlamaConfig(
             attention_bias=bool(
                 hf.get("attention_bias", arch == "Qwen2ForCausalLM")
             ),
+            hidden_act=hidden_act,
+            rms_norm_unit_offset=gemma,
+            scale_embeddings=gemma,
             vocab_size=hf["vocab_size"],
             hidden_size=hf["hidden_size"],
             intermediate_size=hf["intermediate_size"],
@@ -148,7 +190,7 @@ class LlamaConfig:
             head_dim=head_dim,
             rope_theta=float(hf.get("rope_theta", 10000.0)),
             rms_norm_eps=float(hf.get("rms_norm_eps", 1e-5)),
-            tie_word_embeddings=bool(hf.get("tie_word_embeddings", False)),
+            tie_word_embeddings=bool(hf.get("tie_word_embeddings", gemma)),
             rope_scaling_factor=factor,
             rope_low_freq_factor=float(rope_scaling.get("low_freq_factor", 1.0)),
             rope_high_freq_factor=float(rope_scaling.get("high_freq_factor", 4.0)),
@@ -357,11 +399,16 @@ def params_from_gguf(gguf_file, cfg: LlamaConfig) -> dict:
 # ---------------------------------------------------------------------------
 
 
-def rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
+def rms_norm(
+    x: jax.Array, weight: jax.Array, eps: float, unit_offset: bool = False
+) -> jax.Array:
     xf = x.astype(jnp.float32)
     var = jnp.mean(xf * xf, axis=-1, keepdims=True)
     out = xf * lax.rsqrt(var + eps)
-    return (out * weight.astype(jnp.float32)).astype(x.dtype)
+    w = weight.astype(jnp.float32)
+    if unit_offset:  # Gemma stores norm weights as deltas around 1
+        w = w + 1.0
+    return (out * w).astype(x.dtype)
 
 
 def _rope_inv_freq(cfg: LlamaConfig) -> jax.Array:
@@ -660,11 +707,20 @@ def forward_hidden(
     h = params["embed"][tokens].astype(cfg.dtype)  # [B,T,H]
     if mm_embeds is not None:
         h = jnp.where(mm_mask[..., None], mm_embeds.astype(cfg.dtype), h)
+    if cfg.scale_embeddings:  # Gemma: normalizer cast to the model dtype
+        h = h * jnp.asarray(math.sqrt(cfg.hidden_size), cfg.dtype)
+    off = cfg.rms_norm_unit_offset
+    if cfg.hidden_act == "silu":
+        act = jax.nn.silu
+    elif cfg.hidden_act == "gelu_tanh":
+        act = partial(jax.nn.gelu, approximate=True)
+    else:
+        raise ValueError(f"unknown hidden_act {cfg.hidden_act!r}")
 
     def layer(carry, xs):
         h, k_full, v_full = carry
         lp, li = xs
-        x = rms_norm(h, lp["attn_norm"], cfg.rms_norm_eps)
+        x = rms_norm(h, lp["attn_norm"], cfg.rms_norm_eps, off)
         b, t, _ = x.shape
         q, k, v = x @ lp["wq"], x @ lp["wk"], x @ lp["wv"]
         if cfg.attention_bias:
@@ -677,8 +733,8 @@ def forward_hidden(
             first_chunk=first_chunk, mesh=mesh,
         )
         h = h + attn @ lp["wo"]
-        x = rms_norm(h, lp["mlp_norm"], cfg.rms_norm_eps)
-        gate = jax.nn.silu((x @ lp["w_gate"]).astype(jnp.float32))
+        x = rms_norm(h, lp["mlp_norm"], cfg.rms_norm_eps, off)
+        gate = act((x @ lp["w_gate"]).astype(jnp.float32))
         up = (x @ lp["w_up"]).astype(jnp.float32)
         h = h + ((gate * up).astype(cfg.dtype) @ lp["w_down"])
         return (h, k_full, v_full), staged
@@ -691,7 +747,7 @@ def forward_hidden(
     k_new, v_new = land_staged_kv(
         k_new, v_new, staged, page_tables, positions, valid, mesh=mesh
     )
-    h = rms_norm(h, params["final_norm"], cfg.rms_norm_eps)
+    h = rms_norm(h, params["final_norm"], cfg.rms_norm_eps, off)
     return h, KVPages(k=k_new, v=v_new)
 
 

@@ -49,6 +49,9 @@ _LLAMA_PRESETS: dict[str, Callable[[], LlamaConfig]] = {
     # Qwen2 family = Llama + qkv bias (models/llama.py attention_bias).
     "qwen2-7b": LlamaConfig.qwen2_7b,
     "qwen2-0.5b": LlamaConfig.qwen2_05b,
+    # Gemma family = GeGLU + (1+w) RMSNorm + scaled embeddings + tied head.
+    "gemma-2b": LlamaConfig.gemma_2b,
+    "gemma-7b": LlamaConfig.gemma_7b,
 }
 
 
@@ -175,7 +178,16 @@ def get_model(
         arch = (hf.get("architectures") or ["LlamaForCausalLM"])[0]
         if "mixtral" in arch.lower():
             moe_cfg = MoeConfig.from_hf_config(hf)
-        elif "llama" in arch.lower() or "qwen2" in arch.lower():
+        elif (
+            "llama" in arch.lower()
+            or "qwen2" in arch.lower()
+            # Only first-gen Gemma: Gemma 2/3 add softcapping, sliding-
+            # window attention and pre/post norms, and RecurrentGemma is a
+            # different architecture entirely — refuse those rather than
+            # run a silently-wrong model.
+            or arch == "GemmaForCausalLM"
+            or hf.get("model_type") == "gemma"
+        ):
             cfg = LlamaConfig.from_hf_config(hf)
         else:
             raise ValueError(f"unsupported architecture {arch} for {name}")

@@ -1,0 +1,139 @@
+"""Gemma family (GeGLU + (1+w) RMSNorm + scaled embeddings + tied head)
+vs HuggingFace GemmaForCausalLM."""
+
+from dataclasses import replace
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dynamo_tpu.models.llama import (
+    LlamaConfig,
+    forward,
+    init_kv_pages,
+    init_params,
+    params_from_torch_state_dict,
+)
+
+PAGE_SIZE = 4
+
+
+def _tiny_gemma_cfg():
+    return replace(
+        LlamaConfig.tiny(),
+        num_kv_heads=1,  # Gemma-2B-style MQA
+        rms_norm_eps=1e-6,
+        tie_word_embeddings=True,
+        hidden_act="gelu_tanh",
+        rms_norm_unit_offset=True,
+        scale_embeddings=True,
+    )
+
+
+def _run_paged(cfg, params, toks):
+    b, t = toks.shape
+    kv = init_kv_pages(cfg, 64, PAGE_SIZE)
+    n_pages = -(-t // PAGE_SIZE)
+    pts = np.zeros((b, n_pages), np.int32)
+    for i in range(b):
+        pts[i] = np.arange(1 + i * n_pages, 1 + (i + 1) * n_pages)
+    positions = np.tile(np.arange(t, dtype=np.int32), (b, 1))
+    logits, _ = forward(
+        params, cfg, jnp.asarray(toks), jnp.asarray(positions),
+        jnp.ones((b, t), bool), kv, jnp.asarray(pts),
+    )
+    return np.asarray(logits)
+
+
+def test_against_hf_gemma():
+    torch = pytest.importorskip("torch")
+    from transformers import GemmaConfig, GemmaForCausalLM
+
+    cfg = _tiny_gemma_cfg()
+    hf_cfg = GemmaConfig(
+        vocab_size=cfg.vocab_size,
+        hidden_size=cfg.hidden_size,
+        intermediate_size=cfg.intermediate_size,
+        num_hidden_layers=cfg.num_layers,
+        num_attention_heads=cfg.num_heads,
+        num_key_value_heads=cfg.num_kv_heads,
+        head_dim=cfg.head_dim,
+        rope_theta=cfg.rope_theta,
+        rms_norm_eps=cfg.rms_norm_eps,
+        hidden_activation="gelu_pytorch_tanh",
+        tie_word_embeddings=True,
+        attention_bias=False,
+    )
+    torch.manual_seed(11)
+    model = GemmaForCausalLM(hf_cfg).eval()
+    params = params_from_torch_state_dict(model.state_dict(), cfg)
+    assert "lm_head" not in params  # tied
+
+    rng = np.random.default_rng(7)
+    toks = rng.integers(0, cfg.vocab_size, size=(2, 9)).astype(np.int32)
+    with torch.no_grad():
+        ref = model(torch.from_numpy(toks.astype(np.int64))).logits.numpy()
+    ours = _run_paged(cfg, params, toks)
+    np.testing.assert_allclose(ours, ref, rtol=5e-2, atol=5e-2)
+    assert (ours.argmax(-1) == ref.argmax(-1)).mean() > 0.95
+
+
+def test_gemma_features_change_output():
+    """Each Gemma delta must actually flow through the forward pass."""
+    cfg = _tiny_gemma_cfg()
+    params = init_params(jax.random.key(0), cfg)
+    rng = np.random.default_rng(1)
+    toks = rng.integers(0, cfg.vocab_size, size=(1, 8)).astype(np.int32)
+    base = _run_paged(cfg, params, toks)
+    for flip in (
+        {"hidden_act": "silu"},
+        {"rms_norm_unit_offset": False},
+        {"scale_embeddings": False},
+    ):
+        other = _run_paged(replace(cfg, **flip), params, toks)
+        assert not np.allclose(base, other), flip
+
+
+def test_gemma_preset_serves_through_engine():
+    from dynamo_tpu.engine import EngineConfig
+    from dynamo_tpu.engine.engine import JaxEngine
+    from dynamo_tpu.engine.request import SamplingParams
+    from dynamo_tpu.models.registry import get_model
+
+    adapter = get_model("gemma-2b", dtype="float32")
+    assert adapter.config.hidden_act == "gelu_tanh"
+    assert adapter.config.rms_norm_unit_offset
+
+    # tiny gemma-style engine run end to end (register a throwaway preset)
+    from dynamo_tpu.models import registry
+
+    registry._LLAMA_PRESETS["gemma-tiny"] = _tiny_gemma_cfg
+    try:
+        base = EngineConfig.for_tests()
+        cfg = EngineConfig(**{**base.__dict__, "model": "gemma-tiny"})
+        eng = JaxEngine(cfg)
+        eng.add_request("g", [5, 6, 7, 8],
+                        SamplingParams(temperature=0.0, max_tokens=4))
+        out = eng.run_to_completion()["g"]
+        assert len(out) == 4
+    finally:
+        registry._LLAMA_PRESETS.pop("gemma-tiny", None)
+
+
+def test_gemma2_hf_config_rejected(tmp_path):
+    import json
+
+    from dynamo_tpu.models.registry import get_model
+
+    d = tmp_path / "g2"
+    d.mkdir()
+    (d / "config.json").write_text(json.dumps({
+        "architectures": ["Gemma2ForCausalLM"],
+        "model_type": "gemma2",
+        "vocab_size": 256, "hidden_size": 64, "intermediate_size": 128,
+        "num_hidden_layers": 2, "num_attention_heads": 4,
+    }))
+    with pytest.raises(ValueError, match="unsupported architecture"):
+        get_model(str(d))

@@ -122,10 +122,16 @@ def test_spec_cooldown_on_lookup_miss():
     eng.add_request("m", [11, 7, 23, 5, 17],
                     SamplingParams(temperature=0.0, max_tokens=12))
     eng.step()  # prefill
-    eng.step()  # spec attempt -> low acceptance -> cooldown set
-    assert eng._spec_cooldown == 3 or eng.metrics.spec_accepted > 0
+    eng.step()  # spec attempt
+    # The contract, independent of what the random model sampled: a step
+    # under the acceptance threshold sets the cooldown; at/above it no
+    # cooldown engages.
+    rate = eng.metrics.spec_accepted / max(1, eng.metrics.spec_drafted)
+    below = rate < eng.config.spec_min_accept_rate
+    assert (eng._spec_cooldown == 3) == below, (rate, eng._spec_cooldown)
     drafted_after_first = eng.metrics.spec_drafted
-    if eng._spec_cooldown == 3:
-        # next cooldown steps run the fused path: drafted doesn't grow
+    if below:
+        # next cooldown step runs the fused path: drafted doesn't grow
         eng.step()
         assert eng.metrics.spec_drafted == drafted_after_first
+        assert eng._spec_cooldown == 2
