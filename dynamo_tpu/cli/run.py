@@ -44,6 +44,7 @@ def _engine_config(args, eos_token_ids: tuple = ()) -> EngineConfig:
         disk_kv_cache_bytes=getattr(args, "disk_kv_bytes", 0),
         disk_kv_cache_dir=getattr(args, "disk_kv_dir", None),
         spec_ngram=getattr(args, "spec_ngram", 0),
+        quantize=getattr(args, "quantize", None),
     )
 
 
@@ -527,6 +528,10 @@ def main(argv: Optional[list[str]] = None) -> None:
         "--spec-ngram", type=int, default=0, dest="spec_ngram",
         help="speculative decoding: draft tokens per step proposed by "
              "prompt lookup and verified in one forward pass (0 = off)",
+    )
+    runp.add_argument(
+        "--quantize", default=None, choices=["int8"],
+        help="weight-only quantization (per-output-channel int8 scales)",
     )
     runp.add_argument("--max-context", type=int, default=4096, dest="max_context")
     runp.add_argument("--prefill-chunk", type=int, default=512, dest="prefill_chunk")

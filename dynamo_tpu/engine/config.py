@@ -62,6 +62,9 @@ class EngineConfig:
     eos_token_ids: tuple[int, ...] = ()
     #: dtype name for params/KV ("bfloat16" | "float32")
     dtype: str = "bfloat16"
+    #: weight-only quantization: None | "int8" (per-output-channel scales;
+    #: halves the HBM weight traffic decode is bound by)
+    quantize: Optional[str] = None
     #: decode attention: "auto" (pallas on TPU single-chip, else xla),
     #: "xla", or "pallas"
     attention_impl: str = "auto"

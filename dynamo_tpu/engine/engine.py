@@ -137,11 +137,25 @@ class JaxEngine:
             else:
                 logger.info("initializing random params for %s", config.model)
                 params = self.adapter.init_params(jax.random.key(0))
+        if config.quantize:
+            if config.quantize != "int8":
+                raise ValueError(
+                    f"unsupported quantize={config.quantize!r}; use int8"
+                )
+            dense_names = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+            layers = (params.get("layers") or {}) if isinstance(params, dict) else {}
+            if not all(n in layers for n in dense_names):
+                raise ValueError(
+                    "--quantize int8 supports the Llama-family models "
+                    "(llama3/qwen2/gemma)"
+                )
+            from dynamo_tpu.models.llama import quantize_params_int8
+
+            params = quantize_params_int8(params)
         kv = self.adapter.init_kv(config.num_pages, config.page_size)
         if self.mesh is not None:
-            params = jax.device_put(
-                params, shardings_for(self.mesh, self.adapter.param_specs())
-            )
+            specs = self.adapter.param_specs(quantized=bool(config.quantize))
+            params = jax.device_put(params, shardings_for(self.mesh, specs))
             kv = jax.device_put(kv, shardings_for(self.mesh, self.adapter.kv_spec()))
         self.params = params
         self.kv = kv

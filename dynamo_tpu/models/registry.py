@@ -82,7 +82,9 @@ def _llama_adapter(
         init_kv=lambda num_pages, page_size: llama_mod.init_kv_pages(
             cfg, num_pages, page_size
         ),
-        param_specs=lambda: llama_param_specs(cfg),
+        param_specs=lambda quantized=False: llama_param_specs(
+            cfg, quantized=quantized
+        ),
         kv_spec=lambda: KVPages(k=kv_cache_spec(), v=kv_cache_spec()),
         load_params=lambda path: _load_llama_checkpoint(path, cfg),
     )
@@ -135,7 +137,8 @@ def _moe_adapter(name: str, moe_cfg, mesh=None) -> ModelAdapter:
         init_kv=lambda num_pages, page_size: llama_mod.init_kv_pages(
             cfg.base, num_pages, page_size
         ),
-        param_specs=lambda: moe_mod.moe_param_specs(cfg),
+        # same signature as the llama adapter; MoE has no quantized layout
+        param_specs=lambda quantized=False: moe_mod.moe_param_specs(cfg),
         kv_spec=lambda: KVPages(k=kv_cache_spec(), v=kv_cache_spec()),
         load_params=load,
     )

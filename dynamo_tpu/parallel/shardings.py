@@ -25,7 +25,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from dynamo_tpu.models.llama import LlamaConfig
 
 
-def llama_param_specs(cfg: LlamaConfig) -> dict:
+def llama_param_specs(cfg: LlamaConfig, quantized: bool = False) -> dict:
     specs = {
         "embed": P(None, "tp"),
         "layers": {
@@ -46,6 +46,13 @@ def llama_param_specs(cfg: LlamaConfig) -> dict:
         specs["layers"]["bq"] = P(None, "tp")
         specs["layers"]["bk"] = P(None, "tp")
         specs["layers"]["bv"] = P(None, "tp")
+    if quantized:
+        # int8 per-output-channel scales [L, 1, out] shard with their
+        # weight's output dim (w_down's output is the unsharded hidden)
+        for name in ("wq", "wk", "wv", "w_gate", "w_up"):
+            specs["layers"][name + "_scale"] = P(None, None, "tp")
+        specs["layers"]["wo_scale"] = P(None, None, None)
+        specs["layers"]["w_down_scale"] = P(None, None, None)
     if not cfg.tie_word_embeddings:
         specs["lm_head"] = P(None, "tp")
     return specs
