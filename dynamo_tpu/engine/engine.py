@@ -142,16 +142,12 @@ class JaxEngine:
                 raise ValueError(
                     f"unsupported quantize={config.quantize!r}; use int8"
                 )
-            dense_names = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
-            layers = (params.get("layers") or {}) if isinstance(params, dict) else {}
-            if not all(n in layers for n in dense_names):
+            if self.adapter.quantize_params is None:
                 raise ValueError(
-                    "--quantize int8 supports the Llama-family models "
-                    "(llama3/qwen2/gemma)"
+                    f"--quantize int8: the {config.model!r} adapter has no "
+                    "quantized layout (Llama-family models support it)"
                 )
-            from dynamo_tpu.models.llama import quantize_params_int8
-
-            params = quantize_params_int8(params)
+            params = self.adapter.quantize_params(params)
         kv = self.adapter.init_kv(config.num_pages, config.page_size)
         if self.mesh is not None:
             specs = self.adapter.param_specs(quantized=bool(config.quantize))

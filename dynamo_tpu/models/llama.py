@@ -399,6 +399,11 @@ def params_from_gguf(gguf_file, cfg: LlamaConfig) -> dict:
 # ---------------------------------------------------------------------------
 
 
+#: the per-layer dense weights weight-only quantization covers (the engine
+#: guard and the quantizer share this — they must never drift)
+QUANTIZED_DENSE_NAMES = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+
+
 def quantize_params_int8(params: dict) -> dict:
     """Weight-only int8 quantization with per-output-channel symmetric
     scales, applied to the seven layer matmul weights (embed / lm_head /
@@ -415,7 +420,12 @@ def quantize_params_int8(params: dict) -> dict:
 
     out = dict(params)
     layers = dict(params["layers"])
-    for name in ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down"):
+    if any(layers[n].dtype == jnp.int8 for n in QUANTIZED_DENSE_NAMES):
+        raise ValueError(
+            "params are already int8-quantized; re-quantizing would "
+            "recompute scales from quantized values and corrupt the model"
+        )
+    for name in QUANTIZED_DENSE_NAMES:
         # lax.map over the stacked layer axis keeps the fp32 temporary at
         # one layer's size (a whole-tensor astype would briefly double the
         # biggest weight on one device before sharding).

@@ -37,6 +37,10 @@ class ModelAdapter:
     #: (an HF checkpoint dir or a .gguf file); engines load from here when
     #: no explicit checkpoint_path is given
     default_checkpoint: Optional[str] = None
+    #: weight-only quantization transform for this family's param layout
+    #: (None = family doesn't support it); the engine calls it for
+    #: EngineConfig.quantize="int8"
+    quantize_params: Optional[Callable[[Any], Any]] = None
 
 
 _LLAMA_PRESETS: dict[str, Callable[[], LlamaConfig]] = {
@@ -87,6 +91,7 @@ def _llama_adapter(
         ),
         kv_spec=lambda: KVPages(k=kv_cache_spec(), v=kv_cache_spec()),
         load_params=lambda path: _load_llama_checkpoint(path, cfg),
+        quantize_params=llama_mod.quantize_params_int8,
     )
 
 

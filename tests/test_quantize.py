@@ -109,3 +109,12 @@ def test_quantize_rejects_unsupported():
     )
     with pytest.raises(ValueError, match="Llama-family"):
         JaxEngine(moe)
+
+
+def test_double_quantize_rejected():
+    from dynamo_tpu.models.llama import quantize_params_int8
+
+    cfg = LlamaConfig.tiny()
+    params = quantize_params_int8(init_params(jax.random.key(0), cfg))
+    with pytest.raises(ValueError, match="already int8-quantized"):
+        quantize_params_int8(params)
