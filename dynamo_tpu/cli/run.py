@@ -39,6 +39,7 @@ def _engine_config(args, eos_token_ids: tuple = ()) -> EngineConfig:
         dp=args.dp,
         tp=args.tp,
         sp=getattr(args, "sp", 1),
+        ep=getattr(args, "ep", 1),
         eos_token_ids=tuple(eos_token_ids) or (0,),
         host_kv_cache_bytes=getattr(args, "host_kv_bytes", 0),
         disk_kv_cache_bytes=getattr(args, "disk_kv_bytes", 0),
@@ -543,6 +544,10 @@ def main(argv: Optional[list[str]] = None) -> None:
     runp.add_argument(
         "--sp", type=int, default=1,
         help="sequence-parallel devices: long prefills use ring attention",
+    )
+    runp.add_argument(
+        "--ep", type=int, default=1,
+        help="expert-parallel devices (MoE models shard experts over them)",
     )
     runp.add_argument(
         "--coordinator", default=None,

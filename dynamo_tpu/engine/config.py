@@ -74,6 +74,9 @@ class EngineConfig:
     #: sequence/context parallel: long first-chunk prefills run ring
     #: attention over this many devices (parallel/context.py)
     sp: int = 1
+    #: expert parallel: MoE experts shard over this many devices (dense
+    #: models ignore it)
+    ep: int = 1
     #: random seed for sampling
     seed: int = 0
     #: enable content-addressed prefix caching

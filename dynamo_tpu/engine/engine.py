@@ -79,7 +79,9 @@ class JaxEngine:
         checkpoint_path: Optional[str] = None,
     ):
         self.config = config
-        mc = mesh_config or MeshConfig(dp=config.dp, tp=config.tp, sp=config.sp)
+        mc = mesh_config or MeshConfig(
+            dp=config.dp, tp=config.tp, sp=config.sp, ep=config.ep
+        )
         impl = config.attention_impl
         if impl not in ("auto", "xla", "pallas"):
             raise ValueError(

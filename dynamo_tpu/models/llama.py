@@ -399,8 +399,7 @@ def params_from_gguf(gguf_file, cfg: LlamaConfig) -> dict:
 # ---------------------------------------------------------------------------
 
 
-#: the per-layer dense weights weight-only quantization covers (the engine
-#: guard and the quantizer share this — they must never drift)
+#: the per-layer dense weights weight-only quantization covers
 QUANTIZED_DENSE_NAMES = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
 
 

@@ -61,8 +61,6 @@ def test_mixed_workload_stress():
     for rid, (kind, samp, prompt) in kinds.items():
         toks = got[rid]
         assert 1 <= len(toks) <= samp.max_tokens, (rid, toks)
-        if finished[rid] == FinishReason.LENGTH and not samp.stop_token_ids:
-            pass  # hit max_tokens or context
         if kind == 2:  # logprob requests got aligned entries
             assert len(lps[rid]) == len(toks)
         else:
@@ -78,7 +76,7 @@ def test_mixed_workload_stress():
     assert eng2.run_to_completion()["solo"] == got["r0"]
 
 
-def test_stress_under_page_pressure_with_tiering(tmp_path):
+def test_stress_under_page_pressure_with_tiering(tmp_path, caplog):
     """Tiny pool + host/disk tiers + spec decode + preemption: outputs of
     a pressured engine match an unpressured one request-for-request."""
     roomy = JaxEngine(_cfg(num_pages=256))
@@ -97,10 +95,15 @@ def test_stress_under_page_pressure_with_tiering(tmp_path):
             eng.add_request(rid, p, SamplingParams(
                 temperature=0.0, max_tokens=6))
     a = roomy.run_to_completion()
-    b = tight.run_to_completion()
+    import logging
+
+    with caplog.at_level(logging.WARNING, "dynamo_tpu.engine.scheduler"):
+        b = tight.run_to_completion()
     assert a == b, "page pressure / tiering / spec changed outputs"
-    # the pressured engine actually exercised its pressure paths
-    assert tight.allocator.stats.evicted_blocks + len(tight.scheduler.doomed) >= 0
+    # the tight pool must actually have hit pressure — either cached pages
+    # were evicted or a sequence was preempted for recompute
+    preempted = any("preempting" in r.message for r in caplog.records)
+    assert tight.allocator.stats.evicted_blocks > 0 or preempted
 
 
 def test_abort_midflight_under_mixed_load():

@@ -155,3 +155,21 @@ def test_registry_moe_adapter():
     pt = jnp.asarray([[1, 2]], jnp.int32)
     logits, _ = adapter.forward(params, toks, pos, jnp.ones((1, 4), bool), kv, pt)
     assert logits.shape == (1, 4, adapter.vocab_size)
+
+
+def test_moe_engine_with_ep_from_config(cpu_mesh_devices):
+    """EngineConfig.ep reaches the mesh: a MoE model serves with experts
+    sharded over ep devices, matching the single-device output."""
+    from dynamo_tpu.engine import EngineConfig
+    from dynamo_tpu.engine.engine import JaxEngine
+    from dynamo_tpu.engine.request import SamplingParams
+
+    base = EngineConfig.for_tests()
+    over = dict(model="moe-tiny", dtype="float32")
+    single = JaxEngine(EngineConfig(**{**base.__dict__, **over}))
+    sharded = JaxEngine(EngineConfig(**{**base.__dict__, **over, "ep": 2}))
+    assert sharded.mesh is not None and sharded.mesh.shape["ep"] == 2
+    prompt = [3, 5, 7, 9]
+    for eng, rid in ((single, "a"), (sharded, "b")):
+        eng.add_request(rid, prompt, SamplingParams(temperature=0.0, max_tokens=4))
+    assert single.run_to_completion()["a"] == sharded.run_to_completion()["b"]
