@@ -101,11 +101,17 @@ def bench_engine(
         for o in outs:
             rid = o.request_id
             if o.new_token_ids:
-                counts[rid] += len(o.new_token_ids)
+                n = len(o.new_token_ids)
+                counts[rid] += n
                 if rid not in first:
                     first[rid] = now
-                else:
-                    itls[rid].append(now - last[rid])
+                    n -= 1  # first token is TTFT, not an inter-token gap
+                if rid in last and n > 0:
+                    # Fused multi-step decode and speculative acceptance
+                    # emit several tokens per step: spread the step interval
+                    # so ITL stays per-token, not per-dispatch.
+                    gap = (now - last[rid]) / n
+                    itls[rid].extend([gap] * n)
                 last[rid] = now
             if o.finish_reason is not None:
                 done.append(rid)
@@ -201,6 +207,14 @@ def main(argv=None) -> None:
     p.add_argument("--num-pages", type=int, default=2048, dest="num_pages")
     p.add_argument("--page-size", type=int, default=64, dest="page_size")
     p.add_argument("--dtype", default="bfloat16")
+    p.add_argument(
+        "--spec-ngram", type=int, default=0, dest="spec_ngram",
+        help="engine mode: speculative decoding draft length (0 = off)",
+    )
+    p.add_argument(
+        "--quantize", default=None, choices=["int8"],
+        help="engine mode: weight-only quantization",
+    )
     p.add_argument("--csv", action="store_true")
     args = p.parse_args(argv)
 
@@ -237,6 +251,8 @@ def main(argv=None) -> None:
                 max_pages_per_seq=max(8, -(-(longest + 1) // args.page_size)),
                 dtype=args.dtype,
                 enable_prefix_caching=False,
+                spec_ngram=args.spec_ngram,
+                quantize=args.quantize,
             )
         )
         # warmup compiles every program shape the sweep will touch
