@@ -104,8 +104,7 @@ def bench_engine(
                 n = len(o.new_token_ids)
                 counts[rid] += n
                 if rid not in first:
-                    first[rid] = now
-                    n -= 1  # first token is TTFT, not an inter-token gap
+                    first[rid] = now  # whole first output counts as TTFT
                 if rid in last and n > 0:
                     # Fused multi-step decode and speculative acceptance
                     # emit several tokens per step: spread the step interval

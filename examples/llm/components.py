@@ -10,8 +10,10 @@ drains the shared prefill queue.
 Config keys (YAML per service, see configs/):
   Frontend:   port
   Worker:     model, engine (jax|echo|mock), router-mode, page-size,
-              num-pages, max-context, dtype, disagg, max-local-prefill
-  PrefillWorkerService: model, page-size, num-pages, max-context, dtype
+              num-pages, max-context, dtype, disagg, max-local-prefill,
+              prefill-chunk, max-seqs, decode-steps, spec-ngram, quantize,
+              host-kv-bytes, disk-kv-bytes, disk-kv-dir, dp, tp, sp, ep
+  PrefillWorkerService: model + the same engine keys as Worker
 """
 
 from __future__ import annotations
@@ -35,6 +37,15 @@ def _engine_config(cfg: dict):
         max_seqs=int(cfg.get("max-seqs", 64)),
         dtype=cfg.get("dtype", "bfloat16"),
         decode_steps=int(cfg.get("decode-steps", 8)),
+        spec_ngram=int(cfg.get("spec-ngram", 0)),
+        quantize=cfg.get("quantize"),
+        host_kv_cache_bytes=int(cfg.get("host-kv-bytes", 0)),
+        disk_kv_cache_bytes=int(cfg.get("disk-kv-bytes", 0)),
+        disk_kv_cache_dir=cfg.get("disk-kv-dir"),
+        dp=int(cfg.get("dp", 1)),
+        tp=int(cfg.get("tp", 1)),
+        sp=int(cfg.get("sp", 1)),
+        ep=int(cfg.get("ep", 1)),
     )
 
 

@@ -76,3 +76,18 @@ def test_graph_serves_chat(graph, root):
             await handle.stop()
 
     asyncio.run(run())
+
+
+def test_worker_config_passes_engine_knobs():
+    """YAML service config reaches EngineConfig: spec decode, quantization,
+    KV tiers, and the parallel axes must not silently drop."""
+    from examples.llm.components import _engine_config
+
+    cfg = _engine_config({
+        "model": "tiny", "spec-ngram": 3, "quantize": "int8",
+        "host-kv-bytes": 1234, "dp": 2, "tp": 2, "sp": 1, "ep": 2,
+    })
+    assert cfg.spec_ngram == 3
+    assert cfg.quantize == "int8"
+    assert cfg.host_kv_cache_bytes == 1234
+    assert (cfg.dp, cfg.tp, cfg.sp, cfg.ep) == (2, 2, 1, 2)
