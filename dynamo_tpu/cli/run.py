@@ -468,13 +468,30 @@ async def _run_planner(args) -> None:
         rt, namespace=args.namespace, decode_component=args.component
     )
     await observer.start()
-    connector = LocalConnector(spawn_cmd)
+    if args.connector == "kube":
+        from dynamo_tpu.operator.kube import InClusterKube
+        from dynamo_tpu.planner.kube_connector import KubeConnector
+
+        role_services = dict(kv.split("=", 1) for kv in args.role_service)
+        connector = KubeConnector(
+            InClusterKube(),
+            cr_name=args.cr_name,
+            namespace=args.k8s_namespace,
+            role_services=role_services,
+        )
+    else:
+        connector = LocalConnector(spawn_cmd)
     runner = PlannerRunner(planner, connector, observer.observe)
-    print(f"planner up (mode={args.mode}, interval={args.interval}s)", flush=True)
+    print(
+        f"planner up (mode={args.mode}, connector={args.connector}, "
+        f"interval={args.interval}s)",
+        flush=True,
+    )
     try:
         await runner.run()
     finally:
-        connector.stop_all()
+        if hasattr(connector, "stop_all"):
+            connector.stop_all()
         await observer.stop()
         await rt.close()
 
@@ -693,8 +710,41 @@ def main(argv: Optional[list[str]] = None) -> None:
         "--worker-args", default="", dest="worker_args",
         help="extra flags appended to spawned worker commands",
     )
+    planp.add_argument(
+        "--connector", default="local", choices=["local", "kube"],
+        help="local: spawn worker processes on this host; kube: edit the "
+             "DynamoGraphDeployment CR and let the operator reconcile",
+    )
+    planp.add_argument(
+        "--cr-name", default=None, dest="cr_name",
+        help="kube connector: DynamoGraphDeployment name",
+    )
+    planp.add_argument(
+        "--k8s-namespace", default="default", dest="k8s_namespace",
+        help="kube connector: namespace of the CR",
+    )
+    def _role_service(value: str) -> str:
+        if "=" not in value:
+            raise argparse.ArgumentTypeError(
+                f"expected role=ServiceName, got {value!r}"
+            )
+        return value
+
+    planp.add_argument(
+        "--role-service", action="append", default=[], dest="role_service",
+        type=_role_service,
+        help="kube connector: role=ServiceName mapping (repeatable), e.g. "
+             "--role-service decode=Worker --role-service "
+             "prefill=PrefillWorkerService",
+    )
 
     args = p.parse_args(argv)
+    if (
+        args.cmd == "planner"
+        and args.connector == "kube"
+        and not args.cr_name
+    ):
+        p.error("--cr-name is required with --connector kube")
     configure_logging()
 
     from dynamo_tpu.platform import honor_jax_platforms_env

@@ -49,13 +49,17 @@ class InMemoryKube:
         self.actions: list[tuple[str, str, str]] = []  # (verb, kind, name)
 
     def get(self, kind: str, namespace: str, name: str) -> Optional[dict]:
-        return self._objs.get((kind, namespace, name))
+        obj = self._objs.get((kind, namespace, name))
+        # Deep-copy like a real API server: callers mutate what they GET
+        # and write back via replace; aliasing the stored object would make
+        # read-modify-write races invisible to tests.
+        return json.loads(json.dumps(obj)) if obj is not None else None
 
     def list(
         self, kind: str, namespace: str, selector: Optional[dict] = None
     ) -> list[dict]:
         return [
-            o
+            json.loads(json.dumps(o))
             for (k, ns, _), o in sorted(self._objs.items())
             if k == kind and ns == namespace and _match_labels(o, selector)
         ]

@@ -1,0 +1,79 @@
+"""Kubernetes planner connector: actuate scaling by editing the
+DynamoGraphDeployment CR that owns the fleet.
+
+Reference parity: the planner's KubernetesConnector patches CRD replica
+counts and lets the operator reconcile them into Deployments
+(/root/reference components/planner kube.py; our operator is
+dynamo_tpu/operator). The division of labor is identical: the planner
+decides targets, the CR records desired state, the operator converges the
+cluster — so a planner crash never leaves half-applied Deployments, and
+`kubectl get dgd` always shows the current desired fleet.
+
+The connector is kube-client-agnostic (InMemoryKube in tests,
+InClusterKube in a pod)."""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Mapping
+
+logger = logging.getLogger(__name__)
+
+
+class KubeConnector:
+    def __init__(
+        self,
+        kube: Any,
+        cr_name: str,
+        namespace: str = "default",
+        role_services: Mapping[str, str] | None = None,
+    ):
+        """role_services maps planner roles ("decode", "prefill") to the
+        CR's service names (e.g. {"decode": "Worker",
+        "prefill": "PrefillWorkerService"})."""
+        self.kube = kube
+        self.cr_name = cr_name
+        self.namespace = namespace
+        self.role_services = dict(role_services or {})
+
+    async def scale(self, role: str, target: int, observed: int) -> None:
+        service = self.role_services.get(role, role)
+        # Read-modify-write with retry: the operator's status patches bump
+        # resourceVersion between our get and replace, so a PUT can 409;
+        # re-read and re-apply instead of failing the planner tick.
+        for attempt in range(4):
+            cr = self.kube.get(
+                "DynamoGraphDeployment", self.namespace, self.cr_name
+            )
+            if cr is None:
+                logger.warning(
+                    "planner: CR %s/%s not found; cannot scale %s",
+                    self.namespace, self.cr_name, role,
+                )
+                return
+            for svc in cr.get("spec", {}).get("services", []):
+                if svc.get("name") == service:
+                    break
+            else:
+                logger.warning(
+                    "planner: CR %s has no service %r for role %r",
+                    self.cr_name, service, role,
+                )
+                return
+            current = svc.get("replicas", 1)
+            if current == target:
+                return
+            svc["replicas"] = target
+            try:
+                self.kube.replace(
+                    "DynamoGraphDeployment", self.namespace, self.cr_name, cr
+                )
+            except Exception as e:  # HTTPError 409 = lost the write race
+                if getattr(e, "code", None) == 409 and attempt < 3:
+                    continue
+                raise
+            logger.info(
+                "planner: %s (%s) replicas %d -> %d (observed %d)",
+                role, service, current, target, observed,
+            )
+            return

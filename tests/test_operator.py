@@ -155,3 +155,67 @@ def test_two_crs_do_not_interfere():
     reconcile(kube, a2)
     assert kube.get("Deployment", "default", "onlyb") is not None
     assert kube.get("Deployment", "default", "onlya") is None
+
+
+def test_planner_kube_connector_closes_the_loop():
+    """Planner scale() edits the CR; the operator reconciles the edit into
+    the Deployment — the reference's planner->CRD->operator division of
+    labor, end to end with no cluster."""
+    import asyncio
+
+    from dynamo_tpu.planner.kube_connector import KubeConnector
+
+    kube = InMemoryKube()
+    kube.create("DynamoGraphDeployment", "default", make_cr(name="fleet"))
+    ctl = Controller(kube, namespace="default")
+    ctl.reconcile_once()
+    assert kube.get("Deployment", "default", "worker")["spec"]["replicas"] == 2
+
+    conn = KubeConnector(
+        kube, cr_name="fleet", role_services={"decode": "Worker"}
+    )
+    asyncio.run(conn.scale("decode", target=5, observed=2))
+    cr = kube.get("DynamoGraphDeployment", "default", "fleet")
+    assert cr["spec"]["services"][1]["replicas"] == 5
+
+    ctl.reconcile_once()
+    assert kube.get("Deployment", "default", "worker")["spec"]["replicas"] == 5
+
+    # idempotent: same target again writes nothing
+    kube.actions.clear()
+    asyncio.run(conn.scale("decode", target=5, observed=5))
+    assert kube.actions == []
+
+    # unknown role/service and missing CR degrade to no-ops
+    asyncio.run(conn.scale("nonexistent-role", target=3, observed=0))
+    conn2 = KubeConnector(kube, cr_name="ghost")
+    asyncio.run(conn2.scale("decode", target=1, observed=0))
+
+
+def test_kube_connector_retries_on_write_conflict():
+    """A 409 between get and replace (operator status churn) must retry,
+    not fail the planner tick."""
+    import asyncio
+    import urllib.error
+
+    from dynamo_tpu.planner.kube_connector import KubeConnector
+
+    kube = InMemoryKube()
+    kube.create("DynamoGraphDeployment", "default", make_cr(name="fleet"))
+
+    real_replace = kube.replace
+    fails = {"n": 2}
+
+    def flaky_replace(kind, ns, name, obj):
+        if fails["n"] > 0:
+            fails["n"] -= 1
+            raise urllib.error.HTTPError("u", 409, "conflict", {}, None)
+        return real_replace(kind, ns, name, obj)
+
+    kube.replace = flaky_replace
+    conn = KubeConnector(kube, cr_name="fleet",
+                         role_services={"decode": "Worker"})
+    asyncio.run(conn.scale("decode", target=7, observed=2))
+    cr = kube.get("DynamoGraphDeployment", "default", "fleet")
+    assert cr["spec"]["services"][1]["replicas"] == 7
+    assert fails["n"] == 0
