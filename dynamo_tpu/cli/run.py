@@ -739,12 +739,17 @@ def main(argv: Optional[list[str]] = None) -> None:
     )
 
     args = p.parse_args(argv)
-    if (
-        args.cmd == "planner"
-        and args.connector == "kube"
-        and not args.cr_name
-    ):
-        p.error("--cr-name is required with --connector kube")
+    if args.cmd == "planner" and args.connector == "kube":
+        if not args.cr_name:
+            p.error("--cr-name is required with --connector kube")
+        if not args.role_service:
+            # Without mappings the connector falls back to service==role,
+            # which never matches real CR service names — the planner would
+            # start healthy and silently never scale.
+            p.error(
+                "--connector kube requires at least one --role-service "
+                "mapping (e.g. --role-service decode=Worker)"
+            )
     configure_logging()
 
     from dynamo_tpu.platform import honor_jax_platforms_env

@@ -132,6 +132,8 @@ class ModelPipeline:
                     chunk.id = pre.request_id
                     if chunk.usage is not None:
                         usages.append(chunk.usage)
+                        if not chunk.choices:
+                            continue  # usage-only trailer; re-emitted combined
                         chunk.usage = None
                     for c in chunk.choices:
                         c.index = i

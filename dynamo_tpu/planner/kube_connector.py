@@ -37,13 +37,18 @@ class KubeConnector:
         self.role_services = dict(role_services or {})
 
     async def scale(self, role: str, target: int, observed: int) -> None:
+        import asyncio
+
         service = self.role_services.get(role, role)
         # Read-modify-write with retry: the operator's status patches bump
         # resourceVersion between our get and replace, so a PUT can 409;
-        # re-read and re-apply instead of failing the planner tick.
+        # re-read and re-apply instead of failing the planner tick. Kube
+        # calls are blocking HTTP — keep them off the planner's event loop
+        # (the FleetObserver and runtime heartbeats share it).
         for attempt in range(4):
-            cr = self.kube.get(
-                "DynamoGraphDeployment", self.namespace, self.cr_name
+            cr = await asyncio.to_thread(
+                self.kube.get, "DynamoGraphDeployment", self.namespace,
+                self.cr_name,
             )
             if cr is None:
                 logger.warning(
@@ -65,13 +70,20 @@ class KubeConnector:
                 return
             svc["replicas"] = target
             try:
-                self.kube.replace(
-                    "DynamoGraphDeployment", self.namespace, self.cr_name, cr
+                result = await asyncio.to_thread(
+                    self.kube.replace, "DynamoGraphDeployment",
+                    self.namespace, self.cr_name, cr,
                 )
             except Exception as e:  # HTTPError 409 = lost the write race
                 if getattr(e, "code", None) == 409 and attempt < 3:
                     continue
                 raise
+            if result is None:  # 404: the CR vanished mid-write
+                logger.warning(
+                    "planner: CR %s/%s disappeared during scale of %s",
+                    self.namespace, self.cr_name, role,
+                )
+                return
             logger.info(
                 "planner: %s (%s) replicas %d -> %d (observed %d)",
                 role, service, current, target, observed,

@@ -396,10 +396,20 @@ class OpenAIPreprocessor:
         # string) ride the final chunk; dropping them would desync the
         # entry list from the sampled tokens.
         final = chunk(finish_reason=finish or "stop", logprobs=take_lp())
-        if include_usage:
-            final.usage = Usage(
-                prompt_tokens=len(preprocessed.token_ids),
-                completion_tokens=completion_tokens,
-                total_tokens=len(preprocessed.token_ids) + completion_tokens,
-            )
         yield final
+        if include_usage:
+            # OpenAI contract: usage rides its own trailing chunk with an
+            # empty choices list, after the finish_reason chunk.
+            yield ChatCompletionChunk(
+                id=request_id,
+                created=created,
+                model=self.model_name,
+                choices=[],
+                usage=Usage(
+                    prompt_tokens=len(preprocessed.token_ids),
+                    completion_tokens=completion_tokens,
+                    total_tokens=(
+                        len(preprocessed.token_ids) + completion_tokens
+                    ),
+                ),
+            )

@@ -219,3 +219,22 @@ def test_kube_connector_retries_on_write_conflict():
     cr = kube.get("DynamoGraphDeployment", "default", "fleet")
     assert cr["spec"]["services"][1]["replicas"] == 7
     assert fails["n"] == 0
+
+
+def test_kube_connector_detects_cr_vanishing_mid_write(caplog):
+    """A replace that 404s (CR deleted between get and put) must warn, not
+    log a successful scale."""
+    import asyncio
+    import logging
+
+    from dynamo_tpu.planner.kube_connector import KubeConnector
+
+    kube = InMemoryKube()
+    kube.create("DynamoGraphDeployment", "default", make_cr(name="fleet"))
+    kube.replace = lambda *a, **k: None  # InClusterKube's 404 behavior
+    conn = KubeConnector(kube, cr_name="fleet",
+                         role_services={"decode": "Worker"})
+    with caplog.at_level(logging.INFO, "dynamo_tpu.planner.kube_connector"):
+        asyncio.run(conn.scale("decode", target=9, observed=2))
+    assert any("disappeared" in r.message for r in caplog.records)
+    assert not any("->" in r.message for r in caplog.records)
