@@ -3,14 +3,21 @@
 Workload (genai-perf-inspired, scaled to one chip — BASELINE.md): N
 concurrent requests, random prompts, fixed output length, continuous
 batching with paged KV + prefix caching off (worst case). Reports output
-tokens/sec/chip and p50 TTFT.
+tokens/sec/chip, p50 TTFT, p50 ITL, and approximate MFU.
 
 Prints ONE JSON line:
   {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ...,
    "extras": {...}}
 
-vs_baseline compares against `published.output_tok_s_per_chip` in
-BASELINE.json when present (rounds record their numbers there); 1.0 until a
+Robustness contract (the axon TPU tunnel is known to wedge): the backend is
+probed in a SUBPROCESS with a timeout before any in-process jax import
+commits to a platform. On probe failure the bench retries, then falls back
+to CPU with extras.platform="cpu" (vs_baseline compared against the CPU
+record, not the TPU one). Any unexpected crash still emits one structured
+JSON line instead of a bare traceback.
+
+vs_baseline compares against `published.output_tok_s_per_chip` (TPU) or
+`published.cpu_output_tok_s` (CPU fallback) in BASELINE.json; 1.0 until a
 prior round has published.
 """
 
@@ -18,16 +25,57 @@ from __future__ import annotations
 
 import json
 import os
+import subprocess
+import sys
 import time
+
+PROBE_SRC = "import jax; d=jax.devices(); print(d[0].platform)"
+
+
+def probe_backend(retries: int = 3, timeout_s: int = 120) -> str:
+    """Return the usable platform ('tpu' or 'cpu') via subprocess probes.
+
+    A wedged tunnel hangs rather than erroring, so the probe must be a
+    killable child process — never the bench process itself."""
+    want = os.environ.get("JAX_PLATFORMS", "")
+    if want == "cpu":
+        return "cpu"
+    for attempt in range(retries):
+        try:
+            out = subprocess.run(
+                [sys.executable, "-c", PROBE_SRC],
+                capture_output=True, text=True, timeout=timeout_s,
+                env=dict(os.environ),
+            )
+            if out.returncode == 0:
+                plat = out.stdout.strip().splitlines()[-1].strip().lower()
+                return "tpu" if plat not in ("cpu",) else "cpu"
+        except subprocess.TimeoutExpired:
+            pass
+        if attempt < retries - 1:
+            time.sleep(30)
+    return "cpu"
+
+
+def emit(obj: dict) -> None:
+    print(json.dumps(obj), flush=True)
 
 
 def main() -> None:
-    import sys
-
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     from dynamo_tpu.platform import honor_jax_platforms_env
 
     honor_jax_platforms_env()
+    platform = probe_backend(
+        retries=int(os.environ.get("BENCH_PROBE_RETRIES", "3"))
+    )
+    if platform == "cpu":
+        # Commit the fallback before jax initializes in-process. The env var
+        # alone is ineffective once sitecustomize has run — re-apply via
+        # jax.config (backends init lazily, so this sticks).
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        honor_jax_platforms_env()
+
     model = os.environ.get("BENCH_MODEL", "llama3-1b")
     num_requests = int(os.environ.get("BENCH_REQUESTS", "128"))
     isl = int(os.environ.get("BENCH_ISL", "128"))
@@ -67,6 +115,10 @@ def main() -> None:
     )
     eng = JaxEngine(cfg)
 
+    import jax
+
+    n_params = sum(int(x.size) for x in jax.tree.leaves(eng.params))
+
     rng = np.random.default_rng(0)
     prompts = [
         [int(x) for x in rng.integers(1, 32000, isl)] for _ in range(num_requests)
@@ -85,6 +137,8 @@ def main() -> None:
     t0 = time.time()
     submit = {}
     first_token = {}
+    last_token = {}
+    tokens_of = {}
     for i, p in enumerate(prompts):
         rid = f"r{i}"
         submit[rid] = time.time()
@@ -92,45 +146,80 @@ def main() -> None:
     generated = 0
     while eng.has_work:
         for out in eng.step():
+            now = time.time()
             generated += len(out.new_token_ids)
+            tokens_of[out.request_id] = tokens_of.get(out.request_id, 0) + len(
+                out.new_token_ids
+            )
             if out.is_first and out.request_id not in first_token:
-                first_token[out.request_id] = time.time()
+                first_token[out.request_id] = now
+            last_token[out.request_id] = now
     elapsed = time.time() - t0
 
     ttfts = sorted(first_token[r] - submit[r] for r in first_token)
     p50_ttft = ttfts[len(ttfts) // 2] if ttfts else float("nan")
+    itls = sorted(
+        (last_token[r] - first_token[r]) / (tokens_of[r] - 1)
+        for r in first_token
+        if tokens_of.get(r, 0) > 1
+    )
+    p50_itl = itls[len(itls) // 2] if itls else float("nan")
     tok_s = generated / elapsed
 
+    # Approximate MFU: decode is ~2*params FLOPs/token; prefill adds
+    # 2*params per prompt token (attention FLOPs are second-order at these
+    # sequence lengths). Peak: TPU v5e bf16 ~197e12 FLOP/s.
+    peak = 197e12 if platform == "tpu" else float("nan")
+    total_tokens = generated + num_requests * isl
+    mfu = (2.0 * n_params * total_tokens / elapsed) / peak if peak == peak else float("nan")
+
+    baseline_key = (
+        "output_tok_s_per_chip" if platform == "tpu" else "cpu_output_tok_s"
+    )
     baseline = 0.0
     try:
         with open(os.path.join(os.path.dirname(__file__), "BASELINE.json")) as f:
             baseline = float(
-                json.load(f).get("published", {}).get("output_tok_s_per_chip", 0.0)
+                json.load(f).get("published", {}).get(baseline_key, 0.0)
             )
     except Exception:
         pass
     vs = tok_s / baseline if baseline > 0 else 1.0
 
-    print(
-        json.dumps(
-            {
-                "metric": "output_tok_s_per_chip",
-                "value": round(tok_s, 2),
-                "unit": "tok/s",
-                "vs_baseline": round(vs, 3),
-                "extras": {
-                    "model": model,
-                    "num_requests": num_requests,
-                    "isl": isl,
-                    "osl": osl,
-                    "p50_ttft_s": round(p50_ttft, 4),
-                    "elapsed_s": round(elapsed, 2),
-                    "generated_tokens": generated,
-                },
-            }
-        )
+    emit(
+        {
+            "metric": "output_tok_s_per_chip",
+            "value": round(tok_s, 2),
+            "unit": "tok/s",
+            "vs_baseline": round(vs, 3),
+            "extras": {
+                "platform": platform,
+                "model": model,
+                "params": n_params,
+                "num_requests": num_requests,
+                "isl": isl,
+                "osl": osl,
+                "p50_ttft_s": round(p50_ttft, 4),
+                "p50_itl_s": round(p50_itl, 5) if p50_itl == p50_itl else None,
+                "mfu": round(mfu, 4) if mfu == mfu else None,
+                "elapsed_s": round(elapsed, 2),
+                "generated_tokens": generated,
+            },
+        }
     )
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except Exception as e:  # last resort: structured artifact, not a traceback
+        emit(
+            {
+                "metric": "output_tok_s_per_chip",
+                "value": 0.0,
+                "unit": "tok/s",
+                "vs_baseline": 0.0,
+                "error": f"{type(e).__name__}: {e}",
+            }
+        )
+        sys.exit(1)

@@ -158,11 +158,18 @@ class HostTier:
             return True
         self._entries[entry.seq_hash] = entry
         self._bytes += entry.nbytes
-        while self.used_bytes > self.capacity_bytes and self._entries:
-            _, victim = self._entries.popitem(last=False)
-            self._bytes -= victim.nbytes
-            if self._demote is not None:
-                self._demote(victim)
+        # Combined budget: evict Python entries first (they're the odd ones
+        # out), then native slabs, so the tier never sits above capacity.
+        while self.used_bytes > self.capacity_bytes:
+            if self._entries:
+                _, victim = self._entries.popitem(last=False)
+                self._bytes -= victim.nbytes
+                if self._demote is not None:
+                    self._demote(victim)
+            elif self._nh is not None and self._nlib.dyn_host_len(self._nh) > 0:
+                self._evict_native_lru()
+            else:
+                break
         return True
 
     def get(self, seq_hash: int) -> Optional[BlockEntry]:

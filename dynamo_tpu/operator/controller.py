@@ -59,7 +59,13 @@ class Controller:
 
     def run(self, max_passes: Optional[int] = None) -> None:
         while not self._stop.is_set():
-            self.reconcile_once()
+            try:
+                self.reconcile_once()
+            except Exception:
+                # A transient apiserver error on list()/garbage_collect()
+                # must not kill the operator; retry on the next interval.
+                logger.exception("reconcile pass failed; retrying")
+                self.passes += 1
             if max_passes is not None and self.passes >= max_passes:
                 return
             self._stop.wait(self.interval_s)

@@ -104,8 +104,12 @@ class InClusterKube:
         host = os.environ.get("KUBERNETES_SERVICE_HOST", "kubernetes.default.svc")
         port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
         self.base_url = base_url or f"https://{host}:{port}"
-        with open(os.path.join(self.SA_DIR, "token")) as f:
+        self._token_path = os.path.join(self.SA_DIR, "token")
+        # Fail fast at boot on a missing token (misconfigured pod); later
+        # refreshes tolerate transient stat errors.
+        with open(self._token_path) as f:
             self._token = f.read().strip()
+        self._token_mtime = os.stat(self._token_path).st_mtime
         ca = os.path.join(self.SA_DIR, "ca.crt")
         self._ctx = ssl.create_default_context(
             cafile=ca if os.path.exists(ca) else None
@@ -120,23 +124,41 @@ class InClusterKube:
             url += f"/{sub}"
         return url
 
+    def _refresh_token(self, force: bool = False) -> None:
+        # Bound SA tokens are rotated by the kubelet (~1h); re-read when the
+        # projected file changes rather than caching the boot-time value.
+        try:
+            mtime = os.stat(self._token_path).st_mtime
+        except OSError:
+            return
+        if force or mtime != self._token_mtime:
+            with open(self._token_path) as f:
+                self._token = f.read().strip()
+            self._token_mtime = mtime
+
     def _request(
         self, method: str, url: str, body: Optional[dict] = None,
         content_type: str = "application/json",
     ) -> Optional[dict]:
         data = json.dumps(body).encode() if body is not None else None
-        req = urllib.request.Request(url, data=data, method=method)
-        req.add_header("Authorization", f"Bearer {self._token}")
-        req.add_header("Accept", "application/json")
-        if data is not None:
-            req.add_header("Content-Type", content_type)
-        try:
-            with urllib.request.urlopen(req, context=self._ctx, timeout=30) as r:
-                return json.loads(r.read() or b"{}")
-        except urllib.error.HTTPError as e:
-            if e.code == 404:
-                return None
-            raise
+        self._refresh_token()
+        for attempt in (0, 1):
+            req = urllib.request.Request(url, data=data, method=method)
+            req.add_header("Authorization", f"Bearer {self._token}")
+            req.add_header("Accept", "application/json")
+            if data is not None:
+                req.add_header("Content-Type", content_type)
+            try:
+                with urllib.request.urlopen(req, context=self._ctx, timeout=30) as r:
+                    return json.loads(r.read() or b"{}")
+            except urllib.error.HTTPError as e:
+                if e.code == 404:
+                    return None
+                if e.code == 401 and attempt == 0:
+                    self._refresh_token(force=True)
+                    continue
+                raise
+        raise AssertionError("unreachable: loop returns or raises")
 
     def get(self, kind: str, namespace: str, name: str) -> Optional[dict]:
         return self._request("GET", self._url(kind, namespace, name))

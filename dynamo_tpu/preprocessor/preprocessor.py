@@ -118,12 +118,16 @@ def _chat_logprobs(request) -> int:
 
 
 def _completion_logprobs(request) -> int:
-    """Legacy completions logprobs=N → engine value, validated."""
+    """Legacy completions logprobs=N → engine value, validated.
+
+    The legacy OpenAI completions API caps logprobs at 5 (unlike chat's
+    top_logprobs<=20); match it so clients get the same 400 they'd get
+    upstream."""
     n = request.logprobs
     if n is None:
         return -1
-    if not 0 <= int(n) <= 20:
-        raise ValueError(f"logprobs must be between 0 and 20; got {n}")
+    if not 0 <= int(n) <= 5:
+        raise ValueError(f"logprobs must be between 0 and 5; got {n}")
     return int(n)
 
 

@@ -36,6 +36,12 @@ class CodecError(Exception):
 
 def encode_frame(header: Any, payload: bytes = b"") -> bytes:
     h = msgpack.packb(header, use_bin_type=True)
+    if len(h) > MAX_FRAME or len(payload) > MAX_FRAME:
+        # Mirror the read-side bound: the native path casts lengths to u32,
+        # so an oversized input would silently emit a corrupt frame.
+        raise CodecError(
+            f"frame too large: header={len(h)} payload={len(payload)}"
+        )
     lib = native.lib()
     if lib is not None:
         prefix = (ctypes.c_uint8 * _PREFIX.size)()
