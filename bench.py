@@ -112,6 +112,9 @@ def main() -> None:
         max_seqs=max(32, num_requests),
         dtype="bfloat16",
         enable_prefix_caching=False,
+        # llama3-8b bf16 (16GB) exceeds a v5e chip's HBM; int8 weight-only
+        # (BENCH_QUANTIZE=int8) fits it alongside the KV pages.
+        quantize=os.environ.get("BENCH_QUANTIZE") or None,
     )
     eng = JaxEngine(cfg)
 
@@ -168,10 +171,16 @@ def main() -> None:
 
     # Approximate MFU: decode is ~2*params FLOPs/token; prefill adds
     # 2*params per prompt token (attention FLOPs are second-order at these
-    # sequence lengths). Peak: TPU v5e bf16 ~197e12 FLOP/s.
-    peak = 197e12 if platform == "tpu" else float("nan")
+    # sequence lengths). Peak resolved per TPU generation.
+    from benchmarks.perf import tpu_bf16_peak_flops
+
+    peak = tpu_bf16_peak_flops()
     total_tokens = generated + num_requests * isl
-    mfu = (2.0 * n_params * total_tokens / elapsed) / peak if peak == peak else float("nan")
+    mfu = (
+        (2.0 * n_params * total_tokens / elapsed) / peak
+        if peak is not None
+        else float("nan")
+    )
 
     baseline_key = (
         "output_tok_s_per_chip" if platform == "tpu" else "cpu_output_tok_s"

@@ -64,6 +64,36 @@ def summarize(results: list[RequestResult], wall_s: float) -> dict:
 # -- engine mode ------------------------------------------------------------
 
 
+def tpu_bf16_peak_flops() -> Optional[float]:
+    """Per-chip bf16 peak for the attached TPU generation (public specs);
+    None when not on TPU or the generation is unrecognized."""
+    import jax
+
+    if jax.default_backend() != "tpu":
+        return None
+    kind = jax.devices()[0].device_kind.lower()
+    for tag, peak in (
+        ("v6e", 918e12), ("v6", 918e12), ("v5p", 459e12),
+        ("v5e", 197e12), ("v5lite", 197e12), ("v4", 275e12),
+    ):
+        if tag in kind:
+            return peak
+    return None
+
+
+def engine_mfu(engine, prompt_tokens: int, output_tokens: int, wall_s: float) -> Optional[float]:
+    """Approximate model-FLOPs utilization: ~2*params FLOPs per token
+    (prefill and decode both; attention is second-order at these lengths)
+    against the chip's bf16 peak. None off-TPU or unknown generation."""
+    import jax
+
+    peak = tpu_bf16_peak_flops()
+    if peak is None:
+        return None
+    n_params = sum(int(x.size) for x in jax.tree.leaves(engine.params))
+    return (2.0 * n_params * (prompt_tokens + output_tokens) / wall_s) / peak
+
+
 def bench_engine(
     engine, prompts: list[tuple[list[int], int]], concurrency: int
 ) -> dict:
@@ -125,7 +155,16 @@ def bench_engine(
         )
         for rid in done
     ]
-    return summarize(results, wall)
+    out = summarize(results, wall)
+    mfu = engine_mfu(
+        engine,
+        prompt_tokens=sum(len(p) for p, _ in prompts[: len(done)]),
+        output_tokens=sum(counts[rid] for rid in done),
+        wall_s=wall,
+    )
+    if mfu is not None:
+        out["mfu"] = round(mfu, 4)
+    return out
 
 
 # -- http mode --------------------------------------------------------------
@@ -214,6 +253,11 @@ def main(argv=None) -> None:
         "--quantize", default=None, choices=["int8"],
         help="engine mode: weight-only quantization",
     )
+    p.add_argument(
+        "--distribution", default="geometric",
+        choices=["geometric", "sharegpt"],
+        help="ISL/OSL law; sharegpt = lognormal heavy-tail mixture",
+    )
     p.add_argument("--csv", action="store_true")
     args = p.parse_args(argv)
 
@@ -229,6 +273,7 @@ def main(argv=None) -> None:
             depth=0,
             mean_suffix_len=args.isl,
             mean_output_len=args.osl,
+            distribution=args.distribution,
         )
     )
     levels = [int(x) for x in args.concurrency.split(",")]

@@ -35,6 +35,11 @@ class SynthConfig:
     mean_interarrival_s: float = 0.0
     vocab_size: int = 32000
     seed: int = 0
+    #: suffix/output length law: "geometric" (mean_*_len as mean) or
+    #: "sharegpt" — lognormal ISL/OSL shaped like the public ShareGPT
+    #: serving-benchmarks mixture (median ~130/~160 tokens, heavy tail,
+    #: clipped to [4, 2048]); mean_*_len scales the medians.
+    distribution: str = "geometric"
 
 
 @dataclass(frozen=True)
@@ -78,6 +83,18 @@ def _geometric(rng: random.Random, mean: float) -> int:
     return max(1, int(math.log(u) / math.log(1.0 - p)) + 1)
 
 
+def _sharegpt_len(rng: random.Random, median: float, sigma: float = 1.0) -> int:
+    """Lognormal token count with the ShareGPT mixture's shape: most
+    requests short, a heavy conversational tail; clipped to [4, 2048]."""
+    return int(min(2048, max(4, rng.lognormvariate(math.log(max(4, median)), sigma))))
+
+
+def _draw_len(cfg: SynthConfig, rng: random.Random, mean: float) -> int:
+    if cfg.distribution == "sharegpt":
+        return _sharegpt_len(rng, mean)
+    return _geometric(rng, mean)
+
+
 def synthesize(cfg: SynthConfig) -> list[SynthRequest]:
     rng = random.Random(cfg.seed)
     tree = PrefixTree(cfg, rng)
@@ -87,7 +104,7 @@ def synthesize(cfg: SynthConfig) -> list[SynthRequest]:
         depth = rng.randint(0, cfg.depth)
         path = tuple(rng.randrange(cfg.branching) for _ in range(depth))
         prompt = tree.tokens_for_path(path)
-        suffix_len = _geometric(rng, cfg.mean_suffix_len)
+        suffix_len = _draw_len(cfg, rng, cfg.mean_suffix_len)
         prompt.extend(
             rng.randrange(1, cfg.vocab_size) for _ in range(suffix_len)
         )
@@ -96,7 +113,7 @@ def synthesize(cfg: SynthConfig) -> list[SynthRequest]:
         out.append(
             SynthRequest(
                 prompt_tokens=tuple(prompt),
-                output_len=_geometric(rng, cfg.mean_output_len),
+                output_len=_draw_len(cfg, rng, cfg.mean_output_len),
                 arrival_s=t,
                 shared_depth=depth,
             )

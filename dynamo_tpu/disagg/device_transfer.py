@@ -1,0 +1,163 @@
+"""Device-path KV transfer plane: XLA transfer-server pull over ICI/DCN.
+
+The host path (transfer.py) moves pages device→host→TCP→host→device. This
+module is the NIXL-RDMA equivalent the reference uses for bulk KV movement
+(/root/reference lib/llm/src/block_manager/block/transfer.rs:83-111,
+storage/nixl.rs:231), re-designed for TPU: the prefill process STAGES its
+KV pages (still device-resident jax arrays) on an XLA transfer server and
+the decode process PULLS them directly into its own device memory — the
+bulk bytes ride the PjRt transfer fabric (ICI intra-slice, DCN across
+hosts), never the Python host path. Only a tiny "offer" control frame rides
+the existing TCP channel, mirroring the reference's metadata-rendezvous
+pattern (examples/llm/utils/nixl.py:58-86).
+
+Strategy selection (DYN_KV_TRANSFER):
+  auto   — device plane on the TPU backend; host path elsewhere. The CPU
+           backend's transfer server only has an IN-process bulk
+           transport: a cross-process pull fatally aborts the sender
+           (`LocalBulkTransportFactory::RecvBulkTransport` CHECK), so auto
+           never risks it off-TPU. Per-transfer fallback to the host path
+           on nack or pull failure.
+  host   — force the host TCP path (payload frames).
+  device — device plane on any backend (tests use this for in-process CPU
+           pulls; do NOT set it on multi-process CPU clusters).
+
+Staged arrays that are never pulled (decode nacked or died before pulling)
+are dropped only when the transfer server shuts down — bounded by failed
+transfers, same trade the reference accepts for un-consumed NIXL
+registrations.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import threading
+from typing import Optional, Sequence
+
+logger = logging.getLogger(__name__)
+
+_MODE_ENV = "DYN_KV_TRANSFER"
+
+_uuid_lock = threading.Lock()
+_uuid_next = 1
+
+
+def _next_uuid() -> int:
+    """Unique per transfer-server (one server per process): pid-salted so a
+    restarted sender can't collide with an old uuid a peer still holds."""
+    global _uuid_next
+    with _uuid_lock:
+        n = _uuid_next
+        _uuid_next += 1
+    return ((os.getpid() & 0x3FFFFF) << 40) | (n & ((1 << 40) - 1))
+
+
+def mode() -> str:
+    m = os.environ.get(_MODE_ENV, "auto").lower()
+    return m if m in ("auto", "host", "device") else "auto"
+
+
+class DevicePlane:
+    """Process-wide wrapper around jax.experimental.transfer.
+
+    Sender: stage(arrays) -> (address, uuid); receiver: pull(address, uuid,
+    specs) -> arrays on this process's default device. One server and one
+    connection-per-peer are shared by all transfers in the process.
+    """
+
+    _singleton: Optional["DevicePlane"] = None
+    _failed = False
+    _lock = threading.Lock()
+
+    def __init__(self):
+        import jax
+        from jax.experimental import transfer as jax_transfer
+
+        self._jax = jax
+        client = jax.devices()[0].client
+        self._server = jax_transfer.start_transfer_server(client)
+        self._address = self._server.address()
+        self._conns: dict[str, object] = {}
+        self._conn_lock = threading.Lock()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    @classmethod
+    def get(cls) -> Optional["DevicePlane"]:
+        """The process's device plane, or None when unsupported/disabled."""
+        m = mode()
+        if m == "host":
+            return None
+        if m == "auto":
+            import jax
+
+            if jax.default_backend() != "tpu":
+                return None
+        with cls._lock:
+            if cls._singleton is not None:
+                return cls._singleton
+            if cls._failed:
+                return None
+            try:
+                cls._singleton = cls()
+            except Exception:
+                if mode() == "device":
+                    raise
+                logger.info("device KV plane unavailable; using host path",
+                            exc_info=True)
+                cls._failed = True
+                return None
+            logger.info(
+                "device KV transfer plane up at %s", cls._singleton._address
+            )
+            return cls._singleton
+
+    @classmethod
+    def reset_for_tests(cls) -> None:
+        with cls._lock:
+            cls._singleton = None
+            cls._failed = False
+
+    @property
+    def address(self) -> str:
+        return self._address
+
+    # -- sender ------------------------------------------------------------
+
+    def stage(self, arrays: Sequence) -> int:
+        """Schedule device arrays for one remote pull; returns the uuid the
+        peer must pull with."""
+        uuid = _next_uuid()
+        self._server.await_pull(uuid, list(arrays))
+        return uuid
+
+    # -- receiver ----------------------------------------------------------
+
+    def _connection(self, address: str):
+        with self._conn_lock:
+            conn = self._conns.get(address)
+            if conn is None:
+                conn = self._server.connect(address)
+                self._conns[address] = conn
+            return conn
+
+    def _pull_sync(self, address: str, uuid: int, shape, dtype) -> tuple:
+        import jax
+        from jax.sharding import SingleDeviceSharding
+
+        sharding = SingleDeviceSharding(jax.devices()[0])
+        spec = jax.ShapeDtypeStruct(tuple(shape), dtype, sharding=sharding)
+        conn = self._connection(address)
+        k, v = conn.pull(uuid, [spec, spec])
+        return k, v
+
+    async def pull(self, address: str, uuid: int, shape, dtype) -> tuple:
+        """Pull (k, v) staged under uuid from the peer at address; arrays
+        land on this process's default device. Blocking PjRt call runs in
+        the default executor so the event loop stays live."""
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(
+            None, self._pull_sync, address, uuid, shape, dtype
+        )

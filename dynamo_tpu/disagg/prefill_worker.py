@@ -186,8 +186,10 @@ class PrefillWorker:
             if pages is None:
                 raise RuntimeError(f"held pages for {rid} missing")
             # decode reserved ceil((len+1)/ps) pages; we transfer the prompt
-            # KV — the first-token page slot is recomputed decode-side
-            return pages, eng.extract_pages(pages)
+            # KV — the first-token page slot is recomputed decode-side.
+            # DEVICE arrays: the device transfer path stages them directly;
+            # only a host-path fallback pays the device→host copy.
+            return pages, eng.extract_pages_async(pages)
 
         pages, (k, v) = await runner.submit(_extract)
         try:
@@ -196,7 +198,7 @@ class PrefillWorker:
                     f"page count mismatch: prefill {len(pages)} vs decode "
                     f"{len(req.page_ids)} (page_size/config skew?)"
                 )
-            ok = await self.transfer.write(
+            ok = await self.transfer.send(
                 req.transfer_host, req.transfer_port, rid, req.page_ids,
                 k, v, first_token,
             )

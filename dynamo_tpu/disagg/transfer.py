@@ -8,9 +8,18 @@ write callback scatters them into the decode engine's device pool and the
 request's waiter fires with the first sampled token.
 
 This is the reference's NIXL RDMA KV write (dynamo_flow.md:36-38,
-block_manager/storage/nixl.rs) re-designed for TPU: no verbs — pages move
-device→host→TCP→host→device today, with the same interface ready to back
-onto ICI remote DMA (Pallas) intra-slice or DCN streams across slices.
+block_manager/storage/nixl.rs) re-designed for TPU. Two strategies share
+one control channel and one interface (the reference's pluggable transfer
+strategies, block/transfer.rs:83-111):
+
+- **device path** (preferred): the prefill side stages its still-device-
+  resident pages on an XLA transfer server and sends a tiny "offer" frame;
+  the decode side pulls the bytes device-to-device over the PjRt transfer
+  fabric (ICI intra-slice, DCN across hosts) and acks. See
+  device_transfer.py.
+- **host path** (fallback / DYN_KV_TRANSFER=host): pages ride the
+  checksummed two-part framing device→host→TCP→host→device.
+
 Metadata rendezvous (who listens where) rides the lease store exactly like
 the reference's nixl.py:58-86 etcd pattern: the transfer address is
 published in the worker's instance metadata.
@@ -25,12 +34,26 @@ from typing import Awaitable, Callable, Optional, Sequence
 
 import numpy as np
 
+from dynamo_tpu.disagg.device_transfer import DevicePlane
 from dynamo_tpu.runtime.codec import encode_frame, read_frame
 
 logger = logging.getLogger(__name__)
 
 #: write callback: (page_ids, k, v) -> awaitable; arrays [L, Hkv, n, ps, D]
 WriteFn = Callable[[Sequence[int], np.ndarray, np.ndarray], Awaitable[None]]
+#: device write callback: same contract but k/v are device (jax) arrays
+DeviceWriteFn = Callable[[Sequence[int], object, object], Awaitable[None]]
+
+
+def dtype_from_name(name: str) -> np.dtype:
+    """Wire dtypes travel by NAME: bfloat16's numpy `.str` is '<V2' (void),
+    which would silently corrupt the frame on decode."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
 
 
 @dataclass
@@ -44,12 +67,21 @@ class KvTransferServer:
     """Decode-side receiver: accepts page writes, lands them via write_fn,
     resolves per-request waiters."""
 
-    def __init__(self, write_fn: WriteFn, host: str = "127.0.0.1", port: int = 0):
+    def __init__(
+        self,
+        write_fn: WriteFn,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        device_write_fn: Optional[DeviceWriteFn] = None,
+    ):
         self.write_fn = write_fn
+        self.device_write_fn = device_write_fn
         self.host = host
         self.port = port
         self._server: Optional[asyncio.base_events.Server] = None
         self._waiters: dict[str, asyncio.Future] = {}
+        #: transfers landed per strategy (observability: which plane ran)
+        self.transfers = {"device": 0, "host": 0}
 
     async def start(self) -> None:
         self._server = await asyncio.start_server(
@@ -79,6 +111,8 @@ class KvTransferServer:
                 try:
                     if op == "write":
                         await self._on_write(header, payload, writer)
+                    elif op == "offer":
+                        await self._on_offer(header, writer)
                     elif op == "close":
                         return
                     else:
@@ -96,6 +130,35 @@ class KvTransferServer:
         finally:
             writer.close()
 
+    async def _nack(self, writer, rid) -> None:
+        writer.write(encode_frame({"op": "nack", "request_id": rid}))
+        await writer.drain()
+
+    async def _land(self, rid, header, land, writer, path: str) -> None:
+        """Run the strategy-specific landing coroutine, then resolve the
+        waiter and ack — shared tail of both transfer paths."""
+        try:
+            await land()
+        except Exception as e:
+            logger.exception("KV page %s-path landing failed for %s", path, rid)
+            fut = self._waiters.pop(rid, None)
+            if fut is not None and not fut.done():
+                fut.set_exception(e)
+            await self._nack(writer, rid)
+            return
+        self.transfers[path] += 1
+        fut = self._waiters.pop(rid, None)
+        if fut is not None and not fut.done():
+            fut.set_result(
+                TransferResult(
+                    request_id=rid,
+                    first_token=header["first_token"],
+                    num_pages=len(header["page_ids"]),
+                )
+            )
+        writer.write(encode_frame({"op": "ack", "request_id": rid}))
+        await writer.drain()
+
     async def _on_write(self, header, payload: bytes, writer) -> None:
         rid = header["request_id"]
         if rid not in self._waiters:
@@ -103,36 +166,61 @@ class KvTransferServer:
             # reallocated): landing this write would corrupt a live
             # request's KV. Refuse it.
             logger.warning("dropping KV write for %s: no waiter", rid)
-            writer.write(encode_frame({"op": "nack", "request_id": rid}))
-            await writer.drain()
+            await self._nack(writer, rid)
             return
         page_ids = header["page_ids"]
         shape = tuple(header["shape"])  # [L, Hkv, n, ps, D]
-        dtype = np.dtype(header["dtype"])
+        dtype = dtype_from_name(header["dtype"])
         nbytes = int(np.prod(shape)) * dtype.itemsize
         k = np.frombuffer(payload[:nbytes], dtype=dtype).reshape(shape)
         v = np.frombuffer(payload[nbytes : 2 * nbytes], dtype=dtype).reshape(shape)
-        try:
-            await self.write_fn(page_ids, k, v)
-        except Exception as e:
-            logger.exception("KV page write failed for %s", rid)
-            fut = self._waiters.pop(rid, None)
-            if fut is not None and not fut.done():
-                fut.set_exception(e)
-            writer.write(encode_frame({"op": "nack", "request_id": rid}))
-            await writer.drain()
+        await self._land(
+            rid, header, lambda: self.write_fn(page_ids, k, v), writer, "host"
+        )
+
+    async def _on_offer(self, header, writer) -> None:
+        """Device-path offer: pull the staged pages over the PjRt transfer
+        fabric and land them without a host round-trip. Nack when this
+        process has no device plane — the sender falls back to a write."""
+        rid = header["request_id"]
+        plane = DevicePlane.get()
+        if plane is None:
+            await self._nack(writer, rid)
             return
-        fut = self._waiters.pop(rid, None)
-        if fut is not None and not fut.done():
-            fut.set_result(
-                TransferResult(
-                    request_id=rid,
-                    first_token=header["first_token"],
-                    num_pages=len(page_ids),
-                )
+        if rid not in self._waiters:
+            # Refuse BEFORE pulling: the staged arrays stay unconsumed on
+            # the sender (bounded leak, see device_transfer.py docstring)
+            # but no freed/reused decode pages get overwritten.
+            logger.warning("dropping KV offer for %s: no waiter", rid)
+            await self._nack(writer, rid)
+            return
+        page_ids = header["page_ids"]
+        try:
+            k, v = await plane.pull(
+                header["xfer_addr"], header["uuid"],
+                tuple(header["shape"]), dtype_from_name(header["dtype"]),
             )
-        writer.write(encode_frame({"op": "ack", "request_id": rid}))
-        await writer.drain()
+        except Exception:
+            # Pull never touched the pool: nack but KEEP the waiter — the
+            # sender's host-path fallback can still land this request.
+            logger.exception("device KV pull failed for %s", rid)
+            await self._nack(writer, rid)
+            return
+        if rid not in self._waiters:
+            # Re-check after the pull: the decode side may have timed out
+            # DURING the transfer and freed (possibly reallocated) the
+            # pages — landing now would corrupt a live request's KV.
+            logger.warning("dropping pulled KV for %s: waiter gone", rid)
+            await self._nack(writer, rid)
+            return
+
+        async def land():
+            if self.device_write_fn is not None:
+                await self.device_write_fn(page_ids, k, v)
+            else:
+                await self.write_fn(page_ids, np.asarray(k), np.asarray(v))
+
+        await self._land(rid, header, land, writer, "device")
 
     async def stop(self) -> None:
         if self._server is not None:
@@ -167,6 +255,53 @@ class KvTransferClient:
         self._conns[key] = (reader, writer)
         return reader, writer
 
+    async def send(
+        self,
+        host: str,
+        port: int,
+        request_id: str,
+        page_ids: Sequence[int],
+        k,
+        v,
+        first_token: int,
+    ) -> bool:
+        """Ship pages by the best available strategy. k/v: canonical
+        [L, Hkv, n, ps, D], ideally still DEVICE arrays — the device path
+        stages them without a host copy; only a host-path fallback
+        materializes numpy. True on decode-side ack."""
+        plane = DevicePlane.get()
+        if plane is not None:
+            try:
+                uuid = plane.stage([k, v])
+                ok = await self._control(
+                    host, port,
+                    {
+                        "op": "offer",
+                        "request_id": request_id,
+                        "page_ids": list(page_ids),
+                        "shape": list(k.shape),
+                        "dtype": k.dtype.name,
+                        "first_token": int(first_token),
+                        "xfer_addr": plane.address,
+                        "uuid": uuid,
+                    },
+                )
+                if ok:
+                    return True
+                logger.info(
+                    "device KV offer for %s nacked; host-path fallback",
+                    request_id,
+                )
+            except Exception:
+                logger.exception(
+                    "device KV path failed for %s; host-path fallback",
+                    request_id,
+                )
+        return await self.write(
+            host, port, request_id, page_ids,
+            np.asarray(k), np.asarray(v), first_token,
+        )
+
     async def write(
         self,
         host: str,
@@ -177,30 +312,34 @@ class KvTransferClient:
         v: np.ndarray,
         first_token: int,
     ) -> bool:
-        """Ship pages; True on decode-side ack. k/v: [L, Hkv, n, ps, D]
-        with n == len(page_ids)."""
+        """Host path: ship page bytes in the frame payload; True on
+        decode-side ack. k/v: [L, Hkv, n, ps, D] with n == len(page_ids)."""
         assert k.shape == v.shape and k.shape[2] == len(page_ids), (
             k.shape, len(page_ids),
         )
+        return await self._control(
+            host, port,
+            {
+                "op": "write",
+                "request_id": request_id,
+                "page_ids": list(page_ids),
+                "shape": list(k.shape),
+                "dtype": k.dtype.name,
+                "first_token": int(first_token),
+            },
+            payload=k.tobytes() + v.tobytes(),
+        )
+
+    async def _control(
+        self, host: str, port: int, header: dict, payload: bytes = b""
+    ) -> bool:
         key = (host, port)
         async with self._lock(key):
             reader, writer = await self._conn(key)
-            writer.write(
-                encode_frame(
-                    {
-                        "op": "write",
-                        "request_id": request_id,
-                        "page_ids": list(page_ids),
-                        "shape": list(k.shape),
-                        "dtype": k.dtype.str,
-                        "first_token": int(first_token),
-                    },
-                    k.tobytes() + v.tobytes(),
-                )
-            )
+            writer.write(encode_frame(header, payload))
             await writer.drain()
-            header, _ = await read_frame(reader)
-        return header.get("op") == "ack"
+            resp, _ = await read_frame(reader)
+        return resp.get("op") == "ack"
 
     def close(self) -> None:
         for _, writer in self._conns.values():

@@ -1125,28 +1125,49 @@ class JaxEngine:
 
     def inject_pages(self, page_ids: Sequence[int], k: np.ndarray, v: np.ndarray) -> None:
         """Write transferred KV pages (canonical [L, Hkv, n, S, D]) into
-        this engine's pool in place."""
-        # -> device layout [L, n, S, Hkv, Dp]
-        k = np.ascontiguousarray(k.transpose(0, 2, 3, 1, 4))
-        v = np.ascontiguousarray(v.transpose(0, 2, 3, 1, 4))
-        dpad = self.kv.k.shape[-1] - k.shape[-1]
-        if dpad:
-            widths = [(0, 0)] * (k.ndim - 1) + [(0, dpad)]
-            k = np.pad(k, widths)
-            v = np.pad(v, widths)
+        this engine's pool in place. Host arrays become uncommitted device
+        arrays, so the jitted scatter reshards them onto whatever mesh the
+        pool lives on."""
+        self.inject_pages_device(page_ids, jnp.asarray(k), jnp.asarray(v))
+
+    def inject_pages_device(self, page_ids: Sequence[int], k, v) -> None:
+        """Device-path inject: k/v are jax arrays (canonical
+        [L, Hkv, n, S, D]); the transpose, head-dim pad, and scatter all
+        run in one jitted program — no host round-trip on the single-chip
+        path (the point of the ICI transfer plane)."""
+        pool_sharding = getattr(self.kv.k, "sharding", None)
+        if (
+            pool_sharding is not None
+            and len(pool_sharding.device_set) > 1
+            and getattr(k, "sharding", None) is not None
+            and k.sharding.device_set != pool_sharding.device_set
+        ):
+            # Pulled arrays are committed to one device; a jit over a
+            # multi-device pool would reject the conflicting placement.
+            # Stage through host (per-shard ICI pulls are the future
+            # optimization) — jnp.asarray(np) yields uncommitted arrays
+            # the scatter can reshard freely.
+            k = jnp.asarray(np.asarray(k))
+            v = jnp.asarray(np.asarray(v))
         n = len(page_ids)
-        fn = self._jit_cache.get(("inject", n))
+        dpad = self.kv.k.shape[-1] - k.shape[-1]
+        fn = self._jit_cache.get(("inject_dev", n, dpad))
         if fn is None:
             def inject_fn(kv, ids, kk, vv):
+                kk = kk.transpose(0, 2, 3, 1, 4)
+                vv = vv.transpose(0, 2, 3, 1, 4)
+                if dpad:
+                    widths = [(0, 0)] * (kk.ndim - 1) + [(0, dpad)]
+                    kk = jnp.pad(kk, widths)
+                    vv = jnp.pad(vv, widths)
                 return type(kv)(
                     k=kv.k.at[:, ids].set(kk.astype(kv.k.dtype)),
                     v=kv.v.at[:, ids].set(vv.astype(kv.v.dtype)),
                 )
             fn = jax.jit(inject_fn, donate_argnums=(0,))
-            self._jit_cache[("inject", n)] = fn
+            self._jit_cache[("inject_dev", n, dpad)] = fn
         self.kv = fn(
-            self.kv, jnp.asarray(np.asarray(page_ids, np.int32)),
-            jnp.asarray(k), jnp.asarray(v),
+            self.kv, jnp.asarray(np.asarray(page_ids, np.int32)), k, v
         )
 
     def allocate_for_remote_prefill(

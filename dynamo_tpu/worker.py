@@ -128,7 +128,14 @@ class Worker:
                     lambda eng: eng.inject_pages(page_ids, k, v)
                 )
 
-            self.transfer_server = KvTransferServer(write_fn)
+            async def device_write_fn(page_ids, k, v):
+                await runner.submit(
+                    lambda eng: eng.inject_pages_device(page_ids, k, v)
+                )
+
+            self.transfer_server = KvTransferServer(
+                write_fn, device_write_fn=device_write_fn
+            )
             await self.transfer_server.start()
             self.disagg_router = DisaggregatedRouter(
                 self.runtime.fabric, self.disagg_config

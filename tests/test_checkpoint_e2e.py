@@ -1,0 +1,156 @@
+"""Real-checkpoint serving path, end to end on disk.
+
+The reference's perf story is real checkpoints through real engines
+(/root/reference launch/dynamo-run/src/subprocess/vllm_v1_inc.py); this is
+the TPU build's equivalent proof at test scale: a genuine HF-format
+checkpoint directory (config.json + model.safetensors + tokenizer.json) is
+written to disk by transformers itself, then resolved by the model
+registry, loaded through the safetensors loader, tokenized by the real HF
+tokenizer, and driven greedily through the JaxEngine — with every output
+token id compared EXACTLY against transformers' own generate() on the same
+files. No state-dict hand-off: the only shared artifact is the directory.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+
+@pytest.fixture(scope="module")
+def hf_checkpoint(tmp_path_factory):
+    """Write a tiny-but-real Llama HF checkpoint + fast tokenizer to disk."""
+    from tokenizers import Tokenizer, models, pre_tokenizers
+    from transformers import (
+        LlamaConfig as HFConfig,
+        LlamaForCausalLM,
+        PreTrainedTokenizerFast,
+    )
+
+    d = tmp_path_factory.mktemp("hf-llama-ckpt")
+
+    words = [
+        "<unk>", "<s>", "</s>", "the", "quick", "brown", "fox", "jumps",
+        "over", "lazy", "dog", "hello", "world", "a", "b", "c",
+    ]
+    vocab = {w: i for i, w in enumerate(words)}
+    tok = Tokenizer(models.WordLevel(vocab, unk_token="<unk>"))
+    tok.pre_tokenizer = pre_tokenizers.Whitespace()
+    fast = PreTrainedTokenizerFast(
+        tokenizer_object=tok, unk_token="<unk>", bos_token="<s>",
+        eos_token="</s>",
+    )
+    fast.save_pretrained(str(d))
+
+    hf_cfg = HFConfig(
+        vocab_size=len(words),
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        head_dim=16,
+        rope_theta=10000.0,
+        rms_norm_eps=1e-5,
+        tie_word_embeddings=False,
+        attention_bias=False,
+        mlp_bias=False,
+        bos_token_id=1,
+        eos_token_id=2,
+    )
+    torch.manual_seed(7)
+    model = LlamaForCausalLM(hf_cfg).eval()
+    model.save_pretrained(str(d), safe_serialization=True)
+    return str(d)
+
+
+def _hf_greedy(ckpt: str, prompt_ids: list[int], n: int) -> list[int]:
+    from transformers import AutoModelForCausalLM
+
+    model = AutoModelForCausalLM.from_pretrained(
+        ckpt, torch_dtype=torch.float32
+    ).eval()
+    ids = torch.tensor([prompt_ids], dtype=torch.long)
+    with torch.no_grad():
+        out = model.generate(
+            ids, max_new_tokens=n, do_sample=False, eos_token_id=None
+        )
+    return out[0, len(prompt_ids):].tolist()
+
+
+def test_registry_resolves_checkpoint_dir(hf_checkpoint):
+    from dynamo_tpu.models.registry import get_model
+
+    adapter = get_model(hf_checkpoint, dtype="float32")
+    assert adapter.default_checkpoint == hf_checkpoint
+    assert adapter.vocab_size == 16
+    params = adapter.load_params(hf_checkpoint)
+    assert params is not None
+
+
+def test_engine_greedy_matches_hf_generate(hf_checkpoint):
+    """Checkpoint dir → engine → greedy tokens == transformers generate."""
+    from dynamo_tpu.engine import EngineConfig
+    from dynamo_tpu.engine.engine import JaxEngine
+    from dynamo_tpu.engine.request import SamplingParams
+    from dynamo_tpu.preprocessor.tokenizer import HfTokenizer
+
+    tokenizer = HfTokenizer(hf_checkpoint)
+    prompt_ids = tokenizer.encode("the quick brown fox")
+    assert len(prompt_ids) >= 4  # real tokenizer produced real ids
+
+    n_new = 12
+    ref = _hf_greedy(hf_checkpoint, prompt_ids, n_new)
+
+    cfg = EngineConfig(
+        model=hf_checkpoint,
+        num_pages=32,
+        page_size=4,
+        max_pages_per_seq=16,
+        dtype="float32",
+        enable_prefix_caching=False,
+    )
+    eng = JaxEngine(cfg)
+    eng.add_request(
+        "r0", list(prompt_ids), SamplingParams(temperature=0.0, max_tokens=n_new)
+    )
+    got: list[int] = []
+    while eng.has_work:
+        for out in eng.step():
+            got.extend(int(t) for t in out.new_token_ids)
+    assert got == ref
+
+
+def test_two_prompts_batched_match_hf(hf_checkpoint):
+    """Continuous batching must not cross-contaminate checkpoint outputs."""
+    from dynamo_tpu.engine import EngineConfig
+    from dynamo_tpu.engine.engine import JaxEngine
+    from dynamo_tpu.engine.request import SamplingParams
+
+    prompts = [[3, 4, 5, 6, 7], [11, 12, 13]]
+    n_new = 8
+    refs = [_hf_greedy(hf_checkpoint, p, n_new) for p in prompts]
+
+    cfg = EngineConfig(
+        model=hf_checkpoint,
+        num_pages=32,
+        page_size=4,
+        max_pages_per_seq=16,
+        dtype="float32",
+        enable_prefix_caching=False,
+    )
+    eng = JaxEngine(cfg)
+    for i, p in enumerate(prompts):
+        eng.add_request(
+            f"r{i}", p, SamplingParams(temperature=0.0, max_tokens=n_new)
+        )
+    got: dict[str, list[int]] = {}
+    while eng.has_work:
+        for out in eng.step():
+            got.setdefault(out.request_id, []).extend(
+                int(t) for t in out.new_token_ids
+            )
+    assert got["r0"] == refs[0]
+    assert got["r1"] == refs[1]

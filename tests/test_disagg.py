@@ -117,6 +117,187 @@ def test_kv_transfer_numerical_equivalence(tiny_cfg):
     assert got == ref_tokens
 
 
+def test_device_path_numerical_equivalence(tiny_cfg, monkeypatch):
+    """Device plane end to end in-process: stage device arrays, pull them
+    over the transfer fabric, land via inject_pages_device — decode output
+    must equal the single-engine run exactly. (DYN_KV_TRANSFER=device:
+    in-process CPU pulls are safe; auto only enables the plane on TPU.)"""
+    from dynamo_tpu.disagg.device_transfer import DevicePlane
+    from dynamo_tpu.disagg.transfer import KvTransferClient, KvTransferServer
+
+    monkeypatch.setenv("DYN_KV_TRANSFER", "device")
+    plane = DevicePlane.get()
+    assert plane is not None  # CPU backend supports the transfer server
+
+    prompt = [9, 1, 33, 7, 52, 4, 18, 73, 6, 12]
+    n_out = 6
+    ref = JaxEngine(tiny_cfg)
+    ref.add_request("ref", prompt, SamplingParams(temperature=0.0, max_tokens=n_out))
+    ref_tokens = ref.run_to_completion()["ref"]
+
+    pre = JaxEngine(tiny_cfg)
+    req_p = pre.add_request(
+        "d1", prompt, SamplingParams(temperature=0.0, max_tokens=1, ignore_eos=True)
+    )
+    req_p.hold_pages = True
+    first = pre.run_to_completion()["d1"]
+    held = pre.scheduler.held["d1"]
+    k_dev, v_dev = pre.extract_pages_async(held)  # device arrays
+
+    dec = JaxEngine(tiny_cfg)
+    req_d = dec.allocate_for_remote_prefill(
+        "d1", prompt, SamplingParams(temperature=0.0, max_tokens=n_out)
+    )
+
+    async def main():
+        landed = asyncio.Event()
+
+        async def device_write_fn(page_ids, k, v):
+            dec.inject_pages_device(page_ids, k, v)
+            landed.set()
+
+        async def write_fn(page_ids, k, v):  # must not run
+            raise AssertionError("host path used")
+
+        server = KvTransferServer(write_fn, device_write_fn=device_write_fn)
+        await server.start()
+        waiter = server.expect("d1")
+        client = KvTransferClient()
+        try:
+            ok = await client.send(
+                *server.address, "d1", req_d.pages, k_dev, v_dev, first[0]
+            )
+            assert ok
+            result = await asyncio.wait_for(waiter, 10)
+            assert result.first_token == first[0]
+            assert landed.is_set()
+            assert server.transfers == {"device": 1, "host": 0}
+        finally:
+            client.close()
+            await server.stop()
+
+    run(main())
+    pre.scheduler.release_held("d1")
+    outputs = dec.add_prefilled(req_d, first[0])
+    got = [t for o in outputs for t in o.new_token_ids]
+    got += dec.run_to_completion().get("d1", [])
+    assert got == ref_tokens
+
+
+def test_device_pull_failure_falls_back_to_host(tiny_cfg, monkeypatch):
+    """A failed device pull nacks WITHOUT killing the waiter; the sender's
+    host-path fallback then lands the same request."""
+    from dynamo_tpu.disagg.device_transfer import DevicePlane
+    from dynamo_tpu.disagg.transfer import KvTransferClient, KvTransferServer
+
+    monkeypatch.setenv("DYN_KV_TRANSFER", "device")
+    plane = DevicePlane.get()
+    assert plane is not None
+
+    def broken_pull(address, uuid, shape, dtype):
+        raise RuntimeError("simulated ICI failure")
+
+    monkeypatch.setattr(plane, "_pull_sync", broken_pull)
+
+    import ml_dtypes
+
+    shape = (1, 1, 2, 4, 8)  # [L, Hkv, n, ps, D]
+    k = np.arange(np.prod(shape), dtype=np.float32).reshape(shape)
+    v = -k
+
+    async def main():
+        written = {}
+
+        async def write_fn(page_ids, kk, vv):
+            written["pages"] = list(page_ids)
+            np.testing.assert_array_equal(kk, k)
+            np.testing.assert_array_equal(vv, v)
+
+        server = KvTransferServer(write_fn)
+        await server.start()
+        waiter = server.expect("r1")
+        client = KvTransferClient()
+        try:
+            ok = await client.send(*server.address, "r1", [3, 4], k, v, 42)
+            assert ok  # fallback succeeded
+            result = await asyncio.wait_for(waiter, 10)
+            assert result.first_token == 42
+            assert written["pages"] == [3, 4]
+            assert server.transfers == {"device": 0, "host": 1}
+        finally:
+            client.close()
+            await server.stop()
+
+    run(main())
+
+
+def test_host_mode_env_skips_device_plane(monkeypatch):
+    """DYN_KV_TRANSFER=host forces the payload path end to end."""
+    from dynamo_tpu.disagg.transfer import KvTransferClient, KvTransferServer
+
+    monkeypatch.setenv("DYN_KV_TRANSFER", "host")
+    shape = (1, 1, 1, 4, 8)
+    k = np.ones(shape, dtype=np.float32)
+    v = np.zeros(shape, dtype=np.float32)
+
+    async def main():
+        async def write_fn(page_ids, kk, vv):
+            pass
+
+        server = KvTransferServer(write_fn)
+        await server.start()
+        server.expect("r1")
+        client = KvTransferClient()
+        try:
+            ok = await client.send(*server.address, "r1", [1], k, v, 7)
+            assert ok
+            assert server.transfers == {"device": 0, "host": 1}
+        finally:
+            client.close()
+            await server.stop()
+
+    run(main())
+
+
+def test_bfloat16_wire_dtype_roundtrip():
+    """bfloat16's numpy dtype.str is '<V2' (void) — the wire must carry
+    names. Host-path a bf16 page and check byte-exact landing."""
+    import ml_dtypes
+
+    from dynamo_tpu.disagg.transfer import KvTransferClient, KvTransferServer
+
+    shape = (2, 1, 1, 4, 8)
+    rng = np.random.default_rng(0)
+    k = rng.standard_normal(shape).astype(ml_dtypes.bfloat16)
+    v = rng.standard_normal(shape).astype(ml_dtypes.bfloat16)
+
+    async def main():
+        got = {}
+
+        async def write_fn(page_ids, kk, vv):
+            got["k"], got["v"] = kk, vv
+
+        server = KvTransferServer(write_fn)
+        await server.start()
+        server.expect("r1")
+        client = KvTransferClient()
+        try:
+            ok = await client.write(*server.address, "r1", [2], k, v, 1)
+            assert ok
+            assert got["k"].dtype == ml_dtypes.bfloat16
+            np.testing.assert_array_equal(
+                got["k"].view(np.uint16), k.view(np.uint16)
+            )
+            np.testing.assert_array_equal(
+                got["v"].view(np.uint16), v.view(np.uint16)
+            )
+        finally:
+            client.close()
+            await server.stop()
+
+    run(main())
+
+
 def test_remote_prefill_reservation_failure(tiny_cfg):
     eng = JaxEngine(tiny_cfg)
     # pool is 63 usable pages of 4 tokens; ask for more than fits
@@ -130,9 +311,12 @@ def test_remote_prefill_reservation_failure(tiny_cfg):
     assert eng.allocator.num_free == before + 3  # ceil(11/4)
 
 
-def test_disagg_e2e_workers(tiny_cfg):
+def test_disagg_e2e_workers(tiny_cfg, monkeypatch):
     """Full path: decode worker + prefill worker over a fabric server; long
-    prompts prefill remotely and the output matches a local-only run."""
+    prompts prefill remotely and the output matches a local-only run.
+    Workers share this test process, so forcing the device plane is safe
+    on CPU and proves the worker wiring uses it."""
+    monkeypatch.setenv("DYN_KV_TRANSFER", "device")
     from dynamo_tpu.disagg.prefill_worker import PrefillWorker
     from dynamo_tpu.model_card import ModelDeploymentCard
     from dynamo_tpu.runtime import DistributedRuntime, RouterMode
@@ -186,6 +370,9 @@ def test_disagg_e2e_workers(tiny_cfg):
             assert tokens == ref_tokens
             assert decode.remote_prefills == 1
             assert prefill.prefills_done == 1
+            # the bulk bytes rode the DEVICE plane (pull), not host TCP
+            assert decode.transfer_server.transfers["device"] == 1
+            assert decode.transfer_server.transfers["host"] == 0
 
             # short prompt stays local
             short = dict(_req("e2e-2"), token_ids=[7, 7, 7])
