@@ -234,6 +234,7 @@ async def _run_worker(args) -> None:
         router_mode=args.router_mode,
         enable_disagg=args.disagg,
         disagg_config=_disagg_config(args),
+        kv_remote=getattr(args, "kv_remote", False),
     )
     await worker.start()
     print(f"worker {worker.instance_id} up (model={args.model})", flush=True)
@@ -541,6 +542,11 @@ def main(argv: Optional[list[str]] = None) -> None:
     runp.add_argument(
         "--disk-kv-dir", default=None, dest="disk_kv_dir",
         help="directory for the disk KV tier (required with --disk-kv-bytes)",
+    )
+    runp.add_argument(
+        "--kv-remote", action="store_true", dest="kv_remote",
+        help="KVBM G4: serve KV blocks to peers and onboard prefixes a "
+             "peer already computed (cross-worker, over the transfer plane)",
     )
     runp.add_argument(
         "--spec-ngram", type=int, default=0, dest="spec_ngram",

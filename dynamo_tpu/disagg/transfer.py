@@ -43,6 +43,9 @@ logger = logging.getLogger(__name__)
 WriteFn = Callable[[Sequence[int], np.ndarray, np.ndarray], Awaitable[None]]
 #: device write callback: same contract but k/v are device (jax) arrays
 DeviceWriteFn = Callable[[Sequence[int], object, object], Awaitable[None]]
+#: G4 serve callback: (seq_hashes) -> awaitable of
+#: (metas, k, v) | None with metas=[(seq_hash, parent, tokens)...]
+FetchFn = Callable[[Sequence[int]], Awaitable[Optional[tuple]]]
 
 
 def dtype_from_name(name: str) -> np.dtype:
@@ -73,9 +76,11 @@ class KvTransferServer:
         host: str = "127.0.0.1",
         port: int = 0,
         device_write_fn: Optional[DeviceWriteFn] = None,
+        fetch_fn: Optional[FetchFn] = None,
     ):
         self.write_fn = write_fn
         self.device_write_fn = device_write_fn
+        self.fetch_fn = fetch_fn
         self.host = host
         self.port = port
         self._server: Optional[asyncio.base_events.Server] = None
@@ -113,6 +118,8 @@ class KvTransferServer:
                         await self._on_write(header, payload, writer)
                     elif op == "offer":
                         await self._on_offer(header, writer)
+                    elif op == "fetch":
+                        await self._on_fetch(header, writer)
                     elif op == "close":
                         return
                     else:
@@ -222,6 +229,40 @@ class KvTransferServer:
 
         await self._land(rid, header, land, writer, "device")
 
+    async def _on_fetch(self, header, writer) -> None:
+        """G4 remote-tier serve: export the longest locally-resident chain
+        of the requested hashes (reference: export_local_blockset,
+        block_manager.rs:121). Misses return found=0 so the peer's
+        directory self-heals."""
+        hashes = header.get("seq_hashes", [])
+        served = None
+        if self.fetch_fn is not None and hashes:
+            try:
+                served = await self.fetch_fn(hashes)
+            except Exception:
+                logger.exception("KV fetch serve failed")
+        if not served:
+            writer.write(encode_frame({"op": "fetch_ok", "found": 0}))
+            await writer.drain()
+            return
+        metas, k, v = served
+        writer.write(
+            encode_frame(
+                {
+                    "op": "fetch_ok",
+                    "found": len(metas),
+                    "metas": [
+                        [int(h), None if p is None else int(p), list(t)]
+                        for h, p, t in metas
+                    ],
+                    "shape": list(k.shape),
+                    "dtype": k.dtype.name,
+                },
+                k.tobytes() + v.tobytes(),
+            )
+        )
+        await writer.drain()
+
     async def stop(self) -> None:
         if self._server is not None:
             self._server.close()
@@ -330,15 +371,47 @@ class KvTransferClient:
             payload=k.tobytes() + v.tobytes(),
         )
 
+    async def fetch(
+        self, host: str, port: int, seq_hashes: Sequence[int]
+    ) -> Optional[tuple]:
+        """G4 onboard pull: ask a peer for the longest chain of
+        `seq_hashes` it can serve. Returns (metas, k, v) or None."""
+        key = (host, port)
+        resp, payload = await self._roundtrip(
+            key, {"op": "fetch", "seq_hashes": [int(h) for h in seq_hashes]}
+        )
+        if resp.get("op") != "fetch_ok" or not resp.get("found"):
+            return None
+        shape = tuple(resp["shape"])
+        dtype = dtype_from_name(resp["dtype"])
+        nbytes = int(np.prod(shape)) * dtype.itemsize
+        k = np.frombuffer(payload[:nbytes], dtype=dtype).reshape(shape)
+        v = np.frombuffer(payload[nbytes : 2 * nbytes], dtype=dtype).reshape(shape)
+        metas = [(h, p, tuple(t)) for h, p, t in resp["metas"]]
+        return metas, k, v
+
+    async def _roundtrip(
+        self, key: tuple[str, int], header: dict, payload: bytes = b""
+    ) -> tuple[dict, bytes]:
+        """One request/response on the pooled connection. Any failure —
+        including cancellation (a caller's wait_for timeout) mid-read —
+        closes and evicts the connection: reusing it would read the
+        previous exchange's frame and desynchronize every later call."""
+        async with self._lock(key):
+            reader, writer = await self._conn(key)
+            try:
+                writer.write(encode_frame(header, payload))
+                await writer.drain()
+                return await read_frame(reader)
+            except BaseException:
+                writer.close()
+                self._conns.pop(key, None)
+                raise
+
     async def _control(
         self, host: str, port: int, header: dict, payload: bytes = b""
     ) -> bool:
-        key = (host, port)
-        async with self._lock(key):
-            reader, writer = await self._conn(key)
-            writer.write(encode_frame(header, payload))
-            await writer.drain()
-            resp, _ = await read_frame(reader)
+        resp, _ = await self._roundtrip((host, port), header, payload)
         return resp.get("op") == "ack"
 
     def close(self) -> None:

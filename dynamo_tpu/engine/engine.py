@@ -77,6 +77,7 @@ class JaxEngine:
         mesh_config: Optional[MeshConfig] = None,
         on_kv_event: Optional[Callable[[KvEvent], None]] = None,
         checkpoint_path: Optional[str] = None,
+        on_tier_event=None,
     ):
         self.config = config
         mc = mesh_config or MeshConfig(
@@ -119,6 +120,7 @@ class JaxEngine:
                 disk_bytes=config.disk_kv_cache_bytes,
                 disk_dir=config.disk_kv_cache_dir,
                 on_event=on_kv_event,
+                on_tier_event=on_tier_event,
             )
         else:
             self.allocator = PageAllocator(
@@ -1169,6 +1171,83 @@ class JaxEngine:
         self.kv = fn(
             self.kv, jnp.asarray(np.asarray(page_ids, np.int32)), k, v
         )
+
+    # -- G4 remote tier: serve/adopt blocks across workers -----------------
+    # (reference: KvBlockManager::export_local_blockset / onboard_blocks —
+    # block_manager.rs:121,169)
+
+    def serve_blocks(self, seq_hashes: Sequence[int]):
+        """Export the longest locally-resident chain of `seq_hashes` for a
+        peer: (metas, k, v) with metas=[(seq_hash, parent, tokens)...] and
+        k/v canonical [L, Hkv, n, S, D] host arrays; None when the first
+        hash isn't here. Device pages are ref-held during extraction; the
+        lower tiers are read without promotion."""
+        alloc = self.allocator
+        pages = PageAllocator.lookup(alloc, seq_hashes)  # never onboards
+        metas: list[tuple] = []
+        parts_k: list[np.ndarray] = []
+        parts_v: list[np.ndarray] = []
+        try:
+            if pages:
+                k, v = self.extract_pages(pages)
+                parts_k.append(k)
+                parts_v.append(v)
+                metas = [alloc._page_meta[p] for p in pages]
+        finally:
+            if pages:
+                alloc.free(pages)
+        tier_get = getattr(alloc, "_tier_get", None)
+        if tier_get is not None:
+            entries = []
+            for h in seq_hashes[len(pages):]:
+                e = tier_get(h)
+                if e is None:
+                    break
+                entries.append(e)
+            if entries:
+                parts_k.append(np.stack([e.k for e in entries], axis=2))
+                parts_v.append(np.stack([e.v for e in entries], axis=2))
+                metas.extend(
+                    (e.seq_hash, e.parent_hash, e.tokens) for e in entries
+                )
+        if not metas:
+            return None
+        k = parts_k[0] if len(parts_k) == 1 else np.concatenate(parts_k, axis=2)
+        v = parts_v[0] if len(parts_v) == 1 else np.concatenate(parts_v, axis=2)
+        return metas, k, v
+
+    def adopt_blocks(self, metas: Sequence[tuple], k, v) -> int:
+        """Land a peer-served chain into this engine's prefix cache:
+        allocate fresh pages, inject the bytes, register the hashes (which
+        also publishes 'stored' events so routers learn the new holder).
+        Returns blocks adopted; skips blocks already resident and refuses
+        chains whose parent isn't resident (nothing would ever match
+        them)."""
+        alloc = self.allocator
+        tier_contains = getattr(alloc, "tier_contains", lambda h: False)
+        start = 0
+        while start < len(metas) and alloc.match_length([metas[start][0]]):
+            start += 1
+        todo = list(metas[start:])
+        if not todo:
+            return 0
+        parent = todo[0][1]
+        if (
+            parent is not None
+            and not alloc.match_length([parent])
+            and not tier_contains(parent)
+        ):
+            return 0
+        pages = alloc.allocate(len(todo))
+        if pages is None:
+            return 0  # pool pressure — skip this time
+        self.inject_pages(pages, k[:, :, start:], v[:, :, start:])
+        for page, (h, ph, toks) in zip(pages, todo):
+            alloc.register_promoted(page, h, ph, tuple(toks))
+        # Adopted blocks are cache content, not request-held: release so
+        # they stay registered but reclaimable.
+        alloc.free(pages)
+        return len(todo)
 
     def allocate_for_remote_prefill(
         self,

@@ -243,6 +243,23 @@ class PageAllocator:
         self.stats.hit_tokens += len(pages) * self.page_size
         return pages
 
+    def resident_match_length(self, seq_hashes: Sequence[int]) -> int:
+        """Alias of match_length on the base allocator; the tiered
+        subclass extends the chain through its host/disk tiers."""
+        return self.match_length(seq_hashes)
+
+    def register_promoted(
+        self,
+        page: int,
+        seq_hash: int,
+        parent_hash: Optional[int],
+        tokens: tuple[int, ...],
+    ) -> None:
+        """Register a block whose bytes were just brought (back) onto the
+        device — from a lower tier or a peer. The tiered subclass also
+        drops lower-tier copies and counts the onboard."""
+        self.register(page, seq_hash, parent_hash, tokens)
+
     def match_length(self, seq_hashes: Sequence[int]) -> int:
         """Cached-prefix length in blocks, without acquiring references."""
         if self._np is not None:

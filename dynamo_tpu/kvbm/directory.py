@@ -1,0 +1,167 @@
+"""BlockDirectory: who in the fleet can serve which KV block (G4 remote).
+
+Worker-side twin of the router's KvIndexer: subscribes the same
+`kv_events.>` stream (device stored/removed) plus `kvbm_tier.>` (blocks a
+peer offloaded to its host/disk tier — still servable over the transfer
+plane), and answers "which live peer holds block H, and how deep a chain
+can it extend?". This is the knowledge that drives cross-worker onboarding
+(the reference's G4 remote tier + onboard_blocks —
+/root/reference lib/llm/src/block_manager.rs:69-78,169).
+
+Deliberately best-effort: tier events carry only stores (no removals), the
+per-worker hash sets are LRU-capped, and staleness self-heals — a fetch
+that misses drops the claimed hashes for that peer (`drop`), and dead
+workers are pruned against the live instance set (`retain_workers`). A
+stale entry costs one failed fetch, never correctness: the serving peer
+re-checks its tiers at fetch time.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from collections import OrderedDict
+from typing import Optional, Sequence
+
+import msgpack
+
+from dynamo_tpu.subjects import KV_EVENT_SUBJECT, KVBM_TIER_SUBJECT
+
+logger = logging.getLogger(__name__)
+
+#: per-worker hash-set bound (device + tier each): memory backstop, LRU
+MAX_HASHES_PER_WORKER = 200_000
+
+
+class _WorkerSet:
+    """LRU-capped hash set."""
+
+    def __init__(self, cap: int):
+        self.cap = cap
+        self._d: OrderedDict[int, None] = OrderedDict()
+
+    def add(self, h: int) -> None:
+        self._d[h] = None
+        self._d.move_to_end(h)
+        while len(self._d) > self.cap:
+            self._d.popitem(last=False)
+
+    def discard(self, h: int) -> None:
+        self._d.pop(h, None)
+
+    def __contains__(self, h: int) -> bool:
+        return h in self._d
+
+    def __len__(self) -> int:
+        return len(self._d)
+
+
+class BlockDirectory:
+    def __init__(
+        self,
+        fabric,
+        own_instance_id: str = "",
+        cap_per_worker: int = MAX_HASHES_PER_WORKER,
+    ):
+        self.fabric = fabric
+        self.own_instance_id = own_instance_id
+        self.cap = cap_per_worker
+        #: worker -> blocks on its device / in its lower tiers
+        self._dev: dict[str, _WorkerSet] = {}
+        self._tier: dict[str, _WorkerSet] = {}
+        self._subs: list = []
+        self._tasks: list[asyncio.Task] = []
+
+    async def start(self) -> None:
+        loop = asyncio.get_running_loop()
+        for subject, kind in (
+            (KV_EVENT_SUBJECT, "dev"),
+            (KVBM_TIER_SUBJECT, "tier"),
+        ):
+            sub = await self.fabric.subscribe(subject + ".>")
+            self._subs.append(sub)
+            self._tasks.append(loop.create_task(self._pump(sub, kind)))
+
+    async def _pump(self, sub, kind: str) -> None:
+        while True:
+            msg = await sub.next()
+            if msg is None:
+                return
+            try:
+                worker_id = msg.header["instance_id"]
+                if worker_id == self.own_instance_id:
+                    continue
+                events = msgpack.unpackb(msg.payload, raw=False)
+                sets = self._dev if kind == "dev" else self._tier
+                ws = sets.get(worker_id)
+                if ws is None:
+                    ws = sets[worker_id] = _WorkerSet(self.cap)
+                for ev in events:
+                    if ev.get("kind") == "stored":
+                        for h in ev["block_hashes"]:
+                            ws.add(h)
+                    elif ev.get("kind") == "removed":
+                        for h in ev["block_hashes"]:
+                            ws.discard(h)
+            except Exception:
+                logger.exception("bad block-directory event on %s", msg.subject)
+
+    # -- queries -----------------------------------------------------------
+
+    def has_entries(self) -> bool:
+        return any(len(s) for s in self._dev.values()) or any(
+            len(s) for s in self._tier.values()
+        )
+
+    def _servable(self, worker_id: str, h: int) -> bool:
+        dev = self._dev.get(worker_id)
+        if dev is not None and h in dev:
+            return True
+        tier = self._tier.get(worker_id)
+        return tier is not None and h in tier
+
+    def holders(self, h: int) -> list[str]:
+        out = []
+        for w in set(self._dev) | set(self._tier):
+            if self._servable(w, h):
+                out.append(w)
+        return out
+
+    def best_chain(
+        self, seq_hashes: Sequence[int], start: int
+    ) -> Optional[tuple[str, int]]:
+        """Peer that can extend the chain furthest from position `start`:
+        (worker_id, depth). None when nobody holds seq_hashes[start]."""
+        best: Optional[tuple[str, int]] = None
+        for w in self.holders(seq_hashes[start]):
+            depth = 0
+            for h in seq_hashes[start:]:
+                if not self._servable(w, h):
+                    break
+                depth += 1
+            if best is None or depth > best[1]:
+                best = (w, depth)
+        return best
+
+    # -- self-healing ------------------------------------------------------
+
+    def drop(self, worker_id: str, hashes: Sequence[int]) -> None:
+        """A fetch claimed these and missed: forget them for that peer."""
+        for sets in (self._dev, self._tier):
+            ws = sets.get(worker_id)
+            if ws is not None:
+                for h in hashes:
+                    ws.discard(h)
+
+    def retain_workers(self, live: Sequence[str]) -> None:
+        keep = set(live)
+        for sets in (self._dev, self._tier):
+            for w in list(sets):
+                if w not in keep:
+                    del sets[w]
+
+    async def stop(self) -> None:
+        for sub in self._subs:
+            sub.close()
+        for t in self._tasks:
+            t.cancel()

@@ -60,8 +60,13 @@ class TieredPageAllocator(PageAllocator):
         disk_dir: Optional[str] = None,
         on_event=None,
         extract_async_fn: Optional[ExtractFn] = None,
+        on_tier_event: Optional[Callable[[int, Optional[int]], None]] = None,
     ):
         super().__init__(num_pages, page_size, on_event=on_event)
+        #: (seq_hash, parent_hash) -> None, fired when a block lands in a
+        #: lower tier (G4 peers learn this worker can serve it; removals
+        #: self-heal via failed fetches, so only stores are announced)
+        self._on_tier_event = on_tier_event
         self._extract_fn = extract_fn
         self._extract_async_fn = extract_async_fn
         self._inject_fn = inject_fn
@@ -90,12 +95,7 @@ class TieredPageAllocator(PageAllocator):
         todo = []
         for page in pages:
             seq_hash, parent_hash, tokens = self._page_meta[page]
-            in_lower = (
-                seq_hash in self._pending
-                or (self.host is not None and seq_hash in self.host)
-                or (self.disk is not None and seq_hash in self.disk)
-            )
-            if not in_lower:
+            if not self.tier_contains(seq_hash):
                 todo.append((page, seq_hash, parent_hash, tokens))
         if not todo:
             return
@@ -115,6 +115,8 @@ class TieredPageAllocator(PageAllocator):
             ok = self.disk.put(entry)
         if ok:
             self.stats.offloaded_blocks += 1
+            if self._on_tier_event is not None:
+                self._on_tier_event(entry.seq_hash, entry.parent_hash)
 
     def _complete(self, seq_hash: int) -> Optional[BlockEntry]:
         """Materialize one staged offload (np.asarray blocks only until the
@@ -181,6 +183,34 @@ class TieredPageAllocator(PageAllocator):
             return self.disk.get(seq_hash)
         return None
 
+    def tier_contains(self, seq_hash: int) -> bool:
+        return (
+            seq_hash in self._pending
+            or (self.host is not None and seq_hash in self.host)
+            or (self.disk is not None and seq_hash in self.disk)
+        )
+
+    def register_promoted(self, page, seq_hash, parent_hash, tokens) -> None:
+        """Register + drop lower-tier copies (the block lives on device
+        again, tier bytes track unique content) + count the onboard."""
+        self.register(page, seq_hash, parent_hash, tokens)
+        if self.host is not None:
+            self.host.pop(seq_hash)
+        if self.disk is not None:
+            self.disk.pop(seq_hash)
+        self.stats.onboarded_blocks += 1
+
+    def resident_match_length(self, seq_hashes: Sequence[int]) -> int:
+        """Leading blocks resident ANYWHERE locally (device or lower tier)
+        — the probe remote onboarding uses to find where its need starts.
+        No allocation, no LRU movement."""
+        n = self.match_length(seq_hashes)
+        for h in seq_hashes[n:]:
+            if not self.tier_contains(h):
+                break
+            n += 1
+        return n
+
     def lookup(self, seq_hashes: Sequence[int]) -> list[int]:
         pages = super().lookup(seq_hashes)
         if not self._offload_enabled or len(pages) >= len(seq_hashes):
@@ -205,14 +235,7 @@ class TieredPageAllocator(PageAllocator):
             return pages  # pool pressure — skip onboarding this time
         self._inject_fn(fresh, k, v)
         for page, e in zip(fresh, found):
-            self.register(page, e.seq_hash, e.parent_hash, e.tokens)
-            # Promote: the block lives on device again; drop lower copies so
-            # tier bytes track unique content.
-            if self.host is not None:
-                self.host.pop(e.seq_hash)
-            if self.disk is not None:
-                self.disk.pop(e.seq_hash)
-        self.stats.onboarded_blocks += len(found)
+            self.register_promoted(page, e.seq_hash, e.parent_hash, e.tokens)
         self.stats.hit_tokens += len(found) * self.page_size
         pages.extend(fresh)
         return pages

@@ -9,6 +9,10 @@ lib/llm/src/kv_router.rs:48-49).
 #: per-worker KV cache events: kv_events.{instance_id}
 KV_EVENT_SUBJECT = "kv_events"
 
+#: per-worker KVBM lower-tier events (blocks offloaded to host/disk —
+#: still servable to peers over the transfer plane): kvbm_tier.{instance_id}
+KVBM_TIER_SUBJECT = "kvbm_tier"
+
 #: per-worker load metrics: metrics.{component}.{instance_id}
 METRICS_SUBJECT = "metrics"
 

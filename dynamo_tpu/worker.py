@@ -27,7 +27,11 @@ from dynamo_tpu.engine.page_table import KvEvent
 from dynamo_tpu.model_card import ModelDeploymentCard, register_llm
 from dynamo_tpu.preprocessor.preprocessor import PreprocessedRequest
 from dynamo_tpu.runtime import DistributedRuntime, IngressServer
-from dynamo_tpu.subjects import KV_EVENT_SUBJECT, METRICS_SUBJECT
+from dynamo_tpu.subjects import (
+    KV_EVENT_SUBJECT,
+    KVBM_TIER_SUBJECT,
+    METRICS_SUBJECT,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -49,6 +53,9 @@ class Worker:
         disagg_config=None,
         prefill_queue_name: str = "prefill_queue",
         advertise_host: str = "127.0.0.1",
+        kv_remote: bool = False,
+        kv_remote_min_blocks: int = 2,
+        kv_remote_timeout_s: float = 5.0,
     ):
         self.runtime = runtime
         self.card = card
@@ -71,6 +78,15 @@ class Worker:
         self.disagg_router = None
         self.prefill_queue = None
         self.remote_prefills = 0
+        #: G4 remote tier (cross-worker onboarding over the transfer plane)
+        self.kv_remote = kv_remote
+        self.kv_remote_min_blocks = kv_remote_min_blocks
+        self.kv_remote_timeout_s = kv_remote_timeout_s
+        self.kv_directory = None
+        self.remote_onboards = 0
+        self._fetch_client = None
+        self._peer_source = None
+        self._tier_event_buffer: list[tuple[int, Optional[int]]] = []
         self.ingress = IngressServer()
         self.runner: Optional[AsyncEngineRunner] = None
         self.echo: Optional[EchoEngine] = None
@@ -91,7 +107,7 @@ class Worker:
                 MockEngineArgs(
                     page_size=self.card.kv_page_size, salt=self.card.name
                 ),
-                on_kv_event=self._kv_event_buffer.append,
+                on_kv_event=lambda e: self._kv_event_buffer.append(e),
             )
         else:
             # Engine construction (param init, first compiles) blocks for
@@ -101,8 +117,13 @@ class Worker:
                 None,
                 lambda: JaxEngine(
                     self.engine_config,
-                    on_kv_event=self._kv_event_buffer.append,
+                    on_kv_event=lambda e: self._kv_event_buffer.append(e),
                     checkpoint_path=self.checkpoint_path,
+                    on_tier_event=(
+                        (lambda h, p: self._tier_event_buffer.append((h, p)))
+                        if self.kv_remote
+                        else None
+                    ),
                 ),
             )
             self.runner = AsyncEngineRunner(engine)
@@ -114,12 +135,8 @@ class Worker:
         await self.ingress.start()
 
         metadata = {"model": self.card.name}
-        if self.enable_disagg and self.runner is not None:
-            from dynamo_tpu.disagg import (
-                DisaggregatedRouter,
-                KvTransferServer,
-                PrefillQueue,
-            )
+        if (self.enable_disagg or self.kv_remote) and self.runner is not None:
+            from dynamo_tpu.disagg import KvTransferServer
 
             runner = self.runner
 
@@ -133,10 +150,21 @@ class Worker:
                     lambda eng: eng.inject_pages_device(page_ids, k, v)
                 )
 
+            fetch_fn = None
+            if self.kv_remote:
+                async def fetch_fn(seq_hashes):
+                    return await runner.submit(
+                        lambda eng: eng.serve_blocks(seq_hashes)
+                    )
+
             self.transfer_server = KvTransferServer(
-                write_fn, device_write_fn=device_write_fn
+                write_fn, device_write_fn=device_write_fn, fetch_fn=fetch_fn
             )
             await self.transfer_server.start()
+            metadata["kv_transfer_port"] = self.transfer_server.port
+        if self.enable_disagg and self.runner is not None:
+            from dynamo_tpu.disagg import DisaggregatedRouter, PrefillQueue
+
             self.disagg_router = DisaggregatedRouter(
                 self.runtime.fabric, self.disagg_config
             )
@@ -144,7 +172,6 @@ class Worker:
             self.prefill_queue = PrefillQueue(
                 self.runtime.fabric, self.prefill_queue_name
             )
-            metadata["kv_transfer_port"] = self.transfer_server.port
 
         ep = (
             self.runtime.namespace(self.namespace)
@@ -160,6 +187,21 @@ class Worker:
             self.endpoint_name, lease_id=self.runtime.primary_lease,
             router_mode=self.router_mode,
         )
+        if self.kv_remote and self.runner is not None:
+            from dynamo_tpu.disagg.transfer import KvTransferClient
+            from dynamo_tpu.kvbm.directory import BlockDirectory
+            from dynamo_tpu.runtime.component import InstanceSource
+
+            self.kv_directory = BlockDirectory(
+                self.runtime.fabric, own_instance_id=self.instance_id
+            )
+            await self.kv_directory.start()
+            self._fetch_client = KvTransferClient()
+            self._peer_source = InstanceSource(
+                self.runtime.fabric, self.namespace, self.component,
+                self.endpoint_name,
+            )
+            await self._peer_source.start()
         loop = asyncio.get_running_loop()
         self._tasks.append(loop.create_task(self._publish_loop()))
         logger.info(
@@ -210,6 +252,12 @@ class Worker:
             await self.transfer_server.stop()
         if self.disagg_router is not None:
             await self.disagg_router.stop()
+        if self.kv_directory is not None:
+            await self.kv_directory.stop()
+        if self._peer_source is not None:
+            await self._peer_source.stop()
+        if self._fetch_client is not None:
+            self._fetch_client.close()
         if self.runner:
             self.runner.stop()
 
@@ -217,6 +265,11 @@ class Worker:
 
     async def _generate(self, ctx, request: dict):
         pre = PreprocessedRequest.from_dict(request)
+        if self.kv_directory is not None and pre.mm_embeds is None:
+            try:
+                await self._maybe_remote_onboard(pre)
+            except Exception:
+                logger.exception("remote onboard failed; serving cold")
         if self.prefill_queue is not None and await self._want_remote(pre):
             handled = False
             async for event in self._generate_disagg(ctx, pre):
@@ -245,6 +298,73 @@ class Worker:
             "embeddings": [[float(x) for x in v] for v in vecs],
             "prompt_tokens": sum(len(p) for p in prompts),
         }
+
+    # -- G4 remote tier: cross-worker prefix onboarding --------------------
+
+    def _peer_transfer_addr(self, worker_id: str):
+        inst = self._peer_source.instances.get(worker_id)
+        if inst is None:
+            return None
+        port = inst.metadata.get("kv_transfer_port")
+        if not port:
+            return None
+        return inst.host, int(port)
+
+    async def _maybe_remote_onboard(self, pre: PreprocessedRequest) -> None:
+        """Before admission: if a live peer holds more of this prompt's
+        block chain than we do, pull those blocks over the transfer plane
+        and adopt them — the reference's onboard_blocks driven by
+        directory knowledge (block_manager.rs:169). Failures only cost the
+        fetch: the request prefills the cold blocks as usual."""
+        runner = self.runner
+        directory = self.kv_directory
+        if not directory.has_entries():
+            return  # nothing claimable anywhere — skip the engine round trip
+
+        def _probe(eng):
+            from dynamo_tpu.tokens import hash_token_blocks
+
+            hashes = hash_token_blocks(
+                pre.token_ids, block_size=eng.config.page_size,
+                salt=eng.config.model,
+            )
+            return hashes, eng.allocator.resident_match_length(hashes)
+
+        hashes, n_local = await runner.submit(_probe)
+        if n_local >= len(hashes):
+            return
+        best = directory.best_chain(hashes, n_local)
+        if best is None or best[1] < self.kv_remote_min_blocks:
+            return
+        worker_id, depth = best
+        want = hashes[n_local : n_local + depth]
+        addr = self._peer_transfer_addr(worker_id)
+        if addr is None:
+            # Peer is gone, or live but serving no transfer port (not
+            # --kv-remote): drop its claims so we don't re-select it for
+            # this prefix forever, and prune dead workers wholesale.
+            directory.drop(worker_id, want)
+            directory.retain_workers(list(self._peer_source.instances))
+            return
+        try:
+            served = await asyncio.wait_for(
+                self._fetch_client.fetch(*addr, want),
+                self.kv_remote_timeout_s,
+            )
+        except Exception:
+            logger.warning("KV fetch from %s failed", worker_id, exc_info=True)
+            served = None
+        if not served:
+            directory.drop(worker_id, want)  # self-heal the stale claim
+            return
+        metas, k, v = served
+        n = await runner.submit(lambda eng: eng.adopt_blocks(metas, k, v))
+        self.remote_onboards += n
+        if n:
+            logger.info(
+                "onboarded %d blocks for %s from peer %s",
+                n, pre.request_id, worker_id,
+            )
 
     # -- disaggregated path ------------------------------------------------
 
@@ -372,7 +492,12 @@ class Worker:
         fabric = self.runtime.fabric
         while True:
             await asyncio.sleep(self.metrics_interval)
-            events, self._kv_event_buffer = self._kv_event_buffer, []
+            # Drain WITHOUT rebinding: the engine thread appends through a
+            # late-binding callback, but any captured reference must stay
+            # valid — rebinding here once silently severed the event plane
+            # (appends landed in the dead list forever after).
+            events = self._kv_event_buffer[: len(self._kv_event_buffer)]
+            del self._kv_event_buffer[: len(events)]
             if events:
                 payload = msgpack.packb(
                     [
@@ -389,6 +514,25 @@ class Worker:
                 await fabric.publish(
                     f"{KV_EVENT_SUBJECT}.{self.instance_id}",
                     {"instance_id": self.instance_id, "count": len(events)},
+                    payload,
+                )
+            tiered = self._tier_event_buffer[: len(self._tier_event_buffer)]
+            del self._tier_event_buffer[: len(tiered)]
+            if tiered:
+                payload = msgpack.packb(
+                    [
+                        {
+                            "kind": "stored",
+                            "block_hashes": [h],
+                            "parent_hash": p,
+                        }
+                        for h, p in tiered
+                    ],
+                    use_bin_type=True,
+                )
+                await fabric.publish(
+                    f"{KVBM_TIER_SUBJECT}.{self.instance_id}",
+                    {"instance_id": self.instance_id, "count": len(tiered)},
                     payload,
                 )
             m = None
