@@ -588,6 +588,10 @@ def main(argv: Optional[list[str]] = None) -> None:
     fabricp = sub.add_parser("fabric", help="start the fabric server")
     fabricp.add_argument("--host", default="127.0.0.1")
     fabricp.add_argument("--port", type=int, default=4222)
+    fabricp.add_argument(
+        "--persist-dir", default=None, dest="persist_dir",
+        help="WAL directory: state survives server restarts",
+    )
 
     ctlp = sub.add_parser(
         "ctl", help="inspect/edit model + instance registrations (llmctl)"

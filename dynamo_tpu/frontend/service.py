@@ -441,6 +441,14 @@ class ModelWatcher:
             try:
                 if ev.kind == "put":
                     await self._on_put(ev.key, ev.value)
+                elif ev.kind == "reset":
+                    # fabric session re-established: the server replays
+                    # live entries as puts next. Forget entry->key
+                    # bookkeeping so replays rebuild it; attached models
+                    # stay up (their push routers keep serving) and
+                    # truly-deleted entries detach on the next delete or
+                    # when their instances prune.
+                    self._entries.clear()
                 else:
                     await self._on_delete(ev.key)
             except Exception:

@@ -147,6 +147,10 @@ class InstanceSource:
             if ev.kind == "put":
                 inst = Instance.unpack(ev.value)
                 self.instances[inst.instance_id] = inst
+            elif ev.kind == "reset":
+                # reconnected after a fabric outage: current state replays
+                # as puts next — drop instances that may have died meanwhile
+                self.instances.clear()
             else:
                 iid = ev.key.rsplit("/", 1)[-1]
                 self.instances.pop(iid, None)

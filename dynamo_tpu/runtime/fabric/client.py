@@ -1,10 +1,22 @@
-"""RemoteFabric: client to a FabricServer (AbstractFabric over TCP)."""
+"""RemoteFabric: client to a FabricServer (AbstractFabric over TCP).
+
+Survival story: on connection loss the client reconnects with backoff and
+re-establishes its SESSION — leases are reattached under their original
+ids (server op lease.reattach), leased keys are re-put, watches re-created
+(each local Watch first receives a synthetic "reset" event so consumers
+drop state that may have been deleted during the outage — the server
+re-sends current state as puts), and subscriptions re-subscribed. In-flight
+calls during the outage fail fast with FabricConnectionError; callers
+retry or surface the error, matching etcd client semantics (the reference
+leans on etcd's own lease keepalive + re-watch machinery the same way).
+"""
 
 from __future__ import annotations
 
 import asyncio
 import itertools
 import logging
+import random
 from typing import Any, Optional
 
 from dynamo_tpu.runtime.codec import encode_frame, read_frame
@@ -19,33 +31,46 @@ class FabricConnectionError(ConnectionError):
 
 
 class RemoteFabric:
-    def __init__(self, address: str):
+    def __init__(self, address: str, reconnect: bool = True):
         self.address = address
+        self.reconnect = reconnect
         self._reader: Optional[asyncio.StreamReader] = None
         self._writer: Optional[asyncio.StreamWriter] = None
         self._ids = itertools.count(1)
         self._pending: dict[int, asyncio.Future] = {}
         self._watches: dict[int, Watch] = {}
+        self._watch_prefixes: dict[int, str] = {}
         self._subs: dict[int, Subscription] = {}
         self._reader_task: Optional[asyncio.Task] = None
         self._keepalive_task: Optional[asyncio.Task] = None
+        self._reconnect_task: Optional[asyncio.Task] = None
         self._leases: set[str] = set()
+        self._lease_ttls: dict[str, float] = {}
+        #: leased key -> (value, lease_id): the session state re-put on
+        #: reconnect (liveness registrations, model entries)
+        self._restorable: dict[str, tuple[bytes, Optional[str]]] = {}
         self._send_lock = asyncio.Lock()
+        self._closed = False
 
     @classmethod
-    async def connect(cls, address: str) -> "RemoteFabric":
-        self = cls(address)
-        host, port = address.rsplit(":", 1)
+    async def connect(
+        cls, address: str, reconnect: bool = True
+    ) -> "RemoteFabric":
+        self = cls(address, reconnect=reconnect)
+        await self._open()
+        return self
+
+    async def _open(self) -> None:
+        host, port = self.address.rsplit(":", 1)
         try:
             self._reader, self._writer = await asyncio.open_connection(
                 host, int(port)
             )
         except OSError as e:
-            raise FabricConnectionError(f"cannot reach fabric at {address}: {e}")
+            raise FabricConnectionError(f"cannot reach fabric at {self.address}: {e}")
         self._reader_task = asyncio.get_running_loop().create_task(
             self._read_loop()
         )
-        return self
 
     async def _read_loop(self) -> None:
         try:
@@ -65,10 +90,60 @@ class RemoteFabric:
                 if not fut.done():
                     fut.set_exception(err)
             self._pending.clear()
-            for w in list(self._watches.values()):
-                w.close()
-            for s in list(self._subs.values()):
-                s.close()
+            if self._closed or not self.reconnect:
+                for w in list(self._watches.values()):
+                    w.close()
+                for s in list(self._subs.values()):
+                    s.close()
+            elif self._reconnect_task is None or self._reconnect_task.done():
+                self._reconnect_task = asyncio.get_running_loop().create_task(
+                    self._reconnect_loop()
+                )
+
+    # -- session re-establishment ------------------------------------------
+
+    async def _reconnect_loop(self) -> None:
+        delay = 0.2
+        while not self._closed:
+            await asyncio.sleep(delay * (0.7 + 0.6 * random.random()))
+            delay = min(delay * 1.7, 2.0)
+            try:
+                await self._open()
+                await self._reestablish()
+            except Exception:
+                if self._writer is not None:
+                    self._writer.close()
+                continue
+            logger.info("fabric session re-established with %s", self.address)
+            return
+
+    async def _reestablish(self) -> None:
+        for lease in list(self._leases):
+            await self._call(
+                {
+                    "op": "lease.reattach", "lease": lease,
+                    "ttl": self._lease_ttls.get(lease, 3.0),
+                }
+            )
+        for key, (value, lease) in list(self._restorable.items()):
+            await self._call(
+                {"op": "kv.put", "key": key, "lease": lease}, value
+            )
+        for watch_id, prefix in list(self._watch_prefixes.items()):
+            w = self._watches.get(watch_id)
+            if w is None or w._closed:
+                continue
+            # reset BEFORE re-watching: the server replays current state
+            # as puts; consumers drop anything deleted during the outage
+            w._push(WatchEvent("reset", ""))
+            await self._call(
+                {"op": "kv.watch", "prefix": prefix, "watch_id": watch_id}
+            )
+        for sub_id, s in list(self._subs.items()):
+            if not s._closed:
+                await self._call(
+                    {"op": "bus.sub", "subject": s.subject, "sub_id": sub_id}
+                )
 
     def _handle_push(self, h: Any, payload: bytes) -> None:
         if h["push"] == "watch":
@@ -103,11 +178,17 @@ class RemoteFabric:
 
     async def put(self, key, value, lease_id=None):
         await self._call({"op": "kv.put", "key": key, "lease": lease_id}, value)
+        if lease_id is not None:
+            self._restorable[key] = (bytes(value), lease_id)
+        else:
+            self._restorable.pop(key, None)  # unleased put unbinds the key
 
     async def create(self, key, value, lease_id=None):
         h, _ = await self._call(
             {"op": "kv.create", "key": key, "lease": lease_id}, value
         )
+        if h["created"] and lease_id is not None:
+            self._restorable[key] = (bytes(value), lease_id)
         return h["created"]
 
     async def get(self, key):
@@ -119,6 +200,7 @@ class RemoteFabric:
         return h["items"]
 
     async def delete(self, key):
+        self._restorable.pop(key, None)
         h, _ = await self._call({"op": "kv.delete", "key": key})
         return h["deleted"]
 
@@ -126,6 +208,7 @@ class RemoteFabric:
         watch_id = next(self._ids)
         w = Watch()
         self._watches[watch_id] = w
+        self._watch_prefixes[watch_id] = prefix
         await self._call(
             {"op": "kv.watch", "prefix": prefix, "watch_id": watch_id}
         )
@@ -136,6 +219,7 @@ class RemoteFabric:
         def close_with_unwatch():
             orig_close()
             self._watches.pop(watch_id, None)
+            self._watch_prefixes.pop(watch_id, None)
             if self._writer is not None and not self._writer.is_closing():
                 asyncio.get_running_loop().create_task(self._unwatch(watch_id))
 
@@ -153,6 +237,7 @@ class RemoteFabric:
     async def grant_lease(self, ttl):
         h, _ = await self._call({"op": "lease.grant", "ttl": ttl})
         self._leases.add(h["lease"])
+        self._lease_ttls[h["lease"]] = ttl
         self._ensure_keepalive(ttl)
         return h["lease"]
 
@@ -162,6 +247,11 @@ class RemoteFabric:
 
     async def revoke_lease(self, lease_id):
         self._leases.discard(lease_id)
+        self._lease_ttls.pop(lease_id, None)
+        for key in [
+            k for k, (_, l) in self._restorable.items() if l == lease_id
+        ]:
+            del self._restorable[key]
         await self._call({"op": "lease.revoke", "lease": lease_id})
 
     def _ensure_keepalive(self, ttl: float) -> None:
@@ -176,9 +266,42 @@ class RemoteFabric:
                 await asyncio.sleep(interval)
                 for lease in list(self._leases):
                     try:
-                        await self.keepalive(lease)
+                        alive = await self.keepalive(lease)
                     except Exception:
                         logger.warning("keepalive failed for %s", lease)
+                        continue
+                    if not alive:
+                        # Lease vanished server-side (expired during an
+                        # outage, or revoked by a stale connection's
+                        # cleanup): re-establish it and restore its keys
+                        # instead of silently disappearing from discovery.
+                        logger.warning(
+                            "lease %s lost; reattaching + restoring keys",
+                            lease,
+                        )
+                        try:
+                            await self._call(
+                                {
+                                    "op": "lease.reattach", "lease": lease,
+                                    "ttl": self._lease_ttls.get(lease, 3.0),
+                                }
+                            )
+                            for key, (value, l) in list(
+                                self._restorable.items()
+                            ):
+                                if l == lease:
+                                    await self._call(
+                                        {
+                                            "op": "kv.put", "key": key,
+                                            "lease": lease,
+                                        },
+                                        value,
+                                    )
+                        except Exception:
+                            logger.warning(
+                                "lease %s recovery failed", lease,
+                                exc_info=True,
+                            )
         except asyncio.CancelledError:
             pass
 
@@ -253,6 +376,9 @@ class RemoteFabric:
         return bool(h.get("ok"))
 
     async def close(self):
+        self._closed = True
+        if self._reconnect_task:
+            self._reconnect_task.cancel()
         if self._keepalive_task:
             self._keepalive_task.cancel()
         if self._reader_task:

@@ -72,6 +72,9 @@ class LocalFabric:
     async def keepalive(self, lease_id):
         return await self.store.keepalive(lease_id)
 
+    async def reattach_lease(self, lease_id, ttl):
+        await self.store.reattach_lease(lease_id, ttl)
+
     async def revoke_lease(self, lease_id):
         await self.store.revoke_lease(lease_id)
 
@@ -93,8 +96,10 @@ class LocalFabric:
     def _q(self, name: str) -> _LocalQueue:
         return self._queues.setdefault(name, _LocalQueue())
 
-    async def queue_push(self, queue, header, payload=b""):
-        self._q(queue).push(QueueItem(uuid.uuid4().hex, header, payload))
+    async def queue_push(self, queue, header, payload=b"") -> QueueItem:
+        item = QueueItem(uuid.uuid4().hex, header, payload)
+        self._q(queue).push(item)
+        return item
 
     async def queue_pop(self, queue, timeout=None):
         q = self._q(queue)

@@ -1,0 +1,228 @@
+"""Fabric persistence: append-only WAL + startup compaction.
+
+The reference's control plane survives restarts because etcd raft-persists
+every write and JetStream journals queue items. This gives the single
+fabric server the same survival story at its scale: every mutation is
+appended to a WAL (codec-framed records, so a torn tail from a crash is
+detected by checksum and dropped); startup replays the log, then compacts
+it to a fresh snapshot-as-WAL. Leases are restored in an ORPHANED state —
+deadline = now + max(ttl, orphan_grace) — giving their owners a reconnect
+window (lease.reattach) before expiry deletes their keys, which is exactly
+etcd's lease-TTL-survives-restart behavior (transports/etcd.rs:78).
+
+Durability trade: records are flushed (OS buffer) but not fsync'd per
+record — a host power loss can drop the tail; a process crash cannot.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+from dynamo_tpu.runtime.codec import CodecError, decode_frame, encode_frame
+from dynamo_tpu.runtime.fabric.base import QueueItem
+from dynamo_tpu.runtime.fabric.local import LocalFabric
+
+logger = logging.getLogger(__name__)
+
+WAL_NAME = "fabric.wal"
+#: reconnect window for lease owners after a server restart
+DEFAULT_ORPHAN_GRACE = 10.0
+#: compact when the WAL holds this many records beyond live state
+COMPACT_SLACK = 5000
+
+
+class PersistentFabric(LocalFabric):
+    """LocalFabric journaling every mutation to a WAL under `directory`."""
+
+    def __init__(
+        self, directory: str, orphan_grace: float = DEFAULT_ORPHAN_GRACE
+    ):
+        super().__init__()
+        self.directory = directory
+        self.orphan_grace = orphan_grace
+        os.makedirs(directory, exist_ok=True)
+        self._path = os.path.join(directory, WAL_NAME)
+        self._wal = None
+        self._records = 0
+
+    # -- journal -----------------------------------------------------------
+
+    def _append(self, header: dict, payload: bytes = b"") -> None:
+        if self._wal is None:
+            return
+        self._wal.write(encode_frame(header, payload))
+        self._wal.flush()
+        self._records += 1
+
+    async def load_and_open(self) -> None:
+        """Replay an existing WAL, then compact and start journaling."""
+        records = []
+        if os.path.exists(self._path):
+            with open(self._path, "rb") as f:
+                buf = f.read()
+            off = 0
+            while off < len(buf):
+                try:
+                    h, p, used = decode_frame(buf[off:])
+                except CodecError:
+                    logger.warning(
+                        "WAL tail truncated at byte %d (%d bytes dropped)",
+                        off, len(buf) - off,
+                    )
+                    break
+                records.append((h, p))
+                off += used
+        await self._replay(records)
+        await self._compact()
+
+    async def _replay(self, records) -> None:
+        import time
+
+        for h, p in records:
+            op = h["r"]
+            try:
+                if op == "lease":
+                    # restore the id verbatim; deadline set below
+                    self.store._leases[h["lease"]] = 0.0
+                    self.store._lease_ttl[h["lease"]] = h["ttl"]
+                    self.store._lease_keys.setdefault(h["lease"], set())
+                elif op == "lease_rm":
+                    await self.store.revoke_lease(h["lease"])
+                elif op == "put":
+                    await self.store.put(h["key"], p, h.get("lease"))
+                elif op == "del":
+                    await self.store.delete(h["key"])
+                elif op == "qpush":
+                    self._q(h["queue"]).push(
+                        QueueItem(h["item"], h.get("header"), p)
+                    )
+                elif op == "qack":
+                    q = self._q(h["queue"])
+                    q.inflight.pop(h["item"], None)
+                    for i, item in enumerate(q.items):
+                        if item.item_id == h["item"]:
+                            del q.items[i]
+                            break
+                elif op == "oput":
+                    self._objects[h["name"]] = bytes(p)
+                elif op == "odel":
+                    self._objects.pop(h["name"], None)
+            except Exception:
+                logger.exception("WAL replay failed for %r", h)
+        # Orphan every restored lease: owners get a reconnect window, then
+        # normal expiry semantics delete their keys.
+        now = time.monotonic()
+        for lease_id, ttl in self.store._lease_ttl.items():
+            self.store._leases[lease_id] = now + max(ttl, self.orphan_grace)
+        if records:
+            self.store._ensure_reaper()
+            logger.info(
+                "fabric WAL replayed: %d records, %d keys, %d leases, "
+                "%d queues, %d objects",
+                len(records), len(self.store._data), len(self.store._leases),
+                len(self._queues), len(self._objects),
+            )
+
+    async def _compact(self) -> None:
+        """Rewrite the WAL as current state (snapshot-as-WAL)."""
+        tmp = self._path + ".tmp"
+        with open(tmp, "wb") as f:
+            for lease_id, ttl in self.store._lease_ttl.items():
+                f.write(encode_frame({"r": "lease", "lease": lease_id, "ttl": ttl}))
+            for key, e in self.store._data.items():
+                f.write(
+                    encode_frame(
+                        {"r": "put", "key": key, "lease": e.lease_id}, e.value
+                    )
+                )
+            for name, q in self._queues.items():
+                # inflight items were never acked: restore them as pending
+                for item in list(q.inflight.values()) + list(q.items):
+                    f.write(
+                        encode_frame(
+                            {"r": "qpush", "queue": name, "item": item.item_id,
+                             "header": item.header},
+                            item.payload,
+                        )
+                    )
+            for name, data in self._objects.items():
+                f.write(encode_frame({"r": "oput", "name": name}, data))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._path)
+        if self._wal is not None:
+            self._wal.close()
+        self._wal = open(self._path, "ab")
+        self._records = 0
+
+    async def _maybe_compact(self) -> None:
+        if self._records >= COMPACT_SLACK:
+            await self._compact()
+
+    # -- journaled mutations ----------------------------------------------
+
+    async def put(self, key, value, lease_id=None):
+        await super().put(key, value, lease_id)
+        self._append({"r": "put", "key": key, "lease": lease_id}, value)
+        await self._maybe_compact()
+
+    async def create(self, key, value, lease_id=None):
+        created = await super().create(key, value, lease_id)
+        if created:
+            self._append({"r": "put", "key": key, "lease": lease_id}, value)
+            await self._maybe_compact()
+        return created
+
+    async def delete(self, key):
+        deleted = await super().delete(key)
+        if deleted:
+            self._append({"r": "del", "key": key})
+        return deleted
+
+    async def grant_lease(self, ttl):
+        lease = await super().grant_lease(ttl)
+        self._append({"r": "lease", "lease": lease, "ttl": ttl})
+        return lease
+
+    async def reattach_lease(self, lease_id: str, ttl: float) -> None:
+        """Re-establish a lease by id after a restart (or create it if the
+        orphan window already expired — the owner re-puts its keys next)."""
+        if await self.store.reattach_lease(lease_id, ttl):
+            self._append({"r": "lease", "lease": lease_id, "ttl": ttl})
+
+    async def revoke_lease(self, lease_id):
+        await super().revoke_lease(lease_id)
+        self._append({"r": "lease_rm", "lease": lease_id})
+
+    async def queue_push(self, queue, header, payload=b""):
+        item = await super().queue_push(queue, header, payload)
+        self._append(
+            {"r": "qpush", "queue": queue, "item": item.item_id,
+             "header": header},
+            payload,
+        )
+        await self._maybe_compact()
+        return item
+
+    async def queue_ack(self, queue, item_id):
+        await super().queue_ack(queue, item_id)
+        self._append({"r": "qack", "queue": queue, "item": item_id})
+
+    async def obj_put(self, name, data):
+        await super().obj_put(name, data)
+        self._append({"r": "oput", "name": name}, bytes(data))
+        await self._maybe_compact()
+
+    async def obj_delete(self, name):
+        deleted = await super().obj_delete(name)
+        if deleted:
+            self._append({"r": "odel", "name": name})
+        return deleted
+
+    async def close(self):
+        await super().close()
+        if self._wal is not None:
+            self._wal.close()
+            self._wal = None

@@ -42,14 +42,26 @@ class _Conn:
 
 
 class FabricServer:
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        persist_dir: Optional[str] = None,
+    ):
         self.host = host
         self.port = port
-        self.fabric = LocalFabric()
+        if persist_dir:
+            from dynamo_tpu.runtime.fabric.persist import PersistentFabric
+
+            self.fabric = PersistentFabric(persist_dir)
+        else:
+            self.fabric = LocalFabric()
         self._server: Optional[asyncio.Server] = None
         self._conns: set[_Conn] = set()
 
     async def start(self) -> None:
+        if hasattr(self.fabric, "load_and_open"):
+            await self.fabric.load_and_open()
         self._server = await asyncio.start_server(
             self._handle, self.host, self.port
         )
@@ -148,6 +160,20 @@ class FabricServer:
                 lease = await f.grant_lease(h["ttl"])
                 conn.leases.add(lease)
                 await conn.send({"id": rid, "ok": True, "lease": lease})
+            elif op == "lease.reattach":
+                # Post-restart/reconnect session re-establishment:
+                # re-create (or refresh) the client's lease under its
+                # ORIGINAL id so its re-puts keep their liveness binding.
+                await f.reattach_lease(h["lease"], h["ttl"])
+                # The lease now belongs to THIS connection. Disown it from
+                # any lingering half-dead connection of the same client —
+                # otherwise that conn's eventual _cleanup would revoke the
+                # reattached lease and silently delete every re-put key.
+                for other in self._conns:
+                    if other is not conn:
+                        other.leases.discard(h["lease"])
+                conn.leases.add(h["lease"])
+                await conn.send({"id": rid, "ok": True})
             elif op == "lease.keepalive":
                 ok = await f.keepalive(h["lease"])
                 await conn.send({"id": rid, "ok": True, "alive": ok})
@@ -252,7 +278,7 @@ class FabricServer:
 
 
 async def _amain(args) -> None:
-    server = FabricServer(args.host, args.port)
+    server = FabricServer(args.host, args.port, persist_dir=args.persist_dir)
     await server.start()
     print(f"fabric listening on {server.address}", flush=True)
     await asyncio.Event().wait()
@@ -262,6 +288,10 @@ def main() -> None:
     p = argparse.ArgumentParser(description="dynamo-tpu fabric server")
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=4222)
+    p.add_argument(
+        "--persist-dir", default=None, dest="persist_dir",
+        help="WAL directory: state survives server restarts",
+    )
     args = p.parse_args()
     logging.basicConfig(level=logging.INFO)
     asyncio.run(_amain(args))

@@ -20,7 +20,10 @@ from typing import AsyncIterator, Literal, Optional, Protocol
 
 @dataclass(frozen=True)
 class WatchEvent:
-    kind: Literal["put", "delete"]
+    #: "reset" = the watch's channel re-established after an outage:
+    #: consumers drop derived state; the server replays current state as
+    #: puts immediately after
+    kind: Literal["put", "delete", "reset"]
     key: str
     value: Optional[bytes] = None
 
@@ -187,6 +190,18 @@ class MemStore:
         if lease_id not in self._leases:
             return False
         self._leases[lease_id] = time.monotonic() + self._lease_ttl[lease_id]
+        return True
+
+    async def reattach_lease(self, lease_id: str, ttl: float) -> bool:
+        """Re-establish a lease under its ORIGINAL id after a restart or
+        reconnect; True when it had to be re-created (the owner should
+        re-put its keys)."""
+        if await self.keepalive(lease_id):
+            return False
+        self._ensure_reaper()
+        self._leases[lease_id] = time.monotonic() + ttl
+        self._lease_ttl[lease_id] = ttl
+        self._lease_keys.setdefault(lease_id, set())
         return True
 
     async def revoke_lease(self, lease_id: str) -> None:

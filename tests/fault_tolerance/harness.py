@@ -72,17 +72,21 @@ def _cli(*args: str) -> list[str]:
 class Cluster:
     """fabric + OpenAI frontend + N echo workers on one model."""
 
-    def __init__(self, num_workers: int = 2, model: str = "tiny"):
+    def __init__(
+        self, num_workers: int = 2, model: str = "tiny",
+        fabric_persist: bool = False,
+    ):
         self.model = model
         self.fabric_port = _free_port()
         self.http_port = _free_port()
         self.fabric = None
         self.frontend = None
         self.workers: list[ManagedProc] = []
+        self.persist_dir = (
+            tempfile.mkdtemp(prefix="fabric-wal-") if fabric_persist else None
+        )
         try:
-            self.fabric = ManagedProc(
-                "fabric", _cli("fabric", "--port", str(self.fabric_port))
-            )
+            self.fabric = ManagedProc("fabric", self._fabric_argv())
             self.fabric.wait_for("fabric server on|listening", timeout=20)
             for _ in range(num_workers):
                 self.add_worker()
@@ -101,6 +105,18 @@ class Cluster:
             # (the fixture never gets a Cluster object to stop()).
             self.stop()
             raise
+
+    def _fabric_argv(self) -> list[str]:
+        argv = _cli("fabric", "--port", str(self.fabric_port))
+        if self.persist_dir:
+            argv += ["--persist-dir", self.persist_dir]
+        return argv
+
+    def restart_fabric(self) -> None:
+        """Bring the fabric back on the SAME port (same WAL when
+        persistent); clients re-establish their sessions on their own."""
+        self.fabric = ManagedProc("fabric", self._fabric_argv())
+        self.fabric.wait_for("fabric server on|listening", timeout=20)
 
     def add_worker(self) -> ManagedProc:
         w = ManagedProc(
