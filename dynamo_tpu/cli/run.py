@@ -682,6 +682,11 @@ def main(argv: Optional[list[str]] = None) -> None:
         help="address this router advertises to frontends (must be "
              "routable from other machines in multi-host deployments)",
     )
+    routerp.add_argument(
+        "--shards", type=int, default=1,
+        help="index shards (each with its own event pump thread) — scale "
+             "event application past one pump at high fleet event rates",
+    )
 
     metricsp = sub.add_parser("metrics", help="Prometheus metrics service")
     metricsp.add_argument("--fabric", required=True, help="fabric host:port")

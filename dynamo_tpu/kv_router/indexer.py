@@ -258,6 +258,141 @@ def make_radix_tree():
     return RadixTree()
 
 
+class KvIndexerSharded:
+    """Worker-sharded index: N independent trees, each owning a subset of
+    workers (hash of worker id), each with its OWN event queue drained by
+    its own thread — native tree calls release the GIL, so event
+    application parallelizes across shards once event rates outgrow one
+    pump (reference: KvIndexerSharded — indexer.rs:696).
+
+    Queries fan out to every shard and merge: per-worker scores live in
+    exactly one shard, so the merge is a dict union; matched_blocks is the
+    max across shards."""
+
+    def __init__(self, fabric, num_shards: int = 4, subject: str = KV_EVENT_SUBJECT):
+        import queue as _queue
+        import threading
+
+        if num_shards < 1:
+            raise ValueError("num_shards must be >= 1")
+        self.fabric = fabric
+        self.subject = subject
+        self.num_shards = num_shards
+        self.trees = [make_radix_tree() for _ in range(num_shards)]
+        #: one lock per shard: serializes that shard's apply (drain thread)
+        #: against queries (event-loop thread) — the native tree has no
+        #: internal locking, and ctypes releases the GIL during calls.
+        #: Cross-shard applies still run in parallel, which is the point.
+        self._locks = [threading.Lock() for _ in range(num_shards)]
+        self._queues = [_queue.SimpleQueue() for _ in range(num_shards)]
+        self._busy = [False] * num_shards
+        self._applied = [0] * num_shards  # per-shard: no cross-thread +=
+        self._threads = [
+            threading.Thread(
+                target=self._drain, args=(i,), daemon=True,
+                name=f"kv-indexer-shard-{i}",
+            )
+            for i in range(num_shards)
+        ]
+        self._sub = None
+        self._task: Optional[asyncio.Task] = None
+        self._on_event_hooks = []
+
+    @property
+    def events_applied(self) -> int:
+        return sum(self._applied)
+
+    def _shard_of(self, worker_id: str) -> int:
+        import zlib
+
+        return zlib.crc32(worker_id.encode()) % self.num_shards
+
+    async def start(self) -> None:
+        for t in self._threads:
+            t.start()
+        self._sub = await self.fabric.subscribe(self.subject + ".>")
+        self._task = asyncio.get_running_loop().create_task(self._pump())
+
+    async def _pump(self) -> None:
+        while True:
+            msg = await self._sub.next()
+            if msg is None:
+                for q in self._queues:
+                    q.put(None)
+                return
+            try:
+                worker_id = msg.header["instance_id"]
+                events = msgpack.unpackb(msg.payload, raw=False)
+                self._queues[self._shard_of(worker_id)].put(
+                    (worker_id, events)
+                )
+                for ev in events:
+                    for hook in self._on_event_hooks:
+                        hook(worker_id, ev, time.monotonic())
+            except Exception:
+                logger.exception("bad kv event message on %s", msg.subject)
+
+    def _drain(self, shard: int) -> None:
+        q, tree, lock = self._queues[shard], self.trees[shard], self._locks[shard]
+        while True:
+            item = q.get()
+            if item is None:
+                return
+            self._busy[shard] = True
+            try:
+                worker_id, events = item
+                with lock:
+                    for ev in events:
+                        try:
+                            tree.apply_event(worker_id, ev)
+                        except Exception:
+                            logger.exception("shard %d apply failed", shard)
+                self._applied[shard] += len(events)
+            finally:
+                self._busy[shard] = False
+
+    def add_event_hook(self, hook) -> None:
+        self._on_event_hooks.append(hook)
+
+    def find_matches(self, seq_hashes: Sequence[int]) -> OverlapScores:
+        out = OverlapScores()
+        for tree, lock in zip(self.trees, self._locks):
+            with lock:
+                part = tree.find_matches(seq_hashes)
+            out.scores.update(part.scores)
+            out.matched_blocks = max(out.matched_blocks, part.matched_blocks)
+        return out
+
+    def workers(self) -> set:
+        out: set = set()
+        for tree, lock in zip(self.trees, self._locks):
+            with lock:
+                out |= tree.workers()
+        return out
+
+    def remove_worker(self, worker_id: str) -> int:
+        shard = self._shard_of(worker_id)
+        with self._locks[shard]:
+            return self.trees[shard].remove_worker(worker_id)
+
+    async def drain_for_tests(self, timeout: float = 2.0) -> None:
+        """Wait until every shard queue is empty AND no apply is mid-flight
+        (a popped batch is invisible to q.empty())."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if all(q.empty() for q in self._queues) and not any(self._busy):
+                return
+            await asyncio.sleep(0.005)
+
+    async def stop(self) -> None:
+        if self._sub is not None:
+            self._sub.close()
+        if self._task is not None:
+            self._task.cancel()
+        for q in self._queues:
+            q.put(None)
+
+
 class KvIndexer:
     """Event-driven index: subscribes `kv_events.>` on the fabric and keeps
     a RadixTree current (reference: KvIndexer — indexer.rs:518, fed from the
@@ -296,6 +431,9 @@ class KvIndexer:
 
     def find_matches(self, seq_hashes: Sequence[int]) -> OverlapScores:
         return self.tree.find_matches(seq_hashes)
+
+    def workers(self) -> set:
+        return self.tree.workers()
 
     def remove_worker(self, worker_id: str) -> int:
         return self.tree.remove_worker(worker_id)

@@ -43,6 +43,7 @@ class KvRouter:
         salt: str,
         config: Optional[KvRouterConfig] = None,
         selector=None,
+        indexer_shards: int = 1,
     ):
         self.fabric = fabric
         self.component = component
@@ -51,7 +52,12 @@ class KvRouter:
         self.salt = salt
         self.config = config or KvRouterConfig()
         self.selector = selector or DefaultWorkerSelector(self.config)
-        self.indexer = KvIndexer(fabric)
+        if indexer_shards > 1:
+            from dynamo_tpu.kv_router.indexer import KvIndexerSharded
+
+            self.indexer = KvIndexerSharded(fabric, num_shards=indexer_shards)
+        else:
+            self.indexer = KvIndexer(fabric)
         self.metrics = MetricsAggregator(fabric, component)
         self.active = ActiveSequences(block_size)
         self._prune_task: Optional[asyncio.Task] = None
@@ -72,7 +78,7 @@ class KvRouter:
             await asyncio.sleep(interval)
             live = {i.instance_id for i in self.source.list()}
             known = (
-                self.indexer.tree.workers()
+                self.indexer.workers()
                 | set(self.metrics.snapshot())
                 | self.active.workers()
             )

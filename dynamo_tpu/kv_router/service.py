@@ -35,6 +35,7 @@ class RouterService:
         salt: str = "",
         config: Optional[KvRouterConfig] = None,
         advertise_host: str = "127.0.0.1",
+        indexer_shards: int = 1,
     ):
         self.runtime = runtime
         self.namespace = namespace
@@ -43,6 +44,7 @@ class RouterService:
         self.block_size = block_size
         self.salt = salt
         self.config = config
+        self.indexer_shards = indexer_shards
         self.advertise_host = advertise_host
         self.router: Optional[KvRouter] = None
         bind = (
@@ -68,6 +70,7 @@ class RouterService:
             block_size=self.block_size,
             salt=self.salt,
             config=self.config,
+            indexer_shards=self.indexer_shards,
         )
         await self.router.start()
         self.ingress.add_handler("choose", self._choose)
@@ -141,6 +144,7 @@ async def run_router(args) -> None:
         block_size=args.block_size,
         salt=args.salt,
         advertise_host=args.host,
+        indexer_shards=getattr(args, "shards", 1),
     )
     await svc.start()
     print(f"router {svc.instance_id} up", flush=True)

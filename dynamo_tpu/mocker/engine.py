@@ -1,18 +1,31 @@
 """Mock engine: a zero-hardware stand-in worker.
 
 Simulates a paged-KV continuous-batching engine faithfully enough to test
-routing and observability with no TPU: it runs a real PageAllocator (so
-prefix caching, eviction, and KV events are REAL — same code as JaxEngine),
-simulated prefill/decode timing, and deterministic token output (reference:
-the mocker component — lib/llm/src/mocker/engine.rs:60, kv_manager.rs:121,
-protocols.rs MockEngineArgs :72).
+routing, planner, and capacity behavior with no TPU. Unlike a
+sleep-per-request fake, this runs the reference mocker's actual shape
+(lib/llm/src/mocker/engine.rs:60, scheduler.rs:197, kv_manager.rs:121):
+
+- one BATCHED step loop ticks every `decode_s_per_step`; all running
+  requests advance together (continuous batching), so fleet-level load,
+  queueing, and latency under concurrency are simulated, not faked;
+- a real PageAllocator backs the KV pool — prefix caching, eviction, and
+  KV events are REAL (same code as JaxEngine);
+- admission is WATERMARK-gated (kv_manager.rs watermark checks): a request
+  only joins the batch if its pages fit while keeping `watermark` of the
+  pool free; otherwise it queues (visible as num_waiting to the planner);
+- prefill is chunked under a shared per-tick token budget, so long prompts
+  cost proportional ticks and delay TTFT realistically; cached prefix
+  blocks are free;
+- decode growth that can't get a page PREEMPTS the request back to the
+  queue (pages freed), the reference scheduler's block-exhaustion path.
 """
 
 from __future__ import annotations
 
 import asyncio
 import hashlib
-from dataclasses import dataclass
+from collections import deque
+from dataclasses import dataclass, field
 from typing import Callable, Optional
 
 from dynamo_tpu.engine.page_table import KvEvent, PageAllocator
@@ -24,11 +37,33 @@ from dynamo_tpu.tokens import TokenBlockSequence
 class MockEngineArgs:
     num_pages: int = 256
     page_size: int = 16
-    #: simulated seconds per prefill token / per decode step
-    prefill_s_per_token: float = 0.0001
+    #: simulated step tick (all running requests produce one token per tick)
     decode_s_per_step: float = 0.002
+    #: shared chunked-prefill token budget per tick (scheduler.rs batching)
+    prefill_tokens_per_step: int = 512
+    #: max concurrently-running requests (batch cap)
+    max_batch: int = 32
+    #: fraction of the pool kept free at admission (kv_manager watermark)
+    watermark: float = 0.05
     vocab_size: int = 256
     salt: str = "mock"
+    #: legacy knob kept for compat: folded into the prefill budget model
+    prefill_s_per_token: float = 0.0
+
+
+@dataclass
+class _Req:
+    request: PreprocessedRequest
+    context: object
+    chain: TokenBlockSequence
+    hashes: list
+    out_q: asyncio.Queue
+    pages: list = field(default_factory=list)
+    cached_blocks: int = 0
+    prefill_left: int = 0  # uncached prompt tokens still to prefill
+    history: list = field(default_factory=list)
+    produced: int = 0
+    preemptions: int = 0
 
 
 class MockEngine:
@@ -43,10 +78,26 @@ class MockEngine:
         )
         self.active_requests = 0
         self.requests_received = 0
+        self.preemptions = 0
+        self._waiting: deque[_Req] = deque()
+        self._running: list[_Req] = []
+        self._loop_task: Optional[asyncio.Task] = None
+
+    # -- queue visibility (planner/metrics) --------------------------------
+
+    @property
+    def num_waiting(self) -> int:
+        return len(self._waiting)
+
+    @property
+    def num_running(self) -> int:
+        return len(self._running)
 
     def _next_token(self, history: list[int]) -> int:
         h = hashlib.blake2b(bytes(str(history[-8:]), "utf-8"), digest_size=4)
         return int.from_bytes(h.digest(), "little") % self.args.vocab_size
+
+    # -- public API ---------------------------------------------------------
 
     async def generate(self, context, request: PreprocessedRequest):
         a = self.args
@@ -55,59 +106,192 @@ class MockEngine:
         chain = TokenBlockSequence(
             request.token_ids, block_size=a.page_size, salt=a.salt
         )
-        hashes = chain.sequence_hashes()
-        cached = self.allocator.lookup(hashes)
-        need = -(-(len(request.token_ids) + 1) // a.page_size) - len(cached)
-        pages = self.allocator.allocate(max(need, 0)) or []
-        all_pages = cached + pages
+        req = _Req(
+            request=request,
+            context=context,
+            chain=chain,
+            hashes=list(chain.sequence_hashes()),
+            out_q=asyncio.Queue(),
+            history=list(request.token_ids),
+        )
+        self._waiting.append(req)
+        self._ensure_loop()
         try:
-            # simulated prefill (cached prefix is free)
-            uncached = len(request.token_ids) - len(cached) * a.page_size
-            await asyncio.sleep(max(uncached, 0) * a.prefill_s_per_token)
-            # register the prompt's full blocks for prefix reuse (and so KV
-            # events cover the prompt, which is what routing matches on)
-            for bi in range(len(cached), len(chain.blocks)):
-                if bi < len(all_pages):
-                    blk = chain.blocks[bi]
-                    self.allocator.register(
-                        all_pages[bi],
-                        blk.sequence_hash,
-                        blk.parent_sequence_hash,
-                        blk.tokens,
-                    )
-            history = list(request.token_ids)
-            produced = 0
-            while produced < request.max_tokens:
-                if context.cancelled:
+            while True:
+                item = await req.out_q.get()
+                if item is None:
                     return
-                await asyncio.sleep(a.decode_s_per_step)
-                tok = self._next_token(history)
-                history.append(tok)
-                committed = chain.append(tok)
-                if committed is not None:
-                    # register the newly-filled page for prefix reuse
-                    page_idx = committed.block_index
-                    if page_idx < len(all_pages):
-                        self.allocator.register(
-                            all_pages[page_idx],
-                            committed.sequence_hash,
-                            committed.parent_sequence_hash,
-                            committed.tokens,
-                        )
-                    grown = self.allocator.allocate(1)
-                    if grown:
-                        all_pages.extend(grown)
-                produced += 1
-                stop = (
-                    not request.ignore_eos and tok in request.stop_token_ids
-                ) or produced >= request.max_tokens
-                yield {
-                    "token_ids": [tok],
-                    "finish_reason": ("stop" if tok in request.stop_token_ids else "length") if stop else None,
-                }
-                if stop:
-                    return
+                yield item
         finally:
             self.active_requests -= 1
-            if all_pages:
-                self.allocator.free(all_pages)
+            req.context = _CANCELLED  # consumer gone: step loop reaps it
+
+    # -- step loop ----------------------------------------------------------
+
+    def _ensure_loop(self) -> None:
+        if self._loop_task is None or self._loop_task.done():
+            self._loop_task = asyncio.get_running_loop().create_task(
+                self._step_loop()
+            )
+
+    async def _step_loop(self) -> None:
+        idle_ticks = 0
+        while idle_ticks < 50:
+            await asyncio.sleep(self.args.decode_s_per_step)
+            if self._step():
+                idle_ticks = 0
+            else:
+                idle_ticks += 1
+
+    def _step(self) -> bool:
+        """One engine tick: reap cancels, admit, prefill-chunk, decode.
+        Returns True when any request is resident."""
+        self._reap_cancelled()
+        self._admit()
+        budget = self.args.prefill_tokens_per_step
+        for req in list(self._running):
+            if req.prefill_left > 0:
+                if budget <= 0:
+                    continue
+                step = min(req.prefill_left, budget)
+                req.prefill_left -= step
+                budget -= step
+                if req.prefill_left == 0:
+                    self._register_prompt(req)
+            else:
+                self._decode_one(req)
+        return bool(self._running or self._waiting)
+
+    def _reap_cancelled(self) -> None:
+        for q in [r for r in self._running if r.context.cancelled]:
+            self._finish(q, emit=None)
+        for q in [r for r in self._waiting if r.context.cancelled]:
+            self._waiting.remove(q)
+            q.out_q.put_nowait(None)
+
+    def _admit(self) -> None:
+        a = self.args
+        while self._waiting and len(self._running) < a.max_batch:
+            req = self._waiting[0]
+            # After a preemption the tokens to (re)prefill are the FULL
+            # history (prompt + produced), not just the original prompt —
+            # sizing from the prompt would leave later blocks pageless and
+            # silently unregistered.
+            tokens = req.history
+            # Gate with match_length (no refs, no LRU movement): a blocked
+            # head-of-line request polls every tick and must not perturb
+            # eviction order while it waits.
+            cached_n = self.allocator.match_length(req.hashes)
+            need = max(-(-(len(tokens) + 1) // a.page_size) - cached_n, 0)
+            max_admittable = (
+                a.num_pages - 1 - int(a.watermark * a.num_pages)
+            )
+            if need > max_admittable:
+                # Can NEVER fit: reject instead of wedging the queue.
+                self._waiting.popleft()
+                req.out_q.put_nowait(
+                    {
+                        "error": (
+                            f"prompt needs {need} KV pages; pool admits at "
+                            f"most {max_admittable}"
+                        ),
+                    }
+                )
+                req.out_q.put_nowait(None)
+                continue
+            # Watermark: admission must leave `watermark` of the pool free.
+            if self.allocator.num_free - need < a.watermark * a.num_pages:
+                return  # head-of-line blocks; keeps FIFO fairness
+            cached = self.allocator.lookup(req.hashes)
+            n_new = max(-(-(len(tokens) + 1) // a.page_size) - len(cached), 0)
+            pages = self.allocator.allocate(n_new) if n_new else []
+            if pages is None:
+                if cached:
+                    self.allocator.free(cached)
+                return
+            self._waiting.popleft()
+            req.cached_blocks = len(cached)
+            req.pages = list(cached) + list(pages)
+            req.prefill_left = max(
+                len(tokens) - len(cached) * a.page_size, 0
+            )
+            self._running.append(req)
+            if req.prefill_left == 0:
+                self._register_prompt(req)
+
+    def _register_prompt(self, req: _Req) -> None:
+        for bi in range(req.cached_blocks, len(req.chain.blocks)):
+            if bi < len(req.pages):
+                blk = req.chain.blocks[bi]
+                self.allocator.register(
+                    req.pages[bi],
+                    blk.sequence_hash,
+                    blk.parent_sequence_hash,
+                    blk.tokens,
+                )
+
+    def _decode_one(self, req: _Req) -> None:
+        r = req.request
+        tok = self._next_token(req.history)
+        committed = req.chain.append(tok)
+        if committed is not None:
+            page_idx = committed.block_index
+            if page_idx < len(req.pages):
+                self.allocator.register(
+                    req.pages[page_idx],
+                    committed.sequence_hash,
+                    committed.parent_sequence_hash,
+                    committed.tokens,
+                )
+            grown = self.allocator.allocate(1)
+            if grown is None:
+                # Block exhaustion: preempt back to the queue (pages
+                # freed; prefix blocks stay cached for the re-run).
+                self.preemptions += 1
+                req.preemptions += 1
+                self.allocator.free(req.pages)
+                req.pages = []
+                self._running.remove(req)
+                # re-prefill from scratch next admission (cache helps)
+                req.chain = TokenBlockSequence(
+                    req.history, block_size=self.args.page_size,
+                    salt=self.args.salt,
+                )
+                req.hashes = list(req.chain.sequence_hashes())
+                self._waiting.appendleft(req)
+                return
+            req.pages.extend(grown)
+        req.history.append(tok)
+        req.produced += 1
+        stop = (
+            not r.ignore_eos and tok in r.stop_token_ids
+        ) or req.produced >= r.max_tokens
+        item = {
+            "token_ids": [tok],
+            "finish_reason": (
+                ("stop" if tok in r.stop_token_ids else "length")
+                if stop
+                else None
+            ),
+        }
+        if stop:
+            self._finish(req, emit=item)
+        else:
+            req.out_q.put_nowait(item)
+
+    def _finish(self, req: _Req, emit: Optional[dict]) -> None:
+        if req in self._running:
+            self._running.remove(req)
+        if req.pages:
+            self.allocator.free(req.pages)
+            req.pages = []
+        if emit is not None:
+            req.out_q.put_nowait(emit)
+        req.out_q.put_nowait(None)
+
+
+class _Cancelled:
+    cancelled = True
+
+
+_CANCELLED = _Cancelled()

@@ -541,8 +541,8 @@ class Worker:
             elif self.mock is not None:
                 alloc = self.mock.allocator
                 m = {
-                    "num_waiting": 0,
-                    "num_running": self.mock.active_requests,
+                    "num_waiting": self.mock.num_waiting,
+                    "num_running": self.mock.num_running,
                     "kv_active_pages": alloc.num_active,
                     "kv_total_pages": alloc.num_pages - 1,
                     "kv_usage": alloc.usage(),
