@@ -34,18 +34,55 @@ class VisionConfig:
     proj_dim: int = 4096
     layer_norm_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
+    #: CLIP architectural switches (all on for real CLIP checkpoints;
+    #: off = the lean encoder used before loader support existed)
+    cls_token: bool = False
+    pre_norm: bool = False
+    attn_bias: bool = False
+    mlp_bias: bool = False
+    #: "gelu_tanh" | "quick_gelu" (original CLIP uses quick_gelu)
+    hidden_act: str = "gelu_tanh"
 
     @property
     def num_patches(self) -> int:
         return (self.image_size // self.patch_size) ** 2
 
     @property
+    def seq_len(self) -> int:
+        return self.num_patches + (1 if self.cls_token else 0)
+
+    @property
     def head_dim(self) -> int:
         return self.hidden_size // self.num_heads
 
     @staticmethod
-    def clip_vit_l_14() -> "VisionConfig":
-        return VisionConfig()  # defaults are CLIP-ViT-L/14 @ 224
+    def clip_vit_l_14(proj_dim: int = 4096) -> "VisionConfig":
+        """openai/clip-vit-large-patch14's vision tower (the llava encoder)."""
+        return VisionConfig(
+            proj_dim=proj_dim, cls_token=True, pre_norm=True,
+            attn_bias=True, mlp_bias=True, hidden_act="quick_gelu",
+        )
+
+    @staticmethod
+    def from_hf_config(
+        hf: dict, proj_dim: int = 4096, dtype: Any = jnp.bfloat16
+    ) -> "VisionConfig":
+        """From an HF CLIPVisionConfig dict (or CLIPConfig['vision_config'])."""
+        if "vision_config" in hf:
+            hf = hf["vision_config"]
+        return VisionConfig(
+            dtype=dtype,
+            image_size=hf.get("image_size", 224),
+            patch_size=hf.get("patch_size", 14),
+            hidden_size=hf.get("hidden_size", 1024),
+            intermediate_size=hf.get("intermediate_size", 4096),
+            num_layers=hf.get("num_hidden_layers", 24),
+            num_heads=hf.get("num_attention_heads", 16),
+            layer_norm_eps=hf.get("layer_norm_eps", 1e-5),
+            proj_dim=proj_dim,
+            cls_token=True, pre_norm=True, attn_bias=True, mlp_bias=True,
+            hidden_act=hf.get("hidden_act", "quick_gelu"),
+        )
 
     @staticmethod
     def tiny(proj_dim: int = 64) -> "VisionConfig":
@@ -53,6 +90,17 @@ class VisionConfig:
             image_size=16, patch_size=4, hidden_size=32,
             intermediate_size=64, num_layers=2, num_heads=2,
             proj_dim=proj_dim, dtype=jnp.float32,
+        )
+
+    @staticmethod
+    def tiny_clip(proj_dim: int = 64) -> "VisionConfig":
+        """tiny() with every real-CLIP switch on (loader/golden tests)."""
+        return VisionConfig(
+            image_size=16, patch_size=4, hidden_size=32,
+            intermediate_size=64, num_layers=2, num_heads=2,
+            proj_dim=proj_dim, dtype=jnp.float32,
+            cls_token=True, pre_norm=True, attn_bias=True, mlp_bias=True,
+            hidden_act="quick_gelu",
         )
 
 
@@ -68,9 +116,9 @@ def init_params(key: jax.Array, cfg: VisionConfig) -> dict:
             jax.random.normal(key, shape, jnp.float32) * scale
         ).astype(cfg.dtype)
 
-    return {
+    params = {
         "patch_embed": dense(keys[0], (patch_in, h), patch_in),
-        "pos_embed": dense(keys[1], (cfg.num_patches, h), h),
+        "pos_embed": dense(keys[1], (cfg.seq_len, h), h),
         "layers": {
             "ln1": jnp.ones((L, h), cfg.dtype),
             "ln1_b": jnp.zeros((L, h), cfg.dtype),
@@ -86,6 +134,125 @@ def init_params(key: jax.Array, cfg: VisionConfig) -> dict:
         "proj1": dense(keys[6], (h, cfg.proj_dim), h),
         "proj2": dense(keys[7], (cfg.proj_dim, cfg.proj_dim), cfg.proj_dim),
     }
+    if cfg.cls_token:
+        params["cls_embed"] = jnp.zeros((h,), cfg.dtype)
+    if cfg.pre_norm:
+        params["pre_ln"] = jnp.ones((h,), cfg.dtype)
+        params["pre_ln_b"] = jnp.zeros((h,), cfg.dtype)
+    if cfg.attn_bias:
+        params["layers"]["wqkv_b"] = jnp.zeros((L, 3 * h), cfg.dtype)
+        params["layers"]["wo_b"] = jnp.zeros((L, h), cfg.dtype)
+    if cfg.mlp_bias:
+        params["layers"]["w1_b"] = jnp.zeros((L, i), cfg.dtype)
+        params["layers"]["w2_b"] = jnp.zeros((L, h), cfg.dtype)
+    return params
+
+
+def params_from_torch_state_dict(sd, cfg: VisionConfig) -> dict:
+    """HF CLIPVisionModel weights -> this module's pytree.
+
+    Handles both bare CLIPVisionModel state dicts ("vision_model....") and
+    CLIPModel ones (same keys). The patch conv [h, 3, p, p] becomes the
+    row-major patch matmul weight [p*p*3, h] matching patchify()'s
+    [p, p, 3] flattening. The projector gets a deterministic random init
+    (bare CLIP carries none — see the inline note on llava projectors).
+    Reference checkpoints: /root/reference examples/multimodal (llava's
+    openai/clip-vit-large-patch14-336 tower)."""
+    import numpy as np
+
+    def t(name):
+        key = name if name in sd else f"vision_model.{name}"
+        return np.asarray(sd[key].detach().cpu().numpy(), np.float32)
+
+    h, L = cfg.hidden_size, cfg.num_layers
+    conv = t("embeddings.patch_embedding.weight")  # [h, 3, p, p]
+    patch_w = conv.transpose(2, 3, 1, 0).reshape(-1, h)  # [p*p*3, h]
+
+    def stack(fmt, transpose=False):
+        ws = [t(fmt.format(i)) for i in range(L)]
+        out = np.stack([w.T if transpose else w for w in ws])
+        return jnp.asarray(out, cfg.dtype)
+
+    def qkv_w(i):
+        q = t(f"encoder.layers.{i}.self_attn.q_proj.weight")
+        k = t(f"encoder.layers.{i}.self_attn.k_proj.weight")
+        v = t(f"encoder.layers.{i}.self_attn.v_proj.weight")
+        return np.concatenate([q.T, k.T, v.T], axis=1)  # [h, 3h]
+
+    def qkv_b(i):
+        return np.concatenate(
+            [
+                t(f"encoder.layers.{i}.self_attn.q_proj.bias"),
+                t(f"encoder.layers.{i}.self_attn.k_proj.bias"),
+                t(f"encoder.layers.{i}.self_attn.v_proj.bias"),
+            ]
+        )
+
+    params = {
+        "patch_embed": jnp.asarray(patch_w, cfg.dtype),
+        "pos_embed": jnp.asarray(
+            t("embeddings.position_embedding.weight"), cfg.dtype
+        ),
+        "layers": {
+            "ln1": stack("encoder.layers.{}.layer_norm1.weight"),
+            "ln1_b": stack("encoder.layers.{}.layer_norm1.bias"),
+            "wqkv": jnp.asarray(
+                np.stack([qkv_w(i) for i in range(L)]), cfg.dtype
+            ),
+            "wqkv_b": jnp.asarray(
+                np.stack([qkv_b(i) for i in range(L)]), cfg.dtype
+            ),
+            "wo": stack(
+                "encoder.layers.{}.self_attn.out_proj.weight", transpose=True
+            ),
+            "wo_b": stack("encoder.layers.{}.self_attn.out_proj.bias"),
+            "ln2": stack("encoder.layers.{}.layer_norm2.weight"),
+            "ln2_b": stack("encoder.layers.{}.layer_norm2.bias"),
+            "w1": stack("encoder.layers.{}.mlp.fc1.weight", transpose=True),
+            "w1_b": stack("encoder.layers.{}.mlp.fc1.bias"),
+            "w2": stack("encoder.layers.{}.mlp.fc2.weight", transpose=True),
+            "w2_b": stack("encoder.layers.{}.mlp.fc2.bias"),
+        },
+        "final_ln": jnp.asarray(t("post_layernorm.weight"), cfg.dtype),
+        "final_ln_b": jnp.asarray(t("post_layernorm.bias"), cfg.dtype),
+        "cls_embed": jnp.asarray(t("embeddings.class_embedding"), cfg.dtype),
+        "pre_ln": jnp.asarray(t("pre_layrnorm.weight"), cfg.dtype),
+        "pre_ln_b": jnp.asarray(t("pre_layrnorm.bias"), cfg.dtype),
+    }
+    # Projector: deterministic random init. A bare CLIP checkpoint carries
+    # no projector; loading a trained llava projector is future work — it
+    # requires the PRE-post-layernorm feature surface llava trains on
+    # (vision_feature_layer=-2) plus its linear biases, not a weight copy.
+    keys = jax.random.split(jax.random.key(0), 2)
+    scale1 = 1.0 / math.sqrt(h)
+    scale2 = 1.0 / math.sqrt(cfg.proj_dim)
+    params["proj1"] = (
+        jax.random.normal(keys[0], (h, cfg.proj_dim), jnp.float32) * scale1
+    ).astype(cfg.dtype)
+    params["proj2"] = (
+        jax.random.normal(keys[1], (cfg.proj_dim, cfg.proj_dim), jnp.float32)
+        * scale2
+    ).astype(cfg.dtype)
+    return params
+
+
+def load_vision_checkpoint(
+    path: str, proj_dim: int = 4096, dtype: Any = jnp.bfloat16
+):
+    """Load an HF CLIP checkpoint DIRECTORY: returns (cfg, params).
+
+    Accepts CLIPVisionModel or CLIPModel checkpoints (config.json with or
+    without a nested vision_config)."""
+    import json
+    import os
+
+    with open(os.path.join(path, "config.json")) as f:
+        hf = json.load(f)
+    cfg = VisionConfig.from_hf_config(hf, proj_dim=proj_dim, dtype=dtype)
+    from transformers import CLIPVisionModel
+
+    model = CLIPVisionModel.from_pretrained(path)
+    return cfg, params_from_torch_state_dict(model.state_dict(), cfg)
 
 
 def _layer_norm(x, w, b, eps):
@@ -106,18 +273,37 @@ def patchify(images: jax.Array, cfg: VisionConfig) -> jax.Array:
     return x.reshape(b, g * g, p * p * 3)
 
 
-def forward(params: dict, cfg: VisionConfig, images: jax.Array) -> jax.Array:
-    """[B, image_size, image_size, 3] pixels -> [B, num_patches, proj_dim]
-    projected patch embeddings (the tokens spliced into the LLM prompt)."""
+def _act(x: jax.Array, kind: str) -> jax.Array:
+    if kind == "quick_gelu":  # original CLIP: x * sigmoid(1.702 x)
+        return x * jax.nn.sigmoid(1.702 * x)
+    if kind == "gelu":  # HF "gelu" is the EXACT erf form
+        return jax.nn.gelu(x, approximate=False)
+    return jax.nn.gelu(x, approximate=True)
+
+
+def forward_features(
+    params: dict, cfg: VisionConfig, images: jax.Array
+) -> jax.Array:
+    """[B, H, W, 3] pixels -> [B, seq_len, hidden] final-norm hidden states
+    (HF CLIPVisionModel.last_hidden_state equivalent — the golden-test
+    surface)."""
     x = patchify(images.astype(cfg.dtype), cfg) @ params["patch_embed"]
+    if cfg.cls_token:
+        cls = jnp.broadcast_to(
+            params["cls_embed"], (x.shape[0], 1, cfg.hidden_size)
+        ).astype(x.dtype)
+        x = jnp.concatenate([cls, x], axis=1)
     x = x + params["pos_embed"][None]
+    if cfg.pre_norm:
+        x = _layer_norm(x, params["pre_ln"], params["pre_ln_b"], cfg.layer_norm_eps)
 
     def layer(x, lp):
         y = _layer_norm(x, lp["ln1"], lp["ln1_b"], cfg.layer_norm_eps)
         b, n, h = y.shape
-        qkv = (y @ lp["wqkv"]).reshape(
-            b, n, 3, cfg.num_heads, cfg.head_dim
-        )
+        qkv = y @ lp["wqkv"]
+        if cfg.attn_bias:
+            qkv = qkv + lp["wqkv_b"]
+        qkv = qkv.reshape(b, n, 3, cfg.num_heads, cfg.head_dim)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
         scores = jnp.einsum(
             "bnhd,bmhd->bhnm", q.astype(jnp.float32), k.astype(jnp.float32)
@@ -126,13 +312,34 @@ def forward(params: dict, cfg: VisionConfig, images: jax.Array) -> jax.Array:
         attn = jnp.einsum(
             "bhnm,bmhd->bnhd", probs, v.astype(jnp.float32)
         ).reshape(b, n, h).astype(x.dtype)
-        x = x + attn @ lp["wo"]
+        attn = attn @ lp["wo"]
+        if cfg.attn_bias:
+            attn = attn + lp["wo_b"]
+        x = x + attn
         y = _layer_norm(x, lp["ln2"], lp["ln2_b"], cfg.layer_norm_eps)
-        y = jax.nn.gelu((y @ lp["w1"]).astype(jnp.float32), approximate=True)
-        return x + (y.astype(cfg.dtype) @ lp["w2"]), None
+        y = y @ lp["w1"]
+        if cfg.mlp_bias:
+            y = y + lp["w1_b"]
+        y = _act(y.astype(jnp.float32), cfg.hidden_act).astype(cfg.dtype)
+        y = y @ lp["w2"]
+        if cfg.mlp_bias:
+            y = y + lp["w2_b"]
+        return x + y, None
 
     x, _ = jax.lax.scan(layer, x, params["layers"])
-    x = _layer_norm(x, params["final_ln"], params["final_ln_b"], cfg.layer_norm_eps)
+    return _layer_norm(
+        x, params["final_ln"], params["final_ln_b"], cfg.layer_norm_eps
+    )
+
+
+def forward(params: dict, cfg: VisionConfig, images: jax.Array) -> jax.Array:
+    """[B, image_size, image_size, 3] pixels -> [B, num_patches, proj_dim]
+    projected patch embeddings (the tokens spliced into the LLM prompt).
+    With a CLS token, the CLS position is dropped before projection
+    (llava splices patch embeddings only)."""
+    x = forward_features(params, cfg, images)
+    if cfg.cls_token:
+        x = x[:, 1:]
     # llava-style 2-layer MLP projector into the LM embedding space
     y = jax.nn.gelu((x @ params["proj1"]).astype(jnp.float32), approximate=True)
     return (y.astype(cfg.dtype) @ params["proj2"]).astype(cfg.dtype)

@@ -9,7 +9,9 @@ ride the fabric data plane as framed tensors: the EncodeWorker serves an
 as the image encoder.
 
 Config keys:
-  EncodeWorker:       vision-model (tiny | clip-vit-l-14), proj-dim
+  EncodeWorker:       vision-model (tiny | clip-vit-l-14 | path to an HF
+                      CLIP/CLIP-vision checkpoint DIRECTORY — real
+                      weights, golden-tested vs transformers), proj-dim
   Worker / Frontend:  as in examples/llm
 """
 
@@ -39,17 +41,21 @@ class EncodeWorker:
 
             from dynamo_tpu.models import vision
 
+            import os
+
             name = self.config.get("vision-model", "clip-vit-l-14")
             proj_dim = int(self.config.get("proj-dim", 4096))
-            if name == "tiny":
+            if os.path.isdir(name):
+                # real weights: an HF CLIP(-vision) checkpoint directory
+                cfg, params = vision.load_vision_checkpoint(
+                    name, proj_dim=proj_dim
+                )
+            elif name == "tiny":
                 cfg = vision.VisionConfig.tiny(proj_dim=proj_dim)
+                params = vision.init_params(jax.random.key(0), cfg)
             else:
-                cfg = vision.VisionConfig.clip_vit_l_14()
-                if proj_dim != cfg.proj_dim:
-                    from dataclasses import replace
-
-                    cfg = replace(cfg, proj_dim=proj_dim)
-            params = vision.init_params(jax.random.key(0), cfg)
+                cfg = vision.VisionConfig.clip_vit_l_14(proj_dim=proj_dim)
+                params = vision.init_params(jax.random.key(0), cfg)
             fwd = jax.jit(
                 lambda params, images: vision.forward(params, cfg, images)
             )

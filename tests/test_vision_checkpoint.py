@@ -1,0 +1,101 @@
+"""Vision encoder with REAL checkpoint weights, golden-tested against HF.
+
+Parity target: the reference's multimodal examples serve real CLIP towers
+(/root/reference examples/multimodal — llava's openai/clip-vit-large-
+patch14 encoder). Zero-egress environment, so the checkpoint is a real
+HF-format CLIPVisionModel written to disk by transformers itself; the only
+shared artifact between HF and our loader is the directory.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+
+@pytest.fixture(scope="module")
+def clip_checkpoint(tmp_path_factory):
+    from transformers import CLIPVisionConfig, CLIPVisionModel
+
+    d = tmp_path_factory.mktemp("clip-ckpt")
+    hf_cfg = CLIPVisionConfig(
+        image_size=16, patch_size=4, hidden_size=32,
+        intermediate_size=64, num_hidden_layers=2, num_attention_heads=2,
+        hidden_act="quick_gelu",
+    )
+    torch.manual_seed(3)
+    model = CLIPVisionModel(hf_cfg).eval()
+    model.save_pretrained(str(d), safe_serialization=True)
+    return str(d)
+
+
+def test_features_match_hf_last_hidden_state(clip_checkpoint):
+    from transformers import CLIPVisionModel
+
+    from dynamo_tpu.models import vision
+
+    import jax.numpy as jnp
+
+    cfg, params = vision.load_vision_checkpoint(
+        clip_checkpoint, proj_dim=8, dtype=jnp.float32
+    )
+    assert cfg.cls_token and cfg.pre_norm and cfg.hidden_act == "quick_gelu"
+
+    rng = np.random.default_rng(0)
+    images = rng.standard_normal((2, 16, 16, 3)).astype(np.float32)
+
+    ours = np.asarray(vision.forward_features(params, cfg, images))
+
+    model = CLIPVisionModel.from_pretrained(clip_checkpoint).eval()
+    with torch.no_grad():
+        out = model(torch.from_numpy(images.transpose(0, 3, 1, 2)))  # NCHW
+        # HF's last_hidden_state excludes post_layernorm (applied only to
+        # the pooled CLS); our features are post-ln over all positions, so
+        # compare on that surface.
+        ref = model.vision_model.post_layernorm(
+            out.last_hidden_state
+        ).numpy()
+
+    assert ours.shape == ref.shape == (2, 17, 32)  # CLS + 16 patches
+    np.testing.assert_allclose(ours, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_projected_output_drops_cls(clip_checkpoint):
+    from dynamo_tpu.models import vision
+
+    cfg, params = vision.load_vision_checkpoint(clip_checkpoint, proj_dim=8)
+    images = np.zeros((1, 16, 16, 3), np.float32)
+    out = np.asarray(vision.forward(params, cfg, images))
+    assert out.shape == (1, 16, 8)  # patches only, projected
+
+
+def test_encode_worker_serves_checkpoint(clip_checkpoint):
+    """The encode component loads the directory and serves real-weight
+    embeddings end to end (fabric-free direct drive)."""
+    from examples.multimodal.components import EncodeWorker
+
+    class _Ctx:
+        cancelled = False
+
+    async def main():
+        w = EncodeWorker.__new__(EncodeWorker)
+        w.config = {"vision-model": clip_checkpoint, "proj-dim": "8"}
+        w._forward = w._params = w._cfg = None
+        await w.setup()
+        pixels = np.random.default_rng(1).standard_normal(
+            (1, 16, 16, 3)
+        ).astype(np.float32)
+        out = None
+        async for item in w.encode(_Ctx(), {
+            "pixels": pixels.tobytes(), "shape": [1, 16, 16, 3],
+        }):
+            out = item
+        emb = np.frombuffer(out["embeddings"], np.float32).reshape(
+            out["shape"]
+        )
+        assert emb.shape == (1, 16, 8)
+        assert np.isfinite(emb).all() and np.abs(emb).sum() > 0
+
+    asyncio.run(main())
