@@ -1,13 +1,28 @@
 """SLA profiler: build the per-worker perf tables the SLA planner consumes.
 
-Reference parity: benchmarks/profiler/profile_sla.py sweeps parallel
-configs and interpolates TTFT/ITL against load to pre-compute planner
-tables (docs sla_planner.md). Here: sweep closed-loop concurrency against
-ONE engine worker, record (achieved req/s -> TTFT ms, ITL ms), and emit
-exactly the JSON `dynamo-tpu planner --mode sla --perf-table` loads:
+Reference parity: benchmarks/profiler/profile_sla.py sweeps PARALLEL
+CONFIGS (TP) and picks the one meeting the TTFT/ITL targets
+(profile_sla.py:81-84), interpolating metric-vs-load to pre-compute
+planner tables (docs sla_planner.md). Here:
+
+- `profile(...)` sweeps closed-loop concurrency against ONE engine config,
+  recording (achieved req/s -> TTFT ms, ITL ms);
+- `sweep_parallel_configs(...)` runs that per (tp, dp) mesh config and
+  SELECTS the config with the highest SLA-feasible rate PER CHIP — the
+  quantity that decides deployment cost.
+
+Emits the JSON `dynamo-tpu planner --mode sla --perf-table` loads: the
+top-level `ttft_vs_rate`/`itl_vs_rate` are the SELECTED config's rows
+(back-compatible), with every swept config under `configs` so the planner
+can re-select against ITS OWN targets at load time:
 
     {"ttft_vs_rate": [[req_s, ttft_p50_ms], ...],
      "itl_vs_rate":  [[req_s, itl_p50_ms], ...],
+     "selected": {"tp": T, "dp": D},
+     "sla": {"ttft_ms": ..., "itl_ms": ...},
+     "configs": [{"tp": ..., "dp": ..., "ttft_vs_rate": ...,
+                  "itl_vs_rate": ..., "sla_rate": ...,
+                  "sla_rate_per_chip": ...}, ...],
      "meta": {...}}
 """
 
@@ -17,6 +32,70 @@ import argparse
 import json
 
 
+# selection policy shared with the planner's load-time re-selection
+from dynamo_tpu.planner.perf_model import (  # noqa: E402
+    select_parallel_config,
+    sla_feasible_rate,
+)
+
+
+def sweep_parallel_configs(
+    parallel: list[tuple[int, int]],
+    ttft_target_ms: float = 200.0,
+    itl_target_ms: float = 20.0,
+    model: str = "tiny",
+    num_requests: int = 32,
+    isl: int = 64,
+    osl: int = 32,
+    concurrency_levels=(1, 2, 4, 8),
+    base_engine_config=None,
+) -> dict:
+    """Profile each (tp, dp) config and select the SLA-best per chip.
+
+    Reference: profiler sweeps TP and picks the config meeting TTFT/ITL
+    (profile_sla.py:81-84); per-chip normalization is what makes a tp=4
+    config that's 1.5x faster still LOSE to tp=1 on cost."""
+    from dataclasses import replace
+
+    from dynamo_tpu.engine import EngineConfig
+
+    configs = []
+    for tp, dp in parallel:
+        if base_engine_config is not None:
+            cfg = replace(base_engine_config, tp=tp, dp=dp)
+        else:
+            cfg = None
+        t = profile(
+            model=model, num_requests=num_requests, isl=isl, osl=osl,
+            concurrency_levels=concurrency_levels, engine_config=cfg,
+            tp=tp, dp=dp,
+        )
+        rate = sla_feasible_rate(t, ttft_target_ms, itl_target_ms)
+        configs.append(
+            {
+                "tp": tp, "dp": dp,
+                "ttft_vs_rate": t["ttft_vs_rate"],
+                "itl_vs_rate": t["itl_vs_rate"],
+                "sla_rate": round(rate, 4),
+                "sla_rate_per_chip": round(rate / (tp * dp), 4),
+                "meta": t["meta"],
+            }
+        )
+    best = select_parallel_config(configs, ttft_target_ms, itl_target_ms)
+    feasible = [c for c in configs if c["sla_rate"] > 0]
+    return {
+        "ttft_vs_rate": best["ttft_vs_rate"],
+        "itl_vs_rate": best["itl_vs_rate"],
+        "selected": {"tp": best["tp"], "dp": best["dp"]},
+        "sla": {"ttft_ms": ttft_target_ms, "itl_ms": itl_target_ms},
+        "configs": configs,
+        "meta": {
+            "model": model, "isl": isl, "osl": osl,
+            "sla_feasible": bool(feasible),
+        },
+    }
+
+
 def profile(
     model: str = "tiny",
     num_requests: int = 32,
@@ -24,6 +103,8 @@ def profile(
     osl: int = 32,
     concurrency_levels=(1, 2, 4, 8),
     engine_config=None,
+    tp: int = 1,
+    dp: int = 1,
 ) -> dict:
     from benchmarks.perf import bench_engine
     from benchmarks.synthesizer import SynthConfig, synthesize
@@ -46,6 +127,8 @@ def profile(
         max_pages_per_seq=max(8, -(-(longest + 1) // 64)),
         dtype="bfloat16",
         enable_prefix_caching=False,
+        tp=tp,
+        dp=dp,
     )
     # A caller-supplied config has a fixed context budget: clamp prompts to
     # it (the synthesizer's geometric tail would trip the admission guard).
@@ -83,6 +166,13 @@ def main(argv=None) -> None:
     p.add_argument("--isl", type=int, default=128)
     p.add_argument("--osl", type=int, default=64)
     p.add_argument("--concurrency", default="1,2,4,8,16")
+    p.add_argument(
+        "--parallel", default=None,
+        help='comma-separated TPxDP mesh configs to sweep, e.g. "1x1,2x1,4x1"'
+             " — selects the SLA-best per chip (omit = single default config)",
+    )
+    p.add_argument("--ttft-target", type=float, default=200.0, dest="ttft_target")
+    p.add_argument("--itl-target", type=float, default=20.0, dest="itl_target")
     p.add_argument("-o", "--output", default=None, help="write JSON here")
     args = p.parse_args(argv)
 
@@ -90,13 +180,30 @@ def main(argv=None) -> None:
 
     honor_jax_platforms_env()
 
-    table = profile(
-        model=args.model,
-        num_requests=args.num_requests,
-        isl=args.isl,
-        osl=args.osl,
-        concurrency_levels=[int(x) for x in args.concurrency.split(",")],
-    )
+    levels = [int(x) for x in args.concurrency.split(",")]
+    if args.parallel:
+        parallel = [
+            (int(t), int(d))
+            for t, d in (s.split("x") for s in args.parallel.split(","))
+        ]
+        table = sweep_parallel_configs(
+            parallel,
+            ttft_target_ms=args.ttft_target,
+            itl_target_ms=args.itl_target,
+            model=args.model,
+            num_requests=args.num_requests,
+            isl=args.isl,
+            osl=args.osl,
+            concurrency_levels=levels,
+        )
+    else:
+        table = profile(
+            model=args.model,
+            num_requests=args.num_requests,
+            isl=args.isl,
+            osl=args.osl,
+            concurrency_levels=levels,
+        )
     text = json.dumps(table, indent=2)
     if args.output:
         with open(args.output, "w") as f:

@@ -439,6 +439,25 @@ async def _run_planner(args) -> None:
             sys.exit(2)
         with open(args.perf_table) as f:
             table = json.load(f)
+        if table.get("configs"):
+            # Multi-(tp,dp) table from the profiler sweep: re-select
+            # against the PLANNER's targets (which may differ from the
+            # profile-time SLA) on per-chip SLA-feasible rate.
+            from dynamo_tpu.planner.perf_model import select_parallel_config
+
+            chosen = select_parallel_config(
+                table["configs"], args.ttft_ms, args.itl_ms
+            )
+            table = dict(table, **{
+                "ttft_vs_rate": chosen["ttft_vs_rate"],
+                "itl_vs_rate": chosen["itl_vs_rate"],
+            })
+            print(
+                f"planner: perf table selects tp={chosen['tp']} "
+                f"dp={chosen['dp']} for ttft<={args.ttft_ms}ms "
+                f"itl<={args.itl_ms}ms",
+                flush=True,
+            )
         planner = SlaPlanner(
             cfg,
             SlaTargets(ttft_ms=args.ttft_ms, itl_ms=args.itl_ms),

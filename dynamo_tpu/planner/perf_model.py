@@ -53,3 +53,35 @@ class PerfInterpolator:
                 best = x0 + t * (x1 - x0)
             break
         return best
+
+
+def sla_feasible_rate(table: dict, ttft_ms: float, itl_ms: float) -> float:
+    """Highest profiled req/s at which BOTH metrics stay within target
+    (0.0 when no profiled load qualifies). `table` carries
+    ttft_vs_rate/itl_vs_rate rows as [[req_s, ms], ...]."""
+    rates = []
+    for rows, target in (
+        (table["ttft_vs_rate"], ttft_ms),
+        (table["itl_vs_rate"], itl_ms),
+    ):
+        if not rows:
+            return 0.0
+        rates.append(PerfInterpolator(*zip(*rows)).max_load_within(target))
+    return max(0.0, min(rates))
+
+
+def select_parallel_config(
+    configs: Sequence[dict], ttft_ms: float, itl_ms: float
+) -> dict:
+    """The ONE selection policy for (tp, dp) perf-table configs, shared by
+    the offline profiler sweep and the planner's load-time re-selection:
+    score each config by SLA-feasible rate PER CHIP, prefer feasible ones,
+    fall back to the best-scoring config when nothing meets the targets
+    (reference: profiler picks the config meeting TTFT/ITL,
+    profile_sla.py:81-84)."""
+    scored = [
+        (sla_feasible_rate(c, ttft_ms, itl_ms) / (c["tp"] * c["dp"]), c)
+        for c in configs
+    ]
+    feasible = [s for s in scored if s[0] > 0]
+    return max(feasible or scored, key=lambda s: s[0])[1]
