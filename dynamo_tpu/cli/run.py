@@ -54,7 +54,10 @@ def _disagg_config(args):
         return None
     from dynamo_tpu.disagg import DisaggConfig
 
-    return DisaggConfig(max_local_prefill_length=args.max_local_prefill)
+    return DisaggConfig(
+        max_local_prefill_length=args.max_local_prefill,
+        transfer_timeout_s=getattr(args, "transfer_timeout", 30.0),
+    )
 
 
 def _card(args):
@@ -235,6 +238,7 @@ async def _run_worker(args) -> None:
         enable_disagg=args.disagg,
         disagg_config=_disagg_config(args),
         kv_remote=getattr(args, "kv_remote", False),
+        echo_delay=getattr(args, "echo_delay", 0.0),
     )
     await worker.start()
     print(f"worker {worker.instance_id} up (model={args.model})", flush=True)
@@ -543,6 +547,16 @@ def main(argv: Optional[list[str]] = None) -> None:
     runp.add_argument(
         "--max-local-prefill", type=int, default=512, dest="max_local_prefill",
         help="uncached prefill tokens above which prefill goes remote",
+    )
+    runp.add_argument(
+        "--echo-delay", type=float, default=0.0, dest="echo_delay",
+        help="out=echo: seconds per emitted token (stream-timing tests)",
+    )
+    runp.add_argument(
+        "--transfer-timeout", type=float, default=30.0,
+        dest="transfer_timeout",
+        help="seconds to wait for the remote-prefill KV landing before "
+             "falling back to local prefill",
     )
     runp.add_argument("--namespace", default="dynamo")
     runp.add_argument("--component", default="backend")

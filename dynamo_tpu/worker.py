@@ -56,6 +56,7 @@ class Worker:
         kv_remote: bool = False,
         kv_remote_min_blocks: int = 2,
         kv_remote_timeout_s: float = 5.0,
+        echo_delay: float = 0.0,
     ):
         self.runtime = runtime
         self.card = card
@@ -92,6 +93,7 @@ class Worker:
         self.echo: Optional[EchoEngine] = None
         self.registration = None
         self.instance_id: str = ""
+        self.echo_delay = echo_delay
         self._kv_event_buffer: list[KvEvent] = []
         self._tasks: list[asyncio.Task] = []
 
@@ -99,7 +101,7 @@ class Worker:
 
     async def start(self) -> None:
         if self.engine_kind == "echo":
-            self.echo = EchoEngine()
+            self.echo = EchoEngine(delay=self.echo_delay)
         elif self.engine_kind == "mock":
             from dynamo_tpu.mocker import MockEngine, MockEngineArgs
 

@@ -72,11 +72,16 @@ def _cli(*args: str) -> list[str]:
 class Cluster:
     """fabric + OpenAI frontend + N echo workers on one model."""
 
+    #: request-body knobs subclasses override (tiny-context engines)
+    MAX_TOKENS = 32
+    TEXT_LIMIT = None
+
     def __init__(
         self, num_workers: int = 2, model: str = "tiny",
-        fabric_persist: bool = False,
+        fabric_persist: bool = False, echo_delay: float = 0.0,
     ):
         self.model = model
+        self.echo_delay = echo_delay
         self.fabric_port = _free_port()
         self.http_port = _free_port()
         self.fabric = None
@@ -88,8 +93,7 @@ class Cluster:
         try:
             self.fabric = ManagedProc("fabric", self._fabric_argv())
             self.fabric.wait_for("fabric server on|listening", timeout=20)
-            for _ in range(num_workers):
-                self.add_worker()
+            self._spawn_workers(num_workers)
             self.frontend = ManagedProc(
                 "frontend",
                 _cli(
@@ -106,6 +110,10 @@ class Cluster:
             self.stop()
             raise
 
+    def _spawn_workers(self, n: int) -> None:
+        for _ in range(n):
+            self.add_worker()
+
     def _fabric_argv(self) -> list[str]:
         argv = _cli("fabric", "--port", str(self.fabric_port))
         if self.persist_dir:
@@ -119,23 +127,26 @@ class Cluster:
         self.fabric.wait_for("fabric server on|listening", timeout=20)
 
     def add_worker(self) -> ManagedProc:
-        w = ManagedProc(
-            f"worker{len(self.workers)}",
-            _cli(
-                "run", "in=dyn", "out=echo", "--model", self.model,
-                "--fabric", f"127.0.0.1:{self.fabric_port}",
-            ),
+        argv = _cli(
+            "run", "in=dyn", "out=echo", "--model", self.model,
+            "--fabric", f"127.0.0.1:{self.fabric_port}",
         )
-        w.wait_for(r"worker \w+ up", timeout=40)
+        if self.echo_delay:
+            argv += ["--echo-delay", str(self.echo_delay)]
+        w = ManagedProc(f"worker{len(self.workers)}", argv)
+        # append BEFORE readiness: a failed wait must not leak the process
         self.workers.append(w)
+        w.wait_for(r"worker \w+ up", timeout=40)
         return w
 
     def request(self, text: str, timeout: float = 10.0) -> tuple[int, dict]:
+        if self.TEXT_LIMIT:
+            text = text[: self.TEXT_LIMIT]
         body = json.dumps(
             {
                 "model": self.model,
                 "messages": [{"role": "user", "content": text}],
-                "max_tokens": 32,
+                "max_tokens": self.MAX_TOKENS,
             }
         ).encode()
         req = urllib.request.Request(
@@ -184,3 +195,144 @@ def _free_port() -> int:
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         return s.getsockname()[1]
+
+
+class PhaseMetrics:
+    """Per-phase success/latency accounting (the reference harness collects
+    per-phase latency across its kill schedule — tests/fault_tolerance/
+    utils/metrics.py + parse_results.py). Scenarios record every request
+    under a named phase; the summary lands in a JSON artifact."""
+
+    def __init__(self):
+        self.phases: dict[str, dict] = {}
+
+    def record(self, phase: str, ok: bool, latency_s: float) -> None:
+        p = self.phases.setdefault(phase, {"ok": 0, "fail": 0, "lat": []})
+        p["ok" if ok else "fail"] += 1
+        if ok:
+            p["lat"].append(latency_s)
+
+    @staticmethod
+    def _pct(values, q):
+        if not values:
+            return None
+        v = sorted(values)
+        return v[min(len(v) - 1, int(round(q * (len(v) - 1))))]
+
+    def summary(self) -> dict:
+        out = {}
+        for name, p in self.phases.items():
+            out[name] = {
+                "requests": p["ok"] + p["fail"],
+                "ok": p["ok"],
+                "fail": p["fail"],
+                "p50_ms": (
+                    round(self._pct(p["lat"], 0.5) * 1e3, 1)
+                    if p["lat"] else None
+                ),
+                "p95_ms": (
+                    round(self._pct(p["lat"], 0.95) * 1e3, 1)
+                    if p["lat"] else None
+                ),
+                "max_ms": (
+                    round(max(p["lat"]) * 1e3, 1) if p["lat"] else None
+                ),
+            }
+        return out
+
+    def write(self, path: str) -> dict:
+        s = self.summary()
+        with open(path, "w") as f:
+            json.dump(s, f, indent=1)
+        return s
+
+
+def drive_phase(
+    cluster, metrics: PhaseMetrics, phase: str, n: int,
+    text: str = "msg", timeout: float = 15.0,
+) -> int:
+    """n requests recorded under `phase`; returns successes."""
+    ok = 0
+    for i in range(n):
+        t0 = time.time()
+        try:
+            status, _ = cluster.request(f"{text} {i}", timeout=timeout)
+        except Exception:
+            status = -1
+        metrics.record(phase, status == 200, time.time() - t0)
+        ok += status == 200
+    return ok
+
+
+class DisaggCluster(Cluster):
+    """fabric + jax decode worker (remote prefill on) + prefill worker +
+    frontend — the disagg serving stack for kill-injection scenarios.
+
+    Context is 32 tokens (byte tokenizer + template ~17): prompts stay
+    tiny, and any prompt with >4 uncached tokens goes to the prefill fleet
+    (--max-local-prefill 4)."""
+
+    ENGINE = [
+        "--model", "tiny", "--page-size", "4", "--num-pages", "64",
+        "--max-context", "32", "--dtype", "float32",
+    ]
+    MAX_TOKENS = 4
+    TEXT_LIMIT = 8
+
+    def __init__(self):
+        self.prefill: ManagedProc | None = None
+        super().__init__(num_workers=1)
+
+    def _spawn_workers(self, n: int) -> None:
+        decode = ManagedProc(
+            "decode",
+            _cli(
+                "run", "in=dyn", "out=jax", *self.ENGINE,
+                "--fabric", f"127.0.0.1:{self.fabric_port}",
+                "--disagg", "--max-local-prefill", "4",
+                "--transfer-timeout", "3",
+            ),
+        )
+        self.workers.append(decode)
+        decode.wait_for(r"worker \w+ up", timeout=60)
+        self.prefill = self.spawn_prefill()
+
+    @property
+    def decode(self) -> ManagedProc:
+        return self.workers[0]
+
+    def spawn_prefill(self) -> ManagedProc:
+        p = ManagedProc(
+            "prefill",
+            _cli(
+                "run", "in=dyn", "out=jax", *self.ENGINE,
+                "--role", "prefill",
+                "--fabric", f"127.0.0.1:{self.fabric_port}",
+            ),
+        )
+        # track BEFORE readiness so a failed wait can't leak the process
+        self.prefill = p
+        p.wait_for(r"prefill worker \w+ up", timeout=60)
+        return p
+
+    def remote_prefills_done(self) -> int:
+        with open(self.prefill.log_path) as f:
+            return f.read().count("compiled prefill")
+
+    def clear_kv(self) -> None:
+        """Flush every worker's prefix cache so the next prompts are fully
+        uncached (and therefore eligible for remote prefill again)."""
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{self.http_port}/clear_kv_blocks", data=b"{}",
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            assert resp.status == 200
+
+    def stop(self) -> None:
+        if self.prefill is not None:
+            try:
+                self.prefill.stop()
+            except Exception:
+                pass
+        super().stop()
