@@ -1,0 +1,7 @@
+{{- define "dynamo.image" -}}
+{{ .Values.image.repository }}:{{ .Values.image.tag }}
+{{- end -}}
+
+{{- define "dynamo.fabricAddr" -}}
+{{ .Release.Name }}-fabric:{{ .Values.fabric.port }}
+{{- end -}}

@@ -681,6 +681,11 @@ def main(argv: Optional[list[str]] = None) -> None:
              "operator) instead of raw Deployments/Services",
     )
     deployp.add_argument(
+        "--fabric-external", action="store_true", dest="fabric_external",
+        help="with --cr: the fabric at --fabric-host is platform-managed "
+             "(helm chart); the operator won't render a per-graph fabric",
+    )
+    deployp.add_argument(
         "--name", default=None,
         help="CR name with --cr (default: derived from the root service)",
     )
@@ -836,6 +841,10 @@ def main(argv: Optional[list[str]] = None) -> None:
                         "services": manifest["services"],
                     },
                 }
+                if args.fabric_external:
+                    # target a platform-managed fabric: the operator must
+                    # not render (and fight over) a per-graph one
+                    cr["spec"]["fabricExternal"] = True
                 os.makedirs(args.output, exist_ok=True)
                 kpath = os.path.join(args.output, "graph-deployment.yaml")
                 with open(kpath, "w") as f:

@@ -40,6 +40,7 @@ def desired_objects(cr: dict) -> list[dict]:
             "endpoints": s.get("endpoints", []),
             "depends": s.get("depends", []),
             "config": s.get("config", {}) or {},
+            "k8s": s.get("k8s", {}) or {},
         }
         for s in spec.get("services", [])
     ]
@@ -49,7 +50,22 @@ def desired_objects(cr: dict) -> list[dict]:
     }
     owner = cr["metadata"]["name"]
     namespace = cr["metadata"].get("namespace", "default")
-    objs = render_k8s(manifest, fabric_host=spec.get("fabricHost", f"{owner}-fabric"))
+    # fabricExternal: the platform (helm chart) owns a persistent fabric;
+    # the graph's services rendezvous there instead of the operator
+    # rendering a per-graph fabric. An external fabric with no address
+    # would silently point pods at a nonexistent Service — fail loudly.
+    external = spec.get("fabricExternal", False)
+    if external and not spec.get("fabricHost"):
+        raise ValueError(
+            f"CR {owner}: fabricExternal requires fabricHost (the address "
+            "of the platform-managed fabric Service)"
+        )
+    objs = render_k8s(
+        manifest,
+        fabric_host=spec.get("fabricHost", f"{owner}-fabric"),
+        include_fabric=not external,
+        fabric_port=int(spec.get("fabricPort", 4222)),
+    )
     for obj in objs:
         meta = obj.setdefault("metadata", {})
         meta["namespace"] = namespace

@@ -73,10 +73,21 @@ def _k8s_name(s: str) -> str:
     return s.lower().replace("_", "-")
 
 
-def render_k8s(manifest: dict, fabric_host: str = "dynamo-fabric") -> list[dict]:
+def render_k8s(
+    manifest: dict,
+    fabric_host: str = "dynamo-fabric",
+    include_fabric: bool = True,
+    fabric_port: int = 4222,
+) -> list[dict]:
     """One Deployment per service (replicas from the graph), plus the
-    fabric control-plane Deployment + Service the workers rendezvous on."""
-    objs: list[dict] = [
+    fabric control-plane Deployment + Service the workers rendezvous on.
+    `include_fabric=False` points services at an EXTERNALLY-managed fabric
+    at `fabric_host:fabric_port` (platform-chart mode: one persistent
+    fabric shared by graphs, like the reference's shared etcd/NATS
+    platform services)."""
+    if not include_fabric:
+        return _service_objs(manifest, fabric_host, fabric_port)
+    objs = [
         {
             "apiVersion": "apps/v1",
             "kind": "Deployment",
@@ -93,9 +104,9 @@ def render_k8s(manifest: dict, fabric_host: str = "dynamo-fabric") -> list[dict]
                                 "image": manifest["image"],
                                 "command": [
                                     "python", "-m", "dynamo_tpu.cli.run",
-                                    "fabric", "--port", "4222",
+                                    "fabric", "--port", str(fabric_port),
                                 ],
-                                "ports": [{"containerPort": 4222}],
+                                "ports": [{"containerPort": fabric_port}],
                             }
                         ]
                     },
@@ -108,10 +119,19 @@ def render_k8s(manifest: dict, fabric_host: str = "dynamo-fabric") -> list[dict]
             "metadata": {"name": fabric_host},
             "spec": {
                 "selector": {"app": fabric_host},
-                "ports": [{"port": 4222, "targetPort": 4222}],
+                "ports": [
+                    {"port": fabric_port, "targetPort": fabric_port}
+                ],
             },
         },
     ]
+    return objs + _service_objs(manifest, fabric_host, fabric_port)
+
+
+def _service_objs(
+    manifest: dict, fabric_host: str, fabric_port: int = 4222
+) -> list[dict]:
+    objs: list[dict] = []
     for svc in manifest["services"]:
         name = _k8s_name(svc["name"])
         container = {
@@ -119,7 +139,7 @@ def render_k8s(manifest: dict, fabric_host: str = "dynamo-fabric") -> list[dict]
             "image": manifest["image"],
             "command": [
                 "python", "-m", "dynamo_tpu.sdk.serving",
-                svc["class"], "--fabric", f"{fabric_host}:4222",
+                svc["class"], "--fabric", f"{fabric_host}:{fabric_port}",
             ],
             "env": [
                 {"name": "DYNTPU_SERVICE_CONFIG",
@@ -129,6 +149,14 @@ def render_k8s(manifest: dict, fabric_host: str = "dynamo-fabric") -> list[dict]
         port = svc["config"].get("port")
         if port:
             container["ports"] = [{"containerPort": int(port)}]
+        # k8s scheduling passthrough (TPU nodepools/chips): the graph
+        # manifest can't know cluster topology, so the CR carries it
+        k8s = svc.get("k8s") or {}
+        if k8s.get("resources"):
+            container["resources"] = k8s["resources"]
+        pod_spec: dict = {"containers": [container]}
+        if k8s.get("nodeSelector"):
+            pod_spec["nodeSelector"] = k8s["nodeSelector"]
         objs.append(
             {
                 "apiVersion": "apps/v1",
@@ -139,7 +167,7 @@ def render_k8s(manifest: dict, fabric_host: str = "dynamo-fabric") -> list[dict]
                     "selector": {"matchLabels": {"app": name}},
                     "template": {
                         "metadata": {"labels": {"app": name}},
-                        "spec": {"containers": [container]},
+                        "spec": pod_spec,
                     },
                 },
             }
