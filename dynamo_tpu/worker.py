@@ -57,6 +57,7 @@ class Worker:
         kv_remote_min_blocks: int = 2,
         kv_remote_timeout_s: float = 5.0,
         echo_delay: float = 0.0,
+        mock_args=None,
     ):
         self.runtime = runtime
         self.card = card
@@ -94,6 +95,7 @@ class Worker:
         self.registration = None
         self.instance_id: str = ""
         self.echo_delay = echo_delay
+        self.mock_args = mock_args
         self._kv_event_buffer: list[KvEvent] = []
         self._tasks: list[asyncio.Task] = []
 
@@ -105,10 +107,22 @@ class Worker:
         elif self.engine_kind == "mock":
             from dynamo_tpu.mocker import MockEngine, MockEngineArgs
 
+            args = self.mock_args or MockEngineArgs(
+                page_size=self.card.kv_page_size, salt=self.card.name
+            )
+            if (
+                args.page_size != self.card.kv_page_size
+                or args.salt != self.card.name
+            ):
+                # Routers hash blocks with (card page size, card name) —
+                # a mismatched mock would emit events no router can match.
+                raise ValueError(
+                    f"mock_args page_size/salt ({args.page_size}, "
+                    f"{args.salt!r}) must match the card "
+                    f"({self.card.kv_page_size}, {self.card.name!r})"
+                )
             self.mock = MockEngine(
-                MockEngineArgs(
-                    page_size=self.card.kv_page_size, salt=self.card.name
-                ),
+                args,
                 on_kv_event=lambda e: self._kv_event_buffer.append(e),
             )
         else:

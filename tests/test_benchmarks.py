@@ -83,6 +83,35 @@ def test_bench_engine_and_sla_profile_tiny():
     assert planner is not None
 
 
+def test_routing_bench_smoke():
+    """routing_bench runs end to end at tiny scale and KV mode never does
+    WORSE than round-robin on hit rate for a shared-prefix workload."""
+    import asyncio
+
+    from benchmarks.routing_bench import bench
+
+    class A:
+        workers = 2
+        requests = 16
+        page = 8
+        pages = 64
+        depth = 4
+        branching = 2
+        suffix = 8
+        concurrency = 4
+        tick = 0.002
+        prefill_budget = 8
+
+    out = asyncio.run(bench(A()))
+    assert set(out["modes"]) == {"round_robin", "kv"}
+    for m in out["modes"].values():
+        assert m["ttft_ms"]["p50"] > 0
+    assert (
+        out["modes"]["kv"]["prefix_hit_rate"]
+        >= out["modes"]["round_robin"]["prefix_hit_rate"] - 0.05
+    )
+
+
 def test_sweep_parallel_configs_selects_per_chip(cpu_mesh_devices):
     """(tp, dp) sweep runs real mesh engines and picks the SLA-best per
     chip (reference profiler: sweeps TP, picks config meeting targets —
