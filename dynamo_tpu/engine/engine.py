@@ -64,6 +64,12 @@ class EngineMetrics:
     #: SpecDecodeStats (kv_router/protocols.rs:96)
     spec_drafted: int = 0
     spec_accepted: int = 0
+    #: why speculation DIDN'T run, by decode dispatch (observability:
+    #: "ineligible" = a sampling/logprob/penalty request in the batch
+    #: disables speculation batch-wide; "cooldown" = acceptance fell
+    #: below spec_min_accept_rate and the engine is backing off)
+    spec_skipped_ineligible: int = 0
+    spec_skipped_cooldown: int = 0
 
     def to_dict(self) -> dict:
         return dict(self.__dict__)
@@ -607,6 +613,9 @@ class JaxEngine:
             if self._spec_cooldown <= 0:
                 return self._run_decode_spec(reqs)
             self._spec_cooldown -= 1
+            self.metrics.spec_skipped_cooldown += 1
+        elif self.config.spec_ngram > 0:
+            self.metrics.spec_skipped_ineligible += 1
         return self._run_decode_plain(reqs)
 
     def _run_decode_plain(self, reqs: list[Request]) -> list[StepOutput]:

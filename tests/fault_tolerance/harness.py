@@ -10,63 +10,28 @@ fabric + frontend + echo workers and exposes kill/spawn/request.
 from __future__ import annotations
 
 import json
-import os
-import re
 import signal
-import subprocess
-import sys
 import tempfile
 import time
 import urllib.error
 import urllib.request
 
-REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-ENV = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+from benchmarks._procs import ENV as _BASE_ENV
+from benchmarks._procs import REPO, ManagedProc as _SharedProc
+from benchmarks._procs import cli as _shared_cli
+
+ENV = dict(_BASE_ENV, JAX_PLATFORMS="cpu")
 
 
-class ManagedProc:
-    """Subprocess with a log file and wait-for-pattern readiness."""
+class ManagedProc(_SharedProc):
+    """Shared machinery pinned to the CPU platform for FT scenarios."""
 
     def __init__(self, name: str, argv: list[str]):
-        self.name = name
-        self.log_path = tempfile.NamedTemporaryFile(
-            mode="w", suffix=f"-{name}.log", delete=False
-        ).name
-        self._log = open(self.log_path, "w")
-        self.proc = subprocess.Popen(
-            argv, cwd=REPO, env=ENV, stdout=self._log, stderr=subprocess.STDOUT
-        )
-
-    def wait_for(self, pattern: str, timeout: float = 30.0) -> None:
-        rx = re.compile(pattern)
-        deadline = time.time() + timeout
-        while time.time() < deadline:
-            with open(self.log_path) as f:
-                if rx.search(f.read()):
-                    return
-            if self.proc.poll() is not None:
-                raise AssertionError(
-                    f"{self.name} exited {self.proc.returncode} before "
-                    f"matching {pattern!r}:\n{open(self.log_path).read()}"
-                )
-            time.sleep(0.2)
-        raise AssertionError(
-            f"{self.name}: {pattern!r} not seen in {timeout}s:\n"
-            + open(self.log_path).read()
-        )
-
-    def kill(self, sig=signal.SIGKILL) -> None:
-        if self.proc.poll() is None:
-            self.proc.send_signal(sig)
-            self.proc.wait(timeout=10)
-
-    def stop(self) -> None:
-        self.kill(signal.SIGTERM)
-        self._log.close()
+        super().__init__(name, argv, env=ENV)
 
 
 def _cli(*args: str) -> list[str]:
-    return [sys.executable, "-m", "dynamo_tpu.cli.run", *args]
+    return _shared_cli(*args)
 
 
 class Cluster:

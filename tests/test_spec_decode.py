@@ -63,6 +63,9 @@ def test_spec_disabled_for_sampling_and_logprobs():
     )
     eng.run_to_completion()
     assert eng.metrics.spec_drafted == 0
+    # observability: the skip REASON is recorded (VERDICT weak #6)
+    assert eng.metrics.spec_skipped_ineligible > 0
+    assert eng.metrics.spec_skipped_cooldown == 0
 
     eng2 = _make(spec=4)
     eng2.add_request(
@@ -71,6 +74,7 @@ def test_spec_disabled_for_sampling_and_logprobs():
     )
     eng2.run_to_completion()
     assert eng2.metrics.spec_drafted == 0
+    assert eng2.metrics.spec_skipped_ineligible > 0
 
 
 def test_spec_with_prefix_cache_and_chunked_prefill():
