@@ -213,6 +213,7 @@ async def _run_worker(args) -> None:
         pw = PrefillWorker(
             rt, _engine_config(args), namespace=args.namespace,
             checkpoint_path=args.checkpoint,
+            advertise_host=args.host,
         )
         await pw.start()
         print(f"prefill worker {pw.instance_id} up (model={args.model})", flush=True)
@@ -239,6 +240,7 @@ async def _run_worker(args) -> None:
         disagg_config=_disagg_config(args),
         kv_remote=getattr(args, "kv_remote", False),
         echo_delay=getattr(args, "echo_delay", 0.0),
+        advertise_host=args.host,
     )
     await worker.start()
     print(f"worker {worker.instance_id} up (model={args.model})", flush=True)

@@ -39,9 +39,24 @@ from typing import Optional, Sequence
 logger = logging.getLogger(__name__)
 
 _MODE_ENV = "DYN_KV_TRANSFER"
+#: bind/advertise host for the transfer server (must be routable from
+#: peers in multi-host deployments; default binds all interfaces and
+#: advertises what PjRt reports)
+_ADDR_ENV = "DYN_TRANSFER_HOST"
+
+_advertise_host: Optional[str] = None
 
 _uuid_lock = threading.Lock()
 _uuid_next = 1
+
+
+def configure(advertise_host: Optional[str]) -> None:
+    """Set the host peers should PULL from BEFORE the plane first starts
+    (workers call this with their --host/advertise address). Loopback
+    stays unset — the default bind already serves same-host peers."""
+    global _advertise_host
+    if advertise_host and advertise_host not in ("127.0.0.1", "localhost"):
+        _advertise_host = advertise_host
 
 
 def _next_uuid() -> int:
@@ -77,7 +92,15 @@ class DevicePlane:
 
         self._jax = jax
         client = jax.devices()[0].client
-        self._server = jax_transfer.start_transfer_server(client)
+        host = os.environ.get(_ADDR_ENV) or _advertise_host
+        if host:
+            if ":" in host and not host.startswith("["):
+                host = f"[{host}]"  # IPv6 literals need brackets
+            self._server = jax_transfer.start_transfer_server(
+                client, address=f"{host}:0"
+            )
+        else:
+            self._server = jax_transfer.start_transfer_server(client)
         self._address = self._server.address()
         self._conns: dict[str, object] = {}
         self._conn_lock = threading.Lock()

@@ -36,7 +36,12 @@ class PrefillWorker:
         max_concurrent: int = 4,
         checkpoint_path: Optional[str] = None,
         runner: Optional[AsyncEngineRunner] = None,
+        advertise_host: str = "127.0.0.1",
     ):
+        from dynamo_tpu.disagg import device_transfer
+
+        # the prefill side STAGES pages; peers pull from this address
+        device_transfer.configure(advertise_host)
         self.runtime = runtime
         self.engine_config = engine_config
         self.namespace = namespace

@@ -152,7 +152,11 @@ class Worker:
 
         metadata = {"model": self.card.name}
         if (self.enable_disagg or self.kv_remote) and self.runner is not None:
-            from dynamo_tpu.disagg import KvTransferServer
+            from dynamo_tpu.disagg import KvTransferServer, device_transfer
+
+            # decode also serves G4 fetches / could stage in future
+            # reversals; advertise a routable pull address in multi-host
+            device_transfer.configure(self.advertise_host)
 
             runner = self.runner
 
