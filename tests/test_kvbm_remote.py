@@ -166,6 +166,54 @@ def test_directory_tracks_and_heals():
     run(main())
 
 
+def test_serve_adopt_fuzz():
+    """Bounded randomized interleaving of generate/serve/adopt between two
+    engines: outputs must stay equal to a fresh reference engine's, and
+    allocator accounting must return to zero active pages. Guards the G4
+    paths' page refcounting under churn."""
+    import random
+
+    rng = random.Random(11)
+    cfg = _tiered_cfg()
+    a, b = JaxEngine(cfg), JaxEngine(cfg)
+    ref_cache: dict[tuple, list] = {}
+
+    def ref_tokens(prompt, n):
+        key = (tuple(prompt), n)
+        if key not in ref_cache:
+            fresh = JaxEngine(cfg)
+            ref_cache[key] = _run_prompt(fresh, "r", prompt, n=n)
+        return ref_cache[key]
+
+    prompts = [
+        [int(x) for x in np.random.default_rng(s).integers(1, 99, 12)]
+        for s in range(4)
+    ]
+    from dynamo_tpu.tokens import hash_token_blocks
+
+    adopted = 0
+    for step in range(30):
+        src, dst = (a, b) if rng.random() < 0.5 else (b, a)
+        p = prompts[rng.randrange(len(prompts))]
+        op = rng.random()
+        if op < 0.5:
+            got = _run_prompt(src, f"g{step}", p, n=4)
+            assert got == ref_tokens(p, 4), f"divergence at step {step}"
+        else:
+            hashes = hash_token_blocks(p, block_size=4, salt="tiny")
+            served = src.serve_blocks(hashes)
+            if served is not None:
+                metas, k, v = served
+                adopted += dst.adopt_blocks(metas, k, v)
+    assert adopted > 0  # the fuzz genuinely exercised the G4 paths
+    for eng in (a, b):
+        assert eng.allocator.num_active == 0
+        # every chain (including late adopts) still decodes correctly
+        for i, p in enumerate(prompts):
+            got = _run_prompt(eng, f"final{i}", p, n=4)
+            assert got == ref_tokens(p, 4)
+
+
 def test_cross_worker_onboarding_e2e(monkeypatch):
     """Two workers on one fabric: worker A serves a prompt; the same
     prompt sent to cold worker B onboards A's blocks over the transfer
