@@ -51,8 +51,9 @@ class LlamaConfig:
     rope_high_freq_factor: float = 4.0
     rope_original_max_position: int = 8192
     dtype: Any = jnp.bfloat16
-    #: "xla" (gather path, any T) | "pallas" (DMA kernel for decode T=1;
-    #: prefill chunks still take the XLA path)
+    #: "xla" (gather path, any T) | "pallas" (flash kernels: page-walk DMA
+    #: decode for T=1, VMEM-tiled causal flash for first-chunk prefill;
+    #: history-chunk prefill still takes the XLA gather path)
     attention_impl: str = "xla"
     #: q/k/v projection bias — the Qwen2 family's one architectural delta
     attention_bias: bool = False
@@ -583,13 +584,17 @@ def _chunk_only_attention(q, k, v, positions, valid, cfg, dpad, mesh=None):
     ppermute of K/V blocks — parallel/context.py), so a prompt too long
     for one chip's attention memory prefills across the sp group. Valid
     first-chunk positions are contiguous from 0, so index-causal masking
-    equals position masking; padding sits past every valid query."""
-    if dpad:
-        k = k[..., : cfg.head_dim]
-        v = v[..., : cfg.head_dim]
+    equals position masking; padding sits past every valid query.
+
+    Under attention_impl="pallas" (and no sp ring), the chunk runs the
+    flash kernel (ops/flash_prefill.py): online softmax in VMEM instead
+    of materializing [B, H, T, T] fp32 scores in HBM."""
     sp = mesh.shape.get("sp", 1) if mesh is not None else 1
     t = q.shape[1]
     if sp > 1 and t % sp == 0 and t > 1:
+        if dpad:
+            k = k[..., : cfg.head_dim]
+            v = v[..., : cfg.head_dim]
         from dynamo_tpu.parallel.context import ring_attention
 
         out = ring_attention(
@@ -599,6 +604,21 @@ def _chunk_only_attention(q, k, v, positions, valid, cfg, dpad, mesh=None):
         )
         b, _, hq, d = q.shape
         return out.reshape(b, t, hq * d)
+    if cfg.attention_impl == "pallas":
+        from dynamo_tpu.ops.flash_prefill import flash_prefill_attention
+
+        qp = jnp.pad(q, ((0, 0), (0, 0), (0, 0), (0, dpad))) if dpad else q
+        valid_len = jnp.sum(valid, axis=1).astype(jnp.int32)
+        out = flash_prefill_attention(
+            qp, k, v, valid_len, scale_dim=cfg.head_dim, mesh=mesh
+        )
+        if dpad:
+            out = out[..., : cfg.head_dim]
+        b, _, hq, d = q.shape
+        return out.reshape(b, t, hq * cfg.head_dim).astype(q.dtype)
+    if dpad:
+        k = k[..., : cfg.head_dim]
+        v = v[..., : cfg.head_dim]
     cur_pos = jnp.where(valid, positions, jnp.int32(1 << 30))
     return paged_attention(q, k, v, positions, cfg, key_positions=cur_pos)
 

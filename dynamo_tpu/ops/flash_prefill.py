@@ -1,0 +1,180 @@
+"""Pallas TPU flash attention for prefill chunks (causal, GQA).
+
+The XLA first-chunk path (models/llama.py:_chunk_only_attention →
+paged_attention) materializes fp32 scores [B, Hkv, g, T, T] in HBM — at
+the north-star ISL (3000) that is hundreds of MB of score traffic per
+layer. This kernel computes the same causal attention with an online
+softmax: scores live in VMEM one [BQ·g, BK] tile at a time, K/V stream
+through VMEM once, nothing is materialized.
+
+Grid: (B, Hkv, T/BQ) — one cell per (sequence, kv head, query block); the
+g query heads sharing a kv head fold into the tile's rows. The causal
+frontier prunes key blocks strictly above the diagonal, and a per-sequence
+`valid_len` (scalar-prefetched) masks the padding tail, matching the
+fallback's semantics (invalid queries produce ignored rows).
+
+Parity note: the reference gets its prefill kernels from vLLM/TRT-LLM
+(engine-delegated, SURVEY.md §2.9); here the engine is first-class so the
+kernel lives in-tree, next to the decode kernel (ops/paged_attention.py).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+#: q/k tile rows; T is padded to a multiple (masked out)
+BLOCK = 128
+
+
+def _prefill_kernel(
+    # scalar prefetch
+    len_ref,  # [B] int32 valid token counts
+    # inputs (VMEM blocks)
+    q_ref,  # [1, 1, G, BQ, D]
+    k_ref,  # [1, 1, T, D]
+    v_ref,  # [1, 1, T, D]
+    # output
+    o_ref,  # [1, 1, G, BQ, D]
+    *,
+    scale_dim: int,
+    block: int,
+):
+    b = pl.program_id(0)
+    qi = pl.program_id(2)
+    g, bq, d = q_ref.shape[2], q_ref.shape[3], q_ref.shape[4]
+    valid = len_ref[b]
+    scale = 1.0 / math.sqrt(scale_dim)
+
+    q = q_ref[0, 0].astype(jnp.float32).reshape(g * bq, d) * scale
+    row_pos = jax.lax.broadcasted_iota(jnp.int32, (g, bq), 1).reshape(
+        g * bq
+    ) + qi * bq  # absolute query positions, per folded row
+
+    acc0 = jnp.zeros((g * bq, d), jnp.float32)
+    m0 = jnp.full((g * bq,), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((g * bq,), jnp.float32)
+
+    def body(j, carry):
+        acc, m, l = carry
+        k_blk = jax.lax.dynamic_slice_in_dim(
+            k_ref[0, 0], j * block, block, axis=0
+        ).astype(jnp.float32)  # [BK, D]
+        v_blk = jax.lax.dynamic_slice_in_dim(
+            v_ref[0, 0], j * block, block, axis=0
+        ).astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [G*BQ, BK]
+        col_pos = jax.lax.broadcasted_iota(jnp.int32, (1, block), 1) + j * block
+        mask = (col_pos <= row_pos[:, None]) & (col_pos < valid)
+        s = jnp.where(mask, s, -1e30)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[:, None])
+        l_new = alpha * l + jnp.sum(p, axis=-1)
+        acc_new = alpha[:, None] * acc + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return acc_new, m_new, l_new
+
+    # causal frontier: key blocks 0..qi inclusive (BQ == BK aligned)
+    acc, m, l = jax.lax.fori_loop(0, qi + 1, body, (acc0, m0, l0))
+    out = acc / jnp.maximum(l, 1e-30)[:, None]  # masked rows stay finite
+    o_ref[0, 0] = out.reshape(g, bq, d).astype(o_ref.dtype)
+
+
+def flash_prefill_attention(
+    q: jax.Array,  # [B, T, Hq, D] post-rope (D may be lane-padded)
+    k: jax.Array,  # [B, T, Hkv, D] post-rope
+    v: jax.Array,  # [B, T, Hkv, D]
+    valid_len: jax.Array,  # [B] int32 — contiguous valid prefix length
+    *,
+    scale_dim: int | None = None,
+    interpret: bool | None = None,
+    mesh=None,
+) -> jax.Array:
+    """Causal flash attention over one prefill chunk. Returns
+    [B, T, Hq, D]; rows at positions >= valid_len are unspecified (the
+    engine ignores them, same contract as the XLA fallback).
+
+    `interpret` defaults to True off-TPU so tests run the kernel on CPU.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if mesh is not None and mesh.shape.get("tp", 1) > 1:
+        from functools import partial
+
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        fn = shard_map(
+            partial(
+                flash_prefill_attention,
+                scale_dim=scale_dim, interpret=interpret, mesh=None,
+            ),
+            mesh=mesh,
+            in_specs=(
+                P(None, None, "tp", None),
+                P(None, None, "tp", None),
+                P(None, None, "tp", None),
+                P(),
+            ),
+            out_specs=P(None, None, "tp", None),
+            check_vma=False,
+        )
+        return fn(q, k, v, valid_len)
+
+    b, t, hq, d = q.shape
+    hkv = k.shape[2]
+    g = hq // hkv
+    tp = -(-t // BLOCK) * BLOCK
+    if tp != t:
+        pad = ((0, 0), (0, tp - t), (0, 0), (0, 0))
+        q = jnp.pad(q, pad)
+        k = jnp.pad(k, pad)
+        v = jnp.pad(v, pad)
+    # head-major layouts: q [B, Hkv, G, T, D] (the g heads of a kv group
+    # are adjacent because Hq ordering is group-major), k/v [B, Hkv, T, D]
+    qh = q.reshape(b, tp, hkv, g, d).transpose(0, 2, 3, 1, 4)
+    kh = k.transpose(0, 2, 1, 3)
+    vh = v.transpose(0, 2, 1, 3)
+
+    grid = (b, hkv, tp // BLOCK)
+    out = pl.pallas_call(
+        functools.partial(
+            _prefill_kernel, scale_dim=scale_dim or d, block=BLOCK
+        ),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec(
+                    (1, 1, g, BLOCK, d),
+                    lambda bi, hi, qi, ln: (bi, hi, 0, qi, 0),
+                ),
+                pl.BlockSpec(
+                    (1, 1, tp, d), lambda bi, hi, qi, ln: (bi, hi, 0, 0)
+                ),
+                pl.BlockSpec(
+                    (1, 1, tp, d), lambda bi, hi, qi, ln: (bi, hi, 0, 0)
+                ),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, 1, g, BLOCK, d),
+                lambda bi, hi, qi, ln: (bi, hi, 0, qi, 0),
+            ),
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, hkv, g, tp, d), q.dtype),
+        interpret=interpret,
+    )(valid_len.astype(jnp.int32), qh, kh, vh)
+    # back to [B, T, Hq, D]
+    out = out.transpose(0, 3, 1, 2, 4)
+    return out.reshape(b, tp, hq, d)[:, :t]
