@@ -714,29 +714,59 @@ def attention_block(
         attn = _chunk_only_attention(
             q, k, v, positions, valid, cfg, dpad, mesh=mesh
         )
-    else:
-        # Prefill chunk: history pages (positions < chunk start) + the
-        # current chunk in registers, one causal mask over both.
-        k_hist = paged_gather(k_cache, layer, page_tables)  # [B,K,Hkv,Dp]
-        v_hist = paged_gather(v_cache, layer, page_tables)
-        kk = k_hist.shape[1]
+    elif t <= 1024:
+        # Prefill chunk with history: paged pages (positions < chunk
+        # start) + the current chunk, one online softmax — the flash
+        # kernel walks pages with double-buffered DMA instead of
+        # materializing the gathered history densely in HBM. The kernel
+        # holds the whole current chunk's K/V in VMEM per grid cell, so
+        # very large chunks (t > 1024) take the XLA path below instead of
+        # oversubscribing VMEM.
+        from dynamo_tpu.ops.flash_prefill import paged_prefill_attention
+
+        qp = jnp.pad(q, ((0, 0), (0, 0), (0, 0), (0, dpad))) if dpad else q
         start = positions[:, 0]
-        hist_pos = jnp.arange(kk, dtype=jnp.int32)[None, :]
-        # Mask unwritten (>= chunk start) gathered slots outright.
-        hist_pos = jnp.where(
-            hist_pos < start[:, None], hist_pos, jnp.int32(1 << 30)
+        hist_lens = jnp.where(valid[:, 0], start, 0).astype(jnp.int32)
+        cur_lens = jnp.sum(valid, axis=1).astype(jnp.int32)
+        out = paged_prefill_attention(
+            qp, k, v, k_cache, v_cache, layer, page_tables,
+            hist_lens, cur_lens, scale_dim=cfg.head_dim, mesh=mesh,
         )
-        cur_pos = jnp.where(valid, positions, jnp.int32(1 << 30))
-        keys = jnp.concatenate([k_hist, k], axis=1)
-        vals = jnp.concatenate([v_hist, v], axis=1)
-        key_positions = jnp.concatenate([hist_pos, cur_pos], axis=1)
         if dpad:
-            keys = keys[..., : cfg.head_dim]
-            vals = vals[..., : cfg.head_dim]
-        attn = paged_attention(
-            q, keys, vals, positions, cfg, key_positions=key_positions
+            out = out[..., : cfg.head_dim]
+        attn = out.reshape(b, t, cfg.num_heads * cfg.head_dim).astype(q.dtype)
+    else:
+        attn = _xla_history_attention(
+            q, k, v, k_cache, v_cache, layer, page_tables, positions,
+            valid, cfg, dpad,
         )
     return attn, k_cache, v_cache, (k, v)
+
+
+def _xla_history_attention(
+    q, k, v, k_cache, v_cache, layer, page_tables, positions, valid, cfg, dpad
+):
+    """Gather-then-attend fallback for history chunks too large for the
+    flash kernel's VMEM budget."""
+    k_hist = paged_gather(k_cache, layer, page_tables)  # [B,K,Hkv,Dp]
+    v_hist = paged_gather(v_cache, layer, page_tables)
+    kk = k_hist.shape[1]
+    start = positions[:, 0]
+    hist_pos = jnp.arange(kk, dtype=jnp.int32)[None, :]
+    # Mask unwritten (>= chunk start) gathered slots outright.
+    hist_pos = jnp.where(
+        hist_pos < start[:, None], hist_pos, jnp.int32(1 << 30)
+    )
+    cur_pos = jnp.where(valid, positions, jnp.int32(1 << 30))
+    keys = jnp.concatenate([k_hist, k], axis=1)
+    vals = jnp.concatenate([v_hist, v], axis=1)
+    key_positions = jnp.concatenate([hist_pos, cur_pos], axis=1)
+    if dpad:
+        keys = keys[..., : cfg.head_dim]
+        vals = vals[..., : cfg.head_dim]
+    return paged_attention(
+        q, keys, vals, positions, cfg, key_positions=key_positions
+    )
 
 
 # ---------------------------------------------------------------------------

@@ -91,6 +91,282 @@ def _prefill_kernel(
     o_ref[0, 0] = out.reshape(g, bq, d).astype(o_ref.dtype)
 
 
+def _hist_kernel(
+    # scalar prefetch
+    layer_ref,  # [1] int32
+    pt_ref,  # [B, MP] int32 page tables (SMEM)
+    hist_ref,  # [B] int32 — tokens already in the cache (chunk start)
+    cur_ref,  # [B] int32 — valid tokens in THIS chunk
+    # inputs
+    q_ref,  # [1, BQ, HQ, D] VMEM (post-rope, unscaled)
+    kcur_ref,  # [1, T, Hkv, D] VMEM — this chunk's keys (post-rope)
+    vcur_ref,  # [1, T, Hkv, D] VMEM
+    k_hbm,  # [L, P, S, Hkv, D] ANY
+    v_hbm,  # [L, P, S, Hkv, D] ANY
+    # output
+    o_ref,  # [1, BQ, HQ, D]
+    # scratch
+    k_scr,  # [2, S, Hkv, D] VMEM
+    v_scr,  # [2, S, Hkv, D] VMEM
+    sem,  # [2, 2] DMA semaphores
+    *,
+    page_size: int,
+    scale_dim: int,
+    num_kv_heads: int,
+):
+    b = pl.program_id(0)
+    qi = pl.program_id(1)
+    li = layer_ref[0]
+    bq, hq, d = q_ref.shape[1], q_ref.shape[2], q_ref.shape[3]
+    t = kcur_ref.shape[1]
+    g = hq // num_kv_heads
+    s = page_size
+    hist = hist_ref[b]
+    cur = cur_ref[b]
+    used = pl.cdiv(hist, s)
+
+    def k_copy(slot, i):
+        return pltpu.make_async_copy(
+            k_hbm.at[li, pt_ref[b, i]], k_scr.at[slot], sem.at[0, slot]
+        )
+
+    def v_copy(slot, i):
+        return pltpu.make_async_copy(
+            v_hbm.at[li, pt_ref[b, i]], v_scr.at[slot], sem.at[1, slot]
+        )
+
+    @pl.when(used > 0)
+    def _():
+        k_copy(0, 0).start()
+        v_copy(0, 0).start()
+
+    scale = 1.0 / math.sqrt(scale_dim)
+    # per-head query tiles [G·BQ, D], group-major like the cache layout
+    q = q_ref[0].astype(jnp.float32) * scale  # [BQ, HQ, D]
+
+    def qh_tile(h):
+        return (
+            q[:, h * g : (h + 1) * g]
+            .transpose(1, 0, 2)
+            .reshape(g * bq, d)
+        )
+
+    # -- history pages (every key position < hist: no causal test) --------
+    def body(i, carry):
+        ms, ls, accs = carry
+        slot = jax.lax.rem(i, 2)
+
+        @pl.when(i + 1 < used)
+        def _():
+            k_copy(1 - slot, i + 1).start()
+            v_copy(1 - slot, i + 1).start()
+
+        k_copy(slot, i).wait()
+        v_copy(slot, i).wait()
+        kp = k_scr[slot].astype(jnp.float32)  # [S, Hkv, D]
+        vp = v_scr[slot].astype(jnp.float32)
+        key_pos = i * s + jax.lax.broadcasted_iota(jnp.int32, (1, s), 1)
+        key_mask = key_pos < hist  # [1, S] — the last page may be partial
+
+        m_out, l_out, a_out = [], [], []
+        for h in range(num_kv_heads):  # static unroll
+            scores = jax.lax.dot_general(
+                qh_tile(h), kp[:, h], (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # [G·BQ, S]
+            scores = jnp.where(key_mask, scores, -1e30)
+            m_new = jnp.maximum(
+                ms[h], jnp.max(scores, axis=1, keepdims=True)
+            )
+            p = jnp.exp(scores - m_new)
+            corr = jnp.exp(ms[h] - m_new)
+            l_new = ls[h] * corr + jnp.sum(p, axis=1, keepdims=True)
+            a_new = accs[h] * corr + jax.lax.dot_general(
+                p, vp[:, h], (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            m_out.append(m_new)
+            l_out.append(l_new)
+            a_out.append(a_new)
+        return tuple(m_out), tuple(l_out), tuple(a_out)
+
+    init = (
+        tuple(
+            jnp.full((g * bq, 1), -jnp.inf, jnp.float32)
+            for _ in range(num_kv_heads)
+        ),
+        tuple(jnp.zeros((g * bq, 1), jnp.float32) for _ in range(num_kv_heads)),
+        tuple(jnp.zeros((g * bq, d), jnp.float32) for _ in range(num_kv_heads)),
+    )
+    ms, ls, accs = jax.lax.fori_loop(0, used, body, init)
+
+    # -- the current chunk (causal within the chunk, padding masked) -------
+    # Key blocks strictly above the causal diagonal are pruned: block j
+    # only matters for q block qi when j <= qi (BQ-aligned), mirroring
+    # _prefill_kernel's frontier loop.
+    row_rel = qi * bq + jax.lax.broadcasted_iota(
+        jnp.int32, (g, bq), 1
+    ).reshape(g * bq, 1)
+
+    def cur_body(j, carry):
+        ms, ls, accs = carry
+        col_rel = j * bq + jax.lax.broadcasted_iota(jnp.int32, (1, bq), 1)
+        cmask = (col_rel <= row_rel) & (col_rel < cur)  # [G·BQ, BQ]
+        m_out, l_out, a_out = [], [], []
+        for h in range(num_kv_heads):
+            kc = jax.lax.dynamic_slice_in_dim(
+                kcur_ref[0, :, h], j * bq, bq, axis=0
+            ).astype(jnp.float32)  # [BQ, D]
+            vc = jax.lax.dynamic_slice_in_dim(
+                vcur_ref[0, :, h], j * bq, bq, axis=0
+            ).astype(jnp.float32)
+            scores = jax.lax.dot_general(
+                qh_tile(h), kc, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # [G·BQ, BQ]
+            scores = jnp.where(cmask, scores, -1e30)
+            m_new = jnp.maximum(
+                ms[h], jnp.max(scores, axis=1, keepdims=True)
+            )
+            p = jnp.exp(scores - m_new)
+            corr = jnp.exp(ms[h] - m_new)
+            l_new = ls[h] * corr + jnp.sum(p, axis=1, keepdims=True)
+            a_new = accs[h] * corr + jax.lax.dot_general(
+                p, vc, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            m_out.append(m_new)
+            l_out.append(l_new)
+            a_out.append(a_new)
+        return tuple(m_out), tuple(l_out), tuple(a_out)
+
+    ms, ls, accs = jax.lax.fori_loop(0, qi + 1, cur_body, (ms, ls, accs))
+    outs = []
+    for h in range(num_kv_heads):
+        out = accs[h] / jnp.maximum(ls[h], 1e-30)  # [G·BQ, D]
+        outs.append(out.reshape(g, bq, d))
+    # [HQ(group-major), BQ, D] -> [BQ, HQ, D]
+    o_ref[0] = (
+        jnp.concatenate(outs, axis=0).transpose(1, 0, 2).astype(o_ref.dtype)
+    )
+
+
+def paged_prefill_attention(
+    q: jax.Array,  # [B, T, Hq, D] post-rope chunk queries (D lane-padded)
+    k_cur: jax.Array,  # [B, T, Hkv, D] this chunk's keys (post-rope)
+    v_cur: jax.Array,  # [B, T, Hkv, D]
+    k_cache: jax.Array,  # [L, P, S, Hkv, D] stacked cache (history)
+    v_cache: jax.Array,
+    layer: jax.Array,  # scalar int32
+    page_tables: jax.Array,  # [B, MP] int32
+    hist_lens: jax.Array,  # [B] int32 — tokens already written to pages
+    cur_lens: jax.Array,  # [B] int32 — valid tokens in this chunk
+    *,
+    scale_dim: int | None = None,
+    interpret: bool | None = None,
+    mesh=None,
+) -> jax.Array:
+    """History-chunk prefill attention: paged history walked with
+    double-buffered DMA (read once per q block) + the in-register current
+    chunk, one online softmax over both — replaces the XLA
+    gather-then-attend path, which materializes the whole history densely
+    in HBM before a single matmul touches it.
+
+    Returns [B, T, Hq, D]; rows past cur_lens are unspecified.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if mesh is not None and mesh.shape.get("tp", 1) > 1:
+        from functools import partial
+
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        fn = shard_map(
+            partial(
+                paged_prefill_attention,
+                scale_dim=scale_dim, interpret=interpret, mesh=None,
+            ),
+            mesh=mesh,
+            in_specs=(
+                P(None, None, "tp", None),
+                P(None, None, "tp", None),
+                P(None, None, "tp", None),
+                P(None, None, None, "tp", None),
+                P(None, None, None, "tp", None),
+                P(), P(), P(), P(),
+            ),
+            out_specs=P(None, None, "tp", None),
+            check_vma=False,
+        )
+        return fn(
+            q, k_cur, v_cur, k_cache, v_cache, layer, page_tables,
+            hist_lens, cur_lens,
+        )
+
+    b, t, hq, d = q.shape
+    hkv, s = k_cache.shape[3], k_cache.shape[2]
+    bq = BLOCK
+    tp = -(-t // bq) * bq
+    if tp != t:
+        qpad = ((0, 0), (0, tp - t), (0, 0), (0, 0))
+        q = jnp.pad(q, qpad)
+        k_cur = jnp.pad(k_cur, qpad)  # BQ-aligned key blocks for the
+        v_cur = jnp.pad(v_cur, qpad)  # frontier loop (cur masks the tail)
+
+    grid = (b, tp // bq)
+    out = pl.pallas_call(
+        functools.partial(
+            _hist_kernel,
+            page_size=s,
+            scale_dim=scale_dim or d,
+            num_kv_heads=hkv,
+        ),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=4,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec(
+                    (1, bq, hq, d),
+                    lambda bi, qi, li, pt, hl, cl: (bi, qi, 0, 0),
+                ),
+                pl.BlockSpec(
+                    (1, tp, hkv, d),
+                    lambda bi, qi, li, pt, hl, cl: (bi, 0, 0, 0),
+                ),
+                pl.BlockSpec(
+                    (1, tp, hkv, d),
+                    lambda bi, qi, li, pt, hl, cl: (bi, 0, 0, 0),
+                ),
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec(memory_space=pl.ANY),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, bq, hq, d),
+                lambda bi, qi, li, pt, hl, cl: (bi, qi, 0, 0),
+            ),
+            scratch_shapes=[
+                pltpu.VMEM((2, s, hkv, d), k_cache.dtype),
+                pltpu.VMEM((2, s, hkv, d), v_cache.dtype),
+                pltpu.SemaphoreType.DMA((2, 2)),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, tp, hq, d), q.dtype),
+        interpret=interpret,
+    )(
+        jnp.asarray(layer, jnp.int32).reshape(1),
+        page_tables.astype(jnp.int32),
+        hist_lens.astype(jnp.int32),
+        cur_lens.astype(jnp.int32),
+        q,
+        k_cur,
+        v_cur,
+        k_cache,
+        v_cache,
+    )
+    return out[:, :t]
+
+
 def flash_prefill_attention(
     q: jax.Array,  # [B, T, Hq, D] post-rope (D may be lane-padded)
     k: jax.Array,  # [B, T, Hkv, D] post-rope

@@ -86,6 +86,128 @@ def test_scale_dim_override():
     np.testing.assert_allclose(got, ref, rtol=2e-5, atol=2e-5)
 
 
+def _ref_hist(q, kc, vc, k_cache, v_cache, layer, pt, hist, cur, scale_dim):
+    """Dense reference: gather history pages + concat current chunk (the
+    old XLA path's semantics)."""
+    b, t, hq, d = q.shape
+    s = k_cache.shape[2]
+    outs = []
+    for bi in range(b):
+        if cur[bi] == 0:  # dead (padded) row: output unspecified
+            outs.append(np.zeros((t, hq, d), np.float32))
+            continue
+        kh = k_cache[layer, pt[bi]].reshape(-1, k_cache.shape[3], d)[: hist[bi]]
+        vh = v_cache[layer, pt[bi]].reshape(-1, k_cache.shape[3], d)[: hist[bi]]
+        keys = np.concatenate([kh, kc[bi, : cur[bi]]], axis=0)
+        vals = np.concatenate([vh, vc[bi, : cur[bi]]], axis=0)
+        n = keys.shape[0]
+        hkv = keys.shape[1]
+        g = hq // hkv
+        qf = q[bi].astype(np.float32).reshape(t, hkv, g, d)
+        scores = np.einsum("tkgd,skd->kgts", qf, keys.astype(np.float32))
+        scores /= np.sqrt(scale_dim)
+        key_pos = np.arange(n)
+        row_pos = hist[bi] + np.arange(t)
+        mask = key_pos[None, None, None, :] <= row_pos[None, None, :, None]
+        scores = np.where(mask, scores, -1e30)
+        p = np.exp(scores - scores.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        o = np.einsum("kgts,skd->tkgd", p, vals.astype(np.float32))
+        outs.append(o.reshape(t, hq, d))
+    return np.stack(outs)
+
+
+@pytest.mark.parametrize(
+    "b,t,hq,hkv,hist,cur",
+    [
+        (2, 128, 4, 2, (128, 65), (128, 90)),   # full + ragged chunk
+        (1, 256, 8, 2, (192,), (256,)),         # GQA g=4, multi-page hist
+        (2, 128, 2, 2, (64, 0), (128, 0)),      # one padded (dead) row
+    ],
+)
+def test_paged_history_matches_dense(b, t, hq, hkv, hist, cur):
+    from dynamo_tpu.ops.flash_prefill import paged_prefill_attention
+
+    d, s, num_pages, mp = 128, 64, 16, 8
+    layers = 1
+    rng = np.random.default_rng(hash((b, t, hq, hist)) % 2**31)
+    q = rng.standard_normal((b, t, hq, d)).astype(np.float32)
+    kc = rng.standard_normal((b, t, hkv, d)).astype(np.float32)
+    vc = rng.standard_normal((b, t, hkv, d)).astype(np.float32)
+    k_cache = rng.standard_normal((layers, num_pages, s, hkv, d)).astype(
+        np.float32
+    )
+    v_cache = rng.standard_normal((layers, num_pages, s, hkv, d)).astype(
+        np.float32
+    )
+    # distinct pages per sequence
+    pt = np.stack(
+        [np.arange(1 + bi * mp, 1 + bi * mp + mp) % num_pages for bi in range(b)]
+    ).astype(np.int32)
+    hist = np.asarray(hist, np.int32)
+    cur = np.asarray(cur, np.int32)
+
+    got = np.asarray(
+        paged_prefill_attention(
+            jnp.asarray(q), jnp.asarray(kc), jnp.asarray(vc),
+            jnp.asarray(k_cache), jnp.asarray(v_cache),
+            jnp.int32(0), jnp.asarray(pt), jnp.asarray(hist),
+            jnp.asarray(cur), scale_dim=d, interpret=True,
+        )
+    )
+    ref = _ref_hist(q, kc, vc, k_cache, v_cache, 0, pt, hist, cur, d)
+    for bi in range(b):
+        n = cur[bi]
+        if n == 0:
+            continue
+        np.testing.assert_allclose(
+            got[bi, :n], ref[bi, :n], rtol=2e-5, atol=2e-5
+        )
+
+
+def test_paged_history_tp_shard_and_layer(cpu_mesh_devices):
+    """paged_prefill_attention under a tp mesh == unsharded, reading a
+    NONZERO layer of the stacked cache."""
+    from dynamo_tpu.ops.flash_prefill import paged_prefill_attention
+    from dynamo_tpu.parallel import MeshConfig, make_mesh
+
+    b, t, hq, hkv, d, s, num_pages, mp, layers = 1, 128, 4, 2, 128, 64, 8, 4, 3
+    layer = 2
+    rng = np.random.default_rng(9)
+    q = jnp.asarray(rng.standard_normal((b, t, hq, d)), jnp.float32)
+    kc = jnp.asarray(rng.standard_normal((b, t, hkv, d)), jnp.float32)
+    vc = jnp.asarray(rng.standard_normal((b, t, hkv, d)), jnp.float32)
+    k_cache = jnp.asarray(
+        rng.standard_normal((layers, num_pages, s, hkv, d)), jnp.float32
+    )
+    v_cache = jnp.asarray(
+        rng.standard_normal((layers, num_pages, s, hkv, d)), jnp.float32
+    )
+    pt = jnp.asarray([[1, 2, 3, 4]], jnp.int32)
+    hist = jnp.asarray([130], jnp.int32)  # partial third page
+    cur = jnp.asarray([t], jnp.int32)
+
+    args = (q, kc, vc, k_cache, v_cache, jnp.int32(layer), pt, hist, cur)
+    ref = np.asarray(
+        paged_prefill_attention(*args, scale_dim=d, interpret=True)
+    )
+    # cross-check layer indexing against the dense reference too
+    dense = _ref_hist(
+        np.asarray(q), np.asarray(kc), np.asarray(vc),
+        np.asarray(k_cache), np.asarray(v_cache), layer,
+        np.asarray(pt), np.asarray(hist), np.asarray(cur), d,
+    )
+    np.testing.assert_allclose(ref, dense, rtol=2e-5, atol=2e-5)
+
+    mesh = make_mesh(MeshConfig(dp=1, tp=2, sp=1))
+    got = np.asarray(
+        paged_prefill_attention(
+            *args, scale_dim=d, interpret=True, mesh=mesh
+        )
+    )
+    np.testing.assert_allclose(got, ref, rtol=2e-5, atol=2e-5)
+
+
 def test_tp_shard_map(cpu_mesh_devices):
     """Head-sharded kernel under a tp mesh == unsharded."""
     from dynamo_tpu.parallel import MeshConfig, make_mesh
