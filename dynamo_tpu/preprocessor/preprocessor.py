@@ -9,6 +9,7 @@ chunks (transform_postprocessor_stream :335 + backend.rs Decoder).
 
 from __future__ import annotations
 
+import logging
 from dataclasses import dataclass, field
 from typing import Any, AsyncIterator, Optional
 
@@ -25,6 +26,8 @@ from dynamo_tpu.protocols.openai import (
     new_request_id,
     now,
 )
+
+logger = logging.getLogger(__name__)
 
 DEFAULT_MAX_TOKENS = 512
 
@@ -155,9 +158,19 @@ class OpenAIPreprocessor:
             )
             for m in messages
         ):
+            if getattr(request, "tools", None):
+                # the multimodal prompt is assembled piecewise in the
+                # fallback format, which has no tool section — surface the
+                # drop instead of silently hiding the definitions
+                logger.warning(
+                    "tools ignored for multimodal request (no template "
+                    "rendering on the multimodal path)"
+                )
             ids, mm_embeds, mm_positions = self._multimodal_prompt(messages)
         else:
-            prompt = self.tokenizer.apply_chat_template(messages)
+            prompt = self.tokenizer.apply_chat_template(
+                messages, tools=getattr(request, "tools", None)
+            )
             ids, mm_embeds, mm_positions = (
                 self.tokenizer.encode(prompt), None, []
             )

@@ -23,7 +23,9 @@ class Tokenizer(Protocol):
 
     def decode(self, ids: Sequence[int]) -> str: ...
 
-    def apply_chat_template(self, messages: list[dict]) -> str: ...
+    def apply_chat_template(
+        self, messages: list[dict], tools: Optional[list[dict]] = None
+    ) -> str: ...
 
     def token_bytes(self, tok: int) -> bytes:
         """The exact bytes one token contributes to the output stream —
@@ -75,7 +77,9 @@ class ByteTokenizer:
     def token_bytes(self, tok: int) -> bytes:
         return bytes([tok]) if 0 <= tok < 256 else b""
 
-    def apply_chat_template(self, messages: list[dict]) -> str:
+    def apply_chat_template(
+        self, messages: list[dict], tools: Optional[list[dict]] = None
+    ) -> str:
         return render_fallback_template(messages)
 
 
@@ -117,11 +121,14 @@ class HfTokenizer:
         except KeyError:
             return piece.encode()
 
-    def apply_chat_template(self, messages: list[dict]) -> str:
+    def apply_chat_template(
+        self, messages: list[dict], tools: Optional[list[dict]] = None
+    ) -> str:
         try:
-            return self._tok.apply_chat_template(
-                messages, tokenize=False, add_generation_prompt=True
-            )
+            kwargs = {"tokenize": False, "add_generation_prompt": True}
+            if tools:
+                kwargs["tools"] = tools  # HF templates render these natively
+            return self._tok.apply_chat_template(messages, **kwargs)
         except Exception:
             return render_fallback_template(messages)
 
@@ -266,9 +273,13 @@ class GgufTokenizer:
             text = text[1:]
         return text
 
-    def apply_chat_template(self, messages: list[dict]) -> str:
+    def apply_chat_template(
+        self, messages: list[dict], tools: Optional[list[dict]] = None
+    ) -> str:
         # GGUF carries a jinja template string; rendering it would need a
-        # jinja engine — use the structured fallback format instead.
+        # jinja engine — use the structured fallback format instead
+        # (tools accepted for interface parity; the fallback format has
+        # no tool section).
         return render_fallback_template(messages)
 
 

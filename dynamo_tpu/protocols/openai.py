@@ -53,6 +53,11 @@ class ChatCompletionRequest(BaseModel):
     presence_penalty: Optional[float] = None
     logprobs: Optional[bool] = None
     top_logprobs: Optional[int] = None  # 0-20 alternatives when logprobs=true
+    #: OpenAI function-calling tool definitions. Rendered into the chat
+    #: template (HF templates accept `tools`) so tool-trained models see
+    #: them; the engine does not parse tool_call outputs (pass-through,
+    #: like the reference forwarding requests to its engines).
+    tools: Optional[list[dict]] = None
     ext: Optional[Ext] = None
     nvext: Optional[Ext] = None  # accepted alias for drop-in compatibility
 

@@ -115,3 +115,46 @@ def test_postprocess_stream_stop_string():
     text, finish = asyncio.run(main())
     assert text == "ab"
     assert finish == ["stop"]
+
+
+def test_tools_render_into_hf_chat_template(tmp_path):
+    """OpenAI `tools` flow into the HF chat template (tool-trained models
+    see their definitions); templates without tools support are
+    unaffected, and the byte tokenizer ignores them."""
+    from tokenizers import Tokenizer as TK, models, pre_tokenizers
+    from transformers import PreTrainedTokenizerFast
+
+    from dynamo_tpu.preprocessor.tokenizer import ByteTokenizer, HfTokenizer
+    from dynamo_tpu.protocols.openai import ChatCompletionRequest
+
+    vocab = {w: i for i, w in enumerate(["<unk>", "hi", "a", "b"])}
+    tk = TK(models.WordLevel(vocab, unk_token="<unk>"))
+    tk.pre_tokenizer = pre_tokenizers.Whitespace()
+    fast = PreTrainedTokenizerFast(tokenizer_object=tk, unk_token="<unk>")
+    fast.chat_template = (
+        "{% if tools %}{% for t in tools %}TOOL:{{ t.function.name }} "
+        "{% endfor %}{% endif %}"
+        "{% for m in messages %}{{ m.role }}: {{ m.content }} {% endfor %}"
+        "assistant:"
+    )
+    d = str(tmp_path / "tok")
+    fast.save_pretrained(d)
+
+    req = ChatCompletionRequest.model_validate(
+        {
+            "model": "m",
+            "messages": [{"role": "user", "content": "hi"}],
+            "tools": [
+                {"type": "function",
+                 "function": {"name": "get_weather", "parameters": {}}}
+            ],
+        }
+    )
+    msgs = [m.model_dump(exclude_none=True) for m in req.messages]
+    tok = HfTokenizer(d)
+    assert "TOOL:get_weather" in tok.apply_chat_template(msgs, tools=req.tools)
+    # no tools: the TEMPLATE itself renders (not the exception fallback)
+    no_tools = tok.apply_chat_template(msgs)
+    assert "TOOL:" not in no_tools and "user: hi" in no_tools
+    # byte + GGUF tokenizers: tools accepted and ignored
+    assert "hi" in ByteTokenizer().apply_chat_template(msgs, tools=req.tools)
