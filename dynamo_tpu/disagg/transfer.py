@@ -29,15 +29,28 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 from dataclasses import dataclass
 from typing import Awaitable, Callable, Optional, Sequence
 
 import numpy as np
 
 from dynamo_tpu.disagg.device_transfer import DevicePlane
-from dynamo_tpu.runtime.codec import encode_frame, read_frame
+from dynamo_tpu.runtime.codec import MAX_FRAME, encode_frame, read_frame
 
 logger = logging.getLogger(__name__)
+
+#: Byte cap for one G4 fetch response. Real-model blocks run ~MBs each, so
+#: an uncapped deep prefix chain would serialize hundreds of MB into one
+#: frame (and past MAX_FRAME the encode raises AFTER the extraction work is
+#: done, permanently failing every long-prefix onboard). Long chains are
+#: instead truncated to a prefix that fits — the peer onboards that prefix
+#: and can fetch deeper next request. Operator overrides are clamped below
+#: MAX_FRAME, else a large override reintroduces the encode failure.
+_FETCH_MAX_BYTES = min(
+    int(os.environ.get("DYN_KV_FETCH_MAX_BYTES", 256 << 20)),
+    MAX_FRAME - (1 << 20),
+)
 
 #: write callback: (page_ids, k, v) -> awaitable; arrays [L, Hkv, n, ps, D]
 WriteFn = Callable[[Sequence[int], np.ndarray, np.ndarray], Awaitable[None]]
@@ -87,6 +100,9 @@ class KvTransferServer:
         self._waiters: dict[str, asyncio.Future] = {}
         #: transfers landed per strategy (observability: which plane ran)
         self.transfers = {"device": 0, "host": 0}
+        #: 2·k-block bytes, learned from the first serve — lets later
+        #: fetches truncate the *requested* hashes before extraction
+        self._fetch_block_bytes: Optional[int] = None
 
     async def start(self) -> None:
         self._server = await asyncio.start_server(
@@ -130,15 +146,20 @@ class KvTransferServer:
                     # side waiting out its transfer timeout.
                     logger.exception("transfer frame failed")
                     rid = header.get("request_id") if isinstance(header, dict) else None
-                    writer.write(encode_frame({"op": "nack", "request_id": rid}))
-                    await writer.drain()
+                    await self._nack(writer, rid, "bad_frame")
         except (asyncio.IncompleteReadError, ConnectionError):
             pass
         finally:
             writer.close()
 
-    async def _nack(self, writer, rid) -> None:
-        writer.write(encode_frame({"op": "nack", "request_id": rid}))
+    async def _nack(self, writer, rid, reason: str) -> None:
+        """Refusal with a machine-readable reason so the sender can decide
+        whether a fallback strategy could still succeed ("no_plane",
+        "pull_failed") or the request is dead on this side ("no_waiter",
+        "land_failed") and retrying would only ship bytes to a second nack."""
+        writer.write(
+            encode_frame({"op": "nack", "request_id": rid, "reason": reason})
+        )
         await writer.drain()
 
     async def _land(self, rid, header, land, writer, path: str) -> None:
@@ -151,7 +172,7 @@ class KvTransferServer:
             fut = self._waiters.pop(rid, None)
             if fut is not None and not fut.done():
                 fut.set_exception(e)
-            await self._nack(writer, rid)
+            await self._nack(writer, rid, "land_failed")
             return
         self.transfers[path] += 1
         fut = self._waiters.pop(rid, None)
@@ -173,7 +194,7 @@ class KvTransferServer:
             # reallocated): landing this write would corrupt a live
             # request's KV. Refuse it.
             logger.warning("dropping KV write for %s: no waiter", rid)
-            await self._nack(writer, rid)
+            await self._nack(writer, rid, "no_waiter")
             return
         page_ids = header["page_ids"]
         shape = tuple(header["shape"])  # [L, Hkv, n, ps, D]
@@ -192,14 +213,14 @@ class KvTransferServer:
         rid = header["request_id"]
         plane = DevicePlane.get()
         if plane is None:
-            await self._nack(writer, rid)
+            await self._nack(writer, rid, "no_plane")
             return
         if rid not in self._waiters:
             # Refuse BEFORE pulling: the staged arrays stay unconsumed on
             # the sender (bounded leak, see device_transfer.py docstring)
             # but no freed/reused decode pages get overwritten.
             logger.warning("dropping KV offer for %s: no waiter", rid)
-            await self._nack(writer, rid)
+            await self._nack(writer, rid, "no_waiter")
             return
         page_ids = header["page_ids"]
         try:
@@ -211,14 +232,14 @@ class KvTransferServer:
             # Pull never touched the pool: nack but KEEP the waiter — the
             # sender's host-path fallback can still land this request.
             logger.exception("device KV pull failed for %s", rid)
-            await self._nack(writer, rid)
+            await self._nack(writer, rid, "pull_failed")
             return
         if rid not in self._waiters:
             # Re-check after the pull: the decode side may have timed out
             # DURING the transfer and freed (possibly reallocated) the
             # pages — landing now would corrupt a live request's KV.
             logger.warning("dropping pulled KV for %s: waiter gone", rid)
-            await self._nack(writer, rid)
+            await self._nack(writer, rid, "no_waiter")
             return
 
         async def land():
@@ -233,8 +254,14 @@ class KvTransferServer:
         """G4 remote-tier serve: export the longest locally-resident chain
         of the requested hashes (reference: export_local_blockset,
         block_manager.rs:121). Misses return found=0 so the peer's
-        directory self-heals."""
+        directory self-heals. Responses are capped at _FETCH_MAX_BYTES by
+        truncating the chain — a chain prefix is always independently
+        adoptable, so the peer lands what fits."""
         hashes = header.get("seq_hashes", [])
+        if self._fetch_block_bytes:
+            # Block size is known from an earlier serve: truncate the
+            # *request* so the engine never extracts pages it can't ship.
+            hashes = hashes[: max(1, _FETCH_MAX_BYTES // self._fetch_block_bytes)]
         served = None
         if self.fetch_fn is not None and hashes:
             try:
@@ -246,6 +273,20 @@ class KvTransferServer:
             await writer.drain()
             return
         metas, k, v = served
+        n_blocks = int(k.shape[2])
+        if n_blocks:
+            per_block = 2 * (k.nbytes // n_blocks)  # k and v
+            self._fetch_block_bytes = per_block
+            fit = max(1, _FETCH_MAX_BYTES // per_block)
+            if n_blocks > fit:
+                logger.info(
+                    "KV fetch: truncating served chain %d -> %d blocks "
+                    "(%d bytes/block, cap %d)",
+                    n_blocks, fit, per_block, _FETCH_MAX_BYTES,
+                )
+                metas = metas[:fit]
+                k = k[:, :, :fit]
+                v = v[:, :, :fit]
         writer.write(
             encode_frame(
                 {
@@ -314,8 +355,8 @@ class KvTransferClient:
         if plane is not None:
             try:
                 uuid = plane.stage([k, v])
-                ok = await self._control(
-                    host, port,
+                resp, _ = await self._roundtrip(
+                    (host, port),
                     {
                         "op": "offer",
                         "request_id": request_id,
@@ -327,11 +368,23 @@ class KvTransferClient:
                         "uuid": uuid,
                     },
                 )
-                if ok:
+                if resp.get("op") == "ack":
                     return True
+                reason = resp.get("reason", "")
+                if reason in ("no_waiter", "land_failed"):
+                    # The request is dead on the decode side (freed /
+                    # timed out / landing already failed its waiter):
+                    # materializing the device→host copy and shipping the
+                    # multi-MB payload would only buy a second nack.
+                    logger.info(
+                        "device KV offer for %s nacked (%s); "
+                        "skipping host-path fallback",
+                        request_id, reason,
+                    )
+                    return False
                 logger.info(
-                    "device KV offer for %s nacked; host-path fallback",
-                    request_id,
+                    "device KV offer for %s nacked (%s); host-path fallback",
+                    request_id, reason or "unspecified",
                 )
             except Exception:
                 logger.exception(

@@ -127,6 +127,22 @@ class BlockDirectory:
                 out.append(w)
         return out
 
+    def has_chain(self, seq_hashes: Sequence[int], min_blocks: int) -> bool:
+        """Cheap pre-filter: does any single worker hold `min_blocks`
+        consecutive hashes starting at ANY position? Upper-bounds every
+        possible best_chain result, so callers can skip the (engine-thread)
+        local-residency probe when nothing claimable exists."""
+        for w in set(self._dev) | set(self._tier):
+            run = 0
+            for h in seq_hashes:
+                if self._servable(w, h):
+                    run += 1
+                    if run >= min_blocks:
+                        return True
+                else:
+                    run = 0
+        return False
+
     def best_chain(
         self, seq_hashes: Sequence[int], start: int
     ) -> Optional[tuple[str, int]]:

@@ -121,6 +121,11 @@ class MockEngine:
                 item = await req.out_q.get()
                 if item is None:
                     return
+                if "error" in item:
+                    # Same stream protocol as AsyncEngineRunner.drain:
+                    # raising turns a capacity rejection into a typed HTTP
+                    # failure instead of an empty 200 "stop" completion.
+                    raise RuntimeError(item["error"])
                 yield item
         finally:
             self.active_requests -= 1

@@ -340,17 +340,21 @@ class Worker:
         directory = self.kv_directory
         if not directory.has_entries():
             return  # nothing claimable anywhere — skip the engine round trip
+        # Hashing needs only static config (page size / salt), so it runs
+        # on the event loop, and the directory is consulted BEFORE the
+        # engine runner: requests with no claimable chain anywhere must not
+        # serialize with engine step dispatch just to learn that.
+        from dynamo_tpu.tokens import hash_token_blocks
 
-        def _probe(eng):
-            from dynamo_tpu.tokens import hash_token_blocks
-
-            hashes = hash_token_blocks(
-                pre.token_ids, block_size=eng.config.page_size,
-                salt=eng.config.model,
-            )
-            return hashes, eng.allocator.resident_match_length(hashes)
-
-        hashes, n_local = await runner.submit(_probe)
+        cfg = self.engine_config
+        hashes = hash_token_blocks(
+            pre.token_ids, block_size=cfg.page_size, salt=cfg.model
+        )
+        if not directory.has_chain(hashes, self.kv_remote_min_blocks):
+            return
+        n_local = await runner.submit(
+            lambda eng: eng.allocator.resident_match_length(hashes)
+        )
         if n_local >= len(hashes):
             return
         best = directory.best_chain(hashes, n_local)

@@ -438,3 +438,37 @@ def test_disagg_fallback_without_prefill_fleet(tiny_cfg):
             await server.stop()
 
     run(main())
+
+
+def test_no_waiter_nack_skips_host_fallback(tiny_cfg, monkeypatch):
+    """A decode side whose waiter is gone nacks with reason "no_waiter";
+    the sender must NOT materialize the device arrays and ship the multi-MB
+    payload over the host path just to collect a second nack."""
+    from dynamo_tpu.disagg.device_transfer import DevicePlane
+    from dynamo_tpu.disagg.transfer import KvTransferClient, KvTransferServer
+
+    monkeypatch.setenv("DYN_KV_TRANSFER", "device")
+    plane = DevicePlane.get()
+    assert plane is not None
+
+    shape = (1, 1, 2, 4, 8)
+    k = np.arange(np.prod(shape), dtype=np.float32).reshape(shape)
+    v = -k
+
+    async def main():
+        async def write_fn(page_ids, kk, vv):
+            raise AssertionError("host fallback ran for a dead request")
+
+        server = KvTransferServer(write_fn)
+        await server.start()
+        client = KvTransferClient()
+        try:
+            # no server.expect(): the request is already dead decode-side
+            ok = await client.send(*server.address, "gone", [3, 4], k, v, 42)
+            assert not ok
+            assert server.transfers == {"device": 0, "host": 0}
+        finally:
+            client.close()
+            await server.stop()
+
+    run(main())

@@ -295,3 +295,50 @@ def test_cross_worker_onboarding_e2e(monkeypatch):
             await server.stop()
 
     run(main())
+
+
+def test_fetch_response_byte_cap(monkeypatch):
+    """Deep prefix chains are truncated to the fetch byte cap — a valid
+    chain PREFIX ships instead of an over-MAX_FRAME codec failure — and
+    once the server has learned the block size, later fetch *requests* are
+    truncated before any extraction work happens."""
+    from dynamo_tpu.disagg import transfer as tr
+
+    shape = (2, 1, 10, 4, 8)  # [L, Hkv, n=10 blocks, ps, D] float32
+    k = np.arange(np.prod(shape), dtype=np.float32).reshape(shape)
+    v = -k
+    per_block = 2 * (k.nbytes // 10)  # k and v bytes for one block
+    monkeypatch.setattr(tr, "_FETCH_MAX_BYTES", 3 * per_block)
+
+    served_hashes = []
+
+    async def fetch_fn(seq_hashes):
+        served_hashes.append(list(seq_hashes))
+        n = len(seq_hashes)
+        metas = [(h, (h - 1) if i else None, (i, i)) for i, h in enumerate(seq_hashes)]
+        return metas, k[:, :, :n], v[:, :, :n]
+
+    async def write_fn(page_ids, kk, vv):
+        raise AssertionError("unused")
+
+    async def main():
+        server = tr.KvTransferServer(write_fn, fetch_fn=fetch_fn)
+        await server.start()
+        client = tr.KvTransferClient()
+        try:
+            got = await client.fetch(*server.address, list(range(1, 11)))
+            assert got is not None
+            metas, gk, gv = got
+            # response capped to the 3-block prefix, chain order intact
+            assert len(metas) == 3 and gk.shape[2] == 3
+            assert [m[0] for m in metas] == [1, 2, 3]
+            np.testing.assert_array_equal(gk, k[:, :, :3])
+            # second fetch: request itself truncated pre-extraction
+            got2 = await client.fetch(*server.address, list(range(1, 11)))
+            assert got2 is not None and len(got2[0]) == 3
+            assert served_hashes == [list(range(1, 11)), [1, 2, 3]]
+        finally:
+            client.close()
+            await server.stop()
+
+    run(main())

@@ -153,8 +153,10 @@ def test_preemption_on_block_exhaustion():
 
 
 def test_oversized_prompt_rejected_not_wedged():
-    """A prompt that can NEVER satisfy the watermark is rejected with an
-    error item instead of blocking the queue head forever."""
+    """A prompt that can NEVER satisfy the watermark is rejected by
+    RAISING through generate() (the AsyncEngineRunner.drain stream
+    protocol — a typed failure, not an empty 200 completion) instead of
+    blocking the queue head forever."""
     args = MockEngineArgs(
         num_pages=8, page_size=2, watermark=0.25, decode_s_per_step=0.001,
     )
@@ -162,10 +164,9 @@ def test_oversized_prompt_rejected_not_wedged():
     async def main():
         eng = MockEngine(args)
         ctx = _Ctx()
-        items = []
-        async for item in eng.generate(ctx, _req("huge", list(range(40)), 2)):
-            items.append(item)
-        assert any("error" in i for i in items)
+        with pytest.raises(RuntimeError, match="KV pages"):
+            async for _ in eng.generate(ctx, _req("huge", list(range(40)), 2)):
+                pass
         # the engine keeps serving normal requests afterwards
         out = await _collect(eng, _req("ok", [1, 2], 3))
         assert len(out) == 3
