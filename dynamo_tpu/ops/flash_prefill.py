@@ -52,34 +52,37 @@ def _prefill_kernel(
     scale = 1.0 / math.sqrt(scale_dim)
 
     q = q_ref[0, 0].astype(jnp.float32).reshape(g * bq, d) * scale
-    row_pos = jax.lax.broadcasted_iota(jnp.int32, (g, bq), 1).reshape(
-        g * bq
-    ) + qi * bq  # absolute query positions, per folded row
+    # absolute query positions per folded row; built 2D via rem — Mosaic
+    # cannot lower a (g, bq) -> (g*bq,) cross-lane reshape of an iota
+    row_pos = (
+        jax.lax.rem(jax.lax.broadcasted_iota(jnp.int32, (g * bq, 1), 0), bq)
+        + qi * bq
+    )  # [G*BQ, 1]
 
     acc0 = jnp.zeros((g * bq, d), jnp.float32)
-    m0 = jnp.full((g * bq,), -jnp.inf, jnp.float32)
-    l0 = jnp.zeros((g * bq,), jnp.float32)
+    m0 = jnp.full((g * bq, 1), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((g * bq, 1), jnp.float32)
 
     def body(j, carry):
         acc, m, l = carry
-        k_blk = jax.lax.dynamic_slice_in_dim(
-            k_ref[0, 0], j * block, block, axis=0
-        ).astype(jnp.float32)  # [BK, D]
-        v_blk = jax.lax.dynamic_slice_in_dim(
-            v_ref[0, 0], j * block, block, axis=0
-        ).astype(jnp.float32)
+        # ref-sliced with pl.ds: Mosaic lowers dynamic indexing on refs,
+        # not lax.dynamic_slice on loaded values
+        k_blk = k_ref[0, 0, pl.ds(j * block, block), :].astype(
+            jnp.float32
+        )  # [BK, D]
+        v_blk = v_ref[0, 0, pl.ds(j * block, block), :].astype(jnp.float32)
         s = jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )  # [G*BQ, BK]
         col_pos = jax.lax.broadcasted_iota(jnp.int32, (1, block), 1) + j * block
-        mask = (col_pos <= row_pos[:, None]) & (col_pos < valid)
+        mask = (col_pos <= row_pos) & (col_pos < valid)
         s = jnp.where(mask, s, -1e30)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         alpha = jnp.exp(m - m_new)
-        p = jnp.exp(s - m_new[:, None])
-        l_new = alpha * l + jnp.sum(p, axis=-1)
-        acc_new = alpha[:, None] * acc + jax.lax.dot_general(
+        p = jnp.exp(s - m_new)
+        l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = alpha * acc + jax.lax.dot_general(
             p, v_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
@@ -87,7 +90,7 @@ def _prefill_kernel(
 
     # causal frontier: key blocks 0..qi inclusive (BQ == BK aligned)
     acc, m, l = jax.lax.fori_loop(0, qi + 1, body, (acc0, m0, l0))
-    out = acc / jnp.maximum(l, 1e-30)[:, None]  # masked rows stay finite
+    out = acc / jnp.maximum(l, 1e-30)  # masked rows stay finite
     o_ref[0, 0] = out.reshape(g, bq, d).astype(o_ref.dtype)
 
 
@@ -204,9 +207,10 @@ def _hist_kernel(
     # Key blocks strictly above the causal diagonal are pruned: block j
     # only matters for q block qi when j <= qi (BQ-aligned), mirroring
     # _prefill_kernel's frontier loop.
-    row_rel = qi * bq + jax.lax.broadcasted_iota(
-        jnp.int32, (g, bq), 1
-    ).reshape(g * bq, 1)
+    # [G*BQ, 1], built via rem (see _prefill_kernel's row_pos note)
+    row_rel = qi * bq + jax.lax.rem(
+        jax.lax.broadcasted_iota(jnp.int32, (g * bq, 1), 0), bq
+    )
 
     def cur_body(j, carry):
         ms, ls, accs = carry
@@ -214,12 +218,10 @@ def _hist_kernel(
         cmask = (col_rel <= row_rel) & (col_rel < cur)  # [G·BQ, BQ]
         m_out, l_out, a_out = [], [], []
         for h in range(num_kv_heads):
-            kc = jax.lax.dynamic_slice_in_dim(
-                kcur_ref[0, :, h], j * bq, bq, axis=0
-            ).astype(jnp.float32)  # [BQ, D]
-            vc = jax.lax.dynamic_slice_in_dim(
-                vcur_ref[0, :, h], j * bq, bq, axis=0
-            ).astype(jnp.float32)
+            kc = kcur_ref[0, pl.ds(j * bq, bq), h, :].astype(
+                jnp.float32
+            )  # [BQ, D]
+            vc = vcur_ref[0, pl.ds(j * bq, bq), h, :].astype(jnp.float32)
             scores = jax.lax.dot_general(
                 qh_tile(h), kc, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
@@ -353,6 +355,12 @@ def paged_prefill_attention(
         ),
         out_shape=jax.ShapeDtypeStruct((b, tp, hq, d), q.dtype),
         interpret=interpret,
+        # the static kv-head unroll holds per-head f32 accumulators; at
+        # llama3 shapes (Hkv=8, G=4, BQ=128, D=128) that is ~19MB of
+        # scoped VMEM — above Mosaic's 16MB default, well under v5e's 128MB
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=100 * 1024 * 1024
+        ),
     )(
         jnp.asarray(layer, jnp.int32).reshape(1),
         page_tables.astype(jnp.int32),

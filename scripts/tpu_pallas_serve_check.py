@@ -1,0 +1,168 @@
+"""On-device pallas-vs-xla serving agreement (VERDICT r2 item 2).
+
+Three comparisons on the live TPU, llama3-1b shapes (seeded random
+weights — no trained checkpoint exists in this zero-egress image):
+
+1. model-forward logits: one 128-token prefill through forward() under
+   attention_impl="xla" vs "pallas"; gate on max |Δlogit| < 0.25 (the
+   measured value is ~0.07 on a ±5 logit range — bf16 accumulation-order
+   noise across 16 layers, amplified by random near-uniform weights).
+2. engine greedy agreement: same requests through two JaxEngines. With
+   random weights argmax gaps are smaller than (1)'s noise, so token
+   flips are EXPECTED; recorded as stats, not gated. (With a trained
+   checkpoint the gap is orders of magnitude larger and greedy is
+   stable; tests/test_checkpoint_e2e.py covers that on CPU.)
+3. steady-state timing: a second, fully-warmed run of the same workload
+   per impl (first run pays Mosaic remote-compile).
+
+Writes artifacts/tpu/pallas_serve_check.json.
+Run: python scripts/tpu_pallas_serve_check.py        (requires live TPU)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import numpy as np  # noqa: E402
+
+LOGIT_TOL = 0.25
+
+
+def logits_check():
+    import jax
+    import jax.numpy as jnp
+
+    from dynamo_tpu.models import LlamaConfig, forward, init_params
+    from dynamo_tpu.models.llama import init_kv_pages
+
+    cfg_x = dataclasses.replace(
+        LlamaConfig.llama3_1b(), attention_impl="xla"
+    )
+    cfg_p = dataclasses.replace(
+        LlamaConfig.llama3_1b(), attention_impl="pallas"
+    )
+    params = init_params(jax.random.key(0), cfg_x)
+    params = jax.tree.map(lambda x: x.astype(jnp.bfloat16), params)
+    rng = np.random.default_rng(7)
+    T = 128
+    toks = jnp.asarray(rng.integers(1, 32000, (1, T)), jnp.int32)
+    positions = jnp.tile(jnp.arange(T, dtype=jnp.int32)[None], (1, 1))
+    valid = jnp.ones((1, T), bool)
+    pt = jnp.asarray(np.arange(1, 9, dtype=np.int32)[None])
+    outs = {}
+    for name, cfg in (("xla", cfg_x), ("pallas", cfg_p)):
+        kv = init_kv_pages(cfg, num_pages=64, page_size=64)
+        logits, _ = forward(params, cfg, toks, positions, valid, kv, pt)
+        outs[name] = np.asarray(logits[0, -1].astype(jnp.float32))
+    diff = float(np.abs(outs["xla"] - outs["pallas"]).max())
+    return {
+        "max_abs_logit_diff": diff,
+        "logit_range": [
+            float(outs["xla"].min()), float(outs["xla"].max())
+        ],
+        "argmax_agree": bool(
+            outs["xla"].argmax() == outs["pallas"].argmax()
+        ),
+        "ok": diff < LOGIT_TOL,
+    }
+
+
+def run_engine(impl: str, prompts, osl: int):
+    from dynamo_tpu.engine import EngineConfig
+    from dynamo_tpu.engine.engine import JaxEngine
+    from dynamo_tpu.engine.request import SamplingParams
+
+    cfg = EngineConfig(
+        model="llama3-1b",
+        num_pages=256,
+        page_size=64,
+        max_pages_per_seq=8,
+        decode_buckets=(4, 8),
+        prefill_chunk=128,
+        prefill_token_budget=1024,
+        decode_steps=8,
+        max_seqs=8,
+        dtype="bfloat16",
+        enable_prefix_caching=False,
+        attention_impl=impl,
+    )
+    eng = JaxEngine(cfg)
+
+    def one_run(tag):
+        t0 = time.time()
+        for i, p in enumerate(prompts):
+            eng.add_request(
+                f"{tag}{i}", p, SamplingParams(temperature=0.0, max_tokens=osl)
+            )
+        outs: dict[str, list[int]] = {}
+        n = 0
+        while eng.has_work:
+            for out in eng.step():
+                outs.setdefault(out.request_id, []).extend(out.new_token_ids)
+                n += len(out.new_token_ids)
+        return outs, n / (time.time() - t0)
+
+    outs, _ = one_run("w")  # warm: compiles every program
+    eng.allocator.clear_cache()
+    outs, tok_s = one_run("r")
+    return outs, tok_s
+
+
+def main():
+    import jax
+
+    plat = jax.devices()[0].platform
+    print(f"platform: {plat}")
+    if plat == "cpu":
+        print("refusing: this check must run on TPU")
+        sys.exit(1)
+
+    logits = logits_check()
+    print("logits:", json.dumps(logits))
+
+    rng = np.random.default_rng(7)
+    prompts = [
+        [int(x) for x in rng.integers(1, 32000, n)]
+        for n in (40, 130, 200, 64)
+    ]
+    osl = 32
+    xla, tok_s_xla = run_engine("xla", prompts, osl)
+    pallas, tok_s_pallas = run_engine("pallas", prompts, osl)
+
+    greedy = []
+    for rid in sorted(xla):
+        a, b = xla[rid], pallas.get(rid, [])
+        agree = next(
+            (i for i, (x, y) in enumerate(zip(a, b)) if x != y),
+            min(len(a), len(b)),
+        )
+        greedy.append(
+            {"request": rid, "agree_prefix": agree, "len": len(a)}
+        )
+
+    out = {
+        "platform": plat,
+        "model": "llama3-1b (seeded random weights)",
+        "logits": logits,
+        "greedy_prefix_agreement": greedy,
+        "steady_state_tok_s": {
+            "xla": round(tok_s_xla, 1),
+            "pallas": round(tok_s_pallas, 1),
+        },
+        "ok": logits["ok"],
+    }
+    path = Path(__file__).resolve().parent.parent / "artifacts/tpu"
+    path.mkdir(parents=True, exist_ok=True)
+    (path / "pallas_serve_check.json").write_text(json.dumps(out, indent=2))
+    print(json.dumps(out))
+    sys.exit(0 if out["ok"] else 2)
+
+
+if __name__ == "__main__":
+    main()
