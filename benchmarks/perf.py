@@ -71,7 +71,8 @@ def tpu_bf16_peak_flops() -> Optional[float]:
 
     if jax.default_backend() != "tpu":
         return None
-    kind = jax.devices()[0].device_kind.lower()
+    # normalize "TPU v5 lite" -> "tpuv5lite" so spaced kinds match
+    kind = jax.devices()[0].device_kind.lower().replace(" ", "")
     for tag, peak in (
         ("v6e", 918e12), ("v6", 918e12), ("v5p", 459e12),
         ("v5e", 197e12), ("v5lite", 197e12), ("v4", 275e12),

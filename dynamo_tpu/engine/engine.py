@@ -97,6 +97,20 @@ class JaxEngine:
         if impl == "auto":
             impl = "pallas" if jax.default_backend() == "tpu" else "xla"
         self.mesh = make_mesh(mc) if mc.num_devices > 1 else None
+        #: mesh spans >1 process: multi-controller lockstep mode. Host
+        #: batch arrays become global arrays assembled per-host from the
+        #: (identical) replicated numpy copies; small jit outputs are
+        #: replicated so every host reads every sampled token
+        #: (engine/spmd.py keeps the hosts' schedulers in lockstep).
+        self._multiproc = self.mesh is not None and (
+            len({d.process_index for d in self.mesh.devices.flat}) > 1
+        )
+        if self._multiproc:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            self._rep_sharding = NamedSharding(self.mesh, PartitionSpec())
+        else:
+            self._rep_sharding = None
         # Under a mesh the Pallas kernels run shard_mapped over tp (heads
         # are embarrassingly parallel); the model needs the mesh object.
         self.adapter: ModelAdapter = get_model(
@@ -114,6 +128,12 @@ class JaxEngine:
                     "head-sharded attention"
                 )
         if config.host_kv_cache_bytes > 0 or config.disk_kv_cache_bytes > 0:
+            if self._multiproc:
+                raise ValueError(
+                    "host/disk KV tiering is single-process for now: "
+                    "extract/inject read KV shards each host cannot "
+                    "address under a cross-host mesh"
+                )
             from dynamo_tpu.kvbm import TieredPageAllocator
 
             self.allocator: PageAllocator = TieredPageAllocator(
@@ -140,14 +160,26 @@ class JaxEngine:
         #: low-acceptance spec dispatch
         self._spec_cooldown = 0
 
+        pre_quantized = False
         if params is None:
             checkpoint_path = checkpoint_path or self.adapter.default_checkpoint
             if checkpoint_path is not None and self.adapter.load_params:
                 params = self.adapter.load_params(checkpoint_path)
+            elif (
+                config.quantize == "int8"
+                and self.adapter.init_params_quantized is not None
+            ):
+                # straight into int8 layout: init+quantize would peak at
+                # full-dtype model size (16GB for 8B — over v5e HBM)
+                logger.info(
+                    "initializing random int8 params for %s", config.model
+                )
+                params = self.adapter.init_params_quantized(jax.random.key(0))
+                pre_quantized = True
             else:
                 logger.info("initializing random params for %s", config.model)
                 params = self.adapter.init_params(jax.random.key(0))
-        if config.quantize:
+        if config.quantize and not pre_quantized:
             if config.quantize != "int8":
                 raise ValueError(
                     f"unsupported quantize={config.quantize!r}; use int8"
@@ -161,24 +193,57 @@ class JaxEngine:
         kv = self.adapter.init_kv(config.num_pages, config.page_size)
         if self.mesh is not None:
             specs = self.adapter.param_specs(quantized=bool(config.quantize))
-            params = jax.device_put(params, shardings_for(self.mesh, specs))
-            kv = jax.device_put(kv, shardings_for(self.mesh, self.adapter.kv_spec()))
+            params = self._put_global(params, shardings_for(self.mesh, specs))
+            kv = self._put_global(
+                kv, shardings_for(self.mesh, self.adapter.kv_spec())
+            )
         self.params = params
         self.kv = kv
         if self.mesh is not None:
             from jax.sharding import NamedSharding
 
+            # ndim 3 covers mm_embeds [B, T, H]
             self._batch_shardings = {
-                nd: NamedSharding(self.mesh, batch_spec(nd)) for nd in (1, 2)
+                nd: NamedSharding(self.mesh, batch_spec(nd))
+                for nd in (1, 2, 3)
             }
         else:
             self._batch_shardings = None
 
+    def _put_global(self, tree, shardings):
+        """Place a host pytree onto the mesh. Single-process: device_put.
+        Multi-process: every host holds the identical full copy, so each
+        assembles its addressable shards via make_array_from_callback
+        (device_put cannot target non-addressable devices)."""
+        if not self._multiproc:
+            return jax.device_put(tree, shardings)
+
+        def put(x, sh):
+            h = np.asarray(x)
+            return jax.make_array_from_callback(
+                h.shape, sh, lambda idx: h[idx]
+            )
+
+        return jax.tree.map(put, tree, shardings)
+
     def _dev(self, arr: np.ndarray):
         """Host batch array -> device, dp-sharded along dim 0 on a mesh.
 
-        Batches not divisible by dp (B=1 prefill, small decode buckets) are
-        left for jit to reshard — an explicit device_put would raise."""
+        Single-process: batches not divisible by dp (B=1 prefill, small
+        decode buckets) are left for jit to reshard — an explicit
+        device_put would raise. Multi-process: every input must be an
+        explicit global array (replicated when not dp-divisible); the
+        host copies are identical by the lockstep contract."""
+        if self._multiproc:
+            arr = np.asarray(arr)
+            dp = self.mesh.shape.get("dp", 1)
+            if dp > 1 and arr.shape[0] % dp == 0:
+                sh = self._batch_shardings[arr.ndim]
+            else:
+                sh = self._rep_sharding
+            return jax.make_array_from_callback(
+                arr.shape, sh, lambda idx: arr[idx]
+            )
         x = jnp.asarray(arr)
         if self._batch_shardings is not None:
             dp = self.mesh.shape.get("dp", 1)
@@ -808,6 +873,17 @@ class JaxEngine:
         if fn is not None:
             return fn
         adapter = self.adapter
+        rep_sh = self._rep_sharding
+
+        def rep(x):
+            """Replicate a small output across the whole mesh so every
+            host of a multi-process mesh can read it (sampled ids drive
+            the replicated schedulers); no-op single-process."""
+            if rep_sh is None or x is None:
+                return x
+            return jax.tree.map(
+                lambda y: jax.lax.with_sharding_constraint(y, rep_sh), x
+            )
 
         def maybe_logprobs(logits, ids):
             """(chosen_lp, top_ids, top_lps) when this variant reports
@@ -844,7 +920,7 @@ class JaxEngine:
                 pooled = jnp.sum(
                     hidden.astype(jnp.float32) * valid[..., None], axis=1
                 )
-                return pooled, kv
+                return rep(pooled), kv
 
             jitted = jax.jit(embed_fn, donate_argnums=(4,))
             self._jit_cache[cache_key] = jitted
@@ -891,8 +967,8 @@ class JaxEngine:
                     length=k_steps,
                 )
                 if lp >= 0:
-                    return all_ids, all_lp, kv  # [K, B] (+ lp triple)
-                return all_ids, kv  # [K, B]
+                    return rep(all_ids), rep(all_lp), kv  # [K, B] (+ lp)
+                return rep(all_ids), kv  # [K, B]
 
             jitted = jax.jit(multi_fn, donate_argnums=(4,))
             self._jit_cache[cache_key] = jitted
@@ -913,7 +989,7 @@ class JaxEngine:
                     params, hidden.reshape(bsz * tlen, h)
                 )
                 ids = jnp.argmax(logits, axis=-1).reshape(bsz, tlen)
-                return ids.astype(jnp.int32), kv
+                return rep(ids.astype(jnp.int32)), kv
 
             jitted = jax.jit(verify_fn, donate_argnums=(4,))
             self._jit_cache[cache_key] = jitted
@@ -960,8 +1036,8 @@ class JaxEngine:
                 counts=counts, freq=freq, pres=pres,
             )
             if lp >= 0:
-                return ids, maybe_logprobs(logits, ids), kv
-            return ids, kv
+                return rep(ids), rep(maybe_logprobs(logits, ids)), kv
+            return rep(ids), kv
 
         jitted = jax.jit(step_fn, donate_argnums=(4,))
         self._jit_cache[cache_key] = jitted

@@ -1,0 +1,174 @@
+"""Multi-controller lockstep serving over a cross-host mesh.
+
+The TPU-native answer to the reference's multi-node serving
+(MultiNodeConfig, engines.rs:43-50 + leader_worker_barrier.rs:26-121):
+instead of a head node RPC-ing shards of work to workers, every host runs
+an IDENTICAL engine replica over one global `jax.sharding.Mesh`, and only
+the tiny admission stream is coordinated:
+
+  1. the leader (process 0) queues submit/abort events from its frontend;
+  2. each round it broadcasts the event log to all hosts (two device
+     collectives: a length then a payload — `broadcast_one_to_all` rides
+     the same ICI/DCN fabric as the model's collectives, no side channel);
+  3. every host applies the events to its own deterministic scheduler
+     replica and calls `engine.step()`. Identical scheduler state means
+     identical batch arrays, so all hosts enter the SAME jit dispatch in
+     lockstep — XLA's compiled collectives do the cross-host math;
+  4. sampled ids come back fully replicated (engine._get_step_fn's
+     `rep`), so every replica advances identically. No output shipping.
+
+Determinism contract (what makes replicated scheduling sound):
+- Scheduler decisions depend only on config + the event stream (FIFO
+  admission, page accounting; `arrival_time` is metadata).
+- Sampling seeds derive from request ids (engine._request_seed), draw
+  counters from per-request emit counts.
+- Host tiering is refused under a multi-process mesh (engine.__init__);
+  spec-decode drafts derive from token history only.
+
+Bring-up rendezvous (coordinator address, mesh shape agreement) is the
+fabric-store barrier, runtime/barrier.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+from typing import Optional
+
+import numpy as np
+
+from dynamo_tpu.engine.engine import JaxEngine, StepOutput
+from dynamo_tpu.engine.request import SamplingParams
+
+logger = logging.getLogger("dynamo_tpu.spmd")
+
+__all__ = ["SpmdDriver"]
+
+
+def _broadcast_bytes(payload: Optional[bytes], is_leader: bool) -> bytes:
+    """Leader ships `payload` to every process; followers pass None.
+    Two collectives: a fixed-shape length, then the padded payload."""
+    from jax.experimental import multihost_utils
+
+    if is_leader:
+        data = np.frombuffer(payload, np.uint8)
+        n = np.asarray(len(data), np.int32)
+    else:
+        data = None
+        n = np.asarray(0, np.int32)
+    n = int(multihost_utils.broadcast_one_to_all(n, is_source=is_leader))
+    if n == 0:
+        return b""
+    if data is None:
+        data = np.zeros(n, np.uint8)
+    out = multihost_utils.broadcast_one_to_all(data, is_source=is_leader)
+    return bytes(np.asarray(out))
+
+
+class SpmdDriver:
+    """Drives one JaxEngine replica in lockstep with its peers.
+
+    Leader usage (process 0 — owns the frontend):
+        drv = SpmdDriver(engine)
+        drv.submit(rid, tokens, SamplingParams(...))
+        outs = drv.step()          # broadcast + step, every host
+        ...
+        drv.shutdown()             # releases the followers' loops
+
+    Follower usage (every other process):
+        SpmdDriver(engine).serve() # blocks until the leader's shutdown
+    """
+
+    def __init__(self, engine: JaxEngine, is_leader: Optional[bool] = None):
+        import jax
+
+        if not engine._multiproc:
+            raise ValueError(
+                "SpmdDriver needs an engine on a multi-process mesh; "
+                "single-process engines are driven directly"
+            )
+        self.engine = engine
+        self.is_leader = (
+            jax.process_index() == 0 if is_leader is None else is_leader
+        )
+        self._pending: list[dict] = []
+        self._stopped = False
+
+    # -- leader-side admission --------------------------------------------
+
+    def submit(
+        self,
+        request_id: str,
+        prompt_tokens: list[int],
+        sampling: SamplingParams,
+    ) -> None:
+        assert self.is_leader, "only the leader admits requests"
+        self._pending.append(
+            {
+                "op": "submit",
+                "rid": request_id,
+                "tokens": [int(t) for t in prompt_tokens],
+                "sampling": dataclasses.asdict(sampling),
+            }
+        )
+
+    def abort(self, request_id: str) -> None:
+        assert self.is_leader, "only the leader aborts requests"
+        self._pending.append({"op": "abort", "rid": request_id})
+
+    # -- lockstep rounds ---------------------------------------------------
+
+    def _apply(self, events: list[dict]) -> None:
+        for ev in events:
+            op = ev["op"]
+            if op == "submit":
+                s = ev["sampling"]
+                s["stop_token_ids"] = tuple(s.get("stop_token_ids", ()))
+                self.engine.add_request(
+                    ev["rid"], ev["tokens"], SamplingParams(**s)
+                )
+            elif op == "abort":
+                self.engine.abort_request(ev["rid"])
+            elif op == "stop":
+                self._stopped = True
+            else:  # pragma: no cover — version-skew guard
+                raise RuntimeError(f"unknown lockstep event {op!r}")
+
+    def _round(self, events: list[dict]) -> list[StepOutput]:
+        payload = json.dumps(events).encode() if self.is_leader else None
+        raw = _broadcast_bytes(payload, self.is_leader)
+        if not self.is_leader:
+            events = json.loads(raw.decode()) if raw else []
+        self._apply(events)
+        if self._stopped:
+            return []
+        return self.engine.step()
+
+    def step(self) -> list[StepOutput]:
+        """One lockstep round: broadcast queued events, step every
+        replica. Leader-only (followers sit in serve())."""
+        events, self._pending = self._pending, []
+        return self._round(events)
+
+    def run_to_completion(self) -> dict[str, list[int]]:
+        """Leader: drain all admitted work across the fleet."""
+        done: dict[str, list[int]] = {}
+        while self._pending or self.engine.has_work:
+            for out in self.step():
+                done.setdefault(out.request_id, []).extend(
+                    out.new_token_ids
+                )
+        return done
+
+    def shutdown(self) -> None:
+        """Leader: release every follower's serve() loop."""
+        if self.is_leader and not self._stopped:
+            self._round([{"op": "stop"}])
+
+    def serve(self) -> None:
+        """Follower loop: block on the leader's broadcasts, mirror every
+        step, exit on the stop event."""
+        assert not self.is_leader
+        while not self._stopped:
+            self._round([])

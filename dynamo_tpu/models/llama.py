@@ -404,6 +404,66 @@ def params_from_gguf(gguf_file, cfg: LlamaConfig) -> dict:
 QUANTIZED_DENSE_NAMES = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
 
 
+def init_params_int8(key: jax.Array, cfg: LlamaConfig) -> dict:
+    """Random-init directly into the int8 weight-only layout.
+
+    `init_params` + `quantize_params_int8` materializes the full
+    model-dtype weights first — 16GB for an 8B config, more than one
+    v5e chip's HBM. Here every quantized dense weight is generated and
+    quantized one layer at a time under lax.map, so peak transient
+    memory is a single fp32 layer (~235MB for 8B); embeddings, norms and
+    biases keep the base init. Output layout == quantize_params_int8's.
+    """
+    h, i, v = cfg.hidden_size, cfg.intermediate_size, cfg.vocab_size
+    qd, kvd = cfg.num_heads * cfg.head_dim, cfg.num_kv_heads * cfg.head_dim
+    L = cfg.num_layers
+    keys = jax.random.split(key, 10)
+
+    def qdense(k, in_dim, out_dim):
+        def one(kl):
+            w = jax.random.normal(
+                kl, (in_dim, out_dim), jnp.float32
+            ) / math.sqrt(in_dim)
+            s = jnp.maximum(
+                jnp.max(jnp.abs(w), axis=0, keepdims=True) / 127.0, 1e-8
+            )
+            return jnp.round(w / s).astype(jnp.int8), s
+
+        return jax.lax.map(one, jax.random.split(k, L))
+
+    def dense(k, shape, fan_in):
+        scale = 1.0 / math.sqrt(fan_in)
+        return (
+            jax.random.normal(k, shape, jnp.float32) * scale
+        ).astype(cfg.dtype)
+
+    layers: dict = {
+        "attn_norm": jnp.ones((L, h), cfg.dtype),
+        "mlp_norm": jnp.ones((L, h), cfg.dtype),
+    }
+    for name, k, din, dout in (
+        ("wq", keys[1], h, qd), ("wk", keys[2], h, kvd),
+        ("wv", keys[3], h, kvd), ("wo", keys[4], qd, h),
+        ("w_gate", keys[5], h, i), ("w_up", keys[6], h, i),
+        ("w_down", keys[7], i, h),
+    ):
+        q, s = qdense(k, din, dout)
+        layers[name] = q
+        layers[name + "_scale"] = s
+    if cfg.attention_bias:
+        layers["bq"] = jnp.zeros((L, qd), cfg.dtype)
+        layers["bk"] = jnp.zeros((L, kvd), cfg.dtype)
+        layers["bv"] = jnp.zeros((L, kvd), cfg.dtype)
+    params = {
+        "embed": dense(keys[0], (v, h), h),
+        "layers": layers,
+        "final_norm": jnp.ones((h,), cfg.dtype),
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = dense(keys[8], (h, v), h)
+    return params
+
+
 def quantize_params_int8(params: dict) -> dict:
     """Weight-only int8 quantization with per-output-channel symmetric
     scales, applied to the seven layer matmul weights (embed / lm_head /

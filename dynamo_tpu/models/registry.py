@@ -41,6 +41,10 @@ class ModelAdapter:
     #: (None = family doesn't support it); the engine calls it for
     #: EngineConfig.quantize="int8"
     quantize_params: Optional[Callable[[Any], Any]] = None
+    #: random-init straight into the quantized layout, one layer at a
+    #: time — init_params + quantize_params peaks at full-model dtype
+    #: size, which for 8B+ configs exceeds a single chip's HBM
+    init_params_quantized: Optional[Callable[[jax.Array], Any]] = None
 
 
 _LLAMA_PRESETS: dict[str, Callable[[], LlamaConfig]] = {
@@ -92,6 +96,9 @@ def _llama_adapter(
         kv_spec=lambda: KVPages(k=kv_cache_spec(), v=kv_cache_spec()),
         load_params=lambda path: _load_llama_checkpoint(path, cfg),
         quantize_params=llama_mod.quantize_params_int8,
+        init_params_quantized=lambda key: llama_mod.init_params_int8(
+            key, cfg
+        ),
     )
 
 

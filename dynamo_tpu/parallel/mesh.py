@@ -58,10 +58,11 @@ def init_multihost(
 
     This wires the process-group bring-up (coordinator rendezvous, global
     device visibility, collective transport). Cross-host SPMD *serving* —
-    every host running the engine step in lockstep with global batch
-    arrays — additionally needs multi-controller scheduling and is not
-    wired yet; make_mesh refuses a multi-process mesh rather than
-    building one that only addresses host 0's devices.
+    every host running the engine step in lockstep over globally-sharded
+    batch arrays — is driven by engine/spmd.py: the leader broadcasts the
+    admission event log, every host replays it through its own
+    deterministic scheduler replica, and identical jit dispatches execute
+    over the shared mesh.
 
     Returns the number of global devices. Idempotent for identical
     arguments; raises on a conflicting re-init.
@@ -95,13 +96,22 @@ def make_mesh(
     all-gathers tokens rarely.
     """
     config = config or MeshConfig.single_device()
+    # Multi-process: jax.devices() is already the GLOBAL list after
+    # init_multihost; the mesh spans every host's chips and the engine
+    # runs multi-controller lockstep over it (engine/spmd.py drives the
+    # replicated schedulers; reference parity: MultiNodeConfig,
+    # engines.rs:43-50).
     if devices is None and jax.process_count() > 1:
-        raise NotImplementedError(
-            "multi-process meshes are not wired into the engine yet: a "
-            "host-local scheduler cannot drive a cross-host SPMD step "
-            "(needs lockstep multi-controller scheduling + global batch "
-            "arrays). The process group itself is up — see init_multihost."
-        )
+        world = jax.devices()
+        if config.num_devices != len(world):
+            # devices[:n] of the global list would be host 0's chips
+            # only — a "cross-host" mesh no other host can address.
+            # Partial-fleet meshes must pass an explicit device list.
+            raise ValueError(
+                f"mesh {config.shape} uses {config.num_devices} of "
+                f"{len(world)} global devices; a multi-process mesh must "
+                "span the whole fleet (or pass devices= explicitly)"
+            )
     devices = list(devices if devices is not None else jax.devices())
     n = config.num_devices
     if len(devices) < n:

@@ -1,0 +1,131 @@
+"""Leader/worker rendezvous barrier over the fabric KV store.
+
+Multi-host model serving needs a bring-up handshake before any collective
+runs: the leader publishes the serving plan (mesh shape, coordinator
+address, engine config digest) and blocks until every expected worker has
+registered; workers register and block until the leader's payload is
+visible. Reference parity: leader_worker_barrier.rs:26-121 (`barrier_key`
++ `wait_for_key_count`) — here rebuilt on the fabric store's `create` +
+`watch_prefix` primitives instead of etcd, so one mechanism serves both
+the in-process MemStore and the TCP fabric.
+
+Keys (namespaced under the barrier id):
+    barrier/{id}/leader            -> leader payload (the plan)
+    barrier/{id}/worker/{worker}   -> worker payload (usually empty)
+
+Both sides are idempotent per (id, role): re-entering the same barrier
+with the same worker id succeeds (the create that loses the race is
+treated as already-registered). A barrier id is single-use by contract —
+reusing one after a completed rendezvous returns immediately with the
+old payload, which is exactly the crash-restart behavior we want (a
+restarted worker re-reads the plan instead of deadlocking).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Optional
+
+from dynamo_tpu.runtime.store import KeyValueStore
+
+__all__ = ["BarrierTimeout", "leader_sync", "worker_sync"]
+
+
+class BarrierTimeout(TimeoutError):
+    """Rendezvous did not complete in time; carries who was missing."""
+
+
+def _prefix(barrier_id: str) -> str:
+    return f"barrier/{barrier_id}/"
+
+
+async def leader_sync(
+    store: KeyValueStore,
+    barrier_id: str,
+    num_workers: int,
+    payload: bytes,
+    *,
+    timeout: Optional[float] = None,
+    lease_id: Optional[str] = None,
+) -> list[str]:
+    """Publish `payload` and wait until `num_workers` distinct workers
+    have registered. Returns the sorted worker ids.
+
+    The payload is published BEFORE waiting (workers may arrive first and
+    must be able to read the plan immediately). With `lease_id`, all
+    barrier keys die with the leader's lease — a crashed bring-up cleans
+    itself up instead of wedging the next attempt.
+    """
+    key = _prefix(barrier_id) + "leader"
+    created = await store.create(key, payload, lease_id=lease_id)
+    if not created:
+        existing = await store.get(key)
+        if existing != payload:
+            raise RuntimeError(
+                f"barrier {barrier_id!r} already has a leader with a "
+                "different payload"
+            )
+    worker_prefix = _prefix(barrier_id) + "worker/"
+
+    async def _wait() -> list[str]:
+        # Subscribe BEFORE the snapshot so registrations that land
+        # between the two are seen on the watch rather than lost.
+        watch = await store.watch_prefix(worker_prefix)
+        try:
+            seen = set((await store.get_prefix(worker_prefix)).keys())
+            while len(seen) < num_workers:
+                ev = await watch.next()
+                if ev is None:
+                    raise RuntimeError("store closed during barrier wait")
+                if ev.kind == "put":
+                    seen.add(ev.key)
+            return sorted(k[len(worker_prefix):] for k in seen)
+        finally:
+            watch.close()
+
+    try:
+        return await asyncio.wait_for(_wait(), timeout)
+    except asyncio.TimeoutError:
+        have = await store.get_prefix(worker_prefix)
+        raise BarrierTimeout(
+            f"barrier {barrier_id!r}: {len(have)}/{num_workers} workers "
+            f"after {timeout}s (have: "
+            f"{sorted(k[len(worker_prefix):] for k in have)})"
+        ) from None
+
+
+async def worker_sync(
+    store: KeyValueStore,
+    barrier_id: str,
+    worker_id: str,
+    *,
+    payload: bytes = b"",
+    timeout: Optional[float] = None,
+    lease_id: Optional[str] = None,
+) -> bytes:
+    """Register under the barrier and wait for the leader's payload."""
+    key = _prefix(barrier_id) + "worker/" + worker_id
+    await store.create(key, payload, lease_id=lease_id)  # lost race == re-entry
+    leader_key = _prefix(barrier_id) + "leader"
+
+    async def _wait() -> bytes:
+        watch = await store.watch_prefix(leader_key)
+        try:
+            data = await store.get(leader_key)
+            while data is None:
+                ev = await watch.next()
+                if ev is None:
+                    raise RuntimeError("store closed during barrier wait")
+                if ev.kind == "put":
+                    data = ev.value
+            return data
+        finally:
+            watch.close()
+
+    try:
+        return await asyncio.wait_for(_wait(), timeout)
+    except asyncio.TimeoutError:
+        raise BarrierTimeout(
+            f"barrier {barrier_id!r}: leader payload not published after "
+            f"{timeout}s (worker {worker_id!r} is registered)"
+        ) from None

@@ -1,0 +1,161 @@
+"""One host of the multi-process SPMD serving test.
+
+Spawned by tests/test_spmd_serve.py (2 processes x 4 virtual CPU devices
+-> one 8-device global mesh). The leader admits a fixed greedy workload
+and writes the generated tokens as JSON; followers mirror every step via
+SpmdDriver.serve(). Run directly only through the test.
+"""
+
+import argparse
+import json
+import os
+import sys
+from pathlib import Path
+
+REPO = str(Path(__file__).resolve().parents[2])
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--host-id", type=int, required=True)
+    ap.add_argument("--num-hosts", type=int, required=True)
+    ap.add_argument("--coordinator", required=True)
+    ap.add_argument("--devices-per-host", type=int, default=4)
+    ap.add_argument("--dp", type=int, default=4)
+    ap.add_argument("--tp", type=int, default=2)
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={args.devices_per_host}"
+    )
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    sys.path.insert(0, REPO)
+
+    from dynamo_tpu.parallel.mesh import init_multihost
+
+    n = init_multihost(args.coordinator, args.num_hosts, args.host_id)
+    assert n == args.num_hosts * args.devices_per_host, n
+
+    from dynamo_tpu.engine import EngineConfig
+    from dynamo_tpu.engine.engine import JaxEngine
+    from dynamo_tpu.engine.request import SamplingParams
+    from dynamo_tpu.engine.spmd import SpmdDriver
+
+    eng = JaxEngine(spmd_test_config(args.dp, args.tp))
+    drv = SpmdDriver(eng)
+    if drv.is_leader:
+        for rid, toks, mt in spmd_test_workload():
+            drv.submit(rid, toks, SamplingParams(temperature=0.0,
+                                                 max_tokens=mt))
+        done = drv.run_to_completion()
+        drv.shutdown()
+        Path(args.out).write_text(json.dumps(done))
+    else:
+        drv.serve()
+
+
+def spmd_test_config(dp: int, tp: int):
+    """Shared by the multi-process hosts and the single-process
+    reference run — identical config => identical programs."""
+    from dynamo_tpu.engine import EngineConfig
+
+    return EngineConfig(
+        model="tiny",
+        dp=dp,
+        tp=tp,
+        num_pages=64,
+        page_size=4,
+        max_pages_per_seq=16,
+        decode_buckets=(2, 4),
+        prefill_chunk=32,
+        prefill_token_budget=128,
+        decode_steps=4,
+        max_seqs=8,
+        dtype="float32",
+        enable_prefix_caching=True,
+    )
+
+
+def spawn_two_hosts(
+    devices_per_host: int = 4,
+    dp: int = 4,
+    tp: int = 2,
+    timeout: float = 420.0,
+):
+    """Spawn the 2-process lockstep fleet and return (leader_outputs,
+    logs). Shared by tests/test_spmd_serve.py and __graft_entry__'s
+    dryrun; kills both hosts and surfaces their logs on timeout."""
+    import socket
+    import subprocess
+    import tempfile
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    out = Path(tempfile.mkdtemp(prefix="spmd-fleet-")) / "leader.json"
+    env = {
+        k: v
+        for k, v in os.environ.items()
+        if k not in ("JAX_PLATFORMS", "XLA_FLAGS")
+    }
+    procs = [
+        subprocess.Popen(
+            [
+                sys.executable, __file__,
+                "--host-id", str(i), "--num-hosts", "2",
+                "--coordinator", f"127.0.0.1:{port}",
+                "--devices-per-host", str(devices_per_host),
+                "--dp", str(dp), "--tp", str(tp),
+                *(["--out", str(out)] if i == 0 else []),
+            ],
+            env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True,
+        )
+        for i in range(2)
+    ]
+    logs = []
+    try:
+        for p in procs:
+            logs.append(p.communicate(timeout=timeout)[0])
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        for p in procs:
+            try:
+                logs.append(p.communicate(timeout=10)[0])
+            except Exception:  # noqa: BLE001
+                logs.append("<no output>")
+        raise RuntimeError(
+            "SPMD hosts timed out\n--- host0 ---\n"
+            + (logs[0] if logs else "?")
+            + "\n--- host1 ---\n"
+            + (logs[1] if len(logs) > 1 else "?")
+        ) from None
+    for i, p in enumerate(procs):
+        if p.returncode != 0:
+            raise RuntimeError(
+                f"SPMD host {i} rc={p.returncode}\n--- host0 ---\n"
+                f"{logs[0]}\n--- host1 ---\n{logs[1]}"
+            )
+    return json.loads(out.read_text()), logs
+
+
+def spmd_test_workload():
+    """(request_id, prompt_tokens, max_tokens) — deterministic, mixed
+    lengths so prefill buckets AND the decode path both run."""
+    import numpy as np
+
+    rng = np.random.default_rng(11)
+    return [
+        (f"req{i}", [int(x) for x in rng.integers(1, 250, ln)], mt)
+        for i, (ln, mt) in enumerate([(6, 8), (13, 8), (25, 6), (9, 4)])
+    ]
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,108 @@
+"""Leader/worker barrier rendezvous (runtime/barrier.py).
+
+Mirrors the reference's leader_worker_barrier tests: leader blocks until
+the worker count is met, workers receive the leader payload regardless of
+arrival order, timeouts name the missing side, re-entry is idempotent.
+"""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.runtime.barrier import BarrierTimeout, leader_sync, worker_sync
+from dynamo_tpu.runtime.store import MemStore
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def test_workers_then_leader():
+    async def main():
+        store = MemStore()
+        workers = [
+            asyncio.create_task(worker_sync(store, "b1", f"w{i}", timeout=5))
+            for i in range(3)
+        ]
+        await asyncio.sleep(0.02)  # workers registered, leader late
+        ids = await leader_sync(store, "b1", 3, b"plan-v1", timeout=5)
+        payloads = await asyncio.gather(*workers)
+        assert ids == ["w0", "w1", "w2"]
+        assert payloads == [b"plan-v1"] * 3
+
+    run(main())
+
+
+def test_leader_then_workers():
+    async def main():
+        store = MemStore()
+        leader = asyncio.create_task(
+            leader_sync(store, "b2", 2, b"plan", timeout=5)
+        )
+        await asyncio.sleep(0.02)
+        assert not leader.done()  # still waiting on workers
+        p1 = await worker_sync(store, "b2", "a", timeout=5)
+        p2 = await worker_sync(store, "b2", "b", timeout=5)
+        assert (p1, p2) == (b"plan", b"plan")
+        assert await leader == ["a", "b"]
+
+    run(main())
+
+
+def test_leader_timeout_names_missing():
+    async def main():
+        store = MemStore()
+        w = asyncio.create_task(worker_sync(store, "b3", "only", timeout=5))
+        await asyncio.sleep(0.02)  # registered, now blocked on the leader
+        with pytest.raises(BarrierTimeout) as e:
+            await leader_sync(store, "b3", 2, b"p", timeout=0.05)
+        assert "1/2" in str(e.value) and "only" in str(e.value)
+        w.cancel()
+
+    run(main())
+
+
+def test_worker_timeout():
+    async def main():
+        store = MemStore()
+        with pytest.raises(BarrierTimeout):
+            await worker_sync(store, "b4", "w", timeout=0.05)
+
+    run(main())
+
+
+def test_reentry_is_idempotent():
+    """A restarted worker re-reads the plan; a re-run leader with the
+    same payload succeeds; a different payload is refused."""
+
+    async def main():
+        store = MemStore()
+        w = asyncio.create_task(worker_sync(store, "b5", "w", timeout=5))
+        await leader_sync(store, "b5", 1, b"plan", timeout=5)
+        await w
+        assert await worker_sync(store, "b5", "w", timeout=5) == b"plan"
+        assert await leader_sync(store, "b5", 1, b"plan", timeout=5) == ["w"]
+        with pytest.raises(RuntimeError, match="different payload"):
+            await leader_sync(store, "b5", 1, b"other", timeout=5)
+
+    run(main())
+
+
+def test_lease_scoped_cleanup():
+    """Barrier keys granted under a lease vanish when the lease dies —
+    a crashed bring-up doesn't wedge the next attempt."""
+
+    async def main():
+        store = MemStore()
+        lease = await store.grant_lease(ttl=30)
+        w = asyncio.create_task(
+            worker_sync(store, "b6", "w", timeout=5, lease_id=lease)
+        )
+        await asyncio.sleep(0.02)  # registered under the lease
+        w.cancel()
+        await store.revoke_lease(lease)
+        # the stale registration is gone: a fresh leader times out
+        with pytest.raises(BarrierTimeout):
+            await leader_sync(store, "b6", 1, b"p", timeout=0.05)
+
+    run(main())

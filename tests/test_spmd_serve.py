@@ -1,0 +1,61 @@
+"""Cross-host SPMD serving, end to end (VERDICT r2 item 3).
+
+Two REAL processes x 4 virtual CPU devices each join one jax.distributed
+group, build one 8-device global mesh (dp=4, tp=2), and serve a greedy
+workload through SpmdDriver's lockstep event broadcast. The leader's
+outputs must match a single-process run of the SAME config on a local
+8-device mesh exactly — proving replicated deterministic scheduling plus
+XLA cross-host collectives implement the reference's multi-node serving
+(MultiNodeConfig, engines.rs:43-50) without a head-node RPC plane.
+"""
+
+import os
+import sys
+from pathlib import Path
+
+import pytest
+
+HELPER = Path(__file__).parent / "helpers" / "spmd_host.py"
+
+pytestmark = pytest.mark.skipif(
+    bool(os.environ.get("DYNTPU_TEST_ON_TPU")),
+    reason="CPU-mesh lockstep test: the subprocess hosts force the CPU "
+    "platform, so an on-TPU reference run would compare greedy argmax "
+    "across backends",
+)
+
+
+@pytest.fixture(scope="module")
+def spmd_outputs():
+    sys.path.insert(0, str(HELPER.parent))
+    from spmd_host import spawn_two_hosts
+
+    outputs, _logs = spawn_two_hosts()
+    return outputs
+
+
+def _reference_outputs():
+    """Same config + workload on this process's local 8-device mesh."""
+    sys.path.insert(0, str(HELPER.parent))
+    from spmd_host import spmd_test_config, spmd_test_workload
+
+    from dynamo_tpu.engine.engine import JaxEngine
+    from dynamo_tpu.engine.request import SamplingParams
+
+    eng = JaxEngine(spmd_test_config(dp=4, tp=2))
+    for rid, toks, mt in spmd_test_workload():
+        eng.add_request(
+            rid, toks, SamplingParams(temperature=0.0, max_tokens=mt)
+        )
+    return eng.run_to_completion()
+
+
+def test_two_host_serving_matches_single_process(spmd_outputs):
+    ref = _reference_outputs()
+    assert set(spmd_outputs) == set(ref)
+    for rid in ref:
+        assert spmd_outputs[rid] == ref[rid], (
+            f"{rid}: spmd={spmd_outputs[rid]} ref={ref[rid]}"
+        )
+    # every request actually generated tokens
+    assert all(len(v) > 0 for v in ref.values())
