@@ -35,6 +35,7 @@ _SOURCES = [
     _NATIVE_DIR / "pool.cpp",
     _NATIVE_DIR / "host_tier.cpp",
     _NATIVE_DIR / "codec.cpp",
+    _NATIVE_DIR / "kv_events.cpp",
     _NATIVE_DIR / "xxh3.h",
 ]
 
@@ -121,6 +122,16 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.dyn_frame_parse_prefix.argtypes = [p, p, p]
     lib.dyn_frame_check.restype = ctypes.c_int
     lib.dyn_frame_check.argtypes = [p, p, sz, p, sz]
+    # kv_events.cpp — external-engine KV-event publisher
+    lib.dyn_kv_pub_connect.restype = p
+    lib.dyn_kv_pub_connect.argtypes = [
+        ctypes.c_char_p, ctypes.c_int, ctypes.c_char_p,
+    ]
+    lib.dyn_kv_pub_publish.restype = ctypes.c_int
+    lib.dyn_kv_pub_publish.argtypes = [p, ctypes.c_int, p, sz, i64]
+    lib.dyn_kv_pub_last_error.restype = ctypes.c_char_p
+    lib.dyn_kv_pub_last_error.argtypes = [p]
+    lib.dyn_kv_pub_close.argtypes = [p]
     return lib
 
 
@@ -170,7 +181,11 @@ def _load() -> Optional[ctypes.CDLL]:
     global _lib, _build_failed
     try:
         _lib = _configure(ctypes.CDLL(str(_LIB_PATH)))
-    except OSError as e:
+    except (OSError, AttributeError) as e:
+        # AttributeError: a .so from an older source revision is missing
+        # newly-declared symbols (git checkouts can leave mtimes that
+        # defeat _stale's strict >) — same contract as unloadable: return
+        # None, pure-Python fallbacks cover the gap
         logger.warning("could not load %s: %s", _LIB_PATH, e)
         _lib = None
         _build_failed = True

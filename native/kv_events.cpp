@@ -1,0 +1,336 @@
+// External-engine KV-event publisher (C ABI).
+//
+// A foreign engine (C/C++/anything with FFI) embeds this to publish
+// KV-cache stored/removed events onto the fabric bus, where the router's
+// indexer consumes them and starts routing prefix-overlapping requests to
+// that engine. Reference parity: lib/bindings/c/src/lib.rs:260
+// (dynamo_kv_event_publish_stored / _removed, which exist precisely so
+// engines outside the framework can feed the KV router).
+//
+// Wire format matches dynamo_tpu/runtime/codec.py (u32 hlen | u32 plen |
+// u64 xxh3(h) | u64 xxh3(p) | msgpack header | payload) and the event
+// dicts of worker.py::_publish_loop:
+//   subject "kv_events.{instance_id}"
+//   header  {"op":"bus.pub","subject":...,"header":{"instance_id":...,
+//            "count":N},"id":n}
+//   payload msgpack [{"kind":"stored"|"removed","block_hashes":[u64...],
+//                     "parent_hash":u64|nil,"token_blocks":[]}, ...]
+//
+// One publisher = one TCP connection + one outstanding request (publish
+// blocks until the fabric acks). Foreign engines batch by passing many
+// hashes per call; block hashes come from dyn_hash_token_blocks
+// (dynamo_native.cpp:41) so the chain matches in-process workers.
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "xxh3.h"
+
+namespace {
+
+// -- minimal msgpack writer (maps w/ str keys, str, u64, i64, nil, arrays)
+
+struct Pack {
+  std::vector<uint8_t> buf;
+
+  void u8(uint8_t b) { buf.push_back(b); }
+  void raw(const void* p, size_t n) {
+    const uint8_t* b = static_cast<const uint8_t*>(p);
+    buf.insert(buf.end(), b, b + n);
+  }
+  void be16(uint16_t v) { uint8_t b[2] = {uint8_t(v >> 8), uint8_t(v)}; raw(b, 2); }
+  void be32(uint32_t v) {
+    uint8_t b[4] = {uint8_t(v >> 24), uint8_t(v >> 16), uint8_t(v >> 8),
+                    uint8_t(v)};
+    raw(b, 4);
+  }
+  void be64(uint64_t v) {
+    uint8_t b[8];
+    for (int i = 0; i < 8; i++) b[i] = uint8_t(v >> (56 - 8 * i));
+    raw(b, 8);
+  }
+
+  void map(uint32_t n) {
+    if (n < 16) u8(0x80 | n);
+    else { u8(0xde); be16(uint16_t(n)); }
+  }
+  void array(uint32_t n) {
+    if (n < 16) u8(0x90 | n);
+    else if (n <= 0xffff) { u8(0xdc); be16(uint16_t(n)); }
+    else { u8(0xdd); be32(n); }
+  }
+  void str(const char* s) {
+    size_t n = strlen(s);
+    if (n < 32) u8(0xa0 | uint8_t(n));
+    else if (n <= 0xff) { u8(0xd9); u8(uint8_t(n)); }
+    else { u8(0xda); be16(uint16_t(n)); }
+    raw(s, n);
+  }
+  void uint(uint64_t v) {
+    if (v < 128) u8(uint8_t(v));
+    else if (v <= 0xff) { u8(0xcc); u8(uint8_t(v)); }
+    else if (v <= 0xffff) { u8(0xcd); be16(uint16_t(v)); }
+    else if (v <= 0xffffffffULL) { u8(0xce); be32(uint32_t(v)); }
+    else { u8(0xcf); be64(v); }
+  }
+  void nil() { u8(0xc0); }
+};
+
+// -- minimal msgpack reader for flat ack maps {ok: bool, id: uint, ...}
+
+struct Scan {
+  const uint8_t* p;
+  const uint8_t* end;
+  bool ok_field = false;
+  bool has_ok = false;
+  std::string error;
+
+  bool skip(int depth = 0);
+  bool parse_top();
+};
+
+bool Scan::skip(int depth) {
+  if (p >= end || depth > 8) return false;
+  uint8_t t = *p++;
+  auto need = [&](size_t n) { return size_t(end - p) >= n; };
+  if (t < 0xc0) {  // fixint / fixmap / fixarray / fixstr
+    if (t >= 0xa0) { size_t n = t & 0x1f; if (!need(n)) return false; p += n; return true; }
+    if (t >= 0x90) { for (int i = t & 0xf; i; i--) if (!skip(depth + 1)) return false; return true; }
+    if (t >= 0x80) { for (int i = (t & 0xf) * 2; i; i--) if (!skip(depth + 1)) return false; return true; }
+    return true;  // positive fixint
+  }
+  if (t >= 0xe0) return true;  // negative fixint
+  switch (t) {
+    case 0xc0: case 0xc2: case 0xc3: return true;
+    case 0xcc: case 0xd0: if (!need(1)) return false; p += 1; return true;
+    case 0xcd: case 0xd1: if (!need(2)) return false; p += 2; return true;
+    case 0xce: case 0xd2: case 0xca: if (!need(4)) return false; p += 4; return true;
+    case 0xcf: case 0xd3: case 0xcb: if (!need(8)) return false; p += 8; return true;
+    case 0xd9: case 0xc4: { if (!need(1)) return false; size_t n = *p++; if (!need(n)) return false; p += n; return true; }
+    case 0xda: case 0xc5: { if (!need(2)) return false; size_t n = (size_t(p[0]) << 8) | p[1]; p += 2; if (!need(n)) return false; p += n; return true; }
+    case 0xdb: case 0xc6: { if (!need(4)) return false; size_t n = (size_t(p[0]) << 24) | (size_t(p[1]) << 16) | (size_t(p[2]) << 8) | p[3]; p += 4; if (!need(n)) return false; p += n; return true; }
+    case 0xdc: { if (!need(2)) return false; size_t n = (size_t(p[0]) << 8) | p[1]; p += 2; for (; n; n--) if (!skip(depth + 1)) return false; return true; }
+    case 0xde: { if (!need(2)) return false; size_t n = ((size_t(p[0]) << 8) | p[1]) * 2; p += 2; for (; n; n--) if (!skip(depth + 1)) return false; return true; }
+    default: return false;  // types the ack never carries
+  }
+}
+
+bool Scan::parse_top() {
+  if (p >= end) return false;
+  uint8_t t = *p++;
+  size_t n;
+  if ((t & 0xf0) == 0x80) n = t & 0xf;
+  else if (t == 0xde) { if (end - p < 2) return false; n = (size_t(p[0]) << 8) | p[1]; p += 2; }
+  else return false;
+  for (; n; n--) {
+    // key (str)
+    if (p >= end) return false;
+    uint8_t kt = *p++;
+    size_t kl;
+    if ((kt & 0xe0) == 0xa0) kl = kt & 0x1f;
+    else if (kt == 0xd9) { if (p >= end) return false; kl = *p++; }
+    else return false;
+    if (size_t(end - p) < kl) return false;
+    const char* key = reinterpret_cast<const char*>(p);
+    p += kl;
+    if (kl == 2 && memcmp(key, "ok", 2) == 0) {
+      if (p >= end) return false;
+      has_ok = true;
+      ok_field = (*p == 0xc3);
+      if (!skip()) return false;
+    } else if (kl == 5 && memcmp(key, "error", 5) == 0) {
+      // fixstr, str8 or str16 — fabric error strings routinely exceed
+      // the 31-char fixstr limit
+      size_t el = 0;
+      const uint8_t* sp = nullptr;
+      if (p < end && (*p & 0xe0) == 0xa0) {
+        el = *p & 0x1f;
+        sp = p + 1;
+      } else if (p + 1 < end && *p == 0xd9) {
+        el = p[1];
+        sp = p + 2;
+      } else if (p + 2 < end && *p == 0xda) {
+        el = (size_t(p[1]) << 8) | p[2];
+        sp = p + 3;
+      }
+      if (sp != nullptr && size_t(end - sp) >= el)
+        error.assign(reinterpret_cast<const char*>(sp), el);
+      if (!skip()) return false;
+    } else {
+      if (!skip()) return false;
+    }
+  }
+  return true;
+}
+
+struct Publisher {
+  int fd = -1;
+  std::string instance_id;
+  std::string subject;
+  uint64_t next_id = 1;
+  std::string last_error;
+
+  bool send_all(const uint8_t* p, size_t n) {
+    while (n) {
+      // MSG_NOSIGNAL: a half-closed socket must surface as rc=2, not
+      // SIGPIPE — the embedding foreign engine has default dispositions
+      ssize_t w = ::send(fd, p, n, MSG_NOSIGNAL);
+      if (w <= 0) { last_error = "send failed"; return false; }
+      p += w;
+      n -= size_t(w);
+    }
+    return true;
+  }
+  bool recv_all(uint8_t* p, size_t n) {
+    while (n) {
+      ssize_t r = ::recv(fd, p, n, 0);
+      if (r <= 0) { last_error = "connection closed"; return false; }
+      p += r;
+      n -= size_t(r);
+    }
+    return true;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* dyn_kv_pub_connect(const char* host, int port,
+                         const char* instance_id) {
+  auto* pub = new Publisher();
+  pub->instance_id = instance_id;
+  pub->subject = std::string("kv_events.") + instance_id;
+
+  addrinfo hints{};
+  hints.ai_family = AF_UNSPEC;
+  hints.ai_socktype = SOCK_STREAM;
+  addrinfo* res = nullptr;
+  char portbuf[16];
+  snprintf(portbuf, sizeof portbuf, "%d", port);
+  if (getaddrinfo(host, portbuf, &hints, &res) != 0 || res == nullptr) {
+    delete pub;
+    return nullptr;
+  }
+  int fd = -1;
+  for (addrinfo* a = res; a; a = a->ai_next) {
+    fd = ::socket(a->ai_family, a->ai_socktype, a->ai_protocol);
+    if (fd < 0) continue;
+    if (::connect(fd, a->ai_addr, a->ai_addrlen) == 0) break;
+    ::close(fd);
+    fd = -1;
+  }
+  freeaddrinfo(res);
+  if (fd < 0) {
+    delete pub;
+    return nullptr;
+  }
+  pub->fd = fd;
+  return pub;
+}
+
+// kind: 0 = stored, 1 = removed. parent_hash < 0 encodes "no parent".
+// Returns 0 on success, nonzero on failure (see dyn_kv_pub_last_error).
+int dyn_kv_pub_publish(void* handle, int kind, const uint64_t* hashes,
+                       size_t n, int64_t parent_hash) {
+  auto* pub = static_cast<Publisher*>(handle);
+  if (pub == nullptr || pub->fd < 0) return 1;
+
+  Pack payload;
+  payload.array(1);
+  payload.map(4);
+  payload.str("kind");
+  payload.str(kind == 0 ? "stored" : "removed");
+  payload.str("block_hashes");
+  payload.array(uint32_t(n));
+  for (size_t i = 0; i < n; i++) payload.uint(hashes[i]);
+  payload.str("parent_hash");
+  if (parent_hash < 0) payload.nil();
+  else payload.uint(uint64_t(parent_hash));
+  payload.str("token_blocks");
+  payload.array(0);
+
+  uint64_t rid = pub->next_id++;
+  Pack header;
+  header.map(4);
+  header.str("op");
+  header.str("bus.pub");
+  header.str("subject");
+  header.str(pub->subject.c_str());
+  header.str("header");
+  header.map(2);
+  header.str("instance_id");
+  header.str(pub->instance_id.c_str());
+  header.str("count");
+  header.uint(1);
+  header.str("id");
+  header.uint(rid);
+
+  uint8_t prefix[24];
+  uint32_t hlen = uint32_t(header.buf.size());
+  uint32_t plen = uint32_t(payload.buf.size());
+  uint64_t hsum = dynxxh3::xxh3_64(header.buf.data(), hlen, 0);
+  uint64_t psum = dynxxh3::xxh3_64(payload.buf.data(), plen, 0);
+  memcpy(prefix + 0, &hlen, 4);
+  memcpy(prefix + 4, &plen, 4);
+  memcpy(prefix + 8, &hsum, 8);
+  memcpy(prefix + 16, &psum, 8);
+
+  if (!pub->send_all(prefix, 24)) return 2;
+  if (!pub->send_all(header.buf.data(), hlen)) return 2;
+  if (!pub->send_all(payload.buf.data(), plen)) return 2;
+
+  // Ack: the only traffic on this connection is our replies (we never
+  // subscribe or watch), so the next frame is the ack.
+  uint8_t rp[24];
+  if (!pub->recv_all(rp, 24)) return 3;
+  uint32_t rhl, rpl;
+  memcpy(&rhl, rp + 0, 4);
+  memcpy(&rpl, rp + 4, 4);
+  if (rhl > (1u << 20) || rpl > (1u << 20)) {
+    pub->last_error = "oversized ack frame";
+    return 3;
+  }
+  std::vector<uint8_t> rh(rhl), rb(rpl);
+  if (!pub->recv_all(rh.data(), rhl)) return 3;
+  if (rpl && !pub->recv_all(rb.data(), rpl)) return 3;
+  uint64_t want;
+  memcpy(&want, rp + 8, 8);
+  if (dynxxh3::xxh3_64(rh.data(), rhl, 0) != want) {
+    pub->last_error = "ack header checksum mismatch";
+    return 3;
+  }
+  Scan s;
+  s.p = rh.data();
+  s.end = rh.data() + rhl;
+  if (!s.parse_top() || !s.has_ok) {
+    pub->last_error = "unparseable ack";
+    return 3;
+  }
+  if (!s.ok_field) {
+    pub->last_error = s.error.empty() ? "fabric nack" : s.error;
+    return 4;
+  }
+  return 0;
+}
+
+const char* dyn_kv_pub_last_error(void* handle) {
+  auto* pub = static_cast<Publisher*>(handle);
+  return pub ? pub->last_error.c_str() : "null publisher";
+}
+
+void dyn_kv_pub_close(void* handle) {
+  auto* pub = static_cast<Publisher*>(handle);
+  if (pub == nullptr) return;
+  if (pub->fd >= 0) ::close(pub->fd);
+  delete pub;
+}
+
+}  // extern "C"

@@ -62,7 +62,10 @@ sweep()      { run_stage perf_sweep python -m benchmarks.perf --mode engine \
                  --num-requests 64 --isl 512 --osl 128 --concurrency 1,4,16,64; }
 sweep_8b()   { run_stage perf_sweep_8b python -m benchmarks.perf --mode engine \
                  --model llama3-8b --quantize int8 --distribution sharegpt \
+                 --num-pages 512 \
                  --num-requests 32 --isl 512 --osl 128 --concurrency 1,4,16; }
+                 # 512 pages: the 2048 default is 17GB of 8B-shape KV —
+                 # with int8 weights that exceeds v5e HBM (measured 24.5G)
 sla()        { run_stage profile_sla python -m benchmarks.profile_sla \
                  --model llama3-1b --isl 512 --osl 128 --concurrency 1,2,4,8; }
 disagg_ab()  { run_stage disagg_ab python -m benchmarks.disagg_bench \

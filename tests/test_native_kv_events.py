@@ -1,0 +1,127 @@
+"""External-engine C-ABI KV-event publish (native/kv_events.cpp).
+
+A ctypes harness poses as a FOREIGN engine — no dynamo_tpu Python runtime
+on the publishing side, just the C ABI: connect to the fabric over TCP,
+publish stored/removed events in the native wire format, and assert the
+router's KvIndexer (a real subscriber on a real FabricServer) indexes
+them and routes prefix overlaps to the foreign worker. Reference parity:
+lib/bindings/c/src/lib.rs:260 (dynamo_kv_event_publish_stored), whose
+stated purpose is exactly this foreign-engine feed.
+"""
+
+import asyncio
+import ctypes
+
+import pytest
+
+from dynamo_tpu import native
+from dynamo_tpu.kv_router.indexer import KvIndexer
+from dynamo_tpu.runtime.fabric import FabricServer, RemoteFabric
+
+
+@pytest.fixture()
+def lib():
+    lib = native.lib()
+    if lib is None or not hasattr(lib, "dyn_kv_pub_publish"):
+        pytest.skip("native library unavailable")
+    return lib
+
+
+def _publish(lib, port: int, instance: bytes, kind: int,
+             hashes: list[int], parent: int = -1) -> None:
+    pub = lib.dyn_kv_pub_connect(b"127.0.0.1", port, instance)
+    assert pub, "C publisher could not connect"
+    try:
+        arr = (ctypes.c_uint64 * len(hashes))(*hashes)
+        rc = lib.dyn_kv_pub_publish(pub, kind, arr, len(hashes), parent)
+        assert rc == 0, lib.dyn_kv_pub_last_error(pub).decode()
+    finally:
+        lib.dyn_kv_pub_close(pub)
+
+
+def test_foreign_engine_feeds_router(lib):
+    async def main():
+        server = FabricServer(port=0)
+        await server.start()
+        try:
+            fabric = await RemoteFabric.connect(f"127.0.0.1:{server.port}")
+            indexer = KvIndexer(fabric)
+            await indexer.start()
+
+            # the "foreign engine" stores a 3-block chain, C ABI only
+            await asyncio.to_thread(
+                _publish, lib, server.port, b"foreign-1", 0,
+                [101, 102, 103],
+            )
+            for _ in range(100):
+                if indexer.tree.num_blocks >= 3:
+                    break
+                await asyncio.sleep(0.02)
+            assert indexer.tree.num_blocks == 3
+            scores = indexer.find_matches([101, 102, 103, 999])
+            assert scores.scores.get("foreign-1") == 3
+            assert indexer.workers() == {"foreign-1"}
+
+            # removal shrinks the index
+            await asyncio.to_thread(
+                _publish, lib, server.port, b"foreign-1", 1, [103],
+            )
+            for _ in range(100):
+                if indexer.tree.num_blocks == 2:
+                    break
+                await asyncio.sleep(0.02)
+            assert indexer.find_matches([101, 102, 103]).scores.get(
+                "foreign-1"
+            ) == 2
+
+            await indexer.stop()
+            await fabric.close()
+        finally:
+            await server.stop()
+
+    asyncio.run(main())
+
+
+def test_publish_batches_and_sequential_calls(lib):
+    """One connection, many publishes — next_id increments must keep
+    acks matched; a second worker's events land in the same index."""
+
+    async def main():
+        server = FabricServer(port=0)
+        await server.start()
+        try:
+            fabric = await RemoteFabric.connect(f"127.0.0.1:{server.port}")
+            indexer = KvIndexer(fabric)
+            await indexer.start()
+
+            def many():
+                pub = lib.dyn_kv_pub_connect(
+                    b"127.0.0.1", server.port, b"foreign-2"
+                )
+                assert pub
+                try:
+                    for base in (0, 100, 200):
+                        hashes = [base + 1, base + 2]
+                        arr = (ctypes.c_uint64 * 2)(*hashes)
+                        rc = lib.dyn_kv_pub_publish(pub, 0, arr, 2, -1)
+                        assert rc == 0, lib.dyn_kv_pub_last_error(
+                            pub
+                        ).decode()
+                finally:
+                    lib.dyn_kv_pub_close(pub)
+
+            await asyncio.to_thread(many)
+            for _ in range(100):
+                if indexer.tree.num_blocks >= 6:
+                    break
+                await asyncio.sleep(0.02)
+            assert indexer.tree.num_blocks == 6
+            assert indexer.find_matches([201, 202]).scores == {
+                "foreign-2": 2
+            }
+            await indexer.stop()
+            await fabric.close()
+        finally:
+            await server.stop()
+
+    asyncio.run(main())
