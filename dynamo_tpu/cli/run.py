@@ -202,6 +202,27 @@ async def _run_batch(args, path: str) -> None:
             runner.stop()
 
 
+def _run_spmd_follower(args) -> None:
+    """Follower host of a cross-host SPMD serving group: build the
+    identical engine replica and block in the lockstep serve loop."""
+    from dynamo_tpu.engine.engine import JaxEngine
+    from dynamo_tpu.engine.spmd import SpmdDriver
+
+    card = _card(args)
+    engine = JaxEngine(
+        _engine_config(args, card.eos_token_ids),
+        checkpoint_path=args.checkpoint,
+    )
+    drv = SpmdDriver(engine)
+    if drv.is_leader:  # pragma: no cover — arg-mismatch guard
+        raise RuntimeError("follower entry reached on process 0")
+    print(
+        f"spmd follower {args.host_id} up (model={args.model})", flush=True
+    )
+    drv.serve()
+    print(f"spmd follower {args.host_id} released", flush=True)
+
+
 async def _run_worker(args) -> None:
     from dynamo_tpu.runtime import DistributedRuntime
     from dynamo_tpu.worker import Worker
@@ -922,6 +943,19 @@ def main(argv: Optional[list[str]] = None) -> None:
             f"{n} global devices",
             flush=True,
         )
+        if args.host_id > 0:
+            # Follower replica of a cross-host SPMD group: no fabric, no
+            # ingress — just mirror the leader's lockstep broadcasts
+            # until its shutdown (engine/spmd.py).
+            if inp != "dyn" or args.out != "jax":
+                print(
+                    "host-id > 0 only serves as an SPMD follower: use "
+                    "`run in=dyn out=jax` on every host",
+                    file=sys.stderr,
+                )
+                sys.exit(2)
+            _run_spmd_follower(args)
+            return
 
     if inp == "dyn":
         asyncio.run(_run_worker(args))

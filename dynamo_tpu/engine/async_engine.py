@@ -87,23 +87,36 @@ class AsyncEngineRunner:
 
     # -- engine thread -----------------------------------------------------
 
+    def _drain_inbox(self):
+        with self._lock:
+            pending, self._pending = self._pending, []
+            aborts, self._aborts = self._aborts, []
+            ops, self._ops = self._ops, []
+        return pending, aborts, ops
+
+    def _run_ops(self, ops) -> None:
+        for fn, fut in ops:
+            try:
+                res = fn(self.engine)
+                self._loop.call_soon_threadsafe(
+                    lambda f=fut, r=res: f.done() or f.set_result(r)
+                )
+            except Exception as e:
+                self._loop.call_soon_threadsafe(
+                    lambda f=fut, err=e: f.done() or f.set_exception(err)
+                )
+
+    def _emit(self, outputs) -> None:
+        for out in outputs:
+            self._post(out.request_id, output_to_dict(out))
+            if out.finish_reason is not None:
+                self._post(out.request_id, None)
+
     def _run(self) -> None:
         eng = self.engine
         while not self._stop:
-            with self._lock:
-                pending, self._pending = self._pending, []
-                aborts, self._aborts = self._aborts, []
-                ops, self._ops = self._ops, []
-            for fn, fut in ops:
-                try:
-                    res = fn(eng)
-                    self._loop.call_soon_threadsafe(
-                        lambda f=fut, r=res: f.done() or f.set_result(r)
-                    )
-                except Exception as e:
-                    self._loop.call_soon_threadsafe(
-                        lambda f=fut, err=e: f.done() or f.set_exception(err)
-                    )
+            pending, aborts, ops = self._drain_inbox()
+            self._run_ops(ops)
             for req, sampling in pending:
                 try:
                     eng.add_request(
@@ -125,10 +138,7 @@ class AsyncEngineRunner:
             except Exception:
                 logger.exception("engine step failed")
                 continue
-            for out in outputs:
-                self._post(out.request_id, output_to_dict(out))
-                if out.finish_reason is not None:
-                    self._post(out.request_id, None)
+            self._emit(outputs)
 
     def _post(self, request_id: str, item) -> None:
         q = self._queues.get(request_id)
@@ -198,6 +208,105 @@ class AsyncEngineRunner:
     @property
     def metrics(self):
         return self.engine.metrics
+
+
+class SpmdEngineRunner(AsyncEngineRunner):
+    """Leader-side runner for one replica of a cross-host lockstep group
+    (engine/spmd.py): admissions, aborts, and cache clears ride the
+    driver's broadcast so every host's scheduler replica stays identical;
+    the jitted steps execute SPMD over the shared mesh.
+
+    Contract differences from the base runner:
+    - submit(fn) ops MUST be read-only (metrics snapshots, hit queries) —
+      a mutating op would desync the replicas. The one mutating op the
+      worker needs, prefix-cache clear, has clear_kv().
+    - multimodal requests are refused (embeddings cannot ride the JSON
+      event broadcast yet).
+    """
+
+    def __init__(self, engine, driver):
+        super().__init__(engine)
+        self.driver = driver
+        self._clears: list[asyncio.Future] = []
+
+    async def clear_kv(self) -> int:
+        """Replicated prefix-cache clear; resolves to freed page count."""
+        fut = asyncio.get_running_loop().create_future()
+        with self._lock:
+            self._clears.append(fut)
+        self._wake.set()
+        return await fut
+
+    async def embed(self, prompts, normalize: bool = True):
+        # engine.embed dispatches leader-only jitted SPMD programs and
+        # allocates scratch pages — the followers would never join the
+        # collectives (cross-host hang) and the allocators would desync.
+        raise RuntimeError(
+            "embeddings are not supported on a cross-host SPMD group yet"
+        )
+
+    def _run(self) -> None:
+        drv = self.driver
+        eng = self.engine
+        while not self._stop:
+            pending, aborts, ops = self._drain_inbox()
+            with self._lock:
+                clears, self._clears = self._clears, []
+            self._run_ops(ops)  # read-only by contract
+            for req, sampling in pending:
+                if req.mm_embeds is not None:
+                    self._post(
+                        req.request_id,
+                        {
+                            "error": "multimodal requests are not "
+                            "supported on a cross-host SPMD group yet"
+                        },
+                    )
+                    self._post(req.request_id, None)
+                    continue
+                drv.submit(req.request_id, list(req.token_ids), sampling)
+            for rid in aborts:
+                drv.abort(rid)
+            if clears:
+                drv.clear_cache()
+            if not (drv._pending or eng.has_work):
+                self._wake.wait(timeout=0.05)
+                self._wake.clear()
+                continue
+            try:
+                outputs = drv.step()
+            except Exception as e:  # broadcast-layer failure (the driver
+                # already swallows engine.step errors symmetrically)
+                logger.exception("lockstep step failed")
+                self._fail_clears(clears, e)
+                continue
+            for rid, err in drv.submit_errors:
+                self._post(rid, {"error": err})
+                self._post(rid, None)
+            drv.submit_errors.clear()
+            for fut in clears:
+                self._loop.call_soon_threadsafe(
+                    lambda f=fut, n=drv.last_cleared: f.done()
+                    or f.set_result(n)
+                )
+            self._emit(outputs)
+        # release the followers' serve() loops, then fail any flush
+        # still waiting (it would otherwise await forever)
+        try:
+            drv.shutdown()
+        except Exception:  # noqa: BLE001 — best-effort during teardown
+            logger.warning("lockstep shutdown broadcast failed", exc_info=True)
+        with self._lock:
+            leftovers, self._clears = self._clears, []
+        self._fail_clears(
+            leftovers, RuntimeError("engine runner stopped")
+        )
+
+    def _fail_clears(self, clears, exc: Exception) -> None:
+        for fut in clears:
+            self._loop.call_soon_threadsafe(
+                lambda f=fut, e=exc: f.done() or f.set_exception(e)
+            )
 
 
 def fake_embedding(tokens, dim: int = 32):

@@ -94,6 +94,12 @@ class SpmdDriver:
         )
         self._pending: list[dict] = []
         self._stopped = False
+        #: (request_id, error message) for submits that failed to admit
+        #: this round — drained by the serving layer to answer clients.
+        #: Every replica records the same failures; only the leader reads.
+        self.submit_errors: list[tuple[str, str]] = []
+        #: result of the last clear_cache op (leader reads after step)
+        self.last_cleared: Optional[int] = None
 
     # -- leader-side admission --------------------------------------------
 
@@ -117,6 +123,12 @@ class SpmdDriver:
         assert self.is_leader, "only the leader aborts requests"
         self._pending.append({"op": "abort", "rid": request_id})
 
+    def clear_cache(self) -> None:
+        """Queue a prefix-cache clear; replicated so every host's
+        allocator stays identical. Result lands in last_cleared."""
+        assert self.is_leader
+        self._pending.append({"op": "clear_cache"})
+
     # -- lockstep rounds ---------------------------------------------------
 
     def _apply(self, events: list[dict]) -> None:
@@ -125,11 +137,20 @@ class SpmdDriver:
             if op == "submit":
                 s = ev["sampling"]
                 s["stop_token_ids"] = tuple(s.get("stop_token_ids", ()))
-                self.engine.add_request(
-                    ev["rid"], ev["tokens"], SamplingParams(**s)
-                )
+                try:
+                    self.engine.add_request(
+                        ev["rid"], ev["tokens"], SamplingParams(**s)
+                    )
+                except Exception as e:  # noqa: BLE001 — deterministic:
+                    # every replica rejects the same bad request the same
+                    # way; only the leader reports it to a client (a
+                    # follower recording too would just leak memory)
+                    if self.is_leader:
+                        self.submit_errors.append((ev["rid"], str(e)))
             elif op == "abort":
                 self.engine.abort_request(ev["rid"])
+            elif op == "clear_cache":
+                self.last_cleared = self.engine.allocator.clear_cache()
             elif op == "stop":
                 self._stopped = True
             else:  # pragma: no cover — version-skew guard
@@ -143,7 +164,15 @@ class SpmdDriver:
         self._apply(events)
         if self._stopped:
             return []
-        return self.engine.step()
+        try:
+            return self.engine.step()
+        except Exception:  # noqa: BLE001 — MUST be symmetric: a
+            # deterministic step failure raises on every replica; if a
+            # follower died on it while the leader caught-and-continued,
+            # the leader's next broadcast would block forever on the
+            # missing participant. Both sides log and stay in lockstep.
+            logger.exception("lockstep engine step failed")
+            return []
 
     def step(self) -> list[StepOutput]:
         """One lockstep round: broadcast queued events, step every

@@ -76,6 +76,15 @@ def init_multihost(
                 f"{args}"
             )
         return len(jax.devices())
+    import os
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # CPU multi-process collectives need an explicit implementation;
+        # must be set before the backend initializes.
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception:  # noqa: BLE001 — older jax: option absent
+            pass
     jax.distributed.initialize(
         coordinator_address=coordinator,
         num_processes=num_hosts,

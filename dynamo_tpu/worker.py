@@ -21,7 +21,11 @@ from typing import Optional
 import msgpack
 
 from dynamo_tpu.engine import EngineConfig
-from dynamo_tpu.engine.async_engine import AsyncEngineRunner, EchoEngine
+from dynamo_tpu.engine.async_engine import (
+    AsyncEngineRunner,
+    EchoEngine,
+    SpmdEngineRunner,
+)
 from dynamo_tpu.engine.engine import JaxEngine
 from dynamo_tpu.engine.page_table import KvEvent
 from dynamo_tpu.model_card import ModelDeploymentCard, register_llm
@@ -142,7 +146,22 @@ class Worker:
                     ),
                 ),
             )
-            self.runner = AsyncEngineRunner(engine)
+            if engine._multiproc:
+                # One replica of a cross-host lockstep group: this host
+                # (the leader) owns the fabric endpoint; admissions ride
+                # the SpmdDriver broadcast to the follower replicas
+                # (engine/spmd.py). Disagg/G4 mutate engine state through
+                # runner.submit and would desync the replicas.
+                if self.enable_disagg or self.kv_remote:
+                    raise ValueError(
+                        "disagg / kv-remote are not supported on a "
+                        "cross-host SPMD group yet"
+                    )
+                from dynamo_tpu.engine.spmd import SpmdDriver
+
+                self.runner = SpmdEngineRunner(engine, SpmdDriver(engine))
+            else:
+                self.runner = AsyncEngineRunner(engine)
             self.runner.start()
 
         self.ingress.add_handler("generate", self._generate)
@@ -497,7 +516,10 @@ class Worker:
 
     async def _flush(self, ctx, request):
         n = 0
-        if self.runner is not None:
+        if isinstance(self.runner, SpmdEngineRunner):
+            # replicated clear: every host's allocator must stay identical
+            n = await self.runner.clear_kv()
+        elif self.runner is not None:
             # The engine thread is the only thread allowed to touch the
             # allocator — route through it.
             n = await self.runner.submit(
