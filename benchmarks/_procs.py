@@ -59,7 +59,14 @@ class ManagedProc:
     def kill(self, sig=signal.SIGKILL) -> None:
         if self.proc.poll() is None:
             self.proc.send_signal(sig)
-            self.proc.wait(timeout=10)
+            try:
+                self.proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                # escalate instead of raising: a raise here would skip
+                # the caller's remaining stop() calls and leak processes
+                if sig != signal.SIGKILL:
+                    self.proc.kill()
+                self.proc.wait(timeout=10)
 
     def stop(self) -> None:
         self.kill(signal.SIGTERM)

@@ -92,6 +92,41 @@ def run_topology(args, disagg: bool) -> dict:
              args.osl)
             for r in reqs
         ]
+        if args.warmup:
+            # Uncached random prompts at the sweep's max length compile
+            # every prefill/decode shape (incl. the remote-prefill path)
+            # before the timer; flush caches so the timed run is cold on
+            # prefixes, warm on XLA.
+            import random
+            import urllib.request
+
+            r = random.Random(13)
+            # cover the timed sweep's length spread (prefill shapes are
+            # bucketed, so warming only the max length would leave the
+            # smaller buckets to cold-compile inside the timed window)
+            lens = sorted({len(t) for t, _ in texts})
+            picks = [
+                lens[min(len(lens) - 1, i * len(lens) // args.warmup)]
+                for i in range(args.warmup)
+            ]
+            warm = [
+                ("".join(chr(97 + r.randrange(26)) for _ in range(n)),
+                 args.osl)
+                for n in picks
+            ]
+            asyncio.run(
+                bench_http(
+                    f"http://127.0.0.1:{hport}", args.model, warm,
+                    args.concurrency,
+                )
+            )
+            creq = urllib.request.Request(
+                f"http://127.0.0.1:{hport}/clear_kv_blocks", data=b"{}",
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(creq, timeout=10) as resp:
+                assert resp.status == 200
+
         out = asyncio.run(
             bench_http(
                 f"http://127.0.0.1:{hport}", args.model, texts,
@@ -100,6 +135,22 @@ def run_topology(args, disagg: bool) -> dict:
         )
         out["topology"] = "disagg" if disagg else "agg"
         return out
+    except BaseException:
+        import sys
+
+        for p in procs:
+            rc = p.proc.poll()
+            print(
+                f"--- {p.name}: {'alive' if rc is None else f'EXITED {rc}'}"
+                f" ({p.log_path})", file=sys.stderr,
+            )
+            try:
+                with open(p.log_path) as f:
+                    print("\n".join(f.read().splitlines()[-30:]),
+                          file=sys.stderr)
+            except OSError:
+                pass
+        raise
     finally:
         for p in reversed(procs):
             p.stop()
@@ -118,6 +169,7 @@ def main(argv=None) -> None:
     p.add_argument("--prefill-workers", type=int, default=1,
                    dest="prefill_workers")
     p.add_argument("--requests", type=int, default=16)
+    p.add_argument("--warmup", type=int, default=0)
     p.add_argument("--isl", type=int, default=24)
     p.add_argument("--osl", type=int, default=8)
     p.add_argument("--concurrency", type=int, default=4)

@@ -935,6 +935,21 @@ def main(argv: Optional[list[str]] = None) -> None:
     args.out = io.get("out", "jax")
 
     if getattr(args, "coordinator", None):
+        if inp != "dyn" or args.out != "jax":
+            # The lockstep group only exists behind the worker path
+            # (in=dyn builds an SpmdEngineRunner on host 0). Any other
+            # input on ANY host would build a plain runner whose first
+            # jitted dispatch blocks forever in cross-host collectives
+            # with no followers participating. Gate BEFORE init_multihost
+            # — that call blocks until every host joins, so a post-init
+            # check would hang instead of failing fast.
+            print(
+                "multi-host SPMD serving requires `run in=dyn out=jax` "
+                "on every host (put an `in=http` frontend in a separate "
+                "process, attached over the fabric)",
+                file=sys.stderr,
+            )
+            sys.exit(2)
         from dynamo_tpu.parallel.mesh import init_multihost
 
         n = init_multihost(args.coordinator, args.num_hosts, args.host_id)
@@ -947,13 +962,6 @@ def main(argv: Optional[list[str]] = None) -> None:
             # Follower replica of a cross-host SPMD group: no fabric, no
             # ingress — just mirror the leader's lockstep broadcasts
             # until its shutdown (engine/spmd.py).
-            if inp != "dyn" or args.out != "jax":
-                print(
-                    "host-id > 0 only serves as an SPMD follower: use "
-                    "`run in=dyn out=jax` on every host",
-                    file=sys.stderr,
-                )
-                sys.exit(2)
             _run_spmd_follower(args)
             return
 

@@ -223,6 +223,10 @@ class KvTransferServer:
             await self._nack(writer, rid, "no_waiter")
             return
         page_ids = header["page_ids"]
+        logger.info(
+            "device KV pull start for %s (%d pages from %s)",
+            rid, len(page_ids), header["xfer_addr"],
+        )
         try:
             k, v = await plane.pull(
                 header["xfer_addr"], header["uuid"],

@@ -279,6 +279,14 @@ class SpmdEngineRunner(AsyncEngineRunner):
                 # already swallows engine.step errors symmetrically)
                 logger.exception("lockstep step failed")
                 self._fail_clears(clears, e)
+                # This round's admissions were popped from the driver's
+                # pending queue before the broadcast died — they reached
+                # neither the engine nor the followers. Fail them; their
+                # clients would otherwise wait forever.
+                for req, _ in pending:
+                    self._post(req.request_id, {"error": f"lockstep step "
+                                                f"failed: {e}"})
+                    self._post(req.request_id, None)
                 continue
             for rid, err in drv.submit_errors:
                 self._post(rid, {"error": err})

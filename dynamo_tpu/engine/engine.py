@@ -85,6 +85,9 @@ class JaxEngine:
         checkpoint_path: Optional[str] = None,
         on_tier_event=None,
     ):
+        from dynamo_tpu.platform import enable_persistent_compile_cache
+
+        enable_persistent_compile_cache()
         self.config = config
         mc = mesh_config or MeshConfig(
             dp=config.dp, tp=config.tp, sp=config.sp, ep=config.ep

@@ -10,16 +10,11 @@ completion arrives and that the follower actually joined and released.
 
 import json
 import os
-import socket
-import subprocess
-import sys
-import time
 import urllib.request
-from pathlib import Path
 
 import pytest
 
-REPO = str(Path(__file__).resolve().parents[1])
+from benchmarks._procs import ManagedProc, cli, free_port
 
 pytestmark = pytest.mark.skipif(
     bool(os.environ.get("DYNTPU_TEST_ON_TPU")),
@@ -28,99 +23,62 @@ pytestmark = pytest.mark.skipif(
 )
 
 
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
-
-
-def _wait_for(log: Path, needle: str, timeout: float, procs) -> None:
-    t0 = time.time()
-    while time.time() - t0 < timeout:
-        for p in procs:
-            if p.poll() is not None:
-                raise AssertionError(
-                    f"process {p.args[-1]} exited rc={p.returncode} "
-                    f"before {needle!r}; log:\n"
-                    + "".join(
-                        f.read_text()
-                        for f in log.parent.glob("*.log")
-                    )[-4000:]
-                )
-        if log.exists() and needle in log.read_text():
-            return
-        time.sleep(0.3)
-    raise AssertionError(
-        f"{needle!r} not seen in {log} after {timeout}s:\n"
-        + (log.read_text()[-2000:] if log.exists() else "<missing>")
-    )
-
-
-def test_cli_spmd_serving(tmp_path):
-    fport = _free_port()
-    hport = _free_port()
-    cport = _free_port()
-    base_env = {
+def _env(devices: int = 0) -> dict:
+    env = {
         k: v
         for k, v in os.environ.items()
         if k not in ("JAX_PLATFORMS", "XLA_FLAGS")
     }
-    base_env["PYTHONPATH"] = REPO
-
-    def spawn(name, extra_args, jax_cpu=False, devices=0):
-        env = dict(base_env)
-        if jax_cpu:
-            env["JAX_PLATFORMS"] = "cpu"
-        if devices:
-            env["XLA_FLAGS"] = (
-                f"--xla_force_host_platform_device_count={devices}"
-            )
-        log = tmp_path / f"{name}.log"
-        proc = subprocess.Popen(
-            [sys.executable, "-u", "-m", "dynamo_tpu.cli.run", *extra_args],
-            env=env,
-            stdout=open(log, "w"),
-            stderr=subprocess.STDOUT,
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)
+    ))
+    env["JAX_PLATFORMS"] = "cpu"
+    if devices:
+        env["XLA_FLAGS"] = (
+            f"--xla_force_host_platform_device_count={devices}"
         )
-        return proc, log
+    return env
 
+
+def test_cli_spmd_serving():
+    fport, hport, cport = free_port(), free_port(), free_port()
     worker_args = [
         "run", "in=dyn", "out=jax", "--model", "tiny",
         "--page-size", "4", "--num-pages", "64", "--max-context", "32",
         "--dtype", "float32", "--dp", "2", "--tp", "2",
         "--coordinator", f"127.0.0.1:{cport}", "--num-hosts", "2",
     ]
-    procs = []
+    procs: list[ManagedProc] = []
     try:
-        fabric, _ = spawn(
-            "fabric", ["fabric", "--port", str(fport)], jax_cpu=True
+        fabric = ManagedProc(
+            "fabric", cli("fabric", "--port", str(fport)), env=_env()
         )
         procs.append(fabric)
-        time.sleep(1.5)
-        leader, llog = spawn(
+        fabric.wait_for("listening|fabric server on")
+        leader = ManagedProc(
             "leader",
-            [*worker_args, "--host-id", "0",
-             "--fabric", f"127.0.0.1:{fport}"],
-            jax_cpu=True, devices=2,
+            cli(*worker_args, "--host-id", "0",
+                "--fabric", f"127.0.0.1:{fport}"),
+            env=_env(devices=2),
         )
         procs.append(leader)
-        follower, wlog = spawn(
+        follower = ManagedProc(
             "follower",
-            [*worker_args, "--host-id", "1",
-             "--fabric", f"127.0.0.1:{fport}"],
-            jax_cpu=True, devices=2,
+            cli(*worker_args, "--host-id", "1",
+                "--fabric", f"127.0.0.1:{fport}"),
+            env=_env(devices=2),
         )
         procs.append(follower)
-        _wait_for(wlog, "spmd follower 1 up", 180, procs)
-        _wait_for(llog, "worker", 180, procs)
-        front, flog = spawn(
+        follower.wait_for("spmd follower 1 up", timeout=180)
+        leader.wait_for(r"worker \w+ up", timeout=180)
+        front = ManagedProc(
             "frontend",
-            ["run", "in=http", "out=dyn",
-             "--fabric", f"127.0.0.1:{fport}", "--port", str(hport)],
-            jax_cpu=True,
+            cli("run", "in=http", "out=dyn",
+                "--fabric", f"127.0.0.1:{fport}", "--port", str(hport)),
+            env=_env(),
         )
         procs.append(front)
-        _wait_for(flog, "model attached", 120, procs)
+        front.wait_for("model attached", timeout=120)
 
         req = urllib.request.Request(
             f"http://127.0.0.1:{hport}/v1/chat/completions",
@@ -141,9 +99,4 @@ def test_cli_spmd_serving(tmp_path):
         # scoped kills by PID — a broad pkill pattern would hit unrelated
         # bench/test workers (see memory: pkill-kills-bench-workers)
         for p in reversed(procs):
-            p.terminate()
-        for p in procs:
-            try:
-                p.wait(timeout=10)
-            except subprocess.TimeoutExpired:
-                p.kill()
+            p.stop()
