@@ -38,18 +38,24 @@ class ManagedProc:
             stdout=self._log, stderr=subprocess.STDOUT,
         )
 
-    def wait_for(self, pattern: str, timeout: float = 30.0) -> None:
+    def wait_for(self, pattern: str, timeout: float = 30.0,
+                 peers: "list[ManagedProc] | None" = None) -> None:
+        """Wait until the log matches. Fails fast if this process — or any
+        of `peers` (e.g. the rest of a cluster this one depends on) —
+        exits first, dumping the dead process's log."""
         rx = re.compile(pattern)
         deadline = time.time() + timeout
         while time.time() < deadline:
             with open(self.log_path) as f:
                 if rx.search(f.read()):
                     return
-            if self.proc.poll() is not None:
-                raise AssertionError(
-                    f"{self.name} exited {self.proc.returncode} before "
-                    f"matching {pattern!r}:\n{open(self.log_path).read()}"
-                )
+            for p in (self, *(peers or ())):
+                if p.proc.poll() is not None:
+                    raise AssertionError(
+                        f"{p.name} exited {p.proc.returncode} while "
+                        f"waiting for {pattern!r} from {self.name}:\n"
+                        + open(p.log_path).read()
+                    )
             time.sleep(0.2)
         raise AssertionError(
             f"{self.name}: {pattern!r} not seen in {timeout}s:\n"
@@ -66,7 +72,14 @@ class ManagedProc:
                 # the caller's remaining stop() calls and leak processes
                 if sig != signal.SIGKILL:
                     self.proc.kill()
-                self.proc.wait(timeout=10)
+                try:
+                    self.proc.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    # D-state zombie (wedged TPU tunnel RPC): nothing more
+                    # a signal can do — report it rather than abort the
+                    # caller's remaining cleanup
+                    print(f"[{self.name}] survived SIGKILL "
+                          f"(pid {self.proc.pid})", file=sys.stderr)
 
     def stop(self) -> None:
         self.kill(signal.SIGTERM)

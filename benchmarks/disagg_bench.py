@@ -92,40 +92,12 @@ def run_topology(args, disagg: bool) -> dict:
              args.osl)
             for r in reqs
         ]
-        if args.warmup:
-            # Uncached random prompts at the sweep's max length compile
-            # every prefill/decode shape (incl. the remote-prefill path)
-            # before the timer; flush caches so the timed run is cold on
-            # prefixes, warm on XLA.
-            import random
-            import urllib.request
+        from benchmarks.perf import warmup_and_flush
 
-            r = random.Random(13)
-            # cover the timed sweep's length spread (prefill shapes are
-            # bucketed, so warming only the max length would leave the
-            # smaller buckets to cold-compile inside the timed window)
-            lens = sorted({len(t) for t, _ in texts})
-            picks = [
-                lens[min(len(lens) - 1, i * len(lens) // args.warmup)]
-                for i in range(args.warmup)
-            ]
-            warm = [
-                ("".join(chr(97 + r.randrange(26)) for _ in range(n)),
-                 args.osl)
-                for n in picks
-            ]
-            asyncio.run(
-                bench_http(
-                    f"http://127.0.0.1:{hport}", args.model, warm,
-                    args.concurrency,
-                )
-            )
-            creq = urllib.request.Request(
-                f"http://127.0.0.1:{hport}/clear_kv_blocks", data=b"{}",
-                headers={"Content-Type": "application/json"},
-            )
-            with urllib.request.urlopen(creq, timeout=10) as resp:
-                assert resp.status == 200
+        warmup_and_flush(
+            f"http://127.0.0.1:{hport}", args.model, texts, args.warmup,
+            args.concurrency,
+        )
 
         out = asyncio.run(
             bench_http(

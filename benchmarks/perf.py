@@ -228,6 +228,42 @@ async def bench_http(
     return summarize(results, wall)
 
 
+def warmup_and_flush(
+    url: str, model: str, texts: list[tuple[str, int]], warmup: int,
+    concurrency: int,
+) -> None:
+    """Compile-then-flush prelude for HTTP A/B harnesses: drive `warmup`
+    uncached random prompts whose lengths span the timed sweep's length
+    spread (prefill shapes are bucketed — warming one length leaves other
+    buckets to cold-compile inside the timed window), then POST
+    /clear_kv_blocks so the timed run starts cold on prefixes but warm on
+    XLA. Random prompts share no prefix, so a kv router balances them by
+    load across ALL workers."""
+    if not warmup:
+        return
+    import random
+    import urllib.request
+
+    r = random.Random(13)
+    lens = sorted({len(t) for t, _ in texts})
+    picks = [
+        lens[i * (len(lens) - 1) // max(1, warmup - 1)]
+        for i in range(warmup)
+    ]
+    osl = texts[0][1]
+    warm = [
+        ("".join(chr(97 + r.randrange(26)) for _ in range(n)), osl)
+        for n in picks
+    ]
+    asyncio.run(bench_http(url, model, warm, concurrency))
+    req = urllib.request.Request(
+        f"{url}/clear_kv_blocks", data=b"{}",
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        assert resp.status == 200
+
+
 # -- CLI --------------------------------------------------------------------
 
 

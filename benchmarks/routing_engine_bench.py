@@ -85,41 +85,12 @@ def run_mode(args, mode: str, texts) -> dict:
         fe.wait_for("listening on")
         fe.wait_for("model attached", timeout=120)
 
-        from benchmarks.perf import bench_http
+        from benchmarks.perf import bench_http, warmup_and_flush
 
-        # Warmup: distinct random prompts (no shared prefix, so the kv
-        # router balances them by load across ALL workers) compile every
-        # prefill/decode shape before the timer; then flush the caches so
-        # the timed sweep starts cold on prefixes but warm on XLA.
-        import random
-        import urllib.request
-
-        r = random.Random(13)
-        # cover the timed sweep's length spread (prefill shapes are
-        # bucketed; warming only one length leaves other buckets to
-        # cold-compile inside the timed window)
-        lens = sorted({len(t) for t, _ in texts})
-        picks = [
-            lens[min(len(lens) - 1, i * len(lens) // max(1, args.warmup))]
-            for i in range(args.warmup)
-        ]
-        warm = [
-            ("".join(chr(97 + r.randrange(26)) for _ in range(n)),
-             texts[0][1])
-            for n in picks
-        ]
-        asyncio.run(
-            bench_http(
-                f"http://127.0.0.1:{hport}", args.model, warm,
-                args.concurrency,
-            )
+        warmup_and_flush(
+            f"http://127.0.0.1:{hport}", args.model, texts, args.warmup,
+            args.concurrency,
         )
-        req = urllib.request.Request(
-            f"http://127.0.0.1:{hport}/clear_kv_blocks", data=b"{}",
-            headers={"Content-Type": "application/json"},
-        )
-        with urllib.request.urlopen(req, timeout=10) as resp:
-            assert resp.status == 200
 
         out = asyncio.run(
             bench_http(

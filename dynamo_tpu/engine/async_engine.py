@@ -253,6 +253,7 @@ class SpmdEngineRunner(AsyncEngineRunner):
             with self._lock:
                 clears, self._clears = self._clears, []
             self._run_ops(ops)  # read-only by contract
+            submitted: list[str] = []
             for req, sampling in pending:
                 if req.mm_embeds is not None:
                     self._post(
@@ -265,6 +266,7 @@ class SpmdEngineRunner(AsyncEngineRunner):
                     self._post(req.request_id, None)
                     continue
                 drv.submit(req.request_id, list(req.token_ids), sampling)
+                submitted.append(req.request_id)
             for rid in aborts:
                 drv.abort(rid)
             if clears:
@@ -281,12 +283,14 @@ class SpmdEngineRunner(AsyncEngineRunner):
                 self._fail_clears(clears, e)
                 # This round's admissions were popped from the driver's
                 # pending queue before the broadcast died — they reached
-                # neither the engine nor the followers. Fail them; their
-                # clients would otherwise wait forever.
-                for req, _ in pending:
-                    self._post(req.request_id, {"error": f"lockstep step "
-                                                f"failed: {e}"})
-                    self._post(req.request_id, None)
+                # neither the engine nor the followers. Fail them (only
+                # the ones actually submitted; refused multimodal ones
+                # already got their error); their clients would otherwise
+                # wait forever.
+                for rid in submitted:
+                    self._post(rid, {"error": f"lockstep step failed: {e}"})
+                    self._post(rid, None)
+                drv.submit_errors.clear()
                 continue
             for rid, err in drv.submit_errors:
                 self._post(rid, {"error": err})

@@ -17,7 +17,9 @@ and is intentionally out of process scope here.
 """
 
 from dynamo_tpu.planner.load_predictor import (
+    ArPredictor,
     ConstantPredictor,
+    HoltWintersPredictor,
     MovingAveragePredictor,
     TrendPredictor,
     make_predictor,
@@ -33,7 +35,9 @@ from dynamo_tpu.planner.planner import (
 )
 
 __all__ = [
+    "ArPredictor",
     "ConstantPredictor",
+    "HoltWintersPredictor",
     "MovingAveragePredictor",
     "TrendPredictor",
     "make_predictor",

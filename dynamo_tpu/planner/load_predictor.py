@@ -1,16 +1,23 @@
 """Load predictors: observe a scalar series, predict the next interval.
 
 The reference ships constant / ARIMA / Prophet predictors
-(components/planner/utils/load_predictor.py:62-132). Heavy statistical
-deps aren't available here (and are overkill at serving timescales), so the
-trend predictor is a windowed least-squares slope — the piece of ARIMA that
-actually matters for scale-ahead decisions.
+(components/planner/utils/load_predictor.py:62-132). The statistical
+packages themselves aren't available here, so the ARIMA-family models are
+implemented directly, dependency-free:
+
+- TrendPredictor     — windowed least-squares slope (cheap default)
+- ArPredictor        — AR(p) on the (optionally first-differenced)
+                       series, fit by numpy least squares: the
+                       ARIMA(p,d,0) family the reference auto-fits
+- HoltWintersPredictor — additive level/trend/seasonal exponential
+                       smoothing: the Prophet role (trend + seasonality)
+                       at serving timescales
 """
 
 from __future__ import annotations
 
 from collections import deque
-from typing import Deque, Optional
+from typing import Deque, List, Optional
 
 
 class ConstantPredictor:
@@ -69,11 +76,146 @@ class TrendPredictor:
         return max(0.0, mean_y + slope * (n - mean_x))  # x = n is "next"
 
 
-def make_predictor(kind: str, window: int = 8):
+class ArPredictor:
+    """ARIMA(p, d, 0) one-step forecast, coefficients re-fit by ordinary
+    least squares over a sliding window each predict() call.
+
+    d=1 (default) models the DIFFERENCED series — the standard treatment
+    for non-stationary load curves (ramps): the AR part then captures
+    momentum/oscillation in the increments and the forecast is
+    last + predicted_increment. Falls back to trend-free behavior until
+    enough samples accumulate. Mirrors the reference's auto-fit ARIMA
+    (load_predictor.py:62-132) without the statsmodels dependency.
+    """
+
+    def __init__(self, window: int = 32, p: int = 3, d: int = 1):
+        if p < 1:
+            raise ValueError("p must be >= 1")
+        if d not in (0, 1):
+            raise ValueError("d must be 0 or 1")
+        if window < p + d + 2:
+            raise ValueError("window too small for the requested order")
+        self.p, self.d = p, d
+        self._buf: Deque[float] = deque(maxlen=window)
+
+    def observe(self, value: float) -> None:
+        self._buf.append(float(value))
+
+    def _series(self) -> List[float]:
+        ys = list(self._buf)
+        if self.d == 1:
+            ys = [b - a for a, b in zip(ys, ys[1:])]
+        return ys
+
+    def predict(self) -> float:
+        if not self._buf:
+            return 0.0
+        last = self._buf[-1]
+        ys = self._series()
+        # need at least p+1 rows for a meaningful fit
+        if len(ys) < self.p + 2:
+            return max(0.0, last)
+        import numpy as np
+
+        y = np.asarray(ys[self.p:], dtype=np.float64)
+        rows = [
+            [ys[t - j] for j in range(1, self.p + 1)] + [1.0]
+            for t in range(self.p, len(ys))
+        ]
+        x = np.asarray(rows, dtype=np.float64)
+        coef, *_ = np.linalg.lstsq(x, y, rcond=None)
+        nxt = float(
+            sum(c * v for c, v in zip(coef[:-1], ys[::-1])) + coef[-1]
+        )
+        return max(0.0, last + nxt if self.d == 1 else nxt)
+
+
+class HoltWintersPredictor:
+    """Additive Holt-Winters (level + trend + optional seasonality),
+    the role Prophet plays in the reference: periodic load patterns
+    (diurnal cycles at ops timescales, batch cadence at bench timescales)
+    forecast one interval ahead.
+
+    season_length=0 degrades to double exponential smoothing (Holt).
+    Seasonal components initialize from the first full season.
+    """
+
+    def __init__(
+        self,
+        alpha: float = 0.4,
+        beta: float = 0.1,
+        gamma: float = 0.3,
+        season_length: int = 0,
+    ):
+        for name, v in (("alpha", alpha), ("beta", beta), ("gamma", gamma)):
+            if not 0.0 < v <= 1.0:
+                raise ValueError(f"{name} must be in (0, 1]")
+        if season_length < 0:
+            raise ValueError("season_length must be >= 0")
+        self.alpha, self.beta, self.gamma = alpha, beta, gamma
+        self.m = season_length
+        self._level: Optional[float] = None
+        self._trend = 0.0
+        self._season: List[float] = []
+        self._warmup: List[float] = []
+        self._t = 0
+
+    def observe(self, value: float) -> None:
+        y = float(value)
+        if self.m and len(self._season) < self.m:
+            # collect one full season, then de-mean it into indices
+            self._warmup.append(y)
+            if len(self._warmup) == self.m:
+                mean = sum(self._warmup) / self.m
+                self._season = [v - mean for v in self._warmup]
+                self._level = mean
+            self._t += 1
+            return
+        if self._level is None:
+            self._level = y
+            self._t += 1
+            return
+        if self.m:
+            idx = self._t % self.m
+            s = self._season[idx]
+            prev_level = self._level
+            self._level = self.alpha * (y - s) + (1 - self.alpha) * (
+                self._level + self._trend
+            )
+            self._trend = self.beta * (self._level - prev_level) + (
+                1 - self.beta
+            ) * self._trend
+            self._season[idx] = self.gamma * (y - self._level) + (
+                1 - self.gamma
+            ) * s
+        else:
+            prev_level = self._level
+            self._level = self.alpha * y + (1 - self.alpha) * (
+                self._level + self._trend
+            )
+            self._trend = self.beta * (self._level - prev_level) + (
+                1 - self.beta
+            ) * self._trend
+        self._t += 1
+
+    def predict(self) -> float:
+        if self._level is None:
+            return self._warmup[-1] if self._warmup else 0.0
+        y = self._level + self._trend
+        if self.m and self._season:
+            y += self._season[self._t % self.m]
+        return max(0.0, y)
+
+
+def make_predictor(kind: str, window: int = 8, season_length: int = 0):
     if kind == "constant":
         return ConstantPredictor()
     if kind == "moving_average":
         return MovingAveragePredictor(window)
     if kind == "trend":
         return TrendPredictor(window)
+    if kind == "arima":
+        return ArPredictor(window=max(window, 8))
+    if kind == "holt_winters":
+        return HoltWintersPredictor(season_length=season_length)
     raise ValueError(f"unknown predictor {kind!r}")

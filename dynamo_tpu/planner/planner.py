@@ -250,12 +250,15 @@ class SlaPlanner:
         itl_vs_rate: PerfInterpolator,
         predictor: str = "trend",
         predictor_window: int = 8,
+        predictor_season: int = 0,
     ):
         self.config = config
         self.targets = targets
         self.ttft_vs_rate = ttft_vs_rate
         self.itl_vs_rate = itl_vs_rate
-        self.predictor = make_predictor(predictor, predictor_window)
+        self.predictor = make_predictor(
+            predictor, predictor_window, season_length=predictor_season
+        )
         #: prefill scaling rides the same queue policy as LoadPlanner
         self._load = LoadPlanner(config)
 

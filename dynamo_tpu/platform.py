@@ -40,14 +40,19 @@ def enable_persistent_compile_cache() -> None:
     path = os.environ.get("DYN_COMPILE_CACHE")
     if path and path.lower() in ("off", "0", "none", "disabled"):
         return
-    if not path:
-        path = os.path.join(
-            os.path.expanduser("~"), ".cache", "dynamo_tpu", "xla"
-        )
     try:
-        os.makedirs(path, exist_ok=True)
         import jax
 
+        if not path:
+            if (
+                jax.config.jax_compilation_cache_dir
+                or os.environ.get("JAX_COMPILATION_CACHE_DIR")
+            ):
+                return  # operator already configured a cache — keep it
+            path = os.path.join(
+                os.path.expanduser("~"), ".cache", "dynamo_tpu", "xla"
+            )
+        os.makedirs(path, exist_ok=True)
         if jax.config.jax_compilation_cache_dir != path:
             jax.config.update("jax_compilation_cache_dir", path)
             # default min-compile-time gate (1 s) would skip most decode

@@ -1,0 +1,78 @@
+#!/usr/bin/env bash
+# Tunnel watcher: probe the axon TPU tunnel on a patient loop and, the
+# moment it answers, run the round's outstanding TPU stages back to back
+# (zero idle chip time after recovery). Probes are spaced 10 min apart —
+# killed-mid-RPC probe clients are suspected of worsening a wedge, so we
+# probe rarely and with a generous timeout.
+#
+#   bash scripts/tpu_watch_queue.sh           # default queue
+#   bash scripts/tpu_watch_queue.sh stage...  # explicit stages
+set -u
+cd "$(dirname "$0")/.."
+OUT=artifacts/tpu
+mkdir -p "$OUT"
+
+probe_once() {
+  timeout 120 python -c \
+    "import jax,sys; sys.exit(0 if jax.devices()[0].platform!='cpu' else 1)" \
+    >/dev/null 2>&1
+}
+
+wait_for_tunnel() {
+  local n=0
+  while ! probe_once; do
+    n=$((n + 1))
+    echo "$(date -u +%H:%M:%S) tunnel down (probe $n); retry in 10 min"
+    sleep 600
+  done
+  echo "$(date -u +%H:%M:%S) tunnel OK after $n failed probes"
+}
+
+run_stage() { # name, command...
+  local name=$1; shift
+  echo "== $name"
+  timeout 3600 "$@" > "$OUT/$name.json" 2> "$OUT/$name.err"
+  local rc=$?
+  echo "$name rc=$rc"
+  if [ $rc -ne 0 ]; then
+    tail -5 "$OUT/$name.err"
+    # a stage wedging usually means the tunnel died again — re-wait
+    wait_for_tunnel
+  else
+    tail -c 300 "$OUT/$name.json"; echo
+  fi
+}
+
+disagg_ab() {
+  run_stage disagg_ab python -m benchmarks.disagg_bench \
+    --model llama3-1b --dtype bfloat16 --page-size 64 --num-pages 1024 \
+    --max-context 4096 --max-local-prefill 256 --requests 32 --isl 1024 \
+    --osl 64 --concurrency 8 --warmup 8
+}
+sweep_8b() {
+  run_stage perf_sweep_8b python -m benchmarks.perf --mode engine \
+    --model llama3-8b --quantize int8 --distribution sharegpt \
+    --num-pages 512 --num-requests 32 --isl 512 --osl 128 \
+    --concurrency 1,4,16
+}
+ft_kill() {
+  run_stage ft_device_kill python scripts/tpu_ft_device_kill.py
+}
+routing() {
+  run_stage routing_engine python -m benchmarks.routing_engine_bench \
+    --model llama3-1b --dtype bfloat16 --page 16 --pages 512 \
+    --max-context 2048 --depth 6 --branching 2 --suffix 64 \
+    --requests 64 --osl 16 --concurrency 8 --warmup 8
+}
+decode_profile() {
+  run_stage decode_profile python scripts/tpu_decode_profile.py
+}
+
+STAGES=("$@")
+[ ${#STAGES[@]} -eq 0 ] && STAGES=(disagg_ab sweep_8b ft_kill routing decode_profile)
+
+wait_for_tunnel
+for s in "${STAGES[@]}"; do
+  "$s"
+done
+echo "queue complete"

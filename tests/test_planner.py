@@ -66,6 +66,80 @@ def test_make_predictor_rejects_unknown():
         make_predictor("prophet")
 
 
+def test_ar_predictor_tracks_ramp():
+    from dynamo_tpu.planner.load_predictor import ArPredictor
+
+    p = ArPredictor(window=16, p=2, d=1)
+    for i in range(12):
+        p.observe(10.0 * i)
+    # differenced series is constant 10 -> forecast continues the ramp
+    assert p.predict() == pytest.approx(120.0, rel=0.05)
+
+
+def test_ar_predictor_flat_series_stays_flat():
+    from dynamo_tpu.planner.load_predictor import ArPredictor
+
+    p = ArPredictor(window=16, p=3, d=1)
+    for _ in range(12):
+        p.observe(7.0)
+    assert p.predict() == pytest.approx(7.0, abs=0.5)
+
+
+def test_ar_predictor_never_negative():
+    from dynamo_tpu.planner.load_predictor import ArPredictor
+
+    p = ArPredictor(window=16, p=2, d=1)
+    for v in (50, 30, 15, 5, 1, 0, 0):
+        p.observe(v)
+    assert p.predict() >= 0.0
+
+
+def test_ar_predictor_order_validation():
+    from dynamo_tpu.planner.load_predictor import ArPredictor
+
+    with pytest.raises(ValueError):
+        ArPredictor(window=3, p=3, d=1)
+    with pytest.raises(ValueError):
+        ArPredictor(p=0)
+    with pytest.raises(ValueError):
+        ArPredictor(d=2)
+
+
+def test_holt_winters_learns_seasonality():
+    from dynamo_tpu.planner.load_predictor import HoltWintersPredictor
+
+    # period-4 sawtooth: 0, 10, 20, 10 repeating
+    season = [0.0, 10.0, 20.0, 10.0]
+    p = HoltWintersPredictor(season_length=4)
+    for cycle in range(8):
+        for v in season:
+            p.observe(v)
+    # next slot is phase 0 -> forecast near the low point, nowhere
+    # near the series mean (10): seasonality was actually learned
+    assert p.predict() < 5.0
+
+
+def test_holt_winters_no_season_tracks_trend():
+    from dynamo_tpu.planner.load_predictor import HoltWintersPredictor
+
+    p = HoltWintersPredictor(alpha=0.8, beta=0.5)
+    for i in range(20):
+        p.observe(5.0 * i)
+    assert p.predict() == pytest.approx(100.0, rel=0.1)
+
+
+def test_make_predictor_arima_and_hw():
+    from dynamo_tpu.planner.load_predictor import (
+        ArPredictor,
+        HoltWintersPredictor,
+    )
+
+    assert isinstance(make_predictor("arima", window=16), ArPredictor)
+    hw = make_predictor("holt_winters", season_length=6)
+    assert isinstance(hw, HoltWintersPredictor)
+    assert hw.m == 6
+
+
 # -- perf interpolation -----------------------------------------------------
 
 

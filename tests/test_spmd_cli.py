@@ -69,8 +69,10 @@ def test_cli_spmd_serving():
             env=_env(devices=2),
         )
         procs.append(follower)
-        follower.wait_for("spmd follower 1 up", timeout=180)
-        leader.wait_for(r"worker \w+ up", timeout=180)
+        follower.wait_for("spmd follower 1 up", timeout=180,
+                          peers=[fabric, leader])
+        leader.wait_for(r"worker \w+ up", timeout=180,
+                        peers=[fabric, follower])
         front = ManagedProc(
             "frontend",
             cli("run", "in=http", "out=dyn",

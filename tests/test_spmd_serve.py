@@ -59,3 +59,55 @@ def test_two_host_serving_matches_single_process(spmd_outputs):
         )
     # every request actually generated tokens
     assert all(len(v) > 0 for v in ref.values())
+
+
+def test_broadcast_failure_fails_inflight_admissions():
+    """A broadcast-layer step failure must error that round's admissions
+    instead of leaving their clients waiting forever (their events were
+    popped from the driver's pending queue but reached no replica)."""
+    import asyncio
+
+    from dynamo_tpu.engine.async_engine import SpmdEngineRunner
+    from dynamo_tpu.preprocessor.preprocessor import PreprocessedRequest
+    from dynamo_tpu.runtime.context import Context
+
+    class FakeEngine:
+        has_work = False
+        metrics = None
+
+    class BrokenDriver:
+        def __init__(self):
+            self._pending = []
+            self.submit_errors = []
+            self.last_cleared = 0
+
+        def submit(self, rid, tokens, sampling):
+            self._pending.append(("submit", rid))
+
+        def abort(self, rid):
+            pass
+
+        def clear_cache(self):
+            self._pending.append(("clear",))
+
+        def step(self):
+            self._pending.clear()
+            raise RuntimeError("fabric barrier lost")
+
+        def shutdown(self):
+            pass
+
+    async def drive():
+        runner = SpmdEngineRunner(FakeEngine(), BrokenDriver())
+        runner.start()
+        try:
+            req = PreprocessedRequest(
+                request_id="r0", token_ids=[1, 2, 3], max_tokens=4
+            )
+            with pytest.raises(RuntimeError, match="lockstep step failed"):
+                async for _ in runner.generate(Context("r0"), req):
+                    pass
+        finally:
+            runner.stop()
+
+    asyncio.run(asyncio.wait_for(drive(), timeout=30))
