@@ -32,11 +32,15 @@ import time
 PROBE_SRC = "import jax; d=jax.devices(); print(d[0].platform)"
 
 
-def probe_backend(retries: int = 3, timeout_s: int = 120) -> str:
+def probe_backend(retries: int = 5, timeout_s: int = 120) -> str:
     """Return the usable platform ('tpu' or 'cpu') via subprocess probes.
 
     A wedged tunnel hangs rather than erroring, so the probe must be a
-    killable child process — never the bench process itself."""
+    killable child process — never the bench process itself. Patience
+    matters: this bench is the round's headline TPU artifact, and a CPU
+    fallback caused by a TRANSIENT wedge wastes the whole round's
+    hardware evidence (round 2 post-mortem) — so by default we probe for
+    ~12 min (5 x 120s probe + 30s gaps) before giving up."""
     want = os.environ.get("JAX_PLATFORMS", "")
     if want == "cpu":
         return "cpu"

@@ -32,26 +32,26 @@ from dynamo_tpu.platform import honor_jax_platforms_env  # noqa: E402
 
 honor_jax_platforms_env()
 
-BATCH = 128
+BATCHES = (16, 128)  # small-batch latency vs large-batch throughput regime
 K_STEPS = 16
 ISL = 128  # resident context per sequence when decode is measured
 MODEL = os.environ.get("PROFILE_MODEL", "llama3-1b")
 
 
-def build_engine(attention_impl: str):
+def build_engine(attention_impl: str, batch: int):
     from dynamo_tpu.engine import EngineConfig
     from dynamo_tpu.engine.engine import JaxEngine
 
     cfg = EngineConfig(
         model=MODEL,
-        num_pages=BATCH * 4 + 64,
+        num_pages=batch * 4 + 64,
         page_size=64,
         max_pages_per_seq=8,
-        decode_buckets=(BATCH,),
+        decode_buckets=(batch,),
         prefill_chunk=128,
-        prefill_token_budget=BATCH * 128,
+        prefill_token_budget=batch * 128,
         decode_steps=K_STEPS,
-        max_seqs=BATCH,
+        max_seqs=batch,
         dtype="bfloat16",
         enable_prefix_caching=False,
         attention_impl=attention_impl,
@@ -59,7 +59,7 @@ def build_engine(attention_impl: str):
     return JaxEngine(cfg)
 
 
-def time_full(eng) -> dict:
+def time_full(eng, batch: int) -> dict:
     """Steady-state per-token time of the engine's own fused decode. Run
     the real serving loop with max_tokens large enough that the timed
     region is pure decode_multi dispatches."""
@@ -68,8 +68,10 @@ def time_full(eng) -> dict:
     from dynamo_tpu.engine.request import SamplingParams
 
     rng = np.random.default_rng(0)
+    vocab = int(getattr(eng.adapter.config, "vocab_size", 32000))
+    hi = min(32000, vocab - 1)
     prompts = [
-        [int(x) for x in rng.integers(1, 32000, ISL)] for _ in range(BATCH)
+        [int(x) for x in rng.integers(1, hi, ISL)] for _ in range(batch)
     ]
     for i, p in enumerate(prompts):
         eng.add_request(
@@ -92,12 +94,12 @@ def time_full(eng) -> dict:
         "tokens": tokens,
         "dispatches": dispatches,
         "wall_s": round(dt, 3),
-        "ms_per_token_row": round(1000 * dt / max(1, tokens / BATCH), 3),
+        "ms_per_token_row": round(1000 * dt / max(1, tokens / batch), 3),
         "tok_s": round(tokens / dt, 1),
     }
 
 
-def time_dense_floor() -> dict:
+def time_dense_floor(batch: int) -> dict:
     """Weight-streaming floor: the same parameter stack driven as pure
     dense matmuls (one token per sequence, attention output zeroed via a
     no-op context of length 1 is still paged — instead we time the lm
@@ -111,12 +113,12 @@ def time_dense_floor() -> dict:
     params = adapter.init_params(jax.random.key(0))
 
     leaves = [x for x in jax.tree.leaves(params) if x.ndim >= 2]
-    x0 = jnp.ones((BATCH, max(l.shape[0] for l in leaves)), jnp.bfloat16)
+    x0 = jnp.ones((batch, max(l.shape[0] for l in leaves)), jnp.bfloat16)
 
     @jax.jit
     def stream_all(x):
         # touch every >=2D parameter with a matmul shaped [B, in] @ [in, out]
-        acc = jnp.zeros((BATCH,), jnp.float32)
+        acc = jnp.zeros((batch,), jnp.float32)
         for leaf in leaves:
             w = leaf.reshape(leaf.shape[0], -1)
             y = jax.lax.dot_general(
@@ -146,15 +148,17 @@ def main() -> None:
 
     out = {
         "platform": jax.devices()[0].platform,
-        "batch": BATCH,
         "k_steps": K_STEPS,
         "model": MODEL,
+        "batches": {},
     }
-    out["dense_floor"] = time_dense_floor()
-    for impl in ("pallas", "xla"):
-        eng = build_engine(impl)
-        out[f"full_{impl}"] = time_full(eng)
-        del eng
+    for batch in BATCHES:
+        row = {"dense_floor": time_dense_floor(batch)}
+        for impl in ("pallas", "xla"):
+            eng = build_engine(impl, batch)
+            row[f"full_{impl}"] = time_full(eng, batch)
+            del eng
+        out["batches"][str(batch)] = row
     path = Path(__file__).resolve().parent.parent / "artifacts" / "tpu"
     path.mkdir(parents=True, exist_ok=True)
     (path / "decode_profile.json").write_text(json.dumps(out, indent=1))
