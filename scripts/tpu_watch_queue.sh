@@ -67,9 +67,14 @@ routing() {
 decode_profile() {
   run_stage decode_profile python scripts/tpu_decode_profile.py
 }
+offload() {
+  run_stage offload_ab python -m benchmarks.offload_bench \
+    --model llama3-1b --dtype bfloat16 --page-size 16 --num-pages 192 \
+    --max-context 2048 --users 8 --turns 4 --turn-chars 400 --osl 16
+}
 
 STAGES=("$@")
-[ ${#STAGES[@]} -eq 0 ] && STAGES=(disagg_ab sweep_8b ft_kill routing decode_profile)
+[ ${#STAGES[@]} -eq 0 ] && STAGES=(disagg_ab sweep_8b ft_kill routing offload decode_profile)
 
 wait_for_tunnel
 for s in "${STAGES[@]}"; do
