@@ -96,3 +96,12 @@ def free_port() -> int:
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         return s.getsockname()[1]
+
+
+def pct(values, q: float):
+    """Nearest-rank percentile (q in [0,1]); None on empty input. The one
+    shared implementation for every bench's TTFT/latency tables."""
+    if not values:
+        return None
+    v = sorted(values)
+    return v[min(len(v) - 1, int(round(q * (len(v) - 1))))]

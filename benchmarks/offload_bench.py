@@ -33,13 +33,12 @@ import time
 from benchmarks._procs import ManagedProc as Proc
 from benchmarks._procs import cli as _cli
 from benchmarks._procs import free_port as _free_port
+from benchmarks._procs import pct as _shared_pct
 
 
 def _pct(values, q):
-    if not values:
-        return None
-    v = sorted(values)
-    return round(v[min(len(v) - 1, int(round(q * (len(v) - 1))))], 2)
+    v = _shared_pct(values, q)
+    return None if v is None else round(v, 2)
 
 
 async def _one_turn(session, url, model, messages, osl):

@@ -31,14 +31,9 @@ class RequestResult:
 
 
 def _percentiles(values, ps=(50, 95)):
-    if not values:
-        return {f"p{p}": None for p in ps}
-    values = sorted(values)
-    out = {}
-    for p in ps:
-        k = min(len(values) - 1, int(round((p / 100) * (len(values) - 1))))
-        out[f"p{p}"] = values[k]
-    return out
+    from benchmarks._procs import pct
+
+    return {f"p{p}": pct(values, p / 100) for p in ps}
 
 
 def summarize(results: list[RequestResult], wall_s: float) -> dict:

@@ -26,11 +26,7 @@ import json
 import time
 
 
-def _pct(values, q):
-    if not values:
-        return None
-    v = sorted(values)
-    return v[min(len(v) - 1, int(round(q * (len(v) - 1))))]
+from benchmarks._procs import pct as _pct
 
 
 async def _drive_mode(

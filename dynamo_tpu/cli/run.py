@@ -46,6 +46,7 @@ def _engine_config(args, eos_token_ids: tuple = ()) -> EngineConfig:
         disk_kv_cache_dir=getattr(args, "disk_kv_dir", None),
         spec_ngram=getattr(args, "spec_ngram", 0),
         quantize=getattr(args, "quantize", None),
+        attention_impl=getattr(args, "attention_impl", "auto"),
     )
 
 
@@ -612,6 +613,12 @@ def main(argv: Optional[list[str]] = None) -> None:
     runp.add_argument(
         "--quantize", default=None, choices=["int8"],
         help="weight-only quantization (per-output-channel int8 scales)",
+    )
+    runp.add_argument(
+        "--attention-impl", default="auto", dest="attention_impl",
+        choices=["auto", "xla", "pallas", "hybrid"],
+        help="decode attention kernels (auto = pallas on TPU, else xla; "
+        "hybrid = pallas under large-batch XLA-gather fallback)",
     )
     runp.add_argument("--max-context", type=int, default=4096, dest="max_context")
     runp.add_argument("--prefill-chunk", type=int, default=512, dest="prefill_chunk")

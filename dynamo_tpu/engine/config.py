@@ -66,7 +66,8 @@ class EngineConfig:
     #: halves the HBM weight traffic decode is bound by)
     quantize: Optional[str] = None
     #: decode attention: "auto" (pallas on TPU single-chip, else xla),
-    #: "xla", or "pallas"
+    #: "xla", "pallas", or "hybrid" (pallas kernels with decode falling
+    #: back to the XLA gather past LlamaConfig.pallas_decode_max_batch)
     attention_impl: str = "auto"
     #: mesh layout
     dp: int = 1

@@ -93,9 +93,9 @@ class JaxEngine:
             dp=config.dp, tp=config.tp, sp=config.sp, ep=config.ep
         )
         impl = config.attention_impl
-        if impl not in ("auto", "xla", "pallas"):
+        if impl not in ("auto", "xla", "pallas", "hybrid"):
             raise ValueError(
-                f"unknown attention_impl {impl!r}; use auto|xla|pallas"
+                f"unknown attention_impl {impl!r}; use auto|xla|pallas|hybrid"
             )
         if impl == "auto":
             impl = "pallas" if jax.default_backend() == "tpu" else "xla"

@@ -53,8 +53,15 @@ class LlamaConfig:
     dtype: Any = jnp.bfloat16
     #: "xla" (gather path, any T) | "pallas" (flash kernels: page-walk DMA
     #: decode for T=1, VMEM-tiled causal flash for first-chunk prefill;
-    #: history-chunk prefill still takes the XLA gather path)
+    #: history-chunk prefill still takes the XLA gather path) | "hybrid"
+    #: (pallas write discipline + flash prefill, but decode attention
+    #: switches to the XLA gather past pallas_decode_max_batch — the
+    #: page-walk kernel issues O(B x pages) DMA descriptors per layer,
+    #: which is latency-optimal at small B and descriptor-bound at large)
     attention_impl: str = "xla"
+    #: "hybrid" decode: largest batch bucket still served by the pallas
+    #: page-walk kernel (bigger buckets use the XLA gather)
+    pallas_decode_max_batch: int = 32
     #: q/k/v projection bias — the Qwen2 family's one architectural delta
     attention_bias: bool = False
     #: MLP activation: "silu" (Llama/Qwen GLU) or "gelu_tanh" (Gemma GeGLU)
@@ -77,7 +84,10 @@ class LlamaConfig:
         padded up to 128 zero lanes when the kernel is active. Padding is
         invisible outside the cache: q·k over zero lanes adds nothing and
         the attention output is sliced back to head_dim."""
-        if self.attention_impl == "pallas" and self.head_dim % 128 != 0:
+        if (
+            self.attention_impl in ("pallas", "hybrid")
+            and self.head_dim % 128 != 0
+        ):
             return -(-self.head_dim // 128) * 128
         return self.head_dim
 
@@ -664,7 +674,7 @@ def _chunk_only_attention(q, k, v, positions, valid, cfg, dpad, mesh=None):
         )
         b, _, hq, d = q.shape
         return out.reshape(b, t, hq * d)
-    if cfg.attention_impl == "pallas":
+    if cfg.attention_impl in ("pallas", "hybrid"):
         from dynamo_tpu.ops.flash_prefill import flash_prefill_attention
 
         qp = jnp.pad(q, ((0, 0), (0, 0), (0, 0), (0, dpad))) if dpad else q
@@ -719,7 +729,7 @@ def attention_block(
         k = jnp.pad(k, ((0, 0), (0, 0), (0, 0), (0, dpad)))
         v = jnp.pad(v, ((0, 0), (0, 0), (0, 0), (0, dpad)))
 
-    if cfg.attention_impl != "pallas":
+    if cfg.attention_impl not in ("pallas", "hybrid"):
         k_cache = paged_scatter(
             k_cache, layer, k, page_tables, positions, valid
         )
@@ -741,7 +751,18 @@ def attention_block(
 
     from dynamo_tpu.ops.paged_attention import paged_decode_attention
 
-    if t == 1:
+    if t == 1 and (
+        cfg.attention_impl == "hybrid" and b > cfg.pallas_decode_max_batch
+    ):
+        # Large decode buckets: the dense gather reads ~the same HBM bytes
+        # in a handful of fused XLA ops instead of O(B x pages) per-page
+        # DMA descriptors; the scatter-free cache still holds history
+        # only, and _xla_history_attention masks exactly that.
+        attn = _xla_history_attention(
+            q, k, v, k_cache, v_cache, layer, page_tables, positions,
+            valid, cfg, dpad,
+        )
+    elif t == 1:
         hist = positions[:, 0]  # tokens already in the cache
         qd = q[:, 0]
         if dpad:

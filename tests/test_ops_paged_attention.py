@@ -234,3 +234,52 @@ def test_paged_write_kernel_under_tp_mesh():
     )
     np.testing.assert_allclose(np.asarray(got_k), np.asarray(want_k))
     np.testing.assert_allclose(np.asarray(got_v), np.asarray(want_v))
+
+
+def test_full_model_decode_hybrid_matches_xla_both_sides_of_threshold():
+    """attention_impl=hybrid: decode == xla whether the bucket lands on
+    the pallas page-walk side (b <= pallas_decode_max_batch) or the
+    XLA-gather side (b > threshold). Same staged write discipline both
+    ways — only the decode attention read path switches."""
+    from dataclasses import replace
+
+    cfg = LlamaConfig.tiny()
+    params = init_params(jax.random.key(0), cfg)
+    rng = np.random.default_rng(5)
+    page_size, num_pages = 4, 32
+
+    pt = jnp.asarray(
+        np.array([[1, 2, 3, 0, 0, 0], [4, 5, 6, 0, 0, 0]], np.int32)
+    )
+    toks = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 9)), jnp.int32)
+    positions = jnp.tile(jnp.arange(9, dtype=jnp.int32)[None], (2, 1))
+    dec_tok = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 1)), jnp.int32)
+    dec_pos = jnp.full((2, 1), 9, jnp.int32)
+    dec_valid = jnp.ones((2, 1), bool)
+
+    variants = {
+        "xla": cfg,
+        # b=2 > 1: hybrid decodes via the XLA gather (kernel-free path)
+        "hybrid_gather": replace(
+            cfg, attention_impl="hybrid", pallas_decode_max_batch=1
+        ),
+        # b=2 <= 8: hybrid decodes via the pallas page-walk kernel
+        "hybrid_kernel": replace(
+            cfg, attention_impl="hybrid", pallas_decode_max_batch=8
+        ),
+    }
+    assert variants["hybrid_gather"].kv_head_dim == 128  # padded cache
+    results = {}
+    for name, c in variants.items():
+        kv = init_kv_pages(c, num_pages, page_size)
+        _, kv = forward_hidden(
+            params, c, toks, positions, jnp.ones((2, 9), bool), kv, pt
+        )
+        h, _ = forward_hidden(params, c, dec_tok, dec_pos, dec_valid, kv, pt)
+        results[name] = np.asarray(h)
+    np.testing.assert_allclose(
+        results["hybrid_gather"], results["xla"], rtol=1e-5, atol=1e-5
+    )
+    np.testing.assert_allclose(
+        results["hybrid_kernel"], results["xla"], rtol=1e-5, atol=1e-5
+    )
