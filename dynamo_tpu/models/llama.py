@@ -70,6 +70,17 @@ class LlamaConfig:
     rms_norm_unit_offset: bool = False
     #: Gemma scales token embeddings by sqrt(hidden_size)
     scale_embeddings: bool = False
+    #: Gemma2: attention scores pass cap*tanh(s/cap) before masking
+    attn_logit_softcap: Optional[float] = None
+    #: Gemma2: final lm_head logits pass cap*tanh(l/cap)
+    final_logit_softcap: Optional[float] = None
+    #: Gemma2 local/global alternation: layers with even index attend only
+    #: the last `sliding_window` positions (HF Gemma2 pattern); 0 disables
+    sliding_window: int = 0
+    #: Gemma2: query scale is query_pre_attn_scalar**-0.5 (None: head_dim)
+    query_pre_attn_scalar: Optional[float] = None
+    #: Gemma2 block: extra post-attention / post-feedforward RMSNorms
+    post_block_norms: bool = False
 
     @property
     def q_per_kv(self) -> int:
@@ -165,16 +176,35 @@ class LlamaConfig:
         )
 
     @staticmethod
+    def gemma2_2b() -> "LlamaConfig":
+        """Gemma-2-2B: Gemma base + sliding/global layer alternation,
+        attn+final logit soft-capping, post-block norms."""
+        return LlamaConfig(
+            vocab_size=256000, hidden_size=2304, intermediate_size=9216,
+            num_layers=26, num_heads=8, num_kv_heads=4, head_dim=256,
+            rope_theta=10000.0, rms_norm_eps=1e-6, tie_word_embeddings=True,
+            hidden_act="gelu_tanh", rms_norm_unit_offset=True,
+            scale_embeddings=True, attn_logit_softcap=50.0,
+            final_logit_softcap=30.0, sliding_window=4096,
+            query_pre_attn_scalar=256.0, post_block_norms=True,
+        )
+
+    @staticmethod
     def from_hf_config(hf: dict) -> "LlamaConfig":
         """Map a HuggingFace `config.json` dict onto LlamaConfig (covers the
-        Llama and Qwen2 families — Qwen2 is Llama + qkv bias)."""
+        Llama, Qwen2 (= Llama + qkv bias), Gemma, and Gemma2 families)."""
         arch = (hf.get("architectures") or ["LlamaForCausalLM"])[0]
         rope_scaling = hf.get("rope_scaling") or {}
         factor = None
         if rope_scaling.get("rope_type", rope_scaling.get("type")) == "llama3":
             factor = float(rope_scaling["factor"])
         head_dim = hf.get("head_dim") or hf["hidden_size"] // hf["num_attention_heads"]
-        gemma = hf.get("model_type") == "gemma" or arch == "GemmaForCausalLM"
+        gemma2 = hf.get("model_type") == "gemma2" or arch == "Gemma2ForCausalLM"
+        gemma = (
+            hf.get("model_type") == "gemma"
+            or arch == "GemmaForCausalLM"
+            or gemma2
+        )
         hidden_act = hf.get("hidden_activation") or hf.get("hidden_act", "silu")
         if hidden_act in ("gelu_pytorch_tanh", "gelu_tanh", "gelu"):
             hidden_act = "gelu_tanh"
@@ -208,6 +238,19 @@ class LlamaConfig:
             rope_original_max_position=int(
                 rope_scaling.get("original_max_position_embeddings", 8192)
             ),
+            attn_logit_softcap=(
+                hf.get("attn_logit_softcapping") if gemma2 else None
+            ),
+            final_logit_softcap=(
+                hf.get("final_logit_softcapping") if gemma2 else None
+            ),
+            sliding_window=int(hf.get("sliding_window") or 0) if gemma2 else 0,
+            query_pre_attn_scalar=(
+                float(hf["query_pre_attn_scalar"])
+                if gemma2 and hf.get("query_pre_attn_scalar")
+                else None
+            ),
+            post_block_norms=gemma2,
         )
 
 
@@ -285,6 +328,9 @@ def init_params(key: jax.Array, cfg: LlamaConfig) -> dict:
         params["layers"]["bq"] = jnp.zeros((L, qd), cfg.dtype)
         params["layers"]["bk"] = jnp.zeros((L, kvd), cfg.dtype)
         params["layers"]["bv"] = jnp.zeros((L, kvd), cfg.dtype)
+    if cfg.post_block_norms:
+        params["layers"]["post_attn_norm"] = norm_init((L, h))
+        params["layers"]["post_mlp_norm"] = norm_init((L, h))
     if not cfg.tie_word_embeddings:
         params["lm_head"] = dense(keys[8], (h, v), h)
     return params
@@ -309,6 +355,14 @@ def params_from_torch_state_dict(state_dict, cfg: LlamaConfig) -> dict:
         ws = [w.T if transpose else w for w in ws]
         return jnp.asarray(np.stack(ws), cfg.dtype)
 
+    # Gemma2 renames the pre-MLP norm: post_attention_layernorm becomes a
+    # POST-attention branch norm and pre_feedforward_layernorm takes the
+    # pre-MLP role the Llama name implies.
+    mlp_norm_name = (
+        "model.layers.{}.pre_feedforward_layernorm.weight"
+        if cfg.post_block_norms
+        else "model.layers.{}.post_attention_layernorm.weight"
+    )
     params = {
         "embed": jnp.asarray(t("model.embed_tokens.weight"), cfg.dtype),
         "layers": {
@@ -317,13 +371,21 @@ def params_from_torch_state_dict(state_dict, cfg: LlamaConfig) -> dict:
             "wk": stack("model.layers.{}.self_attn.k_proj.weight"),
             "wv": stack("model.layers.{}.self_attn.v_proj.weight"),
             "wo": stack("model.layers.{}.self_attn.o_proj.weight"),
-            "mlp_norm": stack("model.layers.{}.post_attention_layernorm.weight", transpose=False),
+            "mlp_norm": stack(mlp_norm_name, transpose=False),
             "w_gate": stack("model.layers.{}.mlp.gate_proj.weight"),
             "w_up": stack("model.layers.{}.mlp.up_proj.weight"),
             "w_down": stack("model.layers.{}.mlp.down_proj.weight"),
         },
         "final_norm": jnp.asarray(t("model.norm.weight"), cfg.dtype),
     }
+    if cfg.post_block_norms:
+        params["layers"]["post_attn_norm"] = stack(
+            "model.layers.{}.post_attention_layernorm.weight", transpose=False
+        )
+        params["layers"]["post_mlp_norm"] = stack(
+            "model.layers.{}.post_feedforward_layernorm.weight",
+            transpose=False,
+        )
     if cfg.attention_bias:
         params["layers"]["bq"] = stack(
             "model.layers.{}.self_attn.q_proj.bias", transpose=False
@@ -615,6 +677,7 @@ def paged_attention(
     q_positions: jax.Array,  # [B, T]
     cfg: LlamaConfig,
     key_positions: Optional[jax.Array] = None,  # [B, K]; default arange(K)
+    window: Optional[jax.Array] = None,  # scalar: keys within (q_pos-w, q_pos]
 ) -> jax.Array:
     """Reference paged attention (XLA path; the Pallas decode kernel in
     dynamo_tpu.ops replaces this for T=1 when cfg.attention_impl="pallas").
@@ -622,28 +685,37 @@ def paged_attention(
     Causality over the whole paged history: key at gathered index i has
     absolute position i (or key_positions when given), so the mask is
     simply key_pos <= q_pos. Unallocated page-table slots sit at positions
-    >= seq_len and are masked by the same comparison.
+    >= seq_len and are masked by the same comparison. `window` (a traced
+    scalar — Gemma2's per-layer local attention) additionally drops keys
+    older than q_pos - window + 1.
     """
     b, t, hq, d = q.shape
     kk = k_pages.shape[1]
     g = cfg.q_per_kv
     qg = q.reshape(b, t, cfg.num_kv_heads, g, d)
-    scale = 1.0 / math.sqrt(d)
+    scale = 1.0 / math.sqrt(cfg.query_pre_attn_scalar or d)
     scores = jnp.einsum(
         "btkgd,bskd->bkgts", qg.astype(jnp.float32), k_pages.astype(jnp.float32)
     ) * scale
+    if cfg.attn_logit_softcap:
+        c = cfg.attn_logit_softcap
+        scores = c * jnp.tanh(scores / c)
     if key_positions is None:
         key_pos = jnp.arange(kk)[None, None, None, None, :]
     else:
         key_pos = key_positions[:, None, None, None, :]
-    mask = key_pos <= q_positions[:, None, None, :, None]
+    q_pos = q_positions[:, None, None, :, None]
+    mask = key_pos <= q_pos
+    if window is not None:
+        mask = mask & (key_pos > q_pos - window)
     scores = jnp.where(mask, scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("bkgts,bskd->btkgd", probs, v_pages.astype(jnp.float32))
     return out.reshape(b, t, hq * d).astype(q.dtype)
 
 
-def _chunk_only_attention(q, k, v, positions, valid, cfg, dpad, mesh=None):
+def _chunk_only_attention(q, k, v, positions, valid, cfg, dpad, mesh=None,
+                          window=None):
     """First-chunk fast path: no history exists, so attend over the
     in-register chunk only — skips the O(MP·S) page gather and the
     attention over its padding. Invalid (padding) keys are pushed past
@@ -662,6 +734,11 @@ def _chunk_only_attention(q, k, v, positions, valid, cfg, dpad, mesh=None):
     sp = mesh.shape.get("sp", 1) if mesh is not None else 1
     t = q.shape[1]
     if sp > 1 and t % sp == 0 and t > 1:
+        if window is not None:
+            raise ValueError(
+                "sliding-window attention (Gemma2) is not implemented for "
+                "the sp ring-attention path — run with sp=1"
+            )
         if dpad:
             k = k[..., : cfg.head_dim]
             v = v[..., : cfg.head_dim]
@@ -690,7 +767,9 @@ def _chunk_only_attention(q, k, v, positions, valid, cfg, dpad, mesh=None):
         k = k[..., : cfg.head_dim]
         v = v[..., : cfg.head_dim]
     cur_pos = jnp.where(valid, positions, jnp.int32(1 << 30))
-    return paged_attention(q, k, v, positions, cfg, key_positions=cur_pos)
+    return paged_attention(
+        q, k, v, positions, cfg, key_positions=cur_pos, window=window
+    )
 
 
 def attention_block(
@@ -729,6 +808,28 @@ def attention_block(
         k = jnp.pad(k, ((0, 0), (0, 0), (0, 0), (0, dpad)))
         v = jnp.pad(v, ((0, 0), (0, 0), (0, 0), (0, dpad)))
 
+    # Gemma2 local/global alternation: even layers see only the trailing
+    # window. A traced scalar per scan step — the mask comparison absorbs
+    # it with no extra program variants.
+    window = None
+    if cfg.sliding_window:
+        window = jnp.where(
+            layer % 2 == 0, jnp.int32(cfg.sliding_window), jnp.int32(1 << 30)
+        )
+    if cfg.attention_impl in ("pallas", "hybrid") and (
+        cfg.sliding_window
+        or cfg.attn_logit_softcap
+        or (
+            cfg.query_pre_attn_scalar is not None
+            and cfg.query_pre_attn_scalar != cfg.head_dim
+        )
+    ):
+        raise ValueError(
+            "sliding-window / softcap / rescaled attention (Gemma2) "
+            "requires attention_impl='xla' — the flash kernels don't "
+            "implement them"
+        )
+
     if cfg.attention_impl not in ("pallas", "hybrid"):
         k_cache = paged_scatter(
             k_cache, layer, k, page_tables, positions, valid
@@ -738,7 +839,8 @@ def attention_block(
         )
         if first_chunk and t > 1:
             attn = _chunk_only_attention(
-                q, k, v, positions, valid, cfg, dpad, mesh=mesh
+                q, k, v, positions, valid, cfg, dpad, mesh=mesh,
+                window=window,
             )
             return attn, k_cache, v_cache, None
         k_all = paged_gather(k_cache, layer, page_tables)
@@ -746,7 +848,7 @@ def attention_block(
         if dpad:
             k_all = k_all[..., : cfg.head_dim]
             v_all = v_all[..., : cfg.head_dim]
-        attn = paged_attention(q, k_all, v_all, positions, cfg)
+        attn = paged_attention(q, k_all, v_all, positions, cfg, window=window)
         return attn, k_cache, v_cache, None
 
     from dynamo_tpu.ops.paged_attention import paged_decode_attention
@@ -909,11 +1011,21 @@ def forward_hidden(
             q, k, v, k_full, v_full, li, page_tables, positions, valid, cfg,
             first_chunk=first_chunk, mesh=mesh,
         )
-        h = h + _mm(attn, lp, "wo", cfg.dtype)
+        attn_out = _mm(attn, lp, "wo", cfg.dtype)
+        if cfg.post_block_norms:  # Gemma2: norm the branch, then residual
+            attn_out = rms_norm(
+                attn_out, lp["post_attn_norm"], cfg.rms_norm_eps, off
+            )
+        h = h + attn_out
         x = rms_norm(h, lp["mlp_norm"], cfg.rms_norm_eps, off)
         gate = act(_mm(x, lp, "w_gate", cfg.dtype).astype(jnp.float32))
         up = _mm(x, lp, "w_up", cfg.dtype).astype(jnp.float32)
-        h = h + _mm((gate * up).astype(cfg.dtype), lp, "w_down", cfg.dtype)
+        mlp_out = _mm((gate * up).astype(cfg.dtype), lp, "w_down", cfg.dtype)
+        if cfg.post_block_norms:
+            mlp_out = rms_norm(
+                mlp_out, lp["post_mlp_norm"], cfg.rms_norm_eps, off
+            )
+        h = h + mlp_out
         return (h, k_full, v_full), staged
 
     (h, k_new, v_new), staged = lax.scan(
@@ -949,7 +1061,11 @@ def compute_logits(params: dict, cfg: LlamaConfig, hidden: jax.Array) -> jax.Arr
     lm_head = params.get("lm_head")
     if lm_head is None:
         lm_head = params["embed"].T
-    return (hidden @ lm_head).astype(jnp.float32)
+    logits = (hidden @ lm_head).astype(jnp.float32)
+    if cfg.final_logit_softcap:  # Gemma2
+        c = cfg.final_logit_softcap
+        logits = c * jnp.tanh(logits / c)
+    return logits
 
 
 def forward(

@@ -9,6 +9,7 @@ here.
 from __future__ import annotations
 
 import json
+import logging
 import os
 from dataclasses import dataclass, replace
 from typing import Any, Callable, Optional
@@ -18,6 +19,8 @@ import jax.numpy as jnp
 
 from dynamo_tpu.models import llama as llama_mod
 from dynamo_tpu.models.llama import KVPages, LlamaConfig
+
+logger = logging.getLogger(__name__)
 
 
 @dataclass(frozen=True)
@@ -60,6 +63,8 @@ _LLAMA_PRESETS: dict[str, Callable[[], LlamaConfig]] = {
     # Gemma family = GeGLU + (1+w) RMSNorm + scaled embeddings + tied head.
     "gemma-2b": LlamaConfig.gemma_2b,
     "gemma-7b": LlamaConfig.gemma_7b,
+    # Gemma2 adds sliding/global alternation, logit softcaps, post-norms.
+    "gemma2-2b": LlamaConfig.gemma2_2b,
 }
 
 
@@ -196,12 +201,10 @@ def get_model(
         elif (
             "llama" in arch.lower()
             or "qwen2" in arch.lower()
-            # Only first-gen Gemma: Gemma 2/3 add softcapping, sliding-
-            # window attention and pre/post norms, and RecurrentGemma is a
-            # different architecture entirely — refuse those rather than
-            # run a silently-wrong model.
-            or arch == "GemmaForCausalLM"
-            or hf.get("model_type") == "gemma"
+            or arch in ("GemmaForCausalLM", "Gemma2ForCausalLM")
+            or hf.get("model_type") in ("gemma", "gemma2")
+            # Gemma 3 and RecurrentGemma remain different architectures —
+            # refuse those rather than run a silently-wrong model.
         ):
             cfg = LlamaConfig.from_hf_config(hf)
         else:
@@ -228,6 +231,24 @@ def get_model(
         cfg = _with_dtype(cfg, dtype)
     if attention_impl is not None:
         cfg = replace(cfg, attention_impl=attention_impl)
+    if cfg.attention_impl in ("pallas", "hybrid") and (
+        cfg.sliding_window
+        or cfg.attn_logit_softcap
+        or (
+            cfg.query_pre_attn_scalar is not None
+            and cfg.query_pre_attn_scalar != cfg.head_dim
+        )
+    ):
+        # Gemma2's sliding-window / softcapped / rescaled attention isn't
+        # implemented in the flash kernels (they scale by 1/sqrt(head_dim))
+        # — serve it on the XLA path rather than fail ("auto" on TPU would
+        # otherwise pick pallas and raise at trace).
+        logger.info(
+            "%s: sliding-window/softcap/rescaled attention -> "
+            "attention_impl=xla",
+            name,
+        )
+        cfg = replace(cfg, attention_impl="xla")
     adapter = _llama_adapter(name, cfg, mesh=mesh)
     if gguf_path is not None:
         from dynamo_tpu.gguf import read_gguf

@@ -1,0 +1,172 @@
+"""Gemma-2 family (sliding/global layer alternation + attn/final logit
+soft-capping + post-block norms + query_pre_attn_scalar) vs HuggingFace
+Gemma2ForCausalLM, through the paged KV cache."""
+
+from dataclasses import replace
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dynamo_tpu.models.llama import (
+    LlamaConfig,
+    forward,
+    init_kv_pages,
+    init_params,
+    params_from_torch_state_dict,
+)
+
+PAGE_SIZE = 4
+
+
+def _tiny_gemma2_cfg():
+    return LlamaConfig(
+        vocab_size=256,
+        hidden_size=32,
+        intermediate_size=64,
+        num_layers=4,  # >= 2 of each: sliding (even) + global (odd)
+        num_heads=4,
+        num_kv_heads=2,
+        head_dim=8,
+        rope_theta=10000.0,
+        rms_norm_eps=1e-6,
+        dtype=jnp.float32,
+        tie_word_embeddings=True,
+        hidden_act="gelu_tanh",
+        rms_norm_unit_offset=True,
+        scale_embeddings=True,
+        attn_logit_softcap=50.0,
+        final_logit_softcap=30.0,
+        sliding_window=6,  # < seq len below, so locality really bites
+        query_pre_attn_scalar=12.0,  # != head_dim: scale must use this
+        post_block_norms=True,
+    )
+
+
+def _run_paged(cfg, params, toks, chunks=None):
+    b, t = toks.shape
+    kv = init_kv_pages(cfg, 64, PAGE_SIZE)
+    n_pages = -(-t // PAGE_SIZE)
+    pts = np.zeros((b, n_pages), np.int32)
+    for i in range(b):
+        pts[i] = np.arange(1 + i * n_pages, 1 + (i + 1) * n_pages)
+    outs = []
+    for start, end in chunks or [(0, t)]:
+        positions = np.tile(
+            np.arange(start, end, dtype=np.int32), (b, 1)
+        )
+        logits, kv = forward(
+            params, cfg, jnp.asarray(toks[:, start:end]),
+            jnp.asarray(positions),
+            jnp.ones((b, end - start), bool), kv, jnp.asarray(pts),
+        )
+        outs.append(np.asarray(logits))
+    return np.concatenate(outs, axis=1)
+
+
+def test_against_hf_gemma2():
+    torch = pytest.importorskip("torch")
+    from transformers import Gemma2Config, Gemma2ForCausalLM
+
+    cfg = _tiny_gemma2_cfg()
+    hf_cfg = Gemma2Config(
+        vocab_size=cfg.vocab_size,
+        hidden_size=cfg.hidden_size,
+        intermediate_size=cfg.intermediate_size,
+        num_hidden_layers=cfg.num_layers,
+        num_attention_heads=cfg.num_heads,
+        num_key_value_heads=cfg.num_kv_heads,
+        head_dim=cfg.head_dim,
+        rope_theta=cfg.rope_theta,
+        rms_norm_eps=cfg.rms_norm_eps,
+        hidden_activation="gelu_pytorch_tanh",
+        tie_word_embeddings=True,
+        attention_bias=False,
+        attn_logit_softcapping=cfg.attn_logit_softcap,
+        final_logit_softcapping=cfg.final_logit_softcap,
+        sliding_window=cfg.sliding_window,
+        query_pre_attn_scalar=cfg.query_pre_attn_scalar,
+        attn_implementation="eager",  # sdpa skips the softcap
+    )
+    torch.manual_seed(5)
+    model = Gemma2ForCausalLM(hf_cfg).eval()
+    params = params_from_torch_state_dict(model.state_dict(), cfg)
+    assert "post_attn_norm" in params["layers"]
+
+    rng = np.random.default_rng(7)
+    # seq 12 > window 6: sliding layers attend a strict subset
+    toks = rng.integers(0, cfg.vocab_size, size=(2, 12)).astype(np.int32)
+    with torch.no_grad():
+        ref = model(torch.from_numpy(toks.astype(np.int64))).logits.numpy()
+    ours = _run_paged(cfg, params, toks)
+    np.testing.assert_allclose(ours, ref, rtol=5e-2, atol=5e-2)
+    assert (ours.argmax(-1) == ref.argmax(-1)).mean() > 0.95
+
+    # Decode continuation through the paged cache (prefill 8, step 4 more)
+    ours_chunked = _run_paged(cfg, params, toks, chunks=[(0, 8), (8, 12)])
+    np.testing.assert_allclose(ours_chunked, ours, rtol=1e-4, atol=1e-4)
+
+
+def test_gemma2_features_change_output():
+    """Each Gemma2 delta must actually flow through the forward pass."""
+    cfg = _tiny_gemma2_cfg()
+    params = init_params(jax.random.key(0), cfg)
+    rng = np.random.default_rng(1)
+    toks = rng.integers(0, cfg.vocab_size, size=(1, 12)).astype(np.int32)
+    base = _run_paged(cfg, params, toks)
+    for flip in (
+        {"attn_logit_softcap": None},
+        {"final_logit_softcap": None},
+        {"sliding_window": 0},
+        {"query_pre_attn_scalar": None},
+    ):
+        other = _run_paged(replace(cfg, **flip), params, toks)
+        assert not np.allclose(other, base), flip
+    # post_block_norms changes the param tree, so flip it with fresh params
+    cfg_off = replace(cfg, post_block_norms=False)
+    other = _run_paged(cfg_off, init_params(jax.random.key(0), cfg_off), toks)
+    assert not np.allclose(other, base)
+
+
+def test_gemma2_registry_forces_xla_attention():
+    from dynamo_tpu.models.registry import get_model
+
+    adapter = get_model("gemma2-2b", dtype="float32", attention_impl="pallas")
+    assert adapter.config.attention_impl == "xla"
+
+
+def test_gemma2_hf_checkpoint_dir_resolves(tmp_path):
+    """A Gemma2ForCausalLM checkpoint directory must resolve through
+    get_model (from_hf_config's production caller), not be refused."""
+    torch = pytest.importorskip("torch")
+    from transformers import Gemma2Config, Gemma2ForCausalLM
+
+    from dynamo_tpu.models.registry import get_model
+
+    cfg = _tiny_gemma2_cfg()
+    hf_cfg = Gemma2Config(
+        vocab_size=cfg.vocab_size,
+        hidden_size=cfg.hidden_size,
+        intermediate_size=cfg.intermediate_size,
+        num_hidden_layers=cfg.num_layers,
+        num_attention_heads=cfg.num_heads,
+        num_key_value_heads=cfg.num_kv_heads,
+        head_dim=cfg.head_dim,
+        rms_norm_eps=cfg.rms_norm_eps,
+        hidden_activation="gelu_pytorch_tanh",
+        tie_word_embeddings=True,
+        attn_logit_softcapping=50.0,
+        final_logit_softcapping=30.0,
+        sliding_window=6,
+        query_pre_attn_scalar=12.0,
+    )
+    torch.manual_seed(5)
+    Gemma2ForCausalLM(hf_cfg).save_pretrained(str(tmp_path))
+    adapter = get_model(str(tmp_path), dtype="float32")
+    c = adapter.config
+    assert c.post_block_norms and c.sliding_window == 6
+    assert c.attn_logit_softcap == 50.0 and c.final_logit_softcap == 30.0
+    assert c.query_pre_attn_scalar == 12.0
+    assert c.attention_impl == "xla"  # flash kernels are refused for these
