@@ -124,7 +124,13 @@ class JaxEngine:
             acfg = self.adapter.config
             if not hasattr(acfg, "num_heads"):
                 acfg = acfg.base
-            if acfg.num_heads % mc.tp or acfg.num_kv_heads % mc.tp:
+            # MLA's shared-latent cache replicates over tp (the q heads
+            # still shard) — only head-sharded caches need kv divisibility.
+            kv_ok = (
+                getattr(acfg, "mqa_latent_cache", False)
+                or acfg.num_kv_heads % mc.tp == 0
+            )
+            if acfg.num_heads % mc.tp or not kv_ok:
                 raise ValueError(
                     f"tp={mc.tp} must divide num_heads ({acfg.num_heads}) "
                     f"and num_kv_heads ({acfg.num_kv_heads}) for "

@@ -74,9 +74,12 @@ class LlamaConfig:
     attn_logit_softcap: Optional[float] = None
     #: Gemma2: final lm_head logits pass cap*tanh(l/cap)
     final_logit_softcap: Optional[float] = None
-    #: Gemma2 local/global alternation: layers with even index attend only
-    #: the last `sliding_window` positions (HF Gemma2 pattern); 0 disables
+    #: Local attention: affected layers attend only the last
+    #: `sliding_window` positions; 0 disables
     sliding_window: int = 0
+    #: which layers are local: layer_idx % every == 0. 2 = Gemma2's
+    #: local/global alternation; 1 = every layer (Mistral)
+    sliding_window_every: int = 2
     #: Gemma2: query scale is query_pre_attn_scalar**-0.5 (None: head_dim)
     query_pre_attn_scalar: Optional[float] = None
     #: Gemma2 block: extra post-attention / post-feedforward RMSNorms
@@ -176,6 +179,17 @@ class LlamaConfig:
         )
 
     @staticmethod
+    def mistral_7b() -> "LlamaConfig":
+        """Mistral-7B-v0.1: Llama architecture + sliding-window attention
+        on every layer (window 4096)."""
+        return LlamaConfig(
+            vocab_size=32000, hidden_size=4096, intermediate_size=14336,
+            num_layers=32, num_heads=32, num_kv_heads=8, head_dim=128,
+            rope_theta=10000.0, rms_norm_eps=1e-5,
+            sliding_window=4096, sliding_window_every=1,
+        )
+
+    @staticmethod
     def gemma2_2b() -> "LlamaConfig":
         """Gemma-2-2B: Gemma base + sliding/global layer alternation,
         attn+final logit soft-capping, post-block norms."""
@@ -204,6 +218,9 @@ class LlamaConfig:
             hf.get("model_type") == "gemma"
             or arch == "GemmaForCausalLM"
             or gemma2
+        )
+        mistral = (
+            hf.get("model_type") == "mistral" or arch == "MistralForCausalLM"
         )
         hidden_act = hf.get("hidden_activation") or hf.get("hidden_act", "silu")
         if hidden_act in ("gelu_pytorch_tanh", "gelu_tanh", "gelu"):
@@ -244,7 +261,11 @@ class LlamaConfig:
             final_logit_softcap=(
                 hf.get("final_logit_softcapping") if gemma2 else None
             ),
-            sliding_window=int(hf.get("sliding_window") or 0) if gemma2 else 0,
+            sliding_window=(
+                int(hf.get("sliding_window") or 0) if (gemma2 or mistral)
+                else 0
+            ),
+            sliding_window_every=2 if gemma2 else 1,
             query_pre_attn_scalar=(
                 float(hf["query_pre_attn_scalar"])
                 if gemma2 and hf.get("query_pre_attn_scalar")
@@ -808,13 +829,14 @@ def attention_block(
         k = jnp.pad(k, ((0, 0), (0, 0), (0, 0), (0, dpad)))
         v = jnp.pad(v, ((0, 0), (0, 0), (0, 0), (0, dpad)))
 
-    # Gemma2 local/global alternation: even layers see only the trailing
-    # window. A traced scalar per scan step — the mask comparison absorbs
-    # it with no extra program variants.
+    # Local attention (Gemma2 alternation / Mistral all-layers): affected
+    # layers see only the trailing window. A traced scalar per scan step —
+    # the mask comparison absorbs it with no extra program variants.
     window = None
     if cfg.sliding_window:
         window = jnp.where(
-            layer % 2 == 0, jnp.int32(cfg.sliding_window), jnp.int32(1 << 30)
+            layer % cfg.sliding_window_every == 0,
+            jnp.int32(cfg.sliding_window), jnp.int32(1 << 30),
         )
     if cfg.attention_impl in ("pallas", "hybrid") and (
         cfg.sliding_window

@@ -65,6 +65,8 @@ _LLAMA_PRESETS: dict[str, Callable[[], LlamaConfig]] = {
     "gemma-7b": LlamaConfig.gemma_7b,
     # Gemma2 adds sliding/global alternation, logit softcaps, post-norms.
     "gemma2-2b": LlamaConfig.gemma2_2b,
+    # Mistral = Llama + sliding-window attention on every layer.
+    "mistral-7b": LlamaConfig.mistral_7b,
 }
 
 
@@ -118,6 +120,54 @@ def _load_llama_checkpoint(path: str, cfg: LlamaConfig):
     return llama_mod.params_from_torch_state_dict(model.state_dict(), cfg)
 
 
+def _mla_adapter(name: str, cfg, mesh=None) -> ModelAdapter:
+    from dynamo_tpu.models import mla as mla_mod
+    from dynamo_tpu.parallel.shardings import kv_cache_spec
+
+    def fwd(params, tokens, positions, valid, kv, pt):
+        return mla_mod.forward(params, cfg, tokens, positions, valid, kv, pt)
+
+    def fwd_hidden(params, tokens, positions, valid, kv, pt, **mm):
+        return mla_mod.forward_hidden(
+            params, cfg, tokens, positions, valid, kv, pt, mesh=mesh, **mm
+        )
+
+    def load(path):
+        import torch
+        from transformers import AutoModelForCausalLM
+
+        model = AutoModelForCausalLM.from_pretrained(
+            path, torch_dtype=torch.float32, low_cpu_mem_usage=True,
+            trust_remote_code=False,
+        )
+        return mla_mod.params_from_torch_state_dict(model.state_dict(), cfg)
+
+    return ModelAdapter(
+        name=name,
+        config=cfg,
+        vocab_size=cfg.vocab_size,
+        init_params=lambda key: mla_mod.init_params(key, cfg),
+        forward=fwd,
+        forward_hidden=fwd_hidden,
+        compute_logits=lambda params, h: mla_mod.compute_logits(
+            params, cfg, h
+        ),
+        init_kv=lambda num_pages, page_size: mla_mod.init_kv_pages(
+            cfg, num_pages, page_size
+        ),
+        param_specs=lambda quantized=False: mla_mod.mla_param_specs(
+            cfg, quantized=quantized
+        ),
+        # one shared latent per token: the cache replicates over tp (MQA
+        # shape) — reuse the generic spec with no head axis to shard
+        kv_spec=lambda: KVPages(
+            k=kv_cache_spec(shard_heads=False),
+            v=kv_cache_spec(shard_heads=False),
+        ),
+        load_params=load,
+    )
+
+
 def _moe_adapter(name: str, moe_cfg, mesh=None) -> ModelAdapter:
     from dynamo_tpu.models import moe as moe_mod
     from dynamo_tpu.parallel.shardings import kv_cache_spec
@@ -168,6 +218,7 @@ def get_model(
     mesh=None,
 ) -> ModelAdapter:
     """Resolve a model name: preset id, or a local HF checkpoint dir."""
+    from dynamo_tpu.models.mla import MlaConfig
     from dynamo_tpu.models.moe import MoeConfig
 
     key = name.lower()
@@ -175,7 +226,13 @@ def get_model(
         "mixtral-8x7b": MoeConfig.mixtral_8x7b,
         "moe-tiny": MoeConfig.tiny,
     }
+    mla_presets = {
+        "deepseek-v2-lite": MlaConfig.deepseek_v2_lite,
+        "mla-tiny": MlaConfig.tiny,
+        "mla-tiny-moe": MlaConfig.tiny_moe,
+    }
     moe_cfg = None
+    mla_cfg = None
     gguf_path = None
     if key in _LLAMA_PRESETS:
         cfg = _LLAMA_PRESETS[key]()
@@ -192,6 +249,8 @@ def get_model(
         gguf_path = name
     elif key in moe_presets:
         moe_cfg = moe_presets[key]()
+    elif key in mla_presets:
+        mla_cfg = mla_presets[key]()
     elif os.path.isdir(name) and os.path.exists(os.path.join(name, "config.json")):
         with open(os.path.join(name, "config.json")) as f:
             hf = json.load(f)
@@ -199,10 +258,17 @@ def get_model(
         if "mixtral" in arch.lower():
             moe_cfg = MoeConfig.from_hf_config(hf)
         elif (
+            arch == "DeepseekV2ForCausalLM"
+            or hf.get("model_type") == "deepseek_v2"
+        ):
+            mla_cfg = MlaConfig.from_hf_config(hf)
+        elif (
             "llama" in arch.lower()
             or "qwen2" in arch.lower()
-            or arch in ("GemmaForCausalLM", "Gemma2ForCausalLM")
-            or hf.get("model_type") in ("gemma", "gemma2")
+            or arch in (
+                "GemmaForCausalLM", "Gemma2ForCausalLM", "MistralForCausalLM"
+            )
+            or hf.get("model_type") in ("gemma", "gemma2", "mistral")
             # Gemma 3 and RecurrentGemma remain different architectures —
             # refuse those rather than run a silently-wrong model.
         ):
@@ -212,9 +278,20 @@ def get_model(
     else:
         raise ValueError(
             f"unknown model {name!r}; presets: "
-            f"{sorted(_LLAMA_PRESETS) + sorted(moe_presets)} "
+            f"{sorted(_LLAMA_PRESETS) + sorted(moe_presets) + sorted(mla_presets)} "
             "or a local HF checkpoint directory"
         )
+    if mla_cfg is not None:
+        if dtype is not None:
+            mla_cfg = _with_dtype(mla_cfg, dtype)
+        if attention_impl not in (None, "auto", "xla"):
+            # MLA's absorbed-latent attention only has the XLA path; the
+            # flash kernels assume per-head K/V pages.
+            logger.info("%s: MLA attention -> attention_impl=xla", name)
+        mla_adapter = _mla_adapter(name, mla_cfg, mesh=mesh)
+        if os.path.isdir(name):
+            mla_adapter = replace(mla_adapter, default_checkpoint=name)
+        return mla_adapter
     if moe_cfg is not None:
         if dtype is not None:
             moe_cfg = replace(moe_cfg, base=_with_dtype(moe_cfg.base, dtype))

@@ -58,9 +58,10 @@ def llama_param_specs(cfg: LlamaConfig, quantized: bool = False) -> dict:
     return specs
 
 
-def kv_cache_spec() -> P:
-    # [L, P, S, Hkv, D] — kv heads ride with their tp shard.
-    return P(None, None, None, "tp", None)
+def kv_cache_spec(shard_heads: bool = True) -> P:
+    # [L, P, S, Hkv, D] — kv heads ride with their tp shard. MQA-shaped
+    # caches (MLA's shared latent: Hkv=1) replicate instead.
+    return P(None, None, None, "tp" if shard_heads else None, None)
 
 
 def batch_spec(ndim: int) -> P:

@@ -122,18 +122,25 @@ def test_gemma_preset_serves_through_engine():
         registry._LLAMA_PRESETS.pop("gemma-tiny", None)
 
 
-def test_gemma2_hf_config_rejected(tmp_path):
+def test_unsupported_gemma_variants_rejected(tmp_path):
+    """Gemma-2 is supported (tests/test_model_gemma2.py); Gemma-3 and
+    RecurrentGemma remain different architectures and must be refused
+    rather than run silently wrong."""
     import json
 
     from dynamo_tpu.models.registry import get_model
 
-    d = tmp_path / "g2"
-    d.mkdir()
-    (d / "config.json").write_text(json.dumps({
-        "architectures": ["Gemma2ForCausalLM"],
-        "model_type": "gemma2",
-        "vocab_size": 256, "hidden_size": 64, "intermediate_size": 128,
-        "num_hidden_layers": 2, "num_attention_heads": 4,
-    }))
-    with pytest.raises(ValueError, match="unsupported architecture"):
-        get_model(str(d))
+    for arch, mt in (
+        ("Gemma3ForCausalLM", "gemma3"),
+        ("RecurrentGemmaForCausalLM", "recurrent_gemma"),
+    ):
+        d = tmp_path / mt
+        d.mkdir()
+        (d / "config.json").write_text(json.dumps({
+            "architectures": [arch],
+            "model_type": mt,
+            "vocab_size": 256, "hidden_size": 64, "intermediate_size": 128,
+            "num_hidden_layers": 2, "num_attention_heads": 4,
+        }))
+        with pytest.raises(ValueError, match="unsupported architecture"):
+            get_model(str(d))
