@@ -517,10 +517,7 @@ def init_params_int8(key: jax.Array, cfg: LlamaConfig) -> dict:
             w = jax.random.normal(
                 kl, (in_dim, out_dim), jnp.float32
             ) / math.sqrt(in_dim)
-            s = jnp.maximum(
-                jnp.max(jnp.abs(w), axis=0, keepdims=True) / 127.0, 1e-8
-            )
-            return jnp.round(w / s).astype(jnp.int8), s
+            return quantize_channelwise_int8(w)
 
         return jax.lax.map(one, jax.random.split(k, L))
 
@@ -565,11 +562,7 @@ def quantize_params_int8(params: dict) -> dict:
     bf16 — XLA streams the int8->bf16 convert + scale into the dot's
     operand read. Matmul helpers (`_mm`) dequantize transparently, so the
     same forward serves both layouts."""
-    def quant_one(wl):  # [in, out] — one layer's weight
-        wf = wl.astype(jnp.float32)
-        scale = jnp.max(jnp.abs(wf), axis=0, keepdims=True) / 127.0  # [1,out]
-        scale = jnp.maximum(scale, 1e-8)
-        return jnp.round(wf / scale).astype(jnp.int8), scale
+    quant_one = quantize_channelwise_int8
 
     out = dict(params)
     layers = dict(params["layers"])
@@ -595,6 +588,17 @@ def _mm(x: jax.Array, lp: dict, name: str, dtype) -> jax.Array:
     if w.dtype == jnp.int8:
         return (x @ w.astype(dtype)) * lp[name + "_scale"][0].astype(dtype)
     return x @ w
+
+
+def quantize_channelwise_int8(w: jax.Array):
+    """THE int8 scheme, shared by every family's quantize/init path:
+    per-output-channel symmetric max-abs scales over a [in, out] weight.
+    Returns (int8 weight, [1, out] f32 scale)."""
+    wf = w.astype(jnp.float32)
+    scale = jnp.maximum(
+        jnp.max(jnp.abs(wf), axis=0, keepdims=True) / 127.0, 1e-8
+    )
+    return jnp.round(wf / scale).astype(jnp.int8), scale
 
 
 def rms_norm(

@@ -51,10 +51,30 @@ from jax import lax
 
 from dynamo_tpu.models.llama import (
     KVPages,
+    _mm,
     paged_gather,
     paged_scatter,
+    quantize_channelwise_int8,
     rms_norm,
 )
+
+#: weight names quantized by quantize_params_int8 / init_params_int8
+#: (w_router stays fp32 — routing precision; norms/embeds keep base dtype)
+_QUANT_2D = (
+    "wq", "wq_a", "wq_b", "wkv_a", "wkv_b", "wo",
+    "w_gate", "w_up", "w_down", "ws_gate", "ws_up", "ws_down",
+)
+_QUANT_EXPERTS = ("we_gate", "we_up", "we_down")  # [L, E, in, out]
+
+
+def _w(lp: dict, name: str, dtype) -> jax.Array:
+    """lp[name], dequantized when int8 — for weights consumed by einsum
+    (the scale varies over non-factorable axes, so dequant first; XLA
+    fuses the convert+scale into the consumer's operand read)."""
+    w = lp[name]
+    if w.dtype == jnp.int8:
+        return w.astype(dtype) * lp[name + "_scale"].astype(dtype)
+    return w.astype(dtype)
 
 
 @dataclass(frozen=True)
@@ -434,16 +454,18 @@ def mla_attention(
 
     if cfg.q_lora_rank:
         qa = rms_norm(
-            (x @ lp["wq_a"]).astype(cfg.dtype), lp["q_a_norm"],
-            cfg.rms_norm_eps,
+            _mm(x, lp, "wq_a", cfg.dtype).astype(cfg.dtype),
+            lp["q_a_norm"], cfg.rms_norm_eps,
         )
-        q = (qa @ lp["wq_b"]).reshape(b, t, hn, cfg.qk_head_dim)
+        q = _mm(qa, lp, "wq_b", cfg.dtype).reshape(
+            b, t, hn, cfg.qk_head_dim
+        )
     else:
-        q = (x @ lp["wq"]).reshape(b, t, hn, cfg.qk_head_dim)
+        q = _mm(x, lp, "wq", cfg.dtype).reshape(b, t, hn, cfg.qk_head_dim)
     q_nope, q_pe = q[..., :n], q[..., n:]
     q_pe = _interleaved_rope(q_pe, positions, cfg.rope_theta)
 
-    kv_a = x @ lp["wkv_a"]  # [B,T,c+r]
+    kv_a = _mm(x, lp, "wkv_a", cfg.dtype)  # [B,T,c+r]
     c_kv = rms_norm(
         kv_a[..., :c].astype(cfg.dtype), lp["kv_a_norm"], cfg.rms_norm_eps
     )
@@ -462,7 +484,7 @@ def mla_attention(
     c_hist = paged_gather(k_cache, layer, page_tables)[:, :, 0]  # [B,K,c]
     pe_hist = paged_gather(v_cache, layer, page_tables)[:, :, 0]  # [B,K,r]
 
-    wkv_b = lp["wkv_b"].reshape(c, hn, n + vd)
+    wkv_b = _w(lp, "wkv_b", jnp.float32).reshape(c, hn, n + vd)
     w_uk, w_uv = wkv_b[..., :n], wkv_b[..., n:]
 
     scale = 1.0 / math.sqrt(cfg.qk_head_dim)
@@ -485,7 +507,7 @@ def mla_attention(
     o_lat = jnp.einsum("bhtk,bkc->bthc", probs, c_hist.astype(jnp.float32))
     out = jnp.einsum("bthc,chv->bthv", o_lat, w_uv.astype(jnp.float32))
     out = out.reshape(b, t, hn * vd).astype(cfg.dtype)
-    return out @ lp["wo"], k_cache, v_cache
+    return _mm(out, lp, "wo", cfg.dtype), k_cache, v_cache
 
 
 # ---------------------------------------------------------------------------
@@ -528,22 +550,21 @@ def _deepseek_moe_ffn(x: jax.Array, lp: dict, cfg: MlaConfig) -> jax.Array:
 
     xe = jnp.einsum("nec,nh->ech", dispatch, xf.astype(jnp.float32))
     gate = jax.nn.silu(
-        jnp.einsum("ech,ehi->eci", xe, lp["we_gate"].astype(jnp.float32))
+        jnp.einsum("ech,ehi->eci", xe, _w(lp, "we_gate", jnp.float32))
     )
-    up = jnp.einsum("ech,ehi->eci", xe, lp["we_up"].astype(jnp.float32))
+    up = jnp.einsum("ech,ehi->eci", xe, _w(lp, "we_up", jnp.float32))
     down = jnp.einsum(
-        "eci,eih->ech", gate * up, lp["we_down"].astype(jnp.float32)
+        "eci,eih->ech", gate * up, _w(lp, "we_down", jnp.float32)
     )
     routed = jnp.einsum("nec,ech->nh", combine, down)
 
     shared_gate = jax.nn.silu(
-        (xf @ lp["ws_gate"]).astype(jnp.float32)
+        _mm(xf, lp, "ws_gate", cfg.dtype).astype(jnp.float32)
     )
-    shared = (
-        (shared_gate * (xf @ lp["ws_up"]).astype(jnp.float32)).astype(
-            cfg.dtype
-        )
-        @ lp["ws_down"]
+    shared = _mm(
+        (shared_gate * _mm(xf, lp, "ws_up", cfg.dtype).astype(jnp.float32))
+        .astype(cfg.dtype),
+        lp, "ws_down", cfg.dtype,
     )
     return (routed.astype(cfg.dtype) + shared).reshape(b, t, h)
 
@@ -580,11 +601,9 @@ def forward_hidden(
         )
         h = h + attn
         x = rms_norm(h, lp["mlp_norm"], cfg.rms_norm_eps)
-        gate = jax.nn.silu(
-            (x @ lp["w_gate"]).astype(jnp.float32)
-        )
-        up = (x @ lp["w_up"]).astype(jnp.float32)
-        h = h + (gate * up).astype(cfg.dtype) @ lp["w_down"]
+        gate = jax.nn.silu(_mm(x, lp, "w_gate", cfg.dtype).astype(jnp.float32))
+        up = _mm(x, lp, "w_up", cfg.dtype).astype(jnp.float32)
+        h = h + _mm((gate * up).astype(cfg.dtype), lp, "w_down", cfg.dtype)
         return (h, kc, vc), None
 
     def moe_layer(carry, xs):
@@ -636,7 +655,10 @@ def forward(params, cfg: MlaConfig, tokens, positions, valid, kv, page_tables):
 def mla_param_specs(cfg: MlaConfig, quantized: bool = False):
     """PartitionSpecs: attention heads shard over tp (the packed head
     output axes of wq/wkv_b/wo), routed experts over ep; the latent
-    projections and cache replicate (one shared latent — MQA-shaped)."""
+    projections and cache replicate (one shared latent — MQA-shaped).
+    Quantized layouts add per-output-channel scale leaves: a scale
+    shards with its weight's OUTPUT dim (contraction-sharded wo/w_down
+    keep replicated scales, which commute with the partial-sum)."""
     from jax.sharding import PartitionSpec as P
 
     def attn_specs(moe: bool) -> dict:
@@ -669,6 +691,18 @@ def mla_param_specs(cfg: MlaConfig, quantized: bool = False):
                 ws_up=P(None, None, "tp"),
                 ws_down=P(None, "tp", None),
             )
+        if quantized:
+            for name in list(specs):
+                if name not in _QUANT_2D + _QUANT_EXPERTS:
+                    continue
+                wspec = tuple(specs[name])
+                if name in _QUANT_EXPERTS:
+                    # [L, E, 1, out]: scale rides the expert shard
+                    specs[name + "_scale"] = P(None, "ep", None, None)
+                elif wspec and wspec[-1] == "tp":  # output-dim sharded
+                    specs[name + "_scale"] = P(None, None, "tp")
+                else:  # replicated or contraction-sharded: scale replicates
+                    specs[name + "_scale"] = P()
         return specs
 
     specs = {
@@ -680,3 +714,134 @@ def mla_param_specs(cfg: MlaConfig, quantized: bool = False):
     if not cfg.tie_word_embeddings:
         specs["lm_head"] = P(None, "tp")
     return specs
+
+
+# ---------------------------------------------------------------------------
+# Weight-only int8
+# ---------------------------------------------------------------------------
+
+
+def quantize_params_int8(params: dict) -> dict:
+    """Per-output-channel symmetric int8 for every dense matmul weight
+    (same scheme as llama.quantize_params_int8; w_router / norms / embed
+    stay in the base dtype). Makes deepseek-v2-lite's 15.7B weights
+    ~16GB — servable on one v5e chip."""
+
+    quant_one = quantize_channelwise_int8
+
+    out = dict(params)
+    for gname in ("dense_layers", "moe_layers"):
+        group = dict(params.get(gname) or {})
+        if not group:
+            continue
+        if any(
+            group.get(n) is not None and group[n].dtype == jnp.int8
+            for n in _QUANT_2D + _QUANT_EXPERTS
+        ):
+            raise ValueError("params are already int8-quantized")
+        for name in _QUANT_2D:
+            if name in group:
+                q, s = jax.lax.map(quant_one, group[name])
+                group[name] = q
+                group[name + "_scale"] = s
+        for name in _QUANT_EXPERTS:
+            if name in group:
+                q, s = jax.lax.map(
+                    lambda we: jax.lax.map(quant_one, we), group[name]
+                )
+                group[name] = q
+                group[name + "_scale"] = s
+        out[gname] = group
+    return out
+
+
+def init_params_int8(key: jax.Array, cfg: MlaConfig) -> dict:
+    """Random-init straight into the int8 layout, one (layer, expert)
+    tensor at a time — full-dtype init of deepseek-v2-lite (~31GB bf16)
+    would blow a single chip's HBM before quantization could run."""
+    counter = iter(range(1 << 30))
+
+    def qdense(shape):
+        k = jax.random.fold_in(key, next(counter))
+        w = jax.random.normal(k, shape, jnp.float32) / math.sqrt(shape[0])
+        return quantize_channelwise_int8(w)
+
+    def dense(shape):
+        k = jax.random.fold_in(key, next(counter))
+        return (
+            jax.random.normal(k, shape, jnp.float32) / math.sqrt(shape[0])
+        ).astype(cfg.dtype)
+
+    def norm(shape):
+        return jnp.ones(shape, cfg.dtype)
+
+    h = cfg.hidden_size
+
+    def group(n_layers: int, moe: bool) -> dict:
+        if n_layers == 0:
+            return {}
+        lp: dict = {}
+        for name, shape in _attn_layer_shapes(cfg).items():
+            if "norm" in name:
+                lp[name] = jnp.stack([norm(shape)] * n_layers)
+            elif name in _QUANT_2D:
+                qs = [qdense(shape) for _ in range(n_layers)]
+                lp[name] = jnp.stack([q for q, _ in qs])
+                lp[name + "_scale"] = jnp.stack([s for _, s in qs])
+            else:
+                lp[name] = jnp.stack([dense(shape) for _ in range(n_layers)])
+        if not moe:
+            i = cfg.intermediate_size
+            for nm, shape in (
+                ("w_gate", (h, i)), ("w_up", (h, i)), ("w_down", (i, h)),
+            ):
+                qs = [qdense(shape) for _ in range(n_layers)]
+                lp[nm] = jnp.stack([q for q, _ in qs])
+                lp[nm + "_scale"] = jnp.stack([s for _, s in qs])
+        else:
+            e, mi = cfg.n_routed_experts, cfg.moe_intermediate_size
+            si = mi * cfg.n_shared_experts
+            lp["w_router"] = jnp.stack(
+                [dense((h, e)) for _ in range(n_layers)]
+            )
+            for nm, shape in (
+                ("we_gate", (e, h, mi)), ("we_up", (e, h, mi)),
+                ("we_down", (e, mi, h)),
+            ):
+                # one compiled map over all (layer, expert) tensors —
+                # eager per-expert dispatch would mean thousands of
+                # round-trips and list-then-stack copies at v2-lite scale
+                base = next(counter)
+
+                def one(idx, _shape=shape[1:], _base=base):
+                    k = jax.random.fold_in(key, _base + idx)
+                    w = jax.random.normal(
+                        k, _shape, jnp.float32
+                    ) / math.sqrt(_shape[0])
+                    return quantize_channelwise_int8(w)
+
+                q, s = jax.lax.map(
+                    one, jnp.arange(n_layers * e, dtype=jnp.int32)
+                )
+                for _ in range(n_layers * e - 1):
+                    next(counter)  # keep the fold_in stream unique
+                lp[nm] = q.reshape(n_layers, e, *shape[1:])
+                lp[nm + "_scale"] = s.reshape(n_layers, e, 1, shape[2])
+            for nm, shape in (
+                ("ws_gate", (h, si)), ("ws_up", (h, si)),
+                ("ws_down", (si, h)),
+            ):
+                qs = [qdense(shape) for _ in range(n_layers)]
+                lp[nm] = jnp.stack([q for q, _ in qs])
+                lp[nm + "_scale"] = jnp.stack([s for _, s in qs])
+        return lp
+
+    params = {
+        "embed": dense((cfg.vocab_size, h)),
+        "dense_layers": group(cfg.num_dense_layers, moe=False),
+        "moe_layers": group(cfg.num_moe_layers, moe=True),
+        "final_norm": norm((h,)),
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = dense((h, cfg.vocab_size))
+    return params

@@ -165,6 +165,10 @@ def _mla_adapter(name: str, cfg, mesh=None) -> ModelAdapter:
             v=kv_cache_spec(shard_heads=False),
         ),
         load_params=load,
+        quantize_params=mla_mod.quantize_params_int8,
+        init_params_quantized=lambda key: mla_mod.init_params_int8(
+            key, cfg
+        ),
     )
 
 

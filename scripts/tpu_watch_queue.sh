@@ -72,9 +72,16 @@ offload() {
     --model llama3-1b --dtype bfloat16 --page-size 16 --num-pages 192 \
     --max-context 2048 --users 8 --turns 4 --turn-chars 400 --osl 16
 }
+bench_dsv2() {
+  # DeepSeek-V2-Lite (15.7B MLA+MoE) int8 on ONE v5e chip: the compressed
+  # latent cache + weight-only int8 make it fit; random weights (no
+  # checkpoints in the image), so tok/s+MFU are the story, not quality.
+  BENCH_MODEL=deepseek-v2-lite BENCH_QUANTIZE=int8 BENCH_REQUESTS=32 \
+    run_stage bench_dsv2 python bench.py
+}
 
 STAGES=("$@")
-[ ${#STAGES[@]} -eq 0 ] && STAGES=(disagg_ab sweep_8b ft_kill routing offload decode_profile)
+[ ${#STAGES[@]} -eq 0 ] && STAGES=(disagg_ab sweep_8b ft_kill routing offload bench_dsv2 decode_profile)
 
 wait_for_tunnel
 for s in "${STAGES[@]}"; do

@@ -208,6 +208,7 @@ def test_mla_serves_under_tp_mesh(cpu_mesh_devices):
             model="mla-tiny", tp=2, num_pages=32, page_size=4,
             max_pages_per_seq=8, decode_buckets=(2,), prefill_chunk=8,
             max_seqs=2, dtype="float32",
+            quantize="int8",  # also exercises quantized specs on a mesh
         )
     )
     rng = np.random.default_rng(1)
@@ -218,3 +219,41 @@ def test_mla_serves_under_tp_mesh(cpu_mesh_devices):
         )
     done = eng.run_to_completion()
     assert all(len(v) == 4 for v in done.values()), done
+
+
+def test_mla_int8_quantized_serving_close_to_fp():
+    """Weight-only int8 over the full MLA+MoE layout: engine serves, and
+    the quantized forward stays close to fp32 (per-channel scales)."""
+    from dynamo_tpu.engine import EngineConfig
+    from dynamo_tpu.engine.engine import JaxEngine
+    from dynamo_tpu.engine.request import SamplingParams
+    from dynamo_tpu.models.mla import quantize_params_int8
+
+    cfg = MlaConfig.tiny_moe()
+    params = init_params(jax.random.key(2), cfg)
+    qparams = quantize_params_int8(params)
+    assert qparams["moe_layers"]["we_gate"].dtype == jnp.int8
+    assert "we_gate_scale" in qparams["moe_layers"]
+    with pytest.raises(ValueError, match="already int8"):
+        quantize_params_int8(qparams)
+
+    rng = np.random.default_rng(31)
+    toks = rng.integers(0, cfg.vocab_size, size=(1, 8)).astype(np.int32)
+    fp = _run_paged(cfg, params, toks)
+    q8 = _run_paged(cfg, qparams, toks)
+    # loose: int8 quantization noise, but same model
+    assert (fp.argmax(-1) == q8.argmax(-1)).mean() > 0.7
+
+    eng = JaxEngine(
+        EngineConfig(
+            model="mla-tiny-moe", num_pages=32, page_size=4,
+            max_pages_per_seq=8, decode_buckets=(2,), prefill_chunk=8,
+            max_seqs=2, dtype="float32", quantize="int8",
+        )
+    )
+    eng.add_request(
+        "r0", [int(x) for x in rng.integers(1, 250, 6)],
+        SamplingParams(temperature=0.0, max_tokens=4),
+    )
+    done = eng.run_to_completion()
+    assert len(done["r0"]) == 4
