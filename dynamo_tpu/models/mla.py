@@ -59,7 +59,8 @@ from dynamo_tpu.models.llama import (
 )
 
 #: weight names quantized by quantize_params_int8 / init_params_int8
-#: (w_router stays fp32 — routing precision; norms/embeds keep base dtype)
+#: (w_router stays UNquantized in the base dtype — the gate matmul
+#: upcasts it to f32; norms/embeds keep the base dtype too)
 _QUANT_2D = (
     "wq", "wq_a", "wq_b", "wkv_a", "wkv_b", "wo",
     "w_gate", "w_up", "w_down", "ws_gate", "ws_up", "ws_down",
@@ -103,6 +104,12 @@ class MlaConfig:
     routed_scaling_factor: float = 1.0
     norm_topk_prob: bool = False
     capacity_factor: float = 2.0
+    #: "greedy" (V2-Lite) or "group_limited_greedy" (V2/V2-Chat): experts
+    #: are split into n_group groups, the top topk_group groups win (by
+    #: max expert score), and top-k selects within the winners only
+    topk_method: str = "greedy"
+    n_group: int = 1
+    topk_group: int = 1
 
     @property
     def qk_head_dim(self) -> int:
@@ -169,11 +176,23 @@ class MlaConfig:
                 "DeepSeek YaRN rope scaling is not implemented; refuse "
                 "rather than run a silently-wrong model"
             )
-        if hf.get("topk_method", "greedy") != "greedy":
+        topk_method = hf.get("topk_method") or "greedy"
+        if topk_method not in ("greedy", "group_limited_greedy"):
             raise ValueError(
-                "only the greedy top-k method (DeepSeek-V2-Lite) is "
-                "implemented; group_limited_greedy is not"
+                f"unsupported topk_method {topk_method!r} (V3's "
+                "noaux_tc sigmoid gate is not implemented)"
             )
+        if topk_method == "group_limited_greedy":
+            ng = int(hf.get("n_group") or 1)
+            tg = int(hf.get("topk_group") or 1)
+            ne = int(hf.get("n_routed_experts") or 0)
+            # fail at load with a named error, not at trace with a shape one
+            if ne % max(ng, 1) or tg > ng:
+                raise ValueError(
+                    f"group_limited_greedy needs n_group ({ng}) dividing "
+                    f"n_routed_experts ({ne}) and topk_group ({tg}) <= "
+                    f"n_group"
+                )
         return MlaConfig(
             vocab_size=hf["vocab_size"],
             hidden_size=hf["hidden_size"],
@@ -197,6 +216,9 @@ class MlaConfig:
                 hf.get("routed_scaling_factor", 1.0)
             ),
             norm_topk_prob=bool(hf.get("norm_topk_prob", False)),
+            topk_method=topk_method,
+            n_group=int(hf.get("n_group") or 1),
+            topk_group=int(hf.get("topk_group") or 1),
         )
 
 
@@ -523,7 +545,19 @@ def _deepseek_moe_ffn(x: jax.Array, lp: dict, cfg: MlaConfig) -> jax.Array:
 
     logits = (xf.astype(jnp.float32)) @ lp["w_router"].astype(jnp.float32)
     scores = jax.nn.softmax(logits, axis=-1)  # [N, E]
-    topw, topi = lax.top_k(scores, k)  # greedy method (V2-Lite)
+    if cfg.topk_method == "group_limited_greedy":
+        # HF DeepseekV2MoEGate: rank expert GROUPS by their max member
+        # score, zero everything outside the top topk_group groups, then
+        # top-k within the winners.
+        g = cfg.n_group
+        group_scores = jnp.max(scores.reshape(nt, g, e // g), axis=-1)
+        _, gidx = lax.top_k(group_scores, cfg.topk_group)  # [N, tg]
+        gmask = jnp.sum(
+            jax.nn.one_hot(gidx, g, dtype=jnp.float32), axis=1
+        )  # [N, g]
+        emask = jnp.repeat(gmask, e // g, axis=-1)  # [N, E]
+        scores = scores * emask
+    topw, topi = lax.top_k(scores, k)
     if cfg.norm_topk_prob:
         topw = topw / jnp.sum(topw, axis=-1, keepdims=True)
     topw = topw * cfg.routed_scaling_factor

@@ -194,9 +194,11 @@ def test_mla_yarn_config_refused(tmp_path):
         get_model(str(d))
 
 
-def test_mla_serves_under_tp_mesh(cpu_mesh_devices):
+@pytest.mark.parametrize("quantize", [None, "int8"])
+def test_mla_serves_under_tp_mesh(cpu_mesh_devices, quantize):
     """tp=2: q heads shard, the latent cache replicates (the engine's
-    kv-divisibility check must not refuse the MQA-shaped cache)."""
+    kv-divisibility check must not refuse the MQA-shaped cache) — both
+    the fp and int8 layouts' PartitionSpecs must serve."""
     import numpy as np
 
     from dynamo_tpu.engine import EngineConfig
@@ -207,8 +209,7 @@ def test_mla_serves_under_tp_mesh(cpu_mesh_devices):
         EngineConfig(
             model="mla-tiny", tp=2, num_pages=32, page_size=4,
             max_pages_per_seq=8, decode_buckets=(2,), prefill_chunk=8,
-            max_seqs=2, dtype="float32",
-            quantize="int8",  # also exercises quantized specs on a mesh
+            max_seqs=2, dtype="float32", quantize=quantize,
         )
     )
     rng = np.random.default_rng(1)
@@ -257,3 +258,46 @@ def test_mla_int8_quantized_serving_close_to_fp():
     )
     done = eng.run_to_completion()
     assert len(done["r0"]) == 4
+
+
+def test_mla_moe_group_limited_greedy_against_hf():
+    """Full-V2 gating: top groups by max member score, then top-k within
+    the winning groups only."""
+    cfg = replace(
+        MlaConfig.tiny_moe(),
+        topk_method="group_limited_greedy", n_group=2, topk_group=1,
+    )
+    torch = pytest.importorskip("torch")
+    from transformers import DeepseekV2Config, DeepseekV2ForCausalLM
+
+    hf_cfg = DeepseekV2Config(
+        vocab_size=cfg.vocab_size, hidden_size=cfg.hidden_size,
+        intermediate_size=cfg.intermediate_size,
+        num_hidden_layers=cfg.num_layers,
+        num_attention_heads=cfg.num_heads,
+        num_key_value_heads=cfg.num_heads,
+        q_lora_rank=None, kv_lora_rank=cfg.kv_lora_rank,
+        qk_nope_head_dim=cfg.qk_nope_head_dim,
+        qk_rope_head_dim=cfg.qk_rope_head_dim,
+        v_head_dim=cfg.v_head_dim, head_dim=cfg.qk_rope_head_dim,
+        rms_norm_eps=cfg.rms_norm_eps,
+        n_routed_experts=cfg.n_routed_experts,
+        n_shared_experts=cfg.n_shared_experts,
+        moe_intermediate_size=cfg.moe_intermediate_size,
+        num_experts_per_tok=cfg.num_experts_per_tok,
+        first_k_dense_replace=cfg.first_k_dense_replace,
+        topk_method="group_limited_greedy", n_group=2, topk_group=1,
+        rope_scaling=None, attn_implementation="eager",
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(19)
+    model = DeepseekV2ForCausalLM(hf_cfg).eval()
+    params = params_from_torch_state_dict(model.state_dict(), cfg)
+
+    rng = np.random.default_rng(21)
+    toks = rng.integers(0, cfg.vocab_size, size=(2, 8)).astype(np.int32)
+    with torch.no_grad():
+        ref = model(torch.from_numpy(toks.astype(np.int64))).logits.numpy()
+    ours = _run_paged(cfg, params, toks)
+    np.testing.assert_allclose(ours, ref, rtol=5e-2, atol=5e-2)
+    assert (ours.argmax(-1) == ref.argmax(-1)).mean() > 0.9
