@@ -104,9 +104,11 @@ class MlaConfig:
     routed_scaling_factor: float = 1.0
     norm_topk_prob: bool = False
     capacity_factor: float = 2.0
-    #: "greedy" (V2-Lite) or "group_limited_greedy" (V2/V2-Chat): experts
-    #: are split into n_group groups, the top topk_group groups win (by
-    #: max expert score), and top-k selects within the winners only
+    #: "greedy" (V2-Lite), "group_limited_greedy" (V2/V2-Chat), or
+    #: "noaux_tc" (V3/R1: sigmoid scores + aux-loss-free bias-corrected
+    #: group routing). Groups rank by max member (V2) / top-2 sum (V3) of
+    #: the (bias-corrected, V3) scores; top-k selects within the winning
+    #: groups; V3 weights come from the UNcorrected sigmoid scores
     topk_method: str = "greedy"
     n_group: int = 1
     topk_group: int = 1
@@ -176,20 +178,25 @@ class MlaConfig:
                 "DeepSeek YaRN rope scaling is not implemented; refuse "
                 "rather than run a silently-wrong model"
             )
-        topk_method = hf.get("topk_method") or "greedy"
-        if topk_method not in ("greedy", "group_limited_greedy"):
-            raise ValueError(
-                f"unsupported topk_method {topk_method!r} (V3's "
-                "noaux_tc sigmoid gate is not implemented)"
-            )
-        if topk_method == "group_limited_greedy":
+        v3 = (
+            hf.get("model_type") == "deepseek_v3"
+            or "DeepseekV3ForCausalLM" in (hf.get("architectures") or [])
+        )
+        topk_method = hf.get("topk_method") or (
+            "noaux_tc" if v3 else "greedy"
+        )
+        if topk_method not in (
+            "greedy", "group_limited_greedy", "noaux_tc"
+        ):
+            raise ValueError(f"unsupported topk_method {topk_method!r}")
+        if topk_method in ("group_limited_greedy", "noaux_tc"):
             ng = int(hf.get("n_group") or 1)
             tg = int(hf.get("topk_group") or 1)
             ne = int(hf.get("n_routed_experts") or 0)
             # fail at load with a named error, not at trace with a shape one
             if ne % max(ng, 1) or tg > ng:
                 raise ValueError(
-                    f"group_limited_greedy needs n_group ({ng}) dividing "
+                    f"{topk_method} needs n_group ({ng}) dividing "
                     f"n_routed_experts ({ne}) and topk_group ({tg}) <= "
                     f"n_group"
                 )
@@ -295,6 +302,8 @@ def init_params(key: jax.Array, cfg: MlaConfig) -> dict:
             lp["w_router"] = jnp.stack(
                 [dense((h, e)) for _ in range(n_layers)]
             )
+            if cfg.topk_method == "noaux_tc":
+                lp["router_bias"] = jnp.zeros((n_layers, e), jnp.float32)
             for nm, shape in (
                 ("we_gate", (e, h, mi)), ("we_up", (e, h, mi)),
                 ("we_down", (e, mi, h)),
@@ -393,6 +402,17 @@ def params_from_torch_state_dict(state_dict, cfg: MlaConfig) -> dict:
         moe_lp["w_router"] = stack(
             moe_idx, "model.layers.{}.mlp.gate.weight"
         )  # HF gate.weight is [E, h]; transposed to [h, E]
+        if cfg.topk_method == "noaux_tc":
+            # keep FULL f32 precision: stack() would round-trip through
+            # cfg.dtype (bf16) and lose the tie-breaking bias bits that
+            # govern V3 expert selection
+            moe_lp["router_bias"] = jnp.asarray(
+                np.stack([
+                    t(f"model.layers.{l}.mlp.gate.e_score_correction_bias")
+                    for l in moe_idx
+                ]),
+                jnp.float32,
+            )
         for nm, hf_nm in (
             ("we_gate", "gate_proj"), ("we_up", "up_proj"),
             ("we_down", "down_proj"),
@@ -544,22 +564,41 @@ def _deepseek_moe_ffn(x: jax.Array, lp: dict, cfg: MlaConfig) -> jax.Array:
     xf = x.reshape(nt, h)
 
     logits = (xf.astype(jnp.float32)) @ lp["w_router"].astype(jnp.float32)
-    scores = jax.nn.softmax(logits, axis=-1)  # [N, E]
-    if cfg.topk_method == "group_limited_greedy":
-        # HF DeepseekV2MoEGate: rank expert GROUPS by their max member
-        # score, zero everything outside the top topk_group groups, then
-        # top-k within the winners.
+
+    def _group_mask(choice, rank_fn):
         g = cfg.n_group
-        group_scores = jnp.max(scores.reshape(nt, g, e // g), axis=-1)
+        group_scores = rank_fn(choice.reshape(nt, g, e // g))
         _, gidx = lax.top_k(group_scores, cfg.topk_group)  # [N, tg]
         gmask = jnp.sum(
             jax.nn.one_hot(gidx, g, dtype=jnp.float32), axis=1
         )  # [N, g]
-        emask = jnp.repeat(gmask, e // g, axis=-1)  # [N, E]
-        scores = scores * emask
-    topw, topi = lax.top_k(scores, k)
-    if cfg.norm_topk_prob:
-        topw = topw / jnp.sum(topw, axis=-1, keepdims=True)
+        return jnp.repeat(gmask, e // g, axis=-1)  # [N, E]
+
+    if cfg.topk_method == "noaux_tc":
+        # HF DeepseekV3TopkRouter: sigmoid scores; groups rank by the SUM
+        # of their top-2 bias-corrected scores; selection uses corrected
+        # scores, weights use the uncorrected ones.
+        scores = jax.nn.sigmoid(logits)
+        choice = scores + lp["router_bias"][None, :]
+        choice = choice * _group_mask(
+            choice,
+            lambda gc: jnp.sum(lax.top_k(gc, min(2, e // cfg.n_group))[0],
+                               axis=-1),
+        )
+        _, topi = lax.top_k(choice, k)
+        topw = jnp.take_along_axis(scores, topi, axis=-1)
+        if cfg.norm_topk_prob:
+            topw = topw / (jnp.sum(topw, axis=-1, keepdims=True) + 1e-20)
+    else:
+        scores = jax.nn.softmax(logits, axis=-1)  # [N, E]
+        if cfg.topk_method == "group_limited_greedy":
+            # HF DeepseekV2MoEGate: groups rank by their max member score
+            scores = scores * _group_mask(
+                scores, lambda gc: jnp.max(gc, axis=-1)
+            )
+        topw, topi = lax.top_k(scores, k)
+        if cfg.norm_topk_prob:
+            topw = topw / jnp.sum(topw, axis=-1, keepdims=True)
     topw = topw * cfg.routed_scaling_factor
 
     cap = max(1, int(math.ceil(k * nt / e * cfg.capacity_factor)))
@@ -718,6 +757,11 @@ def mla_param_specs(cfg: MlaConfig, quantized: bool = False):
         else:
             specs.update(
                 w_router=P(),
+                **(
+                    {"router_bias": P()}
+                    if cfg.topk_method == "noaux_tc"
+                    else {}
+                ),
                 we_gate=P(None, "ep", None, None),
                 we_up=P(None, "ep", None, None),
                 we_down=P(None, "ep", None, None),
@@ -838,6 +882,8 @@ def init_params_int8(key: jax.Array, cfg: MlaConfig) -> dict:
             lp["w_router"] = jnp.stack(
                 [dense((h, e)) for _ in range(n_layers)]
             )
+            if cfg.topk_method == "noaux_tc":
+                lp["router_bias"] = jnp.zeros((n_layers, e), jnp.float32)
             for nm, shape in (
                 ("we_gate", (e, h, mi)), ("we_up", (e, h, mi)),
                 ("we_down", (e, mi, h)),

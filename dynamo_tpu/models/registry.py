@@ -262,8 +262,8 @@ def get_model(
         if "mixtral" in arch.lower():
             moe_cfg = MoeConfig.from_hf_config(hf)
         elif (
-            arch == "DeepseekV2ForCausalLM"
-            or hf.get("model_type") == "deepseek_v2"
+            arch in ("DeepseekV2ForCausalLM", "DeepseekV3ForCausalLM")
+            or hf.get("model_type") in ("deepseek_v2", "deepseek_v3")
         ):
             mla_cfg = MlaConfig.from_hf_config(hf)
         elif (

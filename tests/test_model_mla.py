@@ -301,3 +301,86 @@ def test_mla_moe_group_limited_greedy_against_hf():
     ours = _run_paged(cfg, params, toks)
     np.testing.assert_allclose(ours, ref, rtol=5e-2, atol=5e-2)
     assert (ours.argmax(-1) == ref.argmax(-1)).mean() > 0.9
+
+
+def test_mla_v3_noaux_gate_against_hf():
+    """DeepSeek-V3/R1 routing: sigmoid scores, bias-corrected top-2-sum
+    group ranking, weights from uncorrected scores, normalized + scaled."""
+    torch = pytest.importorskip("torch")
+    from transformers import DeepseekV3Config, DeepseekV3ForCausalLM
+
+    cfg = replace(
+        MlaConfig.tiny_moe(),
+        q_lora_rank=24,
+        topk_method="noaux_tc", n_group=2, topk_group=2,
+        norm_topk_prob=True, routed_scaling_factor=2.5,
+    )
+    hf_cfg = DeepseekV3Config(
+        vocab_size=cfg.vocab_size, hidden_size=cfg.hidden_size,
+        intermediate_size=cfg.intermediate_size,
+        num_hidden_layers=cfg.num_layers,
+        num_attention_heads=cfg.num_heads,
+        num_key_value_heads=cfg.num_heads,
+        q_lora_rank=cfg.q_lora_rank, kv_lora_rank=cfg.kv_lora_rank,
+        qk_nope_head_dim=cfg.qk_nope_head_dim,
+        qk_rope_head_dim=cfg.qk_rope_head_dim,
+        v_head_dim=cfg.v_head_dim, head_dim=cfg.qk_rope_head_dim,
+        rms_norm_eps=cfg.rms_norm_eps,
+        n_routed_experts=cfg.n_routed_experts,
+        n_shared_experts=cfg.n_shared_experts,
+        moe_intermediate_size=cfg.moe_intermediate_size,
+        num_experts_per_tok=cfg.num_experts_per_tok,
+        first_k_dense_replace=cfg.first_k_dense_replace,
+        n_group=2, topk_group=2, norm_topk_prob=True,
+        routed_scaling_factor=2.5,
+        rope_scaling=None, rope_interleave=True,
+        attn_implementation="eager", tie_word_embeddings=False,
+    )
+    torch.manual_seed(29)
+    model = DeepseekV3ForCausalLM(hf_cfg).eval()
+    # give the correction bias real values (zeros would under-test it)
+    with torch.no_grad():
+        for layer in model.model.layers[cfg.first_k_dense_replace:]:
+            layer.mlp.gate.e_score_correction_bias.uniform_(-0.5, 0.5)
+    params = params_from_torch_state_dict(model.state_dict(), cfg)
+    assert "router_bias" in params["moe_layers"]
+
+    rng = np.random.default_rng(33)
+    toks = rng.integers(0, cfg.vocab_size, size=(2, 8)).astype(np.int32)
+    with torch.no_grad():
+        ref = model(torch.from_numpy(toks.astype(np.int64))).logits.numpy()
+    ours = _run_paged(cfg, params, toks)
+    np.testing.assert_allclose(ours, ref, rtol=5e-2, atol=5e-2)
+    assert (ours.argmax(-1) == ref.argmax(-1)).mean() > 0.9
+
+
+def test_mla_param_specs_cover_every_leaf():
+    """Sharded init does jax.device_put(params, tree_map(specs)) — a spec
+    pytree missing any param leaf (e.g. router_bias) crashes engine init
+    on a mesh. Assert structural match for every config variant."""
+    import jax
+
+    from dynamo_tpu.models.mla import mla_param_specs, quantize_params_int8
+
+    for cfg in (
+        MlaConfig.tiny(),
+        MlaConfig.tiny_moe(),
+        replace(
+            MlaConfig.tiny_moe(), q_lora_rank=24, topk_method="noaux_tc",
+            n_group=2, topk_group=2, norm_topk_prob=True,
+        ),
+    ):
+        params = init_params(jax.random.key(0), cfg)
+        for quantized, tree in (
+            (False, params),
+            (True, quantize_params_int8(params)),
+        ):
+            specs = mla_param_specs(cfg, quantized=quantized)
+            ts_p = jax.tree.structure(tree)
+            ts_s = jax.tree.structure(
+                specs, is_leaf=lambda x: not isinstance(x, dict)
+            )
+            assert ts_p == ts_s, (
+                f"specs/params mismatch for {cfg.topk_method} "
+                f"quantized={quantized}:\n{ts_p}\nvs\n{ts_s}"
+            )
