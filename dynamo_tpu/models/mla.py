@@ -91,6 +91,15 @@ class MlaConfig:
     qk_rope_head_dim: int = 8
     v_head_dim: int = 16
     rope_theta: float = 10000.0
+    #: DeepSeek-YaRN rope scaling (None disables): matches HF's
+    #: _compute_yarn_parameters + the V2/V3 practice of scaling the
+    #: rotary cos/sin by the attention factor
+    rope_scaling_factor: Optional[float] = None
+    rope_beta_fast: float = 32.0
+    rope_beta_slow: float = 1.0
+    rope_mscale: Optional[float] = None
+    rope_mscale_all_dim: Optional[float] = None
+    rope_original_max_position: int = 4096
     rms_norm_eps: float = 1e-6
     tie_word_embeddings: bool = False
     dtype: Any = jnp.bfloat16
@@ -173,10 +182,11 @@ class MlaConfig:
 
     @staticmethod
     def from_hf_config(hf: dict) -> "MlaConfig":
-        if hf.get("rope_scaling"):
+        rs = hf.get("rope_scaling") or {}
+        if rs and rs.get("rope_type", rs.get("type")) != "yarn":
             raise ValueError(
-                "DeepSeek YaRN rope scaling is not implemented; refuse "
-                "rather than run a silently-wrong model"
+                f"unsupported rope_scaling {rs!r} for DeepSeek (only "
+                "yarn is implemented)"
             )
         v3 = (
             hf.get("model_type") == "deepseek_v3"
@@ -212,6 +222,17 @@ class MlaConfig:
             qk_rope_head_dim=hf["qk_rope_head_dim"],
             v_head_dim=hf["v_head_dim"],
             rope_theta=float(hf.get("rope_theta", 10000.0)),
+            rope_scaling_factor=(
+                float(rs["factor"]) if rs else None
+            ),
+            rope_beta_fast=float(rs.get("beta_fast") or 32.0),
+            rope_beta_slow=float(rs.get("beta_slow") or 1.0),
+            rope_mscale=rs.get("mscale"),
+            rope_mscale_all_dim=rs.get("mscale_all_dim"),
+            rope_original_max_position=int(
+                rs.get("original_max_position_embeddings")
+                or hf.get("max_position_embeddings", 4096)
+            ),
             rms_norm_eps=float(hf.get("rms_norm_eps", 1e-6)),
             tie_word_embeddings=bool(hf.get("tie_word_embeddings", False)),
             n_routed_experts=int(hf.get("n_routed_experts") or 0),
@@ -459,14 +480,61 @@ def params_from_torch_state_dict(state_dict, cfg: MlaConfig) -> dict:
 # ---------------------------------------------------------------------------
 
 
-def _interleaved_rope(x: jax.Array, positions: jax.Array, theta: float):
+def _yarn_inv_freq_and_factor(cfg: MlaConfig, d: int):
+    """HF _compute_yarn_parameters: blend interpolated/extrapolated
+    inverse frequencies with a linear ramp between the beta_fast/slow
+    correction dims; the attention factor (mscale ratio, or
+    0.1*ln(factor)+1) scales the rotary cos/sin — exactly how the HF
+    DeepSeek rotary applies it (freqs_cis * attention_scaling)."""
+    import numpy as np
+
+    base, factor = cfg.rope_theta, cfg.rope_scaling_factor
+    pos_freqs = base ** (np.arange(0, d, 2, dtype=np.float64) / d)
+    extrap = 1.0 / pos_freqs
+    interp = 1.0 / (factor * pos_freqs)
+
+    def corr_dim(rot):
+        return (
+            d
+            * math.log(cfg.rope_original_max_position / (rot * 2 * math.pi))
+        ) / (2 * math.log(base))
+
+    low = max(math.floor(corr_dim(cfg.rope_beta_fast)), 0)
+    high = min(math.ceil(corr_dim(cfg.rope_beta_slow)), d - 1)
+    if low == high:
+        high += 0.001
+    ramp = np.clip(
+        (np.arange(d // 2, dtype=np.float64) - low) / (high - low), 0, 1
+    )
+    extrap_factor = 1.0 - ramp
+    inv = interp * (1 - extrap_factor) + extrap * extrap_factor
+
+    def get_mscale(scale, m=1.0):
+        return 1.0 if scale <= 1 else 0.1 * m * math.log(scale) + 1.0
+
+    if cfg.rope_mscale and cfg.rope_mscale_all_dim:
+        att = get_mscale(factor, cfg.rope_mscale) / get_mscale(
+            factor, cfg.rope_mscale_all_dim
+        )
+    else:
+        att = get_mscale(factor)
+    return jnp.asarray(inv, jnp.float32), float(att)
+
+
+def _interleaved_rope(x: jax.Array, positions: jax.Array, cfg: MlaConfig):
     """DeepSeek rope: adjacent pairs (x[2j], x[2j+1]) rotate as complex
     numbers (modeling_deepseek_v2.apply_rotary_emb) — unlike Llama's
     half-split pairing. x: [B, T, ..., D], positions [B, T]."""
     d = x.shape[-1]
-    inv = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    if cfg.rope_scaling_factor:
+        inv, att = _yarn_inv_freq_and_factor(cfg, d)
+    else:
+        inv = 1.0 / (
+            cfg.rope_theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d)
+        )
+        att = 1.0
     freqs = positions.astype(jnp.float32)[..., None] * inv  # [B,T,d/2]
-    cos, sin = jnp.cos(freqs), jnp.sin(freqs)
+    cos, sin = jnp.cos(freqs) * att, jnp.sin(freqs) * att
     extra = x.ndim - 3  # broadcast over any head axes between T and D
     for _ in range(extra):
         cos, sin = cos[..., None, :], sin[..., None, :]
@@ -505,13 +573,13 @@ def mla_attention(
     else:
         q = _mm(x, lp, "wq", cfg.dtype).reshape(b, t, hn, cfg.qk_head_dim)
     q_nope, q_pe = q[..., :n], q[..., n:]
-    q_pe = _interleaved_rope(q_pe, positions, cfg.rope_theta)
+    q_pe = _interleaved_rope(q_pe, positions, cfg)
 
     kv_a = _mm(x, lp, "wkv_a", cfg.dtype)  # [B,T,c+r]
     c_kv = rms_norm(
         kv_a[..., :c].astype(cfg.dtype), lp["kv_a_norm"], cfg.rms_norm_eps
     )
-    k_pe = _interleaved_rope(kv_a[..., c:], positions, cfg.rope_theta)
+    k_pe = _interleaved_rope(kv_a[..., c:], positions, cfg)
 
     # Land this chunk's latent + rope key, then attend over the gathered
     # (history + current) cache — same scatter-then-gather discipline as

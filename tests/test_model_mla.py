@@ -174,7 +174,9 @@ def test_mla_serves_through_engine():
     assert all(len(v) == 5 for v in done.values()), done
 
 
-def test_mla_yarn_config_refused(tmp_path):
+def test_mla_yarn_config_resolves(tmp_path):
+    """YaRN rope-scaling configs (the released R1/V2 shape) load; other
+    rope_scaling types stay refused by name."""
     import json
 
     from dynamo_tpu.models.registry import get_model
@@ -188,10 +190,82 @@ def test_mla_yarn_config_refused(tmp_path):
         "num_hidden_layers": 2, "num_attention_heads": 4,
         "kv_lora_rank": 32, "qk_nope_head_dim": 16,
         "qk_rope_head_dim": 8, "v_head_dim": 16,
-        "rope_scaling": {"type": "yarn", "factor": 40},
+        "rope_scaling": {"type": "yarn", "factor": 40, "mscale": 1.0,
+                         "mscale_all_dim": 1.0,
+                         "original_max_position_embeddings": 4096},
     }))
-    with pytest.raises(ValueError, match="YaRN"):
-        get_model(str(d))
+    c = get_model(str(d), dtype="float32").config
+    assert c.rope_scaling_factor == 40.0
+    assert c.rope_original_max_position == 4096
+
+    d2 = tmp_path / "ds2"
+    d2.mkdir()
+    (d2 / "config.json").write_text(json.dumps({
+        "architectures": ["DeepseekV2ForCausalLM"],
+        "model_type": "deepseek_v2",
+        "vocab_size": 256, "hidden_size": 64, "intermediate_size": 128,
+        "num_hidden_layers": 2, "num_attention_heads": 4,
+        "kv_lora_rank": 32, "qk_nope_head_dim": 16,
+        "qk_rope_head_dim": 8, "v_head_dim": 16,
+        "rope_scaling": {"type": "linear", "factor": 4},
+    }))
+    with pytest.raises(ValueError, match="rope_scaling"):
+        get_model(str(d2))
+
+
+def test_mla_yarn_against_hf():
+    """YaRN-scaled rope (interp/extrap ramp + mscale-scaled cos/sin) vs
+    HF with an original_max_position SMALLER than the sequence, so the
+    scaling demonstrably bites."""
+    cfg = replace(
+        MlaConfig.tiny(),
+        rope_scaling_factor=4.0,
+        rope_beta_fast=32.0,
+        rope_beta_slow=1.0,
+        rope_mscale=1.0,
+        rope_mscale_all_dim=0.8,
+        rope_original_max_position=8,
+    )
+    torch = pytest.importorskip("torch")
+    from transformers import DeepseekV2Config, DeepseekV2ForCausalLM
+
+    hf_cfg = DeepseekV2Config(
+        vocab_size=cfg.vocab_size, hidden_size=cfg.hidden_size,
+        intermediate_size=cfg.intermediate_size,
+        num_hidden_layers=cfg.num_layers,
+        num_attention_heads=cfg.num_heads,
+        num_key_value_heads=cfg.num_heads,
+        q_lora_rank=None, kv_lora_rank=cfg.kv_lora_rank,
+        qk_nope_head_dim=cfg.qk_nope_head_dim,
+        qk_rope_head_dim=cfg.qk_rope_head_dim,
+        v_head_dim=cfg.v_head_dim, head_dim=cfg.qk_rope_head_dim,
+        rope_theta=cfg.rope_theta, rms_norm_eps=cfg.rms_norm_eps,
+        n_routed_experts=None, first_k_dense_replace=cfg.num_layers,
+        tie_word_embeddings=False, attn_implementation="eager",
+        max_position_embeddings=64,
+        rope_scaling={
+            "rope_type": "yarn", "factor": 4.0, "beta_fast": 32,
+            "beta_slow": 1, "mscale": 1.0, "mscale_all_dim": 0.8,
+            "original_max_position_embeddings": 8, "truncate": True,
+        },
+    )
+    torch.manual_seed(41)
+    model = DeepseekV2ForCausalLM(hf_cfg).eval()
+    params = params_from_torch_state_dict(model.state_dict(), cfg)
+
+    rng = np.random.default_rng(43)
+    toks = rng.integers(0, cfg.vocab_size, size=(2, 12)).astype(np.int32)
+    with torch.no_grad():
+        ref = model(torch.from_numpy(toks.astype(np.int64))).logits.numpy()
+    ours = _run_paged(cfg, params, toks)
+    np.testing.assert_allclose(ours, ref, rtol=5e-2, atol=5e-2)
+    assert (ours.argmax(-1) == ref.argmax(-1)).mean() > 0.95
+
+    # yarn genuinely differs from plain rope on this sequence
+    plain = _run_paged(
+        replace(cfg, rope_scaling_factor=None), params, toks
+    )
+    assert not np.allclose(plain, ours)
 
 
 @pytest.mark.parametrize("quantize", [None, "int8"])
