@@ -26,8 +26,10 @@ decompression of the history, FLOPs independent of kv_b:
 
 RoPE here is the DeepSeek complex-interleaved pairing (adjacent elements
 (x[2j], x[2j+1]) rotate together — modeling_deepseek_v2.apply_rotary_emb)
-— NOT the Llama half-split. YaRN rope scaling is not implemented; configs
-requesting it are refused.
+— NOT the Llama half-split. DeepSeek-YaRN rope scaling is implemented
+(interp/extrap frequency ramp; the attention factor scales the rotary
+cos/sin, and V3/R1 configs additionally scale the softmax by
+yarn_mscale(factor, mscale_all_dim)^2 — both matching HF).
 
 MoE layers follow HF DeepseekV2MoE semantics: softmax gate -> greedy
 top-k (weights NOT renormalized unless norm_topk_prob) scaled by
@@ -100,6 +102,10 @@ class MlaConfig:
     rope_mscale: Optional[float] = None
     rope_mscale_all_dim: Optional[float] = None
     rope_original_max_position: int = 4096
+    #: V3/R1: softmax scale additionally multiplies by
+    #: yarn_mscale(factor, mscale_all_dim)^2 (DeepseekV3Attention); the
+    #: integrated HF V2 port does NOT — gate per generation
+    rope_mscale_softmax: bool = False
     rms_norm_eps: float = 1e-6
     tie_word_embeddings: bool = False
     dtype: Any = jnp.bfloat16
@@ -125,6 +131,22 @@ class MlaConfig:
     @property
     def qk_head_dim(self) -> int:
         return self.qk_nope_head_dim + self.qk_rope_head_dim
+
+    @property
+    def softmax_scale(self) -> float:
+        s = 1.0 / math.sqrt(self.qk_head_dim)
+        if (
+            self.rope_mscale_softmax
+            and self.rope_scaling_factor
+            and self.rope_scaling_factor > 1
+            and self.rope_mscale_all_dim
+        ):
+            m = (
+                0.1 * self.rope_mscale_all_dim
+                * math.log(self.rope_scaling_factor) + 1.0
+            )
+            s *= m * m
+        return s
 
     @property
     def cache_dim(self) -> int:
@@ -155,8 +177,8 @@ class MlaConfig:
     def deepseek_v2_lite() -> "MlaConfig":
         """DeepSeek-V2-Lite (15.7B total / 2.4B active): MLA with direct q,
         layer 0 dense, 26 MoE layers of 64 routed (top-6, greedy) + 2
-        shared experts. NOTE: released weights use YaRN rope scaling which
-        is not implemented — random-weight serving/benching only."""
+        shared experts. Plain-rope shape for random-weight benching; real
+        checkpoints load their YaRN fields from config.json."""
         return MlaConfig(
             vocab_size=102400, hidden_size=2048, intermediate_size=10944,
             num_layers=27, num_heads=16, q_lora_rank=None,
@@ -187,6 +209,10 @@ class MlaConfig:
             raise ValueError(
                 f"unsupported rope_scaling {rs!r} for DeepSeek (only "
                 "yarn is implemented)"
+            )
+        if rs and rs.get("factor") is None:
+            raise ValueError(
+                "yarn rope_scaling needs an explicit 'factor'"
             )
         v3 = (
             hf.get("model_type") == "deepseek_v3"
@@ -233,6 +259,7 @@ class MlaConfig:
                 rs.get("original_max_position_embeddings")
                 or hf.get("max_position_embeddings", 4096)
             ),
+            rope_mscale_softmax=v3,
             rms_norm_eps=float(hf.get("rms_norm_eps", 1e-6)),
             tie_word_embeddings=bool(hf.get("tie_word_embeddings", False)),
             n_routed_experts=int(hf.get("n_routed_experts") or 0),
@@ -597,7 +624,7 @@ def mla_attention(
     wkv_b = _w(lp, "wkv_b", jnp.float32).reshape(c, hn, n + vd)
     w_uk, w_uv = wkv_b[..., :n], wkv_b[..., n:]
 
-    scale = 1.0 / math.sqrt(cfg.qk_head_dim)
+    scale = cfg.softmax_scale
     q_lat = jnp.einsum(
         "bthn,chn->bthc", q_nope.astype(jnp.float32),
         w_uk.astype(jnp.float32),

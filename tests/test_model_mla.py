@@ -458,3 +458,62 @@ def test_mla_param_specs_cover_every_leaf():
                 f"specs/params mismatch for {cfg.topk_method} "
                 f"quantized={quantized}:\n{ts_p}\nvs\n{ts_s}"
             )
+
+
+def test_mla_v3_yarn_mscale_softmax_against_hf():
+    """V3/R1 YaRN: HF's DeepseekV3Attention multiplies the softmax scale
+    by yarn_mscale(factor, mscale_all_dim)^2 (the V2 integrated port does
+    not) — with mscale == mscale_all_dim the rotary attention factor is
+    1.0, so ONLY the softmax adjustment distinguishes right from wrong."""
+    torch = pytest.importorskip("torch")
+    from transformers import DeepseekV3Config, DeepseekV3ForCausalLM
+
+    cfg = replace(
+        MlaConfig.tiny(),
+        q_lora_rank=24,
+        rope_scaling_factor=40.0,
+        rope_mscale=1.0,
+        rope_mscale_all_dim=1.0,
+        rope_original_max_position=8,
+        rope_mscale_softmax=True,
+    )
+    assert abs(cfg.softmax_scale * (cfg.qk_head_dim ** 0.5) - 1.869) < 0.01
+    hf_cfg = DeepseekV3Config(
+        vocab_size=cfg.vocab_size, hidden_size=cfg.hidden_size,
+        intermediate_size=cfg.intermediate_size,
+        num_hidden_layers=cfg.num_layers,
+        num_attention_heads=cfg.num_heads,
+        num_key_value_heads=cfg.num_heads,
+        q_lora_rank=cfg.q_lora_rank, kv_lora_rank=cfg.kv_lora_rank,
+        qk_nope_head_dim=cfg.qk_nope_head_dim,
+        qk_rope_head_dim=cfg.qk_rope_head_dim,
+        v_head_dim=cfg.v_head_dim, head_dim=cfg.qk_rope_head_dim,
+        rms_norm_eps=cfg.rms_norm_eps,
+        n_routed_experts=8, n_shared_experts=1,
+        moe_intermediate_size=32, num_experts_per_tok=2,
+        n_group=2, topk_group=2, norm_topk_prob=True,
+        routed_scaling_factor=2.5,
+        first_k_dense_replace=cfg.num_layers,  # all dense: isolate rope
+        tie_word_embeddings=False, attn_implementation="eager",
+        max_position_embeddings=64, rope_interleave=True,
+        rope_scaling={
+            "rope_type": "yarn", "factor": 40.0, "beta_fast": 32,
+            "beta_slow": 1, "mscale": 1.0, "mscale_all_dim": 1.0,
+            "original_max_position_embeddings": 8, "truncate": True,
+        },
+    )
+    torch.manual_seed(47)
+    model = DeepseekV3ForCausalLM(hf_cfg).eval()
+    params = params_from_torch_state_dict(model.state_dict(), cfg)
+
+    rng = np.random.default_rng(51)
+    toks = rng.integers(0, cfg.vocab_size, size=(2, 12)).astype(np.int32)
+    with torch.no_grad():
+        ref = model(torch.from_numpy(toks.astype(np.int64))).logits.numpy()
+    ours = _run_paged(cfg, params, toks)
+    np.testing.assert_allclose(ours, ref, rtol=5e-2, atol=5e-2)
+    assert (ours.argmax(-1) == ref.argmax(-1)).mean() > 0.95
+
+    # without the softmax adjustment the logits demonstrably diverge
+    wrong = _run_paged(replace(cfg, rope_mscale_softmax=False), params, toks)
+    assert not np.allclose(wrong, ours, atol=1e-3)
