@@ -64,6 +64,9 @@ class LlamaConfig:
     pallas_decode_max_batch: int = 32
     #: q/k/v projection bias — the Qwen2 family's one architectural delta
     attention_bias: bool = False
+    #: Qwen3: per-head RMSNorm on q and k (head_dim-wide), applied after
+    #: the projections, before rope
+    qk_norm: bool = False
     #: MLP activation: "silu" (Llama/Qwen GLU) or "gelu_tanh" (Gemma GeGLU)
     hidden_act: str = "silu"
     #: Gemma-style RMSNorm: scale by (1 + weight) instead of weight
@@ -179,6 +182,15 @@ class LlamaConfig:
         )
 
     @staticmethod
+    def qwen3_8b() -> "LlamaConfig":
+        """Qwen3-8B: Llama architecture + per-head q/k RMSNorm, no bias."""
+        return LlamaConfig(
+            vocab_size=151936, hidden_size=4096, intermediate_size=12288,
+            num_layers=36, num_heads=32, num_kv_heads=8, head_dim=128,
+            rope_theta=1000000.0, rms_norm_eps=1e-6, qk_norm=True,
+        )
+
+    @staticmethod
     def mistral_7b() -> "LlamaConfig":
         """Mistral-7B-v0.1: Llama architecture + sliding-window attention
         on every layer (window 4096)."""
@@ -213,6 +225,14 @@ class LlamaConfig:
         if rope_scaling.get("rope_type", rope_scaling.get("type")) == "llama3":
             factor = float(rope_scaling["factor"])
         head_dim = hf.get("head_dim") or hf["hidden_size"] // hf["num_attention_heads"]
+        rs_type = rope_scaling.get("rope_type", rope_scaling.get("type"))
+        if rope_scaling and rs_type != "llama3":
+            # refuse rather than run long-context positions unscaled
+            # (e.g. Qwen3's recommended yarn setup for >32k)
+            raise ValueError(
+                f"unsupported rope_scaling type {rs_type!r} for this "
+                "family (only llama3 NTK scaling is implemented)"
+            )
         gemma2 = hf.get("model_type") == "gemma2" or arch == "Gemma2ForCausalLM"
         gemma = (
             hf.get("model_type") == "gemma"
@@ -222,6 +242,8 @@ class LlamaConfig:
         mistral = (
             hf.get("model_type") == "mistral" or arch == "MistralForCausalLM"
         )
+        qwen3 = hf.get("model_type") == "qwen3" or arch == "Qwen3ForCausalLM"
+
         hidden_act = hf.get("hidden_activation") or hf.get("hidden_act", "silu")
         if hidden_act in ("gelu_pytorch_tanh", "gelu_tanh", "gelu"):
             hidden_act = "gelu_tanh"
@@ -236,6 +258,7 @@ class LlamaConfig:
             attention_bias=bool(
                 hf.get("attention_bias", arch == "Qwen2ForCausalLM")
             ),
+            qk_norm=qwen3,
             hidden_act=hidden_act,
             rms_norm_unit_offset=gemma,
             scale_embeddings=gemma,
@@ -349,6 +372,9 @@ def init_params(key: jax.Array, cfg: LlamaConfig) -> dict:
         params["layers"]["bq"] = jnp.zeros((L, qd), cfg.dtype)
         params["layers"]["bk"] = jnp.zeros((L, kvd), cfg.dtype)
         params["layers"]["bv"] = jnp.zeros((L, kvd), cfg.dtype)
+    if cfg.qk_norm:
+        params["layers"]["q_norm"] = norm_init((L, cfg.head_dim))
+        params["layers"]["k_norm"] = norm_init((L, cfg.head_dim))
     if cfg.post_block_norms:
         params["layers"]["post_attn_norm"] = norm_init((L, h))
         params["layers"]["post_mlp_norm"] = norm_init((L, h))
@@ -399,6 +425,13 @@ def params_from_torch_state_dict(state_dict, cfg: LlamaConfig) -> dict:
         },
         "final_norm": jnp.asarray(t("model.norm.weight"), cfg.dtype),
     }
+    if cfg.qk_norm:
+        params["layers"]["q_norm"] = stack(
+            "model.layers.{}.self_attn.q_norm.weight", transpose=False
+        )
+        params["layers"]["k_norm"] = stack(
+            "model.layers.{}.self_attn.k_norm.weight", transpose=False
+        )
     if cfg.post_block_norms:
         params["layers"]["post_attn_norm"] = stack(
             "model.layers.{}.post_attention_layernorm.weight", transpose=False
@@ -531,6 +564,9 @@ def init_params_int8(key: jax.Array, cfg: LlamaConfig) -> dict:
         "attn_norm": jnp.ones((L, h), cfg.dtype),
         "mlp_norm": jnp.ones((L, h), cfg.dtype),
     }
+    if cfg.qk_norm:
+        layers["q_norm"] = jnp.ones((L, cfg.head_dim), cfg.dtype)
+        layers["k_norm"] = jnp.ones((L, cfg.head_dim), cfg.dtype)
     for name, k, din, dout in (
         ("wq", keys[1], h, qd), ("wk", keys[2], h, kvd),
         ("wv", keys[3], h, kvd), ("wo", keys[4], qd, h),
@@ -1033,6 +1069,9 @@ def forward_hidden(
         q = q.reshape(b, t, cfg.num_heads, cfg.head_dim)
         k = k.reshape(b, t, cfg.num_kv_heads, cfg.head_dim)
         v = v.reshape(b, t, cfg.num_kv_heads, cfg.head_dim)
+        if cfg.qk_norm:  # Qwen3: head_dim-wide RMSNorm pre-rope
+            q = rms_norm(q, lp["q_norm"], cfg.rms_norm_eps, off)
+            k = rms_norm(k, lp["k_norm"], cfg.rms_norm_eps, off)
         attn, k_full, v_full, staged = attention_block(
             q, k, v, k_full, v_full, li, page_tables, positions, valid, cfg,
             first_chunk=first_chunk, mesh=mesh,

@@ -104,7 +104,9 @@ class MlaConfig:
     rope_original_max_position: int = 4096
     #: V3/R1: softmax scale additionally multiplies by
     #: yarn_mscale(factor, mscale_all_dim)^2 (DeepseekV3Attention); the
-    #: integrated HF V2 port does NOT — gate per generation
+    #: integrated HF V2 port does NOT, so V2 configs default False — but
+    #: deepseek-ai's ORIGINAL remote code applies it for V2 too; set True
+    #: to match such a checkpoint's training-time semantics
     rope_mscale_softmax: bool = False
     rms_norm_eps: float = 1e-6
     tie_word_embeddings: bool = False

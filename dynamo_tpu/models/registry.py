@@ -67,6 +67,8 @@ _LLAMA_PRESETS: dict[str, Callable[[], LlamaConfig]] = {
     "gemma2-2b": LlamaConfig.gemma2_2b,
     # Mistral = Llama + sliding-window attention on every layer.
     "mistral-7b": LlamaConfig.mistral_7b,
+    # Qwen3 = Llama + per-head q/k RMSNorm (no attention bias).
+    "qwen3-8b": LlamaConfig.qwen3_8b,
 }
 
 
@@ -270,9 +272,10 @@ def get_model(
             "llama" in arch.lower()
             or "qwen2" in arch.lower()
             or arch in (
-                "GemmaForCausalLM", "Gemma2ForCausalLM", "MistralForCausalLM"
+                "GemmaForCausalLM", "Gemma2ForCausalLM",
+                "MistralForCausalLM", "Qwen3ForCausalLM",
             )
-            or hf.get("model_type") in ("gemma", "gemma2", "mistral")
+            or hf.get("model_type") in ("gemma", "gemma2", "mistral", "qwen3")
             # Gemma 3 and RecurrentGemma remain different architectures —
             # refuse those rather than run a silently-wrong model.
         ):

@@ -46,6 +46,10 @@ def llama_param_specs(cfg: LlamaConfig, quantized: bool = False) -> dict:
         specs["layers"]["bq"] = P(None, "tp")
         specs["layers"]["bk"] = P(None, "tp")
         specs["layers"]["bv"] = P(None, "tp")
+    if getattr(cfg, "qk_norm", False):
+        # per-head-dim norms apply identically on every (tp-sharded) head
+        specs["layers"]["q_norm"] = P(None, None)
+        specs["layers"]["k_norm"] = P(None, None)
     if quantized:
         # int8 per-output-channel scales [L, 1, out] shard with their
         # weight's output dim (w_down's output is the unsharded hidden)
