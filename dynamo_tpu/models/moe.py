@@ -41,13 +41,28 @@ from dynamo_tpu.models.llama import (
 
 @dataclass(frozen=True)
 class MoeConfig:
-    """Mixtral shape: Llama attention + MoE FFN."""
+    """Mixtral shape: Llama attention + MoE FFN. The Qwen3-MoE family is
+    the same block with qk_norm on the base, a separate expert MLP width,
+    different HF tensor names, and the norm_topk_prob flag HF documents
+    as "only diff with mixtral"."""
 
     base: LlamaConfig = field(default_factory=LlamaConfig)
     num_experts: int = 8
     top_k: int = 2
     #: per-expert capacity = ceil(top_k * tokens / num_experts) * factor
     capacity_factor: float = 2.0
+    #: renormalize the top-k weights to sum 1 (Mixtral always does;
+    #: Qwen3-MoE gates it on config.norm_topk_prob)
+    norm_topk_prob: bool = True
+    #: expert MLP width (None: base.intermediate_size — Mixtral)
+    expert_intermediate_size: Optional[int] = None
+    #: HF tensor naming: "mixtral" (block_sparse_moe.experts.N.w1/w2/w3)
+    #: or "qwen3_moe" (mlp.experts.N.gate/up/down_proj)
+    hf_naming: str = "mixtral"
+
+    @property
+    def expert_width(self) -> int:
+        return self.expert_intermediate_size or self.base.intermediate_size
 
     @staticmethod
     def mixtral_8x7b() -> "MoeConfig":
@@ -68,8 +83,43 @@ class MoeConfig:
         )
 
     @staticmethod
+    def qwen3_moe_30b() -> "MoeConfig":
+        """Qwen3-30B-A3B: qk-norm attention + 128 experts (top-8,
+        renormalized), expert width 768."""
+        return MoeConfig(
+            base=LlamaConfig(
+                vocab_size=151936, hidden_size=2048, intermediate_size=6144,
+                num_layers=48, num_heads=32, num_kv_heads=4, head_dim=128,
+                rope_theta=1000000.0, rms_norm_eps=1e-6, qk_norm=True,
+            ),
+            num_experts=128, top_k=8, norm_topk_prob=True,
+            expert_intermediate_size=768, hf_naming="qwen3_moe",
+        )
+
+    @staticmethod
     def from_hf_config(hf: dict) -> "MoeConfig":
         base = LlamaConfig.from_hf_config(hf)
+        qwen3_moe = (
+            hf.get("model_type") == "qwen3_moe"
+            or "Qwen3MoeForCausalLM" in (hf.get("architectures") or [])
+        )
+        if qwen3_moe:
+            if hf.get("mlp_only_layers") or hf.get("decoder_sparse_step", 1) != 1:
+                raise ValueError(
+                    "qwen3_moe dense-layer interleaving (mlp_only_layers/"
+                    "decoder_sparse_step) is not implemented"
+                )
+            return MoeConfig(
+                base=base,
+                num_experts=int(hf.get("num_experts", 128)),
+                top_k=int(hf.get("num_experts_per_tok", 8)),
+                norm_topk_prob=bool(hf.get("norm_topk_prob", False)),
+                expert_intermediate_size=int(
+                    hf.get("moe_intermediate_size")
+                    or hf["intermediate_size"]
+                ),
+                hf_naming="qwen3_moe",
+            )
         return MoeConfig(
             base=base,
             num_experts=int(hf.get("num_local_experts", 8)),
@@ -85,7 +135,7 @@ def _capacity(cfg: MoeConfig, num_tokens: int) -> int:
 def init_params(key: jax.Array, cfg: MoeConfig) -> dict:
     """Llama params with the dense FFN replaced by router + stacked experts."""
     base = llama_mod.init_params(key, cfg.base)
-    h, i = cfg.base.hidden_size, cfg.base.intermediate_size
+    h, i = cfg.base.hidden_size, cfg.expert_width
     L, E = cfg.base.num_layers, cfg.num_experts
     keys = jax.random.split(jax.random.fold_in(key, 1), 4)
 
@@ -134,6 +184,13 @@ def params_from_torch_state_dict(state_dict, cfg: MoeConfig) -> dict:
             dt,
         )
 
+    if cfg.hf_naming == "qwen3_moe":
+        moe_prefix = "model.layers.{}.mlp"
+        e_gate, e_up, e_down = "gate_proj", "up_proj", "down_proj"
+    else:
+        moe_prefix = "model.layers.{}.block_sparse_moe"
+        e_gate, e_up, e_down = "w1", "w3", "w2"
+
     params = {
         "embed": jnp.asarray(t("model.embed_tokens.weight"), dt),
         "layers": {
@@ -145,15 +202,27 @@ def params_from_torch_state_dict(state_dict, cfg: MoeConfig) -> dict:
             "mlp_norm": stack(
                 "model.layers.{}.post_attention_layernorm.weight", False
             ),
-            "w_router": stack("model.layers.{}.block_sparse_moe.gate.weight"),
-            "we_gate": stack_experts(
-                "model.layers.{}.block_sparse_moe.experts.{}.w1.weight"
+            **(
+                {
+                    "q_norm": stack(
+                        "model.layers.{}.self_attn.q_norm.weight", False
+                    ),
+                    "k_norm": stack(
+                        "model.layers.{}.self_attn.k_norm.weight", False
+                    ),
+                }
+                if cfg.base.qk_norm
+                else {}
             ),
-            "we_down": stack_experts(
-                "model.layers.{}.block_sparse_moe.experts.{}.w2.weight"
+            "w_router": stack(moe_prefix + ".gate.weight"),
+            "we_gate": stack_experts(
+                moe_prefix + ".experts.{}." + e_gate + ".weight"
             ),
             "we_up": stack_experts(
-                "model.layers.{}.block_sparse_moe.experts.{}.w3.weight"
+                moe_prefix + ".experts.{}." + e_up + ".weight"
+            ),
+            "we_down": stack_experts(
+                moe_prefix + ".experts.{}." + e_down + ".weight"
             ),
         },
         "final_norm": jnp.asarray(t("model.norm.weight"), dt),
@@ -166,8 +235,10 @@ def top_k_gating(
     logits: jax.Array,  # [N, E] f32
     top_k: int,
     capacity: int,
+    norm_topk_prob: bool = True,
 ) -> tuple[jax.Array, jax.Array]:
-    """GShard dispatch/combine tensors, Mixtral gate semantics.
+    """GShard dispatch/combine tensors, Mixtral gate semantics (Qwen3-MoE
+    = the same with renormalization gated on norm_topk_prob).
 
     Returns (dispatch [N, E, C] in {0,1}, combine [N, E, C] f32). Slot-major
     position assignment: every token's 1st choice is placed before any 2nd
@@ -176,7 +247,8 @@ def top_k_gating(
     n, e = logits.shape
     probs = jax.nn.softmax(logits, axis=-1)
     vals, idx = lax.top_k(probs, top_k)  # [N, k]
-    vals = vals / jnp.sum(vals, axis=-1, keepdims=True)
+    if norm_topk_prob:
+        vals = vals / jnp.sum(vals, axis=-1, keepdims=True)
 
     onehot = jax.nn.one_hot(idx, e, dtype=jnp.int32)  # [N, k, E]
     flat = onehot.transpose(1, 0, 2).reshape(top_k * n, e)  # slot-major
@@ -201,7 +273,10 @@ def moe_ffn(x: jax.Array, lp: dict, cfg: MoeConfig) -> jax.Array:
     n = b * t
     xf = x.reshape(n, h)
     logits = (xf @ lp["w_router"]).astype(jnp.float32)  # [N, E]
-    dispatch, combine = top_k_gating(logits, cfg.top_k, _capacity(cfg, n))
+    dispatch, combine = top_k_gating(
+        logits, cfg.top_k, _capacity(cfg, n),
+        norm_topk_prob=cfg.norm_topk_prob,
+    )
     d = dispatch.astype(x.dtype)
     expert_in = jnp.einsum("nh,nec->ech", xf, d)  # [E, C, H]
     gate = jax.nn.silu(
@@ -244,6 +319,9 @@ def forward_hidden(
         q = (x @ lp["wq"]).reshape(b, t, bc.num_heads, bc.head_dim)
         k = (x @ lp["wk"]).reshape(b, t, bc.num_kv_heads, bc.head_dim)
         v = (x @ lp["wv"]).reshape(b, t, bc.num_kv_heads, bc.head_dim)
+        if bc.qk_norm:  # Qwen3-MoE: per-head RMSNorm pre-rope
+            q = rms_norm(q, lp["q_norm"], bc.rms_norm_eps)
+            k = rms_norm(k, lp["k_norm"], bc.rms_norm_eps)
         attn, k_full, v_full, staged = attention_block(
             q, k, v, k_full, v_full, li, page_tables, positions, valid, bc,
             first_chunk=first_chunk, mesh=mesh,

@@ -231,6 +231,7 @@ def get_model(
     moe_presets = {
         "mixtral-8x7b": MoeConfig.mixtral_8x7b,
         "moe-tiny": MoeConfig.tiny,
+        "qwen3-moe-30b": MoeConfig.qwen3_moe_30b,
     }
     mla_presets = {
         "deepseek-v2-lite": MlaConfig.deepseek_v2_lite,
@@ -261,7 +262,11 @@ def get_model(
         with open(os.path.join(name, "config.json")) as f:
             hf = json.load(f)
         arch = (hf.get("architectures") or ["LlamaForCausalLM"])[0]
-        if "mixtral" in arch.lower():
+        if (
+            "mixtral" in arch.lower()
+            or arch == "Qwen3MoeForCausalLM"
+            or hf.get("model_type") == "qwen3_moe"
+        ):
             moe_cfg = MoeConfig.from_hf_config(hf)
         elif (
             arch in ("DeepseekV2ForCausalLM", "DeepseekV3ForCausalLM")

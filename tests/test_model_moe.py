@@ -173,3 +173,63 @@ def test_moe_engine_with_ep_from_config(cpu_mesh_devices):
     for eng, rid in ((single, "a"), (sharded, "b")):
         eng.add_request(rid, prompt, SamplingParams(temperature=0.0, max_tokens=4))
     assert single.run_to_completion()["a"] == sharded.run_to_completion()["b"]
+
+
+def test_qwen3_moe_against_hf():
+    """Qwen3-MoE: Mixtral block + qk-norm attention + separate expert
+    width + norm_topk_prob-gated renormalization, vs HF."""
+    import pytest as _pytest
+
+    torch = _pytest.importorskip("torch")
+    from dataclasses import replace as _replace
+
+    from transformers import Qwen3MoeConfig, Qwen3MoeForCausalLM
+
+    from dynamo_tpu.models.llama import LlamaConfig
+    from dynamo_tpu.models.moe import (
+        MoeConfig,
+        forward,
+        params_from_torch_state_dict,
+    )
+
+    cfg = MoeConfig(
+        base=_replace(
+            LlamaConfig.tiny(), rms_norm_eps=1e-6, qk_norm=True,
+        ),
+        num_experts=4, top_k=2, norm_topk_prob=True,
+        expert_intermediate_size=32, hf_naming="qwen3_moe",
+        capacity_factor=4.0,  # no drops: exactness vs HF
+    )
+    bc = cfg.base
+    hf_cfg = Qwen3MoeConfig(
+        vocab_size=bc.vocab_size, hidden_size=bc.hidden_size,
+        intermediate_size=bc.intermediate_size,
+        num_hidden_layers=bc.num_layers,
+        num_attention_heads=bc.num_heads,
+        num_key_value_heads=bc.num_kv_heads,
+        head_dim=bc.head_dim, rope_theta=bc.rope_theta,
+        rms_norm_eps=bc.rms_norm_eps, tie_word_embeddings=False,
+        num_experts=4, num_experts_per_tok=2, norm_topk_prob=True,
+        moe_intermediate_size=32, decoder_sparse_step=1,
+        mlp_only_layers=[], attn_implementation="eager",
+    )
+    torch.manual_seed(27)
+    model = Qwen3MoeForCausalLM(hf_cfg).eval()
+    params = params_from_torch_state_dict(model.state_dict(), cfg)
+    assert "q_norm" in params["layers"]
+
+    rng = np.random.default_rng(12)
+    toks = rng.integers(0, bc.vocab_size, size=(2, 9)).astype(np.int32)
+    with torch.no_grad():
+        ref = model(torch.from_numpy(toks.astype(np.int64))).logits.numpy()
+
+    kv = init_kv_pages(bc, 64, 4)
+    pts = np.stack([np.arange(1, 4), np.arange(4, 7)]).astype(np.int32)
+    positions = np.tile(np.arange(9, dtype=np.int32), (2, 1))
+    logits, _ = forward(
+        params, cfg, jnp.asarray(toks), jnp.asarray(positions),
+        jnp.ones((2, 9), bool), kv, jnp.asarray(pts),
+    )
+    ours = np.asarray(logits)
+    np.testing.assert_allclose(ours, ref, rtol=5e-2, atol=5e-2)
+    assert (ours.argmax(-1) == ref.argmax(-1)).mean() > 0.9
