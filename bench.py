@@ -135,6 +135,28 @@ def main() -> None:
     import jax
 
     n_params = sum(int(x.size) for x in jax.tree.leaves(eng.params))
+    # MoE: FLOPs/token follow the ACTIVE parameters (top_k of E experts),
+    # not the resident total — MFU from total params would overstate ~8x
+    # for deepseek-v2-lite. Routed expert leaves are named we_*.
+    acfg = eng.adapter.config
+    n_experts = getattr(acfg, "n_routed_experts", 0) or getattr(
+        acfg, "num_experts", 0
+    )
+    top_k = getattr(acfg, "num_experts_per_tok", None) or getattr(
+        acfg, "top_k", 0
+    )
+    n_active = n_params
+    if n_experts and top_k:
+        expert_elems = sum(
+            int(leaf.size)
+            for path, leaf in jax.tree_util.tree_leaves_with_path(eng.params)
+            if any(
+                getattr(k, "key", "").startswith("we_")
+                and not getattr(k, "key", "").endswith("_scale")
+                for k in path
+            )
+        )
+        n_active = n_params - expert_elems + expert_elems * top_k // n_experts
 
     rng = np.random.default_rng(0)
     prompts = [
@@ -191,7 +213,7 @@ def main() -> None:
     peak = tpu_bf16_peak_flops()
     total_tokens = generated + num_requests * isl
     mfu = (
-        (2.0 * n_params * total_tokens / elapsed) / peak
+        (2.0 * n_active * total_tokens / elapsed) / peak
         if peak is not None
         else float("nan")
     )
