@@ -1,0 +1,210 @@
+"""Distributed-stack soak: sustained mixed traffic against the real
+serving stack (fabric + KV-routed jax workers + HTTP frontend), with
+per-process RSS tracking.
+
+tests/test_soak.py bounds a short in-process soak; this script is the
+session-scale complement (the reference keeps a soak in
+lib/runtime/tests/soak.rs): tens of minutes of continuous mixed load —
+unary + streaming, logprobs, penalties, n>1, stop strings, cancels —
+asserting zero transport-level failures and a bounded post-warmup RSS
+slope on every process (leak detection for the fabric, workers, and
+frontend alike).
+
+Usage: python scripts/soak_distributed.py --minutes 20
+Writes artifacts/soak_distributed.json.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import random
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from benchmarks._procs import ManagedProc as Proc  # noqa: E402
+from benchmarks._procs import cli as _cli  # noqa: E402
+from benchmarks._procs import free_port as _free_port  # noqa: E402
+
+
+def rss_mb(pid: int) -> float:
+    try:
+        with open(f"/proc/{pid}/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1]) / 1024.0
+    except OSError:
+        pass
+    return -1.0
+
+
+async def one_request(session, url: str, model: str, r: random.Random,
+                      stats: dict) -> None:
+    body = {
+        "model": model,
+        "messages": [{"role": "user", "content": "".join(
+            chr(97 + r.randrange(26)) for _ in range(r.randrange(4, 12))
+        )}],
+        "max_tokens": r.randrange(1, 6),
+        "temperature": r.choice([0.0, 0.7]),
+    }
+    kind = r.randrange(6)
+    if kind == 1:
+        body["logprobs"] = True
+        body["top_logprobs"] = 2
+    elif kind == 2:
+        body["frequency_penalty"] = 0.5
+    elif kind == 3:
+        body["n"] = 2
+    elif kind == 4:
+        body["stop"] = ["zz"]
+    stream = kind == 5 or r.random() < 0.5
+    body["stream"] = stream
+    t0 = time.perf_counter()
+    try:
+        async with session.post(
+            f"{url}/v1/chat/completions", json=body,
+            timeout=__import__("aiohttp").ClientTimeout(total=60),
+        ) as resp:
+            if resp.status != 200:
+                stats["http_errors"] += 1
+                return
+            if stream:
+                # occasionally abandon mid-stream (exercises the
+                # disconnect-cancel path)
+                abandon = r.random() < 0.05
+                n = 0
+                async for _ in resp.content:
+                    n += 1
+                    if abandon and n >= 2:
+                        stats["aborted"] += 1
+                        return
+            else:
+                await resp.json()
+        stats["ok"] += 1
+        stats["lat_ms"].append((time.perf_counter() - t0) * 1000)
+    except Exception:  # noqa: BLE001
+        stats["transport_errors"] += 1
+
+
+async def drive(url: str, model: str, minutes: float, concurrency: int,
+                procs: list[Proc]) -> dict:
+    import aiohttp
+
+    r = random.Random(99)
+    stats = {
+        "ok": 0, "http_errors": 0, "transport_errors": 0, "aborted": 0,
+        "lat_ms": [],
+    }
+    rss_series: dict[str, list[float]] = {p.name: [] for p in procs}
+    deadline = time.time() + minutes * 60
+    sample_every = 15.0
+    next_sample = time.time()
+    async with aiohttp.ClientSession() as session:
+        async def worker(wid: int):
+            rr = random.Random(1000 + wid)
+            while time.time() < deadline:
+                await one_request(session, url, model, rr, stats)
+
+        async def sampler():
+            nonlocal next_sample
+            while time.time() < deadline:
+                if time.time() >= next_sample:
+                    for p in procs:
+                        rss_series[p.name].append(rss_mb(p.proc.pid))
+                    next_sample += sample_every
+                await asyncio.sleep(1.0)
+
+        await asyncio.gather(
+            sampler(), *(worker(i) for i in range(concurrency))
+        )
+
+    lat = sorted(stats.pop("lat_ms"))
+    out = dict(stats)
+    out["requests_total"] = sum(
+        stats[k] for k in ("ok", "http_errors", "transport_errors", "aborted")
+    )
+    if lat:
+        out["lat_ms"] = {
+            "p50": round(lat[len(lat) // 2], 1),
+            "p99": round(lat[min(len(lat) - 1, int(len(lat) * 0.99))], 1),
+        }
+    out["rss_mb"] = {}
+    for name, series in rss_series.items():
+        if len(series) >= 4:
+            # post-warmup slope: compare the 2nd quarter median to the
+            # last quarter median (first samples include jit warmup)
+            q = len(series) // 4
+            early = sorted(series[q:2 * q])[q // 2 if q else 0]
+            late = sorted(series[-q:])[q // 2 if q else 0]
+            out["rss_mb"][name] = {
+                "early": round(early, 1), "late": round(late, 1),
+                "growth_pct": round(100 * (late - early) / max(early, 1), 2),
+            }
+    return out
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--minutes", type=float, default=20.0)
+    ap.add_argument("--concurrency", type=int, default=8)
+    ap.add_argument("--workers", type=int, default=2)
+    args = ap.parse_args()
+
+    fport, hport = _free_port(), _free_port()
+    engine = [
+        "--model", "tiny", "--page-size", "4", "--num-pages", "64",
+        "--max-context", "48", "--dtype", "float32", "--router-mode", "kv",
+    ]
+    procs: list[Proc] = []
+    try:
+        fb = Proc("fabric", _cli("fabric", "--port", str(fport)))
+        procs.append(fb)
+        fb.wait_for("listening|fabric server on")
+        for i in range(args.workers):
+            w = Proc(
+                f"worker{i}",
+                _cli("run", "in=dyn", "out=jax", *engine,
+                     "--fabric", f"127.0.0.1:{fport}"),
+            )
+            procs.append(w)
+            w.wait_for(r"worker \w+ up", timeout=300)
+        fe = Proc(
+            "frontend",
+            _cli("run", "in=http", "out=dyn",
+                 "--fabric", f"127.0.0.1:{fport}", "--port", str(hport)),
+        )
+        procs.append(fe)
+        fe.wait_for("model attached", timeout=120)
+
+        out = asyncio.run(
+            drive(f"http://127.0.0.1:{hport}", "tiny", args.minutes,
+                  args.concurrency, procs)
+        )
+        out["minutes"] = args.minutes
+        out["workers"] = args.workers
+        # soak verdict: no transport failures, every process's post-warmup
+        # RSS growth bounded
+        out["ok_verdict"] = bool(
+            out["transport_errors"] == 0
+            and out["http_errors"] == 0
+            and all(
+                v["growth_pct"] < 15.0 for v in out["rss_mb"].values()
+            )
+        )
+        path = Path(__file__).resolve().parent.parent / "artifacts"
+        path.mkdir(exist_ok=True)
+        (path / "soak_distributed.json").write_text(json.dumps(out, indent=1))
+        print(json.dumps(out, indent=1))
+        sys.exit(0 if out["ok_verdict"] else 1)
+    finally:
+        for p in reversed(procs):
+            p.stop()
+
+
+if __name__ == "__main__":
+    main()
