@@ -517,3 +517,30 @@ def test_mla_v3_yarn_mscale_softmax_against_hf():
     # without the softmax adjustment the logits demonstrably diverge
     wrong = _run_paged(replace(cfg, rope_mscale_softmax=False), params, toks)
     assert not np.allclose(wrong, ours, atol=1e-3)
+
+
+def test_mla_spec_decode_byte_identical():
+    """Prompt-lookup speculative decoding rides the family-agnostic
+    spec_verify path: over the compressed MLA cache it must stay
+    byte-identical to plain greedy decoding."""
+    from dynamo_tpu.engine import EngineConfig
+    from dynamo_tpu.engine.engine import JaxEngine
+    from dynamo_tpu.engine.request import SamplingParams
+
+    def run(spec_ngram):
+        eng = JaxEngine(
+            EngineConfig(
+                model="mla-tiny", num_pages=64, page_size=4,
+                max_pages_per_seq=16, decode_buckets=(2,),
+                prefill_chunk=16, max_seqs=2, dtype="float32",
+                spec_ngram=spec_ngram,
+            )
+        )
+        rng = np.random.default_rng(7)
+        base = [int(x) for x in rng.integers(1, 250, 8)]
+        eng.add_request(  # repetitive prompt: lookup actually proposes
+            "r0", base * 3, SamplingParams(temperature=0.0, max_tokens=12)
+        )
+        return eng.run_to_completion()["r0"]
+
+    assert run(0) == run(4)
