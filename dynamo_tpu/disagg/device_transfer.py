@@ -166,21 +166,28 @@ class DevicePlane:
                 self._conns[address] = conn
             return conn
 
-    def _pull_sync(self, address: str, uuid: int, shape, dtype) -> tuple:
+    def _pull_sync(self, address: str, uuid: int, k_shape, v_shape,
+                   dtype) -> tuple:
         import jax
         from jax.sharding import SingleDeviceSharding
 
         sharding = SingleDeviceSharding(jax.devices()[0])
-        spec = jax.ShapeDtypeStruct(tuple(shape), dtype, sharding=sharding)
+        specs = [
+            jax.ShapeDtypeStruct(tuple(k_shape), dtype, sharding=sharding),
+            jax.ShapeDtypeStruct(tuple(v_shape), dtype, sharding=sharding),
+        ]
         conn = self._connection(address)
-        k, v = conn.pull(uuid, [spec, spec])
+        k, v = conn.pull(uuid, specs)
         return k, v
 
-    async def pull(self, address: str, uuid: int, shape, dtype) -> tuple:
+    async def pull(self, address: str, uuid: int, k_shape, v_shape,
+                   dtype) -> tuple:
         """Pull (k, v) staged under uuid from the peer at address; arrays
-        land on this process's default device. Blocking PjRt call runs in
-        the default executor so the event loop stays live."""
+        land on this process's default device. k and v carry their OWN
+        shapes (MLA caches are asymmetric: latent vs rope-key widths).
+        Blocking PjRt call runs in the default executor so the event loop
+        stays live."""
         loop = asyncio.get_running_loop()
         return await loop.run_in_executor(
-            None, self._pull_sync, address, uuid, shape, dtype
+            None, self._pull_sync, address, uuid, k_shape, v_shape, dtype
         )

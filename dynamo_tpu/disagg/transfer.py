@@ -197,11 +197,17 @@ class KvTransferServer:
             await self._nack(writer, rid, "no_waiter")
             return
         page_ids = header["page_ids"]
-        shape = tuple(header["shape"])  # [L, Hkv, n, ps, D]
+        shape = tuple(header["shape"])  # [L, Hkv, n, ps, Dk]
+        # MLA caches are asymmetric (k = latent, v = rope key); symmetric
+        # senders omit v_shape
+        v_shape = tuple(header.get("v_shape") or shape)
         dtype = dtype_from_name(header["dtype"])
-        nbytes = int(np.prod(shape)) * dtype.itemsize
-        k = np.frombuffer(payload[:nbytes], dtype=dtype).reshape(shape)
-        v = np.frombuffer(payload[nbytes : 2 * nbytes], dtype=dtype).reshape(shape)
+        nbytes_k = int(np.prod(shape)) * dtype.itemsize
+        nbytes_v = int(np.prod(v_shape)) * dtype.itemsize
+        k = np.frombuffer(payload[:nbytes_k], dtype=dtype).reshape(shape)
+        v = np.frombuffer(
+            payload[nbytes_k : nbytes_k + nbytes_v], dtype=dtype
+        ).reshape(v_shape)
         await self._land(
             rid, header, lambda: self.write_fn(page_ids, k, v), writer, "host"
         )
@@ -230,7 +236,9 @@ class KvTransferServer:
         try:
             k, v = await plane.pull(
                 header["xfer_addr"], header["uuid"],
-                tuple(header["shape"]), dtype_from_name(header["dtype"]),
+                tuple(header["shape"]),
+                tuple(header.get("v_shape") or header["shape"]),
+                dtype_from_name(header["dtype"]),
             )
         except Exception:
             # Pull never touched the pool: nack but KEEP the waiter — the
@@ -301,6 +309,7 @@ class KvTransferServer:
                         for h, p, t in metas
                     ],
                     "shape": list(k.shape),
+                    "v_shape": list(v.shape),
                     "dtype": k.dtype.name,
                 },
                 k.tobytes() + v.tobytes(),
@@ -366,6 +375,7 @@ class KvTransferClient:
                         "request_id": request_id,
                         "page_ids": list(page_ids),
                         "shape": list(k.shape),
+                        "v_shape": list(v.shape),
                         "dtype": k.dtype.name,
                         "first_token": int(first_token),
                         "xfer_addr": plane.address,
@@ -422,6 +432,7 @@ class KvTransferClient:
                 "request_id": request_id,
                 "page_ids": list(page_ids),
                 "shape": list(k.shape),
+                "v_shape": list(v.shape),
                 "dtype": k.dtype.name,
                 "first_token": int(first_token),
             },
@@ -440,10 +451,14 @@ class KvTransferClient:
         if resp.get("op") != "fetch_ok" or not resp.get("found"):
             return None
         shape = tuple(resp["shape"])
+        v_shape = tuple(resp.get("v_shape") or shape)
         dtype = dtype_from_name(resp["dtype"])
-        nbytes = int(np.prod(shape)) * dtype.itemsize
-        k = np.frombuffer(payload[:nbytes], dtype=dtype).reshape(shape)
-        v = np.frombuffer(payload[nbytes : 2 * nbytes], dtype=dtype).reshape(shape)
+        nbytes_k = int(np.prod(shape)) * dtype.itemsize
+        nbytes_v = int(np.prod(v_shape)) * dtype.itemsize
+        k = np.frombuffer(payload[:nbytes_k], dtype=dtype).reshape(shape)
+        v = np.frombuffer(
+            payload[nbytes_k : nbytes_k + nbytes_v], dtype=dtype
+        ).reshape(v_shape)
         metas = [(h, p, tuple(t)) for h, p, t in resp["metas"]]
         return metas, k, v
 

@@ -1180,15 +1180,20 @@ class JaxEngine:
         )
 
     @property
-    def _canonical_head_dim(self) -> int:
-        """The model's true head_dim — the wire/host format for extracted
-        pages. The device cache may be lane-padded (cfg.kv_head_dim) when
-        the Pallas kernel is active; extract strips the padding and inject
-        restores it, so disagg peers and KVBM tiers with different
-        attention impls interoperate (and host/disk tiers don't store
-        zero lanes)."""
+    def _canonical_head_dims(self) -> tuple:
+        """The true last-dim widths of (k, v) — the wire/host format for
+        extracted pages. The device cache may be lane-padded
+        (cfg.kv_head_dim) when the Pallas kernel is active; extract strips
+        the padding and inject restores it, so disagg peers and KVBM tiers
+        with different attention impls interoperate (and host/disk tiers
+        don't store zero lanes). MLA caches are ASYMMETRIC (k = latent,
+        v = rope key) and unpadded — their widths come straight from the
+        cache."""
         cfg = self.adapter.config
-        return cfg.head_dim if hasattr(cfg, "head_dim") else cfg.base.head_dim
+        if hasattr(cfg, "kv_lora_rank"):  # MLA: unpadded, asymmetric
+            return (self.kv.k.shape[-1], self.kv.v.shape[-1])
+        d = cfg.head_dim if hasattr(cfg, "head_dim") else cfg.base.head_dim
+        return (d, d)
 
     def extract_pages(self, page_ids: Sequence[int]):
         """Pull KV pages to host in the canonical wire format:
@@ -1208,10 +1213,10 @@ class JaxEngine:
         offload rides this — the reference overlaps offload DMA the same
         way, block_manager/offload.rs)."""
         ids = jnp.asarray(np.asarray(page_ids, np.int32))
-        d = self._canonical_head_dim
+        dk, dv = self._canonical_head_dims
         # [L, n, S, Hkv, Dp] -> [L, Hkv, n, S, D] on device
-        k = jnp.take(self.kv.k, ids, axis=1).transpose(0, 3, 1, 2, 4)[..., :d]
-        v = jnp.take(self.kv.v, ids, axis=1).transpose(0, 3, 1, 2, 4)[..., :d]
+        k = jnp.take(self.kv.k, ids, axis=1).transpose(0, 3, 1, 2, 4)[..., :dk]
+        v = jnp.take(self.kv.v, ids, axis=1).transpose(0, 3, 1, 2, 4)[..., :dv]
         try:
             k.copy_to_host_async()
             v.copy_to_host_async()
@@ -1246,22 +1251,27 @@ class JaxEngine:
             k = jnp.asarray(np.asarray(k))
             v = jnp.asarray(np.asarray(v))
         n = len(page_ids)
-        dpad = self.kv.k.shape[-1] - k.shape[-1]
-        fn = self._jit_cache.get(("inject_dev", n, dpad))
+        dpad_k = self.kv.k.shape[-1] - k.shape[-1]
+        dpad_v = self.kv.v.shape[-1] - v.shape[-1]
+        fn = self._jit_cache.get(("inject_dev", n, dpad_k, dpad_v))
         if fn is None:
             def inject_fn(kv, ids, kk, vv):
                 kk = kk.transpose(0, 2, 3, 1, 4)
                 vv = vv.transpose(0, 2, 3, 1, 4)
-                if dpad:
-                    widths = [(0, 0)] * (kk.ndim - 1) + [(0, dpad)]
-                    kk = jnp.pad(kk, widths)
-                    vv = jnp.pad(vv, widths)
+                if dpad_k:
+                    kk = jnp.pad(
+                        kk, [(0, 0)] * (kk.ndim - 1) + [(0, dpad_k)]
+                    )
+                if dpad_v:
+                    vv = jnp.pad(
+                        vv, [(0, 0)] * (vv.ndim - 1) + [(0, dpad_v)]
+                    )
                 return type(kv)(
                     k=kv.k.at[:, ids].set(kk.astype(kv.k.dtype)),
                     v=kv.v.at[:, ids].set(vv.astype(kv.v.dtype)),
                 )
             fn = jax.jit(inject_fn, donate_argnums=(0,))
-            self._jit_cache[("inject_dev", n, dpad)] = fn
+            self._jit_cache[("inject_dev", n, dpad_k, dpad_v)] = fn
         self.kv = fn(
             self.kv, jnp.asarray(np.asarray(page_ids, np.int32)), k, v
         )

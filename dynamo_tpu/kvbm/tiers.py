@@ -63,6 +63,7 @@ class HostTier:
         self._nlib = None
         self._nh = None
         self._block_bytes = 0
+        self._k_bytes = 0  # k's share of a slab (MLA: k and v differ)
         self._meta: dict[int, tuple[Optional[int], tuple[int, ...], tuple, np.dtype]] = {}
 
     def _try_native_init(self, entry: BlockEntry) -> None:
@@ -76,6 +77,9 @@ class HostTier:
         if nh:
             self._nlib, self._nh = lib, nh
             self._block_bytes = entry.nbytes
+            self._k_bytes = int(
+                np.ascontiguousarray(entry.k).view(np.uint8).size
+            )
         else:
             self._nlib = False
 
@@ -106,11 +110,17 @@ class HostTier:
     # -- native-slab entry views -------------------------------------------
 
     def _slab_entry(self, seq_hash: int, ptr: int) -> BlockEntry:
-        parent, tokens, shape, dtype = self._meta[seq_hash]
-        half = self._block_bytes // 2
+        # k and v carry their OWN shapes/offsets: MLA caches are
+        # asymmetric (k = latent, v = rope key), so a half/half split
+        # would corrupt both
+        parent, tokens, k_shape, v_shape, dtype = self._meta[seq_hash]
+        kb = self._k_bytes
+        vb = self._block_bytes - kb
         buf = (ctypes.c_uint8 * self._block_bytes).from_address(ptr)
-        k = np.frombuffer(buf, np.uint8, half).view(dtype).reshape(shape)
-        v = np.frombuffer(buf, np.uint8, half, offset=half).view(dtype).reshape(shape)
+        k = np.frombuffer(buf, np.uint8, kb).view(dtype).reshape(k_shape)
+        v = np.frombuffer(buf, np.uint8, vb, offset=kb).view(dtype).reshape(
+            v_shape
+        )
         return BlockEntry(
             seq_hash=seq_hash, parent_hash=parent, tokens=tokens, k=k, v=v
         )
@@ -147,13 +157,14 @@ class HostTier:
                 ptr = self._nlib.dyn_host_reserve(self._nh, entry.seq_hash)
             if not ptr:  # allocation failure — pass down the hierarchy
                 return bool(self._demote is not None and self._demote(entry))
-            half = self._block_bytes // 2
+            kb = self._k_bytes
             buf = (ctypes.c_uint8 * self._block_bytes).from_address(ptr)
             dst = np.frombuffer(buf, np.uint8)
-            dst[:half] = np.ascontiguousarray(entry.k).view(np.uint8).reshape(-1)
-            dst[half:] = np.ascontiguousarray(entry.v).view(np.uint8).reshape(-1)
+            dst[:kb] = np.ascontiguousarray(entry.k).view(np.uint8).reshape(-1)
+            dst[kb:] = np.ascontiguousarray(entry.v).view(np.uint8).reshape(-1)
             self._meta[entry.seq_hash] = (
-                entry.parent_hash, entry.tokens, entry.k.shape, entry.k.dtype,
+                entry.parent_hash, entry.tokens, entry.k.shape,
+                entry.v.shape, entry.k.dtype,
             )
             return True
         self._entries[entry.seq_hash] = entry
@@ -233,10 +244,10 @@ class DiskTier:
         self.directory = directory
         self.capacity_bytes = capacity_bytes
         os.makedirs(directory, exist_ok=True)
-        #: seq_hash -> (parent_hash, tokens, nbytes, dtype_name, block_shape)
-        self._index: OrderedDict[
-            int, tuple[Optional[int], tuple[int, ...], int, str, tuple[int, ...]]
-        ] = OrderedDict()
+        #: seq_hash -> (parent_hash, tokens, nbytes, dtype_name,
+        #:              k_shape, v_shape) — separate shapes: MLA caches
+        #:              are asymmetric
+        self._index: OrderedDict[int, tuple] = OrderedDict()
         self._bytes = 0
 
     def _path(self, seq_hash: int) -> str:
@@ -257,15 +268,18 @@ class DiskTier:
             return True
         if entry.nbytes > self.capacity_bytes:
             return False
-        stacked = np.stack([entry.k, entry.v])
+        flat = np.concatenate([
+            np.ascontiguousarray(entry.k).view(np.uint8).reshape(-1),
+            np.ascontiguousarray(entry.v).view(np.uint8).reshape(-1),
+        ])
         try:
-            np.save(self._path(entry.seq_hash), stacked.view(np.uint8))
+            np.save(self._path(entry.seq_hash), flat)
         except OSError:
             logger.exception("disk tier write failed for %x", entry.seq_hash)
             return False
         self._index[entry.seq_hash] = (
             entry.parent_hash, entry.tokens, entry.nbytes,
-            entry.k.dtype.name, entry.k.shape,
+            entry.k.dtype.name, entry.k.shape, entry.v.shape,
         )
         self._bytes += entry.nbytes
         while self._bytes > self.capacity_bytes:
@@ -278,18 +292,21 @@ class DiskTier:
         meta = self._index.get(seq_hash)
         if meta is None:
             return None
-        parent_hash, tokens, _, dtype_name, shape = meta
+        parent_hash, tokens, _, dtype_name, k_shape, v_shape = meta
         try:
             raw = np.load(self._path(seq_hash))
         except OSError:
             logger.exception("disk tier read failed for %x", seq_hash)
             self.pop(seq_hash)
             return None
-        kv = raw.view(_dtype_from_name(dtype_name)).reshape((2, *shape))
+        dtype = _dtype_from_name(dtype_name)
+        kb = int(np.prod(k_shape)) * dtype.itemsize
+        k = raw[:kb].view(dtype).reshape(k_shape)
+        v = raw[kb:].view(dtype).reshape(v_shape)
         self._index.move_to_end(seq_hash)
         return BlockEntry(
             seq_hash=seq_hash, parent_hash=parent_hash, tokens=tokens,
-            k=kv[0], v=kv[1],
+            k=k, v=v,
         )
 
     def pop(self, seq_hash: int) -> None:

@@ -544,3 +544,111 @@ def test_mla_spec_decode_byte_identical():
         return eng.run_to_completion()["r0"]
 
     assert run(0) == run(4)
+
+
+def test_mla_tier_evict_onboard_byte_exact():
+    """KVBM host tier over the ASYMMETRIC MLA cache (k latent 32-wide,
+    v rope-key 8-wide): evict a prefix, re-serve it, outputs must be
+    byte-identical (extract/inject must not assume k/v share a width)."""
+    from dynamo_tpu.engine import EngineConfig
+    from dynamo_tpu.engine.engine import JaxEngine
+    from dynamo_tpu.engine.request import SamplingParams
+
+    eng = JaxEngine(
+        EngineConfig(
+            model="mla-tiny", num_pages=10, page_size=4,
+            max_pages_per_seq=8, decode_buckets=(1,), prefill_chunk=8,
+            max_seqs=1, dtype="float32",
+            host_kv_cache_bytes=1 << 20,
+        )
+    )
+    rng = np.random.default_rng(61)
+    prompt = [int(x) for x in rng.integers(1, 250, 12)]
+
+    def serve(rid, toks):
+        eng.add_request(rid, toks, SamplingParams(temperature=0.0,
+                                                  max_tokens=4))
+        return eng.run_to_completion()[rid]
+
+    first = serve("a", prompt)
+    # churn the tiny pool so the prompt's pages evict into the host tier
+    for i in range(3):
+        serve(f"churn{i}", [int(x) for x in rng.integers(1, 250, 12)])
+    # re-serve: prefix onboards from the tier; output must match exactly
+    again = serve("b", prompt)
+    assert first == again, (first, again)
+    assert eng.allocator.stats.onboarded_blocks > 0  # tier really used
+
+
+def test_mla_disagg_device_path_in_process(monkeypatch):
+    """Disagg KV transfer of the asymmetric MLA cache over the DEVICE
+    plane in-process: staged (k latent, v rope) arrays pull with their
+    OWN shapes and decode continues byte-identically."""
+    import asyncio
+
+    from dynamo_tpu.disagg.device_transfer import DevicePlane
+    from dynamo_tpu.disagg.transfer import KvTransferClient, KvTransferServer
+    from dynamo_tpu.engine import EngineConfig
+    from dynamo_tpu.engine.engine import JaxEngine
+    from dynamo_tpu.engine.request import SamplingParams
+
+    DevicePlane.reset_for_tests()
+    monkeypatch.setenv("DYN_KV_TRANSFER", "device")
+    cfg = EngineConfig(
+        model="mla-tiny", num_pages=32, page_size=4, max_pages_per_seq=8,
+        decode_buckets=(1,), prefill_chunk=8, max_seqs=1, dtype="float32",
+    )
+    rng = np.random.default_rng(71)
+    prompt = [int(x) for x in rng.integers(1, 250, 9)]
+    n_out = 5
+
+    ref = JaxEngine(cfg)
+    ref.add_request("ref", prompt,
+                    SamplingParams(temperature=0.0, max_tokens=n_out))
+    ref_tokens = ref.run_to_completion()["ref"]
+
+    pre = JaxEngine(cfg, params=ref.params)
+    req_p = pre.add_request(
+        "d1", prompt,
+        SamplingParams(temperature=0.0, max_tokens=1, ignore_eos=True),
+    )
+    req_p.hold_pages = True
+    first = pre.run_to_completion()["d1"]
+    held = pre.scheduler.held["d1"]
+    k_dev, v_dev = pre.extract_pages_async(held)
+    assert k_dev.shape[-1] != v_dev.shape[-1]  # genuinely asymmetric
+
+    dec = JaxEngine(cfg, params=ref.params)
+    req_d = dec.allocate_for_remote_prefill(
+        "d1", prompt, SamplingParams(temperature=0.0, max_tokens=n_out)
+    )
+    assert req_d is not None
+
+    async def main():
+        async def device_write_fn(page_ids, k, v):
+            dec.inject_pages_device(page_ids, k, v)
+
+        async def write_fn(page_ids, k, v):  # must not run
+            raise AssertionError("host path used")
+
+        server = KvTransferServer(write_fn, device_write_fn=device_write_fn)
+        await server.start()
+        waiter = server.expect("d1")
+        client = KvTransferClient()
+        try:
+            ok = await client.send(
+                *server.address, "d1", req_d.pages, k_dev, v_dev, first[0]
+            )
+            assert ok
+            await asyncio.wait_for(waiter, 10)
+            assert server.transfers == {"device": 1, "host": 0}
+        finally:
+            client.close()
+            await server.stop()
+
+    asyncio.run(main())
+    pre.scheduler.release_held("d1")
+    outputs = dec.add_prefilled(req_d, first[0])
+    got = [t for o in outputs for t in o.new_token_ids]
+    got += dec.run_to_completion().get("d1", [])
+    assert got == ref_tokens
