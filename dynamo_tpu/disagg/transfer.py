@@ -287,7 +287,7 @@ class KvTransferServer:
         metas, k, v = served
         n_blocks = int(k.shape[2])
         if n_blocks:
-            per_block = 2 * (k.nbytes // n_blocks)  # k and v
+            per_block = (k.nbytes + v.nbytes) // n_blocks
             self._fetch_block_bytes = per_block
             fit = max(1, _FETCH_MAX_BYTES // per_block)
             if n_blocks > fit:
@@ -422,7 +422,7 @@ class KvTransferClient:
     ) -> bool:
         """Host path: ship page bytes in the frame payload; True on
         decode-side ack. k/v: [L, Hkv, n, ps, D] with n == len(page_ids)."""
-        assert k.shape == v.shape and k.shape[2] == len(page_ids), (
+        assert k.shape[2] == len(page_ids) and v.shape[2] == len(page_ids), (
             k.shape, len(page_ids),
         )
         return await self._control(

@@ -59,12 +59,15 @@ class HostTier:
         self._demote = demote
         self._entries: OrderedDict[int, BlockEntry] = OrderedDict()
         self._bytes = 0
-        # native slab store (lazy): hash -> (parent, tokens, k_shape, dtype)
+        # native slab store (lazy):
+        #   hash -> (parent, tokens, k_shape, v_shape, dtype)
         self._nlib = None
         self._nh = None
         self._block_bytes = 0
         self._k_bytes = 0  # k's share of a slab (MLA: k and v differ)
-        self._meta: dict[int, tuple[Optional[int], tuple[int, ...], tuple, np.dtype]] = {}
+        self._meta: dict[
+            int, tuple[Optional[int], tuple[int, ...], tuple, tuple, np.dtype]
+        ] = {}
 
     def _try_native_init(self, entry: BlockEntry) -> None:
         if self._nh is not None or self._nlib is not None:

@@ -194,7 +194,7 @@ def test_device_pull_failure_falls_back_to_host(tiny_cfg, monkeypatch):
     plane = DevicePlane.get()
     assert plane is not None
 
-    def broken_pull(address, uuid, shape, dtype):
+    def broken_pull(address, uuid, k_shape, v_shape, dtype):
         raise RuntimeError("simulated ICI failure")
 
     monkeypatch.setattr(plane, "_pull_sync", broken_pull)

@@ -652,3 +652,71 @@ def test_mla_disagg_device_path_in_process(monkeypatch):
     got = [t for o in outputs for t in o.new_token_ids]
     got += dec.run_to_completion().get("d1", [])
     assert got == ref_tokens
+
+
+def test_mla_disagg_host_path(monkeypatch):
+    """Host-path transfer of the asymmetric MLA cache (the default
+    transport off-TPU and the device-path fallback): separate k/v widths
+    must ride the write frame and decode must continue byte-identically."""
+    import asyncio
+
+    from dynamo_tpu.disagg.transfer import KvTransferClient, KvTransferServer
+    from dynamo_tpu.engine import EngineConfig
+    from dynamo_tpu.engine.engine import JaxEngine
+    from dynamo_tpu.engine.request import SamplingParams
+
+    monkeypatch.setenv("DYN_KV_TRANSFER", "host")
+    cfg = EngineConfig(
+        model="mla-tiny", num_pages=32, page_size=4, max_pages_per_seq=8,
+        decode_buckets=(1,), prefill_chunk=8, max_seqs=1, dtype="float32",
+    )
+    rng = np.random.default_rng(81)
+    prompt = [int(x) for x in rng.integers(1, 250, 9)]
+    n_out = 4
+
+    ref = JaxEngine(cfg)
+    ref.add_request("ref", prompt,
+                    SamplingParams(temperature=0.0, max_tokens=n_out))
+    ref_tokens = ref.run_to_completion()["ref"]
+
+    pre = JaxEngine(cfg, params=ref.params)
+    req_p = pre.add_request(
+        "d1", prompt,
+        SamplingParams(temperature=0.0, max_tokens=1, ignore_eos=True),
+    )
+    req_p.hold_pages = True
+    first = pre.run_to_completion()["d1"]
+    held = pre.scheduler.held["d1"]
+    k, v = pre.extract_pages(held)
+    assert k.shape[-1] != v.shape[-1]
+
+    dec = JaxEngine(cfg, params=ref.params)
+    req_d = dec.allocate_for_remote_prefill(
+        "d1", prompt, SamplingParams(temperature=0.0, max_tokens=n_out)
+    )
+
+    async def main():
+        async def write_fn(page_ids, kk, vv):
+            dec.inject_pages(page_ids, kk, vv)
+
+        server = KvTransferServer(write_fn)
+        await server.start()
+        waiter = server.expect("d1")
+        client = KvTransferClient()
+        try:
+            ok = await client.send(
+                *server.address, "d1", req_d.pages, k, v, first[0]
+            )
+            assert ok
+            await asyncio.wait_for(waiter, 10)
+            assert server.transfers == {"device": 0, "host": 1}
+        finally:
+            client.close()
+            await server.stop()
+
+    asyncio.run(main())
+    pre.scheduler.release_held("d1")
+    outputs = dec.add_prefilled(req_d, first[0])
+    got = [t for o in outputs for t in o.new_token_ids]
+    got += dec.run_to_completion().get("d1", [])
+    assert got == ref_tokens
