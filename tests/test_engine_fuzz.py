@@ -9,17 +9,20 @@ this bounded version keeps the cross-config invariant in CI."""
 import dataclasses
 import random
 
+import pytest
+
 from dynamo_tpu.engine import EngineConfig
 from dynamo_tpu.engine.engine import JaxEngine
 from dynamo_tpu.engine.request import SamplingParams
 
 
-def test_engine_fuzz_bounded():
+@pytest.mark.parametrize("model,rounds", [("tiny", 5), ("mla-tiny-moe", 2)])
+def test_engine_fuzz_bounded(model, rounds):
     rng = random.Random(20260730)
-    base = EngineConfig.for_tests()
+    base = dataclasses.replace(EngineConfig.for_tests(), model=model)
     ref_eng = JaxEngine(base)
 
-    for rnd in range(5):
+    for rnd in range(rounds):
         over = {
             "num_pages": rng.choice([16, 24, 48, 128]),
             "decode_steps": rng.choice([1, 2, 4, 8]),
