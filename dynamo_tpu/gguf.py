@@ -346,6 +346,8 @@ class GgufFile:
         )
         rope_scale = key("rope.scaling.factor")
         return LlamaConfig(
+            attention_bias=(arch == "qwen2"),
+            qk_norm=(arch == "qwen3"),
             vocab_size=vocab_size,
             hidden_size=embed,
             intermediate_size=int(key("feed_forward_length", 4 * embed)),

@@ -516,6 +516,17 @@ def params_from_gguf(gguf_file, cfg: LlamaConfig) -> dict:
             t("output_norm.weight", transpose=False), cfg.dtype
         ),
     }
+    if cfg.attention_bias:  # qwen2-family GGUFs carry qkv biases
+        params["layers"]["bq"] = stack("blk.{}.attn_q.bias", transpose=False)
+        params["layers"]["bk"] = stack("blk.{}.attn_k.bias", transpose=False)
+        params["layers"]["bv"] = stack("blk.{}.attn_v.bias", transpose=False)
+    if cfg.qk_norm:  # qwen3-family GGUFs carry per-head q/k norms
+        params["layers"]["q_norm"] = stack(
+            "blk.{}.attn_q_norm.weight", transpose=False
+        )
+        params["layers"]["k_norm"] = stack(
+            "blk.{}.attn_k_norm.weight", transpose=False
+        )
     if "output.weight" in g.tensors:
         params["lm_head"] = jnp.asarray(t("output.weight"), cfg.dtype)
     return params

@@ -248,7 +248,7 @@ def get_model(
 
         g = read_gguf(name)
         arch = g.architecture()
-        if arch not in ("llama", "qwen2"):
+        if arch not in ("llama", "qwen2", "qwen3"):
             raise ValueError(
                 f"unsupported GGUF architecture {arch!r} for {name}"
             )

@@ -234,3 +234,140 @@ def test_gguf_tokenizer_preserves_generated_whitespace(tmp_path):
     tok = load_tokenizer({"kind": "gguf", "path": path})
     assert tok.decode([1, 3]) == "\n\nhi"  # newlines survive
     assert tok.decode([2]) == "hi"  # dummy prefix stripped
+
+
+def _blk_tensors(cfg, params):
+    lp = params["layers"]
+    tensors = {
+        "token_embd.weight": np.asarray(params["embed"], np.float32),
+        "output_norm.weight": np.asarray(params["final_norm"], np.float32),
+    }
+    for l in range(cfg.num_layers):
+        tensors[f"blk.{l}.attn_norm.weight"] = np.asarray(
+            lp["attn_norm"][l], np.float32
+        )
+        tensors[f"blk.{l}.attn_q.weight"] = np.asarray(lp["wq"][l], np.float32).T
+        tensors[f"blk.{l}.attn_k.weight"] = np.asarray(lp["wk"][l], np.float32).T
+        tensors[f"blk.{l}.attn_v.weight"] = np.asarray(lp["wv"][l], np.float32).T
+        tensors[f"blk.{l}.attn_output.weight"] = np.asarray(
+            lp["wo"][l], np.float32
+        ).T
+        tensors[f"blk.{l}.ffn_norm.weight"] = np.asarray(
+            lp["mlp_norm"][l], np.float32
+        )
+        tensors[f"blk.{l}.ffn_gate.weight"] = np.asarray(
+            lp["w_gate"][l], np.float32
+        ).T
+        tensors[f"blk.{l}.ffn_up.weight"] = np.asarray(lp["w_up"][l], np.float32).T
+        tensors[f"blk.{l}.ffn_down.weight"] = np.asarray(
+            lp["w_down"][l], np.float32
+        ).T
+    if "lm_head" in params:
+        tensors["output.weight"] = np.asarray(params["lm_head"], np.float32).T
+    return tensors
+
+
+def _family_md(arch, cfg):
+    return {
+        "general.architecture": arch,
+        f"{arch}.block_count": cfg.num_layers,
+        f"{arch}.embedding_length": cfg.hidden_size,
+        f"{arch}.feed_forward_length": cfg.intermediate_size,
+        f"{arch}.attention.head_count": cfg.num_heads,
+        f"{arch}.attention.head_count_kv": cfg.num_kv_heads,
+        f"{arch}.attention.key_length": cfg.head_dim,
+        f"{arch}.attention.layer_norm_rms_epsilon": float(cfg.rms_norm_eps),
+        f"{arch}.rope.freq_base": float(cfg.rope_theta),
+        f"{arch}.vocab_size": cfg.vocab_size,
+        f"{arch}.context_length": 64,
+        "tokenizer.ggml.model": "llama",
+        "tokenizer.ggml.tokens": [f"<t{i}>" for i in range(cfg.vocab_size)],
+        "tokenizer.ggml.eos_token_id": 2,
+    }
+
+
+def test_gguf_qwen2_biases_load(tmp_path):
+    """qwen2-arch GGUFs carry qkv biases; the loaded model must match the
+    in-memory params exactly (biases silently dropped would diverge)."""
+    from dataclasses import replace
+
+    import jax
+    import jax.numpy as jnp
+
+    from dynamo_tpu.models.llama import LlamaConfig, forward, init_kv_pages, init_params
+    from dynamo_tpu.models.registry import get_model
+
+    cfg = replace(LlamaConfig.tiny(vocab_size=32), attention_bias=True)
+    params = init_params(jax.random.key(1), cfg)
+    # non-zero biases: a loader that drops them must fail the comparison
+    params["layers"]["bq"] = params["layers"]["bq"] + 0.1
+    params["layers"]["bk"] = params["layers"]["bk"] - 0.2
+    params["layers"]["bv"] = params["layers"]["bv"] + 0.3
+
+    tensors = _blk_tensors(cfg, params)
+    for l in range(cfg.num_layers):
+        for ours, g in (("bq", "attn_q"), ("bk", "attn_k"), ("bv", "attn_v")):
+            tensors[f"blk.{l}.{g}.bias"] = np.asarray(
+                params["layers"][ours][l], np.float32
+            )
+    path = str(tmp_path / "q2.gguf")
+    write_gguf(path, _family_md("qwen2", cfg), tensors)
+
+    adapter = get_model(path, dtype="float32")
+    assert adapter.config.attention_bias
+    loaded = adapter.load_params(path)
+
+    toks = np.arange(1, 9, dtype=np.int32)[None]
+    pts = np.asarray([[1, 2]], np.int32)
+    pos = np.arange(8, dtype=np.int32)[None]
+    kv1 = init_kv_pages(cfg, 8, 4)
+    kv2 = init_kv_pages(cfg, 8, 4)
+    a, _ = forward(params, cfg, jnp.asarray(toks), jnp.asarray(pos),
+                   jnp.ones((1, 8), bool), kv1, jnp.asarray(pts))
+    b, _ = forward(loaded, cfg, jnp.asarray(toks), jnp.asarray(pos),
+                   jnp.ones((1, 8), bool), kv2, jnp.asarray(pts))
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_gguf_qwen3_qk_norms_load(tmp_path):
+    """qwen3-arch GGUFs carry per-head q/k RMSNorm weights."""
+    from dataclasses import replace
+
+    import jax
+    import jax.numpy as jnp
+
+    from dynamo_tpu.models.llama import LlamaConfig, forward, init_kv_pages, init_params
+    from dynamo_tpu.models.registry import get_model
+
+    cfg = replace(LlamaConfig.tiny(vocab_size=32), qk_norm=True)
+    params = init_params(jax.random.key(2), cfg)
+    params["layers"]["q_norm"] = params["layers"]["q_norm"] * 1.5
+    params["layers"]["k_norm"] = params["layers"]["k_norm"] * 0.5
+
+    tensors = _blk_tensors(cfg, params)
+    for l in range(cfg.num_layers):
+        tensors[f"blk.{l}.attn_q_norm.weight"] = np.asarray(
+            params["layers"]["q_norm"][l], np.float32
+        )
+        tensors[f"blk.{l}.attn_k_norm.weight"] = np.asarray(
+            params["layers"]["k_norm"][l], np.float32
+        )
+    path = str(tmp_path / "q3.gguf")
+    write_gguf(path, _family_md("qwen3", cfg), tensors)
+
+    adapter = get_model(path, dtype="float32")
+    assert adapter.config.qk_norm
+    loaded = adapter.load_params(path)
+
+    toks = np.arange(1, 9, dtype=np.int32)[None]
+    pts = np.asarray([[1, 2]], np.int32)
+    pos = np.arange(8, dtype=np.int32)[None]
+    kv1 = init_kv_pages(cfg, 8, 4)
+    kv2 = init_kv_pages(cfg, 8, 4)
+    a, _ = forward(params, cfg, jnp.asarray(toks), jnp.asarray(pos),
+                   jnp.ones((1, 8), bool), kv1, jnp.asarray(pts))
+    b, _ = forward(loaded, cfg, jnp.asarray(toks), jnp.asarray(pos),
+                   jnp.ones((1, 8), bool), kv2, jnp.asarray(pts))
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5,
+                               atol=1e-5)
