@@ -35,8 +35,47 @@ from dynamo_tpu.models.llama import (
     LlamaConfig,
     attention_block,
     land_staged_kv,
+    quantize_channelwise_int8,
     rms_norm,
 )
+
+#: per-layer 2D weights int8 covers (w_router stays in the base dtype)
+_QUANT_ATTN = ("wq", "wk", "wv", "wo")
+_QUANT_EXPERTS = ("we_gate", "we_up", "we_down")  # [L, E, in, out]
+
+
+def _w(lp: dict, name: str, dtype):
+    """lp[name], dequantized when int8 (einsum-consumed expert stacks —
+    XLA fuses the convert+scale into the consumer's operand read)."""
+    w = lp[name]
+    if w.dtype == jnp.int8:
+        return w.astype(dtype) * lp[name + "_scale"].astype(dtype)
+    return w.astype(dtype)
+
+
+def quantize_params_int8(params: dict) -> dict:
+    """Weight-only int8 over the MoE layout: attention projections via
+    llama's per-layer scheme, expert stacks per (layer, expert)."""
+    out = dict(params)
+    layers = dict(params["layers"])
+    if any(
+        layers.get(n) is not None and layers[n].dtype == jnp.int8
+        for n in _QUANT_ATTN + _QUANT_EXPERTS
+    ):
+        raise ValueError("params are already int8-quantized")
+    for name in _QUANT_ATTN:
+        q, sc = jax.lax.map(quantize_channelwise_int8, layers[name])
+        layers[name] = q
+        layers[name + "_scale"] = sc
+    for name in _QUANT_EXPERTS:
+        q, sc = jax.lax.map(
+            lambda we: jax.lax.map(quantize_channelwise_int8, we),
+            layers[name],
+        )
+        layers[name] = q
+        layers[name + "_scale"] = sc
+    out["layers"] = layers
+    return out
 
 
 @dataclass(frozen=True)
@@ -280,11 +319,15 @@ def moe_ffn(x: jax.Array, lp: dict, cfg: MoeConfig) -> jax.Array:
     d = dispatch.astype(x.dtype)
     expert_in = jnp.einsum("nh,nec->ech", xf, d)  # [E, C, H]
     gate = jax.nn.silu(
-        jnp.einsum("ech,ehi->eci", expert_in, lp["we_gate"]).astype(jnp.float32)
+        jnp.einsum(
+            "ech,ehi->eci", expert_in, _w(lp, "we_gate", x.dtype)
+        ).astype(jnp.float32)
     )
-    up = jnp.einsum("ech,ehi->eci", expert_in, lp["we_up"]).astype(jnp.float32)
+    up = jnp.einsum(
+        "ech,ehi->eci", expert_in, _w(lp, "we_up", x.dtype)
+    ).astype(jnp.float32)
     expert_out = jnp.einsum(
-        "eci,eih->ech", (gate * up).astype(x.dtype), lp["we_down"]
+        "eci,eih->ech", (gate * up).astype(x.dtype), _w(lp, "we_down", x.dtype)
     )  # [E, C, H]
     out = jnp.einsum(
         "ech,nec->nh", expert_out.astype(jnp.float32), combine
@@ -316,9 +359,15 @@ def forward_hidden(
         lp, li = xs
         x = rms_norm(h, lp["attn_norm"], bc.rms_norm_eps)
         b, t, _ = x.shape
-        q = (x @ lp["wq"]).reshape(b, t, bc.num_heads, bc.head_dim)
-        k = (x @ lp["wk"]).reshape(b, t, bc.num_kv_heads, bc.head_dim)
-        v = (x @ lp["wv"]).reshape(b, t, bc.num_kv_heads, bc.head_dim)
+        q = llama_mod._mm(x, lp, "wq", bc.dtype).reshape(
+            b, t, bc.num_heads, bc.head_dim
+        )
+        k = llama_mod._mm(x, lp, "wk", bc.dtype).reshape(
+            b, t, bc.num_kv_heads, bc.head_dim
+        )
+        v = llama_mod._mm(x, lp, "wv", bc.dtype).reshape(
+            b, t, bc.num_kv_heads, bc.head_dim
+        )
         if bc.qk_norm:  # Qwen3-MoE: per-head RMSNorm pre-rope
             q = rms_norm(q, lp["q_norm"], bc.rms_norm_eps)
             k = rms_norm(k, lp["k_norm"], bc.rms_norm_eps)
@@ -326,7 +375,7 @@ def forward_hidden(
             q, k, v, k_full, v_full, li, page_tables, positions, valid, bc,
             first_chunk=first_chunk, mesh=mesh,
         )
-        h = h + attn @ lp["wo"]
+        h = h + llama_mod._mm(attn, lp, "wo", bc.dtype)
         x = rms_norm(h, lp["mlp_norm"], bc.rms_norm_eps)
         h = h + moe_ffn(x, lp, cfg)
         return (h, k_full, v_full), staged
@@ -348,19 +397,26 @@ def forward(params, cfg: MoeConfig, tokens, positions, valid, kv, page_tables):
     return llama_mod.compute_logits(params, cfg.base, h), kv
 
 
-def moe_param_specs(cfg: MoeConfig):
+def moe_param_specs(cfg: MoeConfig, quantized: bool = False):
     """Llama specs + expert weights sharded on the ep axis; expert
-    intermediate dims additionally on tp."""
+    intermediate dims additionally on tp. Quantized scales ride their
+    weight's output-dim shard (contraction-sharded wo/we_down keep
+    replicated/ep-only scales)."""
     from jax.sharding import PartitionSpec as P
 
     from dynamo_tpu.parallel.shardings import llama_param_specs
 
-    specs = llama_param_specs(cfg.base)
+    specs = llama_param_specs(cfg.base, quantized=quantized)
     layers = specs["layers"]
     for name in ("w_gate", "w_up", "w_down"):
         del layers[name]
+        layers.pop(name + "_scale", None)
     layers["w_router"] = P(None, None, None)
     layers["we_gate"] = P(None, "ep", None, "tp")
     layers["we_up"] = P(None, "ep", None, "tp")
     layers["we_down"] = P(None, "ep", "tp", None)
+    if quantized:
+        layers["we_gate_scale"] = P(None, "ep", None, "tp")
+        layers["we_up_scale"] = P(None, "ep", None, "tp")
+        layers["we_down_scale"] = P(None, "ep", None, None)
     return specs

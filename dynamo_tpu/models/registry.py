@@ -210,10 +210,12 @@ def _moe_adapter(name: str, moe_cfg, mesh=None) -> ModelAdapter:
         init_kv=lambda num_pages, page_size: llama_mod.init_kv_pages(
             cfg.base, num_pages, page_size
         ),
-        # same signature as the llama adapter; MoE has no quantized layout
-        param_specs=lambda quantized=False: moe_mod.moe_param_specs(cfg),
+        param_specs=lambda quantized=False: moe_mod.moe_param_specs(
+            cfg, quantized=quantized
+        ),
         kv_spec=lambda: KVPages(k=kv_cache_spec(), v=kv_cache_spec()),
         load_params=load,
+        quantize_params=moe_mod.quantize_params_int8,
     )
 
 

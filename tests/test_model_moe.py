@@ -233,3 +233,53 @@ def test_qwen3_moe_against_hf():
     ours = np.asarray(logits)
     np.testing.assert_allclose(ours, ref, rtol=5e-2, atol=5e-2)
     assert (ours.argmax(-1) == ref.argmax(-1)).mean() > 0.9
+
+
+def test_moe_int8_quantized_serving(cpu_mesh_devices):
+    """Weight-only int8 over the MoE layout serves (single-chip AND on a
+    tp x ep mesh: scale leaves need matching PartitionSpecs) and stays
+    close to the fp forward."""
+    from dataclasses import replace as _replace
+
+    import jax
+
+    from dynamo_tpu.engine import EngineConfig
+    from dynamo_tpu.engine.engine import JaxEngine
+    from dynamo_tpu.engine.request import SamplingParams
+    from dynamo_tpu.models.moe import (
+        MoeConfig,
+        forward,
+        init_params as moe_init,
+        quantize_params_int8,
+    )
+
+    cfg = MoeConfig.tiny()
+    params = moe_init(jax.random.key(4), cfg)
+    qparams = quantize_params_int8(params)
+    assert qparams["layers"]["we_gate"].dtype == jnp.int8
+
+    toks = np.arange(1, 9, dtype=np.int32)[None]
+    pts = np.asarray([[1, 2]], np.int32)
+    pos = np.arange(8, dtype=np.int32)[None]
+    kv1 = init_kv_pages(cfg.base, 8, 4)
+    kv2 = init_kv_pages(cfg.base, 8, 4)
+    a, _ = forward(params, cfg, jnp.asarray(toks), jnp.asarray(pos),
+                   jnp.ones((1, 8), bool), kv1, jnp.asarray(pts))
+    b, _ = forward(qparams, cfg, jnp.asarray(toks), jnp.asarray(pos),
+                   jnp.ones((1, 8), bool), kv2, jnp.asarray(pts))
+    assert (np.asarray(a).argmax(-1) == np.asarray(b).argmax(-1)).mean() > 0.7
+
+    for tp, ep in ((1, 1), (2, 2)):
+        eng = JaxEngine(
+            EngineConfig(
+                model="moe-tiny", tp=tp, ep=ep, num_pages=32, page_size=4,
+                max_pages_per_seq=8, decode_buckets=(2,), prefill_chunk=8,
+                max_seqs=2, dtype="float32", quantize="int8",
+            )
+        )
+        rng = np.random.default_rng(5)
+        eng.add_request(
+            "r0", [int(x) for x in rng.integers(1, 250, 6)],
+            SamplingParams(temperature=0.0, max_tokens=3),
+        )
+        assert len(eng.run_to_completion()["r0"]) == 3
