@@ -637,6 +637,17 @@ def _mm(x: jax.Array, lp: dict, name: str, dtype) -> jax.Array:
     return x @ w
 
 
+def _w(lp: dict, name: str, dtype) -> jax.Array:
+    """lp[name], dequantized when int8 — for weights consumed by einsum
+    (the scale varies over non-factorable axes, so dequant first; XLA
+    fuses the convert+scale into the consumer's operand read). Shared by
+    every family (mla/moe expert stacks, wkv_b)."""
+    w = lp[name]
+    if w.dtype == jnp.int8:
+        return w.astype(dtype) * lp[name + "_scale"].astype(dtype)
+    return w.astype(dtype)
+
+
 def quantize_channelwise_int8(w: jax.Array):
     """THE int8 scheme, shared by every family's quantize/init path:
     per-output-channel symmetric max-abs scales over a [in, out] weight.

@@ -54,6 +54,7 @@ from jax import lax
 from dynamo_tpu.models.llama import (
     KVPages,
     _mm,
+    _w,
     paged_gather,
     paged_scatter,
     quantize_channelwise_int8,
@@ -68,16 +69,6 @@ _QUANT_2D = (
     "w_gate", "w_up", "w_down", "ws_gate", "ws_up", "ws_down",
 )
 _QUANT_EXPERTS = ("we_gate", "we_up", "we_down")  # [L, E, in, out]
-
-
-def _w(lp: dict, name: str, dtype) -> jax.Array:
-    """lp[name], dequantized when int8 — for weights consumed by einsum
-    (the scale varies over non-factorable axes, so dequant first; XLA
-    fuses the convert+scale into the consumer's operand read)."""
-    w = lp[name]
-    if w.dtype == jnp.int8:
-        return w.astype(dtype) * lp[name + "_scale"].astype(dtype)
-    return w.astype(dtype)
 
 
 @dataclass(frozen=True)

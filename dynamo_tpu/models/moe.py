@@ -33,6 +33,7 @@ from dynamo_tpu.models import llama as llama_mod
 from dynamo_tpu.models.llama import (
     KVPages,
     LlamaConfig,
+    _w,
     attention_block,
     land_staged_kv,
     quantize_channelwise_int8,
@@ -42,15 +43,6 @@ from dynamo_tpu.models.llama import (
 #: per-layer 2D weights int8 covers (w_router stays in the base dtype)
 _QUANT_ATTN = ("wq", "wk", "wv", "wo")
 _QUANT_EXPERTS = ("we_gate", "we_up", "we_down")  # [L, E, in, out]
-
-
-def _w(lp: dict, name: str, dtype):
-    """lp[name], dequantized when int8 (einsum-consumed expert stacks —
-    XLA fuses the convert+scale into the consumer's operand read)."""
-    w = lp[name]
-    if w.dtype == jnp.int8:
-        return w.astype(dtype) * lp[name + "_scale"].astype(dtype)
-    return w.astype(dtype)
 
 
 def quantize_params_int8(params: dict) -> dict:

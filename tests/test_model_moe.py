@@ -239,8 +239,6 @@ def test_moe_int8_quantized_serving(cpu_mesh_devices):
     """Weight-only int8 over the MoE layout serves (single-chip AND on a
     tp x ep mesh: scale leaves need matching PartitionSpecs) and stays
     close to the fp forward."""
-    from dataclasses import replace as _replace
-
     import jax
 
     from dynamo_tpu.engine import EngineConfig
