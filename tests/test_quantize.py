@@ -112,11 +112,7 @@ def test_quantize_rejects_unsupported():
     base = EngineConfig.for_tests()
     with pytest.raises(ValueError, match="unsupported quantize"):
         JaxEngine(EngineConfig(**{**base.__dict__, "quantize": "int4"}))
-    moe = EngineConfig(
-        **{**base.__dict__, "quantize": "int8", "model": "moe-tiny"}
-    )
-    with pytest.raises(ValueError, match="Llama-family"):
-        JaxEngine(moe)
+    # (MoE int8 is now supported — tests/test_model_moe.py serves it.)
 
 
 def test_double_quantize_rejected():
