@@ -191,6 +191,17 @@ class LlamaConfig:
         )
 
     @staticmethod
+    def phi3_mini() -> "LlamaConfig":
+        """Phi-3-mini-4k: Llama architecture with fused qkv/gate_up
+        projections in the checkpoint (split at load); 128k longrope
+        variants are refused."""
+        return LlamaConfig(
+            vocab_size=32064, hidden_size=3072, intermediate_size=8192,
+            num_layers=32, num_heads=32, num_kv_heads=32, head_dim=96,
+            rope_theta=10000.0, rms_norm_eps=1e-5,
+        )
+
+    @staticmethod
     def mistral_7b() -> "LlamaConfig":
         """Mistral-7B-v0.1: Llama architecture + sliding-window attention
         on every layer (window 4096)."""
@@ -396,6 +407,26 @@ def params_from_torch_state_dict(state_dict, cfg: LlamaConfig) -> dict:
         return np.asarray(w.to("cpu").float().numpy())
 
     L = cfg.num_layers
+
+    if "model.layers.0.self_attn.qkv_proj.weight" in state_dict:
+        # Phi-3 fuses qkv and gate_up; split into the canonical leaves so
+        # one forward serves the family (HF Phi3Attention chunks in
+        # q/k/v order, Phi3MLP in gate/up order).
+        qd = cfg.num_heads * cfg.head_dim
+        kvd = cfg.num_kv_heads * cfg.head_dim
+        for l in range(L):
+            qkv = state_dict[f"model.layers.{l}.self_attn.qkv_proj.weight"]
+            state_dict[f"model.layers.{l}.self_attn.q_proj.weight"] = qkv[:qd]
+            state_dict[f"model.layers.{l}.self_attn.k_proj.weight"] = (
+                qkv[qd : qd + kvd]
+            )
+            state_dict[f"model.layers.{l}.self_attn.v_proj.weight"] = (
+                qkv[qd + kvd :]
+            )
+            gu = state_dict[f"model.layers.{l}.mlp.gate_up_proj.weight"]
+            half = gu.shape[0] // 2
+            state_dict[f"model.layers.{l}.mlp.gate_proj.weight"] = gu[:half]
+            state_dict[f"model.layers.{l}.mlp.up_proj.weight"] = gu[half:]
 
     def stack(fmt, transpose=True):
         ws = [t(fmt.format(l)) for l in range(L)]

@@ -69,6 +69,8 @@ _LLAMA_PRESETS: dict[str, Callable[[], LlamaConfig]] = {
     "mistral-7b": LlamaConfig.mistral_7b,
     # Qwen3 = Llama + per-head q/k RMSNorm (no attention bias).
     "qwen3-8b": LlamaConfig.qwen3_8b,
+    # Phi-3 = Llama with fused qkv/gate_up in the checkpoint.
+    "phi3-mini": LlamaConfig.phi3_mini,
 }
 
 
@@ -281,8 +283,11 @@ def get_model(
             or arch in (
                 "GemmaForCausalLM", "Gemma2ForCausalLM",
                 "MistralForCausalLM", "Qwen3ForCausalLM",
+                "Phi3ForCausalLM",
             )
-            or hf.get("model_type") in ("gemma", "gemma2", "mistral", "qwen3")
+            or hf.get("model_type") in (
+                "gemma", "gemma2", "mistral", "qwen3", "phi3"
+            )
             # Gemma 3 and RecurrentGemma remain different architectures —
             # refuse those rather than run a silently-wrong model.
         ):
