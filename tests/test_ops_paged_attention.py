@@ -283,3 +283,41 @@ def test_full_model_decode_hybrid_matches_xla_both_sides_of_threshold():
     np.testing.assert_allclose(
         results["hybrid_kernel"], results["xla"], rtol=1e-5, atol=1e-5
     )
+
+
+def test_hybrid_serves_under_tp_mesh(cpu_mesh_devices):
+    """hybrid impl on a tp=2 mesh, with the decode bucket ABOVE the
+    pallas threshold so the XLA-gather branch runs against the sharded
+    (lane-padded) cache; tokens must match the single-chip xla engine."""
+    from dataclasses import replace as _replace
+
+    from dynamo_tpu.engine import EngineConfig
+    from dynamo_tpu.engine.engine import JaxEngine
+    from dynamo_tpu.engine.request import SamplingParams
+    from dynamo_tpu.models.registry import _LLAMA_PRESETS
+
+    _LLAMA_PRESETS["hybrid-test-tiny"] = lambda: _replace(
+        LlamaConfig.tiny(), pallas_decode_max_batch=1
+    )
+    try:
+        kw = dict(
+            model="hybrid-test-tiny", num_pages=32, page_size=4,
+            max_pages_per_seq=8, decode_buckets=(2,), prefill_chunk=8,
+            max_seqs=2, dtype="float32",
+        )
+        outs = {}
+        for name, extra in (
+            ("xla", dict(attention_impl="xla")),
+            ("hybrid_tp", dict(attention_impl="hybrid", tp=2)),
+        ):
+            eng = JaxEngine(EngineConfig(**kw, **extra))
+            rng = np.random.default_rng(9)
+            for i in range(2):
+                eng.add_request(
+                    f"r{i}", [int(x) for x in rng.integers(1, 250, 6 + i)],
+                    SamplingParams(temperature=0.0, max_tokens=4),
+                )
+            outs[name] = eng.run_to_completion()
+        assert outs["hybrid_tp"] == outs["xla"], outs
+    finally:
+        _LLAMA_PRESETS.pop("hybrid-test-tiny", None)
