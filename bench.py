@@ -105,32 +105,52 @@ def main() -> None:
     # One wave: every request resident at once (weights amortize across
     # the whole batch), pages sized for prompt+output per sequence.
     pages_per_seq = -(-(isl + osl + 1) // 64)
-    cfg = EngineConfig(
-        model=model,
-        num_pages=max(512, num_requests * (pages_per_seq + 1)),
-        page_size=64,
-        max_pages_per_seq=max(16, pages_per_seq + 1),
-        # Buckets up to and INCLUDING one that fits the whole batch, so
-        # decode really runs as one wave (the scheduler caps batches at
-        # decode_buckets[-1]).
-        decode_buckets=tuple(
-            b for b in (1, 2, 4, 8, 16, 32, 64, 128, 256, 512)
-            if b < num_requests
-        ) + (num_requests,),
-        prefill_chunk=chunk,
-        # Whole-workload dispatches: all prompts prefill in one batched
-        # program; decode fuses K steps per host sync (the TPU sits behind
-        # a ~65ms tunnel round-trip, so syncs dominate unamortized).
-        prefill_token_budget=num_requests * chunk,
-        decode_steps=int(os.environ.get("BENCH_DECODE_STEPS", "64")),
-        max_seqs=max(32, num_requests),
-        dtype="bfloat16",
-        enable_prefix_caching=False,
-        # llama3-8b bf16 (16GB) exceeds a v5e chip's HBM; int8 weight-only
-        # (BENCH_QUANTIZE=int8) fits it alongside the KV pages.
-        quantize=os.environ.get("BENCH_QUANTIZE") or None,
-    )
-    eng = JaxEngine(cfg)
+
+    def make_engine(attention_impl: str) -> JaxEngine:
+        cfg = EngineConfig(
+            model=model,
+            num_pages=max(512, num_requests * (pages_per_seq + 1)),
+            page_size=64,
+            max_pages_per_seq=max(16, pages_per_seq + 1),
+            # Buckets up to and INCLUDING one that fits the whole batch, so
+            # decode really runs as one wave (the scheduler caps batches at
+            # decode_buckets[-1]).
+            decode_buckets=tuple(
+                b for b in (1, 2, 4, 8, 16, 32, 64, 128, 256, 512)
+                if b < num_requests
+            ) + (num_requests,),
+            prefill_chunk=chunk,
+            # Whole-workload dispatches: all prompts prefill in one batched
+            # program; decode fuses K steps per host sync (the TPU sits
+            # behind a ~65ms tunnel round-trip, so syncs dominate
+            # unamortized).
+            prefill_token_budget=num_requests * chunk,
+            decode_steps=int(os.environ.get("BENCH_DECODE_STEPS", "64")),
+            max_seqs=max(32, num_requests),
+            dtype="bfloat16",
+            enable_prefix_caching=False,
+            # llama3-8b bf16 (16GB) exceeds a v5e chip's HBM; int8
+            # weight-only (BENCH_QUANTIZE=int8) fits it alongside the KV
+            # pages.
+            quantize=os.environ.get("BENCH_QUANTIZE") or None,
+            attention_impl=attention_impl,
+        )
+        return JaxEngine(cfg)
+
+    # Serving-config sweep: the pallas page-walk decode is latency-optimal
+    # at small batch but issues O(B x pages) DMA descriptors per layer;
+    # "hybrid" gates large decode buckets onto the XLA gather. The bench
+    # measures both on TPU and reports the BEST (per-impl numbers in
+    # extras) — picking a serving config is legitimate tuning, hiding the
+    # loser would not be.
+    default_impls = "auto,hybrid" if platform == "tpu" else "auto"
+    impls = [
+        i.strip()
+        for i in os.environ.get("BENCH_ATTENTION", default_impls).split(",")
+        if i.strip()
+    ]
+
+    eng = make_engine(impls[0])
 
     import jax
 
@@ -163,47 +183,72 @@ def main() -> None:
         [int(x) for x in rng.integers(1, 32000, isl)] for _ in range(num_requests)
     ]
 
-    # Warmup with the SAME workload (all requests, same osl) so every
-    # decode bucket, fused-step count, and prefill program the timed run
-    # uses is compiled before the timer starts — otherwise tok/s and TTFT
-    # measure XLA (the fused decode K adapts to remaining max_tokens, so a
-    # short warmup osl would compile the wrong K).
-    for i, p in enumerate(prompts):
-        eng.add_request(f"warm{i}", p, SamplingParams(temperature=0.0, max_tokens=osl))
-    eng.run_to_completion()
-    eng.allocator.clear_cache()
-
-    t0 = time.time()
-    submit = {}
-    first_token = {}
-    last_token = {}
-    tokens_of = {}
-    for i, p in enumerate(prompts):
-        rid = f"r{i}"
-        submit[rid] = time.time()
-        eng.add_request(rid, p, SamplingParams(temperature=0.0, max_tokens=osl))
-    generated = 0
-    while eng.has_work:
-        for out in eng.step():
-            now = time.time()
-            generated += len(out.new_token_ids)
-            tokens_of[out.request_id] = tokens_of.get(out.request_id, 0) + len(
-                out.new_token_ids
+    def run_timed(eng) -> dict:
+        # Warmup with the SAME workload (all requests, same osl) so every
+        # decode bucket, fused-step count, and prefill program the timed
+        # run uses is compiled before the timer starts — otherwise tok/s
+        # and TTFT measure XLA (the fused decode K adapts to remaining
+        # max_tokens, so a short warmup osl would compile the wrong K).
+        for i, p in enumerate(prompts):
+            eng.add_request(
+                f"warm{i}", p,
+                SamplingParams(temperature=0.0, max_tokens=osl),
             )
-            if out.is_first and out.request_id not in first_token:
-                first_token[out.request_id] = now
-            last_token[out.request_id] = now
-    elapsed = time.time() - t0
+        eng.run_to_completion()
+        eng.allocator.clear_cache()
 
-    ttfts = sorted(first_token[r] - submit[r] for r in first_token)
-    p50_ttft = ttfts[len(ttfts) // 2] if ttfts else float("nan")
-    itls = sorted(
-        (last_token[r] - first_token[r]) / (tokens_of[r] - 1)
-        for r in first_token
-        if tokens_of.get(r, 0) > 1
-    )
-    p50_itl = itls[len(itls) // 2] if itls else float("nan")
-    tok_s = generated / elapsed
+        t0 = time.time()
+        submit = {}
+        first_token = {}
+        last_token = {}
+        tokens_of = {}
+        for i, p in enumerate(prompts):
+            rid = f"r{i}"
+            submit[rid] = time.time()
+            eng.add_request(
+                rid, p, SamplingParams(temperature=0.0, max_tokens=osl)
+            )
+        generated = 0
+        while eng.has_work:
+            for out in eng.step():
+                now = time.time()
+                generated += len(out.new_token_ids)
+                tokens_of[out.request_id] = tokens_of.get(
+                    out.request_id, 0
+                ) + len(out.new_token_ids)
+                if out.is_first and out.request_id not in first_token:
+                    first_token[out.request_id] = now
+                last_token[out.request_id] = now
+        elapsed = time.time() - t0
+        ttfts = sorted(first_token[r] - submit[r] for r in first_token)
+        itls = sorted(
+            (last_token[r] - first_token[r]) / (tokens_of[r] - 1)
+            for r in first_token
+            if tokens_of.get(r, 0) > 1
+        )
+        return {
+            "tok_s": generated / elapsed,
+            "p50_ttft": ttfts[len(ttfts) // 2] if ttfts else float("nan"),
+            "p50_itl": itls[len(itls) // 2] if itls else float("nan"),
+            "elapsed": elapsed,
+            "generated": generated,
+        }
+
+    per_impl = {impls[0]: run_timed(eng)}
+    for impl in impls[1:]:
+        import gc
+
+        del eng
+        gc.collect()
+        eng = make_engine(impl)
+        per_impl[impl] = run_timed(eng)
+    best_impl = max(per_impl, key=lambda k: per_impl[k]["tok_s"])
+    best = per_impl[best_impl]
+    tok_s = best["tok_s"]
+    p50_ttft = best["p50_ttft"]
+    p50_itl = best["p50_itl"]
+    elapsed = best["elapsed"]
+    generated = best["generated"]
 
     # Approximate MFU: decode is ~2*params FLOPs/token; prefill adds
     # 2*params per prompt token (attention FLOPs are second-order at these
@@ -249,6 +294,19 @@ def main() -> None:
                 "mfu": round(mfu, 4) if mfu == mfu else None,
                 "elapsed_s": round(elapsed, 2),
                 "generated_tokens": generated,
+                "attention_impl": best_impl,
+                "attention_impls": {
+                    k: {
+                        "tok_s": round(v["tok_s"], 2),
+                        "p50_ttft_s": round(v["p50_ttft"], 4),
+                        "p50_itl_s": (
+                            round(v["p50_itl"], 5)
+                            if v["p50_itl"] == v["p50_itl"]
+                            else None
+                        ),
+                    }
+                    for k, v in per_impl.items()
+                },
             },
         }
     )
