@@ -77,11 +77,18 @@ bench_dsv2() {
   # latent cache + weight-only int8 make it fit; random weights (no
   # checkpoints in the image), so tok/s+MFU are the story, not quality.
   BENCH_MODEL=deepseek-v2-lite BENCH_QUANTIZE=int8 BENCH_REQUESTS=32 \
+    BENCH_ATTENTION=auto \
     run_stage bench_dsv2 python bench.py
 }
 
+bench_1b_sweep() {
+  # re-capture the headline with the attention-impl sweep (auto vs
+  # hybrid); bench.py reports the best with both in extras
+  run_stage bench_1b python bench.py
+}
+
 STAGES=("$@")
-[ ${#STAGES[@]} -eq 0 ] && STAGES=(disagg_ab sweep_8b ft_kill routing offload bench_dsv2 decode_profile)
+[ ${#STAGES[@]} -eq 0 ] && STAGES=(disagg_ab sweep_8b ft_kill routing offload bench_dsv2 decode_profile bench_1b_sweep)
 
 wait_for_tunnel
 for s in "${STAGES[@]}"; do
