@@ -153,6 +153,9 @@ def main() -> None:
     ap.add_argument("--minutes", type=float, default=20.0)
     ap.add_argument("--concurrency", type=int, default=8)
     ap.add_argument("--workers", type=int, default=2)
+    ap.add_argument("--disagg", action="store_true",
+                    help="decode(+host tier, remote prefill) + prefill "
+                    "fleet instead of plain workers")
     args = ap.parse_args()
 
     fport, hport = _free_port(), _free_port()
@@ -165,14 +168,34 @@ def main() -> None:
         fb = Proc("fabric", _cli("fabric", "--port", str(fport)))
         procs.append(fb)
         fb.wait_for("listening|fabric server on")
-        for i in range(args.workers):
-            w = Proc(
-                f"worker{i}",
+        if args.disagg:
+            d = Proc(
+                "decode",
                 _cli("run", "in=dyn", "out=jax", *engine,
+                     "--disagg", "--max-local-prefill", "4",
+                     "--transfer-timeout", "5",
+                     "--host-kv-bytes", str(1 << 20),
                      "--fabric", f"127.0.0.1:{fport}"),
             )
-            procs.append(w)
-            w.wait_for(r"worker \w+ up", timeout=300)
+            procs.append(d)
+            d.wait_for(r"worker \w+ up", timeout=300)
+            p0 = Proc(
+                "prefill",
+                _cli("run", "in=dyn", "out=jax", *engine,
+                     "--role", "prefill",
+                     "--fabric", f"127.0.0.1:{fport}"),
+            )
+            procs.append(p0)
+            p0.wait_for(r"prefill worker \w+ up", timeout=300)
+        else:
+            for i in range(args.workers):
+                w = Proc(
+                    f"worker{i}",
+                    _cli("run", "in=dyn", "out=jax", *engine,
+                         "--fabric", f"127.0.0.1:{fport}"),
+                )
+                procs.append(w)
+                w.wait_for(r"worker \w+ up", timeout=300)
         fe = Proc(
             "frontend",
             _cli("run", "in=http", "out=dyn",
@@ -187,6 +210,7 @@ def main() -> None:
         )
         out["minutes"] = args.minutes
         out["workers"] = args.workers
+        out["topology"] = "disagg+tier" if args.disagg else "agg"
         # soak verdict: no transport failures, every process's post-warmup
         # RSS growth bounded
         out["ok_verdict"] = bool(
@@ -198,7 +222,10 @@ def main() -> None:
         )
         path = Path(__file__).resolve().parent.parent / "artifacts"
         path.mkdir(exist_ok=True)
-        (path / "soak_distributed.json").write_text(json.dumps(out, indent=1))
+        name = (
+            "soak_disagg.json" if args.disagg else "soak_distributed.json"
+        )
+        (path / name).write_text(json.dumps(out, indent=1))
         print(json.dumps(out, indent=1))
         sys.exit(0 if out["ok_verdict"] else 1)
     finally:
