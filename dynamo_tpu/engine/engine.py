@@ -163,7 +163,6 @@ class JaxEngine:
             )
         self.scheduler = Scheduler(config, self.allocator)
         self.metrics = EngineMetrics(kv_total_pages=config.num_pages - 1)
-        self._outputs_emitted: set[str] = set()
         self._jit_cache: dict[tuple, Callable] = {}
         #: adaptive speculation: steps left on the fused path after a
         #: low-acceptance spec dispatch

@@ -156,7 +156,12 @@ def main() -> None:
     ap.add_argument("--disagg", action="store_true",
                     help="decode(+host tier, remote prefill) + prefill "
                     "fleet instead of plain workers")
+    ap.add_argument("--spmd", action="store_true",
+                    help="one dp=2 x tp=2 model sharded over TWO host "
+                    "processes (lockstep broadcast under load)")
     args = ap.parse_args()
+
+    import os as _os
 
     fport, hport = _free_port(), _free_port()
     engine = [
@@ -168,7 +173,38 @@ def main() -> None:
         fb = Proc("fabric", _cli("fabric", "--port", str(fport)))
         procs.append(fb)
         fb.wait_for("listening|fabric server on")
-        if args.disagg:
+        if args.spmd:
+            cport = _free_port()
+            spmd = [
+                "run", "in=dyn", "out=jax", *engine,
+                "--dp", "2", "--tp", "2",
+                "--coordinator", f"127.0.0.1:{cport}", "--num-hosts", "2",
+                "--fabric", f"127.0.0.1:{fport}",
+            ]
+
+            def _env(devices):
+                env = {
+                    k: v for k, v in _os.environ.items()
+                    if k not in ("JAX_PLATFORMS", "XLA_FLAGS")
+                }
+                env["JAX_PLATFORMS"] = "cpu"
+                env["XLA_FLAGS"] = (
+                    f"--xla_force_host_platform_device_count={devices}"
+                )
+                env["PYTHONPATH"] = _os.path.dirname(
+                    _os.path.dirname(_os.path.abspath(__file__))
+                )
+                return env
+
+            leader = Proc("leader", [*_cli(*spmd), "--host-id", "0"],
+                          env=_env(2))
+            procs.append(leader)
+            follower = Proc("follower", [*_cli(*spmd), "--host-id", "1"],
+                            env=_env(2))
+            procs.append(follower)
+            follower.wait_for("spmd follower 1 up", timeout=300)
+            leader.wait_for(r"worker \w+ up", timeout=300)
+        elif args.disagg:
             d = Proc(
                 "decode",
                 _cli("run", "in=dyn", "out=jax", *engine,
@@ -210,7 +246,10 @@ def main() -> None:
         )
         out["minutes"] = args.minutes
         out["workers"] = args.workers
-        out["topology"] = "disagg+tier" if args.disagg else "agg"
+        out["topology"] = (
+            "spmd-2host" if args.spmd
+            else "disagg+tier" if args.disagg else "agg"
+        )
         # soak verdict: no transport failures, every process's post-warmup
         # RSS growth bounded
         out["ok_verdict"] = bool(
@@ -223,7 +262,9 @@ def main() -> None:
         path = Path(__file__).resolve().parent.parent / "artifacts"
         path.mkdir(exist_ok=True)
         name = (
-            "soak_disagg.json" if args.disagg else "soak_distributed.json"
+            "soak_spmd.json" if args.spmd
+            else "soak_disagg.json" if args.disagg
+            else "soak_distributed.json"
         )
         (path / name).write_text(json.dumps(out, indent=1))
         print(json.dumps(out, indent=1))
