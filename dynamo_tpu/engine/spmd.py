@@ -46,24 +46,39 @@ logger = logging.getLogger("dynamo_tpu.spmd")
 __all__ = ["SpmdDriver"]
 
 
+def _bucket_len(n: int) -> int:
+    """Next power of two (min 1 KiB): broadcast_one_to_all compiles one
+    program PER ARRAY SHAPE, and event-log byte lengths are effectively
+    unique per round — unpadded payloads leaked a compiled executable
+    per distinct length on EVERY host (~300 MB / 15 min in the SPMD
+    soak). Bucketing keeps the program family logarithmic."""
+    b = 1024
+    while b < n:
+        b *= 2
+    return b
+
+
 def _broadcast_bytes(payload: Optional[bytes], is_leader: bool) -> bytes:
     """Leader ships `payload` to every process; followers pass None.
-    Two collectives: a fixed-shape length, then the padded payload."""
+    Two collectives: a fixed-shape length, then the bucket-padded
+    payload (sliced back to the exact length on receipt)."""
     from jax.experimental import multihost_utils
 
     if is_leader:
-        data = np.frombuffer(payload, np.uint8)
-        n = np.asarray(len(data), np.int32)
+        n = np.asarray(len(payload), np.int32)
     else:
-        data = None
         n = np.asarray(0, np.int32)
     n = int(multihost_utils.broadcast_one_to_all(n, is_source=is_leader))
     if n == 0:
         return b""
-    if data is None:
-        data = np.zeros(n, np.uint8)
+    b = _bucket_len(n)
+    if is_leader:
+        data = np.zeros(b, np.uint8)
+        data[:n] = np.frombuffer(payload, np.uint8)
+    else:
+        data = np.zeros(b, np.uint8)
     out = multihost_utils.broadcast_one_to_all(data, is_source=is_leader)
-    return bytes(np.asarray(out))
+    return bytes(np.asarray(out)[:n])
 
 
 class SpmdDriver:
