@@ -10,8 +10,9 @@ asserting zero transport-level failures and a bounded post-warmup RSS
 slope on every process (leak detection for the fabric, workers, and
 frontend alike).
 
-Usage: python scripts/soak_distributed.py --minutes 20
-Writes artifacts/soak_distributed.json.
+Usage: python scripts/soak_distributed.py --minutes 20 [--disagg|--spmd]
+Writes artifacts/soak_distributed.json (agg), soak_disagg.json, or
+soak_spmd.json per topology.
 """
 
 from __future__ import annotations
