@@ -55,7 +55,11 @@ def run_topology(args, disagg: bool) -> dict:
                  "--fabric", f"127.0.0.1:{fport}"),
         )
         procs.append(d)
-        d.wait_for(r"worker \w+ up", timeout=600)
+        # two-stage wait: "booting" appears pre-engine-construction, so a
+        # wedged device tunnel fails in 180s instead of burning the full
+        # engine-bringup budget; compiles after that get the long wait.
+        d.wait_for(r"worker booting", timeout=180)
+        d.wait_for(r"worker \w+ up", timeout=900)
         if disagg:
             for i in range(args.prefill_workers):
                 p = Proc(
@@ -65,7 +69,8 @@ def run_topology(args, disagg: bool) -> dict:
                          "--fabric", f"127.0.0.1:{fport}"),
                 )
                 procs.append(p)
-                p.wait_for(r"prefill worker \w+ up", timeout=600)
+                p.wait_for(r"worker booting", timeout=180)
+                p.wait_for(r"prefill worker \w+ up", timeout=900)
         fe = Proc(
             "frontend",
             _cli("run", "in=http", "out=dyn",

@@ -49,6 +49,9 @@ def sweep_parallel_configs(
     osl: int = 32,
     concurrency_levels=(1, 2, 4, 8),
     base_engine_config=None,
+    quantize: str | None = None,
+    num_pages: int = 2048,
+    page_size: int = 64,
 ) -> dict:
     """Profile each (tp, dp) config and select the SLA-best per chip.
 
@@ -62,13 +65,20 @@ def sweep_parallel_configs(
     configs = []
     for tp, dp in parallel:
         if base_engine_config is not None:
+            # a supplied config owns its page geometry, but an explicit
+            # quantize request must not be silently dropped — profiling
+            # bf16 when the caller asked for int8 would poison the
+            # planner's tables
             cfg = replace(base_engine_config, tp=tp, dp=dp)
+            if quantize is not None:
+                cfg = replace(cfg, quantize=quantize)
         else:
             cfg = None
         t = profile(
             model=model, num_requests=num_requests, isl=isl, osl=osl,
             concurrency_levels=concurrency_levels, engine_config=cfg,
-            tp=tp, dp=dp,
+            tp=tp, dp=dp, quantize=quantize,
+            num_pages=num_pages, page_size=page_size,
         )
         rate = sla_feasible_rate(t, ttft_target_ms, itl_target_ms)
         configs.append(
@@ -105,6 +115,9 @@ def profile(
     engine_config=None,
     tp: int = 1,
     dp: int = 1,
+    quantize: str | None = None,
+    num_pages: int = 2048,
+    page_size: int = 64,
 ) -> dict:
     from benchmarks.perf import bench_engine
     from benchmarks.synthesizer import SynthConfig, synthesize
@@ -122,13 +135,14 @@ def profile(
     longest = max(len(p) + o for p, o in prompts)
     cfg = engine_config or EngineConfig(
         model=model,
-        num_pages=2048,
-        page_size=64,
-        max_pages_per_seq=max(8, -(-(longest + 1) // 64)),
+        num_pages=num_pages,
+        page_size=page_size,
+        max_pages_per_seq=max(8, -(-(longest + 1) // page_size)),
         dtype="bfloat16",
         enable_prefix_caching=False,
         tp=tp,
         dp=dp,
+        quantize=quantize,
     )
     # A caller-supplied config has a fixed context budget: clamp prompts to
     # it (the synthesizer's geometric tail would trip the admission guard).
@@ -171,6 +185,11 @@ def main(argv=None) -> None:
         help='comma-separated TPxDP mesh configs to sweep, e.g. "1x1,2x1,4x1"'
              " — selects the SLA-best per chip (omit = single default config)",
     )
+    p.add_argument("--quantize", default=None, choices=[None, "int8"],
+                   help="weight-only quantization (8B-class models on one "
+                        "16 GB chip need int8)")
+    p.add_argument("--num-pages", type=int, default=2048, dest="num_pages")
+    p.add_argument("--page-size", type=int, default=64, dest="page_size")
     p.add_argument("--ttft-target", type=float, default=200.0, dest="ttft_target")
     p.add_argument("--itl-target", type=float, default=20.0, dest="itl_target")
     p.add_argument("-o", "--output", default=None, help="write JSON here")
@@ -195,6 +214,9 @@ def main(argv=None) -> None:
             isl=args.isl,
             osl=args.osl,
             concurrency_levels=levels,
+            quantize=args.quantize,
+            num_pages=args.num_pages,
+            page_size=args.page_size,
         )
     else:
         table = profile(
@@ -203,6 +225,9 @@ def main(argv=None) -> None:
             isl=args.isl,
             osl=args.osl,
             concurrency_levels=levels,
+            quantize=args.quantize,
+            num_pages=args.num_pages,
+            page_size=args.page_size,
         )
     text = json.dumps(table, indent=2)
     if args.output:

@@ -229,6 +229,11 @@ async def _run_worker(args) -> None:
     from dynamo_tpu.worker import Worker
 
     rt = await DistributedRuntime.create(args.fabric)
+    # progress line BEFORE engine construction: lets a supervisor
+    # distinguish "loading/compiling" (slow but alive) from a wedged
+    # device tunnel (this line never appears)
+    print(f"worker booting (model={args.model}, role={args.role})",
+          flush=True)
     if args.role == "prefill":
         from dynamo_tpu.disagg.prefill_worker import PrefillWorker
 

@@ -253,7 +253,10 @@ class LlamaConfig:
         mistral = (
             hf.get("model_type") == "mistral" or arch == "MistralForCausalLM"
         )
-        qwen3 = hf.get("model_type") == "qwen3" or arch == "Qwen3ForCausalLM"
+        qwen3 = hf.get("model_type") in ("qwen3", "qwen3_moe") or arch in (
+            "Qwen3ForCausalLM",
+            "Qwen3MoeForCausalLM",
+        )
 
         hidden_act = hf.get("hidden_activation") or hf.get("hidden_act", "silu")
         if hidden_act in ("gelu_pytorch_tanh", "gelu_tanh", "gelu"):

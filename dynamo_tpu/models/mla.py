@@ -44,6 +44,7 @@ scan-stacked without per-layer Python unrolling.
 from __future__ import annotations
 
 import math
+import os
 from dataclasses import dataclass
 from typing import Any, Optional
 
@@ -252,7 +253,16 @@ class MlaConfig:
                 rs.get("original_max_position_embeddings")
                 or hf.get("max_position_embeddings", 4096)
             ),
-            rope_mscale_softmax=v3,
+            # V3 applies the yarn mscale^2 term inside the softmax scale;
+            # the integrated HF port of V2 does NOT (our golden tests match
+            # that port), but V2 yarn checkpoints (factor=40,
+            # mscale_all_dim=0.707) were TRAINED with it, so expose an
+            # operator override: DYN_MLA_MSCALE_SOFTMAX=1 forces it on.
+            # See docs/models.md "DeepSeek V2 yarn softmax scale".
+            rope_mscale_softmax=(
+                v3
+                or os.environ.get("DYN_MLA_MSCALE_SOFTMAX", "") == "1"
+            ),
             rms_norm_eps=float(hf.get("rms_norm_eps", 1e-6)),
             tie_word_embeddings=bool(hf.get("tie_word_embeddings", False)),
             n_routed_experts=int(hf.get("n_routed_experts") or 0),

@@ -305,8 +305,14 @@ def get_model(
             mla_cfg = _with_dtype(mla_cfg, dtype)
         if attention_impl not in (None, "auto", "xla"):
             # MLA's absorbed-latent attention only has the XLA path; the
-            # flash kernels assume per-head K/V pages.
-            logger.info("%s: MLA attention -> attention_impl=xla", name)
+            # flash kernels assume per-head K/V pages. An explicit request
+            # gets a WARNING: an operator benchmarking kernels must not
+            # read xla numbers believing they measured pallas.
+            logger.warning(
+                "%s: attention_impl=%s requested but MLA only has the XLA "
+                "path -> serving with attention_impl=xla",
+                name, attention_impl,
+            )
         mla_adapter = _mla_adapter(name, mla_cfg, mesh=mesh)
         if os.path.isdir(name):
             mla_adapter = replace(mla_adapter, default_checkpoint=name)
@@ -338,10 +344,16 @@ def get_model(
         # Gemma2's sliding-window / softcapped / rescaled attention isn't
         # implemented in the flash kernels (they scale by 1/sqrt(head_dim))
         # — serve it on the XLA path rather than fail ("auto" on TPU would
-        # otherwise pick pallas and raise at trace).
-        logger.info(
-            "%s: sliding-window/softcap/rescaled attention -> "
-            "attention_impl=xla",
+        # otherwise pick pallas and raise at trace). Explicit requests get
+        # a WARNING (see the MLA coercion above).
+        log = (
+            logger.warning
+            if attention_impl in ("pallas", "hybrid")
+            else logger.info
+        )
+        log(
+            "%s: sliding-window/softcap/rescaled attention has no flash "
+            "kernel -> serving with attention_impl=xla",
             name,
         )
         cfg = replace(cfg, attention_impl="xla")

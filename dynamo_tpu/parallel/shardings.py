@@ -50,6 +50,10 @@ def llama_param_specs(cfg: LlamaConfig, quantized: bool = False) -> dict:
         # per-head-dim norms apply identically on every (tp-sharded) head
         specs["layers"]["q_norm"] = P(None, None)
         specs["layers"]["k_norm"] = P(None, None)
+    if getattr(cfg, "post_block_norms", False):
+        # Gemma2 post-sublayer norms act on the replicated hidden dim
+        specs["layers"]["post_attn_norm"] = P(None, None)
+        specs["layers"]["post_mlp_norm"] = P(None, None)
     if quantized:
         # int8 per-output-channel scales [L, 1, out] shard with their
         # weight's output dim (w_down's output is the unsharded hidden)

@@ -1,0 +1,83 @@
+"""Pre-warm the persistent XLA compile cache for the disagg A/B shapes.
+
+The round-3 TPU disagg A/B died in bring-up: the decode worker sat in
+cold compiles behind a flaky tunnel until the 600 s readiness window
+expired (artifacts/tpu/disagg_ab.err). Compiles are content-addressed in
+the persistent cache (DYN_COMPILE_CACHE, enabled at engine boot), so one
+in-process run with the A/B's exact engine shapes makes every later
+worker boot warm — compile once here, then the A/B's four processes all
+hit the cache.
+
+Shapes mirror scripts/tpu_watch_queue.sh disagg_ab: llama3-1b bf16,
+page 64 x 1024 pages, max-context 4096 (max_pages_per_seq 64), CLI
+defaults prefill_chunk=512 / max_seqs=32, ISL 1024, concurrency 8.
+
+Usage (tunnel alive): python scripts/tpu_prewarm.py
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from dynamo_tpu.platform import honor_jax_platforms_env  # noqa: E402
+
+honor_jax_platforms_env()
+
+ISL, OSL, CONC = 1024, 80, 8
+
+
+def main() -> None:
+    import jax
+    import numpy as np
+
+    from dynamo_tpu.engine import EngineConfig
+    from dynamo_tpu.engine.engine import JaxEngine
+    from dynamo_tpu.engine.request import SamplingParams
+
+    t0 = time.perf_counter()
+    cfg = EngineConfig(
+        model="llama3-1b",
+        num_pages=1024,
+        page_size=64,
+        max_pages_per_seq=4096 // 64,
+        prefill_chunk=512,
+        max_seqs=32,
+        dtype="bfloat16",
+    )
+    eng = JaxEngine(cfg)
+    boot_s = time.perf_counter() - t0
+
+    rng = np.random.default_rng(0)
+    vocab = int(getattr(eng.adapter.config, "vocab_size", 32000))
+    hi = min(32000, vocab - 1)
+    # the A/B ramps through decode buckets 1..8 as requests arrive/finish;
+    # submit all 8 so prefill (512-chunk) and every bucket <= 8 compile
+    for i in range(CONC):
+        toks = [int(x) for x in rng.integers(1, hi, ISL)]
+        eng.add_request(
+            f"warm{i}", toks, SamplingParams(temperature=0.0, max_tokens=OSL)
+        )
+    steps = 0
+    t1 = time.perf_counter()
+    while eng.has_work:
+        eng.step()
+        steps += 1
+    out = {
+        "platform": jax.devices()[0].platform,
+        "boot_s": round(boot_s, 1),
+        "serve_s": round(time.perf_counter() - t1, 1),
+        "steps": steps,
+        "requests": CONC,
+        "isl": ISL,
+        "osl": OSL,
+    }
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -43,11 +43,23 @@ run_stage() { # name, command...
   fi
 }
 
+prewarm() {
+  # populate the persistent compile cache with the disagg A/B's exact
+  # shapes so the A/B's worker processes boot warm (round-3 failure mode:
+  # decode worker cold-compiling past its readiness window)
+  run_stage prewarm python scripts/tpu_prewarm.py
+}
 disagg_ab() {
   run_stage disagg_ab python -m benchmarks.disagg_bench \
     --model llama3-1b --dtype bfloat16 --page-size 64 --num-pages 1024 \
     --max-context 4096 --max-local-prefill 256 --requests 32 --isl 1024 \
     --osl 64 --concurrency 8 --warmup 8
+}
+sla_8b() {
+  run_stage profile_sla_8b python -m benchmarks.profile_sla \
+    --model llama3-8b --quantize int8 --num-pages 448 \
+    --num-requests 24 --isl 512 --osl 96 --concurrency 1,4,8,16 \
+    --ttft-target 400 --itl-target 40
 }
 sweep_8b() {
   run_stage perf_sweep_8b python -m benchmarks.perf --mode engine \
@@ -88,7 +100,7 @@ bench_1b_sweep() {
 }
 
 STAGES=("$@")
-[ ${#STAGES[@]} -eq 0 ] && STAGES=(disagg_ab sweep_8b ft_kill routing offload bench_dsv2 decode_profile bench_1b_sweep)
+[ ${#STAGES[@]} -eq 0 ] && STAGES=(prewarm disagg_ab sweep_8b sla_8b ft_kill routing offload bench_dsv2 decode_profile bench_1b_sweep)
 
 wait_for_tunnel
 for s in "${STAGES[@]}"; do

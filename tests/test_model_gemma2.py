@@ -170,3 +170,31 @@ def test_gemma2_hf_checkpoint_dir_resolves(tmp_path):
     assert c.attn_logit_softcap == 50.0 and c.final_logit_softcap == 30.0
     assert c.query_pre_attn_scalar == 12.0
     assert c.attention_impl == "xla"  # flash kernels are refused for these
+
+
+def test_gemma2_serves_under_tp_mesh(cpu_mesh_devices):
+    """post_block_norms leaves need PartitionSpecs on a mesh: a missing
+    spec leaf only explodes when JaxEngine shards params (device_put over
+    a specs pytree that must match the params pytree exactly)."""
+    from dynamo_tpu.engine import EngineConfig
+    from dynamo_tpu.engine.engine import JaxEngine
+    from dynamo_tpu.engine.request import SamplingParams
+    from dynamo_tpu.models.registry import _LLAMA_PRESETS
+
+    _LLAMA_PRESETS["gemma2-test-tiny"] = _tiny_gemma2_cfg
+    try:
+        eng = JaxEngine(
+            EngineConfig(
+                model="gemma2-test-tiny", tp=2, num_pages=32,
+                page_size=4, max_pages_per_seq=8, decode_buckets=(2,),
+                prefill_chunk=8, max_seqs=2, dtype="float32",
+            )
+        )
+        rng = np.random.default_rng(7)
+        eng.add_request(
+            "r0", [int(x) for x in rng.integers(1, 250, 6)],
+            SamplingParams(temperature=0.0, max_tokens=3),
+        )
+        assert len(eng.run_to_completion()["r0"]) == 3
+    finally:
+        _LLAMA_PRESETS.pop("gemma2-test-tiny", None)

@@ -235,6 +235,36 @@ def test_qwen3_moe_against_hf():
     assert (ours.argmax(-1) == ref.argmax(-1)).mean() > 0.9
 
 
+def test_qwen3_moe_from_hf_config_enables_qk_norm():
+    """A real qwen3_moe config.json must map to qk_norm=True: the HF
+    checkpoint carries per-head q/k RMSNorm weights, and loading them
+    with qk_norm=False silently drops the norms (wrong logits)."""
+    from dynamo_tpu.models.moe import MoeConfig
+
+    hf = {
+        "model_type": "qwen3_moe",
+        "architectures": ["Qwen3MoeForCausalLM"],
+        "vocab_size": 151936, "hidden_size": 2048,
+        "intermediate_size": 6144, "num_hidden_layers": 48,
+        "num_attention_heads": 32, "num_key_value_heads": 4,
+        "head_dim": 128, "rope_theta": 1000000.0,
+        "rms_norm_eps": 1e-6, "hidden_act": "silu",
+        "num_experts": 128, "num_experts_per_tok": 8,
+        "norm_topk_prob": True, "moe_intermediate_size": 768,
+        "decoder_sparse_step": 1, "mlp_only_layers": [],
+        "tie_word_embeddings": False,
+    }
+    cfg = MoeConfig.from_hf_config(hf)
+    assert cfg.base.qk_norm is True
+    assert cfg.hf_naming == "qwen3_moe"
+    assert cfg.num_experts == 128 and cfg.top_k == 8
+    # arch-only detection (model_type absent) must also work
+    cfg2 = MoeConfig.from_hf_config(
+        {k: v for k, v in hf.items() if k != "model_type"}
+    )
+    assert cfg2.base.qk_norm is True
+
+
 def test_moe_int8_quantized_serving(cpu_mesh_devices):
     """Weight-only int8 over the MoE layout serves (single-chip AND on a
     tp x ep mesh: scale leaves need matching PartitionSpecs) and stays
