@@ -28,17 +28,142 @@ published in the worker's instance metadata.
 from __future__ import annotations
 
 import asyncio
+import atexit
 import logging
+import mmap
 import os
+import re
+import socket
+import uuid as _uuid
 from dataclasses import dataclass
 from typing import Awaitable, Callable, Optional, Sequence
 
 import numpy as np
 
 from dynamo_tpu.disagg.device_transfer import DevicePlane
-from dynamo_tpu.runtime.codec import MAX_FRAME, encode_frame, read_frame
+from dynamo_tpu.runtime.codec import (
+    MAX_FRAME,
+    encode_frame,
+    read_frame,
+    write_frame,
+)
 
 logger = logging.getLogger(__name__)
+
+#: asyncio's default 64 KiB StreamReader buffer forces ~1000 event-loop
+#: wakeups per 64 MB KV frame; bulk-plane connections use a bigger window
+_STREAM_LIMIT = 16 << 20
+
+# --- same-host shared-memory fast path -------------------------------------
+# TCP loopback through one asyncio loop tops out well under 1 GB/s on a
+# single core (sender + receiver share it, every byte crosses the kernel
+# twice). Same-host KV movement instead rides a pooled /dev/shm segment:
+# one warm memcpy in, zero-copy map out — the control frame stays on TCP.
+# Remote targets keep the TCP path untouched.
+
+_SHM_DIR = "/dev/shm"
+_SHM_NAME_RE = re.compile(r"^dynkv-[0-9]+-[0-9a-f]{12}$")
+_LOCAL_HOSTS = ("127.0.0.1", "::1", "localhost")
+
+
+def _shm_enabled() -> bool:
+    return (
+        os.environ.get("DYN_KV_SHM", "on") != "off"
+        and os.path.isdir(_SHM_DIR)
+        and os.access(_SHM_DIR, os.W_OK)
+    )
+
+
+def _is_local_host(host: str) -> bool:
+    return host in _LOCAL_HOSTS or host == socket.gethostname()
+
+
+class _ShmSegment:
+    def __init__(self, size: int):
+        self.name = f"dynkv-{os.getpid()}-{_uuid.uuid4().hex[:12]}"
+        self.path = os.path.join(_SHM_DIR, self.name)
+        fd = os.open(self.path, os.O_CREAT | os.O_EXCL | os.O_RDWR, 0o600)
+        try:
+            os.ftruncate(fd, size)
+            self.mm = mmap.mmap(fd, size)
+        finally:
+            os.close(fd)
+        self.size = size
+        # pre-touch: tmpfs first-touch page allocation halves the first
+        # copy's bandwidth; pay it once at pool-creation time instead
+        np.frombuffer(self.mm, np.uint8)[:: mmap.PAGESIZE] = 0
+
+    def close(self) -> None:
+        try:
+            self.mm.close()
+        except BufferError:  # an exported view still alive — leave mapped
+            pass
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+
+
+class _ShmPool:
+    """Sender-owned segments, reused after each acked transfer (the ack
+    guarantees the receiver has copied out). Unlinked at close/atexit."""
+
+    def __init__(self):
+        self._free: list[_ShmSegment] = []
+        self._all: list[_ShmSegment] = []
+        atexit.register(self.close)
+        self._sweep_orphans()
+
+    @staticmethod
+    def _sweep_orphans() -> None:
+        """atexit never runs for SIGKILLed workers (the FT kill scenarios
+        do exactly that), so their segments outlive them. Every new pool
+        reaps segments whose owning pid — embedded in the name — is gone."""
+        try:
+            names = os.listdir(_SHM_DIR)
+        except OSError:
+            return
+        for name in names:
+            if not _SHM_NAME_RE.match(name):
+                continue
+            pid = int(name.split("-")[1])
+            try:
+                os.kill(pid, 0)
+            except ProcessLookupError:
+                try:
+                    os.unlink(os.path.join(_SHM_DIR, name))
+                    logger.info("reaped orphaned KV shm segment %s", name)
+                except OSError:
+                    pass
+            except PermissionError:
+                pass  # someone else's live pid
+
+    def acquire(self, nbytes: int) -> _ShmSegment:
+        for i, seg in enumerate(self._free):
+            if seg.size >= nbytes:
+                return self._free.pop(i)
+        seg = _ShmSegment(max(nbytes, 1 << 20))
+        self._all.append(seg)
+        return seg
+
+    def release(self, seg: _ShmSegment) -> None:
+        self._free.append(seg)
+
+    def discard(self, seg: _ShmSegment) -> None:
+        """Permanently retire a segment (unacked transfer: a receiver may
+        still hold a live map of it — never reuse, just unlink)."""
+        seg.close()
+        try:
+            self._all.remove(seg)
+        except ValueError:
+            pass
+
+    def close(self) -> None:
+        for seg in self._all:
+            seg.close()
+        self._all.clear()
+        self._free.clear()
+        atexit.unregister(self.close)
 
 #: Byte cap for one G4 fetch response. Real-model blocks run ~MBs each, so
 #: an uncapped deep prefix chain would serialize hundreds of MB into one
@@ -99,14 +224,14 @@ class KvTransferServer:
         self._server: Optional[asyncio.base_events.Server] = None
         self._waiters: dict[str, asyncio.Future] = {}
         #: transfers landed per strategy (observability: which plane ran)
-        self.transfers = {"device": 0, "host": 0}
+        self.transfers = {"device": 0, "host": 0, "shm": 0}
         #: 2·k-block bytes, learned from the first serve — lets later
         #: fetches truncate the *requested* hashes before extraction
         self._fetch_block_bytes: Optional[int] = None
 
     async def start(self) -> None:
         self._server = await asyncio.start_server(
-            self._handle, self.host, self.port
+            self._handle, self.host, self.port, limit=_STREAM_LIMIT
         )
         self.port = self._server.sockets[0].getsockname()[1]
 
@@ -125,6 +250,11 @@ class KvTransferServer:
         self._waiters.pop(request_id, None)
 
     async def _handle(self, reader, writer) -> None:
+        # sender-segment mappings, cached per shm name (segments are
+        # reused across transfers) and dropped with THIS connection — a
+        # server outliving many prefill clients must not pin their
+        # unlinked segments' tmpfs pages forever
+        shm_maps: dict[str, mmap.mmap] = {}
         try:
             while True:
                 header, payload = await read_frame(reader)
@@ -132,6 +262,8 @@ class KvTransferServer:
                 try:
                     if op == "write":
                         await self._on_write(header, payload, writer)
+                    elif op == "write_shm":
+                        await self._on_write_shm(header, writer, shm_maps)
                     elif op == "offer":
                         await self._on_offer(header, writer)
                     elif op == "fetch":
@@ -151,6 +283,11 @@ class KvTransferServer:
             pass
         finally:
             writer.close()
+            for mm in shm_maps.values():
+                try:
+                    mm.close()
+                except BufferError:  # a view outlived its handler
+                    pass
 
     async def _nack(self, writer, rid, reason: str) -> None:
         """Refusal with a machine-readable reason so the sender can decide
@@ -210,6 +347,69 @@ class KvTransferServer:
         ).reshape(v_shape)
         await self._land(
             rid, header, lambda: self.write_fn(page_ids, k, v), writer, "host"
+        )
+
+    async def _on_write_shm(self, header, writer, shm_maps) -> None:
+        """Same-host fast path: the payload sits in a sender-owned
+        /dev/shm segment; map it (cached per name — senders reuse
+        segments) and land zero-copy views. The sender only reuses the
+        segment after our ack, so the views are stable until write_fn
+        returns — write_fn must copy (device put) before returning, which
+        the engine pool write does."""
+        rid = header["request_id"]
+        name = header.get("shm_name", "")
+        if rid not in self._waiters:
+            logger.warning("dropping shm KV write for %s: no waiter", rid)
+            await self._nack(writer, rid, "no_waiter")
+            return
+        if not _SHM_NAME_RE.match(name):
+            # names come off the wire: refuse anything that isn't exactly
+            # a pool-generated name (no separators, no traversal)
+            logger.warning("refusing shm name %r", name)
+            await self._nack(writer, rid, "shm_failed")
+            return
+        mm = shm_maps.get(name)
+        if mm is None or len(mm) < int(header["shm_size"]):
+            try:
+                fd = os.open(os.path.join(_SHM_DIR, name), os.O_RDONLY)
+                try:
+                    # ValueError: shm_size exceeds the file (truncated or
+                    # version-skewed sender) — same remedy as a missing
+                    # segment: let the sender fall back to TCP
+                    mm = mmap.mmap(
+                        fd, int(header["shm_size"]), prot=mmap.PROT_READ
+                    )
+                finally:
+                    os.close(fd)
+            except (OSError, ValueError):
+                # not same-host after all (or the segment vanished):
+                # tell the sender so it falls back to the TCP payload path
+                logger.warning("cannot map shm segment %s", name)
+                await self._nack(writer, rid, "shm_failed")
+                return
+            old = shm_maps.pop(name, None)
+            if old is not None:
+                try:
+                    old.close()
+                except BufferError:
+                    pass
+            shm_maps[name] = mm
+            logger.info(
+                "mapped KV shm segment %s (%d bytes)", name, len(mm)
+            )
+        shape = tuple(header["shape"])
+        v_shape = tuple(header.get("v_shape") or shape)
+        dtype = dtype_from_name(header["dtype"])
+        nbytes_k = int(np.prod(shape)) * dtype.itemsize
+        k = np.frombuffer(mm, dtype=dtype, count=int(np.prod(shape))).reshape(
+            shape
+        )
+        v = np.frombuffer(
+            mm, dtype=dtype, count=int(np.prod(v_shape)), offset=nbytes_k
+        ).reshape(v_shape)
+        page_ids = header["page_ids"]
+        await self._land(
+            rid, header, lambda: self.write_fn(page_ids, k, v), writer, "shm"
         )
 
     async def _on_offer(self, header, writer) -> None:
@@ -333,6 +533,10 @@ class KvTransferClient:
     def __init__(self):
         self._conns: dict[tuple[str, int], tuple] = {}
         self._locks: dict[tuple[str, int], asyncio.Lock] = {}
+        self._shm_pool = _ShmPool() if _shm_enabled() else None
+        #: targets where the shm handshake failed (remote host / no shm
+        #: support): don't re-attempt every transfer
+        self._shm_bad: set[tuple[str, int]] = set()
 
     def _lock(self, key: tuple[str, int]) -> asyncio.Lock:
         # created synchronously, so concurrent writers share one lock
@@ -346,7 +550,9 @@ class KvTransferClient:
         conn = self._conns.get(key)
         if conn is not None and not conn[1].is_closing():
             return conn
-        reader, writer = await asyncio.open_connection(*key)
+        reader, writer = await asyncio.open_connection(
+            *key, limit=_STREAM_LIMIT
+        )
         self._conns[key] = (reader, writer)
         return reader, writer
 
@@ -420,23 +626,76 @@ class KvTransferClient:
         v: np.ndarray,
         first_token: int,
     ) -> bool:
-        """Host path: ship page bytes in the frame payload; True on
-        decode-side ack. k/v: [L, Hkv, n, ps, D] with n == len(page_ids)."""
+        """Host path: same-host targets ride a pooled /dev/shm segment
+        (one warm memcpy; the control frame carries only the segment
+        name), remote targets ship the page bytes in the frame payload as
+        vectored writes. True on decode-side ack. k/v: [L, Hkv, n, ps, D]
+        with n == len(page_ids)."""
         assert k.shape[2] == len(page_ids) and v.shape[2] == len(page_ids), (
             k.shape, len(page_ids),
         )
+        k = np.ascontiguousarray(k)
+        v = np.ascontiguousarray(v)
+        header = {
+            "op": "write",
+            "request_id": request_id,
+            "page_ids": list(page_ids),
+            "shape": list(k.shape),
+            "v_shape": list(v.shape),
+            "dtype": k.dtype.name,
+            "first_token": int(first_token),
+        }
+        key = (host, port)
+        if (
+            self._shm_pool is not None
+            and key not in self._shm_bad
+            and _is_local_host(host)
+        ):
+            seg = self._shm_pool.acquire(k.nbytes + v.nbytes)
+            np.copyto(
+                np.frombuffer(seg.mm, dtype=k.dtype, count=k.size).reshape(
+                    k.shape
+                ),
+                k,
+            )
+            np.copyto(
+                np.frombuffer(
+                    seg.mm, dtype=v.dtype, count=v.size, offset=k.nbytes
+                ).reshape(v.shape),
+                v,
+            )
+            try:
+                resp, _ = await self._roundtrip(
+                    key,
+                    {
+                        **header,
+                        "op": "write_shm",
+                        "shm_name": seg.name,
+                        "shm_size": seg.size,
+                    },
+                )
+            except BaseException:
+                # No ack ⇒ the receiver may STILL be reading this segment
+                # (sender-side cancel races the landing) — reusing it would
+                # hand a live reader torn bytes. Quarantine: unlink now
+                # (existing maps stay valid, the name can't be reopened)
+                # and drop it from the pool instead of releasing.
+                self._shm_pool.discard(seg)
+                raise
+            self._shm_pool.release(seg)
+            if resp.get("op") == "ack":
+                return True
+            if resp.get("reason") != "shm_failed":
+                return False  # request-level refusal; TCP wouldn't help
+            logger.info(
+                "shm KV write to %s:%d refused; using TCP payload path",
+                host, port,
+            )
+            self._shm_bad.add(key)
+        # bf16 has no buffer protocol (numpy dtype 'E'); ship uint8 views
         return await self._control(
-            host, port,
-            {
-                "op": "write",
-                "request_id": request_id,
-                "page_ids": list(page_ids),
-                "shape": list(k.shape),
-                "v_shape": list(v.shape),
-                "dtype": k.dtype.name,
-                "first_token": int(first_token),
-            },
-            payload=k.tobytes() + v.tobytes(),
+            host, port, header,
+            parts=[k.view(np.uint8), v.view(np.uint8)],
         )
 
     async def fetch(
@@ -463,17 +722,26 @@ class KvTransferClient:
         return metas, k, v
 
     async def _roundtrip(
-        self, key: tuple[str, int], header: dict, payload: bytes = b""
+        self,
+        key: tuple[str, int],
+        header: dict,
+        payload: bytes = b"",
+        parts=None,
     ) -> tuple[dict, bytes]:
-        """One request/response on the pooled connection. Any failure —
-        including cancellation (a caller's wait_for timeout) mid-read —
-        closes and evicts the connection: reusing it would read the
-        previous exchange's frame and desynchronize every later call."""
+        """One request/response on the pooled connection. Bulk payloads go
+        as `parts` (vectored, streaming-checksummed — no concatenation
+        copy). Any failure — including cancellation (a caller's wait_for
+        timeout) mid-read — closes and evicts the connection: reusing it
+        would read the previous exchange's frame and desynchronize every
+        later call."""
         async with self._lock(key):
             reader, writer = await self._conn(key)
             try:
-                writer.write(encode_frame(header, payload))
-                await writer.drain()
+                if parts is not None:
+                    await write_frame(writer, header, parts)
+                else:
+                    writer.write(encode_frame(header, payload))
+                    await writer.drain()
                 return await read_frame(reader)
             except BaseException:
                 writer.close()
@@ -481,12 +749,15 @@ class KvTransferClient:
                 raise
 
     async def _control(
-        self, host: str, port: int, header: dict, payload: bytes = b""
+        self, host: str, port: int, header: dict, payload: bytes = b"",
+        parts=None,
     ) -> bool:
-        resp, _ = await self._roundtrip((host, port), header, payload)
+        resp, _ = await self._roundtrip((host, port), header, payload, parts)
         return resp.get("op") == "ack"
 
     def close(self) -> None:
         for _, writer in self._conns.values():
             writer.close()
         self._conns.clear()
+        if self._shm_pool is not None:
+            self._shm_pool.close()

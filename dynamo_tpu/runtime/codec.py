@@ -59,6 +59,32 @@ def encode_frame(header: Any, payload: bytes = b"") -> bytes:
     )
 
 
+async def write_frame(
+    writer: asyncio.StreamWriter, header: Any, parts: list = ()
+) -> None:
+    """Vectored frame write: identical wire format to encode_frame, but the
+    payload is written part-by-part with a STREAMING checksum — no
+    concatenation copy of multi-MB KV payloads. `parts` are buffer-likes
+    (bytes / memoryview / contiguous array views)."""
+    h = msgpack.packb(header, use_bin_type=True)
+    views = [memoryview(p).cast("B") for p in parts]
+    plen = sum(v.nbytes for v in views)
+    if len(h) > MAX_FRAME or plen > MAX_FRAME:
+        raise CodecError(f"frame too large: header={len(h)} payload={plen}")
+    psum = xxhash.xxh3_64()
+    for v in views:
+        psum.update(v)
+    writer.write(
+        _PREFIX.pack(
+            len(h), plen, xxhash.xxh3_64_intdigest(h), psum.intdigest()
+        )
+        + h
+    )
+    for v in views:
+        writer.write(v)
+    await writer.drain()
+
+
 def _check_frame(prefix: bytes, h: bytes, p: bytes) -> None:
     lib = native.lib()
     if lib is not None:

@@ -171,7 +171,7 @@ def test_device_path_numerical_equivalence(tiny_cfg, monkeypatch):
             result = await asyncio.wait_for(waiter, 10)
             assert result.first_token == first[0]
             assert landed.is_set()
-            assert server.transfers == {"device": 1, "host": 0}
+            assert server.transfers == {"device": 1, "host": 0, "shm": 0}
         finally:
             client.close()
             await server.stop()
@@ -223,7 +223,7 @@ def test_device_pull_failure_falls_back_to_host(tiny_cfg, monkeypatch):
             result = await asyncio.wait_for(waiter, 10)
             assert result.first_token == 42
             assert written["pages"] == [3, 4]
-            assert server.transfers == {"device": 0, "host": 1}
+            assert server.transfers == {"device": 0, "host": 0, "shm": 1}
         finally:
             client.close()
             await server.stop()
@@ -251,7 +251,7 @@ def test_host_mode_env_skips_device_plane(monkeypatch):
         try:
             ok = await client.send(*server.address, "r1", [1], k, v, 7)
             assert ok
-            assert server.transfers == {"device": 0, "host": 1}
+            assert server.transfers == {"device": 0, "host": 0, "shm": 1}
         finally:
             client.close()
             await server.stop()
@@ -466,9 +466,155 @@ def test_no_waiter_nack_skips_host_fallback(tiny_cfg, monkeypatch):
             # no server.expect(): the request is already dead decode-side
             ok = await client.send(*server.address, "gone", [3, 4], k, v, 42)
             assert not ok
-            assert server.transfers == {"device": 0, "host": 0}
+            assert server.transfers == {"device": 0, "host": 0, "shm": 0}
         finally:
             client.close()
             await server.stop()
 
     run(main())
+
+
+def test_shm_bad_name_refused_then_tcp_fallback():
+    """A wire-supplied shm name that isn't exactly a pool-generated name
+    is refused (shm_failed), and the sender's TCP payload fallback still
+    lands the request — plus the target is marked so later writes skip
+    the shm attempt."""
+    from dynamo_tpu.disagg.transfer import KvTransferClient, KvTransferServer
+    from dynamo_tpu.runtime.codec import encode_frame, read_frame
+
+    shape = (1, 1, 1, 4, 8)
+    k = np.ones(shape, dtype=np.float32)
+    v = np.zeros(shape, dtype=np.float32)
+
+    async def main():
+        async def write_fn(page_ids, kk, vv):
+            pass
+
+        server = KvTransferServer(write_fn)
+        await server.start()
+        server.expect("evil")
+        # hand-rolled frame with a traversal-shaped name
+        reader, writer = await asyncio.open_connection(*server.address)
+        writer.write(
+            encode_frame(
+                {
+                    "op": "write_shm",
+                    "request_id": "evil",
+                    "page_ids": [1],
+                    "shape": list(shape),
+                    "v_shape": list(shape),
+                    "dtype": "float32",
+                    "first_token": 0,
+                    "shm_name": "../etc/passwd",
+                    "shm_size": 128,
+                }
+            )
+        )
+        await writer.drain()
+        resp, _ = await read_frame(reader)
+        assert resp["op"] == "nack" and resp["reason"] == "shm_failed"
+        writer.close()
+
+        # a real client that gets shm_failed falls back to TCP and
+        # remembers the target
+        client = KvTransferClient()
+        try:
+            if client._shm_pool is not None:
+                orig_names = []
+
+                class _BadSeg:
+                    def __init__(self, real):
+                        self._real = real
+                        self.name = "not-a-pool-name"
+                        self.mm = real.mm
+                        self.size = real.size
+
+                real_acquire = client._shm_pool.acquire
+                client._shm_pool.acquire = lambda n: _BadSeg(real_acquire(n))
+                client._shm_pool.release = (
+                    lambda seg: orig_names.append(seg.name)
+                )
+            server.expect("r1")
+            ok = await client.write(*server.address, "r1", [1], k, v, 7)
+            assert ok
+            assert server.transfers["host"] == 1  # landed via TCP payload
+            # second write skips the shm attempt entirely
+            server.expect("r2")
+            ok = await client.write(*server.address, "r2", [1], k, v, 7)
+            assert ok
+            assert server.transfers["host"] == 2
+        finally:
+            client.close()
+            await server.stop()
+
+    run(main())
+
+
+def test_shm_segment_reuse_and_cleanup():
+    """Consecutive writes to the same target reuse one pooled segment,
+    and client.close() unlinks it from /dev/shm."""
+    import os
+
+    from dynamo_tpu.disagg.transfer import KvTransferClient, KvTransferServer
+
+    shape = (1, 1, 2, 4, 8)
+    k = np.arange(np.prod(shape), dtype=np.float32).reshape(shape)
+    v = -k
+
+    async def main():
+        got = []
+
+        async def write_fn(page_ids, kk, vv):
+            got.append((np.array(kk), np.array(vv)))
+
+        server = KvTransferServer(write_fn)
+        await server.start()
+        client = KvTransferClient()
+        if client._shm_pool is None:
+            await server.stop()
+            return  # /dev/shm unavailable: nothing to assert
+        try:
+            for i in range(3):
+                server.expect(f"r{i}")
+                assert await client.write(
+                    *server.address, f"r{i}", [1, 2], k + i, v - i, 0
+                )
+            assert server.transfers["shm"] == 3
+            assert len(client._shm_pool._all) == 1  # one segment, reused
+            seg_path = client._shm_pool._all[0].path
+            assert os.path.exists(seg_path)
+            for i, (kk, vv) in enumerate(got):
+                np.testing.assert_array_equal(kk, k + i)
+                np.testing.assert_array_equal(vv, v - i)
+        finally:
+            client.close()
+            await server.stop()
+        assert not os.path.exists(seg_path)  # unlinked at close
+
+    run(main())
+
+
+def test_shm_orphan_sweeper(tmp_path):
+    """Segments owned by a dead pid (SIGKILLed worker — atexit never ran)
+    are reaped when a new pool starts; live-pid segments survive."""
+    import os
+
+    from dynamo_tpu.disagg.transfer import _SHM_DIR, _ShmPool
+
+    if not os.access(_SHM_DIR, os.W_OK):
+        return
+    dead = os.path.join(_SHM_DIR, "dynkv-999999999-deadbeefcafe")
+    live = os.path.join(_SHM_DIR, f"dynkv-{os.getpid()}-aaaabbbbcccc")
+    for p in (dead, live):
+        with open(p, "wb") as f:
+            f.write(b"x")
+    try:
+        _ShmPool._sweep_orphans()
+        assert not os.path.exists(dead)
+        assert os.path.exists(live)
+    finally:
+        for p in (dead, live):
+            try:
+                os.unlink(p)
+            except OSError:
+                pass

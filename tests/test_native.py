@@ -257,3 +257,62 @@ def test_native_codec_matches_python_framing():
     # Python side decodes the native-framed bytes
     h2, p2, consumed = decode_frame(native_frame)
     assert h2 == header and p2 == payload and consumed == len(frame)
+
+
+def test_write_frame_vectored_matches_encode_frame():
+    """The vectored bulk write (streaming checksum, no concat copy) must
+    produce byte-identical wire format to encode_frame."""
+    import asyncio
+
+    import numpy as np
+
+    from dynamo_tpu.runtime.codec import (
+        encode_frame,
+        read_frame,
+        write_frame,
+    )
+
+    header = {"op": "write", "request_id": "x", "page_ids": [1, 2]}
+    k = np.arange(48, dtype=np.float32).reshape(2, 24)
+    v = np.ones(16, dtype=np.uint8)
+    expect = encode_frame(header, k.tobytes() + v.tobytes())
+
+    async def main():
+        server_got = {}
+
+        async def handle(reader, writer):
+            server_got["frame"] = await reader.readexactly(len(expect))
+            h, p = b"", b""
+            writer.close()
+
+        server = await asyncio.start_server(handle, "127.0.0.1", 0)
+        port = server.sockets[0].getsockname()[1]
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        await write_frame(writer, header, [k.view(np.uint8), v])
+        await asyncio.sleep(0.1)
+        writer.close()
+        server.close()
+        return server_got["frame"]
+
+    wire = asyncio.run(main())
+    assert wire == expect
+
+    # and the read side accepts it
+    async def roundtrip():
+        async def handle(reader, writer):
+            h, p = await read_frame(reader)
+            writer.write(repr((h, len(p))).encode())
+            await writer.drain()
+            writer.close()
+
+        server = await asyncio.start_server(handle, "127.0.0.1", 0)
+        port = server.sockets[0].getsockname()[1]
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        await write_frame(writer, header, [k.view(np.uint8), v])
+        out = await reader.read(1 << 16)
+        writer.close()
+        server.close()
+        return out
+
+    out = asyncio.run(roundtrip())
+    assert b"208" in out  # 192 + 16 payload bytes arrived intact
