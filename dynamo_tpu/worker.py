@@ -62,6 +62,7 @@ class Worker:
         kv_remote_timeout_s: float = 5.0,
         echo_delay: float = 0.0,
         mock_args=None,
+        engine=None,
     ):
         self.runtime = runtime
         self.card = card
@@ -100,13 +101,38 @@ class Worker:
         self.instance_id: str = ""
         self.echo_delay = echo_delay
         self.mock_args = mock_args
+        #: engine_kind="external": a caller-supplied AsyncEngine — any
+        #: object with `generate(context, PreprocessedRequest) -> async
+        #: iterator of {token_ids, finish_reason}` joins as a first-class
+        #: worker (the reference's engine-subprocess shims,
+        #: launch/dynamo-run/src/subprocess/vllm_v1_inc.py). See
+        #: docs/external_engines.md.
+        if engine is not None and engine_kind != "external":
+            # silently routing generate() to `engine` while start() builds
+            # the native one would serve tokens from one engine and
+            # metrics from another
+            raise ValueError(
+                f"engine= requires engine_kind='external' (got "
+                f"{engine_kind!r})"
+            )
+        self.external = engine
         self._kv_event_buffer: list[KvEvent] = []
         self._tasks: list[asyncio.Task] = []
 
     # -- lifecycle ---------------------------------------------------------
 
     async def start(self) -> None:
-        if self.engine_kind == "echo":
+        if self.engine_kind == "external":
+            if self.external is None:
+                raise ValueError(
+                    "engine_kind='external' needs an `engine` object "
+                    "implementing AsyncEngine.generate"
+                )
+            # foreign engines publish KV events (prefix routing) by
+            # calling this sink — duck-typed so a shim can opt out
+            if hasattr(self.external, "on_kv_event"):
+                self.external.on_kv_event = self._kv_event_buffer.append
+        elif self.engine_kind == "echo":
             self.echo = EchoEngine(delay=self.echo_delay)
         elif self.engine_kind == "mock":
             from dynamo_tpu.mocker import MockEngine, MockEngineArgs
@@ -317,7 +343,9 @@ class Worker:
             if handled:
                 return
             # transfer fell through — run the normal local path below
-        gen = (self.echo or self.mock or self.runner).generate(ctx, pre)
+        gen = (
+            self.external or self.echo or self.mock or self.runner
+        ).generate(ctx, pre)
         async for event in gen:
             yield event
 
@@ -327,6 +355,8 @@ class Worker:
         prompts = request["prompts"]
         if self.runner is not None:
             vecs = await self.runner.embed(prompts)
+        elif self.external is not None and hasattr(self.external, "embed"):
+            vecs = await self.external.embed(prompts)
         else:
             from dynamo_tpu.engine.async_engine import fake_embedding
 
@@ -584,6 +614,10 @@ class Worker:
             m = None
             if self.runner is not None:
                 m = self.runner.metrics.to_dict()
+            elif self.external is not None and hasattr(
+                self.external, "metrics_dict"
+            ):
+                m = dict(self.external.metrics_dict())
             elif self.mock is not None:
                 alloc = self.mock.allocator
                 m = {

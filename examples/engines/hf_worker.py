@@ -1,0 +1,240 @@
+"""Foreign-engine worker shim: HuggingFace transformers (torch CPU).
+
+This is the framework's external-engine integration path — the role of
+the reference's engine-subprocess shims
+(launch/dynamo-run/src/subprocess/vllm_v1_inc.py:1-375, sglang_inc.py,
+trtllm_inc.py): a process whose ENGINE is not ours joins the runtime as
+a first-class worker. The shim side of the contract
+(docs/external_engines.md) is tiny:
+
+1. implement `generate(context, PreprocessedRequest) -> async iterator
+   of {"token_ids": [...], "finish_reason": None|"stop"|"length"}`,
+2. hand the object to `Worker(engine_kind="external", engine=...)`,
+3. (optional) expose `on_kv_event` so prefix-cache stored/removed events
+   reach the KV router, and `metrics_dict()` for the load plane.
+
+Everything else — fabric registration under a lease, model-card publish,
+ingress framing, router targeting, metrics/KV-event publishing — is the
+Worker's job, exactly as it is for the native JAX engine.
+
+Run (CPU, random-weight tiny model unless --checkpoint is a real HF dir):
+
+  python examples/engines/hf_worker.py --fabric 127.0.0.1:4499 \
+      --model hf-tiny [--checkpoint /path/to/hf_dir]
+
+then serve through any frontend: `run in=http out=dyn --fabric ...`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+
+from dynamo_tpu.engine.page_table import KvEvent
+from dynamo_tpu.model_card import ModelDeploymentCard
+from dynamo_tpu.preprocessor.preprocessor import PreprocessedRequest
+from dynamo_tpu.runtime import DistributedRuntime
+from dynamo_tpu.tokens.blocks import TokenBlockSequence
+from dynamo_tpu.worker import Worker
+
+logger = logging.getLogger("hf_worker")
+
+
+class HFTransformersEngine:
+    """AsyncEngine over a torch-CPU transformers CausalLM.
+
+    Incremental decode with past_key_values, one token per stream item;
+    honors temperature/top-p, stop ids, ignore_eos and max_tokens, and
+    checks `context.cancelled` between steps (client-disconnect → stop).
+    Emits "stored" KV events for each full prompt block so KV-aware
+    routers can prefix-route to this worker too.
+    """
+
+    def __init__(self, model, eos_token_ids=(), block_size: int = 16,
+                 salt: str = ""):
+        self.model = model
+        self.eos_token_ids = tuple(eos_token_ids)
+        self.block_size = block_size
+        self.salt = salt
+        self.on_kv_event = None  # set by Worker(engine_kind="external")
+        self.requests_received = 0
+        self.active = 0
+
+    def metrics_dict(self) -> dict:
+        return {
+            "num_waiting": 0,
+            "num_running": self.active,
+            "requests_received": self.requests_received,
+        }
+
+    def _emit_stored(self, token_ids) -> None:
+        if self.on_kv_event is None:
+            return
+        seq = TokenBlockSequence(
+            tuple(int(t) for t in token_ids),
+            block_size=self.block_size, salt=self.salt,
+        )
+        blocks = seq.blocks
+        if not blocks:
+            return
+        self.on_kv_event(
+            KvEvent(
+                kind="stored",
+                block_hashes=tuple(b.sequence_hash for b in blocks),
+                parent_hash=None,
+                token_blocks=tuple(tuple(b.tokens) for b in blocks),
+            )
+        )
+
+    @staticmethod
+    def _sample(logits, temperature: float, top_p: float, generator):
+        import torch
+
+        if temperature <= 0.0:
+            return int(torch.argmax(logits, dim=-1))
+        probs = torch.softmax(logits / temperature, dim=-1)
+        if 0.0 < top_p < 1.0:
+            sorted_probs, idx = torch.sort(probs, descending=True)
+            keep = torch.cumsum(sorted_probs, -1) - sorted_probs < top_p
+            keep[..., 0] = True
+            probs = torch.zeros_like(probs).scatter(
+                -1, idx, sorted_probs * keep
+            )
+            probs = probs / probs.sum(-1, keepdim=True)
+        return int(torch.multinomial(probs, 1, generator=generator))
+
+    async def generate(self, context, request: PreprocessedRequest):
+        import torch
+
+        self.requests_received += 1
+        self.active += 1
+        try:
+            generator = None
+            if request.seed is not None:
+                generator = torch.Generator().manual_seed(int(request.seed))
+            # ignore_eos suppresses ALL eos-derived stops (the
+            # preprocessor seeds stop_token_ids with the tokenizer's eos
+            # ids) — matching the native engine's semantics, so
+            # fixed-length benchmarking behaves identically here
+            stop_ids = (
+                set()
+                if request.ignore_eos
+                else set(request.stop_token_ids) | set(self.eos_token_ids)
+            )
+            input_ids = torch.tensor([list(request.token_ids)], dtype=torch.long)
+            past = None
+            produced = 0
+            loop = asyncio.get_running_loop()
+            while produced < request.max_tokens:
+                if context.cancelled:
+                    return
+
+                def step(ids=input_ids, past_kv=past):
+                    with torch.no_grad():
+                        out = self.model(
+                            input_ids=ids, past_key_values=past_kv,
+                            use_cache=True,
+                        )
+                    return out
+
+                # the forward blocks for ~ms–s: keep the worker's event
+                # loop (lease keepalives, other requests) responsive
+                out = await loop.run_in_executor(None, step)
+                past = out.past_key_values
+                tok = self._sample(
+                    out.logits[0, -1], request.temperature, request.top_p,
+                    generator,
+                )
+                produced += 1
+                input_ids = torch.tensor([[tok]], dtype=torch.long)
+                if tok in stop_ids:
+                    yield {"token_ids": [tok], "finish_reason": "stop"}
+                    return
+                yield {
+                    "token_ids": [tok],
+                    "finish_reason": (
+                        "length" if produced >= request.max_tokens else None
+                    ),
+                }
+            return
+        finally:
+            self.active -= 1
+            self._emit_stored(request.token_ids)
+
+
+def build_model(checkpoint: str | None, vocab_size: int):
+    """A real HF checkpoint directory, or a tiny random-weight Llama (the
+    protocol demo needs a causal LM, not a good one)."""
+    import torch
+
+    torch.manual_seed(0)
+    if checkpoint:
+        from transformers import AutoModelForCausalLM
+
+        return AutoModelForCausalLM.from_pretrained(
+            checkpoint, torch_dtype=torch.float32
+        ).eval()
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    cfg = LlamaConfig(
+        vocab_size=vocab_size, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=2048,
+    )
+    return LlamaForCausalLM(cfg).eval()
+
+
+async def main() -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--fabric", required=True, help="host:port")
+    p.add_argument("--model", default="hf-tiny", help="served model name")
+    p.add_argument("--checkpoint", default=None, help="HF model directory")
+    p.add_argument("--tokenizer", default=None,
+                   help="HF tokenizer dir (default: byte tokenizer)")
+    p.add_argument("--namespace", default="dynamo")
+    p.add_argument("--page-size", type=int, default=16, dest="page_size")
+    p.add_argument("--max-context", type=int, default=2048,
+                   dest="max_context")
+    p.add_argument("--router-mode", default="round_robin",
+                   dest="router_mode", choices=["round_robin", "random", "kv"])
+    args = p.parse_args()
+
+    logging.basicConfig(level=logging.INFO)
+    tokenizer = (
+        {"kind": "hf", "path": args.tokenizer}
+        if args.tokenizer
+        else {"kind": "byte"}
+    )
+    card = ModelDeploymentCard(
+        name=args.model, tokenizer=tokenizer,
+        context_length=args.max_context, kv_page_size=args.page_size,
+    )
+    model = build_model(args.checkpoint, vocab_size=512)
+    eos = ()
+    if args.checkpoint:
+        eos_id = getattr(model.config, "eos_token_id", None)
+        if eos_id is not None:
+            eos = tuple(eos_id) if isinstance(eos_id, list) else (int(eos_id),)
+    engine = HFTransformersEngine(
+        model, eos_token_ids=eos, block_size=args.page_size,
+        salt=args.model,
+    )
+
+    rt = await DistributedRuntime.create(args.fabric)
+    print(f"worker booting (model={args.model}, role=external-hf)",
+          flush=True)
+    worker = Worker(
+        rt, card, engine_kind="external", engine=engine,
+        namespace=args.namespace, router_mode=args.router_mode,
+    )
+    await worker.start()
+    print(f"worker {worker.instance_id} up (model={args.model})", flush=True)
+    try:
+        await asyncio.Event().wait()
+    finally:
+        await worker.stop()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

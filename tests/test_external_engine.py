@@ -1,0 +1,216 @@
+"""External-engine protocol e2e: a FOREIGN engine (HuggingFace
+transformers, torch CPU) joins the runtime as a worker and serves
+/v1/chat/completions through the distributed stack.
+
+This is the parity surface for the reference's engine-subprocess shims
+(launch/dynamo-run/src/subprocess/vllm_v1_inc.py): the engine is not
+ours, the planes are. Also proves the optional hooks: KV stored-events
+reach the worker's publish buffer (prefix routing) and metrics_dict
+rides the load plane."""
+
+import asyncio
+
+import pytest
+
+aiohttp = pytest.importorskip("aiohttp")
+torch = pytest.importorskip("torch")
+
+from dynamo_tpu.frontend import HttpService, ModelManager  # noqa: E402
+from dynamo_tpu.frontend.service import ModelWatcher  # noqa: E402
+from dynamo_tpu.model_card import ModelDeploymentCard  # noqa: E402
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def _engine(block_size=16, salt="hf-ext"):
+    from examples.engines.hf_worker import HFTransformersEngine, build_model
+
+    return HFTransformersEngine(
+        build_model(None, vocab_size=512),
+        eos_token_ids=(), block_size=block_size, salt=salt,
+    )
+
+
+def test_hf_engine_streams_tokens_and_respects_limits():
+    """The AsyncEngine contract directly: greedy determinism, max_tokens,
+    stop ids, and cancellation."""
+    from dynamo_tpu.preprocessor.preprocessor import PreprocessedRequest
+    from dynamo_tpu.runtime.context import Context
+
+    eng = _engine()
+
+    async def collect(req):
+        out = []
+        async for item in eng.generate(Context(request_id=req.request_id), req):
+            out.append(item)
+        return out
+
+    req = PreprocessedRequest(
+        request_id="r1", token_ids=[5, 9, 13], max_tokens=6, temperature=0.0
+    )
+    a = run(collect(req))
+    b = run(collect(req))
+    toks = [t for i in a for t in i["token_ids"]]
+    assert len(toks) == 6
+    assert toks == [t for i in b for t in i["token_ids"]]  # greedy == greedy
+    assert a[-1]["finish_reason"] == "length"
+
+    # stop id cuts the stream with finish_reason=stop
+    req_stop = PreprocessedRequest(
+        request_id="r2", token_ids=[5, 9, 13], max_tokens=32,
+        temperature=0.0, stop_token_ids=[toks[1]],
+    )
+    s = run(collect(req_stop))
+    assert s[-1]["finish_reason"] == "stop"
+    assert len(s) <= 2 + 1
+
+    # cancellation stops generation
+    async def cancelled():
+        ctx = Context(request_id="r3")
+        req3 = PreprocessedRequest(
+            request_id="r3", token_ids=[1, 2], max_tokens=500,
+            temperature=0.0,
+        )
+        n = 0
+        async for _ in eng.generate(ctx, req3):
+            n += 1
+            if n == 2:
+                ctx.cancel()
+        return n
+
+    assert run(cancelled()) <= 3
+
+
+def test_external_worker_serves_chat_through_distributed_stack():
+    """fabric server + external HF worker + ModelWatcher frontend: the
+    full wire path, plus KV events buffered for the router publish loop
+    and external metrics on the load plane."""
+
+    async def main():
+        from dynamo_tpu.runtime import DistributedRuntime
+        from dynamo_tpu.runtime.fabric import FabricServer
+        from dynamo_tpu.worker import Worker
+
+        fabric_server = FabricServer(port=0)
+        await fabric_server.start()
+
+        eng = _engine(block_size=4, salt="hf-ext")
+        rt_worker = await DistributedRuntime.create(fabric_server.address)
+        card = ModelDeploymentCard(
+            name="hf-ext", tokenizer={"kind": "byte"}, context_length=512,
+            kv_page_size=4,
+        )
+        worker = Worker(
+            rt_worker, card, engine_kind="external", engine=eng,
+            namespace="ns", metrics_interval=60.0,  # keep events buffered
+        )
+        await worker.start()
+        assert eng.on_kv_event is not None  # worker wired the sink
+
+        rt_front = await DistributedRuntime.create(fabric_server.address)
+        manager = ModelManager()
+        watcher = ModelWatcher(rt_front, manager)
+        await watcher.start()
+        for _ in range(80):
+            if manager.get("hf-ext"):
+                break
+            await asyncio.sleep(0.05)
+        assert manager.get("hf-ext") is not None
+
+        svc = HttpService(manager, host="127.0.0.1", port=0)
+        await svc.start()
+        base = f"http://127.0.0.1:{svc.port}"
+        async with aiohttp.ClientSession() as s:
+            body = {
+                "model": "hf-ext",
+                "messages": [{"role": "user", "content": "hello ext"}],
+                "max_tokens": 8,
+                "temperature": 0.0,
+            }
+            async with s.post(f"{base}/v1/chat/completions", json=body) as r:
+                assert r.status == 200
+                data = await r.json()
+            assert data["usage"]["completion_tokens"] == 8
+            assert data["choices"][0]["finish_reason"] == "length"
+
+            # streaming SSE rides the same engine
+            body["stream"] = True
+            async with s.post(f"{base}/v1/chat/completions", json=body) as r:
+                assert r.status == 200
+                text = await r.text()
+            # random tokens under the byte tokenizer buffer at UTF-8
+            # boundaries, so chunk count < token count is fine — require
+            # a real stream: >=1 delta chunk plus the DONE sentinel
+            assert text.count("data:") >= 2
+            assert "[DONE]" in text
+
+        # the foreign engine's stored-events reached the publish buffer
+        assert any(
+            e.kind == "stored" and e.token_blocks
+            for e in worker._kv_event_buffer
+        )
+
+        await svc.stop()
+        await watcher.stop()
+        await rt_front.close()
+        await worker.stop()
+        await rt_worker.close()
+        await fabric_server.stop()
+
+    run(main())
+
+
+def test_hf_shim_script_subprocess_e2e():
+    """The actual shim SCRIPT as a process: fabric + hf_worker.py +
+    http frontend, completion over the wire (kv router mode)."""
+    import aiohttp  # noqa: F811
+
+    from benchmarks._procs import ManagedProc, cli, free_port
+
+    import sys
+
+    fport, hport = free_port(), free_port()
+    procs = []
+    try:
+        fb = ManagedProc("fabric", cli("fabric", "--port", str(fport)))
+        procs.append(fb)
+        fb.wait_for("listening|fabric server on")
+        w = ManagedProc(
+            "hf-worker",
+            [sys.executable, "examples/engines/hf_worker.py",
+             "--fabric", f"127.0.0.1:{fport}", "--model", "hf-sub",
+             "--router-mode", "kv", "--page-size", "4"],
+        )
+        procs.append(w)
+        w.wait_for(r"worker booting", timeout=120)
+        w.wait_for(r"worker \w+ up", timeout=120)
+        fe = ManagedProc(
+            "frontend",
+            cli("run", "in=http", "out=dyn",
+                "--fabric", f"127.0.0.1:{fport}", "--port", str(hport)),
+        )
+        procs.append(fe)
+        fe.wait_for("model attached", timeout=120)
+
+        async def drive():
+            async with aiohttp.ClientSession() as s:
+                body = {
+                    "model": "hf-sub",
+                    "messages": [{"role": "user", "content": "Hi"}],
+                    "max_tokens": 5,
+                    "temperature": 0.0,
+                }
+                async with s.post(
+                    f"http://127.0.0.1:{hport}/v1/chat/completions",
+                    json=body,
+                ) as r:
+                    assert r.status == 200
+                    return await r.json()
+
+        data = run(drive())
+        assert data["usage"]["completion_tokens"] == 5
+    finally:
+        for p in reversed(procs):
+            p.stop()
