@@ -17,6 +17,7 @@ PartitionSpecs and the same jitted programs run SPMD over the mesh.
 from __future__ import annotations
 
 import logging
+import os
 import time
 from dataclasses import dataclass
 from functools import partial
@@ -43,6 +44,16 @@ from dynamo_tpu.parallel.shardings import batch_spec, shardings_for
 from dynamo_tpu.tokens import TokenBlockSequence
 
 logger = logging.getLogger(__name__)
+
+
+def _canonical_gather(kv, ids, dk: int, dv: int):
+    """Pool layout [L, P, S, Hkv, Dpad] -> canonical wire layout
+    [L, Hkv, n, S, D] (padding stripped). THE one definition of the
+    extract layout — single-process async extract and the multi-host
+    sharded extract both trace this, so they can never diverge."""
+    k = jnp.take(kv.k, ids, axis=1).transpose(0, 3, 1, 2, 4)[..., :dk]
+    v = jnp.take(kv.v, ids, axis=1).transpose(0, 3, 1, 2, 4)[..., :dv]
+    return k, v
 
 
 @dataclass
@@ -137,23 +148,35 @@ class JaxEngine:
                     "head-sharded attention"
                 )
         if config.host_kv_cache_bytes > 0 or config.disk_kv_cache_bytes > 0:
-            if self._multiproc:
-                raise ValueError(
-                    "host/disk KV tiering is single-process for now: "
-                    "extract/inject read KV shards each host cannot "
-                    "address under a cross-host mesh"
-                )
             from dynamo_tpu.kvbm import TieredPageAllocator
 
+            # Cross-host meshes tier PER-HOST SHARDS: every replica runs
+            # the same (lockstep-deterministic) tier decisions, extract
+            # hands each host its own Hkv slice, inject reassembles the
+            # global array from the local slices — so G2/G3 capacity
+            # scales with hosts and no host ever addresses a remote
+            # shard. The async double-buffered extract stays
+            # single-process (its staged arrays materialize via
+            # np.asarray, which a multi-host global array refuses).
+            disk_dir = config.disk_kv_cache_dir
+            if self._multiproc and disk_dir:
+                # disk entries are keyed by seq_hash alone; co-located
+                # processes sharing one dir would overwrite each other's
+                # per-host slices (same shapes, silently wrong heads)
+                disk_dir = os.path.join(
+                    disk_dir, f"host{jax.process_index()}"
+                )
             self.allocator: PageAllocator = TieredPageAllocator(
                 config.num_pages,
                 config.page_size,
                 extract_fn=self.extract_pages,
-                extract_async_fn=self.extract_pages_async,
+                extract_async_fn=(
+                    None if self._multiproc else self.extract_pages_async
+                ),
                 inject_fn=self.inject_pages,
                 host_bytes=config.host_kv_cache_bytes,
                 disk_bytes=config.disk_kv_cache_bytes,
-                disk_dir=config.disk_kv_cache_dir,
+                disk_dir=disk_dir,
                 on_event=on_kv_event,
                 on_tier_event=on_tier_event,
             )
@@ -1198,9 +1221,62 @@ class JaxEngine:
         """Pull KV pages to host in the canonical wire format:
         (k, v) as [L, Hkv, n, page_size, D] — layout- and padding-agnostic
         so disagg peers and KVBM tiers interoperate across engine configs.
-        (Device cache is [L, P, S, Hkv, Dpad].)"""
-        k, v = self.extract_pages_async(page_ids)
-        return np.asarray(k), np.asarray(v)
+        (Device cache is [L, P, S, Hkv, Dpad].)
+
+        Cross-host meshes return the PROCESS-LOCAL Hkv slice: each host
+        tiers its own shard and `inject_pages` reassembles the global
+        array from the per-host slices (reference KVBM has no
+        single-process restriction either, block_manager.rs:69-78)."""
+        if not self._multiproc:
+            k, v = self.extract_pages_async(page_ids)
+            return np.asarray(k), np.asarray(v)
+        n = len(page_ids)
+        fn = self._jit_cache.get(("extract_mp", n))
+        if fn is None:
+            dk, dv = self._canonical_head_dims
+            fn = jax.jit(
+                lambda kv, ids: _canonical_gather(kv, ids, dk, dv),
+                out_shardings=(
+                    self._canonical_kv_sharding(self.kv.k),
+                    self._canonical_kv_sharding(self.kv.v),
+                ),
+            )
+            self._jit_cache[("extract_mp", n)] = fn
+        k, v = fn(self.kv, jnp.asarray(np.asarray(page_ids, np.int32)))
+        return self._process_local_np(k), self._process_local_np(v)
+
+    def _canonical_kv_sharding(self, pool):
+        """Sharding of the canonical [L, Hkv, n, S, D] layout matching
+        `pool`'s [L, P, S, Hkv, Dpad] placement: the Hkv axis keeps the
+        pool's mesh axis (tp for head-sharded caches, replicated for
+        MLA's shared latent), everything else replicates."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        spec = getattr(pool.sharding, "spec", None)
+        head_axis = spec[3] if spec is not None and len(spec) > 3 else None
+        return NamedSharding(self.mesh, P(None, head_axis, None, None, None))
+
+    @staticmethod
+    def _process_local_np(arr) -> np.ndarray:
+        """This process's slice of a canonical global array as numpy:
+        dedupe the addressable shards by their Hkv offset (dp replicas
+        carry identical bytes) and concatenate the distinct slices."""
+        by_start: dict = {}
+        for s in arr.addressable_shards:
+            sl = s.index[1]
+            start = sl.start or 0
+            if start not in by_start:
+                by_start[start] = np.asarray(s.data)
+        starts = sorted(by_start)
+        parts = [by_start[i] for i in starts]
+        # make_array_from_process_local_data needs one contiguous local
+        # block per process — standard mesh construction guarantees it
+        for a, b, p in zip(starts, starts[1:], parts):
+            assert a + p.shape[1] == b, (
+                "non-contiguous local KV shards; mesh device order is "
+                "not process-contiguous"
+            )
+        return parts[0] if len(parts) == 1 else np.concatenate(parts, axis=1)
 
     def extract_pages_async(self, page_ids: Sequence[int]):
         """Async variant: the page gather + canonical transpose run on
@@ -1213,9 +1289,7 @@ class JaxEngine:
         way, block_manager/offload.rs)."""
         ids = jnp.asarray(np.asarray(page_ids, np.int32))
         dk, dv = self._canonical_head_dims
-        # [L, n, S, Hkv, Dp] -> [L, Hkv, n, S, D] on device
-        k = jnp.take(self.kv.k, ids, axis=1).transpose(0, 3, 1, 2, 4)[..., :dk]
-        v = jnp.take(self.kv.v, ids, axis=1).transpose(0, 3, 1, 2, 4)[..., :dv]
+        k, v = _canonical_gather(self.kv, ids, dk, dv)
         try:
             k.copy_to_host_async()
             v.copy_to_host_async()
@@ -1227,7 +1301,23 @@ class JaxEngine:
         """Write transferred KV pages (canonical [L, Hkv, n, S, D]) into
         this engine's pool in place. Host arrays become uncommitted device
         arrays, so the jitted scatter reshards them onto whatever mesh the
-        pool lives on."""
+        pool lives on. Cross-host meshes take the PROCESS-LOCAL Hkv slice
+        (what `extract_pages` returned on this host) and assemble the
+        global array from every host's slice."""
+        if self._multiproc:
+            ksh = self._canonical_kv_sharding(self.kv.k)
+            vsh = self._canonical_kv_sharding(self.kv.v)
+            hkv = self.kv.k.shape[3]
+            gk = jax.make_array_from_process_local_data(
+                ksh, np.ascontiguousarray(k),
+                (k.shape[0], hkv, *k.shape[2:]),
+            )
+            gv = jax.make_array_from_process_local_data(
+                vsh, np.ascontiguousarray(v),
+                (v.shape[0], hkv, *v.shape[2:]),
+            )
+            self.inject_pages_device(page_ids, gk, gv)
+            return
         self.inject_pages_device(page_ids, jnp.asarray(k), jnp.asarray(v))
 
     def inject_pages_device(self, page_ids: Sequence[int], k, v) -> None:
@@ -1282,9 +1372,17 @@ class JaxEngine:
     def serve_blocks(self, seq_hashes: Sequence[int]):
         """Export the longest locally-resident chain of `seq_hashes` for a
         peer: (metas, k, v) with metas=[(seq_hash, parent, tokens)...] and
-        k/v canonical [L, Hkv, n, S, D] host arrays; None when the first
-        hash isn't here. Device pages are ref-held during extraction; the
-        lower tiers are read without promotion."""
+        k/v canonical FULL-Hkv [L, Hkv, n, S, D] host arrays; None when
+        the first hash isn't here. Device pages are ref-held during
+        extraction; the lower tiers are read without promotion.
+
+        Cross-host meshes refuse: extraction (and the tiers) hold only
+        this process's Hkv slice, and shipping a partial-head array to a
+        peer expecting the full canonical layout would install silently
+        wrong KV. (The Worker already bars kv_remote on SPMD groups —
+        this guard keeps the contract honest for direct callers.)"""
+        if self._multiproc:
+            return None
         alloc = self.allocator
         pages = PageAllocator.lookup(alloc, seq_hashes)  # never onboards
         metas: list[tuple] = []

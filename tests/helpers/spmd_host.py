@@ -23,6 +23,9 @@ def main() -> None:
     ap.add_argument("--devices-per-host", type=int, default=4)
     ap.add_argument("--dp", type=int, default=4)
     ap.add_argument("--tp", type=int, default=2)
+    ap.add_argument("--tier", action="store_true",
+                    help="host-tier config + two-phase evict/onboard "
+                         "workload (per-host shard tiering)")
     ap.add_argument("--out", default=None)
     args = ap.parse_args()
 
@@ -46,15 +49,32 @@ def main() -> None:
     from dynamo_tpu.engine.request import SamplingParams
     from dynamo_tpu.engine.spmd import SpmdDriver
 
-    eng = JaxEngine(spmd_test_config(args.dp, args.tp))
+    cfg = (
+        spmd_tier_config(args.dp, args.tp)
+        if args.tier
+        else spmd_test_config(args.dp, args.tp)
+    )
+    eng = JaxEngine(cfg)
     drv = SpmdDriver(eng)
     if drv.is_leader:
-        for rid, toks, mt in spmd_test_workload():
-            drv.submit(rid, toks, SamplingParams(temperature=0.0,
-                                                 max_tokens=mt))
-        done = drv.run_to_completion()
+        done = {}
+        for phase in (
+            spmd_tier_workload() if args.tier else [spmd_test_workload()]
+        ):
+            for rid, toks, mt in phase:
+                drv.submit(
+                    rid, toks, SamplingParams(temperature=0.0, max_tokens=mt)
+                )
+            done.update(drv.run_to_completion())
         drv.shutdown()
-        Path(args.out).write_text(json.dumps(done))
+        out = dict(done)
+        if args.tier:
+            out = {
+                "outputs": done,
+                "offloaded": eng.allocator.stats.offloaded_blocks,
+                "onboarded": eng.allocator.stats.onboarded_blocks,
+            }
+        Path(args.out).write_text(json.dumps(out))
     else:
         drv.serve()
 
@@ -81,11 +101,42 @@ def spmd_test_config(dp: int, tp: int):
     )
 
 
+def spmd_tier_config(dp: int, tp: int):
+    """Lockstep config with a host KV tier and a pool small enough that
+    the churn workload forces evictions through it."""
+    from dataclasses import replace
+
+    # pool sized so the churn phase MUST evict the pinned prompt's cached
+    # blocks through the host tier (each churn request alone nearly fills
+    # the free pool)
+    return replace(
+        spmd_test_config(dp, tp),
+        num_pages=16,
+        host_kv_cache_bytes=1 << 22,
+    )
+
+
+def spmd_tier_workload():
+    """Two phases: (A) a pinned prompt + churn that evicts its cached
+    blocks into the host tier, (B) the same prompt again — blocks must
+    onboard from each host's tier shard, byte-identically."""
+    import numpy as np
+
+    rng = np.random.default_rng(23)
+    prompt_a = [int(x) for x in rng.integers(1, 200, 16)]
+    phase_a = [("a0", prompt_a, 6)] + [
+        (f"churn{i}", [int(x) for x in rng.integers(200, 250, 20)], 4)
+        for i in range(6)
+    ]
+    return [phase_a, [("a1", prompt_a, 6)]]
+
+
 def spawn_two_hosts(
     devices_per_host: int = 4,
     dp: int = 4,
     tp: int = 2,
     timeout: float = 420.0,
+    tier: bool = False,
 ):
     """Spawn the 2-process lockstep fleet and return (leader_outputs,
     logs). Shared by tests/test_spmd_serve.py and __graft_entry__'s
@@ -111,6 +162,7 @@ def spawn_two_hosts(
                 "--coordinator", f"127.0.0.1:{port}",
                 "--devices-per-host", str(devices_per_host),
                 "--dp", str(dp), "--tp", str(tp),
+                *(["--tier"] if tier else []),
                 *(["--out", str(out)] if i == 0 else []),
             ],
             env=env, stdout=subprocess.PIPE,
