@@ -77,7 +77,10 @@ routing() {
     --requests 64 --osl 16 --concurrency 8 --warmup 8
 }
 decode_profile() {
-  run_stage decode_profile python scripts/tpu_decode_profile.py
+  # stage name differs from the script's own artifact
+  # (decode_profile.json) — run_stage's stdout redirect opens its file at
+  # offset 0, so a shared name would clobber the clean write_text JSON
+  run_stage decode_prof python scripts/tpu_decode_profile.py
 }
 offload() {
   run_stage offload_ab python -m benchmarks.offload_bench \
@@ -98,9 +101,21 @@ bench_1b_sweep() {
   # hybrid); bench.py reports the best with both in extras
   run_stage bench_1b python bench.py
 }
+pallas_gate() {
+  # numerics GATE: prefill logit diff + 32-step teacher-forced drift
+  # (budget 0.25 / >=90% argmax agreement); exit 2 = gate failed.
+  # Stage name != the script's own pallas_serve_check.json artifact (see
+  # decode_profile note).
+  run_stage pallas_gate python scripts/tpu_pallas_serve_check.py
+}
+transfer() {
+  # re-measure the transfer planes on the chip (host path now rides the
+  # same-host shm plane; device pull needs the PJRT transfer server)
+  run_stage transfer python -m benchmarks.transfer_bench --mb 64 --iters 4
+}
 
 STAGES=("$@")
-[ ${#STAGES[@]} -eq 0 ] && STAGES=(prewarm disagg_ab sweep_8b sla_8b ft_kill routing offload bench_dsv2 decode_profile bench_1b_sweep)
+[ ${#STAGES[@]} -eq 0 ] && STAGES=(prewarm disagg_ab sweep_8b sla_8b ft_kill routing offload bench_dsv2 decode_profile bench_1b_sweep pallas_gate transfer)
 
 wait_for_tunnel
 for s in "${STAGES[@]}"; do
