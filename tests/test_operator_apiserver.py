@@ -1,0 +1,155 @@
+"""Operator vs a REAL (fake) API server — the test tier beyond the
+in-memory double (round-4 verdict item 9; reference operator: envtest,
+deploy/cloud/operator/internal/controller/suite_test.go).
+
+The reconciler/controller drive `InClusterKube` (the production REST
+client, stdlib urllib + Bearer auth) against a kwok-style HTTP apiserver
+with real semantics: resourceVersions, 409 Conflicts, Status error
+bodies, label selectors, /status merge-patch. Covers create / heal /
+GC / conflict-retry / 401 token-refresh."""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent / "helpers"))
+
+import pytest
+
+from fake_kube_apiserver import FakeKubeApiServer  # noqa: E402
+
+from dynamo_tpu.operator.controller import Controller  # noqa: E402
+from dynamo_tpu.operator.kube import InClusterKube  # noqa: E402
+
+
+def _cr(name="demo", ns="default", replicas=1):
+    return {
+        "apiVersion": "dynamo.tpu/v1alpha1",
+        "kind": "DynamoGraphDeployment",
+        "metadata": {"name": name, "namespace": ns, "generation": 1},
+        "spec": {
+            "image": "dynamo-tpu:test",
+            "services": [
+                {"name": "Frontend", "class": "frontend",
+                 "replicas": replicas, "endpoints": [], "depends": [],
+                 "config": {}, "k8s": {}},
+            ],
+        },
+    }
+
+
+@pytest.fixture()
+def stack(tmp_path, monkeypatch):
+    server = FakeKubeApiServer(token="sekret").start()
+    sa = tmp_path / "sa"
+    sa.mkdir()
+    (sa / "token").write_text("sekret")
+    monkeypatch.setattr(InClusterKube, "SA_DIR", str(sa))
+    kube = InClusterKube(base_url=server.base_url)
+    yield server, kube
+    server.stop()
+
+
+def test_create_heal_gc_against_http_apiserver(stack):
+    server, kube = stack
+    server.seed("DynamoGraphDeployment", "default", _cr())
+    ctl = Controller(kube, namespace="default")
+
+    # CREATE: children appear on the server with ownership labels + RVs
+    statuses = ctl.reconcile_once()
+    assert statuses["demo"]["lastAction"]["created"] >= 2  # deploys + svcs
+    deploys = server.objects("Deployment", "default")
+    assert deploys and all(
+        d["metadata"]["labels"]["dynamo.tpu/deployment"] == "demo"
+        for d in deploys
+    )
+    assert all(d["metadata"]["resourceVersion"] for d in deploys)
+    cr = server.get("DynamoGraphDeployment", "default", "demo")
+    assert cr["status"]["conditions"][0]["status"] == "True"
+
+    # steady state: second pass is a no-op
+    statuses = ctl.reconcile_once()
+    assert statuses["demo"]["lastAction"] == {
+        "created": 0, "replaced": 0, "deleted": 0,
+    }
+
+    # HEAL: hand-break a child's spec server-side; reconcile replaces it
+    victim = deploys[0]["metadata"]["name"]
+    broken = server.get("Deployment", "default", victim)
+    broken["spec"]["replicas"] = 99
+    server.seed("Deployment", "default", broken)
+    statuses = ctl.reconcile_once()
+    assert statuses["demo"]["lastAction"]["replaced"] == 1
+    healed = server.get("Deployment", "default", victim)
+    assert healed["spec"]["replicas"] != 99
+
+    # GC: CR vanishes -> every owned child is swept
+    server.delete("DynamoGraphDeployment", "default", "demo")
+    ctl.reconcile_once()
+    assert server.objects("Deployment", "default") == []
+    assert server.objects("Service", "default") == []
+
+
+def test_orphan_child_sweep(stack):
+    """A child whose name is no longer desired (service renamed/removed)
+    is deleted by the ownership sweep."""
+    server, kube = stack
+    server.seed("DynamoGraphDeployment", "default", _cr())
+    ctl = Controller(kube, namespace="default")
+    ctl.reconcile_once()
+    server.seed(
+        "Deployment", "default",
+        {
+            "apiVersion": "apps/v1", "kind": "Deployment",
+            "metadata": {
+                "name": "demo-stale-worker",
+                "labels": {
+                    "app.kubernetes.io/managed-by": "dynamo-tpu-operator",
+                    "dynamo.tpu/deployment": "demo",
+                },
+            },
+            "spec": {"replicas": 1},
+        },
+    )
+    statuses = ctl.reconcile_once()
+    assert statuses["demo"]["lastAction"]["deleted"] == 1
+    assert server.get("Deployment", "default", "demo-stale-worker") is None
+
+
+def test_conflict_on_update_retries_to_convergence(stack):
+    """A 409 Conflict mid-reconcile errors THAT pass (Ready=False) but
+    must not wedge the loop: the next pass re-reads fresh
+    resourceVersions and converges."""
+    server, kube = stack
+    server.seed("DynamoGraphDeployment", "default", _cr())
+    ctl = Controller(kube, namespace="default")
+    ctl.reconcile_once()
+
+    victim = server.objects("Deployment", "default")[0]["metadata"]["name"]
+    broken = server.get("Deployment", "default", victim)
+    broken["spec"]["replicas"] = 99
+    server.seed("Deployment", "default", broken)
+
+    server.fail_next(409)  # the healing PUT hits a conflict
+    statuses = ctl.reconcile_once()
+    assert statuses["demo"]["conditions"][0]["status"] == "False"
+    assert server.get(
+        "Deployment", "default", victim
+    )["spec"]["replicas"] == 99  # still broken after the failed pass
+
+    statuses = ctl.reconcile_once()  # retry pass converges
+    assert statuses["demo"]["conditions"][0]["status"] == "True"
+    assert server.get(
+        "Deployment", "default", victim
+    )["spec"]["replicas"] != 99
+
+
+def test_401_refreshes_token_and_retries(stack, tmp_path):
+    """A 401 (rotated service-account token) is absorbed by the client's
+    refresh+retry — the reconcile pass succeeds transparently."""
+    server, kube = stack
+    server.seed("DynamoGraphDeployment", "default", _cr())
+    ctl = Controller(kube, namespace="default")
+    server.fail_next(401)
+    statuses = ctl.reconcile_once()
+    assert statuses["demo"]["conditions"][0]["status"] == "True"
+    assert server.objects("Deployment", "default")
