@@ -889,6 +889,26 @@ def _chunk_only_attention(q, k, v, positions, valid, cfg, dpad, mesh=None,
     )
 
 
+#: route decode to the XLA gather past this kernel VMEM estimate rather
+#: than letting Mosaic fail allocation (v5e VMEM is 16 MiB; leave head-
+#: room for Mosaic's own buffers)
+_PALLAS_DECODE_VMEM_BUDGET = 12 << 20
+
+
+def maybe_decode_work(cfg, tokens, positions, kv, page_tables):
+    """The decode kernel's (sequence, page) work list is LAYER-INVARIANT:
+    build it once per step, outside the layer scan (XLA won't reliably
+    hoist the sort out of the loop). Shared by the Llama and MoE forward
+    passes; None whenever the step can't take the kernel path."""
+    if tokens.shape[1] != 1 or cfg.attention_impl not in (
+        "pallas", "hybrid"
+    ):
+        return None
+    from dynamo_tpu.ops.paged_attention import decode_work_list
+
+    return decode_work_list(page_tables, positions[:, 0], kv.k.shape[2])
+
+
 def attention_block(
     q: jax.Array,  # [B, T, Hq, D] pre-rope
     k: jax.Array,  # [B, T, Hkv, D] pre-rope
@@ -902,6 +922,7 @@ def attention_block(
     cfg: LlamaConfig,
     first_chunk: bool = False,
     mesh=None,
+    decode_work=None,  # precomputed ops.paged_attention.decode_work_list
 ):
     """rope → paged attention, in one of two write disciplines:
 
@@ -969,15 +990,24 @@ def attention_block(
         attn = paged_attention(q, k_all, v_all, positions, cfg, window=window)
         return attn, k_cache, v_cache, None
 
-    from dynamo_tpu.ops.paged_attention import paged_decode_attention
+    from dynamo_tpu.ops.paged_attention import (
+        decode_vmem_bytes,
+        paged_decode_attention,
+    )
 
+    tp = mesh.shape.get("tp", 1) if mesh is not None else 1
+    kernel_vmem = decode_vmem_bytes(
+        b, cfg.num_heads // tp, cfg.kv_head_dim, k_cache.shape[2],
+        cfg.num_kv_heads // tp or 1, jnp.dtype(cfg.dtype).itemsize,
+    )
     if t == 1 and (
-        cfg.attention_impl == "hybrid" and b > cfg.pallas_decode_max_batch
+        (cfg.attention_impl == "hybrid" and b > cfg.pallas_decode_max_batch)
+        or kernel_vmem > _PALLAS_DECODE_VMEM_BUDGET
     ):
-        # Large decode buckets: the dense gather reads ~the same HBM bytes
-        # in a handful of fused XLA ops instead of O(B x pages) per-page
-        # DMA descriptors; the scatter-free cache still holds history
-        # only, and _xla_history_attention masks exactly that.
+        # Two routes to the dense gather: (a) hybrid's large-batch policy
+        # (the gather reads ~the same HBM bytes in a handful of fused XLA
+        # ops), (b) the flattened kernel's whole-batch VMEM blocks would
+        # overflow — route instead of letting Mosaic fail allocation.
         attn = _xla_history_attention(
             q, k, v, k_cache, v_cache, layer, page_tables, positions,
             valid, cfg, dpad,
@@ -989,7 +1019,7 @@ def attention_block(
             qd = jnp.pad(qd, ((0, 0), (0, 0), (0, dpad)))
         acc, m, l = paged_decode_attention(
             qd, k_cache, v_cache, layer, page_tables, hist,
-            scale_dim=cfg.head_dim, mesh=mesh,
+            scale_dim=cfg.head_dim, mesh=mesh, work_list=decode_work,
         )  # acc [B,Hq,Dpad] unnormalized, m/l [B,Hq]
         # Exact merge of the current (unwritten) token: self-attention
         # score s = q·k_cur/√d folded into the flash running state.
@@ -1112,6 +1142,8 @@ def forward_hidden(
     else:
         raise ValueError(f"unknown hidden_act {cfg.hidden_act!r}")
 
+    decode_work = maybe_decode_work(cfg, tokens, positions, kv, page_tables)
+
     def layer(carry, xs):
         h, k_full, v_full = carry
         lp, li = xs
@@ -1130,7 +1162,7 @@ def forward_hidden(
             k = rms_norm(k, lp["k_norm"], cfg.rms_norm_eps, off)
         attn, k_full, v_full, staged = attention_block(
             q, k, v, k_full, v_full, li, page_tables, positions, valid, cfg,
-            first_chunk=first_chunk, mesh=mesh,
+            first_chunk=first_chunk, mesh=mesh, decode_work=decode_work,
         )
         attn_out = _mm(attn, lp, "wo", cfg.dtype)
         if cfg.post_block_norms:  # Gemma2: norm the branch, then residual

@@ -346,6 +346,10 @@ def forward_hidden(
     if mm_embeds is not None:
         h = jnp.where(mm_mask[..., None], mm_embeds.astype(bc.dtype), h)
 
+    decode_work = llama_mod.maybe_decode_work(
+        bc, tokens, positions, kv, page_tables
+    )
+
     def layer(carry, xs):
         h, k_full, v_full = carry
         lp, li = xs
@@ -365,7 +369,7 @@ def forward_hidden(
             k = rms_norm(k, lp["k_norm"], bc.rms_norm_eps)
         attn, k_full, v_full, staged = attention_block(
             q, k, v, k_full, v_full, li, page_tables, positions, valid, bc,
-            first_chunk=first_chunk, mesh=mesh,
+            first_chunk=first_chunk, mesh=mesh, decode_work=decode_work,
         )
         h = h + llama_mod._mm(attn, lp, "wo", bc.dtype)
         x = rms_norm(h, lp["mlp_norm"], bc.rms_norm_eps)

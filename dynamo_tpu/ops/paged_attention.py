@@ -4,16 +4,26 @@ Decode (T=1) attention over the paged KV history. The XLA fallback path
 (models/llama.py:paged_attention) gathers the full per-sequence KV history
 into a dense [B, K, Hkv, D] array in HBM before the matmuls — 2× the HBM
 traffic (read pages, write gather, read gather) plus O(B·MP·S) memory. This
-kernel instead walks each sequence's page table and streams pages HBM→VMEM
-with double-buffered async DMA, accumulating a flash-style online softmax.
-KV bytes are read exactly once, nothing is materialized.
+kernel streams pages HBM→VMEM with multi-buffered async DMA, accumulating a
+flash-style online softmax. KV bytes are read exactly once, nothing is
+materialized.
+
+The work list is FLATTENED: one kernel invocation (grid=(1,)) walks every
+(sequence, page) pair of the batch back to back, so the DMA pipeline stays
+full across the whole batch. The round-3 per-sequence-grid design drained
+its 2-deep pipeline at every grid-cell boundary — at decode batch 128 that
+is 128 pipeline restarts per layer per step, and DMA issue latency (not
+bandwidth) dominated the measured 13 ms/token-row vs the ~4 ms HBM
+roofline (artifacts/tpu/decode_profile.json). Per-page flash merges are
+order-independent (max/rescale/add), so each page read-modify-writes its
+sequence's running (m, l, acc) rows in the VMEM outputs directly — no
+carried state, no sequence-boundary flushes.
 
 Cache layout is [L, P, S, Hkv, D] (models/llama.py KVPages): one (layer,
 page) slice is a contiguous [S, Hkv, D] block, so a single DMA per page
-feeds the compute for EVERY kv head — the grid is (B,), one cell per
-sequence, with the (small) per-head dots unrolled inside the cell. D is
-lane-padded to a 128 multiple (LlamaConfig.kv_head_dim): Mosaic DMA slices
-must be 128-aligned in the minor dimension.
+feeds the compute for EVERY kv head. D is lane-padded to a 128 multiple
+(LlamaConfig.kv_head_dim): Mosaic DMA slices must be 128-aligned in the
+minor dimension.
 
 The kernel reads HISTORY ONLY (tokens already written to pages — the
 current token's KV is staged and written once per step by ops/kv_update).
@@ -37,108 +47,169 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+#: DMA pipeline depth (slots per k/v scratch). 4 hides issue latency well
+#: past the 2-deep minimum while costing only 2 extra [S, Hkv, D] buffers.
+_DEPTH = 4
+
 
 def _decode_kernel(
     # scalar prefetch
     layer_ref,  # [1] int32 — layer of the stacked cache to read
-    pt_ref,  # [B, MP] int32 page tables (SMEM)
+    nwork_ref,  # [1] int32 — valid (sequence, page) work items
+    order_ref,  # [B*MP] int32 — work item -> b*MP + page ordinal
+    page_of_ref,  # [B*MP] int32 — work item -> physical page id
     len_ref,  # [B] int32 HISTORY lengths (tokens already in the cache)
     # inputs
-    q_ref,  # [1, HQ, D] VMEM block (this sequence's queries, unscaled)
+    q_ref,  # [B, HQ, D] VMEM (whole batch's queries, unscaled)
     k_ref,  # [L, P, S, Hkv, D] in HBM/ANY
     v_ref,  # [L, P, S, Hkv, D] in HBM/ANY
-    # outputs
-    acc_ref,  # [1, HQ, D] f32 — UNNORMALIZED flash accumulator
-    m_ref,  # [1, HQ, 128] f32 — running max (lane-broadcast)
-    l_ref,  # [1, HQ, 128] f32 — running denominator (lane-broadcast)
+    # outputs (whole batch resident in VMEM; read-modify-written per page)
+    acc_ref,  # [B, HQ, D] f32 — UNNORMALIZED flash accumulator
+    m_ref,  # [B, HQ, 128] f32 — running max (lane-broadcast)
+    l_ref,  # [B, HQ, 128] f32 — running denominator (lane-broadcast)
     # scratch
-    k_scr,  # [2, S, Hkv, D] VMEM
-    v_scr,  # [2, S, Hkv, D] VMEM
-    sem,  # [2, 2] DMA semaphores: [k|v, slot]
+    k_scr,  # [DEPTH, S, Hkv, D] VMEM
+    v_scr,  # [DEPTH, S, Hkv, D] VMEM
+    sem,  # [2, DEPTH] DMA semaphores: [k|v, slot]
     *,
     page_size: int,
     scale_dim: int,
     num_kv_heads: int,
+    max_pages: int,  # MP — decodes order_ref into (sequence, ordinal)
 ):
-    b = pl.program_id(0)
     li = layer_ref[0]
+    n = nwork_ref[0]
     hq, d = q_ref.shape[1], q_ref.shape[2]
     g = hq // num_kv_heads
     s = page_size
-    hist = len_ref[b]
-    used = pl.cdiv(hist, s)  # pages the history actually occupies
+    inv_scale = 1.0 / math.sqrt(scale_dim)
 
-    def k_copy(slot, i):
+    # Rows never visited (zero history) must read as the empty-history
+    # state the caller's merge expects: acc=0, m=-inf, l=0.
+    acc_ref[...] = jnp.zeros_like(acc_ref)
+    m_ref[...] = jnp.full_like(m_ref, -jnp.inf)
+    l_ref[...] = jnp.zeros_like(l_ref)
+
+    def k_copy(slot, j):
         return pltpu.make_async_copy(
-            k_ref.at[li, pt_ref[b, i]], k_scr.at[slot], sem.at[0, slot]
+            k_ref.at[li, page_of_ref[j]], k_scr.at[slot], sem.at[0, slot]
         )
 
-    def v_copy(slot, i):
+    def v_copy(slot, j):
         return pltpu.make_async_copy(
-            v_ref.at[li, pt_ref[b, i]], v_scr.at[slot], sem.at[1, slot]
+            v_ref.at[li, page_of_ref[j]], v_scr.at[slot], sem.at[1, slot]
         )
 
-    @pl.when(used > 0)
-    def _():
-        k_copy(0, 0).start()
-        v_copy(0, 0).start()
+    # prime the pipeline: DEPTH-1 transfers in flight before compute starts
+    for p in range(_DEPTH - 1):
+        @pl.when(p < n)
+        def _(p=p):
+            k_copy(p, p).start()
+            v_copy(p, p).start()
 
-    # Scale after the f32 cast so bf16 q matches the XLA path bit-for-bit.
-    # scale_dim is the model's true head_dim — d may be lane-padded.
-    q = q_ref[0].astype(jnp.float32) * (1.0 / math.sqrt(scale_dim))  # [HQ, D]
+    def body(j, _):
+        slot = jax.lax.rem(j, _DEPTH)
 
-    def body(i, carry):
-        ms, ls, accs = carry  # per-head tuples: [G,1], [G,1], [G,D]
-        slot = jax.lax.rem(i, 2)
-
-        @pl.when(i + 1 < used)
+        @pl.when(j + _DEPTH - 1 < n)
         def _():
-            k_copy(1 - slot, i + 1).start()
-            v_copy(1 - slot, i + 1).start()
+            nslot = jax.lax.rem(j + _DEPTH - 1, _DEPTH)
+            k_copy(nslot, j + _DEPTH - 1).start()
+            v_copy(nslot, j + _DEPTH - 1).start()
 
-        k_copy(slot, i).wait()
-        v_copy(slot, i).wait()
+        k_copy(slot, j).wait()
+        v_copy(slot, j).wait()
 
+        oj = order_ref[j]
+        bj = oj // max_pages
+        hist = len_ref[bj]
+        q = q_ref[bj].astype(jnp.float32) * inv_scale  # [HQ, D]
         kp = k_scr[slot].astype(jnp.float32)  # [S, Hkv, D]
         vp = v_scr[slot].astype(jnp.float32)
-        key_pos = i * s + jax.lax.broadcasted_iota(jnp.int32, (g, s), 1)
+        key_pos = (oj % max_pages) * s + jax.lax.broadcasted_iota(
+            jnp.int32, (g, s), 1
+        )
         key_mask = key_pos < hist  # [G, S]
+
+        m_old = m_ref[bj]  # [HQ, 128] (column 0 is the value)
+        l_old = l_ref[bj]
+        acc_old = acc_ref[bj]  # [HQ, D]
 
         # One DMA fed all heads; the per-head dots are small but the page
         # walk is DMA-bound, so their latency hides under the next copy.
         m_out, l_out, a_out = [], [], []
         for h in range(num_kv_heads):  # static unroll
-            qh = q[h * g : (h + 1) * g]  # [G, D]
+            sl = slice(h * g, (h + 1) * g)
+            qh = q[sl]  # [G, D]
+            ms = m_old[sl, :1]  # [G, 1]
+            ls = l_old[sl, :1]
+            accs = acc_old[sl]  # [G, D]
             scores = jax.lax.dot_general(
                 qh, kp[:, h], (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
             )  # [G, S]
             scores = jnp.where(key_mask, scores, -1e30)
-            m_new = jnp.maximum(ms[h], jnp.max(scores, axis=1, keepdims=True))
+            m_new = jnp.maximum(ms, jnp.max(scores, axis=1, keepdims=True))
             p = jnp.exp(scores - m_new)
-            corr = jnp.exp(ms[h] - m_new)
-            l_new = ls[h] * corr + jnp.sum(p, axis=1, keepdims=True)
-            a_new = accs[h] * corr + jax.lax.dot_general(
+            corr = jnp.exp(ms - m_new)
+            l_new = ls * corr + jnp.sum(p, axis=1, keepdims=True)
+            a_new = accs * corr + jax.lax.dot_general(
                 p, vp[:, h], (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
             )
             m_out.append(m_new)
             l_out.append(l_new)
             a_out.append(a_new)
-        return tuple(m_out), tuple(l_out), tuple(a_out)
+        acc_ref[bj] = jnp.concatenate(a_out, axis=0)
+        m_ref[bj] = jnp.broadcast_to(
+            jnp.concatenate(m_out, axis=0), (hq, 128)
+        )
+        l_ref[bj] = jnp.broadcast_to(
+            jnp.concatenate(l_out, axis=0), (hq, 128)
+        )
+        return 0
 
-    init = (
-        tuple(
-            jnp.full((g, 1), -jnp.inf, jnp.float32)
-            for _ in range(num_kv_heads)
-        ),
-        tuple(jnp.zeros((g, 1), jnp.float32) for _ in range(num_kv_heads)),
-        tuple(jnp.zeros((g, d), jnp.float32) for _ in range(num_kv_heads)),
+    jax.lax.fori_loop(0, n, body, 0)
+
+
+def decode_work_list(
+    page_tables: jax.Array,  # [B, MP] int32
+    history_lens: jax.Array,  # [B] int32
+    page_size: int,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Compacted (sequence, page) work list for the decode kernel:
+    (n_work [1], order [B*MP], page_of [B*MP]) with valid pairs first in
+    (b, i) order. `order` encodes both coordinates — the kernel derives
+    b = order//MP, i = order%MP with two scalar ops instead of carrying
+    two more [B*MP] prefetch arrays through SMEM.
+
+    LAYER-INVARIANT: build it once per decode step and pass it to every
+    layer's paged_decode_attention — inside the per-layer scan body XLA
+    is not guaranteed to hoist the sort, and re-sorting B*MP elements per
+    layer re-adds fixed per-layer overhead the flattened walk exists to
+    remove."""
+    mp = page_tables.shape[1]
+    hist = history_lens.astype(jnp.int32)
+    used = -(-hist // page_size)  # cdiv
+    valid = jnp.arange(mp, dtype=jnp.int32)[None, :] < used[:, None]
+    flat_valid = valid.reshape(-1)
+    order = jnp.argsort(~flat_valid, stable=True).astype(jnp.int32)
+    page_of = page_tables.reshape(-1).astype(jnp.int32)[order]
+    n_work = flat_valid.sum(dtype=jnp.int32).reshape(1)
+    return n_work, order, page_of
+
+
+def decode_vmem_bytes(
+    b: int, hq: int, d: int, s: int, hkv: int, itemsize: int
+) -> int:
+    """Kernel VMEM footprint estimate: whole-batch q + f32 acc/m/l blocks
+    plus the DMA scratch. The caller routes to the XLA gather when this
+    exceeds the budget instead of letting Mosaic fail allocation."""
+    return (
+        b * hq * d * itemsize  # q
+        + b * hq * d * 4  # acc f32
+        + 2 * b * hq * 128 * 4  # m, l f32 (lane-broadcast)
+        + 2 * _DEPTH * s * hkv * d * itemsize  # k/v scratch
     )
-    ms, ls, accs = jax.lax.fori_loop(0, used, body, init)
-    acc_ref[0] = jnp.concatenate(accs, axis=0)
-    m_ref[0] = jnp.broadcast_to(jnp.concatenate(ms, axis=0), (hq, 128))
-    l_ref[0] = jnp.broadcast_to(jnp.concatenate(ls, axis=0), (hq, 128))
 
 
 def paged_decode_attention(
@@ -152,6 +223,7 @@ def paged_decode_attention(
     scale_dim: int | None = None,
     interpret: bool | None = None,
     mesh=None,
+    work_list=None,  # precomputed decode_work_list (layer-invariant)
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """History-only flash attention over the paged cache.
 
@@ -164,22 +236,28 @@ def paged_decode_attention(
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    hkv, s = k_cache.shape[3], k_cache.shape[2]
+    if work_list is None:
+        work_list = decode_work_list(page_tables, history_lens, s)
     if mesh is not None and mesh.shape.get("tp", 1) > 1:
         # Heads are embarrassingly parallel: shard_map the kernel over tp
         # (q/outputs on the head axis, caches on the kv-head axis) — each
         # shard walks the same pages for its own heads, no collectives.
+        # The (replicated) work list rides along so shards don't re-sort.
         from functools import partial
 
         from jax import shard_map
         from jax.sharding import PartitionSpec as P
 
+        def sharded(q_, k_, v_, layer_, pt_, hist_, *wl):
+            return paged_decode_attention(
+                q_, k_, v_, layer_, pt_, hist_,
+                scale_dim=scale_dim, interpret=interpret, mesh=None,
+                work_list=tuple(wl),
+            )
+
         fn = shard_map(
-            partial(
-                paged_decode_attention,
-                scale_dim=scale_dim,
-                interpret=interpret,
-                mesh=None,
-            ),
+            sharded,
             mesh=mesh,
             in_specs=(
                 P(None, "tp", None),
@@ -188,31 +266,46 @@ def paged_decode_attention(
                 P(),
                 P(),
                 P(),
+                P(),
+                P(),
+                P(),
             ),
             out_specs=(P(None, "tp", None), P(None, "tp"), P(None, "tp")),
             check_vma=False,
         )
-        return fn(q, k_cache, v_cache, layer, page_tables, history_lens)
+        return fn(
+            q, k_cache, v_cache, layer, page_tables, history_lens,
+            *work_list,
+        )
     b, hq, d = q.shape
-    hkv, s = k_cache.shape[3], k_cache.shape[2]
+    mp = page_tables.shape[1]
+    n_work, order, page_of = work_list
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=3,
-        grid=(b,),
+        num_scalar_prefetch=5,
+        grid=(1,),
         in_specs=[
-            pl.BlockSpec((1, hq, d), lambda bi, li, pt, ln: (bi, 0, 0)),
+            pl.BlockSpec(
+                (b, hq, d), lambda i, li, n, od, pg, ln: (0, 0, 0)
+            ),
             pl.BlockSpec(memory_space=pl.ANY),
             pl.BlockSpec(memory_space=pl.ANY),
         ],
         out_specs=[
-            pl.BlockSpec((1, hq, d), lambda bi, li, pt, ln: (bi, 0, 0)),
-            pl.BlockSpec((1, hq, 128), lambda bi, li, pt, ln: (bi, 0, 0)),
-            pl.BlockSpec((1, hq, 128), lambda bi, li, pt, ln: (bi, 0, 0)),
+            pl.BlockSpec(
+                (b, hq, d), lambda i, li, n, od, pg, ln: (0, 0, 0)
+            ),
+            pl.BlockSpec(
+                (b, hq, 128), lambda i, li, n, od, pg, ln: (0, 0, 0)
+            ),
+            pl.BlockSpec(
+                (b, hq, 128), lambda i, li, n, od, pg, ln: (0, 0, 0)
+            ),
         ],
         scratch_shapes=[
-            pltpu.VMEM((2, s, hkv, d), k_cache.dtype),
-            pltpu.VMEM((2, s, hkv, d), v_cache.dtype),
-            pltpu.SemaphoreType.DMA((2, 2)),
+            pltpu.VMEM((_DEPTH, s, hkv, d), k_cache.dtype),
+            pltpu.VMEM((_DEPTH, s, hkv, d), v_cache.dtype),
+            pltpu.SemaphoreType.DMA((2, _DEPTH)),
         ],
     )
     acc, m, l = pl.pallas_call(
@@ -221,6 +314,7 @@ def paged_decode_attention(
             page_size=s,
             scale_dim=scale_dim or d,
             num_kv_heads=hkv,
+            max_pages=mp,
         ),
         out_shape=[
             jax.ShapeDtypeStruct((b, hq, d), jnp.float32),
@@ -231,7 +325,9 @@ def paged_decode_attention(
         interpret=interpret,
     )(
         jnp.asarray(layer, jnp.int32).reshape(1),
-        page_tables.astype(jnp.int32),
+        n_work,
+        order,
+        page_of,
         history_lens.astype(jnp.int32),
         q,
         k_cache,

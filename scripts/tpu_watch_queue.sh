@@ -43,6 +43,14 @@ run_stage() { # name, command...
   fi
 }
 
+pallas_kernels() {
+  # FIRST: Mosaic compile + numerics proof for all four kernels
+  # (interpret=False). The round-4 decode kernel is a rewrite (flattened
+  # page walk); if Mosaic rejects it this stage says so immediately and
+  # explains any later pallas-path stage failures. Operational fallback:
+  # attention_impl=xla everywhere.
+  run_stage pallas_kernels python scripts/tpu_pallas_check.py
+}
 prewarm() {
   # populate the persistent compile cache with the disagg A/B's exact
   # shapes so the A/B's worker processes boot warm (round-3 failure mode:
@@ -115,7 +123,7 @@ transfer() {
 }
 
 STAGES=("$@")
-[ ${#STAGES[@]} -eq 0 ] && STAGES=(prewarm disagg_ab sweep_8b sla_8b ft_kill routing offload bench_dsv2 decode_profile bench_1b_sweep pallas_gate transfer)
+[ ${#STAGES[@]} -eq 0 ] && STAGES=(pallas_kernels prewarm disagg_ab sweep_8b sla_8b ft_kill routing offload bench_dsv2 decode_profile bench_1b_sweep pallas_gate transfer)
 
 wait_for_tunnel
 for s in "${STAGES[@]}"; do
