@@ -34,6 +34,7 @@ import mmap
 import os
 import re
 import socket
+import threading
 import uuid as _uuid
 from dataclasses import dataclass
 from typing import Awaitable, Callable, Optional, Sequence
@@ -62,6 +63,8 @@ _STREAM_LIMIT = 16 << 20
 # Remote targets keep the TCP path untouched.
 
 _SHM_DIR = "/dev/shm"
+#: receiver-side cap on cached segment maps per connection (LRU)
+_MAX_SHM_MAPS = 8
 _SHM_NAME_RE = re.compile(r"^dynkv-[0-9]+-[0-9a-f]{12}$")
 _LOCAL_HOSTS = ("127.0.0.1", "::1", "localhost")
 
@@ -74,8 +77,110 @@ def _shm_enabled() -> bool:
     )
 
 
-def _is_local_host(host: str) -> bool:
-    return host in _LOCAL_HOSTS or host == socket.gethostname()
+#: host -> bool verdict (permanent) or int negative-TTL countdown
+_local_addr_cache: dict[str, "bool | int"] = {}
+
+
+def _resolve_is_local(host: str) -> bool:
+    """Blocking half of the locality check — callers run it off-loop.
+
+    An address is "local" iff the kernel routes it over a local
+    interface: connect() a UDP socket (no packet is sent) toward each
+    resolved address and check whether the source address the kernel
+    picks IS the target — true exactly for addresses assigned to this
+    machine. This avoids getaddrinfo(gethostname()), which on stock
+    Debian maps to 127.0.1.1 and never lists the NIC IPs a
+    Worker.advertise_host deployment actually advertises."""
+    for family, _, _, _, sockaddr in socket.getaddrinfo(
+        host, 0, type=socket.SOCK_DGRAM
+    ):
+        addr = sockaddr[0]
+        if addr in ("127.0.0.1", "::1"):
+            return True
+        try:
+            with socket.socket(family, socket.SOCK_DGRAM) as s:
+                s.connect((addr, 9))
+                if s.getsockname()[0] == addr:
+                    return True
+        except OSError:
+            continue
+    return False
+
+
+#: resolver FAILURES suppress the check for this many transfers, then
+#: one retry — a resolver that is briefly down at startup must not pin
+#: the slow path, but a broken one must not cost every transfer.
+_NEG_TTL_FAIL = 64
+#: clean non-local VERDICTS last much longer: the host resolved fine to
+#: an address this machine demonstrably does not own, so re-checking is
+#: only insurance against startup races (address not yet assigned).
+_NEG_TTL_VERDICT = 4096
+
+_resolve_locks_guard = threading.Lock()
+_resolve_locks: dict[str, threading.Lock] = {}
+
+
+def _resolve_verdict(host: str, force: bool = False) -> bool:
+    """Blocking cached resolve — runs in a worker thread. Serialized
+    PER HOST so a burst of first-time transfers to one host performs ONE
+    resolution (late arrivals block on that host's lock, then read the
+    cache) while distinct hosts resolve concurrently. `force` is set by
+    the one caller whose TTL countdown expired — it re-resolves even
+    though the (re-armed) negative entry is present."""
+    with _resolve_locks_guard:
+        lock = _resolve_locks.setdefault(host, threading.Lock())
+    with lock:
+        cached = _local_addr_cache.get(host)
+        if cached is True:
+            return True
+        if (
+            not force
+            and isinstance(cached, int)
+            and not isinstance(cached, bool)
+        ):
+            return False  # a concurrent caller just resolved: negative
+        try:
+            verdict = _resolve_is_local(host)
+            ttl = _NEG_TTL_VERDICT
+        except (OSError, UnicodeError):
+            # UnicodeError: getaddrinfo IDNA-encodes hostnames and raises
+            # it (not OSError) for malformed labels — a bad
+            # advertise_host must cost the TCP fallback, not the transfer
+            verdict = False
+            ttl = _NEG_TTL_FAIL
+        _local_addr_cache[host] = True if verdict else ttl
+        return verdict
+
+
+async def _is_local_host(host: str) -> bool:
+    """Single-host deployments often advertise a routable IP
+    (Worker.advertise_host), not loopback — resolve the target and check
+    whether it is one of this machine's own addresses so they still take
+    the shm fast path. Resolution runs in a worker thread (a slow
+    resolver must not stall every transfer sharing the loop). True
+    verdicts are cached for the process lifetime; failures AND False
+    verdicts get only a bounded negative TTL, so a startup transient
+    (resolver down, address not yet assigned) cannot pin a local
+    deployment to the TCP slow path forever. A wrong verdict only costs
+    the TCP fallback, never correctness (the receiver nacks `shm_failed`
+    if it cannot map the segment)."""
+    if host in _LOCAL_HOSTS or host == socket.gethostname():
+        return True
+    cached = _local_addr_cache.get(host)
+    if cached is True:
+        return True
+    force = False
+    if isinstance(cached, int) and not isinstance(cached, bool):
+        if cached > 1:
+            _local_addr_cache[host] = cached - 1
+            return False
+        # Budget spent: THIS caller re-resolves. Re-arm the countdown
+        # first so concurrent transfers keep taking the cached TCP
+        # fallback instead of piling onto the per-host lock for the
+        # full resolver timeout — only one transfer pays the probe.
+        _local_addr_cache[host] = _NEG_TTL_FAIL
+        force = True
+    return await asyncio.to_thread(_resolve_verdict, host, force)
 
 
 class _ShmSegment:
@@ -138,16 +243,54 @@ class _ShmPool:
             except PermissionError:
                 pass  # someone else's live pid
 
+    #: free segments kept warm; beyond this (or the byte budget) the
+    #: excess is unlinked — these are RAM-backed (tmpfs) and pre-touched,
+    #: so an unbounded pool is a resident-memory leak
+    _MAX_FREE = 4
+    _MAX_FREE_BYTES = int(
+        os.environ.get("DYN_KV_SHM_POOL_BYTES", 512 << 20)
+    )
+
     def acquire(self, nbytes: int) -> _ShmSegment:
+        # Round up so a workload with drifting transfer sizes reuses one
+        # segment instead of minting one per distinct size: powers of two
+        # up to 64 MiB, then 64 MiB granularity — segments are pre-touched
+        # (fully RAM-resident in tmpfs), so pow2 rounding above that would
+        # waste up to 2x the request.
+        gran = 64 << 20
+        if nbytes <= gran:
+            want = 1 << max(20, (nbytes - 1).bit_length())
+        else:
+            want = -(-nbytes // gran) * gran
+        # Best-fit with a size-ratio cap: a lone post-burst huge segment
+        # must not get pinned forever serving tiny transfers (it would
+        # always first-fit and never be evicted) — beyond 4x the rounded
+        # need, mint a right-sized segment and let eviction age the big
+        # one out.
+        best = None
         for i, seg in enumerate(self._free):
-            if seg.size >= nbytes:
-                return self._free.pop(i)
-        seg = _ShmSegment(max(nbytes, 1 << 20))
+            if nbytes <= seg.size <= 4 * want and (
+                best is None or seg.size < self._free[best].size
+            ):
+                best = i
+        if best is not None:
+            return self._free.pop(best)
+        seg = _ShmSegment(want)
         self._all.append(seg)
         return seg
 
     def release(self, seg: _ShmSegment) -> None:
         self._free.append(seg)
+        # FIFO eviction on both a count and a byte budget: oldest-released
+        # first, so segments sized for a workload phase that has passed
+        # (e.g. one burst of huge transfers) age out instead of pinning
+        # tmpfs RAM for the process lifetime, while the sizes currently
+        # in rotation keep getting re-acquired off the back of the list.
+        while len(self._free) > self._MAX_FREE or (
+            len(self._free) > 1
+            and sum(s.size for s in self._free) > self._MAX_FREE_BYTES
+        ):
+            self.discard(self._free.pop(0))
 
     def discard(self, seg: _ShmSegment) -> None:
         """Permanently retire a segment (unacked transfer: a receiver may
@@ -253,7 +396,10 @@ class KvTransferServer:
         # sender-segment mappings, cached per shm name (segments are
         # reused across transfers) and dropped with THIS connection — a
         # server outliving many prefill clients must not pin their
-        # unlinked segments' tmpfs pages forever
+        # unlinked segments' tmpfs pages forever. LRU-bounded: the
+        # sender's pool evicts and re-mints segments as sizes drift, and
+        # every stale map here would pin an unlinked segment's RAM for
+        # the connection's (pooled, long) lifetime.
         shm_maps: dict[str, mmap.mmap] = {}
         try:
             while True:
@@ -397,6 +543,19 @@ class KvTransferServer:
             logger.info(
                 "mapped KV shm segment %s (%d bytes)", name, len(mm)
             )
+            while len(shm_maps) > _MAX_SHM_MAPS:
+                # LRU evict (dict order = recency, see below): a name the
+                # sender's pool retired would otherwise pin its unlinked
+                # segment's tmpfs RAM for this connection's lifetime. If
+                # the segment is still live, the next write re-maps it.
+                stale = next(iter(shm_maps))
+                try:
+                    shm_maps.pop(stale).close()
+                except BufferError:
+                    pass
+        else:
+            # refresh recency so steady reuse never evicts the hot map
+            shm_maps[name] = shm_maps.pop(name)
         shape = tuple(header["shape"])
         v_shape = tuple(header.get("v_shape") or shape)
         dtype = dtype_from_name(header["dtype"])
@@ -535,8 +694,23 @@ class KvTransferClient:
         self._locks: dict[tuple[str, int], asyncio.Lock] = {}
         self._shm_pool = _ShmPool() if _shm_enabled() else None
         #: targets where the shm handshake failed (remote host / no shm
-        #: support): don't re-attempt every transfer
-        self._shm_bad: set[tuple[str, int]] = set()
+        #: support): don't re-attempt every transfer — but a single
+        #: transient failure must not disable shm for the client's
+        #: lifetime, so each entry only suppresses the next
+        #: _SHM_RETRY_AFTER transfers to that target, then one retry.
+        self._shm_bad: dict[tuple[str, int], int] = {}
+
+    _SHM_RETRY_AFTER = 64
+
+    def _shm_suppressed(self, key: tuple[str, int]) -> bool:
+        left = self._shm_bad.get(key)
+        if left is None:
+            return False
+        if left <= 1:
+            del self._shm_bad[key]  # budget spent: retry shm once
+            return False
+        self._shm_bad[key] = left - 1
+        return True
 
     def _lock(self, key: tuple[str, int]) -> asyncio.Lock:
         # created synchronously, so concurrent writers share one lock
@@ -648,8 +822,8 @@ class KvTransferClient:
         key = (host, port)
         if (
             self._shm_pool is not None
-            and key not in self._shm_bad
-            and _is_local_host(host)
+            and not self._shm_suppressed(key)
+            and await _is_local_host(host)
         ):
             seg = self._shm_pool.acquire(k.nbytes + v.nbytes)
             np.copyto(
@@ -691,7 +865,7 @@ class KvTransferClient:
                 "shm KV write to %s:%d refused; using TCP payload path",
                 host, port,
             )
-            self._shm_bad.add(key)
+            self._shm_bad[key] = self._SHM_RETRY_AFTER
         # bf16 has no buffer protocol (numpy dtype 'E'); ship uint8 views
         return await self._control(
             host, port, header,

@@ -1364,6 +1364,16 @@ class JaxEngine:
         self.kv = fn(
             self.kv, jnp.asarray(np.asarray(page_ids, np.int32)), k, v
         )
+        # The transfer server acks the sender the moment its write_fn
+        # returns, and the sender then reuses its staging buffer (the shm
+        # plane reuses the very mmap our jnp.asarray views may alias on
+        # the CPU backend, or an async H2D copy may still be reading on
+        # TPU). Commit the scatter before returning so the ack really
+        # means "bytes landed" — once per transfer, not per token. On the
+        # worker path this blocks the ENGINE thread (runner.submit), not
+        # the event loop, and the next decode step would queue behind the
+        # same device stream anyway.
+        jax.block_until_ready((self.kv.k, self.kv.v))
 
     # -- G4 remote tier: serve/adopt blocks across workers -----------------
     # (reference: KvBlockManager::export_local_blockset / onboard_blocks —

@@ -23,6 +23,7 @@ router, so KV routing hashes align with engine pages).
 
 from __future__ import annotations
 
+import logging
 import math
 from dataclasses import dataclass, replace
 from functools import partial
@@ -893,6 +894,8 @@ def _chunk_only_attention(q, k, v, positions, valid, cfg, dpad, mesh=None,
 #: than letting Mosaic fail allocation (v5e VMEM is 16 MiB; leave head-
 #: room for Mosaic's own buffers)
 _PALLAS_DECODE_VMEM_BUDGET = 12 << 20
+#: shapes whose explicit-pallas VMEM reroute was already warned about
+_warned_vmem_reroute: set = set()
 
 
 def maybe_decode_work(cfg, tokens, positions, kv, page_tables):
@@ -1008,6 +1011,25 @@ def attention_block(
         # (the gather reads ~the same HBM bytes in a handful of fused XLA
         # ops), (b) the flattened kernel's whole-batch VMEM blocks would
         # overflow — route instead of letting Mosaic fail allocation.
+        if (
+            cfg.attention_impl == "pallas"
+            and kernel_vmem > _PALLAS_DECODE_VMEM_BUDGET
+            and (key := (b, cfg.num_heads // tp, k_cache.shape[2]))
+            not in _warned_vmem_reroute
+        ):
+            # An explicit pallas request silently running the XLA gather
+            # is the measured-the-wrong-kernel hazard: say so at trace
+            # time (same severity as the registry coercions). Once per
+            # shape, not once per layer per retrace.
+            _warned_vmem_reroute.add(key)
+            logging.getLogger(__name__).warning(
+                "attention_impl='pallas' rerouted to the XLA gather: "
+                "decode kernel needs ~%.1f MiB VMEM (budget %.0f MiB) at "
+                "b=%d heads=%d S=%d — shrink batch, page size, or "
+                "heads-per-chip (tp) to keep the Pallas path",
+                kernel_vmem / 2**20, _PALLAS_DECODE_VMEM_BUDGET / 2**20,
+                b, cfg.num_heads // tp, k_cache.shape[2],
+            )
         attn = _xla_history_attention(
             q, k, v, k_cache, v_cache, layer, page_tables, positions,
             valid, cfg, dpad,

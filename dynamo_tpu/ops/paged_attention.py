@@ -202,13 +202,16 @@ def decode_vmem_bytes(
     b: int, hq: int, d: int, s: int, hkv: int, itemsize: int
 ) -> int:
     """Kernel VMEM footprint estimate: whole-batch q + f32 acc/m/l blocks
-    plus the DMA scratch. The caller routes to the XLA gather when this
-    exceeds the budget instead of letting Mosaic fail allocation."""
+    plus the DMA scratch and the per-slot f32 k/v cast temporaries
+    (`kp`/`vp` in the kernel body — one slot's pages live in f32 while
+    its scores/weights compute). The caller routes to the XLA gather when
+    this exceeds the budget instead of letting Mosaic fail allocation."""
     return (
         b * hq * d * itemsize  # q
         + b * hq * d * 4  # acc f32
         + 2 * b * hq * 128 * 4  # m, l f32 (lane-broadcast)
         + 2 * _DEPTH * s * hkv * d * itemsize  # k/v scratch
+        + 2 * s * hkv * d * 4  # kp/vp f32 cast of the active slot
     )
 
 

@@ -618,3 +618,74 @@ def test_shm_orphan_sweeper(tmp_path):
                 os.unlink(p)
             except OSError:
                 pass
+
+
+def test_shm_pool_rounding_and_eviction():
+    """Acquire rounds to pow2 (≤64 MiB) so drifting sizes reuse segments;
+    release evicts FIFO past both the count and byte budgets so one burst
+    of big segments can't pin tmpfs RAM forever."""
+    from dynamo_tpu.disagg.transfer import _ShmPool, _shm_enabled
+
+    if not _shm_enabled():
+        pytest.skip("/dev/shm unavailable")
+    pool = _ShmPool()
+    try:
+        seg = pool.acquire(3 << 20)
+        assert seg.size == 4 << 20  # pow2 rounding
+        pool.release(seg)
+        # a slightly different size reuses the same rounded segment
+        assert pool.acquire(int(3.5 * (1 << 20))) is seg
+        pool.release(seg)
+
+        # count budget: oldest released goes first
+        segs = [pool.acquire((i + 5) << 20) for i in range(5)]
+        assert len({id(s) for s in segs}) == 5  # all distinct (in use)
+        for s in segs:
+            pool.release(s)
+        assert len(pool._free) <= pool._MAX_FREE
+        assert seg not in pool._free  # oldest (the 4 MiB one) evicted
+
+        # byte budget
+        old_budget = _ShmPool._MAX_FREE_BYTES
+        _ShmPool._MAX_FREE_BYTES = 8 << 20
+        try:
+            big = pool.acquire(7 << 20)
+            pool.release(big)
+            assert sum(s.size for s in pool._free) <= (8 << 20) or (
+                len(pool._free) == 1
+            )
+        finally:
+            _ShmPool._MAX_FREE_BYTES = old_budget
+    finally:
+        pool.close()
+
+
+def test_is_local_host_verdicts():
+    """Loopback and own-NIC addresses are local; RFC-5737 TEST-NET is
+    not; resolver failures are cached only with a bounded negative TTL."""
+    import socket as _socket
+
+    from dynamo_tpu.disagg import transfer as tr
+
+    async def main():
+        assert await tr._is_local_host("127.0.0.1")
+        assert await tr._is_local_host("localhost")
+        # the address the kernel would use to reach the outside world is
+        # one of ours — must be detected local even though it's not in
+        # _LOCAL_HOSTS and getaddrinfo(hostname) may never list it
+        try:
+            with _socket.socket(_socket.AF_INET, _socket.SOCK_DGRAM) as s:
+                s.connect(("192.0.2.1", 9))
+                my_ip = s.getsockname()[0]
+        except OSError:
+            my_ip = None
+        if my_ip and my_ip != "0.0.0.0":
+            assert await tr._is_local_host(my_ip)
+        assert not await tr._is_local_host("192.0.2.1")  # TEST-NET
+        # negative TTL: an unresolvable name is suppressed, then retried
+        tr._local_addr_cache.pop("no-such-host.invalid", None)
+        assert not await tr._is_local_host("no-such-host.invalid")
+        entry = tr._local_addr_cache.get("no-such-host.invalid")
+        assert isinstance(entry, int) and not isinstance(entry, bool)
+
+    run(main())
