@@ -171,7 +171,7 @@ def test_device_path_numerical_equivalence(tiny_cfg, monkeypatch):
             result = await asyncio.wait_for(waiter, 10)
             assert result.first_token == first[0]
             assert landed.is_set()
-            assert server.transfers == {"device": 1, "host": 0, "shm": 0}
+            assert server.transfers == {"device": 1, "host": 0, "shm": 0, "bulk": 0}
         finally:
             client.close()
             await server.stop()
@@ -223,7 +223,7 @@ def test_device_pull_failure_falls_back_to_host(tiny_cfg, monkeypatch):
             result = await asyncio.wait_for(waiter, 10)
             assert result.first_token == 42
             assert written["pages"] == [3, 4]
-            assert server.transfers == {"device": 0, "host": 0, "shm": 1}
+            assert server.transfers == {"device": 0, "host": 0, "shm": 1, "bulk": 0}
         finally:
             client.close()
             await server.stop()
@@ -251,7 +251,7 @@ def test_host_mode_env_skips_device_plane(monkeypatch):
         try:
             ok = await client.send(*server.address, "r1", [1], k, v, 7)
             assert ok
-            assert server.transfers == {"device": 0, "host": 0, "shm": 1}
+            assert server.transfers == {"device": 0, "host": 0, "shm": 1, "bulk": 0}
         finally:
             client.close()
             await server.stop()
@@ -466,7 +466,7 @@ def test_no_waiter_nack_skips_host_fallback(tiny_cfg, monkeypatch):
             # no server.expect(): the request is already dead decode-side
             ok = await client.send(*server.address, "gone", [3, 4], k, v, 42)
             assert not ok
-            assert server.transfers == {"device": 0, "host": 0, "shm": 0}
+            assert server.transfers == {"device": 0, "host": 0, "shm": 0, "bulk": 0}
         finally:
             client.close()
             await server.stop()
@@ -687,5 +687,140 @@ def test_is_local_host_verdicts():
         assert not await tr._is_local_host("no-such-host.invalid")
         entry = tr._local_addr_cache.get("no-such-host.invalid")
         assert isinstance(entry, int) and not isinstance(entry, bool)
+
+    run(main())
+
+
+def test_bulk_transfer_path(monkeypatch):
+    """Payloads past _BULK_MIN ride the side blocking-socket bulk plane
+    (threads both ends) with numerical equality; small payloads stay on
+    the inline asyncio path; a server without a bulk listener falls back
+    to inline transparently."""
+    import dynamo_tpu.disagg.transfer as tr
+    from dynamo_tpu.disagg.transfer import KvTransferClient, KvTransferServer
+
+    monkeypatch.setattr(tr, "_BULK_MIN", 1 << 16)  # small test payloads
+
+    shape = (2, 2, 4, 8, 64)  # 2*2*4*8*64*4B = 32 KiB per array
+    k = np.arange(np.prod(shape), dtype=np.float32).reshape(shape)
+    v = -k
+
+    async def main():
+        got = []
+
+        async def write_fn(page_ids, kk, vv):
+            got.append((np.array(kk), np.array(vv)))
+
+        server = KvTransferServer(write_fn)
+        await server.start()
+        client = KvTransferClient()
+        # force past shm so the bulk plane is exercised on loopback
+        client._shm_bad[server.address] = 1 << 30
+        try:
+            server.expect("b1")
+            assert await client.write(
+                *server.address, "b1", [1, 2, 3, 4], k, v, 0
+            )
+            assert server.transfers["bulk"] == 1, server.transfers
+            np.testing.assert_array_equal(got[0][0], k)
+            np.testing.assert_array_equal(got[0][1], v)
+
+            # second transfer reuses the bulk connection
+            server.expect("b2")
+            assert await client.write(
+                *server.address, "b2", [1, 2, 3, 4], k + 1, v - 1, 0
+            )
+            assert server.transfers["bulk"] == 2
+            np.testing.assert_array_equal(got[1][0], k + 1)
+
+            # a tiny payload stays inline (below _BULK_MIN)
+            small = k[:, :, :1, :1, :2]
+            server.expect("b3")
+            assert await client.write(
+                *server.address, "b3",
+                [1], np.ascontiguousarray(small),
+                np.ascontiguousarray(-small), 0,
+            )
+            assert server.transfers["host"] == 1
+        finally:
+            client.close()
+            await server.stop()
+
+    run(main())
+
+
+def test_bulk_summed_mode(monkeypatch):
+    """DYN_KV_BULK_SUM=on adds the chunked xxh3 trailer end to end."""
+    import dynamo_tpu.disagg.transfer as tr
+    from dynamo_tpu.disagg.transfer import KvTransferClient, KvTransferServer
+
+    monkeypatch.setattr(tr, "_BULK_MIN", 1 << 16)
+    monkeypatch.setenv("DYN_KV_BULK_SUM", "on")
+
+    shape = (2, 2, 4, 8, 64)
+    k = np.arange(np.prod(shape), dtype=np.float32).reshape(shape)
+    v = 2 * k
+
+    async def main():
+        got = []
+
+        async def write_fn(page_ids, kk, vv):
+            got.append((np.array(kk), np.array(vv)))
+
+        server = KvTransferServer(write_fn)
+        await server.start()
+        client = KvTransferClient()
+        client._shm_bad[server.address] = 1 << 30
+        try:
+            server.expect("s1")
+            assert await client.write(
+                *server.address, "s1", [1, 2, 3, 4], k, v, 0
+            )
+            assert server.transfers["bulk"] == 1
+            np.testing.assert_array_equal(got[0][0], k)
+        finally:
+            client.close()
+            await server.stop()
+
+    run(main())
+
+
+def test_bulk_fallback_without_listener(monkeypatch):
+    """A receiver with the bulk plane disabled still lands big writes via
+    the inline path (bulk_port handshake returns 0)."""
+    import dynamo_tpu.disagg.transfer as tr
+    from dynamo_tpu.disagg.transfer import KvTransferClient, KvTransferServer
+
+    monkeypatch.setattr(tr, "_BULK_MIN", 1 << 16)
+
+    shape = (2, 2, 4, 8, 64)
+    k = np.ones(shape, np.float32)
+    v = -k
+
+    async def main():
+        got = []
+
+        async def write_fn(page_ids, kk, vv):
+            got.append(np.array(kk))
+
+        server = KvTransferServer(write_fn)
+        monkeypatch.setenv("DYN_KV_BULK", "off")
+        try:
+            await server.start()  # no bulk listener
+        finally:
+            monkeypatch.delenv("DYN_KV_BULK")
+        client = KvTransferClient()
+        client._shm_bad[server.address] = 1 << 30
+        try:
+            server.expect("f1")
+            assert await client.write(
+                *server.address, "f1", [1, 2, 3, 4], k, v, 0
+            )
+            assert server.transfers["host"] == 1
+            assert server.transfers["bulk"] == 0
+            np.testing.assert_array_equal(got[0], k)
+        finally:
+            client.close()
+            await server.stop()
 
     run(main())

@@ -641,7 +641,7 @@ def test_mla_disagg_device_path_in_process(monkeypatch):
             )
             assert ok
             await asyncio.wait_for(waiter, 10)
-            assert server.transfers == {"device": 1, "host": 0, "shm": 0}
+            assert server.transfers == {"device": 1, "host": 0, "shm": 0, "bulk": 0}
         finally:
             client.close()
             await server.stop()
@@ -709,7 +709,7 @@ def test_mla_disagg_host_path(monkeypatch):
             )
             assert ok
             await asyncio.wait_for(waiter, 10)
-            assert server.transfers == {"device": 0, "host": 0, "shm": 1}
+            assert server.transfers == {"device": 0, "host": 0, "shm": 1, "bulk": 0}
         finally:
             client.close()
             await server.stop()
