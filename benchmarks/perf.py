@@ -286,6 +286,18 @@ def main(argv=None) -> None:
         help="engine mode: weight-only quantization",
     )
     p.add_argument(
+        "--prefill-budget", type=int, default=None, dest="prefill_budget",
+        help="engine mode: prefill tokens per step across sequences "
+        "(EngineConfig.prefill_token_budget; default 4x prefill_chunk). "
+        "The saturation-TTFT knob: a bigger budget batches more prompts "
+        "into one prefill dispatch, draining an arrival burst in fewer, "
+        "larger steps at the cost of longer decode stalls while it runs.",
+    )
+    p.add_argument(
+        "--prefill-chunk", type=int, default=None, dest="prefill_chunk",
+        help="engine mode: per-sequence prefill chunk length",
+    )
+    p.add_argument(
         "--distribution", default="geometric",
         choices=["geometric", "sharegpt"],
         help="ISL/OSL law; sharegpt = lognormal heavy-tail mixture",
@@ -329,6 +341,12 @@ def main(argv=None) -> None:
                 enable_prefix_caching=False,
                 spec_ngram=args.spec_ngram,
                 quantize=args.quantize,
+                prefill_token_budget=args.prefill_budget,
+                **(
+                    {"prefill_chunk": args.prefill_chunk}
+                    if args.prefill_chunk is not None
+                    else {}
+                ),
             )
         )
         # warmup compiles every program shape the sweep will touch

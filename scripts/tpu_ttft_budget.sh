@@ -1,0 +1,45 @@
+#!/usr/bin/env bash
+# Saturation-TTFT knob on the chip: sweep prefill_token_budget at c=64
+# on llama3-1b (the round-3 cliff config: p50 2,232 ms at budget 2048).
+# Run AFTER scripts/tpu_watch_queue.sh drains (probe first, like it does).
+# Artifact: artifacts/tpu/ttft_budget.json
+set -u
+cd "$(dirname "$0")/.."
+OUT=artifacts/tpu
+mkdir -p "$OUT"
+
+if ! timeout 120 python -c \
+  "import jax,sys; sys.exit(0 if jax.devices()[0].platform!='cpu' else 1)" \
+  >/dev/null 2>&1; then
+  echo "tunnel down; not running" >&2
+  exit 1
+fi
+
+python - << 'PY' > "$OUT/ttft_budget.json" 2> "$OUT/ttft_budget.err"
+import json, subprocess, sys
+
+rows = {}
+for budget in (2048, 4096, 8192):
+    # one wedged/timed-out run must not discard the budgets already
+    # measured — chip time is the scarce resource here
+    try:
+        out = subprocess.run(
+            [sys.executable, "-m", "benchmarks.perf", "--mode", "engine",
+             "--model", "llama3-1b", "--dtype", "bfloat16",
+             "--num-pages", "1024", "--page-size", "64",
+             "--num-requests", "64", "--isl", "512", "--osl", "64",
+             "--prefill-budget", str(budget), "--concurrency", "16,64"],
+            capture_output=True, text=True, timeout=3000,
+        ).stdout
+        rows[budget] = json.loads(out[out.index("{"):])["sweep"]
+    except Exception as e:
+        rows[budget] = {"error": repr(e)}
+print(json.dumps({
+    "what": "prefill_token_budget sweep at saturation (docs/PERF.md round-5 "
+            "TTFT-cliff section); round-3 baseline: c=64 p50 2232 ms",
+    "sweep_by_budget": rows,
+}, indent=1))
+PY
+rc=$?
+tail -c 300 "$OUT/ttft_budget.json"
+exit $rc
