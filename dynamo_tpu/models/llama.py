@@ -88,6 +88,12 @@ class LlamaConfig:
     query_pre_attn_scalar: Optional[float] = None
     #: Gemma2 block: extra post-attention / post-feedforward RMSNorms
     post_block_norms: bool = False
+    #: Qwen2-VL m-RoPE: head_dim/2 frequency slots partitioned into
+    #: (temporal, height, width) sections — e.g. (16, 24, 24) for D=128.
+    #: Rope positions may then be [3, B, T] (one stream per axis); plain
+    #: [B, T] positions still work and equal the (p, p, p) case exactly,
+    #: which is why text-only serving needs no special path.
+    mrope_section: Optional[tuple[int, ...]] = None
 
     @property
     def q_per_kv(self) -> int:
@@ -727,9 +733,24 @@ def _rope_inv_freq(cfg: LlamaConfig) -> jax.Array:
 
 
 def apply_rope(x: jax.Array, positions: jax.Array, cfg: LlamaConfig) -> jax.Array:
-    """x: [B, T, H, D]; positions: [B, T] absolute positions."""
+    """x: [B, T, H, D]; positions: [B, T] absolute positions — or
+    [3, B, T] m-RoPE streams (temporal, height, width) when
+    cfg.mrope_section is set (Qwen2-VL; reference reaches this family
+    only through vLLM — /root/reference examples/multimodal)."""
     inv_freq = _rope_inv_freq(cfg)
-    angles = positions[..., None].astype(jnp.float32) * inv_freq  # [B,T,D/2]
+    if positions.ndim == 3:
+        if not cfg.mrope_section:
+            raise ValueError("[3,B,T] rope positions need cfg.mrope_section")
+        # Each frequency section takes its angles from one position
+        # stream; equal streams reduce to standard rope exactly.
+        angles3 = positions[..., None].astype(jnp.float32) * inv_freq
+        parts, off = [], 0
+        for j, sec in enumerate(cfg.mrope_section):
+            parts.append(angles3[j, ..., off : off + sec])
+            off += sec
+        angles = jnp.concatenate(parts, axis=-1)  # [B,T,D/2]
+    else:
+        angles = positions[..., None].astype(jnp.float32) * inv_freq  # [B,T,D/2]
     cos = jnp.cos(angles)[:, :, None, :]  # [B,T,1,D/2]
     sin = jnp.sin(angles)[:, :, None, :]
     x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
@@ -926,6 +947,7 @@ def attention_block(
     first_chunk: bool = False,
     mesh=None,
     decode_work=None,  # precomputed ops.paged_attention.decode_work_list
+    rope_positions=None,  # [3,B,T] m-RoPE streams; None = positions
 ):
     """rope → paged attention, in one of two write disciplines:
 
@@ -942,8 +964,9 @@ def attention_block(
     Handles the cache's lane padding (cfg.kv_head_dim) transparently.
     """
     b, t = q.shape[0], q.shape[1]
-    q = apply_rope(q, positions, cfg)
-    k = apply_rope(k, positions, cfg)
+    rp = positions if rope_positions is None else rope_positions
+    q = apply_rope(q, rp, cfg)
+    k = apply_rope(k, rp, cfg)
     dpad = cfg.kv_head_dim - cfg.head_dim
     if dpad:
         k = jnp.pad(k, ((0, 0), (0, 0), (0, 0), (0, dpad)))
@@ -1139,6 +1162,7 @@ def forward_hidden(
     mm_mask: Optional[jax.Array] = None,  # [B, T] bool — use mm_embeds here
     first_chunk: bool = False,  # static: every row starts at position 0
     mesh=None,  # tp mesh: the Pallas kernels shard_map over it
+    rope_positions: Optional[jax.Array] = None,  # [3,B,T] m-RoPE streams
 ) -> tuple[jax.Array, KVPages]:
     """One model step over a token chunk; returns (hidden [B,T,H] post final
     norm, new kv). The engine applies `compute_logits` only at the positions
@@ -1185,6 +1209,7 @@ def forward_hidden(
         attn, k_full, v_full, staged = attention_block(
             q, k, v, k_full, v_full, li, page_tables, positions, valid, cfg,
             first_chunk=first_chunk, mesh=mesh, decode_work=decode_work,
+            rope_positions=rope_positions,
         )
         attn_out = _mm(attn, lp, "wo", cfg.dtype)
         if cfg.post_block_norms:  # Gemma2: norm the branch, then residual
@@ -1251,8 +1276,11 @@ def forward(
     valid: jax.Array,
     kv: KVPages,
     page_tables: jax.Array,
+    **kw,
 ) -> tuple[jax.Array, KVPages]:
     """forward_hidden + full-chunk logits (tests/tools; engine uses the
     split form to avoid the all-positions lm_head matmul)."""
-    h, kv = forward_hidden(params, cfg, tokens, positions, valid, kv, page_tables)
+    h, kv = forward_hidden(
+        params, cfg, tokens, positions, valid, kv, page_tables, **kw
+    )
     return compute_logits(params, cfg, h), kv

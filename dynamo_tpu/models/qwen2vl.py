@@ -1,0 +1,463 @@
+"""Qwen2-VL: ViT vision tower + m-RoPE language model (BASELINE config 5).
+
+The reference serves this family only through vLLM
+(/root/reference examples/multimodal/ — no in-tree implementation);
+here it is a first-class model family like the other Llama variants:
+
+- **Vision tower**: a full-attention ViT over flattened conv patches
+  (HF's Conv3d patch embed with stride == kernel is exactly one matmul),
+  2D rotary position embedding per (h, w) patch coordinate, and the 2x2
+  PatchMerger MLP projecting into the language model's hidden size.
+  Patches arrive in the Qwen2-VL image-processor order (merge-group
+  major), matching HF `pixel_values` bit for bit.
+- **Language model**: the Qwen2 architecture (llama.py with qkv bias)
+  plus m-RoPE — rope positions carry three streams (temporal, height,
+  width) with the frequency dim partitioned by `mrope_section`
+  (llama.apply_rope). Text-only prompts have all three streams equal,
+  which reduces to standard rope EXACTLY — so text serving runs the
+  stock engine path unchanged.
+- **get_rope_index**: the position-stream builder (images; HF
+  Qwen2VLModel.get_rope_index semantics) used by tests and the
+  multimodal preprocessor.
+
+Serving note: through the serving engine, image prompts splice vision
+embeds llava-style at sequential positions (the unified multimodal
+contract, models/vision.py). Native m-RoPE grid positions are exact at
+this model API (`forward(..., rope_positions=[3,B,T])`) and golden-
+tested against HF `Qwen2VLForConditionalGeneration`
+(tests/test_model_qwen2vl.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dynamo_tpu.models.llama import LlamaConfig
+
+__all__ = [
+    "Qwen2VLVisionConfig",
+    "text_config",
+    "get_rope_index",
+    "init_vision_params",
+    "vision_forward",
+    "vision_params_from_torch_state_dict",
+    "remap_language_state_dict",
+]
+
+
+@dataclass(frozen=True)
+class Qwen2VLVisionConfig:
+    depth: int = 32
+    embed_dim: int = 1280
+    num_heads: int = 16
+    in_channels: int = 3
+    patch_size: int = 14
+    temporal_patch_size: int = 2
+    spatial_merge_size: int = 2
+    mlp_ratio: float = 4.0
+    hidden_size: int = 1536  # language-model hidden size (merger output)
+    dtype: jnp.dtype = jnp.float32
+
+    @property
+    def head_dim(self) -> int:
+        return self.embed_dim // self.num_heads
+
+    @property
+    def patch_dim(self) -> int:
+        return (
+            self.in_channels
+            * self.temporal_patch_size
+            * self.patch_size
+            * self.patch_size
+        )
+
+    @property
+    def mlp_dim(self) -> int:
+        return int(self.embed_dim * self.mlp_ratio)
+
+    @staticmethod
+    def tiny(hidden_size: int = 64) -> "Qwen2VLVisionConfig":
+        return Qwen2VLVisionConfig(
+            depth=2, embed_dim=32, num_heads=4, patch_size=4,
+            temporal_patch_size=2, spatial_merge_size=2, mlp_ratio=2.0,
+            hidden_size=hidden_size,
+        )
+
+    @staticmethod
+    def qwen2_vl(hidden_size: int) -> "Qwen2VLVisionConfig":
+        """The production tower (same for 2B/7B/72B; only the merger's
+        output dim differs)."""
+        return Qwen2VLVisionConfig(hidden_size=hidden_size)
+
+
+def text_config(
+    *,
+    vocab_size: int,
+    hidden_size: int,
+    intermediate_size: int,
+    num_layers: int,
+    num_heads: int,
+    num_kv_heads: int,
+    head_dim: int,
+    rope_theta: float = 1_000_000.0,
+    mrope_section: tuple[int, ...] = (16, 24, 24),
+    dtype=jnp.bfloat16,
+    tie_word_embeddings: bool = False,
+) -> LlamaConfig:
+    """Qwen2-VL language model = Qwen2 (qkv bias) + mrope_section."""
+    if sum(mrope_section) != head_dim // 2:
+        raise ValueError(
+            f"mrope_section {mrope_section} must sum to head_dim/2 "
+            f"({head_dim // 2})"
+        )
+    return LlamaConfig(
+        vocab_size=vocab_size,
+        hidden_size=hidden_size,
+        intermediate_size=intermediate_size,
+        num_layers=num_layers,
+        num_heads=num_heads,
+        num_kv_heads=num_kv_heads,
+        head_dim=head_dim,
+        rope_theta=rope_theta,
+        attention_bias=True,
+        rms_norm_eps=1e-6,
+        mrope_section=mrope_section,
+        dtype=dtype,
+        tie_word_embeddings=tie_word_embeddings,
+    )
+
+
+def text_tiny() -> LlamaConfig:
+    """Unit-test scale, comparable against HF on CPU."""
+    return text_config(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_layers=2, num_heads=4, num_kv_heads=2, head_dim=16,
+        rope_theta=10000.0, mrope_section=(2, 3, 3), dtype=jnp.float32,
+    )
+
+
+def text_2b() -> LlamaConfig:
+    """Qwen2-VL-2B-Instruct language model."""
+    return text_config(
+        vocab_size=151936, hidden_size=1536, intermediate_size=8960,
+        num_layers=28, num_heads=12, num_kv_heads=2, head_dim=128,
+        tie_word_embeddings=True,
+    )
+
+
+def text_7b() -> LlamaConfig:
+    """Qwen2-VL-7B-Instruct language model."""
+    return text_config(
+        vocab_size=152064, hidden_size=3584, intermediate_size=18944,
+        num_layers=28, num_heads=28, num_kv_heads=4, head_dim=128,
+    )
+
+
+def config_from_hf(hf: dict) -> LlamaConfig:
+    """LlamaConfig for a Qwen2-VL HF checkpoint config.json (text fields
+    nest under `text_config` in new transformers; older dumps keep them
+    top-level)."""
+    t = hf.get("text_config") or hf
+    rope = (t.get("rope_scaling") or {}).get("mrope_section") or (16, 24, 24)
+    heads = t["num_attention_heads"]
+    return text_config(
+        vocab_size=t["vocab_size"],
+        hidden_size=t["hidden_size"],
+        intermediate_size=t["intermediate_size"],
+        num_layers=t["num_hidden_layers"],
+        num_heads=heads,
+        num_kv_heads=t.get("num_key_value_heads", heads),
+        head_dim=t.get("head_dim") or t["hidden_size"] // heads,
+        rope_theta=t.get("rope_theta", 1_000_000.0),
+        mrope_section=tuple(rope),
+        tie_word_embeddings=t.get("tie_word_embeddings", False),
+    )
+
+
+# --- m-RoPE position streams ------------------------------------------------
+
+
+def get_rope_index(
+    tokens: Sequence[int],
+    image_grid_thw: Sequence[tuple[int, int, int]],
+    *,
+    image_token_id: int,
+    spatial_merge_size: int = 2,
+) -> tuple[np.ndarray, int]:
+    """Build the [3, T] (temporal, height, width) rope position streams
+    for one sequence. Text runs advance all three streams together;
+    each image's tokens get (t_base, h, w) grid positions; the following
+    text resumes at max(previous positions) + 1. Returns (positions,
+    delta) where delta = next_position - len(tokens) — decode continues
+    at len(tokens) + step + delta on all three streams (HF
+    `mrope_position_deltas` semantics)."""
+    toks = np.asarray(tokens)
+    pos = np.zeros((3, len(toks)), np.int32)
+    img_i = 0
+    st = 0  # next unpositioned token index
+    base = 0  # next position value
+    while st < len(toks):
+        img_positions = np.nonzero(toks[st:] == image_token_id)[0]
+        if img_positions.size == 0 or img_i >= len(image_grid_thw):
+            n = len(toks) - st
+            pos[:, st:] = base + np.arange(n)
+            base += n
+            st = len(toks)
+            break
+        img_at = st + int(img_positions[0])
+        # text run before the image
+        n_text = img_at - st
+        if n_text:
+            pos[:, st:img_at] = base + np.arange(n_text)
+            base += n_text
+        t, h, w = image_grid_thw[img_i]
+        lh, lw = h // spatial_merge_size, w // spatial_merge_size
+        n_img = t * lh * lw
+        tt = np.repeat(np.arange(t), lh * lw)
+        hh = np.tile(np.repeat(np.arange(lh), lw), t)
+        ww = np.tile(np.arange(lw), t * lh)
+        pos[0, img_at : img_at + n_img] = base + tt
+        pos[1, img_at : img_at + n_img] = base + hh
+        pos[2, img_at : img_at + n_img] = base + ww
+        base += int(max(t, lh, lw))
+        st = img_at + n_img
+        img_i += 1
+    return pos, base - len(toks)
+
+
+# --- vision tower -----------------------------------------------------------
+
+
+def _rot_pos_emb(cfg: Qwen2VLVisionConfig, grid_thw) -> np.ndarray:
+    """Per-patch 2D rotary angles [N, head_dim/2]: the first half of the
+    slots rotates by the patch's h coordinate, the second by w —
+    coordinates emitted in the image processor's merge-group-major patch
+    order (HF Qwen2VisionTransformer.rot_pos_emb)."""
+    dim = cfg.head_dim // 2  # freqs per axis
+    inv_freq = 1.0 / (
+        10000.0 ** (np.arange(0, dim, 2, dtype=np.float64) / dim)
+    )
+    m = cfg.spatial_merge_size
+    out = []
+    for t, h, w in grid_thw:
+        hp = np.broadcast_to(np.arange(h)[:, None], (h, w))
+        hp = (
+            hp.reshape(h // m, m, w // m, m).transpose(0, 2, 1, 3).reshape(-1)
+        )
+        wp = np.broadcast_to(np.arange(w)[None, :], (h, w))
+        wp = (
+            wp.reshape(h // m, m, w // m, m).transpose(0, 2, 1, 3).reshape(-1)
+        )
+        ang_h = hp[:, None].astype(np.float64) * inv_freq
+        ang_w = wp[:, None].astype(np.float64) * inv_freq
+        per = np.concatenate([ang_h, ang_w], axis=-1)  # [h*w, head_dim/2]
+        out.append(np.tile(per, (t, 1)))
+    return np.concatenate(out, axis=0).astype(np.float32)
+
+
+def init_vision_params(key: jax.Array, cfg: Qwen2VLVisionConfig) -> dict:
+    ks = list(jax.random.split(key, 8))
+
+    def dense(k, shape, fan_in):
+        return (
+            jax.random.normal(k, shape, jnp.float32) / np.sqrt(fan_in)
+        ).astype(cfg.dtype)
+
+    e, md = cfg.embed_dim, cfg.mlp_dim
+    d = cfg.depth
+    merged = e * cfg.spatial_merge_size**2
+    blocks = {
+        "n1_w": jnp.ones((d, e), cfg.dtype),
+        "n1_b": jnp.zeros((d, e), cfg.dtype),
+        "qkv_w": dense(ks[1], (d, e, 3 * e), e),
+        "qkv_b": jnp.zeros((d, 3 * e), cfg.dtype),
+        "proj_w": dense(ks[2], (d, e, e), e),
+        "proj_b": jnp.zeros((d, e), cfg.dtype),
+        "n2_w": jnp.ones((d, e), cfg.dtype),
+        "n2_b": jnp.zeros((d, e), cfg.dtype),
+        "fc1_w": dense(ks[3], (d, e, md), e),
+        "fc1_b": jnp.zeros((d, md), cfg.dtype),
+        "fc2_w": dense(ks[4], (d, md, e), md),
+        "fc2_b": jnp.zeros((d, e), cfg.dtype),
+    }
+    return {
+        "patch_w": dense(ks[0], (cfg.patch_dim, e), cfg.patch_dim),
+        "blocks": blocks,
+        "ln_q_w": jnp.ones((e,), cfg.dtype),
+        "ln_q_b": jnp.zeros((e,), cfg.dtype),
+        "merge1_w": dense(ks[5], (merged, merged), merged),
+        "merge1_b": jnp.zeros((merged,), cfg.dtype),
+        "merge2_w": dense(ks[6], (merged, cfg.hidden_size), merged),
+        "merge2_b": jnp.zeros((cfg.hidden_size,), cfg.dtype),
+    }
+
+
+def _ln(x, w, b, eps=1e-6):
+    x32 = x.astype(jnp.float32)
+    mu = x32.mean(-1, keepdims=True)
+    var = x32.var(-1, keepdims=True)
+    return ((x32 - mu) / jnp.sqrt(var + eps) * w + b).astype(x.dtype)
+
+
+def _quick_gelu(x):
+    return x * jax.nn.sigmoid(1.702 * x)
+
+
+def vision_forward(
+    params: dict,
+    cfg: Qwen2VLVisionConfig,
+    patches: jax.Array,  # [N, patch_dim] HF pixel_values layout
+    grid_thw: Sequence[tuple[int, int, int]],  # static per-image grids
+) -> jax.Array:
+    """Encode flattened conv patches into [N / merge^2, hidden_size]
+    language-model embeddings. Attention is full within each image and
+    blocked across images (HF cu_seqlens semantics)."""
+    h = patches.astype(cfg.dtype) @ params["patch_w"]  # [N, E]
+    angles = jnp.asarray(_rot_pos_emb(cfg, grid_thw))  # [N, hd/2]
+    cos = jnp.cos(angles)[:, None, :]  # [N, 1, hd/2]
+    sin = jnp.sin(angles)[:, None, :]
+
+    # block-diagonal mask across images (static: grids are static)
+    seg = np.repeat(
+        np.arange(len(grid_thw)), [t * gh * gw for t, gh, gw in grid_thw]
+    )
+    mask = jnp.asarray(seg[:, None] == seg[None, :])
+    nh, hd = cfg.num_heads, cfg.head_dim
+    scale = 1.0 / np.sqrt(hd)
+
+    def block(h, lp):
+        x = _ln(h, lp["n1_w"], lp["n1_b"])
+        qkv = x @ lp["qkv_w"] + lp["qkv_b"]  # [N, 3E]
+        n = qkv.shape[0]
+        q, k, v = (
+            qkv.reshape(n, 3, nh, hd).transpose(1, 0, 2, 3).astype(jnp.float32)
+        )
+        # 2D rope (rotate-half over the full head dim, cos/sin tiled)
+        def rot(t):
+            t1, t2 = jnp.split(t, 2, axis=-1)
+            return jnp.concatenate(
+                [t1 * cos - t2 * sin, t2 * cos + t1 * sin], axis=-1
+            )
+
+        q, k = rot(q), rot(k)
+        scores = jnp.einsum("qhd,khd->hqk", q, k) * scale
+        scores = jnp.where(mask[None], scores, -1e30)
+        attn = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum("hqk,khd->qhd", attn, v.astype(jnp.float32))
+        out = out.reshape(n, nh * hd).astype(h.dtype)
+        h = h + (out @ lp["proj_w"] + lp["proj_b"])
+        x = _ln(h, lp["n2_w"], lp["n2_b"])
+        m = _quick_gelu((x @ lp["fc1_w"] + lp["fc1_b"]).astype(jnp.float32))
+        h = h + (m.astype(h.dtype) @ lp["fc2_w"] + lp["fc2_b"])
+        return h, None
+
+    h, _ = jax.lax.scan(block, h, params["blocks"])
+    # PatchMerger: LN then group merge^2 CONSECUTIVE patches (the image
+    # processor already emits merge-group-major order)
+    x = _ln(h, params["ln_q_w"], params["ln_q_b"])
+    x = x.reshape(-1, cfg.embed_dim * cfg.spatial_merge_size**2)
+    x = jax.nn.gelu(x @ params["merge1_w"] + params["merge1_b"], approximate=False)
+    return x @ params["merge2_w"] + params["merge2_b"]
+
+
+def pixels_to_patches(
+    images: np.ndarray, cfg: Qwen2VLVisionConfig
+) -> tuple[np.ndarray, list[tuple[int, int, int]]]:
+    """[B, H, W, 3] float pixels -> (patches [B*n, patch_dim], grids).
+
+    The HF Qwen2VLImageProcessor layout exactly: patch order is
+    merge-group-major ((gh/m, gw/m, m, m)) and each patch flattens in
+    (C, temporal, ps, ps) order with the still image repeated across the
+    temporal patch. H and W must be multiples of patch_size *
+    spatial_merge_size (the processor's resize step guarantees this for
+    real inputs; callers here pre-size)."""
+    b, h, w, c = images.shape
+    ps, m, tps = cfg.patch_size, cfg.spatial_merge_size, cfg.temporal_patch_size
+    if h % (ps * m) or w % (ps * m):
+        raise ValueError(
+            f"image {h}x{w} not a multiple of patch*merge {ps * m}"
+        )
+    gh, gw = h // ps, w // ps
+    x = images.transpose(0, 3, 1, 2)  # [B, C, H, W]
+    x = x.reshape(b, c, gh // m, m, ps, gw // m, m, ps)
+    x = x.transpose(0, 2, 5, 3, 6, 1, 4, 7)  # [B, gh/m, gw/m, m, m, C, ps, ps]
+    x = x.reshape(b, gh * gw, c, ps, ps)
+    x = np.repeat(x[:, :, :, None], tps, axis=3)  # temporal duplicate
+    patches = x.reshape(b * gh * gw, c * tps * ps * ps)
+    return patches.astype(np.float32), [(1, gh, gw)] * b
+
+
+# --- HF weight conversion ---------------------------------------------------
+
+
+def vision_params_from_torch_state_dict(
+    sd, cfg: Qwen2VLVisionConfig, prefix: Optional[str] = None
+) -> dict:
+    """Convert HF Qwen2VisionTransformerPretrainedModel weights.
+    State-dict keys are `model.visual.*` in current transformers;
+    original checkpoint dumps (and older versions) use bare `visual.*` —
+    both are accepted, like remap_language_state_dict's tolerance."""
+    if prefix is None:
+        prefix = (
+            "model.visual."
+            if any(k.startswith("model.visual.") for k in sd)
+            else "visual."
+        )
+
+    def t(name, transpose=False):
+        w = np.asarray(sd[prefix + name].to("cpu").float().numpy())
+        return jnp.asarray(w.T if transpose else w, cfg.dtype)
+
+    def stack(fmt, transpose=False):
+        return jnp.stack(
+            [t(fmt.format(i), transpose) for i in range(cfg.depth)]
+        )
+
+    patch = np.asarray(
+        sd[prefix + "patch_embed.proj.weight"].to("cpu").float().numpy()
+    )  # [E, C, tps, ps, ps] conv kernel == linear on the flattened patch
+    return {
+        "patch_w": jnp.asarray(patch.reshape(cfg.embed_dim, -1).T, cfg.dtype),
+        "blocks": {
+            "n1_w": stack("blocks.{}.norm1.weight"),
+            "n1_b": stack("blocks.{}.norm1.bias"),
+            "qkv_w": stack("blocks.{}.attn.qkv.weight", transpose=True),
+            "qkv_b": stack("blocks.{}.attn.qkv.bias"),
+            "proj_w": stack("blocks.{}.attn.proj.weight", transpose=True),
+            "proj_b": stack("blocks.{}.attn.proj.bias"),
+            "n2_w": stack("blocks.{}.norm2.weight"),
+            "n2_b": stack("blocks.{}.norm2.bias"),
+            "fc1_w": stack("blocks.{}.mlp.fc1.weight", transpose=True),
+            "fc1_b": stack("blocks.{}.mlp.fc1.bias"),
+            "fc2_w": stack("blocks.{}.mlp.fc2.weight", transpose=True),
+            "fc2_b": stack("blocks.{}.mlp.fc2.bias"),
+        },
+        "ln_q_w": t("merger.ln_q.weight"),
+        "ln_q_b": t("merger.ln_q.bias"),
+        "merge1_w": t("merger.mlp.0.weight", transpose=True),
+        "merge1_b": t("merger.mlp.0.bias"),
+        "merge2_w": t("merger.mlp.2.weight", transpose=True),
+        "merge2_b": t("merger.mlp.2.bias"),
+    }
+
+
+def remap_language_state_dict(sd) -> dict:
+    """Map Qwen2-VL language-model keys (`model.language_model.*`, plus
+    the legacy `model.model.*` layout) onto the plain `model.*` names
+    llama.params_from_torch_state_dict expects."""
+    out = {}
+    for k, v in sd.items():
+        if k.startswith("model.visual.") or k.startswith("visual."):
+            continue
+        for old in ("model.language_model.", "language_model.model.",
+                    "model.model."):
+            if k.startswith(old):
+                k = "model." + k[len(old):]
+                break
+        out[k] = v
+    return out

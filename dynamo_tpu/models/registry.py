@@ -18,6 +18,7 @@ import jax
 import jax.numpy as jnp
 
 from dynamo_tpu.models import llama as llama_mod
+from dynamo_tpu.models import qwen2vl as qwen2vl_mod
 from dynamo_tpu.models.llama import KVPages, LlamaConfig
 
 logger = logging.getLogger(__name__)
@@ -72,6 +73,17 @@ _LLAMA_PRESETS: dict[str, Callable[[], LlamaConfig]] = {
     # Phi-3 = Llama with fused qkv/gate_up in the checkpoint.
     "phi3-mini": LlamaConfig.phi3_mini,
 }
+
+
+# Qwen2-VL language models (Qwen2 + m-RoPE; the vision tower rides the
+# multimodal encode worker, models/qwen2vl.vision_forward).
+_LLAMA_PRESETS.update(
+    {
+        "qwen2-vl-tiny": qwen2vl_mod.text_tiny,
+        "qwen2-vl-2b": qwen2vl_mod.text_2b,
+        "qwen2-vl-7b": qwen2vl_mod.text_7b,
+    }
+)
 
 
 def _llama_adapter(
@@ -245,6 +257,7 @@ def get_model(
     moe_cfg = None
     mla_cfg = None
     gguf_path = None
+    qwen2vl_dir = False
     if key in _LLAMA_PRESETS:
         cfg = _LLAMA_PRESETS[key]()
     elif key.endswith(".gguf") and os.path.isfile(name):
@@ -277,6 +290,14 @@ def get_model(
             or hf.get("model_type") in ("deepseek_v2", "deepseek_v3")
         ):
             mla_cfg = MlaConfig.from_hf_config(hf)
+        elif (
+            arch == "Qwen2VLForConditionalGeneration"
+            or hf.get("model_type") == "qwen2_vl"
+        ):
+            from dynamo_tpu.models import qwen2vl
+
+            cfg = qwen2vl.config_from_hf(hf)
+            qwen2vl_dir = True
         elif (
             "llama" in arch.lower()
             or "qwen2" in arch.lower()
@@ -369,7 +390,29 @@ def get_model(
         )
     elif os.path.isdir(name):
         adapter = replace(adapter, default_checkpoint=name)
+        if qwen2vl_dir:
+            # Qwen2-VL dirs hold a conditional-generation model;
+            # AutoModelForCausalLM refuses them, and the language weights
+            # live under `model.language_model.*`.
+            adapter = replace(
+                adapter,
+                load_params=lambda path: _load_qwen2vl_checkpoint(path, cfg),
+            )
     return adapter
+
+
+def _load_qwen2vl_checkpoint(path: str, cfg: LlamaConfig):
+    import torch
+    from transformers import Qwen2VLForConditionalGeneration
+
+    from dynamo_tpu.models.qwen2vl import remap_language_state_dict
+
+    model = Qwen2VLForConditionalGeneration.from_pretrained(
+        path, torch_dtype=torch.float32, low_cpu_mem_usage=True
+    )
+    return llama_mod.params_from_torch_state_dict(
+        remap_language_state_dict(model.state_dict()), cfg
+    )
 
 
 def _with_dtype(cfg: LlamaConfig, dtype) -> LlamaConfig:

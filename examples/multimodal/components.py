@@ -45,6 +45,8 @@ class EncodeWorker:
 
             name = self.config.get("vision-model", "clip-vit-l-14")
             proj_dim = int(self.config.get("proj-dim", 4096))
+            if "qwen2-vl" in name or self._is_qwen2vl_dir(name):
+                return self._build_qwen2vl(name, proj_dim)
             if os.path.isdir(name):
                 # real weights: an HF CLIP(-vision) checkpoint directory
                 cfg, params = vision.load_vision_checkpoint(
@@ -67,6 +69,82 @@ class EncodeWorker:
         self._cfg, self._params, self._forward = (
             await asyncio.get_running_loop().run_in_executor(None, build)
         )
+
+    @staticmethod
+    def _is_qwen2vl_dir(name: str) -> bool:
+        import json
+        import os
+
+        cfg_path = os.path.join(name, "config.json")
+        if not (os.path.isdir(name) and os.path.exists(cfg_path)):
+            return False
+        with open(cfg_path) as f:
+            hf = json.load(f)
+        return hf.get("model_type") == "qwen2_vl"
+
+    def _build_qwen2vl(self, name: str, proj_dim: int):
+        """Qwen2-VL tower: pixels are patched in the HF processor layout
+        and encoded through the native ViT (models/qwen2vl.py); the
+        merger projects straight into the LM hidden size, so proj-dim
+        names that size here. Checkpoint dirs load ONLY the `visual.*`
+        tensors (safetensors shard scan) — the 2B/7B language weights
+        belong to the LM worker, not this process."""
+        import functools
+        import glob
+        import json
+        import os
+
+        import jax
+        import jax.numpy as jnp
+
+        from dynamo_tpu.models import qwen2vl
+
+        if os.path.isdir(name):
+            with open(os.path.join(name, "config.json")) as f:
+                hfv = json.load(f)["vision_config"]
+            cfg = qwen2vl.Qwen2VLVisionConfig(
+                depth=hfv.get("depth", 32),
+                embed_dim=hfv.get("embed_dim", 1280),
+                num_heads=hfv.get("num_heads", 16),
+                in_channels=hfv.get("in_channels", 3),
+                patch_size=hfv.get("patch_size", 14),
+                temporal_patch_size=hfv.get("temporal_patch_size", 2),
+                spatial_merge_size=hfv.get("spatial_merge_size", 2),
+                mlp_ratio=hfv.get("mlp_ratio", 4.0),
+                hidden_size=hfv.get("hidden_size", proj_dim),
+            )
+            from safetensors import torch as st
+
+            sd = {}
+            for shard in sorted(glob.glob(os.path.join(name, "*.safetensors"))):
+                for k, v in st.load_file(shard).items():
+                    if "visual." in k:
+                        sd[k] = v
+            params = qwen2vl.vision_params_from_torch_state_dict(sd, cfg)
+        elif name == "qwen2-vl-tiny":
+            cfg = qwen2vl.Qwen2VLVisionConfig.tiny(hidden_size=proj_dim)
+            params = qwen2vl.init_vision_params(jax.random.key(0), cfg)
+        else:
+            # production geometry (depth 32, patch 14 — images must be
+            # multiples of 28), random weights until a dir is given
+            cfg = qwen2vl.Qwen2VLVisionConfig.qwen2_vl(hidden_size=proj_dim)
+            params = qwen2vl.init_vision_params(jax.random.key(0), cfg)
+
+        @functools.lru_cache(maxsize=8)
+        def compiled(grids):  # grids are static per pixel shape
+            return jax.jit(
+                lambda p, x: qwen2vl.vision_forward(p, cfg, x, list(grids))
+            )
+
+        def fwd(params, images):
+            b = images.shape[0]
+            patches, grids = qwen2vl.pixels_to_patches(
+                np.asarray(images, np.float32), cfg
+            )
+            out = compiled(tuple(grids))(params, jnp.asarray(patches))
+            return np.asarray(out, np.float32).reshape(b, -1, out.shape[-1])
+
+        return cfg, params, fwd
 
     @endpoint
     async def encode(self, ctx, request):

@@ -1,0 +1,286 @@
+"""Qwen2-VL (vision tower + m-RoPE LM) vs HF Qwen2VLForConditionalGeneration.
+
+BASELINE config 5's model family; the reference reaches it only through
+vLLM (/root/reference examples/multimodal/), here it is golden-tested
+like the other families.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dynamo_tpu.models import qwen2vl
+from dynamo_tpu.models.llama import (
+    forward,
+    init_kv_pages,
+    params_from_torch_state_dict,
+)
+
+PAGE_SIZE = 4
+IMG_TOK = 251
+VSTART = 250
+
+pytestmark = pytest.mark.filterwarnings("ignore")
+
+
+def _hf_model():
+    torch = pytest.importorskip("torch")
+    from transformers import Qwen2VLConfig, Qwen2VLForConditionalGeneration
+
+    cfg = Qwen2VLConfig(
+        vision_config=dict(
+            depth=2, embed_dim=32, num_heads=4, in_channels=3,
+            patch_size=4, temporal_patch_size=2, spatial_merge_size=2,
+            mlp_ratio=2.0, hidden_size=64,
+        ),
+        text_config=dict(
+            vocab_size=256, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, rope_theta=10000.0, rms_norm_eps=1e-6,
+            tie_word_embeddings=False,
+            rope_scaling={"type": "mrope", "mrope_section": [2, 3, 3]},
+            max_position_embeddings=512,
+        ),
+        image_token_id=IMG_TOK, video_token_id=252,
+        vision_start_token_id=VSTART, vision_end_token_id=253,
+    )
+    torch.manual_seed(7)
+    model = Qwen2VLForConditionalGeneration(cfg).eval()
+    with torch.no_grad():  # qkv biases are zero-init; make them matter
+        for layer in model.model.language_model.layers:
+            for proj in (layer.self_attn.q_proj, layer.self_attn.k_proj,
+                         layer.self_attn.v_proj):
+                proj.bias.normal_(0.0, 0.3)
+    return model
+
+
+def _ours_from_hf(model):
+    sd = model.state_dict()
+    vcfg = qwen2vl.Qwen2VLVisionConfig.tiny(hidden_size=64)
+    tcfg = qwen2vl.text_tiny()
+    vparams = qwen2vl.vision_params_from_torch_state_dict(sd, vcfg)
+    tparams = params_from_torch_state_dict(
+        qwen2vl.remap_language_state_dict(sd), tcfg
+    )
+    return vcfg, vparams, tcfg, tparams
+
+
+def _grid_patches(rng, vcfg, grid):
+    """Random pixel patches in the HF pixel_values layout [N, patch_dim]."""
+    t, h, w = grid
+    n = t * h * w
+    return rng.normal(size=(n, vcfg.patch_dim)).astype(np.float32)
+
+
+def test_vision_tower_golden():
+    torch = pytest.importorskip("torch")
+    model = _hf_model()
+    vcfg, vparams, _, _ = _ours_from_hf(model)
+    rng = np.random.default_rng(0)
+    grid = (1, 4, 4)  # 16 patches -> 4 merged embeds
+    patches = _grid_patches(rng, vcfg, grid)
+    with torch.no_grad():
+        ref = model.model.visual(
+            torch.from_numpy(patches),
+            grid_thw=torch.tensor([list(grid)]),
+        ).numpy()
+    ours = np.asarray(
+        qwen2vl.vision_forward(vparams, vcfg, jnp.asarray(patches), [grid])
+    )
+    assert ours.shape == ref.shape == (4, 64)
+    np.testing.assert_allclose(ours, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_vision_tower_two_images_block_diagonal():
+    """Two images must not attend to each other (cu_seqlens semantics):
+    encoding [A, B] jointly equals encoding A and B separately."""
+    model = _hf_model()
+    vcfg, vparams, _, _ = _ours_from_hf(model)
+    rng = np.random.default_rng(1)
+    ga, gb = (1, 4, 4), (1, 2, 4)
+    pa, pb = _grid_patches(rng, vcfg, ga), _grid_patches(rng, vcfg, gb)
+    joint = np.asarray(
+        qwen2vl.vision_forward(
+            vparams, vcfg, jnp.asarray(np.concatenate([pa, pb])), [ga, gb]
+        )
+    )
+    solo_a = np.asarray(
+        qwen2vl.vision_forward(vparams, vcfg, jnp.asarray(pa), [ga])
+    )
+    solo_b = np.asarray(
+        qwen2vl.vision_forward(vparams, vcfg, jnp.asarray(pb), [gb])
+    )
+    np.testing.assert_allclose(
+        joint, np.concatenate([solo_a, solo_b]), rtol=1e-5, atol=1e-5
+    )
+
+
+def test_get_rope_index_golden():
+    torch = pytest.importorskip("torch")
+    model = _hf_model()
+    grid = (1, 4, 4)  # 4 merged image tokens
+    toks = [5, 9, VSTART, IMG_TOK, IMG_TOK, IMG_TOK, IMG_TOK, 253, 17, 3]
+    ref_pos, ref_delta = model.model.get_rope_index(
+        torch.tensor([toks]), image_grid_thw=torch.tensor([list(grid)])
+    )
+    pos, delta = qwen2vl.get_rope_index(
+        toks, [grid], image_token_id=IMG_TOK
+    )
+    np.testing.assert_array_equal(pos, ref_pos[:, 0].numpy())
+    assert delta == int(ref_delta[0, 0])
+
+    # text-only: all three streams equal arange
+    pos2, delta2 = qwen2vl.get_rope_index(
+        [1, 2, 3, 4], [], image_token_id=IMG_TOK
+    )
+    np.testing.assert_array_equal(pos2, np.tile(np.arange(4), (3, 1)))
+    assert delta2 == 0
+
+
+def _run_ours(tcfg, tparams, toks, pos3=None, mm_embeds=None, mm_mask=None):
+    b, t = toks.shape
+    kv = init_kv_pages(tcfg, 64, PAGE_SIZE)
+    n_pages = -(-t // PAGE_SIZE)
+    pts = np.zeros((b, n_pages), np.int32)
+    for i in range(b):
+        pts[i] = np.arange(1 + i * n_pages, 1 + (i + 1) * n_pages)
+    positions = np.tile(np.arange(t, dtype=np.int32), (b, 1))
+    kw = {}
+    if pos3 is not None:
+        kw["rope_positions"] = jnp.asarray(pos3)
+    if mm_embeds is not None:
+        kw["mm_embeds"] = mm_embeds
+        kw["mm_mask"] = jnp.asarray(mm_mask)
+    logits, _ = forward(
+        tparams, tcfg, jnp.asarray(toks), jnp.asarray(positions),
+        jnp.ones((b, t), bool), kv, jnp.asarray(pts), **kw,
+    )
+    return np.asarray(logits)
+
+
+def test_full_model_golden_with_image():
+    """End to end: vision encode -> splice -> m-RoPE LM forward equals
+    HF Qwen2VLForConditionalGeneration logits."""
+    torch = pytest.importorskip("torch")
+    model = _hf_model()
+    vcfg, vparams, tcfg, tparams = _ours_from_hf(model)
+    rng = np.random.default_rng(2)
+    grid = (1, 4, 4)
+    patches = _grid_patches(rng, vcfg, grid)
+    toks = [5, 9, VSTART, IMG_TOK, IMG_TOK, IMG_TOK, IMG_TOK, 253, 17, 3]
+
+    with torch.no_grad():
+        ref = model(
+            input_ids=torch.tensor([toks]),
+            pixel_values=torch.from_numpy(patches),
+            image_grid_thw=torch.tensor([list(grid)]),
+        ).logits.numpy()
+
+    embeds = qwen2vl.vision_forward(
+        vparams, vcfg, jnp.asarray(patches), [grid]
+    )  # [4, H]
+    toks_np = np.asarray([toks], np.int32)
+    mm_mask = toks_np == IMG_TOK
+    mm_embeds = jnp.zeros((1, len(toks), tcfg.hidden_size), jnp.float32)
+    mm_embeds = mm_embeds.at[0, np.nonzero(mm_mask[0])[0]].set(embeds)
+    pos3, _ = qwen2vl.get_rope_index(toks, [grid], image_token_id=IMG_TOK)
+    ours = _run_ours(
+        tcfg, tparams, toks_np, pos3=pos3[:, None, :],
+        mm_embeds=mm_embeds, mm_mask=mm_mask,
+    )
+    np.testing.assert_allclose(ours, ref, rtol=5e-2, atol=5e-2)
+    assert (ours.argmax(-1) == ref.argmax(-1)).mean() > 0.95
+
+
+def test_text_only_scalar_positions_exact():
+    """Text-only m-RoPE with equal streams IS standard rope: the serving
+    engine's [B, T] scalar positions are exact, not approximate."""
+    model = _hf_model()
+    _, _, tcfg, tparams = _ours_from_hf(model)
+    rng = np.random.default_rng(3)
+    toks = rng.integers(0, 250, size=(2, 8)).astype(np.int32)
+    pos3 = np.tile(np.arange(8, dtype=np.int32), (3, 2, 1))
+    a = _run_ours(tcfg, tparams, toks)  # scalar positions
+    b = _run_ours(tcfg, tparams, toks, pos3=pos3)
+    np.testing.assert_array_equal(a, b)
+
+
+def test_text_golden_vs_hf():
+    """Text-only logits vs HF (the serving path)."""
+    torch = pytest.importorskip("torch")
+    model = _hf_model()
+    _, _, tcfg, tparams = _ours_from_hf(model)
+    rng = np.random.default_rng(4)
+    toks = rng.integers(0, 250, size=(2, 11)).astype(np.int32)
+    with torch.no_grad():
+        ref = model(
+            input_ids=torch.from_numpy(toks.astype(np.int64))
+        ).logits.numpy()
+    ours = _run_ours(tcfg, tparams, toks)
+    np.testing.assert_allclose(ours, ref, rtol=5e-2, atol=5e-2)
+    assert (ours.argmax(-1) == ref.argmax(-1)).mean() > 0.95
+
+
+def test_preset_and_engine_serving():
+    """The registry preset serves text through the real engine."""
+    from dynamo_tpu.engine import EngineConfig
+    from dynamo_tpu.engine.engine import JaxEngine
+    from dynamo_tpu.engine.request import SamplingParams
+    from dynamo_tpu.models.registry import get_model
+
+    adapter = get_model("qwen2-vl-tiny", dtype="float32")
+    assert adapter.config.mrope_section == (2, 3, 3)
+    assert adapter.config.attention_bias
+
+    eng = JaxEngine(
+        EngineConfig(
+            model="qwen2-vl-tiny", num_pages=64, page_size=4,
+            max_pages_per_seq=8, decode_buckets=(1, 2, 4),
+            prefill_chunk=16, max_seqs=4, dtype="float32",
+        )
+    )
+    eng.add_request(
+        "q", [5, 17, 42, 9], SamplingParams(temperature=0.0, max_tokens=4)
+    )
+    out = eng.run_to_completion()["q"]
+    assert len(out) == 4
+
+
+def test_mrope_sharding_specs(cpu_mesh_devices):
+    from dynamo_tpu.models.registry import get_model
+    from dynamo_tpu.parallel import MeshConfig, make_mesh, shardings_for
+
+    adapter = get_model("qwen2-vl-tiny", dtype="float32")
+    mesh = make_mesh(
+        MeshConfig(dp=1, tp=2, sp=1), devices=cpu_mesh_devices[:2]
+    )
+    params = adapter.init_params(jax.random.key(0))
+    sh = shardings_for(mesh, adapter.param_specs())
+    jax.device_put(params, sh)  # must not throw
+
+
+def test_pixels_to_patches_matches_hf_processor():
+    """Our patch layout equals Qwen2VLImageProcessor's (merge-group-major
+    patch order, (C, temporal, ps, ps) flattening)."""
+    pytest.importorskip("torch")
+    from transformers.models.qwen2_vl.image_processing_qwen2_vl import (
+        Qwen2VLImageProcessor,
+    )
+
+    vcfg = qwen2vl.Qwen2VLVisionConfig.tiny()
+    proc = Qwen2VLImageProcessor(
+        patch_size=vcfg.patch_size, merge_size=vcfg.spatial_merge_size,
+        temporal_patch_size=vcfg.temporal_patch_size,
+        do_resize=False, do_rescale=False, do_normalize=False,
+        do_convert_rgb=False,
+    )
+    rng = np.random.default_rng(6)
+    img = rng.normal(size=(16, 8, 3)).astype(np.float32)
+    out = proc(images=[img], return_tensors="np")
+    ref = out["pixel_values"]
+    ref_grid = out["image_grid_thw"][0]
+    patches, grids = qwen2vl.pixels_to_patches(img[None], vcfg)
+    assert tuple(ref_grid) == grids[0]
+    np.testing.assert_allclose(patches, ref, rtol=1e-6, atol=1e-6)

@@ -179,3 +179,66 @@ def test_multimodal_graph_end_to_end():
             await handle.stop()
 
     asyncio.run(run())
+
+
+def test_multimodal_graph_qwen2vl_end_to_end():
+    """The Qwen2-VL tower through the same encode/splice pipeline: pixels
+    patched in the HF processor layout, ViT+merger embeds spliced into a
+    qwen2-vl (m-RoPE) language model, completion returned."""
+    import aiohttp
+
+    from dynamo_tpu.sdk.serving import serve_graph
+    from examples.multimodal.graph import MultimodalFrontend
+
+    cfg = {
+        "MultimodalFrontend": {"port": 0},
+        "Worker": {
+            "model": "qwen2-vl-tiny", "engine": "jax", "dtype": "float32",
+            "page-size": 4, "num-pages": 64, "max-context": 128,
+            "prefill-chunk": 16, "max-seqs": 4, "decode-steps": 1,
+        },
+        "EncodeWorker": {"vision-model": "qwen2-vl-tiny", "proj-dim": 64},
+    }
+
+    async def run():
+        handle = await serve_graph(MultimodalFrontend, config=cfg, static=True)
+        try:
+            frontend = handle.instance_of(MultimodalFrontend)
+            await asyncio.sleep(0.5)
+            # 16x8 pixels -> 4x2 patch grid -> 2x1 merged = 2 image tokens
+            pixels = np.random.default_rng(0).normal(
+                size=(16, 8, 3)
+            ).astype(np.float32)
+            import base64
+
+            async with aiohttp.ClientSession() as sess:
+                r = await sess.post(
+                    f"http://127.0.0.1:{frontend.port}/v1/chat/completions",
+                    json={
+                        "model": "qwen2-vl-tiny",
+                        "messages": [
+                            {
+                                "role": "user",
+                                "content": [
+                                    {"type": "text", "text": "describe"},
+                                    {
+                                        "type": "image_pixels",
+                                        "data": base64.b64encode(
+                                            pixels.tobytes()
+                                        ).decode(),
+                                        "shape": [16, 8, 3],
+                                    },
+                                ],
+                            }
+                        ],
+                        "max_tokens": 4,
+                    },
+                    timeout=aiohttp.ClientTimeout(total=300),
+                )
+                assert r.status == 200, await r.text()
+                body = await r.json()
+                assert body["choices"][0]["message"]["content"] is not None
+        finally:
+            await handle.stop()
+
+    asyncio.run(run())
