@@ -12,6 +12,6 @@ needed at this scale)."""
 
 from dynamo_tpu.operator.controller import Controller
 from dynamo_tpu.operator.kube import InMemoryKube
-from dynamo_tpu.operator.reconciler import reconcile
+from dynamo_tpu.operator.reconciler import reconcile, reconcile_component
 
-__all__ = ["Controller", "InMemoryKube", "reconcile"]
+__all__ = ["Controller", "InMemoryKube", "reconcile", "reconcile_component"]

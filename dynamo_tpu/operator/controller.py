@@ -17,7 +17,11 @@ import threading
 import time
 from typing import Any, Optional
 
-from dynamo_tpu.operator.reconciler import garbage_collect, reconcile
+from dynamo_tpu.operator.reconciler import (
+    garbage_collect,
+    reconcile,
+    reconcile_component,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -39,7 +43,8 @@ class Controller:
             name = cr["metadata"]["name"]
             live.add(name)
             try:
-                status = reconcile(self.kube, cr)
+                # component convergence happens in our own pass below
+                status = reconcile(self.kube, cr, converge_components=False)
                 self.kube.patch_status(
                     "DynamoGraphDeployment", self.namespace, name, status
                 )
@@ -51,6 +56,20 @@ class Controller:
                         {"type": "Ready", "status": "False", "reason": "Error"}
                     ]
                 }
+        # Component pass: converge every DCD and record its status —
+        # this is what picks up /scale subresource changes (planner,
+        # HPA) between graph edits.
+        for dcd in self.kube.list(
+            "DynamoComponentDeployment", self.namespace
+        ):
+            name = dcd["metadata"]["name"]
+            try:
+                status = reconcile_component(self.kube, dcd)
+                self.kube.patch_status(
+                    "DynamoComponentDeployment", self.namespace, name, status
+                )
+            except Exception:
+                logger.exception("component reconcile failed for %s", name)
         gc = garbage_collect(self.kube, self.namespace, live)
         if gc:
             logger.info("garbage-collected %d orphaned objects", gc)

@@ -30,6 +30,10 @@ _API = {
     "Deployment": ("apis/apps/v1", "deployments"),
     "Service": ("api/v1", "services"),
     "DynamoGraphDeployment": ("apis/dynamo.tpu/v1alpha1", "dynamographdeployments"),
+    "DynamoComponentDeployment": (
+        "apis/dynamo.tpu/v1alpha1",
+        "dynamocomponentdeployments",
+    ),
 }
 
 
@@ -93,6 +97,18 @@ class InMemoryKube:
         if obj is not None:
             obj["status"] = json.loads(json.dumps(status))
             self.actions.append(("status", kind, name))
+
+    def patch_scale(
+        self, kind: str, namespace: str, name: str, replicas: int
+    ) -> Optional[dict]:
+        """The /scale subresource: set spec.replicas WITHOUT a full-object
+        write — no read-modify-write race with the reconciler, like HPA."""
+        obj = self._objs.get((kind, namespace, name))
+        if obj is None:
+            return None
+        obj.setdefault("spec", {})["replicas"] = int(replicas)
+        self.actions.append(("scale", kind, name))
+        return json.loads(json.dumps(obj))
 
 
 class InClusterKube:
@@ -181,6 +197,20 @@ class InClusterKube:
 
     def delete(self, kind: str, namespace: str, name: str) -> bool:
         return self._request("DELETE", self._url(kind, namespace, name)) is not None
+
+    def patch_scale(
+        self, kind: str, namespace: str, name: str, replicas: int
+    ) -> Optional[dict]:
+        """PATCH the /scale subresource (the CRD declares it —
+        deploy/k8s/crds.yaml): the API server updates only
+        spec.replicas, so planner scaling never conflicts with the
+        reconciler's status writes or a concurrent spec edit."""
+        return self._request(
+            "PATCH",
+            self._url(kind, namespace, name, sub="scale"),
+            {"spec": {"replicas": int(replicas)}},
+            content_type="application/merge-patch+json",
+        )
 
     def patch_status(self, kind: str, namespace: str, name: str, status: dict) -> None:
         self._request(

@@ -1,20 +1,36 @@
-"""Reconcile one DynamoGraphDeployment into Deployments + Services.
+"""Reconcile DynamoGraphDeployments through DynamoComponentDeployments
+into Deployments + Services.
 
-The CR's spec carries a frozen build manifest (`dynamo-tpu build` output —
-sdk/build.py): image + the service list with replicas/config. Desired
-child objects come from the same renderer the `deploy` command uses
-(sdk/build.render_k8s), stamped with ownership labels; reconciliation is
-a three-way sweep — create missing, replace drifted, delete orphaned —
-exactly the reference operator's loop (deploy/cloud/operator
-internal/controller/dynamographdeployment_controller.go) without the
-controller-runtime machinery.
+Two controllers, like the reference's (deploy/cloud/operator
+internal/controller/{dynamographdeployment,dynamocomponentdeployment}
+_controller.go), without the controller-runtime machinery:
 
-Drift detection compares the desired spec against the observed object's
-spec (fields we own); unknown server-set fields are ignored, so the loop
-is idempotent against defaulting."""
+1. **Graph level** (`reconcile`): the CR's spec carries a frozen build
+   manifest (`dynamo-tpu build` output — sdk/build.py). Each service
+   becomes one DynamoComponentDeployment child CR; the shared fabric's
+   Deployment+Service are reconciled directly (they belong to the graph,
+   not any one component).
+2. **Component level** (`reconcile_component`): one DCD renders into its
+   Deployment (+Service when it exposes a port) via the same renderer
+   the `deploy` command uses (sdk/build.render_k8s).
+
+Both levels are a three-way sweep — create missing, replace drifted,
+delete orphaned. Drift detection compares only fields we own; unknown
+server-set fields are ignored, so the loops are idempotent against
+defaulting.
+
+**Replica ownership**: a DCD's `spec.replicas` is scalable via the
+/scale subresource (planner KubeConnector, HPA — the reference's
+dynamocomponentdeployment_types.go scale path). The graph CR's
+per-service `replicas` is the *initial* value and keeps propagating
+only when the graph author CHANGES it — the DCD's
+`dynamo.tpu/graph-replicas` annotation records the last value the graph
+stated, so a planner scale-up is not clobbered by the next no-op graph
+reconcile, while an explicit graph edit still wins."""
 
 from __future__ import annotations
 
+import json
 import logging
 from typing import Any
 
@@ -25,41 +41,51 @@ logger = logging.getLogger(__name__)
 MANAGED_BY = "dynamo-tpu-operator"
 LABEL_MANAGED = "app.kubernetes.io/managed-by"
 LABEL_OWNER = "dynamo.tpu/deployment"
+LABEL_COMPONENT = "dynamo.tpu/component"
+ANNO_GRAPH_REPLICAS = "dynamo.tpu/graph-replicas"
+
+
+def component_name(owner: str, service: str) -> str:
+    return f"{owner}-{service.lower()}"
+
+
+def _norm_service(s: dict) -> dict:
+    """Hand-written CRs may omit fields the CRD marks optional; default
+    them before rendering (render_k8s indexes replicas/config directly)."""
+    return {
+        "name": s["name"],
+        "class": s["class"],
+        "replicas": s.get("replicas", 1),
+        "endpoints": s.get("endpoints", []),
+        "depends": s.get("depends", []),
+        "config": s.get("config", {}) or {},
+        "k8s": s.get("k8s", {}) or {},
+    }
+
+
+def _validate_fabric(spec: dict, owner: str) -> None:
+    # fabricExternal: the platform (helm chart) owns a persistent fabric;
+    # an external fabric with no address would silently point pods at a
+    # nonexistent Service — fail loudly.
+    if spec.get("fabricExternal", False) and not spec.get("fabricHost"):
+        raise ValueError(
+            f"CR {owner}: fabricExternal requires fabricHost (the address "
+            "of the platform-managed fabric Service)"
+        )
 
 
 def desired_objects(cr: dict) -> list[dict]:
     """Render the CR's child objects, labeled for ownership sweeps."""
     spec = cr.get("spec", {})
-    # Hand-written CRs may omit fields the CRD marks optional; default them
-    # before rendering (render_k8s indexes replicas/config directly).
-    services = [
-        {
-            "name": s["name"],
-            "class": s["class"],
-            "replicas": s.get("replicas", 1),
-            "endpoints": s.get("endpoints", []),
-            "depends": s.get("depends", []),
-            "config": s.get("config", {}) or {},
-            "k8s": s.get("k8s", {}) or {},
-        }
-        for s in spec.get("services", [])
-    ]
+    services = [_norm_service(s) for s in spec.get("services", [])]
     manifest = {
         "image": spec.get("image", "dynamo-tpu:latest"),
         "services": services,
     }
     owner = cr["metadata"]["name"]
     namespace = cr["metadata"].get("namespace", "default")
-    # fabricExternal: the platform (helm chart) owns a persistent fabric;
-    # the graph's services rendezvous there instead of the operator
-    # rendering a per-graph fabric. An external fabric with no address
-    # would silently point pods at a nonexistent Service — fail loudly.
     external = spec.get("fabricExternal", False)
-    if external and not spec.get("fabricHost"):
-        raise ValueError(
-            f"CR {owner}: fabricExternal requires fabricHost (the address "
-            "of the platform-managed fabric Service)"
-        )
+    _validate_fabric(spec, owner)
     objs = render_k8s(
         manifest,
         fabric_host=spec.get("fabricHost", f"{owner}-fabric"),
@@ -106,38 +132,176 @@ def _spec_drifted(desired: dict, observed: dict) -> bool:
     return any(have.get(k) != v for k, v in want.items())
 
 
-def reconcile(kube: Any, cr: dict) -> dict:
-    """One reconcile pass. Returns a status patch for the CR."""
-    namespace = cr["metadata"].get("namespace", "default")
+def desired_components(cr: dict) -> list[dict]:
+    """One DynamoComponentDeployment per graph service."""
+    spec = cr.get("spec", {})
     owner = cr["metadata"]["name"]
-    desired = desired_objects(cr)
-    created = replaced = deleted = 0
+    namespace = cr["metadata"].get("namespace", "default")
+    _validate_fabric(spec, owner)
+    out = []
+    for s in map(_norm_service, spec.get("services", [])):
+        replicas = s["replicas"]
+        out.append(
+            {
+                "apiVersion": "dynamo.tpu/v1alpha1",
+                "kind": "DynamoComponentDeployment",
+                "metadata": {
+                    "name": component_name(owner, s["name"]),
+                    "namespace": namespace,
+                    "labels": {
+                        LABEL_MANAGED: MANAGED_BY,
+                        LABEL_OWNER: owner,
+                    },
+                    "annotations": {ANNO_GRAPH_REPLICAS: str(replicas)},
+                },
+                "spec": {
+                    "image": spec.get("image", "dynamo-tpu:latest"),
+                    "fabricHost": spec.get("fabricHost", f"{owner}-fabric"),
+                    "fabricPort": int(spec.get("fabricPort", 4222)),
+                    "replicas": replicas,
+                    "service": {
+                        k: v for k, v in s.items() if k != "replicas"
+                    },
+                },
+            }
+        )
+    return out
 
-    want_names: dict[str, set[str]] = {"Deployment": set(), "Service": set()}
+
+def _sweep(
+    kube: Any, namespace: str, desired: list[dict], kinds: tuple,
+    selector: dict, keep_fields=(),
+) -> tuple[int, int, int]:
+    """Three-way convergence: create missing, replace drifted, delete
+    owned-but-undesired. `keep_fields` names observed top-level spec
+    fields another plane owns (e.g. replicas via /scale) — they are
+    carried into the desired spec before the drift compare/write."""
+    created = replaced = deleted = 0
+    want: dict[str, set[str]] = {k: set() for k in kinds}
     for obj in desired:
         kind, name = obj["kind"], obj["metadata"]["name"]
-        want_names[kind].add(name)
+        want[kind].add(name)
         observed = kube.get(kind, namespace, name)
         if observed is None:
             kube.create(kind, namespace, obj)
             created += 1
-        elif _spec_drifted(obj, observed):
+            continue
+        obj = json.loads(json.dumps(obj))
+        anno_stale = False
+        for field in keep_fields:
+            if field in (observed.get("spec") or {}):
+                anno = (
+                    observed.get("metadata", {}).get("annotations", {}) or {}
+                )
+                stated = obj["metadata"].get("annotations", {}).get(
+                    ANNO_GRAPH_REPLICAS
+                )
+                if (
+                    field == "replicas"
+                    and stated is not None
+                    and anno.get(ANNO_GRAPH_REPLICAS) != stated
+                ):
+                    # the graph author changed it: propagate, and make
+                    # sure the annotation WRITE happens even when the new
+                    # value already matches (e.g. the author aligned the
+                    # manifest with a planner scale) — a stale annotation
+                    # would clobber every later scale
+                    anno_stale = True
+                    continue
+                obj["spec"][field] = observed["spec"][field]
+        if anno_stale or _spec_drifted(obj, observed):
             merged = dict(observed)
             merged["spec"] = obj["spec"]
             labels = dict(observed.get("metadata", {}).get("labels", {}) or {})
             labels.update(obj["metadata"]["labels"])
             merged.setdefault("metadata", {})["labels"] = labels
+            annos = dict(
+                observed.get("metadata", {}).get("annotations", {}) or {}
+            )
+            annos.update(obj["metadata"].get("annotations", {}))
+            if annos:
+                merged["metadata"]["annotations"] = annos
             kube.replace(kind, namespace, name, merged)
             replaced += 1
-
-    # Ownership sweep: anything we manage for this CR that is no longer
-    # desired (service removed from the graph, port dropped) gets deleted.
-    selector = {LABEL_MANAGED: MANAGED_BY, LABEL_OWNER: owner}
-    for kind in ("Deployment", "Service"):
+    for kind in kinds:
         for obj in kube.list(kind, namespace, selector):
             name = obj["metadata"]["name"]
-            if name not in want_names[kind]:
+            if name not in want[kind]:
                 kube.delete(kind, namespace, name)
+                deleted += 1
+    return created, replaced, deleted
+
+
+def reconcile(kube: Any, cr: dict, converge_components: bool = True) -> dict:
+    """Graph-level pass: converge the component CRs + the shared fabric,
+    then (by default) converge every desired component's children so one
+    call fully converges a graph. The Controller passes
+    converge_components=False — its own component pass immediately
+    follows, and doing the work twice per tick doubles the API load.
+    Returns a status patch for the CR."""
+    namespace = cr["metadata"].get("namespace", "default")
+    owner = cr["metadata"]["name"]
+    spec = cr.get("spec", {})
+    comps = desired_components(cr)
+
+    selector = {LABEL_MANAGED: MANAGED_BY, LABEL_OWNER: owner}
+    created, replaced, deleted = _sweep(
+        kube, namespace, comps, ("DynamoComponentDeployment",),
+        selector, keep_fields=("replicas",),
+    )
+
+    # the shared fabric belongs to the graph, not any one component
+    fabric_objs = []
+    if not spec.get("fabricExternal", False):
+        fabric_objs = render_k8s(
+            {"image": spec.get("image", "dynamo-tpu:latest"), "services": []},
+            fabric_host=spec.get("fabricHost", f"{owner}-fabric"),
+            include_fabric=True,
+            fabric_port=int(spec.get("fabricPort", 4222)),
+        )
+        for obj in fabric_objs:
+            meta = obj.setdefault("metadata", {})
+            meta["namespace"] = namespace
+            meta.setdefault("labels", {}).update(selector)
+            if obj["kind"] == "Deployment":
+                # keep `kubectl get pods -l dynamo.tpu/deployment=<name>`
+                # covering the fabric pod too
+                tmeta = obj["spec"]["template"].setdefault("metadata", {})
+                tmeta.setdefault("labels", {})[LABEL_OWNER] = owner
+    fabric_selector = dict(selector, **{LABEL_COMPONENT: "fabric"})
+    for obj in fabric_objs:
+        obj["metadata"]["labels"][LABEL_COMPONENT] = "fabric"
+    c2, r2, d2 = _sweep(
+        kube, namespace, fabric_objs, ("Deployment", "Service"),
+        fabric_selector,
+    )
+    created, replaced, deleted = created + c2, replaced + r2, deleted + d2
+
+    # component-level convergence (the controller instead runs its own
+    # per-DCD pass each tick, catching /scale changes between graph edits)
+    if converge_components:
+        for comp in comps:
+            observed = kube.get(
+                "DynamoComponentDeployment", namespace,
+                comp["metadata"]["name"],
+            )
+            if observed is not None:
+                c3, r3, d3 = reconcile_component_counts(kube, observed)
+                created, replaced, deleted = (
+                    created + c3, replaced + r3, deleted + d3,
+                )
+
+    # children of components that no longer exist (service removed from
+    # the graph): their DCD was swept above, so nothing reconciles them —
+    # delete by exclusion on the component label
+    live_comps = {c["metadata"]["name"] for c in comps} | {"fabric"}
+    for kind in ("Deployment", "Service"):
+        for obj in kube.list(kind, namespace, selector):
+            comp = (obj["metadata"].get("labels") or {}).get(LABEL_COMPONENT)
+            # no component label = a stray we own anyway (pre-component
+            # operator versions, manual edits): sweep it with the rest
+            if comp not in live_comps:
+                kube.delete(kind, namespace, obj["metadata"]["name"])
                 deleted += 1
 
     if created or replaced or deleted:
@@ -152,10 +316,69 @@ def reconcile(kube: Any, cr: dict) -> dict:
                 "type": "Ready",
                 "status": "True",
                 "reason": "Reconciled",
-                "message": (
-                    f"{len(want_names['Deployment'])} deployments, "
-                    f"{len(want_names['Service'])} services"
-                ),
+                "message": f"{len(comps)} components",
+            }
+        ],
+        "lastAction": {
+            "created": created, "replaced": replaced, "deleted": deleted,
+        },
+    }
+
+
+def component_objects(dcd: dict) -> list[dict]:
+    """Render one component CR's children (Deployment + Service when it
+    exposes a port) with graph + component ownership labels."""
+    spec = dcd.get("spec", {})
+    svc = dict(spec.get("service", {}))
+    svc["replicas"] = spec.get("replicas", 1)
+    objs = render_k8s(
+        {"image": spec.get("image", "dynamo-tpu:latest"), "services": [svc]},
+        fabric_host=spec.get("fabricHost", "dynamo-fabric"),
+        include_fabric=False,
+        fabric_port=int(spec.get("fabricPort", 4222)),
+    )
+    namespace = dcd["metadata"].get("namespace", "default")
+    owner = dcd["metadata"].get("labels", {}).get(
+        LABEL_OWNER, dcd["metadata"]["name"]
+    )
+    comp = dcd["metadata"]["name"]
+    for obj in objs:
+        meta = obj.setdefault("metadata", {})
+        meta["namespace"] = namespace
+        labels = meta.setdefault("labels", {})
+        labels[LABEL_MANAGED] = MANAGED_BY
+        labels[LABEL_OWNER] = owner
+        labels[LABEL_COMPONENT] = comp
+        if obj["kind"] == "Deployment":
+            tmeta = obj["spec"]["template"].setdefault("metadata", {})
+            tlabels = tmeta.setdefault("labels", {})
+            tlabels[LABEL_OWNER] = owner
+    return objs
+
+
+def reconcile_component_counts(kube: Any, dcd: dict) -> tuple[int, int, int]:
+    namespace = dcd["metadata"].get("namespace", "default")
+    comp = dcd["metadata"]["name"]
+    objs = component_objects(dcd)
+    selector = {LABEL_MANAGED: MANAGED_BY, LABEL_COMPONENT: comp}
+    return _sweep(
+        kube, namespace, objs, ("Deployment", "Service"), selector
+    )
+
+
+def reconcile_component(kube: Any, dcd: dict) -> dict:
+    """Component-level pass. Returns a status patch for the DCD."""
+    created, replaced, deleted = reconcile_component_counts(kube, dcd)
+    replicas = dcd.get("spec", {}).get("replicas", 1)
+    return {
+        "observedGeneration": dcd["metadata"].get("generation", 0),
+        "replicas": replicas,  # statusReplicasPath for the /scale read
+        "conditions": [
+            {
+                "type": "Ready",
+                "status": "True",
+                "reason": "Reconciled",
+                "message": f"replicas={replicas}",
             }
         ],
         "lastAction": {
@@ -168,10 +391,27 @@ def garbage_collect(kube: Any, namespace: str, live_owners: set[str]) -> int:
     """Delete objects owned by CRs that no longer exist (explicit-label GC —
     the ownerReference cascade without relying on the API server)."""
     n = 0
+    # children of STANDALONE component CRs (user-created, no graph) carry
+    # the DCD's own name as owner — they are live as long as their DCD is
+    live = set(live_owners) | {
+        d["metadata"]["name"]
+        for d in kube.list("DynamoComponentDeployment", namespace)
+    }
+    for obj in kube.list(
+        "DynamoComponentDeployment", namespace, {LABEL_MANAGED: MANAGED_BY}
+    ):
+        owner = (obj["metadata"].get("labels") or {}).get(LABEL_OWNER)
+        if owner and owner not in live_owners:
+            kube.delete(
+                "DynamoComponentDeployment", namespace,
+                obj["metadata"]["name"],
+            )
+            live.discard(obj["metadata"]["name"])
+            n += 1
     for kind in ("Deployment", "Service"):
         for obj in kube.list(kind, namespace, {LABEL_MANAGED: MANAGED_BY}):
             owner = (obj["metadata"].get("labels") or {}).get(LABEL_OWNER)
-            if owner and owner not in live_owners:
+            if owner and owner not in live:
                 kube.delete(kind, namespace, obj["metadata"]["name"])
                 n += 1
     return n

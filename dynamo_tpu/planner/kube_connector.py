@@ -40,11 +40,36 @@ class KubeConnector:
         import asyncio
 
         service = self.role_services.get(role, role)
-        # Read-modify-write with retry: the operator's status patches bump
-        # resourceVersion between our get and replace, so a PUT can 409;
-        # re-read and re-apply instead of failing the planner tick. Kube
-        # calls are blocking HTTP — keep them off the planner's event loop
-        # (the FleetObserver and runtime heartbeats share it).
+        # Preferred path: the component CR's /scale subresource — one
+        # conflict-free PATCH that only the scale plane writes, exactly
+        # the reference's DynamoComponentDeployment scale mechanism
+        # (dynamocomponentdeployment_types.go). No read-modify-write, no
+        # 409 retry loop, and the graph CR is never rewritten. Kube calls
+        # are blocking HTTP — keep them off the planner's event loop.
+        from dynamo_tpu.operator.reconciler import component_name
+
+        dcd_name = component_name(self.cr_name, service)
+        dcd = await asyncio.to_thread(
+            self.kube.get, "DynamoComponentDeployment", self.namespace,
+            dcd_name,
+        )
+        if dcd is not None:
+            if dcd.get("spec", {}).get("replicas") == target:
+                return  # idempotent: no API churn on a no-op tick
+            result = await asyncio.to_thread(
+                self.kube.patch_scale, "DynamoComponentDeployment",
+                self.namespace, dcd_name, target,
+            )
+            if result is not None:
+                logger.info(
+                    "planner: %s (%s) scaled to %d via /scale (observed %d)",
+                    role, dcd_name, target, observed,
+                )
+                return
+            # the DCD vanished between get and patch: fall through
+        # Legacy fallback (pre-component operators): rewrite the graph
+        # CR's replicas with a 409 retry loop — the operator's status
+        # patches bump resourceVersion between our get and replace.
         for attempt in range(4):
             cr = await asyncio.to_thread(
                 self.kube.get, "DynamoGraphDeployment", self.namespace,

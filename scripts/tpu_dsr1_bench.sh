@@ -1,0 +1,22 @@
+#!/usr/bin/env bash
+# DeepSeek-R1-Distill-Llama-8B (BASELINE config 1) on the chip: int8
+# weight-only so the 8B fits one v5e with KV headroom. Run after
+# scripts/tpu_watch_queue.sh drains. Artifact: artifacts/tpu/bench_dsr1.json
+set -u
+cd "$(dirname "$0")/.."
+OUT=artifacts/tpu
+mkdir -p "$OUT"
+
+if ! timeout 120 python -c \
+  "import jax,sys; sys.exit(0 if jax.devices()[0].platform!='cpu' else 1)" \
+  >/dev/null 2>&1; then
+  echo "tunnel down; not running" >&2
+  exit 1
+fi
+
+BENCH_MODEL=deepseek-r1-distill-llama-8b BENCH_QUANTIZE=int8 \
+  BENCH_REQUESTS=32 BENCH_ATTENTION=auto \
+  timeout 3600 python bench.py > "$OUT/bench_dsr1.json" 2> "$OUT/bench_dsr1.err"
+rc=$?
+tail -c 300 "$OUT/bench_dsr1.json"
+exit $rc

@@ -28,13 +28,16 @@ from urllib.parse import parse_qs, unquote, urlparse
 
 #: path prefix -> kind (mirrors operator/kube._API)
 _ROUTES = [
-    (r"^/apis/apps/v1/namespaces/([^/]+)/deployments(?:/([^/]+))?(/status)?$",
+    (r"^/apis/apps/v1/namespaces/([^/]+)/deployments(?:/([^/]+))?(/status|/scale)?$",
      "Deployment"),
     (r"^/api/v1/namespaces/([^/]+)/services(?:/([^/]+))?(/status)?$",
      "Service"),
     (r"^/apis/dynamo\.tpu/v1alpha1/namespaces/([^/]+)/"
-     r"dynamographdeployments(?:/([^/]+))?(/status)?$",
+     r"dynamographdeployments(?:/([^/]+))?(/status|/scale)?$",
      "DynamoGraphDeployment"),
+    (r"^/apis/dynamo\.tpu/v1alpha1/namespaces/([^/]+)/"
+     r"dynamocomponentdeployments(?:/([^/]+))?(/status|/scale)?$",
+     "DynamoComponentDeployment"),
 ]
 
 
@@ -81,9 +84,8 @@ class FakeKubeApiServer:
                     m = re.match(pat, parsed.path)
                     if m:
                         ns, name, sub = m.group(1), m.group(2), m.group(3)
-                        return kind, ns, name, bool(sub), parse_qs(
-                            parsed.query
-                        )
+                        return kind, ns, name, (sub or "").lstrip("/"), \
+                            parse_qs(parsed.query)
                 return None
 
             def _authed(self) -> bool:
@@ -199,8 +201,14 @@ class FakeKubeApiServer:
                         return self._status(
                             404, "NotFound", f"{kind} {ns}/{name}"
                         )
-                    if is_status:
+                    if is_status == "status":
                         obj["status"] = patch.get("status", {})
+                    elif is_status == "scale":
+                        # the /scale subresource updates ONLY
+                        # spec.replicas, like a real apiserver
+                        obj.setdefault("spec", {})["replicas"] = int(
+                            patch.get("spec", {}).get("replicas", 0)
+                        )
                     else:
                         obj.update(patch)
                     server._rv += 1

@@ -63,9 +63,12 @@ def test_reconcile_creates_then_idempotent():
     cr = make_cr()
     kube.create("DynamoGraphDeployment", "default", cr)
     status = reconcile(kube, cr)
-    assert status["lastAction"] == {"created": 5, "replaced": 0, "deleted": 0}
+    # 2 component CRs + fabric Dep+Svc + frontend Dep+Svc + worker Dep
+    assert status["lastAction"] == {"created": 7, "replaced": 0, "deleted": 0}
     assert status["conditions"][0]["status"] == "True"
-    # replicas made it through
+    # the component layer exists and replicas made it all the way through
+    dcd = kube.get("DynamoComponentDeployment", "default", "demo-worker")
+    assert dcd["spec"]["replicas"] == 2
     worker = kube.get("Deployment", "default", "worker")
     assert worker["spec"]["replicas"] == 2
     # Second pass: no changes.
@@ -82,7 +85,8 @@ def test_reconcile_scales_on_spec_change():
     cr2 = copy.deepcopy(cr)
     cr2["spec"]["services"][1]["replicas"] = 5
     status = reconcile(kube, cr2)
-    assert status["lastAction"]["replaced"] == 1
+    # both levels converge: the component CR and its Deployment
+    assert status["lastAction"]["replaced"] == 2
     assert kube.get("Deployment", "default", "worker")["spec"]["replicas"] == 5
 
 
@@ -94,7 +98,9 @@ def test_reconcile_deletes_removed_service():
     cr2 = copy.deepcopy(cr)
     cr2["spec"]["services"] = cr2["spec"]["services"][:1]  # drop Worker
     status = reconcile(kube, cr2)
-    assert status["lastAction"]["deleted"] == 1
+    # the component CR and its Deployment both go
+    assert status["lastAction"]["deleted"] == 2
+    assert kube.get("DynamoComponentDeployment", "default", "demo-worker") is None
     assert kube.get("Deployment", "default", "worker") is None
     # frontend + fabric untouched
     assert kube.get("Deployment", "default", "frontend") is not None
@@ -117,7 +123,7 @@ def test_garbage_collect_orphans():
     cr = make_cr(name="gone")
     reconcile(kube, cr)
     n = garbage_collect(kube, "default", live_owners=set())
-    assert n == 5
+    assert n == 7  # incl. the two component CRs
     assert kube.list("Deployment", "default") == []
 
 
@@ -175,8 +181,12 @@ def test_planner_kube_connector_closes_the_loop():
         kube, cr_name="fleet", role_services={"decode": "Worker"}
     )
     asyncio.run(conn.scale("decode", target=5, observed=2))
+    # the /scale subresource path: the component CR scaled, the graph CR
+    # NEVER rewritten (no read-modify-write conflicts with the operator)
+    dcd = kube.get("DynamoComponentDeployment", "default", "fleet-worker")
+    assert dcd["spec"]["replicas"] == 5
     cr = kube.get("DynamoGraphDeployment", "default", "fleet")
-    assert cr["spec"]["services"][1]["replicas"] == 5
+    assert cr["spec"]["services"][1]["replicas"] == 2  # untouched
 
     ctl.reconcile_once()
     assert kube.get("Deployment", "default", "worker")["spec"]["replicas"] == 5
@@ -185,6 +195,10 @@ def test_planner_kube_connector_closes_the_loop():
     kube.actions.clear()
     asyncio.run(conn.scale("decode", target=5, observed=5))
     assert kube.actions == []
+
+    # a later no-op graph reconcile must NOT clobber the planner's scale
+    ctl.reconcile_once()
+    assert kube.get("Deployment", "default", "worker")["spec"]["replicas"] == 5
 
     # unknown role/service and missing CR degrade to no-ops
     asyncio.run(conn.scale("nonexistent-role", target=3, observed=0))
@@ -238,3 +252,65 @@ def test_kube_connector_detects_cr_vanishing_mid_write(caplog):
         asyncio.run(conn.scale("decode", target=9, observed=2))
     assert any("disappeared" in r.message for r in caplog.records)
     assert not any("->" in r.message for r in caplog.records)
+
+
+def test_graph_edit_wins_over_stale_scale():
+    """Replica ownership: the planner's /scale survives no-op graph
+    reconciles, but an explicit graph-spec replica CHANGE propagates
+    (the dynamo.tpu/graph-replicas annotation records what the graph
+    last stated)."""
+    kube = InMemoryKube()
+    cr = make_cr(name="own")
+    reconcile(kube, cr)
+    # planner scales the component to 6
+    kube.patch_scale("DynamoComponentDeployment", "default", "own-worker", 6)
+    reconcile(kube, cr)  # no-op graph pass: scale preserved
+    assert (
+        kube.get("DynamoComponentDeployment", "default", "own-worker")
+        ["spec"]["replicas"] == 6
+    )
+    assert kube.get("Deployment", "default", "worker")["spec"]["replicas"] == 6
+    # the graph author now explicitly changes replicas: graph wins
+    cr2 = copy.deepcopy(cr)
+    cr2["spec"]["services"][1]["replicas"] = 3
+    reconcile(kube, cr2)
+    assert (
+        kube.get("DynamoComponentDeployment", "default", "own-worker")
+        ["spec"]["replicas"] == 3
+    )
+    assert kube.get("Deployment", "default", "worker")["spec"]["replicas"] == 3
+
+
+def test_component_status_has_scale_read_path():
+    """The DCD status carries .status.replicas (the CRD's
+    statusReplicasPath) after a controller pass."""
+    kube = InMemoryKube()
+    kube.create("DynamoGraphDeployment", "default", make_cr(name="s"))
+    Controller(kube, namespace="default").reconcile_once()
+    dcd = kube.get("DynamoComponentDeployment", "default", "s-worker")
+    assert dcd["status"]["replicas"] == 2
+    assert dcd["status"]["conditions"][0]["status"] == "True"
+
+
+def test_annotation_updates_when_graph_aligns_with_scale():
+    """If the graph author edits replicas to the exact value the planner
+    already scaled to, the annotation must still advance — else every
+    LATER planner scale gets clobbered by the stale annotation."""
+    kube = InMemoryKube()
+    cr = make_cr(name="al")
+    reconcile(kube, cr)  # graph says 2
+    kube.patch_scale("DynamoComponentDeployment", "default", "al-worker", 6)
+    cr2 = copy.deepcopy(cr)
+    cr2["spec"]["services"][1]["replicas"] = 6  # author aligns with scale
+    reconcile(kube, cr2)
+    dcd = kube.get("DynamoComponentDeployment", "default", "al-worker")
+    assert dcd["metadata"]["annotations"][
+        "dynamo.tpu/graph-replicas"] == "6"
+    # planner scales again; a no-op graph pass must NOT revert it
+    kube.patch_scale("DynamoComponentDeployment", "default", "al-worker", 10)
+    reconcile(kube, cr2)
+    assert (
+        kube.get("DynamoComponentDeployment", "default", "al-worker")
+        ["spec"]["replicas"] == 10
+    )
+    assert kube.get("Deployment", "default", "worker")["spec"]["replicas"] == 10

@@ -153,3 +153,39 @@ def test_401_refreshes_token_and_retries(stack, tmp_path):
     statuses = ctl.reconcile_once()
     assert statuses["demo"]["conditions"][0]["status"] == "True"
     assert server.objects("Deployment", "default")
+
+
+def test_scale_subresource_end_to_end(stack):
+    """The planner's /scale PATCH against real HTTP semantics: only
+    spec.replicas changes on the component CR, the graph CR is never
+    written, and the next controller pass converges the Deployment."""
+    import asyncio
+
+    from dynamo_tpu.planner.kube_connector import KubeConnector
+
+    server, kube = stack
+    server.seed("DynamoGraphDeployment", "default", _cr())
+    ctl = Controller(kube, namespace="default")
+    ctl.reconcile_once()
+    dcd = kube.get("DynamoComponentDeployment", "default", "demo-frontend")
+    assert dcd is not None and dcd["spec"]["replicas"] == 1
+    graph_rv = server.get("DynamoGraphDeployment", "default", "demo")[
+        "metadata"]["resourceVersion"]
+
+    conn = KubeConnector(
+        kube, cr_name="demo", role_services={"decode": "Frontend"}
+    )
+    asyncio.run(conn.scale("decode", target=4, observed=1))
+    dcd = kube.get("DynamoComponentDeployment", "default", "demo-frontend")
+    assert dcd["spec"]["replicas"] == 4
+    # the graph CR was not rewritten by the scale
+    assert server.get("DynamoGraphDeployment", "default", "demo")[
+        "metadata"]["resourceVersion"] == graph_rv
+
+    ctl.reconcile_once()
+    dep = server.get("Deployment", "default", "frontend")
+    assert dep["spec"]["replicas"] == 4
+    # and a later no-op graph pass preserves the scaled value
+    ctl.reconcile_once()
+    assert server.get("Deployment", "default", "frontend")[
+        "spec"]["replicas"] == 4
