@@ -1,0 +1,12 @@
+#!/usr/bin/env bash
+# Round-5 chip extras: wait for the main watcher queue to drain, then run
+# the budget sweep + the DeepSeek-R1-distill bench (each self-probes).
+set -u
+cd "$(dirname "$0")/.."
+LOG=artifacts/tpu/watch_r5.log
+while ! grep -q "queue complete" "$LOG" 2>/dev/null; do
+  sleep 300
+done
+bash scripts/tpu_ttft_budget.sh
+bash scripts/tpu_dsr1_bench.sh
+echo "extras complete"
