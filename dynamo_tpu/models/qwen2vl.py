@@ -315,17 +315,19 @@ def vision_forward(
     grid_thw: Sequence[tuple[int, int, int]],  # static per-image grids
 ) -> jax.Array:
     """Encode flattened conv patches into [N / merge^2, hidden_size]
-    language-model embeddings. Attention is full within each image and
-    blocked across images (HF cu_seqlens semantics)."""
+    language-model embeddings. Attention is full within each TEMPORAL
+    FRAME and blocked across frames and images (HF cu_seqlens repeats
+    h*w per temporal patch)."""
     h = patches.astype(cfg.dtype) @ params["patch_w"]  # [N, E]
     angles = jnp.asarray(_rot_pos_emb(cfg, grid_thw))  # [N, hd/2]
     cos = jnp.cos(angles)[:, None, :]  # [N, 1, hd/2]
     sin = jnp.sin(angles)[:, None, :]
 
-    # block-diagonal mask across images (static: grids are static)
-    seg = np.repeat(
-        np.arange(len(grid_thw)), [t * gh * gw for t, gh, gw in grid_thw]
-    )
+    # block-diagonal mask per (image, temporal frame): HF's cu_seqlens
+    # repeat h*w per temporal patch, so a video frame attends only
+    # within itself (static: grids are static)
+    frame_lens = [gh * gw for t, gh, gw in grid_thw for _ in range(t)]
+    seg = np.repeat(np.arange(len(frame_lens)), frame_lens)
     mask = jnp.asarray(seg[:, None] == seg[None, :])
     nh, hd = cfg.num_heads, cfg.head_dim
     scale = 1.0 / np.sqrt(hd)

@@ -20,6 +20,7 @@ from dynamo_tpu.models.llama import (
 
 PAGE_SIZE = 4
 IMG_TOK = 251
+VIDEO_TOK = 252
 VSTART = 250
 
 pytestmark = pytest.mark.filterwarnings("ignore")
@@ -43,7 +44,7 @@ def _hf_model():
             rope_scaling={"type": "mrope", "mrope_section": [2, 3, 3]},
             max_position_embeddings=512,
         ),
-        image_token_id=IMG_TOK, video_token_id=252,
+        image_token_id=IMG_TOK, video_token_id=VIDEO_TOK,
         vision_start_token_id=VSTART, vision_end_token_id=253,
     )
     torch.manual_seed(7)
@@ -284,3 +285,35 @@ def test_pixels_to_patches_matches_hf_processor():
     patches, grids = qwen2vl.pixels_to_patches(img[None], vcfg)
     assert tuple(ref_grid) == grids[0]
     np.testing.assert_allclose(patches, ref, rtol=1e-6, atol=1e-6)
+
+
+def test_video_temporal_grid_golden():
+    """t>1 grids (video): the vision tower tiles positions across
+    temporal patches and get_rope_index advances the temporal stream —
+    both must match HF exactly."""
+    torch = pytest.importorskip("torch")
+    model = _hf_model()
+    vcfg, vparams, _, _ = _ours_from_hf(model)
+    rng = np.random.default_rng(8)
+    grid = (2, 2, 4)  # 2 temporal patches of a 2x4 spatial grid
+    patches = _grid_patches(rng, vcfg, grid)
+    with torch.no_grad():
+        ref = model.model.visual(
+            torch.from_numpy(patches), grid_thw=torch.tensor([list(grid)])
+        ).numpy()
+    ours = np.asarray(
+        qwen2vl.vision_forward(vparams, vcfg, jnp.asarray(patches), [grid])
+    )
+    assert ours.shape == ref.shape == (4, 64)  # 16 patches -> 4 merged
+    np.testing.assert_allclose(ours, ref, rtol=2e-4, atol=2e-4)
+
+    # m-RoPE position streams for the video placeholder run
+    toks = [5, VSTART, *([VIDEO_TOK] * 4), 253, 9]
+    ref_pos, ref_delta = model.model.get_rope_index(
+        torch.tensor([toks]), video_grid_thw=torch.tensor([list(grid)])
+    )
+    pos, delta = qwen2vl.get_rope_index(
+        toks, [grid], image_token_id=VIDEO_TOK
+    )
+    np.testing.assert_array_equal(pos, ref_pos[:, 0].numpy())
+    assert delta == int(ref_delta[0, 0])
