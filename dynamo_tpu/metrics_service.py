@@ -38,6 +38,12 @@ _WORKER_FIELDS = (
     ("steps", "counter"),
     ("generated_tokens", "counter"),
     ("requests_received", "counter"),
+    # disagg KV transfer planes (absent on non-disagg workers)
+    ("kv_transfer_device_total", "counter"),
+    ("kv_transfer_shm_total", "counter"),
+    ("kv_transfer_bulk_total", "counter"),
+    ("kv_transfer_host_total", "counter"),
+    ("remote_prefills_total", "counter"),
 )
 
 

@@ -630,6 +630,13 @@ class Worker:
                     "requests_received": self.mock.requests_received,
                 }
             if m is not None:
+                if self.transfer_server is not None:
+                    # which KV plane transfers actually rode (device /
+                    # shm / bulk / inline host) — the ops signal for a
+                    # misconfigured fast path silently falling back
+                    for plane, n in self.transfer_server.transfers.items():
+                        m[f"kv_transfer_{plane}_total"] = n
+                    m["remote_prefills_total"] = self.remote_prefills
                 m["instance_id"] = self.instance_id
                 m["model"] = self.card.name
                 await fabric.publish(

@@ -29,6 +29,8 @@ def test_metrics_service_exposition():
                     "num_waiting": 3,
                     "generated_tokens": 100,
                     "requests_received": 7,
+                    "kv_transfer_bulk_total": 4,
+                    "remote_prefills_total": 5,
                 },
             )
             for _ in range(2):
@@ -50,6 +52,14 @@ def test_metrics_service_exposition():
                     health = await resp.json()
 
             assert 'dynamo_tpu_live_workers{component="backend"} 1' in text
+            assert (
+                'dynamo_tpu_worker_kv_transfer_bulk_total'
+                '{component="backend",instance="worker-1"} 4' in text
+            )
+            assert (
+                'dynamo_tpu_worker_remote_prefills_total'
+                '{component="backend",instance="worker-1"} 5' in text
+            )
             assert (
                 'dynamo_tpu_worker_kv_usage{component="backend",instance="worker-1"} 0.25'
                 in text
