@@ -88,12 +88,33 @@ class LlamaConfig:
     query_pre_attn_scalar: Optional[float] = None
     #: Gemma2 block: extra post-attention / post-feedforward RMSNorms
     post_block_norms: bool = False
+    #: Gemma3: layer is GLOBAL iff (layer+1) % this == 0, all others are
+    #: local (the 5:1 pattern with 6). 0 = use sliding_window_every's
+    #: "every Nth layer is local" semantics instead (Gemma2/Mistral).
+    sliding_global_every: int = 0
+    #: Gemma3: LOCAL-attention layers rope with this theta (10k) while
+    #: global layers use rope_theta (1M). None = one theta everywhere.
+    rope_local_theta: Optional[float] = None
+    #: Gemma3 4B+: linear rope position scaling on GLOBAL layers only
+    #: (positions effectively divided by this factor)
+    rope_linear_factor: Optional[float] = None
     #: Qwen2-VL m-RoPE: head_dim/2 frequency slots partitioned into
     #: (temporal, height, width) sections — e.g. (16, 24, 24) for D=128.
     #: Rope positions may then be [3, B, T] (one stream per axis); plain
     #: [B, T] positions still work and equal the (p, p, p) case exactly,
     #: which is why text-only serving needs no special path.
     mrope_section: Optional[tuple[int, ...]] = None
+
+    def __post_init__(self):
+        if self.rope_local_theta is not None and not self.sliding_global_every:
+            # the per-layer theta selection keys off the global-layer
+            # period; without it the modulus is by zero (undefined under
+            # XLA) and every layer's theta would be silently arbitrary
+            raise ValueError(
+                "rope_local_theta requires sliding_global_every > 0 "
+                "(the dual-theta selection follows the Gemma3 "
+                "local/global layer pattern)"
+            )
 
     @property
     def q_per_kv(self) -> int:
@@ -234,28 +255,90 @@ class LlamaConfig:
         )
 
     @staticmethod
+    def gemma3_1b() -> "LlamaConfig":
+        """Gemma-3-1B: Gemma2 block structure minus the soft-caps, plus
+        qk-norm, 5:1 local/global layer pattern, and dual rope theta
+        (1M global / 10k local)."""
+        return LlamaConfig(
+            vocab_size=262144, hidden_size=1152, intermediate_size=6912,
+            num_layers=26, num_heads=4, num_kv_heads=1, head_dim=256,
+            rope_theta=1_000_000.0, rope_local_theta=10_000.0,
+            rms_norm_eps=1e-6, tie_word_embeddings=True,
+            hidden_act="gelu_tanh", rms_norm_unit_offset=True,
+            scale_embeddings=True, qk_norm=True, sliding_window=512,
+            sliding_global_every=6, query_pre_attn_scalar=256.0,
+            post_block_norms=True,
+        )
+
+    @staticmethod
+    def gemma3_4b_text() -> "LlamaConfig":
+        """Gemma-3-4B language model (text weights of the multimodal
+        checkpoint): 1B recipe + linear rope scaling x8 on global
+        layers."""
+        return LlamaConfig(
+            vocab_size=262208, hidden_size=2560, intermediate_size=10240,
+            num_layers=34, num_heads=8, num_kv_heads=4, head_dim=256,
+            rope_theta=1_000_000.0, rope_local_theta=10_000.0,
+            rope_linear_factor=8.0, rms_norm_eps=1e-6,
+            tie_word_embeddings=True, hidden_act="gelu_tanh",
+            rms_norm_unit_offset=True, scale_embeddings=True, qk_norm=True,
+            sliding_window=1024, sliding_global_every=6,
+            query_pre_attn_scalar=256.0, post_block_norms=True,
+        )
+
+    @staticmethod
     def from_hf_config(hf: dict) -> "LlamaConfig":
         """Map a HuggingFace `config.json` dict onto LlamaConfig (covers the
-        Llama, Qwen2 (= Llama + qkv bias), Gemma, and Gemma2 families)."""
+        Llama, Qwen2 (= Llama + qkv bias), Gemma, Gemma2, and Gemma3-text
+        families)."""
         arch = (hf.get("architectures") or ["LlamaForCausalLM"])[0]
+        gemma3 = (
+            hf.get("model_type") == "gemma3_text"
+            or arch == "Gemma3ForCausalLM"
+        )
         rope_scaling = hf.get("rope_scaling") or {}
         factor = None
-        if rope_scaling.get("rope_type", rope_scaling.get("type")) == "llama3":
-            factor = float(rope_scaling["factor"])
-        head_dim = hf.get("head_dim") or hf["hidden_size"] // hf["num_attention_heads"]
+        linear_factor = None
         rs_type = rope_scaling.get("rope_type", rope_scaling.get("type"))
-        if rope_scaling and rs_type != "llama3":
+        if rs_type == "llama3":
+            factor = float(rope_scaling["factor"])
+        elif gemma3 and rs_type == "linear":
+            linear_factor = float(rope_scaling["factor"])
+        elif rope_scaling:
             # refuse rather than run long-context positions unscaled
             # (e.g. Qwen3's recommended yarn setup for >32k)
             raise ValueError(
                 f"unsupported rope_scaling type {rs_type!r} for this "
-                "family (only llama3 NTK scaling is implemented)"
+                "family (llama3 NTK and Gemma3 linear are implemented)"
             )
+        head_dim = hf.get("head_dim") or hf["hidden_size"] // hf["num_attention_heads"]
+        global_every = 0
+        if gemma3:
+            lt = hf.get("layer_types") or []
+            global_every = (
+                lt.index("full_attention") + 1
+                if "full_attention" in lt
+                else 6
+            )
+            want = [
+                "full_attention"
+                if (i + 1) % global_every == 0
+                else "sliding_attention"
+                for i in range(len(lt))
+            ]
+            if lt and lt != want:
+                # refuse rather than run a non-periodic pattern silently
+                # wrong (only the every-Nth-global layout is implemented)
+                raise ValueError(
+                    f"unsupported gemma3 layer_types pattern {lt!r}: only "
+                    f"periodic every-{global_every}th-global is implemented"
+                )
         gemma2 = hf.get("model_type") == "gemma2" or arch == "Gemma2ForCausalLM"
         gemma = (
             hf.get("model_type") == "gemma"
             or arch == "GemmaForCausalLM"
             or gemma2
+            or gemma3
         )
         mistral = (
             hf.get("model_type") == "mistral" or arch == "MistralForCausalLM"
@@ -279,7 +362,7 @@ class LlamaConfig:
             attention_bias=bool(
                 hf.get("attention_bias", arch == "Qwen2ForCausalLM")
             ),
-            qk_norm=qwen3,
+            qk_norm=qwen3 or gemma3,
             hidden_act=hidden_act,
             rms_norm_unit_offset=gemma,
             scale_embeddings=gemma,
@@ -306,16 +389,24 @@ class LlamaConfig:
                 hf.get("final_logit_softcapping") if gemma2 else None
             ),
             sliding_window=(
-                int(hf.get("sliding_window") or 0) if (gemma2 or mistral)
+                int(hf.get("sliding_window") or 0)
+                if (gemma2 or gemma3 or mistral)
                 else 0
             ),
             sliding_window_every=2 if gemma2 else 1,
-            query_pre_attn_scalar=(
-                float(hf["query_pre_attn_scalar"])
-                if gemma2 and hf.get("query_pre_attn_scalar")
+            sliding_global_every=global_every,
+            rope_local_theta=(
+                float(hf.get("rope_local_base_freq", 10_000.0))
+                if gemma3
                 else None
             ),
-            post_block_norms=gemma2,
+            rope_linear_factor=linear_factor,
+            query_pre_attn_scalar=(
+                float(hf["query_pre_attn_scalar"])
+                if (gemma2 or gemma3) and hf.get("query_pre_attn_scalar")
+                else None
+            ),
+            post_block_norms=gemma2 or gemma3,
         )
 
 
@@ -712,11 +803,23 @@ def rms_norm(
     return (out * w).astype(x.dtype)
 
 
-def _rope_inv_freq(cfg: LlamaConfig) -> jax.Array:
+def _rope_inv_freq(
+    cfg: LlamaConfig,
+    theta: Optional[float] = None,
+    linear_factor: Optional[float] = None,
+) -> jax.Array:
+    """`theta` overrides cfg.rope_theta (Gemma3 local layers — the NTK
+    path below never applies to an override); `linear_factor` divides
+    every frequency, i.e. linear position scaling."""
     d = cfg.head_dim
+    base = cfg.rope_theta if theta is None else theta
     inv_freq = 1.0 / (
-        cfg.rope_theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d)
+        base ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d)
     )
+    if linear_factor is not None:
+        inv_freq = inv_freq / linear_factor
+    if theta is not None:
+        return inv_freq
     if cfg.rope_scaling_factor is not None:
         # Llama-3.1 NTK-by-parts scaling.
         low = cfg.rope_original_max_position / cfg.rope_low_freq_factor
@@ -732,12 +835,20 @@ def _rope_inv_freq(cfg: LlamaConfig) -> jax.Array:
     return inv_freq
 
 
-def apply_rope(x: jax.Array, positions: jax.Array, cfg: LlamaConfig) -> jax.Array:
+def apply_rope(
+    x: jax.Array,
+    positions: jax.Array,
+    cfg: LlamaConfig,
+    inv_freq: Optional[jax.Array] = None,
+) -> jax.Array:
     """x: [B, T, H, D]; positions: [B, T] absolute positions — or
     [3, B, T] m-RoPE streams (temporal, height, width) when
     cfg.mrope_section is set (Qwen2-VL; reference reaches this family
-    only through vLLM — /root/reference examples/multimodal)."""
-    inv_freq = _rope_inv_freq(cfg)
+    only through vLLM — /root/reference examples/multimodal).
+    `inv_freq` overrides the frequency table (Gemma3's per-layer-type
+    selection, attention_block)."""
+    if inv_freq is None:
+        inv_freq = _rope_inv_freq(cfg)
     if positions.ndim == 3:
         if not cfg.mrope_section:
             raise ValueError("[3,B,T] rope positions need cfg.mrope_section")
@@ -965,8 +1076,28 @@ def attention_block(
     """
     b, t = q.shape[0], q.shape[1]
     rp = positions if rope_positions is None else rope_positions
-    q = apply_rope(q, rp, cfg)
-    k = apply_rope(k, rp, cfg)
+    # Gemma3's every-Nth-layer-global predicate, shared by the rope theta
+    # selection and the window selection below (`layer` is a traced scan
+    # carry, so this is a traced scalar bool)
+    is_global = (
+        (layer + 1) % cfg.sliding_global_every == 0
+        if cfg.sliding_global_every
+        else None
+    )
+    if cfg.rope_local_theta is not None:
+        # Gemma3: global layers rope at rope_theta (with optional linear
+        # scaling), local layers at rope_local_theta — select between the
+        # two tiny [D/2] frequency tables, one rope application each.
+        inv_freq = jnp.where(
+            is_global,
+            _rope_inv_freq(cfg, linear_factor=cfg.rope_linear_factor),
+            _rope_inv_freq(cfg, theta=cfg.rope_local_theta),
+        )
+        q = apply_rope(q, rp, cfg, inv_freq=inv_freq)
+        k = apply_rope(k, rp, cfg, inv_freq=inv_freq)
+    else:
+        q = apply_rope(q, rp, cfg)
+        k = apply_rope(k, rp, cfg)
     dpad = cfg.kv_head_dim - cfg.head_dim
     if dpad:
         k = jnp.pad(k, ((0, 0), (0, 0), (0, 0), (0, dpad)))
@@ -977,10 +1108,17 @@ def attention_block(
     # the mask comparison absorbs it with no extra program variants.
     window = None
     if cfg.sliding_window:
-        window = jnp.where(
-            layer % cfg.sliding_window_every == 0,
-            jnp.int32(cfg.sliding_window), jnp.int32(1 << 30),
-        )
+        if is_global is not None:
+            # Gemma3: every Nth layer is GLOBAL, the rest are local
+            window = jnp.where(
+                is_global,
+                jnp.int32(1 << 30), jnp.int32(cfg.sliding_window),
+            )
+        else:
+            window = jnp.where(
+                layer % cfg.sliding_window_every == 0,
+                jnp.int32(cfg.sliding_window), jnp.int32(1 << 30),
+            )
     if cfg.attention_impl in ("pallas", "hybrid") and (
         cfg.sliding_window
         or cfg.attn_logit_softcap

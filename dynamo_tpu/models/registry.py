@@ -66,6 +66,10 @@ _LLAMA_PRESETS: dict[str, Callable[[], LlamaConfig]] = {
     "gemma-7b": LlamaConfig.gemma_7b,
     # Gemma2 adds sliding/global alternation, logit softcaps, post-norms.
     "gemma2-2b": LlamaConfig.gemma2_2b,
+    # Gemma3: 5:1 local/global pattern, dual rope theta, qk-norm,
+    # no softcaps (text model; the 4B+ vision tower is not served).
+    "gemma3-1b": LlamaConfig.gemma3_1b,
+    "gemma3-4b-text": LlamaConfig.gemma3_4b_text,
     # Mistral = Llama + sliding-window attention on every layer.
     "mistral-7b": LlamaConfig.mistral_7b,
     # Qwen3 = Llama + per-head q/k RMSNorm (no attention bias).
@@ -303,14 +307,16 @@ def get_model(
             or "qwen2" in arch.lower()
             or arch in (
                 "GemmaForCausalLM", "Gemma2ForCausalLM",
-                "MistralForCausalLM", "Qwen3ForCausalLM",
-                "Phi3ForCausalLM",
+                "Gemma3ForCausalLM", "MistralForCausalLM",
+                "Qwen3ForCausalLM", "Phi3ForCausalLM",
             )
             or hf.get("model_type") in (
-                "gemma", "gemma2", "mistral", "qwen3", "phi3"
+                "gemma", "gemma2", "gemma3_text", "mistral", "qwen3",
+                "phi3",
             )
-            # Gemma 3 and RecurrentGemma remain different architectures —
-            # refuse those rather than run a silently-wrong model.
+            # Multimodal Gemma3 dumps (model_type "gemma3") and
+            # RecurrentGemma remain refused rather than served
+            # silently wrong (text-only Gemma3ForCausalLM is covered).
         ):
             cfg = LlamaConfig.from_hf_config(hf)
         else:

@@ -123,15 +123,16 @@ def test_gemma_preset_serves_through_engine():
 
 
 def test_unsupported_gemma_variants_rejected(tmp_path):
-    """Gemma-2 is supported (tests/test_model_gemma2.py); Gemma-3 and
-    RecurrentGemma remain different architectures and must be refused
-    rather than run silently wrong."""
+    """Gemma-2 and Gemma-3 TEXT are supported (tests/test_model_gemma2.py,
+    test_model_gemma3.py); multimodal Gemma-3 dumps and RecurrentGemma
+    remain different architectures and must be refused rather than run
+    silently wrong."""
     import json
 
     from dynamo_tpu.models.registry import get_model
 
     for arch, mt in (
-        ("Gemma3ForCausalLM", "gemma3"),
+        ("Gemma3ForConditionalGeneration", "gemma3"),
         ("RecurrentGemmaForCausalLM", "recurrent_gemma"),
     ):
         d = tmp_path / mt
