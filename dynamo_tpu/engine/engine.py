@@ -292,6 +292,7 @@ class JaxEngine:
         mm_embeds: Optional[np.ndarray] = None,
         mm_positions: Sequence[int] = (),
     ) -> Request:
+        self._validate_bias(sampling)
         if mm_embeds is not None:
             mm_embeds = np.asarray(mm_embeds, np.float32)
             if len(mm_positions) != len(mm_embeds):
@@ -451,12 +452,17 @@ class JaxEngine:
                 pen_args = (
                     self._penalty_arrays(reqs, b_bucket, pen) if pen else ()
                 )
+                bias = self._batch_bias(reqs)
+                bias_kwargs = (
+                    self._bias_arrays(reqs, b_bucket) if bias else {}
+                )
                 fn = self._get_step_fn(
                     "prefill", b_bucket, t_bucket, greedy=all_greedy,
                     mm=any_mm, first_chunk=first_chunk, lp=lp, pen=pen,
+                    bias=bias,
                 )
-                # mm ride as keywords: the positional tail of the shared
-                # step_fn signature belongs to the penalty args.
+                # mm/bias ride as keywords: the positional tail of the
+                # shared step_fn signature belongs to the penalty args.
                 mm_kwargs = (
                     {"mm_embeds": mm_args[0], "mm_mask": mm_args[1]}
                     if any_mm
@@ -465,13 +471,13 @@ class JaxEngine:
                 if lp >= 0:
                     token_ids, lp_raw, self.kv = fn(
                         *args, self._dev(last_idx), *samp, *pen_args,
-                        **mm_kwargs
+                        **bias_kwargs, **mm_kwargs
                     )
                     lp_data = tuple(np.asarray(x) for x in lp_raw)
                 else:
                     token_ids, self.kv = fn(
                         *args, self._dev(last_idx), *samp, *pen_args,
-                        **mm_kwargs
+                        **bias_kwargs, **mm_kwargs
                     )
                 ids = np.asarray(token_ids)
             else:
@@ -591,6 +597,8 @@ class JaxEngine:
                 or s.logprobs >= 0
                 or s.frequency_penalty
                 or s.presence_penalty
+                or s.logit_bias
+                or s.min_tokens
             ):
                 return False
         return True
@@ -734,6 +742,8 @@ class JaxEngine:
         pen_args = (
             self._penalty_arrays(reqs, b_bucket, pen) if pen else ()
         )
+        bias = self._batch_bias(reqs)
+        bias_kwargs = self._bias_arrays(reqs, b_bucket) if bias else {}
         args = (
             self.params, self._dev(tokens), self._dev(positions),
             self._dev(valid), self.kv, self._dev(pt),
@@ -741,26 +751,33 @@ class JaxEngine:
         lp_data = None
         if k_steps == 1:
             fn = self._get_step_fn(
-                "decode", b_bucket, 1, greedy=all_greedy, lp=lp, pen=pen
+                "decode", b_bucket, 1, greedy=all_greedy, lp=lp, pen=pen,
+                bias=bias,
             )
             last_idx = np.zeros(b_bucket, np.int32)
             if lp >= 0:
                 token_ids, lp_data, self.kv = fn(
-                    *args, self._dev(last_idx), *samp, *pen_args
+                    *args, self._dev(last_idx), *samp, *pen_args,
+                    **bias_kwargs,
                 )
             else:
                 token_ids, self.kv = fn(
-                    *args, self._dev(last_idx), *samp, *pen_args
+                    *args, self._dev(last_idx), *samp, *pen_args,
+                    **bias_kwargs,
                 )
         else:
             fn = self._get_step_fn(
                 "decode_multi", b_bucket, k_steps, greedy=all_greedy, lp=lp,
-                pen=pen,
+                pen=pen, bias=bias,
             )
             if lp >= 0:
-                token_ids, lp_data, self.kv = fn(*args, *samp, *pen_args)
+                token_ids, lp_data, self.kv = fn(
+                    *args, *samp, *pen_args, **bias_kwargs
+                )
             else:
-                token_ids, self.kv = fn(*args, *samp, *pen_args)  # [K, B]
+                token_ids, self.kv = fn(
+                    *args, *samp, *pen_args, **bias_kwargs
+                )  # [K, B]
         ids = np.asarray(token_ids).reshape(k_steps, b_bucket)
         if lp_data is not None:
             chosen_lp = np.asarray(lp_data[0]).reshape(k_steps, b_bucket)
@@ -856,6 +873,95 @@ class JaxEngine:
             self._dev(out_toks), self._dev(out_valid),
         )
 
+    def _validate_bias(self, sampling: Optional[SamplingParams]) -> None:
+        """Reject over-limit / out-of-vocab logit_bias at admission, where
+        the runner returns the error to THIS client (a failure inside
+        step() would wedge the whole batch loop)."""
+        if sampling is None or not (sampling.logit_bias or sampling.min_tokens):
+            return
+        from dynamo_tpu.engine.sampling import BIAS_SLOTS
+
+        need = len(sampling.logit_bias or ())
+        if sampling.min_tokens > 0:
+            ban = set(sampling.stop_token_ids)
+            if not sampling.ignore_eos:
+                ban |= set(self.config.eos_token_ids)
+            need += len(ban)
+        if need > BIAS_SLOTS:
+            raise ValueError(
+                f"logit_bias entries + min_tokens eos/stop bans need "
+                f"{need} slots; at most {BIAS_SLOTS} supported"
+            )
+        v = self.adapter.vocab_size
+        for tid, _ in sampling.logit_bias or ():
+            if not 0 <= tid < v:
+                raise ValueError(
+                    f"logit_bias token id {tid} outside vocab [0,{v})"
+                )
+
+    @staticmethod
+    def _batch_bias(reqs: list[Request]) -> bool:
+        """Program-variant selector for the sparse logit-bias/min_tokens
+        path (sampling.apply_logit_bias)."""
+        return any(
+            r.sampling.logit_bias or r.sampling.min_tokens for r in reqs
+        )
+
+    def _bias_row(self, req: Request):
+        """Per-request packed bias slots, computed once and cached on the
+        request — the rows are invariant for its lifetime (only the
+        counters vary per step, and those ride the sampling arrays)."""
+        row = getattr(req, "_bias_row", None)
+        if row is not None:
+            return row
+        from dynamo_tpu.engine.sampling import BIAS_SLOTS
+
+        ids = np.zeros(BIAS_SLOTS, np.int32)
+        vals = np.zeros(BIAS_SLOTS, np.float32)
+        gated = np.zeros(BIAS_SLOTS, bool)
+        s = req.sampling
+        slot = 0
+        for tid, bv in s.logit_bias or ():
+            ids[slot] = tid
+            vals[slot] = bv
+            slot += 1
+        if s.min_tokens > 0:
+            ban = set(s.stop_token_ids)
+            if not s.ignore_eos:
+                ban |= set(self.config.eos_token_ids)
+            for tid in sorted(ban):
+                if slot >= BIAS_SLOTS:
+                    break  # bounded at admission; belt and braces
+                ids[slot] = tid
+                vals[slot] = -1e30
+                gated[slot] = True
+                slot += 1
+        row = (ids, vals, gated, s.min_tokens)
+        req._bias_row = row
+        return row
+
+    def _bias_arrays(self, reqs: list[Request], pad_to: int) -> dict:
+        """kwargs for the bias program variants: user logit_bias entries
+        plus min_tokens' gated eos/stop bans packed into BIAS_SLOTS."""
+        from dynamo_tpu.engine.sampling import BIAS_SLOTS
+
+        ids = np.zeros((pad_to, BIAS_SLOTS), np.int32)
+        vals = np.zeros((pad_to, BIAS_SLOTS), np.float32)
+        gated = np.zeros((pad_to, BIAS_SLOTS), bool)
+        mins = np.zeros(pad_to, np.int32)
+        for i, r in enumerate(reqs):
+            row_ids, row_vals, row_gated, row_min = self._bias_row(r)
+            ids[i] = row_ids
+            vals[i] = row_vals
+            gated[i] = row_gated
+            mins[i] = row_min
+        return {
+            "bias_ids": self._dev(ids),
+            "bias_vals": self._dev(vals),
+            "bias_gated": self._dev(gated),
+            "min_toks": self._dev(mins),
+        }
+
     def _sampling_arrays(self, reqs: list[Request], pad_to: Optional[int] = None):
         """Returns ((temps, top_ps, top_ks, seeds, counters), all_greedy).
         all_greedy selects the argmax-only program variant — temperature-0
@@ -897,9 +1003,9 @@ class JaxEngine:
     def _get_step_fn(
         self, kind: str, b: int, t: int, greedy: bool = False,
         mm: bool = False, first_chunk: bool = False, lp: int = -1,
-        pen: int = 0,
+        pen: int = 0, bias: bool = False,
     ) -> Callable:
-        cache_key = (kind, b, t, greedy, mm, first_chunk, lp, pen)
+        cache_key = (kind, b, t, greedy, mm, first_chunk, lp, pen, bias)
         fn = self._jit_cache.get(cache_key)
         if fn is not None:
             return fn
@@ -926,14 +1032,25 @@ class JaxEngine:
 
             return token_logprobs(logits, ids, lp)
 
-        def pick(logits, samp_args, counts=None, freq=None, pres=None):
-            """Sample ids [B] from (possibly penalty-adjusted) logits;
-            logprob reporting reads the raw logits separately."""
+        def pick(logits, samp_args, counts=None, freq=None, pres=None,
+                 bias_args=None):
+            """Sample ids [B] from (possibly penalty/bias-adjusted)
+            logits; logprob reporting reads the raw logits separately.
+            bias_args = (bias_ids, bias_vals, bias_gated, min_toks); the
+            min-token gating reads the CURRENT counters from samp_args,
+            so fused-scan steps gate correctly as the count advances."""
             eff = logits
             if counts is not None:
                 from dynamo_tpu.engine.sampling import apply_penalties
 
                 eff = apply_penalties(logits, counts, freq, pres)
+            if bias_args is not None:
+                from dynamo_tpu.engine.sampling import apply_logit_bias
+
+                b_ids, b_vals, b_gated, b_min = bias_args
+                eff = apply_logit_bias(
+                    eff, b_ids, b_vals, b_gated, samp_args[4], b_min
+                )
             if greedy:
                 ids = sample_greedy(eff)
             else:
@@ -963,7 +1080,9 @@ class JaxEngine:
 
             def multi_fn(params, tokens, positions, valid, kv, pt,
                          temps, top_ps, top_ks, seeds, counters,
-                         freq=None, pres=None, out_toks=None, out_valid=None):
+                         freq=None, pres=None, out_toks=None, out_valid=None,
+                         bias_ids=None, bias_vals=None, bias_gated=None,
+                         min_toks=None):
                 if pen:
                     from dynamo_tpu.engine.sampling import build_output_counts
 
@@ -982,6 +1101,11 @@ class JaxEngine:
                     ids = pick(
                         logits, (temps, top_ps, top_ks, seeds, counters),
                         counts=counts if pen else None, freq=freq, pres=pres,
+                        bias_args=(
+                            (bias_ids, bias_vals, bias_gated, min_toks)
+                            if bias
+                            else None
+                        ),
                     )
                     if pen:
                         # Each fused step extends the history it penalizes.
@@ -1046,7 +1170,8 @@ class JaxEngine:
         def step_fn(params, tokens, positions, valid, kv, pt, last_idx,
                     temps, top_ps, top_ks, seeds, counters,
                     freq=None, pres=None, out_toks=None, out_valid=None,
-                    mm_embeds=None, mm_mask=None):
+                    bias_ids=None, bias_vals=None, bias_gated=None,
+                    min_toks=None, mm_embeds=None, mm_mask=None):
             hidden, kv = adapter.forward_hidden(
                 params, tokens, positions, valid, kv, pt,
                 mm_embeds=mm_embeds, mm_mask=mm_mask,
@@ -1065,6 +1190,11 @@ class JaxEngine:
             ids = pick(
                 logits, (temps, top_ps, top_ks, seeds, counters),
                 counts=counts, freq=freq, pres=pres,
+                bias_args=(
+                    (bias_ids, bias_vals, bias_gated, min_toks)
+                    if bias
+                    else None
+                ),
             )
             if lp >= 0:
                 return rep(ids), rep(maybe_logprobs(logits, ids)), kv
@@ -1469,6 +1599,7 @@ class JaxEngine:
         """Decode-side page reservation: allocate the prompt's pages (plus
         one-token headroom) now so a prefill worker can write into them.
         Returns None when the pool can't take it (caller falls back local)."""
+        self._validate_bias(sampling)
         ps = self.config.page_size
         need = -(-(len(prompt_tokens) + 1) // ps)
         pages = self.allocator.allocate(need)

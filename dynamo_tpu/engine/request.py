@@ -28,6 +28,14 @@ class SamplingParams:
     #: OpenAI penalties over the output-token history (0 = off)
     frequency_penalty: float = 0.0
     presence_penalty: float = 0.0
+    #: OpenAI logit_bias: additive per-token-id biases applied in the
+    #: sampler (before temperature). Bounded by sampling.BIAS_SLOTS
+    #: minus the min_tokens ban slots.
+    logit_bias: tuple[tuple[int, float], ...] = ()
+    #: suppress eos/stop-token finishes until this many output tokens
+    #: (reference: protocols/common.rs min_tokens) — implemented as
+    #: sampler-level bans, so the banned ids are never emitted
+    min_tokens: int = 0
 
 
 class FinishReason(str, enum.Enum):

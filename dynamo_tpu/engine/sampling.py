@@ -53,6 +53,31 @@ def apply_penalties(
     )
 
 
+#: static per-row sparse logit-bias slots (OpenAI logit_bias entries +
+#: min_tokens eos/stop bans share them); requests needing more are
+#: rejected at the API boundary
+BIAS_SLOTS = 16
+
+
+def apply_logit_bias(
+    logits: jax.Array,  # [B, V] f32
+    bias_ids: jax.Array,  # [B, K] i32 token ids (0-padded)
+    bias_vals: jax.Array,  # [B, K] f32 additive biases (0 = no-op)
+    bias_gated: jax.Array,  # [B, K] bool — active only before min_tokens
+    counters: jax.Array,  # [B] i32 output-token counter
+    min_toks: jax.Array,  # [B] i32 min_tokens per request
+) -> jax.Array:
+    """Sparse additive logit bias (OpenAI `logit_bias`), with slots that
+    can be GATED on the output count — min_tokens is implemented as
+    gated -inf entries on the eos/stop ids, lifted once `counters`
+    reaches the request's minimum. Zero-valued padding slots scatter-add
+    nothing, so bias-free rows are exact no-ops."""
+    active = (~bias_gated) | (counters < min_toks)[:, None]
+    vals = jnp.where(active, bias_vals, 0.0)
+    rows = jnp.arange(logits.shape[0])[:, None]
+    return logits.at[rows, bias_ids].add(vals)
+
+
 def sample(
     logits: jax.Array,  # [B, V] f32
     temperature: jax.Array,  # [B] f32 (<=0 => greedy)

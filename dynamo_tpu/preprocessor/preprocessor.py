@@ -50,6 +50,10 @@ class PreprocessedRequest:
     logprobs: int = -1
     frequency_penalty: float = 0.0
     presence_penalty: float = 0.0
+    #: OpenAI logit_bias as [[token_id, bias], ...] (validated/clamped)
+    logit_bias: list = field(default_factory=list)
+    #: eos/stop suppression floor (ext.min_tokens)
+    min_tokens: int = 0
     annotations: dict[str, Any] = field(default_factory=dict)
     #: multimodal: projected image embeddings [n, H] f32 (numpy) spliced at
     #: mm_positions (absolute prompt indices of the placeholder tokens)
@@ -71,6 +75,8 @@ class PreprocessedRequest:
             "logprobs": self.logprobs,
             "frequency_penalty": self.frequency_penalty,
             "presence_penalty": self.presence_penalty,
+            "logit_bias": self.logit_bias,
+            "min_tokens": self.min_tokens,
             "annotations": self.annotations,
         }
         if self.mm_embeds is not None:
@@ -93,6 +99,31 @@ class PreprocessedRequest:
 
             pre.mm_embeds = np.frombuffer(raw, np.float32).reshape(shape)
         return pre
+
+
+def _logit_bias_list(raw) -> list:
+    """OpenAI logit_bias dict (JSON string or int keys) -> validated
+    [[token_id, bias], ...]. Values clamp to [-100, 100] (OpenAI's
+    documented range); non-integer keys are a 400, like the reference's
+    validate_logit_bias (protocols/openai/validate.rs)."""
+    if not raw:
+        return []
+    from dynamo_tpu.engine.sampling import BIAS_SLOTS
+
+    if len(raw) > BIAS_SLOTS:
+        raise ValueError(
+            f"logit_bias supports at most {BIAS_SLOTS} entries; got {len(raw)}"
+        )
+    out = []
+    for k, v in raw.items():
+        try:
+            tid = int(k)
+        except (TypeError, ValueError):
+            raise ValueError(f"logit_bias keys must be token ids; got {k!r}")
+        if tid < 0:
+            raise ValueError(f"logit_bias token id must be >= 0; got {tid}")
+        out.append([tid, max(-100.0, min(100.0, float(v)))])
+    return out
 
 
 def _stop_list(stop) -> list[str]:
@@ -188,6 +219,7 @@ class OpenAIPreprocessor:
             logprobs=_chat_logprobs(request),
             frequency_penalty=request.frequency_penalty or 0.0,
             presence_penalty=request.presence_penalty or 0.0,
+            logit_bias=_logit_bias_list(request.logit_bias),
         )
         pre.mm_embeds = mm_embeds
         pre.mm_positions = mm_positions
@@ -271,13 +303,17 @@ class OpenAIPreprocessor:
             logprobs=_completion_logprobs(request),
             frequency_penalty=request.frequency_penalty or 0.0,
             presence_penalty=request.presence_penalty or 0.0,
+            logit_bias=_logit_bias_list(request.logit_bias),
         )
 
     def _common(
         self, prompt_ids, max_tokens, temperature, top_p, top_k, seed, stop,
         ext, logprobs: int = -1, frequency_penalty: float = 0.0,
-        presence_penalty: float = 0.0,
+        presence_penalty: float = 0.0, logit_bias=None,
     ) -> PreprocessedRequest:
+        min_tokens = int(ext.min_tokens or 0) if ext else 0
+        if min_tokens < 0:
+            raise ValueError(f"min_tokens must be >= 0; got {min_tokens}")
         return PreprocessedRequest(
             request_id=new_request_id(),
             token_ids=prompt_ids,
@@ -292,6 +328,8 @@ class OpenAIPreprocessor:
             logprobs=logprobs,
             frequency_penalty=frequency_penalty or 0.0,
             presence_penalty=presence_penalty or 0.0,
+            logit_bias=logit_bias or [],
+            min_tokens=min_tokens,
             annotations=(ext.annotations or {}) if ext else {},
         )
 

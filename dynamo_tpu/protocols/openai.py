@@ -24,6 +24,9 @@ class Ext(BaseModel):
     annotations: Optional[dict[str, Any]] = None
     #: greedy-route this request to a specific worker instance
     instance_id: Optional[str] = None
+    #: suppress eos/stop-token finishes until this many output tokens
+    #: (the reference's common-protocol min_tokens)
+    min_tokens: Optional[int] = None
 
 
 class ChatMessage(BaseModel):
@@ -53,6 +56,9 @@ class ChatCompletionRequest(BaseModel):
     presence_penalty: Optional[float] = None
     logprobs: Optional[bool] = None
     top_logprobs: Optional[int] = None  # 0-20 alternatives when logprobs=true
+    #: OpenAI logit_bias: token id (JSON string or int) -> bias in
+    #: [-100, 100], applied in the sampler
+    logit_bias: Optional[dict[Union[int, str], float]] = None
     #: OpenAI function-calling tool definitions. Rendered into the chat
     #: template (HF templates accept `tools`) so tool-trained models see
     #: them; the engine does not parse tool_call outputs (pass-through,
@@ -84,6 +90,7 @@ class CompletionRequest(BaseModel):
     seed: Optional[int] = None
     echo: Optional[bool] = False
     logprobs: Optional[int] = None  # legacy: N => chosen + top-N per token
+    logit_bias: Optional[dict[Union[int, str], float]] = None
     frequency_penalty: Optional[float] = None
     presence_penalty: Optional[float] = None
     ext: Optional[Ext] = None

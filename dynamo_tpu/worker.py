@@ -452,6 +452,12 @@ class Worker:
         # arrays must align with the emitted tokens from the first one.
         if pre.logprobs >= 0:
             return False
+        # logit_bias / min_tokens requests prefill locally: the remote
+        # wire's sampling dict doesn't carry them, so the prefill worker
+        # would sample the FIRST token unbiased (min_tokens could even
+        # end the request on an un-banned eos).
+        if getattr(pre, "logit_bias", None) or getattr(pre, "min_tokens", 0):
+            return False
         # Cheap local short-circuit: uncached length can't exceed prompt
         # length, so short prompts never qualify — skip the engine-thread
         # and fabric round-trips entirely.

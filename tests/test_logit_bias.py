@@ -1,0 +1,198 @@
+"""OpenAI logit_bias + min_tokens: sampler-level sparse biases and
+min-token-gated eos/stop bans (reference validates logit_bias in
+protocols/openai/validate.rs and carries min_tokens in common.rs)."""
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.engine import EngineConfig
+from dynamo_tpu.engine.engine import JaxEngine
+from dynamo_tpu.engine.request import SamplingParams
+
+
+def _cfg(**kw):
+    base = dict(
+        model="tiny", num_pages=64, page_size=4, max_pages_per_seq=8,
+        decode_buckets=(1, 2, 4), prefill_chunk=16, max_seqs=4,
+        dtype="float32",
+    )
+    base.update(kw)
+    return EngineConfig(**base)
+
+
+PROMPT = [5, 17, 42, 9, 3, 8]
+
+
+@pytest.mark.parametrize("decode_steps", [1, 8])
+def test_logit_bias_forces_token(decode_steps):
+    """A +1000 bias on one token makes greedy emit it every step, on both
+    the single-step and fused decode paths (and the prefill first
+    token)."""
+    eng = JaxEngine(_cfg(decode_steps=decode_steps))
+    eng.add_request(
+        "b", PROMPT,
+        SamplingParams(
+            temperature=0.0, max_tokens=6, ignore_eos=True,
+            logit_bias=((77, 1000.0),),
+        ),
+    )
+    out = eng.run_to_completion()["b"]
+    assert out == [77] * 6
+
+
+def test_logit_bias_ban_changes_output():
+    """Banning greedy's natural first choice (-1000) must change it."""
+    eng = JaxEngine(_cfg())
+    eng.add_request(
+        "ref", PROMPT, SamplingParams(temperature=0.0, max_tokens=1,
+                                      ignore_eos=True)
+    )
+    first = eng.run_to_completion()["ref"][0]
+
+    eng2 = JaxEngine(_cfg())
+    eng2.add_request(
+        "ban", PROMPT,
+        SamplingParams(
+            temperature=0.0, max_tokens=1, ignore_eos=True,
+            logit_bias=((first, -1000.0),),
+        ),
+    )
+    banned = eng2.run_to_completion()["ban"][0]
+    assert banned != first
+
+    # and a bias-free request sharing no state is unaffected
+    eng3 = JaxEngine(_cfg())
+    eng3.add_request(
+        "plain", PROMPT, SamplingParams(temperature=0.0, max_tokens=1,
+                                        ignore_eos=True)
+    )
+    assert eng3.run_to_completion()["plain"][0] == first
+
+
+@pytest.mark.parametrize("decode_steps", [1, 8])
+def test_min_tokens_suppresses_stop(decode_steps):
+    """A stop token that would fire immediately is banned until
+    min_tokens output tokens exist — then allowed again."""
+    eng = JaxEngine(_cfg(decode_steps=decode_steps))
+    eng.add_request(
+        "ref", PROMPT, SamplingParams(temperature=0.0, max_tokens=8,
+                                      ignore_eos=True)
+    )
+    ref = eng.run_to_completion()["ref"]
+    stop = ref[0]  # greedy's first choice, used as the stop token
+
+    eng2 = JaxEngine(_cfg(decode_steps=decode_steps))
+    eng2.add_request(
+        "short", PROMPT,
+        SamplingParams(temperature=0.0, max_tokens=8,
+                       stop_token_ids=(stop,)),
+    )
+    short = eng2.run_to_completion()["short"]
+    assert len(short) == 1 and short[0] == stop  # stops immediately
+
+    eng3 = JaxEngine(_cfg(decode_steps=decode_steps))
+    eng3.add_request(
+        "min", PROMPT,
+        SamplingParams(temperature=0.0, max_tokens=8,
+                       stop_token_ids=(stop,), min_tokens=4),
+    )
+    got = eng3.run_to_completion()["min"]
+    assert len(got) >= 4
+    assert stop not in got[:4]  # banned while under the minimum
+
+
+def test_bias_slot_overflow_rejected():
+    from dynamo_tpu.engine.sampling import BIAS_SLOTS
+
+    eng = JaxEngine(_cfg())
+    with pytest.raises(ValueError, match="slots"):
+        eng.add_request(
+            "x", PROMPT,
+            SamplingParams(
+                logit_bias=tuple((i, 1.0) for i in range(BIAS_SLOTS + 1)),
+            ),
+        )
+    with pytest.raises(ValueError, match="vocab"):
+        eng.add_request(
+            "y", PROMPT, SamplingParams(logit_bias=((99999, 1.0),)),
+        )
+
+
+# -- HTTP API surface --------------------------------------------------------
+
+
+def test_http_logit_bias_and_min_tokens():
+    """OpenAI logit_bias (string keys, clamped) + ext.min_tokens through
+    the real HTTP frontend into the jitted sampler."""
+    import asyncio
+
+    import aiohttp
+
+    from dynamo_tpu.engine.async_engine import AsyncEngineRunner
+    from dynamo_tpu.frontend import HttpService, ModelManager
+    from dynamo_tpu.frontend.service import local_pipeline
+    from dynamo_tpu.model_card import ModelDeploymentCard
+
+    async def main():
+        engine = JaxEngine(EngineConfig.for_tests())
+        runner = AsyncEngineRunner(engine)
+        runner.start()
+        card = ModelDeploymentCard(
+            name="tiny", tokenizer={"kind": "byte"}, context_length=32
+        )
+        manager = ModelManager()
+        manager.add("tiny", local_pipeline(card, runner))
+        svc = HttpService(manager, host="127.0.0.1", port=0)
+        await svc.start()
+        base = f"http://127.0.0.1:{svc.port}"
+        try:
+            async with aiohttp.ClientSession() as s:
+                # +100 bias on byte 'Z' (90) forces greedy onto it
+                async with s.post(
+                    f"{base}/v1/chat/completions",
+                    json={
+                        "model": "tiny",
+                        "messages": [{"role": "user", "content": "ab"}],
+                        "max_tokens": 4,
+                        "temperature": 0,
+                        "logit_bias": {"90": 100},
+                        "ext": {"ignore_eos": True},
+                    },
+                ) as r:
+                    assert r.status == 200, await r.text()
+                    data = await r.json()
+                assert data["choices"][0]["message"]["content"] == "ZZZZ"
+
+                # non-integer key is a 400, like the reference's
+                # validate_logit_bias
+                async with s.post(
+                    f"{base}/v1/chat/completions",
+                    json={
+                        "model": "tiny",
+                        "messages": [{"role": "user", "content": "ab"}],
+                        "logit_bias": {"not-a-token": 1},
+                    },
+                ) as r:
+                    assert r.status == 400
+
+                # min_tokens floors the output even when the model would
+                # stop (bias eos-ish behavior indirectly: just assert the
+                # completion reaches the floor)
+                async with s.post(
+                    f"{base}/v1/completions",
+                    json={
+                        "model": "tiny",
+                        "prompt": "ab",
+                        "max_tokens": 6,
+                        "temperature": 0,
+                        "ext": {"min_tokens": 6, "ignore_eos": False},
+                    },
+                ) as r:
+                    assert r.status == 200, await r.text()
+                    data = await r.json()
+                assert data["usage"]["completion_tokens"] == 6
+        finally:
+            runner.stop()
+            await svc.stop()
+
+    asyncio.run(main())
