@@ -35,6 +35,7 @@ def test_engine_fuzz_bounded(model, rounds):
         eng = JaxEngine(cfg)
         n = rng.randrange(2, 9)
         greedy_cases = {}
+        bias_cases = {}
         out: dict[str, list[int]] = {}
         for i in range(n):
             rid = f"f{rnd}_{i}"
@@ -57,11 +58,29 @@ def test_engine_fuzz_bounded(model, rounds):
                     temperature=0.0, max_tokens=rng.randrange(1, 8),
                     logprobs=rng.choice([0, 2]),
                 )
-            else:
+            elif style < 0.93:
                 samp = SamplingParams(
                     temperature=0.0, max_tokens=rng.randrange(1, 8),
                     frequency_penalty=rng.choice([0.5, 30.0]),
                 )
+            else:
+                # logit_bias / min_tokens: gated sampler bans must hold
+                # through preemption, fused steps, and speculation
+                # fallback. The +large bias makes output predictable
+                # enough for the <=16 bound; min_tokens with a stop
+                # token the bias would otherwise force immediately.
+                bias_tok = rng.randrange(1, 250)
+                mt = rng.randrange(2, 8)
+                min_t = rng.choice([0, mt - 1])
+                samp = SamplingParams(
+                    temperature=0.0, max_tokens=mt,
+                    logit_bias=((bias_tok, 1000.0),),
+                    stop_token_ids=(bias_tok,),
+                    min_tokens=min_t,
+                )
+                # deterministic: the ban holds for min_t tokens, then the
+                # bias forces bias_tok which stops the request
+                bias_cases[rid] = (bias_tok, min_t + 1)
             eng.add_request(rid, prompt, samp)
             # Random mid-flight abort. The interleaved step's outputs may
             # carry other requests' tokens — collect them.
@@ -70,6 +89,7 @@ def test_engine_fuzz_bounded(model, rounds):
                     out.setdefault(o.request_id, []).extend(o.new_token_ids)
                 eng.abort_request(rid)
                 greedy_cases.pop(rid, None)
+                bias_cases.pop(rid, None)
                 out.pop(rid, None)
         steps = 0
         while eng.has_work:
@@ -79,6 +99,15 @@ def test_engine_fuzz_bounded(model, rounds):
                 out.setdefault(o.request_id, []).extend(o.new_token_ids)
         for rid, toks in out.items():
             assert len(toks) <= 16, (rid, toks)
+        # logit_bias/min_tokens invariant: the gated ban holds for exactly
+        # min_tokens outputs, then the bias forces the stop token (shorter
+        # only via context-limit dooming, never via a leaked ban)
+        for rid, (bias_tok, expect) in bias_cases.items():
+            got = out.get(rid, [])
+            assert 1 <= len(got) <= expect, (rid, got, expect)
+            if len(got) == expect:
+                assert got[-1] == bias_tok, (rid, got, bias_tok)
+                assert bias_tok not in got[:-1], (rid, got, bias_tok)
         # Greedy byte-equivalence vs the roomy reference engine: pressure,
         # speculation, tiering, and chunking must never change tokens.
         for rid, (prompt, mt) in greedy_cases.items():
