@@ -242,3 +242,79 @@ def test_multimodal_graph_qwen2vl_end_to_end():
             await handle.stop()
 
     asyncio.run(run())
+
+
+def test_qwen2vl_with_host_kv_offload():
+    """BASELINE config 5's pipeline shape: a Qwen2-VL (m-RoPE) model
+    serving image traffic INTERLEAVED with multi-turn text whose KV
+    offloads to the host tier and onboards byte-exact. Image prompts
+    bypass the prefix cache by design (placeholder ids don't identify
+    pixels); the text turns around them exercise offload/onboard on the
+    same engine."""
+    import jax
+
+    from dynamo_tpu.engine import EngineConfig
+    from dynamo_tpu.engine.engine import JaxEngine
+    from dynamo_tpu.engine.request import SamplingParams
+    from dynamo_tpu.kvbm import TieredPageAllocator
+    from dynamo_tpu.models import qwen2vl
+
+    cfg = EngineConfig(
+        model="qwen2-vl-tiny", num_pages=10, page_size=4,
+        max_pages_per_seq=8, decode_buckets=(1, 2, 4), prefill_chunk=16,
+        max_seqs=2, dtype="float32", enable_prefix_caching=True,
+        host_kv_cache_bytes=1 << 20,
+    )
+    eng = JaxEngine(cfg)
+    assert isinstance(eng.allocator, TieredPageAllocator)
+
+    def run(e, rid, prompt, n=4, **kw):
+        e.add_request(
+            rid, prompt, SamplingParams(temperature=0.0, max_tokens=n), **kw
+        )
+        return e.run_to_completion()[rid]
+
+    def image_req(e, rid, seed):
+        """A 2-merged-token image prompt through the vision tower."""
+        vcfg = qwen2vl.Qwen2VLVisionConfig.tiny(hidden_size=64)
+        vparams = qwen2vl.init_vision_params(jax.random.key(seed), vcfg)
+        pixels = np.random.default_rng(seed).normal(
+            size=(1, 16, 8, 3)
+        ).astype(np.float32)
+        patches, grids = qwen2vl.pixels_to_patches(pixels, vcfg)
+        embeds = np.asarray(
+            qwen2vl.vision_forward(vparams, vcfg, patches, grids), np.float32
+        )
+        prompt = [5, 9, 0, 0, 17, 3]
+        return run(
+            e, rid, prompt, mm_embeds=embeds, mm_positions=[2, 3]
+        )
+
+    rng = np.random.default_rng(0)
+    text_a = [int(x) for x in rng.integers(1, 200, 8)]
+    import dataclasses
+
+    expected = run(
+        JaxEngine(dataclasses.replace(cfg, host_kv_cache_bytes=0)),
+        "ref", text_a,
+    )
+
+    assert run(eng, "a", text_a) == expected
+    img_first = image_req(eng, "img0", seed=1)
+    assert len(img_first) == 4
+
+    # churn (incl. image requests) until A's pages offload to the host
+    i = 0
+    while eng.allocator.stats.offloaded_blocks == 0 and i < 12:
+        run(eng, f"churn{i}", [int(x) for x in rng.integers(200, 255, 20)], n=2)
+        if i % 2 == 0:
+            image_req(eng, f"imgc{i}", seed=10 + i)
+        i += 1
+    assert eng.allocator.stats.offloaded_blocks > 0
+    assert len(eng.allocator.host) > 0
+
+    # text A onboards byte-exact; a repeated image gives identical tokens
+    # (deterministic splice) without touching the prefix cache
+    assert run(eng, "a2", text_a) == expected
+    assert eng.allocator.stats.onboarded_blocks > 0
+    assert image_req(eng, "img1", seed=1) == img_first
