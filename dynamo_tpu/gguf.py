@@ -345,13 +345,55 @@ class GgufFile:
             key("vocab_size", len(vocab) if vocab else 32000)
         )
         rope_scale = key("rope.scaling.factor")
+        gemma = arch in ("gemma", "gemma2", "gemma3")
+        n_layers = int(key("block_count", 32))
+        gemma_kw = {}
+        if gemma:
+            # llama.cpp's converter folds Gemma's (1+w) norm offset INTO
+            # the stored norm tensors, so the config must NOT add the
+            # unit offset again; embeddings scale at runtime as usual.
+            gemma_kw = dict(
+                hidden_act="gelu_tanh",
+                rms_norm_unit_offset=False,
+                scale_embeddings=True,
+                tie_word_embeddings=True,
+                post_block_norms=(arch in ("gemma2", "gemma3")),
+                sliding_window=int(key("attention.sliding_window", 0) or 0),
+            )
+            # GGUF metadata carries no query_pre_attn_scalar key; the
+            # 27B-class checkpoints are the only ones where it differs
+            # from head_dim (gemma2-27B: 4608/32=144 at 46 layers;
+            # gemma3-27B: 5376/32=168 at 62 layers). llama.cpp
+            # special-cases them by model type the same way.
+            if (arch == "gemma2" and n_layers == 46) or (
+                arch == "gemma3" and n_layers == 62
+            ):
+                gemma_kw["query_pre_attn_scalar"] = float(embed / n_heads)
+            if arch == "gemma2":
+                sc = key("attn_logit_softcapping")
+                fc = key("final_logit_softcapping")
+                gemma_kw.update(
+                    attn_logit_softcap=float(sc) if sc else None,
+                    final_logit_softcap=float(fc) if fc else None,
+                    sliding_window_every=2,
+                )
+            if arch == "gemma3":
+                local = key("rope.local.freq_base", 10_000.0)
+                gemma_kw.update(
+                    sliding_global_every=6,  # llama.cpp hardcodes 5:1 too
+                    rope_local_theta=float(local),
+                    rope_linear_factor=(
+                        float(rope_scale) if rope_scale else None
+                    ),
+                )
+                rope_scale = None  # consumed as the linear factor
         return LlamaConfig(
             attention_bias=(arch == "qwen2"),
-            qk_norm=(arch == "qwen3"),
+            qk_norm=arch in ("qwen3", "gemma3"),
             vocab_size=vocab_size,
             hidden_size=embed,
             intermediate_size=int(key("feed_forward_length", 4 * embed)),
-            num_layers=int(key("block_count", 32)),
+            num_layers=n_layers,
             num_heads=n_heads,
             num_kv_heads=int(key("attention.head_count_kv", n_heads)),
             head_dim=head_dim,
@@ -362,6 +404,7 @@ class GgufFile:
             rope_scaling_factor=(
                 float(rope_scale) if rope_scale is not None else None
             ),
+            **gemma_kw,
         )
 
     def context_length(self) -> int:

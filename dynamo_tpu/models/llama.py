@@ -652,12 +652,19 @@ def params_from_gguf(gguf_file, cfg: LlamaConfig) -> dict:
         params["layers"]["bq"] = stack("blk.{}.attn_q.bias", transpose=False)
         params["layers"]["bk"] = stack("blk.{}.attn_k.bias", transpose=False)
         params["layers"]["bv"] = stack("blk.{}.attn_v.bias", transpose=False)
-    if cfg.qk_norm:  # qwen3-family GGUFs carry per-head q/k norms
+    if cfg.qk_norm:  # qwen3/gemma3-family GGUFs carry per-head q/k norms
         params["layers"]["q_norm"] = stack(
             "blk.{}.attn_q_norm.weight", transpose=False
         )
         params["layers"]["k_norm"] = stack(
             "blk.{}.attn_k_norm.weight", transpose=False
+        )
+    if cfg.post_block_norms:  # gemma2/3 sandwich norms
+        params["layers"]["post_attn_norm"] = stack(
+            "blk.{}.post_attention_norm.weight", transpose=False
+        )
+        params["layers"]["post_mlp_norm"] = stack(
+            "blk.{}.post_ffw_norm.weight", transpose=False
         )
     if "output.weight" in g.tensors:
         params["lm_head"] = jnp.asarray(t("output.weight"), cfg.dtype)

@@ -269,7 +269,8 @@ def get_model(
 
         g = read_gguf(name)
         arch = g.architecture()
-        if arch not in ("llama", "qwen2", "qwen3"):
+        if arch not in ("llama", "qwen2", "qwen3", "gemma", "gemma2",
+                        "gemma3"):
             raise ValueError(
                 f"unsupported GGUF architecture {arch!r} for {name}"
             )

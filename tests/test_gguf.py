@@ -371,3 +371,126 @@ def test_gguf_qwen3_qk_norms_load(tmp_path):
                    jnp.ones((1, 8), bool), kv2, jnp.asarray(pts))
     np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5,
                                atol=1e-5)
+
+
+def test_gemma3_gguf_roundtrip_equivalence(tmp_path):
+    """Gemma-3 GGUFs serve correctly under llama.cpp's conventions: norm
+    tensors store (1+w) folded in (so the config clears the unit offset),
+    q/k norms + sandwich norms load, the head is tied, and the dual-theta
+    sliding config maps from the gemma3.* metadata — logits must equal
+    the HF-convention engine's exactly."""
+    import jax
+    import jax.numpy as jnp
+
+    from dynamo_tpu.models.llama import (
+        LlamaConfig,
+        forward,
+        init_kv_pages,
+        init_params,
+        params_from_gguf,
+    )
+
+    cfg_hf = LlamaConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_layers=6, num_heads=4, num_kv_heads=2, head_dim=16,
+        rope_theta=1_000_000.0, rope_local_theta=10_000.0,
+        rope_linear_factor=8.0, rms_norm_eps=1e-6,
+        tie_word_embeddings=True, hidden_act="gelu_tanh",
+        rms_norm_unit_offset=True, scale_embeddings=True, qk_norm=True,
+        sliding_window=8, sliding_global_every=6,
+        post_block_norms=True, dtype=jnp.float32,
+    )
+    P = init_params(jax.random.key(3), cfg_hf)
+
+    def fold(x):  # llama.cpp stores gemma norms as (1 + w)
+        return np.asarray(x, np.float32) + 1.0
+
+    L = cfg_hf.num_layers
+    lp = P["layers"]
+    tensors = {
+        "token_embd.weight": np.asarray(P["embed"], np.float32),
+        "output_norm.weight": fold(P["final_norm"]),
+    }
+    for l in range(L):
+        tensors[f"blk.{l}.attn_norm.weight"] = fold(lp["attn_norm"][l])
+        tensors[f"blk.{l}.ffn_norm.weight"] = fold(lp["mlp_norm"][l])
+        tensors[f"blk.{l}.post_attention_norm.weight"] = fold(
+            lp["post_attn_norm"][l]
+        )
+        tensors[f"blk.{l}.post_ffw_norm.weight"] = fold(lp["post_mlp_norm"][l])
+        tensors[f"blk.{l}.attn_q_norm.weight"] = fold(lp["q_norm"][l])
+        tensors[f"blk.{l}.attn_k_norm.weight"] = fold(lp["k_norm"][l])
+        for ours, theirs in (
+            ("wq", "attn_q"), ("wk", "attn_k"), ("wv", "attn_v"),
+            ("wo", "attn_output"), ("w_gate", "ffn_gate"),
+            ("w_up", "ffn_up"), ("w_down", "ffn_down"),
+        ):
+            tensors[f"blk.{l}.{theirs}.weight"] = np.asarray(
+                lp[ours][l], np.float32
+            ).T  # GGUF stores [out, in]
+    md = {
+        "general.architecture": "gemma3",
+        "gemma3.block_count": L,
+        "gemma3.embedding_length": cfg_hf.hidden_size,
+        "gemma3.feed_forward_length": cfg_hf.intermediate_size,
+        "gemma3.attention.head_count": cfg_hf.num_heads,
+        "gemma3.attention.head_count_kv": cfg_hf.num_kv_heads,
+        "gemma3.attention.key_length": cfg_hf.head_dim,
+        "gemma3.attention.layer_norm_rms_epsilon": cfg_hf.rms_norm_eps,
+        "gemma3.attention.sliding_window": cfg_hf.sliding_window,
+        "gemma3.rope.freq_base": cfg_hf.rope_theta,
+        "gemma3.rope.local.freq_base": cfg_hf.rope_local_theta,
+        "gemma3.rope.scaling.factor": cfg_hf.rope_linear_factor,
+        "gemma3.vocab_size": cfg_hf.vocab_size,
+        "gemma3.context_length": 256,
+        "tokenizer.ggml.model": "llama",
+        "tokenizer.ggml.tokens": ["<pad>"] * 256,
+        "tokenizer.ggml.eos_token_id": 1,
+    }
+    path = str(tmp_path / "gemma3.gguf")
+    write_gguf(path, md, tensors)
+
+    g = read_gguf(path)
+    import dataclasses
+
+    cfg = dataclasses.replace(g.to_llama_config(), dtype=jnp.float32)
+    assert not cfg.rms_norm_unit_offset  # folded into the stored norms
+    assert cfg.qk_norm and cfg.post_block_norms and cfg.tie_word_embeddings
+    assert cfg.sliding_global_every == 6
+    assert cfg.rope_local_theta == 10_000.0
+    assert cfg.rope_linear_factor == 8.0
+    assert cfg.query_pre_attn_scalar is None  # non-27B: scale by head_dim
+
+    # 27B-class shapes are the one case where the attention scale is NOT
+    # head_dim; GGUF has no key for it, so it is derived by model type
+    # (layer count), the way llama.cpp special-cases it
+    md27 = dict(md)
+    md27.update({
+        "gemma3.block_count": 62,
+        "gemma3.embedding_length": 5376,
+        "gemma3.attention.head_count": 32,
+        "gemma3.attention.key_length": 128,
+    })
+    p27 = str(tmp_path / "g27.gguf")
+    write_gguf(p27, md27, {"token_embd.weight": np.zeros((4, 8), np.float32)})
+    cfg27 = read_gguf(p27).to_llama_config()
+    assert cfg27.query_pre_attn_scalar == 5376 / 32  # 168
+    gp = params_from_gguf(g, cfg)
+    assert "lm_head" not in gp  # tied
+
+    rng = np.random.default_rng(7)
+    toks = rng.integers(0, 256, size=(1, 12)).astype(np.int32)
+    positions = np.arange(12, dtype=np.int32)[None]
+    pts = np.arange(1, 4, dtype=np.int32)[None]
+
+    def run(c, p):
+        kv = init_kv_pages(c, 16, 4)
+        logits, _ = forward(
+            p, c, jnp.asarray(toks), jnp.asarray(positions),
+            jnp.ones((1, 12), bool), kv, jnp.asarray(pts),
+        )
+        return np.asarray(logits)
+
+    np.testing.assert_allclose(
+        run(cfg, gp), run(cfg_hf, P), rtol=1e-4, atol=1e-4
+    )
