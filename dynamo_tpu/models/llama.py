@@ -98,6 +98,23 @@ class LlamaConfig:
     #: Gemma3 4B+: linear rope position scaling on GLOBAL layers only
     #: (positions effectively divided by this factor)
     rope_linear_factor: Optional[float] = None
+    #: Llama-4: rope rotates interleaved pairs (x0,x1),(x2,x3)… (the
+    #: complex freqs_cis convention) instead of the HF half-split
+    rope_interleaved: bool = False
+    #: Llama-4 NoPE: every Nth layer ((layer+1) % N == 0) skips rope and
+    #: attends globally; 0 = rope everywhere
+    nope_every: int = 0
+    #: Llama-4: weightless L2 q/k norm after rope (rope layers only)
+    qk_l2_norm: bool = False
+    #: Llama-4: scale NoPE-layer queries by
+    #: log1p(floor((pos+1)/floor_scale)) * attn_scale + 1
+    attn_temperature_tuning: bool = False
+    attn_floor_scale: float = 8192.0
+    attn_scale_coef: float = 0.1
+    #: Llama-4 chunked attention on rope layers: token attends only
+    #: within its `attention_chunk`-sized block (0 = off). Equivalent to
+    #: a per-query window of (pos % chunk) + 1.
+    attention_chunk: int = 0
     #: Qwen2-VL m-RoPE: head_dim/2 frequency slots partitioned into
     #: (temporal, height, width) sections — e.g. (16, 24, 24) for D=128.
     #: Rope positions may then be [3, B, T] (one stream per axis); plain
@@ -340,6 +357,29 @@ class LlamaConfig:
             or gemma2
             or gemma3
         )
+        llama4 = (
+            hf.get("model_type") == "llama4_text"
+            or arch == "Llama4ForCausalLM"
+        )
+        nope_every = 0
+        if llama4:
+            nrl = hf.get("no_rope_layers") or []
+            if not nrl:
+                # HF serializes an empty list to mean "the default
+                # pattern" (every no_rope_layer_interval-th layer NoPE)
+                nope_every = int(hf.get("no_rope_layer_interval") or 4)
+            elif 0 in nrl:
+                nope_every = nrl.index(0) + 1
+                want = [
+                    0 if (i + 1) % nope_every == 0 else 1
+                    for i in range(len(nrl))
+                ]
+                if nrl != want:
+                    raise ValueError(
+                        f"unsupported llama4 no_rope_layers pattern "
+                        f"{nrl!r}: only periodic every-{nope_every}th-NoPE "
+                        "is implemented"
+                    )
         mistral = (
             hf.get("model_type") == "mistral" or arch == "MistralForCausalLM"
         )
@@ -407,6 +447,17 @@ class LlamaConfig:
                 else None
             ),
             post_block_norms=gemma2 or gemma3,
+            rope_interleaved=llama4,
+            nope_every=nope_every,
+            qk_l2_norm=bool(llama4 and hf.get("use_qk_norm", True)),
+            attn_temperature_tuning=bool(
+                llama4 and hf.get("attn_temperature_tuning", True)
+            ),
+            attn_floor_scale=float(hf.get("floor_scale", 8192.0)),
+            attn_scale_coef=float(hf.get("attn_scale", 0.1)),
+            attention_chunk=(
+                int(hf.get("attention_chunk_size") or 0) if llama4 else 0
+            ),
         )
 
 
@@ -798,6 +849,13 @@ def quantize_channelwise_int8(w: jax.Array):
     return jnp.round(wf / scale).astype(jnp.int8), scale
 
 
+def _l2_norm(x: jax.Array, eps: float) -> jax.Array:
+    """Weightless RMS normalization (Llama-4's q/k norm)."""
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    return (xf * lax.rsqrt(var + eps)).astype(x.dtype)
+
+
 def rms_norm(
     x: jax.Array, weight: jax.Array, eps: float, unit_offset: bool = False
 ) -> jax.Array:
@@ -871,7 +929,17 @@ def apply_rope(
         angles = positions[..., None].astype(jnp.float32) * inv_freq  # [B,T,D/2]
     cos = jnp.cos(angles)[:, :, None, :]  # [B,T,1,D/2]
     sin = jnp.sin(angles)[:, :, None, :]
-    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    xf = x.astype(jnp.float32)
+    if cfg.rope_interleaved:
+        # Llama-4 / original-Llama pairing: (x[2i], x[2i+1]) rotate by
+        # angle i (torch.view_as_complex semantics)
+        x1 = xf[..., 0::2]
+        x2 = xf[..., 1::2]
+        out = jnp.stack(
+            [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1
+        ).reshape(x.shape)
+        return out.astype(x.dtype)
+    x1, x2 = jnp.split(xf, 2, axis=-1)
     out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
     return out.astype(x.dtype)
 
@@ -964,6 +1032,9 @@ def paged_attention(
     q_pos = q_positions[:, None, None, :, None]
     mask = key_pos <= q_pos
     if window is not None:
+        if getattr(window, "ndim", 0) == 2:
+            # per-query window [B, T] (Llama-4 chunked attention)
+            window = window[:, None, None, :, None]
         mask = mask & (key_pos > q_pos - window)
     scores = jnp.where(mask, scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1)
@@ -1091,6 +1162,10 @@ def attention_block(
         if cfg.sliding_global_every
         else None
     )
+    # Llama-4 NoPE: every Nth layer skips rope entirely (traced bool)
+    use_rope = (
+        (layer + 1) % cfg.nope_every != 0 if cfg.nope_every else None
+    )
     if cfg.rope_local_theta is not None:
         # Gemma3: global layers rope at rope_theta (with optional linear
         # scaling), local layers at rope_local_theta — select between the
@@ -1103,8 +1178,36 @@ def attention_block(
         q = apply_rope(q, rp, cfg, inv_freq=inv_freq)
         k = apply_rope(k, rp, cfg, inv_freq=inv_freq)
     else:
-        q = apply_rope(q, rp, cfg)
-        k = apply_rope(k, rp, cfg)
+        rq = apply_rope(q, rp, cfg)
+        rk = apply_rope(k, rp, cfg)
+        if cfg.qk_l2_norm:
+            # Llama-4: weightless L2 norm AFTER rope, rope layers only
+            rq = _l2_norm(rq, cfg.rms_norm_eps)
+            rk = _l2_norm(rk, cfg.rms_norm_eps)
+        if use_rope is None:
+            q, k = rq, rk
+        else:
+            q = jnp.where(use_rope, rq, q)
+            k = jnp.where(use_rope, rk, k)
+            if cfg.attn_temperature_tuning:
+                # arXiv 2501.19399 temperature tuning on NoPE layers
+                scales = (
+                    jnp.log1p(
+                        jnp.floor(
+                            (positions.astype(jnp.float32) + 1.0)
+                            / cfg.attn_floor_scale
+                        )
+                    )
+                    * cfg.attn_scale_coef
+                    + 1.0
+                )  # [B, T]
+                q = jnp.where(
+                    use_rope,
+                    q,
+                    (q.astype(jnp.float32) * scales[..., None, None]).astype(
+                        q.dtype
+                    ),
+                )
     dpad = cfg.kv_head_dim - cfg.head_dim
     if dpad:
         k = jnp.pad(k, ((0, 0), (0, 0), (0, 0), (0, dpad)))
@@ -1126,18 +1229,27 @@ def attention_block(
                 layer % cfg.sliding_window_every == 0,
                 jnp.int32(cfg.sliding_window), jnp.int32(1 << 30),
             )
+    elif cfg.attention_chunk:
+        # Llama-4 chunked attention ≡ a PER-QUERY window of
+        # (pos % chunk) + 1 on rope layers; NoPE layers attend globally
+        wq = positions % cfg.attention_chunk + 1  # [B, T]
+        if use_rope is not None:
+            wq = jnp.where(use_rope, wq, jnp.int32(1 << 30))
+        window = wq
     if cfg.attention_impl in ("pallas", "hybrid") and (
         cfg.sliding_window
         or cfg.attn_logit_softcap
+        or cfg.attention_chunk
+        or cfg.nope_every
         or (
             cfg.query_pre_attn_scalar is not None
             and cfg.query_pre_attn_scalar != cfg.head_dim
         )
     ):
         raise ValueError(
-            "sliding-window / softcap / rescaled attention (Gemma2) "
-            "requires attention_impl='xla' — the flash kernels don't "
-            "implement them"
+            "sliding-window / softcap / rescaled / chunked / NoPE "
+            "attention (Gemma2, Llama-4) requires attention_impl='xla' — "
+            "the flash kernels don't implement them"
         )
 
     if cfg.attention_impl not in ("pallas", "hybrid"):

@@ -87,9 +87,16 @@ class MoeConfig:
     norm_topk_prob: bool = True
     #: expert MLP width (None: base.intermediate_size — Mixtral)
     expert_intermediate_size: Optional[int] = None
-    #: HF tensor naming: "mixtral" (block_sparse_moe.experts.N.w1/w2/w3)
-    #: or "qwen3_moe" (mlp.experts.N.gate/up/down_proj)
+    #: HF tensor naming: "mixtral" (block_sparse_moe.experts.N.w1/w2/w3),
+    #: "qwen3_moe" (mlp.experts.N.gate/up/down_proj), or "llama4"
+    #: (feed_forward.experts fused gate_up_proj + shared_expert)
     hf_naming: str = "mixtral"
+    #: gate semantics: "softmax" (Mixtral/Qwen3: softmax probs, output
+    #: combine) or "llama4" (sigmoid of the top-k LOGITS, scaling the
+    #: expert INPUT — expert(x·s), not s·expert(x))
+    gate: str = "softmax"
+    #: Llama-4: a dense expert-width MLP added to every token's output
+    shared_expert: bool = False
 
     @property
     def expert_width(self) -> int:
@@ -128,6 +135,47 @@ class MoeConfig:
         )
 
     @staticmethod
+    def llama4_scout_text() -> "MoeConfig":
+        """Llama-4-Scout (17B-A/16E) language model: interleaved rope
+        with llama3 NTK scaling, NoPE every 4th layer with temperature
+        tuning, chunked attention (8192) on rope layers, weightless L2
+        q/k norm, sigmoid top-1 input-scaled routing + shared expert."""
+        return MoeConfig(
+            base=LlamaConfig(
+                vocab_size=202048, hidden_size=5120,
+                intermediate_size=8192, num_layers=48, num_heads=40,
+                num_kv_heads=8, head_dim=128, rope_theta=500000.0,
+                rope_scaling_factor=8.0, rope_low_freq_factor=1.0,
+                rope_high_freq_factor=4.0, rope_original_max_position=8192,
+                rope_interleaved=True, nope_every=4, qk_l2_norm=True,
+                attn_temperature_tuning=True, attention_chunk=8192,
+            ),
+            num_experts=16, top_k=1, norm_topk_prob=False,
+            hf_naming="llama4", gate="llama4", shared_expert=True,
+        )
+
+    @staticmethod
+    def llama4_tiny(vocab_size: int = 256) -> "MoeConfig":
+        """Unit-test scale Llama-4 shape: 4 layers so the every-4th-NoPE
+        pattern appears; chunk 4 so chunked masking bites at T=12."""
+        return MoeConfig(
+            base=LlamaConfig(
+                vocab_size=vocab_size, hidden_size=64,
+                intermediate_size=32, num_layers=4, num_heads=4,
+                num_kv_heads=2, head_dim=16, rope_theta=10000.0,
+                rope_interleaved=True, nope_every=4, qk_l2_norm=True,
+                attn_temperature_tuning=True, attn_floor_scale=4.0,
+                attention_chunk=4, dtype=jnp.float32,
+            ),
+            num_experts=4, top_k=1, norm_topk_prob=False,
+            hf_naming="llama4", gate="llama4", shared_expert=True,
+            # test-scale: room for every token on ONE expert, so chunked
+            # decode continuation is capacity-drop-free and exactly
+            # reproduces full prefill
+            capacity_factor=4.0,
+        )
+
+    @staticmethod
     def from_hf_config(hf: dict) -> "MoeConfig":
         base = LlamaConfig.from_hf_config(hf)
         qwen3_moe = (
@@ -150,6 +198,26 @@ class MoeConfig:
                     or hf["intermediate_size"]
                 ),
                 hf_naming="qwen3_moe",
+            )
+        llama4 = (
+            hf.get("model_type") == "llama4_text"
+            or "Llama4ForCausalLM" in (hf.get("architectures") or [])
+        )
+        if llama4:
+            if int(hf.get("interleave_moe_layer_step", 1)) != 1:
+                raise ValueError(
+                    "llama4 dense/MoE layer interleaving "
+                    "(interleave_moe_layer_step > 1, Maverick) is not "
+                    "implemented — Scout-style all-MoE only"
+                )
+            return MoeConfig(
+                base=base,
+                num_experts=int(hf.get("num_local_experts", 16)),
+                top_k=int(hf.get("num_experts_per_tok", 1)),
+                norm_topk_prob=False,
+                hf_naming="llama4",
+                gate="llama4",
+                shared_expert=True,
             )
         return MoeConfig(
             base=base,
@@ -185,6 +253,11 @@ def init_params(key: jax.Array, cfg: MoeConfig) -> dict:
     layers["we_gate"] = dense(keys[1], (L, E, h, i), h)
     layers["we_up"] = dense(keys[2], (L, E, h, i), h)
     layers["we_down"] = dense(keys[3], (L, E, i, h), i)
+    if cfg.shared_expert:
+        sk = jax.random.split(jax.random.fold_in(key, 2), 3)
+        layers["ws_gate"] = dense(sk[0], (L, h, i), h)
+        layers["ws_up"] = dense(sk[1], (L, h, i), h)
+        layers["ws_down"] = dense(sk[2], (L, i, h), i)
     return base
 
 
@@ -218,6 +291,9 @@ def params_from_torch_state_dict(state_dict, cfg: MoeConfig) -> dict:
     if cfg.hf_naming == "qwen3_moe":
         moe_prefix = "model.layers.{}.mlp"
         e_gate, e_up, e_down = "gate_proj", "up_proj", "down_proj"
+    elif cfg.hf_naming == "llama4":
+        moe_prefix = "model.layers.{}.feed_forward"
+        e_gate = e_up = e_down = None  # fused 3D tensors, handled below
     else:
         moe_prefix = "model.layers.{}.block_sparse_moe"
         e_gate, e_up, e_down = "w1", "w3", "w2"
@@ -245,15 +321,70 @@ def params_from_torch_state_dict(state_dict, cfg: MoeConfig) -> dict:
                 if cfg.base.qk_norm
                 else {}
             ),
-            "w_router": stack(moe_prefix + ".gate.weight"),
-            "we_gate": stack_experts(
-                moe_prefix + ".experts.{}." + e_gate + ".weight"
-            ),
-            "we_up": stack_experts(
-                moe_prefix + ".experts.{}." + e_up + ".weight"
-            ),
-            "we_down": stack_experts(
-                moe_prefix + ".experts.{}." + e_down + ".weight"
+            **(
+                {
+                    # Llama-4: router named differently, experts FUSED as
+                    # [E, H, 2I] gate_up (already [in, out] orientation)
+                    # + [E, I, H] down, plus the shared expert MLP
+                    "w_router": stack(moe_prefix + ".router.weight"),
+                    # gate_up is one [E, H, 2I] tensor per layer (~5 GB
+                    # f32 at Scout scale): convert ONCE, slice both halves
+                    **(
+                        lambda gus: {
+                            "we_gate": jnp.asarray(
+                                np.stack(
+                                    [g[:, :, : cfg.expert_width] for g in gus]
+                                ),
+                                dt,
+                            ),
+                            "we_up": jnp.asarray(
+                                np.stack(
+                                    [g[:, :, cfg.expert_width :] for g in gus]
+                                ),
+                                dt,
+                            ),
+                        }
+                    )(
+                        [
+                            t(moe_prefix.format(l) + ".experts.gate_up_proj")
+                            for l in range(L)
+                        ]
+                    ),
+                    "we_down": jnp.asarray(
+                        np.stack(
+                            [
+                                t(
+                                    moe_prefix.format(l)
+                                    + ".experts.down_proj"
+                                )
+                                for l in range(L)
+                            ]
+                        ),
+                        dt,
+                    ),
+                    "ws_gate": stack(
+                        moe_prefix + ".shared_expert.gate_proj.weight"
+                    ),
+                    "ws_up": stack(
+                        moe_prefix + ".shared_expert.up_proj.weight"
+                    ),
+                    "ws_down": stack(
+                        moe_prefix + ".shared_expert.down_proj.weight"
+                    ),
+                }
+                if cfg.hf_naming == "llama4"
+                else {
+                    "w_router": stack(moe_prefix + ".gate.weight"),
+                    "we_gate": stack_experts(
+                        moe_prefix + ".experts.{}." + e_gate + ".weight"
+                    ),
+                    "we_up": stack_experts(
+                        moe_prefix + ".experts.{}." + e_up + ".weight"
+                    ),
+                    "we_down": stack_experts(
+                        moe_prefix + ".experts.{}." + e_down + ".weight"
+                    ),
+                }
             ),
         },
         "final_norm": jnp.asarray(t("model.norm.weight"), dt),
@@ -267,19 +398,25 @@ def top_k_gating(
     top_k: int,
     capacity: int,
     norm_topk_prob: bool = True,
+    gate: str = "softmax",
 ) -> tuple[jax.Array, jax.Array]:
     """GShard dispatch/combine tensors, Mixtral gate semantics (Qwen3-MoE
-    = the same with renormalization gated on norm_topk_prob).
+    = the same with renormalization gated on norm_topk_prob; Llama-4 =
+    sigmoid of the raw top-k LOGITS, no renormalization).
 
     Returns (dispatch [N, E, C] in {0,1}, combine [N, E, C] f32). Slot-major
     position assignment: every token's 1st choice is placed before any 2nd
     choice, so capacity pressure drops the weakest assignments first.
     """
     n, e = logits.shape
-    probs = jax.nn.softmax(logits, axis=-1)
-    vals, idx = lax.top_k(probs, top_k)  # [N, k]
-    if norm_topk_prob:
-        vals = vals / jnp.sum(vals, axis=-1, keepdims=True)
+    if gate == "llama4":
+        vals, idx = lax.top_k(logits, top_k)  # raw logits
+        vals = jax.nn.sigmoid(vals)
+    else:
+        probs = jax.nn.softmax(logits, axis=-1)
+        vals, idx = lax.top_k(probs, top_k)  # [N, k]
+        if norm_topk_prob:
+            vals = vals / jnp.sum(vals, axis=-1, keepdims=True)
 
     onehot = jax.nn.one_hot(idx, e, dtype=jnp.int32)  # [N, k, E]
     flat = onehot.transpose(1, 0, 2).reshape(top_k * n, e)  # slot-major
@@ -306,10 +443,15 @@ def moe_ffn(x: jax.Array, lp: dict, cfg: MoeConfig) -> jax.Array:
     logits = (xf @ lp["w_router"]).astype(jnp.float32)  # [N, E]
     dispatch, combine = top_k_gating(
         logits, cfg.top_k, _capacity(cfg, n),
-        norm_topk_prob=cfg.norm_topk_prob,
+        norm_topk_prob=cfg.norm_topk_prob, gate=cfg.gate,
     )
-    d = dispatch.astype(x.dtype)
-    expert_in = jnp.einsum("nh,nec->ech", xf, d)  # [E, C, H]
+    if cfg.gate == "llama4":
+        # Llama-4 scales the expert INPUT by the sigmoid score —
+        # expert(x·s), not s·expert(x) — and sums outputs unweighted
+        in_w, out_w = combine.astype(x.dtype), dispatch.astype(jnp.float32)
+    else:
+        in_w, out_w = dispatch.astype(x.dtype), combine
+    expert_in = jnp.einsum("nh,nec->ech", xf, in_w)  # [E, C, H]
     gate = jax.nn.silu(
         jnp.einsum(
             "ech,ehi->eci", expert_in, _w(lp, "we_gate", x.dtype)
@@ -322,8 +464,21 @@ def moe_ffn(x: jax.Array, lp: dict, cfg: MoeConfig) -> jax.Array:
         "eci,eih->ech", (gate * up).astype(x.dtype), _w(lp, "we_down", x.dtype)
     )  # [E, C, H]
     out = jnp.einsum(
-        "ech,nec->nh", expert_out.astype(jnp.float32), combine
+        "ech,nec->nh", expert_out.astype(jnp.float32), out_w
     )
+    if cfg.shared_expert:
+        sg = jax.nn.silu(
+            jnp.einsum(
+                "nh,hi->ni", xf, _w(lp, "ws_gate", x.dtype)
+            ).astype(jnp.float32)
+        )
+        su = jnp.einsum(
+            "nh,hi->ni", xf, _w(lp, "ws_up", x.dtype)
+        ).astype(jnp.float32)
+        out = out + jnp.einsum(
+            "ni,ih->nh", (sg * su).astype(x.dtype),
+            _w(lp, "ws_down", x.dtype),
+        ).astype(jnp.float32)
     return out.reshape(b, t, h).astype(x.dtype)
 
 

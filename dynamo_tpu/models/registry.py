@@ -252,6 +252,8 @@ def get_model(
         "mixtral-8x7b": MoeConfig.mixtral_8x7b,
         "moe-tiny": MoeConfig.tiny,
         "qwen3-moe-30b": MoeConfig.qwen3_moe_30b,
+        "llama4-scout-text": MoeConfig.llama4_scout_text,
+        "llama4-tiny": MoeConfig.llama4_tiny,
     }
     mla_presets = {
         "deepseek-v2-lite": MlaConfig.deepseek_v2_lite,
@@ -286,8 +288,8 @@ def get_model(
         arch = (hf.get("architectures") or ["LlamaForCausalLM"])[0]
         if (
             "mixtral" in arch.lower()
-            or arch == "Qwen3MoeForCausalLM"
-            or hf.get("model_type") == "qwen3_moe"
+            or arch in ("Qwen3MoeForCausalLM", "Llama4ForCausalLM")
+            or hf.get("model_type") in ("qwen3_moe", "llama4_text")
         ):
             moe_cfg = MoeConfig.from_hf_config(hf)
         elif (
