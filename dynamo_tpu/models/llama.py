@@ -115,6 +115,21 @@ class LlamaConfig:
     #: within its `attention_chunk`-sized block (0 = off). Equivalent to
     #: a per-query window of (pos % chunk) + 1.
     attention_chunk: int = 0
+    #: YaRN rope scaling (GPT-OSS): interpolation factor; None = off.
+    #: Uses rope_original_max_position as the pretraining context and
+    #: scales cos/sin by the paper's 0.1·ln(factor)+1 attention factor.
+    rope_yarn_factor: Optional[float] = None
+    rope_yarn_beta_fast: float = 32.0
+    rope_yarn_beta_slow: float = 1.0
+    rope_yarn_truncate: bool = True
+    #: explicit cos/sin scale override (HF rope_scaling.attention_factor);
+    #: None = the paper's 0.1·ln(factor)+1
+    rope_yarn_attention_factor: Optional[float] = None
+    #: GPT-OSS attention sinks: a learned per-head logit joins every
+    #: softmax (params key "sinks" [Hq] per layer)
+    attn_sinks: bool = False
+    #: GPT-OSS: the o projection carries a bias too (params key "bo")
+    attention_out_bias: bool = False
     #: Qwen2-VL m-RoPE: head_dim/2 frequency slots partitioned into
     #: (temporal, height, width) sections — e.g. (16, 24, 24) for D=128.
     #: Rope positions may then be [3, B, T] (one stream per axis); plain
@@ -316,17 +331,42 @@ class LlamaConfig:
         rope_scaling = hf.get("rope_scaling") or {}
         factor = None
         linear_factor = None
+        yarn = {}
         rs_type = rope_scaling.get("rope_type", rope_scaling.get("type"))
         if rs_type == "llama3":
             factor = float(rope_scaling["factor"])
         elif gemma3 and rs_type == "linear":
             linear_factor = float(rope_scaling["factor"])
+        elif rs_type == "yarn":
+            if rope_scaling.get("mscale") or rope_scaling.get(
+                "mscale_all_dim"
+            ):
+                # DeepSeek-style mscale yarn lives in models/mla.py;
+                # refuse rather than scale attention silently wrong here
+                raise ValueError(
+                    "yarn mscale/mscale_all_dim is only implemented for "
+                    "the DeepSeek MLA family"
+                )
+            att = rope_scaling.get("attention_factor")
+            yarn = dict(
+                rope_yarn_factor=float(rope_scaling["factor"]),
+                rope_yarn_beta_fast=float(
+                    rope_scaling.get("beta_fast") or 32.0
+                ),
+                rope_yarn_beta_slow=float(
+                    rope_scaling.get("beta_slow") or 1.0
+                ),
+                rope_yarn_truncate=bool(rope_scaling.get("truncate", True)),
+                rope_yarn_attention_factor=(
+                    float(att) if att is not None else None
+                ),
+            )
         elif rope_scaling:
             # refuse rather than run long-context positions unscaled
-            # (e.g. Qwen3's recommended yarn setup for >32k)
             raise ValueError(
                 f"unsupported rope_scaling type {rs_type!r} for this "
-                "family (llama3 NTK and Gemma3 linear are implemented)"
+                "family (llama3 NTK, Gemma3 linear, and yarn are "
+                "implemented)"
             )
         head_dim = hf.get("head_dim") or hf["hidden_size"] // hf["num_attention_heads"]
         global_every = 0
@@ -360,6 +400,9 @@ class LlamaConfig:
         llama4 = (
             hf.get("model_type") == "llama4_text"
             or arch == "Llama4ForCausalLM"
+        )
+        gpt_oss = (
+            hf.get("model_type") == "gpt_oss" or arch == "GptOssForCausalLM"
         )
         nope_every = 0
         if llama4:
@@ -420,7 +463,12 @@ class LlamaConfig:
             rope_low_freq_factor=float(rope_scaling.get("low_freq_factor", 1.0)),
             rope_high_freq_factor=float(rope_scaling.get("high_freq_factor", 4.0)),
             rope_original_max_position=int(
-                rope_scaling.get("original_max_position_embeddings", 8192)
+                rope_scaling.get("original_max_position_embeddings")
+                # HF's yarn falls back to the model's max positions, NOT
+                # a fixed constant — the correction range depends on it
+                or (hf.get("max_position_embeddings") if rs_type == "yarn"
+                    else None)
+                or 8192
             ),
             attn_logit_softcap=(
                 hf.get("attn_logit_softcapping") if gemma2 else None
@@ -430,10 +478,10 @@ class LlamaConfig:
             ),
             sliding_window=(
                 int(hf.get("sliding_window") or 0)
-                if (gemma2 or gemma3 or mistral)
+                if (gemma2 or gemma3 or mistral or gpt_oss)
                 else 0
             ),
-            sliding_window_every=2 if gemma2 else 1,
+            sliding_window_every=2 if (gemma2 or gpt_oss) else 1,
             sliding_global_every=global_every,
             rope_local_theta=(
                 float(hf.get("rope_local_base_freq", 10_000.0))
@@ -458,6 +506,9 @@ class LlamaConfig:
             attention_chunk=(
                 int(hf.get("attention_chunk_size") or 0) if llama4 else 0
             ),
+            attn_sinks=gpt_oss,
+            attention_out_bias=gpt_oss,
+            **yarn,
         )
 
 
@@ -885,6 +936,32 @@ def _rope_inv_freq(
         inv_freq = inv_freq / linear_factor
     if theta is not None:
         return inv_freq
+    if cfg.rope_yarn_factor is not None:
+        # YaRN (2309.00071): interpolate low-frequency slots by `factor`,
+        # keep high-frequency slots, ramp between the correction bounds.
+        f = cfg.rope_yarn_factor
+        orig = cfg.rope_original_max_position
+
+        def corr_dim(rot):
+            return (
+                d * math.log(orig / (rot * 2 * math.pi))
+            ) / (2 * math.log(base))
+
+        low = corr_dim(cfg.rope_yarn_beta_fast)
+        high = corr_dim(cfg.rope_yarn_beta_slow)
+        if cfg.rope_yarn_truncate:
+            low, high = math.floor(low), math.ceil(high)
+        low, high = max(low, 0), min(high, d - 1)
+        if low == high:
+            high += 0.001
+        ramp = jnp.clip(
+            (jnp.arange(d // 2, dtype=jnp.float32) - low) / (high - low),
+            0.0, 1.0,
+        )
+        extrapolation_w = 1.0 - ramp
+        return (inv_freq / f) * (1.0 - extrapolation_w) + (
+            inv_freq * extrapolation_w
+        )
     if cfg.rope_scaling_factor is not None:
         # Llama-3.1 NTK-by-parts scaling.
         low = cfg.rope_original_max_position / cfg.rope_low_freq_factor
@@ -912,6 +989,7 @@ def apply_rope(
     only through vLLM — /root/reference examples/multimodal).
     `inv_freq` overrides the frequency table (Gemma3's per-layer-type
     selection, attention_block)."""
+    default_table = inv_freq is None
     if inv_freq is None:
         inv_freq = _rope_inv_freq(cfg)
     if positions.ndim == 3:
@@ -929,6 +1007,16 @@ def apply_rope(
         angles = positions[..., None].astype(jnp.float32) * inv_freq  # [B,T,D/2]
     cos = jnp.cos(angles)[:, :, None, :]  # [B,T,1,D/2]
     sin = jnp.sin(angles)[:, :, None, :]
+    if default_table and cfg.rope_yarn_factor is not None:
+        # YaRN attention factor scales the rotated vectors (HF convention:
+        # cos/sin multiplied, so q·k scores scale by the factor squared)
+        s = (
+            cfg.rope_yarn_attention_factor
+            if cfg.rope_yarn_attention_factor is not None
+            else 0.1 * math.log(cfg.rope_yarn_factor) + 1.0
+        )
+        cos = cos * s
+        sin = sin * s
     xf = x.astype(jnp.float32)
     if cfg.rope_interleaved:
         # Llama-4 / original-Llama pairing: (x[2i], x[2i+1]) rotate by
@@ -1003,6 +1091,7 @@ def paged_attention(
     cfg: LlamaConfig,
     key_positions: Optional[jax.Array] = None,  # [B, K]; default arange(K)
     window: Optional[jax.Array] = None,  # scalar: keys within (q_pos-w, q_pos]
+    sinks: Optional[jax.Array] = None,  # [Hq] per-head sink logits
 ) -> jax.Array:
     """Reference paged attention (XLA path; the Pallas decode kernel in
     dynamo_tpu.ops replaces this for T=1 when cfg.attention_impl="pallas").
@@ -1037,13 +1126,24 @@ def paged_attention(
             window = window[:, None, None, :, None]
         mask = mask & (key_pos > q_pos - window)
     scores = jnp.where(mask, scores, -1e30)
-    probs = jax.nn.softmax(scores, axis=-1)
+    if sinks is not None:
+        # GPT-OSS attention sinks: a learned per-head logit joins the
+        # softmax denominator (equivalently: softmax over [scores, sink]
+        # with the sink column dropped)
+        sk = sinks.astype(jnp.float32).reshape(cfg.num_kv_heads, g)[
+            None, :, :, None, None
+        ]
+        m = jnp.maximum(jnp.max(scores, axis=-1, keepdims=True), sk)
+        e = jnp.exp(scores - m)
+        probs = e / (jnp.sum(e, axis=-1, keepdims=True) + jnp.exp(sk - m))
+    else:
+        probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("bkgts,bskd->btkgd", probs, v_pages.astype(jnp.float32))
     return out.reshape(b, t, hq * d).astype(q.dtype)
 
 
 def _chunk_only_attention(q, k, v, positions, valid, cfg, dpad, mesh=None,
-                          window=None):
+                          window=None, sinks=None):
     """First-chunk fast path: no history exists, so attend over the
     in-register chunk only — skips the O(MP·S) page gather and the
     attention over its padding. Invalid (padding) keys are pushed past
@@ -1062,10 +1162,10 @@ def _chunk_only_attention(q, k, v, positions, valid, cfg, dpad, mesh=None,
     sp = mesh.shape.get("sp", 1) if mesh is not None else 1
     t = q.shape[1]
     if sp > 1 and t % sp == 0 and t > 1:
-        if window is not None:
+        if window is not None or sinks is not None:
             raise ValueError(
-                "sliding-window attention (Gemma2) is not implemented for "
-                "the sp ring-attention path — run with sp=1"
+                "sliding-window / sink attention (Gemma2, GPT-OSS) is not "
+                "implemented for the sp ring-attention path — run with sp=1"
             )
         if dpad:
             k = k[..., : cfg.head_dim]
@@ -1096,7 +1196,8 @@ def _chunk_only_attention(q, k, v, positions, valid, cfg, dpad, mesh=None,
         v = v[..., : cfg.head_dim]
     cur_pos = jnp.where(valid, positions, jnp.int32(1 << 30))
     return paged_attention(
-        q, k, v, positions, cfg, key_positions=cur_pos, window=window
+        q, k, v, positions, cfg, key_positions=cur_pos, window=window,
+        sinks=sinks,
     )
 
 
@@ -1137,6 +1238,7 @@ def attention_block(
     mesh=None,
     decode_work=None,  # precomputed ops.paged_attention.decode_work_list
     rope_positions=None,  # [3,B,T] m-RoPE streams; None = positions
+    sinks=None,  # [Hq] GPT-OSS per-head sink logits
 ):
     """rope → paged attention, in one of two write disciplines:
 
@@ -1241,15 +1343,16 @@ def attention_block(
         or cfg.attn_logit_softcap
         or cfg.attention_chunk
         or cfg.nope_every
+        or cfg.attn_sinks
         or (
             cfg.query_pre_attn_scalar is not None
             and cfg.query_pre_attn_scalar != cfg.head_dim
         )
     ):
         raise ValueError(
-            "sliding-window / softcap / rescaled / chunked / NoPE "
-            "attention (Gemma2, Llama-4) requires attention_impl='xla' — "
-            "the flash kernels don't implement them"
+            "sliding-window / softcap / rescaled / chunked / NoPE / "
+            "sink attention (Gemma2, Llama-4, GPT-OSS) requires "
+            "attention_impl='xla' — the flash kernels don't implement them"
         )
 
     if cfg.attention_impl not in ("pallas", "hybrid"):
@@ -1262,7 +1365,7 @@ def attention_block(
         if first_chunk and t > 1:
             attn = _chunk_only_attention(
                 q, k, v, positions, valid, cfg, dpad, mesh=mesh,
-                window=window,
+                window=window, sinks=sinks,
             )
             return attn, k_cache, v_cache, None
         k_all = paged_gather(k_cache, layer, page_tables)
@@ -1270,7 +1373,9 @@ def attention_block(
         if dpad:
             k_all = k_all[..., : cfg.head_dim]
             v_all = v_all[..., : cfg.head_dim]
-        attn = paged_attention(q, k_all, v_all, positions, cfg, window=window)
+        attn = paged_attention(
+            q, k_all, v_all, positions, cfg, window=window, sinks=sinks
+        )
         return attn, k_cache, v_cache, None
 
     from dynamo_tpu.ops.paged_attention import (

@@ -97,6 +97,12 @@ class MoeConfig:
     gate: str = "softmax"
     #: Llama-4: a dense expert-width MLP added to every token's output
     shared_expert: bool = False
+    #: GPT-OSS: the router linear carries a bias (params "b_router")
+    router_bias: bool = False
+    #: expert MLP: "swiglu" (silu(gate)·up) or "gpt_oss" (clamped GLU
+    #: gate·σ(1.702·gate)·(up+1), with per-expert biases)
+    expert_mlp: str = "swiglu"
+    swiglu_limit: float = 7.0
 
     @property
     def expert_width(self) -> int:
@@ -176,6 +182,49 @@ class MoeConfig:
         )
 
     @staticmethod
+    def gpt_oss_20b() -> "MoeConfig":
+        """GPT-OSS-20B: alternating sliding(128)/full attention with
+        learned per-head sinks, YaRN x32 rope, biased qkv/o projections,
+        32 experts top-4 (softmax-over-top-k) with biased clamped-GLU
+        MLPs. Released MXFP4 checkpoints load via their HF bf16
+        dequantization."""
+        return MoeConfig(
+            base=LlamaConfig(
+                vocab_size=201088, hidden_size=2880,
+                intermediate_size=2880, num_layers=24, num_heads=64,
+                num_kv_heads=8, head_dim=64, rope_theta=150000.0,
+                rms_norm_eps=1e-5, attention_bias=True,
+                attention_out_bias=True, attn_sinks=True,
+                sliding_window=128, sliding_window_every=2,
+                rope_yarn_factor=32.0, rope_yarn_beta_fast=32.0,
+                rope_yarn_beta_slow=1.0, rope_yarn_truncate=False,
+                rope_original_max_position=4096,
+            ),
+            num_experts=32, top_k=4, norm_topk_prob=True,
+            hf_naming="gpt_oss", router_bias=True, expert_mlp="gpt_oss",
+        )
+
+    @staticmethod
+    def gpt_oss_tiny(vocab_size: int = 256) -> "MoeConfig":
+        """Unit-test scale GPT-OSS shape: 4 layers (two sliding, two
+        full), sinks, yarn, biases everywhere, clamped-GLU experts."""
+        return MoeConfig(
+            base=LlamaConfig(
+                vocab_size=vocab_size, hidden_size=64,
+                intermediate_size=32, num_layers=4, num_heads=4,
+                num_kv_heads=2, head_dim=16, rope_theta=10000.0,
+                rms_norm_eps=1e-5, attention_bias=True,
+                attention_out_bias=True, attn_sinks=True,
+                sliding_window=8, sliding_window_every=2,
+                rope_yarn_factor=4.0, rope_yarn_truncate=False,
+                rope_original_max_position=32, dtype=jnp.float32,
+            ),
+            num_experts=4, top_k=2, norm_topk_prob=True,
+            hf_naming="gpt_oss", router_bias=True, expert_mlp="gpt_oss",
+            capacity_factor=4.0,
+        )
+
+    @staticmethod
     def from_hf_config(hf: dict) -> "MoeConfig":
         base = LlamaConfig.from_hf_config(hf)
         qwen3_moe = (
@@ -198,6 +247,21 @@ class MoeConfig:
                     or hf["intermediate_size"]
                 ),
                 hf_naming="qwen3_moe",
+            )
+        gpt_oss = (
+            hf.get("model_type") == "gpt_oss"
+            or "GptOssForCausalLM" in (hf.get("architectures") or [])
+        )
+        if gpt_oss:
+            return MoeConfig(
+                base=base,
+                num_experts=int(hf.get("num_local_experts", 32)),
+                top_k=int(hf.get("num_experts_per_tok", 4)),
+                norm_topk_prob=True,
+                hf_naming="gpt_oss",
+                router_bias=True,
+                expert_mlp="gpt_oss",
+                swiglu_limit=float(hf.get("swiglu_limit") or 7.0),
             )
         llama4 = (
             hf.get("model_type") == "llama4_text"
@@ -258,6 +322,18 @@ def init_params(key: jax.Array, cfg: MoeConfig) -> dict:
         layers["ws_gate"] = dense(sk[0], (L, h, i), h)
         layers["ws_up"] = dense(sk[1], (L, h, i), h)
         layers["ws_down"] = dense(sk[2], (L, i, h), i)
+    if cfg.router_bias:
+        layers["b_router"] = jnp.zeros((L, E), cfg.base.dtype)
+    if cfg.expert_mlp == "gpt_oss":
+        layers["be_gate"] = jnp.zeros((L, E, i), jnp.float32)
+        layers["be_up"] = jnp.zeros((L, E, i), jnp.float32)
+        layers["be_down"] = jnp.zeros((L, E, h), jnp.float32)
+    if cfg.base.attn_sinks:
+        layers["sinks"] = jnp.zeros(
+            (L, cfg.base.num_heads), cfg.base.dtype
+        )
+    if cfg.base.attention_out_bias:
+        layers["bo"] = jnp.zeros((L, h), cfg.base.dtype)
     return base
 
 
@@ -291,12 +367,33 @@ def params_from_torch_state_dict(state_dict, cfg: MoeConfig) -> dict:
     if cfg.hf_naming == "qwen3_moe":
         moe_prefix = "model.layers.{}.mlp"
         e_gate, e_up, e_down = "gate_proj", "up_proj", "down_proj"
-    elif cfg.hf_naming == "llama4":
-        moe_prefix = "model.layers.{}.feed_forward"
+    elif cfg.hf_naming in ("llama4", "gpt_oss"):
+        moe_prefix = (
+            "model.layers.{}.feed_forward"
+            if cfg.hf_naming == "llama4"
+            else "model.layers.{}.mlp"
+        )
         e_gate = e_up = e_down = None  # fused 3D tensors, handled below
     else:
         moe_prefix = "model.layers.{}.block_sparse_moe"
         e_gate, e_up, e_down = "w1", "w3", "w2"
+
+    def fused_halves(name_fmt, bias=False):
+        """Split a fused [E, H|1, 2I] gate_up tensor per layer into our
+        (gate, up) pair, converting each big tensor ONCE. llama4 fuses as
+        halves [gate | up]; gpt_oss INTERLEAVES (::2 gate, 1::2 up)."""
+        gus = [t(name_fmt.format(l)) for l in range(L)]
+        if cfg.hf_naming == "gpt_oss":
+            gs = [g[..., 0::2] for g in gus]
+            us = [g[..., 1::2] for g in gus]
+        else:
+            gs = [g[..., : cfg.expert_width] for g in gus]
+            us = [g[..., cfg.expert_width :] for g in gus]
+        cast = jnp.float32 if bias else dt
+        return (
+            jnp.asarray(np.stack(gs), cast),
+            jnp.asarray(np.stack(us), cast),
+        )
 
     params = {
         "embed": jnp.asarray(t("model.embed_tokens.weight"), dt),
@@ -323,32 +420,40 @@ def params_from_torch_state_dict(state_dict, cfg: MoeConfig) -> dict:
             ),
             **(
                 {
-                    # Llama-4: router named differently, experts FUSED as
-                    # [E, H, 2I] gate_up (already [in, out] orientation)
-                    # + [E, I, H] down, plus the shared expert MLP
+                    "bq": stack("model.layers.{}.self_attn.q_proj.bias", False),
+                    "bk": stack("model.layers.{}.self_attn.k_proj.bias", False),
+                    "bv": stack("model.layers.{}.self_attn.v_proj.bias", False),
+                }
+                if cfg.base.attention_bias
+                else {}
+            ),
+            **(
+                {
+                    "bo": stack(
+                        "model.layers.{}.self_attn.o_proj.bias", False
+                    )
+                }
+                if cfg.base.attention_out_bias
+                else {}
+            ),
+            **(
+                {"sinks": stack("model.layers.{}.self_attn.sinks", False)}
+                if cfg.base.attn_sinks
+                else {}
+            ),
+            **(
+                {
+                    # Llama-4 / GPT-OSS: experts FUSED as [E, H, 2I]
+                    # gate_up (already [in, out] orientation) + [E, I, H]
+                    # down; router named "router"; per-family extras below
                     "w_router": stack(moe_prefix + ".router.weight"),
-                    # gate_up is one [E, H, 2I] tensor per layer (~5 GB
-                    # f32 at Scout scale): convert ONCE, slice both halves
-                    **(
-                        lambda gus: {
-                            "we_gate": jnp.asarray(
-                                np.stack(
-                                    [g[:, :, : cfg.expert_width] for g in gus]
-                                ),
-                                dt,
+                    **dict(
+                        zip(
+                            ("we_gate", "we_up"),
+                            fused_halves(
+                                moe_prefix + ".experts.gate_up_proj"
                             ),
-                            "we_up": jnp.asarray(
-                                np.stack(
-                                    [g[:, :, cfg.expert_width :] for g in gus]
-                                ),
-                                dt,
-                            ),
-                        }
-                    )(
-                        [
-                            t(moe_prefix.format(l) + ".experts.gate_up_proj")
-                            for l in range(L)
-                        ]
+                        )
                     ),
                     "we_down": jnp.asarray(
                         np.stack(
@@ -362,17 +467,56 @@ def params_from_torch_state_dict(state_dict, cfg: MoeConfig) -> dict:
                         ),
                         dt,
                     ),
-                    "ws_gate": stack(
-                        moe_prefix + ".shared_expert.gate_proj.weight"
+                    **(
+                        {
+                            "ws_gate": stack(
+                                moe_prefix
+                                + ".shared_expert.gate_proj.weight"
+                            ),
+                            "ws_up": stack(
+                                moe_prefix + ".shared_expert.up_proj.weight"
+                            ),
+                            "ws_down": stack(
+                                moe_prefix
+                                + ".shared_expert.down_proj.weight"
+                            ),
+                        }
+                        if cfg.shared_expert
+                        else {}
                     ),
-                    "ws_up": stack(
-                        moe_prefix + ".shared_expert.up_proj.weight"
-                    ),
-                    "ws_down": stack(
-                        moe_prefix + ".shared_expert.down_proj.weight"
+                    **(
+                        {
+                            "b_router": stack(
+                                moe_prefix + ".router.bias", False
+                            ),
+                            **dict(
+                                zip(
+                                    ("be_gate", "be_up"),
+                                    fused_halves(
+                                        moe_prefix
+                                        + ".experts.gate_up_proj_bias",
+                                        bias=True,
+                                    ),
+                                )
+                            ),
+                            "be_down": jnp.asarray(
+                                np.stack(
+                                    [
+                                        t(
+                                            moe_prefix.format(l)
+                                            + ".experts.down_proj_bias"
+                                        )
+                                        for l in range(L)
+                                    ]
+                                ),
+                                jnp.float32,
+                            ),
+                        }
+                        if cfg.hf_naming == "gpt_oss"
+                        else {}
                     ),
                 }
-                if cfg.hf_naming == "llama4"
+                if cfg.hf_naming in ("llama4", "gpt_oss")
                 else {
                     "w_router": stack(moe_prefix + ".gate.weight"),
                     "we_gate": stack_experts(
@@ -441,6 +585,8 @@ def moe_ffn(x: jax.Array, lp: dict, cfg: MoeConfig) -> jax.Array:
     n = b * t
     xf = x.reshape(n, h)
     logits = (xf @ lp["w_router"]).astype(jnp.float32)  # [N, E]
+    if cfg.router_bias:
+        logits = logits + lp["b_router"].astype(jnp.float32)
     dispatch, combine = top_k_gating(
         logits, cfg.top_k, _capacity(cfg, n),
         norm_topk_prob=cfg.norm_topk_prob, gate=cfg.gate,
@@ -452,17 +598,33 @@ def moe_ffn(x: jax.Array, lp: dict, cfg: MoeConfig) -> jax.Array:
     else:
         in_w, out_w = dispatch.astype(x.dtype), combine
     expert_in = jnp.einsum("nh,nec->ech", xf, in_w)  # [E, C, H]
-    gate = jax.nn.silu(
-        jnp.einsum(
-            "ech,ehi->eci", expert_in, _w(lp, "we_gate", x.dtype)
-        ).astype(jnp.float32)
-    )
+    gate_raw = jnp.einsum(
+        "ech,ehi->eci", expert_in, _w(lp, "we_gate", x.dtype)
+    ).astype(jnp.float32)
     up = jnp.einsum(
         "ech,ehi->eci", expert_in, _w(lp, "we_up", x.dtype)
     ).astype(jnp.float32)
-    expert_out = jnp.einsum(
-        "eci,eih->ech", (gate * up).astype(x.dtype), _w(lp, "we_down", x.dtype)
-    )  # [E, C, H]
+    if cfg.expert_mlp == "gpt_oss":
+        # clamped GLU with per-expert biases: g·σ(1.702g)·(u+1); padding
+        # capacity slots produce bias-driven outputs but carry combine
+        # weight 0, so they vanish in the weighted sum
+        lim = cfg.swiglu_limit
+        g = jnp.minimum(gate_raw + lp["be_gate"][:, None, :], lim)
+        u = jnp.clip(up + lp["be_up"][:, None, :], -lim, lim)
+        act = (u + 1.0) * (g * jax.nn.sigmoid(1.702 * g))
+        expert_out = (
+            jnp.einsum(
+                "eci,eih->ech", act.astype(x.dtype),
+                _w(lp, "we_down", x.dtype),
+            )
+            + lp["be_down"][:, None, :]
+        )  # [E, C, H]
+    else:
+        gate = jax.nn.silu(gate_raw)
+        expert_out = jnp.einsum(
+            "eci,eih->ech", (gate * up).astype(x.dtype),
+            _w(lp, "we_down", x.dtype),
+        )  # [E, C, H]
     out = jnp.einsum(
         "ech,nec->nh", expert_out.astype(jnp.float32), out_w
     )
@@ -519,14 +681,22 @@ def forward_hidden(
         v = llama_mod._mm(x, lp, "wv", bc.dtype).reshape(
             b, t, bc.num_kv_heads, bc.head_dim
         )
+        if bc.attention_bias:  # GPT-OSS: qkv biases
+            q = q + lp["bq"].reshape(bc.num_heads, bc.head_dim)
+            k = k + lp["bk"].reshape(bc.num_kv_heads, bc.head_dim)
+            v = v + lp["bv"].reshape(bc.num_kv_heads, bc.head_dim)
         if bc.qk_norm:  # Qwen3-MoE: per-head RMSNorm pre-rope
             q = rms_norm(q, lp["q_norm"], bc.rms_norm_eps)
             k = rms_norm(k, lp["k_norm"], bc.rms_norm_eps)
         attn, k_full, v_full, staged = attention_block(
             q, k, v, k_full, v_full, li, page_tables, positions, valid, bc,
             first_chunk=first_chunk, mesh=mesh, decode_work=decode_work,
+            sinks=lp["sinks"] if bc.attn_sinks else None,
         )
-        h = h + llama_mod._mm(attn, lp, "wo", bc.dtype)
+        attn_out = llama_mod._mm(attn, lp, "wo", bc.dtype)
+        if bc.attention_out_bias:
+            attn_out = attn_out + lp["bo"]
+        h = h + attn_out
         x = rms_norm(h, lp["mlp_norm"], bc.rms_norm_eps)
         h = h + moe_ffn(x, lp, cfg)
         return (h, k_full, v_full), staged

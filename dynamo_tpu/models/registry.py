@@ -254,6 +254,8 @@ def get_model(
         "qwen3-moe-30b": MoeConfig.qwen3_moe_30b,
         "llama4-scout-text": MoeConfig.llama4_scout_text,
         "llama4-tiny": MoeConfig.llama4_tiny,
+        "gpt-oss-20b": MoeConfig.gpt_oss_20b,
+        "gpt-oss-tiny": MoeConfig.gpt_oss_tiny,
     }
     mla_presets = {
         "deepseek-v2-lite": MlaConfig.deepseek_v2_lite,
@@ -288,8 +290,11 @@ def get_model(
         arch = (hf.get("architectures") or ["LlamaForCausalLM"])[0]
         if (
             "mixtral" in arch.lower()
-            or arch in ("Qwen3MoeForCausalLM", "Llama4ForCausalLM")
-            or hf.get("model_type") in ("qwen3_moe", "llama4_text")
+            or arch in (
+                "Qwen3MoeForCausalLM", "Llama4ForCausalLM",
+                "GptOssForCausalLM",
+            )
+            or hf.get("model_type") in ("qwen3_moe", "llama4_text", "gpt_oss")
         ):
             moe_cfg = MoeConfig.from_hf_config(hf)
         elif (

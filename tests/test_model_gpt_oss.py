@@ -1,0 +1,174 @@
+"""GPT-OSS vs HuggingFace GptOssForCausalLM.
+
+The 4-layer tiny config exercises every delta in one forward: alternating
+sliding(8)/full attention, learned per-head attention sinks, YaRN rope
+(factor 4, truncate=False), biased qkv/o projections, biased router, and
+the clamped-GLU expert MLP (g·σ(1.702g)·(u+1) with per-expert biases,
+softmax-over-top-k output weighting).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dynamo_tpu.models.llama import init_kv_pages
+from dynamo_tpu.models.moe import (
+    MoeConfig,
+    forward,
+    init_params,
+    params_from_torch_state_dict,
+)
+
+PAGE_SIZE = 4
+
+
+def _hf_model(cfg: MoeConfig):
+    torch = pytest.importorskip("torch")
+    from transformers import GptOssConfig, GptOssForCausalLM
+
+    b = cfg.base
+    hf_cfg = GptOssConfig(
+        vocab_size=b.vocab_size,
+        hidden_size=b.hidden_size,
+        intermediate_size=b.intermediate_size,
+        num_hidden_layers=b.num_layers,
+        num_attention_heads=b.num_heads,
+        num_key_value_heads=b.num_kv_heads,
+        head_dim=b.head_dim,
+        num_local_experts=cfg.num_experts,
+        num_experts_per_tok=cfg.top_k,
+        rope_theta=b.rope_theta,
+        rope_scaling={
+            "rope_type": "yarn",
+            "factor": b.rope_yarn_factor,
+            "beta_fast": b.rope_yarn_beta_fast,
+            "beta_slow": b.rope_yarn_beta_slow,
+            "truncate": b.rope_yarn_truncate,
+            "original_max_position_embeddings": b.rope_original_max_position,
+        },
+        rms_norm_eps=b.rms_norm_eps,
+        sliding_window=b.sliding_window,
+        attention_bias=True,
+        tie_word_embeddings=False,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(23)
+    model = GptOssForCausalLM(hf_cfg).eval()
+    with torch.no_grad():  # zero-init params must matter
+        for layer in model.model.layers:
+            layer.self_attn.sinks.normal_(0.0, 1.0)
+            for p in (layer.self_attn.q_proj.bias,
+                      layer.self_attn.k_proj.bias,
+                      layer.self_attn.v_proj.bias,
+                      layer.self_attn.o_proj.bias,
+                      layer.mlp.router.bias,
+                      layer.mlp.experts.gate_up_proj_bias,
+                      layer.mlp.experts.down_proj_bias):
+                p.normal_(0.0, 0.3)
+    return model
+
+
+def _run_paged(cfg, params, toks):
+    b, t = toks.shape
+    kv = init_kv_pages(cfg.base, 64, PAGE_SIZE)
+    n_pages = -(-t // PAGE_SIZE)
+    pts = np.zeros((b, n_pages), np.int32)
+    for i in range(b):
+        pts[i] = np.arange(1 + i * n_pages, 1 + (i + 1) * n_pages)
+    positions = np.tile(np.arange(t, dtype=np.int32), (b, 1))
+    logits, _ = forward(
+        params, cfg, jnp.asarray(toks), jnp.asarray(positions),
+        jnp.ones((b, t), bool), kv, jnp.asarray(pts),
+    )
+    return np.asarray(logits)
+
+
+def test_against_hf_gpt_oss():
+    torch = pytest.importorskip("torch")
+    cfg = MoeConfig.gpt_oss_tiny()
+    model = _hf_model(cfg)
+    assert model.config.layer_types == [
+        "sliding_attention", "full_attention",
+        "sliding_attention", "full_attention",
+    ]
+    params = params_from_torch_state_dict(model.state_dict(), cfg)
+    for k in ("sinks", "bo", "b_router", "be_gate"):
+        assert k in params["layers"], k
+
+    rng = np.random.default_rng(13)
+    # T=12 > sliding_window=8 so the alternating local mask bites
+    toks = rng.integers(0, cfg.base.vocab_size, size=(2, 12)).astype(np.int32)
+    with torch.no_grad():
+        ref = model(torch.from_numpy(toks.astype(np.int64))).logits.numpy()
+    ours = _run_paged(cfg, params, toks)
+    np.testing.assert_allclose(ours, ref, rtol=5e-2, atol=5e-2)
+    assert (ours.argmax(-1) == ref.argmax(-1)).mean() > 0.95
+
+
+def test_gpt_oss_deltas_all_matter():
+    from dataclasses import replace
+
+    cfg = MoeConfig.gpt_oss_tiny()
+    params = init_params(jax.random.key(4), cfg)
+    # zero-init sinks/biases still flow (exp(0) in the softmax
+    # denominator); perturb them so ablations bite harder
+    params["layers"]["sinks"] = params["layers"]["sinks"] + 1.5
+    params["layers"]["b_router"] = params["layers"]["b_router"] + 0.5
+    rng = np.random.default_rng(5)
+    toks = rng.integers(0, 256, size=(1, 12)).astype(np.int32)
+    base_out = _run_paged(cfg, params, toks)
+
+    def variant(**base_kw):
+        return replace(cfg, base=replace(cfg.base, **base_kw))
+
+    for name, v in (
+        ("sinks", variant(attn_sinks=False)),
+        ("yarn", variant(rope_yarn_factor=None)),
+        ("sliding", variant(sliding_window=0)),
+        ("router bias", replace(cfg, router_bias=False)),
+        ("clamped glu", replace(cfg, expert_mlp="swiglu")),
+    ):
+        assert not np.allclose(base_out, _run_paged(v, params, toks)), name
+
+
+def test_gpt_oss_decode_continuation_matches_full_prefill():
+    cfg = MoeConfig.gpt_oss_tiny()
+    params = init_params(jax.random.key(6), cfg)
+    params["layers"]["sinks"] = params["layers"]["sinks"] + 1.0
+    rng = np.random.default_rng(7)
+    toks = rng.integers(0, 256, size=(1, 10)).astype(np.int32)
+    full = _run_paged(cfg, params, toks)
+
+    kv = init_kv_pages(cfg.base, 64, PAGE_SIZE)
+    pts = jnp.asarray(np.arange(1, 5, dtype=np.int32)[None])
+    logits, kv = forward(
+        params, cfg, jnp.asarray(toks[:, :6]),
+        jnp.asarray(np.arange(6, dtype=np.int32)[None]),
+        jnp.ones((1, 6), bool), kv, pts,
+    )
+    steps = [np.asarray(logits)[:, -1]]
+    for t in range(6, 10):
+        logits, kv = forward(
+            params, cfg, jnp.asarray(toks[:, t : t + 1]),
+            jnp.asarray(np.array([[t]], np.int32)),
+            jnp.ones((1, 1), bool), kv, pts,
+        )
+        steps.append(np.asarray(logits)[:, -1])
+    np.testing.assert_allclose(
+        np.stack(steps, axis=1), full[:, 5:10], rtol=2e-4, atol=2e-4
+    )
+
+
+def test_gpt_oss_presets_resolve():
+    from dynamo_tpu.models.registry import get_model
+
+    adapter = get_model("gpt-oss-tiny", dtype="float32")
+    assert adapter.config.expert_mlp == "gpt_oss"
+    assert adapter.config.base.attn_sinks
+
+    big = MoeConfig.gpt_oss_20b()
+    assert big.base.rope_yarn_factor == 32.0
+    assert not big.base.rope_yarn_truncate
+    assert big.num_experts == 32 and big.top_k == 4

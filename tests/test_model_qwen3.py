@@ -132,19 +132,37 @@ def test_qwen3_serves_under_tp_mesh(cpu_mesh_devices):
         _LLAMA_PRESETS.pop("qwen3-test-tiny", None)
 
 
-def test_qwen3_yarn_rope_scaling_refused(tmp_path):
+def test_qwen3_yarn_rope_scaling_loads(tmp_path):
+    """Qwen3's recommended >32k yarn setup (standard yarn) loads with the
+    real scaled frequency table — previously refused, now implemented
+    (the GPT-OSS yarn path is generic)."""
     import json
 
-    from dynamo_tpu.models.registry import get_model
+    from dynamo_tpu.models.llama import LlamaConfig
 
-    d = tmp_path / "q3"
-    d.mkdir()
-    (d / "config.json").write_text(json.dumps({
+    cfg = LlamaConfig.from_hf_config({
         "architectures": ["Qwen3ForCausalLM"], "model_type": "qwen3",
         "vocab_size": 256, "hidden_size": 64, "intermediate_size": 128,
         "num_hidden_layers": 2, "num_attention_heads": 4,
         "num_key_value_heads": 2, "head_dim": 16,
-        "rope_scaling": {"rope_type": "yarn", "factor": 4},
-    }))
-    with pytest.raises(ValueError, match="rope_scaling"):
-        get_model(str(d))
+        "rope_scaling": {
+            "rope_type": "yarn", "factor": 4,
+            "original_max_position_embeddings": 32768,
+        },
+    })
+    assert cfg.rope_yarn_factor == 4.0
+    assert cfg.rope_original_max_position == 32768
+    # the scaled table actually differs from the unscaled one
+    import dataclasses
+
+    import numpy as np
+
+    from dynamo_tpu.models.llama import _rope_inv_freq
+
+    scaled = np.asarray(_rope_inv_freq(cfg))
+    plain = np.asarray(
+        _rope_inv_freq(dataclasses.replace(cfg, rope_yarn_factor=None))
+    )
+    assert not np.allclose(scaled, plain)
+    # high-frequency slots are preserved (extrapolation side of the ramp)
+    assert np.isclose(scaled[0], plain[0])
