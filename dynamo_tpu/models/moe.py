@@ -740,4 +740,18 @@ def moe_param_specs(cfg: MoeConfig, quantized: bool = False):
         layers["we_gate_scale"] = P(None, "ep", None, "tp")
         layers["we_up_scale"] = P(None, "ep", None, "tp")
         layers["we_down_scale"] = P(None, "ep", None, None)
+    if cfg.shared_expert:  # Llama-4: dense MLP beside the experts
+        layers["ws_gate"] = P(None, None, "tp")
+        layers["ws_up"] = P(None, None, "tp")
+        layers["ws_down"] = P(None, "tp", None)
+    if cfg.router_bias:  # GPT-OSS
+        layers["b_router"] = P(None, None)
+    if cfg.expert_mlp == "gpt_oss":  # per-expert biases ride their dims
+        layers["be_gate"] = P(None, "ep", "tp")
+        layers["be_up"] = P(None, "ep", "tp")
+        layers["be_down"] = P(None, "ep", None)
+    if cfg.base.attn_sinks:  # per-head logits shard with the heads
+        layers["sinks"] = P(None, "tp")
+    if cfg.base.attention_out_bias:  # o-proj output dim is unsharded
+        layers["bo"] = P(None, None)
     return specs

@@ -172,3 +172,30 @@ def test_gpt_oss_presets_resolve():
     assert big.base.rope_yarn_factor == 32.0
     assert not big.base.rope_yarn_truncate
     assert big.num_experts == 32 and big.top_k == 4
+
+
+def test_gpt_oss_serves_under_tp_mesh(cpu_mesh_devices):
+    """The new param leaves (sinks, qkv/o biases, router bias, expert
+    biases) need sharding specs — a missing leaf only explodes under a
+    mesh (device_put tree-prefix error)."""
+    from dynamo_tpu.engine import EngineConfig
+    from dynamo_tpu.engine.engine import JaxEngine
+    from dynamo_tpu.engine.request import SamplingParams
+    from dynamo_tpu.parallel.mesh import MeshConfig
+
+    outs = {}
+    for tp in (1, 2):
+        eng = JaxEngine(
+            EngineConfig(
+                model="gpt-oss-tiny", num_pages=64, page_size=4,
+                max_pages_per_seq=8, decode_buckets=(1, 2),
+                prefill_chunk=16, max_seqs=2, dtype="float32", tp=tp,
+            ),
+            mesh_config=MeshConfig(dp=1, tp=tp) if tp > 1 else None,
+        )
+        eng.add_request(
+            "r", [5, 17, 42, 9, 3, 8],
+            SamplingParams(temperature=0.0, max_tokens=3),
+        )
+        outs[tp] = eng.run_to_completion()["r"]
+    assert outs[1] == outs[2]  # sharding must not change tokens

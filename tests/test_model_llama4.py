@@ -192,3 +192,29 @@ def test_from_hf_config_empty_no_rope_list_defaults():
     # explicit pattern roundtrips too
     hf["no_rope_layers"] = [1, 1, 1, 0, 1, 1, 1, 0]
     assert LlamaConfig.from_hf_config(hf).nope_every == 4
+
+
+def test_llama4_serves_under_tp_mesh(cpu_mesh_devices):
+    """Shared-expert weights need sharding specs (missing leaves only
+    explode under a mesh); tp must not change tokens."""
+    from dynamo_tpu.engine import EngineConfig
+    from dynamo_tpu.engine.engine import JaxEngine
+    from dynamo_tpu.engine.request import SamplingParams
+    from dynamo_tpu.parallel.mesh import MeshConfig
+
+    outs = {}
+    for tp in (1, 2):
+        eng = JaxEngine(
+            EngineConfig(
+                model="llama4-tiny", num_pages=64, page_size=4,
+                max_pages_per_seq=8, decode_buckets=(1, 2),
+                prefill_chunk=16, max_seqs=2, dtype="float32", tp=tp,
+            ),
+            mesh_config=MeshConfig(dp=1, tp=tp) if tp > 1 else None,
+        )
+        eng.add_request(
+            "r", [5, 17, 42, 9, 3, 8],
+            SamplingParams(temperature=0.0, max_tokens=3),
+        )
+        outs[tp] = eng.run_to_completion()["r"]
+    assert outs[1] == outs[2]
