@@ -62,6 +62,16 @@ class Qwen2VLVisionConfig:
     mlp_ratio: float = 4.0
     hidden_size: int = 1536  # language-model hidden size (merger output)
     dtype: jnp.dtype = jnp.float32
+    #: "qwen2" (LayerNorm, QuickGELU MLP, full attention) or "qwen2_5"
+    #: (RMSNorm, biased SwiGLU MLP, WINDOWED attention except
+    #: fullatt_block_indexes, window-reordered merge units)
+    variant: str = "qwen2"
+    #: qwen2_5: window edge in PIXELS (112 = 8 patches = 4 merge units)
+    window_size: int = 112
+    #: qwen2_5: blocks that attend across the whole frame
+    fullatt_block_indexes: tuple[int, ...] = ()
+    #: qwen2_5: explicit MLP width (qwen2 uses mlp_ratio)
+    intermediate_size: Optional[int] = None
 
     @property
     def head_dim(self) -> int:
@@ -78,6 +88,8 @@ class Qwen2VLVisionConfig:
 
     @property
     def mlp_dim(self) -> int:
+        if self.intermediate_size is not None:
+            return self.intermediate_size
         return int(self.embed_dim * self.mlp_ratio)
 
     @staticmethod
@@ -93,6 +105,28 @@ class Qwen2VLVisionConfig:
         """The production tower (same for 2B/7B/72B; only the merger's
         output dim differs)."""
         return Qwen2VLVisionConfig(hidden_size=hidden_size)
+
+    @staticmethod
+    def tiny_25(hidden_size: int = 64) -> "Qwen2VLVisionConfig":
+        """Test-scale Qwen2.5-VL tower: 4 blocks (block 3 full-attention,
+        the rest windowed at 2 merge units per side)."""
+        return Qwen2VLVisionConfig(
+            depth=4, embed_dim=32, num_heads=4, patch_size=4,
+            temporal_patch_size=2, spatial_merge_size=2,
+            hidden_size=hidden_size, variant="qwen2_5",
+            window_size=16, fullatt_block_indexes=(3,),
+            intermediate_size=48,
+        )
+
+    @staticmethod
+    def qwen2_5_vl(hidden_size: int) -> "Qwen2VLVisionConfig":
+        """Qwen2.5-VL production tower (3B/7B/72B share it)."""
+        return Qwen2VLVisionConfig(
+            depth=32, embed_dim=1280, num_heads=16, patch_size=14,
+            hidden_size=hidden_size, variant="qwen2_5",
+            window_size=112, fullatt_block_indexes=(7, 15, 23, 31),
+            intermediate_size=3420,
+        )
 
 
 def text_config(
@@ -152,6 +186,23 @@ def text_2b() -> LlamaConfig:
 
 def text_7b() -> LlamaConfig:
     """Qwen2-VL-7B-Instruct language model."""
+    return text_config(
+        vocab_size=152064, hidden_size=3584, intermediate_size=18944,
+        num_layers=28, num_heads=28, num_kv_heads=4, head_dim=128,
+    )
+
+
+def text_25_3b() -> LlamaConfig:
+    """Qwen2.5-VL-3B-Instruct language model."""
+    return text_config(
+        vocab_size=151936, hidden_size=2048, intermediate_size=11008,
+        num_layers=36, num_heads=16, num_kv_heads=2, head_dim=128,
+        tie_word_embeddings=True,
+    )
+
+
+def text_25_7b() -> LlamaConfig:
+    """Qwen2.5-VL-7B-Instruct language model."""
     return text_config(
         vocab_size=152064, hidden_size=3584, intermediate_size=18944,
         num_layers=28, num_heads=28, num_kv_heads=4, head_dim=128,
@@ -260,6 +311,38 @@ def _rot_pos_emb(cfg: Qwen2VLVisionConfig, grid_thw) -> np.ndarray:
     return np.concatenate(out, axis=0).astype(np.float32)
 
 
+def _window_order(cfg: Qwen2VLVisionConfig, grid_thw):
+    """Qwen2.5-VL window reordering, all static per grid: merge units are
+    permuted window-major (HF get_window_index), and each PATCH gets a
+    window-segment id in the reordered sequence. Returns
+    (unit_order [Nu], patch_win_seg [N] in reordered order)."""
+    m = cfg.spatial_merge_size
+    win = cfg.window_size // m // cfg.patch_size  # merge units per side
+    orders = []
+    segs = []
+    unit_base = 0
+    seg_base = 0
+    for t, h, w in grid_thw:
+        lh, lw = h // m, w // m
+        idx = np.arange(t * lh * lw).reshape(t, lh, lw)
+        ph, pw = (-lh) % win, (-lw) % win
+        padded = np.full((t, lh + ph, lw + pw), -1, np.int64)
+        padded[:, :lh, :lw] = idx
+        nh, nw = (lh + ph) // win, (lw + pw) // win
+        padded = (
+            padded.reshape(t, nh, win, nw, win)
+            .transpose(0, 1, 3, 2, 4)
+            .reshape(t * nh * nw, win * win)
+        )
+        for wi, row in enumerate(padded):
+            units = row[row != -1]
+            orders.append(units + unit_base)
+            segs.append(np.full(len(units) * m * m, seg_base + wi))
+        unit_base += t * lh * lw
+        seg_base += t * nh * nw
+    return np.concatenate(orders), np.concatenate(segs)
+
+
 def init_vision_params(key: jax.Array, cfg: Qwen2VLVisionConfig) -> dict:
     ks = list(jax.random.split(key, 8))
 
@@ -271,25 +354,45 @@ def init_vision_params(key: jax.Array, cfg: Qwen2VLVisionConfig) -> dict:
     e, md = cfg.embed_dim, cfg.mlp_dim
     d = cfg.depth
     merged = e * cfg.spatial_merge_size**2
-    blocks = {
-        "n1_w": jnp.ones((d, e), cfg.dtype),
-        "n1_b": jnp.zeros((d, e), cfg.dtype),
-        "qkv_w": dense(ks[1], (d, e, 3 * e), e),
-        "qkv_b": jnp.zeros((d, 3 * e), cfg.dtype),
-        "proj_w": dense(ks[2], (d, e, e), e),
-        "proj_b": jnp.zeros((d, e), cfg.dtype),
-        "n2_w": jnp.ones((d, e), cfg.dtype),
-        "n2_b": jnp.zeros((d, e), cfg.dtype),
-        "fc1_w": dense(ks[3], (d, e, md), e),
-        "fc1_b": jnp.zeros((d, md), cfg.dtype),
-        "fc2_w": dense(ks[4], (d, md, e), md),
-        "fc2_b": jnp.zeros((d, e), cfg.dtype),
-    }
+    if cfg.variant == "qwen2_5":
+        blocks = {
+            "n1_w": jnp.ones((d, e), cfg.dtype),
+            "qkv_w": dense(ks[1], (d, e, 3 * e), e),
+            "qkv_b": jnp.zeros((d, 3 * e), cfg.dtype),
+            "proj_w": dense(ks[2], (d, e, e), e),
+            "proj_b": jnp.zeros((d, e), cfg.dtype),
+            "n2_w": jnp.ones((d, e), cfg.dtype),
+            "gate_w": dense(ks[3], (d, e, md), e),
+            "gate_b": jnp.zeros((d, md), cfg.dtype),
+            "up_w": dense(ks[4], (d, e, md), e),
+            "up_b": jnp.zeros((d, md), cfg.dtype),
+            "down_w": dense(ks[7], (d, md, e), md),
+            "down_b": jnp.zeros((d, e), cfg.dtype),
+        }
+        extra = {"ln_q_w": jnp.ones((e,), cfg.dtype)}
+    else:
+        blocks = {
+            "n1_w": jnp.ones((d, e), cfg.dtype),
+            "n1_b": jnp.zeros((d, e), cfg.dtype),
+            "qkv_w": dense(ks[1], (d, e, 3 * e), e),
+            "qkv_b": jnp.zeros((d, 3 * e), cfg.dtype),
+            "proj_w": dense(ks[2], (d, e, e), e),
+            "proj_b": jnp.zeros((d, e), cfg.dtype),
+            "n2_w": jnp.ones((d, e), cfg.dtype),
+            "n2_b": jnp.zeros((d, e), cfg.dtype),
+            "fc1_w": dense(ks[3], (d, e, md), e),
+            "fc1_b": jnp.zeros((d, md), cfg.dtype),
+            "fc2_w": dense(ks[4], (d, md, e), md),
+            "fc2_b": jnp.zeros((d, e), cfg.dtype),
+        }
+        extra = {
+            "ln_q_w": jnp.ones((e,), cfg.dtype),
+            "ln_q_b": jnp.zeros((e,), cfg.dtype),
+        }
     return {
         "patch_w": dense(ks[0], (cfg.patch_dim, e), cfg.patch_dim),
         "blocks": blocks,
-        "ln_q_w": jnp.ones((e,), cfg.dtype),
-        "ln_q_b": jnp.zeros((e,), cfg.dtype),
+        **extra,
         "merge1_w": dense(ks[5], (merged, merged), merged),
         "merge1_b": jnp.zeros((merged,), cfg.dtype),
         "merge2_w": dense(ks[6], (merged, cfg.hidden_size), merged),
@@ -308,6 +411,12 @@ def _quick_gelu(x):
     return x * jax.nn.sigmoid(1.702 * x)
 
 
+def _rms(x, w, eps=1e-6):
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+    return (x32 * jax.lax.rsqrt(var + eps) * w).astype(x.dtype)
+
+
 def vision_forward(
     params: dict,
     cfg: Qwen2VLVisionConfig,
@@ -315,25 +424,44 @@ def vision_forward(
     grid_thw: Sequence[tuple[int, int, int]],  # static per-image grids
 ) -> jax.Array:
     """Encode flattened conv patches into [N / merge^2, hidden_size]
-    language-model embeddings. Attention is full within each TEMPORAL
-    FRAME and blocked across frames and images (HF cu_seqlens repeats
-    h*w per temporal patch)."""
+    language-model embeddings. qwen2: attention is full within each
+    TEMPORAL FRAME and blocked across frames/images (HF cu_seqlens repeat
+    h*w per temporal patch). qwen2_5: merge units are reordered
+    window-major; most blocks attend only within their window
+    (cu_window_seqlens), the fullatt_block_indexes within the frame; the
+    merged outputs are restored to raster order at the end."""
+    v25 = cfg.variant == "qwen2_5"
     h = patches.astype(cfg.dtype) @ params["patch_w"]  # [N, E]
-    angles = jnp.asarray(_rot_pos_emb(cfg, grid_thw))  # [N, hd/2]
-    cos = jnp.cos(angles)[:, None, :]  # [N, 1, hd/2]
-    sin = jnp.sin(angles)[:, None, :]
+    angles_np = _rot_pos_emb(cfg, grid_thw)  # [N, hd/2] raster order
 
-    # block-diagonal mask per (image, temporal frame): HF's cu_seqlens
-    # repeat h*w per temporal patch, so a video frame attends only
-    # within itself (static: grids are static)
     frame_lens = [gh * gw for t, gh, gw in grid_thw for _ in range(t)]
-    seg = np.repeat(np.arange(len(frame_lens)), frame_lens)
-    mask = jnp.asarray(seg[:, None] == seg[None, :])
+    raw_seg = np.repeat(np.arange(len(frame_lens)), frame_lens)
+    unit_order = None
+    if v25:
+        mm = cfg.spatial_merge_size**2
+        unit_order, win_seg = _window_order(cfg, grid_thw)
+        patch_order = (
+            unit_order[:, None] * mm + np.arange(mm)
+        ).reshape(-1)
+        h = h[jnp.asarray(patch_order)]
+        angles_np = angles_np[patch_order]
+        full_seg = raw_seg[patch_order]
+        mask_full = jnp.asarray(full_seg[:, None] == full_seg[None, :])
+        mask_win = jnp.asarray(win_seg[:, None] == win_seg[None, :])
+    else:
+        mask_full = jnp.asarray(raw_seg[:, None] == raw_seg[None, :])
+        mask_win = mask_full
+
+    cos = jnp.cos(jnp.asarray(angles_np))[:, None, :]  # [N, 1, hd/2]
+    sin = jnp.sin(jnp.asarray(angles_np))[:, None, :]
     nh, hd = cfg.num_heads, cfg.head_dim
     scale = 1.0 / np.sqrt(hd)
 
-    def block(h, lp):
-        x = _ln(h, lp["n1_w"], lp["n1_b"])
+    def block(h, lp, mask):
+        if v25:
+            x = _rms(h, lp["n1_w"])
+        else:
+            x = _ln(h, lp["n1_w"], lp["n1_b"])
         qkv = x @ lp["qkv_w"] + lp["qkv_b"]  # [N, 3E]
         n = qkv.shape[0]
         q, k, v = (
@@ -353,18 +481,53 @@ def vision_forward(
         out = jnp.einsum("hqk,khd->qhd", attn, v.astype(jnp.float32))
         out = out.reshape(n, nh * hd).astype(h.dtype)
         h = h + (out @ lp["proj_w"] + lp["proj_b"])
-        x = _ln(h, lp["n2_w"], lp["n2_b"])
-        m = _quick_gelu((x @ lp["fc1_w"] + lp["fc1_b"]).astype(jnp.float32))
-        h = h + (m.astype(h.dtype) @ lp["fc2_w"] + lp["fc2_b"])
-        return h, None
+        if v25:
+            x = _rms(h, lp["n2_w"])
+            g = jax.nn.silu(
+                (x @ lp["gate_w"] + lp["gate_b"]).astype(jnp.float32)
+            )
+            u = (x @ lp["up_w"] + lp["up_b"]).astype(jnp.float32)
+            h = h + (
+                (g * u).astype(h.dtype) @ lp["down_w"] + lp["down_b"]
+            )
+        else:
+            x = _ln(h, lp["n2_w"], lp["n2_b"])
+            m = _quick_gelu(
+                (x @ lp["fc1_w"] + lp["fc1_b"]).astype(jnp.float32)
+            )
+            h = h + (m.astype(h.dtype) @ lp["fc2_w"] + lp["fc2_b"])
+        return h
 
-    h, _ = jax.lax.scan(block, h, params["blocks"])
-    # PatchMerger: LN then group merge^2 CONSECUTIVE patches (the image
-    # processor already emits merge-group-major order)
-    x = _ln(h, params["ln_q_w"], params["ln_q_b"])
+    if v25:
+        # ONE traced block for all depth layers: scan with a per-layer
+        # flag indexing the stacked [2, N, N] masks (unrolling would
+        # compile depth copies of the O(N^2) attention per grid shape)
+        masks = jnp.stack([mask_win, mask_full])
+        flags = jnp.asarray(
+            [int(i in cfg.fullatt_block_indexes) for i in range(cfg.depth)],
+            jnp.int32,
+        )
+        h, _ = jax.lax.scan(
+            lambda c, xs: (block(c, xs[0], masks[xs[1]]), None),
+            h, (params["blocks"], flags),
+        )
+    else:
+        h, _ = jax.lax.scan(
+            lambda c, lp: (block(c, lp, mask_full), None),
+            h, params["blocks"],
+        )
+    # PatchMerger: norm then group merge^2 CONSECUTIVE patches (raster
+    # order for qwen2; window order for qwen2_5, restored after)
+    if v25:
+        x = _rms(h, params["ln_q_w"])
+    else:
+        x = _ln(h, params["ln_q_w"], params["ln_q_b"])
     x = x.reshape(-1, cfg.embed_dim * cfg.spatial_merge_size**2)
     x = jax.nn.gelu(x @ params["merge1_w"] + params["merge1_b"], approximate=False)
-    return x @ params["merge2_w"] + params["merge2_b"]
+    out = x @ params["merge2_w"] + params["merge2_b"]
+    if v25:
+        out = out[jnp.asarray(np.argsort(unit_order))]
+    return out
 
 
 def pixels_to_patches(
@@ -423,9 +586,24 @@ def vision_params_from_torch_state_dict(
     patch = np.asarray(
         sd[prefix + "patch_embed.proj.weight"].to("cpu").float().numpy()
     )  # [E, C, tps, ps, ps] conv kernel == linear on the flattened patch
-    return {
-        "patch_w": jnp.asarray(patch.reshape(cfg.embed_dim, -1).T, cfg.dtype),
-        "blocks": {
+    if cfg.variant == "qwen2_5":
+        blocks = {
+            "n1_w": stack("blocks.{}.norm1.weight"),
+            "qkv_w": stack("blocks.{}.attn.qkv.weight", transpose=True),
+            "qkv_b": stack("blocks.{}.attn.qkv.bias"),
+            "proj_w": stack("blocks.{}.attn.proj.weight", transpose=True),
+            "proj_b": stack("blocks.{}.attn.proj.bias"),
+            "n2_w": stack("blocks.{}.norm2.weight"),
+            "gate_w": stack("blocks.{}.mlp.gate_proj.weight", transpose=True),
+            "gate_b": stack("blocks.{}.mlp.gate_proj.bias"),
+            "up_w": stack("blocks.{}.mlp.up_proj.weight", transpose=True),
+            "up_b": stack("blocks.{}.mlp.up_proj.bias"),
+            "down_w": stack("blocks.{}.mlp.down_proj.weight", transpose=True),
+            "down_b": stack("blocks.{}.mlp.down_proj.bias"),
+        }
+        extra = {"ln_q_w": t("merger.ln_q.weight")}
+    else:
+        blocks = {
             "n1_w": stack("blocks.{}.norm1.weight"),
             "n1_b": stack("blocks.{}.norm1.bias"),
             "qkv_w": stack("blocks.{}.attn.qkv.weight", transpose=True),
@@ -438,9 +616,15 @@ def vision_params_from_torch_state_dict(
             "fc1_b": stack("blocks.{}.mlp.fc1.bias"),
             "fc2_w": stack("blocks.{}.mlp.fc2.weight", transpose=True),
             "fc2_b": stack("blocks.{}.mlp.fc2.bias"),
-        },
-        "ln_q_w": t("merger.ln_q.weight"),
-        "ln_q_b": t("merger.ln_q.bias"),
+        }
+        extra = {
+            "ln_q_w": t("merger.ln_q.weight"),
+            "ln_q_b": t("merger.ln_q.bias"),
+        }
+    return {
+        "patch_w": jnp.asarray(patch.reshape(cfg.embed_dim, -1).T, cfg.dtype),
+        "blocks": blocks,
+        **extra,
         "merge1_w": t("merger.mlp.0.weight", transpose=True),
         "merge1_b": t("merger.mlp.0.bias"),
         "merge2_w": t("merger.mlp.2.weight", transpose=True),

@@ -86,6 +86,8 @@ _LLAMA_PRESETS.update(
         "qwen2-vl-tiny": qwen2vl_mod.text_tiny,
         "qwen2-vl-2b": qwen2vl_mod.text_2b,
         "qwen2-vl-7b": qwen2vl_mod.text_7b,
+        "qwen2.5-vl-3b": qwen2vl_mod.text_25_3b,
+        "qwen2.5-vl-7b": qwen2vl_mod.text_25_7b,
     }
 )
 
@@ -303,8 +305,11 @@ def get_model(
         ):
             mla_cfg = MlaConfig.from_hf_config(hf)
         elif (
-            arch == "Qwen2VLForConditionalGeneration"
-            or hf.get("model_type") == "qwen2_vl"
+            arch in (
+                "Qwen2VLForConditionalGeneration",
+                "Qwen2_5_VLForConditionalGeneration",
+            )
+            or hf.get("model_type") in ("qwen2_vl", "qwen2_5_vl")
         ):
             from dynamo_tpu.models import qwen2vl
 
@@ -417,11 +422,16 @@ def get_model(
 
 def _load_qwen2vl_checkpoint(path: str, cfg: LlamaConfig):
     import torch
-    from transformers import Qwen2VLForConditionalGeneration
 
     from dynamo_tpu.models.qwen2vl import remap_language_state_dict
 
-    model = Qwen2VLForConditionalGeneration.from_pretrained(
+    with open(os.path.join(path, "config.json")) as f:
+        mt = json.load(f).get("model_type")
+    if mt == "qwen2_5_vl":
+        from transformers import Qwen2_5_VLForConditionalGeneration as cls
+    else:
+        from transformers import Qwen2VLForConditionalGeneration as cls
+    model = cls.from_pretrained(
         path, torch_dtype=torch.float32, low_cpu_mem_usage=True
     )
     return llama_mod.params_from_torch_state_dict(

@@ -45,7 +45,8 @@ class EncodeWorker:
 
             name = self.config.get("vision-model", "clip-vit-l-14")
             proj_dim = int(self.config.get("proj-dim", 4096))
-            if "qwen2-vl" in name or self._is_qwen2vl_dir(name):
+            if ("qwen2-vl" in name or "qwen2.5-vl" in name
+                    or self._is_qwen2vl_dir(name)):
                 return self._build_qwen2vl(name, proj_dim)
             if os.path.isdir(name):
                 # real weights: an HF CLIP(-vision) checkpoint directory
@@ -80,7 +81,7 @@ class EncodeWorker:
             return False
         with open(cfg_path) as f:
             hf = json.load(f)
-        return hf.get("model_type") == "qwen2_vl"
+        return hf.get("model_type") in ("qwen2_vl", "qwen2_5_vl")
 
     def _build_qwen2vl(self, name: str, proj_dim: int):
         """Qwen2-VL tower: pixels are patched in the HF processor layout
@@ -101,17 +102,36 @@ class EncodeWorker:
 
         if os.path.isdir(name):
             with open(os.path.join(name, "config.json")) as f:
-                hfv = json.load(f)["vision_config"]
+                full = json.load(f)
+            hfv = full["vision_config"]
+            v25 = full.get("model_type") == "qwen2_5_vl"
             cfg = qwen2vl.Qwen2VLVisionConfig(
                 depth=hfv.get("depth", 32),
-                embed_dim=hfv.get("embed_dim", 1280),
+                # 2.5 renames embed_dim -> hidden_size and the merger
+                # output -> out_hidden_size
+                embed_dim=hfv.get("embed_dim")
+                or hfv.get("hidden_size", 1280),
                 num_heads=hfv.get("num_heads", 16),
                 in_channels=hfv.get("in_channels", 3),
                 patch_size=hfv.get("patch_size", 14),
                 temporal_patch_size=hfv.get("temporal_patch_size", 2),
                 spatial_merge_size=hfv.get("spatial_merge_size", 2),
                 mlp_ratio=hfv.get("mlp_ratio", 4.0),
-                hidden_size=hfv.get("hidden_size", proj_dim),
+                hidden_size=(
+                    hfv.get("out_hidden_size", proj_dim)
+                    if v25
+                    else hfv.get("hidden_size", proj_dim)
+                ),
+                variant="qwen2_5" if v25 else "qwen2",
+                window_size=hfv.get("window_size", 112),
+                fullatt_block_indexes=tuple(
+                    hfv.get("fullatt_block_indexes")
+                    # HF's default when the config omits it
+                    or ((7, 15, 23, 31) if v25 else ())
+                ),
+                intermediate_size=hfv.get("intermediate_size")
+                if v25
+                else None,
             )
             from safetensors import torch as st
 
@@ -123,6 +143,14 @@ class EncodeWorker:
             params = qwen2vl.vision_params_from_torch_state_dict(sd, cfg)
         elif name == "qwen2-vl-tiny":
             cfg = qwen2vl.Qwen2VLVisionConfig.tiny(hidden_size=proj_dim)
+            params = qwen2vl.init_vision_params(jax.random.key(0), cfg)
+        elif name == "qwen2.5-vl-tiny":
+            cfg = qwen2vl.Qwen2VLVisionConfig.tiny_25(hidden_size=proj_dim)
+            params = qwen2vl.init_vision_params(jax.random.key(0), cfg)
+        elif "2.5" in name or "2_5" in name:
+            cfg = qwen2vl.Qwen2VLVisionConfig.qwen2_5_vl(
+                hidden_size=proj_dim
+            )
             params = qwen2vl.init_vision_params(jax.random.key(0), cfg)
         else:
             # production geometry (depth 32, patch 14 — images must be

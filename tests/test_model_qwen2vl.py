@@ -317,3 +317,84 @@ def test_video_temporal_grid_golden():
     )
     np.testing.assert_array_equal(pos, ref_pos[:, 0].numpy())
     assert delta == int(ref_delta[0, 0])
+
+
+# -- Qwen2.5-VL tower --------------------------------------------------------
+
+
+def _hf_25_vision(vcfg):
+    torch = pytest.importorskip("torch")
+    from transformers.models.qwen2_5_vl import modeling_qwen2_5_vl as m25
+    from transformers.models.qwen2_5_vl.configuration_qwen2_5_vl import (
+        Qwen2_5_VLVisionConfig,
+    )
+
+    hf_cfg = Qwen2_5_VLVisionConfig(
+        depth=vcfg.depth, hidden_size=vcfg.embed_dim,
+        num_heads=vcfg.num_heads, in_channels=vcfg.in_channels,
+        patch_size=vcfg.patch_size,
+        temporal_patch_size=vcfg.temporal_patch_size,
+        spatial_merge_size=vcfg.spatial_merge_size,
+        window_size=vcfg.window_size,
+        fullatt_block_indexes=list(vcfg.fullatt_block_indexes),
+        intermediate_size=vcfg.intermediate_size,
+        out_hidden_size=vcfg.hidden_size,
+        hidden_act="silu",
+        attn_implementation="eager",
+    )
+    torch.manual_seed(31)
+    return m25.Qwen2_5_VisionTransformerPretrainedModel(hf_cfg).eval()
+
+
+def test_qwen2_5_vision_tower_golden():
+    """The 2.5 tower: RMSNorm blocks, biased SwiGLU MLP, window-major
+    reordering with per-block window/full attention, raster-order
+    restore — vs HF Qwen2_5_VisionTransformer. Grid (1, 8, 12) gives
+    2x3 windows of 2x2 merge units, so the window mask and the reorder
+    both bite."""
+    torch = pytest.importorskip("torch")
+    vcfg = qwen2vl.Qwen2VLVisionConfig.tiny_25(hidden_size=64)
+    model = _hf_25_vision(vcfg)
+    vparams = qwen2vl.vision_params_from_torch_state_dict(
+        model.state_dict(), vcfg, prefix=""
+    )
+    assert "gate_w" in vparams["blocks"] and "n1_b" not in vparams["blocks"]
+
+    rng = np.random.default_rng(21)
+    grid = (1, 8, 12)
+    patches = rng.normal(size=(96, vcfg.patch_dim)).astype(np.float32)
+    with torch.no_grad():
+        ref = model(
+            torch.from_numpy(patches), grid_thw=torch.tensor([list(grid)])
+        ).numpy()
+    ours = np.asarray(
+        qwen2vl.vision_forward(vparams, vcfg, jnp.asarray(patches), [grid])
+    )
+    assert ours.shape == ref.shape == (24, 64)
+    np.testing.assert_allclose(ours, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_qwen2_5_windowing_matters():
+    """The window mask and full-attention block selection must actually
+    flow: making every block full-attention changes the output."""
+    from dataclasses import replace
+
+    vcfg = qwen2vl.Qwen2VLVisionConfig.tiny_25()
+    import jax as _jax
+
+    vparams = qwen2vl.init_vision_params(_jax.random.key(8), vcfg)
+    rng = np.random.default_rng(22)
+    grid = (1, 8, 12)
+    patches = rng.normal(size=(96, vcfg.patch_dim)).astype(np.float32)
+    base = np.asarray(
+        qwen2vl.vision_forward(vparams, vcfg, jnp.asarray(patches), [grid])
+    )
+    all_full = replace(vcfg, fullatt_block_indexes=(0, 1, 2, 3))
+    assert not np.allclose(
+        base,
+        np.asarray(
+            qwen2vl.vision_forward(
+                vparams, all_full, jnp.asarray(patches), [grid]
+            )
+        ),
+    )

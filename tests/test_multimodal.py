@@ -318,3 +318,66 @@ def test_qwen2vl_with_host_kv_offload():
     assert run(eng, "a2", text_a) == expected
     assert eng.allocator.stats.onboarded_blocks > 0
     assert image_req(eng, "img1", seed=1) == img_first
+
+
+def test_multimodal_graph_qwen2_5_vl_end_to_end():
+    """Qwen2.5-VL tower (windowed attention, RMSNorm, SwiGLU) through the
+    encode/splice pipeline into the m-RoPE language model."""
+    import aiohttp
+
+    from dynamo_tpu.sdk.serving import serve_graph
+    from examples.multimodal.graph import MultimodalFrontend
+
+    cfg = {
+        "MultimodalFrontend": {"port": 0},
+        "Worker": {
+            "model": "qwen2-vl-tiny", "engine": "jax", "dtype": "float32",
+            "page-size": 4, "num-pages": 64, "max-context": 128,
+            "prefill-chunk": 16, "max-seqs": 4, "decode-steps": 1,
+        },
+        "EncodeWorker": {"vision-model": "qwen2.5-vl-tiny", "proj-dim": 64},
+    }
+
+    async def run():
+        handle = await serve_graph(MultimodalFrontend, config=cfg, static=True)
+        try:
+            frontend = handle.instance_of(MultimodalFrontend)
+            await asyncio.sleep(0.5)
+            # 16x16 pixels -> 4x4 patch grid -> 2x2 merged = 4 image
+            # tokens; window 16px = 2x2 merge units, so one window
+            pixels = np.random.default_rng(0).normal(
+                size=(16, 16, 3)
+            ).astype(np.float32)
+            import base64
+
+            async with aiohttp.ClientSession() as sess:
+                r = await sess.post(
+                    f"http://127.0.0.1:{frontend.port}/v1/chat/completions",
+                    json={
+                        "model": "qwen2-vl-tiny",
+                        "messages": [
+                            {
+                                "role": "user",
+                                "content": [
+                                    {"type": "text", "text": "describe"},
+                                    {
+                                        "type": "image_pixels",
+                                        "data": base64.b64encode(
+                                            pixels.tobytes()
+                                        ).decode(),
+                                        "shape": [16, 16, 3],
+                                    },
+                                ],
+                            }
+                        ],
+                        "max_tokens": 4,
+                    },
+                    timeout=aiohttp.ClientTimeout(total=300),
+                )
+                assert r.status == 200, await r.text()
+                body = await r.json()
+                assert body["choices"][0]["message"]["content"] is not None
+        finally:
+            await handle.stop()
+
+    asyncio.run(run())
