@@ -262,6 +262,17 @@ class LlamaConfig:
         )
 
     @staticmethod
+    def phi4() -> "LlamaConfig":
+        """Phi-4 (14B): the same phi3 architecture (fused qkv/gate_up
+        split at load, tests/test_model_phi3.py) at 40 layers with GQA
+        and a 250k rope base."""
+        return LlamaConfig(
+            vocab_size=100352, hidden_size=5120, intermediate_size=17920,
+            num_layers=40, num_heads=40, num_kv_heads=10, head_dim=128,
+            rope_theta=250000.0, rms_norm_eps=1e-5,
+        )
+
+    @staticmethod
     def mistral_7b() -> "LlamaConfig":
         """Mistral-7B-v0.1: Llama architecture + sliding-window attention
         on every layer (window 4096)."""

@@ -74,8 +74,9 @@ _LLAMA_PRESETS: dict[str, Callable[[], LlamaConfig]] = {
     "mistral-7b": LlamaConfig.mistral_7b,
     # Qwen3 = Llama + per-head q/k RMSNorm (no attention bias).
     "qwen3-8b": LlamaConfig.qwen3_8b,
-    # Phi-3 = Llama with fused qkv/gate_up in the checkpoint.
+    # Phi-3/Phi-4 = Llama with fused qkv/gate_up in the checkpoint.
     "phi3-mini": LlamaConfig.phi3_mini,
+    "phi4": LlamaConfig.phi4,
 }
 
 
