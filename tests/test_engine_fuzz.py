@@ -16,7 +16,10 @@ from dynamo_tpu.engine.engine import JaxEngine
 from dynamo_tpu.engine.request import SamplingParams
 
 
-@pytest.mark.parametrize("model,rounds", [("tiny", 5), ("mla-tiny-moe", 2)])
+@pytest.mark.parametrize(
+    "model,rounds",
+    [("tiny", 5), ("mla-tiny-moe", 2), ("gpt-oss-tiny", 2)],
+)
 def test_engine_fuzz_bounded(model, rounds):
     rng = random.Random(20260730)
     base = dataclasses.replace(EngineConfig.for_tests(), model=model)
