@@ -41,6 +41,8 @@ def output_to_dict(out: StepOutput) -> dict:
         d["top_logprobs"] = [
             [[tid, lp] for tid, lp in alts] for alts in out.top_logprobs
         ]
+    if out.cached_tokens is not None:
+        d["cached_tokens"] = out.cached_tokens
     return d
 
 

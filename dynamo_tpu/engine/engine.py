@@ -1247,6 +1247,9 @@ class JaxEngine:
                 is_first=first,
                 logprobs=lps,
                 top_logprobs=tops,
+                # prefix-cache accounting rides the first output (OpenAI
+                # usage.prompt_tokens_details.cached_tokens)
+                cached_tokens=req.num_cached_prompt_tokens if first else None,
             )
         ]
 

@@ -119,3 +119,6 @@ class StepOutput:
     logprobs: Optional[tuple[float, ...]] = None
     #: per-token top-N alternatives [(token_id, logprob), ...]
     top_logprobs: Optional[tuple[tuple[tuple[int, float], ...], ...]] = None
+    #: prompt tokens served from the prefix cache (first output only —
+    #: OpenAI usage.prompt_tokens_details.cached_tokens)
+    cached_tokens: Optional[int] = None

@@ -347,6 +347,7 @@ class OpenAIPreprocessor:
         stop = StopChecker(preprocessed.stop_strings)
         created = now()
         completion_tokens = 0
+        cached_tokens = 0
         first = True
         finish: Optional[str] = None
         #: logprob entries for tokens whose text is still buffered by the
@@ -413,6 +414,8 @@ class OpenAIPreprocessor:
 
         stop_ids = set(preprocessed.stop_token_ids)
         async for event in engine_stream:
+            if event.get("cached_tokens"):
+                cached_tokens = int(event["cached_tokens"])
             for i, tok in enumerate(event.get("token_ids", ())):
                 completion_tokens += 1
                 if tok in stop_ids and not preprocessed.ignore_eos:
@@ -465,6 +468,11 @@ class OpenAIPreprocessor:
                     completion_tokens=completion_tokens,
                     total_tokens=(
                         len(preprocessed.token_ids) + completion_tokens
+                    ),
+                    prompt_tokens_details=(
+                        {"cached_tokens": cached_tokens}
+                        if cached_tokens
+                        else None
                     ),
                 ),
             )

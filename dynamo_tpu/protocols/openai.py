@@ -111,6 +111,9 @@ class Usage(BaseModel):
     prompt_tokens: int = 0
     completion_tokens: int = 0
     total_tokens: int = 0
+    #: OpenAI detail block; carries {"cached_tokens": n} when the prompt
+    #: hit the prefix cache
+    prompt_tokens_details: Optional[dict[str, int]] = None
 
 
 def combine_usages(usages: list["Usage"]) -> Optional["Usage"]:
@@ -121,6 +124,15 @@ def combine_usages(usages: list["Usage"]) -> Optional["Usage"]:
     u = Usage(
         prompt_tokens=usages[0].prompt_tokens,
         completion_tokens=sum(x.completion_tokens for x in usages),
+        # deterministic across n>1 sibling completion order: the MAX of
+        # the siblings' cached counts (a fresh prefill plus cache-hitting
+        # siblings must not flip between absent and ~full-prompt per run)
+        prompt_tokens_details=max(
+            (x.prompt_tokens_details for x in usages
+             if x.prompt_tokens_details),
+            key=lambda d: d.get("cached_tokens", 0),
+            default=None,
+        ),
     )
     u.total_tokens = u.prompt_tokens + u.completion_tokens
     return u

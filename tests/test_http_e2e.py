@@ -314,3 +314,51 @@ def test_clear_kv_blocks_fans_out_to_workers():
             await worker.stop()
 
     asyncio.run(run())
+
+
+def test_usage_reports_cached_prompt_tokens():
+    """OpenAI usage.prompt_tokens_details.cached_tokens: a repeated
+    prompt's second run reports the prefix-cache hit (vLLM's
+    num_cached_tokens parity)."""
+    from dynamo_tpu.engine import EngineConfig
+    from dynamo_tpu.engine.async_engine import AsyncEngineRunner
+    from dynamo_tpu.engine.engine import JaxEngine
+
+    async def main():
+        engine = JaxEngine(EngineConfig.for_tests())
+        runner = AsyncEngineRunner(engine)
+        runner.start()
+        card = ModelDeploymentCard(
+            name="tiny", tokenizer={"kind": "byte"}, context_length=32
+        )
+        manager = ModelManager()
+        manager.add("tiny", local_pipeline(card, runner))
+        svc = HttpService(manager, host="127.0.0.1", port=0)
+        await svc.start()
+        base = f"http://127.0.0.1:{svc.port}"
+        body = {
+            "model": "tiny",
+            "messages": [{"role": "user", "content": "abcd"}],
+            "max_tokens": 2,
+            "temperature": 0,
+        }
+        try:
+            async with aiohttp.ClientSession() as s:
+                async with s.post(f"{base}/v1/chat/completions", json=body) as r:
+                    first = await r.json()
+                async with s.post(f"{base}/v1/chat/completions", json=body) as r:
+                    second = await r.json()
+            assert first["usage"].get("prompt_tokens_details") in (None, {})
+            details = second["usage"]["prompt_tokens_details"]
+            assert details and details["cached_tokens"] > 0
+            assert details["cached_tokens"] <= second["usage"]["prompt_tokens"]
+            # identical greedy output either way (cache is exact)
+            assert (
+                first["choices"][0]["message"]["content"]
+                == second["choices"][0]["message"]["content"]
+            )
+        finally:
+            runner.stop()
+            await svc.stop()
+
+    run(main())
