@@ -101,13 +101,14 @@ def run_topology(args, disagg: bool) -> dict:
 
         warmup_and_flush(
             f"http://127.0.0.1:{hport}", args.model, texts, args.warmup,
-            args.concurrency,
+            args.concurrency, request_timeout_s=args.request_timeout,
         )
 
         out = asyncio.run(
             bench_http(
                 f"http://127.0.0.1:{hport}", args.model, texts,
                 args.concurrency,
+                request_timeout_s=args.request_timeout,
             )
         )
         out["topology"] = "disagg" if disagg else "agg"
@@ -150,12 +151,24 @@ def main(argv=None) -> None:
     p.add_argument("--isl", type=int, default=24)
     p.add_argument("--osl", type=int, default=8)
     p.add_argument("--concurrency", type=int, default=4)
+    p.add_argument("--request-timeout", type=float, default=None,
+                   dest="request_timeout",
+                   help="per-request total-stream bound in seconds; timed-out"
+                   " requests are counted, not fatal (flaky-tunnel mode)")
+    p.add_argument("--out", default=None,
+                   help="also write the JSON here incrementally after each"
+                   " topology, so a wedge mid-phase keeps the finished phase")
     args = p.parse_args(argv)
 
-    results = {
-        "agg": run_topology(args, disagg=False),
-        "disagg": run_topology(args, disagg=True),
-    }
+    def _flush(results: dict) -> None:
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(results, f, indent=1)
+
+    results: dict = {}
+    results["agg"] = run_topology(args, disagg=False)
+    _flush(results)
+    results["disagg"] = run_topology(args, disagg=True)
     agg, dis = results["agg"], results["disagg"]
     if agg.get("output_tok_s") and dis.get("output_tok_s"):
         results["disagg_throughput_ratio"] = round(
@@ -165,6 +178,7 @@ def main(argv=None) -> None:
             results["disagg_ttft_ratio"] = round(
                 agg["ttft_ms"]["p50"] / dis["ttft_ms"]["p50"], 3
             )
+    _flush(results)
     print(json.dumps(results, indent=1))
 
 

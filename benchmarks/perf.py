@@ -198,34 +198,55 @@ async def _one_http(session, url: str, model: str, prompt_text: str, osl: int):
 
 
 async def bench_http(
-    url: str, model: str, prompts: list[tuple[str, int]], concurrency: int
+    url: str, model: str, prompts: list[tuple[str, int]], concurrency: int,
+    request_timeout_s: float | None = None,
 ) -> dict:
+    """`request_timeout_s` bounds each request's total stream time; timed-out
+    or errored requests are counted (summary key `failed`) instead of killing
+    the whole run — on a flaky device tunnel the surviving requests still
+    yield an honest partial measurement."""
     import aiohttp
 
     queue: asyncio.Queue = asyncio.Queue()
     for p in prompts:
         queue.put_nowait(p)
     results: list[RequestResult] = []
+    failures = 0
+    # None keeps aiohttp's default (total=300 s); ClientTimeout(total=None)
+    # would instead disable the bound and let a wedged stream hang forever
+    kw = (
+        {"timeout": aiohttp.ClientTimeout(total=request_timeout_s)}
+        if request_timeout_s is not None else {}
+    )
 
-    async with aiohttp.ClientSession() as session:
+    async with aiohttp.ClientSession(**kw) as session:
 
         async def worker():
+            nonlocal failures
             while True:
                 try:
                     text, osl = queue.get_nowait()
                 except asyncio.QueueEmpty:
                     return
-                results.append(await _one_http(session, url, model, text, osl))
+                try:
+                    results.append(
+                        await _one_http(session, url, model, text, osl)
+                    )
+                except (asyncio.TimeoutError, aiohttp.ClientError):
+                    failures += 1
 
         t0 = time.perf_counter()
         await asyncio.gather(*(worker() for _ in range(concurrency)))
         wall = time.perf_counter() - t0
-    return summarize(results, wall)
+    out = summarize(results, wall)
+    if failures:
+        out["failed"] = failures
+    return out
 
 
 def warmup_and_flush(
     url: str, model: str, texts: list[tuple[str, int]], warmup: int,
-    concurrency: int,
+    concurrency: int, request_timeout_s: float | None = None,
 ) -> None:
     """Compile-then-flush prelude for HTTP A/B harnesses: drive `warmup`
     uncached random prompts whose lengths span the timed sweep's length
@@ -250,7 +271,10 @@ def warmup_and_flush(
         ("".join(chr(97 + r.randrange(26)) for _ in range(n)), osl)
         for n in picks
     ]
-    asyncio.run(bench_http(url, model, warm, concurrency))
+    asyncio.run(
+        bench_http(url, model, warm, concurrency,
+                   request_timeout_s=request_timeout_s)
+    )
     req = urllib.request.Request(
         f"{url}/clear_kv_blocks", data=b"{}",
         headers={"Content-Type": "application/json"},
