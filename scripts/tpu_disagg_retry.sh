@@ -1,0 +1,32 @@
+#!/usr/bin/env bash
+# Burst-mode retry of the disagg A/B (the north-star measurement): waits
+# for the tunnel, then runs a shorter A/B with per-request timeouts and
+# incremental --out so a mid-phase tunnel wedge keeps the finished phase.
+# Run AFTER the main watcher queue (single chip — no concurrent stages).
+set -u
+cd "$(dirname "$0")/.."
+OUT=artifacts/tpu
+mkdir -p "$OUT"
+
+probe_once() {
+  timeout 120 python -c \
+    "import jax,sys; sys.exit(0 if jax.devices()[0].platform!='cpu' else 1)" \
+    >/dev/null 2>&1
+}
+n=0
+while ! probe_once; do
+  n=$((n + 1))
+  echo "$(date -u +%H:%M:%S) tunnel down (probe $n); retry in 10 min"
+  sleep 600
+done
+echo "$(date -u +%H:%M:%S) tunnel OK after $n failed probes"
+
+timeout 3000 python -m benchmarks.disagg_bench \
+  --model llama3-1b --dtype bfloat16 --page-size 64 --num-pages 1024 \
+  --max-context 4096 --max-local-prefill 256 --requests 24 --isl 1024 \
+  --osl 64 --concurrency 8 --warmup 8 \
+  --request-timeout 120 --out "$OUT/disagg_ab.json" \
+  > "$OUT/disagg_ab.log" 2> "$OUT/disagg_ab.err"
+rc=$?
+echo "disagg_ab retry rc=$rc"
+tail -c 400 "$OUT/disagg_ab.json" 2>/dev/null; echo

@@ -44,3 +44,59 @@ def test_cpu_fallback_line_is_labeled_and_carries_tpu_artifact():
         assert art is not None
         assert art["payload"]["extras"]["platform"] == "tpu"
         assert "age_hours" in art and "recorded_utc" in art
+
+
+def test_bench_http_counts_failures_instead_of_raising():
+    """Flaky-tunnel mode (round-5): a request that times out or errors
+    mid-stream must become a `failed` count, not a stage-killing raise,
+    and surviving requests must still be summarized."""
+    import asyncio
+
+    import benchmarks.perf as perf
+
+    calls = {"n": 0}
+
+    async def fake_one_http(session, url, model, text, osl):
+        calls["n"] += 1
+        if calls["n"] % 2:
+            raise asyncio.TimeoutError
+        return perf.RequestResult(
+            ttft_s=0.01, latency_s=0.05, output_tokens=4, itls_s=[0.01] * 3
+        )
+
+    orig = perf._one_http
+    perf._one_http = fake_one_http
+    try:
+        out = asyncio.run(
+            perf.bench_http(
+                "http://127.0.0.1:1", "tiny", [("x", 4)] * 6, 2,
+                request_timeout_s=5,
+            )
+        )
+    finally:
+        perf._one_http = orig
+    assert out["failed"] == 3
+    assert out["requests"] == 3
+    assert out["output_tok_s"] > 0
+
+
+def test_bench_http_survives_total_failure():
+    """All requests failing yields an empty-but-valid summary (percentile
+    keys None), so the caller can still emit an honest artifact."""
+    import asyncio
+
+    import benchmarks.perf as perf
+
+    async def fake_one_http(session, url, model, text, osl):
+        raise asyncio.TimeoutError
+
+    orig = perf._one_http
+    perf._one_http = fake_one_http
+    try:
+        out = asyncio.run(
+            perf.bench_http("http://127.0.0.1:1", "tiny", [("x", 4)] * 4, 2)
+        )
+    finally:
+        perf._one_http = orig
+    assert out["failed"] == 4
+    assert out["requests"] == 0
