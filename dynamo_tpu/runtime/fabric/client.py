@@ -89,6 +89,11 @@ class RemoteFabric:
             for fut in self._pending.values():
                 if not fut.done():
                     fut.set_exception(err)
+                    # a requester that was itself cancelled at teardown never
+                    # awaits this future; pre-retrieve the exception so GC
+                    # doesn't log "exception was never retrieved" (a later
+                    # await still raises — only the log flag is cleared)
+                    fut.exception()
             self._pending.clear()
             if self._closed or not self.reconnect:
                 for w in list(self._watches.values()):
