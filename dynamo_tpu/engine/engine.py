@@ -282,6 +282,19 @@ class JaxEngine:
                 x = jax.device_put(x, self._batch_shardings[arr.ndim])
         return x
 
+    def _dev_tree(self, tree):
+        """All host inputs of one dispatch -> device in a SINGLE batched
+        transfer. A dispatch ships ~4-14 small arrays (tokens/positions/
+        valid/page-table + sampling/penalty/bias planes); putting them one
+        by one costs a transfer round trip each — over a tunneled TPU
+        that per-message latency rivals the decode step itself. On the
+        plain single-chip path jax.device_put of the whole pytree lands
+        everything in one batched_device_put; sharded/multi-process paths
+        keep the per-leaf placement rules of _dev."""
+        if self._multiproc or self._batch_shardings is not None:
+            return jax.tree.map(self._dev, tree)
+        return jax.device_put(tree)
+
     # -- public API --------------------------------------------------------
 
     def add_request(
@@ -427,13 +440,9 @@ class JaxEngine:
                             mm_embeds[i, off] = req.mm_embeds[j]
                             mm_mask[i, off] = True
 
-            args = (
-                self.params, self._dev(tokens), self._dev(positions),
-                self._dev(valid), self.kv, self._dev(pt),
-            )
-            mm_args = (
-                (self._dev(mm_embeds), self._dev(mm_mask)) if any_mm else ()
-            )
+            host = {"base": (tokens, positions, valid, pt)}
+            if any_mm:
+                host["mm"] = (mm_embeds, mm_mask)
             # Every piece starting at 0 (un-chunked prompts, no prefix
             # hits — the common case) compiles a history-free program:
             # attention over the in-register chunk only, no page gather.
@@ -449,13 +458,17 @@ class JaxEngine:
                 pen = self._batch_penalty_bucket(reqs)
                 if pen and not any(self._penalty_history(r) for r in reqs):
                     pen = 0
-                pen_args = (
-                    self._penalty_arrays(reqs, b_bucket, pen) if pen else ()
+                host.update(
+                    samp=samp, last=last_idx,
+                    pen=self._penalty_arrays(reqs, b_bucket, pen)
+                    if pen else (),
                 )
                 bias = self._batch_bias(reqs)
-                bias_kwargs = (
-                    self._bias_arrays(reqs, b_bucket) if bias else {}
-                )
+                if bias:
+                    host["bias"] = self._bias_arrays(reqs, b_bucket)
+                dev = self._dev_tree(host)
+                args = (self.params, *dev["base"][:3], self.kv,
+                        dev["base"][3])
                 fn = self._get_step_fn(
                     "prefill", b_bucket, t_bucket, greedy=all_greedy,
                     mm=any_mm, first_chunk=first_chunk, lp=lp, pen=pen,
@@ -464,30 +477,34 @@ class JaxEngine:
                 # mm/bias ride as keywords: the positional tail of the
                 # shared step_fn signature belongs to the penalty args.
                 mm_kwargs = (
-                    {"mm_embeds": mm_args[0], "mm_mask": mm_args[1]}
+                    {"mm_embeds": dev["mm"][0], "mm_mask": dev["mm"][1]}
                     if any_mm
                     else {}
                 )
+                bias_kwargs = dev.get("bias", {})
                 if lp >= 0:
                     token_ids, lp_raw, self.kv = fn(
-                        *args, self._dev(last_idx), *samp, *pen_args,
+                        *args, dev["last"], *dev["samp"], *dev["pen"],
                         **bias_kwargs, **mm_kwargs
                     )
                     lp_data = tuple(np.asarray(x) for x in lp_raw)
                 else:
                     token_ids, self.kv = fn(
-                        *args, self._dev(last_idx), *samp, *pen_args,
+                        *args, dev["last"], *dev["samp"], *dev["pen"],
                         **bias_kwargs, **mm_kwargs
                     )
                 ids = np.asarray(token_ids)
             else:
                 # No piece finishes its prompt: KV writes only — skip the
                 # vocab-sized logits + sampling entirely.
+                dev = self._dev_tree(host)
+                args = (self.params, *dev["base"][:3], self.kv,
+                        dev["base"][3])
                 fn = self._get_step_fn(
                     "prefill_nosample", b_bucket, t_bucket, mm=any_mm,
                     first_chunk=first_chunk,
                 )
-                self.kv = fn(*args, *mm_args)
+                self.kv = fn(*args, *dev.get("mm", ()))
                 ids = None
             for i, piece in enumerate(pieces):
                 req = piece.request
@@ -677,9 +694,11 @@ class JaxEngine:
             pt[i, : len(req.pages)] = req.pages
 
         fn = self._get_step_fn("spec_verify", b_bucket, t)
+        d_tokens, d_positions, d_valid, d_pt = self._dev_tree(
+            (tokens, positions, valid, pt)
+        )
         target_ids, self.kv = fn(
-            self.params, self._dev(tokens), self._dev(positions),
-            self._dev(valid), self.kv, self._dev(pt),
+            self.params, d_tokens, d_positions, d_valid, self.kv, d_pt,
         )
         target = np.asarray(target_ids)  # [B, t]
         outputs: list[StepOutput] = []
@@ -744,25 +763,30 @@ class JaxEngine:
         )
         bias = self._batch_bias(reqs)
         bias_kwargs = self._bias_arrays(reqs, b_bucket) if bias else {}
-        args = (
-            self.params, self._dev(tokens), self._dev(positions),
-            self._dev(valid), self.kv, self._dev(pt),
-        )
+        host = {
+            "base": (tokens, positions, valid, pt), "samp": samp,
+            "pen": pen_args, "bias": bias_kwargs,
+        }
+        if k_steps == 1:
+            host["last"] = np.zeros(b_bucket, np.int32)
+        dev = self._dev_tree(host)
+        samp, pen_args, bias_kwargs = dev["samp"], dev["pen"], dev["bias"]
+        d_tokens, d_positions, d_valid, d_pt = dev["base"]
+        args = (self.params, d_tokens, d_positions, d_valid, self.kv, d_pt)
         lp_data = None
         if k_steps == 1:
             fn = self._get_step_fn(
                 "decode", b_bucket, 1, greedy=all_greedy, lp=lp, pen=pen,
                 bias=bias,
             )
-            last_idx = np.zeros(b_bucket, np.int32)
             if lp >= 0:
                 token_ids, lp_data, self.kv = fn(
-                    *args, self._dev(last_idx), *samp, *pen_args,
+                    *args, dev["last"], *samp, *pen_args,
                     **bias_kwargs,
                 )
             else:
                 token_ids, self.kv = fn(
-                    *args, self._dev(last_idx), *samp, *pen_args,
+                    *args, dev["last"], *samp, *pen_args,
                     **bias_kwargs,
                 )
         else:
@@ -868,10 +892,7 @@ class JaxEngine:
             if n:
                 out_toks[i, :n] = hist[-n:]
                 out_valid[i, :n] = True
-        return (
-            self._dev(freq), self._dev(pres),
-            self._dev(out_toks), self._dev(out_valid),
-        )
+        return (freq, pres, out_toks, out_valid)
 
     def _validate_bias(self, sampling: Optional[SamplingParams]) -> None:
         """Reject over-limit / out-of-vocab logit_bias at admission, where
@@ -956,10 +977,10 @@ class JaxEngine:
             gated[i] = row_gated
             mins[i] = row_min
         return {
-            "bias_ids": self._dev(ids),
-            "bias_vals": self._dev(vals),
-            "bias_gated": self._dev(gated),
-            "min_toks": self._dev(mins),
+            "bias_ids": ids,
+            "bias_vals": vals,
+            "bias_gated": gated,
+            "min_toks": mins,
         }
 
     def _sampling_arrays(self, reqs: list[Request], pad_to: Optional[int] = None):
@@ -982,13 +1003,7 @@ class JaxEngine:
             counters[i] = r.num_emitted + len(r.output_tokens)
             if r.sampling.temperature > 0.0:
                 all_greedy = False
-        return (
-            (
-                self._dev(temps), self._dev(top_ps), self._dev(top_ks),
-                self._dev(seeds), self._dev(counters),
-            ),
-            all_greedy,
-        )
+        return ((temps, top_ps, top_ks, seeds, counters), all_greedy)
 
     def _request_seed(self, req: Request) -> int:
         if req.sampling.seed is not None:
@@ -1303,9 +1318,12 @@ class JaxEngine:
                     pt = np.zeros((1, mp), np.int32)
                     pt[0, : len(pages)] = pages
                     fn = self._get_step_fn("embed", 1, t_bucket)
+                    d_tokens, d_positions, d_valid, d_pt = self._dev_tree(
+                        (tokens, positions, valid, pt)
+                    )
                     pooled, self.kv = fn(
-                        self.params, self._dev(tokens), self._dev(positions),
-                        self._dev(valid), self.kv, self._dev(pt),
+                        self.params, d_tokens, d_positions, d_valid,
+                        self.kv, d_pt,
                     )
                     vec = np.asarray(pooled, np.float32)[0]
                     acc = vec if acc is None else acc + vec
