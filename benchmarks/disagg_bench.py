@@ -39,6 +39,8 @@ def run_topology(args, disagg: bool) -> dict:
     ]
     if args.quantize:
         engine += ["--quantize", args.quantize]
+    if args.decode_steps is not None:
+        engine += ["--decode-steps", str(args.decode_steps)]
     procs = []
     try:
         fb = Proc("fabric", _cli("fabric", "--port", str(fport)))
@@ -151,6 +153,9 @@ def main(argv=None) -> None:
     p.add_argument("--isl", type=int, default=24)
     p.add_argument("--osl", type=int, default=8)
     p.add_argument("--concurrency", type=int, default=4)
+    p.add_argument("--decode-steps", type=int, default=None,
+                   dest="decode_steps",
+                   help="worker decode fusion (~64 on a tunneled TPU)")
     p.add_argument("--request-timeout", type=float, default=None,
                    dest="request_timeout",
                    help="per-request total-stream bound in seconds; timed-out"

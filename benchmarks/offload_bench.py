@@ -120,6 +120,8 @@ def run_mode(args, host_tier: bool) -> dict:
     )
     if host_tier:
         argv += ["--host-kv-bytes", str(args.host_kv_bytes)]
+    if args.decode_steps is not None:
+        argv += ["--decode-steps", str(args.decode_steps)]
     server = Proc("server", argv)
     try:
         server.wait_for("listening on", timeout=900)
@@ -157,6 +159,9 @@ def main(argv=None) -> None:
     p.add_argument("--turns", type=int, default=3)
     p.add_argument("--turn-chars", type=int, default=24, dest="turn_chars")
     p.add_argument("--osl", type=int, default=8)
+    p.add_argument("--decode-steps", type=int, default=None,
+                   dest="decode_steps",
+                   help="worker decode fusion (~64 on a tunneled TPU)")
     args = p.parse_args(argv)
 
     results = {

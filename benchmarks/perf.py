@@ -322,6 +322,12 @@ def main(argv=None) -> None:
         help="engine mode: per-sequence prefill chunk length",
     )
     p.add_argument(
+        "--decode-steps", type=int, default=None, dest="decode_steps",
+        help="engine mode: decode steps fused per dispatch (one host sync "
+        "per K tokens/seq; ~64 on a remote/tunneled TPU where the sync "
+        "RTT dominates a step). Default: engine default (8)",
+    )
+    p.add_argument(
         "--distribution", default="geometric",
         choices=["geometric", "sharegpt"],
         help="ISL/OSL law; sharegpt = lognormal heavy-tail mixture",
@@ -369,6 +375,11 @@ def main(argv=None) -> None:
                 **(
                     {"prefill_chunk": args.prefill_chunk}
                     if args.prefill_chunk is not None
+                    else {}
+                ),
+                **(
+                    {"decode_steps": args.decode_steps}
+                    if args.decode_steps is not None
                     else {}
                 ),
             )

@@ -118,6 +118,7 @@ def profile(
     quantize: str | None = None,
     num_pages: int = 2048,
     page_size: int = 64,
+    decode_steps: int | None = None,
 ) -> dict:
     from benchmarks.perf import bench_engine
     from benchmarks.synthesizer import SynthConfig, synthesize
@@ -143,6 +144,8 @@ def profile(
         tp=tp,
         dp=dp,
         quantize=quantize,
+        **({"decode_steps": decode_steps} if decode_steps is not None
+           else {}),
     )
     # A caller-supplied config has a fixed context budget: clamp prompts to
     # it (the synthesizer's geometric tail would trip the admission guard).
@@ -193,6 +196,10 @@ def main(argv=None) -> None:
     p.add_argument("--ttft-target", type=float, default=200.0, dest="ttft_target")
     p.add_argument("--itl-target", type=float, default=20.0, dest="itl_target")
     p.add_argument("-o", "--output", default=None, help="write JSON here")
+    p.add_argument(
+        "--decode-steps", type=int, default=None, dest="decode_steps",
+        help="decode steps fused per dispatch (~64 on a tunneled TPU)",
+    )
     args = p.parse_args(argv)
 
     from dynamo_tpu.platform import honor_jax_platforms_env
@@ -228,6 +235,7 @@ def main(argv=None) -> None:
             quantize=args.quantize,
             num_pages=args.num_pages,
             page_size=args.page_size,
+            decode_steps=args.decode_steps,
         )
     text = json.dumps(table, indent=2)
     if args.output:

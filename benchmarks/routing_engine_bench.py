@@ -63,6 +63,8 @@ def run_mode(args, mode: str, texts) -> dict:
         "--max-context", str(args.max_context),
         "--router-mode", mode,
     ]
+    if args.decode_steps is not None:
+        engine += ["--decode-steps", str(args.decode_steps)]
     procs: list[Proc] = []
     try:
         fb = Proc("fabric", _cli("fabric", "--port", str(fport)))
@@ -134,6 +136,9 @@ def main(argv=None) -> None:
     p.add_argument("--warmup", type=int, default=8)
     p.add_argument("--osl", type=int, default=8)
     p.add_argument("--concurrency", type=int, default=4)
+    p.add_argument("--decode-steps", type=int, default=None,
+                   dest="decode_steps",
+                   help="worker decode fusion (~64 on a tunneled TPU)")
     args = p.parse_args(argv)
 
     texts, reuse = _texts(args)

@@ -47,6 +47,7 @@ def _engine_config(args, eos_token_ids: tuple = ()) -> EngineConfig:
         spec_ngram=getattr(args, "spec_ngram", 0),
         quantize=getattr(args, "quantize", None),
         attention_impl=getattr(args, "attention_impl", "auto"),
+        decode_steps=getattr(args, "decode_steps", None) or 8,
     )
 
 
@@ -592,6 +593,12 @@ def main(argv: Optional[list[str]] = None) -> None:
     runp.add_argument("--endpoint", default="generate")
     runp.add_argument("--num-pages", type=int, default=512, dest="num_pages")
     runp.add_argument("--page-size", type=int, default=64, dest="page_size")
+    runp.add_argument(
+        "--decode-steps", type=int, default=None, dest="decode_steps",
+        help="decode steps fused per dispatch (host sync per K tokens/seq;"
+             " raise to ~64 on a remote/tunneled TPU where the sync RTT"
+             " dominates a step). Default: engine default (8)",
+    )
     runp.add_argument(
         "--host-kv-bytes", type=int, default=0, dest="host_kv_bytes",
         help="KVBM G2: host-DRAM KV tier byte budget (0 = off); evicted "

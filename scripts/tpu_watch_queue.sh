@@ -58,22 +58,26 @@ prewarm() {
   run_stage prewarm python scripts/tpu_prewarm.py
 }
 disagg_ab() {
+  # burst mode: per-request timeout (a tunnel wedge costs requests, not
+  # the stage), incremental partial artifact, decode fusion 64 (the
+  # tunnel sync RTT dominates an un-fused decode step)
   run_stage disagg_ab python -m benchmarks.disagg_bench \
     --model llama3-1b --dtype bfloat16 --page-size 64 --num-pages 1024 \
-    --max-context 4096 --max-local-prefill 256 --requests 32 --isl 1024 \
-    --osl 64 --concurrency 8 --warmup 8
+    --max-context 4096 --max-local-prefill 256 --requests 24 --isl 1024 \
+    --osl 64 --concurrency 8 --warmup 8 --decode-steps 64 \
+    --request-timeout 120 --out "$OUT/disagg_ab_partial.json"
 }
 sla_8b() {
   run_stage profile_sla_8b python -m benchmarks.profile_sla \
     --model llama3-8b --quantize int8 --num-pages 448 \
     --num-requests 24 --isl 512 --osl 96 --concurrency 1,4,8,16 \
-    --ttft-target 400 --itl-target 40
+    --ttft-target 400 --itl-target 40 --decode-steps 64
 }
 sweep_8b() {
   run_stage perf_sweep_8b python -m benchmarks.perf --mode engine \
     --model llama3-8b --quantize int8 --distribution sharegpt \
     --num-pages 512 --num-requests 32 --isl 512 --osl 128 \
-    --concurrency 1,4,16
+    --concurrency 1,4,16 --decode-steps 64
 }
 ft_kill() {
   run_stage ft_device_kill python scripts/tpu_ft_device_kill.py
@@ -82,7 +86,7 @@ routing() {
   run_stage routing_engine python -m benchmarks.routing_engine_bench \
     --model llama3-1b --dtype bfloat16 --page 16 --pages 512 \
     --max-context 2048 --depth 6 --branching 2 --suffix 64 \
-    --requests 64 --osl 16 --concurrency 8 --warmup 8
+    --requests 64 --osl 16 --concurrency 8 --warmup 8 --decode-steps 16
 }
 decode_profile() {
   # stage name differs from the script's own artifact
@@ -93,7 +97,8 @@ decode_profile() {
 offload() {
   run_stage offload_ab python -m benchmarks.offload_bench \
     --model llama3-1b --dtype bfloat16 --page-size 16 --num-pages 192 \
-    --max-context 2048 --users 8 --turns 4 --turn-chars 400 --osl 16
+    --max-context 2048 --users 8 --turns 4 --turn-chars 400 --osl 16 \
+    --decode-steps 16
 }
 bench_dsv2() {
   # DeepSeek-V2-Lite (15.7B MLA+MoE) int8 on ONE v5e chip: the compressed
