@@ -10,7 +10,9 @@ hit the cache.
 
 Shapes mirror scripts/tpu_watch_queue.sh disagg_ab: llama3-1b bf16,
 page 64 x 1024 pages, max-context 4096 (max_pages_per_seq 64), CLI
-defaults prefill_chunk=512 / max_seqs=32, ISL 1024, concurrency 8.
+defaults prefill_chunk=512 / max_seqs=32, ISL 1024, concurrency 8,
+decode fusion 64 (the A/B passes --decode-steps 64 — the k=64
+decode_multi programs are the expensive compiles).
 
 Usage (tunnel alive): python scripts/tpu_prewarm.py
 """
@@ -48,6 +50,7 @@ def main() -> None:
         prefill_chunk=512,
         max_seqs=32,
         dtype="bfloat16",
+        decode_steps=64,
     )
     eng = JaxEngine(cfg)
     boot_s = time.perf_counter() - t0
