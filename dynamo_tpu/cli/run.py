@@ -550,7 +550,7 @@ async def _run_planner(args) -> None:
         await rt.close()
 
 
-def main(argv: Optional[list[str]] = None) -> None:
+def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="dynamo-tpu")
     sub = p.add_subparsers(dest="cmd", required=True)
     runp = sub.add_parser("run", help="serve / chat / batch / worker")
@@ -833,6 +833,11 @@ def main(argv: Optional[list[str]] = None) -> None:
              "prefill=PrefillWorkerService",
     )
 
+    return p
+
+
+def main(argv: Optional[list[str]] = None) -> None:
+    p = build_parser()
     args = p.parse_args(argv)
     if args.cmd == "planner" and args.connector == "kube":
         if not args.cr_name:

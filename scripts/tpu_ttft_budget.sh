@@ -28,7 +28,8 @@ for budget in (2048, 4096, 8192):
              "--model", "llama3-1b", "--dtype", "bfloat16",
              "--num-pages", "1024", "--page-size", "64",
              "--num-requests", "64", "--isl", "512", "--osl", "64",
-             "--prefill-budget", str(budget), "--concurrency", "16,64"],
+             "--prefill-budget", str(budget), "--concurrency", "16,64",
+             "--decode-steps", "64"],
             capture_output=True, text=True, timeout=3000,
         ).stdout
         rows[budget] = json.loads(out[out.index("{"):])["sweep"]

@@ -57,3 +57,21 @@ def test_ctl_add_list_remove():
         )
 
     asyncio.run(main())
+
+
+def test_run_cli_decode_steps_flag_reaches_engine_config():
+    """--decode-steps plumbs through to EngineConfig (the tunneled-TPU
+    decode-fusion knob the chip benchmark stages pass explicitly)."""
+    import argparse
+
+    from dynamo_tpu.cli.run import _engine_config, build_parser
+
+    p = build_parser()
+    args = p.parse_args(
+        ["run", "in=text", "out=jax", "--model", "tiny",
+         "--decode-steps", "64"]
+    )
+    assert _engine_config(args).decode_steps == 64
+    # default: engine default (8)
+    args = p.parse_args(["run", "in=text", "out=jax", "--model", "tiny"])
+    assert _engine_config(args).decode_steps == 8
