@@ -1,0 +1,59 @@
+"""Summarize the TPU stage queue: which artifacts are fresh, stale, empty.
+
+Usage: python scripts/tpu_queue_status.py
+Prints one line per known stage artifact with age and a one-word verdict,
+so a recovering tunnel session can see at a glance what still needs chip
+time (the round-4 lesson: budget tunnel-down time explicitly).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from pathlib import Path
+
+OUT = Path(__file__).resolve().parent.parent / "artifacts" / "tpu"
+
+STAGES = [
+    "pallas_kernels", "prewarm", "disagg_ab", "disagg_ab_partial",
+    "perf_sweep_8b", "profile_sla_8b", "ft_device_kill", "routing_engine",
+    "offload_ab", "bench_dsv2", "decode_prof", "bench_1b", "pallas_gate",
+    "transfer", "ttft_budget", "bench_dsr1",
+]
+
+
+def main() -> None:
+    now = time.time()
+    for name in STAGES:
+        p = OUT / f"{name}.json"
+        if not p.exists():
+            print(f"{name:18s} MISSING")
+            continue
+        size = p.stat().st_size
+        age_h = (now - p.stat().st_mtime) / 3600
+        if size == 0:
+            print(f"{name:18s} EMPTY   (age {age_h:5.1f} h)")
+            continue
+        verdict = "ok"
+        try:
+            text = p.read_text().strip()
+            try:
+                doc = json.loads(text)
+            except ValueError:
+                # run_stage captures whole stdout; the JSON document is
+                # the last line (stage scripts print progress above it)
+                doc = json.loads(text.splitlines()[-1])
+            plat = None
+            if isinstance(doc, dict):
+                plat = doc.get("platform") or doc.get("extras", {}).get(
+                    "platform"
+                )
+            if plat and plat != "tpu":
+                verdict = f"non-tpu ({plat})"
+        except ValueError:
+            verdict = "unparseable"
+        print(f"{name:18s} {verdict:14s} {size:7d} B  age {age_h:5.1f} h")
+
+
+if __name__ == "__main__":
+    main()
