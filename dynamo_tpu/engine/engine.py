@@ -119,6 +119,7 @@ class JaxEngine:
         self._multiproc = self.mesh is not None and (
             len({d.process_index for d in self.mesh.devices.flat}) > 1
         )
+        self._batched_put_ok = True
         if self._multiproc:
             from jax.sharding import NamedSharding, PartitionSpec
 
@@ -290,10 +291,24 @@ class JaxEngine:
         that per-message latency rivals the decode step itself. On the
         plain single-chip path jax.device_put of the whole pytree lands
         everything in one batched_device_put; sharded/multi-process paths
-        keep the per-leaf placement rules of _dev."""
+        keep the per-leaf placement rules of _dev.
+
+        Defensive fallback: the axon PJRT backend has shipped with missing
+        transfer features before (no CreateBuffersForAsyncHostToDevice —
+        disagg/transfer.py) — if the batched put raises there, drop to
+        per-leaf jnp.asarray once and stay there for the engine's life."""
         if self._multiproc or self._batch_shardings is not None:
             return jax.tree.map(self._dev, tree)
-        return jax.device_put(tree)
+        if self._batched_put_ok:
+            try:
+                return jax.device_put(tree)
+            except Exception:
+                self._batched_put_ok = False
+                logger.warning(
+                    "batched device_put unsupported on this backend; "
+                    "falling back to per-leaf transfers"
+                )
+        return jax.tree.map(self._dev, tree)
 
     # -- public API --------------------------------------------------------
 
