@@ -325,6 +325,28 @@ def main() -> None:
             }
         except (OSError, ValueError):
             tpu_latest = None
+        # also carry the freshest on-chip kernel numerics proof — it can
+        # be newer than any bench artifact when a tunnel wedge cut a
+        # round's queue short after the kernel stage
+        try:
+            kp = os.path.join(art_dir, "pallas_check.json")
+            with open(kp) as f:
+                kdoc = json.load(f)
+            if kdoc.get("platform") == "tpu":
+                kmtime = os.path.getmtime(kp)
+                if tpu_latest is None:
+                    tpu_latest = {}
+                tpu_latest["kernel_check"] = {
+                    "all_ok": kdoc.get("all_ok"),
+                    "age_hours": round(
+                        (time.time() - kmtime) / 3600.0, 1
+                    ),
+                    "recorded_utc": time.strftime(
+                        "%Y-%m-%dT%H:%M:%SZ", time.gmtime(kmtime)
+                    ),
+                }
+        except (OSError, ValueError):
+            pass
 
     emit(
         {
