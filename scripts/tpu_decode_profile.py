@@ -99,6 +99,49 @@ def time_full(eng, batch: int) -> dict:
     }
 
 
+def time_pure_program(eng, batch: int) -> dict:
+    """The same fused decode_multi program timed WITHOUT the engine's host
+    loop: fixed device-resident inputs, kv threaded through (it may be
+    donated), one block per call mirroring the per-dispatch sync. The gap
+    serve_ms_per_dispatch - pure_ms_per_dispatch is the host overhead
+    (scheduling, input staging, detokenize feedback) — the number that
+    says whether further host-loop work (input packing) pays."""
+    import jax
+    import numpy as np
+
+    fn = eng._get_step_fn(
+        "decode_multi", batch, K_STEPS, greedy=True, lp=-1, pen=0,
+        bias=False,
+    )
+    mp = eng.config.max_pages_per_seq
+    tokens = np.ones((batch, 1), np.int32)
+    positions = np.full((batch, 1), ISL - 1, np.int32)
+    valid = np.ones((batch, 1), bool)
+    pt = np.zeros((batch, mp), np.int32)
+    for i in range(batch):
+        pt[i, :4] = 1 + 4 * i + np.arange(4)
+    samp, _ = eng._sampling_arrays([], pad_to=batch)
+    dev = eng._dev_tree({"base": (tokens, positions, valid, pt),
+                         "samp": samp})
+    d_tokens, d_positions, d_valid, d_pt = dev["base"]
+    kv = eng.kv
+    ids, kv = fn(eng.params, d_tokens, d_positions, d_valid, kv, d_pt,
+                 *dev["samp"])
+    jax.block_until_ready(ids)
+    n = 5
+    t0 = time.perf_counter()
+    for _ in range(n):
+        ids, kv = fn(eng.params, d_tokens, d_positions, d_valid, kv, d_pt,
+                     *dev["samp"])
+        jax.block_until_ready(ids)
+    dt = (time.perf_counter() - t0) / n
+    eng.kv = kv
+    return {
+        "ms_per_dispatch": round(1000 * dt, 3),
+        "ms_per_token_row": round(1000 * dt / K_STEPS, 3),
+    }
+
+
 def time_dense_floor(batch: int) -> dict:
     """Weight-streaming floor: the same parameter stack driven as pure
     dense matmuls (one token per sequence, attention output zeroed via a
@@ -157,6 +200,13 @@ def main() -> None:
         for impl in ("pallas", "xla"):
             eng = build_engine(impl, batch)
             row[f"full_{impl}"] = time_full(eng, batch)
+            row[f"pure_{impl}"] = time_pure_program(eng, batch)
+            full = row[f"full_{impl}"]
+            if full["dispatches"]:
+                serve_ms = 1000 * full["wall_s"] / full["dispatches"]
+                row[f"host_overhead_ms_{impl}"] = round(
+                    serve_ms - row[f"pure_{impl}"]["ms_per_dispatch"], 3
+                )
             del eng
         out["batches"][str(batch)] = row
     path = Path(__file__).resolve().parent.parent / "artifacts" / "tpu"
