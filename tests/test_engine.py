@@ -341,3 +341,31 @@ print("MULTIHOST_OK", n)
         timeout=120, cwd="/root/repo",
     )
     assert "MULTIHOST_OK" in out.stdout, out.stderr
+
+
+def test_long_context_chunked_prefill_thousands_of_tokens(engine_factory):
+    """Long-context serving at real scale for the test model: a ~3k-token
+    prompt walks 12 prefill chunks and ~48 KV pages, and the greedy
+    continuation must match a one-shot (single-chunk) prefill of the same
+    prompt bit-for-bit (SURVEY §5.7; the reference reaches long context
+    through vLLM's chunked prefill — this pins ours through the paged
+    path at depth, not just the 2-chunk smoke above)."""
+    import numpy as np
+
+    rng = np.random.default_rng(7)
+    prompt = [int(x) for x in rng.integers(1, 250, 3000)]
+
+    chunked = engine_factory(
+        prefill_chunk=256, page_size=64, max_pages_per_seq=64,
+        num_pages=80, max_seqs=4,
+    )
+    chunked.add_request("lc", list(prompt), _greedy(8))
+    out_chunked = chunked.run_to_completion()["lc"]
+
+    oneshot = engine_factory(
+        prefill_chunk=4096, page_size=64, max_pages_per_seq=64,
+        num_pages=80, max_seqs=4,
+    )
+    oneshot.add_request("lc", list(prompt), _greedy(8))
+    assert oneshot.run_to_completion()["lc"] == out_chunked
+    assert len(out_chunked) == 8
