@@ -1,0 +1,25 @@
+#!/usr/bin/env bash
+# Post-queue chain: run the round-5 extras (TTFT budget sweep, DSR1
+# bench), then retry any stage whose artifact is still empty/missing.
+# Single chip — run only after the main watcher queue exits.
+set -u
+cd "$(dirname "$0")/.."
+OUT=artifacts/tpu
+
+bash scripts/tpu_ttft_budget.sh || true
+bash scripts/tpu_dsr1_bench.sh || true
+
+# retry empties via the queue's own stage functions (fresh queue pass
+# with an explicit stage list keeps run_stage semantics + tunnel waits)
+retries=()
+[ -s "$OUT/disagg_ab.json" ]     || retries+=(disagg_ab)
+[ -s "$OUT/perf_sweep_8b.json" ] || retries+=(sweep_8b)
+[ -s "$OUT/profile_sla_8b.json" ] || retries+=(sla_8b)
+[ -s "$OUT/bench_1b.json" ]      || retries+=(bench_1b_sweep)
+[ -s "$OUT/decode_prof.json" ]   || retries+=(decode_profile)
+[ -s "$OUT/pallas_gate.json" ]   || retries+=(pallas_gate)
+if [ ${#retries[@]} -gt 0 ]; then
+  echo "retrying: ${retries[*]}"
+  bash scripts/tpu_watch_queue.sh "${retries[@]}"
+fi
+echo "followup complete"
