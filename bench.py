@@ -293,6 +293,7 @@ def main() -> None:
     # (payload + age) so the round record holds a TPU number either way.
     metric = "output_tok_s_per_chip"
     tpu_latest = None
+    kernel_check = None
     if platform != "tpu":
         metric = "output_tok_s_cpu_fallback"
         art_dir = os.path.join(
@@ -325,18 +326,17 @@ def main() -> None:
             }
         except (OSError, ValueError):
             tpu_latest = None
-        # also carry the freshest on-chip kernel numerics proof — it can
-        # be newer than any bench artifact when a tunnel wedge cut a
-        # round's queue short after the kernel stage
+        # also carry the freshest on-chip kernel numerics proof (its own
+        # extras key — latest_tpu_artifact keeps its file/payload/age
+        # shape) — it can be newer than any bench artifact when a tunnel
+        # wedge cut a round's queue short after the kernel stage
         try:
             kp = os.path.join(art_dir, "pallas_check.json")
             with open(kp) as f:
                 kdoc = json.load(f)
             if kdoc.get("platform") == "tpu":
                 kmtime = os.path.getmtime(kp)
-                if tpu_latest is None:
-                    tpu_latest = {}
-                tpu_latest["kernel_check"] = {
+                kernel_check = {
                     "all_ok": kdoc.get("all_ok"),
                     "age_hours": round(
                         (time.time() - kmtime) / 3600.0, 1
@@ -368,6 +368,7 @@ def main() -> None:
                 "generated_tokens": generated,
                 "baseline_workload": baseline_workload,
                 **({"latest_tpu_artifact": tpu_latest} if tpu_latest else {}),
+                **({"kernel_check": kernel_check} if kernel_check else {}),
                 "attention_impl": best_impl,
                 "attention_impls": {
                     k: {

@@ -47,7 +47,11 @@ def _engine_config(args, eos_token_ids: tuple = ()) -> EngineConfig:
         spec_ngram=getattr(args, "spec_ngram", 0),
         quantize=getattr(args, "quantize", None),
         attention_impl=getattr(args, "attention_impl", "auto"),
-        decode_steps=getattr(args, "decode_steps", None) or 8,
+        **(
+            {"decode_steps": args.decode_steps}
+            if getattr(args, "decode_steps", None) is not None
+            else {}
+        ),
     )
 
 

@@ -302,11 +302,21 @@ class JaxEngine:
         if self._batched_put_ok:
             try:
                 return jax.device_put(tree)
-            except Exception:
+            except Exception as e:
+                # latch the fallback ONLY for capability errors — a
+                # transient failure (OOM, tunnel hiccup) must surface,
+                # not silently degrade every later dispatch
+                msg = str(e).lower()
+                if not any(
+                    s in msg
+                    for s in ("unimplemented", "not implemented",
+                              "unsupported", "not supported")
+                ):
+                    raise
                 self._batched_put_ok = False
                 logger.warning(
-                    "batched device_put unsupported on this backend; "
-                    "falling back to per-leaf transfers"
+                    "batched device_put unsupported on this backend (%s); "
+                    "falling back to per-leaf transfers", e,
                 )
         return jax.tree.map(self._dev, tree)
 

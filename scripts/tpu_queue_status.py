@@ -50,7 +50,9 @@ def main() -> None:
                 )
             if plat and plat != "tpu":
                 verdict = f"non-tpu ({plat})"
-        except ValueError:
+        except (OSError, ValueError):
+            # artifact rewritten/deleted mid-poll by the watcher queue —
+            # report and keep listing
             verdict = "unparseable"
         print(f"{name:18s} {verdict:14s} {size:7d} B  age {age_h:5.1f} h")
 

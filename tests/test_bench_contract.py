@@ -44,6 +44,11 @@ def test_cpu_fallback_line_is_labeled_and_carries_tpu_artifact():
         assert art is not None
         assert art["payload"]["extras"]["platform"] == "tpu"
         assert "age_hours" in art and "recorded_utc" in art
+    # the freshest on-chip kernel numerics proof rides as its OWN key
+    # (latest_tpu_artifact keeps its file/payload shape)
+    kc = ex.get("kernel_check")
+    if (REPO / "artifacts" / "tpu" / "pallas_check.json").exists():
+        assert kc is not None and "all_ok" in kc and "age_hours" in kc
 
 
 def test_bench_http_counts_failures_instead_of_raising():
