@@ -318,6 +318,20 @@ def main(argv=None) -> None:
         "larger steps at the cost of longer decode stalls while it runs.",
     )
     p.add_argument(
+        "--prefill-policy", default="fixed", dest="prefill_policy",
+        choices=["fixed", "adaptive"],
+        help="engine mode: adaptive grows the step budget with the "
+        "un-prefilled backlog (to 4x the budget), draining saturation "
+        "bursts in O(1) dispatches without raising the idle-time budget",
+    )
+    p.add_argument(
+        "--prefill-budget-max", type=int, default=None,
+        dest="prefill_budget_max",
+        help="engine mode: adaptive-policy ceiling (default 4x the "
+        "budget); bounds the worst-case single prefill dispatch and so "
+        "the ITL spike it can inflict",
+    )
+    p.add_argument(
         "--prefill-chunk", type=int, default=None, dest="prefill_chunk",
         help="engine mode: per-sequence prefill chunk length",
     )
@@ -372,6 +386,8 @@ def main(argv=None) -> None:
                 spec_ngram=args.spec_ngram,
                 quantize=args.quantize,
                 prefill_token_budget=args.prefill_budget,
+                prefill_budget_policy=args.prefill_policy,
+                prefill_budget_max=args.prefill_budget_max,
                 **(
                     {"prefill_chunk": args.prefill_chunk}
                     if args.prefill_chunk is not None

@@ -47,6 +47,9 @@ def _engine_config(args, eos_token_ids: tuple = ()) -> EngineConfig:
         spec_ngram=getattr(args, "spec_ngram", 0),
         quantize=getattr(args, "quantize", None),
         attention_impl=getattr(args, "attention_impl", "auto"),
+        prefill_token_budget=getattr(args, "prefill_budget", None),
+        prefill_budget_policy=getattr(args, "prefill_policy", "fixed"),
+        prefill_budget_max=getattr(args, "prefill_budget_max", None),
         **(
             {"decode_steps": args.decode_steps}
             if getattr(args, "decode_steps", None) is not None
@@ -638,6 +641,25 @@ def build_parser() -> argparse.ArgumentParser:
     )
     runp.add_argument("--max-context", type=int, default=4096, dest="max_context")
     runp.add_argument("--prefill-chunk", type=int, default=512, dest="prefill_chunk")
+    runp.add_argument(
+        "--prefill-budget", type=int, default=None, dest="prefill_budget",
+        help="prefill tokens per step across sequences (default 4x "
+        "prefill-chunk); the saturation-TTFT knob (docs/PERF.md)",
+    )
+    runp.add_argument(
+        "--prefill-policy", default="fixed", dest="prefill_policy",
+        choices=["fixed", "adaptive"],
+        help="adaptive grows the step budget with the un-prefilled "
+        "backlog (to 4x the budget) so arrival bursts drain in O(1) "
+        "dispatches; fixed always spends at most --prefill-budget",
+    )
+    runp.add_argument(
+        "--prefill-budget-max", type=int, default=None,
+        dest="prefill_budget_max",
+        help="adaptive-policy ceiling (default 4x the budget): bounds "
+        "the worst-case single prefill dispatch = the longest decode "
+        "stall (ITL spike) a running sequence can observe",
+    )
     runp.add_argument("--max-seqs", type=int, default=32, dest="max_seqs")
     runp.add_argument("--max-tokens", type=int, default=256, dest="max_tokens")
     runp.add_argument("--dtype", default="bfloat16")

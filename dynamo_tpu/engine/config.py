@@ -31,6 +31,18 @@ class EngineConfig:
     #: Pieces of the same length bucket run as ONE batched [B, T] program —
     #: this is what lets many short/medium prompts prefill in one dispatch.
     prefill_token_budget: Optional[int] = None
+    #: "fixed" spends at most `effective_prefill_budget` tokens per prefill
+    #: step; "adaptive" grows the step budget toward the whole un-prefilled
+    #: backlog (capped at `prefill_budget_max`) so an arrival burst drains
+    #: in O(1) large dispatches instead of O(backlog) small ones — the
+    #: saturation-TTFT cliff (docs/PERF.md: c=64 p50 2,232 ms was backlog
+    #: drain at the default budget). An unloaded engine still takes the
+    #: small fixed budget, keeping the per-step decode stall short.
+    prefill_budget_policy: str = "fixed"
+    #: adaptive-policy ceiling (None => 4× the effective budget). Bounds
+    #: the worst-case single prefill dispatch, which is exactly the
+    #: longest decode stall (ITL spike) a running sequence can observe.
+    prefill_budget_max: Optional[int] = None
     #: max sequences resident (decode slots)
     max_seqs: int = 64
     #: decode steps fused per dispatch (lax.scan with on-device token
@@ -115,6 +127,20 @@ class EngineConfig:
                 "down to page boundaries, so a smaller budget could never "
                 "schedule any prefill work"
             )
+        if self.prefill_budget_policy not in ("fixed", "adaptive"):
+            raise ValueError(
+                "prefill_budget_policy must be 'fixed' or 'adaptive', got "
+                f"{self.prefill_budget_policy!r}"
+            )
+        if (
+            self.prefill_budget_max is not None
+            and self.prefill_budget_max < self.effective_prefill_budget
+        ):
+            raise ValueError(
+                f"prefill_budget_max ({self.prefill_budget_max}) must be >= "
+                f"the effective budget ({self.effective_prefill_budget}) — "
+                "adaptive only ever grows the step budget"
+            )
 
     @property
     def max_context(self) -> int:
@@ -123,6 +149,11 @@ class EngineConfig:
     @property
     def effective_prefill_budget(self) -> int:
         return self.prefill_token_budget or 4 * self.prefill_chunk
+
+    @property
+    def effective_prefill_budget_max(self) -> int:
+        """Adaptive-policy ceiling (the single source of the 4× default)."""
+        return self.prefill_budget_max or 4 * self.effective_prefill_budget
 
     def decode_bucket_for(self, n: int) -> int:
         for b in self.decode_buckets:

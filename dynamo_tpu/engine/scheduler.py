@@ -182,12 +182,28 @@ class Scheduler:
             self.waiting.pop(0)
             self.running.append(req)
 
+    def _prefill_step_budget(self) -> int:
+        """Token budget for this prefill step. Adaptive policy: grow
+        toward the whole un-prefilled backlog (capped) so a saturation
+        burst drains in a few large dispatches — see EngineConfig
+        docstrings and docs/PERF.md (saturation-TTFT section)."""
+        base = self.config.effective_prefill_budget
+        if self.config.prefill_budget_policy != "adaptive":
+            return base
+        pending = sum(
+            len(r.prompt_tokens) - r.num_computed_tokens
+            for r in self.running
+            if r.state == RequestState.PREFILL
+        )
+        cap = self.config.effective_prefill_budget_max
+        return max(base, min(pending, cap))
+
     def _schedule_prefill(self) -> Optional[ScheduledBatch]:
         # Each piece is capped at prefill_chunk tokens; the step budget
         # spans sequences. The engine groups same-bucket pieces into one
         # batched [B, T] program, so packing many prompts here turns into
         # fewer, larger dispatches rather than serial B=1 launches.
-        budget = self.config.effective_prefill_budget
+        budget = self._prefill_step_budget()
         ps = self.config.page_size
         pieces: list[PrefillPiece] = []
         for req in self.running:

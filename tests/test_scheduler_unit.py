@@ -96,3 +96,66 @@ def test_doomed_oversized_prompt():
     assert s.schedule() is None
     assert len(s.doomed) == 1 and s.doomed[0][0].request_id == "big"
     assert not s.waiting
+
+
+def test_adaptive_budget_scales_with_backlog():
+    """Adaptive policy: the prefill step budget grows toward the
+    un-prefilled backlog (draining a burst in one large dispatch) but
+    never exceeds prefill_budget_max, and idles back to the fixed base
+    when the backlog is gone (docs/PERF.md saturation-TTFT section)."""
+    cfg = _cfg(
+        num_pages=64, prefill_chunk=16, prefill_token_budget=16,
+        prefill_budget_policy="adaptive", prefill_budget_max=48,
+    )
+    alloc = PageAllocator(cfg.num_pages, cfg.page_size)
+    s = Scheduler(cfg, alloc)
+    for i in range(6):  # 6 x 16-token prompts = 96 pending tokens
+        _mk(s, f"r{i}", 16)
+    batch = s.schedule()
+    assert batch is not None and batch.kind == "prefill"
+    # backlog (96) > cap (48): the step spends exactly the cap
+    assert batch.num_tokens == 48
+    for piece in batch.prefill:
+        piece.request.num_computed_tokens += piece.length
+    batch = s.schedule()
+    assert batch is not None and batch.num_tokens == 48  # remaining 3 prompts
+    for piece in batch.prefill:
+        piece.request.num_computed_tokens += piece.length
+    # Backlog drained: an incoming single prompt sees the base budget path
+    # (still schedules, but the computed step budget is the fixed base).
+    _mk(s, "late", 16)
+    s._admit()
+    assert s._prefill_step_budget() == 16
+
+
+def test_adaptive_budget_default_cap_and_fixed_policy():
+    """Default cap is 4x the effective budget; fixed policy ignores the
+    backlog entirely."""
+    cfg = _cfg(
+        num_pages=64, prefill_chunk=16, prefill_token_budget=16,
+        prefill_budget_policy="adaptive",
+    )
+    alloc = PageAllocator(cfg.num_pages, cfg.page_size)
+    s = Scheduler(cfg, alloc)
+    for i in range(8):
+        _mk(s, f"r{i}", 16)
+    s._admit()
+    assert s._prefill_step_budget() == 64  # min(128 pending, 4x16 cap)
+
+    fixed_cfg = _cfg(num_pages=64, prefill_chunk=16, prefill_token_budget=16)
+    fixed = Scheduler(
+        fixed_cfg, PageAllocator(fixed_cfg.num_pages, fixed_cfg.page_size)
+    )
+    for i in range(8):
+        _mk(fixed, f"f{i}", 16)
+    fixed._admit()
+    assert fixed._prefill_step_budget() == 16
+
+
+def test_adaptive_budget_config_validation():
+    import pytest
+
+    with pytest.raises(ValueError, match="prefill_budget_policy"):
+        _cfg(prefill_budget_policy="magic")
+    with pytest.raises(ValueError, match="prefill_budget_max"):
+        _cfg(prefill_token_budget=32, prefill_budget_max=16)
