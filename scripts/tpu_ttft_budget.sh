@@ -19,22 +19,27 @@ python - << 'PY' > "$OUT/ttft_budget.json" 2> "$OUT/ttft_budget.err"
 import json, subprocess, sys
 
 rows = {}
-for budget in (2048, 4096, 8192):
-    # one wedged/timed-out run must not discard the budgets already
+cases = [("2048", []), ("4096", []), ("8192", []),
+         # the adaptive policy at the DEFAULT budget: drains the c=64
+         # burst in O(1) dispatches without raising the idle budget
+         ("adaptive", ["--prefill-policy", "adaptive"])]
+for name, extra in cases:
+    # one wedged/timed-out run must not discard the cases already
     # measured — chip time is the scarce resource here
+    budget = name if name.isdigit() else "2048"
     try:
         out = subprocess.run(
             [sys.executable, "-m", "benchmarks.perf", "--mode", "engine",
              "--model", "llama3-1b", "--dtype", "bfloat16",
              "--num-pages", "1024", "--page-size", "64",
              "--num-requests", "64", "--isl", "512", "--osl", "64",
-             "--prefill-budget", str(budget), "--concurrency", "16,64",
-             "--decode-steps", "64"],
+             "--prefill-budget", budget, "--concurrency", "16,64",
+             "--decode-steps", "64", *extra],
             capture_output=True, text=True, timeout=3000,
         ).stdout
-        rows[budget] = json.loads(out[out.index("{"):])["sweep"]
+        rows[name] = json.loads(out[out.index("{"):])["sweep"]
     except Exception as e:
-        rows[budget] = {"error": repr(e)}
+        rows[name] = {"error": repr(e)}
 print(json.dumps({
     "what": "prefill_token_budget sweep at saturation (docs/PERF.md round-5 "
             "TTFT-cliff section); round-3 baseline: c=64 p50 2232 ms",
