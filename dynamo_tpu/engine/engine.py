@@ -639,6 +639,7 @@ class JaxEngine:
                 or s.logprobs >= 0
                 or s.frequency_penalty
                 or s.presence_penalty
+                or s.repetition_penalty != 1.0
                 or s.logit_bias
                 or s.min_tokens
             ):
@@ -892,7 +893,9 @@ class JaxEngine:
         index. The bucket, not the batch, keys the program variant — the
         family grows log2(max_tokens) deep."""
         if not any(
-            r.sampling.frequency_penalty or r.sampling.presence_penalty
+            r.sampling.frequency_penalty
+            or r.sampling.presence_penalty
+            or r.sampling.repetition_penalty != 1.0
             for r in reqs
         ):
             return 0
@@ -903,21 +906,24 @@ class JaxEngine:
         return o
 
     def _penalty_arrays(self, reqs: list[Request], pad_to: int, o_bucket: int):
-        """(freq [B], pres [B], out_tokens [B, O], out_valid [B, O]) — the
-        generated-token history the penalties are computed over."""
+        """(freq [B], pres [B], rep [B], out_tokens [B, O], out_valid
+        [B, O]) — the generated-token history the penalties are computed
+        over. Padding rows carry rep=1 (multiplicative no-op)."""
         freq = np.zeros(pad_to, np.float32)
         pres = np.zeros(pad_to, np.float32)
+        rep = np.ones(pad_to, np.float32)
         out_toks = np.zeros((pad_to, o_bucket), np.int32)
         out_valid = np.zeros((pad_to, o_bucket), bool)
         for i, r in enumerate(reqs):
             freq[i] = r.sampling.frequency_penalty
             pres[i] = r.sampling.presence_penalty
+            rep[i] = r.sampling.repetition_penalty or 1.0
             hist = self._penalty_history(r)
             n = min(len(hist), o_bucket)
             if n:
                 out_toks[i, :n] = hist[-n:]
                 out_valid[i, :n] = True
-        return (freq, pres, out_toks, out_valid)
+        return (freq, pres, rep, out_toks, out_valid)
 
     def _validate_bias(self, sampling: Optional[SamplingParams]) -> None:
         """Reject over-limit / out-of-vocab logit_bias at admission, where
@@ -1073,7 +1079,7 @@ class JaxEngine:
             return token_logprobs(logits, ids, lp)
 
         def pick(logits, samp_args, counts=None, freq=None, pres=None,
-                 bias_args=None):
+                 rep_p=None, bias_args=None):
             """Sample ids [B] from (possibly penalty/bias-adjusted)
             logits; logprob reporting reads the raw logits separately.
             bias_args = (bias_ids, bias_vals, bias_gated, min_toks); the
@@ -1083,7 +1089,7 @@ class JaxEngine:
             if counts is not None:
                 from dynamo_tpu.engine.sampling import apply_penalties
 
-                eff = apply_penalties(logits, counts, freq, pres)
+                eff = apply_penalties(logits, counts, freq, pres, rep_p)
             if bias_args is not None:
                 from dynamo_tpu.engine.sampling import apply_logit_bias
 
@@ -1120,7 +1126,8 @@ class JaxEngine:
 
             def multi_fn(params, tokens, positions, valid, kv, pt,
                          temps, top_ps, top_ks, seeds, counters,
-                         freq=None, pres=None, out_toks=None, out_valid=None,
+                         freq=None, pres=None, rep_p=None,
+                         out_toks=None, out_valid=None,
                          bias_ids=None, bias_vals=None, bias_gated=None,
                          min_toks=None):
                 if pen:
@@ -1141,6 +1148,7 @@ class JaxEngine:
                     ids = pick(
                         logits, (temps, top_ps, top_ks, seeds, counters),
                         counts=counts if pen else None, freq=freq, pres=pres,
+                        rep_p=rep_p,
                         bias_args=(
                             (bias_ids, bias_vals, bias_gated, min_toks)
                             if bias
@@ -1209,7 +1217,8 @@ class JaxEngine:
 
         def step_fn(params, tokens, positions, valid, kv, pt, last_idx,
                     temps, top_ps, top_ks, seeds, counters,
-                    freq=None, pres=None, out_toks=None, out_valid=None,
+                    freq=None, pres=None, rep_p=None,
+                    out_toks=None, out_valid=None,
                     bias_ids=None, bias_vals=None, bias_gated=None,
                     min_toks=None, mm_embeds=None, mm_mask=None):
             hidden, kv = adapter.forward_hidden(
@@ -1229,7 +1238,7 @@ class JaxEngine:
                 )
             ids = pick(
                 logits, (temps, top_ps, top_ks, seeds, counters),
-                counts=counts, freq=freq, pres=pres,
+                counts=counts, freq=freq, pres=pres, rep_p=rep_p,
                 bias_args=(
                     (bias_ids, bias_vals, bias_gated, min_toks)
                     if bias

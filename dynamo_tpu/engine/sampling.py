@@ -43,9 +43,22 @@ def apply_penalties(
     counts: jax.Array,  # [B, V] f32 output-token frequency
     freq_pen: jax.Array,  # [B] f32
     pres_pen: jax.Array,  # [B] f32
+    rep_pen: jax.Array | None = None,  # [B] f32 (1 = off)
 ) -> jax.Array:
     """OpenAI penalty rule: logit -= freq_pen * count + pres_pen * (count>0),
-    applied to the raw logits before temperature scaling."""
+    applied to the raw logits before temperature scaling. `rep_pen` is a
+    multiplicative repetition penalty (the reference exposes one via
+    nvext — protocols/openai/nvext.rs repetition_penalty): seen tokens'
+    logits divide by r when positive and multiply when negative, applied
+    before the additive penalties. Like frequency/presence here, "seen"
+    means GENERATED tokens only — prompt tokens are not penalized (HF's
+    generate also walks the prompt; penalizing it would grow the history
+    bucket to the full context length for every penalized step)."""
+    if rep_pen is not None:
+        seen = counts > 0
+        r = rep_pen[:, None]
+        adjusted = jnp.where(logits > 0, logits / r, logits * r)
+        logits = jnp.where(seen, adjusted, logits)
     return (
         logits
         - freq_pen[:, None] * counts

@@ -50,6 +50,9 @@ class PreprocessedRequest:
     logprobs: int = -1
     frequency_penalty: float = 0.0
     presence_penalty: float = 0.0
+    #: HF-style multiplicative repetition penalty (1 = off; ext or
+    #: top-level — the reference carries it in nvext)
+    repetition_penalty: float = 1.0
     #: OpenAI logit_bias as [[token_id, bias], ...] (validated/clamped)
     logit_bias: list = field(default_factory=list)
     #: eos/stop suppression floor (ext.min_tokens)
@@ -79,6 +82,10 @@ class PreprocessedRequest:
             "min_tokens": self.min_tokens,
             "annotations": self.annotations,
         }
+        if self.repetition_penalty != 1.0:
+            # omit the no-op default so older external-engine shims
+            # (docs/external_engines.md) keep parsing the dict
+            d["repetition_penalty"] = self.repetition_penalty
         if self.mm_embeds is not None:
             import numpy as np
 
@@ -198,6 +205,25 @@ class OpenAIPreprocessor:
                     "rendering on the multimodal path)"
                 )
             ids, mm_embeds, mm_positions = self._multimodal_prompt(messages)
+        elif request.extension and request.extension.use_raw_prompt:
+            # nvext use_raw_prompt (reference nvext.rs:56): skip the chat
+            # template and tokenize the concatenated message contents
+            # verbatim — for clients that pre-render their own prompt.
+            # Structured content contributes its text parts.
+            parts: list[str] = []
+            for m in messages:
+                c = m.get("content")
+                if isinstance(c, str):
+                    parts.append(c)
+                elif isinstance(c, list):
+                    parts += [
+                        p.get("text", "")
+                        for p in c
+                        if isinstance(p, dict) and p.get("type") == "text"
+                    ]
+            ids, mm_embeds, mm_positions = (
+                self.tokenizer.encode("".join(parts)), None, []
+            )
         else:
             prompt = self.tokenizer.apply_chat_template(
                 messages, tools=getattr(request, "tools", None)
@@ -219,6 +245,11 @@ class OpenAIPreprocessor:
             logprobs=_chat_logprobs(request),
             frequency_penalty=request.frequency_penalty or 0.0,
             presence_penalty=request.presence_penalty or 0.0,
+            repetition_penalty=(
+                request.repetition_penalty
+                if request.repetition_penalty is not None
+                else 1.0
+            ),
             logit_bias=_logit_bias_list(request.logit_bias),
         )
         pre.mm_embeds = mm_embeds
@@ -303,6 +334,11 @@ class OpenAIPreprocessor:
             logprobs=_completion_logprobs(request),
             frequency_penalty=request.frequency_penalty or 0.0,
             presence_penalty=request.presence_penalty or 0.0,
+            repetition_penalty=(
+                request.repetition_penalty
+                if request.repetition_penalty is not None
+                else 1.0
+            ),
             logit_bias=_logit_bias_list(request.logit_bias),
         )
 
@@ -310,10 +346,24 @@ class OpenAIPreprocessor:
         self, prompt_ids, max_tokens, temperature, top_p, top_k, seed, stop,
         ext, logprobs: int = -1, frequency_penalty: float = 0.0,
         presence_penalty: float = 0.0, logit_bias=None,
+        repetition_penalty: float = 1.0,
     ) -> PreprocessedRequest:
         min_tokens = int(ext.min_tokens or 0) if ext else 0
         if min_tokens < 0:
             raise ValueError(f"min_tokens must be >= 0; got {min_tokens}")
+        rep = repetition_penalty
+        if ext and ext.repetition_penalty is not None:
+            rep = ext.repetition_penalty
+        if rep is None or rep <= 0:
+            if rep is not None:
+                raise ValueError(
+                    f"repetition_penalty must be > 0; got {rep}"
+                )
+            rep = 1.0
+        if ext and ext.greed_sampling:
+            # nvext greed_sampling: force argmax decoding regardless of
+            # the request's temperature (reference nvext.rs:50)
+            temperature = 0.0
         return PreprocessedRequest(
             request_id=new_request_id(),
             token_ids=prompt_ids,
@@ -328,6 +378,7 @@ class OpenAIPreprocessor:
             logprobs=logprobs,
             frequency_penalty=frequency_penalty or 0.0,
             presence_penalty=presence_penalty or 0.0,
+            repetition_penalty=rep,
             logit_bias=logit_bias or [],
             min_tokens=min_tokens,
             annotations=(ext.annotations or {}) if ext else {},

@@ -27,6 +27,15 @@ class Ext(BaseModel):
     #: suppress eos/stop-token finishes until this many output tokens
     #: (the reference's common-protocol min_tokens)
     min_tokens: Optional[int] = None
+    #: skip chat-template rendering; tokenize the message contents
+    #: verbatim (reference nvext.rs use_raw_prompt)
+    use_raw_prompt: Optional[bool] = None
+    #: force argmax decoding regardless of temperature (nvext.rs
+    #: greed_sampling)
+    greed_sampling: Optional[bool] = None
+    #: HF-style multiplicative repetition penalty, > 0 (1 = off;
+    #: nvext.rs repetition_penalty — also accepted at top level)
+    repetition_penalty: Optional[float] = None
 
 
 class ChatMessage(BaseModel):
@@ -54,6 +63,7 @@ class ChatCompletionRequest(BaseModel):
     seed: Optional[int] = None
     frequency_penalty: Optional[float] = None
     presence_penalty: Optional[float] = None
+    repetition_penalty: Optional[float] = None  # extension, like top_k
     logprobs: Optional[bool] = None
     top_logprobs: Optional[int] = None  # 0-20 alternatives when logprobs=true
     #: OpenAI logit_bias: token id (JSON string or int) -> bias in
@@ -93,6 +103,7 @@ class CompletionRequest(BaseModel):
     logit_bias: Optional[dict[Union[int, str], float]] = None
     frequency_penalty: Optional[float] = None
     presence_penalty: Optional[float] = None
+    repetition_penalty: Optional[float] = None  # extension, like top_k
     ext: Optional[Ext] = None
     nvext: Optional[Ext] = None
 

@@ -566,3 +566,50 @@ def test_penalty_history_survives_preemption():
     hist = req.prompt_tokens[3:] + toks if req.num_emitted else toks
     all_gen = hist
     assert len(set(all_gen)) == len(all_gen), all_gen
+
+
+def test_repetition_penalty_breaks_repetition():
+    """nvext-style multiplicative repetition penalty (HF semantics): a
+    greedy run that repeats must diversify under a strong penalty, and
+    rep=1.0 must be byte-identical to off (the no-op default)."""
+    eng = JaxEngine(EngineConfig.for_tests())
+    eng.add_request(
+        "r0", [3, 1, 4, 1, 5],
+        SamplingParams(temperature=0.0, max_tokens=12),
+    )
+    base = eng.run_to_completion()["r0"]
+    assert len(set(base)) < len(base)  # repeats without the penalty
+
+    eng2 = JaxEngine(EngineConfig.for_tests())
+    eng2.add_request(
+        "r1", [3, 1, 4, 1, 5],
+        SamplingParams(temperature=0.0, max_tokens=12,
+                       repetition_penalty=1e9),
+    )
+    pen = eng2.run_to_completion()["r1"]
+    assert len(pen) == 12
+    # an enormous multiplicative penalty forbids any repeat
+    assert len(set(pen)) == len(pen), pen
+
+    eng3 = JaxEngine(EngineConfig.for_tests())
+    eng3.add_request(
+        "r2", [3, 1, 4, 1, 5],
+        SamplingParams(temperature=0.0, max_tokens=12,
+                       repetition_penalty=1.0),
+    )
+    assert eng3.run_to_completion()["r2"] == base
+
+
+def test_repetition_penalty_across_fused_steps():
+    """The fused-scan decode threads the repetition penalty through its
+    carry exactly like frequency/presence."""
+    base = EngineConfig.for_tests()
+    cfg = EngineConfig(**{**base.__dict__, "decode_steps": 8})
+    eng = JaxEngine(cfg)
+    eng.add_request(
+        "r3", [7, 7, 7],
+        SamplingParams(temperature=0.0, max_tokens=10,
+                       repetition_penalty=1e9),
+    )
+    toks = eng.run_to_completion()["r3"]
+    assert len(set(toks)) == len(toks), toks

@@ -158,3 +158,98 @@ def test_tools_render_into_hf_chat_template(tmp_path):
     assert "TOOL:" not in no_tools and "user: hi" in no_tools
     # byte + GGUF tokenizers: tools accepted and ignored
     assert "hi" in ByteTokenizer().apply_chat_template(msgs, tools=req.tools)
+
+
+def test_ext_use_raw_prompt_skips_template():
+    """nvext use_raw_prompt (reference nvext.rs:56): the chat template is
+    skipped and the message contents tokenize verbatim."""
+    t = ByteTokenizer()
+    p = OpenAIPreprocessor(t, model_name="m")
+    req = ChatCompletionRequest(
+        model="m",
+        messages=[ChatMessage(role="user", content="RAW PROMPT")],
+        ext=Ext(use_raw_prompt=True),
+    )
+    pre = p.preprocess_chat(req)
+    assert pre.token_ids == t.encode("RAW PROMPT")
+
+
+def test_ext_greed_sampling_forces_greedy():
+    """nvext greed_sampling (nvext.rs:50) zeroes the temperature."""
+    t = ByteTokenizer()
+    p = OpenAIPreprocessor(t, model_name="m")
+    req = ChatCompletionRequest(
+        model="m",
+        messages=[ChatMessage(role="user", content="hi")],
+        temperature=0.9,
+        ext=Ext(greed_sampling=True),
+    )
+    assert p.preprocess_chat(req).temperature == 0.0
+
+
+def test_repetition_penalty_plumbing():
+    """repetition_penalty flows from nvext (priority) or top level
+    (extension, like top_k); <= 0 rejected; wire dict omits the 1.0
+    default for older external-engine shims."""
+    t = ByteTokenizer()
+    p = OpenAIPreprocessor(t, model_name="m")
+    msgs = [ChatMessage(role="user", content="hi")]
+
+    top = ChatCompletionRequest(model="m", messages=msgs,
+                                repetition_penalty=1.3)
+    assert p.preprocess_chat(top).repetition_penalty == 1.3
+
+    ext = ChatCompletionRequest(model="m", messages=msgs,
+                                repetition_penalty=1.3,
+                                ext=Ext(repetition_penalty=1.7))
+    assert p.preprocess_chat(ext).repetition_penalty == 1.7
+
+    comp = CompletionRequest(model="m", prompt="abc",
+                             repetition_penalty=1.2)
+    pre = p.preprocess_completion(comp)
+    assert pre.repetition_penalty == 1.2
+    assert pre.to_dict()["repetition_penalty"] == 1.2
+
+    default = p.preprocess_chat(
+        ChatCompletionRequest(model="m", messages=msgs)
+    )
+    assert default.repetition_penalty == 1.0
+    assert "repetition_penalty" not in default.to_dict()
+
+    with pytest.raises(ValueError, match="repetition_penalty"):
+        p.preprocess_chat(
+            ChatCompletionRequest(model="m", messages=msgs,
+                                  ext=Ext(repetition_penalty=-2.0))
+        )
+
+
+def test_repetition_penalty_top_level_zero_rejected():
+    """Top-level 0 must 400 like the ext path (no silent no-op)."""
+    t = ByteTokenizer()
+    p = OpenAIPreprocessor(t, model_name="m")
+    with pytest.raises(ValueError, match="repetition_penalty"):
+        p.preprocess_chat(
+            ChatCompletionRequest(
+                model="m",
+                messages=[ChatMessage(role="user", content="hi")],
+                repetition_penalty=0.0,
+            )
+        )
+
+
+def test_use_raw_prompt_structured_content():
+    """Structured (list-of-parts) content contributes its text parts."""
+    t = ByteTokenizer()
+    p = OpenAIPreprocessor(t, model_name="m")
+    req = ChatCompletionRequest(
+        model="m",
+        messages=[
+            ChatMessage(
+                role="user",
+                content=[{"type": "text", "text": "AB"},
+                         {"type": "text", "text": "CD"}],
+            )
+        ],
+        ext=Ext(use_raw_prompt=True),
+    )
+    assert p.preprocess_chat(req).token_ids == t.encode("ABCD")
