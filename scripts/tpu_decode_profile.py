@@ -159,10 +159,12 @@ def time_dense_floor(batch: int) -> dict:
     x0 = jnp.ones((batch, max(l.shape[0] for l in leaves)), jnp.bfloat16)
 
     @jax.jit
-    def stream_all(x):
+    def stream_all(x, ws):
         # touch every >=2D parameter with a matmul shaped [B, in] @ [in, out]
+        # (ws passed as an ARGUMENT — closing over the params bakes 2.5GB
+        # of constants into the lowered program and stalls tunnel compiles)
         acc = jnp.zeros((batch,), jnp.float32)
-        for leaf in leaves:
+        for leaf in ws:
             w = leaf.reshape(leaf.shape[0], -1)
             y = jax.lax.dot_general(
                 x[:, : w.shape[0]], w,
@@ -172,11 +174,11 @@ def time_dense_floor(batch: int) -> dict:
             acc = acc + y.sum(axis=-1)
         return acc
 
-    stream_all(x0).block_until_ready()
+    stream_all(x0, leaves).block_until_ready()
     n = 10
     t0 = time.perf_counter()
     for _ in range(n):
-        stream_all(x0).block_until_ready()
+        stream_all(x0, leaves).block_until_ready()
     dt = (time.perf_counter() - t0) / n
     total_bytes = sum(l.size * l.dtype.itemsize for l in leaves)
     return {
