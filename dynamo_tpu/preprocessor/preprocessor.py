@@ -10,7 +10,7 @@ chunks (transform_postprocessor_stream :335 + backend.rs Decoder).
 from __future__ import annotations
 
 import logging
-from dataclasses import dataclass, field
+from dataclasses import dataclass, field, fields
 from typing import Any, AsyncIterator, Optional
 
 from dynamo_tpu.preprocessor.detokenize import DecodeStream
@@ -100,12 +100,22 @@ class PreprocessedRequest:
         d = dict(d)
         raw = d.pop("mm_embeds", None)
         shape = d.pop("mm_shape", None)
+        # the wire contract (docs/external_engines.md) says unknown fields
+        # may be ignored — honor it here too, so a newer frontend can add
+        # optional fields without breaking older workers
+        unknown = [k for k in d if k not in _REQUEST_FIELDS]
+        if unknown:
+            logger.debug("ignoring unknown request fields: %s", unknown)
+            d = {k: v for k, v in d.items() if k in _REQUEST_FIELDS}
         pre = PreprocessedRequest(**d)
         if raw is not None:
             import numpy as np
 
             pre.mm_embeds = np.frombuffer(raw, np.float32).reshape(shape)
         return pre
+
+
+_REQUEST_FIELDS = frozenset(f.name for f in fields(PreprocessedRequest))
 
 
 def _logit_bias_list(raw) -> list:

@@ -253,3 +253,14 @@ def test_use_raw_prompt_structured_content():
         ext=Ext(use_raw_prompt=True),
     )
     assert p.preprocess_chat(req).token_ids == t.encode("ABCD")
+
+
+def test_from_dict_ignores_unknown_fields():
+    """Wire-contract forward compatibility: a newer frontend's extra
+    fields must not break an older worker's from_dict."""
+    from dynamo_tpu.preprocessor.preprocessor import PreprocessedRequest
+
+    d = PreprocessedRequest(request_id="x", token_ids=[1, 2]).to_dict()
+    d["some_future_field"] = {"nested": True}
+    pre = PreprocessedRequest.from_dict(d)
+    assert pre.request_id == "x" and pre.token_ids == [1, 2]
