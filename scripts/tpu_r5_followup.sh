@@ -24,6 +24,12 @@ fi
 # retry empties via the queue's own stage functions (fresh queue pass
 # with an explicit stage list keeps run_stage semantics + tunnel waits)
 retries=()
+# error artifacts (bench.py emits value 0.0 on an engine/compile failure)
+# deserve one more attempt — the 09:33 bench_dsv2 failure was a transient
+# remote-compile HTTP 500
+if grep -q '"value": 0.0' "$OUT/bench_dsv2.json" 2>/dev/null; then
+  retries+=(bench_dsv2)
+fi
 [ -s "$OUT/disagg_ab.json" ]     || retries+=(disagg_ab)
 [ -s "$OUT/perf_sweep_8b.json" ] || retries+=(sweep_8b)
 [ -s "$OUT/profile_sla_8b.json" ] || retries+=(sla_8b)
