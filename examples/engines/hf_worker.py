@@ -125,6 +125,10 @@ class HFTransformersEngine:
             input_ids = torch.tensor([list(request.token_ids)], dtype=torch.long)
             past = None
             produced = 0
+            # optional wire field (omitted at the 1.0 no-op): HF-style
+            # multiplicative repetition penalty over generated tokens
+            rep = float(getattr(request, "repetition_penalty", 1.0) or 1.0)
+            generated: list[int] = []
             loop = asyncio.get_running_loop()
             while produced < request.max_tokens:
                 if context.cancelled:
@@ -142,10 +146,17 @@ class HFTransformersEngine:
                 # loop (lease keepalives, other requests) responsive
                 out = await loop.run_in_executor(None, step)
                 past = out.past_key_values
+                logits = out.logits[0, -1]
+                if rep != 1.0 and generated:
+                    idx = torch.tensor(sorted(set(generated)), dtype=torch.long)
+                    vals = logits[idx]
+                    logits = logits.clone()
+                    logits[idx] = torch.where(vals > 0, vals / rep, vals * rep)
                 tok = self._sample(
-                    out.logits[0, -1], request.temperature, request.top_p,
+                    logits, request.temperature, request.top_p,
                     generator,
                 )
+                generated.append(tok)
                 produced += 1
                 input_ids = torch.tensor([[tok]], dtype=torch.long)
                 if tok in stop_ids:

@@ -214,3 +214,31 @@ def test_hf_shim_script_subprocess_e2e():
     finally:
         for p in reversed(procs):
             p.stop()
+
+
+def test_hf_engine_repetition_penalty():
+    """The shim honors the optional wire field: a huge multiplicative
+    penalty forbids repeats that the unpenalized greedy run makes."""
+    from dynamo_tpu.preprocessor.preprocessor import PreprocessedRequest
+    from dynamo_tpu.runtime.context import Context
+
+    eng = _engine()
+
+    async def collect(req):
+        out = []
+        async for item in eng.generate(Context(request_id=req.request_id), req):
+            out += item["token_ids"]
+        return out
+
+    base = run(collect(PreprocessedRequest(
+        request_id="rp0", token_ids=[5, 9, 13], max_tokens=24,
+        temperature=0.0,
+    )))
+    assert len(set(base)) < len(base)  # greedy repeats from step 14 here
+
+    pen = run(collect(PreprocessedRequest(
+        request_id="rp1", token_ids=[5, 9, 13], max_tokens=24,
+        temperature=0.0, repetition_penalty=1e9,
+    )))
+    assert len(pen) == 24
+    assert len(set(pen)) == len(pen), pen
