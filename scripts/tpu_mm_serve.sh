@@ -1,0 +1,97 @@
+#!/usr/bin/env bash
+# BASELINE config 5 on the chip: the Qwen2-VL multimodal graph (encode
+# worker -> prefill/decode worker) serving an image chat on the TPU.
+# Random tiny weights (no checkpoints in the image) — the evidence is
+# the full pipeline (ViT tower + m-RoPE splice + paged serving)
+# compiling and serving on hardware. Artifact: artifacts/tpu/mm_serve.json
+set -u
+cd "$(dirname "$0")/.."
+OUT=artifacts/tpu
+mkdir -p "$OUT"
+
+if ! timeout 120 python -c \
+  "import jax,sys; sys.exit(0 if jax.devices()[0].platform!='cpu' else 1)" \
+  >/dev/null 2>&1; then
+  echo "tunnel down; not running" >&2
+  exit 1
+fi
+
+python - << 'PY' > "$OUT/mm_serve.json" 2> "$OUT/mm_serve.err"
+import base64
+import json
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+
+import numpy as np
+
+PLATFORM = subprocess.run(
+    [sys.executable, "-c", "import jax; print(jax.default_backend())"],
+    capture_output=True, text=True, timeout=120,
+).stdout.strip() or "unknown"
+
+PORT = 8931
+cfg = open("examples/multimodal/config_qwen2vl.yaml").read()
+cfg = cfg.replace("port: 8080", f"port: {PORT}")
+cfg_path = "/tmp/mm_serve_chip.yaml"
+open(cfg_path, "w").write(cfg)
+proc = subprocess.Popen(
+    [sys.executable, "-m", "dynamo_tpu.cli.run", "serve",
+     "examples.multimodal.graph:MultimodalFrontend", "-f", cfg_path],
+    stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT,
+)
+try:
+    pixels = np.random.default_rng(0).random((16, 16, 3), np.float32)
+    body = json.dumps({
+        "model": "qwen2-vl-tiny",
+        "messages": [{
+            "role": "user",
+            "content": [
+                {"type": "text", "text": "describe"},
+                {"type": "image_pixels",
+                 "data": base64.b64encode(pixels.tobytes()).decode(),
+                 "shape": [16, 16, 3]},
+            ],
+        }],
+        "max_tokens": 8,
+    }).encode()
+    deadline = time.time() + 1500  # tunnel compiles are minutes each
+    last_err = None
+    t0 = None
+    while time.time() < deadline:
+        try:
+            t0 = time.time()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{PORT}/v1/chat/completions", data=body,
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=600) as r:
+                out = json.load(r)
+            print(json.dumps({
+                "ok": True,
+                "platform": PLATFORM,
+                "model": "qwen2-vl-tiny (random weights)",
+                "completion_tokens": out["usage"]["completion_tokens"],
+                "request_s": round(time.time() - t0, 2),
+                "note": "full multimodal pipeline (ViT tower + m-RoPE "
+                        "splice + paged decode) served end-to-end; "
+                        "BASELINE config 5's topology",
+            }, indent=1))
+            break
+        except Exception as e:  # noqa: BLE001 - boot races are expected
+            last_err = repr(e)
+            time.sleep(10)
+    else:
+        print(json.dumps({"ok": False, "error": last_err}, indent=1))
+finally:
+    proc.send_signal(signal.SIGTERM)
+    try:
+        proc.wait(timeout=20)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+PY
+rc=$?
+tail -c 300 "$OUT/mm_serve.json"
+exit $rc
