@@ -64,7 +64,8 @@ def test_engine_fuzz_bounded(model, rounds):
             elif style < 0.93:
                 samp = SamplingParams(
                     temperature=0.0, max_tokens=rng.randrange(1, 8),
-                    frequency_penalty=rng.choice([0.5, 30.0]),
+                    frequency_penalty=rng.choice([0.0, 0.5, 30.0]),
+                    repetition_penalty=rng.choice([1.0, 1.3, 50.0]),
                 )
             else:
                 # logit_bias / min_tokens: gated sampler bans must hold
