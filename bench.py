@@ -294,6 +294,17 @@ def main() -> None:
     metric = "output_tok_s_per_chip"
     tpu_latest = None
     kernel_check = None
+    disagg_ab = None
+
+    def _stamp(path: str) -> dict:
+        mt = os.path.getmtime(path)
+        return {
+            "age_hours": round((time.time() - mt) / 3600.0, 1),
+            "recorded_utc": time.strftime(
+                "%Y-%m-%dT%H:%M:%SZ", time.gmtime(mt)
+            ),
+        }
+
     if platform != "tpu":
         metric = "output_tok_s_cpu_fallback"
         art_dir = os.path.join(
@@ -315,13 +326,9 @@ def main() -> None:
             )
             with open(newest) as f:
                 payload = json.load(f)
-            mtime = os.path.getmtime(newest)
             tpu_latest = {
                 "file": os.path.basename(newest),
-                "age_hours": round((time.time() - mtime) / 3600.0, 1),
-                "recorded_utc": time.strftime(
-                    "%Y-%m-%dT%H:%M:%SZ", time.gmtime(mtime)
-                ),
+                **_stamp(newest),
                 "payload": payload,
             }
         except (OSError, ValueError):
@@ -335,15 +342,29 @@ def main() -> None:
             with open(kp) as f:
                 kdoc = json.load(f)
             if kdoc.get("platform") == "tpu":
-                kmtime = os.path.getmtime(kp)
                 kernel_check = {
                     "all_ok": kdoc.get("all_ok"),
-                    "age_hours": round(
-                        (time.time() - kmtime) / 3600.0, 1
-                    ),
-                    "recorded_utc": time.strftime(
-                        "%Y-%m-%dT%H:%M:%SZ", time.gmtime(kmtime)
-                    ),
+                    **_stamp(kp),
+                }
+        except (OSError, ValueError):
+            pass
+        # the round's headline A/B (disagg vs agg on chip) rides along
+        # too — it is the reference's own north-star comparison. Same
+        # provenance rule as kernel_check: only chip-declared artifacts.
+        try:
+            ap = os.path.join(art_dir, "disagg_ab.json")
+            with open(ap) as f:
+                adoc = json.load(f)
+            if (
+                adoc.get("platform") == "tpu"
+                and "disagg_throughput_ratio" in adoc
+            ):
+                disagg_ab = {
+                    "disagg_throughput_ratio": adoc[
+                        "disagg_throughput_ratio"
+                    ],
+                    "disagg_ttft_ratio": adoc.get("disagg_ttft_ratio"),
+                    **_stamp(ap),
                 }
         except (OSError, ValueError):
             pass
@@ -369,6 +390,7 @@ def main() -> None:
                 "baseline_workload": baseline_workload,
                 **({"latest_tpu_artifact": tpu_latest} if tpu_latest else {}),
                 **({"kernel_check": kernel_check} if kernel_check else {}),
+                **({"disagg_ab_chip": disagg_ab} if disagg_ab else {}),
                 "attention_impl": best_impl,
                 "attention_impls": {
                     k: {

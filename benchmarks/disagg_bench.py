@@ -171,6 +171,15 @@ def main(argv=None) -> None:
                 json.dump(results, f, indent=1)
 
     results: dict = {}
+    # provenance: the A/B's workers run on this platform (bench.py only
+    # carries the artifact forward as chip evidence when it says "tpu")
+    import subprocess
+    import sys as _sys
+
+    results["platform"] = subprocess.run(
+        [_sys.executable, "-c", "import jax; print(jax.default_backend())"],
+        capture_output=True, text=True, timeout=180,
+    ).stdout.strip() or "unknown"
     results["agg"] = run_topology(args, disagg=False)
     _flush(results)
     results["disagg"] = run_topology(args, disagg=True)
