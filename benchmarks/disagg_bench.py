@@ -176,10 +176,16 @@ def main(argv=None) -> None:
     import subprocess
     import sys as _sys
 
-    results["platform"] = subprocess.run(
-        [_sys.executable, "-c", "import jax; print(jax.default_backend())"],
-        capture_output=True, text=True, timeout=180,
-    ).stdout.strip() or "unknown"
+    try:
+        results["platform"] = subprocess.run(
+            [_sys.executable, "-c",
+             "import jax; print(jax.default_backend())"],
+            capture_output=True, text=True, timeout=180,
+        ).stdout.strip() or "unknown"
+    except (subprocess.SubprocessError, OSError):
+        # provenance is best-effort: a wedged tunnel hanging the probe
+        # must not kill the A/B (bench.py simply won't carry "unknown")
+        results["platform"] = "unknown"
     results["agg"] = run_topology(args, disagg=False)
     _flush(results)
     results["disagg"] = run_topology(args, disagg=True)

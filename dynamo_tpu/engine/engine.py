@@ -81,6 +81,16 @@ class EngineMetrics:
     #: below spec_min_accept_rate and the engine is backing off)
     spec_skipped_ineligible: int = 0
     spec_skipped_cooldown: int = 0
+    #: step-phase wall time, cumulative ms (host-loop observability:
+    #: time_*_ms − the profiler's pure program time = host overhead,
+    #: see scripts/tpu_decode_profile.py / docs/PERF.md). schedule
+    #: covers admission + batch packing; prefill/decode cover host
+    #: array build + dispatch + device sync + postprocess.
+    time_schedule_ms: float = 0.0
+    time_prefill_ms: float = 0.0
+    time_decode_ms: float = 0.0
+    prefill_dispatches: int = 0
+    decode_dispatches: int = 0
 
     def to_dict(self) -> dict:
         return dict(self.__dict__)
@@ -373,13 +383,26 @@ class JaxEngine:
         return self.scheduler.has_work
 
     def step(self) -> list[StepOutput]:
+        t0 = time.perf_counter()
         batch = self.scheduler.schedule()
+        t1 = time.perf_counter()
+        self.metrics.time_schedule_ms += (t1 - t0) * 1000.0
         outputs = self._drain_doomed()
         if batch is not None:
+            t2 = time.perf_counter()  # after the drain: phase time is
+            # dispatch+sync+postprocess only, as the field docs promise
             if batch.kind == "prefill":
                 outputs += self._run_prefill(batch)
+                self.metrics.prefill_dispatches += 1
+                self.metrics.time_prefill_ms += (
+                    time.perf_counter() - t2
+                ) * 1000.0
             else:
                 outputs += self._run_decode(batch)
+                self.metrics.decode_dispatches += 1
+                self.metrics.time_decode_ms += (
+                    time.perf_counter() - t2
+                ) * 1000.0
             self.metrics.steps += 1
         self._refresh_metrics()
         return outputs

@@ -44,6 +44,13 @@ _WORKER_FIELDS = (
     ("kv_transfer_bulk_total", "counter"),
     ("kv_transfer_host_total", "counter"),
     ("remote_prefills_total", "counter"),
+    # step-phase wall time (EngineMetrics.time_*_ms — host-loop
+    # observability; ratios against dispatch counters give ms/dispatch)
+    ("time_schedule_ms", "counter"),
+    ("time_prefill_ms", "counter"),
+    ("time_decode_ms", "counter"),
+    ("prefill_dispatches", "counter"),
+    ("decode_dispatches", "counter"),
 )
 
 

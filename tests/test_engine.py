@@ -397,3 +397,20 @@ def test_adaptive_prefill_budget_engine_e2e():
     adaptive = serve("adaptive")
     assert fixed == adaptive  # identical greedy outputs per request
     assert all(len(v) == 6 for v in adaptive.values())
+
+
+def test_step_phase_timing_metrics():
+    """EngineMetrics accumulates per-phase wall time and dispatch counts
+    (the host-loop observability plane — exported via metrics_service)."""
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.engine import JaxEngine
+    from dynamo_tpu.engine.request import SamplingParams
+
+    eng = JaxEngine(EngineConfig.for_tests())
+    eng.add_request("t0", [1, 2, 3, 4], SamplingParams(temperature=0.0, max_tokens=4))
+    eng.run_to_completion()
+    m = eng.metrics.to_dict()
+    assert m["prefill_dispatches"] >= 1
+    assert m["decode_dispatches"] >= 1
+    assert m["time_prefill_ms"] > 0 and m["time_decode_ms"] > 0
+    assert m["time_schedule_ms"] >= 0
