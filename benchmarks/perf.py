@@ -160,6 +160,17 @@ def bench_engine(
     )
     if mfu is not None:
         out["mfu"] = round(mfu, 4)
+    # the engine's step-phase timing plane (cumulative over this run —
+    # subtract across sweep levels for per-level numbers): where wall
+    # time went, host loop included
+    m = engine.metrics
+    out["engine_timing"] = {
+        "time_schedule_ms": round(m.time_schedule_ms, 1),
+        "time_prefill_ms": round(m.time_prefill_ms, 1),
+        "time_decode_ms": round(m.time_decode_ms, 1),
+        "prefill_dispatches": m.prefill_dispatches,
+        "decode_dispatches": m.decode_dispatches,
+    }
     return out
 
 
