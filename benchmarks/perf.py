@@ -98,6 +98,13 @@ def bench_engine(
     from dynamo_tpu.engine.request import SamplingParams
 
     pending = list(enumerate(prompts))
+    timing0 = {
+        k: getattr(engine.metrics, k)
+        for k in (
+            "time_schedule_ms", "time_prefill_ms", "time_decode_ms",
+            "prefill_dispatches", "decode_dispatches",
+        )
+    }
     starts: dict[str, float] = {}
     first: dict[str, float] = {}
     last: dict[str, float] = {}
@@ -160,16 +167,16 @@ def bench_engine(
     )
     if mfu is not None:
         out["mfu"] = round(mfu, 4)
-    # the engine's step-phase timing plane (cumulative over this run —
-    # subtract across sweep levels for per-level numbers): where wall
-    # time went, host loop included
+    # the engine's step-phase timing plane, as a DELTA over this call —
+    # per-level numbers that exclude warmup/compile from earlier calls
     m = engine.metrics
     out["engine_timing"] = {
-        "time_schedule_ms": round(m.time_schedule_ms, 1),
-        "time_prefill_ms": round(m.time_prefill_ms, 1),
-        "time_decode_ms": round(m.time_decode_ms, 1),
-        "prefill_dispatches": m.prefill_dispatches,
-        "decode_dispatches": m.decode_dispatches,
+        k: (
+            round(getattr(m, k) - timing0[k], 1)
+            if isinstance(timing0[k], float)
+            else getattr(m, k) - timing0[k]
+        )
+        for k in timing0
     }
     return out
 

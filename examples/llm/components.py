@@ -11,7 +11,8 @@ Config keys (YAML per service, see configs/):
   Frontend:   port
   Worker:     model, engine (jax|echo|mock), router-mode, page-size,
               num-pages, max-context, dtype, disagg, max-local-prefill,
-              prefill-chunk, max-seqs, decode-steps, spec-ngram, quantize,
+              prefill-chunk, prefill-budget, prefill-policy (fixed|adaptive),
+              prefill-budget-max, max-seqs, decode-steps, spec-ngram, quantize,
               host-kv-bytes, disk-kv-bytes, disk-kv-dir, dp, tp, sp, ep
   PrefillWorkerService: model + the same engine keys as Worker
 """
@@ -39,6 +40,17 @@ def _engine_config(cfg: dict):
         decode_steps=int(cfg.get("decode-steps", 8)),
         spec_ngram=int(cfg.get("spec-ngram", 0)),
         quantize=cfg.get("quantize"),
+        prefill_token_budget=(
+            int(cfg["prefill-budget"])
+            if cfg.get("prefill-budget") is not None
+            else None
+        ),
+        prefill_budget_policy=cfg.get("prefill-policy", "fixed"),
+        prefill_budget_max=(
+            int(cfg["prefill-budget-max"])
+            if cfg.get("prefill-budget-max") is not None
+            else None
+        ),
         host_kv_cache_bytes=int(cfg.get("host-kv-bytes", 0)),
         disk_kv_cache_bytes=int(cfg.get("disk-kv-bytes", 0)),
         disk_kv_cache_dir=cfg.get("disk-kv-dir"),
