@@ -31,6 +31,8 @@ def test_metrics_service_exposition():
                     "requests_received": 7,
                     "kv_transfer_bulk_total": 4,
                     "remote_prefills_total": 5,
+                    "time_decode_ms": 123.5,
+                    "decode_dispatches": 9,
                 },
             )
             for _ in range(2):
@@ -71,6 +73,15 @@ def test_metrics_service_exposition():
             assert "dynamo_tpu_kv_hit_rate_events_total 2" in text
             assert "dynamo_tpu_kv_hit_rate_isl_tokens_total 200" in text
             assert "dynamo_tpu_kv_hit_rate_overlap_tokens_total 128" in text
+            # step-phase timing plane (EngineMetrics.time_*_ms)
+            assert (
+                'dynamo_tpu_worker_time_decode_ms'
+                '{component="backend",instance="worker-1"} 123.5' in text
+            )
+            assert (
+                'dynamo_tpu_worker_decode_dispatches'
+                '{component="backend",instance="worker-1"} 9' in text
+            )
             assert "dynamo_tpu_kv_hit_rate 0.64" in text
             assert health["workers"] == 1
 
