@@ -32,6 +32,7 @@ if grep -q '"value": 0.0' "$OUT/bench_dsv2.json" 2>/dev/null; then
   retries+=(bench_dsv2)
 fi
 [ -s "$OUT/disagg_ab.json" ]     || retries+=(disagg_ab)
+[ -s "$OUT/ft_device_kill.json" ] || retries+=(ft_kill)
 [ -s "$OUT/perf_sweep_8b.json" ] || retries+=(sweep_8b)
 [ -s "$OUT/profile_sla_8b.json" ] || retries+=(sla_8b)
 [ -s "$OUT/bench_1b.json" ]      || retries+=(bench_1b_sweep)
