@@ -100,10 +100,7 @@ def bench_engine(
     pending = list(enumerate(prompts))
     timing0 = {
         k: getattr(engine.metrics, k)
-        for k in (
-            "time_schedule_ms", "time_prefill_ms", "time_decode_ms",
-            "prefill_dispatches", "decode_dispatches",
-        )
+        for k in type(engine.metrics).TIMING_FIELDS
     }
     starts: dict[str, float] = {}
     first: dict[str, float] = {}
@@ -171,12 +168,7 @@ def bench_engine(
     # per-level numbers that exclude warmup/compile from earlier calls
     m = engine.metrics
     out["engine_timing"] = {
-        k: (
-            round(getattr(m, k) - timing0[k], 1)
-            if isinstance(timing0[k], float)
-            else getattr(m, k) - timing0[k]
-        )
-        for k in timing0
+        k: round(getattr(m, k) - timing0[k], 1) for k in timing0
     }
     return out
 

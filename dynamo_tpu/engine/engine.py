@@ -92,6 +92,13 @@ class EngineMetrics:
     prefill_dispatches: int = 0
     decode_dispatches: int = 0
 
+    #: the timing plane's field names — the one list consumers (perf
+    #: harness, dashboards) should iterate instead of restating
+    TIMING_FIELDS = (
+        "time_schedule_ms", "time_prefill_ms", "time_decode_ms",
+        "prefill_dispatches", "decode_dispatches",
+    )
+
     def to_dict(self) -> dict:
         return dict(self.__dict__)
 

@@ -364,12 +364,8 @@ class OpenAIPreprocessor:
         rep = repetition_penalty
         if ext and ext.repetition_penalty is not None:
             rep = ext.repetition_penalty
-        if rep is None or rep <= 0:
-            if rep is not None:
-                raise ValueError(
-                    f"repetition_penalty must be > 0; got {rep}"
-                )
-            rep = 1.0
+        if rep <= 0:
+            raise ValueError(f"repetition_penalty must be > 0; got {rep}")
         if ext and ext.greed_sampling:
             # nvext greed_sampling: force argmax decoding regardless of
             # the request's temperature (reference nvext.rs:50)
