@@ -18,7 +18,7 @@ STAGES = [
     "pallas_kernels", "prewarm", "disagg_ab", "disagg_ab_partial",
     "perf_sweep_8b", "profile_sla_8b", "ft_device_kill", "routing_engine",
     "offload_ab", "bench_dsv2", "decode_prof", "bench_1b", "pallas_gate",
-    "transfer", "ttft_budget", "bench_dsr1",
+    "transfer", "ttft_budget", "bench_dsr1", "mm_serve",
 ]
 
 
