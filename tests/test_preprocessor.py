@@ -264,3 +264,32 @@ def test_from_dict_ignores_unknown_fields():
     d["some_future_field"] = {"nested": True}
     pre = PreprocessedRequest.from_dict(d)
     assert pre.request_id == "x" and pre.token_ids == [1, 2]
+
+
+def test_use_raw_prompt_multimodal_precedence():
+    """Image-bearing prompts take the multimodal splice path even when
+    use_raw_prompt is set — the raw-text path has nowhere to put image
+    embeddings, so multimodal wins deliberately."""
+    import numpy as np
+
+    t = ByteTokenizer()
+    p = OpenAIPreprocessor(t, model_name="m")
+    req = ChatCompletionRequest(
+        model="m",
+        messages=[ChatMessage(role="user", content="x")],
+        ext=Ext(use_raw_prompt=True),
+    )
+    messages = [
+        {
+            "role": "user",
+            "content": [
+                {"type": "text", "text": "see"},
+                {
+                    "type": "image_embed",
+                    "embedding": np.ones((2, 8), np.float32),
+                },
+            ],
+        }
+    ]
+    pre = p.preprocess_chat_messages(messages, req)
+    assert pre.mm_embeds is not None and len(pre.mm_positions) == 2
