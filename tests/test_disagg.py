@@ -824,3 +824,84 @@ def test_bulk_fallback_without_listener(monkeypatch):
             await server.stop()
 
     run(main())
+
+
+def test_disagg_prefill_worker_adaptive_budget(monkeypatch):
+    """The prefill worker is where the adaptive budget matters most (it
+    drains the shared queue's prompt backlog): the disagg path produces
+    identical tokens under the adaptive policy."""
+    monkeypatch.setenv("DYN_KV_TRANSFER", "host")
+    from dynamo_tpu.disagg.prefill_worker import PrefillWorker
+    from dynamo_tpu.model_card import ModelDeploymentCard
+    from dynamo_tpu.runtime import DistributedRuntime, RouterMode
+    from dynamo_tpu.runtime.fabric import FabricServer
+    from dynamo_tpu.worker import Worker
+
+    base = EngineConfig.for_tests()
+    cfg = EngineConfig(**{
+        **base.__dict__,
+        "prefill_token_budget": base.page_size,
+        "prefill_budget_policy": "adaptive",
+    })
+    prompts = [[5, 17, 42, 99, 3, 8, 21, 60, 11, 2, 13, 44],
+               [9, 9, 4, 1, 6, 2, 7, 3, 5, 8, 10, 12]]
+    n_out = 4
+
+    refs = {}
+    ref = JaxEngine(cfg)
+    for i, p in enumerate(prompts):
+        ref.add_request(
+            f"ref{i}", p, SamplingParams(temperature=0.0, max_tokens=n_out)
+        )
+    refs = ref.run_to_completion()
+
+    card = ModelDeploymentCard(
+        name="tiny", kv_page_size=cfg.page_size,
+        context_length=cfg.max_context,
+    )
+
+    def _req(rid, toks):
+        return {
+            "request_id": rid, "token_ids": toks, "max_tokens": n_out,
+            "temperature": 0.0, "top_p": 1.0, "top_k": 0, "seed": None,
+            "stop_token_ids": [], "stop_strings": [], "ignore_eos": True,
+            "annotations": {},
+        }
+
+    async def main():
+        server = FabricServer(port=0)
+        await server.start()
+        rt_d = await DistributedRuntime.create(server.address)
+        decode = Worker(
+            rt_d, card, engine_config=cfg, engine_kind="jax",
+            namespace="adapt", metrics_interval=0.1, enable_disagg=True,
+            disagg_config=DisaggConfig(
+                max_local_prefill_length=4, transfer_timeout_s=20.0
+            ),
+        )
+        await decode.start()
+        rt_p = await DistributedRuntime.create(server.address)
+        prefill = PrefillWorker(rt_p, cfg, namespace="adapt")
+        await prefill.start()
+        rt_c = await DistributedRuntime.create(server.address)
+        try:
+            ep = rt_c.namespace("adapt").component("backend").endpoint(
+                "generate"
+            )
+            router = await ep.router(mode=RouterMode.ROUND_ROBIN)
+            await router.source.wait_for_instances()
+            for i, p in enumerate(prompts):
+                toks = []
+                async for item in router.generate(_req(f"a{i}", p)):
+                    toks.extend(item.get("token_ids", ()))
+                assert toks == refs[f"ref{i}"], (i, toks)
+            assert prefill.prefills_done == len(prompts)
+        finally:
+            await rt_c.close()
+            await prefill.stop()
+            await decode.stop()
+            await rt_p.close()
+            await rt_d.close()
+            await server.stop()
+
+    run(main())
