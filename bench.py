@@ -106,7 +106,11 @@ def main() -> None:
     # the whole batch), pages sized for prompt+output per sequence.
     pages_per_seq = -(-(isl + osl + 1) // 64)
 
-    def make_engine(attention_impl: str) -> JaxEngine:
+    def make_engine(
+        attention_impl: str,
+        overlap: bool = True,
+        decode_steps: int = None,
+    ) -> JaxEngine:
         cfg = EngineConfig(
             model=model,
             num_pages=max(512, num_requests * (pages_per_seq + 1)),
@@ -125,7 +129,11 @@ def main() -> None:
             # behind a ~65ms tunnel round-trip, so syncs dominate
             # unamortized).
             prefill_token_budget=num_requests * chunk,
-            decode_steps=int(os.environ.get("BENCH_DECODE_STEPS", "64")),
+            decode_steps=(
+                decode_steps
+                if decode_steps is not None
+                else int(os.environ.get("BENCH_DECODE_STEPS", "64"))
+            ),
             max_seqs=max(32, num_requests),
             dtype="bfloat16",
             enable_prefix_caching=False,
@@ -134,6 +142,7 @@ def main() -> None:
             # pages.
             quantize=os.environ.get("BENCH_QUANTIZE") or None,
             attention_impl=attention_impl,
+            overlap_decode=overlap,
         )
         return JaxEngine(cfg)
 
@@ -197,6 +206,16 @@ def main() -> None:
         eng.run_to_completion()
         eng.allocator.clear_cache()
 
+        # decode phase split (dispatch/sync/postprocess + overlap
+        # counters) is reported as deltas over the TIMED section only
+        phase0 = {
+            k: getattr(eng.metrics, k)
+            for k in (
+                "time_decode_dispatch_ms", "time_decode_sync_ms",
+                "time_decode_host_ms", "overlap_dispatches",
+                "overlap_hits", "overlap_rollbacks",
+            )
+        }
         t0 = time.time()
         submit = {}
         first_token = {}
@@ -232,6 +251,10 @@ def main() -> None:
             "p50_itl": itls[len(itls) // 2] if itls else float("nan"),
             "elapsed": elapsed,
             "generated": generated,
+            "decode_phases": {
+                k: round(getattr(eng.metrics, k) - v, 2)
+                for k, v in phase0.items()
+            },
         }
 
     per_impl = {impls[0]: run_timed(eng)}
@@ -244,6 +267,38 @@ def main() -> None:
         per_impl[impl] = run_timed(eng)
     best_impl = max(per_impl, key=lambda k: per_impl[k]["tok_s"])
     best = per_impl[best_impl]
+
+    # Overlap on/off A/B (CPU fallback only): the overlapped decode
+    # loop's win lives where per-step syncs dominate, so the A/B runs
+    # the same workload at decode_steps=1 (classic stepping) with
+    # overlap_decode on vs off — BENCH_r06 carries the evidence even
+    # when the TPU tunnel is down. The TPU headline number already runs
+    # with overlap on (fused K amortizes most of what's left).
+    overlap_ab = None
+    if platform != "tpu" and os.environ.get("BENCH_OVERLAP_AB", "1") != "0":
+        import gc
+
+        ab_steps = int(os.environ.get("BENCH_OVERLAP_AB_STEPS", "1"))
+        overlap_ab = {"decode_steps": ab_steps}
+        for tag, ov in (("overlap_on", True), ("overlap_off", False)):
+            del eng
+            gc.collect()
+            eng = make_engine(best_impl, overlap=ov, decode_steps=ab_steps)
+            r = run_timed(eng)
+            ph = r["decode_phases"]
+            overlap_ab[tag] = {
+                "tok_s": round(r["tok_s"], 2),
+                "decode_dispatch_ms": ph["time_decode_dispatch_ms"],
+                "decode_sync_ms": ph["time_decode_sync_ms"],
+                "decode_host_ms": ph["time_decode_host_ms"],
+            }
+        off_tok_s = overlap_ab["overlap_off"]["tok_s"]
+        overlap_ab["speedup"] = (
+            round(overlap_ab["overlap_on"]["tok_s"] / off_tok_s, 3)
+            if off_tok_s
+            else None
+        )
+
     tok_s = best["tok_s"]
     p50_ttft = best["p50_ttft"]
     p50_itl = best["p50_itl"]
@@ -387,6 +442,24 @@ def main() -> None:
                 "mfu": round(mfu, 4) if mfu == mfu else None,
                 "elapsed_s": round(elapsed, 2),
                 "generated_tokens": generated,
+                # decode phase split of the headline run (docs/engine.md
+                # "The decode loop"): sync ≈ 0 means the overlapped
+                # pipeline has taken the host readback off the critical
+                # path
+                "decode_dispatch_ms": best["decode_phases"][
+                    "time_decode_dispatch_ms"
+                ],
+                "decode_sync_ms": best["decode_phases"][
+                    "time_decode_sync_ms"
+                ],
+                "decode_host_ms": best["decode_phases"][
+                    "time_decode_host_ms"
+                ],
+                "overlap_hits": best["decode_phases"]["overlap_hits"],
+                "overlap_rollbacks": best["decode_phases"][
+                    "overlap_rollbacks"
+                ],
+                **({"overlap_ab": overlap_ab} if overlap_ab else {}),
                 "baseline_workload": baseline_workload,
                 **({"latest_tpu_artifact": tpu_latest} if tpu_latest else {}),
                 **({"kernel_check": kernel_check} if kernel_check else {}),

@@ -45,6 +45,7 @@ def _engine_config(args, eos_token_ids: tuple = ()) -> EngineConfig:
         disk_kv_cache_bytes=getattr(args, "disk_kv_bytes", 0),
         disk_kv_cache_dir=getattr(args, "disk_kv_dir", None),
         spec_ngram=getattr(args, "spec_ngram", 0),
+        overlap_decode=getattr(args, "overlap_decode", True),
         quantize=getattr(args, "quantize", None),
         attention_impl=getattr(args, "attention_impl", "auto"),
         prefill_token_budget=getattr(args, "prefill_budget", None),
@@ -628,6 +629,13 @@ def build_parser() -> argparse.ArgumentParser:
         "--spec-ngram", type=int, default=0, dest="spec_ngram",
         help="speculative decoding: draft tokens per step proposed by "
              "prompt lookup and verified in one forward pass (0 = off)",
+    )
+    runp.add_argument(
+        "--no-overlap-decode", action="store_false", dest="overlap_decode",
+        default=True,
+        help="disable the overlapped decode loop (speculative next-step "
+             "dispatch with one-step-lagged host readback; on by default, "
+             "auto-off on multi-host SPMD and with --spec-ngram)",
     )
     runp.add_argument(
         "--quantize", default=None, choices=["int8"],

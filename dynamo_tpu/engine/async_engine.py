@@ -68,7 +68,15 @@ def _sampling_from(req: PreprocessedRequest) -> SamplingParams:
 
 
 class AsyncEngineRunner:
-    """Thread-backed continuous-batching loop around a JaxEngine."""
+    """Thread-backed continuous-batching loop around a JaxEngine.
+
+    With the engine's overlapped decode pipeline (EngineConfig
+    .overlap_decode), each `eng.step()` returns step N's outputs while
+    step N+1 is already in flight on device — so this loop streams
+    tokens to clients (and drains admissions/aborts for the next step)
+    exactly in the window the device is computing. When the queue
+    drains, any dangling speculative dispatch is discarded before the
+    thread sleeps so its device buffers free promptly."""
 
     def __init__(self, engine: JaxEngine):
         self.engine = engine
@@ -138,6 +146,9 @@ class AsyncEngineRunner:
             for rid in aborts:
                 eng.abort_request(rid)
             if not eng.has_work:
+                drain = getattr(eng, "drain_overlap", None)
+                if drain is not None:
+                    drain()
                 self._wake.wait(timeout=0.05)
                 self._wake.clear()
                 continue

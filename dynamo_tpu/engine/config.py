@@ -52,6 +52,17 @@ class EngineConfig:
     #: conditions are applied on the host afterwards — up to K-1 speculative
     #: tokens past a stop are computed and dropped. 1 = classic stepping.
     decode_steps: int = 8
+    #: overlapped decode loop: after dispatching decode step N, dispatch
+    #: step N+1 speculatively (same batch, +1 round, sampled ids fed back
+    #: on device) and read step N's ids back one step lagged via an async
+    #: copy — host postprocessing and array staging hide under device
+    #: compute. Rolled back (overshoot discarded, like decode_multi's
+    #: post-stop tokens) when a finish/preemption/abort/admitted prefill
+    #: changes the batch. Forced off on multi-process SPMD meshes (until
+    #: validated under lockstep) and when spec_ngram > 0 (prompt-lookup
+    #: drafts need host tokens). Token streams are bit-identical to the
+    #: synchronous path (pinned by tests/test_engine_overlap.py).
+    overlap_decode: bool = True
     #: speculative decoding by prompt lookup (draft-free n-gram
     #: speculation): propose this many draft tokens per decode step from
     #: the last occurrence of the sequence's trailing n-gram, verify all

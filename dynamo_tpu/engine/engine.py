@@ -10,6 +10,17 @@ Execution model per `step()`:
   scheduler -> ScheduledBatch -> pad to bucket -> jitted forward+sample ->
   host sync of sampled ids -> append/finish bookkeeping + page registration.
 
+Overlapped decode (config.overlap_decode, docs/engine.md "The decode
+loop"): after dispatching decode step N, the engine speculatively
+dispatches step N+1 — same batch, +1 round, sampled ids fed back as a
+device array — starts an async host copy of step N's ids, and only then
+postprocesses step N. The device therefore computes N+1 while the host
+scans N for stops and the next `step()` reads back a one-step-lagged,
+already-copied result. The speculation is validated against the next
+scheduled batch and rolled back (overshoot discarded, exactly like
+decode_multi's post-stop tokens) when a finish, preemption, abort, or a
+newly admitted prefill changes the batch.
+
 Multi-chip: pass a MeshConfig; params/KV are device_put with tp/dp
 PartitionSpecs and the same jitted programs run SPMD over the mesh.
 """
@@ -89,18 +100,61 @@ class EngineMetrics:
     time_schedule_ms: float = 0.0
     time_prefill_ms: float = 0.0
     time_decode_ms: float = 0.0
+    #: decode's phase split (sums to ~time_decode_ms): dispatch = host
+    #: array build + program launch (incl. any speculative next-step
+    #: launch), sync = blocking on the sampled ids' device→host copy,
+    #: host = the stop/finish scan + page registration. Under
+    #: overlap_decode the sync column collapses (the copy was started a
+    #: step earlier) — the overlap's visibility in bench.py extras.
+    time_decode_dispatch_ms: float = 0.0
+    time_decode_sync_ms: float = 0.0
+    time_decode_host_ms: float = 0.0
     prefill_dispatches: int = 0
     decode_dispatches: int = 0
+    #: overlapped decode pipeline: speculative next-step dispatches
+    #: issued / consumed as the real step / rolled back (overshoot
+    #: discarded because the batch changed underneath them)
+    overlap_dispatches: int = 0
+    overlap_hits: int = 0
+    overlap_rollbacks: int = 0
 
     #: the timing plane's field names — the one list consumers (perf
     #: harness, dashboards) should iterate instead of restating
     TIMING_FIELDS = (
         "time_schedule_ms", "time_prefill_ms", "time_decode_ms",
+        "time_decode_dispatch_ms", "time_decode_sync_ms",
+        "time_decode_host_ms",
         "prefill_dispatches", "decode_dispatches",
+        "overlap_dispatches", "overlap_hits", "overlap_rollbacks",
     )
 
     def to_dict(self) -> dict:
         return dict(self.__dict__)
+
+
+@dataclass
+class _InflightDecode:
+    """One speculatively dispatched decode step whose sampled ids are
+    still on device (async host copy already started). It becomes the
+    real step iff the next scheduled batch is the same decode batch and
+    every request advanced exactly the pending step's token count;
+    otherwise it is rolled back (the ids are overshoot, and the KV it
+    wrote sits past every live sequence's length or in freed pages that
+    later writers fully overwrite before any read)."""
+
+    reqs: tuple
+    b_bucket: int
+    k_steps: int
+    token_ids: object  # device array, [B] (k=1) or [K, B]
+    lp_data: Optional[tuple]  # device (chosen, top_ids, top_lps) or None
+    #: per-request state the batch must show when this step is consumed
+    expected_num_tokens: tuple
+    expected_out_len: tuple
+    #: program-variant flags at dispatch (same reqs => same flags; kept
+    #: so the next speculation reuses them without recomputation)
+    greedy: bool = False
+    lp: int = -1
+    bias: bool = False
 
 
 class JaxEngine:
@@ -208,6 +262,16 @@ class JaxEngine:
         #: adaptive speculation: steps left on the fused path after a
         #: low-acceptance spec dispatch
         self._spec_cooldown = 0
+        #: overlapped decode: the one speculative in-flight dispatch (or
+        #: None). Off on multi-process meshes (lockstep replicas must
+        #: observe identical step results before the next broadcast) and
+        #: under prompt-lookup speculation (drafts need host tokens).
+        self._inflight: Optional[_InflightDecode] = None
+        self._overlap_enabled = (
+            config.overlap_decode
+            and not self._multiproc
+            and config.spec_ngram <= 0
+        )
 
         pre_quantized = False
         if params is None:
@@ -395,6 +459,14 @@ class JaxEngine:
         t1 = time.perf_counter()
         self.metrics.time_schedule_ms += (t1 - t0) * 1000.0
         outputs = self._drain_doomed()
+        if self._inflight is not None and (
+            batch is None or batch.kind != "decode"
+        ):
+            # A speculated decode step can only be the next DECODE step;
+            # an admitted prefill (or a drained queue) invalidates it.
+            self._discard_inflight(
+                "no batch" if batch is None else "prefill scheduled"
+            )
         if batch is not None:
             t2 = time.perf_counter()  # after the drain: phase time is
             # dispatch+sync+postprocess only, as the field docs promise
@@ -411,6 +483,10 @@ class JaxEngine:
                     time.perf_counter() - t2
                 ) * 1000.0
             self.metrics.steps += 1
+        if self._inflight is not None and not self.scheduler.has_work:
+            # the wave ended on a sampled stop the speculation couldn't
+            # predict: drop the dangling dispatch so device arrays free
+            self._discard_inflight("idle")
         self._refresh_metrics()
         return outputs
 
@@ -587,6 +663,16 @@ class JaxEngine:
 
     # -- decode ------------------------------------------------------------
 
+    @staticmethod
+    def _pow2_floor(k: int) -> int:
+        """Largest power of two <= k (k >= 1). Fused-step counts snap to
+        powers of two so the decode_multi program family stays
+        log-sized — every distinct k is a full-model compile."""
+        p = 1
+        while p * 2 <= k:
+            p *= 2
+        return p
+
     def _pick_decode_steps(self, reqs: list[Request]) -> int:
         """Fused steps for this dispatch: capped by config, by remaining
         context room, and dropped to 1 when admission is pending (so new
@@ -620,13 +706,10 @@ class JaxEngine:
         while p < max(1, rem_max):
             p *= 2
         k = min(k, p)
-        # The context/page caps above can leave an arbitrary k: snap DOWN to
-        # a power of two so cap-bound sequences don't each compile a fresh
-        # decode_multi program (k=37, 35, 33, ... would).
-        p = 1
-        while p * 2 <= k:
-            p *= 2
-        k = p
+        # The context/page caps above can leave an arbitrary k: snap DOWN
+        # so cap-bound sequences don't each compile a fresh decode_multi
+        # program (k=37, 35, 33, ... would).
+        k = self._pow2_floor(k)
         if k <= 1:
             return 1
         if not self._grow_pages_for(reqs, k - 1):
@@ -798,6 +881,13 @@ class JaxEngine:
         return self._run_decode_plain(reqs)
 
     def _run_decode_plain(self, reqs: list[Request]) -> list[StepOutput]:
+        inflight, self._inflight = self._inflight, None
+        if inflight is not None:
+            if self._inflight_matches(inflight, reqs):
+                return self._consume_inflight(inflight)
+            self._inflight = inflight  # hand back for the metrics/log
+            self._discard_inflight("decode batch changed")
+        t0 = time.perf_counter()
         b_bucket = self.config.decode_bucket_for(len(reqs))
         mp = self.config.max_pages_per_seq
         k_steps = self._pick_decode_steps(reqs)
@@ -858,11 +948,44 @@ class JaxEngine:
                 token_ids, self.kv = fn(
                     *args, *samp, *pen_args, **bias_kwargs
                 )  # [K, B]
+        self.metrics.time_decode_dispatch_ms += (
+            time.perf_counter() - t0
+        ) * 1000.0
+        # Keep the device busy past this step BEFORE blocking on its
+        # result: the speculated N+1 dispatch computes while the host
+        # scans this step's ids for stops below.
+        self._maybe_speculate(
+            reqs, b_bucket, k_steps, token_ids,
+            greedy=all_greedy, lp=lp, bias=bias,
+        )
+        t1 = time.perf_counter()
         ids = np.asarray(token_ids).reshape(k_steps, b_bucket)
-        if lp_data is not None:
-            chosen_lp = np.asarray(lp_data[0]).reshape(k_steps, b_bucket)
-            top_ids = np.asarray(lp_data[1]).reshape(k_steps, b_bucket, -1)
-            top_lps = np.asarray(lp_data[2]).reshape(k_steps, b_bucket, -1)
+        lp_arrays = self._materialize_lp(lp_data, k_steps, b_bucket)
+        self.metrics.time_decode_sync_ms += (
+            time.perf_counter() - t1
+        ) * 1000.0
+        return self._decode_postprocess(reqs, k_steps, ids, lp_arrays)
+
+    @staticmethod
+    def _materialize_lp(lp_data, k_steps: int, b_bucket: int):
+        """Device logprob outputs -> host (chosen, top_ids, top_lps),
+        reshaped to [K, B(, N)]; None passes through."""
+        if lp_data is None:
+            return None
+        return (
+            np.asarray(lp_data[0]).reshape(k_steps, b_bucket),
+            np.asarray(lp_data[1]).reshape(k_steps, b_bucket, -1),
+            np.asarray(lp_data[2]).reshape(k_steps, b_bucket, -1),
+        )
+
+    def _decode_postprocess(
+        self, reqs: list[Request], k_steps: int, ids: np.ndarray, lp_arrays
+    ) -> list[StepOutput]:
+        """Host half of a decode step: scan sampled ids for finish
+        conditions (dropping overshoot past a stop), append accepted
+        tokens, and register newly filled pages. Under overlap_decode
+        this runs while the device computes the NEXT step."""
+        t0 = time.perf_counter()
         outputs: list[StepOutput] = []
         for i, req in enumerate(reqs):
             accepted: list[int] = []
@@ -875,7 +998,8 @@ class JaxEngine:
                     break
             req.num_computed_tokens += len(accepted)
             lps = tops = None
-            if lp_data is not None and req.sampling.logprobs >= 0:
+            if lp_arrays is not None and req.sampling.logprobs >= 0:
+                chosen_lp, top_ids, top_lps = lp_arrays
                 n = len(accepted)
                 lps = tuple(float(chosen_lp[kk, i]) for kk in range(n))
                 nk = req.sampling.logprobs
@@ -891,7 +1015,209 @@ class JaxEngine:
                 self._accept_tokens(req, accepted, finish, lps=lps, tops=tops)
             )
             self._register_pages(req)
+        self.metrics.time_decode_host_ms += (
+            time.perf_counter() - t0
+        ) * 1000.0
         return outputs
+
+    # -- overlapped decode (one-step-lagged readback) ----------------------
+
+    def _maybe_speculate(
+        self, reqs: list[Request], b_bucket: int, k_prev: int, ids_dev,
+        greedy: bool, lp: int, bias: bool,
+    ) -> None:
+        """Dispatch the NEXT decode step before the pending step's ids
+        reach the host: same batch, positions advanced by k_prev, tokens
+        = the pending step's last sampled ids sliced ON DEVICE (no host
+        round-trip). Only when the scheduler guarantees batch stability
+        (no admissible waiting request, nothing mid-prefill), every
+        request surely survives the pending step's k_prev tokens, pages
+        can pre-grow to cover the window, and no penalty history (which
+        would need the pending tokens host-side) is in play."""
+        if not self._overlap_enabled:
+            return
+        if not self.scheduler.decode_batch_stable():
+            return
+        if self._batch_penalty_bucket(reqs):
+            return
+        cap = min(
+            self.config.max_context,
+            self.config.max_pages_per_seq * self.config.page_size,
+        )
+        k_next = k_prev
+        for req in reqs:
+            s = req.sampling
+            if (
+                len(req.output_tokens) + req.num_emitted + k_prev
+                >= s.max_tokens
+            ):
+                return  # pending step finishes it: batch will change
+            if req.num_tokens + k_prev >= self.config.max_context:
+                return
+            # never write KV past the page-table cap
+            k_next = min(k_next, cap - (req.num_tokens + k_prev) + 1)
+        if k_next < 1:
+            return
+        k_next = self._pow2_floor(k_next)  # reuse the program family
+        if not self._grow_pages_for(reqs, k_prev + k_next - 1):
+            return
+        t0 = time.perf_counter()
+        mp = self.config.max_pages_per_seq
+        positions = np.zeros((b_bucket, 1), np.int32)
+        valid = np.zeros((b_bucket, 1), bool)
+        pt = np.zeros((b_bucket, mp), np.int32)
+        for i, req in enumerate(reqs):
+            positions[i, 0] = req.num_tokens - 1 + k_prev
+            valid[i, 0] = True
+            pt[i, : len(req.pages)] = req.pages
+        samp, _ = self._sampling_arrays(reqs, pad_to=b_bucket)
+        # the pending step advances every draw counter by its k
+        samp[4][: len(reqs)] += k_prev
+        bias_kwargs = self._bias_arrays(reqs, b_bucket) if bias else {}
+        host = {
+            "base": (positions, valid, pt), "samp": samp,
+            "bias": bias_kwargs,
+        }
+        if k_next == 1:
+            host["last"] = np.zeros(b_bucket, np.int32)
+        try:
+            dev = self._dev_tree(host)
+            d_positions, d_valid, d_pt = dev["base"]
+            # on-device token feedback: [B] or [K, B] -> last step [B, 1]
+            d_tokens = (
+                ids_dev if ids_dev.ndim == 2 else ids_dev[None]
+            )[-1][:, None].astype(jnp.int32)
+            args = (
+                self.params, d_tokens, d_positions, d_valid, self.kv, d_pt
+            )
+            lp_data = None
+            if k_next == 1:
+                fn = self._get_step_fn(
+                    "decode", b_bucket, 1, greedy=greedy, lp=lp, pen=0,
+                    bias=bias,
+                )
+                if lp >= 0:
+                    token_ids, lp_data, self.kv = fn(
+                        *args, dev["last"], *dev["samp"], **dev["bias"]
+                    )
+                else:
+                    token_ids, self.kv = fn(
+                        *args, dev["last"], *dev["samp"], **dev["bias"]
+                    )
+            else:
+                fn = self._get_step_fn(
+                    "decode_multi", b_bucket, k_next, greedy=greedy, lp=lp,
+                    pen=0, bias=bias,
+                )
+                if lp >= 0:
+                    token_ids, lp_data, self.kv = fn(
+                        *args, *dev["samp"], **dev["bias"]
+                    )
+                else:
+                    token_ids, self.kv = fn(
+                        *args, *dev["samp"], **dev["bias"]
+                    )
+        except Exception:
+            # A failed speculative dispatch must never take down the real
+            # step it was riding on: latch overlap off for this engine.
+            logger.exception(
+                "overlap dispatch failed; disabling overlap_decode"
+            )
+            self._overlap_enabled = False
+            return
+        # one-step-lagged readback: start the device→host copy now so the
+        # next step's sync finds the bytes already landed
+        for arr in (token_ids, *(lp_data or ())):
+            try:
+                arr.copy_to_host_async()
+            except AttributeError:
+                pass  # older jax array types; np.asarray will sync-copy
+        self.metrics.overlap_dispatches += 1
+        self._inflight = _InflightDecode(
+            reqs=tuple(reqs),
+            b_bucket=b_bucket,
+            k_steps=k_next,
+            token_ids=token_ids,
+            lp_data=lp_data,
+            expected_num_tokens=tuple(r.num_tokens + k_prev for r in reqs),
+            expected_out_len=tuple(
+                len(r.output_tokens) + k_prev for r in reqs
+            ),
+            greedy=greedy,
+            lp=lp,
+            bias=bias,
+        )
+        self.metrics.time_decode_dispatch_ms += (
+            time.perf_counter() - t0
+        ) * 1000.0
+
+    def _inflight_matches(
+        self, inflight: _InflightDecode, reqs: list[Request]
+    ) -> bool:
+        """The speculation is this step iff the scheduled batch is the
+        SAME requests (identity — an aborted+resubmitted id is a new
+        object) in the same rows, and each advanced exactly the pending
+        step's k tokens (a preemption/recompute resets output_tokens and
+        fails here even though num_tokens survives the fold)."""
+        if len(reqs) != len(inflight.reqs):
+            return False
+        for r, spec_r, exp_nt, exp_out in zip(
+            reqs, inflight.reqs, inflight.expected_num_tokens,
+            inflight.expected_out_len,
+        ):
+            if (
+                r is not spec_r
+                or r.num_tokens != exp_nt
+                or len(r.output_tokens) != exp_out
+            ):
+                return False
+        return True
+
+    def _consume_inflight(
+        self, inflight: _InflightDecode
+    ) -> list[StepOutput]:
+        """The speculated dispatch IS this step: speculate the next one
+        (so the device never drains), then materialize the one-step-
+        lagged ids — their async copy started last step, so this sync is
+        (near) free — and postprocess."""
+        self.metrics.overlap_hits += 1
+        reqs = list(inflight.reqs)
+        self._maybe_speculate(
+            reqs, inflight.b_bucket, inflight.k_steps, inflight.token_ids,
+            greedy=inflight.greedy, lp=inflight.lp, bias=inflight.bias,
+        )
+        t0 = time.perf_counter()
+        ids = np.asarray(inflight.token_ids).reshape(
+            inflight.k_steps, inflight.b_bucket
+        )
+        lp_arrays = self._materialize_lp(
+            inflight.lp_data, inflight.k_steps, inflight.b_bucket
+        )
+        self.metrics.time_decode_sync_ms += (
+            time.perf_counter() - t0
+        ) * 1000.0
+        return self._decode_postprocess(
+            reqs, inflight.k_steps, ids, lp_arrays
+        )
+
+    def _discard_inflight(self, why: str) -> None:
+        """Roll back a speculated dispatch. The sampled ids are overshoot
+        — dropped exactly like decode_multi's post-stop tokens. Its KV
+        writes are benign: for surviving requests they used the true
+        tokens at the true positions (the real dispatch overwrites them
+        before any read); for finished/preempted requests they sit in
+        released pages whose next owner's writes are stream-ordered
+        after them. Pages grown for the window stay with their requests."""
+        inflight, self._inflight = self._inflight, None
+        if inflight is None:
+            return
+        self.metrics.overlap_rollbacks += 1
+        logger.debug("overlap rollback: %s", why)
+
+    def drain_overlap(self) -> None:
+        """Public: discard any speculative in-flight decode dispatch
+        (idle/stop paths; also pins the sync/overlap boundary in tests)."""
+        self._discard_inflight("drained")
 
     # -- shared ------------------------------------------------------------
 

@@ -28,8 +28,10 @@ class SamplingParams:
     #: OpenAI penalties over the output-token history (0 = off)
     frequency_penalty: float = 0.0
     presence_penalty: float = 0.0
-    #: HF-style multiplicative repetition penalty over seen output
-    #: tokens (1 = off; reference exposes it via nvext)
+    #: multiplicative repetition penalty over GENERATED tokens only —
+    #: prompt tokens are deliberately not penalized, unlike HF's
+    #: RepetitionPenaltyLogitsProcessor (1 = off; reference exposes it
+    #: via nvext)
     repetition_penalty: float = 1.0
     #: OpenAI logit_bias: additive per-token-id biases applied in the
     #: sampler (before temperature). Bounded by sampling.BIAS_SLOTS

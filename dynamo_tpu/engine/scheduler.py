@@ -17,6 +17,11 @@ Policy (one `schedule()` call = one engine step):
 3. Otherwise schedule a decode batch over all running sequences, growing
    page tables by one page where the next token would overflow; preempt
    the youngest sequences if pages run out.
+
+Decode batches are STABLE between consecutive `schedule()` calls unless
+admission, chunked prefill, or a request-side event (finish, abort,
+preemption) intervenes — `decode_batch_stable()` states the contract the
+engine's overlapped decode pipeline relies on.
 """
 
 from __future__ import annotations
@@ -112,6 +117,20 @@ class Scheduler:
 
     def num_running(self) -> int:
         return len(self.running)
+
+    def decode_batch_stable(self) -> bool:
+        """The overlap contract (engine `overlap_decode`, docs/engine.md):
+        absent request-side events, the NEXT `schedule()` call returns
+        the same decode batch iff no waiting request is admissible right
+        now and no running request still needs prefill — admission and
+        chunked prefill are the only scheduler-side sources of batch
+        change. The engine detects the request-side invalidations
+        (finish, abort, preemption-recompute) per request at consume
+        time; this predicate covers the scheduler side so a speculative
+        next-step dispatch is only issued when it has a chance to land."""
+        if any(r.state == RequestState.PREFILL for r in self.running):
+            return False
+        return not (self.waiting and self.can_admit_head())
 
     # -- the step ----------------------------------------------------------
 

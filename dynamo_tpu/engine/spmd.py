@@ -109,6 +109,10 @@ class SpmdDriver:
         )
         self._pending: list[dict] = []
         self._stopped = False
+        #: liveness guard: consecutive failed rounds (a dead collective
+        #: plane burns a full transport timeout PER ROUND — looping on
+        #: it forever would wedge run_to_completion)
+        self._failed_rounds = 0
         #: (request_id, error message) for submits that failed to admit
         #: this round — drained by the serving layer to answer clients.
         #: Every replica records the same failures; only the leader reads.
@@ -180,13 +184,36 @@ class SpmdDriver:
         if self._stopped:
             return []
         try:
-            return self.engine.step()
-        except Exception:  # noqa: BLE001 — MUST be symmetric: a
+            outs = self.engine.step()
+            self._failed_rounds = 0
+            return outs
+        except Exception as e:  # noqa: BLE001 — MUST be symmetric: a
             # deterministic step failure raises on every replica; if a
             # follower died on it while the leader caught-and-continued,
             # the leader's next broadcast would block forever on the
             # missing participant. Both sides log and stay in lockstep.
             logger.exception("lockstep engine step failed")
+            # ... EXCEPT when the collective plane itself is dead: every
+            # replica observes the same transport failure (symmetric by
+            # construction), retrying burns a full transport timeout per
+            # round, and no future round can succeed — raise instead of
+            # wedging run_to_completion. Same for any failure streak long
+            # enough that "deterministic one-off" is no longer credible.
+            self._failed_rounds += 1
+            msg = str(e).lower()
+            # transport-specific markers only — a generic XLA status
+            # token (FAILED_PRECONDITION alone) must not be mistaken
+            # for a plane outage on its first occurrence
+            dead_plane = any(
+                s in msg
+                for s in ("gloo", "deadline_exceeded", "getkeyvalue")
+            )
+            if dead_plane or self._failed_rounds >= 8:
+                raise RuntimeError(
+                    "lockstep collective plane failed "
+                    f"({self._failed_rounds} consecutive failed rounds): "
+                    f"{e}"
+                ) from e
             return []
 
     def step(self) -> list[StepOutput]:

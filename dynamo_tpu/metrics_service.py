@@ -49,8 +49,17 @@ _WORKER_FIELDS = (
     ("time_schedule_ms", "counter"),
     ("time_prefill_ms", "counter"),
     ("time_decode_ms", "counter"),
+    # decode's phase split (dispatch/sync/postprocess) + the overlapped-
+    # decode pipeline counters — sync collapsing toward zero is the
+    # overlap working (docs/engine.md "The decode loop")
+    ("time_decode_dispatch_ms", "counter"),
+    ("time_decode_sync_ms", "counter"),
+    ("time_decode_host_ms", "counter"),
     ("prefill_dispatches", "counter"),
     ("decode_dispatches", "counter"),
+    ("overlap_dispatches", "counter"),
+    ("overlap_hits", "counter"),
+    ("overlap_rollbacks", "counter"),
 )
 
 

@@ -281,7 +281,9 @@ def paged_prefill_attention(
     if mesh is not None and mesh.shape.get("tp", 1) > 1:
         from functools import partial
 
-        from jax import shard_map
+        from dynamo_tpu.platform import get_shard_map
+
+        shard_map = get_shard_map()
         from jax.sharding import PartitionSpec as P
 
         fn = shard_map(
@@ -396,7 +398,9 @@ def flash_prefill_attention(
     if mesh is not None and mesh.shape.get("tp", 1) > 1:
         from functools import partial
 
-        from jax import shard_map
+        from dynamo_tpu.platform import get_shard_map
+
+        shard_map = get_shard_map()
         from jax.sharding import PartitionSpec as P
 
         fn = shard_map(

@@ -102,7 +102,9 @@ def paged_write(
     if use_kernel and mesh is not None and mesh.shape.get("tp", 1) > 1:
         from functools import partial
 
-        from jax import shard_map
+        from dynamo_tpu.platform import get_shard_map
+
+        shard_map = get_shard_map()
         from jax.sharding import PartitionSpec as P
 
         kv_spec = P(None, None, None, "tp", None)

@@ -249,7 +249,9 @@ def paged_decode_attention(
         # The (replicated) work list rides along so shards don't re-sort.
         from functools import partial
 
-        from jax import shard_map
+        from dynamo_tpu.platform import get_shard_map
+
+        shard_map = get_shard_map()
         from jax.sharding import PartitionSpec as P
 
         def sharded(q_, k_, v_, layer_, pt_, hist_, *wl):

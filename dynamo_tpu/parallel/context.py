@@ -34,7 +34,9 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from dynamo_tpu.platform import get_shard_map
+
+shard_map = get_shard_map()
 
 
 def dense_gqa_attention(
@@ -118,8 +120,13 @@ def _ring_shard(q, k, v, *, axis_name: str, causal: bool):
         return m, l, acc, k_nxt, v_nxt
 
     # pcast-to-varying: the carry is device-varying over sp (vma typing).
+    # Pre-vma jax (no lax.pcast) treats every shard_map value as varying
+    # already, so the cast degrades to identity there.
     def _vary(x):
-        return lax.pcast(x, axis_name, to="varying")
+        pcast = getattr(lax, "pcast", None)
+        if pcast is None:
+            return x
+        return pcast(x, axis_name, to="varying")
 
     m0 = _vary(jnp.full((b, hkv, g, tl, 1), -jnp.inf, jnp.float32))
     l0 = _vary(jnp.zeros((b, hkv, g, tl, 1), jnp.float32))

@@ -17,6 +17,27 @@ from __future__ import annotations
 import os
 
 
+def get_shard_map():
+    """The shard_map entry point across jax versions: `jax.shard_map`
+    (0.6+) or `jax.experimental.shard_map.shard_map` (the baked
+    toolchain's 0.4.x, where the replication-check kwarg is spelled
+    `check_rep` instead of `check_vma`). Every in-tree user imports
+    through here so a toolchain bump is a one-line change."""
+    import jax
+
+    fn = getattr(jax, "shard_map", None)
+    if fn is not None and callable(fn):
+        return fn
+    from jax.experimental.shard_map import shard_map
+
+    def compat(f, **kwargs):
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return shard_map(f, **kwargs)
+
+    return compat
+
+
 def honor_jax_platforms_env() -> None:
     requested = os.environ.get("JAX_PLATFORMS")
     if not requested:

@@ -50,8 +50,9 @@ class PreprocessedRequest:
     logprobs: int = -1
     frequency_penalty: float = 0.0
     presence_penalty: float = 0.0
-    #: HF-style multiplicative repetition penalty (1 = off; ext or
-    #: top-level — the reference carries it in nvext)
+    #: multiplicative repetition penalty over generated tokens (1 = off;
+    #: ext or top-level — the reference carries it in nvext; prompt
+    #: tokens are deliberately not penalized)
     repetition_penalty: float = 1.0
     #: OpenAI logit_bias as [[token_id, bias], ...] (validated/clamped)
     logit_bias: list = field(default_factory=list)
@@ -217,22 +218,28 @@ class OpenAIPreprocessor:
             ids, mm_embeds, mm_positions = self._multimodal_prompt(messages)
         elif request.extension and request.extension.use_raw_prompt:
             # nvext use_raw_prompt (reference nvext.rs:56): skip the chat
-            # template and tokenize the concatenated message contents
-            # verbatim — for clients that pre-render their own prompt.
-            # Structured content contributes its text parts.
-            parts: list[str] = []
+            # template and tokenize the message contents verbatim — for
+            # clients that pre-render their own prompt. Messages join
+            # with a newline (the reference's raw-prompt fallback
+            # semantics; a bare ''.join would fuse tokens across message
+            # boundaries). Structured content contributes its text parts.
+            texts: list[str] = []
             for m in messages:
                 c = m.get("content")
                 if isinstance(c, str):
-                    parts.append(c)
+                    texts.append(c)
                 elif isinstance(c, list):
-                    parts += [
-                        p.get("text", "")
-                        for p in c
-                        if isinstance(p, dict) and p.get("type") == "text"
-                    ]
+                    # a message's own text parts stay contiguous
+                    texts.append(
+                        "".join(
+                            p.get("text", "")
+                            for p in c
+                            if isinstance(p, dict)
+                            and p.get("type") == "text"
+                        )
+                    )
             ids, mm_embeds, mm_positions = (
-                self.tokenizer.encode("".join(parts)), None, []
+                self.tokenizer.encode("\n".join(texts)), None, []
             )
         else:
             prompt = self.tokenizer.apply_chat_template(
@@ -363,7 +370,16 @@ class OpenAIPreprocessor:
             raise ValueError(f"min_tokens must be >= 0; got {min_tokens}")
         rep = repetition_penalty
         if ext and ext.repetition_penalty is not None:
+            # nvext-sourced values mirror the reference's validation
+            # range (nvext.rs:42) for drop-in parity; the top-level
+            # field stays an any->0 extension (docs/migrating.md).
             rep = ext.repetition_penalty
+            if not 0 < rep <= 2.0:
+                raise ValueError(
+                    f"nvext repetition_penalty must be in (0, 2.0]; got "
+                    f"{rep} (the top-level field accepts any value > 0 "
+                    "as an extension)"
+                )
         if rep <= 0:
             raise ValueError(f"repetition_penalty must be > 0; got {rep}")
         if ext and ext.greed_sampling:

@@ -33,8 +33,11 @@ class Ext(BaseModel):
     #: force argmax decoding regardless of temperature (nvext.rs
     #: greed_sampling)
     greed_sampling: Optional[bool] = None
-    #: HF-style multiplicative repetition penalty, > 0 (1 = off;
-    #: nvext.rs repetition_penalty — also accepted at top level)
+    #: multiplicative repetition penalty over GENERATED tokens, in the
+    #: reference's (0, 2.0] range (1 = off; nvext.rs repetition_penalty —
+    #: also accepted at top level, where any > 0 value is an accepted
+    #: extension). Unlike HF's processor it deliberately skips prompt
+    #: tokens — docs/migrating.md "Sampling semantics".
     repetition_penalty: Optional[float] = None
 
 

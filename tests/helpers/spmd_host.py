@@ -197,6 +197,70 @@ def spawn_two_hosts(
     return json.loads(out.read_text()), logs
 
 
+_PLANE_OK = None
+
+
+def collective_plane_available(timeout: float = 120.0) -> bool:
+    """One cached probe of this host's cross-process collective plane:
+    spawn a 2-process jax.distributed group and run a single broadcast.
+    Containers without a working gloo rendezvous either error each
+    collective after a ~30 s transport timeout or wedge inside one with
+    no timeout at all — without this gate every fleet test burns its
+    full spawn timeout on an environment that can never pass."""
+    global _PLANE_OK
+    if _PLANE_OK is not None:
+        return _PLANE_OK
+    import socket
+    import subprocess
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    src = (
+        "import os, sys\n"
+        "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+        "os.environ['XLA_FLAGS'] = "
+        "'--xla_force_host_platform_device_count=2'\n"
+        f"sys.path.insert(0, {REPO!r})\n"
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "jax.config.update('jax_cpu_collectives_implementation', 'gloo')\n"
+        "from dynamo_tpu.parallel.mesh import init_multihost\n"
+        f"init_multihost('127.0.0.1:{port}', 2, int(sys.argv[1]))\n"
+        "import numpy as np\n"
+        "from jax.experimental import multihost_utils\n"
+        "v = multihost_utils.broadcast_one_to_all(\n"
+        "    np.int32(7), is_source=(sys.argv[1] == '0'))\n"
+        "assert int(v) == 7\n"
+        "print('PLANE_OK')\n"
+    )
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", src, str(i)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+        for i in range(2)
+    ]
+    ok = True
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=timeout)
+            ok = ok and p.returncode == 0 and "PLANE_OK" in out
+    except subprocess.TimeoutExpired:
+        ok = False
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for p in procs:
+            try:
+                p.communicate(timeout=10)
+            except Exception:  # noqa: BLE001
+                pass
+    _PLANE_OK = ok
+    return ok
+
+
 def spmd_test_workload():
     """(request_id, prompt_tokens, max_tokens) — deterministic, mixed
     lengths so prefill buckets AND the decode path both run."""

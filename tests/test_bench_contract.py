@@ -49,6 +49,16 @@ def test_cpu_fallback_line_is_labeled_and_carries_tpu_artifact():
     kc = ex.get("kernel_check")
     if (REPO / "artifacts" / "tpu" / "pallas_check.json").exists():
         assert kc is not None and "all_ok" in kc and "age_hours" in kc
+    # decode phase split (overlapped-decode visibility): all three
+    # columns present, and the CPU fallback carries the overlap on/off
+    # A/B with per-phase timings for each arm
+    for k in ("decode_dispatch_ms", "decode_sync_ms", "decode_host_ms"):
+        assert k in ex, k
+    ab = ex["overlap_ab"]
+    for arm in ("overlap_on", "overlap_off"):
+        assert ab[arm]["tok_s"] > 0
+        assert "decode_sync_ms" in ab[arm]
+    assert ab["speedup"] is not None
 
 
 def test_bench_http_counts_failures_instead_of_raising():

@@ -1,0 +1,230 @@
+"""Overlapped decode loop (EngineConfig.overlap_decode): the speculative
+next-step dispatch with on-device token feedback and one-step-lagged
+async readback must produce BIT-IDENTICAL per-request token streams to
+the synchronous path, and roll back cleanly whenever the batch changes
+underneath it (finish, mid-wave admission, preemption)."""
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.engine import EngineConfig
+from dynamo_tpu.engine.engine import JaxEngine
+from dynamo_tpu.engine.request import SamplingParams
+
+
+@pytest.fixture(scope="module")
+def engine_factory():
+    def make(**overrides):
+        base = EngineConfig.for_tests()
+        cfg = EngineConfig(**{**base.__dict__, **overrides})
+        return JaxEngine(cfg)
+
+    return make
+
+
+def _mixed_workload():
+    """Mixed greedy/sampled requests with stop tokens and staggered
+    max_tokens so finishes land mid-wave (the rollback-heavy shape the
+    issue's parity criterion names)."""
+    rng = np.random.default_rng(7)
+    reqs = []
+    for i in range(6):
+        prompt = [int(x) for x in rng.integers(1, 200, 3 + (i % 4))]
+        sampled = i % 2 == 1
+        reqs.append(
+            (
+                f"r{i}",
+                prompt,
+                SamplingParams(
+                    temperature=0.8 if sampled else 0.0,
+                    top_p=0.9 if sampled else 1.0,
+                    seed=100 + i,
+                    max_tokens=4 + 3 * (i % 3),  # 4/7/10: mid-wave length
+                    stop_token_ids=(13,) if i in (2, 5) else (),
+                ),
+            )
+        )
+    return reqs
+
+
+def _run(eng, reqs):
+    for rid, prompt, s in reqs:
+        eng.add_request(rid, prompt, s)
+    return eng.run_to_completion()
+
+
+def test_overlap_parity_mixed_workload(engine_factory):
+    """The headline contract: identical per-request streams, overlap on
+    vs off, across fused-step depths."""
+    reqs = _mixed_workload()
+    for k in (1, 2, 8):
+        ref = _run(engine_factory(overlap_decode=False, decode_steps=k), reqs)
+        eng = engine_factory(overlap_decode=True, decode_steps=k)
+        got = _run(eng, reqs)
+        assert got == ref, f"decode_steps={k}"
+        if k == 1:
+            # long k=1 waves are where the pipeline must actually engage
+            assert eng.metrics.overlap_hits > 0
+
+
+def test_overlap_parity_across_decode_steps(engine_factory):
+    """Overlapped k=1 must also match synchronous k=8 (the token stream
+    is defined by the requests, not the dispatch shape)."""
+    reqs = _mixed_workload()
+    ref = _run(engine_factory(overlap_decode=False, decode_steps=8), reqs)
+    assert _run(engine_factory(overlap_decode=True, decode_steps=1), reqs) == ref
+
+
+def test_overlap_engages_and_collapses_sync(engine_factory):
+    """Steady-state wave: speculation consumed nearly every step, and the
+    one-step-lagged readback makes sync cheaper than the blocking path."""
+    eng = engine_factory(overlap_decode=True, decode_steps=1)
+    eng.add_request("w", [5, 17, 42], SamplingParams(max_tokens=24, ignore_eos=True))
+    eng.run_to_completion()
+    m = eng.metrics
+    assert m.overlap_dispatches > 10
+    assert m.overlap_hits == m.overlap_dispatches - m.overlap_rollbacks
+    # the phase split is populated (the bench's overlap visibility)
+    assert m.time_decode_dispatch_ms > 0 and m.time_decode_host_ms > 0
+
+
+def test_rollback_on_midwave_prefill(engine_factory):
+    """A prefill admitted mid-overlap invalidates the speculated step;
+    the engine must discard the overshoot and still produce the exact
+    streams of the synchronous engine fed the same arrival order."""
+
+    def run(overlap):
+        eng = engine_factory(overlap_decode=overlap, decode_steps=1)
+        eng.add_request("a", [1, 2, 3, 4], SamplingParams(max_tokens=12, ignore_eos=True))
+        eng.add_request("b", [9, 8, 7], SamplingParams(max_tokens=12, ignore_eos=True))
+        out = {}
+        steps = 0
+        late_added = False
+        while eng.has_work:
+            for o in eng.step():
+                out.setdefault(o.request_id, []).extend(o.new_token_ids)
+            steps += 1
+            if steps == 6 and not late_added:
+                # arrives mid-wave: next schedule() admits -> prefill
+                eng.add_request(
+                    "late", [3, 1, 4, 1, 5],
+                    SamplingParams(max_tokens=6, ignore_eos=True),
+                )
+                late_added = True
+        return out, eng.metrics
+
+    ref, _ = run(False)
+    got, m = run(True)
+    assert got == ref
+    assert m.overlap_rollbacks >= 1  # the admitted prefill killed one
+
+
+def test_rollback_on_finish(engine_factory):
+    """A request hitting max_tokens mid-wave changes the batch; survivors
+    must continue with identical streams (the speculated dispatch that
+    included the finished row is discarded as overshoot)."""
+
+    def run(overlap):
+        eng = engine_factory(overlap_decode=overlap, decode_steps=1)
+        eng.add_request("short", [1, 2, 3], SamplingParams(max_tokens=3, ignore_eos=True))
+        eng.add_request("long", [4, 5, 6], SamplingParams(max_tokens=14, ignore_eos=True))
+        return _run(eng, [])
+
+    assert run(True) == run(False)
+
+
+def test_overlap_under_preemption(engine_factory):
+    """Page pressure forces preemption-by-recompute mid-wave; the folded
+    request re-prefills and rejoins. Streams must match sync exactly."""
+
+    def run(overlap):
+        eng = engine_factory(
+            overlap_decode=overlap, decode_steps=1,
+            num_pages=12, max_pages_per_seq=8,  # 12 pages DO preempt here
+        )
+        eng.add_request("p1", [1, 2, 3, 4, 5, 6, 7, 8],
+                        SamplingParams(max_tokens=16, ignore_eos=True))
+        eng.add_request("p2", [9, 10, 11, 12, 13, 14, 15, 16],
+                        SamplingParams(max_tokens=16, ignore_eos=True))
+        return _run(eng, [])
+
+    assert run(True) == run(False)
+
+
+def test_overlap_with_logprobs_and_bias(engine_factory):
+    """Logprob reporting and logit_bias ride the speculated dispatch
+    (penalties force the sync path); values must match sync."""
+
+    def run(overlap):
+        eng = engine_factory(overlap_decode=overlap, decode_steps=1)
+        eng.add_request(
+            "lp", [5, 6, 7],
+            SamplingParams(max_tokens=8, ignore_eos=True, logprobs=2,
+                           logit_bias=((3, 5.0),)),
+        )
+        toks, lps = [], []
+        while eng.has_work:
+            for o in eng.step():
+                toks.extend(o.new_token_ids)
+                if o.logprobs:
+                    lps.extend(o.logprobs)
+        return toks, lps
+
+    assert run(True) == run(False)
+
+
+def test_penalties_fall_back_to_sync(engine_factory):
+    """Penalty history needs the pending step's tokens host-side, so the
+    engine must not speculate — and streams still match."""
+
+    def run(overlap):
+        eng = engine_factory(overlap_decode=overlap, decode_steps=1)
+        eng.add_request(
+            "pen", [5, 6, 7],
+            SamplingParams(max_tokens=8, ignore_eos=True,
+                           repetition_penalty=1.5),
+        )
+        out = _run(eng, [])
+        return out, eng.metrics.overlap_dispatches
+
+    (ref, _), (got, n_spec) = run(False), run(True)
+    assert got == ref
+    assert n_spec == 0
+
+
+def test_abort_mid_overlap(engine_factory):
+    """Aborting a request between steps invalidates the speculation via
+    the identity check; the survivor's stream is unaffected."""
+    eng = engine_factory(overlap_decode=True, decode_steps=1)
+    eng.add_request("keep", [1, 2, 3], SamplingParams(max_tokens=10, ignore_eos=True))
+    eng.add_request("kill", [7, 8, 9], SamplingParams(max_tokens=10, ignore_eos=True))
+    out = {}
+    steps = 0
+    while eng.has_work:
+        for o in eng.step():
+            out.setdefault(o.request_id, []).extend(o.new_token_ids)
+        steps += 1
+        if steps == 4:
+            assert eng.abort_request("kill")
+    solo = engine_factory(overlap_decode=False, decode_steps=1)
+    solo.add_request("keep", [1, 2, 3], SamplingParams(max_tokens=10, ignore_eos=True))
+    assert solo.run_to_completion()["keep"] == out["keep"]
+
+
+def test_drain_overlap_is_idempotent(engine_factory):
+    eng = engine_factory(overlap_decode=True, decode_steps=1)
+    eng.drain_overlap()  # nothing in flight: no-op
+    eng.add_request("d", [1, 2, 3], SamplingParams(max_tokens=6, ignore_eos=True))
+    toks = []
+    for _ in range(2):  # prefill, then first decode + speculation
+        for o in eng.step():
+            toks.extend(o.new_token_ids)
+    assert eng._inflight is not None
+    eng.drain_overlap()
+    assert eng._inflight is None
+    assert eng.metrics.overlap_rollbacks == 1
+    # the wave still completes correctly after a forced drain
+    toks.extend(eng.run_to_completion()["d"])
+    ref = engine_factory(overlap_decode=False)
+    ref.add_request("d", [1, 2, 3], SamplingParams(max_tokens=6, ignore_eos=True))
+    assert toks == ref.run_to_completion()["d"]

@@ -40,7 +40,17 @@ def _env(devices: int = 0) -> dict:
     return env
 
 
+@pytest.mark.slow  # 4-process fleet over cross-host gloo collectives:
+# a flaky rendezvous can wedge past the quick-suite budget
 def test_cli_spmd_serving():
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).parent / "helpers"))
+    from spmd_host import collective_plane_available
+
+    if not collective_plane_available():
+        pytest.skip("cross-process collective plane (gloo) unavailable")
     fport, hport, cport = free_port(), free_port(), free_port()
     worker_args = [
         "run", "in=dyn", "out=jax", "--model", "tiny",

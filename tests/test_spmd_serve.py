@@ -24,9 +24,29 @@ pytestmark = pytest.mark.skipif(
     "across backends",
 )
 
+#: the fleet-spawning tests are `slow`: each spawns a 2-process
+#: jax.distributed group (420 s spawn timeout, and a flaky gloo
+#: rendezvous can wedge a collective with no timeout at all) — far past
+#: the quick-suite budget. The fake-driver test below stays quick.
+fleet = pytest.mark.slow
+
 
 @pytest.fixture(scope="module")
-def spmd_outputs():
+def collective_plane():
+    """Skip (not wedge) on hosts whose cross-process collective plane
+    can't come up — a dead gloo rendezvous otherwise burns each fleet
+    test's full spawn timeout, or hangs inside a timeout-less
+    collective. Only the @fleet tests request this; the fake-driver
+    test needs no plane and must not pay the probe."""
+    sys.path.insert(0, str(HELPER.parent))
+    from spmd_host import collective_plane_available
+
+    if not collective_plane_available():
+        pytest.skip("cross-process collective plane (gloo) unavailable")
+
+
+@pytest.fixture(scope="module")
+def spmd_outputs(collective_plane):
     sys.path.insert(0, str(HELPER.parent))
     from spmd_host import spawn_two_hosts
 
@@ -50,6 +70,7 @@ def _reference_outputs():
     return eng.run_to_completion()
 
 
+@fleet
 def test_two_host_serving_matches_single_process(spmd_outputs):
     ref = _reference_outputs()
     assert set(spmd_outputs) == set(ref)
@@ -98,7 +119,10 @@ def _tier_ab(devices_per_host: int, dp: int, tp: int):
         )
 
 
-def test_two_host_tiering_evicts_and_onboards_byte_identically():
+@fleet
+def test_two_host_tiering_evicts_and_onboards_byte_identically(
+    collective_plane,
+):
     """G2 host tiering under a CROSS-HOST mesh (round-4 verdict item 6):
     each host tiers its own Hkv shard; eviction + onboard must reproduce
     the single-process run exactly — the re-served prompt's continuation
@@ -108,7 +132,8 @@ def test_two_host_tiering_evicts_and_onboards_byte_identically():
     _tier_ab(devices_per_host=4, dp=4, tp=2)
 
 
-def test_two_host_tiering_with_tp_spanning_hosts():
+@fleet
+def test_two_host_tiering_with_tp_spanning_hosts(collective_plane):
     """The PARTIAL-slice path: 1 device/host, tp=2 — each host holds
     HALF the kv heads, so extract really returns a partial Hkv slice and
     inject really reassembles the global array from two processes'
