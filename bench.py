@@ -110,7 +110,12 @@ def main() -> None:
         attention_impl: str,
         overlap: bool = True,
         decode_steps: int = None,
+        kv_quantize: str = "env",
     ) -> JaxEngine:
+        if kv_quantize == "env":
+            # chip stage: BENCH_KV_QUANTIZE=int8 runs the headline with
+            # quantized pages (queued as a tpu_round.sh A/B stage)
+            kv_quantize = os.environ.get("BENCH_KV_QUANTIZE") or None
         cfg = EngineConfig(
             model=model,
             num_pages=max(512, num_requests * (pages_per_seq + 1)),
@@ -141,6 +146,7 @@ def main() -> None:
             # weight-only (BENCH_QUANTIZE=int8) fits it alongside the KV
             # pages.
             quantize=os.environ.get("BENCH_QUANTIZE") or None,
+            kv_quantize=kv_quantize,
             attention_impl=attention_impl,
             overlap_decode=overlap,
         )
@@ -299,6 +305,39 @@ def main() -> None:
             else None
         )
 
+    # KV-quant on/off A/B (CPU fallback now; the chip stage is queued in
+    # tpu_round.sh as bench_1b_kvq for BENCH_r06): same workload with
+    # int8 pages vs model-dtype pages, plus the pool-byte gauges so the
+    # ~2x effective-capacity claim rides the record next to the tok/s.
+    kvquant_ab = None
+    if platform != "tpu" and os.environ.get("BENCH_KVQUANT_AB", "1") != "0":
+        import gc
+
+        kvquant_ab = {}
+        for tag, kvq in (("kv_fp", None), ("kv_int8", "int8")):
+            del eng
+            gc.collect()
+            eng = make_engine(best_impl, kv_quantize=kvq)
+            r = run_timed(eng)
+            kvquant_ab[tag] = {
+                "tok_s": round(r["tok_s"], 2),
+                "kv_pool_bytes": eng.metrics.kv_pool_bytes,
+                "kv_pool_bytes_dense_equiv": (
+                    eng.metrics.kv_pool_bytes_dense_equiv
+                ),
+            }
+        fp_tok_s = kvquant_ab["kv_fp"]["tok_s"]
+        kvquant_ab["speedup"] = (
+            round(kvquant_ab["kv_int8"]["tok_s"] / fp_tok_s, 3)
+            if fp_tok_s
+            else None
+        )
+        kvquant_ab["capacity_ratio"] = round(
+            kvquant_ab["kv_int8"]["kv_pool_bytes_dense_equiv"]
+            / max(kvquant_ab["kv_int8"]["kv_pool_bytes"], 1),
+            3,
+        )
+
     tok_s = best["tok_s"]
     p50_ttft = best["p50_ttft"]
     p50_itl = best["p50_itl"]
@@ -330,6 +369,17 @@ def main() -> None:
     if platform != "tpu":
         baseline = float(published.get("cpu_output_tok_s", 0.0) or 0.0)
         baseline_workload = published.get("cpu_note", "cpu fallback")
+    elif os.environ.get("BENCH_KV_QUANTIZE"):
+        # kv-quant chip stages score against their own records, never the
+        # fp-page ones (same like-with-like rule as the int8-weights 8B)
+        key = f"{model.replace('-', '_').replace('.', '_')}_kv_" + (
+            os.environ["BENCH_KV_QUANTIZE"]
+        )
+        rec = published.get(key, {})
+        baseline = float(rec.get("output_tok_s_per_chip", 0.0) or 0.0)
+        baseline_workload = rec.get(
+            "workload", f"{model} kv {os.environ['BENCH_KV_QUANTIZE']}"
+        )
     elif model == "llama3-8b" and os.environ.get("BENCH_QUANTIZE") == "int8":
         rec = published.get("llama3_8b_int8", {})
         baseline = float(rec.get("output_tok_s_per_chip", 0.0) or 0.0)
@@ -460,6 +510,12 @@ def main() -> None:
                     "overlap_rollbacks"
                 ],
                 **({"overlap_ab": overlap_ab} if overlap_ab else {}),
+                **({"kvquant_ab": kvquant_ab} if kvquant_ab else {}),
+                **(
+                    {"kv_quantize": os.environ["BENCH_KV_QUANTIZE"]}
+                    if os.environ.get("BENCH_KV_QUANTIZE")
+                    else {}
+                ),
                 "baseline_workload": baseline_workload,
                 **({"latest_tpu_artifact": tpu_latest} if tpu_latest else {}),
                 **({"kernel_check": kernel_check} if kernel_check else {}),

@@ -88,6 +88,14 @@ class EngineConfig:
     #: weight-only quantization: None | "int8" (per-output-channel scales;
     #: halves the HBM weight traffic decode is bound by)
     quantize: Optional[str] = None
+    #: KV-cache page quantization: None | "int8" | "fp8". Pages store the
+    #: narrow dtype with per-(page, slot, kv-head) f32 scale planes;
+    #: dequant is folded into the Pallas page-walk kernels (and the XLA
+    #: gather fallback), halving KV HBM traffic in the history-dominated
+    #: decode regime and ~doubling effective cache capacity. "fp8" needs
+    #: a jax with float8_e4m3fn. Not supported for MLA (shared-latent
+    #: cache) models.
+    kv_quantize: Optional[str] = None
     #: decode attention: "auto" (pallas on TPU single-chip, else xla),
     #: "xla", "pallas", or "hybrid" (pallas kernels with decode falling
     #: back to the XLA gather past LlamaConfig.pallas_decode_max_batch)
@@ -137,6 +145,11 @@ class EngineConfig:
                 f">= page_size ({self.page_size}): mid-prompt chunks round "
                 "down to page boundaries, so a smaller budget could never "
                 "schedule any prefill work"
+            )
+        if self.kv_quantize not in (None, "int8", "fp8"):
+            raise ValueError(
+                f"kv_quantize must be None, 'int8' or 'fp8', got "
+                f"{self.kv_quantize!r}"
             )
         if self.prefill_budget_policy not in ("fixed", "adaptive"):
             raise ValueError(

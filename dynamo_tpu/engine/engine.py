@@ -61,10 +61,35 @@ def _canonical_gather(kv, ids, dk: int, dv: int):
     """Pool layout [L, P, S, Hkv, Dpad] -> canonical wire layout
     [L, Hkv, n, S, D] (padding stripped). THE one definition of the
     extract layout — single-process async extract and the multi-host
-    sharded extract both trace this, so they can never diverge."""
+    sharded extract both trace this, so they can never diverge.
+
+    Quantized pools pack each row's f32 scale into 4 trailing int8
+    lanes: wire width becomes D+4 and the array stays ONE narrow-dtype
+    tensor, so every downstream plane (KVBM host/disk tiers, disagg
+    shm/bulk-TCP/device transfer, G4 serve/adopt) ships quantized bytes
+    + scales at half the fp traffic without knowing about quantization
+    — byte accounting (np.nbytes) is automatically honest."""
     k = jnp.take(kv.k, ids, axis=1).transpose(0, 3, 1, 2, 4)[..., :dk]
     v = jnp.take(kv.v, ids, axis=1).transpose(0, 3, 1, 2, 4)[..., :dv]
+    if kv.k_scale is not None:
+        bits = lambda x: jax.lax.bitcast_convert_type(x, jnp.int8)
+        ks = jnp.take(kv.k_scale, ids, axis=1).transpose(0, 3, 1, 2)
+        vs = jnp.take(kv.v_scale, ids, axis=1).transpose(0, 3, 1, 2)
+        # fp8 payloads bitcast to int8 so payload+scale share one dtype
+        k = jnp.concatenate([bits(k), bits(ks)], axis=-1)
+        v = jnp.concatenate([bits(v), bits(vs)], axis=-1)
     return k, v
+
+
+def _wire_unpack(arr, d_true: int, pool_dtype):
+    """Canonical QUANTIZED wire array [..., D+4] int8 ->
+    (payload [..., D] pool dtype, scale [...] f32): inverse of
+    _canonical_gather's scale packing."""
+    payload = jax.lax.bitcast_convert_type(arr[..., :d_true], pool_dtype)
+    scale = jax.lax.bitcast_convert_type(
+        arr[..., d_true : d_true + 4], jnp.float32
+    )
+    return payload, scale
 
 
 @dataclass
@@ -75,8 +100,14 @@ class EngineMetrics:
     num_waiting: int = 0
     num_running: int = 0
     kv_active_pages: int = 0
+    kv_free_pages: int = 0
     kv_total_pages: int = 0
     kv_usage: float = 0.0
+    #: device bytes the KV pool actually occupies (quantized pages +
+    #: scale planes) vs the model-dtype equivalent — their ratio is the
+    #: effective cache-capacity multiplier kv_quantize buys
+    kv_pool_bytes: int = 0
+    kv_pool_bytes_dense_equiv: int = 0
     prefix_hit_rate: float = 0.0
     steps: int = 0
     generated_tokens: int = 0
@@ -303,15 +334,37 @@ class JaxEngine:
                     "quantized layout (Llama-family models support it)"
                 )
             params = self.adapter.quantize_params(params)
-        kv = self.adapter.init_kv(config.num_pages, config.page_size)
+        kv = self.adapter.init_kv(
+            config.num_pages, config.page_size,
+            kv_quantize=config.kv_quantize,
+        )
         if self.mesh is not None:
             specs = self.adapter.param_specs(quantized=bool(config.quantize))
             params = self._put_global(params, shardings_for(self.mesh, specs))
             kv = self._put_global(
-                kv, shardings_for(self.mesh, self.adapter.kv_spec())
+                kv,
+                shardings_for(
+                    self.mesh,
+                    self.adapter.kv_spec(kv_quantize=config.kv_quantize),
+                ),
             )
         self.params = params
         self.kv = kv
+        # KV-pool byte gauges: actual device bytes (quantized pages +
+        # scale planes) vs what the same pool costs at the model dtype —
+        # the ~2x effective-capacity claim, measured not asserted.
+        m = self.metrics
+        m.kv_pool_bytes = int(
+            sum(x.nbytes for x in jax.tree.leaves(kv))
+        )
+        model_itemsize = jnp.dtype(
+            getattr(self.adapter.config, "dtype", None)
+            or self.adapter.config.base.dtype
+        ).itemsize
+        m.kv_pool_bytes_dense_equiv = int(
+            (kv.k.size + kv.v.size) * model_itemsize
+        )
+        m.kv_free_pages = self.allocator.num_free
         if self.mesh is not None:
             from jax.sharding import NamedSharding
 
@@ -1863,9 +1916,10 @@ class JaxEngine:
 
     def inject_pages_device(self, page_ids: Sequence[int], k, v) -> None:
         """Device-path inject: k/v are jax arrays (canonical
-        [L, Hkv, n, S, D]); the transpose, head-dim pad, and scatter all
-        run in one jitted program — no host round-trip on the single-chip
-        path (the point of the ICI transfer plane)."""
+        [L, Hkv, n, S, D] — D+4 int8 with trailing packed scales on
+        quantized pools); the unpack, transpose, head-dim pad, and
+        scatter all run in one jitted program — no host round-trip on the
+        single-chip path (the point of the ICI transfer plane)."""
         pool_sharding = getattr(self.kv.k, "sharding", None)
         if (
             pool_sharding is not None
@@ -1881,11 +1935,21 @@ class JaxEngine:
             k = jnp.asarray(np.asarray(k))
             v = jnp.asarray(np.asarray(v))
         n = len(page_ids)
-        dpad_k = self.kv.k.shape[-1] - k.shape[-1]
-        dpad_v = self.kv.v.shape[-1] - v.shape[-1]
+        quantized = self.kv.k_scale is not None
+        scale_lanes = 4 if quantized else 0
+        dpad_k = self.kv.k.shape[-1] - (k.shape[-1] - scale_lanes)
+        dpad_v = self.kv.v.shape[-1] - (v.shape[-1] - scale_lanes)
         fn = self._jit_cache.get(("inject_dev", n, dpad_k, dpad_v))
         if fn is None:
             def inject_fn(kv, ids, kk, vv):
+                kks = vvs = None
+                if quantized:
+                    kk, kks = _wire_unpack(
+                        kk, kv.k.shape[-1] - dpad_k, kv.k.dtype
+                    )
+                    vv, vvs = _wire_unpack(
+                        vv, kv.v.shape[-1] - dpad_v, kv.v.dtype
+                    )
                 kk = kk.transpose(0, 2, 3, 1, 4)
                 vv = vv.transpose(0, 2, 3, 1, 4)
                 if dpad_k:
@@ -1896,10 +1960,20 @@ class JaxEngine:
                     vv = jnp.pad(
                         vv, [(0, 0)] * (vv.ndim - 1) + [(0, dpad_v)]
                     )
-                return type(kv)(
+                out = kv._replace(
                     k=kv.k.at[:, ids].set(kk.astype(kv.k.dtype)),
                     v=kv.v.at[:, ids].set(vv.astype(kv.v.dtype)),
                 )
+                if quantized:
+                    out = out._replace(
+                        k_scale=kv.k_scale.at[:, ids].set(
+                            kks.transpose(0, 2, 3, 1)
+                        ),
+                        v_scale=kv.v_scale.at[:, ids].set(
+                            vvs.transpose(0, 2, 3, 1)
+                        ),
+                    )
+                return out
             fn = jax.jit(inject_fn, donate_argnums=(0,))
             self._jit_cache[("inject_dev", n, dpad_k, dpad_v)] = fn
         self.kv = fn(
@@ -1914,7 +1988,7 @@ class JaxEngine:
         # worker path this blocks the ENGINE thread (runner.submit), not
         # the event loop, and the next decode step would queue behind the
         # same device stream anyway.
-        jax.block_until_ready((self.kv.k, self.kv.v))
+        jax.block_until_ready(tuple(x for x in self.kv if x is not None))
 
     # -- G4 remote tier: serve/adopt blocks across workers -----------------
     # (reference: KvBlockManager::export_local_blockset / onboard_blocks —
@@ -2074,5 +2148,6 @@ class JaxEngine:
         m.num_waiting = self.scheduler.num_waiting()
         m.num_running = self.scheduler.num_running()
         m.kv_active_pages = self.allocator.num_active
+        m.kv_free_pages = self.allocator.num_free
         m.kv_usage = self.allocator.usage()
         m.prefix_hit_rate = self.allocator.stats.hit_rate

@@ -31,7 +31,13 @@ PREFIX = "dynamo_tpu"
 _WORKER_FIELDS = (
     ("kv_usage", "gauge"),
     ("kv_active_pages", "gauge"),
+    ("kv_free_pages", "gauge"),
     ("kv_total_pages", "gauge"),
+    # KV-pool byte gauges (EngineConfig.kv_quantize): actual device bytes
+    # (quantized pages + scale planes) vs the model-dtype equivalent —
+    # their ratio is the effective cache-capacity multiplier
+    ("kv_pool_bytes", "gauge"),
+    ("kv_pool_bytes_dense_equiv", "gauge"),
     ("num_waiting", "gauge"),
     ("num_running", "gauge"),
     ("prefix_hit_rate", "gauge"),

@@ -535,10 +535,20 @@ class KVPages(NamedTuple):
     single strided DMA (stride = the page axis). tp shards the kv-heads
     axis. Page 0 is the null page: padding writes land there and no real
     page table ever references it.
+
+    Quantized pages (`kv_quantize="int8"|"fp8"`): k/v hold the narrow
+    dtype and k_scale/v_scale carry per-(page, slot, kv-head) f32 scale
+    planes of shape [L, P, S, Hkv] — each page travels with its own
+    [S, Hkv] scale plane. A token's row [D] quantizes symmetrically
+    against its own amax on write, so pages filling incrementally never
+    need re-scaling, and readers dequantize in VMEM right after the page
+    DMA lands — no fp copy of the cache ever exists in HBM.
     """
 
     k: jax.Array
     v: jax.Array
+    k_scale: Optional[jax.Array] = None  # [L, P, S, Hkv] f32, quantized only
+    v_scale: Optional[jax.Array] = None
 
     @property
     def num_pages(self) -> int:
@@ -548,15 +558,88 @@ class KVPages(NamedTuple):
     def page_size(self) -> int:
         return self.k.shape[2]
 
+    @property
+    def quantized(self) -> bool:
+        return self.k_scale is not None
+
+
+#: kv_quantize mode -> (storage dtype, symmetric max representable)
+def kv_quant_spec(mode: str):
+    if mode == "int8":
+        return jnp.int8, 127.0
+    if mode == "fp8":
+        fp8 = getattr(jnp, "float8_e4m3fn", None)
+        if fp8 is None:
+            raise ValueError(
+                "kv_quantize='fp8' needs jnp.float8_e4m3fn (newer jax); "
+                "use 'int8'"
+            )
+        return fp8, 448.0
+    raise ValueError(f"unknown kv_quantize mode {mode!r}; use int8|fp8")
+
+
+def quantize_kv_rows(x: jax.Array, mode: str = "int8"):
+    """Per-token, per-kv-head symmetric quantization of KV rows:
+    x [..., D] -> (q [..., D] narrow dtype, scale [...] f32). The scale is
+    each row's amax/qmax — decode writes one token at a time, so row-local
+    scales are exact under incremental page fill (a page-wide scale would
+    clip tokens written after it was fixed)."""
+    dtype, qmax = kv_quant_spec(mode)
+    xf = x.astype(jnp.float32)
+    scale = jnp.maximum(jnp.max(jnp.abs(xf), axis=-1) / qmax, 1e-8)
+    q = xf / scale[..., None]
+    if dtype == jnp.int8:
+        q = jnp.round(q)
+    return q.astype(dtype), scale
+
+
+def dequantize_kv_rows(q: jax.Array, scale: jax.Array, dtype) -> jax.Array:
+    """Inverse of quantize_kv_rows: q [..., D] x scale [...] -> dtype."""
+    return (q.astype(jnp.float32) * scale[..., None]).astype(dtype)
+
 
 def init_kv_pages(
-    cfg: LlamaConfig, num_pages: int, page_size: int, dtype=None
+    cfg: LlamaConfig,
+    num_pages: int,
+    page_size: int,
+    dtype=None,
+    kv_quantize: Optional[str] = None,
 ) -> KVPages:
     shape = (
         cfg.num_layers, num_pages, page_size, cfg.num_kv_heads, cfg.kv_head_dim
     )
+    if kv_quantize:
+        qdtype, _ = kv_quant_spec(kv_quantize)
+        scale_shape = shape[:-1]
+        return KVPages(
+            k=jnp.zeros(shape, qdtype),
+            v=jnp.zeros(shape, qdtype),
+            k_scale=jnp.zeros(scale_shape, jnp.float32),
+            v_scale=jnp.zeros(scale_shape, jnp.float32),
+        )
     dtype = dtype or cfg.dtype
     return KVPages(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
+
+
+def kv_page_bytes(
+    cfg, page_size: int, kv_quantize: Optional[str] = None, dtype=None
+) -> int:
+    """Bytes ONE page costs across all layers (k + v + scale planes) —
+    the capacity-planning arithmetic for sizing num_pages against an HBM
+    budget before an engine exists (the live gauges, kv_pool_bytes /
+    kv_pool_bytes_dense_equiv, are computed from the actual pool arrays
+    at engine init instead — that also covers MLA's asymmetric caches).
+    `cfg` is a LlamaConfig (MoE passes cfg.base); quantized pages pay
+    1 byte/elem + 4-byte f32 row scales, i.e. ~(1 + 4/D)/itemsize of
+    the dense cost. Pinned by tests/test_kv_quant.py."""
+    d = cfg.kv_head_dim
+    elems = cfg.num_layers * page_size * cfg.num_kv_heads
+    if kv_quantize:
+        qdtype, _ = kv_quant_spec(kv_quantize)
+        per = d * jnp.dtype(qdtype).itemsize + 4  # row + f32 scale
+    else:
+        per = d * jnp.dtype(dtype or cfg.dtype).itemsize
+    return 2 * elems * per  # k and v
 
 
 # ---------------------------------------------------------------------------
@@ -1044,16 +1127,18 @@ def apply_rope(
 
 
 def paged_scatter(
-    cache: jax.Array,  # [L, P, S, Hkv, D] — the FULL stacked cache
+    cache: jax.Array,  # [L, P, S, ...] — the FULL stacked cache
     layer: jax.Array,  # scalar int32 layer index
-    new: jax.Array,  # [B, T, Hkv, D]
+    new: jax.Array,  # [B, T, ...] (KV rows [B,T,Hkv,D] or scale [B,T,Hkv])
     page_tables: jax.Array,  # [B, MP] int32
     positions: jax.Array,  # [B, T] int32
     valid: jax.Array,  # [B, T] bool
 ) -> jax.Array:
     """Write new KV for absolute `positions` into cache[layer]'s pages
     (the XLA fallback path; the Pallas impl stages writes and lands them
-    with one DMA kernel per step instead — ops/kv_update.py).
+    with one DMA kernel per step instead — ops/kv_update.py). Trailing
+    dims are generic: the same scatter lands KV rows and their quantized
+    scale planes.
 
     Invalid (padding) slots are redirected to the null page 0 slot 0.
 
@@ -1075,7 +1160,7 @@ def paged_scatter(
     slot_of = jnp.where(valid, slot_of, 0)
     flat_pages = page_ids.reshape(-1)
     flat_slots = slot_of.reshape(-1)
-    flat_new = new.reshape(-1, new.shape[2], new.shape[3])  # [N,Hkv,D]
+    flat_new = new.reshape(-1, *new.shape[2:])  # [N, ...]
     layer_cache = lax.dynamic_index_in_dim(cache, layer, 0, keepdims=False)
     layer_cache = layer_cache.at[flat_pages, flat_slots].set(
         flat_new, mode="drop"
@@ -1083,15 +1168,69 @@ def paged_scatter(
     return lax.dynamic_update_index_in_dim(cache, layer_cache, layer, 0)
 
 
+def paged_scatter_kv(
+    kv: KVPages,
+    layer: jax.Array,
+    k_new: jax.Array,  # [B, T, Hkv, D] model-dtype rows
+    v_new: jax.Array,
+    page_tables: jax.Array,
+    positions: jax.Array,
+    valid: jax.Array,
+) -> KVPages:
+    """paged_scatter over the whole pool, quantizing on write when the
+    pool is quantized (scales land next to their rows)."""
+    if not kv.quantized:
+        return kv._replace(
+            k=paged_scatter(
+                kv.k, layer, k_new.astype(kv.k.dtype), page_tables,
+                positions, valid,
+            ),
+            v=paged_scatter(
+                kv.v, layer, v_new.astype(kv.v.dtype), page_tables,
+                positions, valid,
+            ),
+        )
+    mode = "int8" if kv.k.dtype == jnp.int8 else "fp8"
+    kq, ks = quantize_kv_rows(k_new, mode)
+    vq, vs = quantize_kv_rows(v_new, mode)
+    args = (page_tables, positions, valid)
+    return KVPages(
+        k=paged_scatter(kv.k, layer, kq, *args),
+        v=paged_scatter(kv.v, layer, vq, *args),
+        k_scale=paged_scatter(kv.k_scale, layer, ks, *args),
+        v_scale=paged_scatter(kv.v_scale, layer, vs, *args),
+    )
+
+
 def paged_gather(
     cache: jax.Array, layer: jax.Array, page_tables: jax.Array
 ) -> jax.Array:
-    """[L, P, S, Hkv, D] × [B, MP] -> [B, MP*S, Hkv, D], position-ordered."""
+    """[L, P, S, ...] × [B, MP] -> [B, MP*S, ...], position-ordered.
+    Trailing dims are generic (KV rows or their scale planes)."""
     g = jax.lax.dynamic_index_in_dim(
         cache, layer, axis=0, keepdims=False
-    )[page_tables]  # [B, MP, S, Hkv, D]
-    b, mp, s, hkv, d = g.shape
-    return g.reshape(b, mp * s, hkv, d)
+    )[page_tables]  # [B, MP, S, ...]
+    b, mp, s = g.shape[:3]
+    return g.reshape(b, mp * s, *g.shape[3:])
+
+
+def paged_gather_kv(
+    kv: KVPages, layer: jax.Array, page_tables: jax.Array, dtype
+) -> tuple[jax.Array, jax.Array]:
+    """Gather + dequantize the paged history densely (the XLA fallback
+    read path): returns (k, v) [B, MP*S, Hkv, D] in `dtype`. Quantized
+    pools dequantize row-by-row against their gathered scale planes, so
+    the xla/hybrid impls see exactly the values the flash kernels see."""
+    k = paged_gather(kv.k, layer, page_tables)
+    v = paged_gather(kv.v, layer, page_tables)
+    if kv.quantized:
+        ks = paged_gather(kv.k_scale, layer, page_tables)  # [B, K, Hkv]
+        vs = paged_gather(kv.v_scale, layer, page_tables)
+        return (
+            dequantize_kv_rows(k, ks, dtype),
+            dequantize_kv_rows(v, vs, dtype),
+        )
+    return k.astype(dtype), v.astype(dtype)
 
 
 def paged_attention(
@@ -1238,8 +1377,7 @@ def attention_block(
     q: jax.Array,  # [B, T, Hq, D] pre-rope
     k: jax.Array,  # [B, T, Hkv, D] pre-rope
     v: jax.Array,  # [B, T, Hkv, D]
-    k_cache: jax.Array,  # [L, P, S, kv_head_dim] full stacked cache
-    v_cache: jax.Array,
+    kv: KVPages,  # full stacked cache (+ scale planes when quantized)
     layer: jax.Array,  # scalar int32
     page_tables: jax.Array,  # [B, MP] int32
     positions: jax.Array,  # [B, T] int32
@@ -1261,8 +1399,13 @@ def attention_block(
       Decode (T==1) runs the flash kernel + exact current-token merge;
       prefill attends to history pages + the in-register current chunk.
 
-    Returns (attn [B,T,Hq*head_dim], k_cache, v_cache, staged) where
-    staged is None (xla) or ([B,T,Hkv,Dpad], [B,T,Hkv,Dpad]).
+    Quantized pools (kv.quantized): the xla discipline quantizes on
+    scatter and dequantizes on gather; the pallas discipline stages
+    model-dtype KV (the write kernel quantizes) and the flash kernels
+    dequantize each page in VMEM right after its DMA lands.
+
+    Returns (attn [B,T,Hq*head_dim], kv, staged) where staged is None
+    (xla) or ([B,T,Hkv,Dpad], [B,T,Hkv,Dpad]).
     Handles the cache's lane padding (cfg.kv_head_dim) transparently.
     """
     b, t = q.shape[0], q.shape[1]
@@ -1367,27 +1510,23 @@ def attention_block(
         )
 
     if cfg.attention_impl not in ("pallas", "hybrid"):
-        k_cache = paged_scatter(
-            k_cache, layer, k, page_tables, positions, valid
-        )
-        v_cache = paged_scatter(
-            v_cache, layer, v, page_tables, positions, valid
+        kv = paged_scatter_kv(
+            kv, layer, k, v, page_tables, positions, valid
         )
         if first_chunk and t > 1:
             attn = _chunk_only_attention(
                 q, k, v, positions, valid, cfg, dpad, mesh=mesh,
                 window=window, sinks=sinks,
             )
-            return attn, k_cache, v_cache, None
-        k_all = paged_gather(k_cache, layer, page_tables)
-        v_all = paged_gather(v_cache, layer, page_tables)
+            return attn, kv, None
+        k_all, v_all = paged_gather_kv(kv, layer, page_tables, cfg.dtype)
         if dpad:
             k_all = k_all[..., : cfg.head_dim]
             v_all = v_all[..., : cfg.head_dim]
         attn = paged_attention(
             q, k_all, v_all, positions, cfg, window=window, sinks=sinks
         )
-        return attn, k_cache, v_cache, None
+        return attn, kv, None
 
     from dynamo_tpu.ops.paged_attention import (
         decode_vmem_bytes,
@@ -1396,8 +1535,9 @@ def attention_block(
 
     tp = mesh.shape.get("tp", 1) if mesh is not None else 1
     kernel_vmem = decode_vmem_bytes(
-        b, cfg.num_heads // tp, cfg.kv_head_dim, k_cache.shape[2],
-        cfg.num_kv_heads // tp or 1, jnp.dtype(cfg.dtype).itemsize,
+        b, cfg.num_heads // tp, cfg.kv_head_dim, kv.k.shape[2],
+        cfg.num_kv_heads // tp or 1, jnp.dtype(kv.k.dtype).itemsize,
+        quantized=kv.quantized,
     )
     if t == 1 and (
         (cfg.attention_impl == "hybrid" and b > cfg.pallas_decode_max_batch)
@@ -1410,7 +1550,7 @@ def attention_block(
         if (
             cfg.attention_impl == "pallas"
             and kernel_vmem > _PALLAS_DECODE_VMEM_BUDGET
-            and (key := (b, cfg.num_heads // tp, k_cache.shape[2]))
+            and (key := (b, cfg.num_heads // tp, kv.k.shape[2]))
             not in _warned_vmem_reroute
         ):
             # An explicit pallas request silently running the XLA gather
@@ -1424,11 +1564,10 @@ def attention_block(
                 "b=%d heads=%d S=%d — shrink batch, page size, or "
                 "heads-per-chip (tp) to keep the Pallas path",
                 kernel_vmem / 2**20, _PALLAS_DECODE_VMEM_BUDGET / 2**20,
-                b, cfg.num_heads // tp, k_cache.shape[2],
+                b, cfg.num_heads // tp, kv.k.shape[2],
             )
         attn = _xla_history_attention(
-            q, k, v, k_cache, v_cache, layer, page_tables, positions,
-            valid, cfg, dpad,
+            q, k, v, kv, layer, page_tables, positions, valid, cfg, dpad,
         )
     elif t == 1:
         hist = positions[:, 0]  # tokens already in the cache
@@ -1436,8 +1575,9 @@ def attention_block(
         if dpad:
             qd = jnp.pad(qd, ((0, 0), (0, 0), (0, dpad)))
         acc, m, l = paged_decode_attention(
-            qd, k_cache, v_cache, layer, page_tables, hist,
+            qd, kv.k, kv.v, layer, page_tables, hist,
             scale_dim=cfg.head_dim, mesh=mesh, work_list=decode_work,
+            k_scale=kv.k_scale, v_scale=kv.v_scale,
         )  # acc [B,Hq,Dpad] unnormalized, m/l [B,Hq]
         # Exact merge of the current (unwritten) token: self-attention
         # score s = q·k_cur/√d folded into the flash running state.
@@ -1478,27 +1618,26 @@ def attention_block(
         hist_lens = jnp.where(valid[:, 0], start, 0).astype(jnp.int32)
         cur_lens = jnp.sum(valid, axis=1).astype(jnp.int32)
         out = paged_prefill_attention(
-            qp, k, v, k_cache, v_cache, layer, page_tables,
+            qp, k, v, kv.k, kv.v, layer, page_tables,
             hist_lens, cur_lens, scale_dim=cfg.head_dim, mesh=mesh,
+            k_scale=kv.k_scale, v_scale=kv.v_scale,
         )
         if dpad:
             out = out[..., : cfg.head_dim]
         attn = out.reshape(b, t, cfg.num_heads * cfg.head_dim).astype(q.dtype)
     else:
         attn = _xla_history_attention(
-            q, k, v, k_cache, v_cache, layer, page_tables, positions,
-            valid, cfg, dpad,
+            q, k, v, kv, layer, page_tables, positions, valid, cfg, dpad,
         )
-    return attn, k_cache, v_cache, (k, v)
+    return attn, kv, (k, v)
 
 
 def _xla_history_attention(
-    q, k, v, k_cache, v_cache, layer, page_tables, positions, valid, cfg, dpad
+    q, k, v, kv, layer, page_tables, positions, valid, cfg, dpad
 ):
     """Gather-then-attend fallback for history chunks too large for the
-    flash kernel's VMEM budget."""
-    k_hist = paged_gather(k_cache, layer, page_tables)  # [B,K,Hkv,Dp]
-    v_hist = paged_gather(v_cache, layer, page_tables)
+    flash kernel's VMEM budget (dequantizes quantized pools on gather)."""
+    k_hist, v_hist = paged_gather_kv(kv, layer, page_tables, k.dtype)
     kk = k_hist.shape[1]
     start = positions[:, 0]
     hist_pos = jnp.arange(kk, dtype=jnp.int32)[None, :]
@@ -1564,7 +1703,7 @@ def forward_hidden(
     decode_work = maybe_decode_work(cfg, tokens, positions, kv, page_tables)
 
     def layer(carry, xs):
-        h, k_full, v_full = carry
+        h, kvc = carry
         lp, li = xs
         x = rms_norm(h, lp["attn_norm"], cfg.rms_norm_eps, off)
         b, t, _ = x.shape
@@ -1579,8 +1718,8 @@ def forward_hidden(
         if cfg.qk_norm:  # Qwen3: head_dim-wide RMSNorm pre-rope
             q = rms_norm(q, lp["q_norm"], cfg.rms_norm_eps, off)
             k = rms_norm(k, lp["k_norm"], cfg.rms_norm_eps, off)
-        attn, k_full, v_full, staged = attention_block(
-            q, k, v, k_full, v_full, li, page_tables, positions, valid, cfg,
+        attn, kvc, staged = attention_block(
+            q, k, v, kvc, li, page_tables, positions, valid, cfg,
             first_chunk=first_chunk, mesh=mesh, decode_work=decode_work,
             rope_positions=rope_positions,
         )
@@ -1599,34 +1738,38 @@ def forward_hidden(
                 mlp_out, lp["post_mlp_norm"], cfg.rms_norm_eps, off
             )
         h = h + mlp_out
-        return (h, k_full, v_full), staged
+        return (h, kvc), staged
 
-    (h, k_new, v_new), staged = lax.scan(
+    (h, kv_new), staged = lax.scan(
         layer,
-        (h, kv.k, kv.v),
+        (h, kv),
         (params["layers"], jnp.arange(cfg.num_layers, dtype=jnp.int32)),
     )
-    k_new, v_new = land_staged_kv(
-        k_new, v_new, staged, page_tables, positions, valid, mesh=mesh
+    kv_new = land_staged_kv(
+        kv_new, staged, page_tables, positions, valid, mesh=mesh
     )
     h = rms_norm(h, params["final_norm"], cfg.rms_norm_eps, off)
-    return h, KVPages(k=k_new, v=v_new)
+    return h, kv_new
 
 
 def land_staged_kv(
-    k_cache, v_cache, staged, page_tables, positions, valid, mesh=None
-):
+    kv: KVPages, staged, page_tables, positions, valid, mesh=None
+) -> KVPages:
     """Land a layer scan's staged KV (pallas write discipline) in one DMA
     kernel call; no-op under the xla scatter discipline (staged is None).
-    Shared by the Llama and MoE forward passes."""
+    Quantized pools quantize inside the page writer (the staged arrays
+    are model-dtype). Shared by the Llama and MoE forward passes."""
     if staged is None:
-        return k_cache, v_cache
+        return kv
     from dynamo_tpu.ops.kv_update import paged_write
 
-    return paged_write(
-        k_cache, v_cache, staged[0], staged[1], page_tables, positions,
-        valid, mesh=mesh,
+    out = paged_write(
+        kv.k, kv.v, staged[0], staged[1], page_tables, positions,
+        valid, mesh=mesh, k_scale=kv.k_scale, v_scale=kv.v_scale,
     )
+    if kv.quantized:
+        return KVPages(*out)
+    return kv._replace(k=out[0], v=out[1])
 
 
 def compute_logits(params: dict, cfg: LlamaConfig, hidden: jax.Array) -> jax.Array:

@@ -113,6 +113,12 @@ class MlaConfig:
     routed_scaling_factor: float = 1.0
     norm_topk_prob: bool = False
     capacity_factor: float = 2.0
+    #: routed experts computed per lax.map step in the MoE FFN: bounds the
+    #: f32 expert intermediates (xe/gate/up/down) AND the dequantized
+    #: int8 expert weights to one group's worth instead of all E at once
+    #: — the all-at-once temps (264M+192M+132M at V2-Lite decode shapes)
+    #: OOM'd a v5e chip. 0 = auto-size groups to ~_MOE_CHUNK_BYTES.
+    moe_expert_chunk: int = 0
     #: "greedy" (V2-Lite), "group_limited_greedy" (V2/V2-Chat), or
     #: "noaux_tc" (V3/R1: sigmoid scores + aux-loss-free bias-corrected
     #: group routing). Groups rank by max member (V2) / top-2 sum (V3) of
@@ -655,6 +661,91 @@ def mla_attention(
 # ---------------------------------------------------------------------------
 
 
+#: auto expert-chunk byte budget for _routed_expert_ffn's per-group f32
+#: temporaries + dequantized weights (v5e has ~16G HBM; keep the MoE FFN's
+#: transient share well under the KV pool + params headroom)
+_MOE_CHUNK_BYTES = 128 << 20
+
+
+def _auto_expert_chunk(e: int, cap: int, h: int, i: int) -> int:
+    """Largest divisor of `e` whose per-group transients fit the budget:
+    per expert the FFN holds xe/down ([C, H] f32 each), gate/up ([C, I]
+    f32 each) plus the dequantized f32 weight slices (3·H·I)."""
+    per_expert = 4 * (cap * (2 * h + 2 * i) + 3 * h * i)
+    g = max(1, min(e, _MOE_CHUNK_BYTES // max(per_expert, 1)))
+    while e % g:
+        g -= 1
+    return g
+
+
+def _routed_expert_ffn(
+    xf: jax.Array,  # [N, H] f32 tokens
+    dispatch: jax.Array,  # [N, E, C] f32 one-hot dispatch
+    combine: jax.Array,  # [N, E, C] f32 weighted combine
+    lp: dict,
+    cfg: MlaConfig,
+    cap: int,
+) -> jax.Array:
+    """The routed experts' gated FFN, chunked over expert groups.
+
+    The fused all-experts einsum chain materializes xe [E, C, H] +
+    gate/up [E, C, I] f32 (264M+192M+132M at V2-Lite decode shapes) plus
+    — with int8 expert weights — the full [E, H, I] f32 dequants, which
+    OOMs a single v5e chip. lax.map over groups of `moe_expert_chunk`
+    experts rematerializes per group: same contractions, same f32
+    accumulation within a group, peak transients divided by E/group
+    (the cross-group sum reorders f32 adds — sub-ulp vs the fused path).
+    """
+    nt, e, _ = dispatch.shape
+    h = xf.shape[1]
+    i = cfg.moe_intermediate_size
+    eg = cfg.moe_expert_chunk or _auto_expert_chunk(e, cap, h, i)
+    eg = max(1, min(eg, e))
+    while e % eg:
+        eg -= 1
+
+    def dequant(w, scale):
+        if scale is None:
+            return w.astype(jnp.float32)
+        return w.astype(jnp.float32) * scale.astype(jnp.float32)
+
+    if eg == e:  # one group — the original fused path, no map overhead
+        xe = jnp.einsum("nec,nh->ech", dispatch, xf)
+        gate = jax.nn.silu(
+            jnp.einsum("ech,ehi->eci", xe, _w(lp, "we_gate", jnp.float32))
+        )
+        up = jnp.einsum("ech,ehi->eci", xe, _w(lp, "we_up", jnp.float32))
+        down = jnp.einsum(
+            "eci,eih->ech", gate * up, _w(lp, "we_down", jnp.float32)
+        )
+        return jnp.einsum("nec,ech->nh", combine, down)
+
+    ng = e // eg
+    quantized = lp["we_gate"].dtype == jnp.int8
+    xs = {
+        "disp": dispatch.reshape(nt, ng, eg, cap).transpose(1, 0, 2, 3),
+        "comb": combine.reshape(nt, ng, eg, cap).transpose(1, 0, 2, 3),
+    }
+    for name in ("we_gate", "we_up", "we_down"):
+        w = lp[name]
+        xs[name] = w.reshape(ng, eg, *w.shape[1:])
+        if quantized:
+            s = lp[name + "_scale"]
+            xs[name + "_s"] = s.reshape(ng, eg, *s.shape[1:])
+
+    def group(g):
+        wg = dequant(g["we_gate"], g.get("we_gate_s"))
+        wu = dequant(g["we_up"], g.get("we_up_s"))
+        wd = dequant(g["we_down"], g.get("we_down_s"))
+        xe = jnp.einsum("nec,nh->ech", g["disp"], xf)  # [eg, C, H]
+        gate = jax.nn.silu(jnp.einsum("ech,ehi->eci", xe, wg))
+        up = jnp.einsum("ech,ehi->eci", xe, wu)
+        down = jnp.einsum("eci,eih->ech", gate * up, wd)
+        return jnp.einsum("nec,ech->nh", g["comb"], down)  # [N, H]
+
+    return jnp.sum(lax.map(group, xs), axis=0)
+
+
 def _deepseek_moe_ffn(x: jax.Array, lp: dict, cfg: MlaConfig) -> jax.Array:
     b, t, h = x.shape
     nt = b * t
@@ -719,15 +810,9 @@ def _deepseek_moe_ffn(x: jax.Array, lp: dict, cfg: MlaConfig) -> jax.Array:
     dispatch = jnp.einsum("nke,nkc->nec", onehot, slot)  # [N,E,C]
     combine = jnp.einsum("nke,nkc,nk->nec", onehot, slot, topw)
 
-    xe = jnp.einsum("nec,nh->ech", dispatch, xf.astype(jnp.float32))
-    gate = jax.nn.silu(
-        jnp.einsum("ech,ehi->eci", xe, _w(lp, "we_gate", jnp.float32))
+    routed = _routed_expert_ffn(
+        xf.astype(jnp.float32), dispatch, combine, lp, cfg, cap
     )
-    up = jnp.einsum("ech,ehi->eci", xe, _w(lp, "we_up", jnp.float32))
-    down = jnp.einsum(
-        "eci,eih->ech", gate * up, _w(lp, "we_down", jnp.float32)
-    )
-    routed = jnp.einsum("nec,ech->nh", combine, down)
 
     shared_gate = jax.nn.silu(
         _mm(xf, lp, "ws_gate", cfg.dtype).astype(jnp.float32)

@@ -668,7 +668,7 @@ def forward_hidden(
     )
 
     def layer(carry, xs):
-        h, k_full, v_full = carry
+        h, kvc = carry
         lp, li = xs
         x = rms_norm(h, lp["attn_norm"], bc.rms_norm_eps)
         b, t, _ = x.shape
@@ -688,8 +688,8 @@ def forward_hidden(
         if bc.qk_norm:  # Qwen3-MoE: per-head RMSNorm pre-rope
             q = rms_norm(q, lp["q_norm"], bc.rms_norm_eps)
             k = rms_norm(k, lp["k_norm"], bc.rms_norm_eps)
-        attn, k_full, v_full, staged = attention_block(
-            q, k, v, k_full, v_full, li, page_tables, positions, valid, bc,
+        attn, kvc, staged = attention_block(
+            q, k, v, kvc, li, page_tables, positions, valid, bc,
             first_chunk=first_chunk, mesh=mesh, decode_work=decode_work,
             sinks=lp["sinks"] if bc.attn_sinks else None,
         )
@@ -699,18 +699,18 @@ def forward_hidden(
         h = h + attn_out
         x = rms_norm(h, lp["mlp_norm"], bc.rms_norm_eps)
         h = h + moe_ffn(x, lp, cfg)
-        return (h, k_full, v_full), staged
+        return (h, kvc), staged
 
-    (h, k_new, v_new), staged = lax.scan(
+    (h, kv_new), staged = lax.scan(
         layer,
-        (h, kv.k, kv.v),
+        (h, kv),
         (params["layers"], jnp.arange(bc.num_layers, dtype=jnp.int32)),
     )
-    k_new, v_new = land_staged_kv(
-        k_new, v_new, staged, page_tables, positions, valid, mesh=mesh
+    kv_new = land_staged_kv(
+        kv_new, staged, page_tables, positions, valid, mesh=mesh
     )
     h = rms_norm(h, params["final_norm"], bc.rms_norm_eps)
-    return h, KVPages(k=k_new, v=v_new)
+    return h, kv_new
 
 
 def forward(params, cfg: MoeConfig, tokens, positions, valid, kv, page_tables):

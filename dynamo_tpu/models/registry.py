@@ -33,7 +33,9 @@ class ModelAdapter:
     forward: Callable[..., tuple[jax.Array, KVPages]]  # (params, tokens, positions, valid, kv, pt) -> (logits, kv)
     forward_hidden: Callable[..., tuple[jax.Array, KVPages]]  # same in, (hidden, kv) out
     compute_logits: Callable[[Any, jax.Array], jax.Array]  # (params, hidden) -> logits
-    init_kv: Callable[[int, int], KVPages]
+    #: (num_pages, page_size, kv_quantize=None) -> KVPages; families
+    #: without quantized pages raise on kv_quantize != None
+    init_kv: Callable[..., KVPages]
     param_specs: Callable[[], Any]
     kv_spec: Callable[[], Any]
     load_params: Optional[Callable[[str], Any]] = None  # from a checkpoint dir
@@ -49,6 +51,26 @@ class ModelAdapter:
     #: time — init_params + quantize_params peaks at full-model dtype
     #: size, which for 8B+ configs exceeds a single chip's HBM
     init_params_quantized: Optional[Callable[[jax.Array], Any]] = None
+
+
+def _kv_pages_spec(kv_quantize=None, shard_heads: bool = True):
+    """Partition specs matching init_kv_pages' pytree: head-sharded KV
+    pools, scale planes (when quantized) sharded on the same Hkv axis."""
+    from jax.sharding import PartitionSpec as P
+
+    from dynamo_tpu.parallel.shardings import kv_cache_spec
+
+    scale = (
+        P(None, None, None, "tp" if shard_heads else None)
+        if kv_quantize
+        else None
+    )
+    return KVPages(
+        k=kv_cache_spec(shard_heads),
+        v=kv_cache_spec(shard_heads),
+        k_scale=scale,
+        v_scale=scale,
+    )
 
 
 _LLAMA_PRESETS: dict[str, Callable[[], LlamaConfig]] = {
@@ -96,7 +118,7 @@ _LLAMA_PRESETS.update(
 def _llama_adapter(
     name: str, cfg: LlamaConfig, mesh=None
 ) -> ModelAdapter:
-    from dynamo_tpu.parallel.shardings import kv_cache_spec, llama_param_specs
+    from dynamo_tpu.parallel.shardings import llama_param_specs
 
     def forward(params, tokens, positions, valid, kv, page_tables):
         return llama_mod.forward(params, cfg, tokens, positions, valid, kv, page_tables)
@@ -117,13 +139,15 @@ def _llama_adapter(
         forward=forward,
         forward_hidden=forward_hidden,
         compute_logits=lambda params, h: llama_mod.compute_logits(params, cfg, h),
-        init_kv=lambda num_pages, page_size: llama_mod.init_kv_pages(
-            cfg, num_pages, page_size
+        init_kv=lambda num_pages, page_size, kv_quantize=None: (
+            llama_mod.init_kv_pages(
+                cfg, num_pages, page_size, kv_quantize=kv_quantize
+            )
         ),
         param_specs=lambda quantized=False: llama_param_specs(
             cfg, quantized=quantized
         ),
-        kv_spec=lambda: KVPages(k=kv_cache_spec(), v=kv_cache_spec()),
+        kv_spec=lambda kv_quantize=None: _kv_pages_spec(kv_quantize),
         load_params=lambda path: _load_llama_checkpoint(path, cfg),
         quantize_params=llama_mod.quantize_params_int8,
         init_params_quantized=lambda key: llama_mod.init_params_int8(
@@ -143,9 +167,21 @@ def _load_llama_checkpoint(path: str, cfg: LlamaConfig):
     return llama_mod.params_from_torch_state_dict(model.state_dict(), cfg)
 
 
+def _mla_init_kv(cfg, num_pages: int, page_size: int, kv_quantize):
+    from dynamo_tpu.models import mla as mla_mod
+
+    if kv_quantize:
+        # The shared-latent cache IS the attention input (no per-head
+        # rows to scale); refuse rather than serve silently degraded.
+        raise ValueError(
+            "kv_quantize is not supported for MLA (shared-latent cache) "
+            "models — run with kv_quantize=None"
+        )
+    return mla_mod.init_kv_pages(cfg, num_pages, page_size)
+
+
 def _mla_adapter(name: str, cfg, mesh=None) -> ModelAdapter:
     from dynamo_tpu.models import mla as mla_mod
-    from dynamo_tpu.parallel.shardings import kv_cache_spec
 
     def fwd(params, tokens, positions, valid, kv, pt):
         return mla_mod.forward(params, cfg, tokens, positions, valid, kv, pt)
@@ -175,17 +211,16 @@ def _mla_adapter(name: str, cfg, mesh=None) -> ModelAdapter:
         compute_logits=lambda params, h: mla_mod.compute_logits(
             params, cfg, h
         ),
-        init_kv=lambda num_pages, page_size: mla_mod.init_kv_pages(
-            cfg, num_pages, page_size
+        init_kv=lambda num_pages, page_size, kv_quantize=None: (
+            _mla_init_kv(cfg, num_pages, page_size, kv_quantize)
         ),
         param_specs=lambda quantized=False: mla_mod.mla_param_specs(
             cfg, quantized=quantized
         ),
         # one shared latent per token: the cache replicates over tp (MQA
         # shape) — reuse the generic spec with no head axis to shard
-        kv_spec=lambda: KVPages(
-            k=kv_cache_spec(shard_heads=False),
-            v=kv_cache_spec(shard_heads=False),
+        kv_spec=lambda kv_quantize=None: _kv_pages_spec(
+            kv_quantize, shard_heads=False
         ),
         load_params=load,
         quantize_params=mla_mod.quantize_params_int8,
@@ -197,7 +232,6 @@ def _mla_adapter(name: str, cfg, mesh=None) -> ModelAdapter:
 
 def _moe_adapter(name: str, moe_cfg, mesh=None) -> ModelAdapter:
     from dynamo_tpu.models import moe as moe_mod
-    from dynamo_tpu.parallel.shardings import kv_cache_spec
 
     cfg = moe_cfg
 
@@ -228,13 +262,15 @@ def _moe_adapter(name: str, moe_cfg, mesh=None) -> ModelAdapter:
         compute_logits=lambda params, h: llama_mod.compute_logits(
             params, cfg.base, h
         ),
-        init_kv=lambda num_pages, page_size: llama_mod.init_kv_pages(
-            cfg.base, num_pages, page_size
+        init_kv=lambda num_pages, page_size, kv_quantize=None: (
+            llama_mod.init_kv_pages(
+                cfg.base, num_pages, page_size, kv_quantize=kv_quantize
+            )
         ),
         param_specs=lambda quantized=False: moe_mod.moe_param_specs(
             cfg, quantized=quantized
         ),
-        kv_spec=lambda: KVPages(k=kv_cache_spec(), v=kv_cache_spec()),
+        kv_spec=lambda kv_quantize=None: _kv_pages_spec(kv_quantize),
         load_params=load,
         quantize_params=moe_mod.quantize_params_int8,
     )

@@ -5,6 +5,13 @@ fused and fine for short contexts. These kernels replace the pieces where
 hand-control over HBM traffic wins: paged-attention decode streams KV pages
 HBM→VMEM once with double-buffered DMA instead of materializing the whole
 gathered history (paged_gather) in HBM.
+
+Quantized pools (EngineConfig.kv_quantize): every kernel also has an
+int8/fp8 mode — the page writer quantizes staged rows and lands narrow
+pages + per-row f32 scale planes in one launch, and both readers DMA the
+scale planes alongside their pages and dequantize in VMEM, so the cache's
+HBM footprint and read traffic roughly halve with no fp copy ever
+materialized.
 """
 
 from dynamo_tpu.ops.paged_attention import paged_decode_attention

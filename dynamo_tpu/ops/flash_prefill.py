@@ -28,6 +28,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from dynamo_tpu.platform import tpu_compiler_params
+
 #: q/k tile rows; T is padded to a multiple (masked out)
 BLOCK = 128
 
@@ -100,23 +102,33 @@ def _hist_kernel(
     pt_ref,  # [B, MP] int32 page tables (SMEM)
     hist_ref,  # [B] int32 — tokens already in the cache (chunk start)
     cur_ref,  # [B] int32 — valid tokens in THIS chunk
-    # inputs
-    q_ref,  # [1, BQ, HQ, D] VMEM (post-rope, unscaled)
-    kcur_ref,  # [1, T, Hkv, D] VMEM — this chunk's keys (post-rope)
-    vcur_ref,  # [1, T, Hkv, D] VMEM
-    k_hbm,  # [L, P, S, Hkv, D] ANY
-    v_hbm,  # [L, P, S, Hkv, D] ANY
-    # output
-    o_ref,  # [1, BQ, HQ, D]
-    # scratch
-    k_scr,  # [2, S, Hkv, D] VMEM
-    v_scr,  # [2, S, Hkv, D] VMEM
-    sem,  # [2, 2] DMA semaphores
-    *,
+    # then (positional, extra scale refs only when `quantized`):
+    #   q_ref,  # [1, BQ, HQ, D] VMEM (post-rope, unscaled)
+    #   kcur_ref,  # [1, T, Hkv, D] VMEM — this chunk's keys (post-rope)
+    #   vcur_ref,  # [1, T, Hkv, D] VMEM
+    #   k_hbm,  # [L, P, S, Hkv, D] ANY (narrow dtype when quantized)
+    #   v_hbm,
+    #   [ks_hbm, vs_hbm]  # [L, P, S, Hkv] f32 scale planes (quantized)
+    # output:
+    #   o_ref,  # [1, BQ, HQ, D]
+    # scratch:
+    #   k_scr,  # [2, S, Hkv, D] VMEM
+    #   v_scr,
+    #   [ks_scr, vs_scr]  # [2, S, Hkv] f32 VMEM (quantized)
+    #   sem,  # [2 or 4, 2] DMA semaphores
+    *refs,
     page_size: int,
     scale_dim: int,
     num_kv_heads: int,
+    quantized: bool,
 ):
+    if quantized:
+        (q_ref, kcur_ref, vcur_ref, k_hbm, v_hbm, ks_hbm, vs_hbm,
+         o_ref, k_scr, v_scr, ks_scr, vs_scr, sem) = refs
+    else:
+        (q_ref, kcur_ref, vcur_ref, k_hbm, v_hbm,
+         o_ref, k_scr, v_scr, sem) = refs
+        ks_hbm = vs_hbm = ks_scr = vs_scr = None
     b = pl.program_id(0)
     qi = pl.program_id(1)
     li = layer_ref[0]
@@ -128,20 +140,22 @@ def _hist_kernel(
     cur = cur_ref[b]
     used = pl.cdiv(hist, s)
 
-    def k_copy(slot, i):
-        return pltpu.make_async_copy(
-            k_hbm.at[li, pt_ref[b, i]], k_scr.at[slot], sem.at[0, slot]
-        )
+    planes = [(k_hbm, k_scr), (v_hbm, v_scr)]
+    if quantized:
+        planes += [(ks_hbm, ks_scr), (vs_hbm, vs_scr)]
 
-    def v_copy(slot, i):
-        return pltpu.make_async_copy(
-            v_hbm.at[li, pt_ref[b, i]], v_scr.at[slot], sem.at[1, slot]
+    def copies(slot, i):
+        return tuple(
+            pltpu.make_async_copy(
+                src.at[li, pt_ref[b, i]], dst.at[slot], sem.at[pi, slot]
+            )
+            for pi, (src, dst) in enumerate(planes)
         )
 
     @pl.when(used > 0)
     def _():
-        k_copy(0, 0).start()
-        v_copy(0, 0).start()
+        for c in copies(0, 0):
+            c.start()
 
     scale = 1.0 / math.sqrt(scale_dim)
     # per-head query tiles [G·BQ, D], group-major like the cache layout
@@ -161,13 +175,18 @@ def _hist_kernel(
 
         @pl.when(i + 1 < used)
         def _():
-            k_copy(1 - slot, i + 1).start()
-            v_copy(1 - slot, i + 1).start()
+            for c in copies(1 - slot, i + 1):
+                c.start()
 
-        k_copy(slot, i).wait()
-        v_copy(slot, i).wait()
+        for c in copies(slot, i):
+            c.wait()
         kp = k_scr[slot].astype(jnp.float32)  # [S, Hkv, D]
         vp = v_scr[slot].astype(jnp.float32)
+        if quantized:
+            # dequant in VMEM right after the page lands (scale folds
+            # into this page's slice of the online softmax)
+            kp = kp * ks_scr[slot][..., None]
+            vp = vp * vs_scr[slot][..., None]
         key_pos = i * s + jax.lax.broadcasted_iota(jnp.int32, (1, s), 1)
         key_mask = key_pos < hist  # [1, S] — the last page may be partial
 
@@ -267,17 +286,22 @@ def paged_prefill_attention(
     scale_dim: int | None = None,
     interpret: bool | None = None,
     mesh=None,
+    k_scale: jax.Array | None = None,  # [L, P, S, Hkv] f32 (quantized pools)
+    v_scale: jax.Array | None = None,
 ) -> jax.Array:
     """History-chunk prefill attention: paged history walked with
     double-buffered DMA (read once per q block) + the in-register current
     chunk, one online softmax over both — replaces the XLA
     gather-then-attend path, which materializes the whole history densely
-    in HBM before a single matmul touches it.
+    in HBM before a single matmul touches it. With `k_scale`/`v_scale`
+    the history pages are quantized; each page's scale plane rides its
+    DMA pipeline and rows dequantize in VMEM.
 
     Returns [B, T, Hq, D]; rows past cur_lens are unspecified.
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    quantized = k_scale is not None
     if mesh is not None and mesh.shape.get("tp", 1) > 1:
         from functools import partial
 
@@ -286,27 +310,35 @@ def paged_prefill_attention(
         shard_map = get_shard_map()
         from jax.sharding import PartitionSpec as P
 
-        fn = shard_map(
-            partial(
-                paged_prefill_attention,
+        def sharded(q_, kc_, vc_, k_, v_, layer_, pt_, hl_, cl_, *scales):
+            return paged_prefill_attention(
+                q_, kc_, vc_, k_, v_, layer_, pt_, hl_, cl_,
                 scale_dim=scale_dim, interpret=interpret, mesh=None,
-            ),
+                k_scale=scales[0] if scales else None,
+                v_scale=scales[1] if scales else None,
+            )
+
+        in_specs = [
+            P(None, None, "tp", None),
+            P(None, None, "tp", None),
+            P(None, None, "tp", None),
+            P(None, None, None, "tp", None),
+            P(None, None, None, "tp", None),
+            P(), P(), P(), P(),
+        ]
+        args = [q, k_cur, v_cur, k_cache, v_cache, layer, page_tables,
+                hist_lens, cur_lens]
+        if quantized:
+            in_specs += [P(None, None, None, "tp"), P(None, None, None, "tp")]
+            args += [k_scale, v_scale]
+        fn = shard_map(
+            sharded,
             mesh=mesh,
-            in_specs=(
-                P(None, None, "tp", None),
-                P(None, None, "tp", None),
-                P(None, None, "tp", None),
-                P(None, None, None, "tp", None),
-                P(None, None, None, "tp", None),
-                P(), P(), P(), P(),
-            ),
+            in_specs=tuple(in_specs),
             out_specs=P(None, None, "tp", None),
             check_vma=False,
         )
-        return fn(
-            q, k_cur, v_cur, k_cache, v_cache, layer, page_tables,
-            hist_lens, cur_lens,
-        )
+        return fn(*args)
 
     b, t, hq, d = q.shape
     hkv, s = k_cache.shape[3], k_cache.shape[2]
@@ -318,6 +350,41 @@ def paged_prefill_attention(
         k_cur = jnp.pad(k_cur, qpad)  # BQ-aligned key blocks for the
         v_cur = jnp.pad(v_cur, qpad)  # frontier loop (cur masks the tail)
 
+    in_specs = [
+        pl.BlockSpec(
+            (1, bq, hq, d),
+            lambda bi, qi, li, pt, hl, cl: (bi, qi, 0, 0),
+        ),
+        pl.BlockSpec(
+            (1, tp, hkv, d),
+            lambda bi, qi, li, pt, hl, cl: (bi, 0, 0, 0),
+        ),
+        pl.BlockSpec(
+            (1, tp, hkv, d),
+            lambda bi, qi, li, pt, hl, cl: (bi, 0, 0, 0),
+        ),
+        pl.BlockSpec(memory_space=pl.ANY),
+        pl.BlockSpec(memory_space=pl.ANY),
+    ]
+    scratch_shapes = [
+        pltpu.VMEM((2, s, hkv, d), k_cache.dtype),
+        pltpu.VMEM((2, s, hkv, d), v_cache.dtype),
+    ]
+    operands = [q, k_cur, v_cur, k_cache, v_cache]
+    if quantized:
+        in_specs += [
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ]
+        scratch_shapes += [
+            pltpu.VMEM((2, s, hkv), jnp.float32),
+            pltpu.VMEM((2, s, hkv), jnp.float32),
+        ]
+        operands += [k_scale, v_scale]
+    scratch_shapes.append(
+        pltpu.SemaphoreType.DMA((4 if quantized else 2, 2))
+    )
+
     grid = (b, tp // bq)
     out = pl.pallas_call(
         functools.partial(
@@ -325,42 +392,24 @@ def paged_prefill_attention(
             page_size=s,
             scale_dim=scale_dim or d,
             num_kv_heads=hkv,
+            quantized=quantized,
         ),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=4,
             grid=grid,
-            in_specs=[
-                pl.BlockSpec(
-                    (1, bq, hq, d),
-                    lambda bi, qi, li, pt, hl, cl: (bi, qi, 0, 0),
-                ),
-                pl.BlockSpec(
-                    (1, tp, hkv, d),
-                    lambda bi, qi, li, pt, hl, cl: (bi, 0, 0, 0),
-                ),
-                pl.BlockSpec(
-                    (1, tp, hkv, d),
-                    lambda bi, qi, li, pt, hl, cl: (bi, 0, 0, 0),
-                ),
-                pl.BlockSpec(memory_space=pl.ANY),
-                pl.BlockSpec(memory_space=pl.ANY),
-            ],
+            in_specs=in_specs,
             out_specs=pl.BlockSpec(
                 (1, bq, hq, d),
                 lambda bi, qi, li, pt, hl, cl: (bi, qi, 0, 0),
             ),
-            scratch_shapes=[
-                pltpu.VMEM((2, s, hkv, d), k_cache.dtype),
-                pltpu.VMEM((2, s, hkv, d), v_cache.dtype),
-                pltpu.SemaphoreType.DMA((2, 2)),
-            ],
+            scratch_shapes=scratch_shapes,
         ),
         out_shape=jax.ShapeDtypeStruct((b, tp, hq, d), q.dtype),
         interpret=interpret,
         # the static kv-head unroll holds per-head f32 accumulators; at
         # llama3 shapes (Hkv=8, G=4, BQ=128, D=128) that is ~19MB of
         # scoped VMEM — above Mosaic's 16MB default, well under v5e's 128MB
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=tpu_compiler_params(
             vmem_limit_bytes=100 * 1024 * 1024
         ),
     )(
@@ -368,11 +417,7 @@ def paged_prefill_attention(
         page_tables.astype(jnp.int32),
         hist_lens.astype(jnp.int32),
         cur_lens.astype(jnp.int32),
-        q,
-        k_cur,
-        v_cur,
-        k_cache,
-        v_cache,
+        *operands,
     )
     return out[:, :t]
 

@@ -16,8 +16,19 @@ are beyond every sequence's readable history and are overwritten by decode
 before they become readable. Invalid (padding) runs are redirected to the
 null page 0.
 
+Quantized pools (kv_quantize, models/llama.py): the staged model-dtype
+rows are quantized HERE — per-token, per-kv-head symmetric amax scales —
+and the kernel DMAs the narrow pages plus their [run, Hkv] f32 scale
+planes in the same launch, so no fp copy of the cache ever exists in HBM
+(the staged arrays are transient step-sized temporaries either way).
+
 input_output_aliasing keeps both caches in place. D must be a 128 multiple
-on TPU (LlamaConfig.kv_head_dim) — Mosaic DMA minor-dim alignment.
+on TPU (LlamaConfig.kv_head_dim) — Mosaic DMA minor-dim alignment. The
+scale-plane copies have a SUB-128 minor dim (Hkv) — interpret mode can't
+prove Mosaic accepts that, so the queued on-chip stages
+(scripts/tpu_pallas_check.py paged_write_int8 / paged_decode_int8) are
+the lowering proof; if Mosaic rejects it, store the planes lane-padded
+(or packed into spare page lanes) — the semantics here don't change.
 
 Parity: the engine-side KV write the reference delegates to vLLM's
 reshape_and_cache CUDA kernel (SURVEY.md §2.9); TPU-native equivalent as a
@@ -37,37 +48,60 @@ from jax.experimental.pallas import tpu as pltpu
 def _write_kernel(
     pages_ref,  # [NR] int32 target page per run (scalar prefetch)
     slots_ref,  # [NR] int32 first slot per run (scalar prefetch)
-    k_src_ref,  # [L, NR, R, Hkv, D] ANY — staged K rows, run-major
-    v_src_ref,  # [L, NR, R, Hkv, D] ANY
-    k_in_ref,  # [L, P, S, Hkv, D] ANY (aliased with k_out)
-    v_in_ref,
-    k_out_ref,  # [L, P, S, Hkv, D] ANY
-    v_out_ref,
-    sem,  # DMA semaphore
-    *,
+    *refs,  # srcs, aliased-ins, outs, sem — layout depends on `quantized`
     num_runs: int,
     run: int,
+    quantized: bool,
 ):
-    del k_in_ref, v_in_ref  # aliased: writes land in place
+    if quantized:
+        (
+            k_src_ref,  # [L, NR, R, Hkv, D] ANY — quantized staged rows
+            v_src_ref,
+            ks_src_ref,  # [L, NR, R, Hkv] ANY — f32 row scales
+            vs_src_ref,
+            k_in_ref, v_in_ref, ks_in_ref, vs_in_ref,  # aliased
+            k_out_ref,  # [L, P, S, Hkv, D] ANY
+            v_out_ref,
+            ks_out_ref,  # [L, P, S, Hkv] ANY
+            vs_out_ref,
+            sem,
+        ) = refs
+        del k_in_ref, v_in_ref, ks_in_ref, vs_in_ref
+        pairs = (
+            (k_src_ref, k_out_ref),
+            (v_src_ref, v_out_ref),
+            (ks_src_ref, ks_out_ref),
+            (vs_src_ref, vs_out_ref),
+        )
+    else:
+        (
+            k_src_ref,  # [L, NR, R, Hkv, D] ANY — staged K rows, run-major
+            v_src_ref,
+            k_in_ref, v_in_ref,  # aliased: writes land in place
+            k_out_ref,  # [L, P, S, Hkv, D] ANY
+            v_out_ref,
+            sem,
+        ) = refs
+        del k_in_ref, v_in_ref
+        pairs = ((k_src_ref, k_out_ref), (v_src_ref, v_out_ref))
 
     def copies(i):
-        dst_k = k_out_ref.at[:, pages_ref[i], pl.ds(slots_ref[i], run)]
-        dst_v = v_out_ref.at[:, pages_ref[i], pl.ds(slots_ref[i], run)]
-        return (
-            pltpu.make_async_copy(k_src_ref.at[:, i], dst_k, sem),
-            pltpu.make_async_copy(v_src_ref.at[:, i], dst_v, sem),
+        return tuple(
+            pltpu.make_async_copy(
+                src.at[:, i], dst.at[:, pages_ref[i], pl.ds(slots_ref[i], run)],
+                sem,
+            )
+            for src, dst in pairs
         )
 
     def start(i, _):
-        ck, cv = copies(i)
-        ck.start()
-        cv.start()
+        for c in copies(i):
+            c.start()
         return 0
 
     def drain(i, _):
-        ck, cv = copies(i)
-        ck.wait()
-        cv.wait()
+        for c in copies(i):
+            c.wait()
         return 0
 
     # All runs' DMAs go out before any wait: targets are disjoint (padding
@@ -88,8 +122,13 @@ def paged_write(
     *,
     use_kernel: bool | None = None,
     mesh=None,
-) -> tuple[jax.Array, jax.Array]:
+    k_scale: jax.Array | None = None,  # [L, P, S, Hkv] f32 (quantized pools)
+    v_scale: jax.Array | None = None,
+):
     """Write one step's staged KV for all layers into the caches in place.
+
+    Returns (k_cache, v_cache) or, with scale planes,
+    (k_cache, v_cache, k_scale, v_scale).
 
     Requires T == 1 (decode) or page-aligned chunk starts with T a multiple
     of min(T, S) (prefill — guaranteed by the scheduler's page-aligned
@@ -97,6 +136,7 @@ def paged_write(
     kernel is shard_mapped: staging and cache both shard on the kv-head
     axis, every shard writes its own lanes of the same rows.
     """
+    quantized = k_scale is not None
     if use_kernel is None:
         use_kernel = jax.default_backend() == "tpu"
     if use_kernel and mesh is not None and mesh.shape.get("tp", 1) > 1:
@@ -108,39 +148,74 @@ def paged_write(
         from jax.sharding import PartitionSpec as P
 
         kv_spec = P(None, None, None, "tp", None)
+        scale_spec = P(None, None, None, "tp")
+        in_specs = [
+            kv_spec, kv_spec, kv_spec, kv_spec,
+            P(None, None), P(None, None), P(None, None),
+        ]
+        out_specs = [kv_spec, kv_spec]
+        if quantized:
+            in_specs += [scale_spec, scale_spec]
+            out_specs += [scale_spec, scale_spec]
+
+        def sharded(kc, vc, ks_st, vs_st, pt, pos, vl, *scales):
+            return paged_write(
+                kc, vc, ks_st, vs_st, pt, pos, vl,
+                use_kernel=True, mesh=None,
+                k_scale=scales[0] if scales else None,
+                v_scale=scales[1] if scales else None,
+            )
+
         fn = shard_map(
-            partial(paged_write, use_kernel=True, mesh=None),
+            sharded,
             mesh=mesh,
-            in_specs=(
-                kv_spec, kv_spec, kv_spec, kv_spec,
-                P(None, None), P(None, None), P(None, None),
-            ),
-            out_specs=(kv_spec, kv_spec),
+            in_specs=tuple(in_specs),
+            out_specs=tuple(out_specs),
             check_vma=False,
         )
-        return fn(
-            k_cache, v_cache, k_stage, v_stage, page_tables, positions, valid
-        )
+        args = [k_cache, v_cache, k_stage, v_stage, page_tables, positions,
+                valid]
+        if quantized:
+            args += [k_scale, v_scale]
+        return fn(*args)
     L, b, t = k_stage.shape[0], k_stage.shape[1], k_stage.shape[2]
     s = k_cache.shape[2]
 
+    if quantized:
+        from dynamo_tpu.models.llama import quantize_kv_rows
+
+        mode = "int8" if k_cache.dtype == jnp.int8 else "fp8"
+        k_q, k_s = quantize_kv_rows(k_stage, mode)  # [L,B,T,Hkv,D], [L,B,T,Hkv]
+        v_q, v_s = quantize_kv_rows(v_stage, mode)
+    else:
+        k_q, v_q, k_s, v_s = k_stage, v_stage, None, None
+
     if not use_kernel:
         # XLA scatter fallback (CPU, meshes): token-granular, one 5D
-        # advanced-index scatter per cache.
+        # advanced-index scatter per cache (+ the scale planes when
+        # quantized).
         page_of = positions // s
         slot_of = positions % s
         page_ids = jnp.take_along_axis(page_tables, page_of, axis=1)
         page_ids = jnp.where(valid, page_ids, 0).reshape(-1)
         slot_of = jnp.where(valid, slot_of, 0).reshape(-1)
-        ks = k_stage.reshape(L, b * t, *k_stage.shape[3:])
-        vs = v_stage.reshape(L, b * t, *v_stage.shape[3:])
+        ks = k_q.reshape(L, b * t, *k_q.shape[3:])
+        vs = v_q.reshape(L, b * t, *v_q.shape[3:])
         k_cache = k_cache.at[:, page_ids, slot_of].set(
             ks.astype(k_cache.dtype), mode="drop"
         )
         v_cache = v_cache.at[:, page_ids, slot_of].set(
             vs.astype(v_cache.dtype), mode="drop"
         )
-        return k_cache, v_cache
+        if not quantized:
+            return k_cache, v_cache
+        k_scale = k_scale.at[:, page_ids, slot_of].set(
+            k_s.reshape(L, b * t, -1), mode="drop"
+        )
+        v_scale = v_scale.at[:, page_ids, slot_of].set(
+            v_s.reshape(L, b * t, -1), mode="drop"
+        )
+        return k_cache, v_cache, k_scale, v_scale
 
     run = min(t, s)
     assert t % run == 0, f"chunk T={t} must be a multiple of run={run}"
@@ -154,39 +229,48 @@ def paged_write(
     slots = jnp.where(first_valid, first_pos % s, 0).reshape(-1)
 
     shape_tail = k_stage.shape[3:]
-    k_src = k_stage.reshape(L, nr, run, *shape_tail).astype(k_cache.dtype)
-    v_src = v_stage.reshape(L, nr, run, *shape_tail).astype(v_cache.dtype)
+    k_src = k_q.reshape(L, nr, run, *shape_tail).astype(k_cache.dtype)
+    v_src = v_q.reshape(L, nr, run, *shape_tail).astype(v_cache.dtype)
+    srcs = [k_src, v_src]
+    out_shape = [
+        jax.ShapeDtypeStruct(k_cache.shape, k_cache.dtype),
+        jax.ShapeDtypeStruct(v_cache.shape, v_cache.dtype),
+    ]
+    caches = [k_cache, v_cache]
+    if quantized:
+        srcs += [
+            k_s.reshape(L, nr, run, *k_s.shape[3:]),
+            v_s.reshape(L, nr, run, *v_s.shape[3:]),
+        ]
+        out_shape += [
+            jax.ShapeDtypeStruct(k_scale.shape, k_scale.dtype),
+            jax.ShapeDtypeStruct(v_scale.shape, v_scale.dtype),
+        ]
+        caches += [k_scale, v_scale]
+    n_src = len(srcs)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(1,),
-        in_specs=[
-            pl.BlockSpec(memory_space=pl.ANY),
-            pl.BlockSpec(memory_space=pl.ANY),
-            pl.BlockSpec(memory_space=pl.ANY),
-            pl.BlockSpec(memory_space=pl.ANY),
-        ],
-        out_specs=[
-            pl.BlockSpec(memory_space=pl.ANY),
-            pl.BlockSpec(memory_space=pl.ANY),
-        ],
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)] * (2 * n_src),
+        out_specs=[pl.BlockSpec(memory_space=pl.ANY)] * n_src,
         scratch_shapes=[pltpu.SemaphoreType.DMA],
     )
-    return pl.pallas_call(
-        functools.partial(_write_kernel, num_runs=nr, run=run),
-        out_shape=[
-            jax.ShapeDtypeStruct(k_cache.shape, k_cache.dtype),
-            jax.ShapeDtypeStruct(v_cache.shape, v_cache.dtype),
-        ],
+    out = pl.pallas_call(
+        functools.partial(
+            _write_kernel, num_runs=nr, run=run, quantized=quantized
+        ),
+        out_shape=out_shape,
         grid_spec=grid_spec,
-        # operands: pages, slots, k_src, v_src, k_cache, v_cache
-        input_output_aliases={4: 0, 5: 1},
+        # operands: pages, slots, *srcs, *caches — cache i (after the 2
+        # scalar-prefetch operands and n_src staging arrays) aliases
+        # output i, keeping every pool in place
+        input_output_aliases={2 + n_src + i: i for i in range(n_src)},
         interpret=jax.default_backend() != "tpu",
     )(
         page_ids.astype(jnp.int32),
         slots.astype(jnp.int32),
-        k_src,
-        v_src,
-        k_cache,
-        v_cache,
+        *srcs,
+        *caches,
     )
+    return tuple(out)

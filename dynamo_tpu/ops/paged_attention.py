@@ -59,24 +59,34 @@ def _decode_kernel(
     order_ref,  # [B*MP] int32 — work item -> b*MP + page ordinal
     page_of_ref,  # [B*MP] int32 — work item -> physical page id
     len_ref,  # [B] int32 HISTORY lengths (tokens already in the cache)
-    # inputs
-    q_ref,  # [B, HQ, D] VMEM (whole batch's queries, unscaled)
-    k_ref,  # [L, P, S, Hkv, D] in HBM/ANY
-    v_ref,  # [L, P, S, Hkv, D] in HBM/ANY
-    # outputs (whole batch resident in VMEM; read-modify-written per page)
-    acc_ref,  # [B, HQ, D] f32 — UNNORMALIZED flash accumulator
-    m_ref,  # [B, HQ, 128] f32 — running max (lane-broadcast)
-    l_ref,  # [B, HQ, 128] f32 — running denominator (lane-broadcast)
-    # scratch
-    k_scr,  # [DEPTH, S, Hkv, D] VMEM
-    v_scr,  # [DEPTH, S, Hkv, D] VMEM
-    sem,  # [2, DEPTH] DMA semaphores: [k|v, slot]
-    *,
+    # then (positional, shape depends on `quantized`):
+    #   q_ref,  # [B, HQ, D] VMEM (whole batch's queries, unscaled)
+    #   k_ref,  # [L, P, S, Hkv, D] in HBM/ANY (narrow dtype when quantized)
+    #   v_ref,
+    #   [ks_ref, vs_ref]  # [L, P, S, Hkv] f32 scale planes (quantized)
+    # outputs (whole batch resident in VMEM; read-modify-written per page):
+    #   acc_ref,  # [B, HQ, D] f32 — UNNORMALIZED flash accumulator
+    #   m_ref,  # [B, HQ, 128] f32 — running max (lane-broadcast)
+    #   l_ref,  # [B, HQ, 128] f32 — running denominator (lane-broadcast)
+    # scratch:
+    #   k_scr,  # [DEPTH, S, Hkv, D] VMEM
+    #   v_scr,
+    #   [ks_scr, vs_scr]  # [DEPTH, S, Hkv] f32 VMEM (quantized)
+    #   sem,  # [2 or 4, DEPTH] DMA semaphores: [plane, slot]
+    *refs,
     page_size: int,
     scale_dim: int,
     num_kv_heads: int,
     max_pages: int,  # MP — decodes order_ref into (sequence, ordinal)
+    quantized: bool,
 ):
+    if quantized:
+        (q_ref, k_ref, v_ref, ks_ref, vs_ref, acc_ref, m_ref, l_ref,
+         k_scr, v_scr, ks_scr, vs_scr, sem) = refs
+    else:
+        (q_ref, k_ref, v_ref, acc_ref, m_ref, l_ref,
+         k_scr, v_scr, sem) = refs
+        ks_ref = vs_ref = ks_scr = vs_scr = None
     li = layer_ref[0]
     n = nwork_ref[0]
     hq, d = q_ref.shape[1], q_ref.shape[2]
@@ -90,22 +100,26 @@ def _decode_kernel(
     m_ref[...] = jnp.full_like(m_ref, -jnp.inf)
     l_ref[...] = jnp.zeros_like(l_ref)
 
-    def k_copy(slot, j):
-        return pltpu.make_async_copy(
-            k_ref.at[li, page_of_ref[j]], k_scr.at[slot], sem.at[0, slot]
-        )
+    # one DMA plane per (cache/scale, slot); scale planes ride the same
+    # pipeline as their pages — a page and its scales land together
+    planes = [(k_ref, k_scr), (v_ref, v_scr)]
+    if quantized:
+        planes += [(ks_ref, ks_scr), (vs_ref, vs_scr)]
 
-    def v_copy(slot, j):
-        return pltpu.make_async_copy(
-            v_ref.at[li, page_of_ref[j]], v_scr.at[slot], sem.at[1, slot]
+    def copies(slot, j):
+        return tuple(
+            pltpu.make_async_copy(
+                src.at[li, page_of_ref[j]], dst.at[slot], sem.at[pi, slot]
+            )
+            for pi, (src, dst) in enumerate(planes)
         )
 
     # prime the pipeline: DEPTH-1 transfers in flight before compute starts
     for p in range(_DEPTH - 1):
         @pl.when(p < n)
         def _(p=p):
-            k_copy(p, p).start()
-            v_copy(p, p).start()
+            for c in copies(p, p):
+                c.start()
 
     def body(j, _):
         slot = jax.lax.rem(j, _DEPTH)
@@ -113,11 +127,11 @@ def _decode_kernel(
         @pl.when(j + _DEPTH - 1 < n)
         def _():
             nslot = jax.lax.rem(j + _DEPTH - 1, _DEPTH)
-            k_copy(nslot, j + _DEPTH - 1).start()
-            v_copy(nslot, j + _DEPTH - 1).start()
+            for c in copies(nslot, j + _DEPTH - 1):
+                c.start()
 
-        k_copy(slot, j).wait()
-        v_copy(slot, j).wait()
+        for c in copies(slot, j):
+            c.wait()
 
         oj = order_ref[j]
         bj = oj // max_pages
@@ -125,6 +139,12 @@ def _decode_kernel(
         q = q_ref[bj].astype(jnp.float32) * inv_scale  # [HQ, D]
         kp = k_scr[slot].astype(jnp.float32)  # [S, Hkv, D]
         vp = v_scr[slot].astype(jnp.float32)
+        if quantized:
+            # dequantize in VMEM right after the DMA lands: the f32 rows
+            # feed the flash merge directly, so the scale folds into the
+            # per-page scores/weights and no fp page ever touches HBM
+            kp = kp * ks_scr[slot][..., None]
+            vp = vp * vs_scr[slot][..., None]
         key_pos = (oj % max_pages) * s + jax.lax.broadcasted_iota(
             jnp.int32, (g, s), 1
         )
@@ -199,19 +219,24 @@ def decode_work_list(
 
 
 def decode_vmem_bytes(
-    b: int, hq: int, d: int, s: int, hkv: int, itemsize: int
+    b: int, hq: int, d: int, s: int, hkv: int, itemsize: int,
+    quantized: bool = False,
 ) -> int:
     """Kernel VMEM footprint estimate: whole-batch q + f32 acc/m/l blocks
     plus the DMA scratch and the per-slot f32 k/v cast temporaries
     (`kp`/`vp` in the kernel body — one slot's pages live in f32 while
-    its scores/weights compute). The caller routes to the XLA gather when
-    this exceeds the budget instead of letting Mosaic fail allocation."""
+    its scores/weights compute). Quantized pools add the f32 scale-plane
+    scratch (and `itemsize` is the narrow dtype's — the scratch shrinks).
+    The caller routes to the XLA gather when this exceeds the budget
+    instead of letting Mosaic fail allocation."""
+    scale_scratch = 2 * _DEPTH * s * hkv * 4 if quantized else 0
     return (
-        b * hq * d * itemsize  # q
+        b * hq * d * itemsize  # q (itemsize of q ≈ cache dtype or wider)
         + b * hq * d * 4  # acc f32
         + 2 * b * hq * 128 * 4  # m, l f32 (lane-broadcast)
         + 2 * _DEPTH * s * hkv * d * itemsize  # k/v scratch
         + 2 * s * hkv * d * 4  # kp/vp f32 cast of the active slot
+        + scale_scratch
     )
 
 
@@ -227,6 +252,8 @@ def paged_decode_attention(
     interpret: bool | None = None,
     mesh=None,
     work_list=None,  # precomputed decode_work_list (layer-invariant)
+    k_scale: jax.Array | None = None,  # [L, P, S, Hkv] f32 (quantized pools)
+    v_scale: jax.Array | None = None,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """History-only flash attention over the paged cache.
 
@@ -235,10 +262,15 @@ def paged_decode_attention(
     A sequence with history_lens == 0 yields acc=0, l=0, m=-inf — the merge
     then reduces to pure self-attention.
 
+    With `k_scale`/`v_scale` the cache holds quantized rows; each page's
+    scale plane DMAs alongside it and the rows dequantize in VMEM before
+    the flash merge.
+
     `interpret` defaults to True off-TPU so tests run the same kernel on CPU.
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    quantized = k_scale is not None
     hkv, s = k_cache.shape[3], k_cache.shape[2]
     if work_list is None:
         work_list = decode_work_list(page_tables, history_lens, s)
@@ -254,48 +286,73 @@ def paged_decode_attention(
         shard_map = get_shard_map()
         from jax.sharding import PartitionSpec as P
 
-        def sharded(q_, k_, v_, layer_, pt_, hist_, *wl):
+        def sharded(q_, k_, v_, layer_, pt_, hist_, n_, od_, pg_, *scales):
             return paged_decode_attention(
                 q_, k_, v_, layer_, pt_, hist_,
                 scale_dim=scale_dim, interpret=interpret, mesh=None,
-                work_list=tuple(wl),
+                work_list=(n_, od_, pg_),
+                k_scale=scales[0] if scales else None,
+                v_scale=scales[1] if scales else None,
             )
 
+        in_specs = [
+            P(None, "tp", None),
+            P(None, None, None, "tp", None),
+            P(None, None, None, "tp", None),
+            P(),
+            P(),
+            P(),
+            P(),
+            P(),
+            P(),
+        ]
+        args = [q, k_cache, v_cache, layer, page_tables, history_lens,
+                *work_list]
+        if quantized:
+            in_specs += [P(None, None, None, "tp"), P(None, None, None, "tp")]
+            args += [k_scale, v_scale]
         fn = shard_map(
             sharded,
             mesh=mesh,
-            in_specs=(
-                P(None, "tp", None),
-                P(None, None, None, "tp", None),
-                P(None, None, None, "tp", None),
-                P(),
-                P(),
-                P(),
-                P(),
-                P(),
-                P(),
-            ),
+            in_specs=tuple(in_specs),
             out_specs=(P(None, "tp", None), P(None, "tp"), P(None, "tp")),
             check_vma=False,
         )
-        return fn(
-            q, k_cache, v_cache, layer, page_tables, history_lens,
-            *work_list,
-        )
+        return fn(*args)
     b, hq, d = q.shape
     mp = page_tables.shape[1]
     n_work, order, page_of = work_list
 
+    in_specs = [
+        pl.BlockSpec(
+            (b, hq, d), lambda i, li, n, od, pg, ln: (0, 0, 0)
+        ),
+        pl.BlockSpec(memory_space=pl.ANY),
+        pl.BlockSpec(memory_space=pl.ANY),
+    ]
+    scratch_shapes = [
+        pltpu.VMEM((_DEPTH, s, hkv, d), k_cache.dtype),
+        pltpu.VMEM((_DEPTH, s, hkv, d), v_cache.dtype),
+    ]
+    operands = [q, k_cache, v_cache]
+    if quantized:
+        in_specs += [
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ]
+        scratch_shapes += [
+            pltpu.VMEM((_DEPTH, s, hkv), jnp.float32),
+            pltpu.VMEM((_DEPTH, s, hkv), jnp.float32),
+        ]
+        operands += [k_scale, v_scale]
+    scratch_shapes.append(
+        pltpu.SemaphoreType.DMA((4 if quantized else 2, _DEPTH))
+    )
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=5,
         grid=(1,),
-        in_specs=[
-            pl.BlockSpec(
-                (b, hq, d), lambda i, li, n, od, pg, ln: (0, 0, 0)
-            ),
-            pl.BlockSpec(memory_space=pl.ANY),
-            pl.BlockSpec(memory_space=pl.ANY),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec(
                 (b, hq, d), lambda i, li, n, od, pg, ln: (0, 0, 0)
@@ -307,11 +364,7 @@ def paged_decode_attention(
                 (b, hq, 128), lambda i, li, n, od, pg, ln: (0, 0, 0)
             ),
         ],
-        scratch_shapes=[
-            pltpu.VMEM((_DEPTH, s, hkv, d), k_cache.dtype),
-            pltpu.VMEM((_DEPTH, s, hkv, d), v_cache.dtype),
-            pltpu.SemaphoreType.DMA((2, _DEPTH)),
-        ],
+        scratch_shapes=scratch_shapes,
     )
     acc, m, l = pl.pallas_call(
         functools.partial(
@@ -320,6 +373,7 @@ def paged_decode_attention(
             scale_dim=scale_dim or d,
             num_kv_heads=hkv,
             max_pages=mp,
+            quantized=quantized,
         ),
         out_shape=[
             jax.ShapeDtypeStruct((b, hq, d), jnp.float32),
@@ -334,8 +388,6 @@ def paged_decode_attention(
         order,
         page_of,
         history_lens.astype(jnp.int32),
-        q,
-        k_cache,
-        v_cache,
+        *operands,
     )
     return acc, m[:, :, 0], l[:, :, 0]

@@ -38,6 +38,18 @@ def get_shard_map():
     return compat
 
 
+def tpu_compiler_params(**kwargs):
+    """pltpu compiler-params across jax versions: `CompilerParams`
+    (0.6+) vs `TPUCompilerParams` (the baked toolchain's 0.4.x). Same
+    one-import-site rule as get_shard_map."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    cls = getattr(pltpu, "CompilerParams", None) or getattr(
+        pltpu, "TPUCompilerParams"
+    )
+    return cls(**kwargs)
+
+
 def honor_jax_platforms_env() -> None:
     requested = os.environ.get("JAX_PLATFORMS")
     if not requested:

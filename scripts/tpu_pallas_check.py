@@ -221,6 +221,87 @@ def check_paged_write():
     return err
 
 
+def check_paged_write_int8():
+    """Quantized pools: the DMA writer must land the same int8 rows +
+    f32 scale planes as the XLA scatter (page-aligned full-run writes)."""
+    from dynamo_tpu.models.llama import init_kv_pages, LlamaConfig
+
+    key = jax.random.PRNGKey(4)
+    L, b, t, hkv, d = 2, 2, 64, 2, 128
+    P, S, MP = 32, 64, 8
+    ks = jax.random.split(key, 2)
+    cfg = LlamaConfig(
+        num_layers=L, num_kv_heads=hkv, head_dim=d, attention_impl="pallas"
+    )
+    k_stage = jax.random.normal(ks[0], (L, b, t, hkv, d), jnp.bfloat16)
+    v_stage = jax.random.normal(ks[1], (L, b, t, hkv, d), jnp.bfloat16)
+    pt = jnp.arange(b * MP, dtype=jnp.int32).reshape(b, MP)
+    positions = jnp.tile(jnp.arange(t, dtype=jnp.int32)[None], (b, 1)) + 64
+    valid = jnp.ones((b, t), bool)
+    outs = []
+    for use_kernel in (True, False):
+        kv = init_kv_pages(cfg, P, S, kv_quantize="int8")
+        outs.append(paged_write(
+            kv.k, kv.v, k_stage, v_stage, pt, positions, valid,
+            use_kernel=use_kernel, k_scale=kv.k_scale, v_scale=kv.v_scale,
+        ))
+    err = 0.0
+    for a, b_ in zip(*outs):
+        err = max(err, float(jnp.max(jnp.abs(
+            a.astype(jnp.float32) - b_.astype(jnp.float32)
+        ))))
+    assert err == 0.0, f"quantized paged_write kernel != scatter: {err}"
+    return err
+
+
+def check_paged_decode_int8():
+    """int8 pages + in-kernel dequant vs the dense dequantized XLA
+    reference — the Mosaic proof of the scale-plane DMA + VMEM dequant."""
+    from dynamo_tpu.models.llama import dequantize_kv_rows, quantize_kv_rows
+
+    key = jax.random.PRNGKey(5)
+    b, hq, hkv, d = 4, 8, 2, 128
+    L, P, S, MP = 2, 64, 64, 16
+    ks = jax.random.split(key, 3)
+    q = jax.random.normal(ks[0], (b, hq, d), jnp.bfloat16)
+    k_f = jax.random.normal(ks[1], (L, P, S, hkv, d), jnp.float32)
+    v_f = jax.random.normal(ks[2], (L, P, S, hkv, d), jnp.float32)
+    k_cache, k_scale = quantize_kv_rows(k_f, "int8")
+    v_cache, v_scale = quantize_kv_rows(v_f, "int8")
+    pt = jnp.arange(b * MP, dtype=jnp.int32).reshape(b, MP) % P
+    hist = jnp.array([512, 130, 64, 0], jnp.int32)
+    layer = jnp.asarray(0, jnp.int32)
+    acc, m, l = paged_decode_attention(
+        q, k_cache, v_cache, layer, pt, hist, scale_dim=d, interpret=False,
+        k_scale=k_scale, v_scale=v_scale,
+    )
+    kd = dequantize_kv_rows(k_cache, k_scale, jnp.float32)
+    vd = dequantize_kv_rows(v_cache, v_scale, jnp.float32)
+    g = hq // hkv
+    errs = []
+    for i in range(b):
+        h = int(hist[i])
+        if h == 0:
+            errs.append(jnp.max(jnp.abs(acc[i])))
+            continue
+        npages = -(-h // S)
+        pages = pt[i, :npages]
+        kh = jnp.repeat(kd[0, pages].reshape(-1, hkv, d)[:h], g, axis=1)
+        vh = jnp.repeat(vd[0, pages].reshape(-1, hkv, d)[:h], g, axis=1)
+        qf = q[i].astype(jnp.float32) / math.sqrt(d)
+        s = jnp.einsum("hd,shd->hs", qf, kh)
+        m_ref = jnp.max(s, axis=-1)
+        p = jnp.exp(s - m_ref[:, None])
+        l_ref = jnp.sum(p, axis=-1)
+        acc_ref = jnp.einsum("hs,shd->hd", p, vh)
+        o_kernel = acc[i] / jnp.maximum(l[i], 1e-30)[:, None]
+        o_ref = acc_ref / jnp.maximum(l_ref, 1e-30)[:, None]
+        errs.append(jnp.max(jnp.abs(o_kernel - o_ref)))
+    err = float(jnp.max(jnp.stack(errs)))
+    assert err < 0.05, f"quantized paged_decode mismatch: {err}"
+    return err
+
+
 def main():
     plat = jax.devices()[0].platform
     print(f"platform: {plat} ({jax.devices()})")
@@ -231,6 +312,8 @@ def main():
     record("paged_prefill_attention", check_paged_prefill)
     record("paged_decode_attention", check_paged_decode)
     record("paged_write", check_paged_write)
+    record("paged_write_int8", check_paged_write_int8)
+    record("paged_decode_attention_int8", check_paged_decode_int8)
     out = {
         "platform": plat,
         "device": str(jax.devices()[0]),

@@ -1,7 +1,10 @@
 """On-device pallas-vs-xla serving agreement + numerics GATE.
 
-Four comparisons on the live TPU, llama3-1b shapes (seeded random
-weights — no trained checkpoint exists in this zero-egress image):
+Five comparisons on the live TPU, llama3-1b shapes (seeded random
+weights — no trained checkpoint exists in this zero-egress image);
+2b. is the kv-quant leg: the SAME teacher-forced drift with int8 KV
+pages (pallas+kv_quantize vs fp xla), gated on the same <0.25 /
+>=90%-argmax budget:
 
 1. model-forward logits: one 128-token prefill through forward() under
    attention_impl="xla" vs "pallas"; GATED on max |Δlogit| < 0.25 (the
@@ -126,6 +129,64 @@ def teacher_forced_drift():
     }
 
 
+def kv_quant_drift():
+    """The kv-quant numerics leg (ISSUE 2 CI gate): teacher-forced decode
+    where the int8-KV pallas path consumes the fp xla path's greedy
+    stream. Budget: per-step max |Δlogit| < 0.25 (the fp pallas leg's
+    budget — int8 row-scale quantization noise lands well inside it at
+    these logit ranges) and ≥90% per-step argmax agreement."""
+    import dataclasses
+
+    import jax.numpy as jnp
+
+    from dynamo_tpu.models import forward
+    from dynamo_tpu.models.llama import init_kv_pages
+
+    cfgs, params, T, toks, positions, valid, pt = _prefill_setup()
+    arms = {
+        "xla": (dict(cfgs)["xla"], None),
+        "pallas_int8": (
+            dataclasses.replace(dict(cfgs)["pallas"]),
+            "int8",
+        ),
+    }
+    state = {}
+    for name, (cfg, kvq) in arms.items():
+        kv = init_kv_pages(cfg, num_pages=64, page_size=64, kv_quantize=kvq)
+        logits, kv = forward(params, cfg, toks, positions, valid, kv, pt)
+        state[name] = (
+            np.asarray(logits[0, -1].astype(jnp.float32)), cfg, kv
+        )
+    drift, agree = [], 0
+    cur = int(state["xla"][0].argmax())
+    for i in range(STEPS):
+        step = {}
+        for name in arms:
+            _, cfg, kv = state[name]
+            logits, kv = forward(
+                params, cfg,
+                jnp.asarray([[cur]], jnp.int32),
+                jnp.asarray([[T + i]], jnp.int32),
+                jnp.ones((1, 1), bool), kv, pt,
+            )
+            step[name] = np.asarray(logits[0, -1].astype(jnp.float32))
+            state[name] = (step[name], cfg, kv)
+        drift.append(
+            round(float(np.abs(step["xla"] - step["pallas_int8"]).max()), 4)
+        )
+        agree += int(step["xla"].argmax() == step["pallas_int8"].argmax())
+        cur = int(step["xla"].argmax())
+    agreement = agree / STEPS
+    return {
+        "steps": STEPS,
+        "per_step_max_abs_logit_diff": drift,
+        "max_drift": max(drift),
+        "teacher_forced_argmax_agreement": agreement,
+        "budget": {"max_drift": LOGIT_TOL, "min_agreement": MIN_AGREE},
+        "ok": max(drift) < LOGIT_TOL and agreement >= MIN_AGREE,
+    }
+
+
 def logits_check():
     import jax.numpy as jnp
 
@@ -205,6 +266,8 @@ def main():
     print("logits:", json.dumps(logits))
     drift = teacher_forced_drift()
     print("teacher-forced drift:", json.dumps(drift))
+    kvq = kv_quant_drift()
+    print("kv-quant drift (int8 pages):", json.dumps(kvq))
 
     rng = np.random.default_rng(7)
     prompts = [
@@ -231,6 +294,7 @@ def main():
         "model": f"{MODEL_PRESET} (seeded random weights)",
         "logits": logits,
         "teacher_forced_drift": drift,
+        "kv_quant_drift": kvq,
         # free-running agreement: stats only (documented waiver — random
         # near-uniform weights fork on bf16 noise; see module docstring)
         "greedy_prefix_agreement": greedy,
@@ -238,7 +302,7 @@ def main():
             "xla": round(tok_s_xla, 1),
             "pallas": round(tok_s_pallas, 1),
         },
-        "ok": logits["ok"] and drift["ok"],
+        "ok": logits["ok"] and drift["ok"] and kvq["ok"],
     }
     path = Path(__file__).resolve().parent.parent / "artifacts/tpu"
     path.mkdir(parents=True, exist_ok=True)

@@ -114,6 +114,11 @@ bench_1b_sweep() {
   # hybrid); bench.py reports the best with both in extras
   run_stage bench_1b python bench.py
 }
+bench_1b_kvq() {
+  # kv-quant bench A/B arm (ISSUE 2): identical workload with int8 KV
+  # pages; compare tok/s + pool-byte gauges against bench_1b
+  BENCH_KV_QUANTIZE=int8 run_stage bench_1b_kvq python bench.py
+}
 pallas_gate() {
   # numerics GATE: prefill logit diff + 32-step teacher-forced drift
   # (budget 0.25 / >=90% argmax agreement); exit 2 = gate failed.
@@ -128,7 +133,7 @@ transfer() {
 }
 
 STAGES=("$@")
-[ ${#STAGES[@]} -eq 0 ] && STAGES=(pallas_kernels prewarm disagg_ab sweep_8b sla_8b ft_kill routing offload bench_dsv2 decode_profile bench_1b_sweep pallas_gate transfer)
+[ ${#STAGES[@]} -eq 0 ] && STAGES=(pallas_kernels prewarm disagg_ab sweep_8b sla_8b ft_kill routing offload bench_dsv2 decode_profile bench_1b_sweep bench_1b_kvq pallas_gate transfer)
 
 wait_for_tunnel
 for s in "${STAGES[@]}"; do

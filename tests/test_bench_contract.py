@@ -58,6 +58,17 @@ def test_cpu_fallback_line_is_labeled_and_carries_tpu_artifact():
     for arm in ("overlap_on", "overlap_off"):
         assert ab[arm]["tok_s"] > 0
         assert "decode_sync_ms" in ab[arm]
+    # kv-quant on/off A/B (ISSUE 2): both arms ran, the int8 arm's pool
+    # gauges show the byte saving, and capacity_ratio reports the
+    # effective-cache multiplier the quantized pages buy
+    kq = ex["kvquant_ab"]
+    for arm in ("kv_fp", "kv_int8"):
+        assert kq[arm]["tok_s"] > 0
+        assert kq[arm]["kv_pool_bytes"] > 0
+    assert (
+        kq["kv_int8"]["kv_pool_bytes"] < kq["kv_fp"]["kv_pool_bytes"]
+    )
+    assert kq["capacity_ratio"] > 1.3
     assert ab["speedup"] is not None
 
 

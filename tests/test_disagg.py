@@ -117,6 +117,54 @@ def test_kv_transfer_numerical_equivalence(tiny_cfg):
     assert got == ref_tokens
 
 
+def test_kv_transfer_equivalence_quantized_pages(tiny_cfg):
+    """The same remote-prefill handoff with kv_quantize=int8 engines on
+    BOTH ends: the wire ships quantized pages + packed scales (half the
+    fp bytes), the reconstructed cache is byte-identical to the source
+    pages, and decode continues exactly like the single local engine."""
+    import dataclasses
+
+    import numpy as np
+
+    qcfg = dataclasses.replace(tiny_cfg, kv_quantize="int8")
+    prompt = [5, 17, 42, 99, 3, 8, 21, 60, 11, 2]
+    n_out = 6
+
+    ref = JaxEngine(qcfg)
+    ref.add_request(
+        "ref", prompt, SamplingParams(temperature=0.0, max_tokens=n_out)
+    )
+    ref_tokens = ref.run_to_completion()["ref"]
+
+    pre = JaxEngine(qcfg)
+    req_p = pre.add_request(
+        "d1", prompt,
+        SamplingParams(temperature=0.0, max_tokens=1, ignore_eos=True),
+    )
+    req_p.hold_pages = True
+    first = pre.run_to_completion()["d1"]
+    held = pre.scheduler.held["d1"]
+    k, v = pre.extract_pages(held)
+    # quantized wire: int8 payload + 4 packed f32-scale lanes per row
+    assert k.dtype == np.int8
+    assert k.shape[-1] == pre.adapter.config.head_dim + 4
+
+    dec = JaxEngine(qcfg)
+    req_d = dec.allocate_for_remote_prefill(
+        "d1", prompt, SamplingParams(temperature=0.0, max_tokens=n_out)
+    )
+    dec.inject_pages(req_d.pages, k, v)
+    # BYTE IDENTITY of the reconstructed cache: re-extracting the landed
+    # pages must reproduce the sender's bytes exactly (rows AND scales)
+    k2, v2 = dec.extract_pages(req_d.pages)
+    assert np.array_equal(k, k2) and np.array_equal(v, v2)
+    pre.scheduler.release_held("d1")
+    outputs = dec.add_prefilled(req_d, first[0])
+    got = [t for o in outputs for t in o.new_token_ids]
+    got += dec.run_to_completion().get("d1", [])
+    assert got == ref_tokens
+
+
 def test_device_path_numerical_equivalence(tiny_cfg, monkeypatch):
     """Device plane end to end in-process: stage device arrays, pull them
     over the transfer fabric, land via inject_pages_device — decode output

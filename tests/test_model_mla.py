@@ -720,3 +720,36 @@ def test_mla_disagg_host_path(monkeypatch):
     got = [t for o in outputs for t in o.new_token_ids]
     got += dec.run_to_completion().get("d1", [])
     assert got == ref_tokens
+
+
+@pytest.mark.parametrize("quantize", [False, True])
+def test_moe_expert_chunking_matches_fused(quantize):
+    """The chunked (ng > 1) branch of _routed_expert_ffn — the v5e OOM
+    fix for the all-experts f32 temps — must reproduce the fused path:
+    same contractions per group, only the cross-group f32 sum reorders
+    (sub-ulp). Auto-sizing never chunks at CI shapes, so force it."""
+    cfg = MlaConfig.tiny_moe()
+    params = init_params(jax.random.key(0), cfg)
+    if quantize:
+        from dynamo_tpu.models import mla as mla_mod
+
+        params = mla_mod.quantize_params_int8(params)
+    rng = np.random.default_rng(0)
+    toks = jnp.asarray(rng.integers(1, 200, (2, 8)), jnp.int32)
+    pos = jnp.broadcast_to(jnp.arange(8, dtype=jnp.int32), (2, 8))
+    valid = jnp.ones((2, 8), bool)
+    pt = jnp.asarray(
+        np.stack([np.arange(1, 5), np.arange(5, 9)]).astype(np.int32)
+    )
+
+    def run(chunk):
+        c = replace(cfg, moe_expert_chunk=chunk)
+        kv = init_kv_pages(c, 16, PAGE_SIZE)
+        logits, _ = forward(params, c, toks, pos, valid, kv, pt)
+        return np.asarray(logits)
+
+    fused = run(cfg.n_routed_experts)
+    for chunk in (1, 2):
+        assert cfg.n_routed_experts % chunk == 0
+        got = run(chunk)
+        np.testing.assert_allclose(got, fused, atol=1e-4)
