@@ -65,6 +65,63 @@ def emit(obj: dict) -> None:
     print(json.dumps(obj), flush=True)
 
 
+def _ext_harness_ab(num_requests: int = 8, tokens: int = 64) -> dict:
+    """Per-token overhead of the subprocess external-engine harness: the
+    SAME echo workload through an in-process EchoEngine vs the torch-free
+    reference worker behind the wire protocol (spawn + frames + msgpack +
+    checksums). The delta prices the isolation boundary a foreign engine
+    pays per token (docs/external_engines.md 'Level 2')."""
+    import asyncio
+
+    from dynamo_tpu.engine.async_engine import EchoEngine
+    from dynamo_tpu.external.client import SubprocessEngine
+    from dynamo_tpu.preprocessor.preprocessor import PreprocessedRequest
+    from dynamo_tpu.runtime.context import Context
+
+    prompt = list(range(1, tokens + 1))
+
+    async def drive(engine, tag):
+        async def one(i):
+            req = PreprocessedRequest(
+                request_id=f"{tag}{i}", token_ids=prompt, max_tokens=tokens
+            )
+            n = 0
+            ctx = Context(request_id=req.request_id)
+            async for item in engine.generate(ctx, req):
+                n += len(item["token_ids"])
+            return n
+
+        t0 = time.time()
+        counts = await asyncio.gather(*[one(i) for i in range(num_requests)])
+        return sum(counts), time.time() - t0
+
+    async def run():
+        n_in, t_in = await drive(EchoEngine(), "warm-in")
+        n_in, t_in = await drive(EchoEngine(), "in")
+        ext = SubprocessEngine(
+            [sys.executable, "-m", "dynamo_tpu.external.reference_worker",
+             "--model", "bench-ext", "--metrics-interval", "60"],
+            name="bench-ext",
+        )
+        await ext.start()
+        try:
+            await drive(ext, "warm-ext")
+            n_ext, t_ext = await drive(ext, "ext")
+        finally:
+            await ext.stop()
+        return {
+            "requests": num_requests,
+            "tokens_per_arm": n_in,
+            "inproc_tok_s": round(n_in / t_in, 1) if t_in else None,
+            "subprocess_tok_s": round(n_ext / t_ext, 1) if t_ext else None,
+            "wire_overhead_us_per_token": round(
+                (t_ext / n_ext - t_in / n_in) * 1e6, 2
+            ),
+        }
+
+    return asyncio.run(run())
+
+
 def main() -> None:
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     from dynamo_tpu.platform import honor_jax_platforms_env
@@ -338,6 +395,17 @@ def main() -> None:
             3,
         )
 
+    # Subprocess external-engine harness A/B (CPU only: the harness is
+    # engine-agnostic plumbing; its cost doesn't depend on the chip): the
+    # per-token price of the wire hop, reported next to the headline.
+    ext_ab = None
+    if platform != "tpu" and os.environ.get("BENCH_EXT_AB", "1") != "0":
+        try:
+            ext_ab = _ext_harness_ab()
+        except Exception as e:  # noqa: BLE001 — A/B failure must not kill
+            # the headline artifact
+            ext_ab = {"error": f"{type(e).__name__}: {e}"}
+
     tok_s = best["tok_s"]
     p50_ttft = best["p50_ttft"]
     p50_itl = best["p50_itl"]
@@ -511,6 +579,7 @@ def main() -> None:
                 ],
                 **({"overlap_ab": overlap_ab} if overlap_ab else {}),
                 **({"kvquant_ab": kvquant_ab} if kvquant_ab else {}),
+                **({"ext_harness_ab": ext_ab} if ext_ab else {}),
                 **(
                     {"kv_quantize": os.environ["BENCH_KV_QUANTIZE"]}
                     if os.environ.get("BENCH_KV_QUANTIZE")

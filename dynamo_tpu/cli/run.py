@@ -6,6 +6,15 @@
   dynamo-tpu run in=dyn out=jax model=llama3-8b --fabric host:port
                                                             # join as worker
   dynamo-tpu run in=http out=dyn --fabric host:port         # frontend only
+  dynamo-tpu run in=http 'out=ext:python -m my_engine_shim' # subprocess
+                                                            # engine harness
+
+`out=ext:<command...>` runs the command as a supervised subprocess
+speaking the external-engine wire protocol (docs/external_engines.md
+"Level 2") — the reference's `dynamo-run in=http out=vllm` shape
+(launch/dynamo-run/src/subprocess/vllm_inc.py). Quote the whole
+`out=ext:...` token when the engine command takes flags that collide
+with dynamo-tpu's own (e.g. --model).
 
 (reference: `dynamo run in=<http|text|stdin|batch:f|dyn://...>
 out=<engine>` — launch/dynamo-run/src/lib.rs:44, opt.rs:7.)
@@ -117,6 +126,12 @@ async def _make_local_pipeline(args):
         from dynamo_tpu.mocker import MockEngine
 
         return local_pipeline(card, MockEngine()), None
+    if args.out.startswith("ext:"):
+        from dynamo_tpu.external import SubprocessEngine
+
+        engine = SubprocessEngine(args.ext_cmd, name="ext")
+        await engine.start()
+        return local_pipeline(card, engine), engine
     engine = JaxEngine(
         _engine_config(args, card.eos_token_ids),
         checkpoint_path=args.checkpoint,
@@ -124,6 +139,16 @@ async def _make_local_pipeline(args):
     runner = AsyncEngineRunner(engine)
     runner.start()
     return local_pipeline(card, runner), runner
+
+
+async def _stop_engine(runner) -> None:
+    """AsyncEngineRunner.stop() is sync; SubprocessEngine.stop() is a
+    coroutine — stop either."""
+    if runner is None:
+        return
+    res = runner.stop()
+    if asyncio.iscoroutine(res):
+        await res
 
 
 async def _run_http(args) -> None:
@@ -149,8 +174,7 @@ async def _run_http(args) -> None:
         await asyncio.Event().wait()
     finally:
         await svc.stop()
-        if runner:
-            runner.stop()
+        await _stop_engine(runner)
 
 
 async def _run_text(args) -> None:
@@ -183,8 +207,7 @@ async def _run_text(args) -> None:
             print()
             history.append(ChatMessage(role="assistant", content="".join(text)))
     finally:
-        if runner:
-            runner.stop()
+        await _stop_engine(runner)
 
 
 async def _run_batch(args, path: str) -> None:
@@ -209,8 +232,7 @@ async def _run_batch(args, path: str) -> None:
                         text.append(c.delta.content)
             print(json.dumps({"index": i, "prompt": prompt, "output": "".join(text)}), flush=True)
     finally:
-        if runner:
-            runner.stop()
+        await _stop_engine(runner)
 
 
 def _run_spmd_follower(args) -> None:
@@ -259,6 +281,12 @@ async def _run_worker(args) -> None:
         finally:
             await pw.stop()
         return
+    external = None
+    if args.out.startswith("ext:"):
+        from dynamo_tpu.external import SubprocessEngine
+
+        external = SubprocessEngine(args.ext_cmd, name="ext")
+        await external.start()
     worker = Worker(
         rt,
         _card(args),
@@ -267,7 +295,8 @@ async def _run_worker(args) -> None:
             if args.out == "jax"
             else None
         ),
-        engine_kind=args.out,
+        engine_kind="external" if external is not None else args.out,
+        engine=external,
         namespace=args.namespace,
         component=args.component,
         endpoint=args.endpoint,
@@ -285,6 +314,8 @@ async def _run_worker(args) -> None:
         await asyncio.Event().wait()
     finally:
         await worker.stop()
+        if external is not None:
+            await external.stop()
 
 
 async def _run_ctl(args) -> None:
@@ -879,9 +910,39 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def _ext_command(
+    argv: list[str], out_value: str, tail: list[str], extra: list[str]
+) -> list[str]:
+    """Assemble the external-engine command from `out=ext:<cmd>` plus any
+    argv tokens dynamo-tpu itself did not claim, in their original order.
+    The quoted form (`'out=ext:python -m pkg --flag'`) is exact; unquoted
+    trailing tokens pass through only if no dynamo-tpu option consumed
+    them first (collisions like --model need the quoted form)."""
+    import shlex
+
+    cmd = shlex.split(out_value[len("ext:"):])
+    pool = list(tail) + list(extra)
+    seen_out = False
+    for tok in argv:
+        if not seen_out:
+            seen_out = tok == "out=" + out_value
+            continue
+        if tok in pool:
+            pool.remove(tok)
+            cmd.append(tok)
+    cmd += pool  # anything left (defensive: tokens before out=)
+    if not cmd:
+        raise SystemExit("out=ext: needs a command, e.g. "
+                         "'out=ext:python -m my_shim'")
+    return cmd
+
+
 def main(argv: Optional[list[str]] = None) -> None:
     p = build_parser()
-    args = p.parse_args(argv)
+    raw_argv = list(argv) if argv is not None else sys.argv[1:]
+    args, extra_argv = p.parse_known_args(argv)
+    if extra_argv and not any(a.startswith("out=ext:") for a in raw_argv):
+        p.error(f"unrecognized arguments: {' '.join(extra_argv)}")
     if args.cmd == "planner" and args.connector == "kube":
         if not args.cr_name:
             p.error("--cr-name is required with --connector kube")
@@ -997,9 +1058,32 @@ def main(argv: Optional[list[str]] = None) -> None:
         asyncio.run(_run_ctl(args))
         return
 
-    io = dict(kv.split("=", 1) for kv in args.io if "=" in kv)
-    inp = io.get("in", "text")
-    args.out = io.get("out", "jax")
+    if any(t.startswith("out=ext:") for t in args.io):
+        # ext mode: in=/out= must be unique, and every OTHER io token —
+        # including stray k=v ones like `config=prod.yaml` or a second
+        # `out=jax` — belongs to the engine command. The plain dict parse
+        # below would silently swallow them (or worse, reroute the whole
+        # invocation to a different engine via last-wins out=).
+        io = {}
+        leftover = []
+        for tok in args.io:
+            k, sep, v = tok.partition("=")
+            if sep and k in ("in", "out"):
+                if k in io:
+                    p.error(
+                        f"duplicate {k}= with out=ext: — quote the whole "
+                        f"engine command ('out=ext:python -m ...')"
+                    )
+                io[k] = v
+                continue
+            leftover.append(tok)
+        inp = io.get("in", "text")
+        args.out = io["out"]
+        args.ext_cmd = _ext_command(raw_argv, args.out, leftover, extra_argv)
+    else:
+        io = dict(kv.split("=", 1) for kv in args.io if "=" in kv)
+        inp = io.get("in", "text")
+        args.out = io.get("out", "jax")
 
     if getattr(args, "coordinator", None):
         if inp != "dyn" or args.out != "jax":

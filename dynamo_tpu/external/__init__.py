@@ -1,0 +1,21 @@
+"""Subprocess external-engine harness.
+
+Runs ANY engine as a supervised subprocess speaking a versioned wire
+protocol and presents it to the rest of the stack as a first-class
+AsyncEngine — the reference's engine-subprocess shims
+(launch/dynamo-run/src/subprocess/vllm_inc.py, sglang_inc.py,
+trtllm_inc.py) as a reusable subsystem:
+
+- protocol.py  — the versioned frame vocabulary over the fabric codec
+- supervisor.py — spawn / handshake / heartbeat / backoff-restart
+- client.py    — SubprocessEngine: the AsyncEngine facade workers use
+- shim.py      — the library a foreign engine imports to speak the wire
+- reference_worker.py — torch-free reference engine for tests/CI
+
+See docs/external_engines.md "Level 2: subprocess workers".
+"""
+
+from dynamo_tpu.external.client import SubprocessEngine
+from dynamo_tpu.external.supervisor import EngineSupervisor, SupervisorConfig
+
+__all__ = ["SubprocessEngine", "EngineSupervisor", "SupervisorConfig"]

@@ -1,0 +1,256 @@
+"""SubprocessEngine: a supervised foreign engine as a first-class
+AsyncEngine.
+
+`Worker(engine_kind="external", engine=SubprocessEngine([...]))` needs
+zero changes to its registration/ingress/KV-publish paths: this class
+satisfies the whole AsyncEngine surface (engine/async_engine.py) —
+`generate`, optional `embed`, `metrics_dict()`, and the `on_kv_event`
+sink the Worker wires for prefix routing — while the actual engine
+lives in a subprocess behind external/protocol.py frames.
+
+Failure semantics (the isolation boundary the in-process Level-1 path
+cannot give):
+
+- the child crashing mid-stream turns every in-flight request into an
+  ERROR finish (never a hung stream) while the supervisor backoff-
+  restarts it;
+- a request arriving while the child is down waits up to
+  `admission_timeout` for readiness, then raises EngineUnavailableError
+  — a RetryableHandlerError, so the worker's ingress flags the error
+  frame retryable and PushRouter.mark_down retry logic sends the
+  request to a surviving instance.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Any, AsyncIterator, Optional
+
+from dynamo_tpu.engine.page_table import KvEvent
+from dynamo_tpu.external import protocol
+from dynamo_tpu.external.supervisor import EngineSupervisor, SupervisorConfig
+from dynamo_tpu.runtime.context import (
+    CANCELLED,
+    Context,
+    queue_get_or_cancelled,
+)
+from dynamo_tpu.runtime.ingress import RetryableHandlerError
+
+logger = logging.getLogger(__name__)
+
+
+class EngineUnavailableError(RetryableHandlerError):
+    """The subprocess engine is down/restarting/broken: another instance
+    should take the request (PushRouter marks this one down)."""
+
+
+class SubprocessEngine:
+    """AsyncEngine over a supervised external/protocol.py subprocess."""
+
+    def __init__(
+        self,
+        cmd: list[str],
+        name: str = "ext",
+        config: Optional[SupervisorConfig] = None,
+        admission_timeout: float = 10.0,
+    ):
+        self.supervisor = EngineSupervisor(
+            cmd, name=name, config=config,
+            on_frame=self._on_frame, on_down=self._on_down,
+        )
+        self.name = name
+        self.admission_timeout = admission_timeout
+        #: set by Worker(engine_kind="external"): KvEvent sink feeding the
+        #: worker's publish buffer (prefix routing for foreign engines)
+        self.on_kv_event = None
+        self.requests_received = 0
+        self._streams: dict[str, asyncio.Queue] = {}
+        self._embeds: dict[str, asyncio.Future] = {}
+        self._metrics: dict = {}
+        self._embed_ids = iter(range(1, 1 << 62))
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def start(self, wait_ready: bool = True) -> None:
+        await self.supervisor.start()
+        if wait_ready and not await self.supervisor.wait_ready(
+            self.supervisor.config.ready_timeout
+        ):
+            state = self.supervisor.state
+            await self.supervisor.stop()
+            raise RuntimeError(
+                f"external engine {self.name!r} never became ready "
+                f"(state={state}); see its stderr in the logs"
+            )
+
+    async def stop(self) -> None:
+        await self.supervisor.stop()
+        self._fail_inflight("engine stopped")
+
+    @property
+    def hello(self) -> Optional[dict]:
+        return self.supervisor.hello
+
+    @property
+    def capabilities(self) -> dict:
+        return (self.supervisor.hello or {}).get("capabilities") or {}
+
+    # -- frame routing (supervisor read loop) ------------------------------
+
+    def _on_frame(self, header: Any, payload: bytes) -> None:
+        t = header.get("type") if isinstance(header, dict) else None
+        if t == "token":
+            q = self._streams.get(header.get("id"))
+            if q is not None:
+                q.put_nowait(protocol.unpack(payload))
+        elif t == "finish":
+            q = self._streams.get(header.get("id"))
+            if q is not None:
+                q.put_nowait(None)
+        elif t == "error":
+            rid = header.get("id")
+            if rid is None:
+                logger.error(
+                    "engine %s fatal: %s", self.name, header.get("message")
+                )
+                return
+            q = self._streams.get(rid)
+            if q is not None:
+                q.put_nowait({"error": header.get("message") or "engine error"})
+                q.put_nowait(None)
+        elif t == "kv_event":
+            if self.on_kv_event is None:
+                return
+            for e in protocol.unpack(payload):
+                self.on_kv_event(
+                    KvEvent(
+                        kind=e["kind"],
+                        block_hashes=tuple(e.get("block_hashes") or ()),
+                        parent_hash=e.get("parent_hash"),
+                        token_blocks=tuple(
+                            tuple(b) for b in e.get("token_blocks") or ()
+                        ),
+                    )
+                )
+        elif t == "metrics":
+            self._metrics = protocol.unpack(payload)
+        elif t == "embed_result":
+            fut = self._embeds.pop(header.get("id"), None)
+            if fut is not None and not fut.done():
+                if header.get("error"):
+                    fut.set_exception(RuntimeError(header["error"]))
+                else:
+                    fut.set_result(protocol.unpack(payload)["embeddings"])
+        elif t == "pong":
+            pass
+        else:
+            logger.debug("ignoring unknown frame type %r", t)
+
+    def _on_down(self, reason: str) -> None:
+        self._fail_inflight(f"engine subprocess died: {reason}")
+
+    def _fail_inflight(self, message: str) -> None:
+        streams, self._streams = dict(self._streams), {}
+        for q in streams.values():
+            q.put_nowait({"error": message, "engine_down": True})
+            q.put_nowait(None)
+        embeds, self._embeds = dict(self._embeds), {}
+        for fut in embeds.values():
+            if not fut.done():
+                fut.set_exception(EngineUnavailableError(message))
+
+    # -- AsyncEngine contract ----------------------------------------------
+
+    async def _admit(self) -> None:
+        sup = self.supervisor
+        if sup.state == "broken":
+            raise EngineUnavailableError(
+                f"external engine {self.name!r} is circuit-broken"
+            )
+        if not sup.ready and not await sup.wait_ready(self.admission_timeout):
+            raise EngineUnavailableError(
+                f"external engine {self.name!r} is down "
+                f"(state={sup.state})"
+            )
+
+    async def generate(
+        self, context: Context, request
+    ) -> AsyncIterator[dict]:
+        await self._admit()
+        self.requests_received += 1
+        rid = request.request_id
+        q: asyncio.Queue = asyncio.Queue()
+        self._streams[rid] = q
+        got_data = False
+        settled = False  # terminal frame seen / cancel already propagated
+        try:
+            try:
+                await self.supervisor.send(
+                    {"type": "generate", "id": rid},
+                    protocol.pack(request.to_dict()),
+                )
+            except ConnectionError as e:
+                settled = True  # never reached the child
+                raise EngineUnavailableError(str(e))
+            while True:
+                if context.cancelled:
+                    settled = True
+                    try:
+                        await self.supervisor.send(
+                            {"type": "cancel", "id": rid}
+                        )
+                    except Exception:
+                        pass  # child gone — nothing left to cancel
+                    return
+                item = await queue_get_or_cancelled(context, q)
+                if item is CANCELLED:
+                    continue  # loop re-checks context.cancelled
+                if item is None:
+                    settled = True
+                    return
+                if "error" in item:
+                    settled = True
+                    if item.get("engine_down") and not got_data:
+                        # nothing streamed yet: the request is safely
+                        # retryable on another instance
+                        raise EngineUnavailableError(item["error"])
+                    raise RuntimeError(item["error"])
+                got_data = True
+                yield item
+        finally:
+            self._streams.pop(rid, None)
+            if not settled:
+                # the CONSUMER abandoned the stream (client disconnect
+                # closed this generator mid-yield): tell the child, or it
+                # computes the whole request for nobody
+                try:
+                    await self.supervisor.send({"type": "cancel", "id": rid})
+                except Exception:
+                    pass
+
+    async def embed(self, prompts, normalize: bool = True):
+        if not self.capabilities.get("embed"):
+            raise RuntimeError(
+                f"external engine {self.name!r} does not serve embeddings"
+            )
+        await self._admit()
+        eid = f"embed-{next(self._embed_ids)}"
+        fut = asyncio.get_running_loop().create_future()
+        self._embeds[eid] = fut
+        try:
+            await self.supervisor.send(
+                {"type": "embed", "id": eid},
+                protocol.pack({"prompts": [list(p) for p in prompts],
+                               "normalize": bool(normalize)}),
+            )
+            return await asyncio.wait_for(fut, self.admission_timeout + 30.0)
+        finally:
+            self._embeds.pop(eid, None)
+
+    def metrics_dict(self) -> dict:
+        return {
+            "requests_received": self.requests_received,
+            **self._metrics,
+            **self.supervisor.metrics(),
+        }

@@ -1,0 +1,174 @@
+"""Versioned wire protocol between a Worker and a subprocess engine.
+
+Frames ride the fabric codec (`runtime/codec.py`: u32 header_len, u32
+payload_len, u64 xxh3(header), u64 xxh3(payload), msgpack header, raw
+payload) over the child's stdio pipes or a unix socket — same checksum
+discipline as every other cross-process plane in this repo, so a
+truncated or corrupted frame is a `CodecError`, never a silent
+misparse. Headers are JSON-shaped documents (string keys, scalar/list
+values); bulk bodies (the request dict, token items, KV event batches)
+ride the payload as msgpack.
+
+Handshake: the CHILD speaks first —
+
+  child  -> hello  {v, model, capabilities: {embed, kv_events}, card?}
+  parent -> ready  {v}            (or error + close on version mismatch)
+
+after which either side may send, full duplex:
+
+  parent -> generate {id} + payload msgpack(PreprocessedRequest.to_dict())
+  parent -> cancel   {id}         (context.cancelled propagation)
+  parent -> embed    {id} + payload msgpack({prompts})
+  parent -> ping     {n}
+  parent -> shutdown {}           (graceful drain request)
+
+  child  -> token    {id} + payload msgpack(stream item dict)
+  child  -> finish   {id, finish_reason?, cancelled}   (terminal)
+  child  -> error    {id?, message}   (request-terminal with id;
+                                       process-fatal without)
+  child  -> embed_result {id} + payload msgpack({embeddings})
+  child  -> kv_event {} + payload msgpack([{kind, block_hashes,
+                          parent_hash, token_blocks}, ...])  — the exact
+                          dict shape worker.py publishes on the bus,
+                          wire-compatible with engine/page_table.KvEvent
+                          and native/kv_events.cpp
+  child  -> metrics  {} + payload msgpack(load snapshot dict)
+  child  -> pong     {n}
+
+Unknown frame types are ignored by both sides (forward compatibility);
+a `hello` whose `v` differs from PROTOCOL_VERSION is refused at
+handshake — the ONLY version gate, so a fleet can mix shim builds until
+an actual frame-vocabulary break bumps the number.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import sys
+from typing import Any, Optional
+
+import msgpack
+
+from dynamo_tpu.runtime.codec import CodecError, encode_frame, read_frame
+
+__all__ = [
+    "PROTOCOL_VERSION",
+    "UDS_ENV",
+    "CodecError",
+    "ProtocolError",
+    "VersionMismatch",
+    "hello_frame",
+    "ready_frame",
+    "check_hello",
+    "check_ready",
+    "pack",
+    "unpack",
+    "read_frame",
+    "encode_frame",
+    "stdio_streams",
+    "child_streams",
+]
+
+PROTOCOL_VERSION = 1
+
+#: env var naming the unix socket the child should connect to instead of
+#: speaking on stdio (set by the supervisor in transport="uds" mode)
+UDS_ENV = "DYNAMO_EXT_UDS"
+
+
+class ProtocolError(Exception):
+    """Frame that violates the protocol (bad handshake, missing fields)."""
+
+
+class VersionMismatch(ProtocolError):
+    """Handshake refused: peer speaks a different protocol version."""
+
+
+def pack(obj: Any) -> bytes:
+    return msgpack.packb(obj, use_bin_type=True)
+
+
+def unpack(payload: bytes) -> Any:
+    return msgpack.unpackb(payload, raw=False)
+
+
+def hello_frame(
+    model: str,
+    capabilities: Optional[dict] = None,
+    card: Optional[dict] = None,
+) -> dict:
+    h = {
+        "type": "hello",
+        "v": PROTOCOL_VERSION,
+        "model": model,
+        "capabilities": dict(capabilities or {}),
+    }
+    if card:
+        h["card"] = card
+    return h
+
+
+def ready_frame() -> dict:
+    return {"type": "ready", "v": PROTOCOL_VERSION}
+
+
+def check_hello(header: Any) -> dict:
+    """Validate the child's opening frame; returns it. Raises
+    VersionMismatch / ProtocolError for the supervisor to refuse."""
+    if not isinstance(header, dict) or header.get("type") != "hello":
+        raise ProtocolError(
+            f"expected hello frame, got {header!r:.200}"
+        )
+    v = header.get("v")
+    if v != PROTOCOL_VERSION:
+        raise VersionMismatch(
+            f"engine speaks protocol v{v}, this runtime speaks "
+            f"v{PROTOCOL_VERSION}"
+        )
+    return header
+
+
+def check_ready(header: Any) -> dict:
+    """Child-side validation of the supervisor's ready frame."""
+    if not isinstance(header, dict) or header.get("type") != "ready":
+        raise ProtocolError(
+            f"expected ready frame, got {header!r:.200}"
+        )
+    v = header.get("v")
+    if v != PROTOCOL_VERSION:
+        raise VersionMismatch(
+            f"supervisor speaks protocol v{v}, this shim speaks "
+            f"v{PROTOCOL_VERSION}"
+        )
+    return header
+
+
+# -- transports -------------------------------------------------------------
+
+
+async def stdio_streams() -> tuple[asyncio.StreamReader, asyncio.StreamWriter]:
+    """(reader, writer) over THIS process's stdin/stdout — the child side
+    of the stdio transport. stdout becomes the wire: anything else the
+    engine wants to say must go to stderr (the supervisor forwards it
+    into the logging plane)."""
+    loop = asyncio.get_running_loop()
+    reader = asyncio.StreamReader()
+    await loop.connect_read_pipe(
+        lambda: asyncio.StreamReaderProtocol(reader), sys.stdin.buffer
+    )
+    w_transport, w_protocol = await loop.connect_write_pipe(
+        lambda: asyncio.streams.FlowControlMixin(),
+        os.fdopen(os.dup(sys.stdout.fileno()), "wb"),
+    )
+    writer = asyncio.StreamWriter(w_transport, w_protocol, reader, loop)
+    return reader, writer
+
+
+async def child_streams() -> tuple[asyncio.StreamReader, asyncio.StreamWriter]:
+    """Child-side transport resolution: unix socket if the supervisor
+    exported UDS_ENV, else stdio."""
+    path = os.environ.get(UDS_ENV)
+    if path:
+        return await asyncio.open_unix_connection(path)
+    return await stdio_streams()

@@ -1,0 +1,157 @@
+"""Self-contained reference engine for the subprocess harness.
+
+Torch-free and deterministic, so tier-1 CPU tests (and bench.py's wire
+overhead A/B) can spawn a REAL foreign process without model weights:
+the "sampler" emits the prompt tokens cyclically (EchoCore semantics,
+engines.rs) while honoring max_tokens, stop ids, ignore_eos, and
+cancellation, and it emits real KV stored-events (chained block hashes
+over the prompt, tokens/blocks.py) so KV-aware routers prefix-route to
+it exactly as to a native worker.
+
+Run under a supervisor:
+
+  dynamo-tpu run in=http 'out=ext:python -m dynamo_tpu.external.reference_worker'
+
+Fault-injection knobs for the FT suite:
+  --delay S        seconds per emitted token (mid-stream kill windows)
+  --fail-after N   hard-exit (os._exit 13) after N tokens total
+  --hello-version V  claim protocol version V in hello (handshake tests)
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import sys
+
+from dynamo_tpu.engine.page_table import KvEvent
+from dynamo_tpu.preprocessor.preprocessor import PreprocessedRequest
+from dynamo_tpu.tokens.blocks import TokenBlockSequence
+
+
+class ReferenceEngine:
+    """Deterministic echo engine with KV stored-events and fault knobs."""
+
+    def __init__(
+        self,
+        block_size: int = 16,
+        salt: str = "",
+        delay: float = 0.0,
+        fail_after: int = 0,
+    ):
+        self.block_size = block_size
+        self.salt = salt
+        self.delay = delay
+        self.fail_after = fail_after
+        self.on_kv_event = None  # set by the shim / Worker
+        self.requests_received = 0
+        self.active = 0
+        self.tokens_emitted = 0
+
+    def metrics_dict(self) -> dict:
+        return {
+            "num_waiting": 0,
+            "num_running": self.active,
+            "requests_received": self.requests_received,
+            "generated_tokens": self.tokens_emitted,
+        }
+
+    def _emit_stored(self, token_ids) -> None:
+        if self.on_kv_event is None:
+            return
+        blocks = TokenBlockSequence(
+            tuple(int(t) for t in token_ids),
+            block_size=self.block_size, salt=self.salt,
+        ).blocks
+        if not blocks:
+            return
+        self.on_kv_event(
+            KvEvent(
+                kind="stored",
+                block_hashes=tuple(b.sequence_hash for b in blocks),
+                parent_hash=None,
+                token_blocks=tuple(tuple(b.tokens) for b in blocks),
+            )
+        )
+
+    async def generate(self, context, request: PreprocessedRequest):
+        self.requests_received += 1
+        self.active += 1
+        try:
+            prompt = list(request.token_ids) or [0]
+            # stored-events go out BEFORE decoding so routers can already
+            # prefix-match this worker while the stream runs
+            self._emit_stored(prompt)
+            stop_ids = (
+                set() if request.ignore_eos else set(request.stop_token_ids)
+            )
+            for i in range(request.max_tokens):
+                if context.cancelled:
+                    return
+                if self.delay:
+                    await asyncio.sleep(self.delay)
+                tok = prompt[i % len(prompt)]
+                self.tokens_emitted += 1
+                if self.fail_after and self.tokens_emitted >= self.fail_after:
+                    import os
+
+                    sys.stderr.write("reference_worker: injected crash\n")
+                    sys.stderr.flush()
+                    os._exit(13)
+                if tok in stop_ids:
+                    yield {"token_ids": [tok], "finish_reason": "stop"}
+                    return
+                yield {
+                    "token_ids": [tok],
+                    "finish_reason": (
+                        "length" if i == request.max_tokens - 1 else None
+                    ),
+                }
+        finally:
+            self.active -= 1
+
+    async def embed(self, prompts, normalize: bool = True):
+        from dynamo_tpu.engine.async_engine import fake_embedding
+
+        import numpy as np
+
+        return np.stack([fake_embedding(p) for p in prompts])
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--model", default="ext-reference")
+    p.add_argument("--block-size", type=int, default=16, dest="block_size")
+    p.add_argument("--salt", default=None,
+                   help="KV block hash salt (default: the model name)")
+    p.add_argument("--delay", type=float, default=0.0,
+                   help="seconds per emitted token")
+    p.add_argument("--fail-after", type=int, default=0, dest="fail_after",
+                   help="hard-exit after N tokens total (fault injection)")
+    p.add_argument("--hello-version", type=int, default=None,
+                   dest="hello_version",
+                   help="claim this protocol version (handshake tests)")
+    p.add_argument("--metrics-interval", type=float, default=0.5,
+                   dest="metrics_interval")
+    args = p.parse_args(argv)
+
+    if args.hello_version is not None:
+        from dynamo_tpu.external import protocol
+
+        protocol.PROTOCOL_VERSION = args.hello_version
+
+    from dynamo_tpu.external.shim import run_engine
+
+    engine = ReferenceEngine(
+        block_size=args.block_size,
+        salt=args.salt if args.salt is not None else args.model,
+        delay=args.delay,
+        fail_after=args.fail_after,
+    )
+    run_engine(
+        engine, model=args.model, metrics_interval=args.metrics_interval
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,258 @@
+"""The library a foreign engine imports to speak the wire protocol.
+
+A shim process wraps any object satisfying the Level-1 AsyncEngine
+contract (docs/external_engines.md): `generate(context,
+PreprocessedRequest) -> async iterator of {"token_ids": [...],
+"finish_reason": ...}`, optional `embed`, optional `metrics_dict`,
+optional assignable `on_kv_event`. `run_engine(engine, model=...)` does
+the rest: transport resolution (stdio, or the unix socket named in
+$DYNAMO_EXT_UDS), hello/ready handshake with version refusal,
+concurrent request serving with cancel propagation, KV-event and
+metrics upstreaming, ping/pong, and graceful drain on `shutdown`.
+
+Mirrors the reference's engine-side shims
+(launch/dynamo-run/src/subprocess/vllm_inc.py sglang_inc.py): ~40 lines
+of engine-specific code joins the runtime; everything else is here.
+
+IMPORTANT: in stdio mode stdout IS the wire. The shim assumes nothing
+else writes to it — print() diagnostics must go to stderr (the
+supervisor forwards stderr into the serving process's log plane).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import sys
+from typing import Any, Optional
+
+from dynamo_tpu.external import protocol
+from dynamo_tpu.preprocessor.preprocessor import PreprocessedRequest
+from dynamo_tpu.runtime.context import Context
+
+logger = logging.getLogger(__name__)
+
+
+class EngineShim:
+    def __init__(
+        self,
+        engine,
+        model: str = "external",
+        card: Optional[dict] = None,
+        metrics_interval: float = 1.0,
+        kv_flush_interval: float = 0.2,
+    ):
+        self.engine = engine
+        self.model = model
+        self.card = card
+        self.metrics_interval = metrics_interval
+        self.kv_flush_interval = kv_flush_interval
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._write_lock = asyncio.Lock()
+        self._contexts: dict[str, Context] = {}
+        self._tasks: set[asyncio.Task] = set()
+        self._kv_buffer: list[dict] = []
+
+    # -- capabilities ------------------------------------------------------
+
+    def _capabilities(self) -> dict:
+        return {
+            "embed": hasattr(self.engine, "embed"),
+            "kv_events": hasattr(self.engine, "on_kv_event"),
+        }
+
+    def _buffer_kv(self, event) -> None:
+        """KvEvent (or an equivalent duck) -> the wire dict shape the
+        worker's publish loop uses on the bus."""
+        self._kv_buffer.append(
+            {
+                "kind": event.kind,
+                "block_hashes": list(event.block_hashes),
+                "parent_hash": event.parent_hash,
+                "token_blocks": [list(t) for t in event.token_blocks],
+            }
+        )
+
+    # -- serving -----------------------------------------------------------
+
+    async def send(self, header: Any, payload: bytes = b"") -> None:
+        async with self._write_lock:
+            self._writer.write(protocol.encode_frame(header, payload))
+            await self._writer.drain()
+
+    async def serve(self) -> None:
+        reader, self._writer = await protocol.child_streams()
+        await self.send(
+            protocol.hello_frame(
+                self.model, self._capabilities(), card=self.card
+            )
+        )
+        header, _ = await asyncio.wait_for(protocol.read_frame(reader), 30.0)
+        protocol.check_ready(header)  # VersionMismatch propagates -> exit
+        if hasattr(self.engine, "on_kv_event"):
+            self.engine.on_kv_event = self._buffer_kv
+        pumps = [
+            asyncio.get_running_loop().create_task(self._metrics_loop()),
+            asyncio.get_running_loop().create_task(self._kv_flush_loop()),
+        ]
+        try:
+            while True:
+                try:
+                    header, payload = await protocol.read_frame(reader)
+                except (asyncio.IncompleteReadError, ConnectionError):
+                    return  # supervisor gone: exit with it
+                t = header.get("type")
+                if t == "generate":
+                    self._spawn_generate(header["id"], payload)
+                elif t == "cancel":
+                    ctx = self._contexts.get(header.get("id"))
+                    if ctx is not None:
+                        ctx.cancel()
+                elif t == "embed":
+                    self._spawn_embed(header["id"], payload)
+                elif t == "ping":
+                    await self.send({"type": "pong", "n": header.get("n")})
+                elif t == "shutdown":
+                    await self._drain()
+                    return
+                else:
+                    logger.debug("ignoring unknown frame type %r", t)
+        finally:
+            for p in pumps:
+                p.cancel()
+            await self._flush_kv()
+
+    def _spawn_generate(self, rid: str, payload: bytes) -> None:
+        ctx = Context(request_id=rid)
+        self._contexts[rid] = ctx
+        t = asyncio.get_running_loop().create_task(
+            self._serve_generate(ctx, rid, payload)
+        )
+        self._tasks.add(t)
+        t.add_done_callback(self._tasks.discard)
+
+    async def _serve_generate(
+        self, ctx: Context, rid: str, payload: bytes
+    ) -> None:
+        try:
+            request = PreprocessedRequest.from_dict(protocol.unpack(payload))
+            finish = None
+            async for item in self.engine.generate(ctx, request):
+                if "error" in item:
+                    await self.send(
+                        {"type": "error", "id": rid,
+                         "message": str(item["error"])}
+                    )
+                    return
+                finish = item.get("finish_reason")
+                await self.send(
+                    {"type": "token", "id": rid}, protocol.pack(item)
+                )
+            await self.send(
+                {
+                    "type": "finish", "id": rid, "finish_reason": finish,
+                    "cancelled": ctx.cancelled,
+                }
+            )
+        except ConnectionError:
+            pass  # parent gone — nobody left to tell
+        except Exception as e:  # noqa: BLE001 — stream errors to the parent
+            logger.exception("generate failed for %s", rid)
+            await self._send_error(rid, e)
+        finally:
+            self._contexts.pop(rid, None)
+
+    async def _send_error(self, rid: str, e: Exception) -> None:
+        try:
+            await self.send(
+                {"type": "error", "id": rid,
+                 "message": f"{type(e).__name__}: {e}"}
+            )
+        except Exception:
+            pass
+
+    def _spawn_embed(self, eid: str, payload: bytes) -> None:
+        async def _run():
+            try:
+                req = protocol.unpack(payload)
+                vecs = await self.engine.embed(
+                    req["prompts"], normalize=req.get("normalize", True)
+                )
+                await self.send(
+                    {"type": "embed_result", "id": eid},
+                    protocol.pack(
+                        {"embeddings": [[float(x) for x in v] for v in vecs]}
+                    ),
+                )
+            except Exception as e:  # noqa: BLE001
+                await self.send(
+                    {"type": "embed_result", "id": eid,
+                     "error": f"{type(e).__name__}: {e}"}
+                )
+
+        t = asyncio.get_running_loop().create_task(_run())
+        self._tasks.add(t)
+        t.add_done_callback(self._tasks.discard)
+
+    async def _drain(self, timeout: float = 4.0) -> None:
+        """shutdown frame: let in-flight generations finish briefly, then
+        cancel what's left. Cancelled streams send no finish frame — the
+        parent's stop() already error-finishes its in-flight requests, so
+        the child's only job here is to stop cleanly and flush KV."""
+        if self._tasks:
+            done, pending = await asyncio.wait(
+                set(self._tasks), timeout=timeout
+            )
+            for t in pending:
+                t.cancel()
+            if pending:
+                await asyncio.gather(*pending, return_exceptions=True)
+        await self._flush_kv()
+
+    # -- upstream pumps ----------------------------------------------------
+
+    async def _metrics_loop(self) -> None:
+        if not hasattr(self.engine, "metrics_dict"):
+            return
+        while True:
+            await asyncio.sleep(self.metrics_interval)
+            try:
+                await self.send(
+                    {"type": "metrics"},
+                    protocol.pack(dict(self.engine.metrics_dict())),
+                )
+            except (ConnectionError, RuntimeError):
+                return
+
+    async def _kv_flush_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.kv_flush_interval)
+            try:
+                await self._flush_kv()
+            except (ConnectionError, RuntimeError):
+                return
+
+    async def _flush_kv(self) -> None:
+        events = self._kv_buffer[: len(self._kv_buffer)]
+        del self._kv_buffer[: len(events)]
+        if events:
+            await self.send({"type": "kv_event"}, protocol.pack(events))
+
+
+def run_engine(
+    engine,
+    model: str = "external",
+    card: Optional[dict] = None,
+    metrics_interval: float = 1.0,
+) -> None:
+    """Blocking entry: serve `engine` on this process's wire until the
+    supervisor shuts us down. Exits 2 on a protocol-version refusal."""
+    logging.basicConfig(level=logging.INFO, stream=sys.stderr)
+    shim = EngineShim(
+        engine, model=model, card=card, metrics_interval=metrics_interval
+    )
+    try:
+        asyncio.run(shim.serve())
+    except protocol.VersionMismatch as e:
+        print(f"refusing to serve: {e}", file=sys.stderr, flush=True)
+        sys.exit(2)

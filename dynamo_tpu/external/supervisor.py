@@ -1,0 +1,492 @@
+"""Subprocess engine lifecycle: spawn, handshake, heartbeat, restarts.
+
+The supervisor owns exactly one child process at a time and the policy
+around it (the role circus/the arbiter plays for the reference's local
+serving, sdk cli/serving.py, plus the per-engine drain handlers in its
+subprocess shims):
+
+- spawn + `hello`/`ready` handshake with a timeout — a child that never
+  says hello (or says it in the wrong protocol version) is killed and
+  counted as a failed start;
+- heartbeat: pings on an interval; a child that goes silent past the
+  timeout is killed (the restart path takes it from there);
+- restart with exponential backoff and a max-consecutive-failures
+  circuit breaker — a crash-looping engine ends in state "broken"
+  instead of burning CPU forever. A child that stays ready for
+  `stable_after` seconds resets the failure streak, so a once-a-day
+  crash never trips the breaker;
+- graceful drain on stop(): `shutdown` frame, a grace period, then
+  SIGTERM/SIGKILL;
+- stderr capture: every child stderr line lands in this process's
+  logging plane (JSONL-ready via logging_config) under the child's
+  name, so foreign-engine tracebacks are never lost to the void.
+
+The supervisor knows frames only as (header, payload) — routing them to
+request streams is the client's job (client.py) via `on_frame`; process
+death is reported via `on_down` so in-flight requests get error
+finishes instead of dropped streams.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import tempfile
+from dataclasses import dataclass, field
+from typing import Any, Awaitable, Callable, Optional
+
+from dynamo_tpu.external import protocol
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class SupervisorConfig:
+    #: seconds the child has to complete the hello/ready handshake
+    ready_timeout: float = 30.0
+    #: ping cadence; 0 disables heartbeating
+    heartbeat_interval: float = 2.0
+    #: no frame of ANY kind for this long after readiness => kill+restart
+    heartbeat_timeout: float = 15.0
+    backoff_initial: float = 0.2
+    backoff_max: float = 5.0
+    backoff_factor: float = 2.0
+    #: consecutive failed starts/crashes before the circuit opens
+    max_restarts: int = 5
+    #: a child ready this long resets the consecutive-failure streak
+    stable_after: float = 10.0
+    #: graceful-stop grace period after the shutdown frame
+    drain_timeout: float = 5.0
+    #: "stdio" (frames on the child's stdin/stdout) or "uds" (frames on a
+    #: unix socket named in $DYNAMO_EXT_UDS; the child's stdout joins
+    #: stderr in the log plane)
+    transport: str = "stdio"
+    env: dict = field(default_factory=dict)
+
+
+class EngineSupervisor:
+    """One supervised subprocess speaking external/protocol.py."""
+
+    def __init__(
+        self,
+        cmd: list[str],
+        name: str = "ext",
+        config: Optional[SupervisorConfig] = None,
+        on_frame: Optional[Callable[[Any, bytes], None]] = None,
+        on_down: Optional[Callable[[str], None]] = None,
+    ):
+        if not cmd:
+            raise ValueError("empty external engine command")
+        self.cmd = list(cmd)
+        self.name = name
+        self.config = config or SupervisorConfig()
+        if self.config.transport not in ("stdio", "uds"):
+            raise ValueError(f"unknown transport {self.config.transport!r}")
+        #: (header, payload) for every post-handshake child frame
+        self.on_frame = on_frame
+        #: called with a reason string each time the child dies/restarts
+        self.on_down = on_down
+        self.hello: Optional[dict] = None
+        self.state = "idle"  # starting | ready | backoff | broken | stopped
+        self.spawns_total = 0
+        self.restarts_total = 0
+        self.consecutive_failures = 0
+        self.last_exit: Optional[int] = None
+        self.proc: Optional[asyncio.subprocess.Process] = None
+        self._writer: Optional[asyncio.StreamWriter] = None
+        self._reader: Optional[asyncio.StreamReader] = None
+        self._ready = asyncio.Event()
+        self._broken = asyncio.Event()  # terminal: circuit open / version skew
+        self._stopping = False
+        self._run_task: Optional[asyncio.Task] = None
+        self._side_tasks: list[asyncio.Task] = []
+        self._log_tasks: list[asyncio.Task] = []
+        self._send_lock = asyncio.Lock()
+        self._last_rx = 0.0
+        self._uds_dir: Optional[tempfile.TemporaryDirectory] = None
+        self._uds_server: Optional[asyncio.AbstractServer] = None
+        self._uds_accepted: Optional[asyncio.Future] = None
+
+    # -- public api --------------------------------------------------------
+
+    async def start(self) -> None:
+        self._stopping = False
+        self._run_task = asyncio.get_running_loop().create_task(
+            self._run(), name=f"supervise-{self.name}"
+        )
+
+    async def wait_ready(self, timeout: float) -> bool:
+        """True once ready; False on timeout OR as soon as the engine is
+        permanently down (circuit open / version mismatch) — waiters must
+        not sit out the full timeout for an engine that will never come."""
+        r = asyncio.ensure_future(self._ready.wait())
+        b = asyncio.ensure_future(self._broken.wait())
+        try:
+            await asyncio.wait(
+                {r, b}, timeout=timeout,
+                return_when=asyncio.FIRST_COMPLETED,
+            )
+        finally:
+            r.cancel()
+            b.cancel()
+        return self._ready.is_set()
+
+    @property
+    def ready(self) -> bool:
+        return self._ready.is_set()
+
+    async def send(self, header: Any, payload: bytes = b"") -> None:
+        """Write one frame to the child. Raises ConnectionError if the
+        child is not up — callers decide whether that's retryable."""
+        writer = self._writer
+        if writer is None or writer.is_closing():
+            raise ConnectionError(f"engine {self.name!r} is not connected")
+        async with self._send_lock:
+            writer.write(protocol.encode_frame(header, payload))
+            await writer.drain()
+
+    async def stop(self) -> None:
+        """Graceful drain: shutdown frame, grace period, then escalate."""
+        self._stopping = True
+        self.state = "stopped"
+        proc = self.proc
+        if proc is not None and proc.returncode is None:
+            try:
+                await self.send({"type": "shutdown"})
+            except Exception:
+                pass
+            try:
+                await asyncio.wait_for(proc.wait(), self.config.drain_timeout)
+            except asyncio.TimeoutError:
+                logger.warning(
+                    "engine %s did not drain in %.1fs; terminating",
+                    self.name, self.config.drain_timeout,
+                )
+                self._terminate(proc)
+                try:
+                    await asyncio.wait_for(proc.wait(), 3.0)
+                except asyncio.TimeoutError:
+                    proc.kill()
+                    await proc.wait()
+        if self._run_task is not None:
+            self._run_task.cancel()
+            try:
+                await self._run_task
+            except (asyncio.CancelledError, Exception):
+                pass
+        self._cancel_side_tasks()
+        if self._log_tasks:
+            await asyncio.gather(*self._log_tasks, return_exceptions=True)
+        self._close_uds()
+        self._ready.clear()
+
+    def kill(self) -> None:
+        """Hard-kill the current child (tests / heartbeat): the run loop
+        observes the death and applies restart policy."""
+        proc = self.proc
+        if proc is not None and proc.returncode is None:
+            proc.kill()
+
+    def metrics(self) -> dict:
+        return {
+            "ext_spawns_total": self.spawns_total,
+            "ext_restarts_total": self.restarts_total,
+            "ext_consecutive_failures": self.consecutive_failures,
+            "ext_ready": int(self.ready),
+            "ext_broken": int(self.state == "broken"),
+        }
+
+    # -- run loop ----------------------------------------------------------
+
+    async def _run(self) -> None:
+        cfg = self.config
+        loop = asyncio.get_running_loop()
+        while not self._stopping:
+            if self.consecutive_failures > cfg.max_restarts:
+                self.state = "broken"
+                self._broken.set()
+                logger.error(
+                    "engine %s circuit open after %d consecutive failures",
+                    self.name, self.consecutive_failures - 1,
+                )
+                if self.on_down:
+                    self.on_down("circuit open")
+                return
+            if self.consecutive_failures:
+                self.state = "backoff"
+                delay = min(
+                    cfg.backoff_initial
+                    * cfg.backoff_factor ** (self.consecutive_failures - 1),
+                    cfg.backoff_max,
+                )
+                logger.info(
+                    "engine %s restart %d in %.2fs",
+                    self.name, self.consecutive_failures, delay,
+                )
+                await asyncio.sleep(delay)
+            self.state = "starting"
+            ready_at: Optional[float] = None
+            try:
+                await self._spawn()
+                await self._handshake()
+                ready_at = loop.time()
+                self._last_rx = ready_at
+                self.state = "ready"
+                self._ready.set()
+                if self.spawns_total > 1:
+                    self.restarts_total += 1
+                self._start_side_task(self._heartbeat())
+                await self._pump()
+                reason = "wire closed"
+            except protocol.VersionMismatch as e:
+                # a wrong-version engine will NEVER become right by
+                # restarting — refuse permanently
+                logger.error("engine %s refused at handshake: %s", self.name, e)
+                await self._reap()
+                self.state = "broken"
+                self._broken.set()
+                if self.on_down:
+                    self.on_down(str(e))
+                return
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                reason = f"{type(e).__name__}: {e}"
+            finally:
+                self._ready.clear()
+                self._cancel_side_tasks()
+            await self._reap()
+            if reason == "wire closed":
+                reason = f"exited with {self.last_exit}"
+            if self._stopping:
+                return
+            stable = (
+                ready_at is not None
+                and loop.time() - ready_at >= cfg.stable_after
+            )
+            self.consecutive_failures = 1 if stable else (
+                self.consecutive_failures + 1
+            )
+            logger.warning("engine %s down: %s", self.name, reason)
+            if self.on_down:
+                self.on_down(reason)
+
+    async def _spawn(self) -> None:
+        cfg = self.config
+        env = dict(os.environ, **cfg.env)
+        stdout = asyncio.subprocess.PIPE
+        if cfg.transport == "uds":
+            self._open_uds()
+            env[protocol.UDS_ENV] = self._uds_path
+        self.spawns_total += 1
+        self.proc = await asyncio.create_subprocess_exec(
+            *self.cmd,
+            stdin=asyncio.subprocess.PIPE,
+            stdout=stdout,
+            stderr=asyncio.subprocess.PIPE,
+            env=env,
+        )
+        self._start_log_task(self._pump_logs(self.proc.stderr, "stderr"))
+        if cfg.transport == "stdio":
+            self._reader = self.proc.stdout
+            self._writer = self.proc.stdin
+        else:
+            # stdout is plain output in uds mode — log it like stderr
+            self._start_log_task(self._pump_logs(self.proc.stdout, "stdout"))
+            try:
+                reader, writer = await asyncio.wait_for(
+                    asyncio.shield(self._uds_accepted), cfg.ready_timeout
+                )
+            except asyncio.TimeoutError:
+                raise TimeoutError(
+                    f"engine {self.name!r} never connected to the unix "
+                    f"socket within {cfg.ready_timeout}s"
+                )
+            self._reader, self._writer = reader, writer
+
+    async def _handshake(self) -> None:
+        try:
+            header, _ = await asyncio.wait_for(
+                protocol.read_frame(self._reader), self.config.ready_timeout
+            )
+        except asyncio.TimeoutError:
+            raise TimeoutError(
+                f"engine {self.name!r} sent no hello within "
+                f"{self.config.ready_timeout}s"
+            )
+        except asyncio.IncompleteReadError:
+            raise ConnectionError(
+                f"engine {self.name!r} closed the wire before hello"
+            )
+        self.hello = protocol.check_hello(header)
+        await self.send(protocol.ready_frame())
+        logger.info(
+            "engine %s ready: model=%s capabilities=%s",
+            self.name, self.hello.get("model"),
+            self.hello.get("capabilities"),
+        )
+
+    async def _pump(self) -> None:
+        loop = asyncio.get_running_loop()
+        while True:
+            try:
+                header, payload = await protocol.read_frame(self._reader)
+            except (asyncio.IncompleteReadError, ConnectionError):
+                return
+            except protocol.CodecError as e:
+                # a corrupt frame means the stream is unrecoverable (we
+                # cannot re-synchronize a length-prefixed wire) — kill and
+                # let restart policy take over
+                logger.error(
+                    "engine %s wire corrupted (%s); killing", self.name, e
+                )
+                self.kill()
+                return
+            self._last_rx = loop.time()
+            if self.on_frame is not None:
+                try:
+                    self.on_frame(header, payload)
+                except Exception:
+                    logger.exception(
+                        "frame handler failed for %s frame",
+                        header.get("type") if isinstance(header, dict)
+                        else type(header),
+                    )
+
+    async def _heartbeat(self) -> None:
+        cfg = self.config
+        if cfg.heartbeat_interval <= 0:
+            return
+        loop = asyncio.get_running_loop()
+        #: send time of the oldest PING no frame has arrived after — the
+        #: liveness question is "did the child answer our ping", never
+        #: "how long since the last frame": the latter misfires when the
+        #: PARENT loop stalls (a blocking import/compile elsewhere in the
+        #: serving process) and reads a healthy child's frames late.
+        outstanding: Optional[float] = None
+        n = 0
+        while True:
+            t0 = loop.time()
+            await asyncio.sleep(cfg.heartbeat_interval)
+            now = loop.time()
+            if now - t0 > cfg.heartbeat_interval * 2:
+                # parent stall: answered frames may still sit unread in
+                # the pump's backlog — drop the outstanding ping and
+                # re-probe instead of blaming the child
+                outstanding = None
+                continue
+            if outstanding is not None and self._last_rx >= outstanding:
+                outstanding = None  # answered (any frame counts)
+            if (
+                outstanding is not None
+                and now - outstanding > cfg.heartbeat_timeout
+            ):
+                logger.warning(
+                    "engine %s unresponsive %.1fs after ping; killing "
+                    "for restart", self.name, now - outstanding,
+                )
+                self.kill()
+                return
+            if outstanding is None:
+                outstanding = loop.time()
+                n += 1
+                try:
+                    await self.send({"type": "ping", "n": n})
+                except Exception:
+                    return  # writer gone; the pump/run loop handles it
+
+    async def _pump_logs(self, stream, channel: str) -> None:
+        """Child stderr/stdout lines -> this process's logging plane."""
+        if stream is None:
+            return
+        log = logging.getLogger(f"external.{self.name}")
+        while True:
+            try:
+                line = await stream.readline()
+            except (ValueError, ConnectionError):
+                return  # line longer than the stream limit / pipe gone
+            if not line:
+                return
+            log.info(
+                "%s", line.decode(errors="replace").rstrip(),
+                extra={"child": self.name, "channel": channel},
+            )
+
+    # -- helpers -----------------------------------------------------------
+
+    def _start_side_task(self, coro: Awaitable) -> None:
+        t = asyncio.get_running_loop().create_task(coro)
+        self._side_tasks.append(t)
+        t.add_done_callback(
+            lambda t: self._side_tasks.remove(t)
+            if t in self._side_tasks else None
+        )
+
+    def _start_log_task(self, coro: Awaitable) -> None:
+        # log pumps are NOT cancelled with the side tasks: they must be
+        # left to drain the child's final stderr lines (the crash
+        # traceback) after death; they end on pipe EOF
+        t = asyncio.get_running_loop().create_task(coro)
+        self._log_tasks.append(t)
+        t.add_done_callback(
+            lambda t: self._log_tasks.remove(t)
+            if t in self._log_tasks else None
+        )
+
+    def _cancel_side_tasks(self) -> None:
+        for t in list(self._side_tasks):
+            t.cancel()
+
+    def _terminate(self, proc) -> None:
+        try:
+            proc.terminate()
+        except ProcessLookupError:
+            pass
+
+    async def _reap(self) -> None:
+        proc = self.proc
+        if proc is None:
+            return
+        if proc.returncode is None:
+            self._terminate(proc)
+            try:
+                await asyncio.wait_for(proc.wait(), 3.0)
+            except asyncio.TimeoutError:
+                proc.kill()
+                await proc.wait()
+        self.last_exit = proc.returncode
+        if self._writer is not None:
+            try:
+                self._writer.close()
+            except Exception:
+                pass
+        self._writer = None
+        self._reader = None
+
+    def _open_uds(self) -> None:
+        self._close_uds()
+        self._uds_dir = tempfile.TemporaryDirectory(prefix="dyn-ext-")
+        self._uds_path = os.path.join(self._uds_dir.name, "engine.sock")
+        self._uds_accepted = asyncio.get_running_loop().create_future()
+
+        async def _serve():
+            def on_conn(reader, writer):
+                if not self._uds_accepted.done():
+                    self._uds_accepted.set_result((reader, writer))
+                else:
+                    writer.close()
+
+            self._uds_server = await asyncio.start_unix_server(
+                on_conn, self._uds_path
+            )
+
+        self._start_side_task(_serve())
+
+    def _close_uds(self) -> None:
+        if self._uds_server is not None:
+            self._uds_server.close()
+            self._uds_server = None
+        if self._uds_dir is not None:
+            self._uds_dir.cleanup()
+            self._uds_dir = None

@@ -66,6 +66,13 @@ _WORKER_FIELDS = (
     ("overlap_dispatches", "counter"),
     ("overlap_hits", "counter"),
     ("overlap_rollbacks", "counter"),
+    # subprocess external-engine harness (absent on native workers):
+    # supervisor lifecycle for foreign engines (docs/external_engines.md
+    # "Level 2") — restarts climbing or ready=0 is a crash-looping child
+    ("ext_ready", "gauge"),
+    ("ext_broken", "gauge"),
+    ("ext_restarts_total", "counter"),
+    ("ext_consecutive_failures", "gauge"),
 )
 
 
@@ -76,6 +83,7 @@ class MetricsService:
         component: str = "backend",
         host: str = "127.0.0.1",
         port: int = 9091,
+        fabric_stats_interval: float = 2.0,
     ):
         self.fabric = fabric
         self.component = component
@@ -86,8 +94,13 @@ class MetricsService:
         self.hit_events = 0
         self.isl_tokens_total = 0
         self.overlap_tokens_total = 0
+        #: latest broker self-metrics snapshot (fabric `stats` op) —
+        #: empty when the fabric backend doesn't expose stats
+        self.fabric_stats: dict = {}
+        self.fabric_stats_interval = fabric_stats_interval
         self._sub = None
         self._task: Optional[asyncio.Task] = None
+        self._stats_task: Optional[asyncio.Task] = None
         self._runner: Optional[web.AppRunner] = None
 
     # -- lifecycle ---------------------------------------------------------
@@ -96,6 +109,10 @@ class MetricsService:
         await self.aggregator.start()
         self._sub = await self.fabric.subscribe(KV_HIT_RATE_SUBJECT)
         self._task = asyncio.get_running_loop().create_task(self._pump())
+        if hasattr(self.fabric, "stats"):
+            self._stats_task = asyncio.get_running_loop().create_task(
+                self._poll_fabric_stats()
+            )
         app = web.Application()
         app.router.add_get("/metrics", self._metrics)
         app.router.add_get("/health", self._health)
@@ -111,6 +128,8 @@ class MetricsService:
             self._sub.close()
         if self._task is not None:
             self._task.cancel()
+        if self._stats_task is not None:
+            self._stats_task.cancel()
         await self.aggregator.stop()
         if self._runner is not None:
             await self._runner.cleanup()
@@ -133,7 +152,41 @@ class MetricsService:
             self.isl_tokens_total += isl
             self.overlap_tokens_total += overlap
 
+    async def _poll_fabric_stats(self) -> None:
+        """Broker self-metrics: poll the fabric's `stats` op (RemoteFabric
+        issues the wire request; LocalFabric answers in-process). A
+        broker outage blanks the snapshot instead of serving stale
+        numbers."""
+        while True:
+            try:
+                res = self.fabric.stats()
+                if asyncio.iscoroutine(res):
+                    res = await res
+                self.fabric_stats = res or {}
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                self.fabric_stats = {}
+            await asyncio.sleep(self.fabric_stats_interval)
+
     # -- exposition --------------------------------------------------------
+
+    def _fabric_lines(self) -> list[str]:
+        lines = []
+        for key, val in sorted(self.fabric_stats.items()):
+            if key == "queues":
+                name = f"{PREFIX}_fabric_queue_depth"
+                lines.append(f"# TYPE {name} gauge")
+                for qname, depth in sorted(val.items()):
+                    lines.append(f'{name}{{queue="{qname}"}} {depth}')
+                continue
+            if not isinstance(val, (int, float)):
+                continue
+            ptype = "counter" if key.endswith("_total") else "gauge"
+            name = f"{PREFIX}_fabric_{key}"
+            lines.append(f"# TYPE {name} {ptype}")
+            lines.append(f"{name} {val}")
+        return lines
 
     def expose(self) -> str:
         snap = self.aggregator.snapshot()
@@ -161,6 +214,7 @@ class MetricsService:
             f"{PREFIX}_kv_hit_rate "
             f"{self.overlap_tokens_total / self.isl_tokens_total if self.isl_tokens_total else 0.0}",
         ]
+        lines += self._fabric_lines()
         return "\n".join(lines) + "\n"
 
     async def _metrics(self, request: web.Request) -> web.Response:

@@ -48,6 +48,29 @@ class CancellationToken:
             raise asyncio.CancelledError("context cancelled")
 
 
+#: sentinel returned by queue_get_or_cancelled when cancellation won
+CANCELLED = object()
+
+
+async def queue_get_or_cancelled(context: "Context", q: asyncio.Queue):
+    """await q.get() raced against the context's cancellation; returns the
+    item, or CANCELLED if cancellation fired first (the caller re-checks
+    `context.cancelled` and notifies its peer). The single home for the
+    subtle two-task race used by streaming consumers (PushRouter,
+    SubprocessEngine): both tasks are always reaped, and a get() that
+    completed in the same wakeup as the cancel still delivers its item."""
+    get_task = asyncio.ensure_future(q.get())
+    cancel_task = asyncio.ensure_future(context.token.wait())
+    done, _ = await asyncio.wait(
+        {get_task, cancel_task}, return_when=asyncio.FIRST_COMPLETED
+    )
+    cancel_task.cancel()
+    if get_task not in done:
+        get_task.cancel()
+        return CANCELLED
+    return get_task.result()
+
+
 class Context:
     """Request context: id + cancellation + free-form metadata."""
 

@@ -380,6 +380,11 @@ class RemoteFabric:
         h, _ = await self._call({"op": "ping"})
         return bool(h.get("ok"))
 
+    async def stats(self) -> dict:
+        """Broker self-metrics snapshot (server op `stats`)."""
+        h, _ = await self._call({"op": "stats"})
+        return h.get("stats") or {}
+
     async def close(self):
         self._closed = True
         if self._reconnect_task:

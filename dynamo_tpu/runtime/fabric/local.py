@@ -45,6 +45,30 @@ class LocalFabric:
         self._subs: list[Subscription] = []
         self._queues: dict[str, _LocalQueue] = {}
         self._objects: dict[str, bytes] = {}
+        #: items put back after a consumer died/nacked (at-least-once
+        #: delivery in action — the broker self-observability plane)
+        self.redeliveries_total = 0
+
+    def stats(self) -> dict:
+        """Broker-side self-metrics (consumed by the fabric server's
+        `stats` op and, through it, metrics_service.py)."""
+        return {
+            "active_subs": sum(1 for s in self._subs if not s._closed),
+            "active_leases": len(getattr(self.store, "_leases", ())),
+            "objects": len(self._objects),
+            "redeliveries_total": self.redeliveries_total,
+            # NOT *_total: these are level gauges (they go down), and the
+            # exposition layer types *_total keys as Prometheus counters
+            "queued_items": sum(
+                len(q.items) for q in self._queues.values()
+            ),
+            "inflight_items": sum(
+                len(q.inflight) for q in self._queues.values()
+            ),
+            "queues": {
+                name: len(q.items) for name, q in self._queues.items()
+            },
+        }
 
     # -- kv/lease/watch: delegate ------------------------------------------
 
@@ -129,6 +153,7 @@ class LocalFabric:
         q = self._q(queue)
         item = q.inflight.pop(item_id, None)
         if item is not None:
+            self.redeliveries_total += 1
             q.items.appendleft(item)
             q.event.set()
 

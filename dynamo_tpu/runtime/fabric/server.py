@@ -58,6 +58,21 @@ class FabricServer:
             self.fabric = LocalFabric()
         self._server: Optional[asyncio.Server] = None
         self._conns: set[_Conn] = set()
+        self._connections_total = 0
+        self._ops_total = 0
+
+    def stats(self) -> dict:
+        """Broker self-metrics: the server's own health joins the
+        observability plane (op `stats`; metrics_service.py polls it and
+        exposes Prometheus `dynamo_tpu_fabric_*` gauges)."""
+        return {
+            "connections": len(self._conns),
+            "connections_total": self._connections_total,
+            "ops_total": self._ops_total,
+            "active_watches": sum(len(c.watches) for c in self._conns),
+            "pending_dispatches": sum(len(c.tasks) for c in self._conns),
+            **self.fabric.stats(),
+        }
 
     async def start(self) -> None:
         if hasattr(self.fabric, "load_and_open"):
@@ -88,6 +103,7 @@ class FabricServer:
     ) -> None:
         conn = _Conn(writer)
         self._conns.add(conn)
+        self._connections_total += 1
         try:
             while True:
                 header, payload = await read_frame(reader)
@@ -124,6 +140,7 @@ class FabricServer:
     async def _dispatch(self, conn: _Conn, h: Any, payload: bytes) -> None:
         op, rid = h.get("op"), h.get("id")
         f = self.fabric
+        self._ops_total += 1
         try:
             if op == "kv.put":
                 await f.put(h["key"], payload, h.get("lease"))
@@ -244,6 +261,8 @@ class FabricServer:
             elif op == "obj.delete":
                 deleted = await f.obj_delete(h["name"])
                 await conn.send({"id": rid, "ok": True, "deleted": deleted})
+            elif op == "stats":
+                await conn.send({"id": rid, "ok": True, "stats": self.stats()})
             elif op == "ping":
                 await conn.send({"id": rid, "ok": True})
             else:

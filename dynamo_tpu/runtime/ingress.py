@@ -25,6 +25,13 @@ logger = logging.getLogger(__name__)
 Handler = Callable[[Context, dict], AsyncIterator]
 
 
+class RetryableHandlerError(RuntimeError):
+    """Handler failure that is safe to retry on ANOTHER instance (e.g. the
+    worker's external engine subprocess is down/restarting). The error
+    frame carries retryable=true; PushRouter marks the instance down and
+    retries elsewhere if the stream hasn't produced data yet."""
+
+
 class IngressServer:
     def __init__(self, host: str = "127.0.0.1", port: int = 0):
         self.host = host
@@ -138,7 +145,12 @@ class IngressServer:
         except Exception as e:  # noqa: BLE001 — stream errors to the caller
             logger.exception("handler error for %s", endpoint)
             try:
-                await send({"op": "error", "request_id": rid, "message": str(e)})
+                await send(
+                    {
+                        "op": "error", "request_id": rid, "message": str(e),
+                        "retryable": isinstance(e, RetryableHandlerError),
+                    }
+                )
             except Exception:
                 pass
         finally:

@@ -23,7 +23,11 @@ import msgpack
 
 from dynamo_tpu.runtime.codec import encode_frame, read_frame
 from dynamo_tpu.runtime.component import Instance, InstanceSource
-from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.runtime.context import (
+    CANCELLED,
+    Context,
+    queue_get_or_cancelled,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -186,17 +190,9 @@ class PushRouter:
                         return
                     # race q.get() against cancellation so a cancel issued
                     # while idle reaches the worker immediately
-                    get_task = asyncio.ensure_future(q.get())
-                    cancel_task = asyncio.ensure_future(ctx.token.wait())
-                    done, _ = await asyncio.wait(
-                        {get_task, cancel_task},
-                        return_when=asyncio.FIRST_COMPLETED,
-                    )
-                    cancel_task.cancel()
-                    if get_task not in done:
-                        get_task.cancel()
+                    item = await queue_get_or_cancelled(ctx, q)
+                    if item is CANCELLED:
                         continue  # loop re-checks ctx.cancelled and notifies
-                    item = get_task.result()
                     if item is None:  # connection dropped mid-stream
                         self.source.mark_down(inst.instance_id)
                         if got_data or attempts >= max_attempts:
@@ -212,6 +208,17 @@ class PushRouter:
                     elif op == "end":
                         return
                     elif op == "error":
+                        if header.get("retryable") and not got_data:
+                            # the worker itself says another instance
+                            # should take this (its engine subprocess is
+                            # down/restarting): mark down + retry, same
+                            # as a pre-stream connection failure
+                            self.source.mark_down(inst.instance_id)
+                            if attempts >= max_attempts:
+                                raise EngineStreamError(
+                                    header.get("message")
+                                )
+                            break
                         raise EngineStreamError(header.get("message"))
             finally:
                 conn.streams.pop(rid, None)

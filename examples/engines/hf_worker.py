@@ -23,6 +23,13 @@ Run (CPU, random-weight tiny model unless --checkpoint is a real HF dir):
       --model hf-tiny [--checkpoint /path/to/hf_dir]
 
 then serve through any frontend: `run in=http out=dyn --fabric ...`.
+
+Level-2 alternative (`--shim`): speak the subprocess harness wire
+protocol on stdio instead of joining the fabric directly — a supervised
+Worker owns lifecycle/restarts (docs/external_engines.md "Level 2"):
+
+  dynamo-tpu run in=http \
+      'out=ext:python examples/engines/hf_worker.py --shim --model hf-tiny'
 """
 
 from __future__ import annotations
@@ -196,9 +203,28 @@ def build_model(checkpoint: str | None, vocab_size: int):
     return LlamaForCausalLM(cfg).eval()
 
 
-async def main() -> None:
+def _build_engine(args):
+    model = build_model(args.checkpoint, vocab_size=512)
+    eos = ()
+    if args.checkpoint:
+        eos_id = getattr(model.config, "eos_token_id", None)
+        if eos_id is not None:
+            eos = tuple(eos_id) if isinstance(eos_id, list) else (int(eos_id),)
+    return HFTransformersEngine(
+        model, eos_token_ids=eos, block_size=args.page_size,
+        salt=args.model,
+    )
+
+
+def _build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(description=__doc__)
-    p.add_argument("--fabric", required=True, help="host:port")
+    p.add_argument("--fabric", default=None, help="host:port")
+    p.add_argument(
+        "--shim", action="store_true",
+        help="speak the external-engine wire protocol on stdio (run under "
+             "a Worker's subprocess supervisor) instead of joining the "
+             "fabric as a self-registered worker",
+    )
     p.add_argument("--model", default="hf-tiny", help="served model name")
     p.add_argument("--checkpoint", default=None, help="HF model directory")
     p.add_argument("--tokenizer", default=None,
@@ -209,8 +235,10 @@ async def main() -> None:
                    dest="max_context")
     p.add_argument("--router-mode", default="round_robin",
                    dest="router_mode", choices=["round_robin", "random", "kv"])
-    args = p.parse_args()
+    return p
 
+
+async def _serve_fabric(args) -> None:
     logging.basicConfig(level=logging.INFO)
     tokenizer = (
         {"kind": "hf", "path": args.tokenizer}
@@ -221,16 +249,7 @@ async def main() -> None:
         name=args.model, tokenizer=tokenizer,
         context_length=args.max_context, kv_page_size=args.page_size,
     )
-    model = build_model(args.checkpoint, vocab_size=512)
-    eos = ()
-    if args.checkpoint:
-        eos_id = getattr(model.config, "eos_token_id", None)
-        if eos_id is not None:
-            eos = tuple(eos_id) if isinstance(eos_id, list) else (int(eos_id),)
-    engine = HFTransformersEngine(
-        model, eos_token_ids=eos, block_size=args.page_size,
-        salt=args.model,
-    )
+    engine = _build_engine(args)
 
     rt = await DistributedRuntime.create(args.fabric)
     print(f"worker booting (model={args.model}, role=external-hf)",
@@ -247,5 +266,20 @@ async def main() -> None:
         await worker.stop()
 
 
+def main() -> None:
+    p = _build_parser()
+    args = p.parse_args()
+    if args.shim:
+        # torch/transformers still gate this path (build_model imports
+        # them); the shim owns the event loop, so dispatch pre-asyncio
+        from dynamo_tpu.external.shim import run_engine
+
+        run_engine(_build_engine(args), model=args.model)
+        return
+    if not args.fabric:
+        p.error("--fabric is required (or use --shim)")
+    asyncio.run(_serve_fabric(args))
+
+
 if __name__ == "__main__":
-    asyncio.run(main())
+    main()

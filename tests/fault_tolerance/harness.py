@@ -229,6 +229,61 @@ def drive_phase(
     return ok
 
 
+class ExtCluster(Cluster):
+    """fabric + frontend + N workers whose ENGINES are supervised
+    subprocesses (the external-engine harness, docs/external_engines.md
+    "Level 2"): every worker is `run in=dyn out=ext:<reference_worker>`,
+    so kill injection can target the ENGINE process while the worker —
+    its lease, ingress, and supervisor — stays up."""
+
+    MAX_TOKENS = 16
+
+    def __init__(self, num_workers: int = 2, delay: float = 0.05):
+        self.delay = delay
+        super().__init__(num_workers=num_workers)
+
+    def add_worker(self) -> ManagedProc:
+        import sys
+
+        ext = (
+            f"{sys.executable} -m dynamo_tpu.external.reference_worker "
+            f"--block-size 4 --delay {self.delay}"
+        )
+        argv = _cli(
+            "run", "in=dyn", "out=ext:" + ext, "--model", self.model,
+            "--fabric", f"127.0.0.1:{self.fabric_port}",
+        )
+        w = ManagedProc(f"worker{len(self.workers)}", argv)
+        self.workers.append(w)
+        w.wait_for(r"worker \w+ up", timeout=60)
+        return w
+
+    def engine_pids(self, worker: ManagedProc) -> list[int]:
+        """PIDs of the worker's supervised engine subprocess(es) —
+        read from /proc so there's no pgrep/psutil dependency."""
+        pid = worker.proc.pid
+        try:
+            with open(f"/proc/{pid}/task/{pid}/children") as f:
+                return [int(x) for x in f.read().split()]
+        except OSError:
+            return []
+
+    def kill_engines(self) -> int:
+        """SIGKILL every worker's engine subprocess (not the workers);
+        returns how many engines were killed."""
+        import os
+
+        n = 0
+        for w in self.workers:
+            for cpid in self.engine_pids(w):
+                try:
+                    os.kill(cpid, signal.SIGKILL)
+                    n += 1
+                except ProcessLookupError:
+                    pass
+        return n
+
+
 class DisaggCluster(Cluster):
     """fabric + jax decode worker (remote prefill on) + prefill worker +
     frontend — the disagg serving stack for kill-injection scenarios.

@@ -25,6 +25,7 @@ import pytest
 from tests.fault_tolerance.harness import (
     Cluster,
     DisaggCluster,
+    ExtCluster,
     ManagedProc,
     PhaseMetrics,
     drive_phase,
@@ -238,6 +239,70 @@ def test_worker_kill_during_stream():
 
         c.add_worker()
         c.wait_until_ready(30)  # exception-tolerant recovery poll
+    finally:
+        c.stop()
+
+
+def test_subprocess_engine_kill_midstream_restart_markdown():
+    """ISSUE 3 FT scenario: SIGKILL the supervised ENGINE subprocesses
+    (not the workers) while a streaming response is mid-flight. The
+    in-flight stream must error-finish promptly (never hang), the
+    supervisors must backoff-restart the engines, and during the restart
+    window pre-stream requests must ride the retryable-error mark-down
+    onto whichever engine is back first — steady state recovers to 100%
+    success with the ORIGINAL worker processes still up."""
+    import http.client
+
+    c = ExtCluster(num_workers=2, delay=0.05)
+    try:
+        m = PhaseMetrics()
+        assert drive_phase(c, m, "baseline", 4) == 4
+        # every worker has a live engine child before the kill
+        engines_before = [c.engine_pids(w) for w in c.workers]
+        assert all(engines_before), engines_before
+
+        conn = http.client.HTTPConnection(
+            "127.0.0.1", c.http_port, timeout=30
+        )
+        body = json.dumps(
+            {
+                "model": c.model,
+                "messages": [{"role": "user", "content": "stream on"}],
+                "max_tokens": 64,
+                "stream": True,
+            }
+        )
+        conn.request(
+            "POST", "/v1/chat/completions", body=body,
+            headers={"Content-Type": "application/json"},
+        )
+        resp = conn.getresponse()
+        assert resp.status == 200
+        assert resp.read(40)  # the stream is live
+        assert c.kill_engines() >= 2
+        t0 = time.time()
+        try:
+            while resp.read(256):  # must terminate (error finish), not hang
+                pass
+        except Exception:
+            pass
+        elapsed = time.time() - t0
+        assert elapsed < 15, f"stream hung {elapsed:.1f}s after engine kill"
+        conn.close()
+
+        # supervised restart: the SAME worker processes serve again
+        c.wait_until_ready(30)
+        assert drive_phase(c, m, "after_restart", 6) == 6
+
+        # the workers never died — their engine children did and were
+        # replaced by the supervisor
+        for w, before in zip(c.workers, engines_before):
+            assert w.proc.poll() is None, "worker process died with engine"
+            after = c.engine_pids(w)
+            assert after and set(after) != set(before), (before, after)
+
+        s = _write_metrics("subprocess_engine_kill", m)
+        assert s["after_restart"]["fail"] == 0
     finally:
         c.stop()
 

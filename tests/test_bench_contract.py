@@ -70,6 +70,13 @@ def test_cpu_fallback_line_is_labeled_and_carries_tpu_artifact():
     )
     assert kq["capacity_ratio"] > 1.3
     assert ab["speedup"] is not None
+    # subprocess external-engine harness A/B (ISSUE 3): both arms ran the
+    # same echo workload and the wire hop's per-token price is reported
+    ext = ex["ext_harness_ab"]
+    assert "error" not in ext, ext
+    assert ext["inproc_tok_s"] > 0 and ext["subprocess_tok_s"] > 0
+    assert ext["tokens_per_arm"] > 0
+    assert "wire_overhead_us_per_token" in ext
 
 
 def test_bench_http_counts_failures_instead_of_raising():

@@ -216,6 +216,79 @@ def test_hf_shim_script_subprocess_e2e():
             p.stop()
 
 
+def test_hf_shim_through_subprocess_harness():
+    """Level 2 (ISSUE 3): the SAME HF engine promoted to a supervised
+    subprocess via `hf_worker.py --shim` — tokens stream through the
+    wire protocol, greedy-deterministic and identical to the in-process
+    engine, and its KV stored-events cross the wire as real KvEvents.
+    Skips with the module when torch is absent."""
+    import os
+    import sys
+
+    from dynamo_tpu.external.client import SubprocessEngine
+    from dynamo_tpu.external.supervisor import SupervisorConfig
+    from dynamo_tpu.preprocessor.preprocessor import PreprocessedRequest
+    from dynamo_tpu.runtime.context import Context
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+    async def main():
+        eng = SubprocessEngine(
+            [sys.executable,
+             os.path.join(repo, "examples", "engines", "hf_worker.py"),
+             "--shim", "--model", "hf-shim", "--page-size", "4"],
+            name="hf-shim",
+            # torch+transformers imports can take tens of seconds on a
+            # loaded CI box — give the handshake room
+            config=SupervisorConfig(
+                env={"PYTHONPATH": repo}, ready_timeout=120.0
+            ),
+        )
+        events = []
+        eng.on_kv_event = events.append
+        await eng.start()
+        assert eng.hello["model"] == "hf-shim"
+
+        req = PreprocessedRequest(
+            request_id="s1", token_ids=[5, 9, 13], max_tokens=6,
+            temperature=0.0,
+        )
+        out = []
+        async for item in eng.generate(Context(request_id="s1"), req):
+            out += item["token_ids"]
+        assert len(out) == 6
+
+        # greedy through the wire == greedy in-process (same seed/model)
+        inproc = _engine(block_size=4, salt="hf-shim")
+
+        async def collect():
+            toks = []
+            async for item in inproc.generate(
+                Context(request_id="s2"), req
+            ):
+                toks += item["token_ids"]
+            return toks
+
+        assert out == await collect()
+
+        # stored-events need a full block: send a block-aligned prompt
+        req2 = PreprocessedRequest(
+            request_id="s3", token_ids=[5, 9, 13, 7, 2, 4, 6, 8],
+            max_tokens=2, temperature=0.0,
+        )
+        async for _ in eng.generate(Context(request_id="s3"), req2):
+            pass
+        for _ in range(80):
+            if events:
+                break
+            await asyncio.sleep(0.05)
+        assert events and events[0].kind == "stored"
+        assert events[0].token_blocks[0] == (5, 9, 13, 7)
+        await eng.stop()
+
+    run(main())
+
+
 def test_hf_engine_repetition_penalty():
     """The shim honors the optional wire field: a huge multiplicative
     penalty forbids repeats that the unpenalized greedy run makes."""

@@ -1,0 +1,542 @@
+"""Subprocess external-engine harness e2e: a FOREIGN engine in a
+SEPARATE PROCESS serves through the full stack — supervised lifecycle,
+cancellation propagation, crash-mid-stream error finishes with
+backoff-restart, circuit breaking, retryable mark-down onto surviving
+workers, and KV-routed HTTP serving with the indexer observing the
+wire-forwarded KV stored-events. All CPU, all tier-1."""
+
+import asyncio
+import sys
+
+import pytest
+
+from dynamo_tpu.external.client import (
+    EngineUnavailableError,
+    SubprocessEngine,
+)
+from dynamo_tpu.external.supervisor import SupervisorConfig
+from dynamo_tpu.preprocessor.preprocessor import PreprocessedRequest
+from dynamo_tpu.runtime.context import Context
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def _ref_cmd(*extra: str) -> list[str]:
+    return [
+        sys.executable, "-m", "dynamo_tpu.external.reference_worker",
+        "--model", "ext-ref", "--block-size", "4",
+        "--metrics-interval", "0.1", *extra,
+    ]
+
+
+def _req(rid: str, tokens, max_tokens: int, **kw) -> PreprocessedRequest:
+    return PreprocessedRequest(
+        request_id=rid, token_ids=list(tokens), max_tokens=max_tokens, **kw
+    )
+
+
+async def _collect(eng, req, ctx=None):
+    out = []
+    async for item in eng.generate(
+        ctx or Context(request_id=req.request_id), req
+    ):
+        out.append(item)
+    return out
+
+
+def test_generate_stream_and_kv_events():
+    """The AsyncEngine contract through a real child process: token
+    identity, finish reasons, stop ids, KvEvent forwarding, metrics."""
+
+    async def main():
+        eng = SubprocessEngine(_ref_cmd(), name="ref")
+        events = []
+        eng.on_kv_event = events.append
+        await eng.start()
+        assert eng.hello["model"] == "ext-ref"
+        assert eng.capabilities["kv_events"]
+
+        out = await _collect(eng, _req("r1", [1, 2, 3, 4, 5, 6, 7, 8], 6))
+        toks = [t for i in out for t in i["token_ids"]]
+        assert toks == [1, 2, 3, 4, 5, 6]
+        assert out[-1]["finish_reason"] == "length"
+
+        # stop id cuts the stream
+        out = await _collect(
+            eng, _req("r2", [1, 2, 3], 32, stop_token_ids=[2])
+        )
+        assert out[-1]["finish_reason"] == "stop"
+        assert [t for i in out for t in i["token_ids"]] == [1, 2]
+
+        # the child's stored-events crossed the wire as real KvEvents
+        for _ in range(40):
+            if events:
+                break
+            await asyncio.sleep(0.05)
+        assert events and events[0].kind == "stored"
+        assert events[0].block_hashes and events[0].token_blocks
+        # chained hashes match what a native worker would emit for the
+        # same tokens (same TokenBlockSequence discipline)
+        from dynamo_tpu.tokens.blocks import TokenBlockSequence
+
+        want = TokenBlockSequence(
+            (1, 2, 3, 4, 5, 6, 7, 8), block_size=4, salt="ext-ref"
+        ).blocks
+        assert tuple(events[0].block_hashes) == tuple(
+            b.sequence_hash for b in want
+        )
+
+        # metrics frames reached the load plane snapshot (6 + 2 tokens)
+        for _ in range(40):
+            if eng.metrics_dict().get("generated_tokens", 0) >= 8:
+                break
+            await asyncio.sleep(0.05)
+        m = eng.metrics_dict()
+        assert m["ext_ready"] == 1 and m["ext_restarts_total"] == 0
+        assert m["generated_tokens"] >= 8
+
+        vecs = await eng.embed([[1, 2, 3], [4, 5]])
+        assert len(vecs) == 2 and len(vecs[0]) == 32
+        await eng.stop()
+
+    run(main())
+
+
+def test_cancellation_propagates_to_child():
+    """context.cancel() mid-stream: the stream ends promptly, the child
+    keeps serving later requests (its generate task was cancelled, not
+    its loop)."""
+
+    async def main():
+        eng = SubprocessEngine(_ref_cmd("--delay", "0.03"), name="ref")
+        await eng.start()
+        ctx = Context(request_id="c1")
+        n = 0
+        async for _ in eng.generate(ctx, _req("c1", [1, 2, 3, 4], 200)):
+            n += 1
+            if n == 3:
+                ctx.cancel()
+        assert n <= 5
+
+        out = await _collect(eng, _req("c2", [9, 8], 2))
+        assert [t for i in out for t in i["token_ids"]] == [9, 8]
+        await eng.stop()
+
+    run(main())
+
+
+def test_abandoned_stream_cancels_in_child():
+    """Closing the generator WITHOUT context.cancel() (what an HTTP
+    client disconnect does to the ingress handler) must still send the
+    child a cancel frame — otherwise the engine burns capacity computing
+    the whole request for nobody."""
+
+    async def main():
+        eng = SubprocessEngine(
+            _ref_cmd("--delay", "0.02"), name="ref",
+        )
+        await eng.start()
+        agen = eng.generate(
+            Context(request_id="a1"), _req("a1", [1, 2, 3], 500)
+        )
+        n = 0
+        async for _ in agen:
+            n += 1
+            if n == 2:
+                break  # abandon mid-stream, no explicit cancel
+        await agen.aclose()
+        # the child's token counter must stop climbing almost immediately
+        await asyncio.sleep(0.4)
+        t1 = eng.metrics_dict().get("generated_tokens", 0)
+        await asyncio.sleep(0.5)
+        t2 = eng.metrics_dict().get("generated_tokens", 0)
+        assert t2 == t1, f"child kept generating after abandon: {t1}->{t2}"
+        assert t1 < 50, f"child ran {t1} tokens for an abandoned request"
+        await eng.stop()
+
+    run(main())
+
+
+def test_kill_mid_stream_error_finish_then_restart():
+    """SIGKILL the child mid-stream: the in-flight request gets an ERROR
+    finish (no hung stream), the supervisor backoff-restarts, and the
+    next request succeeds on the fresh child."""
+
+    async def main():
+        eng = SubprocessEngine(
+            _ref_cmd("--delay", "0.03"), name="ref",
+            config=SupervisorConfig(backoff_initial=0.05),
+        )
+        await eng.start()
+        n = 0
+        with pytest.raises(RuntimeError, match="died"):
+            async for _ in eng.generate(
+                Context(request_id="k1"), _req("k1", list(range(8)), 200)
+            ):
+                n += 1
+                if n == 3:
+                    eng.supervisor.kill()
+        assert n >= 3  # streamed, then error-finished
+
+        out = await _collect(eng, _req("k2", [5, 6, 7], 3))
+        assert [t for i in out for t in i["token_ids"]] == [5, 6, 7]
+        assert eng.supervisor.restarts_total >= 1
+        assert eng.metrics_dict()["ext_restarts_total"] >= 1
+        await eng.stop()
+
+    run(main())
+
+
+def test_injected_crash_error_finish():
+    """--fail-after: the child hard-exits mid-stream on its own (no
+    signal racing); same error-finish + restart contract."""
+
+    async def main():
+        eng = SubprocessEngine(
+            _ref_cmd("--fail-after", "5"), name="ref",
+            config=SupervisorConfig(backoff_initial=0.05),
+        )
+        await eng.start()
+        with pytest.raises(RuntimeError, match="died"):
+            await _collect(eng, _req("f1", [1, 2, 3], 50))
+        # fresh child, fresh counter: a short request completes
+        out = await _collect(eng, _req("f2", [1, 2], 2))
+        assert [t for i in out for t in i["token_ids"]] == [1, 2]
+        await eng.stop()
+
+    run(main())
+
+
+def test_crash_loop_opens_circuit_breaker():
+    """An engine that dies on boot ends in state 'broken' after
+    max_restarts consecutive failures; admission raises the retryable
+    EngineUnavailableError instead of queueing forever."""
+
+    async def main():
+        eng = SubprocessEngine(
+            [sys.executable, "-c", "import sys; sys.exit(3)"],
+            name="crash",
+            config=SupervisorConfig(
+                backoff_initial=0.02, backoff_max=0.05, max_restarts=2,
+                ready_timeout=5.0,
+            ),
+            admission_timeout=0.2,
+        )
+        await eng.start(wait_ready=False)
+        for _ in range(200):
+            if eng.supervisor.state == "broken":
+                break
+            await asyncio.sleep(0.05)
+        assert eng.supervisor.state == "broken"
+        assert eng.supervisor.spawns_total == 3  # initial + 2 retries
+        with pytest.raises(EngineUnavailableError):
+            await _collect(eng, _req("x", [1], 1))
+        assert eng.metrics_dict()["ext_broken"] == 1
+        await eng.stop()
+
+    run(main())
+
+
+_WEDGED_CHILD = """
+import time
+from dynamo_tpu.external import protocol
+from dynamo_tpu.runtime.codec import encode_frame
+import sys, asyncio
+
+async def main():
+    r, w = await protocol.child_streams()
+    w.write(encode_frame(protocol.hello_frame("wedge")))
+    await w.drain()
+    await protocol.read_frame(r)  # ready
+    time.sleep(600)  # wedge: blocks the loop, never answers a ping
+
+asyncio.run(main())
+"""
+
+
+def test_heartbeat_kills_wedged_child():
+    """A child that handshakes then wedges (alive but never answers a
+    ping) is killed by the heartbeat and goes through restart policy —
+    silence is death, not a hang for the supervisor."""
+
+    async def main():
+        eng = SubprocessEngine(
+            [sys.executable, "-c", _WEDGED_CHILD], name="wedge",
+            config=SupervisorConfig(
+                heartbeat_interval=0.1, heartbeat_timeout=0.5,
+                backoff_initial=0.05, max_restarts=1,
+            ),
+        )
+        await eng.start()
+        for _ in range(200):
+            if eng.supervisor.restarts_total >= 1 or (
+                eng.supervisor.state == "broken"
+            ):
+                break
+            await asyncio.sleep(0.05)
+        assert (
+            eng.supervisor.restarts_total >= 1
+            or eng.supervisor.state == "broken"
+        ), eng.supervisor.state
+        await eng.stop()
+
+    run(main())
+
+
+def test_uds_transport_round_trip():
+    """transport='uds': frames ride a unix socket; the child's stdout
+    stays a plain log channel."""
+
+    async def main():
+        eng = SubprocessEngine(
+            _ref_cmd(), name="uds",
+            config=SupervisorConfig(transport="uds"),
+        )
+        await eng.start()
+        out = await _collect(eng, _req("u1", [3, 1, 4], 3))
+        assert [t for i in out for t in i["token_ids"]] == [3, 1, 4]
+        await eng.stop()
+
+    run(main())
+
+
+def test_retryable_error_marks_down_and_retries_surviving_worker():
+    """Two external workers on one endpoint, one circuit-broken: the
+    PushRouter turns its retryable error frames into mark_down + retry,
+    so every request lands on the survivor."""
+
+    async def main():
+        from dynamo_tpu.model_card import ModelDeploymentCard
+        from dynamo_tpu.runtime import DistributedRuntime
+        from dynamo_tpu.runtime.fabric import FabricServer
+        from dynamo_tpu.runtime.push_router import RouterMode
+        from dynamo_tpu.worker import Worker
+
+        server = FabricServer(port=0)
+        await server.start()
+        card = ModelDeploymentCard(
+            name="ext-ref", tokenizer={"kind": "byte"}, context_length=512,
+            kv_page_size=4,
+        )
+
+        broken = SubprocessEngine(
+            [sys.executable, "-c", "import sys; sys.exit(3)"], name="broken",
+            config=SupervisorConfig(
+                backoff_initial=0.02, backoff_max=0.05, max_restarts=1,
+            ),
+            admission_timeout=0.2,
+        )
+        await broken.start(wait_ready=False)
+        healthy = SubprocessEngine(_ref_cmd(), name="healthy")
+        await healthy.start()
+
+        rt_a = await DistributedRuntime.create(server.address)
+        rt_b = await DistributedRuntime.create(server.address)
+        rt_c = await DistributedRuntime.create(server.address)
+        wa = Worker(
+            rt_a, card, engine_kind="external", engine=broken,
+            namespace="ns", metrics_interval=60.0,
+        )
+        wb = Worker(
+            rt_b, card, engine_kind="external", engine=healthy,
+            namespace="ns", metrics_interval=60.0,
+        )
+        await wa.start()
+        await wb.start()
+        for _ in range(200):
+            if broken.supervisor.state == "broken":
+                break
+            await asyncio.sleep(0.05)
+
+        ep = rt_c.namespace("ns").component("backend").endpoint("generate")
+        router = await ep.router(mode=RouterMode.ROUND_ROBIN)
+        pre = _req("rr", [7, 7, 7], 3)
+        # every request succeeds: hits on the broken worker come back as
+        # retryable error frames -> mark_down -> retry on the survivor
+        for i in range(4):
+            pre.request_id = f"rr{i}"
+            toks = []
+            async for item in router.generate(pre.to_dict()):
+                toks += item.get("token_ids", [])
+            assert toks == [7, 7, 7], (i, toks)
+
+        router.close()
+        await wb.stop()
+        await wa.stop()
+        await healthy.stop()
+        await broken.stop()
+        for rt in (rt_a, rt_b, rt_c):
+            await rt.close()
+        await server.stop()
+
+    run(main())
+
+
+def test_http_kv_routed_e2e_with_crash_and_recovery():
+    """THE acceptance e2e: a separate-process engine serves
+    /v1/chat/completions through the HTTP frontend with router_mode=kv;
+    the KV router's indexer observes its wire-forwarded stored-events
+    (prefix affinity for a foreign engine); killing the subprocess
+    mid-stream yields an error finish (no hung stream), a supervised
+    restart, and subsequent requests succeed."""
+    aiohttp = pytest.importorskip("aiohttp")
+
+    async def main():
+        from dynamo_tpu.frontend import HttpService, ModelManager
+        from dynamo_tpu.frontend.service import ModelWatcher
+        from dynamo_tpu.model_card import ModelDeploymentCard
+        from dynamo_tpu.runtime import DistributedRuntime
+        from dynamo_tpu.runtime.fabric import FabricServer
+        from dynamo_tpu.worker import Worker
+
+        server = FabricServer(port=0)
+        await server.start()
+
+        eng = SubprocessEngine(
+            _ref_cmd("--delay", "0.02"), name="ref",
+            config=SupervisorConfig(backoff_initial=0.05),
+        )
+        await eng.start()
+        rt_w = await DistributedRuntime.create(server.address)
+        card = ModelDeploymentCard(
+            name="ext-ref", tokenizer={"kind": "byte"}, context_length=512,
+            kv_page_size=4,
+        )
+        worker = Worker(
+            rt_w, card, engine_kind="external", engine=eng,
+            namespace="ns", router_mode="kv", metrics_interval=0.1,
+        )
+        await worker.start()
+        assert eng.on_kv_event is not None  # Worker wired the sink
+
+        rt_f = await DistributedRuntime.create(server.address)
+        manager = ModelManager()
+        watcher = ModelWatcher(rt_f, manager)
+        await watcher.start()
+        for _ in range(100):
+            if manager.get("ext-ref"):
+                break
+            await asyncio.sleep(0.05)
+        assert manager.get("ext-ref") is not None
+
+        svc = HttpService(manager, host="127.0.0.1", port=0)
+        await svc.start()
+        base = f"http://127.0.0.1:{svc.port}"
+        body = {
+            "model": "ext-ref",
+            "messages": [{"role": "user", "content": "hello subprocess"}],
+            "max_tokens": 8,
+            "temperature": 0.0,
+        }
+        async with aiohttp.ClientSession() as s:
+            async with s.post(f"{base}/v1/chat/completions", json=body) as r:
+                assert r.status == 200
+                data = await r.json()
+            assert data["usage"]["completion_tokens"] == 8
+
+            # the indexer behind the KV router saw the foreign engine's
+            # stored-events under this worker's instance id
+            from dynamo_tpu.kv_router.indexer import KvIndexerSharded
+
+            indexer = KvIndexerSharded(rt_f.fabric, num_shards=1)
+            await indexer.start()
+            # replay does not exist on the bus: send one more request so
+            # fresh events flow while this indexer subscribes
+            async with s.post(
+                f"{base}/v1/chat/completions", json=body
+            ) as r:
+                assert r.status == 200
+            ok = False
+            for _ in range(100):
+                if worker.instance_id in indexer.workers():
+                    ok = True
+                    break
+                await asyncio.sleep(0.05)
+            assert ok, "indexer never observed the subprocess KV events"
+            await indexer.stop()
+
+            # kill mid-stream: the streaming response terminates (error
+            # finish), never hangs
+            kill_body = dict(body, max_tokens=400, stream=True)
+            async with s.post(
+                f"{base}/v1/chat/completions", json=kill_body
+            ) as r:
+                assert r.status == 200
+                got = 0
+                killed = False
+                try:
+                    async for chunk in r.content.iter_chunked(256):
+                        got += 1
+                        if got == 2 and not killed:
+                            eng.supervisor.kill()
+                            killed = True
+                except Exception:
+                    pass  # mid-stream termination is acceptable too
+            assert killed
+
+            # supervised restart: the SAME worker serves again
+            ok = False
+            for _ in range(60):
+                try:
+                    async with s.post(
+                        f"{base}/v1/chat/completions", json=body
+                    ) as r:
+                        if r.status == 200:
+                            ok = True
+                            break
+                except Exception:
+                    pass
+                await asyncio.sleep(0.2)
+            assert ok, "worker never recovered after subprocess restart"
+            assert eng.supervisor.restarts_total >= 1
+
+        await svc.stop()
+        await watcher.stop()
+        await rt_f.close()
+        await worker.stop()
+        await rt_w.close()
+        await eng.stop()
+        await server.stop()
+
+    run(main())
+
+
+def test_cli_out_ext_http_serving():
+    """`run in=http out=ext:...` as real CLI processes: the launcher
+    spawns + supervises the engine subprocess and serves OpenAI chat."""
+    import json
+    import urllib.request
+
+    from benchmarks._procs import ManagedProc, cli, free_port
+
+    port = free_port()
+    fe = ManagedProc(
+        "http-ext",
+        cli(
+            "run", "in=http",
+            "out=ext:" + sys.executable
+            + " -m dynamo_tpu.external.reference_worker --block-size 4",
+            "--port", str(port), "--model", "tiny",
+        ),
+    )
+    try:
+        fe.wait_for("listening on", timeout=60)
+        body = json.dumps(
+            {
+                "model": "tiny",
+                "messages": [{"role": "user", "content": "hi"}],
+                "max_tokens": 5,
+            }
+        ).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/chat/completions", data=body,
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=20) as resp:
+            assert resp.status == 200
+            data = json.loads(resp.read())
+        assert data["usage"]["completion_tokens"] == 5
+    finally:
+        fe.stop()

@@ -33,6 +33,8 @@ def test_metrics_service_exposition():
                     "remote_prefills_total": 5,
                     "time_decode_ms": 123.5,
                     "decode_dispatches": 9,
+                    "ext_ready": 1,
+                    "ext_restarts_total": 2,
                 },
             )
             for _ in range(2):
@@ -83,8 +85,70 @@ def test_metrics_service_exposition():
                 '{component="backend",instance="worker-1"} 9' in text
             )
             assert "dynamo_tpu_kv_hit_rate 0.64" in text
+            # subprocess-harness supervisor plane (external workers)
+            assert (
+                'dynamo_tpu_worker_ext_restarts_total'
+                '{component="backend",instance="worker-1"} 2' in text
+            )
             assert health["workers"] == 1
 
+            await svc.stop()
+            await rt_m.close()
+            await rt_w.close()
+        finally:
+            await server.stop()
+
+    asyncio.run(main())
+
+
+def test_fabric_broker_self_metrics():
+    """The fabric's own health joins the Prometheus plane: the service
+    polls the broker's `stats` op and exposes connections, subs,
+    watches, leases, queue depths, and redelivery counters."""
+
+    async def main():
+        server = FabricServer(port=0)
+        await server.start()
+        try:
+            rt_m = await DistributedRuntime.create(server.address)
+            rt_w = await DistributedRuntime.create(server.address)
+            # some broker state to observe: a queue with a depth, a sub
+            await rt_w.fabric.queue_push("workq", {"h": 1}, b"item-a")
+            await rt_w.fabric.queue_push("workq", {"h": 2}, b"item-b")
+            sub = await rt_w.fabric.subscribe("some.subject")
+            # one redelivery: pop then nack
+            item = await rt_w.fabric.queue_pop("workq")
+            await rt_w.fabric.queue_nack("workq", item.item_id)
+
+            # the raw stats op first (RemoteFabric -> server -> LocalFabric)
+            stats = await rt_w.fabric.stats()
+            assert stats["connections"] >= 2
+            assert stats["active_subs"] >= 1
+            assert stats["redeliveries_total"] >= 1
+            assert stats["queues"]["workq"] == 2
+            assert stats["ops_total"] > 0
+
+            svc = MetricsService(
+                rt_m.fabric, component="backend", port=0,
+                fabric_stats_interval=0.1,
+            )
+            await svc.start()
+            await asyncio.sleep(0.3)
+            async with aiohttp.ClientSession() as sess:
+                async with sess.get(
+                    f"http://127.0.0.1:{svc.port}/metrics"
+                ) as resp:
+                    assert resp.status == 200
+                    text = await resp.text()
+            assert "dynamo_tpu_fabric_connections " in text
+            assert "dynamo_tpu_fabric_active_subs " in text
+            assert "dynamo_tpu_fabric_active_watches " in text
+            assert "dynamo_tpu_fabric_active_leases " in text
+            assert "# TYPE dynamo_tpu_fabric_ops_total counter" in text
+            assert "# TYPE dynamo_tpu_fabric_redeliveries_total counter" in text
+            assert 'dynamo_tpu_fabric_queue_depth{queue="workq"} 2' in text
+
+            sub.close()
             await svc.stop()
             await rt_m.close()
             await rt_w.close()
