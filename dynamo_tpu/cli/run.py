@@ -628,6 +628,12 @@ def build_parser() -> argparse.ArgumentParser:
         help="seconds to wait for the remote-prefill KV landing before "
              "falling back to local prefill",
     )
+    runp.add_argument(
+        "--trace", action="store_true",
+        help="enable distributed request tracing (in-memory ring served "
+             "at /v1/traces; equivalently DYNTPU_TRACING=1 or "
+             "DYNTPU_TRACE_RING=<n> — docs/observability.md)",
+    )
     runp.add_argument("--namespace", default="dynamo")
     runp.add_argument("--component", default="backend")
     runp.add_argument("--endpoint", default="generate")
@@ -955,6 +961,10 @@ def main(argv: Optional[list[str]] = None) -> None:
                 "mapping (e.g. --role-service decode=Worker)"
             )
     configure_logging()
+    if getattr(args, "trace", False):
+        from dynamo_tpu import telemetry
+
+        telemetry.configure(enabled=True)
 
     from dynamo_tpu.platform import honor_jax_platforms_env
 

@@ -123,7 +123,18 @@ class PrefillWorker:
 
     async def _handle(self, item_id: str, req: RemotePrefillRequest) -> None:
         try:
-            await self._prefill_and_transfer(req)
+            from dynamo_tpu import telemetry
+
+            # parented on the decode worker's disagg span via the queue
+            # item's trace context; a fresh trace when absent/off
+            with telemetry.span(
+                "disagg.prefill", service="prefill",
+                parent=req.trace or None,
+                attrs={"request_id": req.request_id,
+                       "isl_tokens": len(req.token_ids),
+                       "attempt": req.attempts},
+            ):
+                await self._prefill_and_transfer(req)
             await self.queue.ack(item_id)
             self.prefills_done += 1
         except Exception:

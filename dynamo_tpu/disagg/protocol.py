@@ -26,6 +26,10 @@ class RemotePrefillRequest:
     model: str = ""
     #: delivery attempts so far; requeued with +1 on failure, dropped at cap
     attempts: int = 0
+    #: trace context ({"trace_id", "span_id"}) so the prefill worker's
+    #: spans stitch under the decode worker's disagg span; empty when
+    #: tracing is off (telemetry/trace.py)
+    trace: dict[str, Any] = field(default_factory=dict)
 
     def pack(self) -> bytes:
         return msgpack.packb(dict(self.__dict__), use_bin_type=True)

@@ -15,6 +15,7 @@ import logging
 import threading
 from typing import Any, AsyncIterator, Optional, Protocol
 
+from dynamo_tpu import telemetry
 from dynamo_tpu.engine.engine import JaxEngine
 from dynamo_tpu.engine.request import SamplingParams, StepOutput
 from dynamo_tpu.preprocessor.preprocessor import PreprocessedRequest
@@ -190,12 +191,29 @@ class AsyncEngineRunner:
     async def generate(
         self, context: Context, request: PreprocessedRequest
     ) -> AsyncIterator[dict]:
-        q = self.watch_request(request.request_id)
-        with self._lock:
-            self._pending.append((request, _sampling_from(request)))
-        self._wake.set()
-        async for item in self.drain(context, request.request_id, q):
-            yield item
+        # The engine thread itself is contextvar-free; this async-side
+        # span brackets the whole engine residency (submit -> finish) and
+        # marks the first token. Phase costs (queue wait, per-dispatch
+        # prefill/decode) land in the telemetry phase histograms from the
+        # scheduler/step loop.
+        with telemetry.span(
+            "engine.generate", service="engine",
+            attrs={
+                "request_id": request.request_id,
+                "input_tokens": len(request.token_ids),
+            },
+        ) as sp:
+            q = self.watch_request(request.request_id)
+            with self._lock:
+                self._pending.append((request, _sampling_from(request)))
+            self._wake.set()
+            generated = 0
+            async for item in self.drain(context, request.request_id, q):
+                if generated == 0:
+                    sp.add_event("first_token")
+                generated += len(item.get("token_ids", ()))
+                yield item
+            sp.set_attr("generated_tokens", generated)
 
     async def drain(
         self, context: Context, request_id: str, q: asyncio.Queue

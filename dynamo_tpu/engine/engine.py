@@ -523,18 +523,20 @@ class JaxEngine:
         if batch is not None:
             t2 = time.perf_counter()  # after the drain: phase time is
             # dispatch+sync+postprocess only, as the field docs promise
+            from dynamo_tpu.telemetry import phases
+
             if batch.kind == "prefill":
                 outputs += self._run_prefill(batch)
                 self.metrics.prefill_dispatches += 1
-                self.metrics.time_prefill_ms += (
-                    time.perf_counter() - t2
-                ) * 1000.0
+                dt_ms = (time.perf_counter() - t2) * 1000.0
+                self.metrics.time_prefill_ms += dt_ms
+                phases.observe("prefill_ms", dt_ms)
             else:
                 outputs += self._run_decode(batch)
                 self.metrics.decode_dispatches += 1
-                self.metrics.time_decode_ms += (
-                    time.perf_counter() - t2
-                ) * 1000.0
+                dt_ms = (time.perf_counter() - t2) * 1000.0
+                self.metrics.time_decode_ms += dt_ms
+                phases.observe("decode_step_ms", dt_ms)
             self.metrics.steps += 1
         if self._inflight is not None and not self.scheduler.has_work:
             # the wave ended on a sampled stop the speculation couldn't

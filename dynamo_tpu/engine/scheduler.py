@@ -27,6 +27,7 @@ engine's overlapped decode pipeline relies on.
 from __future__ import annotations
 
 import logging
+import time
 from dataclasses import dataclass, field
 from typing import Literal, Optional
 
@@ -200,6 +201,18 @@ class Scheduler:
             req.state = RequestState.PREFILL
             self.waiting.pop(0)
             self.running.append(req)
+            # admission latency, per request (preempted requests re-enter
+            # the queue and observe their re-admission wait too).
+            # arrival_time defaults to 0.0 for directly-constructed
+            # Requests (unit tests, tools) — an epoch-sized wait there is
+            # garbage, not a measurement
+            if req.arrival_time:
+                from dynamo_tpu.telemetry import phases
+
+                phases.observe(
+                    "queue_wait_ms",
+                    max(0.0, (time.time() - req.arrival_time) * 1000.0),
+                )
 
     def _prefill_step_budget(self) -> int:
         """Token budget for this prefill step. Adaptive policy: grow

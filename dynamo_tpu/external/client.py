@@ -27,6 +27,7 @@ import asyncio
 import logging
 from typing import Any, AsyncIterator, Optional
 
+from dynamo_tpu import telemetry
 from dynamo_tpu.engine.page_table import KvEvent
 from dynamo_tpu.external import protocol
 from dynamo_tpu.external.supervisor import EngineSupervisor, SupervisorConfig
@@ -135,6 +136,14 @@ class SubprocessEngine:
                 )
         elif t == "metrics":
             self._metrics = protocol.unpack(payload)
+        elif t == "span":
+            # the child's side of a distributed trace: adopt its finished
+            # spans into this process's ring (no-op when tracing is off)
+            try:
+                for s in protocol.unpack(payload):
+                    telemetry.record_span_dict(s)
+            except Exception:
+                logger.debug("malformed span frame dropped", exc_info=True)
         elif t == "embed_result":
             fut = self._embeds.pop(header.get("id"), None)
             if fut is not None and not fut.done():
@@ -184,50 +193,64 @@ class SubprocessEngine:
         self._streams[rid] = q
         got_data = False
         settled = False  # terminal frame seen / cancel already propagated
-        try:
+        with telemetry.span(
+            "engine.generate", service="engine",
+            attrs={"request_id": rid, "engine": self.name,
+                   "input_tokens": len(request.token_ids)},
+        ) as sp:
+            gen_header: dict = {"type": "generate", "id": rid}
+            trace_ctx = telemetry.wire_context()
+            if trace_ctx:
+                # the child stitches its own spans under this one and
+                # ships them back as `span` frames
+                gen_header["trace"] = trace_ctx
             try:
-                await self.supervisor.send(
-                    {"type": "generate", "id": rid},
-                    protocol.pack(request.to_dict()),
-                )
-            except ConnectionError as e:
-                settled = True  # never reached the child
-                raise EngineUnavailableError(str(e))
-            while True:
-                if context.cancelled:
-                    settled = True
+                try:
+                    await self.supervisor.send(
+                        gen_header, protocol.pack(request.to_dict())
+                    )
+                except ConnectionError as e:
+                    settled = True  # never reached the child
+                    raise EngineUnavailableError(str(e))
+                while True:
+                    if context.cancelled:
+                        settled = True
+                        try:
+                            await self.supervisor.send(
+                                {"type": "cancel", "id": rid}
+                            )
+                        except Exception:
+                            pass  # child gone — nothing left to cancel
+                        return
+                    item = await queue_get_or_cancelled(context, q)
+                    if item is CANCELLED:
+                        continue  # loop re-checks context.cancelled
+                    if item is None:
+                        settled = True
+                        return
+                    if "error" in item:
+                        settled = True
+                        if item.get("engine_down") and not got_data:
+                            # nothing streamed yet: the request is safely
+                            # retryable on another instance
+                            raise EngineUnavailableError(item["error"])
+                        raise RuntimeError(item["error"])
+                    if not got_data:
+                        sp.add_event("first_token")
+                    got_data = True
+                    yield item
+            finally:
+                self._streams.pop(rid, None)
+                if not settled:
+                    # the CONSUMER abandoned the stream (client disconnect
+                    # closed this generator mid-yield): tell the child, or
+                    # it computes the whole request for nobody
                     try:
                         await self.supervisor.send(
                             {"type": "cancel", "id": rid}
                         )
                     except Exception:
-                        pass  # child gone — nothing left to cancel
-                    return
-                item = await queue_get_or_cancelled(context, q)
-                if item is CANCELLED:
-                    continue  # loop re-checks context.cancelled
-                if item is None:
-                    settled = True
-                    return
-                if "error" in item:
-                    settled = True
-                    if item.get("engine_down") and not got_data:
-                        # nothing streamed yet: the request is safely
-                        # retryable on another instance
-                        raise EngineUnavailableError(item["error"])
-                    raise RuntimeError(item["error"])
-                got_data = True
-                yield item
-        finally:
-            self._streams.pop(rid, None)
-            if not settled:
-                # the CONSUMER abandoned the stream (client disconnect
-                # closed this generator mid-yield): tell the child, or it
-                # computes the whole request for nobody
-                try:
-                    await self.supervisor.send({"type": "cancel", "id": rid})
-                except Exception:
-                    pass
+                        pass
 
     async def embed(self, prompts, normalize: bool = True):
         if not self.capabilities.get("embed"):

@@ -33,6 +33,11 @@ after which either side may send, full duplex:
                           wire-compatible with engine/page_table.KvEvent
                           and native/kv_events.cpp
   child  -> metrics  {} + payload msgpack(load snapshot dict)
+  child  -> span     {} + payload msgpack([finished span dicts,
+                          telemetry/trace.py Span.to_dict shape]) — the
+                          child's side of a distributed trace, emitted
+                          only when the generate frame carried a `trace`
+                          context; the parent adopts them into its ring
   child  -> pong     {n}
 
 Unknown frame types are ignored by both sides (forward compatibility);

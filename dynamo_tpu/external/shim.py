@@ -23,12 +23,15 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 import sys
+import time
 from typing import Any, Optional
 
 from dynamo_tpu.external import protocol
 from dynamo_tpu.preprocessor.preprocessor import PreprocessedRequest
 from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.telemetry.trace import new_span_id
 
 logger = logging.getLogger(__name__)
 
@@ -103,7 +106,9 @@ class EngineShim:
                     return  # supervisor gone: exit with it
                 t = header.get("type")
                 if t == "generate":
-                    self._spawn_generate(header["id"], payload)
+                    self._spawn_generate(
+                        header["id"], payload, trace=header.get("trace")
+                    )
                 elif t == "cancel":
                     ctx = self._contexts.get(header.get("id"))
                     if ctx is not None:
@@ -122,18 +127,55 @@ class EngineShim:
                 p.cancel()
             await self._flush_kv()
 
-    def _spawn_generate(self, rid: str, payload: bytes) -> None:
+    def _spawn_generate(
+        self, rid: str, payload: bytes, trace: Optional[dict] = None
+    ) -> None:
         ctx = Context(request_id=rid)
         self._contexts[rid] = ctx
         t = asyncio.get_running_loop().create_task(
-            self._serve_generate(ctx, rid, payload)
+            self._serve_generate(ctx, rid, payload, trace)
         )
         self._tasks.add(t)
         t.add_done_callback(self._tasks.discard)
 
+    def _child_span(self, rid: str, trace: Optional[dict]) -> Optional[dict]:
+        """A hand-built span dict stitched under the parent's engine span
+        (the `trace` context from the generate frame). Built directly —
+        the child's own tracer stays off; the PARENT decides whether a
+        request is traced by sending (or not sending) the context."""
+        if not isinstance(trace, dict) or not trace.get("trace_id"):
+            return None
+        return {
+            "trace_id": trace["trace_id"],
+            "span_id": new_span_id(),
+            "parent_id": trace.get("span_id"),
+            "name": "child.generate",
+            "service": "ext-child",
+            "start_ts": time.time(),
+            "duration_ms": None,
+            "status": "ok",
+            "attrs": {"request_id": rid, "child_pid": os.getpid(),
+                      "model": self.model},
+            "events": [],
+        }
+
+    async def _ship_span(self, span: Optional[dict], t0: float, **attrs) -> None:
+        if span is None:
+            return
+        span["duration_ms"] = (time.perf_counter() - t0) * 1000.0
+        span["attrs"].update(attrs)
+        try:
+            await self.send({"type": "span"}, protocol.pack([span]))
+        except Exception:
+            pass  # wire gone — the trace just loses the child's side
+
     async def _serve_generate(
-        self, ctx: Context, rid: str, payload: bytes
+        self, ctx: Context, rid: str, payload: bytes,
+        trace: Optional[dict] = None,
     ) -> None:
+        span = self._child_span(rid, trace)
+        t0 = time.perf_counter()
+        tokens = 0
         try:
             request = PreprocessedRequest.from_dict(protocol.unpack(payload))
             finish = None
@@ -143,8 +185,16 @@ class EngineShim:
                         {"type": "error", "id": rid,
                          "message": str(item["error"])}
                     )
+                    await self._ship_span(span, t0, tokens=tokens)
+                    span = None
                     return
                 finish = item.get("finish_reason")
+                if span is not None and tokens == 0:
+                    span["events"].append(
+                        {"ts": time.time(), "name": "first_token",
+                         "attrs": {}}
+                    )
+                tokens += len(item.get("token_ids", ()))
                 await self.send(
                     {"type": "token", "id": rid}, protocol.pack(item)
                 )
@@ -154,11 +204,20 @@ class EngineShim:
                     "cancelled": ctx.cancelled,
                 }
             )
+            if span is not None and ctx.cancelled:
+                span["status"] = "cancelled"
+            await self._ship_span(span, t0, tokens=tokens)
+            span = None
         except ConnectionError:
             pass  # parent gone — nobody left to tell
         except Exception as e:  # noqa: BLE001 — stream errors to the parent
             logger.exception("generate failed for %s", rid)
             await self._send_error(rid, e)
+            if span is not None:
+                span["status"] = "error"
+                span["attrs"]["error"] = f"{type(e).__name__}: {e}"
+                await self._ship_span(span, t0, tokens=tokens)
+                span = None
         finally:
             self._contexts.pop(rid, None)
 

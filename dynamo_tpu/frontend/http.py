@@ -45,6 +45,7 @@ from dynamo_tpu.protocols.openai import (
     sse_event,
 )
 from dynamo_tpu.runtime.context import Context
+from dynamo_tpu import telemetry
 
 logger = logging.getLogger(__name__)
 
@@ -116,6 +117,8 @@ class HttpService:
                 web.get("/live", self.health),
                 web.get("/ready", self.health),
                 web.get("/metrics", self.metrics_handler),
+                web.get("/v1/traces", self.traces_list),
+                web.get("/v1/traces/{trace_id}", self.trace_get),
                 web.post("/clear_kv_blocks", self.clear_kv_blocks),
             ]
         )
@@ -154,6 +157,22 @@ class HttpService:
         return web.Response(
             text=self.metrics.expose(), content_type="text/plain"
         )
+
+    # -- request tracing (docs/observability.md) ---------------------------
+
+    async def traces_list(self, request: web.Request) -> web.Response:
+        from dynamo_tpu.telemetry.http_api import traces_payload
+
+        body, status = traces_payload(request.query.get("limit"))
+        return web.json_response(body, status=status)
+
+    async def trace_get(self, request: web.Request) -> web.Response:
+        from dynamo_tpu.telemetry.http_api import trace_payload
+
+        body, status = trace_payload(
+            request.match_info["trace_id"], request.query.get("format")
+        )
+        return web.json_response(body, status=status)
 
     async def clear_kv_blocks(self, request: web.Request) -> web.Response:
         """Flush reusable (cached, unreferenced) KV pages on every worker
@@ -384,7 +403,16 @@ class HttpService:
         stream_fn = (
             pipeline.chat_stream if kind == "chat" else pipeline.completion_stream
         )
-        with self.metrics.inflight_guard(req.model):
+        # Root span of the distributed trace: parented on an incoming
+        # traceparent / x-request-id, else a fresh trace. Everything the
+        # request touches in this task (preprocess, router, local engine)
+        # nests under it via the contextvar.
+        parent = telemetry.context_from_headers(request.headers)
+        with self.metrics.inflight_guard(req.model), telemetry.span(
+            "http.request", service="frontend", parent=parent,
+            attrs={"model": req.model, "endpoint": kind,
+                   "stream": bool(req.stream)},
+        ) as root:
             try:
                 if req.stream:
                     return await self._stream(
@@ -392,11 +420,14 @@ class HttpService:
                     )
                 return await self._unary(req, stream_fn(req, ctx), kind, t0)
             except ValueError as e:
+                root.set_attr("http_status", 400)
                 self.metrics.request_done(req.model, kind, "400", time.time() - t0)
                 return web.json_response({"error": str(e)}, status=400)
             except Exception as e:
                 logger.exception("request failed")
                 ctx.cancel()
+                root.set_attr("http_status", 500)
+                root.end(status="error")
                 self.metrics.request_done(req.model, kind, "500", time.time() - t0)
                 return web.json_response({"error": str(e)}, status=500)
 

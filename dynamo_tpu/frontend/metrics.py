@@ -4,13 +4,18 @@ exposition; no client library in the image).
 Metric names mirror the reference's HTTP service plane
 (http/service/metrics.rs:104-111): requests_total, inflight_requests,
 request_duration, input/output_sequence_tokens, time_to_first_token,
-inter_token_latency.
+inter_token_latency. Latency histograms use a seconds ladder (≤30 s);
+sequence-token histograms use their own power-of-two ladder (8…32768) —
+a p99 prompt length must land in a real bucket, not +Inf.
+
+The exposition is linted in tests by telemetry/promlint.py — new
+metrics must keep unique TYPE lines, escaped labels, `_total` counter
+names, and monotonic histogram buckets.
 """
 
 from __future__ import annotations
 
 import threading
-import time
 from collections import defaultdict
 from typing import Optional
 
@@ -18,17 +23,25 @@ PREFIX = "dynamo_tpu_http_service"
 
 _BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0)
 
+#: token-count ladder for input/output_sequence_tokens (power of two up
+#: to a 32k context)
+_TOKEN_BUCKETS = (
+    8.0, 16.0, 32.0, 64.0, 128.0, 256.0, 512.0, 1024.0, 2048.0, 4096.0,
+    8192.0, 16384.0, 32768.0,
+)
+
 
 class Histogram:
-    def __init__(self):
-        self.counts = [0] * (len(_BUCKETS) + 1)
+    def __init__(self, buckets: tuple = _BUCKETS):
+        self.buckets = buckets
+        self.counts = [0] * (len(buckets) + 1)
         self.total = 0.0
         self.n = 0
 
     def observe(self, v: float) -> None:
         self.total += v
         self.n += 1
-        for i, b in enumerate(_BUCKETS):
+        for i, b in enumerate(self.buckets):
             if v <= b:
                 self.counts[i] += 1
                 return
@@ -37,7 +50,7 @@ class Histogram:
     def expose(self, name: str, labels: str) -> list[str]:
         out = []
         cum = 0
-        for i, b in enumerate(_BUCKETS):
+        for i, b in enumerate(self.buckets):
             cum += self.counts[i]
             out.append(f'{name}_bucket{{{labels},le="{b}"}} {cum}')
         cum += self.counts[-1]
@@ -47,13 +60,19 @@ class Histogram:
         return out
 
 
+def _token_histogram() -> Histogram:
+    return Histogram(buckets=_TOKEN_BUCKETS)
+
+
 class FrontendMetrics:
     def __init__(self):
         self._lock = threading.Lock()
         self.requests_total = defaultdict(int)  # (model, endpoint, status)
         self.inflight = defaultdict(int)  # model
-        self.input_tokens = defaultdict(int)
-        self.output_tokens = defaultdict(int)
+        #: per-request sequence-length distributions (token ladder); the
+        #: _sum series still carries total tokens for rate() dashboards
+        self.input_tokens = defaultdict(_token_histogram)
+        self.output_tokens = defaultdict(_token_histogram)
         self.duration = defaultdict(Histogram)  # model
         self.ttft = defaultdict(Histogram)
         self.itl = defaultdict(Histogram)
@@ -65,8 +84,13 @@ class FrontendMetrics:
     ) -> None:
         with self._lock:
             self.requests_total[(model, endpoint, status)] += 1
-            self.input_tokens[model] += input_tokens
-            self.output_tokens[model] += output_tokens
+            # error paths (400/404/500) report no token counts; a zero
+            # there is absence of data, not a zero-length sequence — it
+            # must not drag the length distribution into the first bucket
+            if input_tokens:
+                self.input_tokens[model].observe(input_tokens)
+            if output_tokens:
+                self.output_tokens[model].observe(output_tokens)
             self.duration[model].observe(duration_s)
             if ttft_s is not None:
                 self.ttft[model].observe(ttft_s)
@@ -90,11 +114,6 @@ class FrontendMetrics:
             for name, table in (
                 ("input_sequence_tokens", self.input_tokens),
                 ("output_sequence_tokens", self.output_tokens),
-            ):
-                lines.append(f"# TYPE {PREFIX}_{name} counter")
-                for model, n in sorted(table.items()):
-                    lines.append(f'{PREFIX}_{name}{{model="{model}"}} {n}')
-            for name, table in (
                 ("request_duration_seconds", self.duration),
                 ("time_to_first_token_seconds", self.ttft),
                 ("inter_token_latency_seconds", self.itl),
@@ -102,6 +121,11 @@ class FrontendMetrics:
                 lines.append(f"# TYPE {PREFIX}_{name} histogram")
                 for model, h in sorted(table.items()):
                     lines.extend(h.expose(f"{PREFIX}_{name}", f'model="{model}"'))
+        # per-phase latency histograms live process-global (telemetry
+        # layer); whichever process hosts a phase shows it here
+        from dynamo_tpu.telemetry import phases
+
+        lines.extend(phases.expose_lines())
         return "\n".join(lines) + "\n"
 
 

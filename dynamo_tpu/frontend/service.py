@@ -34,6 +34,7 @@ from dynamo_tpu.protocols.openai import (
 )
 from dynamo_tpu.runtime.context import Context
 from dynamo_tpu.runtime.push_router import PushRouter, RouterMode
+from dynamo_tpu import telemetry
 
 logger = logging.getLogger(__name__)
 
@@ -65,11 +66,18 @@ class ModelPipeline:
         self, request: ChatCompletionRequest, context: Optional[Context] = None
     ) -> AsyncIterator[ChatCompletionChunk]:
         ctx = context or Context()
-        messages = [m.model_dump(exclude_none=True) for m in request.messages]
-        if any(isinstance(m.get("content"), list) for m in messages):
-            messages = await self._encode_image_parts(messages)
-        pre = self.preprocessor.preprocess_chat_messages(messages, request)
-        self._clamp(pre)
+        with telemetry.span(
+            "preprocess", service="frontend",
+            attrs={"model": self.card.name},
+        ) as sp:
+            messages = [
+                m.model_dump(exclude_none=True) for m in request.messages
+            ]
+            if any(isinstance(m.get("content"), list) for m in messages):
+                messages = await self._encode_image_parts(messages)
+            pre = self.preprocessor.preprocess_chat_messages(messages, request)
+            self._clamp(pre)
+            sp.set_attr("input_tokens", len(pre.token_ids))
         include_usage = bool(
             request.stream_options and request.stream_options.include_usage
         ) or not request.stream
@@ -82,8 +90,13 @@ class ModelPipeline:
         self, request: CompletionRequest, context: Optional[Context] = None
     ) -> AsyncIterator[ChatCompletionChunk]:
         ctx = context or Context()
-        pre = self.preprocessor.preprocess_completion(request)
-        self._clamp(pre)
+        with telemetry.span(
+            "preprocess", service="frontend",
+            attrs={"model": self.card.name},
+        ) as sp:
+            pre = self.preprocessor.preprocess_completion(request)
+            self._clamp(pre)
+            sp.set_attr("input_tokens", len(pre.token_ids))
         include_usage = bool(
             request.stream_options and request.stream_options.include_usage
         ) or not request.stream

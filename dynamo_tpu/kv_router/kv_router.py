@@ -19,6 +19,7 @@ import asyncio
 import logging
 from typing import Optional, Sequence
 
+from dynamo_tpu import telemetry
 from dynamo_tpu.kv_router.indexer import KvIndexer
 from dynamo_tpu.kv_router.metrics_aggregator import MetricsAggregator
 from dynamo_tpu.kv_router.scheduler import (
@@ -120,25 +121,40 @@ class KvRouter:
     ) -> tuple[Optional[str], int]:
         """Pick a worker for this prompt; returns (instance_id, overlap_blocks)
         and registers the in-flight footprint when request_id is given."""
-        instances = self.source.list()
-        if not instances:
-            instances = await self.source.wait_for_instances(timeout=2.0)
-        ids = [i.instance_id for i in instances]
-        hashes = hash_token_blocks(
-            token_ids, block_size=self.block_size, salt=self.salt
-        )
-        overlaps = self.indexer.find_matches(hashes)
-        choice = self.selector.select(
-            self._snapshots(ids), overlaps.scores, len(hashes)
-        )
-        if choice is None:
-            return None, 0
-        overlap = overlaps.scores.get(choice, 0)
-        if request_id is not None:
-            total_blocks = -(-len(token_ids) // self.block_size)
-            self.active.add(choice, request_id, total_blocks - overlap)
-        await self._emit_hit_rate(len(token_ids), overlap)
-        return choice, overlap
+        with telemetry.span(
+            "kv.choose", service="router",
+            attrs={"isl_tokens": len(token_ids)},
+        ) as sp:
+            instances = self.source.list()
+            if not instances:
+                instances = await self.source.wait_for_instances(timeout=2.0)
+            ids = [i.instance_id for i in instances]
+            hashes = hash_token_blocks(
+                token_ids, block_size=self.block_size, salt=self.salt
+            )
+            overlaps = self.indexer.find_matches(hashes)
+            choice = self.selector.select(
+                self._snapshots(ids), overlaps.scores, len(hashes)
+            )
+            sp.set_attr("total_blocks", len(hashes))
+            sp.set_attr("candidates", len(ids))
+            if choice is None:
+                sp.set_attr("chosen", None)
+                return None, 0
+            overlap = overlaps.scores.get(choice, 0)
+            # the routing decision, traceable per request: who won, how
+            # much of the prefix they already hold, and the score field
+            sp.set_attr("chosen", choice)
+            sp.set_attr("matched_blocks", overlap)
+            sp.set_attr(
+                "overlap_score",
+                overlap / len(hashes) if hashes else 0.0,
+            )
+            if request_id is not None:
+                total_blocks = -(-len(token_ids) // self.block_size)
+                self.active.add(choice, request_id, total_blocks - overlap)
+            await self._emit_hit_rate(len(token_ids), overlap)
+            return choice, overlap
 
     async def _emit_hit_rate(self, isl: int, overlap_blocks: int) -> None:
         try:

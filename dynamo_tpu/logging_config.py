@@ -18,6 +18,25 @@ _STD_ATTRS = set(
 ) | {"message", "asctime"}
 
 
+def _jsonable(v):
+    """A value safe to embed in the record's JSON document. Plain
+    `json.dumps(v)` only raises TypeError for foreign objects — NaN/Inf
+    floats serialize into INVALID JSON (bare `NaN` tokens) and circular
+    references raise ValueError, both of which would kill the final
+    dumps of the whole record. allow_nan=False turns the NaN case into a
+    catchable error; default=repr degrades foreign members of otherwise
+    serializable containers; anything still hostile becomes repr(v)."""
+    try:
+        json.dumps(v, allow_nan=False)
+        return v
+    except (TypeError, ValueError):
+        pass
+    try:
+        return json.loads(json.dumps(v, default=repr, allow_nan=False))
+    except Exception:  # circular refs, NaN nested in containers, ...
+        return repr(v)
+
+
 class JsonlFormatter(logging.Formatter):
     def format(self, record: logging.LogRecord) -> str:
         out = {
@@ -30,12 +49,20 @@ class JsonlFormatter(logging.Formatter):
             out["exc"] = self.formatException(record.exc_info)
         for k, v in record.__dict__.items():
             if k not in _STD_ATTRS and not k.startswith("_"):
-                try:
-                    json.dumps(v)
-                    out[k] = v
-                except TypeError:
-                    out[k] = repr(v)
-        return json.dumps(out)
+                out[k] = _jsonable(v)
+        # logs join traces for free: any record emitted inside an active
+        # span carries its ids (the explicit-extra ones win)
+        if "trace_id" not in out:
+            try:
+                from dynamo_tpu import telemetry
+
+                sp = telemetry.current_span()
+                if sp is not None:
+                    out["trace_id"] = sp.trace_id
+                    out["span_id"] = sp.span_id
+            except Exception:
+                pass
+        return json.dumps(out, default=repr)
 
 
 def env_is_truthy(name: str) -> bool:

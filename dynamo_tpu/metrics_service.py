@@ -27,7 +27,11 @@ logger = logging.getLogger(__name__)
 
 PREFIX = "dynamo_tpu"
 
-#: worker snapshot fields -> (prometheus suffix, type)
+#: worker snapshot fields -> (prometheus suffix, type). Counters whose
+#: field name lacks the `_total` suffix gain it in the EXPOSED name
+#: (Prometheus naming convention, enforced by telemetry/promlint.py in
+#: tests) — e.g. snapshot field `steps` serves as
+#: dynamo_tpu_worker_steps_total. See docs/migrating.md.
 _WORKER_FIELDS = (
     ("kv_usage", "gauge"),
     ("kv_active_pages", "gauge"),
@@ -116,6 +120,8 @@ class MetricsService:
         app = web.Application()
         app.router.add_get("/metrics", self._metrics)
         app.router.add_get("/health", self._health)
+        app.router.add_get("/v1/traces", self._traces)
+        app.router.add_get("/v1/traces/{trace_id}", self._trace)
         self._runner = web.AppRunner(app)
         await self._runner.setup()
         site = web.TCPSite(self._runner, self.host, self.port)
@@ -196,6 +202,8 @@ class MetricsService:
         ]
         for field, ptype in _WORKER_FIELDS:
             name = f"{PREFIX}_worker_{field}"
+            if ptype == "counter" and not field.endswith("_total"):
+                name += "_total"
             lines.append(f"# TYPE {name} {ptype}")
             for iid, m in sorted(snap.items()):
                 if field in m:
@@ -215,6 +223,10 @@ class MetricsService:
             f"{self.overlap_tokens_total / self.isl_tokens_total if self.isl_tokens_total else 0.0}",
         ]
         lines += self._fabric_lines()
+        # per-phase latency histograms (telemetry plane, process-global)
+        from dynamo_tpu.telemetry import phases
+
+        lines += phases.expose_lines()
         return "\n".join(lines) + "\n"
 
     async def _metrics(self, request: web.Request) -> web.Response:
@@ -226,3 +238,17 @@ class MetricsService:
         return web.json_response(
             {"status": "ok", "workers": len(self.aggregator.snapshot())}
         )
+
+    async def _traces(self, request: web.Request) -> web.Response:
+        from dynamo_tpu.telemetry.http_api import traces_payload
+
+        body, status = traces_payload(request.query.get("limit"))
+        return web.json_response(body, status=status)
+
+    async def _trace(self, request: web.Request) -> web.Response:
+        from dynamo_tpu.telemetry.http_api import trace_payload
+
+        body, status = trace_payload(
+            request.match_info["trace_id"], request.query.get("format")
+        )
+        return web.json_response(body, status=status)

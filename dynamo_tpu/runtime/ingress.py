@@ -17,6 +17,7 @@ import asyncio
 import logging
 from typing import AsyncIterator, Awaitable, Callable, Optional
 
+from dynamo_tpu import telemetry
 from dynamo_tpu.runtime.codec import encode_frame, read_frame
 from dynamo_tpu.runtime.context import Context
 
@@ -129,13 +130,26 @@ class IngressServer:
                 )
                 return
             request = msgpack.unpackb(payload, raw=False) if payload else None
-            async for item in handler(ctx, request):
-                if ctx.cancelled:
-                    break
-                await send(
-                    {"op": "data", "request_id": rid},
-                    msgpack.packb(item, use_bin_type=True),
-                )
+            # the trace context rode the call header's metadata (PushRouter
+            # injects it); this span is the worker-side stitch point every
+            # engine/disagg span below nests under (same task => contextvar)
+            with telemetry.span(
+                f"worker.{endpoint}", service="worker",
+                parent=telemetry.extract(ctx.metadata),
+                attrs={"request_id": rid},
+            ) as wspan:
+                n_items = 0
+                async for item in handler(ctx, request):
+                    if ctx.cancelled:
+                        break
+                    n_items += 1
+                    if n_items == 1:
+                        wspan.add_event("first_item")
+                    await send(
+                        {"op": "data", "request_id": rid},
+                        msgpack.packb(item, use_bin_type=True),
+                    )
+                wspan.set_attr("items", n_items)
             await send({"op": "end", "request_id": rid, "cancelled": ctx.cancelled})
         except asyncio.CancelledError:
             try:

@@ -16,11 +16,13 @@ import enum
 import itertools
 import logging
 import random
+import time
 import uuid
 from typing import Any, AsyncIterator, Optional
 
 import msgpack
 
+from dynamo_tpu import telemetry
 from dynamo_tpu.runtime.codec import encode_frame, read_frame
 from dynamo_tpu.runtime.component import Instance, InstanceSource
 from dynamo_tpu.runtime.context import (
@@ -28,6 +30,7 @@ from dynamo_tpu.runtime.context import (
     Context,
     queue_get_or_cancelled,
 )
+from dynamo_tpu.telemetry import phases
 
 logger = logging.getLogger(__name__)
 
@@ -146,82 +149,125 @@ class PushRouter:
         as EngineStreamError after marking the instance down."""
         ctx = context or Context()
         attempts = 0
-        while True:
-            attempts += 1
-            inst = await self._pick(request, instance_id)
-            try:
-                conn = await self._conn_for(inst)
-            except OSError:
-                self.source.mark_down(inst.instance_id)
-                if attempts >= max_attempts:
-                    raise NoInstancesError(
-                        f"no reachable instance for {self.endpoint}"
-                    )
-                continue
+        with telemetry.span(
+            "router.dispatch", service="router",
+            attrs={"endpoint": self.endpoint, "mode": self.mode.value},
+        ) as rspan:
+            t_dispatch = time.perf_counter()
+            dispatched = False  # first response frame seen (any op)
 
-            rid = ctx.request_id + "-" + uuid.uuid4().hex[:6]
-            q: asyncio.Queue = asyncio.Queue()
-            conn.streams[rid] = q
-            try:
-                await conn.send(
-                    {
-                        "op": "call", "request_id": rid,
-                        "endpoint": self.endpoint, "metadata": ctx.metadata,
-                    },
-                    msgpack.packb(request, use_bin_type=True),
-                )
-            except (OSError, ConnectionError):
-                conn.streams.pop(rid, None)
-                self.source.mark_down(inst.instance_id)
-                if attempts >= max_attempts:
-                    raise NoInstancesError(
-                        f"no reachable instance for {self.endpoint}"
+            def _first_frame() -> None:
+                nonlocal dispatched
+                if not dispatched:
+                    dispatched = True
+                    phases.observe(
+                        "router_dispatch_ms",
+                        (time.perf_counter() - t_dispatch) * 1000.0,
                     )
-                continue
+                    rspan.add_event("first_frame")
 
-            got_data = False
-            try:
-                while True:
-                    if ctx.cancelled:
-                        try:
-                            await conn.send({"op": "cancel", "request_id": rid})
-                        except Exception:
-                            pass
-                        return
-                    # race q.get() against cancellation so a cancel issued
-                    # while idle reaches the worker immediately
-                    item = await queue_get_or_cancelled(ctx, q)
-                    if item is CANCELLED:
-                        continue  # loop re-checks ctx.cancelled and notifies
-                    if item is None:  # connection dropped mid-stream
-                        self.source.mark_down(inst.instance_id)
-                        if got_data or attempts >= max_attempts:
-                            raise EngineStreamError(
-                                f"stream from {inst.instance_id} dropped"
-                            )
-                        break  # retry another instance
-                    header, payload = item
-                    op = header["op"]
-                    if op == "data":
-                        got_data = True
-                        yield msgpack.unpackb(payload, raw=False)
-                    elif op == "end":
-                        return
-                    elif op == "error":
-                        if header.get("retryable") and not got_data:
-                            # the worker itself says another instance
-                            # should take this (its engine subprocess is
-                            # down/restarting): mark down + retry, same
-                            # as a pre-stream connection failure
+            while True:
+                attempts += 1
+                inst = await self._pick(request, instance_id)
+                rspan.set_attr("instance_id", inst.instance_id)
+                rspan.set_attr("attempts", attempts)
+                try:
+                    conn = await self._conn_for(inst)
+                except OSError:
+                    self.source.mark_down(inst.instance_id)
+                    rspan.add_event(
+                        "mark_down", instance=inst.instance_id,
+                        reason="connect failed",
+                    )
+                    if attempts >= max_attempts:
+                        raise NoInstancesError(
+                            f"no reachable instance for {self.endpoint}"
+                        )
+                    continue
+
+                rid = ctx.request_id + "-" + uuid.uuid4().hex[:6]
+                q: asyncio.Queue = asyncio.Queue()
+                conn.streams[rid] = q
+                try:
+                    await conn.send(
+                        {
+                            "op": "call", "request_id": rid,
+                            "endpoint": self.endpoint,
+                            # trace context rides the request-header
+                            # metadata so the worker's spans stitch under
+                            # this dispatch span
+                            "metadata": telemetry.inject(
+                                dict(ctx.metadata)
+                            ),
+                        },
+                        msgpack.packb(request, use_bin_type=True),
+                    )
+                except (OSError, ConnectionError):
+                    conn.streams.pop(rid, None)
+                    self.source.mark_down(inst.instance_id)
+                    rspan.add_event(
+                        "mark_down", instance=inst.instance_id,
+                        reason="send failed",
+                    )
+                    if attempts >= max_attempts:
+                        raise NoInstancesError(
+                            f"no reachable instance for {self.endpoint}"
+                        )
+                    continue
+
+                got_data = False
+                try:
+                    while True:
+                        if ctx.cancelled:
+                            try:
+                                await conn.send({"op": "cancel", "request_id": rid})
+                            except Exception:
+                                pass
+                            return
+                        # race q.get() against cancellation so a cancel issued
+                        # while idle reaches the worker immediately
+                        item = await queue_get_or_cancelled(ctx, q)
+                        if item is CANCELLED:
+                            continue  # loop re-checks ctx.cancelled and notifies
+                        if item is None:  # connection dropped mid-stream
                             self.source.mark_down(inst.instance_id)
-                            if attempts >= max_attempts:
+                            rspan.add_event(
+                                "mark_down", instance=inst.instance_id,
+                                reason="stream dropped",
+                            )
+                            if got_data or attempts >= max_attempts:
                                 raise EngineStreamError(
-                                    header.get("message")
+                                    f"stream from {inst.instance_id} dropped"
                                 )
-                            break
-                        raise EngineStreamError(header.get("message"))
-            finally:
-                conn.streams.pop(rid, None)
+                            break  # retry another instance
+                        header, payload = item
+                        op = header["op"]
+                        _first_frame()
+                        if op == "data":
+                            got_data = True
+                            yield msgpack.unpackb(payload, raw=False)
+                        elif op == "end":
+                            return
+                        elif op == "error":
+                            if header.get("retryable") and not got_data:
+                                # the worker itself says another instance
+                                # should take this (its engine subprocess is
+                                # down/restarting): mark down + retry, same
+                                # as a pre-stream connection failure
+                                self.source.mark_down(inst.instance_id)
+                                rspan.add_event(
+                                    "mark_down",
+                                    instance=inst.instance_id,
+                                    reason="retryable error",
+                                )
+                                if attempts >= max_attempts:
+                                    raise EngineStreamError(
+                                        header.get("message")
+                                    )
+                                break
+                            raise EngineStreamError(header.get("message"))
+                finally:
+                    conn.streams.pop(rid, None)
 
     def close(self) -> None:
         for conn in self._conns.values():

@@ -1,0 +1,30 @@
+"""dynamo_tpu.telemetry — the cross-cutting observability plane.
+
+- trace: spans + contextvar propagation + the in-memory trace ring
+  (docs/observability.md); default OFF, enable with DYNTPU_TRACING=1 /
+  DYNTPU_TRACE_RING=<n> / configure().
+- phases: per-phase latency histograms (queue_wait, prefill,
+  decode_step, router_dispatch, disagg_transfer) for /metrics.
+- chrome_export: trace -> Chrome trace-event JSON (Perfetto).
+- promlint: pure-python Prometheus exposition linter (tests gate every
+  hand-rolled /metrics surface with it).
+"""
+
+from dynamo_tpu.telemetry import phases  # noqa: F401
+from dynamo_tpu.telemetry.trace import (  # noqa: F401
+    NOOP_SPAN,
+    Span,
+    TraceRing,
+    configure,
+    context_from_headers,
+    current_span,
+    enabled,
+    extract,
+    get_trace,
+    inject,
+    list_traces,
+    record_span_dict,
+    reset,
+    span,
+    wire_context,
+)

@@ -1,0 +1,123 @@
+"""Chrome trace-event export: one trace -> a JSON document loadable in
+Perfetto (https://ui.perfetto.dev) or chrome://tracing.
+
+Mapping: every service (frontend / router / worker / engine / ext-child
+/ prefill) becomes a pid with a process_name metadata event; every span
+becomes a complete ("ph": "X") event on its own tid lane within that
+pid (lanes keep concurrent spans of one service from visually merging);
+span events become instant ("ph": "i") events on the same lane. ts/dur
+are integer MICROSECONDS with ts anchored at each span's wall-clock
+start — cross-process spans line up as well as the hosts' clocks do.
+
+Usage:
+  python -m dynamo_tpu.telemetry.chrome_export <trace_id> \
+      [--url http://127.0.0.1:8080] [-o out.json]
+or in-process: `export_trace(trace_id)` writes `<trace_id>.json`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+from dynamo_tpu.telemetry import trace as _trace
+
+
+def to_chrome_trace(spans: list[dict]) -> dict:
+    """Span dicts (trace.Span.to_dict shape) -> trace-event JSON doc."""
+    events: list[dict] = []
+    pids: dict[str, int] = {}
+    lanes: dict[int, int] = {}  # pid -> next tid lane
+    for s in sorted(spans, key=lambda s: s.get("start_ts") or 0.0):
+        service = str(s.get("service") or "app")
+        pid = pids.setdefault(service, len(pids) + 1)
+        if pid not in lanes:
+            events.append(
+                {
+                    "ph": "M", "name": "process_name", "pid": pid,
+                    "tid": 0, "args": {"name": service},
+                }
+            )
+            lanes[pid] = 0
+        lanes[pid] += 1
+        tid = lanes[pid]
+        ts_us = int(float(s.get("start_ts") or 0.0) * 1e6)
+        dur_us = max(1, int(float(s.get("duration_ms") or 0.0) * 1e3))
+        events.append(
+            {
+                "name": str(s.get("name") or "span"),
+                "cat": service,
+                "ph": "X",
+                "ts": ts_us,
+                "dur": dur_us,
+                "pid": pid,
+                "tid": tid,
+                "args": {
+                    "span_id": s.get("span_id"),
+                    "parent_id": s.get("parent_id"),
+                    "status": s.get("status"),
+                    **(s.get("attrs") or {}),
+                },
+            }
+        )
+        for ev in s.get("events") or ():
+            events.append(
+                {
+                    "name": str(ev.get("name") or "event"),
+                    "cat": service,
+                    "ph": "i",
+                    "s": "t",
+                    "ts": int(float(ev.get("ts") or 0.0) * 1e6),
+                    "pid": pid,
+                    "tid": tid,
+                    "args": dict(ev.get("attrs") or {}),
+                }
+            )
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def export_trace(
+    trace_id: str,
+    path: Optional[str] = None,
+    spans: Optional[list[dict]] = None,
+) -> str:
+    """Write `<trace_id>.json` (or `path`) for one recorded trace from
+    this process's ring (or an explicit span list). Returns the path;
+    raises KeyError when the trace is unknown."""
+    if spans is None:
+        spans = _trace.get_trace(trace_id)
+    if spans is None:
+        raise KeyError(f"trace {trace_id!r} not in the ring")
+    path = path or f"{trace_id}.json"
+    with open(path, "w") as f:
+        json.dump(to_chrome_trace(spans), f)
+    return path
+
+
+def main(argv: Optional[list[str]] = None) -> None:
+    import argparse
+    import urllib.request
+
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("trace_id")
+    p.add_argument(
+        "--url", default=os.environ.get(
+            "DYNTPU_TRACE_URL", "http://127.0.0.1:8080"
+        ),
+        help="base URL of a frontend/metrics service serving /v1/traces",
+    )
+    p.add_argument("-o", "--output", default=None)
+    args = p.parse_args(argv)
+    with urllib.request.urlopen(
+        f"{args.url}/v1/traces/{args.trace_id}", timeout=10
+    ) as resp:
+        doc = json.loads(resp.read())
+    path = export_trace(
+        args.trace_id, path=args.output, spans=doc["spans"]
+    )
+    print(path)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,37 @@
+"""The trace-API payloads shared by every HTTP surface that serves a
+process's trace ring (the OpenAI frontend and the metrics service both
+mount GET /v1/traces and GET /v1/traces/{trace_id}). Framework-free:
+handlers pass raw query/path strings in and get (json-able body, http
+status) back, so the two aiohttp mounts can't drift apart."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from dynamo_tpu.telemetry import trace as _trace
+
+
+def traces_payload(limit_str: Optional[str]) -> tuple[dict, int]:
+    """GET /v1/traces?limit=N -> (body, status)."""
+    try:
+        limit = int(limit_str) if limit_str is not None else 50
+    except ValueError:
+        return {"error": "limit must be int"}, 400
+    return {
+        "enabled": _trace._tracer.enabled,
+        "traces": _trace.list_traces(limit),
+    }, 200
+
+
+def trace_payload(
+    trace_id: str, fmt: Optional[str] = None
+) -> tuple[dict, int]:
+    """GET /v1/traces/{trace_id}[?format=chrome] -> (body, status)."""
+    spans = _trace.get_trace(trace_id)
+    if spans is None:
+        return {"error": f"trace {trace_id!r} not found"}, 404
+    if fmt == "chrome":
+        from dynamo_tpu.telemetry.chrome_export import to_chrome_trace
+
+        return to_chrome_trace(spans), 200
+    return {"trace_id": trace_id, "spans": spans}, 200

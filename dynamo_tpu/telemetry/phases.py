@@ -1,0 +1,93 @@
+"""Per-phase latency histograms (Prometheus exposition lines).
+
+The aggregate time_*_ms counters (EngineMetrics) answer "where does the
+fleet's time go"; these histograms answer "what does one request's phase
+COST look like" — tails included. Observed unconditionally (they are
+metrics, not traces; a few float compares under a lock per event), and
+appended to both FrontendMetrics.expose() and MetricsService.expose()
+so whichever process hosts the phase shows it on /metrics.
+
+Phases:
+  queue_wait_ms        admission wait in the engine scheduler
+  prefill_ms           one prefill dispatch (host+device wall time)
+  decode_step_ms       one decode dispatch
+  router_dispatch_ms   PushRouter pick->first response frame
+  disagg_transfer_ms   remote prefill enqueue->KV landing
+"""
+
+from __future__ import annotations
+
+import threading
+
+PREFIX = "dynamo_tpu_phase"
+
+PHASES = (
+    "queue_wait_ms",
+    "prefill_ms",
+    "decode_step_ms",
+    "router_dispatch_ms",
+    "disagg_transfer_ms",
+)
+
+#: ms ladder wide enough for a sub-ms decode step and a 60s stuck
+#: transfer alike
+BUCKETS_MS = (
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0,
+    500.0, 1000.0, 2500.0, 5000.0, 15000.0, 60000.0,
+)
+
+
+class PhaseHistograms:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counts: dict[str, list[int]] = {}
+        self._sums: dict[str, float] = {}
+
+    def observe(self, phase: str, value_ms: float) -> None:
+        with self._lock:
+            counts = self._counts.get(phase)
+            if counts is None:
+                counts = self._counts[phase] = [0] * (len(BUCKETS_MS) + 1)
+                self._sums[phase] = 0.0
+            self._sums[phase] += value_ms
+            for i, b in enumerate(BUCKETS_MS):
+                if value_ms <= b:
+                    counts[i] += 1
+                    return
+            counts[-1] += 1
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counts.clear()
+            self._sums.clear()
+
+    def expose_lines(self) -> list[str]:
+        """Prometheus text lines for every phase that has observations."""
+        lines: list[str] = []
+        with self._lock:
+            for phase in PHASES:
+                counts = self._counts.get(phase)
+                if counts is None:
+                    continue
+                name = f"{PREFIX}_{phase}"
+                lines.append(f"# TYPE {name} histogram")
+                cum = 0
+                for i, b in enumerate(BUCKETS_MS):
+                    cum += counts[i]
+                    lines.append(f'{name}_bucket{{le="{b}"}} {cum}')
+                cum += counts[-1]
+                lines.append(f'{name}_bucket{{le="+Inf"}} {cum}')
+                lines.append(f"{name}_sum {self._sums[phase]}")
+                lines.append(f"{name}_count {cum}")
+        return lines
+
+
+phase_histograms = PhaseHistograms()
+
+
+def observe(phase: str, value_ms: float) -> None:
+    phase_histograms.observe(phase, value_ms)
+
+
+def expose_lines() -> list[str]:
+    return phase_histograms.expose_lines()

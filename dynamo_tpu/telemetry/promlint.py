@@ -1,0 +1,182 @@
+"""Pure-python Prometheus text-exposition linter.
+
+The repo hand-rolls its exposition (no client library in the image), so
+nothing structurally validates what /metrics emits — a stray duplicate
+`# TYPE`, an unescaped label value, or a non-monotonic histogram bucket
+silently corrupts scrapes. `lint(text)` returns a list of human-readable
+problems (empty == clean); tests run it against FrontendMetrics.expose()
+and MetricsService.expose() so future metric additions can't regress
+the format.
+
+Checks:
+  - sample/metadata line shape (name, optional {labels}, float value)
+  - label syntax + escaping (\\, \", \\n escaped inside quoted values)
+  - at most one `# TYPE` per metric family, declared before its samples
+  - every sample belongs to a declared family (suffix-aware for
+    histogram/summary series)
+  - counters end in `_total` (per the Prometheus naming convention)
+  - histograms: per-label-set cumulative buckets are monotonically
+    non-decreasing, an `le="+Inf"` bucket exists and equals `_count`
+"""
+
+from __future__ import annotations
+
+import math
+import re
+
+_NAME = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
+_METRIC_RE = re.compile(
+    rf"^({_NAME})(\{{(.*)\}})?\s+(\S+)(\s+\S+)?$"
+)
+_LABEL_RE = re.compile(
+    rf'({_NAME})="((?:[^"\\\n]|\\\\|\\"|\\n)*)"'
+)
+_TYPE_RE = re.compile(rf"^# TYPE ({_NAME}) (\w+)$")
+_VALID_TYPES = {"counter", "gauge", "histogram", "summary", "untyped"}
+_SUFFIXES = {
+    "histogram": ("_bucket", "_sum", "_count"),
+    "summary": ("_sum", "_count"),
+    "counter": ("_total", "_created"),
+}
+
+
+def _parse_labels(raw: str, line_no: int, errors: list[str]) -> dict:
+    labels: dict[str, str] = {}
+    pos = 0
+    raw = raw.strip()
+    while pos < len(raw):
+        m = _LABEL_RE.match(raw, pos)
+        if m is None:
+            errors.append(
+                f"line {line_no}: bad label syntax/escaping at "
+                f"{raw[pos:pos + 40]!r}"
+            )
+            return labels
+        if m.group(1) in labels:
+            errors.append(
+                f"line {line_no}: duplicate label {m.group(1)!r}"
+            )
+        labels[m.group(1)] = m.group(2)
+        pos = m.end()
+        if pos < len(raw):
+            if raw[pos] != ",":
+                errors.append(
+                    f"line {line_no}: expected ',' between labels at "
+                    f"{raw[pos:pos + 20]!r}"
+                )
+                return labels
+            pos += 1
+    return labels
+
+
+def _family_of(name: str, types: dict[str, str]) -> str | None:
+    """Which declared family a sample name belongs to (suffix-aware)."""
+    if name in types:
+        return name
+    for fam, t in types.items():
+        for suf in _SUFFIXES.get(t, ()):
+            if name == fam + suf:
+                return fam
+    return None
+
+
+def lint(text: str) -> list[str]:
+    errors: list[str] = []
+    types: dict[str, str] = {}
+    seen_sample_of: set[str] = set()
+    # histogram state: family -> {label-key-without-le: [(le, cum), ...]}
+    buckets: dict[str, dict[tuple, list[tuple[float, float]]]] = {}
+    counts: dict[str, dict[tuple, float]] = {}
+
+    for i, line in enumerate(text.splitlines(), start=1):
+        if not line.strip():
+            continue
+        if line.startswith("#"):
+            if line.startswith("# TYPE"):
+                m = _TYPE_RE.match(line)
+                if m is None:
+                    errors.append(f"line {i}: malformed TYPE line")
+                    continue
+                fam, t = m.group(1), m.group(2)
+                if t not in _VALID_TYPES:
+                    errors.append(
+                        f"line {i}: unknown metric type {t!r} for {fam}"
+                    )
+                if fam in types:
+                    errors.append(
+                        f"line {i}: duplicate '# TYPE {fam}'"
+                    )
+                if fam in seen_sample_of:
+                    errors.append(
+                        f"line {i}: TYPE for {fam} declared after its "
+                        "samples"
+                    )
+                types[fam] = t
+                if t == "counter" and not fam.endswith("_total"):
+                    errors.append(
+                        f"line {i}: counter {fam!r} must end in '_total'"
+                    )
+            continue  # other comments (# HELP) are fine
+        m = _METRIC_RE.match(line)
+        if m is None:
+            errors.append(f"line {i}: unparseable sample {line!r:.80}")
+            continue
+        name, _, rawlabels, value = (
+            m.group(1), m.group(2), m.group(3), m.group(4),
+        )
+        labels = (
+            _parse_labels(rawlabels, i, errors) if rawlabels else {}
+        )
+        try:
+            val = float(value)
+        except ValueError:
+            errors.append(f"line {i}: non-numeric value {value!r}")
+            continue
+        fam = _family_of(name, types)
+        if fam is None:
+            errors.append(
+                f"line {i}: sample {name!r} has no preceding '# TYPE'"
+            )
+            continue
+        seen_sample_of.add(fam)
+        if types[fam] == "histogram":
+            key = tuple(
+                sorted((k, v) for k, v in labels.items() if k != "le")
+            )
+            if name == fam + "_bucket":
+                le = labels.get("le")
+                if le is None:
+                    errors.append(
+                        f"line {i}: histogram bucket without 'le' label"
+                    )
+                    continue
+                lev = math.inf if le == "+Inf" else float(le)
+                buckets.setdefault(fam, {}).setdefault(key, []).append(
+                    (lev, val)
+                )
+            elif name == fam + "_count":
+                counts.setdefault(fam, {})[key] = val
+
+    for fam, series in buckets.items():
+        for key, pairs in series.items():
+            pairs.sort(key=lambda p: p[0])
+            prev = -math.inf
+            for le, cum in pairs:
+                if cum < prev:
+                    errors.append(
+                        f"{fam}{dict(key)}: bucket le={le} count {cum} "
+                        f"< previous {prev} (non-monotonic)"
+                    )
+                prev = cum
+            if not pairs or pairs[-1][0] != math.inf:
+                errors.append(
+                    f"{fam}{dict(key)}: missing le=\"+Inf\" bucket"
+                )
+            else:
+                total = counts.get(fam, {}).get(key)
+                if total is not None and total != pairs[-1][1]:
+                    errors.append(
+                        f"{fam}{dict(key)}: _count {total} != +Inf "
+                        f"bucket {pairs[-1][1]}"
+                    )
+    return errors

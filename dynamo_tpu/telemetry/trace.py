@@ -1,0 +1,450 @@
+"""Dependency-free distributed tracing: spans, context propagation, ring.
+
+The repo's observability plane is hand-rolled (no prometheus_client, no
+opentelemetry in the image) — this module follows suit. One request
+produces one TRACE (a 32-hex id minted at the HTTP frontend from an
+incoming `traceparent`/`x-request-id` header, or generated); every hop
+contributes SPANS (named, timed, attributed) stitched by
+(trace_id, parent span id):
+
+  frontend `http.request`
+    └─ `preprocess`
+    └─ router `router.dispatch` ── `kv.choose` (matched blocks / overlap)
+         └─ worker `worker.generate`          (rides fabric metadata)
+              └─ engine `engine.generate`
+                   └─ ext-child `child.generate`  (rides the external
+                                                   wire; shipped back as
+                                                   `span` frames)
+              └─ disagg `disagg.remote_prefill`   (rides the prefill
+                                                   queue item)
+
+Propagation is a contextvar inside a process (everything that runs in
+the request's asyncio task sees the current span) and a small wire dict
+`{"trace_id", "span_id"}` across processes — carried in the fabric
+request-header `metadata` (ingress/PushRouter), the external-engine
+`generate` frame, and `RemotePrefillRequest.trace`.
+
+Default OFF: with no env toggle, `span()` yields a shared no-op object,
+the contextvar is never touched, and nothing is recorded — serving is
+bit-identical. Enable with `DYNTPU_TRACING=1` (ring of 256 traces) or
+`DYNTPU_TRACE_RING=<n>` (explicit capacity; 0 keeps tracing off), or
+programmatically via `configure()`.
+
+Finished spans land in a bounded in-memory ring keyed by trace_id —
+served by `GET /v1/traces/{id}` / `GET /v1/traces?limit=N` on the HTTP
+frontend and the metrics service, exportable as Chrome trace-event JSON
+(telemetry/chrome_export.py), and joined with JSONL logs for free via
+logging_config.JsonlFormatter's trace_id/span_id injection.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import hashlib
+import os
+import re
+import threading
+import time
+import uuid
+from collections import OrderedDict
+from typing import Any, Iterator, Optional
+
+__all__ = [
+    "Span",
+    "TraceRing",
+    "configure",
+    "enabled",
+    "span",
+    "current_span",
+    "wire_context",
+    "inject",
+    "extract",
+    "context_from_headers",
+    "get_trace",
+    "list_traces",
+    "record_span_dict",
+    "ring",
+    "reset",
+]
+
+_HEX32 = re.compile(r"^[0-9a-f]{32}$")
+_HEX16 = re.compile(r"^[0-9a-f]{16}$")
+_TRACEPARENT = re.compile(
+    r"^[0-9a-f]{2}-([0-9a-f]{32})-([0-9a-f]{16})-[0-9a-f]{2}$"
+)
+
+#: the single contextvar carrying the active span for this task tree
+_current: contextvars.ContextVar[Optional["Span"]] = contextvars.ContextVar(
+    "dyntpu_current_span", default=None
+)
+
+
+def new_trace_id() -> str:
+    return uuid.uuid4().hex
+
+
+def new_span_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+class Span:
+    """One timed unit of work. Wall-clock anchored at start; duration via
+    the monotonic perf counter so clock steps can't produce negative or
+    inflated spans. end() is idempotent; the first call records."""
+
+    __slots__ = (
+        "trace_id", "span_id", "parent_id", "name", "service",
+        "start_ts", "duration_ms", "status", "attrs", "events", "_t0",
+        "_done",
+    )
+
+    def __init__(
+        self,
+        name: str,
+        service: str,
+        trace_id: str,
+        parent_id: Optional[str] = None,
+        attrs: Optional[dict] = None,
+    ):
+        self.name = name
+        self.service = service
+        self.trace_id = trace_id
+        self.span_id = new_span_id()
+        self.parent_id = parent_id
+        self.start_ts = time.time()
+        self._t0 = time.perf_counter()
+        self.duration_ms: Optional[float] = None
+        self.status = "ok"
+        self.attrs: dict[str, Any] = dict(attrs or {})
+        self.events: list[dict] = []
+        self._done = False
+
+    def set_attr(self, key: str, value: Any) -> None:
+        self.attrs[key] = value
+
+    def add_event(self, name: str, **attrs: Any) -> None:
+        self.events.append(
+            {"ts": time.time(), "name": name, "attrs": attrs}
+        )
+
+    def wire(self) -> dict:
+        return {"trace_id": self.trace_id, "span_id": self.span_id}
+
+    def end(self, status: Optional[str] = None) -> None:
+        if self._done:
+            return
+        self._done = True
+        self.duration_ms = (time.perf_counter() - self._t0) * 1000.0
+        if status is not None:
+            self.status = status
+        _tracer.record(self.to_dict())
+
+    def to_dict(self) -> dict:
+        return {
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "name": self.name,
+            "service": self.service,
+            "start_ts": self.start_ts,
+            "duration_ms": self.duration_ms,
+            "status": self.status,
+            "attrs": dict(self.attrs),
+            "events": list(self.events),
+        }
+
+
+class _NoopSpan:
+    """Shared do-nothing span: the disabled path allocates nothing and
+    never touches the contextvar."""
+
+    __slots__ = ()
+    trace_id = ""
+    span_id = ""
+    status = "ok"
+
+    def set_attr(self, key: str, value: Any) -> None:
+        pass
+
+    def add_event(self, name: str, **attrs: Any) -> None:
+        pass
+
+    def end(self, status: Optional[str] = None) -> None:
+        pass
+
+    def wire(self) -> dict:
+        return {}
+
+
+NOOP_SPAN = _NoopSpan()
+
+
+class TraceRing:
+    """Bounded store of finished spans keyed by trace_id. Capacity counts
+    TRACES (insertion order eviction), so one chatty request can't evict
+    a thousand quiet ones span-by-span. Thread-safe: spans arrive from
+    the event loop and the engine thread alike."""
+
+    #: spans kept per trace — a client that reuses one x-request-id (so
+    #: one deterministic trace id) forever must not grow a list without
+    #: bound; past the cap new spans are dropped
+    MAX_SPANS_PER_TRACE = 512
+
+    def __init__(self, capacity: int = 256):
+        self.capacity = capacity
+        self._lock = threading.Lock()
+        self._traces: "OrderedDict[str, list[dict]]" = OrderedDict()
+
+    def record(self, span_dict: dict) -> None:
+        tid = span_dict.get("trace_id")
+        if not tid or self.capacity <= 0:
+            return
+        with self._lock:
+            spans = self._traces.get(tid)
+            if spans is None:
+                while len(self._traces) >= self.capacity:
+                    self._traces.popitem(last=False)
+                spans = self._traces[tid] = []
+            if len(spans) < self.MAX_SPANS_PER_TRACE:
+                spans.append(span_dict)
+
+    def get(self, trace_id: str) -> Optional[list[dict]]:
+        with self._lock:
+            spans = self._traces.get(trace_id)
+            return list(spans) if spans is not None else None
+
+    def list(self, limit: int = 50) -> list[dict]:
+        """Newest-first trace summaries. Adopted spans are third-party
+        input (the external wire) — every field access here tolerates
+        missing keys rather than 500ing the /v1/traces endpoint."""
+        if limit <= 0:
+            return []
+        with self._lock:
+            items = list(self._traces.items())[-limit:]
+        out = []
+        for tid, spans in reversed(items):
+            # local root: parent absent OR remote (minted from an incoming
+            # traceparent header, so the parent span lives upstream)
+            local_ids = {s.get("span_id") for s in spans}
+            roots = [
+                s for s in spans if s.get("parent_id") not in local_ids
+            ]
+            head = roots[0] if roots else (spans[0] if spans else {})
+            out.append(
+                {
+                    "trace_id": tid,
+                    "root": head.get("name"),
+                    "service": head.get("service"),
+                    "start_ts": min(
+                        (
+                            s["start_ts"]
+                            for s in spans
+                            if isinstance(
+                                s.get("start_ts"), (int, float)
+                            )
+                        ),
+                        default=None,
+                    ),
+                    "duration_ms": head.get("duration_ms"),
+                    "spans": len(spans),
+                    "services": sorted(
+                        {str(s.get("service") or "?") for s in spans}
+                    ),
+                }
+            )
+        return out
+
+    def clear(self) -> None:
+        with self._lock:
+            self._traces.clear()
+
+
+class _Tracer:
+    def __init__(self) -> None:
+        ring_env = os.environ.get("DYNTPU_TRACE_RING", "")
+        try:
+            ring_size = int(ring_env) if ring_env else 0
+        except ValueError:
+            ring_size = 0
+        toggled = os.environ.get("DYNTPU_TRACING", "").lower() in (
+            "1", "true", "yes", "on"
+        )
+        self.enabled = toggled or ring_size > 0
+        self.ring = TraceRing(ring_size if ring_size > 0 else 256)
+
+    def configure(
+        self,
+        enabled: Optional[bool] = None,
+        ring_size: Optional[int] = None,
+    ) -> None:
+        if ring_size is not None:
+            if ring_size <= 0:
+                self.enabled = False
+            else:
+                self.ring.capacity = ring_size
+        if enabled is not None:
+            self.enabled = enabled
+
+    def record(self, span_dict: dict) -> None:
+        if self.enabled:
+            self.ring.record(span_dict)
+
+
+_tracer = _Tracer()
+ring = _tracer.ring
+
+
+def configure(
+    enabled: Optional[bool] = None, ring_size: Optional[int] = None
+) -> None:
+    """Programmatic toggle (the CLI's --trace flag; tests)."""
+    _tracer.configure(enabled=enabled, ring_size=ring_size)
+
+
+def enabled() -> bool:
+    return _tracer.enabled
+
+
+def reset() -> None:
+    """Drop all recorded traces (tests)."""
+    _tracer.ring.clear()
+
+
+def current_span() -> Optional[Span]:
+    return _current.get()
+
+
+def _resolve_parent(parent: Any) -> tuple[Optional[str], Optional[str]]:
+    """-> (trace_id, parent span_id) from a Span, a wire dict, or None."""
+    if isinstance(parent, Span):
+        return parent.trace_id, parent.span_id
+    if isinstance(parent, dict):
+        tid = parent.get("trace_id")
+        if isinstance(tid, str) and _HEX32.match(tid):
+            sid = parent.get("span_id")
+            if not (isinstance(sid, str) and _HEX16.match(sid)):
+                sid = None
+            return tid, sid
+    return None, None
+
+
+@contextlib.contextmanager
+def span(
+    name: str,
+    service: str = "app",
+    parent: Any = None,
+    attrs: Optional[dict] = None,
+) -> Iterator[Span]:
+    """Open a span as the task's current one. Parent resolution: the
+    explicit `parent` (a Span or wire dict) wins; else the contextvar's
+    current span; else this starts a fresh trace — an absent or corrupt
+    upstream context degrades to a new root, never an error."""
+    if not _tracer.enabled:
+        yield NOOP_SPAN  # type: ignore[misc]
+        return
+    trace_id, parent_id = _resolve_parent(parent)
+    if trace_id is None:
+        cur = _current.get()
+        if cur is not None:
+            trace_id, parent_id = cur.trace_id, cur.span_id
+        else:
+            trace_id = new_trace_id()
+    sp = Span(name, service, trace_id, parent_id=parent_id, attrs=attrs)
+    token = _current.set(sp)
+    status: Optional[str] = None
+    try:
+        yield sp
+    except BaseException as e:  # noqa: BLE001 — status tagging; re-raised
+        if isinstance(e, Exception):
+            sp.set_attr("error", f"{type(e).__name__}: {e}")
+            status = "error"
+        else:
+            status = "cancelled"
+        raise
+    finally:
+        try:
+            _current.reset(token)
+        except ValueError:
+            # a span opened inside a generator can be finalized from a
+            # different context (event-loop-driven aclose); the var copy
+            # dies with that context, so a failed reset is harmless —
+            # recording the span still matters
+            pass
+        sp.end(status)
+
+
+def wire_context() -> Optional[dict]:
+    """The current span as a wire dict, or None (also None when off)."""
+    if not _tracer.enabled:
+        return None
+    cur = _current.get()
+    return cur.wire() if cur is not None else None
+
+
+def inject(metadata: dict) -> dict:
+    """Put the current trace context into a fabric-metadata-style dict
+    (mutates and returns it). No-op when tracing is off or no span is
+    active — remote peers then see no `trace` key at all."""
+    ctx = wire_context()
+    if ctx:
+        metadata["trace"] = ctx
+    return metadata
+
+
+def extract(metadata: Any) -> Optional[dict]:
+    """The inverse of inject: a validated wire dict or None. Malformed
+    values degrade to None (fresh trace downstream), never raise."""
+    if not isinstance(metadata, dict):
+        return None
+    ctx = metadata.get("trace")
+    tid, sid = _resolve_parent(ctx if isinstance(ctx, dict) else None)
+    if tid is None:
+        return None
+    return {"trace_id": tid, "span_id": sid}
+
+
+def context_from_headers(headers: Any) -> Optional[dict]:
+    """Mint the frontend's trace context from HTTP headers.
+
+    `traceparent` (W3C: 00-<trace32>-<span16>-<flags>) wins; else an
+    `x-request-id` becomes the trace id (verbatim if it already is 32
+    lowercase hex, else hashed to 32 hex so the id is deterministic and
+    greppable from the original). Absent/malformed headers -> None (the
+    caller starts a fresh root trace)."""
+    try:
+        tp = headers.get("traceparent")
+        if tp:
+            m = _TRACEPARENT.match(tp.strip().lower())
+            if m:
+                return {"trace_id": m.group(1), "span_id": m.group(2)}
+        rid = headers.get("x-request-id")
+        if rid:
+            rid = rid.strip()
+            if _HEX32.match(rid):
+                return {"trace_id": rid, "span_id": None}
+            digest = hashlib.md5(rid.encode()).hexdigest()
+            return {"trace_id": digest, "span_id": None}
+    except Exception:
+        return None
+    return None
+
+
+def record_span_dict(span_dict: Any) -> None:
+    """Adopt an already-finished span produced by another process (the
+    external-engine child ships these over the wire). Validated loosely;
+    garbage is dropped, not raised."""
+    if not _tracer.enabled or not isinstance(span_dict, dict):
+        return
+    tid = span_dict.get("trace_id")
+    if not (isinstance(tid, str) and _HEX32.match(tid)):
+        return
+    _tracer.ring.record(span_dict)
+
+
+def get_trace(trace_id: str) -> Optional[list[dict]]:
+    return _tracer.ring.get(trace_id)
+
+
+def list_traces(limit: int = 50) -> list[dict]:
+    return _tracer.ring.list(limit)

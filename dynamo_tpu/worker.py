@@ -487,49 +487,68 @@ class Worker:
         """Remote prefill: reserve pages, enqueue, wait for the KV landing,
         then decode locally. Yields nothing (falls back) on reservation
         failure or transfer timeout."""
+        import time as _time
+
+        from dynamo_tpu import telemetry
         from dynamo_tpu.disagg.protocol import RemotePrefillRequest
         from dynamo_tpu.engine.async_engine import _sampling_from
+        from dynamo_tpu.telemetry import phases
 
         runner = self.runner
         rid = pre.request_id
         sampling = _sampling_from(pre)
-        req = await runner.submit(
-            lambda eng: eng.allocate_for_remote_prefill(
-                rid, pre.token_ids, sampling
-            )
-        )
-        if req is None:
-            logger.info("disagg: no pages free for %s; local fallback", rid)
-            return
-        # From here until add_prefilled succeeds, any failure must give the
-        # page reservation and the transfer waiter back.
-        waiter = self.transfer_server.expect(rid)
-        try:
-            await self.prefill_queue.push(
-                RemotePrefillRequest(
-                    request_id=rid,
-                    token_ids=list(pre.token_ids),
-                    page_ids=list(req.pages),
-                    transfer_host=self.advertise_host,
-                    transfer_port=self.transfer_server.port,
-                    sampling={
-                        "temperature": pre.temperature, "top_p": pre.top_p,
-                        "top_k": pre.top_k, "seed": pre.seed,
-                    },
-                    model=self.card.name,
+        with telemetry.span(
+            "disagg.remote_prefill", service="disagg",
+            attrs={"request_id": rid, "isl_tokens": len(pre.token_ids)},
+        ) as dspan:
+            req = await runner.submit(
+                lambda eng: eng.allocate_for_remote_prefill(
+                    rid, pre.token_ids, sampling
                 )
             )
-            timeout = self.disagg_router.config.transfer_timeout_s
-            result = await asyncio.wait_for(waiter, timeout)
-        except Exception:
-            self.transfer_server.forget(rid)
-            await runner.submit(lambda eng: eng.cancel_remote_prefill(req))
-            logger.warning(
-                "disagg: remote prefill for %s failed/timed out; local fallback",
-                rid,
-            )
-            return
-        self.remote_prefills += 1
+            if req is None:
+                logger.info(
+                    "disagg: no pages free for %s; local fallback", rid
+                )
+                dspan.end(status="cancelled")
+                return
+            dspan.add_event("pages_reserved", pages=len(req.pages))
+            # From here until add_prefilled succeeds, any failure must give
+            # the page reservation and the transfer waiter back.
+            waiter = self.transfer_server.expect(rid)
+            t_push = _time.perf_counter()
+            try:
+                await self.prefill_queue.push(
+                    RemotePrefillRequest(
+                        request_id=rid,
+                        token_ids=list(pre.token_ids),
+                        page_ids=list(req.pages),
+                        transfer_host=self.advertise_host,
+                        transfer_port=self.transfer_server.port,
+                        sampling={
+                            "temperature": pre.temperature, "top_p": pre.top_p,
+                            "top_k": pre.top_k, "seed": pre.seed,
+                        },
+                        model=self.card.name,
+                        trace=telemetry.wire_context() or {},
+                    )
+                )
+                timeout = self.disagg_router.config.transfer_timeout_s
+                result = await asyncio.wait_for(waiter, timeout)
+            except Exception:
+                self.transfer_server.forget(rid)
+                await runner.submit(lambda eng: eng.cancel_remote_prefill(req))
+                logger.warning(
+                    "disagg: remote prefill for %s failed/timed out; "
+                    "local fallback",
+                    rid,
+                )
+                dspan.end(status="error")
+                return
+            transfer_ms = (_time.perf_counter() - t_push) * 1000.0
+            phases.observe("disagg_transfer_ms", transfer_ms)
+            dspan.add_event("kv_landed", transfer_ms=round(transfer_ms, 3))
+            self.remote_prefills += 1
         from dynamo_tpu.engine.async_engine import output_to_dict
 
         out_q = runner.watch_request(rid)

@@ -77,6 +77,18 @@ def test_cpu_fallback_line_is_labeled_and_carries_tpu_artifact():
     assert ext["inproc_tok_s"] > 0 and ext["subprocess_tok_s"] > 0
     assert ext["tokens_per_arm"] > 0
     assert "wire_overhead_us_per_token" in ext
+    # tracing on/off A/B (ISSUE 4): both arms ran; the <3% overhead claim
+    # is pinned by the DETERMINISTIC modeled number (span-layer us per
+    # request / request serving time) because this box's scheduler noise
+    # on a short echo run dwarfs the span layer's true cost — the
+    # interleaved wall A/B only gets a generous sanity band.
+    tr = ex["trace_overhead"]
+    assert "error" not in tr, tr
+    assert tr["trace_off_tok_s"] > 0 and tr["trace_on_tok_s"] > 0
+    assert tr["modeled_overhead_pct"] is not None, tr
+    assert tr["modeled_overhead_pct"] < 3.0, tr
+    assert tr["measured_overhead_pct"] is not None, tr
+    assert tr["measured_overhead_pct"] < 30.0, tr
 
 
 def test_bench_http_counts_failures_instead_of_raising():

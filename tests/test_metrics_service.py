@@ -68,8 +68,10 @@ def test_metrics_service_exposition():
                 'dynamo_tpu_worker_kv_usage{component="backend",instance="worker-1"} 0.25'
                 in text
             )
+            # counters without a _total field name gain the suffix in the
+            # exposed name (Prometheus convention; telemetry/promlint.py)
             assert (
-                'dynamo_tpu_worker_requests_received{component="backend",instance="worker-1"} 7'
+                'dynamo_tpu_worker_requests_received_total{component="backend",instance="worker-1"} 7'
                 in text
             )
             assert "dynamo_tpu_kv_hit_rate_events_total 2" in text
@@ -77,11 +79,11 @@ def test_metrics_service_exposition():
             assert "dynamo_tpu_kv_hit_rate_overlap_tokens_total 128" in text
             # step-phase timing plane (EngineMetrics.time_*_ms)
             assert (
-                'dynamo_tpu_worker_time_decode_ms'
+                'dynamo_tpu_worker_time_decode_ms_total'
                 '{component="backend",instance="worker-1"} 123.5' in text
             )
             assert (
-                'dynamo_tpu_worker_decode_dispatches'
+                'dynamo_tpu_worker_decode_dispatches_total'
                 '{component="backend",instance="worker-1"} 9' in text
             )
             assert "dynamo_tpu_kv_hit_rate 0.64" in text

@@ -1,0 +1,386 @@
+"""Unit coverage for the telemetry plane (ISSUE 4): span API + context
+propagation + ring, header extraction (degrades to a fresh trace, never
+an error), Chrome trace export, the JsonlFormatter NaN/circular-ref
+regression + trace-id injection, the per-phase histograms, and the
+Prometheus exposition linter run against every hand-rolled /metrics
+surface."""
+
+import json
+import logging
+import math
+
+import pytest
+
+from dynamo_tpu import telemetry
+from dynamo_tpu.logging_config import JsonlFormatter
+from dynamo_tpu.telemetry import phases, promlint
+from dynamo_tpu.telemetry.chrome_export import export_trace, to_chrome_trace
+
+
+@pytest.fixture()
+def tracing():
+    telemetry.configure(enabled=True, ring_size=16)
+    telemetry.reset()
+    yield
+    telemetry.configure(enabled=False)
+    telemetry.reset()
+
+
+# -- span API ---------------------------------------------------------------
+
+
+def test_span_nesting_records_parent_chain(tracing):
+    with telemetry.span("http.request", service="frontend") as root:
+        root.set_attr("model", "tiny")
+        with telemetry.span("router.dispatch", service="router") as child:
+            child.add_event("retry", reason="test")
+        tid = root.trace_id
+    spans = telemetry.get_trace(tid)
+    assert spans is not None and len(spans) == 2
+    by_name = {s["name"]: s for s in spans}
+    assert by_name["router.dispatch"]["parent_id"] == (
+        by_name["http.request"]["span_id"]
+    )
+    assert by_name["http.request"]["parent_id"] is None
+    assert by_name["http.request"]["attrs"]["model"] == "tiny"
+    assert by_name["router.dispatch"]["events"][0]["name"] == "retry"
+    assert all(s["duration_ms"] >= 0 for s in spans)
+    assert all(s["trace_id"] == tid for s in spans)
+
+
+def test_span_error_status(tracing):
+    with pytest.raises(ValueError):
+        with telemetry.span("boom", service="engine") as sp:
+            tid = sp.trace_id
+            raise ValueError("nope")
+    (rec,) = telemetry.get_trace(tid)
+    assert rec["status"] == "error"
+    assert "ValueError" in rec["attrs"]["error"]
+
+
+def test_disabled_tracing_is_noop():
+    telemetry.configure(enabled=False)
+    telemetry.reset()
+    with telemetry.span("x", service="frontend") as sp:
+        sp.set_attr("k", "v")
+        sp.add_event("e")
+        assert sp is telemetry.NOOP_SPAN
+        assert telemetry.current_span() is None
+        assert telemetry.wire_context() is None
+    assert telemetry.list_traces() == []
+    # inject adds nothing when off
+    md = {}
+    assert telemetry.inject(md) == {} and "trace" not in md
+
+
+def test_ring_list_tolerates_malformed_adopted_spans(tracing):
+    """Adopted spans are third-party wire input: a span with only a
+    trace_id must not 500 the /v1/traces listing."""
+    telemetry.record_span_dict({"trace_id": "e" * 32})
+    (summary,) = telemetry.list_traces(5)
+    assert summary["trace_id"] == "e" * 32
+    assert summary["services"] == ["?"]
+    assert summary["start_ts"] is None
+    # limit<=0 means none, not all
+    assert telemetry.list_traces(0) == []
+    assert telemetry.list_traces(-3) == []
+
+
+def test_ring_caps_spans_per_trace(tracing):
+    """One reused x-request-id (one deterministic trace id) must not
+    grow a span list without bound."""
+    from dynamo_tpu.telemetry.trace import TraceRing
+
+    ring = TraceRing(capacity=4)
+    for _ in range(TraceRing.MAX_SPANS_PER_TRACE + 50):
+        ring.record({"trace_id": "f" * 32, "span_id": "a" * 16})
+    assert len(ring.get("f" * 32)) == TraceRing.MAX_SPANS_PER_TRACE
+
+
+def test_ring_eviction_is_per_trace(tracing):
+    telemetry.configure(ring_size=3)
+    tids = []
+    for _ in range(5):
+        with telemetry.span("r", service="s") as sp:
+            tids.append(sp.trace_id)
+    assert telemetry.get_trace(tids[0]) is None
+    assert telemetry.get_trace(tids[-1]) is not None
+    assert len(telemetry.list_traces(50)) == 3
+    telemetry.configure(ring_size=16)
+
+
+# -- context propagation ----------------------------------------------------
+
+
+def test_inject_extract_roundtrip(tracing):
+    with telemetry.span("parent", service="router") as sp:
+        md = telemetry.inject({"model": "tiny"})
+        assert md["trace"] == {
+            "trace_id": sp.trace_id, "span_id": sp.span_id,
+        }
+    ctx = telemetry.extract(md)
+    assert ctx["trace_id"] == sp.trace_id
+    with telemetry.span("child", service="worker", parent=ctx) as child:
+        assert child.trace_id == sp.trace_id
+        assert child.parent_id == sp.span_id
+
+
+@pytest.mark.parametrize(
+    "metadata",
+    [
+        None,
+        {},
+        {"trace": "nonsense"},
+        {"trace": {"trace_id": "short"}},
+        {"trace": {"trace_id": 42}},
+        {"trace": {"trace_id": "Z" * 32}},
+    ],
+)
+def test_extract_malformed_degrades_to_none(tracing, metadata):
+    assert telemetry.extract(metadata) is None
+    # ...and a span over a None parent starts a FRESH trace, no error
+    with telemetry.span("w", service="worker", parent=None) as sp:
+        assert len(sp.trace_id) == 32
+
+
+def test_header_extraction(tracing):
+    # W3C traceparent wins
+    ctx = telemetry.context_from_headers(
+        {"traceparent": "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01"}
+    )
+    assert ctx == {"trace_id": "ab" * 16, "span_id": "cd" * 8}
+    # 32-hex x-request-id is used verbatim
+    ctx = telemetry.context_from_headers({"x-request-id": "f" * 32})
+    assert ctx["trace_id"] == "f" * 32 and ctx["span_id"] is None
+    # arbitrary x-request-id hashes deterministically
+    a = telemetry.context_from_headers({"x-request-id": "req-123"})
+    b = telemetry.context_from_headers({"x-request-id": "req-123"})
+    assert a == b and len(a["trace_id"]) == 32
+    # absent / malformed -> None (fresh trace downstream)
+    assert telemetry.context_from_headers({}) is None
+    assert (
+        telemetry.context_from_headers({"traceparent": "zz-not-a-trace"})
+        is None
+    )
+
+
+def test_adopted_child_span_dict(tracing):
+    with telemetry.span("engine.generate", service="engine") as sp:
+        tid = sp.trace_id
+        telemetry.record_span_dict(
+            {
+                "trace_id": tid, "span_id": "a" * 16,
+                "parent_id": sp.span_id, "name": "child.generate",
+                "service": "ext-child", "start_ts": 1.0,
+                "duration_ms": 2.0, "status": "ok", "attrs": {},
+                "events": [],
+            }
+        )
+        telemetry.record_span_dict({"trace_id": "junk"})  # dropped
+        telemetry.record_span_dict("garbage")  # dropped
+    spans = telemetry.get_trace(tid)
+    assert {s["service"] for s in spans} == {"engine", "ext-child"}
+
+
+# -- chrome export ----------------------------------------------------------
+
+
+def test_chrome_export_shape(tracing, tmp_path):
+    with telemetry.span("http.request", service="frontend") as root:
+        tid = root.trace_id
+        with telemetry.span("engine.generate", service="engine") as sp:
+            sp.add_event("first_token")
+    doc = to_chrome_trace(telemetry.get_trace(tid))
+    json.dumps(doc)  # serializable
+    events = doc["traceEvents"]
+    complete = [e for e in events if e["ph"] == "X"]
+    meta = [e for e in events if e["ph"] == "M"]
+    instants = [e for e in events if e["ph"] == "i"]
+    assert len(complete) == 2 and len(instants) == 1
+    assert {m["args"]["name"] for m in meta} == {"frontend", "engine"}
+    for e in complete:
+        assert isinstance(e["ts"], int) and isinstance(e["dur"], int)
+        assert e["dur"] >= 1
+        assert isinstance(e["pid"], int) and isinstance(e["tid"], int)
+    # pids are per-service and consistent between meta + events
+    pid_of = {m["args"]["name"]: m["pid"] for m in meta}
+    for e in complete:
+        assert e["pid"] == pid_of[e["cat"]]
+    # file export
+    path = export_trace(tid, path=str(tmp_path / "t.json"))
+    with open(path) as f:
+        assert json.load(f)["traceEvents"]
+    with pytest.raises(KeyError):
+        export_trace("0" * 32, path=str(tmp_path / "missing.json"))
+
+
+# -- JsonlFormatter regression (satellite 1) --------------------------------
+
+
+def _format(extra: dict) -> dict:
+    rec = logging.LogRecord("t", logging.INFO, "f.py", 1, "msg", (), None)
+    for k, v in extra.items():
+        setattr(rec, k, v)
+    line = JsonlFormatter().format(rec)
+    # STRICT validity: the old formatter emitted bare NaN tokens, which
+    # json.loads tolerates but real JSON consumers reject
+    assert "NaN" not in line and "Infinity" not in line
+    return json.loads(line)
+
+
+def test_jsonl_formatter_nan_and_inf_degrade_to_repr():
+    out = _format({"bad": float("nan"), "worse": float("inf"), "ok": 1.5})
+    assert out["bad"] == "nan"
+    assert out["worse"] == "inf"
+    assert out["ok"] == 1.5
+
+
+def test_jsonl_formatter_circular_ref():
+    loop = {}
+    loop["self"] = loop
+    out = _format({"cyc": loop})
+    assert isinstance(out["cyc"], str)
+
+
+def test_jsonl_formatter_nested_foreign_objects():
+    class Thing:
+        def __repr__(self):
+            return "<thing>"
+
+    out = _format({"mix": [1, Thing()], "nan_in_list": [float("nan")]})
+    assert out["mix"] == [1, "<thing>"]
+    assert isinstance(out["nan_in_list"], str)  # whole value degraded
+
+
+def test_jsonl_formatter_injects_trace_ids():
+    telemetry.configure(enabled=True, ring_size=4)
+    try:
+        with telemetry.span("req", service="frontend") as sp:
+            out = _format({})
+            assert out["trace_id"] == sp.trace_id
+            assert out["span_id"] == sp.span_id
+        out = _format({"trace_id": "explicit"})
+        assert out["trace_id"] == "explicit"
+    finally:
+        telemetry.configure(enabled=False)
+        telemetry.reset()
+
+
+# -- per-phase histograms ---------------------------------------------------
+
+
+def test_phase_histograms_expose_and_lint():
+    phases.phase_histograms.reset()
+    phases.observe("queue_wait_ms", 0.7)
+    phases.observe("queue_wait_ms", 90000.0)  # beyond the ladder -> +Inf
+    phases.observe("router_dispatch_ms", 3.0)
+    text = "\n".join(phases.expose_lines()) + "\n"
+    assert "# TYPE dynamo_tpu_phase_queue_wait_ms histogram" in text
+    assert 'dynamo_tpu_phase_queue_wait_ms_bucket{le="+Inf"} 2' in text
+    assert "dynamo_tpu_phase_queue_wait_ms_count 2" in text
+    assert promlint.lint(text) == []
+    phases.phase_histograms.reset()
+
+
+# -- the exposition linter (satellite 5) ------------------------------------
+
+
+def test_promlint_catches_real_problems():
+    assert promlint.lint(
+        "# TYPE foo_total counter\n"
+        'foo_total{a="b"} 1\n'
+    ) == []
+    # duplicate TYPE
+    assert promlint.lint(
+        "# TYPE x gauge\nx 1\n# TYPE x gauge\nx 2\n"
+    )
+    # counter without _total
+    assert promlint.lint("# TYPE bar counter\nbar 1\n")
+    # sample without TYPE
+    assert promlint.lint("mystery_metric 1\n")
+    # broken label escaping (unescaped quote)
+    assert promlint.lint(
+        "# TYPE l gauge\n" + 'l{a="b"c"} 1\n'
+    )
+    # non-monotonic histogram buckets
+    bad_hist = (
+        "# TYPE h histogram\n"
+        'h_bucket{le="1"} 5\n'
+        'h_bucket{le="2"} 3\n'
+        'h_bucket{le="+Inf"} 5\n'
+        "h_sum 2\nh_count 5\n"
+    )
+    assert any("non-monotonic" in e for e in promlint.lint(bad_hist))
+    # missing +Inf bucket
+    no_inf = (
+        "# TYPE h histogram\n"
+        'h_bucket{le="1"} 5\n'
+        "h_sum 2\nh_count 5\n"
+    )
+    assert any("+Inf" in e for e in promlint.lint(no_inf))
+    # _count disagreeing with the +Inf bucket
+    bad_count = (
+        "# TYPE h histogram\n"
+        'h_bucket{le="+Inf"} 5\n'
+        "h_sum 2\nh_count 4\n"
+    )
+    assert any("_count" in e for e in promlint.lint(bad_count))
+
+
+def test_frontend_exposition_passes_lint():
+    from dynamo_tpu.frontend.metrics import FrontendMetrics
+
+    m = FrontendMetrics()
+    m.request_done(
+        "tiny", "chat", "200", 0.5, input_tokens=9000, output_tokens=64,
+        ttft_s=0.05, itl_s=[0.01, 0.02],
+    )
+    m.request_done("tiny", "chat", "500", 1.0)
+    with m.inflight_guard("tiny"):
+        text = m.expose()
+    assert promlint.lint(text) == [], promlint.lint(text)
+    # sequence-token histograms use the token ladder: a 9k-token prompt
+    # lands in a real bucket, not +Inf (satellite 2)
+    assert (
+        'dynamo_tpu_http_service_input_sequence_tokens_bucket'
+        '{model="tiny",le="16384.0"} 1' in text
+    )
+    assert (
+        'dynamo_tpu_http_service_input_sequence_tokens_bucket'
+        '{model="tiny",le="8192.0"} 0' in text
+    )
+    # the 500 reported no token counts: absence of data, not a 0-length
+    # sequence — the distribution must hold exactly one sample
+    assert (
+        'dynamo_tpu_http_service_input_sequence_tokens_count'
+        '{model="tiny"} 1' in text
+    )
+
+
+def test_metrics_service_exposition_passes_lint():
+    from dynamo_tpu.metrics_service import MetricsService
+
+    svc = MetricsService(fabric=None)
+    # a realistic worker snapshot incl. counters that gain _total in the
+    # exposed name (steps -> dynamo_tpu_worker_steps_total)
+    svc.aggregator._latest = {
+        "w-1": (
+            {
+                "instance_id": "w-1", "kv_usage": 0.5, "steps": 12,
+                "generated_tokens": 99, "requests_received": 3,
+                "time_decode_ms": 5.5, "decode_dispatches": 4,
+                "kv_transfer_bulk_total": 1, "ext_ready": 1,
+            },
+            __import__("time").monotonic(),
+        )
+    }
+    svc.fabric_stats = {
+        "connections": 2, "ops_total": 10,
+        "queues": {"prefill_queue": 1},
+    }
+    phases.observe("decode_step_ms", 1.0)
+    text = svc.expose()
+    assert promlint.lint(text) == [], promlint.lint(text)
+    assert "dynamo_tpu_worker_steps_total" in text
+    assert "# TYPE dynamo_tpu_worker_kv_usage gauge" in text
+    phases.phase_histograms.reset()

@@ -1,0 +1,290 @@
+"""End-to-end distributed trace propagation (ISSUE 4 acceptance).
+
+One chat completion through `in=http` + KV-routed worker + external
+subprocess engine must produce ONE trace whose spans cover
+frontend -> router -> engine -> subprocess child (>=6 spans), retrievable
+at /v1/traces/{id} with a valid Chrome-trace export; a disagg variant
+covers the prefill-handoff span crossing the prefill queue; and a
+request WITHOUT any trace header still serves identically while minting
+a fresh trace."""
+
+import asyncio
+import sys
+
+import aiohttp
+import pytest
+
+from dynamo_tpu import telemetry
+from dynamo_tpu.external.client import SubprocessEngine
+from dynamo_tpu.frontend import HttpService, ModelManager
+from dynamo_tpu.frontend.service import ModelWatcher
+from dynamo_tpu.model_card import ModelDeploymentCard
+from dynamo_tpu.runtime import DistributedRuntime
+from dynamo_tpu.runtime.fabric import FabricServer
+from dynamo_tpu.worker import Worker
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+@pytest.fixture()
+def tracing():
+    telemetry.configure(enabled=True, ring_size=64)
+    telemetry.reset()
+    yield
+    telemetry.configure(enabled=False)
+    telemetry.reset()
+
+
+TRACE_ID = "ab" * 16
+TRACEPARENT = f"00-{TRACE_ID}-{'cd' * 8}-01"
+
+
+def _ref_cmd() -> list[str]:
+    return [
+        sys.executable, "-m", "dynamo_tpu.external.reference_worker",
+        "--model", "ext-ref", "--block-size", "4",
+        "--metrics-interval", "0.1",
+    ]
+
+
+async def _await_spans(trace_id: str, want_services: set, tries: int = 100):
+    """Poll the ring until every wanted service contributed (the child's
+    span frame arrives asynchronously after the finish frame)."""
+    for _ in range(tries):
+        spans = telemetry.get_trace(trace_id) or []
+        if want_services <= {s["service"] for s in spans}:
+            return spans
+        await asyncio.sleep(0.05)
+    raise AssertionError(
+        f"trace {trace_id} never covered {want_services}; has "
+        f"{[(s['service'], s['name']) for s in (telemetry.get_trace(trace_id) or [])]}"
+    )
+
+
+def test_http_kv_routed_subprocess_trace(tracing):
+    """frontend -> kv router -> worker -> SubprocessEngine -> child, one
+    trace, >=6 spans, parent links intact, served over /v1/traces."""
+
+    async def main():
+        server = FabricServer(port=0)
+        await server.start()
+        eng = SubprocessEngine(_ref_cmd(), name="ref")
+        await eng.start()
+        rt_w = await DistributedRuntime.create(server.address)
+        card = ModelDeploymentCard(
+            name="ext-ref", tokenizer={"kind": "byte"}, context_length=512,
+            kv_page_size=4,
+        )
+        worker = Worker(
+            rt_w, card, engine_kind="external", engine=eng,
+            namespace="ns", router_mode="kv", metrics_interval=0.1,
+        )
+        await worker.start()
+        rt_f = await DistributedRuntime.create(server.address)
+        manager = ModelManager()
+        watcher = ModelWatcher(rt_f, manager)
+        await watcher.start()
+        for _ in range(100):
+            if manager.get("ext-ref"):
+                break
+            await asyncio.sleep(0.05)
+        assert manager.get("ext-ref") is not None
+        svc = HttpService(manager, host="127.0.0.1", port=0)
+        await svc.start()
+        base = f"http://127.0.0.1:{svc.port}"
+        body = {
+            "model": "ext-ref",
+            "messages": [{"role": "user", "content": "trace me"}],
+            "max_tokens": 6,
+            "temperature": 0.0,
+        }
+        try:
+            async with aiohttp.ClientSession() as s:
+                async with s.post(
+                    f"{base}/v1/chat/completions", json=body,
+                    headers={"traceparent": TRACEPARENT},
+                ) as r:
+                    assert r.status == 200
+                    data = await r.json()
+                assert data["usage"]["completion_tokens"] == 6
+
+                spans = await _await_spans(
+                    TRACE_ID,
+                    {"frontend", "router", "worker", "engine", "ext-child"},
+                )
+                assert len(spans) >= 6, [s["name"] for s in spans]
+                by_name = {s["name"]: s for s in spans}
+                for name in (
+                    "http.request", "preprocess", "router.dispatch",
+                    "kv.choose", "worker.generate", "engine.generate",
+                    "child.generate",
+                ):
+                    assert name in by_name, (name, sorted(by_name))
+                # every span belongs to the ONE trace, and the stitch
+                # chain holds across both the fabric hop and the wire
+                assert all(s["trace_id"] == TRACE_ID for s in spans)
+                ids = {s["span_id"] for s in spans}
+                root = by_name["http.request"]
+                assert root["parent_id"] == "cd" * 8  # traceparent span
+                assert by_name["worker.generate"]["parent_id"] in ids
+                assert (
+                    by_name["engine.generate"]["parent_id"]
+                    == by_name["worker.generate"]["span_id"]
+                )
+                assert (
+                    by_name["child.generate"]["parent_id"]
+                    == by_name["engine.generate"]["span_id"]
+                )
+                # the KV decision is attributed on the trace
+                kv = by_name["kv.choose"]
+                assert kv["attrs"]["chosen"] == worker.instance_id
+                assert "matched_blocks" in kv["attrs"]
+                assert "overlap_score" in kv["attrs"]
+
+                # retrievable over HTTP (frontend serves the ring) ...
+                async with s.get(f"{base}/v1/traces/{TRACE_ID}") as r:
+                    assert r.status == 200
+                    doc = await r.json()
+                assert len(doc["spans"]) == len(spans)
+                async with s.get(f"{base}/v1/traces?limit=5") as r:
+                    listing = await r.json()
+                assert listing["enabled"] is True
+                assert any(
+                    t["trace_id"] == TRACE_ID for t in listing["traces"]
+                )
+                # ... and the chrome export is valid, pid/tid/ts intact
+                async with s.get(
+                    f"{base}/v1/traces/{TRACE_ID}?format=chrome"
+                ) as r:
+                    chrome = await r.json()
+                complete = [
+                    e for e in chrome["traceEvents"] if e["ph"] == "X"
+                ]
+                assert len(complete) == len(spans)
+                assert all(
+                    isinstance(e["ts"], int)
+                    and isinstance(e["pid"], int)
+                    and isinstance(e["tid"], int)
+                    for e in complete
+                )
+                async with s.get(f"{base}/v1/traces/{'9' * 32}") as r:
+                    assert r.status == 404
+
+                # absent trace header: same serving behavior, fresh trace
+                n_before = len(telemetry.list_traces(64))
+                async with s.post(
+                    f"{base}/v1/chat/completions", json=body
+                ) as r:
+                    assert r.status == 200
+                    data = await r.json()
+                assert data["usage"]["completion_tokens"] == 6
+                for _ in range(100):
+                    fresh = [
+                        t for t in telemetry.list_traces(64)
+                        if t["trace_id"] != TRACE_ID
+                    ]
+                    if fresh and fresh[0]["spans"] >= 6:
+                        break
+                    await asyncio.sleep(0.05)
+                assert len(telemetry.list_traces(64)) > n_before
+                assert fresh[0]["trace_id"] != TRACE_ID
+                assert len(fresh[0]["trace_id"]) == 32
+        finally:
+            await svc.stop()
+            await watcher.stop()
+            await rt_f.close()
+            await worker.stop()
+            await rt_w.close()
+            await eng.stop()
+            await server.stop()
+
+    run(main())
+
+
+def test_disagg_prefill_handoff_trace(tracing, monkeypatch):
+    """The disagg variant: a long prompt's remote prefill contributes
+    disagg.remote_prefill (decode side) and disagg.prefill (prefill
+    worker, parented across the QUEUE hop) to the same trace. Host
+    transfer plane: always available on CPU (the device plane needs
+    jax.experimental.transfer, absent from the baked toolchain)."""
+    monkeypatch.setenv("DYN_KV_TRANSFER", "host")
+    from dynamo_tpu.disagg import DisaggConfig
+    from dynamo_tpu.disagg.prefill_worker import PrefillWorker
+    from dynamo_tpu.engine import EngineConfig
+    from dynamo_tpu.runtime import RouterMode
+
+    tiny_cfg = EngineConfig.for_tests()
+    prompt = [5, 17, 42, 99, 3, 8, 21, 60, 11, 2]
+    card = ModelDeploymentCard(
+        name="tiny", kv_page_size=tiny_cfg.page_size,
+        context_length=tiny_cfg.max_context,
+    )
+
+    async def main():
+        server = FabricServer(port=0)
+        await server.start()
+        rt_d = await DistributedRuntime.create(server.address)
+        decode = Worker(
+            rt_d, card, engine_config=tiny_cfg, engine_kind="jax",
+            namespace="test", metrics_interval=0.1, enable_disagg=True,
+            disagg_config=DisaggConfig(
+                max_local_prefill_length=4, transfer_timeout_s=20.0
+            ),
+        )
+        await decode.start()
+        rt_p = await DistributedRuntime.create(server.address)
+        prefill = PrefillWorker(rt_p, tiny_cfg, namespace="test")
+        await prefill.start()
+        rt_c = await DistributedRuntime.create(server.address)
+        try:
+            ep = rt_c.namespace("test").component("backend").endpoint(
+                "generate"
+            )
+            router = await ep.router(mode=RouterMode.ROUND_ROBIN)
+            await router.source.wait_for_instances()
+
+            with telemetry.span("test.root", service="frontend") as root:
+                trace_id = root.trace_id
+                tokens = []
+                async for item in router.generate(
+                    {
+                        "request_id": "trace-disagg", "token_ids": prompt,
+                        "max_tokens": 4, "temperature": 0.0, "top_p": 1.0,
+                        "top_k": 0, "seed": None, "stop_token_ids": [],
+                        "stop_strings": [], "ignore_eos": True,
+                        "annotations": {},
+                    }
+                ):
+                    tokens.extend(item.get("token_ids", ()))
+            assert len(tokens) == 4
+            assert decode.remote_prefills == 1
+
+            spans = await _await_spans(
+                trace_id, {"router", "worker", "disagg", "prefill"}
+            )
+            by_name = {s["name"]: s for s in spans}
+            assert "disagg.remote_prefill" in by_name
+            assert "disagg.prefill" in by_name
+            # the handoff span crossed the prefill QUEUE with its parent
+            # link intact: prefill-worker side hangs off the decode side
+            assert (
+                by_name["disagg.prefill"]["parent_id"]
+                == by_name["disagg.remote_prefill"]["span_id"]
+            )
+            assert by_name["disagg.prefill"]["trace_id"] == trace_id
+            events = {
+                e["name"]
+                for e in by_name["disagg.remote_prefill"]["events"]
+            }
+            assert {"pages_reserved", "kv_landed"} <= events
+        finally:
+            await rt_c.close()
+            await prefill.stop()
+            await rt_p.close()
+            await decode.stop()
+            await rt_d.close()
+            await server.stop()
+
+    run(main())
