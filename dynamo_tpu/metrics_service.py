@@ -59,6 +59,10 @@ _WORKER_FIELDS = (
     ("time_schedule_ms", "counter"),
     ("time_prefill_ms", "counter"),
     ("time_decode_ms", "counter"),
+    # mixed prefill+decode steps (EngineConfig.mixed_steps): one fused
+    # dispatch carrying a prefill chunk AND the decode batch — the
+    # stall-free path (docs/engine.md "Mixed steps")
+    ("time_mixed_ms", "counter"),
     # decode's phase split (dispatch/sync/postprocess) + the overlapped-
     # decode pipeline counters — sync collapsing toward zero is the
     # overlap working (docs/engine.md "The decode loop")
@@ -67,6 +71,7 @@ _WORKER_FIELDS = (
     ("time_decode_host_ms", "counter"),
     ("prefill_dispatches", "counter"),
     ("decode_dispatches", "counter"),
+    ("mixed_dispatches", "counter"),
     ("overlap_dispatches", "counter"),
     ("overlap_hits", "counter"),
     ("overlap_rollbacks", "counter"),

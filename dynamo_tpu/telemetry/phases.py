@@ -11,6 +11,12 @@ Phases:
   queue_wait_ms        admission wait in the engine scheduler
   prefill_ms           one prefill dispatch (host+device wall time)
   decode_step_ms       one decode dispatch
+  mixed_step_ms        one mixed prefill+decode dispatch (mixed_steps)
+  decode_stall_ms      gap between consecutive token emissions of one
+                       running request when a prefill-carrying dispatch
+                       ran in between — the prefill-induced decode stall.
+                       The XOR scheduler pays whole backlog drains here;
+                       mixed steps collapse it to one step.
   router_dispatch_ms   PushRouter pick->first response frame
   disagg_transfer_ms   remote prefill enqueue->KV landing
 """
@@ -25,6 +31,8 @@ PHASES = (
     "queue_wait_ms",
     "prefill_ms",
     "decode_step_ms",
+    "mixed_step_ms",
+    "decode_stall_ms",
     "router_dispatch_ms",
     "disagg_transfer_ms",
 )

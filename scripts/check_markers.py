@@ -1,0 +1,187 @@
+#!/usr/bin/env python
+"""Tier-1 marker audit: fleet/collective-plane tests must be `slow`.
+
+Codifies the PR 1 gloo-wedge fix as a check instead of tribal knowledge:
+any test that spawns a subprocess fleet (a multi-process jax.distributed
+group, or a CLI worker fleet joined via `--coordinator`) or requires the
+cross-process collective plane can wedge on a flaky gloo rendezvous —
+past the whole tier-1 budget, with no timeout inside a collective. Such
+tests MUST carry the `slow` marker so the quick suite (`-m 'not slow'`)
+never runs them; the gate probe (`collective_plane_available`) protects
+the slow lane, not the budget.
+
+Static (AST) scan, `-p no:randomly`-safe: no test module is imported, so
+the audit cannot be perturbed by plugin ordering or collection order.
+A test function is RISKY when its own source — or the source of any
+fixture it requests (transitively, through same-module and conftest.py
+fixture chains alike) — mentions one of the fleet tokens below. A risky test passes the audit when it (or its
+module's `pytestmark`) carries `pytest.mark.slow`, including through a
+module-level alias (`fleet = pytest.mark.slow`).
+
+Exit 0 = clean; exit 1 = violations (one line each); exit 2 = usage.
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+
+#: source substrings that mean "this test spawns a fleet / needs the
+#: collective plane". Single-process `init_multihost(..., num_hosts=1)`
+#: smokes deliberately do NOT match.
+RISK_TOKENS = (
+    "spawn_two_hosts",  # tests/helpers/spmd_host.py fleet spawner
+    "--coordinator",    # CLI worker fleet joining a jax.distributed group
+    "collective_plane_available",  # the gate probe itself needs the plane
+)
+
+
+def _is_slow_marker(expr: ast.expr, aliases: set[str]) -> bool:
+    src = ast.unparse(expr)
+    return "mark.slow" in src or src in aliases
+
+
+def _module_facts(tree: ast.Module):
+    """(slow_aliases, module_is_slow) from top-level assignments."""
+    aliases: set[str] = set()
+    module_slow = False
+    for node in tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        src = ast.unparse(node.value)
+        names = [
+            t.id for t in node.targets if isinstance(t, ast.Name)
+        ]
+        if "mark.slow" in src:
+            for name in names:
+                if name == "pytestmark":
+                    module_slow = True
+                else:
+                    aliases.add(name)
+    return aliases, module_slow
+
+
+def _collect_fixtures(src: str, tree: ast.Module) -> dict:
+    """fixture name -> (source text incl. decorators, fixture names it
+    requests) — enough to walk fixture chains without re-parsing."""
+    out: dict[str, tuple] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and any(
+            "fixture" in ast.unparse(d) for d in node.decorator_list
+        ):
+            out[node.name] = (_fn_text(src, node), _requested_fixtures(node))
+    return out
+
+
+def _fn_text(src: str, node) -> str:
+    """Function source INCLUDING decorators (get_source_segment starts
+    at `def`, which would hide @pytest.mark.usefixtures arguments)."""
+    parts = [ast.unparse(d) for d in node.decorator_list]
+    parts.append(ast.get_source_segment(src, node) or "")
+    return "\n".join(parts)
+
+
+def _requested_fixtures(node) -> list[str]:
+    """Fixture names a test can pull in: positional, positional-only and
+    keyword-only parameters, plus @pytest.mark.usefixtures entries."""
+    names = [
+        a.arg
+        for a in (
+            node.args.posonlyargs + node.args.args + node.args.kwonlyargs
+        )
+    ]
+    for d in node.decorator_list:
+        text = ast.unparse(d)
+        if "usefixtures" in text and isinstance(d, ast.Call):
+            names.extend(
+                a.value for a in d.args if isinstance(a, ast.Constant)
+            )
+    return names
+
+
+def audit_file(path: Path, conftest_fixtures: dict) -> list[str]:
+    src = path.read_text()
+    if not any(tok in src for tok in RISK_TOKENS) and not conftest_fixtures:
+        return []
+    tree = ast.parse(src)
+    aliases, module_slow = _module_facts(tree)
+
+    funcs: dict[str, ast.AST] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            funcs[node.name] = node
+    #: same-module fixtures shadow conftest ones (pytest resolution)
+    fixtures = dict(conftest_fixtures)
+    fixtures.update(_collect_fixtures(src, tree))
+
+    def risky(text: str, requested: list[str], seen: set[str]) -> bool:
+        if any(tok in text for tok in RISK_TOKENS):
+            return True
+        for name in requested:
+            if name in fixtures and name not in seen:
+                seen.add(name)
+                if risky(*fixtures[name], seen):
+                    return True
+        return False
+
+    errors = []
+    for name, node in funcs.items():
+        if not name.startswith("test_"):
+            continue
+        if not risky(
+            _fn_text(src, node), _requested_fixtures(node), set()
+        ):
+            continue
+        slow = module_slow or any(
+            _is_slow_marker(d, aliases) for d in node.decorator_list
+        )
+        if not slow:
+            errors.append(
+                f"{path}:{node.lineno}: {name} spawns a subprocess fleet "
+                "or needs the collective plane but lacks "
+                "@pytest.mark.slow — a flaky gloo rendezvous can wedge "
+                "it past the tier-1 budget (see PR 1 / "
+                "tests/helpers/spmd_host.py)"
+            )
+    return errors
+
+
+def main(argv: list[str]) -> int:
+    root = Path(argv[1]) if len(argv) > 1 else (
+        Path(__file__).resolve().parent.parent / "tests"
+    )
+    if not root.exists():
+        print(f"check_markers: no such path {root}", file=sys.stderr)
+        return 2
+    files = (
+        sorted(root.rglob("test_*.py")) if root.is_dir() else [root]
+    )
+    #: fleet-spawning fixtures defined in conftest.py must be visible to
+    #: every test module that can request them
+    conftest_fixtures: dict = {}
+    conftests = (
+        sorted(root.rglob("conftest.py")) if root.is_dir() else []
+    )
+    for cf in conftests:
+        cf_src = cf.read_text()
+        conftest_fixtures.update(
+            _collect_fixtures(cf_src, ast.parse(cf_src))
+        )
+    errors: list[str] = []
+    for f in files:
+        errors.extend(audit_file(f, conftest_fixtures))
+    for e in errors:
+        print(e)
+    if errors:
+        print(
+            f"check_markers: {len(errors)} unmarked fleet test(s)",
+            file=sys.stderr,
+        )
+        return 1
+    print(f"check_markers: {len(files)} files OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

@@ -57,6 +57,10 @@ bench_1b()   { run_stage bench_1b python bench.py; }
 bench_1b_kvq() { # kv-quant A/B arm: same workload, int8 KV pages — read
                  # against bench_1b for the on-chip traffic win (BENCH_r06)
                BENCH_KV_QUANTIZE=int8 run_stage bench_1b_kvq python bench.py; }
+bench_1b_mixed() { # mixed-steps chip arm (ISSUE 5): the c=32 saturation
+                   # A/B (mixed_ab extras) measured on the chip with the
+                   # headline model — burst-drain ITL p95 vs XOR
+               BENCH_MIXED_AB=1 run_stage bench_1b_mixed python bench.py; }
 bench_8b()   { BENCH_MODEL=llama3-8b BENCH_QUANTIZE=int8 BENCH_REQUESTS=64 \
                run_stage bench_8b python bench.py; }
 transfer()   { run_stage transfer python -m benchmarks.transfer_bench --mb 64; }
@@ -76,7 +80,7 @@ disagg_ab()  { run_stage disagg_ab python -m benchmarks.disagg_bench \
                  --num-pages 1024 --max-context 4096 --max-local-prefill 256 \
                  --requests 32 --isl 1024 --osl 64 --concurrency 8; }
 
-STAGES_ALL=(bench_1b bench_1b_kvq bench_8b transfer sweep sweep_8b sla disagg_ab)
+STAGES_ALL=(bench_1b bench_1b_kvq bench_1b_mixed bench_8b transfer sweep sweep_8b sla disagg_ab)
 # disagg A/B last: two engine processes timeshare the one chip — expect
 # contention; honest multi-chip runs need dp mesh halves or two hosts
 

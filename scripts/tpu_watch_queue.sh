@@ -119,6 +119,12 @@ bench_1b_kvq() {
   # pages; compare tok/s + pool-byte gauges against bench_1b
   BENCH_KV_QUANTIZE=int8 run_stage bench_1b_kvq python bench.py
 }
+bench_1b_mixed() {
+  # mixed-steps chip arm (ISSUE 5): the c=32 saturation A/B on the chip
+  # with the headline model — mixed_ab extras carry burst-drain ITL p95
+  # and TTFT p50 ratios vs fixed-budget XOR scheduling
+  BENCH_MIXED_AB=1 run_stage bench_1b_mixed python bench.py
+}
 pallas_gate() {
   # numerics GATE: prefill logit diff + 32-step teacher-forced drift
   # (budget 0.25 / >=90% argmax agreement); exit 2 = gate failed.
@@ -133,7 +139,7 @@ transfer() {
 }
 
 STAGES=("$@")
-[ ${#STAGES[@]} -eq 0 ] && STAGES=(pallas_kernels prewarm disagg_ab sweep_8b sla_8b ft_kill routing offload bench_dsv2 decode_profile bench_1b_sweep bench_1b_kvq pallas_gate transfer)
+[ ${#STAGES[@]} -eq 0 ] && STAGES=(pallas_kernels prewarm disagg_ab sweep_8b sla_8b ft_kill routing offload bench_dsv2 decode_profile bench_1b_sweep bench_1b_kvq bench_1b_mixed pallas_gate transfer)
 
 wait_for_tunnel
 for s in "${STAGES[@]}"; do

