@@ -56,6 +56,7 @@ def _engine_config(args, eos_token_ids: tuple = ()) -> EngineConfig:
         spec_ngram=getattr(args, "spec_ngram", 0),
         overlap_decode=getattr(args, "overlap_decode", True),
         mixed_steps=getattr(args, "mixed_steps", True),
+        fleet_telemetry=getattr(args, "fleet_telemetry", True),
         quantize=getattr(args, "quantize", None),
         kv_quantize=getattr(args, "kv_quantize", None),
         attention_impl=getattr(args, "attention_impl", "auto"),
@@ -479,7 +480,11 @@ async def _run_metrics(args) -> None:
         rt.fabric, component=args.component, host=args.host, port=args.port
     )
     await svc.start()
-    print(f"metrics service on {args.host}:{svc.port}", flush=True)
+    print(
+        f"metrics service on {args.host}:{svc.port} "
+        f"(/metrics, /v1/fleet, /v1/traces)",
+        flush=True,
+    )
     try:
         await asyncio.Event().wait()
     finally:
@@ -684,6 +689,14 @@ def build_parser() -> argparse.ArgumentParser:
              "batch, so decodes emit a token every step while a prompt "
              "burst drains; on by default for aggregated topology, "
              "auto-off on multi-host SPMD and with --spec-ngram)",
+    )
+    runp.add_argument(
+        "--no-fleet-telemetry", action="store_false",
+        dest="fleet_telemetry", default=True,
+        help="disable the live fleet telemetry plane (worker SLO "
+             "sketches, live MFU gauge, fleet-frame publishing; on by "
+             "default — host-side metrics only, the token path is "
+             "identical either way; docs/observability.md)",
     )
     runp.add_argument(
         "--quantize", default=None, choices=["int8"],

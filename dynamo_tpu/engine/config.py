@@ -126,6 +126,13 @@ class EngineConfig:
     seed: int = 0
     #: enable content-addressed prefix caching
     enable_prefix_caching: bool = True
+    #: live fleet telemetry (docs/observability.md "Fleet view & SLO
+    #: accounting"): per-request TTFT/ITL/e2e quantile sketches + SLA
+    #: counters on the engine, the live MFU gauge, and the worker's
+    #: fleet-frame publishing. Host-side metrics only — the token path
+    #: is identical either way; off (`--no-fleet-telemetry`) skips the
+    #: bookkeeping entirely (bench.py `slo_overhead` prices it <1%).
+    fleet_telemetry: bool = True
     #: KVBM tiering (dynamo_tpu/kvbm): host-DRAM tier byte budget (0 = off)
     host_kv_cache_bytes: int = 0
     #: disk tier byte budget (0 = off; needs disk_kv_cache_dir)

@@ -161,6 +161,25 @@ class EngineMetrics:
     overlap_dispatches: int = 0
     overlap_hits: int = 0
     overlap_rollbacks: int = 0
+    #: engine-internals plane (fleet telemetry, docs/observability.md):
+    #: jit-cache misses (one full XLA compile each) and their cumulative
+    #: wall cost — climbing in steady state means the program family is
+    #: churning (the compile hazard the 3-axis mixed family introduced)
+    compiles: int = 0
+    compile_ms: float = 0.0
+    #: page-pool pressure: the high-watermark of active pages since boot
+    #: and the scheduler's preemption-by-recompute count — preemptions
+    #: climbing while the watermark pins at capacity is the "pool too
+    #: small for this workload" signal
+    kv_pages_watermark: int = 0
+    preemptions: int = 0
+    #: live utilization over a sliding window (~10 s): token throughput
+    #: and the model-FLOPs utilization it implies against the chip's
+    #: roofline peak (2*active-params FLOPs/token / device_peak_flops —
+    #: same arithmetic as bench.py's headline MFU; docs/PERF.md maps it
+    #: to the measured decode roofline ceiling of ~0.43)
+    tokens_per_s: float = 0.0
+    mfu: float = 0.0
 
     #: the timing plane's field names — the one list consumers (perf
     #: harness, dashboards) should iterate instead of restating
@@ -304,6 +323,32 @@ class JaxEngine:
         self.scheduler = Scheduler(config, self.allocator)
         self.metrics = EngineMetrics(kv_total_pages=config.num_pages - 1)
         self._jit_cache: dict[tuple, Callable] = {}
+        #: compile counter by program kind (prefill/decode/mixed/...) —
+        #: published in the worker's fleet frame as per-kind labels
+        self.compiles_by_kind: dict[str, int] = {}
+        #: fleet telemetry plane (config.fleet_telemetry; mutable so the
+        #: bench A/B can toggle one warm engine): SLO sketches + the MFU
+        #: window. All host-side — the token path never reads them.
+        self._fleet_telemetry = config.fleet_telemetry
+        if self._fleet_telemetry:
+            from dynamo_tpu.telemetry.slo import SloTracker
+
+            self.slo: Optional["SloTracker"] = SloTracker()
+        else:
+            self.slo = None
+        #: per-request SLO marks: rid -> [ttft_ms|None, itl_sum_ms,
+        #: itl_samples, last_emit_perf_t]
+        self._slo_marks: dict[str, list] = {}
+        #: (perf_t, tokens_computed) per recent step, for the windowed
+        #: tokens/s + MFU gauges
+        from collections import deque
+
+        self._thru_window: deque = deque()
+        self._thru_window_s = 10.0
+        #: running sum of the window's token counts (kept in step with
+        #: append/popleft so _refresh_metrics stays O(evicted), not
+        #: O(window) — the window holds thousands of entries at speed)
+        self._thru_tokens = 0
         #: adaptive speculation: steps left on the fused path after a
         #: low-acceptance spec dispatch
         self._spec_cooldown = 0
@@ -381,6 +426,14 @@ class JaxEngine:
             )
         self.params = params
         self.kv = kv
+        # Live-MFU constants: FLOPs/token follow the ACTIVE parameters
+        # (MoE: top_k of E experts — total params would overstate ~8x),
+        # against the chip's public peak (nominal off-TPU so the gauge
+        # stays a plausible (0,1] number on dev boxes).
+        from dynamo_tpu.platform import device_peak_flops
+
+        self._peak_flops = device_peak_flops()
+        self._n_active_params = self._active_param_count(params)
         # KV-pool byte gauges: actual device bytes (quantized pages +
         # scale planes) vs what the same pool costs at the model dtype —
         # the ~2x effective-capacity claim, measured not asserted.
@@ -532,6 +585,7 @@ class JaxEngine:
 
     def abort_request(self, request_id: str) -> bool:
         self._last_emit.pop(request_id, None)
+        self._slo_marks.pop(request_id, None)
         return self.scheduler.abort_request(request_id) is not None
 
     @property
@@ -556,6 +610,7 @@ class JaxEngine:
         if batch is not None:
             t2 = time.perf_counter()  # after the drain: phase time is
             # dispatch+sync+postprocess only, as the field docs promise
+            gen0 = self.metrics.generated_tokens
             from dynamo_tpu.telemetry import phases
 
             # Dispatch counters increment BEFORE the run so emissions
@@ -580,6 +635,15 @@ class JaxEngine:
                 self.metrics.time_decode_ms += dt_ms
                 phases.observe("decode_step_ms", dt_ms)
             self.metrics.steps += 1
+            if self._fleet_telemetry:
+                # tokens this step pushed through the model (prefill
+                # chunk tokens + emitted decode tokens — a conservative
+                # undercount of forward-pass work, so MFU never flatters)
+                step_toks = sum(p.length for p in batch.prefill) + (
+                    self.metrics.generated_tokens - gen0
+                )
+                self._thru_window.append((time.perf_counter(), step_toks))
+                self._thru_tokens += step_toks
         if self._inflight is not None and not self.scheduler.has_work:
             # the wave ended on a sampled stop the speculation couldn't
             # predict: drop the dangling dispatch so device arrays free
@@ -593,6 +657,7 @@ class JaxEngine:
         for req, why in self.scheduler.doomed:
             logger.error("request %s cannot progress: %s", req.request_id, why)
             self._last_emit.pop(req.request_id, None)
+            self._slo_marks.pop(req.request_id, None)
             req.state = RequestState.FINISHED
             req.finish_reason = FinishReason.LENGTH
             outputs.append(
@@ -1743,6 +1808,63 @@ class JaxEngine:
             & 0xFFFFFFFF
         )
 
+    def _active_param_count(self, params) -> int:
+        """Parameters active per token (MoE: routed-expert leaves scaled
+        by top_k/E) — the FLOPs/token basis of the live MFU gauge."""
+        n_params = sum(int(x.size) for x in jax.tree.leaves(params))
+        acfg = self.adapter.config
+        n_experts = getattr(acfg, "n_routed_experts", 0) or getattr(
+            acfg, "num_experts", 0
+        )
+        top_k = getattr(acfg, "num_experts_per_tok", None) or getattr(
+            acfg, "top_k", 0
+        )
+        if not (n_experts and top_k):
+            return n_params
+        expert_elems = sum(
+            int(leaf.size)
+            for path, leaf in jax.tree_util.tree_leaves_with_path(params)
+            if any(
+                getattr(k, "key", "").startswith("we_")
+                and not getattr(k, "key", "").endswith("_scale")
+                for k in path
+            )
+        )
+        return n_params - expert_elems + expert_elems * top_k // n_experts
+
+    def _cache_jit(self, kind: str, cache_key, jitted: Callable) -> Callable:
+        """Install a jitted program into the cache wrapped so its FIRST
+        invocation — where XLA actually compiles — is counted, timed
+        (dynamo_tpu_phase_compile_ms; wall time of compile+first run,
+        compile-dominated), and spanned in the trace ring. The wrapper
+        replaces itself with the bare jitted fn after that one call, so
+        the steady-state dispatch path pays nothing."""
+
+        def first_call(*args, **kwargs):
+            import time as _time
+
+            from dynamo_tpu import telemetry
+            from dynamo_tpu.telemetry import phases
+
+            t0 = _time.perf_counter()
+            with telemetry.span(
+                "engine.compile", service="engine",
+                attrs={"kind": kind, "key": str(cache_key)},
+            ):
+                out = jitted(*args, **kwargs)
+            dt_ms = (_time.perf_counter() - t0) * 1000.0
+            self.metrics.compiles += 1
+            self.metrics.compile_ms += dt_ms
+            self.compiles_by_kind[kind] = (
+                self.compiles_by_kind.get(kind, 0) + 1
+            )
+            phases.observe("compile_ms", dt_ms)
+            self._jit_cache[cache_key] = jitted
+            return out
+
+        self._jit_cache[cache_key] = first_call
+        return first_call
+
     def _get_step_fn(
         self, kind: str, b: int, t: int, greedy: bool = False,
         mm: bool = False, first_chunk: bool = False, lp: int = -1,
@@ -1818,9 +1940,8 @@ class JaxEngine:
                 return rep(pooled), kv
 
             jitted = jax.jit(embed_fn, donate_argnums=(4,))
-            self._jit_cache[cache_key] = jitted
             logger.info("compiled %s program B=%d T=%d", kind, b, t)
-            return jitted
+            return self._cache_jit(kind, cache_key, jitted)
 
         if kind == "decode_multi":
             k_steps = t  # the (b, t) slot carries (bucket, fused steps)
@@ -1875,12 +1996,11 @@ class JaxEngine:
                 return rep(all_ids), kv  # [K, B]
 
             jitted = jax.jit(multi_fn, donate_argnums=(4,))
-            self._jit_cache[cache_key] = jitted
             logger.info(
                 "compiled decode_multi program B=%d K=%d greedy=%s",
                 b, k_steps, greedy,
             )
-            return jitted
+            return self._cache_jit(kind, cache_key, jitted)
 
         if kind == "mixed":
             # One fused program per (b=decode bucket, t=prefill T bucket,
@@ -1939,12 +2059,11 @@ class JaxEngine:
                 return rep(ids), kv
 
             jitted = jax.jit(mixed_fn, donate_argnums=(4,))
-            self._jit_cache[cache_key] = jitted
             logger.info(
                 "compiled mixed program Bdec=%d T=%d Bpre=%d psamp=%s",
                 b, t, b_pre, psamp,
             )
-            return jitted
+            return self._cache_jit(kind, cache_key, jitted)
 
         if kind == "spec_verify":
 
@@ -1960,9 +2079,8 @@ class JaxEngine:
                 return rep(ids.astype(jnp.int32)), kv
 
             jitted = jax.jit(verify_fn, donate_argnums=(4,))
-            self._jit_cache[cache_key] = jitted
             logger.info("compiled %s program B=%d T=%d", kind, b, t)
-            return jitted
+            return self._cache_jit(kind, cache_key, jitted)
 
         if kind == "prefill_nosample":
 
@@ -1976,9 +2094,8 @@ class JaxEngine:
                 return kv
 
             jitted = jax.jit(nosample_fn, donate_argnums=(4,))
-            self._jit_cache[cache_key] = jitted
             logger.info("compiled %s program B=%d T=%d", kind, b, t)
-            return jitted
+            return self._cache_jit(kind, cache_key, jitted)
 
         def step_fn(params, tokens, positions, valid, kv, pt, last_idx,
                     temps, top_ps, top_ks, seeds, counters,
@@ -2015,9 +2132,8 @@ class JaxEngine:
             return rep(ids), kv
 
         jitted = jax.jit(step_fn, donate_argnums=(4,))
-        self._jit_cache[cache_key] = jitted
         logger.info("compiled %s program B=%d T=%d", kind, b, t)
-        return jitted
+        return self._cache_jit(kind, cache_key, jitted)
 
     def _finish_reason_for(
         self, req: Request, token: int, n_new: int
@@ -2054,6 +2170,40 @@ class JaxEngine:
         else:
             self._last_emit[req.request_id] = (now, mark)
 
+    def _observe_slo(self, req: Request, n_tokens: int, finished: bool) -> None:
+        """Feed the worker-side SLO sketches (config.fleet_telemetry):
+        TTFT on the first emission, per-token ITL on later ones (a fused
+        K-step emission spreads its gap over its K tokens), e2e + the
+        SLA/goodput judgement at finish. arrival_time is 0.0 for
+        directly-constructed Requests (unit tests, tools) — those skip
+        the wall-clock metrics rather than record epoch-sized garbage."""
+        now = time.perf_counter()
+        mark = self._slo_marks.get(req.request_id)
+        if mark is None:
+            ttft_ms = None
+            if req.arrival_time:
+                ttft_ms = max(0.0, (time.time() - req.arrival_time) * 1000.0)
+                self.slo.observe("ttft_ms", ttft_ms)
+            mark = self._slo_marks[req.request_id] = [ttft_ms, 0.0, 0, now]
+        else:
+            gap_ms = (now - mark[3]) * 1000.0 / max(1, n_tokens)
+            self.slo.observe("itl_ms", gap_ms)
+            mark[1] += gap_ms
+            mark[2] += 1
+            mark[3] = now
+        if finished:
+            self._slo_marks.pop(req.request_id, None)
+            e2e_ms = None
+            if req.arrival_time:
+                e2e_ms = max(0.0, (time.time() - req.arrival_time) * 1000.0)
+                self.slo.observe("e2e_ms", e2e_ms)
+            self.slo.finish_request(
+                ttft_ms=mark[0],
+                itl_ms=mark[1] / mark[2] if mark[2] else None,
+                e2e_ms=e2e_ms,
+                tokens=len(req.output_tokens) + req.num_emitted,
+            )
+
     def _accept_tokens(
         self,
         req: Request,
@@ -2072,6 +2222,8 @@ class JaxEngine:
         self.metrics.generated_tokens += len(tokens)
         if tokens:
             self._observe_emission(req, finished=finish is not None)
+            if self.slo is not None:
+                self._observe_slo(req, len(tokens), finish is not None)
         if finish is not None:
             self.scheduler.finish(req)
             req.finish_reason = finish
@@ -2216,7 +2368,7 @@ class JaxEngine:
                     self._canonical_kv_sharding(self.kv.v),
                 ),
             )
-            self._jit_cache[("extract_mp", n)] = fn
+            fn = self._cache_jit("extract", ("extract_mp", n), fn)
         k, v = fn(self.kv, jnp.asarray(np.asarray(page_ids, np.int32)))
         return self._process_local_np(k), self._process_local_np(v)
 
@@ -2355,8 +2507,10 @@ class JaxEngine:
                         ),
                     )
                 return out
-            fn = jax.jit(inject_fn, donate_argnums=(0,))
-            self._jit_cache[("inject_dev", n, dpad_k, dpad_v)] = fn
+            fn = self._cache_jit(
+                "inject", ("inject_dev", n, dpad_k, dpad_v),
+                jax.jit(inject_fn, donate_argnums=(0,)),
+            )
         self.kv = fn(
             self.kv, jnp.asarray(np.asarray(page_ids, np.int32)), k, v
         )
@@ -2532,3 +2686,30 @@ class JaxEngine:
         m.kv_free_pages = self.allocator.num_free
         m.kv_usage = self.allocator.usage()
         m.prefix_hit_rate = self.allocator.stats.hit_rate
+        m.kv_pages_watermark = max(
+            getattr(self.allocator, "watermark", 0), m.kv_active_pages,
+            m.kv_pages_watermark,
+        )
+        m.preemptions = self.scheduler.preemptions
+        if self._fleet_telemetry:
+            # windowed throughput -> live MFU against the roofline peak
+            now = time.perf_counter()
+            w = self._thru_window
+            while w and now - w[0][0] > self._thru_window_s:
+                self._thru_tokens -= w.popleft()[1]
+            if len(w) >= 2:
+                span = now - w[0][0]
+                toks = self._thru_tokens
+                if span > 1e-3 and toks:
+                    rate = toks / span
+                    m.tokens_per_s = round(rate, 2)
+                    m.mfu = min(
+                        1.0,
+                        2.0 * self._n_active_params * rate
+                        / self._peak_flops,
+                    )
+            else:
+                # window drained: an idle worker must report zero, not
+                # its last busy throughput forever
+                m.tokens_per_s = 0.0
+                m.mfu = 0.0

@@ -79,6 +79,11 @@ class PageAllocator:
         self._page_meta: dict[int, tuple[int, Optional[int], tuple[int, ...]]] = {}
         self._on_event = on_event
         self.stats = PrefixCacheStats()
+        #: high-watermark of active (referenced) pages since boot — the
+        #: pool-pressure gauge the fleet plane exports; updated on every
+        #: successful allocation, so peaks between metric refreshes are
+        #: still captured
+        self.watermark = 0
         self._nlib = native.lib()
         if self._nlib is not None:
             self._np = self._nlib.dyn_pool_new(num_pages)
@@ -140,6 +145,7 @@ class PageAllocator:
             if not self._nlib.dyn_pool_allocate(self._np, n, out):
                 return None
             self._drain_evicted()
+            self.watermark = max(self.watermark, self.num_active)
             return list(out[:n])
         if n > self.num_free:
             return None
@@ -152,6 +158,7 @@ class PageAllocator:
                 self._evict(page)
             self._refcount[page] = 1
             out_pages.append(page)
+        self.watermark = max(self.watermark, self.num_active)
         return out_pages
 
     def free(self, pages: Sequence[int]) -> None:

@@ -84,6 +84,9 @@ class Scheduler:
         self.doomed: list[tuple[Request, str]] = []
         #: pages of finished hold_pages requests, awaiting extraction
         self.held: dict[str, list[int]] = {}
+        #: preemption-by-recompute count (page pressure) — exported as
+        #: the dynamo_tpu_worker_preemptions_total fleet counter
+        self.preemptions = 0
 
     # -- queue interface ---------------------------------------------------
 
@@ -392,6 +395,7 @@ class Scheduler:
         logger.warning(
             "preempting %s (recompute) under page pressure", victim.request_id
         )
+        self.preemptions += 1
         self._release(victim)
         # Recompute-from-scratch: prompt grows to include generated tokens.
         victim.state = RequestState.WAITING

@@ -76,6 +76,13 @@ class FrontendMetrics:
         self.duration = defaultdict(Histogram)  # model
         self.ttft = defaultdict(Histogram)
         self.itl = defaultdict(Histogram)
+        #: streaming SLO accounting per endpoint (telemetry/slo.py):
+        #: TTFT/ITL/e2e quantile sketches + SLA-attainment, goodput and
+        #: multi-window burn-rate gauges, exposed as dynamo_tpu_slo_*
+        from dynamo_tpu.telemetry.slo import SloTracker
+
+        self.slo: dict[str, SloTracker] = {}
+        self._slo_factory = SloTracker
 
     def request_done(
         self, model: str, endpoint: str, status: str, duration_s: float,
@@ -96,6 +103,24 @@ class FrontendMetrics:
                 self.ttft[model].observe(ttft_s)
             for v in itl_s or ():
                 self.itl[model].observe(v)
+            if status == "200":
+                tr = self.slo.get(endpoint)
+                if tr is None:
+                    tr = self.slo[endpoint] = self._slo_factory()
+                ttft_ms = ttft_s * 1000.0 if ttft_s is not None else None
+                if ttft_ms is not None:
+                    tr.observe("ttft_ms", ttft_ms)
+                itl_ms = None
+                if itl_s:
+                    for v in itl_s:
+                        tr.observe("itl_ms", v * 1000.0)
+                    itl_ms = sum(itl_s) / len(itl_s) * 1000.0
+                e2e_ms = duration_s * 1000.0
+                tr.observe("e2e_ms", e2e_ms)
+                tr.finish_request(
+                    ttft_ms=ttft_ms, itl_ms=itl_ms, e2e_ms=e2e_ms,
+                    tokens=output_tokens,
+                )
 
     def inflight_guard(self, model: str) -> "InflightGuard":
         return InflightGuard(self, model)
@@ -121,6 +146,18 @@ class FrontendMetrics:
                 lines.append(f"# TYPE {PREFIX}_{name} histogram")
                 for model, h in sorted(table.items()):
                     lines.extend(h.expose(f"{PREFIX}_{name}", f'model="{model}"'))
+            if self.slo:
+                from dynamo_tpu.telemetry import slo as slo_mod
+
+                lines.extend(
+                    slo_mod.expose_lines(
+                        "dynamo_tpu_slo",
+                        [
+                            (f'endpoint="{ep}"', tr)
+                            for ep, tr in sorted(self.slo.items())
+                        ],
+                    )
+                )
         # per-phase latency histograms live process-global (telemetry
         # layer); whichever process hosts a phase shows it here
         from dynamo_tpu.telemetry import phases

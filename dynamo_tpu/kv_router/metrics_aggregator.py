@@ -48,10 +48,17 @@ class MetricsAggregator:
             msg = await self._sub.next()
             if msg is None:
                 return
-            m = msg.header
-            iid = m.get("instance_id")
+            m = getattr(msg, "header", None)
+            try:
+                iid = m.get("instance_id")
+            except (AttributeError, TypeError):
+                # One malformed publish (non-dict header, a worker dying
+                # mid-frame) must not kill the pump and freeze every
+                # later snapshot at its pre-crash state.
+                logger.warning("malformed metrics frame: %r", m)
+                continue
             if iid:
-                self._latest[iid] = (m, time.monotonic())
+                self._latest[str(iid)] = (m, time.monotonic())
 
     def snapshot(self) -> dict[str, dict]:
         """instance_id → latest metrics dict, stale entries pruned."""
@@ -64,6 +71,16 @@ class MetricsAggregator:
         for iid in dead:
             del self._latest[iid]
         return {iid: m for iid, (m, _) in self._latest.items()}
+
+    def snapshot_with_age(self) -> dict[str, tuple[dict, float]]:
+        """instance_id → (latest metrics dict, seconds since it landed);
+        stale entries pruned like snapshot(). The age becomes the fleet
+        snapshot's per-worker `last_seen_s` field."""
+        now = time.monotonic()
+        self.snapshot()  # prune
+        return {
+            iid: (m, now - ts) for iid, (m, ts) in self._latest.items()
+        }
 
     def for_instance(self, instance_id: str) -> Optional[dict]:
         entry = self._latest.get(instance_id)

@@ -82,6 +82,23 @@ _WORKER_FIELDS = (
     ("ext_broken", "gauge"),
     ("ext_restarts_total", "counter"),
     ("ext_consecutive_failures", "gauge"),
+    # engine-internals plane (fleet telemetry): jit-cache misses + their
+    # cumulative wall cost, page-pool pressure (high-watermark +
+    # preemption-by-recompute), and the live utilization gauges
+    ("compiles", "counter"),
+    ("compile_ms", "counter"),
+    ("kv_pages_watermark", "gauge"),
+    ("preemptions", "counter"),
+    ("tokens_per_s", "gauge"),
+    ("mfu", "gauge"),
+)
+
+#: numeric per-worker fields copied verbatim into the /v1/fleet snapshot
+_FLEET_WORKER_FIELDS = (
+    "kv_usage", "kv_free_pages", "kv_active_pages", "kv_total_pages",
+    "kv_pages_watermark", "preemptions", "num_running", "num_waiting",
+    "steps", "generated_tokens", "requests_received", "compiles",
+    "compile_ms", "tokens_per_s", "mfu", "prefix_hit_rate",
 )
 
 
@@ -93,12 +110,30 @@ class MetricsService:
         host: str = "127.0.0.1",
         port: int = 9091,
         fabric_stats_interval: float = 2.0,
+        extra_components: tuple = ("prefill",),
     ):
         self.fabric = fabric
         self.component = component
         self.host = host
         self.port = port
         self.aggregator = MetricsAggregator(fabric, component)
+        #: fleet view spans every serving role: one aggregator per
+        #: component's subject space (decode pool + disagg prefill pool
+        #: by default). The primary keeps its name for back-compat.
+        self.aggregators = [self.aggregator] + [
+            MetricsAggregator(fabric, c)
+            for c in extra_components
+            if c and c != component
+        ]
+        #: per-instance (requests_received, generated_tokens, monotonic)
+        #: baselines for the fleet snapshot's req/s + tok/s rates
+        self._rate_state: dict[str, tuple[int, int, float]] = {}
+        #: counter-churn bookkeeping for the `dynamo_tpu_fleet_*_total`
+        #: families: last-seen counter contributions per live worker, and
+        #: per-role monotonic bases holding the contributions of departed
+        #: or restarted workers (see _fold_departed)
+        self._live_contrib: dict[str, tuple[str, dict]] = {}
+        self._retired_counters: dict[str, dict] = {}
         # cumulative router-decision counters (KVHitRateEvent stream)
         self.hit_events = 0
         self.isl_tokens_total = 0
@@ -115,7 +150,8 @@ class MetricsService:
     # -- lifecycle ---------------------------------------------------------
 
     async def start(self) -> None:
-        await self.aggregator.start()
+        for agg in self.aggregators:
+            await agg.start()
         self._sub = await self.fabric.subscribe(KV_HIT_RATE_SUBJECT)
         self._task = asyncio.get_running_loop().create_task(self._pump())
         if hasattr(self.fabric, "stats"):
@@ -125,6 +161,7 @@ class MetricsService:
         app = web.Application()
         app.router.add_get("/metrics", self._metrics)
         app.router.add_get("/health", self._health)
+        app.router.add_get("/v1/fleet", self._fleet)
         app.router.add_get("/v1/traces", self._traces)
         app.router.add_get("/v1/traces/{trace_id}", self._trace)
         self._runner = web.AppRunner(app)
@@ -141,7 +178,8 @@ class MetricsService:
             self._task.cancel()
         if self._stats_task is not None:
             self._stats_task.cancel()
-        await self.aggregator.stop()
+        for agg in self.aggregators:
+            await agg.stop()
         if self._runner is not None:
             await self._runner.cleanup()
 
@@ -199,21 +237,347 @@ class MetricsService:
             lines.append(f"{name} {val}")
         return lines
 
-    def expose(self) -> str:
-        snap = self.aggregator.snapshot()
-        lines = [
-            f"# TYPE {PREFIX}_live_workers gauge",
-            f'{PREFIX}_live_workers{{component="{self.component}"}} {len(snap)}',
+    def _snapshot_all(self) -> dict[str, tuple[dict, float, str]]:
+        """instance_id → (frame, age_s, component) across every
+        aggregated component (decode + prefill pools)."""
+        out: dict[str, tuple[dict, float, str]] = {}
+        for agg in self.aggregators:
+            for iid, (m, age) in agg.snapshot_with_age().items():
+                comp = m.get("component") or agg.component
+                out[iid] = (m, age, str(comp))
+        return out
+
+    # -- fleet view (docs/observability.md "Fleet view & SLO accounting") --
+
+    def _assemble_fleet(self, snap=None):
+        """One pass over the live frames -> (snapshot doc, per-role
+        MergedSlo). A worker publishing garbage is logged and skipped —
+        the fleet view degrades by one worker, never dies (and never
+        kills the serving pump; see tests/test_fleet_telemetry.py)."""
+        import time as _time
+
+        from dynamo_tpu.telemetry import slo as slo_mod
+
+        if snap is None:
+            snap = self._snapshot_all()
+        now = _time.monotonic()
+        workers: dict[str, dict] = {}
+        wires_by_role: dict[str, list[dict]] = {}
+        role_stats: dict[str, dict] = {}
+        contribs: dict[str, tuple[str, dict]] = {}
+        for iid, (m, age, comp) in sorted(snap.items()):
+            try:
+                role = str(
+                    m.get("role")
+                    or ("prefill" if "prefill" in comp else "decode")
+                )
+                w: dict = {
+                    "role": role,
+                    "component": comp,
+                    "model": m.get("model"),
+                    "last_seen_s": round(age, 3),
+                }
+                for f in _FLEET_WORKER_FIELDS:
+                    v = m.get(f)
+                    if isinstance(v, (int, float)):
+                        w[f] = v
+                # req/s + tok/s from per-instance counter deltas (>=1 s
+                # between baselines so rapid /v1/fleet polls don't alias)
+                rr = int(m.get("requests_received", 0) or 0)
+                gt = int(m.get("generated_tokens", 0) or 0)
+                prev = self._rate_state.get(iid)
+                if prev is not None and now - prev[2] >= 1.0:
+                    dt = now - prev[2]
+                    prev = (
+                        rr, gt, now,
+                        round(max(0, rr - prev[0]) / dt, 3),
+                        round(max(0, gt - prev[1]) / dt, 2),
+                    )
+                    self._rate_state[iid] = prev
+                elif prev is None:
+                    prev = (rr, gt, now, 0.0, 0.0)
+                    self._rate_state[iid] = prev
+                w["req_s"], w["tok_s"] = prev[3], prev[4]
+                cbk = m.get("compiles_by_kind")
+                if isinstance(cbk, dict):
+                    w["compiles_by_kind"] = {
+                        str(k): int(v)
+                        for k, v in cbk.items()
+                        if isinstance(v, int)
+                    }
+                st = role_stats.setdefault(
+                    role,
+                    {"workers": 0, "kv_usage": [], "mfu": [],
+                     "tokens_per_s": 0.0, "preemptions": 0,
+                     "compiles_by_kind": {}},
+                )
+                st["workers"] += 1
+                if "kv_usage" in w:
+                    st["kv_usage"].append(float(w["kv_usage"]))
+                if "mfu" in w:
+                    st["mfu"].append(float(w["mfu"]))
+                st["tokens_per_s"] += float(w.get("tokens_per_s", 0.0))
+                st["preemptions"] += int(w.get("preemptions", 0))
+                for k, v in w.get("compiles_by_kind", {}).items():
+                    st["compiles_by_kind"][k] = (
+                        st["compiles_by_kind"].get(k, 0) + v
+                    )
+                # None marks a family ABSENT from this frame (the worker
+                # drops a key it failed to build, a garbage wire merges
+                # to zero sources) — _fold_departed must tell that apart
+                # from a genuine counter reset, or the fold+restore cycle
+                # double-counts the monotonic fleet families
+                slo_counts = None
+                wire = m.get("slo")
+                if isinstance(wire, dict):
+                    one = slo_mod.merge_trackers([wire])
+                    if one.sources:
+                        w["slo"] = one.to_snapshot()
+                        wires_by_role.setdefault(role, []).append(wire)
+                        slo_counts = (
+                            one.requests_total, one.within_sla_total,
+                            one.tokens_total, one.goodput_tokens_total,
+                        )
+                contribs[iid] = (
+                    role,
+                    {
+                        "preemptions": (
+                            None if m.get("preemptions") is None
+                            else int(w.get("preemptions", 0) or 0)
+                        ),
+                        "compiles": (
+                            dict(w["compiles_by_kind"])
+                            if isinstance(w.get("compiles_by_kind"), dict)
+                            else None
+                        ),
+                        "slo": slo_counts,
+                    },
+                )
+                workers[iid] = w
+            except Exception:
+                logger.warning(
+                    "skipping malformed worker frame from %s", iid,
+                    exc_info=True,
+                )
+        self._fold_departed(snap, contribs)
+        role_merged = {
+            role: slo_mod.merge_trackers(wires)
+            for role, wires in wires_by_role.items()
+        }
+        all_wires = [w for ws in wires_by_role.values() for w in ws]
+        roles: dict[str, dict] = {}
+        for role, st in sorted(role_stats.items()):
+            roles[role] = {
+                "workers": st["workers"],
+                "kv_usage": (
+                    round(sum(st["kv_usage"]) / len(st["kv_usage"]), 4)
+                    if st["kv_usage"]
+                    else None
+                ),
+                "mfu": (
+                    round(sum(st["mfu"]) / len(st["mfu"]), 6)
+                    if st["mfu"]
+                    else None
+                ),
+                "tokens_per_s": round(st["tokens_per_s"], 2),
+                "preemptions": st["preemptions"],
+                "compiles_by_kind": st["compiles_by_kind"],
+            }
+            merged = role_merged.get(role)
+            if merged is not None and merged.sources:
+                roles[role]["slo"] = merged.to_snapshot()
+        fleet = slo_mod.merge_trackers(all_wires)
+        doc = {
+            "workers": workers,
+            "roles": roles,
+            "fleet": {
+                "workers": len(workers),
+                **(
+                    {"slo": fleet.to_snapshot()} if fleet.sources else {}
+                ),
+            },
+        }
+        return doc, role_merged, role_stats
+
+    def _fold_departed(self, snap: dict, contribs: dict) -> None:
+        """Counter-churn bookkeeping for the fleet exposition. The
+        `dynamo_tpu_fleet_*_total` families are sums over live worker
+        frames — a worker aging out (or restarting with fresh counters)
+        would make them DROP, which Prometheus rate()/increase() reads
+        as a counter reset and turns into a phantom spike equal to the
+        whole new sum. So: when a worker departs or its counters
+        regress, its last-seen contribution moves into a per-role
+        monotonic base that _fleet_lines adds back. Also prunes the
+        req/s-tok/s rate baselines of departed workers (unbounded
+        growth under churn otherwise)."""
+        for iid in list(self._rate_state):
+            if iid not in snap:
+                del self._rate_state[iid]
+        for iid, (role, prev) in list(self._live_contrib.items()):
+            cur = contribs.get(iid)
+            if cur is None:
+                # malformed-this-pass frames (iid still in snap) keep
+                # their old contribution until they truly age out
+                if iid not in snap:
+                    self._fold_retired(role, prev)
+                    del self._live_contrib[iid]
+                continue
+            c = cur[1]
+            # a family ABSENT from this frame (None) keeps its previous
+            # contribution — absence is a dropped key on the worker or a
+            # garbage wire, not a counter reset; treating it as zero
+            # would fold prev now and re-add it from the next healthy
+            # frame, permanently double-counting the monotonic families
+            for fam in ("preemptions", "compiles", "slo"):
+                if c[fam] is None:
+                    c[fam] = prev[fam]
+            # fold ONLY the families that actually regressed (reset on a
+            # worker restart) — a regression in one never implies the
+            # others reset too
+            folded = {"preemptions": 0, "compiles": {}, "slo": None}
+            any_folded = False
+            if (
+                prev["preemptions"] is not None
+                and (c["preemptions"] or 0) < prev["preemptions"]
+            ):
+                folded["preemptions"] = prev["preemptions"]
+                any_folded = True
+            if prev["compiles"] is not None and any(
+                (c["compiles"] or {}).get(k, 0) < v
+                for k, v in prev["compiles"].items()
+            ):
+                folded["compiles"] = prev["compiles"]
+                any_folded = True
+            if prev["slo"] is not None and any(
+                x < p for x, p in zip(c["slo"] or (0, 0, 0, 0), prev["slo"])
+            ):
+                folded["slo"] = prev["slo"]
+                any_folded = True
+            if any_folded:
+                self._fold_retired(role, folded)
+        self._live_contrib.update(contribs)
+
+    def _fold_retired(self, role: str, contrib: dict) -> None:
+        base = self._retired_counters.setdefault(
+            role, {"preemptions": 0, "compiles": {}, "slo": [0, 0, 0, 0]}
+        )
+        base["preemptions"] += contrib["preemptions"] or 0
+        for k, v in (contrib["compiles"] or {}).items():
+            base["compiles"][k] = base["compiles"].get(k, 0) + v
+        base["slo"] = [
+            a + b
+            for a, b in zip(base["slo"], contrib["slo"] or (0, 0, 0, 0))
         ]
+
+    def fleet_snapshot(self) -> dict:
+        return self._assemble_fleet()[0]
+
+    def _fleet_lines(self, assembled=None) -> list[str]:
+        """`dynamo_tpu_fleet_*{role=...}` exposition: per-role worker
+        counts, merged SLO percentiles / attainment / burn rates /
+        goodput, mean utilization, and folded engine-internals counters.
+        Counter families include the retired-worker bases so they stay
+        monotonic across worker churn (the /v1/fleet JSON deliberately
+        does not — it describes the live fleet at this instant)."""
+        import dataclasses
+
+        from dynamo_tpu.telemetry import slo as slo_mod
+
+        _, role_merged, role_stats = assembled or self._assemble_fleet()
+        retired = self._retired_counters
+        lines: list[str] = []
+        if role_stats:
+            lines.append(f"# TYPE {PREFIX}_fleet_workers gauge")
+            for role, st in sorted(role_stats.items()):
+                lines.append(
+                    f'{PREFIX}_fleet_workers{{role="{role}"}} '
+                    f'{st["workers"]}'
+                )
+            for field, ptype, pick in (
+                ("kv_usage", "gauge",
+                 lambda role, st: (
+                     sum(st["kv_usage"]) / len(st["kv_usage"])
+                     if st["kv_usage"] else None
+                 )),
+                ("mfu", "gauge",
+                 lambda role, st: (
+                     sum(st["mfu"]) / len(st["mfu"])
+                     if st["mfu"] else None
+                 )),
+                ("tokens_per_s", "gauge",
+                 lambda role, st: st["tokens_per_s"]),
+                ("preemptions_total", "counter",
+                 lambda role, st: (
+                     st["preemptions"]
+                     + retired.get(role, {}).get("preemptions", 0)
+                 )),
+            ):
+                vals = [
+                    (role, pick(role, st))
+                    for role, st in sorted(role_stats.items())
+                ]
+                vals = [(r, v) for r, v in vals if v is not None]
+                if not vals:
+                    continue
+                lines.append(f"# TYPE {PREFIX}_fleet_{field} {ptype}")
+                for role, v in vals:
+                    lines.append(
+                        f'{PREFIX}_fleet_{field}{{role="{role}"}} '
+                        f"{round(v, 6)}"
+                    )
+            kind_totals: dict[str, dict] = {}
+            for role, st in role_stats.items():
+                kt = dict(st["compiles_by_kind"])
+                for k, v in retired.get(role, {}).get("compiles", {}).items():
+                    kt[k] = kt.get(k, 0) + v
+                kind_totals[role] = kt
+            kind_samples = [
+                (role, k, v)
+                for role in sorted(role_stats)
+                for k, v in sorted(kind_totals[role].items())
+            ]
+            if kind_samples:
+                lines.append(f"# TYPE {PREFIX}_fleet_compile_total counter")
+                for role, k, v in kind_samples:
+                    lines.append(
+                        f'{PREFIX}_fleet_compile_total{{role="{role}",'
+                        f'kind="{k}"}} {v}'
+                    )
+        scopes = []
+        for role, merged in sorted(role_merged.items()):
+            b = retired.get(role, {}).get("slo")
+            if b and any(b):
+                merged = dataclasses.replace(
+                    merged,
+                    requests_total=merged.requests_total + b[0],
+                    within_sla_total=merged.within_sla_total + b[1],
+                    tokens_total=merged.tokens_total + b[2],
+                    goodput_tokens_total=merged.goodput_tokens_total + b[3],
+                )
+            scopes.append((f'role="{role}"', merged))
+        lines += slo_mod.expose_lines(f"{PREFIX}_fleet", scopes)
+        return lines
+
+    def expose(self) -> str:
+        snap3 = self._snapshot_all()
+        assembled = self._assemble_fleet(snap3)
+        counts: dict[str, int] = {self.component: 0}
+        for _, (_, _, comp) in snap3.items():
+            counts[comp] = counts.get(comp, 0) + 1
+        lines = [f"# TYPE {PREFIX}_live_workers gauge"]
+        for comp, n in sorted(counts.items()):
+            lines.append(
+                f'{PREFIX}_live_workers{{component="{comp}"}} {n}'
+            )
         for field, ptype in _WORKER_FIELDS:
             name = f"{PREFIX}_worker_{field}"
             if ptype == "counter" and not field.endswith("_total"):
                 name += "_total"
             lines.append(f"# TYPE {name} {ptype}")
-            for iid, m in sorted(snap.items()):
-                if field in m:
+            for iid, (m, _, comp) in sorted(snap3.items()):
+                if field in m and isinstance(m[field], (int, float)):
                     lines.append(
-                        f'{name}{{component="{self.component}",'
+                        f'{name}{{component="{comp}",'
                         f'instance="{iid}"}} {m[field]}'
                     )
         lines += [
@@ -228,6 +592,7 @@ class MetricsService:
             f"{self.overlap_tokens_total / self.isl_tokens_total if self.isl_tokens_total else 0.0}",
         ]
         lines += self._fabric_lines()
+        lines += self._fleet_lines(assembled)
         # per-phase latency histograms (telemetry plane, process-global)
         from dynamo_tpu.telemetry import phases
 
@@ -243,6 +608,12 @@ class MetricsService:
         return web.json_response(
             {"status": "ok", "workers": len(self.aggregator.snapshot())}
         )
+
+    async def _fleet(self, request: web.Request) -> web.Response:
+        """The queryable fleet snapshot: per-worker role / rates /
+        engine internals / SLO percentiles + per-role and fleet-wide
+        merged views (scripts/fleet_top.py renders this)."""
+        return web.json_response(self.fleet_snapshot())
 
     async def _traces(self, request: web.Request) -> web.Response:
         from dynamo_tpu.telemetry.http_api import traces_payload

@@ -57,6 +57,14 @@ class FleetState:
     prefill_queue_depth: int
     #: request arrivals observed this interval (SLA planner)
     request_rate: float = 0.0
+    #: OBSERVED SLA inputs (fleet telemetry plane, merged worker SLO
+    #: sketches — docs/observability.md "Fleet view & SLO accounting").
+    #: None when no worker published SLO frames yet; the planner's
+    #: control loop today still runs on the perf-interpolation tables
+    #: (ROADMAP item 4 closes the loop on these).
+    observed_ttft_p95_ms: Optional[float] = None
+    observed_itl_p95_ms: Optional[float] = None
+    sla_attainment: Optional[float] = None
 
 
 @dataclass(frozen=True)

@@ -88,6 +88,28 @@ class FleetObserver:
         except Exception:
             logger.debug("prefill queue depth unavailable", exc_info=True)
             depth = 0
+        # Observed-SLA input (fleet telemetry plane): fold the SLO
+        # sketches riding the worker metrics frames into live TTFT/ITL
+        # p95s + attainment. Optional by design — a fleet without
+        # fleet_telemetry (or a garbage wire) leaves the fields None and
+        # the planner keeps running on its offline tables.
+        ttft_p95 = itl_p95 = attain = None
+        try:
+            from dynamo_tpu.telemetry import slo as slo_mod
+
+            wires = [
+                m["slo"]
+                for m in snap.values()
+                if isinstance(m.get("slo"), dict)
+            ]
+            if wires:
+                merged = slo_mod.merge_trackers(wires)
+                if merged.sources:
+                    ttft_p95 = merged.sketches["ttft_ms"].quantile(0.95)
+                    itl_p95 = merged.sketches["itl_ms"].quantile(0.95)
+                    attain = merged.attainment()
+        except Exception:
+            logger.debug("observed-SLA fold failed", exc_info=True)
         return FleetState(
             num_decode=len(decode),
             num_prefill=len(prefill),
@@ -95,4 +117,7 @@ class FleetObserver:
             num_waiting=waiting,
             prefill_queue_depth=depth,
             request_rate=rate,
+            observed_ttft_p95_ms=ttft_p95,
+            observed_itl_p95_ms=itl_p95,
+            sla_attainment=attain,
         )

@@ -95,3 +95,32 @@ def enable_persistent_compile_cache() -> None:
             )
     except Exception:  # cache is an optimization, never a boot failure
         pass
+
+
+def device_peak_flops() -> float:
+    """Per-chip peak FLOP/s for the attached accelerator — the
+    denominator of the live MFU gauge (docs/PERF.md "Live MFU gauge").
+    TPU generations resolve to their public bf16 peaks; off-TPU the
+    fallback comes from DYNTPU_PEAK_FLOPS (else a nominal 1e12 so the
+    gauge stays a plausible (0,1] number on CPU dev boxes instead of
+    vanishing)."""
+    import jax
+
+    try:
+        if jax.default_backend() == "tpu":
+            kind = jax.devices()[0].device_kind.lower().replace(" ", "")
+            for tag, peak in (
+                ("v6e", 918e12), ("v6", 918e12), ("v5p", 459e12),
+                ("v5e", 197e12), ("v5lite", 197e12), ("v4", 275e12),
+            ):
+                if tag in kind:
+                    return peak
+    except Exception:
+        pass
+    try:
+        env = float(os.environ.get("DYNTPU_PEAK_FLOPS", "") or 0.0)
+        if env > 0:
+            return env
+    except ValueError:
+        pass
+    return 1e12

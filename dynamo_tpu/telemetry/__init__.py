@@ -8,9 +8,12 @@
 - chrome_export: trace -> Chrome trace-event JSON (Perfetto).
 - promlint: pure-python Prometheus exposition linter (tests gate every
   hand-rolled /metrics surface with it).
+- slo: streaming quantile sketch (mergeable, bounded memory) + SLA
+  attainment/goodput/burn-rate accounting — the fleet telemetry plane
+  (docs/observability.md "Fleet view & SLO accounting").
 """
 
-from dynamo_tpu.telemetry import phases  # noqa: F401
+from dynamo_tpu.telemetry import phases, slo  # noqa: F401
 from dynamo_tpu.telemetry.trace import (  # noqa: F401
     NOOP_SPAN,
     Span,

@@ -19,6 +19,12 @@ Phases:
                        mixed steps collapse it to one step.
   router_dispatch_ms   PushRouter pick->first response frame
   disagg_transfer_ms   remote prefill enqueue->KV landing
+  compile_ms           one jit-program build+first-execution (engine
+                       _jit_cache miss). Dominated by XLA compilation;
+                       a busy histogram here means the program family
+                       is churning (new buckets / fused-step counts /
+                       mixed-shape combinations) — the compile hazard
+                       the 3-axis mixed family introduced.
 """
 
 from __future__ import annotations
@@ -35,6 +41,7 @@ PHASES = (
     "decode_stall_ms",
     "router_dispatch_ms",
     "disagg_transfer_ms",
+    "compile_ms",
 )
 
 #: ms ladder wide enough for a sub-ms decode step and a 60s stuck

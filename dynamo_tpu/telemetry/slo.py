@@ -1,0 +1,578 @@
+"""Streaming SLO accounting: quantile sketch + sliding windows + burn rates.
+
+Dependency-free (no ddsketch/prometheus_client in the image), bounded
+memory, and MERGEABLE — the properties the fleet plane needs: every
+worker/frontend keeps its own sketches, ships them as a compact wire
+dict on the metrics bus, and the metrics service merges them into one
+fleet view whose percentiles match the percentiles of the pooled raw
+observations (tests/test_slo_sketch.py pins <=1% rank error against
+exact numpy.percentile on adversarial distributions).
+
+Three layers:
+
+- `QuantileSketch`: DDSketch-style log-bucketed sketch with relative
+  bucket width 2*alpha (default 0.5%). Small streams (<= EXACT_CAP
+  observations) stay EXACT — raw values, numpy-style linear-interpolated
+  quantiles — and spill into buckets only past the cap, so a lightly
+  loaded fleet reports exact percentiles and a heavily loaded one pays
+  bounded memory. Each bucket keeps (count, sum, min, max): pure point
+  masses answer EXACTLY (min == max), continuous mass interpolates
+  inside the bucket — the worst-case rank error of a quantile query is
+  the mass of one bucket, which a 1%-wide bucket keeps well under 1%
+  for anything that isn't a sub-bucket point/continuum mixture. Merging
+  is bucket-wise addition (exact concatenation while both sides are
+  still exact): merge(a, b) == merge(b, a) and equals the sketch of the
+  concatenated stream — associativity is structural, not approximate.
+
+- `SloTracker`: per-endpoint/worker SLA accounting. Cumulative sketches
+  for TTFT / ITL / e2e, within-SLA + goodput counters (tokens served by
+  requests that met their SLA), and a ring of time slices powering
+  sliding-window attainment and multi-window burn-rate gauges
+  (burn rate = (1 - attainment) / (1 - objective): 1.0 = exactly
+  spending the error budget, >1 = burning it faster).
+
+- `merge_trackers(wires)`: the fleet-side fold over published
+  `SloTracker.to_wire()` dicts (malformed wires are skipped, never
+  raised — a worker's garbage must not take down the fleet view).
+
+Everything here is host-side Python on the metrics path only; the token
+path never calls into this module.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Iterable, Optional, Sequence
+
+#: relative half-width of one bucket (0.5% => ~1% wide buckets). 2545
+#: buckets would cover 1ns..1e8ms densely; storage is sparse, so real
+#: sketches hold a few dozen.
+DEFAULT_ALPHA = 0.005
+
+#: values at or below this clamp into the bottom bucket (latencies are
+#: positive; zero shows up from clock granularity)
+_MIN_VALUE = 1e-9
+
+#: the quantiles every exposition reports
+EXPOSED_QUANTILES = (0.5, 0.9, 0.95, 0.99)
+
+#: raw values kept before spilling into log buckets (exact quantiles up
+#: to here; ~4 KB of floats at the cap)
+EXACT_CAP = 512
+
+
+class QuantileSketch:
+    """Log-bucketed mergeable quantile sketch (DDSketch-flavored).
+
+    Buckets are indexed by ceil(log_gamma(v)) with gamma = (1+a)/(1-a);
+    each holds [count, sum, min, max]. Memory is O(distinct buckets),
+    bounded by the dynamic range of the data (~2.5k buckets for 11
+    decades at the default alpha).
+    """
+
+    __slots__ = ("alpha", "_log_gamma", "buckets", "count", "total",
+                 "_exact")
+
+    def __init__(self, alpha: float = DEFAULT_ALPHA):
+        if not 0.0 < alpha < 0.5:
+            raise ValueError(f"alpha must be in (0, 0.5), got {alpha}")
+        self.alpha = alpha
+        self._log_gamma = math.log((1.0 + alpha) / (1.0 - alpha))
+        #: bucket index -> [count, sum, min, max]
+        self.buckets: dict[int, list[float]] = {}
+        self.count = 0
+        self.total = 0.0
+        #: raw values while small (exact quantiles); None once spilled
+        self._exact: Optional[list[float]] = []
+
+    # -- ingest ------------------------------------------------------------
+
+    def _index(self, value: float) -> int:
+        return math.ceil(math.log(max(value, _MIN_VALUE)) / self._log_gamma)
+
+    def _bucket_insert(self, v: float) -> None:
+        b = self.buckets.get(self._index(v))
+        if b is None:
+            self.buckets[self._index(v)] = [1, v, v, v]
+        else:
+            b[0] += 1
+            b[1] += v
+            if v < b[2]:
+                b[2] = v
+            elif v > b[3]:
+                b[3] = v
+
+    def _spill(self) -> None:
+        """Move the exact values into log buckets (one-way)."""
+        if self._exact is None:
+            return
+        for v in self._exact:
+            self._bucket_insert(v)
+        self._exact = None
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        if v != v:  # NaN: clock skew artifacts must not poison the sketch
+            return
+        v = max(v, _MIN_VALUE)
+        if self._exact is not None:
+            if len(self._exact) < EXACT_CAP:
+                self._exact.append(v)
+            else:
+                self._spill()
+                self._bucket_insert(v)
+        else:
+            self._bucket_insert(v)
+        self.count += 1
+        self.total += v
+
+    def merge(self, other: "QuantileSketch") -> None:
+        """Fold `other` into self. While both sides are still exact and
+        fit the cap, the merge IS concatenation (exact quantiles);
+        otherwise both spill and merge bucket-wise (exact, associative).
+        Sketches must share alpha — the fleet protocol pins it."""
+        if abs(other.alpha - self.alpha) > 1e-12:
+            raise ValueError(
+                f"cannot merge sketches with alpha {self.alpha} != "
+                f"{other.alpha}"
+            )
+        if (
+            self._exact is not None
+            and other._exact is not None
+            and len(self._exact) + len(other._exact) <= EXACT_CAP
+        ):
+            self._exact.extend(other._exact)
+        else:
+            self._spill()
+            if other._exact is not None:
+                for v in other._exact:
+                    self._bucket_insert(v)
+            else:
+                for idx, (c, s, mn, mx) in other.buckets.items():
+                    b = self.buckets.get(idx)
+                    if b is None:
+                        self.buckets[idx] = [c, s, mn, mx]
+                    else:
+                        b[0] += c
+                        b[1] += s
+                        b[2] = min(b[2], mn)
+                        b[3] = max(b[3], mx)
+        self.count += other.count
+        self.total += other.total
+
+    # -- query -------------------------------------------------------------
+
+    def quantile(self, q: float) -> Optional[float]:
+        """Value at quantile q in [0, 1]; None on an empty sketch.
+        Exact (numpy-style linear interpolation) while the stream is
+        small; bucket-approximate past EXACT_CAP."""
+        if self.count == 0:
+            return None
+        q = min(max(q, 0.0), 1.0)
+        target = q * (self.count - 1)
+        if self._exact is not None:
+            xs = sorted(self._exact)
+            lo = int(target)
+            frac = target - lo
+            if lo + 1 < len(xs) and frac:
+                return xs[lo] + frac * (xs[lo + 1] - xs[lo])
+            return xs[min(lo, len(xs) - 1)]
+        cum = 0
+        last = None
+        for idx in sorted(self.buckets):
+            c, s, mn, mx = last = self.buckets[idx]
+            if target < cum + c:
+                if mn == mx or c == 1:
+                    return mn
+                frac = (target - cum) / (c - 1)
+                return mn + min(frac, 1.0) * (mx - mn)
+            cum += c
+        return last[3] if last else None
+
+    def quantiles(self, qs: Sequence[float] = EXPOSED_QUANTILES) -> dict:
+        return {q: self.quantile(q) for q in qs}
+
+    @property
+    def mean(self) -> Optional[float]:
+        return self.total / self.count if self.count else None
+
+    # -- wire --------------------------------------------------------------
+
+    def to_wire(self) -> dict:
+        """Compact msgpack/json-safe dict: raw values while exact
+        ("x"), bucket quintuples after spilling ("b")."""
+        out: dict = {"alpha": self.alpha, "n": self.count, "sum": self.total}
+        if self._exact is not None:
+            out["x"] = list(self._exact)
+        else:
+            out["b"] = [
+                [idx, c, s, mn, mx]
+                for idx, (c, s, mn, mx) in sorted(self.buckets.items())
+            ]
+        return out
+
+    @classmethod
+    def from_wire(cls, wire: dict) -> "QuantileSketch":
+        sk = cls(alpha=float(wire.get("alpha", DEFAULT_ALPHA)))
+        if "x" in wire and "b" not in wire:
+            for v in wire["x"]:
+                sk.observe(float(v))
+            return sk
+        sk._exact = None
+        for idx, c, s, mn, mx in wire.get("b", ()):
+            sk.buckets[int(idx)] = [int(c), float(s), float(mn), float(mx)]
+        sk.count = int(wire.get("n", sum(b[0] for b in sk.buckets.values())))
+        sk.total = float(
+            wire.get("sum", sum(b[1] for b in sk.buckets.values()))
+        )
+        return sk
+
+
+@dataclass(frozen=True)
+class SlaTargets:
+    """What 'within SLA' means for one endpoint/worker. A None target is
+    not judged (e.g. unary requests have no TTFT)."""
+
+    ttft_ms: Optional[float] = 2000.0
+    itl_ms: Optional[float] = 200.0
+    e2e_ms: Optional[float] = None
+    #: SLO objective the burn rate is priced against (0.99 = 1% budget)
+    objective: float = 0.99
+
+    def ok(self, ttft_ms, itl_ms, e2e_ms) -> bool:
+        if self.ttft_ms is not None and ttft_ms is not None:
+            if ttft_ms > self.ttft_ms:
+                return False
+        if self.itl_ms is not None and itl_ms is not None:
+            if itl_ms > self.itl_ms:
+                return False
+        if self.e2e_ms is not None and e2e_ms is not None:
+            if e2e_ms > self.e2e_ms:
+                return False
+        return True
+
+    def to_wire(self) -> dict:
+        return {
+            "ttft_ms": self.ttft_ms,
+            "itl_ms": self.itl_ms,
+            "e2e_ms": self.e2e_ms,
+            "objective": self.objective,
+        }
+
+
+@dataclass
+class _Slice:
+    """One time slice of the attainment ring."""
+
+    start: float = 0.0
+    requests: int = 0
+    within_sla: int = 0
+    tokens: int = 0
+    goodput_tokens: int = 0
+
+
+#: burn-rate windows (seconds) — a fast window that pages and a slow one
+#: that confirms, the standard multi-window pattern
+DEFAULT_WINDOWS = (60.0, 600.0)
+
+#: seconds per ring slice (windows must be multiples of this)
+SLICE_S = 5.0
+
+
+class SloTracker:
+    """Streaming SLO accounting for one endpoint or worker: cumulative
+    sketches + SLA/goodput counters + sliding-window attainment.
+
+    Thread-safe (the engine thread observes, the publish loop serializes).
+    """
+
+    METRICS = ("ttft_ms", "itl_ms", "e2e_ms")
+
+    def __init__(
+        self,
+        sla: Optional[SlaTargets] = None,
+        windows: Sequence[float] = DEFAULT_WINDOWS,
+        clock=time.monotonic,
+    ):
+        self.sla = sla or SlaTargets()
+        self.windows = tuple(float(w) for w in windows)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self.sketches = {m: QuantileSketch() for m in self.METRICS}
+        self.requests_total = 0
+        self.within_sla_total = 0
+        self.tokens_total = 0
+        self.goodput_tokens_total = 0
+        #: ring of slices spanning the LONGEST window
+        n = max(1, int(max(self.windows, default=SLICE_S) / SLICE_S))
+        self._ring: list[_Slice] = [_Slice() for _ in range(n)]
+
+    # -- ingest ------------------------------------------------------------
+
+    def observe(self, metric: str, value_ms: float) -> None:
+        """Feed one latency sample into the named sketch
+        (ttft_ms | itl_ms | e2e_ms)."""
+        with self._lock:
+            self.sketches[metric].observe(value_ms)
+
+    def _slot(self, now: float) -> _Slice:
+        i = int(now / SLICE_S) % len(self._ring)
+        sl = self._ring[i]
+        start = (now // SLICE_S) * SLICE_S
+        if sl.start != start:
+            self._ring[i] = sl = _Slice(start=start)
+        return sl
+
+    def finish_request(
+        self,
+        ttft_ms: Optional[float] = None,
+        itl_ms: Optional[float] = None,
+        e2e_ms: Optional[float] = None,
+        tokens: int = 0,
+    ) -> bool:
+        """Account one completed request (its samples should already have
+        been fed via observe()). Returns the SLA judgement."""
+        ok = self.sla.ok(ttft_ms, itl_ms, e2e_ms)
+        with self._lock:
+            now = self._clock()
+            sl = self._slot(now)
+            self.requests_total += 1
+            self.tokens_total += tokens
+            sl.requests += 1
+            sl.tokens += tokens
+            if ok:
+                self.within_sla_total += 1
+                self.goodput_tokens_total += tokens
+                sl.within_sla += 1
+                sl.goodput_tokens += tokens
+        return ok
+
+    # -- query -------------------------------------------------------------
+
+    def _window_counts(self, window_s: float, now: float) -> tuple[int, int]:
+        lo = now - window_s
+        n = ok = 0
+        for sl in self._ring:
+            if sl.start >= lo - SLICE_S and sl.requests:
+                n += sl.requests
+                ok += sl.within_sla
+        return n, ok
+
+    def attainment(self, window_s: Optional[float] = None) -> float:
+        """Fraction of requests within SLA (1.0 when idle — no traffic
+        burns no budget)."""
+        with self._lock:
+            if window_s is None:
+                n, ok = self.requests_total, self.within_sla_total
+            else:
+                n, ok = self._window_counts(window_s, self._clock())
+        return ok / n if n else 1.0
+
+    def burn_rate(self, window_s: float) -> float:
+        a = self.attainment(window_s)
+        budget = 1.0 - self.sla.objective
+        return (1.0 - a) / budget if budget > 0 else 0.0
+
+    # -- wire --------------------------------------------------------------
+
+    def to_wire(self) -> dict:
+        with self._lock:
+            now = self._clock()
+            return {
+                "sla": self.sla.to_wire(),
+                "sketches": {
+                    m: sk.to_wire() for m, sk in self.sketches.items()
+                },
+                "requests_total": self.requests_total,
+                "within_sla_total": self.within_sla_total,
+                "tokens_total": self.tokens_total,
+                "goodput_tokens_total": self.goodput_tokens_total,
+                "windows": {
+                    str(int(w)): list(self._window_counts(w, now))
+                    for w in self.windows
+                },
+            }
+
+
+@dataclass
+class MergedSlo:
+    """Fleet-side fold of SloTracker wires (one role, or the whole fleet)."""
+
+    sketches: dict = field(
+        default_factory=lambda: {m: QuantileSketch() for m in SloTracker.METRICS}
+    )
+    requests_total: int = 0
+    within_sla_total: int = 0
+    tokens_total: int = 0
+    goodput_tokens_total: int = 0
+    #: window-seconds -> [requests, within_sla]
+    windows: dict = field(default_factory=dict)
+    sources: int = 0
+    objective: float = 0.99
+
+    def attainment(self, window: Optional[str] = None) -> float:
+        if window is None:
+            n, ok = self.requests_total, self.within_sla_total
+        else:
+            n, ok = self.windows.get(window, (0, 0))
+        return ok / n if n else 1.0
+
+    def burn_rate(self, window: str) -> float:
+        budget = 1.0 - self.objective
+        return (1.0 - self.attainment(window)) / budget if budget > 0 else 0.0
+
+    def to_snapshot(self) -> dict:
+        """JSON-safe summary for /v1/fleet."""
+        out: dict = {
+            "sources": self.sources,
+            "requests_total": self.requests_total,
+            "within_sla_total": self.within_sla_total,
+            "tokens_total": self.tokens_total,
+            "goodput_tokens_total": self.goodput_tokens_total,
+            "attainment": round(self.attainment(), 6),
+            "windows": {},
+        }
+        for w in sorted(self.windows, key=lambda x: int(x)):
+            out["windows"][w] = {
+                "requests": self.windows[w][0],
+                "attainment": round(self.attainment(w), 6),
+                "burn_rate": round(self.burn_rate(w), 4),
+            }
+        for m, sk in self.sketches.items():
+            if sk.count:
+                out[m] = {
+                    f"p{int(q * 100)}": round(v, 3)
+                    for q, v in sk.quantiles().items()
+                    if v is not None
+                }
+                out[m]["n"] = sk.count
+        return out
+
+
+def merge_trackers(wires: Iterable[dict]) -> MergedSlo:
+    """Fold published tracker wires into one MergedSlo. Malformed wires
+    are skipped (the fleet view degrades by one worker, never dies)."""
+    out = MergedSlo()
+    for wire in wires:
+        if not isinstance(wire, dict) or not isinstance(
+            wire.get("sketches"), dict
+        ):
+            continue  # structurally not a tracker wire
+        try:
+            sketches = {
+                m: QuantileSketch.from_wire(wire["sketches"][m])
+                for m in SloTracker.METRICS
+                if m in wire.get("sketches", {})
+            }
+            for m, sk in sketches.items():
+                if abs(sk.alpha - out.sketches[m].alpha) > 1e-12:
+                    # alpha mismatch would raise mid-merge below and
+                    # leave MergedSlo partially folded — reject the
+                    # whole wire up front instead
+                    raise ValueError("sketch alpha mismatch")
+            req = int(wire.get("requests_total", 0))
+            ok = int(wire.get("within_sla_total", 0))
+            toks = int(wire.get("tokens_total", 0))
+            good = int(wire.get("goodput_tokens_total", 0))
+            windows = {
+                str(w): (int(n), int(k))
+                for w, (n, k) in dict(wire.get("windows", {})).items()
+            }
+            objective = float(
+                dict(wire.get("sla") or {}).get("objective", 0.99)
+            )
+        except Exception:
+            continue  # one garbage wire must not kill the fleet fold
+        for m, sk in sketches.items():
+            out.sketches[m].merge(sk)
+        out.requests_total += req
+        out.within_sla_total += ok
+        out.tokens_total += toks
+        out.goodput_tokens_total += good
+        for w, (n, k) in windows.items():
+            cur = out.windows.get(w, (0, 0))
+            out.windows[w] = (cur[0] + n, cur[1] + k)
+        out.objective = objective  # fleet convention: one shared objective
+        out.sources += 1
+    return out
+
+
+def expose_lines(prefix: str, scopes) -> list[str]:
+    """Prometheus text lines for a set of SLO scopes sharing one metric
+    prefix. `scopes` is a list of (labels, tracker-or-MergedSlo) where
+    `labels` is a rendered label body WITHOUT braces (e.g.
+    'endpoint="chat"' or 'role="decode"'); each family is declared ONCE
+    with every scope's samples under it (the Prometheus text format
+    keeps a family's series together — the promlint gate in tests
+    validates the shapes). Families are emitted only when populated."""
+    resolved: list[tuple[str, MergedSlo]] = []
+    for labels, src in scopes:
+        if isinstance(src, SloTracker):
+            src = merge_trackers([src.to_wire()])
+        resolved.append((labels, src))
+    lines: list[str] = []
+    fams: dict[str, tuple[str, list[tuple[str, float]]]] = {}
+
+    def fam(name: str, ptype: str, samples: list[tuple[str, float]]):
+        if not samples:
+            return
+        entry = fams.setdefault(name, (ptype, []))
+        entry[1].extend(samples)
+
+    for labels, src in resolved:
+        sep = "," if labels else ""
+        for m in SloTracker.METRICS:
+            sk = src.sketches[m]
+            if not sk.count:
+                continue
+            fam(
+                m, "gauge",
+                [
+                    (f'{labels}{sep}quantile="{q}"', round(v, 4))
+                    for q, v in sk.quantiles().items()
+                    if v is not None
+                ],
+            )
+        if src.requests_total or src.sources:
+            fam("requests_total", "counter", [(labels, src.requests_total)])
+            fam(
+                "sla_requests_total", "counter",
+                [(labels, src.within_sla_total)],
+            )
+            fam("tokens_total", "counter", [(labels, src.tokens_total)])
+            fam(
+                "goodput_tokens_total", "counter",
+                [(labels, src.goodput_tokens_total)],
+            )
+            fam(
+                "attainment", "gauge",
+                [(f'{labels}{sep}window="all"', round(src.attainment(), 6))]
+                + [
+                    (
+                        f'{labels}{sep}window="{w}s"',
+                        round(src.attainment(w), 6),
+                    )
+                    for w in sorted(src.windows, key=lambda x: int(x))
+                ],
+            )
+            fam(
+                "burn_rate", "gauge",
+                [
+                    (
+                        f'{labels}{sep}window="{w}s"',
+                        round(src.burn_rate(w), 4),
+                    )
+                    for w in sorted(src.windows, key=lambda x: int(x))
+                ],
+            )
+    for name, (ptype, samples) in fams.items():
+        lines.append(f"# TYPE {prefix}_{name} {ptype}")
+        for lbl, val in samples:
+            lines.append(
+                f"{prefix}_{name}{{{lbl}}} {val}" if lbl
+                else f"{prefix}_{name} {val}"
+            )
+    return lines

@@ -655,6 +655,25 @@ class Worker:
                     "requests_received": self.mock.requests_received,
                 }
             if m is not None:
+                # fleet telemetry plane (docs/observability.md "Fleet
+                # view & SLO accounting"): role for the per-role fleet
+                # rollup, SLO sketches + per-kind compile counters when
+                # the engine carries them. Defensive: a telemetry
+                # serialization bug must not sever the load-metrics
+                # plane routers/planner depend on.
+                m["component"] = self.component
+                m["role"] = (
+                    "prefill" if "prefill" in self.component else "decode"
+                )
+                eng = getattr(self.runner, "engine", None)
+                if eng is not None and getattr(eng, "slo", None) is not None:
+                    try:
+                        m["slo"] = eng.slo.to_wire()
+                        m["compiles_by_kind"] = dict(eng.compiles_by_kind)
+                    except Exception:
+                        logger.warning(
+                            "fleet telemetry frame failed", exc_info=True
+                        )
                 if self.transfer_server is not None:
                     # which KV plane transfers actually rode (device /
                     # shm / bulk / inline host) — the ops signal for a

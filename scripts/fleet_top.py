@@ -1,0 +1,149 @@
+#!/usr/bin/env python3
+"""fleet_top: terminal view of the metrics service's /v1/fleet snapshot.
+
+One-shot by default; `--watch N` redraws every N seconds. For operators
+who want the fleet at a glance without Grafana:
+
+    python scripts/fleet_top.py --url http://127.0.0.1:9091
+    python scripts/fleet_top.py --watch 2
+    python scripts/fleet_top.py --snapshot artifacts/fleet.json  # offline
+
+Per worker: role, model, req/s, tok/s, TTFT/ITL p50/p95, KV-pool %,
+live MFU, jit compiles, last_seen age. Fleet footer: merged percentiles,
+SLA attainment + burn rates, goodput. Dependency-free (urllib only);
+`render()` is a pure function smoke-tested against a recorded snapshot
+in tests/test_fleet_telemetry.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.request
+
+
+def _fmt(v, nd: int = 1, suffix: str = "") -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.{nd}f}{suffix}"
+    return f"{v}{suffix}"
+
+
+def _pct(slo: dict, metric: str, q: str):
+    return (slo or {}).get(metric, {}).get(q)
+
+
+def render(snap: dict) -> str:
+    """Pure snapshot -> text table (no I/O; unit-testable)."""
+    cols = (
+        ("WORKER", 22), ("ROLE", 8), ("MODEL", 12), ("REQ/S", 7),
+        ("TOK/S", 8), ("TTFT p50/p95", 14), ("ITL p50/p95", 12),
+        ("KV%", 6), ("WM", 6), ("MFU", 7), ("COMP", 5), ("PREEMPT", 7),
+        ("AGE s", 6),
+    )
+    out = [" ".join(f"{h:<{w}}" for h, w in cols)]
+    for iid, w in sorted((snap.get("workers") or {}).items()):
+        slo = w.get("slo") or {}
+        kv = w.get("kv_usage")
+        row = (
+            iid[:22], w.get("role", "?"), str(w.get("model", "?"))[:12],
+            _fmt(w.get("req_s")), _fmt(w.get("tok_s")),
+            f"{_fmt(_pct(slo, 'ttft_ms', 'p50'), 0)}/"
+            f"{_fmt(_pct(slo, 'ttft_ms', 'p95'), 0)}",
+            f"{_fmt(_pct(slo, 'itl_ms', 'p50'), 0)}/"
+            f"{_fmt(_pct(slo, 'itl_ms', 'p95'), 0)}",
+            _fmt(kv * 100.0 if kv is not None else None, 0),
+            _fmt(w.get("kv_pages_watermark"), 0),
+            _fmt(w.get("mfu"), 4), _fmt(w.get("compiles"), 0),
+            _fmt(w.get("preemptions"), 0), _fmt(w.get("last_seen_s")),
+        )
+        out.append(
+            " ".join(f"{str(v):<{wd}}" for v, (_, wd) in zip(row, cols))
+        )
+    fleet = snap.get("fleet") or {}
+    out.append("")
+    out.append(f"fleet: {fleet.get('workers', 0)} workers")
+    slo = fleet.get("slo")
+    if slo:
+        for m, label in (
+            ("ttft_ms", "ttft"), ("itl_ms", "itl"), ("e2e_ms", "e2e"),
+        ):
+            q = slo.get(m)
+            if q:
+                out.append(
+                    f"  {label:<5} p50 {_fmt(q.get('p50'))} ms   "
+                    f"p95 {_fmt(q.get('p95'))} ms   "
+                    f"p99 {_fmt(q.get('p99'))} ms   (n={q.get('n')})"
+                )
+        out.append(
+            f"  sla   attainment {_fmt(slo.get('attainment'), 4)}   "
+            f"goodput {slo.get('goodput_tokens_total', 0)}/"
+            f"{slo.get('tokens_total', 0)} tokens"
+        )
+        for w_s, wd in sorted(
+            (slo.get("windows") or {}).items(), key=lambda x: int(x[0])
+        ):
+            out.append(
+                f"    {w_s:>4}s window: attainment "
+                f"{_fmt(wd.get('attainment'), 4)}  burn rate "
+                f"{_fmt(wd.get('burn_rate'), 2)}x  "
+                f"({wd.get('requests', 0)} req)"
+            )
+    for role, r in sorted((snap.get("roles") or {}).items()):
+        out.append(
+            f"  {role:<6} {r.get('workers', 0)} workers  "
+            f"tok/s {_fmt(r.get('tokens_per_s'))}  "
+            f"mfu {_fmt(r.get('mfu'), 4)}  "
+            f"kv {_fmt((r.get('kv_usage') or 0) * 100, 0)}%  "
+            f"compiles {sum((r.get('compiles_by_kind') or {}).values())}"
+        )
+    return "\n".join(out)
+
+
+def fetch(url: str) -> dict:
+    with urllib.request.urlopen(f"{url}/v1/fleet", timeout=5) as resp:
+        return json.loads(resp.read().decode())
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "--url", default="http://127.0.0.1:9091",
+        help="metrics service base URL",
+    )
+    ap.add_argument(
+        "--watch", type=float, default=0.0, metavar="SECONDS",
+        help="redraw every N seconds (0 = one shot)",
+    )
+    ap.add_argument(
+        "--snapshot", default=None,
+        help="render a recorded snapshot JSON file instead of fetching",
+    )
+    args = ap.parse_args(argv)
+    while True:
+        if args.snapshot:
+            with open(args.snapshot) as f:
+                snap = json.load(f)
+        else:
+            try:
+                snap = fetch(args.url)
+            except Exception as e:
+                print(f"fetch {args.url}/v1/fleet failed: {e}", file=sys.stderr)
+                if not args.watch:
+                    return 1
+                time.sleep(args.watch)
+                continue
+        text = render(snap)
+        if args.watch:
+            print("\x1b[2J\x1b[H" + text, flush=True)
+            time.sleep(args.watch)
+        else:
+            print(text)
+            return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

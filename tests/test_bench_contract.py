@@ -109,6 +109,17 @@ def test_cpu_fallback_line_is_labeled_and_carries_tpu_artifact():
     assert tr["modeled_overhead_pct"] < 3.0, tr
     assert tr["measured_overhead_pct"] is not None, tr
     assert tr["measured_overhead_pct"] < 30.0, tr
+    # fleet-telemetry on/off A/B (ISSUE 6): sketch observes + SLA
+    # accounting + fleet-frame serialization priced <1% of token
+    # throughput by the deterministic model; the interleaved wall A/B
+    # gets the same generous sanity band as trace_overhead (box noise).
+    so = ex["slo_overhead"]
+    assert "error" not in so, so
+    assert so["telemetry_on_tok_s"] > 0 and so["telemetry_off_tok_s"] > 0
+    assert so["modeled_overhead_pct"] is not None, so
+    assert so["modeled_overhead_pct"] < 1.0, so
+    assert so["measured_overhead_pct"] is not None, so
+    assert so["measured_overhead_pct"] < 30.0, so
 
 
 def test_bench_http_counts_failures_instead_of_raising():

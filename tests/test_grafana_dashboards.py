@@ -1,0 +1,127 @@
+"""Grafana dashboards must query metrics the expositions actually emit.
+
+PR 4's promlint gate stopped malformed expositions; what it could not
+catch was DRIFT — a panel still charting `dynamo_tpu_worker_steps`
+after the exposition renamed it `_total`. This test closes that hole
+permanently: it renders fully-populated FrontendMetrics and
+MetricsService expositions (every worker field, SLO scopes, fleet
+families, fabric stats, every phase histogram), lints them, collects
+every series name they emit, and asserts every `dynamo_tpu_*` metric
+referenced by every panel PromQL under deploy/compose/grafana/ is one
+of them."""
+
+import json
+import pathlib
+import re
+import time
+
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+DASH_DIR = REPO / "deploy" / "compose" / "grafana" / "dashboards"
+
+_NAME_RE = re.compile(r"\bdynamo_tpu_[a-zA-Z0-9_:]*")
+
+
+class _DummyFabric:
+    pass
+
+
+def _populated_expositions() -> list[str]:
+    """Every exposition surface, with every family populated."""
+    from dynamo_tpu.engine.engine import EngineMetrics
+    from dynamo_tpu.frontend.metrics import FrontendMetrics
+    from dynamo_tpu.metrics_service import MetricsService
+    from dynamo_tpu.telemetry import phases
+    from dynamo_tpu.telemetry.slo import SloTracker
+
+    fm = FrontendMetrics()
+    with fm.inflight_guard("m"):
+        pass
+    fm.request_done(
+        "m", "chat", "200", 0.5, input_tokens=64, output_tokens=32,
+        ttft_s=0.1, itl_s=[0.01, 0.02],
+    )
+
+    svc = MetricsService(_DummyFabric())
+    tr = SloTracker()
+    for m in ("ttft_ms", "itl_ms", "e2e_ms"):
+        tr.observe(m, 10.0)
+    tr.finish_request(ttft_ms=10.0, itl_ms=10.0, e2e_ms=10.0, tokens=8)
+    frame = EngineMetrics().to_dict()
+    frame.update(
+        instance_id="w1", model="tiny", component="backend", role="decode",
+        slo=tr.to_wire(), compiles_by_kind={"prefill": 1},
+        prefix_hit_rate=0.5,
+        kv_transfer_device_total=1, kv_transfer_shm_total=1,
+        kv_transfer_bulk_total=1, kv_transfer_host_total=1,
+        remote_prefills_total=1,
+        ext_ready=1, ext_broken=0, ext_restarts_total=0,
+        ext_consecutive_failures=0,
+    )
+    svc.aggregator._latest["w1"] = (frame, time.monotonic())
+    pframe = dict(frame)
+    pframe.update(instance_id="p1", component="prefill", role="prefill")
+    svc.aggregators[1]._latest["p1"] = (pframe, time.monotonic())
+    svc.hit_events = 1
+    svc.isl_tokens_total = 10
+    svc.overlap_tokens_total = 5
+    svc.fabric_stats = {
+        "connections": 2, "active_subs": 1, "active_watches": 1,
+        "active_leases": 1, "ops_total": 10, "redeliveries_total": 1,
+        "queued_items": 0, "inflight_items": 0,
+        "queues": {"q": 0},
+    }
+    phases.phase_histograms.reset()
+    for phase in phases.PHASES:
+        phases.observe(phase, 1.0)
+    try:
+        texts = [fm.expose(), svc.expose()]
+    finally:
+        phases.phase_histograms.reset()
+    return texts
+
+
+def _emitted_series(texts) -> set:
+    names = set()
+    for text in texts:
+        for line in text.splitlines():
+            if not line or line.startswith("#"):
+                continue
+            names.add(re.split(r"[{\s]", line, maxsplit=1)[0])
+    return names
+
+
+def _dashboard_exprs():
+    files = sorted(DASH_DIR.glob("*.json"))
+    assert files, f"no dashboards under {DASH_DIR}"
+    for f in files:
+        doc = json.loads(f.read_text())
+        for panel in doc.get("panels", ()):
+            for target in panel.get("targets", ()):
+                expr = target.get("expr")
+                if expr:
+                    yield f.name, panel.get("title", "?"), expr
+
+
+def test_expositions_lint_clean_when_fully_populated():
+    from dynamo_tpu.telemetry import promlint
+
+    for text in _populated_expositions():
+        assert promlint.lint(text) == [], promlint.lint(text)[:8]
+
+
+def test_every_dashboard_metric_is_emitted():
+    emitted = _emitted_series(_populated_expositions())
+    missing = []
+    checked = 0
+    for fname, title, expr in _dashboard_exprs():
+        for name in _NAME_RE.findall(expr):
+            checked += 1
+            if name not in emitted:
+                missing.append(f"{fname} / {title!r}: {name}")
+    assert checked > 40  # the extraction is actually seeing the panels
+    assert not missing, (
+        "dashboard panels reference metrics no exposition emits "
+        "(rename drift):\n  " + "\n  ".join(missing)
+    )

@@ -1,0 +1,181 @@
+"""Property tests for the streaming quantile sketch + SLO tracker
+(dynamo_tpu/telemetry/slo.py): <=1% rank error against exact
+numpy.percentile on adversarial distributions, exact merge
+associativity, wire round-trips, and SLA/burn-rate accounting."""
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.telemetry.slo import (
+    MergedSlo,
+    QuantileSketch,
+    SlaTargets,
+    SloTracker,
+    merge_trackers,
+)
+
+QS = (0.01, 0.05, 0.25, 0.5, 0.75, 0.9, 0.95, 0.99)
+
+
+def rank_error(data: np.ndarray, estimate: float, q: float) -> float:
+    """Distance from the target rank q to the cdf interval the estimate
+    occupies: [P(x < est), P(x <= est)]. 0 for any estimate lying on the
+    exact quantile's tie range."""
+    n = len(data)
+    lo = np.sum(data < estimate) / n
+    hi = np.sum(data <= estimate) / n
+    if lo <= q <= hi:
+        return 0.0
+    return min(abs(q - lo), abs(q - hi))
+
+
+def sketch_of(values) -> QuantileSketch:
+    sk = QuantileSketch()
+    for v in values:
+        sk.observe(float(v))
+    return sk
+
+
+def _distributions(rng):
+    """Adversarial latency-shaped distributions (ms scale)."""
+    n = 20_000
+    return {
+        "bimodal": np.concatenate(
+            [
+                rng.normal(12.0, 0.8, n // 2).clip(0.5),
+                rng.normal(900.0, 45.0, n - n // 2).clip(500),
+            ]
+        ),
+        "heavy_tail": rng.lognormal(mean=3.0, sigma=1.6, size=n).clip(
+            0.01, 1e7
+        ),
+        "pareto_tail": (rng.pareto(1.3, n) + 1.0) * 7.0,
+        "constant": np.full(n, 42.5),
+        "uniform_wide": rng.uniform(0.05, 5_000.0, n),
+    }
+
+
+def test_rank_error_within_one_percent():
+    rng = np.random.default_rng(7)
+    for name, data in _distributions(rng).items():
+        sk = sketch_of(data)
+        for q in QS:
+            est = sk.quantile(q)
+            err = rank_error(data, est, q)
+            assert err <= 0.01, (
+                f"{name} q={q}: estimate {est} rank error {err:.4f}"
+            )
+
+
+def test_constant_distribution_is_exact():
+    sk = sketch_of([42.5] * 5000)
+    for q in QS:
+        assert sk.quantile(q) == 42.5
+
+
+def test_merge_associative_and_equals_concat():
+    rng = np.random.default_rng(11)
+    a = rng.lognormal(2.0, 1.2, 7000)
+    b = rng.normal(300.0, 20.0, 5000).clip(1)
+    c = rng.uniform(0.1, 50.0, 3000)
+    concat = np.concatenate([a, b, c])
+
+    ab_c = sketch_of(a)
+    ab_c.merge(sketch_of(b))
+    ab_c.merge(sketch_of(c))
+    c_ba = sketch_of(c)
+    bc = sketch_of(b)
+    bc.merge(sketch_of(a))
+    c_ba.merge(bc)
+    direct = sketch_of(concat)
+
+    # merging is bucket-wise addition: both orders and the direct sketch
+    # agree exactly on structure (buckets, counts, extrema); bucket sums
+    # only differ in float addition order
+    for other in (c_ba, direct):
+        assert sorted(ab_c.buckets) == sorted(other.buckets)
+        for idx, (cnt, s, mn, mx) in ab_c.buckets.items():
+            ocnt, os_, omn, omx = other.buckets[idx]
+            assert (cnt, mn, mx) == (ocnt, omn, omx)
+            assert s == pytest.approx(os_, rel=1e-12)
+    assert ab_c.count == len(concat)
+    for q in QS:
+        assert ab_c.quantile(q) == c_ba.quantile(q) == direct.quantile(q)
+        assert rank_error(concat, ab_c.quantile(q), q) <= 0.01
+
+
+def test_wire_round_trip_preserves_quantiles():
+    rng = np.random.default_rng(3)
+    data = rng.lognormal(1.0, 2.0, 4000)
+    sk = sketch_of(data)
+    back = QuantileSketch.from_wire(sk.to_wire())
+    assert back.count == sk.count
+    for q in QS:
+        assert back.quantile(q) == sk.quantile(q)
+    # wire is msgpack/json-safe (lists + scalars only)
+    import json
+
+    json.dumps(sk.to_wire())
+
+
+def test_merge_rejects_alpha_mismatch():
+    a = QuantileSketch(alpha=0.005)
+    b = QuantileSketch(alpha=0.01)
+    with pytest.raises(ValueError):
+        a.merge(b)
+
+
+def test_empty_and_single_value():
+    sk = QuantileSketch()
+    assert sk.quantile(0.5) is None
+    sk.observe(17.0)
+    assert sk.quantile(0.0) == sk.quantile(1.0) == 17.0
+
+
+def test_tracker_sla_judgement_and_goodput():
+    clock = [1000.0]
+    tr = SloTracker(
+        sla=SlaTargets(ttft_ms=100.0, itl_ms=20.0, objective=0.9),
+        windows=(60.0,),
+        clock=lambda: clock[0],
+    )
+    assert tr.finish_request(ttft_ms=50.0, itl_ms=10.0, tokens=32)
+    assert not tr.finish_request(ttft_ms=500.0, itl_ms=10.0, tokens=32)
+    assert not tr.finish_request(ttft_ms=50.0, itl_ms=90.0, tokens=8)
+    # None latencies aren't judged against their target
+    assert tr.finish_request(ttft_ms=None, itl_ms=None, tokens=4)
+    assert tr.requests_total == 4
+    assert tr.within_sla_total == 2
+    assert tr.goodput_tokens_total == 36
+    assert tr.tokens_total == 76
+    assert tr.attainment() == 0.5
+    assert tr.attainment(60.0) == 0.5
+    # burn rate: (1 - 0.5) / (1 - 0.9) = 5x the error budget
+    assert abs(tr.burn_rate(60.0) - 5.0) < 1e-9
+    # the window slides: 10 minutes later the failures age out
+    clock[0] += 600.0
+    assert tr.attainment(60.0) == 1.0
+    assert tr.burn_rate(60.0) == 0.0
+    # cumulative accounting never forgets
+    assert tr.attainment() == 0.5
+
+
+def test_merge_trackers_skips_garbage_wires():
+    tr = SloTracker()
+    tr.observe("ttft_ms", 120.0)
+    tr.finish_request(ttft_ms=120.0, tokens=10)
+    merged = merge_trackers(
+        [
+            tr.to_wire(),
+            {"sketches": "nonsense"},
+            ["not", "a", "dict"],
+            {"sketches": {"ttft_ms": {"b": "garbage"}}},
+            tr.to_wire(),
+        ]
+    )
+    assert isinstance(merged, MergedSlo)
+    assert merged.sources == 2
+    assert merged.requests_total == 2
+    assert merged.sketches["ttft_ms"].count == 2
+    snap = merged.to_snapshot()
+    assert snap["ttft_ms"]["p50"] == pytest.approx(120.0, rel=0.02)
