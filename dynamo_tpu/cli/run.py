@@ -57,6 +57,9 @@ def _engine_config(args, eos_token_ids: tuple = ()) -> EngineConfig:
         overlap_decode=getattr(args, "overlap_decode", True),
         mixed_steps=getattr(args, "mixed_steps", True),
         fleet_telemetry=getattr(args, "fleet_telemetry", True),
+        flight_recorder=getattr(args, "flight_recorder", True),
+        stall_watchdog=getattr(args, "stall_watchdog", True),
+        stall_hard_deadline_s=getattr(args, "stall_hard_deadline", None),
         quantize=getattr(args, "quantize", None),
         kv_quantize=getattr(args, "kv_quantize", None),
         attention_impl=getattr(args, "attention_impl", "auto"),
@@ -640,6 +643,11 @@ def build_parser() -> argparse.ArgumentParser:
              "at /v1/traces; equivalently DYNTPU_TRACING=1 or "
              "DYNTPU_TRACE_RING=<n> — docs/observability.md)",
     )
+    runp.add_argument(
+        "--log-file", default=None, dest="log_file", metavar="NAME|PATH",
+        help="also log (JSONL) to this file; a bare name lands in "
+             "DYNTPU_LOG_DIR (default artifacts/log), never the CWD",
+    )
     runp.add_argument("--namespace", default="dynamo")
     runp.add_argument("--component", default="backend")
     runp.add_argument("--endpoint", default="generate")
@@ -697,6 +705,27 @@ def build_parser() -> argparse.ArgumentParser:
              "sketches, live MFU gauge, fleet-frame publishing; on by "
              "default — host-side metrics only, the token path is "
              "identical either way; docs/observability.md)",
+    )
+    runp.add_argument(
+        "--no-flight-recorder", action="store_false",
+        dest="flight_recorder", default=True,
+        help="disable the per-step flight recorder (bounded ring served "
+             "at /v1/debug/flight and shipped in metrics frames; on by "
+             "default, <1%% overhead, host-side only — "
+             "docs/observability.md 'Debugging a slow or stuck worker')",
+    )
+    runp.add_argument(
+        "--no-stall-watchdog", action="store_false",
+        dest="stall_watchdog", default=True,
+        help="disable the per-request stall watchdog (structured "
+             "diagnosis of wedged streams: flight window + thread "
+             "stacks + trace ids, dynamo_tpu_stalls_total{cause})",
+    )
+    runp.add_argument(
+        "--stall-hard-deadline", type=float, default=None,
+        dest="stall_hard_deadline", metavar="SECONDS",
+        help="error-finish a stream stalled past this many seconds "
+             "instead of hanging the client (default: diagnose-only)",
     )
     runp.add_argument(
         "--quantize", default=None, choices=["int8"],
@@ -876,6 +905,11 @@ def build_parser() -> argparse.ArgumentParser:
     metricsp.add_argument("--component", default="backend")
     metricsp.add_argument("--host", default="127.0.0.1")
     metricsp.add_argument("--port", type=int, default=9091)
+    metricsp.add_argument(
+        "--log-file", default=None, dest="log_file", metavar="NAME|PATH",
+        help="also log (JSONL) to this file; a bare name lands in "
+             "DYNTPU_LOG_DIR (default artifacts/log), never the CWD",
+    )
 
     planp = sub.add_parser("planner", help="autoscale the worker fleet")
     planp.add_argument("--fabric", required=True, help="fabric host:port")
@@ -983,7 +1017,7 @@ def main(argv: Optional[list[str]] = None) -> None:
                 "--connector kube requires at least one --role-service "
                 "mapping (e.g. --role-service decode=Worker)"
             )
-    configure_logging()
+    configure_logging(log_file=getattr(args, "log_file", None))
     if getattr(args, "trace", False):
         from dynamo_tpu import telemetry
 

@@ -92,15 +92,75 @@ class AsyncEngineRunner:
         self._wake = threading.Event()
         self._stop = False
         self._thread: Optional[threading.Thread] = None
+        #: stall watchdog (telemetry/watchdog.py, config.stall_watchdog):
+        #: built in start() — it needs the running event loop, which is
+        #: deliberately NOT the engine thread it watches
+        self.watchdog = None
+
+    def _start_watchdog(self) -> None:
+        cfg = getattr(self.engine, "config", None)
+        if cfg is None or not getattr(cfg, "stall_watchdog", False):
+            return
+        import weakref
+
+        from dynamo_tpu.telemetry.watchdog import StallWatchdog
+
+        eng = self.engine
+
+        def itl_ms():
+            """Live ITL-p95 estimate from the SLO plane (None cold)."""
+            slo = getattr(eng, "slo", None)
+            if slo is None:
+                return None
+            sk = slo.sketches.get("itl_ms")
+            return sk.quantile(0.95) if sk is not None and sk.count else None
+
+        self.watchdog = StallWatchdog(
+            itl_estimate_ms=itl_ms,
+            flight=getattr(eng, "flight", None),
+            stall_factor=cfg.stall_factor,
+            stall_min_s=cfg.stall_min_s,
+            queue_wait_budget_s=cfg.stall_queue_wait_s,
+            hard_deadline_s=cfg.stall_hard_deadline_s,
+            on_wedged=self._wedge_request,
+        )
+        self.watchdog.start()
+        try:
+            eng._watchdog_ref = weakref.ref(self.watchdog)
+        except AttributeError:
+            pass  # non-JaxEngine test doubles need not carry the slot
+
+    def _wedge_request(self, request_id: str, info: dict) -> None:
+        """Hard-deadline action (config.stall_hard_deadline_s): error-
+        finish the wedged stream through its output queue — the client
+        unblocks even while the engine thread is stuck — and enqueue an
+        abort for whenever the engine recovers."""
+        self._post(
+            request_id,
+            {
+                "error": (
+                    f"stall watchdog: {info.get('cause')} for "
+                    f"{info.get('stalled_s')}s; stream error-finished by "
+                    "hard deadline"
+                )
+            },
+        )
+        self._post(request_id, None)
+        with self._lock:
+            self._aborts.append(request_id)
+        self._wake.set()
 
     def start(self) -> None:
         self._loop = asyncio.get_running_loop()
+        self._start_watchdog()
         self._thread = threading.Thread(target=self._run, daemon=True, name="engine")
         self._thread.start()
 
     def stop(self) -> None:
         self._stop = True
         self._wake.set()
+        if self.watchdog is not None:
+            self.watchdog.stop()
         if self._thread is not None:
             self._thread.join(timeout=10)
 
@@ -126,9 +186,16 @@ class AsyncEngineRunner:
                 )
 
     def _emit(self, outputs) -> None:
+        wd = self.watchdog
         for out in outputs:
+            if wd is not None and out.new_token_ids:
+                # engine-side progress mark: a wedged engine thread stops
+                # exactly these, which is what the watchdog detects
+                wd.progress(out.request_id)
             self._post(out.request_id, output_to_dict(out))
             if out.finish_reason is not None:
+                if wd is not None:
+                    wd.done(out.request_id)
                 self._post(out.request_id, None)
 
     def _run(self) -> None:
@@ -155,11 +222,18 @@ class AsyncEngineRunner:
                 self._wake.wait(timeout=0.05)
                 self._wake.clear()
                 continue
+            wd = self.watchdog
+            if wd is not None:
+                wd.step_begin()  # a dispatch that never returns is the
+                # cause="engine_stuck" signal
             try:
                 outputs = eng.step()
             except Exception:
                 logger.exception("engine step failed")
                 continue
+            finally:
+                if wd is not None:
+                    wd.step_end()
             self._emit(outputs)
 
     def _post(self, request_id: str, item) -> None:
@@ -227,7 +301,18 @@ class AsyncEngineRunner:
     ) -> AsyncIterator[dict]:
         """Stream a watched request's output queue: the single place that
         knows the cancel/sentinel/error protocol (used by generate and the
-        disaggregated decode path)."""
+        disaggregated decode path). Also the single place every streamed
+        request enters/leaves the stall watchdog — with its current
+        trace/span ids, so a stall diagnosis can name the wedged trace."""
+        wd = self.watchdog
+        if wd is not None:
+            sp = telemetry.current_span()
+            wd.track(
+                request_id,
+                {"trace_id": sp.trace_id, "span_id": sp.span_id}
+                if sp is not None
+                else None,
+            )
         try:
             while True:
                 if context.cancelled:
@@ -242,6 +327,8 @@ class AsyncEngineRunner:
                     raise RuntimeError(item["error"])
                 yield item
         finally:
+            if wd is not None:
+                wd.done(request_id)
             self._queues.pop(request_id, None)
 
     async def embed(self, prompts, normalize: bool = True):

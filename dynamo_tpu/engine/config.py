@@ -133,6 +133,32 @@ class EngineConfig:
     #: is identical either way; off (`--no-fleet-telemetry`) skips the
     #: bookkeeping entirely (bench.py `slo_overhead` prices it <1%).
     fleet_telemetry: bool = True
+    #: flight recorder (docs/observability.md "Debugging a slow or stuck
+    #: worker"): an always-on bounded ring of per-step records — batch
+    #: kind/buckets, page-pool deltas, dispatch/sync/host ms, overlap
+    #: hits/rollbacks, compile events, queue depths — served at
+    #: GET /v1/debug/flight and shipped in the worker's metrics frames.
+    #: Host-side only; off (`--no-flight-recorder`) is bit-identical on
+    #: the token path (bench.py `flight_overhead` prices it <1%).
+    flight_recorder: bool = True
+    #: flight ring capacity (records, one per engine step)
+    flight_ring: int = 512
+    #: stall watchdog (telemetry/watchdog.py): per-request progress
+    #: monitor diagnosing wedged streams (structured JSONL diagnosis +
+    #: dynamo_tpu_stalls_total{cause}); runs on the worker event loop
+    stall_watchdog: bool = True
+    #: a stream is "stalled" after stall_factor × the live ITL-p95
+    #: estimate with no emission, floored at stall_min_s (first compiles
+    #: legitimately take seconds)
+    stall_factor: float = 32.0
+    stall_min_s: float = 5.0
+    #: admission-wait budget: a request with NO first emission after
+    #: this many seconds is diagnosed as cause="queue_wait"
+    stall_queue_wait_s: float = 120.0
+    #: None (default) = diagnose-only. A number hard-finishes streams
+    #: stalled past it with an error frame instead of hanging the
+    #: client (`--stall-hard-deadline`)
+    stall_hard_deadline_s: Optional[float] = None
     #: KVBM tiering (dynamo_tpu/kvbm): host-DRAM tier byte budget (0 = off)
     host_kv_cache_bytes: int = 0
     #: disk tier byte budget (0 = off; needs disk_kv_cache_dir)

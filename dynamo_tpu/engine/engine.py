@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import logging
 import os
+import threading
 import time
 from dataclasses import dataclass
 from functools import partial
@@ -326,6 +327,35 @@ class JaxEngine:
         #: compile counter by program kind (prefill/decode/mixed/...) —
         #: published in the worker's fleet frame as per-kind labels
         self.compiles_by_kind: dict[str, int] = {}
+        #: per-program cost table (docs/observability.md "Debugging a
+        #: slow or stuck worker"): cache_key -> {kind, compile_ms,
+        #: flops, bytes} from the compiled program's cost_analysis();
+        #: programs_report() joins it with measured per-kind dispatch
+        #: time into roofline %-attainment (GET /v1/debug/programs)
+        self.programs: dict[tuple, dict] = {}
+        #: flight recorder (config.flight_recorder): bounded ring of
+        #: per-step records appended at deque cost from step(); None
+        #: when disabled — the token path is bit-identical either way
+        if config.flight_recorder:
+            from dynamo_tpu.telemetry.flight import FlightRecorder
+
+            self.flight: Optional["FlightRecorder"] = FlightRecorder(
+                config.flight_ring
+            )
+        else:
+            self.flight = None
+        #: armed jax.profiler capture (request_profile): {steps_left,
+        #: dir, started}; consumed by _profile_tick on the engine thread
+        self._profile: Optional[dict] = None
+        self._profile_lock = threading.Lock()
+        #: set (weakly) by AsyncEngineRunner when a stall watchdog is
+        #: attached, so the in-process debug surface can list diagnoses
+        self._watchdog_ref = None
+        # in-process debug surface (GET /v1/debug/*): weak registration,
+        # a GC'd engine drops out
+        from dynamo_tpu.telemetry import debug as _debug
+
+        self.debug_name = _debug.register_engine(self)
         #: fleet telemetry plane (config.fleet_telemetry; mutable so the
         #: bench A/B can toggle one warm engine): SLO sketches + the MFU
         #: window. All host-side — the token path never reads them.
@@ -593,6 +623,8 @@ class JaxEngine:
         return self.scheduler.has_work
 
     def step(self) -> list[StepOutput]:
+        if self._profile is not None:
+            self._profile_start()  # armed capture opens BEFORE this step
         t0 = time.perf_counter()
         batch = self.scheduler.schedule()
         t1 = time.perf_counter()
@@ -644,6 +676,35 @@ class JaxEngine:
                 )
                 self._thru_window.append((time.perf_counter(), step_toks))
                 self._thru_tokens += step_toks
+            if self.flight is not None:
+                self.flight.record_step(
+                    self.metrics,
+                    kind=batch.kind,
+                    step_ms=dt_ms,
+                    n_decode=len(batch.decode),
+                    b_decode=(
+                        self.config.decode_bucket_for(len(batch.decode))
+                        if batch.decode
+                        else 0
+                    ),
+                    n_prefill=len(batch.prefill),
+                    t_bucket=(
+                        max(self._bucket_t(p.length) for p in batch.prefill)
+                        if batch.prefill
+                        else 0
+                    ),
+                    prefill_tokens=sum(p.length for p in batch.prefill),
+                    waiting=self.scheduler.num_waiting(),
+                    running=self.scheduler.num_running(),
+                    free_pages=self.allocator.num_free,
+                    active_pages=self.allocator.num_active,
+                    watermark=max(
+                        getattr(self.allocator, "watermark", 0),
+                        self.metrics.kv_pages_watermark,
+                    ),
+                )
+        if self._profile is not None and batch is not None:
+            self._profile_count()  # one dispatched step captured
         if self._inflight is not None and not self.scheduler.has_work:
             # the wave ended on a sampled stop the speculation couldn't
             # predict: drop the dangling dispatch so device arrays free
@@ -1832,13 +1893,52 @@ class JaxEngine:
         )
         return n_params - expert_elems + expert_elems * top_k // n_experts
 
+    @staticmethod
+    def _cost_scalars(cost) -> tuple[Optional[float], Optional[float]]:
+        """Normalize a cost_analysis() result — a dict on current jax,
+        a per-device list of dicts on older ones, occasionally None —
+        into (flops, bytes_accessed)."""
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else None
+        if not isinstance(cost, dict):
+            return None, None
+
+        def pick(*keys):
+            for k in keys:
+                v = cost.get(k)
+                if isinstance(v, (int, float)) and v == v and v >= 0:
+                    return float(v)
+            return None
+
+        return pick("flops"), pick("bytes accessed", "bytes_accessed")
+
+    def _program_cost(self, jitted: Callable, args, kwargs):
+        """Trace+lower the jitted program (NO XLA compile — ~ms, vs the
+        compile's 10s of ms to seconds) and read the lowering's
+        cost_analysis() flops / bytes accessed: the cost-model numerator
+        of /v1/debug/programs' roofline attainment. Deliberately NOT
+        `.lower().compile()`: caching the AOT Compiled object would skip
+        jax's C++ jit fastpath on every steady-state dispatch (~6%
+        per-call measured), and the AOT executable cache is disjoint
+        from the traced path's, so it would also compile twice. Returns
+        (None, None) on any refusal: cost analysis varies by backend and
+        the serving path must never depend on it."""
+        try:
+            cost = jitted.lower(*args, **kwargs).cost_analysis()
+        except Exception:
+            logger.debug("lowered cost_analysis unavailable", exc_info=True)
+            return None, None
+        return self._cost_scalars(cost)
+
     def _cache_jit(self, kind: str, cache_key, jitted: Callable) -> Callable:
         """Install a jitted program into the cache wrapped so its FIRST
         invocation — where XLA actually compiles — is counted, timed
         (dynamo_tpu_phase_compile_ms; wall time of compile+first run,
-        compile-dominated), and spanned in the trace ring. The wrapper
-        replaces itself with the bare jitted fn after that one call, so
-        the steady-state dispatch path pays nothing."""
+        compile-dominated), spanned in the trace ring, and cost-modeled
+        (the lowering's cost_analysis flops/bytes land in self.programs
+        for GET /v1/debug/programs). The wrapper replaces itself with
+        the bare jitted fn after that one call, so the steady-state
+        dispatch path pays nothing."""
 
         def first_call(*args, **kwargs):
             import time as _time
@@ -1851,6 +1951,7 @@ class JaxEngine:
                 "engine.compile", service="engine",
                 attrs={"kind": kind, "key": str(cache_key)},
             ):
+                flops, nbytes = self._program_cost(jitted, args, kwargs)
                 out = jitted(*args, **kwargs)
             dt_ms = (_time.perf_counter() - t0) * 1000.0
             self.metrics.compiles += 1
@@ -1860,6 +1961,13 @@ class JaxEngine:
             )
             phases.observe("compile_ms", dt_ms)
             self._jit_cache[cache_key] = jitted
+            self.programs[cache_key] = {
+                "kind": kind,
+                "key": str(cache_key),
+                "compile_ms": round(dt_ms, 3),
+                "flops": flops,
+                "bytes": nbytes,
+            }
             return out
 
         self._jit_cache[cache_key] = first_call
@@ -2713,3 +2821,158 @@ class JaxEngine:
                 # its last busy throughput forever
                 m.tokens_per_s = 0.0
                 m.mfu = 0.0
+
+    # -- debug plane: program cost model + on-demand profiling ------------
+    # (docs/observability.md "Debugging a slow or stuck worker")
+
+    #: program kind -> the (cumulative ms, dispatch count) metrics pair
+    #: whose ratio is that kind's measured ms/dispatch. Decode-family
+    #: kinds share the decode columns; mixed steps land in time_mixed_ms.
+    _MEASURED_BY_KIND = {
+        "prefill": ("time_prefill_ms", "prefill_dispatches"),
+        "prefill_nosample": ("time_prefill_ms", "prefill_dispatches"),
+        "decode": ("time_decode_ms", "decode_dispatches"),
+        "decode_multi": ("time_decode_ms", "decode_dispatches"),
+        "spec_verify": ("time_decode_ms", "decode_dispatches"),
+        "mixed": ("time_mixed_ms", "mixed_dispatches"),
+    }
+
+    @staticmethod
+    def _roofline_ms(
+        flops: Optional[float], nbytes: Optional[float],
+        peak_flops: float, peak_bytes_s: float,
+    ) -> Optional[float]:
+        """Cost-model floor for one dispatch: the slower of the compute
+        roof (flops / peak FLOP/s) and the memory roof (bytes accessed /
+        peak HBM bytes/s) — the same arithmetic as docs/PERF.md's
+        decode-roofline table, per compiled program."""
+        t = 0.0
+        if flops and peak_flops:
+            t = max(t, flops / peak_flops)
+        if nbytes and peak_bytes_s:
+            t = max(t, nbytes / peak_bytes_s)
+        return round(t * 1e3, 6) if t > 0 else None
+
+    def programs_report(self) -> dict:
+        """GET /v1/debug/programs: every compiled program's cost model
+        (compile ms, cost_analysis flops/bytes, roofline ms) plus a
+        per-kind rollup joining the kind's production-shape program (its
+        most expensive one — smaller warmup buckets would flatter the
+        number) with the measured ms/dispatch from the step-phase
+        counters into roofline %-attainment. Note the measured column is
+        host wall time per dispatch — under overlap_decode it contains
+        host-loop overhead the roofline doesn't, which is exactly the
+        gap ROADMAP item 3 (on-device multi-step scheduling) attacks."""
+        from dynamo_tpu.platform import device_peak_bytes_per_s
+
+        peak_f = self._peak_flops
+        peak_b = device_peak_bytes_per_s()
+        m = self.metrics
+        programs: list[dict] = []
+        kinds: dict[str, dict] = {}
+        # list() first: the engine thread inserts on steady-state
+        # recompiles (the compile-storm case this report diagnoses)
+        # while the publish loop / debug endpoints iterate here
+        for p in list(self.programs.values()):
+            rl = self._roofline_ms(p["flops"], p["bytes"], peak_f, peak_b)
+            programs.append(dict(p, roofline_ms=rl))
+            k = kinds.setdefault(
+                p["kind"],
+                {"programs": 0, "compile_ms": 0.0, "flops": None,
+                 "bytes": None, "roofline_ms": None},
+            )
+            k["programs"] += 1
+            k["compile_ms"] = round(k["compile_ms"] + p["compile_ms"], 3)
+            if p["flops"] is not None and (
+                k["flops"] is None or p["flops"] > k["flops"]
+            ):
+                k["flops"], k["bytes"], k["roofline_ms"] = (
+                    p["flops"], p["bytes"], rl
+                )
+        for kind, k in kinds.items():
+            k["compiles"] = self.compiles_by_kind.get(kind, 0)
+            pair = self._MEASURED_BY_KIND.get(kind)
+            measured = None
+            if pair is not None:
+                total_ms, disp = getattr(m, pair[0]), getattr(m, pair[1])
+                if disp:
+                    measured = round(total_ms / disp, 3)
+            k["measured_ms_per_dispatch"] = measured
+            # 6 digits: tiny CPU-dev attainments (roofline µs vs a
+            # compile-laden first dispatch's 100s of ms) must not round
+            # to an indistinguishable 0.0
+            k["attainment"] = (
+                round(min(1.0, k["roofline_ms"] / measured), 6)
+                if k["roofline_ms"] and measured
+                else None
+            )
+        return {
+            "peak_flops": peak_f,
+            "peak_bytes_per_s": peak_b,
+            "programs": programs,
+            "kinds": kinds,
+        }
+
+    def programs_wire(self) -> dict:
+        """The compact per-kind rollup that rides the metrics frame."""
+        return self.programs_report()["kinds"]
+
+    def request_profile(self, steps: int, outdir: Optional[str] = None) -> dict:
+        """Arm a jax.profiler capture for `steps` engine steps (POST
+        /v1/debug/profile). The engine thread starts the trace at the
+        end of its next step() and stops it after `steps` dispatched
+        steps, so the capture brackets whole dispatches. Thread-safe;
+        refuses while a capture is already armed. An idle engine starts
+        capturing at its next piece of traffic."""
+        if steps < 1:
+            raise ValueError(f"steps must be >= 1, got {steps}")
+        if outdir is None:
+            outdir = os.path.join(
+                "artifacts", "profile",
+                f"{self.config.model.replace('/', '_')}-{int(time.time())}",
+            )
+        with self._profile_lock:
+            if self._profile is not None:
+                raise RuntimeError(
+                    "a profile capture is already armed/running"
+                )
+            self._profile = {
+                "steps_left": int(steps), "dir": outdir, "started": False,
+            }
+        return {"dir": outdir, "steps": int(steps)}
+
+    def _profile_start(self) -> None:
+        """Engine-thread half of request_profile (1/2): open the trace
+        before the first step after arming. Behind a plain None check in
+        step() — zero cost unarmed."""
+        with self._profile_lock:
+            p = self._profile
+            if p is None or p["started"]:
+                return
+            try:
+                os.makedirs(p["dir"], exist_ok=True)
+                jax.profiler.start_trace(p["dir"])
+            except Exception:
+                logger.exception("jax.profiler capture failed to start")
+                self._profile = None
+                return
+            p["started"] = True
+            logger.info(
+                "profiling %d steps into %s", p["steps_left"], p["dir"]
+            )
+
+    def _profile_count(self) -> None:
+        """Engine-thread half of request_profile (2/2): one dispatched
+        step captured; stop after the armed count."""
+        with self._profile_lock:
+            p = self._profile
+            if p is None or not p["started"]:
+                return
+            p["steps_left"] -= 1
+            if p["steps_left"] <= 0:
+                try:
+                    jax.profiler.stop_trace()
+                    logger.info("profile capture done: %s", p["dir"])
+                except Exception:
+                    logger.exception("jax.profiler stop failed")
+                self._profile = None

@@ -119,6 +119,10 @@ class HttpService:
                 web.get("/metrics", self.metrics_handler),
                 web.get("/v1/traces", self.traces_list),
                 web.get("/v1/traces/{trace_id}", self.trace_get),
+                web.get("/v1/debug/flight", self.debug_flight),
+                web.get("/v1/debug/programs", self.debug_programs),
+                web.get("/v1/debug/stalls", self.debug_stalls),
+                web.post("/v1/debug/profile", self.debug_profile),
                 web.post("/clear_kv_blocks", self.clear_kv_blocks),
             ]
         )
@@ -173,6 +177,40 @@ class HttpService:
             request.match_info["trace_id"], request.query.get("format")
         )
         return web.json_response(body, status=status)
+
+    # -- debug plane (docs/observability.md "Debugging a slow or stuck
+    # worker"): the flight ring / program cost model / stall diagnoses /
+    # jax.profiler trigger of any engine living IN THIS PROCESS (the
+    # single-process `in=http out=jax` topology). Remote workers'
+    # windows are served by the metrics service from their frames. -----
+
+    async def debug_flight(self, request: web.Request) -> web.Response:
+        from dynamo_tpu.telemetry.debug import flight_payload
+
+        body, status = flight_payload(request.query.get("n"))
+        return web.json_response(body, status=status)
+
+    async def debug_programs(self, request: web.Request) -> web.Response:
+        from dynamo_tpu.telemetry.debug import programs_payload
+
+        body, status = programs_payload()
+        return web.json_response(body, status=status)
+
+    async def debug_stalls(self, request: web.Request) -> web.Response:
+        from dynamo_tpu.telemetry.debug import stalls_payload
+
+        body, status = stalls_payload()
+        return web.json_response(body, status=status)
+
+    async def debug_profile(self, request: web.Request) -> web.Response:
+        from dynamo_tpu.telemetry.debug import profile_payload
+
+        try:
+            body = await request.json()
+        except Exception:
+            body = {}
+        payload, status = profile_payload(body)
+        return web.json_response(payload, status=status)
 
     async def clear_kv_blocks(self, request: web.Request) -> web.Response:
         """Flush reusable (cached, unreferenced) KV pages on every worker

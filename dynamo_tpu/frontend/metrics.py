@@ -163,6 +163,12 @@ class FrontendMetrics:
         from dynamo_tpu.telemetry import phases
 
         lines.extend(phases.expose_lines())
+        # stall-watchdog counters (telemetry/watchdog.py): also
+        # process-global — the single-process topology hosts the engine
+        # (and therefore its stalls) right here
+        from dynamo_tpu.telemetry.watchdog import stall_counters
+
+        lines.extend(stall_counters.expose_lines())
         return "\n".join(lines) + "\n"
 
 

@@ -69,7 +69,32 @@ def env_is_truthy(name: str) -> bool:
     return os.environ.get(name, "").lower() in ("1", "true", "yes", "on")
 
 
-def configure_logging(level: int | None = None) -> None:
+#: where bare log-file names land (DYNTPU_LOG_DIR overrides) — services
+#: must not scatter frontend.log/metrics.log into whatever CWD they were
+#: launched from (those strays used to end up at the repo root)
+DEFAULT_LOG_DIR = os.path.join("artifacts", "log")
+
+
+def resolve_log_file(name_or_path: str) -> str:
+    """A bare file name (no directory part) lands in the log dir
+    (DYNTPU_LOG_DIR, default artifacts/log — created on demand);
+    an explicit path is honored as-is."""
+    if os.path.dirname(name_or_path):
+        return name_or_path
+    log_dir = os.environ.get("DYNTPU_LOG_DIR") or DEFAULT_LOG_DIR
+    os.makedirs(log_dir, exist_ok=True)
+    return os.path.join(log_dir, name_or_path)
+
+
+def configure_logging(
+    level: int | None = None, log_file: str | None = None
+) -> None:
+    """Console handler (pretty or JSONL per DYNTPU_LOGGING_JSONL), plus
+    an optional JSONL file handler: `log_file` argument or the
+    DYNTPU_LOG_FILE env var; bare names default into artifacts/log (see
+    resolve_log_file). The file plane is always JSONL — it is the sink
+    the stall watchdog's structured diagnoses and the trace join are
+    designed for (docs/observability.md)."""
     level = level if level is not None else (
         logging.DEBUG if env_is_truthy("DYNTPU_DEBUG") else logging.INFO
     )
@@ -80,6 +105,19 @@ def configure_logging(level: int | None = None) -> None:
         handler.setFormatter(
             logging.Formatter("%(asctime)s %(levelname)s %(name)s: %(message)s")
         )
+    handlers: list[logging.Handler] = [handler]
+    log_file = log_file or os.environ.get("DYNTPU_LOG_FILE") or None
+    if log_file:
+        try:
+            fh = logging.FileHandler(resolve_log_file(log_file))
+            fh.setFormatter(JsonlFormatter())
+            handlers.append(fh)
+        except OSError:
+            # an unwritable log dir must not stop the service booting
+            logging.getLogger(__name__).warning(
+                "cannot open log file %r; console only", log_file,
+                exc_info=True,
+            )
     root = logging.getLogger()
-    root.handlers[:] = [handler]
+    root.handlers[:] = handlers
     root.setLevel(level)

@@ -91,6 +91,11 @@ _WORKER_FIELDS = (
     ("preemptions", "counter"),
     ("tokens_per_s", "gauge"),
     ("mfu", "gauge"),
+    # stall watchdog (telemetry/watchdog.py): stalls diagnosed on this
+    # worker — climbing means streams are wedging (the per-cause split
+    # is in the worker's own dynamo_tpu_stalls_total{cause} and in the
+    # /v1/fleet snapshot's stalls_by_cause)
+    ("stalls_total", "counter"),
 )
 
 #: numeric per-worker fields copied verbatim into the /v1/fleet snapshot
@@ -99,6 +104,7 @@ _FLEET_WORKER_FIELDS = (
     "kv_pages_watermark", "preemptions", "num_running", "num_waiting",
     "steps", "generated_tokens", "requests_received", "compiles",
     "compile_ms", "tokens_per_s", "mfu", "prefix_hit_rate",
+    "stalls_total",
 )
 
 
@@ -164,6 +170,9 @@ class MetricsService:
         app.router.add_get("/v1/fleet", self._fleet)
         app.router.add_get("/v1/traces", self._traces)
         app.router.add_get("/v1/traces/{trace_id}", self._trace)
+        app.router.add_get("/v1/debug/flight", self._debug_flight)
+        app.router.add_get("/v1/debug/programs", self._debug_programs)
+        app.router.add_post("/v1/debug/profile", self._debug_profile)
         self._runner = web.AppRunner(app)
         await self._runner.setup()
         site = web.TCPSite(self._runner, self.host, self.port)
@@ -303,6 +312,13 @@ class MetricsService:
                     w["compiles_by_kind"] = {
                         str(k): int(v)
                         for k, v in cbk.items()
+                        if isinstance(v, int)
+                    }
+                sbc = m.get("stalls_by_cause")
+                if isinstance(sbc, dict):
+                    w["stalls_by_cause"] = {
+                        str(k): int(v)
+                        for k, v in sbc.items()
                         if isinstance(v, int)
                     }
                 st = role_stats.setdefault(
@@ -597,6 +613,11 @@ class MetricsService:
         from dynamo_tpu.telemetry import phases
 
         lines += phases.expose_lines()
+        # stall-watchdog counters (process-global, usually empty here —
+        # the per-worker view is dynamo_tpu_worker_stalls_total above)
+        from dynamo_tpu.telemetry.watchdog import stall_counters
+
+        lines += stall_counters.expose_lines()
         return "\n".join(lines) + "\n"
 
     async def _metrics(self, request: web.Request) -> web.Response:
@@ -628,3 +649,54 @@ class MetricsService:
             request.match_info["trace_id"], request.query.get("format")
         )
         return web.json_response(body, status=status)
+
+    # -- debug plane: fleet-wide flight windows + program cost tables ------
+    # (the per-worker data rides the metrics frames; docs/observability.md
+    # "Debugging a slow or stuck worker")
+
+    async def _debug_flight(self, request: web.Request) -> web.Response:
+        from dynamo_tpu.telemetry.debug import parse_window
+        from dynamo_tpu.telemetry.flight import tail
+
+        n, err = parse_window(request.query.get("n"))
+        if err is not None:
+            return web.json_response(err, status=400)
+
+        workers = {}
+        for iid, (m, age, comp) in sorted(self._snapshot_all().items()):
+            fl = m.get("flight")
+            if not isinstance(fl, list):
+                continue
+            fl = tail(fl, n)
+            workers[iid] = {
+                "component": comp,
+                "last_seen_s": round(age, 3),
+                "records": fl,
+            }
+        return web.json_response({"workers": workers})
+
+    async def _debug_programs(self, request: web.Request) -> web.Response:
+        workers = {}
+        for iid, (m, age, comp) in sorted(self._snapshot_all().items()):
+            pk = m.get("programs_by_kind")
+            if not isinstance(pk, dict):
+                continue
+            workers[iid] = {
+                "component": comp,
+                "last_seen_s": round(age, 3),
+                "kinds": pk,
+            }
+        return web.json_response({"workers": workers})
+
+    async def _debug_profile(self, request: web.Request) -> web.Response:
+        # the metrics service hosts no engine; the payload layer answers
+        # the honest 501 (profile captures must be triggered on the
+        # process that owns the device)
+        from dynamo_tpu.telemetry.debug import profile_payload
+
+        try:
+            body = await request.json()
+        except Exception:
+            body = {}
+        payload, status = profile_payload(body)
+        return web.json_response(payload, status=status)

@@ -124,3 +124,31 @@ def device_peak_flops() -> float:
     except ValueError:
         pass
     return 1e12
+
+
+def device_peak_bytes_per_s() -> float:
+    """Per-chip peak HBM bandwidth — the memory roof of the per-program
+    cost model (engine.programs_report / GET /v1/debug/programs). TPU
+    generations resolve to their public HBM numbers; off-TPU the
+    fallback comes from DYNTPU_PEAK_BYTES (else a nominal 1e11 so
+    attainment stays a plausible fraction on CPU dev boxes)."""
+    import jax
+
+    try:
+        if jax.default_backend() == "tpu":
+            kind = jax.devices()[0].device_kind.lower().replace(" ", "")
+            for tag, peak in (
+                ("v6e", 1640e9), ("v6", 1640e9), ("v5p", 2765e9),
+                ("v5e", 819e9), ("v5lite", 819e9), ("v4", 1228e9),
+            ):
+                if tag in kind:
+                    return peak
+    except Exception:
+        pass
+    try:
+        env = float(os.environ.get("DYNTPU_PEAK_BYTES", "") or 0.0)
+        if env > 0:
+            return env
+    except ValueError:
+        pass
+    return 1e11

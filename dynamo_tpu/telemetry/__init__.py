@@ -11,9 +11,21 @@
 - slo: streaming quantile sketch (mergeable, bounded memory) + SLA
   attainment/goodput/burn-rate accounting — the fleet telemetry plane
   (docs/observability.md "Fleet view & SLO accounting").
+- flight: always-on bounded ring of per-step engine/scheduler records
+  (the "what happened around second 41" plane).
+- watchdog: per-request stall detection + structured diagnosis
+  (dynamo_tpu_stalls_total{cause}, thread stacks, hard-deadline
+  error-finish of wedged streams).
+- debug: the /v1/debug/* payload layer (flight / programs / stalls /
+  profile) shared by the frontend and metrics-service mounts.
 """
 
 from dynamo_tpu.telemetry import phases, slo  # noqa: F401
+from dynamo_tpu.telemetry.flight import FlightRecorder  # noqa: F401
+from dynamo_tpu.telemetry.watchdog import (  # noqa: F401
+    StallWatchdog,
+    stall_counters,
+)
 from dynamo_tpu.telemetry.trace import (  # noqa: F401
     NOOP_SPAN,
     Span,

@@ -1,0 +1,159 @@
+"""In-process debug surface: the `/v1/debug/*` payload layer.
+
+Engines register themselves here (weakly — a GC'd engine drops out) so
+whatever HTTP surface the process happens to have (the OpenAI frontend
+in single-process serving, the metrics service for its own process) can
+serve:
+
+  GET  /v1/debug/flight[?n=]   the flight-recorder window per engine
+  GET  /v1/debug/programs      per-program cost-model attainment
+                               (compile cost, cost_analysis flops/bytes,
+                               measured ms/dispatch vs roofline)
+  GET  /v1/debug/stalls        watchdog counters + recent diagnoses
+  POST /v1/debug/profile       {"steps": K[, "dir": path]} — arm a
+                               jax.profiler capture for K engine steps
+                               (501 when no engine/profiler is here)
+
+Framework-free like telemetry/http_api.py: handlers pass raw strings /
+parsed bodies in and get (json-able body, status) back, so the two
+aiohttp mounts can't drift apart. Remote workers' windows are served by
+the metrics service from their metrics frames instead (docs/
+observability.md "Debugging a slow or stuck worker").
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import weakref
+from typing import Optional
+
+#: the only place HTTP-supplied profile captures may land — a debug
+#: endpoint must not become an arbitrary-path write primitive
+PROFILE_BASE = os.path.join("artifacts", "profile")
+
+#: name -> engine (weak: an engine that fell out of scope must not be
+#: resurrected by its debug surface)
+_engines: "weakref.WeakValueDictionary[str, object]" = (
+    weakref.WeakValueDictionary()
+)
+_counter = itertools.count()
+
+
+def register_engine(engine, name: Optional[str] = None) -> str:
+    """Called by JaxEngine at construction. Returns the registry key."""
+    if name is None:
+        model = getattr(getattr(engine, "config", None), "model", "engine")
+        name = f"{model}-{next(_counter)}"
+    _engines[name] = engine
+    return name
+
+
+def registered_engines() -> dict:
+    return dict(_engines)
+
+
+def _clear_registry() -> None:
+    """Test hook: isolate registry state between tests."""
+    _engines.clear()
+
+
+# -- payloads -------------------------------------------------------------
+
+
+def parse_window(n_str: Optional[str]):
+    """The `?n=` parse shared by the frontend AND metrics-service mounts
+    (one copy, so the two can't drift): -> (n, error_body_or_None)."""
+    if n_str is None:
+        return None, None
+    try:
+        return int(n_str), None
+    except ValueError:
+        return None, {"error": "n must be int"}
+
+
+def flight_payload(n_str: Optional[str]) -> tuple[dict, int]:
+    """GET /v1/debug/flight?n=N -> (body, status)."""
+    n, err = parse_window(n_str)
+    if err is not None:
+        return err, 400
+    engines = {}
+    for name, eng in sorted(registered_engines().items()):
+        fl = getattr(eng, "flight", None)
+        engines[name] = {
+            "enabled": fl is not None,
+            "records": fl.snapshot(n) if fl is not None else [],
+        }
+    return {"engines": engines}, 200
+
+
+def programs_payload() -> tuple[dict, int]:
+    """GET /v1/debug/programs -> per-engine program cost tables."""
+    engines = {}
+    for name, eng in sorted(registered_engines().items()):
+        report = getattr(eng, "programs_report", None)
+        engines[name] = report() if callable(report) else {}
+    return {"engines": engines}, 200
+
+
+def stalls_payload() -> tuple[dict, int]:
+    """GET /v1/debug/stalls -> process stall counters + diagnoses."""
+    from dynamo_tpu.telemetry.watchdog import stall_counters
+
+    diagnoses = []
+    for eng in registered_engines().values():
+        wd = getattr(eng, "_watchdog_ref", None)
+        wd = wd() if callable(wd) else wd
+        if wd is not None:
+            diagnoses.extend(wd.diagnoses[-8:])
+    return {
+        "stalls_by_cause": stall_counters.snapshot(),
+        "stalls_total": stall_counters.total,
+        "diagnoses": diagnoses,
+    }, 200
+
+
+def profile_payload(body: Optional[dict]) -> tuple[dict, int]:
+    """POST /v1/debug/profile -> arm a capture on every registered
+    engine that supports it. Graceful 501 when jax.profiler is missing
+    or no engine lives in this process (e.g. the metrics service)."""
+    body = body or {}
+    try:
+        steps = int(body.get("steps", 8))
+        if steps < 1:
+            raise ValueError
+    except (TypeError, ValueError):
+        return {"error": "steps must be a positive int"}, 400
+    outdir = body.get("dir")
+    if outdir is not None:
+        if not isinstance(outdir, str):
+            return {"error": "dir must be a string path"}, 400
+        # confine client-supplied dirs under PROFILE_BASE: this endpoint
+        # is unauthenticated and os.makedirs at an attacker-chosen
+        # absolute path is a write primitive (in-process callers of
+        # engine.request_profile keep full path freedom)
+        norm = os.path.normpath(outdir)
+        if os.path.isabs(norm) or norm.split(os.sep, 1)[0] == "..":
+            return {
+                "error": "dir must be a relative path "
+                         f"(captures land under {PROFILE_BASE}/)"
+            }, 400
+        outdir = os.path.join(PROFILE_BASE, norm)
+    try:
+        from jax import profiler as _profiler  # noqa: F401
+
+        if not hasattr(_profiler, "start_trace"):
+            raise ImportError("jax.profiler.start_trace unavailable")
+    except Exception as e:
+        return {"error": f"jax profiler unavailable: {e}"}, 501
+    armed = {}
+    for name, eng in sorted(registered_engines().items()):
+        req = getattr(eng, "request_profile", None)
+        if callable(req):
+            try:
+                armed[name] = req(steps, outdir)
+            except Exception as e:  # an un-armable engine must not 500
+                armed[name] = {"error": str(e)}
+    if not armed:
+        return {"error": "no profilable engine in this process"}, 501
+    return {"armed": armed, "steps": steps}, 200

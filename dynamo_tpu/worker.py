@@ -674,6 +674,26 @@ class Worker:
                         logger.warning(
                             "fleet telemetry frame failed", exc_info=True
                         )
+                # debug plane (docs/observability.md "Debugging a slow
+                # or stuck worker"): the flight-recorder window + the
+                # per-kind program cost rollup ride the frame so the
+                # metrics service can serve GET /v1/debug/{flight,
+                # programs} for the whole fleet; same defensive wrap.
+                if eng is not None:
+                    try:
+                        fl = getattr(eng, "flight", None)
+                        if fl is not None:
+                            m["flight"] = fl.to_wire()
+                        if getattr(eng, "programs", None):
+                            m["programs_by_kind"] = eng.programs_wire()
+                    except Exception:
+                        logger.warning(
+                            "debug-plane frame failed", exc_info=True
+                        )
+                wd = getattr(self.runner, "watchdog", None)
+                if wd is not None:
+                    m["stalls_by_cause"] = wd.counters.snapshot()
+                    m["stalls_total"] = wd.counters.total
                 if self.transfer_server is not None:
                     # which KV plane transfers actually rode (device /
                     # shm / bulk / inline host) — the ops signal for a

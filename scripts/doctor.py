@@ -1,0 +1,323 @@
+#!/usr/bin/env python3
+"""doctor: one-shot rule-based diagnosis of a dynamo-tpu fleet.
+
+Snapshots the metrics service's `/v1/fleet`, `/v1/debug/flight` and
+`/v1/debug/programs`, runs the rule set below over them, and prints one
+human-readable report — the "why is this worker slow/stuck" companion
+to fleet_top's "what are the numbers" view:
+
+    python scripts/doctor.py --url http://127.0.0.1:9091
+    python scripts/doctor.py --snapshot fleet.json --flight flight.json
+
+Rules (each emits severity + worker + evidence + suggested action):
+  compile-storm        compile events keep firing in the recent flight
+                       window — the program family is churning in steady
+                       state (every miss is a full XLA compile)
+  pool-exhaustion      free pages pinned at ~0 with the watermark at
+                       capacity and/or preemption-by-recompute firing in
+                       the window — the KV pool is too small for the
+                       workload (preemption thrash burns recompute)
+  stalled-worker       the stall watchdog diagnosed wedged streams
+                       (stalls_total > 0), or a worker with running
+                       requests shows no flight activity
+  decode-stall         pure prefill steps are interleaving with decode
+                       rows waiting (mixed steps off or ineffective) —
+                       running requests pay whole prefill drains as ITL
+  dead-worker          a worker stopped publishing (last_seen_s beyond
+                       the threshold)
+  skewed-worker        one worker's token throughput sits far below its
+                       role's mean — a limping replica drags the whole
+                       pool's SLA
+  sla-burn             a role is burning its error budget (burn rate >1
+                       in the merged windows)
+  low-attainment       a program kind's measured ms/dispatch sits far
+                       off its cost-model roofline (GET /v1/debug/
+                       programs) — host-loop overhead, not the chip, is
+                       the limit (ROADMAP item 3)
+
+`diagnose()` is pure (snapshots in, findings out) and unit-tested
+against recorded snapshots in tests/test_doctor.py. Dependency-free
+(urllib only), like fleet_top.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.request
+from typing import Optional
+
+#: last_seen_s beyond this = the worker stopped publishing
+DEAD_AFTER_S = 10.0
+#: fraction of the role-mean tok/s below which a worker counts as skewed
+SKEW_FRACTION = 0.25
+#: compile events in more than this fraction of the recent window's
+#: steps = the program family is churning, not warming up
+COMPILE_STORM_FRACTION = 0.3
+#: free pages at or below this fraction of total = exhausted
+POOL_FREE_FRACTION = 0.02
+#: decode-attainment below this = the host loop, not the chip, rules
+ATTAINMENT_FLOOR = 0.05
+
+
+def _finding(severity: str, rule: str, worker: Optional[str], summary: str,
+             evidence: dict, action: str) -> dict:
+    return {
+        "severity": severity, "rule": rule, "worker": worker,
+        "summary": summary, "evidence": evidence, "action": action,
+    }
+
+
+def _flight_records(flight: dict, iid: str) -> list[dict]:
+    w = (flight or {}).get("workers", {}).get(iid) or {}
+    recs = w.get("records")
+    return recs if isinstance(recs, list) else []
+
+
+def diagnose(
+    fleet: dict,
+    flight: Optional[dict] = None,
+    programs: Optional[dict] = None,
+) -> list[dict]:
+    """Pure rule pass: (/v1/fleet, /v1/debug/flight, /v1/debug/programs)
+    snapshots -> ordered findings (severity: critical > warning > info)."""
+    findings: list[dict] = []
+    workers = (fleet or {}).get("workers") or {}
+    roles = (fleet or {}).get("roles") or {}
+    #: flight data present at all? The silent-worker rule needs the
+    #: distinction between "no flight doc" and "enabled but silent"
+    flight_collected = bool((flight or {}).get("workers"))
+
+    # per-role token-throughput means for the skew rule
+    role_tok: dict[str, list[float]] = {}
+    for iid, w in workers.items():
+        role_tok.setdefault(str(w.get("role", "?")), []).append(
+            float(w.get("tok_s") or 0.0)
+        )
+    role_mean = {
+        r: (sum(v) / len(v) if v else 0.0) for r, v in role_tok.items()
+    }
+
+    for iid, w in sorted(workers.items()):
+        age = float(w.get("last_seen_s") or 0.0)
+        if age > DEAD_AFTER_S:
+            findings.append(_finding(
+                "critical", "dead-worker", iid,
+                f"{iid} stopped publishing {age:.1f}s ago",
+                {"last_seen_s": age},
+                "check the worker process / its fabric connection; "
+                "deregister or restart it",
+            ))
+            continue  # stale numbers would double-diagnose below
+
+        stalls = int(w.get("stalls_total") or 0)
+        if stalls > 0:
+            findings.append(_finding(
+                "critical", "stalled-worker", iid,
+                f"{iid} diagnosed {stalls} stalled stream(s) "
+                f"({w.get('stalls_by_cause')})",
+                {"stalls_total": stalls,
+                 "stalls_by_cause": w.get("stalls_by_cause")},
+                "read the watchdog diagnosis in the worker's JSONL log "
+                "(thread stacks + flight window + trace ids); "
+                "GET /v1/debug/stalls on the worker's process",
+            ))
+
+        recs = _flight_records(flight or {}, iid)
+        if recs:
+            n = len(recs)
+            compile_steps = sum(1 for r in recs if r.get("compiles"))
+            if n >= 8 and compile_steps / n > COMPILE_STORM_FRACTION:
+                findings.append(_finding(
+                    "warning", "compile-storm", iid,
+                    f"{iid}: compile events in {compile_steps}/{n} of the "
+                    "recent steps — the program family is churning",
+                    {"compile_steps": compile_steps, "window": n},
+                    "inspect GET /v1/debug/programs for the churning "
+                    "kind; pin decode buckets / prefill chunking so "
+                    "shapes stop multiplying",
+                ))
+            preempted = sum(r.get("preempted", 0) for r in recs)
+            free = recs[-1].get("free_pages", None)
+            total = int(w.get("kv_total_pages") or 0)
+            if preempted > 0 or (
+                free is not None and total
+                and free <= total * POOL_FREE_FRACTION
+            ):
+                findings.append(_finding(
+                    "warning", "pool-exhaustion", iid,
+                    f"{iid}: page pool under pressure (free={free}, "
+                    f"watermark={recs[-1].get('watermark')}, "
+                    f"preemptions_in_window={preempted})",
+                    {"free_pages": free, "preempted": preempted,
+                     "watermark": recs[-1].get("watermark"),
+                     "total_pages": total},
+                    "grow --num-pages (or add workers / enable "
+                    "--kv-quantize int8 for ~2x effective capacity); "
+                    "preemption-by-recompute burns whole prompts",
+                ))
+            # prefill-induced decode stall: pure prefill dispatches while
+            # decode rows exist and no mixed steps are being taken
+            pure_prefill = sum(
+                1 for r in recs
+                if r.get("kind") == "prefill" and r.get("running", 0) > r.get("n_prefill", 0)
+            )
+            mixed_steps = sum(1 for r in recs if r.get("kind") == "mixed")
+            if pure_prefill >= 3 and mixed_steps == 0:
+                findings.append(_finding(
+                    "warning", "decode-stall", iid,
+                    f"{iid}: {pure_prefill} pure prefill steps ran while "
+                    "decode rows waited and no mixed steps fired — "
+                    "running requests pay the prefill drain as ITL",
+                    {"pure_prefill_steps": pure_prefill,
+                     "mixed_steps": mixed_steps, "window": n},
+                    "enable mixed steps (drop --no-mixed-steps) or lower "
+                    "the prefill budget; see docs/engine.md 'Mixed steps'",
+                ))
+        elif flight_collected and int(w.get("num_running") or 0) > 0:
+            # only meaningful when flight data WAS collected for this
+            # fleet — in --snapshot-only mode (no flight doc) a busy
+            # worker with no records is the norm, not a wedge
+            findings.append(_finding(
+                "warning", "stalled-worker", iid,
+                f"{iid}: {w.get('num_running')} running request(s) but no "
+                "recent flight records — the engine loop may be wedged",
+                {"num_running": w.get("num_running")},
+                "check the worker's /v1/debug/stalls and JSONL log; a "
+                "dispatch stuck in the device tunnel shows in the "
+                "engine thread's stack",
+            ))
+
+        mean = role_mean.get(str(w.get("role", "?")), 0.0)
+        tok = float(w.get("tok_s") or 0.0)
+        if mean > 1.0 and tok < mean * SKEW_FRACTION:
+            findings.append(_finding(
+                "warning", "skewed-worker", iid,
+                f"{iid}: {tok:.1f} tok/s vs role mean {mean:.1f} — a "
+                "limping replica drags the pool's SLA",
+                {"tok_s": tok, "role_mean_tok_s": round(mean, 1)},
+                "compare its flight window and /v1/debug/programs "
+                "attainment against a healthy peer; drain + restart if "
+                "the hardware is degraded",
+            ))
+
+    for role, r in sorted(roles.items()):
+        slo = r.get("slo") or {}
+        for win, wd in sorted((slo.get("windows") or {}).items()):
+            burn = (wd or {}).get("burn_rate")
+            if burn is not None and burn > 1.0:
+                findings.append(_finding(
+                    "warning", "sla-burn", None,
+                    f"role {role}: burning error budget at {burn:.1f}x "
+                    f"over the {win}s window "
+                    f"(attainment {wd.get('attainment')})",
+                    {"role": role, "window_s": win, "burn_rate": burn},
+                    "scale the role up (planner/operator) or shed load; "
+                    "fleet_top's BURN column names the worst workers",
+                ))
+
+    for iid, p in sorted(((programs or {}).get("workers") or {}).items()):
+        for kind, k in sorted((p.get("kinds") or {}).items()):
+            att = k.get("attainment")
+            if att is not None and att < ATTAINMENT_FLOOR and kind in (
+                "decode", "decode_multi", "mixed"
+            ):
+                findings.append(_finding(
+                    "info", "low-attainment", iid,
+                    f"{iid}: {kind} runs at {att * 100:.2f}% of its "
+                    "cost-model roofline "
+                    f"({k.get('measured_ms_per_dispatch')}ms measured vs "
+                    f"{k.get('roofline_ms')}ms roofline)",
+                    {"kind": kind, **{
+                        f: k.get(f) for f in (
+                            "attainment", "measured_ms_per_dispatch",
+                            "roofline_ms", "flops", "bytes",
+                        )
+                    }},
+                    "the host loop, not the chip, is the limit — see "
+                    "docs/PERF.md (decode roofline) and ROADMAP item 3 "
+                    "(on-device multi-step scheduling)",
+                ))
+
+    order = {"critical": 0, "warning": 1, "info": 2}
+    findings.sort(key=lambda f: (order.get(f["severity"], 9), str(f["worker"])))
+    return findings
+
+
+def render_report(fleet: dict, findings: list[dict]) -> str:
+    """Findings -> the human-readable report."""
+    n_workers = len((fleet or {}).get("workers") or {})
+    out = [f"dynamo-tpu doctor: {n_workers} worker(s), "
+           f"{len(findings)} finding(s)"]
+    if not findings:
+        out.append("  all clear: no rule fired")
+        return "\n".join(out)
+    for f in findings:
+        head = f"[{f['severity'].upper():8}] {f['rule']}"
+        if f["worker"]:
+            head += f" @ {f['worker']}"
+        out.append(head)
+        out.append(f"  {f['summary']}")
+        out.append(f"  -> {f['action']}")
+    return "\n".join(out)
+
+
+def _fetch(url: str, path: str) -> Optional[dict]:
+    try:
+        with urllib.request.urlopen(f"{url}{path}", timeout=5) as resp:
+            return json.loads(resp.read().decode())
+    except Exception as e:
+        print(f"fetch {url}{path} failed: {e}", file=sys.stderr)
+        return None
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "--url", default="http://127.0.0.1:9091",
+        help="metrics service base URL",
+    )
+    ap.add_argument(
+        "--snapshot", default=None,
+        help="recorded /v1/fleet JSON file instead of fetching",
+    )
+    ap.add_argument(
+        "--flight", default=None,
+        help="recorded /v1/debug/flight JSON file instead of fetching",
+    )
+    ap.add_argument(
+        "--programs", default=None,
+        help="recorded /v1/debug/programs JSON file instead of fetching",
+    )
+    ap.add_argument(
+        "--json", action="store_true",
+        help="emit the findings as JSON instead of the text report",
+    )
+    args = ap.parse_args(argv)
+
+    def load(path):
+        with open(path) as f:
+            return json.load(f)
+
+    fleet = load(args.snapshot) if args.snapshot else _fetch(args.url, "/v1/fleet")
+    if fleet is None:
+        return 1
+    flight = (
+        load(args.flight) if args.flight
+        else (_fetch(args.url, "/v1/debug/flight") if not args.snapshot else {})
+    )
+    programs = (
+        load(args.programs) if args.programs
+        else (_fetch(args.url, "/v1/debug/programs") if not args.snapshot else {})
+    )
+    findings = diagnose(fleet, flight or {}, programs or {})
+    if args.json:
+        print(json.dumps(findings, indent=2))
+    else:
+        print(render_report(fleet, findings))
+    return 2 if any(f["severity"] == "critical" for f in findings) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -9,8 +9,10 @@ who want the fleet at a glance without Grafana:
     python scripts/fleet_top.py --snapshot artifacts/fleet.json  # offline
 
 Per worker: role, model, req/s, tok/s, TTFT/ITL p50/p95, KV-pool %,
-live MFU, jit compiles, last_seen age. Fleet footer: merged percentiles,
-SLA attainment + burn rates, goodput. Dependency-free (urllib only);
+live MFU, jit compiles, stall count (dynamo_tpu_stalls_total, via the
+worker frames' stalls_total), SLO burn rate (shortest attainment
+window), last_seen age. Fleet footer: merged percentiles, SLA
+attainment + burn rates, goodput. Dependency-free (urllib only);
 `render()` is a pure function smoke-tested against a recorded snapshot
 in tests/test_fleet_telemetry.py.
 """
@@ -36,18 +38,29 @@ def _pct(slo: dict, metric: str, q: str):
     return (slo or {}).get(metric, {}).get(q)
 
 
+def _worker_burn(slo: dict):
+    """Per-worker burn rate from its SHORTEST attainment window (the
+    fast-paging one of the multi-window pair)."""
+    windows = (slo or {}).get("windows") or {}
+    if not windows:
+        return None
+    shortest = min(windows, key=lambda x: int(x))
+    return (windows[shortest] or {}).get("burn_rate")
+
+
 def render(snap: dict) -> str:
     """Pure snapshot -> text table (no I/O; unit-testable)."""
     cols = (
         ("WORKER", 22), ("ROLE", 8), ("MODEL", 12), ("REQ/S", 7),
         ("TOK/S", 8), ("TTFT p50/p95", 14), ("ITL p50/p95", 12),
         ("KV%", 6), ("WM", 6), ("MFU", 7), ("COMP", 5), ("PREEMPT", 7),
-        ("AGE s", 6),
+        ("STALLS", 6), ("BURN", 6), ("AGE s", 6),
     )
     out = [" ".join(f"{h:<{w}}" for h, w in cols)]
     for iid, w in sorted((snap.get("workers") or {}).items()):
         slo = w.get("slo") or {}
         kv = w.get("kv_usage")
+        burn = _worker_burn(slo)
         row = (
             iid[:22], w.get("role", "?"), str(w.get("model", "?"))[:12],
             _fmt(w.get("req_s")), _fmt(w.get("tok_s")),
@@ -58,7 +71,10 @@ def render(snap: dict) -> str:
             _fmt(kv * 100.0 if kv is not None else None, 0),
             _fmt(w.get("kv_pages_watermark"), 0),
             _fmt(w.get("mfu"), 4), _fmt(w.get("compiles"), 0),
-            _fmt(w.get("preemptions"), 0), _fmt(w.get("last_seen_s")),
+            _fmt(w.get("preemptions"), 0),
+            _fmt(w.get("stalls_total"), 0),
+            _fmt(burn, 1, "x") if burn is not None else "-",
+            _fmt(w.get("last_seen_s")),
         )
         out.append(
             " ".join(f"{str(v):<{wd}}" for v, (_, wd) in zip(row, cols))

@@ -120,6 +120,18 @@ def test_cpu_fallback_line_is_labeled_and_carries_tpu_artifact():
     assert so["modeled_overhead_pct"] < 1.0, so
     assert so["measured_overhead_pct"] is not None, so
     assert so["measured_overhead_pct"] < 30.0, so
+    # flight-recorder on/off A/B (ISSUE 7): one record per engine step
+    # priced <1% of token throughput by the deterministic model (record
+    # microbench x measured records/token); the interleaved wall A/B
+    # gets the same generous sanity band as the other telemetry A/Bs.
+    fo = ex["flight_overhead"]
+    assert "error" not in fo, fo
+    assert fo["flight_on_tok_s"] > 0 and fo["flight_off_tok_s"] > 0
+    assert fo["records_per_token"] > 0, fo
+    assert fo["modeled_overhead_pct"] is not None, fo
+    assert fo["modeled_overhead_pct"] < 1.0, fo
+    assert fo["measured_overhead_pct"] is not None, fo
+    assert fo["measured_overhead_pct"] < 30.0, fo
 
 
 def test_bench_http_counts_failures_instead_of_raising():

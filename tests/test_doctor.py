@@ -1,0 +1,204 @@
+"""scripts/doctor.py: rule-based fleet diagnosis over recorded
+/v1/fleet + /v1/debug/{flight,programs} snapshots (pure `diagnose()`),
+the text report, and the offline CLI path."""
+
+import importlib.util
+import json
+import pathlib
+import subprocess
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _load_doctor():
+    spec = importlib.util.spec_from_file_location(
+        "doctor", REPO / "scripts" / "doctor.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _rec(kind="decode", **kw):
+    return {"seq": 0, "kind": kind, "step_ms": 1.0, "running": 4, **kw}
+
+
+FLEET = {
+    "workers": {
+        "w-healthy": {
+            "role": "decode", "last_seen_s": 0.3, "tok_s": 800.0,
+            "kv_total_pages": 512, "num_running": 4, "stalls_total": 0,
+        },
+        "w-dead": {
+            "role": "decode", "last_seen_s": 42.0, "tok_s": 0.0,
+        },
+        "w-stalled": {
+            "role": "decode", "last_seen_s": 0.4, "tok_s": 700.0,
+            "stalls_total": 2,
+            "stalls_by_cause": {"engine_stuck": 2},
+            "kv_total_pages": 512,
+        },
+        "w-thrash": {
+            "role": "decode", "last_seen_s": 0.2, "tok_s": 650.0,
+            "kv_total_pages": 512, "num_running": 8,
+        },
+        "w-storm": {
+            "role": "decode", "last_seen_s": 0.2, "tok_s": 720.0,
+            "kv_total_pages": 512,
+        },
+        "w-slow": {
+            "role": "decode", "last_seen_s": 0.2, "tok_s": 50.0,
+            "kv_total_pages": 512,
+        },
+        "w-xor": {
+            "role": "decode", "last_seen_s": 0.2, "tok_s": 750.0,
+            "kv_total_pages": 512,
+        },
+        "w-silent": {
+            "role": "decode", "last_seen_s": 0.2, "tok_s": 740.0,
+            "num_running": 3, "kv_total_pages": 512,
+        },
+    },
+    "roles": {
+        "decode": {
+            "workers": 8,
+            "slo": {
+                "windows": {
+                    "60": {"attainment": 0.95, "burn_rate": 5.0,
+                           "requests": 100},
+                },
+            },
+        },
+    },
+    "fleet": {"workers": 8},
+}
+
+FLIGHT = {
+    "workers": {
+        "w-healthy": {"records": [_rec() for _ in range(16)]},
+        "w-stalled": {"records": [_rec() for _ in range(16)]},
+        "w-thrash": {"records": [
+            _rec(free_pages=2, watermark=511, preempted=1)
+            for _ in range(16)
+        ]},
+        "w-storm": {"records": [
+            _rec(compiles=1, compile_ms=300.0) for _ in range(16)
+        ]},
+        "w-slow": {"records": [_rec() for _ in range(16)]},
+        # pure prefill steps while decode rows run, zero mixed steps
+        "w-xor": {"records": [
+            _rec(kind="prefill", n_prefill=1, running=5)
+            for _ in range(16)
+        ]},
+        # w-silent: running requests, NO flight records
+    },
+}
+
+PROGRAMS = {
+    "workers": {
+        "w-slow": {
+            "kinds": {
+                "decode_multi": {
+                    "attainment": 0.002, "roofline_ms": 0.01,
+                    "measured_ms_per_dispatch": 5.0,
+                    "flops": 1e6, "bytes": 1e6,
+                },
+            },
+        },
+    },
+}
+
+
+def test_rules_fire_on_the_recorded_fleet():
+    doctor = _load_doctor()
+    findings = doctor.diagnose(FLEET, FLIGHT, PROGRAMS)
+    by_rule = {}
+    for f in findings:
+        by_rule.setdefault(f["rule"], []).append(f)
+
+    assert [f["worker"] for f in by_rule["dead-worker"]] == ["w-dead"]
+    assert by_rule["dead-worker"][0]["severity"] == "critical"
+    stalled = {f["worker"] for f in by_rule["stalled-worker"]}
+    assert stalled == {"w-stalled", "w-silent"}
+    assert [f["worker"] for f in by_rule["pool-exhaustion"]] == ["w-thrash"]
+    assert [f["worker"] for f in by_rule["compile-storm"]] == ["w-storm"]
+    assert [f["worker"] for f in by_rule["decode-stall"]] == ["w-xor"]
+    assert [f["worker"] for f in by_rule["skewed-worker"]] == ["w-slow"]
+    assert [f["evidence"]["role"] for f in by_rule["sla-burn"]] == ["decode"]
+    assert [f["worker"] for f in by_rule["low-attainment"]] == ["w-slow"]
+    # criticals sort first
+    assert findings[0]["severity"] == "critical"
+    # healthy worker triggers nothing
+    assert all(f["worker"] != "w-healthy" for f in findings)
+
+
+def test_snapshot_only_mode_does_not_flag_busy_workers_as_stalled():
+    """--snapshot without --flight: no flight doc at all — busy workers
+    with no records are the NORM there, not wedged engines (the silent-
+    worker rule only fires when flight data was actually collected)."""
+    doctor = _load_doctor()
+    findings = doctor.diagnose(FLEET, {}, {})
+    silent = [
+        f for f in findings
+        if f["rule"] == "stalled-worker" and f["worker"] == "w-silent"
+    ]
+    assert silent == []
+    # the counter-sourced stalled-worker finding still fires
+    assert any(
+        f["rule"] == "stalled-worker" and f["worker"] == "w-stalled"
+        for f in findings
+    )
+
+
+def test_clean_fleet_reports_all_clear():
+    doctor = _load_doctor()
+    fleet = {
+        "workers": {
+            "w1": {"role": "decode", "last_seen_s": 0.2, "tok_s": 800.0,
+                   "kv_total_pages": 512},
+            "w2": {"role": "decode", "last_seen_s": 0.3, "tok_s": 780.0,
+                   "kv_total_pages": 512},
+        },
+        "roles": {}, "fleet": {"workers": 2},
+    }
+    flight = {"workers": {
+        "w1": {"records": [_rec() for _ in range(8)]},
+        "w2": {"records": [_rec() for _ in range(8)]},
+    }}
+    findings = doctor.diagnose(fleet, flight, {})
+    assert findings == []
+    assert "all clear" in doctor.render_report(fleet, findings)
+
+
+def test_report_renders_and_cli_runs_offline(tmp_path):
+    doctor = _load_doctor()
+    findings = doctor.diagnose(FLEET, FLIGHT, PROGRAMS)
+    text = doctor.render_report(FLEET, findings)
+    assert "dynamo-tpu doctor: 8 worker(s)" in text
+    assert "[CRITICAL" in text and "dead-worker" in text
+    assert "compile-storm @ w-storm" in text
+    assert "-> " in text  # every finding carries an action
+
+    snap = tmp_path / "fleet.json"
+    fl = tmp_path / "flight.json"
+    pr = tmp_path / "programs.json"
+    snap.write_text(json.dumps(FLEET))
+    fl.write_text(json.dumps(FLIGHT))
+    pr.write_text(json.dumps(PROGRAMS))
+    out = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "doctor.py"),
+         "--snapshot", str(snap), "--flight", str(fl),
+         "--programs", str(pr)],
+        capture_output=True, text=True, timeout=60,
+    )
+    # exit code 2 signals critical findings (probe-friendly)
+    assert out.returncode == 2, out.stderr
+    assert "dead-worker" in out.stdout
+    out = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "doctor.py"),
+         "--snapshot", str(snap), "--json"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert out.returncode == 2
+    assert isinstance(json.loads(out.stdout), list)

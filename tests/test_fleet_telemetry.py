@@ -178,6 +178,29 @@ def test_fleet_snapshot_e2e():
                     assert 0.0 < w["mfu"] <= 1.0, (iid, w.get("mfu"))
                     assert w["last_seen_s"] < 5.0
                     assert "slo" in w and w["slo"]["requests_total"] > 0
+                    # debug plane (ISSUE 7): healthy workers report the
+                    # watchdog counter at zero
+                    assert w["stalls_total"] == 0, (iid, w)
+
+                # the workers' flight windows + program cost rollups
+                # rode the frames into the metrics service
+                async with s.get(f"{mbase}/v1/debug/flight?n=8") as r:
+                    assert r.status == 200
+                    fdoc = await r.json()
+                assert len(fdoc["workers"]) == 2
+                for iid, fw in fdoc["workers"].items():
+                    assert fw["records"], iid
+                    assert fw["records"][-1]["kind"] in (
+                        "prefill", "decode", "mixed"
+                    )
+                async with s.get(f"{mbase}/v1/debug/programs") as r:
+                    assert r.status == 200
+                    pdoc = await r.json()
+                for iid, pw in pdoc["workers"].items():
+                    assert any(
+                        k.get("attainment") is not None
+                        for k in pw["kinds"].values()
+                    ), (iid, pw)
                 role = snap["roles"]["decode"]
                 assert role["workers"] == 2
                 assert role["slo"]["requests_total"] == 40
@@ -416,6 +439,8 @@ RECORDED_SNAPSHOT = {
             "last_seen_s": 0.4, "req_s": 12.5, "tok_s": 812.0,
             "kv_usage": 0.42, "kv_free_pages": 1187,
             "kv_pages_watermark": 1622, "preemptions": 3,
+            "stalls_total": 2,
+            "stalls_by_cause": {"stalled_stream": 1, "queue_wait": 1},
             "num_running": 9, "num_waiting": 1, "compiles": 14,
             "compiles_by_kind": {"prefill": 6, "decode_multi": 8},
             "mfu": 0.241, "tokens_per_s": 812.0,
@@ -480,6 +505,18 @@ def test_fleet_top_renders_recorded_snapshot(tmp_path):
     assert "130.1" in text or "130/" in text  # ttft p50 in fleet footer
     assert "burn rate 2.50x" in text
     assert "goodput 25100/25600 tokens" in text
+    # stall-count + burn-rate columns (sourced from the watchdog's
+    # stalls_total and the worker SLO windows)
+    assert "STALLS" in text and "BURN" in text
+    decode_row = next(
+        l for l in text.splitlines() if l.startswith("worker-decode-1")
+    )
+    assert " 2 " in decode_row  # stalls_total
+    assert "2.5x" in decode_row  # 60s-window burn rate
+    prefill_row = next(
+        l for l in text.splitlines() if l.startswith("worker-prefill-1")
+    )
+    assert " - " in prefill_row  # no stall/burn data: dashes, not zeros
     # the CLI one-shot path over a recorded file
     snap_file = tmp_path / "fleet.json"
     snap_file.write_text(json.dumps(RECORDED_SNAPSHOT))

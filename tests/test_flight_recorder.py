@@ -1,0 +1,91 @@
+"""Flight recorder (ISSUE 7): bounded per-step ring, counter deltas,
+engine integration, wire shape, and the bit-identical-off guarantee."""
+
+import dataclasses
+import json
+
+from dynamo_tpu.engine import EngineConfig
+from dynamo_tpu.engine.engine import EngineMetrics, JaxEngine
+from dynamo_tpu.engine.request import SamplingParams
+from dynamo_tpu.telemetry.flight import FlightRecorder
+
+
+def test_ring_is_bounded_and_ordered():
+    fl = FlightRecorder(capacity=4)
+    m = EngineMetrics()
+    for i in range(10):
+        m.generated_tokens += 1
+        fl.record_step(m, kind="decode", step_ms=1.0, n_decode=1)
+    recs = fl.snapshot()
+    assert len(recs) == 4 == len(fl)
+    assert [r["seq"] for r in recs] == [6, 7, 8, 9]
+    # n= trims from the newest end
+    assert [r["seq"] for r in fl.snapshot(2)] == [8, 9]
+    assert fl.to_wire(1)[0]["seq"] == 9
+    # n=0 is an empty window, not the whole ring ([-0:] off-by-zero)
+    assert fl.snapshot(0) == []
+
+
+def test_records_carry_counter_deltas_not_cumulatives():
+    fl = FlightRecorder()
+    m = EngineMetrics()
+    m.compiles = 3
+    m.compile_ms = 120.0
+    m.preemptions = 1
+    fl.record_step(m, kind="prefill", step_ms=5.0)
+    # first record sees the whole cumulative as its delta (boot window)
+    r0 = fl.snapshot()[-1]
+    assert r0["compiles"] == 3 and r0["preempted"] == 1
+    # a quiet step records NO delta keys at all (compact records)
+    fl.record_step(m, kind="decode", step_ms=1.0)
+    r1 = fl.snapshot()[-1]
+    assert "compiles" not in r1 and "preempted" not in r1
+    m.compiles += 1
+    m.overlap_hits += 2
+    fl.record_step(m, kind="decode", step_ms=1.0)
+    r2 = fl.snapshot()[-1]
+    assert r2["compiles"] == 1 and r2["overlap_hits"] == 2
+
+
+def test_engine_steps_append_records_with_buckets_and_compiles():
+    eng = JaxEngine(EngineConfig.for_tests())
+    for i in range(3):
+        eng.add_request(
+            f"r{i}", [1 + i, 2, 3, 4, 5],
+            SamplingParams(temperature=0.0, max_tokens=4),
+        )
+    eng.run_to_completion()
+    recs = eng.flight.snapshot()
+    assert recs, "engine steps must append flight records"
+    kinds = {r["kind"] for r in recs}
+    assert "prefill" in kinds and ("decode" in kinds or "mixed" in kinds)
+    pre = next(r for r in recs if r["kind"] == "prefill")
+    assert pre["n_prefill"] == 3 and pre["t_bucket"] >= 5
+    assert pre["prefill_tokens"] == 15
+    dec = next(r for r in recs if r["kind"] in ("decode", "mixed"))
+    assert dec["n_decode"] == 3 and dec["b_decode"] == 4  # bucket of 3
+    # the first steps carry the jit-compile events
+    assert sum(r.get("compiles", 0) for r in recs) == eng.metrics.compiles
+    assert all(r["step_ms"] > 0 for r in recs)
+    # records are json-safe (they ride the metrics frame wire)
+    json.dumps(recs)
+
+
+def test_flight_off_is_bit_identical_and_recorder_absent():
+    outs = {}
+    for on in (True, False):
+        cfg = dataclasses.replace(
+            EngineConfig.for_tests(), flight_recorder=on
+        )
+        eng = JaxEngine(cfg)
+        for i in range(3):
+            eng.add_request(
+                f"r{i}", [1 + i, 2, 3, 4],
+                SamplingParams(temperature=0.8, top_p=0.9, max_tokens=6),
+            )
+        outs[on] = eng.run_to_completion()
+        if on:
+            assert eng.flight is not None and len(eng.flight) > 0
+        else:
+            assert eng.flight is None
+    assert outs[True] == outs[False]

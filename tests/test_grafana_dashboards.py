@@ -58,6 +58,7 @@ def _populated_expositions() -> list[str]:
         remote_prefills_total=1,
         ext_ready=1, ext_broken=0, ext_restarts_total=0,
         ext_consecutive_failures=0,
+        stalls_total=1, stalls_by_cause={"stalled_stream": 1},
     )
     svc.aggregator._latest["w1"] = (frame, time.monotonic())
     pframe = dict(frame)
@@ -72,13 +73,22 @@ def _populated_expositions() -> list[str]:
         "queued_items": 0, "inflight_items": 0,
         "queues": {"q": 0},
     }
+    # stall-watchdog counters (process-global, like the phase
+    # histograms): populated so the "Stalls & attainment" panels and the
+    # promlint gate see the dynamo_tpu_stalls_total{cause} family
+    from dynamo_tpu.telemetry.watchdog import stall_counters
+
     phases.phase_histograms.reset()
+    stall_counters.reset()
     for phase in phases.PHASES:
         phases.observe(phase, 1.0)
+    for cause in ("queue_wait", "stalled_stream", "engine_stuck"):
+        stall_counters.bump(cause)
     try:
         texts = [fm.expose(), svc.expose()]
     finally:
         phases.phase_histograms.reset()
+        stall_counters.reset()
     return texts
 
 
