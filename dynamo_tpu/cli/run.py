@@ -54,6 +54,7 @@ def _engine_config(args, eos_token_ids: tuple = ()) -> EngineConfig:
         disk_kv_cache_bytes=getattr(args, "disk_kv_bytes", 0),
         disk_kv_cache_dir=getattr(args, "disk_kv_dir", None),
         spec_ngram=getattr(args, "spec_ngram", 0),
+        max_waiting=getattr(args, "max_waiting", None),
         overlap_decode=getattr(args, "overlap_decode", True),
         mixed_steps=getattr(args, "mixed_steps", True),
         fleet_telemetry=getattr(args, "fleet_telemetry", True),
@@ -172,7 +173,12 @@ async def _run_http(args) -> None:
     else:
         pipeline, runner = await _make_local_pipeline(args)
         manager.add(args.model, pipeline)
-    svc = HttpService(manager, host=args.host, port=args.port)
+    svc = HttpService(
+        manager, host=args.host, port=args.port,
+        max_inflight=getattr(args, "max_inflight", None),
+        shed_burn_threshold=getattr(args, "shed_burn_threshold", None),
+        request_timeout_s=getattr(args, "request_timeout", None),
+    )
     await svc.start()
     print(f"listening on http://{args.host}:{svc.port}/v1", flush=True)
     try:
@@ -312,11 +318,36 @@ async def _run_worker(args) -> None:
         kv_remote=getattr(args, "kv_remote", False),
         echo_delay=getattr(args, "echo_delay", 0.0),
         advertise_host=args.host,
+        drain_budget_s=getattr(args, "drain_budget", 30.0),
     )
     await worker.start()
     print(f"worker {worker.instance_id} up (model={args.model})", flush=True)
+    # SIGTERM = graceful drain (docs/operations.md "Overload & draining"):
+    # deregister, finish in-flight within --drain-budget, exit 0. SIGINT
+    # keeps its fast KeyboardInterrupt teardown for interactive use.
+    import signal as _signal
+
+    term = asyncio.Event()
+    loop = asyncio.get_running_loop()
     try:
-        await asyncio.Event().wait()
+        loop.add_signal_handler(_signal.SIGTERM, term.set)
+    except (NotImplementedError, RuntimeError):  # non-main thread / win
+        pass
+    try:
+        waits = [
+            asyncio.ensure_future(term.wait()),
+            asyncio.ensure_future(worker.drained.wait()),
+        ]
+        try:
+            # wakes on SIGTERM or on an admin-triggered drain completing
+            await asyncio.wait(waits, return_when=asyncio.FIRST_COMPLETED)
+        finally:
+            for w in waits:
+                w.cancel()
+        if not worker.drained.is_set():
+            print(f"worker {worker.instance_id} draining", flush=True)
+            await worker.drain()
+        print(f"worker {worker.instance_id} drained; exiting", flush=True)
     finally:
         await worker.stop()
         if external is not None:
@@ -630,6 +661,42 @@ def build_parser() -> argparse.ArgumentParser:
     runp.add_argument(
         "--echo-delay", type=float, default=0.0, dest="echo_delay",
         help="out=echo: seconds per emitted token (stream-timing tests)",
+    )
+    runp.add_argument(
+        "--max-waiting", type=int, default=None, dest="max_waiting",
+        help="bounded admission: cap on the engine's waiting queue — a "
+             "full queue answers 'overloaded' (HTTP 429 + Retry-After at "
+             "the frontend) instead of queueing forever (default: "
+             "unbounded; docs/operations.md 'Overload & draining')",
+    )
+    runp.add_argument(
+        "--max-inflight", type=int, default=None, dest="max_inflight",
+        help="frontend admission cap: reject with 429 + Retry-After once "
+             "this many requests are in flight (default: unbounded)",
+    )
+    runp.add_argument(
+        "--request-timeout", type=float, default=None,
+        dest="request_timeout", metavar="SECONDS",
+        help="server-default end-to-end deadline; per-request "
+             "x-request-timeout overrides it. Expired requests are "
+             "dropped before admission and error-finished mid-decode "
+             "(default: none)",
+    )
+    runp.add_argument(
+        "--shed-burn-threshold", type=float, default=None,
+        dest="shed_burn_threshold", metavar="RATE",
+        help="SLO-burn load shedder: when the endpoint's short-window "
+             "burn rate exceeds this (1.0 = spending the error budget "
+             "exactly), shed best-effort requests (x-priority < 1) with "
+             "probability ramping to 100%% at 2x the threshold "
+             "(default: off)",
+    )
+    runp.add_argument(
+        "--drain-budget", type=float, default=30.0, dest="drain_budget",
+        metavar="SECONDS",
+        help="graceful drain budget: on SIGTERM (or POST /v1/admin/"
+             "drain) the worker deregisters, finishes in-flight "
+             "requests up to this long, then exits 0",
     )
     runp.add_argument(
         "--transfer-timeout", type=float, default=30.0,
@@ -1018,6 +1085,11 @@ def main(argv: Optional[list[str]] = None) -> None:
                 "mapping (e.g. --role-service decode=Worker)"
             )
     configure_logging(log_file=getattr(args, "log_file", None))
+    # chaos harness: subprocess workers join fault-injection scenarios
+    # via DYNTPU_FAULTS (no-op when unset — dynamo_tpu/testing/faults.py)
+    from dynamo_tpu.testing.faults import install_from_env
+
+    install_from_env()
     if getattr(args, "trace", False):
         from dynamo_tpu import telemetry
 

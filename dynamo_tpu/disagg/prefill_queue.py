@@ -29,11 +29,30 @@ class PrefillQueue:
         self, timeout: Optional[float] = None
     ) -> Optional[tuple[str, RemotePrefillRequest]]:
         """Returns (item_id, request); ack(item_id) when the transfer lands,
-        nack(item_id) to redeliver."""
+        nack(item_id) to redeliver. Broker-counted redeliveries (a consumer
+        died mid-prefill and the item came back) fold into req.attempts so
+        the poison-item cap sees BOTH failure modes — explicit requeues and
+        death-redeliveries."""
         item = await self.fabric.queue_pop(self.name, timeout=timeout)
         if item is None:
             return None
-        return item.item_id, RemotePrefillRequest.unpack(item.payload)
+        req = RemotePrefillRequest.unpack(item.payload)
+        try:
+            redelivered = int((item.header or {}).get("redeliveries", 0))
+        except (TypeError, ValueError):
+            redelivered = 0
+        req.attempts = max(req.attempts, redelivered)
+        return item.item_id, req
+
+    async def dead_letter(self, req: RemotePrefillRequest) -> None:
+        """Park a poison item on the `<name>.dead` queue (never consumed
+        automatically; depth shows in the fabric's queue stats) so it
+        stops cycling through the fleet."""
+        await self.fabric.queue_push(
+            f"{self.name}.dead",
+            {"request_id": req.request_id, "attempts": req.attempts},
+            req.pack(),
+        )
 
     async def ack(self, item_id: str) -> None:
         await self.fabric.queue_ack(self.name, item_id)

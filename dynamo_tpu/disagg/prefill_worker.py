@@ -54,6 +54,10 @@ class PrefillWorker:
         self.registration = None
         self.instance_id = ""
         self.prefills_done = 0
+        #: poison items parked on the dead-letter queue (redelivery cap)
+        self.dead_letters = 0
+        #: deadline-expired items dropped without prefilling
+        self.deadline_drops = 0
         self._task: Optional[asyncio.Task] = None
         self._flush_sub = None
         self._flush_task: Optional[asyncio.Task] = None
@@ -121,7 +125,77 @@ class PrefillWorker:
                 lambda t: t.cancelled() or t.exception()  # observe, never raise
             )
 
+    async def _dead_letter(self, item_id: str, req: RemotePrefillRequest) -> None:
+        """Redelivery cap hit (a poison item that keeps killing its
+        consumer, or a decode target that nacks every attempt): park it
+        on `<queue>.dead` and error-finish the decode side so its waiter
+        stops burning the transfer timeout (docs/operations.md)."""
+        logger.error(
+            "dead-lettering prefill %s after %d attempts",
+            req.request_id, req.attempts,
+        )
+        self.dead_letters += 1
+        try:
+            await self.queue.dead_letter(req)
+        except Exception:
+            logger.exception("dead-letter push for %s failed", req.request_id)
+        try:
+            await self.transfer.send_error(
+                req.transfer_host, req.transfer_port, req.request_id,
+                f"remote prefill dead-lettered after {req.attempts} attempts",
+            )
+        except Exception:
+            # decode side may be long gone (its waiter timed out) —
+            # the dead-letter parking is what matters
+            logger.warning(
+                "dead-letter notify for %s failed", req.request_id,
+                exc_info=True,
+            )
+        await self.queue.ack(item_id)
+
+    @staticmethod
+    def _expired(req: RemotePrefillRequest) -> bool:
+        import time
+
+        return bool(req.deadline) and time.time() > float(req.deadline)
+
     async def _handle(self, item_id: str, req: RemotePrefillRequest) -> None:
+        if req.attempts >= self.MAX_ATTEMPTS:
+            try:
+                await self._dead_letter(item_id, req)
+            except Exception:
+                logger.exception("dead-letter of %s failed", req.request_id)
+            finally:
+                self._sem.release()
+            return
+        if self._expired(req):
+            # the client's deadline already passed: never spend prefill
+            # flops on it — and TELL the decode side, whose waiter would
+            # otherwise sit out the whole transfer timeout holding its
+            # page reservation and the client connection
+            self.deadline_drops += 1
+            logger.info(
+                "dropping expired prefill %s (deadline passed)",
+                req.request_id,
+            )
+            try:
+                try:
+                    await self.transfer.send_error(
+                        req.transfer_host, req.transfer_port,
+                        req.request_id,
+                        "remote prefill dropped: deadline expired",
+                    )
+                except Exception:
+                    logger.warning(
+                        "expiry notify for %s failed", req.request_id,
+                        exc_info=True,
+                    )
+                await self.queue.ack(item_id)
+            except Exception:
+                logger.exception("ack of expired %s failed", req.request_id)
+            finally:
+                self._sem.release()
+            return
         try:
             from dynamo_tpu import telemetry
 
@@ -141,17 +215,15 @@ class PrefillWorker:
             logger.exception("remote prefill %s failed", req.request_id)
             # Bounded retry: requeue a fresh copy with attempts+1 and ack the
             # original, so a permanently-failing item (dead decode worker,
-            # config skew) can't cycle through the fleet forever.
+            # config skew) can't cycle through the fleet forever — at the
+            # cap it dead-letters WITH an error finish to the decode side.
             try:
-                if req.attempts + 1 < self.MAX_ATTEMPTS:
-                    req.attempts += 1
+                req.attempts += 1
+                if req.attempts < self.MAX_ATTEMPTS:
                     await self.queue.push(req)
+                    await self.queue.ack(item_id)
                 else:
-                    logger.error(
-                        "dropping prefill %s after %d attempts",
-                        req.request_id, req.attempts + 1,
-                    )
-                await self.queue.ack(item_id)
+                    await self._dead_letter(item_id, req)
             except Exception:
                 logger.exception("requeue of %s failed", req.request_id)
         finally:

@@ -30,6 +30,10 @@ class RemotePrefillRequest:
     #: spans stitch under the decode worker's disagg span; empty when
     #: tracing is off (telemetry/trace.py)
     trace: dict[str, Any] = field(default_factory=dict)
+    #: end-to-end deadline (epoch seconds; None = none): a prefill
+    #: worker drops expired items instead of spending flops on a client
+    #: that already gave up (docs/operations.md)
+    deadline: Any = None
 
     def pack(self) -> bytes:
         return msgpack.packb(dict(self.__dict__), use_bin_type=True)

@@ -44,6 +44,7 @@ import numpy as np
 import xxhash
 
 from dynamo_tpu.disagg.device_transfer import DevicePlane
+from dynamo_tpu.testing import faults
 from dynamo_tpu.runtime.codec import (
     MAX_FRAME,
     CodecError,
@@ -526,6 +527,13 @@ class TransferResult:
     num_pages: int
 
 
+class RemotePrefillError(RuntimeError):
+    """The prefill side declared this transfer PERMANENTLY failed (e.g.
+    the request was dead-lettered after exhausting its redelivery cap):
+    the decode side must error-finish the stream, not fall back to local
+    prefill — a poison request would just poison again."""
+
+
 class KvTransferServer:
     """Decode-side receiver: accepts page writes, lands them via write_fn,
     resolves per-request waiters."""
@@ -607,6 +615,8 @@ class KvTransferServer:
                         await self._on_offer(header, writer)
                     elif op == "fetch":
                         await self._on_fetch(header, writer)
+                    elif op == "error":
+                        await self._on_error(header, writer)
                     elif op == "close":
                         return
                     else:
@@ -628,6 +638,25 @@ class KvTransferServer:
                 except BufferError:  # a view outlived its handler
                     pass
 
+    async def _on_error(self, header, writer) -> None:
+        """A peer declaring this request's remote prefill permanently
+        failed (dead-lettered): resolve the waiter with
+        RemotePrefillError so the decode side error-finishes immediately
+        instead of burning out its transfer timeout."""
+        rid = header.get("request_id")
+        fut = self._waiters.pop(rid, None)
+        if fut is None:
+            await self._nack(writer, rid, "no_waiter")
+            return
+        if not fut.done():
+            fut.set_exception(
+                RemotePrefillError(
+                    header.get("message") or "remote prefill failed"
+                )
+            )
+        writer.write(encode_frame({"op": "ack", "request_id": rid}))
+        await writer.drain()
+
     async def _nack(self, writer, rid, reason: str) -> None:
         """Refusal with a machine-readable reason so the sender can decide
         whether a fallback strategy could still succeed ("no_plane",
@@ -642,6 +671,9 @@ class KvTransferServer:
         """Run the strategy-specific landing coroutine, then resolve the
         waiter and ack — shared tail of both transfer paths."""
         try:
+            # fault-injection hook: an injected failure here nacks the
+            # sender exactly like a real landing failure
+            await faults.fire("transfer.land", request_id=rid)
             await land()
         except Exception as e:
             logger.exception("KV page %s-path landing failed for %s", path, rid)
@@ -1004,6 +1036,9 @@ class KvTransferClient:
         [L, Hkv, n, ps, D], ideally still DEVICE arrays — the device path
         stages them without a host copy; only a host-path fallback
         materializes numpy. True on decode-side ack."""
+        # fault-injection hook (dynamo_tpu/testing/faults.py): a drop/
+        # error here is a transfer that never left the prefill side
+        await faults.fire("transfer.send", request_id=request_id)
         plane = DevicePlane.get()
         if plane is not None:
             try:
@@ -1272,6 +1307,18 @@ class KvTransferClient:
         ).reshape(v_shape)
         metas = [(h, p, tuple(t)) for h, p, t in resp["metas"]]
         return metas, k, v
+
+    async def send_error(
+        self, host: str, port: int, request_id: str, message: str
+    ) -> bool:
+        """Declare a request's remote prefill permanently failed: the
+        decode side resolves its waiter with RemotePrefillError and
+        error-finishes the stream (dead-letter path). True on ack."""
+        resp, _ = await self._roundtrip(
+            (host, port),
+            {"op": "error", "request_id": request_id, "message": message},
+        )
+        return resp.get("op") == "ack"
 
     async def _roundtrip(
         self,

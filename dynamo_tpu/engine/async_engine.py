@@ -13,13 +13,24 @@ from __future__ import annotations
 import asyncio
 import logging
 import threading
+import time
 from typing import Any, AsyncIterator, Optional, Protocol
 
 from dynamo_tpu import telemetry
 from dynamo_tpu.engine.engine import JaxEngine
 from dynamo_tpu.engine.request import SamplingParams, StepOutput
+from dynamo_tpu.engine.scheduler import QueueFullError
 from dynamo_tpu.preprocessor.preprocessor import PreprocessedRequest
-from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.runtime.context import (
+    CANCELLED,
+    Context,
+    queue_get_or_cancelled,
+)
+from dynamo_tpu.runtime.overload import (
+    OverloadedError,
+    estimate_retry_after_s,
+)
+from dynamo_tpu.testing import faults
 
 logger = logging.getLogger(__name__)
 
@@ -88,6 +99,10 @@ class AsyncEngineRunner:
         self._pending: list[tuple[PreprocessedRequest, SamplingParams]] = []
         self._aborts: list[str] = []
         self._ops: list[tuple] = []
+        #: request_id -> absolute epoch deadline; the engine thread
+        #: error-finishes expired streams mid-decode (the scheduler
+        #: already drops expired WAITING requests pre-admission)
+        self._deadlines: dict[str, float] = {}
         self._lock = threading.Lock()
         self._wake = threading.Event()
         self._stop = False
@@ -198,23 +213,80 @@ class AsyncEngineRunner:
                     wd.done(out.request_id)
                 self._post(out.request_id, None)
 
+    def _add_pending(self, req, sampling) -> None:
+        """Admit one queued request on the engine thread; a full waiting
+        queue answers 'overloaded' with a Retry-After hint priced from
+        the live SLO sketches (docs/operations.md)."""
+        eng = self.engine
+        kwargs = {}
+        deadline = getattr(req, "deadline", None)
+        if deadline:
+            # only deadline-carrying requests pass the kwarg — engines
+            # without deadline support (test doubles, older externals)
+            # keep their add_request signature working
+            kwargs["deadline"] = deadline
+        try:
+            eng.add_request(
+                req.request_id, req.token_ids, sampling,
+                mm_embeds=req.mm_embeds,
+                mm_positions=req.mm_positions,
+                **kwargs,
+            )
+        except QueueFullError as e:
+            eng.metrics.overload_rejects += 1
+            sched = getattr(eng, "scheduler", None)
+            self._post(
+                req.request_id,
+                {
+                    "error": str(e),
+                    "overloaded": True,
+                    "retry_after_s": estimate_retry_after_s(
+                        getattr(eng, "slo", None),
+                        queue_depth=(
+                            sched.num_waiting() if sched is not None else 0
+                        ),
+                    ),
+                },
+            )
+            self._post(req.request_id, None)
+        except Exception as e:
+            self._post(req.request_id, {"error": str(e)})
+            self._post(req.request_id, None)
+
+    def _expire_deadlines(self) -> None:
+        """Mid-decode deadline enforcement (engine thread): abort expired
+        streams and error-finish them — pages free via the abort path,
+        and the cost already sunk is the only cost paid."""
+        if not self._deadlines:
+            return
+        now = time.time()
+        with self._lock:
+            expired = [r for r, d in self._deadlines.items() if now > d]
+            for rid in expired:
+                del self._deadlines[rid]
+        eng = self.engine
+        for rid in expired:
+            if eng.abort_request(rid):
+                try:
+                    eng._runner_deadline_expired += 1
+                except AttributeError:
+                    pass  # non-JaxEngine test doubles
+            wd = self.watchdog
+            if wd is not None:
+                wd.done(rid)
+            self._post(rid, {"token_ids": [], "finish_reason": "error"})
+            self._post(rid, None)
+
     def _run(self) -> None:
         eng = self.engine
         while not self._stop:
             pending, aborts, ops = self._drain_inbox()
             self._run_ops(ops)
             for req, sampling in pending:
-                try:
-                    eng.add_request(
-                        req.request_id, req.token_ids, sampling,
-                        mm_embeds=req.mm_embeds,
-                        mm_positions=req.mm_positions,
-                    )
-                except Exception as e:
-                    self._post(req.request_id, {"error": str(e)})
-                    self._post(req.request_id, None)
+                self._add_pending(req, sampling)
             for rid in aborts:
                 eng.abort_request(rid)
+            self._expire_deadlines()
             if not eng.has_work:
                 drain = getattr(eng, "drain_overlap", None)
                 if drain is not None:
@@ -227,6 +299,10 @@ class AsyncEngineRunner:
                 wd.step_begin()  # a dispatch that never returns is the
                 # cause="engine_stuck" signal
             try:
+                # fault-injection hook (dynamo_tpu/testing/faults.py): an
+                # injected delay stalls the loop (watchdog fodder); an
+                # injected error is swallowed like a real step failure
+                faults.fire_sync("engine.step")
                 outputs = eng.step()
             except Exception:
                 logger.exception("engine step failed")
@@ -263,6 +339,16 @@ class AsyncEngineRunner:
 
     def unwatch_request(self, request_id: str) -> None:
         self._queues.pop(request_id, None)
+        with self._lock:
+            self._deadlines.pop(request_id, None)
+
+    def track_deadline(self, request_id: str, deadline) -> None:
+        """Deadline enforcement for requests admitted out of band (the
+        disaggregated decode path): drain() untracks on stream end."""
+        if deadline:
+            with self._lock:
+                self._deadlines[request_id] = deadline
+            self._wake.set()
 
     async def generate(
         self, context: Context, request: PreprocessedRequest
@@ -280,8 +366,11 @@ class AsyncEngineRunner:
             },
         ) as sp:
             q = self.watch_request(request.request_id)
+            deadline = getattr(request, "deadline", None)
             with self._lock:
                 self._pending.append((request, _sampling_from(request)))
+                if deadline:
+                    self._deadlines[request.request_id] = deadline
             self._wake.set()
             generated = 0
             mixed_seen = False
@@ -320,15 +409,27 @@ class AsyncEngineRunner:
                         self._aborts.append(request_id)
                     self._wake.set()
                     return
-                item = await q.get()
+                # race the queue against cancellation: a client that
+                # disconnects while its request still sits in the
+                # WAITING queue (no items ever arrive) must abort it —
+                # a bare q.get() would hold the slot forever
+                item = await queue_get_or_cancelled(context, q)
+                if item is CANCELLED:
+                    continue  # loop re-checks context.cancelled -> abort
                 if item is None:
                     return
                 if "error" in item:
+                    if item.get("overloaded"):
+                        raise OverloadedError(
+                            item["error"], item.get("retry_after_s")
+                        )
                     raise RuntimeError(item["error"])
                 yield item
         finally:
             if wd is not None:
                 wd.done(request_id)
+            with self._lock:
+                self._deadlines.pop(request_id, None)
             self._queues.pop(request_id, None)
 
     async def embed(self, prompts, normalize: bool = True):

@@ -94,6 +94,14 @@ class EngineConfig:
     spec_cooldown_steps: int = 16
     #: admission watermark: keep this fraction of pages free when admitting
     admission_watermark: float = 0.02
+    #: bounded admission (docs/operations.md "Overload & draining"):
+    #: cap on the scheduler's WAITING queue. None (default) keeps the
+    #: historical unbounded queue; with a cap, add_request raises
+    #: QueueFullError once `max_waiting` requests are already queued —
+    #: the worker answers "overloaded" (HTTP 429 + Retry-After at the
+    #: frontend) instead of queueing a request it cannot serve within
+    #: any reasonable deadline. `--max-waiting` on the CLI.
+    max_waiting: Optional[int] = None
     #: eos token ids (from the model card/tokenizer)
     eos_token_ids: tuple[int, ...] = ()
     #: dtype name for params/KV ("bfloat16" | "float32")

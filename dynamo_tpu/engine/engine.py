@@ -181,6 +181,15 @@ class EngineMetrics:
     #: to the measured decode roofline ceiling of ~0.43)
     tokens_per_s: float = 0.0
     mfu: float = 0.0
+    #: overload-protection plane (docs/operations.md "Overload &
+    #: draining"): requests refused at admission because the bounded
+    #: waiting queue (EngineConfig.max_waiting) was full — climbing
+    #: means this worker is shedding (raise capacity), while a deep
+    #: num_waiting with ZERO rejects means the queue is unbounded
+    overload_rejects: int = 0
+    #: requests error-finished because their end-to-end deadline passed
+    #: (pre-admission drops + mid-decode expiries)
+    deadline_expired: int = 0
 
     #: the timing plane's field names — the one list consumers (perf
     #: harness, dashboards) should iterate instead of restating
@@ -323,6 +332,10 @@ class JaxEngine:
             )
         self.scheduler = Scheduler(config, self.allocator)
         self.metrics = EngineMetrics(kv_total_pages=config.num_pages - 1)
+        #: mid-decode deadline expiries, bumped by the runner (its abort
+        #: path) — folded with the scheduler's pre-admission drops into
+        #: metrics.deadline_expired
+        self._runner_deadline_expired = 0
         self._jit_cache: dict[tuple, Callable] = {}
         #: compile counter by program kind (prefill/decode/mixed/...) —
         #: published in the worker's fleet frame as per-kind labels
@@ -577,6 +590,7 @@ class JaxEngine:
         sampling: Optional[SamplingParams] = None,
         mm_embeds: Optional[np.ndarray] = None,
         mm_positions: Sequence[int] = (),
+        deadline: Optional[float] = None,
     ) -> Request:
         self._validate_bias(sampling)
         if mm_embeds is not None:
@@ -606,6 +620,7 @@ class JaxEngine:
             prompt_tokens=list(prompt_tokens),
             sampling=sampling or SamplingParams(),
             arrival_time=time.time(),
+            deadline=deadline,
             mm_embeds=mm_embeds,
             mm_positions=tuple(mm_positions),
         )
@@ -713,19 +728,20 @@ class JaxEngine:
         return outputs
 
     def _drain_doomed(self) -> list[StepOutput]:
-        """Finish requests the scheduler proved can never progress."""
+        """Finish requests the scheduler proved can never progress (or
+        whose deadline expired pre-admission — those finish as ERROR)."""
         outputs = []
-        for req, why in self.scheduler.doomed:
+        for req, why, reason in self.scheduler.doomed:
             logger.error("request %s cannot progress: %s", req.request_id, why)
             self._last_emit.pop(req.request_id, None)
             self._slo_marks.pop(req.request_id, None)
             req.state = RequestState.FINISHED
-            req.finish_reason = FinishReason.LENGTH
+            req.finish_reason = reason
             outputs.append(
                 StepOutput(
                     request_id=req.request_id,
                     new_token_ids=(),
-                    finish_reason=FinishReason.LENGTH,
+                    finish_reason=reason,
                 )
             )
         self.scheduler.doomed.clear()
@@ -2799,6 +2815,11 @@ class JaxEngine:
             m.kv_pages_watermark,
         )
         m.preemptions = self.scheduler.preemptions
+        # pre-admission deadline drops land here; the runner adds its own
+        # mid-decode expiries on top (they never reach the scheduler)
+        m.deadline_expired = (
+            self.scheduler.deadline_drops + self._runner_deadline_expired
+        )
         if self._fleet_telemetry:
             # windowed throughput -> live MFU against the roofline peak
             now = time.perf_counter()

@@ -66,6 +66,10 @@ class Request:
     prompt_tokens: list[int]
     sampling: SamplingParams = field(default_factory=SamplingParams)
     arrival_time: float = 0.0
+    #: absolute end-to-end deadline (epoch seconds; None = none). The
+    #: scheduler drops expired requests BEFORE admission; the runner
+    #: error-finishes expired streams mid-decode (docs/operations.md)
+    deadline: Optional[float] = None
     #: multimodal (llava-style): projected image embeddings [n, H] replacing
     #: the placeholder prompt tokens at mm_positions (absolute indices)
     mm_embeds: Optional["object"] = None  # np.ndarray

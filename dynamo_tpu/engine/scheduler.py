@@ -33,10 +33,16 @@ from typing import Literal, Optional
 
 from dynamo_tpu.engine.config import EngineConfig
 from dynamo_tpu.engine.page_table import PageAllocator
-from dynamo_tpu.engine.request import Request, RequestState
+from dynamo_tpu.engine.request import FinishReason, Request, RequestState
 from dynamo_tpu.tokens import TokenBlockSequence
 
 logger = logging.getLogger(__name__)
+
+
+class QueueFullError(RuntimeError):
+    """Bounded admission (EngineConfig.max_waiting): the waiting queue is
+    at capacity. The runner answers 'overloaded' with a Retry-After hint
+    instead of queueing forever (docs/operations.md)."""
 
 
 @dataclass(frozen=True)
@@ -79,9 +85,12 @@ class Scheduler:
         self.running: list[Request] = []
         #: content chains per live request (prefix registration + routing)
         self.chains: dict[str, TokenBlockSequence] = {}
-        #: requests that can never make progress (engine finishes them) —
-        #: guarantees step() liveness instead of a silent busy-spin
-        self.doomed: list[tuple[Request, str]] = []
+        #: requests that can never make progress (engine finishes them
+        #: with the given reason) — guarantees step() liveness instead
+        #: of a silent busy-spin
+        self.doomed: list[tuple[Request, str, FinishReason]] = []
+        #: deadline-expired requests dropped pre-admission (observability)
+        self.deadline_drops = 0
         #: pages of finished hold_pages requests, awaiting extraction
         self.held: dict[str, list[int]] = {}
         #: preemption-by-recompute count (page pressure) — exported as
@@ -98,6 +107,12 @@ class Scheduler:
                 f"prompt of {len(request.prompt_tokens)} tokens exceeds max "
                 f"context {self.config.max_context} (one slot is reserved for "
                 "generation)"
+            )
+        cap = self.config.max_waiting
+        if cap is not None and len(self.waiting) >= cap:
+            raise QueueFullError(
+                f"waiting queue full ({len(self.waiting)}/{cap} requests); "
+                "retry later or on another instance"
             )
         request.state = RequestState.WAITING
         self.waiting.append(request)
@@ -186,8 +201,27 @@ class Scheduler:
     def _watermark_pages(self) -> int:
         return int(self.allocator.num_pages * self.config.admission_watermark)
 
+    def _drop_expired_waiting(self) -> None:
+        """Deadline-expired requests leave the waiting queue BEFORE
+        admission: prefill flops are never spent on a client whose
+        deadline already passed (docs/operations.md). Error finishes
+        ride the doomed drain."""
+        if not any(r.deadline for r in self.waiting):
+            return
+        now = time.time()
+        for req in [r for r in self.waiting if r.deadline and now > r.deadline]:
+            self.waiting.remove(req)
+            self._release(req)  # waiting requests hold no pages; defensive
+            self.chains.pop(req.request_id, None)
+            self.deadline_drops += 1
+            self.doomed.append(
+                (req, "deadline expired before admission",
+                 FinishReason.ERROR)
+            )
+
     def _admit(self) -> None:
         ps = self.config.page_size
+        self._drop_expired_waiting()
         while self.waiting and len(self.running) < self.config.max_seqs:
             req = self.waiting[0]
             # A prompt that can never fit the pool (even with everything else
@@ -197,7 +231,8 @@ class Scheduler:
                 self.waiting.pop(0)
                 self.doomed.append(
                     (req, f"prompt needs {min_need} pages; pool has "
-                          f"{self.allocator.num_pages - 1}")
+                          f"{self.allocator.num_pages - 1}",
+                     FinishReason.LENGTH)
                 )
                 continue
             chain = self.chains.get(req.request_id)
@@ -367,7 +402,8 @@ class Scheduler:
                             self.chains.pop(req.request_id, None)
                             self.doomed.append(
                                 (req, "kv pool exhausted with no preemption "
-                                      "victim")
+                                      "victim",
+                                 FinishReason.LENGTH)
                             )
                         continue  # stalled this step; others may progress
                 req.pages.extend(got)

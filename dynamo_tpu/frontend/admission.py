@@ -1,0 +1,112 @@
+"""Frontend admission control: inflight cap + SLO-burn load shedding.
+
+The goodput-preserving half of the overload plane (docs/operations.md
+"Overload & draining"): when demand exceeds capacity, answering a
+bounded subset of requests fast beats answering all of them late.
+
+Two gates, checked before a request touches the pipeline:
+
+1. **Inflight cap** (`--max-inflight`): a hard ceiling on concurrently
+   served requests across all models. Everything past it is shed.
+
+2. **Burn-rate shedder** (`--shed-burn-threshold`): watches the
+   endpoint's short-window SLO burn rate (telemetry/slo.py — 1.0 means
+   spending the error budget exactly). Past the threshold, shedding
+   ramps LINEARLY with the overshoot (threshold → 0%, 2x threshold →
+   100%) and only ever hits work below the priority floor — requests
+   carrying `x-priority: 1` (or higher) ride through, so paying/critical
+   traffic keeps its SLA while best-effort load absorbs the degradation.
+
+Both answer HTTP 429 with a `Retry-After` computed from the endpoint's
+live latency sketches. Default-off: no cap + no threshold = the gate is
+never consulted (bit-identical serving).
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass
+from typing import Optional
+
+from dynamo_tpu.frontend.metrics import FrontendMetrics
+
+#: requests at or above this x-priority are never burn-shed
+PRIORITY_FLOOR = 1
+
+
+@dataclass(frozen=True)
+class ShedDecision:
+    reason: str  # frontend_inflight | burn
+    retry_after_s: float
+    message: str
+
+
+class AdmissionController:
+    def __init__(
+        self,
+        metrics: FrontendMetrics,
+        max_inflight: Optional[int] = None,
+        burn_threshold: Optional[float] = None,
+        rng=None,
+    ):
+        self.metrics = metrics
+        self.max_inflight = max_inflight
+        self.burn_threshold = burn_threshold
+        self._rng = rng or random.random
+
+    @property
+    def enabled(self) -> bool:
+        return self.max_inflight is not None or self.burn_threshold is not None
+
+    @staticmethod
+    def priority_from(headers) -> int:
+        """`x-priority` header (int; default 0 = best-effort; >=1 is
+        never burn-shed). Malformed values read as 0, never an error."""
+        try:
+            return int(headers.get("x-priority", 0))
+        except (TypeError, ValueError):
+            return 0
+
+    def _burn_rate(self, endpoint: str) -> float:
+        tracker = self.metrics.slo.get(endpoint)
+        if tracker is None or not tracker.windows:
+            return 0.0
+        # the SHORT window pages first — that's the one shedding acts on
+        return tracker.burn_rate(min(tracker.windows))
+
+    def check(self, endpoint: str, priority: int = 0) -> Optional[ShedDecision]:
+        """None = admit; a ShedDecision = reject with 429."""
+        if self.max_inflight is not None:
+            inflight = self.metrics.total_inflight()
+            if inflight >= self.max_inflight:
+                self.metrics.shed("frontend_inflight")
+                return ShedDecision(
+                    reason="frontend_inflight",
+                    retry_after_s=self.metrics.retry_after_s(endpoint),
+                    message=(
+                        f"{inflight} requests already in flight "
+                        f"(--max-inflight {self.max_inflight})"
+                    ),
+                )
+        thr = self.burn_threshold
+        if thr is not None and priority < PRIORITY_FLOOR:
+            burn = self._burn_rate(endpoint)
+            if burn > thr:
+                # linear ramp: thr -> 0% shed, 2*thr -> 100% shed.
+                # thr == 0 reads as "shed best-effort whenever burning
+                # at all" — full shed, never a division by zero.
+                frac = (
+                    min(1.0, (burn - thr) / thr) if thr > 0 else 1.0
+                )
+                if self._rng() < frac:
+                    self.metrics.shed("burn")
+                    return ShedDecision(
+                        reason="burn",
+                        retry_after_s=self.metrics.retry_after_s(endpoint),
+                        message=(
+                            f"SLO burn rate {burn:.2f} over threshold "
+                            f"{thr:.2f}; shedding best-effort work "
+                            "(send x-priority >= 1 to bypass)"
+                        ),
+                    )
+        return None

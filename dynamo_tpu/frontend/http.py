@@ -45,6 +45,7 @@ from dynamo_tpu.protocols.openai import (
     sse_event,
 )
 from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.runtime.overload import OverloadedError
 from dynamo_tpu import telemetry
 
 logger = logging.getLogger(__name__)
@@ -100,11 +101,26 @@ class HttpService:
         host: str = "0.0.0.0",
         port: int = 8080,
         metrics: Optional[FrontendMetrics] = None,
+        max_inflight: Optional[int] = None,
+        shed_burn_threshold: Optional[float] = None,
+        request_timeout_s: Optional[float] = None,
     ):
+        from dynamo_tpu.frontend.admission import AdmissionController
+
         self.manager = manager
         self.host = host
         self.port = port
         self.metrics = metrics or FrontendMetrics()
+        #: overload plane (docs/operations.md "Overload & draining"):
+        #: inflight cap + SLO-burn shedder, both default-off
+        self.admission = AdmissionController(
+            self.metrics,
+            max_inflight=max_inflight,
+            burn_threshold=shed_burn_threshold,
+        )
+        #: server-default end-to-end deadline (seconds; None = none) —
+        #: per-request `x-request-timeout` overrides it
+        self.request_timeout_s = request_timeout_s
         self.app = web.Application()
         self.app.add_routes(
             [
@@ -123,6 +139,7 @@ class HttpService:
                 web.get("/v1/debug/programs", self.debug_programs),
                 web.get("/v1/debug/stalls", self.debug_stalls),
                 web.post("/v1/debug/profile", self.debug_profile),
+                web.post("/v1/admin/drain", self.admin_drain),
                 web.post("/clear_kv_blocks", self.clear_kv_blocks),
             ]
         )
@@ -212,6 +229,96 @@ class HttpService:
         payload, status = profile_payload(body)
         return web.json_response(payload, status=status)
 
+    # -- overload & draining (docs/operations.md) --------------------------
+
+    def _deadline_from(self, request: web.Request) -> Optional[float]:
+        """Absolute epoch deadline from `x-request-timeout` (seconds) or
+        the server default; None when neither is set. A malformed header
+        is ignored (logged), never a 400 — degrading to 'no deadline' is
+        safer than rejecting live traffic on a client typo."""
+        timeout = self.request_timeout_s
+        raw = request.headers.get("x-request-timeout")
+        if raw is not None:
+            try:
+                parsed = float(raw)
+            except (TypeError, ValueError):
+                logger.warning("ignoring malformed x-request-timeout %r", raw)
+            else:
+                if parsed > 0:
+                    timeout = parsed
+                else:
+                    # 0/negative reads as "no timeout", not "1ms" — a
+                    # guaranteed 504 would reject live traffic silently
+                    logger.warning(
+                        "ignoring non-positive x-request-timeout %r", raw
+                    )
+                    timeout = None
+        return time.time() + timeout if timeout else None
+
+    @staticmethod
+    def _reject_429(message: str, retry_after_s: Optional[float]) -> web.Response:
+        headers = {}
+        if retry_after_s is not None:
+            import math
+
+            headers["Retry-After"] = str(max(1, math.ceil(retry_after_s)))
+        return web.json_response(
+            {"error": message}, status=429, headers=headers
+        )
+
+    def _check_admission(
+        self, request: web.Request, model: str, kind: str, t0: float
+    ) -> Optional[web.Response]:
+        """Frontend admission gates; a Response = reject with 429."""
+        if not self.admission.enabled:
+            return None
+        decision = self.admission.check(
+            kind, self.admission.priority_from(request.headers)
+        )
+        if decision is None:
+            return None
+        self.metrics.request_done(model, kind, "429", time.time() - t0)
+        return self._reject_429(decision.message, decision.retry_after_s)
+
+    async def admin_drain(self, request: web.Request) -> web.Response:
+        """POST /v1/admin/drain {"instance_id": ..., "model": ...}:
+        flip one worker into graceful drain — it deregisters, finishes
+        in-flight requests within its drain budget, then exits 0
+        (equivalently: SIGTERM the worker process). `/v1/fleet` shows
+        state=draining while it winds down."""
+        try:
+            body = await request.json()
+        except Exception:
+            body = {}
+        instance_id = body.get("instance_id")
+        if not instance_id:
+            return web.json_response(
+                {"error": "instance_id is required"}, status=400
+            )
+        models = self.manager.list_models()
+        name = body.get("model") or (models[0] if len(models) == 1 else None)
+        pipeline = self.manager.get(name) if name else None
+        if pipeline is None:
+            return web.json_response(
+                {"error": f"model {name!r} not found (pass \"model\")"},
+                status=404,
+            )
+        if pipeline.drain_fn is None:
+            return web.json_response(
+                {"error": "drain requires a distributed pipeline "
+                          "(in=http out=dyn); in-process engines stop "
+                          "with the server"},
+                status=501,
+            )
+        try:
+            reply = await pipeline.drain_fn(instance_id)
+        except Exception as e:
+            logger.exception("drain of %s failed", instance_id)
+            return web.json_response({"error": str(e)}, status=502)
+        return web.json_response(
+            {"status": "ok", "instance_id": instance_id, **(reply or {})}
+        )
+
     async def clear_kv_blocks(self, request: web.Request) -> web.Response:
         """Flush reusable (cached, unreferenced) KV pages on every worker
         of every attached model (reference: /clear_kv_blocks fan-out)."""
@@ -285,7 +392,10 @@ class HttpService:
             return web.json_response(
                 {"error": f"model {req.model!r} not found"}, status=404
             )
-        ctx = Context()
+        rejected = self._check_admission(request, req.model, "responses", t0)
+        if rejected is not None:
+            return rejected
+        ctx = Context(deadline=self._deadline_from(request))
         rid = new_request_id("resp")
         with self.metrics.inflight_guard(req.model):
             try:
@@ -295,14 +405,29 @@ class HttpService:
                         request, req, rid, chunk_stream, ctx, t0
                     )
                 chunks = [c async for c in chunk_stream]
+                if self._deadline_error_finish(ctx, chunks):
+                    raise RuntimeError("request deadline exceeded")
             except ValueError as e:
                 self.metrics.request_done(
                     req.model, "responses", "400", time.time() - t0
                 )
                 return web.json_response({"error": str(e)}, status=400)
+            except OverloadedError as e:
+                self.metrics.shed("worker_queue_full")
+                self.metrics.request_done(
+                    req.model, "responses", "429", time.time() - t0
+                )
+                return self._reject_429(str(e), e.retry_after_s)
             except Exception as e:
                 logger.exception("responses request failed")
                 ctx.cancel()
+                if ctx.deadline and time.time() >= ctx.deadline:
+                    self.metrics.request_done(
+                        req.model, "responses", "504", time.time() - t0
+                    )
+                    return web.json_response(
+                        {"error": "request deadline exceeded"}, status=504
+                    )
                 self.metrics.request_done(
                     req.model, "responses", "500", time.time() - t0
                 )
@@ -344,7 +469,11 @@ class HttpService:
         t0: float,
     ) -> web.StreamResponse:
         """Responses streaming: typed SSE events (response.created,
-        response.output_text.delta, response.completed)."""
+        response.output_text.delta, response.completed). The first
+        chunk is pulled before the SSE prepares, so a pre-output
+        failure (overloaded, deadline burned) propagates to the JSON
+        handler's real HTTP status instead of a 200 event stream."""
+        chunk_stream = await self._pull_first(chunk_stream)
         resp = web.StreamResponse(
             status=200,
             headers={
@@ -436,8 +565,11 @@ class HttpService:
             return web.json_response(
                 {"error": f"model {req.model!r} not found"}, status=404
             )
+        rejected = self._check_admission(request, req.model, kind, t0)
+        if rejected is not None:
+            return rejected
 
-        ctx = Context()
+        ctx = Context(deadline=self._deadline_from(request))
         stream_fn = (
             pipeline.chat_stream if kind == "chat" else pipeline.completion_stream
         )
@@ -456,21 +588,94 @@ class HttpService:
                     return await self._stream(
                         request, req, stream_fn(req, ctx), ctx, kind, t0
                     )
-                return await self._unary(req, stream_fn(req, ctx), kind, t0)
+                return await self._unary(
+                    req, stream_fn(req, ctx), ctx, kind, t0
+                )
             except ValueError as e:
                 root.set_attr("http_status", 400)
                 self.metrics.request_done(req.model, kind, "400", time.time() - t0)
                 return web.json_response({"error": str(e)}, status=400)
+            except OverloadedError as e:
+                # every reachable worker's bounded admission refused
+                # (or the local engine's queue is full): 429 with the
+                # worker-supplied Retry-After hint
+                self.metrics.shed("worker_queue_full")
+                root.set_attr("http_status", 429)
+                self.metrics.request_done(req.model, kind, "429", time.time() - t0)
+                return self._reject_429(str(e), e.retry_after_s)
             except Exception as e:
                 logger.exception("request failed")
                 ctx.cancel()
+                if ctx.deadline and time.time() >= ctx.deadline:
+                    # the end-to-end deadline expired somewhere in the
+                    # stack — the honest status is 504, not 500
+                    root.set_attr("http_status", 504)
+                    root.end(status="error")
+                    self.metrics.request_done(
+                        req.model, kind, "504", time.time() - t0
+                    )
+                    return web.json_response(
+                        {"error": "request deadline exceeded"}, status=504
+                    )
                 root.set_attr("http_status", 500)
                 root.end(status="error")
                 self.metrics.request_done(req.model, kind, "500", time.time() - t0)
                 return web.json_response({"error": str(e)}, status=500)
 
-    async def _unary(self, req, chunk_stream, kind: str, t0: float) -> web.Response:
+    @staticmethod
+    async def _pull_first(chunk_stream):
+        """Pull the FIRST chunk before preparing an SSE response: a
+        failure that happens before any output (all workers overloaded
+        -> 429, no instances -> 5xx, deadline already burned -> 504)
+        surfaces as a real HTTP status the client's retry logic
+        understands, instead of a 200 SSE stream carrying an error
+        event. Errors after output still ride the SSE."""
+        it = chunk_stream.__aiter__()
+        try:
+            first_chunk = await it.__anext__()
+        except StopAsyncIteration:
+            first_chunk = None
+
+        async def chained():
+            # close the UNDERLYING stream on any exit — an abandoned
+            # wrapper (client disconnect closes this generator) must
+            # still propagate the close (and its cancel frames) to the
+            # engine stream, exactly as the unwrapped stream did
+            try:
+                if first_chunk is not None:
+                    yield first_chunk
+                async for c in it:
+                    yield c
+            finally:
+                aclose = getattr(it, "aclose", None)
+                if aclose is not None:
+                    await aclose()
+
+        return chained()
+
+    @staticmethod
+    def _deadline_error_finish(ctx: Context, chunks) -> bool:
+        """True when the request's deadline expired and the engine
+        error-finished the stream — the honest unary status is 504, not
+        a 200 body wrapping an empty error finish."""
+        return bool(
+            ctx.deadline
+            and time.time() >= ctx.deadline
+            and any(
+                c.finish_reason == "error"
+                for chunk in chunks
+                for c in chunk.choices
+            )
+        )
+
+    async def _unary(
+        self, req, chunk_stream, ctx: Context, kind: str, t0: float
+    ) -> web.Response:
         chunks = [c async for c in chunk_stream]
+        if self._deadline_error_finish(ctx, chunks):
+            # the engine error-finished this stream because its deadline
+            # expired: surface 504 via the handler above, not a 200 body
+            raise RuntimeError("request deadline exceeded")
         rid = chunks[0].id if chunks else "unknown"
         resp = aggregate_chat_stream(chunks, req.model, rid)
         usage = resp.usage
@@ -518,6 +723,7 @@ class HttpService:
         self, http_request: web.Request, req, chunk_stream, ctx: Context,
         kind: str, t0: float,
     ) -> web.StreamResponse:
+        chunk_stream = await self._pull_first(chunk_stream)
         resp = web.StreamResponse(
             status=200,
             headers={

@@ -83,6 +83,12 @@ class FrontendMetrics:
 
         self.slo: dict[str, SloTracker] = {}
         self._slo_factory = SloTracker
+        #: load shedding (docs/operations.md "Overload & draining"):
+        #: requests rejected with 429, by reason — exposed as
+        #: dynamo_tpu_shed_total{reason}. Reasons: frontend_inflight
+        #: (--max-inflight gate), burn (SLO burn-rate shedder),
+        #: worker_queue_full (every worker's bounded admission refused)
+        self.shed_total: dict[str, int] = defaultdict(int)
 
     def request_done(
         self, model: str, endpoint: str, status: str, duration_s: float,
@@ -122,6 +128,25 @@ class FrontendMetrics:
                     tokens=output_tokens,
                 )
 
+    def shed(self, reason: str) -> None:
+        """Count one load-shed 429 (the request_done 429 row is separate:
+        shed_total answers "why", requests_total answers "how many")."""
+        with self._lock:
+            self.shed_total[reason] += 1
+
+    def total_inflight(self) -> int:
+        with self._lock:
+            return sum(self.inflight.values())
+
+    def retry_after_s(self, endpoint: str) -> float:
+        """Retry-After hint for a frontend-side shed, priced from the
+        endpoint's live SLO sketches (runtime/overload.py)."""
+        from dynamo_tpu.runtime.overload import estimate_retry_after_s
+
+        with self._lock:
+            tracker = self.slo.get(endpoint)
+        return estimate_retry_after_s(tracker)
+
     def inflight_guard(self, model: str) -> "InflightGuard":
         return InflightGuard(self, model)
 
@@ -136,6 +161,12 @@ class FrontendMetrics:
             lines.append(f"# TYPE {PREFIX}_inflight_requests gauge")
             for model, n in sorted(self.inflight.items()):
                 lines.append(f'{PREFIX}_inflight_requests{{model="{model}"}} {n}')
+            if self.shed_total:
+                lines.append("# TYPE dynamo_tpu_shed_total counter")
+                for reason, n in sorted(self.shed_total.items()):
+                    lines.append(
+                        f'dynamo_tpu_shed_total{{reason="{reason}"}} {n}'
+                    )
             for name, table in (
                 ("input_sequence_tokens", self.input_tokens),
                 ("output_sequence_tokens", self.output_tokens),

@@ -61,6 +61,10 @@ class ModelPipeline:
         self.image_encode_fn = None
         #: async () -> cleared page count (the /clear_kv_blocks fan-out)
         self.flush_fn = None
+        #: async (instance_id) -> reply dict: flip one worker into
+        #: graceful drain (POST /v1/admin/drain; distributed pipelines
+        #: only — docs/operations.md "Overload & draining")
+        self.drain_fn = None
 
     async def chat_stream(
         self, request: ChatCompletionRequest, context: Optional[Context] = None
@@ -77,6 +81,7 @@ class ModelPipeline:
                 messages = await self._encode_image_parts(messages)
             pre = self.preprocessor.preprocess_chat_messages(messages, request)
             self._clamp(pre)
+            pre.deadline = ctx.deadline  # rides every wire hop from here
             sp.set_attr("input_tokens", len(pre.token_ids))
         include_usage = bool(
             request.stream_options and request.stream_options.include_usage
@@ -96,6 +101,7 @@ class ModelPipeline:
         ) as sp:
             pre = self.preprocessor.preprocess_completion(request)
             self._clamp(pre)
+            pre.deadline = ctx.deadline  # rides every wire hop from here
             sp.set_attr("input_tokens", len(pre.token_ids))
         include_usage = bool(
             request.stream_options and request.stream_options.include_usage
@@ -363,6 +369,7 @@ def router_pipeline(
         router.close()
         embed_router.close()
         flush_router.close()
+        drain_router.close()
         if kv_router is not None:
             await kv_router.stop()
 
@@ -381,6 +388,18 @@ def router_pipeline(
     flush_router = PushRouter(
         router.source, "flush", mode=RouterMode.DIRECT
     )
+    drain_router = PushRouter(
+        router.source, "drain", mode=RouterMode.DIRECT
+    )
+
+    async def drain_fn(instance_id: str) -> dict:
+        """Flip ONE worker into graceful drain (its `drain` ingress
+        handler answers immediately; the wind-down runs worker-side)."""
+        async for reply in drain_router.generate(
+            {}, instance_id=instance_id, max_attempts=1
+        ):
+            return reply if isinstance(reply, dict) else {}
+        return {}
 
     async def flush_fn() -> int:
         """Fan /clear_kv_blocks out to EVERY live worker instance. A dead
@@ -409,6 +428,7 @@ def router_pipeline(
         card, engine_fn=engine_fn, close_fn=close_fn, embed_fn=embed_fn
     )
     pipeline.flush_fn = flush_fn
+    pipeline.drain_fn = drain_fn
     return pipeline
 
 

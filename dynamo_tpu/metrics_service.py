@@ -96,6 +96,12 @@ _WORKER_FIELDS = (
     # is in the worker's own dynamo_tpu_stalls_total{cause} and in the
     # /v1/fleet snapshot's stalls_by_cause)
     ("stalls_total", "counter"),
+    # overload plane (docs/operations.md "Overload & draining"): bounded-
+    # admission rejects (EngineConfig.max_waiting) and deadline-expired
+    # error finishes — climbing rejects = shedding (raise capacity);
+    # deep num_waiting with zero rejects = queue unbounded (enable caps)
+    ("overload_rejects", "counter"),
+    ("deadline_expired", "counter"),
 )
 
 #: numeric per-worker fields copied verbatim into the /v1/fleet snapshot
@@ -104,7 +110,7 @@ _FLEET_WORKER_FIELDS = (
     "kv_pages_watermark", "preemptions", "num_running", "num_waiting",
     "steps", "generated_tokens", "requests_received", "compiles",
     "compile_ms", "tokens_per_s", "mfu", "prefix_hit_rate",
-    "stalls_total",
+    "stalls_total", "overload_rejects", "deadline_expired",
 )
 
 
@@ -286,6 +292,11 @@ class MetricsService:
                     "model": m.get("model"),
                     "last_seen_s": round(age, 3),
                 }
+                state = m.get("state")
+                if isinstance(state, str):
+                    # serving | draining — doctor's draining-worker rule
+                    # and fleet_top key off this
+                    w["state"] = state
                 for f in _FLEET_WORKER_FIELDS:
                     v = m.get(f)
                     if isinstance(v, (int, float)):

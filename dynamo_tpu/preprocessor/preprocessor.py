@@ -58,6 +58,12 @@ class PreprocessedRequest:
     logit_bias: list = field(default_factory=list)
     #: eos/stop suppression floor (ext.min_tokens)
     min_tokens: int = 0
+    #: absolute end-to-end deadline, epoch seconds (None = none). Minted
+    #: at the HTTP frontend from `x-request-timeout` (or the server
+    #: default) and carried through every hop — router wire, disagg
+    #: queue, external-engine frames (docs/operations.md "Overload &
+    #: draining"). Clocks across hosts are assumed loosely NTP-synced.
+    deadline: Optional[float] = None
     annotations: dict[str, Any] = field(default_factory=dict)
     #: multimodal: projected image embeddings [n, H] f32 (numpy) spliced at
     #: mm_positions (absolute prompt indices of the placeholder tokens)
@@ -87,6 +93,10 @@ class PreprocessedRequest:
             # omit the no-op default so older external-engine shims
             # (docs/external_engines.md) keep parsing the dict
             d["repetition_penalty"] = self.repetition_penalty
+        if self.deadline is not None:
+            # same back-compat shape: only deadline-carrying requests
+            # put the key on the wire
+            d["deadline"] = self.deadline
         if self.mm_embeds is not None:
             import numpy as np
 

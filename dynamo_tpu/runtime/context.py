@@ -79,10 +79,15 @@ class Context:
         request_id: Optional[str] = None,
         token: Optional[CancellationToken] = None,
         metadata: Optional[dict[str, Any]] = None,
+        deadline: Optional[float] = None,
     ):
         self.request_id = request_id or uuid.uuid4().hex
         self.token = token or CancellationToken()
         self.metadata = metadata or {}
+        #: absolute end-to-end deadline (epoch seconds; None = none) —
+        #: set by the HTTP frontend, copied onto the PreprocessedRequest
+        #: (which is what actually rides the wire)
+        self.deadline = deadline
 
     @property
     def cancelled(self) -> bool:
@@ -96,4 +101,5 @@ class Context:
             request_id=self.request_id,
             token=self.token.child(),
             metadata=dict(self.metadata),
+            deadline=self.deadline,
         )

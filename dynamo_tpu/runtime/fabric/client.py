@@ -22,6 +22,7 @@ from typing import Any, Optional
 from dynamo_tpu.runtime.codec import encode_frame, read_frame
 from dynamo_tpu.runtime.fabric.base import BusMessage, QueueItem, Subscription
 from dynamo_tpu.runtime.store import Watch, WatchEvent
+from dynamo_tpu.testing import faults
 
 logger = logging.getLogger(__name__)
 
@@ -165,6 +166,12 @@ class RemoteFabric:
                 s._push(BusMessage(h["subject"], h.get("header"), payload))
 
     async def _call(self, header: dict, payload: bytes = b"") -> tuple[Any, bytes]:
+        # fault-injection hook (dynamo_tpu/testing/faults.py): a no-op
+        # global check unless a chaos scenario installed an injector
+        try:
+            await faults.fire("fabric.call", op=header.get("op"))
+        except ConnectionError as e:
+            raise FabricConnectionError(str(e))
         rid = next(self._ids)
         header["id"] = rid
         fut = asyncio.get_running_loop().create_future()

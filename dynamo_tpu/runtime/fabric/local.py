@@ -9,6 +9,7 @@ from __future__ import annotations
 import asyncio
 import uuid
 from collections import deque
+from dataclasses import replace
 from typing import Any, Optional
 
 from dynamo_tpu.runtime.fabric.base import (
@@ -154,6 +155,12 @@ class LocalFabric:
         item = q.inflight.pop(item_id, None)
         if item is not None:
             self.redeliveries_total += 1
+            # per-item redelivery count rides the header so consumers can
+            # cap poison items (PrefillQueue folds it into req.attempts —
+            # a consumer dying mid-work must not redeliver forever)
+            header = dict(item.header or {})
+            header["redeliveries"] = int(header.get("redeliveries", 0)) + 1
+            item = replace(item, header=header)
             q.items.appendleft(item)
             q.event.set()
 

@@ -20,6 +20,8 @@ from typing import AsyncIterator, Awaitable, Callable, Optional
 from dynamo_tpu import telemetry
 from dynamo_tpu.runtime.codec import encode_frame, read_frame
 from dynamo_tpu.runtime.context import Context
+from dynamo_tpu.runtime.overload import OverloadedError
+from dynamo_tpu.testing import faults
 
 logger = logging.getLogger(__name__)
 
@@ -122,6 +124,9 @@ class IngressServer:
                 await writer.drain()
 
         try:
+            # fault-injection hook (dynamo_tpu/testing/faults.py): an
+            # injected error/drop surfaces as a handler error frame
+            await faults.fire("ingress.call", endpoint=endpoint)
             handler = self._handlers.get(endpoint)
             if handler is None:
                 await send(
@@ -158,13 +163,20 @@ class IngressServer:
                 pass
         except Exception as e:  # noqa: BLE001 — stream errors to the caller
             logger.exception("handler error for %s", endpoint)
+            frame = {
+                "op": "error", "request_id": rid, "message": str(e),
+                "retryable": isinstance(e, RetryableHandlerError),
+            }
+            if isinstance(e, OverloadedError):
+                # bounded admission refused this request: the worker is
+                # healthy, so the router retries ANOTHER instance without
+                # marking this one down, and the frontend answers 429
+                # with the Retry-After hint
+                frame["code"] = "overloaded"
+                if e.retry_after_s is not None:
+                    frame["retry_after_s"] = e.retry_after_s
             try:
-                await send(
-                    {
-                        "op": "error", "request_id": rid, "message": str(e),
-                        "retryable": isinstance(e, RetryableHandlerError),
-                    }
-                )
+                await send(frame)
             except Exception:
                 pass
         finally:

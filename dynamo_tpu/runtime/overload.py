@@ -1,0 +1,106 @@
+"""Overload-protection primitives shared across the stack.
+
+The graceful-degradation plane (docs/operations.md "Overload &
+draining") has three load-bearing pieces that must agree on types:
+
+- `OverloadedError`: a worker (or the frontend's own admission gate)
+  refusing work because a bounded queue is full. Carries the
+  `retry_after_s` hint computed from the live SLO sketches; the HTTP
+  frontend maps it to 429 + `Retry-After`. Deliberately NOT a
+  RetryableHandlerError: an overloaded worker is healthy, so the router
+  retries a different instance WITHOUT marking this one down.
+
+- `estimate_retry_after_s`: prices "when is capacity likely to free"
+  from a telemetry/slo.py SloTracker — p95 ITL x queue depth (how long
+  the queue ahead takes to drain in the decode-bound regime), floored
+  by the median request residency (e2e p50). Clamped to [1, 30] s so a
+  cold sketch can't tell clients to hammer or to go away for an hour.
+
+- `deadline_guard`: wraps an engine stream with an absolute deadline
+  (epoch seconds): on expiry the request context is cancelled (which
+  propagates cancel frames to subprocess children and remote workers)
+  and the stream error-finishes instead of hanging the client.
+
+Deadlines are absolute epoch times so they survive process hops
+(frontend -> router -> worker -> disagg -> external child); multi-host
+deployments assume loosely NTP-synced clocks, same as the reference.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import AsyncIterator, Optional
+
+
+class OverloadedError(RuntimeError):
+    """Bounded admission refused this request (queue full / shed)."""
+
+    def __init__(self, message: str, retry_after_s: Optional[float] = None):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
+
+
+#: Retry-After clamp: never tell a client "retry immediately" or
+#: "come back in an hour" off a cold or pathological sketch
+RETRY_AFTER_MIN_S = 1.0
+RETRY_AFTER_MAX_S = 30.0
+
+
+def estimate_retry_after_s(
+    tracker, queue_depth: int = 0, default_s: float = 1.0
+) -> float:
+    """Retry-After from a telemetry/slo.py SloTracker (None-safe)."""
+    est_ms = 0.0
+    if tracker is not None:
+        itl = tracker.sketches.get("itl_ms")
+        if itl is not None and itl.count:
+            p95 = itl.quantile(0.95)
+            if p95:
+                est_ms = p95 * max(queue_depth, 1)
+        e2e = tracker.sketches.get("e2e_ms")
+        if e2e is not None and e2e.count:
+            p50 = e2e.quantile(0.5)
+            if p50:
+                est_ms = max(est_ms, p50)
+    est_s = est_ms / 1000.0 if est_ms else default_s
+    return min(max(est_s, RETRY_AFTER_MIN_S), RETRY_AFTER_MAX_S)
+
+
+async def deadline_guard(
+    context, deadline: float, stream: AsyncIterator[dict]
+) -> AsyncIterator[dict]:
+    """Enforce an absolute deadline over an engine stream: items pass
+    through until the deadline, then the context is cancelled (cancel
+    frames reach subprocess children / remote workers) and one final
+    error-finish item ends the stream cleanly."""
+    it = stream.__aiter__()
+    expired = False
+    try:
+        while True:
+            remaining = deadline - time.time()
+            if remaining <= 0:
+                expired = True
+                break
+            try:
+                item = await asyncio.wait_for(it.__anext__(), remaining)
+            except StopAsyncIteration:
+                return
+            except asyncio.TimeoutError:
+                expired = True
+                break
+            yield item
+        # the error finish must go out BEFORE the context is cancelled:
+        # the ingress send loop drops items once ctx.cancelled, and a
+        # silently truncated stream would read as a clean finish
+        if expired:
+            yield {"token_ids": [], "finish_reason": "error"}
+    finally:
+        if expired:
+            context.cancel()
+            aclose = getattr(it, "aclose", None)
+            if aclose is not None:
+                try:
+                    await aclose()
+                except Exception:  # noqa: BLE001 — best-effort teardown
+                    pass

@@ -30,6 +30,7 @@ from dynamo_tpu.runtime.context import (
     Context,
     queue_get_or_cancelled,
 )
+from dynamo_tpu.runtime.overload import OverloadedError
 from dynamo_tpu.telemetry import phases
 
 logger = logging.getLogger(__name__)
@@ -87,17 +88,35 @@ class _WorkerConn:
 
 
 class PushRouter:
+    #: retry backoff: capped exponential with full jitter — a retry
+    #: storm against a recovering worker arrives spread out, not as a
+    #: synchronized hammer (docs/operations.md "Overload & draining")
+    RETRY_BACKOFF_BASE_MS = 25.0
+    RETRY_BACKOFF_MAX_MS = 500.0
+
     def __init__(
         self,
         source: InstanceSource,
         endpoint: str,
         mode: RouterMode = RouterMode.ROUND_ROBIN,
         kv_chooser=None,
+        retry_backoff_base_ms: Optional[float] = None,
+        retry_backoff_max_ms: Optional[float] = None,
     ):
         self.source = source
         self.endpoint = endpoint
         self.mode = mode
         self.kv_chooser = kv_chooser  # async (request) -> instance_id
+        self.retry_backoff_base_ms = (
+            self.RETRY_BACKOFF_BASE_MS
+            if retry_backoff_base_ms is None
+            else retry_backoff_base_ms
+        )
+        self.retry_backoff_max_ms = (
+            self.RETRY_BACKOFF_MAX_MS
+            if retry_backoff_max_ms is None
+            else retry_backoff_max_ms
+        )
         self._rr = itertools.count()
         self._conns: dict[str, _WorkerConn] = {}
 
@@ -155,6 +174,7 @@ class PushRouter:
         ) as rspan:
             t_dispatch = time.perf_counter()
             dispatched = False  # first response frame seen (any op)
+            backoff_total_ms = 0.0
 
             def _first_frame() -> None:
                 nonlocal dispatched
@@ -165,6 +185,22 @@ class PushRouter:
                         (time.perf_counter() - t_dispatch) * 1000.0,
                     )
                     rspan.add_event("first_frame")
+
+            async def _retry_backoff() -> None:
+                """Capped exponential backoff with full jitter before the
+                NEXT attempt; cumulative ms lands on the dispatch span
+                beside `attempts` so retry storms are visible."""
+                nonlocal backoff_total_ms
+                delay_ms = min(
+                    self.retry_backoff_max_ms,
+                    self.retry_backoff_base_ms * (2 ** (attempts - 1)),
+                ) * random.random()
+                backoff_total_ms += delay_ms
+                rspan.set_attr(
+                    "retry_backoff_ms", round(backoff_total_ms, 2)
+                )
+                if delay_ms > 0:
+                    await asyncio.sleep(delay_ms / 1000.0)
 
             while True:
                 attempts += 1
@@ -183,6 +219,7 @@ class PushRouter:
                         raise NoInstancesError(
                             f"no reachable instance for {self.endpoint}"
                         )
+                    await _retry_backoff()
                     continue
 
                 rid = ctx.request_id + "-" + uuid.uuid4().hex[:6]
@@ -213,6 +250,7 @@ class PushRouter:
                         raise NoInstancesError(
                             f"no reachable instance for {self.endpoint}"
                         )
+                    await _retry_backoff()
                     continue
 
                 got_data = False
@@ -239,6 +277,7 @@ class PushRouter:
                                 raise EngineStreamError(
                                     f"stream from {inst.instance_id} dropped"
                                 )
+                            await _retry_backoff()
                             break  # retry another instance
                         header, payload = item
                         op = header["op"]
@@ -249,6 +288,27 @@ class PushRouter:
                         elif op == "end":
                             return
                         elif op == "error":
+                            if (
+                                header.get("code") == "overloaded"
+                                and not got_data
+                            ):
+                                # bounded admission refused: the worker
+                                # is healthy (do NOT mark it down) —
+                                # back off and try another instance;
+                                # exhausted attempts surface as 429 at
+                                # the frontend with the Retry-After hint
+                                rspan.add_event(
+                                    "overloaded",
+                                    instance=inst.instance_id,
+                                )
+                                if attempts >= max_attempts:
+                                    raise OverloadedError(
+                                        header.get("message")
+                                        or "all instances overloaded",
+                                        header.get("retry_after_s"),
+                                    )
+                                await _retry_backoff()
+                                break
                             if header.get("retryable") and not got_data:
                                 # the worker itself says another instance
                                 # should take this (its engine subprocess is
@@ -264,6 +324,7 @@ class PushRouter:
                                     raise EngineStreamError(
                                         header.get("message")
                                     )
+                                await _retry_backoff()
                                 break
                             raise EngineStreamError(header.get("message"))
                 finally:

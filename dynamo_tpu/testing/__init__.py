@@ -1,0 +1,14 @@
+"""Test-support planes shipped with the package (fault injection)."""
+
+from dynamo_tpu.testing.faults import (  # noqa: F401
+    FaultError,
+    FaultInjector,
+    FaultRule,
+    HOOK_POINTS,
+    fire,
+    fire_sync,
+    get_injector,
+    install,
+    install_from_env,
+    uninstall,
+)

@@ -1,0 +1,256 @@
+"""Deterministic, seedable fault injection for the distributed stack.
+
+The chaos companion to the subprocess kill harness (tests/fault_tolerance):
+instead of killing whole processes, named HOOK POINTS inside the fabric
+client, the disagg transfer planes, the worker ingress and the engine step
+loop consult a process-global injector and — per an installed rule table —
+drop (connection loss), delay, or error the operation. Everything is
+driven by one `random.Random(seed)`, so a chaos scenario replays
+identically under a pinned seed.
+
+Default state is OFF: no injector installed means every hook site is a
+single global-load + `is None` check on the host path (the token path is
+bit-identical — pinned by tests/test_overload.py). Installation is either
+programmatic (tests) or via `DYNTPU_FAULTS` for subprocess workers:
+
+    DYNTPU_FAULTS="transfer.land:error:1.0:times=2;engine.step:delay:0.5:delay_ms=200"
+    DYNTPU_FAULTS_SEED=7
+
+Spec grammar, `;`-separated rules of `point:kind:prob[:k=v...]` with
+k=v in {times, delay_ms}. Unknown points are rejected at install time —
+a typo must not silently inject nothing.
+
+Hook points (each named after the operation it brackets):
+
+    fabric.call     RemoteFabric._call — every control-plane op (kv,
+                    lease, queue, bus). `op=` carries the fabric op name
+                    so rules can target e.g. only `queue.pop`.
+    ingress.call    IngressServer._serve_call — a pushed request arriving
+                    at a worker, before its handler runs.
+    transfer.send   KvTransferClient.send — the prefill→decode KV push,
+                    client side (before any bytes move).
+    transfer.land   KvTransferServer._land — the decode-side landing of a
+                    KV write (an injected error nacks the sender, exactly
+                    like a real landing failure).
+    engine.step     the engine thread, immediately before `eng.step()` —
+                    an injected delay stalls the loop (watchdog fodder),
+                    an injected error is swallowed by the step-loop guard
+                    like any real step failure.
+
+Kinds:
+
+    drop       raise ConnectionError (the wire died mid-operation)
+    error      raise FaultError (an application-level failure)
+    delay      sleep `delay_ms` (async at async sites, blocking at sync
+               sites), then proceed
+    partition  alias of drop with prob=1.0 and no `times` cap — a peer
+               that stays unreachable until the rule is removed
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+logger = logging.getLogger(__name__)
+
+HOOK_POINTS = (
+    "fabric.call",
+    "ingress.call",
+    "transfer.send",
+    "transfer.land",
+    "engine.step",
+)
+
+
+class FaultError(RuntimeError):
+    """An injected application-level failure."""
+
+
+@dataclass
+class FaultRule:
+    point: str
+    kind: str  # drop | error | delay | partition
+    prob: float = 1.0
+    #: max times this rule fires (None = unbounded)
+    times: Optional[int] = None
+    delay_ms: float = 100.0
+    #: ctx key=value filters — every listed key must match the hook's
+    #: keyword context exactly (e.g. op="queue.pop")
+    match: dict[str, Any] = field(default_factory=dict)
+    fired: int = 0
+
+    def __post_init__(self):
+        if self.point not in HOOK_POINTS:
+            raise ValueError(
+                f"unknown hook point {self.point!r}; valid: {HOOK_POINTS}"
+            )
+        if self.kind not in ("drop", "error", "delay", "partition"):
+            raise ValueError(f"unknown fault kind {self.kind!r}")
+        if self.kind == "partition":
+            # a partition IS a persistent drop: normalize so firing logic
+            # has three behaviors, not four
+            self.kind = "drop"
+            self.prob = 1.0
+            self.times = None
+
+    def matches(self, ctx: dict) -> bool:
+        return all(ctx.get(k) == v for k, v in self.match.items())
+
+
+class FaultInjector:
+    """Rule table + seeded RNG + fire log. Thread-safe: hook sites live
+    on the event loop AND the engine thread."""
+
+    def __init__(self, seed: int = 0):
+        self.rng = random.Random(seed)
+        self.rules: list[FaultRule] = []
+        self._lock = threading.Lock()
+        #: (point, kind) -> fire count
+        self.fired: dict[tuple[str, str], int] = {}
+        #: chronological fire log [(point, kind, ctx)] for assertions
+        self.log: list[tuple[str, str, dict]] = []
+
+    def add_rule(self, point: str, kind: str, prob: float = 1.0,
+                 times: Optional[int] = None, delay_ms: float = 100.0,
+                 **match) -> FaultRule:
+        rule = FaultRule(
+            point=point, kind=kind, prob=prob, times=times,
+            delay_ms=delay_ms, match=match,
+        )
+        with self._lock:
+            self.rules.append(rule)
+        return rule
+
+    def remove_rule(self, rule: FaultRule) -> None:
+        with self._lock:
+            if rule in self.rules:
+                self.rules.remove(rule)
+
+    def clear(self) -> None:
+        with self._lock:
+            self.rules.clear()
+
+    def _decide(self, point: str, ctx: dict) -> Optional[FaultRule]:
+        """First matching rule that wins its coin flip (under the lock:
+        the RNG and the `fired` budgets are shared state)."""
+        with self._lock:
+            for rule in self.rules:
+                if rule.point != point or not rule.matches(ctx):
+                    continue
+                if rule.times is not None and rule.fired >= rule.times:
+                    continue
+                if rule.prob < 1.0 and self.rng.random() >= rule.prob:
+                    continue
+                rule.fired += 1
+                key = (point, rule.kind)
+                self.fired[key] = self.fired.get(key, 0) + 1
+                self.log.append((point, rule.kind, dict(ctx)))
+                return rule
+        return None
+
+    @staticmethod
+    def _raise(point: str, rule: FaultRule) -> None:
+        if rule.kind == "drop":
+            raise ConnectionError(f"fault-injected drop at {point}")
+        raise FaultError(f"fault-injected error at {point}")
+
+    async def fire(self, point: str, **ctx) -> None:
+        rule = self._decide(point, ctx)
+        if rule is None:
+            return
+        logger.warning("fault injected: %s %s %s", rule.kind, point, ctx)
+        if rule.kind == "delay":
+            await asyncio.sleep(rule.delay_ms / 1000.0)
+            return
+        self._raise(point, rule)
+
+    def fire_sync(self, point: str, **ctx) -> None:
+        """Blocking variant for sync sites (the engine thread)."""
+        rule = self._decide(point, ctx)
+        if rule is None:
+            return
+        logger.warning("fault injected: %s %s %s", rule.kind, point, ctx)
+        if rule.kind == "delay":
+            time.sleep(rule.delay_ms / 1000.0)
+            return
+        self._raise(point, rule)
+
+
+#: the process-global injector; None = fault injection entirely off
+_injector: Optional[FaultInjector] = None
+
+
+def get_injector() -> Optional[FaultInjector]:
+    return _injector
+
+
+def install(injector: Optional[FaultInjector] = None,
+            seed: int = 0) -> FaultInjector:
+    global _injector
+    _injector = injector or FaultInjector(seed=seed)
+    return _injector
+
+
+def uninstall() -> None:
+    global _injector
+    _injector = None
+
+
+async def fire(point: str, **ctx) -> None:
+    """Hook entry for async sites; a no-op (one global load) when no
+    injector is installed."""
+    inj = _injector
+    if inj is not None:
+        await inj.fire(point, **ctx)
+
+
+def fire_sync(point: str, **ctx) -> None:
+    """Hook entry for sync sites (engine thread)."""
+    inj = _injector
+    if inj is not None:
+        inj.fire_sync(point, **ctx)
+
+
+def parse_spec(spec: str) -> list[FaultRule]:
+    """`point:kind:prob[:k=v...]` rules, `;`-separated (see module doc)."""
+    rules: list[FaultRule] = []
+    for part in spec.split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        bits = part.split(":")
+        if len(bits) < 2:
+            raise ValueError(f"bad fault rule {part!r}")
+        point, kind = bits[0], bits[1]
+        prob = float(bits[2]) if len(bits) > 2 else 1.0
+        kw: dict[str, Any] = {}
+        for extra in bits[3:]:
+            k, _, v = extra.partition("=")
+            if k == "times":
+                kw["times"] = int(v)
+            elif k == "delay_ms":
+                kw["delay_ms"] = float(v)
+            else:
+                raise ValueError(f"bad fault rule option {extra!r}")
+        rules.append(FaultRule(point=point, kind=kind, prob=prob, **kw))
+    return rules
+
+
+def install_from_env() -> Optional[FaultInjector]:
+    """Install from DYNTPU_FAULTS / DYNTPU_FAULTS_SEED (subprocess chaos
+    workers); returns the injector or None when the env is unset."""
+    spec = os.environ.get("DYNTPU_FAULTS")
+    if not spec:
+        return None
+    inj = FaultInjector(seed=int(os.environ.get("DYNTPU_FAULTS_SEED", "0")))
+    inj.rules.extend(parse_spec(spec))
+    install(inj)
+    logger.warning("fault injection active: %s", spec)
+    return inj

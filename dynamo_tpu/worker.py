@@ -63,6 +63,7 @@ class Worker:
         echo_delay: float = 0.0,
         mock_args=None,
         engine=None,
+        drain_budget_s: float = 30.0,
     ):
         self.runtime = runtime
         self.card = card
@@ -118,6 +119,14 @@ class Worker:
         self.external = engine
         self._kv_event_buffer: list[KvEvent] = []
         self._tasks: list[asyncio.Task] = []
+        #: graceful drain (docs/operations.md "Overload & draining"):
+        #: SIGTERM or the `drain` ingress op flips this — the worker
+        #: deregisters, refuses new ingress (router retries a survivor),
+        #: finishes in-flight work within drain_budget_s, then `drained`
+        #: fires so the CLI process can exit 0
+        self.draining = False
+        self.drain_budget_s = drain_budget_s
+        self.drained = asyncio.Event()
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -193,6 +202,7 @@ class Worker:
         self.ingress.add_handler("generate", self._generate)
         self.ingress.add_handler("embed", self._embed)
         self.ingress.add_handler("flush", self._flush)
+        self.ingress.add_handler("drain", self._drain_handler)
         await self.ingress.start()
 
         metadata = {"model": self.card.name}
@@ -274,38 +284,89 @@ class Worker:
             self.ingress.port,
         )
 
+    def _busy(self) -> bool:
+        # ingress inflight covers the whole request lifecycle —
+        # runner._pending hand-off, disagg transfer waits, and the
+        # final response frames — not just scheduler occupancy.
+        if self.ingress.num_inflight > 0:
+            return True
+        return self.runner is not None and self.runner.engine.has_work
+
+    async def _deregister(self) -> None:
+        if self.registration is None:
+            return
+        try:
+            await self.registration.deregister()
+        except Exception:
+            # Routers will keep sending until the lease expires — make
+            # that window observable instead of silent.
+            logger.warning(
+                "deregister failed; relying on lease expiry", exc_info=True
+            )
+        self.registration = None
+
+    async def drain(self, budget_s: Optional[float] = None) -> bool:
+        """Graceful drain (docs/operations.md "Overload & draining"):
+        deregister so routers stop choosing this worker, refuse new
+        ingress (`_generate` raises RetryableHandlerError — the router
+        retries a survivor), finish in-flight requests within the
+        budget, then fire `drained` so the host process exits 0. KV
+        stays serveable the whole time: --kv-remote peers can still
+        onboard this worker's blocks over the transfer plane until the
+        process exits (the serve/adopt hand-off path). Returns True if
+        everything in flight finished inside the budget."""
+        if self.draining:
+            await self.drained.wait()
+            return not self._busy()
+        self.draining = True
+        budget = self.drain_budget_s if budget_s is None else budget_s
+        logger.info(
+            "worker %s draining (budget %.1fs, %d in flight)",
+            self.instance_id, budget, self.ingress.num_inflight,
+        )
+        await self._deregister()
+        clean = True
+        deadline = asyncio.get_running_loop().time() + max(budget, 0.0)
+        while self._busy() and asyncio.get_running_loop().time() < deadline:
+            await asyncio.sleep(0.05)
+        if self._busy():
+            clean = False
+            logger.warning(
+                "drain budget exhausted: %d calls still in flight",
+                self.ingress.num_inflight,
+            )
+        else:
+            logger.info("worker %s drained", self.instance_id)
+        self.drained.set()
+        return clean
+
+    async def _drain_handler(self, ctx, request):
+        """`drain` ingress op (POST /v1/admin/drain at the frontend):
+        acknowledge immediately, wind down in the background."""
+        budget = None
+        if isinstance(request, dict) and request.get("budget_s") is not None:
+            budget = float(request["budget_s"])
+        task = asyncio.get_running_loop().create_task(self.drain(budget))
+        task.add_done_callback(
+            lambda t: t.cancelled() or t.exception()  # observe, never raise
+        )
+        yield {
+            "draining": True,
+            "inflight": self.ingress.num_inflight,
+            "budget_s": self.drain_budget_s if budget is None else budget,
+        }
+
     async def stop(self, drain_timeout: float = 30.0) -> None:
         """Graceful shutdown (reference: the vLLM drain handlers,
         examples worker.py:156-170): deregister FIRST so routers stop
         sending here, let in-flight requests finish up to drain_timeout,
         then tear the planes down."""
-        if self.registration is not None:
-            try:
-                await self.registration.deregister()
-            except Exception:
-                # Routers will keep sending until the lease expires — make
-                # that window observable instead of silent.
-                logger.warning(
-                    "deregister failed; relying on lease expiry",
-                    exc_info=True,
-                )
-            self.registration = None
-        if drain_timeout > 0:
-
-            def busy() -> bool:
-                # ingress inflight covers the whole request lifecycle —
-                # runner._pending hand-off, disagg transfer waits, and the
-                # final response frames — not just scheduler occupancy.
-                if self.ingress.num_inflight > 0:
-                    return True
-                return (
-                    self.runner is not None and self.runner.engine.has_work
-                )
-
+        await self._deregister()
+        if drain_timeout > 0 and not self.drained.is_set():
             deadline = asyncio.get_running_loop().time() + drain_timeout
-            while busy() and asyncio.get_running_loop().time() < deadline:
+            while self._busy() and asyncio.get_running_loop().time() < deadline:
                 await asyncio.sleep(0.05)
-            if busy():
+            if self._busy():
                 logger.warning(
                     "drain timeout: %d calls still in flight; closing",
                     self.ingress.num_inflight,
@@ -329,6 +390,14 @@ class Worker:
     # -- handlers ----------------------------------------------------------
 
     async def _generate(self, ctx, request: dict):
+        if self.draining:
+            # the router retries a survivor; this instance is already
+            # deregistered and only finishing what it has
+            from dynamo_tpu.runtime.ingress import RetryableHandlerError
+
+            raise RetryableHandlerError(
+                f"worker {self.instance_id} is draining"
+            )
         pre = PreprocessedRequest.from_dict(request)
         if self.kv_directory is not None and pre.mm_embeds is None:
             try:
@@ -346,6 +415,14 @@ class Worker:
         gen = (
             self.external or self.echo or self.mock or self.runner
         ).generate(ctx, pre)
+        if pre.deadline and self.runner is None:
+            # engines without the runner's built-in deadline enforcement
+            # (external subprocess / echo / mock): the guard cancels the
+            # context on expiry — the cancel frame reaches subprocess
+            # children — and error-finishes the stream
+            from dynamo_tpu.runtime.overload import deadline_guard
+
+            gen = deadline_guard(ctx, pre.deadline, gen)
         async for event in gen:
             yield event
 
@@ -491,6 +568,7 @@ class Worker:
 
         from dynamo_tpu import telemetry
         from dynamo_tpu.disagg.protocol import RemotePrefillRequest
+        from dynamo_tpu.disagg.transfer import RemotePrefillError
         from dynamo_tpu.engine.async_engine import _sampling_from
         from dynamo_tpu.telemetry import phases
 
@@ -531,10 +609,22 @@ class Worker:
                         },
                         model=self.card.name,
                         trace=telemetry.wire_context() or {},
+                        deadline=pre.deadline,
                     )
                 )
                 timeout = self.disagg_router.config.transfer_timeout_s
                 result = await asyncio.wait_for(waiter, timeout)
+            except RemotePrefillError as e:
+                # the prefill fleet dead-lettered this request: error-
+                # finish (a local fallback would just poison again)
+                self.transfer_server.forget(rid)
+                await runner.submit(lambda eng: eng.cancel_remote_prefill(req))
+                logger.error(
+                    "disagg: remote prefill for %s dead-lettered: %s", rid, e
+                )
+                dspan.end(status="error")
+                yield {"token_ids": [], "finish_reason": "error"}
+                return
             except Exception:
                 self.transfer_server.forget(rid)
                 await runner.submit(lambda eng: eng.cancel_remote_prefill(req))
@@ -553,6 +643,15 @@ class Worker:
 
         out_q = runner.watch_request(rid)
         try:
+            if pre.deadline and _time.time() > pre.deadline:
+                # the deadline lapsed while the transfer was in flight:
+                # never admit (the reservation frees, no decode flops) —
+                # tracking it BEFORE admission would let the runner
+                # expire-and-forget it, then add_prefilled would admit a
+                # request nothing ever aborts
+                await runner.submit(lambda eng: eng.cancel_remote_prefill(req))
+                yield {"token_ids": [], "finish_reason": "error"}
+                return
             try:
                 outputs = await runner.submit(
                     lambda eng: eng.add_prefilled(req, result.first_token)
@@ -560,6 +659,11 @@ class Worker:
             except Exception:
                 await runner.submit(lambda eng: eng.cancel_remote_prefill(req))
                 raise
+            if pre.deadline:
+                # decode-side deadline enforcement for the out-of-band
+                # admission path, armed only once the request is ADMITTED
+                # (an expiry now aborts a live request and frees pages)
+                runner.track_deadline(rid, pre.deadline)
             for out in outputs:
                 yield output_to_dict(out)
                 if out.finish_reason is not None:
@@ -665,6 +769,10 @@ class Worker:
                 m["role"] = (
                     "prefill" if "prefill" in self.component else "decode"
                 )
+                # drain visibility: /v1/fleet shows state=draining while
+                # the worker winds down (doctor's draining-worker rule
+                # keys off this instead of tripping dead/stalled rules)
+                m["state"] = "draining" if self.draining else "serving"
                 eng = getattr(self.runner, "engine", None)
                 if eng is not None and getattr(eng, "slo", None) is not None:
                     try:

@@ -10,6 +10,11 @@ tests MUST carry the `slow` marker so the quick suite (`-m 'not slow'`)
 never runs them; the gate probe (`collective_plane_available`) protects
 the slow lane, not the budget.
 
+Chaos and fault-injection scenarios that spawn process fleets (the
+tests/fault_tolerance harness, ChaosCluster) are forced `slow` the same
+way: a cluster bring-up plus kill/drain schedules costs minutes of wall
+clock and has subprocess-wedge failure modes tier-1 must never inherit.
+
 Static (AST) scan, `-p no:randomly`-safe: no test module is imported, so
 the audit cannot be perturbed by plugin ordering or collection order.
 A test function is RISKY when its own source — or the source of any
@@ -34,6 +39,13 @@ RISK_TOKENS = (
     "spawn_two_hosts",  # tests/helpers/spmd_host.py fleet spawner
     "--coordinator",    # CLI worker fleet joining a jax.distributed group
     "collective_plane_available",  # the gate probe itself needs the plane
+    # chaos / fault-injection fleets (docs/operations.md "Overload &
+    # draining"): the FT harness spawns a whole CLI process cluster
+    # (fabric + frontend + workers) and drives it with injected kills,
+    # drains and saturation — minutes of wall clock, never tier-1
+    "ManagedProc",      # benchmarks/_procs.py process spawner
+    "fault_tolerance.harness",  # importing the cluster harness at all
+    "ChaosCluster",     # tests/test_chaos.py process-level scenarios
 )
 
 

@@ -25,6 +25,15 @@ Rules (each emits severity + worker + evidence + suggested action):
                        running requests pay whole prefill drains as ITL
   dead-worker          a worker stopped publishing (last_seen_s beyond
                        the threshold)
+  draining-worker      a worker reports state=draining (planned wind-
+                       down via SIGTERM / POST /v1/admin/drain) — an
+                       info note, and the dead/stalled rules are
+                       suppressed for it so a drain never pages
+  overload             bounded admission is rejecting (overload_rejects
+                       climbing -> "shedding, raise capacity"), or the
+                       waiting queue is deep while the role burns its
+                       SLO budget with ZERO rejects -> "queue unbounded,
+                       enable admission caps" (docs/operations.md)
   skewed-worker        one worker's token throughput sits far below its
                        role's mean — a limping replica drags the whole
                        pool's SLA
@@ -59,6 +68,9 @@ COMPILE_STORM_FRACTION = 0.3
 POOL_FREE_FRACTION = 0.02
 #: decode-attainment below this = the host loop, not the chip, rules
 ATTAINMENT_FLOOR = 0.05
+#: waiting queue deeper than max(this, 4x running) while the role burns
+#: its SLO budget = saturated with no admission caps
+QUEUE_DEPTH_FLOOR = 8
 
 
 def _finding(severity: str, rule: str, worker: Optional[str], summary: str,
@@ -98,9 +110,46 @@ def diagnose(
     role_mean = {
         r: (sum(v) / len(v) if v else 0.0) for r, v in role_tok.items()
     }
+    #: worst (shortest-window) burn rate per role, for the overload rule
+    role_burn: dict[str, float] = {}
+    for role, r in roles.items():
+        for wd in ((r.get("slo") or {}).get("windows") or {}).values():
+            burn = (wd or {}).get("burn_rate")
+            if burn is not None:
+                role_burn[role] = max(role_burn.get(role, 0.0), float(burn))
 
     for iid, w in sorted(workers.items()):
         age = float(w.get("last_seen_s") or 0.0)
+        if str(w.get("state") or "") == "draining":
+            # planned wind-down (SIGTERM / POST /v1/admin/drain): the
+            # dead/stalled/skew rules below would misread a drain as an
+            # outage — suppress them. But a drain is supposed to END
+            # (budget default 30s, then exit 0 and the snapshot entry
+            # ages out) — one that went SILENT past the dead threshold
+            # is a wedged drain, which must still surface as a warning.
+            # (stalls_total is lifetime-cumulative, so a pre-drain stall
+            # must not read as a wedged drain — only silence does.)
+            wedged = age > DEAD_AFTER_S
+            findings.append(_finding(
+                "warning" if wedged else "info", "draining-worker", iid,
+                (f"{iid} is draining but looks wedged "
+                 f"(last_seen {age:.1f}s ago) — the drain budget "
+                 "should have ended this"
+                 if wedged else
+                 f"{iid} is draining (planned wind-down; "
+                 f"{w.get('num_running') or 0} running)"),
+                {"state": "draining", "last_seen_s": age,
+                 "num_running": w.get("num_running"),
+                 "stalls_total": w.get("stalls_total")},
+                ("verify the process exited 0; if it is still alive "
+                 "past its --drain-budget, read its /v1/debug/stalls "
+                 "and JSONL log — in-flight work may be wedged"
+                 if wedged else
+                 "no action: the worker deregistered and is finishing "
+                 "in-flight requests; it exits 0 when drained (or when "
+                 "its --drain-budget lapses)"),
+            ))
+            continue
         if age > DEAD_AFTER_S:
             findings.append(_finding(
                 "critical", "dead-worker", iid,
@@ -187,6 +236,41 @@ def diagnose(
                 "check the worker's /v1/debug/stalls and JSONL log; a "
                 "dispatch stuck in the device tunnel shows in the "
                 "engine thread's stack",
+            ))
+
+        # overload (docs/operations.md "Overload & draining"): two
+        # mirror-image states — bounded admission actively shedding
+        # (capacity is the fix), vs a deep unbounded queue silently
+        # burning the SLO budget (admission caps are the fix)
+        rejects = int(w.get("overload_rejects") or 0)
+        waiting = int(w.get("num_waiting") or 0)
+        running = int(w.get("num_running") or 0)
+        burn = role_burn.get(str(w.get("role", "?")), 0.0)
+        if rejects > 0:
+            findings.append(_finding(
+                "warning", "overload", iid,
+                f"{iid}: bounded admission rejected {rejects} request(s) "
+                f"(waiting={waiting}) — this worker is shedding",
+                {"overload_rejects": rejects, "num_waiting": waiting,
+                 "num_running": running,
+                 "deadline_expired": w.get("deadline_expired")},
+                "shedding is working as designed; raise capacity (add "
+                "workers / grow the pool) if the 429 rate is above what "
+                "clients tolerate — dynamo_tpu_shed_total{reason} at the "
+                "frontend names the shed reasons",
+            ))
+        elif waiting > max(QUEUE_DEPTH_FLOOR, 4 * running) and burn > 1.0:
+            findings.append(_finding(
+                "warning", "overload", iid,
+                f"{iid}: {waiting} requests queued against {running} "
+                f"running while the role burns its SLO budget at "
+                f"{burn:.1f}x, with ZERO admission rejects — the queue "
+                "is unbounded",
+                {"num_waiting": waiting, "num_running": running,
+                 "burn_rate": burn, "overload_rejects": 0},
+                "enable admission caps (--max-waiting on workers, "
+                "--max-inflight at the frontend) so excess load answers "
+                "429 + Retry-After instead of queueing past its deadline",
             ))
 
         mean = role_mean.get(str(w.get("role", "?")), 0.0)

@@ -1,0 +1,418 @@
+"""Chaos scenario: live traffic under injected faults (the acceptance
+gate of docs/operations.md "Overload & draining").
+
+Two layers, matching the two failure planes:
+
+- process-level (ChaosCluster over the FT harness's ManagedProc): real
+  CLI fleet — fabric + frontend(--max-inflight) + echo workers — driven
+  by concurrent clients while a worker is SIGKILLed, another is drained
+  via SIGTERM (must exit 0 with its in-flight streams finished), the
+  frontend saturates into 429s, and a deadline-carrying request 504s.
+  The invariant throughout: EVERY request terminates with a real HTTP
+  status inside its timeout — zero hung streams.
+
+- in-process disagg (fabric server + decode Worker + PrefillWorker with
+  the fault injector aimed at the KV transfer planes): repeated landing
+  failures must dead-letter the prefill and error-finish the decode
+  stream (never redeliver forever, never hang), a single transient drop
+  must retry to the SAME tokens, and recovery after the faults clear
+  must be bit-identical to a local reference run.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import concurrent.futures
+import json
+import signal
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from dynamo_tpu.testing import faults
+
+pytestmark = pytest.mark.slow
+
+
+# -- process-level chaos ----------------------------------------------------
+
+
+class ChaosCluster:
+    """fabric + frontend with admission caps + echo workers, all real
+    CLI processes (tests/fault_tolerance/harness.py shape, with the
+    overload-plane flags wired in)."""
+
+    def __init__(self, num_workers=2, max_inflight=4, echo_delay=0.05,
+                 drain_budget=8.0):
+        from benchmarks._procs import free_port
+        from tests.fault_tolerance.harness import ManagedProc, _cli
+
+        self._cli = _cli
+        self._ManagedProc = ManagedProc
+        self.echo_delay = echo_delay
+        self.drain_budget = drain_budget
+        self.fabric_port = free_port()
+        self.http_port = free_port()
+        self.workers = []
+        self.frontend = None
+        self.fabric = None
+        try:
+            self.fabric = ManagedProc(
+                "fabric", _cli("fabric", "--port", str(self.fabric_port))
+            )
+            self.fabric.wait_for("fabric server on|listening", timeout=20)
+            for _ in range(num_workers):
+                self.add_worker()
+            self.frontend = ManagedProc(
+                "frontend",
+                _cli(
+                    "run", "in=http", "out=dyn",
+                    "--fabric", f"127.0.0.1:{self.fabric_port}",
+                    "--port", str(self.http_port),
+                    "--max-inflight", str(max_inflight),
+                ),
+            )
+            self.frontend.wait_for("listening on", timeout=30)
+            self.wait_until_ready()
+        except BaseException:
+            self.stop()
+            raise
+
+    def add_worker(self):
+        w = self._ManagedProc(
+            f"worker{len(self.workers)}",
+            self._cli(
+                "run", "in=dyn", "out=echo", "--model", "tiny",
+                "--fabric", f"127.0.0.1:{self.fabric_port}",
+                "--echo-delay", str(self.echo_delay),
+                "--drain-budget", str(self.drain_budget),
+            ),
+        )
+        self.workers.append(w)
+        w.wait_for(r"worker \w+ up", timeout=40)
+        return w
+
+    def request(self, text: str, timeout: float = 30.0,
+                headers: dict | None = None) -> tuple[int, dict]:
+        """One chat completion; ALWAYS returns a terminal status (an
+        exception here is a protocol-level failure, counted by the
+        caller — never a hang, urllib enforces the timeout)."""
+        body = json.dumps({
+            "model": "tiny",
+            "messages": [{"role": "user", "content": text}],
+            "max_tokens": 24,
+        }).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{self.http_port}/v1/chat/completions",
+            data=body,
+            headers={"Content-Type": "application/json", **(headers or {})},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                return resp.status, json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            try:
+                payload = json.loads(e.read())
+            except Exception:
+                payload = {}
+            return e.code, dict(e.headers) | payload
+
+    def wait_until_ready(self, timeout: float = 30.0) -> None:
+        deadline = time.time() + timeout
+        last = None
+        while time.time() < deadline:
+            try:
+                status, data = self.request("ping", timeout=5)
+                if status == 200:
+                    return
+                last = (status, data)
+            except Exception as e:
+                last = e
+            time.sleep(0.5)
+        raise AssertionError(f"cluster never became ready: {last}")
+
+    def stop(self) -> None:
+        for p in [self.frontend, *self.workers, self.fabric]:
+            if p is None:
+                continue
+            try:
+                p.stop()
+            except Exception:
+                pass
+
+
+def _drive(cluster, n, tag, timeout=30.0, headers=None):
+    """n concurrent requests; every one MUST terminate (status or
+    protocol error) inside its timeout. Returns the status list —
+    a worker-thread that never returns would trip the outer wait."""
+    with concurrent.futures.ThreadPoolExecutor(max_workers=n) as pool:
+        futs = [
+            pool.submit(cluster.request, f"{tag} {i}", timeout, headers)
+            for i in range(n)
+        ]
+        done, not_done = concurrent.futures.wait(futs, timeout=timeout + 30)
+        assert not not_done, f"{len(not_done)} hung streams in phase {tag!r}"
+        statuses = []
+        for f in done:
+            try:
+                statuses.append(f.result()[0])
+            except Exception:
+                statuses.append(-1)  # connection reset etc. — terminal
+        return statuses
+
+
+def test_chaos_kill_drain_saturation_deadline():
+    """The full process-level scenario: baseline -> worker SIGKILL ->
+    replacement -> graceful SIGTERM drain (exit 0) -> queue saturation
+    (429 + Retry-After) -> expired deadline (504). Zero hung streams in
+    any phase; the fleet answers 200s again after every disruption."""
+    cluster = ChaosCluster(num_workers=2, max_inflight=4)
+    try:
+        # phase 1: baseline under the inflight cap — all tokens
+        statuses = _drive(cluster, 3, "baseline")
+        assert statuses == [200, 200, 200], statuses
+
+        # phase 2: SIGKILL one worker mid-traffic. In-flight streams on
+        # the dead worker may error (that IS a terminal finish); the
+        # survivor keeps serving, and a replacement restores capacity.
+        with concurrent.futures.ThreadPoolExecutor(max_workers=3) as pool:
+            futs = [
+                pool.submit(cluster.request, f"kill {i}", 30.0)
+                for i in range(3)
+            ]
+            time.sleep(0.15)
+            cluster.workers[0].kill(signal.SIGKILL)
+            done, not_done = concurrent.futures.wait(futs, timeout=60)
+            assert not not_done, "hung streams across the worker kill"
+        assert cluster.workers[0].proc.returncode not in (None, 0)
+        cluster.add_worker()
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if _drive(cluster, 3, "recovered").count(200) == 3:
+                break
+            time.sleep(0.5)
+        else:
+            raise AssertionError("fleet never recovered from the kill")
+
+        # phase 3: graceful drain — SIGTERM mid-stream. The drained
+        # worker finishes its in-flight requests within --drain-budget
+        # and exits 0; traffic keeps flowing on the survivors.
+        victim = cluster.workers[1]
+        with concurrent.futures.ThreadPoolExecutor(max_workers=3) as pool:
+            futs = [
+                pool.submit(cluster.request, f"drain {i}", 30.0)
+                for i in range(3)
+            ]
+            time.sleep(0.3)
+            victim.proc.send_signal(signal.SIGTERM)
+            done, not_done = concurrent.futures.wait(futs, timeout=60)
+            assert not not_done, "hung streams across the drain"
+            drain_statuses = [f.result()[0] for f in done]
+        assert victim.proc.wait(timeout=30) == 0, (
+            "drained worker must exit 0:\n" + open(victim.log_path).read()
+        )
+        with open(victim.log_path) as f:
+            assert "drained; exiting" in f.read()
+        # in-flight work finished or was retried on a survivor — every
+        # stream terminated and the fleet still answers
+        assert all(s != -1 for s in drain_statuses), drain_statuses
+        assert _drive(cluster, 3, "post-drain").count(200) == 3
+
+        # phase 4: saturation — 12 concurrent slow streams against
+        # --max-inflight 4: excess answers 429 + Retry-After, admitted
+        # work completes, nothing hangs
+        statuses = _drive(cluster, 12, "saturate")
+        assert statuses.count(429) >= 1, statuses
+        assert statuses.count(200) >= 1, statuses
+        assert all(s in (200, 429) for s in statuses), statuses
+        status, payload = cluster.request("one more", timeout=30)
+        if status == 429:
+            assert int(payload.get("Retry-After", 0)) >= 1
+
+        # phase 5: a request whose deadline can't be met 504s instead of
+        # burning the engine (the echoed stream runs ~0.25s at 50ms per
+        # token; a 0.12s deadline expires mid-stream)
+        deadline_status, _ = cluster.request(
+            "too slow", timeout=30, headers={"x-request-timeout": "0.12"}
+        )
+        assert deadline_status == 504, deadline_status
+
+        # coda: the fleet is still healthy after the whole gauntlet
+        assert _drive(cluster, 3, "coda").count(200) == 3
+    finally:
+        cluster.stop()
+
+
+# -- in-process disagg chaos: transfer faults -------------------------------
+
+
+def _req(rid, prompt, n_out):
+    return {
+        "request_id": rid, "token_ids": prompt, "max_tokens": n_out,
+        "temperature": 0.0, "top_p": 1.0, "top_k": 0, "seed": None,
+        "stop_token_ids": [], "stop_strings": [], "ignore_eos": True,
+        "annotations": {},
+    }
+
+
+def test_chaos_disagg_transfer_faults_dead_letter_and_recover():
+    """Injected KV-landing failures exhaust the redelivery cap: the
+    request dead-letters with an ERROR finish on the decode stream
+    (bounded, fast — not a timeout, not an infinite redelivery). With
+    the fault table cleared the same pipeline recovers to tokens that
+    are bit-identical to a local reference run; a single transient
+    send-drop self-heals through the bounded retry."""
+    from dynamo_tpu.disagg.prefill_worker import PrefillWorker
+    from dynamo_tpu.disagg.router import DisaggConfig
+    from dynamo_tpu.engine.config import EngineConfig
+    from dynamo_tpu.engine.engine import JaxEngine
+    from dynamo_tpu.engine.request import SamplingParams
+    from dynamo_tpu.model_card import ModelDeploymentCard
+    from dynamo_tpu.runtime import DistributedRuntime, RouterMode
+    from dynamo_tpu.runtime.fabric import FabricServer
+    from dynamo_tpu.worker import Worker
+
+    cfg = EngineConfig.for_tests()
+    #: distinct prompt per phase — a shared prompt would prefix-cache
+    #: after the first successful transfer and later phases would prefill
+    #: locally, never reaching the faulted transfer plane
+    prompts = {
+        "poison": [5, 17, 42, 99, 3, 8, 21, 60, 11, 2],
+        "recover": [7, 19, 44, 101, 5, 10, 23, 62, 13, 4],
+        "transient": [9, 21, 46, 103, 7, 12, 25, 64, 15, 6],
+        "land-fault": [11, 23, 48, 105, 9, 14, 27, 66, 17, 8],
+        "slow-transfer": [13, 25, 50, 107, 11, 16, 29, 68, 19, 10],
+    }
+    n_out = 5
+
+    ref = JaxEngine(cfg)
+    ref_tokens = {}
+    for rid, prompt in prompts.items():  # solo runs: same shape as serving
+        ref.add_request(
+            rid, prompt,
+            SamplingParams(temperature=0.0, max_tokens=n_out, ignore_eos=True),
+        )
+        ref_tokens.update(ref.run_to_completion())
+
+    card = ModelDeploymentCard(
+        name="tiny", kv_page_size=cfg.page_size,
+        context_length=cfg.max_context,
+    )
+
+    async def main():
+        inj = faults.install(seed=5)
+        server = FabricServer(port=0)
+        await server.start()
+        rt_d = await DistributedRuntime.create(server.address)
+        decode = Worker(
+            rt_d, card, engine_config=cfg, engine_kind="jax",
+            namespace="chaos", metrics_interval=0.1, enable_disagg=True,
+            disagg_config=DisaggConfig(
+                max_local_prefill_length=4, transfer_timeout_s=15.0
+            ),
+        )
+        await decode.start()
+        rt_p = await DistributedRuntime.create(server.address)
+        prefill = PrefillWorker(rt_p, cfg, namespace="chaos")
+        await prefill.start()
+        rt_c = await DistributedRuntime.create(server.address)
+        try:
+            ep = (
+                rt_c.namespace("chaos").component("backend")
+                .endpoint("generate")
+            )
+            router = await ep.router(mode=RouterMode.ROUND_ROBIN)
+            await router.source.wait_for_instances()
+
+            async def stream(rid):
+                tokens, finishes = [], []
+                async for item in router.generate(
+                    _req(rid, prompts[rid], n_out)
+                ):
+                    tokens.extend(item.get("token_ids", ()))
+                    if item.get("finish_reason"):
+                        finishes.append(item["finish_reason"])
+                return tokens, finishes
+
+            # 1) the prefill side dies before any byte moves, EVERY
+            #    attempt (persistent partition at transfer.send): the
+            #    redelivery cap dead-letters the request and the decode
+            #    stream ERROR-finishes fast — no infinite redelivery,
+            #    no burned transfer timeout, no hang
+            rule = inj.add_rule("transfer.send", "partition")
+            t0 = time.monotonic()
+            tokens, finishes = await asyncio.wait_for(stream("poison"), 60)
+            assert tokens == []
+            assert finishes == ["error"]
+            assert time.monotonic() - t0 < 15.0, "burned the timeout"
+            assert inj.fired.get(("transfer.send", "drop"), 0) == 3
+            assert prefill.dead_letters == 1
+
+            # 2) fault cleared: the same pipeline recovers, bit-identical
+            inj.remove_rule(rule)
+            tokens, finishes = await asyncio.wait_for(stream("recover"), 60)
+            assert tokens == ref_tokens["recover"]
+            assert finishes[-1] in ("length", "stop")
+            assert prefill.prefills_done >= 1
+
+            # 3) one transient send-drop self-heals via the bounded retry
+            done_before = prefill.prefills_done
+            inj.add_rule("transfer.send", "drop", times=1)
+            tokens, finishes = await asyncio.wait_for(stream("transient"), 60)
+            assert tokens == ref_tokens["transient"]
+            assert inj.fired.get(("transfer.send", "drop"), 0) == 4
+            assert prefill.prefills_done > done_before
+
+            # 4) a decode-side LANDING failure degrades gracefully: the
+            #    waiter fails over to local prefill (landed bytes can't
+            #    be retried into possibly-reused pages) and the client
+            #    still gets the exact reference tokens
+            inj.add_rule("transfer.land", "error", times=1)
+            tokens, finishes = await asyncio.wait_for(stream("land-fault"), 60)
+            assert tokens == ref_tokens["land-fault"]
+            assert inj.fired.get(("transfer.land", "error"), 0) == 1
+
+            # 5) the client's deadline lapses WHILE the transfer is in
+            #    flight (injected send delay): the decode side error-
+            #    finishes without ever admitting the request — no decode
+            #    flops for a dead client, no pages leaked, no unwatched
+            #    stream decoding to completion behind the error
+            free_before = await decode.runner.submit(
+                lambda e: e.allocator.num_free
+            )
+            inj.add_rule("transfer.send", "delay", times=1, delay_ms=1500.0)
+            body = dict(
+                _req("slow-transfer", prompts["slow-transfer"], n_out),
+                deadline=time.time() + 0.5,
+            )
+            tokens, finishes = [], []
+            async for item in router.generate(body):
+                tokens.extend(item.get("token_ids", ()))
+                if item.get("finish_reason"):
+                    finishes.append(item["finish_reason"])
+            assert tokens == []
+            assert finishes == ["error"]
+            deadline_chk = time.monotonic() + 10
+            while time.monotonic() < deadline_chk:
+                running, waiting, free = await decode.runner.submit(
+                    lambda e: (len(e.scheduler.running),
+                               len(e.scheduler.waiting),
+                               e.allocator.num_free)
+                )
+                if (running, waiting, free) == (0, 0, free_before):
+                    break
+                await asyncio.sleep(0.1)
+            assert (running, waiting, free) == (0, 0, free_before)
+        finally:
+            faults.uninstall()
+            router.close()
+            await prefill.stop()
+            await decode.stop()
+            await rt_c.close()
+            await rt_p.close()
+            await rt_d.close()
+            await server.stop()
+
+    asyncio.run(main())

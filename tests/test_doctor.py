@@ -59,6 +59,36 @@ FLEET = {
             "role": "decode", "last_seen_s": 0.2, "tok_s": 740.0,
             "num_running": 3, "kv_total_pages": 512,
         },
+        # draining (fresh): state=draining suppresses the dead/stalled
+        # rules — a planned wind-down must never page. The lifetime
+        # stalls_total=1 (a stall diagnosed long before the drain) must
+        # NOT read as a wedged drain.
+        "w-drain": {
+            "role": "decode", "last_seen_s": 0.4, "tok_s": 0.0,
+            "state": "draining", "num_running": 2, "stalls_total": 1,
+            "kv_total_pages": 512,
+        },
+        # draining but WEDGED: silent past the dead threshold — a drain
+        # that should long have ended still surfaces (warning), without
+        # tripping dead/stalled
+        "w-drain-wedged": {
+            "role": "decode", "last_seen_s": 42.0, "tok_s": 0.0,
+            "state": "draining", "num_running": 2, "stalls_total": 1,
+            "kv_total_pages": 512,
+        },
+        # bounded admission actively shedding -> "raise capacity"
+        "w-shed": {
+            "role": "decode", "last_seen_s": 0.2, "tok_s": 760.0,
+            "kv_total_pages": 512, "num_running": 4, "num_waiting": 6,
+            "overload_rejects": 17, "deadline_expired": 3,
+        },
+        # deep queue + the role burning budget + ZERO rejects ->
+        # "queue unbounded, enable admission caps"
+        "w-unbounded": {
+            "role": "decode", "last_seen_s": 0.2, "tok_s": 710.0,
+            "kv_total_pages": 512, "num_running": 2, "num_waiting": 40,
+            "overload_rejects": 0,
+        },
     },
     "roles": {
         "decode": {
@@ -92,6 +122,8 @@ FLIGHT = {
             for _ in range(16)
         ]},
         # w-silent: running requests, NO flight records
+        "w-shed": {"records": [_rec() for _ in range(16)]},
+        "w-unbounded": {"records": [_rec() for _ in range(16)]},
     },
 }
 
@@ -127,6 +159,25 @@ def test_rules_fire_on_the_recorded_fleet():
     assert [f["worker"] for f in by_rule["skewed-worker"]] == ["w-slow"]
     assert [f["evidence"]["role"] for f in by_rule["sla-burn"]] == ["decode"]
     assert [f["worker"] for f in by_rule["low-attainment"]] == ["w-slow"]
+    # overload fires in BOTH directions with opposite prescriptions
+    overload = {f["worker"]: f for f in by_rule["overload"]}
+    assert set(overload) == {"w-shed", "w-unbounded"}
+    assert "raise capacity" in overload["w-shed"]["action"]
+    assert overload["w-shed"]["evidence"]["overload_rejects"] == 17
+    assert "--max-waiting" in overload["w-unbounded"]["action"]
+    assert overload["w-unbounded"]["evidence"]["burn_rate"] == 5.0
+    # draining: a fresh drain is an info note; one silent past the dead
+    # threshold (or with stalls) escalates to warning — but neither ever
+    # trips the dead/stalled rules
+    draining = {f["worker"]: f for f in by_rule["draining-worker"]}
+    assert set(draining) == {"w-drain", "w-drain-wedged"}
+    assert draining["w-drain"]["severity"] == "info"
+    assert draining["w-drain-wedged"]["severity"] == "warning"
+    assert "wedged" in draining["w-drain-wedged"]["summary"]
+    assert all(
+        f["worker"] not in ("w-drain", "w-drain-wedged")
+        for f in findings if f["rule"] in ("dead-worker", "stalled-worker")
+    )
     # criticals sort first
     assert findings[0]["severity"] == "critical"
     # healthy worker triggers nothing
@@ -175,7 +226,7 @@ def test_report_renders_and_cli_runs_offline(tmp_path):
     doctor = _load_doctor()
     findings = doctor.diagnose(FLEET, FLIGHT, PROGRAMS)
     text = doctor.render_report(FLEET, findings)
-    assert "dynamo-tpu doctor: 8 worker(s)" in text
+    assert "dynamo-tpu doctor: 12 worker(s)" in text
     assert "[CRITICAL" in text and "dead-worker" in text
     assert "compile-storm @ w-storm" in text
     assert "-> " in text  # every finding carries an action

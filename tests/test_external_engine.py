@@ -162,6 +162,7 @@ def test_external_worker_serves_chat_through_distributed_stack():
     run(main())
 
 
+@pytest.mark.slow
 def test_hf_shim_script_subprocess_e2e():
     """The actual shim SCRIPT as a process: fabric + hf_worker.py +
     http frontend, completion over the wire (kv router mode)."""

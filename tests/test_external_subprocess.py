@@ -503,6 +503,7 @@ def test_http_kv_routed_e2e_with_crash_and_recovery():
     run(main())
 
 
+@pytest.mark.slow
 def test_cli_out_ext_http_serving():
     """`run in=http out=ext:...` as real CLI processes: the launcher
     spawns + supervises the engine subprocess and serves OpenAI chat."""

@@ -109,7 +109,11 @@ def test_queue_redelivery_on_worker_crash():
             assert item.header == {"req": "A"}
             await worker1.close()  # crash before ack
             item2 = await worker2.queue_pop("prefill", timeout=2)
-            assert item2 is not None and item2.header == {"req": "A"}
+            # the redelivered copy carries the broker's redelivery count
+            # (consumers cap poison items on it — docs/operations.md
+            # "Overload & draining")
+            assert item2 is not None
+            assert item2.header == {"req": "A", "redeliveries": 1}
             await worker2.queue_ack("prefill", item2.item_id)
             assert await worker2.queue_pop("prefill", timeout=0.05) is None
         finally:

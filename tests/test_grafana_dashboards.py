@@ -42,6 +42,11 @@ def _populated_expositions() -> list[str]:
         "m", "chat", "200", 0.5, input_tokens=64, output_tokens=32,
         ttft_s=0.1, itl_s=[0.01, 0.02],
     )
+    # overload plane: the shed counter family (by reason) must exist for
+    # the "Overload & degradation" row
+    fm.shed("frontend_inflight")
+    fm.shed("burn")
+    fm.shed("worker_queue_full")
 
     svc = MetricsService(_DummyFabric())
     tr = SloTracker()

@@ -177,3 +177,31 @@ def test_module_pytestmark_counts(tmp_path):
     )
     out = _run(str(tmp_path))
     assert out.returncode == 0, out.stdout + out.stderr
+
+
+def test_catches_unmarked_chaos_cluster_test(tmp_path):
+    """Chaos / fault-injection scenarios that spawn a process cluster
+    (the FT harness, the chaos cluster) are forced slow, same as gloo
+    fleets."""
+    # assembled at runtime so the substring scan never flags THIS file
+    chaos = "Chaos" + "Cluster"
+    bad = tmp_path / "test_chaos_fleet.py"
+    bad.write_text(
+        "from test_chaos import {c}\n\n"
+        "def test_chaos_without_marker():\n"
+        "    {c}(num_workers=2)\n".format(c=chaos)
+    )
+    out = _run(str(tmp_path))
+    assert out.returncode == 1
+    assert "test_chaos_without_marker" in out.stdout
+    ok = tmp_path / "test_chaos_fleet_marked.py"
+    ok.write_text(
+        "import pytest\n"
+        "from test_chaos import {c}\n\n"
+        "pytestmark = pytest.mark.slow\n\n"
+        "def test_chaos_with_marker():\n"
+        "    {c}(num_workers=2)\n".format(c=chaos)
+    )
+    bad.unlink()
+    out = _run(str(tmp_path))
+    assert out.returncode == 0, out.stdout + out.stderr

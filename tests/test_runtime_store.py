@@ -97,10 +97,11 @@ def test_local_queue_ack_nack(run):
         assert await f.queue_len("q") == 2
         item = await f.queue_pop("q", timeout=1)
         assert item.header == {"job": 1}
-        # nack -> redelivered at the front
+        # nack -> redelivered at the front, stamped with the broker's
+        # redelivery count (poison-item caps key off it)
         await f.queue_nack("q", item.item_id)
         item2 = await f.queue_pop("q", timeout=1)
-        assert item2.header == {"job": 1}
+        assert item2.header == {"job": 1, "redeliveries": 1}
         await f.queue_ack("q", item2.item_id)
         item3 = await f.queue_pop("q", timeout=1)
         assert item3.header == {"job": 2}
