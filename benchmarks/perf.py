@@ -316,6 +316,17 @@ def main(argv=None) -> None:
         help="engine mode: speculative decoding draft length (0 = off)",
     )
     p.add_argument(
+        "--spec-draft", default=None, dest="spec_draft",
+        help="engine mode: draft-model speculation (same-vocab small "
+        "model, e.g. llama3-draft; composes with overlap + mixed steps)",
+    )
+    p.add_argument(
+        "--spec-draft-tokens", type=int, default=4,
+        dest="spec_draft_tokens",
+        help="engine mode: drafts proposed per spec step (with "
+        "--spec-draft)",
+    )
+    p.add_argument(
         "--quantize", default=None, choices=["int8"],
         help="engine mode: weight-only quantization",
     )
@@ -394,6 +405,8 @@ def main(argv=None) -> None:
                 dtype=args.dtype,
                 enable_prefix_caching=False,
                 spec_ngram=args.spec_ngram,
+                spec_draft_model=args.spec_draft,
+                spec_draft_tokens=args.spec_draft_tokens,
                 quantize=args.quantize,
                 prefill_token_budget=args.prefill_budget,
                 prefill_budget_policy=args.prefill_policy,

@@ -54,6 +54,9 @@ def _engine_config(args, eos_token_ids: tuple = ()) -> EngineConfig:
         disk_kv_cache_bytes=getattr(args, "disk_kv_bytes", 0),
         disk_kv_cache_dir=getattr(args, "disk_kv_dir", None),
         spec_ngram=getattr(args, "spec_ngram", 0),
+        spec_draft_model=getattr(args, "spec_draft", None),
+        spec_draft_tokens=getattr(args, "spec_draft_tokens", 4),
+        spec_draft_checkpoint=getattr(args, "spec_draft_checkpoint", None),
         max_waiting=getattr(args, "max_waiting", None),
         overlap_decode=getattr(args, "overlap_decode", True),
         mixed_steps=getattr(args, "mixed_steps", True),
@@ -748,6 +751,28 @@ def build_parser() -> argparse.ArgumentParser:
         "--spec-ngram", type=int, default=0, dest="spec_ngram",
         help="speculative decoding: draft tokens per step proposed by "
              "prompt lookup and verified in one forward pass (0 = off)",
+    )
+    runp.add_argument(
+        "--spec-draft", default=None, dest="spec_draft",
+        help="draft-model speculative decoding: a small same-family "
+             "model (e.g. llama3-draft for llama3-1b/8b targets; must "
+             "share the target's vocabulary) proposes greedy drafts "
+             "verified + accepted ON DEVICE per decode step — bit-exact "
+             "greedy, exact rejection sampling for temperature>0. "
+             "Composes with the overlap pipeline and mixed steps "
+             "(unlike --spec-ngram)",
+    )
+    runp.add_argument(
+        "--spec-draft-tokens", type=int, default=4,
+        dest="spec_draft_tokens",
+        help="drafts proposed and verified per spec step (with "
+             "--spec-draft; default 4)",
+    )
+    runp.add_argument(
+        "--spec-draft-checkpoint", default=None,
+        dest="spec_draft_checkpoint",
+        help="checkpoint dir for the draft weights (default: the draft "
+             "model's own default checkpoint, else random init)",
     )
     runp.add_argument(
         "--no-overlap-decode", action="store_false", dest="overlap_decode",

@@ -57,6 +57,8 @@ def output_to_dict(out: StepOutput) -> dict:
         d["cached_tokens"] = out.cached_tokens
     if out.mixed:
         d["mixed"] = True
+    if out.spec:
+        d["spec"] = True
     return d
 
 
@@ -374,6 +376,7 @@ class AsyncEngineRunner:
             self._wake.set()
             generated = 0
             mixed_seen = False
+            spec_seen = False
             async for item in self.drain(context, request.request_id, q):
                 if generated == 0:
                     sp.add_event("first_token")
@@ -381,6 +384,10 @@ class AsyncEngineRunner:
                     # at least one token rode a mixed prefill+decode step
                     mixed_seen = True
                     sp.set_attr("mixed", True)
+                if not spec_seen and item.get("spec"):
+                    # at least one token rode a speculative verify step
+                    spec_seen = True
+                    sp.set_attr("spec", True)
                 generated += len(item.get("token_ids", ()))
                 yield item
             sp.set_attr("generated_tokens", generated)

@@ -86,6 +86,27 @@ class EngineConfig:
     spec_ngram: int = 0
     #: trailing n-gram length the lookup matches on
     spec_ngram_match: int = 2
+    #: draft-model speculative decoding: a SECOND (small) model from the
+    #: same registry family proposes spec_draft_tokens greedy drafts per
+    #: decode step, and one fused program runs draft catch-up + proposal
+    #: + target verify + ON-DEVICE acceptance (bit-exact greedy; exact
+    #: rejection sampling for temperature>0 — accept draft x with prob
+    #: min(1, p_target(x)/q(x)) where q is the deterministic draft's
+    #: point mass, resample the residual otherwise, which preserves the
+    #: target sampling distribution exactly). Unlike spec_ngram, the
+    #: draft path COMPOSES with overlap_decode (the next spec dispatch
+    #: chains off the previous one's on-device outputs) and mixed_steps
+    #: (the verify program runs as the decode leg beside the prefill
+    #: chunk). None = off. The draft must share the target's vocabulary
+    #: (same tokenizer family); `--spec-draft` on the CLI.
+    spec_draft_model: Optional[str] = None
+    #: drafts proposed (and verified) per spec step; the fused program's
+    #: verify window is spec_draft_tokens+1 wide
+    spec_draft_tokens: int = 4
+    #: checkpoint dir for the draft weights (None = the draft adapter's
+    #: default checkpoint, else random init — random drafts accept at
+    #: chance and immediately hit the acceptance cooldown)
+    spec_draft_checkpoint: Optional[str] = None
     #: adaptive fallback: when a spec step's draft acceptance rate drops
     #: below this, decode reverts to the fused multi-step path for
     #: spec_cooldown_steps before probing speculation again (lookup-miss
@@ -204,6 +225,16 @@ class EngineConfig:
             raise ValueError(
                 f"kv_quantize must be None, 'int8' or 'fp8', got "
                 f"{self.kv_quantize!r}"
+            )
+        if self.spec_draft_model is not None and self.spec_ngram > 0:
+            raise ValueError(
+                "spec_draft_model and spec_ngram are mutually exclusive "
+                "speculation modes — configure one of them"
+            )
+        if self.spec_draft_model is not None and self.spec_draft_tokens < 1:
+            raise ValueError(
+                f"spec_draft_tokens must be >= 1, got "
+                f"{self.spec_draft_tokens}"
             )
         if self.prefill_budget_policy not in ("fixed", "adaptive"):
             raise ValueError(

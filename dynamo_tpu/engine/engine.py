@@ -124,6 +124,17 @@ class EngineMetrics:
     #: below spec_min_accept_rate and the engine is backing off)
     spec_skipped_ineligible: int = 0
     spec_skipped_cooldown: int = 0
+    #: live draft-acceptance rate over a ~60 s window of spec steps
+    #: (accepted/drafted; 0.0 when speculation is idle) — the lever the
+    #: effective tok/s multiplier (1 + rate*S) rides on, exported as the
+    #: dynamo_tpu_*_spec_accept_rate gauge on both Prometheus surfaces
+    spec_accept_rate: float = 0.0
+    #: drafts inside that same window — the rate's denominator/weight,
+    #: shipped so aggregators can (a) tell an actively-FAILING draft
+    #: (rate 0, window_drafted > 0) from an idle one and (b) compute the
+    #: true windowed fleet ratio as a drafted-weighted mean instead of a
+    #: lifetime ratio that never moves again
+    spec_window_drafted: int = 0
     #: step-phase wall time, cumulative ms (host-loop observability:
     #: time_*_ms − the profiler's pure program time = host overhead,
     #: see scripts/tpu_decode_profile.py / docs/PERF.md). schedule
@@ -229,6 +240,33 @@ class _InflightDecode:
     greedy: bool = False
     lp: int = -1
     bias: bool = False
+
+
+@dataclass
+class _InflightSpec:
+    """One speculatively chained spec-fused dispatch (draft-model
+    speculation composing with the overlap pipeline): its catch-up
+    window is the PREVIOUS spec dispatch's on-device outputs — out_ids
+    masked by n_acc feed the next draft+verify program with no host
+    round-trip between spec steps. It becomes the real step iff the
+    host's acceptance scan of the previous step agreed with the
+    device's n_acc on every row (no finish/stop truncation — the device
+    cannot see those) and the decode batch is unchanged; otherwise it
+    rolls back exactly like _InflightDecode."""
+
+    reqs: tuple
+    b_bucket: int
+    out_ids: object  # device [B, S+1]
+    draft_ids: object  # device [B, S]
+    n_acc: object  # device [B] i32
+    counters_v0: object  # [B] verify-start draw counters (device or host)
+    greedy: bool = False
+    bias: bool = False
+    #: filled by the previous step's postprocess once the host confirms
+    #: the device acceptance; None means "not yet validated" and the
+    #: speculation can never be consumed
+    expected_num_tokens: Optional[tuple] = None
+    expected_out_len: Optional[tuple] = None
 
 
 class JaxEngine:
@@ -395,6 +433,23 @@ class JaxEngine:
         #: adaptive speculation: steps left on the fused path after a
         #: low-acceptance spec dispatch
         self._spec_cooldown = 0
+        #: draft-model speculation (config.spec_draft_model): a second
+        #: adapter + param tree + its own page pool. The pool shares the
+        #: TARGET allocator's page ids/accounting — request page tables
+        #: address both pools, so no second allocator exists.
+        self._spec_draft = config.spec_draft_model is not None
+        self.draft_adapter: Optional[ModelAdapter] = None
+        self.draft_params = None
+        self.draft_kv = None
+        #: chained spec dispatch in flight (overlap pipeline for the
+        #: draft path; None when idle or chaining is off)
+        self._inflight_spec: Optional[_InflightSpec] = None
+        #: (perf_t, drafted, accepted) per spec step + running sums, for
+        #: the windowed live acceptance-rate gauge
+        self._spec_window: deque = deque()  # deque imported above
+        self._spec_window_s = 60.0
+        self._spec_win_drafted = 0
+        self._spec_win_accepted = 0
         #: overlapped decode: the one speculative in-flight dispatch (or
         #: None). Off on multi-process meshes (lockstep replicas must
         #: observe identical step results before the next broadcast) and
@@ -469,6 +524,8 @@ class JaxEngine:
             )
         self.params = params
         self.kv = kv
+        if self._spec_draft:
+            self._init_draft_model(config, impl)
         # Live-MFU constants: FLOPs/token follow the ACTIVE parameters
         # (MoE: top_k of E experts — total params would overstate ~8x),
         # against the chip's public peak (nominal off-TPU so the gauge
@@ -483,6 +540,7 @@ class JaxEngine:
         m = self.metrics
         m.kv_pool_bytes = int(
             sum(x.nbytes for x in jax.tree.leaves(kv))
+            + sum(x.nbytes for x in jax.tree.leaves(self.draft_kv))
         )
         model_itemsize = jnp.dtype(
             getattr(self.adapter.config, "dtype", None)
@@ -581,6 +639,69 @@ class JaxEngine:
                 )
         return jax.tree.map(self._dev, tree)
 
+    def _init_draft_model(self, config: EngineConfig, impl: str) -> None:
+        """Load the speculation draft model (config.spec_draft_model): a
+        second adapter + param tree and a second KV pool addressed by the
+        SAME page ids as the target pool — request page tables index both,
+        so the PageAllocator's accounting covers draft pages for free.
+
+        Self-draft (draft name == target model, no draft checkpoint)
+        shares the target's param tree instead of loading a copy: zero
+        extra HBM, acceptance ~1 under greedy — the pipeline-validation /
+        upper-bound harness bench.py's spec_ab uses."""
+        if self._multiproc:
+            raise ValueError(
+                "spec_draft_model is not supported on multi-process SPMD "
+                "meshes yet (the chained dispatch feedback is per-process)"
+            )
+        self.draft_adapter = get_model(
+            config.spec_draft_model, dtype=config.dtype,
+            attention_impl=impl, mesh=self.mesh,
+        )
+        if self.draft_adapter.vocab_size != self.adapter.vocab_size:
+            raise ValueError(
+                f"draft model {config.spec_draft_model!r} has vocab "
+                f"{self.draft_adapter.vocab_size} but target "
+                f"{config.model!r} has {self.adapter.vocab_size} — "
+                "speculation requires a shared tokenizer/vocabulary"
+            )
+        ckpt = (
+            config.spec_draft_checkpoint
+            or self.draft_adapter.default_checkpoint
+        )
+        if (
+            config.spec_draft_model == config.model
+            and config.spec_draft_checkpoint is None
+        ):
+            # self-draft: share the tree. Checked BEFORE the checkpoint
+            # branch — when the model name IS a checkpoint dir/GGUF the
+            # adapter carries a default_checkpoint, and loading it again
+            # would duplicate the full target weights in HBM
+            dparams = self.params
+        elif ckpt is not None and self.draft_adapter.load_params:
+            dparams = self.draft_adapter.load_params(ckpt)
+        else:
+            logger.info(
+                "initializing random draft params for %s (acceptance "
+                "will sit at chance until real weights are loaded)",
+                config.spec_draft_model,
+            )
+            dparams = self.draft_adapter.init_params(jax.random.key(0))
+        dkv = self.draft_adapter.init_kv(config.num_pages, config.page_size)
+        if self.mesh is not None:
+            if dparams is not self.params:
+                dparams = self._put_global(
+                    dparams,
+                    shardings_for(
+                        self.mesh, self.draft_adapter.param_specs()
+                    ),
+                )
+            dkv = self._put_global(
+                dkv, shardings_for(self.mesh, self.draft_adapter.kv_spec())
+            )
+        self.draft_params = dparams
+        self.draft_kv = dkv
+
     # -- public API --------------------------------------------------------
 
     def add_request(
@@ -645,15 +766,15 @@ class JaxEngine:
         t1 = time.perf_counter()
         self.metrics.time_schedule_ms += (t1 - t0) * 1000.0
         outputs = self._drain_doomed()
-        if self._inflight is not None and (
-            batch is None or batch.kind not in ("decode", "mixed")
-        ):
+        if batch is None or batch.kind not in ("decode", "mixed"):
             # A speculated decode step can only be the next decode step
             # or the decode half of a mixed step; a pure prefill (or a
             # drained queue) invalidates it.
-            self._discard_inflight(
-                "no batch" if batch is None else "prefill scheduled"
-            )
+            why = "no batch" if batch is None else "prefill scheduled"
+            if self._inflight is not None:
+                self._discard_inflight(why)
+            if self._inflight_spec is not None:
+                self._discard_inflight_spec(why)
         if batch is not None:
             t2 = time.perf_counter()  # after the drain: phase time is
             # dispatch+sync+postprocess only, as the field docs promise
@@ -720,10 +841,13 @@ class JaxEngine:
                 )
         if self._profile is not None and batch is not None:
             self._profile_count()  # one dispatched step captured
-        if self._inflight is not None and not self.scheduler.has_work:
+        if not self.scheduler.has_work:
             # the wave ended on a sampled stop the speculation couldn't
-            # predict: drop the dangling dispatch so device arrays free
-            self._discard_inflight("idle")
+            # predict: drop any dangling dispatch so device arrays free
+            if self._inflight is not None:
+                self._discard_inflight("idle")
+            if self._inflight_spec is not None:
+                self._discard_inflight_spec("idle")
         self._refresh_metrics()
         return outputs
 
@@ -788,6 +912,18 @@ class JaxEngine:
         `mixed` marks outputs emitted as part of a mixed step (the
         overlap split path runs the prefill half through here)."""
         outputs: list[StepOutput] = []
+        if self._spec_draft:
+            # the draft pool prefills alongside the target pool — this
+            # also covers the prefix-cached region the target skipped
+            # (cached pages hold target KV only; the draft must compute
+            # its own), so spec_draft_pos reaches each piece's end
+            self._spec_draft_cover(
+                [
+                    (p.request, p.start + p.length)
+                    for p in batch.prefill
+                    if p.request.mm_embeds is None
+                ]
+            )
         groups: dict[int, list] = {}
         for piece in batch.prefill:
             groups.setdefault(self._bucket_t(piece.length), []).append(piece)
@@ -995,9 +1131,19 @@ class JaxEngine:
     # -- speculative decode (prompt lookup / n-gram) ------------------------
 
     def _spec_eligible(self, reqs: list[Request]) -> bool:
-        """Draft-free speculation serves all-greedy batches with no
-        logprob/penalty reporting (those paths need per-position state the
-        verify program doesn't thread)."""
+        """Draft-model speculation verifies any sampling configuration
+        the on-device accept scan threads — temperature/top-p/top-k
+        (exact rejection sampling), penalties and logit_bias/min_tokens
+        ride the same row-space plumbing as the plain programs. Only
+        logprob reporting (per-position logprob state isn't threaded)
+        and multimodal requests (the draft has no mm path) fall back to
+        plain decode. Draft-free prompt lookup keeps its greedy-only
+        restriction: its verify program has no sampling plane at all."""
+        if self._spec_draft:
+            return not any(
+                r.sampling.logprobs >= 0 or r.mm_embeds is not None
+                for r in reqs
+            )
         if self.config.spec_ngram <= 0:
             return False
         for r in reqs:
@@ -1013,6 +1159,23 @@ class JaxEngine:
             ):
                 return False
         return True
+
+    def _spec_active(self, reqs: list[Request]) -> bool:
+        """Whether THIS step's decode batch runs through a speculative
+        verify program. One bookkeeping point for eligibility + the
+        acceptance cooldown, shared by _run_decode and _run_mixed (which
+        asks before splitting the spec verify out as its decode leg) —
+        call it at most once per engine step."""
+        if not (self._spec_draft or self.config.spec_ngram > 0):
+            return False
+        if self._spec_eligible(reqs):
+            if self._spec_cooldown <= 0:
+                return True
+            self._spec_cooldown -= 1
+            self.metrics.spec_skipped_cooldown += 1
+        else:
+            self.metrics.spec_skipped_ineligible += 1
+        return False
 
     def _propose_drafts(self, req: Request, s: int) -> list[int]:
         """Prompt-lookup proposal: the s tokens that followed the LAST
@@ -1073,6 +1236,7 @@ class JaxEngine:
         if not self._grow_pages_for(reqs, s):
             return self._run_decode_plain(reqs)
 
+        t0 = time.perf_counter()
         tokens = np.zeros((b_bucket, t), np.int32)
         positions = np.zeros((b_bucket, t), np.int32)
         valid = np.zeros((b_bucket, t), bool)
@@ -1094,7 +1258,19 @@ class JaxEngine:
         target_ids, self.kv = fn(
             self.params, d_tokens, d_positions, d_valid, self.kv, d_pt,
         )
+        # timing parity with _run_decode_plain (flight-recorder deltas
+        # and the dispatch/sync/host split must not go blind under
+        # speculation): array build + launch = dispatch, the blocking
+        # device→host read = sync, the accept scan = host
+        self.metrics.time_decode_dispatch_ms += (
+            time.perf_counter() - t0
+        ) * 1000.0
+        t1 = time.perf_counter()
         target = np.asarray(target_ids)  # [B, t]
+        self.metrics.time_decode_sync_ms += (
+            time.perf_counter() - t1
+        ) * 1000.0
+        t2 = time.perf_counter()
         outputs: list[StepOutput] = []
         step_drafted = step_accepted = 0
         for i, req in enumerate(reqs):
@@ -1111,10 +1287,11 @@ class JaxEngine:
             step_drafted += s
             step_accepted += len(accepted) - 1
             req.num_computed_tokens += len(accepted)
-            outputs.extend(self._accept_tokens(req, accepted, finish))
+            outputs.extend(
+                self._accept_tokens(req, accepted, finish, spec=True)
+            )
             self._register_pages(req)
-        self.metrics.spec_drafted += step_drafted
-        self.metrics.spec_accepted += step_accepted
+        self._note_spec_step(step_drafted, step_accepted)
         if (
             step_drafted
             and step_accepted / step_drafted < self.config.spec_min_accept_rate
@@ -1122,17 +1299,389 @@ class JaxEngine:
             # Lookup is missing on this workload: revert to fused multi-
             # step decode for a while, then probe speculation again.
             self._spec_cooldown = self.config.spec_cooldown_steps
+        self.metrics.time_decode_host_ms += (
+            time.perf_counter() - t2
+        ) * 1000.0
         return outputs
+
+    # -- speculative decode (draft model, fused on-device acceptance) ------
+
+    def _note_spec_step(self, drafted: int, accepted: int) -> None:
+        """Counters + the sliding window behind the live acceptance-rate
+        gauge (shared by the prompt-lookup and draft-model paths)."""
+        self.metrics.spec_drafted += drafted
+        self.metrics.spec_accepted += accepted
+        self._spec_window.append((time.perf_counter(), drafted, accepted))
+        self._spec_win_drafted += drafted
+        self._spec_win_accepted += accepted
+
+    def _spec_draft_cover(self, spans) -> None:
+        """Bring the DRAFT pool's KV up to date over `spans` = [(req,
+        upto)]: chunked draft-model forward (KV writes only) over
+        [req.spec_draft_pos, upto). The target prefill path calls this
+        per piece — so the draft rides every prefill step, including the
+        prefix-cached region the target skipped — and the spec decode
+        path calls it when a request arrives in decode with a stale
+        draft pool (disagg add_prefilled, fused-mixed prefills during an
+        acceptance cooldown). Chunk r of every span runs before chunk
+        r+1 of any (a mid-sequence chunk's attention reads the previous
+        chunk's KV); within a round chunks batch by T bucket exactly
+        like _run_prefill."""
+        chunk = self.config.prefill_chunk
+        mp = self.config.max_pages_per_seq
+        rounds: list[list[tuple]] = []
+        for req, upto in spans:
+            start = req.spec_draft_pos
+            r = 0
+            while start < upto:
+                take = min(chunk, upto - start)
+                if r >= len(rounds):
+                    rounds.append([])
+                rounds[r].append((req, start, take))
+                start += take
+                r += 1
+            req.spec_draft_pos = max(req.spec_draft_pos, upto)
+        for round_items in rounds:
+            groups: dict[int, list] = {}
+            for item in round_items:
+                groups.setdefault(self._bucket_t(item[2]), []).append(item)
+            for t_bucket, items in sorted(groups.items()):
+                b_bucket = self._bucket_b(len(items))
+                tokens = np.zeros((b_bucket, t_bucket), np.int32)
+                positions = np.zeros((b_bucket, t_bucket), np.int32)
+                valid = np.zeros((b_bucket, t_bucket), bool)
+                pt = np.zeros((b_bucket, mp), np.int32)
+                for i, (req, start, length) in enumerate(items):
+                    tokens[i, :length] = req.all_tokens[start : start + length]
+                    positions[i] = (
+                        np.arange(t_bucket, dtype=np.int32) + start
+                    )
+                    valid[i, :length] = True
+                    pt[i, : len(req.pages)] = req.pages
+                first_chunk = all(it[1] == 0 for it in items)
+                fn = self._get_step_fn(
+                    "spec_draft_prefill", b_bucket, t_bucket,
+                    first_chunk=first_chunk,
+                )
+                d_tokens, d_positions, d_valid, d_pt = self._dev_tree(
+                    (tokens, positions, valid, pt)
+                )
+                self.draft_kv = fn(
+                    self.draft_params, d_tokens, d_positions, d_valid,
+                    self.draft_kv, d_pt,
+                )
+
+    def _run_decode_spec_draft(
+        self, reqs: list[Request], mixed: bool = False
+    ) -> list[StepOutput]:
+        """One draft-model spec step: a single fused program runs draft
+        catch-up (the tokens accepted since the draft's last committed
+        position) + S greedy draft proposals + the target verify forward
+        + ON-DEVICE acceptance (bit-exact argmax for greedy rows, exact
+        rejection sampling otherwise — sampling.spec_accept_step). Per
+        request 1..S+1 tokens land per step. Composes with the overlap
+        pipeline: when the batch is stable the NEXT spec dispatch chains
+        off this one's device outputs (accepted window = out_ids masked
+        by n_acc) before this one's ids reach the host."""
+        s = self.config.spec_draft_tokens
+        w = s + 1
+        mp = self.config.max_pages_per_seq
+        cap_tokens = mp * self.config.page_size
+        for req in reqs:
+            if req.num_tokens + s > min(cap_tokens, self.config.max_context):
+                self._discard_inflight_spec("window over context cap")
+                return self._run_decode_plain(reqs, mixed=mixed)
+        if not self._grow_pages_for(reqs, s):
+            self._discard_inflight_spec("page pressure")
+            return self._run_decode_plain(reqs, mixed=mixed)
+        if self._inflight is not None:
+            # a plain speculative dispatch (primed during a cooldown)
+            # cannot serve the verify path
+            self._discard_inflight("spec verify owns the decode batch")
+        spans = [
+            (req, req.num_tokens - 1)
+            for req in reqs
+            if req.num_tokens - req.spec_draft_pos > w
+        ]
+        if spans:
+            self._spec_draft_cover(spans)
+        b_bucket = self.config.decode_bucket_for(len(reqs))
+        inflight, self._inflight_spec = self._inflight_spec, None
+        if inflight is not None:
+            if self._spec_inflight_matches(inflight, reqs):
+                # the chained dispatch IS this step: chain the next one
+                # (device never drains), then materialize the lagged ids
+                self.metrics.overlap_hits += 1
+                self._maybe_chain_spec(
+                    reqs, b_bucket, inflight.out_ids, inflight.n_acc,
+                    inflight.counters_v0, greedy=inflight.greedy,
+                    bias=inflight.bias,
+                )
+                t1 = time.perf_counter()
+                out = np.asarray(inflight.out_ids)
+                drafts = np.asarray(inflight.draft_ids)
+                n_acc = np.asarray(inflight.n_acc)
+                self.metrics.time_decode_sync_ms += (
+                    time.perf_counter() - t1
+                ) * 1000.0
+                return self._spec_postprocess(
+                    reqs, out, drafts, n_acc, mixed=mixed
+                )
+            self._inflight_spec = inflight
+            self._discard_inflight_spec("decode batch changed")
+        t0 = time.perf_counter()
+        win_tokens = np.zeros((b_bucket, w), np.int32)
+        win_len = np.zeros(b_bucket, np.int32)
+        pos0 = np.zeros(b_bucket, np.int32)
+        pt = np.zeros((b_bucket, mp), np.int32)
+        for i, req in enumerate(reqs):
+            toks = req.all_tokens[req.spec_draft_pos :]
+            win_tokens[i, : len(toks)] = toks
+            win_len[i] = len(toks)
+            pos0[i] = req.spec_draft_pos
+            pt[i, : len(req.pages)] = req.pages
+        samp, all_greedy = self._sampling_arrays(reqs, pad_to=b_bucket)
+        pen = self._batch_penalty_bucket(reqs)
+        pen_args = self._penalty_arrays(reqs, b_bucket, pen) if pen else ()
+        bias = self._batch_bias(reqs)
+        bias_kwargs = self._bias_arrays(reqs, b_bucket) if bias else {}
+        host = {
+            "base": (win_tokens, win_len, pos0, pt),
+            "samp": samp, "pen": pen_args, "bias": bias_kwargs,
+        }
+        dev = self._dev_tree(host)
+        d_tokens, d_len, d_pos0, d_pt = dev["base"]
+        fn = self._get_step_fn(
+            "spec_fused", b_bucket, w, greedy=all_greedy, pen=pen,
+            bias=bias,
+        )
+        out_ids, draft_ids, n_acc, self.kv, self.draft_kv = fn(
+            self.params, self.draft_params, d_tokens, d_len, d_pos0,
+            self.kv, self.draft_kv, d_pt, *dev["samp"], *dev["pen"],
+            **dev["bias"],
+        )
+        self.metrics.time_decode_dispatch_ms += (
+            time.perf_counter() - t0
+        ) * 1000.0
+        # keep the device busy past this step BEFORE blocking on its
+        # result (same discipline as _run_decode_plain)
+        self._maybe_chain_spec(
+            reqs, b_bucket, out_ids, n_acc, samp[4],
+            greedy=all_greedy, bias=bias,
+        )
+        t1 = time.perf_counter()
+        out = np.asarray(out_ids)
+        drafts = np.asarray(draft_ids)
+        n_acc_h = np.asarray(n_acc)
+        self.metrics.time_decode_sync_ms += (
+            time.perf_counter() - t1
+        ) * 1000.0
+        return self._spec_postprocess(reqs, out, drafts, n_acc_h, mixed=mixed)
+
+    def _spec_postprocess(
+        self, reqs: list[Request], out: np.ndarray, drafts: np.ndarray,
+        n_acc: np.ndarray, mixed: bool = False,
+    ) -> list[StepOutput]:
+        """Host half of a draft-spec step: the same accept loop as the
+        prompt-lookup path (accept matched drafts + the device's token at
+        the first mismatch — the on-device scan already made out[i, j]
+        the canonical token at each position), plus chain validation: a
+        finish/stop truncation the device could not see invalidates the
+        chained next dispatch."""
+        t0 = time.perf_counter()
+        s = self.config.spec_draft_tokens
+        outputs: list[StepOutput] = []
+        step_drafted = step_accepted = 0
+        chain = self._inflight_spec  # the dispatch chained for the NEXT step
+        chain_ok = chain is not None
+        for i, req in enumerate(reqs):
+            accepted: list[int] = []
+            finish: Optional[FinishReason] = None
+            for j in range(s + 1):
+                tok = int(out[i, j])
+                accepted.append(tok)
+                finish = self._finish_reason_for(req, tok, len(accepted))
+                if finish is not None:
+                    break
+                if j < s and int(drafts[i, j]) != tok:
+                    break
+            step_drafted += s
+            step_accepted += len(accepted) - 1
+            # catch-up committed through the old last token; the accepted
+            # tokens are the next step's window
+            req.spec_draft_pos = req.num_tokens
+            req.num_computed_tokens += len(accepted)
+            if finish is not None or len(accepted) != int(n_acc[i]):
+                chain_ok = False
+            outputs.extend(
+                self._accept_tokens(
+                    req, accepted, finish, mixed=mixed, spec=True
+                )
+            )
+            self._register_pages(req)
+        self._note_spec_step(step_drafted, step_accepted)
+        if chain is not None:
+            if chain_ok:
+                chain.expected_num_tokens = tuple(
+                    r.num_tokens for r in reqs
+                )
+                chain.expected_out_len = tuple(
+                    len(r.output_tokens) for r in reqs
+                )
+            else:
+                self._discard_inflight_spec("acceptance diverged or finish")
+        if (
+            step_drafted
+            and step_accepted / step_drafted
+            < self.config.spec_min_accept_rate
+        ):
+            # the draft is missing on this workload: fall back to the
+            # plain (overlapped/fused) path for a while, then probe again
+            self._spec_cooldown = self.config.spec_cooldown_steps
+            self._discard_inflight_spec("acceptance cooldown")
+        self.metrics.time_decode_host_ms += (
+            time.perf_counter() - t0
+        ) * 1000.0
+        return outputs
+
+    def _maybe_chain_spec(
+        self, reqs: list[Request], b_bucket: int, out_ids, n_acc,
+        counters_v0, greedy: bool, bias: bool,
+    ) -> None:
+        """Dispatch the NEXT spec step before the pending one's ids reach
+        the host: its catch-up window is the pending step's accepted
+        tokens, derived ON DEVICE from (out_ids, n_acc) — the same
+        token-feedback trick the plain overlap loop uses, generalized to
+        a data-dependent window length. Only when the scheduler
+        guarantees batch stability (mixed steps count: the chained
+        dispatch lands as the decode leg of the next mixed step), no
+        request can finish inside the pending window's worst case, pages
+        can pre-grow to cover both windows, and no penalty history (host
+        state) is in play."""
+        if not self._overlap_enabled:
+            return
+        if not self.scheduler.decode_batch_stable():
+            if not (
+                self._mixed_enabled
+                and self.scheduler.decode_rows_stable(reqs)
+            ):
+                return
+        if self._batch_penalty_bucket(reqs):
+            return
+        s = self.config.spec_draft_tokens
+        w = s + 1
+        cap = min(
+            self.config.max_context,
+            self.config.max_pages_per_seq * self.config.page_size,
+        )
+        for req in reqs:
+            sp = req.sampling
+            if (
+                len(req.output_tokens) + req.num_emitted + w
+                >= sp.max_tokens
+            ):
+                return  # the pending step may finish it
+            if req.num_tokens + w + s > cap:
+                return
+        if not self._grow_pages_for(reqs, 2 * s + 1):
+            return
+        t0 = time.perf_counter()
+        mp = self.config.max_pages_per_seq
+        pos0 = np.zeros(b_bucket, np.int32)
+        pt = np.zeros((b_bucket, mp), np.int32)
+        for i, req in enumerate(reqs):
+            pos0[i] = req.num_tokens  # accepted tokens land at n, n+1, …
+            pt[i, : len(req.pages)] = req.pages
+        samp, _ = self._sampling_arrays(reqs, pad_to=b_bucket)
+        bias_kwargs = self._bias_arrays(reqs, b_bucket) if bias else {}
+        host = {"base": (pos0, pt), "samp": samp[:4], "bias": bias_kwargs}
+        try:
+            dev = self._dev_tree(host)
+            d_pos0, d_pt = dev["base"]
+            # verify-start counters advance by the pending acceptance —
+            # a device add, no host round-trip
+            cv0 = jnp.asarray(counters_v0) + n_acc
+            fn = self._get_step_fn(
+                "spec_fused", b_bucket, w, greedy=greedy, pen=0, bias=bias,
+            )
+            out2, drafts2, nacc2, self.kv, self.draft_kv = fn(
+                self.params, self.draft_params, out_ids, n_acc, d_pos0,
+                self.kv, self.draft_kv, d_pt, *dev["samp"], cv0,
+                **dev["bias"],
+            )
+        except Exception:
+            # a failed chained dispatch must never take down the real
+            # step it was riding on: latch the pipeline off
+            logger.exception(
+                "chained spec dispatch failed; disabling overlap_decode"
+            )
+            self._overlap_enabled = False
+            return
+        for arr in (out2, drafts2, nacc2):
+            try:
+                arr.copy_to_host_async()
+            except AttributeError:
+                pass  # older jax array types; np.asarray will sync-copy
+        self.metrics.overlap_dispatches += 1
+        self._inflight_spec = _InflightSpec(
+            reqs=tuple(reqs),
+            b_bucket=b_bucket,
+            out_ids=out2,
+            draft_ids=drafts2,
+            n_acc=nacc2,
+            counters_v0=cv0,
+            greedy=greedy,
+            bias=bias,
+        )
+        self.metrics.time_decode_dispatch_ms += (
+            time.perf_counter() - t0
+        ) * 1000.0
+
+    def _spec_inflight_matches(
+        self, inflight: _InflightSpec, reqs: list[Request]
+    ) -> bool:
+        """A chained spec dispatch is this step iff the previous step's
+        postprocess validated it (host acceptance == device n_acc, no
+        finish) and the batch is the same requests, each advanced
+        exactly as validated."""
+        if inflight.expected_num_tokens is None:
+            return False
+        if len(reqs) != len(inflight.reqs):
+            return False
+        for r, spec_r, exp_nt, exp_out in zip(
+            reqs, inflight.reqs, inflight.expected_num_tokens,
+            inflight.expected_out_len,
+        ):
+            if (
+                r is not spec_r
+                or r.num_tokens != exp_nt
+                or len(r.output_tokens) != exp_out
+            ):
+                return False
+        return True
+
+    def _discard_inflight_spec(self, why: str) -> None:
+        """Roll back a chained spec dispatch. Like _discard_inflight, the
+        sampled ids are overshoot and its KV writes (target AND draft
+        pool) are benign: surviving requests' true tokens overwrite
+        those positions before any read, and freed pages' next owners
+        fully overwrite them."""
+        inflight, self._inflight_spec = self._inflight_spec, None
+        if inflight is None:
+            return
+        self.metrics.overlap_rollbacks += 1
+        logger.debug("spec chain rollback: %s", why)
 
     def _run_decode(self, batch: ScheduledBatch) -> list[StepOutput]:
         reqs = list(batch.decode)
-        if self._spec_eligible(reqs):
-            if self._spec_cooldown <= 0:
-                return self._run_decode_spec(reqs)
-            self._spec_cooldown -= 1
-            self.metrics.spec_skipped_cooldown += 1
-        elif self.config.spec_ngram > 0:
-            self.metrics.spec_skipped_ineligible += 1
+        if self._spec_active(reqs):
+            if self._spec_draft:
+                return self._run_decode_spec_draft(reqs)
+            return self._run_decode_spec(reqs)
+        if self._inflight_spec is not None:
+            # cooldown or an ineligible batch routes to the plain path:
+            # a chained spec dispatch can never land there
+            self._discard_inflight_spec("speculation inactive")
         return self._run_decode_plain(reqs)
 
     def _run_decode_plain(
@@ -1301,6 +1850,23 @@ class JaxEngine:
         has no mm variant)."""
         reqs_d = list(batch.decode)
         pieces = list(batch.prefill)
+        if self._spec_draft and self._spec_active(reqs_d):
+            # Speculation composes with mixed steps: the fused
+            # draft+verify program runs as the DECODE LEG beside the
+            # prefill chunk (two dispatches, same stall-free semantics —
+            # decode rows emit 1..S+1 tokens while the backlog drains;
+            # the chained spec dispatch consumes/primes exactly as in
+            # pure decode). The prefill half rides _run_prefill, which
+            # also keeps the draft pool covered for the pieces.
+            self.metrics.prefill_dispatches += 1
+            outputs = self._run_prefill(
+                ScheduledBatch(kind="prefill", prefill=batch.prefill),
+                mixed=True,
+            )
+            outputs += self._run_decode_spec_draft(reqs_d, mixed=True)
+            return outputs
+        if self._inflight_spec is not None:
+            self._discard_inflight_spec("speculation inactive")
         inflight = self._inflight
         use_inflight = inflight is not None and self._inflight_matches(
             inflight, reqs_d
@@ -1701,6 +2267,7 @@ class JaxEngine:
         """Public: discard any speculative in-flight decode dispatch
         (idle/stop paths; also pins the sync/overlap boundary in tests)."""
         self._discard_inflight("drained")
+        self._discard_inflight_spec("drained")
 
     # -- shared ------------------------------------------------------------
 
@@ -2206,6 +2773,179 @@ class JaxEngine:
             logger.info("compiled %s program B=%d T=%d", kind, b, t)
             return self._cache_jit(kind, cache_key, jitted)
 
+        if kind == "spec_draft_prefill":
+            draft_adapter = self.draft_adapter
+
+            def draft_pre_fn(draft_params, tokens, positions, valid,
+                             draft_kv, pt):
+                _, draft_kv = draft_adapter.forward_hidden(
+                    draft_params, tokens, positions, valid, draft_kv, pt,
+                    first_chunk=first_chunk,
+                )
+                return draft_kv
+
+            jitted = jax.jit(draft_pre_fn, donate_argnums=(4,))
+            logger.info("compiled %s program B=%d T=%d", kind, b, t)
+            return self._cache_jit(kind, cache_key, jitted)
+
+        if kind == "spec_fused":
+            # One program per spec step (docs/engine.md "Speculative
+            # decoding"): draft catch-up over the accepted window + S
+            # greedy draft proposals (on-device feedback, own KV pool) +
+            # the target verify forward over [last, d_0..d_{S-1}] + the
+            # sequential acceptance scan. The (b, t) slot carries
+            # (decode bucket, S+1). Inputs are window tokens + per-row
+            # lengths so the HOST-fed first dispatch and the DEVICE-fed
+            # chained dispatch (win_tokens=prev out_ids, win_len=prev
+            # n_acc) share one compiled program.
+            draft_adapter = self.draft_adapter
+            s_steps = self.config.spec_draft_tokens
+            vocab = adapter.vocab_size
+            b_static = b
+
+            def spec_fn(params, draft_params, win_tokens, win_len, pos0,
+                        kv, draft_kv, pt,
+                        temps, top_ps, top_ks, seeds, counters_v0,
+                        freq=None, pres=None, rep_p=None,
+                        out_toks=None, out_valid=None,
+                        bias_ids=None, bias_vals=None, bias_gated=None,
+                        min_toks=None):
+                from dynamo_tpu.engine.sampling import spec_accept_step
+
+                rows = jnp.arange(b_static)
+                w = s_steps + 1
+                w_positions = (
+                    pos0[:, None] + jnp.arange(w, dtype=jnp.int32)[None]
+                )
+                w_valid = (
+                    jnp.arange(w, dtype=jnp.int32)[None]
+                    < win_len[:, None]
+                )
+                live = win_len > 0  # padding rows never write KV
+                last_idx = jnp.maximum(win_len - 1, 0)
+                # draft catch-up: commits the window tokens' draft KV and
+                # yields the hidden state the first proposal reads
+                hid_d, draft_kv = draft_adapter.forward_hidden(
+                    draft_params, win_tokens, w_positions, w_valid,
+                    draft_kv, pt,
+                )
+                h = hid_d[rows, last_idx]
+                pos_last = pos0 + last_idx  # [B] = num_tokens - 1
+                d0 = jnp.argmax(
+                    draft_adapter.compute_logits(draft_params, h), axis=-1
+                ).astype(jnp.int32)
+                if s_steps > 1:
+
+                    def propose(carry, j):
+                        tok, dkv = carry
+                        hj, dkv = draft_adapter.forward_hidden(
+                            draft_params, tok[:, None],
+                            (pos_last + 1 + j)[:, None], live[:, None],
+                            dkv, pt,
+                        )
+                        nxt = jnp.argmax(
+                            draft_adapter.compute_logits(
+                                draft_params, hj[:, -1]
+                            ),
+                            axis=-1,
+                        ).astype(jnp.int32)
+                        return (nxt, dkv), nxt
+
+                    (_, draft_kv), rest = jax.lax.scan(
+                        propose, (d0, draft_kv),
+                        jnp.arange(s_steps - 1, dtype=jnp.int32),
+                    )
+                    draft_ids = jnp.concatenate(
+                        [d0[:, None], rest.T], axis=1
+                    )  # [B, S]
+                else:
+                    draft_ids = d0[:, None]
+                # target verify over [last accepted, d_0 .. d_{S-1}]
+                last_tok = win_tokens[rows, last_idx]
+                v_tokens = jnp.concatenate(
+                    [last_tok[:, None], draft_ids], axis=1
+                )
+                v_positions = (
+                    pos_last[:, None]
+                    + jnp.arange(w, dtype=jnp.int32)[None]
+                )
+                v_valid = jnp.broadcast_to(live[:, None], (b_static, w))
+                hid_t, kv = adapter.forward_hidden(
+                    params, v_tokens, v_positions, v_valid, kv, pt
+                )
+                bsz, tlen, hdim = hid_t.shape
+                logits = adapter.compute_logits(
+                    params, hid_t.reshape(bsz * tlen, hdim)
+                ).reshape(bsz, tlen, -1)
+                # sequential acceptance: position j emits iff every
+                # earlier draft was accepted; penalties extend their
+                # history per emitted token exactly like decode_multi
+                if pen:
+                    from dynamo_tpu.engine.sampling import (
+                        build_output_counts,
+                    )
+
+                    counts = build_output_counts(out_toks, out_valid, vocab)
+                else:
+                    counts = None
+                alive = live
+                n_acc = jnp.zeros(b_static, jnp.int32)
+                outs = []
+                for j in range(w):
+                    eff = logits[:, j]
+                    if pen:
+                        from dynamo_tpu.engine.sampling import (
+                            apply_penalties,
+                        )
+
+                        eff = apply_penalties(
+                            eff, counts, freq, pres, rep_p
+                        )
+                    if bias:
+                        from dynamo_tpu.engine.sampling import (
+                            apply_logit_bias,
+                        )
+
+                        eff = apply_logit_bias(
+                            eff, bias_ids, bias_vals, bias_gated,
+                            counters_v0 + j, min_toks,
+                        )
+                    draft_j = (
+                        draft_ids[:, j]
+                        if j < s_steps
+                        else jnp.zeros(b_static, jnp.int32)
+                    )
+                    if greedy:
+                        chosen = jnp.argmax(eff, axis=-1).astype(jnp.int32)
+                        acc = (
+                            chosen == draft_j
+                            if j < s_steps
+                            else jnp.ones(b_static, bool)
+                        )
+                    else:
+                        chosen, acc = spec_accept_step(
+                            eff, draft_j, j < s_steps, temps, top_ps,
+                            top_ks, seeds, counters_v0 + j,
+                        )
+                    outs.append(chosen)
+                    n_acc = n_acc + alive.astype(jnp.int32)
+                    if pen:
+                        counts = counts.at[rows, chosen].add(
+                            alive.astype(jnp.float32)
+                        )
+                    alive = alive & acc
+                out_ids = jnp.stack(outs, axis=1)  # [B, S+1]
+                return (
+                    rep(out_ids), rep(draft_ids), rep(n_acc), kv, draft_kv
+                )
+
+            jitted = jax.jit(spec_fn, donate_argnums=(5, 6))
+            logger.info(
+                "compiled spec_fused program B=%d S=%d greedy=%s pen=%s "
+                "bias=%s", b, s_steps, greedy, pen, bias,
+            )
+            return self._cache_jit(kind, cache_key, jitted)
+
         if kind == "prefill_nosample":
 
             def nosample_fn(params, tokens, positions, valid, kv, pt,
@@ -2337,6 +3077,7 @@ class JaxEngine:
         lps: Optional[tuple[float, ...]] = None,
         tops: Optional[tuple] = None,
         mixed: bool = False,
+        spec: bool = False,
     ) -> list[StepOutput]:
         chain = self.scheduler.chains.get(req.request_id)
         for tok in tokens:
@@ -2363,6 +3104,7 @@ class JaxEngine:
                 # usage.prompt_tokens_details.cached_tokens)
                 cached_tokens=req.num_cached_prompt_tokens if first else None,
                 mixed=mixed,
+                spec=spec,
             )
         ]
 
@@ -2815,6 +3557,20 @@ class JaxEngine:
             m.kv_pages_watermark,
         )
         m.preemptions = self.scheduler.preemptions
+        if self._spec_draft or self.config.spec_ngram > 0:
+            # live acceptance-rate gauge over the spec-step window
+            now_s = time.perf_counter()
+            sw = self._spec_window
+            while sw and now_s - sw[0][0] > self._spec_window_s:
+                _, d, a = sw.popleft()
+                self._spec_win_drafted -= d
+                self._spec_win_accepted -= a
+            m.spec_accept_rate = (
+                round(self._spec_win_accepted / self._spec_win_drafted, 4)
+                if self._spec_win_drafted
+                else 0.0
+            )
+            m.spec_window_drafted = self._spec_win_drafted
         # pre-admission deadline drops land here; the runner adds its own
         # mid-decode expiries on top (they never reach the scheduler)
         m.deadline_expired = (
@@ -2855,6 +3611,8 @@ class JaxEngine:
         "decode": ("time_decode_ms", "decode_dispatches"),
         "decode_multi": ("time_decode_ms", "decode_dispatches"),
         "spec_verify": ("time_decode_ms", "decode_dispatches"),
+        "spec_fused": ("time_decode_ms", "decode_dispatches"),
+        "spec_draft_prefill": ("time_prefill_ms", "prefill_dispatches"),
         "mixed": ("time_mixed_ms", "mixed_dispatches"),
     }
 

@@ -97,6 +97,13 @@ class Request:
     spec_index: Optional[dict] = None
     spec_ctx: Optional[list] = None
     spec_indexed_upto: int = 0
+    #: draft-model speculation (EngineConfig.spec_draft_model): number of
+    #: tokens whose DRAFT KV is committed (positions [0, spec_draft_pos)).
+    #: The draft prefill rides the target prefill; each spec step's
+    #: catch-up window re-feeds the tokens accepted since. Reset to 0 on
+    #: preemption-by-recompute (pages are released; the re-admission
+    #: prefill rebuilds both pools).
+    spec_draft_pos: int = 0
 
     @property
     def num_tokens(self) -> int:
@@ -134,3 +141,7 @@ class StepOutput:
     #: emitted by a mixed prefill+decode step (EngineConfig.mixed_steps) —
     #: surfaces as the `mixed` attribute on the engine.generate trace span
     mixed: bool = False
+    #: emitted by a speculative verify step (spec_ngram or
+    #: spec_draft_model) — surfaces as the `spec` attribute on the
+    #: engine.generate trace span
+    spec: bool = False

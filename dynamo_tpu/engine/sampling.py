@@ -133,6 +133,94 @@ def sample(
     return jnp.where(greedy, jnp.argmax(logits, axis=-1), sampled).astype(jnp.int32)
 
 
+def spec_accept_step(
+    logits: jax.Array,  # [B, V] f32 raw (penalty/bias-adjusted) target logits
+    draft: jax.Array,  # [B] i32 proposed token (ignored when has_draft=False)
+    has_draft: bool,  # static: False for the bonus position (fresh draw)
+    temperature: jax.Array,  # [B] f32 (<=0 => greedy row)
+    top_p: jax.Array,  # [B] f32
+    top_k: jax.Array,  # [B] i32
+    seeds: jax.Array,  # [B] u32
+    counters: jax.Array,  # [B] i32 draw counter for THIS position
+    k_cap: int = DEFAULT_K_CAP,
+) -> tuple[jax.Array, jax.Array]:  # (chosen [B] i32, accept [B] bool)
+    """One position of speculative rejection sampling (Leviathan et al.
+    2023 / Chen et al. 2023), specialized to a DETERMINISTIC draft: the
+    proposal q is a point mass at the draft token, so accept it with
+    probability p_eff(draft) and otherwise resample from the residual —
+    p_eff with the draft zeroed, renormalized. The marginal of the
+    emitted token is exactly p_eff for every position: p_eff(draft) from
+    acceptance plus (1-p_eff(draft)) * p_eff(y)/(1-p_eff(draft))
+    elsewhere.
+
+    p_eff here is the PRECISE distribution `sample()` draws from —
+    temperature-scaled logits truncated to the top-k_cap candidates,
+    top-p/top-k masked, softmax over the surviving candidates — so
+    spec-on sampling is distributionally identical to spec-off sampling
+    (pinned by tests/test_spec_draft.py). Greedy rows (temperature<=0)
+    take the argmax and accept iff it equals the draft — the bit-exact
+    greedy path. The bonus position (has_draft=False) draws with the
+    SAME fold_in(key(seed), counter) gumbel stream as `sample()`, so a
+    bonus token is bit-identical to what the plain sampler would have
+    drawn at that counter.
+    """
+    b, v = logits.shape
+    k_cap = min(k_cap, v)
+    greedy = temperature <= 0.0
+    safe_t = jnp.where(greedy, 1.0, jnp.maximum(temperature, 1e-6))
+    scaled = logits / safe_t[:, None]
+    cand_logits, cand_idx = jax.lax.top_k(scaled, k_cap)  # [B, K]
+    lse = jax.scipy.special.logsumexp(scaled, axis=-1, keepdims=True)
+    probs = jnp.exp(cand_logits - lse)
+    cum = jnp.cumsum(probs, axis=-1)
+    ranks = jnp.arange(k_cap)[None, :]
+    keep_p = (cum - probs) < top_p[:, None]
+    eff_k = jnp.where(top_k > 0, jnp.minimum(top_k, k_cap), k_cap)
+    keep = keep_p & (ranks < eff_k[:, None])
+    masked = jnp.where(keep, cand_logits, _NEG_INF)
+    greedy_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    def row_gumbel(seed, counter):
+        key = jax.random.fold_in(jax.random.key(seed), counter)
+        return jax.random.gumbel(key, (k_cap,), jnp.float32)
+
+    gumbel = jax.vmap(row_gumbel)(seeds, counters)  # [B, K]
+
+    if not has_draft:
+        rank = jnp.argmax(masked + gumbel, axis=-1)
+        samp_tok = jnp.take_along_axis(cand_idx, rank[:, None], axis=-1)[:, 0]
+        chosen = jnp.where(greedy, greedy_tok, samp_tok).astype(jnp.int32)
+        return chosen, jnp.ones((b,), bool)
+
+    # p_eff(draft): the draft's true mass under the kept-candidate softmax
+    kept_lse = jax.scipy.special.logsumexp(masked, axis=-1, keepdims=True)
+    is_draft = cand_idx == draft[:, None]
+    p_draft = jnp.sum(
+        jnp.where(is_draft & keep, jnp.exp(masked - kept_lse), 0.0), axis=-1
+    )
+
+    def row_u(seed, counter):
+        # accept-uniform: an extra fold keeps it independent of the
+        # gumbel stream that shares (seed, counter)
+        key = jax.random.fold_in(
+            jax.random.fold_in(jax.random.key(seed), counter), 0x5BEC
+        )
+        return jax.random.uniform(key, ())
+
+    u = jax.vmap(row_u)(seeds, counters)
+    # residual resample: p_eff restricted to kept candidates minus the
+    # draft (gumbel-argmax over masked logits == softmax-renormalized)
+    masked_excl = jnp.where(is_draft, _NEG_INF, masked)
+    has_alt = jnp.any(keep & ~is_draft, axis=-1)
+    rank = jnp.argmax(masked_excl + gumbel, axis=-1)
+    resampled = jnp.take_along_axis(cand_idx, rank[:, None], axis=-1)[:, 0]
+    accept_s = (u < p_draft) | ~has_alt
+    chosen_s = jnp.where(accept_s, draft, resampled)
+    chosen = jnp.where(greedy, greedy_tok, chosen_s).astype(jnp.int32)
+    accept = jnp.where(greedy, greedy_tok == draft, accept_s)
+    return chosen, accept
+
+
 def sample_greedy(logits: jax.Array) -> jax.Array:
     """Argmax-only fast path: when every request in the batch is greedy the
     engine compiles this instead of the sampling pipeline."""

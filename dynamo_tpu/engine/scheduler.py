@@ -440,6 +440,10 @@ class Scheduler:
         victim.output_tokens = []
         victim.num_computed_tokens = 0
         victim.num_cached_prompt_tokens = 0
+        # draft-model speculation: the draft pool's KV for this request
+        # lived in the released pages — the re-admission prefill rebuilds
+        # both pools from scratch
+        victim.spec_draft_pos = 0
         self.running.remove(victim)
         self.waiting.insert(0, victim)
         self.chains.pop(victim.request_id, None)

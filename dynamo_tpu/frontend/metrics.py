@@ -200,6 +200,13 @@ class FrontendMetrics:
         from dynamo_tpu.telemetry.watchdog import stall_counters
 
         lines.extend(stall_counters.expose_lines())
+        # speculative-decoding counters + live acceptance-rate gauge:
+        # process-global over in-process engines (single-process serving
+        # exposes them here; the metrics service mirrors the families
+        # for its own process — "both Prometheus surfaces")
+        from dynamo_tpu.telemetry import debug as _debug
+
+        lines.extend(_debug.spec_lines())  # fixed dynamo_tpu_spec_* name
         return "\n".join(lines) + "\n"
 
 

@@ -75,6 +75,17 @@ _WORKER_FIELDS = (
     ("overlap_dispatches", "counter"),
     ("overlap_hits", "counter"),
     ("overlap_rollbacks", "counter"),
+    # speculative decoding (spec_ngram / spec_draft_model): drafts
+    # proposed vs accepted — their ratio times S is the extra tokens per
+    # verify dispatch; the skip counters say WHY speculation sat out
+    # (ineligible batch vs acceptance cooldown). spec_accept_rate is the
+    # engine's live ~60 s window, not the lifetime ratio.
+    ("spec_drafted", "counter"),
+    ("spec_accepted", "counter"),
+    ("spec_skipped_ineligible", "counter"),
+    ("spec_skipped_cooldown", "counter"),
+    ("spec_accept_rate", "gauge"),
+    ("spec_window_drafted", "gauge"),
     # subprocess external-engine harness (absent on native workers):
     # supervisor lifecycle for foreign engines (docs/external_engines.md
     # "Level 2") — restarts climbing or ready=0 is a crash-looping child
@@ -111,6 +122,8 @@ _FLEET_WORKER_FIELDS = (
     "steps", "generated_tokens", "requests_received", "compiles",
     "compile_ms", "tokens_per_s", "mfu", "prefix_hit_rate",
     "stalls_total", "overload_rejects", "deadline_expired",
+    "spec_drafted", "spec_accepted", "spec_skipped_ineligible",
+    "spec_skipped_cooldown", "spec_accept_rate", "spec_window_drafted",
 )
 
 
@@ -336,6 +349,8 @@ class MetricsService:
                     role,
                     {"workers": 0, "kv_usage": [], "mfu": [],
                      "tokens_per_s": 0.0, "preemptions": 0,
+                     "spec_drafted": 0, "spec_accepted": 0,
+                     "spec_rate_num": 0.0, "spec_rate_den": 0,
                      "compiles_by_kind": {}},
                 )
                 st["workers"] += 1
@@ -345,6 +360,20 @@ class MetricsService:
                     st["mfu"].append(float(w["mfu"]))
                 st["tokens_per_s"] += float(w.get("tokens_per_s", 0.0))
                 st["preemptions"] += int(w.get("preemptions", 0))
+                st["spec_drafted"] += int(w.get("spec_drafted", 0))
+                st["spec_accepted"] += int(w.get("spec_accepted", 0))
+                # the LIVE per-role rate is the drafted-weighted mean of
+                # the workers' ~60 s windowed rates (== the true windowed
+                # accepted/drafted ratio), NOT the lifetime ratio — a
+                # draft that degrades must move this gauge within the
+                # window, and an actively-failing draft (rate 0, window
+                # drafted > 0) must weigh it down rather than vanish
+                wd = int(w.get("spec_window_drafted", 0) or 0)
+                if wd > 0:
+                    st["spec_rate_num"] += (
+                        float(w.get("spec_accept_rate", 0.0) or 0.0) * wd
+                    )
+                    st["spec_rate_den"] += wd
                 for k, v in w.get("compiles_by_kind", {}).items():
                     st["compiles_by_kind"][k] = (
                         st["compiles_by_kind"].get(k, 0) + v
@@ -371,6 +400,13 @@ class MetricsService:
                         "preemptions": (
                             None if m.get("preemptions") is None
                             else int(w.get("preemptions", 0) or 0)
+                        ),
+                        "spec": (
+                            None if m.get("spec_drafted") is None
+                            else (
+                                int(w.get("spec_drafted", 0) or 0),
+                                int(w.get("spec_accepted", 0) or 0),
+                            )
                         ),
                         "compiles": (
                             dict(w["compiles_by_kind"])
@@ -408,6 +444,13 @@ class MetricsService:
                 ),
                 "tokens_per_s": round(st["tokens_per_s"], 2),
                 "preemptions": st["preemptions"],
+                "spec_drafted": st["spec_drafted"],
+                "spec_accepted": st["spec_accepted"],
+                "spec_accept_rate": (
+                    round(st["spec_rate_num"] / st["spec_rate_den"], 4)
+                    if st["spec_rate_den"]
+                    else 0.0
+                ),
                 "compiles_by_kind": st["compiles_by_kind"],
             }
             merged = role_merged.get(role)
@@ -455,19 +498,28 @@ class MetricsService:
             # garbage wire, not a counter reset; treating it as zero
             # would fold prev now and re-add it from the next healthy
             # frame, permanently double-counting the monotonic families
-            for fam in ("preemptions", "compiles", "slo"):
-                if c[fam] is None:
-                    c[fam] = prev[fam]
+            for fam in ("preemptions", "spec", "compiles", "slo"):
+                if c.get(fam) is None:
+                    c[fam] = prev.get(fam)
             # fold ONLY the families that actually regressed (reset on a
             # worker restart) — a regression in one never implies the
             # others reset too
-            folded = {"preemptions": 0, "compiles": {}, "slo": None}
+            folded = {
+                "preemptions": 0, "spec": None, "compiles": {},
+                "slo": None,
+            }
             any_folded = False
             if (
                 prev["preemptions"] is not None
                 and (c["preemptions"] or 0) < prev["preemptions"]
             ):
                 folded["preemptions"] = prev["preemptions"]
+                any_folded = True
+            if prev.get("spec") is not None and any(
+                x < p
+                for x, p in zip(c.get("spec") or (0, 0), prev["spec"])
+            ):
+                folded["spec"] = prev["spec"]
                 any_folded = True
             if prev["compiles"] is not None and any(
                 (c["compiles"] or {}).get(k, 0) < v
@@ -486,9 +538,17 @@ class MetricsService:
 
     def _fold_retired(self, role: str, contrib: dict) -> None:
         base = self._retired_counters.setdefault(
-            role, {"preemptions": 0, "compiles": {}, "slo": [0, 0, 0, 0]}
+            role,
+            {"preemptions": 0, "spec": [0, 0], "compiles": {},
+             "slo": [0, 0, 0, 0]},
         )
         base["preemptions"] += contrib["preemptions"] or 0
+        base["spec"] = [
+            a + b
+            for a, b in zip(
+                base.get("spec", [0, 0]), contrib.get("spec") or (0, 0)
+            )
+        ]
         for k, v in (contrib["compiles"] or {}).items():
             base["compiles"][k] = base["compiles"].get(k, 0) + v
         base["slo"] = [
@@ -537,6 +597,27 @@ class MetricsService:
                  lambda role, st: (
                      st["preemptions"]
                      + retired.get(role, {}).get("preemptions", 0)
+                 )),
+                # speculation: drafted/accepted counters stay monotonic
+                # across worker churn like preemptions; the rate gauge
+                # is the LIVE fleet ratio (live workers only)
+                ("spec_drafted_total", "counter",
+                 lambda role, st: (
+                     st.get("spec_drafted", 0)
+                     + retired.get(role, {}).get("spec", [0, 0])[0]
+                 )),
+                ("spec_accepted_total", "counter",
+                 lambda role, st: (
+                     st.get("spec_accepted", 0)
+                     + retired.get(role, {}).get("spec", [0, 0])[1]
+                 )),
+                # windowed drafted-weighted mean, NOT the lifetime ratio
+                # (which would stop moving after hours of serving)
+                ("spec_accept_rate", "gauge",
+                 lambda role, st: (
+                     st["spec_rate_num"] / st["spec_rate_den"]
+                     if st.get("spec_rate_den")
+                     else 0.0
                  )),
             ):
                 vals = [
@@ -620,6 +701,12 @@ class MetricsService:
         ]
         lines += self._fabric_lines()
         lines += self._fleet_lines(assembled)
+        # process-global speculation counters (in-process engines; the
+        # per-worker fleet view is dynamo_tpu_worker_spec_* above) —
+        # the same families FrontendMetrics exposes, both surfaces
+        from dynamo_tpu.telemetry import debug as _debug
+
+        lines += _debug.spec_lines(PREFIX)
         # per-phase latency histograms (telemetry plane, process-global)
         from dynamo_tpu.telemetry import phases
 

@@ -192,6 +192,21 @@ class LlamaConfig:
         )
 
     @staticmethod
+    def llama3_draft() -> "LlamaConfig":
+        """Draft-sized Llama sharing the Llama-3 vocabulary (128256):
+        ~8% of llama3-1b's non-embedding FLOPs — the speculation draft
+        (`EngineConfig.spec_draft_model="llama3-draft"`) for the 1B/8B
+        targets. Random-init unless a distilled checkpoint is loaded
+        via spec_draft_checkpoint; a random draft accepts at chance and
+        the engine's acceptance cooldown keeps it out of the hot path."""
+        return LlamaConfig(
+            hidden_size=512, intermediate_size=2048, num_layers=4,
+            num_heads=8, num_kv_heads=4, head_dim=64,
+            tie_word_embeddings=True,
+            rope_scaling_factor=32.0,
+        )
+
+    @staticmethod
     def tiny(vocab_size: int = 256) -> "LlamaConfig":
         """For unit tests (CPU) — small enough to compare against torch."""
         return LlamaConfig(

@@ -76,6 +76,9 @@ def _kv_pages_spec(kv_quantize=None, shard_heads: bool = True):
 _LLAMA_PRESETS: dict[str, Callable[[], LlamaConfig]] = {
     "tiny": LlamaConfig.tiny,
     "llama3-1b": LlamaConfig.llama3_1b,
+    # speculation draft for the llama3 family (same 128256 vocab);
+    # serveable standalone but meant for EngineConfig.spec_draft_model
+    "llama3-draft": LlamaConfig.llama3_draft,
     "llama3-8b": LlamaConfig.llama3_8b,
     "llama3-70b": LlamaConfig.llama3_70b,
     # DeepSeek-R1-Distill-Llama-8B is architecturally Llama-3-8B.

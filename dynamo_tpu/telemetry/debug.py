@@ -58,6 +58,49 @@ def _clear_registry() -> None:
     _engines.clear()
 
 
+def spec_lines(prefix: str = "dynamo_tpu") -> list[str]:
+    """Process-global speculative-decoding exposition, summed over the
+    registered in-process engines: `{prefix}_spec_*_total` counters plus
+    the live acceptance-rate gauge. Included by BOTH Prometheus surfaces
+    (FrontendMetrics for in-process serving, MetricsService for its own
+    process) — the per-WORKER fleet view rides the metrics frames as
+    `{prefix}_worker_spec_*` instead. Always emitted (zeros when no
+    engine speculates) so dashboards and the panel-name gate see the
+    families."""
+    drafted = accepted = skip_inel = skip_cool = 0
+    rate_num = rate_den = 0.0
+    for eng in registered_engines().values():
+        m = getattr(eng, "metrics", None)
+        if m is None:
+            continue
+        drafted += getattr(m, "spec_drafted", 0)
+        accepted += getattr(m, "spec_accepted", 0)
+        skip_inel += getattr(m, "spec_skipped_ineligible", 0)
+        skip_cool += getattr(m, "spec_skipped_cooldown", 0)
+        # weight each engine's windowed rate by its windowed drafts:
+        # an ACTIVELY-FAILING draft (rate 0, window drafted > 0) must
+        # pull the aggregate down, while idle engines (window drained)
+        # must not — gating on the rate's truthiness would conflate them
+        wd = getattr(m, "spec_window_drafted", 0) or 0
+        r = getattr(m, "spec_accept_rate", None)
+        if wd > 0 and isinstance(r, (int, float)):
+            rate_num += float(r) * wd
+            rate_den += wd
+    rate = rate_num / rate_den if rate_den else 0.0
+    return [
+        f"# TYPE {prefix}_spec_drafted_total counter",
+        f"{prefix}_spec_drafted_total {drafted}",
+        f"# TYPE {prefix}_spec_accepted_total counter",
+        f"{prefix}_spec_accepted_total {accepted}",
+        f"# TYPE {prefix}_spec_skipped_ineligible_total counter",
+        f"{prefix}_spec_skipped_ineligible_total {skip_inel}",
+        f"# TYPE {prefix}_spec_skipped_cooldown_total counter",
+        f"{prefix}_spec_skipped_cooldown_total {skip_cool}",
+        f"# TYPE {prefix}_spec_accept_rate gauge",
+        f"{prefix}_spec_accept_rate {round(rate, 4)}",
+    ]
+
+
 # -- payloads -------------------------------------------------------------
 
 

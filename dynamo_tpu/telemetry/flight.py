@@ -42,6 +42,10 @@ _DELTA_FIELDS = (
     ("host_ms", "time_decode_host_ms"),
     ("overlap_hits", "overlap_hits"),
     ("overlap_rollbacks", "overlap_rollbacks"),
+    # speculative decoding (ngram or draft model): drafted/accepted per
+    # step — a record with tokens but no spec_drafted is a plain step
+    ("spec_drafted", "spec_drafted"),
+    ("spec_accepted", "spec_accepted"),
     ("compiles", "compiles"),
     ("compile_ms", "compile_ms"),
     ("preempted", "preemptions"),

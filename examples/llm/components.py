@@ -12,8 +12,10 @@ Config keys (YAML per service, see configs/):
   Worker:     model, engine (jax|echo|mock), router-mode, page-size,
               num-pages, max-context, dtype, disagg, max-local-prefill,
               prefill-chunk, prefill-budget, prefill-policy (fixed|adaptive),
-              prefill-budget-max, max-seqs, decode-steps, spec-ngram, quantize,
-              host-kv-bytes, disk-kv-bytes, disk-kv-dir, dp, tp, sp, ep
+              prefill-budget-max, max-seqs, decode-steps, spec-ngram,
+              spec-draft, spec-draft-tokens, spec-draft-checkpoint,
+              quantize, host-kv-bytes, disk-kv-bytes, disk-kv-dir,
+              dp, tp, sp, ep
   PrefillWorkerService: model + the same engine keys as Worker
 """
 
@@ -39,6 +41,9 @@ def _engine_config(cfg: dict):
         dtype=cfg.get("dtype", "bfloat16"),
         decode_steps=int(cfg.get("decode-steps", 8)),
         spec_ngram=int(cfg.get("spec-ngram", 0)),
+        spec_draft_model=cfg.get("spec-draft"),
+        spec_draft_tokens=int(cfg.get("spec-draft-tokens", 4)),
+        spec_draft_checkpoint=cfg.get("spec-draft-checkpoint"),
         quantize=cfg.get("quantize"),
         prefill_token_budget=(
             int(cfg["prefill-budget"])

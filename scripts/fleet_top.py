@@ -54,7 +54,7 @@ def render(snap: dict) -> str:
         ("WORKER", 22), ("ROLE", 8), ("MODEL", 12), ("REQ/S", 7),
         ("TOK/S", 8), ("TTFT p50/p95", 14), ("ITL p50/p95", 12),
         ("KV%", 6), ("WM", 6), ("MFU", 7), ("COMP", 5), ("PREEMPT", 7),
-        ("STALLS", 6), ("BURN", 6), ("AGE s", 6),
+        ("SPEC%", 6), ("STALLS", 6), ("BURN", 6), ("AGE s", 6),
     )
     out = [" ".join(f"{h:<{w}}" for h, w in cols)]
     for iid, w in sorted((snap.get("workers") or {}).items()):
@@ -72,6 +72,16 @@ def render(snap: dict) -> str:
             _fmt(w.get("kv_pages_watermark"), 0),
             _fmt(w.get("mfu"), 4), _fmt(w.get("compiles"), 0),
             _fmt(w.get("preemptions"), 0),
+            # live draft-acceptance rate (speculative decoding), keyed
+            # on the windowed draft count so the three states read
+            # apart: a rate (incl. "0" = actively-failing draft) while
+            # the window has drafts, "idle" when speculation ran before
+            # but the window drained, "-" when it never ran
+            (
+                _fmt((w.get("spec_accept_rate") or 0.0) * 100.0, 0)
+                if w.get("spec_window_drafted")
+                else ("idle" if w.get("spec_drafted") else "-")
+            ),
             _fmt(w.get("stalls_total"), 0),
             _fmt(burn, 1, "x") if burn is not None else "-",
             _fmt(w.get("last_seen_s")),

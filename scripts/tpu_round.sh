@@ -61,6 +61,12 @@ bench_1b_mixed() { # mixed-steps chip arm (ISSUE 5): the c=32 saturation
                    # A/B (mixed_ab extras) measured on the chip with the
                    # headline model — burst-drain ITL p95 vs XOR
                BENCH_MIXED_AB=1 run_stage bench_1b_mixed python bench.py; }
+bench_1b_spec() { # draft-model speculation chip arm (ISSUE 9): spec_ab
+                  # extras at batch<=8 with llama3-draft (random-init —
+                  # read modeled_at_accept_rate; point BENCH_SPEC_DRAFT
+                  # at a distilled draft, or =llama3-1b for the
+                  # self-draft upper bound, target >=2x)
+               BENCH_SPEC=1 run_stage bench_1b_spec python bench.py; }
 bench_8b()   { BENCH_MODEL=llama3-8b BENCH_QUANTIZE=int8 BENCH_REQUESTS=64 \
                run_stage bench_8b python bench.py; }
 transfer()   { run_stage transfer python -m benchmarks.transfer_bench --mb 64; }
@@ -80,7 +86,7 @@ disagg_ab()  { run_stage disagg_ab python -m benchmarks.disagg_bench \
                  --num-pages 1024 --max-context 4096 --max-local-prefill 256 \
                  --requests 32 --isl 1024 --osl 64 --concurrency 8; }
 
-STAGES_ALL=(bench_1b bench_1b_kvq bench_1b_mixed bench_8b transfer sweep sweep_8b sla disagg_ab)
+STAGES_ALL=(bench_1b bench_1b_kvq bench_1b_mixed bench_1b_spec bench_8b transfer sweep sweep_8b sla disagg_ab)
 # disagg A/B last: two engine processes timeshare the one chip — expect
 # contention; honest multi-chip runs need dp mesh halves or two hosts
 

@@ -125,6 +125,14 @@ bench_1b_mixed() {
   # and TTFT p50 ratios vs fixed-budget XOR scheduling
   BENCH_MIXED_AB=1 run_stage bench_1b_mixed python bench.py
 }
+bench_1b_spec() {
+  # draft-model speculation chip arm (ISSUE 9): spec_ab extras — decode
+  # tok/s A/B at batch<=8, fused draft+verify vs plain. Default draft is
+  # llama3-draft (random-init: acceptance ~chance, read the
+  # modeled_at_accept_rate curve); BENCH_SPEC_DRAFT=llama3-1b runs the
+  # self-draft upper bound (acceptance ~1, target >=2x modeled)
+  BENCH_SPEC=1 run_stage bench_1b_spec python bench.py
+}
 pallas_gate() {
   # numerics GATE: prefill logit diff + 32-step teacher-forced drift
   # (budget 0.25 / >=90% argmax agreement); exit 2 = gate failed.
@@ -139,7 +147,7 @@ transfer() {
 }
 
 STAGES=("$@")
-[ ${#STAGES[@]} -eq 0 ] && STAGES=(pallas_kernels prewarm disagg_ab sweep_8b sla_8b ft_kill routing offload bench_dsv2 decode_profile bench_1b_sweep bench_1b_kvq bench_1b_mixed pallas_gate transfer)
+[ ${#STAGES[@]} -eq 0 ] && STAGES=(pallas_kernels prewarm disagg_ab sweep_8b sla_8b ft_kill routing offload bench_dsv2 decode_profile bench_1b_sweep bench_1b_kvq bench_1b_mixed bench_1b_spec pallas_gate transfer)
 
 wait_for_tunnel
 for s in "${STAGES[@]}"; do

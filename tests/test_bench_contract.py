@@ -78,6 +78,20 @@ def test_cpu_fallback_line_is_labeled_and_carries_tpu_artifact():
     # chunk itself faster), so the floor is only a sanity bound.
     assert mab["ttft_p50_ratio"] <= 1.1, mab
     assert mab["ttft_p50_ratio"] >= 0.5, mab
+    # draft-model speculation A/B (ISSUE 9): both arms ran on the warm
+    # engine; the asserted number is the DETERMINISTIC dispatch-level
+    # model — tokens/dispatch x ms/dispatch medians, priced at the
+    # measured acceptance rate (self-draft here, acceptance ~1) — since
+    # wall ratios swing with box load. Target >= 1.5x at batch <= 8 on
+    # the CPU A/B (the chip arm bench_1b_spec is armed for the >= 2x
+    # verification).
+    sab = ex["spec_ab"]
+    assert "error" not in sab, sab
+    assert sab["batch"] <= 8
+    assert sab["spec_on"]["accept_rate"] > 0.5, sab  # self-draft
+    assert sab["spec_off"]["tok_s"] > 0
+    assert sab["modeled_decode_tok_s_ratio"] is not None, sab
+    assert sab["modeled_decode_tok_s_ratio"] >= 1.5, sab
     # kv-quant on/off A/B (ISSUE 2): both arms ran, the int8 arm's pool
     # gauges show the byte saving, and capacity_ratio reports the
     # effective-cache multiplier the quantized pages buy

@@ -1,0 +1,439 @@
+"""Draft-model speculative decoding (ISSUE 9): the fused on-device
+draft+verify+accept path (engine._run_decode_spec_draft / the
+`spec_fused` program) and its composition with the overlap pipeline and
+mixed steps.
+
+The contracts that matter:
+- greedy spec-on output is EXACTLY the plain greedy output (speculation
+  changes dispatch counts, never tokens);
+- sampled spec-on output is DISTRIBUTIONALLY the plain sampler's output
+  (rejection sampling preserves the target distribution — pinned at the
+  sampling layer where the exact distribution is computable);
+- speculation no longer auto-disables overlap_decode or mixed_steps:
+  all composition cells produce the same streams and page accounting.
+"""
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.engine import EngineConfig
+from dynamo_tpu.engine.engine import JaxEngine
+from dynamo_tpu.engine.request import SamplingParams
+
+
+def _cfg(**over):
+    base = EngineConfig.for_tests()
+    return EngineConfig(**{**base.__dict__, **over})
+
+
+def _mk(**over):
+    return JaxEngine(_cfg(**over))
+
+
+def _mk_spec(**over):
+    return _mk(spec_draft_model="tiny", spec_draft_tokens=3, **over)
+
+
+PROMPTS = [
+    [1, 2, 3, 4, 1, 2, 3, 4, 1, 2],  # repetitive
+    [9, 8, 7, 6, 5],
+    [3, 3],  # short
+]
+
+
+def _gen(eng, prompts, max_tokens=12, temperature=0.0, seed=None):
+    for i, p in enumerate(prompts):
+        eng.add_request(
+            f"r{i}", p,
+            SamplingParams(
+                temperature=temperature, max_tokens=max_tokens, seed=seed
+            ),
+        )
+    return eng.run_to_completion()
+
+
+# -- greedy bit-exactness ---------------------------------------------------
+
+
+def test_spec_draft_matches_plain_greedy_exactly():
+    plain = _gen(_mk(), PROMPTS)
+    eng = _mk_spec()
+    spec = _gen(eng, PROMPTS)
+    assert spec == plain, (spec, plain)
+    # self-draft (identical params) accepts nearly everything greedy
+    assert eng.metrics.spec_drafted > 0
+    assert eng.metrics.spec_accepted > eng.metrics.spec_drafted // 2
+
+
+def test_spec_draft_greedy_with_penalties_and_bias_bit_exact():
+    sp = SamplingParams(
+        temperature=0.0, max_tokens=10, frequency_penalty=0.5,
+        presence_penalty=0.2, repetition_penalty=1.2,
+        logit_bias=((5, 3.0),), min_tokens=3,
+    )
+    a, b = _mk_spec(), _mk()
+    a.add_request("p", [1, 2, 3, 4], sp)
+    b.add_request("p", [1, 2, 3, 4], sp)
+    assert a.run_to_completion() == b.run_to_completion()
+    # penalties no longer make the batch ineligible (the greedy-only
+    # restriction fell away) — the verify path actually ran
+    assert a.metrics.spec_drafted > 0
+    assert a.metrics.spec_skipped_ineligible == 0
+
+
+def test_spec_draft_stops_at_eos_and_max_tokens():
+    plain, spec = _mk(), _mk_spec()
+    p = [2, 4, 6, 8, 2, 4, 6, 8]
+    for eng in (plain, spec):
+        eng.add_request(
+            "a", p, SamplingParams(temperature=0.0, max_tokens=3)
+        )
+    o1 = plain.run_to_completion()["a"]
+    o2 = spec.run_to_completion()["a"]
+    assert o1 == o2 and len(o2) == 3
+
+
+def test_spec_draft_logprobs_fall_back_plain():
+    eng = _mk_spec()
+    eng.add_request(
+        "l", [1, 2, 3],
+        SamplingParams(temperature=0.0, max_tokens=4, logprobs=0),
+    )
+    out = eng.run_to_completion()
+    assert len(out["l"]) == 4
+    assert eng.metrics.spec_drafted == 0
+    assert eng.metrics.spec_skipped_ineligible > 0
+
+
+# -- distributional correctness (the acceptance-sampling lemma) -------------
+
+
+def _exact_p_eff(logits, temp, top_p, top_k, k_cap=64):
+    """The distribution sample() draws from, computed independently in
+    numpy: temperature-scaled, truncated to top-k_cap, top-p/top-k
+    masked, softmax over survivors."""
+    v = logits.shape[0]
+    scaled = logits / temp
+    order = np.argsort(-scaled, kind="stable")[: min(k_cap, v)]
+    probs_full = np.exp(scaled - scaled.max())
+    probs_full = probs_full / probs_full.sum()
+    cand_p = probs_full[order]
+    cum = np.cumsum(cand_p)
+    keep = (cum - cand_p) < top_p
+    if top_k > 0:
+        keep &= np.arange(len(order)) < top_k
+    kept = order[keep]
+    w = np.exp(scaled[kept] - scaled[kept].max())
+    p = np.zeros(v)
+    p[kept] = w / w.sum()
+    return p
+
+
+@pytest.mark.parametrize("draft_tok", [0, 3, 11])
+def test_rejection_sampling_preserves_target_distribution(draft_tok):
+    """Empirical marginal of spec_accept_step's emitted token over many
+    seeded draws == the exact effective target distribution, for a draft
+    inside the mass (0), mid-mass (3) and outside the kept set (11)."""
+    import jax
+    import jax.numpy as jnp
+
+    from dynamo_tpu.engine.sampling import spec_accept_step
+
+    rng = np.random.default_rng(1)
+    v, n = 12, 20000
+    row_logits = np.asarray(
+        sorted(rng.normal(0, 2.0, v), reverse=True), np.float32
+    )
+    temp, top_p, top_k = 0.9, 0.85, 8
+    p_exact = _exact_p_eff(row_logits, temp, top_p, top_k)
+
+    logits = jnp.broadcast_to(jnp.asarray(row_logits), (n, v))
+    args = (
+        jnp.full((n,), draft_tok, jnp.int32),
+        True,
+        jnp.full((n,), temp, jnp.float32),
+        jnp.full((n,), top_p, jnp.float32),
+        jnp.full((n,), top_k, jnp.int32),
+        jnp.arange(n, dtype=jnp.uint32),  # distinct seeds
+        jnp.zeros((n,), jnp.int32),
+    )
+    chosen, accept = jax.jit(
+        lambda lg, d, t, tp, tk, s, c: spec_accept_step(
+            lg, d, True, t, tp, tk, s, c
+        )
+    )(logits, args[0], *args[2:])
+    chosen = np.asarray(chosen)
+    accept = np.asarray(accept)
+    emp = np.bincount(chosen, minlength=v) / n
+    # per-token tolerance: 5 standard errors + a floor for zero-mass ids
+    tol = 5 * np.sqrt(p_exact * (1 - p_exact) / n) + 2e-3
+    assert np.all(np.abs(emp - p_exact) < tol), (emp, p_exact)
+    # acceptance-rate sanity: accepted fraction == p_eff(draft)
+    assert abs(accept.mean() - p_exact[draft_tok]) < 0.02
+    if p_exact[draft_tok] == 0.0:
+        # a draft outside the kept set is never emitted
+        assert not np.any(chosen == draft_tok)
+    # zero-mass tokens are never emitted (truncation semantics survive)
+    assert emp[p_exact == 0.0].sum() == 0.0
+
+
+def test_bonus_position_draw_is_bit_identical_to_plain_sampler():
+    """has_draft=False (the bonus position) uses the SAME
+    fold_in(key(seed), counter) gumbel stream as sample() — the drawn
+    token is bit-identical to the plain sampler's."""
+    import jax.numpy as jnp
+
+    from dynamo_tpu.engine.sampling import sample, spec_accept_step
+
+    rng = np.random.default_rng(2)
+    b, v = 64, 32
+    logits = jnp.asarray(rng.normal(0, 2.0, (b, v)), jnp.float32)
+    temps = jnp.full((b,), 0.8, jnp.float32)
+    top_ps = jnp.full((b,), 0.9, jnp.float32)
+    top_ks = jnp.zeros((b,), jnp.int32)
+    seeds = jnp.arange(b, dtype=jnp.uint32)
+    counters = jnp.arange(b, dtype=jnp.int32) * 3
+    plain = sample(logits, temps, top_ps, top_ks, seeds, counters)
+    bonus, acc = spec_accept_step(
+        logits, jnp.zeros((b,), jnp.int32), False, temps, top_ps, top_ks,
+        seeds, counters,
+    )
+    assert np.array_equal(np.asarray(plain), np.asarray(bonus))
+    assert bool(np.all(np.asarray(acc)))
+
+
+def test_spec_draft_sampled_deterministic_per_seed():
+    outs = []
+    for _ in range(2):
+        eng = _mk_spec()
+        outs.append(
+            _gen(eng, PROMPTS, max_tokens=10, temperature=0.8, seed=11)
+        )
+    assert outs[0] == outs[1]
+
+
+# -- composition: spec x overlap x mixed x preemption -----------------------
+
+
+def _drive_staggered(eng):
+    """Two early requests, two arriving mid-decode (forces mixed steps
+    when enabled); returns streams + final page accounting."""
+    eng.add_request(
+        "r0", [1, 2, 3, 4, 1, 2, 3, 4],
+        SamplingParams(temperature=0.0, max_tokens=14),
+    )
+    eng.add_request(
+        "r1", [9, 8, 7], SamplingParams(temperature=0.0, max_tokens=14)
+    )
+    out = {}
+    steps = 0
+    while eng.has_work or steps < 4:
+        for o in eng.step():
+            out.setdefault(o.request_id, []).extend(o.new_token_ids)
+        steps += 1
+        if steps == 3:
+            eng.add_request(
+                "r2", list(range(1, 14)),
+                SamplingParams(temperature=0.0, max_tokens=10),
+            )
+            eng.add_request(
+                "r3", [4, 4, 4, 4, 2],
+                SamplingParams(temperature=0.0, max_tokens=10),
+            )
+    return out
+
+
+def test_spec_composition_matrix_bit_exact_and_pages_clean():
+    ref_eng = _mk(overlap_decode=False, mixed_steps=False)
+    ref = _drive_staggered(ref_eng)
+    for overlap in (False, True):
+        for mixed in (False, True):
+            eng = _mk_spec(overlap_decode=overlap, mixed_steps=mixed)
+            out = _drive_staggered(eng)
+            assert out == ref, (overlap, mixed)
+            # page accounting: everything returned to the pool
+            assert eng.allocator.num_active == 0, (overlap, mixed)
+            assert eng.metrics.spec_drafted > 0
+            if mixed:
+                # the composition actually exercised mixed steps
+                assert eng.metrics.mixed_dispatches > 0
+            if overlap:
+                # the chained spec pipeline actually landed dispatches
+                assert eng.metrics.overlap_hits > 0
+
+
+def test_spec_sampled_stream_invariant_across_pipeline_toggles():
+    """The overlap chain and the mixed split dispatch the SAME fused
+    program with the same inputs — a seeded sampled stream must be
+    bit-identical across all composition cells (distributional
+    correctness is the sampling-layer test; THIS pins that the pipeline
+    plumbing never perturbs the draws)."""
+    outs = {}
+    for overlap in (False, True):
+        for mixed in (False, True):
+            eng = _mk_spec(overlap_decode=overlap, mixed_steps=mixed)
+            for i, p in enumerate(PROMPTS):
+                eng.add_request(
+                    f"r{i}", p,
+                    SamplingParams(
+                        temperature=0.7, max_tokens=10, seed=5
+                    ),
+                )
+            outs[(overlap, mixed)] = eng.run_to_completion()
+    vals = list(outs.values())
+    assert all(v == vals[0] for v in vals), outs
+
+
+def test_spec_draft_preemption_resume_matches_plain():
+    """Page pressure forcing preemption-by-recompute: the draft pool is
+    rebuilt on re-admission (spec_draft_pos reset) and streams stay
+    bit-exact vs the plain engine under the same pressure."""
+    over = dict(num_pages=12, max_pages_per_seq=8, max_seqs=4)
+    prompts = [[1, 2, 3, 4, 5, 6], [7, 8, 9, 1], [2, 4, 6, 8]]
+    plain = _mk(**over)
+    po = _gen(plain, prompts, max_tokens=12)
+    spec = _mk_spec(**over)
+    so = _gen(spec, prompts, max_tokens=12)
+    assert so == po
+    assert spec.allocator.num_active == 0
+    assert spec.scheduler.preemptions > 0  # the scenario really preempted
+
+
+def test_spec_draft_with_prefix_cache_and_chunked_prefill():
+    cfg = _cfg(
+        spec_draft_model="tiny", spec_draft_tokens=3,
+        enable_prefix_caching=True, prefill_chunk=8,
+    )
+    eng = JaxEngine(cfg)
+    long_prompt = list(range(1, 12)) + list(range(1, 12))
+    out1 = _gen(eng, [long_prompt], max_tokens=8)["r0"]
+    # same prompt again: prefix-cached admission — the draft pool must
+    # cover the cached region the target skipped
+    eng.add_request(
+        "again", long_prompt, SamplingParams(temperature=0.0, max_tokens=8)
+    )
+    out2 = eng.run_to_completion()["again"]
+    assert out2 == out1
+
+
+def test_spec_draft_cooldown_on_disagreeing_draft():
+    """A draft that disagrees with the target (different random params)
+    accepts at chance and must push decode back to the plain path."""
+    eng = _mk(
+        spec_draft_model="qwen2-vl-tiny",  # same 256 vocab, different arch
+        spec_draft_tokens=3, spec_cooldown_steps=4,
+    )
+    plain = _mk()
+    p = [11, 7, 23, 5, 17, 3, 9]
+    for e in (eng, plain):
+        e.add_request(
+            "m", p, SamplingParams(temperature=0.0, max_tokens=16)
+        )
+    assert eng.run_to_completion() == plain.run_to_completion()
+    rate = eng.metrics.spec_accepted / max(1, eng.metrics.spec_drafted)
+    if rate < eng.config.spec_min_accept_rate:
+        assert eng.metrics.spec_skipped_cooldown > 0
+
+
+# -- config validation ------------------------------------------------------
+
+
+def test_spec_modes_mutually_exclusive():
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        _cfg(spec_draft_model="tiny", spec_ngram=4)
+
+
+def test_spec_draft_vocab_mismatch_refused():
+    with pytest.raises(ValueError, match="vocab"):
+        JaxEngine(_cfg(spec_draft_model="llama3-draft"))
+
+
+# -- observability surfaces -------------------------------------------------
+
+
+def test_spec_counters_and_gauge_surface():
+    eng = _mk_spec()
+    _gen(eng, PROMPTS)
+    m = eng.metrics
+    assert m.spec_drafted > 0
+    assert 0 <= m.spec_accepted <= m.spec_drafted
+    assert 0.0 < m.spec_accept_rate <= 1.0
+    d = m.to_dict()
+    for k in (
+        "spec_drafted", "spec_accepted", "spec_skipped_ineligible",
+        "spec_skipped_cooldown", "spec_accept_rate",
+    ):
+        assert k in d
+
+
+def test_spec_metrics_on_both_prometheus_surfaces_and_fleet():
+    import time as _time
+
+    from dynamo_tpu.engine.engine import EngineMetrics
+    from dynamo_tpu.frontend.metrics import FrontendMetrics
+    from dynamo_tpu.metrics_service import MetricsService
+    from dynamo_tpu.telemetry import promlint
+
+    # frontend surface: process-global dynamo_tpu_spec_* families
+    text = FrontendMetrics().expose()
+    assert promlint.lint(text) == []
+    for name in (
+        "dynamo_tpu_spec_drafted_total",
+        "dynamo_tpu_spec_accepted_total",
+        "dynamo_tpu_spec_accept_rate",
+    ):
+        assert name in text
+
+    # metrics service: per-worker + fleet families from a frame
+    class _F:
+        pass
+
+    svc = MetricsService(_F())
+    frame = EngineMetrics().to_dict()
+    frame.update(
+        instance_id="w1", model="tiny", component="backend",
+        role="decode", spec_drafted=100, spec_accepted=63,
+        spec_accept_rate=0.63, spec_window_drafted=40,
+    )
+    svc.aggregator._latest["w1"] = (frame, _time.monotonic())
+    text = svc.expose()
+    assert promlint.lint(text) == []
+    assert "dynamo_tpu_worker_spec_drafted_total" in text
+    assert "dynamo_tpu_worker_spec_accept_rate" in text
+    assert 'dynamo_tpu_fleet_spec_drafted_total{role="decode"} 100' in text
+    assert 'dynamo_tpu_fleet_spec_accepted_total{role="decode"} 63' in text
+    assert 'dynamo_tpu_fleet_spec_accept_rate{role="decode"} 0.63' in text
+    snap = svc.fleet_snapshot()
+    w = snap["workers"]["w1"]
+    assert w["spec_drafted"] == 100 and w["spec_accepted"] == 63
+    role = snap["roles"]["decode"]
+    assert role["spec_accept_rate"] == 0.63
+
+    # the role/fleet gauge is the WINDOWED drafted-weighted mean: an
+    # actively-failing draft (rate 0, window drafted > 0) drags it down
+    # immediately — a lifetime ratio would sit at the stale value
+    frame2 = EngineMetrics().to_dict()
+    frame2.update(
+        instance_id="w2", model="tiny", component="backend",
+        role="decode", spec_drafted=5000, spec_accepted=4500,
+        spec_accept_rate=0.0, spec_window_drafted=40,
+    )
+    svc.aggregator._latest["w2"] = (frame2, _time.monotonic())
+    role = svc.fleet_snapshot()["roles"]["decode"]
+    assert role["spec_accept_rate"] == pytest.approx(0.315, abs=1e-3)
+
+
+def test_spec_outputs_flag_and_flight_deltas():
+    eng = _mk_spec()
+    eng.add_request(
+        "s", [1, 2, 3, 4, 1, 2],
+        SamplingParams(temperature=0.0, max_tokens=8),
+    )
+    saw_spec = False
+    while eng.has_work:
+        for o in eng.step():
+            if o.spec:
+                saw_spec = True
+    assert saw_spec
+    recs = eng.flight.snapshot(None)
+    assert any(r.get("spec_drafted") for r in recs)
