@@ -171,7 +171,10 @@ async def _run_http(args) -> None:
         from dynamo_tpu.runtime import DistributedRuntime
 
         rt = await DistributedRuntime.create(args.fabric)
-        watcher = ModelWatcher(rt, manager)
+        watcher = ModelWatcher(
+            rt, manager,
+            stream_replay=getattr(args, "stream_replay", False),
+        )
         await watcher.start()
     else:
         pipeline, runner = await _make_local_pipeline(args)
@@ -301,6 +304,15 @@ async def _run_worker(args) -> None:
 
         external = SubprocessEngine(args.ext_cmd, name="ext")
         await external.start()
+    mock_args = None
+    if args.out == "mock" and getattr(args, "mock_step", None):
+        from dynamo_tpu.mocker import MockEngineArgs
+
+        mock_args = MockEngineArgs(
+            page_size=args.page_size,
+            salt=args.model,
+            decode_s_per_step=args.mock_step,
+        )
     worker = Worker(
         rt,
         _card(args),
@@ -311,6 +323,7 @@ async def _run_worker(args) -> None:
         ),
         engine_kind="external" if external is not None else args.out,
         engine=external,
+        mock_args=mock_args,
         namespace=args.namespace,
         component=args.component,
         endpoint=args.endpoint,
@@ -533,6 +546,9 @@ async def _run_planner(args) -> None:
     import shlex
 
     from dynamo_tpu.planner import (
+        ClosedLoopPlanner,
+        ControlConfig,
+        ControlRunner,
         LoadPlanner,
         LocalConnector,
         PerfInterpolator,
@@ -540,7 +556,7 @@ async def _run_planner(args) -> None:
         SlaPlanner,
     )
     from dynamo_tpu.planner.planner import PlannerRunner, SlaTargets
-    from dynamo_tpu.planner.service import FleetObserver
+    from dynamo_tpu.planner.service import FleetFlipper, FleetObserver
     from dynamo_tpu.runtime import DistributedRuntime
 
     cfg = PlannerConfig(
@@ -550,7 +566,23 @@ async def _run_planner(args) -> None:
         min_prefill=args.min_prefill,
         max_prefill=args.max_prefill,
     )
-    if args.mode == "sla":
+    if args.mode == "closed":
+        planner = ClosedLoopPlanner(
+            ControlConfig(
+                interval_s=args.interval,
+                min_decode=args.min_decode,
+                max_decode=args.max_decode,
+                min_prefill=args.min_prefill,
+                max_prefill=args.max_prefill,
+                ttft_target_ms=args.ttft_ms,
+                itl_target_ms=args.itl_ms,
+                cooldown_s=args.cooldown,
+                flip_cooldown_s=args.flip_cooldown,
+                max_actions_per_tick=args.max_actions,
+                allow_flips=args.flip,
+            )
+        )
+    elif args.mode == "sla":
         if not args.perf_table:
             print("--perf-table is required in SLA mode", file=sys.stderr)
             sys.exit(2)
@@ -618,7 +650,19 @@ async def _run_planner(args) -> None:
         )
     else:
         connector = LocalConnector(spawn_cmd)
-    runner = PlannerRunner(planner, connector, observer.observe)
+    if args.mode == "closed":
+        from dynamo_tpu.subjects import PLANNER_SUBJECT
+
+        async def status_fn(frame: dict) -> None:
+            await rt.fabric.publish(PLANNER_SUBJECT, frame)
+
+        runner = ControlRunner(
+            planner, connector, observer.observe,
+            flipper=FleetFlipper(observer) if args.flip else None,
+            status_fn=status_fn,
+        )
+    else:
+        runner = PlannerRunner(planner, connector, observer.observe)
     print(
         f"planner up (mode={args.mode}, connector={args.connector}, "
         f"interval={args.interval}s)",
@@ -666,6 +710,12 @@ def build_parser() -> argparse.ArgumentParser:
         help="out=echo: seconds per emitted token (stream-timing tests)",
     )
     runp.add_argument(
+        "--mock-step", type=float, default=None, dest="mock_step",
+        help="out=mock (in=dyn): simulated engine step seconds — slows "
+             "the mock's batched decode tick for stream-timing/chaos "
+             "tests (default: MockEngineArgs.decode_s_per_step)",
+    )
+    runp.add_argument(
         "--max-waiting", type=int, default=None, dest="max_waiting",
         help="bounded admission: cap on the engine's waiting queue — a "
              "full queue answers 'overloaded' (HTTP 429 + Retry-After at "
@@ -693,6 +743,16 @@ def build_parser() -> argparse.ArgumentParser:
              "exactly), shed best-effort requests (x-priority < 1) with "
              "probability ramping to 100%% at 2x the threshold "
              "(default: off)",
+    )
+    runp.add_argument(
+        "--stream-replay", action="store_true", dest="stream_replay",
+        help="crash-replayed streams (frontend, in=http out=dyn): when "
+             "a worker dies mid-stream, re-dispatch the request to a "
+             "survivor as prompt + tokens-emitted-so-far — the client "
+             "stream continues with no duplicate and no missing token "
+             "(bit-identical for greedy; sampled streams resume under a "
+             "derived seed). Default off; router behavior is identical "
+             "to before when off",
     )
     runp.add_argument(
         "--drain-budget", type=float, default=30.0, dest="drain_budget",
@@ -1005,7 +1065,33 @@ def build_parser() -> argparse.ArgumentParser:
 
     planp = sub.add_parser("planner", help="autoscale the worker fleet")
     planp.add_argument("--fabric", required=True, help="fabric host:port")
-    planp.add_argument("--mode", default="load", choices=["load", "sla"])
+    planp.add_argument(
+        "--mode", default="load", choices=["load", "sla", "closed"],
+        help="load: KV/queue thresholds; sla: offline perf tables; "
+             "closed: the live closed loop — scales on the fleet's "
+             "OBSERVED SLO burn/attainment (worker SLO sketches) with "
+             "hysteresis bands, per-role cooldowns, and a per-tick "
+             "action clamp (docs/operations.md 'Closed-loop "
+             "autoscaling & role flips')",
+    )
+    planp.add_argument(
+        "--flip", action="store_true",
+        help="closed mode: prefer flipping an idle worker between "
+             "prefill/decode roles (drain + re-register; hot KV pages "
+             "survive) over kill+spawn. Default off.",
+    )
+    planp.add_argument(
+        "--cooldown", type=float, default=30.0,
+        help="closed mode: seconds between scale actions on one role",
+    )
+    planp.add_argument(
+        "--flip-cooldown", type=float, default=60.0, dest="flip_cooldown",
+        help="closed mode: seconds between role flips fleet-wide",
+    )
+    planp.add_argument(
+        "--max-actions", type=int, default=2, dest="max_actions",
+        help="closed mode: hard per-tick actuation clamp (scales+flips)",
+    )
     planp.add_argument("--namespace", default="dynamo")
     planp.add_argument("--component", default="backend")
     planp.add_argument("--interval", type=float, default=10.0)

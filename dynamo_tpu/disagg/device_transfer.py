@@ -74,6 +74,20 @@ def mode() -> str:
     return m if m in ("auto", "host", "device") else "auto"
 
 
+def available() -> bool:
+    """True when this jax build ships the transfer-server module the
+    device plane is built on (jax.experimental.transfer). Some builds —
+    including the baked CPU toolchain in CI containers — omit it; tests
+    that force DYN_KV_TRANSFER=device gate on this instead of failing
+    collection-deep with an ImportError."""
+    import importlib.util
+
+    try:
+        return importlib.util.find_spec("jax.experimental.transfer") is not None
+    except (ImportError, ModuleNotFoundError, ValueError):
+        return False
+
+
 class DevicePlane:
     """Process-wide wrapper around jax.experimental.transfer.
 

@@ -37,6 +37,7 @@ class PrefillWorker:
         checkpoint_path: Optional[str] = None,
         runner: Optional[AsyncEngineRunner] = None,
         advertise_host: str = "127.0.0.1",
+        register: bool = True,
     ):
         from dynamo_tpu.disagg import device_transfer
 
@@ -51,6 +52,11 @@ class PrefillWorker:
         self.max_concurrent = max_concurrent
         self.checkpoint_path = checkpoint_path
         self.runner = runner
+        #: embedded mode (Worker.flip_role): the host Worker owns the
+        #: runner AND the registration — this instance only consumes the
+        #: queue. stop() then leaves the borrowed runner running.
+        self._own_runner = runner is None
+        self._register = register
         self.registration = None
         self.instance_id = ""
         self.prefills_done = 0
@@ -76,16 +82,19 @@ class PrefillWorker:
             self.runner = AsyncEngineRunner(engine)
             self.runner.start()
         # Register for liveness/planner visibility (no ingress: work arrives
-        # via the queue, not pushed RPC).
-        ep = (
-            self.runtime.namespace(self.namespace)
-            .component(self.component)
-            .endpoint("prefill")
-        )
-        self.registration = await ep.register(
-            "127.0.0.1", 0, metadata={"model": self.engine_config.model}
-        )
-        self.instance_id = self.registration.instance.instance_id
+        # via the queue, not pushed RPC). Embedded mode (register=False):
+        # the host Worker registers the prefill endpoint itself, under its
+        # own instance id and dialable ingress address.
+        if self._register:
+            ep = (
+                self.runtime.namespace(self.namespace)
+                .component(self.component)
+                .endpoint("prefill")
+            )
+            self.registration = await ep.register(
+                "127.0.0.1", 0, metadata={"model": self.engine_config.model}
+            )
+            self.instance_id = self.registration.instance.instance_id
         loop = asyncio.get_running_loop()
         self._task = loop.create_task(self._consume_loop())
         # No ingress here — admin flush arrives as a fabric broadcast.
@@ -304,5 +313,5 @@ class PrefillWorker:
         self.transfer.close()
         if self.registration is not None:
             await self.registration.deregister()
-        if self.runner is not None:
+        if self.runner is not None and self._own_runner:
             self.runner.stop()

@@ -456,9 +456,15 @@ class ModelManager:
 class ModelWatcher:
     """Attach/detach models from MODEL_ROOT watch events."""
 
-    def __init__(self, runtime, manager: ModelManager):
+    def __init__(self, runtime, manager: ModelManager,
+                 stream_replay: bool = False):
         self.runtime = runtime
         self.manager = manager
+        #: crash-replayed streams (--stream-replay, default OFF): the
+        #: generate PushRouter re-dispatches a mid-stream worker death
+        #: to a survivor as prompt+emitted-tokens so the client stream
+        #: continues uninterrupted (docs/operations.md)
+        self.stream_replay = stream_replay
         self._task: Optional[asyncio.Task] = None
         #: model -> set of entry keys currently backing it
         self._entries: dict[str, set[str]] = {}
@@ -513,7 +519,8 @@ class ModelWatcher:
             )
             await kv_router.start()
             router = PushRouter(
-                src, ep.name, mode=mode, kv_chooser=kv_router.choose
+                src, ep.name, mode=mode, kv_chooser=kv_router.choose,
+                replay=self.stream_replay,
             )
             self.manager.add(
                 entry.model,
@@ -523,7 +530,7 @@ class ModelWatcher:
                 ),
             )
             return
-        router = await ep.router(mode=mode)
+        router = await ep.router(mode=mode, replay=self.stream_replay)
         self.manager.add(
             entry.model,
             router_pipeline(card, router, fabric=self.runtime.fabric),

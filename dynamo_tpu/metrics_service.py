@@ -21,7 +21,7 @@ from typing import Optional
 from aiohttp import web
 
 from dynamo_tpu.kv_router.metrics_aggregator import MetricsAggregator
-from dynamo_tpu.subjects import KV_HIT_RATE_SUBJECT
+from dynamo_tpu.subjects import KV_HIT_RATE_SUBJECT, PLANNER_SUBJECT
 
 logger = logging.getLogger(__name__)
 
@@ -113,6 +113,9 @@ _WORKER_FIELDS = (
     # deep num_waiting with zero rejects = queue unbounded (enable caps)
     ("overload_rejects", "counter"),
     ("deadline_expired", "counter"),
+    # role flips this worker performed (closed-loop planner actuation —
+    # docs/operations.md "Closed-loop autoscaling & role flips")
+    ("flips_total", "counter"),
 )
 
 #: numeric per-worker fields copied verbatim into the /v1/fleet snapshot
@@ -121,7 +124,7 @@ _FLEET_WORKER_FIELDS = (
     "kv_pages_watermark", "preemptions", "num_running", "num_waiting",
     "steps", "generated_tokens", "requests_received", "compiles",
     "compile_ms", "tokens_per_s", "mfu", "prefix_hit_rate",
-    "stalls_total", "overload_rejects", "deadline_expired",
+    "stalls_total", "overload_rejects", "deadline_expired", "flips_total",
     "spec_drafted", "spec_accepted", "spec_skipped_ineligible",
     "spec_skipped_cooldown", "spec_accept_rate", "spec_window_drafted",
 )
@@ -167,8 +170,16 @@ class MetricsService:
         #: empty when the fabric backend doesn't expose stats
         self.fabric_stats: dict = {}
         self.fabric_stats_interval = fabric_stats_interval
+        #: latest closed-loop planner status frame (ControlRunner.status
+        #: over PLANNER_SUBJECT) + when it arrived — serves the
+        #: dynamo_tpu_planner_* families and the /v1/fleet `planner`
+        #: section doctor's planner rules read
+        self.planner_status: Optional[dict] = None
+        self.planner_status_age: float = 0.0
         self._sub = None
+        self._planner_sub = None
         self._task: Optional[asyncio.Task] = None
+        self._planner_task: Optional[asyncio.Task] = None
         self._stats_task: Optional[asyncio.Task] = None
         self._runner: Optional[web.AppRunner] = None
 
@@ -179,6 +190,10 @@ class MetricsService:
             await agg.start()
         self._sub = await self.fabric.subscribe(KV_HIT_RATE_SUBJECT)
         self._task = asyncio.get_running_loop().create_task(self._pump())
+        self._planner_sub = await self.fabric.subscribe(PLANNER_SUBJECT)
+        self._planner_task = asyncio.get_running_loop().create_task(
+            self._planner_pump()
+        )
         if hasattr(self.fabric, "stats"):
             self._stats_task = asyncio.get_running_loop().create_task(
                 self._poll_fabric_stats()
@@ -204,6 +219,10 @@ class MetricsService:
             self._sub.close()
         if self._task is not None:
             self._task.cancel()
+        if self._planner_sub is not None:
+            self._planner_sub.close()
+        if self._planner_task is not None:
+            self._planner_task.cancel()
         if self._stats_task is not None:
             self._stats_task.cancel()
         for agg in self.aggregators:
@@ -228,6 +247,81 @@ class MetricsService:
             self.hit_events += 1
             self.isl_tokens_total += isl
             self.overlap_tokens_total += overlap
+
+    async def _planner_pump(self) -> None:
+        """Latest-wins consumer of the planner's status frames. A
+        malformed frame is logged and skipped — the planner section
+        degrades to its previous value, never kills the pump."""
+        import time as _time
+
+        while True:
+            msg = await self._planner_sub.next()
+            if msg is None:
+                return
+            frame = getattr(msg, "header", None)
+            if not isinstance(frame, dict):
+                logger.warning("malformed planner frame: %r", frame)
+                continue
+            self.planner_status = frame
+            self.planner_status_age = _time.monotonic()
+
+    def _planner_doc(self) -> Optional[dict]:
+        import time as _time
+
+        if self.planner_status is None:
+            return None
+        return {
+            **self.planner_status,
+            "last_seen_s": round(
+                _time.monotonic() - self.planner_status_age, 3
+            ),
+        }
+
+    def _planner_lines(self) -> list[str]:
+        """`dynamo_tpu_planner_*`: the closed-loop autoscaler's own
+        exposition — pool targets vs observed, decision counters, flip
+        count, SLO signals vs setpoint (the Grafana "Planner" row)."""
+        p = self.planner_status
+        if not isinstance(p, dict):
+            return []
+        lines: list[str] = []
+
+        def fam(name: str, ptype: str, samples: list) -> None:
+            samples = [(lab, v) for lab, v in samples if v is not None]
+            if not samples:
+                return
+            lines.append(f"# TYPE {PREFIX}_planner_{name} {ptype}")
+            for lab, v in samples:
+                label = f"{{{lab}}}" if lab else ""
+                lines.append(f"{PREFIX}_planner_{name}{label} {v}")
+
+        targets = p.get("targets") or {}
+        observed = p.get("observed") or {}
+        fam("pool_target", "gauge", [
+            (f'role="{r}"', targets.get(r)) for r in sorted(targets)
+        ])
+        fam("pool_observed", "gauge", [
+            (f'role="{r}"', observed.get(r)) for r in sorted(observed)
+        ])
+        decisions = p.get("decisions_total") or {}
+        fam("decisions_total", "counter", [
+            (f'action="{a}"', decisions.get(a)) for a in sorted(decisions)
+        ])
+        fam("flips_total", "counter", [("", p.get("flips_total", 0))])
+        fam("actions_clamped_total", "counter",
+            [("", p.get("actions_clamped_total", 0))])
+        fam("cooldown_holds_total", "counter",
+            [("", p.get("cooldown_holds_total", 0))])
+        signals = p.get("signals") or {}
+        setpoint = p.get("setpoint") or {}
+        fam("sla_attainment", "gauge",
+            [("", signals.get("sla_attainment"))])
+        fam("burn_rate", "gauge", [("", signals.get("burn_rate"))])
+        fam("attainment_setpoint", "gauge",
+            [("", setpoint.get("attainment"))])
+        fam("burn_high_ticks", "gauge", [("", p.get("burn_high_ticks"))])
+        fam("at_max", "gauge", [("", int(bool(p.get("at_max"))))])
+        return lines
 
     async def _poll_fabric_stats(self) -> None:
         """Broker self-metrics: poll the fabric's `stats` op (RemoteFabric
@@ -467,6 +561,9 @@ class MetricsService:
                 ),
             },
         }
+        planner = self._planner_doc()
+        if planner is not None:
+            doc["planner"] = planner
         return doc, role_merged, role_stats
 
     def _fold_departed(self, snap: dict, contribs: dict) -> None:
@@ -701,6 +798,7 @@ class MetricsService:
         ]
         lines += self._fabric_lines()
         lines += self._fleet_lines(assembled)
+        lines += self._planner_lines()
         # process-global speculation counters (in-process engines; the
         # per-worker fleet view is dynamo_tpu_worker_spec_* above) —
         # the same families FrontendMetrics exposes, both surfaces

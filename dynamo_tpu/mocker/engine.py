@@ -71,6 +71,8 @@ class MockEngine:
         self,
         args: MockEngineArgs = MockEngineArgs(),
         on_kv_event: Optional[Callable[[KvEvent], None]] = None,
+        sla=None,
+        slo_windows=None,
     ):
         self.args = args
         self.allocator = PageAllocator(
@@ -78,10 +80,22 @@ class MockEngine:
         )
         self.active_requests = 0
         self.requests_received = 0
+        self.generated_tokens = 0
         self.preemptions = 0
         self._waiting: deque[_Req] = deque()
         self._running: list[_Req] = []
         self._loop_task: Optional[asyncio.Task] = None
+        #: real SLO plane (telemetry/slo.py) fed with MEASURED stream
+        #: latencies — mock fleets are full citizens of the fleet
+        #: telemetry plane, so the closed-loop planner's burn/attainment
+        #: signals work against a 500-worker mocker fleet exactly as
+        #: against JaxEngine workers (ROADMAP item 4's scale proof)
+        from dynamo_tpu.telemetry.slo import SloTracker
+
+        self.slo = SloTracker(
+            sla=sla,
+            **({"windows": tuple(slo_windows)} if slo_windows else {}),
+        )
 
     # -- queue visibility (planner/metrics) --------------------------------
 
@@ -100,6 +114,8 @@ class MockEngine:
     # -- public API ---------------------------------------------------------
 
     async def generate(self, context, request: PreprocessedRequest):
+        import time as _time
+
         a = self.args
         self.active_requests += 1
         self.requests_received += 1
@@ -116,6 +132,11 @@ class MockEngine:
         )
         self._waiting.append(req)
         self._ensure_loop()
+        # measured stream latencies feed the SLO plane: TTFT includes
+        # queue wait (the saturation signal the planner scales on)
+        t0 = _time.monotonic()
+        t_first = t_last = None
+        tokens = 0
         try:
             while True:
                 item = await req.out_q.get()
@@ -126,10 +147,38 @@ class MockEngine:
                     # raising turns a capacity rejection into a typed HTTP
                     # failure instead of an empty 200 "stop" completion.
                     raise RuntimeError(item["error"])
+                now = _time.monotonic()
+                n = len(item.get("token_ids", ()))
+                tokens += n
+                self.generated_tokens += n
+                if n:
+                    if t_first is None:
+                        t_first = now
+                        self.slo.observe("ttft_ms", (now - t0) * 1000.0)
+                    elif t_last is not None:
+                        self.slo.observe(
+                            "itl_ms", (now - t_last) * 1000.0
+                        )
+                    t_last = now
                 yield item
         finally:
             self.active_requests -= 1
             req.context = _CANCELLED  # consumer gone: step loop reaps it
+            if t_first is not None:
+                now = _time.monotonic()
+                e2e = (now - t0) * 1000.0
+                itl = (
+                    (now - t_first) / max(1, tokens - 1) * 1000.0
+                    if tokens > 1
+                    else None
+                )
+                self.slo.observe("e2e_ms", e2e)
+                self.slo.finish_request(
+                    ttft_ms=(t_first - t0) * 1000.0,
+                    itl_ms=itl,
+                    e2e_ms=e2e,
+                    tokens=tokens,
+                )
 
     # -- step loop ----------------------------------------------------------
 

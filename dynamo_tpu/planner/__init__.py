@@ -8,6 +8,11 @@ prefill-queue depth) and adjusts the decode/prefill fleet:
 - `SlaPlanner` — predicts the request rate (load predictors) and sizes the
   fleet from offline perf-interpolation tables so predicted TTFT/ITL stay
   inside targets (reference planner_sla.py + utils/perf_interpolation.py).
+- `ClosedLoopPlanner` + `ControlRunner` — the live closed loop: scales on
+  the fleet's OBSERVED SLO burn/attainment (worker SLO sketches merged by
+  the telemetry plane) with hysteresis bands, per-role cooldowns, a
+  per-tick action clamp, and role FLIPS through the drain + re-register
+  path (docs/operations.md "Closed-loop autoscaling & role flips").
 
 Actuation goes through a `Connector`: `LocalConnector` spawns/stops worker
 processes on this host (reference's circus LocalConnector,
@@ -26,7 +31,11 @@ from dynamo_tpu.planner.load_predictor import (
 )
 from dynamo_tpu.planner.perf_model import PerfInterpolator
 from dynamo_tpu.planner.planner import (
+    Actions,
+    ClosedLoopPlanner,
     Connector,
+    ControlConfig,
+    ControlRunner,
     LoadPlanner,
     LocalConnector,
     PlannerConfig,
@@ -43,8 +52,12 @@ __all__ = [
     "make_predictor",
     "PerfInterpolator",
     "PlannerConfig",
+    "ControlConfig",
+    "Actions",
     "LoadPlanner",
     "SlaPlanner",
+    "ClosedLoopPlanner",
+    "ControlRunner",
     "Connector",
     "LocalConnector",
     "RecordingConnector",

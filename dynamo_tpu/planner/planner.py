@@ -59,12 +59,15 @@ class FleetState:
     request_rate: float = 0.0
     #: OBSERVED SLA inputs (fleet telemetry plane, merged worker SLO
     #: sketches — docs/observability.md "Fleet view & SLO accounting").
-    #: None when no worker published SLO frames yet; the planner's
-    #: control loop today still runs on the perf-interpolation tables
-    #: (ROADMAP item 4 closes the loop on these).
+    #: None when no worker published SLO frames yet; the closed-loop
+    #: planner (ClosedLoopPlanner) drives on these and falls back to the
+    #: queue/KV signals above until they arrive.
     observed_ttft_p95_ms: Optional[float] = None
     observed_itl_p95_ms: Optional[float] = None
     sla_attainment: Optional[float] = None
+    #: worst (shortest-window) fleet SLO burn rate — >1 means the fleet
+    #: is spending its error budget faster than the objective allows
+    burn_rate: Optional[float] = None
 
 
 @dataclass(frozen=True)
@@ -292,6 +295,395 @@ class SlaPlanner:
             target_decode=_clamp(int(needed), c.min_decode, c.max_decode),
             target_prefill=prefill,
         )
+
+
+# -- closed-loop control (ROADMAP item 4: the live-SLO control loop) --------
+
+
+@dataclass(frozen=True)
+class ControlConfig(PlannerConfig):
+    """ClosedLoopPlanner knobs on top of the shared pool bounds.
+
+    The loop is a setpoint controller on the LIVE SLO plane (worker SLO
+    sketches merged by the fleet telemetry plane) with three
+    anti-oscillation mechanisms, each pinned by injected-clock tests:
+
+    - hysteresis band: burn above `burn_high` scales up, but scale-down
+      eligibility needs burn below `burn_low` (the dead band between
+      them HOLDS — a signal that noisily crosses one threshold cannot
+      alternate decisions);
+    - calm streak: scale-down additionally needs `down_stable_ticks`
+      consecutive calm observations (inherited knob);
+    - cooldowns (enforced by ControlRunner's clock): after any scale
+      action on a role, further actions on that role wait `cooldown_s`;
+      flips wait `flip_cooldown_s` — a flip is a double-sided action.
+    """
+
+    #: scale up when the worst-window burn rate crosses this...
+    burn_high: float = 1.0
+    #: ...and scale-down only becomes eligible below this
+    burn_low: float = 0.25
+    #: observed p95 pressure thresholds (same semantics as SlaTargets)
+    ttft_target_ms: float = 2000.0
+    itl_target_ms: float = 200.0
+    #: attainment below this blocks scale-down regardless of burn
+    attainment_setpoint: float = 0.99
+    #: seconds between scale actions on one role (ControlRunner clock)
+    cooldown_s: float = 30.0
+    #: seconds between role flips fleet-wide
+    flip_cooldown_s: float = 60.0
+    #: hard per-tick actuation clamp (scale steps + flips combined)
+    max_actions_per_tick: int = 2
+    #: prefer flipping an idle worker between roles over kill+spawn
+    allow_flips: bool = True
+
+
+@dataclass(frozen=True)
+class Actions:
+    """One tick's intent: pool targets plus role flips. Flips move an
+    EXISTING worker between roles through its drain + re-register path
+    (hot KV pages survive; see docs/operations.md "Closed-loop
+    autoscaling & role flips"), so one flip is both a -1 and a +1."""
+
+    target_decode: int
+    target_prefill: int
+    #: (from_role, to_role) pairs, at most one per tick in practice
+    flips: tuple = ()
+    reason: str = ""
+
+    def delta(self, state: FleetState) -> tuple[int, int]:
+        return (
+            self.target_decode - state.num_decode,
+            self.target_prefill - state.num_prefill,
+        )
+
+
+class ClosedLoopPlanner:
+    """Pure setpoint controller over the live SLO plane.
+
+    Pressure attribution mirrors the disaggregated split: ITL p95 /
+    burn / decode-queue pressure sizes the DECODE pool, TTFT p95 /
+    prefill-queue pressure sizes the PREFILL pool. When one pool is hot
+    while the other has slack, the decision is a role FLIP instead of a
+    kill+spawn — the flipped worker keeps its KV pages (served/adopted
+    over the existing G4 hand-off) so prefix routing stays warm.
+
+    Pure function of (state, internal streak counters): no clocks, no
+    I/O — cooldown/clamp timing lives in ControlRunner where a clock can
+    be injected."""
+
+    def __init__(self, config: Optional[ControlConfig] = None):
+        self.config = config or ControlConfig()
+        self._calm_ticks = 0
+
+    # -- signal extraction -------------------------------------------------
+
+    def _decode_pressure(self, state: FleetState) -> Optional[str]:
+        c = self.config
+        if (
+            state.observed_itl_p95_ms is not None
+            and state.observed_itl_p95_ms > c.itl_target_ms
+        ):
+            return f"itl_p95 {state.observed_itl_p95_ms:.0f}ms > {c.itl_target_ms:.0f}ms"
+        if state.burn_rate is not None and state.burn_rate > c.burn_high:
+            return f"burn {state.burn_rate:.2f} > {c.burn_high}"
+        # load fallbacks keep the loop closed before SLO wires arrive
+        waiting_pw = state.num_waiting / max(1, state.num_decode)
+        if waiting_pw >= c.waiting_per_worker_high:
+            return f"waiting/worker {waiting_pw:.1f}"
+        if state.kv_usage >= c.kv_usage_high:
+            return f"kv_usage {state.kv_usage:.2f}"
+        return None
+
+    def _prefill_pressure(self, state: FleetState) -> Optional[str]:
+        c = self.config
+        queue_pw = state.prefill_queue_depth / max(1, state.num_prefill)
+        if queue_pw >= c.prefill_queue_per_worker_high:
+            return f"prefill queue/worker {queue_pw:.1f}"
+        if (
+            state.num_prefill > 0
+            and state.observed_ttft_p95_ms is not None
+            and state.observed_ttft_p95_ms > c.ttft_target_ms
+            and state.prefill_queue_depth > 0
+        ):
+            return f"ttft_p95 {state.observed_ttft_p95_ms:.0f}ms with queue backlog"
+        return None
+
+    def _calm(self, state: FleetState) -> bool:
+        c = self.config
+        if state.burn_rate is not None and state.burn_rate > c.burn_low:
+            return False
+        if (
+            state.sla_attainment is not None
+            and state.sla_attainment < c.attainment_setpoint
+        ):
+            return False
+        return (
+            state.kv_usage <= c.kv_usage_low
+            and state.num_waiting == 0
+            and state.prefill_queue_depth == 0
+        )
+
+    # -- the decision ------------------------------------------------------
+
+    def tick(self, state: FleetState) -> Actions:
+        c = self.config
+        decode, prefill = state.num_decode, state.num_prefill
+        flips: list[tuple[str, str]] = []
+        reason = "steady"
+
+        d_hot = self._decode_pressure(state)
+        p_hot = self._prefill_pressure(state)
+
+        if d_hot:
+            self._calm_ticks = 0
+            decode += c.max_step
+            reason = f"decode hot ({d_hot})"
+            # an idle prefill pool is warm capacity: ALSO propose a flip
+            # — the runner prefers it when it lands (the flipped roles
+            # skip their scale step that tick), and falls back to the
+            # spawn path on flip cooldown/failure so a big capacity gap
+            # still closes at max_step per tick
+            if (
+                c.allow_flips
+                and prefill > c.min_prefill
+                and state.prefill_queue_depth == 0
+                and not p_hot
+            ):
+                flips.append(("prefill", "decode"))
+                reason = f"decode hot ({d_hot}); flipping idle prefill"
+        if p_hot:
+            prefill += c.max_step
+            reason = f"prefill hot ({p_hot})"
+            # same both-paths shape as decode-hot: the flip is preferred
+            # when it lands, but the scale step must exist as the
+            # fallback — a fleet with no flippable workers (or inside
+            # the flip cooldown) still has to grow the hot pool
+            if (
+                c.allow_flips
+                and not d_hot
+                and decode > c.min_decode
+                and state.num_waiting == 0
+                and state.kv_usage <= c.kv_usage_low
+            ):
+                flips.append(("decode", "prefill"))
+                reason = f"prefill hot ({p_hot}); flipping idle decode"
+
+        if not d_hot and not p_hot:
+            if self._calm(state):
+                self._calm_ticks += 1
+                if self._calm_ticks >= c.down_stable_ticks:
+                    self._calm_ticks = 0
+                    # shed from the larger-slack pool first
+                    if prefill > c.min_prefill and state.prefill_queue_depth == 0:
+                        prefill -= c.max_step
+                        reason = "calm; prefill down"
+                    elif decode > c.min_decode:
+                        decode -= c.max_step
+                        reason = "calm; decode down"
+            else:
+                self._calm_ticks = 0
+
+        return Actions(
+            target_decode=_clamp(decode, c.min_decode, c.max_decode),
+            target_prefill=_clamp(prefill, c.min_prefill, c.max_prefill),
+            flips=tuple(flips),
+            reason=reason,
+        )
+
+
+class ControlRunner:
+    """Clock-aware actuation around a pure planner core.
+
+    Enforces per-role cooldowns, the fleet-wide flip cooldown, and the
+    max-actions-per-tick clamp; actuates scales through the Connector
+    and flips through an injected async `flipper(from_role, to_role) ->
+    bool`; publishes a status frame (`status_fn`) each tick so the
+    metrics service can serve `dynamo_tpu_planner_*` and the planner
+    section of /v1/fleet (scripts/doctor.py's planner-oscillation and
+    sla-unrecovered rules read it). `now_fn` is injectable so the
+    anti-oscillation behavior is unit-testable without real time."""
+
+    RECENT = 32
+
+    def __init__(
+        self,
+        planner,
+        connector: Connector,
+        observe,
+        flipper=None,
+        interval_s: Optional[float] = None,
+        now_fn=time.monotonic,
+        status_fn=None,
+    ):
+        self.planner = planner
+        self.connector = connector
+        self.observe = observe
+        self.flipper = flipper
+        self.interval_s = interval_s or planner.config.interval_s
+        self.now_fn = now_fn
+        self.status_fn = status_fn
+        self.decisions = {
+            "scale_up": 0, "scale_down": 0, "flip": 0, "hold": 0,
+        }
+        self.actions_clamped = 0
+        self.cooldown_holds = 0
+        #: consecutive ticks with burn above the band while the decode
+        #: target sits at max_decode — the "scaled to the ceiling and
+        #: still burning" signal doctor's sla-unrecovered rule fires on
+        self.burn_high_ticks = 0
+        self.recent: list[dict] = []
+        self._last_action: dict[str, float] = {}
+        self._last_flip: float = float("-inf")
+        self._task: Optional[asyncio.Task] = None
+
+    def _record(self, action: str, role: Optional[str], **extra) -> None:
+        self.decisions[action] = self.decisions.get(action, 0) + 1
+        rec = {"ts": round(self.now_fn(), 3), "action": action,
+               "role": role, **extra}
+        self.recent.append(rec)
+        del self.recent[: -self.RECENT]
+
+    async def step(self) -> Actions:
+        c = self.planner.config
+        state = await self.observe()
+        acts = self.planner.tick(state)
+        now = self.now_fn()
+        budget = getattr(c, "max_actions_per_tick", 1)
+        flipped_roles: set[str] = set()
+
+        for src, dst in acts.flips:
+            if budget <= 0:
+                self.actions_clamped += 1
+                continue
+            if now - self._last_flip < getattr(c, "flip_cooldown_s", 0.0):
+                self.cooldown_holds += 1
+                continue
+            if self.flipper is None:
+                break
+            ok = False
+            try:
+                ok = bool(await self.flipper(src, dst))
+            except Exception:
+                logger.exception("planner: flip %s->%s failed", src, dst)
+            if ok:
+                budget -= 1
+                self._last_flip = now
+                # a flip IS a scale action on both roles — start their
+                # cooldowns so a scale step can't pile on the same tick
+                self._last_action[src] = now
+                self._last_action[dst] = now
+                flipped_roles.update((src, dst))
+                self._record("flip", None, src=src, dst=dst)
+                logger.info("planner: flipped a %s worker to %s", src, dst)
+
+        acted = bool(flipped_roles)
+        for role, target, observed in (
+            ("decode", acts.target_decode, state.num_decode),
+            ("prefill", acts.target_prefill, state.num_prefill),
+        ):
+            delta = target - observed
+            if delta == 0 or role in flipped_roles:
+                continue
+            cooldown = getattr(c, "cooldown_s", 0.0)
+            if now - self._last_action.get(role, float("-inf")) < cooldown:
+                self.cooldown_holds += 1
+                continue
+            if budget <= 0:
+                self.actions_clamped += 1
+                continue
+            step = max(-c.max_step, min(c.max_step, delta))
+            step_target = observed + step
+            logger.info(
+                "planner: %s %d -> %d (%s)", role, observed, step_target,
+                acts.reason,
+            )
+            await self.connector.scale(role, step_target, observed)
+            budget -= 1
+            acted = True
+            self._last_action[role] = now
+            self._record(
+                "scale_up" if step > 0 else "scale_down", role,
+                **{"from": observed, "to": step_target},
+            )
+        if not acted:
+            self.decisions["hold"] += 1
+
+        burn = state.burn_rate
+        at_max = acts.target_decode >= c.max_decode
+        if (
+            burn is not None
+            and burn > getattr(c, "burn_high", 1.0)
+            and at_max
+        ):
+            self.burn_high_ticks += 1
+        else:
+            self.burn_high_ticks = 0
+
+        if self.status_fn is not None:
+            try:
+                await self.status_fn(self.status(state, acts))
+            except Exception:
+                logger.warning("planner status publish failed", exc_info=True)
+        return acts
+
+    def status(self, state: FleetState, acts: Actions) -> dict:
+        c = self.planner.config
+        return {
+            "mode": type(self.planner).__name__,
+            "targets": {"decode": acts.target_decode,
+                        "prefill": acts.target_prefill},
+            "observed": {"decode": state.num_decode,
+                         "prefill": state.num_prefill},
+            "limits": {"min_decode": c.min_decode, "max_decode": c.max_decode,
+                       "min_prefill": c.min_prefill,
+                       "max_prefill": c.max_prefill},
+            "setpoint": {
+                "attainment": getattr(c, "attainment_setpoint", None),
+                "burn_high": getattr(c, "burn_high", None),
+                "burn_low": getattr(c, "burn_low", None),
+                "ttft_ms": getattr(c, "ttft_target_ms", None),
+                "itl_ms": getattr(c, "itl_target_ms", None),
+                "cooldown_s": getattr(c, "cooldown_s", None),
+                "flip_cooldown_s": getattr(c, "flip_cooldown_s", None),
+            },
+            "signals": {
+                "burn_rate": state.burn_rate,
+                "sla_attainment": state.sla_attainment,
+                "observed_ttft_p95_ms": state.observed_ttft_p95_ms,
+                "observed_itl_p95_ms": state.observed_itl_p95_ms,
+                "kv_usage": round(state.kv_usage, 4),
+                "num_waiting": state.num_waiting,
+                "prefill_queue_depth": state.prefill_queue_depth,
+                "request_rate": round(state.request_rate, 3),
+            },
+            "reason": acts.reason,
+            "decisions_total": dict(self.decisions),
+            "flips_total": self.decisions.get("flip", 0),
+            "actions_clamped_total": self.actions_clamped,
+            "cooldown_holds_total": self.cooldown_holds,
+            "burn_high_ticks": self.burn_high_ticks,
+            "at_max": acts.target_decode >= c.max_decode,
+            "recent_decisions": list(self.recent),
+        }
+
+    async def run(self) -> None:
+        while True:
+            try:
+                await self.step()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                logger.exception("planner tick failed")
+            await asyncio.sleep(self.interval_s)
+
+    def start(self) -> None:
+        self._task = asyncio.get_running_loop().create_task(self.run())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
 
 
 class PlannerRunner:

@@ -11,6 +11,7 @@ utils/prometheus.py; here the worker metrics plane carries it directly).
 
 from __future__ import annotations
 
+import asyncio
 import logging
 import time
 from typing import Optional
@@ -93,7 +94,7 @@ class FleetObserver:
         # p95s + attainment. Optional by design — a fleet without
         # fleet_telemetry (or a garbage wire) leaves the fields None and
         # the planner keeps running on its offline tables.
-        ttft_p95 = itl_p95 = attain = None
+        ttft_p95 = itl_p95 = attain = burn = None
         try:
             from dynamo_tpu.telemetry import slo as slo_mod
 
@@ -107,7 +108,27 @@ class FleetObserver:
                 if merged.sources:
                     ttft_p95 = merged.sketches["ttft_ms"].quantile(0.95)
                     itl_p95 = merged.sketches["itl_ms"].quantile(0.95)
-                    attain = merged.attainment()
+                    # SLIDING-WINDOW attainment, not lifetime: the
+                    # control signal must recover once the fleet does —
+                    # a lifetime ratio would carry one bad burst forever
+                    # and block every later scale-down. Empty windows
+                    # (idle fleet) leave it None, which the planner
+                    # treats as unconstrained.
+                    attains = [
+                        merged.attainment(w)
+                        for w, (n, _) in merged.windows.items()
+                        if n > 0
+                    ]
+                    attain = min(attains) if attains else None
+                    # worst (shortest-window) burn — the paging signal
+                    # the closed-loop planner scales up on
+                    burns = [
+                        merged.burn_rate(w)
+                        for w, (n, _) in merged.windows.items()
+                        if n > 0
+                    ]
+                    if burns:
+                        burn = max(burns)
         except Exception:
             logger.debug("observed-SLA fold failed", exc_info=True)
         return FleetState(
@@ -120,4 +141,85 @@ class FleetObserver:
             observed_ttft_p95_ms=ttft_p95,
             observed_itl_p95_ms=itl_p95,
             sla_attainment=attain,
+            burn_rate=burn,
         )
+
+
+class FleetFlipper:
+    """Actuates a role flip on a live worker: picks the least-busy
+    flippable instance of the source role and calls its `flip` ingress
+    op (Worker._flip_handler). Only workers that advertise
+    `flippable: true` in their registration metadata qualify — plain
+    PrefillWorker processes have no ingress and can't flip."""
+
+    def __init__(self, observer: FleetObserver):
+        self.observer = observer
+        self.flips = 0
+
+    def _source(self, role: str):
+        return (
+            self.observer._decode_src
+            if role == "decode"
+            else self.observer._prefill_src
+        )
+
+    async def __call__(self, from_role: str, to_role: str) -> bool:
+        import msgpack
+
+        from dynamo_tpu.runtime.codec import encode_frame, read_frame
+
+        candidates = [
+            inst
+            for inst in self._source(from_role).list()
+            if inst.metadata.get("flippable") and inst.port
+        ]
+        if not candidates:
+            return False
+        snap = self.observer.metrics.snapshot()
+        victim = min(
+            candidates,
+            key=lambda i: (
+                int(snap.get(i.instance_id, {}).get("num_running", 0) or 0),
+                i.instance_id,
+            ),
+        )
+        # one-shot direct call to the victim's ingress `flip` op — the
+        # worker acks immediately and winds the flip down in background
+        try:
+            reader, writer = await asyncio.open_connection(
+                victim.host, victim.port
+            )
+            try:
+                writer.write(
+                    encode_frame(
+                        {
+                            "op": "call",
+                            "request_id": f"flip-{self.flips}",
+                            "endpoint": "flip",
+                        },
+                        msgpack.packb({"role": to_role}, use_bin_type=True),
+                    )
+                )
+                await writer.drain()
+                header, payload = await asyncio.wait_for(
+                    read_frame(reader), timeout=5.0
+                )
+                if header.get("op") == "error":
+                    logger.warning(
+                        "flip refused by %s: %s", victim.instance_id,
+                        header.get("message"),
+                    )
+                    return False
+            finally:
+                writer.close()
+        except Exception:
+            logger.warning(
+                "flip call to %s failed", victim.instance_id, exc_info=True
+            )
+            return False
+        self.flips += 1
+        logger.info(
+            "flip %s->%s dispatched to %s", from_role, to_role,
+            victim.instance_id,
+        )
+        return True

@@ -104,7 +104,14 @@ class EndpointRegistration:
         metadata: Optional[dict] = None,
         lease_ttl: float = DEFAULT_LEASE_TTL,
         lease_id: Optional[str] = None,
+        instance_id: Optional[str] = None,
     ) -> "EndpointRegistration":
+        """`instance_id` lets a worker KEEP its identity across a role
+        flip (deregister from one endpoint, re-register under another):
+        KV events, metrics frames, and router prefix indexes stay keyed
+        to the same id, so the flipped worker's hot KV pages remain
+        routable (docs/operations.md "Closed-loop autoscaling & role
+        flips")."""
         owns_lease = lease_id is None
         if lease_id is None:
             lease_id = await fabric.grant_lease(lease_ttl)
@@ -112,7 +119,7 @@ class EndpointRegistration:
             namespace=namespace,
             component=component,
             endpoint=endpoint,
-            instance_id=uuid.uuid4().hex[:12],
+            instance_id=instance_id or uuid.uuid4().hex[:12],
             host=host,
             port=port,
             metadata=metadata or {},

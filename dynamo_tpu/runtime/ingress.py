@@ -59,6 +59,14 @@ class IngressServer:
         self.port = self._server.sockets[0].getsockname()[1]
         logger.info("ingress on %s:%d", self.host, self.port)
 
+    def drop_connections(self) -> None:
+        """Abruptly close every live client connection (the server keeps
+        listening). Chaos harness primitive: to a router this is exactly
+        a network partition / process death mid-stream — in-flight
+        frames stop, the read loop sees EOF, streams drop."""
+        for w in list(self._writers):
+            w.close()
+
     async def stop(self) -> None:
         if self._server:
             self._server.close()

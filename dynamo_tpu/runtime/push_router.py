@@ -102,6 +102,8 @@ class PushRouter:
         kv_chooser=None,
         retry_backoff_base_ms: Optional[float] = None,
         retry_backoff_max_ms: Optional[float] = None,
+        replay: bool = False,
+        max_replays: int = 2,
     ):
         self.source = source
         self.endpoint = endpoint
@@ -117,8 +119,86 @@ class PushRouter:
             if retry_backoff_max_ms is None
             else retry_backoff_max_ms
         )
+        #: crash-replayed streams (default OFF — router behavior is
+        #: bit-identical to before when off, pinned by tests): when a
+        #: worker dies MID-stream, re-dispatch the request to a survivor
+        #: as original-prompt + tokens-emitted-so-far. The survivor
+        #: generates strictly the NEXT tokens (the emitted ones are part
+        #: of its prompt), so the client stream continues with no
+        #: duplicate and no gap — bit-identical for greedy (and the
+        #: survivor's prefix cache / G4 onboarding makes the replayed
+        #: prefill near-free). Sampled streams resume under a derived
+        #: seed (see _replay_request). docs/operations.md "Crash-replayed
+        #: streams".
+        self.replay = replay
+        self.max_replays = max_replays
+        self.replays = 0
+        self.replayed_streams = 0
         self._rr = itertools.count()
         self._conns: dict[str, _WorkerConn] = {}
+
+    # -- crash replay ------------------------------------------------------
+
+    @staticmethod
+    def _replay_eligible(request: Any, emitted: list) -> bool:
+        """A stream can replay iff the request is the standard
+        PreprocessedRequest dict and the continuation is expressible as
+        prompt + emitted tokens: logprob streams can't (their arrays
+        must align from the first emitted token), multimodal prompts
+        can't (token ids alone don't identify the image embeds)."""
+        if not isinstance(request, dict):
+            return False
+        if not isinstance(request.get("token_ids"), (list, tuple)):
+            return False
+        lp = request.get("logprobs", -1)
+        if lp is not None and int(lp) >= 0:
+            return False
+        if request.get("mm_embeds") is not None:
+            return False
+        # penalty state covers GENERATED tokens only (engine/sampling.py
+        # deliberately never penalizes the prompt) — a replay turns the
+        # emitted tokens INTO prompt, so the survivor would drop all
+        # penalty pressure accumulated over them and the continuation
+        # (greedy included) could diverge from the lost stream. Refuse:
+        # these streams keep the pre-existing error surface.
+        if float(request.get("frequency_penalty", 0.0) or 0.0) != 0.0:
+            return False
+        if float(request.get("presence_penalty", 0.0) or 0.0) != 0.0:
+            return False
+        if float(request.get("repetition_penalty", 1.0) or 1.0) != 1.0:
+            return False
+        if len(emitted) >= int(request.get("max_tokens", 0) or 0):
+            return False  # nothing left to generate (finish was in flight)
+        return True
+
+    def _replay_request(self, request: dict, emitted: list, n: int) -> dict:
+        """Build the continuation request: prompt grows by the emitted
+        tokens, budgets shrink by them. Greedy continuations are
+        bit-identical to the lost stream by construction. Sampled
+        continuations resume under a DERIVED seed (seed + replay index
+        — deterministic, but a different draw sequence than the dead
+        worker would have produced; unseeded requests simply keep
+        sampling). Penalty-carrying requests never reach here
+        (_replay_eligible refuses them) — documented in
+        docs/operations.md."""
+        new = dict(request)
+        new["token_ids"] = list(request["token_ids"]) + [
+            int(t) for t in emitted
+        ]
+        new["max_tokens"] = int(request.get("max_tokens", 0)) - len(emitted)
+        if new.get("min_tokens"):
+            new["min_tokens"] = max(
+                0, int(new["min_tokens"]) - len(emitted)
+            )
+        if new.get("seed") is not None:
+            new["seed"] = int(new["seed"]) + 1000003 * n
+        rid = str(new.get("request_id") or "req")
+        new["request_id"] = f"{rid}+r{n}"
+        ann = dict(new.get("annotations") or {})
+        ann["replay"] = n
+        ann["replayed_tokens"] = len(emitted)
+        new["annotations"] = ann
+        return new
 
     # -- selection ---------------------------------------------------------
 
@@ -168,6 +248,12 @@ class PushRouter:
         as EngineStreamError after marking the instance down."""
         ctx = context or Context()
         attempts = 0
+        #: crash-replay bookkeeping (self.replay): cumulative tokens the
+        #: client has already received, and the live (possibly rebuilt)
+        #: request the next dispatch carries
+        emitted: list = []
+        replays = 0
+        live_request = request
         with telemetry.span(
             "router.dispatch", service="router",
             attrs={"endpoint": self.endpoint, "mode": self.mode.value},
@@ -204,7 +290,7 @@ class PushRouter:
 
             while True:
                 attempts += 1
-                inst = await self._pick(request, instance_id)
+                inst = await self._pick(live_request, instance_id)
                 rspan.set_attr("instance_id", inst.instance_id)
                 rspan.set_attr("attempts", attempts)
                 try:
@@ -237,7 +323,7 @@ class PushRouter:
                                 dict(ctx.metadata)
                             ),
                         },
-                        msgpack.packb(request, use_bin_type=True),
+                        msgpack.packb(live_request, use_bin_type=True),
                     )
                 except (OSError, ConnectionError):
                     conn.streams.pop(rid, None)
@@ -273,6 +359,55 @@ class PushRouter:
                                 "mark_down", instance=inst.instance_id,
                                 reason="stream dropped",
                             )
+                            if (
+                                got_data
+                                and self.replay
+                                and replays < self.max_replays
+                            ):
+                                if (
+                                    isinstance(request, dict)
+                                    and emitted
+                                    and len(emitted)
+                                    >= int(request.get("max_tokens", 0) or 0)
+                                    > 0
+                                ):
+                                    # the worker died between emitting the
+                                    # final token and the finish frame:
+                                    # the budget is spent — close the
+                                    # stream instead of replaying a
+                                    # zero-token continuation
+                                    yield {
+                                        "token_ids": [],
+                                        "finish_reason": "length",
+                                    }
+                                    return
+                                if self._replay_eligible(request, emitted):
+                                    replays += 1
+                                    self.replays += 1
+                                    if replays == 1:
+                                        self.replayed_streams += 1
+                                    live_request = self._replay_request(
+                                        request, emitted, replays
+                                    )
+                                    rspan.add_event(
+                                        "replay",
+                                        instance=inst.instance_id,
+                                        replayed_tokens=len(emitted),
+                                        n=replays,
+                                    )
+                                    logger.warning(
+                                        "replaying stream %s on a survivor "
+                                        "(%d tokens already emitted, "
+                                        "replay #%d)",
+                                        live_request["request_id"],
+                                        len(emitted), replays,
+                                    )
+                                    # fresh stream: pre-data retry logic
+                                    # applies to the replay dispatch too
+                                    got_data = False
+                                    attempts = 0
+                                    await _retry_backoff()
+                                    break  # re-dispatch to a survivor
                             if got_data or attempts >= max_attempts:
                                 raise EngineStreamError(
                                     f"stream from {inst.instance_id} dropped"
@@ -284,7 +419,10 @@ class PushRouter:
                         _first_frame()
                         if op == "data":
                             got_data = True
-                            yield msgpack.unpackb(payload, raw=False)
+                            data = msgpack.unpackb(payload, raw=False)
+                            if self.replay and isinstance(data, dict):
+                                emitted.extend(data.get("token_ids") or ())
+                            yield data
                         elif op == "end":
                             return
                         elif op == "error":

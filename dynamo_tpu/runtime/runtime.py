@@ -88,12 +88,14 @@ class Endpoint:
         return (self.component.namespace.name, self.component.name, self.name)
 
     async def register(
-        self, host: str, port: int, metadata: Optional[dict] = None
+        self, host: str, port: int, metadata: Optional[dict] = None,
+        instance_id: Optional[str] = None,
     ) -> EndpointRegistration:
         ns, comp, ep = self.path
         return await EndpointRegistration.register(
             self._rt.fabric, ns, comp, ep, host, port,
             metadata=metadata, lease_id=self._rt.primary_lease,
+            instance_id=instance_id,
         )
 
     async def instance_source(self) -> InstanceSource:
@@ -103,7 +105,10 @@ class Endpoint:
         return src
 
     async def router(
-        self, mode: RouterMode = RouterMode.ROUND_ROBIN, kv_chooser=None
+        self, mode: RouterMode = RouterMode.ROUND_ROBIN, kv_chooser=None,
+        replay: bool = False,
     ) -> PushRouter:
         src = await self.instance_source()
-        return PushRouter(src, self.name, mode=mode, kv_chooser=kv_chooser)
+        return PushRouter(
+            src, self.name, mode=mode, kv_chooser=kv_chooser, replay=replay
+        )

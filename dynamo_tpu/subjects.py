@@ -22,3 +22,9 @@ KV_HIT_RATE_SUBJECT = "kv-hit-rate"
 #: admin broadcast: every worker (decode AND prefill) flushes reusable KV
 #: pages on receipt — reaches fleet members the frontend has no route to
 FLUSH_SUBJECT = "admin.flush"
+
+#: closed-loop planner status frames (ControlRunner.status): targets vs
+#: observed pool sizes, SLO signals, decision counters, recent-decision
+#: ring — the metrics service folds these into dynamo_tpu_planner_* and
+#: the `planner` section of /v1/fleet (doctor's planner rules read it)
+PLANNER_SUBJECT = "planner.status"

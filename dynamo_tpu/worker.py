@@ -127,6 +127,24 @@ class Worker:
         self.draining = False
         self.drain_budget_s = drain_budget_s
         self.drained = asyncio.Event()
+        #: live role (closed-loop planner flips this between decode and
+        #: prefill via the `flip` ingress op — docs/operations.md
+        #: "Closed-loop autoscaling & role flips"). The engine, its KV
+        #: pool, and the instance id survive a flip: hot pages stay
+        #: registered (and G4-serveable), so prefix routing stays warm.
+        self.role = "prefill" if "prefill" in component else "decode"
+        #: where a flip to decode registers (a worker STARTED in the
+        #: prefill role has component="prefill", which is not a decode
+        #: pool — flips land it in the default decode pool)
+        self.decode_component = (
+            component if "prefill" not in component else "backend"
+        )
+        self.decode_endpoint = (
+            endpoint if "prefill" not in component else "generate"
+        )
+        self.flips = 0
+        self._prefill_embedded = None
+        self._flip_lock = asyncio.Lock()
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -203,9 +221,15 @@ class Worker:
         self.ingress.add_handler("embed", self._embed)
         self.ingress.add_handler("flush", self._flush)
         self.ingress.add_handler("drain", self._drain_handler)
+        self.ingress.add_handler("flip", self._flip_handler)
         await self.ingress.start()
 
         metadata = {"model": self.card.name}
+        if self.runner is not None or self.mock is not None:
+            # role-flip capable: has an ingress the planner can reach and
+            # an engine whose KV pool survives the flip (external/echo
+            # engines have no paged KV to keep warm — they stay put)
+            metadata["flippable"] = True
         if (self.enable_disagg or self.kv_remote) and self.runner is not None:
             from dynamo_tpu.disagg import KvTransferServer, device_transfer
 
@@ -356,6 +380,136 @@ class Worker:
             "budget_s": self.drain_budget_s if budget is None else budget,
         }
 
+    # -- role flips (docs/operations.md "Closed-loop autoscaling & role
+    # flips"): the planner's alternative to kill+spawn -------------------
+
+    async def flip_role(
+        self, role: str, budget_s: Optional[float] = None
+    ) -> bool:
+        """Flip this worker between decode and prefill roles in place.
+
+        decode -> prefill: deregister from the decode endpoint (routers
+        retry survivors), let in-flight decodes finish within the budget
+        (they keep streaming even past it — the ingress stays up), start
+        an embedded prefill-queue consumer on the SAME engine runner,
+        and register the prefill endpoint under the SAME instance id.
+        The KV pool is untouched: every page the worker computed stays
+        registered, serveable to G4 peers over the transfer plane, and
+        warm for the flip back.
+
+        prefill -> decode: stop consuming the queue (in-flight prefills
+        finish; borrowed runner keeps running) and re-register the
+        decode endpoint, again under the same instance id — routers'
+        prefix indexes for this id apply immediately, so the first
+        request with a cached prefix hits warm pages."""
+        if role not in ("decode", "prefill"):
+            raise ValueError(f"unknown role {role!r}")
+        if role == "prefill" and self.runner is None and self.mock is None:
+            raise ValueError(
+                f"engine kind {self.engine_kind!r} cannot serve the "
+                "prefill role"
+            )
+        async with self._flip_lock:
+            if role == self.role:
+                return True
+            loop = asyncio.get_running_loop()
+            if role == "prefill":
+                # quiesce decode: stop being chosen, finish what's here
+                self.draining = True
+                await self._deregister()
+                budget = (
+                    self.drain_budget_s if budget_s is None else budget_s
+                )
+                deadline = loop.time() + max(budget, 0.0)
+                while self._busy() and loop.time() < deadline:
+                    await asyncio.sleep(0.05)
+                if self._busy():
+                    logger.warning(
+                        "flip budget exhausted with %d in flight; they "
+                        "keep streaming while the worker serves prefill",
+                        self.ingress.num_inflight,
+                    )
+                if self.runner is not None and self.engine_config is not None:
+                    from dynamo_tpu.disagg.prefill_worker import PrefillWorker
+
+                    self._prefill_embedded = PrefillWorker(
+                        self.runtime,
+                        self.engine_config,
+                        namespace=self.namespace,
+                        queue_name=self.prefill_queue_name,
+                        runner=self.runner,
+                        advertise_host=self.advertise_host,
+                        register=False,
+                    )
+                    await self._prefill_embedded.start()
+                ep = (
+                    self.runtime.namespace(self.namespace)
+                    .component("prefill")
+                    .endpoint("prefill")
+                )
+                self.registration = await ep.register(
+                    self.advertise_host,
+                    self.ingress.port,
+                    metadata={"model": self.card.name, "flippable": True},
+                    instance_id=self.instance_id,
+                )
+                self.role = "prefill"
+                self.draining = False
+            else:
+                await self._deregister()
+                if self._prefill_embedded is not None:
+                    await self._prefill_embedded.stop()
+                    self._prefill_embedded = None
+                metadata = {"model": self.card.name, "flippable": True}
+                if self.transfer_server is not None:
+                    metadata["kv_transfer_port"] = self.transfer_server.port
+                ep = (
+                    self.runtime.namespace(self.namespace)
+                    .component(self.decode_component)
+                    .endpoint(self.decode_endpoint)
+                )
+                self.registration = await ep.register(
+                    self.advertise_host,
+                    self.ingress.port,
+                    metadata=metadata,
+                    instance_id=self.instance_id,
+                )
+                self.role = "decode"
+                self.draining = False
+            self.flips += 1
+            logger.info(
+                "worker %s flipped to %s (flip #%d)",
+                self.instance_id, self.role, self.flips,
+            )
+            return True
+
+    async def _flip_handler(self, ctx, request):
+        """`flip` ingress op (the planner's FleetFlipper): validate,
+        acknowledge immediately, flip in the background."""
+        role = (request or {}).get("role") if isinstance(request, dict) else None
+        if role not in ("decode", "prefill"):
+            raise ValueError(f"flip needs role=decode|prefill, got {role!r}")
+        if role == "prefill" and self.runner is None and self.mock is None:
+            raise ValueError(
+                f"engine kind {self.engine_kind!r} cannot serve the "
+                "prefill role"
+            )
+        budget = None
+        if request.get("budget_s") is not None:
+            budget = float(request["budget_s"])
+        task = asyncio.get_running_loop().create_task(
+            self.flip_role(role, budget)
+        )
+        task.add_done_callback(
+            lambda t: t.cancelled() or t.exception()  # observe, never raise
+        )
+        yield {
+            "flipping": True,
+            "to": role,
+            "from": self.role,
+            "inflight": self.ingress.num_inflight,
+        }
+
     async def stop(self, drain_timeout: float = 30.0) -> None:
         """Graceful shutdown (reference: the vLLM drain handlers,
         examples worker.py:156-170): deregister FIRST so routers stop
@@ -373,6 +527,9 @@ class Worker:
                 )
         for t in self._tasks:
             t.cancel()
+        if self._prefill_embedded is not None:
+            await self._prefill_embedded.stop()
+            self._prefill_embedded = None
         await self.ingress.stop()
         if self.transfer_server is not None:
             await self.transfer_server.stop()
@@ -390,13 +547,16 @@ class Worker:
     # -- handlers ----------------------------------------------------------
 
     async def _generate(self, ctx, request: dict):
-        if self.draining:
+        if self.draining or self.role != "decode":
             # the router retries a survivor; this instance is already
-            # deregistered and only finishing what it has
+            # deregistered (draining, or flipped to the prefill role —
+            # a stale router list may still push here briefly) and only
+            # finishing what it has
             from dynamo_tpu.runtime.ingress import RetryableHandlerError
 
             raise RetryableHandlerError(
-                f"worker {self.instance_id} is draining"
+                f"worker {self.instance_id} is "
+                f"{'draining' if self.draining else 'serving prefill'}"
             )
         pre = PreprocessedRequest.from_dict(request)
         if self.kv_directory is not None and pre.mm_embeds is None:
@@ -757,7 +917,16 @@ class Worker:
                     "kv_usage": alloc.usage(),
                     "prefix_hit_rate": alloc.stats.hit_rate,
                     "requests_received": self.mock.requests_received,
+                    "generated_tokens": self.mock.generated_tokens,
+                    "preemptions": self.mock.preemptions,
                 }
+                try:
+                    # mock fleets ride the real SLO plane (fleet sim)
+                    m["slo"] = self.mock.slo.to_wire()
+                except Exception:
+                    logger.warning(
+                        "mock SLO frame failed", exc_info=True
+                    )
             if m is not None:
                 # fleet telemetry plane (docs/observability.md "Fleet
                 # view & SLO accounting"): role for the per-role fleet
@@ -765,10 +934,23 @@ class Worker:
                 # the engine carries them. Defensive: a telemetry
                 # serialization bug must not sever the load-metrics
                 # plane routers/planner depend on.
-                m["component"] = self.component
-                m["role"] = (
-                    "prefill" if "prefill" in self.component else "decode"
-                )
+                # a flipped worker reports (and routes its frames) under
+                # its LIVE role so /v1/fleet and the planner see the
+                # pool move the moment the flip lands
+                if self.role == "prefill":
+                    # a worker CONFIGURED as prefill keeps its own
+                    # component subject; only a flipped decode worker
+                    # moves its frames into the default prefill space
+                    pub_component = (
+                        self.component
+                        if "prefill" in self.component
+                        else "prefill"
+                    )
+                else:
+                    pub_component = self.decode_component
+                m["component"] = pub_component
+                m["role"] = self.role
+                m["flips_total"] = self.flips
                 # drain visibility: /v1/fleet shows state=draining while
                 # the worker winds down (doctor's draining-worker rule
                 # keys off this instead of tripping dead/stalled rules)
@@ -812,6 +994,6 @@ class Worker:
                 m["instance_id"] = self.instance_id
                 m["model"] = self.card.name
                 await fabric.publish(
-                    f"{METRICS_SUBJECT}.{self.component}.{self.instance_id}",
+                    f"{METRICS_SUBJECT}.{pub_component}.{self.instance_id}",
                     m,
                 )

@@ -39,6 +39,15 @@ Rules (each emits severity + worker + evidence + suggested action):
                        pool's SLA
   sla-burn             a role is burning its error budget (burn rate >1
                        in the merged windows)
+  planner-oscillation  the closed-loop planner's recent decisions
+                       alternate scale directions on one role (or flips
+                       storm) inside the cooldown window — hysteresis /
+                       cooldown knobs are misconfigured and the fleet
+                       is thrashing spawn/drain cycles
+  sla-unrecovered      the planner has been at its max_decode clamp for
+                       N+ consecutive ticks while the fleet still burns
+                       its SLO budget — scaling is out of headroom; the
+                       fix is capacity or shedding, not the loop
   low-attainment       a program kind's measured ms/dispatch sits far
                        off its cost-model roofline (GET /v1/debug/
                        programs) — host-loop overhead, not the chip, is
@@ -71,6 +80,23 @@ ATTAINMENT_FLOOR = 0.05
 #: waiting queue deeper than max(this, 4x running) while the role burns
 #: its SLO budget = saturated with no admission caps
 QUEUE_DEPTH_FLOOR = 8
+#: consecutive burn-above-band ticks at the max_decode clamp before
+#: sla-unrecovered fires
+BURN_UNRECOVERED_TICKS = 5
+#: direction reversals (up->down->up on one role) inside the oscillation
+#: window before planner-oscillation fires
+OSCILLATION_REVERSALS = 2
+#: flip pairs inside the flip oscillation window before a storm fires
+FLIP_STORM_COUNT = 2
+#: the oscillation window is this multiple of the advertised cooldown:
+#: ControlRunner already ENFORCES the cooldown (recorded same-role
+#: decisions are never closer than cooldown_s apart), so the thrash
+#: signature is a reversal landing shortly AFTER each cooldown expiry —
+#: up at t, down at t+cooldown, up at t+2*cooldown. Comparing against
+#: the bare cooldown would make the rule unsatisfiable.
+OSCILLATION_WINDOW_FACTOR = 3.0
+#: fallback window (seconds) when the frame advertises no cooldown
+OSCILLATION_WINDOW_FLOOR_S = 60.0
 
 
 def _finding(severity: str, rule: str, worker: Optional[str], summary: str,
@@ -301,6 +327,8 @@ def diagnose(
                     "fleet_top's BURN column names the worst workers",
                 ))
 
+    findings.extend(_planner_rules((fleet or {}).get("planner")))
+
     for iid, p in sorted(((programs or {}).get("workers") or {}).items()):
         for kind, k in sorted((p.get("kinds") or {}).items()):
             att = k.get("attainment")
@@ -326,6 +354,108 @@ def diagnose(
 
     order = {"critical": 0, "warning": 1, "info": 2}
     findings.sort(key=lambda f: (order.get(f["severity"], 9), str(f["worker"])))
+    return findings
+
+
+def _planner_rules(planner: Optional[dict]) -> list[dict]:
+    """Closed-loop planner health (fleet snapshot `planner` section,
+    published by ControlRunner through the metrics service)."""
+    findings: list[dict] = []
+    if not isinstance(planner, dict):
+        return findings
+    setpoint = planner.get("setpoint") or {}
+    cooldown = float(setpoint.get("cooldown_s") or 0.0)
+    flip_cooldown = float(setpoint.get("flip_cooldown_s") or 0.0)
+    osc_window = (
+        cooldown * OSCILLATION_WINDOW_FACTOR
+        if cooldown > 0.0
+        else OSCILLATION_WINDOW_FLOOR_S
+    )
+    flip_window = (
+        flip_cooldown * OSCILLATION_WINDOW_FACTOR
+        if flip_cooldown > 0.0
+        else OSCILLATION_WINDOW_FLOOR_S
+    )
+    recent = [
+        d for d in (planner.get("recent_decisions") or [])
+        if isinstance(d, dict)
+    ]
+
+    # planner-oscillation: alternating scale directions on one role
+    # inside the oscillation window (a small multiple of the enforced
+    # cooldown — see OSCILLATION_WINDOW_FACTOR) — the loop is chasing
+    # its own wake
+    by_role: dict = {}
+    for d in sorted(recent, key=lambda d: float(d.get("ts") or 0.0)):
+        if d.get("action") in ("scale_up", "scale_down") and d.get("role"):
+            by_role.setdefault(str(d["role"]), []).append(d)
+    for role, ds in sorted(by_role.items()):
+        reversals = 0
+        for a, b in zip(ds, ds[1:]):
+            dt = float(b.get("ts") or 0.0) - float(a.get("ts") or 0.0)
+            if a["action"] != b["action"] and dt < osc_window:
+                reversals += 1
+        if reversals >= OSCILLATION_REVERSALS:
+            findings.append(_finding(
+                "warning", "planner-oscillation", None,
+                f"planner reversed scale direction on {role} {reversals} "
+                f"time(s) within the {osc_window:.0f}s oscillation "
+                "window — the control loop is flapping",
+                {"role": role, "reversals": reversals,
+                 "cooldown_s": cooldown, "window_s": osc_window,
+                 "decisions": ds[-6:]},
+                "widen the hysteresis band (burn_low/burn_high) or raise "
+                "--cooldown; a loop that spawns then drains the same "
+                "worker burns engine cold-starts for nothing",
+            ))
+    flips = [
+        d for d in sorted(recent, key=lambda d: float(d.get("ts") or 0.0))
+        if d.get("action") == "flip"
+    ]
+    # a storm is ALTERNATION (A->B then B->A — the same capacity bounced
+    # back), not a same-direction flip train, which is a legitimate ramp
+    # (e.g. flipping several idle prefill workers into a flash crowd)
+    storm = sum(
+        1
+        for a, b in zip(flips, flips[1:])
+        if (
+            float(b.get("ts") or 0.0) - float(a.get("ts") or 0.0)
+            < flip_window
+            and (a.get("src"), a.get("dst")) == (b.get("dst"), b.get("src"))
+        )
+    )
+    if storm >= FLIP_STORM_COUNT:
+        findings.append(_finding(
+            "warning", "planner-oscillation", None,
+            f"{len(flips)} role flips with {storm} pair(s) inside the "
+            f"{flip_window:.0f}s flip oscillation window — a flip storm "
+            "thrashes pool roles (each flip drains a worker)",
+            {"flips": len(flips), "storm_pairs": storm,
+             "flip_cooldown_s": flip_cooldown,
+             "window_s": flip_window},
+            "raise --flip-cooldown or disable --flip until the pressure "
+            "signals stop alternating between the pools",
+        ))
+
+    # sla-unrecovered: scaled to the ceiling, still burning
+    burn_ticks = int(planner.get("burn_high_ticks") or 0)
+    if burn_ticks >= BURN_UNRECOVERED_TICKS and planner.get("at_max"):
+        signals = planner.get("signals") or {}
+        limits = planner.get("limits") or {}
+        findings.append(_finding(
+            "critical", "sla-unrecovered", None,
+            f"fleet has burned its SLO budget for {burn_ticks} "
+            f"consecutive planner ticks with the decode pool pinned at "
+            f"max_decode={limits.get('max_decode')} — the control loop "
+            "is out of headroom",
+            {"burn_high_ticks": burn_ticks,
+             "burn_rate": signals.get("burn_rate"),
+             "sla_attainment": signals.get("sla_attainment"),
+             "limits": limits},
+            "raise --max-decode (add capacity) or shed load "
+            "(--shed-burn-threshold / --max-inflight); the planner "
+            "cannot recover this SLA by itself",
+        ))
     return findings
 
 

@@ -1,0 +1,303 @@
+"""Mocker-backed fleet simulation harness (ISSUE 10 acceptance).
+
+Builds an N-worker fleet of `Worker(engine_kind="mock")` processes-in-one
+-process over a REAL FabricServer, drives it with a REAL PushRouter (with
+crash replay), observes it with the REAL FleetObserver/ControlRunner
+closed loop, and actuates scaling through a SimConnector that spawns and
+retires mock workers in-process. Everything between the traffic source
+and the MockEngine step loop is the production code path: fabric
+registration/leases/watches, ingress TCP framing, router retry/replay,
+worker metrics + SLO frames, planner signal folding, flip ingress ops.
+
+The MockEngine is the reference mocker's shape (batched step loop, real
+PageAllocator, watermark admission, chunked prefill, preemption), so
+fleet-level queueing and latency under load are simulated, not faked —
+its SloTracker feeds MEASURED stream latencies into the planner's
+burn/attainment signals.
+
+Chaos primitives:
+- kill(i): abrupt worker death — ingress torn down with live
+  connections, publishing stops, registration erased (lease-expiry
+  stand-in). Routers see mid-stream drops; with replay on, client
+  streams continue on survivors.
+- partition(i): the worker stays alive but every live connection is
+  severed once (drop_connections) — the network-blip shape.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import math
+import random
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+from dynamo_tpu.mocker import MockEngine, MockEngineArgs
+from dynamo_tpu.model_card import ModelDeploymentCard
+from dynamo_tpu.runtime import DistributedRuntime
+from dynamo_tpu.runtime.fabric import FabricServer
+from dynamo_tpu.runtime.push_router import PushRouter, RouterMode
+from dynamo_tpu.telemetry.slo import SlaTargets
+from dynamo_tpu.worker import Worker
+
+MODEL = "sim-tiny"
+PAGE_SIZE = 16
+
+
+def _card() -> ModelDeploymentCard:
+    return ModelDeploymentCard(
+        name=MODEL, tokenizer={"kind": "byte"}, context_length=4096,
+        kv_page_size=PAGE_SIZE,
+    )
+
+
+@dataclass
+class SimStats:
+    started: int = 0
+    completed: int = 0
+    errored: int = 0
+    #: client-side TTFT/e2e per completed request: (t_submit, ttft_s, ok)
+    ttfts: list = field(default_factory=list)
+    finishes: dict = field(default_factory=dict)
+
+    @property
+    def dropped(self) -> int:
+        return self.started - self.completed
+
+
+class FleetSim:
+    def __init__(
+        self,
+        decode_s_per_step: float = 0.01,
+        max_batch: int = 4,
+        num_pages: int = 256,
+        metrics_interval: float = 0.4,
+        sla_ttft_ms: float = 500.0,
+        slo_windows: tuple = (10.0,),
+        prefill_tokens_per_step: int = 256,
+    ):
+        self.decode_s_per_step = decode_s_per_step
+        self.max_batch = max_batch
+        self.num_pages = num_pages
+        self.metrics_interval = metrics_interval
+        self.sla = SlaTargets(ttft_ms=sla_ttft_ms, itl_ms=None, e2e_ms=None)
+        self.slo_windows = slo_windows
+        self.prefill_tokens_per_step = prefill_tokens_per_step
+        self.server: Optional[FabricServer] = None
+        self.runtime: Optional[DistributedRuntime] = None
+        self.router: Optional[PushRouter] = None
+        self.workers: list[Worker] = []
+        self.stats = SimStats()
+        self.rng = random.Random(7)
+        self._rid = 0
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def start(self, replay: bool = True) -> None:
+        self.server = FabricServer(port=0)
+        await self.server.start()
+        # ONE runtime/fabric connection shared by every sim worker —
+        # the wire protocol and watch planes are identical; only the
+        # per-worker TCP connection count is collapsed, which is what
+        # makes a 500-worker fleet fit one process
+        self.runtime = await DistributedRuntime.create(self.server.address)
+        ep = (
+            self.runtime.namespace("dynamo")
+            .component("backend")
+            .endpoint("generate")
+        )
+        src = await ep.instance_source()
+        self.router = PushRouter(
+            src, "generate", mode=RouterMode.ROUND_ROBIN, replay=replay,
+            # fast, bounded retries: the sim drives hundreds of streams
+            retry_backoff_base_ms=5.0, retry_backoff_max_ms=50.0,
+        )
+
+    def _mock_args(self) -> MockEngineArgs:
+        return MockEngineArgs(
+            num_pages=self.num_pages,
+            page_size=PAGE_SIZE,
+            decode_s_per_step=self.decode_s_per_step,
+            prefill_tokens_per_step=self.prefill_tokens_per_step,
+            max_batch=self.max_batch,
+            salt=MODEL,
+        )
+
+    async def add_worker(self, role: str = "decode") -> Worker:
+        component, endpoint = (
+            ("backend", "generate") if role == "decode" else
+            ("prefill", "prefill")
+        )
+        w = Worker(
+            self.runtime,
+            _card(),
+            engine_kind="mock",
+            component=component,
+            endpoint=endpoint,
+            metrics_interval=self.metrics_interval,
+            mock_args=self._mock_args(),
+        )
+        await w.start()
+        # feed the planner's burn signal from measured latencies with a
+        # short window so the sim's compressed time moves it
+        w.mock.slo = type(w.mock.slo)(
+            sla=self.sla, windows=self.slo_windows
+        )
+        self.workers.append(w)
+        return w
+
+    def alive(self, role: Optional[str] = None) -> list[Worker]:
+        out = []
+        for w in self.workers:
+            if w.registration is None:
+                continue
+            if role is None or w.role == role:
+                out.append(w)
+        return out
+
+    # -- chaos primitives --------------------------------------------------
+
+    async def kill(self, w: Worker) -> None:
+        """Abrupt death: live connections sever mid-stream, publishing
+        stops, the registration is erased (lease-expiry stand-in)."""
+        for t in w._tasks:
+            t.cancel()
+        await w.ingress.stop()
+        try:
+            await w._deregister()
+        except Exception:
+            pass
+
+    def partition(self, w: Worker) -> None:
+        """One network blip: every live connection drops; the worker
+        stays registered and keeps serving new connections."""
+        w.ingress.drop_connections()
+
+    async def retire(self, w: Worker, drain_timeout: float = 5.0) -> None:
+        """Graceful scale-down: deregister, finish in-flight, stop."""
+        await w.stop(drain_timeout=drain_timeout)
+
+    # -- traffic -----------------------------------------------------------
+
+    def _request(self, isl: int, osl: int) -> dict:
+        self._rid += 1
+        prompt = [self.rng.randrange(1, 200) for _ in range(isl)]
+        return {
+            "request_id": f"sim-{self._rid}",
+            "token_ids": prompt,
+            "max_tokens": osl,
+            "temperature": 0.0,
+            "top_p": 1.0,
+            "top_k": 0,
+            "seed": None,
+            "stop_token_ids": [],
+            "stop_strings": [],
+            "ignore_eos": True,
+            "annotations": {},
+        }
+
+    async def one(self, isl: int = 24, osl: int = 8,
+                  timeout: float = 30.0) -> tuple[list, Optional[str], float]:
+        """Drive one stream to a terminal state. Returns (tokens,
+        finish_reason, ttft_s); an exception IS a dropped stream and
+        propagates to the caller's accounting."""
+        req = self._request(isl, osl)
+        self.stats.started += 1
+        tokens: list = []
+        finish = None
+        t0 = time.monotonic()
+        t_first = None
+
+        async def drive():
+            nonlocal finish, t_first
+            async for item in self.router.generate(req, max_attempts=8):
+                if not isinstance(item, dict):
+                    continue
+                got = item.get("token_ids") or ()
+                if got and t_first is None:
+                    t_first = time.monotonic()
+                tokens.extend(got)
+                if item.get("finish_reason"):
+                    finish = item["finish_reason"]
+
+        try:
+            await asyncio.wait_for(drive(), timeout)
+        except Exception:
+            self.stats.errored += 1
+            raise
+        if finish in ("length", "stop"):
+            self.stats.completed += 1
+            ttft = (t_first or time.monotonic()) - t0
+            self.stats.ttfts.append((t0, ttft, True))
+            self.stats.finishes[req["request_id"]] = finish
+        else:
+            self.stats.errored += 1
+        return tokens, finish, (t_first or time.monotonic()) - t0
+
+    async def drive_phase(
+        self,
+        seconds: float,
+        rate_fn,
+        isl: int = 24,
+        osl: int = 8,
+        timeout: float = 30.0,
+    ) -> list:
+        """Open-loop arrivals for `seconds`: at time t (phase-relative),
+        requests arrive at rate_fn(t) req/s. Returns the list of stream
+        tasks' results; every stream MUST reach a terminal state."""
+        tasks: list[asyncio.Task] = []
+        t0 = time.monotonic()
+        while True:
+            t = time.monotonic() - t0
+            if t >= seconds:
+                break
+            rate = max(0.05, float(rate_fn(t)))
+            tasks.append(
+                asyncio.create_task(self.one(isl=isl, osl=osl,
+                                             timeout=timeout))
+            )
+            await asyncio.sleep(1.0 / rate)
+        return await asyncio.gather(*tasks, return_exceptions=True)
+
+    @staticmethod
+    def diurnal(base: float, amp: float, period_s: float):
+        """Compressed day: rate(t) = base + amp * sin(2πt/period)."""
+        return lambda t: base + amp * math.sin(2 * math.pi * t / period_s)
+
+    # -- teardown ----------------------------------------------------------
+
+    async def stop(self) -> None:
+        if self.router is not None:
+            self.router.close()
+        for w in list(self.workers):
+            try:
+                await w.stop(drain_timeout=0)
+            except Exception:
+                pass
+        if self.runtime is not None:
+            await self.runtime.close()
+        if self.server is not None:
+            await self.server.stop()
+
+
+class SimConnector:
+    """Planner Connector over the sim: spawn mock workers on scale-up,
+    retire the youngest on scale-down (graceful drain). Records calls
+    like RecordingConnector so tests can assert the actuation path."""
+
+    def __init__(self, sim: FleetSim, max_spawn_per_call: int = 4):
+        self.sim = sim
+        self.max_spawn_per_call = max_spawn_per_call
+        self.calls: list[tuple[str, int, int]] = []
+
+    async def scale(self, role: str, target: int, observed: int) -> None:
+        self.calls.append((role, target, observed))
+        delta = target - observed
+        if delta > 0:
+            for _ in range(min(delta, self.max_spawn_per_call)):
+                await self.sim.add_worker(role=role)
+        elif delta < 0:
+            victims = self.sim.alive(role)[delta:]
+            for w in victims:
+                await self.sim.retire(w)

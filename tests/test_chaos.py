@@ -45,12 +45,15 @@ class ChaosCluster:
     overload-plane flags wired in)."""
 
     def __init__(self, num_workers=2, max_inflight=4, echo_delay=0.05,
-                 drain_budget=8.0):
+                 drain_budget=8.0, engine="echo", mock_step=None,
+                 frontend_args=()):
         from benchmarks._procs import free_port
         from tests.fault_tolerance.harness import ManagedProc, _cli
 
         self._cli = _cli
         self._ManagedProc = ManagedProc
+        self.engine = engine
+        self.mock_step = mock_step
         self.echo_delay = echo_delay
         self.drain_budget = drain_budget
         self.fabric_port = free_port()
@@ -72,6 +75,7 @@ class ChaosCluster:
                     "--fabric", f"127.0.0.1:{self.fabric_port}",
                     "--port", str(self.http_port),
                     "--max-inflight", str(max_inflight),
+                    *frontend_args,
                 ),
             )
             self.frontend.wait_for("listening on", timeout=30)
@@ -81,13 +85,20 @@ class ChaosCluster:
             raise
 
     def add_worker(self):
+        extra = (
+            ("--mock-step", str(self.mock_step))
+            if self.engine == "mock" and self.mock_step
+            else ("--echo-delay", str(self.echo_delay))
+            if self.engine == "echo"
+            else ()
+        )
         w = self._ManagedProc(
             f"worker{len(self.workers)}",
             self._cli(
-                "run", "in=dyn", "out=echo", "--model", "tiny",
+                "run", "in=dyn", f"out={self.engine}", "--model", "tiny",
                 "--fabric", f"127.0.0.1:{self.fabric_port}",
-                "--echo-delay", str(self.echo_delay),
                 "--drain-budget", str(self.drain_budget),
+                *extra,
             ),
         )
         self.workers.append(w)
@@ -241,6 +252,103 @@ def test_chaos_kill_drain_saturation_deadline():
 
         # coda: the fleet is still healthy after the whole gauntlet
         assert _drive(cluster, 3, "coda").count(200) == 3
+    finally:
+        cluster.stop()
+
+
+def _stream_content(port: int, prompt: str, max_tokens: int,
+                    timeout: float = 60.0) -> str:
+    """One STREAMING chat completion; returns the concatenated delta
+    content (SSE parse). Raises on a dropped/errored stream."""
+    body = json.dumps({
+        "model": "tiny",
+        "messages": [{"role": "user", "content": prompt}],
+        "max_tokens": max_tokens,
+        "temperature": 0.0,
+        "stream": True,
+        # the mock's deterministic token chain hits byte-EOS (token 0)
+        # early on some prompts — the scenario needs the full-length
+        # stream so the kill lands mid-way
+        "ext": {"ignore_eos": True},
+    }).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/chat/completions",
+        data=body, headers={"Content-Type": "application/json"},
+    )
+    out = []
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        assert resp.status == 200
+        for raw in resp:
+            line = raw.decode().strip()
+            if not line.startswith("data:"):
+                continue
+            payload = line[5:].strip()
+            if payload == "[DONE]":
+                break
+            doc = json.loads(payload)
+            for c in doc.get("choices", ()):
+                delta = (c.get("delta") or {}).get("content")
+                if delta:
+                    out.append(delta)
+    return "".join(out)
+
+
+def test_chaos_midstream_sigkill_stream_replay_bit_identical():
+    """Satellite 6: SIGKILL the worker serving a live stream, with
+    --stream-replay on — the client's HTTP stream CONTINUES on the
+    survivor and the final text is BIT-IDENTICAL to an undisturbed
+    greedy run (the mock engine's token chain is a pure function of
+    history, so one duplicated, missing, or diverged token changes the
+    bytes). This is the process-level twin of
+    tests/test_stream_replay.py's in-process pin."""
+    # mock workers: deterministic greedy tokens, ~60ms per step so the
+    # kill lands mid-stream; replay enabled at the frontend router
+    cluster = ChaosCluster(
+        num_workers=1, max_inflight=32, engine="mock", mock_step=0.08,
+        frontend_args=("--stream-replay",),
+    )
+    try:
+        prompt = "replay me, exactly"
+        # ~10 s of stream at 80 ms/step: the mid-stream survivor spawn
+        # (a full worker process boot, seconds) plus the kills must all
+        # land well before the stream would finish on its own
+        n_tok = 120
+        # undisturbed reference on worker0
+        ref = _stream_content(cluster.http_port, prompt, n_tok)
+        assert len(ref) > 0
+
+        # start a second candidate; the stream lands on one of the two
+        # (round-robin makes which one ambiguous) — so after the stream
+        # starts, spawn a FRESH survivor and SIGKILL every pre-stream
+        # worker: the serving worker dies mid-stream by construction,
+        # and the only place the stream can continue is the survivor.
+        candidates = [cluster.workers[0], cluster.add_worker()]
+        time.sleep(1.0)
+
+        def frontend_replays() -> int:
+            with open(cluster.frontend.log_path) as f:
+                return f.read().count("replaying stream")
+
+        with concurrent.futures.ThreadPoolExecutor(max_workers=1) as pool:
+            fut = pool.submit(
+                _stream_content, cluster.http_port, prompt, n_tok, 90.0
+            )
+            time.sleep(0.4)  # a handful of tokens in
+            survivor = cluster.add_worker()
+            time.sleep(0.8)  # frontend's watch sees the survivor
+            for victim in candidates:
+                victim.kill(signal.SIGKILL)
+            text = fut.result(timeout=90)
+        assert text == ref, (
+            f"replayed stream diverged:\nref={ref!r}\ngot={text!r}"
+        )
+        for victim in candidates:
+            assert victim.proc.returncode not in (None, 0)
+        assert frontend_replays() >= 1, "no stream was ever severed"
+
+        # the fleet still serves after the kills (replay did not poison
+        # the router state)
+        assert cluster.request("after", timeout=30)[0] == 200
     finally:
         cluster.stop()
 

@@ -7,6 +7,7 @@ import numpy as np
 import pytest
 
 from dynamo_tpu.disagg import DisaggConfig, DisaggregatedRouter, PrefillQueue
+from dynamo_tpu.disagg import device_transfer
 from dynamo_tpu.disagg.protocol import RemotePrefillRequest
 from dynamo_tpu.disagg.router import publish_disagg_config
 from dynamo_tpu.engine import EngineConfig
@@ -165,6 +166,11 @@ def test_kv_transfer_equivalence_quantized_pages(tiny_cfg):
     assert got == ref_tokens
 
 
+@pytest.mark.skipif(
+    not device_transfer.available(),
+    reason="jax.experimental.transfer absent from this jax build "
+           "(device KV transfer plane unavailable)",
+)
 def test_device_path_numerical_equivalence(tiny_cfg, monkeypatch):
     """Device plane end to end in-process: stage device arrays, pull them
     over the transfer fabric, land via inject_pages_device — decode output
@@ -232,6 +238,11 @@ def test_device_path_numerical_equivalence(tiny_cfg, monkeypatch):
     assert got == ref_tokens
 
 
+@pytest.mark.skipif(
+    not device_transfer.available(),
+    reason="jax.experimental.transfer absent from this jax build "
+           "(device KV transfer plane unavailable)",
+)
 def test_device_pull_failure_falls_back_to_host(tiny_cfg, monkeypatch):
     """A failed device pull nacks WITHOUT killing the waiter; the sender's
     host-path fallback then lands the same request."""
@@ -359,6 +370,11 @@ def test_remote_prefill_reservation_failure(tiny_cfg):
     assert eng.allocator.num_free == before + 3  # ceil(11/4)
 
 
+@pytest.mark.skipif(
+    not device_transfer.available(),
+    reason="jax.experimental.transfer absent from this jax build "
+           "(device KV transfer plane unavailable)",
+)
 def test_disagg_e2e_workers(tiny_cfg, monkeypatch):
     """Full path: decode worker + prefill worker over a fabric server; long
     prompts prefill remotely and the output matches a local-only run.
@@ -488,6 +504,11 @@ def test_disagg_fallback_without_prefill_fleet(tiny_cfg):
     run(main())
 
 
+@pytest.mark.skipif(
+    not device_transfer.available(),
+    reason="jax.experimental.transfer absent from this jax build "
+           "(device KV transfer plane unavailable)",
+)
 def test_no_waiter_nack_skips_host_fallback(tiny_cfg, monkeypatch):
     """A decode side whose waiter is gone nacks with reason "no_waiter";
     the sender must NOT materialize the device arrays and ship the multi-MB

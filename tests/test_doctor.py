@@ -202,6 +202,123 @@ def test_snapshot_only_mode_does_not_flag_busy_workers_as_stalled():
     )
 
 
+def _planner(**kw):
+    base = {
+        "mode": "ClosedLoopPlanner",
+        "targets": {"decode": 4, "prefill": 1},
+        "observed": {"decode": 4, "prefill": 1},
+        "limits": {"min_decode": 1, "max_decode": 4,
+                   "min_prefill": 0, "max_prefill": 4},
+        "setpoint": {"attainment": 0.99, "burn_high": 1.0,
+                     "burn_low": 0.25, "cooldown_s": 30.0,
+                     "flip_cooldown_s": 60.0},
+        "signals": {"burn_rate": 0.2, "sla_attainment": 0.995},
+        "decisions_total": {"hold": 50},
+        "flips_total": 0,
+        "actions_clamped_total": 0,
+        "cooldown_holds_total": 0,
+        "burn_high_ticks": 0,
+        "at_max": False,
+        "recent_decisions": [],
+    }
+    base.update(kw)
+    return base
+
+
+def test_planner_oscillation_rule_fires_on_alternating_directions():
+    doctor = _load_doctor()
+    fleet = {
+        "workers": {}, "roles": {}, "fleet": {"workers": 0},
+        # up->down->up->down on decode, each pair 5s apart — well inside
+        # the 30s cooldown the setpoint advertises: flapping
+        "planner": _planner(recent_decisions=[
+            {"ts": 100.0, "action": "scale_up", "role": "decode",
+             "from": 2, "to": 3},
+            {"ts": 105.0, "action": "scale_down", "role": "decode",
+             "from": 3, "to": 2},
+            {"ts": 110.0, "action": "scale_up", "role": "decode",
+             "from": 2, "to": 3},
+            {"ts": 115.0, "action": "scale_down", "role": "decode",
+             "from": 3, "to": 2},
+        ]),
+    }
+    findings = doctor.diagnose(fleet, {}, {})
+    osc = [f for f in findings if f["rule"] == "planner-oscillation"]
+    assert len(osc) == 1, findings
+    assert osc[0]["severity"] == "warning"
+    assert osc[0]["evidence"]["role"] == "decode"
+    assert osc[0]["evidence"]["reversals"] >= 2
+    assert "hysteresis" in osc[0]["action"]
+
+
+def test_planner_flip_storm_fires_inside_cooldown_window():
+    doctor = _load_doctor()
+    fleet = {
+        "workers": {}, "roles": {}, "fleet": {"workers": 0},
+        "planner": _planner(recent_decisions=[
+            {"ts": 100.0, "action": "flip", "src": "prefill",
+             "dst": "decode"},
+            {"ts": 110.0, "action": "flip", "src": "decode",
+             "dst": "prefill"},
+            {"ts": 120.0, "action": "flip", "src": "prefill",
+             "dst": "decode"},
+        ], flips_total=3),
+    }
+    findings = doctor.diagnose(fleet, {}, {})
+    osc = [f for f in findings if f["rule"] == "planner-oscillation"]
+    assert len(osc) == 1, findings
+    assert "flip storm" in osc[0]["summary"]
+
+
+def test_sla_unrecovered_fires_at_the_clamp():
+    doctor = _load_doctor()
+    fleet = {
+        "workers": {}, "roles": {}, "fleet": {"workers": 0},
+        "planner": _planner(
+            burn_high_ticks=7, at_max=True,
+            targets={"decode": 4, "prefill": 1},
+            signals={"burn_rate": 2.3, "sla_attainment": 0.91},
+        ),
+    }
+    findings = doctor.diagnose(fleet, {}, {})
+    unrec = [f for f in findings if f["rule"] == "sla-unrecovered"]
+    assert len(unrec) == 1, findings
+    assert unrec[0]["severity"] == "critical"
+    assert unrec[0]["evidence"]["burn_high_ticks"] == 7
+    assert "--max-decode" in unrec[0]["action"]
+    # below the tick threshold, or not at the clamp: no finding
+    for planner in (
+        _planner(burn_high_ticks=2, at_max=True),
+        _planner(burn_high_ticks=9, at_max=False),
+    ):
+        fleet["planner"] = planner
+        assert not [
+            f for f in doctor.diagnose(fleet, {}, {})
+            if f["rule"] == "sla-unrecovered"
+        ]
+
+
+def test_planner_rules_quiet_on_healthy_planner():
+    doctor = _load_doctor()
+    fleet = {
+        "workers": {}, "roles": {}, "fleet": {"workers": 0},
+        "planner": _planner(recent_decisions=[
+            # well-spaced same-direction scaling is a healthy ramp
+            {"ts": 100.0, "action": "scale_up", "role": "decode",
+             "from": 2, "to": 3},
+            {"ts": 200.0, "action": "scale_up", "role": "decode",
+             "from": 3, "to": 4},
+            {"ts": 400.0, "action": "scale_down", "role": "decode",
+             "from": 4, "to": 3},
+        ]),
+    }
+    findings = doctor.diagnose(fleet, {}, {})
+    assert not [
+        f for f in findings
+        if f["rule"] in ("planner-oscillation", "sla-unrecovered")
+    ], findings
+
+
 def test_clean_fleet_reports_all_clear():
     doctor = _load_doctor()
     fleet = {

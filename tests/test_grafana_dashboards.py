@@ -64,8 +64,39 @@ def _populated_expositions() -> list[str]:
         ext_ready=1, ext_broken=0, ext_restarts_total=0,
         ext_consecutive_failures=0,
         stalls_total=1, stalls_by_cause={"stalled_stream": 1},
+        flips_total=1,
     )
     svc.aggregator._latest["w1"] = (frame, time.monotonic())
+    # closed-loop planner status frame (ControlRunner.status shape) so
+    # the "Planner" row's dynamo_tpu_planner_* families are populated
+    svc.planner_status = {
+        "mode": "ClosedLoopPlanner",
+        "targets": {"decode": 3, "prefill": 1},
+        "observed": {"decode": 2, "prefill": 1},
+        "limits": {"min_decode": 1, "max_decode": 8,
+                   "min_prefill": 0, "max_prefill": 4},
+        "setpoint": {"attainment": 0.99, "burn_high": 1.0,
+                     "burn_low": 0.25, "ttft_ms": 2000.0, "itl_ms": 200.0,
+                     "cooldown_s": 30.0, "flip_cooldown_s": 60.0},
+        "signals": {"burn_rate": 1.4, "sla_attainment": 0.97,
+                    "observed_ttft_p95_ms": 900.0,
+                    "observed_itl_p95_ms": 45.0, "kv_usage": 0.6,
+                    "num_waiting": 3, "prefill_queue_depth": 0,
+                    "request_rate": 8.0},
+        "reason": "decode hot (burn 1.40 > 1.0)",
+        "decisions_total": {"scale_up": 2, "scale_down": 1, "flip": 1,
+                            "hold": 10},
+        "flips_total": 1,
+        "actions_clamped_total": 1,
+        "cooldown_holds_total": 2,
+        "burn_high_ticks": 0,
+        "at_max": False,
+        "recent_decisions": [
+            {"ts": 100.0, "action": "scale_up", "role": "decode",
+             "from": 2, "to": 3},
+        ],
+    }
+    svc.planner_status_age = time.monotonic()
     pframe = dict(frame)
     pframe.update(instance_id="p1", component="prefill", role="prefill")
     svc.aggregators[1]._latest["p1"] = (pframe, time.monotonic())

@@ -14,6 +14,7 @@ import pytest
 import jax
 import jax.numpy as jnp
 
+from dynamo_tpu.disagg import device_transfer
 from dynamo_tpu.models.mla import (
     MlaConfig,
     forward,
@@ -580,6 +581,11 @@ def test_mla_tier_evict_onboard_byte_exact():
     assert eng.allocator.stats.onboarded_blocks > 0  # tier really used
 
 
+@pytest.mark.skipif(
+    not device_transfer.available(),
+    reason="jax.experimental.transfer absent from this jax build "
+           "(device KV transfer plane unavailable)",
+)
 def test_mla_disagg_device_path_in_process(monkeypatch):
     """Disagg KV transfer of the asymmetric MLA cache over the DEVICE
     plane in-process: staged (k latent, v rope) arrays pull with their

@@ -1,0 +1,392 @@
+"""Closed-loop planner core + ControlRunner (ISSUE 10 tentpole):
+pressure attribution, hysteresis bands, flip preference, and the
+clock-injected anti-oscillation guarantees (cooldowns + per-tick action
+clamp), plus the default-off gate pins."""
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu.planner import (
+    Actions,
+    ClosedLoopPlanner,
+    ControlConfig,
+    ControlRunner,
+    RecordingConnector,
+)
+from dynamo_tpu.planner.planner import FleetState
+
+
+def _state(**kw):
+    base = dict(
+        num_decode=2, num_prefill=1, kv_usage=0.3, num_waiting=0,
+        prefill_queue_depth=0, request_rate=0.0,
+    )
+    base.update(kw)
+    return FleetState(**base)
+
+
+def _cfg(**kw):
+    base = dict(
+        min_decode=1, max_decode=8, min_prefill=0, max_prefill=4,
+        down_stable_ticks=2, cooldown_s=30.0, flip_cooldown_s=60.0,
+        max_actions_per_tick=2,
+    )
+    base.update(kw)
+    return ControlConfig(**base)
+
+
+# -- pure core --------------------------------------------------------------
+
+
+def test_burn_above_band_scales_decode_up():
+    p = ClosedLoopPlanner(_cfg(allow_flips=False))
+    a = p.tick(_state(burn_rate=1.8, sla_attainment=0.95))
+    assert a.target_decode == 3
+    assert "burn" in a.reason
+
+
+def test_itl_pressure_scales_decode_up():
+    p = ClosedLoopPlanner(_cfg(itl_target_ms=50.0, allow_flips=False))
+    a = p.tick(_state(observed_itl_p95_ms=90.0))
+    assert a.target_decode == 3
+
+
+def test_dead_band_holds():
+    """Burn between burn_low and burn_high: neither up nor down — the
+    hysteresis band absorbs a noisy signal."""
+    p = ClosedLoopPlanner(_cfg())
+    for _ in range(10):
+        a = p.tick(_state(burn_rate=0.6, kv_usage=0.1))
+        assert (a.target_decode, a.target_prefill) == (2, 1)
+        assert a.flips == ()
+
+
+def test_noisy_signal_cannot_alternate_decisions():
+    """A signal flapping across burn_high produces scale-ups and holds,
+    NEVER a scale-down: down needs burn under burn_low AND a calm
+    streak, so the band + streak make alternation impossible."""
+    p = ClosedLoopPlanner(_cfg(allow_flips=False))
+    decisions = []
+    n = 2
+    for i in range(12):
+        burn = 1.4 if i % 2 == 0 else 0.6  # noisy: hot, band, hot, band
+        a = p.tick(_state(num_decode=n, burn_rate=burn, kv_usage=0.2))
+        decisions.append(a.target_decode - n)
+        n = a.target_decode
+    assert all(d >= 0 for d in decisions), decisions
+
+
+def test_scale_down_needs_calm_streak_under_burn_low():
+    p = ClosedLoopPlanner(_cfg(down_stable_ticks=3))
+    calm = _state(
+        num_decode=4, num_prefill=0, burn_rate=0.05, sla_attainment=1.0,
+        kv_usage=0.1,
+    )
+    assert p.tick(calm).target_decode == 4
+    assert p.tick(calm).target_decode == 4
+    assert p.tick(calm).target_decode == 3
+    # an overprovisioned prefill pool sheds BEFORE decode
+    p_pref = ClosedLoopPlanner(_cfg(down_stable_ticks=1))
+    a = p_pref.tick(_state(
+        num_decode=4, num_prefill=2, burn_rate=0.0, sla_attainment=1.0,
+        kv_usage=0.1,
+    ))
+    assert (a.target_decode, a.target_prefill) == (4, 1)
+    # attainment under the setpoint blocks scale-down even at zero burn
+    p2 = ClosedLoopPlanner(_cfg(down_stable_ticks=1))
+    a = p2.tick(_state(
+        num_decode=4, burn_rate=0.0, sla_attainment=0.9, kv_usage=0.1
+    ))
+    assert a.target_decode == 4
+
+
+def test_decode_pressure_with_idle_prefill_flips():
+    p = ClosedLoopPlanner(_cfg())
+    a = p.tick(_state(burn_rate=2.0, num_prefill=2, prefill_queue_depth=0))
+    assert a.flips == (("prefill", "decode"),)
+    # capacity is proposed alongside the flip: the runner prefers the
+    # flip when it lands (flipped roles skip their scale step), and the
+    # spawn path covers flip-cooldown ticks
+    assert a.target_decode == 3
+
+
+def test_prefill_pressure_with_idle_decode_flips():
+    p = ClosedLoopPlanner(_cfg())
+    a = p.tick(_state(
+        num_decode=3, kv_usage=0.1, num_waiting=0, prefill_queue_depth=6,
+        num_prefill=1,
+    ))
+    assert a.flips == (("decode", "prefill"),)
+
+
+def test_prefill_pressure_with_busy_decode_scales():
+    p = ClosedLoopPlanner(_cfg())
+    a = p.tick(_state(
+        num_decode=3, kv_usage=0.9, num_waiting=9, prefill_queue_depth=6,
+        num_prefill=1,
+    ))
+    # both pools hot: no flip (it would rob Peter to pay Paul) — scale
+    assert a.flips == ()
+    assert a.target_decode == 4
+    assert a.target_prefill == 2
+
+
+def test_queue_fallback_closes_loop_without_slo_wires():
+    """Before any worker ships SLO frames (all observed fields None),
+    the loop still reacts to queue/KV pressure."""
+    p = ClosedLoopPlanner(_cfg(allow_flips=False))
+    a = p.tick(_state(num_waiting=10))
+    assert a.target_decode == 3
+
+
+def test_bounds_respected():
+    p = ClosedLoopPlanner(_cfg(max_decode=3, allow_flips=False))
+    a = p.tick(_state(num_decode=3, burn_rate=5.0))
+    assert a.target_decode == 3
+
+
+# -- ControlRunner: injected-clock anti-oscillation -------------------------
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+
+def _runner(states, cfg=None, flipper=None, clock=None):
+    conn = RecordingConnector()
+    it = iter(states)
+
+    async def observe():
+        return next(it)
+
+    r = ControlRunner(
+        ClosedLoopPlanner(cfg or _cfg()), conn, observe,
+        flipper=flipper, now_fn=clock or _Clock(), interval_s=0.01,
+    )
+    return r, conn
+
+
+def test_cooldown_blocks_consecutive_scale_ups():
+    clock = _Clock()
+    hot = [_state(burn_rate=2.0, num_prefill=0) for _ in range(4)]
+    r, conn = _runner(hot, cfg=_cfg(cooldown_s=30.0), clock=clock)
+
+    async def main():
+        await r.step()          # t=1000: scales
+        clock.t += 5
+        await r.step()          # t=1005: cooldown holds
+        clock.t += 5
+        await r.step()          # t=1010: still held
+        clock.t += 25
+        await r.step()          # t=1035: past cooldown — scales again
+        return conn.calls
+
+    calls = asyncio.run(main())
+    assert calls == [("decode", 3, 2), ("decode", 3, 2)]
+    assert r.cooldown_holds == 2
+
+
+def test_cooldown_prevents_up_down_flapping_on_noisy_signal():
+    """The canonical flap: burn spikes, scales up, burn collapses below
+    the band with a calm fleet — the runner must NOT immediately scale
+    back down inside the cooldown."""
+    clock = _Clock()
+    states = [
+        _state(burn_rate=2.0, num_prefill=0),               # up
+        _state(num_decode=3, burn_rate=0.0, sla_attainment=1.0,
+               kv_usage=0.1, num_prefill=0),                # calm 1
+        _state(num_decode=3, burn_rate=0.0, sla_attainment=1.0,
+               kv_usage=0.1, num_prefill=0),                # calm 2 -> down?
+        _state(num_decode=3, burn_rate=0.0, sla_attainment=1.0,
+               kv_usage=0.1, num_prefill=0),                # calm 3 -> down?
+    ]
+    r, conn = _runner(
+        states, cfg=_cfg(cooldown_s=100.0, down_stable_ticks=2),
+        clock=clock,
+    )
+
+    async def main():
+        for _ in states:
+            await r.step()
+            clock.t += 10  # ticks every 10s, cooldown 100s
+        return conn.calls
+
+    calls = asyncio.run(main())
+    # exactly ONE action: the up. Every down decision hit the cooldown.
+    assert calls == [("decode", 3, 2)]
+    assert r.cooldown_holds >= 1
+
+
+def test_max_actions_per_tick_clamps():
+    clock = _Clock()
+    # both pools hot: wants decode up AND prefill up in one tick
+    states = [_state(
+        num_decode=2, kv_usage=0.9, num_waiting=9, prefill_queue_depth=8,
+        num_prefill=1,
+    )]
+    r, conn = _runner(
+        states, cfg=_cfg(max_actions_per_tick=1, allow_flips=False),
+        clock=clock,
+    )
+    asyncio.run(r.step())
+    assert len(conn.calls) == 1
+    assert r.actions_clamped == 1
+
+
+def test_max_step_bounds_one_scale_action():
+    clock = _Clock()
+    states = [_state(burn_rate=3.0, num_prefill=0)]
+    r, conn = _runner(states, cfg=_cfg(max_step=1), clock=clock)
+    asyncio.run(r.step())
+    # however hot, one tick moves one worker (max_step)
+    assert conn.calls == [("decode", 3, 2)]
+
+
+def test_flip_cooldown_blocks_flip_storm():
+    clock = _Clock()
+    flips = []
+
+    async def flipper(src, dst):
+        flips.append((src, dst))
+        return True
+
+    hot = [_state(burn_rate=2.0, num_prefill=2) for _ in range(3)]
+    r, conn = _runner(
+        hot, cfg=_cfg(flip_cooldown_s=60.0), flipper=flipper, clock=clock,
+    )
+
+    async def main():
+        await r.step()          # flips prefill->decode
+        clock.t += 10
+        await r.step()          # flip cooldown holds; scale is separate
+        clock.t += 60
+        await r.step()          # past flip cooldown
+        return flips
+
+    got = asyncio.run(main())
+    assert got == [("prefill", "decode"), ("prefill", "decode")]
+    # a flip consumed the tick for both roles: no same-tick scale call
+    # on decode at t=1000
+    assert ("decode", 3, 2) not in conn.calls[:1]
+
+
+def test_flip_starts_role_cooldowns():
+    """After a flip, the SAME tick cannot also scale the flipped roles,
+    and the next tick's scale on those roles waits out cooldown_s."""
+    clock = _Clock()
+
+    async def flipper(src, dst):
+        return True
+
+    states = [
+        _state(burn_rate=2.0, num_prefill=2),   # flip
+        _state(burn_rate=2.0, num_prefill=1),   # wants decode up: cooldown
+    ]
+    r, conn = _runner(
+        states, cfg=_cfg(cooldown_s=30.0), flipper=flipper, clock=clock,
+    )
+
+    async def main():
+        await r.step()
+        clock.t += 5
+        await r.step()
+        return conn.calls
+
+    calls = asyncio.run(main())
+    assert calls == []  # no scale actions at all: flip, then cooldown
+    assert r.decisions["flip"] == 1
+    assert r.cooldown_holds >= 1
+
+
+def test_status_frame_shape_and_burn_ticks():
+    clock = _Clock()
+    frames = []
+
+    async def status_fn(f):
+        frames.append(f)
+
+    conn = RecordingConnector()
+    states = iter([
+        _state(num_decode=8, burn_rate=3.0, num_prefill=0),
+        _state(num_decode=8, burn_rate=3.0, num_prefill=0),
+    ])
+
+    async def observe():
+        return next(states)
+
+    r = ControlRunner(
+        ClosedLoopPlanner(_cfg(max_decode=8)), conn, observe,
+        now_fn=clock, status_fn=status_fn, interval_s=0.01,
+    )
+
+    async def main():
+        await r.step()
+        clock.t += 40
+        await r.step()
+
+    asyncio.run(main())
+    assert len(frames) == 2
+    f = frames[-1]
+    assert f["targets"]["decode"] == 8
+    assert f["observed"] == {"decode": 8, "prefill": 0}
+    assert f["at_max"] is True
+    assert f["burn_high_ticks"] == 2  # at the clamp and still burning
+    assert f["signals"]["burn_rate"] == 3.0
+    assert isinstance(f["recent_decisions"], list)
+    assert f["setpoint"]["cooldown_s"] == 30.0
+
+
+def test_recent_decisions_ring_is_bounded():
+    clock = _Clock()
+
+    async def flipper(src, dst):
+        return True
+
+    conn = RecordingConnector()
+
+    async def observe():
+        return _state(burn_rate=2.0, num_prefill=0)
+
+    r = ControlRunner(
+        ClosedLoopPlanner(_cfg(cooldown_s=0.0)), conn, observe,
+        now_fn=clock, interval_s=0.01,
+    )
+
+    async def main():
+        for _ in range(ControlRunner.RECENT + 10):
+            await r.step()
+            clock.t += 1.0
+
+    asyncio.run(main())
+    assert len(r.recent) == ControlRunner.RECENT
+
+
+# -- default-off gates ------------------------------------------------------
+
+
+def test_router_replay_default_off_and_worker_not_draining_by_default():
+    """The planner/replay machinery is opt-in: a PushRouter constructed
+    the way every existing call site constructs it has replay OFF, and
+    Endpoint.router() keeps that default."""
+    import inspect
+
+    from dynamo_tpu.runtime.push_router import PushRouter
+    from dynamo_tpu.runtime.runtime import Endpoint
+
+    assert inspect.signature(PushRouter.__init__).parameters[
+        "replay"
+    ].default is False
+    assert inspect.signature(Endpoint.router).parameters[
+        "replay"
+    ].default is False
+    # ModelWatcher keeps the stream_replay gate off unless asked
+    from dynamo_tpu.frontend.service import ModelWatcher
+
+    assert inspect.signature(ModelWatcher.__init__).parameters[
+        "stream_replay"
+    ].default is False

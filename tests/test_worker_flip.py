@@ -1,0 +1,227 @@
+"""Role flips with KV adoption (ISSUE 10 tentpole): a live worker flips
+decode <-> prefill through the drain + re-register path, keeping its
+engine, KV pool, and instance id — hot pages stay warm across the flip
+(prefix hits on the flip back), in-flight streams survive a flip under
+load, and a flipped worker REALLY serves the prefill queue (full disagg
+hand-off through its embedded consumer)."""
+
+import asyncio
+
+from dynamo_tpu.engine import EngineConfig
+from dynamo_tpu.model_card import ModelDeploymentCard
+from dynamo_tpu.runtime import DistributedRuntime, RouterMode
+from dynamo_tpu.runtime.fabric import FabricServer
+from dynamo_tpu.worker import Worker
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def _card(cfg: EngineConfig) -> ModelDeploymentCard:
+    return ModelDeploymentCard(
+        name=cfg.model, tokenizer={"kind": "byte"},
+        context_length=cfg.max_context, kv_page_size=cfg.page_size,
+    )
+
+
+def _req(rid, prompt, n_out, **kw):
+    return {
+        "request_id": rid, "token_ids": prompt, "max_tokens": n_out,
+        "temperature": 0.0, "top_p": 1.0, "top_k": 0, "seed": None,
+        "stop_token_ids": [], "stop_strings": [], "ignore_eos": True,
+        "annotations": {}, **kw,
+    }
+
+
+def test_flip_under_load_keeps_streams_and_kv_warm():
+    """decode -> prefill -> decode round trip on a live JaxEngine worker:
+    - the flip lands while a stream is IN FLIGHT; that stream finishes
+      normally (the ingress stays up through the flip);
+    - the instance id is preserved across both re-registrations;
+    - after the flip back, a repeat prompt hits the worker's own warm
+      pages (allocator prefix match > 0) and greedy tokens are identical
+      to the pre-flip run."""
+    cfg = EngineConfig.for_tests()
+
+    async def main():
+        server = FabricServer(port=0)
+        await server.start()
+        rt_w = await DistributedRuntime.create(server.address)
+        rt_c = await DistributedRuntime.create(server.address)
+        w = Worker(
+            rt_w, _card(cfg), engine_config=cfg, engine_kind="jax",
+            namespace="flip", metrics_interval=0.2,
+        )
+        await w.start()
+        iid0 = w.instance_id
+        try:
+            ns = rt_c.namespace("flip")
+            dec_ep = ns.component("backend").endpoint("generate")
+            router = await dec_ep.router(mode=RouterMode.ROUND_ROBIN)
+            await router.source.wait_for_instances()
+            prefill_src = await ns.component("prefill").endpoint(
+                "prefill"
+            ).instance_source()
+
+            prompt = [3, 1, 4, 1, 5, 9, 2, 6]
+
+            async def stream(rid, prompt, n_out):
+                tokens, finish = [], None
+                async for item in router.generate(_req(rid, prompt, n_out)):
+                    tokens.extend(item.get("token_ids", ()))
+                    if item.get("finish_reason"):
+                        finish = item["finish_reason"]
+                return tokens, finish
+
+            ref_tokens, finish = await stream("warm", prompt, 6)
+            assert finish in ("length", "stop")
+            assert len(ref_tokens) == 6
+
+            # flip UNDER LOAD: a stream is mid-flight when the flip op
+            # arrives (zero drain budget: the flip must not wait for it)
+            inflight = asyncio.create_task(stream("inflight", [9, 8, 7], 8))
+            await asyncio.sleep(0.05)
+            flip = asyncio.create_task(w.flip_role("prefill", budget_s=0.2))
+            tokens, finish = await asyncio.wait_for(inflight, 30)
+            assert finish in ("length", "stop")
+            assert len(tokens) == 8
+            assert await asyncio.wait_for(flip, 30) is True
+
+            # now a prefill-role worker, same instance id, same lease
+            assert w.role == "prefill"
+            assert w.instance_id == iid0
+            assert w._prefill_embedded is not None
+            for _ in range(100):
+                if prefill_src.instances and not router.source.instances:
+                    break
+                await asyncio.sleep(0.05)
+            assert list(prefill_src.instances) == [iid0]
+            assert iid0 not in router.source.instances
+
+            # a stale router pushing generate gets bounced retryable
+            from dynamo_tpu.runtime.push_router import NoInstancesError
+
+            try:
+                await asyncio.wait_for(stream("stale", [1, 2], 2), 10)
+                raised = False
+            except (NoInstancesError, Exception):
+                raised = True
+            assert raised
+
+            # flip BACK to decode: same id re-registers, KV still warm
+            assert await w.flip_role("decode") is True
+            assert w.role == "decode"
+            assert w.instance_id == iid0
+            assert w._prefill_embedded is None
+            for _ in range(100):
+                if iid0 in router.source.instances:
+                    break
+                await asyncio.sleep(0.05)
+            assert iid0 in router.source.instances
+
+            # warm pages survived both flips: the repeat prompt's block
+            # chain is still resident in the allocator
+            from dynamo_tpu.tokens import hash_token_blocks
+
+            hashes = hash_token_blocks(
+                ref_tokens and prompt, block_size=cfg.page_size,
+                salt=cfg.model,
+            )
+            n_match = await w.runner.submit(
+                lambda eng: eng.allocator.match_length(hashes)
+            )
+            assert n_match > 0, "flip evicted the KV pages"
+            again, finish = await stream("again", prompt, 6)
+            assert again == ref_tokens  # greedy, warm-prefix bit-identity
+        finally:
+            await w.stop(drain_timeout=0)
+            router.close()
+            await prefill_src.stop()
+            await rt_c.close()
+            await rt_w.close()
+            await server.stop()
+
+    run(main())
+
+
+def test_flipped_worker_serves_the_prefill_queue():
+    """Full disagg hand-off through a FLIPPED worker: decode worker B
+    (disagg on) pushes a long prompt to the prefill queue; worker A —
+    started as decode, flipped to prefill — consumes it through its
+    embedded PrefillWorker on the SAME engine runner, ships the KV, and
+    B streams the decode. Greedy tokens match B's local reference."""
+    import dataclasses
+
+    cfg = EngineConfig.for_tests()
+    cfg = dataclasses.replace(cfg, max_pages_per_seq=16)
+
+    async def main():
+        from dynamo_tpu.disagg import DisaggConfig
+        from dynamo_tpu.engine.engine import JaxEngine
+        from dynamo_tpu.engine.request import SamplingParams
+
+        prompt = [5, 17, 42, 99, 3, 8, 21, 60, 11, 2, 33, 44]
+        n_out = 5
+        ref = JaxEngine(cfg)
+        ref.add_request(
+            "ref", prompt,
+            SamplingParams(temperature=0.0, max_tokens=n_out,
+                           ignore_eos=True),
+        )
+        ref_tokens = ref.run_to_completion()["ref"]
+
+        server = FabricServer(port=0)
+        await server.start()
+        rt_a = await DistributedRuntime.create(server.address)
+        rt_b = await DistributedRuntime.create(server.address)
+        rt_c = await DistributedRuntime.create(server.address)
+        a = Worker(
+            rt_a, _card(cfg), engine_config=cfg, engine_kind="jax",
+            namespace="flipq", metrics_interval=0.2,
+        )
+        await a.start()
+        b = Worker(
+            rt_b, _card(cfg), engine_config=cfg, engine_kind="jax",
+            namespace="flipq", metrics_interval=0.2, enable_disagg=True,
+            disagg_config=DisaggConfig(
+                max_local_prefill_length=4, transfer_timeout_s=20.0
+            ),
+        )
+        await b.start()
+        try:
+            # flip A to the prefill role — B stays the only decode worker
+            assert await asyncio.wait_for(a.flip_role("prefill"), 30)
+            ep = (
+                rt_c.namespace("flipq").component("backend")
+                .endpoint("generate")
+            )
+            router = await ep.router(mode=RouterMode.ROUND_ROBIN)
+            for _ in range(100):
+                insts = {i.instance_id for i in router.source.list()}
+                if insts == {b.instance_id}:
+                    break
+                await asyncio.sleep(0.05)
+            tokens, finish = [], None
+            async for item in router.generate(
+                _req("q1", prompt, n_out)
+            ):
+                tokens.extend(item.get("token_ids", ()))
+                if item.get("finish_reason"):
+                    finish = item["finish_reason"]
+            assert finish in ("length", "stop")
+            assert tokens == ref_tokens
+            # the prefill REALLY ran on flipped A
+            assert a._prefill_embedded is not None
+            assert a._prefill_embedded.prefills_done == 1
+            assert b.remote_prefills == 1
+            router.close()
+        finally:
+            await a.stop(drain_timeout=0)
+            await b.stop(drain_timeout=0)
+            await rt_c.close()
+            await rt_b.close()
+            await rt_a.close()
+            await server.stop()
+
+    run(main())
