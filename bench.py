@@ -942,6 +942,130 @@ def _slo_overhead_ab(pairs: int = 3, osl: int = 32, n_req: int = 8) -> dict:
     }
 
 
+def _handover_ab() -> dict:
+    """Worker-handover A/B (ISSUE 12 acceptance): TTFT of a CONTINUED
+    stream when its prompt blocks arrived warm via handover vs
+    replay-by-recompute, plus the bytes-moved vs prefill-flops-saved
+    accounting. The headline numbers are DETERMINISTIC by construction:
+    blocks/bytes moved follow exactly from the workload shape and the
+    canonical wire format, flops saved is the standard 2·P·T over the
+    cached tokens, and `modeled_ttft_ratio` counts prefill-chunk
+    dispatches (uncached/chunk vs total/chunk) — the wall-clock TTFT
+    pair rides along as a sanity band only (box noise).
+
+    Engine-level: the same export/adopt calls the Worker handover op
+    drives (engine.handover_metas / export_blocks_by_hash /
+    prepare+commit_handover_adopt); the transfer-plane hop is covered by
+    tests/test_handover.py."""
+    import math
+
+    from dataclasses import replace
+
+    import jax
+    import numpy as np
+
+    from dynamo_tpu.engine import EngineConfig
+    from dynamo_tpu.engine.engine import JaxEngine
+    from dynamo_tpu.engine.request import SamplingParams
+    from dynamo_tpu.tokens import hash_token_blocks
+
+    cfg = replace(EngineConfig.for_tests(), max_pages_per_seq=32)
+    prompt = [((i * 37) % 211) + 1 for i in range(48)]
+    n_emit = 8
+
+    # retiring side: serve once (registers prompt + generated blocks),
+    # then export the whole registered set in the canonical wire format
+    a = JaxEngine(cfg)
+    a.add_request(
+        "warm", prompt,
+        SamplingParams(temperature=0.0, max_tokens=n_emit, ignore_eos=True),
+    )
+    emitted = a.run_to_completion()["warm"]
+    metas = a.handover_metas()
+    t0 = time.perf_counter()
+    emetas, k, v = a.export_blocks_by_hash([h for h, _, _ in metas])
+    export_s = time.perf_counter() - t0
+    bytes_moved = int(k.nbytes + v.nbytes)
+    blocks_moved = len(emetas)
+    block_bytes = bytes_moved // blocks_moved
+
+    # successor: compile-warm its programs on a DISJOINT prompt so the
+    # cold/warm TTFT pair measures prefill work, not XLA compiles
+    b = JaxEngine(cfg)
+    b.add_request(
+        "jit", [7] * len(prompt),
+        SamplingParams(temperature=0.0, max_tokens=n_emit, ignore_eos=True),
+    )
+    b.run_to_completion()
+    b.allocator.clear_cache()
+
+    continuation = list(prompt) + [int(t) for t in emitted]
+
+    def ttft(tag: str) -> float:
+        b.add_request(
+            tag, continuation,
+            SamplingParams(temperature=0.0, max_tokens=2, ignore_eos=True),
+        )
+        t0 = time.perf_counter()
+        for _ in range(10_000):
+            outs = b.step()
+            if any(o.request_id == tag and o.new_token_ids for o in outs):
+                dt = time.perf_counter() - t0
+                b.run_to_completion()  # drain the tail
+                return dt
+        raise RuntimeError("no first token")
+
+    # replay-by-recompute: the continuation prefills from scratch
+    ttft_cold_s = ttft("cold")
+    b.allocator.clear_cache()
+
+    # warm handover: adopt the exported blocks, then the SAME
+    # continuation prefix-hits them
+    t0 = time.perf_counter()
+    pages, kept, want = b.prepare_handover_adopt(emetas)
+    b.inject_pages(
+        pages,
+        np.ascontiguousarray(k[:, :, want]),
+        np.ascontiguousarray(v[:, :, want]),
+    )
+    adopted = b.commit_handover_adopt(pages, kept)
+    adopt_s = time.perf_counter() - t0
+    hashes = hash_token_blocks(
+        continuation, block_size=cfg.page_size, salt=cfg.model
+    )
+    cached_tokens = b.allocator.match_length(hashes) * cfg.page_size
+    ttft_warm_s = ttft("warmc")
+
+    uncached = len(continuation) - cached_tokens
+    chunks_cold = math.ceil(len(continuation) / cfg.prefill_chunk)
+    chunks_warm = max(1, math.ceil(uncached / cfg.prefill_chunk))
+    n_params = sum(int(x.size) for x in jax.tree.leaves(b.params))
+    flops_saved = 2 * n_params * cached_tokens
+    return {
+        "prompt_tokens": len(prompt),
+        "emitted_tokens": len(emitted),
+        "page_size": cfg.page_size,
+        "params": n_params,
+        "blocks_moved": blocks_moved,
+        "block_bytes": block_bytes,
+        "bytes_moved": bytes_moved,
+        "blocks_adopted": adopted,
+        "cached_tokens": cached_tokens,
+        "prefill_flops_saved": flops_saved,
+        "flops_saved_per_byte": round(flops_saved / bytes_moved, 2),
+        "export_s": round(export_s, 4),
+        "adopt_s": round(adopt_s, 4),
+        "ttft_cold_s": round(ttft_cold_s, 4),
+        "ttft_warm_s": round(ttft_warm_s, 4),
+        "measured_ttft_ratio": round(ttft_warm_s / ttft_cold_s, 3)
+        if ttft_cold_s
+        else None,
+        # deterministic: prefill-chunk dispatches the warm continuation
+        # skips vs the cold one — the pinned contract number
+        "modeled_ttft_ratio": round(chunks_warm / chunks_cold, 4),
+    }
+
+
 def _flight_overhead_ab(pairs: int = 4, osl: int = 32, n_req: int = 8) -> dict:
     """Flight-recorder overhead A/B (ISSUE 7 acceptance): the per-step
     record — one small dict build + deque append, ONCE per engine step
@@ -1370,6 +1494,16 @@ def main() -> None:
             # the headline artifact
             flight_ab = {"error": f"{type(e).__name__}: {e}"}
 
+    # Worker-handover A/B (ISSUE 12): warm-handover continuation TTFT vs
+    # replay-by-recompute + bytes-moved vs prefill-flops-saved.
+    handover_ab = None
+    if platform != "tpu" and os.environ.get("BENCH_HANDOVER_AB", "1") != "0":
+        try:
+            handover_ab = _handover_ab()
+        except Exception as e:  # noqa: BLE001 — A/B failure must not kill
+            # the headline artifact
+            handover_ab = {"error": f"{type(e).__name__}: {e}"}
+
     # Draft-model speculative decoding A/B (ISSUE 9): decode tok/s with
     # the fused draft+verify path on vs off at batch <= 8. Runs by
     # default on the CPU fallback (tiny self-draft — acceptance ~1, the
@@ -1579,6 +1713,7 @@ def main() -> None:
                 **({"trace_overhead": trace_ab} if trace_ab else {}),
                 **({"slo_overhead": slo_ab} if slo_ab else {}),
                 **({"flight_overhead": flight_ab} if flight_ab else {}),
+                **({"handover_ab": handover_ab} if handover_ab else {}),
                 **(
                     {"kv_quantize": os.environ["BENCH_KV_QUANTIZE"]}
                     if os.environ.get("BENCH_KV_QUANTIZE")

@@ -556,7 +556,12 @@ async def _run_planner(args) -> None:
         SlaPlanner,
     )
     from dynamo_tpu.planner.planner import PlannerRunner, SlaTargets
-    from dynamo_tpu.planner.service import FleetFlipper, FleetObserver
+    from dynamo_tpu.planner.service import (
+        FleetFlipper,
+        FleetHandover,
+        FleetObserver,
+        rolling_upgrade,
+    )
     from dynamo_tpu.runtime import DistributedRuntime
 
     cfg = PlannerConfig(
@@ -650,6 +655,30 @@ async def _run_planner(args) -> None:
         )
     else:
         connector = LocalConnector(spawn_cmd)
+    if getattr(args, "rolling_upgrade", False):
+        # one sweep, then exit: replace every worker one at a time with
+        # live KV handover (docs/operations.md "Rolling upgrades &
+        # worker handover")
+        print(
+            f"rolling upgrade starting (cooldown="
+            f"{args.upgrade_cooldown}s)",
+            flush=True,
+        )
+        try:
+            # give the instance watches a moment to prime
+            await asyncio.sleep(0.5)
+            summary = await rolling_upgrade(
+                observer, connector, FleetHandover(observer),
+                cooldown_s=args.upgrade_cooldown,
+            )
+            print(json.dumps({"rolling_upgrade": summary}), flush=True)
+            failed = any(v["failed"] for v in summary.values())
+            if failed:
+                sys.exit(3)
+        finally:
+            await observer.stop()
+            await rt.close()
+        return
     if args.mode == "closed":
         from dynamo_tpu.subjects import PLANNER_SUBJECT
 
@@ -659,6 +688,11 @@ async def _run_planner(args) -> None:
         runner = ControlRunner(
             planner, connector, observer.observe,
             flipper=FleetFlipper(observer) if args.flip else None,
+            handover=(
+                FleetHandover(observer)
+                if getattr(args, "handover", True)
+                else None
+            ),
             status_fn=status_fn,
         )
     else:
@@ -1091,6 +1125,25 @@ def build_parser() -> argparse.ArgumentParser:
     planp.add_argument(
         "--max-actions", type=int, default=2, dest="max_actions",
         help="closed mode: hard per-tick actuation clamp (scales+flips)",
+    )
+    planp.add_argument(
+        "--no-handover", action="store_false", dest="handover",
+        help="closed mode: scale-down kills workers instead of retiring "
+             "them by live KV handover (docs/operations.md 'Rolling "
+             "upgrades & worker handover'). Default: handover preferred, "
+             "kill as fallback.",
+    )
+    planp.add_argument(
+        "--rolling-upgrade", action="store_true", dest="rolling_upgrade",
+        help="run ONE rolling-upgrade sweep instead of the control loop: "
+             "replace every worker one at a time (spawn replacement -> "
+             "wait registered -> handover -> cooldown), then exit. Zero "
+             "dropped streams; in-flight work continues on warm KV.",
+    )
+    planp.add_argument(
+        "--upgrade-cooldown", type=float, default=5.0,
+        dest="upgrade_cooldown",
+        help="rolling upgrade: seconds between replaced workers",
     )
     planp.add_argument("--namespace", default="dynamo")
     planp.add_argument("--component", default="backend")

@@ -55,6 +55,12 @@ from dynamo_tpu.runtime.codec import (
 
 logger = logging.getLogger(__name__)
 
+#: process-global count of transfer frames rejected by the codec checksum
+#: (wire bit-rot / injected corruption); exposed on both Prometheus
+#: surfaces as dynamo_tpu_transfer_corrupt_total
+#: (telemetry/debug.integrity_lines)
+transfer_corrupt_total = 0
+
 #: asyncio's default 64 KiB StreamReader buffer forces ~1000 event-loop
 #: wakeups per 64 MB KV frame; bulk-plane connections use a bigger window
 _STREAM_LIMIT = 16 << 20
@@ -556,6 +562,10 @@ class KvTransferServer:
         self._waiters: dict[str, asyncio.Future] = {}
         #: transfers landed per strategy (observability: which plane ran)
         self.transfers = {"device": 0, "host": 0, "shm": 0, "bulk": 0}
+        #: frames rejected by the codec's xxh3 check (bit-rot / corruption
+        #: on the wire): the connection is dropped, the sender retries or
+        #: falls back — corrupt KV bytes NEVER land in the pool
+        self.corrupt_rejects = 0
         #: 2·k-block bytes, learned from the first serve — lets later
         #: fetches truncate the *requested* hashes before extraction
         self._fetch_block_bytes: Optional[int] = None
@@ -630,6 +640,19 @@ class KvTransferServer:
                     await self._nack(writer, rid, "bad_frame")
         except (asyncio.IncompleteReadError, ConnectionError):
             pass
+        except CodecError:
+            # A frame failed its xxh3 check: bytes rotted somewhere on the
+            # wire. The stream is unrecoverable mid-frame — drop the
+            # connection (the sender's pooled-connection error handling
+            # retries or falls back) and count the rejection. The corrupt
+            # payload never reached a landing callback.
+            global transfer_corrupt_total
+            self.corrupt_rejects += 1
+            transfer_corrupt_total += 1
+            logger.warning(
+                "transfer connection dropped: frame checksum mismatch "
+                "(corrupt KV payload rejected)"
+            )
         finally:
             writer.close()
             for mm in shm_maps.values():
@@ -1336,10 +1359,28 @@ class KvTransferClient:
         async with self._lock(key):
             reader, writer = await self._conn(key)
             try:
-                if parts is not None:
+                if parts is not None and not faults.wants_corrupt(
+                    "transfer.send"
+                ):
                     await write_frame(writer, header, parts)
                 else:
-                    writer.write(encode_frame(header, payload))
+                    # chaos corrupt rules (testing/faults.py `corrupt`
+                    # kind) flip a byte of the ENCODED frame — after the
+                    # codec computed its checksums — so tests can prove
+                    # the receiver rejects rotten KV bytes instead of
+                    # landing them. The parts fast path pre-flattens only
+                    # when a corrupt rule is actually armed.
+                    if parts is not None:
+                        payload = b"".join(
+                            bytes(memoryview(p).cast("B")) for p in parts
+                        )
+                    buf = faults.corrupt_bytes(
+                        "transfer.send",
+                        encode_frame(header, payload),
+                        op=header.get("op"),
+                        request_id=header.get("request_id"),
+                    )
+                    writer.write(buf)
                     await writer.drain()
                 return await read_frame(reader)
             except BaseException:

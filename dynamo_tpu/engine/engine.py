@@ -3476,6 +3476,92 @@ class JaxEngine:
         alloc.free(pages)
         return len(todo)
 
+    # -- worker handover: bulk export / adopt of the registered block set
+    # (docs/operations.md "Rolling upgrades & worker handover"). The
+    # byte movement itself rides the disagg transfer planes via the
+    # normal page-addressed write path — these helpers only deal in the
+    # allocator's content addressing on either side. ---------------------
+
+    def handover_metas(self) -> list:
+        """Topo-ordered (seq_hash, parent_hash, tokens) for every
+        device-registered block — the retiring worker's migratable hot
+        set, parents before children so any batch prefix is adoptable.
+        Cross-host meshes export nothing (same partial-Hkv refusal as
+        serve_blocks)."""
+        if self._multiproc:
+            return []
+        from dynamo_tpu.handover import topo_order_metas
+
+        return topo_order_metas(list(self.allocator._page_meta.values()))
+
+    def export_blocks_by_hash(self, seq_hashes: Sequence[int]):
+        """Extract the subset of `seq_hashes` still device-registered as
+        (metas, k, v) in the canonical wire format — the handover batch
+        export. Unlike serve_blocks this addresses blocks individually
+        (a topo batch may span branches), holds a reference on each page
+        across the extraction, and never touches the lower tiers. None
+        when nothing in the batch is still resident (eviction between
+        the meta listing and this call is legal — the batch shrinks)."""
+        if self._multiproc:
+            return None
+        alloc = self.allocator
+        pages: list[int] = []
+        metas: list[tuple] = []
+        try:
+            for h in seq_hashes:
+                got = PageAllocator.lookup(alloc, [h])  # base: no onboard
+                if not got:
+                    continue
+                pages.append(got[0])
+                metas.append(alloc._page_meta[got[0]])
+            if not pages:
+                return None
+            k, v = self.extract_pages(pages)
+        finally:
+            if pages:
+                alloc.free(pages)
+        return metas, np.asarray(k), np.asarray(v)
+
+    def prepare_handover_adopt(self, metas: Sequence[tuple]):
+        """Successor-side reservation: allocate fresh pages for the
+        not-yet-resident blocks of `metas`. Returns (pages, kept_metas,
+        want_idx) — the transfer write lands bytes into `pages`, then
+        commit_handover_adopt registers them (or abort_ frees them).
+        Trims to what the pool can take right now: a handover must never
+        preempt live work on the successor."""
+        alloc = self.allocator
+        tier_contains = getattr(alloc, "tier_contains", lambda h: False)
+        kept: list[tuple] = []
+        want_idx: list[int] = []
+        for i, (h, p, toks) in enumerate(metas):
+            if alloc.match_length([h]) or tier_contains(h):
+                continue
+            kept.append((h, p, toks))
+            want_idx.append(i)
+        n_fit = min(len(kept), alloc.num_free)
+        kept, want_idx = kept[:n_fit], want_idx[:n_fit]
+        if not kept:
+            return None
+        pages = alloc.allocate(len(kept))
+        if pages is None:
+            return None
+        return pages, kept, want_idx
+
+    def commit_handover_adopt(self, pages, metas) -> int:
+        """The batch's bytes landed (transfer ack fired): content-address
+        the reserved pages and release them into the reclaimable cache —
+        registration publishes 'stored' events, so routers immediately
+        score this worker for the migrated prefixes."""
+        for page, (h, p, toks) in zip(pages, metas):
+            self.allocator.register_promoted(page, h, p, tuple(toks))
+        self.allocator.free(pages)
+        return len(pages)
+
+    def abort_handover_adopt(self, pages) -> None:
+        """The bytes never landed: the unregistered reservation goes
+        straight back to the free list — no leak, no half-adopted KV."""
+        self.allocator.free(pages)
+
     def allocate_for_remote_prefill(
         self,
         request_id: str,

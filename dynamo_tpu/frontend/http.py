@@ -140,6 +140,7 @@ class HttpService:
                 web.get("/v1/debug/stalls", self.debug_stalls),
                 web.post("/v1/debug/profile", self.debug_profile),
                 web.post("/v1/admin/drain", self.admin_drain),
+                web.post("/v1/admin/handover", self.admin_handover),
                 web.post("/clear_kv_blocks", self.clear_kv_blocks),
             ]
         )
@@ -280,12 +281,16 @@ class HttpService:
         self.metrics.request_done(model, kind, "429", time.time() - t0)
         return self._reject_429(decision.message, decision.retry_after_s)
 
-    async def admin_drain(self, request: web.Request) -> web.Response:
-        """POST /v1/admin/drain {"instance_id": ..., "model": ...}:
-        flip one worker into graceful drain — it deregisters, finishes
-        in-flight requests within its drain budget, then exits 0
-        (equivalently: SIGTERM the worker process). `/v1/fleet` shows
-        state=draining while it winds down."""
+    async def _admin_worker_op(
+        self, request: web.Request, op: str, fn_attr: str,
+        call,
+    ) -> web.Response:
+        """Shared body of the one-worker admin ops (drain / handover):
+        parse {"instance_id", "model", ...}, resolve the pipeline, and
+        dispatch through its `fn_attr` callable — 400 on a missing id,
+        404 on an unresolvable model, 501 on an in-process pipeline,
+        502 when the worker call fails. `call(fn, instance_id, body)`
+        performs the op-specific invocation."""
         try:
             body = await request.json()
         except Exception:
@@ -303,20 +308,45 @@ class HttpService:
                 {"error": f"model {name!r} not found (pass \"model\")"},
                 status=404,
             )
-        if pipeline.drain_fn is None:
+        fn = getattr(pipeline, fn_attr, None)
+        if fn is None:
             return web.json_response(
-                {"error": "drain requires a distributed pipeline "
+                {"error": f"{op} requires a distributed pipeline "
                           "(in=http out=dyn); in-process engines stop "
                           "with the server"},
                 status=501,
             )
         try:
-            reply = await pipeline.drain_fn(instance_id)
+            reply = await call(fn, instance_id, body)
         except Exception as e:
-            logger.exception("drain of %s failed", instance_id)
+            logger.exception("%s of %s failed", op, instance_id)
             return web.json_response({"error": str(e)}, status=502)
         return web.json_response(
             {"status": "ok", "instance_id": instance_id, **(reply or {})}
+        )
+
+    async def admin_drain(self, request: web.Request) -> web.Response:
+        """POST /v1/admin/drain {"instance_id": ..., "model": ...}:
+        flip one worker into graceful drain — it deregisters, finishes
+        in-flight requests within its drain budget, then exits 0
+        (equivalently: SIGTERM the worker process). `/v1/fleet` shows
+        state=draining while it winds down."""
+        return await self._admin_worker_op(
+            request, "drain", "drain_fn",
+            lambda fn, iid, body: fn(iid),
+        )
+
+    async def admin_handover(self, request: web.Request) -> web.Response:
+        """POST /v1/admin/handover {"instance_id": ..., "successor":
+        optional, "model": optional}: retire one worker by LIVE KV
+        migration (docs/operations.md "Rolling upgrades & worker
+        handover") — it stops admissions, ships its hot KV pages to a
+        successor over the transfer plane, lets in-flight streams
+        continue there via replay (warm, no prompt recompute), then
+        exits 0. Any failure degrades to the plain drain."""
+        return await self._admin_worker_op(
+            request, "handover", "handover_fn",
+            lambda fn, iid, body: fn(iid, body.get("successor")),
         )
 
     async def clear_kv_blocks(self, request: web.Request) -> web.Response:

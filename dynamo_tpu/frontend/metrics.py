@@ -207,6 +207,9 @@ class FrontendMetrics:
         from dynamo_tpu.telemetry import debug as _debug
 
         lines.extend(_debug.spec_lines())  # fixed dynamo_tpu_spec_* name
+        # data-integrity rejections (disk-tier checksum misses, corrupt
+        # transfer frames): process-global like the phase histograms
+        lines.extend(_debug.integrity_lines())
         return "\n".join(lines) + "\n"
 
 

@@ -65,6 +65,11 @@ class ModelPipeline:
         #: graceful drain (POST /v1/admin/drain; distributed pipelines
         #: only — docs/operations.md "Overload & draining")
         self.drain_fn = None
+        #: async (instance_id, successor=None) -> reply dict: retire one
+        #: worker by live KV handover (POST /v1/admin/handover;
+        #: distributed pipelines only — docs/operations.md "Rolling
+        #: upgrades & worker handover")
+        self.handover_fn = None
 
     async def chat_stream(
         self, request: ChatCompletionRequest, context: Optional[Context] = None
@@ -370,6 +375,7 @@ def router_pipeline(
         embed_router.close()
         flush_router.close()
         drain_router.close()
+        handover_router.close()
         if kv_router is not None:
             await kv_router.stop()
 
@@ -391,12 +397,26 @@ def router_pipeline(
     drain_router = PushRouter(
         router.source, "drain", mode=RouterMode.DIRECT
     )
+    handover_router = PushRouter(
+        router.source, "handover", mode=RouterMode.DIRECT
+    )
 
     async def drain_fn(instance_id: str) -> dict:
         """Flip ONE worker into graceful drain (its `drain` ingress
         handler answers immediately; the wind-down runs worker-side)."""
         async for reply in drain_router.generate(
             {}, instance_id=instance_id, max_attempts=1
+        ):
+            return reply if isinstance(reply, dict) else {}
+        return {}
+
+    async def handover_fn(instance_id: str, successor=None) -> dict:
+        """Retire ONE worker by live KV handover (its `handover` ingress
+        handler acks immediately; migration + drain run worker-side —
+        docs/operations.md "Rolling upgrades & worker handover")."""
+        async for reply in handover_router.generate(
+            {"successor": successor}, instance_id=instance_id,
+            max_attempts=1,
         ):
             return reply if isinstance(reply, dict) else {}
         return {}
@@ -429,6 +449,7 @@ def router_pipeline(
     )
     pipeline.flush_fn = flush_fn
     pipeline.drain_fn = drain_fn
+    pipeline.handover_fn = handover_fn
     return pipeline
 
 

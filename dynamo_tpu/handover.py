@@ -1,0 +1,143 @@
+"""Worker handover: live KV migration between workers (ISSUE 12 tentpole).
+
+A retiring worker stops admissions (the PR-8 drain machinery), exports its
+device-registered KV blocks in the canonical quantized wire format, ships
+them to a successor over the EXISTING disagg transfer planes (the
+successor pre-reserves pages and arms a transfer waiter, so the bytes
+ride the very same `KvTransferClient.send` path — device / shm / bulk /
+inline, checksummed end-to-end — that remote prefill uses), and the
+successor registers the landed pages, publishing `stored` KV events so
+KV-aware routing scores it immediately. In-flight streams then continue
+on the successor via the PR-10 crash-replay path — their prompt blocks
+are already warm, so the replayed prefill is a prefix-cache hit, not a
+recompute — and the retiring process exits 0.
+
+This module holds the orchestration-side helpers shared by worker.py and
+the planner actuators (planner/service.py FleetHandover, FleetFlipper):
+topological ordering of the registered block graph, byte-bounded
+batching, and the one-shot direct ingress call.
+
+Failure semantics (docs/operations.md "Rolling upgrades & worker
+handover"): any fault mid-extract / mid-offer / mid-transfer / mid-adopt
+degrades the handover to the plain drain path — the worker finishes (or
+severs) its in-flight work and exits; streams continue on survivors by
+replay-with-recompute; the successor's reservation watchdog frees its
+pages. No phase can hang a stream or leak a page on either side.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+from typing import Optional, Sequence
+
+import msgpack
+
+from dynamo_tpu.runtime.codec import encode_frame, read_frame
+
+logger = logging.getLogger(__name__)
+
+#: blocks shipped per transfer batch; each batch is an independently
+#: adoptable topo-contiguous segment, so a mid-migration failure leaves
+#: the successor with a usable prefix, never a broken chain
+BATCH_BLOCKS = int(os.environ.get("DYN_KV_HANDOVER_BATCH_BLOCKS", "64"))
+
+#: byte budget for one handover (hottest chains ship first; beyond this
+#: the remainder stays behind and is recomputed on demand). 0 = unbounded.
+MAX_BYTES = int(os.environ.get("DYN_KV_HANDOVER_MAX_BYTES", "0"))
+
+#: successor-side reservation watchdog: pages allocated for an offer are
+#: freed if the bytes never land inside this window
+ADOPT_TIMEOUT_S = float(os.environ.get("DYN_KV_HANDOVER_ADOPT_TIMEOUT", "30"))
+
+
+def topo_order_metas(page_meta_values) -> list[tuple]:
+    """Order (seq_hash, parent_hash, tokens) triples parents-first.
+
+    Input is the allocator's registered-page metadata (any order). The
+    output is a DFS preorder over the block forest rooted at
+    parent_hash=None — every block appears after its parent, so any
+    topo-contiguous batch prefix is adoptable on its own. Orphan
+    subtrees (parent evicted locally) are dropped: the successor could
+    never prefix-match into them, and `adopt_blocks` would refuse them
+    anyway."""
+    by_hash: dict[int, tuple] = {}
+    for h, p, tokens in page_meta_values:
+        by_hash[h] = (p, tokens)
+    children: dict[Optional[int], list[int]] = {}
+    roots: list[int] = []
+    for h, (p, _) in by_hash.items():
+        if p is None:
+            roots.append(h)
+        elif p in by_hash:
+            children.setdefault(p, []).append(h)
+        # else: orphan subtree — skipped
+    out: list[tuple] = []
+    stack = sorted(roots, reverse=True)
+    while stack:
+        h = stack.pop()
+        p, tokens = by_hash[h]
+        out.append((h, p, tokens))
+        stack.extend(sorted(children.get(h, ()), reverse=True))
+    return out
+
+
+def batches(metas: Sequence[tuple], batch_blocks: int = 0):
+    """Yield topo-contiguous meta batches of at most `batch_blocks`."""
+    n = batch_blocks or BATCH_BLOCKS
+    for i in range(0, len(metas), n):
+        yield metas[i : i + n]
+
+
+def metas_to_wire(metas: Sequence[tuple]) -> list:
+    return [
+        [int(h), None if p is None else int(p), list(t)] for h, p, t in metas
+    ]
+
+
+def metas_from_wire(wire) -> list[tuple]:
+    return [(int(h), None if p is None else int(p), tuple(t)) for h, p, t in wire]
+
+
+async def call_ingress(
+    host: str,
+    port: int,
+    endpoint: str,
+    body: Optional[dict] = None,
+    timeout: float = 10.0,
+    request_id: str = "direct",
+) -> dict:
+    """One-shot direct call to a worker's ingress `endpoint`: returns the
+    FIRST data frame as a dict. Raises RuntimeError on an error frame
+    (message preserved) and on an empty stream. Used by worker→worker
+    handover offers and the planner's flip/handover actuators — peers that
+    have no PushRouter and need exactly one request/reply."""
+    reader, writer = await asyncio.open_connection(host, port)
+    try:
+        writer.write(
+            encode_frame(
+                {"op": "call", "request_id": request_id, "endpoint": endpoint},
+                msgpack.packb(body or {}, use_bin_type=True),
+            )
+        )
+        await writer.drain()
+        deadline = asyncio.get_running_loop().time() + timeout
+        while True:
+            left = deadline - asyncio.get_running_loop().time()
+            if left <= 0:
+                raise asyncio.TimeoutError(
+                    f"{endpoint} call to {host}:{port} timed out"
+                )
+            header, payload = await asyncio.wait_for(read_frame(reader), left)
+            op = header.get("op")
+            if op == "error":
+                raise RuntimeError(header.get("message") or f"{endpoint} failed")
+            if op == "data":
+                reply = msgpack.unpackb(payload, raw=False)
+                return reply if isinstance(reply, dict) else {"reply": reply}
+            if op == "end":
+                raise RuntimeError(f"{endpoint} returned no reply")
+            # anything else (stray frames): keep reading until deadline
+    finally:
+        writer.close()

@@ -56,14 +56,21 @@ class RadixTree:
     # -- mutation ----------------------------------------------------------
 
     def apply_event(self, worker_id: str, event: dict) -> None:
-        """Apply one stored/removed event (the wire dict form emitted by
-        workers — worker.py _publish_loop)."""
+        """Apply one stored/removed/handed_over event (the wire dict form
+        emitted by workers — worker.py _publish_loop)."""
         kind = event["kind"]
         hashes = event["block_hashes"]
         if kind == "stored":
             self._store(worker_id, hashes)
         elif kind == "removed":
             self._remove(worker_id, hashes)
+        elif kind == "handed_over":
+            # bulk ownership move (worker handover): every block this
+            # worker held now lives on the successor — reassign in one
+            # pass instead of waiting for lease expiry + stored-event
+            # propagation, so prefix routing scores the successor the
+            # moment the retiring worker announces
+            self.move_worker(worker_id, str(event.get("successor") or ""))
         else:
             logger.warning("unknown kv event kind %r", kind)
         self.events_applied += 1
@@ -94,6 +101,35 @@ class RadixTree:
                 workers.discard(worker_id)
                 if not workers:
                     del self._workers_by_hash[h]
+        return len(hashes)
+
+    def take_worker(self, worker_id: str) -> list[int]:
+        """remove_worker that RETURNS the dropped hashes — the sharded
+        indexer's cross-shard move is a take on the source shard + a
+        bulk store on the destination shard."""
+        hashes = self._hashes_by_worker.pop(worker_id, set())
+        for h in hashes:
+            workers = self._workers_by_hash.get(h)
+            if workers is not None:
+                workers.discard(worker_id)
+                if not workers:
+                    del self._workers_by_hash[h]
+        return list(hashes)
+
+    def store_bulk(self, worker_id: str, hashes: Sequence[int]) -> None:
+        self._store(worker_id, hashes)
+
+    def move_worker(self, src: str, dst: str) -> int:
+        """Bulk ownership move (worker handover): reassign every block of
+        `src` to `dst` in one pass. Slightly optimistic — blocks whose
+        transfer actually failed are credited to `dst` too — which is
+        self-healing: a mis-routed prefix costs one cold prefill, and
+        the successor's own stored/removed events correct the set."""
+        if not dst or dst == src:
+            return self.remove_worker(src)
+        hashes = self.take_worker(src)
+        if hashes:
+            self._store(dst, hashes)
         return len(hashes)
 
     def clear(self) -> None:
@@ -181,6 +217,11 @@ class NativeRadixTree:
     def apply_event(self, worker_id: str, event: dict) -> None:
         kind = event["kind"]
         hashes = event["block_hashes"]  # KeyError parity with RadixTree
+        if kind == "handed_over":
+            self.move_worker(worker_id, str(event.get("successor") or ""))
+            self._unknown_events += 1  # events_applied parity (native
+            # move counts no apply)
+            return
         if kind not in ("stored", "removed"):
             logger.warning("unknown kv event kind %r", kind)
             self._unknown_events += 1
@@ -199,6 +240,24 @@ class NativeRadixTree:
         if wid is None:
             return 0
         return self._lib.dyn_radix_remove_worker(self._ptr, wid)
+
+    def take_worker(self, worker_id: str) -> list[int]:
+        """The native index cannot enumerate a worker's hashes — the
+        take degrades to a remove and returns nothing; the successor's
+        own stored events repopulate its score within one metrics
+        interval (documented honest degradation of the bulk move)."""
+        self.remove_worker(worker_id)
+        return []
+
+    def store_bulk(self, worker_id: str, hashes) -> None:
+        if not hashes:
+            return
+        arr, buf, n = self._hash_buf(list(hashes))
+        self._lib.dyn_radix_apply(self._ptr, self._intern(worker_id), 0, buf, n)
+        self._live.add(worker_id)
+
+    def move_worker(self, src: str, dst: str) -> int:
+        return self.remove_worker(src)
 
     def clear(self) -> None:
         self._lib.dyn_radix_clear(self._ptr)
@@ -341,15 +400,37 @@ class KvIndexerSharded:
             self._busy[shard] = True
             try:
                 worker_id, events = item
-                with lock:
-                    for ev in events:
-                        try:
+                for ev in events:
+                    try:
+                        if ev.get("kind") == "handed_over":
+                            # cross-shard bulk move: src and dst may hash
+                            # to different shards, so the move cannot run
+                            # under one shard lock — _move locks both in
+                            # index order (no ABBA deadlock)
+                            self._move(
+                                worker_id, str(ev.get("successor") or "")
+                            )
+                            continue
+                        with lock:
                             tree.apply_event(worker_id, ev)
-                        except Exception:
-                            logger.exception("shard %d apply failed", shard)
+                    except Exception:
+                        logger.exception("shard %d apply failed", shard)
                 self._applied[shard] += len(events)
             finally:
                 self._busy[shard] = False
+
+    def _move(self, src: str, dst: str) -> None:
+        s_src = self._shard_of(src)
+        s_dst = self._shard_of(dst) if dst else s_src
+        if not dst or s_src == s_dst:
+            with self._locks[s_src]:
+                self.trees[s_src].move_worker(src, dst)
+            return
+        a, b = sorted((s_src, s_dst))
+        with self._locks[a], self._locks[b]:
+            hashes = self.trees[s_src].take_worker(src)
+            if hashes:
+                self.trees[s_dst].store_bulk(dst, hashes)
 
     def add_event_hook(self, hook) -> None:
         self._on_event_hooks.append(hook)
@@ -374,6 +455,10 @@ class KvIndexerSharded:
         shard = self._shard_of(worker_id)
         with self._locks[shard]:
             return self.trees[shard].remove_worker(worker_id)
+
+    def move_worker(self, src: str, dst: str) -> None:
+        """Bulk ownership move (worker handover), cross-shard safe."""
+        self._move(src, dst)
 
     async def drain_for_tests(self, timeout: float = 2.0) -> None:
         """Wait until every shard queue is empty AND no apply is mid-flight
@@ -437,6 +522,10 @@ class KvIndexer:
 
     def remove_worker(self, worker_id: str) -> int:
         return self.tree.remove_worker(worker_id)
+
+    def move_worker(self, src: str, dst: str) -> int:
+        """Bulk ownership move (worker handover)."""
+        return self.tree.move_worker(src, dst)
 
     async def stop(self) -> None:
         if self._sub is not None:

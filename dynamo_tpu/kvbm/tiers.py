@@ -12,15 +12,30 @@ from __future__ import annotations
 import ctypes
 import logging
 import os
+import threading
 from collections import OrderedDict
 from dataclasses import dataclass
 from typing import Callable, Optional
 
 import numpy as np
+import xxhash
 
 from dynamo_tpu import native
 
 logger = logging.getLogger(__name__)
+
+#: process-global count of disk-tier blocks whose at-rest checksum failed
+#: on read (bit-rot -> cache miss, never garbage tokens). Exposed on both
+#: Prometheus surfaces as dynamo_tpu_kvbm_disk_corrupt_total
+#: (telemetry/debug.integrity_lines).
+_disk_corrupt_lock = threading.Lock()
+disk_corrupt_total = 0
+
+
+def _count_disk_corrupt() -> None:
+    global disk_corrupt_total
+    with _disk_corrupt_lock:
+        disk_corrupt_total += 1
 
 
 @dataclass
@@ -241,7 +256,13 @@ class DiskTier:
     k stacked over v, stored as raw uint8 bytes because np.save round-trips
     ml_dtypes.bfloat16 as an unusable void dtype), in-memory LRU index.
     Process-scoped (the index is not persisted), like the reference's G3
-    pool."""
+    pool.
+
+    At-rest integrity: every file carries an 8-byte xxh3 trailer over the
+    block bytes; `get` verifies it and treats a mismatch as a miss —
+    the file is unlinked, the corruption counted
+    (dynamo_tpu_kvbm_disk_corrupt_total) and NEVER served. Bit-rot on
+    disk costs a cache miss, not garbage tokens."""
 
     def __init__(self, directory: str, capacity_bytes: int):
         self.directory = directory
@@ -252,6 +273,9 @@ class DiskTier:
         #:              are asymmetric
         self._index: OrderedDict[int, tuple] = OrderedDict()
         self._bytes = 0
+        #: this tier's corrupt-read count (the module counter aggregates
+        #: every tier in the process)
+        self.corrupt_reads = 0
 
     def _path(self, seq_hash: int) -> str:
         return os.path.join(self.directory, f"{seq_hash & 0xFFFFFFFFFFFFFFFF:016x}.npy")
@@ -275,8 +299,13 @@ class DiskTier:
             np.ascontiguousarray(entry.k).view(np.uint8).reshape(-1),
             np.ascontiguousarray(entry.v).view(np.uint8).reshape(-1),
         ])
+        # xxh3 trailer over the block bytes, stored IN the same file so
+        # the sum can never get separated from the data it covers
+        digest = np.frombuffer(
+            xxhash.xxh3_64_digest(flat.tobytes()), np.uint8
+        )
         try:
-            np.save(self._path(entry.seq_hash), flat)
+            np.save(self._path(entry.seq_hash), np.concatenate([flat, digest]))
         except OSError:
             logger.exception("disk tier write failed for %x", entry.seq_hash)
             return False
@@ -295,17 +324,45 @@ class DiskTier:
         meta = self._index.get(seq_hash)
         if meta is None:
             return None
-        parent_hash, tokens, _, dtype_name, k_shape, v_shape = meta
+        parent_hash, tokens, nbytes, dtype_name, k_shape, v_shape = meta
         try:
             raw = np.load(self._path(seq_hash))
         except OSError:
             logger.exception("disk tier read failed for %x", seq_hash)
             self.pop(seq_hash)
             return None
+        except ValueError:
+            # np.load parsed a header that disagrees with the file body
+            # (truncation / partial write): corruption, same remedy as a
+            # failed checksum — miss + unlink + count
+            logger.warning(
+                "disk tier block %x is malformed (truncated?); dropping "
+                "as corrupt", seq_hash,
+            )
+            self.corrupt_reads += 1
+            _count_disk_corrupt()
+            self.pop(seq_hash)
+            return None
+        # verify the xxh3 trailer BEFORE handing any byte out: a
+        # truncated or bit-rotted file is a MISS (unlink + counter), the
+        # caller re-prefills the block — never decodes from garbage
+        if (
+            len(raw) != nbytes + 8
+            or xxhash.xxh3_64_digest(raw[:nbytes].tobytes())
+            != raw[nbytes:].tobytes()
+        ):
+            logger.warning(
+                "disk tier block %x failed its checksum (%d bytes); "
+                "dropping as corrupt", seq_hash, len(raw),
+            )
+            self.corrupt_reads += 1
+            _count_disk_corrupt()
+            self.pop(seq_hash)
+            return None
         dtype = _dtype_from_name(dtype_name)
         kb = int(np.prod(k_shape)) * dtype.itemsize
         k = raw[:kb].view(dtype).reshape(k_shape)
-        v = raw[kb:].view(dtype).reshape(v_shape)
+        v = raw[kb:nbytes].view(dtype).reshape(v_shape)
         self._index.move_to_end(seq_hash)
         return BlockEntry(
             seq_hash=seq_hash, parent_hash=parent_hash, tokens=tokens,

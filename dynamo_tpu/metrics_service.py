@@ -116,6 +116,17 @@ _WORKER_FIELDS = (
     # role flips this worker performed (closed-loop planner actuation —
     # docs/operations.md "Closed-loop autoscaling & role flips")
     ("flips_total", "counter"),
+    # worker handover (docs/operations.md "Rolling upgrades & worker
+    # handover"): completed handovers vs drain fallbacks on the retiring
+    # side, KV bytes/blocks migrated out, blocks adopted as a successor,
+    # and transfer frames the codec checksum rejected (wire corruption
+    # never lands)
+    ("handovers_total", "counter"),
+    ("handover_fallbacks_total", "counter"),
+    ("handover_bytes_total", "counter"),
+    ("handover_blocks_total", "counter"),
+    ("handovers_adopted_total", "counter"),
+    ("kv_transfer_corrupt_total", "counter"),
 )
 
 #: numeric per-worker fields copied verbatim into the /v1/fleet snapshot
@@ -127,6 +138,9 @@ _FLEET_WORKER_FIELDS = (
     "stalls_total", "overload_rejects", "deadline_expired", "flips_total",
     "spec_drafted", "spec_accepted", "spec_skipped_ineligible",
     "spec_skipped_cooldown", "spec_accept_rate", "spec_window_drafted",
+    "handovers_total", "handover_fallbacks_total", "handover_bytes_total",
+    "handover_blocks_total", "handovers_adopted_total",
+    "kv_transfer_corrupt_total",
 )
 
 
@@ -401,9 +415,13 @@ class MetricsService:
                 }
                 state = m.get("state")
                 if isinstance(state, str):
-                    # serving | draining — doctor's draining-worker rule
-                    # and fleet_top key off this
+                    # serving | draining | handover — doctor's draining-
+                    # worker / handover-stuck rules and fleet_top key
+                    # off this
                     w["state"] = state
+                phase = m.get("handover_phase")
+                if isinstance(phase, str):
+                    w["handover_phase"] = phase
                 for f in _FLEET_WORKER_FIELDS:
                     v = m.get(f)
                     if isinstance(v, (int, float)):
@@ -805,6 +823,9 @@ class MetricsService:
         from dynamo_tpu.telemetry import debug as _debug
 
         lines += _debug.spec_lines(PREFIX)
+        # data-integrity rejections (disk-tier checksum misses, corrupt
+        # transfer frames) — same both-surfaces contract as spec_lines
+        lines += _debug.integrity_lines(PREFIX)
         # per-phase latency histograms (telemetry plane, process-global)
         from dynamo_tpu.telemetry import phases
 

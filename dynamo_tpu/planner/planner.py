@@ -515,16 +515,25 @@ class ControlRunner:
         interval_s: Optional[float] = None,
         now_fn=time.monotonic,
         status_fn=None,
+        handover=None,
     ):
         self.planner = planner
         self.connector = connector
         self.observe = observe
         self.flipper = flipper
+        #: async (role) -> bool: retire one worker of `role` via live KV
+        #: handover (docs/operations.md "Rolling upgrades & worker
+        #: handover"). When set, scale-DOWN steps try it first — the
+        #: victim's hot pages migrate to a peer and its in-flight
+        #: streams continue there — and fall back to connector.scale
+        #: (kill/terminate) when it fails.
+        self.handover = handover
         self.interval_s = interval_s or planner.config.interval_s
         self.now_fn = now_fn
         self.status_fn = status_fn
         self.decisions = {
             "scale_up": 0, "scale_down": 0, "flip": 0, "hold": 0,
+            "handover": 0,
         }
         self.actions_clamped = 0
         self.cooldown_holds = 0
@@ -598,14 +607,45 @@ class ControlRunner:
                 "planner: %s %d -> %d (%s)", role, observed, step_target,
                 acts.reason,
             )
-            await self.connector.scale(role, step_target, observed)
+            handed = 0
+            if step < 0 and self.handover is not None:
+                # scale-down prefers handover over kill: each retired
+                # worker ships its hot KV to a peer and exits 0 — same
+                # capacity change, none of the recompute. Partial
+                # success (k of |step|) shrinks the kill fallback.
+                for _ in range(-step):
+                    ok = False
+                    try:
+                        ok = bool(await self.handover(role))
+                    except Exception:
+                        logger.exception(
+                            "planner: %s handover failed", role
+                        )
+                    if not ok:
+                        break
+                    handed += 1
+                if handed:
+                    self._record(
+                        "handover", role,
+                        **{"from": observed, "to": observed - handed},
+                    )
+                    logger.info(
+                        "planner: retired %d %s worker(s) by handover",
+                        handed, role,
+                    )
+            if handed < abs(step):
+                # the handed-over workers are ALREADY exiting; only the
+                # remainder (or a scale-up) goes through the connector
+                await self.connector.scale(
+                    role, step_target + handed, observed,
+                )
+                self._record(
+                    "scale_up" if step > 0 else "scale_down", role,
+                    **{"from": observed, "to": step_target},
+                )
             budget -= 1
             acted = True
             self._last_action[role] = now
-            self._record(
-                "scale_up" if step > 0 else "scale_down", role,
-                **{"from": observed, "to": step_target},
-            )
         if not acted:
             self.decisions["hold"] += 1
 

@@ -164,9 +164,7 @@ class FleetFlipper:
         )
 
     async def __call__(self, from_role: str, to_role: str) -> bool:
-        import msgpack
-
-        from dynamo_tpu.runtime.codec import encode_frame, read_frame
+        from dynamo_tpu.handover import call_ingress
 
         candidates = [
             inst
@@ -186,32 +184,10 @@ class FleetFlipper:
         # one-shot direct call to the victim's ingress `flip` op — the
         # worker acks immediately and winds the flip down in background
         try:
-            reader, writer = await asyncio.open_connection(
-                victim.host, victim.port
+            await call_ingress(
+                victim.host, victim.port, "flip", {"role": to_role},
+                timeout=5.0, request_id=f"flip-{self.flips}",
             )
-            try:
-                writer.write(
-                    encode_frame(
-                        {
-                            "op": "call",
-                            "request_id": f"flip-{self.flips}",
-                            "endpoint": "flip",
-                        },
-                        msgpack.packb({"role": to_role}, use_bin_type=True),
-                    )
-                )
-                await writer.drain()
-                header, payload = await asyncio.wait_for(
-                    read_frame(reader), timeout=5.0
-                )
-                if header.get("op") == "error":
-                    logger.warning(
-                        "flip refused by %s: %s", victim.instance_id,
-                        header.get("message"),
-                    )
-                    return False
-            finally:
-                writer.close()
         except Exception:
             logger.warning(
                 "flip call to %s failed", victim.instance_id, exc_info=True
@@ -223,3 +199,178 @@ class FleetFlipper:
             victim.instance_id,
         )
         return True
+
+
+class FleetHandover:
+    """Actuates a worker handover (docs/operations.md "Rolling upgrades &
+    worker handover"): picks the least-busy flippable instance of the
+    role (or the named victim), calls its `handover` ingress op — the
+    worker migrates its KV to a peer it picks itself, finishes/severs
+    its streams and exits 0. Used by ControlRunner's scale-down path and
+    the rolling-upgrade sweep."""
+
+    def __init__(self, observer: FleetObserver):
+        self.observer = observer
+        self.handovers = 0
+
+    def _source(self, role: str):
+        return (
+            self.observer._decode_src
+            if role == "decode"
+            else self.observer._prefill_src
+        )
+
+    async def __call__(
+        self,
+        role: str,
+        victim_id: Optional[str] = None,
+        successor_id: Optional[str] = None,
+    ) -> bool:
+        from dynamo_tpu.handover import call_ingress
+
+        candidates = [
+            inst
+            for inst in self._source(role).list()
+            if inst.metadata.get("flippable")
+            and inst.port
+            and (victim_id is None or inst.instance_id == victim_id)
+        ]
+        if len(self._source(role).list()) < 2 or not candidates:
+            # a lone worker has no successor; retiring it would drop the
+            # pool to zero — refuse, the caller falls back to kill/spawn
+            return False
+        snap = self.observer.metrics.snapshot()
+        victim = min(
+            candidates,
+            key=lambda i: (
+                int(snap.get(i.instance_id, {}).get("num_running", 0) or 0),
+                i.instance_id,
+            ),
+        )
+        try:
+            await call_ingress(
+                victim.host, victim.port, "handover",
+                {"successor": successor_id},
+                timeout=5.0, request_id=f"handover-{self.handovers}",
+            )
+        except Exception:
+            logger.warning(
+                "handover call to %s failed", victim.instance_id,
+                exc_info=True,
+            )
+            return False
+        self.handovers += 1
+        logger.info(
+            "handover dispatched to %s (%s)", victim.instance_id, role
+        )
+        return True
+
+
+async def rolling_upgrade(
+    observer: FleetObserver,
+    connector,
+    handover: FleetHandover,
+    roles=("decode", "prefill"),
+    cooldown_s: float = 5.0,
+    step_timeout_s: float = 120.0,
+    status_cb=None,
+) -> dict:
+    """Replace every worker in the fleet, one at a time, with zero
+    dropped streams (docs/operations.md "Rolling upgrades & worker
+    handover" — the `dynamo planner --rolling-upgrade` sweep):
+
+    for each worker of each role, oldest-first:
+      1. spawn a replacement (connector.scale to n+1) and wait for it to
+         register — capacity never dips below steady state;
+      2. hand the victim over (its KV migrates to a peer, its streams
+         continue there via replay) and wait for it to deregister;
+      3. flip-style cooldown before the next victim.
+
+    Workers that appear DURING the sweep (the replacements) are not
+    re-upgraded — the victim set is snapshotted per role up front.
+    Returns a summary dict: upgraded / failed instance ids per role."""
+    summary: dict = {}
+    for role in roles:
+        src = (
+            observer._decode_src if role == "decode" else observer._prefill_src
+        )
+        victims = [i.instance_id for i in src.list()]
+        done: list[str] = []
+        failed: list[str] = []
+        summary[role] = {"planned": list(victims), "upgraded": done,
+                         "failed": failed}
+        async def shed_spare(n0: int) -> None:
+            """A victim we failed to retire keeps serving while its
+            replacement is already up: scale the role back to n0 (the
+            connector stops the youngest child = the spare). Without
+            this, --rolling-upgrade one-shot mode — which exits after
+            the sweep, no steady-state loop behind it — would leave the
+            fleet one worker larger per failure, compounding."""
+            cur = len(src.list())
+            if cur > n0:
+                await connector.scale(role, n0, cur)
+
+        for victim in victims:
+            n0 = len(src.list())
+            if victim not in {i.instance_id for i in src.list()}:
+                continue  # already gone (crashed / externally retired)
+            if status_cb is not None:
+                await status_cb(
+                    {"phase": "spawn", "role": role, "victim": victim}
+                )
+            # 1. replacement first: the fleet never runs a worker short
+            await connector.scale(role, n0 + 1, n0)
+            deadline = time.monotonic() + step_timeout_s
+            while time.monotonic() < deadline and len(src.list()) < n0 + 1:
+                await asyncio.sleep(0.25)
+            if len(src.list()) < n0 + 1:
+                logger.warning(
+                    "rolling upgrade: replacement for %s never registered; "
+                    "skipping this victim", victim,
+                )
+                failed.append(victim)
+                continue
+            # baseline refresh (no-op delta): tell the connector the
+            # replacement REGISTERED. LocalConnector retires a spawned
+            # child's pending-capacity credit only when the observed
+            # count rises between its scale() calls — and in a 1-for-1
+            # rolling sweep the count returns to n0 before the next
+            # call, so without this the credit never retires and every
+            # later victim's replacement spawn is silently suppressed
+            # (found by the live CLI drive, 2026-08-04).
+            await connector.scale(role, n0 + 1, n0 + 1)
+            # 2. retire the victim via handover (falls back to drain
+            # inside the worker; either way it deregisters and exits 0)
+            if status_cb is not None:
+                await status_cb(
+                    {"phase": "handover", "role": role, "victim": victim}
+                )
+            ok = await handover(role, victim_id=victim)
+            if not ok:
+                logger.warning(
+                    "rolling upgrade: handover call to %s failed", victim
+                )
+                failed.append(victim)
+                await shed_spare(n0)
+                continue
+            deadline = time.monotonic() + step_timeout_s
+            while time.monotonic() < deadline and victim in {
+                i.instance_id for i in src.list()
+            }:
+                await asyncio.sleep(0.25)
+            if victim in {i.instance_id for i in src.list()}:
+                logger.warning(
+                    "rolling upgrade: %s still registered after its "
+                    "handover budget", victim,
+                )
+                failed.append(victim)
+                await shed_spare(n0)
+                continue
+            done.append(victim)
+            logger.info(
+                "rolling upgrade: %s replaced (%d/%d %s)",
+                victim, len(done), len(victims), role,
+            )
+            # 3. fleet-wide cooldown between victims (flip-style)
+            await asyncio.sleep(cooldown_s)
+    return summary

@@ -179,7 +179,17 @@ class RemoteFabric:
         async with self._send_lock:
             if self._writer is None:
                 raise FabricConnectionError("not connected")
-            self._writer.write(encode_frame(header, payload))
+            # corrupt-kind chaos rules flip a byte of the encoded frame
+            # (queue payloads included) AFTER the codec checksummed it —
+            # the server's read_frame rejects it and drops the session;
+            # this call must fail, never deliver rotten bytes
+            self._writer.write(
+                faults.corrupt_bytes(
+                    "fabric.call",
+                    encode_frame(header, payload),
+                    op=header.get("op"),
+                )
+            )
             await self._writer.drain()
         h, p = await fut
         if not h.get("ok"):

@@ -203,11 +203,24 @@ class PushRouter:
     # -- selection ---------------------------------------------------------
 
     async def _pick(
-        self, request: Any, instance_id: Optional[str]
+        self,
+        request: Any,
+        instance_id: Optional[str],
+        avoid: Optional[str] = None,
     ) -> Instance:
+        """`avoid` names an instance whose stream JUST dropped (crash
+        replay re-dispatch): mark_down already removed it locally, but a
+        racing lease-watch `put` can re-add it while its lease is still
+        live — e.g. a handing-over worker that deregistered but has not
+        exited. Skip it whenever any other instance exists; the replay
+        must land on a survivor."""
         instances = self.source.list()
         if not instances:
             instances = await self.source.wait_for_instances(timeout=2.0)
+        if avoid is not None:
+            others = [i for i in instances if i.instance_id != avoid]
+            if others:
+                instances = others
         if self.mode == RouterMode.DIRECT:
             if instance_id is None:
                 raise ValueError("direct mode requires instance_id")
@@ -254,6 +267,7 @@ class PushRouter:
         emitted: list = []
         replays = 0
         live_request = request
+        avoid: Optional[str] = None  # instance whose stream just dropped
         with telemetry.span(
             "router.dispatch", service="router",
             attrs={"endpoint": self.endpoint, "mode": self.mode.value},
@@ -290,7 +304,7 @@ class PushRouter:
 
             while True:
                 attempts += 1
-                inst = await self._pick(live_request, instance_id)
+                inst = await self._pick(live_request, instance_id, avoid)
                 rspan.set_attr("instance_id", inst.instance_id)
                 rspan.set_attr("attempts", attempts)
                 try:
@@ -354,6 +368,7 @@ class PushRouter:
                         if item is CANCELLED:
                             continue  # loop re-checks ctx.cancelled and notifies
                         if item is None:  # connection dropped mid-stream
+                            avoid = inst.instance_id
                             self.source.mark_down(inst.instance_id)
                             rspan.add_event(
                                 "mark_down", instance=inst.instance_id,

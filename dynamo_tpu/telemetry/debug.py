@@ -101,6 +101,24 @@ def spec_lines(prefix: str = "dynamo_tpu") -> list[str]:
     ]
 
 
+def integrity_lines(prefix: str = "dynamo_tpu") -> list[str]:
+    """Process-global data-integrity counters: KV bytes whose checksum
+    failed verification and were REJECTED — disk-tier blocks at rest
+    (kvbm/tiers.py xxh3 trailer) and transfer-plane frames on the wire
+    (runtime/codec.py framing). Always emitted (zeros included) so the
+    dashboard-name gate sees the families; a nonzero rate is bit-rot or
+    a failing link, never served tokens."""
+    from dynamo_tpu.disagg import transfer as _transfer
+    from dynamo_tpu.kvbm import tiers as _tiers
+
+    return [
+        f"# TYPE {prefix}_kvbm_disk_corrupt_total counter",
+        f"{prefix}_kvbm_disk_corrupt_total {_tiers.disk_corrupt_total}",
+        f"# TYPE {prefix}_transfer_corrupt_total counter",
+        f"{prefix}_transfer_corrupt_total {_transfer.transfer_corrupt_total}",
+    ]
+
+
 # -- payloads -------------------------------------------------------------
 
 

@@ -25,6 +25,10 @@ Phases:
                        is churning (new buckets / fused-step counts /
                        mixed-shape combinations) — the compile hazard
                        the 3-axis mixed family introduced.
+  handover_adopt_ms    worker handover, successor side: one batch's
+                       page reservation armed -> bytes landed ->
+                       registered (docs/operations.md "Rolling
+                       upgrades & worker handover").
 """
 
 from __future__ import annotations
@@ -42,6 +46,10 @@ PHASES = (
     "router_dispatch_ms",
     "disagg_transfer_ms",
     "compile_ms",
+    # worker handover: successor-side batch adopt latency, reservation
+    # armed -> pages registered (transfer landing included) — the
+    # Grafana "Handover" row's latency panel
+    "handover_adopt_ms",
 )
 
 #: ms ladder wide enough for a sub-ms decode step and a 60s stuck

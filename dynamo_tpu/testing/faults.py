@@ -45,6 +45,15 @@ Kinds:
                sites), then proceed
     partition  alias of drop with prob=1.0 and no `times` cap — a peer
                that stays unreachable until the rule is removed
+    corrupt    bit-rot on the wire: flip one byte of the ENCODED frame
+               AFTER its checksum was computed, at byte-moving sites
+               (transfer.send payload frames, fabric.call frames — the
+               queue plane). `fire()` ignores corrupt rules; sites that
+               ship bytes call `corrupt_bytes(point, buf, ...)` instead,
+               which returns the (possibly flipped) buffer. The receiver
+               must reject the frame via the codec's xxh3 check — this is
+               how tests prove corruption becomes a connection-level
+               failure, never landed data.
 """
 
 from __future__ import annotations
@@ -66,6 +75,13 @@ HOOK_POINTS = (
     "transfer.send",
     "transfer.land",
     "engine.step",
+    # worker handover phases (docs/operations.md "Rolling upgrades &
+    # worker handover"): a fault at any of them must degrade the
+    # handover to the plain drain + replay-by-recompute path
+    "handover.extract",
+    "handover.offer",
+    "handover.transfer",
+    "handover.adopt",
 )
 
 
@@ -91,7 +107,7 @@ class FaultRule:
             raise ValueError(
                 f"unknown hook point {self.point!r}; valid: {HOOK_POINTS}"
             )
-        if self.kind not in ("drop", "error", "delay", "partition"):
+        if self.kind not in ("drop", "error", "delay", "partition", "corrupt"):
             raise ValueError(f"unknown fault kind {self.kind!r}")
         if self.kind == "partition":
             # a partition IS a persistent drop: normalize so firing logic
@@ -137,11 +153,19 @@ class FaultInjector:
         with self._lock:
             self.rules.clear()
 
-    def _decide(self, point: str, ctx: dict) -> Optional[FaultRule]:
+    def _decide(
+        self, point: str, ctx: dict, corrupting: bool = False
+    ) -> Optional[FaultRule]:
         """First matching rule that wins its coin flip (under the lock:
-        the RNG and the `fired` budgets are shared state)."""
+        the RNG and the `fired` budgets are shared state). `corrupting`
+        selects between the two disjoint rule populations: fire()/
+        fire_sync() consider everything EXCEPT corrupt rules (those are
+        payload transforms, not control-flow faults), corrupt_bytes()
+        considers ONLY corrupt rules."""
         with self._lock:
             for rule in self.rules:
+                if (rule.kind == "corrupt") != corrupting:
+                    continue
                 if rule.point != point or not rule.matches(ctx):
                     continue
                 if rule.times is not None and rule.fired >= rule.times:
@@ -182,6 +206,38 @@ class FaultInjector:
             return
         self._raise(point, rule)
 
+    def corrupt(self, point: str, buf: bytes, **ctx) -> bytes:
+        """Flip one byte of `buf` when a matching corrupt rule fires —
+        the position is drawn from the seeded RNG so scenarios replay.
+        The flip lands in the BACK half of the buffer, which for an
+        encoded frame is payload territory (either checksum tripping is
+        a rejection; payload bytes are the interesting victim for KV
+        pages)."""
+        rule = self._decide(point, ctx, corrupting=True)
+        if rule is None or not buf:
+            return buf
+        with self._lock:
+            pos = self.rng.randrange(len(buf) // 2, len(buf))
+        logger.warning(
+            "fault injected: corrupt %s byte %d/%d %s",
+            point, pos, len(buf), ctx,
+        )
+        out = bytearray(buf)
+        out[pos] ^= 0xFF
+        return bytes(out)
+
+    def wants_corrupt(self, point: str) -> bool:
+        """True when an armed (budget-remaining) corrupt rule targets
+        `point` — lets vectored-write fast paths pre-flatten only when a
+        corruption could actually fire."""
+        with self._lock:
+            return any(
+                r.kind == "corrupt"
+                and r.point == point
+                and (r.times is None or r.fired < r.times)
+                for r in self.rules
+            )
+
 
 #: the process-global injector; None = fault injection entirely off
 _injector: Optional[FaultInjector] = None
@@ -216,6 +272,21 @@ def fire_sync(point: str, **ctx) -> None:
     inj = _injector
     if inj is not None:
         inj.fire_sync(point, **ctx)
+
+
+def corrupt_bytes(point: str, buf: bytes, **ctx) -> bytes:
+    """Hook entry for byte-moving sites: returns `buf`, possibly with one
+    byte flipped per an installed corrupt rule. No-op (one global load)
+    when no injector is installed."""
+    inj = _injector
+    if inj is None:
+        return buf
+    return inj.corrupt(point, buf, **ctx)
+
+
+def wants_corrupt(point: str) -> bool:
+    inj = _injector
+    return inj is not None and inj.wants_corrupt(point)
 
 
 def parse_spec(spec: str) -> list[FaultRule]:

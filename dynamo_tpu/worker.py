@@ -145,6 +145,18 @@ class Worker:
         self.flips = 0
         self._prefill_embedded = None
         self._flip_lock = asyncio.Lock()
+        #: worker handover (docs/operations.md "Rolling upgrades & worker
+        #: handover"): live KV migration to a successor before this
+        #: process exits — the planner's zero-downtime alternative to
+        #: kill+spawn, and the drain path's warm-KV upgrade
+        self.handing_over = False
+        self._handover_phase: Optional[str] = None
+        self.handovers = 0          # completed as the retiring side
+        self.handover_fallbacks = 0  # degraded to plain drain
+        self.handover_bytes = 0      # KV bytes shipped to successors
+        self.handover_blocks = 0     # blocks accepted by successors
+        self.handovers_adopted = 0   # blocks adopted as a successor
+        self._handover_tasks: set[asyncio.Task] = set()
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -222,6 +234,8 @@ class Worker:
         self.ingress.add_handler("flush", self._flush)
         self.ingress.add_handler("drain", self._drain_handler)
         self.ingress.add_handler("flip", self._flip_handler)
+        self.ingress.add_handler("handover", self._handover_handler)
+        self.ingress.add_handler("handover_offer", self._handover_offer_handler)
         await self.ingress.start()
 
         metadata = {"model": self.card.name}
@@ -230,7 +244,15 @@ class Worker:
             # an engine whose KV pool survives the flip (external/echo
             # engines have no paged KV to keep warm — they stay put)
             metadata["flippable"] = True
-        if (self.enable_disagg or self.kv_remote) and self.runner is not None:
+        # The KV transfer plane serves every single-host engine worker,
+        # not just disagg/kv-remote ones: worker handover ships the
+        # retiring worker's registered pages through it, so any jax
+        # worker must be able to RECEIVE pages (docs/operations.md
+        # "Rolling upgrades & worker handover"). SPMD groups refuse —
+        # extraction holds only the process-local Hkv slice.
+        if self.runner is not None and not isinstance(
+            self.runner, SpmdEngineRunner
+        ):
             from dynamo_tpu.disagg import KvTransferServer, device_transfer
 
             # decode also serves G4 fetches / could stage in future
@@ -510,6 +532,399 @@ class Worker:
             "inflight": self.ingress.num_inflight,
         }
 
+    # -- worker handover (docs/operations.md "Rolling upgrades & worker
+    # handover"): live KV migration to a successor, then exit 0 ----------
+
+    def _handover_capable(self) -> bool:
+        from dynamo_tpu.engine.async_engine import SpmdEngineRunner as _Spmd
+
+        if self.mock is not None:
+            return True
+        return self.runner is not None and not isinstance(
+            self.runner, _Spmd
+        )
+
+    async def handover(
+        self,
+        successor_id: Optional[str] = None,
+        budget_s: Optional[float] = None,
+    ) -> bool:
+        """Retire this worker with its KV pages kept warm fleet-wide:
+
+        1. **drain** — stop admissions (deregister; routers retry
+           survivors), exactly the PR-8 drain machinery;
+        2. **extract** — topo-order the device-registered block set and
+           pull each batch to host in the canonical quantized wire
+           format (engine.export_blocks_by_hash);
+        3. **offer/transfer** — the successor reserves pages and arms a
+           transfer waiter (handover_offer), then the bytes ride the
+           normal `KvTransferClient.send` page write — device/shm/bulk/
+           inline, checksummed end to end;
+        4. **adopt** (successor side) — landed pages get registered,
+           'stored' events publish, KV-aware routers score the successor
+           immediately; this worker announces the bulk ownership move on
+           its KV-event subject (`handed_over`);
+        5. **finish** — in-flight streams get the remaining budget, then
+           `drained` fires and the host process exits 0. Streams still
+           open at that point continue on survivors via the PR-10 replay
+           path — their prompt blocks are already warm on the successor,
+           so the replayed prefill is a prefix hit, not a recompute.
+
+        ANY failure mid-phase degrades to the plain drain+replay path:
+        pages freed on both sides, zero hung streams. Returns True only
+        when the migration completed."""
+        if self.draining:
+            await self.drained.wait()
+            return False
+        loop = asyncio.get_running_loop()
+        self.handing_over = True
+        self.draining = True
+        self._handover_phase = "drain"
+        logger.info(
+            "worker %s handing over (%d in flight)",
+            self.instance_id, self.ingress.num_inflight,
+        )
+        await self._deregister()
+        ok = False
+        try:
+            ok = await self._handover_migrate(successor_id)
+        except Exception:
+            logger.exception(
+                "handover migration failed; degrading to drain+replay"
+            )
+        if ok:
+            self.handovers += 1
+            logger.info("worker %s handover complete", self.instance_id)
+        else:
+            self.handover_fallbacks += 1
+            logger.warning(
+                "worker %s handover fell back to plain drain (streams "
+                "continue on survivors by replay-with-recompute)",
+                self.instance_id,
+            )
+        self._handover_phase = "finish"
+        budget = self.drain_budget_s if budget_s is None else budget_s
+        deadline = loop.time() + max(budget, 0.0)
+        while self._busy() and loop.time() < deadline:
+            await asyncio.sleep(0.05)
+        if self._busy():
+            logger.info(
+                "handover: %d stream(s) still in flight at exit; they "
+                "continue on survivors via stream replay",
+                self.ingress.num_inflight,
+            )
+        self._handover_phase = None
+        self.handing_over = False
+        self.drained.set()
+        return ok
+
+    async def _pick_successor(self, successor_id: Optional[str]):
+        """A live peer of this worker's CURRENT role to adopt the pages:
+        the named instance when given, else every candidate sorted (the
+        caller tries them in order). Returns a list of Instance."""
+        from dynamo_tpu.runtime.component import InstanceSource
+
+        if self.role == "decode":
+            comp, ep = self.decode_component, self.decode_endpoint
+        elif "prefill" in self.component:
+            comp, ep = self.component, self.endpoint_name
+        else:
+            comp, ep = "prefill", "prefill"
+        src = InstanceSource(self.runtime.fabric, self.namespace, comp, ep)
+        await src.start()
+        try:
+            deadline = asyncio.get_running_loop().time() + 2.0
+            while asyncio.get_running_loop().time() < deadline:
+                peers = [
+                    i
+                    for i in src.list()
+                    if i.instance_id != self.instance_id
+                    and (
+                        successor_id is None
+                        or i.instance_id == successor_id
+                    )
+                ]
+                if peers:
+                    return peers
+                await asyncio.sleep(0.05)
+            return []
+        finally:
+            await src.stop()
+
+    async def _handover_migrate(self, successor_id: Optional[str]) -> bool:
+        from dynamo_tpu import handover as ho
+        from dynamo_tpu.testing import faults
+
+        if not self._handover_capable():
+            return False
+        runner, mock = self.runner, self.mock
+        self._handover_phase = "extract"
+        await faults.fire("handover.extract")
+        if runner is not None:
+            metas = await runner.submit(lambda eng: eng.handover_metas())
+        else:
+            metas = ho.topo_order_metas(
+                list(mock.allocator._page_meta.values())
+            )
+        peers = await self._pick_successor(successor_id)
+        if not peers:
+            logger.warning("handover: no successor instance available")
+            return False
+        succ, last_err = None, None
+        for cand in peers[:3]:
+            try:
+                done = await self._handover_to(cand, metas, runner, mock)
+            except Exception as e:
+                last_err = e
+                logger.warning(
+                    "handover to %s failed: %s", cand.instance_id, e
+                )
+                continue
+            if done:
+                succ = cand
+                break
+        if succ is None:
+            if last_err is not None:
+                logger.warning("handover: every candidate failed")
+            return False
+        # bulk ownership move: indexers reassign this worker's block
+        # entries to the successor NOW instead of waiting for lease
+        # expiry + stored-event propagation (kv_router/indexer.py
+        # `handed_over`)
+        import msgpack as _msgpack
+
+        await self.runtime.fabric.publish(
+            f"{KV_EVENT_SUBJECT}.{self.instance_id}",
+            {"instance_id": self.instance_id, "count": 1},
+            _msgpack.packb(
+                [{
+                    "kind": "handed_over",
+                    "block_hashes": [],
+                    "successor": succ.instance_id,
+                }],
+                use_bin_type=True,
+            ),
+        )
+        return True
+
+    async def _handover_to(self, succ, metas, runner, mock) -> bool:
+        """Ship every batch to ONE candidate successor. True when all
+        batches were offered (an empty want-list counts — the successor
+        already holds those blocks)."""
+        from dynamo_tpu import handover as ho
+        from dynamo_tpu.testing import faults
+
+        if not metas:
+            # nothing registered to migrate — the handover is trivially
+            # complete (the drain tail still runs)
+            return True
+        client = None
+        try:
+            for batch in ho.batches(metas):
+                self._handover_phase = "offer"
+                await faults.fire("handover.offer")
+                if mock is not None:
+                    reply = await ho.call_ingress(
+                        succ.host, succ.port, "handover_offer",
+                        {
+                            "metas": ho.metas_to_wire(batch),
+                            "source": self.instance_id,
+                            "payload": False,
+                        },
+                    )
+                    self.handover_blocks += int(reply.get("adopted") or 0)
+                    continue
+                exported = await runner.submit(
+                    lambda eng, b=batch: eng.export_blocks_by_hash(
+                        [h for h, _, _ in b]
+                    )
+                )
+                if exported is None:
+                    continue  # evicted since the listing — batch gone
+                emetas, k, v = exported
+                reply = await ho.call_ingress(
+                    succ.host, succ.port, "handover_offer",
+                    {
+                        "metas": ho.metas_to_wire(emetas),
+                        "source": self.instance_id,
+                        "payload": True,
+                    },
+                )
+                page_ids = reply.get("page_ids") or []
+                if not page_ids:
+                    continue  # successor already holds the whole batch
+                want = list(reply.get("want_idx") or ())
+                self._handover_phase = "transfer"
+                await faults.fire("handover.transfer")
+                if client is None:
+                    from dynamo_tpu.disagg.transfer import KvTransferClient
+
+                    client = KvTransferClient()
+                if len(want) != k.shape[2]:
+                    import numpy as np
+
+                    k = np.ascontiguousarray(k[:, :, want])
+                    v = np.ascontiguousarray(v[:, :, want])
+                ok = await asyncio.wait_for(
+                    client.send(
+                        reply["host"], int(reply["port"]), reply["rid"],
+                        page_ids, k, v, 0,
+                    ),
+                    timeout=ho.ADOPT_TIMEOUT_S,
+                )
+                if not ok:
+                    return False
+                self.handover_bytes += int(k.nbytes + v.nbytes)
+                self.handover_blocks += len(page_ids)
+                if ho.MAX_BYTES and self.handover_bytes >= ho.MAX_BYTES:
+                    logger.info(
+                        "handover: byte budget reached (%d); leaving the "
+                        "colder tail behind", self.handover_bytes,
+                    )
+                    break
+            return True
+        finally:
+            if client is not None:
+                client.close()
+
+    async def _handover_handler(self, ctx, request):
+        """`handover` ingress op (POST /v1/admin/handover, planner
+        FleetHandover): validate, acknowledge immediately, migrate in
+        the background — mirrors the drain/flip handler shape."""
+        req = request if isinstance(request, dict) else {}
+        if not self._handover_capable():
+            raise ValueError(
+                f"engine kind {self.engine_kind!r} has no KV pool to hand "
+                "over; use drain"
+            )
+        if self.draining:
+            # refuse instead of ack: an ack here would make a planner
+            # (whose instance watch hasn't seen the deregistration yet)
+            # count the SAME victim as a second retirement and skip its
+            # kill fallback — the caller must pick another worker
+            raise ValueError(
+                f"worker {self.instance_id} is already "
+                f"{'handing over' if self.handing_over else 'draining'}"
+            )
+        successor = req.get("successor") or None
+        budget = (
+            float(req["budget_s"]) if req.get("budget_s") is not None else None
+        )
+        task = asyncio.get_running_loop().create_task(
+            self.handover(successor, budget)
+        )
+        task.add_done_callback(
+            lambda t: t.cancelled() or t.exception()  # observe, never raise
+        )
+        yield {
+            "handing_over": True,
+            "inflight": self.ingress.num_inflight,
+            "successor": successor,
+            "budget_s": self.drain_budget_s if budget is None else budget,
+        }
+
+    async def _handover_offer_handler(self, ctx, request):
+        """Successor side: reserve pages for the offered block batch and
+        arm a transfer waiter; the source then writes the bytes through
+        the normal transfer plane addressed at those pages, and the
+        watchdog task registers them on landing (or frees them on
+        timeout/failure — a dead source can never leak our pages)."""
+        import time as _time
+        import uuid as _uuid
+
+        from dynamo_tpu import handover as ho
+        from dynamo_tpu.telemetry import phases
+        from dynamo_tpu.testing import faults
+
+        await faults.fire("handover.adopt")
+        if self.draining:
+            from dynamo_tpu.runtime.ingress import RetryableHandlerError
+
+            raise RetryableHandlerError(
+                f"worker {self.instance_id} is draining; cannot adopt"
+            )
+        req = request if isinstance(request, dict) else {}
+        metas = ho.metas_from_wire(req.get("metas") or [])
+        if not metas:
+            yield {"adopted": 0, "page_ids": []}
+            return
+        if self.mock is not None:
+            # mock fleets: metadata-only adopt — the mock's KV "content"
+            # IS the hash chain, so registering the metas gives replayed
+            # streams the same warm-prefix admission a real pool would
+            alloc = self.mock.allocator
+            n = 0
+            for h, p, toks in metas:
+                if alloc.match_length([h]):
+                    continue
+                pages = alloc.allocate(1)
+                if pages is None:
+                    break
+                alloc.register_promoted(pages[0], h, p, tuple(toks))
+                alloc.free(pages)
+                n += 1
+            self.handovers_adopted += n
+            yield {"adopted": n, "page_ids": [], "payload": False}
+            return
+        if (
+            self.runner is None
+            or self.transfer_server is None
+            or not self._handover_capable()
+        ):
+            raise ValueError(
+                f"worker {self.instance_id} cannot adopt a handover"
+            )
+        if req.get("payload") is False:
+            raise ValueError("metadata-only offer refused: this worker "
+                             "holds real KV bytes")
+        runner = self.runner
+        prep = await runner.submit(
+            lambda eng: eng.prepare_handover_adopt(metas)
+        )
+        if prep is None:
+            yield {"adopted": 0, "page_ids": []}
+            return
+        pages, kept, want_idx = prep
+        rid = f"ho-{self.instance_id}-{_uuid.uuid4().hex[:8]}"
+        waiter = self.transfer_server.expect(rid)
+        t0 = _time.perf_counter()
+
+        async def _watch():
+            try:
+                await asyncio.wait_for(waiter, ho.ADOPT_TIMEOUT_S)
+            except BaseException:
+                self.transfer_server.forget(rid)
+                await runner.submit(
+                    lambda eng: eng.abort_handover_adopt(pages)
+                )
+                logger.warning(
+                    "handover adopt %s never landed; %d reserved pages "
+                    "freed", rid, len(pages),
+                )
+                return
+            n = await runner.submit(
+                lambda eng: eng.commit_handover_adopt(pages, kept)
+            )
+            self.handovers_adopted += n
+            phases.observe(
+                "handover_adopt_ms", (_time.perf_counter() - t0) * 1000.0
+            )
+            logger.info(
+                "adopted %d handover block(s) from %s",
+                n, req.get("source") or "?",
+            )
+
+        task = asyncio.get_running_loop().create_task(_watch())
+        self._handover_tasks.add(task)
+        task.add_done_callback(self._handover_tasks.discard)
+        yield {
+            "rid": rid,
+            "page_ids": list(pages),
+            "want_idx": list(want_idx),
+            "host": self.advertise_host,
+            "port": self.transfer_server.port,
+        }
+
     async def stop(self, drain_timeout: float = 30.0) -> None:
         """Graceful shutdown (reference: the vLLM drain handlers,
         examples worker.py:156-170): deregister FIRST so routers stop
@@ -527,6 +942,14 @@ class Worker:
                 )
         for t in self._tasks:
             t.cancel()
+        for t in list(self._handover_tasks):
+            # cancelling an adopt watchdog frees its page reservation
+            # (the _watch except-path) before the runner goes away
+            t.cancel()
+        if self._handover_tasks:
+            await asyncio.gather(
+                *self._handover_tasks, return_exceptions=True
+            )
         if self._prefill_embedded is not None:
             await self._prefill_embedded.stop()
             self._prefill_embedded = None
@@ -953,8 +1376,21 @@ class Worker:
                 m["flips_total"] = self.flips
                 # drain visibility: /v1/fleet shows state=draining while
                 # the worker winds down (doctor's draining-worker rule
-                # keys off this instead of tripping dead/stalled rules)
-                m["state"] = "draining" if self.draining else "serving"
+                # keys off this instead of tripping dead/stalled rules);
+                # state=handover while a live KV migration runs (doctor's
+                # handover-stuck rule watches its age + phase)
+                m["state"] = (
+                    "handover"
+                    if self.handing_over
+                    else "draining" if self.draining else "serving"
+                )
+                if self._handover_phase is not None:
+                    m["handover_phase"] = self._handover_phase
+                m["handovers_total"] = self.handovers
+                m["handover_fallbacks_total"] = self.handover_fallbacks
+                m["handover_bytes_total"] = self.handover_bytes
+                m["handover_blocks_total"] = self.handover_blocks
+                m["handovers_adopted_total"] = self.handovers_adopted
                 eng = getattr(self.runner, "engine", None)
                 if eng is not None and getattr(eng, "slo", None) is not None:
                     try:
@@ -991,6 +1427,11 @@ class Worker:
                     for plane, n in self.transfer_server.transfers.items():
                         m[f"kv_transfer_{plane}_total"] = n
                     m["remote_prefills_total"] = self.remote_prefills
+                    # frames the codec's checksum rejected (wire bit-rot
+                    # / chaos corrupt rules): corrupt pages never land
+                    m["kv_transfer_corrupt_total"] = (
+                        self.transfer_server.corrupt_rejects
+                    )
                 m["instance_id"] = self.instance_id
                 m["model"] = self.card.name
                 await fabric.publish(

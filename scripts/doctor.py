@@ -29,6 +29,15 @@ Rules (each emits severity + worker + evidence + suggested action):
                        down via SIGTERM / POST /v1/admin/drain) — an
                        info note, and the dead/stalled rules are
                        suppressed for it so a drain never pages
+  handover-worker /    a worker reports state=handover (live KV
+  handover-stuck       migration, POST /v1/admin/handover) — info while
+                       fresh; escalates to handover-stuck when it went
+                       SILENT past the dead threshold mid-migration
+                       (the fallback-to-drain path should have ended it)
+  handover-fallback-   handovers keep degrading to plain drain fleet-
+  storm                wide — successors refusing or the transfer plane
+                       failing; upgrades silently lose their warm-KV
+                       benefit
   overload             bounded admission is rejecting (overload_rejects
                        climbing -> "shedding, raise capacity"), or the
                        waiting queue is deep while the role burns its
@@ -97,6 +106,9 @@ FLIP_STORM_COUNT = 2
 OSCILLATION_WINDOW_FACTOR = 3.0
 #: fallback window (seconds) when the frame advertises no cooldown
 OSCILLATION_WINDOW_FLOOR_S = 60.0
+#: handover drain-fallbacks (exceeding completions) before the
+#: fallback-storm rule fires
+FALLBACK_STORM_COUNT = 3
 
 
 def _finding(severity: str, rule: str, worker: Optional[str], summary: str,
@@ -144,8 +156,44 @@ def diagnose(
             if burn is not None:
                 role_burn[role] = max(role_burn.get(role, 0.0), float(burn))
 
+    #: fleet-wide handover fallback tally (storm rule below)
+    handover_done = handover_fb = 0
+
     for iid, w in sorted(workers.items()):
         age = float(w.get("last_seen_s") or 0.0)
+        handover_done += int(w.get("handovers_total") or 0)
+        handover_fb += int(w.get("handover_fallbacks_total") or 0)
+        if str(w.get("state") or "") == "handover":
+            # live KV migration (POST /v1/admin/handover / planner
+            # scale-down / rolling upgrade): planned, suppress the
+            # dead/stalled/skew rules like a drain. But EVERY phase is
+            # deadline-bounded and any failure degrades to drain — a
+            # handover that went silent past the dead threshold is stuck.
+            wedged = age > DEAD_AFTER_S
+            findings.append(_finding(
+                "warning" if wedged else "info",
+                "handover-stuck" if wedged else "handover-worker", iid,
+                (f"{iid} is mid-handover but went silent "
+                 f"(last_seen {age:.1f}s ago, phase="
+                 f"{w.get('handover_phase') or '?'}) — the fallback-to-"
+                 "drain path should have ended this"
+                 if wedged else
+                 f"{iid} is handing over (phase="
+                 f"{w.get('handover_phase') or '?'}, "
+                 f"{w.get('num_running') or 0} running)"),
+                {"state": "handover", "last_seen_s": age,
+                 "handover_phase": w.get("handover_phase"),
+                 "num_running": w.get("num_running"),
+                 "handover_bytes_total": w.get("handover_bytes_total")},
+                ("check the worker's JSONL log for the stuck phase; if "
+                 "the process is alive, SIGTERM it — the drain path "
+                 "still exits 0 and streams replay on survivors"
+                 if wedged else
+                 "no action: KV pages are migrating to a successor; the "
+                 "worker exits 0 when done (or falls back to a plain "
+                 "drain on any failure)"),
+            ))
+            continue
         if str(w.get("state") or "") == "draining":
             # planned wind-down (SIGTERM / POST /v1/admin/drain): the
             # dead/stalled/skew rules below would misread a drain as an
@@ -326,6 +374,20 @@ def diagnose(
                     "scale the role up (planner/operator) or shed load; "
                     "fleet_top's BURN column names the worst workers",
                 ))
+
+    if handover_fb >= FALLBACK_STORM_COUNT and handover_fb > handover_done:
+        findings.append(_finding(
+            "warning", "handover-fallback-storm", None,
+            f"{handover_fb} handover(s) degraded to plain drain vs "
+            f"{handover_done} completed — upgrades are losing their "
+            "warm-KV benefit fleet-wide",
+            {"handover_fallbacks_total": handover_fb,
+             "handovers_total": handover_done},
+            "read the retiring workers' logs for the failing phase "
+            "(extract / offer / transfer / adopt); common causes: "
+            "successors with full pools, a partitioned transfer plane, "
+            "or single-worker pools with no successor at all",
+        ))
 
     findings.extend(_planner_rules((fleet or {}).get("planner")))
 

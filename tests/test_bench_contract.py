@@ -157,6 +157,25 @@ def test_cpu_fallback_line_is_labeled_and_carries_tpu_artifact():
     assert fo["modeled_overhead_pct"] < 1.0, fo
     assert fo["measured_overhead_pct"] is not None, fo
     assert fo["measured_overhead_pct"] < 30.0, fo
+    # worker-handover A/B (ISSUE 12): the accounting is DETERMINISTIC by
+    # construction — the 48-token prompt exports exactly its 12 full
+    # blocks, the whole prompt lands cached on the successor (no prompt
+    # recompute), bytes/flops follow exactly from the wire format and
+    # 2·P·T, and the modeled TTFT ratio counts prefill-chunk dispatches
+    # (1 warm chunk vs 4 cold at chunk=16). The wall TTFT pair gets a
+    # generous sanity band only (box noise).
+    ho = ex["handover_ab"]
+    assert "error" not in ho, ho
+    assert ho["blocks_moved"] == ho["prompt_tokens"] // ho["page_size"]
+    assert ho["blocks_adopted"] == ho["blocks_moved"]
+    assert ho["bytes_moved"] == ho["blocks_moved"] * ho["block_bytes"]
+    assert ho["cached_tokens"] >= ho["prompt_tokens"], ho
+    assert ho["prefill_flops_saved"] == (
+        2 * ho["params"] * ho["cached_tokens"]
+    )
+    assert ho["modeled_ttft_ratio"] == 0.25, ho
+    assert ho["ttft_warm_s"] > 0 and ho["ttft_cold_s"] > 0
+    assert ho["measured_ttft_ratio"] < 1.5, ho  # sanity band
 
 
 def test_bench_http_counts_failures_instead_of_raising():

@@ -353,6 +353,140 @@ def test_chaos_midstream_sigkill_stream_replay_bit_identical():
         cluster.stop()
 
 
+# -- process-level worker handover (ISSUE 12) --------------------------------
+
+
+def _worker_instance_id(worker) -> str:
+    import re
+
+    with open(worker.log_path) as f:
+        m = re.search(r"worker (\w+) up", f.read())
+    assert m, "worker never logged its instance id"
+    return m.group(1)
+
+
+def _admin_post(port: int, path: str, body: dict,
+                timeout: float = 15.0) -> tuple[int, dict]:
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        try:
+            return e.code, json.loads(e.read())
+        except Exception:
+            return e.code, {}
+
+
+def test_chaos_handover_process_exits_zero_stream_continues():
+    """The tentpole's process-level acceptance: POST /v1/admin/handover
+    retires the worker serving a LIVE stream — its KV (mock: the
+    registered hash chains) migrates to the survivor, the client's
+    stream continues BIT-IDENTICALLY via replay on the warm survivor,
+    and the retiring process exits 0."""
+    cluster = ChaosCluster(
+        num_workers=1, max_inflight=32, engine="mock", mock_step=0.08,
+        drain_budget=2.0, frontend_args=("--stream-replay",),
+    )
+    try:
+        prompt = "hand me over, exactly"
+        n_tok = 120
+        ref = _stream_content(cluster.http_port, prompt, n_tok)
+        assert len(ref) > 0
+        victim = cluster.workers[0]
+        victim_id = _worker_instance_id(victim)
+
+        with concurrent.futures.ThreadPoolExecutor(max_workers=1) as pool:
+            # the stream starts while the victim is the ONLY worker, so
+            # it must be mid-flight there when the handover severs it
+            fut = pool.submit(
+                _stream_content, cluster.http_port, prompt, n_tok, 90.0
+            )
+            time.sleep(0.4)
+            survivor = cluster.add_worker()  # boots while tokens flow
+            time.sleep(0.8)  # frontend's watch sees the survivor
+            status, reply = _admin_post(
+                cluster.http_port, "/v1/admin/handover",
+                {"instance_id": victim_id},
+            )
+            assert status == 200, reply
+            assert reply.get("handing_over") is True
+            text = fut.result(timeout=90)
+        assert text == ref, (
+            f"stream diverged across handover:\nref={ref!r}\ngot={text!r}"
+        )
+        # the stream really was severed and continued (not merely
+        # finished on the victim before the handover landed)
+        with open(cluster.frontend.log_path) as f:
+            assert f.read().count("replaying stream") >= 1
+        # the retiring process exits 0 on its own (drained fires)
+        assert victim.proc.wait(timeout=60) == 0, (
+            open(victim.log_path).read()[-2000:]
+        )
+        with open(victim.log_path) as f:
+            assert "drained; exiting" in f.read()
+        # the survivor keeps serving
+        assert cluster.request("after handover", timeout=30)[0] == 200
+        del survivor
+    finally:
+        cluster.stop()
+
+
+def test_chaos_sigkill_mid_handover_degrades_to_replay():
+    """Kill-at-phase, process level: the retiring worker is SIGKILLed
+    MID-handover (a fault-injected delay pins it inside the offer
+    phase). The in-flight stream still continues bit-identically on the
+    survivor via plain crash replay — a dying handover can never hang or
+    corrupt a stream."""
+    import os
+
+    # the initial worker carries a fault table that WEDGES its handover
+    # in the offer phase, so the SIGKILL lands mid-handover
+    os.environ["DYNTPU_FAULTS"] = "handover.offer:delay:1.0:delay_ms=10000"
+    try:
+        cluster = ChaosCluster(
+            num_workers=1, max_inflight=32, engine="mock", mock_step=0.08,
+            drain_budget=2.0, frontend_args=("--stream-replay",),
+        )
+    finally:
+        del os.environ["DYNTPU_FAULTS"]
+    try:
+        victim = cluster.workers[0]
+        victim_id = _worker_instance_id(victim)
+        prompt = "kill me mid-migration"
+        n_tok = 120
+        ref = _stream_content(cluster.http_port, prompt, n_tok)
+
+        with concurrent.futures.ThreadPoolExecutor(max_workers=1) as pool:
+            fut = pool.submit(
+                _stream_content, cluster.http_port, prompt, n_tok, 90.0
+            )
+            time.sleep(0.4)
+            survivor = cluster.add_worker()
+            time.sleep(0.8)
+            status, _ = _admin_post(
+                cluster.http_port, "/v1/admin/handover",
+                {"instance_id": victim_id},
+            )
+            assert status == 200
+            time.sleep(1.0)  # inside the injected offer-phase delay
+            victim.kill(signal.SIGKILL)
+            text = fut.result(timeout=90)
+        assert text == ref, (
+            f"stream diverged across mid-handover kill:\n"
+            f"ref={ref!r}\ngot={text!r}"
+        )
+        assert victim.proc.returncode not in (None, 0)
+        assert cluster.request("after kill", timeout=30)[0] == 200
+        del survivor
+    finally:
+        cluster.stop()
+
+
 # -- in-process disagg chaos: transfer faults -------------------------------
 
 

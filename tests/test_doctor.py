@@ -184,6 +184,75 @@ def test_rules_fire_on_the_recorded_fleet():
     assert all(f["worker"] != "w-healthy" for f in findings)
 
 
+def test_handover_rules_fire_on_recorded_snapshots():
+    """handover-worker / handover-stuck / handover-fallback-storm
+    (ISSUE 12): a live migration is an info note with the dead/stalled
+    rules suppressed; one SILENT past the dead threshold is stuck; a
+    fleet whose handovers keep degrading to drain is a storm."""
+    doctor = _load_doctor()
+    fleet = {
+        "workers": {
+            "w-ho": {
+                "role": "decode", "last_seen_s": 0.3, "tok_s": 500.0,
+                "state": "handover", "handover_phase": "transfer",
+                "num_running": 2, "handover_bytes_total": 4096,
+            },
+            "w-ho-stuck": {
+                "role": "decode", "last_seen_s": 42.0, "tok_s": 0.0,
+                "state": "handover", "handover_phase": "offer",
+                "stalls_total": 1,
+            },
+        },
+        "roles": {},
+    }
+    findings = doctor.diagnose(fleet, {}, {})
+    by_rule = {}
+    for f in findings:
+        by_rule.setdefault(f["rule"], []).append(f)
+    assert [f["worker"] for f in by_rule["handover-worker"]] == ["w-ho"]
+    assert by_rule["handover-worker"][0]["severity"] == "info"
+    assert "transfer" in by_rule["handover-worker"][0]["summary"]
+    stuck = by_rule["handover-stuck"]
+    assert [f["worker"] for f in stuck] == ["w-ho-stuck"]
+    assert stuck[0]["severity"] == "warning"
+    assert stuck[0]["evidence"]["handover_phase"] == "offer"
+    # neither trips dead/stalled while mid-handover
+    assert all(
+        f["rule"] not in ("dead-worker", "stalled-worker")
+        for f in findings
+    )
+    assert "handover-fallback-storm" not in by_rule
+
+    # fallback storm: fleet-wide drain degradations outnumber successes
+    storm = {
+        "workers": {
+            f"w{i}": {
+                "role": "decode", "last_seen_s": 0.2, "tok_s": 500.0,
+                "handover_fallbacks_total": 2, "handovers_total": 0,
+            }
+            for i in range(3)
+        },
+        "roles": {},
+    }
+    findings = doctor.diagnose(storm, {}, {})
+    storms = [f for f in findings if f["rule"] == "handover-fallback-storm"]
+    assert len(storms) == 1 and storms[0]["severity"] == "warning"
+    assert storms[0]["evidence"]["handover_fallbacks_total"] == 6
+    assert "failing phase" in storms[0]["action"]
+    # a healthy upgrade history (successes >= fallbacks) is quiet
+    ok = {
+        "workers": {
+            "w0": {"role": "decode", "last_seen_s": 0.2,
+                   "handovers_total": 8, "handover_fallbacks_total": 3},
+        },
+        "roles": {},
+    }
+    assert not [
+        f for f in doctor.diagnose(ok, {}, {})
+        if f["rule"] == "handover-fallback-storm"
+    ]
+
+
 def test_snapshot_only_mode_does_not_flag_busy_workers_as_stalled():
     """--snapshot without --flight: no flight doc at all — busy workers
     with no records are the NORM there, not wedged engines (the silent-

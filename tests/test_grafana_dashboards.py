@@ -65,6 +65,9 @@ def _populated_expositions() -> list[str]:
         ext_consecutive_failures=0,
         stalls_total=1, stalls_by_cause={"stalled_stream": 1},
         flips_total=1,
+        handovers_total=1, handover_fallbacks_total=1,
+        handover_bytes_total=1024, handover_blocks_total=2,
+        handovers_adopted_total=2, kv_transfer_corrupt_total=1,
     )
     svc.aggregator._latest["w1"] = (frame, time.monotonic())
     # closed-loop planner status frame (ControlRunner.status shape) so

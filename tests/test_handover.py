@@ -1,0 +1,676 @@
+"""Worker handover (ISSUE 12 tentpole): live KV migration between
+workers, and corruption containment on every byte-moving plane.
+
+Layers:
+
+- pure: topo ordering / batching of the registered block forest, the
+  fault injector's `corrupt` kind.
+- jax e2e (tier-1): a retiring worker's registered pages migrate to a
+  successor over a REAL transfer plane (shm on this box); the successor
+  serves the same prompt bit-identically from warm pages; an IN-FLIGHT
+  stream severed by the handover continues on the successor via stream
+  replay without recomputing the cached prompt blocks; the KV indexer
+  scores the successor for the migrated prefixes (bulk ownership move).
+- fault matrix (tier-1): an injected error at every handover phase
+  (extract / offer / transfer / adopt / successor-dead) degrades to the
+  plain drain path — zero hung streams, pages freed on BOTH allocators.
+  Injected wire corruption (`corrupt` kind) is REJECTED by the codec's
+  checksum and never lands.
+- admin plane: POST /v1/admin/handover drives the whole thing through
+  the HTTP frontend.
+
+The process-level twins (retiring process exits 0, SIGKILL mid-handover)
+live in tests/test_chaos.py and stay `slow`.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+from dynamo_tpu import handover as ho
+from dynamo_tpu.engine import EngineConfig
+from dynamo_tpu.model_card import ModelDeploymentCard
+from dynamo_tpu.runtime import DistributedRuntime, RouterMode
+from dynamo_tpu.runtime.fabric import FabricServer
+from dynamo_tpu.testing import faults
+from dynamo_tpu.worker import Worker
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_injector():
+    faults.uninstall()
+    yield
+    faults.uninstall()
+
+
+@pytest.fixture(autouse=True)
+def _fast_adopt_timeout(monkeypatch):
+    """Reservation watchdogs must fire inside test budgets."""
+    monkeypatch.setattr(ho, "ADOPT_TIMEOUT_S", 1.0)
+    yield
+
+
+def _card(cfg: EngineConfig) -> ModelDeploymentCard:
+    return ModelDeploymentCard(
+        name=cfg.model, tokenizer={"kind": "byte"},
+        context_length=cfg.max_context, kv_page_size=cfg.page_size,
+    )
+
+
+def _req(rid, prompt, n_out, **kw):
+    return {
+        "request_id": rid, "token_ids": prompt, "max_tokens": n_out,
+        "temperature": 0.0, "top_p": 1.0, "top_k": 0, "seed": None,
+        "stop_token_ids": [], "stop_strings": [], "ignore_eos": True,
+        "annotations": {}, **kw,
+    }
+
+
+# -- pure: topo ordering / batching -----------------------------------------
+
+
+def test_topo_order_parents_first_and_orphans_dropped():
+    # forest: 1 -> 2 -> 3, 1 -> 4;  10 (root);  21 -> 22 with 20 missing
+    metas = [
+        (3, 2, (7, 8)),
+        (22, 21, ()),
+        (2, 1, (5, 6)),
+        (10, None, (9,)),
+        (4, 1, ()),
+        (1, None, (1, 2)),
+        (21, 20, ()),  # orphan: parent 20 was evicted locally
+    ]
+    out = ho.topo_order_metas(metas)
+    hashes = [h for h, _, _ in out]
+    assert 21 not in hashes and 22 not in hashes  # orphan subtree dropped
+    assert set(hashes) == {1, 2, 3, 4, 10}
+    pos = {h: i for i, h in enumerate(hashes)}
+    assert pos[1] < pos[2] < pos[3]
+    assert pos[1] < pos[4]
+    # every batch prefix is adoptable: batches stay topo-contiguous
+    b = list(ho.batches(out, 2))
+    assert [len(x) for x in b] == [2, 2, 1]
+    assert sum((list(x) for x in b), []) == out
+    # wire round-trip
+    assert ho.metas_from_wire(ho.metas_to_wire(out)) == [
+        (h, p, tuple(t)) for h, p, t in out
+    ]
+
+
+# -- pure: the corrupt fault kind -------------------------------------------
+
+
+def test_corrupt_kind_flips_bytes_and_fire_ignores_it():
+    inj = faults.install(seed=3)
+    rule = inj.add_rule("transfer.send", "corrupt", times=2)
+    buf = bytes(range(64)) * 4
+    # fire() must NOT consume corrupt rules (they are payload transforms)
+    run(inj.fire("transfer.send"))
+    assert rule.fired == 0
+    out1 = faults.corrupt_bytes("transfer.send", buf)
+    assert out1 != buf and len(out1) == len(buf)
+    diff = [i for i, (a, b) in enumerate(zip(buf, out1)) if a != b]
+    assert len(diff) == 1 and diff[0] >= len(buf) // 2  # back half
+    assert faults.wants_corrupt("transfer.send")
+    out2 = faults.corrupt_bytes("transfer.send", buf)
+    assert out2 != buf
+    # budget spent: pass-through afterwards
+    assert not faults.wants_corrupt("transfer.send")
+    assert faults.corrupt_bytes("transfer.send", buf) == buf
+    assert inj.fired[("transfer.send", "corrupt")] == 2
+    # seeded determinism: same seed -> same flip positions
+    inj2 = faults.install(seed=3)
+    inj2.add_rule("transfer.send", "corrupt", times=2)
+    run(inj2.fire("transfer.send"))
+    assert faults.corrupt_bytes("transfer.send", buf) == out1
+    faults.uninstall()
+    # no injector: one global load, bytes untouched
+    assert faults.corrupt_bytes("transfer.send", buf) is buf
+
+
+def test_parse_spec_accepts_corrupt():
+    rules = faults.parse_spec("transfer.send:corrupt:1.0:times=1")
+    assert rules[0].kind == "corrupt" and rules[0].times == 1
+
+
+# -- jax e2e: real KV bytes migrate, streams continue warm -------------------
+
+
+def _two_worker_env():
+    """(ctx manager coro pieces) fabric + 2 jax workers + client router."""
+    cfg = EngineConfig.for_tests()
+    return cfg, _card(cfg)
+
+
+async def _stream(router, rid, prompt, n_out, **kw):
+    tokens, finish = [], None
+    async for item in router.generate(_req(rid, prompt, n_out, **kw)):
+        tokens.extend(item.get("token_ids", ()))
+        if item.get("finish_reason"):
+            finish = item["finish_reason"]
+    return tokens, finish
+
+
+def test_handover_migrates_kv_and_successor_serves_warm():
+    """The zero→aha path: warm worker A, start B, hand A over. B adopts
+    A's registered blocks over a REAL transfer plane, the indexer's bulk
+    move scores B for the migrated prefixes, and the same prompt served
+    by B is greedy bit-identical WITH a full-prompt prefix hit (no
+    prompt recompute)."""
+    cfg, card = _two_worker_env()
+
+    async def main():
+        from dynamo_tpu.kv_router.indexer import KvIndexer
+        from dynamo_tpu.tokens import hash_token_blocks
+
+        server = FabricServer(port=0)
+        await server.start()
+        rt_a = await DistributedRuntime.create(server.address)
+        rt_b = await DistributedRuntime.create(server.address)
+        rt_c = await DistributedRuntime.create(server.address)
+        a = Worker(rt_a, card, engine_config=cfg, engine_kind="jax",
+                   namespace="ho", metrics_interval=0.1)
+        await a.start()
+        b = None
+        router = None
+        indexer = KvIndexer(rt_c.fabric)
+        await indexer.start()
+        try:
+            ep = rt_c.namespace("ho").component("backend").endpoint(
+                "generate"
+            )
+            router = await ep.router(mode=RouterMode.ROUND_ROBIN)
+            await router.source.wait_for_instances()
+            prompt = [5, 17, 42, 99, 3, 8, 21, 60, 11, 2, 33, 44]
+            ref, fin = await _stream(router, "warm", prompt, 6)
+            assert fin in ("length", "stop") and len(ref) == 6
+
+            b = Worker(rt_b, card, engine_config=cfg, engine_kind="jax",
+                       namespace="ho", metrics_interval=0.1)
+            await b.start()
+            free_b0 = await b.runner.submit(lambda e: e.allocator.num_free)
+
+            assert await asyncio.wait_for(a.handover(budget_s=2.0), 30)
+            assert a.handovers == 1 and a.handover_fallbacks == 0
+            assert a.handover_bytes > 0 and a.handover_blocks >= 3
+            assert a.drained.is_set()
+
+            for _ in range(100):  # adopt watchdog commits async
+                if b.handovers_adopted >= a.handover_blocks:
+                    break
+                await asyncio.sleep(0.05)
+            assert b.handovers_adopted == a.handover_blocks
+            # the bytes rode a REAL plane (shm on one box; bulk/inline
+            # elsewhere) — never the "nothing moved" path
+            assert sum(b.transfer_server.transfers.values()) >= 1
+
+            hashes = hash_token_blocks(
+                prompt, block_size=cfg.page_size, salt=cfg.model
+            )
+            n = await b.runner.submit(
+                lambda e: e.allocator.match_length(hashes)
+            )
+            assert n == len(hashes), "prompt chain not fully adopted"
+
+            # indexer: the handed_over bulk move + B's stored events
+            # score B for the migrated prefixes; A no longer scores
+            for _ in range(100):
+                scores = indexer.find_matches(hashes)
+                if (
+                    scores.scores.get(b.instance_id, 0) >= len(hashes)
+                    and a.instance_id not in scores.scores
+                ):
+                    break
+                await asyncio.sleep(0.05)
+            scores = indexer.find_matches(hashes)
+            assert scores.scores.get(b.instance_id, 0) >= len(hashes)
+            assert a.instance_id not in scores.scores
+
+            await a.stop(drain_timeout=0)
+            hit0 = await b.runner.submit(
+                lambda e: e.allocator.stats.hit_tokens
+            )
+            again, fin = await _stream(router, "again", prompt, 6)
+            assert again == ref  # greedy bit-identity on the successor
+            hit1 = await b.runner.submit(
+                lambda e: e.allocator.stats.hit_tokens
+            )
+            # the WHOLE prompt came from migrated pages — no recompute
+            assert hit1 - hit0 >= len(hashes) * cfg.page_size
+            # adopted pages are cache content: nothing left referenced
+            active = await b.runner.submit(lambda e: e.allocator.num_active)
+            assert active == 0
+            assert free_b0 == await b.runner.submit(
+                lambda e: e.allocator.num_free
+            )
+        finally:
+            await indexer.stop()
+            if router is not None:
+                router.close()
+            if b is not None:
+                await b.stop(drain_timeout=0)
+            await a.stop(drain_timeout=0)
+            await rt_c.close()
+            await rt_b.close()
+            await rt_a.close()
+            await server.stop()
+
+    run(main())
+
+
+def test_handover_inflight_stream_replays_on_warm_successor():
+    """A stream is mid-flight when the handover lands: the retiring
+    worker severs it at exit, stream replay continues it on the
+    successor BIT-IDENTICALLY (greedy), and the replayed prefill hits
+    the migrated prompt blocks instead of recomputing them."""
+    from dataclasses import replace
+
+    cfg, card = _two_worker_env()
+    # one engine.step() == one emitted token (overlap chaining and the
+    # fused K-step decode both off), so the injected step delay paces
+    # the stream deterministically — otherwise one paced step emits up
+    # to decode_steps tokens and the stream could finish before the
+    # handover severs it
+    cfg = replace(cfg, overlap_decode=False, decode_steps=1)
+
+    async def main():
+        server = FabricServer(port=0)
+        await server.start()
+        rt_a = await DistributedRuntime.create(server.address)
+        rt_b = await DistributedRuntime.create(server.address)
+        rt_c = await DistributedRuntime.create(server.address)
+        a = Worker(rt_a, card, engine_config=cfg, engine_kind="jax",
+                   namespace="hof", metrics_interval=0.1)
+        await a.start()
+        b = None
+        router = None
+        try:
+            ep = rt_c.namespace("hof").component("backend").endpoint(
+                "generate"
+            )
+            router = await ep.router(mode=RouterMode.ROUND_ROBIN)
+            router.replay = True
+            await router.source.wait_for_instances()
+            prompt = [9, 8, 7, 6, 5, 4, 3, 2, 1, 2, 3, 4]
+            n_out = 16
+            # reference: undisturbed greedy run (A is the only worker)
+            ref, fin = await _stream(router, "ref", prompt, n_out)
+            assert fin in ("length", "stop") and len(ref) == n_out
+
+            b = Worker(rt_b, card, engine_config=cfg, engine_kind="jax",
+                       namespace="hof", metrics_interval=0.1)
+            await b.start()
+            # pace BOTH engines' step loops so the stream is genuinely
+            # mid-flight when the handover severs it
+            # 120ms/step x 16 tokens ≈ 2s of stream — the handover
+            # (whose engine-thread submits also pay the paced steps)
+            # plus the sever land well inside it even on a loaded box
+            faults.install(seed=0).add_rule(
+                "engine.step", "delay", delay_ms=120.0
+            )
+            # pin the round-robin cursor so the live stream lands on A
+            # (the worker being retired), not the successor
+            import itertools
+
+            for _ in range(100):
+                if len(router.source.list()) == 2:
+                    break
+                await asyncio.sleep(0.05)
+            ids = sorted(i.instance_id for i in router.source.list())
+            router._rr = itertools.count(ids.index(a.instance_id))
+            inflight = asyncio.create_task(
+                _stream(router, "live", prompt, n_out)
+            )
+            await asyncio.sleep(0.15)  # a few tokens in
+            assert await asyncio.wait_for(a.handover(budget_s=0.0), 30)
+            for _ in range(100):
+                if b.handovers_adopted:
+                    break
+                await asyncio.sleep(0.05)
+            # sever A's live connections (the CLI path exits the process
+            # here); the frontend router replays onto B
+            await a.stop(drain_timeout=0)
+            tokens, fin = await asyncio.wait_for(inflight, 60)
+            assert fin in ("length", "stop")
+            assert tokens == ref, "replayed continuation diverged"
+            assert router.replays >= 1, "stream was never severed"
+            # warm replay: B prefix-hit at least the migrated prompt
+            hit = await b.runner.submit(
+                lambda e: e.allocator.stats.hit_tokens
+            )
+            assert hit >= (len(prompt) // cfg.page_size) * cfg.page_size
+        finally:
+            faults.uninstall()
+            if router is not None:
+                router.close()
+            if b is not None:
+                await b.stop(drain_timeout=0)
+            await a.stop(drain_timeout=0)
+            await rt_c.close()
+            await rt_b.close()
+            await rt_a.close()
+            await server.stop()
+
+    run(main())
+
+
+# -- fault matrix: every phase degrades to drain+replay, pages freed --------
+
+
+def test_handover_fault_matrix_mock_phases():
+    """Injected error at extract / offer / adopt (and a dead successor):
+    the handover falls back to the plain drain, the worker still
+    drains cleanly, traffic keeps flowing, and NOTHING is adopted."""
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).parent))
+    from helpers.fleet_sim import FleetSim
+
+    async def main():
+        for phase in ("handover.extract", "handover.offer",
+                      "handover.adopt", "successor-dead"):
+            sim = FleetSim(decode_s_per_step=0.01)
+            try:
+                await sim.start(replay=True)
+                a = await sim.add_worker()
+                await sim.one(isl=24, osl=4)  # warm A (only worker yet)
+                bworker = await sim.add_worker()
+                inj = faults.install(seed=1)
+                if phase == "successor-dead":
+                    await sim.kill(bworker)
+                else:
+                    inj.add_rule(phase, "error", times=1)
+                ok = await asyncio.wait_for(a.handover(budget_s=1.0), 30)
+                assert ok is False
+                assert a.handover_fallbacks == 1 and a.handovers == 0
+                assert a.drained.is_set()
+                assert bworker.handovers_adopted == 0
+                faults.uninstall()
+                # the fleet still serves (zero hung streams: sim.one
+                # enforces a terminal state under timeout)
+                if phase != "successor-dead":
+                    tokens, fin, _ = await sim.one(isl=8, osl=4)
+                    assert fin in ("length", "stop")
+                assert sim.stats.dropped == sim.stats.errored == 0
+            finally:
+                faults.uninstall()
+                await sim.stop()
+
+    run(main())
+
+
+def test_handover_transfer_fault_and_corruption_jax():
+    """The byte-moving phases on real engines: (1) an error at the
+    transfer phase falls back to drain and the successor's reserved
+    pages are FREED by its watchdog; (2) an injected `corrupt` flip on
+    the wire is REJECTED by the codec checksum — the corrupt pages
+    never land, the handover falls back, and the rejection is counted."""
+    cfg, card = _two_worker_env()
+
+    async def main():
+        server = FabricServer(port=0)
+        await server.start()
+        rt_c = await DistributedRuntime.create(server.address)
+        ep = rt_c.namespace("hot").component("backend").endpoint("generate")
+        router = None
+        prompt = [11, 3, 5, 7, 13, 17, 19, 23, 4, 6, 8, 10]
+
+        for mode in ("transfer-error", "wire-corrupt"):
+            rt_a = await DistributedRuntime.create(server.address)
+            rt_b = await DistributedRuntime.create(server.address)
+            a = Worker(rt_a, card, engine_config=cfg, engine_kind="jax",
+                       namespace="hot", metrics_interval=0.1)
+            await a.start()
+            if router is None:
+                router = await ep.router(mode=RouterMode.ROUND_ROBIN)
+            await router.source.wait_for_instances()
+            ref, _ = await _stream(router, f"warm-{mode}", prompt, 4)
+            b = Worker(rt_b, card, engine_config=cfg, engine_kind="jax",
+                       namespace="hot", metrics_interval=0.1)
+            await b.start()
+            free_b0 = await b.runner.submit(lambda e: e.allocator.num_free)
+            inj = faults.install(seed=2)
+            if mode == "transfer-error":
+                inj.add_rule("handover.transfer", "error", times=1)
+            else:
+                inj.add_rule("transfer.send", "corrupt", times=1)
+            try:
+                ok = await asyncio.wait_for(a.handover(budget_s=1.0), 30)
+                assert ok is False
+                assert a.handover_fallbacks == 1
+                assert b.handovers_adopted == 0
+                if mode == "wire-corrupt":
+                    # the checksummed framing rejected the flipped frame
+                    assert b.transfer_server.corrupt_rejects == 1
+                # the successor's reservation watchdog freed its pages
+                for _ in range(100):
+                    free = await b.runner.submit(
+                        lambda e: e.allocator.num_free
+                    )
+                    if free == free_b0:
+                        break
+                    await asyncio.sleep(0.05)
+                assert free_b0 == await b.runner.submit(
+                    lambda e: e.allocator.num_free
+                ), "successor leaked its handover reservation"
+                active = await b.runner.submit(
+                    lambda e: e.allocator.num_active
+                )
+                assert active == 0
+                # zero hung streams: traffic still terminates (on B — A
+                # deregistered during its fallback drain)
+                faults.uninstall()
+                await a.stop(drain_timeout=0)
+                again, fin = await _stream(
+                    router, f"again-{mode}", prompt, 4
+                )
+                assert fin in ("length", "stop") and again == ref
+            finally:
+                faults.uninstall()
+                await b.stop(drain_timeout=0)
+                await a.stop(drain_timeout=0)
+                await rt_b.close()
+                await rt_a.close()
+        if router is not None:
+            router.close()
+        await rt_c.close()
+        await server.stop()
+
+    run(main())
+
+
+# -- rolling upgrade: replace every worker, one at a time, live traffic ----
+
+
+def test_rolling_upgrade_sweep_zero_dropped_streams():
+    """`dynamo planner --rolling-upgrade` semantics against a live mock
+    fleet: every original worker is replaced one at a time (replacement
+    spawns FIRST, then handover retires the victim), while open-loop
+    traffic keeps arriving — zero dropped streams, every original
+    instance id gone, fleet size back to steady state, and TTFT
+    degradation during the sweep stays bounded."""
+    import statistics
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).parent))
+    from helpers.fleet_sim import FleetSim, SimConnector
+
+    from dynamo_tpu.planner.service import (
+        FleetHandover,
+        FleetObserver,
+        rolling_upgrade,
+    )
+    from dynamo_tpu.runtime import DistributedRuntime
+
+    async def main():
+        sim = FleetSim(decode_s_per_step=0.005, metrics_interval=0.2)
+        try:
+            await sim.start(replay=True)
+            n0 = 4
+            for _ in range(n0):
+                await sim.add_worker()
+            rt_obs = await DistributedRuntime.create(sim.server.address)
+            observer = FleetObserver(rt_obs)
+            await observer.start()
+            for _ in range(100):
+                if len(observer._decode_src.list()) == n0:
+                    break
+                await asyncio.sleep(0.05)
+            original = {i.instance_id for i in observer._decode_src.list()}
+            assert len(original) == n0
+
+            # steady-state baseline TTFT under the same traffic shape
+            await sim.drive_phase(1.5, lambda t: 6.0, isl=24, osl=6)
+            base = [t for _, t, ok in sim.stats.ttfts if ok]
+            t_sweep = asyncio.get_running_loop().time()
+
+            connector = SimConnector(sim)
+            sweep = asyncio.create_task(
+                rolling_upgrade(
+                    observer, connector, FleetHandover(observer),
+                    roles=("decode",), cooldown_s=0.2,
+                    step_timeout_s=30.0,
+                )
+            )
+            # open-loop traffic THROUGH the whole sweep
+            while not sweep.done():
+                await sim.drive_phase(0.5, lambda t: 6.0, isl=24, osl=6)
+            summary = await sweep
+            assert summary["decode"]["failed"] == []
+            assert set(summary["decode"]["upgraded"]) == original
+
+            # every original instance replaced; pool back at steady size
+            now = {i.instance_id for i in observer._decode_src.list()}
+            assert now.isdisjoint(original)
+            assert len(now) == n0
+            # zero dropped / errored streams across the whole sweep
+            assert sim.stats.dropped == 0 and sim.stats.errored == 0
+            # bounded TTFT degradation: sweep-phase p95 within 10x the
+            # steady-state p95 + scheduling slack (mock steps are ms —
+            # the bound catches stalls, not jitter)
+            swept = [
+                t for t0, t, ok in sim.stats.ttfts
+                if ok and t0 >= t_sweep
+            ]
+            assert swept, "no traffic completed during the sweep"
+            base_p95 = statistics.quantiles(base, n=20)[18] if len(
+                base
+            ) >= 20 else max(base)
+            sweep_p95 = statistics.quantiles(swept, n=20)[18] if len(
+                swept
+            ) >= 20 else max(swept)
+            assert sweep_p95 <= base_p95 * 10 + 1.0, (
+                f"TTFT degraded unboundedly: {sweep_p95:.3f}s vs "
+                f"baseline {base_p95:.3f}s"
+            )
+            # the replacements really adopted the victims' block metas
+            adopted = sum(w.handovers_adopted for w in sim.workers)
+            handed = sum(w.handovers for w in sim.workers)
+            assert handed == n0
+            assert adopted > 0
+            await observer.stop()
+            await rt_obs.close()
+        finally:
+            await sim.stop()
+
+    run(main())
+
+
+# -- admin plane: POST /v1/admin/handover -----------------------------------
+
+
+def test_admin_handover_endpoint_retires_worker():
+    """The operator surface: POST /v1/admin/handover through a real HTTP
+    frontend retires the named worker; its KV lands on the survivor and
+    the fleet keeps serving."""
+    cfg, card = _two_worker_env()
+
+    async def main():
+        import json
+        import urllib.error
+        import urllib.request
+
+        from dynamo_tpu.frontend.http import HttpService
+        from dynamo_tpu.frontend.service import ModelManager, router_pipeline
+        from dynamo_tpu.model_card import register_llm
+
+        server = FabricServer(port=0)
+        await server.start()
+        rt_a = await DistributedRuntime.create(server.address)
+        rt_b = await DistributedRuntime.create(server.address)
+        rt_f = await DistributedRuntime.create(server.address)
+        a = Worker(rt_a, card, engine_config=cfg, engine_kind="jax",
+                   namespace="dynamo", metrics_interval=0.1)
+        await a.start()
+        ep = rt_f.namespace("dynamo").component("backend").endpoint(
+            "generate"
+        )
+        router = await ep.router(mode=RouterMode.ROUND_ROBIN)
+        await router.source.wait_for_instances()
+        manager = ModelManager()
+        manager.add(card.name, router_pipeline(card, router))
+        http = HttpService(manager, host="127.0.0.1", port=0)
+        await http.start()
+        b = None
+        try:
+            # warm A while it is the only instance, so there is KV worth
+            # migrating when the admin call retires it
+            ref, _ = await _stream(router, "w", [1, 2, 3, 4, 5, 6, 7, 8], 4)
+            b = Worker(rt_b, card, engine_config=cfg, engine_kind="jax",
+                       namespace="dynamo", metrics_interval=0.1)
+            await b.start()
+            for _ in range(100):
+                if len(router.source.list()) == 2:
+                    break
+                await asyncio.sleep(0.05)
+
+            def post(path, body):
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{http.port}{path}",
+                    data=json.dumps(body).encode(),
+                    headers={"Content-Type": "application/json"},
+                )
+                try:
+                    with urllib.request.urlopen(req, timeout=10) as resp:
+                        return resp.status, json.loads(resp.read())
+                except urllib.error.HTTPError as e:
+                    return e.code, {}
+
+            status, reply = await asyncio.to_thread(
+                post, "/v1/admin/handover",
+                {"instance_id": a.instance_id,
+                 "successor": b.instance_id},
+            )
+            assert status == 200 and reply["handing_over"] is True
+            await asyncio.wait_for(a.drained.wait(), 30)
+            assert a.handovers == 1
+            for _ in range(100):
+                if b.handovers_adopted:
+                    break
+                await asyncio.sleep(0.05)
+            assert b.handovers_adopted >= 2
+            # unknown instance -> 502 (the direct dispatch fails)
+            status, _ = await asyncio.to_thread(
+                post, "/v1/admin/handover", {"instance_id": "nope"}
+            )
+            assert status == 502
+        finally:
+            await http.stop()
+            await manager.remove(card.name)
+            if b is not None:
+                await b.stop(drain_timeout=0)
+            await a.stop(drain_timeout=0)
+            await rt_f.close()
+            await rt_b.close()
+            await rt_a.close()
+            await server.stop()
+
+    run(main())

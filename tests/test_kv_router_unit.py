@@ -65,6 +65,124 @@ class TestRadixTree:
         m = t.find_matches(hash_token_blocks(tokens, block_size=64, salt="b"))
         assert m.scores == {}
 
+    def test_handed_over_bulk_move(self):
+        """Worker handover (ISSUE 12): the `handed_over` event reassigns
+        EVERY block of the retiring worker to the successor in one pass
+        — no per-block events, no lease-expiry wait."""
+        t = RadixTree()
+        h = hash_token_blocks(list(range(64 * 3)), block_size=64)
+        _store(t, "w1", h)
+        _store(t, "w2", h[:1])
+        t.apply_event(
+            "w1",
+            {"kind": "handed_over", "block_hashes": [], "successor": "w3"},
+        )
+        assert t.find_matches(h).scores == {"w3": 3, "w2": 1}
+        assert t.blocks_for("w1") == 0
+        assert "w1" not in t.workers()
+        # moving onto a worker that already holds some blocks merges
+        t.apply_event(
+            "w3",
+            {"kind": "handed_over", "block_hashes": [], "successor": "w2"},
+        )
+        assert t.find_matches(h).scores == {"w2": 3}
+        # degenerate successors degrade to a plain remove
+        _store(t, "w4", h[:2])
+        t.apply_event(
+            "w4", {"kind": "handed_over", "block_hashes": [], "successor": ""}
+        )
+        assert "w4" not in t.workers()
+
+    def test_move_worker_api(self):
+        t = RadixTree()
+        h = hash_token_blocks(list(range(64 * 2)), block_size=64)
+        _store(t, "a", h)
+        assert t.move_worker("a", "b") == 2
+        assert t.find_matches(h).scores == {"b": 2}
+        assert t.take_worker("b") and t.blocks_for("b") == 0
+        # move of an unknown worker is a no-op
+        assert t.move_worker("ghost", "b") == 0
+
+
+def test_native_tree_move_degrades_to_remove():
+    """The native index cannot enumerate a worker's hashes, so its bulk
+    move honestly degrades: src entries drop, the successor's own
+    stored events repopulate it (documented in indexer.py)."""
+    import pytest
+
+    from dynamo_tpu.kv_router.indexer import NativeRadixTree
+
+    try:
+        t = NativeRadixTree()
+    except RuntimeError:
+        pytest.skip("native library unavailable")
+    h = hash_token_blocks(list(range(64 * 2)), block_size=64)
+    _store(t, "a", h)
+    t.apply_event(
+        "a", {"kind": "handed_over", "block_hashes": [], "successor": "b"}
+    )
+    assert "a" not in t.workers()
+    assert t.find_matches(h).scores == {}  # dst repopulates via events
+    _store(t, "b", h)
+    assert t.find_matches(h).scores == {"b": 2}
+    assert t.take_worker("b") == []  # degradation contract
+
+
+def test_sharded_indexer_cross_shard_move(monkeypatch):
+    """KvIndexerSharded: a handed_over event whose src and dst hash to
+    DIFFERENT shards must still move the entries (take on the source
+    shard, bulk store on the destination shard), and a later
+    remove_worker(dst) must find them all. Pinned to the Python tree —
+    the native tree's per-shard degradation is covered above."""
+    import asyncio
+
+    from dynamo_tpu.kv_router import indexer as indexer_mod
+    from dynamo_tpu.kv_router.indexer import KvIndexerSharded
+
+    monkeypatch.setattr(indexer_mod, "make_radix_tree", RadixTree)
+
+    class _FakeSub:
+        async def next(self):
+            await asyncio.sleep(3600)
+
+        def close(self):
+            pass
+
+    class _FakeFabric:
+        async def subscribe(self, subject):
+            return _FakeSub()
+
+    async def main():
+        idx = KvIndexerSharded(_FakeFabric(), num_shards=4)
+        await idx.start()
+        try:
+            # find two worker ids in different shards
+            src, dst = "w-src", None
+            for i in range(64):
+                cand = f"w-dst-{i}"
+                if idx._shard_of(cand) != idx._shard_of(src):
+                    dst = cand
+                    break
+            assert dst is not None
+            h = hash_token_blocks(list(range(64 * 3)), block_size=64)
+            idx._queues[idx._shard_of(src)].put(
+                (src, [{"kind": "stored", "block_hashes": list(h)}])
+            )
+            await idx.drain_for_tests()
+            assert idx.find_matches(h).scores == {src: 3}
+            idx._queues[idx._shard_of(src)].put(
+                (src, [{"kind": "handed_over", "block_hashes": [],
+                        "successor": dst}])
+            )
+            await idx.drain_for_tests()
+            assert idx.find_matches(h).scores == {dst: 3}
+            assert idx.remove_worker(dst) == 3
+            assert idx.find_matches(h).scores == {}
+        finally:
+            await idx.stop()
+
+    asyncio.run(main())
+
 
 class TestSelector:
     def _w(self, iid, active=0, total=1000):

@@ -82,6 +82,45 @@ def test_disk_tier_round_trip_and_bound(tmp_path):
     assert len(list(tmp_path.iterdir())) == 3
 
 
+def test_disk_tier_detects_bit_rot(tmp_path):
+    """At-rest integrity (ISSUE 12 satellite): a flipped byte in a
+    stored block file fails the xxh3 trailer check on `get` — the block
+    reads as a MISS, the file is unlinked, the corruption is counted,
+    and garbage bytes are never served. A truncated file is caught the
+    same way."""
+    from dynamo_tpu.kvbm import tiers as tiers_mod
+
+    t = DiskTier(str(tmp_path), capacity_bytes=1 << 20)
+    for h in (1, 2, 3):
+        t.put(_entry(h))
+    base = tiers_mod.disk_corrupt_total
+
+    # flip one payload byte of block 2's file (past the .npy header)
+    path = t._path(2)
+    raw = bytearray(open(path, "rb").read())
+    raw[-20] ^= 0xFF
+    open(path, "wb").write(bytes(raw))
+    assert t.get(2) is None
+    assert t.corrupt_reads == 1
+    assert tiers_mod.disk_corrupt_total == base + 1
+    assert 2 not in t and not any(
+        p.name == path.rsplit("/", 1)[-1] for p in tmp_path.iterdir()
+    ), "corrupt file must be unlinked"
+
+    # truncation is also a checksum miss, not a crash or garbage
+    path3 = t._path(3)
+    data = open(path3, "rb").read()
+    open(path3, "wb").write(data[: len(data) - 9])
+    assert t.get(3) is None
+    assert t.corrupt_reads == 2
+
+    # untouched blocks still round-trip exactly
+    e = t.get(1)
+    assert e is not None
+    np.testing.assert_array_equal(e.k, _entry(1).k)
+    np.testing.assert_array_equal(e.v, _entry(1).v)
+
+
 # -- engine e2e -------------------------------------------------------------
 
 

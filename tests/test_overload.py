@@ -103,6 +103,49 @@ def test_fault_hooks_are_noops_without_injector():
     run(faults.fire("fabric.call", op="kv.get"))
 
 
+def test_corrupt_queue_payload_rejected_never_lands():
+    """Corrupt kind on the fabric plane (ISSUE 12 satellite): a flipped
+    byte in a queue.push frame fails the codec's xxh3 check server-side
+    — the push ERRORS (the corrupt item never lands in the queue), the
+    session drops, and the reconnecting client's later pushes land."""
+    from dynamo_tpu.runtime import DistributedRuntime
+    from dynamo_tpu.runtime.fabric import FabricServer
+
+    async def main():
+        server = FabricServer(port=0)
+        await server.start()
+        rt = await DistributedRuntime.create(server.address)
+        fab = rt.fabric
+        try:
+            await fab.queue_push("q", {"h": 1}, b"payload")
+            assert await fab.queue_len("q") == 1
+            inj = faults.install(seed=0)
+            inj.add_rule("fabric.call", "corrupt", times=1)
+            with pytest.raises(Exception):
+                await asyncio.wait_for(
+                    fab.queue_push("q", {"h": 2}, b"evil"), 10
+                )
+            assert inj.fired[("fabric.call", "corrupt")] == 1
+            faults.uninstall()
+            # the client session re-establishes; good pushes land again
+            for _ in range(50):
+                try:
+                    await asyncio.wait_for(
+                        fab.queue_push("q", {"h": 3}, b"fine"), 2
+                    )
+                    break
+                except Exception:
+                    await asyncio.sleep(0.1)
+            # exactly the two GOOD items — the corrupt one never landed
+            assert await fab.queue_len("q") == 2
+        finally:
+            faults.uninstall()
+            await rt.close()
+            await server.stop()
+
+    run(main())
+
+
 def test_rule_times_cap_and_ctx_match():
     inj = faults.install(seed=0)
     inj.add_rule("fabric.call", "error", times=2, op="queue.pop")
@@ -201,8 +244,11 @@ def test_runner_overload_surfaces_retry_after(tiny_cfg):
     cfg = replace(tiny_cfg, max_seqs=1, max_waiting=1, overlap_decode=False)
     eng = JaxEngine(cfg)
     # keep "run" on the engine long enough that "wait" is still queued
-    # when "shed" knocks, even with a warm compile cache
-    faults.install(seed=0).add_rule("engine.step", "delay", delay_ms=30.0)
+    # when "shed" knocks, even with a warm compile cache. 300ms: the
+    # fused K-step decode retires up to decode_steps=8 tokens per paced
+    # step, so "run" (24 tokens ≈ 3 steps) must still be mid-flight at
+    # the 0.4s probe — at 30ms it occasionally finished first.
+    faults.install(seed=0).add_rule("engine.step", "delay", delay_ms=300.0)
 
     async def go():
         runner = AsyncEngineRunner(eng)
@@ -216,9 +262,29 @@ def test_runner_overload_surfaces_retry_after(tiny_cfg):
                     out.extend(item.get("token_ids", ()))
                 return out
 
-            t_run = asyncio.create_task(consume("run", 24))   # occupies max_seqs
+            def occupancy():
+                # read-only length peeks from the test thread: cheap
+                # enough to poll every 10ms, which matters — a
+                # runner.submit round-trip pays a whole paced step and
+                # would burn "run"'s lifetime on bookkeeping
+                return (len(eng.scheduler.running),
+                        len(eng.scheduler.waiting))
+
+            # sequence the admissions: "run" must hold the single seat
+            # BEFORE "wait" joins the queue — submitting both at once
+            # races their inbox order, and a first-admitted "wait"
+            # finishes fast and frees the queue before the probe
+            t_run = asyncio.create_task(consume("run", 24))  # occupies max_seqs
+            for _ in range(500):
+                if occupancy()[0] >= 1:
+                    break
+                await asyncio.sleep(0.01)
             t_wait = asyncio.create_task(consume("wait", 4))  # fills max_waiting
-            await asyncio.sleep(0.4)
+            for _ in range(500):
+                if occupancy()[1] >= 1:
+                    break
+                await asyncio.sleep(0.01)
+            assert occupancy() == (1, 1)
             with pytest.raises(OverloadedError) as ei:
                 await consume("shed", 4)
             assert ei.value.retry_after_s is not None
@@ -393,8 +459,11 @@ def test_runner_expires_stream_mid_decode(tiny_cfg):
     free_before = eng.allocator.num_free
     # pace the step loop with an injected delay so the deadline reliably
     # lapses mid-decode even with a warm compile cache (the stream would
-    # otherwise race to its LENGTH cap first)
-    faults.install(seed=0).add_rule("engine.step", "delay", delay_ms=60.0)
+    # otherwise race to its LENGTH cap first). 300ms: the 0.8s deadline
+    # admits at most ~3 paced steps, well short of the ~5 this config
+    # needs to reach its 28-token context cap — at 60ms the cap
+    # occasionally won the race on a fast box and finished `length`.
+    faults.install(seed=0).add_rule("engine.step", "delay", delay_ms=300.0)
 
     async def go():
         runner = AsyncEngineRunner(eng)

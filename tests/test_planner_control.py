@@ -247,6 +247,159 @@ def test_max_step_bounds_one_scale_action():
     assert conn.calls == [("decode", 3, 2)]
 
 
+# -- scale-down prefers handover over kill (ISSUE 12) -----------------------
+
+
+def _calm_states(n, num_decode=3):
+    return [
+        _state(num_decode=num_decode, burn_rate=0.0, sla_attainment=1.0,
+               kv_usage=0.1, num_prefill=0)
+        for _ in range(n)
+    ]
+
+
+def _down_runner(handover_ok, clock=None):
+    """Runner driven to a decode scale-down on tick `down_stable_ticks`,
+    with a recording handover actuator."""
+    calls = []
+
+    async def handover(role):
+        calls.append(role)
+        return handover_ok
+
+    conn = RecordingConnector()
+    it = iter(_calm_states(4))
+
+    async def observe():
+        return next(it)
+
+    r = ControlRunner(
+        ClosedLoopPlanner(_cfg(down_stable_ticks=2, cooldown_s=0.0)),
+        conn, observe, handover=handover,
+        now_fn=clock or _Clock(), interval_s=0.01,
+    )
+    return r, conn, calls
+
+
+def test_scale_down_prefers_handover_over_kill():
+    clock = _Clock()
+    r, conn, calls = _down_runner(handover_ok=True, clock=clock)
+
+    async def main():
+        for _ in range(3):
+            await r.step()
+            clock.t += 50
+
+    asyncio.run(main())
+    # the down decision actuated as ONE handover, zero connector kills
+    assert calls == ["decode"]
+    assert conn.calls == []
+    assert r.decisions["handover"] == 1
+
+
+def test_scale_down_falls_back_to_kill_when_handover_fails():
+    clock = _Clock()
+    r, conn, calls = _down_runner(handover_ok=False, clock=clock)
+
+    async def main():
+        for _ in range(3):
+            await r.step()
+            clock.t += 50
+
+    asyncio.run(main())
+    # handover was tried, failed, and the kill path covered the delta
+    assert calls == ["decode"]
+    assert conn.calls == [("decode", 2, 3)]
+    assert r.decisions["handover"] == 0
+    assert r.decisions["scale_down"] == 1
+
+
+def test_rolling_upgrade_refreshes_connector_baseline():
+    """The 1-for-1 sweep must tell the connector when each replacement
+    REGISTERS (a no-op-delta scale call): LocalConnector retires a
+    spawned child's pending-capacity credit only when the observed
+    count rises between scale() calls, and a rolling sweep returns to
+    steady size before the next call — without the refresh, every
+    victim after the first silently gets no replacement (found by the
+    live CLI drive)."""
+    from dynamo_tpu.planner.service import rolling_upgrade
+
+    class _Inst:
+        def __init__(self, iid):
+            self.instance_id = iid
+            self.metadata = {"flippable": True}
+            self.port = 1
+
+    class _Src:
+        def __init__(self, ids):
+            self.ids = list(ids)
+
+        def list(self):
+            return [_Inst(i) for i in self.ids]
+
+    class _Obs:
+        def __init__(self):
+            self._decode_src = _Src(["w-a", "w-b"])
+            self._prefill_src = _Src([])
+
+    obs = _Obs()
+    conn = RecordingConnector()
+    spawned = iter(["w-new1", "w-new2"])
+
+    async def scale(role, target, observed):
+        await RecordingConnector.scale(conn, role, target, observed)
+        if target > len(obs._decode_src.ids):
+            obs._decode_src.ids.append(next(spawned))
+
+    conn.scale = scale
+    handed = []
+
+    async def handover(role, victim_id=None, successor_id=None):
+        handed.append(victim_id)
+        obs._decode_src.ids.remove(victim_id)
+        return True
+
+    summary = asyncio.run(
+        rolling_upgrade(
+            obs, conn, handover, roles=("decode",), cooldown_s=0.0,
+            step_timeout_s=1.0,
+        )
+    )
+    assert summary["decode"]["upgraded"] == ["w-a", "w-b"]
+    assert summary["decode"]["failed"] == []
+    assert handed == ["w-a", "w-b"]
+    assert obs._decode_src.ids == ["w-new1", "w-new2"]
+    # per victim: the spawn call (n0+1, n0) AND the baseline refresh
+    # (n0+1, n0+1) after the replacement registered
+    assert conn.calls == [
+        ("decode", 3, 2), ("decode", 3, 3),
+        ("decode", 3, 2), ("decode", 3, 3),
+    ]
+
+
+def test_scale_up_never_touches_handover():
+    clock = _Clock()
+    calls = []
+
+    async def handover(role):
+        calls.append(role)
+        return True
+
+    conn = RecordingConnector()
+    it = iter([_state(burn_rate=2.0, num_prefill=0)])
+
+    async def observe():
+        return next(it)
+
+    r = ControlRunner(
+        ClosedLoopPlanner(_cfg()), conn, observe, handover=handover,
+        now_fn=clock, interval_s=0.01,
+    )
+    asyncio.run(r.step())
+    assert calls == []
+    assert conn.calls == [("decode", 3, 2)]
+
+
 def test_flip_cooldown_blocks_flip_storm():
     clock = _Clock()
     flips = []
