@@ -1154,6 +1154,107 @@ def _flight_overhead_ab(pairs: int = 4, osl: int = 32, n_req: int = 8) -> dict:
     }
 
 
+def _kv_index_overhead_ab(pairs: int = 4, osl: int = 32, n_req: int = 8) -> dict:
+    """KV index sequencing overhead A/B (ISSUE 13 acceptance): the
+    sequence stamp + rolling-digest fold added to the KV event publish
+    path must cost <1% of token throughput. The stamp runs in the
+    worker's async publish loop — off the token path entirely — and KV
+    events are RARE relative to tokens (one stored event per full page
+    = 1/page_size per generated token, plus evictions), so the honest
+    claim is the DETERMINISTIC model: a microbench of the REAL
+    Worker._stamp_kv_events hot path priced at the measured
+    events-per-token rate of a live drive. The interleaved wall A/B
+    (same engine, publish-tick simulation stamping on/off per arm)
+    rides along as a sanity band."""
+    import statistics
+
+    from dynamo_tpu.engine import EngineConfig
+    from dynamo_tpu.engine.engine import JaxEngine
+    from dynamo_tpu.engine.request import SamplingParams
+    from dynamo_tpu.model_card import ModelDeploymentCard
+    from dynamo_tpu.worker import Worker
+
+    card = ModelDeploymentCard(name="tiny", kv_page_size=4)
+    w = Worker(None, card, engine_kind="echo")
+
+    # deterministic microbench: the real stamping path over realistic
+    # single-hash stored/removed batches (what the allocator emits)
+    batch = [
+        {
+            "kind": "stored" if i % 3 else "removed",
+            "block_hashes": [(i * 2654435761) & ((1 << 64) - 1)],
+            "parent_hash": None,
+            "token_blocks": [[1, 2, 3, 4]],
+        }
+        for i in range(64)
+    ]
+    iters = 2_000
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        for ev in batch:
+            ev.pop("seq", None)
+        w._stamp_kv_events(batch)
+    stamp_us = (time.perf_counter() - t0) / (iters * len(batch)) * 1e6
+
+    events = []
+    eng = JaxEngine(
+        EngineConfig.for_tests(), on_kv_event=lambda e: events.append(e)
+    )
+    wire = Worker._kv_event_wire
+
+    def drive(tag: str, stamp: bool) -> tuple[float, int, int]:
+        del events[:]
+        for i in range(n_req):
+            eng.add_request(
+                f"{tag}-{i}", [1 + i, 2, 3, 4],
+                SamplingParams(temperature=0.0, max_tokens=osl),
+            )
+        t0 = time.perf_counter()
+        done = eng.run_to_completion()
+        # the publish-tick work the sequencing adds, in-line so the arm
+        # pays it inside the timed window
+        batch = [wire(e) for e in events]
+        if stamp:
+            w._stamp_kv_events(batch)
+        dt = time.perf_counter() - t0
+        eng.allocator.clear_cache()
+        toks = sum(len(v) for v in done.values())
+        return (toks / dt if dt else 0.0), toks, len(batch)
+
+    drive("warm", False)
+    rates: dict = {"on": [], "off": []}
+    ev_total = tok_total = 0
+    for rep in range(pairs):
+        arms = [("on", True), ("off", False)]
+        if rep % 2:
+            arms.reverse()
+        for tag, stamp in arms:
+            rate, toks, nev = drive(f"{tag}{rep}", stamp)
+            rates[tag].append(rate)
+            if stamp:
+                ev_total += nev
+                tok_total += toks
+    on_med = statistics.median(rates["on"])
+    off_med = statistics.median(rates["off"])
+    events_per_token = ev_total / tok_total if tok_total else 1.0
+    modeled = measured = None
+    if off_med:
+        serving_us_per_token = 1e6 / off_med
+        modeled = round(
+            stamp_us * events_per_token / serving_us_per_token * 100.0, 4
+        )
+        measured = round((1.0 - on_med / off_med) * 100.0, 2)
+    return {
+        "pairs": pairs,
+        "seq_on_tok_s": round(on_med, 1),
+        "seq_off_tok_s": round(off_med, 1),
+        "stamp_us": round(stamp_us, 4),
+        "events_per_token": round(events_per_token, 4),
+        "modeled_overhead_pct": modeled,
+        "measured_overhead_pct": measured,
+    }
+
+
 def main() -> None:
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     from dynamo_tpu.platform import honor_jax_platforms_env
@@ -1504,6 +1605,17 @@ def main() -> None:
             # the headline artifact
             handover_ab = {"error": f"{type(e).__name__}: {e}"}
 
+    # KV index sequencing A/B (ISSUE 13): the sequence stamp + digest
+    # fold on the event publish path must stay under 1% of token
+    # throughput.
+    kv_index_ab = None
+    if platform != "tpu" and os.environ.get("BENCH_KV_INDEX_AB", "1") != "0":
+        try:
+            kv_index_ab = _kv_index_overhead_ab()
+        except Exception as e:  # noqa: BLE001 — A/B failure must not kill
+            # the headline artifact
+            kv_index_ab = {"error": f"{type(e).__name__}: {e}"}
+
     # Draft-model speculative decoding A/B (ISSUE 9): decode tok/s with
     # the fused draft+verify path on vs off at batch <= 8. Runs by
     # default on the CPU fallback (tiny self-draft — acceptance ~1, the
@@ -1714,6 +1826,9 @@ def main() -> None:
                 **({"slo_overhead": slo_ab} if slo_ab else {}),
                 **({"flight_overhead": flight_ab} if flight_ab else {}),
                 **({"handover_ab": handover_ab} if handover_ab else {}),
+                **(
+                    {"kv_index_overhead": kv_index_ab} if kv_index_ab else {}
+                ),
                 **(
                     {"kv_quantize": os.environ["BENCH_KV_QUANTIZE"]}
                     if os.environ.get("BENCH_KV_QUANTIZE")

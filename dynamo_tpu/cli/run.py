@@ -335,6 +335,7 @@ async def _run_worker(args) -> None:
         echo_delay=getattr(args, "echo_delay", 0.0),
         advertise_host=args.host,
         drain_budget_s=getattr(args, "drain_budget", 30.0),
+        kv_sequencing=getattr(args, "kv_sequencing", True),
     )
     await worker.start()
     print(f"worker {worker.instance_id} up (model={args.model})", flush=True)
@@ -794,6 +795,14 @@ def build_parser() -> argparse.ArgumentParser:
         help="graceful drain budget: on SIGTERM (or POST /v1/admin/"
              "drain) the worker deregisters, finishes in-flight "
              "requests up to this long, then exits 0",
+    )
+    runp.add_argument(
+        "--no-kv-sequencing", action="store_false", dest="kv_sequencing",
+        default=True,
+        help="disable KV event sequence stamping + the rolling block-set "
+             "digest (docs/operations.md 'KV index consistency'): the "
+             "event wire reverts to the pre-sequencing format and "
+             "indexers lose gap/drift detection for this worker",
     )
     runp.add_argument(
         "--transfer-timeout", type=float, default=30.0,

@@ -210,6 +210,10 @@ class FrontendMetrics:
         # data-integrity rejections (disk-tier checksum misses, corrupt
         # transfer frames): process-global like the phase histograms
         lines.extend(_debug.integrity_lines())
+        # KV index health (gaps / resyncs / drift / stale subtrees): the
+        # KV-aware router lives in this process in single-process
+        # serving — docs/operations.md "KV index consistency"
+        lines.extend(_debug.kv_index_lines())
         return "\n".join(lines) + "\n"
 
 

@@ -1,0 +1,88 @@
+"""Rolling block-set digest: the KV index's drift detector.
+
+One number summarizes a worker's whole registered block set: the XOR of
+`xxh3_64(le64(seq_hash), DIGEST_SEED)` over every registered chained
+block hash, plus the set size. XOR makes the fold order-independent and
+self-inverse — store toggles a block in, remove toggles it out, both
+O(1) — so the WORKER maintains it incrementally on the event publish
+path, ships it in its metrics frames, and serves it (with the full hash
+forest) from the `kv.snapshot` ingress op; the INDEXER recomputes the
+same fold from its per-worker indexed set during the anti-entropy sweep
+(RadixTree.digest_for / native dyn_radix_digest). Equal (fold, count)
+at equal sequence number == the index holds exactly the worker's real
+block set; any mismatch is drift, and drift triggers a targeted resync
+(kv_router/indexer.py).
+
+The per-hash xxh3 wrap (rather than XOR-ing raw hashes) keeps related
+chained hashes from cancelling structurally; the same seed + little-
+endian byte layout is implemented natively in native/dynamo_native.cpp
+dyn_radix_digest — tests assert the two agree.
+"""
+
+from __future__ import annotations
+
+import struct
+
+import xxhash
+
+#: seed isolating the digest fold from every other xxh3 use in the stack
+DIGEST_SEED = 0x5E0D16E57
+
+_MASK64 = (1 << 64) - 1
+
+
+def fold_one(seq_hash: int) -> int:
+    """The per-block fold term: xxh3 of the hash's 8 LE bytes."""
+    return xxhash.xxh3_64_intdigest(
+        struct.pack("<Q", seq_hash & _MASK64), seed=DIGEST_SEED
+    )
+
+
+def fold_hashes(hashes) -> tuple[int, int]:
+    """(fold, count) of a full hash set — the from-scratch recompute used
+    after a resync subtree replace and by RadixTree.digest_for."""
+    fold = 0
+    n = 0
+    for h in hashes:
+        fold ^= fold_one(h)
+        n += 1
+    return fold, n
+
+
+class SetDigest:
+    """Incrementally-maintained (fold, count) over an exact hash set.
+
+    The worker-side publisher keeps one of these: exact set semantics
+    (duplicate stores / removes of absent hashes are no-ops) guarantee
+    the digest always equals fold_hashes(current set), so the advertised
+    digest is trustworthy even against a buggy or replayed event
+    stream."""
+
+    __slots__ = ("fold", "blocks")
+
+    def __init__(self):
+        self.fold = 0
+        #: hash -> parent hash (the forest the kv.snapshot op serves)
+        self.blocks: dict[int, int | None] = {}
+
+    @property
+    def count(self) -> int:
+        return len(self.blocks)
+
+    def store(self, seq_hash: int, parent: int | None = None) -> bool:
+        if seq_hash in self.blocks:
+            return False
+        self.blocks[seq_hash] = parent
+        self.fold ^= fold_one(seq_hash)
+        return True
+
+    def remove(self, seq_hash: int) -> bool:
+        if seq_hash not in self.blocks:
+            return False
+        del self.blocks[seq_hash]
+        self.fold ^= fold_one(seq_hash)
+        return True
+
+    def clear(self) -> None:
+        self.fold = 0
+        self.blocks.clear()

@@ -19,14 +19,44 @@ from __future__ import annotations
 import asyncio
 import logging
 import time
+import weakref
 from dataclasses import dataclass, field
-from typing import Optional, Sequence
+from typing import Awaitable, Callable, Optional, Sequence
 
 import msgpack
 
+from dynamo_tpu.kv_router.digest import DIGEST_SEED, fold_hashes
 from dynamo_tpu.subjects import KV_EVENT_SUBJECT
 
 logger = logging.getLogger(__name__)
+
+
+# -- process-global index-health counters (telemetry/debug.kv_index_lines
+# exposes them as dynamo_tpu_kv_index_{gaps,resyncs,drift_blocks}_total on
+# both Prometheus surfaces; docs/operations.md "KV index consistency") ----
+
+
+class IndexHealthCounters:
+    def __init__(self):
+        self.gaps = 0
+        self.resyncs = 0
+        self.resync_failures = 0
+        self.drift_blocks = 0
+        self.digest_mismatches = 0
+
+    def reset(self) -> None:
+        self.__init__()
+
+
+index_counters = IndexHealthCounters()
+
+#: live indexers in this process (weak — a dropped router must not pin
+#: its index); the stale-workers gauge sums over them
+_live_indexers: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def process_stale_workers() -> int:
+    return sum(len(idx._stale) for idx in _live_indexers)
 
 
 @dataclass
@@ -168,6 +198,13 @@ class RadixTree:
     def blocks_for(self, worker_id: str) -> int:
         return len(self._hashes_by_worker.get(worker_id, ()))
 
+    def digest_for(self, worker_id: str) -> tuple[int, int]:
+        """(xxh3-fold, count) of this worker's indexed block set — the
+        anti-entropy comparand against the worker-advertised digest
+        (kv_router/digest.py; the native tree computes the identical
+        fold in dyn_radix_digest)."""
+        return fold_hashes(self._hashes_by_worker.get(worker_id, ()))
+
 
 class NativeRadixTree:
     """Same interface as RadixTree, backed by the C++ index
@@ -218,9 +255,13 @@ class NativeRadixTree:
         kind = event["kind"]
         hashes = event["block_hashes"]  # KeyError parity with RadixTree
         if kind == "handed_over":
-            self.move_worker(worker_id, str(event.get("successor") or ""))
-            self._unknown_events += 1  # events_applied parity (native
-            # move counts no apply)
+            dst = str(event.get("successor") or "")
+            moved = self.move_worker(worker_id, dst)
+            if not (dst and dst != worker_id and moved):
+                # events_applied parity: a real move counted one native
+                # apply (the store_bulk); an empty/removal-only move
+                # counted none
+                self._unknown_events += 1
             return
         if kind not in ("stored", "removed"):
             logger.warning("unknown kv event kind %r", kind)
@@ -242,12 +283,20 @@ class NativeRadixTree:
         return self._lib.dyn_radix_remove_worker(self._ptr, wid)
 
     def take_worker(self, worker_id: str) -> list[int]:
-        """The native index cannot enumerate a worker's hashes — the
-        take degrades to a remove and returns nothing; the successor's
-        own stored events repopulate its score within one metrics
-        interval (documented honest degradation of the bulk move)."""
-        self.remove_worker(worker_id)
-        return []
+        """remove_worker that RETURNS the dropped hashes (native
+        enumeration via dyn_radix_take_worker) — full parity with the
+        Python tree, so bulk-ownership moves and resync subtree swaps
+        behave identically on both implementations."""
+        import numpy as np
+
+        self._live.discard(worker_id)
+        wid = self._ids.get(worker_id)
+        if wid is None:
+            return []
+        n = self._lib.dyn_radix_blocks_for(self._ptr, wid)
+        out = np.empty(max(1, n), np.uint64)
+        k = self._lib.dyn_radix_take_worker(self._ptr, wid, out.ctypes.data, n)
+        return [int(x) for x in out[: min(k, n)]]
 
     def store_bulk(self, worker_id: str, hashes) -> None:
         if not hashes:
@@ -257,7 +306,12 @@ class NativeRadixTree:
         self._live.add(worker_id)
 
     def move_worker(self, src: str, dst: str) -> int:
-        return self.remove_worker(src)
+        if not dst or dst == src:
+            return self.remove_worker(src)
+        hashes = self.take_worker(src)
+        if hashes:
+            self.store_bulk(dst, hashes)
+        return len(hashes)
 
     def clear(self) -> None:
         self._lib.dyn_radix_clear(self._ptr)
@@ -307,6 +361,18 @@ class NativeRadixTree:
             return 0
         return self._lib.dyn_radix_blocks_for(self._ptr, wid)
 
+    def digest_for(self, worker_id: str) -> tuple[int, int]:
+        import ctypes
+
+        wid = self._ids.get(worker_id)
+        if wid is None:
+            return (0, 0)
+        fold = ctypes.c_uint64(0)
+        n = self._lib.dyn_radix_digest(
+            self._ptr, wid, DIGEST_SEED, ctypes.byref(fold)
+        )
+        return (int(fold.value), int(n))
+
 
 def make_radix_tree():
     """Native-backed tree when libdynamo_native is available, else Python."""
@@ -317,7 +383,404 @@ def make_radix_tree():
     return RadixTree()
 
 
-class KvIndexerSharded:
+# -- convergence machinery (docs/operations.md "KV index consistency") ----
+#
+# The fabric's pub/sub is at-most-once per connection epoch; the replay
+# ring (runtime/fabric/local.py) narrows but cannot close the loss window
+# (ring trimmed, broker restarted without a WAL, worker publish failures).
+# So the index defends itself end to end:
+#
+#   gap detection   every worker stamps its events with a monotonic `seq`
+#                   (worker.py _stamp_kv_events); a skipped seq == lost
+#                   events == this worker's subtree may be wrong.
+#   anti-entropy    workers advertise a rolling (seq, xxh3-fold, count)
+#                   digest of their registered set in their metrics
+#                   frames; a periodic sweep compares it — at equal seq —
+#                   against the index's own per-worker digest, catching
+#                   silent drift no gap ever reveals (and a lost stream
+#                   TAIL: the frame's seq keeps leading while the index's
+#                   stops moving).
+#   stale-as-cold   a worker flagged by either detector is scored COLD by
+#                   find_matches until repaired: a false cold hit costs
+#                   one prefill; a false warm hit routes a request at
+#                   pages that do not exist.
+#   targeted resync fetch the worker's full hash forest over the
+#                   `kv.snapshot` ingress op, atomically replace its
+#                   subtree (live events buffered during the swap, then
+#                   replayed past the snapshot's seq), and un-stale it.
+#                   Cold start bootstraps the same way instead of waiting
+#                   for event repopulation.
+
+
+@dataclass
+class _WkState:
+    """Per-worker consistency bookkeeping (event-loop confined)."""
+
+    last_seq: int = 0
+    #: a stamped event or snapshot has established the cursor
+    tracked: bool = False
+    stale: bool = False
+    resyncing: bool = False
+    #: events held back while a resync swap is in flight
+    buffer: list = field(default_factory=list)
+    #: consecutive sweeps the advertised seq led a non-advancing cursor
+    lag_sweeps: int = 0
+    prev_sweep_seq: int = -1
+    #: consecutive sweeps the digest mismatched at equal seq — one
+    #: mismatch can be transient skew (a sharded drain backlog between
+    #: the screened cursor and the tree), so drift needs two in a row
+    mismatch_sweeps: int = 0
+    #: sweeps to sit out entirely: set on the SUCCESSOR of a
+    #: handed_over move, whose advertised digest lags the index's
+    #: optimistic credit until its adoption `stored` events publish —
+    #: comparing inside that window would cold-score the very worker
+    #: the handover just warmed
+    sweep_grace: int = 0
+
+
+class _ConsistencyBase:
+    """Sequence/digest/staleness logic shared by KvIndexer and
+    KvIndexerSharded; subclasses provide `_apply_events` (route one
+    screened batch into the tree(s)), `_swap_subtree` (atomic remove +
+    bulk store, serialized with event application) and `_digest_of`."""
+
+    #: seconds between anti-entropy sweeps / stale-repair attempts
+    anti_entropy_interval: float = 2.0
+
+    def _init_consistency(
+        self,
+        snapshot_fn: Optional[Callable[[str], Awaitable[Optional[dict]]]],
+        digest_source: Optional[Callable[[], dict]],
+        anti_entropy_interval: float,
+    ) -> None:
+        self.snapshot_fn = snapshot_fn
+        self.digest_source = digest_source
+        self.anti_entropy_interval = anti_entropy_interval
+        self._states: dict[str, _WkState] = {}
+        self._stale: set[str] = set()
+        self.gaps_total = 0
+        self.resyncs_total = 0
+        self.resync_failures_total = 0
+        self.drift_blocks_total = 0
+        self.digest_mismatches_total = 0
+        self._consistency_task: Optional[asyncio.Task] = None
+        _live_indexers.add(self)
+
+    @property
+    def resync_enabled(self) -> bool:
+        return self.snapshot_fn is not None
+
+    def stats(self) -> dict:
+        """Index-health snapshot (KvRouter publishes it on
+        kv_index.status; doctor's kv-index-drift rule reads the fold)."""
+        return {
+            "gaps_total": self.gaps_total,
+            "resyncs_total": self.resyncs_total,
+            "resync_failures_total": self.resync_failures_total,
+            "drift_blocks_total": self.drift_blocks_total,
+            "digest_mismatches_total": self.digest_mismatches_total,
+            "stale_workers": len(self._stale),
+            "workers_tracked": sum(
+                1 for s in self._states.values() if s.tracked
+            ),
+            "resync_enabled": self.resync_enabled,
+        }
+
+    def stale_workers(self) -> set[str]:
+        return set(self._stale)
+
+    def _state(self, worker_id: str) -> _WkState:
+        st = self._states.get(worker_id)
+        if st is None:
+            st = self._states[worker_id] = _WkState()
+        return st
+
+    def _mark_stale(self, worker_id: str, st: _WkState, why: str) -> None:
+        if not self.resync_enabled:
+            # no repair path configured: keep the legacy scoring behavior
+            # (never down-score), just surface the observation
+            logger.warning(
+                "kv index %s for worker %s (no resync configured)",
+                why, worker_id,
+            )
+            return
+        if not st.stale:
+            st.stale = True
+            self._stale.add(worker_id)
+            logger.warning(
+                "kv index marked worker %s stale (%s); scoring it cold "
+                "until resync", worker_id, why,
+            )
+
+    def _note_gap(self, worker_id: str, st: _WkState, seq: int) -> None:
+        self.gaps_total += 1
+        index_counters.gaps += 1
+        self._mark_stale(
+            worker_id, st,
+            f"sequence gap (have {st.last_seq}, saw {seq})",
+        )
+
+    def _screen_events(self, worker_id: str, events: list) -> list:
+        """Event-loop-side admission of one published batch: duplicates
+        (transport redelivery / resume overlap) dropped, events held
+        while a resync swap is in flight, sequence gaps flagged.
+        Unstamped events (sequencing off / older peers) pass through
+        untracked — the pre-sequencing behavior, bit for bit."""
+        out = []
+        st = self._states.get(worker_id)
+        for ev in events:
+            seq = ev.get("seq") if isinstance(ev, dict) else None
+            if not isinstance(seq, int) or seq <= 0:
+                out.append(ev)
+                continue
+            if st is None:
+                st = self._state(worker_id)
+            if st.resyncing:
+                st.buffer.append(ev)
+                continue
+            if st.tracked and seq <= st.last_seq:
+                continue  # duplicate
+            if st.tracked and seq > st.last_seq + 1:
+                self._note_gap(worker_id, st, seq)
+            elif not st.tracked and seq > 1 and self.resync_enabled:
+                # first contact mid-stream: everything before `seq` was
+                # published before we subscribed (indexer restart) —
+                # same repair as a gap
+                self._note_gap(worker_id, st, seq)
+            st.last_seq = seq
+            st.tracked = True
+            if ev.get("kind") == "handed_over":
+                # the move credits the successor with blocks its OWN
+                # digest won't advertise until its adoption `stored`
+                # events publish — give it a comparison grace window
+                succ = ev.get("successor")
+                if succ and succ != worker_id:
+                    self._state(str(succ)).sweep_grace = 2
+            out.append(ev)
+        return out
+
+    def _filter_stale(self, out: "OverlapScores") -> "OverlapScores":
+        """Stale subtrees score COLD: drop their entries so the selector
+        can never route a warm hit at pages the worker may not hold."""
+        if self._stale:
+            dropped = False
+            for w in self._stale:
+                if out.scores.pop(w, None) is not None:
+                    dropped = True
+            if dropped:
+                out.matched_blocks = max(out.scores.values(), default=0)
+        return out
+
+    def _forget_worker(self, worker_id: str) -> None:
+        self._states.pop(worker_id, None)
+        self._stale.discard(worker_id)
+
+    # -- resync ------------------------------------------------------------
+
+    async def _resync(self, worker_id: str) -> bool:
+        """Snapshot fetch → atomic subtree replace → buffered-event
+        replay. False (and the worker stays stale) when the snapshot is
+        unavailable — a dead worker stays cold until the prune loop
+        removes it; a live one is retried next sweep."""
+        if not self.resync_enabled:
+            return False
+        st = self._state(worker_id)
+        if st.resyncing:
+            return False
+        st.resyncing = True
+        snap = None
+        try:
+            snap = await self.snapshot_fn(worker_id)
+        except Exception:
+            logger.warning(
+                "kv.snapshot fetch from %s failed", worker_id,
+                exc_info=True,
+            )
+        swapped = False
+        try:
+            if isinstance(snap, dict) and snap.get("sequencing"):
+                # a malformed snapshot body (mixed-version peer, junk
+                # hashes) must fail like an unavailable one — never
+                # leave st.resyncing latched with the buffer growing
+                hashes = [int(b[0]) for b in snap.get("blocks") or ()]
+                seq = int(snap.get("seq") or 0)
+                drift = await self._swap_subtree(worker_id, hashes)
+                swapped = True
+        except Exception:
+            logger.warning(
+                "kv.snapshot from %s unusable", worker_id, exc_info=True
+            )
+        if not swapped:
+            self.resync_failures_total += 1
+            index_counters.resync_failures += 1
+            buffered, st.buffer = st.buffer, []
+            st.resyncing = False
+            # apply what we buffered anyway — newer truth beats nothing —
+            # and keep the worker stale for the next attempt
+            events = self._screen_events(worker_id, buffered)
+            if events:
+                await self._apply_events(worker_id, events)
+            return False
+        self.drift_blocks_total += drift
+        index_counters.drift_blocks += drift
+        st.last_seq = seq
+        st.tracked = True
+        st.lag_sweeps = 0
+        st.mismatch_sweeps = 0
+        buffered, st.buffer = st.buffer, []
+        st.resyncing = False
+        if st.stale:
+            st.stale = False
+            self._stale.discard(worker_id)
+        self.resyncs_total += 1
+        index_counters.resyncs += 1
+        # events that arrived during the swap: anything at or below the
+        # snapshot's seq is already IN the snapshot; the rest applies on
+        # top (an in-buffer gap re-flags and re-syncs)
+        events = self._screen_events(worker_id, buffered)
+        if events:
+            await self._apply_events(worker_id, events)
+        logger.info(
+            "kv index resynced worker %s: %d blocks at seq %d "
+            "(%d drift corrected)", worker_id, len(hashes), seq, drift,
+        )
+        return True
+
+    async def bootstrap(self, worker_ids: Sequence[str]) -> int:
+        """Cold-start population from live workers' snapshots instead of
+        waiting for event repopulation (indexer restart / late join).
+        Returns how many workers were loaded."""
+        n = 0
+        for w in worker_ids:
+            try:
+                if await self._resync(w):
+                    n += 1
+            except Exception:
+                logger.warning("bootstrap of %s failed", w, exc_info=True)
+        return n
+
+    # -- anti-entropy ------------------------------------------------------
+
+    def _start_consistency(self) -> None:
+        if self.snapshot_fn is not None or self.digest_source is not None:
+            self._consistency_task = asyncio.get_running_loop().create_task(
+                self._consistency_loop()
+            )
+
+    def _stop_consistency(self) -> None:
+        if self._consistency_task is not None:
+            self._consistency_task.cancel()
+
+    async def _consistency_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.anti_entropy_interval)
+            try:
+                await self._consistency_tick()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                logger.exception("kv index consistency tick failed")
+
+    async def _consistency_tick(self) -> None:
+        # 1. repair: every stale subtree gets a resync attempt
+        for w in list(self._stale):
+            st = self._states.get(w)
+            if st is not None and not st.resyncing:
+                await self._resync(w)
+        # 2. anti-entropy sweep against the metrics-frame digests
+        if self.digest_source is None:
+            return
+        try:
+            digests = self.digest_source() or {}
+        except Exception:
+            logger.warning("digest source failed", exc_info=True)
+            return
+        for w, d in digests.items():
+            if not isinstance(d, dict):
+                continue
+            try:
+                seq = int(d.get("seq") or 0)
+                fold = int(d.get("fold") or 0)
+                count = int(d.get("count") or 0)
+            except (TypeError, ValueError):
+                continue
+            st = self._state(w)
+            if st.resyncing or st.stale:
+                continue
+            if st.sweep_grace > 0:
+                st.sweep_grace -= 1
+                continue
+            if seq == st.last_seq:
+                # comparable cut: the index applied exactly through the
+                # digest's seq, so the sets must be identical. One
+                # mismatched sweep can still be transient skew (the
+                # sharded drain thread lagging the screened cursor) —
+                # only two in a row is drift.
+                ifold, icount = self._digest_of(w)
+                if (ifold, icount) != (fold, count):
+                    st.mismatch_sweeps += 1
+                    if st.mismatch_sweeps >= 2:
+                        st.mismatch_sweeps = 0
+                        self.digest_mismatches_total += 1
+                        index_counters.digest_mismatches += 1
+                        self._mark_stale(
+                            w, st,
+                            f"digest drift at seq {seq} "
+                            f"(index {icount} blocks, worker {count})",
+                        )
+                else:
+                    st.mismatch_sweeps = 0
+                st.lag_sweeps = 0
+            elif seq > st.last_seq:
+                # the worker is ahead. Normally the missing events are in
+                # flight and the cursor catches up; a cursor that does
+                # NOT move across consecutive sweeps means the stream's
+                # tail was lost — the one loss shape no later event's
+                # seq can ever reveal
+                if st.prev_sweep_seq == st.last_seq:
+                    st.lag_sweeps += 1
+                else:
+                    st.lag_sweeps = 1
+                if st.lag_sweeps >= 2:
+                    st.lag_sweeps = 0
+                    self._note_gap(w, st, seq)
+            else:
+                st.lag_sweeps = 0
+            st.prev_sweep_seq = st.last_seq
+
+    # -- subclass hooks ----------------------------------------------------
+
+    async def _apply_events(self, worker_id: str, events: list) -> None:
+        raise NotImplementedError
+
+    async def _swap_subtree(self, worker_id: str, hashes: list[int]) -> int:
+        raise NotImplementedError
+
+    def _digest_of(self, worker_id: str) -> tuple[int, int]:
+        raise NotImplementedError
+
+
+def _resolve_future(fut: asyncio.Future, value) -> None:
+    if not fut.done():
+        fut.set_result(value)
+
+
+class _SwapOp:
+    """A resync subtree replace routed THROUGH the shard queue, so it
+    serializes behind every event batch already enqueued for the worker
+    (the swap must land after them, before anything buffered during
+    it)."""
+
+    __slots__ = ("worker_id", "hashes", "future", "loop")
+
+    def __init__(self, worker_id, hashes, future, loop):
+        self.worker_id = worker_id
+        self.hashes = hashes
+        self.future = future
+        self.loop = loop
+
+
+class KvIndexerSharded(_ConsistencyBase):
     """Worker-sharded index: N independent trees, each owning a subset of
     workers (hash of worker id), each with its OWN event queue drained by
     its own thread — native tree calls release the GIL, so event
@@ -326,9 +789,22 @@ class KvIndexerSharded:
 
     Queries fan out to every shard and merge: per-worker scores live in
     exactly one shard, so the merge is a dict union; matched_blocks is the
-    max across shards."""
+    max across shards.
 
-    def __init__(self, fabric, num_shards: int = 4, subject: str = KV_EVENT_SUBJECT):
+    With `snapshot_fn`/`digest_source` wired (KvRouter does), the index
+    is self-healing: sequence gaps and digest drift mark a worker's
+    subtree stale (scored cold) and trigger a targeted resync — see
+    _ConsistencyBase above."""
+
+    def __init__(
+        self,
+        fabric,
+        num_shards: int = 4,
+        subject: str = KV_EVENT_SUBJECT,
+        snapshot_fn=None,
+        digest_source=None,
+        anti_entropy_interval: float = 2.0,
+    ):
         import queue as _queue
         import threading
 
@@ -337,6 +813,9 @@ class KvIndexerSharded:
         self.fabric = fabric
         self.subject = subject
         self.num_shards = num_shards
+        self._init_consistency(
+            snapshot_fn, digest_source, anti_entropy_interval
+        )
         self.trees = [make_radix_tree() for _ in range(num_shards)]
         #: one lock per shard: serializes that shard's apply (drain thread)
         #: against queries (event-loop thread) — the native tree has no
@@ -371,6 +850,7 @@ class KvIndexerSharded:
             t.start()
         self._sub = await self.fabric.subscribe(self.subject + ".>")
         self._task = asyncio.get_running_loop().create_task(self._pump())
+        self._start_consistency()
 
     async def _pump(self) -> None:
         while True:
@@ -382,12 +862,16 @@ class KvIndexerSharded:
             try:
                 worker_id = msg.header["instance_id"]
                 events = msgpack.unpackb(msg.payload, raw=False)
-                self._queues[self._shard_of(worker_id)].put(
-                    (worker_id, events)
-                )
+                # hooks observe the raw stream (recorder/metrics taps);
+                # the tree only gets what the seq screen admits
                 for ev in events:
                     for hook in self._on_event_hooks:
                         hook(worker_id, ev, time.monotonic())
+                events = self._screen_events(worker_id, events)
+                if events:
+                    self._queues[self._shard_of(worker_id)].put(
+                        (worker_id, events)
+                    )
             except Exception:
                 logger.exception("bad kv event message on %s", msg.subject)
 
@@ -399,6 +883,32 @@ class KvIndexerSharded:
                 return
             self._busy[shard] = True
             try:
+                if isinstance(item, _SwapOp):
+                    # guarded like the per-event path below, and the
+                    # future ALWAYS resolves: a raise here would kill
+                    # this shard's drain thread (index frozen for its
+                    # workers) and wedge the awaiting _resync forever
+                    drift = 0
+                    try:
+                        with lock:
+                            old = tree.take_worker(item.worker_id)
+                            if item.hashes:
+                                tree.store_bulk(
+                                    item.worker_id, item.hashes
+                                )
+                        drift = len(set(old) ^ set(item.hashes))
+                    except Exception:
+                        logger.exception(
+                            "shard %d swap failed for %s",
+                            shard, item.worker_id,
+                        )
+                    try:
+                        item.loop.call_soon_threadsafe(
+                            _resolve_future, item.future, drift
+                        )
+                    except RuntimeError:
+                        pass  # loop closed: nobody is awaiting anymore
+                    continue
                 worker_id, events = item
                 for ev in events:
                     try:
@@ -442,7 +952,7 @@ class KvIndexerSharded:
                 part = tree.find_matches(seq_hashes)
             out.scores.update(part.scores)
             out.matched_blocks = max(out.matched_blocks, part.matched_blocks)
-        return out
+        return self._filter_stale(out)
 
     def workers(self) -> set:
         out: set = set()
@@ -452,9 +962,28 @@ class KvIndexerSharded:
         return out
 
     def remove_worker(self, worker_id: str) -> int:
+        self._forget_worker(worker_id)
         shard = self._shard_of(worker_id)
         with self._locks[shard]:
             return self.trees[shard].remove_worker(worker_id)
+
+    # -- consistency hooks (_ConsistencyBase) ------------------------------
+
+    async def _apply_events(self, worker_id: str, events: list) -> None:
+        self._queues[self._shard_of(worker_id)].put((worker_id, events))
+
+    async def _swap_subtree(self, worker_id: str, hashes: list[int]) -> int:
+        loop = asyncio.get_running_loop()
+        fut: asyncio.Future = loop.create_future()
+        self._queues[self._shard_of(worker_id)].put(
+            _SwapOp(worker_id, hashes, fut, loop)
+        )
+        return await fut
+
+    def _digest_of(self, worker_id: str) -> tuple[int, int]:
+        shard = self._shard_of(worker_id)
+        with self._locks[shard]:
+            return self.trees[shard].digest_for(worker_id)
 
     def move_worker(self, src: str, dst: str) -> None:
         """Bulk ownership move (worker handover), cross-shard safe."""
@@ -470,6 +999,7 @@ class KvIndexerSharded:
             await asyncio.sleep(0.005)
 
     async def stop(self) -> None:
+        self._stop_consistency()
         if self._sub is not None:
             self._sub.close()
         if self._task is not None:
@@ -478,22 +1008,35 @@ class KvIndexerSharded:
             q.put(None)
 
 
-class KvIndexer:
+class KvIndexer(_ConsistencyBase):
     """Event-driven index: subscribes `kv_events.>` on the fabric and keeps
     a RadixTree current (reference: KvIndexer — indexer.rs:518, fed from the
-    NATS kv_events subject, kv_router.rs:131-152)."""
+    NATS kv_events subject, kv_router.rs:131-152). Gains the same
+    gap-detection / anti-entropy / resync machinery as the sharded
+    variant when `snapshot_fn`/`digest_source` are wired."""
 
-    def __init__(self, fabric, subject: str = KV_EVENT_SUBJECT):
+    def __init__(
+        self,
+        fabric,
+        subject: str = KV_EVENT_SUBJECT,
+        snapshot_fn=None,
+        digest_source=None,
+        anti_entropy_interval: float = 2.0,
+    ):
         self.fabric = fabric
         self.subject = subject
         self.tree = make_radix_tree()
         self._sub = None
         self._task: Optional[asyncio.Task] = None
         self._on_event_hooks = []
+        self._init_consistency(
+            snapshot_fn, digest_source, anti_entropy_interval
+        )
 
     async def start(self) -> None:
         self._sub = await self.fabric.subscribe(self.subject + ".>")
         self._task = asyncio.get_running_loop().create_task(self._pump())
+        self._start_consistency()
 
     async def _pump(self) -> None:
         while True:
@@ -504,9 +1047,10 @@ class KvIndexer:
                 worker_id = msg.header["instance_id"]
                 events = msgpack.unpackb(msg.payload, raw=False)
                 for ev in events:
-                    self.tree.apply_event(worker_id, ev)
                     for hook in self._on_event_hooks:
                         hook(worker_id, ev, time.monotonic())
+                for ev in self._screen_events(worker_id, events):
+                    self.tree.apply_event(worker_id, ev)
             except Exception:
                 logger.exception("bad kv event message on %s", msg.subject)
 
@@ -515,19 +1059,39 @@ class KvIndexer:
         self._on_event_hooks.append(hook)
 
     def find_matches(self, seq_hashes: Sequence[int]) -> OverlapScores:
-        return self.tree.find_matches(seq_hashes)
+        return self._filter_stale(self.tree.find_matches(seq_hashes))
 
     def workers(self) -> set:
         return self.tree.workers()
 
     def remove_worker(self, worker_id: str) -> int:
+        self._forget_worker(worker_id)
         return self.tree.remove_worker(worker_id)
 
     def move_worker(self, src: str, dst: str) -> int:
         """Bulk ownership move (worker handover)."""
         return self.tree.move_worker(src, dst)
 
+    # -- consistency hooks (_ConsistencyBase) ------------------------------
+
+    async def _apply_events(self, worker_id: str, events: list) -> None:
+        for ev in events:
+            try:
+                self.tree.apply_event(worker_id, ev)
+            except Exception:
+                logger.exception("apply failed for %s", worker_id)
+
+    async def _swap_subtree(self, worker_id: str, hashes: list[int]) -> int:
+        old = self.tree.take_worker(worker_id)
+        if hashes:
+            self.tree.store_bulk(worker_id, hashes)
+        return len(set(old) ^ set(hashes))
+
+    def _digest_of(self, worker_id: str) -> tuple[int, int]:
+        return self.tree.digest_for(worker_id)
+
     async def stop(self) -> None:
+        self._stop_consistency()
         if self._sub is not None:
             self._sub.close()
         if self._task is not None:

@@ -53,15 +53,37 @@ class KvRouter:
         self.salt = salt
         self.config = config or KvRouterConfig()
         self.selector = selector or DefaultWorkerSelector(self.config)
+        self.metrics = MetricsAggregator(fabric, component)
+        # self-healing index (docs/operations.md "KV index consistency"):
+        # snapshots come from the workers' `kv.snapshot` ingress op,
+        # digests from the same metrics frames this router already
+        # aggregates — sequence gaps and digest drift mark a subtree
+        # stale (scored cold) and trigger a targeted resync
         if indexer_shards > 1:
             from dynamo_tpu.kv_router.indexer import KvIndexerSharded
 
-            self.indexer = KvIndexerSharded(fabric, num_shards=indexer_shards)
+            self.indexer = KvIndexerSharded(
+                fabric,
+                num_shards=indexer_shards,
+                snapshot_fn=self._fetch_snapshot,
+                digest_source=self._worker_digests,
+            )
         else:
-            self.indexer = KvIndexer(fabric)
-        self.metrics = MetricsAggregator(fabric, component)
+            self.indexer = KvIndexer(
+                fabric,
+                snapshot_fn=self._fetch_snapshot,
+                digest_source=self._worker_digests,
+            )
         self.active = ActiveSequences(block_size)
+        #: distinguishes this router's kv_index.status frames from other
+        #: routers serving the same component (the metrics service keys
+        #: and sums per (component, router) — two frontends must not
+        #: overwrite each other's counters into a sawtooth)
+        import uuid
+
+        self.router_id = uuid.uuid4().hex[:12]
         self._prune_task: Optional[asyncio.Task] = None
+        self._bootstrap_task: Optional[asyncio.Task] = None
 
     async def start(self) -> None:
         await self.indexer.start()
@@ -69,12 +91,62 @@ class KvRouter:
         self._prune_task = asyncio.get_running_loop().create_task(
             self._prune_loop()
         )
+        # cold-start bootstrap: load live workers' snapshots instead of
+        # waiting for event repopulation (a restarted router scores warm
+        # prefixes within one round trip per worker)
+        self._bootstrap_task = asyncio.get_running_loop().create_task(
+            self._bootstrap()
+        )
+
+    async def _bootstrap(self) -> None:
+        try:
+            instances = self.source.list()
+            if not instances:
+                instances = await self.source.wait_for_instances(timeout=2.0)
+            n = await self.indexer.bootstrap(
+                [i.instance_id for i in instances]
+            )
+            if n:
+                logger.info(
+                    "kv index bootstrapped from %d worker snapshot(s)", n
+                )
+        except Exception:
+            logger.warning("kv index bootstrap failed", exc_info=True)
+
+    async def _fetch_snapshot(self, worker_id: str) -> Optional[dict]:
+        """`kv.snapshot` fetch for the indexer's resync path."""
+        inst = next(
+            (
+                i
+                for i in self.source.list()
+                if i.instance_id == worker_id
+            ),
+            None,
+        )
+        if inst is None:
+            return None
+        from dynamo_tpu.handover import call_ingress
+
+        return await call_ingress(
+            inst.host, inst.port, "kv.snapshot", {}, timeout=5.0
+        )
+
+    def _worker_digests(self) -> dict:
+        """Latest worker-advertised digests for the anti-entropy sweep."""
+        out = {}
+        for iid, m in self.metrics.snapshot().items():
+            d = m.get("kv_digest")
+            if isinstance(d, dict):
+                out[iid] = d
+        return out
 
     async def _prune_loop(self, interval: float = 1.0) -> None:
         """Drop state for workers whose registration disappeared. "Known"
         workers are whatever the index/metrics/bookkeeping have actually
         heard from — not a polled history — so a worker that lives and dies
         between two ticks is still cleaned up."""
+        from dynamo_tpu.subjects import KV_INDEX_SUBJECT
+
         while True:
             await asyncio.sleep(interval)
             live = {i.instance_id for i in self.source.list()}
@@ -92,6 +164,21 @@ class KvRouter:
                         "pruned %d indexed blocks of departed worker %s",
                         n, gone,
                     )
+            # index-health heartbeat: the metrics service folds this into
+            # dynamo_tpu_router_kv_index_*{component,router} and
+            # /v1/fleet's `kv_index` section (doctor's kv-index-drift
+            # rule reads it)
+            try:
+                await self.fabric.publish(
+                    KV_INDEX_SUBJECT,
+                    {
+                        "component": self.component,
+                        "router": self.router_id,
+                        **self.indexer.stats(),
+                    },
+                )
+            except Exception:
+                logger.debug("kv_index status publish failed", exc_info=True)
 
     # -- the decision ------------------------------------------------------
 
@@ -188,5 +275,7 @@ class KvRouter:
     async def stop(self) -> None:
         if self._prune_task is not None:
             self._prune_task.cancel()
+        if self._bootstrap_task is not None:
+            self._bootstrap_task.cancel()
         await self.indexer.stop()
         await self.metrics.stop()

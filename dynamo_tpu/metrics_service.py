@@ -21,7 +21,11 @@ from typing import Optional
 from aiohttp import web
 
 from dynamo_tpu.kv_router.metrics_aggregator import MetricsAggregator
-from dynamo_tpu.subjects import KV_HIT_RATE_SUBJECT, PLANNER_SUBJECT
+from dynamo_tpu.subjects import (
+    KV_HIT_RATE_SUBJECT,
+    KV_INDEX_SUBJECT,
+    PLANNER_SUBJECT,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -176,6 +180,11 @@ class MetricsService:
         #: or restarted workers (see _fold_departed)
         self._live_contrib: dict[str, tuple[str, dict]] = {}
         self._retired_counters: dict[str, dict] = {}
+        #: contributions folded for AGED-OUT workers, kept so a worker
+        #: that returns with its counters intact (a transient publish
+        #: gap — partition, fabric outage — not a restart) can be
+        #: UN-folded instead of double-counted (see _fold_departed)
+        self._ghost_contrib: dict[str, tuple[str, dict]] = {}
         # cumulative router-decision counters (KVHitRateEvent stream)
         self.hit_events = 0
         self.isl_tokens_total = 0
@@ -190,9 +199,19 @@ class MetricsService:
         #: section doctor's planner rules read
         self.planner_status: Optional[dict] = None
         self.planner_status_age: float = 0.0
+        #: latest KV index-health frame per (component, router id)
+        #: (KvRouter publishes its indexer's stats over
+        #: KV_INDEX_SUBJECT) — serves the
+        #: dynamo_tpu_router_kv_index_*{component,router} families and
+        #: the /v1/fleet `kv_index` section doctor's kv-index-drift
+        #: rule reads
+        self.kv_index_status: dict[str, dict] = {}
+        self.kv_index_status_age: dict[str, float] = {}
         self._sub = None
         self._planner_sub = None
+        self._kv_index_sub = None
         self._task: Optional[asyncio.Task] = None
+        self._kv_index_task: Optional[asyncio.Task] = None
         self._planner_task: Optional[asyncio.Task] = None
         self._stats_task: Optional[asyncio.Task] = None
         self._runner: Optional[web.AppRunner] = None
@@ -207,6 +226,10 @@ class MetricsService:
         self._planner_sub = await self.fabric.subscribe(PLANNER_SUBJECT)
         self._planner_task = asyncio.get_running_loop().create_task(
             self._planner_pump()
+        )
+        self._kv_index_sub = await self.fabric.subscribe(KV_INDEX_SUBJECT)
+        self._kv_index_task = asyncio.get_running_loop().create_task(
+            self._kv_index_pump()
         )
         if hasattr(self.fabric, "stats"):
             self._stats_task = asyncio.get_running_loop().create_task(
@@ -237,6 +260,10 @@ class MetricsService:
             self._planner_sub.close()
         if self._planner_task is not None:
             self._planner_task.cancel()
+        if self._kv_index_sub is not None:
+            self._kv_index_sub.close()
+        if self._kv_index_task is not None:
+            self._kv_index_task.cancel()
         if self._stats_task is not None:
             self._stats_task.cancel()
         for agg in self.aggregators:
@@ -278,6 +305,104 @@ class MetricsService:
                 continue
             self.planner_status = frame
             self.planner_status_age = _time.monotonic()
+
+    async def _kv_index_pump(self) -> None:
+        """Latest-wins consumer of router index-health frames, keyed by
+        (component, router id) — two routers serving the SAME component
+        (e.g. two frontends) must not overwrite each other into a
+        counter sawtooth; the exposition emits per-key samples and the
+        fleet doc sums them. A malformed frame is logged and skipped,
+        never kills the pump. Frames from dead routers age out."""
+        import time as _time
+
+        while True:
+            msg = await self._kv_index_sub.next()
+            if msg is None:
+                return
+            frame = getattr(msg, "header", None)
+            if not isinstance(frame, dict):
+                logger.warning("malformed kv_index frame: %r", frame)
+                continue
+            comp = str(frame.get("component") or "backend")
+            key = f"{comp}|{frame.get('router') or ''}"
+            now = _time.monotonic()
+            self.kv_index_status[key] = frame
+            self.kv_index_status_age[key] = now
+            # a restarted router gets a fresh router id: prune entries
+            # that stopped refreshing so its old counters don't linger
+            for k in list(self.kv_index_status):
+                if now - self.kv_index_status_age.get(k, now) > 15.0:
+                    del self.kv_index_status[k]
+                    self.kv_index_status_age.pop(k, None)
+
+    def _kv_index_doc(self) -> Optional[dict]:
+        """The /v1/fleet `kv_index` section: SUMMED counters across
+        every live router frame at the top level (one stale subtree
+        anywhere must surface there) plus the per-(component, router)
+        frames underneath."""
+        import time as _time
+
+        if not self.kv_index_status:
+            return None
+        now = _time.monotonic()
+        doc: dict = {"components": {}}
+        totals = {
+            k: 0
+            for k in (
+                "gaps_total", "resyncs_total", "resync_failures_total",
+                "drift_blocks_total", "digest_mismatches_total",
+                "stale_workers",
+            )
+        }
+        for key, frame in sorted(self.kv_index_status.items()):
+            doc["components"][key] = {
+                **frame,
+                "last_seen_s": round(
+                    now - self.kv_index_status_age.get(key, now), 3
+                ),
+            }
+            for k in totals:
+                try:
+                    totals[k] += int(frame.get(k) or 0)
+                except (TypeError, ValueError):
+                    pass
+        doc.update(totals)
+        return doc
+
+    def _kv_index_lines(self) -> list[str]:
+        """`dynamo_tpu_router_kv_index_*{component,router}` — the
+        fleet-side view of router-published index health, one sample
+        per live router frame (dashboards sum over them; the routers'
+        own processes expose the unlabeled dynamo_tpu_kv_index_*
+        families via debug.kv_index_lines)."""
+        if not self.kv_index_status:
+            return []
+        lines: list[str] = []
+        fields = (
+            ("gaps_total", "counter"),
+            ("resyncs_total", "counter"),
+            ("resync_failures_total", "counter"),
+            ("drift_blocks_total", "counter"),
+            ("digest_mismatches_total", "counter"),
+            ("stale_workers", "gauge"),
+        )
+        for fieldname, ptype in fields:
+            samples = []
+            for key, frame in sorted(self.kv_index_status.items()):
+                v = frame.get(fieldname)
+                if isinstance(v, (int, float)):
+                    comp = str(frame.get("component") or "backend")
+                    router = str(frame.get("router") or "")
+                    samples.append((comp, router, v))
+            if not samples:
+                continue
+            name = f"{PREFIX}_router_kv_index_{fieldname}"
+            lines.append(f"# TYPE {name} {ptype}")
+            for comp, router, v in samples:
+                lines.append(
+                    f'{name}{{component="{comp}",router="{router}"}} {v}'
+                )
+        return lines
 
     def _planner_doc(self) -> Optional[dict]:
         import time as _time
@@ -582,6 +707,9 @@ class MetricsService:
         planner = self._planner_doc()
         if planner is not None:
             doc["planner"] = planner
+        kv_index = self._kv_index_doc()
+        if kv_index is not None:
+            doc["kv_index"] = kv_index
         return doc, role_merged, role_stats
 
     def _fold_departed(self, snap: dict, contribs: dict) -> None:
@@ -598,6 +726,42 @@ class MetricsService:
         for iid in list(self._rate_state):
             if iid not in snap:
                 del self._rate_state[iid]
+        # a worker RETURNING after aging out: if its counters carried on
+        # from where the fold left them (>= the folded contribution in
+        # every present family), the gap was a transient publish outage,
+        # not a restart — un-fold the ghost so the monotonic fleet
+        # families don't count its history twice. A genuinely regressed
+        # family means a restart: the fold stays (the new counters are a
+        # fresh life).
+        for iid in list(self._ghost_contrib):
+            cur = contribs.get(iid)
+            if cur is None:
+                continue
+            role, ghost = self._ghost_contrib.pop(iid)
+            c = cur[1]
+            unfold = {
+                "preemptions": 0, "spec": None, "compiles": {}, "slo": None,
+            }
+            if ghost.get("preemptions") is not None and (
+                c.get("preemptions") or 0
+            ) >= ghost["preemptions"]:
+                unfold["preemptions"] = ghost["preemptions"]
+            if ghost.get("spec") is not None and all(
+                x >= p
+                for x, p in zip(c.get("spec") or (0, 0), ghost["spec"])
+            ):
+                unfold["spec"] = ghost["spec"]
+            if ghost.get("compiles") is not None and all(
+                (c.get("compiles") or {}).get(k, 0) >= v
+                for k, v in ghost["compiles"].items()
+            ):
+                unfold["compiles"] = ghost["compiles"]
+            if ghost.get("slo") is not None and all(
+                x >= p
+                for x, p in zip(c.get("slo") or (0, 0, 0, 0), ghost["slo"])
+            ):
+                unfold["slo"] = ghost["slo"]
+            self._unfold_retired(role, unfold)
         for iid, (role, prev) in list(self._live_contrib.items()):
             cur = contribs.get(iid)
             if cur is None:
@@ -605,6 +769,11 @@ class MetricsService:
                 # their old contribution until they truly age out
                 if iid not in snap:
                     self._fold_retired(role, prev)
+                    self._ghost_contrib[iid] = (role, prev)
+                    while len(self._ghost_contrib) > 1024:
+                        self._ghost_contrib.pop(
+                            next(iter(self._ghost_contrib))
+                        )
                     del self._live_contrib[iid]
                 continue
             c = cur[1]
@@ -650,6 +819,30 @@ class MetricsService:
             if any_folded:
                 self._fold_retired(role, folded)
         self._live_contrib.update(contribs)
+
+    def _unfold_retired(self, role: str, contrib: dict) -> None:
+        """Subtract a returned worker's folded contribution back out of
+        the per-role monotonic base (floored at 0: the base must never
+        make a fleet counter regress)."""
+        base = self._retired_counters.get(role)
+        if base is None:
+            return
+        base["preemptions"] = max(
+            0, base["preemptions"] - (contrib.get("preemptions") or 0)
+        )
+        base["spec"] = [
+            max(0, a - b)
+            for a, b in zip(
+                base.get("spec", [0, 0]), contrib.get("spec") or (0, 0)
+            )
+        ]
+        for k, v in (contrib.get("compiles") or {}).items():
+            if k in base["compiles"]:
+                base["compiles"][k] = max(0, base["compiles"][k] - v)
+        base["slo"] = [
+            max(0, a - b)
+            for a, b in zip(base["slo"], contrib.get("slo") or (0, 0, 0, 0))
+        ]
 
     def _fold_retired(self, role: str, contrib: dict) -> None:
         base = self._retired_counters.setdefault(
@@ -817,6 +1010,7 @@ class MetricsService:
         lines += self._fabric_lines()
         lines += self._fleet_lines(assembled)
         lines += self._planner_lines()
+        lines += self._kv_index_lines()
         # process-global speculation counters (in-process engines; the
         # per-worker fleet view is dynamo_tpu_worker_spec_* above) —
         # the same families FrontendMetrics exposes, both surfaces
@@ -826,6 +1020,10 @@ class MetricsService:
         # data-integrity rejections (disk-tier checksum misses, corrupt
         # transfer frames) — same both-surfaces contract as spec_lines
         lines += _debug.integrity_lines(PREFIX)
+        # process-global KV index health (zeros here — this process hosts
+        # no router; the per-component fleet view is
+        # dynamo_tpu_router_kv_index_* above) — both-surfaces contract
+        lines += _debug.kv_index_lines(PREFIX)
         # per-phase latency histograms (telemetry plane, process-global)
         from dynamo_tpu.telemetry import phases
 

@@ -59,6 +59,10 @@ def _configure(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.dyn_radix_apply.argtypes = [p, u32, ctypes.c_int, p, sz]
     lib.dyn_radix_remove_worker.restype = sz
     lib.dyn_radix_remove_worker.argtypes = [p, u32]
+    lib.dyn_radix_take_worker.restype = sz
+    lib.dyn_radix_take_worker.argtypes = [p, u32, p, sz]
+    lib.dyn_radix_digest.restype = sz
+    lib.dyn_radix_digest.argtypes = [p, u32, u64, p]
     lib.dyn_radix_clear.argtypes = [p]
     lib.dyn_radix_find.restype = sz
     lib.dyn_radix_find.argtypes = [p, p, sz, p, p, sz, p]

@@ -26,6 +26,10 @@ class BusMessage:
     subject: str
     header: Any
     payload: bytes
+    #: broker publish sequence for ring-retained subjects (JetStream-style
+    #: replay cursor — see local.py's per-subject replay ring); 0 for
+    #: subjects outside the ring set (no resume semantics)
+    seq: int = 0
 
 
 class Subscription:
@@ -33,6 +37,17 @@ class Subscription:
         self.subject = subject
         self.queue: asyncio.Queue[Optional[BusMessage]] = asyncio.Queue()
         self._closed = False
+        #: replay-resume cursor: the highest broker seq delivered (or the
+        #: broker's seq at subscribe time) — RemoteFabric re-subscribes
+        #: from here after a reconnect instead of losing the gap
+        self.last_seq = 0
+        #: broker epoch the cursor belongs to (a broker restart without
+        #: persistence invalidates cursors; the WAL preserves the epoch)
+        self.epoch: Optional[str] = None
+        #: True when the last resume could NOT be made lossless (the ring
+        #: trimmed past the cursor, or the broker epoch changed without a
+        #: WAL) — consumers with their own sequencing resync off this
+        self.resume_gap = False
 
     def _push(self, msg: Optional[BusMessage]) -> None:
         if not self._closed:

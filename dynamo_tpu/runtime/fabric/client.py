@@ -147,9 +147,59 @@ class RemoteFabric:
             )
         for sub_id, s in list(self._subs.items()):
             if not s._closed:
-                await self._call(
-                    {"op": "bus.sub", "subject": s.subject, "sub_id": sub_id}
-                )
+                # resume from the last-seen broker seq: the server replays
+                # the ring-retained gap, so a subscriber that rode out an
+                # outage observes every retained message exactly once.
+                # DISARM the duplicate guard BEFORE the call: replayed
+                # pushes can be processed from the same read batch as the
+                # reply (the read loop does not yield to this coroutine
+                # between frames), and under a changed broker epoch their
+                # fresh low seqs would be swallowed by the stale cursor.
+                # No duplicate can arrive while disarmed — a same-epoch
+                # server replays strictly past `cursor`, a new-epoch
+                # server's ring is entirely unseen — and the first push
+                # re-arms it.
+                cursor, epoch = s.last_seq, s.epoch
+                s.last_seq = 0
+                try:
+                    h, _ = await self._call(
+                        {
+                            "op": "bus.sub", "subject": s.subject,
+                            "sub_id": sub_id, "resume": cursor,
+                            "epoch": epoch,
+                        }
+                    )
+                except BaseException:
+                    # the link dropped again mid-reestablish: put the
+                    # cursor back so the NEXT attempt doesn't resume
+                    # from 0 and replay the whole ring as duplicates
+                    s.last_seq = max(s.last_seq, cursor)
+                    raise
+                if h.get("epoch") == epoch:
+                    # same epoch: the cursor stays meaningful — restore
+                    # it (max: replayed pushes may already have advanced
+                    # past it) so a quiet subject doesn't leave the NEXT
+                    # resume at 0, which would replay the whole ring
+                    s.last_seq = max(s.last_seq, cursor)
+                s.epoch = h.get("epoch")
+                if h.get("gap"):
+                    s.resume_gap = True
+                    logger.warning(
+                        "subscription %s resumed with a replay gap "
+                        "(ring trimmed or broker epoch changed)",
+                        s.subject,
+                    )
+
+    @staticmethod
+    def _apply_sub_reply(s: Subscription, h: Any) -> None:
+        """Fold a fresh bus.sub reply into the subscription's resume
+        cursor: baseline = the broker's seq at registration. max() with
+        the live cursor — pushes from the same read batch as the reply
+        may already have advanced it, and regressing would be harmless
+        but confusing. (Resume replies are handled in _reestablish,
+        which disarms the guard first.)"""
+        s.last_seq = max(s.last_seq, int(h.get("seq") or 0))
+        s.epoch = h.get("epoch")
 
     def _handle_push(self, h: Any, payload: bytes) -> None:
         if h["push"] == "watch":
@@ -163,7 +213,16 @@ class RemoteFabric:
         elif h["push"] == "msg":
             s = self._subs.get(h["sub_id"])
             if s is not None:
-                s._push(BusMessage(h["subject"], h.get("header"), payload))
+                seq = int(h.get("seq") or 0)
+                if seq:
+                    # at-least-once transport + this guard = exactly-once
+                    # delivery per subscription for ring-retained
+                    # subjects (a resume replay can overlap messages that
+                    # raced out just before the drop)
+                    if seq <= s.last_seq:
+                        return
+                    s.last_seq = seq
+                s._push(BusMessage(h["subject"], h.get("header"), payload, seq))
 
     async def _call(self, header: dict, payload: bytes = b"") -> tuple[Any, bytes]:
         # fault-injection hook (dynamo_tpu/testing/faults.py): a no-op
@@ -338,7 +397,10 @@ class RemoteFabric:
         sub_id = next(self._ids)
         s = Subscription(subject)
         self._subs[sub_id] = s
-        await self._call({"op": "bus.sub", "subject": subject, "sub_id": sub_id})
+        h, _ = await self._call(
+            {"op": "bus.sub", "subject": subject, "sub_id": sub_id}
+        )
+        self._apply_sub_reply(s, h)
 
         orig_close = s.close
 

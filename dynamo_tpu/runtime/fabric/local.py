@@ -7,6 +7,7 @@ zero external infrastructure.
 from __future__ import annotations
 
 import asyncio
+import os
 import uuid
 from collections import deque
 from dataclasses import replace
@@ -19,6 +20,25 @@ from dynamo_tpu.runtime.fabric.base import (
     subject_matches,
 )
 from dynamo_tpu.runtime.store import MemStore, Watch
+
+#: bounded per-subject replay ring (JetStream-style): messages published
+#: to retained subjects are kept so a subscriber can RESUME from its
+#: last-seen broker sequence after a reconnect instead of losing the gap.
+#: 0 disables the ring entirely (publish/subscribe revert to pure
+#: fire-and-forget — the pre-ring wire, bit-identical).
+RING_SIZE = int(os.environ.get("DYNTPU_FABRIC_RING", "512"))
+
+#: subject prefixes the ring retains. KV events are the load-bearing
+#: default: the router's prefix index silently diverges on any lost
+#: event, which is exactly what resume repairs. Metrics/planner frames
+#: are latest-wins and deliberately NOT ringed.
+RING_SUBJECTS = tuple(
+    p
+    for p in os.environ.get(
+        "DYNTPU_FABRIC_RING_SUBJECTS", "kv_events."
+    ).split(",")
+    if p
+)
 
 
 class _LocalQueue:
@@ -41,7 +61,11 @@ class _LocalQueue:
 
 
 class LocalFabric:
-    def __init__(self):
+    def __init__(
+        self,
+        ring_size: Optional[int] = None,
+        ring_subjects: Optional[tuple] = None,
+    ):
         self.store = MemStore()
         self._subs: list[Subscription] = []
         self._queues: dict[str, _LocalQueue] = {}
@@ -49,6 +73,27 @@ class LocalFabric:
         #: items put back after a consumer died/nacked (at-least-once
         #: delivery in action — the broker self-observability plane)
         self.redeliveries_total = 0
+        #: broker epoch: a resume cursor is only meaningful against the
+        #: epoch it was minted under. PersistentFabric restores it from
+        #: the WAL so cursors survive server restarts.
+        self.epoch = uuid.uuid4().hex
+        #: global publish sequence — advances ONLY for ring-retained
+        #: subjects, so the WAL can restore it exactly (every ringed
+        #: publish is journaled; unringed traffic never moves it)
+        self.pub_seq = 0
+        self.ring_size = RING_SIZE if ring_size is None else ring_size
+        self.ring_subjects = (
+            RING_SUBJECTS if ring_subjects is None else tuple(ring_subjects)
+        )
+        #: subject -> deque[BusMessage] (bounded), and the highest seq
+        #: each subject's ring has TRIMMED (resume below it = gap)
+        self._rings: dict[str, deque[BusMessage]] = {}
+        self._ring_trimmed: dict[str, int] = {}
+
+    def _ringed(self, subject: str) -> bool:
+        return self.ring_size > 0 and any(
+            subject.startswith(p) for p in self.ring_subjects
+        )
 
     def stats(self) -> dict:
         """Broker-side self-metrics (consumed by the fabric server's
@@ -58,6 +103,9 @@ class LocalFabric:
             "active_leases": len(getattr(self.store, "_leases", ())),
             "objects": len(self._objects),
             "redeliveries_total": self.redeliveries_total,
+            "ring_subjects": len(self._rings),
+            "ring_entries": sum(len(r) for r in self._rings.values()),
+            "pub_seq": self.pub_seq,
             # NOT *_total: these are level gauges (they go down), and the
             # exposition layer types *_total keys as Prometheus counters
             "queued_items": sum(
@@ -105,15 +153,53 @@ class LocalFabric:
 
     # -- pub/sub -----------------------------------------------------------
 
+    def _ring_append(self, msg: BusMessage) -> None:
+        ring = self._rings.get(msg.subject)
+        if ring is None:
+            ring = self._rings[msg.subject] = deque()
+        ring.append(msg)
+        while len(ring) > self.ring_size:
+            dropped = ring.popleft()
+            self._ring_trimmed[msg.subject] = dropped.seq
+
     async def publish(self, subject, header, payload=b""):
-        msg = BusMessage(subject, header, payload)
+        seq = 0
+        if self._ringed(subject):
+            self.pub_seq += 1
+            seq = self.pub_seq
+        msg = BusMessage(subject, header, payload, seq)
+        if seq:
+            self._ring_append(msg)
         for sub in self._subs:
             if subject_matches(sub.subject, subject):
                 sub._push(msg)
 
-    async def subscribe(self, subject) -> Subscription:
+    async def subscribe(
+        self, subject, from_seq: Optional[int] = None
+    ) -> Subscription:
+        """Subscribe; with `from_seq`, first replay every retained
+        message with seq > from_seq whose subject matches (merged across
+        subjects in publish order). The registration and the replay are
+        one synchronous block, so a concurrent publish can neither be
+        missed nor delivered twice. Sets `sub.resume_gap` when some ring
+        trimmed past the cursor (messages were lost for good)."""
         sub = Subscription(subject)
+        sub.epoch = self.epoch
+        sub.last_seq = self.pub_seq
         self._subs.append(sub)
+        if from_seq is not None:
+            replay: list[BusMessage] = []
+            gap = False
+            for subj, ring in self._rings.items():
+                if not subject_matches(subject, subj):
+                    continue
+                if self._ring_trimmed.get(subj, 0) > from_seq:
+                    gap = True
+                replay.extend(m for m in ring if m.seq > from_seq)
+            replay.sort(key=lambda m: m.seq)
+            for m in replay:
+                sub._push(m)
+            sub.resume_gap = gap
         return sub
 
     # -- queues ------------------------------------------------------------

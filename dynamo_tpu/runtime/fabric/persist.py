@@ -83,7 +83,21 @@ class PersistentFabric(LocalFabric):
         for h, p in records:
             op = h["r"]
             try:
-                if op == "lease":
+                if op == "pubmark":
+                    # replay-ring continuity: the broker epoch + publish
+                    # seq survive the restart, so subscriber resume
+                    # cursors stay valid (client.py _apply_sub_reply)
+                    self.epoch = h["epoch"]
+                    self.pub_seq = max(self.pub_seq, int(h.get("seq") or 0))
+                elif op == "pub":
+                    from dynamo_tpu.runtime.fabric.base import BusMessage
+
+                    seq = int(h.get("seq") or 0)
+                    self._ring_append(
+                        BusMessage(h["subject"], h.get("header"), p, seq)
+                    )
+                    self.pub_seq = max(self.pub_seq, seq)
+                elif op == "lease":
                     # restore the id verbatim; deadline set below
                     self.store._leases[h["lease"]] = 0.0
                     self.store._lease_ttl[h["lease"]] = h["ttl"]
@@ -129,6 +143,23 @@ class PersistentFabric(LocalFabric):
         """Rewrite the WAL as current state (snapshot-as-WAL)."""
         tmp = self._path + ".tmp"
         with open(tmp, "wb") as f:
+            f.write(
+                encode_frame(
+                    {"r": "pubmark", "epoch": self.epoch, "seq": self.pub_seq}
+                )
+            )
+            ring_msgs = sorted(
+                (m for ring in self._rings.values() for m in ring),
+                key=lambda m: m.seq,
+            )
+            for m in ring_msgs:
+                f.write(
+                    encode_frame(
+                        {"r": "pub", "subject": m.subject,
+                         "header": m.header, "seq": m.seq},
+                        m.payload,
+                    )
+                )
             for lease_id, ttl in self.store._lease_ttl.items():
                 f.write(encode_frame({"r": "lease", "lease": lease_id, "ttl": ttl}))
             for key, e in self.store._data.items():
@@ -162,6 +193,20 @@ class PersistentFabric(LocalFabric):
             await self._compact()
 
     # -- journaled mutations ----------------------------------------------
+
+    async def publish(self, subject, header, payload=b""):
+        before = self.pub_seq
+        await super().publish(subject, header, payload)
+        if self.pub_seq != before:
+            # ring-retained subject: journal it so the replay ring (and
+            # the seq watermark) survive a server restart — the WAL's
+            # JetStream-shaped corner
+            self._append(
+                {"r": "pub", "subject": subject, "header": header,
+                 "seq": self.pub_seq},
+                payload,
+            )
+            await self._maybe_compact()
 
     async def put(self, key, value, lease_id=None):
         await super().put(key, value, lease_id)

@@ -203,13 +203,46 @@ class FabricServer:
                 if rid is not None:
                     await conn.send({"id": rid, "ok": True})
             elif op == "bus.sub":
-                sub = await f.subscribe(h["subject"])
+                # resume semantics (replay ring, local.py): a reconnecting
+                # subscriber passes its last-seen seq + the epoch it was
+                # minted under; an epoch mismatch (broker restarted
+                # without its WAL) invalidates the cursor — replay the
+                # whole ring (the client has seen none of THIS epoch) and
+                # flag the gap so sequencing consumers resync.
+                resume = h.get("resume")
+                from_seq = None
+                epoch_gap = False
+                if resume is not None:
+                    if h.get("epoch") == getattr(f, "epoch", None):
+                        from_seq = int(resume)
+                    else:
+                        from_seq = 0
+                        epoch_gap = True
+                # baseline read BEFORE registration (no await between —
+                # LocalFabric.subscribe never yields): a publish racing
+                # this dispatch either lands pre-registration (seq <=
+                # baseline, not queued for us) or post (seq > baseline,
+                # queued and passes the client's duplicate guard)
+                base_seq = getattr(f, "pub_seq", 0)
+                sub = await f.subscribe(h["subject"], from_seq=from_seq)
                 sub_id = h["sub_id"]
+                # reply BEFORE the pump starts: the client must learn the
+                # epoch/seq baseline before any replayed push arrives, or
+                # its duplicate guard could drop legitimate replays
+                await conn.send(
+                    {
+                        "id": rid, "ok": True,
+                        "seq": base_seq,
+                        "epoch": getattr(f, "epoch", ""),
+                        "gap": bool(
+                            epoch_gap or getattr(sub, "resume_gap", False)
+                        ),
+                    }
+                )
                 task = asyncio.get_running_loop().create_task(
                     self._pump_sub(conn, sub_id, sub)
                 )
                 conn.subs[sub_id] = (sub, task)
-                await conn.send({"id": rid, "ok": True})
             elif op == "bus.unsub":
                 entry = conn.subs.pop(h["sub_id"], None)
                 if entry:
@@ -290,7 +323,7 @@ class FabricServer:
             await conn.send(
                 {
                     "push": "msg", "sub_id": sub_id, "subject": msg.subject,
-                    "header": msg.header,
+                    "header": msg.header, "seq": msg.seq,
                 },
                 msg.payload,
             )

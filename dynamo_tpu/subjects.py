@@ -23,6 +23,13 @@ KV_HIT_RATE_SUBJECT = "kv-hit-rate"
 #: pages on receipt — reaches fleet members the frontend has no route to
 FLUSH_SUBJECT = "admin.flush"
 
+#: KV index health frames (KvRouter publishes its indexer's consistency
+#: stats — gaps detected, resyncs run, drift blocks corrected, stale
+#: workers): the metrics service folds these into
+#: dynamo_tpu_router_kv_index_*{component,router} and the `kv_index`
+#: section of /v1/fleet (doctor's kv-index-drift rule)
+KV_INDEX_SUBJECT = "kv_index.status"
+
 #: closed-loop planner status frames (ControlRunner.status): targets vs
 #: observed pool sizes, SLO signals, decision counters, recent-decision
 #: ring — the metrics service folds these into dynamo_tpu_planner_* and

@@ -119,6 +119,37 @@ def integrity_lines(prefix: str = "dynamo_tpu") -> list[str]:
     ]
 
 
+def kv_index_lines(prefix: str = "dynamo_tpu") -> list[str]:
+    """Process-global KV index health (kv_router/indexer.py counters):
+    sequence gaps detected, targeted resyncs run (and failed), drift
+    blocks corrected, and the live stale-subtree gauge. Included by BOTH
+    Prometheus surfaces — the process hosting a KV-aware router (the
+    frontend in single-process serving) is where the index lives; the
+    metrics service additionally folds router-published kv_index.status
+    frames for multi-process fleets. Always emitted (zeros) so the
+    dashboard panel-vs-emitted gate sees the families."""
+    from dynamo_tpu.kv_router.indexer import (
+        index_counters,
+        process_stale_workers,
+    )
+
+    c = index_counters
+    return [
+        f"# TYPE {prefix}_kv_index_gaps_total counter",
+        f"{prefix}_kv_index_gaps_total {c.gaps}",
+        f"# TYPE {prefix}_kv_index_resyncs_total counter",
+        f"{prefix}_kv_index_resyncs_total {c.resyncs}",
+        f"# TYPE {prefix}_kv_index_resync_failures_total counter",
+        f"{prefix}_kv_index_resync_failures_total {c.resync_failures}",
+        f"# TYPE {prefix}_kv_index_drift_blocks_total counter",
+        f"{prefix}_kv_index_drift_blocks_total {c.drift_blocks}",
+        f"# TYPE {prefix}_kv_index_digest_mismatches_total counter",
+        f"{prefix}_kv_index_digest_mismatches_total {c.digest_mismatches}",
+        f"# TYPE {prefix}_kv_index_stale_workers gauge",
+        f"{prefix}_kv_index_stale_workers {process_stale_workers()}",
+    ]
+
+
 # -- payloads -------------------------------------------------------------
 
 

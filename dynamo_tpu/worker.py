@@ -64,6 +64,7 @@ class Worker:
         mock_args=None,
         engine=None,
         drain_budget_s: float = 30.0,
+        kv_sequencing: bool = True,
     ):
         self.runtime = runtime
         self.card = card
@@ -118,6 +119,19 @@ class Worker:
             )
         self.external = engine
         self._kv_event_buffer: list[KvEvent] = []
+        #: KV event sequencing + rolling block-set digest (docs/
+        #: operations.md "KV index consistency"): every published event
+        #: carries a per-worker monotonic `seq`, and the metrics frames
+        #: carry (seq, xxh3-fold, count) of the registered block set —
+        #: indexers detect lost events (sequence gaps) and silent drift
+        #: (digest mismatch) and resync from the `kv.snapshot` ingress
+        #: op. Off = the exact pre-sequencing wire (no seq keys, no
+        #: digest frame, no snapshot state), pinned by tests.
+        self.kv_sequencing = kv_sequencing
+        self._kv_seq = 0
+        from dynamo_tpu.kv_router.digest import SetDigest
+
+        self._kv_digest = SetDigest()
         self._tasks: list[asyncio.Task] = []
         #: graceful drain (docs/operations.md "Overload & draining"):
         #: SIGTERM or the `drain` ingress op flips this — the worker
@@ -232,6 +246,7 @@ class Worker:
         self.ingress.add_handler("generate", self._generate)
         self.ingress.add_handler("embed", self._embed)
         self.ingress.add_handler("flush", self._flush)
+        self.ingress.add_handler("kv.snapshot", self._kv_snapshot_handler)
         self.ingress.add_handler("drain", self._drain_handler)
         self.ingress.add_handler("flip", self._flip_handler)
         self.ingress.add_handler("handover", self._handover_handler)
@@ -690,20 +705,19 @@ class Worker:
         # bulk ownership move: indexers reassign this worker's block
         # entries to the successor NOW instead of waiting for lease
         # expiry + stored-event propagation (kv_router/indexer.py
-        # `handed_over`)
-        import msgpack as _msgpack
-
-        await self.runtime.fabric.publish(
-            f"{KV_EVENT_SUBJECT}.{self.instance_id}",
-            {"instance_id": self.instance_id, "count": 1},
-            _msgpack.packb(
-                [{
-                    "kind": "handed_over",
-                    "block_hashes": [],
-                    "successor": succ.instance_id,
-                }],
-                use_bin_type=True,
-            ),
+        # `handed_over`). Rides the SAME stamped path as store/remove
+        # events — with any still-buffered events flushed ahead of it in
+        # the batch — so the move keeps its place in the sequence stream
+        # and this worker's advertised digest empties with it.
+        pending = self._kv_event_buffer[: len(self._kv_event_buffer)]
+        del self._kv_event_buffer[: len(pending)]
+        await self._publish_kv_events(
+            [self._kv_event_wire(e) for e in pending]
+            + [{
+                "kind": "handed_over",
+                "block_hashes": [],
+                "successor": succ.instance_id,
+            }]
         )
         return True
 
@@ -1271,6 +1285,80 @@ class Worker:
             n = self.mock.allocator.clear_cache()
         yield {"cleared_pages": n}
 
+    # -- KV event sequencing + snapshot (docs/operations.md "KV index
+    # consistency"): the worker side of the convergent index protocol ---
+
+    @staticmethod
+    def _kv_event_wire(e: KvEvent) -> dict:
+        return {
+            "kind": e.kind,
+            "block_hashes": list(e.block_hashes),
+            "parent_hash": e.parent_hash,
+            "token_blocks": [list(t) for t in e.token_blocks],
+        }
+
+    def _stamp_kv_events(self, wire_events: list[dict]) -> None:
+        """Stamp each outgoing event with the next per-worker sequence
+        number and fold it into the rolling digest. Runs ONLY on the
+        event-loop publish path, so seq/digest state is loop-confined
+        and the advertised digest is exactly the set as-of the last
+        stamped seq."""
+        dg = self._kv_digest
+        for ev in wire_events:
+            self._kv_seq += 1
+            ev["seq"] = self._kv_seq
+            kind = ev.get("kind")
+            if kind == "stored":
+                parent = ev.get("parent_hash")
+                for h in ev.get("block_hashes", ()):
+                    dg.store(h, parent)
+            elif kind == "removed":
+                for h in ev.get("block_hashes", ()):
+                    dg.remove(h)
+            elif kind == "handed_over":
+                # ownership moved wholesale to the successor: this
+                # worker's advertised set empties, matching the index's
+                # post-move view of it
+                dg.clear()
+
+    async def _publish_kv_events(self, wire_events: list[dict]) -> None:
+        """Stamp (when sequencing) and publish one event batch. A failed
+        publish DROPS the batch — the stamped seqs are burned, so the
+        indexer sees a sequence gap and repairs by resync; re-sending
+        later would reorder the stream, which is worse than honest
+        loss."""
+        if self.kv_sequencing:
+            self._stamp_kv_events(wire_events)
+        try:
+            await self.runtime.fabric.publish(
+                f"{KV_EVENT_SUBJECT}.{self.instance_id}",
+                {"instance_id": self.instance_id, "count": len(wire_events)},
+                msgpack.packb(wire_events, use_bin_type=True),
+            )
+        except Exception:
+            logger.warning(
+                "KV event publish failed; %d event(s) dropped (indexers "
+                "detect the sequence gap and resync)", len(wire_events),
+                exc_info=True,
+            )
+
+    async def _kv_snapshot_handler(self, ctx, request):
+        """`kv.snapshot` ingress op: the full registered hash forest +
+        the digest, as of the last PUBLISHED event — indexers use it for
+        cold-start bootstrap and targeted resync (events with seq >
+        this snapshot's seq apply cleanly on top)."""
+        if not self.kv_sequencing:
+            yield {"sequencing": False}
+            return
+        dg = self._kv_digest
+        yield {
+            "sequencing": True,
+            "seq": self._kv_seq,
+            "fold": dg.fold,
+            "count": dg.count,
+            "blocks": [[h, p] for h, p in dg.blocks.items()],
+        }
+
     # -- publishers --------------------------------------------------------
 
     async def _publish_loop(self) -> None:
@@ -1280,161 +1368,168 @@ class Worker:
         fabric = self.runtime.fabric
         while True:
             await asyncio.sleep(self.metrics_interval)
-            # Drain WITHOUT rebinding: the engine thread appends through a
-            # late-binding callback, but any captured reference must stay
-            # valid — rebinding here once silently severed the event plane
-            # (appends landed in the dead list forever after).
-            events = self._kv_event_buffer[: len(self._kv_event_buffer)]
-            del self._kv_event_buffer[: len(events)]
-            if events:
-                payload = msgpack.packb(
-                    [
-                        {
-                            "kind": e.kind,
-                            "block_hashes": list(e.block_hashes),
-                            "parent_hash": e.parent_hash,
-                            "token_blocks": [list(t) for t in e.token_blocks],
-                        }
-                        for e in events
-                    ],
-                    use_bin_type=True,
+            try:
+                await self._publish_once(fabric)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                # a fabric outage (or any publish failure) must not kill
+                # the loop: frames resume when the fabric does, and any
+                # KV events lost in between surface as sequence gaps the
+                # indexer repairs by resync
+                logger.warning("publish tick failed", exc_info=True)
+
+    async def _publish_once(self, fabric) -> None:
+        # Drain WITHOUT rebinding: the engine thread appends through a
+        # late-binding callback, but any captured reference must stay
+        # valid — rebinding here once silently severed the event plane
+        # (appends landed in the dead list forever after).
+        events = self._kv_event_buffer[: len(self._kv_event_buffer)]
+        del self._kv_event_buffer[: len(events)]
+        if events:
+            await self._publish_kv_events(
+                [self._kv_event_wire(e) for e in events]
+            )
+        tiered = self._tier_event_buffer[: len(self._tier_event_buffer)]
+        del self._tier_event_buffer[: len(tiered)]
+        if tiered:
+            payload = msgpack.packb(
+                [
+                    {
+                        "kind": "stored",
+                        "block_hashes": [h],
+                        "parent_hash": p,
+                    }
+                    for h, p in tiered
+                ],
+                use_bin_type=True,
+            )
+            await fabric.publish(
+                f"{KVBM_TIER_SUBJECT}.{self.instance_id}",
+                {"instance_id": self.instance_id, "count": len(tiered)},
+                payload,
+            )
+        m = None
+        if self.runner is not None:
+            m = self.runner.metrics.to_dict()
+        elif self.external is not None and hasattr(
+            self.external, "metrics_dict"
+        ):
+            m = dict(self.external.metrics_dict())
+        elif self.mock is not None:
+            alloc = self.mock.allocator
+            m = {
+                "num_waiting": self.mock.num_waiting,
+                "num_running": self.mock.num_running,
+                "kv_active_pages": alloc.num_active,
+                "kv_total_pages": alloc.num_pages - 1,
+                "kv_usage": alloc.usage(),
+                "prefix_hit_rate": alloc.stats.hit_rate,
+                "requests_received": self.mock.requests_received,
+                "generated_tokens": self.mock.generated_tokens,
+                "preemptions": self.mock.preemptions,
+            }
+            try:
+                # mock fleets ride the real SLO plane (fleet sim)
+                m["slo"] = self.mock.slo.to_wire()
+            except Exception:
+                logger.warning(
+                    "mock SLO frame failed", exc_info=True
                 )
-                await fabric.publish(
-                    f"{KV_EVENT_SUBJECT}.{self.instance_id}",
-                    {"instance_id": self.instance_id, "count": len(events)},
-                    payload,
+        if m is not None:
+            # fleet telemetry plane (docs/observability.md "Fleet
+            # view & SLO accounting"): role for the per-role fleet
+            # rollup, SLO sketches + per-kind compile counters when
+            # the engine carries them. Defensive: a telemetry
+            # serialization bug must not sever the load-metrics
+            # plane routers/planner depend on.
+            # a flipped worker reports (and routes its frames) under
+            # its LIVE role so /v1/fleet and the planner see the
+            # pool move the moment the flip lands
+            if self.role == "prefill":
+                # a worker CONFIGURED as prefill keeps its own
+                # component subject; only a flipped decode worker
+                # moves its frames into the default prefill space
+                pub_component = (
+                    self.component
+                    if "prefill" in self.component
+                    else "prefill"
                 )
-            tiered = self._tier_event_buffer[: len(self._tier_event_buffer)]
-            del self._tier_event_buffer[: len(tiered)]
-            if tiered:
-                payload = msgpack.packb(
-                    [
-                        {
-                            "kind": "stored",
-                            "block_hashes": [h],
-                            "parent_hash": p,
-                        }
-                        for h, p in tiered
-                    ],
-                    use_bin_type=True,
-                )
-                await fabric.publish(
-                    f"{KVBM_TIER_SUBJECT}.{self.instance_id}",
-                    {"instance_id": self.instance_id, "count": len(tiered)},
-                    payload,
-                )
-            m = None
-            if self.runner is not None:
-                m = self.runner.metrics.to_dict()
-            elif self.external is not None and hasattr(
-                self.external, "metrics_dict"
-            ):
-                m = dict(self.external.metrics_dict())
-            elif self.mock is not None:
-                alloc = self.mock.allocator
-                m = {
-                    "num_waiting": self.mock.num_waiting,
-                    "num_running": self.mock.num_running,
-                    "kv_active_pages": alloc.num_active,
-                    "kv_total_pages": alloc.num_pages - 1,
-                    "kv_usage": alloc.usage(),
-                    "prefix_hit_rate": alloc.stats.hit_rate,
-                    "requests_received": self.mock.requests_received,
-                    "generated_tokens": self.mock.generated_tokens,
-                    "preemptions": self.mock.preemptions,
-                }
+            else:
+                pub_component = self.decode_component
+            m["component"] = pub_component
+            m["role"] = self.role
+            m["flips_total"] = self.flips
+            # drain visibility: /v1/fleet shows state=draining while
+            # the worker winds down (doctor's draining-worker rule
+            # keys off this instead of tripping dead/stalled rules);
+            # state=handover while a live KV migration runs (doctor's
+            # handover-stuck rule watches its age + phase)
+            m["state"] = (
+                "handover"
+                if self.handing_over
+                else "draining" if self.draining else "serving"
+            )
+            if self._handover_phase is not None:
+                m["handover_phase"] = self._handover_phase
+            m["handovers_total"] = self.handovers
+            m["handover_fallbacks_total"] = self.handover_fallbacks
+            m["handover_bytes_total"] = self.handover_bytes
+            m["handover_blocks_total"] = self.handover_blocks
+            m["handovers_adopted_total"] = self.handovers_adopted
+            eng = getattr(self.runner, "engine", None)
+            if eng is not None and getattr(eng, "slo", None) is not None:
                 try:
-                    # mock fleets ride the real SLO plane (fleet sim)
-                    m["slo"] = self.mock.slo.to_wire()
+                    m["slo"] = eng.slo.to_wire()
+                    m["compiles_by_kind"] = dict(eng.compiles_by_kind)
                 except Exception:
                     logger.warning(
-                        "mock SLO frame failed", exc_info=True
+                        "fleet telemetry frame failed", exc_info=True
                     )
-            if m is not None:
-                # fleet telemetry plane (docs/observability.md "Fleet
-                # view & SLO accounting"): role for the per-role fleet
-                # rollup, SLO sketches + per-kind compile counters when
-                # the engine carries them. Defensive: a telemetry
-                # serialization bug must not sever the load-metrics
-                # plane routers/planner depend on.
-                # a flipped worker reports (and routes its frames) under
-                # its LIVE role so /v1/fleet and the planner see the
-                # pool move the moment the flip lands
-                if self.role == "prefill":
-                    # a worker CONFIGURED as prefill keeps its own
-                    # component subject; only a flipped decode worker
-                    # moves its frames into the default prefill space
-                    pub_component = (
-                        self.component
-                        if "prefill" in self.component
-                        else "prefill"
+            # debug plane (docs/observability.md "Debugging a slow
+            # or stuck worker"): the flight-recorder window + the
+            # per-kind program cost rollup ride the frame so the
+            # metrics service can serve GET /v1/debug/{flight,
+            # programs} for the whole fleet; same defensive wrap.
+            if eng is not None:
+                try:
+                    fl = getattr(eng, "flight", None)
+                    if fl is not None:
+                        m["flight"] = fl.to_wire()
+                    if getattr(eng, "programs", None):
+                        m["programs_by_kind"] = eng.programs_wire()
+                except Exception:
+                    logger.warning(
+                        "debug-plane frame failed", exc_info=True
                     )
-                else:
-                    pub_component = self.decode_component
-                m["component"] = pub_component
-                m["role"] = self.role
-                m["flips_total"] = self.flips
-                # drain visibility: /v1/fleet shows state=draining while
-                # the worker winds down (doctor's draining-worker rule
-                # keys off this instead of tripping dead/stalled rules);
-                # state=handover while a live KV migration runs (doctor's
-                # handover-stuck rule watches its age + phase)
-                m["state"] = (
-                    "handover"
-                    if self.handing_over
-                    else "draining" if self.draining else "serving"
+            wd = getattr(self.runner, "watchdog", None)
+            if wd is not None:
+                m["stalls_by_cause"] = wd.counters.snapshot()
+                m["stalls_total"] = wd.counters.total
+            if self.transfer_server is not None:
+                # which KV plane transfers actually rode (device /
+                # shm / bulk / inline host) — the ops signal for a
+                # misconfigured fast path silently falling back
+                for plane, n in self.transfer_server.transfers.items():
+                    m[f"kv_transfer_{plane}_total"] = n
+                m["remote_prefills_total"] = self.remote_prefills
+                # frames the codec's checksum rejected (wire bit-rot
+                # / chaos corrupt rules): corrupt pages never land
+                m["kv_transfer_corrupt_total"] = (
+                    self.transfer_server.corrupt_rejects
                 )
-                if self._handover_phase is not None:
-                    m["handover_phase"] = self._handover_phase
-                m["handovers_total"] = self.handovers
-                m["handover_fallbacks_total"] = self.handover_fallbacks
-                m["handover_bytes_total"] = self.handover_bytes
-                m["handover_blocks_total"] = self.handover_blocks
-                m["handovers_adopted_total"] = self.handovers_adopted
-                eng = getattr(self.runner, "engine", None)
-                if eng is not None and getattr(eng, "slo", None) is not None:
-                    try:
-                        m["slo"] = eng.slo.to_wire()
-                        m["compiles_by_kind"] = dict(eng.compiles_by_kind)
-                    except Exception:
-                        logger.warning(
-                            "fleet telemetry frame failed", exc_info=True
-                        )
-                # debug plane (docs/observability.md "Debugging a slow
-                # or stuck worker"): the flight-recorder window + the
-                # per-kind program cost rollup ride the frame so the
-                # metrics service can serve GET /v1/debug/{flight,
-                # programs} for the whole fleet; same defensive wrap.
-                if eng is not None:
-                    try:
-                        fl = getattr(eng, "flight", None)
-                        if fl is not None:
-                            m["flight"] = fl.to_wire()
-                        if getattr(eng, "programs", None):
-                            m["programs_by_kind"] = eng.programs_wire()
-                    except Exception:
-                        logger.warning(
-                            "debug-plane frame failed", exc_info=True
-                        )
-                wd = getattr(self.runner, "watchdog", None)
-                if wd is not None:
-                    m["stalls_by_cause"] = wd.counters.snapshot()
-                    m["stalls_total"] = wd.counters.total
-                if self.transfer_server is not None:
-                    # which KV plane transfers actually rode (device /
-                    # shm / bulk / inline host) — the ops signal for a
-                    # misconfigured fast path silently falling back
-                    for plane, n in self.transfer_server.transfers.items():
-                        m[f"kv_transfer_{plane}_total"] = n
-                    m["remote_prefills_total"] = self.remote_prefills
-                    # frames the codec's checksum rejected (wire bit-rot
-                    # / chaos corrupt rules): corrupt pages never land
-                    m["kv_transfer_corrupt_total"] = (
-                        self.transfer_server.corrupt_rejects
-                    )
-                m["instance_id"] = self.instance_id
-                m["model"] = self.card.name
-                await fabric.publish(
-                    f"{METRICS_SUBJECT}.{pub_component}.{self.instance_id}",
-                    m,
-                )
+            if self.kv_sequencing:
+                # rolling block-set digest as of the last published KV
+                # event: indexers run their anti-entropy sweep against
+                # this (docs/operations.md "KV index consistency")
+                m["kv_digest"] = {
+                    "seq": self._kv_seq,
+                    "fold": self._kv_digest.fold,
+                    "count": self._kv_digest.count,
+                }
+            m["instance_id"] = self.instance_id
+            m["model"] = self.card.name
+            await fabric.publish(
+                f"{METRICS_SUBJECT}.{pub_component}.{self.instance_id}",
+                m,
+            )

@@ -175,6 +175,48 @@ size_t dyn_radix_find(void* p, const uint64_t* hashes, size_t n, uint32_t* out_i
     return k;
 }
 
+// Enumerate-and-remove a worker's whole hash set (the bulk-ownership
+// move / resync subtree-replace primitive — indexer.py take_worker).
+// Writes up to `cap` hashes to `out`; returns how many the worker held
+// (callers size `out` via dyn_radix_blocks_for first; a short buffer
+// still removes everything but truncates the enumeration).
+size_t dyn_radix_take_worker(void* p, uint32_t worker_id, uint64_t* out,
+                             size_t cap) {
+    RadixIndex* r = (RadixIndex*)p;
+    auto mit = r->hashes_by_worker.find(worker_id);
+    if (mit == r->hashes_by_worker.end()) return 0;
+    size_t n = 0;
+    for (uint64_t h : mit->second) {
+        if (out != nullptr && n < cap) out[n] = h;
+        n++;
+        auto it = r->workers_by_hash.find(h);
+        if (it != r->workers_by_hash.end()) {
+            it->second.erase(worker_id);
+            if (it->second.empty()) r->workers_by_hash.erase(it);
+        }
+    }
+    r->hashes_by_worker.erase(mit);
+    return n;
+}
+
+// Rolling block-set digest over a worker's indexed hashes: XOR of
+// xxh3_64 over each hash's 8 little-endian bytes under `seed` — the
+// exact fold dynamo_tpu/kv_router/digest.py computes, so the
+// anti-entropy sweep can compare this index against worker-advertised
+// digests without enumerating (returns the worker's block count).
+size_t dyn_radix_digest(void* p, uint32_t worker_id, uint64_t seed,
+                        uint64_t* out_fold) {
+    RadixIndex* r = (RadixIndex*)p;
+    *out_fold = 0;
+    auto mit = r->hashes_by_worker.find(worker_id);
+    if (mit == r->hashes_by_worker.end()) return 0;
+    uint64_t fold = 0;
+    for (uint64_t h : mit->second)
+        fold ^= dynxxh3::xxh3_64(&h, 8, seed);
+    *out_fold = fold;
+    return mit->second.size();
+}
+
 size_t dyn_radix_num_blocks(void* p) {
     return ((RadixIndex*)p)->workers_by_hash.size();
 }

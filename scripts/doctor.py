@@ -48,6 +48,12 @@ Rules (each emits severity + worker + evidence + suggested action):
                        pool's SLA
   sla-burn             a role is burning its error budget (burn rate >1
                        in the merged windows)
+  kv-index-drift       the KV-aware routers' prefix index detected
+                       sequence gaps / digest drift: info when repaired
+                       (resyncs converged), warning while subtrees sit
+                       stale (those workers route cold), critical when
+                       resyncs keep failing and the index cannot
+                       converge
   planner-oscillation  the closed-loop planner's recent decisions
                        alternate scale directions on one role (or flips
                        storm) inside the cooldown window — hysteresis /
@@ -389,6 +395,7 @@ def diagnose(
             "or single-worker pools with no successor at all",
         ))
 
+    findings.extend(_kv_index_rules((fleet or {}).get("kv_index")))
     findings.extend(_planner_rules((fleet or {}).get("planner")))
 
     for iid, p in sorted(((programs or {}).get("workers") or {}).items()):
@@ -416,6 +423,67 @@ def diagnose(
 
     order = {"critical": 0, "warning": 1, "info": 2}
     findings.sort(key=lambda f: (order.get(f["severity"], 9), str(f["worker"])))
+    return findings
+
+
+def _kv_index_rules(kv_index: Optional[dict]) -> list[dict]:
+    """KV index consistency (fleet snapshot `kv_index` section,
+    published by KV-aware routers over kv_index.status — docs/
+    operations.md "KV index consistency"). Drift that is detected AND
+    repaired is an info note (the plane converged); subtrees sitting
+    stale are a warning (those workers route cold — real prefix hits
+    are being recomputed); stale subtrees with FAILING resyncs are
+    critical when repair has never succeeded (the index cannot
+    converge: snapshot fetches are failing or sequencing is off)."""
+    findings: list[dict] = []
+    if not isinstance(kv_index, dict):
+        return findings
+    stale = int(kv_index.get("stale_workers") or 0)
+    gaps = int(kv_index.get("gaps_total") or 0)
+    mismatches = int(kv_index.get("digest_mismatches_total") or 0)
+    resyncs = int(kv_index.get("resyncs_total") or 0)
+    failures = int(kv_index.get("resync_failures_total") or 0)
+    drift = int(kv_index.get("drift_blocks_total") or 0)
+    evidence = {
+        "stale_workers": stale, "gaps_total": gaps,
+        "digest_mismatches_total": mismatches,
+        "resyncs_total": resyncs, "resync_failures_total": failures,
+        "drift_blocks_total": drift,
+    }
+    if stale > 0:
+        wedged = failures > 0 and resyncs == 0
+        findings.append(_finding(
+            "critical" if wedged else "warning", "kv-index-drift", None,
+            (f"{stale} index subtree(s) stale and every resync attempt "
+             f"has failed ({failures} failure(s), 0 succeeded) — the "
+             "prefix index cannot converge"
+             if wedged else
+             f"{stale} index subtree(s) stale — prefix routing scores "
+             "those workers COLD until their resync lands (warm hits on "
+             "them are being recomputed)"),
+            evidence,
+            ("check that workers run with KV sequencing enabled (no "
+             "--no-kv-sequencing) and that the router can reach their "
+             "ingress for kv.snapshot; a dead worker clears when its "
+             "registration prunes"
+             if wedged else
+             "usually self-heals within an anti-entropy sweep; if stale "
+             "persists, check the worker's ingress reachability and the "
+             "router log's kv.snapshot fetch errors"),
+        ))
+    elif gaps or mismatches:
+        findings.append(_finding(
+            "info", "kv-index-drift", None,
+            f"index drift was detected ({gaps} sequence gap(s), "
+            f"{mismatches} digest mismatch(es)) and repaired by "
+            f"{resyncs} resync(s), {drift} block(s) corrected — the "
+            "event plane is lossy but converging",
+            evidence,
+            "no action needed now; a climbing gap rate means KV events "
+            "are being dropped (fabric outages, ring overflow) — check "
+            "dynamo_tpu_kv_index_gaps_total's rate and the fabric's "
+            "health",
+        ))
     return findings
 
 

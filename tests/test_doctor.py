@@ -439,3 +439,54 @@ def test_report_renders_and_cli_runs_offline(tmp_path):
     )
     assert out.returncode == 2
     assert isinstance(json.loads(out.stdout), list)
+
+
+def test_kv_index_drift_rule_severities():
+    """kv-index-drift (ISSUE 13): info when drift was detected AND
+    repaired; warning while subtrees sit stale (routing them cold);
+    critical when resyncs only ever fail (the index cannot converge);
+    silent with no kv_index section and on a clean converged plane."""
+    doctor = _load_doctor()
+
+    def fleet(**kv_index):
+        return {"workers": {}, "roles": {}, "fleet": {"workers": 0},
+                "kv_index": kv_index}
+
+    def drift_findings(f):
+        return [
+            x for x in doctor.diagnose(f, {}, {})
+            if x["rule"] == "kv-index-drift"
+        ]
+
+    # repaired drift: info, evidence carries the counters
+    (info,) = drift_findings(fleet(
+        stale_workers=0, gaps_total=3, digest_mismatches_total=1,
+        resyncs_total=4, resync_failures_total=0, drift_blocks_total=17,
+    ))
+    assert info["severity"] == "info"
+    assert info["evidence"]["drift_blocks_total"] == 17
+
+    # stale subtrees pending repair: warning
+    (warn,) = drift_findings(fleet(
+        stale_workers=2, gaps_total=5, digest_mismatches_total=0,
+        resyncs_total=3, resync_failures_total=1, drift_blocks_total=9,
+    ))
+    assert warn["severity"] == "warning"
+    assert "COLD" in warn["summary"]
+
+    # stale + only failures: critical (cannot converge)
+    (crit,) = drift_findings(fleet(
+        stale_workers=1, gaps_total=2, digest_mismatches_total=0,
+        resyncs_total=0, resync_failures_total=6, drift_blocks_total=0,
+    ))
+    assert crit["severity"] == "critical"
+    assert "no-kv-sequencing" in crit["action"]
+
+    # clean plane / missing section: quiet
+    assert drift_findings(fleet(
+        stale_workers=0, gaps_total=0, digest_mismatches_total=0,
+        resyncs_total=0, resync_failures_total=0, drift_blocks_total=0,
+    )) == []
+    assert drift_findings(
+        {"workers": {}, "roles": {}, "fleet": {"workers": 0}}
+    ) == []

@@ -140,3 +140,170 @@ def test_bad_op_and_error_paths():
             await server.stop()
 
     run(main())
+
+
+# -- replay ring + resume (ISSUE 13): exactly-once subscription delivery --
+
+
+def test_ring_replay_resume_after_connection_loss():
+    """A subscriber that loses its connection mid-stream observes every
+    ring-retained message exactly once: the client's reconnect loop
+    re-subscribes from its last-seen broker seq and the server replays
+    the gap (no loss), while the duplicate guard drops any overlap (no
+    double delivery)."""
+
+    async def main():
+        server = await _server()
+        sub_fab = await RemoteFabric.connect(server.address)
+        pub_fab = await RemoteFabric.connect(server.address)
+        try:
+            sub = await sub_fab.subscribe("kv_events.>")
+            await pub_fab.publish("kv_events.w", {"i": 1}, b"e1")
+            m = await sub.next(2.0)
+            assert m is not None and m.payload == b"e1" and m.seq >= 1
+
+            # sever the SUBSCRIBER's connection; publish into the gap
+            sub_fab._writer.close()
+            await pub_fab.publish("kv_events.w", {"i": 2}, b"e2")
+            await pub_fab.publish("kv_events.w", {"i": 3}, b"e3")
+
+            got = []
+            for _ in range(2):
+                m = await sub.next(8.0)
+                assert m is not None, f"lost the gap; got {got}"
+                got.append(m.payload)
+            assert got == [b"e2", b"e3"]
+            assert await sub.next(0.3) is None  # and no duplicates
+            assert not sub.resume_gap  # lossless resume
+
+            # unringed subjects keep fire-and-forget semantics (seq 0)
+            s2 = await sub_fab.subscribe("metrics.backend.>")
+            await pub_fab.publish("metrics.backend.w", {"x": 1}, b"m")
+            m = await s2.next(2.0)
+            assert m is not None and m.seq == 0
+        finally:
+            await sub_fab.close()
+            await pub_fab.close()
+            await server.stop()
+
+    run(main())
+
+
+def test_ring_replay_survives_server_restart_with_wal(tmp_path):
+    """Satellite (ISSUE 13): WAL + replay ring across a server RESTART —
+    the broker epoch and publish seq persist, so a subscriber that rode
+    out the restart observes every event exactly once: nothing from
+    before the restart is redelivered, nothing published after it is
+    lost."""
+
+    async def main():
+        d = str(tmp_path / "wal")
+        server = FabricServer(port=0, persist_dir=d)
+        await server.start()
+        port = server.port
+        epoch = server.fabric.epoch
+        sub_fab = await RemoteFabric.connect(f"127.0.0.1:{port}")
+        pub_fab = await RemoteFabric.connect(f"127.0.0.1:{port}")
+        sub = await sub_fab.subscribe("kv_events.>")
+        await pub_fab.publish("kv_events.w", {"i": 1}, b"pre")
+        m = await sub.next(2.0)
+        assert m is not None and m.payload == b"pre"
+
+        await server.stop()
+        await pub_fab.close()
+        server2 = FabricServer(port=port, persist_dir=d)
+        await server2.start()
+        try:
+            # continuity: same epoch, seq watermark restored
+            assert server2.fabric.epoch == epoch
+            assert server2.fabric.pub_seq >= 1
+            pub2 = await RemoteFabric.connect(f"127.0.0.1:{port}")
+            await pub2.publish("kv_events.w", {"i": 2}, b"post1")
+            await pub2.publish("kv_events.w", {"i": 3}, b"post2")
+            got = []
+            for _ in range(2):
+                m = await sub.next(10.0)
+                assert m is not None, f"lost events across restart: {got}"
+                got.append(m.payload)
+            # exactly once: both post-restart events, the pre-restart one
+            # NOT redelivered despite living in the restored ring
+            assert got == [b"post1", b"post2"]
+            assert await sub.next(0.3) is None
+            await pub2.close()
+        finally:
+            await sub_fab.close()
+            await server2.stop()
+
+    run(main())
+
+
+def test_ring_trim_past_cursor_flags_gap():
+    """A resume older than the ring's retention cannot be lossless: the
+    server replays what it still has and flags the gap, which sequencing
+    consumers (the KV indexer) treat as a resync trigger."""
+
+    async def main():
+        from dynamo_tpu.runtime.fabric.local import LocalFabric
+
+        f = LocalFabric(ring_size=4)
+        for i in range(10):
+            await f.publish("kv_events.w", {"i": i}, b"x%d" % i)
+        sub = await f.subscribe("kv_events.>", from_seq=2)
+        assert sub.resume_gap  # seqs 3,4,5,6 were trimmed
+        got = [await sub.next(0.1) for _ in range(4)]
+        assert [m.seq for m in got] == [7, 8, 9, 10]
+        assert await sub.next(0.05) is None
+
+    run(main())
+
+
+def test_epoch_change_resume_delivers_fresh_ring():
+    """Review regression: a broker restart WITHOUT a WAL mints a new
+    epoch and restarts seq numbering below the subscriber's old cursor.
+    The resume must deliver everything the new broker retained — the
+    client disarms its duplicate guard for the resume window so the
+    fresh low seqs aren't swallowed by the stale cursor — and flag the
+    gap (pre-restart history is gone for good)."""
+
+    async def main():
+        server = await _server()
+        port = server.port
+        sub_fab = await RemoteFabric.connect(server.address)
+        pub_fab = await RemoteFabric.connect(server.address)
+        sub = await sub_fab.subscribe("kv_events.>")
+        # drive the cursor well past what the NEW broker will number
+        for i in range(20):
+            await pub_fab.publish("kv_events.w", {"i": i}, b"old%d" % i)
+        for _ in range(20):
+            assert (await sub.next(2.0)) is not None
+        assert sub.last_seq >= 20
+
+        await server.stop()
+        await pub_fab.close()
+        server2 = FabricServer(port=port)  # NO persist dir: fresh epoch
+        await server2.start()
+        try:
+            pub2 = await RemoteFabric.connect(f"127.0.0.1:{port}")
+            # published into the new broker BEFORE the subscriber's
+            # reconnect lands: seqs 1..2, far below the old cursor
+            await pub2.publish("kv_events.w", {"i": 100}, b"new1")
+            await pub2.publish("kv_events.w", {"i": 101}, b"new2")
+            got = []
+            for _ in range(2):
+                m = await sub.next(10.0)
+                assert m is not None, (
+                    f"new-epoch replay swallowed by stale cursor; {got}"
+                )
+                got.append(m.payload)
+            assert got == [b"new1", b"new2"]
+            assert sub.resume_gap  # pre-restart history was lost
+            # live traffic keeps flowing with the re-armed guard
+            await pub2.publish("kv_events.w", {"i": 102}, b"new3")
+            m = await sub.next(2.0)
+            assert m is not None and m.payload == b"new3"
+            await pub2.close()
+        finally:
+            await sub_fab.close()
+            await server2.stop()
+
+    run(main())

@@ -100,6 +100,19 @@ def _populated_expositions() -> list[str]:
         ],
     }
     svc.planner_status_age = time.monotonic()
+    # KV index-health frame (KvRouter.stats shape over kv_index.status)
+    # so the "KV index health" row's dynamo_tpu_router_kv_index_*
+    # families are populated
+    svc.kv_index_status = {
+        "backend|r1": {
+            "component": "backend", "router": "r1", "gaps_total": 1,
+            "resyncs_total": 1, "resync_failures_total": 0,
+            "drift_blocks_total": 2, "digest_mismatches_total": 0,
+            "stale_workers": 0, "workers_tracked": 1,
+            "resync_enabled": True,
+        }
+    }
+    svc.kv_index_status_age = {"backend|r1": time.monotonic()}
     pframe = dict(frame)
     pframe.update(instance_id="p1", component="prefill", role="prefill")
     svc.aggregators[1]._latest["p1"] = (pframe, time.monotonic())

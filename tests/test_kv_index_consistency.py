@@ -1,0 +1,870 @@
+"""A KV index you can trust (ISSUE 13): sequenced events, gap-triggered
+resync, and anti-entropy convergence for prefix-aware routing.
+
+Layers under test, bottom up:
+ 1. the digest primitives (kv_router/digest.py) and their native parity
+    (dyn_radix_digest);
+ 2. worker-side stamping + rolling digest + `kv.snapshot` (worker.py),
+    including the sequencing-off wire pin (bit-identical to pre-seq);
+ 3. indexer-side screening (duplicate drop, gap detection), stale-as-
+    cold scoring, targeted resync with live-event buffering, cold-start
+    bootstrap, and the anti-entropy digest sweep (kv_router/indexer.py);
+ 4. the tree property pin: random event streams applied event-wise ==
+    bulk reconstruction from the final block sets, Python and native
+    trees agreeing exactly;
+ 5. the tentpole convergence property: random store/remove/DROP
+    schedules through a real pump → post-resync tree == ground truth;
+ 6. e2e chaos: real FabricServer + mock workers + KvRouter under
+    fault-injected publish drops converge to digest-exact agreement,
+    and a restarted router bootstraps warm from snapshots.
+"""
+
+import asyncio
+import random
+
+import pytest
+
+from dynamo_tpu.kv_router.digest import SetDigest, fold_hashes, fold_one
+from dynamo_tpu.kv_router.indexer import (
+    KvIndexer,
+    KvIndexerSharded,
+    RadixTree,
+    index_counters,
+)
+from dynamo_tpu.model_card import ModelDeploymentCard
+from dynamo_tpu.runtime.fabric.local import LocalFabric
+from dynamo_tpu.tokens import hash_token_blocks
+from dynamo_tpu.worker import Worker
+
+PAGE = 16
+
+
+@pytest.fixture(autouse=True)
+def _reset_counters():
+    index_counters.reset()
+    yield
+    index_counters.reset()
+
+
+def _native_tree_or_skip():
+    from dynamo_tpu.kv_router.indexer import NativeRadixTree
+
+    try:
+        return NativeRadixTree()
+    except RuntimeError:
+        pytest.skip("native library unavailable")
+
+
+# -- 1. digest primitives --------------------------------------------------
+
+
+class TestDigest:
+    def test_set_semantics_and_fold_roundtrip(self):
+        dg = SetDigest()
+        assert dg.store(10) and dg.store(20, parent=10)
+        assert not dg.store(10)  # duplicate store is a no-op
+        assert (dg.fold, dg.count) == fold_hashes([10, 20])
+        assert not dg.remove(99)  # absent remove is a no-op
+        assert dg.remove(10)
+        assert (dg.fold, dg.count) == fold_hashes([20])
+        assert dg.remove(20)
+        assert (dg.fold, dg.count) == (0, 0)
+
+    def test_fold_is_order_independent_and_self_inverse(self):
+        hashes = [fold_one(i) for i in range(8)]  # spread u64s
+        a = fold_hashes(hashes)
+        b = fold_hashes(list(reversed(hashes)))
+        assert a == b
+        f, _ = fold_hashes(hashes + [hashes[0]])  # xor-toggle out
+        assert f == fold_hashes(hashes[1:])[0]
+
+    def test_python_tree_digest_matches_worker_fold(self):
+        t = RadixTree()
+        h = hash_token_blocks(list(range(PAGE * 3)), block_size=PAGE)
+        t.apply_event("w", {"kind": "stored", "block_hashes": list(h)})
+        assert t.digest_for("w") == fold_hashes(h)
+        assert t.digest_for("ghost") == (0, 0)
+
+    def test_native_tree_digest_parity(self):
+        nt = _native_tree_or_skip()
+        pt = RadixTree()
+        h = hash_token_blocks(list(range(PAGE * 5)), block_size=PAGE)
+        for t in (nt, pt):
+            t.apply_event("w", {"kind": "stored", "block_hashes": list(h)})
+            t.apply_event(
+                "w", {"kind": "removed", "block_hashes": [h[-1]]}
+            )
+        assert nt.digest_for("w") == pt.digest_for("w") == fold_hashes(h[:-1])
+
+
+# -- 2. worker-side stamping + snapshot ------------------------------------
+
+
+def _worker(sequencing=True, engine_kind="echo"):
+    card = ModelDeploymentCard(name="m", kv_page_size=PAGE)
+    return Worker(None, card, engine_kind=engine_kind,
+                  kv_sequencing=sequencing)
+
+
+def _stored(h, parent=None):
+    return {"kind": "stored", "block_hashes": [h], "parent_hash": parent,
+            "token_blocks": [[1] * PAGE]}
+
+
+def _removed(h):
+    return {"kind": "removed", "block_hashes": [h], "parent_hash": None,
+            "token_blocks": []}
+
+
+class TestWorkerStamping:
+    def test_seq_monotonic_and_digest_tracks_set(self):
+        w = _worker()
+        b1 = [_stored(101), _stored(102, parent=101)]
+        b2 = [_removed(101), _stored(103)]
+        w._stamp_kv_events(b1)
+        w._stamp_kv_events(b2)
+        assert [e["seq"] for e in b1 + b2] == [1, 2, 3, 4]
+        assert (w._kv_digest.fold, w._kv_digest.count) == fold_hashes(
+            [102, 103]
+        )
+        # parents ride the snapshot forest
+        assert w._kv_digest.blocks == {102: 101, 103: None}
+
+    def test_handed_over_clears_digest(self):
+        w = _worker()
+        w._stamp_kv_events([_stored(1), _stored(2)])
+        w._stamp_kv_events(
+            [{"kind": "handed_over", "block_hashes": [], "successor": "s"}]
+        )
+        assert (w._kv_digest.fold, w._kv_digest.count) == (0, 0)
+        assert w._kv_seq == 3
+
+    def test_snapshot_handler_shape(self):
+        async def main():
+            w = _worker()
+            w._stamp_kv_events([_stored(7), _stored(8, parent=7)])
+            out = [r async for r in w._kv_snapshot_handler(None, {})]
+            (snap,) = out
+            assert snap["sequencing"] is True
+            assert snap["seq"] == 2
+            assert (snap["fold"], snap["count"]) == fold_hashes([7, 8])
+            assert sorted(b[0] for b in snap["blocks"]) == [7, 8]
+
+            off = _worker(sequencing=False)
+            (snap_off,) = [
+                r async for r in off._kv_snapshot_handler(None, {})
+            ]
+            assert snap_off == {"sequencing": False}
+
+        asyncio.run(main())
+
+    def test_sequencing_off_wire_is_bit_identical_to_pre_seq(self):
+        """--no-kv-sequencing pin: published events carry NO seq key and
+        the metrics frame carries NO kv_digest — the exact pre-ISSUE-13
+        wire."""
+        from dynamo_tpu.engine.page_table import KvEvent
+
+        async def main():
+            fabric = LocalFabric()
+
+            class _Rt:
+                pass
+
+            for sequencing, want_seq in ((False, False), (True, True)):
+                w = _worker(sequencing=sequencing)
+                rt = _Rt()
+                rt.fabric = fabric
+                w.runtime = rt
+                w.instance_id = f"w-{sequencing}"
+                sub = await fabric.subscribe("kv_events.>")
+                w._kv_event_buffer.append(
+                    KvEvent(kind="stored", block_hashes=(11,),
+                            parent_hash=None, token_blocks=((1,),))
+                )
+                await w._publish_once(fabric)
+                msg = await sub.next(1.0)
+                assert msg is not None
+                import msgpack
+
+                (ev,) = msgpack.unpackb(msg.payload, raw=False)
+                assert ("seq" in ev) is want_seq
+                if not want_seq:
+                    assert set(ev) == {
+                        "kind", "block_hashes", "parent_hash",
+                        "token_blocks",
+                    }
+                sub.close()
+
+        asyncio.run(main())
+
+    def test_publish_failure_drops_batch_and_burns_seqs(self):
+        """A failed publish loses the events but keeps the loop alive;
+        the burned seqs surface as a gap at the indexer (the repair
+        contract, not silent divergence)."""
+
+        async def main():
+            class _BoomFabric:
+                async def publish(self, *a, **k):
+                    raise ConnectionError("fabric down")
+
+            w = _worker()
+
+            class _Rt:
+                pass
+
+            rt = _Rt()
+            rt.fabric = _BoomFabric()
+            w.runtime = rt
+            w.instance_id = "w"
+            await w._publish_kv_events([_stored(5)])  # must not raise
+            assert w._kv_seq == 1  # seq burned
+            # digest still reflects the stamped event: the worker DID
+            # register the block; only the announcement was lost
+            assert w._kv_digest.count == 1
+
+        asyncio.run(main())
+
+
+# -- 3. indexer screening / stale scoring / resync / anti-entropy ----------
+
+
+class _FakeSub:
+    async def next(self):
+        await asyncio.sleep(3600)
+
+    def close(self):
+        pass
+
+
+class _FakeFabric:
+    async def subscribe(self, subject):
+        return _FakeSub()
+
+
+def _chain(n, start=0):
+    return hash_token_blocks(
+        list(range(start, start + PAGE * n)), block_size=PAGE
+    )
+
+
+def _seq_stored(hashes, seq_start):
+    return [
+        {"kind": "stored", "block_hashes": [h], "parent_hash": None,
+         "token_blocks": [], "seq": seq_start + i}
+        for i, h in enumerate(hashes)
+    ]
+
+
+class TestIndexerConsistency:
+    def test_duplicates_dropped_gap_flagged_stale_scored_cold(self):
+        async def main():
+            snap_calls = []
+
+            async def snapshot_fn(worker_id):
+                snap_calls.append(worker_id)
+                return None  # unavailable: worker stays stale
+
+            idx = KvIndexer(_FakeFabric(), snapshot_fn=snapshot_fn)
+            h = _chain(4)
+            events = _seq_stored(h[:2], 1)
+            await idx._apply_events("w", idx._screen_events("w", events))
+            # duplicate redelivery: dropped, nothing double-applied
+            before = idx.tree.events_applied
+            assert idx._screen_events("w", events) == []
+            assert idx.tree.events_applied == before
+            assert idx.find_matches(h).scores == {"w": 2}
+
+            # gap: seq 3 lost, seq 4 arrives
+            gap_ev = _seq_stored([h[3]], 4)
+            await idx._apply_events("w", idx._screen_events("w", gap_ev))
+            assert idx.gaps_total == 1
+            assert "w" in idx.stale_workers()
+            # stale-as-cold: the router can never score w warm now
+            out = idx.find_matches(h)
+            assert out.scores == {} and out.matched_blocks == 0
+            # repair attempt ran and failed; still stale
+            await idx._consistency_tick()
+            assert snap_calls == ["w"]
+            assert idx.resync_failures_total == 1
+            assert "w" in idx.stale_workers()
+            await idx.stop()
+
+        asyncio.run(main())
+
+    def test_resync_converges_and_buffers_live_events(self):
+        async def main():
+            h = _chain(6)
+            release = asyncio.Event()
+
+            async def snapshot_fn(worker_id):
+                await release.wait()
+                return {
+                    "sequencing": True, "seq": 10,
+                    "fold": fold_hashes(h[:4])[0], "count": 4,
+                    "blocks": [[x, None] for x in h[:4]],
+                }
+
+            idx = KvIndexer(_FakeFabric(), snapshot_fn=snapshot_fn)
+            # gap straight away (first contact at seq 5)
+            await idx._apply_events(
+                "w", idx._screen_events("w", _seq_stored([h[5]], 5))
+            )
+            assert "w" in idx.stale_workers()
+            task = asyncio.get_running_loop().create_task(idx._resync("w"))
+            await asyncio.sleep(0.01)
+            # live events DURING the swap are buffered, then replayed:
+            # seq 11 extends past the snapshot, seq 9 is inside it (dup)
+            held = idx._screen_events(
+                "w",
+                _seq_stored([h[3]], 9) + _seq_stored([h[4]], 11),
+            )
+            assert held == []  # buffered, not applied
+            release.set()
+            assert await task is True
+            assert idx.resyncs_total == 1
+            assert "w" not in idx.stale_workers()
+            # snapshot(4 blocks) + buffered seq-11 block applied on top
+            assert idx.find_matches(h).scores == {"w": 5}
+            assert idx._states["w"].last_seq == 11
+            # stale h[5] from the pre-resync gap event was REPLACED by
+            # the snapshot (atomic subtree swap) — drift was corrected
+            assert idx.drift_blocks_total > 0
+            await idx.stop()
+
+        asyncio.run(main())
+
+    def test_anti_entropy_digest_mismatch_triggers_resync(self):
+        async def main():
+            h = _chain(3)
+            truth = {"fold": fold_hashes(h)[0], "count": 3, "seq": 3}
+
+            async def snapshot_fn(worker_id):
+                return {
+                    "sequencing": True, "seq": 3, "fold": truth["fold"],
+                    "count": 3, "blocks": [[x, None] for x in h],
+                }
+
+            idx = KvIndexer(
+                _FakeFabric(), snapshot_fn=snapshot_fn,
+                digest_source=lambda: {"w": truth},
+            )
+            # index silently diverged: it only holds 2 of the 3 blocks
+            # but its cursor is current (no gap will ever fire)
+            await idx._apply_events(
+                "w", idx._screen_events("w", _seq_stored(h[:2], 1))
+            )
+            idx._states["w"].last_seq = 3
+            # one mismatched sweep is treated as transient skew (a
+            # sharded drain backlog); TWO in a row is drift
+            await idx._consistency_tick()
+            assert idx.digest_mismatches_total == 0
+            assert "w" not in idx.stale_workers()
+            await idx._consistency_tick()  # detect (marks stale) ...
+            assert idx.digest_mismatches_total == 1
+            await idx._consistency_tick()  # ... and repair
+            assert idx.resyncs_total == 1
+            assert idx.find_matches(h).scores == {"w": 3}
+            assert idx._digest_of("w") == (truth["fold"], 3)
+            await idx.stop()
+
+        asyncio.run(main())
+
+    def test_malformed_snapshot_fails_resync_without_wedging(self):
+        """Review regression: a junk snapshot body (mixed-version peer)
+        must fail like an unavailable one — st.resyncing released,
+        buffered events applied, worker retryable — never a permanently
+        latched resyncing state with an unbounded buffer."""
+
+        async def main():
+            h = _chain(3)
+            bodies = iter([
+                {"sequencing": True, "seq": "junk",
+                 "blocks": [["x", None]]},  # malformed
+                {"sequencing": True, "seq": 3,
+                 "fold": fold_hashes(h)[0], "count": 3,
+                 "blocks": [[x, None] for x in h]},  # then healthy
+            ])
+
+            async def snapshot_fn(worker_id):
+                return next(bodies)
+
+            idx = KvIndexer(_FakeFabric(), snapshot_fn=snapshot_fn)
+            await idx._apply_events(
+                "w", idx._screen_events("w", _seq_stored([h[2]], 3))
+            )
+            assert "w" in idx.stale_workers()
+            assert await idx._resync("w") is False
+            assert idx.resync_failures_total == 1
+            assert not idx._states["w"].resyncing  # NOT latched
+            # events still flow while stale...
+            more = idx._screen_events("w", _seq_stored([h[1]], 4))
+            assert more  # applied, not buffered forever
+            await idx._apply_events("w", more)
+            # ...and the next attempt repairs
+            assert await idx._resync("w") is True
+            assert "w" not in idx.stale_workers()
+            assert idx.find_matches(h).scores == {"w": 3}
+            await idx.stop()
+
+        asyncio.run(main())
+
+    def test_handed_over_successor_gets_sweep_grace(self):
+        """Review regression: the bulk move credits the successor with
+        blocks its own digest won't advertise until its adoption
+        `stored` events publish. The sweep must NOT cold-score the very
+        worker the handover just warmed in that window — and once the
+        successor's events land, the plane is calm with zero false
+        mismatches."""
+
+        async def main():
+            h = _chain(3)
+            frames = {"dst": {"seq": 0, "fold": 0, "count": 0}}
+
+            async def snapshot_fn(worker_id):
+                return None
+
+            idx = KvIndexer(
+                _FakeFabric(), snapshot_fn=snapshot_fn,
+                digest_source=lambda: frames,
+            )
+            await idx._apply_events(
+                "src", idx._screen_events("src", _seq_stored(h, 1))
+            )
+            move = [{"kind": "handed_over", "block_hashes": [],
+                     "successor": "dst", "seq": 4}]
+            await idx._apply_events(
+                "src", idx._screen_events("src", move)
+            )
+            assert idx.find_matches(h).scores == {"dst": 3}
+            # dst's advertised digest lags (count 0 vs the index's 3):
+            # the grace window sits out the comparison
+            await idx._consistency_tick()
+            await idx._consistency_tick()
+            assert "dst" not in idx.stale_workers()
+            assert idx.digest_mismatches_total == 0
+            # dst's adoption stored events publish: duplicates of the
+            # moved hashes (set no-op) advance its cursor, frame catches
+            # up, and the sweep agrees
+            await idx._apply_events(
+                "dst", idx._screen_events("dst", _seq_stored(h, 1))
+            )
+            frames["dst"] = {
+                "seq": 3, "fold": fold_hashes(h)[0], "count": 3,
+            }
+            for _ in range(3):
+                await idx._consistency_tick()
+            assert "dst" not in idx.stale_workers()
+            assert idx.digest_mismatches_total == 0
+            assert idx.find_matches(h).scores == {"dst": 3}
+            await idx.stop()
+
+        asyncio.run(main())
+
+    def test_anti_entropy_lost_tail_detected(self):
+        """The one loss shape no later event can reveal: the stream's
+        tail. The worker's advertised seq keeps leading a cursor that
+        stopped moving — two sweeps of that is a gap."""
+
+        async def main():
+            h = _chain(4)
+
+            async def snapshot_fn(worker_id):
+                return {
+                    "sequencing": True, "seq": 4,
+                    "fold": fold_hashes(h)[0], "count": 4,
+                    "blocks": [[x, None] for x in h],
+                }
+
+            frame = {"seq": 4, "fold": fold_hashes(h)[0], "count": 4}
+            idx = KvIndexer(
+                _FakeFabric(), snapshot_fn=snapshot_fn,
+                digest_source=lambda: {"w": frame},
+            )
+            await idx._apply_events(
+                "w", idx._screen_events("w", _seq_stored(h[:2], 1))
+            )
+            await idx._consistency_tick()  # lag sweep 1: benign
+            assert "w" not in idx.stale_workers()
+            await idx._consistency_tick()  # lag sweep 2: lost tail
+            assert idx.gaps_total == 1
+            await idx._consistency_tick()  # repair
+            assert idx.find_matches(h).scores == {"w": 4}
+            assert "w" not in idx.stale_workers()
+            await idx.stop()
+
+        asyncio.run(main())
+
+    def test_bootstrap_loads_snapshots_cold(self):
+        async def main():
+            h = _chain(5)
+
+            async def snapshot_fn(worker_id):
+                return {
+                    "sequencing": True, "seq": 5,
+                    "fold": fold_hashes(h)[0], "count": 5,
+                    "blocks": [[x, None] for x in h],
+                }
+
+            idx = KvIndexer(_FakeFabric(), snapshot_fn=snapshot_fn)
+            assert await idx.bootstrap(["w"]) == 1
+            assert idx.find_matches(h).scores == {"w": 5}
+            assert idx._states["w"].last_seq == 5
+            # later events continue seamlessly from the snapshot's seq
+            extra = _chain(1, start=10_000)
+            await idx._apply_events(
+                "w", idx._screen_events("w", _seq_stored(extra, 6))
+            )
+            assert idx.gaps_total == 0
+            await idx.stop()
+
+        asyncio.run(main())
+
+    def test_unstamped_events_keep_legacy_behavior(self):
+        """Events without seq (older peers / --no-kv-sequencing): no
+        tracking, no gaps, no staleness — the pre-ISSUE-13 scoring."""
+
+        async def main():
+            idx = KvIndexer(_FakeFabric())
+            h = _chain(3)
+            bare = [
+                {"kind": "stored", "block_hashes": list(h),
+                 "parent_hash": None, "token_blocks": []}
+            ]
+            screened = idx._screen_events("w", bare)
+            assert screened == bare
+            await idx._apply_events("w", screened)
+            assert idx.find_matches(h).scores == {"w": 3}
+            assert idx.gaps_total == 0 and not idx._states
+            await idx.stop()
+
+        asyncio.run(main())
+
+    def test_sharded_swap_serializes_with_event_queue(self):
+        """KvIndexerSharded: the resync swap rides the shard queue, so
+        events enqueued BEFORE the resync apply first and the swap
+        replaces them atomically."""
+
+        async def main():
+            h = _chain(6)
+
+            async def snapshot_fn(worker_id):
+                return {
+                    "sequencing": True, "seq": 20,
+                    "fold": fold_hashes(h[:3])[0], "count": 3,
+                    "blocks": [[x, None] for x in h[:3]],
+                }
+
+            idx = KvIndexerSharded(
+                _FakeFabric(), num_shards=3, snapshot_fn=snapshot_fn
+            )
+            await idx.start()
+            try:
+                # stale junk ahead of the swap in the queue
+                await idx._apply_events(
+                    "w", idx._screen_events("w", _seq_stored(h[3:], 1))
+                )
+                assert await idx._resync("w") is True
+                await idx.drain_for_tests()
+                out = idx.find_matches(h)
+                assert out.scores == {"w": 3}
+                assert idx._digest_of("w") == fold_hashes(h[:3])
+                assert idx._states["w"].last_seq == 20
+            finally:
+                await idx.stop()
+
+        asyncio.run(main())
+
+
+# -- 4. tree property pin (satellite): event-wise == bulk reconstruction ---
+
+
+def _random_stream(rng, n_ops=400, n_workers=4):
+    """(ops, ground_truth) — ops over stored/removed/handed_over/clear,
+    ground truth maintained as worker -> set of hashes."""
+    workers = [f"w{i}" for i in range(n_workers)]
+    truth: dict[str, set] = {w: set() for w in workers}
+    pool = [
+        hash_token_blocks(
+            list(range(s, s + PAGE * 4)), block_size=PAGE
+        )
+        for s in range(0, 4000, 400)
+    ]
+    ops = []
+    for _ in range(n_ops):
+        w = rng.choice(workers)
+        r = rng.random()
+        if r < 0.55:
+            chain = rng.choice(pool)
+            k = rng.randrange(1, len(chain) + 1)
+            hs = list(chain[:k])
+            ops.append((w, {"kind": "stored", "block_hashes": hs}))
+            truth[w].update(hs)
+        elif r < 0.8:
+            if truth[w]:
+                hs = rng.sample(sorted(truth[w]), min(3, len(truth[w])))
+                ops.append((w, {"kind": "removed", "block_hashes": hs}))
+                truth[w].difference_update(hs)
+        elif r < 0.92:
+            dst = rng.choice(workers)
+            ops.append(
+                (w, {"kind": "handed_over", "block_hashes": [],
+                     "successor": dst})
+            )
+            if dst != w:
+                truth[dst].update(truth[w])
+                truth[w] = set()
+            else:
+                truth[w] = set()  # self-move == remove (tree contract)
+        else:
+            ops.append(("__clear__", None))
+            truth = {w: set() for w in workers}
+    return ops, truth, pool
+
+
+@pytest.mark.parametrize("seed", [1, 7, 42])
+def test_tree_property_eventwise_equals_bulk_reconstruction(seed):
+    rng = random.Random(seed)
+    ops, truth, pool = _random_stream(rng)
+    impls = [RadixTree()]
+    from dynamo_tpu import native
+
+    if native.lib() is not None:
+        impls.append(_native_tree_or_skip())
+    for t in impls:
+        for w, ev in ops:
+            if w == "__clear__":
+                t.clear()
+            else:
+                t.apply_event(w, ev)
+    # bulk reconstruction from the FINAL ground-truth block sets
+    bulk = RadixTree()
+    for w, hs in truth.items():
+        bulk.store_bulk(w, sorted(hs))
+    for t in impls:
+        for w, hs in truth.items():
+            assert t.blocks_for(w) == len(hs), (type(t).__name__, w)
+            assert t.digest_for(w) == bulk.digest_for(w) == fold_hashes(hs)
+        for chain in pool:
+            got = t.find_matches(chain)
+            want = bulk.find_matches(chain)
+            assert got.scores == want.scores, type(t).__name__
+            assert got.matched_blocks == want.matched_blocks
+
+
+# -- 5. tentpole pin: random store/remove/drop schedules converge ----------
+
+
+@pytest.mark.parametrize("seed", [3, 11, 29])
+def test_convergence_under_random_drop_schedules(seed):
+    """Random store/remove schedules with random BATCH DROPS between
+    worker and indexer: after the anti-entropy sweeps run, the index's
+    per-worker subtree equals the ground-truth reconstruction exactly
+    (digest-exact), with gaps detected and resyncs counted."""
+
+    async def main():
+        rng = random.Random(seed)
+        fabric = LocalFabric()
+        worker = SetDigest()  # the worker's real registered set
+        seq = 0
+        dropped = 0
+
+        async def snapshot_fn(worker_id):
+            return {
+                "sequencing": True, "seq": seq,
+                "fold": worker.fold, "count": worker.count,
+                "blocks": [[h, p] for h, p in worker.blocks.items()],
+            }
+
+        def digest_source():
+            return {
+                "w": {"seq": seq, "fold": worker.fold,
+                      "count": worker.count}
+            }
+
+        idx = KvIndexer(
+            fabric, snapshot_fn=snapshot_fn, digest_source=digest_source
+        )
+        await idx.start()
+        import msgpack
+
+        pool = list(range(100, 400))
+        try:
+            for _ in range(120):
+                batch = []
+                for _ in range(rng.randrange(1, 4)):
+                    seq += 1
+                    if worker.blocks and rng.random() < 0.35:
+                        h = rng.choice(sorted(worker.blocks))
+                        worker.remove(h)
+                        ev = {"kind": "removed", "block_hashes": [h]}
+                    else:
+                        h = fold_one(rng.choice(pool))  # spread u64
+                        worker.store(h)
+                        ev = {"kind": "stored", "block_hashes": [h],
+                              "parent_hash": None, "token_blocks": []}
+                    ev["seq"] = seq
+                    batch.append(ev)
+                if rng.random() < 0.25:
+                    dropped += 1
+                    continue  # the batch is LOST on the wire
+                await fabric.publish(
+                    "kv_events.w", {"instance_id": "w",
+                                    "count": len(batch)},
+                    msgpack.packb(batch, use_bin_type=True),
+                )
+            await asyncio.sleep(0.05)  # pump drains (same loop)
+            assert dropped > 0, "schedule produced no drops; bad seed"
+            # convergence: a few deterministic sweeps (detect-lag x2,
+            # resync, verify)
+            for _ in range(5):
+                await idx._consistency_tick()
+            assert idx._digest_of("w") == (worker.fold, worker.count)
+            assert idx._states["w"].last_seq == seq
+            assert "w" not in idx.stale_workers()
+            assert idx.gaps_total > 0
+            assert idx.resyncs_total > 0
+        finally:
+            await idx.stop()
+
+    asyncio.run(main())
+
+
+# -- 6. e2e chaos: real fabric + mock workers + router ---------------------
+
+
+def _req(rid, tokens, max_tokens=2 * PAGE):
+    return {
+        "request_id": rid, "token_ids": tokens, "max_tokens": max_tokens,
+        "temperature": 0.0, "top_p": 1.0, "top_k": 0, "seed": None,
+        "stop_token_ids": [], "stop_strings": [], "ignore_eos": True,
+        "annotations": {},
+    }
+
+
+def test_e2e_chaos_drops_converge_and_restart_bootstraps_warm():
+    """The acceptance scenario at tier-1 speed: two mock workers over a
+    real FabricServer, KV-event publishes fault-dropped, a KvRouter
+    whose index must (a) reach digest-exact agreement with every
+    worker's real block set within a bounded window, and (b) after the
+    router is torn down and replaced (indexer SIGKILL-equivalent), the
+    fresh index bootstraps warm from worker snapshots."""
+    from dynamo_tpu.kv_router import KvRouter, KvRouterConfig
+    from dynamo_tpu.runtime import DistributedRuntime, RouterMode
+    from dynamo_tpu.runtime.fabric import FabricServer
+    from dynamo_tpu.runtime.push_router import PushRouter
+    from dynamo_tpu.testing import faults
+
+    async def main():
+        server = FabricServer(port=0)
+        await server.start()
+
+        async def spawn_worker():
+            rt = await DistributedRuntime.create(server.address)
+            w = Worker(
+                rt, ModelDeploymentCard(name="mock-model",
+                                        kv_page_size=PAGE),
+                engine_kind="mock", namespace="test", component="backend",
+                endpoint="generate", metrics_interval=0.05,
+                router_mode="kv",
+            )
+            await w.start()
+            return rt, w
+
+        async def spawn_router():
+            rt = await DistributedRuntime.create(server.address)
+            ep = rt.namespace("test").component("backend").endpoint(
+                "generate"
+            )
+            src = await ep.instance_source()
+            kv = KvRouter(
+                rt.fabric, "backend", src, block_size=PAGE,
+                salt="mock-model", config=KvRouterConfig(temperature=0.0),
+            )
+            kv.indexer.anti_entropy_interval = 0.15
+            await kv.start()
+            router = PushRouter(
+                src, "generate", mode=RouterMode.KV, kv_chooser=kv.choose
+            )
+            return rt, src, kv, router
+
+        rt1, w1 = await spawn_worker()
+        rt2, w2 = await spawn_worker()
+        rtc, src, kv, router = await spawn_router()
+        inj = faults.install(seed=7)
+        # drop ~35% of ALL fabric publishes (KV events AND metrics
+        # frames ride bus.pub) — the convergence protocol must cope
+        inj.add_rule("fabric.call", "drop", prob=0.35, op="bus.pub")
+        workers = {w.instance_id: w for w in (w1, w2)}
+        try:
+            await src.wait_for_instances()
+            for i in range(24):
+                prompt = list(range(i * 100, i * 100 + 4 * PAGE))
+                out = [x async for x in router.generate(
+                    _req(f"r{i}", prompt)
+                )]
+                assert out
+                kv.on_complete(f"r{i}")
+            # faults off; the protocol now has a bounded window to
+            # repair whatever the drops broke
+            faults.uninstall()
+
+            def agree(iid):
+                w = workers[iid]
+                st = kv.indexer._states.get(iid)
+                return (
+                    st is not None
+                    and not st.stale
+                    and st.last_seq == w._kv_seq
+                    and kv.indexer._digest_of(iid)
+                    == (w._kv_digest.fold, w._kv_digest.count)
+                )
+
+            deadline = asyncio.get_running_loop().time() + 15.0
+            while asyncio.get_running_loop().time() < deadline:
+                if all(agree(iid) for iid in workers):
+                    break
+                await asyncio.sleep(0.1)
+            for iid, w in workers.items():
+                assert agree(iid), (
+                    f"{iid} never converged: "
+                    f"{kv.indexer._states.get(iid)} vs seq {w._kv_seq}; "
+                    f"stats {kv.indexer.stats()}"
+                )
+            assert kv.indexer.gaps_total > 0, (
+                "drop schedule never lost a KV batch; chaos ineffective"
+            )
+            stats = kv.indexer.stats()
+            assert stats["resyncs_total"] > 0
+
+            # --- indexer SIGKILL-equivalent: a FRESH router bootstraps
+            # its index warm from worker snapshots, no event replay
+            await kv.stop()
+            router.close()
+            await rtc.close()
+            rtc2, src2, kv2, router2 = await spawn_router()
+            try:
+                deadline = asyncio.get_running_loop().time() + 10.0
+                while asyncio.get_running_loop().time() < deadline:
+                    if all(
+                        kv2.indexer._digest_of(iid)
+                        == (w._kv_digest.fold, w._kv_digest.count)
+                        for iid, w in workers.items()
+                    ):
+                        break
+                    await asyncio.sleep(0.1)
+                for iid, w in workers.items():
+                    assert kv2.indexer._digest_of(iid) == (
+                        w._kv_digest.fold, w._kv_digest.count,
+                    ), f"cold-start bootstrap missed {iid}"
+            finally:
+                await kv2.stop()
+                router2.close()
+                await rtc2.close()
+        finally:
+            faults.uninstall()
+            await kv.stop()
+            await w1.stop(); await rt1.close()
+            await w2.stop(); await rt2.close()
+            await server.stop()
+
+    asyncio.run(main())

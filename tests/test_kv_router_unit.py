@@ -104,28 +104,41 @@ class TestRadixTree:
         assert t.move_worker("ghost", "b") == 0
 
 
-def test_native_tree_move_degrades_to_remove():
-    """The native index cannot enumerate a worker's hashes, so its bulk
-    move honestly degrades: src entries drop, the successor's own
-    stored events repopulate it (documented in indexer.py)."""
+def test_native_tree_move_parity_with_python_tree():
+    """The native index can now enumerate a worker's hashes
+    (dyn_radix_take_worker), so its bulk-ownership move is FULL parity
+    with the Python tree — a handover's `handed_over` event leaves both
+    implementations in identical state (ISSUE 13: the old degradation
+    to remove + event repopulation is gone)."""
     import pytest
 
     from dynamo_tpu.kv_router.indexer import NativeRadixTree
 
     try:
-        t = NativeRadixTree()
+        nt = NativeRadixTree()
     except RuntimeError:
         pytest.skip("native library unavailable")
-    h = hash_token_blocks(list(range(64 * 2)), block_size=64)
-    _store(t, "a", h)
-    t.apply_event(
-        "a", {"kind": "handed_over", "block_hashes": [], "successor": "b"}
-    )
-    assert "a" not in t.workers()
-    assert t.find_matches(h).scores == {}  # dst repopulates via events
-    _store(t, "b", h)
-    assert t.find_matches(h).scores == {"b": 2}
-    assert t.take_worker("b") == []  # degradation contract
+    pt = RadixTree()
+    h = hash_token_blocks(list(range(64 * 3)), block_size=64)
+    for t in (nt, pt):
+        _store(t, "a", h)
+        _store(t, "c", h[:1])
+        t.apply_event(
+            "a",
+            {"kind": "handed_over", "block_hashes": [], "successor": "b"},
+        )
+    # tree-state equality after the handover move
+    assert nt.find_matches(h).scores == pt.find_matches(h).scores == {
+        "b": 3, "c": 1,
+    }
+    assert "a" not in nt.workers() and "a" not in pt.workers()
+    assert nt.blocks_for("b") == pt.blocks_for("b") == 3
+    assert nt.digest_for("b") == pt.digest_for("b")
+    assert nt.digest_for("a") == pt.digest_for("a") == (0, 0)
+    assert nt.events_applied == pt.events_applied
+    # take_worker enumerates for real on both
+    assert sorted(nt.take_worker("b")) == sorted(pt.take_worker("b"))
+    assert nt.blocks_for("b") == pt.blocks_for("b") == 0
 
 
 def test_sharded_indexer_cross_shard_move(monkeypatch):

@@ -158,3 +158,114 @@ def test_fabric_broker_self_metrics():
             await server.stop()
 
     asyncio.run(main())
+
+
+def test_stale_return_does_not_double_count_fleet_counters():
+    """Satellite (ISSUE 13, mirrors the PR 6 hardening): a worker aging
+    out of the aggregator folds its monotonic counters into the
+    retired-per-role base — but a worker that RETURNS with its counters
+    intact (a transient publish gap: partition, fabric outage, exactly
+    the windows the KV digest plane now rides out) must be UN-folded,
+    or the dynamo_tpu_fleet_*_total families count its history twice. A
+    genuine restart (counters reset) keeps the fold."""
+    import re
+    import time as _time
+
+    class _DummyFabric:
+        pass
+
+    def _fleet_preemptions(svc, role="decode"):
+        text = "\n".join(svc._fleet_lines())
+        m = re.search(
+            r'dynamo_tpu_fleet_preemptions_total\{role="%s"\} (\d+)' % role,
+            text,
+        )
+        return int(m.group(1)) if m else 0
+
+    svc = MetricsService(_DummyFabric())
+    frame = {
+        "instance_id": "w1", "component": "backend", "role": "decode",
+        "preemptions": 5, "generated_tokens": 100,
+    }
+    # a steady peer keeps the role's families emitting while w1 churns
+    peer = dict(frame, instance_id="w2", preemptions=1)
+    svc.aggregator._latest["w2"] = (peer, _time.monotonic())
+    svc.aggregator._latest["w1"] = (frame, _time.monotonic())
+    assert _fleet_preemptions(svc) == 6
+
+    # w1 goes stale (ages out of the aggregator): its 5 preemptions
+    # move into the retired base, total stays 6
+    del svc.aggregator._latest["w1"]
+    assert _fleet_preemptions(svc) == 6
+
+    # ... and RETURNS with counters intact (and climbing): the ghost
+    # unfolds — live 7+1, base back to 0, total 8 (NOT 13)
+    frame2 = dict(frame, preemptions=7)
+    svc.aggregator._latest["w1"] = (frame2, _time.monotonic())
+    assert _fleet_preemptions(svc) == 8
+    # steady state stays correct on repeated assemblies
+    assert _fleet_preemptions(svc) == 8
+
+    # contrast: age out again, then return RESET (a real restart) —
+    # the fold must stick and the fresh life adds on top
+    del svc.aggregator._latest["w1"]
+    assert _fleet_preemptions(svc) == 8
+    frame3 = dict(frame, preemptions=2)
+    svc.aggregator._latest["w1"] = (frame3, _time.monotonic())
+    assert _fleet_preemptions(svc) == 10  # 7 folded + 2 new + 1 peer
+
+
+def test_kv_index_status_fold_and_fleet_section():
+    """Router-published kv_index.status frames become the
+    dynamo_tpu_router_kv_index_* families and /v1/fleet's `kv_index`
+    section (doctor's kv-index-drift input)."""
+    import time as _time
+
+    class _DummyFabric:
+        pass
+
+    svc = MetricsService(_DummyFabric())
+    # keyed by (component, router id): two routers on one component
+    # must both show up, not overwrite each other into a sawtooth
+    svc.kv_index_status = {
+        "backend|ra": {
+            "component": "backend", "router": "ra", "gaps_total": 3,
+            "resyncs_total": 2, "resync_failures_total": 1,
+            "drift_blocks_total": 40, "digest_mismatches_total": 1,
+            "stale_workers": 1,
+        },
+        "backend|rb": {
+            "component": "backend", "router": "rb", "gaps_total": 1,
+            "resyncs_total": 1, "resync_failures_total": 0,
+            "drift_blocks_total": 2, "digest_mismatches_total": 0,
+            "stale_workers": 0,
+        },
+    }
+    svc.kv_index_status_age = {
+        "backend|ra": _time.monotonic(), "backend|rb": _time.monotonic(),
+    }
+    text = svc.expose()
+    assert (
+        'dynamo_tpu_router_kv_index_gaps_total'
+        '{component="backend",router="ra"} 3' in text
+    )
+    assert (
+        'dynamo_tpu_router_kv_index_gaps_total'
+        '{component="backend",router="rb"} 1' in text
+    )
+    assert (
+        'dynamo_tpu_router_kv_index_stale_workers'
+        '{component="backend",router="ra"} 1' in text
+    )
+    # the process-global families ride the same exposition (zeros here)
+    assert "dynamo_tpu_kv_index_gaps_total" in text
+    from dynamo_tpu.telemetry import promlint
+
+    assert promlint.lint(text) == [], promlint.lint(text)[:6]
+
+    doc = svc.fleet_snapshot()
+    ki = doc["kv_index"]
+    assert ki["gaps_total"] == 4  # summed across router frames
+    assert ki["stale_workers"] == 1
+    assert ki["components"]["backend|ra"]["resyncs_total"] == 2
+    assert "last_seen_s" in ki["components"]["backend|ra"]
