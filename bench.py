@@ -1255,6 +1255,161 @@ def _kv_index_overhead_ab(pairs: int = 4, osl: int = 32, n_req: int = 8) -> dict
     }
 
 
+def _trace_plane_overhead_ab(
+    pairs: int = 3, osl: int = 32, n_req: int = 8
+) -> dict:
+    """Fleet trace plane overhead A/B (ISSUE 14 acceptance): span
+    SHIPPING (sink append + msgpack batch pack) + phase-histogram
+    EXEMPLAR stamping on a warm engine must cost <1% of token
+    throughput. Like the sibling telemetry A/Bs, the <1% claim is the
+    DETERMINISTIC model — a microbench of the per-span ship work and
+    the per-observe exemplar delta priced at the MEASURED
+    spans/request and observes/token of a live traced drive — while
+    the interleaved wall A/B rides along as a sanity band. The model
+    is conservative twice over: the batch pack actually runs in the
+    async publish loop (off the token path), and every engine-thread
+    observe is charged the exemplar-stamped price."""
+    import statistics
+
+    import msgpack
+
+    from dynamo_tpu import telemetry
+    from dynamo_tpu.engine import EngineConfig
+    from dynamo_tpu.engine.engine import JaxEngine
+    from dynamo_tpu.engine.request import SamplingParams
+    from dynamo_tpu.telemetry import phases as _phases
+    from dynamo_tpu.telemetry import traceplane
+
+    # -- microbench 1: one shipped span (open+close through the sink)
+    # plus its amortized share of a 64-span msgpack batch pack
+    telemetry.configure(enabled=True, ring_size=8)
+    traceplane.ensure_shipping()
+    iters = 3_000
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        with telemetry.span("engine.generate", service="engine") as sp:
+            sp.add_event("first_token")
+    span_us = (time.perf_counter() - t0) / iters * 1e6
+    batch = traceplane.drain_spans()[:64]
+    t0 = time.perf_counter()
+    for _ in range(200):
+        msgpack.packb(batch, use_bin_type=True, default=repr)
+    pack_us_per_span = (
+        (time.perf_counter() - t0) / (200 * max(1, len(batch))) * 1e6
+    )
+    ship_us_per_span = span_us + pack_us_per_span
+
+    # -- microbench 2: exemplar-stamped observe vs plain observe
+    tid = "ab" * 16
+    t0 = time.perf_counter()
+    for i in range(20_000):
+        _phases.observe("decode_step_ms", 1.0 + (i & 7), trace_id=tid)
+    stamped_us = (time.perf_counter() - t0) / 20_000 * 1e6
+    telemetry.configure(enabled=False)
+    _phases.phase_histograms.reset()
+    t0 = time.perf_counter()
+    for i in range(20_000):
+        _phases.observe("decode_step_ms", 1.0 + (i & 7))
+    plain_us = (time.perf_counter() - t0) / 20_000 * 1e6
+    exemplar_us = max(0.0, stamped_us - plain_us)
+
+    # -- the interleaved wall A/B on one warm engine, measuring the
+    # live spans/request + observes/token rates for the model
+    eng = JaxEngine(EngineConfig.for_tests())
+
+    def drive(tag: str, on: bool) -> tuple[float, int, int, int]:
+        if on:
+            telemetry.configure(enabled=True, ring_size=64)
+            traceplane.ensure_shipping()
+            traceplane.drain_spans()
+        obs0 = sum(
+            sum(c) for c in _phases.phase_histograms._counts.values()
+        )
+        t0 = time.perf_counter()
+        reqs = []
+        for i in range(n_req):
+            # the traced path exactly as AsyncEngineRunner drives it:
+            # one engine span per request, trace id stamped on the
+            # engine-side Request (exemplars + breakdown enrichment)
+            if on:
+                with telemetry.span(
+                    "engine.generate", service="engine"
+                ) as sp:
+                    req = eng.add_request(
+                        f"{tag}-{i}", [1 + i, 2, 3, 4],
+                        SamplingParams(temperature=0.0, max_tokens=osl),
+                    )
+                    req.trace_id = sp.trace_id
+            else:
+                req = eng.add_request(
+                    f"{tag}-{i}", [1 + i, 2, 3, 4],
+                    SamplingParams(temperature=0.0, max_tokens=osl),
+                )
+            reqs.append(req)
+        done = eng.run_to_completion()
+        shipped = 0
+        if on:
+            spans = traceplane.drain_spans()
+            msgpack.packb(spans, use_bin_type=True, default=repr)
+            shipped = len(spans)
+        dt = time.perf_counter() - t0
+        if on:
+            telemetry.configure(enabled=False)
+        eng.allocator.clear_cache()
+        toks = sum(len(v) for v in done.values())
+        obs = (
+            sum(sum(c) for c in _phases.phase_histograms._counts.values())
+            - obs0
+        )
+        return (toks / dt if dt else 0.0), toks, shipped, obs
+
+    drive("warm", False)
+    rates: dict = {"on": [], "off": []}
+    span_total = tok_total = obs_total = 0
+    for rep in range(pairs):
+        arms = [("on", True), ("off", False)]
+        if rep % 2:
+            arms.reverse()
+        for tag, on in arms:
+            rate, toks, shipped, obs = drive(f"{tag}{rep}", on)
+            rates[tag].append(rate)
+            if on:
+                span_total += shipped
+                tok_total += toks
+                obs_total += obs
+    telemetry.configure(enabled=False)
+    traceplane.disable_shipping()
+    _phases.phase_histograms.reset()
+    on_med = statistics.median(rates["on"])
+    off_med = statistics.median(rates["off"])
+    spans_per_token = span_total / tok_total if tok_total else 1.0
+    observes_per_token = obs_total / tok_total if tok_total else 1.0
+    modeled = measured = None
+    if off_med:
+        serving_us_per_token = 1e6 / off_med
+        modeled = round(
+            (
+                ship_us_per_span * spans_per_token
+                + exemplar_us * observes_per_token
+            )
+            / serving_us_per_token
+            * 100.0,
+            4,
+        )
+        measured = round((1.0 - on_med / off_med) * 100.0, 2)
+    return {
+        "pairs": pairs,
+        "trace_plane_on_tok_s": round(on_med, 1),
+        "trace_plane_off_tok_s": round(off_med, 1),
+        "ship_us_per_span": round(ship_us_per_span, 3),
+        "exemplar_us_per_observe": round(exemplar_us, 4),
+        "spans_per_token": round(spans_per_token, 4),
+        "observes_per_token": round(observes_per_token, 4),
+        "modeled_overhead_pct": modeled,
+        "measured_overhead_pct": measured,
+    }
+
+
 def main() -> None:
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     from dynamo_tpu.platform import honor_jax_platforms_env
@@ -1616,6 +1771,18 @@ def main() -> None:
             # the headline artifact
             kv_index_ab = {"error": f"{type(e).__name__}: {e}"}
 
+    # Fleet trace plane A/B (ISSUE 14): span shipping + exemplar
+    # stamping on a warm engine must stay under 1% of token throughput.
+    trace_plane_ab = None
+    if platform != "tpu" and os.environ.get(
+        "BENCH_TRACE_PLANE_AB", "1"
+    ) != "0":
+        try:
+            trace_plane_ab = _trace_plane_overhead_ab()
+        except Exception as e:  # noqa: BLE001 — A/B failure must not kill
+            # the headline artifact
+            trace_plane_ab = {"error": f"{type(e).__name__}: {e}"}
+
     # Draft-model speculative decoding A/B (ISSUE 9): decode tok/s with
     # the fused draft+verify path on vs off at batch <= 8. Runs by
     # default on the CPU fallback (tiny self-draft — acceptance ~1, the
@@ -1828,6 +1995,11 @@ def main() -> None:
                 **({"handover_ab": handover_ab} if handover_ab else {}),
                 **(
                     {"kv_index_overhead": kv_index_ab} if kv_index_ab else {}
+                ),
+                **(
+                    {"trace_plane_overhead": trace_plane_ab}
+                    if trace_plane_ab
+                    else {}
                 ),
                 **(
                     {"kv_quantize": os.environ["BENCH_KV_QUANTIZE"]}

@@ -528,12 +528,15 @@ async def _run_metrics(args) -> None:
 
     rt = await DistributedRuntime.create(args.fabric)
     svc = MetricsService(
-        rt.fabric, component=args.component, host=args.host, port=args.port
+        rt.fabric, component=args.component, host=args.host, port=args.port,
+        trace_sample_rate=getattr(args, "trace_sample_rate", None),
+        trace_window_s=getattr(args, "trace_window", 2.0),
+        trace_keep=getattr(args, "trace_keep", 512),
     )
     await svc.start()
     print(
         f"metrics service on {args.host}:{svc.port} "
-        f"(/metrics, /v1/fleet, /v1/traces)",
+        f"(/metrics, /v1/fleet, /v1/fleet/events, /v1/traces)",
         flush=True,
     )
     try:
@@ -682,10 +685,15 @@ async def _run_planner(args) -> None:
         return
     if args.mode == "closed":
         from dynamo_tpu.subjects import PLANNER_SUBJECT
+        from dynamo_tpu.telemetry.traceplane import TelemetryShipper
 
         async def status_fn(frame: dict) -> None:
             await rt.fabric.publish(PLANNER_SUBJECT, frame)
 
+        # fleet event timeline: planner decisions buffered by the
+        # ControlRunner ship to fleet.events on a 1 s cadence
+        shipper = TelemetryShipper(rt.fabric, source="planner")
+        shipper.start()
         runner = ControlRunner(
             planner, connector, observer.observe,
             flipper=FleetFlipper(observer) if args.flip else None,
@@ -697,6 +705,7 @@ async def _run_planner(args) -> None:
             status_fn=status_fn,
         )
     else:
+        shipper = None
         runner = PlannerRunner(planner, connector, observer.observe)
     print(
         f"planner up (mode={args.mode}, connector={args.connector}, "
@@ -708,6 +717,8 @@ async def _run_planner(args) -> None:
     finally:
         if hasattr(connector, "stop_all"):
             connector.stop_all()
+        if shipper is not None:
+            await shipper.stop()
         await observer.stop()
         await rt.close()
 
@@ -1104,6 +1115,27 @@ def build_parser() -> argparse.ArgumentParser:
         "--log-file", default=None, dest="log_file", metavar="NAME|PATH",
         help="also log (JSONL) to this file; a bare name lands in "
              "DYNTPU_LOG_DIR (default artifacts/log), never the CWD",
+    )
+    metricsp.add_argument(
+        "--trace-sample-rate", type=int, default=None,
+        dest="trace_sample_rate", metavar="N",
+        help="fleet trace plane: keep 1-in-N HEALTHY traces (anomalous "
+             "ones — slow/error/replayed/incomplete — are always kept); "
+             "0 keeps none but the anomalies. Default 10, or "
+             "DYNTPU_TRACE_SAMPLE_RATE",
+    )
+    metricsp.add_argument(
+        "--trace-window", type=float, default=2.0, dest="trace_window",
+        metavar="SECONDS",
+        help="trace assembly quiet window before a trace finalizes "
+             "through the tail sampler (stragglers arriving later "
+             "attach to kept traces; default 2.0)",
+    )
+    metricsp.add_argument(
+        "--trace-keep", type=int, default=512, dest="trace_keep",
+        metavar="N",
+        help="kept-trace ring capacity at the metrics service "
+             "(LRU-evicted; default 512)",
     )
 
     planp = sub.add_parser("planner", help="autoscale the worker fleet")

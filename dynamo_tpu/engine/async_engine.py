@@ -59,6 +59,13 @@ def output_to_dict(out: StepOutput) -> dict:
         d["mixed"] = True
     if out.spec:
         d["spec"] = True
+    # tracing enrichment (traced requests only — these keys are absent
+    # from the wire when tracing is off, keeping it bit-identical):
+    # measured queue wait / prefill-induced stall for the engine span
+    if out.queue_wait_ms is not None:
+        d["queue_wait_ms"] = out.queue_wait_ms
+    if out.stall_ms is not None:
+        d["stall_ms"] = out.stall_ms
     return d
 
 
@@ -105,6 +112,10 @@ class AsyncEngineRunner:
         #: error-finishes expired streams mid-decode (the scheduler
         #: already drops expired WAITING requests pre-admission)
         self._deadlines: dict[str, float] = {}
+        #: request_id -> trace id, populated ONLY while tracing is on:
+        #: _add_pending stamps it onto the engine-side Request so phase
+        #: exemplars and the breakdown enrichment know their trace
+        self._trace_ids: dict[str, str] = {}
         self._lock = threading.Lock()
         self._wake = threading.Event()
         self._stop = False
@@ -228,12 +239,22 @@ class AsyncEngineRunner:
             # keep their add_request signature working
             kwargs["deadline"] = deadline
         try:
-            eng.add_request(
+            req_obj = eng.add_request(
                 req.request_id, req.token_ids, sampling,
                 mm_embeds=req.mm_embeds,
                 mm_positions=req.mm_positions,
                 **kwargs,
             )
+            tid = self._trace_ids.get(req.request_id)
+            if tid is not None and req_obj is not None:
+                try:
+                    # traced request: the engine-side Request carries its
+                    # trace id (exemplars + breakdown enrichment). Set by
+                    # attribute so engines with narrower add_request
+                    # signatures (test doubles, externals) are untouched.
+                    req_obj.trace_id = tid
+                except (AttributeError, TypeError):
+                    pass
         except QueueFullError as e:
             eng.metrics.overload_rejects += 1
             sched = getattr(eng, "scheduler", None)
@@ -369,6 +390,10 @@ class AsyncEngineRunner:
         ) as sp:
             q = self.watch_request(request.request_id)
             deadline = getattr(request, "deadline", None)
+            if sp.trace_id:
+                # tracing on: let the engine thread stamp this request's
+                # Request/StepOutputs with the trace (cleaned in drain)
+                self._trace_ids[request.request_id] = sp.trace_id
             with self._lock:
                 self._pending.append((request, _sampling_from(request)))
                 if deadline:
@@ -388,6 +413,13 @@ class AsyncEngineRunner:
                     # at least one token rode a speculative verify step
                     spec_seen = True
                     sp.set_attr("spec", True)
+                qw = item.get("queue_wait_ms")
+                if qw is not None:
+                    # measured admission wait (timeline breakdown input)
+                    sp.set_attr("queue_wait_ms", round(float(qw), 3))
+                stall = item.get("stall_ms")
+                if stall is not None:
+                    sp.set_attr("decode_stall_ms", round(float(stall), 3))
                 generated += len(item.get("token_ids", ()))
                 yield item
             sp.set_attr("generated_tokens", generated)
@@ -438,6 +470,7 @@ class AsyncEngineRunner:
             with self._lock:
                 self._deadlines.pop(request_id, None)
             self._queues.pop(request_id, None)
+            self._trace_ids.pop(request_id, None)
 
     async def embed(self, prompts, normalize: bool = True):
         """Embedding vectors via the engine thread (shares the page pool

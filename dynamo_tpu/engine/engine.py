@@ -784,24 +784,27 @@ class JaxEngine:
             # Dispatch counters increment BEFORE the run so emissions
             # inside it record the post-step mark (the decode-stall
             # histogram compares marks across emissions).
+            # exemplar for this dispatch's bucket: any traced request in
+            # the batch (None when tracing is off — zero extra work)
+            batch_tid = self._batch_trace_id(batch)
             if batch.kind == "prefill":
                 self.metrics.prefill_dispatches += 1
                 outputs += self._run_prefill(batch)
                 dt_ms = (time.perf_counter() - t2) * 1000.0
                 self.metrics.time_prefill_ms += dt_ms
-                phases.observe("prefill_ms", dt_ms)
+                phases.observe("prefill_ms", dt_ms, trace_id=batch_tid)
             elif batch.kind == "mixed":
                 self.metrics.mixed_dispatches += 1
                 outputs += self._run_mixed(batch)
                 dt_ms = (time.perf_counter() - t2) * 1000.0
                 self.metrics.time_mixed_ms += dt_ms
-                phases.observe("mixed_step_ms", dt_ms)
+                phases.observe("mixed_step_ms", dt_ms, trace_id=batch_tid)
             else:
                 self.metrics.decode_dispatches += 1
                 outputs += self._run_decode(batch)
                 dt_ms = (time.perf_counter() - t2) * 1000.0
                 self.metrics.time_decode_ms += dt_ms
-                phases.observe("decode_step_ms", dt_ms)
+                phases.observe("decode_step_ms", dt_ms, trace_id=batch_tid)
             self.metrics.steps += 1
             if self._fleet_telemetry:
                 # tokens this step pushed through the model (prefill
@@ -3015,6 +3018,20 @@ class JaxEngine:
             return FinishReason.LENGTH
         return None
 
+    @staticmethod
+    def _batch_trace_id(batch) -> Optional[str]:
+        """Any traced request's trace id in this dispatch — the phase
+        histogram's exemplar for the bucket the step lands in. Always
+        None when tracing is off (no Request carries a trace_id then),
+        so the disabled path pays one short loop over the batch."""
+        for req in batch.decode:
+            if req.trace_id is not None:
+                return req.trace_id
+        for piece in batch.prefill:
+            if piece.request.trace_id is not None:
+                return piece.request.trace_id
+        return None
+
     def _observe_emission(self, req: Request, finished: bool) -> None:
         """Decode-stall histogram bookkeeping: observe the gap since this
         request's previous token emission whenever a prefill-carrying
@@ -3028,7 +3045,15 @@ class JaxEngine:
         if prev is not None and mark > prev[1]:
             from dynamo_tpu.telemetry import phases
 
-            phases.observe("decode_stall_ms", (now - prev[0]) * 1000.0)
+            stall_ms = (now - prev[0]) * 1000.0
+            if req.trace_id is not None:
+                # traced request: accumulate so the final StepOutput can
+                # carry the request's TOTAL prefill-induced stall onto
+                # its engine.generate span (timeline breakdown)
+                req.stall_accum_ms += stall_ms
+            phases.observe(
+                "decode_stall_ms", stall_ms, trace_id=req.trace_id
+            )
         if finished:
             self._last_emit.pop(req.request_id, None)
         else:
@@ -3105,6 +3130,21 @@ class JaxEngine:
                 cached_tokens=req.num_cached_prompt_tokens if first else None,
                 mixed=mixed,
                 spec=spec,
+                # tracing enrichment (traced requests only; None — and
+                # absent from the wire — otherwise): queue wait on the
+                # first output, accumulated decode stall on the last
+                queue_wait_ms=(
+                    req.queue_wait_ms
+                    if first and req.trace_id is not None
+                    else None
+                ),
+                stall_ms=(
+                    round(req.stall_accum_ms, 3)
+                    if finish is not None
+                    and req.trace_id is not None
+                    and req.stall_accum_ms > 0.0
+                    else None
+                ),
             )
         ]
 

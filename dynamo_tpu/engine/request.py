@@ -104,6 +104,15 @@ class Request:
     #: preemption-by-recompute (pages are released; the re-admission
     #: prefill rebuilds both pools).
     spec_draft_pos: int = 0
+    #: distributed-tracing enrichment (set by AsyncEngineRunner only
+    #: while tracing is ON; None otherwise — the default token path is
+    #: untouched): the request's trace id stamps phase-histogram
+    #: exemplars, and the measured queue wait / prefill-induced stall
+    #: ride the first/last StepOutput onto the engine.generate span so
+    #: the assembled trace's timeline breakdown can attribute them
+    trace_id: Optional[str] = None
+    queue_wait_ms: Optional[float] = None
+    stall_accum_ms: float = 0.0
 
     @property
     def num_tokens(self) -> int:
@@ -145,3 +154,10 @@ class StepOutput:
     #: spec_draft_model) — surfaces as the `spec` attribute on the
     #: engine.generate trace span
     spec: bool = False
+    #: tracing enrichment (first output of a TRACED request only; None
+    #: otherwise — the wire shape is unchanged when tracing is off):
+    #: admission-to-schedule wait, for the trace timeline breakdown
+    queue_wait_ms: Optional[float] = None
+    #: tracing enrichment (final output of a traced request): total
+    #: prefill-induced decode stall this request experienced
+    stall_ms: Optional[float] = None

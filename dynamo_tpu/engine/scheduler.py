@@ -285,9 +285,16 @@ class Scheduler:
             if req.arrival_time:
                 from dynamo_tpu.telemetry import phases
 
+                wait_ms = max(
+                    0.0, (time.time() - req.arrival_time) * 1000.0
+                )
+                if req.trace_id is not None:
+                    # traced request: the wait rides the first StepOutput
+                    # onto the engine.generate span (timeline breakdown)
+                    # and stamps the histogram bucket's exemplar
+                    req.queue_wait_ms = wait_ms
                 phases.observe(
-                    "queue_wait_ms",
-                    max(0.0, (time.time() - req.arrival_time) * 1000.0),
+                    "queue_wait_ms", wait_ms, trace_id=req.trace_id
                 )
 
     def _mixed_max_pieces(self) -> Optional[int]:

@@ -176,6 +176,16 @@ class HttpService:
         return web.json_response(listing.model_dump())
 
     async def metrics_handler(self, request: web.Request) -> web.Response:
+        from dynamo_tpu.telemetry import openmetrics
+
+        # content negotiation: Prometheus asks for OpenMetrics by
+        # default and gets exemplars; classic scrapers keep the 0.0.4
+        # text they can parse (exemplar syntax would fail their scrape)
+        if openmetrics.negotiate(request.headers.get("Accept")):
+            return web.Response(
+                text=self.metrics.expose(openmetrics=True),
+                content_type=openmetrics.CONTENT_TYPE,
+            )
         return web.Response(
             text=self.metrics.expose(), content_type="text/plain"
         )
@@ -714,6 +724,10 @@ class HttpService:
             input_tokens=usage.prompt_tokens if usage else 0,
             output_tokens=usage.completion_tokens if usage else 0,
         )
+        root = telemetry.current_span()
+        if root is not None:
+            root.set_attr("http_status", 200)
+            root.set_attr("e2e_ms", round((time.time() - t0) * 1000.0, 3))
         if kind == "completion":
             from dynamo_tpu.protocols.openai import CompletionLogprobs
 
@@ -802,6 +816,17 @@ class HttpService:
                 req.model, kind, status, time.time() - t0,
                 output_tokens=ntokens, ttft_s=ttft, itl_s=itl,
             )
+            # outcome attrs on the trace root: the tail sampler's
+            # slow/error signals (TTFT/e2e vs the live SLO p95s, HTTP
+            # status) read straight off the assembled root span
+            root = telemetry.current_span()
+            if root is not None:
+                root.set_attr("http_status", int(status))
+                root.set_attr(
+                    "e2e_ms", round((time.time() - t0) * 1000.0, 3)
+                )
+                if ttft is not None:
+                    root.set_attr("ttft_ms", round(ttft * 1000.0, 3))
         with contextlib.suppress(Exception):
             await resp.write_eof()
         return resp

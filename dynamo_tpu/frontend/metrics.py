@@ -130,9 +130,18 @@ class FrontendMetrics:
 
     def shed(self, reason: str) -> None:
         """Count one load-shed 429 (the request_done 429 row is separate:
-        shed_total answers "why", requests_total answers "how many")."""
+        shed_total answers "why", requests_total answers "how many").
+        Also marks the fleet event timeline: per-request 429s coalesce
+        into one shed EPISODE event per ~5 s burst (GET /v1/fleet/events
+        + the Grafana annotation layer)."""
         with self._lock:
             self.shed_total[reason] += 1
+        from dynamo_tpu.telemetry import events
+
+        events.record(
+            "shed", severity="warning", source=f"frontend:{reason}",
+            coalesce_s=5.0, reason=reason,
+        )
 
     def total_inflight(self) -> int:
         with self._lock:
@@ -150,7 +159,11 @@ class FrontendMetrics:
     def inflight_guard(self, model: str) -> "InflightGuard":
         return InflightGuard(self, model)
 
-    def expose(self) -> str:
+    def expose(self, openmetrics: bool = False) -> str:
+        """Classic Prometheus text by default; `openmetrics=True` is the
+        negotiated rendering — OpenMetrics counter-family naming, the
+        `# EOF` terminator, and phase-histogram EXEMPLARS (which the
+        classic parser would reject, failing the whole scrape)."""
         lines = []
         with self._lock:
             lines.append(f"# TYPE {PREFIX}_requests_total counter")
@@ -193,7 +206,7 @@ class FrontendMetrics:
         # layer); whichever process hosts a phase shows it here
         from dynamo_tpu.telemetry import phases
 
-        lines.extend(phases.expose_lines())
+        lines.extend(phases.expose_lines(exemplars=openmetrics))
         # stall-watchdog counters (telemetry/watchdog.py): also
         # process-global — the single-process topology hosts the engine
         # (and therefore its stalls) right here
@@ -214,7 +227,12 @@ class FrontendMetrics:
         # KV-aware router lives in this process in single-process
         # serving — docs/operations.md "KV index consistency"
         lines.extend(_debug.kv_index_lines())
-        return "\n".join(lines) + "\n"
+        text = "\n".join(lines) + "\n"
+        if openmetrics:
+            from dynamo_tpu.telemetry.openmetrics import to_openmetrics
+
+            return to_openmetrics(text)
+        return text
 
 
 class InflightGuard:

@@ -489,10 +489,20 @@ class ModelWatcher:
         self._task: Optional[asyncio.Task] = None
         #: model -> set of entry keys currently backing it
         self._entries: dict[str, set[str]] = {}
+        #: fleet trace plane: this process's finished spans (frontend,
+        #: router, kv.choose) + fleet events (shed episodes, stream
+        #: replays, kv resyncs) ship to the metrics service on a 1 s
+        #: cadence — the frontend has no metrics publish loop to ride
+        self._shipper = None
 
     async def start(self) -> None:
         from dynamo_tpu.runtime.component import MODEL_ROOT
+        from dynamo_tpu.telemetry.traceplane import TelemetryShipper
 
+        self._shipper = TelemetryShipper(
+            self.runtime.fabric, source="frontend"
+        )
+        self._shipper.start()
         watch = await self.runtime.fabric.watch_prefix(MODEL_ROOT + "/")
         self._task = asyncio.get_running_loop().create_task(self._pump(watch))
 
@@ -569,3 +579,9 @@ class ModelWatcher:
     async def stop(self) -> None:
         if self._task:
             self._task.cancel()
+        if self._shipper is not None:
+            try:
+                await self._shipper.stop()
+            except Exception:
+                logger.warning("telemetry shipper stop failed", exc_info=True)
+            self._shipper = None

@@ -634,6 +634,15 @@ class _ConsistencyBase:
             self._stale.discard(worker_id)
         self.resyncs_total += 1
         index_counters.resyncs += 1
+        # fleet event timeline: a resync marks the moment a subtree's
+        # routing went cold->warm again (GET /v1/fleet/events; Grafana
+        # annotations) — joined to any traces that overlapped it
+        from dynamo_tpu.telemetry import events as fleet_events
+
+        fleet_events.record(
+            "kv_resync", source=worker_id, seq=seq,
+            blocks=len(hashes), drift_blocks=drift,
+        )
         # events that arrived during the swap: anything at or below the
         # snapshot's seq is already IN the snapshot; the rest applies on
         # top (an in-buffer gap re-flags and re-syncs)

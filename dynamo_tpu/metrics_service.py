@@ -22,10 +22,14 @@ from aiohttp import web
 
 from dynamo_tpu.kv_router.metrics_aggregator import MetricsAggregator
 from dynamo_tpu.subjects import (
+    FLEET_EVENTS_SUBJECT,
     KV_HIT_RATE_SUBJECT,
     KV_INDEX_SUBJECT,
     PLANNER_SUBJECT,
+    TRACE_SPANS_SUBJECT,
 )
+from dynamo_tpu.telemetry.events import EventRing
+from dynamo_tpu.telemetry.traceplane import TailSampler, TraceAssembler
 
 logger = logging.getLogger(__name__)
 
@@ -157,12 +161,43 @@ class MetricsService:
         port: int = 9091,
         fabric_stats_interval: float = 2.0,
         extra_components: tuple = ("prefill",),
+        trace_sample_rate: Optional[int] = None,
+        trace_window_s: float = 2.0,
+        trace_keep: int = 512,
+        trace_sample_seed: int = 0,
     ):
         self.fabric = fabric
         self.component = component
         self.host = host
         self.port = port
         self.aggregator = MetricsAggregator(fabric, component)
+        #: fleet trace plane (docs/observability.md "Fleet traces &
+        #: event timeline"): assemble every process's shipped spans into
+        #: cross-process traces behind the tail sampler. "Slow" tracks
+        #: the LIVE fleet SLO p95s via _slo_p95s (cached ~5 s).
+        import os as _os
+
+        rate = (
+            trace_sample_rate
+            if trace_sample_rate is not None
+            else int(_os.environ.get("DYNTPU_TRACE_SAMPLE_RATE", "10") or 10)
+        )
+        self.trace_sampler = TailSampler(
+            healthy_rate=rate,
+            seed=trace_sample_seed,
+            slo_p95s=self._slo_p95s,
+        )
+        self.traces = TraceAssembler(
+            sampler=self.trace_sampler,
+            window_s=trace_window_s,
+            keep=trace_keep,
+        )
+        self._slo_p95_cache: tuple[float, dict] = (0.0, {})
+        #: fleet event timeline: bounded ring of control-plane events
+        #: (flips, handovers, shed episodes, planner decisions, replays,
+        #: resyncs, worker losses) served at GET /v1/fleet/events and
+        #: exposed for the Grafana annotation layer
+        self.events = EventRing()
         #: fleet view spans every serving role: one aggregator per
         #: component's subject space (decode pool + disagg prefill pool
         #: by default). The primary keeps its name for back-compat.
@@ -185,6 +220,11 @@ class MetricsService:
         #: gap — partition, fabric outage — not a restart) can be
         #: UN-folded instead of double-counted (see _fold_departed)
         self._ghost_contrib: dict[str, tuple[str, dict]] = {}
+        #: last advertised state per worker (serving/draining/handover)
+        #: — a departure that ANNOUNCED itself (drain, handover: it
+        #: already put its own event on the timeline) must not also
+        #: fire a worker_lost warning when its frames age out
+        self._last_state: dict[str, str] = {}
         # cumulative router-decision counters (KVHitRateEvent stream)
         self.hit_events = 0
         self.isl_tokens_total = 0
@@ -210,10 +250,15 @@ class MetricsService:
         self._sub = None
         self._planner_sub = None
         self._kv_index_sub = None
+        self._trace_sub = None
+        self._events_sub = None
         self._task: Optional[asyncio.Task] = None
         self._kv_index_task: Optional[asyncio.Task] = None
         self._planner_task: Optional[asyncio.Task] = None
         self._stats_task: Optional[asyncio.Task] = None
+        self._trace_task: Optional[asyncio.Task] = None
+        self._events_task: Optional[asyncio.Task] = None
+        self._sweep_task: Optional[asyncio.Task] = None
         self._runner: Optional[web.AppRunner] = None
 
     # -- lifecycle ---------------------------------------------------------
@@ -231,6 +276,17 @@ class MetricsService:
         self._kv_index_task = asyncio.get_running_loop().create_task(
             self._kv_index_pump()
         )
+        self._trace_sub = await self.fabric.subscribe(TRACE_SPANS_SUBJECT)
+        self._trace_task = asyncio.get_running_loop().create_task(
+            self._trace_pump()
+        )
+        self._events_sub = await self.fabric.subscribe(FLEET_EVENTS_SUBJECT)
+        self._events_task = asyncio.get_running_loop().create_task(
+            self._events_pump()
+        )
+        self._sweep_task = asyncio.get_running_loop().create_task(
+            self._trace_sweep_loop()
+        )
         if hasattr(self.fabric, "stats"):
             self._stats_task = asyncio.get_running_loop().create_task(
                 self._poll_fabric_stats()
@@ -239,6 +295,7 @@ class MetricsService:
         app.router.add_get("/metrics", self._metrics)
         app.router.add_get("/health", self._health)
         app.router.add_get("/v1/fleet", self._fleet)
+        app.router.add_get("/v1/fleet/events", self._fleet_events)
         app.router.add_get("/v1/traces", self._traces)
         app.router.add_get("/v1/traces/{trace_id}", self._trace)
         app.router.add_get("/v1/debug/flight", self._debug_flight)
@@ -264,6 +321,16 @@ class MetricsService:
             self._kv_index_sub.close()
         if self._kv_index_task is not None:
             self._kv_index_task.cancel()
+        if self._trace_sub is not None:
+            self._trace_sub.close()
+        if self._trace_task is not None:
+            self._trace_task.cancel()
+        if self._events_sub is not None:
+            self._events_sub.close()
+        if self._events_task is not None:
+            self._events_task.cancel()
+        if self._sweep_task is not None:
+            self._sweep_task.cancel()
         if self._stats_task is not None:
             self._stats_task.cancel()
         for agg in self.aggregators:
@@ -288,6 +355,97 @@ class MetricsService:
             self.hit_events += 1
             self.isl_tokens_total += isl
             self.overlap_tokens_total += overlap
+
+    async def _trace_pump(self) -> None:
+        """Consume shipped span batches into the assembler. A malformed
+        batch is logged and skipped — one garbage publisher must not
+        sever the whole trace plane."""
+        import msgpack
+
+        while True:
+            msg = await self._trace_sub.next()
+            if msg is None:
+                return
+            try:
+                spans = msgpack.unpackb(msg.payload, raw=False)
+                if not isinstance(spans, list):
+                    raise TypeError(f"span batch is {type(spans).__name__}")
+            except Exception:
+                logger.warning("malformed trace.spans batch", exc_info=True)
+                continue
+            try:
+                self.traces.add_spans(spans)
+            except Exception:
+                logger.warning("trace assembly failed", exc_info=True)
+
+    async def _events_pump(self) -> None:
+        """Consume fleet-event batch frames into the bounded ring
+        (garbage batches/frames are dropped — by the unpack guard and
+        EventRing.add respectively — and never kill the pump)."""
+        import msgpack
+
+        while True:
+            msg = await self._events_sub.next()
+            if msg is None:
+                return
+            try:
+                batch = msgpack.unpackb(msg.payload, raw=False)
+                if not isinstance(batch, list):
+                    raise TypeError(
+                        f"event batch is {type(batch).__name__}"
+                    )
+            except Exception:
+                logger.warning(
+                    "malformed fleet.events batch", exc_info=True
+                )
+                continue
+            for ev in batch:
+                self.events.add(ev)
+
+    async def _trace_sweep_loop(self) -> None:
+        """Finalize trace assemblies that went quiet past the window
+        (the tail-sampling decision point)."""
+        interval = max(0.25, self.traces.window_s / 4.0)
+        while True:
+            await asyncio.sleep(interval)
+            try:
+                self.traces.sweep()
+            except Exception:
+                logger.warning("trace sweep failed", exc_info=True)
+
+    def _slo_p95s(self) -> dict:
+        """Live fleet TTFT/e2e p95s for the tail sampler's "slow"
+        thresholds, merged from the workers' SLO wires and cached ~5 s
+        (the sampler calls this per finalized trace). Sketches with too
+        few observations return nothing — a cold fleet must not flag
+        every trace slow off three data points."""
+        import time as _time
+
+        from dynamo_tpu.telemetry import slo as slo_mod
+
+        now = _time.monotonic()
+        cached_at, cached = self._slo_p95_cache
+        if now - cached_at < 5.0:
+            return cached
+        wires = []
+        for iid, (m, age, comp) in self._snapshot_all().items():
+            wire = m.get("slo")
+            if isinstance(wire, dict):
+                wires.append(wire)
+        out: dict = {}
+        try:
+            merged = slo_mod.merge_trackers(wires)
+            for metric in ("ttft_ms", "e2e_ms"):
+                sk = merged.sketches.get(metric)
+                if sk is not None and sk.count >= 50:
+                    q = sk.quantile(0.95)
+                    if q is not None:
+                        out[metric] = float(q)
+        except Exception:
+            logger.warning("slo p95 merge failed", exc_info=True)
+            out = {}
+        self._slo_p95_cache = (now, out)
+        return out
 
     async def _planner_pump(self) -> None:
         """Latest-wins consumer of the planner's status frames. A
@@ -544,6 +702,7 @@ class MetricsService:
                     # worker / handover-stuck rules and fleet_top key
                     # off this
                     w["state"] = state
+                    self._last_state[iid] = state
                 phase = m.get("handover_phase")
                 if isinstance(phase, str):
                     w["handover_phase"] = phase
@@ -769,6 +928,17 @@ class MetricsService:
                 # their old contribution until they truly age out
                 if iid not in snap:
                     self._fold_retired(role, prev)
+                    # fleet event timeline: an UNANNOUNCED disappearance
+                    # is exactly what an incident reconstruction looks
+                    # for. A worker whose last frame said draining/
+                    # handover already put its own event on the timeline
+                    # — a planned wind-down must not cry worker_lost.
+                    last_state = self._last_state.pop(iid, "serving")
+                    if last_state not in ("draining", "handover"):
+                        self.events.add({
+                            "type": "worker_lost", "severity": "warning",
+                            "source": iid, "attrs": {"role": role},
+                        })
                     self._ghost_contrib[iid] = (role, prev)
                     while len(self._ghost_contrib) > 1024:
                         self._ghost_contrib.pop(
@@ -974,7 +1144,11 @@ class MetricsService:
         lines += slo_mod.expose_lines(f"{PREFIX}_fleet", scopes)
         return lines
 
-    def expose(self) -> str:
+    def expose(self, openmetrics: bool = False) -> str:
+        """Classic Prometheus text by default; `openmetrics=True` is
+        the negotiated rendering (OpenMetrics counter naming, `# EOF`,
+        phase-histogram exemplars — classic parsers reject exemplar
+        syntax, so it never rides the text/plain surface)."""
         snap3 = self._snapshot_all()
         assembled = self._assemble_fleet(snap3)
         counts: dict[str, int] = {self.component: 0}
@@ -1011,6 +1185,10 @@ class MetricsService:
         lines += self._fleet_lines(assembled)
         lines += self._planner_lines()
         lines += self._kv_index_lines()
+        # fleet trace plane: assembly/sampling counters + the event-
+        # timeline counter family the Grafana annotation layer queries
+        lines += self.traces.expose_lines(PREFIX)
+        lines += self.events.expose_lines(PREFIX)
         # process-global speculation counters (in-process engines; the
         # per-worker fleet view is dynamo_tpu_worker_spec_* above) —
         # the same families FrontendMetrics exposes, both surfaces
@@ -1027,15 +1205,28 @@ class MetricsService:
         # per-phase latency histograms (telemetry plane, process-global)
         from dynamo_tpu.telemetry import phases
 
-        lines += phases.expose_lines()
+        lines += phases.expose_lines(exemplars=openmetrics)
         # stall-watchdog counters (process-global, usually empty here —
         # the per-worker view is dynamo_tpu_worker_stalls_total above)
         from dynamo_tpu.telemetry.watchdog import stall_counters
 
         lines += stall_counters.expose_lines()
-        return "\n".join(lines) + "\n"
+        text = "\n".join(lines) + "\n"
+        if openmetrics:
+            from dynamo_tpu.telemetry.openmetrics import to_openmetrics
+
+            return to_openmetrics(text)
+        return text
 
     async def _metrics(self, request: web.Request) -> web.Response:
+        from dynamo_tpu.telemetry import openmetrics
+
+        if openmetrics.negotiate(request.headers.get("Accept")):
+            return web.Response(
+                text=self.expose(openmetrics=True),
+                content_type=openmetrics.CONTENT_TYPE,
+                charset="utf-8",
+            )
         return web.Response(
             text=self.expose(), content_type="text/plain", charset="utf-8"
         )
@@ -1052,18 +1243,80 @@ class MetricsService:
         return web.json_response(self.fleet_snapshot())
 
     async def _traces(self, request: web.Request) -> web.Response:
-        from dynamo_tpu.telemetry.http_api import traces_payload
-
-        body, status = traces_payload(request.query.get("limit"))
-        return web.json_response(body, status=status)
+        """GET /v1/traces — the fleet trace SEARCH API over assembled,
+        tail-sampled traces: ?min_ms= &status= &worker= &endpoint=
+        &since= &sort=recent|duration &limit=N. (The per-process rings
+        still serve the same path on each frontend/worker; this surface
+        is the cross-process one.)"""
+        q = request.query
+        try:
+            kwargs = {
+                "min_ms": float(q["min_ms"]) if "min_ms" in q else None,
+                "status": q.get("status"),
+                "worker": q.get("worker"),
+                "endpoint": q.get("endpoint"),
+                "since": float(q["since"]) if "since" in q else None,
+                "sort": q.get("sort", "recent"),
+                "limit": int(q.get("limit", "50")),
+            }
+        except (TypeError, ValueError) as e:
+            return web.json_response(
+                {"error": f"bad query parameter: {e}"}, status=400
+            )
+        if kwargs["sort"] not in ("recent", "duration"):
+            return web.json_response(
+                {"error": "sort must be recent|duration"}, status=400
+            )
+        return web.json_response(
+            {
+                "traces": self.traces.search(**kwargs),
+                "stats": self.traces.stats(),
+                "sample_rate": self.trace_sampler.healthy_rate,
+            }
+        )
 
     async def _trace(self, request: web.Request) -> web.Response:
-        from dynamo_tpu.telemetry.http_api import trace_payload
+        """GET /v1/traces/{id}[?format=chrome] — one ASSEMBLED trace:
+        spans from every process, the timeline breakdown, and the fleet
+        events that overlapped its window."""
+        tid = request.match_info["trace_id"]
+        doc = self.traces.get(tid)
+        if doc is None:
+            return web.json_response(
+                {"error": f"trace {tid!r} not found"}, status=404
+            )
+        if request.query.get("format") == "chrome":
+            from dynamo_tpu.telemetry.chrome_export import to_chrome_trace
 
-        body, status = trace_payload(
-            request.match_info["trace_id"], request.query.get("format")
-        )
-        return web.json_response(body, status=status)
+            return web.json_response(to_chrome_trace(doc["spans"]))
+        summary = doc["summary"]
+        t0 = float(summary.get("start_ts") or 0.0)
+        dur_ms = float(summary.get("duration_ms") or 0.0)
+        doc["events"] = self.events.overlapping(t0, t0 + dur_ms / 1000.0)
+        doc["breakdown"] = (summary or {}).get("breakdown")
+        return web.json_response(doc)
+
+    async def _fleet_events(self, request: web.Request) -> web.Response:
+        """GET /v1/fleet/events — the fleet event timeline:
+        ?since=<id> &since_ts=<epoch> &type= &severity= &source=
+        &limit=N (newest last; `id` is monotonic, tail with since=)."""
+        q = request.query
+        try:
+            kwargs = {
+                "since_id": int(q["since"]) if "since" in q else None,
+                "since_ts": (
+                    float(q["since_ts"]) if "since_ts" in q else None
+                ),
+                "etype": q.get("type"),
+                "severity": q.get("severity"),
+                "source": q.get("source"),
+                "limit": int(q.get("limit", "200")),
+            }
+        except (TypeError, ValueError) as e:
+            return web.json_response(
+                {"error": f"bad query parameter: {e}"}, status=400
+            )
+        return web.json_response({"events": self.events.query(**kwargs)})
 
     # -- debug plane: fleet-wide flight windows + program cost tables ------
     # (the per-worker data rides the metrics frames; docs/observability.md

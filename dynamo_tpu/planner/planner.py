@@ -552,6 +552,16 @@ class ControlRunner:
                "role": role, **extra}
         self.recent.append(rec)
         del self.recent[: -self.RECENT]
+        # fleet event timeline: every actuated decision is an annotation
+        # on the dashboards and a joinable moment for slow traces
+        # (GET /v1/fleet/events); holds are deliberately not events
+        from dynamo_tpu.telemetry import events
+
+        events.record(
+            "planner_decision", source="planner", action=action,
+            **({"role": role} if role else {}),
+            **{k: v for k, v in extra.items() if isinstance(v, (int, str))},
+        )
 
     async def step(self) -> Actions:
         c = self.planner.config

@@ -410,6 +410,21 @@ class PushRouter:
                                         replayed_tokens=len(emitted),
                                         n=replays,
                                     )
+                                    # fleet event timeline: a replayed
+                                    # stream is exactly the kind of
+                                    # moment an incident reconstruction
+                                    # needs on the annotation layer
+                                    from dynamo_tpu.telemetry import (
+                                        events as _events,
+                                    )
+
+                                    _events.record(
+                                        "stream_replay",
+                                        severity="warning",
+                                        source=inst.instance_id,
+                                        replayed_tokens=len(emitted),
+                                        n=replays,
+                                    )
                                     logger.warning(
                                         "replaying stream %s on a survivor "
                                         "(%d tokens already emitted, "

@@ -30,6 +30,20 @@ FLUSH_SUBJECT = "admin.flush"
 #: section of /v1/fleet (doctor's kv-index-drift rule)
 KV_INDEX_SUBJECT = "kv_index.status"
 
+#: finished-span batches (fleet trace plane): every traced process
+#: ships its spans here on the metrics-frame cadence; the metrics
+#: service assembles cross-process traces keyed by trace_id behind a
+#: tail-based sampler and serves them at GET /v1/traces
+#: (docs/observability.md "Fleet traces & event timeline")
+TRACE_SPANS_SUBJECT = "trace.spans"
+
+#: structured fleet events (planner decisions, role flips, handovers,
+#: drains, shed episodes, stream replays, KV resyncs): the metrics
+#: service stores them in a bounded ring served at GET /v1/fleet/events
+#: and exposes dynamo_tpu_fleet_events_total{type,severity} for the
+#: Grafana annotation layer
+FLEET_EVENTS_SUBJECT = "fleet.events"
+
 #: closed-loop planner status frames (ControlRunner.status): targets vs
 #: observed pool sizes, SLO signals, decision counters, recent-decision
 #: ring — the metrics service folds these into dynamo_tpu_planner_* and

@@ -20,7 +20,7 @@
   profile) shared by the frontend and metrics-service mounts.
 """
 
-from dynamo_tpu.telemetry import phases, slo  # noqa: F401
+from dynamo_tpu.telemetry import events, phases, slo  # noqa: F401
 from dynamo_tpu.telemetry.flight import FlightRecorder  # noqa: F401
 from dynamo_tpu.telemetry.watchdog import (  # noqa: F401
     StallWatchdog,
@@ -33,6 +33,7 @@ from dynamo_tpu.telemetry.trace import (  # noqa: F401
     configure,
     context_from_headers,
     current_span,
+    current_trace_id,
     enabled,
     extract,
     get_trace,

@@ -1,0 +1,233 @@
+"""Fleet event timeline: structured control-plane events on the fabric.
+
+The self-healing machinery (planner decisions, role flips, handovers,
+drains, shed episodes, stream replays, KV-index resyncs) used to emit
+only counters — an incident could be graphed but not *reconstructed*.
+This module gives every process one cheap, dependency-free call:
+
+    events.record("role_flip", severity="info", source=instance_id,
+                  src="prefill", dst="decode")
+
+Events land in a bounded process-local buffer; whichever telemetry
+shipper the process runs (the worker's publish loop, the frontend's
+ModelWatcher shipper, the planner service) drains the buffer and
+publishes batches on the `fleet.events` subject. The metrics service
+folds them into a fleet-wide `EventRing` served at
+`GET /v1/fleet/events`, exposed as
+`dynamo_tpu_fleet_events_total{type,severity}` (the Grafana annotation
+layer queries `changes()` over it), and joined to slow traces by time
+window (a kept trace's breakdown names the fleet events that overlapped
+it — docs/observability.md "Fleet traces & event timeline").
+
+`record()` never raises and never blocks beyond a lock; a full buffer
+drops the OLDEST events (the timeline is an operational aid, not a
+ledger). Recording is always on — an event is a control-plane fact,
+not a trace — but costs one dict + list append per occurrence, and the
+noisy per-request sources (shed, replay) coalesce into per-source
+episodes so a 429 storm is one event with a count, not ten thousand.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+#: canonical event type names. The Grafana annotation CI gate
+#: (tests/test_grafana_dashboards.py) validates every annotation
+#: query's `type="..."` matcher against this tuple, so a renamed or
+#: mistyped event can't silently blank an annotation layer.
+EVENT_TYPES = (
+    "planner_decision",   # ControlRunner scale_up/scale_down actuation
+    "role_flip",          # worker flipped prefill<->decode in place
+    "handover",           # live KV migration phase transitions
+    "drain",              # graceful wind-down started (SIGTERM / admin)
+    "worker_lost",        # a worker's frames aged out unannounced
+    "shed",               # load-shed episode (429s, coalesced)
+    "stream_replay",      # a dead worker's stream continued on a survivor
+    "kv_resync",          # KV index gap/drift repaired by resync
+)
+
+SEVERITIES = ("info", "warning", "critical")
+
+#: process-local buffer capacity (events awaiting shipping)
+BUFFER_CAP = 512
+
+_lock = threading.Lock()
+_buffer: deque = deque(maxlen=BUFFER_CAP)
+
+
+def record(
+    etype: str,
+    severity: str = "info",
+    source: str = "",
+    coalesce_s: float = 0.0,
+    **attrs,
+) -> None:
+    """Buffer one fleet event for the process's telemetry shipper.
+
+    `coalesce_s`: if the newest buffered event shares (type, source)
+    and is younger than this, bump its `count` and refresh its attrs
+    instead of appending — per-request sources (shed, replay) become
+    per-episode events. Never raises."""
+    try:
+        now = time.time()
+        if severity not in SEVERITIES:
+            severity = "info"
+        with _lock:
+            if coalesce_s > 0.0 and _buffer:
+                last = _buffer[-1]
+                if (
+                    last["type"] == etype
+                    and last["source"] == source
+                    and now - last["ts"] < coalesce_s
+                ):
+                    last["count"] = int(last.get("count", 1)) + 1
+                    last["severity"] = max(
+                        last["severity"], severity,
+                        key=SEVERITIES.index,
+                    )
+                    last["attrs"].update(attrs)
+                    return
+            _buffer.append(
+                {
+                    "ts": now,
+                    "type": str(etype),
+                    "severity": severity,
+                    "source": str(source),
+                    "count": 1,
+                    "attrs": dict(attrs),
+                }
+            )
+    except Exception:
+        pass  # telemetry must never take down the caller
+
+
+def drain() -> list[dict]:
+    """Pop every buffered event (the shipper's side of the contract)."""
+    with _lock:
+        out = list(_buffer)
+        _buffer.clear()
+    return out
+
+
+def pending() -> int:
+    with _lock:
+        return len(_buffer)
+
+
+def reset() -> None:
+    """Drop buffered events (tests)."""
+    with _lock:
+        _buffer.clear()
+
+
+class EventRing:
+    """Bounded fleet-wide event store at the metrics service.
+
+    Events arrive from `fleet.events` publishes (and locally, e.g. the
+    aggregator's worker_lost detection); each gets a monotonically
+    increasing `id` so `GET /v1/fleet/events?since=<id>` can tail.
+    Eviction is oldest-first; the (type, severity) counters stay
+    monotonic across eviction — they feed the
+    `dynamo_tpu_fleet_events_total` family Grafana's annotation layer
+    queries with `changes()`."""
+
+    def __init__(self, capacity: int = 2048):
+        self.capacity = capacity
+        self._lock = threading.Lock()
+        self._events: deque = deque(maxlen=capacity)
+        self._next_id = 1
+        #: monotonic (type, severity) -> count, eviction-proof
+        self.counters: dict[tuple[str, str], int] = {}
+
+    def add(self, event: dict) -> Optional[dict]:
+        """Validate + store one event; returns the stored copy (with its
+        id) or None when the frame is garbage."""
+        if not isinstance(event, dict):
+            return None
+        etype = event.get("type")
+        if not isinstance(etype, str) or not etype:
+            return None
+        try:
+            ts = float(event.get("ts") or time.time())
+        except (TypeError, ValueError):
+            ts = time.time()
+        severity = event.get("severity")
+        if severity not in SEVERITIES:
+            severity = "info"
+        attrs = event.get("attrs")
+        stored = {
+            "ts": ts,
+            "type": etype,
+            "severity": severity,
+            "source": str(event.get("source") or ""),
+            "count": max(1, int(event.get("count") or 1)),
+            "attrs": dict(attrs) if isinstance(attrs, dict) else {},
+        }
+        with self._lock:
+            stored["id"] = self._next_id
+            self._next_id += 1
+            self._events.append(stored)
+            key = (etype, severity)
+            self.counters[key] = self.counters.get(key, 0) + stored["count"]
+        return stored
+
+    def query(
+        self,
+        since_id: Optional[int] = None,
+        since_ts: Optional[float] = None,
+        etype: Optional[str] = None,
+        severity: Optional[str] = None,
+        source: Optional[str] = None,
+        limit: int = 200,
+    ) -> list[dict]:
+        """Newest-last slice of the ring matching every given filter."""
+        with self._lock:
+            evs = list(self._events)
+        out = []
+        for e in evs:
+            if since_id is not None and e["id"] <= since_id:
+                continue
+            if since_ts is not None and e["ts"] < since_ts:
+                continue
+            if etype is not None and e["type"] != etype:
+                continue
+            if severity is not None and e["severity"] != severity:
+                continue
+            if source is not None and e["source"] != source:
+                continue
+            out.append(e)
+        return out[-limit:] if limit > 0 else []
+
+    def overlapping(
+        self, t0: float, t1: float, pad_s: float = 0.5, limit: int = 32
+    ) -> list[dict]:
+        """Events inside [t0-pad, t1+pad] — the trace<->timeline join:
+        a slow trace's breakdown names the fleet events that were
+        happening while it ran."""
+        with self._lock:
+            evs = list(self._events)
+        hits = [e for e in evs if t0 - pad_s <= e["ts"] <= t1 + pad_s]
+        return hits[-limit:] if limit > 0 else []
+
+    def expose_lines(self, prefix: str = "dynamo_tpu") -> list[str]:
+        """`dynamo_tpu_fleet_events_total{type,severity}` — the Grafana
+        annotation layer's query target (changes() over it marks event
+        moments on the dashboards)."""
+        with self._lock:
+            items = sorted(self.counters.items())
+        if not items:
+            return []
+        name = f"{prefix}_fleet_events_total"
+        lines = [f"# TYPE {name} counter"]
+        for (etype, severity), n in items:
+            lines.append(
+                f'{name}{{type="{etype}",severity="{severity}"}} {n}'
+            )
+        return lines
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._events)

@@ -1,0 +1,50 @@
+"""OpenMetrics rendering of the hand-rolled Prometheus expositions.
+
+The classic 0.0.4 text format has no exemplar syntax — a trailing
+`# {trace_id="..."} v ts` on a bucket line makes the classic parser
+fail the ENTIRE scrape. So exemplars (the heatmap-spike → assembled-
+trace jump, docs/observability.md "Fleet traces & event timeline")
+only ride the OpenMetrics rendering, served when the scraper asks for
+it via content negotiation — which Prometheus does by default
+(`Accept: application/openmetrics-text;version=1.0.0,...`).
+
+`to_openmetrics(classic_text)` converts the classic rendering:
+  - counter families declare their name WITHOUT the `_total` suffix
+    (OpenMetrics names the family `x`; its samples are `x_total`)
+  - the `# EOF` terminator is appended
+Histogram/gauge families and all sample lines pass through unchanged
+(exemplar tails included). `negotiate(accept_header)` decides which
+rendering a request gets.
+"""
+
+from __future__ import annotations
+
+import re
+
+#: the content type OpenMetrics responses declare
+CONTENT_TYPE = "application/openmetrics-text"
+CONTENT_TYPE_FULL = (
+    "application/openmetrics-text; version=1.0.0; charset=utf-8"
+)
+
+_COUNTER_TYPE_RE = re.compile(
+    r"^# TYPE ([a-zA-Z_:][a-zA-Z0-9_:]*)_total counter$"
+)
+
+
+def negotiate(accept_header: str | None) -> bool:
+    """True when the scraper's Accept header asks for OpenMetrics."""
+    return bool(accept_header) and CONTENT_TYPE in accept_header
+
+
+def to_openmetrics(classic_text: str) -> str:
+    """Classic exposition -> OpenMetrics exposition (see module doc)."""
+    out = []
+    for line in classic_text.splitlines():
+        m = _COUNTER_TYPE_RE.match(line)
+        if m is not None:
+            out.append(f"# TYPE {m.group(1)} counter")
+        else:
+            out.append(line)
+    out.append("# EOF")
+    return "\n".join(out) + "\n"

@@ -34,6 +34,7 @@ Phases:
 from __future__ import annotations
 
 import threading
+import time
 
 PREFIX = "dynamo_tpu_phase"
 
@@ -61,56 +62,104 @@ BUCKETS_MS = (
 
 
 class PhaseHistograms:
+    """Counts + sums per phase, plus (tracing only) the newest exemplar
+    per bucket: with a trace_id attached, a bucket observation remembers
+    which TRACE put it there, and the exposition emits it in OpenMetrics
+    exemplar syntax — Grafana jumps from a latency-heatmap spike
+    straight to the assembled trace at GET /v1/traces/{id}. With
+    tracing off no exemplar is ever stored and the exposition is
+    byte-identical to before."""
+
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._counts: dict[str, list[int]] = {}
         self._sums: dict[str, float] = {}
+        #: phase -> bucket index -> (trace_id, value_ms, unix_ts)
+        self._exemplars: dict[str, dict[int, tuple[str, float, float]]] = {}
 
-    def observe(self, phase: str, value_ms: float) -> None:
+    def observe(
+        self, phase: str, value_ms: float, trace_id: str | None = None
+    ) -> None:
         with self._lock:
             counts = self._counts.get(phase)
             if counts is None:
                 counts = self._counts[phase] = [0] * (len(BUCKETS_MS) + 1)
                 self._sums[phase] = 0.0
             self._sums[phase] += value_ms
+            idx = len(BUCKETS_MS)
             for i, b in enumerate(BUCKETS_MS):
                 if value_ms <= b:
-                    counts[i] += 1
-                    return
-            counts[-1] += 1
+                    idx = i
+                    break
+            counts[idx] += 1
+            if trace_id:
+                self._exemplars.setdefault(phase, {})[idx] = (
+                    trace_id, value_ms, time.time(),
+                )
 
     def reset(self) -> None:
         with self._lock:
             self._counts.clear()
             self._sums.clear()
+            self._exemplars.clear()
 
-    def expose_lines(self) -> list[str]:
-        """Prometheus text lines for every phase that has observations."""
+    def expose_lines(self, exemplars: bool = False) -> list[str]:
+        """Prometheus text lines for every phase that has observations.
+        With `exemplars=True` (the OPENMETRICS rendering only — the
+        classic text/plain parser rejects exemplar syntax, which would
+        fail the whole scrape) bucket lines carry the stamped trace:
+        `name_bucket{le="X"} N # {trace_id="..."} value ts`."""
         lines: list[str] = []
         with self._lock:
             for phase in PHASES:
                 counts = self._counts.get(phase)
                 if counts is None:
                     continue
+                ex = self._exemplars.get(phase, {}) if exemplars else {}
                 name = f"{PREFIX}_{phase}"
                 lines.append(f"# TYPE {name} histogram")
                 cum = 0
                 for i, b in enumerate(BUCKETS_MS):
                     cum += counts[i]
-                    lines.append(f'{name}_bucket{{le="{b}"}} {cum}')
+                    lines.append(
+                        f'{name}_bucket{{le="{b}"}} {cum}'
+                        + _exemplar_suffix(ex.get(i))
+                    )
                 cum += counts[-1]
-                lines.append(f'{name}_bucket{{le="+Inf"}} {cum}')
+                lines.append(
+                    f'{name}_bucket{{le="+Inf"}} {cum}'
+                    + _exemplar_suffix(ex.get(len(BUCKETS_MS)))
+                )
                 lines.append(f"{name}_sum {self._sums[phase]}")
                 lines.append(f"{name}_count {cum}")
         return lines
 
 
+def _exemplar_suffix(ex: tuple[str, float, float] | None) -> str:
+    if ex is None:
+        return ""
+    trace_id, value_ms, ts = ex
+    return (
+        f' # {{trace_id="{trace_id}"}} {round(value_ms, 6)} {round(ts, 3)}'
+    )
+
+
 phase_histograms = PhaseHistograms()
 
 
-def observe(phase: str, value_ms: float) -> None:
-    phase_histograms.observe(phase, value_ms)
+def observe(
+    phase: str, value_ms: float, trace_id: str | None = None
+) -> None:
+    """Record one phase observation. `trace_id` stamps the bucket's
+    exemplar; when omitted, the active trace context is used (always
+    None with tracing off — one flag check, no contextvar touch on the
+    disabled path)."""
+    if trace_id is None:
+        from dynamo_tpu.telemetry import trace as _trace
+
+        trace_id = _trace.current_trace_id()
+    phase_histograms.observe(phase, value_ms, trace_id)
 
 
-def expose_lines() -> list[str]:
-    return phase_histograms.expose_lines()
+def expose_lines(exemplars: bool = False) -> list[str]:
+    return phase_histograms.expose_lines(exemplars=exemplars)

@@ -17,6 +17,18 @@ Checks:
   - counters end in `_total` (per the Prometheus naming convention)
   - histograms: per-label-set cumulative buckets are monotonically
     non-decreasing, an `le="+Inf"` bucket exists and equals `_count`
+  - OpenMetrics exemplars (`... # {trace_id="..."} value [ts]`):
+    REJECTED in classic mode — the 0.0.4 parser fails the whole scrape
+    on one — and validated in `lint(text, openmetrics=True)`: only on
+    histogram buckets or counters, valid label syntax, combined
+    label-set length <= 128 runes, numeric value (and timestamp when
+    present), and — for buckets — the exemplar value lies within the
+    bucket's bounds (prev_le, le]. The phase histograms stamp these
+    with kept-trace ids on the negotiated OpenMetrics rendering only
+    (docs/observability.md "Fleet traces & event timeline").
+  - `openmetrics=True` also relaxes counter family naming (OpenMetrics
+    declares the family WITHOUT `_total`; samples keep it) and accepts
+    the `# EOF` terminator.
 """
 
 from __future__ import annotations
@@ -32,12 +44,16 @@ _LABEL_RE = re.compile(
     rf'({_NAME})="((?:[^"\\\n]|\\\\|\\"|\\n)*)"'
 )
 _TYPE_RE = re.compile(rf"^# TYPE ({_NAME}) (\w+)$")
+_EXEMPLAR_RE = re.compile(r"^\{(.*)\}\s+(\S+)(?:\s+(\S+))?$")
 _VALID_TYPES = {"counter", "gauge", "histogram", "summary", "untyped"}
 _SUFFIXES = {
     "histogram": ("_bucket", "_sum", "_count"),
     "summary": ("_sum", "_count"),
     "counter": ("_total", "_created"),
 }
+#: OpenMetrics: an exemplar's label names + values together must not
+#: exceed 128 UTF-8 characters
+_EXEMPLAR_MAX_RUNES = 128
 
 
 def _parse_labels(raw: str, line_no: int, errors: list[str]) -> dict:
@@ -80,13 +96,77 @@ def _family_of(name: str, types: dict[str, str]) -> str | None:
     return None
 
 
-def lint(text: str) -> list[str]:
+def _lint_exemplar(
+    raw: str,
+    line_no: int,
+    name: str,
+    fam: str,
+    ftype: str,
+    sample_labels: dict,
+    errors: list[str],
+    bucket_exemplars: dict,
+) -> None:
+    """Validate one exemplar tail (the part after ` # `). Bucket
+    exemplar values are recorded for the bounds check in the histogram
+    post-pass (the lower bound needs the sorted bucket ladder)."""
+    m = _EXEMPLAR_RE.match(raw.strip())
+    if m is None:
+        errors.append(
+            f"line {line_no}: malformed exemplar {raw[:60]!r}"
+        )
+        return
+    is_bucket = ftype == "histogram" and name == fam + "_bucket"
+    if not is_bucket and ftype != "counter":
+        errors.append(
+            f"line {line_no}: exemplar on a {ftype} sample {name!r} "
+            "(only histogram buckets and counters may carry exemplars)"
+        )
+        return
+    labels = _parse_labels(m.group(1), line_no, errors)
+    runes = sum(len(k) + len(v) for k, v in labels.items())
+    if runes > _EXEMPLAR_MAX_RUNES:
+        errors.append(
+            f"line {line_no}: exemplar label set is {runes} runes "
+            f"(OpenMetrics caps it at {_EXEMPLAR_MAX_RUNES})"
+        )
+    try:
+        value = float(m.group(2))
+    except ValueError:
+        errors.append(
+            f"line {line_no}: non-numeric exemplar value {m.group(2)!r}"
+        )
+        return
+    if m.group(3) is not None:
+        try:
+            float(m.group(3))
+        except ValueError:
+            errors.append(
+                f"line {line_no}: non-numeric exemplar timestamp "
+                f"{m.group(3)!r}"
+            )
+    if is_bucket:
+        le = sample_labels.get("le")
+        if le is not None:
+            lev = math.inf if le == "+Inf" else float(le)
+            key = tuple(
+                sorted(
+                    (k, v) for k, v in sample_labels.items() if k != "le"
+                )
+            )
+            bucket_exemplars.setdefault(fam, {}).setdefault(
+                key, []
+            ).append((lev, value, line_no))
+
+
+def lint(text: str, openmetrics: bool = False) -> list[str]:
     errors: list[str] = []
     types: dict[str, str] = {}
     seen_sample_of: set[str] = set()
     # histogram state: family -> {label-key-without-le: [(le, cum), ...]}
     buckets: dict[str, dict[tuple, list[tuple[float, float]]]] = {}
     counts: dict[str, dict[tuple, float]] = {}
+    # exemplar state: family -> {key: [(le, exemplar value, line)]}
+    bucket_exemplars: dict[str, dict[tuple, list[tuple]]] = {}
 
     for i, line in enumerate(text.splitlines(), start=1):
         if not line.strip():
@@ -112,12 +192,17 @@ def lint(text: str) -> list[str]:
                         "samples"
                     )
                 types[fam] = t
-                if t == "counter" and not fam.endswith("_total"):
+                if (
+                    t == "counter"
+                    and not fam.endswith("_total")
+                    and not openmetrics
+                ):
                     errors.append(
                         f"line {i}: counter {fam!r} must end in '_total'"
                     )
-            continue  # other comments (# HELP) are fine
-        m = _METRIC_RE.match(line)
+            continue  # other comments (# HELP, # EOF) are fine
+        base, _, exemplar = line.partition(" # ")
+        m = _METRIC_RE.match(base)
         if m is None:
             errors.append(f"line {i}: unparseable sample {line!r:.80}")
             continue
@@ -139,6 +224,19 @@ def lint(text: str) -> list[str]:
             )
             continue
         seen_sample_of.add(fam)
+        if exemplar:
+            if not openmetrics:
+                # the classic 0.0.4 parser fails the WHOLE scrape on an
+                # exemplar tail — it must never reach that surface
+                errors.append(
+                    f"line {i}: exemplar on a classic text-format "
+                    "exposition (OpenMetrics-only syntax)"
+                )
+            else:
+                _lint_exemplar(
+                    exemplar, i, name, fam, types[fam], labels, errors,
+                    bucket_exemplars,
+                )
         if types[fam] == "histogram":
             key = tuple(
                 sorted((k, v) for k, v in labels.items() if k != "le")
@@ -178,5 +276,22 @@ def lint(text: str) -> list[str]:
                     errors.append(
                         f"{fam}{dict(key)}: _count {total} != +Inf "
                         f"bucket {pairs[-1][1]}"
+                    )
+            # exemplar bounds: each bucket's exemplar value must lie in
+            # (prev_le, le] of the sorted ladder (a tiny tolerance
+            # absorbs the exposition's value rounding)
+            ladder = [le for le, _ in pairs]
+            for le, exval, line_no in bucket_exemplars.get(fam, {}).get(
+                key, ()
+            ):
+                if le not in ladder:
+                    continue  # bucket itself already flagged above
+                idx = ladder.index(le)
+                prev_le = ladder[idx - 1] if idx > 0 else -math.inf
+                if exval > le + 1e-9 or exval <= prev_le - 1e-6:
+                    errors.append(
+                        f"{fam}{dict(key)}: exemplar value {exval} on "
+                        f"bucket le={le} (line {line_no}) is outside "
+                        f"the bucket's bounds ({prev_le}, {le}]"
                     )
     return errors

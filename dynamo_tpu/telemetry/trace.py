@@ -57,6 +57,8 @@ __all__ = [
     "enabled",
     "span",
     "current_span",
+    "current_trace_id",
+    "set_sink",
     "wire_context",
     "inject",
     "extract",
@@ -272,6 +274,11 @@ class _Tracer:
         )
         self.enabled = toggled or ring_size > 0
         self.ring = TraceRing(ring_size if ring_size > 0 else 256)
+        #: optional finished-span sink beside the ring — the fleet trace
+        #: plane's ship buffer (telemetry/traceplane.py) registers here
+        #: so every finished span can ride the fabric to the metrics
+        #: service. None (the default) costs one attribute read.
+        self.sink = None
 
     def configure(
         self,
@@ -289,6 +296,12 @@ class _Tracer:
     def record(self, span_dict: dict) -> None:
         if self.enabled:
             self.ring.record(span_dict)
+            sink = self.sink
+            if sink is not None:
+                try:
+                    sink(span_dict)
+                except Exception:
+                    pass  # shipping must never break span recording
 
 
 _tracer = _Tracer()
@@ -313,6 +326,22 @@ def reset() -> None:
 
 def current_span() -> Optional[Span]:
     return _current.get()
+
+
+def current_trace_id() -> Optional[str]:
+    """The active trace id, or None (always None when tracing is off) —
+    the exemplar hook for the phase histograms: one enabled-flag check
+    plus a contextvar read, cheap enough for per-observe use."""
+    if not _tracer.enabled:
+        return None
+    cur = _current.get()
+    return cur.trace_id if cur is not None else None
+
+
+def set_sink(sink) -> None:
+    """Register (or clear, with None) the finished-span sink the fleet
+    trace plane ships from. At most one sink; last call wins."""
+    _tracer.sink = sink
 
 
 def _resolve_parent(parent: Any) -> tuple[Optional[str], Optional[str]]:
@@ -439,7 +468,9 @@ def record_span_dict(span_dict: Any) -> None:
     tid = span_dict.get("trace_id")
     if not (isinstance(tid, str) and _HEX32.match(tid)):
         return
-    _tracer.ring.record(span_dict)
+    # through record(), not the ring directly: adopted spans must reach
+    # the fleet trace plane's ship sink like locally-finished ones
+    _tracer.record(span_dict)
 
 
 def get_trace(trace_id: str) -> Optional[list[dict]]:

@@ -1,0 +1,797 @@
+"""Fleet trace plane: span shipping, cross-process assembly, tail-based
+sampling, and per-trace timeline breakdowns.
+
+PR 4 gave every process a private trace ring — one request's spans end
+up scattered across the frontend's, the router's, and each worker's
+ring, so "show me the assembled trace of last night's p99 request" was
+unanswerable. This module closes the loop:
+
+- **Shipping** (every traced process): finished spans land in a bounded
+  ship buffer via the trace module's sink hook; the process's telemetry
+  shipper (the worker's publish loop, the frontend's ModelWatcher
+  shipper, the planner service) drains it on the metrics-frame cadence
+  and publishes msgpack batches on the `trace.spans` subject. Fleet
+  events (telemetry/events.py) ride the same shipper on `fleet.events`.
+
+- **Assembly** (metrics service): `TraceAssembler` groups incoming
+  spans by trace_id, waits a quiet window for stragglers (the child's
+  span frame arrives after the finish frame; a disagg prefill span
+  crosses a queue hop), then finalizes the trace through the
+  tail sampler. Memory is bounded twice: at most `max_open` in-flight
+  assemblies (oldest evicted first, finalized as `incomplete` rather
+  than dropped silently) and at most `keep` kept traces (LRU).
+
+- **Tail sampling**: `TailSampler` keeps 100% of anomalous traces —
+  error/4xx/5xx finishes, deadline expiries, stream replays, retry/
+  mark_down dispatches, overloaded bounces, TTFT/e2e above the fleet's
+  live SLO-sketch p95, incomplete assemblies — plus a deterministic
+  seeded 1-in-N of healthy traffic, so the kept set is small but the
+  interesting traces are always in it.
+
+- **Breakdown**: `breakdown(spans)` partitions the root span's wall
+  time into queue_wait / prefill / transfer / decode / decode_stall /
+  dispatch / preprocess / replay_gap / other from the span tree — the
+  machine-readable "where did this request's time go" that
+  `GET /v1/traces/{id}` serves and doctor's slow-trace-attribution
+  rule folds into its report.
+
+Everything is default-off-safe: with tracing disabled nothing is
+buffered or shipped and the token path is bit-identical (pinned in
+tests/test_trace_plane.py).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import OrderedDict, deque
+from typing import Any, Callable, Iterable, Optional
+
+from dynamo_tpu.telemetry import events as events_mod
+from dynamo_tpu.telemetry import trace as trace_mod
+
+__all__ = [
+    "TailSampler",
+    "TraceAssembler",
+    "TelemetryShipper",
+    "breakdown",
+    "drain_spans",
+    "ensure_shipping",
+    "pending_spans",
+    "ship_once",
+    "summarize",
+]
+
+#: ship-buffer capacity (finished spans awaiting publish); overflow
+#: drops the OLDEST spans — their trace assembles `incomplete`, which
+#: the sampler keeps, so loss is visible rather than silent
+SHIP_BUFFER_CAP = 4096
+
+_ship_lock = threading.Lock()
+_ship_buffer: deque = deque(maxlen=SHIP_BUFFER_CAP)
+_shipping_registered = False
+
+
+def _sink(span_dict: dict) -> None:
+    with _ship_lock:
+        _ship_buffer.append(span_dict)
+
+
+def ensure_shipping() -> None:
+    """Register the ship buffer as the trace module's span sink (idempotent).
+    Costs nothing while tracing is disabled — the sink is only invoked
+    for recorded spans."""
+    global _shipping_registered
+    if not _shipping_registered:
+        trace_mod.set_sink(_sink)
+        _shipping_registered = True
+
+
+def disable_shipping() -> None:
+    """Unregister + drop the buffer (tests)."""
+    global _shipping_registered
+    trace_mod.set_sink(None)
+    _shipping_registered = False
+    with _ship_lock:
+        _ship_buffer.clear()
+
+
+def drain_spans() -> list[dict]:
+    with _ship_lock:
+        out = list(_ship_buffer)
+        _ship_buffer.clear()
+    return out
+
+
+def pending_spans() -> int:
+    with _ship_lock:
+        return len(_ship_buffer)
+
+
+async def ship_once(fabric, source: str = "") -> None:
+    """Publish any buffered spans + fleet events. One batch per subject
+    per call (the metrics-frame cadence keeps batches small). A failed
+    publish drops the batch — the trace assembles incomplete and the
+    sampler keeps it, which is the honest degradation."""
+    import msgpack
+
+    from dynamo_tpu.subjects import (
+        FLEET_EVENTS_SUBJECT,
+        TRACE_SPANS_SUBJECT,
+    )
+
+    spans = drain_spans()
+    if spans:
+        try:
+            await fabric.publish(
+                TRACE_SPANS_SUBJECT,
+                {"source": source, "count": len(spans)},
+                msgpack.packb(spans, use_bin_type=True, default=repr),
+            )
+        except Exception:
+            pass  # dropped batch -> incomplete trace, kept by the sampler
+    events = events_mod.drain()
+    if events:
+        # one batch frame, like the spans — a coalesced 429 storm must
+        # not serialize hundreds of publish round-trips on this loop
+        try:
+            await fabric.publish(
+                FLEET_EVENTS_SUBJECT,
+                {"source": source, "count": len(events)},
+                msgpack.packb(events, use_bin_type=True, default=repr),
+            )
+        except Exception:
+            pass
+
+
+class TelemetryShipper:
+    """Background shipping loop for processes without a metrics publish
+    loop of their own (the HTTP frontend, the planner service). The
+    worker piggybacks `ship_once` on its existing `_publish_loop`
+    instead."""
+
+    def __init__(self, fabric, source: str = "", interval_s: float = 1.0):
+        self.fabric = fabric
+        self.source = source
+        self.interval_s = interval_s
+        self._task = None
+
+    def start(self) -> None:
+        import asyncio
+
+        ensure_shipping()
+        self._task = asyncio.get_running_loop().create_task(self._run())
+
+    async def _run(self) -> None:
+        import asyncio
+
+        while True:
+            await asyncio.sleep(self.interval_s)
+            await ship_once(self.fabric, self.source)
+
+    async def stop(self, flush: bool = True) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+        if flush:
+            await ship_once(self.fabric, self.source)
+
+
+# -- the span-tree breakdown ----------------------------------------------
+
+#: breakdown phase names, in presentation order
+BREAKDOWN_PHASES = (
+    "preprocess", "dispatch", "queue_wait", "prefill", "transfer",
+    "decode", "decode_stall", "replay_gap", "other",
+)
+
+#: span names that count as one worker-side "attempt" (a replayed
+#: stream has several; the gaps between them are replay_gap)
+_ATTEMPT_NAMES = ("engine.generate", "worker.generate", "child.generate")
+
+
+def _span_end_ts(s: dict) -> float:
+    start = float(s.get("start_ts") or 0.0)
+    dur = s.get("duration_ms")
+    return start + (float(dur) / 1000.0 if dur else 0.0)
+
+
+def _first_token_ts(s: dict) -> Optional[float]:
+    for ev in s.get("events") or ():
+        if isinstance(ev, dict) and ev.get("name") == "first_token":
+            try:
+                return float(ev["ts"])
+            except (KeyError, TypeError, ValueError):
+                return None
+    return None
+
+
+def _root_of(spans: list[dict]) -> Optional[dict]:
+    ids = {s.get("span_id") for s in spans}
+    roots = [s for s in spans if s.get("parent_id") not in ids]
+    if not roots:
+        roots = spans
+    for r in roots:
+        if r.get("name") == "http.request":
+            return r
+    return min(
+        roots, key=lambda s: float(s.get("start_ts") or 0.0), default=None
+    )
+
+
+def _attempts_of(spans: list[dict]) -> list[dict]:
+    """Worker-side attempt spans, deepest available level first:
+    engine.generate where present (the jax/external path), else
+    worker.generate (mock workers), else child.generate."""
+    for name in _ATTEMPT_NAMES:
+        hits = [s for s in spans if s.get("name") == name]
+        if hits:
+            return sorted(
+                hits, key=lambda s: float(s.get("start_ts") or 0.0)
+            )
+    return []
+
+
+def breakdown(spans: list[dict]) -> Optional[dict]:
+    """Partition the root span's wall time into phases, from the span
+    tree alone. The phases sum to total_ms exactly (`other` absorbs the
+    un-attributed remainder; cross-process clock skew that would push
+    the sum past the total is clipped and reported as skew_ms) — the
+    reconciliation the acceptance test pins to ±1 ms."""
+    spans = [s for s in spans if isinstance(s, dict)]
+    root = _root_of(spans)
+    if root is None:
+        return None
+    t0 = float(root.get("start_ts") or 0.0)
+    total = float(root.get("duration_ms") or 0.0)
+    if total <= 0.0:
+        total = max(
+            (_span_end_ts(s) for s in spans), default=t0
+        ) - t0
+        total *= 1000.0
+    phases = {p: 0.0 for p in BREAKDOWN_PHASES}
+
+    for s in spans:
+        if s.get("name") == "preprocess" and s.get("duration_ms"):
+            phases["preprocess"] += float(s["duration_ms"])
+
+    attempts = _attempts_of(spans)
+    # remote-prefill hand-offs, attributed inside their enclosing attempt
+    remote = [s for s in spans if s.get("name") == "disagg.remote_prefill"]
+    remote_prefill = [s for s in spans if s.get("name") == "disagg.prefill"]
+
+    for s in attempts:
+        a0 = float(s.get("start_ts") or 0.0)
+        dur = float(s.get("duration_ms") or 0.0)
+        ft = _first_token_ts(s)
+        pre_ms = (
+            max(0.0, (ft - a0) * 1000.0) if ft is not None else dur
+        )
+        pre_ms = min(pre_ms, dur)
+        attrs = s.get("attrs") or {}
+        qw = min(pre_ms, max(0.0, float(attrs.get("queue_wait_ms") or 0.0)))
+        # transfer: the decode-side hand-off window minus the prefill
+        # compute nested inside it (the queue ride + KV landing)
+        transfer = 0.0
+        rprefill = 0.0
+        for r in remote:
+            r0 = float(r.get("start_ts") or 0.0)
+            if not (a0 <= r0 <= _span_end_ts(s) + 1e-9):
+                continue
+            rdur = float(r.get("duration_ms") or 0.0)
+            nested = sum(
+                float(p.get("duration_ms") or 0.0)
+                for p in remote_prefill
+                if r0 <= float(p.get("start_ts") or 0.0)
+                <= _span_end_ts(r) + 1e-9
+            )
+            rprefill += min(nested, rdur)
+            transfer += max(0.0, rdur - nested)
+        transfer = min(transfer, max(0.0, pre_ms - qw))
+        prefill = (
+            min(rprefill, max(0.0, pre_ms - qw - transfer))
+            if rprefill
+            else max(0.0, pre_ms - qw - transfer)
+        )
+        decode_win = max(0.0, dur - pre_ms)
+        stall = min(
+            decode_win,
+            max(0.0, float(attrs.get("decode_stall_ms") or 0.0)),
+        )
+        phases["queue_wait"] += qw
+        phases["transfer"] += transfer
+        phases["prefill"] += prefill
+        phases["decode_stall"] += stall
+        phases["decode"] += decode_win - stall
+        # whatever of the pre-token window queue_wait+transfer+prefill
+        # did not explain (disagg queue wait happens remotely) stays in
+        # prefill via the else-branch above — nothing is dropped
+
+    for a, b in zip(attempts, attempts[1:]):
+        gap = (float(b.get("start_ts") or 0.0) - _span_end_ts(a)) * 1000.0
+        if gap > 0.0:
+            phases["replay_gap"] += gap
+
+    # router overhead: dispatch start -> first attempt start (pick,
+    # connect, retries, backoff) — disjoint from the attempt windows
+    dispatches = [s for s in spans if s.get("name") == "router.dispatch"]
+    if dispatches and attempts:
+        d0 = min(float(s.get("start_ts") or 0.0) for s in dispatches)
+        a0 = float(attempts[0].get("start_ts") or 0.0)
+        phases["dispatch"] = max(0.0, (a0 - d0) * 1000.0)
+    elif dispatches:
+        phases["dispatch"] = sum(
+            float(s.get("duration_ms") or 0.0) for s in dispatches
+        )
+
+    attributed = sum(phases.values())
+    skew_ms = 0.0
+    if attributed > total:
+        # cross-process clock skew (or overlapping spans) pushed the
+        # parts past the whole: scale down proportionally so the
+        # partition invariant holds, and report the excess honestly
+        skew_ms = attributed - total
+        if attributed > 0.0:
+            scale = total / attributed
+            for k in phases:
+                phases[k] *= scale
+        attributed = total
+    phases["other"] = max(0.0, total - attributed)
+
+    ranked = sorted(
+        ((k, v) for k, v in phases.items() if k != "other" and v > 0.0),
+        key=lambda kv: kv[1], reverse=True,
+    )
+    return {
+        "total_ms": round(total, 3),
+        "phases": {k: round(v, 3) for k, v in phases.items()},
+        "dominant": ranked[0][0] if ranked else None,
+        "attempts": len(attempts),
+        **({"skew_ms": round(skew_ms, 3)} if skew_ms else {}),
+    }
+
+
+def summarize(trace_id: str, spans: list[dict]) -> dict:
+    """Search-index row for one assembled trace: endpoint/status/worker
+    facets + the breakdown, computed once at finalize time."""
+    root = _root_of(spans) or {}
+    attrs = root.get("attrs") or {}
+    workers: set[str] = set()
+    services: set[str] = set()
+    ttft_ms = None
+    t0 = float(root.get("start_ts") or 0.0)
+    for s in spans:
+        services.add(str(s.get("service") or "?"))
+        a = s.get("attrs") or {}
+        for key in ("instance_id", "chosen"):
+            v = a.get(key)
+            if isinstance(v, str) and v:
+                workers.add(v)
+        if ttft_ms is None and s.get("name") in _ATTEMPT_NAMES:
+            ft = _first_token_ts(s)
+            if ft is not None and t0:
+                ttft_ms = max(0.0, (ft - t0) * 1000.0)
+    if attrs.get("ttft_ms") is not None:
+        try:
+            ttft_ms = float(attrs["ttft_ms"])
+        except (TypeError, ValueError):
+            pass
+    status = attrs.get("http_status")
+    if status is None:
+        status = (
+            "error"
+            if any(s.get("status") not in (None, "ok") for s in spans)
+            else "ok"
+        )
+    return {
+        "trace_id": trace_id,
+        "root": root.get("name"),
+        "start_ts": t0,
+        "duration_ms": root.get("duration_ms"),
+        "status": str(status),
+        "endpoint": attrs.get("endpoint"),
+        "model": attrs.get("model"),
+        "ttft_ms": round(ttft_ms, 3) if ttft_ms is not None else None,
+        "spans": len(spans),
+        "services": sorted(services),
+        "workers": sorted(workers),
+        "breakdown": breakdown(spans),
+    }
+
+
+# -- tail-based sampling ---------------------------------------------------
+
+#: span event names that mark a trace anomalous, -> keep reason
+_ANOMALY_EVENTS = {
+    "replay": "replay",
+    "mark_down": "retry",
+    "overloaded": "overloaded",
+}
+
+
+def _healthy_hash(trace_id: str, seed: int) -> int:
+    import xxhash
+
+    return xxhash.xxh64_intdigest(trace_id.encode(), seed=seed)
+
+
+class TailSampler:
+    """Keep decision over an ASSEMBLED trace (that is what makes it
+    tail-based: the decision runs after the outcome is known, not at
+    the root). `slo_p95s` is an injected callable returning the live
+    fleet p95s ({"ttft_ms": ..., "e2e_ms": ...}, empty when cold) so
+    "slow" tracks the fleet's actual distribution, not a static
+    threshold; static floors can be layered on via slow_ttft_ms /
+    slow_e2e_ms."""
+
+    def __init__(
+        self,
+        healthy_rate: int = 10,
+        seed: int = 0,
+        slo_p95s: Optional[Callable[[], dict]] = None,
+        slow_ttft_ms: Optional[float] = None,
+        slow_e2e_ms: Optional[float] = None,
+    ):
+        self.healthy_rate = max(0, int(healthy_rate))
+        self.seed = int(seed)
+        self.slo_p95s = slo_p95s
+        self.slow_ttft_ms = slow_ttft_ms
+        self.slow_e2e_ms = slow_e2e_ms
+
+    def decide(
+        self,
+        trace_id: str,
+        spans: list[dict],
+        incomplete: bool = False,
+        summary: Optional[dict] = None,
+    ) -> tuple[bool, list[str]]:
+        """-> (keep, reasons). Anomalies always keep; a healthy trace
+        keeps iff its seeded hash lands in the 1-in-N slot (deterministic
+        across restarts and across assemblers sharing the seed).
+        `summary` lets the assembler pass its precomputed summarize()
+        so a finalize does the O(spans) breakdown work once."""
+        reasons: list[str] = []
+        if incomplete:
+            reasons.append("incomplete")
+        root = _root_of(spans) or {}
+        attrs = root.get("attrs") or {}
+        status = attrs.get("http_status")
+        try:
+            if status is not None and int(status) >= 400:
+                reasons.append(f"http_{int(status)}")
+        except (TypeError, ValueError):
+            pass
+        for s in spans:
+            if s.get("status") not in (None, "ok"):
+                reasons.append("error")
+                break
+        for s in spans:
+            for ev in s.get("events") or ():
+                name = ev.get("name") if isinstance(ev, dict) else None
+                reason = _ANOMALY_EVENTS.get(name)
+                if reason is not None and reason not in reasons:
+                    reasons.append(reason)
+                elif (
+                    isinstance(name, str)
+                    and "deadline" in name
+                    and "deadline" not in reasons
+                ):
+                    reasons.append("deadline")
+        if summary is None:
+            summary = summarize(trace_id, spans)
+        p95s = {}
+        if self.slo_p95s is not None:
+            try:
+                p95s = self.slo_p95s() or {}
+            except Exception:
+                p95s = {}
+        ttft = summary.get("ttft_ms")
+        thr_ttft = _min_defined(p95s.get("ttft_ms"), self.slow_ttft_ms)
+        if ttft is not None and thr_ttft is not None and ttft > thr_ttft:
+            reasons.append("slow_ttft")
+        e2e = summary.get("duration_ms")
+        thr_e2e = _min_defined(p95s.get("e2e_ms"), self.slow_e2e_ms)
+        if e2e is not None and thr_e2e is not None and float(e2e) > thr_e2e:
+            reasons.append("slow_e2e")
+        if reasons:
+            return True, reasons
+        if (
+            self.healthy_rate > 0
+            and _healthy_hash(trace_id, self.seed) % self.healthy_rate == 0
+        ):
+            return True, ["healthy_sample"]
+        return False, []
+
+
+def _min_defined(*vals: Optional[float]) -> Optional[float]:
+    xs = [float(v) for v in vals if v is not None]
+    return min(xs) if xs else None
+
+
+# -- cross-process assembly ------------------------------------------------
+
+
+class TraceAssembler:
+    """Group shipped spans by trace_id, finalize after a quiet window,
+    sample, keep. Thread-safe (the metrics service's pump task and its
+    HTTP handlers share it).
+
+    Bounds: `max_open` concurrent assemblies (evicting the LRU one
+    finalizes it immediately as incomplete=likely — never a silent
+    drop), `keep` kept traces, MAX_SPANS_PER_TRACE spans each."""
+
+    MAX_SPANS_PER_TRACE = 512
+
+    def __init__(
+        self,
+        sampler: Optional[TailSampler] = None,
+        window_s: float = 2.0,
+        max_age_s: float = 30.0,
+        max_open: int = 2048,
+        keep: int = 512,
+        now_fn: Callable[[], float] = time.monotonic,
+    ):
+        self.sampler = sampler or TailSampler(
+            healthy_rate=int(
+                os.environ.get("DYNTPU_TRACE_SAMPLE_RATE", "10") or 10
+            )
+        )
+        self.window_s = window_s
+        self.max_age_s = max_age_s
+        self.max_open = max_open
+        self.keep = keep
+        self.now_fn = now_fn
+        self._lock = threading.Lock()
+        #: trace_id -> [spans, first_seen, last_seen, span_id_set]
+        self._open: "OrderedDict[str, list]" = OrderedDict()
+        #: trace_id -> {"summary", "spans", "kept_reasons", "incomplete"}
+        self._kept: "OrderedDict[str, dict]" = OrderedDict()
+        # counters (exposed as dynamo_tpu_trace_* on the metrics service)
+        self.spans_received = 0
+        self.kept_total: dict[str, int] = {}
+        self.dropped_total = 0
+        self.incomplete_total = 0
+        self.evicted_total = 0
+
+    # -- ingest ------------------------------------------------------------
+
+    def add_spans(self, spans: Iterable[Any]) -> None:
+        now = self.now_fn()
+        evict: list[tuple[str, list]] = []
+        with self._lock:
+            for s in spans:
+                if not isinstance(s, dict):
+                    continue
+                tid = s.get("trace_id")
+                if not isinstance(tid, str) or not tid:
+                    continue
+                self.spans_received += 1
+                entry = self._open.get(tid)
+                if entry is None:
+                    if tid in self._kept:
+                        # straggler after finalize: attach to the kept
+                        # trace so late child frames don't vanish
+                        self._attach_straggler(tid, s)
+                        continue
+                    entry = self._open[tid] = [[], now, now, set()]
+                    while len(self._open) > self.max_open:
+                        old_tid, old = self._open.popitem(last=False)
+                        self.evicted_total += 1
+                        evict.append((old_tid, old))
+                if len(entry[0]) < self.MAX_SPANS_PER_TRACE:
+                    entry[0].append(s)
+                    sid = s.get("span_id")
+                    if isinstance(sid, str):
+                        entry[3].add(sid)
+                entry[2] = now
+                self._open.move_to_end(tid)
+        for tid, entry in evict:
+            self._finalize(tid, entry, forced=True)
+
+    @staticmethod
+    def _spans_incomplete(spans: list[dict]) -> bool:
+        """The structural half of _is_incomplete, reusable after
+        straggler attach: more (or fewer) than one root, or a
+        mark_down event (a worker vanished mid-trace)."""
+        ids = {s.get("span_id") for s in spans}
+        roots = sum(
+            1
+            for s in spans
+            if s.get("parent_id") is None or s.get("parent_id") not in ids
+        )
+        if roots != 1:
+            return True
+        for s in spans:
+            for ev in s.get("events") or ():
+                if isinstance(ev, dict) and ev.get("name") == "mark_down":
+                    return True
+        return False
+
+    def _attach_straggler(self, tid: str, s: dict) -> None:
+        """A span arriving AFTER its trace finalized (a shipper on a
+        slower cadence than the assembly window): attach it, and
+        re-evaluate the incomplete flag — the straggler may be exactly
+        the missing stitch, and a now-complete trace must stop reading
+        as a lost one. Caller holds the lock."""
+        doc = self._kept[tid]
+        if len(doc["spans"]) >= self.MAX_SPANS_PER_TRACE:
+            return
+        doc["spans"].append(s)
+        if doc["incomplete"] and not self._spans_incomplete(doc["spans"]):
+            doc["incomplete"] = False
+            self.incomplete_total = max(0, self.incomplete_total - 1)
+        doc["summary"] = {
+            **summarize(tid, doc["spans"]),
+            "kept_reasons": doc["kept_reasons"],
+            "incomplete": doc["incomplete"],
+        }
+
+    # -- finalize ----------------------------------------------------------
+
+    def _is_incomplete(self, entry: list) -> bool:
+        """A trace is incomplete when a subtree lost its stitch (some
+        span's parent never arrived, beyond the one remote root a
+        traceparent header explains) or a worker vanished mid-trace
+        (a mark_down event: a SIGKILLed worker's in-flight spans never
+        end, so they never ship) — the signatures of lost spans."""
+        return self._spans_incomplete(entry[0])
+
+    def sweep(self) -> int:
+        """Finalize assemblies quiet past the window (or alive past
+        max_age). Returns how many finalized."""
+        now = self.now_fn()
+        done: list[tuple[str, list]] = []
+        with self._lock:
+            for tid, entry in list(self._open.items()):
+                if (
+                    now - entry[2] >= self.window_s
+                    or now - entry[1] >= self.max_age_s
+                ):
+                    done.append((tid, entry))
+                    del self._open[tid]
+        for tid, entry in done:
+            self._finalize(tid, entry, forced=False)
+        return len(done)
+
+    def flush(self) -> None:
+        """Finalize everything now (tests / shutdown)."""
+        with self._lock:
+            done = list(self._open.items())
+            self._open.clear()
+        for tid, entry in done:
+            self._finalize(tid, entry, forced=False)
+
+    def _finalize(self, tid: str, entry: list, forced: bool) -> None:
+        spans = entry[0]
+        if not spans:
+            return
+        incomplete = forced or self._is_incomplete(entry)
+        # one summarize() (it owns the O(spans) breakdown) serves both
+        # the sampling decision and the kept doc
+        summary = summarize(tid, spans)
+        keep, reasons = self.sampler.decide(
+            tid, spans, incomplete, summary=summary
+        )
+        if incomplete:
+            self.incomplete_total += 1
+        if not keep:
+            self.dropped_total += 1
+            return
+        reason = reasons[0] if reasons else "healthy_sample"
+        with self._lock:
+            self.kept_total[reason] = self.kept_total.get(reason, 0) + 1
+            self._kept[tid] = {
+                "summary": {
+                    **summary,
+                    "kept_reasons": reasons,
+                    "incomplete": incomplete,
+                },
+                "spans": spans,
+                "kept_reasons": reasons,
+                "incomplete": incomplete,
+            }
+            while len(self._kept) > self.keep:
+                self._kept.popitem(last=False)
+
+    # -- queries -----------------------------------------------------------
+
+    def get(self, trace_id: str) -> Optional[dict]:
+        with self._lock:
+            doc = self._kept.get(trace_id)
+            if doc is not None:
+                return {
+                    "trace_id": trace_id,
+                    "spans": list(doc["spans"]),
+                    "summary": dict(doc["summary"]),
+                    "kept_reasons": list(doc["kept_reasons"]),
+                    "incomplete": doc["incomplete"],
+                }
+            entry = self._open.get(trace_id)
+            if entry is not None:
+                # still assembling: serve what exists, honestly flagged
+                return {
+                    "trace_id": trace_id,
+                    "spans": list(entry[0]),
+                    "summary": summarize(trace_id, list(entry[0])),
+                    "kept_reasons": [],
+                    "incomplete": True,
+                    "assembling": True,
+                }
+        return None
+
+    def search(
+        self,
+        min_ms: Optional[float] = None,
+        status: Optional[str] = None,
+        worker: Optional[str] = None,
+        endpoint: Optional[str] = None,
+        since: Optional[float] = None,
+        sort: str = "recent",
+        limit: int = 50,
+    ) -> list[dict]:
+        """Kept-trace summaries matching every given filter. sort:
+        `recent` (newest kept first) or `duration` (slowest first) —
+        the worst-trace query doctor and fleet_top ride."""
+        with self._lock:
+            docs = [dict(d["summary"]) for d in self._kept.values()]
+        out = []
+        for s in docs:
+            dur = s.get("duration_ms")
+            if min_ms is not None and (dur is None or dur < min_ms):
+                continue
+            if status is not None and str(s.get("status")) != status:
+                continue
+            if worker is not None and worker not in (s.get("workers") or ()):
+                continue
+            if endpoint is not None and s.get("endpoint") != endpoint:
+                continue
+            if since is not None and float(s.get("start_ts") or 0) < since:
+                continue
+            out.append(s)
+        if sort == "duration":
+            out.sort(key=lambda s: float(s.get("duration_ms") or 0.0),
+                     reverse=True)
+        else:
+            out.reverse()  # kept order is oldest-first
+        return out[: max(0, limit)]
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "spans_received_total": self.spans_received,
+                "kept_total": dict(self.kept_total),
+                "dropped_total": self.dropped_total,
+                "incomplete_total": self.incomplete_total,
+                "evicted_total": self.evicted_total,
+                "open": len(self._open),
+                "kept": len(self._kept),
+            }
+
+    def expose_lines(self, prefix: str = "dynamo_tpu") -> list[str]:
+        st = self.stats()
+        lines = [
+            f"# TYPE {prefix}_trace_spans_received_total counter",
+            f"{prefix}_trace_spans_received_total "
+            f"{st['spans_received_total']}",
+            f"# TYPE {prefix}_traces_kept_total counter",
+        ]
+        for reason, n in sorted(st["kept_total"].items()):
+            lines.append(
+                f'{prefix}_traces_kept_total{{reason="{reason}"}} {n}'
+            )
+        if not st["kept_total"]:
+            lines.append(
+                f'{prefix}_traces_kept_total{{reason="healthy_sample"}} 0'
+            )
+        lines += [
+            f"# TYPE {prefix}_traces_dropped_total counter",
+            f"{prefix}_traces_dropped_total {st['dropped_total']}",
+            f"# TYPE {prefix}_traces_incomplete_total counter",
+            f"{prefix}_traces_incomplete_total {st['incomplete_total']}",
+            f"# TYPE {prefix}_trace_assembler_open gauge",
+            f"{prefix}_trace_assembler_open {st['open']}",
+            f"# TYPE {prefix}_trace_assembler_kept gauge",
+            f"{prefix}_trace_assembler_kept {st['kept']}",
+        ]
+        return lines

@@ -36,6 +36,7 @@ from dynamo_tpu.subjects import (
     KVBM_TIER_SUBJECT,
     METRICS_SUBJECT,
 )
+from dynamo_tpu import telemetry
 
 logger = logging.getLogger(__name__)
 
@@ -338,6 +339,12 @@ class Worker:
                 self.endpoint_name,
             )
             await self._peer_source.start()
+        # fleet trace plane: finished spans buffer for shipping on the
+        # metrics-frame cadence (no-op while tracing is off); fleet
+        # events (flips, handovers, drains) ride the same shipper
+        from dynamo_tpu.telemetry import traceplane
+
+        traceplane.ensure_shipping()
         loop = asyncio.get_running_loop()
         self._tasks.append(loop.create_task(self._publish_loop()))
         logger.info(
@@ -385,6 +392,15 @@ class Worker:
             "worker %s draining (budget %.1fs, %d in flight)",
             self.instance_id, budget, self.ingress.num_inflight,
         )
+        telemetry.events.record(
+            "drain", source=self.instance_id,
+            inflight=self.ingress.num_inflight, budget_s=budget,
+        )
+        # ship NOW, not on the next publish tick — a quiet drain exits
+        # before the tick and would take its own timeline entry with it
+        from dynamo_tpu.telemetry import traceplane
+
+        await traceplane.ship_once(self.runtime.fabric, self.instance_id)
         await self._deregister()
         clean = True
         deadline = asyncio.get_running_loop().time() + max(budget, 0.0)
@@ -518,6 +534,12 @@ class Worker:
                 "worker %s flipped to %s (flip #%d)",
                 self.instance_id, self.role, self.flips,
             )
+            telemetry.events.record(
+                "role_flip", source=self.instance_id,
+                dst=self.role,
+                src="decode" if self.role == "prefill" else "prefill",
+                flips=self.flips,
+            )
             return True
 
     async def _flip_handler(self, ctx, request):
@@ -599,6 +621,15 @@ class Worker:
             "worker %s handing over (%d in flight)",
             self.instance_id, self.ingress.num_inflight,
         )
+        telemetry.events.record(
+            "handover", source=self.instance_id, phase="start",
+            successor=successor_id, inflight=self.ingress.num_inflight,
+        )
+        # ship immediately: the retiring process exits at the end of
+        # this method — its timeline entries must not die with it
+        from dynamo_tpu.telemetry import traceplane
+
+        await traceplane.ship_once(self.runtime.fabric, self.instance_id)
         await self._deregister()
         ok = False
         try:
@@ -610,12 +641,20 @@ class Worker:
         if ok:
             self.handovers += 1
             logger.info("worker %s handover complete", self.instance_id)
+            telemetry.events.record(
+                "handover", source=self.instance_id, phase="complete",
+                bytes=self.handover_bytes, blocks=self.handover_blocks,
+            )
         else:
             self.handover_fallbacks += 1
             logger.warning(
                 "worker %s handover fell back to plain drain (streams "
                 "continue on survivors by replay-with-recompute)",
                 self.instance_id,
+            )
+            telemetry.events.record(
+                "handover", severity="warning", source=self.instance_id,
+                phase="fallback",
             )
         self._handover_phase = "finish"
         budget = self.drain_budget_s if budget_s is None else budget_s
@@ -631,6 +670,9 @@ class Worker:
         self._handover_phase = None
         self.handing_over = False
         self.drained.set()
+        # flush the complete/fallback event (and any final spans)
+        # before the host process exits
+        await traceplane.ship_once(self.runtime.fabric, self.instance_id)
         return ok
 
     async def _pick_successor(self, successor_id: Optional[str]):
@@ -1533,3 +1575,8 @@ class Worker:
                 f"{METRICS_SUBJECT}.{pub_component}.{self.instance_id}",
                 m,
             )
+        # fleet trace plane: ship buffered spans + fleet events on the
+        # same cadence as the metrics frames (empty -> no publish)
+        from dynamo_tpu.telemetry import traceplane
+
+        await traceplane.ship_once(fabric, self.instance_id)

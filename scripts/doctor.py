@@ -67,6 +67,13 @@ Rules (each emits severity + worker + evidence + suggested action):
                        off its cost-model roofline (GET /v1/debug/
                        programs) — host-loop overhead, not the chip, is
                        the limit (ROADMAP item 3)
+  slow-trace-          the N worst KEPT traces (metrics service
+  attribution          GET /v1/traces?sort=duration — the tail sampler
+                       keeps every anomalous trace) are dominated by an
+                       actionable phase: queue_wait -> scale the pool /
+                       cap admission, transfer -> check the disagg
+                       planes, dispatch -> router retries, decode_stall
+                       -> enable mixed steps, replay_gap -> worker churn
 
 `diagnose()` is pure (snapshots in, findings out) and unit-tested
 against recorded snapshots in tests/test_doctor.py. Dependency-free
@@ -115,6 +122,45 @@ OSCILLATION_WINDOW_FLOOR_S = 60.0
 #: handover drain-fallbacks (exceeding completions) before the
 #: fallback-storm rule fires
 FALLBACK_STORM_COUNT = 3
+#: worst kept traces the slow-trace-attribution rule examines
+TRACE_WORST_N = 5
+#: a phase must explain at least this share of a trace's wall time to
+#: count as its dominant phase for attribution
+TRACE_DOMINANT_SHARE = 0.4
+#: traces shorter than this never attribute — a 2 ms admin call is
+#: trivially "dominated" by whatever it did, not a latency problem
+TRACE_MIN_TOTAL_MS = 50.0
+#: dominant-phase -> what to do about it. decode/prefill-dominant slow
+#: traces are just long generations — not findings.
+TRACE_PHASE_ACTIONS = {
+    "queue_wait": (
+        "requests spend their time waiting for admission — scale the "
+        "pool up (planner --mode closed does this on burn) or enable "
+        "admission caps (--max-waiting / --max-inflight) so excess "
+        "load answers 429 instead of queueing"
+    ),
+    "transfer": (
+        "the disagg KV hand-off dominates — check which transfer plane "
+        "requests actually ride (dynamo_tpu_worker_kv_transfer_*: a "
+        "device/shm plane silently falling back to inline host doubles "
+        "the hand-off) and the prefill queue depth"
+    ),
+    "dispatch": (
+        "router dispatch overhead dominates — workers are refusing or "
+        "down (read the router.dispatch spans' mark_down/overloaded "
+        "events and retry_backoff_ms in the kept traces)"
+    ),
+    "decode_stall": (
+        "prefill-induced decode stalls dominate — enable mixed steps "
+        "(drop --no-mixed-steps) so decode rows keep emitting while "
+        "prompt bursts drain (docs/engine.md 'Mixed steps')"
+    ),
+    "replay_gap": (
+        "time lost between stream-replay attempts dominates — workers "
+        "are dying mid-stream; GET /v1/fleet/events names the kills/"
+        "handovers these traces overlapped"
+    ),
+}
 
 
 def _finding(severity: str, rule: str, worker: Optional[str], summary: str,
@@ -135,9 +181,11 @@ def diagnose(
     fleet: dict,
     flight: Optional[dict] = None,
     programs: Optional[dict] = None,
+    traces: Optional[dict] = None,
 ) -> list[dict]:
-    """Pure rule pass: (/v1/fleet, /v1/debug/flight, /v1/debug/programs)
-    snapshots -> ordered findings (severity: critical > warning > info)."""
+    """Pure rule pass: (/v1/fleet, /v1/debug/flight, /v1/debug/programs,
+    /v1/traces) snapshots -> ordered findings (severity: critical >
+    warning > info)."""
     findings: list[dict] = []
     workers = (fleet or {}).get("workers") or {}
     roles = (fleet or {}).get("roles") or {}
@@ -397,6 +445,7 @@ def diagnose(
 
     findings.extend(_kv_index_rules((fleet or {}).get("kv_index")))
     findings.extend(_planner_rules((fleet or {}).get("planner")))
+    findings.extend(_trace_rules(traces, workers))
 
     for iid, p in sorted(((programs or {}).get("workers") or {}).items()):
         for kind, k in sorted((p.get("kinds") or {}).items()):
@@ -423,6 +472,63 @@ def diagnose(
 
     order = {"critical": 0, "warning": 1, "info": 2}
     findings.sort(key=lambda f: (order.get(f["severity"], 9), str(f["worker"])))
+    return findings
+
+
+def _trace_rules(traces: Optional[dict], workers: dict) -> list[dict]:
+    """slow-trace-attribution: attribute each of the N worst KEPT traces
+    (the fleet trace plane's GET /v1/traces, tail-sampled so anomalies
+    are all there) to its dominant breakdown phase; actionable dominant
+    phases fold into one finding per phase, naming the traces and — when
+    the traces agree on a pool — the role to act on."""
+    findings: list[dict] = []
+    if not isinstance(traces, dict):
+        return findings
+    kept = [t for t in traces.get("traces") or [] if isinstance(t, dict)]
+    kept.sort(
+        key=lambda t: float(t.get("duration_ms") or 0.0), reverse=True
+    )
+    by_phase: dict[str, list[dict]] = {}
+    for t in kept[:TRACE_WORST_N]:
+        bd = t.get("breakdown") or {}
+        total = float(bd.get("total_ms") or 0.0)
+        dominant = bd.get("dominant")
+        if not dominant or total < TRACE_MIN_TOTAL_MS:
+            continue
+        share = float((bd.get("phases") or {}).get(dominant) or 0.0) / total
+        if share < TRACE_DOMINANT_SHARE:
+            continue
+        if dominant in TRACE_PHASE_ACTIONS:
+            by_phase.setdefault(dominant, []).append(t)
+    for phase, ts in sorted(by_phase.items()):
+        roles = {
+            str((workers.get(w) or {}).get("role"))
+            for t in ts
+            for w in t.get("workers") or ()
+            if w in workers
+        } - {"None"}
+        pool = (
+            f" on the {next(iter(roles))} pool" if len(roles) == 1 else ""
+        )
+        worst = ts[0]
+        findings.append(_finding(
+            "warning", "slow-trace-attribution", None,
+            f"{len(ts)} of the {min(TRACE_WORST_N, len(kept))} worst "
+            f"kept traces are dominated by {phase}{pool} (worst: "
+            f"{worst.get('trace_id')} at "
+            f"{float(worst.get('duration_ms') or 0):.0f} ms, "
+            f"{float((worst.get('breakdown') or {}).get('phases', {}).get(phase) or 0):.0f} ms "
+            f"in {phase})",
+            {"phase": phase, "roles": sorted(roles),
+             "traces": [
+                 {"trace_id": t.get("trace_id"),
+                  "duration_ms": t.get("duration_ms"),
+                  "kept_reasons": t.get("kept_reasons"),
+                  "breakdown": (t.get("breakdown") or {}).get("phases")}
+                 for t in ts
+             ]},
+            TRACE_PHASE_ACTIONS[phase],
+        ))
     return findings
 
 
@@ -635,6 +741,10 @@ def main(argv=None) -> int:
         help="recorded /v1/debug/programs JSON file instead of fetching",
     )
     ap.add_argument(
+        "--traces", default=None,
+        help="recorded /v1/traces JSON file instead of fetching",
+    )
+    ap.add_argument(
         "--json", action="store_true",
         help="emit the findings as JSON instead of the text report",
     )
@@ -655,7 +765,18 @@ def main(argv=None) -> int:
         load(args.programs) if args.programs
         else (_fetch(args.url, "/v1/debug/programs") if not args.snapshot else {})
     )
-    findings = diagnose(fleet, flight or {}, programs or {})
+    traces = (
+        load(args.traces) if args.traces
+        else (
+            _fetch(
+                args.url,
+                f"/v1/traces?sort=duration&limit={2 * TRACE_WORST_N}",
+            )
+            if not args.snapshot
+            else {}
+        )
+    )
+    findings = diagnose(fleet, flight or {}, programs or {}, traces or {})
     if args.json:
         print(json.dumps(findings, indent=2))
     else:

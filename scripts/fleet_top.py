@@ -7,14 +7,20 @@ who want the fleet at a glance without Grafana:
     python scripts/fleet_top.py --url http://127.0.0.1:9091
     python scripts/fleet_top.py --watch 2
     python scripts/fleet_top.py --snapshot artifacts/fleet.json  # offline
+    python scripts/fleet_top.py --events            # fleet event timeline
+    python scripts/fleet_top.py --events --watch 2  # tail it
 
 Per worker: role, model, req/s, tok/s, TTFT/ITL p50/p95, KV-pool %,
 live MFU, jit compiles, stall count (dynamo_tpu_stalls_total, via the
 worker frames' stalls_total), SLO burn rate (shortest attainment
-window), last_seen age. Fleet footer: merged percentiles, SLA
-attainment + burn rates, goodput. Dependency-free (urllib only);
-`render()` is a pure function smoke-tested against a recorded snapshot
-in tests/test_fleet_telemetry.py.
+window), the worst KEPT trace touching the worker (fleet trace plane,
+GET /v1/traces — its id pastes straight into /v1/traces/{id}),
+last_seen age. Fleet footer: merged percentiles, SLA attainment + burn
+rates, goodput. `--events` tails GET /v1/fleet/events instead — one
+severity-colored line per control-plane event (flips, handovers, shed
+episodes, replays, resyncs, planner decisions). Dependency-free
+(urllib only); `render()` / `render_events()` are pure functions
+smoke-tested against recorded snapshots in tests/test_fleet_telemetry.py.
 """
 
 from __future__ import annotations
@@ -48,19 +54,42 @@ def _worker_burn(slo: dict):
     return (windows[shortest] or {}).get("burn_rate")
 
 
-def render(snap: dict) -> str:
-    """Pure snapshot -> text table (no I/O; unit-testable)."""
+def _worst_traces_by_worker(traces) -> dict:
+    """worker id -> (trace_id, duration_ms) of the slowest kept trace
+    that touched it (fleet trace plane summaries)."""
+    worst: dict = {}
+    for t in traces or ():
+        if not isinstance(t, dict):
+            continue
+        dur = t.get("duration_ms")
+        if dur is None:
+            continue
+        for w in t.get("workers") or ():
+            cur = worst.get(w)
+            if cur is None or dur > cur[1]:
+                worst[w] = (str(t.get("trace_id") or ""), float(dur))
+    return worst
+
+
+def render(snap: dict, traces=None) -> str:
+    """Pure snapshot -> text table (no I/O; unit-testable). `traces`
+    is the metrics service's kept-trace summary list (GET /v1/traces);
+    the WORST-TRACE column shows the slowest kept trace touching each
+    worker as `<id prefix> <ms>`."""
     cols = (
         ("WORKER", 22), ("ROLE", 8), ("MODEL", 12), ("REQ/S", 7),
         ("TOK/S", 8), ("TTFT p50/p95", 14), ("ITL p50/p95", 12),
         ("KV%", 6), ("WM", 6), ("MFU", 7), ("COMP", 5), ("PREEMPT", 7),
-        ("SPEC%", 6), ("STALLS", 6), ("BURN", 6), ("AGE s", 6),
+        ("SPEC%", 6), ("STALLS", 6), ("BURN", 6), ("WORST-TRACE", 16),
+        ("AGE s", 6),
     )
+    worst = _worst_traces_by_worker(traces)
     out = [" ".join(f"{h:<{w}}" for h, w in cols)]
     for iid, w in sorted((snap.get("workers") or {}).items()):
         slo = w.get("slo") or {}
         kv = w.get("kv_usage")
         burn = _worker_burn(slo)
+        wt = worst.get(iid)
         row = (
             iid[:22], w.get("role", "?"), str(w.get("model", "?"))[:12],
             _fmt(w.get("req_s")), _fmt(w.get("tok_s")),
@@ -84,6 +113,7 @@ def render(snap: dict) -> str:
             ),
             _fmt(w.get("stalls_total"), 0),
             _fmt(burn, 1, "x") if burn is not None else "-",
+            f"{wt[0][:8]} {wt[1]:.0f}ms" if wt else "-",
             _fmt(w.get("last_seen_s")),
         )
         out.append(
@@ -129,8 +159,44 @@ def render(snap: dict) -> str:
     return "\n".join(out)
 
 
-def fetch(url: str) -> dict:
-    with urllib.request.urlopen(f"{url}/v1/fleet", timeout=5) as resp:
+#: severity -> ANSI color for the --events timeline
+_SEV_COLORS = {"info": "\x1b[36m", "warning": "\x1b[33m",
+               "critical": "\x1b[31m"}
+_RESET = "\x1b[0m"
+
+
+def render_events(events, color: bool = True) -> str:
+    """Pure event list (GET /v1/fleet/events order: newest last) ->
+    one line per event, severity-colored: time, type, source, count,
+    compact attrs."""
+    lines = []
+    for e in events or ():
+        if not isinstance(e, dict):
+            continue
+        sev = str(e.get("severity") or "info")
+        ts = time.strftime(
+            "%H:%M:%S", time.localtime(float(e.get("ts") or 0.0))
+        )
+        count = int(e.get("count") or 1)
+        attrs = " ".join(
+            f"{k}={v}" for k, v in sorted((e.get("attrs") or {}).items())
+        )
+        head = f"{e.get('type', '?'):<16}"
+        if color:
+            head = f"{_SEV_COLORS.get(sev, '')}{head}{_RESET}"
+        lines.append(
+            f"{ts} {sev[:4]:<4} {head} "
+            f"{str(e.get('source') or '-'):<22}"
+            + (f" x{count}" if count > 1 else "")
+            + (f"  {attrs}" if attrs else "")
+        )
+    if not lines:
+        lines = ["(no fleet events)"]
+    return "\n".join(lines)
+
+
+def fetch(url: str, path: str = "/v1/fleet") -> dict:
+    with urllib.request.urlopen(f"{url}{path}", timeout=5) as resp:
         return json.loads(resp.read().decode())
 
 
@@ -148,21 +214,59 @@ def main(argv=None) -> int:
         "--snapshot", default=None,
         help="render a recorded snapshot JSON file instead of fetching",
     )
+    ap.add_argument(
+        "--events", action="store_true",
+        help="render the fleet event timeline (GET /v1/fleet/events) "
+             "instead of the worker table",
+    )
+    ap.add_argument(
+        "--no-color", action="store_true",
+        help="disable ANSI severity colors in --events output",
+    )
     args = ap.parse_args(argv)
     while True:
-        if args.snapshot:
-            with open(args.snapshot) as f:
-                snap = json.load(f)
-        else:
+        if args.events:
             try:
-                snap = fetch(args.url)
+                doc = fetch(args.url, "/v1/fleet/events")
             except Exception as e:
-                print(f"fetch {args.url}/v1/fleet failed: {e}", file=sys.stderr)
+                print(
+                    f"fetch {args.url}/v1/fleet/events failed: {e}",
+                    file=sys.stderr,
+                )
                 if not args.watch:
                     return 1
                 time.sleep(args.watch)
                 continue
-        text = render(snap)
+            text = render_events(
+                doc.get("events"), color=not args.no_color
+            )
+        else:
+            if args.snapshot:
+                with open(args.snapshot) as f:
+                    snap = json.load(f)
+                traces = None
+            else:
+                try:
+                    snap = fetch(args.url)
+                except Exception as e:
+                    print(
+                        f"fetch {args.url}/v1/fleet failed: {e}",
+                        file=sys.stderr,
+                    )
+                    if not args.watch:
+                        return 1
+                    time.sleep(args.watch)
+                    continue
+                try:
+                    # kept-trace summaries feed the WORST-TRACE column;
+                    # an older metrics service without the trace plane
+                    # just loses the column, never the table
+                    traces = fetch(
+                        args.url, "/v1/traces?sort=duration&limit=64"
+                    ).get("traces")
+                except Exception:
+                    traces = None
+            text = render(snap, traces=traces)
         if args.watch:
             print("\x1b[2J\x1b[H" + text, flush=True)
             time.sleep(args.watch)

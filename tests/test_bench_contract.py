@@ -191,6 +191,23 @@ def test_cpu_fallback_line_is_labeled_and_carries_tpu_artifact():
     assert ki["modeled_overhead_pct"] < 1.0, ki
     assert ki["measured_overhead_pct"] is not None, ki
     assert ki["measured_overhead_pct"] < 30.0, ki
+    # fleet trace plane A/B (ISSUE 14): span shipping + exemplar
+    # stamping priced <1% by the deterministic model (per-span ship
+    # microbench + per-observe exemplar delta x the MEASURED
+    # spans/token and observes/token of a live traced drive); the
+    # interleaved wall A/B gets the same generous sanity band as the
+    # other telemetry A/Bs.
+    tp = ex["trace_plane_overhead"]
+    assert "error" not in tp, tp
+    assert tp["trace_plane_on_tok_s"] > 0, tp
+    assert tp["trace_plane_off_tok_s"] > 0, tp
+    assert tp["ship_us_per_span"] > 0, tp
+    assert tp["spans_per_token"] > 0, tp
+    assert tp["observes_per_token"] > 0, tp
+    assert tp["modeled_overhead_pct"] is not None, tp
+    assert tp["modeled_overhead_pct"] < 1.0, tp
+    assert tp["measured_overhead_pct"] is not None, tp
+    assert tp["measured_overhead_pct"] < 30.0, tp
 
 
 def test_bench_http_counts_failures_instead_of_raising():

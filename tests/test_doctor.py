@@ -490,3 +490,82 @@ def test_kv_index_drift_rule_severities():
     assert drift_findings(
         {"workers": {}, "roles": {}, "fleet": {"workers": 0}}
     ) == []
+
+
+def _trace_summary(tid, total, dominant, phases, workers, reasons=None):
+    return {
+        "trace_id": tid, "duration_ms": total, "workers": workers,
+        "kept_reasons": reasons or ["slow_e2e"],
+        "breakdown": {
+            "total_ms": total, "dominant": dominant, "phases": phases,
+        },
+    }
+
+
+def test_slow_trace_attribution_rule():
+    """slow-trace-attribution (fleet trace plane): the worst kept
+    traces' dominant phases fold into one actionable finding per phase
+    — 'p99 dominated by queue_wait on the decode pool -> scale decode'
+    — while decode-dominant (just long) traces stay quiet."""
+    doctor = _load_doctor()
+    fleet = {
+        "workers": {
+            "w-dec": {"role": "decode", "last_seen_s": 0.2,
+                      "tok_s": 800.0, "kv_total_pages": 512},
+        },
+        "roles": {}, "fleet": {"workers": 1},
+    }
+
+    def rule_findings(traces):
+        return [
+            f for f in doctor.diagnose(fleet, {}, {}, traces)
+            if f["rule"] == "slow-trace-attribution"
+        ]
+
+    # queue_wait-dominated worst traces on the decode pool -> one
+    # warning naming the phase, the pool, and the worst trace id
+    traces = {"traces": [
+        _trace_summary("a1" * 16, 5000.0, "queue_wait",
+                       {"queue_wait": 4000.0, "decode": 1000.0},
+                       ["w-dec"]),
+        _trace_summary("b2" * 16, 3000.0, "queue_wait",
+                       {"queue_wait": 2000.0, "decode": 1000.0},
+                       ["w-dec"]),
+        _trace_summary("c3" * 16, 400.0, "decode", {"decode": 400.0},
+                       ["w-dec"], reasons=["healthy_sample"]),
+    ]}
+    (f,) = rule_findings(traces)
+    assert f["severity"] == "warning"
+    assert "queue_wait" in f["summary"]
+    assert "decode pool" in f["summary"]
+    assert "a1" * 16 in f["summary"]  # the worst trace is named
+    assert "scale" in f["action"]
+    assert len(f["evidence"]["traces"]) == 2
+
+    # decode-dominant traces are just long generations: no finding
+    assert rule_findings({"traces": [
+        _trace_summary("d4" * 16, 9000.0, "decode", {"decode": 9000.0},
+                       ["w-dec"]),
+    ]}) == []
+
+    # a dominant phase below the share floor does not attribute
+    assert rule_findings({"traces": [
+        _trace_summary("e5" * 16, 1000.0, "queue_wait",
+                       {"queue_wait": 200.0, "decode": 150.0,
+                        "prefill": 150.0, "other": 500.0},
+                       ["w-dec"]),
+    ]}) == []
+
+    # transfer-dominated -> the disagg-plane action, no pool suffix
+    # when workers span roles unknown to the snapshot
+    (t,) = rule_findings({"traces": [
+        _trace_summary("f6" * 16, 2000.0, "transfer",
+                       {"transfer": 1500.0, "decode": 500.0},
+                       ["w-unknown"]),
+    ]})
+    assert "transfer plane" in t["action"]
+    assert "the  pool" not in t["summary"]  # no half-formed pool suffix
+
+    # absent/garbage trace docs: quiet
+    assert rule_findings(None) == []
+    assert rule_findings({"traces": "garbage"}) == []

@@ -1,0 +1,141 @@
+"""Fleet event timeline (ISSUE 14): process-local recording +
+coalescing, the metrics service's bounded EventRing, query filters,
+and the annotation-layer exposition."""
+
+import threading
+
+from dynamo_tpu.telemetry import events
+from dynamo_tpu.telemetry.events import EVENT_TYPES, EventRing
+
+
+def setup_function(_fn):
+    events.reset()
+
+
+def teardown_function(_fn):
+    events.reset()
+
+
+def test_record_and_drain_roundtrip():
+    events.record("role_flip", source="w1", src="prefill", dst="decode")
+    events.record(
+        "handover", severity="warning", source="w2", phase="fallback"
+    )
+    assert events.pending() == 2
+    evs = events.drain()
+    assert events.pending() == 0
+    assert [e["type"] for e in evs] == ["role_flip", "handover"]
+    assert evs[0]["attrs"] == {"src": "prefill", "dst": "decode"}
+    assert evs[1]["severity"] == "warning"
+    assert all(e["count"] == 1 for e in evs)
+    # garbage severity degrades to info, never raises
+    events.record("drain", severity="shouting", source="w3")
+    assert events.drain()[0]["severity"] == "info"
+
+
+def test_coalescing_folds_bursts_into_episodes():
+    for _ in range(50):
+        events.record(
+            "shed", severity="warning", source="frontend:burn",
+            coalesce_s=60.0, reason="burn",
+        )
+    # a different source never folds into the episode
+    events.record(
+        "shed", severity="warning", source="frontend:inflight",
+        coalesce_s=60.0, reason="frontend_inflight",
+    )
+    evs = events.drain()
+    assert len(evs) == 2
+    assert evs[0]["count"] == 50
+    assert evs[1]["count"] == 1
+    # coalescing never upgrades severity downward
+    events.record("shed", severity="info", source="s", coalesce_s=60.0)
+    events.record("shed", severity="critical", source="s", coalesce_s=60.0)
+    assert events.drain()[0]["severity"] == "critical"
+
+
+def test_buffer_is_bounded_oldest_dropped():
+    for i in range(events.BUFFER_CAP + 100):
+        events.record("drain", source=f"w{i}")
+    evs = events.drain()
+    assert len(evs) == events.BUFFER_CAP
+    assert evs[0]["source"] == "w100"  # oldest 100 dropped
+
+
+def test_record_is_thread_safe():
+    def pump():
+        for _ in range(200):
+            events.record("kv_resync", source="t")
+
+    threads = [threading.Thread(target=pump) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert events.pending() == min(800, events.BUFFER_CAP)
+
+
+def test_ring_ids_counters_and_query_filters():
+    ring = EventRing(capacity=4)
+    for i, etype in enumerate(
+        ("role_flip", "shed", "shed", "worker_lost", "drain")
+    ):
+        ring.add({
+            "ts": 100.0 + i, "type": etype,
+            "severity": "warning" if etype != "drain" else "info",
+            "source": f"w{i}", "count": 2 if etype == "shed" else 1,
+        })
+    # bounded: 5 added, capacity 4 -> oldest evicted
+    assert len(ring) == 4
+    # but the counters stay monotonic across eviction
+    assert ring.counters[("role_flip", "warning")] == 1
+    assert ring.counters[("shed", "warning")] == 4  # 2 events x count 2
+    # ids are monotonic; since_id tails
+    evs = ring.query()
+    ids = [e["id"] for e in evs]
+    assert ids == sorted(ids)
+    tail = ring.query(since_id=ids[-2])
+    assert [e["id"] for e in tail] == ids[-1:]
+    # filters compose (the evicted role_flip is gone from the ring but
+    # not from the counters above)
+    assert ring.query(etype="role_flip") == []
+    assert [e["type"] for e in ring.query(etype="shed")] == ["shed", "shed"]
+    assert ring.query(severity="info")[0]["type"] == "drain"
+    assert ring.query(source="w3")[0]["type"] == "worker_lost"
+    assert ring.query(since_ts=103.5)[0]["type"] == "drain"
+    # limit=0 means none, not all
+    assert ring.query(limit=0) == []
+    assert ring.overlapping(0.0, 1000.0, limit=0) == []
+    # garbage frames are rejected, not raised
+    assert ring.add(None) is None
+    assert ring.add({"no_type": 1}) is None
+    assert ring.add({"type": "x", "ts": "yesterday"}) is not None
+
+
+def test_overlapping_joins_by_time_window():
+    ring = EventRing()
+    ring.add({"ts": 10.0, "type": "role_flip", "source": "w1"})
+    ring.add({"ts": 20.0, "type": "shed", "source": "f"})
+    ring.add({"ts": 40.0, "type": "drain", "source": "w2"})
+    hits = ring.overlapping(19.0, 21.0)
+    assert [e["type"] for e in hits] == ["shed"]
+    # the pad catches events just outside the trace window
+    hits = ring.overlapping(20.4, 21.0, pad_s=0.5)
+    assert [e["type"] for e in hits] == ["shed"]
+    assert ring.overlapping(100.0, 101.0) == []
+
+
+def test_exposition_matches_annotation_layer_contract():
+    from dynamo_tpu.telemetry import promlint
+
+    ring = EventRing()
+    for etype in EVENT_TYPES:
+        ring.add({"type": etype, "severity": "info", "source": "w"})
+    lines = ring.expose_lines()
+    text = "\n".join(lines) + "\n"
+    assert promlint.lint(text) == []
+    for etype in EVENT_TYPES:
+        assert any(f'type="{etype}"' in l for l in lines)
+    # empty ring: no family at all (the metrics service's exposition
+    # stays lint-clean either way)
+    assert EventRing().expose_lines() == []

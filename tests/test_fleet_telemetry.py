@@ -496,8 +496,59 @@ def _load_fleet_top():
     return mod
 
 
+#: recorded kept-trace summaries (metrics service GET /v1/traces) for
+#: the WORST-TRACE column
+RECORDED_TRACES = [
+    {"trace_id": "aa11" * 8, "duration_ms": 4200.5,
+     "workers": ["worker-decode-1"], "kept_reasons": ["slow_e2e"],
+     "breakdown": {"total_ms": 4200.5, "dominant": "queue_wait",
+                   "phases": {"queue_wait": 3000.0, "decode": 1200.5}}},
+    {"trace_id": "bb22" * 8, "duration_ms": 900.0,
+     "workers": ["worker-decode-1", "worker-prefill-1"],
+     "kept_reasons": ["healthy_sample"],
+     "breakdown": {"total_ms": 900.0, "dominant": "decode",
+                   "phases": {"decode": 900.0}}},
+]
+
+
+def test_fleet_top_renders_events_timeline():
+    ft = _load_fleet_top()
+    events = [
+        {"id": 1, "ts": 1754300000.0, "type": "role_flip",
+         "severity": "info", "source": "worker-1", "count": 1,
+         "attrs": {"src": "prefill", "dst": "decode"}},
+        {"id": 2, "ts": 1754300011.0, "type": "shed",
+         "severity": "warning", "source": "frontend:burn", "count": 37,
+         "attrs": {"reason": "burn"}},
+        {"id": 3, "ts": 1754300012.5, "type": "worker_lost",
+         "severity": "critical", "source": "worker-9", "count": 1,
+         "attrs": {"role": "decode"}},
+    ]
+    text = ft.render_events(events, color=True)
+    lines = text.splitlines()
+    assert len(lines) == 3
+    assert "role_flip" in lines[0] and "dst=decode" in lines[0]
+    assert "x37" in lines[1] and "\x1b[33m" in lines[1]  # warning color
+    assert "\x1b[31m" in lines[2]  # critical color
+    plain = ft.render_events(events, color=False)
+    assert "\x1b[" not in plain
+    assert "(no fleet events)" in ft.render_events([])
+
+
 def test_fleet_top_renders_recorded_snapshot(tmp_path):
     ft = _load_fleet_top()
+    text = ft.render(RECORDED_SNAPSHOT, traces=RECORDED_TRACES)
+    # WORST-TRACE column: slowest kept trace touching each worker
+    assert "WORST-TRACE" in text
+    decode_row0 = next(
+        l for l in text.splitlines() if l.startswith("worker-decode-1")
+    )
+    assert "aa11aa11 4200ms" in decode_row0
+    prefill_row0 = next(
+        l for l in text.splitlines() if l.startswith("worker-prefill-1")
+    )
+    assert "bb22bb22 900ms" in prefill_row0
+    # without trace data the column degrades to dashes, not a crash
     text = ft.render(RECORDED_SNAPSHOT)
     assert "worker-decode-1" in text
     assert "decode" in text and "prefill" in text

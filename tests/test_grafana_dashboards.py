@@ -113,6 +113,25 @@ def _populated_expositions() -> list[str]:
         }
     }
     svc.kv_index_status_age = {"backend|r1": time.monotonic()}
+    # fleet event timeline: one event of every canonical type so the
+    # dynamo_tpu_fleet_events_total{type,severity} family (the Grafana
+    # annotation layer's query target) is fully populated
+    from dynamo_tpu.telemetry.events import EVENT_TYPES
+
+    for etype in EVENT_TYPES:
+        svc.events.add(
+            {"type": etype, "severity": "info", "source": "w1",
+             "attrs": {}}
+        )
+    # fleet trace plane: one kept trace so the assembler's counter
+    # families carry real samples
+    svc.traces.add_spans([
+        {"trace_id": "ab" * 16, "span_id": "cd" * 8, "parent_id": None,
+         "name": "http.request", "service": "frontend", "start_ts": 1.0,
+         "duration_ms": 5.0, "status": "ok",
+         "attrs": {"http_status": 500}, "events": []},
+    ])
+    svc.traces.flush()
     pframe = dict(frame)
     pframe.update(instance_id="p1", component="prefill", role="prefill")
     svc.aggregators[1]._latest["p1"] = (pframe, time.monotonic())
@@ -166,11 +185,28 @@ def _dashboard_exprs():
                     yield f.name, panel.get("title", "?"), expr
 
 
+def _annotation_exprs():
+    """Annotation-layer queries (the fleet event timeline rendered on
+    the dashboards) — gated like panel exprs."""
+    for f in sorted(DASH_DIR.glob("*.json")):
+        doc = json.loads(f.read_text())
+        for ann in (doc.get("annotations") or {}).get("list", ()):
+            expr = ann.get("expr")
+            if expr:
+                yield f.name, ann.get("name", "?"), expr
+
+
 def test_expositions_lint_clean_when_fully_populated():
     from dynamo_tpu.telemetry import promlint
+    from dynamo_tpu.telemetry.openmetrics import to_openmetrics
 
     for text in _populated_expositions():
         assert promlint.lint(text) == [], promlint.lint(text)[:8]
+        # the negotiated OpenMetrics rendering of the same exposition
+        # must lint clean too (counter family renaming + # EOF)
+        om = to_openmetrics(text)
+        errs = promlint.lint(om, openmetrics=True)
+        assert errs == [], errs[:8]
 
 
 def test_every_dashboard_metric_is_emitted():
@@ -186,4 +222,35 @@ def test_every_dashboard_metric_is_emitted():
     assert not missing, (
         "dashboard panels reference metrics no exposition emits "
         "(rename drift):\n  " + "\n  ".join(missing)
+    )
+
+
+def test_annotation_queries_reference_emitted_metrics_and_event_types():
+    """The annotation layer (fleet event timeline on the dashboards)
+    must (a) query only metrics the expositions emit and (b) match only
+    canonical event type names — a renamed event would otherwise blank
+    an annotation layer silently (same spirit as the panel gate)."""
+    from dynamo_tpu.telemetry.events import EVENT_TYPES
+
+    emitted = _emitted_series(_populated_expositions())
+    type_re = re.compile(r'type="([^"]*)"')
+    missing, bad_types = [], []
+    checked = 0
+    for fname, name, expr in _annotation_exprs():
+        checked += 1
+        for metric in _NAME_RE.findall(expr):
+            if metric not in emitted:
+                missing.append(f"{fname} / {name!r}: {metric}")
+        for etype in type_re.findall(expr):
+            if etype not in EVENT_TYPES:
+                bad_types.append(f"{fname} / {name!r}: type={etype!r}")
+    assert checked >= 6, "annotation layer vanished from the dashboards"
+    assert not missing, (
+        "annotation queries reference metrics no exposition emits:\n  "
+        + "\n  ".join(missing)
+    )
+    assert not bad_types, (
+        "annotation queries match event types nothing emits (rename "
+        "drift vs telemetry.events.EVENT_TYPES):\n  "
+        + "\n  ".join(bad_types)
     )

@@ -1,0 +1,838 @@
+"""Fleet trace plane (ISSUE 14): span shipping over the fabric,
+cross-process assembly at the metrics service, tail-based sampling,
+timeline breakdowns, and the chaos-grade stitch-across-replay proof.
+
+Unit layer: TailSampler determinism + anomaly coverage, TraceAssembler
+window/eviction bounds, breakdown reconciliation, exemplar emission.
+E2E layer: a multi-hop request (frontend -> kv router -> worker ->
+subprocess child; disagg variant) assembles into ONE trace at the
+metrics service's GET /v1/traces/{id} with an intact parent chain and
+a reconciling breakdown; a SIGKILL-equivalent mid-stream kill stitches
+both replay attempts under one trace_id, flagged incomplete, never
+dropped."""
+
+import asyncio
+import sys
+import time
+
+import aiohttp
+import pytest
+
+from dynamo_tpu import telemetry
+from dynamo_tpu.telemetry import phases, promlint, trace, traceplane
+from dynamo_tpu.telemetry.traceplane import (
+    TailSampler,
+    TraceAssembler,
+    breakdown,
+)
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+@pytest.fixture()
+def tracing():
+    telemetry.configure(enabled=True, ring_size=256)
+    telemetry.reset()
+    traceplane.ensure_shipping()
+    traceplane.drain_spans()
+    telemetry.events.reset()
+    phases.phase_histograms.reset()
+    yield
+    telemetry.configure(enabled=False)
+    telemetry.reset()
+    traceplane.disable_shipping()
+    telemetry.events.reset()
+    phases.phase_histograms.reset()
+
+
+def _span(
+    name="http.request", service="frontend", trace_id="ab" * 16,
+    span_id="11" * 8, parent_id=None, start_ts=1000.0, duration_ms=10.0,
+    status="ok", attrs=None, events=None,
+):
+    return {
+        "trace_id": trace_id, "span_id": span_id, "parent_id": parent_id,
+        "name": name, "service": service, "start_ts": start_ts,
+        "duration_ms": duration_ms, "status": status,
+        "attrs": dict(attrs or {}), "events": list(events or []),
+    }
+
+
+def _healthy_trace(tid, dur_ms=10.0):
+    return [
+        _span(trace_id=tid, span_id="aa" * 8, duration_ms=dur_ms,
+              attrs={"http_status": 200, "endpoint": "chat"}),
+        _span(name="engine.generate", service="engine", trace_id=tid,
+              span_id="bb" * 8, parent_id="aa" * 8,
+              duration_ms=dur_ms * 0.8),
+    ]
+
+
+# -- tail sampler ----------------------------------------------------------
+
+
+def test_sampler_keeps_every_anomaly_and_seeded_healthy_subset():
+    sampler = TailSampler(healthy_rate=10, seed=42)
+    anomalies = {
+        "error status": [_span(status="error")],
+        "http 504": [_span(attrs={"http_status": 504})],
+        "http 429": [_span(attrs={"http_status": 429})],
+        "replay event": [_span(events=[{"ts": 1.0, "name": "replay",
+                                        "attrs": {}}])],
+        "mark_down event": [_span(events=[{"ts": 1.0, "name": "mark_down",
+                                           "attrs": {}}])],
+        "overloaded event": [_span(events=[{"ts": 1.0, "name": "overloaded",
+                                            "attrs": {}}])],
+        "deadline event": [_span(events=[{"ts": 1.0,
+                                          "name": "deadline_expired",
+                                          "attrs": {}}])],
+    }
+    for label, spans in anomalies.items():
+        keep, reasons = sampler.decide("cd" * 16, spans)
+        assert keep, label
+        assert reasons and reasons != ["healthy_sample"], (label, reasons)
+    # incomplete assemblies are anomalous by definition
+    keep, reasons = sampler.decide("cd" * 16, [_span()], incomplete=True)
+    assert keep and "incomplete" in reasons
+
+    # healthy traffic: the seeded 1-in-N decision is deterministic and
+    # lands near the configured rate
+    tids = ["%032x" % i for i in range(2000)]
+    kept1 = {t for t in tids
+             if sampler.decide(t, _healthy_trace(t))[0]}
+    kept2 = {t for t in tids
+             if TailSampler(healthy_rate=10, seed=42).decide(
+                 t, _healthy_trace(t))[0]}
+    assert kept1 == kept2  # same seed -> same decisions, restart-proof
+    assert 100 < len(kept1) < 320  # ~1 in 10 of 2000
+    other_seed = {t for t in tids
+                  if TailSampler(healthy_rate=10, seed=7).decide(
+                      t, _healthy_trace(t))[0]}
+    assert other_seed != kept1  # the seed matters
+    # rate 0: anomalies only
+    none_kept = [t for t in tids[:100]
+                 if TailSampler(healthy_rate=0).decide(
+                     t, _healthy_trace(t))[0]]
+    assert none_kept == []
+
+
+def test_sampler_slow_thresholds_track_live_slo_p95():
+    p95 = {"ttft_ms": 100.0, "e2e_ms": 1000.0}
+    sampler = TailSampler(healthy_rate=0, slo_p95s=lambda: p95)
+    slow_root = _span(attrs={"http_status": 200, "ttft_ms": 250.0},
+                      duration_ms=300.0)
+    keep, reasons = sampler.decide("ee" * 16, [slow_root])
+    assert keep and "slow_ttft" in reasons
+    slow_e2e = _span(attrs={"http_status": 200}, duration_ms=5000.0)
+    keep, reasons = sampler.decide("ee" * 16, [slow_e2e])
+    assert keep and "slow_e2e" in reasons
+    fast = _span(attrs={"http_status": 200, "ttft_ms": 10.0},
+                 duration_ms=50.0)
+    assert not sampler.decide("ee" * 16, [fast])[0]
+    # a cold fleet (empty p95s) must not flag everything slow
+    cold = TailSampler(healthy_rate=0, slo_p95s=lambda: {})
+    assert not cold.decide("ee" * 16, [slow_root])[0]
+    # a crashing provider degrades to no thresholds, never raises
+    broken = TailSampler(
+        healthy_rate=0, slo_p95s=lambda: (_ for _ in ()).throw(ValueError)
+    )
+    assert not broken.decide("ee" * 16, [slow_root])[0]
+
+
+# -- assembler bounds ------------------------------------------------------
+
+
+def test_assembler_quiet_window_and_memory_bounds():
+    clock = [0.0]
+    asm = TraceAssembler(
+        sampler=TailSampler(healthy_rate=1), window_s=1.0,
+        max_age_s=30.0, max_open=8, keep=4, now_fn=lambda: clock[0],
+    )
+    asm.add_spans(_healthy_trace("aa" * 16))
+    assert asm.sweep() == 0  # still inside the quiet window
+    clock[0] = 0.5
+    asm.add_spans([_span(name="preprocess", trace_id="aa" * 16,
+                         span_id="cc" * 8, parent_id="aa" * 8)])
+    clock[0] = 1.2
+    assert asm.sweep() == 0  # the straggler reset the quiet clock
+    clock[0] = 1.6
+    assert asm.sweep() == 1
+    doc = asm.get("aa" * 16)
+    assert doc is not None and not doc["incomplete"]
+    assert len(doc["spans"]) == 3
+
+    # max_open: the 9th concurrent assembly evicts the oldest, which
+    # finalizes (incomplete, kept) instead of vanishing
+    for i in range(9):
+        asm.add_spans(_healthy_trace("%032x" % (i + 1)))
+    st = asm.stats()
+    assert st["open"] <= 8
+    assert st["evicted_total"] == 1
+    evicted = asm.get("%032x" % 1)
+    assert evicted is not None and evicted["incomplete"]
+    # keep ring is bounded too (LRU)
+    clock[0] = 10.0
+    asm.sweep()
+    assert asm.stats()["kept"] <= 4
+
+    # under sustained load open assemblies stay bounded (the eviction
+    # test of the acceptance criteria)
+    for i in range(500):
+        asm.add_spans(_healthy_trace("%032x" % (1000 + i)))
+    st = asm.stats()
+    assert st["open"] <= 8 and st["kept"] <= 4
+
+
+def test_assembler_mixed_traffic_keeps_all_anomalies_at_rate():
+    """Acceptance: mixed healthy/slow/error/replayed traffic -> 100% of
+    anomalies kept, healthy kept at the deterministic seeded rate."""
+    clock = [0.0]
+    sampler = TailSampler(healthy_rate=5, seed=9)
+    asm = TraceAssembler(sampler=sampler, window_s=0.1, keep=4096,
+                         max_open=4096, now_fn=lambda: clock[0])
+    anomalous, healthy = [], []
+    for i in range(300):
+        tid = "%032x" % (i + 1)
+        if i % 3 == 0:
+            anomalous.append(tid)
+            spans = [_span(trace_id=tid, span_id="aa" * 8,
+                           attrs={"http_status": 504})]
+        elif i % 3 == 1:
+            anomalous.append(tid)
+            spans = [_span(trace_id=tid, span_id="aa" * 8,
+                           events=[{"ts": 1.0, "name": "replay",
+                                    "attrs": {}}])]
+        else:
+            healthy.append(tid)
+            spans = _healthy_trace(tid)
+        asm.add_spans(spans)
+    clock[0] = 1.0
+    asm.sweep()
+    for tid in anomalous:
+        assert asm.get(tid) is not None, "anomalous trace dropped"
+    kept_healthy = [t for t in healthy if asm.get(t) is not None]
+    expected = [
+        t for t in healthy if sampler.decide(t, _healthy_trace(t))[0]
+    ]
+    assert kept_healthy == expected
+    assert 0 < len(kept_healthy) < len(healthy)
+
+
+def test_straggler_completes_an_early_finalized_trace():
+    """A shipper on a slower cadence than the assembly window: the
+    trace finalizes incomplete (kept), then the missing subtree's
+    spans arrive — they attach AND clear the incomplete flag, because
+    the stitch is now whole."""
+    clock = [0.0]
+    asm = TraceAssembler(sampler=TailSampler(healthy_rate=1),
+                         window_s=0.5, now_fn=lambda: clock[0])
+    tid = "cc" * 16
+    # the EARLY-ENDING spans ship first (preprocess, kv.choose end in
+    # microseconds; their parents — http.request, router.dispatch —
+    # are still streaming, so they ship a cadence later): two dangling
+    # subtrees -> incomplete at finalize
+    asm.add_spans([
+        _span(name="preprocess", trace_id=tid, span_id="bb" * 8,
+              parent_id="aa" * 8),
+        _span(name="kv.choose", service="router", trace_id=tid,
+              span_id="dd" * 8, parent_id="ee" * 8),
+    ])
+    clock[0] = 1.0
+    asm.sweep()
+    doc = asm.get(tid)
+    assert doc is not None and doc["incomplete"]
+    # the late shipper fires: the roots arrive, the stitch is whole
+    asm.add_spans([
+        _span(trace_id=tid, span_id="aa" * 8,
+              attrs={"http_status": 200, "endpoint": "chat"}),
+        _span(name="router.dispatch", service="router", trace_id=tid,
+              span_id="ee" * 8, parent_id="aa" * 8),
+    ])
+    doc = asm.get(tid)
+    assert len(doc["spans"]) == 4
+    assert not doc["incomplete"]
+    assert not doc["summary"]["incomplete"]
+    assert asm.stats()["incomplete_total"] == 0
+
+
+def test_incomplete_trace_is_kept_and_flagged_not_dropped():
+    """A subtree whose parent never shipped (SIGKILLed worker) and a
+    mark_down-carrying trace both finalize as incomplete + kept."""
+    clock = [0.0]
+    asm = TraceAssembler(sampler=TailSampler(healthy_rate=0),
+                         window_s=0.1, now_fn=lambda: clock[0])
+    # dangling subtree: engine span whose parent id never arrives
+    asm.add_spans([
+        _span(trace_id="dd" * 16, span_id="aa" * 8,
+              attrs={"http_status": 200}),
+        _span(name="engine.generate", service="engine",
+              trace_id="dd" * 16, span_id="bb" * 8,
+              parent_id="99" * 8),
+    ])
+    clock[0] = 1.0
+    asm.sweep()
+    doc = asm.get("dd" * 16)
+    assert doc is not None
+    assert doc["incomplete"] and "incomplete" in doc["kept_reasons"]
+    assert asm.stats()["incomplete_total"] == 1
+
+
+# -- breakdown -------------------------------------------------------------
+
+
+def test_breakdown_reconciles_and_attributes_phases():
+    t0 = 1000.0
+    spans = [
+        _span(span_id="aa" * 8, start_ts=t0, duration_ms=100.0,
+              attrs={"http_status": 200, "endpoint": "chat"}),
+        _span(name="preprocess", span_id="bb" * 8, parent_id="aa" * 8,
+              start_ts=t0 + 0.001, duration_ms=5.0),
+        _span(name="router.dispatch", service="router",
+              span_id="cc" * 8, parent_id="aa" * 8,
+              start_ts=t0 + 0.006, duration_ms=90.0,
+              events=[{"ts": t0 + 0.030, "name": "first_frame",
+                       "attrs": {}}]),
+        # attempt 1: killed after 20 ms of decode
+        _span(name="engine.generate", service="engine",
+              span_id="dd" * 8, parent_id="cc" * 8,
+              start_ts=t0 + 0.010, duration_ms=30.0, status="cancelled",
+              attrs={"queue_wait_ms": 4.0},
+              events=[{"ts": t0 + 0.014, "name": "first_token",
+                       "attrs": {}}]),
+        # 10 ms replay gap, then attempt 2 with a disagg prefill hop
+        _span(name="engine.generate", service="engine",
+              span_id="ee" * 8, parent_id="cc" * 8,
+              start_ts=t0 + 0.050, duration_ms=46.0,
+              attrs={"queue_wait_ms": 2.0, "decode_stall_ms": 3.0},
+              events=[{"ts": t0 + 0.070, "name": "first_token",
+                       "attrs": {}}]),
+        _span(name="disagg.remote_prefill", service="disagg",
+              span_id="ff" * 8, parent_id="ee" * 8,
+              start_ts=t0 + 0.052, duration_ms=14.0),
+        _span(name="disagg.prefill", service="prefill",
+              span_id="ab" * 8, parent_id="ff" * 8,
+              start_ts=t0 + 0.054, duration_ms=9.0),
+    ]
+    bd = breakdown(spans)
+    assert bd is not None
+    ph = bd["phases"]
+    # the partition invariant the acceptance pins at +-1 ms
+    assert abs(sum(ph.values()) - bd["total_ms"]) < 1e-6
+    assert bd["total_ms"] == 100.0
+    assert bd["attempts"] == 2
+    assert ph["preprocess"] == 5.0
+    assert ph["queue_wait"] == 6.0       # 4 + 2
+    assert ph["replay_gap"] == pytest.approx(10.0, abs=0.001)
+    assert ph["transfer"] == pytest.approx(5.0, abs=0.001)  # 14 - 9
+    assert ph["prefill"] > 0.0
+    assert ph["decode_stall"] == 3.0
+    assert ph["decode"] > 0.0
+    assert ph["other"] >= 0.0
+    # dispatch: router start -> first attempt start
+    assert ph["dispatch"] == pytest.approx(4.0, abs=0.001)
+    assert bd["dominant"] in ("decode", "prefill")
+
+    # degenerate inputs never raise
+    assert breakdown([]) is None
+    garbage = breakdown([{"garbage": True}])
+    assert garbage is None or garbage["total_ms"] == 0.0
+
+
+# -- exemplars on both expositions ----------------------------------------
+
+
+def test_exemplars_resolve_to_traces_and_lint_clean(tracing):
+    """Acceptance: BOTH Prometheus surfaces carry OpenMetrics exemplars
+    on their NEGOTIATED OpenMetrics rendering (trace ids resolving to
+    kept traces), while the classic text/plain rendering stays
+    exemplar-free — the 0.0.4 parser fails a whole scrape on exemplar
+    syntax — and promlint passes over both, fully populated."""
+    from dynamo_tpu.frontend.metrics import FrontendMetrics
+    from dynamo_tpu.metrics_service import MetricsService
+
+    with telemetry.span("http.request", service="frontend") as root:
+        tid = root.trace_id
+        phases.observe("queue_wait_ms", 3.0)          # contextvar path
+        phases.observe("decode_step_ms", 0.7, trace_id=tid)
+    fm = FrontendMetrics()
+
+    class _F:
+        pass
+
+    svc = MetricsService(_F())
+    for classic, om in (
+        (fm.expose(), fm.expose(openmetrics=True)),
+        (svc.expose(), svc.expose(openmetrics=True)),
+    ):
+        # classic surface: parseable by 0.0.4 scrapers, NO exemplars
+        assert " # " not in classic
+        assert promlint.lint(classic) == [], promlint.lint(classic)[:6]
+        # OpenMetrics surface: exemplars + EOF, counters renamed
+        assert om.rstrip().endswith("# EOF")
+        ex_lines = [l for l in om.splitlines() if " # {" in l]
+        assert ex_lines, "no exemplars on the OpenMetrics rendering"
+        assert any(f'trace_id="{tid}"' in l for l in ex_lines)
+        assert "# TYPE dynamo_tpu_phase_queue_wait_ms histogram" in om
+        errs = promlint.lint(om, openmetrics=True)
+        assert errs == [], errs[:6]
+        # the classic linter REJECTS exemplar leakage (the regression
+        # that would break production scrapes)
+        assert any("classic" in e for e in promlint.lint(om))
+    # the exemplar's trace is in the ring (resolvable via /v1/traces)
+    assert telemetry.get_trace(tid)
+
+    # tracing off: no exemplars anywhere, lint still clean
+    phases.phase_histograms.reset()
+    telemetry.configure(enabled=False)
+    phases.observe("decode_step_ms", 0.7)
+    off_text = FrontendMetrics().expose(openmetrics=True)
+    assert " # {" not in off_text
+    assert promlint.lint(off_text, openmetrics=True) == []
+
+
+# -- default-off bit-identity (the PR 4/6 invariant) -----------------------
+
+
+def test_token_path_identical_with_tracing_off_and_on():
+    """Greedy streams through AsyncEngineRunner are bit-identical with
+    the trace plane off and on; with it OFF the wire carries none of
+    the enrichment keys."""
+    from dynamo_tpu.engine import EngineConfig
+    from dynamo_tpu.engine.async_engine import AsyncEngineRunner
+    from dynamo_tpu.engine.engine import JaxEngine
+    from dynamo_tpu.preprocessor.preprocessor import PreprocessedRequest
+    from dynamo_tpu.runtime.context import Context
+
+    async def drive(enable: bool):
+        telemetry.configure(enabled=enable, ring_size=64 if enable else None)
+        if enable:
+            traceplane.ensure_shipping()
+        eng = JaxEngine(EngineConfig.for_tests())
+        runner = AsyncEngineRunner(eng)
+        runner.start()
+        try:
+            streams = {}
+            for i in range(3):
+                req = PreprocessedRequest(
+                    request_id=f"pin-{i}",
+                    token_ids=[3 + i, 5, 7, 11, 13], max_tokens=8,
+                    temperature=0.0, ignore_eos=True,
+                )
+                items = []
+                async for item in runner.generate(Context(), req):
+                    items.append(item)
+                streams[i] = items
+            return streams
+        finally:
+            runner.stop()
+            telemetry.configure(enabled=False)
+            traceplane.disable_shipping()
+
+    off = run(drive(False))
+    on = run(drive(True))
+    for i in off:
+        toks_off = [t for it in off[i] for t in it["token_ids"]]
+        toks_on = [t for it in on[i] for t in it["token_ids"]]
+        assert toks_off == toks_on
+        # off: the enrichment keys never appear on the wire
+        for item in off[i]:
+            assert "queue_wait_ms" not in item
+            assert "stall_ms" not in item
+    # on: the first emission carried the measured queue wait
+    assert any(
+        "queue_wait_ms" in item for items in on.values() for item in items
+    )
+
+
+# -- e2e: multi-hop assembly at the metrics service ------------------------
+
+
+def _ref_cmd() -> list[str]:
+    return [
+        sys.executable, "-m", "dynamo_tpu.external.reference_worker",
+        "--model", "ext-ref", "--block-size", "4",
+        "--metrics-interval", "0.1",
+    ]
+
+
+async def _await_assembled(base: str, trace_id: str, want_services: set,
+                           tries: int = 240):
+    async with aiohttp.ClientSession() as s:
+        last = None
+        for _ in range(tries):
+            async with s.get(f"{base}/v1/traces/{trace_id}") as r:
+                if r.status == 200:
+                    last = await r.json()
+                    have = {
+                        sp.get("service") for sp in last.get("spans", ())
+                    }
+                    if want_services <= have and not last.get("assembling"):
+                        return last
+            await asyncio.sleep(0.05)
+    raise AssertionError(
+        f"trace {trace_id} never assembled {want_services}; last={last}"
+    )
+
+
+def test_multi_hop_assembly_proof(tracing):
+    """Acceptance: one request (frontend -> kv router -> worker ->
+    subprocess child) yields ONE assembled trace at the metrics
+    service with an intact parent chain across every process boundary,
+    a reconciling breakdown, and search API hits."""
+    from dynamo_tpu.external.client import SubprocessEngine
+    from dynamo_tpu.frontend import HttpService, ModelManager
+    from dynamo_tpu.frontend.service import ModelWatcher
+    from dynamo_tpu.metrics_service import MetricsService
+    from dynamo_tpu.model_card import ModelDeploymentCard
+    from dynamo_tpu.runtime import DistributedRuntime
+    from dynamo_tpu.runtime.fabric import FabricServer
+    from dynamo_tpu.worker import Worker
+
+    TRACE_ID = "fa" * 16
+    TRACEPARENT = f"00-{TRACE_ID}-{'cd' * 8}-01"
+
+    async def main():
+        server = FabricServer(port=0)
+        await server.start()
+        eng = SubprocessEngine(_ref_cmd(), name="ref")
+        await eng.start()
+        rt_w = await DistributedRuntime.create(server.address)
+        card = ModelDeploymentCard(
+            name="ext-ref", tokenizer={"kind": "byte"},
+            context_length=512, kv_page_size=4,
+        )
+        worker = Worker(
+            rt_w, card, engine_kind="external", engine=eng,
+            namespace="ns", router_mode="kv", metrics_interval=0.1,
+        )
+        await worker.start()
+        rt_m = await DistributedRuntime.create(server.address)
+        metrics = MetricsService(
+            rt_m.fabric, host="127.0.0.1", port=0,
+            trace_sample_rate=1, trace_window_s=1.5,
+        )
+        await metrics.start()
+        rt_f = await DistributedRuntime.create(server.address)
+        manager = ModelManager()
+        watcher = ModelWatcher(rt_f, manager)
+        await watcher.start()
+        for _ in range(100):
+            if manager.get("ext-ref"):
+                break
+            await asyncio.sleep(0.05)
+        svc = HttpService(manager, host="127.0.0.1", port=0)
+        await svc.start()
+        base = f"http://127.0.0.1:{svc.port}"
+        mbase = f"http://127.0.0.1:{metrics.port}"
+        body = {
+            "model": "ext-ref",
+            "messages": [{"role": "user", "content": "assemble me"}],
+            "max_tokens": 6, "temperature": 0.0,
+        }
+        try:
+            async with aiohttp.ClientSession() as s:
+                async with s.post(
+                    f"{base}/v1/chat/completions", json=body,
+                    headers={"traceparent": TRACEPARENT},
+                ) as r:
+                    assert r.status == 200
+                    data = await r.json()
+                assert data["usage"]["completion_tokens"] == 6
+
+            doc = await _await_assembled(
+                mbase, TRACE_ID,
+                {"frontend", "router", "worker", "engine", "ext-child"},
+            )
+            spans = doc["spans"]
+            by_name = {sp["name"]: sp for sp in spans}
+            ids = {sp["span_id"] for sp in spans}
+            # the stitch chain holds across every process boundary
+            assert by_name["http.request"]["parent_id"] == "cd" * 8
+            assert by_name["worker.generate"]["parent_id"] in ids
+            assert (
+                by_name["engine.generate"]["parent_id"]
+                == by_name["worker.generate"]["span_id"]
+            )
+            assert (
+                by_name["child.generate"]["parent_id"]
+                == by_name["engine.generate"]["span_id"]
+            )
+            assert all(sp["trace_id"] == TRACE_ID for sp in spans)
+            assert not doc["incomplete"]
+            # breakdown reconciles: phases partition the root wall time
+            bd = doc["breakdown"]
+            assert bd is not None
+            assert abs(sum(bd["phases"].values()) - bd["total_ms"]) <= 1.0
+            assert bd["phases"]["decode"] > 0.0
+            # chrome export of the assembled trace
+            async with aiohttp.ClientSession() as s:
+                async with s.get(
+                    f"{mbase}/v1/traces/{TRACE_ID}?format=chrome"
+                ) as r:
+                    chrome = await r.json()
+                assert len(
+                    [e for e in chrome["traceEvents"] if e["ph"] == "X"]
+                ) == len(spans)
+                # search API facets
+                async with s.get(
+                    f"{mbase}/v1/traces?endpoint=chat&sort=duration"
+                    f"&min_ms=0.1&worker={worker.instance_id}"
+                ) as r:
+                    listing = await r.json()
+                assert any(
+                    t["trace_id"] == TRACE_ID
+                    for t in listing["traces"]
+                )
+                async with s.get(
+                    f"{mbase}/v1/traces?worker=not-a-worker"
+                ) as r:
+                    assert (await r.json())["traces"] == []
+                async with s.get(f"{mbase}/v1/traces?min_ms=bogus") as r:
+                    assert r.status == 400
+        finally:
+            await svc.stop()
+            await watcher.stop()
+            await rt_f.close()
+            await metrics.stop()
+            await rt_m.close()
+            await worker.stop()
+            await rt_w.close()
+            await eng.stop()
+            await server.stop()
+
+    run(main())
+
+
+def test_disagg_prefill_hop_assembles(tracing, monkeypatch):
+    """The disagg variant: decode + prefill workers' spans (crossing
+    the prefill QUEUE) assemble into one trace at the metrics service
+    with the hand-off chain intact and transfer attributed."""
+    monkeypatch.setenv("DYN_KV_TRANSFER", "host")
+    from dynamo_tpu.disagg import DisaggConfig
+    from dynamo_tpu.disagg.prefill_worker import PrefillWorker
+    from dynamo_tpu.engine import EngineConfig
+    from dynamo_tpu.metrics_service import MetricsService
+    from dynamo_tpu.model_card import ModelDeploymentCard
+    from dynamo_tpu.runtime import DistributedRuntime, RouterMode
+    from dynamo_tpu.runtime.fabric import FabricServer
+    from dynamo_tpu.worker import Worker
+
+    tiny_cfg = EngineConfig.for_tests()
+    prompt = [5, 17, 42, 99, 3, 8, 21, 60, 11, 2]
+    card = ModelDeploymentCard(
+        name="tiny", kv_page_size=tiny_cfg.page_size,
+        context_length=tiny_cfg.max_context,
+    )
+
+    async def main():
+        server = FabricServer(port=0)
+        await server.start()
+        rt_d = await DistributedRuntime.create(server.address)
+        decode = Worker(
+            rt_d, card, engine_config=tiny_cfg, engine_kind="jax",
+            namespace="test", metrics_interval=0.1, enable_disagg=True,
+            disagg_config=DisaggConfig(
+                max_local_prefill_length=4, transfer_timeout_s=20.0
+            ),
+        )
+        await decode.start()
+        rt_p = await DistributedRuntime.create(server.address)
+        prefill = PrefillWorker(rt_p, tiny_cfg, namespace="test")
+        await prefill.start()
+        rt_m = await DistributedRuntime.create(server.address)
+        metrics = MetricsService(
+            rt_m.fabric, host="127.0.0.1", port=0,
+            trace_sample_rate=1, trace_window_s=1.0,
+        )
+        await metrics.start()
+        rt_c = await DistributedRuntime.create(server.address)
+        try:
+            ep = rt_c.namespace("test").component("backend").endpoint(
+                "generate"
+            )
+            router = await ep.router(mode=RouterMode.ROUND_ROBIN)
+            await router.source.wait_for_instances()
+            with telemetry.span("test.root", service="frontend") as root:
+                trace_id = root.trace_id
+                tokens = []
+                async for item in router.generate(
+                    {
+                        "request_id": "tp-disagg", "token_ids": prompt,
+                        "max_tokens": 4, "temperature": 0.0,
+                        "top_p": 1.0, "top_k": 0, "seed": None,
+                        "stop_token_ids": [], "stop_strings": [],
+                        "ignore_eos": True, "annotations": {},
+                    }
+                ):
+                    tokens.extend(item.get("token_ids", ()))
+            assert len(tokens) == 4
+            # this client process has no shipper loop: ship explicitly
+            # (the real frontend's ModelWatcher shipper does this)
+            await traceplane.ship_once(rt_c.fabric, "client")
+            mbase = f"http://127.0.0.1:{metrics.port}"
+            doc = await _await_assembled(
+                mbase, trace_id,
+                {"frontend", "router", "worker", "disagg", "prefill"},
+            )
+            by_name = {sp["name"]: sp for sp in doc["spans"]}
+            assert (
+                by_name["disagg.prefill"]["parent_id"]
+                == by_name["disagg.remote_prefill"]["span_id"]
+            )
+            bd = doc["breakdown"]
+            assert abs(sum(bd["phases"].values()) - bd["total_ms"]) <= 1.0
+            assert bd["phases"]["transfer"] >= 0.0
+        finally:
+            await rt_c.close()
+            await metrics.stop()
+            await rt_m.close()
+            await prefill.stop()
+            await rt_p.close()
+            await decode.stop()
+            await rt_d.close()
+            await server.stop()
+
+    run(main())
+
+
+# -- chaos: SIGKILL-equivalent mid-stream, replay stitches one trace -------
+
+
+def test_kill_midstream_replay_stitches_one_trace(tracing):
+    """Chaos-grade assembly (satellite): kv-routed traffic through a
+    2-worker fleet with stream replay; the serving worker dies
+    (SIGKILL-equivalent: tasks cancelled, ingress severed, publishing
+    stops) after the first tokens. The kept trace stitches BOTH
+    attempts under one trace_id with a `replay` event, is flagged
+    incomplete (a worker vanished mid-trace), and never vanishes."""
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).parent))
+    from helpers.fleet_sim import FleetSim
+
+    from dynamo_tpu.metrics_service import MetricsService
+    from dynamo_tpu.runtime import DistributedRuntime
+
+    async def main():
+        sim = FleetSim(decode_s_per_step=0.03, metrics_interval=0.1)
+        await sim.start(replay=True)
+        rt_m = await DistributedRuntime.create(sim.server.address)
+        metrics = MetricsService(
+            rt_m.fabric, host="127.0.0.1", port=0,
+            trace_sample_rate=1, trace_window_s=1.0,
+        )
+        await metrics.start()
+        try:
+            a = await sim.add_worker()
+            b = await sim.add_worker()
+            req = sim._request(isl=8, osl=12)
+            tokens = []
+            killed = None
+            with telemetry.span("http.request", service="frontend",
+                                attrs={"endpoint": "chat"}) as root:
+                trace_id = root.trace_id
+                async for item in sim.router.generate(
+                    req, max_attempts=8
+                ):
+                    tokens.extend(item.get("token_ids") or ())
+                    if len(tokens) >= 3 and killed is None:
+                        killed = a if a.mock.active_requests else b
+                        await sim.kill(killed)
+            assert len(tokens) == 12  # the stream continued seamlessly
+            # the dead worker's publish loop is gone — the survivor's
+            # shipper (same process, shared buffer) and the client-side
+            # ship below deliver what DID finish
+            await traceplane.ship_once(
+                sim.runtime.fabric, "test-client"
+            )
+            mbase = f"http://127.0.0.1:{metrics.port}"
+            doc = await _await_assembled(
+                mbase, trace_id, {"frontend", "router", "worker"},
+            )
+            spans = doc["spans"]
+            assert all(sp["trace_id"] == trace_id for sp in spans)
+            # both attempts stitched: two worker-side generate spans
+            attempts = [
+                sp for sp in spans if sp["name"] == "worker.generate"
+            ]
+            assert len(attempts) >= 2, [sp["name"] for sp in spans]
+            # the dispatch span carries the replay + mark_down record
+            dispatch = next(
+                sp for sp in spans if sp["name"] == "router.dispatch"
+            )
+            ev_names = {e["name"] for e in dispatch["events"]}
+            assert "replay" in ev_names and "mark_down" in ev_names
+            # kept BECAUSE anomalous, and honestly flagged incomplete
+            assert doc["incomplete"]
+            reasons = set(doc["kept_reasons"])
+            assert {"replay", "retry", "incomplete"} & reasons
+            # the stream_replay fleet event landed on the timeline and
+            # joins the trace by window
+            async with aiohttp.ClientSession() as s:
+                for _ in range(100):
+                    async with s.get(
+                        f"{mbase}/v1/fleet/events?type=stream_replay"
+                    ) as r:
+                        evs = (await r.json())["events"]
+                    if evs:
+                        break
+                    await asyncio.sleep(0.05)
+            assert evs and evs[-1]["source"] == killed.instance_id
+            # eviction never blocked: the assembler is empty or bounded
+            assert metrics.traces.stats()["open"] < 2048
+        finally:
+            await metrics.stop()
+            await rt_m.close()
+            await sim.stop()
+
+    run(main())
+
+
+# -- fleet events: worker-side emitters land on the timeline ---------------
+
+
+def test_worker_drain_event_reaches_timeline(tracing):
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).parent))
+    from helpers.fleet_sim import FleetSim
+
+    from dynamo_tpu.metrics_service import MetricsService
+    from dynamo_tpu.runtime import DistributedRuntime
+
+    async def main():
+        sim = FleetSim(metrics_interval=0.1)
+        await sim.start(replay=False)
+        rt_m = await DistributedRuntime.create(sim.server.address)
+        metrics = MetricsService(rt_m.fabric, host="127.0.0.1", port=0)
+        await metrics.start()
+        try:
+            a = await sim.add_worker()
+            b = await sim.add_worker()
+            await b.drain(budget_s=0.1)
+            await a.flip_role("prefill", budget_s=0.1)
+            mbase = f"http://127.0.0.1:{metrics.port}"
+            async with aiohttp.ClientSession() as s:
+                for _ in range(120):
+                    async with s.get(f"{mbase}/v1/fleet/events") as r:
+                        evs = (await r.json())["events"]
+                    have = {e["type"] for e in evs}
+                    if {"drain", "role_flip"} <= have:
+                        break
+                    await asyncio.sleep(0.05)
+            assert {"drain", "role_flip"} <= {e["type"] for e in evs}
+            flip = next(e for e in evs if e["type"] == "role_flip")
+            assert flip["source"] == a.instance_id
+            assert flip["attrs"]["dst"] == "prefill"
+            # exposition: the annotation layer's counter family is live
+            text = metrics.expose()
+            assert 'dynamo_tpu_fleet_events_total{type="role_flip"' in text
+            assert promlint.lint(text) == []
+        finally:
+            await metrics.stop()
+            await rt_m.close()
+            await sim.stop()
+
+    run(main())
