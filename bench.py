@@ -1410,6 +1410,138 @@ def _trace_plane_overhead_ab(
     }
 
 
+def _failover_blackout() -> dict:
+    """Control-plane failover blackout (ISSUE 15 acceptance): primary +
+    warm standby in-process, a steady ringed publisher, SIGKILL-
+    equivalent primary death. `blackout_ms` spans the last successful
+    publish before the kill to the FIRST successful publish after the
+    standby promoted (detector budget 0.3s here). Plus the replication-
+    overhead A/B: the journal tap is the only cost replication adds to
+    the publish path, measured by interleaved tap-on/tap-off batches on
+    the fabric publish path and MODELED against the measured wire
+    publish round-trip (<2% target, asserted in test_bench_contract —
+    wall ratios on this box swing with load, so the deterministic model
+    is the claim)."""
+    import asyncio
+    import statistics
+    import time as _time
+
+    from dynamo_tpu.runtime.fabric import (
+        FabricNode,
+        FabricServer,
+        RemoteFabric,
+    )
+    from dynamo_tpu.runtime.fabric.local import LocalFabric
+
+    async def drive() -> dict:
+        primary = FabricServer(port=0)
+        await primary.start()
+        node = FabricNode(
+            port=0, standby_of=primary.address, detector_budget_s=0.3,
+            orphan_grace=10.0,
+        )
+        await node.start()
+        client = await RemoteFabric.connect(
+            f"{primary.address},{node.address}"
+        )
+        try:
+            # steady-state wire publish cost (standby attached — the
+            # deployed configuration)
+            for _ in range(20):  # warm
+                await client.publish("kv_events.bench", {"i": -1}, b"x" * 64)
+            t0 = _time.perf_counter()
+            n_wire = 200
+            for i in range(n_wire):
+                await client.publish("kv_events.bench", {"i": i}, b"x" * 64)
+            wire_us = (_time.perf_counter() - t0) / n_wire * 1e6
+
+            # blackout: publish at a tight cadence, kill, time to the
+            # first success on the promoted standby
+            before = after = 0
+            last_ok = _time.perf_counter()
+            for i in range(50):
+                await client.publish("kv_events.bench", {"b": i}, b"x")
+                before += 1
+                last_ok = _time.perf_counter()
+            primary.kill()
+            first_ok = None
+            deadline = _time.perf_counter() + 30.0
+            while first_ok is None and _time.perf_counter() < deadline:
+                try:
+                    await client.publish("kv_events.bench", {"a": after}, b"x")
+                    first_ok = _time.perf_counter()
+                except (ConnectionError, RuntimeError, OSError):
+                    await asyncio.sleep(0.005)
+            if first_ok is None:
+                return {"error": "no publish succeeded after the kill"}
+            for i in range(20):
+                await client.publish("kv_events.bench", {"a": i}, b"x")
+                after += 1
+            return {
+                "blackout_ms": round((first_ok - last_ok) * 1000.0, 1),
+                "detector_budget_ms": 300.0,
+                "publishes_before": before,
+                "publishes_after": after + 1,
+                "promoted_fence": node.fabric.fence,
+                "wire_publish_us": round(wire_us, 1),
+            }
+        finally:
+            await client.close()
+            await node.stop()
+            await primary.stop()
+
+    async def tap_ab(wire_us: float) -> dict:
+        """Interleaved journal-tap on/off batches on the publish path."""
+        f = LocalFabric()
+        n, reps = 400, 6
+        base_runs, tap_runs = [], []
+        q = None
+        for r in range(2 * reps):
+            tap = r % 2 == 1
+            if tap and q is None:
+                q = f.repl_attach()
+            if not tap and q is not None:
+                f.repl_detach(q)
+                q = None
+            t0 = _time.perf_counter()
+            for i in range(n):
+                await f.publish("kv_events.ab", {"i": i}, b"x" * 64)
+            us = (_time.perf_counter() - t0) / n * 1e6
+            (tap_runs if tap else base_runs).append(us)
+            if q is not None:
+                while not q.empty():  # drain like a live standby would
+                    q.get_nowait()
+        base_us = statistics.median(base_runs)
+        tap_us = statistics.median(tap_runs)
+        tap_cost = max(0.0, tap_us - base_us)
+        return {
+            "publish_path_base_us": round(base_us, 3),
+            "publish_path_tap_us": round(tap_us, 3),
+            "tap_cost_us": round(tap_cost, 3),
+            # the model: replication adds tap_cost to every wire publish
+            # that costs wire_us end to end — THIS is the deployment
+            # overhead claim (<2%)
+            "modeled_repl_overhead_pct": round(
+                tap_cost / wire_us * 100.0, 4
+            ) if wire_us else None,
+            # the raw in-process path ratio (microseconds on
+            # microseconds) — NOT a deployment overhead; reported so the
+            # tap cost itself is visible
+            "tap_path_ratio_pct": round(
+                (tap_us / base_us - 1.0) * 100.0, 2
+            ) if base_us else None,
+        }
+
+    async def run():
+        doc = await drive()
+        if "error" in doc:
+            return doc
+        doc.update(await tap_ab(doc["wire_publish_us"]))
+        return doc
+
+    return asyncio.run(run())
+
+
 def main() -> None:
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     from dynamo_tpu.platform import honor_jax_platforms_env
@@ -1783,6 +1915,19 @@ def main() -> None:
             # the headline artifact
             trace_plane_ab = {"error": f"{type(e).__name__}: {e}"}
 
+    # Control-plane failover blackout + replication overhead (ISSUE 15):
+    # warm-standby promotion window under a SIGKILL'd primary, and the
+    # journal tap's cost on the publish path (<2% modeled).
+    failover_ab = None
+    if platform != "tpu" and os.environ.get(
+        "BENCH_FAILOVER_AB", "1"
+    ) != "0":
+        try:
+            failover_ab = _failover_blackout()
+        except Exception as e:  # noqa: BLE001 — A/B failure must not kill
+            # the headline artifact
+            failover_ab = {"error": f"{type(e).__name__}: {e}"}
+
     # Draft-model speculative decoding A/B (ISSUE 9): decode tok/s with
     # the fused draft+verify path on vs off at batch <= 8. Runs by
     # default on the CPU fallback (tiny self-draft — acceptance ~1, the
@@ -1999,6 +2144,11 @@ def main() -> None:
                 **(
                     {"trace_plane_overhead": trace_plane_ab}
                     if trace_plane_ab
+                    else {}
+                ),
+                **(
+                    {"failover_blackout": failover_ab}
+                    if failover_ab
                     else {}
                 ),
                 **(

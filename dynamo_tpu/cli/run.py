@@ -703,6 +703,11 @@ async def _run_planner(args) -> None:
                 else None
             ),
             status_fn=status_fn,
+            # HOLD while the control plane is degraded (no broker):
+            # signals are frozen and actuation would fly blind
+            degraded_fn=lambda: bool(
+                getattr(rt.fabric, "degraded", False)
+            ),
         )
     else:
         shipper = None
@@ -731,7 +736,13 @@ def build_parser() -> argparse.ArgumentParser:
     runp.add_argument("--model", default="tiny")
     runp.add_argument("--checkpoint", default=None, help="local HF checkpoint dir")
     runp.add_argument("--tokenizer", default=None, help="local tokenizer dir")
-    runp.add_argument("--fabric", default=None, help="fabric server host:port")
+    runp.add_argument(
+        "--fabric", default=None,
+        help="fabric broker address(es): host:port, or a comma list "
+             "a:4222,b:4222 for an HA pair — the client rotates through "
+             "them, follows NotPrimary redirects, and rides out a "
+             "broker failover (docs/operations.md 'Control-plane HA')",
+    )
     runp.add_argument("--host", default="127.0.0.1")
     runp.add_argument("--port", type=int, default=8080)
     runp.add_argument(
@@ -1003,7 +1014,41 @@ def build_parser() -> argparse.ArgumentParser:
     fabricp.add_argument("--port", type=int, default=4222)
     fabricp.add_argument(
         "--persist-dir", default=None, dest="persist_dir",
-        help="WAL directory: state survives server restarts",
+        help="WAL directory: state survives server restarts (and, with "
+             "--standby-of, makes a promotion's fence bump durable)",
+    )
+    fabricp.add_argument(
+        "--standby-of", default=None, dest="standby_of", metavar="ADDR",
+        help="control-plane HA: run as the WARM STANDBY of the primary "
+             "at host:port — bootstrap from its snapshot, tail its "
+             "journal, answer clients NotPrimary+redirect, and promote "
+             "when it is unreachable past --detector-budget "
+             "(docs/operations.md 'Control-plane HA')",
+    )
+    fabricp.add_argument(
+        "--peer", action="append", default=[], metavar="ADDR",
+        help="other broker addresses (repeatable). On startup a primary "
+             "defers to any peer serving at a higher fence instead of "
+             "split-braining — give the restarted old primary its "
+             "standby's address",
+    )
+    fabricp.add_argument(
+        "--detector-budget", type=float, default=3.0,
+        dest="detector_budget", metavar="SECONDS",
+        help="standby: promote after the primary has been unreachable "
+             "this long (default 3.0)",
+    )
+    fabricp.add_argument(
+        "--no-auto-promote", action="store_false", dest="auto_promote",
+        default=True,
+        help="standby: never promote on its own — only an explicit "
+             "`run fabric --promote` / repl.promote admin op",
+    )
+    fabricp.add_argument(
+        "--promote", default=None, metavar="ADDR",
+        help="do not start a broker: tell the STANDBY at host:port to "
+             "promote NOW, print its reply, and exit (the manual "
+             "failover drill)",
     )
 
     ctlp = sub.add_parser(
@@ -1375,6 +1420,59 @@ def main(argv: Optional[list[str]] = None) -> None:
     ensure_built()
 
     if args.cmd == "fabric":
+        if getattr(args, "promote", None):
+            from dynamo_tpu.runtime.fabric.replica import promote_standby
+
+            reply = asyncio.run(promote_standby(args.promote))
+            print(json.dumps({"promote": args.promote, "reply": reply}),
+                  flush=True)
+            sys.exit(0 if reply.get("ok") else 1)
+        if getattr(args, "standby_of", None) or getattr(args, "peer", None):
+            # HA broker (standby, or a primary that can be fenced by
+            # peers); the flag-less path below stays the single-broker
+            # server, bit-identical to before
+            from dynamo_tpu.runtime.fabric.replica import FabricNode
+
+            async def _ha_main() -> None:
+                node = FabricNode(
+                    args.host, args.port,
+                    persist_dir=args.persist_dir,
+                    standby_of=args.standby_of,
+                    peers=tuple(args.peer),
+                    detector_budget_s=args.detector_budget,
+                    auto_promote=args.auto_promote,
+                )
+                await node.start()
+                print(
+                    f"fabric {node.role} on {node.address}"
+                    + (
+                        # live primary address, not args.standby_of: a
+                        # primary-eligible node that DEFERRED to a
+                        # higher-fenced peer is a standby of that peer
+                        f" (standby of {node.server.primary_address})"
+                        if node.role == "standby"
+                        else ""
+                    ),
+                    flush=True,
+                )
+                if node.role == "primary":
+                    node.promoted.clear()  # report only LATER promotions
+                try:
+                    while True:
+                        await node.promoted.wait()
+                        print(
+                            f"fabric PROMOTED to primary on "
+                            f"{node.address} (fence "
+                            f"{node.fabric.fence})",
+                            flush=True,
+                        )
+                        node.promoted.clear()
+                        # a later demotion re-arms the wait
+                finally:
+                    await node.stop()
+
+            asyncio.run(_ha_main())
+            return
         from dynamo_tpu.runtime.fabric.server import _amain
 
         asyncio.run(_amain(args))

@@ -223,6 +223,10 @@ class FrontendMetrics:
         # data-integrity rejections (disk-tier checksum misses, corrupt
         # transfer frames): process-global like the phase histograms
         lines.extend(_debug.integrity_lines())
+        # control-plane HA: degraded gauge + outage/failover counters
+        # for this process's fabric connection (zeros for local
+        # pipelines, which have no broker to lose)
+        lines.extend(_debug.control_plane_lines())
         # KV index health (gaps / resyncs / drift / stale subtrees): the
         # KV-aware router lives in this process in single-process
         # serving — docs/operations.md "KV index consistency"

@@ -135,6 +135,14 @@ _WORKER_FIELDS = (
     ("handover_blocks_total", "counter"),
     ("handovers_adopted_total", "counter"),
     ("kv_transfer_corrupt_total", "counter"),
+    # control-plane HA (docs/operations.md "Control-plane HA"): the
+    # worker's broker-connection view — degraded is live only while the
+    # worker can still publish (partial partitions); the counters carry
+    # the post-recovery accounting of full outages
+    ("degraded", "gauge"),
+    ("degraded_entries_total", "counter"),
+    ("kv_events_dropped_total", "counter"),
+    ("kv_events_pending", "gauge"),
 )
 
 #: numeric per-worker fields copied verbatim into the /v1/fleet snapshot
@@ -149,6 +157,8 @@ _FLEET_WORKER_FIELDS = (
     "handovers_total", "handover_fallbacks_total", "handover_bytes_total",
     "handover_blocks_total", "handovers_adopted_total",
     "kv_transfer_corrupt_total",
+    "degraded", "degraded_entries_total", "kv_events_dropped_total",
+    "kv_events_pending",
 )
 
 
@@ -620,6 +630,36 @@ class MetricsService:
         fam("at_max", "gauge", [("", int(bool(p.get("at_max"))))])
         return lines
 
+    def _control_plane_doc(self) -> dict:
+        """The /v1/fleet `control_plane` section doctor's
+        control-plane-degraded and replication-lag rules read: this
+        process's own broker-connection state plus the latest broker
+        self-metrics (replication lag, fence, orphaned leases)."""
+        fab = self.fabric
+        doc = {
+            "degraded": bool(getattr(fab, "degraded", False)),
+            "disconnected_s": round(
+                float(getattr(fab, "disconnected_s", 0.0) or 0.0), 2
+            ),
+            "degraded_total": int(getattr(fab, "degraded_total", 0) or 0),
+            "failovers_total": int(
+                getattr(fab, "failovers_total", 0) or 0
+            ),
+            "addresses": list(getattr(fab, "addresses", []) or []),
+        }
+        st = self.fabric_stats
+        if st:
+            doc["broker"] = {
+                k: st[k]
+                for k in (
+                    "is_primary", "fence", "repl_subscribers",
+                    "repl_lag_records", "promotions_total",
+                    "demotions_total", "orphaned_leases", "active_leases",
+                )
+                if k in st
+            }
+        return doc
+
     async def _poll_fabric_stats(self) -> None:
         """Broker self-metrics: poll the fabric's `stats` op (RemoteFabric
         issues the wire request; LocalFabric answers in-process). A
@@ -863,6 +903,7 @@ class MetricsService:
                 ),
             },
         }
+        doc["control_plane"] = self._control_plane_doc()
         planner = self._planner_doc()
         if planner is not None:
             doc["planner"] = planner
@@ -1198,6 +1239,10 @@ class MetricsService:
         # data-integrity rejections (disk-tier checksum misses, corrupt
         # transfer frames) — same both-surfaces contract as spec_lines
         lines += _debug.integrity_lines(PREFIX)
+        # control-plane HA: this process's broker-connection state
+        # (degraded gauge, outage counters, client-observed failovers)
+        # — docs/operations.md "Control-plane HA"
+        lines += _debug.control_plane_lines(PREFIX)
         # process-global KV index health (zeros here — this process hosts
         # no router; the per-component fleet view is
         # dynamo_tpu_router_kv_index_* above) — both-surfaces contract

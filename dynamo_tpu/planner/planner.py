@@ -516,11 +516,18 @@ class ControlRunner:
         now_fn=time.monotonic,
         status_fn=None,
         handover=None,
+        degraded_fn=None,
     ):
         self.planner = planner
         self.connector = connector
         self.observe = observe
         self.flipper = flipper
+        #: () -> bool: True while the control plane is DEGRADED (no
+        #: broker answering past the budget — docs/operations.md
+        #: "Control-plane HA"). The planner then HOLDs: its signals are
+        #: frozen snapshots and its actuation (spawn/flip/handover all
+        #: need the fabric) would act on a world it cannot see.
+        self.degraded_fn = degraded_fn
         #: async (role) -> bool: retire one worker of `role` via live KV
         #: handover (docs/operations.md "Rolling upgrades & worker
         #: handover"). When set, scale-DOWN steps try it first — the
@@ -537,6 +544,7 @@ class ControlRunner:
         }
         self.actions_clamped = 0
         self.cooldown_holds = 0
+        self.degraded_holds = 0
         #: consecutive ticks with burn above the band while the decode
         #: target sits at max_decode — the "scaled to the ceiling and
         #: still burning" signal doctor's sla-unrecovered rule fires on
@@ -566,6 +574,31 @@ class ControlRunner:
     async def step(self) -> Actions:
         c = self.planner.config
         state = await self.observe()
+        if self.degraded_fn is not None and self.degraded_fn():
+            # control plane degraded: every signal is a frozen snapshot
+            # and every actuator needs the fabric — HOLD until a broker
+            # answers instead of scaling blind. Checked BEFORE
+            # planner.tick(): feeding the same frozen state through the
+            # planner every held tick would advance its hysteresis
+            # counters / predictor history on outage data and poison
+            # the first post-recovery decision.
+            self.decisions["hold"] += 1
+            self.degraded_holds += 1
+            from dynamo_tpu.telemetry import events
+
+            events.record(
+                "degraded", severity="warning", source="planner",
+                coalesce_s=60.0, action="planner_hold",
+            )
+            logger.warning(
+                "planner HOLD: control plane degraded (no broker "
+                "answering) — signals frozen, actuation suspended"
+            )
+            return Actions(
+                target_decode=state.num_decode,
+                target_prefill=state.num_prefill,
+                reason="hold: control plane degraded",
+            )
         acts = self.planner.tick(state)
         now = self.now_fn()
         budget = getattr(c, "max_actions_per_tick", 1)
@@ -712,6 +745,7 @@ class ControlRunner:
             "flips_total": self.decisions.get("flip", 0),
             "actions_clamped_total": self.actions_clamped,
             "cooldown_holds_total": self.cooldown_holds,
+            "degraded_holds_total": self.degraded_holds,
             "burn_high_ticks": self.burn_high_ticks,
             "at_max": acts.target_decode >= c.max_decode,
             "recent_decisions": list(self.recent),

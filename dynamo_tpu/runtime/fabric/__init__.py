@@ -2,6 +2,11 @@ from dynamo_tpu.runtime.fabric.base import AbstractFabric, Subscription
 from dynamo_tpu.runtime.fabric.local import LocalFabric
 from dynamo_tpu.runtime.fabric.server import FabricServer
 from dynamo_tpu.runtime.fabric.client import RemoteFabric
+from dynamo_tpu.runtime.fabric.replica import (
+    FabricNode,
+    ReplicationTail,
+    fabric_state_digest,
+)
 
 __all__ = [
     "AbstractFabric",
@@ -9,4 +14,7 @@ __all__ = [
     "LocalFabric",
     "FabricServer",
     "RemoteFabric",
+    "FabricNode",
+    "ReplicationTail",
+    "fabric_state_digest",
 ]

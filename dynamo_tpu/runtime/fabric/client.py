@@ -9,6 +9,17 @@ re-sends current state as puts), and subscriptions re-subscribed. In-flight
 calls during the outage fail fast with FabricConnectionError; callers
 retry or surface the error, matching etcd client semantics (the reference
 leans on etcd's own lease keepalive + re-watch machinery the same way).
+
+Control-plane HA (docs/operations.md "Control-plane HA"): the address may
+be a comma-separated list (`--fabric a:4222,b:4222`) — the reconnect loop
+rotates through it, a `NotPrimary` refusal is followed to the hinted
+primary transparently (the op retries there, it was never executed), and
+the per-subscription resume cursors + seq dedup make ringed subjects
+deliver exactly once ACROSS a broker failover. When no broker answers
+past `DYNTPU_DEGRADED_AFTER` seconds the client reports `degraded` — the
+designed broker-less mode: consumers keep serving from cached discovery
+snapshots, publishers buffer or shed, and both Prometheus surfaces gauge
+the state (telemetry/debug.control_plane_lines).
 """
 
 from __future__ import annotations
@@ -16,7 +27,9 @@ from __future__ import annotations
 import asyncio
 import itertools
 import logging
+import os
 import random
+import time
 from typing import Any, Optional
 
 from dynamo_tpu.runtime.codec import encode_frame, read_frame
@@ -26,6 +39,11 @@ from dynamo_tpu.testing import faults
 
 logger = logging.getLogger(__name__)
 
+#: seconds of continuous broker unreachability before the client calls
+#: itself DEGRADED (the designed broker-less mode: cached-discovery
+#: serving, bounded publish buffering, planner HOLD)
+DEGRADED_AFTER_S = float(os.environ.get("DYNTPU_DEGRADED_AFTER", "5.0"))
+
 
 class FabricConnectionError(ConnectionError):
     pass
@@ -33,7 +51,13 @@ class FabricConnectionError(ConnectionError):
 
 class RemoteFabric:
     def __init__(self, address: str, reconnect: bool = True):
-        self.address = address
+        #: failover rotation: `address` may be "a:4222,b:4222" — the
+        #: first entry is tried first, NotPrimary redirects and the
+        #: reconnect loop rotate through the rest
+        self.addresses = [a.strip() for a in address.split(",") if a.strip()]
+        if not self.addresses:
+            raise ValueError(f"no fabric address in {address!r}")
+        self.address = self.addresses[0]
         self.reconnect = reconnect
         self._reader: Optional[asyncio.StreamReader] = None
         self._writer: Optional[asyncio.StreamWriter] = None
@@ -51,15 +75,167 @@ class RemoteFabric:
         #: reconnect (liveness registrations, model entries)
         self._restorable: dict[str, tuple[bytes, Optional[str]]] = {}
         self._send_lock = asyncio.Lock()
+        self._switch_lock = asyncio.Lock()
+        self._switching = False
+        self._in_reestablish = False
+        #: connection generation: a read loop only tears the session
+        #: down if it is still the CURRENT connection's loop (an address
+        #: switch bumps this and owns the transition)
+        self._gen = 0
         self._closed = False
+        #: degraded-mode bookkeeping (docs/operations.md "Control-plane
+        #: HA"): connection state, when it was lost, and the counters
+        #: both Prometheus surfaces expose via control_plane_lines()
+        self.connected = False
+        self.degraded_after_s = DEGRADED_AFTER_S
+        self._disconnected_at: Optional[float] = None
+        self._degraded_marked = False
+        self.degraded_total = 0
+        self.degraded_seconds_total = 0.0
+        #: times the established broker ADDRESS changed (a failover the
+        #: client rode out — redirect-following or rotation)
+        self.failovers_total = 0
+        self._established: Optional[str] = None
+        # exposition registry (weak): whatever Prometheus surface this
+        # process has gauges dynamo_tpu_control_plane_degraded off us
+        from dynamo_tpu.telemetry import debug as _debug
+
+        _debug.register_fabric_client(self)
+
+    @property
+    def degraded(self) -> bool:
+        """True once no broker has answered past the budget — consumers
+        switch to the designed broker-less mode (cached discovery,
+        bounded buffering, planner HOLD)."""
+        return (
+            not self.connected
+            and self._disconnected_at is not None
+            and time.monotonic() - self._disconnected_at
+            >= self.degraded_after_s
+        )
+
+    @property
+    def disconnected_s(self) -> float:
+        if self.connected or self._disconnected_at is None:
+            return 0.0
+        return time.monotonic() - self._disconnected_at
 
     @classmethod
     async def connect(
         cls, address: str, reconnect: bool = True
     ) -> "RemoteFabric":
         self = cls(address, reconnect=reconnect)
-        await self._open()
-        return self
+        last: Optional[Exception] = None
+        for addr in list(self.addresses):
+            self.address = addr
+            try:
+                await self._open()
+            except FabricConnectionError as e:
+                last = e
+                continue
+            # follow a standby's redirect BEFORE the caller's first op:
+            # connecting to the warm standby of a two-broker deployment
+            # must land the session on the primary
+            try:
+                await self._follow_primary()
+            except FabricConnectionError as e:
+                last = e
+                continue
+            self._mark_established()
+            return self
+        raise last or FabricConnectionError(
+            f"cannot reach any fabric in {address!r}"
+        )
+
+    async def _follow_primary(self, hops: int = 3) -> None:
+        """Probe `repl.state` (served in every role) and hop to the
+        advertised primary if this broker is a standby."""
+        for _ in range(hops):
+            try:
+                h, _ = await self._call_raw({"op": "repl.state"})
+            except RuntimeError:
+                return  # pre-HA server: no repl ops, it IS the primary
+            if h.get("role") == "primary" or not h.get("ok"):
+                return
+            hint = h.get("primary") or None
+            # a standby learns its primary lazily; fall back to rotation
+            nxt = hint or self._next_address()
+            if nxt is None or nxt == self.address:
+                return
+            await self._reopen(nxt)
+        raise FabricConnectionError("redirect loop while locating primary")
+
+    def _next_address(self) -> Optional[str]:
+        if len(self.addresses) < 2:
+            return None
+        i = self.addresses.index(self.address) if (
+            self.address in self.addresses
+        ) else -1
+        return self.addresses[(i + 1) % len(self.addresses)]
+
+    def _learn_address(self, addr: str) -> None:
+        if addr and addr not in self.addresses:
+            self.addresses.append(addr)
+
+    async def _reopen(self, addr: str) -> None:
+        """Tear the current connection down quietly (no reconnect-loop
+        spawn) and open `addr` instead. Ops still in flight on the old
+        connection fail fast with FabricConnectionError — they were
+        addressed at a broker that is not (or no longer) the primary."""
+        self._switching = True
+        try:
+            self.connected = False
+            if self._reader_task is not None:
+                self._reader_task.cancel()
+            if self._writer is not None:
+                self._writer.close()
+            self._fail_pending()
+            self.address = addr
+            try:
+                await self._open()
+            except BaseException:
+                # the redirect target is unreachable — hand recovery to
+                # the reconnect loop (the cancelled read loop skipped
+                # spawning one because this switch owned the transition,
+                # so WITHOUT this the client would stay dead forever)
+                if self._disconnected_at is None:
+                    self._disconnected_at = time.monotonic()
+                if (
+                    not self._closed
+                    and self.reconnect
+                    and (
+                        self._reconnect_task is None
+                        or self._reconnect_task.done()
+                    )
+                ):
+                    self._reconnect_task = (
+                        asyncio.get_running_loop().create_task(
+                            self._reconnect_loop()
+                        )
+                    )
+                raise
+        finally:
+            self._switching = False
+
+    def _mark_established(self) -> None:
+        # an establishment always ends any outage bookkeeping: a
+        # connect-time redirect's cancelled read loop may have stamped
+        # _disconnected_at mid-switch, and leaving it stale would make a
+        # LATER sub-second blip read as instantly past the degraded
+        # budget (hours-old timestamp)
+        self._clear_outage()
+        prev, self._established = self._established, self.address
+        if prev is not None and prev != self.address:
+            self.failovers_total += 1
+            logger.warning(
+                "fabric failover: %s -> %s", prev, self.address
+            )
+            from dynamo_tpu.telemetry import events
+
+            events.record(
+                "broker_failover", severity="warning", source=prev,
+                to=self.address,
+            )
 
     async def _open(self) -> None:
         host, port = self.address.rsplit(":", 1)
@@ -69,14 +245,29 @@ class RemoteFabric:
             )
         except OSError as e:
             raise FabricConnectionError(f"cannot reach fabric at {self.address}: {e}")
+        self._gen += 1
+        self.connected = True
         self._reader_task = asyncio.get_running_loop().create_task(
             self._read_loop()
         )
 
+    def _fail_pending(self) -> None:
+        err = FabricConnectionError(f"fabric connection {self.address} lost")
+        for fut in self._pending.values():
+            if not fut.done():
+                fut.set_exception(err)
+                # a requester that was itself cancelled at teardown never
+                # awaits this future; pre-retrieve the exception so GC
+                # doesn't log "exception was never retrieved" (a later
+                # await still raises — only the log flag is cleared)
+                fut.exception()
+        self._pending.clear()
+
     async def _read_loop(self) -> None:
+        gen, reader = self._gen, self._reader
         try:
             while True:
-                header, payload = await read_frame(self._reader)
+                header, payload = await read_frame(reader)
                 if "push" in header:
                     self._handle_push(header, payload)
                     continue
@@ -86,21 +277,22 @@ class RemoteFabric:
         except (asyncio.IncompleteReadError, ConnectionError, asyncio.CancelledError):
             pass
         finally:
-            err = FabricConnectionError(f"fabric connection {self.address} lost")
-            for fut in self._pending.values():
-                if not fut.done():
-                    fut.set_exception(err)
-                    # a requester that was itself cancelled at teardown never
-                    # awaits this future; pre-retrieve the exception so GC
-                    # doesn't log "exception was never retrieved" (a later
-                    # await still raises — only the log flag is cleared)
-                    fut.exception()
-            self._pending.clear()
+            if gen != self._gen:
+                # a deliberate address switch already replaced this
+                # connection (and failed its pending futures) — a late-
+                # running finally must not tear the NEW session down
+                return
+            self.connected = False
+            if self._disconnected_at is None:
+                self._disconnected_at = time.monotonic()
+            self._fail_pending()
             if self._closed or not self.reconnect:
                 for w in list(self._watches.values()):
                     w.close()
                 for s in list(self._subs.values()):
                     s.close()
+            elif self._switching:
+                pass  # _reopen owns the transition
             elif self._reconnect_task is None or self._reconnect_task.done():
                 self._reconnect_task = asyncio.get_running_loop().create_task(
                     self._reconnect_loop()
@@ -108,22 +300,80 @@ class RemoteFabric:
 
     # -- session re-establishment ------------------------------------------
 
+    def _maybe_mark_degraded(self) -> None:
+        if self._degraded_marked or not self.degraded:
+            return
+        self._degraded_marked = True
+        self.degraded_total += 1
+        logger.warning(
+            "control plane DEGRADED: no broker answered for %.1fs "
+            "(tried %s) — serving from cached discovery, publishes "
+            "buffer/shed until a broker returns",
+            self.disconnected_s, ",".join(self.addresses),
+        )
+        from dynamo_tpu.telemetry import events
+
+        events.record(
+            "degraded", severity="warning", source=self.address,
+            phase="enter", addresses=",".join(self.addresses),
+        )
+
+    def _clear_outage(self) -> None:
+        if self._disconnected_at is not None and self._degraded_marked:
+            outage = time.monotonic() - self._disconnected_at
+            self.degraded_seconds_total += outage
+            logger.info(
+                "control plane recovered after %.1fs degraded", outage
+            )
+            from dynamo_tpu.telemetry import events
+
+            events.record(
+                "degraded", source=self.address, phase="exit",
+                outage_s=round(outage, 2),
+            )
+        self._degraded_marked = False
+        self._disconnected_at = None
+
     async def _reconnect_loop(self) -> None:
         delay = 0.2
+        start = (
+            self.addresses.index(self.address)
+            if self.address in self.addresses
+            else 0
+        )
+        attempt = 0
         while not self._closed:
             await asyncio.sleep(delay * (0.7 + 0.6 * random.random()))
             delay = min(delay * 1.7, 2.0)
+            self._maybe_mark_degraded()
+            # rotate through the address list: whichever broker answers
+            # (and, via _follow_primary, whoever it says is primary) wins
+            self.address = self.addresses[
+                (start + attempt) % len(self.addresses)
+            ]
+            attempt += 1
             try:
                 await self._open()
+                await self._follow_primary()
                 await self._reestablish()
             except Exception:
+                self.connected = False
                 if self._writer is not None:
                     self._writer.close()
                 continue
+            self._mark_established()
+            self._clear_outage()
             logger.info("fabric session re-established with %s", self.address)
             return
 
     async def _reestablish(self) -> None:
+        self._in_reestablish = True
+        try:
+            await self._reestablish_inner()
+        finally:
+            self._in_reestablish = False
+
+    async def _reestablish_inner(self) -> None:
         for lease in list(self._leases):
             await self._call(
                 {
@@ -224,7 +474,11 @@ class RemoteFabric:
                     s.last_seq = seq
                 s._push(BusMessage(h["subject"], h.get("header"), payload, seq))
 
-    async def _call(self, header: dict, payload: bytes = b"") -> tuple[Any, bytes]:
+    async def _call_raw(
+        self, header: dict, payload: bytes = b""
+    ) -> tuple[Any, bytes]:
+        """Send one op and await its reply frame — no ok/NotPrimary
+        interpretation (that's _call's job)."""
         # fault-injection hook (dynamo_tpu/testing/faults.py): a no-op
         # global check unless a chaos scenario installed an injector
         try:
@@ -237,6 +491,7 @@ class RemoteFabric:
         self._pending[rid] = fut
         async with self._send_lock:
             if self._writer is None:
+                self._pending.pop(rid, None)
                 raise FabricConnectionError("not connected")
             # corrupt-kind chaos rules flip a byte of the encoded frame
             # (queue payloads included) AFTER the codec checksummed it —
@@ -250,10 +505,51 @@ class RemoteFabric:
                 )
             )
             await self._writer.drain()
-        h, p = await fut
-        if not h.get("ok"):
-            raise RuntimeError(f"fabric {header.get('op')}: {h.get('error')}")
-        return h, p
+        return await fut
+
+    async def _call(self, header: dict, payload: bytes = b"") -> tuple[Any, bytes]:
+        for _ in range(4):
+            sent_on = self.address
+            h, p = await self._call_raw(header, payload)
+            if h.get("not_primary"):
+                # epoch-fenced redirect: the broker refused because it is
+                # a standby / demoted stale primary. The op was NOT
+                # executed, so retrying it on the hinted primary is safe.
+                hint = h.get("primary") or None
+                if hint:
+                    self._learn_address(hint)
+                if self._in_reestablish:
+                    # _reestablish runs under the reconnect loop (which
+                    # re-probes for the primary) — switching here would
+                    # re-enter the switch lock. Fail fast; the loop
+                    # rotates and retries.
+                    raise FabricConnectionError(
+                        f"fabric at {sent_on} is not primary"
+                    )
+                nxt = hint or self._next_address()
+                if nxt is None:
+                    raise FabricConnectionError(
+                        f"fabric at {sent_on} is not primary and no "
+                        "alternate address is configured"
+                    )
+                async with self._switch_lock:
+                    if self.address == sent_on and not self._closed:
+                        logger.warning(
+                            "fabric %s answered NotPrimary; following "
+                            "redirect to %s", sent_on, nxt,
+                        )
+                        await self._reopen(nxt)
+                        # the new primary needs this client's SESSION —
+                        # leases reattached, leased keys re-put, watches
+                        # reset, subscriptions resumed from their cursors
+                        await self._reestablish()
+                        self._mark_established()
+                        self._clear_outage()
+                continue
+            if not h.get("ok"):
+                raise RuntimeError(f"fabric {header.get('op')}: {h.get('error')}")
+            return h, p
+        raise FabricConnectionError("NotPrimary redirect loop")
 
     # -- kv ----------------------------------------------------------------
 

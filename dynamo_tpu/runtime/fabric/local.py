@@ -40,6 +40,22 @@ RING_SUBJECTS = tuple(
     if p
 )
 
+#: replication: how many un-consumed journal records a live replication
+#: subscriber may fall behind before it is disconnected (it re-bootstraps
+#: from a fresh snapshot — bounded memory beats an unbounded backlog)
+REPL_QUEUE_CAP = int(os.environ.get("DYNTPU_FABRIC_REPL_QUEUE", "8192"))
+
+#: promotion jumps the publish sequence forward by this much: a standby
+#: may lag the dead primary by a few records, so seqs it would otherwise
+#: mint could COLLIDE with seqs the primary already delivered — a
+#: subscriber's duplicate guard would then swallow fresh messages. The
+#: skip keeps post-failover seqs disjoint; cursors inside the skipped
+#: range mark a replication-lag gap (resume flags it, sequencing
+#: consumers resync).
+PROMOTE_SEQ_SKIP = int(
+    os.environ.get("DYNTPU_FABRIC_PROMOTE_SEQ_SKIP", "1000000")
+)
+
 
 class _LocalQueue:
     def __init__(self):
@@ -75,8 +91,24 @@ class LocalFabric:
         self.redeliveries_total = 0
         #: broker epoch: a resume cursor is only meaningful against the
         #: epoch it was minted under. PersistentFabric restores it from
-        #: the WAL so cursors survive server restarts.
+        #: the WAL so cursors survive server restarts. A promoted standby
+        #: KEEPS the epoch (its ring is a replica of the primary's, same
+        #: seqs) so resume cursors stay valid across a failover.
         self.epoch = uuid.uuid4().hex
+        #: fencing counter (monotonic, unlike the opaque epoch string):
+        #: every promotion bumps it, the WAL fsyncs the bump, and a
+        #: returning broker with a LOWER fence demotes instead of
+        #: split-braining (docs/operations.md "Control-plane HA")
+        self.fence = 1
+        #: live replication subscribers: every journaled mutation record
+        #: fans out to these queues (the `repl.subscribe` stream a warm
+        #: standby tails) — empty in single-broker deployments, so the
+        #: journal tap costs one falsy check per mutation
+        self._repl_subs: list[asyncio.Queue] = []
+        #: (pre, post) publish-seq ranges skipped by promotions: a resume
+        #: cursor inside one belongs to messages only the dead primary
+        #: ever had — flagged as a gap so sequencing consumers resync
+        self._promote_gaps: list[tuple[int, int]] = []
         #: global publish sequence — advances ONLY for ring-retained
         #: subjects, so the WAL can restore it exactly (every ringed
         #: publish is journaled; unringed traffic never moves it)
@@ -101,6 +133,12 @@ class LocalFabric:
         return {
             "active_subs": sum(1 for s in self._subs if not s._closed),
             "active_leases": len(getattr(self.store, "_leases", ())),
+            # leases restored after a restart/promotion whose owners have
+            # not reattached yet (the orphan-grace window — climbing
+            # after a failover means workers are not finding the new
+            # primary)
+            "orphaned_leases": len(getattr(self.store, "_orphaned", ())),
+            "fence": self.fence,
             "objects": len(self._objects),
             "redeliveries_total": self.redeliveries_total,
             "ring_subjects": len(self._rings),
@@ -119,13 +157,126 @@ class LocalFabric:
             },
         }
 
+    # -- journal tap -------------------------------------------------------
+    # Every mutation emits ONE canonical record (the same header shapes
+    # PersistentFabric has always written to its WAL — persist.py owns
+    # the replay side). LocalFabric's `_journal` fans records out to live
+    # replication subscribers; PersistentFabric extends it to also append
+    # the WAL. With neither a WAL nor a standby attached, the tap is one
+    # falsy check per mutation (single-broker path unchanged).
+
+    def _journal(self, header: dict, payload: bytes = b"") -> None:
+        if not self._repl_subs:
+            return
+        for q in list(self._repl_subs):
+            if q.qsize() >= REPL_QUEUE_CAP:
+                # a subscriber this far behind re-bootstraps from a fresh
+                # snapshot; an unbounded backlog would eat the broker
+                self._repl_subs.remove(q)
+                q.put_nowait(None)
+                continue
+            q.put_nowait((header, payload))
+
+    def repl_attach(self) -> asyncio.Queue:
+        """Attach a live replication subscriber. Call snapshot_records()
+        and this in ONE synchronous block (no await between) so the
+        snapshot + tail form a consistent cut of the mutation stream."""
+        q: asyncio.Queue = asyncio.Queue()
+        self._repl_subs.append(q)
+        return q
+
+    def repl_detach(self, q: asyncio.Queue) -> None:
+        if q in self._repl_subs:
+            self._repl_subs.remove(q)
+
+    def snapshot_records(self) -> list[tuple[dict, bytes]]:
+        """Current state as canonical journal records (snapshot-as-WAL):
+        the replication bootstrap AND PersistentFabric's compaction both
+        write exactly this."""
+        records: list[tuple[dict, bytes]] = [
+            (
+                {"r": "pubmark", "epoch": self.epoch, "seq": self.pub_seq,
+                 "fence": self.fence},
+                b"",
+            )
+        ]
+        ring_msgs = sorted(
+            (m for ring in self._rings.values() for m in ring),
+            key=lambda m: m.seq,
+        )
+        for m in ring_msgs:
+            records.append(
+                (
+                    {"r": "pub", "subject": m.subject, "header": m.header,
+                     "seq": m.seq},
+                    m.payload,
+                )
+            )
+        for lease_id, ttl in self.store._lease_ttl.items():
+            records.append(({"r": "lease", "lease": lease_id, "ttl": ttl}, b""))
+        for key, e in self.store._data.items():
+            records.append(
+                ({"r": "put", "key": key, "lease": e.lease_id}, e.value)
+            )
+        for name, q in self._queues.items():
+            # inflight items were never acked: snapshot them as pending
+            for item in list(q.inflight.values()) + list(q.items):
+                records.append(
+                    (
+                        {"r": "qpush", "queue": name, "item": item.item_id,
+                         "header": item.header},
+                        item.payload,
+                    )
+                )
+        for name, data in self._objects.items():
+            records.append(({"r": "oput", "name": name}, data))
+        return records
+
+    def promote_state(self, seq_skip: int = PROMOTE_SEQ_SKIP) -> None:
+        """Standby -> primary state transition: bump the fence (the
+        monotonic split-brain guard), skip the publish sequence past any
+        seqs the dead primary may have minted beyond our replication
+        watermark, and journal the bump — PersistentFabric fsyncs
+        pubmark records ALWAYS, so the promoted fence survives host
+        power loss and can never regress."""
+        self.fence += 1
+        pre = self.pub_seq
+        if seq_skip > 0:
+            self.pub_seq += seq_skip
+            self._promote_gaps.append((pre, self.pub_seq))
+        self._journal(
+            {"r": "pubmark", "epoch": self.epoch, "seq": self.pub_seq,
+             "fence": self.fence}
+        )
+
+    def reset_for_bootstrap(self, epoch: str, fence: int) -> None:
+        """Drop all state ahead of a replication bootstrap (the snapshot
+        records that follow rebuild it) and adopt the primary's epoch +
+        fence so resume cursors and the fencing order survive a later
+        promotion."""
+        self.store.close()
+        from dynamo_tpu.runtime.store import MemStore
+
+        self.store = MemStore()
+        self._queues.clear()
+        self._objects.clear()
+        self._rings.clear()
+        self._ring_trimmed.clear()
+        self.pub_seq = 0
+        self.epoch = epoch
+        self.fence = int(fence)
+
     # -- kv/lease/watch: delegate ------------------------------------------
 
     async def put(self, key, value, lease_id=None):
         await self.store.put(key, value, lease_id)
+        self._journal({"r": "put", "key": key, "lease": lease_id}, value)
 
     async def create(self, key, value, lease_id=None):
-        return await self.store.create(key, value, lease_id)
+        created = await self.store.create(key, value, lease_id)
+        if created:
+            self._journal({"r": "put", "key": key, "lease": lease_id}, value)
+        return created
 
     async def get(self, key):
         return await self.store.get(key)
@@ -134,22 +285,29 @@ class LocalFabric:
         return await self.store.get_prefix(prefix)
 
     async def delete(self, key):
-        return await self.store.delete(key)
+        deleted = await self.store.delete(key)
+        if deleted:
+            self._journal({"r": "del", "key": key})
+        return deleted
 
     async def watch_prefix(self, prefix) -> Watch:
         return await self.store.watch_prefix(prefix)
 
     async def grant_lease(self, ttl):
-        return await self.store.grant_lease(ttl)
+        lease = await self.store.grant_lease(ttl)
+        self._journal({"r": "lease", "lease": lease, "ttl": ttl})
+        return lease
 
     async def keepalive(self, lease_id):
         return await self.store.keepalive(lease_id)
 
     async def reattach_lease(self, lease_id, ttl):
-        await self.store.reattach_lease(lease_id, ttl)
+        if await self.store.reattach_lease(lease_id, ttl):
+            self._journal({"r": "lease", "lease": lease_id, "ttl": ttl})
 
     async def revoke_lease(self, lease_id):
         await self.store.revoke_lease(lease_id)
+        self._journal({"r": "lease_rm", "lease": lease_id})
 
     # -- pub/sub -----------------------------------------------------------
 
@@ -170,6 +328,13 @@ class LocalFabric:
         msg = BusMessage(subject, header, payload, seq)
         if seq:
             self._ring_append(msg)
+            # only ring-retained publishes are journaled (they carry the
+            # seq watermark; fire-and-forget traffic has no resume story)
+            self._journal(
+                {"r": "pub", "subject": subject, "header": header,
+                 "seq": seq},
+                payload,
+            )
         for sub in self._subs:
             if subject_matches(sub.subject, subject):
                 sub._push(msg)
@@ -196,6 +361,12 @@ class LocalFabric:
                 if self._ring_trimmed.get(subj, 0) > from_seq:
                     gap = True
                 replay.extend(m for m in ring if m.seq > from_seq)
+            for pre, post in self._promote_gaps:
+                # cursor inside a promotion skip range: the subscriber
+                # saw messages only the dead primary ever had (they
+                # outran replication) — honest loss, resync territory
+                if pre < from_seq <= post:
+                    gap = True
             replay.sort(key=lambda m: m.seq)
             for m in replay:
                 sub._push(m)
@@ -210,6 +381,11 @@ class LocalFabric:
     async def queue_push(self, queue, header, payload=b"") -> QueueItem:
         item = QueueItem(uuid.uuid4().hex, header, payload)
         self._q(queue).push(item)
+        self._journal(
+            {"r": "qpush", "queue": queue, "item": item.item_id,
+             "header": header},
+            payload,
+        )
         return item
 
     async def queue_pop(self, queue, timeout=None):
@@ -235,6 +411,7 @@ class LocalFabric:
 
     async def queue_ack(self, queue, item_id):
         self._q(queue).inflight.pop(item_id, None)
+        self._journal({"r": "qack", "queue": queue, "item": item_id})
 
     async def queue_nack(self, queue, item_id):
         q = self._q(queue)
@@ -257,14 +434,21 @@ class LocalFabric:
 
     async def obj_put(self, name, data):
         self._objects[name] = bytes(data)
+        self._journal({"r": "oput", "name": name}, bytes(data))
 
     async def obj_get(self, name):
         return self._objects.get(name)
 
     async def obj_delete(self, name):
-        return self._objects.pop(name, None) is not None
+        deleted = self._objects.pop(name, None) is not None
+        if deleted:
+            self._journal({"r": "odel", "name": name})
+        return deleted
 
     async def close(self):
         self.store.close()
         for s in self._subs:
             s.close()
+        for q in self._repl_subs:
+            q.put_nowait(None)
+        self._repl_subs.clear()

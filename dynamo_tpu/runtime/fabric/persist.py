@@ -2,26 +2,37 @@
 
 The reference's control plane survives restarts because etcd raft-persists
 every write and JetStream journals queue items. This gives the single
-fabric server the same survival story at its scale: every mutation is
-appended to a WAL (codec-framed records, so a torn tail from a crash is
-detected by checksum and dropped); startup replays the log, then compacts
-it to a fresh snapshot-as-WAL. Leases are restored in an ORPHANED state —
-deadline = now + max(ttl, orphan_grace) — giving their owners a reconnect
-window (lease.reattach) before expiry deletes their keys, which is exactly
-etcd's lease-TTL-survives-restart behavior (transports/etcd.rs:78).
+fabric server the same survival story at its scale: every mutation record
+LocalFabric journals (local.py `_journal` — the same stream a warm standby
+tails over `repl.subscribe`) is appended to a WAL (codec-framed records,
+so a torn tail from a crash is detected by checksum and dropped); startup
+replays the log, then compacts it to a fresh snapshot-as-WAL. Leases are
+restored in an ORPHANED state — deadline = now + max(ttl, orphan_grace) —
+giving their owners a reconnect window (lease.reattach) before expiry
+deletes their keys, which is exactly etcd's lease-TTL-survives-restart
+behavior (transports/etcd.rs:78).
 
-Durability trade: records are flushed (OS buffer) but not fsync'd per
-record — a host power loss can drop the tail; a process crash cannot.
+Durability trade (`DYNTPU_FABRIC_FSYNC`):
+  epoch (default)  records are flushed (OS buffer) but only `pubmark`
+                   records — the broker epoch/fence bumps a promotion
+                   writes — are fsync'd: a host power loss can drop the
+                   mutation tail, but FENCING stays monotonic, so a
+                   promoted standby can never be out-fenced by a
+                   resurrected stale primary (a process crash drops
+                   nothing either way).
+  always           fsync every record (etcd-grade durability; lease
+                   grants and KV writes survive power loss too).
 """
 
 from __future__ import annotations
 
 import logging
 import os
+import time
 from typing import Optional
 
 from dynamo_tpu.runtime.codec import CodecError, decode_frame, encode_frame
-from dynamo_tpu.runtime.fabric.base import QueueItem
+from dynamo_tpu.runtime.fabric.base import BusMessage, QueueItem
 from dynamo_tpu.runtime.fabric.local import LocalFabric
 
 logger = logging.getLogger(__name__)
@@ -31,6 +42,114 @@ WAL_NAME = "fabric.wal"
 DEFAULT_ORPHAN_GRACE = 10.0
 #: compact when the WAL holds this many records beyond live state
 COMPACT_SLACK = 5000
+
+
+def _fsync_mode() -> str:
+    mode = os.environ.get("DYNTPU_FABRIC_FSYNC", "epoch").strip().lower()
+    if mode not in ("epoch", "always"):
+        logger.warning(
+            "DYNTPU_FABRIC_FSYNC=%r is not epoch|always; using epoch", mode
+        )
+        return "epoch"
+    return mode
+
+
+def apply_record(fabric: LocalFabric, h: dict, p: bytes) -> None:
+    """Apply ONE canonical journal record to a fabric. Shared by WAL
+    replay and the replication tail (fabric/replica.py), so a standby
+    applying the record stream converges on exactly the state a restart
+    would rebuild. Lease records register the lease with NO deadline
+    (deadline 0 is already-expired under the reaper — callers stamp
+    deadlines: replay orphans with the grace window, a standby pins them
+    far-future until promotion orphans them)."""
+    op = h["r"]
+    store = fabric.store
+    if op == "pubmark":
+        # replay-ring continuity: the broker epoch + publish seq + fence
+        # survive the restart, so subscriber resume cursors stay valid
+        # (client.py _apply_sub_reply) and fencing stays monotonic
+        fabric.epoch = h["epoch"]
+        fabric.pub_seq = max(fabric.pub_seq, int(h.get("seq") or 0))
+        fabric.fence = max(fabric.fence, int(h.get("fence") or 1))
+    elif op == "pub":
+        seq = int(h.get("seq") or 0)
+        fabric._ring_append(BusMessage(h["subject"], h.get("header"), p, seq))
+        fabric.pub_seq = max(fabric.pub_seq, seq)
+    elif op == "lease":
+        store._leases.setdefault(h["lease"], float("inf"))
+        store._lease_ttl[h["lease"]] = h["ttl"]
+        store._lease_keys.setdefault(h["lease"], set())
+    elif op == "lease_rm":
+        # synchronous revoke: _lease_keys deletions must not await
+        store._leases.pop(h["lease"], None)
+        store._lease_ttl.pop(h["lease"], None)
+        getattr(store, "_orphaned", set()).discard(h["lease"])
+        for key in list(store._lease_keys.pop(h["lease"], ())):
+            e = store._data.pop(key, None)
+            if e is not None:
+                from dynamo_tpu.runtime.store import WatchEvent
+
+                store._notify(WatchEvent("delete", key))
+    elif op == "put":
+        lease = h.get("lease")
+        if lease is not None:
+            # the record stream grants leases before binding keys, but a
+            # torn WAL tail / replication race must not kill the apply
+            store._leases.setdefault(lease, float("inf"))
+            store._lease_ttl.setdefault(lease, 3.0)
+        prev = store._data.get(key := h["key"])
+        if prev is not None and prev.lease_id and prev.lease_id != lease:
+            store._lease_keys.get(prev.lease_id, set()).discard(key)
+        if lease is not None:
+            store._lease_keys.setdefault(lease, set()).add(key)
+        from dynamo_tpu.runtime.store import KvEntry, WatchEvent
+
+        store._data[key] = KvEntry(key, p, lease)
+        store._notify(WatchEvent("put", key, p))
+    elif op == "del":
+        e = store._data.pop(h["key"], None)
+        if e is not None:
+            if e.lease_id and e.lease_id in store._lease_keys:
+                store._lease_keys[e.lease_id].discard(h["key"])
+            from dynamo_tpu.runtime.store import WatchEvent
+
+            store._notify(WatchEvent("delete", h["key"]))
+    elif op == "qpush":
+        q = fabric._q(h["queue"])
+        if h["item"] not in q.inflight and not any(
+            it.item_id == h["item"] for it in q.items
+        ):
+            q.push(QueueItem(h["item"], h.get("header"), p))
+    elif op == "qack":
+        q = fabric._q(h["queue"])
+        q.inflight.pop(h["item"], None)
+        for i, item in enumerate(q.items):
+            if item.item_id == h["item"]:
+                del q.items[i]
+                break
+    elif op == "oput":
+        fabric._objects[h["name"]] = bytes(p)
+    elif op == "odel":
+        fabric._objects.pop(h["name"], None)
+    else:
+        raise ValueError(f"unknown journal record {op!r}")
+
+
+def orphan_leases(fabric: LocalFabric, grace: float) -> int:
+    """Stamp every lease with deadline = now + max(ttl, grace): owners
+    get a reconnect window (lease.reattach), then normal expiry deletes
+    their keys. Used by WAL replay AND standby promotion."""
+    store = fabric.store
+    now = time.monotonic()
+    orphaned = getattr(store, "_orphaned", None)
+    if orphaned is None:
+        orphaned = store._orphaned = set()
+    for lease_id, ttl in store._lease_ttl.items():
+        store._leases[lease_id] = now + max(ttl, grace)
+        orphaned.add(lease_id)
+    if store._lease_ttl:
+        store._ensure_reaper()
+    return len(store._lease_ttl)
 
 
 class PersistentFabric(LocalFabric):
@@ -46,15 +165,24 @@ class PersistentFabric(LocalFabric):
         self._path = os.path.join(directory, WAL_NAME)
         self._wal = None
         self._records = 0
+        self._fsync = _fsync_mode()
 
     # -- journal -----------------------------------------------------------
 
-    def _append(self, header: dict, payload: bytes = b"") -> None:
+    def _journal(self, header: dict, payload: bytes = b"") -> None:
+        super()._journal(header, payload)  # live replication subscribers
         if self._wal is None:
             return
         self._wal.write(encode_frame(header, payload))
         self._wal.flush()
+        if self._fsync == "always" or header.get("r") == "pubmark":
+            # pubmark carries the epoch + FENCE: a promotion's fence bump
+            # must survive host power loss, or a resurrected stale
+            # primary could out-fence the live one (split brain)
+            os.fsync(self._wal.fileno())
         self._records += 1
+        if self._records >= COMPACT_SLACK:
+            self._compact()
 
     async def load_and_open(self) -> None:
         """Replay an existing WAL, then compact and start journaling."""
@@ -74,112 +202,29 @@ class PersistentFabric(LocalFabric):
                     break
                 records.append((h, p))
                 off += used
-        await self._replay(records)
-        await self._compact()
-
-    async def _replay(self, records) -> None:
-        import time
-
         for h, p in records:
-            op = h["r"]
             try:
-                if op == "pubmark":
-                    # replay-ring continuity: the broker epoch + publish
-                    # seq survive the restart, so subscriber resume
-                    # cursors stay valid (client.py _apply_sub_reply)
-                    self.epoch = h["epoch"]
-                    self.pub_seq = max(self.pub_seq, int(h.get("seq") or 0))
-                elif op == "pub":
-                    from dynamo_tpu.runtime.fabric.base import BusMessage
-
-                    seq = int(h.get("seq") or 0)
-                    self._ring_append(
-                        BusMessage(h["subject"], h.get("header"), p, seq)
-                    )
-                    self.pub_seq = max(self.pub_seq, seq)
-                elif op == "lease":
-                    # restore the id verbatim; deadline set below
-                    self.store._leases[h["lease"]] = 0.0
-                    self.store._lease_ttl[h["lease"]] = h["ttl"]
-                    self.store._lease_keys.setdefault(h["lease"], set())
-                elif op == "lease_rm":
-                    await self.store.revoke_lease(h["lease"])
-                elif op == "put":
-                    await self.store.put(h["key"], p, h.get("lease"))
-                elif op == "del":
-                    await self.store.delete(h["key"])
-                elif op == "qpush":
-                    self._q(h["queue"]).push(
-                        QueueItem(h["item"], h.get("header"), p)
-                    )
-                elif op == "qack":
-                    q = self._q(h["queue"])
-                    q.inflight.pop(h["item"], None)
-                    for i, item in enumerate(q.items):
-                        if item.item_id == h["item"]:
-                            del q.items[i]
-                            break
-                elif op == "oput":
-                    self._objects[h["name"]] = bytes(p)
-                elif op == "odel":
-                    self._objects.pop(h["name"], None)
+                apply_record(self, h, p)
             except Exception:
                 logger.exception("WAL replay failed for %r", h)
-        # Orphan every restored lease: owners get a reconnect window, then
-        # normal expiry semantics delete their keys.
-        now = time.monotonic()
-        for lease_id, ttl in self.store._lease_ttl.items():
-            self.store._leases[lease_id] = now + max(ttl, self.orphan_grace)
+        # Orphan every restored lease: owners get a reconnect window,
+        # then normal expiry semantics delete their keys.
+        orphan_leases(self, self.orphan_grace)
         if records:
-            self.store._ensure_reaper()
             logger.info(
                 "fabric WAL replayed: %d records, %d keys, %d leases, "
-                "%d queues, %d objects",
+                "%d queues, %d objects (fence %d)",
                 len(records), len(self.store._data), len(self.store._leases),
-                len(self._queues), len(self._objects),
+                len(self._queues), len(self._objects), self.fence,
             )
+        self._compact()
 
-    async def _compact(self) -> None:
+    def _compact(self) -> None:
         """Rewrite the WAL as current state (snapshot-as-WAL)."""
         tmp = self._path + ".tmp"
         with open(tmp, "wb") as f:
-            f.write(
-                encode_frame(
-                    {"r": "pubmark", "epoch": self.epoch, "seq": self.pub_seq}
-                )
-            )
-            ring_msgs = sorted(
-                (m for ring in self._rings.values() for m in ring),
-                key=lambda m: m.seq,
-            )
-            for m in ring_msgs:
-                f.write(
-                    encode_frame(
-                        {"r": "pub", "subject": m.subject,
-                         "header": m.header, "seq": m.seq},
-                        m.payload,
-                    )
-                )
-            for lease_id, ttl in self.store._lease_ttl.items():
-                f.write(encode_frame({"r": "lease", "lease": lease_id, "ttl": ttl}))
-            for key, e in self.store._data.items():
-                f.write(
-                    encode_frame(
-                        {"r": "put", "key": key, "lease": e.lease_id}, e.value
-                    )
-                )
-            for name, q in self._queues.items():
-                # inflight items were never acked: restore them as pending
-                for item in list(q.inflight.values()) + list(q.items):
-                    f.write(
-                        encode_frame(
-                            {"r": "qpush", "queue": name, "item": item.item_id,
-                             "header": item.header},
-                            item.payload,
-                        )
-                    )
-            for name, data in self._objects.items():
-                f.write(encode_frame({"r": "oput", "name": name}, data))
+            for h, p in self.snapshot_records():
+                f.write(encode_frame(h, p))
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, self._path)
@@ -187,84 +232,6 @@ class PersistentFabric(LocalFabric):
             self._wal.close()
         self._wal = open(self._path, "ab")
         self._records = 0
-
-    async def _maybe_compact(self) -> None:
-        if self._records >= COMPACT_SLACK:
-            await self._compact()
-
-    # -- journaled mutations ----------------------------------------------
-
-    async def publish(self, subject, header, payload=b""):
-        before = self.pub_seq
-        await super().publish(subject, header, payload)
-        if self.pub_seq != before:
-            # ring-retained subject: journal it so the replay ring (and
-            # the seq watermark) survive a server restart — the WAL's
-            # JetStream-shaped corner
-            self._append(
-                {"r": "pub", "subject": subject, "header": header,
-                 "seq": self.pub_seq},
-                payload,
-            )
-            await self._maybe_compact()
-
-    async def put(self, key, value, lease_id=None):
-        await super().put(key, value, lease_id)
-        self._append({"r": "put", "key": key, "lease": lease_id}, value)
-        await self._maybe_compact()
-
-    async def create(self, key, value, lease_id=None):
-        created = await super().create(key, value, lease_id)
-        if created:
-            self._append({"r": "put", "key": key, "lease": lease_id}, value)
-            await self._maybe_compact()
-        return created
-
-    async def delete(self, key):
-        deleted = await super().delete(key)
-        if deleted:
-            self._append({"r": "del", "key": key})
-        return deleted
-
-    async def grant_lease(self, ttl):
-        lease = await super().grant_lease(ttl)
-        self._append({"r": "lease", "lease": lease, "ttl": ttl})
-        return lease
-
-    async def reattach_lease(self, lease_id: str, ttl: float) -> None:
-        """Re-establish a lease by id after a restart (or create it if the
-        orphan window already expired — the owner re-puts its keys next)."""
-        if await self.store.reattach_lease(lease_id, ttl):
-            self._append({"r": "lease", "lease": lease_id, "ttl": ttl})
-
-    async def revoke_lease(self, lease_id):
-        await super().revoke_lease(lease_id)
-        self._append({"r": "lease_rm", "lease": lease_id})
-
-    async def queue_push(self, queue, header, payload=b""):
-        item = await super().queue_push(queue, header, payload)
-        self._append(
-            {"r": "qpush", "queue": queue, "item": item.item_id,
-             "header": header},
-            payload,
-        )
-        await self._maybe_compact()
-        return item
-
-    async def queue_ack(self, queue, item_id):
-        await super().queue_ack(queue, item_id)
-        self._append({"r": "qack", "queue": queue, "item": item_id})
-
-    async def obj_put(self, name, data):
-        await super().obj_put(name, data)
-        self._append({"r": "oput", "name": name}, bytes(data))
-        await self._maybe_compact()
-
-    async def obj_delete(self, name):
-        deleted = await super().obj_delete(name)
-        if deleted:
-            self._append({"r": "odel", "name": name})
-        return deleted
 
     async def close(self):
         await super().close()

@@ -1,0 +1,518 @@
+"""Control-plane HA: warm-standby broker replication + epoch-fenced
+failover (docs/operations.md "Control-plane HA").
+
+The reference survives control-plane death because etcd raft-replicates
+every write and JetStream runs replicated streams; the single
+FabricServer was this stack's last SPOF. This module closes it at the
+same scale:
+
+* `ReplicationTail` — the standby's wire client: one `repl.subscribe`
+  session bootstraps from the primary's compacted snapshot-as-WAL
+  records, then applies the live journal tail (persist.apply_record —
+  the byte-for-byte records the WAL holds), acking a watermark the
+  primary exposes as `repl_lag_records`. A corrupt frame (CodecError) or
+  a backlog reset drops the session and re-bootstraps from a FRESH
+  snapshot — a standby can fall behind or restart its tail, but it can
+  never silently diverge.
+
+* `FabricNode` — one HA broker process, primary or standby:
+  - standby (`run fabric --standby-of a:4222`): serves NotPrimary +
+    redirect for data ops while tailing the primary; when the primary is
+    unreachable past `--detector-budget` (or an explicit
+    `run fabric --promote`), it PROMOTES: leases restore ORPHANED with
+    the persist.py grace window, the fence bumps (fsync'd with a WAL —
+    it can never regress), the publish seq skips past anything the dead
+    primary may have minted beyond the replication watermark, and the
+    broker starts serving. The epoch string is KEPT, so subscriber
+    resume cursors stay valid — ringed subjects deliver exactly once
+    across the failover.
+  - a returning stale primary DEMOTES instead of split-braining: on
+    startup it probes `--peer` brokers and defers to any serving primary
+    with a strictly higher fence; the promoted broker's fencer loop
+    also actively delivers `repl.fence` to the old address, so even a
+    peer-less restart is fenced out within seconds. A demoted broker
+    answers every data op with NotPrimary + the live primary's address
+    and re-enters the standby role (fresh bootstrap) — a failover
+    leaves you with a warm standby again.
+
+Exactly one node per deployment runs without `--standby-of`.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import struct
+import time
+from typing import Optional
+
+import xxhash
+
+from dynamo_tpu.runtime.codec import CodecError, encode_frame, read_frame
+from dynamo_tpu.runtime.fabric.local import LocalFabric
+from dynamo_tpu.runtime.fabric.persist import (
+    DEFAULT_ORPHAN_GRACE,
+    PersistentFabric,
+    apply_record,
+    orphan_leases,
+)
+from dynamo_tpu.runtime.fabric.server import FabricServer
+
+logger = logging.getLogger(__name__)
+
+#: seconds of primary unreachability before a standby auto-promotes
+DEFAULT_DETECTOR_BUDGET_S = 3.0
+#: cadence of the promoted broker's active fencing probes
+FENCE_INTERVAL_S = 2.0
+#: ack cadence: records applied between watermark acks
+ACK_EVERY_RECORDS = 64
+
+
+class ReplicaRedirect(Exception):
+    """The tail's target is not the primary; `hint` names who is."""
+
+    def __init__(self, hint: Optional[str]):
+        super().__init__(f"replication target is standby of {hint}")
+        self.hint = hint
+
+
+class ReplicationReset(Exception):
+    """The primary dropped our tail (journal backlog past the cap):
+    re-bootstrap from a fresh snapshot."""
+
+
+def fabric_state_digest(fabric: LocalFabric) -> tuple[int, int]:
+    """(fold, count) over the fabric's full replicated state — KV entries
+    (key, lease binding, value), lease TTL table, objects, queue items
+    (inflight counts as pending: that is exactly how a restart/standby
+    restores it), and the replay rings. The same order-independent
+    xxh3-XOR fold shape as kv_router/digest.py, so primary-vs-standby
+    equality is one integer comparison in tests and chaos proofs."""
+    fold = 0
+    n = 0
+
+    def f(*parts: bytes) -> None:
+        nonlocal fold, n
+        h = xxhash.xxh3_64(b"\x1f".join(parts))
+        fold ^= h.intdigest()
+        n += 1
+
+    for key, e in fabric.store._data.items():
+        f(b"kv", key.encode(), (e.lease_id or "").encode(), e.value)
+    for lease, ttl in fabric.store._lease_ttl.items():
+        f(b"lease", lease.encode(), struct.pack("<d", float(ttl)))
+    for name, data in fabric._objects.items():
+        f(b"obj", name.encode(), data)
+    for qname, q in fabric._queues.items():
+        for item in list(q.inflight.values()) + list(q.items):
+            f(b"q", qname.encode(), item.item_id.encode(), item.payload)
+    for subj, ring in fabric._rings.items():
+        for m in ring:
+            f(b"ring", subj.encode(), struct.pack("<Q", m.seq), m.payload)
+    return fold, n
+
+
+async def _probe(address: str, header: dict, timeout: float = 2.0) -> dict:
+    """One-shot op against a broker: connect, send, read the reply,
+    close. Used for fencing probes and the explicit-promote CLI."""
+    host, port = address.rsplit(":", 1)
+    reader, writer = await asyncio.wait_for(
+        asyncio.open_connection(host, int(port)), timeout
+    )
+    try:
+        header = dict(header, id=1)
+        writer.write(encode_frame(header))
+        await writer.drain()
+        h, _ = await asyncio.wait_for(read_frame(reader), timeout)
+        return h
+    finally:
+        writer.close()
+
+
+class ReplicationTail:
+    """One standby's replication client. `run_once()` = one subscribe
+    session (bootstrap + live tail) that raises on any failure; the
+    owning FabricNode loops it and owns the promotion detector."""
+
+    def __init__(
+        self,
+        fabric: LocalFabric,
+        primary_address: str,
+        ack_every: int = ACK_EVERY_RECORDS,
+        idle_timeout_s: float = 5.0,
+    ):
+        self.fabric = fabric
+        self.primary_address = primary_address
+        self.ack_every = ack_every
+        #: liveness window per read: a QUIET primary is fine (we ping and
+        #: wait one more window), but a session wedged mid-frame — e.g. a
+        #: bit-flipped length prefix has readexactly awaiting bytes that
+        #: will never come — must die and re-bootstrap, not hang the
+        #: standby forever
+        self.idle_timeout_s = idle_timeout_s
+        #: highest record seq acked back to the primary (its lag gauge
+        #: reads delivered - this)
+        self.watermark = 0
+        self.delivered = 0
+        #: snapshot bootstraps completed (a fuzz-poisoned or reset
+        #: session re-bootstraps, bumping this)
+        self.bootstraps = 0
+        self.codec_errors = 0
+        self.snapshot_applied = False
+        #: wall clock of the last applied frame / successful connect —
+        #: the promotion detector's liveness signal
+        self.last_contact = 0.0
+        #: called once per completed bootstrap (FabricNode compacts a
+        #: persistent standby here)
+        self.on_bootstrap = None
+
+    async def _read(self, reader, writer):
+        """read_frame with a liveness bound: on a silent window, ping
+        and allow one more — a healthy-but-quiet primary answers the
+        ping (any frame proves liveness); a wedged torn read swallows
+        the reply bytes, so a second silence kills the session (the
+        cancel may tear a partial frame, which the next read surfaces
+        as CodecError → clean re-bootstrap; never a silent hang)."""
+        try:
+            return await asyncio.wait_for(
+                read_frame(reader), self.idle_timeout_s
+            )
+        except asyncio.TimeoutError:
+            writer.write(encode_frame({"op": "ping"}))
+            await writer.drain()
+        try:
+            return await asyncio.wait_for(
+                read_frame(reader), self.idle_timeout_s
+            )
+        except asyncio.TimeoutError:
+            raise ConnectionError(
+                f"replication stream from {self.primary_address} went "
+                f"silent past {2 * self.idle_timeout_s:.1f}s"
+            )
+
+    async def run_once(self) -> None:
+        host, port = self.primary_address.rsplit(":", 1)
+        reader, writer = await asyncio.open_connection(host, int(port))
+        try:
+            # the target must BE the primary: replicating from a fellow
+            # standby would freeze us at its bootstrap state
+            writer.write(encode_frame({"op": "repl.state", "id": 1}))
+            await writer.drain()
+            h, _ = await self._read(reader, writer)
+            if h.get("ok") and h.get("role") != "primary":
+                raise ReplicaRedirect(h.get("primary") or None)
+            writer.write(
+                encode_frame({"op": "repl.subscribe", "sub_id": 1, "id": 2})
+            )
+            await writer.drain()
+            while True:
+                h, _ = await self._read(reader, writer)
+                if h.get("id") == 2:
+                    break
+            if h.get("not_primary"):
+                raise ReplicaRedirect(h.get("primary") or None)
+            if not h.get("ok"):
+                raise ConnectionError(f"repl.subscribe refused: {h}")
+            snapshot_n = int(h.get("snapshot") or 0)
+            self.last_contact = time.monotonic()
+            # fresh cut: drop local state, adopt the primary's epoch +
+            # fence, apply the snapshot records that follow
+            self.fabric.reset_for_bootstrap(
+                h.get("epoch") or "", int(h.get("fence") or 1)
+            )
+            self.snapshot_applied = snapshot_n == 0
+            self.bootstraps += 1
+            if self.snapshot_applied and self.on_bootstrap is not None:
+                self.on_bootstrap()
+            applied = 0
+            unacked = 0
+            while True:
+                try:
+                    fh, fp = await self._read(reader, writer)
+                except CodecError:
+                    # a bit-flipped frame CANNOT be applied (the payload
+                    # boundary itself is untrustworthy): poison the
+                    # session, re-bootstrap from a fresh snapshot — the
+                    # fuzz suite pins "never a silently diverged standby"
+                    self.codec_errors += 1
+                    raise
+                if fh.get("push") != "repl":
+                    continue  # ack replies etc.
+                if fh.get("reset"):
+                    raise ReplicationReset()
+                apply_record(self.fabric, fh["r"], fp)
+                self.delivered = int(fh.get("rseq") or 0)
+                self.last_contact = time.monotonic()
+                applied += 1
+                unacked += 1
+                if applied == snapshot_n:
+                    self.snapshot_applied = True
+                    if self.on_bootstrap is not None:
+                        self.on_bootstrap()
+                if unacked >= self.ack_every or (
+                    applied >= snapshot_n and unacked > 0
+                ):
+                    # id-less ack: fire-and-forget watermark (the server
+                    # sends no reply frame for it)
+                    writer.write(
+                        encode_frame(
+                            {"op": "repl.ack", "sub_id": 1,
+                             "rseq": self.delivered}
+                        )
+                    )
+                    await writer.drain()
+                    self.watermark = self.delivered
+                    unacked = 0
+        finally:
+            writer.close()
+
+
+class FabricNode:
+    """One HA broker: a FabricServer plus the standby/promotion/fencing
+    state machine. `run fabric` builds one of these whenever
+    --standby-of or --peer is given; without them the plain single-
+    broker server path is untouched."""
+
+    def __init__(
+        self,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        persist_dir: Optional[str] = None,
+        standby_of: Optional[str] = None,
+        peers: tuple = (),
+        detector_budget_s: float = DEFAULT_DETECTOR_BUDGET_S,
+        auto_promote: bool = True,
+        orphan_grace: Optional[float] = None,
+        fence_interval_s: float = FENCE_INTERVAL_S,
+    ):
+        self.server = FabricServer(host, port, persist_dir=persist_dir)
+        self.standby_of = standby_of
+        self.peers = tuple(p for p in peers if p)
+        self.detector_budget_s = detector_budget_s
+        self.auto_promote = auto_promote
+        self.orphan_grace = (
+            DEFAULT_ORPHAN_GRACE if orphan_grace is None else orphan_grace
+        )
+        self.fence_interval_s = fence_interval_s
+        self.tail: Optional[ReplicationTail] = None
+        #: set the moment this node starts serving as primary (tests and
+        #: the CLI banner wait on it)
+        self.promoted = asyncio.Event()
+        self._tail_task: Optional[asyncio.Task] = None
+        self._fence_task: Optional[asyncio.Task] = None
+        self._closed = False
+
+    @property
+    def fabric(self) -> LocalFabric:
+        return self.server.fabric
+
+    @property
+    def address(self) -> str:
+        return self.server.address
+
+    @property
+    def role(self) -> str:
+        return self.server.role
+
+    async def start(self) -> None:
+        await self.server.start()
+        self.server.on_promote = self._admin_promote
+        self.server.on_demote = self._on_demote
+        if self.standby_of:
+            self._enter_standby(self.standby_of)
+            return
+        # primary-eligible — but a serving primary with a STRICTLY
+        # higher fence (someone promoted while we were dead) wins:
+        # defer to it instead of split-braining. Equal fences mean no
+        # promotion happened; the operator designated us primary.
+        superior = await self._find_superior_peer()
+        if superior is not None:
+            logger.warning(
+                "peer %s serves at a higher fence; starting as its standby",
+                superior,
+            )
+            self.server.role = "standby"
+            self.server.primary_address = superior
+            self._enter_standby(superior)
+            return
+        self.promoted.set()
+
+    async def _find_superior_peer(self) -> Optional[str]:
+        for addr in self.peers:
+            try:
+                h = await _probe(addr, {"op": "repl.state"})
+            except Exception:
+                continue
+            if (
+                h.get("ok")
+                and h.get("role") == "primary"
+                and int(h.get("fence") or 0) > self.fabric.fence
+            ):
+                return h.get("address") or addr
+        return None
+
+    # -- standby ----------------------------------------------------------
+
+    def _enter_standby(self, primary_address: str) -> None:
+        self.server.role = "standby"
+        self.server.primary_address = primary_address
+        self.promoted.clear()
+        if self._fence_task is not None:
+            self._fence_task.cancel()
+            self._fence_task = None
+        self.tail = ReplicationTail(self.fabric, primary_address)
+        if isinstance(self.fabric, PersistentFabric):
+            # checkpoint each completed bootstrap so a standby restart
+            # (or a later promotion) starts from a durable snapshot
+            self.tail.on_bootstrap = self.fabric._compact
+        self._tail_task = asyncio.get_running_loop().create_task(
+            self._standby_loop()
+        )
+        logger.info(
+            "standby of %s (detector budget %.1fs, auto_promote=%s)",
+            primary_address, self.detector_budget_s, self.auto_promote,
+        )
+
+    async def _standby_loop(self) -> None:
+        tail = self.tail
+        first_fail: Optional[float] = None
+        while not self._closed and self.server.role == "standby":
+            try:
+                await tail.run_once()
+            except asyncio.CancelledError:
+                return
+            except ReplicaRedirect as e:
+                if e.hint and e.hint != self.address:
+                    logger.info("replication redirect -> %s", e.hint)
+                    tail.primary_address = e.hint
+                    self.server.primary_address = e.hint
+                    first_fail = None
+                    await asyncio.sleep(0.1)
+                    continue
+                await asyncio.sleep(0.2)
+            except (ReplicationReset, CodecError):
+                # primary is alive (it just dropped/poisoned the tail):
+                # immediate fresh bootstrap, detector untouched
+                first_fail = None
+                await asyncio.sleep(0.05)
+                continue
+            except (ConnectionError, OSError, asyncio.IncompleteReadError):
+                pass
+            except Exception:
+                logger.exception("replication tail failed")
+            now = time.monotonic()
+            if tail.last_contact and tail.last_contact > (first_fail or 0):
+                # the session that just died HAD contact: the outage
+                # clock starts at its death, not at standby startup
+                first_fail = now
+            elif first_fail is None:
+                first_fail = now
+            if (
+                self.auto_promote
+                and tail.snapshot_applied
+                and now - first_fail >= self.detector_budget_s
+            ):
+                await self.promote(reason="detector")
+                return
+            await asyncio.sleep(min(0.25, self.detector_budget_s / 4))
+
+    # -- promotion / demotion ---------------------------------------------
+
+    async def _admin_promote(self) -> bool:
+        return await self.promote(reason="admin")
+
+    async def promote(self, reason: str = "admin") -> bool:
+        """Standby -> primary: orphan the replicated leases (owners get
+        the persist.py grace window to reattach), bump the fence +
+        skip the publish seq (fsync'd pubmark via the WAL), start
+        serving, and actively fence the old primary's address."""
+        if self.server.role == "primary":
+            return True
+        if self.tail is not None and not self.tail.snapshot_applied:
+            logger.warning("refusing promotion: bootstrap incomplete")
+            return False
+        old_primary = self.server.primary_address
+        if self._tail_task is not None and (
+            self._tail_task is not asyncio.current_task()
+        ):
+            self._tail_task.cancel()
+        self._tail_task = None
+        f = self.fabric
+        n_orphaned = orphan_leases(f, self.orphan_grace)
+        f.promote_state()
+        if isinstance(f, PersistentFabric):
+            f._compact()  # durable snapshot under the new fence
+        self.server.role = "primary"
+        self.server.primary_address = None
+        self.server.promotions_total += 1
+        logger.warning(
+            "PROMOTED to primary (%s): fence %d, %d leases orphaned "
+            "(grace %.1fs), repl watermark %d",
+            reason, f.fence, n_orphaned, self.orphan_grace,
+            self.tail.watermark if self.tail else 0,
+        )
+        from dynamo_tpu.telemetry import events
+
+        events.record(
+            "broker_promote", severity="warning", source=self.address,
+            fence=f.fence, reason=reason, orphaned_leases=n_orphaned,
+        )
+        self.promoted.set()
+        targets = [
+            a
+            for a in dict.fromkeys((old_primary, *self.peers))
+            if a and a != self.address
+        ]
+        if targets:
+            self._fence_task = asyncio.get_running_loop().create_task(
+                self._fence_loop(targets)
+            )
+        return True
+
+    async def _fence_loop(self, targets: list[str]) -> None:
+        """Actively deliver our fence to the old primary's address (and
+        any configured peers) forever: a stale primary that resurrects
+        — even WITHOUT --peer config — demotes within one interval
+        instead of accepting writes indefinitely."""
+        while not self._closed and self.server.role == "primary":
+            for addr in targets:
+                try:
+                    h = await _probe(
+                        addr,
+                        {
+                            "op": "repl.fence",
+                            "fence": self.fabric.fence,
+                            "primary": self.address,
+                        },
+                    )
+                    if h.get("demoted"):
+                        logger.warning(
+                            "fenced stale broker at %s (their fence %s)",
+                            addr, h.get("fence"),
+                        )
+                except Exception:
+                    pass
+            await asyncio.sleep(self.fence_interval_s)
+
+    async def _on_demote(self, primary_address: Optional[str]) -> None:
+        """server.demote() flipped us to standby (a higher fence spoke):
+        become a warm standby of the new primary."""
+        self.promoted.clear()
+        if self._fence_task is not None:
+            self._fence_task.cancel()
+            self._fence_task = None
+        if primary_address:
+            self._enter_standby(primary_address)
+
+    async def stop(self) -> None:
+        self._closed = True
+        for t in (self._tail_task, self._fence_task):
+            if t is not None:
+                t.cancel()
+        await self.server.stop()
+
+
+async def promote_standby(address: str) -> dict:
+    """Explicit failover (`run fabric --promote host:port`): tell the
+    standby at `address` to promote NOW. Returns its reply."""
+    return await _probe(address, {"op": "repl.promote"}, timeout=10.0)

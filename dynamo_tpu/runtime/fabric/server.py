@@ -34,6 +34,13 @@ class _Conn:
         self.inflight: set[tuple[str, str]] = set()  # (queue, item_id)
         self.tasks: set[asyncio.Task] = set()  # pending op dispatches
         self.lock = asyncio.Lock()
+        #: replication subscriber state (a warm standby tailing us over
+        #: `repl.subscribe`): the live record queue + pump task, the
+        #: highest record seq delivered, and the standby's acked
+        #: watermark — delivered - acked is the standby's lag
+        self.repl: Any = None  # (queue, pump task)
+        self.repl_delivered = 0
+        self.repl_acked = 0
 
     async def send(self, header: Any, payload: bytes = b"") -> None:
         async with self.lock:
@@ -60,17 +67,49 @@ class FabricServer:
         self._conns: set[_Conn] = set()
         self._connections_total = 0
         self._ops_total = 0
+        #: HA role (docs/operations.md "Control-plane HA"): a standby
+        #: answers every data op with NotPrimary + the primary's address
+        #: (clients follow the redirect) while serving repl.*/ping/stats,
+        #: until fabric/replica.py promotes it
+        self.role = "primary"
+        self.primary_address: Optional[str] = None
+        self.promotions_total = 0
+        self.demotions_total = 0
+        #: replica.py hooks: `repl.promote` (admin op) and an incoming
+        #: higher-fence `repl.fence` claim route through these so the
+        #: owning FabricNode can flip roles / start tailing
+        self.on_promote = None  # async () -> bool
+        self.on_demote = None  # async (primary_address) -> None
+
+    #: ops a standby still serves (everything else redirects): liveness
+    #: probes, self-metrics, and the whole replication/fencing plane
+    _STANDBY_OPS = frozenset(
+        ("ping", "stats", "repl.subscribe", "repl.ack", "repl.state",
+         "repl.fence", "repl.promote")
+    )
 
     def stats(self) -> dict:
         """Broker self-metrics: the server's own health joins the
         observability plane (op `stats`; metrics_service.py polls it and
         exposes Prometheus `dynamo_tpu_fabric_*` gauges)."""
+        repl_conns = [c for c in self._conns if c.repl is not None]
         return {
             "connections": len(self._conns),
             "connections_total": self._connections_total,
             "ops_total": self._ops_total,
             "active_watches": sum(len(c.watches) for c in self._conns),
             "pending_dispatches": sum(len(c.tasks) for c in self._conns),
+            # control-plane HA: standby count + worst replication lag in
+            # records (doctor's replication-lag rule: a lagging standby
+            # is not safe to promote) + promotion/demotion counters
+            "repl_subscribers": len(repl_conns),
+            "repl_lag_records": max(
+                (c.repl_delivered - c.repl_acked for c in repl_conns),
+                default=0,
+            ),
+            "promotions_total": self.promotions_total,
+            "demotions_total": self.demotions_total,
+            "is_primary": 1 if self.role == "primary" else 0,
             **self.fabric.stats(),
         }
 
@@ -126,6 +165,11 @@ class FabricServer:
         # otherwise pop an item for this dead connection and strand it)
         for t in list(conn.tasks):
             t.cancel()
+        if conn.repl is not None:
+            q, task = conn.repl
+            self.fabric.repl_detach(q)
+            task.cancel()
+            conn.repl = None
         for _, (w, task) in conn.watches.items():
             w.close()
             task.cancel()
@@ -141,6 +185,23 @@ class FabricServer:
         op, rid = h.get("op"), h.get("id")
         f = self.fabric
         self._ops_total += 1
+        if self.role != "primary" and op not in self._STANDBY_OPS:
+            # epoch-fenced refusal: a standby (or a demoted stale
+            # primary) answers every data op with the live primary's
+            # address instead of serving stale state or split-braining
+            # writes — clients follow the redirect (client.py)
+            if rid is not None:
+                try:
+                    await conn.send(
+                        {
+                            "id": rid, "ok": False, "error": "NotPrimary",
+                            "not_primary": True,
+                            "primary": self.primary_address or "",
+                        }
+                    )
+                except Exception:
+                    pass
+            return
         try:
             if op == "kv.put":
                 await f.put(h["key"], payload, h.get("lease"))
@@ -298,6 +359,78 @@ class FabricServer:
                 await conn.send({"id": rid, "ok": True, "stats": self.stats()})
             elif op == "ping":
                 await conn.send({"id": rid, "ok": True})
+            elif op == "repl.subscribe":
+                # warm-standby bootstrap + live tail: snapshot-as-WAL
+                # records first, then every journaled mutation as it
+                # happens. snapshot_records() and repl_attach() run in
+                # ONE synchronous block (no await between), so the
+                # snapshot + tail are a consistent cut of the stream.
+                if conn.repl is not None:
+                    q_old, t_old = conn.repl
+                    f.repl_detach(q_old)
+                    t_old.cancel()
+                records = f.snapshot_records()
+                q = f.repl_attach()
+                await conn.send(
+                    {
+                        "id": rid, "ok": True, "epoch": f.epoch,
+                        "fence": f.fence, "snapshot": len(records),
+                        "seq": f.pub_seq,
+                    }
+                )
+                task = asyncio.get_running_loop().create_task(
+                    self._pump_repl(conn, h["sub_id"], records, q)
+                )
+                conn.repl = (q, task)
+            elif op == "repl.ack":
+                conn.repl_acked = max(conn.repl_acked, int(h.get("rseq") or 0))
+                if rid is not None:
+                    await conn.send({"id": rid, "ok": True})
+            elif op == "repl.state":
+                # fencing probe: peers compare (role, fence) on startup
+                # and after promotions to decide who serves
+                await conn.send(
+                    {
+                        "id": rid, "ok": True, "role": self.role,
+                        "fence": f.fence, "epoch": f.epoch,
+                        "address": self.address,
+                    }
+                )
+            elif op == "repl.fence":
+                # a peer claims primaryship at `fence`: a LOWER-fenced
+                # primary demotes (answers NotPrimary + redirect from the
+                # next op on) instead of split-braining — the promoted
+                # standby's fencer loop delivers this to a returning
+                # stale primary (fabric/replica.py)
+                claimed = int(h.get("fence") or 0)
+                demoted = False
+                if claimed > f.fence and self.role == "primary":
+                    await self.demote(h.get("primary") or None)
+                    demoted = True
+                await conn.send(
+                    {
+                        "id": rid, "ok": True, "demoted": demoted,
+                        "fence": f.fence, "role": self.role,
+                    }
+                )
+            elif op == "repl.promote":
+                # explicit promotion (`run fabric --promote addr`):
+                # only meaningful on a broker whose owner wired the hook
+                if self.on_promote is None:
+                    await conn.send(
+                        {
+                            "id": rid, "ok": False,
+                            "error": "not a standby (no promote hook)",
+                        }
+                    )
+                else:
+                    ok = bool(await self.on_promote())
+                    await conn.send(
+                        {
+                            "id": rid, "ok": ok, "role": self.role,
+                            "fence": f.fence,
+                        }
+                    )
             else:
                 await conn.send({"id": rid, "ok": False, "error": f"bad op {op}"})
         except Exception as e:  # noqa: BLE001 — report op failures to caller
@@ -327,6 +460,96 @@ class FabricServer:
                 },
                 msg.payload,
             )
+
+    async def _pump_repl(self, conn: _Conn, sub_id: int, records, q) -> None:
+        """Ship the snapshot, then the live journal tail. Each frame
+        carries a per-subscription record seq (`rseq`) the standby acks
+        back (`repl.ack`) — delivered minus acked is its lag. A standby
+        dropping mid-pump just ends the pump (it re-bootstraps on
+        reconnect); the queue is detached either way so the journal tap
+        stops feeding a dead subscriber."""
+        rseq = 0
+        try:
+            for h, p in records:
+                rseq += 1
+                conn.repl_delivered = rseq
+                await conn.send(
+                    {"push": "repl", "sub_id": sub_id, "rseq": rseq,
+                     "r": h},
+                    p,
+                )
+            while True:
+                item = await q.get()
+                if item is None:
+                    # the journal tap dropped us (backlog past the cap)
+                    # or the fabric closed: the standby sees the stream
+                    # end and re-bootstraps from a fresh snapshot
+                    await conn.send(
+                        {"push": "repl", "sub_id": sub_id, "reset": True}
+                    )
+                    return
+                h, p = item
+                rseq += 1
+                conn.repl_delivered = rseq
+                await conn.send(
+                    {"push": "repl", "sub_id": sub_id, "rseq": rseq,
+                     "r": h},
+                    p,
+                )
+        except (ConnectionError, OSError, asyncio.CancelledError):
+            pass
+        finally:
+            self.fabric.repl_detach(q)
+
+    async def demote(self, primary_address: Optional[str]) -> None:
+        """Fence this broker out: flip to standby (every subsequent data
+        op answers NotPrimary + redirect) and hand control to the owner
+        hook so it can start tailing the new primary."""
+        if self.role != "primary":
+            self.primary_address = primary_address or self.primary_address
+            return
+        self.role = "standby"
+        self.primary_address = primary_address
+        self.demotions_total += 1
+        logger.warning(
+            "broker demoted (stale fence %d); redirecting to %s",
+            self.fabric.fence, primary_address,
+        )
+        from dynamo_tpu.telemetry import events
+
+        events.record(
+            "broker_demote", severity="warning", source=self.address,
+            fence=self.fabric.fence, primary=str(primary_address or ""),
+        )
+        if self.on_demote is not None:
+            try:
+                await self.on_demote(primary_address)
+            except Exception:
+                logger.exception("demote hook failed")
+
+    def kill(self) -> None:
+        """Abrupt death for chaos tests / the blackout bench: abort every
+        connection and the listener with NO cleanup (the in-process
+        equivalent of SIGKILL — leases survive server-side, clients see
+        a hard connection loss)."""
+        if self._server is not None:
+            self._server.close()
+        for conn in list(self._conns):
+            for t in list(conn.tasks):
+                t.cancel()
+            for _, (w, task) in conn.watches.items():
+                w.close()
+                task.cancel()
+            for _, (s, task) in conn.subs.items():
+                s.close()
+                task.cancel()
+            if conn.repl is not None:
+                self.fabric.repl_detach(conn.repl[0])
+                conn.repl[1].cancel()
+            transport = conn.writer.transport
+            if transport is not None:
+                transport.abort()
+        self._conns.clear()
 
 
 async def _amain(args) -> None:

@@ -101,6 +101,11 @@ class MemStore:
         self._leases: dict[str, float] = {}  # lease_id -> deadline
         self._lease_ttl: dict[str, float] = {}
         self._lease_keys: dict[str, set[str]] = {}
+        #: leases restored after a broker restart/promotion, counting
+        #: down their orphan-grace window until the owner reattaches
+        #: (fabric/persist.py orphan_leases) — a stats gauge, and the
+        #: failover runbook's "did everyone find the new primary" signal
+        self._orphaned: set[str] = set()
         self._watches: list[tuple[str, Watch]] = []
         self._reaper: Optional[asyncio.Task] = None
 
@@ -190,6 +195,7 @@ class MemStore:
         if lease_id not in self._leases:
             return False
         self._leases[lease_id] = time.monotonic() + self._lease_ttl[lease_id]
+        self._orphaned.discard(lease_id)
         return True
 
     async def reattach_lease(self, lease_id: str, ttl: float) -> bool:
@@ -207,6 +213,7 @@ class MemStore:
     async def revoke_lease(self, lease_id: str) -> None:
         self._leases.pop(lease_id, None)
         self._lease_ttl.pop(lease_id, None)
+        self._orphaned.discard(lease_id)
         for key in list(self._lease_keys.pop(lease_id, ())):
             await self.delete(key)
 

@@ -58,6 +58,59 @@ def _clear_registry() -> None:
     _engines.clear()
 
 
+#: fabric clients (RemoteFabric) living in this process — weak, like the
+#: engine registry: whatever Prometheus surface the process has gauges
+#: the control-plane connection state off them (docs/operations.md
+#: "Control-plane HA")
+_fabric_clients: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def register_fabric_client(client) -> None:
+    """Called by RemoteFabric at construction."""
+    _fabric_clients.add(client)
+
+
+def control_plane_lines(prefix: str = "dynamo_tpu") -> list[str]:
+    """Process-global control-plane health: the degraded gauge (1 = no
+    broker has answered past the budget; this process is serving from
+    cached discovery / buffering publishes), outage counters, and
+    client-observed broker failovers. Included by BOTH Prometheus
+    surfaces; always emitted (zeros — including for LocalFabric
+    processes, which are their own broker) so the dashboard
+    panel-vs-emitted gate sees the families."""
+    degraded = 0
+    disconnected_s = 0.0
+    entries = 0
+    seconds = 0.0
+    failovers = 0
+    for c in list(_fabric_clients):
+        if getattr(c, "degraded", False):
+            degraded = 1
+        disconnected_s = max(
+            disconnected_s, float(getattr(c, "disconnected_s", 0.0) or 0.0)
+        )
+        entries += int(getattr(c, "degraded_total", 0) or 0)
+        seconds += float(getattr(c, "degraded_seconds_total", 0.0) or 0.0)
+        failovers += int(getattr(c, "failovers_total", 0) or 0)
+    return [
+        f"# TYPE {prefix}_control_plane_degraded gauge",
+        f"{prefix}_control_plane_degraded {degraded}",
+        f"# TYPE {prefix}_control_plane_disconnected_seconds gauge",
+        f"{prefix}_control_plane_disconnected_seconds "
+        f"{round(disconnected_s, 3)}",
+        # "_entries_total", not "_total": the OpenMetrics rendering
+        # strips counter _total suffixes into family names, and
+        # "control_plane_degraded" is already the gauge's family
+        f"# TYPE {prefix}_control_plane_degraded_entries_total counter",
+        f"{prefix}_control_plane_degraded_entries_total {entries}",
+        f"# TYPE {prefix}_control_plane_degraded_seconds_total counter",
+        f"{prefix}_control_plane_degraded_seconds_total "
+        f"{round(seconds, 3)}",
+        f"# TYPE {prefix}_fabric_client_failovers_total counter",
+        f"{prefix}_fabric_client_failovers_total {failovers}",
+    ]
+
+
 def spec_lines(prefix: str = "dynamo_tpu") -> list[str]:
     """Process-global speculative-decoding exposition, summed over the
     registered in-process engines: `{prefix}_spec_*_total` counters plus

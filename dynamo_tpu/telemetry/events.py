@@ -47,6 +47,11 @@ EVENT_TYPES = (
     "shed",               # load-shed episode (429s, coalesced)
     "stream_replay",      # a dead worker's stream continued on a survivor
     "kv_resync",          # KV index gap/drift repaired by resync
+    # control-plane HA (docs/operations.md "Control-plane HA")
+    "broker_promote",     # a warm standby promoted itself to primary
+    "broker_demote",      # a stale-fenced broker demoted (split-brain refusal)
+    "broker_failover",    # a client's established broker address changed
+    "degraded",           # broker-less mode entered/left (phase attr)
 )
 
 SEVERITIES = ("info", "warning", "critical")
@@ -110,6 +115,17 @@ def drain() -> list[dict]:
         out = list(_buffer)
         _buffer.clear()
     return out
+
+
+def requeue(batch: list[dict]) -> None:
+    """Put drained-but-unshipped events back, in order (a failed publish
+    during a broker outage must not eat the timeline — the degraded-mode
+    and failover events are exactly what must ship on reconnect). The
+    buffer stays bounded: oldest events fall off first."""
+    with _lock:
+        combined = list(batch) + list(_buffer)
+        _buffer.clear()
+        _buffer.extend(combined[-BUFFER_CAP:])
 
 
 def pending() -> int:

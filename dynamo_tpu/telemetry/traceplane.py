@@ -133,6 +133,12 @@ async def ship_once(fabric, source: str = "") -> None:
             pass  # dropped batch -> incomplete trace, kept by the sampler
     events = events_mod.drain()
     if events:
+        if getattr(fabric, "connected", True) is False:
+            # broker-less degraded mode: keep the timeline (bounded) —
+            # the degraded/failover events themselves are what must
+            # arrive once a broker answers again
+            events_mod.requeue(events)
+            return
         # one batch frame, like the spans — a coalesced 429 storm must
         # not serialize hundreds of publish round-trips on this loop
         try:
@@ -142,7 +148,7 @@ async def ship_once(fabric, source: str = "") -> None:
                 msgpack.packb(events, use_bin_type=True, default=repr),
             )
         except Exception:
-            pass
+            events_mod.requeue(events)
 
 
 class TelemetryShipper:

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 from typing import Optional
 
 import msgpack
@@ -133,6 +134,17 @@ class Worker:
         from dynamo_tpu.kv_router.digest import SetDigest
 
         self._kv_digest = SetDigest()
+        #: designed degraded mode (docs/operations.md "Control-plane
+        #: HA"): while no broker answers, KV events buffer UNSTAMPED in
+        #: this bounded queue — a short outage loses nothing; overflow
+        #: is stamped-and-dropped so the burned seqs surface as a
+        #: detectable gap (indexers resync on reconnect) instead of
+        #: silent divergence or unbounded memory
+        self._kv_pending: list[dict] = []
+        self.kv_pending_cap = int(
+            os.environ.get("DYNTPU_KV_EVENT_BUFFER", "4096")
+        )
+        self.kv_events_dropped = 0
         self._tasks: list[asyncio.Task] = []
         #: graceful drain (docs/operations.md "Overload & draining"):
         #: SIGTERM or the `drain` ingress op flips this — the worker
@@ -753,8 +765,10 @@ class Worker:
         # and this worker's advertised digest empties with it.
         pending = self._kv_event_buffer[: len(self._kv_event_buffer)]
         del self._kv_event_buffer[: len(pending)]
+        held, self._kv_pending = self._kv_pending, []
         await self._publish_kv_events(
-            [self._kv_event_wire(e) for e in pending]
+            held
+            + [self._kv_event_wire(e) for e in pending]
             + [{
                 "kind": "handed_over",
                 "block_hashes": [],
@@ -1421,6 +1435,11 @@ class Worker:
                 # indexer repairs by resync
                 logger.warning("publish tick failed", exc_info=True)
 
+    def _broker_reachable(self, fabric) -> bool:
+        # LocalFabric (and anything without connection state) is always
+        # reachable; RemoteFabric reports its live connection
+        return getattr(fabric, "connected", True) is not False
+
     async def _publish_once(self, fabric) -> None:
         # Drain WITHOUT rebinding: the engine thread appends through a
         # late-binding callback, but any captured reference must stay
@@ -1428,12 +1447,35 @@ class Worker:
         # (appends landed in the dead list forever after).
         events = self._kv_event_buffer[: len(self._kv_event_buffer)]
         del self._kv_event_buffer[: len(events)]
-        if events:
-            await self._publish_kv_events(
-                [self._kv_event_wire(e) for e in events]
-            )
+        wire = self._kv_pending + [self._kv_event_wire(e) for e in events]
+        self._kv_pending = []
+        if wire:
+            if not self._broker_reachable(fabric):
+                # degraded mode: hold UNSTAMPED events for the broker's
+                # return (a short outage loses nothing); past the cap,
+                # stamp-and-drop the oldest — their burned seqs are the
+                # detectable gap that triggers resync on reconnect
+                overflow = wire[: max(0, len(wire) - self.kv_pending_cap)]
+                self._kv_pending = wire[len(overflow):]
+                if overflow:
+                    if self.kv_sequencing:
+                        self._stamp_kv_events(overflow)
+                    self.kv_events_dropped += len(overflow)
+                    logger.warning(
+                        "degraded: KV event buffer overflowed; %d "
+                        "event(s) dropped with seqs burned (indexers "
+                        "resync on reconnect)", len(overflow),
+                    )
+            else:
+                await self._publish_kv_events(wire)
         tiered = self._tier_event_buffer[: len(self._tier_event_buffer)]
         del self._tier_event_buffer[: len(tiered)]
+        if tiered and not self._broker_reachable(fabric):
+            # lower-tier hints are advisory (peers re-learn them from
+            # later events): bound the outage backlog instead of growing
+            tiered = tiered[-self.kv_pending_cap:]
+            self._tier_event_buffer[:0] = tiered
+            tiered = []
         if tiered:
             payload = msgpack.packb(
                 [
@@ -1569,12 +1611,24 @@ class Worker:
                     "fold": self._kv_digest.fold,
                     "count": self._kv_digest.count,
                 }
+            # control-plane health from THIS worker's seat (docs/
+            # operations.md "Control-plane HA"): the live degraded flag
+            # plus outage counters — during a full outage these frames
+            # cannot ship, so what the fleet view mostly sees is the
+            # post-recovery accounting (how long, how many drops)
+            m["degraded"] = 1 if getattr(fabric, "degraded", False) else 0
+            m["degraded_entries_total"] = int(
+                getattr(fabric, "degraded_total", 0)
+            )
+            m["kv_events_dropped_total"] = self.kv_events_dropped
+            m["kv_events_pending"] = len(self._kv_pending)
             m["instance_id"] = self.instance_id
             m["model"] = self.card.name
-            await fabric.publish(
-                f"{METRICS_SUBJECT}.{pub_component}.{self.instance_id}",
-                m,
-            )
+            if self._broker_reachable(fabric):
+                await fabric.publish(
+                    f"{METRICS_SUBJECT}.{pub_component}.{self.instance_id}",
+                    m,
+                )
         # fleet trace plane: ship buffered spans + fleet events on the
         # same cadence as the metrics frames (empty -> no publish)
         from dynamo_tpu.telemetry import traceplane

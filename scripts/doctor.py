@@ -74,6 +74,19 @@ Rules (each emits severity + worker + evidence + suggested action):
                        cap admission, transfer -> check the disagg
                        planes, dispatch -> router retries, decode_stall
                        -> enable mixed steps, replay_gap -> worker churn
+  control-plane-       the broker is unreachable (or a worker reports
+  degraded             broker-less degraded mode): the fleet serves from
+                       cached discovery, KV scores go stale-cold, the
+                       planner HOLDs — warning while frames are still
+                       fresh / one worker degraded, CRITICAL when the
+                       metrics service itself is degraded and the whole
+                       fleet's frames have gone stale (docs/operations.md
+                       "Control-plane HA")
+  replication-lag      the warm standby's acked replication watermark
+                       trails the primary's journal by more than the
+                       threshold — promoting NOW would lose that tail
+                       (leases/keys/ring records); hold the failover or
+                       find the lagging link
 
 `diagnose()` is pure (snapshots in, findings out) and unit-tested
 against recorded snapshots in tests/test_doctor.py. Dependency-free
@@ -117,6 +130,9 @@ FLIP_STORM_COUNT = 2
 #: up at t, down at t+cooldown, up at t+2*cooldown. Comparing against
 #: the bare cooldown would make the rule unsatisfiable.
 OSCILLATION_WINDOW_FACTOR = 3.0
+#: standby replication lag (records behind the primary's journal) above
+#: which the standby is not safe to promote
+REPL_LAG_WARN_RECORDS = 256
 #: fallback window (seconds) when the frame advertises no cooldown
 OSCILLATION_WINDOW_FLOOR_S = 60.0
 #: handover drain-fallbacks (exceeding completions) before the
@@ -189,6 +205,7 @@ def diagnose(
     findings: list[dict] = []
     workers = (fleet or {}).get("workers") or {}
     roles = (fleet or {}).get("roles") or {}
+    findings.extend(_control_plane_rules(fleet, workers))
     #: flight data present at all? The silent-worker rule needs the
     #: distinction between "no flight doc" and "enabled but silent"
     flight_collected = bool((flight or {}).get("workers"))
@@ -473,6 +490,77 @@ def diagnose(
     order = {"critical": 0, "warning": 1, "info": 2}
     findings.sort(key=lambda f: (order.get(f["severity"], 9), str(f["worker"])))
     return findings
+
+
+def _control_plane_rules(fleet: dict, workers: dict) -> list[dict]:
+    """control-plane-degraded + replication-lag over the /v1/fleet
+    `control_plane` section (docs/operations.md "Control-plane HA")."""
+    out: list[dict] = []
+    cp = (fleet or {}).get("control_plane") or {}
+    if cp.get("degraded"):
+        ages = [
+            float(w.get("last_seen_s") or 0.0) for w in workers.values()
+        ]
+        all_stale = not ages or all(a > DEAD_AFTER_S for a in ages)
+        out.append(_finding(
+            "critical" if all_stale else "warning",
+            "control-plane-degraded", None,
+            (
+                "the metrics service cannot reach any broker "
+                f"({cp.get('disconnected_s', 0)}s) and every worker's "
+                "frames are stale — the WHOLE fleet is in broker-less "
+                "degraded mode (serving from cached discovery, KV "
+                "scores stale-cold, planner holding)"
+                if all_stale else
+                "the metrics service lost its broker "
+                f"({cp.get('disconnected_s', 0)}s); worker frames are "
+                "still fresh, so this may be a partial partition"
+            ),
+            {"disconnected_s": cp.get("disconnected_s"),
+             "addresses": cp.get("addresses"),
+             "degraded_total": cp.get("degraded_total"),
+             "workers_stale": all_stale},
+            "restart/restore a broker (or promote the standby: `run "
+            "fabric --promote <standby>`); chats keep serving over "
+            "direct ingress meanwhile, and KV indexes resync on "
+            "reconnect",
+        ))
+    for iid, w in sorted(workers.items()):
+        if int(w.get("degraded") or 0) and float(
+            w.get("last_seen_s") or 0.0
+        ) <= DEAD_AFTER_S:
+            out.append(_finding(
+                "warning", "control-plane-degraded", iid,
+                f"{iid} reports broker-less degraded mode "
+                f"(dropped {w.get('kv_events_dropped_total') or 0} KV "
+                f"event(s), {w.get('kv_events_pending') or 0} pending)",
+                {"degraded": 1,
+                 "degraded_entries_total": w.get("degraded_entries_total"),
+                 "kv_events_dropped_total":
+                     w.get("kv_events_dropped_total"),
+                 "kv_events_pending": w.get("kv_events_pending")},
+                "this worker cannot reach the broker others can — check "
+                "its --fabric list and the network path; its KV events "
+                "buffer (bounded) and the index resyncs on reconnect",
+            ))
+    broker = cp.get("broker") or {}
+    lag = int(broker.get("repl_lag_records") or 0)
+    if int(broker.get("repl_subscribers") or 0) > 0 and (
+        lag > REPL_LAG_WARN_RECORDS
+    ):
+        out.append(_finding(
+            "warning", "replication-lag", None,
+            f"the warm standby trails the primary's journal by {lag} "
+            f"records — promoting now would LOSE that tail",
+            {"repl_lag_records": lag,
+             "repl_subscribers": broker.get("repl_subscribers"),
+             "fence": broker.get("fence")},
+            "hold any manual failover; check the standby host/link "
+            "(fabric repl_lag_records should sit near 0) — the detector "
+            "still promotes on primary death, accepting the gap "
+            "(sequencing consumers resync)",
+        ))
+    return out
 
 
 def _trace_rules(traces: Optional[dict], workers: dict) -> list[dict]:

@@ -208,6 +208,24 @@ def test_cpu_fallback_line_is_labeled_and_carries_tpu_artifact():
     assert tp["modeled_overhead_pct"] < 1.0, tp
     assert tp["measured_overhead_pct"] is not None, tp
     assert tp["measured_overhead_pct"] < 30.0, tp
+    # control-plane failover blackout (ISSUE 15): SIGKILL the primary
+    # mid-publish-stream -> the warm standby promotes (fence 2) and the
+    # first successful publish lands within a bounded window (detector
+    # 300ms + reconnect backoff; generous wall ceiling for box load).
+    # The replication-overhead claim (<2%) is the DETERMINISTIC model:
+    # the journal tap's measured per-publish cost priced against the
+    # measured wire publish round-trip — the raw in-process path ratio
+    # (tap_path_ratio_pct, microseconds on microseconds) rides along
+    # unasserted.
+    fb = ex["failover_blackout"]
+    assert "error" not in fb, fb
+    assert fb["promoted_fence"] == 2, fb
+    assert fb["publishes_before"] > 0 and fb["publishes_after"] > 0, fb
+    assert 0 < fb["blackout_ms"] < 15000, fb
+    assert fb["blackout_ms"] >= fb["detector_budget_ms"] * 0.5, fb
+    assert fb["wire_publish_us"] > 0, fb
+    assert fb["modeled_repl_overhead_pct"] is not None, fb
+    assert fb["modeled_repl_overhead_pct"] < 2.0, fb
 
 
 def test_bench_http_counts_failures_instead_of_raising():

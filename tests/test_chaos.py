@@ -46,7 +46,7 @@ class ChaosCluster:
 
     def __init__(self, num_workers=2, max_inflight=4, echo_delay=0.05,
                  drain_budget=8.0, engine="echo", mock_step=None,
-                 frontend_args=()):
+                 frontend_args=(), ha=False, detector_budget=1.0):
         from benchmarks._procs import free_port
         from tests.fault_tolerance.harness import ManagedProc, _cli
 
@@ -57,22 +57,37 @@ class ChaosCluster:
         self.echo_delay = echo_delay
         self.drain_budget = drain_budget
         self.fabric_port = free_port()
+        #: control-plane HA (docs/operations.md "Control-plane HA"):
+        #: ha=True adds a warm standby broker and points every client at
+        #: the comma list, so a primary SIGKILL fails over
+        self.standby_port = free_port() if ha else None
         self.http_port = free_port()
         self.workers = []
         self.frontend = None
         self.fabric = None
+        self.standby = None
         try:
             self.fabric = ManagedProc(
                 "fabric", _cli("fabric", "--port", str(self.fabric_port))
             )
             self.fabric.wait_for("fabric server on|listening", timeout=20)
+            if ha:
+                self.standby = ManagedProc(
+                    "fabric-standby",
+                    _cli(
+                        "fabric", "--port", str(self.standby_port),
+                        "--standby-of", f"127.0.0.1:{self.fabric_port}",
+                        "--detector-budget", str(detector_budget),
+                    ),
+                )
+                self.standby.wait_for("fabric standby on", timeout=20)
             for _ in range(num_workers):
                 self.add_worker()
             self.frontend = ManagedProc(
                 "frontend",
                 _cli(
                     "run", "in=http", "out=dyn",
-                    "--fabric", f"127.0.0.1:{self.fabric_port}",
+                    "--fabric", self.fabric_addr(),
                     "--port", str(self.http_port),
                     "--max-inflight", str(max_inflight),
                     *frontend_args,
@@ -83,6 +98,14 @@ class ChaosCluster:
         except BaseException:
             self.stop()
             raise
+
+    def fabric_addr(self) -> str:
+        if self.standby_port is not None:
+            return (
+                f"127.0.0.1:{self.fabric_port},"
+                f"127.0.0.1:{self.standby_port}"
+            )
+        return f"127.0.0.1:{self.fabric_port}"
 
     def add_worker(self):
         extra = (
@@ -96,7 +119,7 @@ class ChaosCluster:
             f"worker{len(self.workers)}",
             self._cli(
                 "run", "in=dyn", f"out={self.engine}", "--model", "tiny",
-                "--fabric", f"127.0.0.1:{self.fabric_port}",
+                "--fabric", self.fabric_addr(),
                 "--drain-budget", str(self.drain_budget),
                 *extra,
             ),
@@ -145,7 +168,7 @@ class ChaosCluster:
         raise AssertionError(f"cluster never became ready: {last}")
 
     def stop(self) -> None:
-        for p in [self.frontend, *self.workers, self.fabric]:
+        for p in [self.frontend, *self.workers, self.fabric, self.standby]:
             if p is None:
                 continue
             try:
@@ -658,3 +681,111 @@ def test_chaos_disagg_transfer_faults_dead_letter_and_recover():
             await server.stop()
 
     asyncio.run(main())
+
+
+def _frontend_metrics(cluster) -> str:
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{cluster.http_port}/metrics", timeout=5
+    ) as resp:
+        return resp.read().decode()
+
+
+def test_chaos_control_plane_failover_then_degraded_then_recovery():
+    """ISSUE 15 acceptance, process level: (1) SIGKILL the primary
+    broker mid-traffic -> the warm standby promotes inside the detector
+    budget and the fleet recovers to all-200 (leases reattach on the new
+    primary within the orphan grace, zero hung streams throughout);
+    (2) resurrect the stale primary with --peer -> it starts DEMOTED
+    (split-brain refusal pinned); (3) SIGKILL the remaining broker ->
+    the DESIGNED degraded mode: cached-discovery traffic keeps serving
+    200 over direct ingress and the frontend's Prometheus surface gauges
+    dynamo_tpu_control_plane_degraded=1; (4) a broker returns -> clients
+    re-establish sessions (leased registrations re-put, watches
+    reset+replay) and the gauge drops back to 0."""
+    cluster = ChaosCluster(
+        num_workers=2, max_inflight=8, ha=True, detector_budget=1.0,
+    )
+    try:
+        assert _drive(cluster, 3, "baseline") == [200, 200, 200]
+
+        # phase 1: primary SIGKILL mid-traffic -> promotion + recovery
+        with concurrent.futures.ThreadPoolExecutor(max_workers=4) as pool:
+            futs = [
+                pool.submit(cluster.request, f"mid {i}", 30.0)
+                for i in range(4)
+            ]
+            time.sleep(0.2)
+            cluster.fabric.kill(signal.SIGKILL)
+            done, not_done = concurrent.futures.wait(futs, timeout=60)
+            assert not not_done, "hung streams during broker failover"
+            # chats ride direct ingress: the broker's death must not
+            # terminate a single in-flight stream abnormally
+            for f in done:
+                assert f.result()[0] == 200, f.result()
+        cluster.standby.wait_for("PROMOTED to primary", timeout=30)
+        deadline = time.time() + 30
+        statuses = []
+        while time.time() < deadline:
+            statuses = _drive(cluster, 3, "after-failover", timeout=20)
+            if statuses == [200, 200, 200]:
+                break
+            time.sleep(1.0)
+        assert statuses == [200, 200, 200], statuses
+
+        # phase 2: the stale primary resurrects with --peer -> demoted
+        # standby, never a second primary
+        stale = cluster._ManagedProc(
+            "fabric-stale",
+            cluster._cli(
+                "fabric", "--port", str(cluster.fabric_port),
+                "--peer", f"127.0.0.1:{cluster.standby_port}",
+            ),
+        )
+        try:
+            stale.wait_for("fabric standby on", timeout=20)
+            assert _drive(cluster, 2, "with-stale") == [200, 200]
+        finally:
+            stale.stop()
+
+        # phase 3: kill the LAST broker -> designed degraded mode
+        cluster.standby.kill(signal.SIGKILL)
+        time.sleep(1.0)
+        statuses = _drive(cluster, 4, "degraded", timeout=20)
+        assert statuses == [200, 200, 200, 200], statuses
+        deadline = time.time() + 25  # default DYNTPU_DEGRADED_AFTER=5s
+        seen = False
+        while time.time() < deadline:
+            if "dynamo_tpu_control_plane_degraded 1" in (
+                _frontend_metrics(cluster)
+            ):
+                seen = True
+                break
+            time.sleep(0.5)
+        assert seen, "frontend never gauged degraded mode"
+        # still serving while verifiably degraded
+        assert _drive(cluster, 2, "degraded-still") == [200, 200]
+
+        # phase 4: a broker returns (fresh state) -> sessions
+        # re-establish and the fleet exits degraded mode
+        revived = cluster._ManagedProc(
+            "fabric-revived",
+            cluster._cli("fabric", "--port", str(cluster.fabric_port)),
+        )
+        try:
+            revived.wait_for("fabric server on|listening", timeout=20)
+            deadline = time.time() + 45
+            ok = False
+            while time.time() < deadline:
+                statuses = _drive(cluster, 3, "recovered", timeout=20)
+                txt = _frontend_metrics(cluster)
+                if statuses == [200, 200, 200] and (
+                    "dynamo_tpu_control_plane_degraded 0" in txt
+                ):
+                    ok = True
+                    break
+                time.sleep(1.0)
+            assert ok, (statuses, "degraded gauge never cleared")
+        finally:
+            revived.stop()
+    finally:
+        cluster.stop()

@@ -569,3 +569,87 @@ def test_slow_trace_attribution_rule():
     # absent/garbage trace docs: quiet
     assert rule_findings(None) == []
     assert rule_findings({"traces": "garbage"}) == []
+
+
+def test_control_plane_degraded_rule_severities():
+    doctor = _load_doctor()
+    # metrics service degraded AND every worker's frames stale -> the
+    # whole fleet is broker-less: critical
+    fleet = {
+        "workers": {"w1": {"role": "decode", "last_seen_s": 42.0}},
+        "control_plane": {
+            "degraded": True, "disconnected_s": 12.0,
+            "addresses": ["a:4222", "b:4222"], "degraded_total": 1,
+        },
+    }
+    hits = [
+        f for f in doctor.diagnose(fleet, {}, {})
+        if f["rule"] == "control-plane-degraded"
+    ]
+    assert hits and hits[0]["severity"] == "critical"
+    assert hits[0]["evidence"]["workers_stale"] is True
+
+    # degraded metrics service but FRESH worker frames (partial
+    # partition) -> warning
+    fleet2 = {
+        "workers": {
+            "w1": {"role": "decode", "last_seen_s": 0.2, "tok_s": 500.0,
+                   "kv_total_pages": 512},
+        },
+        "control_plane": {"degraded": True, "disconnected_s": 6.0},
+    }
+    hits2 = [
+        f for f in doctor.diagnose(fleet2, {}, {})
+        if f["rule"] == "control-plane-degraded"
+    ]
+    assert hits2 and hits2[0]["severity"] == "warning"
+
+    # ONE worker reporting broker-less mode while the service is fine
+    # -> per-worker warning naming the drop counters
+    fleet3 = {
+        "workers": {
+            "w1": {"role": "decode", "last_seen_s": 0.2, "tok_s": 500.0,
+                   "kv_total_pages": 512, "degraded": 1,
+                   "kv_events_dropped_total": 7, "kv_events_pending": 12,
+                   "degraded_entries_total": 2},
+        },
+        "control_plane": {"degraded": False},
+    }
+    hits3 = [
+        f for f in doctor.diagnose(fleet3, {}, {})
+        if f["rule"] == "control-plane-degraded"
+    ]
+    assert len(hits3) == 1
+    assert hits3[0]["worker"] == "w1"
+    assert hits3[0]["severity"] == "warning"
+    assert hits3[0]["evidence"]["kv_events_dropped_total"] == 7
+
+
+def test_replication_lag_rule():
+    doctor = _load_doctor()
+    base = {"workers": {}, "control_plane": {
+        "degraded": False,
+        "broker": {"repl_subscribers": 1, "repl_lag_records": 1000,
+                   "fence": 1},
+    }}
+    hits = [
+        f for f in doctor.diagnose(base, {}, {})
+        if f["rule"] == "replication-lag"
+    ]
+    assert hits and hits[0]["severity"] == "warning"
+    assert "standby" in hits[0]["summary"]
+
+    # small lag: healthy replication, quiet
+    base["control_plane"]["broker"]["repl_lag_records"] = 3
+    assert not [
+        f for f in doctor.diagnose(base, {}, {})
+        if f["rule"] == "replication-lag"
+    ]
+    # no standby attached: lag is meaningless, quiet
+    base["control_plane"]["broker"] = {
+        "repl_subscribers": 0, "repl_lag_records": 99999,
+    }
+    assert not [
+        f for f in doctor.diagnose(base, {}, {})
+        if f["rule"] == "replication-lag"
+    ]

@@ -1,0 +1,567 @@
+"""Control-plane HA (docs/operations.md "Control-plane HA"): warm-standby
+replication, epoch-fenced promotion, client failover, split-brain
+refusal, replication-wire fuzz, and the designed degraded mode — all
+in-process (the subprocess CLI variant lives in tests/test_chaos.py)."""
+
+import asyncio
+import random
+
+import pytest
+
+from dynamo_tpu.runtime.fabric import (
+    FabricNode,
+    FabricServer,
+    RemoteFabric,
+    fabric_state_digest,
+)
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def _drain_lag(primary: FabricServer, timeout: float = 5.0) -> None:
+    """Wait until every replication subscriber acked the whole stream."""
+    deadline = asyncio.get_event_loop().time() + timeout
+    while asyncio.get_event_loop().time() < deadline:
+        st = primary.stats()
+        if st["repl_subscribers"] > 0 and st["repl_lag_records"] == 0:
+            return
+        await asyncio.sleep(0.02)
+    raise AssertionError(f"replication lag never drained: {primary.stats()}")
+
+
+async def _standby(primary: FabricServer, **kw) -> FabricNode:
+    node = FabricNode(
+        port=0, standby_of=primary.address,
+        detector_budget_s=kw.pop("detector_budget_s", 0.4),
+        orphan_grace=kw.pop("orphan_grace", 10.0), **kw,
+    )
+    await node.start()
+    return node
+
+
+def test_standby_bootstraps_and_converges_digest_exact():
+    async def main():
+        primary = FabricServer(port=0)
+        await primary.start()
+        c = await RemoteFabric.connect(primary.address)
+        lease = await c.grant_lease(30.0)
+        await c.put("v1/instances/a", b"worker-a", lease_id=lease)
+        await c.put("plain/k", b"v0")
+        await c.obj_put("card/m", b"{}")
+        await c.queue_push("prefill_queue", {"n": 1}, b"item")
+        for i in range(20):
+            await c.publish("kv_events.w1", {"i": i}, f"e{i}".encode())
+
+        node = await _standby(primary, auto_promote=False)
+        try:
+            # live tail after bootstrap: keep mutating
+            await c.put("plain/k", b"v1")
+            await c.delete("v1/instances/a")
+            await c.put("v1/instances/b", b"worker-b", lease_id=lease)
+            for i in range(20, 35):
+                await c.publish("kv_events.w1", {"i": i}, f"e{i}".encode())
+            await _drain_lag(primary)
+            assert fabric_state_digest(primary.fabric) == (
+                fabric_state_digest(node.fabric)
+            )
+            # standby redirects data ops
+            assert node.role == "standby"
+            st = primary.stats()
+            assert st["repl_subscribers"] == 1
+            assert st["is_primary"] == 1
+            assert node.server.stats()["is_primary"] == 0
+        finally:
+            await c.close()
+            await node.stop()
+            await primary.stop()
+
+    run(main())
+
+
+def test_failover_client_follows_exactly_once_and_leases_reattach():
+    """The tentpole proof, in-process: SIGKILL-equivalent primary death
+    mid-traffic -> the standby promotes inside the detector budget, the
+    multi-address client fails over, ringed subjects deliver exactly
+    once ACROSS the failover, and leased keys survive via reattach
+    inside the orphan grace."""
+
+    async def main():
+        primary = FabricServer(port=0)
+        await primary.start()
+        node = await _standby(primary, detector_budget_s=0.3)
+        try:
+            addrs = f"{primary.address},{node.address}"
+            sub_fab = await RemoteFabric.connect(addrs)
+            pub_fab = await RemoteFabric.connect(addrs)
+            lease = await pub_fab.grant_lease(2.0)
+            await pub_fab.put("v1/instances/w1", b"meta", lease_id=lease)
+
+            sub = await sub_fab.subscribe("kv_events.>")
+            got: list[int] = []
+
+            async def consume():
+                async for m in sub:
+                    got.append(m.header["i"])
+
+            consumer = asyncio.get_running_loop().create_task(consume())
+            for i in range(10):
+                await pub_fab.publish("kv_events.w1", {"i": i}, b"x")
+            await _drain_lag(primary)
+
+            primary.kill()  # SIGKILL-equivalent: no cleanup, no goodbyes
+            await asyncio.wait_for(node.promoted.wait(), timeout=10.0)
+            assert node.role == "primary"
+            assert node.fabric.fence == 2
+
+            # publish THROUGH the failover: first calls may fail while
+            # the client reconnects — retry like any fabric caller
+            for i in range(10, 20):
+                for _ in range(100):
+                    try:
+                        await pub_fab.publish("kv_events.w1", {"i": i}, b"x")
+                        break
+                    except (ConnectionError, RuntimeError):
+                        await asyncio.sleep(0.05)
+                else:
+                    raise AssertionError(f"publish {i} never landed")
+
+            deadline = asyncio.get_event_loop().time() + 10.0
+            while len(got) < 20 and asyncio.get_event_loop().time() < deadline:
+                await asyncio.sleep(0.02)
+            # exactly once across the failover: every message, no dups
+            assert got == list(range(20)), got
+
+            # leased key reattached on the new primary within grace
+            check = await RemoteFabric.connect(node.address)
+            deadline = asyncio.get_event_loop().time() + 5.0
+            val = None
+            while asyncio.get_event_loop().time() < deadline:
+                val = await check.get("v1/instances/w1")
+                if val == b"meta":
+                    break
+                await asyncio.sleep(0.05)
+            assert val == b"meta"
+            # both clients failed over to the standby's address
+            assert pub_fab.address == node.address
+            assert pub_fab.failovers_total >= 1
+            consumer.cancel()
+            await check.close()
+            await sub_fab.close()
+            await pub_fab.close()
+        finally:
+            await node.stop()
+            await primary.stop()
+
+    run(main())
+
+
+def test_stale_primary_demotes_and_redirects_split_brain_refused(tmp_path):
+    """Restart the dead primary from its WAL after a failover: the
+    promoted broker's fencer (plus the startup peer probe) demotes it,
+    and a client pointed ONLY at the old address is transparently
+    redirected — its write lands on the new primary."""
+
+    async def main():
+        d = str(tmp_path / "wal-a")
+        primary = FabricServer(port=0, persist_dir=d)
+        await primary.start()
+        port_a = primary.port
+        c = await RemoteFabric.connect(primary.address)
+        await c.put("k", b"v")
+        node = await _standby(primary, detector_budget_s=0.3)
+        try:
+            await _drain_lag(primary)
+            await c.close()
+            primary.kill()
+            await primary.stop()
+            await asyncio.wait_for(node.promoted.wait(), timeout=10.0)
+
+            # resurrect the stale primary on its old port with its WAL
+            # and its standby as --peer: the startup probe sees the
+            # higher fence and it starts DEMOTED (standby of the new
+            # primary) instead of accepting writes
+            stale = FabricNode(
+                port=port_a, persist_dir=d, peers=(node.address,),
+                detector_budget_s=30.0,
+            )
+            await stale.start()
+            assert stale.role == "standby"
+            assert stale.server.primary_address == node.address
+
+            # a client configured ONLY with the old address follows the
+            # NotPrimary redirect transparently
+            c2 = await RemoteFabric.connect(f"127.0.0.1:{port_a}")
+            await c2.put("after-failover", b"yes")
+            assert c2.address == node.address
+            assert await c2.get("k") == b"v"  # replicated state intact
+            direct = await RemoteFabric.connect(node.address)
+            assert await direct.get("after-failover") == b"yes"
+            # ... and the resurrected broker re-converges as a standby
+            await _drain_lag(node.server)
+            assert fabric_state_digest(node.fabric) == (
+                fabric_state_digest(stale.fabric)
+            )
+            await direct.close()
+            await c2.close()
+            await stale.stop()
+        finally:
+            await node.stop()
+
+    run(main())
+
+
+def test_fencer_demotes_peerless_stale_primary(tmp_path):
+    """A stale primary restarted WITHOUT --peer config is still fenced:
+    the promoted broker's fencer loop actively delivers repl.fence to
+    the old address."""
+
+    async def main():
+        d = str(tmp_path / "wal")
+        primary = FabricServer(port=0, persist_dir=d)
+        await primary.start()
+        port_a = primary.port
+        node = await _standby(primary, detector_budget_s=0.25)
+        node.fence_interval_s = 0.2
+        try:
+            await _drain_lag(primary)
+            primary.kill()
+            await primary.stop()
+            await asyncio.wait_for(node.promoted.wait(), timeout=10.0)
+
+            stale = FabricServer(port=port_a, persist_dir=d)
+            await stale.start()
+            assert stale.role == "primary"  # resurrection, no peer info
+            deadline = asyncio.get_event_loop().time() + 5.0
+            while (
+                stale.role == "primary"
+                and asyncio.get_event_loop().time() < deadline
+            ):
+                await asyncio.sleep(0.05)
+            assert stale.role == "standby"
+            assert stale.primary_address == node.address
+            assert stale.demotions_total == 1
+            await stale.stop()
+        finally:
+            await node.stop()
+
+    run(main())
+
+
+def test_promotion_seq_skip_flags_client_ahead_cursor_as_gap():
+    """A resume cursor pointing into the promotion's skipped seq range
+    (messages only the dead primary ever delivered) resumes with
+    gap=True — sequencing consumers resync instead of silently missing
+    the tail."""
+    from dynamo_tpu.runtime.fabric.local import LocalFabric
+
+    async def main():
+        f = LocalFabric()
+        for i in range(5):
+            await f.publish("kv_events.w", {"i": i}, b"")
+        assert f.pub_seq == 5
+        # standby only replicated up to seq 3, then promoted
+        f.pub_seq = 3
+        f.promote_state(seq_skip=1000)
+        assert f.fence == 2
+        # cursor 5 (the client saw seqs the standby never had) -> gap
+        sub = await f.subscribe("kv_events.>", from_seq=5)
+        assert sub.resume_gap is True
+        # cursor 3 (exactly the watermark) -> lossless resume, no gap
+        sub2 = await f.subscribe("kv_events.>", from_seq=3)
+        assert sub2.resume_gap is False
+        # new publishes land past the skip: no collision with seqs <= 5
+        await f.publish("kv_events.w", {"i": 99}, b"")
+        assert f.pub_seq == 1004
+
+    run(main())
+
+
+def test_repl_wire_fuzz_never_a_diverged_standby():
+    """Bit-flip fuzz over the replication stream (a corrupting proxy
+    between primary and standby): every corrupt frame is a CodecError
+    -> session drop -> fresh snapshot bootstrap, and once the wire
+    heals the standby is digest-EXACT — never silently diverged."""
+
+    async def main():
+        rng = random.Random(7)
+        primary = FabricServer(port=0)
+        await primary.start()
+        phost, pport = primary.address.rsplit(":", 1)
+
+        corrupting = True
+
+        async def proxy(reader, writer):
+            try:
+                up_r, up_w = await asyncio.open_connection(phost, int(pport))
+            except OSError:
+                writer.close()
+                return
+
+            async def pump(src, dst, corrupt):
+                try:
+                    while True:
+                        chunk = await src.read(4096)
+                        if not chunk:
+                            break
+                        if corrupt and corrupting and rng.random() < 0.10:
+                            b = bytearray(chunk)
+                            b[rng.randrange(len(b))] ^= 1 << rng.randrange(8)
+                            chunk = bytes(b)
+                        dst.write(chunk)
+                        await dst.drain()
+                except (ConnectionError, OSError, asyncio.CancelledError):
+                    pass
+                finally:
+                    try:
+                        dst.close()
+                    except Exception:
+                        pass
+
+            await asyncio.gather(
+                pump(reader, up_w, False),     # standby -> primary clean
+                pump(up_r, writer, True),      # primary -> standby fuzzed
+            )
+
+        proxy_srv = await asyncio.start_server(proxy, "127.0.0.1", 0)
+        proxy_addr = "127.0.0.1:%d" % proxy_srv.sockets[0].getsockname()[1]
+
+        node = FabricNode(
+            port=0, standby_of=proxy_addr, auto_promote=False,
+        )
+        await node.start()
+        # tight liveness window: a wedged torn read (bit-flipped length
+        # prefix) must die fast enough for the convergence budget below
+        node.tail.idle_timeout_s = 0.4
+        c = await RemoteFabric.connect(primary.address)
+        try:
+            for i in range(120):
+                await c.put(f"k/{i % 17}", f"v{i}".encode())
+                await c.publish("kv_events.w", {"i": i}, b"p" * 32)
+                await asyncio.sleep(0.002)
+            # the fuzz MUST have bitten at least once at 10%/chunk
+            deadline = asyncio.get_event_loop().time() + 10.0
+            while (
+                node.tail.codec_errors == 0
+                and asyncio.get_event_loop().time() < deadline
+            ):
+                await c.put("k/poke", b"x")
+                await asyncio.sleep(0.01)
+            assert node.tail.codec_errors > 0
+            assert node.tail.bootstraps >= 2  # re-bootstrapped after poison
+
+            corrupting = False  # heal the wire
+            await c.put("k/final", b"done")
+            deadline = asyncio.get_event_loop().time() + 10.0
+            while asyncio.get_event_loop().time() < deadline:
+                if (
+                    node.tail.snapshot_applied
+                    and fabric_state_digest(primary.fabric)
+                    == fabric_state_digest(node.fabric)
+                ):
+                    break
+                await asyncio.sleep(0.05)
+            assert fabric_state_digest(primary.fabric) == (
+                fabric_state_digest(node.fabric)
+            ), "standby diverged after wire corruption"
+        finally:
+            await c.close()
+            await node.stop()
+            proxy_srv.close()
+            await primary.stop()
+
+    run(main())
+
+
+def test_explicit_promote_admin_op():
+    async def main():
+        from dynamo_tpu.runtime.fabric.replica import promote_standby
+
+        primary = FabricServer(port=0)
+        await primary.start()
+        node = await _standby(primary, auto_promote=False)
+        try:
+            await _drain_lag(primary)
+            reply = await promote_standby(node.address)
+            assert reply.get("ok") is True
+            assert reply.get("role") == "primary"
+            assert node.role == "primary"
+            # primary refuses the promote op (no hook): explicit error
+            reply2 = await promote_standby(primary.address)
+            assert reply2.get("ok") is False
+        finally:
+            await node.stop()
+            await primary.stop()
+
+    run(main())
+
+
+def test_multi_address_parse_and_single_broker_unchanged():
+    f = RemoteFabric("a:1,b:2, c:3")
+    assert f.addresses == ["a:1", "b:2", "c:3"]
+    assert f.address == "a:1"
+    g = RemoteFabric("127.0.0.1:4222")
+    assert g.addresses == ["127.0.0.1:4222"]
+    with pytest.raises(ValueError):
+        RemoteFabric(" , ")
+
+    async def main():
+        # single-broker path: no standby -> no repl subscribers, role
+        # primary, zero lag — the pre-HA wire pinned by the rest of
+        # tests/test_fabric_e2e.py
+        s = FabricServer(port=0)
+        await s.start()
+        c = await RemoteFabric.connect(s.address)
+        await c.put("k", b"v")
+        st = s.stats()
+        assert st["repl_subscribers"] == 0
+        assert st["repl_lag_records"] == 0
+        assert st["is_primary"] == 1
+        assert st["fence"] == 1
+        await c.close()
+        await s.stop()
+
+    run(main())
+
+
+def test_worker_degraded_mode_buffers_and_burns_seqs_on_overflow():
+    """Designed broker-less mode at the worker: KV events buffer
+    UNSTAMPED while no broker answers (a short outage loses nothing),
+    overflow is stamped-and-burned (detectable seq gap), and the buffer
+    ships on reconnect — the indexer sees [1..3, gap, 6..10]."""
+    from dynamo_tpu.engine.page_table import KvEvent
+    from dynamo_tpu.model_card import ModelDeploymentCard
+    from dynamo_tpu.runtime import DistributedRuntime
+    from dynamo_tpu.worker import Worker
+
+    async def main():
+        server = FabricServer(port=0)
+        await server.start()
+        port = server.port
+        fabric = await RemoteFabric.connect(server.address)
+        fabric.degraded_after_s = 0.05
+        rt = DistributedRuntime(fabric)
+        worker = Worker(
+            rt, ModelDeploymentCard(name="tiny"), engine_kind="echo",
+        )
+        worker.instance_id = "w-ha"
+        worker.kv_pending_cap = 5
+
+        sub = await fabric.subscribe("kv_events.>")
+        got: list[list[int]] = []
+
+        async def consume():
+            import msgpack as _mp
+
+            async for m in sub:
+                got.append(
+                    [e["seq"] for e in _mp.unpackb(m.payload, raw=False)]
+                )
+
+        task = asyncio.get_running_loop().create_task(consume())
+
+        def ev(i):
+            return KvEvent("stored", (1000 + i,), None, ((i,),))
+
+        worker._kv_event_buffer.extend(ev(i) for i in range(3))
+        await worker._publish_once(fabric)
+        assert worker._kv_seq == 3  # stamped + published
+
+        server.kill()
+        await server.stop()
+        deadline = asyncio.get_event_loop().time() + 5.0
+        while fabric.connected and (
+            asyncio.get_event_loop().time() < deadline
+        ):
+            await asyncio.sleep(0.02)
+        assert not fabric.connected
+
+        worker._kv_event_buffer.extend(ev(10 + i) for i in range(4))
+        await worker._publish_once(fabric)
+        assert len(worker._kv_pending) == 4
+        assert worker._kv_seq == 3  # pending events stay UNSTAMPED
+        assert worker.kv_events_dropped == 0
+
+        worker._kv_event_buffer.extend(ev(20 + i) for i in range(3))
+        await worker._publish_once(fabric)
+        # 7 > cap 5: the 2 oldest were stamped (seqs 4,5 burned) and
+        # dropped — an honest, detectable gap
+        assert len(worker._kv_pending) == 5
+        assert worker._kv_seq == 5
+        assert worker.kv_events_dropped == 2
+
+        # frames carry the accounting once a broker is back
+        server2 = FabricServer(port=port)
+        await server2.start()
+        deadline = asyncio.get_event_loop().time() + 10.0
+        while not fabric.connected and (
+            asyncio.get_event_loop().time() < deadline
+        ):
+            await asyncio.sleep(0.02)
+        assert fabric.connected
+        await worker._publish_once(fabric)
+        assert worker._kv_pending == []
+        assert worker._kv_seq == 10  # 5 pending stamped 6..10
+
+        deadline = asyncio.get_event_loop().time() + 5.0
+        while sum(len(b) for b in got) < 8 and (
+            asyncio.get_event_loop().time() < deadline
+        ):
+            await asyncio.sleep(0.02)
+        seqs = [s for batch in got for s in batch]
+        assert seqs == [1, 2, 3, 6, 7, 8, 9, 10]  # the gap IS 4,5
+        assert fabric.degraded_total >= 1  # outage was marked + cleared
+        task.cancel()
+        await fabric.close()
+        await server2.stop()
+
+    run(main())
+
+
+def test_planner_holds_while_control_plane_degraded():
+    from dynamo_tpu.planner.planner import (
+        Actions,
+        ControlConfig,
+        ControlRunner,
+        FleetState,
+    )
+
+    class _Planner:
+        config = ControlConfig(interval_s=1.0)
+
+        def tick(self, state):
+            return Actions(
+                target_decode=8, target_prefill=4, reason="burn high"
+            )
+
+    scaled = []
+
+    class _Conn:
+        async def scale(self, role, target, observed):
+            scaled.append((role, target))
+
+    async def observe():
+        return FleetState(
+            num_decode=2, num_prefill=1, kv_usage=0.5, num_waiting=0,
+            prefill_queue_depth=0,
+        )
+
+    async def main():
+        degraded = {"on": True}
+        r = ControlRunner(
+            _Planner(), _Conn(), observe,
+            degraded_fn=lambda: degraded["on"],
+        )
+        acts = await r.step()
+        assert scaled == []  # actuation suspended
+        assert r.decisions["hold"] == 1
+        assert r.degraded_holds == 1
+        assert acts.reason.startswith("hold")
+        assert acts.target_decode == 2  # frozen at observed
+
+        degraded["on"] = False
+        await r.step()
+        assert scaled  # broker back -> the loop actuates again
+
+    run(main())

@@ -139,3 +139,49 @@ def test_exposition_matches_annotation_layer_contract():
     # empty ring: no family at all (the metrics service's exposition
     # stays lint-clean either way)
     assert EventRing().expose_lines() == []
+
+
+def test_ship_once_requeues_events_while_broker_unreachable():
+    """Degraded mode must not eat the timeline: events drained while no
+    broker answers go BACK in the (bounded) buffer and ship on
+    reconnect — the degraded/failover events are exactly the ones that
+    must survive the outage they describe."""
+    import asyncio
+
+    from dynamo_tpu.telemetry import events, traceplane
+
+    events.reset()
+    try:
+        events.record("degraded", severity="warning", source="w1")
+
+        class _Offline:
+            connected = False
+
+            async def publish(self, *a, **k):
+                raise AssertionError("must not publish while offline")
+
+        asyncio.run(traceplane.ship_once(_Offline(), "w1"))
+        assert events.pending() == 1  # requeued, not dropped
+
+        class _Flaky:
+            connected = True
+
+            async def publish(self, *a, **k):
+                raise ConnectionError("lost mid-publish")
+
+        asyncio.run(traceplane.ship_once(_Flaky(), "w1"))
+        assert events.pending() == 1  # failed publish requeues too
+
+        sent = []
+
+        class _Online:
+            connected = True
+
+            async def publish(self, subject, header, payload=b""):
+                sent.append(subject)
+
+        asyncio.run(traceplane.ship_once(_Online(), "w1"))
+        assert events.pending() == 0
+        assert any("fleet.events" in s for s in sent)
+    finally:
+        events.reset()

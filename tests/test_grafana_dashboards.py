@@ -68,6 +68,9 @@ def _populated_expositions() -> list[str]:
         handovers_total=1, handover_fallbacks_total=1,
         handover_bytes_total=1024, handover_blocks_total=2,
         handovers_adopted_total=2, kv_transfer_corrupt_total=1,
+        # control-plane HA: the worker's broker-connection view
+        degraded=0, degraded_entries_total=1,
+        kv_events_dropped_total=3, kv_events_pending=0,
     )
     svc.aggregator._latest["w1"] = (frame, time.monotonic())
     # closed-loop planner status frame (ControlRunner.status shape) so
@@ -143,6 +146,11 @@ def _populated_expositions() -> list[str]:
         "active_leases": 1, "ops_total": 10, "redeliveries_total": 1,
         "queued_items": 0, "inflight_items": 0,
         "queues": {"q": 0},
+        # control-plane HA broker self-metrics (server.py stats):
+        # replication + fencing families for the "Control plane" row
+        "repl_subscribers": 1, "repl_lag_records": 0,
+        "promotions_total": 1, "demotions_total": 0,
+        "is_primary": 1, "fence": 2, "orphaned_leases": 0,
     }
     # stall-watchdog counters (process-global, like the phase
     # histograms): populated so the "Stalls & attainment" panels and the
