@@ -75,6 +75,7 @@ def _engine_config(args, eos_token_ids: tuple = ()) -> EngineConfig:
             if getattr(args, "decode_steps", None) is not None
             else {}
         ),
+        decode_kstep=getattr(args, "decode_kstep", 1),
     )
 
 
@@ -853,6 +854,16 @@ def build_parser() -> argparse.ArgumentParser:
         help="decode steps fused per dispatch (host sync per K tokens/seq;"
              " raise to ~64 on a remote/tunneled TPU where the sync RTT"
              " dominates a step). Default: engine default (8)",
+    )
+    runp.add_argument(
+        "--decode-kstep", type=int, default=1, dest="decode_kstep",
+        help="fuse K decode iterations into ONE on-device program per "
+             "dispatch: sampling, stop checks, and paged-KV writes run "
+             "on device, the host syncs once per K tokens (vLLM's "
+             "--num-scheduler-steps analogue). 1 (default) = classic "
+             "per-step loop, bit-identical streams; K>1 stays bit-exact "
+             "and auto-disables under speculation, logprobs rows, and "
+             "multi-host SPMD",
     )
     runp.add_argument(
         "--host-kv-bytes", type=int, default=0, dest="host_kv_bytes",

@@ -52,6 +52,27 @@ class EngineConfig:
     #: conditions are applied on the host afterwards — up to K-1 speculative
     #: tokens past a stop are computed and dropped. 1 = classic stepping.
     decode_steps: int = 8
+    #: on-device K-step decode windows (ROADMAP item 2a, the host-loop
+    #: elimination lever): run K decode iterations inside ONE XLA program
+    #: with per-iteration on-device sampling, on-device stop-condition
+    #: masks (eos/stop-token/max_tokens freeze finished rows mid-window;
+    #: frozen rows waste only masked lanes), and on-device paged KV
+    #: writes + position advances — the host reads back [K, B] ids plus
+    #: per-row emitted counts once per window instead of deciding every
+    #: step. Differs from decode_steps (decode_multi) in that finish
+    #: conditions are evaluated ON DEVICE, so no overshoot tokens are
+    #: computed past a stop, and the scheduler reserves the whole
+    #: window's page runway up front (or clamps the window). Composes
+    #: with overlap_decode (the next window chains speculatively off
+    #: device outputs) and mixed_steps (the window runs as the decode
+    #: leg beside the prefill chunk). Auto-disabled, with a logged
+    #: reason, for spec_ngram/spec_draft (they already batch steps),
+    #: logprobs rows, oversized stop sets, and multi-process SPMD
+    #: meshes. 1 (default) = off: the classic path, bit-identical.
+    #: Token streams at K>1 are bit-exact vs K=1 (greedy AND sampled —
+    #: pinned by tests/test_engine_kstep.py). `--decode-kstep` on the
+    #: CLI (vLLM `--num-scheduler-steps` analogue, docs/migrating.md).
+    decode_kstep: int = 1
     #: overlapped decode loop: after dispatching decode step N, dispatch
     #: step N+1 speculatively (same batch, +1 round, sampled ids fed back
     #: on device) and read step N's ids back one step lagged via an async
@@ -236,6 +257,12 @@ class EngineConfig:
                 f"spec_draft_tokens must be >= 1, got "
                 f"{self.spec_draft_tokens}"
             )
+        if self.decode_kstep < 1:
+            raise ValueError(
+                f"decode_kstep must be >= 1, got {self.decode_kstep} "
+                "(1 = classic stepping; K>1 fuses K on-device iterations "
+                "per dispatch)"
+            )
         if self.prefill_budget_policy not in ("fixed", "adaptive"):
             raise ValueError(
                 "prefill_budget_policy must be 'fixed' or 'adaptive', got "
@@ -271,8 +298,8 @@ class EngineConfig:
         return self.decode_buckets[-1]
 
     @staticmethod
-    def for_tests() -> "EngineConfig":
-        return EngineConfig(
+    def for_tests(**overrides) -> "EngineConfig":
+        defaults = dict(
             model="tiny",
             num_pages=64,
             page_size=4,
@@ -282,3 +309,5 @@ class EngineConfig:
             max_seqs=8,
             dtype="float32",
         )
+        defaults.update(overrides)
+        return EngineConfig(**defaults)

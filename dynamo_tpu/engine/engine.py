@@ -173,6 +173,20 @@ class EngineMetrics:
     overlap_dispatches: int = 0
     overlap_hits: int = 0
     overlap_rollbacks: int = 0
+    #: on-device K-step decode windows (EngineConfig.decode_kstep):
+    #: windows dispatched (speculatively-chained ones included), device
+    #: iterations run inside them (steps/windows = the average fused K),
+    #: the most recent window's size (gauge), and dispatches where a
+    #: configured K>1 window fell back to the classic path (logprobs
+    #: rows or an oversized stop set in the batch)
+    kstep_windows: int = 0
+    kstep_steps: int = 0
+    kstep_window_size: int = 0
+    kstep_fallbacks: int = 0
+    #: cumulative wall ms of K-step window dispatch+sync — with
+    #: kstep_windows it is the decode_kstep program family's measured
+    #: ms/dispatch column in /v1/debug/programs attainment
+    time_kstep_ms: float = 0.0
     #: engine-internals plane (fleet telemetry, docs/observability.md):
     #: jit-cache misses (one full XLA compile each) and their cumulative
     #: wall cost — climbing in steady state means the program family is
@@ -211,6 +225,7 @@ class EngineMetrics:
         "time_decode_host_ms",
         "prefill_dispatches", "decode_dispatches", "mixed_dispatches",
         "overlap_dispatches", "overlap_hits", "overlap_rollbacks",
+        "kstep_windows", "kstep_steps", "time_kstep_ms",
     )
 
     def to_dict(self) -> dict:
@@ -240,6 +255,9 @@ class _InflightDecode:
     greedy: bool = False
     lp: int = -1
     bias: bool = False
+    #: dispatched through the decode_kstep program family (on-device
+    #: stop masks); the chained re-speculation stays in the family
+    kstep: bool = False
 
 
 @dataclass
@@ -470,6 +488,36 @@ class JaxEngine:
             and config.spec_ngram <= 0
         )
         self.scheduler.mixed_enabled = self._mixed_enabled
+        #: on-device K-step decode windows (config.decode_kstep): same
+        #: policy surface as overlap/mixed — off on multi-process SPMD
+        #: meshes (lockstep replicas: not validated) and under BOTH
+        #: speculation modes (they already batch steps per dispatch).
+        #: _decode_kstep is the live window target (bench A/B toggles it
+        #: on a warm engine); per-dispatch eligibility (logprobs rows,
+        #: stop-set size, page runway) is decided in _pick_kstep.
+        self._decode_kstep = config.decode_kstep
+        self._kstep_enabled = (
+            config.decode_kstep > 1
+            and not self._multiproc
+            and config.spec_ngram <= 0
+            and not self._spec_draft
+        )
+        if config.decode_kstep > 1 and not self._kstep_enabled:
+            logger.info(
+                "decode_kstep=%d auto-disabled: %s",
+                config.decode_kstep,
+                "multi-process SPMD mesh (lockstep replicas not "
+                "validated)" if self._multiproc
+                else "speculative decoding already batches steps per "
+                "dispatch",
+            )
+        #: live K-step window state: the last dispatched window size
+        #: (the stall watchdog floors its threshold at a multiple of it)
+        #: and the device-measured per-step ms of that window (spreads
+        #: window emissions in the decode-stall histogram so a healthy
+        #: K-wide gap is not booked as a prefill stall)
+        self._kstep_live = 1
+        self._kstep_step_ms = 0.0
         #: per-request last token-emission mark for the decode-stall
         #: histogram: request_id -> (perf_counter at emission, prefill+
         #: mixed dispatch count at emission). A later emission whose
@@ -1131,6 +1179,119 @@ class JaxEngine:
                 req.pages.extend(got)
         return True
 
+    # -- on-device K-step decode windows (config.decode_kstep) -------------
+
+    def _kstep_stop_ids(self, req: Request) -> Optional[tuple[int, ...]]:
+        """This request's device-side stop set (eos ∪ stop_token_ids; an
+        ignore_eos request stops on NOTHING — `_finish_reason_for`
+        ignores both sets for it), or None when it exceeds the static
+        STOP_SLOTS packing and the window must fall back to the
+        host-side finish scan."""
+        from dynamo_tpu.engine.sampling import STOP_SLOTS
+
+        s = req.sampling
+        if s.ignore_eos:
+            return ()
+        ids = tuple(
+            dict.fromkeys(
+                tuple(self.config.eos_token_ids) + tuple(s.stop_token_ids)
+            )
+        )
+        return ids if len(ids) <= STOP_SLOTS else None
+
+    def _kstep_candidate(self, reqs: list[Request]) -> bool:
+        """Side-effect-free eligibility for a K-step window over these
+        rows: configured on, policy-enabled, no logprobs rows (the fused
+        window threads no per-position logprob state), every stop set
+        fits STOP_SLOTS. Mixed steps use this to decide whether to split
+        the K-window out as their decode leg; _pick_kstep layers the
+        stateful clamps (admission latency, page runway) on top."""
+        if self._decode_kstep <= 1 or not self._kstep_enabled:
+            return False
+        if self._batch_logprobs(reqs) >= 0:
+            return False
+        return all(self._kstep_stop_ids(r) is not None for r in reqs)
+
+    def _pick_kstep(self, reqs: list[Request]) -> int:
+        """Window size for this decode dispatch; 1 => take the classic
+        decode/decode_multi path. Mirrors _pick_decode_steps' admission
+        rule (drop to 1 when an admissible request waits) and its
+        pow2 snapping, but the page headroom is reserved UP FRONT for
+        the whole window via the scheduler's runway clamp — the
+        on-device loop can never ask the host for a page mid-window."""
+        if self._decode_kstep <= 1 or not self._kstep_enabled:
+            return 1
+        if not self._kstep_candidate(reqs):
+            self.metrics.kstep_fallbacks += 1
+            logger.debug(
+                "kstep fallback: logprobs rows or oversized stop set"
+            )
+            return 1
+        if self.scheduler.num_waiting() > 0 and self.scheduler.can_admit_head():
+            return 1  # stay responsive: new arrivals don't wait K steps
+        k = self._pow2_floor(self._decode_kstep)
+        # context/page-table room: growing a window past max_context or
+        # max_pages_per_seq would overflow the [B, mp] page table (same
+        # per-request caps as _pick_decode_steps)
+        cap_tokens = self.config.max_pages_per_seq * self.config.page_size
+        for req in reqs:
+            k = min(k, self.config.max_context - req.num_tokens + 1)
+            k = min(k, cap_tokens - req.num_tokens + 1)
+        # cover the longest remaining completion, rounded up to a power
+        # of two (same reasoning as _pick_decode_steps: the tail of a
+        # wave runs as one window, the program family stays log-sized)
+        rem_max = 0
+        for req in reqs:
+            s = req.sampling
+            rem_max = max(
+                rem_max,
+                s.max_tokens - len(req.output_tokens) - req.num_emitted,
+            )
+        p = 1
+        while p < max(1, rem_max):
+            p *= 2
+        k = self._pow2_floor(min(k, p))
+        if k <= 1:
+            return 1
+        # scheduler-guaranteed page runway for the WHOLE window (or a
+        # clamped one); _grow_pages_for then actually reserves it
+        k = self.scheduler.clamp_kstep_window(reqs, k)
+        while k > 1 and not self._grow_pages_for(reqs, k - 1):
+            k //= 2  # pool raced smaller than the clamp's view
+        return max(1, k)
+
+    def _kstep_arrays(
+        self, reqs: list[Request], pad_to: int, emitted_ahead: int = 0
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """Device inputs for the window's on-device finish evaluation:
+        per-row packed stop slots (−1-padded) and per-row emission
+        budgets — the EXACT token counts `_finish_reason_for` would
+        allow (max_tokens and max_context legs), so the device freeze
+        decisions and the host finish scan agree position-for-position.
+        `emitted_ahead` discounts a pending overlapped window's tokens
+        when building the chained window's budgets. Padding rows get
+        budget 0 and empty stop sets (they are never alive anyway)."""
+        from dynamo_tpu.engine.sampling import STOP_SLOTS
+
+        stops = np.full((pad_to, STOP_SLOTS), -1, np.int32)
+        budgets = np.zeros(pad_to, np.int32)
+        for i, req in enumerate(reqs):
+            ids = self._kstep_stop_ids(req)  # eligibility pre-checked
+            if ids:
+                stops[i, : len(ids)] = ids
+            s = req.sampling
+            budgets[i] = max(
+                0,
+                min(
+                    s.max_tokens
+                    - len(req.output_tokens)
+                    - req.num_emitted,
+                    self.config.max_context - req.num_tokens,
+                )
+                - emitted_ahead,
+            )
+        return stops, budgets
+
     # -- speculative decode (prompt lookup / n-gram) ------------------------
 
     def _spec_eligible(self, reqs: list[Request]) -> bool:
@@ -1699,7 +1860,12 @@ class JaxEngine:
         t0 = time.perf_counter()
         b_bucket = self.config.decode_bucket_for(len(reqs))
         mp = self.config.max_pages_per_seq
-        k_steps = self._pick_decode_steps(reqs)
+        # On-device K-step window first (config.decode_kstep): finish
+        # conditions evaluate ON DEVICE, so no overshoot compute past a
+        # stop; k_win == 1 falls through to the classic path (which is
+        # then bit-identical to a decode_kstep-free build).
+        k_win = self._pick_kstep(reqs)
+        k_steps = k_win if k_win > 1 else self._pick_decode_steps(reqs)
         tokens = np.zeros((b_bucket, 1), np.int32)
         positions = np.zeros((b_bucket, 1), np.int32)
         valid = np.zeros((b_bucket, 1), bool)
@@ -1722,14 +1888,35 @@ class JaxEngine:
             "base": (tokens, positions, valid, pt), "samp": samp,
             "pen": pen_args, "bias": bias_kwargs,
         }
-        if k_steps == 1:
+        if k_win > 1:
+            host["stops"], host["budgets"] = self._kstep_arrays(
+                reqs, b_bucket
+            )
+        elif k_steps == 1:
             host["last"] = np.zeros(b_bucket, np.int32)
         dev = self._dev_tree(host)
         samp, pen_args, bias_kwargs = dev["samp"], dev["pen"], dev["bias"]
         d_tokens, d_positions, d_valid, d_pt = dev["base"]
         args = (self.params, d_tokens, d_positions, d_valid, self.kv, d_pt)
         lp_data = None
-        if k_steps == 1:
+        n_emit_dev = None
+        if k_win > 1:
+            # logprobs rows never reach here (_pick_kstep falls back),
+            # so the family has no lp variant
+            fn = self._get_step_fn(
+                "decode_kstep", b_bucket, k_steps, greedy=all_greedy,
+                lp=-1, pen=pen, bias=bias,
+            )
+            token_ids, n_emit_dev, self.kv = fn(
+                *args, dev["stops"], dev["budgets"], *samp, *pen_args,
+                **bias_kwargs,
+            )
+            m = self.metrics
+            m.kstep_windows += 1
+            m.kstep_steps += k_steps
+            m.kstep_window_size = k_steps
+            self._kstep_live = k_steps
+        elif k_steps == 1:
             fn = self._get_step_fn(
                 "decode", b_bucket, 1, greedy=all_greedy, lp=lp, pen=pen,
                 bias=bias,
@@ -1765,7 +1952,7 @@ class JaxEngine:
         # scans this step's ids for stops below.
         self._maybe_speculate(
             reqs, b_bucket, k_steps, token_ids,
-            greedy=all_greedy, lp=lp, bias=bias,
+            greedy=all_greedy, lp=lp, bias=bias, kstep=k_win > 1,
         )
         t1 = time.perf_counter()
         ids = np.asarray(token_ids).reshape(k_steps, b_bucket)
@@ -1773,6 +1960,28 @@ class JaxEngine:
         self.metrics.time_decode_sync_ms += (
             time.perf_counter() - t1
         ) * 1000.0
+        if k_win > 1:
+            # window wall (dispatch+sync) is the measured column for the
+            # decode_kstep family's attainment; /k is the per-step time
+            # the stall histogram spreads window emissions by
+            window_ms = (time.perf_counter() - t0) * 1000.0
+            self.metrics.time_kstep_ms += window_ms
+            self._kstep_step_ms = window_ms / k_steps
+            outputs = self._decode_postprocess(
+                reqs, k_steps, ids, lp_arrays, mixed=mixed, kstep=True
+            )
+            # device freeze decisions vs the host finish scan: they are
+            # the same arithmetic — disagreement means a program bug, so
+            # surface it loudly rather than silently trusting either
+            host_emitted = sum(len(o.new_token_ids) for o in outputs)
+            dev_emitted = int(np.asarray(n_emit_dev)[: len(reqs)].sum())
+            if host_emitted != dev_emitted:
+                logger.warning(
+                    "decode_kstep window disagreement: device emitted "
+                    "%d tokens, host accepted %d (K=%d, B=%d)",
+                    dev_emitted, host_emitted, k_steps, len(reqs),
+                )
+            return outputs
         return self._decode_postprocess(
             reqs, k_steps, ids, lp_arrays, mixed=mixed
         )
@@ -1791,7 +2000,7 @@ class JaxEngine:
 
     def _decode_postprocess(
         self, reqs: list[Request], k_steps: int, ids: np.ndarray, lp_arrays,
-        mixed: bool = False,
+        mixed: bool = False, kstep: bool = False,
     ) -> list[StepOutput]:
         """Host half of a decode step: scan sampled ids for finish
         conditions (dropping overshoot past a stop), append accepted
@@ -1825,7 +2034,8 @@ class JaxEngine:
                     )
             outputs.extend(
                 self._accept_tokens(
-                    req, accepted, finish, lps=lps, tops=tops, mixed=mixed
+                    req, accepted, finish, lps=lps, tops=tops, mixed=mixed,
+                    kstep=kstep,
                 )
             )
             self._register_pages(req)
@@ -1875,7 +2085,11 @@ class JaxEngine:
             inflight, reqs_d
         )
         any_mm = any(p.request.mm_embeds is not None for p in pieces)
-        if use_inflight or any_mm:
+        # K-step windows compose with mixed steps as the decode LEG
+        # beside the prefill chunk (two dispatches, same semantics):
+        # the fused mixed program has no kstep variant, and the window
+        # path handles its own stops/budgets/runway host arrays.
+        if use_inflight or any_mm or self._kstep_candidate(reqs_d):
             self.metrics.prefill_dispatches += 1
             outputs = self._run_prefill(
                 ScheduledBatch(kind="prefill", prefill=batch.prefill),
@@ -2066,7 +2280,7 @@ class JaxEngine:
 
     def _maybe_speculate(
         self, reqs: list[Request], b_bucket: int, k_prev: int, ids_dev,
-        greedy: bool, lp: int, bias: bool,
+        greedy: bool, lp: int, bias: bool, kstep: bool = False,
     ) -> None:
         """Dispatch the NEXT decode step before the pending step's ids
         reach the host: same batch, positions advanced by k_prev, tokens
@@ -2130,7 +2344,17 @@ class JaxEngine:
             "base": (positions, valid, pt), "samp": samp,
             "bias": bias_kwargs,
         }
-        if k_next == 1:
+        use_kstep = kstep and k_next > 1
+        if use_kstep:
+            # chain the next K-window through the SAME decode_kstep
+            # family: budgets discount the pending window's k_prev
+            # tokens (the early-outs above already guarantee no row
+            # LENGTH-finishes inside the pending window; a sampled stop
+            # still rolls the chained window back at consume time)
+            host["stops"], host["budgets"] = self._kstep_arrays(
+                reqs, b_bucket, emitted_ahead=k_prev
+            )
+        elif k_next == 1:
             host["last"] = np.zeros(b_bucket, np.int32)
         try:
             dev = self._dev_tree(host)
@@ -2143,7 +2367,23 @@ class JaxEngine:
                 self.params, d_tokens, d_positions, d_valid, self.kv, d_pt
             )
             lp_data = None
-            if k_next == 1:
+            if use_kstep:
+                # kstep eligibility pinned lp == -1 at the original
+                # dispatch; the chained window inherits it
+                fn = self._get_step_fn(
+                    "decode_kstep", b_bucket, k_next, greedy=greedy,
+                    lp=-1, pen=0, bias=bias,
+                )
+                token_ids, _n_emit, self.kv = fn(
+                    *args, dev["stops"], dev["budgets"], *dev["samp"],
+                    **dev["bias"]
+                )
+                m = self.metrics
+                m.kstep_windows += 1
+                m.kstep_steps += k_next
+                m.kstep_window_size = k_next
+                self._kstep_live = k_next
+            elif k_next == 1:
                 fn = self._get_step_fn(
                     "decode", b_bucket, 1, greedy=greedy, lp=lp, pen=0,
                     bias=bias,
@@ -2198,6 +2438,7 @@ class JaxEngine:
             greedy=greedy,
             lp=lp,
             bias=bias,
+            kstep=use_kstep,
         )
         self.metrics.time_decode_dispatch_ms += (
             time.perf_counter() - t0
@@ -2237,6 +2478,7 @@ class JaxEngine:
         self._maybe_speculate(
             reqs, inflight.b_bucket, inflight.k_steps, inflight.token_ids,
             greedy=inflight.greedy, lp=inflight.lp, bias=inflight.bias,
+            kstep=inflight.kstep,
         )
         t0 = time.perf_counter()
         ids = np.asarray(inflight.token_ids).reshape(
@@ -2249,7 +2491,8 @@ class JaxEngine:
             time.perf_counter() - t0
         ) * 1000.0
         return self._decode_postprocess(
-            reqs, inflight.k_steps, ids, lp_arrays, mixed=mixed
+            reqs, inflight.k_steps, ids, lp_arrays, mixed=mixed,
+            kstep=inflight.kstep,
         )
 
     def _discard_inflight(self, why: str) -> None:
@@ -2696,6 +2939,99 @@ class JaxEngine:
             )
             return self._cache_jit(kind, cache_key, jitted)
 
+        if kind == "decode_kstep":
+            # K decode iterations with ON-DEVICE finish evaluation
+            # (config.decode_kstep): like decode_multi's fused scan, but
+            # an `alive` mask carries each row's stop/budget state so
+            # finished rows freeze mid-window — their lanes compute
+            # masked garbage, their KV writes redirect to the null page
+            # (forward_hidden valid=False => ops/kv_update.paged_write
+            # page 0), their positions/draw counters/penalty counts stop
+            # advancing. Because counters and counts advance only while
+            # alive, every surviving row's gumbel stream and penalty
+            # state are IDENTICAL to K=1 sequential stepping (where the
+            # finished row simply leaves the batch) — the bit-exactness
+            # contract tests/test_engine_kstep.py pins. The host reads
+            # back [K, B] ids + per-row emitted counts once per window.
+            # No logprobs variant: logprobs rows fall back (lp == -1).
+            k_steps = t
+
+            def kstep_fn(params, tokens, positions, valid, kv, pt,
+                         stops, budgets,
+                         temps, top_ps, top_ks, seeds, counters,
+                         freq=None, pres=None, rep_p=None,
+                         out_toks=None, out_valid=None,
+                         bias_ids=None, bias_vals=None, bias_gated=None,
+                         min_toks=None):
+                from dynamo_tpu.engine.sampling import stop_mask
+
+                if pen:
+                    from dynamo_tpu.engine.sampling import (
+                        build_output_counts,
+                    )
+
+                    counts0 = build_output_counts(
+                        out_toks, out_valid, adapter.vocab_size
+                    )
+                else:
+                    counts0 = jnp.zeros((), jnp.float32)  # unused carry
+                alive0 = valid[:, 0]  # padding rows start frozen
+                n0 = jnp.zeros((valid.shape[0],), jnp.int32)
+
+                def body(carry, _):
+                    (tokens, positions, kv, counters, counts, alive,
+                     n_emit) = carry
+                    v = valid & alive[:, None]
+                    hidden, kv = adapter.forward_hidden(
+                        params, tokens, positions, v, kv, pt
+                    )
+                    logits = adapter.compute_logits(params, hidden[:, -1])
+                    ids = pick(
+                        logits, (temps, top_ps, top_ks, seeds, counters),
+                        counts=counts if pen else None, freq=freq,
+                        pres=pres, rep_p=rep_p,
+                        bias_args=(
+                            (bias_ids, bias_vals, bias_gated, min_toks)
+                            if bias
+                            else None
+                        ),
+                    )
+                    emit_i = alive.astype(jnp.int32)
+                    n_emit = n_emit + emit_i
+                    if pen:
+                        rows = jnp.arange(ids.shape[0])
+                        counts = counts.at[rows, ids].add(
+                            alive.astype(jnp.float32)
+                        )
+                    # emit-then-freeze: a stop token (or the budget's
+                    # last token) IS emitted — the row freezes for the
+                    # REST of the window, matching the host scan that
+                    # appends the token and then breaks on its finish
+                    alive = (
+                        alive
+                        & ~stop_mask(ids, stops)
+                        & (n_emit < budgets)
+                    )
+                    return (
+                        (ids[:, None], positions + emit_i[:, None], kv,
+                         counters + emit_i, counts, alive, n_emit),
+                        ids,
+                    )
+
+                (_, _, kv, _, _, _, n_emit), all_ids = jax.lax.scan(
+                    body,
+                    (tokens, positions, kv, counters, counts0, alive0, n0),
+                    None, length=k_steps,
+                )
+                return rep(all_ids), rep(n_emit), kv  # [K, B], [B]
+
+            jitted = jax.jit(kstep_fn, donate_argnums=(4,))
+            logger.info(
+                "compiled decode_kstep program B=%d K=%d greedy=%s",
+                b, k_steps, greedy,
+            )
+            return self._cache_jit(kind, cache_key, jitted)
+
         if kind == "mixed":
             # One fused program per (b=decode bucket, t=prefill T bucket,
             # b_pre=prefill row bucket): prefill chunk KV+decode token in
@@ -3032,13 +3368,22 @@ class JaxEngine:
                 return piece.request.trace_id
         return None
 
-    def _observe_emission(self, req: Request, finished: bool) -> None:
+    def _observe_emission(
+        self, req: Request, finished: bool, n_tokens: int = 1,
+        kstep: bool = False,
+    ) -> None:
         """Decode-stall histogram bookkeeping: observe the gap since this
         request's previous token emission whenever a prefill-carrying
         dispatch (pure prefill or mixed) ran in between — the prefill-
         attributed stall one running request experienced. Under the XOR
         scheduler these gaps are whole backlog drains; under mixed steps
-        they collapse to one step."""
+        they collapse to one step.
+
+        A K-step window delivers its K tokens in one host visit, so the
+        raw gap is K× the per-token cadence even when nothing stalled:
+        discount the device-measured healthy window time (per-step ms ×
+        n_tokens) before observing, leaving only true prefill-induced
+        excess in the histogram."""
         now = time.perf_counter()
         mark = self.metrics.prefill_dispatches + self.metrics.mixed_dispatches
         prev = self._last_emit.get(req.request_id)
@@ -3046,6 +3391,10 @@ class JaxEngine:
             from dynamo_tpu.telemetry import phases
 
             stall_ms = (now - prev[0]) * 1000.0
+            if kstep and n_tokens > 1:
+                stall_ms = max(
+                    0.0, stall_ms - self._kstep_step_ms * n_tokens
+                )
             if req.trace_id is not None:
                 # traced request: accumulate so the final StepOutput can
                 # carry the request's TOTAL prefill-induced stall onto
@@ -3103,6 +3452,7 @@ class JaxEngine:
         tops: Optional[tuple] = None,
         mixed: bool = False,
         spec: bool = False,
+        kstep: bool = False,
     ) -> list[StepOutput]:
         chain = self.scheduler.chains.get(req.request_id)
         for tok in tokens:
@@ -3111,7 +3461,10 @@ class JaxEngine:
                 chain.append(tok)
         self.metrics.generated_tokens += len(tokens)
         if tokens:
-            self._observe_emission(req, finished=finish is not None)
+            self._observe_emission(
+                req, finished=finish is not None,
+                n_tokens=len(tokens), kstep=kstep,
+            )
             if self.slo is not None:
                 self._observe_slo(req, len(tokens), finish is not None)
         if finish is not None:
@@ -3130,6 +3483,7 @@ class JaxEngine:
                 cached_tokens=req.num_cached_prompt_tokens if first else None,
                 mixed=mixed,
                 spec=spec,
+                kstep=kstep,
                 # tracing enrichment (traced requests only; None — and
                 # absent from the wire — otherwise): queue wait on the
                 # first output, accumulated decode stall on the last
@@ -3736,6 +4090,7 @@ class JaxEngine:
         "prefill_nosample": ("time_prefill_ms", "prefill_dispatches"),
         "decode": ("time_decode_ms", "decode_dispatches"),
         "decode_multi": ("time_decode_ms", "decode_dispatches"),
+        "decode_kstep": ("time_kstep_ms", "kstep_windows"),
         "spec_verify": ("time_decode_ms", "decode_dispatches"),
         "spec_fused": ("time_decode_ms", "decode_dispatches"),
         "spec_draft_prefill": ("time_prefill_ms", "prefill_dispatches"),

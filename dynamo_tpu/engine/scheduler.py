@@ -147,6 +147,30 @@ class Scheduler:
     def num_running(self) -> int:
         return len(self.running)
 
+    def clamp_kstep_window(self, reqs, k: int) -> int:
+        """Page-runway guarantee for on-device K-step decode windows
+        (EngineConfig.decode_kstep): the fused program writes K tokens
+        of KV per row with NO host allocation mid-window, so every page
+        the window needs must exist before dispatch. Halve K until the
+        whole batch's runway (pages to cover num_tokens + K - 1 per row,
+        beyond what each row already holds) fits in the free pool — the
+        engine then pre-grows via its normal growth path, which can
+        still preempt-by-recompute if a race shrinks the pool. Returns
+        the clamped window (>= 1); K=1 needs no runway beyond classic
+        stepping's."""
+        ps = self.config.page_size
+        while k > 1:
+            need = 0
+            for req in reqs:
+                need += max(
+                    0,
+                    -(-(req.num_tokens + k - 1) // ps) - len(req.pages),
+                )
+            if need <= self.allocator.num_free:
+                return k
+            k //= 2
+        return 1
+
     def decode_batch_stable(self) -> bool:
         """The overlap contract (engine `overlap_decode`, docs/engine.md):
         absent request-side events, the NEXT `schedule()` call returns

@@ -1701,6 +1701,13 @@ def forward_hidden(
     pass projected image embeddings in mm_embeds; where mm_mask is True
     they replace the token-id embedding lookup (the placeholder ids under
     the mask are ignored).
+
+    The fused K-step decode window (EngineConfig.decode_kstep) calls
+    this inside a lax.scan with per-iteration valid masks: rows frozen
+    mid-window keep the same [B, 1] shapes and their paged_write lanes
+    redirect to the null page (valid=False contract in ops/kv_update),
+    so the whole window lowers to ONE XLA program with no host in the
+    loop.
     """
     h = params["embed"][tokens].astype(cfg.dtype)  # [B,T,H]
     if mm_embeds is not None:

@@ -135,6 +135,13 @@ def paged_write(
     chunking). `use_kernel` defaults to True on TPU. Under a tp mesh the
     kernel is shard_mapped: staging and cache both shard on the kv-head
     axis, every shard writes its own lanes of the same rows.
+
+    valid=False lanes redirect to page 0 (the engine's reserved null
+    page) instead of skipping the write — that redirect is what lets the
+    fused K-step decode window (EngineConfig.decode_kstep) freeze
+    finished rows MID-WINDOW entirely on device: a frozen row keeps
+    dispatching through the same program shape, its KV writes land in
+    the null page, and its real pages are untouched for the next owner.
     """
     quantized = k_scale is not None
     if use_kernel is None:

@@ -133,6 +133,14 @@ bench_1b_spec() {
   # self-draft upper bound (acceptance ~1, target >=2x modeled)
   BENCH_SPEC=1 run_stage bench_1b_spec python bench.py
 }
+bench_1b_kstep() {
+  # on-device K-step decode window chip arm (ISSUE 16): kstep_ab extras
+  # — ms/token with the fused K=8 window (sampling, stop checks, and
+  # paged-KV writes on device; one host sync per 8 tokens) vs the
+  # per-token host loop, headline model. The number that re-measures
+  # docs/PERF.md's 13ms-vs-3.7ms host-loop argument.
+  BENCH_KSTEP=8 run_stage bench_1b_kstep python bench.py
+}
 pallas_gate() {
   # numerics GATE: prefill logit diff + 32-step teacher-forced drift
   # (budget 0.25 / >=90% argmax agreement); exit 2 = gate failed.
@@ -147,7 +155,7 @@ transfer() {
 }
 
 STAGES=("$@")
-[ ${#STAGES[@]} -eq 0 ] && STAGES=(pallas_kernels prewarm disagg_ab sweep_8b sla_8b ft_kill routing offload bench_dsv2 decode_profile bench_1b_sweep bench_1b_kvq bench_1b_mixed bench_1b_spec pallas_gate transfer)
+[ ${#STAGES[@]} -eq 0 ] && STAGES=(pallas_kernels prewarm disagg_ab sweep_8b sla_8b ft_kill routing offload bench_dsv2 decode_profile bench_1b_sweep bench_1b_kvq bench_1b_mixed bench_1b_spec bench_1b_kstep pallas_gate transfer)
 
 wait_for_tunnel
 for s in "${STAGES[@]}"; do

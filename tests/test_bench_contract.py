@@ -103,6 +103,22 @@ def test_cpu_fallback_line_is_labeled_and_carries_tpu_artifact():
     assert sab["spec_off"]["tok_s"] > 0
     assert sab["modeled_decode_tok_s_ratio"] is not None, sab
     assert sab["modeled_decode_tok_s_ratio"] >= 1.5, sab
+    # on-device K-step decode window A/B (ISSUE 16): both arms ran in
+    # one warm engine; the asserted number is the DETERMINISTIC
+    # dispatch-level ms/token model (per-dispatch medians x
+    # steps/dispatch) — the K=8 arm lands ~K tokens per host visit, so
+    # the ratio prices the host-loop tax the fused window removes.
+    # Target >= 1.5x on the CPU A/B (the chip arm bench_1b_kstep is
+    # armed for the on-chip verification).
+    kab = ex["kstep_ab"]
+    assert "error" not in kab, kab
+    assert kab["kstep"] == 8
+    assert kab["kstep_on"]["windows"] > 0, kab
+    assert kab["kstep_on"]["tok_per_dispatch"] > (
+        2 * kab["kstep_off"]["tok_per_dispatch"]
+    ), kab
+    assert kab["modeled_ms_per_token_ratio"] is not None, kab
+    assert kab["modeled_ms_per_token_ratio"] >= 1.5, kab
     # kv-quant on/off A/B (ISSUE 2): both arms ran, the int8 arm's pool
     # gauges show the byte saving, and capacity_ratio reports the
     # effective-cache multiplier the quantized pages buy

@@ -1,0 +1,448 @@
+"""On-device K-step decode windows (EngineConfig.decode_kstep): K decode
+iterations fused into ONE XLA program with on-device sampling, stop
+checks, and paged-KV writes. The headline contract is bit-exactness —
+every per-request token stream at K>1 must be identical to K=1
+sequential stepping (which itself is pinned bit-identical to a
+decode_kstep-free engine), across greedy, sampled, penalty, bias,
+min_tokens, mid-window stops, overlap chaining/rollback, mixed-step
+carry, and preemption."""
+
+import numpy as np
+import pytest
+
+from dynamo_tpu.engine import EngineConfig
+from dynamo_tpu.engine.engine import JaxEngine
+from dynamo_tpu.engine.request import SamplingParams
+
+
+@pytest.fixture(scope="module")
+def engine_factory():
+    def make(**overrides):
+        return JaxEngine(EngineConfig.for_tests(**overrides))
+
+    return make
+
+
+def _run(eng, reqs):
+    for rid, prompt, s in reqs:
+        eng.add_request(rid, prompt, s)
+    return eng.run_to_completion()
+
+
+def _workload(styles=("greedy", "sampled")):
+    """Mixed per-row sampling configurations with staggered max_tokens so
+    finishes land mid-window at K=8."""
+    rng = np.random.default_rng(11)
+    mk = {
+        "greedy": lambda i: SamplingParams(
+            temperature=0.0, max_tokens=5 + 4 * (i % 3), ignore_eos=True
+        ),
+        "sampled": lambda i: SamplingParams(
+            temperature=0.8, top_p=0.9, top_k=20, seed=300 + i,
+            max_tokens=5 + 4 * (i % 3), ignore_eos=True,
+        ),
+        "penalty": lambda i: SamplingParams(
+            temperature=0.7, seed=400 + i, repetition_penalty=1.3,
+            frequency_penalty=0.2, max_tokens=6 + 3 * (i % 2),
+            ignore_eos=True,
+        ),
+        "bias": lambda i: SamplingParams(
+            temperature=0.0, logit_bias=((3, 4.0), (7, -2.0)),
+            max_tokens=6 + 3 * (i % 2), ignore_eos=True,
+        ),
+        "min_tokens": lambda i: SamplingParams(
+            temperature=0.0, min_tokens=6, max_tokens=9,
+        ),
+    }
+    reqs = []
+    for i in range(6):
+        style = styles[i % len(styles)]
+        prompt = [int(x) for x in rng.integers(1, 200, 3 + (i % 4))]
+        reqs.append((f"{style}{i}", prompt, mk[style](i)))
+    return reqs
+
+
+# -- K=1 default: the engine must be bit-identical to a kstep-free build --
+
+
+def test_default_is_off_and_pinned(engine_factory):
+    """decode_kstep defaults to 1: the window policy never engages, no
+    kstep program compiles, and streams equal an explicit K=1 build (the
+    pin that the default path is untouched)."""
+    reqs = _workload()
+    base = engine_factory()
+    assert base.config.decode_kstep == 1 and not base._kstep_enabled
+    ref = _run(base, reqs)
+    assert base.metrics.kstep_windows == 0
+    assert _run(engine_factory(decode_kstep=1), reqs) == ref
+
+
+# -- bit-exactness vs K=1 across the sampling feature matrix --------------
+
+
+@pytest.mark.parametrize(
+    "styles",
+    [("greedy",), ("sampled",), ("penalty",), ("bias", "min_tokens"),
+     ("greedy", "sampled", "penalty", "bias")],
+    ids=["greedy", "sampled", "penalty", "bias_min_tokens", "mixed_rows"],
+)
+def test_kstep_bitexact_vs_k1(engine_factory, styles):
+    reqs = _workload(styles)
+    ref = _run(engine_factory(decode_kstep=1, overlap_decode=False), reqs)
+    eng = engine_factory(decode_kstep=8, overlap_decode=False)
+    got = _run(eng, reqs)
+    assert got == ref
+    m = eng.metrics
+    assert m.kstep_windows > 0, "window path never engaged"
+    assert m.kstep_steps >= m.kstep_windows
+    assert m.time_kstep_ms > 0
+    assert m.kstep_window_size in (2, 4, 8)
+
+
+def test_kstep_k16_long_wave(engine_factory):
+    """A K=16 window over a long greedy wave: one host visit per 16
+    tokens, stream still byte-identical."""
+    reqs = [("w", [5, 17, 42], SamplingParams(max_tokens=48, ignore_eos=True))]
+    geom = dict(num_pages=128, max_pages_per_seq=16)  # room for 51 tokens
+    ref = _run(
+        engine_factory(decode_kstep=1, overlap_decode=False, **geom), reqs
+    )
+    eng = engine_factory(decode_kstep=16, overlap_decode=False, **geom)
+    got = _run(eng, reqs)
+    assert got == ref
+    assert eng.metrics.kstep_window_size == 16
+    # 48 tokens in far fewer host visits than per-token stepping
+    assert eng.metrics.kstep_steps >= 32
+
+
+# -- on-device finish evaluation: stops and budgets mid-window ------------
+
+
+def test_stop_token_freezes_mid_window(engine_factory):
+    """Pick a token the greedy stream actually emits mid-stream, then
+    re-run with it as a stop token: the device must emit it and freeze
+    the row for the rest of the window — same stream as K=1, nothing
+    past the stop."""
+    probe = _run(
+        engine_factory(decode_kstep=1, overlap_decode=False),
+        [("p", [9, 9, 9], SamplingParams(max_tokens=24, ignore_eos=True))],
+    )["p"]
+    stop_tok = probe[10]  # fires mid-stream, mid-window at K=8
+
+    def reqs():
+        return [
+            ("s", [9, 9, 9],
+             SamplingParams(max_tokens=24, stop_token_ids=(stop_tok,))),
+            ("other", [4, 4, 2],
+             SamplingParams(max_tokens=24, ignore_eos=True)),
+        ]
+
+    ref = _run(engine_factory(decode_kstep=1, overlap_decode=False), reqs())
+    eng = engine_factory(decode_kstep=8, overlap_decode=False)
+    got = _run(eng, reqs())
+    assert got == ref
+    assert got["s"][-1] == stop_tok or len(got["s"]) < len(probe)
+    assert len(got["other"]) == 24  # survivor unaffected by the freeze
+    assert eng.metrics.kstep_windows > 0
+
+
+def test_max_tokens_budget_mid_window(engine_factory):
+    """max_tokens that isn't a multiple of K: the on-device budget must
+    cut the row at exactly the host's count — never K-rounded."""
+    reqs = [
+        ("a", [1, 2, 3], SamplingParams(max_tokens=5, ignore_eos=True)),
+        ("b", [4, 5, 6], SamplingParams(max_tokens=13, ignore_eos=True)),
+    ]
+    eng = engine_factory(decode_kstep=8, overlap_decode=False)
+    got = _run(eng, reqs)
+    assert len(got["a"]) == 5 and len(got["b"]) == 13
+    assert got == _run(
+        engine_factory(decode_kstep=1, overlap_decode=False), reqs
+    )
+
+
+def test_oversized_stop_set_falls_back(engine_factory):
+    """More stop ids than the device's STOP_SLOTS packing: the window
+    must fall back to per-token stepping (counted), streams unchanged."""
+    from dynamo_tpu.engine.sampling import STOP_SLOTS
+
+    stops = tuple(range(1000, 1000 + STOP_SLOTS + 3))
+    reqs = [("f", [1, 2, 3],
+             SamplingParams(max_tokens=6, stop_token_ids=stops))]
+    eng = engine_factory(decode_kstep=8, overlap_decode=False)
+    got = _run(eng, reqs)
+    assert eng.metrics.kstep_windows == 0
+    assert eng.metrics.kstep_fallbacks > 0
+    assert got == _run(
+        engine_factory(decode_kstep=1, overlap_decode=False), reqs
+    )
+
+
+def test_logprobs_rows_fall_back(engine_factory):
+    """No logprobs variant of the window program: a logprobs row drops
+    the batch to the classic path, values identical."""
+
+    def run(k):
+        eng = engine_factory(decode_kstep=k, overlap_decode=False)
+        eng.add_request(
+            "lp", [5, 6, 7],
+            SamplingParams(max_tokens=8, ignore_eos=True, logprobs=2),
+        )
+        toks, lps = [], []
+        while eng.has_work:
+            for o in eng.step():
+                toks.extend(o.new_token_ids)
+                if o.logprobs:
+                    lps.extend(o.logprobs)
+        return toks, lps, eng.metrics.kstep_windows
+
+    ref_t, ref_l, _ = run(1)
+    got_t, got_l, windows = run(8)
+    assert (got_t, got_l) == (ref_t, ref_l)
+    assert windows == 0
+
+
+# -- composition: overlap chaining, rollback, mixed steps, preemption -----
+
+
+def test_overlap_chains_kstep_windows(engine_factory):
+    """With overlap on, the next K-window dispatches speculatively while
+    the host postprocesses the current one — streams bit-exact vs both
+    (overlap off, K=8) and (overlap off, K=1)."""
+    reqs = _workload(("greedy", "sampled"))
+    ref = _run(engine_factory(decode_kstep=1, overlap_decode=False), reqs)
+    eng = engine_factory(decode_kstep=8, overlap_decode=True)
+    got = _run(eng, reqs)
+    assert got == ref
+    assert eng.metrics.kstep_windows > 0
+    assert _run(
+        engine_factory(decode_kstep=8, overlap_decode=False), reqs
+    ) == ref
+
+
+def test_overlap_rollback_on_midwave_admission(engine_factory):
+    """A prefill admitted while a speculative K-window is in flight must
+    roll it back (overshoot discarded) and still match the synchronous
+    K=1 engine fed the same arrival order."""
+
+    def run(k, overlap):
+        eng = engine_factory(decode_kstep=k, overlap_decode=overlap)
+        eng.add_request("a", [1, 2, 3, 4],
+                        SamplingParams(max_tokens=24, ignore_eos=True))
+        eng.add_request("b", [9, 8, 7],
+                        SamplingParams(max_tokens=24, ignore_eos=True))
+        out = {}
+        steps = 0
+        late = False
+        while eng.has_work:
+            for o in eng.step():
+                out.setdefault(o.request_id, []).extend(o.new_token_ids)
+            steps += 1
+            if steps == 2 and not late:
+                eng.add_request(
+                    "late", [3, 1, 4, 1, 5],
+                    SamplingParams(max_tokens=8, ignore_eos=True),
+                )
+                late = True
+        return out, eng.metrics
+
+    ref, _ = run(1, False)
+    got, m = run(8, True)
+    assert got == ref
+    assert m.kstep_windows > 0
+
+
+def test_kstep_under_preemption(engine_factory):
+    """Page pressure preempts a row mid-wave; the window path (including
+    its pre-reserved page runway) must recover to the exact K=1 stream."""
+
+    def run(k):
+        eng = engine_factory(
+            decode_kstep=k, overlap_decode=False,
+            num_pages=12, max_pages_per_seq=8,
+        )
+        eng.add_request("p1", [1, 2, 3, 4, 5, 6, 7, 8],
+                        SamplingParams(max_tokens=16, ignore_eos=True))
+        eng.add_request("p2", [9, 10, 11, 12, 13, 14, 15, 16],
+                        SamplingParams(max_tokens=16, ignore_eos=True))
+        return eng.run_to_completion()
+
+    assert run(8) == run(1)
+
+
+def test_mixed_step_kstep_decode_leg(engine_factory):
+    """Under mixed_steps a K-window serves as the decode leg beside the
+    prefill chunk (two dispatches instead of one fused program) — the
+    staggered-arrival streams still match K=1 exactly."""
+
+    def run(k):
+        eng = engine_factory(
+            decode_kstep=k, overlap_decode=False, mixed_steps=True
+        )
+        eng.add_request("d1", [1, 2, 3],
+                        SamplingParams(max_tokens=20, ignore_eos=True))
+        eng.add_request("d2", [4, 5, 6],
+                        SamplingParams(max_tokens=20, ignore_eos=True))
+        out = {}
+        steps = 0
+        late = False
+        while eng.has_work:
+            for o in eng.step():
+                out.setdefault(o.request_id, []).extend(o.new_token_ids)
+            steps += 1
+            if steps == 2 and not late:
+                eng.add_request(
+                    "late", list(range(1, 20)),
+                    SamplingParams(max_tokens=8, ignore_eos=True),
+                )
+                late = True
+        return out, eng.metrics.kstep_windows
+
+    ref, _ = run(1)
+    got, windows = run(8)
+    assert got == ref
+    assert windows > 0
+
+
+def test_spec_ngram_disables_kstep(engine_factory):
+    """Prompt-lookup speculation owns the decode batch: decode_kstep
+    must auto-disable (logged at construction) with streams unchanged."""
+    eng = engine_factory(decode_kstep=8, spec_ngram=4, overlap_decode=False)
+    assert not eng._kstep_enabled
+    reqs = [("g", [7, 8, 9, 7, 8], SamplingParams(max_tokens=8,
+                                                  ignore_eos=True))]
+    got = _run(eng, reqs)
+    assert eng.metrics.kstep_windows == 0
+    assert got == _run(
+        engine_factory(decode_kstep=1, spec_ngram=4, overlap_decode=False),
+        reqs,
+    )
+
+
+# -- scheduler page runway ------------------------------------------------
+
+
+def test_clamp_kstep_window_runway(engine_factory):
+    """The scheduler halves K until the allocator can cover the whole
+    window's page growth: any K it returns must actually fit, and a
+    starved pool clamps to 1."""
+    eng = engine_factory(decode_kstep=8, overlap_decode=False,
+                         num_pages=16, max_pages_per_seq=8)
+    eng.add_request("c1", [1, 2, 3, 4, 5, 6],
+                    SamplingParams(max_tokens=32, ignore_eos=True))
+    eng.add_request("c2", [9, 8, 7, 6, 5, 4],
+                    SamplingParams(max_tokens=32, ignore_eos=True))
+    while eng.has_work and not eng.scheduler.running:
+        eng.step()
+    reqs = list(eng.scheduler.running)
+    sched = eng.scheduler
+    ps = eng.config.page_size
+    for ask in (16, 8, 4):
+        k = sched.clamp_kstep_window(reqs, ask)
+        assert 1 <= k <= ask
+        if k > 1:  # returned window's growth must fit the free pool
+            need = sum(
+                max(0, -(-(r.num_tokens + k - 1) // ps) - len(r.pages))
+                for r in reqs
+            )
+            assert need <= sched.allocator.num_free
+    # a starved pool may only return a k whose page growth is ZERO (the
+    # rows' current page slack covers the whole window)
+    taken = sched.allocator.allocate(sched.allocator.num_free)
+    k0 = sched.clamp_kstep_window(reqs, 8)
+    need0 = sum(
+        max(0, -(-(r.num_tokens + k0 - 1) // ps) - len(r.pages))
+        for r in reqs
+    )
+    assert k0 < 8 and need0 == 0
+    sched.allocator.free(taken)
+    eng.run_to_completion()
+
+
+# -- telemetry: watchdog floor, stall spread, flight deltas ---------------
+
+
+def test_watchdog_floor_at_k16():
+    """Regression for the false-stall bug: a healthy K=16 window emits
+    once per 16×ITL. With stall_factor=8 the naive threshold (8×ITL)
+    sits INSIDE the healthy gap — the watchdog must floor the factor at
+    2K so the threshold clears it."""
+    from dynamo_tpu.telemetry.watchdog import StallWatchdog
+
+    itl_ms = 100.0
+    naive = StallWatchdog(
+        itl_estimate_ms=lambda: itl_ms, stall_factor=8.0, stall_min_s=0.1
+    )
+    assert naive.stall_threshold_s() == pytest.approx(0.8)
+
+    wd = StallWatchdog(
+        itl_estimate_ms=lambda: itl_ms, stall_factor=8.0, stall_min_s=0.1,
+        window_steps=lambda: 16,
+    )
+    healthy_gap_s = 16 * itl_ms / 1000.0
+    assert wd.stall_threshold_s() > healthy_gap_s  # 2*16*0.1 = 3.2 > 1.6
+    # per-token engines (window 1) keep the configured factor exactly
+    wd1 = StallWatchdog(
+        itl_estimate_ms=lambda: itl_ms, stall_factor=8.0, stall_min_s=0.1,
+        window_steps=lambda: 1,
+    )
+    assert wd1.stall_threshold_s() == naive.stall_threshold_s()
+    # a broken callable degrades to the configured factor, not a crash
+    def boom():
+        raise RuntimeError("nope")
+
+    wdx = StallWatchdog(
+        itl_estimate_ms=lambda: itl_ms, stall_factor=8.0, stall_min_s=0.1,
+        window_steps=boom,
+    )
+    assert wdx.stall_threshold_s() == naive.stall_threshold_s()
+
+
+def test_observe_emission_spreads_window(engine_factory):
+    """A K-token window emission observed after a prefill dispatch must
+    discount the device-measured healthy window time (K × per-step ms)
+    so only true prefill-induced excess lands in the stall histogram."""
+    import time as _time
+
+    from dynamo_tpu.telemetry import phases
+
+    eng = engine_factory(decode_kstep=8)
+    eng.add_request("o", [1, 2, 3], SamplingParams(max_tokens=4,
+                                                   ignore_eos=True))
+    req = eng.scheduler.waiting[0]
+    eng._kstep_step_ms = 1e6  # huge healthy-window time: spread clamps to 0
+    eng._observe_emission(req, finished=False)  # arm prev mark
+    eng.metrics.prefill_dispatches += 1  # a prefill ran in between
+    hist = phases.phase_histograms
+    before = list(hist._counts.get("decode_stall_ms", []))
+    n_before = sum(before)
+    zero_before = before[0] if before else 0
+    _time.sleep(0.002)
+    eng._observe_emission(req, finished=True, n_tokens=8, kstep=True)
+    after = hist._counts["decode_stall_ms"]
+    # exactly one new observation, clamped into the lowest bucket (0 ms)
+    assert sum(after) == n_before + 1
+    assert after[0] == zero_before + 1
+
+
+def test_flight_recorder_kstep_deltas(engine_factory):
+    """The flight recorder's per-window frame deltas include the window
+    counters, so a post-mortem shows K-step cadence around an incident."""
+    from dynamo_tpu.telemetry.flight import _DELTA_FIELDS
+
+    tracked = {src for src, _ in _DELTA_FIELDS}
+    assert {"kstep_windows", "kstep_steps"} <= tracked
+
+
+def test_debug_programs_reports_kstep_family(engine_factory):
+    """/v1/debug/programs joins decode_kstep dispatches with the
+    time_kstep_ms column for live attainment."""
+    assert JaxEngine._MEASURED_BY_KIND.get("decode_kstep") == (
+        "time_kstep_ms", "kstep_windows",
+    )
+    eng = engine_factory(decode_kstep=8, overlap_decode=False)
+    _run(eng, [("d", [1, 2, 3], SamplingParams(max_tokens=16,
+                                               ignore_eos=True))])
+    kinds = eng.programs_report()["kinds"]
+    assert "decode_kstep" in kinds
+    assert kinds["decode_kstep"]["measured_ms_per_dispatch"] is not None
