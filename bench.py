@@ -1094,9 +1094,10 @@ def _handover_ab() -> dict:
     Engine-level: the same export/adopt calls the Worker handover op
     drives (engine.handover_metas / export_blocks_by_hash /
     prepare+commit_handover_adopt); the transfer-plane hop is covered by
-    tests/test_handover.py."""
-    import math
-
+    tests/test_handover.py. The accounting itself (2·P·T, wire bytes,
+    chunk-counted modeled ratio) lives in kv_economy.CostModel — the
+    ONE pricing function the router, the planner and this bench share
+    (ISSUE 18)."""
     from dataclasses import replace
 
     import jax
@@ -1105,6 +1106,7 @@ def _handover_ab() -> dict:
     from dynamo_tpu.engine import EngineConfig
     from dynamo_tpu.engine.engine import JaxEngine
     from dynamo_tpu.engine.request import SamplingParams
+    from dynamo_tpu.kv_economy import CostModel
     from dynamo_tpu.tokens import hash_token_blocks
 
     cfg = replace(EngineConfig.for_tests(), max_pages_per_seq=32)
@@ -1174,11 +1176,12 @@ def _handover_ab() -> dict:
     cached_tokens = b.allocator.match_length(hashes) * cfg.page_size
     ttft_warm_s = ttft("warmc")
 
-    uncached = len(continuation) - cached_tokens
-    chunks_cold = math.ceil(len(continuation) / cfg.prefill_chunk)
-    chunks_warm = max(1, math.ceil(uncached / cfg.prefill_chunk))
     n_params = sum(int(x.size) for x in jax.tree.leaves(b.params))
-    flops_saved = 2 * n_params * cached_tokens
+    cm = CostModel(
+        params=n_params, block_bytes=block_bytes, page_size=cfg.page_size
+    )
+    flops_saved = cm.flops_saved(cached_tokens)
+    assert cm.bytes_moved(blocks_moved) == bytes_moved
     return {
         "prompt_tokens": len(prompt),
         "emitted_tokens": len(emitted),
@@ -1200,7 +1203,149 @@ def _handover_ab() -> dict:
         else None,
         # deterministic: prefill-chunk dispatches the warm continuation
         # skips vs the cold one — the pinned contract number
-        "modeled_ttft_ratio": round(chunks_warm / chunks_cold, 4),
+        "modeled_ttft_ratio": round(
+            cm.modeled_ttft_ratio(
+                len(continuation), cached_tokens, cfg.prefill_chunk
+            ),
+            4,
+        ),
+    }
+
+
+def _prefix_migration_ab() -> dict:
+    """Per-prefix KV migration A/B (ISSUE 18 acceptance): a multi-turn
+    chat session's turn-2 TTFT when only the session's HOT PREFIX CHAIN
+    migrated to a fresh worker vs cold prefill, priced by the shared
+    kv_economy CostModel. Unlike `_handover_ab` (the whole registered
+    set moves with its worker), this moves exactly the chain the next
+    request will hit — the router's migrate_prefix shape: export the
+    matched hashes, adopt on the destination, re-serve.
+
+    Deterministic headline: turn 1 is 32 tokens (8 full blocks at
+    page_size=4 — the source's registered chain covers the prompt;
+    decode tokens ride uncached), 8 emitted; turn 2 re-sends the
+    history plus 8 user tokens → 48 total, 16 uncached → 1 warm prefill
+    chunk vs 3 cold at chunk=16 (modeled_ttft_ratio 1/3).
+    should_migrate must hold at this shape — the bench run re-checks
+    the same pricing fn the router gates on."""
+    from dataclasses import replace
+
+    import jax
+    import numpy as np
+
+    from dynamo_tpu.engine import EngineConfig
+    from dynamo_tpu.engine.engine import JaxEngine
+    from dynamo_tpu.engine.request import SamplingParams
+    from dynamo_tpu.kv_economy import CostModel
+    from dynamo_tpu.tokens import hash_token_blocks
+
+    cfg = replace(EngineConfig.for_tests(), max_pages_per_seq=32)
+    turn1 = [((i * 37) % 211) + 1 for i in range(32)]
+    n_emit = 8
+
+    # the session's home worker: serve turn 1 (prompt + generated blocks
+    # register as they fill), then export ONLY the chain turn 2 needs
+    a = JaxEngine(cfg)
+    a.add_request(
+        "turn1", turn1,
+        SamplingParams(temperature=0.0, max_tokens=n_emit, ignore_eos=True),
+    )
+    emitted = a.run_to_completion()["turn1"]
+    history = list(turn1) + [int(t) for t in emitted]
+    turn2 = history + [((i * 53) % 211) + 1 for i in range(8)]
+    chain = hash_token_blocks(
+        history, block_size=cfg.page_size, salt=cfg.model
+    )
+    t0 = time.perf_counter()
+    exported = a.export_blocks_by_hash([int(h) for h in chain])
+    export_s = time.perf_counter() - t0
+    if exported is None:
+        raise RuntimeError("hot prefix chain not resident on the source")
+    emetas, k, v = exported
+    bytes_moved = int(k.nbytes + v.nbytes)
+    blocks_moved = len(emetas)
+    block_bytes = bytes_moved // blocks_moved
+
+    # the fresh worker the router redirected to: compile-warm on a
+    # disjoint prompt so the TTFT pair measures prefill work only
+    b = JaxEngine(cfg)
+    b.add_request(
+        "jit", [7] * len(turn2),
+        SamplingParams(temperature=0.0, max_tokens=n_emit, ignore_eos=True),
+    )
+    b.run_to_completion()
+    b.allocator.clear_cache()
+
+    def ttft(tag: str) -> float:
+        b.add_request(
+            tag, turn2,
+            SamplingParams(temperature=0.0, max_tokens=2, ignore_eos=True),
+        )
+        t0 = time.perf_counter()
+        for _ in range(10_000):
+            outs = b.step()
+            if any(o.request_id == tag and o.new_token_ids for o in outs):
+                dt = time.perf_counter() - t0
+                b.run_to_completion()  # drain the tail
+                return dt
+        raise RuntimeError("no first token")
+
+    # cold: the suppressed-migration path — turn 2 prefills from scratch
+    ttft_cold_s = ttft("cold")
+    b.allocator.clear_cache()
+
+    # warm: adopt the migrated chain, then the SAME turn 2 prefix-hits
+    t0 = time.perf_counter()
+    pages, kept, want = b.prepare_handover_adopt(emetas)
+    b.inject_pages(
+        pages,
+        np.ascontiguousarray(k[:, :, want]),
+        np.ascontiguousarray(v[:, :, want]),
+    )
+    adopted = b.commit_handover_adopt(pages, kept)
+    adopt_s = time.perf_counter() - t0
+    hashes = hash_token_blocks(
+        turn2, block_size=cfg.page_size, salt=cfg.model
+    )
+    cached_tokens = b.allocator.match_length(hashes) * cfg.page_size
+    ttft_warm_s = ttft("warmc")
+
+    n_params = sum(int(x.size) for x in jax.tree.leaves(b.params))
+    cm = CostModel(
+        params=n_params, block_bytes=block_bytes, page_size=cfg.page_size
+    )
+    price = cm.price(blocks_moved)
+    return {
+        "turn1_tokens": len(turn1),
+        "turn2_tokens": len(turn2),
+        "emitted_tokens": len(emitted),
+        "page_size": cfg.page_size,
+        "params": n_params,
+        "blocks_moved": blocks_moved,
+        "block_bytes": block_bytes,
+        "bytes_moved": bytes_moved,
+        "blocks_adopted": adopted,
+        "cached_tokens": cached_tokens,
+        "prefill_flops_saved": cm.flops_saved(cached_tokens),
+        "flops_saved_per_byte": round(price.flops_saved_per_byte, 2),
+        # the router's gate, re-evaluated on the bench shape: this move
+        # must clear the break-even threshold
+        "should_migrate": cm.should_migrate(blocks_moved),
+        "export_s": round(export_s, 4),
+        "adopt_s": round(adopt_s, 4),
+        "ttft_cold_s": round(ttft_cold_s, 4),
+        "ttft_warm_s": round(ttft_warm_s, 4),
+        "measured_ttft_ratio": round(ttft_warm_s / ttft_cold_s, 3)
+        if ttft_cold_s
+        else None,
+        # deterministic: 1 warm prefill chunk vs 3 cold (16 uncached vs
+        # 48 total at chunk=16) — the pinned contract number
+        "modeled_ttft_ratio": round(
+            cm.modeled_ttft_ratio(
+                len(turn2), cached_tokens, cfg.prefill_chunk
+            ),
+            4,
+        ),
     }
 
 
@@ -2030,6 +2175,20 @@ def main() -> None:
             # the headline artifact
             handover_ab = {"error": f"{type(e).__name__}: {e}"}
 
+    # Per-prefix KV migration A/B (ISSUE 18): turn-2 TTFT after
+    # migrating only the session's hot prefix chain vs cold prefill,
+    # priced by the shared kv_economy CostModel. Runs by default on the
+    # CPU fallback; the chip arm is queued as bench_1b_prefixmig in
+    # tpu_round.sh (BENCH_PREFIXMIG=1 forces it on TPU).
+    prefixmig_ab = None
+    default_prefixmig = "1" if platform != "tpu" else "0"
+    if os.environ.get("BENCH_PREFIXMIG", default_prefixmig) != "0":
+        try:
+            prefixmig_ab = _prefix_migration_ab()
+        except Exception as e:  # noqa: BLE001 — A/B failure must not kill
+            # the headline artifact
+            prefixmig_ab = {"error": f"{type(e).__name__}: {e}"}
+
     # KV index sequencing A/B (ISSUE 13): the sequence stamp + digest
     # fold on the event publish path must stay under 1% of token
     # throughput.
@@ -2298,6 +2457,11 @@ def main() -> None:
                 **({"slo_overhead": slo_ab} if slo_ab else {}),
                 **({"flight_overhead": flight_ab} if flight_ab else {}),
                 **({"handover_ab": handover_ab} if handover_ab else {}),
+                **(
+                    {"prefix_migration_ab": prefixmig_ab}
+                    if prefixmig_ab
+                    else {}
+                ),
                 **(
                     {"kv_index_overhead": kv_index_ab} if kv_index_ab else {}
                 ),

@@ -175,6 +175,7 @@ async def _run_http(args) -> None:
         watcher = ModelWatcher(
             rt, manager,
             stream_replay=getattr(args, "stream_replay", False),
+            kv_economy=getattr(args, "kv_economy", False),
         )
         await watcher.start()
     else:
@@ -337,6 +338,7 @@ async def _run_worker(args) -> None:
         advertise_host=args.host,
         drain_budget_s=getattr(args, "drain_budget", 30.0),
         kv_sequencing=getattr(args, "kv_sequencing", True),
+        kv_economy=getattr(args, "kv_economy", False),
     )
     await worker.start()
     print(f"worker {worker.instance_id} up (model={args.model})", flush=True)
@@ -695,14 +697,23 @@ async def _run_planner(args) -> None:
         # ControlRunner ship to fleet.events on a 1 s cadence
         shipper = TelemetryShipper(rt.fabric, source="planner")
         shipper.start()
+        economy = None
+        if getattr(args, "kv_economy", False):
+            from dynamo_tpu.kv_economy import cost_model_from_card
+            from dynamo_tpu.planner.service import FleetKvEconomy
+
+            # no card in the planner process — the 1B-class shape
+            # defaults; only the flops/byte RATIO gates decisions
+            economy = FleetKvEconomy(observer, cost_model_from_card(None))
         runner = ControlRunner(
             planner, connector, observer.observe,
             flipper=FleetFlipper(observer) if args.flip else None,
             handover=(
-                FleetHandover(observer)
+                FleetHandover(observer, economy=economy)
                 if getattr(args, "handover", True)
                 else None
             ),
+            prewarm=economy.prewarm if economy is not None else None,
             status_fn=status_fn,
             # HOLD while the control plane is degraded (no broker):
             # signals are frozen and actuation would fly blind
@@ -882,6 +893,17 @@ def build_parser() -> argparse.ArgumentParser:
         "--kv-remote", action="store_true", dest="kv_remote",
         help="KVBM G4: serve KV blocks to peers and onboard prefixes a "
              "peer already computed (cross-worker, over the transfer plane)",
+    )
+    runp.add_argument(
+        "--kv-economy", action="store_true", dest="kv_economy",
+        help="the KV economy (docs/operations.md 'The KV economy'): on "
+             "a frontend, KV routing scores lower-tier residency at a "
+             "promotion-cost discount and migrates hot prefixes to the "
+             "chosen worker when the prefill flops saved beat the bytes "
+             "moved; on a worker, publishes tier residency hints, "
+             "serves migrate_prefix, and demotes cold pages under HBM "
+             "watermark pressure. Default off; routing and the wire are "
+             "bit-identical to before when off",
     )
     runp.add_argument(
         "--spec-ngram", type=int, default=0, dest="spec_ngram",
@@ -1265,6 +1287,14 @@ def build_parser() -> argparse.ArgumentParser:
     planp.add_argument("--model", default="tiny", help="model spawned workers serve")
     planp.add_argument(
         "--checkpoint", default=None, help="checkpoint dir for spawned workers"
+    )
+    planp.add_argument(
+        "--kv-economy", action="store_true", dest="kv_economy",
+        help="price scale decisions with the KV-economy CostModel "
+             "(docs/operations.md 'The KV economy'): scale-down hands "
+             "over only when the victim's resident KV is worth the "
+             "bytes, and each scale-up is followed by a prefix "
+             "pre-warm of the newcomer from the hottest peer",
     )
     planp.add_argument(
         "--worker-args", default="", dest="worker_args",

@@ -478,7 +478,7 @@ class ModelWatcher:
     """Attach/detach models from MODEL_ROOT watch events."""
 
     def __init__(self, runtime, manager: ModelManager,
-                 stream_replay: bool = False):
+                 stream_replay: bool = False, kv_economy: bool = False):
         self.runtime = runtime
         self.manager = manager
         #: crash-replayed streams (--stream-replay, default OFF): the
@@ -486,6 +486,13 @@ class ModelWatcher:
         #: to a survivor as prompt+emitted-tokens so the client stream
         #: continues uninterrupted (docs/operations.md)
         self.stream_replay = stream_replay
+        #: the KV economy (--kv-economy, default OFF): KV-routed models
+        #: get an EconomyPolicy — tier-discounted warmth scores plus
+        #: per-prefix hot-KV migration (docs/operations.md "The KV
+        #: economy"). Off keeps routing bit-identical to before.
+        self.kv_economy = kv_economy
+        #: started TierMaps, stopped alongside the watcher
+        self._tier_maps: list = []
         self._task: Optional[asyncio.Task] = None
         #: model -> set of entry keys currently backing it
         self._entries: dict[str, set[str]] = {}
@@ -541,12 +548,25 @@ class ModelWatcher:
             from dynamo_tpu.kv_router import KvRouter
 
             src = await ep.instance_source()
+            economy = None
+            if self.kv_economy:
+                from dynamo_tpu.kv_economy import (
+                    EconomyPolicy, TierMap, cost_model_from_card,
+                )
+
+                tier_map = TierMap(self.runtime.fabric)
+                await tier_map.start()
+                self._tier_maps.append(tier_map)
+                economy = EconomyPolicy(
+                    cost_model_from_card(card), tier_map=tier_map
+                )
             kv_router = KvRouter(
                 self.runtime.fabric,
                 entry.component,
                 src,
                 block_size=card.kv_page_size,
                 salt=card.name,
+                economy=economy,
             )
             await kv_router.start()
             router = PushRouter(
@@ -579,6 +599,12 @@ class ModelWatcher:
     async def stop(self) -> None:
         if self._task:
             self._task.cancel()
+        for tm in self._tier_maps:
+            try:
+                await tm.stop()
+            except Exception:
+                logger.warning("tier map stop failed", exc_info=True)
+        self._tier_maps.clear()
         if self._shipper is not None:
             try:
                 await self._shipper.stop()

@@ -1,0 +1,49 @@
+"""The KV economy: the fleet's aggregate HBM + host + disk as ONE cache.
+
+PR 12's handover path ships arbitrary KV block forests between workers
+in the canonical quantized wire format; PR 13's sequenced, digest-
+verified index gives the router an honest global view of who holds
+what. This package is the POLICY plane that composes them (ROADMAP
+item 3 — the Dynamo-KVBM multi-tier block-management story; Mooncake's
+KVCache-centric scheduling; CachedAttention's hierarchical KV reuse):
+
+- `CostModel` — the bytes-moved vs 2·P·T prefill-flops-saved pricing,
+  factored out of bench.py's `_handover_ab` so router, planner, and
+  bench price a KV move with ONE function.
+- `MigrationManager` — admission control for per-prefix migrations:
+  single-flight per (prefix, destination), per-prefix backoff, byte
+  budget — migration storms cannot starve decode.
+- `TierPolicy` — demotes cold pages HBM→host→disk under watermark
+  pressure through the existing KVBM tiers (kvbm/manager.py).
+- `TierMap` — router-side tier-residency view (which peer holds which
+  block in a LOWER tier), fed by the same kvbm_tier.* hint subjects
+  the worker-side BlockDirectory consumes, so the indexer's warmth
+  scores can be discounted by promotion cost.
+
+Everything here is optional and default-off: with no `economy` object
+handed to the router and no TierPolicy loop started, every byte on the
+wire and every routing decision is identical to the pre-economy tree
+(pinned by tests/test_kv_economy.py).
+"""
+
+from dynamo_tpu.kv_economy.cost_model import (
+    CostModel,
+    MigrationPrice,
+    block_wire_bytes,
+    cost_model_from_card,
+)
+from dynamo_tpu.kv_economy.migration import MigrationManager
+from dynamo_tpu.kv_economy.router import EconomyPolicy
+from dynamo_tpu.kv_economy.tier_map import TierMap
+from dynamo_tpu.kv_economy.tier_policy import TierPolicy
+
+__all__ = [
+    "CostModel",
+    "EconomyPolicy",
+    "MigrationPrice",
+    "MigrationManager",
+    "TierMap",
+    "TierPolicy",
+    "block_wire_bytes",
+    "cost_model_from_card",
+]

@@ -1,0 +1,206 @@
+"""CostModel: one pricing function for every KV move in the fleet.
+
+Moving a cached prefix instead of recomputing it trades TRANSFER bytes
+against PREFILL flops. The exchange rate is deterministic from the
+model shape and the canonical quantized wire format:
+
+- bytes moved  = blocks · block_bytes          (wire bytes per block)
+- flops saved  = 2 · P · T                     (P params, T cached tokens)
+
+`_handover_ab` (bench.py) has priced whole-worker handovers with these
+exact formulas since PR 12; this module factors them out so the router
+(per-request migration), the planner (flip vs handover vs migration),
+and the bench all consult ONE function — a threshold change moves every
+consumer at once.
+
+Tier residency discounts the same way: a block parked in host or disk
+is worth less than an HBM-resident one because promoting it back costs
+tier-bandwidth seconds. `tier_discount` prices that against the prefill
+seconds the block saves, yielding a [0, 1] multiplier for the indexer's
+warmth scores.
+
+Everything here is pure arithmetic — no I/O, no clocks — so the modeled
+quantities the acceptance tests pin are deterministic by construction.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+from dataclasses import dataclass
+from typing import Optional
+
+#: flops-saved per byte-moved below which a migration is NOT worth it.
+#: 1.0 = break even against a (pessimistic) 1 flop/s-per-byte/s fabric;
+#: real blocks sit orders of magnitude above this (a page_size=16 block
+#: of a 1B model saves 2·1e9·16 flops for ~100KB moved ≈ 3e5 flops/B),
+#: so the threshold only suppresses degenerate moves — tiny models,
+#: huge pages, or a single-block delta on a fat-KV config.
+DEFAULT_MIN_FLOPS_PER_BYTE = float(
+    os.environ.get("DYN_KV_ECONOMY_MIN_FLOPS_PER_BYTE", "1.0")
+)
+
+#: default tier bandwidths for promotion pricing (bytes/s): host slab
+#: memcpy vs NVMe read — deliberately conservative, overridable per
+#: CostModel instance
+HOST_TIER_BYTES_PER_S = 8e9
+DISK_TIER_BYTES_PER_S = 1e9
+
+#: default sustained prefill rate used to convert saved flops into saved
+#: seconds for tier discounting (order v5e bf16; only the RATIO against
+#: tier bandwidth matters, so coarse is fine)
+PREFILL_FLOPS_PER_S = 1e14
+
+
+@dataclass(frozen=True)
+class MigrationPrice:
+    """One priced KV move."""
+
+    blocks: int
+    bytes_moved: int
+    cached_tokens: int
+    flops_saved: int
+
+    @property
+    def flops_saved_per_byte(self) -> float:
+        if self.bytes_moved <= 0:
+            return 0.0
+        return self.flops_saved / self.bytes_moved
+
+
+@dataclass(frozen=True)
+class CostModel:
+    """Pricing for KV movement, fixed by model + wire shape.
+
+    `params` is the model parameter count P; `block_bytes` the canonical
+    quantized wire bytes of ONE KV block (k.nbytes + v.nbytes per
+    block); `page_size` tokens per block.
+    """
+
+    params: int
+    block_bytes: int
+    page_size: int
+    min_flops_per_byte: float = DEFAULT_MIN_FLOPS_PER_BYTE
+    #: migrations below this many blocks never pay for their fixed
+    #: offer/transfer round trips
+    min_blocks: int = 2
+    host_bytes_per_s: float = HOST_TIER_BYTES_PER_S
+    disk_bytes_per_s: float = DISK_TIER_BYTES_PER_S
+    prefill_flops_per_s: float = PREFILL_FLOPS_PER_S
+
+    # -- the PR 12 handover accounting, verbatim ---------------------------
+
+    def flops_saved(self, cached_tokens: int) -> int:
+        """Standard 2·P·T prefill flops over the cached tokens."""
+        return 2 * self.params * cached_tokens
+
+    def bytes_moved(self, blocks: int) -> int:
+        return blocks * self.block_bytes
+
+    def price(self, blocks: int) -> MigrationPrice:
+        """Price moving `blocks` prefix blocks (bytes out, flops back)."""
+        cached_tokens = blocks * self.page_size
+        return MigrationPrice(
+            blocks=blocks,
+            bytes_moved=self.bytes_moved(blocks),
+            cached_tokens=cached_tokens,
+            flops_saved=self.flops_saved(cached_tokens),
+        )
+
+    def worth_it(self, price: MigrationPrice) -> bool:
+        """Does the prefill saved pay for the bytes moved at the
+        configured exchange rate?"""
+        if price.blocks < self.min_blocks:
+            return False
+        return price.flops_saved_per_byte >= self.min_flops_per_byte
+
+    def should_migrate(self, delta_blocks: int) -> bool:
+        """Router entry point: migrate when the REMOTE worker's extra
+        `delta_blocks` of prefix (beyond what the chosen worker holds)
+        saves more flops than its bytes cost to move."""
+        return delta_blocks > 0 and self.worth_it(self.price(delta_blocks))
+
+    # -- modeled TTFT (the deterministic bench/acceptance quantity) --------
+
+    @staticmethod
+    def modeled_ttft_ratio(
+        total_tokens: int, cached_tokens: int, prefill_chunk: int
+    ) -> float:
+        """Warm/cold TTFT as prefill-chunk dispatches skipped: the warm
+        continuation prefills only the uncached tail. Deterministic from
+        the workload shape — the pinned contract number (bench.py
+        handover_ab / prefix_migration_ab)."""
+        uncached = total_tokens - cached_tokens
+        chunks_cold = math.ceil(total_tokens / prefill_chunk)
+        chunks_warm = max(1, math.ceil(uncached / prefill_chunk))
+        return chunks_warm / max(1, chunks_cold)
+
+    # -- tier discounting --------------------------------------------------
+
+    def tier_discount(self, tier: Optional[str]) -> float:
+        """Warmth multiplier for a block resident in `tier`: the share
+        of a block's prefill savings left after paying its promotion.
+        HBM (None/"device") costs nothing to use → 1.0; host/disk divide
+        the saved seconds by saved + promote seconds."""
+        if tier in (None, "", "device", "hbm"):
+            return 1.0
+        bw = {
+            "host": self.host_bytes_per_s,
+            "disk": self.disk_bytes_per_s,
+        }.get(tier)
+        if bw is None or bw <= 0:
+            return 0.0
+        saved_s = self.flops_saved(self.page_size) / self.prefill_flops_per_s
+        promote_s = self.block_bytes / bw
+        if saved_s <= 0:
+            return 0.0
+        return saved_s / (saved_s + promote_s)
+
+
+def block_wire_bytes(
+    layers: int, kv_heads: int, page_size: int, head_dim: int, itemsize: int
+) -> int:
+    """Canonical wire bytes of one block ([L, Hkv, S, D] k + v) — for
+    callers that know the model shape but have no exported batch to
+    measure (router-side CostModel construction)."""
+    return 2 * layers * kv_heads * page_size * head_dim * itemsize
+
+
+#: fallback model shape for cards that don't publish one (a 1B-class
+#: config); only the params/block_bytes RATIO gates migrations, and any
+#: transformer's ratio clears the break-even threshold by orders of
+#: magnitude, so coarse defaults never flip a decision the shape-aware
+#: path would make differently
+_DEFAULT_SHAPE = {
+    "params": 1_000_000_000,
+    "layers": 16,
+    "kv_heads": 8,
+    "head_dim": 64,
+    "kv_itemsize": 1,  # canonical wire format is quantized int8
+}
+
+
+def cost_model_from_card(card) -> CostModel:
+    """Build the router-side CostModel from a ModelDeploymentCard.
+
+    Workers that publish their shape in `card.extra` (params, layers,
+    kv_heads, head_dim, kv_itemsize) get exact pricing; others get the
+    1B-class defaults above."""
+    extra = getattr(card, "extra", None) or {}
+
+    def _num(key: str) -> int:
+        try:
+            v = int(extra.get(key) or 0)
+        except (TypeError, ValueError):
+            v = 0
+        return v if v > 0 else _DEFAULT_SHAPE[key]
+
+    page_size = int(getattr(card, "kv_page_size", 0) or 0) or 16
+    return CostModel(
+        params=_num("params"),
+        block_bytes=block_wire_bytes(
+            _num("layers"), _num("kv_heads"), page_size,
+            _num("head_dim"), _num("kv_itemsize"),
+        ),
+        page_size=page_size,
+    )

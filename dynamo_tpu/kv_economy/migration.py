@@ -1,0 +1,139 @@
+"""MigrationManager: admission control for per-prefix KV migrations.
+
+The router's migration decision is cheap to WANT and expensive to DO:
+an unthrottled hot prefix would be pulled to every worker the selector
+ever picks, saturating the transfer plane and starving decode. This
+manager is the throttle, in admission order:
+
+1. **single-flight** — one in-flight migration per (prefix, dest);
+   concurrent requests for the same pull ride the first one's outcome
+   (their request cold-prefills meanwhile, which is always correct).
+2. **backoff** — a prefix that just migrated (anywhere) is not moved
+   again inside `backoff_s`; repeats inside the window are counted as
+   storm repeats (the doctor's `migration-storm` rule reads them).
+3. **concurrency + byte budget** — global caps so a burst of distinct
+   prefixes still cannot monopolize the transfer plane.
+
+Deny is always safe: the request cold-prefills exactly as it would
+have pre-economy. Time is injected (`clock`) so tests are
+deterministic.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional
+
+#: seconds a migrated prefix is fenced against re-migration
+DEFAULT_BACKOFF_S = 30.0
+#: concurrent in-flight migrations fleet-wide (per router)
+DEFAULT_MAX_INFLIGHT = 2
+#: byte budget per rolling window (0 = unlimited)
+DEFAULT_WINDOW_BYTES = 256 * 1024 * 1024
+DEFAULT_WINDOW_S = 10.0
+
+
+class MigrationManager:
+    def __init__(
+        self,
+        backoff_s: float = DEFAULT_BACKOFF_S,
+        max_inflight: int = DEFAULT_MAX_INFLIGHT,
+        window_bytes: int = DEFAULT_WINDOW_BYTES,
+        window_s: float = DEFAULT_WINDOW_S,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.backoff_s = backoff_s
+        self.max_inflight = max_inflight
+        self.window_bytes = window_bytes
+        self.window_s = window_s
+        self._clock = clock
+        #: (prefix_key, dest) in flight right now
+        self._inflight: set[tuple[int, str]] = set()
+        #: prefix_key -> monotonic stamp of its last COMPLETED migration
+        self._last_done: dict[int, float] = {}
+        #: (stamp, bytes) of recent completions for the byte budget
+        self._window: list[tuple[float, int]] = []
+        # counters (worker/router metrics frames + doctor evidence)
+        self.migrations_total = 0
+        self.migrations_failed = 0
+        self.bytes_total = 0
+        self.blocks_total = 0
+        self.suppressed: dict[str, int] = {}
+        #: same-prefix attempts landing inside the backoff window — the
+        #: thrash signal `migration-storm` alerts on
+        self.storm_repeats = 0
+
+    # -- admission ---------------------------------------------------------
+
+    def _window_spend(self, now: float) -> int:
+        self._window = [
+            (t, b) for t, b in self._window if now - t <= self.window_s
+        ]
+        return sum(b for _, b in self._window)
+
+    def admit(
+        self, prefix_key: int, dest: str, est_bytes: int = 0
+    ) -> tuple[bool, str]:
+        """Try to claim (prefix, dest). Returns (admitted, reason);
+        an admitted claim MUST be released via complete()."""
+        now = self._clock()
+        key = (prefix_key, dest)
+        if key in self._inflight:
+            return self._deny("inflight")
+        last = self._last_done.get(prefix_key)
+        if last is not None and now - last < self.backoff_s:
+            self.storm_repeats += 1
+            return self._deny("backoff")
+        if len(self._inflight) >= self.max_inflight:
+            return self._deny("concurrency")
+        if self.window_bytes and (
+            self._window_spend(now) + est_bytes > self.window_bytes
+        ):
+            return self._deny("budget")
+        self._inflight.add(key)
+        return True, "ok"
+
+    def _deny(self, reason: str) -> tuple[bool, str]:
+        self.suppressed[reason] = self.suppressed.get(reason, 0) + 1
+        return False, reason
+
+    def complete(
+        self,
+        prefix_key: int,
+        dest: str,
+        ok: bool,
+        bytes_moved: int = 0,
+        blocks: int = 0,
+    ) -> None:
+        """Release the single-flight claim; account the outcome. Failed
+        migrations ALSO start the backoff window — retrying a broken
+        transfer every request is the storm we're preventing."""
+        now = self._clock()
+        self._inflight.discard((prefix_key, dest))
+        self._last_done[prefix_key] = now
+        if len(self._last_done) > 10_000:  # memory backstop
+            cutoff = now - self.backoff_s
+            self._last_done = {
+                k: t for k, t in self._last_done.items() if t >= cutoff
+            }
+        if ok:
+            self.migrations_total += 1
+            self.bytes_total += bytes_moved
+            self.blocks_total += blocks
+            if bytes_moved:
+                self._window.append((now, bytes_moved))
+        else:
+            self.migrations_failed += 1
+
+    # -- observability -----------------------------------------------------
+
+    def stats(self) -> dict:
+        return {
+            "migrations_total": self.migrations_total,
+            "migrations_failed_total": self.migrations_failed,
+            "migration_bytes_total": self.bytes_total,
+            "migration_blocks_total": self.blocks_total,
+            "migration_storm_repeats_total": self.storm_repeats,
+            "migrations_inflight": len(self._inflight),
+            "migrations_suppressed": dict(self.suppressed),
+        }

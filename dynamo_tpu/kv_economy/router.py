@@ -1,0 +1,60 @@
+"""EconomyPolicy: the bundle a KV-economy router carries.
+
+One object glues the three policy pieces to the routing hot path:
+`cost_model` prices moves, `manager` throttles them, `tier_map`
+(optional) extends warmth scores into lower tiers. Handing an
+EconomyPolicy to KvRouter(economy=...) switches the economy ON for
+that router; the default None keeps find_best_match bit-identical to
+the pre-economy tree (pinned by tests/test_kv_economy.py).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from dynamo_tpu.kv_economy.cost_model import CostModel
+from dynamo_tpu.kv_economy.migration import MigrationManager
+from dynamo_tpu.kv_economy.tier_map import TierMap
+
+#: router-side wall clock bound on one migration round trip — past it
+#: the request cold-prefills (the transfer may still land and warm the
+#: NEXT request; the source/dest cleanup paths own their pages)
+DEFAULT_MIGRATE_TIMEOUT_S = 10.0
+
+
+class EconomyPolicy:
+    def __init__(
+        self,
+        cost_model: CostModel,
+        manager: Optional[MigrationManager] = None,
+        tier_map: Optional[TierMap] = None,
+        migrate_timeout_s: float = DEFAULT_MIGRATE_TIMEOUT_S,
+    ):
+        self.cost_model = cost_model
+        self.manager = manager or MigrationManager()
+        self.tier_map = tier_map
+        self.migrate_timeout_s = migrate_timeout_s
+
+    def scored_with_tiers(
+        self, scores: dict[str, int], candidates, seq_hashes
+    ) -> dict[str, float]:
+        """Overlap scores extended past HBM: each candidate's device-
+        resident depth continues through its lower-tier chain, every
+        tiered block discounted by its promotion cost. Returns a COPY —
+        the indexer's scores are never mutated."""
+        if self.tier_map is None:
+            return dict(scores)
+        cm = self.cost_model
+        out: dict[str, float] = dict(scores)
+        for iid in candidates:
+            base = scores.get(iid, 0)
+            tiers = self.tier_map.chain_tiers(iid, seq_hashes, base)
+            if tiers:
+                out[iid] = base + sum(cm.tier_discount(t) for t in tiers)
+        return out
+
+    def stats(self) -> dict:
+        out = self.manager.stats()
+        if self.tier_map is not None:
+            out.update(self.tier_map.stats())
+        return out

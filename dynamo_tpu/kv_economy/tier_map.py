@@ -1,0 +1,135 @@
+"""TierMap: router-side view of which blocks live in LOWER tiers where.
+
+The indexer (kv_router/indexer.py) scores workers by device-resident
+prefix depth, fed by the sequenced kv_events stream. Blocks a worker
+demoted to host/disk left that stream (`removed`) but are still
+servable — the worker re-onboards them on a prefix hit, paying the
+tier's promotion bandwidth. This map rides the existing advisory
+`kvbm_tier.{instance_id}` hint subjects (the same ones the worker-side
+BlockDirectory consumes) and answers, per (worker, hash), WHICH tier
+holds it — so the router can extend a worker's warmth score past its
+HBM with `CostModel.tier_discount(tier)` applied.
+
+Same trust model as BlockDirectory: hints are stores-only, LRU-capped,
+and best-effort — a stale entry costs one discounted score, never
+correctness (the worker re-checks its tiers at admission).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from collections import OrderedDict
+from typing import Optional, Sequence
+
+import msgpack
+
+from dynamo_tpu.subjects import KVBM_TIER_SUBJECT
+
+logger = logging.getLogger(__name__)
+
+#: per-worker (hash -> tier) LRU bound
+MAX_HASHES_PER_WORKER = 200_000
+
+
+class _TierLru:
+    def __init__(self, cap: int):
+        self.cap = cap
+        self._d: OrderedDict[int, str] = OrderedDict()
+
+    def put(self, h: int, tier: str) -> None:
+        self._d[h] = tier
+        self._d.move_to_end(h)
+        while len(self._d) > self.cap:
+            self._d.popitem(last=False)
+
+    def get(self, h: int) -> Optional[str]:
+        return self._d.get(h)
+
+    def discard(self, h: int) -> None:
+        self._d.pop(h, None)
+
+    def __len__(self) -> int:
+        return len(self._d)
+
+
+class TierMap:
+    def __init__(self, fabric, cap_per_worker: int = MAX_HASHES_PER_WORKER):
+        self.fabric = fabric
+        self.cap = cap_per_worker
+        self._workers: dict[str, _TierLru] = {}
+        self._sub = None
+        self._task: Optional[asyncio.Task] = None
+
+    async def start(self) -> None:
+        self._sub = await self.fabric.subscribe(KVBM_TIER_SUBJECT + ".>")
+        self._task = asyncio.get_running_loop().create_task(self._pump())
+
+    async def _pump(self) -> None:
+        while True:
+            msg = await self._sub.next()
+            if msg is None:
+                return
+            try:
+                worker_id = msg.header["instance_id"]
+                events = msgpack.unpackb(msg.payload, raw=False)
+                lru = self._workers.get(worker_id)
+                if lru is None:
+                    lru = self._workers[worker_id] = _TierLru(self.cap)
+                for ev in events:
+                    if ev.get("kind") != "stored":
+                        continue
+                    # pre-economy hints carry no tier field; host is the
+                    # first stop of every demotion, so it is the honest
+                    # default for an untagged store
+                    tier = ev.get("tier") or "host"
+                    for h in ev["block_hashes"]:
+                        lru.put(h, tier)
+            except Exception:
+                logger.exception("bad tier hint on %s", msg.subject)
+
+    # -- queries -----------------------------------------------------------
+
+    def tier_of(self, worker_id: str, h: int) -> Optional[str]:
+        lru = self._workers.get(worker_id)
+        return lru.get(h) if lru is not None else None
+
+    def chain_tiers(
+        self, worker_id: str, seq_hashes: Sequence[int], start: int
+    ) -> list[str]:
+        """Tiers of the consecutive run of `seq_hashes[start:]` this
+        worker holds in lower tiers (stops at the first miss)."""
+        lru = self._workers.get(worker_id)
+        out: list[str] = []
+        if lru is None:
+            return out
+        for h in seq_hashes[start:]:
+            tier = lru.get(h)
+            if tier is None:
+                break
+            out.append(tier)
+        return out
+
+    def drop(self, worker_id: str, hashes: Sequence[int]) -> None:
+        lru = self._workers.get(worker_id)
+        if lru is not None:
+            for h in hashes:
+                lru.discard(h)
+
+    def retain_workers(self, live: Sequence[str]) -> None:
+        keep = set(live)
+        for w in list(self._workers):
+            if w not in keep:
+                del self._workers[w]
+
+    def stats(self) -> dict:
+        return {
+            "tier_workers": len(self._workers),
+            "tier_hashes": sum(len(v) for v in self._workers.values()),
+        }
+
+    async def stop(self) -> None:
+        if self._sub is not None:
+            self._sub.close()
+        if self._task is not None:
+            self._task.cancel()

@@ -1,0 +1,78 @@
+"""TierPolicy: demote cold pages down the KVBM hierarchy under pressure.
+
+The device page pool is fixed-size; what watermark pressure actually
+costs is EVICTION LATENCY on the allocation path — a full pool makes
+every admission wait on a synchronous offload of its LRU victims. The
+policy converts that into background work: when the pool's truly-free
+slots fall below the high watermark, it write-backs the coldest
+reclaimable pages into host/disk AHEAD of eviction (the tiered
+allocator skips re-offloading anything already tier-resident), so
+later evictions drop device copies for free and the content stays
+servable from the lower tiers.
+
+Runs wherever the engine thread can call it — the worker drives
+`run_once` on its publish cadence through the engine runner. Pure
+policy: all mechanism lives in kvbm/manager.py.
+"""
+
+from __future__ import annotations
+
+import logging
+
+logger = logging.getLogger(__name__)
+
+#: start demoting when fewer than (1-high)·pages slots are truly free
+DEFAULT_HIGH_WATERMARK = 0.90
+#: demote enough cold pages to restore (1-low)·pages free slots
+DEFAULT_LOW_WATERMARK = 0.75
+#: per-tick demotion cap (bounds the staged-gather HBM + host copies)
+MAX_DEMOTE_PER_TICK = 64
+
+
+class TierPolicy:
+    def __init__(
+        self,
+        allocator,
+        high_watermark: float = DEFAULT_HIGH_WATERMARK,
+        low_watermark: float = DEFAULT_LOW_WATERMARK,
+        max_per_tick: int = MAX_DEMOTE_PER_TICK,
+    ):
+        if not (0.0 < low_watermark <= high_watermark <= 1.0):
+            raise ValueError(
+                f"need 0 < low ({low_watermark}) <= high "
+                f"({high_watermark}) <= 1"
+            )
+        self.allocator = allocator
+        self.high = high_watermark
+        self.low = low_watermark
+        self.max_per_tick = max_per_tick
+        self.demote_ticks = 0
+
+    def pressure(self) -> float:
+        """Fraction of the pool NOT on the free list (allocated or
+        cached): 1.0 = every admission must evict."""
+        alloc = self.allocator
+        total = alloc.num_pages - 1
+        if total <= 0:
+            return 0.0
+        return 1.0 - alloc._free_slots() / total
+
+    def run_once(self) -> int:
+        """One policy tick: newly demoted blocks (0 when below the high
+        watermark or nothing cold is left to demote)."""
+        alloc = self.allocator
+        if not getattr(alloc, "_offload_enabled", False):
+            return 0
+        p = self.pressure()
+        if p < self.high:
+            return 0
+        total = alloc.num_pages - 1
+        want = min(self.max_per_tick, max(1, int((p - self.low) * total)))
+        n = alloc.demote(want)
+        if n:
+            self.demote_ticks += 1
+            logger.debug(
+                "tier policy: demoted %d cold block(s) at pressure %.2f",
+                n, p,
+            )
+        return n

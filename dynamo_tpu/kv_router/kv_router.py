@@ -45,6 +45,7 @@ class KvRouter:
         config: Optional[KvRouterConfig] = None,
         selector=None,
         indexer_shards: int = 1,
+        economy=None,
     ):
         self.fabric = fabric
         self.component = component
@@ -75,6 +76,14 @@ class KvRouter:
                 digest_source=self._worker_digests,
             )
         self.active = ActiveSequences(block_size)
+        #: KV economy (kv_economy.EconomyPolicy, docs/operations.md "The
+        #: KV economy"): when set, find_best_match extends warmth scores
+        #: through lower tiers and, when a remote worker's deeper prefix
+        #: beats the chosen worker's by more than the transfer cost,
+        #: pulls the hot chain to the choice instead of cold-prefilling.
+        #: None (the default) keeps the decision path bit-identical to
+        #: the pre-economy router.
+        self.economy = economy
         #: distinguishes this router's kv_index.status frames from other
         #: routers serving the same component (the metrics service keys
         #: and sums per (component, router) — two frontends must not
@@ -155,6 +164,11 @@ class KvRouter:
                 | set(self.metrics.snapshot())
                 | self.active.workers()
             )
+            if (
+                self.economy is not None
+                and self.economy.tier_map is not None
+            ):
+                self.economy.tier_map.retain_workers(list(live))
             for gone in known - live:
                 n = self.indexer.remove_worker(gone)
                 self.active.remove_worker(gone)
@@ -220,8 +234,14 @@ class KvRouter:
                 token_ids, block_size=self.block_size, salt=self.salt
             )
             overlaps = self.indexer.find_matches(hashes)
+            scores = overlaps.scores
+            if self.economy is not None:
+                # warmth extended past HBM: tiered blocks count at their
+                # promotion-discounted value (a COPY — off-path scoring
+                # is untouched)
+                scores = self.economy.scored_with_tiers(scores, ids, hashes)
             choice = self.selector.select(
-                self._snapshots(ids), overlaps.scores, len(hashes)
+                self._snapshots(ids), scores, len(hashes)
             )
             sp.set_attr("total_blocks", len(hashes))
             sp.set_attr("candidates", len(ids))
@@ -229,6 +249,10 @@ class KvRouter:
                 sp.set_attr("chosen", None)
                 return None, 0
             overlap = overlaps.scores.get(choice, 0)
+            if self.economy is not None and hashes:
+                overlap = await self._maybe_migrate(
+                    instances, hashes, overlaps.scores, choice, overlap
+                )
             # the routing decision, traceable per request: who won, how
             # much of the prefix they already hold, and the score field
             sp.set_attr("chosen", choice)
@@ -242,6 +266,82 @@ class KvRouter:
                 self.active.add(choice, request_id, total_blocks - overlap)
             await self._emit_hit_rate(len(token_ids), overlap)
             return choice, overlap
+
+    async def _maybe_migrate(
+        self,
+        instances,
+        hashes: Sequence[int],
+        scores: dict[str, int],
+        choice: str,
+        overlap: int,
+    ) -> int:
+        """The KV economy's routing decision: when the deepest REMOTE
+        holder of this prefix beats the chosen worker by more blocks
+        than the transfer costs (CostModel), ask the holder to push the
+        missing chain to the choice through the handover offer/transfer
+        plane — the request then admits warm instead of cold-prefilling.
+
+        Every deny/failure path returns the unmodified overlap: the
+        request cold-prefills exactly as the pre-economy router would
+        have. Returns the (possibly migration-credited) overlap."""
+        eco = self.economy
+        source, source_ov = None, overlap
+        for iid, sc in scores.items():
+            if iid != choice and sc > source_ov:
+                source, source_ov = iid, sc
+        delta = source_ov - overlap
+        if source is None or not eco.cost_model.should_migrate(delta):
+            return overlap
+        # the deepest matched block hash names the prefix for
+        # single-flight/backoff purposes
+        prefix_key = int(hashes[min(source_ov, len(hashes)) - 1])
+        admitted, reason = eco.manager.admit(
+            prefix_key, choice, eco.cost_model.bytes_moved(delta)
+        )
+        if not admitted:
+            logger.debug(
+                "migration of %x to %s suppressed (%s)",
+                prefix_key, choice, reason,
+            )
+            return overlap
+        done, moved_bytes, moved_blocks = False, 0, 0
+        try:
+            by_id = {i.instance_id: i for i in instances}
+            src, dst = by_id.get(source), by_id.get(choice)
+            if src is None or dst is None:
+                return overlap
+            from dynamo_tpu.handover import call_ingress
+
+            reply = await asyncio.wait_for(
+                call_ingress(
+                    src.host, src.port, "migrate_prefix",
+                    {
+                        "hashes": [
+                            int(h) for h in hashes[overlap:source_ov]
+                        ],
+                        "dest": {
+                            "instance_id": choice,
+                            "host": dst.host,
+                            "port": dst.port,
+                        },
+                    },
+                ),
+                timeout=eco.migrate_timeout_s,
+            )
+            if reply.get("migrated"):
+                done = True
+                moved_blocks = int(reply.get("blocks") or 0)
+                moved_bytes = int(reply.get("bytes") or 0)
+        except Exception:
+            logger.warning(
+                "prefix migration %s -> %s failed; request cold-prefills",
+                source, choice, exc_info=True,
+            )
+        finally:
+            eco.manager.complete(
+                prefix_key, choice, done, moved_bytes, moved_blocks
+            )
+        return source_ov if done else overlap
 
     async def _emit_hit_rate(self, isl: int, overlap_blocks: int) -> None:
         try:

@@ -60,13 +60,20 @@ class TieredPageAllocator(PageAllocator):
         disk_dir: Optional[str] = None,
         on_event=None,
         extract_async_fn: Optional[ExtractFn] = None,
-        on_tier_event: Optional[Callable[[int, Optional[int]], None]] = None,
+        on_tier_event: Optional[
+            Callable[[int, Optional[int], str], None]
+        ] = None,
     ):
         super().__init__(num_pages, page_size, on_event=on_event)
-        #: (seq_hash, parent_hash) -> None, fired when a block lands in a
-        #: lower tier (G4 peers learn this worker can serve it; removals
-        #: self-heal via failed fetches, so only stores are announced)
+        #: (seq_hash, parent_hash, tier) -> None, fired when a block lands
+        #: in a lower tier (G4 peers learn this worker can serve it, and
+        #: the router's TierMap learns WHICH tier for warmth discounting;
+        #: removals self-heal via failed fetches, so only stores are
+        #: announced)
         self._on_tier_event = on_tier_event
+        #: prefix-hit continuations served from a lower tier, by tier —
+        #: the doctor's tier-pressure rule reads the disk share
+        self.tier_hits: dict[str, int] = {"host": 0, "disk": 0}
         self._extract_fn = extract_fn
         self._extract_async_fn = extract_async_fn
         self._inject_fn = inject_fn
@@ -111,12 +118,14 @@ class TieredPageAllocator(PageAllocator):
     def _store_entry(self, entry: BlockEntry) -> None:
         if self.host is not None:
             ok = self.host.put(entry)
+            tier = "host"
         else:
             ok = self.disk.put(entry)
+            tier = "disk"
         if ok:
             self.stats.offloaded_blocks += 1
             if self._on_tier_event is not None:
-                self._on_tier_event(entry.seq_hash, entry.parent_hash)
+                self._on_tier_event(entry.seq_hash, entry.parent_hash, tier)
 
     def _complete(self, seq_hash: int) -> Optional[BlockEntry]:
         """Materialize one staged offload (np.asarray blocks only until the
@@ -166,6 +175,32 @@ class TieredPageAllocator(PageAllocator):
         if self._offload_enabled:
             self._offload_pages([page])
 
+    def demote(self, n: int) -> int:
+        """Write-back demotion (kv_economy.TierPolicy): stage up to `n`
+        of the coldest reclaimable pages into the tier hierarchy AHEAD
+        of eviction. The device copies stay registered (still free prefix
+        hits); when pool pressure later evicts them, the offload hook
+        finds the bytes already tier-resident and the eviction costs
+        nothing. Returns newly demoted blocks."""
+        if not self._offload_enabled or n <= 0:
+            return 0
+        fresh: list[int] = []
+        # peek past already-demoted victims so repeated ticks make
+        # progress into the colder tail
+        for page in self._peek_reclaimable(4 * n):
+            meta = self._page_meta.get(page)
+            if meta is None or self.tier_contains(meta[0]):
+                continue
+            fresh.append(page)
+            if len(fresh) >= n:
+                break
+        if not fresh:
+            return 0
+        before = self.stats.offloaded_blocks
+        self._offload_pages(fresh)
+        self.flush_offloads()
+        return self.stats.offloaded_blocks - before
+
     # -- onboard (prefix-hit continuation) ---------------------------------
 
     def _tier_get(self, seq_hash: int) -> Optional[BlockEntry]:
@@ -178,10 +213,22 @@ class TieredPageAllocator(PageAllocator):
         if self.host is not None:
             e = self.host.get(seq_hash)
             if e is not None:
+                self.tier_hits["host"] += 1
                 return e
         if self.disk is not None:
-            return self.disk.get(seq_hash)
+            e = self.disk.get(seq_hash)
+            if e is not None:
+                self.tier_hits["disk"] += 1
+            return e
         return None
+
+    def tier_occupancy(self) -> dict[str, int]:
+        """Blocks resident per lower tier (worker metrics frames; the
+        Grafana "KV economy" row charts these)."""
+        return {
+            "host": len(self.host) if self.host is not None else 0,
+            "disk": len(self.disk) if self.disk is not None else 0,
+        }
 
     def tier_contains(self, seq_hash: int) -> bool:
         return (

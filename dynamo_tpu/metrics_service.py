@@ -153,6 +153,20 @@ _WORKER_FIELDS = (
     ("degraded_entries_total", "counter"),
     ("kv_events_dropped_total", "counter"),
     ("kv_events_pending", "gauge"),
+    # KV economy (docs/operations.md "The KV economy"): source-side
+    # per-prefix migration counters + KVBM tier residency/traffic — the
+    # Grafana "KV economy" row and the doctor's migration-storm /
+    # tier-pressure rules read these
+    ("kv_migrations_total", "counter"),
+    ("kv_migration_fallbacks_total", "counter"),
+    ("kv_migration_bytes_total", "counter"),
+    ("kv_migration_blocks_total", "counter"),
+    ("kvbm_host_blocks", "gauge"),
+    ("kvbm_disk_blocks", "gauge"),
+    ("kvbm_demotions_total", "counter"),
+    ("kvbm_promotions_total", "counter"),
+    ("kvbm_host_hits_total", "counter"),
+    ("kvbm_disk_hits_total", "counter"),
 )
 
 #: numeric per-worker fields copied verbatim into the /v1/fleet snapshot
@@ -170,6 +184,11 @@ _FLEET_WORKER_FIELDS = (
     "kv_transfer_corrupt_total",
     "degraded", "degraded_entries_total", "kv_events_dropped_total",
     "kv_events_pending",
+    "kv_migrations_total", "kv_migration_fallbacks_total",
+    "kv_migration_bytes_total", "kv_migration_blocks_total",
+    "kvbm_host_blocks", "kvbm_disk_blocks", "kvbm_demotions_total",
+    "kvbm_promotions_total", "kvbm_host_hits_total",
+    "kvbm_disk_hits_total",
 )
 
 

@@ -517,6 +517,7 @@ class ControlRunner:
         status_fn=None,
         handover=None,
         degraded_fn=None,
+        prewarm=None,
     ):
         self.planner = planner
         self.connector = connector
@@ -535,12 +536,20 @@ class ControlRunner:
         #: streams continue there — and fall back to connector.scale
         #: (kill/terminate) when it fails.
         self.handover = handover
+        #: async (role) -> bool: one hot-to-cold prefix migration inside
+        #: `role` (FleetKvEconomy.prewarm — docs/operations.md "The KV
+        #: economy"). After a scale-UP actuation the runner queues one
+        #: and fires it on the NEXT tick, when the spawned worker has
+        #: had an interval to register: its first requests land warm
+        #: instead of cold-prefilling the fleet's hottest prefix.
+        self.prewarm = prewarm
+        self._prewarm_pending: list[str] = []
         self.interval_s = interval_s or planner.config.interval_s
         self.now_fn = now_fn
         self.status_fn = status_fn
         self.decisions = {
             "scale_up": 0, "scale_down": 0, "flip": 0, "hold": 0,
-            "handover": 0,
+            "handover": 0, "prewarm": 0,
         }
         self.actions_clamped = 0
         self.cooldown_holds = 0
@@ -599,6 +608,19 @@ class ControlRunner:
                 target_prefill=state.num_prefill,
                 reason="hold: control plane degraded",
             )
+        if self.prewarm is not None and self._prewarm_pending:
+            # queued by last tick's scale-up: the newcomer has had one
+            # interval to register. Prewarm is a warmth optimization,
+            # not a capacity change — it doesn't consume action budget.
+            pending, self._prewarm_pending = self._prewarm_pending, []
+            for prole in pending:
+                warmed = False
+                try:
+                    warmed = bool(await self.prewarm(prole))
+                except Exception:
+                    logger.exception("planner: %s prewarm failed", prole)
+                if warmed:
+                    self._record("prewarm", prole)
         acts = self.planner.tick(state)
         now = self.now_fn()
         budget = getattr(c, "max_actions_per_tick", 1)
@@ -686,6 +708,8 @@ class ControlRunner:
                     "scale_up" if step > 0 else "scale_down", role,
                     **{"from": observed, "to": step_target},
                 )
+                if step > 0 and self.prewarm is not None:
+                    self._prewarm_pending.append(role)
             budget -= 1
             acted = True
             self._last_action[role] = now

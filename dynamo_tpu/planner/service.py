@@ -209,8 +209,13 @@ class FleetHandover:
     its streams and exits 0. Used by ControlRunner's scale-down path and
     the rolling-upgrade sweep."""
 
-    def __init__(self, observer: FleetObserver):
+    def __init__(self, observer: FleetObserver, economy=None):
         self.observer = observer
+        #: optional FleetKvEconomy: when set, a victim whose resident KV
+        #: prices BELOW the migration threshold is not handed over —
+        #: kill+recompute is cheaper than shipping its pages (the same
+        #: worth_it() the router uses per-prefix, at worker granularity)
+        self.economy = economy
         self.handovers = 0
 
     def _source(self, role: str):
@@ -247,6 +252,10 @@ class FleetHandover:
                 i.instance_id,
             ),
         )
+        if self.economy is not None and not self.economy.retire_worth_it(
+            victim.instance_id
+        ):
+            return False
         try:
             await call_ingress(
                 victim.host, victim.port, "handover",
@@ -262,6 +271,122 @@ class FleetHandover:
         self.handovers += 1
         logger.info(
             "handover dispatched to %s (%s)", victim.instance_id, role
+        )
+        return True
+
+
+class FleetKvEconomy:
+    """Actuator-side KV economy for the planner (docs/operations.md
+    "The KV economy"): the SAME CostModel the router and bench consult
+    prices the planner's worker-granularity moves, so flip-with-warm-KV,
+    whole-worker handover, and per-prefix migration are one primitive at
+    three sizes:
+
+    - scale-down: `retire_worth_it` — is the victim's resident KV worth
+      shipping (handover), or is kill+recompute cheaper? FleetHandover
+      asks before dispatching; a "no" falls through to the connector.
+    - scale-up: `prewarm` — warm the coldest worker of the role from
+      the hottest one via `migrate_prefix {auto}` (the donor picks its
+      own deepest chain). ControlRunner schedules one after each
+      scale-up actuation, so a newcomer's first requests can land warm.
+    """
+
+    def __init__(
+        self,
+        observer: FleetObserver,
+        cost_model,
+        prewarm_blocks: int = 32,
+        call_timeout_s: float = 30.0,
+    ):
+        self.observer = observer
+        self.cost_model = cost_model
+        self.prewarm_blocks = prewarm_blocks
+        self.call_timeout_s = call_timeout_s
+        self.prewarms = 0
+        self.prewarm_failures = 0
+        self.handovers_skipped = 0
+
+    def _source(self, role: str):
+        return (
+            self.observer._decode_src
+            if role == "decode"
+            else self.observer._prefill_src
+        )
+
+    def _blocks(self, snap: dict, instance_id: str) -> int:
+        return int(
+            snap.get(instance_id, {}).get("kv_active_pages", 0) or 0
+        )
+
+    def retire_worth_it(self, instance_id: str) -> bool:
+        """Price the victim's resident KV as one big migration: a
+        handover ships every registered page; a kill recomputes them."""
+        blocks = self._blocks(
+            self.observer.metrics.snapshot(), instance_id
+        )
+        ok = self.cost_model.worth_it(self.cost_model.price(blocks))
+        if not ok:
+            self.handovers_skipped += 1
+        return ok
+
+    async def prewarm(self, role: str) -> bool:
+        """One hot-to-cold prefix migration inside `role`: donor = the
+        worker with the most resident pages, target = the one with the
+        fewest (a just-registered newcomer has zero). No-op unless the
+        warmth gap prices above the shared migration threshold."""
+        from dynamo_tpu.handover import call_ingress
+
+        insts = [i for i in self._source(role).list() if i.port]
+        if len(insts) < 2:
+            return False
+        snap = self.observer.metrics.snapshot()
+        donor = max(
+            insts, key=lambda i: (self._blocks(snap, i.instance_id),
+                                  i.instance_id),
+        )
+        cold = min(
+            insts, key=lambda i: (self._blocks(snap, i.instance_id),
+                                  i.instance_id),
+        )
+        if donor.instance_id == cold.instance_id:
+            return False
+        gap = (
+            self._blocks(snap, donor.instance_id)
+            - self._blocks(snap, cold.instance_id)
+        )
+        if not self.cost_model.should_migrate(
+            min(gap, self.prewarm_blocks)
+        ):
+            return False
+        try:
+            reply = await call_ingress(
+                donor.host, donor.port, "migrate_prefix",
+                {
+                    "auto": True,
+                    "max_blocks": self.prewarm_blocks,
+                    "dest": {
+                        "instance_id": cold.instance_id,
+                        "host": cold.host,
+                        "port": cold.port,
+                    },
+                },
+                timeout=self.call_timeout_s,
+                request_id=f"prewarm-{self.prewarms}",
+            )
+        except Exception:
+            self.prewarm_failures += 1
+            logger.warning(
+                "prewarm migrate call to %s failed", donor.instance_id,
+                exc_info=True,
+            )
+            return False
+        if not (isinstance(reply, dict) and reply.get("migrated")):
+            self.prewarm_failures += 1
+            return False
+        self.prewarms += 1
+        logger.info(
+            "prewarm: %s -> %s (%s blocks)", donor.instance_id,
+            cold.instance_id, reply.get("blocks"),
         )
         return True
 

@@ -52,6 +52,9 @@ EVENT_TYPES = (
     "broker_demote",      # a stale-fenced broker demoted (split-brain refusal)
     "broker_failover",    # a client's established broker address changed
     "degraded",           # broker-less mode entered/left (phase attr)
+    # KV economy (docs/operations.md "The KV economy")
+    "kv_migration",       # a hot prefix pushed source->dest (or fallback)
+    "kv_demotion",        # TierPolicy demoted cold blocks HBM->host/disk
 )
 
 SEVERITIES = ("info", "warning", "critical")

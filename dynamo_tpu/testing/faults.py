@@ -82,6 +82,12 @@ HOOK_POINTS = (
     "handover.offer",
     "handover.transfer",
     "handover.adopt",
+    # per-prefix KV migration phases (docs/operations.md "The KV
+    # economy"): a fault at any of them must degrade the request to a
+    # cold prefill with both sides' pages freed
+    "migrate.extract",
+    "migrate.offer",
+    "migrate.transfer",
 )
 
 

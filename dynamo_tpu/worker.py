@@ -67,6 +67,7 @@ class Worker:
         engine=None,
         drain_budget_s: float = 30.0,
         kv_sequencing: bool = True,
+        kv_economy: bool = False,
     ):
         self.runtime = runtime
         self.card = card
@@ -97,7 +98,7 @@ class Worker:
         self.remote_onboards = 0
         self._fetch_client = None
         self._peer_source = None
-        self._tier_event_buffer: list[tuple[int, Optional[int]]] = []
+        self._tier_event_buffer: list[tuple[int, Optional[int], str]] = []
         self.ingress = IngressServer()
         self.runner: Optional[AsyncEngineRunner] = None
         self.echo: Optional[EchoEngine] = None
@@ -184,6 +185,18 @@ class Worker:
         self.handover_blocks = 0     # blocks accepted by successors
         self.handovers_adopted = 0   # blocks adopted as a successor
         self._handover_tasks: set[asyncio.Task] = set()
+        #: KV economy (docs/operations.md "The KV economy"): per-prefix
+        #: migration — a KV-economy router asks THIS worker (the holder
+        #: of a hot prefix) to push just that chain to the worker it
+        #: chose, through the same offer/transfer plane handover uses.
+        #: The flag additionally drives the TierPolicy demotion loop on
+        #: the publish cadence when the engine's allocator is tiered.
+        self.kv_economy = kv_economy
+        self._tier_policy = None
+        self.migrations = 0           # completed as the source side
+        self.migration_fallbacks = 0  # failed/degraded to cold prefill
+        self.migration_bytes = 0      # KV bytes pushed to destinations
+        self.migration_blocks = 0     # blocks accepted by destinations
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -232,8 +245,10 @@ class Worker:
                     on_kv_event=lambda e: self._kv_event_buffer.append(e),
                     checkpoint_path=self.checkpoint_path,
                     on_tier_event=(
-                        (lambda h, p: self._tier_event_buffer.append((h, p)))
-                        if self.kv_remote
+                        (lambda h, p, t: self._tier_event_buffer.append(
+                            (h, p, t)
+                        ))
+                        if self.kv_remote or self.kv_economy
                         else None
                     ),
                 ),
@@ -264,6 +279,7 @@ class Worker:
         self.ingress.add_handler("flip", self._flip_handler)
         self.ingress.add_handler("handover", self._handover_handler)
         self.ingress.add_handler("handover_offer", self._handover_offer_handler)
+        self.ingress.add_handler("migrate_prefix", self._migrate_prefix_handler)
         await self.ingress.start()
 
         metadata = {"model": self.card.name}
@@ -322,6 +338,16 @@ class Worker:
                 self.runtime.fabric, self.prefill_queue_name
             )
 
+        if (
+            self.kv_economy
+            and self.runner is not None
+            and not isinstance(self.runner, SpmdEngineRunner)
+        ):
+            alloc = getattr(self.runner.engine, "allocator", None)
+            if hasattr(alloc, "demote"):
+                from dynamo_tpu.kv_economy import TierPolicy
+
+                self._tier_policy = TierPolicy(alloc)
         ep = (
             self.runtime.namespace(self.namespace)
             .component(self.component)
@@ -995,6 +1021,200 @@ class Worker:
             "port": self.transfer_server.port,
         }
 
+    async def _hot_prefix_hashes(self, max_blocks: int) -> list:
+        """The deepest resident prefix chain, root-first, capped at
+        `max_blocks` — the donor side of `migrate_prefix {auto: true}`.
+        Depth is the proxy for heat: the longest registered chain is the
+        prefix most requests have been extending."""
+
+        def pick(metas):
+            parent = {h: p for h, p, _t in metas}
+            if not parent:
+                return []
+            depth: dict = {}
+
+            def d(h):
+                seen = []
+                x = h
+                while x is not None and x not in depth and x in parent:
+                    seen.append(x)
+                    x = parent.get(x)
+                    if len(seen) > len(parent) + 1:
+                        break  # corrupt-meta cycle guard
+                base = depth.get(x, 0) if x is not None else 0
+                for i, y in enumerate(reversed(seen)):
+                    depth[y] = base + i + 1
+                return depth.get(h, 0)
+
+            tip = max(parent, key=lambda h: (d(h), h))
+            chain = []
+            x = tip
+            while x is not None and x in parent:
+                chain.append(x)
+                x = parent.get(x)
+            chain.reverse()
+            return [int(h) for h in chain[:max_blocks]]
+
+        if self.mock is not None:
+            return pick(list(self.mock.allocator._page_meta.values()))
+        if self.runner is None:
+            return []
+        return await self.runner.submit(
+            lambda eng: pick(list(eng.allocator._page_meta.values()))
+        )
+
+    async def _migrate_prefix_handler(self, ctx, request):
+        """`migrate_prefix` ingress op — the KV economy's unit of work
+        (docs/operations.md "The KV economy"). A KV-economy router picked
+        worker D for a request whose prefix THIS worker holds deeper;
+        when the CostModel says the bytes are cheaper than D's cold
+        prefill, the router asks us (the source) to PUSH just that chain
+        to D through the unchanged handover offer/transfer plane:
+
+        - mock fleets: metadata-only offer (the mock's KV "content" IS
+          the hash chain) — D registers the metas and the request
+          admits warm;
+        - jax engines: export_blocks_by_hash in the canonical quantized
+          wire format, offer, then the normal checksummed
+          KvTransferClient page write.
+
+        Blocks are COPIED, not moved — both workers then hold (and
+        advertise) the prefix, which is exactly what a hot prefix
+        wants. ANY failure degrades to D cold-prefilling: our export
+        refs free in its finally, D's adopt watchdog frees reserved
+        pages on transfer timeout, and the reply says migrated=False so
+        the router stops waiting. Nothing leaks, nothing hangs."""
+        import numpy as np
+
+        from dynamo_tpu import handover as ho
+        from dynamo_tpu.testing import faults
+
+        req = request if isinstance(request, dict) else {}
+        hashes = [int(h) for h in (req.get("hashes") or [])]
+        dest = req.get("dest") or {}
+        if not dest.get("host") or not dest.get("port"):
+            yield {"migrated": False, "error": "bad request"}
+            return
+        if self.draining or not self._handover_capable():
+            yield {"migrated": False, "error": "source unavailable"}
+            return
+        if not hashes and req.get("auto"):
+            # planner pre-warm / victim-drain mode: no router in the
+            # loop to name a chain, so WE pick our deepest resident
+            # prefix (the hottest thing a cold newcomer can inherit)
+            hashes = await self._hot_prefix_hashes(
+                int(req.get("max_blocks") or 32)
+            )
+        if not hashes:
+            yield {"migrated": False, "error": "nothing to migrate"}
+            return
+        try:
+            await faults.fire("migrate.extract")
+            if self.mock is not None:
+                alloc = self.mock.allocator
+                meta_by_hash = {
+                    h: (h, p, toks)
+                    for h, p, toks in alloc._page_meta.values()
+                }
+                metas = []
+                for h in hashes:
+                    meta = meta_by_hash.get(h)
+                    if meta is None:
+                        break  # evicted since the router's index view
+                    metas.append(meta)
+                if not metas:
+                    yield {"migrated": False, "error": "prefix evicted"}
+                    return
+                await faults.fire("migrate.offer")
+                await faults.fire("migrate.transfer")
+                reply = await ho.call_ingress(
+                    dest["host"], int(dest["port"]), "handover_offer",
+                    {
+                        "metas": ho.metas_to_wire(metas),
+                        "source": self.instance_id,
+                        "payload": False,
+                    },
+                )
+                blocks = int(reply.get("adopted") or 0)
+                self.migrations += 1
+                self.migration_blocks += blocks
+                telemetry.events.record(
+                    "kv_migration", source=self.instance_id,
+                    dest=dest.get("instance_id"), blocks=blocks,
+                    coalesce_s=5.0,
+                )
+                yield {"migrated": True, "blocks": blocks, "bytes": 0}
+                return
+            runner = self.runner
+            exported = await runner.submit(
+                lambda eng: eng.export_blocks_by_hash(hashes)
+            )
+            if exported is None:
+                yield {"migrated": False, "error": "prefix evicted"}
+                return
+            emetas, k, v = exported
+            await faults.fire("migrate.offer")
+            reply = await ho.call_ingress(
+                dest["host"], int(dest["port"]), "handover_offer",
+                {
+                    "metas": ho.metas_to_wire(emetas),
+                    "source": self.instance_id,
+                    "payload": True,
+                },
+            )
+            page_ids = reply.get("page_ids") or []
+            if not page_ids:
+                # destination already holds the whole chain — the
+                # router's view lagged; count it migrated (the request
+                # admits warm either way)
+                self.migrations += 1
+                yield {"migrated": True, "blocks": 0, "bytes": 0}
+                return
+            want = list(reply.get("want_idx") or ())
+            await faults.fire("migrate.transfer")
+            if len(want) != k.shape[2]:
+                k = np.ascontiguousarray(k[:, :, want])
+                v = np.ascontiguousarray(v[:, :, want])
+            from dynamo_tpu.disagg.transfer import KvTransferClient
+
+            client = KvTransferClient()
+            try:
+                ok = await asyncio.wait_for(
+                    client.send(
+                        reply["host"], int(reply["port"]), reply["rid"],
+                        page_ids, k, v, 0,
+                    ),
+                    timeout=ho.ADOPT_TIMEOUT_S,
+                )
+            finally:
+                client.close()
+            if not ok:
+                raise RuntimeError("transfer send failed")
+            nbytes = int(k.nbytes + v.nbytes)
+            self.migrations += 1
+            self.migration_bytes += nbytes
+            self.migration_blocks += len(page_ids)
+            telemetry.events.record(
+                "kv_migration", source=self.instance_id,
+                dest=dest.get("instance_id"), blocks=len(page_ids),
+                bytes=nbytes, coalesce_s=5.0,
+            )
+            yield {
+                "migrated": True, "blocks": len(page_ids), "bytes": nbytes,
+            }
+        except Exception as e:
+            self.migration_fallbacks += 1
+            telemetry.events.record(
+                "kv_migration", severity="warning",
+                source=self.instance_id, dest=dest.get("instance_id"),
+                phase="fallback",
+            )
+            logger.warning(
+                "prefix migration to %s failed (request cold-prefills): "
+                "%s", dest.get("instance_id") or "?", e,
+            )
+            yield {"migrated": False, "error": str(e)}
+
     async def stop(self, drain_timeout: float = 30.0) -> None:
         """Graceful shutdown (reference: the vLLM drain handlers,
         examples worker.py:156-170): deregister FIRST so routers stop
@@ -1477,14 +1697,17 @@ class Worker:
             self._tier_event_buffer[:0] = tiered
             tiered = []
         if tiered:
+            # the `tier` field is additive: BlockDirectory ignores it
+            # (servable is servable), the router's TierMap prices it
             payload = msgpack.packb(
                 [
                     {
                         "kind": "stored",
                         "block_hashes": [h],
                         "parent_hash": p,
+                        "tier": t,
                     }
-                    for h, p in tiered
+                    for h, p, t in tiered
                 ],
                 use_bin_type=True,
             )
@@ -1493,6 +1716,21 @@ class Worker:
                 {"instance_id": self.instance_id, "count": len(tiered)},
                 payload,
             )
+        if self._tier_policy is not None and self.runner is not None:
+            # watermark-driven demotion rides the publish cadence: one
+            # bounded engine-thread tick per interval, and the demoted
+            # blocks' tier hints ship on the NEXT tick's publish above
+            policy = self._tier_policy
+            try:
+                n = await self.runner.submit(lambda eng: policy.run_once())
+            except Exception:
+                n = 0
+                logger.warning("tier policy tick failed", exc_info=True)
+            if n:
+                telemetry.events.record(
+                    "kv_demotion", source=self.instance_id, blocks=n,
+                    coalesce_s=5.0,
+                )
         m = None
         if self.runner is not None:
             m = self.runner.metrics.to_dict()
@@ -1561,6 +1799,26 @@ class Worker:
             m["handover_bytes_total"] = self.handover_bytes
             m["handover_blocks_total"] = self.handover_blocks
             m["handovers_adopted_total"] = self.handovers_adopted
+            # KV economy: source-side migration counters + tier residency
+            # (the Grafana "KV economy" row and the doctor's
+            # migration-storm / tier-pressure rules read these)
+            m["kv_migrations_total"] = self.migrations
+            m["kv_migration_fallbacks_total"] = self.migration_fallbacks
+            m["kv_migration_bytes_total"] = self.migration_bytes
+            m["kv_migration_blocks_total"] = self.migration_blocks
+            alloc = getattr(
+                getattr(self.runner, "engine", None), "allocator", None
+            )
+            if alloc is None and self.mock is not None:
+                alloc = self.mock.allocator
+            if alloc is not None and hasattr(alloc, "tier_hits"):
+                occ = alloc.tier_occupancy()
+                m["kvbm_host_blocks"] = occ["host"]
+                m["kvbm_disk_blocks"] = occ["disk"]
+                m["kvbm_demotions_total"] = alloc.stats.offloaded_blocks
+                m["kvbm_promotions_total"] = alloc.stats.onboarded_blocks
+                m["kvbm_host_hits_total"] = alloc.tier_hits["host"]
+                m["kvbm_disk_hits_total"] = alloc.tier_hits["disk"]
             eng = getattr(self.runner, "engine", None)
             if eng is not None and getattr(eng, "slo", None) is not None:
                 try:

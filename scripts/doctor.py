@@ -38,6 +38,19 @@ Rules (each emits severity + worker + evidence + suggested action):
   storm                wide — successors refusing or the transfer plane
                        failing; upgrades silently lose their warm-KV
                        benefit
+  migration-storm      the KV economy's per-prefix migrations are
+                       thrashing fleet-wide: transfers keep degrading to
+                       cold prefill (the transfer plane is failing), or
+                       migrations fire on so large a share of requests
+                       that the same hot prefixes must be ping-ponging
+                       between workers (backoff / break-even threshold
+                       misconfigured)
+  tier-pressure        a worker's HBM pool is pegged while its KVBM
+                       tier traffic is dominated by DISK hits — the hot
+                       working set has been demoted past host slab and
+                       every warm hit now pays an NVMe promotion; the
+                       fix is HBM capacity (or a higher demotion
+                       threshold), not more tiering
   overload             bounded admission is rejecting (overload_rejects
                        climbing -> "shedding, raise capacity"), or the
                        waiting queue is deep while the role burns its
@@ -138,6 +151,21 @@ OSCILLATION_WINDOW_FLOOR_S = 60.0
 #: handover drain-fallbacks (exceeding completions) before the
 #: fallback-storm rule fires
 FALLBACK_STORM_COUNT = 3
+#: per-prefix migration fallbacks (exceeding completions) before
+#: migration-storm's transfer-failure branch fires
+MIGRATION_FALLBACK_STORM_COUNT = 3
+#: completed migrations below this never count as churn — a warming
+#: fleet legitimately migrates its first few hot prefixes
+MIGRATION_CHURN_FLOOR = 10
+#: completed migrations per fleet request above which the same hot
+#: prefixes must be ping-ponging between workers (the router's backoff
+#: window or break-even threshold is set too loose)
+MIGRATION_CHURN_RATIO = 0.2
+#: tiered (host+disk) KV hits before tier-pressure can judge the mix
+TIER_HIT_FLOOR = 8
+#: disk share of tiered hits above which the hot working set has been
+#: demoted past the host slab onto NVMe
+TIER_DISK_HIT_SHARE = 0.5
 #: worst kept traces the slow-trace-attribution rule examines
 TRACE_WORST_N = 5
 #: a phase must explain at least this share of a trace's wall time to
@@ -229,11 +257,16 @@ def diagnose(
 
     #: fleet-wide handover fallback tally (storm rule below)
     handover_done = handover_fb = 0
+    #: fleet-wide KV-economy migration tally (migration-storm rule below)
+    migration_done = migration_fb = fleet_requests = 0
 
     for iid, w in sorted(workers.items()):
         age = float(w.get("last_seen_s") or 0.0)
         handover_done += int(w.get("handovers_total") or 0)
         handover_fb += int(w.get("handover_fallbacks_total") or 0)
+        migration_done += int(w.get("kv_migrations_total") or 0)
+        migration_fb += int(w.get("kv_migration_fallbacks_total") or 0)
+        fleet_requests += int(w.get("requests_received") or 0)
         if str(w.get("state") or "") == "handover":
             # live KV migration (POST /v1/admin/handover / planner
             # scale-down / rolling upgrade): planned, suppress the
@@ -418,6 +451,45 @@ def diagnose(
                 "429 + Retry-After instead of queueing past its deadline",
             ))
 
+        # tier-pressure (docs/operations.md "The KV economy"): the HBM
+        # pool is pegged at its demotion watermark AND the KVBM tier
+        # traffic is dominated by DISK hits — the hot working set has
+        # been demoted past the host slab, so every "warm" hit now pays
+        # an NVMe promotion. More tiering can't fix that; HBM capacity
+        # (or a higher demotion threshold) can.
+        host_hits = int(w.get("kvbm_host_hits_total") or 0)
+        disk_hits = int(w.get("kvbm_disk_hits_total") or 0)
+        tier_hits = host_hits + disk_hits
+        demotions = int(w.get("kvbm_demotions_total") or 0)
+        free_pages = w.get("kv_free_pages")
+        total_pages = int(w.get("kv_total_pages") or 0)
+        hbm_pegged = (
+            free_pages is not None and total_pages > 0
+            and int(free_pages) <= total_pages * POOL_FREE_FRACTION
+        )
+        if (
+            demotions > 0 and hbm_pegged and tier_hits >= TIER_HIT_FLOOR
+            and disk_hits >= tier_hits * TIER_DISK_HIT_SHARE
+        ):
+            findings.append(_finding(
+                "warning", "tier-pressure", iid,
+                f"{iid}: HBM pool pegged ({free_pages}/{total_pages} "
+                f"free) with {disk_hits}/{tier_hits} tiered KV hits "
+                "served from DISK — the hot working set was demoted "
+                "past host slab and warm hits now pay NVMe promotion",
+                {"kv_free_pages": free_pages,
+                 "kv_total_pages": total_pages,
+                 "kvbm_demotions_total": demotions,
+                 "kvbm_host_hits_total": host_hits,
+                 "kvbm_disk_hits_total": disk_hits,
+                 "kvbm_host_blocks": w.get("kvbm_host_blocks"),
+                 "kvbm_disk_blocks": w.get("kvbm_disk_blocks")},
+                "add HBM capacity (workers or --num-pages) or raise the "
+                "demotion threshold so the hot set stays resident; the "
+                "router already discounts disk-tier warmth, so persistent "
+                "disk hits mean demand, not misrouting",
+            ))
+
         mean = role_mean.get(str(w.get("role", "?")), 0.0)
         tok = float(w.get("tok_s") or 0.0)
         if mean > 1.0 and tok < mean * SKEW_FRACTION:
@@ -458,6 +530,48 @@ def diagnose(
             "(extract / offer / transfer / adopt); common causes: "
             "successors with full pools, a partitioned transfer plane, "
             "or single-worker pools with no successor at all",
+        ))
+
+    # migration-storm: two failure signatures over the KV economy's
+    # per-prefix migrations. (1) transfers keep DEGRADING — every
+    # attempt falls back to cold prefill, so the fleet pays migration
+    # overhead with none of the warm-TTFT benefit. (2) transfers
+    # SUCCEED but fire on so large a share of requests that the same
+    # hot prefixes must be ping-ponging between workers.
+    if (
+        migration_fb >= MIGRATION_FALLBACK_STORM_COUNT
+        and migration_fb > migration_done
+    ):
+        findings.append(_finding(
+            "warning", "migration-storm", None,
+            f"{migration_fb} prefix migration(s) degraded to cold "
+            f"prefill vs {migration_done} completed — the KV economy "
+            "is paying transfer overhead with no warm-TTFT benefit",
+            {"kv_migration_fallbacks_total": migration_fb,
+             "kv_migrations_total": migration_done},
+            "read the source workers' logs for the failing phase "
+            "(extract / offer / transfer); common causes: destinations "
+            "with full pools or a partitioned transfer plane — the "
+            "router's backoff fences repeat attempts, but the break-even "
+            "gate cannot see transport failures",
+        ))
+    elif (
+        migration_done >= MIGRATION_CHURN_FLOOR
+        and migration_done > fleet_requests * MIGRATION_CHURN_RATIO
+    ):
+        findings.append(_finding(
+            "warning", "migration-storm", None,
+            f"{migration_done} prefix migration(s) completed against "
+            f"{fleet_requests} fleet request(s) — more than one "
+            f"migration per {int(1 / MIGRATION_CHURN_RATIO)} requests "
+            "means hot prefixes are ping-ponging between workers",
+            {"kv_migrations_total": migration_done,
+             "fleet_requests_received": fleet_requests,
+             "kv_migration_fallbacks_total": migration_fb},
+            "raise the router's migration backoff window and/or "
+            "DYN_KV_ECONOMY_MIN_FLOPS_PER_BYTE so only clearly "
+            "profitable moves clear the break-even gate; see "
+            "docs/operations.md 'The KV economy'",
         ))
 
     findings.extend(_kv_index_rules((fleet or {}).get("kv_index")))

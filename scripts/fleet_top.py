@@ -12,8 +12,9 @@ who want the fleet at a glance without Grafana:
 
 Per worker: role, model, req/s, tok/s, TTFT/ITL p50/p95, KV-pool %,
 live MFU, jit compiles, stall count (dynamo_tpu_stalls_total, via the
-worker frames' stalls_total), SLO burn rate (shortest attainment
-window), the worst KEPT trace touching the worker (fleet trace plane,
+worker frames' stalls_total), KVBM tier residency + hit split
+(TIER/HIT — docs/operations.md "The KV economy"), SLO burn rate
+(shortest attainment window), the worst KEPT trace touching the worker (fleet trace plane,
 GET /v1/traces — its id pastes straight into /v1/traces/{id}),
 last_seen age. Fleet footer: merged percentiles, SLA attainment + burn
 rates, goodput. `--events` tails GET /v1/fleet/events instead — one
@@ -80,8 +81,8 @@ def render(snap: dict, traces=None) -> str:
         ("WORKER", 22), ("ROLE", 8), ("MODEL", 12), ("REQ/S", 7),
         ("TOK/S", 8), ("TTFT p50/p95", 14), ("ITL p50/p95", 12),
         ("KV%", 6), ("WM", 6), ("MFU", 7), ("COMP", 5), ("PREEMPT", 7),
-        ("SPEC%", 6), ("STALLS", 6), ("BURN", 6), ("WORST-TRACE", 16),
-        ("AGE s", 6),
+        ("SPEC%", 6), ("TIER/HIT", 12), ("STALLS", 6), ("BURN", 6),
+        ("WORST-TRACE", 16), ("AGE s", 6),
     )
     worst = _worst_traces_by_worker(traces)
     out = [" ".join(f"{h:<{w}}" for h, w in cols)]
@@ -110,6 +111,24 @@ def render(snap: dict, traces=None) -> str:
                 _fmt((w.get("spec_accept_rate") or 0.0) * 100.0, 0)
                 if w.get("spec_window_drafted")
                 else ("idle" if w.get("spec_drafted") else "-")
+            ),
+            # KV economy tier view: lower-tier block residency
+            # (host/disk, KVBM write-back demotion) and which tier
+            # served prefix-hit continuations — "12h3d 5/1" reads
+            # "12 host + 3 disk blocks resident, 5 host / 1 disk hits".
+            # Workers without KVBM tiers show "-", never zeros.
+            (
+                f"{int(w.get('kvbm_host_blocks') or 0)}h"
+                f"{int(w.get('kvbm_disk_blocks') or 0)}d "
+                f"{int(w.get('kvbm_host_hits_total') or 0)}/"
+                f"{int(w.get('kvbm_disk_hits_total') or 0)}"
+                if any(
+                    w.get(f) is not None for f in (
+                        "kvbm_host_blocks", "kvbm_disk_blocks",
+                        "kvbm_demotions_total",
+                    )
+                )
+                else "-"
             ),
             _fmt(w.get("stalls_total"), 0),
             _fmt(burn, 1, "x") if burn is not None else "-",

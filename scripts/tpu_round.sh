@@ -73,6 +73,13 @@ bench_1b_kstep() { # on-device K-step decode window chip arm (ISSUE 16):
                    # with the headline model; read against the 13ms-vs-
                    # 3.7ms roofline gap in docs/PERF.md
                BENCH_KSTEP=8 run_stage bench_1b_kstep python bench.py; }
+bench_1b_prefixmig() { # per-prefix KV migration chip arm (ISSUE 18):
+                   # prefix_migration_ab extras — turn-2 TTFT with the
+                   # session's hot prefix chain migrated vs cold
+                   # prefill, priced by the shared kv_economy CostModel
+                   # (read flops_saved_per_byte + should_migrate +
+                   # modeled_ttft_ratio on the chip wire format)
+               BENCH_PREFIXMIG=1 run_stage bench_1b_prefixmig python bench.py; }
 bench_8b()   { BENCH_MODEL=llama3-8b BENCH_QUANTIZE=int8 BENCH_REQUESTS=64 \
                run_stage bench_8b python bench.py; }
 transfer()   { run_stage transfer python -m benchmarks.transfer_bench --mb 64; }
@@ -92,7 +99,7 @@ disagg_ab()  { run_stage disagg_ab python -m benchmarks.disagg_bench \
                  --num-pages 1024 --max-context 4096 --max-local-prefill 256 \
                  --requests 32 --isl 1024 --osl 64 --concurrency 8; }
 
-STAGES_ALL=(bench_1b bench_1b_kvq bench_1b_mixed bench_1b_spec bench_1b_kstep bench_8b transfer sweep sweep_8b sla disagg_ab)
+STAGES_ALL=(bench_1b bench_1b_kvq bench_1b_mixed bench_1b_spec bench_1b_kstep bench_1b_prefixmig bench_8b transfer sweep sweep_8b sla disagg_ab)
 # disagg A/B last: two engine processes timeshare the one chip — expect
 # contention; honest multi-chip runs need dp mesh halves or two hosts
 

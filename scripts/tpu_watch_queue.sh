@@ -141,6 +141,14 @@ bench_1b_kstep() {
   # docs/PERF.md's 13ms-vs-3.7ms host-loop argument.
   BENCH_KSTEP=8 run_stage bench_1b_kstep python bench.py
 }
+bench_1b_prefixmig() {
+  # per-prefix KV migration chip arm (ISSUE 18): prefix_migration_ab
+  # extras — turn-2 TTFT with the session's hot prefix chain migrated
+  # to a fresh engine vs cold prefill, priced by the shared kv_economy
+  # CostModel (flops_saved_per_byte, should_migrate, modeled ratio on
+  # the chip wire format)
+  BENCH_PREFIXMIG=1 run_stage bench_1b_prefixmig python bench.py
+}
 pallas_gate() {
   # numerics GATE: prefill logit diff + 32-step teacher-forced drift
   # (budget 0.25 / >=90% argmax agreement); exit 2 = gate failed.
@@ -155,7 +163,7 @@ transfer() {
 }
 
 STAGES=("$@")
-[ ${#STAGES[@]} -eq 0 ] && STAGES=(pallas_kernels prewarm disagg_ab sweep_8b sla_8b ft_kill routing offload bench_dsv2 decode_profile bench_1b_sweep bench_1b_kvq bench_1b_mixed bench_1b_spec bench_1b_kstep pallas_gate transfer)
+[ ${#STAGES[@]} -eq 0 ] && STAGES=(pallas_kernels prewarm disagg_ab sweep_8b sla_8b ft_kill routing offload bench_dsv2 decode_profile bench_1b_sweep bench_1b_kvq bench_1b_mixed bench_1b_spec bench_1b_kstep bench_1b_prefixmig pallas_gate transfer)
 
 wait_for_tunnel
 for s in "${STAGES[@]}"; do

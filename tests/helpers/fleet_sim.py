@@ -87,6 +87,10 @@ class FleetSim:
         self.server: Optional[FabricServer] = None
         self.runtime: Optional[DistributedRuntime] = None
         self.router: Optional[PushRouter] = None
+        #: KV-routed mode only (start(router="kv")): the real KvRouter
+        #: whose choose() drives the PushRouter; carries the optional
+        #: EconomyPolicy (ISSUE 18 — the KV economy plane)
+        self.kv_router = None
         self.workers: list[Worker] = []
         self.stats = SimStats()
         self.rng = random.Random(7)
@@ -94,7 +98,12 @@ class FleetSim:
 
     # -- lifecycle ---------------------------------------------------------
 
-    async def start(self, replay: bool = True) -> None:
+    async def start(
+        self,
+        replay: bool = True,
+        router: str = "round_robin",
+        economy=None,
+    ) -> None:
         self.server = FabricServer(port=0)
         await self.server.start()
         # ONE runtime/fabric connection shared by every sim worker —
@@ -108,6 +117,22 @@ class FleetSim:
             .endpoint("generate")
         )
         src = await ep.instance_source()
+        if router == "kv":
+            from dynamo_tpu.kv_router import KvRouter, KvRouterConfig
+
+            self.kv_router = KvRouter(
+                self.runtime.fabric, "backend", src,
+                block_size=PAGE_SIZE, salt=MODEL,
+                config=KvRouterConfig(temperature=0.0),
+                economy=economy,
+            )
+            await self.kv_router.start()
+            self.router = PushRouter(
+                src, "generate", mode=RouterMode.KV,
+                kv_chooser=self.kv_router.choose, replay=replay,
+                retry_backoff_base_ms=5.0, retry_backoff_max_ms=50.0,
+            )
+            return
         self.router = PushRouter(
             src, "generate", mode=RouterMode.ROUND_ROBIN, replay=replay,
             # fast, bounded retries: the sim drives hundreds of streams
@@ -198,11 +223,16 @@ class FleetSim:
         }
 
     async def one(self, isl: int = 24, osl: int = 8,
-                  timeout: float = 30.0) -> tuple[list, Optional[str], float]:
+                  timeout: float = 30.0,
+                  prompt: Optional[list] = None
+                  ) -> tuple[list, Optional[str], float]:
         """Drive one stream to a terminal state. Returns (tokens,
         finish_reason, ttft_s); an exception IS a dropped stream and
-        propagates to the caller's accounting."""
+        propagates to the caller's accounting. `prompt` overrides the
+        random tokens (multi-turn chat sessions re-send their history)."""
         req = self._request(isl, osl)
+        if prompt is not None:
+            req["token_ids"] = list(prompt)
         self.stats.started += 1
         tokens: list = []
         finish = None
@@ -226,6 +256,12 @@ class FleetSim:
         except Exception:
             self.stats.errored += 1
             raise
+        finally:
+            if self.kv_router is not None:
+                # router_pipeline does this in the frontend; the sim
+                # drives PushRouter directly, so free the active-
+                # sequence footprint here
+                self.kv_router.on_complete(req["request_id"])
         if finish in ("length", "stop"):
             self.stats.completed += 1
             ttft = (t_first or time.monotonic()) - t0
@@ -270,6 +306,11 @@ class FleetSim:
     async def stop(self) -> None:
         if self.router is not None:
             self.router.close()
+        if self.kv_router is not None:
+            try:
+                await self.kv_router.stop()
+            except Exception:
+                pass
         for w in list(self.workers):
             try:
                 await w.stop(drain_timeout=0)

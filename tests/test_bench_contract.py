@@ -192,6 +192,27 @@ def test_cpu_fallback_line_is_labeled_and_carries_tpu_artifact():
     assert ho["modeled_ttft_ratio"] == 0.25, ho
     assert ho["ttft_warm_s"] > 0 and ho["ttft_cold_s"] > 0
     assert ho["measured_ttft_ratio"] < 1.5, ho  # sanity band
+    # per-prefix migration A/B (ISSUE 18): the same CostModel pricing on
+    # the multi-turn chat shape — the source's registered chain (the
+    # 32-token turn-1 prompt, exactly 8 full blocks) migrates to the
+    # fresh worker and lands fully cached there, the move clears the
+    # router's break-even gate, and the modeled TTFT ratio counts 1
+    # warm prefill chunk vs 3 cold (16 uncached vs 48 total at
+    # chunk=16). The wall TTFT pair gets the same generous sanity band
+    # as handover_ab.
+    pm = ex["prefix_migration_ab"]
+    assert "error" not in pm, pm
+    assert pm["blocks_moved"] == pm["turn1_tokens"] // pm["page_size"]
+    assert pm["blocks_adopted"] == pm["blocks_moved"]
+    assert pm["bytes_moved"] == pm["blocks_moved"] * pm["block_bytes"]
+    assert pm["cached_tokens"] >= pm["turn1_tokens"], pm
+    assert pm["prefill_flops_saved"] == (
+        2 * pm["params"] * pm["cached_tokens"]
+    )
+    assert pm["should_migrate"] is True, pm
+    assert pm["modeled_ttft_ratio"] == 0.3333, pm
+    assert pm["ttft_warm_s"] > 0 and pm["ttft_cold_s"] > 0
+    assert pm["measured_ttft_ratio"] < 1.5, pm  # sanity band
     # KV index sequencing A/B (ISSUE 13): the seq-stamp + digest fold on
     # the event publish path priced <1% of token throughput by the
     # deterministic model (real _stamp_kv_events microbench x measured

@@ -253,6 +253,105 @@ def test_handover_rules_fire_on_recorded_snapshots():
     ]
 
 
+def test_migration_storm_rule_fires_on_recorded_snapshots():
+    """migration-storm (ISSUE 18): the KV economy's per-prefix
+    migrations thrash in two ways — transfers keep degrading to cold
+    prefill (transfer plane failing), or completions fire on so large a
+    share of requests that hot prefixes are ping-ponging. A healthy
+    economy (occasional profitable moves, few failures) stays quiet."""
+    doctor = _load_doctor()
+
+    def storms(workers):
+        return [
+            f for f in doctor.diagnose(
+                {"workers": workers, "roles": {}}, {}, {}
+            )
+            if f["rule"] == "migration-storm"
+        ]
+
+    def w(**kw):
+        return {"role": "decode", "last_seen_s": 0.2, "tok_s": 500.0,
+                "kv_total_pages": 512, **kw}
+
+    # (1) degradation storm: fallbacks outnumber completions fleet-wide
+    hits = storms({
+        f"w{i}": w(kv_migration_fallbacks_total=2, kv_migrations_total=1)
+        for i in range(3)
+    })
+    assert len(hits) == 1 and hits[0]["severity"] == "warning"
+    assert hits[0]["evidence"]["kv_migration_fallbacks_total"] == 6
+    assert hits[0]["evidence"]["kv_migrations_total"] == 3
+    assert "cold prefill" in hits[0]["summary"]
+    assert "failing phase" in hits[0]["action"]
+
+    # (2) churn storm: completions succeed but fire on >1 in 5 requests
+    hits = storms({
+        "w0": w(kv_migrations_total=18, requests_received=40),
+        "w1": w(kv_migrations_total=12, requests_received=50),
+    })
+    assert len(hits) == 1 and hits[0]["severity"] == "warning"
+    assert hits[0]["evidence"]["kv_migrations_total"] == 30
+    assert hits[0]["evidence"]["fleet_requests_received"] == 90
+    assert "ping-ponging" in hits[0]["summary"]
+    assert "DYN_KV_ECONOMY_MIN_FLOPS_PER_BYTE" in hits[0]["action"]
+
+    # healthy economy: many requests, a few profitable moves, rare
+    # failures below both thresholds — quiet
+    assert storms({
+        "w0": w(kv_migrations_total=30, kv_migration_fallbacks_total=2,
+                requests_received=1000),
+    }) == []
+    # a warming fleet's first few migrations never count as churn
+    assert storms({
+        "w0": w(kv_migrations_total=4, requests_received=5),
+    }) == []
+
+
+def test_tier_pressure_rule_fires_on_recorded_snapshots():
+    """tier-pressure (ISSUE 18): a worker whose HBM pool is pegged at
+    the watermark while its KVBM tier hits are dominated by DISK — the
+    hot working set was demoted past host slab, and every warm hit now
+    pays an NVMe promotion. Host-dominated hits, an unpegged pool, or a
+    pool that never demoted all stay quiet."""
+    doctor = _load_doctor()
+
+    def pressure(extra):
+        fleet = {"workers": {"w0": {
+            "role": "decode", "last_seen_s": 0.2, "tok_s": 500.0,
+            **extra,
+        }}, "roles": {}}
+        return [
+            f for f in doctor.diagnose(fleet, {}, {})
+            if f["rule"] == "tier-pressure"
+        ]
+
+    pegged = {"kv_free_pages": 4, "kv_total_pages": 512,
+              "kvbm_demotions_total": 90, "kvbm_host_blocks": 48,
+              "kvbm_disk_blocks": 200}
+    (f,) = pressure({**pegged, "kvbm_host_hits_total": 3,
+                     "kvbm_disk_hits_total": 17})
+    assert f["severity"] == "warning" and f["worker"] == "w0"
+    assert "DISK" in f["summary"]
+    assert f["evidence"]["kvbm_disk_hits_total"] == 17
+    assert f["evidence"]["kv_free_pages"] == 4
+    assert "HBM capacity" in f["action"]
+
+    # host slab absorbing the warmth: the tiers are doing their job
+    assert pressure({**pegged, "kvbm_host_hits_total": 20,
+                     "kvbm_disk_hits_total": 2}) == []
+    # plenty of free HBM: demotions were transient, not pressure
+    assert pressure({**pegged, "kv_free_pages": 300,
+                     "kvbm_host_hits_total": 3,
+                     "kvbm_disk_hits_total": 17}) == []
+    # pegged but never demoted (no KVBM): a pool-capacity story, not a
+    # tiering one — the pool-exhaustion rule owns it
+    assert pressure({"kv_free_pages": 4, "kv_total_pages": 512,
+                     "kvbm_disk_hits_total": 17}) == []
+    # too few tiered hits to judge the mix
+    assert pressure({**pegged, "kvbm_host_hits_total": 1,
+                     "kvbm_disk_hits_total": 3}) == []
+
+
 def test_snapshot_only_mode_does_not_flag_busy_workers_as_stalled():
     """--snapshot without --flight: no flight doc at all — busy workers
     with no records are the NORM there, not wedged engines (the silent-

@@ -444,6 +444,9 @@ RECORDED_SNAPSHOT = {
             "num_running": 9, "num_waiting": 1, "compiles": 14,
             "compiles_by_kind": {"prefill": 6, "decode_multi": 8},
             "mfu": 0.241, "tokens_per_s": 812.0,
+            "kvbm_host_blocks": 12, "kvbm_disk_blocks": 3,
+            "kvbm_demotions_total": 15, "kvbm_promotions_total": 6,
+            "kvbm_host_hits_total": 5, "kvbm_disk_hits_total": 1,
             "slo": {
                 "requests_total": 400, "within_sla_total": 392,
                 "tokens_total": 25600, "goodput_tokens_total": 25100,
@@ -556,6 +559,11 @@ def test_fleet_top_renders_recorded_snapshot(tmp_path):
     assert "130.1" in text or "130/" in text  # ttft p50 in fleet footer
     assert "burn rate 2.50x" in text
     assert "goodput 25100/25600 tokens" in text
+    # KV-economy TIER/HIT column: lower-tier residency + which tier
+    # served the hits ("12h3d 5/1"); workers without KVBM show "-"
+    assert "TIER/HIT" in text
+    assert "12h3d 5/1" in decode_row0
+    assert "12h3d" not in prefill_row0
     # stall-count + burn-rate columns (sourced from the watchdog's
     # stalls_total and the worker SLO windows)
     assert "STALLS" in text and "BURN" in text

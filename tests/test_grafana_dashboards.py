@@ -71,6 +71,12 @@ def _populated_expositions() -> list[str]:
         # control-plane HA: the worker's broker-connection view
         degraded=0, degraded_entries_total=1,
         kv_events_dropped_total=3, kv_events_pending=0,
+        # KV economy: migration + tier fields for the "KV economy" row
+        kv_migrations_total=2, kv_migration_fallbacks_total=1,
+        kv_migration_bytes_total=4096, kv_migration_blocks_total=4,
+        kvbm_host_blocks=8, kvbm_disk_blocks=2,
+        kvbm_demotions_total=10, kvbm_promotions_total=3,
+        kvbm_host_hits_total=5, kvbm_disk_hits_total=1,
     )
     svc.aggregator._latest["w1"] = (frame, time.monotonic())
     # closed-loop planner status frame (ControlRunner.status shape) so

@@ -1,0 +1,498 @@
+"""The KV economy (ISSUE 18): one cost model, per-prefix migration,
+tiered warmth.
+
+Three layers of proof:
+
+- **Pricing** — CostModel's formulas are the PR 12 handover accounting
+  verbatim (2·P·T flops vs blocks·block_bytes wire bytes), the modeled
+  TTFT ratio is pinned, and the break-even threshold suppresses every
+  degenerate move. MigrationManager's admission order (single-flight →
+  backoff → concurrency → byte budget) runs on an injected clock.
+- **Routing** — KvRouter with economy=None is bit-identical to the
+  pre-economy decision path (the migration hook is provably never
+  reached); with an economy installed, a below-threshold delta never
+  even consults the manager, and the credited/failed migration paths
+  account into the manager exactly once each.
+- **Fleet** — a multi-turn chat session over the mocker fleet sim:
+  turn 1 warms one worker, the router is forced off it, and turn 2
+  must arrive warm on the OTHER worker via a real migrate_prefix →
+  handover_offer round trip (cross-worker prefix hit rate > 0, zero
+  dropped streams, modeled TTFT strictly better than cold). A fault
+  injected mid-migration must degrade the request to a cold prefill
+  with every page back in both workers' free pools. The 500-worker
+  variant is `slow`.
+"""
+
+import asyncio
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).parent))
+
+from helpers.fleet_sim import MODEL, PAGE_SIZE, FleetSim  # noqa: E402
+
+from dynamo_tpu.kv_economy import (
+    CostModel,
+    EconomyPolicy,
+    MigrationManager,
+    block_wire_bytes,
+    cost_model_from_card,
+)
+from dynamo_tpu.kv_router import KvRouter, KvRouterConfig
+from dynamo_tpu.kv_router.indexer import OverlapScores
+from dynamo_tpu.model_card import ModelDeploymentCard
+from dynamo_tpu.testing import faults
+from dynamo_tpu.tokens import hash_token_blocks
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+# ---------------------------------------------------------------------------
+# CostModel: the shared pricing function
+# ---------------------------------------------------------------------------
+
+
+def test_modeled_ttft_ratio_pinned():
+    # THE contract number: bench.py handover_ab / prefix_migration_ab
+    # (2048 total, 1536 cached, 128-token prefill chunks → 4/16 chunks)
+    assert CostModel.modeled_ttft_ratio(2048, 1536, 128) == 0.25
+    assert CostModel.modeled_ttft_ratio(512, 384, 128) == 0.25
+    # nothing cached → no speedup
+    assert CostModel.modeled_ttft_ratio(96, 0, 32) == 1.0
+    # even a full-prefix hit still dispatches one chunk (warm floor)
+    assert CostModel.modeled_ttft_ratio(256, 256, 128) == 0.5
+
+
+def test_pricing_formulas_are_the_handover_accounting():
+    cm = CostModel(params=10**9, block_bytes=4096, page_size=16)
+    p = cm.price(8)
+    assert p.blocks == 8
+    assert p.bytes_moved == 8 * 4096
+    assert p.cached_tokens == 8 * 16
+    assert p.flops_saved == 2 * 10**9 * 128
+    assert p.flops_saved_per_byte == p.flops_saved / p.bytes_moved
+    assert cm.worth_it(p)
+    assert cm.should_migrate(8)
+    # non-positive deltas are never a migration
+    assert not cm.should_migrate(0)
+    assert not cm.should_migrate(-3)
+    # a single block never pays for its offer/transfer round trips
+    assert not cm.should_migrate(1)
+
+
+def test_threshold_suppresses_every_delta():
+    """The router-facing guarantee: when bytes-moved out-prices
+    flops-saved at the configured exchange rate, NO delta migrates."""
+    cm = CostModel(
+        params=1, block_bytes=10**15, page_size=16, min_flops_per_byte=1e30
+    )
+    assert not any(cm.should_migrate(d) for d in range(0, 512))
+
+
+def test_tier_discount_ordering():
+    cm = CostModel(params=10**9, block_bytes=262144, page_size=16)
+    # HBM-resident blocks are full-price, however spelled
+    for t in (None, "", "device", "hbm"):
+        assert cm.tier_discount(t) == 1.0
+    host, disk = cm.tier_discount("host"), cm.tier_discount("disk")
+    # promotion costs strictly discount, and disk costs more than host
+    assert 0.0 < disk < host < 1.0
+    # unknown tiers are worthless rather than mispriced
+    assert cm.tier_discount("tape") == 0.0
+
+
+def test_cost_model_from_card():
+    # no card at all (planner process): 1B-class defaults
+    cm = cost_model_from_card(None)
+    assert cm.params == 1_000_000_000
+    assert cm.page_size == 16
+    assert cm.block_bytes == block_wire_bytes(16, 8, 16, 64, 1)
+
+    # a card that publishes its shape gets exact pricing
+    card = ModelDeploymentCard(
+        name="m", kv_page_size=32,
+        extra={"params": 7_000_000_000, "layers": 32, "kv_heads": 4,
+               "head_dim": 128, "kv_itemsize": 2},
+    )
+    cm2 = cost_model_from_card(card)
+    assert cm2.params == 7_000_000_000
+    assert cm2.page_size == 32
+    assert cm2.block_bytes == block_wire_bytes(32, 4, 32, 128, 2)
+
+    # junk extras fall back per-key instead of exploding
+    cm3 = cost_model_from_card(
+        ModelDeploymentCard(name="m", extra={"params": "lots", "layers": -1})
+    )
+    assert cm3.params == 1_000_000_000
+
+
+def test_scored_with_tiers_discounts_and_never_mutates():
+    cm = CostModel(params=10**9, block_bytes=262144, page_size=16)
+
+    class _Tiers:
+        def chain_tiers(self, iid, hashes, base):
+            return ["host", "disk"] if iid == "w1" else []
+
+        def stats(self):
+            return {}
+
+    eco = EconomyPolicy(cm, tier_map=_Tiers())
+    scores = {"w1": 2}
+    out = eco.scored_with_tiers(scores, ["w1", "w2"], [1, 2, 3, 4])
+    assert scores == {"w1": 2}  # the indexer's dict is untouched
+    assert out["w1"] == 2 + cm.tier_discount("host") + cm.tier_discount("disk")
+    assert "w2" not in out
+    # no tier map → a plain copy
+    out2 = EconomyPolicy(cm).scored_with_tiers(scores, ["w1"], [])
+    assert out2 == scores and out2 is not scores
+
+
+# ---------------------------------------------------------------------------
+# MigrationManager: admission control on an injected clock
+# ---------------------------------------------------------------------------
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_manager_single_flight_and_backoff():
+    clk = _Clock()
+    m = MigrationManager(
+        backoff_s=30.0, max_inflight=8, window_bytes=0, clock=clk
+    )
+    ok, _ = m.admit(1, "w2", 100)
+    assert ok
+    # same (prefix, dest) rides the in-flight pull
+    ok, why = m.admit(1, "w2", 100)
+    assert not ok and why == "inflight"
+    # a different destination is a separate flight
+    ok, _ = m.admit(1, "w3", 100)
+    assert ok
+    m.complete(1, "w3", ok=True, bytes_moved=100, blocks=2)
+    m.complete(1, "w2", ok=True, bytes_moved=100, blocks=2)
+    # the prefix just moved: re-moving it inside the window is a storm
+    ok, why = m.admit(1, "w4", 100)
+    assert not ok and why == "backoff"
+    assert m.storm_repeats == 1
+    clk.t += 31.0
+    ok, _ = m.admit(1, "w4", 100)
+    assert ok
+    m.complete(1, "w4", ok=True)
+    assert m.migrations_total == 3
+    assert m.bytes_total == 200 and m.blocks_total == 4
+
+
+def test_manager_failure_also_starts_backoff():
+    """Retrying a broken transfer on every request IS the storm."""
+    clk = _Clock()
+    m = MigrationManager(backoff_s=30.0, clock=clk)
+    ok, _ = m.admit(7, "w1", 10)
+    assert ok
+    m.complete(7, "w1", ok=False)
+    assert m.migrations_failed == 1
+    ok, why = m.admit(7, "w2", 10)
+    assert not ok and why == "backoff"
+
+
+def test_manager_concurrency_and_byte_budget():
+    clk = _Clock()
+    m = MigrationManager(
+        backoff_s=0.0, max_inflight=1,
+        window_bytes=1000, window_s=10.0, clock=clk,
+    )
+    ok, _ = m.admit(1, "a", 10)
+    assert ok
+    ok, why = m.admit(2, "b", 10)
+    assert not ok and why == "concurrency"
+    m.complete(1, "a", ok=True, bytes_moved=900, blocks=1)
+    # 900 of the 1000-byte window is spent
+    ok, why = m.admit(2, "b", 200)
+    assert not ok and why == "budget"
+    ok, _ = m.admit(2, "b", 50)
+    assert ok
+    m.complete(2, "b", ok=True, bytes_moved=50, blocks=1)
+    # the window rolls off with the clock
+    clk.t += 11.0
+    ok, _ = m.admit(3, "c", 1000)
+    assert ok
+    m.complete(3, "c", ok=True, bytes_moved=1000, blocks=2)
+    s = m.stats()
+    assert s["migrations_total"] == 3
+    assert s["migrations_suppressed"] == {"concurrency": 1, "budget": 1}
+    assert s["migrations_inflight"] == 0
+
+
+# ---------------------------------------------------------------------------
+# KvRouter decision layer: dummy-fabric harness (constructors are
+# fabric-free; subscriptions only happen on start(), which we never call)
+# ---------------------------------------------------------------------------
+
+
+class _Inst:
+    def __init__(self, iid, host="127.0.0.1", port=0):
+        self.instance_id = iid
+        self.host = host
+        self.port = port
+
+
+class _Source:
+    def __init__(self, instances):
+        self._instances = instances
+
+    def list(self):
+        return self._instances
+
+
+class _Fabric:
+    def __init__(self):
+        self.published = []
+
+    async def publish(self, subject, payload):
+        self.published.append((subject, payload))
+
+
+def _router(economy=None, scores=None, snapshot=None):
+    """A KvRouter over canned index/metrics views: w1 is lightly loaded
+    with a shallow prefix, w2 holds a deeper prefix but is heavily
+    loaded — the selector must pick w1, making w2 the migration
+    source."""
+    r = KvRouter(
+        _Fabric(), "backend",
+        _Source([_Inst("w1", port=7001), _Inst("w2", port=7002)]),
+        block_size=16, salt="m",
+        config=KvRouterConfig(temperature=0.0), economy=economy,
+    )
+    canned = dict(scores or {})
+    r.indexer.find_matches = lambda hashes: OverlapScores(
+        scores=dict(canned),
+        matched_blocks=max(canned.values(), default=0),
+    )
+    r.metrics.snapshot = lambda: dict(snapshot or {})
+    return r
+
+
+_SNAPSHOT = {"w2": {"kv_active_pages": 500, "kv_total_pages": 1000}}
+_SCORES = {"w1": 1, "w2": 4}
+_TOKENS = list(range(4 * 16))
+
+
+def test_router_never_migrates_below_threshold():
+    """The acceptance gate: when the shared pricing fn says bytes-moved
+    out-prices flops-saved, the router must not even consult the
+    manager — the decision is identical to the pre-economy router."""
+
+    class _Recorder(MigrationManager):
+        def __init__(self):
+            super().__init__()
+            self.admit_calls = []
+
+        def admit(self, *a, **k):
+            self.admit_calls.append(a)
+            return super().admit(*a, **k)
+
+    man = _Recorder()
+    eco = EconomyPolicy(
+        CostModel(params=1, block_bytes=10**15, page_size=16,
+                  min_flops_per_byte=1e30),
+        manager=man,
+    )
+    r = _router(economy=eco, scores=_SCORES, snapshot=_SNAPSHOT)
+    choice, overlap = run(r.find_best_match(_TOKENS))
+    assert (choice, overlap) == ("w1", 1)
+    assert man.admit_calls == []
+    assert man.stats()["migrations_total"] == 0
+
+
+def test_router_off_path_is_pre_economy_identical():
+    """economy=None: the migration hook is unreachable and the decision
+    matches the economy router's suppressed decision bit for bit."""
+    r = _router(economy=None, scores=_SCORES, snapshot=_SNAPSHOT)
+
+    async def boom(*a, **k):  # pragma: no cover - must never run
+        raise AssertionError("economy off-path reached _maybe_migrate")
+
+    r._maybe_migrate = boom
+    choice, overlap = run(r.find_best_match(_TOKENS))
+    assert (choice, overlap) == ("w1", 1)
+
+
+def test_router_migration_credits_and_failure_falls_back(monkeypatch):
+    from dynamo_tpu import handover
+
+    eco = EconomyPolicy(
+        CostModel(params=10**9, block_bytes=4096, page_size=16),
+        manager=MigrationManager(backoff_s=0.0),
+    )
+    r = _router(economy=eco, scores=_SCORES, snapshot=_SNAPSHOT)
+    calls = []
+
+    async def fake_call(host, port, op, payload, **kw):
+        calls.append((host, port, op, payload))
+        return {"migrated": True, "blocks": 3, "bytes": 3 * 4096}
+
+    monkeypatch.setattr(handover, "call_ingress", fake_call)
+    choice, overlap = run(r.find_best_match(_TOKENS))
+    # the request admits warm at the source's depth on the chosen worker
+    assert (choice, overlap) == ("w1", 4)
+    (host, port, op, payload), = calls
+    assert (port, op) == (7002, "migrate_prefix")  # asked the deep holder
+    hashes = hash_token_blocks(_TOKENS, block_size=16, salt="m")
+    # only the missing chain moves: past w1's overlap, up to w2's depth
+    assert payload["hashes"] == [int(h) for h in hashes[1:4]]
+    assert payload["dest"]["instance_id"] == "w1"
+    assert payload["dest"]["port"] == 7001
+    assert eco.manager.migrations_total == 1
+    assert eco.manager.blocks_total == 3
+    assert eco.manager.bytes_total == 3 * 4096
+
+    async def dead_call(host, port, op, payload, **kw):
+        raise ConnectionError("transfer plane down")
+
+    monkeypatch.setattr(handover, "call_ingress", dead_call)
+    choice, overlap = run(r.find_best_match(_TOKENS))
+    # failure → the unmodified overlap: the request cold-prefills
+    assert (choice, overlap) == ("w1", 1)
+    assert eco.manager.migrations_failed == 1
+
+
+# ---------------------------------------------------------------------------
+# Fleet proof: multi-turn chat over the mocker fleet sim
+# ---------------------------------------------------------------------------
+
+#: a deterministic 6-page chat session; turn 1 sends the first 4 pages,
+#: turn 2 re-sends the full history (the multi-turn chat shape)
+_SESSION = [((i * 37) % 199) + 1 for i in range(6 * PAGE_SIZE)]
+
+
+async def _find_holder(sim, prefix, deadline=15.0):
+    """Poll the router's index until some worker advertises the whole
+    prefix; returns its instance_id."""
+    hashes = hash_token_blocks(prefix, block_size=PAGE_SIZE, salt=MODEL)
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < deadline:
+        ov = sim.kv_router.indexer.find_matches(hashes)
+        if ov.scores and max(ov.scores.values()) >= len(hashes):
+            return max(ov.scores, key=lambda w: (ov.scores[w], w))
+        await asyncio.sleep(0.05)
+    raise AssertionError("turn-1 prefix never appeared in the KV index")
+
+
+async def _settled_free(w, deadline=5.0):
+    """The worker's free-page count once the engine thread has finished
+    releasing stream pages (stable across a few polls)."""
+    last, stable = None, 0
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < deadline:
+        cur = w.mock.allocator.num_free
+        stable = stable + 1 if cur == last else 0
+        last = cur
+        if stable >= 3:
+            return cur
+        await asyncio.sleep(0.05)
+    return last
+
+
+async def _chat_scenario(n_workers, fault_point=None, sim_kw=None):
+    """Turn 1 warms one worker; the router is pinned off it; turn 2 must
+    migrate the hot prefix to the fresh worker (or, under an injected
+    fault, degrade to a cold prefill with no leaked pages)."""
+    sim = FleetSim(
+        decode_s_per_step=0.005, prefill_tokens_per_step=32,
+        **(sim_kw or {}),
+    )
+    eco = EconomyPolicy(
+        CostModel(params=10**9, block_bytes=4096, page_size=PAGE_SIZE),
+        manager=MigrationManager(backoff_s=0.0),
+    )
+    inj = None
+    try:
+        await sim.start(router="kv", economy=eco)
+        for _ in range(n_workers):
+            await sim.add_worker()
+
+        turn1 = _SESSION[: 4 * PAGE_SIZE]
+        tokens, finish, _ = await sim.one(prompt=turn1, osl=4)
+        assert finish in ("length", "stop")
+        holder = await _find_holder(sim, turn1)
+        baseline_free = {
+            w.instance_id: await _settled_free(w) for w in sim.workers
+        }
+
+        if fault_point is not None:
+            inj = faults.install(seed=7)
+            inj.add_rule(fault_point, "error")
+
+        # force the selector off the warm worker: a fat router-local
+        # footprint makes every other worker cheaper
+        sim.kv_router.active.add(holder, "pin-holder", 400)
+        try:
+            tokens, finish, _ = await sim.one(prompt=_SESSION, osl=4)
+        finally:
+            sim.kv_router.active.free("pin-holder")
+        assert finish in ("length", "stop")
+        assert sim.stats.dropped == 0
+
+        src = next(w for w in sim.workers if w.instance_id == holder)
+        dests = [w for w in sim.workers if w.instance_id != holder]
+        if fault_point is None:
+            # the hot prefix moved and turn 2 admitted warm elsewhere
+            assert src.migrations >= 1
+            assert eco.manager.migrations_total >= 1
+            assert eco.manager.blocks_total >= 2
+            assert any(
+                w.mock.allocator.stats.hit_tokens > 0 for w in dests
+            ), "turn 2 never hit the migrated prefix cross-worker"
+            # deterministic TTFT claim: the migrated continuation skips
+            # prefill chunks the cold path must run
+            ratio = CostModel.modeled_ttft_ratio(
+                len(_SESSION),
+                eco.manager.blocks_total * PAGE_SIZE,
+                sim.prefill_tokens_per_step,
+            )
+            assert ratio < 1.0
+        else:
+            # mid-migration fault: the stream completed COLD, the
+            # failure was counted, and nothing adopted
+            assert inj.fired.get((fault_point, "error"), 0) >= 1
+            assert src.migration_fallbacks >= 1
+            assert eco.manager.migrations_failed >= 1
+            assert all(
+                w.mock.allocator.stats.hit_tokens == 0 for w in dests
+            ), "a faulted migration must not leave adopted blocks"
+            # both sides' pages are back in the free pool
+            for w in sim.workers:
+                free = await _settled_free(w)
+                assert free == baseline_free[w.instance_id], (
+                    f"{w.instance_id} leaked pages: "
+                    f"{baseline_free[w.instance_id]} -> {free}"
+                )
+    finally:
+        if inj is not None:
+            faults.uninstall()
+        await sim.stop()
+
+
+def test_fleet_chat_migration_warms_cross_worker():
+    run(_chat_scenario(n_workers=2))
+
+
+def test_fleet_chat_migration_fault_degrades_to_cold():
+    run(_chat_scenario(n_workers=2, fault_point="migrate.transfer"))
+
+
+@pytest.mark.slow
+def test_fleet_chat_migration_500_workers():
+    run(_chat_scenario(
+        n_workers=500,
+        sim_kw=dict(metrics_interval=2.0, num_pages=64),
+    ))

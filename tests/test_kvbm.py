@@ -276,3 +276,136 @@ def test_async_offload_staging_and_inflight_lookup():
     n = alloc.flush_offloads()
     assert n == 2 and 102 in alloc.host and 103 in alloc.host
     assert alloc.stats.offloaded_blocks >= 2
+
+
+# -- demote/promote round-trip property -------------------------------------
+
+
+def _wire_block(rng, shape, fmt):
+    """One random KV page in a canonical wire format. `int8-wire` is the
+    kv_quantize=int8 layout: [..., D+4] int8 with each row's f32 scale
+    packed bit-for-bit into the 4 trailing lanes — the bytes a real
+    quantized pool extracts (engine.extract_pages)."""
+    if fmt == "int8-wire":
+        mantissa = rng.integers(-128, 128, size=shape, dtype=np.int8)
+        scales = (
+            rng.random(size=shape[:-1] + (1,), dtype=np.float32)
+            .view(np.int8).reshape(shape[:-1] + (4,))
+        )
+        return np.concatenate([mantissa, scales], axis=-1)
+    if fmt == "bfloat16":
+        import ml_dtypes
+
+        return rng.standard_normal(size=shape, dtype=np.float32).astype(
+            ml_dtypes.bfloat16
+        )
+    return rng.standard_normal(size=shape, dtype=np.float32)
+
+
+def test_demote_promote_round_trip_property(tmp_path):
+    """Property (ISSUE 18): for ANY random block geometry (asymmetric
+    MLA-style k/v widths included), wire format (int8+packed-scale
+    lanes, bfloat16, float32), host-tier budget (none / tight / ample),
+    and demotion batch size, the write-back path
+    `TieredPageAllocator.demote()` → host → disk → `lookup()` onboard
+    returns byte-identical KV — and every demotion write that reaches
+    disk carries the 8-byte xxh3 at-rest trailer (the PR 12 integrity
+    format), verified against the raw .npy bytes."""
+    import xxhash
+
+    rng = np.random.default_rng(1234)
+    saw_host_hit = saw_disk_hit = False
+    for trial in range(8):
+        fmt = ("int8-wire", "bfloat16", "float32")[trial % 3]
+        L = int(rng.integers(1, 4))
+        hkv = int(rng.integers(1, 3))
+        page = int(rng.integers(2, 6))
+        dk = int(rng.integers(4, 17))
+        dv = dk if trial % 2 == 0 else int(rng.integers(4, 17))
+        n_blocks = int(rng.integers(3, 7))
+
+        store: dict[int, tuple[np.ndarray, np.ndarray]] = {}
+
+        def extract(page_ids):
+            k = np.stack([store[p][0] for p in page_ids], axis=2)
+            v = np.stack([store[p][1] for p in page_ids], axis=2)
+            return k, v
+
+        injected: list[tuple[list, np.ndarray, np.ndarray]] = []
+
+        def inject(page_ids, k, v):
+            injected.append((list(page_ids), k.copy(), v.copy()))
+
+        probe = _wire_block(rng, (L, hkv, page, dk), fmt)
+        block_bytes = probe.nbytes + _wire_block(
+            rng, (L, hkv, page, dv), fmt
+        ).nbytes
+        # host budget: 0 = demote straight to disk; tight = overflow
+        # chains the LRU tail down; ample = disk stays empty
+        host_blocks = (0, 2, n_blocks + 1)[trial % 3]
+        # +3: page 0 is the pool's reserved sentinel, +2 spare slots
+        alloc = TieredPageAllocator(
+            n_blocks + 3, page, extract_fn=extract, inject_fn=inject,
+            host_bytes=host_blocks * block_bytes,
+            disk_bytes=1 << 24, disk_dir=str(tmp_path / f"t{trial}"),
+        )
+
+        pages = alloc.allocate(n_blocks)
+        hashes = [trial * 1000 + j for j in range(n_blocks)]
+        for j, p in enumerate(pages):
+            store[p] = (
+                _wire_block(rng, (L, hkv, page, dk), fmt),
+                _wire_block(rng, (L, hkv, page, dv), fmt),
+            )
+            alloc.register(
+                p, seq_hash=hashes[j],
+                parent_hash=hashes[j - 1] if j else None,
+                tokens=tuple(range(j * page, (j + 1) * page)),
+            )
+        alloc.free(pages)
+
+        # write-back demotion: every registered block lands in a tier,
+        # the device copies stay registered (still free prefix hits)
+        assert alloc.demote(n_blocks) == n_blocks
+        assert alloc.stats.offloaded_blocks == n_blocks
+        assert alloc.match_length(hashes) == n_blocks
+        occ = alloc.tier_occupancy()
+        assert occ["host"] + occ["disk"] == n_blocks
+
+        # every block file the demotion wrote to disk carries the xxh3
+        # trailer over exactly its payload bytes
+        if alloc.disk is not None:
+            for h, meta in alloc.disk._index.items():
+                raw = np.load(alloc.disk._path(h))
+                nbytes = meta[2]
+                assert len(raw) == nbytes + 8
+                assert (
+                    raw[nbytes:].tobytes()
+                    == xxhash.xxh3_64_digest(raw[:nbytes].tobytes())
+                )
+
+        # churn the device copies out (their eviction is free — the
+        # bytes are already tier-resident), then promote everything back
+        alloc.free(alloc.allocate(n_blocks + 2))
+        assert alloc.match_length(hashes) == 0
+        got = alloc.lookup(hashes)
+        assert len(got) == n_blocks
+        assert alloc.stats.onboarded_blocks == n_blocks
+
+        # byte-exact round trip, compared as raw bytes so NaN payloads
+        # and packed scale lanes can't hide behind float semantics
+        (ids, k_in, v_in), = injected
+        assert k_in.shape == (L, hkv, n_blocks, page, probe.shape[-1])
+        for j, p in enumerate(pages):
+            np.testing.assert_array_equal(
+                np.ascontiguousarray(k_in[:, :, j]).view(np.uint8),
+                store[p][0].view(np.uint8),
+            )
+            np.testing.assert_array_equal(
+                np.ascontiguousarray(v_in[:, :, j]).view(np.uint8),
+                store[p][1].view(np.uint8),
+            )
+        saw_host_hit |= alloc.tier_hits["host"] > 0
+        saw_disk_hit |= alloc.tier_hits["disk"] > 0
+    # the trial grid genuinely exercised BOTH promotion sources
+    assert saw_host_hit and saw_disk_hit
