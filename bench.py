@@ -63,6 +63,27 @@ def probe_backend(retries: int = 5, timeout_s: int = 120) -> str:
 
 def emit(obj: dict) -> None:
     print(json.dumps(obj), flush=True)
+    _ledger_append(obj)
+
+
+def _ledger_append(payload: dict) -> None:
+    """Append this emission to artifacts/perf_ledger.jsonl (the
+    perf-regression ledger — scripts/perf_diff.py diffs rounds from
+    it). Best-effort: a ledger problem must never fail the bench run
+    itself. DYNTPU_ROUND names the row's round (driver rounds export
+    it); DYNTPU_PERF_LEDGER overrides the path, empty string disables."""
+    path = os.environ.get("DYNTPU_PERF_LEDGER")
+    if path == "":
+        return
+    try:
+        from dynamo_tpu.telemetry import perf_ledger
+
+        row = perf_ledger.row_from_bench(
+            payload, os.environ.get("DYNTPU_ROUND", "adhoc")
+        )
+        perf_ledger.append_row(row, path or perf_ledger.DEFAULT_LEDGER)
+    except Exception as e:
+        print(f"bench: perf_ledger append failed: {e}", file=sys.stderr)
 
 
 def _make_echo_driver(num_requests: int, tokens: int):

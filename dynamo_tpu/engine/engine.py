@@ -215,6 +215,27 @@ class EngineMetrics:
     #: requests error-finished because their end-to-end deadline passed
     #: (pre-admission drops + mid-decode expiries)
     deadline_expired: int = 0
+    #: HBM accounting plane (GET /v1/debug/memory — docs/observability.md
+    #: "Reading the perf plane"): byte rollups summed over this process's
+    #: addressable devices. weights = the param trees' shard bytes,
+    #: kv_pool = the paged KV pool (mirrors kv_pool_bytes but lives in
+    #: the hbm_* family the plane exposes), scratch = the largest
+    #: compiled program's cost_analysis bytes beyond resident weights+KV
+    #: (a transient-buffer ESTIMATE, documented in memory_report), free/
+    #: peak from jax device memory_stats on TPU with the accounted CPU
+    #: fallback. Refreshed by refresh_memory_metrics() on the publish
+    #: cadence — the token path never touches them.
+    hbm_weights_bytes: int = 0
+    hbm_kv_pool_bytes: int = 0
+    hbm_scratch_bytes: int = 0
+    hbm_free_bytes: int = 0
+    hbm_peak_bytes: int = 0
+    #: mesh introspection plane (GET /v1/debug/mesh): this replica's
+    #: process index under multi-host SPMD (0 single-host) and the
+    #: recent-window decode dispatch p95 — the per-host straggler gauge
+    #: the doctor's host-skew rule compares across hosts
+    host: int = 0
+    dispatch_p95_ms: float = 0.0
 
     #: the timing plane's field names — the one list consumers (perf
     #: harness, dashboards) should iterate instead of restating
@@ -598,6 +619,16 @@ class JaxEngine:
             (kv.k.size + kv.v.size) * model_itemsize
         )
         m.kv_free_pages = self.allocator.num_free
+        # HBM accounting plane (GET /v1/debug/memory): the param trees
+        # never change after construction, so their per-device shard
+        # bytes and per-sharding-spec grouping are computed once here;
+        # memory_report() joins them with the live KV pool / program
+        # scratch / device memory_stats on every call.
+        self._weights_by_device = self._per_device_bytes(
+            (self.params, self.draft_params)
+        )
+        self._param_groups = self._param_group_specs()
+        self.refresh_memory_metrics()
         if self.mesh is not None:
             from jax.sharding import NamedSharding
 
@@ -4176,6 +4207,217 @@ class JaxEngine:
     def programs_wire(self) -> dict:
         """The compact per-kind rollup that rides the metrics frame."""
         return self.programs_report()["kinds"]
+
+    # -- HBM accounting & mesh introspection (GET /v1/debug/{memory,
+    # mesh} — docs/observability.md "Reading the perf plane"). All
+    # host-side, publish-cadence work: the token path never runs any of
+    # it, and with collection enabled the emitted tokens are
+    # bit-identical (pinned in tests/test_perf_plane.py). ---------------
+
+    @staticmethod
+    def _device_key(dev) -> str:
+        """Stable per-device label: the jax device id (the `device`
+        label of the dynamo_tpu_hbm_* families)."""
+        return str(getattr(dev, "id", 0))
+
+    def _per_device_bytes(self, tree) -> dict[str, int]:
+        """Bytes each addressable device holds of `tree`: sharded
+        jax.Arrays contribute their LOCAL shard bytes to the device each
+        shard lives on (so a tp=4 weight counts a quarter per chip);
+        host-resident leaves (numpy, before any device_put) are
+        attributed to device 0, where the first dispatch places them."""
+        out: dict[str, int] = {}
+        default = self._device_key(jax.devices()[0])
+        for x in jax.tree.leaves(tree):
+            shards = getattr(x, "addressable_shards", None)
+            if shards:
+                for s in shards:
+                    k = self._device_key(s.device)
+                    out[k] = out.get(k, 0) + int(s.data.nbytes)
+            else:
+                out[default] = (
+                    out.get(default, 0) + int(getattr(x, "nbytes", 0))
+                )
+        return out
+
+    def _param_group_specs(self) -> dict:
+        """Per-sharding-spec param grouping for /v1/debug/mesh:
+        spec-string -> {params, bytes}. Meshless engines group
+        everything under "replicated"."""
+        groups: dict[str, dict] = {}
+        for x in jax.tree.leaves(self.params):
+            spec = getattr(getattr(x, "sharding", None), "spec", None)
+            key = str(spec) if spec is not None else "replicated"
+            g = groups.setdefault(key, {"params": 0, "bytes": 0})
+            g["params"] += 1
+            g["bytes"] += int(getattr(x, "nbytes", 0))
+        return groups
+
+    def memory_report(self) -> dict:
+        """GET /v1/debug/memory: per-device HBM byte breakdown.
+
+        Accounted components: `weights` (param-tree shard bytes, cached
+        at construction — they never change), `kv_pool` (paged KV +
+        draft KV incl. quantization scale planes), `scratch` — an
+        ESTIMATE: the hungriest compiled program's cost_analysis bytes
+        accessed beyond the resident weights+KV it streams (the
+        transient-buffer proxy PR 7's cost capture affords; XLA exposes
+        no true temp-allocation number pre-execution), split evenly
+        across local devices. live/free/peak come from jax device
+        `memory_stats()` where the backend provides them (TPU); the
+        documented CPU fallback is pure accounting — live =
+        weights+kv+scratch, free = platform.device_hbm_bytes() − live
+        (the shared per-generation table, same sourcing as the program
+        cost model's peaks), peak = live. `source` names which path
+        produced the live numbers."""
+        from dynamo_tpu.platform import device_hbm_bytes
+
+        kv_by_dev = self._per_device_bytes((self.kv, self.draft_kv))
+        weights = self._weights_by_device
+        total_w = sum(weights.values())
+        total_kv = sum(kv_by_dev.values())
+        prog_bytes = [
+            p["bytes"] for p in list(self.programs.values())
+            if p.get("bytes")
+        ]
+        scratch_total = max(
+            0, int(max(prog_bytes, default=0)) - total_w - total_kv
+        )
+        devs = jax.local_devices()
+        scratch_each = scratch_total // max(1, len(devs))
+        limit_nominal = int(device_hbm_bytes())
+        devices: dict[str, dict] = {}
+        source = "accounted"
+        for d in devs:
+            key = self._device_key(d)
+            w = int(weights.get(key, 0))
+            kvb = int(kv_by_dev.get(key, 0))
+            row = {
+                "kind": str(getattr(d, "device_kind", "cpu")),
+                "weights_bytes": w,
+                "kv_pool_bytes": kvb,
+                "scratch_bytes": scratch_each,
+            }
+            try:
+                stats = d.memory_stats()
+            except Exception:
+                stats = None
+            if stats and stats.get("bytes_in_use") is not None:
+                source = "memory_stats"
+                live = int(stats.get("bytes_in_use") or 0)
+                limit = int(stats.get("bytes_limit") or limit_nominal)
+                row["live_bytes"] = live
+                row["limit_bytes"] = limit
+                row["free_bytes"] = max(0, limit - live)
+                row["peak_bytes"] = int(
+                    stats.get("peak_bytes_in_use") or live
+                )
+            else:
+                live = w + kvb + scratch_each
+                row["live_bytes"] = live
+                row["limit_bytes"] = limit_nominal
+                row["free_bytes"] = max(0, limit_nominal - live)
+                row["peak_bytes"] = live
+            devices[key] = row
+        totals = {
+            f: sum(r[f] for r in devices.values())
+            for f in (
+                "weights_bytes", "kv_pool_bytes", "scratch_bytes",
+                "live_bytes", "free_bytes", "peak_bytes",
+            )
+        }
+        return {"source": source, "devices": devices, "totals": totals}
+
+    def refresh_memory_metrics(self) -> dict:
+        """Fold memory_report totals into the EngineMetrics hbm_*
+        gauges plus the host/dispatch straggler fields (the worker's
+        publish loop calls this once per frame). Returns the full
+        report so a caller wanting both doesn't pay twice."""
+        rep = self.memory_report()
+        t = rep["totals"]
+        m = self.metrics
+        m.hbm_weights_bytes = t["weights_bytes"]
+        m.hbm_kv_pool_bytes = t["kv_pool_bytes"]
+        m.hbm_scratch_bytes = t["scratch_bytes"]
+        m.hbm_free_bytes = t["free_bytes"]
+        m.hbm_peak_bytes = t["peak_bytes"]
+        try:
+            m.host = int(jax.process_index())
+        except Exception:
+            m.host = 0
+        m.dispatch_p95_ms = float(
+            self.dispatch_stats().get("p95_ms") or 0.0
+        )
+        return rep
+
+    #: flight-record kinds whose step wall time counts as a decode
+    #: dispatch for the straggler gauge
+    _DISPATCH_KINDS = ("decode", "decode_multi", "decode_kstep", "mixed")
+
+    def dispatch_stats(self) -> dict:
+        """Recent-window decode dispatch wall-time stats (the per-host
+        half of the host-skew gauge, /v1/debug/mesh): p50/p95/mean over
+        the flight ring's decode-ish records. With the recorder off,
+        the lifetime mean from the cumulative counters stands in for
+        every quantile — no window exists to rank."""
+        if self.flight is not None:
+            vals = sorted(
+                float(r.get("step_ms") or 0.0)
+                for r in self.flight.snapshot(None)
+                if r.get("kind") in self._DISPATCH_KINDS
+            )
+            if vals:
+                def q(p: float) -> float:
+                    return round(
+                        vals[min(len(vals) - 1, int(p * len(vals)))], 3
+                    )
+
+                return {
+                    "n": len(vals),
+                    "p50_ms": q(0.50),
+                    "p95_ms": q(0.95),
+                    "mean_ms": round(sum(vals) / len(vals), 3),
+                }
+        m = self.metrics
+        disp = m.decode_dispatches + m.mixed_dispatches + m.kstep_windows
+        total = m.time_decode_ms + m.time_mixed_ms + m.time_kstep_ms
+        mean = round(total / disp, 3) if disp else None
+        return {"n": disp, "p50_ms": mean, "p95_ms": mean, "mean_ms": mean}
+
+    def mesh_report(self) -> dict:
+        """GET /v1/debug/mesh: what the SPMD layer actually built —
+        mesh shape + axis names, the per-sharding-spec param grouping,
+        the KV pool's sharding, this replica's process seat, and the
+        recent decode dispatch window (the metrics service compares the
+        latter ACROSS hosts into the fleet's host-skew view)."""
+        mesh_doc = None
+        if self.mesh is not None:
+            mesh_doc = {
+                "axis_names": [str(a) for a in self.mesh.axis_names],
+                "shape": {
+                    str(k): int(v) for k, v in self.mesh.shape.items()
+                },
+                "devices": int(self.mesh.devices.size),
+            }
+        try:
+            pi, pc = int(jax.process_index()), int(jax.process_count())
+        except Exception:
+            pi, pc = 0, 1
+        kv_spec = getattr(
+            getattr(getattr(self.kv, "k", None), "sharding", None),
+            "spec", None,
+        )
+        return {
+            "mesh": mesh_doc,
+            "multiprocess": bool(self._multiproc),
+            "process_index": pi,
+            "process_count": pc,
+            "param_groups": self._param_groups,
+            "kv_sharding": (
+                str(kv_spec) if kv_spec is not None else "replicated"
+            ),
+            "dispatch": self.dispatch_stats(),
+        }
 
     def request_profile(self, steps: int, outdir: Optional[str] = None) -> dict:
         """Arm a jax.profiler capture for `steps` engine steps (POST

@@ -137,6 +137,8 @@ class HttpService:
                 web.get("/v1/traces/{trace_id}", self.trace_get),
                 web.get("/v1/debug/flight", self.debug_flight),
                 web.get("/v1/debug/programs", self.debug_programs),
+                web.get("/v1/debug/memory", self.debug_memory),
+                web.get("/v1/debug/mesh", self.debug_mesh),
                 web.get("/v1/debug/stalls", self.debug_stalls),
                 web.post("/v1/debug/profile", self.debug_profile),
                 web.post("/v1/admin/drain", self.admin_drain),
@@ -222,6 +224,18 @@ class HttpService:
         from dynamo_tpu.telemetry.debug import programs_payload
 
         body, status = programs_payload()
+        return web.json_response(body, status=status)
+
+    async def debug_memory(self, request: web.Request) -> web.Response:
+        from dynamo_tpu.telemetry.debug import memory_payload
+
+        body, status = memory_payload()
+        return web.json_response(body, status=status)
+
+    async def debug_mesh(self, request: web.Request) -> web.Response:
+        from dynamo_tpu.telemetry.debug import mesh_payload
+
+        body, status = mesh_payload()
         return web.json_response(body, status=status)
 
     async def debug_stalls(self, request: web.Request) -> web.Response:

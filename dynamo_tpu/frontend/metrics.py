@@ -233,6 +233,10 @@ class FrontendMetrics:
         # KV-aware router lives in this process in single-process
         # serving — docs/operations.md "KV index consistency"
         lines.extend(_debug.kv_index_lines())
+        # HBM accounting plane (docs/observability.md "Reading the perf
+        # plane"): per-device weights/kv_pool/scratch/free/peak bytes of
+        # the in-process engines
+        lines.extend(_debug.hbm_lines())
         text = "\n".join(lines) + "\n"
         if openmetrics:
             from dynamo_tpu.telemetry.openmetrics import to_openmetrics

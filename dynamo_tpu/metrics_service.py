@@ -167,6 +167,23 @@ _WORKER_FIELDS = (
     ("kvbm_promotions_total", "counter"),
     ("kvbm_host_hits_total", "counter"),
     ("kvbm_disk_hits_total", "counter"),
+    # HBM accounting plane (docs/observability.md "Reading the perf
+    # plane"): per-worker byte totals summed over the worker's local
+    # devices — weights (param-tree shards), KV pool, compiled-program
+    # scratch estimate, free and peak. On CPU the engine falls back to
+    # accounted sums (source="accounted" in the /v1/debug/memory doc);
+    # the per-device split rides the frames' "memory" report
+    ("hbm_weights_bytes", "gauge"),
+    ("hbm_kv_pool_bytes", "gauge"),
+    ("hbm_scratch_bytes", "gauge"),
+    ("hbm_free_bytes", "gauge"),
+    ("hbm_peak_bytes", "gauge"),
+    # multi-host SPMD introspection: jax.process_index() of the worker
+    # plus its flight-window dispatch p95 — the fleet host-skew family
+    # (dynamo_tpu_fleet_host_dispatch_p95_ms{host}) and the doctor's
+    # host-skew rule are derived from these two
+    ("host", "gauge"),
+    ("dispatch_p95_ms", "gauge"),
 )
 
 #: numeric per-worker fields copied verbatim into the /v1/fleet snapshot
@@ -189,6 +206,8 @@ _FLEET_WORKER_FIELDS = (
     "kvbm_host_blocks", "kvbm_disk_blocks", "kvbm_demotions_total",
     "kvbm_promotions_total", "kvbm_host_hits_total",
     "kvbm_disk_hits_total",
+    "hbm_weights_bytes", "hbm_kv_pool_bytes", "hbm_scratch_bytes",
+    "hbm_free_bytes", "hbm_peak_bytes", "host", "dispatch_p95_ms",
 )
 
 
@@ -340,6 +359,8 @@ class MetricsService:
         app.router.add_get("/v1/traces/{trace_id}", self._trace)
         app.router.add_get("/v1/debug/flight", self._debug_flight)
         app.router.add_get("/v1/debug/programs", self._debug_programs)
+        app.router.add_get("/v1/debug/memory", self._debug_memory)
+        app.router.add_get("/v1/debug/mesh", self._debug_mesh)
         app.router.add_post("/v1/debug/profile", self._debug_profile)
         self._runner = web.AppRunner(app)
         await self._runner.setup()
@@ -1279,6 +1300,29 @@ class MetricsService:
         # no router; the per-component fleet view is
         # dynamo_tpu_router_kv_index_* above) — both-surfaces contract
         lines += _debug.kv_index_lines(PREFIX)
+        # process-global HBM accounting (zeros here — no engine in this
+        # process; the per-worker fleet view is the
+        # dynamo_tpu_worker_hbm_* families above) — both-surfaces
+        # contract
+        lines += _debug.hbm_lines(PREFIX)
+        # host-skew straggler gauge: per-host max of the workers'
+        # flight-window dispatch p95, grouped by the frames' `host`
+        # (jax.process_index()). Under lockstep SPMD one slow host drags
+        # every dispatch — this family makes WHICH host visible. The
+        # zeroed {host="0"} default keeps the family present for the
+        # Grafana panel-vs-emitted-names gate
+        skew: dict[str, float] = {}
+        for _, (m, _, _) in sorted(snap3.items()):
+            p95 = m.get("dispatch_p95_ms")
+            if not isinstance(p95, (int, float)):
+                continue
+            h = str(int(m.get("host", 0) or 0))
+            skew[h] = max(skew.get(h, 0.0), float(p95))
+        lines.append(f"# TYPE {PREFIX}_fleet_host_dispatch_p95_ms gauge")
+        for h, v in sorted(skew.items()) or [("0", 0.0)]:
+            lines.append(
+                f'{PREFIX}_fleet_host_dispatch_p95_ms{{host="{h}"}} {v}'
+            )
         # per-phase latency histograms (telemetry plane, process-global)
         from dynamo_tpu.telemetry import phases
 
@@ -1430,6 +1474,39 @@ class MetricsService:
                 "component": comp,
                 "last_seen_s": round(age, 3),
                 "kinds": pk,
+            }
+        return web.json_response({"workers": workers})
+
+    async def _debug_memory(self, request: web.Request) -> web.Response:
+        """Fleet-wide HBM accounting: each worker's per-device
+        weights/kv_pool/scratch/free/peak byte breakdown, as published
+        in its frames (engine.memory_report())."""
+        workers = {}
+        for iid, (m, age, comp) in sorted(self._snapshot_all().items()):
+            mem = m.get("memory")
+            if not isinstance(mem, dict):
+                continue
+            workers[iid] = {
+                "component": comp,
+                "last_seen_s": round(age, 3),
+                **mem,
+            }
+        return web.json_response({"workers": workers})
+
+    async def _debug_mesh(self, request: web.Request) -> web.Response:
+        """Fleet-wide mesh/sharding introspection: each worker's mesh
+        shape, per-param-group sharding specs, process_index and
+        dispatch timing, as published in its frames
+        (engine.mesh_report())."""
+        workers = {}
+        for iid, (m, age, comp) in sorted(self._snapshot_all().items()):
+            mesh = m.get("mesh")
+            if not isinstance(mesh, dict):
+                continue
+            workers[iid] = {
+                "component": comp,
+                "last_seen_s": round(age, 3),
+                **mesh,
             }
         return web.json_response({"workers": workers})
 

@@ -97,6 +97,56 @@ def enable_persistent_compile_cache() -> None:
         pass
 
 
+#: per-generation public chip numbers, ONE table for every consumer:
+#: device_kind substring tag -> (bf16 peak FLOP/s, peak HBM bytes/s,
+#: HBM capacity bytes per chip). The MFU gauge, the program cost model
+#: (/v1/debug/programs) and the HBM accounting plane (/v1/debug/memory)
+#: all resolve through _device_peaks() so their denominators can never
+#: disagree (they used to live as two drifting copies below). Order
+#: matters: longer/more-specific tags first ("v5e" before "v5lite"
+#: would both miss "v5 lite" after the space strip — keep both).
+_TPU_GENERATIONS = (
+    ("v6e", (918e12, 1640e9, 32e9)),
+    ("v6", (918e12, 1640e9, 32e9)),
+    ("v5p", (459e12, 2765e9, 95e9)),
+    ("v5e", (197e12, 819e9, 16e9)),
+    ("v5lite", (197e12, 819e9, 16e9)),
+    ("v4", (275e12, 1228e9, 32e9)),
+)
+
+#: column indexes into the _TPU_GENERATIONS rows + their env overrides
+#: and nominal CPU-dev fallbacks (documented in each public accessor)
+_PEAK_COLUMNS = {
+    "flops": (0, "DYNTPU_PEAK_FLOPS", 1e12),
+    "bytes_per_s": (1, "DYNTPU_PEAK_BYTES", 1e11),
+    "hbm_bytes": (2, "DYNTPU_HBM_BYTES", 16e9),
+}
+
+
+def _device_peaks(column: str) -> float:
+    """Resolve one peak column for the attached accelerator: the TPU
+    generation table on TPU, the column's env override elsewhere, else
+    its nominal CPU-dev fallback."""
+    idx, env_var, nominal = _PEAK_COLUMNS[column]
+    import jax
+
+    try:
+        if jax.default_backend() == "tpu":
+            kind = jax.devices()[0].device_kind.lower().replace(" ", "")
+            for tag, peaks in _TPU_GENERATIONS:
+                if tag in kind:
+                    return peaks[idx]
+    except Exception:
+        pass
+    try:
+        env = float(os.environ.get(env_var, "") or 0.0)
+        if env > 0:
+            return env
+    except ValueError:
+        pass
+    return nominal
+
+
 def device_peak_flops() -> float:
     """Per-chip peak FLOP/s for the attached accelerator — the
     denominator of the live MFU gauge (docs/PERF.md "Live MFU gauge").
@@ -104,26 +154,7 @@ def device_peak_flops() -> float:
     fallback comes from DYNTPU_PEAK_FLOPS (else a nominal 1e12 so the
     gauge stays a plausible (0,1] number on CPU dev boxes instead of
     vanishing)."""
-    import jax
-
-    try:
-        if jax.default_backend() == "tpu":
-            kind = jax.devices()[0].device_kind.lower().replace(" ", "")
-            for tag, peak in (
-                ("v6e", 918e12), ("v6", 918e12), ("v5p", 459e12),
-                ("v5e", 197e12), ("v5lite", 197e12), ("v4", 275e12),
-            ):
-                if tag in kind:
-                    return peak
-    except Exception:
-        pass
-    try:
-        env = float(os.environ.get("DYNTPU_PEAK_FLOPS", "") or 0.0)
-        if env > 0:
-            return env
-    except ValueError:
-        pass
-    return 1e12
+    return _device_peaks("flops")
 
 
 def device_peak_bytes_per_s() -> float:
@@ -132,23 +163,14 @@ def device_peak_bytes_per_s() -> float:
     generations resolve to their public HBM numbers; off-TPU the
     fallback comes from DYNTPU_PEAK_BYTES (else a nominal 1e11 so
     attainment stays a plausible fraction on CPU dev boxes)."""
-    import jax
+    return _device_peaks("bytes_per_s")
 
-    try:
-        if jax.default_backend() == "tpu":
-            kind = jax.devices()[0].device_kind.lower().replace(" ", "")
-            for tag, peak in (
-                ("v6e", 1640e9), ("v6", 1640e9), ("v5p", 2765e9),
-                ("v5e", 819e9), ("v5lite", 819e9), ("v4", 1228e9),
-            ):
-                if tag in kind:
-                    return peak
-    except Exception:
-        pass
-    try:
-        env = float(os.environ.get("DYNTPU_PEAK_BYTES", "") or 0.0)
-        if env > 0:
-            return env
-    except ValueError:
-        pass
-    return 1e11
+
+def device_hbm_bytes() -> float:
+    """Per-chip HBM capacity — the `free` denominator of the HBM
+    accounting plane (engine.memory_report / GET /v1/debug/memory) when
+    the backend exposes no memory_stats (the documented CPU fallback).
+    TPU generations resolve to their public capacities; off-TPU the
+    fallback comes from DYNTPU_HBM_BYTES (else a nominal 16e9, the v5e
+    capacity, so free/peak stay plausible on CPU dev boxes)."""
+    return _device_peaks("hbm_bytes")

@@ -9,6 +9,14 @@ serve:
   GET  /v1/debug/programs      per-program cost-model attainment
                                (compile cost, cost_analysis flops/bytes,
                                measured ms/dispatch vs roofline)
+  GET  /v1/debug/memory        per-device HBM byte breakdown (weights /
+                               kv_pool / scratch / live / free / peak —
+                               engine.memory_report, docs/
+                               observability.md "Reading the perf
+                               plane")
+  GET  /v1/debug/mesh          mesh shape + axis names, per-param-group
+                               sharding specs, process seat, dispatch
+                               window (engine.mesh_report)
   GET  /v1/debug/stalls        watchdog counters + recent diagnoses
   POST /v1/debug/profile       {"steps": K[, "dir": path]} — arm a
                                jax.profiler capture for K engine steps
@@ -234,6 +242,46 @@ def kv_index_lines(prefix: str = "dynamo_tpu") -> list[str]:
     ]
 
 
+#: the hbm_* family names in exposition order — one list shared by the
+#: emitter below, the memory-report totals, and the tests that pin them
+HBM_COMPONENTS = ("weights", "kv_pool", "scratch", "free", "peak")
+
+
+def hbm_lines(prefix: str = "dynamo_tpu") -> list[str]:
+    """Process-global HBM accounting exposition, per DEVICE, from the
+    registered in-process engines' memory_report (docs/observability.md
+    "Reading the perf plane"): `{prefix}_hbm_{weights,kv_pool,scratch,
+    free,peak}_bytes{device=...}`. Included by BOTH Prometheus surfaces
+    like spec_lines; the per-WORKER fleet rollup rides the metrics
+    frames as `{prefix}_worker_hbm_*` instead. Always emitted (a zeroed
+    device="0" series when no engine lives here) so dashboards and the
+    panel-vs-emitted-names gate see the families."""
+    per_dev: dict[str, dict[str, int]] = {}
+    for eng in registered_engines().values():
+        report = getattr(eng, "memory_report", None)
+        if not callable(report):
+            continue
+        try:
+            devices = report()["devices"]
+        except Exception:
+            continue
+        for dev, row in devices.items():
+            acc = per_dev.setdefault(dev, dict.fromkeys(HBM_COMPONENTS, 0))
+            for comp in HBM_COMPONENTS:
+                acc[comp] += int(row.get(f"{comp}_bytes", 0) or 0)
+    if not per_dev:
+        per_dev = {"0": dict.fromkeys(HBM_COMPONENTS, 0)}
+    lines: list[str] = []
+    for comp in HBM_COMPONENTS:
+        lines.append(f"# TYPE {prefix}_hbm_{comp}_bytes gauge")
+        for dev in sorted(per_dev):
+            lines.append(
+                f'{prefix}_hbm_{comp}_bytes{{device="{dev}"}} '
+                f"{per_dev[dev][comp]}"
+            )
+    return lines
+
+
 # -- payloads -------------------------------------------------------------
 
 
@@ -268,6 +316,24 @@ def programs_payload() -> tuple[dict, int]:
     engines = {}
     for name, eng in sorted(registered_engines().items()):
         report = getattr(eng, "programs_report", None)
+        engines[name] = report() if callable(report) else {}
+    return {"engines": engines}, 200
+
+
+def memory_payload() -> tuple[dict, int]:
+    """GET /v1/debug/memory -> per-engine HBM accounting tables."""
+    engines = {}
+    for name, eng in sorted(registered_engines().items()):
+        report = getattr(eng, "memory_report", None)
+        engines[name] = report() if callable(report) else {}
+    return {"engines": engines}, 200
+
+
+def mesh_payload() -> tuple[dict, int]:
+    """GET /v1/debug/mesh -> per-engine mesh/sharding introspection."""
+    engines = {}
+    for name, eng in sorted(registered_engines().items()):
+        report = getattr(eng, "mesh_report", None)
         engines[name] = report() if callable(report) else {}
     return {"engines": engines}, 200
 

@@ -1844,6 +1844,27 @@ class Worker:
                     logger.warning(
                         "debug-plane frame failed", exc_info=True
                     )
+                # HBM accounting + mesh seat (docs/observability.md
+                # "Reading the perf plane"): refresh the hbm_* / host /
+                # dispatch gauges (m snapshotted metrics BEFORE the
+                # refresh, so fold the fresh values in), and ship the
+                # full per-device table + mesh doc so the metrics
+                # service serves GET /v1/debug/{memory,mesh} fleet-wide.
+                try:
+                    if hasattr(eng, "refresh_memory_metrics"):
+                        m["memory"] = eng.refresh_memory_metrics()
+                        md = eng.metrics
+                        for f in (
+                            "hbm_weights_bytes", "hbm_kv_pool_bytes",
+                            "hbm_scratch_bytes", "hbm_free_bytes",
+                            "hbm_peak_bytes", "host", "dispatch_p95_ms",
+                        ):
+                            m[f] = getattr(md, f)
+                        m["mesh"] = eng.mesh_report()
+                except Exception:
+                    logger.warning(
+                        "memory/mesh frame failed", exc_info=True
+                    )
             wd = getattr(self.runner, "watchdog", None)
             if wd is not None:
                 m["stalls_by_cause"] = wd.counters.snapshot()

@@ -166,6 +166,14 @@ TIER_HIT_FLOOR = 8
 #: disk share of tiered hits above which the hot working set has been
 #: demoted past the host slab onto NVMe
 TIER_DISK_HIT_SHARE = 0.5
+#: host-skew (multi-host SPMD stragglers): a host whose worst dispatch
+#: p95 exceeds the fastest host's by this ratio is a straggler — under
+#: lockstep SPMD every dispatch waits for it (docs/observability.md
+#: "Reading the perf plane")
+HOST_SKEW_RATIO = 1.5
+#: dispatch p95s below this never count as skew — sub-threshold jitter
+#: on near-idle hosts is noise, not a straggler
+HOST_SKEW_FLOOR_MS = 5.0
 #: worst kept traces the slow-trace-attribution rule examines
 TRACE_WORST_N = 5
 #: a phase must explain at least this share of a trace's wall time to
@@ -226,10 +234,11 @@ def diagnose(
     flight: Optional[dict] = None,
     programs: Optional[dict] = None,
     traces: Optional[dict] = None,
+    ledger: Optional[list] = None,
 ) -> list[dict]:
     """Pure rule pass: (/v1/fleet, /v1/debug/flight, /v1/debug/programs,
-    /v1/traces) snapshots -> ordered findings (severity: critical >
-    warning > info)."""
+    /v1/traces) snapshots [+ perf-ledger rows] -> ordered findings
+    (severity: critical > warning > info)."""
     findings: list[dict] = []
     workers = (fleet or {}).get("workers") or {}
     roles = (fleet or {}).get("roles") or {}
@@ -577,6 +586,8 @@ def diagnose(
     findings.extend(_kv_index_rules((fleet or {}).get("kv_index")))
     findings.extend(_planner_rules((fleet or {}).get("planner")))
     findings.extend(_trace_rules(traces, workers))
+    findings.extend(_host_skew_rules(workers))
+    findings.extend(_perf_regression_rules(ledger))
 
     for iid, p in sorted(((programs or {}).get("workers") or {}).items()):
         for kind, k in sorted((p.get("kinds") or {}).items()):
@@ -604,6 +615,110 @@ def diagnose(
     order = {"critical": 0, "warning": 1, "info": 2}
     findings.sort(key=lambda f: (order.get(f["severity"], 9), str(f["worker"])))
     return findings
+
+
+def _host_skew_rules(workers: dict) -> list[dict]:
+    """host-skew: under multi-host SPMD every lockstep dispatch runs at
+    the SLOWEST host's pace — group the live workers' flight-window
+    dispatch p95 by their `host` (jax.process_index()) and name the
+    straggler. Needs >= 2 hosts reporting; single-host fleets (and
+    workers without the HBM/mesh plane) never fire it."""
+    by_host: dict[str, float] = {}
+    members: dict[str, list[str]] = {}
+    for iid, w in sorted(workers.items()):
+        p95 = w.get("dispatch_p95_ms")
+        if not isinstance(p95, (int, float)):
+            continue
+        if float(w.get("last_seen_s") or 0.0) > DEAD_AFTER_S:
+            continue  # the dead-worker rule owns stale frames
+        h = str(int(w.get("host") or 0))
+        by_host[h] = max(by_host.get(h, 0.0), float(p95))
+        members.setdefault(h, []).append(iid)
+    if len(by_host) < 2:
+        return []
+    fastest = min(by_host.values())
+    out: list[dict] = []
+    for h, p95 in sorted(by_host.items()):
+        if p95 < HOST_SKEW_FLOOR_MS:
+            continue
+        if fastest > 0 and p95 > fastest * HOST_SKEW_RATIO:
+            out.append(_finding(
+                "warning", "host-skew", None,
+                f"host {h} dispatches at p95 {p95:.1f}ms vs the fastest "
+                f"host's {fastest:.1f}ms ({p95 / fastest:.1f}x) — under "
+                "lockstep SPMD every dispatch waits for it",
+                {"host": h, "dispatch_p95_ms": p95,
+                 "fastest_host_p95_ms": fastest,
+                 "workers": members.get(h, [])},
+                "compare GET /v1/debug/mesh dispatch sections across "
+                "hosts; look for thermal throttling, a noisy neighbor, "
+                "or host-side input work pinned to that process "
+                "(docs/observability.md 'Reading the perf plane')",
+            ))
+    return out
+
+
+def _import_perf_ledger():
+    """Lazy import of dynamo_tpu.telemetry.perf_ledger — the doctor
+    stays dependency-free unless the ledger plane is actually used.
+    Running as `python scripts/doctor.py` puts scripts/ (not the repo
+    root) on sys.path, so fall back to the parent directory."""
+    try:
+        from dynamo_tpu.telemetry import perf_ledger
+    except ImportError:
+        import os
+
+        sys.path.insert(
+            0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        )
+        try:
+            from dynamo_tpu.telemetry import perf_ledger
+        except ImportError:
+            return None
+    return perf_ledger
+
+
+def _perf_regression_rules(ledger: Optional[list]) -> list[dict]:
+    """perf-regression: compare each round's latest ledger row against
+    the previous ok row with the SAME config fingerprint (same
+    workload), using the shared tolerance bands. Fires one warning per
+    regressed comparison — the doctor flags drift; scripts/perf_diff.py
+    is the CI gate."""
+    if not ledger:
+        return []
+    perf_ledger = _import_perf_ledger()
+    if perf_ledger is None:
+        return []
+    by_round = perf_ledger.rows_by_round(ledger)
+    ordered = [r for r in by_round.values() if r["ok"]]
+    out: list[dict] = []
+    for prev, cur in zip(ordered, ordered[1:]):
+        if prev.get("fingerprint") != cur.get("fingerprint"):
+            continue
+        result = perf_ledger.compare_rows(prev, cur)
+        if not result["regressions"]:
+            continue
+        worst = max(
+            (r for r in result["rows"] if r["verdict"] == "REGRESSION"),
+            key=lambda r: abs(r["rel"] or 0.0),
+        )
+        out.append(_finding(
+            "warning", "perf-regression", None,
+            f"round {cur['round']} regressed "
+            f"{', '.join(result['regressions'])} vs {prev['round']} "
+            f"(worst: {worst['metric']} {worst['rel']:+.1%}, band "
+            f"{worst['band']:.0%})",
+            {"round_a": prev["round"], "round_b": cur["round"],
+             "fingerprint": cur.get("fingerprint"),
+             "regressions": result["regressions"],
+             "rows": [r for r in result["rows"]
+                      if r["verdict"] == "REGRESSION"]},
+            "rerun the round to rule out noise, then bisect: "
+            f"`python scripts/perf_diff.py {prev['round']} "
+            f"{cur['round']}` shows the full table "
+            "(docs/observability.md 'Reading the perf plane')",
+        ))
+    return out
 
 
 def _control_plane_rules(fleet: dict, workers: dict) -> list[dict]:
@@ -947,6 +1062,11 @@ def main(argv=None) -> int:
         help="recorded /v1/traces JSON file instead of fetching",
     )
     ap.add_argument(
+        "--ledger", default=None,
+        help="perf ledger (artifacts/perf_ledger.jsonl) for the "
+             "perf-regression rule; never fetched",
+    )
+    ap.add_argument(
         "--json", action="store_true",
         help="emit the findings as JSON instead of the text report",
     )
@@ -978,7 +1098,23 @@ def main(argv=None) -> int:
             else {}
         )
     )
-    findings = diagnose(fleet, flight or {}, programs or {}, traces or {})
+    ledger_rows = None
+    if args.ledger:
+        perf_ledger = _import_perf_ledger()
+        if perf_ledger is None:
+            print("ledger: dynamo_tpu.telemetry.perf_ledger not "
+                  "importable", file=sys.stderr)
+        else:
+            try:
+                ledger_rows, skipped = perf_ledger.read_rows(args.ledger)
+                for p in skipped:
+                    print(f"ledger: skipped {p}", file=sys.stderr)
+            except OSError as e:
+                print(f"ledger {args.ledger} unreadable: {e}",
+                      file=sys.stderr)
+    findings = diagnose(
+        fleet, flight or {}, programs or {}, traces or {}, ledger_rows
+    )
     if args.json:
         print(json.dumps(findings, indent=2))
     else:

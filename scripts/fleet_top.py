@@ -13,7 +13,10 @@ who want the fleet at a glance without Grafana:
 Per worker: role, model, req/s, tok/s, TTFT/ITL p50/p95, KV-pool %,
 live MFU, jit compiles, stall count (dynamo_tpu_stalls_total, via the
 worker frames' stalls_total), KVBM tier residency + hit split
-(TIER/HIT — docs/operations.md "The KV economy"), SLO burn rate
+(TIER/HIT — docs/operations.md "The KV economy"), HBM byte breakdown
+(HBM w/kv/free — the worker frames' hbm_*_bytes gauges, summed over
+its local devices; docs/observability.md "Reading the perf plane"),
+SLO burn rate
 (shortest attainment window), the worst KEPT trace touching the worker (fleet trace plane,
 GET /v1/traces — its id pastes straight into /v1/traces/{id}),
 last_seen age. Fleet footer: merged percentiles, SLA attainment + burn
@@ -43,6 +46,18 @@ def _fmt(v, nd: int = 1, suffix: str = "") -> str:
 
 def _pct(slo: dict, metric: str, q: str):
     return (slo or {}).get(metric, {}).get(q)
+
+
+def _bshort(v) -> str:
+    """Compact byte count for fixed-width columns: 427K, 3.2G, 24G."""
+    if v is None:
+        return "-"
+    v = float(v)
+    for div, s in ((2**40, "T"), (2**30, "G"), (2**20, "M"), (2**10, "K")):
+        if v >= div:
+            x = v / div
+            return f"{x:.1f}{s}" if x < 10 else f"{x:.0f}{s}"
+    return f"{int(v)}"
 
 
 def _worker_burn(slo: dict):
@@ -81,7 +96,8 @@ def render(snap: dict, traces=None) -> str:
         ("WORKER", 22), ("ROLE", 8), ("MODEL", 12), ("REQ/S", 7),
         ("TOK/S", 8), ("TTFT p50/p95", 14), ("ITL p50/p95", 12),
         ("KV%", 6), ("WM", 6), ("MFU", 7), ("COMP", 5), ("PREEMPT", 7),
-        ("SPEC%", 6), ("TIER/HIT", 12), ("STALLS", 6), ("BURN", 6),
+        ("SPEC%", 6), ("TIER/HIT", 12), ("HBM w/kv/free", 15),
+        ("STALLS", 6), ("BURN", 6),
         ("WORST-TRACE", 16), ("AGE s", 6),
     )
     worst = _worst_traces_by_worker(traces)
@@ -126,6 +142,22 @@ def render(snap: dict, traces=None) -> str:
                     w.get(f) is not None for f in (
                         "kvbm_host_blocks", "kvbm_disk_blocks",
                         "kvbm_demotions_total",
+                    )
+                )
+                else "-"
+            ),
+            # HBM accounting view: weights-resident / KV-pool / free
+            # bytes summed over the worker's local devices ("3.2G/1.1G/
+            # 11G"). Workers predating the perf plane show "-" — absence
+            # of accounting, not an empty device.
+            (
+                f"{_bshort(w.get('hbm_weights_bytes'))}/"
+                f"{_bshort(w.get('hbm_kv_pool_bytes'))}/"
+                f"{_bshort(w.get('hbm_free_bytes'))}"
+                if any(
+                    w.get(f) is not None for f in (
+                        "hbm_weights_bytes", "hbm_kv_pool_bytes",
+                        "hbm_free_bytes",
                     )
                 )
                 else "-"

@@ -1,0 +1,143 @@
+#!/usr/bin/env python
+"""perf_diff.py — diff two rounds of the perf ledger, exit nonzero on
+regression.
+
+    python scripts/perf_diff.py r02 r03
+    python scripts/perf_diff.py BASELINE r05 --baseline BASELINE.json
+    python scripts/perf_diff.py --list
+
+Compares the LATEST row of round B against the latest row of round A
+(A is the reference, B the candidate), metric by metric, with the
+direction and tolerance tables from dynamo_tpu/telemetry/perf_ledger.py
+(--tolerance metric=frac overrides per metric). A worse-direction move
+past the band is a REGRESSION; an improvement or in-band move is OK;
+metrics present on only one side are reported but never flagged.
+
+Exit codes (CI contract, pinned in tests/test_perf_ledger.py):
+  0  no regression (including "nothing comparable" — a failed round has
+     no metrics, and a config-fingerprint mismatch downgrades the whole
+     diff to advisory: different workloads can't regress each other)
+  1  usage/data error (missing round, unreadable ledger)
+  2  at least one metric regressed past its band
+
+docs/observability.md "Reading the perf plane" walks through a session.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from dynamo_tpu.telemetry import perf_ledger  # noqa: E402
+from dynamo_tpu.telemetry.perf_ledger import compare_rows  # noqa: E402,F401
+
+
+def render(result: dict) -> str:
+    lines = [
+        f"perf_diff: {result['round_a']} -> {result['round_b']}",
+    ]
+    if result["note"]:
+        lines.append(f"  note: {result['note']}")
+    if result["rows"]:
+        w = max(len(r["metric"]) for r in result["rows"])
+        for r in result["rows"]:
+            a = "-" if r["a"] is None else f"{r['a']:.6g}"
+            b = "-" if r["b"] is None else f"{r['b']:.6g}"
+            rel = "" if r["rel"] is None else f" {r['rel']:+.1%}"
+            band = "" if r["band"] is None else f" (band {r['band']:.0%})"
+            lines.append(
+                f"  {r['metric']:<{w}}  {a:>12} -> {b:>12}"
+                f"{rel}{band}  {r['verdict']}"
+            )
+    if result["regressions"]:
+        lines.append(
+            f"  RESULT: {len(result['regressions'])} regression(s): "
+            + ", ".join(result["regressions"])
+        )
+    elif result["comparable"]:
+        lines.append("  RESULT: no regressions")
+    else:
+        lines.append("  RESULT: nothing comparable")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="diff two perf-ledger rounds; exit 2 on regression"
+    )
+    ap.add_argument("round_a", nargs="?", help="reference round (or BASELINE)")
+    ap.add_argument("round_b", nargs="?", help="candidate round")
+    ap.add_argument("--ledger", default=perf_ledger.DEFAULT_LEDGER)
+    ap.add_argument(
+        "--baseline", default="BASELINE.json",
+        help="BASELINE.json to satisfy the literal round name BASELINE",
+    )
+    ap.add_argument(
+        "--tolerance", action="append", default=[], metavar="METRIC=FRAC",
+        help="override a metric's band, e.g. --tolerance tok_s=0.02",
+    )
+    ap.add_argument("--list", action="store_true",
+                    help="list rounds in the ledger and exit")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable result on stdout")
+    args = ap.parse_args(argv)
+
+    try:
+        rows, problems = perf_ledger.read_rows(args.ledger)
+    except OSError as e:
+        print(f"perf_diff: cannot read ledger: {e}", file=sys.stderr)
+        return 1
+    for p in problems:
+        print(f"perf_diff: skipped {p}", file=sys.stderr)
+    by_round = perf_ledger.rows_by_round(rows)
+
+    if args.list:
+        for name, row in by_round.items():
+            print(f"{name:>12}  source={row['source']:<16} ok={row['ok']} "
+                  f"metrics={','.join(sorted(row['metrics'])) or '-'}")
+        return 0
+    if not args.round_a or not args.round_b:
+        ap.error("need ROUND_A and ROUND_B (or --list)")
+
+    tol = {}
+    for spec in args.tolerance:
+        name, _, frac = spec.partition("=")
+        try:
+            tol[name] = float(frac)
+        except ValueError:
+            ap.error(f"bad --tolerance {spec!r}")
+
+    picked = {}
+    for which in (args.round_a, args.round_b):
+        if which in by_round:
+            picked[which] = by_round[which]
+        elif which == "BASELINE":
+            try:
+                with open(args.baseline) as f:
+                    picked[which] = perf_ledger.row_from_baseline(
+                        json.load(f)
+                    )
+            except (OSError, ValueError) as e:
+                print(f"perf_diff: cannot read {args.baseline}: {e}",
+                      file=sys.stderr)
+                return 1
+        else:
+            known = ", ".join(by_round) or "(empty ledger)"
+            print(f"perf_diff: round {which!r} not in ledger "
+                  f"({known})", file=sys.stderr)
+            return 1
+
+    result = compare_rows(picked[args.round_a], picked[args.round_b], tol)
+    if args.as_json:
+        print(json.dumps(result, indent=1))
+    else:
+        print(render(result))
+    return 2 if result["regressions"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

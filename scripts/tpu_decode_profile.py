@@ -336,6 +336,28 @@ def main() -> None:
     path.mkdir(parents=True, exist_ok=True)
     (path / "decode_profile.json").write_text(json.dumps(out, indent=1))
     print(json.dumps(out, indent=1))
+    # perf-regression ledger row (scripts/perf_diff.py): headline
+    # tok_s / ms_per_dispatch of this profile, best-effort — a ledger
+    # problem never fails the profile run
+    try:
+        from dynamo_tpu.telemetry import perf_ledger
+
+        row = perf_ledger.row_from_decode_profile(
+            out, os.environ.get("DYNTPU_ROUND", "adhoc")
+        )
+        ledger = os.environ.get("DYNTPU_PERF_LEDGER")
+        if ledger != "":
+            perf_ledger.append_row(
+                row,
+                ledger
+                or str(
+                    Path(__file__).resolve().parent.parent
+                    / perf_ledger.DEFAULT_LEDGER
+                ),
+            )
+    except Exception as e:
+        print(f"decode_profile: perf_ledger append failed: {e}",
+              file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -15,6 +15,12 @@ set -u
 cd "$(dirname "$0")/.."
 OUT=artifacts/tpu
 mkdir -p "$OUT"
+# perf-regression ledger (docs/observability.md "Reading the perf
+# plane"): bench-family stages self-append one row per run to
+# artifacts/perf_ledger.jsonl, named "<tag>/<stage>" so stages of one
+# round don't clobber each other's latest-row slot. Diff rounds with
+#   python scripts/perf_diff.py 20260801/bench_1b 20260807/bench_1b
+ROUND_TAG="${DYNTPU_ROUND_TAG:-$(date +%Y%m%d)}"
 
 probe() {
   echo "== probing TPU tunnel (120s timeout)"
@@ -37,7 +43,8 @@ check_platform() { # artifact file: flag CPU fallbacks loudly
 run_stage() { # name, command...
   local name=$1; shift
   echo "== $name"
-  timeout 3600 "$@" >"$OUT/$name.json" 2>"$OUT/$name.err"
+  DYNTPU_ROUND="${ROUND_TAG}/${name}" \
+    timeout 3600 "$@" >"$OUT/$name.json" 2>"$OUT/$name.err"
   local rc=$?
   if [ $rc -eq 124 ]; then
     # SIGTERM mid-TPU-RPC is the documented wedge trigger: re-verify the

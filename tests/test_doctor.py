@@ -752,3 +752,128 @@ def test_replication_lag_rule():
         f for f in doctor.diagnose(base, {}, {})
         if f["rule"] == "replication-lag"
     ]
+
+
+def test_host_skew_rule_names_the_straggler_host():
+    """host-skew (ISSUE 19): two hosts reporting dispatch p95, one
+    1.5x+ slower than the fastest -> one warning naming the host and
+    its workers; single-host fleets stay quiet."""
+    doctor = _load_doctor()
+    fleet = {"workers": {
+        "w-h0a": {"role": "decode", "last_seen_s": 0.2, "tok_s": 700.0,
+                  "host": 0, "dispatch_p95_ms": 8.0,
+                  "kv_total_pages": 512},
+        "w-h0b": {"role": "decode", "last_seen_s": 0.2, "tok_s": 710.0,
+                  "host": 0, "dispatch_p95_ms": 7.5,
+                  "kv_total_pages": 512},
+        "w-h1": {"role": "decode", "last_seen_s": 0.2, "tok_s": 690.0,
+                 "host": 1, "dispatch_p95_ms": 26.0,
+                 "kv_total_pages": 512},
+    }}
+    hits = [
+        f for f in doctor.diagnose(fleet, {}, {})
+        if f["rule"] == "host-skew"
+    ]
+    assert len(hits) == 1, hits
+    assert hits[0]["severity"] == "warning"
+    assert hits[0]["evidence"]["host"] == "1"
+    assert hits[0]["evidence"]["workers"] == ["w-h1"]
+    assert "/v1/debug/mesh" in hits[0]["action"]
+
+    # a dead worker's frame must not drive the skew verdict
+    fleet["workers"]["w-h1"]["last_seen_s"] = 42.0
+    assert not [
+        f for f in doctor.diagnose(fleet, {}, {})
+        if f["rule"] == "host-skew"
+    ]
+
+    # single host: no comparison to make
+    single = {"workers": {
+        k: dict(v, host=0, last_seen_s=0.2)
+        for k, v in fleet["workers"].items()
+    }}
+    assert not [
+        f for f in doctor.diagnose(single, {}, {})
+        if f["rule"] == "host-skew"
+    ]
+
+
+def test_host_skew_rule_ignores_sub_floor_p95():
+    """Microsecond-scale CPU-test dispatches skew wildly in relative
+    terms; the absolute floor keeps the rule quiet there."""
+    doctor = _load_doctor()
+    fleet = {"workers": {
+        "w-a": {"role": "decode", "last_seen_s": 0.2, "tok_s": 700.0,
+                "host": 0, "dispatch_p95_ms": 0.4,
+                "kv_total_pages": 512},
+        "w-b": {"role": "decode", "last_seen_s": 0.2, "tok_s": 700.0,
+                "host": 1, "dispatch_p95_ms": 2.0,
+                "kv_total_pages": 512},
+    }}
+    assert not [
+        f for f in doctor.diagnose(fleet, {}, {})
+        if f["rule"] == "host-skew"
+    ]
+
+
+def test_perf_regression_rule_fires_on_same_fingerprint_drop():
+    """perf-regression (ISSUE 19): consecutive ok rounds with the SAME
+    config fingerprint, tok_s down 17% -> one warning pointing at
+    scripts/perf_diff.py; a workload change (different fingerprint)
+    stays quiet."""
+    doctor = _load_doctor()
+    from dynamo_tpu.telemetry import perf_ledger
+
+    cfg = {"model": "tiny", "isl": 64}
+    rows = [
+        perf_ledger.make_row("rA", "bench", {"tok_s": 600.0}, cfg),
+        perf_ledger.make_row("rB", "bench", {"tok_s": 500.0}, cfg),
+    ]
+    hits = [
+        f for f in doctor.diagnose({"workers": {}}, {}, {}, {}, rows)
+        if f["rule"] == "perf-regression"
+    ]
+    assert len(hits) == 1, hits
+    assert hits[0]["evidence"]["round_b"] == "rB"
+    assert "tok_s" in hits[0]["evidence"]["regressions"]
+    assert "perf_diff.py rA rB" in hits[0]["action"]
+
+    # same drop across a workload change: apples to oranges, quiet
+    rows[1] = perf_ledger.make_row(
+        "rB", "bench", {"tok_s": 500.0}, {"model": "large", "isl": 64}
+    )
+    assert not [
+        f for f in doctor.diagnose({"workers": {}}, {}, {}, {}, rows)
+        if f["rule"] == "perf-regression"
+    ]
+
+    # in-band drift: quiet
+    rows[1] = perf_ledger.make_row("rB", "bench", {"tok_s": 580.0}, cfg)
+    assert not [
+        f for f in doctor.diagnose({"workers": {}}, {}, {}, {}, rows)
+        if f["rule"] == "perf-regression"
+    ]
+
+
+def test_cli_ledger_path_offline(tmp_path):
+    """`python scripts/doctor.py --snapshot ... --ledger ...` loads the
+    ledger without the package on sys.path and reports the regression."""
+    from dynamo_tpu.telemetry import perf_ledger
+
+    cfg = {"model": "tiny"}
+    ledger = tmp_path / "perf_ledger.jsonl"
+    for name, tok_s in (("rA", 600.0), ("rB", 480.0)):
+        perf_ledger.append_row(
+            perf_ledger.make_row(name, "bench", {"tok_s": tok_s}, cfg),
+            str(ledger),
+        )
+    snap = tmp_path / "fleet.json"
+    snap.write_text(json.dumps({"workers": {}}))
+    out = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "doctor.py"),
+         "--snapshot", str(snap), "--ledger", str(ledger), "--json"],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert out.returncode == 0, out.stderr  # warning, not critical
+    findings = json.loads(out.stdout)
+    assert any(f["rule"] == "perf-regression" for f in findings), findings

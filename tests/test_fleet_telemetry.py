@@ -447,6 +447,9 @@ RECORDED_SNAPSHOT = {
             "kvbm_host_blocks": 12, "kvbm_disk_blocks": 3,
             "kvbm_demotions_total": 15, "kvbm_promotions_total": 6,
             "kvbm_host_hits_total": 5, "kvbm_disk_hits_total": 1,
+            "hbm_weights_bytes": 2147483648, "hbm_kv_pool_bytes": 3435973836,
+            "hbm_free_bytes": 25769803776, "hbm_peak_bytes": 6000000000,
+            "host": 0, "dispatch_p95_ms": 7.2,
             "slo": {
                 "requests_total": 400, "within_sla_total": 392,
                 "tokens_total": 25600, "goodput_tokens_total": 25100,
@@ -536,6 +539,32 @@ def test_fleet_top_renders_events_timeline():
     plain = ft.render_events(events, color=False)
     assert "\x1b[" not in plain
     assert "(no fleet events)" in ft.render_events([])
+
+
+def test_fleet_top_hbm_column(tmp_path):
+    """ISSUE 19 satellite: the HBM w/kv/free column renders the frame's
+    hbm_* gauges compactly; workers without the plane degrade to a
+    dash, not a crash."""
+    ft = _load_fleet_top()
+    assert ft._bshort(2147483648) == "2.0G"
+    assert ft._bshort(3435973836) == "3.2G"
+    assert ft._bshort(25769803776) == "24G"
+    assert ft._bshort(427264) == "417K"  # binary units
+    assert ft._bshort(0) == "0"
+    assert ft._bshort(None) == "-"
+
+    text = ft.render(RECORDED_SNAPSHOT)
+    assert "HBM w/kv/free" in text
+    decode_row = next(
+        l for l in text.splitlines() if l.startswith("worker-decode-1")
+    )
+    assert "2.0G/3.2G/24G" in decode_row
+    # prefill worker predates the plane: no hbm_* fields -> dash
+    prefill_row = next(
+        l for l in text.splitlines() if l.startswith("worker-prefill-1")
+    )
+    cols = prefill_row.split()
+    assert "-" in cols
 
 
 def test_fleet_top_renders_recorded_snapshot(tmp_path):
