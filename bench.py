@@ -447,6 +447,156 @@ def _kstep_ab(
     }
 
 
+def _multihost_pipeline_ab(
+    model: str = "tiny", pairs: int = 3, num_requests: int = 8,
+    osl: int = 64, kstep: int = 8, topology: str = "tp=2,dp=2",
+) -> dict:
+    """The fast decode pipeline carried across hosts (ISSUE 20): under a
+    FORCED multi-host mesh (EngineConfig.force_multihost over the CPU
+    device grid — the engine takes the multi-controller code paths
+    without a fabric), the K-step pipeline ON vs the old multi-host
+    behavior (the pre-lift auto-off: synchronous per-token stepping).
+    ONE warm engine; the arms toggle `eng._decode_kstep` live and
+    interleave per pair so box-load drift cancels. Like _kstep_ab, the
+    TIMED arms keep overlap off (the CPU backend serializes the
+    speculative dispatch, billing the ON arm for pipelining the chip
+    gets free); a separate UN-timed probe drive then runs with overlap
+    re-enabled and reports its engagement (`overlap_probe`) — proof the
+    multi-host overlap path works, without letting its CPU artifact
+    pollute the model.
+
+    The ASSERTED number is the deterministic dispatch-level model (same
+    construction as _kstep_ab): modeled_ms_per_token_ratio =
+    (ms/dispatch / tok/dispatch, pipeline off) / (same, pipeline on) —
+    the per-window host sync the lift removes from every replica's
+    lockstep loop. Wall tok/s rides along unasserted."""
+    import gc
+
+    import numpy as np
+
+    from dynamo_tpu.engine import EngineConfig
+    from dynamo_tpu.engine.engine import JaxEngine
+    from dynamo_tpu.engine.request import SamplingParams
+
+    base = EngineConfig.for_tests() if model == "tiny" else None
+    over = {
+        "model": model,
+        "topology": topology,
+        "force_multihost": True,
+        "decode_kstep": kstep,
+        "num_pages": max(256, num_requests * 8),
+        "page_size": 16,
+        "max_pages_per_seq": 16,
+        "prefill_chunk": 64,
+        "decode_buckets": (1, 2, 4, 8),
+        "max_seqs": max(8, num_requests),
+        "decode_steps": 1,
+        "overlap_decode": False,
+        "enable_prefix_caching": False,
+    }
+    if base is not None:
+        cfg = EngineConfig(**{**base.__dict__, **over})
+    else:
+        cfg = EngineConfig(**over)
+    eng = JaxEngine(cfg)
+    assert eng._multiproc, "force_multihost must engage the SPMD paths"
+    rng = np.random.default_rng(0)
+
+    def drive(tag: str) -> dict:
+        m = eng.metrics
+        keys = (
+            "time_decode_ms", "decode_dispatches", "generated_tokens",
+            "kstep_windows", "overlap_hits",
+        )
+        before = {k: getattr(m, k) for k in keys}
+        t0 = time.perf_counter()
+        for i in range(num_requests):
+            eng.add_request(
+                f"{tag}{i}",
+                [int(x) for x in rng.integers(1, 200, 12)],
+                SamplingParams(temperature=0.0, max_tokens=osl),
+            )
+        gen = 0
+        while eng.has_work:
+            for out in eng.step():
+                gen += len(out.new_token_ids)
+        elapsed = time.perf_counter() - t0
+        eng.drain_overlap()
+        d = {k: getattr(m, k) - v for k, v in before.items()}
+        disp = max(1, d["decode_dispatches"])
+        return {
+            "tok_s": round(gen / elapsed, 1),
+            "ms_per_dispatch": round(d["time_decode_ms"] / disp, 4),
+            "tok_per_dispatch": round(d["generated_tokens"] / disp, 3),
+            "decode_dispatches": d["decode_dispatches"],
+            "kstep_windows": d["kstep_windows"],
+            "overlap_hits": d["overlap_hits"],
+        }
+
+    eng._decode_kstep = kstep
+    drive("warm_on")
+    eng._decode_kstep = 1
+    drive("warm_off")
+    on_runs, off_runs = [], []
+    for p in range(pairs):
+        eng._decode_kstep = kstep
+        on_runs.append(drive(f"on{p}"))
+        eng._decode_kstep = 1
+        off_runs.append(drive(f"off{p}"))
+    # un-timed probe: the overlap path itself, live on the forced
+    # multi-host mesh (its timing is a CPU serialization artifact)
+    eng._decode_kstep = kstep
+    eng._overlap_enabled = True
+    probe = drive("probe")
+    del eng
+    gc.collect()
+
+    import statistics
+
+    def med(runs, k):
+        return statistics.median(r[k] for r in runs)
+
+    ms_on, ms_off = med(on_runs, "ms_per_dispatch"), med(
+        off_runs, "ms_per_dispatch"
+    )
+    tpd_on, tpd_off = med(on_runs, "tok_per_dispatch"), med(
+        off_runs, "tok_per_dispatch"
+    )
+    modeled = (
+        (ms_off / tpd_off) / (ms_on / tpd_on)
+        if tpd_off and tpd_on and ms_on
+        else None
+    )
+    return {
+        "model": model,
+        "topology": topology,
+        "kstep": kstep,
+        "batch": num_requests,
+        "pairs": pairs,
+        "pipeline_on": {
+            "tok_s": med(on_runs, "tok_s"),
+            "ms_per_dispatch": ms_on,
+            "tok_per_dispatch": tpd_on,
+            "kstep_windows": med(on_runs, "kstep_windows"),
+        },
+        "pipeline_off": {
+            "tok_s": med(off_runs, "tok_s"),
+            "ms_per_dispatch": ms_off,
+            "tok_per_dispatch": tpd_off,
+        },
+        "overlap_probe": {
+            "overlap_hits": probe["overlap_hits"],
+            "kstep_windows": probe["kstep_windows"],
+        },
+        "wall_tok_s_ratio": round(
+            med(on_runs, "tok_s") / max(1e-9, med(off_runs, "tok_s")), 3
+        ),
+        "modeled_ms_per_token_ratio": (
+            round(modeled, 3) if modeled is not None else None
+        ),
+    }
+
+
 def _mixed_ab(model: str = "tiny", pairs: int = 1) -> dict:
     """Stall-free mixed prefill+decode steps A/B (ISSUE 5): the c=32
     saturation workload — a few long-running decodes with a steady
@@ -1930,6 +2080,10 @@ def main() -> None:
             kv_quantize=kv_quantize,
             attention_impl=attention_impl,
             overlap_decode=overlap,
+            # chip stage bench_1b_tp: BENCH_TOPOLOGY=tp=4,dp=2 runs the
+            # headline on the combined mesh layout; params place through
+            # the logical-axis rule table (ISSUE 20)
+            topology=os.environ.get("BENCH_TOPOLOGY", ""),
         )
         return JaxEngine(cfg)
 
@@ -2297,6 +2451,29 @@ def main() -> None:
             # the headline artifact
             kstep_ab = {"error": f"{type(e).__name__}: {e}"}
 
+    # Multi-host pipeline A/B (ISSUE 20): the decode pipeline carried
+    # across hosts vs the old multi-host auto-off, under the forced
+    # multi-host CPU mesh. Runs by default on the CPU fallback (tiny);
+    # the chip arm is queued as bench_1b_tp in tpu_round.sh
+    # (BENCH_MULTIHOST forces it with the headline model).
+    multihost_ab = None
+    default_mh = "1" if platform != "tpu" else "0"
+    if os.environ.get("BENCH_MULTIHOST", default_mh) != "0":
+        try:
+            multihost_ab = _multihost_pipeline_ab(
+                model=os.environ.get(
+                    "BENCH_MULTIHOST_MODEL",
+                    "tiny" if platform != "tpu" else model,
+                ),
+                pairs=int(os.environ.get("BENCH_MULTIHOST_PAIRS", "3")),
+                topology=os.environ.get(
+                    "BENCH_MULTIHOST_TOPOLOGY", "tp=2,dp=2"
+                ),
+            )
+        except Exception as e:  # noqa: BLE001 — A/B failure must not kill
+            # the headline artifact
+            multihost_ab = {"error": f"{type(e).__name__}: {e}"}
+
     tok_s = best["tok_s"]
     p50_ttft = best["p50_ttft"]
     p50_itl = best["p50_itl"]
@@ -2472,6 +2649,11 @@ def main() -> None:
                 **({"mixed_ab": mixed_ab} if mixed_ab else {}),
                 **({"spec_ab": spec_ab} if spec_ab else {}),
                 **({"kstep_ab": kstep_ab} if kstep_ab else {}),
+                **(
+                    {"multihost_pipeline_ab": multihost_ab}
+                    if multihost_ab
+                    else {}
+                ),
                 **({"kvquant_ab": kvquant_ab} if kvquant_ab else {}),
                 **({"ext_harness_ab": ext_ab} if ext_ab else {}),
                 **({"trace_overhead": trace_ab} if trace_ab else {}),

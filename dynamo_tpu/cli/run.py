@@ -49,6 +49,7 @@ def _engine_config(args, eos_token_ids: tuple = ()) -> EngineConfig:
         tp=args.tp,
         sp=getattr(args, "sp", 1),
         ep=getattr(args, "ep", 1),
+        topology=getattr(args, "topology", "") or "",
         eos_token_ids=tuple(eos_token_ids) or (0,),
         host_kv_cache_bytes=getattr(args, "host_kv_bytes", 0),
         disk_kv_cache_bytes=getattr(args, "disk_kv_bytes", 0),
@@ -873,8 +874,8 @@ def build_parser() -> argparse.ArgumentParser:
              "on device, the host syncs once per K tokens (vLLM's "
              "--num-scheduler-steps analogue). 1 (default) = classic "
              "per-step loop, bit-identical streams; K>1 stays bit-exact "
-             "and auto-disables under speculation, logprobs rows, and "
-             "multi-host SPMD",
+             "(including on multi-host SPMD meshes) and auto-disables "
+             "under speculation and logprobs rows",
     )
     runp.add_argument(
         "--host-kv-bytes", type=int, default=0, dest="host_kv_bytes",
@@ -936,8 +937,8 @@ def build_parser() -> argparse.ArgumentParser:
         "--no-overlap-decode", action="store_false", dest="overlap_decode",
         default=True,
         help="disable the overlapped decode loop (speculative next-step "
-             "dispatch with one-step-lagged host readback; on by default, "
-             "auto-off on multi-host SPMD and with --spec-ngram)",
+             "dispatch with one-step-lagged host readback; on by default "
+             "including multi-host SPMD, auto-off with --spec-ngram)",
     )
     runp.add_argument(
         "--no-mixed-steps", action="store_false", dest="mixed_steps",
@@ -945,8 +946,8 @@ def build_parser() -> argparse.ArgumentParser:
         help="disable stall-free mixed prefill+decode steps (one fused "
              "dispatch carrying a bounded prefill chunk plus the decode "
              "batch, so decodes emit a token every step while a prompt "
-             "burst drains; on by default for aggregated topology, "
-             "auto-off on multi-host SPMD and with --spec-ngram)",
+             "burst drains; on by default including multi-host SPMD, "
+             "auto-off with --spec-ngram)",
     )
     runp.add_argument(
         "--no-fleet-telemetry", action="store_false",
@@ -1028,6 +1029,12 @@ def build_parser() -> argparse.ArgumentParser:
     runp.add_argument(
         "--ep", type=int, default=1,
         help="expert-parallel devices (MoE models shard experts over them)",
+    )
+    runp.add_argument(
+        "--topology", default="",
+        help="combined mesh layout 'tp=N,dp=M[,ep=K][,sp=J]' — overrides "
+             "the individual --dp/--tp/--sp/--ep flags; the product must "
+             "match the device count (docs/migrating.md)",
     )
     runp.add_argument(
         "--coordinator", default=None,

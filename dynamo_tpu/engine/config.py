@@ -67,8 +67,10 @@ class EngineConfig:
     #: device outputs) and mixed_steps (the window runs as the decode
     #: leg beside the prefill chunk). Auto-disabled, with a logged
     #: reason, for spec_ngram/spec_draft (they already batch steps),
-    #: logprobs rows, oversized stop sets, and multi-process SPMD
-    #: meshes. 1 (default) = off: the classic path, bit-identical.
+    #: logprobs rows, and oversized stop sets. Runs on multi-process
+    #: SPMD meshes too: window outcomes are replicated on-device, so
+    #: every lockstep host reads back identical [K, B] ids and emit
+    #: counts. 1 (default) = off: the classic path, bit-identical.
     #: Token streams at K>1 are bit-exact vs K=1 (greedy AND sampled —
     #: pinned by tests/test_engine_kstep.py). `--decode-kstep` on the
     #: CLI (vLLM `--num-scheduler-steps` analogue, docs/migrating.md).
@@ -79,10 +81,14 @@ class EngineConfig:
     #: copy — host postprocessing and array staging hide under device
     #: compute. Rolled back (overshoot discarded, like decode_multi's
     #: post-stop tokens) when a finish/preemption/abort/admitted prefill
-    #: changes the batch. Forced off on multi-process SPMD meshes (until
-    #: validated under lockstep) and when spec_ngram > 0 (prompt-lookup
-    #: drafts need host tokens). Token streams are bit-identical to the
-    #: synchronous path (pinned by tests/test_engine_overlap.py).
+    #: changes the batch. Runs on multi-process SPMD meshes: decode ids
+    #: are replicated on-device, the rollback decision is a pure
+    #: function of the (broadcast) event log, so every lockstep host
+    #: overlaps and rolls back identically — the lagged readback is the
+    #: ONLY per-window host sync. Forced off when spec_ngram > 0
+    #: (prompt-lookup drafts need host tokens). Token streams are
+    #: bit-identical to the synchronous path (pinned by
+    #: tests/test_engine_overlap.py and test_engine_multihost.py).
     overlap_decode: bool = True
     #: stall-free mixed prefill+decode steps (Sarathi-style piggybacking):
     #: when both a prefill backlog and running decodes exist, the
@@ -93,9 +99,11 @@ class EngineConfig:
     #: XOR (prefill-priority) policy pays (docs/PERF.md saturation
     #: section, lever 4). Greedy token streams are bit-exact vs the XOR
     #: scheduler (same kernels, same per-request order — pinned by
-    #: tests/test_engine_mixed.py). Forced off on multi-process SPMD
-    #: meshes (lockstep replicas: not validated yet) and when
-    #: spec_ngram > 0 (the verify program owns the decode batch).
+    #: tests/test_engine_mixed.py). Runs on multi-process SPMD meshes
+    #: (the mixed/XOR choice is a deterministic function of the
+    #: replicated scheduler state, so lockstep replicas agree). Forced
+    #: off when spec_ngram > 0 (the verify program owns the decode
+    #: batch).
     mixed_steps: bool = True
     #: speculative decoding by prompt lookup (draft-free n-gram
     #: speculation): propose this many draft tokens per decode step from
@@ -172,6 +180,21 @@ class EngineConfig:
     #: expert parallel: MoE experts shard over this many devices (dense
     #: models ignore it)
     ep: int = 1
+    #: combined topology knob: "tp=N,dp=M[,ep=K][,sp=J]" (the
+    #: vLLM-style `--topology` flag; docs/migrating.md). Parsed in
+    #: __post_init__ and OVERRIDES the individual dp/tp/sp/ep fields;
+    #: unnamed axes keep their defaults. The product must match the
+    #: devices the mesh is built over (make_mesh validates). "" = use
+    #: the individual fields.
+    topology: str = ""
+    #: test/bench knob: treat a single-process mesh as multi-host —
+    #: the engine takes the multi-controller SPMD code paths
+    #: (addressable-shard readbacks, replicated decode outputs,
+    #: lockstep-safe scheduling) without a real fabric. Lets CPU tests
+    #: and bench.py exercise the cross-host decode pipeline
+    #: deterministically. No effect on real multi-process meshes
+    #: (already multi-host).
+    force_multihost: bool = False
     #: random seed for sampling
     seed: int = 0
     #: enable content-addressed prefix caching
@@ -217,6 +240,13 @@ class EngineConfig:
     disk_kv_cache_dir: Optional[str] = None
 
     def __post_init__(self):
+        if self.topology:
+            # Parse before the sp validation below so a topology-set sp
+            # goes through the same checks as an explicitly-set one.
+            from dynamo_tpu.parallel.mesh import parse_topology
+
+            for axis, n in parse_topology(self.topology).items():
+                object.__setattr__(self, axis, n)
         if self.prefill_chunk % self.page_size != 0:
             raise ValueError(
                 f"prefill_chunk ({self.prefill_chunk}) must be a multiple of "

@@ -51,6 +51,7 @@ from dynamo_tpu.engine.request import (
 from dynamo_tpu.engine.sampling import sample, sample_greedy
 from dynamo_tpu.engine.scheduler import ScheduledBatch, Scheduler
 from dynamo_tpu.models.registry import ModelAdapter, get_model
+from dynamo_tpu.parallel.logical import default_rules
 from dynamo_tpu.parallel.mesh import MeshConfig, make_mesh
 from dynamo_tpu.parallel.shardings import batch_spec, shardings_for
 from dynamo_tpu.tokens import TokenBlockSequence
@@ -340,6 +341,7 @@ class JaxEngine:
         #: (engine/spmd.py keeps the hosts' schedulers in lockstep).
         self._multiproc = self.mesh is not None and (
             len({d.process_index for d in self.mesh.devices.flat}) > 1
+            or config.force_multihost
         )
         self._batched_put_ok = True
         if self._multiproc:
@@ -490,47 +492,44 @@ class JaxEngine:
         self._spec_win_drafted = 0
         self._spec_win_accepted = 0
         #: overlapped decode: the one speculative in-flight dispatch (or
-        #: None). Off on multi-process meshes (lockstep replicas must
-        #: observe identical step results before the next broadcast) and
+        #: None). Carried ACROSS hosts since the logical-axis refactor:
+        #: chained dispatch feeds tokens on-device (replicated outputs),
+        #: so the readback in _consume_inflight is the only per-window
+        #: host sync and it is identical on every lockstep replica. Off
         #: under prompt-lookup speculation (drafts need host tokens).
         self._inflight: Optional[_InflightDecode] = None
         self._overlap_enabled = (
-            config.overlap_decode
-            and not self._multiproc
-            and config.spec_ngram <= 0
+            config.overlap_decode and config.spec_ngram <= 0
         )
-        #: stall-free mixed prefill+decode steps: off on multi-process
-        #: meshes (lockstep replicas: not validated yet) and under
-        #: prompt-lookup speculation (the verify program owns the decode
-        #: batch). The scheduler only emits `mixed` when this holds.
+        #: stall-free mixed prefill+decode steps: off under prompt-lookup
+        #: speculation (the verify program owns the decode batch). The
+        #: scheduler only emits `mixed` when this holds. Multi-host runs
+        #: keep it: batch assembly is event-log deterministic, the fused
+        #: program's sampled ids come back replicated.
         self._mixed_enabled = (
-            config.mixed_steps
-            and not self._multiproc
-            and config.spec_ngram <= 0
+            config.mixed_steps and config.spec_ngram <= 0
         )
         self.scheduler.mixed_enabled = self._mixed_enabled
         #: on-device K-step decode windows (config.decode_kstep): same
-        #: policy surface as overlap/mixed — off on multi-process SPMD
-        #: meshes (lockstep replicas: not validated) and under BOTH
-        #: speculation modes (they already batch steps per dispatch).
-        #: _decode_kstep is the live window target (bench A/B toggles it
-        #: on a warm engine); per-dispatch eligibility (logprobs rows,
-        #: stop-set size, page runway) is decided in _pick_kstep.
+        #: policy surface as overlap/mixed — off under BOTH speculation
+        #: modes (they already batch steps per dispatch); stays ON for
+        #: multi-process meshes (the scan keeps feedback, stop checks,
+        #: and page-table state on-device; the [K, B] readback is
+        #: replicated). _decode_kstep is the live window target (bench
+        #: A/B toggles it on a warm engine); per-dispatch eligibility
+        #: (logprobs rows, stop-set size, page runway) is decided in
+        #: _pick_kstep.
         self._decode_kstep = config.decode_kstep
         self._kstep_enabled = (
             config.decode_kstep > 1
-            and not self._multiproc
             and config.spec_ngram <= 0
             and not self._spec_draft
         )
         if config.decode_kstep > 1 and not self._kstep_enabled:
             logger.info(
-                "decode_kstep=%d auto-disabled: %s",
+                "decode_kstep=%d auto-disabled: speculative decoding "
+                "already batches steps per dispatch",
                 config.decode_kstep,
-                "multi-process SPMD mesh (lockstep replicas not "
-                "validated)" if self._multiproc
-                else "speculative decoding already batches steps per "
-                "dispatch",
             )
         #: live K-step window state: the last dispatched window size
         #: (the stall watchdog floors its threshold at a multiple of it)
@@ -4242,15 +4241,44 @@ class JaxEngine:
 
     def _param_group_specs(self) -> dict:
         """Per-sharding-spec param grouping for /v1/debug/mesh:
-        spec-string -> {params, bytes}. Meshless engines group
-        everything under "replicated"."""
+        spec-string -> {params, bytes, logical}. `logical` lists the
+        model-declared logical axis names (models/*_logical_axes
+        leaves, e.g. "(layers, None, heads)") that resolved into this
+        placement through the rule table — the provenance half of the
+        logical-axis system. Meshless engines group everything under
+        "replicated"."""
+        leaves = jax.tree.leaves(self.params)
+        logical: list = [None] * len(leaves)
+        if getattr(self.adapter, "logical_axes", None) is not None:
+            try:
+                from jax.sharding import PartitionSpec as P
+
+                from dynamo_tpu.parallel.logical import AxisNames
+
+                ax = jax.tree.leaves(
+                    self.adapter.logical_axes(
+                        quantized=bool(self.config.quantize)
+                    ),
+                    is_leaf=lambda x: isinstance(x, (AxisNames, P)),
+                )
+                if len(ax) == len(leaves):
+                    logical = ax
+            except Exception:  # noqa: BLE001 — provenance is advisory;
+                # the byte accounting must never fail over it
+                logger.exception("logical-axis provenance unavailable")
         groups: dict[str, dict] = {}
-        for x in jax.tree.leaves(self.params):
+        for x, names in zip(leaves, logical):
             spec = getattr(getattr(x, "sharding", None), "spec", None)
             key = str(spec) if spec is not None else "replicated"
-            g = groups.setdefault(key, {"params": 0, "bytes": 0})
+            g = groups.setdefault(
+                key, {"params": 0, "bytes": 0, "logical": []}
+            )
             g["params"] += 1
             g["bytes"] += int(getattr(x, "nbytes", 0))
+            if names is not None:
+                lbl = "(" + ", ".join(str(n) for n in names) + ")"
+                if lbl not in g["logical"]:
+                    g["logical"].append(lbl)
         return groups
 
     def memory_report(self) -> dict:
@@ -4386,10 +4414,12 @@ class JaxEngine:
 
     def mesh_report(self) -> dict:
         """GET /v1/debug/mesh: what the SPMD layer actually built —
-        mesh shape + axis names, the per-sharding-spec param grouping,
-        the KV pool's sharding, this replica's process seat, and the
-        recent decode dispatch window (the metrics service compares the
-        latter ACROSS hosts into the fleet's host-skew view)."""
+        mesh shape + axis names, the per-sharding-spec param grouping
+        (with each group's logical-axis names), the rule table that
+        resolved those names to mesh axes, the KV pool's sharding, this
+        replica's process seat, and the recent decode dispatch window
+        (the metrics service compares the latter ACROSS hosts into the
+        fleet's host-skew view)."""
         mesh_doc = None
         if self.mesh is not None:
             mesh_doc = {
@@ -4413,6 +4443,9 @@ class JaxEngine:
             "process_index": pi,
             "process_count": pc,
             "param_groups": self._param_groups,
+            "logical_axis_rules": [
+                list(r) for r in default_rules().doc()
+            ],
             "kv_sharding": (
                 str(kv_spec) if kv_spec is not None else "replicated"
             ),

@@ -61,7 +61,15 @@ def _bucket_len(n: int) -> int:
 def _broadcast_bytes(payload: Optional[bytes], is_leader: bool) -> bytes:
     """Leader ships `payload` to every process; followers pass None.
     Two collectives: a fixed-shape length, then the bucket-padded
-    payload (sliced back to the exact length on receipt)."""
+    payload (sliced back to the exact length on receipt).
+
+    The payload rides as ONE BYTE PER int32 ELEMENT, not uint8: some
+    jaxlib CPU/gloo builds corrupt uint8 broadcasts by widening the
+    buffer to int32 in the collective and handing back the widened
+    bytes reinterpreted as uint8 (every payload byte followed by three
+    NULs, tail truncated) — on both the source and the receivers. The
+    4x wire size is irrelevant for KB-scale event logs; int32 is the
+    one element type every gloo reduction path handles."""
     from jax.experimental import multihost_utils
 
     if is_leader:
@@ -72,13 +80,11 @@ def _broadcast_bytes(payload: Optional[bytes], is_leader: bool) -> bytes:
     if n == 0:
         return b""
     b = _bucket_len(n)
+    data = np.zeros(b, np.int32)
     if is_leader:
-        data = np.zeros(b, np.uint8)
         data[:n] = np.frombuffer(payload, np.uint8)
-    else:
-        data = np.zeros(b, np.uint8)
     out = multihost_utils.broadcast_one_to_all(data, is_source=is_leader)
-    return bytes(np.asarray(out)[:n])
+    return np.asarray(out)[:n].astype(np.uint8).tobytes()
 
 
 class SpmdDriver:
@@ -179,7 +185,16 @@ class SpmdDriver:
         payload = json.dumps(events).encode() if self.is_leader else None
         raw = _broadcast_bytes(payload, self.is_leader)
         if not self.is_leader:
-            events = json.loads(raw.decode()) if raw else []
+            try:
+                events = json.loads(raw.decode()) if raw else []
+            except (UnicodeDecodeError, json.JSONDecodeError) as e:
+                # a mangled event log means the collective plane is
+                # corrupting payloads — surface WHAT arrived, the next
+                # broadcast would wedge anyway
+                raise RuntimeError(
+                    "lockstep event broadcast corrupt "
+                    f"({len(raw)} bytes, head={raw[:32]!r}): {e}"
+                ) from e
         self._apply(events)
         if self._stopped:
             return []

@@ -706,6 +706,67 @@ def init_params(key: jax.Array, cfg: LlamaConfig) -> dict:
     return params
 
 
+def llama_logical_axes(cfg: LlamaConfig, quantized: bool = False) -> dict:
+    """Logical axis names for every param, declared ONCE beside the
+    shapes `init_params` builds (parallel/logical.py resolves them to
+    PartitionSpecs through the one rule table):
+
+    - "heads"/"kv_heads": packed q/kv head output dims of wq/wk/wv;
+      wo's INPUT dim carries "heads" so the following matmul produces
+      partial sums and XLA inserts the one per-layer psum,
+    - "mlp": ffn intermediate dim (w_down's input, like wo),
+    - "embed": the embedding table's hidden dim; the vocab dim of the
+      TABLE stays unnamed (lookup is a gather — sharding the hidden dim
+      is the cheap one), while an untied lm_head names its output dim
+      "vocab",
+    - "layers": the lax.scan stack dim, never sharded,
+    - int8 scales [L, 1, out] ride their weight's OUTPUT dim
+      (contraction-sharded wo/w_down keep unsharded scales, which
+      commute with the partial-sum).
+    """
+    from dynamo_tpu.parallel.logical import L
+
+    axes = {
+        "embed": L(None, "embed"),
+        "layers": {
+            "attn_norm": L("layers", None),
+            "wq": L("layers", None, "heads"),
+            "wk": L("layers", None, "kv_heads"),
+            "wv": L("layers", None, "kv_heads"),
+            "wo": L("layers", "heads", None),
+            "mlp_norm": L("layers", None),
+            "w_gate": L("layers", None, "mlp"),
+            "w_up": L("layers", None, "mlp"),
+            "w_down": L("layers", "mlp", None),
+        },
+        "final_norm": L(None),
+    }
+    if cfg.attention_bias:
+        # biases shard with their projection's output dim
+        axes["layers"]["bq"] = L("layers", "heads")
+        axes["layers"]["bk"] = L("layers", "kv_heads")
+        axes["layers"]["bv"] = L("layers", "kv_heads")
+    if getattr(cfg, "qk_norm", False):
+        # per-head-dim norms apply identically on every sharded head
+        axes["layers"]["q_norm"] = L("layers", None)
+        axes["layers"]["k_norm"] = L("layers", None)
+    if getattr(cfg, "post_block_norms", False):
+        # Gemma2 post-sublayer norms act on the replicated hidden dim
+        axes["layers"]["post_attn_norm"] = L("layers", None)
+        axes["layers"]["post_mlp_norm"] = L("layers", None)
+    if quantized:
+        axes["layers"]["wq_scale"] = L("layers", None, "heads")
+        axes["layers"]["wk_scale"] = L("layers", None, "kv_heads")
+        axes["layers"]["wv_scale"] = L("layers", None, "kv_heads")
+        axes["layers"]["w_gate_scale"] = L("layers", None, "mlp")
+        axes["layers"]["w_up_scale"] = L("layers", None, "mlp")
+        axes["layers"]["wo_scale"] = L("layers", None, None)
+        axes["layers"]["w_down_scale"] = L("layers", None, None)
+    if not cfg.tie_word_embeddings:
+        axes["lm_head"] = L(None, "vocab")
+    return axes
+
+
 def params_from_torch_state_dict(state_dict, cfg: LlamaConfig) -> dict:
     """Convert a HuggingFace Llama state_dict (torch tensors) to our pytree.
 

@@ -908,73 +908,88 @@ def forward(params, cfg: MlaConfig, tokens, positions, valid, kv, page_tables):
     return compute_logits(params, cfg, h), kv
 
 
-def mla_param_specs(cfg: MlaConfig, quantized: bool = False):
-    """PartitionSpecs: attention heads shard over tp (the packed head
-    output axes of wq/wkv_b/wo), routed experts over ep; the latent
-    projections and cache replicate (one shared latent — MQA-shaped).
-    Quantized layouts add per-output-channel scale leaves: a scale
-    shards with its weight's OUTPUT dim (contraction-sharded wo/w_down
-    keep replicated scales, which commute with the partial-sum)."""
-    from jax.sharding import PartitionSpec as P
+def mla_logical_axes(cfg: MlaConfig, quantized: bool = False) -> dict:
+    """Logical axis names (parallel/logical.py): attention heads carry
+    "heads" (the packed head output axes of wq/wkv_b, wo's input),
+    routed experts carry "expert" with DELIBERATELY unnamed
+    intermediate dims — DeepSeek's many small experts shard on ep
+    alone, tp-splitting a 1408-wide expert mlp would fragment the
+    matmuls below MXU tile size. The latent projections and cache
+    replicate (one shared latent — MQA-shaped). Quantized scale leaves
+    ride their weight's OUTPUT dim (contraction-sharded wo/w_down keep
+    replicated scales, which commute with the partial-sum)."""
+    from dynamo_tpu.parallel.logical import L
 
-    def attn_specs(moe: bool) -> dict:
-        specs = {
-            "attn_norm": P(),
-            "wkv_a": P(),
-            "kv_a_norm": P(),
-            "wkv_b": P(None, None, "tp"),
-            "wo": P(None, "tp", None),
-            "mlp_norm": P(),
+    def attn_axes(moe: bool) -> dict:
+        axes = {
+            "attn_norm": L(),
+            "wkv_a": L(),
+            "kv_a_norm": L(),
+            "wkv_b": L("layers", None, "heads"),
+            "wo": L("layers", "heads", None),
+            "mlp_norm": L(),
         }
         if cfg.q_lora_rank:
-            specs.update(
-                wq_a=P(), q_a_norm=P(), wq_b=P(None, None, "tp")
+            axes.update(
+                wq_a=L(), q_a_norm=L(), wq_b=L("layers", None, "heads")
             )
         else:
-            specs["wq"] = P(None, None, "tp")
+            axes["wq"] = L("layers", None, "heads")
         if not moe:
-            specs.update(
-                w_gate=P(None, None, "tp"), w_up=P(None, None, "tp"),
-                w_down=P(None, "tp", None),
+            axes.update(
+                w_gate=L("layers", None, "mlp"),
+                w_up=L("layers", None, "mlp"),
+                w_down=L("layers", "mlp", None),
             )
         else:
-            specs.update(
-                w_router=P(),
+            axes.update(
+                w_router=L(),
                 **(
-                    {"router_bias": P()}
+                    {"router_bias": L()}
                     if cfg.topk_method == "noaux_tc"
                     else {}
                 ),
-                we_gate=P(None, "ep", None, None),
-                we_up=P(None, "ep", None, None),
-                we_down=P(None, "ep", None, None),
-                ws_gate=P(None, None, "tp"),
-                ws_up=P(None, None, "tp"),
-                ws_down=P(None, "tp", None),
+                we_gate=L("layers", "expert", None, None),
+                we_up=L("layers", "expert", None, None),
+                we_down=L("layers", "expert", None, None),
+                ws_gate=L("layers", None, "mlp"),
+                ws_up=L("layers", None, "mlp"),
+                ws_down=L("layers", "mlp", None),
             )
         if quantized:
-            for name in list(specs):
+            for name in list(axes):
                 if name not in _QUANT_2D + _QUANT_EXPERTS:
                     continue
-                wspec = tuple(specs[name])
+                waxes = tuple(axes[name])
                 if name in _QUANT_EXPERTS:
                     # [L, E, 1, out]: scale rides the expert shard
-                    specs[name + "_scale"] = P(None, "ep", None, None)
-                elif wspec and wspec[-1] == "tp":  # output-dim sharded
-                    specs[name + "_scale"] = P(None, None, "tp")
+                    axes[name + "_scale"] = L(
+                        "layers", "expert", None, None
+                    )
+                elif waxes and waxes[-1] is not None:  # output-dim named
+                    axes[name + "_scale"] = L("layers", None, waxes[-1])
                 else:  # replicated or contraction-sharded: scale replicates
-                    specs[name + "_scale"] = P()
-        return specs
+                    axes[name + "_scale"] = L()
+        return axes
 
-    specs = {
-        "embed": P(),
-        "dense_layers": attn_specs(moe=False) if cfg.num_dense_layers else {},
-        "moe_layers": attn_specs(moe=True) if cfg.num_moe_layers else {},
-        "final_norm": P(),
+    axes = {
+        "embed": L(),
+        "dense_layers": attn_axes(moe=False) if cfg.num_dense_layers else {},
+        "moe_layers": attn_axes(moe=True) if cfg.num_moe_layers else {},
+        "final_norm": L(),
     }
     if not cfg.tie_word_embeddings:
-        specs["lm_head"] = P(None, "tp")
-    return specs
+        axes["lm_head"] = L(None, "vocab")
+    return axes
+
+
+def mla_param_specs(cfg: MlaConfig, quantized: bool = False, rules=None):
+    """PartitionSpecs for MLA params: `mla_logical_axes` resolved
+    through the logical-axis rule table (default table when `rules` is
+    None)."""
+    from dynamo_tpu.parallel.logical import resolve
+
+    return resolve(mla_logical_axes(cfg, quantized=quantized), rules)
 
 
 # ---------------------------------------------------------------------------

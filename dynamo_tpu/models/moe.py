@@ -718,40 +718,51 @@ def forward(params, cfg: MoeConfig, tokens, positions, valid, kv, page_tables):
     return llama_mod.compute_logits(params, cfg.base, h), kv
 
 
-def moe_param_specs(cfg: MoeConfig, quantized: bool = False):
-    """Llama specs + expert weights sharded on the ep axis; expert
-    intermediate dims additionally on tp. Quantized scales ride their
-    weight's output-dim shard (contraction-sharded wo/we_down keep
-    replicated/ep-only scales)."""
-    from jax.sharding import PartitionSpec as P
+def moe_logical_axes(cfg: MoeConfig, quantized: bool = False) -> dict:
+    """Logical axis names (parallel/logical.py): llama's per-layer
+    names minus the dense MLP, plus routed-expert weights [L, E, in,
+    out] whose E dim is "expert" (EP placement) and whose intermediate
+    dim is "mlp" (so experts shard ep x tp); the router and shared
+    expert stay dense-style. Quantized scales ride their weight's
+    output-dim name (contraction-sharded we_down keeps an expert-only
+    scale)."""
+    from dynamo_tpu.models.llama import llama_logical_axes
+    from dynamo_tpu.parallel.logical import L
 
-    from dynamo_tpu.parallel.shardings import llama_param_specs
-
-    specs = llama_param_specs(cfg.base, quantized=quantized)
-    layers = specs["layers"]
+    axes = llama_logical_axes(cfg.base, quantized=quantized)
+    layers = axes["layers"]
     for name in ("w_gate", "w_up", "w_down"):
         del layers[name]
         layers.pop(name + "_scale", None)
-    layers["w_router"] = P(None, None, None)
-    layers["we_gate"] = P(None, "ep", None, "tp")
-    layers["we_up"] = P(None, "ep", None, "tp")
-    layers["we_down"] = P(None, "ep", "tp", None)
+    layers["w_router"] = L("layers", None, None)
+    layers["we_gate"] = L("layers", "expert", None, "mlp")
+    layers["we_up"] = L("layers", "expert", None, "mlp")
+    layers["we_down"] = L("layers", "expert", "mlp", None)
     if quantized:
-        layers["we_gate_scale"] = P(None, "ep", None, "tp")
-        layers["we_up_scale"] = P(None, "ep", None, "tp")
-        layers["we_down_scale"] = P(None, "ep", None, None)
+        layers["we_gate_scale"] = L("layers", "expert", None, "mlp")
+        layers["we_up_scale"] = L("layers", "expert", None, "mlp")
+        layers["we_down_scale"] = L("layers", "expert", None, None)
     if cfg.shared_expert:  # Llama-4: dense MLP beside the experts
-        layers["ws_gate"] = P(None, None, "tp")
-        layers["ws_up"] = P(None, None, "tp")
-        layers["ws_down"] = P(None, "tp", None)
+        layers["ws_gate"] = L("layers", None, "mlp")
+        layers["ws_up"] = L("layers", None, "mlp")
+        layers["ws_down"] = L("layers", "mlp", None)
     if cfg.router_bias:  # GPT-OSS
-        layers["b_router"] = P(None, None)
+        layers["b_router"] = L("layers", None)
     if cfg.expert_mlp == "gpt_oss":  # per-expert biases ride their dims
-        layers["be_gate"] = P(None, "ep", "tp")
-        layers["be_up"] = P(None, "ep", "tp")
-        layers["be_down"] = P(None, "ep", None)
+        layers["be_gate"] = L("layers", "expert", "mlp")
+        layers["be_up"] = L("layers", "expert", "mlp")
+        layers["be_down"] = L("layers", "expert", None)
     if cfg.base.attn_sinks:  # per-head logits shard with the heads
-        layers["sinks"] = P(None, "tp")
+        layers["sinks"] = L("layers", "heads")
     if cfg.base.attention_out_bias:  # o-proj output dim is unsharded
-        layers["bo"] = P(None, None)
-    return specs
+        layers["bo"] = L("layers", None)
+    return axes
+
+
+def moe_param_specs(cfg: MoeConfig, quantized: bool = False, rules=None):
+    """PartitionSpecs for moe params: `moe_logical_axes` resolved
+    through the logical-axis rule table (default table when `rules` is
+    None)."""
+    from dynamo_tpu.parallel.logical import resolve
+
+    return resolve(moe_logical_axes(cfg, quantized=quantized), rules)

@@ -38,6 +38,11 @@ class ModelAdapter:
     init_kv: Callable[..., KVPages]
     param_specs: Callable[[], Any]
     kv_spec: Callable[[], Any]
+    #: (quantized=False) -> the same tree as param_specs but with
+    #: logical AxisNames leaves (parallel/logical.py) — the model's
+    #: single layout declaration; param_specs is this resolved through
+    #: the rule table. /v1/debug/mesh groups params by these names.
+    logical_axes: Optional[Callable[[], Any]] = None
     load_params: Optional[Callable[[str], Any]] = None  # from a checkpoint dir
     #: where weights live when the model name itself identifies them
     #: (an HF checkpoint dir or a .gguf file); engines load from here when
@@ -55,13 +60,16 @@ class ModelAdapter:
 
 def _kv_pages_spec(kv_quantize=None, shard_heads: bool = True):
     """Partition specs matching init_kv_pages' pytree: head-sharded KV
-    pools, scale planes (when quantized) sharded on the same Hkv axis."""
-    from jax.sharding import PartitionSpec as P
-
+    pools, scale planes (when quantized) sharded on the same Hkv axis —
+    both resolved through the logical-axis rule table."""
+    from dynamo_tpu.parallel.logical import L, resolve
     from dynamo_tpu.parallel.shardings import kv_cache_spec
 
     scale = (
-        P(None, None, None, "tp" if shard_heads else None)
+        resolve(L(
+            "layers", "kv_pages", "kv_seq",
+            "kv_heads" if shard_heads else None,
+        ))
         if kv_quantize
         else None
     )
@@ -151,6 +159,9 @@ def _llama_adapter(
             cfg, quantized=quantized
         ),
         kv_spec=lambda kv_quantize=None: _kv_pages_spec(kv_quantize),
+        logical_axes=lambda quantized=False: llama_mod.llama_logical_axes(
+            cfg, quantized=quantized
+        ),
         load_params=lambda path: _load_llama_checkpoint(path, cfg),
         quantize_params=llama_mod.quantize_params_int8,
         init_params_quantized=lambda key: llama_mod.init_params_int8(
@@ -225,6 +236,9 @@ def _mla_adapter(name: str, cfg, mesh=None) -> ModelAdapter:
         kv_spec=lambda kv_quantize=None: _kv_pages_spec(
             kv_quantize, shard_heads=False
         ),
+        logical_axes=lambda quantized=False: mla_mod.mla_logical_axes(
+            cfg, quantized=quantized
+        ),
         load_params=load,
         quantize_params=mla_mod.quantize_params_int8,
         init_params_quantized=lambda key: mla_mod.init_params_int8(
@@ -274,8 +288,44 @@ def _moe_adapter(name: str, moe_cfg, mesh=None) -> ModelAdapter:
             cfg, quantized=quantized
         ),
         kv_spec=lambda kv_quantize=None: _kv_pages_spec(kv_quantize),
+        logical_axes=lambda quantized=False: moe_mod.moe_logical_axes(
+            cfg, quantized=quantized
+        ),
         load_params=load,
         quantize_params=moe_mod.quantize_params_int8,
+    )
+
+
+def _moe_presets() -> dict:
+    from dynamo_tpu.models.moe import MoeConfig
+
+    return {
+        "mixtral-8x7b": MoeConfig.mixtral_8x7b,
+        "moe-tiny": MoeConfig.tiny,
+        "qwen3-moe-30b": MoeConfig.qwen3_moe_30b,
+        "llama4-scout-text": MoeConfig.llama4_scout_text,
+        "llama4-tiny": MoeConfig.llama4_tiny,
+        "gpt-oss-20b": MoeConfig.gpt_oss_20b,
+        "gpt-oss-tiny": MoeConfig.gpt_oss_tiny,
+    }
+
+
+def _mla_presets() -> dict:
+    from dynamo_tpu.models.mla import MlaConfig
+
+    return {
+        "deepseek-v2-lite": MlaConfig.deepseek_v2_lite,
+        "mla-tiny": MlaConfig.tiny,
+        "mla-tiny-moe": MlaConfig.tiny_moe,
+    }
+
+
+def list_presets() -> list[str]:
+    """Every serveable preset id (llama + MoE + MLA families) — the
+    iteration surface for `scripts/dryrun_70b.py --check-rules`, which
+    dry-resolves each one's logical axes through the rule table."""
+    return sorted(_LLAMA_PRESETS) + sorted(_moe_presets()) + sorted(
+        _mla_presets()
     )
 
 
@@ -290,20 +340,8 @@ def get_model(
     from dynamo_tpu.models.moe import MoeConfig
 
     key = name.lower()
-    moe_presets = {
-        "mixtral-8x7b": MoeConfig.mixtral_8x7b,
-        "moe-tiny": MoeConfig.tiny,
-        "qwen3-moe-30b": MoeConfig.qwen3_moe_30b,
-        "llama4-scout-text": MoeConfig.llama4_scout_text,
-        "llama4-tiny": MoeConfig.llama4_tiny,
-        "gpt-oss-20b": MoeConfig.gpt_oss_20b,
-        "gpt-oss-tiny": MoeConfig.gpt_oss_tiny,
-    }
-    mla_presets = {
-        "deepseek-v2-lite": MlaConfig.deepseek_v2_lite,
-        "mla-tiny": MlaConfig.tiny,
-        "mla-tiny-moe": MlaConfig.tiny_moe,
-    }
+    moe_presets = _moe_presets()
+    mla_presets = _mla_presets()
     moe_cfg = None
     mla_cfg = None
     gguf_path = None

@@ -3,10 +3,21 @@ from dynamo_tpu.parallel.context import (
     ring_attention,
     ulysses_attention,
 )
-from dynamo_tpu.parallel.mesh import MeshConfig, make_mesh
+from dynamo_tpu.parallel.logical import (
+    DEFAULT_RULES,
+    AxisNames,
+    L,
+    LogicalAxisRules,
+    UnknownLogicalAxisError,
+    default_rules,
+    resolve,
+    set_rules,
+)
+from dynamo_tpu.parallel.mesh import MeshConfig, make_mesh, parse_topology
 from dynamo_tpu.parallel.shardings import (
     batch_spec,
     kv_cache_spec,
+    kv_logical_axes,
     llama_param_specs,
     shardings_for,
 )
@@ -15,10 +26,20 @@ __all__ = [
     "dense_gqa_attention",
     "ring_attention",
     "ulysses_attention",
+    "DEFAULT_RULES",
+    "AxisNames",
+    "L",
+    "LogicalAxisRules",
+    "UnknownLogicalAxisError",
+    "default_rules",
+    "resolve",
+    "set_rules",
     "MeshConfig",
     "make_mesh",
+    "parse_topology",
     "batch_spec",
     "kv_cache_spec",
+    "kv_logical_axes",
     "llama_param_specs",
     "shardings_for",
 ]

@@ -1,0 +1,188 @@
+"""t5x-style logical axis sharding: one rule table for the whole fleet.
+
+Models name each tensor dimension ONCE with a *logical* axis name
+("heads", "mlp", "expert", ...) instead of hard-coding mesh axes in
+per-model spec tables. A single `LogicalAxisRules` table maps logical
+names to mesh axes ("dp"/"sp"/"ep"/"tp"), so changing the parallel
+layout — tp=8×dp=2 on a v5e-16, EP over a pod slice — is a rule-table
+edit (or a `--topology` knob), not a per-model rewrite.
+
+Resolution semantics (t5x `logical_to_mesh_axes`):
+- rules are scanned IN ORDER; the first rule whose logical name matches
+  the dim wins,
+- a rule mapping to a mesh axis already used by an earlier dim of the
+  SAME array is skipped (a mesh axis can shard at most one dim), and
+  the scan continues to any fallback rule for that name,
+- a dim named `None` — or whose every candidate mesh axis is taken —
+  resolves to `None` (replicated),
+- a logical name with NO rule at all raises `UnknownLogicalAxisError`:
+  new model axes must be placed deliberately, and
+  `scripts/dryrun_70b.py --check-rules` turns that into a fast tier-1
+  failure instead of an on-chip surprise.
+
+The mesh axis names stay this repo's ("dp", "sp", "ep", "tp") — the
+shard_map kernels and the scaling-book layout notes reference them by
+name — so the rule table is where t5x's "data"/"model" indirection
+lives, not a mesh rename.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Sequence, Tuple
+
+from jax.sharding import PartitionSpec as P
+
+
+class UnknownLogicalAxisError(ValueError):
+    """A model declared a logical axis name the rule table doesn't know."""
+
+
+class AxisNames(tuple):
+    """Logical dim names for ONE array — one entry per (leading) dim,
+    `None` for deliberately-unsharded dims. A tuple subclass so jax
+    pytree utilities can treat a whole name-tuple as a leaf (t5x
+    idiom): `is_leaf=lambda x: isinstance(x, AxisNames)`."""
+
+    def __new__(cls, *names: Optional[str]) -> "AxisNames":
+        return super().__new__(cls, names)
+
+    def __repr__(self) -> str:  # pragma: no cover — debug aid
+        return f"AxisNames({', '.join(repr(n) for n in self)})"
+
+
+def L(*names: Optional[str]) -> AxisNames:
+    """Shorthand constructor: ``L("layers", None, "heads")``."""
+    return AxisNames(*names)
+
+
+#: one (logical_name, mesh_axis | None) pair per rule, scanned in order
+LogicalRules = Sequence[Tuple[str, Optional[str]]]
+
+
+@dataclass(frozen=True)
+class LogicalAxisRules:
+    """The ONE table mapping logical axis names to mesh axes.
+
+    `rules` is ordered: earlier rules win, later rules with the same
+    logical name act as fallbacks when the preferred mesh axis is
+    already used by another dim of the same array.
+    """
+
+    rules: Tuple[Tuple[str, Optional[str]], ...]
+
+    def __post_init__(self) -> None:
+        object.__setattr__(self, "rules", tuple(
+            (str(n), a if a is None else str(a)) for n, a in self.rules
+        ))
+
+    def known(self, name: str) -> bool:
+        return any(n == name for n, _ in self.rules)
+
+    def mesh_axis(self, name: str) -> Optional[str]:
+        """First-listed mesh axis for `name` (provenance reporting)."""
+        for n, a in self.rules:
+            if n == name:
+                return a
+        raise UnknownLogicalAxisError(
+            f"logical axis {name!r} has no rule; known axes: "
+            f"{sorted({n for n, _ in self.rules})}"
+        )
+
+    def spec(self, axes) -> P:
+        """Resolve one array's `AxisNames` to a PartitionSpec.
+
+        A raw PartitionSpec passes through untouched — the escape hatch
+        for layouts the logical vocabulary can't express yet.
+        """
+        if isinstance(axes, P):
+            return axes
+        used: set[str] = set()
+        out = []
+        for name in axes:
+            if name is None:
+                out.append(None)
+                continue
+            assigned: Optional[str] = None
+            known = False
+            for n, a in self.rules:
+                if n != name:
+                    continue
+                known = True
+                if a is None:
+                    break  # explicitly replicated
+                if a not in used:
+                    assigned = a
+                    used.add(a)
+                    break
+                # mesh axis taken by an earlier dim: try a fallback rule
+            if not known:
+                raise UnknownLogicalAxisError(
+                    f"logical axis {name!r} (of {tuple(axes)!r}) has no "
+                    f"rule; known axes: "
+                    f"{sorted({n for n, _ in self.rules})}"
+                )
+            out.append(assigned)
+        return P(*out)
+
+    def tree_specs(self, tree):
+        """Resolve a pytree of AxisNames (dicts mirroring a param tree)
+        to the same tree of PartitionSpecs."""
+        import jax
+
+        return jax.tree.map(
+            self.spec, tree,
+            is_leaf=lambda x: isinstance(x, (AxisNames, P)),
+        )
+
+    def doc(self) -> list:
+        """[[logical, mesh_axis|None], ...] — rule-table provenance for
+        /v1/debug/mesh."""
+        return [[n, a] for n, a in self.rules]
+
+
+#: The default table. Mirrors the Megatron-style TP layout the ad-hoc
+#: spec tables hard-coded (docstring of parallel/shardings.py), plus the
+#: EP placement for routed experts:
+#: - head/mlp/vocab/embedding-hidden dims shard on "tp" (innermost ICI
+#:   ring: the per-layer all-reduce is latency-critical),
+#: - the routed-expert dim shards on "ep",
+#: - request batch dims shard on "dp" (DCN-friendly: no per-layer
+#:   collective crosses it),
+#: - layer stacks, KV page pools, and sequence dims stay replicated.
+DEFAULT_RULES = LogicalAxisRules(rules=(
+    ("batch", "dp"),
+    ("embed", "tp"),
+    ("vocab", "tp"),
+    ("heads", "tp"),
+    ("kv_heads", "tp"),
+    ("mlp", "tp"),
+    ("expert", "ep"),
+    ("layers", None),
+    ("kv_pages", None),
+    ("kv_seq", None),
+    ("kv_latent", None),
+))
+
+
+_ACTIVE_RULES: LogicalAxisRules = DEFAULT_RULES
+
+
+def default_rules() -> LogicalAxisRules:
+    """The process-wide rule table resolvers use when none is passed."""
+    return _ACTIVE_RULES
+
+
+def set_rules(rules: Optional[LogicalAxisRules]) -> LogicalAxisRules:
+    """Swap the process-wide table (tests / exotic topologies); returns
+    the previous table so callers can restore it."""
+    global _ACTIVE_RULES
+    prev = _ACTIVE_RULES
+    _ACTIVE_RULES = rules if rules is not None else DEFAULT_RULES
+    return prev
+
+
+def resolve(tree, rules: Optional[LogicalAxisRules] = None):
+    """Module-level convenience: resolve a tree of AxisNames through
+    `rules` (default: the active table)."""
+    return (rules or default_rules()).tree_specs(tree)

@@ -94,6 +94,74 @@ def init_multihost(
     return len(jax.devices())
 
 
+def parse_topology(spec: str) -> dict:
+    """Parse a `--topology tp=N,dp=M[,ep=K][,sp=J]` knob into MeshConfig
+    field overrides. Unknown axes and non-positive sizes raise — a typo'd
+    topology must fail at config parse, not as a mesh-shape surprise."""
+    out: dict[str, int] = {}
+    for part in str(spec).split(","):
+        part = part.strip()
+        if not part:
+            continue
+        key, sep, val = part.partition("=")
+        key = key.strip()
+        if not sep or key not in ("dp", "tp", "sp", "ep"):
+            raise ValueError(
+                f"topology term {part!r}: expected axis=N with axis in "
+                "dp/tp/sp/ep (e.g. 'tp=8,dp=2')"
+            )
+        if key in out:
+            raise ValueError(f"topology names {key!r} twice: {spec!r}")
+        try:
+            n = int(val)
+        except ValueError:
+            raise ValueError(
+                f"topology term {part!r}: size must be an integer"
+            ) from None
+        if n < 1:
+            raise ValueError(f"topology term {part!r}: size must be >= 1")
+        out[key] = n
+    if not out:
+        raise ValueError(f"empty topology spec {spec!r}")
+    return out
+
+
+def _hybrid_device_grid(
+    config: MeshConfig, devices: Sequence[jax.Device]
+):
+    """Lay multi-slice/multi-granule TPU fleets out hybrid: ICI inside a
+    slice, DCN across (mesh_utils.create_hybrid_device_mesh). The OUTER
+    mesh axis — "dp" here — absorbs the DCN dim, so no per-layer tp/ep
+    collective ever crosses the slow inter-slice links. Returns None
+    when the fleet isn't hybrid (single slice, CPU devices, dp not a
+    multiple of the granule count) — the caller falls back to the plain
+    row-major reshape, which keeps every CPU test bit-identical."""
+    if any(d.platform != "tpu" for d in devices):
+        return None
+    granules = sorted(
+        {
+            getattr(d, "slice_index", getattr(d, "process_index", 0))
+            for d in devices
+        }
+    )
+    if len(granules) <= 1:
+        return None
+    num = len(granules)
+    if config.dp % num or config.num_devices != len(devices):
+        return None
+    try:
+        from jax.experimental import mesh_utils
+
+        return mesh_utils.create_hybrid_device_mesh(
+            mesh_shape=(config.dp // num, config.sp, config.ep, config.tp),
+            dcn_mesh_shape=(num, 1, 1, 1),
+            devices=devices,
+        )
+    except Exception:  # noqa: BLE001 — jaxlib without hybrid support /
+        # topology info: the plain reshape still yields a working mesh
+        return None
+
+
 def make_mesh(
     config: Optional[MeshConfig] = None,
     devices: Optional[Sequence[jax.Device]] = None,
@@ -102,7 +170,9 @@ def make_mesh(
 
     TP collectives (per-layer all-reduce) are latency-critical, so they ride
     the innermost device ring; DP gradients-of-nothing (inference) only
-    all-gathers tokens rarely.
+    all-gathers tokens rarely. Multi-slice TPU fleets go through
+    `create_hybrid_device_mesh` so "dp" rides the DCN links between
+    slices while tp/ep stay on in-slice ICI.
     """
     config = config or MeshConfig.single_device()
     # Multi-process: jax.devices() is already the GLOBAL list after
@@ -127,5 +197,7 @@ def make_mesh(
         raise ValueError(
             f"mesh {config.shape} needs {n} devices, have {len(devices)}"
         )
-    arr = np.asarray(devices[:n]).reshape(config.shape)
+    arr = _hybrid_device_grid(config, devices[:n])
+    if arr is None:
+        arr = np.asarray(devices[:n]).reshape(config.shape)
     return Mesh(arr, axis_names=config.axis_names)

@@ -1,80 +1,72 @@
-"""Sharding rules: logical tensor dims -> mesh axes.
+"""Sharding resolution: logical axis names -> mesh axes -> PartitionSpecs.
 
 The recipe (scaling-book style): pick a mesh, annotate param/activation
-shardings with PartitionSpecs, jit, and let XLA insert the ICI collectives.
+shardings with PartitionSpecs, jit, and let XLA insert the ICI
+collectives. Since the logical-axis refactor the per-model layout lives
+with the MODELS as logical names (`models/llama.py::llama_logical_axes`
+and friends) and the mesh placement lives in ONE rule table
+(`parallel/logical.py::DEFAULT_RULES`); this module resolves the two
+into the PartitionSpecs the engine places arrays with.
 
-Megatron-style TP layout for Llama:
-- wq/wk/wv: shard the head (output) dim on "tp" — each device owns a head
-  subset, attention is embarrassingly parallel across heads.
-- wo / w_down: shard the *input* dim on "tp" — the following matmul produces
-  partial sums; XLA inserts one psum (all-reduce) per layer, the minimal TP
-  collective count.
-- embed/lm_head: shard the vocab/hidden dim on "tp".
-- KV pages: shard kv-heads on "tp" — KV stays resident beside its heads,
-  no KV collectives during decode.
+The resolved layout is the Megatron-style TP recipe:
+- wq/wk/wv: shard the head (output) dim on "tp" — each device owns a
+  head subset, attention is embarrassingly parallel across heads.
+- wo / w_down: shard the *input* dim on "tp" — the following matmul
+  produces partial sums; XLA inserts one psum (all-reduce) per layer,
+  the minimal TP collective count.
+- embed/lm_head: shard the hidden/vocab dim on "tp".
+- MoE routed experts: expert dim on "ep" (EP placement), expert
+  intermediate dim on "tp" where the model names it "mlp".
+- KV pages: shard kv-heads on "tp" — KV stays resident beside its
+  heads, no KV collectives during decode.
 - Request batch dims shard on "dp".
 """
 
 from __future__ import annotations
 
-from typing import Any
+from typing import Any, Optional
 
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from dynamo_tpu.models.llama import LlamaConfig
+from dynamo_tpu.models.llama import LlamaConfig, llama_logical_axes
+from dynamo_tpu.parallel.logical import (
+    L,
+    LogicalAxisRules,
+    resolve,
+)
 
 
-def llama_param_specs(cfg: LlamaConfig, quantized: bool = False) -> dict:
-    specs = {
-        "embed": P(None, "tp"),
-        "layers": {
-            "attn_norm": P(None, None),
-            "wq": P(None, None, "tp"),
-            "wk": P(None, None, "tp"),
-            "wv": P(None, None, "tp"),
-            "wo": P(None, "tp", None),
-            "mlp_norm": P(None, None),
-            "w_gate": P(None, None, "tp"),
-            "w_up": P(None, None, "tp"),
-            "w_down": P(None, "tp", None),
-        },
-        "final_norm": P(None),
-    }
-    if cfg.attention_bias:
-        # biases shard with their projection's output dim
-        specs["layers"]["bq"] = P(None, "tp")
-        specs["layers"]["bk"] = P(None, "tp")
-        specs["layers"]["bv"] = P(None, "tp")
-    if getattr(cfg, "qk_norm", False):
-        # per-head-dim norms apply identically on every (tp-sharded) head
-        specs["layers"]["q_norm"] = P(None, None)
-        specs["layers"]["k_norm"] = P(None, None)
-    if getattr(cfg, "post_block_norms", False):
-        # Gemma2 post-sublayer norms act on the replicated hidden dim
-        specs["layers"]["post_attn_norm"] = P(None, None)
-        specs["layers"]["post_mlp_norm"] = P(None, None)
-    if quantized:
-        # int8 per-output-channel scales [L, 1, out] shard with their
-        # weight's output dim (w_down's output is the unsharded hidden)
-        for name in ("wq", "wk", "wv", "w_gate", "w_up"):
-            specs["layers"][name + "_scale"] = P(None, None, "tp")
-        specs["layers"]["wo_scale"] = P(None, None, None)
-        specs["layers"]["w_down_scale"] = P(None, None, None)
-    if not cfg.tie_word_embeddings:
-        specs["lm_head"] = P(None, "tp")
-    return specs
+def llama_param_specs(
+    cfg: LlamaConfig, quantized: bool = False,
+    rules: Optional[LogicalAxisRules] = None,
+) -> dict:
+    """PartitionSpecs for llama-family params: `llama_logical_axes`
+    resolved through the rule table (default table when None)."""
+    return resolve(llama_logical_axes(cfg, quantized=quantized), rules)
 
 
-def kv_cache_spec(shard_heads: bool = True) -> P:
-    # [L, P, S, Hkv, D] — kv heads ride with their tp shard. MQA-shaped
-    # caches (MLA's shared latent: Hkv=1) replicate instead.
-    return P(None, None, None, "tp" if shard_heads else None, None)
+def kv_logical_axes(shard_heads: bool = True):
+    """[L, P, S, Hkv, D] page pool: kv heads ride with their tp shard.
+    MQA-shaped caches (MLA's shared latent: Hkv=1) replicate instead."""
+    return L(
+        "layers", "kv_pages", "kv_seq",
+        "kv_heads" if shard_heads else None, None,
+    )
 
 
-def batch_spec(ndim: int) -> P:
+def kv_cache_spec(
+    shard_heads: bool = True,
+    rules: Optional[LogicalAxisRules] = None,
+) -> P:
+    return resolve(kv_logical_axes(shard_heads), rules)
+
+
+def batch_spec(
+    ndim: int, rules: Optional[LogicalAxisRules] = None
+) -> P:
     # [B, ...] request-batch tensors shard over dp.
-    return P(*(("dp",) + (None,) * (ndim - 1)))
+    return resolve(L(*(("batch",) + (None,) * (ndim - 1))), rules)
 
 
 def shardings_for(mesh: Mesh, specs: Any):

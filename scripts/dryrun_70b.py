@@ -17,6 +17,14 @@ Two halves:
 Writes artifacts/dryrun_70b.json. Run:
   XLA_FLAGS=--xla_force_host_platform_device_count=16 JAX_PLATFORMS=cpu \
       python scripts/dryrun_70b.py
+
+A third, chip-free mode (ISSUE 20):
+  python scripts/dryrun_70b.py --check-rules
+dry-resolves EVERY registry preset's logical axis names through the
+one rule table under both the 1-host layout and the tp=8 x dp=2 pod
+layout — no weights, no mesh, no devices. A model declaring a logical
+axis the table doesn't know fails here (UnknownLogicalAxisError) as a
+fast tier-1 test instead of an on-chip surprise.
 """
 
 from __future__ import annotations
@@ -191,7 +199,76 @@ def execution_proof() -> dict:
     }
 
 
+#: axis sizes of the two layouts --check-rules validates against
+CHECK_RULES_LAYOUTS = {
+    "1-host": {"dp": 1, "sp": 1, "ep": 1, "tp": 1},
+    "tp=8,dp=2": {"dp": 2, "sp": 1, "ep": 1, "tp": 8},
+}
+
+
+def check_rules() -> dict:
+    """Dry-resolve every registry preset x {fp, quantized} through the
+    logical-axis rule table; validate every resolved PartitionSpec only
+    references mesh axes the layouts actually have. Raises on any
+    unknown logical axis name. Pure metadata — no arrays, no devices."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from dynamo_tpu.models.registry import get_model, list_presets
+    from dynamo_tpu.parallel.logical import default_rules
+    from dynamo_tpu.parallel.shardings import kv_logical_axes
+
+    rules = default_rules()
+    mesh_axes = {a for _, a in rules.rules if a is not None}
+    for layout, sizes in CHECK_RULES_LAYOUTS.items():
+        missing = mesh_axes - set(sizes)
+        assert not missing, f"{layout} lacks mesh axes {missing}"
+
+    presets = list_presets()
+    report = {}
+    for name in presets:
+        adapter = get_model(name, dtype="bfloat16")
+        row = {"leaves": 0, "sharded": {}, "quantized_leaves": 0}
+        for quantized in (False, True):
+            tree = adapter.logical_axes(quantized=quantized)
+            specs = rules.tree_specs(tree)  # raises on unknown names
+            leaves = jax.tree.leaves(
+                specs, is_leaf=lambda x: isinstance(x, P)
+            )
+            for spec in leaves:
+                for axis in spec:
+                    if axis is None:
+                        continue
+                    assert axis in mesh_axes, (
+                        f"{name}: resolved spec {spec} references "
+                        f"unknown mesh axis {axis!r}"
+                    )
+                    if not quantized:
+                        row["sharded"][axis] = (
+                            row["sharded"].get(axis, 0) + 1
+                        )
+            key = "quantized_leaves" if quantized else "leaves"
+            row[key] = len(leaves)
+        assert row["sharded"].get("tp"), (
+            f"{name}: no dim resolves to 'tp' — the rule table left the "
+            "whole model replicated under tensor parallelism"
+        )
+        report[name] = row
+    # the KV page pool rides the same table
+    kv_spec = rules.spec(kv_logical_axes())
+    return {
+        "presets_checked": len(presets),
+        "layouts": CHECK_RULES_LAYOUTS,
+        "rules": rules.doc(),
+        "kv_pool_spec": str(kv_spec),
+        "per_preset": report,
+    }
+
+
 def main() -> None:
+    if "--check-rules" in sys.argv:
+        print(json.dumps(check_rules(), indent=2))
+        return
     out = {"accounting": accounting(), "execution": execution_proof()}
     path = Path(__file__).resolve().parent.parent / "artifacts"
     path.mkdir(parents=True, exist_ok=True)

@@ -80,6 +80,15 @@ bench_1b_kstep() { # on-device K-step decode window chip arm (ISSUE 16):
                    # with the headline model; read against the 13ms-vs-
                    # 3.7ms roofline gap in docs/PERF.md
                BENCH_KSTEP=8 run_stage bench_1b_kstep python bench.py; }
+bench_1b_tp() { # pod-scale sharding chip arm (ISSUE 20): the headline
+                # model over a tp=4,dp=2 logical-axis mesh with the
+                # multi-host decode pipeline live — multihost_pipeline_ab
+                # extras carry the modeled ms/token win vs the old
+                # multi-host auto-off (the CPU contract pins >=1.5x;
+                # read the on-chip ratio here)
+               BENCH_MULTIHOST=1 BENCH_MULTIHOST_TOPOLOGY=tp=4,dp=2 \
+               BENCH_TOPOLOGY=tp=4,dp=2 \
+               run_stage bench_1b_tp python bench.py; }
 bench_1b_prefixmig() { # per-prefix KV migration chip arm (ISSUE 18):
                    # prefix_migration_ab extras — turn-2 TTFT with the
                    # session's hot prefix chain migrated vs cold
@@ -106,7 +115,7 @@ disagg_ab()  { run_stage disagg_ab python -m benchmarks.disagg_bench \
                  --num-pages 1024 --max-context 4096 --max-local-prefill 256 \
                  --requests 32 --isl 1024 --osl 64 --concurrency 8; }
 
-STAGES_ALL=(bench_1b bench_1b_kvq bench_1b_mixed bench_1b_spec bench_1b_kstep bench_1b_prefixmig bench_8b transfer sweep sweep_8b sla disagg_ab)
+STAGES_ALL=(bench_1b bench_1b_kvq bench_1b_mixed bench_1b_spec bench_1b_kstep bench_1b_tp bench_1b_prefixmig bench_8b transfer sweep sweep_8b sla disagg_ab)
 # disagg A/B last: two engine processes timeshare the one chip — expect
 # contention; honest multi-chip runs need dp mesh halves or two hosts
 

@@ -141,6 +141,15 @@ bench_1b_kstep() {
   # docs/PERF.md's 13ms-vs-3.7ms host-loop argument.
   BENCH_KSTEP=8 run_stage bench_1b_kstep python bench.py
 }
+bench_1b_tp() {
+  # pod-scale sharding chip arm (ISSUE 20): headline model over a
+  # tp=4,dp=2 logical-axis mesh with the multi-host decode pipeline
+  # live — multihost_pipeline_ab extras carry the modeled ms/token win
+  # vs the old multi-host auto-off (CPU contract pins >=1.5x)
+  BENCH_MULTIHOST=1 BENCH_MULTIHOST_TOPOLOGY=tp=4,dp=2 \
+    BENCH_TOPOLOGY=tp=4,dp=2 \
+    run_stage bench_1b_tp python bench.py
+}
 bench_1b_prefixmig() {
   # per-prefix KV migration chip arm (ISSUE 18): prefix_migration_ab
   # extras — turn-2 TTFT with the session's hot prefix chain migrated

@@ -1,0 +1,414 @@
+"""Logical-axis sharding (parallel/logical.py): the ONE rule table must
+resolve every model's declared logical axes to EXACTLY the
+PartitionSpecs the retired ad-hoc per-model tables hard-coded (the
+refactor's no-regression contract — same specs, same placement, same
+token streams), plus the resolution semantics themselves (ordering,
+fallbacks, unknown-name failure), the tp=2 x dp=2 / EP placement
+matrix, and the `--topology` knob."""
+
+import dataclasses
+
+import jax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from dynamo_tpu.models.llama import LlamaConfig
+from dynamo_tpu.models.mla import MlaConfig, mla_param_specs
+from dynamo_tpu.models.moe import MoeConfig, moe_param_specs
+from dynamo_tpu.parallel import (
+    DEFAULT_RULES,
+    L,
+    LogicalAxisRules,
+    MeshConfig,
+    UnknownLogicalAxisError,
+    make_mesh,
+    parse_topology,
+    resolve,
+    set_rules,
+    shardings_for,
+)
+from dynamo_tpu.parallel.shardings import (
+    batch_spec,
+    kv_cache_spec,
+    llama_param_specs,
+)
+
+# ---------------------------------------------------------------------------
+# Rule-table resolution semantics
+# ---------------------------------------------------------------------------
+
+
+def test_first_matching_rule_wins():
+    rules = LogicalAxisRules(rules=(("x", "tp"), ("x", "dp")))
+    assert rules.spec(L("x")) == P("tp")
+    assert rules.mesh_axis("x") == "tp"
+
+
+def test_fallback_rule_when_mesh_axis_taken():
+    # t5x semantics: "x" takes tp for the first dim; the second "x" dim
+    # can't reuse tp, so the scan continues to the fallback rule.
+    rules = LogicalAxisRules(rules=(("x", "tp"), ("x", "dp")))
+    assert rules.spec(L("x", "x")) == P("tp", "dp")
+    # no fallback left for a third occurrence: replicated
+    assert rules.spec(L("x", "x", "x")) == P("tp", "dp", None)
+
+
+def test_explicit_none_rule_replicates():
+    rules = LogicalAxisRules(rules=(("x", None), ("x", "tp")))
+    # the None rule matches FIRST and terminates the scan
+    assert rules.spec(L("x")) == P(None)
+
+
+def test_none_dim_replicates():
+    assert DEFAULT_RULES.spec(L(None, "heads")) == P(None, "tp")
+
+
+def test_unknown_logical_axis_raises():
+    with pytest.raises(UnknownLogicalAxisError, match="no_such_axis"):
+        DEFAULT_RULES.spec(L("no_such_axis"))
+    with pytest.raises(UnknownLogicalAxisError):
+        DEFAULT_RULES.mesh_axis("no_such_axis")
+
+
+def test_partition_spec_escape_hatch_passes_through():
+    exotic = P(("dp", "tp"), None)
+    assert DEFAULT_RULES.spec(exotic) is exotic
+
+
+def test_tree_resolution_and_set_rules_roundtrip():
+    tree = {"a": L("heads"), "nested": {"b": L(None, "mlp")}}
+    assert resolve(tree) == {"a": P("tp"), "nested": {"b": P(None, "tp")}}
+    # swapping the process-wide table changes resolution; restoring it
+    # restores the default behavior
+    prev = set_rules(LogicalAxisRules(rules=(("heads", None), ("mlp", "dp"))))
+    try:
+        assert resolve(tree) == {
+            "a": P(None), "nested": {"b": P(None, "dp")},
+        }
+    finally:
+        set_rules(prev)
+    assert resolve(tree)["a"] == P("tp")
+
+
+def test_rule_doc_provenance():
+    doc = DEFAULT_RULES.doc()
+    assert ["heads", "tp"] in doc and ["expert", "ep"] in doc
+    assert ["layers", None] in doc
+
+
+# ---------------------------------------------------------------------------
+# Legacy ad-hoc spec equivalence (the refactor's no-regression pin).
+# The three functions below are the RETIRED hard-coded tables, verbatim;
+# the rule-table resolution must reproduce them leaf for leaf.
+# ---------------------------------------------------------------------------
+
+
+def _legacy_llama_param_specs(cfg, quantized=False):
+    specs = {
+        "embed": P(None, "tp"),
+        "layers": {
+            "attn_norm": P(None, None),
+            "wq": P(None, None, "tp"),
+            "wk": P(None, None, "tp"),
+            "wv": P(None, None, "tp"),
+            "wo": P(None, "tp", None),
+            "mlp_norm": P(None, None),
+            "w_gate": P(None, None, "tp"),
+            "w_up": P(None, None, "tp"),
+            "w_down": P(None, "tp", None),
+        },
+        "final_norm": P(None),
+    }
+    if cfg.attention_bias:
+        specs["layers"]["bq"] = P(None, "tp")
+        specs["layers"]["bk"] = P(None, "tp")
+        specs["layers"]["bv"] = P(None, "tp")
+    if getattr(cfg, "qk_norm", False):
+        specs["layers"]["q_norm"] = P(None, None)
+        specs["layers"]["k_norm"] = P(None, None)
+    if getattr(cfg, "post_block_norms", False):
+        specs["layers"]["post_attn_norm"] = P(None, None)
+        specs["layers"]["post_mlp_norm"] = P(None, None)
+    if quantized:
+        for name in ("wq", "wk", "wv", "w_gate", "w_up"):
+            specs["layers"][name + "_scale"] = P(None, None, "tp")
+        specs["layers"]["wo_scale"] = P(None, None, None)
+        specs["layers"]["w_down_scale"] = P(None, None, None)
+    if not cfg.tie_word_embeddings:
+        specs["lm_head"] = P(None, "tp")
+    return specs
+
+
+def _legacy_moe_param_specs(cfg, quantized=False):
+    specs = _legacy_llama_param_specs(cfg.base, quantized=quantized)
+    layers = specs["layers"]
+    for name in ("w_gate", "w_up", "w_down"):
+        del layers[name]
+        layers.pop(name + "_scale", None)
+    layers["w_router"] = P(None, None, None)
+    layers["we_gate"] = P(None, "ep", None, "tp")
+    layers["we_up"] = P(None, "ep", None, "tp")
+    layers["we_down"] = P(None, "ep", "tp", None)
+    if quantized:
+        layers["we_gate_scale"] = P(None, "ep", None, "tp")
+        layers["we_up_scale"] = P(None, "ep", None, "tp")
+        layers["we_down_scale"] = P(None, "ep", None, None)
+    if cfg.shared_expert:
+        layers["ws_gate"] = P(None, None, "tp")
+        layers["ws_up"] = P(None, None, "tp")
+        layers["ws_down"] = P(None, "tp", None)
+    if cfg.router_bias:
+        layers["b_router"] = P(None, None)
+    if cfg.expert_mlp == "gpt_oss":
+        layers["be_gate"] = P(None, "ep", "tp")
+        layers["be_up"] = P(None, "ep", "tp")
+        layers["be_down"] = P(None, "ep", None)
+    if cfg.base.attn_sinks:
+        layers["sinks"] = P(None, "tp")
+    if cfg.base.attention_out_bias:
+        layers["bo"] = P(None, None)
+    return specs
+
+
+def _legacy_mla_param_specs(cfg, quantized=False):
+    from dynamo_tpu.models.mla import _QUANT_2D, _QUANT_EXPERTS
+
+    def attn_specs(moe):
+        specs = {
+            "attn_norm": P(),
+            "wkv_a": P(),
+            "kv_a_norm": P(),
+            "wkv_b": P(None, None, "tp"),
+            "wo": P(None, "tp", None),
+            "mlp_norm": P(),
+        }
+        if cfg.q_lora_rank:
+            specs.update(
+                wq_a=P(), q_a_norm=P(), wq_b=P(None, None, "tp")
+            )
+        else:
+            specs["wq"] = P(None, None, "tp")
+        if not moe:
+            specs.update(
+                w_gate=P(None, None, "tp"), w_up=P(None, None, "tp"),
+                w_down=P(None, "tp", None),
+            )
+        else:
+            specs.update(
+                w_router=P(),
+                **(
+                    {"router_bias": P()}
+                    if cfg.topk_method == "noaux_tc"
+                    else {}
+                ),
+                we_gate=P(None, "ep", None, None),
+                we_up=P(None, "ep", None, None),
+                we_down=P(None, "ep", None, None),
+                ws_gate=P(None, None, "tp"),
+                ws_up=P(None, None, "tp"),
+                ws_down=P(None, "tp", None),
+            )
+        if quantized:
+            for name in list(specs):
+                if name not in _QUANT_2D + _QUANT_EXPERTS:
+                    continue
+                wspec = tuple(specs[name])
+                if name in _QUANT_EXPERTS:
+                    specs[name + "_scale"] = P(None, "ep", None, None)
+                elif wspec and wspec[-1] == "tp":
+                    specs[name + "_scale"] = P(None, None, "tp")
+                else:
+                    specs[name + "_scale"] = P()
+        return specs
+
+    specs = {
+        "embed": P(),
+        "dense_layers": (
+            attn_specs(moe=False) if cfg.num_dense_layers else {}
+        ),
+        "moe_layers": attn_specs(moe=True) if cfg.num_moe_layers else {},
+        "final_norm": P(),
+    }
+    if not cfg.tie_word_embeddings:
+        specs["lm_head"] = P(None, "tp")
+    return specs
+
+
+def _assert_tree_equal(got, want, label):
+    gleaves = jax.tree_util.tree_flatten_with_path(
+        got, is_leaf=lambda x: isinstance(x, P)
+    )[0]
+    wleaves = jax.tree_util.tree_flatten_with_path(
+        want, is_leaf=lambda x: isinstance(x, P)
+    )[0]
+    assert [k for k, _ in gleaves] == [k for k, _ in wleaves], label
+    for (path, g), (_, w) in zip(gleaves, wleaves):
+        assert tuple(g) == tuple(w), f"{label}{jax.tree_util.keystr(path)}"
+
+
+_LLAMA_VARIANTS = {
+    "plain": {},
+    "bias": {"attention_bias": True},
+    "qk_norm": {"qk_norm": True},
+    "post_norms": {"post_block_norms": True},
+    "untied": {"tie_word_embeddings": False},
+}
+
+
+@pytest.mark.parametrize("variant", sorted(_LLAMA_VARIANTS))
+@pytest.mark.parametrize("quantized", [False, True])
+def test_llama_rules_match_legacy_specs(variant, quantized):
+    cfg = dataclasses.replace(
+        LlamaConfig.tiny(), **_LLAMA_VARIANTS[variant]
+    )
+    _assert_tree_equal(
+        llama_param_specs(cfg, quantized=quantized),
+        _legacy_llama_param_specs(cfg, quantized=quantized),
+        f"llama/{variant}",
+    )
+
+
+@pytest.mark.parametrize(
+    "preset", ["tiny", "llama4_tiny", "gpt_oss_tiny", "mixtral_8x7b"]
+)
+@pytest.mark.parametrize("quantized", [False, True])
+def test_moe_rules_match_legacy_specs(preset, quantized):
+    cfg = getattr(MoeConfig, preset)()
+    _assert_tree_equal(
+        moe_param_specs(cfg, quantized=quantized),
+        _legacy_moe_param_specs(cfg, quantized=quantized),
+        f"moe/{preset}",
+    )
+
+
+@pytest.mark.parametrize(
+    "preset", ["tiny", "tiny_moe", "deepseek_v2_lite"]
+)
+@pytest.mark.parametrize("quantized", [False, True])
+def test_mla_rules_match_legacy_specs(preset, quantized):
+    cfg = getattr(MlaConfig, preset)()
+    _assert_tree_equal(
+        mla_param_specs(cfg, quantized=quantized),
+        _legacy_mla_param_specs(cfg, quantized=quantized),
+        f"mla/{preset}",
+    )
+
+
+def test_kv_and_batch_specs_match_legacy():
+    assert kv_cache_spec() == P(None, None, None, "tp", None)
+    assert kv_cache_spec(shard_heads=False) == P(
+        None, None, None, None, None
+    )
+    assert batch_spec(2) == P("dp", None)
+    assert batch_spec(4) == P("dp", None, None, None)
+
+
+# ---------------------------------------------------------------------------
+# tp=2 x dp=2 resolution matrix (incl. EP): every family's logical axes
+# resolve and PLACE on the hybrid-shaped mesh.
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "model", ["tiny", "moe-tiny", "mla-tiny", "mla-tiny-moe"]
+)
+def test_registry_logical_axes_resolve_on_tp2_dp2(model, cpu_mesh_devices):
+    from dynamo_tpu.models.registry import get_model
+
+    adapter = get_model(model)
+    axes = adapter.logical_axes()
+    specs = resolve(axes)
+    mesh = make_mesh(
+        MeshConfig(dp=2, tp=2), devices=cpu_mesh_devices[:4]
+    )
+    params = adapter.init_params(jax.random.key(0))
+    placed = jax.device_put(params, shardings_for(mesh, specs))
+    # tp must actually split something: at least one leaf's local shard
+    # is half the global array
+    halved = False
+    for x in jax.tree.leaves(placed):
+        shard = x.addressable_shards[0].data
+        assert x.size in (shard.size * 4, shard.size * 2, shard.size)
+        halved = halved or shard.size < x.size
+    assert halved, f"{model}: nothing sharded on the tp=2 x dp=2 mesh"
+
+
+def test_moe_expert_dim_lands_on_ep(cpu_mesh_devices):
+    """EP placement: routed-expert weights shard their expert dim over
+    the ep axis (and the expert intermediate dim over tp)."""
+    cfg = MoeConfig.tiny()
+    specs = moe_param_specs(cfg)
+    assert tuple(specs["layers"]["we_gate"]) == (None, "ep", None, "tp")
+    assert tuple(specs["layers"]["we_down"]) == (None, "ep", "tp", None)
+
+    from dynamo_tpu.models.moe import init_params
+
+    mesh = make_mesh(
+        MeshConfig(dp=1, ep=2, tp=2), devices=cpu_mesh_devices[:4]
+    )
+    params = init_params(jax.random.key(0), cfg)
+    placed = jax.device_put(params, shardings_for(mesh, specs))
+    we = placed["layers"]["we_gate"]
+    shard = we.addressable_shards[0].data
+    assert shard.shape[1] == we.shape[1] // 2  # expert dim over ep
+    assert shard.shape[3] == we.shape[3] // 2  # intermediate over tp
+
+
+# ---------------------------------------------------------------------------
+# --topology knob
+# ---------------------------------------------------------------------------
+
+
+def test_parse_topology():
+    assert parse_topology("tp=8,dp=2") == {"tp": 8, "dp": 2}
+    assert parse_topology("tp=2, dp=2, ep=2") == {
+        "tp": 2, "dp": 2, "ep": 2,
+    }
+    for bad in ("pp=2", "tp=0", "tp=x", "tp", "", "tp=2,tp=4"):
+        with pytest.raises(ValueError):
+            parse_topology(bad)
+
+
+def test_engine_config_topology_overrides_axes():
+    from dynamo_tpu.engine import EngineConfig
+
+    cfg = EngineConfig.for_tests(topology="tp=2,dp=4")
+    assert (cfg.dp, cfg.tp, cfg.sp, cfg.ep) == (4, 2, 1, 1)
+    # unnamed axes keep their defaults; a typo fails at construction
+    with pytest.raises(ValueError):
+        EngineConfig.for_tests(topology="pp=2")
+
+
+# ---------------------------------------------------------------------------
+# registry-wide rule audit (scripts/dryrun_70b.py --check-rules)
+# ---------------------------------------------------------------------------
+
+
+def test_check_rules_covers_every_registry_preset():
+    """The chip-free rule audit runs as a fast tier-1 gate: every
+    registry preset's logical axis names must resolve through the one
+    rule table under both audited layouts, every model must land at
+    least one dim on tp, and the audit must cover the full registry."""
+    import importlib.util
+    import pathlib
+
+    repo = pathlib.Path(__file__).resolve().parent.parent
+    spec = importlib.util.spec_from_file_location(
+        "dryrun_70b", repo / "scripts" / "dryrun_70b.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    from dynamo_tpu.models.registry import list_presets
+
+    rep = mod.check_rules()
+    assert rep["presets_checked"] == len(list_presets())
+    assert set(rep["per_preset"]) == set(list_presets())
+    assert set(rep["layouts"]) == {"1-host", "tp=8,dp=2"}
+    assert ["expert", "ep"] in rep["rules"]
+    assert rep["kv_pool_spec"] == "PartitionSpec(None, None, None, 'tp', None)"
+    for name, row in rep["per_preset"].items():
+        assert row["leaves"] > 0 and row["quantized_leaves"] > 0, name
+        assert row["sharded"].get("tp", 0) > 0, name
+    # MoE presets place their routed-expert stacks on ep
+    assert rep["per_preset"]["mixtral-8x7b"]["sharded"].get("ep", 0) > 0
